@@ -1,0 +1,99 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: whiker/Paddle), built on JAX/XLA/Pallas.
+
+Architecture (vs the reference, SURVEY.md §1/§7):
+  - PHI kernel library + CINN + executors  →  XLA (jit/pjit) + Pallas kernels
+  - eager autograd engine (grad nodes)     →  jax.grad over nn.functional_call
+  - ProcessGroupNCCL + fleet topology      →  jax.sharding.Mesh + collectives
+  - ProgramDesc/PIR                        →  jaxprs/StableHLO (jit.to_static)
+
+Top-level namespace mirrors ``import paddle``.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# submodules (paddle parity layout)
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import framework  # noqa: F401
+from . import core  # noqa: F401
+
+# tensor ops at top level (paddle.add, paddle.matmul, ...)
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation as _creation
+
+# framework-level API
+from .framework import (seed, save, load, get_rng_state, set_rng_state,  # noqa: F401
+                        set_default_dtype, get_default_dtype)
+from .framework.random import rng_context, next_rng_key  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .autograd import no_grad, grad, enable_grad, is_grad_enabled  # noqa: F401
+from .nn.layer import ParamAttr  # noqa: F401
+
+# dtype aliases (paddle.float32 etc.)
+import jax.numpy as _jnp
+float16 = _jnp.float16
+bfloat16 = _jnp.bfloat16
+float32 = _jnp.float32
+float64 = _jnp.float64
+int8 = _jnp.int8
+int16 = _jnp.int16
+int32 = _jnp.int32
+int64 = _jnp.int64
+uint8 = _jnp.uint8
+bool = _jnp.bool_
+complex64 = _jnp.complex64
+complex128 = _jnp.complex128
+
+Tensor = _jax.Array
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return len(_jax.devices())
+
+
+def set_device(device: str):
+    """Parity no-op: device placement is XLA's job; kept for script parity."""
+    return device
+
+
+def get_device() -> str:
+    d = _jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def stop_gradient(x):
+    return _jax.lax.stop_gradient(x)
+
+
+# lazily-importable heavy submodules (distributed, vision, io, jit, hapi...)
+# are imported on attribute access to keep `import paddle_tpu` fast.
+_LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
+         "profiler", "static", "kernels", "text", "audio", "sparse",
+         "inference", "device", "ops"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

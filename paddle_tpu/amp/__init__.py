@@ -1,0 +1,17 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py (O1 white/black lists, O2 pure
+fp16/bf16), grad_scaler.py — GradScaler dynamic loss scaling,
+amp.decorate master-weight conversion (SURVEY.md §2.2 "AMP").
+
+TPU-native notes: bf16 is the native mixed-precision dtype on TPU and needs
+NO loss scaling (exponent range equals fp32) — GradScaler is provided for
+fp16 parity and as a no-op-by-default on bf16.  ``auto_cast`` installs a
+thread-local policy consulted by the matmul-class functionals (linear, conv,
+attention): O1 casts just those inputs; O2 expects ``decorate`` to have cast
+parameters.
+"""
+
+from .auto_cast import (auto_cast, amp_guard, is_auto_cast_enabled,  # noqa: F401
+                        amp_state, decorate, white_list, black_list)
+from .grad_scaler import GradScaler  # noqa: F401

@@ -1,0 +1,90 @@
+"""auto_cast / decorate (see package docstring)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+# O1 lists mirror the reference's defaults (python/paddle/amp/auto_cast.py —
+# WHITE_LIST/BLACK_LIST): matmul-class ops cast down; reductions/softmax/norms
+# stay fp32.
+white_list = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "einsum",
+              "attention"}
+black_list = {"softmax", "log_softmax", "layer_norm", "batch_norm", "mean",
+              "sum", "cross_entropy", "exp", "log"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype if _state.enabled else None
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """Parity: paddle.amp.auto_cast."""
+    prev = (_state.enabled, _state.dtype, _state.level)
+    _state.enabled = enable
+    _state.dtype = jnp.dtype(dtype)
+    _state.level = level
+    if custom_white_list:
+        white_list.update(custom_white_list)
+    if custom_black_list:
+        black_list.update(custom_black_list)
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype=None):
+    """O2: cast model params to bf16/fp16; optimizer keeps fp32 masters via
+    multi_precision (parity: paddle.amp.decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=jnp.dtype(dtype))
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for o in opt_list:
+            if master_weight is None or master_weight:
+                o.multi_precision = True
+        if single and opt_single:
+            return model_list[0], opt_list[0]
+        return model_list if not single else model_list[0], opt_list
+    return model_list[0] if single else model_list
+
+
+def maybe_cast(x, op_name: str):
+    """Called by matmul-class functionals to apply O1 policy."""
+    if _state.enabled and op_name in white_list and \
+            hasattr(x, "dtype") and x.dtype == jnp.float32:
+        return x.astype(_state.dtype)
+    return x

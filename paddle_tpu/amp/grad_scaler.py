@@ -1,0 +1,100 @@
+"""GradScaler — dynamic loss scaling (reference:
+python/paddle/amp/grad_scaler.py — GradScaler/AmpScaler).
+
+On TPU bf16 training doesn't need scaling; this exists for fp16 parity and
+for tests asserting reference semantics (init scale, growth/backoff on
+inf/nan).  Works functionally: ``scale(loss)``, then ``unscale(grads)`` →
+(grads, found_inf); ``update(found_inf)`` adjusts the scale on host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1, use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    is_use_dynamic_loss_scaling = lambda self: self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale(self, grads):
+        """Returns (unscaled_grads, found_inf: bool array)."""
+        if not self._enable:
+            return grads, jnp.asarray(False)
+        inv = 1.0 / self._scale
+        unscaled = jax.tree.map(lambda g: g * inv, grads)
+        leaves = jax.tree.leaves(unscaled)
+        found = jnp.asarray(False)
+        for g in leaves:
+            found = found | ~jnp.all(jnp.isfinite(g))
+        return unscaled, found
+
+    # reference name
+    def unscale_(self, optimizer=None, grads=None):
+        return self.unscale(grads)
+
+    def update(self, found_inf) -> None:
+        """Host-side scale adjustment (call with a concrete bool)."""
+        if not (self._enable and self._dynamic):
+            return
+        if bool(found_inf):
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def step(self, optimizer, grads=None):
+        """Eager parity: unscale + skip-on-inf + optimizer.step."""
+        if not self._enable:
+            optimizer.step(grads)
+            return
+        unscaled, found = self.unscale(grads)
+        if not bool(found):
+            optimizer.step(unscaled)
+        self.update(found)
+
+    def minimize(self, optimizer, scaled_loss=None, grads=None):
+        self.step(optimizer, grads)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

@@ -1,0 +1,181 @@
+"""Autograd surface.
+
+Reference: python/paddle/autograd/ — backward, paddle.grad, PyLayer custom
+autograd, no_grad (SURVEY.md §2.2 "autograd"); the C++ engine it fronts
+(paddle/fluid/eager/backward.cc — egr::Backward) is replaced wholesale by
+JAX trace-based AD: ``grad``/``value_and_grad`` over functional_call.
+
+Deviation note (documented, deliberate): there is no per-tensor
+``.backward()`` tape — JAX arrays are immutable values.  ``PyLayer`` maps to
+``jax.custom_vjp`` with the same ctx.save_for_backward idiom.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "value_and_grad", "jacobian", "hessian", "vjp", "jvp",
+           "no_grad", "enable_grad", "is_grad_enabled", "PyLayer",
+           "PyLayerContext", "backward"]
+
+grad_fn = jax.grad
+
+
+def grad(outputs=None, inputs=None, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, fn: Callable | None = None, argnums=0):
+    """Two modes:
+    * functional (TPU-native): ``grad(fn=f, argnums=0)`` → jax.grad wrapper.
+    * parity signature raises with guidance (eager tape doesn't exist).
+    """
+    if fn is not None:
+        return jax.grad(fn, argnums=argnums)
+    if callable(outputs):
+        return jax.grad(outputs, argnums=argnums)
+    raise RuntimeError(
+        "paddle_tpu.autograd.grad needs a function: use "
+        "grad(fn, argnums=...) or value_and_grad over nn.functional_call — "
+        "there is no imperative tape in the TPU-native engine.")
+
+
+def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def jacobian(ys=None, xs=None, *, fn: Callable | None = None, argnums=0,
+             mode: str = "reverse"):
+    f = fn if fn is not None else ys
+    if not callable(f):
+        raise RuntimeError("jacobian needs a function (fn=...)")
+    return (jax.jacrev if mode == "reverse" else jax.jacfwd)(f, argnums=argnums)
+
+
+def hessian(ys=None, xs=None, *, fn: Callable | None = None, argnums=0):
+    f = fn if fn is not None else ys
+    if not callable(f):
+        raise RuntimeError("hessian needs a function (fn=...)")
+    return jax.hessian(f, argnums=argnums)
+
+
+def vjp(func: Callable, xs, v=None):
+    primals, vjp_fn = jax.vjp(func, *(xs if isinstance(xs, (list, tuple)) else (xs,)))
+    if v is None:
+        return primals, vjp_fn
+    return primals, vjp_fn(v)
+
+
+def jvp(func: Callable, xs, v=None):
+    xs_t = xs if isinstance(xs, (list, tuple)) else (xs,)
+    if v is None:
+        v = tuple(jnp.ones_like(x) for x in xs_t)
+    v_t = v if isinstance(v, (list, tuple)) else (v,)
+    return jax.jvp(func, tuple(xs_t), tuple(v_t))
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Parity context: in a functional engine nothing records by default;
+    provided so reference code runs unchanged.  For actually stopping
+    gradient flow use jax.lax.stop_gradient / Tensor stop_gradient."""
+    yield
+
+
+@contextlib.contextmanager
+def enable_grad():
+    yield
+
+
+def is_grad_enabled() -> bool:
+    return True
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    raise RuntimeError(
+        "loss.backward() does not exist in the TPU-native engine; build the "
+        "step as jax.value_and_grad(loss_fn) over nn.functional_call "
+        "(see paddle_tpu.hapi.Model or docs/training.md).")
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable = tensors
+
+
+class _PyLayerMeta(type):
+    def __new__(mcls, name, bases, ns):
+        cls = super().__new__(mcls, name, bases, ns)
+        if name != "PyLayer" and "forward" in ns:
+            cls._build()
+        return cls
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    """Custom autograd op (parity: paddle.autograd.PyLayer) on jax.custom_vjp.
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x ** 3
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor
+                return 3 * x ** 2 * dy
+
+        y = Cube.apply(x)
+    """
+
+    @classmethod
+    def _build(cls):
+        def fwd_only(*args):
+            ctx = PyLayerContext()
+            return cls.forward(ctx, *args)
+
+        f = jax.custom_vjp(fwd_only)
+
+        def fwd(*args):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *args)
+            return out, (ctx, args)
+
+        def bwd(res, g):
+            ctx, args = res
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # pad for non-tensor args
+            out = []
+            gi = iter(grads)
+            for a in args:
+                if isinstance(a, jax.Array) or hasattr(a, "dtype"):
+                    out.append(next(gi, None))
+                else:
+                    out.append(None)
+            return tuple(out)
+
+        f.defvjp(fwd, bwd)
+        cls._fn = staticmethod(f)
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        if kwargs:
+            raise ValueError("PyLayer.apply takes positional args only")
+        return cls._fn(*args)

@@ -1,0 +1,1 @@
+from .flags import define_flag, set_flags, get_flags, flags  # noqa: F401

@@ -1,0 +1,21 @@
+"""Framework-level utilities: RNG, io, dtype defaults."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .random import (seed, get_rng_state, set_rng_state,  # noqa: F401
+                     default_generator, Generator, RNGStatesTracker,
+                     get_rng_state_tracker, rng_context, next_rng_key)
+from .io import save, load  # noqa: F401
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    _default_dtype = jnp.dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load parity (reference: python/paddle/framework/io.py
+— pickled state_dict of params/opt-state).
+
+Format: numpy-converted pytree in a pickle file (portable, no jax dep to
+read); nested dicts/lists/scalars preserved.  Distributed shard-aware
+checkpointing lives in paddle_tpu.distributed.checkpoint (orbax-style).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PDTPU001"
+
+
+def _to_numpy_tree(obj: Any):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        vals = [_to_numpy_tree(v) for v in obj]
+        try:
+            return t(vals)
+        except TypeError:  # namedtuple
+            return t(*vals)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def _to_jax_tree(obj: Any, return_numpy: bool):
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else jnp.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_jax_tree(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        vals = [_to_jax_tree(v, return_numpy) for v in obj]
+        try:
+            return t(vals)
+        except TypeError:
+            return t(*vals)
+    return obj
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _to_jax_tree(obj, return_numpy)

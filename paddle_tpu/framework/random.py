@@ -1,0 +1,182 @@
+"""RNG state management, TPU-native.
+
+Reference surface (upstream Paddle; see SURVEY.md §0 provenance — mount was
+empty, citations are path—symbol pairs):
+  - ``paddle.seed`` — python/paddle/framework/random.py — seed
+  - RNGStatesTracker — python/paddle/distributed/fleet/meta_parallel/
+    parallel_layers/random.py — RNGStatesTracker, get_rng_state_tracker
+
+Design (TPU-first): JAX PRNG keys are functional.  We keep
+
+  * a process-global *default generator* used by eager code (layer init,
+    eager dropout) — a stateful splitter around a ``jax.random.key``;
+  * a context-local *traced key stack* used inside ``functional_call`` /
+    jitted train steps: the caller passes one key per call, layers pull
+    fresh subkeys via :func:`next_rng_key` (splitting a tracer key is a
+    traced, functional op, so this is jit-safe);
+  * :class:`RNGStatesTracker` with named streams for parallelism-aware
+    determinism (e.g. dropout inside a tensor-parallel region must differ
+    per mp rank while matching across dp ranks) — mirrors the reference's
+    tracker used by fleet's recompute/mp layers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "seed",
+    "get_rng_state",
+    "set_rng_state",
+    "default_generator",
+    "Generator",
+    "next_rng_key",
+    "rng_context",
+    "has_rng_context",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+]
+
+
+class Generator:
+    """A stateful splitter over a functional JAX PRNG key.
+
+    Eager-only convenience (never used under trace): each :meth:`next_key`
+    splits the internal key.  Inside jit, use :func:`rng_context`.
+    """
+
+    def __init__(self, seed_: int = 0):
+        self._key = jax.random.key(seed_)
+        self._seed = seed_
+        self._lock = threading.Lock()
+
+    def seed(self, seed_: int) -> None:
+        with self._lock:
+            self._key = jax.random.key(seed_)
+            self._seed = seed_
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key) -> None:
+        with self._lock:
+            self._key = key
+
+
+default_generator = Generator(0)
+
+
+def seed(seed_: int) -> Generator:
+    """Set the global default seed (parity: ``paddle.seed``)."""
+    default_generator.seed(seed_)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    default_generator.set_state(state)
+
+
+class _RngCtx(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_rng_ctx = _RngCtx()
+
+
+@contextlib.contextmanager
+def rng_context(key: jax.Array):
+    """Provide a PRNG key to all :func:`next_rng_key` calls in scope.
+
+    The key may be a tracer: splitting happens with traced ops, so a single
+    key threaded into a jitted step deterministically seeds every dropout /
+    random op in the model.
+    """
+    _rng_ctx.stack.append([key])
+    try:
+        yield
+    finally:
+        _rng_ctx.stack.pop()
+
+
+def has_rng_context() -> bool:
+    return bool(_rng_ctx.stack)
+
+
+def next_rng_key() -> jax.Array:
+    """Pull a fresh subkey: from the innermost :func:`rng_context` if one is
+    active (jit-safe), else from the global default generator (eager)."""
+    if _rng_ctx.stack:
+        cell = _rng_ctx.stack[-1]
+        cell[0], sub = jax.random.split(cell[0])
+        return sub
+    return default_generator.next_key()
+
+
+class RNGStatesTracker:
+    """Named RNG streams (parity: fleet ``RNGStatesTracker``).
+
+    The reference forks CUDA RNG states per stream so tensor-parallel ranks
+    get decorrelated dropout while replicas stay in lockstep.  Here each
+    stream is a fold of the base key with a stable per-stream offset;
+    :meth:`rng_state` temporarily routes :func:`next_rng_key` to the stream.
+    """
+
+    def __init__(self):
+        self._streams: dict[str, int] = {}
+        self._base_seed = 0
+
+    def reset(self) -> None:
+        self._streams.clear()
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self._streams:
+            raise ValueError(f"rng stream {name!r} already exists")
+        if seed_ in self._streams.values():
+            raise ValueError(f"seed {seed_} already used for another stream")
+        self._streams[name] = seed_
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng"):
+        if name not in self._streams:
+            raise ValueError(f"rng stream {name!r} not added")
+        stream_seed = self._streams[name]
+        if _rng_ctx.stack:
+            base = _rng_ctx.stack[-1][0]
+            folded = jax.random.fold_in(base, np.uint32(stream_seed))
+            with rng_context(folded):
+                yield
+        else:
+            gen = Generator(stream_seed)
+            with rng_context(gen.next_key()):
+                yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed_: int = 0, mp_rank: int = 0) -> None:
+    """Seed global + tracker streams the way fleet does: global stream shared
+    across mp ranks, ``model_parallel_rng`` offset per mp rank."""
+    _tracker.reset()
+    seed(seed_)
+    _tracker.add("global_seed", seed_ + 100003)
+    _tracker.add("model_parallel_rng", seed_ + 1 + mp_rank)

@@ -1,0 +1,11 @@
+"""paddle_tpu.nn — the layer zoo (parity surface: python/paddle/nn/)."""
+
+from .layer import Layer, Parameter, ParamAttr  # noqa: F401
+from .functional_call import (  # noqa: F401
+    functional_call, state, parameters_dict, buffers_dict, bind_state,
+    TrainState)
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+from .layers import *  # noqa: F401,F403
+from .layers import (  # noqa: F401
+    container, common, conv, norm, pooling, activation, loss, transformer)

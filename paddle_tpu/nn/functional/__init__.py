@@ -1,0 +1,13 @@
+"""paddle_tpu.nn.functional — functional op surface (parity:
+python/paddle/nn/functional/)."""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .input import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from . import activation, common, conv, norm, pooling, loss, input, attention  # noqa: F401

@@ -1,0 +1,179 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All are thin jnp/lax expressions — XLA fuses them into adjacent matmuls on
+TPU, which is exactly what the reference needs hand-written CUDA epilogues
+for (paddle/phi/kernels/fusion/ — fused bias+act epilogues).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "relu", "relu6", "relu_", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "softmax", "log_softmax", "leaky_relu", "elu", "selu", "celu",
+    "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "softshrink",
+    "tanhshrink", "softplus", "softsign", "mish", "glu", "swiglu",
+    "prelu", "rrelu", "maxout", "thresholded_relu", "log_sigmoid",
+    "gumbel_softmax",
+]
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+relu_ = relu  # in-place alias for parity; arrays are immutable here
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softmax(x, axis: int = -1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x, scale: float = 1.0507009873554805, alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha: float = 1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardsigmoid(x, slope: float = 1.0 / 6, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softshrink(x, threshold: float = 0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.logaddexp(bx, 0.0) / beta)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def mish(x):
+    return x * jnp.tanh(softplus(x))
+
+
+def glu(x, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, y=None):
+    """paddle.incubate.nn.functional.swiglu parity: silu(x) * y (y defaults
+    to the second half of x)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def prelu(x, weight):
+    w = jnp.asarray(weight)
+    if w.ndim == 1 and w.shape[0] > 1:
+        shape = [1] * x.ndim
+        shape[1] = w.shape[0]  # NCHW channel dim, paddle default
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def rrelu(x, lower: float = 1.0 / 8, upper: float = 1.0 / 3, training: bool = False):
+    if training:
+        from ...framework.random import next_rng_key
+        a = jax.random.uniform(next_rng_key(), x.shape, dtype=x.dtype,
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def maxout(x, groups: int, axis: int = 1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False, axis: int = -1):
+    from ...framework.random import next_rng_key
+    g = jax.random.gumbel(next_rng_key(), x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        # straight-through: forward = one-hot, backward = soft
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y

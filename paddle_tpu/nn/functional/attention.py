@@ -1,0 +1,123 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py —
+scaled_dot_product_attention / flash_attention routing to the CUDA
+flash-attn-2 kernels (paddle/phi/kernels/gpu/flash_attn_kernel.cu, built by
+cmake/external/flashattn.cmake).
+
+TPU-native: the default path is a pure-XLA softmax(QK^T)V which XLA already
+executes well for moderate seq; long-seq routes to the Pallas flash kernel
+(paddle_tpu/kernels/flash_attention.py) when FLAGS_use_pallas_attention and
+the platform is TPU.  Layout is paddle's: [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.flags import flags
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdpa_reference"]
+
+
+def _causal_mask(sq, sk, dtype):
+    i = jnp.arange(sq)[:, None]
+    j = jnp.arange(sk)[None, :]
+    return jnp.where(j <= i + (sk - sq), 0.0, jnp.finfo(dtype).min)
+
+
+def sdpa_reference(query, key, value, attn_mask=None, dropout_p: float = 0.0,
+                   is_causal: bool = False, scale: Optional[float] = None,
+                   training: bool = True):
+    """Pure-XLA reference path. q/k/v: [B, S, H, D] (paddle layout)."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    kh = key.shape[2]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    q = jnp.moveaxis(query, 1, 2)   # [B,H,Sq,D]
+    k = jnp.moveaxis(key, 1, 2)
+    v = jnp.moveaxis(value, 1, 2)
+    if kh != h:  # grouped-query attention: repeat kv heads
+        rep = h // kh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        logits = logits + _causal_mask(sq, sk, jnp.float32)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and training:
+        from .common import dropout as _dropout
+        probs = _dropout(probs, p=dropout_p, training=True)
+    probs = probs.astype(v.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.moveaxis(out, 1, 2)  # back to [B,S,H,D]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0, is_causal: bool = False,
+                                 training: bool = True, name=None):
+    """Parity: paddle F.scaled_dot_product_attention ([B,S,H,D] layout).
+
+    Routes to the Pallas TPU flash kernel when profitable, else pure XLA.
+    """
+    use_pallas = (
+        flags.use_pallas_attention
+        and attn_mask is None
+        and dropout_p == 0.0
+        and query.shape[1] >= 512 and key.shape[1] >= 512
+        and query.shape[-1] in (64, 128, 256)
+        and jax.default_backend() not in ("cpu",)
+    )
+    if use_pallas:
+        try:
+            from ...kernels.flash_attention import flash_attention as _pallas_fa
+            return _pallas_fa(query, key, value, causal=is_causal)
+        except Exception:
+            pass  # fall back to XLA path (e.g. unsupported shape/platform)
+    return sdpa_reference(query, key, value, attn_mask, dropout_p, is_causal,
+                          training=training)
+
+
+def flash_attention(query, key, value, dropout: float = 0.0,
+                    causal: bool = False, return_softmax: bool = False,
+                    fixed_seed_offset=None, rng_name: str = "", training=True,
+                    name=None):
+    """Parity: paddle F.flash_attention.flash_attention -> (out, softmax)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training=training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale: float,
+                        dropout: float = 0.0, causal: bool = False,
+                        return_softmax: bool = False, name=None):
+    """Varlen API parity: total-token packed layout [T, H, D] with cu_seqlens.
+
+    Implemented by segment-masking the dense path (static shapes for XLA);
+    fine for tests; perf path should batch fixed shapes.
+    """
+    t, h, d = query.shape
+    seg_q = jnp.cumsum(jnp.zeros(t, jnp.int32).at[cu_seqlens_q[1:-1]].add(1))
+    seg_k = jnp.cumsum(jnp.zeros(key.shape[0], jnp.int32).at[cu_seqlens_k[1:-1]].add(1))
+    logits = jnp.einsum("qhd,khd->hqk", query, key,
+                        preferred_element_type=jnp.float32) * scale
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        pos_q = jnp.arange(t) - jnp.take(cu_seqlens_q, seg_q)
+        pos_k = jnp.arange(key.shape[0]) - jnp.take(cu_seqlens_k, seg_k)
+        mask = mask & (pos_k[None, :] <= pos_q[:, None])
+    logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(value.dtype)
+    out = jnp.einsum("hqk,khd->qhd", probs, value)
+    return (out, None)

@@ -1,0 +1,259 @@
+"""Common functionals: linear, dropout, padding, interpolate, fold/unfold...
+
+Reference: python/paddle/nn/functional/common.py — linear, dropout, pad,
+interpolate, ... (SURVEY.md §2.2 "Functional").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.random import next_rng_key
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
+           "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+           "label_smooth", "unfold", "fold", "zeropad2d"]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's weight layout W:[in, out].
+
+    On TPU this is the MXU primitive; keep inputs bf16-batched and XLA fuses
+    the bias add (the reference needs cuBLASLt epilogues for that —
+    paddle/phi/kernels/fusion — fused_linear).
+    """
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def dropout(x, p: float = 0.5, axis=None, training: bool = True,
+            mode: str = "upscale_in_train", name=None, rng_key=None):
+    """Parity: paddle F.dropout incl. the legacy 'downscale_in_infer' mode."""
+    if p == 0.0 or not training:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1 - p)
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    if rng_key is None:
+        from ...framework.random import has_rng_context
+        import jax.core as _core
+        if not has_rng_context() and isinstance(x, _core.Tracer):
+            # without a threaded key, the eager generator's concrete key
+            # would be baked into the compiled program -> identical mask
+            # every step, silently corrupting training
+            raise RuntimeError(
+                "dropout traced under jit without an RNG context: pass "
+                "rng=key to nn.functional_call (or wrap with "
+                "paddle_tpu.rng_context(key)) so each step draws a fresh "
+                "mask")
+    key = rng_key if rng_key is not None else next_rng_key()
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x)).astype(x.dtype)
+    return jnp.where(keep, x, jnp.zeros_like(x)).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = next_rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def _norm_pad(pad_spec, ndim, data_format):
+    """Convert paddle pad spec (flat list, reversed-dims pairs for the
+    spatial form) to jnp.pad config."""
+    if isinstance(pad_spec, int):
+        return [(pad_spec, pad_spec)] * ndim
+    pad_spec = list(pad_spec)
+    if len(pad_spec) == 2 * ndim:
+        # full-form: [(before,after)] per dim in order
+        return [(pad_spec[2 * i], pad_spec[2 * i + 1]) for i in range(ndim)]
+    # spatial form (e.g. NCHW x with [l, r, t, b]): applies to last spatial
+    # dims in reverse order, matching paddle/torch semantics
+    n_spatial = len(pad_spec) // 2
+    cfg = [(0, 0)] * ndim
+    if data_format and data_format.startswith("N") and data_format.endswith("C"):
+        spatial_dims = list(range(1, 1 + (ndim - 2)))
+    else:
+        spatial_dims = list(range(2, ndim))
+    for i in range(n_spatial):
+        dim = spatial_dims[-(i + 1)]
+        cfg[dim] = (pad_spec[2 * i], pad_spec[2 * i + 1])
+    return cfg
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW", name=None):
+    cfg = _norm_pad(pad, x.ndim, data_format)
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, align_mode: int = 0,
+                data_format: str = "NCHW", name=None):
+    """Image resize via jax.image.resize (nearest/bilinear/bicubic/trilinear)."""
+    if data_format in ("NCHW", "NCDHW", "NCL", "NCW"):
+        spatial = list(x.shape[2:])
+        ch_first = True
+    else:
+        spatial = list(x.shape[1:-1])
+        ch_first = False
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size/scale_factor required")
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode.lower()]
+    if ch_first:
+        out_shape = x.shape[:2] + tuple(size)
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.resize(x, out_shape, method=method).astype(x.dtype)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, o] = x1[b,:] @ W[o] @ x2[b,:] + bias; W: [out, in1, in2]."""
+    y = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        oc = c // (r * r)
+        x = x.reshape(b, oc, r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(b, oc, h * r, w * r)
+    b, h, w, c = x.shape
+    oc = c // (r * r)
+    x = x.reshape(b, h, w, r, r, oc)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h * r, w * r, oc)
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format="NCHW", name=None):
+    r = downscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = x.reshape(b, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(b, c * r * r, h // r, w // r)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // r, w // r, c * r * r)
+
+
+def channel_shuffle(x, groups: int, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = x.reshape(b, groups, c // groups, h, w)
+        return x.transpose(0, 2, 1, 3, 4).reshape(b, c, h, w)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    return x.transpose(0, 1, 2, 4, 3).reshape(b, h, w, c)
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col: [N,C,H,W] -> [N, C*kh*kw, L] (parity: F.unfold)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (None, None)
+    if ph is None:
+        pt, pl, pb, pr = paddings
+    else:
+        pt = pb = ph
+        pl = pr = pw
+    dh, dw = _pair(dilations)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im inverse of unfold (sum of overlapping patches)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    p = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, ckk, l = x.shape
+    c = ckk // (kh * kw)
+    # scatter-add patches back; use the vjp of unfold for correctness
+    def _unfold_fn(img):
+        return unfold(img, (kh, kw), (sh, sw), (p[0], p[1]), (dh, dw))
+    img_shape = (n, c, oh, ow)
+    _, vjp = jax.vjp(_unfold_fn, jnp.zeros(img_shape, x.dtype))
+    return vjp(x)[0]

@@ -1,0 +1,163 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference: python/paddle/nn/functional/conv.py — conv1d/2d/3d(+transpose)
+backed by phi/kernels/gpudnn/conv_kernel.cu (cuDNN).  On TPU the conv maps
+straight onto the MXU via XLA; weight layout follows paddle: [out_c,
+in_c/groups, *spatial].
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n):
+    """paddle padding: int, list of ints, list of pairs, or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == n:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * n:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+        if len(padding) == 1:
+            return [(padding[0], padding[0])] * n
+    return [tuple(p) for p in padding]
+
+
+def _dimnums(n, channel_last):
+    if n == 1:
+        return ("NWC", "OIW"[:3], "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "OIHW", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "OIDHW", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    _dimnums(n, channel_last))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tuple(stride, n),
+        padding=_padding(padding, n),
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    """Gradient-of-conv semantics matching paddle's conv_transpose: weight is
+    [in_c, out_c/groups, *spatial]."""
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    output_padding = _tuple(output_padding, n)
+    pad_cfg = _padding(padding, n)
+    if isinstance(pad_cfg, str):
+        raise NotImplementedError("SAME/VALID for conv_transpose: use ints")
+
+    # lax.conv_transpose with transpose_kernel=True expects weight [i, o, ...]
+    # laid out as IO+spatial when using the right dimension numbers.
+    if channel_last:
+        x_spec = "N" + "DHW"[3 - n:] + "C" if n == 3 else ("NHWC" if n == 2 else "NWC")
+    else:
+        x_spec = "NC" + ("DHW"[3 - n:] if n == 3 else ("HW" if n == 2 else "W"))
+    k_spec = "IO" + ("DHW"[3 - n:] if n == 3 else ("HW" if n == 2 else "W"))
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (x_spec, k_spec, x_spec))
+
+    # conv_transpose padding p maps to lax padding (k-1)*d - p on each side
+    k_spatial = weight.shape[2:]
+    lax_pad = []
+    for i in range(n):
+        eff_k = (k_spatial[i] - 1) * dilation[i]
+        lo = eff_k - pad_cfg[i][0]
+        hi = eff_k - pad_cfg[i][1] + output_padding[i]
+        lax_pad.append((lo, hi))
+
+    if groups == 1:
+        out = lax.conv_transpose(
+            x, weight, strides=stride, padding=lax_pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            transpose_kernel=True)
+    else:
+        # grouped transpose: split, run per group, concat (XLA fuses these)
+        ch_axis = x.ndim - 1 if channel_last else 1
+        xs = jnp.split(x, groups, axis=ch_axis)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [lax.conv_transpose(xi, wi, strides=stride, padding=lax_pad,
+                                   rhs_dilation=dilation, dimension_numbers=dn,
+                                   transpose_kernel=True)
+                for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=ch_axis)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,28 @@
+"""Embedding / one-hot functionals.
+
+Reference: python/paddle/nn/functional/input.py — one_hot, embedding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["one_hot", "embedding"]
+
+
+def one_hot(x, num_classes: int, name=None):
+    return jax.nn.one_hot(x.astype(jnp.int32), num_classes, dtype=jnp.float32)
+
+
+def embedding(x, weight, padding_idx=None, sparse: bool = False, name=None):
+    """Gather rows; padding_idx rows produce zeros with zero grad (parity:
+    paddle embedding padding_idx semantics)."""
+    idx = x.astype(jnp.int32)
+    out = jnp.take(weight, idx, axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx = weight.shape[0] + padding_idx
+        mask = (idx != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return out

@@ -1,0 +1,224 @@
+"""Loss functionals.
+
+Reference: python/paddle/nn/functional/loss.py — cross_entropy,
+softmax_with_cross_entropy, mse_loss, l1_loss, nll_loss, bce losses,
+smooth_l1, kl_div, margin losses; the vocab-parallel variant
+(c_softmax_with_cross_entropy) lives in distributed/ (SURVEY.md §2.3 TP).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss",
+           "l1_loss", "nll_loss", "binary_cross_entropy",
+           "binary_cross_entropy_with_logits", "smooth_l1_loss", "kl_div",
+           "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+           "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
+           "log_loss", "triplet_margin_loss"]
+
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False,
+                  axis: int = -1, use_softmax: bool = True,
+                  label_smoothing: float = 0.0, name=None):
+    """Parity: paddle F.cross_entropy (hard/soft labels, ignore_index,
+    class weights, label smoothing).  Computed in fp32 for stability."""
+    x = input.astype(jnp.float32)
+    logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(
+        jnp.clip(x, 1e-12))
+    nclass = x.shape[axis]
+    if soft_label:
+        tgt = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            tgt = (1 - label_smoothing) * tgt + label_smoothing / nclass
+        loss = -jnp.sum(tgt * logp, axis=axis)
+        valid = None
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = (lbl != ignore_index)
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            mean_logp = jnp.mean(logp, axis=axis)
+            loss = -(1 - label_smoothing) * picked - label_smoothing * mean_logp
+        else:
+            loss = -picked
+        w = jnp.take(weight, safe) if weight is not None else None
+        if w is not None:
+            loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if valid is not None:
+            denom = jnp.sum(jnp.where(valid, w, 0.0)) if w is not None \
+                else jnp.sum(valid.astype(jnp.float32))
+            return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return jnp.mean(loss)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100, numeric_stable_mode=True,
+                               return_softmax: bool = False, axis: int = -1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction: str = "mean", name=None):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def l1_loss(input, label, reduction: str = "mean", name=None):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean", name=None):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1)
+    picked = jnp.squeeze(picked, 1)
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(jnp.take(weight, safe) * valid) if weight is not None \
+            else jnp.sum(valid)
+        return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean",
+                         name=None):
+    x = jnp.clip(input, 1e-12, 1.0 - 1e-7)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction: str = "mean",
+                                     pos_weight=None, name=None):
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * jnp.logaddexp(0.0, -logit)
+    else:
+        loss = jax.nn.relu(logit) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0,
+                   name=None):
+    d = input - label
+    abs_d = jnp.abs(d)
+    loss = jnp.where(abs_d < delta, 0.5 * d * d / delta, abs_d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction: str = "mean", log_target: bool = False,
+           name=None):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.clip(label, 1e-12)
+        loss = label * (jnp.log(safe) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean", name=None):
+    loss = jax.nn.relu(-label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean", name=None):
+    loss = jnp.where(label == 1, input, jax.nn.relu(margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean", name=None):
+    cos = jnp.sum(input1 * input2, -1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1) + 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jax.nn.relu(cos - margin))
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum", name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(
+        1 - input + epsilon)
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6, swap: bool = False,
+                        reduction: str = "mean", name=None):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1), 1 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jax.nn.relu(d_pos - d_neg + margin), reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False):
+    """CTC over optax.ctc_loss.
+
+    Layout follows the reference exactly (paddle F.ctc_loss): log_probs is
+    time-major [T_max, B, K]; labels [B, L_max]; optax wants batch-major, so
+    one deterministic transpose — no shape guessing.
+    """
+    import optax
+    logits = jnp.transpose(log_probs, (1, 0, 2))  # [B, T, K]
+    b, t, k = logits.shape
+    logit_pad = (jnp.arange(t)[None, :] >= input_lengths[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(labels.shape[1])[None, :] >= label_lengths[:, None]
+                 ).astype(jnp.float32)
+    loss = optax.ctc_loss(logits, logit_pad, labels, label_pad, blank_id=blank)
+    return _reduce(loss, reduction)

@@ -1,0 +1,187 @@
+"""Pooling functionals via lax.reduce_window.
+
+Reference: python/paddle/nn/functional/pooling.py — max_pool1d/2d/3d,
+avg_pool*, adaptive_*_pool*, global pooling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d",
+           "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v * n if len(v) == 1 else v
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == n:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * n:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, n, kernel, stride, padding, kind, ceil_mode=False,
+          exclusive=True, data_format="NCHW"):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_cfg(padding, n)
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        spatial_dims = tuple(range(1, n + 1))
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        spatial_dims = tuple(range(2, n + 2))
+    if isinstance(pad, str):
+        pad_all = pad
+    else:
+        full = [(0, 0)] * x.ndim
+        for i, d in enumerate(spatial_dims):
+            full[d] = pad[i]
+        if ceil_mode:
+            # extend upper padding so last partial window is included
+            for i, d in enumerate(spatial_dims):
+                size = x.shape[d] + full[d][0] + full[d][1]
+                rem = (size - kernel[i]) % stride[i]
+                if rem != 0:
+                    full[d] = (full[d][0], full[d][1] + stride[i] - rem)
+        pad_all = full
+
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pad_all)
+    # avg
+    summed = lax.reduce_window(x, 0.0, lax.add, window,
+                               strides, pad_all)
+    if exclusive and pad_all != "VALID" and not isinstance(pad_all, str):
+        ones = jnp.ones_like(x)
+        count = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad_all)
+        return summed / count
+    denom = float(np.prod(kernel))
+    return summed / denom
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    out = _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode, data_format=df)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, 1, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, df)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    out = _pool(x, 2, kernel_size, stride, padding, "avg", ceil_mode,
+                exclusive, data_format)
+    if divisor_override is not None:
+        k = _tuple(kernel_size, 2)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def _adaptive(x, output_size, n, kind, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    output_size = _tuple(output_size, n)
+    spatial_dims = tuple(range(1, n + 1)) if channel_last else tuple(range(2, n + 2))
+    out = x
+    for i, d in enumerate(spatial_dims):
+        osz = output_size[i]
+        if osz is None:
+            continue
+        isz = out.shape[d]
+        if isz % osz == 0:
+            k = isz // osz
+            window = [1] * out.ndim
+            strides = [1] * out.ndim
+            window[d] = k
+            strides[d] = k
+            if kind == "max":
+                out = lax.reduce_window(out, -jnp.inf, lax.max, tuple(window),
+                                        tuple(strides), "VALID")
+            else:
+                out = lax.reduce_window(out, 0.0, lax.add, tuple(window),
+                                        tuple(strides), "VALID") / k
+        else:
+            # general adaptive: gather per output bin (torch-style bins)
+            starts = (np.arange(osz) * isz) // osz
+            ends = -(-((np.arange(osz) + 1) * isz) // osz)
+            slices = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[d] = slice(int(s), int(e))
+                seg = out[tuple(sl)]
+                red = jnp.max(seg, axis=d, keepdims=True) if kind == "max" \
+                    else jnp.mean(seg, axis=d, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=d)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,143 @@
+"""Functional bridge: run a stateful Layer as a pure function of its state.
+
+This is the keystone that replaces the reference's eager autograd engine
+(paddle/fluid/eager/ — egr::Backward, grad nodes): instead of taping grad
+nodes per op, we rebind the module tree's parameters/buffers to (possibly
+traced) values, run forward once under JAX's tracer, and let jax.grad /
+jax.jit do AD and compilation.  Buffer mutations performed by layers during
+forward (BatchNorm running stats, KV caches) are collected and returned, so
+state updates stay functional under jit.
+
+Usage (what train loops / hapi / fleet wrappers build on):
+
+    params, buffers = state(model)
+    def loss_fn(params, buffers, x, y, key):
+        out, new_buf = functional_call(model, params, buffers, (x,), rng=key)
+        return loss(out, y), new_buf
+    (l, new_buf), grads = jax.value_and_grad(loss_fn, has_aux=True)(...)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from ..framework.random import rng_context
+from .layer import Layer
+
+__all__ = ["state", "parameters_dict", "buffers_dict", "functional_call",
+           "bind_state", "TrainState"]
+
+
+def parameters_dict(layer: Layer) -> Dict[str, jax.Array]:
+    return dict(layer.named_parameters())
+
+
+def buffers_dict(layer: Layer, persistable_only: bool = False) -> Dict[str, jax.Array]:
+    return dict(layer.named_buffers(persistable_only=persistable_only))
+
+
+def state(layer: Layer) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """Snapshot (params, buffers) as flat dotted-name pytrees."""
+    return parameters_dict(layer), buffers_dict(layer)
+
+
+def _index_stores(layer: Layer):
+    """name -> (store_dict, key) for params and buffers separately."""
+    pindex, bindex = {}, {}
+    for lname, sub in layer.named_sublayers(include_self=True):
+        for pname in sub._parameters:
+            key = f"{lname}.{pname}" if lname else pname
+            pindex[key] = (sub._parameters, pname)
+        for bname in sub._buffers:
+            key = f"{lname}.{bname}" if lname else bname
+            bindex[key] = (sub._buffers, bname)
+    return pindex, bindex
+
+
+def _write(index, values: Dict[str, Any], strict: bool = True):
+    for k, v in values.items():
+        try:
+            store, name = index[k]
+        except KeyError:
+            if strict:
+                raise KeyError(f"no parameter/buffer named {k!r} in layer") from None
+            continue
+        store[name] = v
+
+
+def _read(index) -> Dict[str, Any]:
+    return {k: store[name] for k, (store, name) in index.items()}
+
+
+@contextlib.contextmanager
+def bind_state(layer: Layer, params: Optional[Dict[str, Any]] = None,
+               buffers: Optional[Dict[str, Any]] = None):
+    """Temporarily bind values into the module tree; restore originals on
+    exit (so tracers never leak into the persistent module).  Yields a
+    ``collect()`` closure returning the current (possibly updated) buffers."""
+    pindex, bindex = _index_stores(layer)
+    saved_p = _read(pindex)
+    saved_b = _read(bindex)
+    try:
+        if params is not None:
+            _write(pindex, params)
+        if buffers is not None:
+            _write(bindex, buffers)
+
+        def collect() -> Dict[str, Any]:
+            # re-index: forward may have registered new buffers (rare)
+            _, bindex2 = _index_stores(layer)
+            return {k: v for k, v in _read(bindex2).items() if v is not None}
+
+        yield collect
+    finally:
+        _write(pindex, saved_p)
+        # restore buffers, including any registered mid-trace, to concrete saves
+        _, bindex3 = _index_stores(layer)
+        for k in _read(bindex3):
+            if k in saved_b:
+                store, name = bindex3[k]
+                store[name] = saved_b[k]
+
+
+def functional_call(layer: Layer, params: Dict[str, Any],
+                    buffers: Optional[Dict[str, Any]], args: tuple = (),
+                    kwargs: Optional[dict] = None, rng: Optional[jax.Array] = None,
+                    train: Optional[bool] = None):
+    """Pure-function call: returns (output, new_buffers)."""
+    kwargs = kwargs or {}
+    prev_modes = None
+    if train is not None:
+        prev_modes = [(l, l.training) for _, l in layer.named_sublayers(include_self=True)]
+        (layer.train() if train else layer.eval())
+    try:
+        with bind_state(layer, params, buffers) as collect:
+            if rng is not None:
+                with rng_context(rng):
+                    out = layer(*args, **kwargs)
+            else:
+                out = layer(*args, **kwargs)
+            new_buffers = collect()
+        return out, new_buffers
+    finally:
+        if prev_modes is not None:
+            for l, mode in prev_modes:
+                object.__setattr__(l, "training", mode)
+
+
+class TrainState:
+    """Mutable convenience holder for eager-style loops; the pytrees inside
+    are what jitted steps consume/produce."""
+
+    def __init__(self, layer: Layer):
+        self.layer = layer
+        self.params, self.buffers = state(layer)
+
+    def sync_to_layer(self):
+        pindex, bindex = _index_stores(self.layer)
+        _write(pindex, self.params)
+        _write(bindex, {k: v for k, v in self.buffers.items() if k in bindex},
+               strict=False)

@@ -1,0 +1,165 @@
+"""Parameter initializers.
+
+Reference: python/paddle/nn/initializer/ — Constant, Normal, TruncatedNormal,
+Uniform, XavierNormal/XavierUniform, KaimingNormal/KaimingUniform, Assign
+(SURVEY.md §2.2 "nn layers").
+
+TPU-native: each initializer is a pure function of (key, shape, dtype); the
+stateful eager path draws keys from the global generator
+(paddle_tpu.framework.random).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.random import next_rng_key
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param: Optional[float] = None) -> float:
+    recipes = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in recipes:
+        return recipes[nonlinearity]
+    raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+
+
+def _fan_in_out(shape: Sequence[int]) -> tuple[int, int]:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    # Linear weights in this framework are [in, out] (paddle convention);
+    # conv kernels are [out_c, in_c, *spatial] (paddle convention).
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    else:
+        receptive = int(np.prod(shape[2:]))
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def init(self, key: jax.Array, shape: Sequence[int], dtype) -> jax.Array:
+        raise NotImplementedError
+
+    def __call__(self, shape: Sequence[int], dtype="float32",
+                 key: Optional[jax.Array] = None) -> jax.Array:
+        if key is None:
+            key = next_rng_key()
+        return self.init(key, tuple(shape), jnp.dtype(dtype))
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def init(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def init(self, key, shape, dtype):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype=dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0, b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def init(self, key, shape, dtype):
+        x = jax.random.truncated_normal(key, self.a, self.b, shape, dtype=dtype)
+        return self.mean + self.std * x
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def init(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype=dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None,
+                 gain: float = 1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def init(self, key, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None,
+                 gain: float = 1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def init(self, key, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self._fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def init(self, key, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self._fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def init(self, key, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def init(self, key, shape, dtype):
+        arr = jnp.asarray(self.value, dtype=dtype)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"Assign shape {arr.shape} != requested {tuple(shape)}")
+        return arr

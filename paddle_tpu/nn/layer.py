@@ -1,0 +1,385 @@
+"""The Layer base class — a stateful module system over functional JAX.
+
+Reference surface: python/paddle/nn/layer/layers.py — ``Layer`` (hooks,
+``state_dict``/``set_state_dict``, ``create_parameter``, ``register_buffer``,
+``sublayers``, ``train``/``eval``, ``to``) — SURVEY.md §2.2.
+
+TPU-native design: a parameter is a plain ``jax.Array`` (no wrapper leaks to
+user forward code). A Layer is a *container of names*:
+
+  * ``self.weight = self.create_parameter(...)`` registers "weight" in
+    ``_parameters`` and attribute access returns the raw array;
+  * buffers (e.g. BatchNorm running stats) live in ``_buffers``; mutating
+    them during a traced forward is captured by ``functional_call`` (see
+    nn/functional_call.py) which snapshots/restores the tree around a trace
+    and returns the updated buffer pytree — the eager mutation model the
+    reference users expect, expressed functionally for XLA.
+
+No autograd machinery lives here: gradients come from ``jax.grad`` over
+``functional_call`` — the eager grad-node engine the reference builds
+(paddle/fluid/eager/ — egr::Backward) is provided by JAX's trace-based AD.
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import initializer as I
+
+__all__ = ["Layer", "Parameter", "ParamAttr"]
+
+
+class Parameter:
+    """Assignment marker: ``self.w = Parameter(array)`` registers a trainable
+    parameter. ``create_parameter`` returns one. Never stored — the raw array
+    goes into ``_parameters``."""
+
+    __slots__ = ("value", "trainable")
+
+    def __init__(self, value, trainable: bool = True):
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+
+
+class ParamAttr:
+    """Parity shim for ``paddle.ParamAttr`` — carries name/initializer/
+    regularizer/trainable/learning_rate hints into ``create_parameter``."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+class Layer:
+    """Base class for all neural network layers."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_non_trainable", set())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistent_buffers", set())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_dtype", jnp.dtype(dtype) if dtype else jnp.float32)
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        object.__setattr__(self, "_name_scope", name_scope or type(self).__name__)
+
+    # ---- registration ---------------------------------------------------
+    def create_parameter(self, shape, attr: Optional[ParamAttr] = None,
+                         dtype=None, is_bias: bool = False,
+                         default_initializer: Optional[I.Initializer] = None
+                         ) -> Parameter:
+        """Create + initialize a parameter (parity: Layer.create_parameter).
+
+        Default init matches the reference's convention: XavierNormal for
+        weights, zeros for biases (python/paddle/nn/initializer — the
+        global default initializer).
+        """
+        dtype = jnp.dtype(dtype) if dtype is not None else self._dtype
+        init = default_initializer
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        value = init(tuple(shape), dtype=dtype)
+        trainable = attr.trainable if attr is not None else True
+        return Parameter(value, trainable=trainable)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]) -> None:
+        if parameter is None:
+            self._parameters[name] = None
+            return
+        if not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter)
+        self._parameters[name] = parameter.value
+        if not parameter.trainable:
+            self._non_trainable.add(name)
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True) -> None:
+        self._buffers[name] = None if tensor is None else jnp.asarray(tensor)
+        if not persistable:
+            self._non_persistent_buffers.add(name)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    # ---- attribute routing ----------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        if params is None:  # before Layer.__init__ ran
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Parameter):
+            self.__dict__.pop(name, None)
+            self._sub_layers.pop(name, None)
+            self._buffers.pop(name, None)
+            self.add_parameter(name, value)
+        elif isinstance(value, Layer):
+            self.__dict__.pop(name, None)
+            params.pop(name, None)
+            self._buffers.pop(name, None)
+            self._sub_layers[name] = value
+        elif name in params:
+            if value is None:
+                params[name] = None
+            else:
+                params[name] = jnp.asarray(value) if not isinstance(value, jax.Array) else value
+        elif name in self._buffers:
+            self._buffers[name] = None if value is None else (
+                value if isinstance(value, jax.Array) else jnp.asarray(value))
+        elif name in self._sub_layers and isinstance(value, Layer):
+            self._sub_layers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ---- traversal ------------------------------------------------------
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self: bool = False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self._sub_layers.items():
+            if l is not None:
+                yield l
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, jax.Array]]:
+        # traversal dedups shared (weight-tied) sublayers by id, matching
+        # named_sublayers — a tied layer contributes its params once, under
+        # its first path, so state_dict/functional_call indices agree
+        if not include_sublayers:
+            for name, p in self._parameters.items():
+                if p is not None:
+                    yield (f"{prefix}.{name}" if prefix else name), p
+            return
+        for lname, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for name, p in layer._parameters.items():
+                if p is not None:
+                    yield (f"{lname}.{name}" if lname else name), p
+
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True,
+                      persistable_only: bool = False):
+        if not include_sublayers:
+            for name, b in self._buffers.items():
+                if b is None or (persistable_only and
+                                 name in self._non_persistent_buffers):
+                    continue
+                yield (f"{prefix}.{name}" if prefix else name), b
+            return
+        for lname, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for name, b in layer._buffers.items():
+                if b is None or (persistable_only and
+                                 name in layer._non_persistent_buffers):
+                    continue
+                yield (f"{lname}.{name}" if lname else name), b
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    # ---- state dict -----------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True
+                   ) -> Dict[str, jax.Array]:
+        out = destination if destination is not None else OrderedDict()
+        for k, v in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
+                                          include_sublayers=include_sublayers):
+            out[k] = v
+        for k, v in self.named_buffers(prefix=structured_name_prefix.rstrip("."),
+                                       include_sublayers=include_sublayers,
+                                       persistable_only=True):
+            out[k] = v
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        """Load a flat dotted-name dict. Returns (missing_keys, unexpected_keys)
+        like the reference."""
+        own = {}
+        index: Dict[str, Tuple[Layer, str, str]] = {}
+        for lname, layer in self.named_sublayers(include_self=True):
+            for pname in layer._parameters:
+                key = f"{lname}.{pname}" if lname else pname
+                index[key] = (layer, "param", pname)
+            for bname in layer._buffers:
+                if bname in layer._non_persistent_buffers:
+                    continue
+                key = f"{lname}.{bname}" if lname else bname
+                index[key] = (layer, "buffer", bname)
+        missing = [k for k in index if k not in state_dict]
+        unexpected = []
+        for k, v in state_dict.items():
+            if k not in index:
+                unexpected.append(k)
+                continue
+            layer, kind, name = index[k]
+            arr = jnp.asarray(v)
+            cur = layer._parameters.get(name) if kind == "param" else layer._buffers.get(name)
+            if cur is not None and tuple(cur.shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: got {arr.shape}, expected {cur.shape}")
+            if cur is not None:
+                arr = arr.astype(cur.dtype)
+            if kind == "param":
+                layer._parameters[name] = arr
+            else:
+                layer._buffers[name] = arr
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- mode / dtype ---------------------------------------------------
+    def train(self) -> "Layer":
+        for layer in self.named_sublayers(include_self=True):
+            object.__setattr__(layer[1], "training", True)
+        return self
+
+    def eval(self) -> "Layer":
+        for layer in self.named_sublayers(include_self=True):
+            object.__setattr__(layer[1], "training", False)
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for sub in self.children():
+            sub.apply(fn)
+        fn(self)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        """Cast floating params/buffers (device moves are XLA's job)."""
+        if dtype is not None:
+            dtype = jnp.dtype(dtype)
+            for _, layer in self.named_sublayers(include_self=True):
+                for n, p in layer._parameters.items():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        layer._parameters[n] = p.astype(dtype)
+                for n, b in layer._buffers.items():
+                    if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                        layer._buffers[n] = b.astype(dtype)
+                object.__setattr__(layer, "_dtype", dtype)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype=jnp.float32)
+
+    def bfloat16(self):
+        return self.to(dtype=jnp.bfloat16)
+
+    # ---- hooks ----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ---- call -----------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    # ---- misc -----------------------------------------------------------
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks_dict = hooks_dict
+        self.id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+
+    def remove(self):
+        self._hooks_dict.pop(self.id, None)

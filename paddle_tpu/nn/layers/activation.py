@@ -1,0 +1,186 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+
+__all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh",
+           "Softmax", "LogSoftmax", "LeakyReLU", "ELU", "SELU", "CELU",
+           "Hardswish", "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink",
+           "Tanhshrink", "Softplus", "Softsign", "Mish", "GLU", "PReLU",
+           "RReLU", "Maxout", "ThresholdedReLU", "LogSigmoid"]
+
+
+def _simple(name, fn_name, **defaults):
+    def __init__(self, name=None, **kw):
+        Layer.__init__(self)
+        self._kw = {**defaults, **kw}
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kw)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+SiLU = _simple("SiLU", "silu")
+Swish = _simple("Swish", "swish")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Tanh = _simple("Tanh", "tanh")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Softsign = _simple("Softsign", "softsign")
+Mish = _simple("Mish", "mish")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+
+
+class GELU(Layer):
+    def __init__(self, approximate: bool = False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, approximate=self.approximate)
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.elu(x, self.alpha)
+
+
+class SELU(Layer):
+    def __init__(self, scale=1.0507009873554805, alpha=1.6732632423543772,
+                 name=None):
+        super().__init__()
+        self.scale, self.alpha = scale, alpha
+
+    def forward(self, x):
+        return F.selu(x, self.scale, self.alpha)
+
+
+class CELU(Layer):
+    def __init__(self, alpha: float = 1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.celu(x, self.alpha)
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0, name=None):
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return F.hardtanh(x, self.min, self.max)
+
+
+class Hardshrink(Layer):
+    def __init__(self, threshold: float = 0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.hardshrink(x, self.threshold)
+
+
+class Softshrink(Layer):
+    def __init__(self, threshold: float = 0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.softshrink(x, self.threshold)
+
+
+class Softplus(Layer):
+    def __init__(self, beta: float = 1.0, threshold: float = 20.0, name=None):
+        super().__init__()
+        self.beta, self.threshold = beta, threshold
+
+    def forward(self, x):
+        return F.softplus(x, self.beta, self.threshold)
+
+
+class GLU(Layer):
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25,
+                 weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Maxout(Layer):
+    def __init__(self, groups: int, axis: int = 1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold: float = 1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold)

@@ -1,0 +1,344 @@
+"""Transformer layers (BASELINE config #2).
+
+Reference: python/paddle/nn/layer/transformer.py — MultiHeadAttention (with
+Cache/StaticCache incremental decoding), TransformerEncoderLayer,
+TransformerEncoder, TransformerDecoderLayer, TransformerDecoder, Transformer.
+
+TPU-native: attention math goes through F.scaled_dot_product_attention which
+routes to the Pallas flash kernel when profitable; otherwise plain XLA einsum
+(MXU-friendly, fp32 softmax accumulation).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import functional as F
+from ..layer import Layer
+from .common import Linear, Dropout
+from .norm import LayerNorm
+from .container import LayerList
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+           "TransformerDecoderLayer", "TransformerDecoder", "Transformer"]
+
+
+def _convert_attention_mask(attn_mask, dtype):
+    """Bool mask (True=keep) -> additive; numeric passes through (parity:
+    reference _convert_attention_mask)."""
+    if attn_mask is None:
+        return None
+    if attn_mask.dtype == jnp.bool_:
+        return jnp.where(attn_mask, 0.0, jnp.finfo(jnp.float32).min).astype(jnp.float32)
+    return attn_mask.astype(jnp.float32)
+
+
+class MultiHeadAttention(Layer):
+    """Inputs [batch, seq, embed_dim]; heads split internally (paddle layout
+    [B, S, H, D] for the attention core)."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(self, embed_dim, num_heads, dropout: float = 0.0,
+                 kdim=None, vdim=None, need_weights: bool = False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim, \
+            "embed_dim must be divisible by num_heads"
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim)
+
+    def gen_cache(self, key, value=None, type=None):
+        """Parity with reference gen_cache: returns StaticCache (cross-attn,
+        precomputed k/v) or Cache (incremental self-attn)."""
+        if type == MultiHeadAttention.StaticCache or (value is not None and type is None):
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value if value is not None else key))
+            return self.StaticCache(k, v)
+        # empty rolling cache; key arg carries batch size reference input
+        b = key.shape[0]
+        k = jnp.zeros((b, 0, self.num_heads, self.head_dim), key.dtype)
+        return self.Cache(k, jnp.zeros_like(k))
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split_heads(self.q_proj(query))
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+            new_cache = cache
+        else:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value))
+            if isinstance(cache, self.Cache):
+                k = jnp.concatenate([cache.k, k], axis=1)
+                v = jnp.concatenate([cache.v, v], axis=1)
+                new_cache = self.Cache(k, v)
+            else:
+                new_cache = None
+        mask = _convert_attention_mask(attn_mask, q.dtype)
+        if mask is not None and mask.ndim == 3:
+            mask = mask[:, None]  # [B,1,Sq,Sk] broadcast over heads
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            training=self.training)
+        b, s = out.shape[:2]
+        out = self.out_proj(out.reshape(b, s, self.embed_dim))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout: float = 0.1,
+                 activation: str = "relu", attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None, layer_norm_eps: float = 1e-5):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation, attn_dropout=attn_dropout,
+                            act_dropout=act_dropout,
+                            normalize_before=normalize_before,
+                            weight_attr=weight_attr, bias_attr=bias_attr,
+                            layer_norm_eps=layer_norm_eps)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            src, cache = self.self_attn(src, src, src, src_mask, cache)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+def _clone_layer(layer):
+    """Fresh-init clone, matching the reference's per-depth construction
+    (python/paddle/nn/layer/transformer.py rebuilds from the layer's config
+    rather than deepcopying weights — identical init across depth measurably
+    hurts early training)."""
+    cfg = getattr(layer, "_config", None)
+    if cfg is not None:
+        return type(layer)(**cfg)
+    import copy
+    return copy.deepcopy(layer)
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers: int, norm=None):
+        super().__init__()
+        self.layers = LayerList([encoder_layer] + [
+            _clone_layer(encoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, src_mask)
+            else:
+                output, new_c = mod(output, src_mask, cache[i])
+                new_caches.append(new_c)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout: float = 0.1,
+                 activation: str = "relu", attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None, layer_norm_eps: float = 1e-5):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation, attn_dropout=attn_dropout,
+                            act_dropout=act_dropout,
+                            normalize_before=normalize_before,
+                            weight_attr=weight_attr, bias_attr=bias_attr,
+                            layer_norm_eps=layer_norm_eps)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm3 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+            incremental_cache, static_cache = None, None
+        else:
+            tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
+                                                    cache[0])
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        else:
+            tgt, static_cache = self.cross_attn(tgt, memory, memory,
+                                                memory_mask, cache[1])
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        if cache is None:
+            return tgt
+        return tgt, (incremental_cache, static_cache)
+
+    def gen_cache(self, memory):
+        incremental = self.self_attn.gen_cache(memory,
+                                               type=MultiHeadAttention.Cache)
+        static = self.cross_attn.gen_cache(memory, memory,
+                                           type=MultiHeadAttention.StaticCache)
+        return incremental, static
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers: int, norm=None):
+        super().__init__()
+        self.layers = LayerList([decoder_layer] + [
+            _clone_layer(decoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        output = tgt
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, memory, tgt_mask, memory_mask)
+            else:
+                output, new_c = mod(output, memory, tgt_mask, memory_mask,
+                                    cache[i])
+                new_caches.append(new_c)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            cache = list(zip(*cache))
+        return cache
+
+
+class Transformer(Layer):
+    """Full encoder-decoder (parity: paddle.nn.Transformer)."""
+
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation: str = "relu", attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None, custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              dec_norm)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length: int):
+        i = jnp.arange(length)[:, None]
+        j = jnp.arange(length)[None, :]
+        return jnp.where(j <= i, 0.0, jnp.finfo(jnp.float32).min)

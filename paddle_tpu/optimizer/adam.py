@@ -1,0 +1,125 @@
+"""Adam family (reference: python/paddle/optimizer/adam.py, adamw.py,
+lamb.py; device side: fused in-place kernels `_C_ops.adamw_` —
+phi/kernels/gpu/adamw_kernel.cu).
+
+AdamW keeps paddle semantics: decoupled weight decay with
+``apply_decay_param_fun`` filter (fleet uses it to skip LayerNorm/bias).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Lamb"]
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update_param(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        m_hat = m / (1 - jnp.power(self.beta1, t))
+        v_hat = v / (1 - jnp.power(self.beta2, t))
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (paddle semantics: decay applied with lr
+    coupling, p -= lr * coeff * p)."""
+
+    _l2_mode = "decoupled"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun: Optional[Callable[[str], bool]] = None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self.apply_decay_param_fun = apply_decay_param_fun
+        self._current_param_name = None
+
+    def update(self, grads, state, params, lr=None):
+        # track names for apply_decay_param_fun when params is a flat dict
+        if self.apply_decay_param_fun is not None and isinstance(params, dict):
+            self._decay_names = {k: self.apply_decay_param_fun(k) for k in params}
+        else:
+            self._decay_names = None
+        if self._decay_names is None:
+            return super().update(grads, state, params, lr=lr)
+        # per-name decay: do the generic update with decay disabled, then
+        # apply decay only to selected names
+        wd = self.weight_decay
+        self.weight_decay = None
+        try:
+            new_params, new_state = super().update(grads, state, params, lr=lr)
+        finally:
+            self.weight_decay = wd
+        coef = self._decay_coef()
+        if coef:
+            if lr is None:
+                lr = self._lr_sched.lr_at(state["step"])
+            for k in list(new_params.keys()):
+                if self._decay_names.get(k, True):
+                    p_old = params[k]
+                    master = state["master"][k] if isinstance(state["master"], dict) else None
+                    base = master if master is not None else p_old
+                    new_params[k] = (new_params[k].astype(jnp.float32) -
+                                     lr * coef * base.astype(jnp.float32)
+                                     ).astype(p_old.dtype)
+        return new_params, new_state
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: python/paddle/optimizer/lamb.py) — layerwise adaptive
+    trust ratio over AdamW updates."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self.lamb_weight_decay = lamb_weight_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update_param(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        m_hat = m / (1 - jnp.power(self.beta1, t))
+        v_hat = v / (1 - jnp.power(self.beta2, t))
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + \
+            self.lamb_weight_decay * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}

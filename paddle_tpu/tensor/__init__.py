@@ -1,0 +1,20 @@
+"""paddle_tpu.tensor — the op surface (parity: python/paddle/tensor/).
+
+All ops operate on plain ``jax.Array`` values; there is no Tensor wrapper —
+jax arrays already expose .shape/.dtype/.T/arithmetic, and ops here add the
+paddle-named functional surface.  The op registry (paddle_tpu.ops) indexes
+these for the OpTest harness.
+"""
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from . import (creation, math, manipulation, linalg, search, logic,  # noqa: F401
+               random, stat)

@@ -1,0 +1,131 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.random import next_rng_key
+
+__all__ = ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+           "full_like", "arange", "linspace", "logspace", "eye", "empty",
+           "empty_like", "meshgrid", "diag", "diagflat", "tril", "triu",
+           "tril_indices", "triu_indices", "assign", "clone", "complex",
+           "create_parameter"]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    arr = jnp.asarray(data, dtype=jnp.dtype(dtype) if dtype else None)
+    return arr
+
+
+def zeros(shape, dtype="float32", name=None):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+def ones(shape, dtype="float32", name=None):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    return jnp.full(shape, fill_value, dtype=jnp.dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def ones_like(x, dtype=None, name=None):
+    return jnp.ones_like(x, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return jnp.full_like(x, fill_value, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return jnp.linspace(start, stop, int(num),
+                        dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return jnp.eye(num_rows, num_columns, dtype=jnp.dtype(dtype))
+
+
+def empty(shape, dtype="float32", name=None):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    return list(jnp.meshgrid(*args, indexing="ij"))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, x.dtype)
+        idx = jnp.arange(x.shape[0])
+        if offset >= 0:
+            return out.at[idx, idx + offset].set(x)
+        return out.at[idx - offset, idx].set(x)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.dtype(dtype))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return jnp.stack([r, c]).astype(jnp.dtype(dtype))
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x, name=None):
+    return jnp.copy(x)
+
+
+def complex(real, imag, name=None):
+    return jax.lax.complex(real, imag)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn import initializer as I
+    init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    return init(shape, dtype=dtype)

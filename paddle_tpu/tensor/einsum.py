@@ -1,0 +1,9 @@
+"""einsum (reference: python/paddle/tensor/einsum.py)."""
+
+import jax.numpy as jnp
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)

@@ -1,0 +1,200 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py —
+paddle.linalg namespace)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["norm", "vector_norm", "matrix_norm", "cond", "det", "slogdet",
+           "inv", "pinv", "solve", "lstsq", "cholesky", "cholesky_solve",
+           "triangular_solve", "lu", "qr", "svd", "svdvals", "eig", "eigh",
+           "eigvals", "eigvalsh", "matrix_rank", "matrix_power", "multi_dot",
+           "bmm", "mv", "matmul", "dist", "householder_product", "corrcoef",
+           "cov", "pca_lowrank"]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if (axis is None or isinstance(axis, (list, tuple))) else 2
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if isinstance(axis, (list, tuple)):
+        return jnp.linalg.norm(x, ord=p if p != "fro" else "fro",
+                               axis=tuple(axis), keepdims=keepdim)
+    if p == jnp.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -jnp.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def inv(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def cholesky(x, upper=False, name=None):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    L = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z,
+                                             lower=False)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    a = x
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(a, y, lower=not upper,
+                                             unit_diagonal=unitriangular)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    if get_infos:
+        return lu_mat, piv.astype(jnp.int32) + 1, jnp.zeros((), jnp.int32)
+    return lu_mat, piv.astype(jnp.int32) + 1
+
+
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V not V^H
+
+
+def svdvals(x, name=None):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, tol)
+
+
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def multi_dot(tensors, name=None):
+    return jnp.linalg.multi_dot(tensors)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=p)
+
+
+def householder_product(x, tau, name=None):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(eye, x.shape[:-2] + (m, m)).copy() if x.ndim > 2 else eye
+
+    def apply_one(q, i):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i])
+        v = v.at[..., i].set(1.0) if v.ndim == 1 else v
+        h = jnp.eye(m, dtype=x.dtype) - tau[..., i] * jnp.outer(v, v)
+        return q @ h, None
+
+    for i in range(n):
+        v = x[..., :, i]
+        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = v.at[i].set(1.0)
+        h = jnp.eye(m, dtype=x.dtype) - tau[i] * jnp.outer(v, v)
+        q = q @ h
+    return q[..., :, :n]
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    m, n = x.shape[-2:]
+    q = q if q is not None else min(6, m, n)
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]

@@ -1,0 +1,74 @@
+"""Logic/compare ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["equal", "not_equal", "greater_than", "greater_equal", "less_than",
+           "less_equal", "logical_and", "logical_or", "logical_xor",
+           "logical_not", "is_empty", "is_tensor", "isin", "all", "any"]
+
+
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+def is_empty(x, name=None):
+    return jnp.asarray(x.size == 0)
+
+
+def is_tensor(x):
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.any(x, axis=axis, keepdims=keepdim)

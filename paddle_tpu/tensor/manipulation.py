@@ -1,0 +1,404 @@
+"""Manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "reshape", "flatten", "transpose", "moveaxis", "rollaxis", "swapaxes",
+    "squeeze", "unsqueeze", "concat", "stack", "hstack", "vstack", "dstack",
+    "split", "vsplit", "hsplit", "dsplit", "tensor_split", "chunk", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip",
+    "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "slice", "strided_slice", "index_select", "index_sample",
+    "index_add", "index_put", "masked_select", "masked_fill", "take_along_axis",
+    "put_along_axis", "unbind", "unique", "unique_consecutive", "unstack",
+    "repeat_interleave", "shard_index", "crop", "as_complex", "as_real",
+    "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d",
+    "diagonal_scatter", "select_scatter", "slice_scatter", "flatten_",
+    "cast", "numel", "shape", "rank",
+]
+
+
+def cast(x, dtype):
+    return x.astype(jnp.dtype(dtype))
+
+
+def numel(x, name=None):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+def shape(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def rank(x):
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+def reshape(x, shape, name=None):
+    return jnp.reshape(x, tuple(int(s) for s in shape) if not isinstance(shape, int) else shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    start = start_axis % x.ndim
+    stop = stop_axis % x.ndim
+    return x.reshape(x.shape[:start] + (-1,) + x.shape[stop + 1:])
+
+
+flatten_ = flatten
+
+
+def transpose(x, perm, name=None):
+    return jnp.transpose(x, perm)
+
+
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+def rollaxis(x, axis, start=0, name=None):
+    return jnp.rollaxis(x, axis, start)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = (axis,)
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def concat(x, axis=0, name=None):
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+def stack(x, axis=0, name=None):
+    return jnp.stack(list(x), axis=axis)
+
+
+def hstack(x, name=None):
+    return jnp.hstack(list(x))
+
+
+def vstack(x, name=None):
+    return jnp.vstack(list(x))
+
+
+def dstack(x, name=None):
+    return jnp.dstack(list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    # paddle allows one -1 section
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return jnp.split(x, offsets, axis=axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return jnp.array_split(x, num_or_indices, axis=axis) \
+        if isinstance(num_or_indices, int) else jnp.split(x, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape, name=None):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = np.broadcast_shapes(*[t.shape for t in inputs])
+    return [jnp.broadcast_to(t, shape) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    return jnp.flip(x, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    return jnp.take(x, index.astype(jnp.int32).reshape(-1), axis=axis)
+
+
+def gather_nd(x, index, name=None):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x[idx]
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index.astype(jnp.int32).reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    # paddle overwrite=False: zero target rows then accumulate
+    zeroed = x.at[idx].set(0.0)
+    return zeroed.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return out.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x.at[idx].add(updates)
+
+
+_slice = slice  # capture builtin before shadowing
+
+
+def slice(x, axes, starts, ends, name=None):
+    idx = [_slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = _slice(int(s), int(e))
+    return x[tuple(idx)]
+
+
+builtins_slice = _slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins_slice(int(s), int(e), int(st))
+    return x[tuple(idx)]
+
+
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[idx].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i.astype(jnp.int32) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic shape: host-side only (not jit-safe); parity convenience
+    return x[np.asarray(mask)]
+
+
+def masked_fill(x, mask, value, name=None):
+    return jnp.where(mask, value, x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return jnp.take_along_axis(arr, indices.astype(jnp.int32), axis=axis)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    idx = indices.astype(jnp.int32)
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, idx, values, axis=axis, inplace=False)
+    if reduce in ("add", "sum"):
+        moved = jnp.moveaxis(arr, axis, -1)
+        return arr.at[tuple(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                                         indexing="ij")[:axis]) + (idx,)].add(values) \
+            if False else _put_add(arr, idx, values, axis)
+    if reduce in ("mul", "multiply"):
+        return _put_mul(arr, idx, values, axis)
+    raise ValueError(reduce)
+
+
+def _fancy_index(idx, axis, shape):
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    index = list(grids)
+    index[axis] = idx
+    return tuple(index)
+
+
+def _put_add(arr, idx, values, axis):
+    values = jnp.broadcast_to(values, idx.shape)
+    return arr.at[_fancy_index(idx, axis, arr.shape)].add(values)
+
+
+def _put_mul(arr, idx, values, axis):
+    values = jnp.broadcast_to(values, idx.shape)
+    return arr.at[_fancy_index(idx, axis, arr.shape)].multiply(values)
+
+
+def unbind(x, axis=0, name=None):
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xnp = np.asarray(x)
+    if axis is None:
+        xnp = xnp.reshape(-1)
+        keep = np.concatenate([[True], xnp[1:] != xnp[:-1]])
+        out = jnp.asarray(xnp[keep])
+        rets = [out]
+        if return_inverse:
+            rets.append(jnp.asarray(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, len(xnp)))
+            rets.append(jnp.asarray(counts))
+        return rets[0] if len(rets) == 1 else tuple(rets)
+    raise NotImplementedError("axis unique_consecutive")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Parity: paddle.shard_index — map global ids to shard-local ids."""
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo, ignore_value)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or x.shape
+    idx = tuple(builtins_slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    return x.view(jnp.dtype(shape_or_dtype))
+
+
+def view_as(x, other, name=None):
+    return jnp.reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    out = [jnp.atleast_1d(x) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [jnp.atleast_2d(x) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [jnp.atleast_3d(x) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    diag_len = min(x.shape[axis1], x.shape[axis2] - offset) if offset >= 0 \
+        else min(x.shape[axis1] + offset, x.shape[axis2])
+    ii = jnp.arange(diag_len)
+    r = ii if offset >= 0 else ii - offset
+    c = ii + offset if offset >= 0 else ii
+    if x.ndim == 2:
+        return x.at[r, c].set(y)
+    moved = jnp.moveaxis(jnp.moveaxis(x, axis1, -2), axis2, -1)
+    updated = moved.at[..., r, c].set(y)
+    return jnp.moveaxis(jnp.moveaxis(updated, -1, axis2), -2, axis1)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    idx = [builtins_slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins_slice(int(s), int(e), int(st))
+    return x.at[tuple(idx)].set(value)

@@ -1,0 +1,603 @@
+"""Math ops (reference: python/paddle/tensor/math.py — ~200 ops).
+
+Thin wrappers over jnp with paddle names/signatures; XLA handles fusion and
+MXU dispatch (matmul).  Ops keep paddle's (x, y, name=None) convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "matmul", "dot", "inner", "outer", "cross", "t",
+    "abs", "neg", "sign", "sqrt", "rsqrt", "square", "exp", "expm1", "log",
+    "log2", "log10", "log1p", "sin", "cos", "tan", "asin", "acos", "atan",
+    "atan2", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "ceil",
+    "floor", "round", "trunc", "frac", "clip", "maximum", "minimum", "fmax",
+    "fmin", "sum", "nansum", "mean", "nanmean", "prod", "max", "min", "amax",
+    "amin", "cumsum", "cumprod", "cummax", "cummin", "logsumexp", "logcumsumexp",
+    "reciprocal", "isnan", "isinf", "isfinite", "nan_to_num", "erf", "erfinv",
+    "lerp", "rad2deg", "deg2rad", "gcd", "lcm", "diff", "angle", "conj",
+    "real", "imag", "trace", "kron", "multiply_", "add_", "addmm", "allclose",
+    "isclose", "equal_all", "heaviside", "stanh", "scale", "count_nonzero",
+    "increment", "multiplex", "log_normal", "sgn", "take", "frexp", "ldexp",
+    "hypot", "combinations", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "bitwise_left_shift", "bitwise_right_shift",
+    "broadcast_shape", "digamma", "lgamma", "gammaln", "polygamma", "i0",
+    "i0e", "i1", "i1e", "logit", "logaddexp", "vander", "renorm",
+    "cartesian_prod", "float_power", "copysign", "signbit", "nextafter",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+def add_(x, y, name=None):
+    return jnp.add(x, y)
+
+
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+multiply_ = multiply
+
+
+def divide(x, y, name=None):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        # paddle default: first axis with dim 3
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=axis)
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def abs(x, name=None):
+    return jnp.abs(x)
+
+
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+sgn = sign
+
+
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+def square(x, name=None):
+    return jnp.square(x)
+
+
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+def expm1(x, name=None):
+    return jnp.expm1(x)
+
+
+def log(x, name=None):
+    return jnp.log(x)
+
+
+def log2(x, name=None):
+    return jnp.log2(x)
+
+
+def log10(x, name=None):
+    return jnp.log10(x)
+
+
+def log1p(x, name=None):
+    return jnp.log1p(x)
+
+
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+def tan(x, name=None):
+    return jnp.tan(x)
+
+
+def asin(x, name=None):
+    return jnp.arcsin(x)
+
+
+def acos(x, name=None):
+    return jnp.arccos(x)
+
+
+def atan(x, name=None):
+    return jnp.arctan(x)
+
+
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+def sinh(x, name=None):
+    return jnp.sinh(x)
+
+
+def cosh(x, name=None):
+    return jnp.cosh(x)
+
+
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+def round(x, decimals=0, name=None):
+    return jnp.round(x, decimals)
+
+
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.sum(x, axis=_axis(axis), keepdims=keepdim,
+                   dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim,
+                      dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim,
+                    dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    # index of the last element achieving the running max
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == (axis % x.ndim) else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    eq = (x == vals)
+    inds = jnp.where(eq, idx, -1)
+    run_idx = jax.lax.associative_scan(jnp.maximum, inds, axis=axis)
+    return vals, run_idx.astype(jnp.dtype(dtype))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == (axis % x.ndim) else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    eq = (x == vals)
+    inds = jnp.where(eq, idx, -1)
+    run_idx = jax.lax.associative_scan(jnp.maximum, inds, axis=axis)
+    return vals, run_idx.astype(jnp.dtype(dtype))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def reciprocal(x, name=None):
+    return jnp.reciprocal(x)
+
+
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def erf(x, name=None):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(x)
+
+
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+def real(x, name=None):
+    return jnp.real(x)
+
+
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def increment(x, value=1.0, name=None):
+    return x + value
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack(inputs, axis=0)  # [K, B, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return jnp.take_along_axis(
+        stacked, idx.reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0)[0]
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    from ..framework.random import next_rng_key
+    return jnp.exp(mean + std * jax.random.normal(next_rng_key(), tuple(shape),
+                                                  dtype=jnp.dtype(dtype)))
+
+
+def take(x, index, mode="raise", name=None):
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, flat.shape[0])
+    elif mode == "clip":
+        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+    idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+    return jnp.take(flat, idx)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(x)
+    return m, e.astype(x.dtype)
+
+
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = x.shape[0]
+    combos = (itertools.combinations_with_replacement(range(n), r)
+              if with_replacement else itertools.combinations(range(n), r))
+    idx = jnp.asarray(list(combos), dtype=jnp.int32)
+    return x[idx]
+
+
+def bitwise_and(x, y, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_left_shift(x, y, name=None):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y, name=None):
+    return jnp.right_shift(x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+gammaln = lgamma
+
+
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jax.scipy.special.logit(x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def cartesian_prod(x, name=None):
+    arrays = x if isinstance(x, (list, tuple)) else [x]
+    grids = jnp.meshgrid(*arrays, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def float_power(x, y, name=None):
+    return jnp.float_power(x, y)
+
+
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)

@@ -1,0 +1,87 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Eager convenience over the global generator; inside jitted code use
+framework.random.rng_context / pass keys explicitly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_rng_key
+
+__all__ = ["rand", "randn", "randint", "randint_like", "randperm", "uniform",
+           "normal", "standard_normal", "poisson", "bernoulli", "multinomial",
+           "exponential_", "binomial", "standard_gamma"]
+
+
+def rand(shape, dtype="float32", name=None):
+    return jax.random.uniform(next_rng_key(), tuple(shape),
+                              dtype=jnp.dtype(dtype))
+
+
+def randn(shape, dtype="float32", name=None):
+    return jax.random.normal(next_rng_key(), tuple(shape), dtype=jnp.dtype(dtype))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(next_rng_key(), tuple(shape), low, high,
+                              dtype=jnp.dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return jax.random.permutation(next_rng_key(), n).astype(jnp.dtype(dtype))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    return jax.random.uniform(next_rng_key(), tuple(shape),
+                              dtype=jnp.dtype(dtype), minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+    return mean + std * jax.random.normal(next_rng_key(), tuple(shape))
+
+
+def poisson(x, name=None):
+    return jax.random.poisson(next_rng_key(), x).astype(x.dtype)
+
+
+def bernoulli(x, name=None):
+    return jax.random.bernoulli(next_rng_key(), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = next_rng_key()
+    logits = jnp.log(jnp.clip(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=x.shape[:-1] + (num_samples,)
+                                      ).astype(jnp.int64)
+    # without replacement: gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def exponential_(x, lam=1.0, name=None):
+    return jax.random.exponential(next_rng_key(), x.shape, x.dtype) / lam
+
+
+def binomial(count, prob, name=None):
+    return jax.random.binomial(next_rng_key(), count, prob).astype(jnp.int64)
+
+
+def standard_gamma(x, name=None):
+    return jax.random.gamma(next_rng_key(), x)

@@ -1,0 +1,151 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+           "searchsorted", "kthvalue", "mode", "median", "nanmedian",
+           "quantile", "nanquantile", "bucketize", "index_of", "masked_scatter"]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.argsort(x, axis=axis, stable=stable or True,
+                      descending=descending)
+    return out.astype(jnp.int64)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = topk(xm, k, -1, largest, sorted)
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    if largest:
+        vals, idx = jax.lax.top_k(x, k)
+    else:
+        vals, idx = jax.lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx.astype(jnp.int64)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(x)  # host-sync; dynamic shape (eager-only)
+    if as_tuple:
+        return tuple(r[:, None] for r in res)
+    return jnp.stack(res, axis=1)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    tidx = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        tidx = jnp.expand_dims(tidx, axis)
+    return taken, tidx.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode_1d(v):
+        sorted_v = jnp.sort(v)
+        # count runs
+        n = v.shape[0]
+        is_new = jnp.concatenate([jnp.array([True]), sorted_v[1:] != sorted_v[:-1]])
+        grp = jnp.cumsum(is_new) - 1
+        counts = jnp.zeros(n, jnp.int32).at[grp].add(1)
+        best_grp = jnp.argmax(counts)
+        val = sorted_v[jnp.argmax(grp == best_grp)]
+        idx = n - 1 - jnp.argmax(jnp.flip(v == val))
+        return val, idx
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = jax.vmap(_mode_1d)(flat)
+    vals = vals.reshape(moved.shape[:-1])
+    idxs = idxs.reshape(moved.shape[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs.astype(jnp.int64)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode == "avg":
+        return jnp.median(x, axis=axis, keepdims=keepdim)
+    # min mode: lower of the two middles
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    n = x.shape[axis]
+    vals = jnp.sort(x, axis=axis)
+    mid = (n - 1) // 2
+    out = jnp.take(vals, mid, axis=axis)
+    if keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+def index_of(x, value):
+    return jnp.argmax(x == value)
+
+
+def masked_scatter(x, mask, value, name=None):
+    flat_val = value.reshape(-1)
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    cum = jnp.cumsum(mask_b.reshape(-1)) - 1
+    gathered = jnp.take(flat_val, jnp.clip(cum, 0, flat_val.shape[0] - 1))
+    return jnp.where(mask_b, gathered.reshape(x.shape), x)

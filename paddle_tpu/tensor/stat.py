@@ -1,0 +1,56 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["mean", "std", "var", "numel", "histogram", "histogramdd",
+           "bincount", "quantile"]
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def numel(x, name=None):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    if min == 0 and max == 0:
+        mn, mx = jnp.min(input), jnp.max(input)
+    else:
+        mn, mx = min, max
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=(mn, mx),
+                            weights=weight, density=density)
+    return hist if density else hist.astype(jnp.int64)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    hist, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                                  weights=weights)
+    return hist, list(edges)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x.reshape(-1), weights=weights, minlength=minlength)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)

@@ -1,0 +1,33 @@
+"""Test env: force CPU platform with 8 virtual devices BEFORE jax import.
+
+Mirrors the reference's strategy of running all "distributed" tests
+single-host (SURVEY.md §4): one process, 8 XLA host devices standing in for
+a TPU slice; sharding/collective semantics are identical.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# this environment's CPU backend defaults to low-precision matmul; tests
+# compare against float64/float32 numpy references
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+    paddle_tpu.seed(1234)
+    np.random.seed(1234)
+    yield

@@ -1,0 +1,190 @@
+"""Functional op tests vs numpy references (OpTest-style; model:
+test/legacy_test/test_activation_op.py etc.)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn.functional as F
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def test_relu_gelu_silu():
+    x = np.random.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(_np(F.relu(jnp.asarray(x))), np.maximum(x, 0))
+    sig = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(_np(F.silu(jnp.asarray(x))), x * sig, rtol=1e-5)
+    # gelu tanh approximation vs formula
+    ref = 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi) * (x + 0.044715 * x**3)))
+    np.testing.assert_allclose(_np(F.gelu(jnp.asarray(x), approximate=True)),
+                               ref, rtol=1e-3, atol=1e-4)
+
+
+def test_softmax_matches_numpy():
+    x = np.random.randn(3, 7).astype(np.float32)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(_np(F.softmax(jnp.asarray(x))), ref, rtol=1e-5)
+
+
+def test_linear():
+    x = np.random.randn(2, 3).astype(np.float32)
+    w = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    out = F.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(_np(out), x @ w + b, rtol=1e-5)
+
+
+def test_conv2d_matches_direct():
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+    out = F.conv2d(jnp.asarray(x), jnp.asarray(w), padding=1)
+    assert out.shape == (1, 3, 5, 5)
+    # direct computation at center pixel
+    ref = 0.0
+    patch = x[0, :, 1:4, 1:4]
+    ref = (patch * w[0]).sum()
+    np.testing.assert_allclose(_np(out)[0, 0, 2, 2], ref, rtol=1e-4)
+
+
+def test_layer_norm():
+    x = np.random.randn(2, 5).astype(np.float32)
+    out = F.layer_norm(jnp.asarray(x), 5)
+    mu = x.mean(-1, keepdims=True)
+    sd = x.std(-1, keepdims=True)
+    np.testing.assert_allclose(_np(out), (x - mu) / np.sqrt(sd**2 + 1e-5),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_eval():
+    x = np.random.randn(4, 3, 2, 2).astype(np.float32)
+    rm = np.zeros(3, np.float32)
+    rv = np.ones(3, np.float32)
+    out = F.batch_norm(jnp.asarray(x), jnp.asarray(rm), jnp.asarray(rv),
+                       training=False)
+    np.testing.assert_allclose(_np(out), x / np.sqrt(1 + 1e-5), rtol=1e-5)
+
+
+def test_max_avg_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = F.max_pool2d(jnp.asarray(x), 2)
+    ap = F.avg_pool2d(jnp.asarray(x), 2)
+    np.testing.assert_array_equal(_np(mp)[0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(_np(ap)[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_adaptive_pool():
+    x = np.random.randn(1, 2, 6, 6).astype(np.float32)
+    out = F.adaptive_avg_pool2d(jnp.asarray(x), 1)
+    np.testing.assert_allclose(_np(out)[0, :, 0, 0], x.mean((2, 3))[0], rtol=1e-5)
+
+
+def test_cross_entropy_hard_vs_manual():
+    logits = np.random.randn(4, 6).astype(np.float32)
+    labels = np.array([0, 2, 5, 1])
+    out = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+def test_cross_entropy_ignore_index():
+    logits = np.random.randn(4, 6).astype(np.float32)
+    labels = np.array([0, -100, 5, -100])
+    out = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2], [0, 5]]).mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+def test_cross_entropy_soft_label():
+    logits = np.random.randn(3, 4).astype(np.float32)
+    soft = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+    out = F.cross_entropy(jnp.asarray(logits), jnp.asarray(soft), soft_label=True)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    logp = np.log(e / e.sum(-1, keepdims=True))
+    ref = -(soft * logp).sum(-1).mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+def test_mse_l1_smooth():
+    a = np.random.randn(5).astype(np.float32)
+    b = np.random.randn(5).astype(np.float32)
+    np.testing.assert_allclose(float(F.mse_loss(jnp.asarray(a), jnp.asarray(b))),
+                               ((a - b) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(F.l1_loss(jnp.asarray(a), jnp.asarray(b))),
+                               np.abs(a - b).mean(), rtol=1e-5)
+
+
+def test_dropout_train_eval():
+    x = jnp.ones((100, 100))
+    y = F.dropout(x, p=0.5, training=True)
+    kept = float((y != 0).mean())
+    assert 0.4 < kept < 0.6
+    # upscale: kept values are 2.0
+    vals = np.unique(_np(y))
+    assert set(np.round(vals, 5)).issubset({0.0, 2.0})
+    np.testing.assert_array_equal(_np(F.dropout(x, 0.5, training=False)), _np(x))
+
+
+def test_embedding_padding_idx():
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = np.array([[1, 0, 3]])
+    out = F.embedding(jnp.asarray(idx), jnp.asarray(w), padding_idx=0)
+    np.testing.assert_allclose(_np(out)[0, 0], w[1], rtol=1e-6)
+    np.testing.assert_array_equal(_np(out)[0, 1], np.zeros(4))
+
+
+def test_sdpa_reference_vs_manual():
+    np.random.seed(0)
+    b, s, h, d = 2, 6, 2, 4
+    q = np.random.randn(b, s, h, d).astype(np.float32)
+    k = np.random.randn(b, s, h, d).astype(np.float32)
+    v = np.random.randn(b, s, h, d).astype(np.float32)
+    out = F.scaled_dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v))
+    # manual
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = (p @ vh).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_causal():
+    b, s, h, d = 1, 5, 1, 4
+    q = np.random.randn(b, s, h, d).astype(np.float32)
+    k = np.random.randn(b, s, h, d).astype(np.float32)
+    v = np.random.randn(b, s, h, d).astype(np.float32)
+    out = F.scaled_dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), is_causal=True)
+    # first position attends only to itself
+    np.testing.assert_allclose(_np(out)[0, 0, 0], v[0, 0, 0], rtol=1e-4)
+
+
+def test_one_hot():
+    out = F.one_hot(jnp.asarray([0, 2]), 3)
+    np.testing.assert_array_equal(_np(out), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_pad_spatial_form():
+    x = jnp.ones((1, 1, 2, 2))
+    out = F.pad(x, [1, 1, 0, 0])  # l,r,t,b on W then H (reversed dims)
+    assert out.shape == (1, 1, 2, 4)
+
+
+def test_interpolate_nearest():
+    x = jnp.asarray(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_array_equal(_np(out)[0, 0], np.repeat(
+        np.repeat(np.arange(4).reshape(2, 2), 2, 0), 2, 1))

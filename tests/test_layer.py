@@ -1,0 +1,181 @@
+"""Layer system tests (model: reference Layer API tests in
+test/legacy_test/test_imperative_layers.py etc.)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import functional_call, state
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_parameters_enumeration():
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert len(m.parameters()) == 4
+    assert m.fc1.weight.shape == (4, 8)
+
+
+def test_state_dict_roundtrip():
+    m = MLP()
+    sd = m.state_dict()
+    m2 = MLP()
+    missing, unexpected = m2.set_state_dict(sd)
+    assert not missing and not unexpected
+    for k in sd:
+        np.testing.assert_array_equal(np.asarray(m2.state_dict()[k]),
+                                      np.asarray(sd[k]))
+
+
+def test_attribute_routing():
+    m = MLP()
+    w0 = m.fc1.weight
+    m.fc1.weight = jnp.zeros_like(w0)
+    assert "weight" in m.fc1._parameters
+    assert float(jnp.sum(jnp.abs(m.fc1.weight))) == 0.0
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    m.eval()
+    x = jnp.ones((2, 4))
+    y1, y2 = m(x), m(x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    m.train()
+    assert m[1].training
+
+
+def test_hooks():
+    m = MLP()
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    m(jnp.ones((1, 4)))
+    assert calls == [1]
+    h.remove()
+    m(jnp.ones((1, 4)))
+    assert calls == [1]
+
+
+def test_sublayers_and_apply():
+    m = MLP()
+    assert len(m.sublayers()) == 3
+    seen = []
+    m.apply(lambda l: seen.append(type(l).__name__))
+    assert "MLP" in seen and "Linear" in seen
+
+
+def test_to_dtype():
+    m = MLP()
+    m.to(dtype="bfloat16")
+    assert m.fc1.weight.dtype == jnp.bfloat16
+
+
+def test_functional_call_pure():
+    m = MLP()
+    params, buffers = state(m)
+    x = jnp.ones((3, 4))
+    out1, _ = functional_call(m, params, buffers, (x,))
+    zeroed = {k: jnp.zeros_like(v) for k, v in params.items()}
+    out0, _ = functional_call(m, zeroed, buffers, (x,))
+    assert float(jnp.sum(jnp.abs(out0))) == 0.0
+    # module unchanged after functional call with zeros
+    out_again = m(x)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out_again), rtol=1e-6)
+
+
+def test_batchnorm_buffers_update():
+    bn = nn.BatchNorm2D(3)
+    x = jnp.asarray(np.random.randn(4, 3, 5, 5).astype(np.float32)) + 2.0
+    params, buffers = state(bn)
+    assert "._mean" in "".join(buffers) or "_mean" in buffers
+    out, new_buffers = functional_call(bn, params, buffers, (x,), train=True)
+    # running mean moved toward batch mean (paddle momentum 0.9)
+    assert abs(float(new_buffers["_mean"][0])) > 0.0
+    # eval mode uses stats, no update
+    out2, nb2 = functional_call(bn, params, new_buffers, (x,), train=False)
+    np.testing.assert_allclose(np.asarray(nb2["_mean"]),
+                               np.asarray(new_buffers["_mean"]))
+
+
+def test_grad_through_functional_call():
+    m = MLP()
+    params, buffers = state(m)
+    x = jnp.ones((3, 4))
+    y = jnp.zeros((3,), jnp.int32)
+
+    def loss_fn(p):
+        out, _ = functional_call(m, p, buffers, (x,))
+        return nn.functional.cross_entropy(out, y)
+
+    g = jax.grad(loss_fn)(params)
+    assert set(g.keys()) == set(params.keys())
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+    # fc1 grad nonzero
+    assert float(jnp.sum(jnp.abs(g["fc1.weight"]))) > 0
+
+
+def test_jit_functional_call_no_leak():
+    m = MLP()
+    params, buffers = state(m)
+
+    @jax.jit
+    def fwd(p, x):
+        out, _ = functional_call(m, p, buffers, (x,))
+        return out
+
+    out = fwd(params, jnp.ones((2, 4)))
+    assert out.shape == (2, 2)
+    # layer attributes are still concrete (no tracer leak)
+    assert isinstance(m.fc1.weight, jax.Array)
+    _ = m(jnp.ones((2, 4)))  # eager still works
+
+
+def test_shared_sublayer_weight_tying():
+    """Tied sublayers must appear once in state (reference pattern: tied
+    input/output embeddings in GPT)."""
+
+    class Tied(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(10, 4)
+            self.head = self.emb  # tied
+
+        def forward(self, x):
+            h = self.emb(x)
+            return h @ self.head.weight.T
+
+    m = Tied()
+    params, buffers = state(m)
+    assert list(params.keys()) == ["emb.weight"]
+    out, _ = functional_call(m, params, buffers, (jnp.asarray([[1, 2]]),))
+    assert out.shape == (1, 2, 10)
+    g = jax.grad(lambda p: jnp.sum(
+        functional_call(m, p, buffers, (jnp.asarray([[1, 2]]),))[0] ** 2))(params)
+    assert set(g.keys()) == {"emb.weight"}
+
+
+def test_dropout_under_jit_requires_rng():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    params, buffers = state(m)
+    with pytest.raises(RuntimeError, match="RNG context"):
+        jax.jit(lambda p, x: functional_call(m, p, buffers, (x,))[0])(
+            params, jnp.ones((2, 4)))
+    # with rng it works and differs across keys
+    f = jax.jit(lambda p, x, k: functional_call(m, p, buffers, (x,), rng=k)[0])
+    o1 = f(params, jnp.ones((2, 4)), jax.random.key(0))
+    o2 = f(params, jnp.ones((2, 4)), jax.random.key(1))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
