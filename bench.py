@@ -1,0 +1,95 @@
+"""Benchmark harness: GPT causal-LM training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline context (BASELINE.md): the north-star metric is tokens/sec/chip +
+MFU on GPT-class training.  On the single available chip we run the largest
+GPT that fits and report tokens/sec/chip with the MFU in extras.
+
+MFU = (6*N + 12*L*E*S) * tokens_per_sec / peak_flops   (BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# v5e (v5 lite) bf16 peak per chip
+PEAK_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.nn.functional_call import functional_call, state
+    from paddle_tpu.distributed.meta_parallel.mp_layers import parallel_cross_entropy
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=12,
+                        num_heads=16, max_seq_len=1024, dropout=0.0,
+                        dtype="bfloat16", remat=False)
+        batch, seq, iters, warmup = 8, 1024, 20, 3
+    else:  # smoke path for CPU debugging
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0, remat=False)
+        batch, seq, iters, warmup = 2, 128, 3, 1
+
+    model = GPTForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        model.to(dtype="bfloat16")
+    params, buffers = state(model)
+    o = opt.AdamW(learning_rate=1e-4, multi_precision=cfg.dtype == "bfloat16")
+    ostate = o.init(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq + 1)))
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    @jax.jit
+    def step(p, os_, x, y):
+        def loss_fn(p):
+            out, _ = functional_call(model, p, buffers, (x,), train=True)
+            return jnp.mean(parallel_cross_entropy(out, y))
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        newp, nos = o.update(g, os_, p)
+        return newp, nos, loss
+
+    # warmup/compile
+    for _ in range(warmup):
+        params, ostate, loss = step(params, ostate, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, ostate, loss = step(params, ostate, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    n_params = cfg.num_params()
+    flops_per_tok = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = PEAK_FLOPS.get(gen, 197e12)
+    mfu = flops_per_tok * tokens_per_sec / peak
+
+    print(json.dumps({
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),  # fraction of the 45%-MFU target
+        "extras": {"mfu": round(mfu, 4), "params": n_params,
+                   "platform": platform, "loss": float(loss),
+                   "config": f"L{cfg.num_layers}-H{cfg.hidden_size}-b{batch}-s{seq}"},
+    }))
+
+
+if __name__ == "__main__":
+    main()

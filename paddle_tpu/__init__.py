@@ -96,4 +96,12 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name == "Model":  # paddle.Model parity
+        from .hapi import Model
+        globals()["Model"] = Model
+        return Model
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+        globals()["DataParallel"] = DataParallel
+        return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -45,17 +45,24 @@ def auto_cast(enable: bool = True, custom_white_list=None,
               dtype: str = "bfloat16", use_promote: bool = True):
     """Parity: paddle.amp.auto_cast."""
     prev = (_state.enabled, _state.dtype, _state.level)
+    saved_white, saved_black = set(white_list), set(black_list)
     _state.enabled = enable
     _state.dtype = jnp.dtype(dtype)
     _state.level = level
     if custom_white_list:
         white_list.update(custom_white_list)
+        black_list.difference_update(custom_white_list)
     if custom_black_list:
         black_list.update(custom_black_list)
+        white_list.difference_update(custom_black_list)
     try:
         yield
     finally:
         _state.enabled, _state.dtype, _state.level = prev
+        white_list.clear()
+        white_list.update(saved_white)
+        black_list.clear()
+        black_list.update(saved_black)
 
 
 amp_guard = auto_cast

@@ -1,0 +1,29 @@
+"""paddle_tpu.distributed — mesh-native distributed surface
+(parity: python/paddle/distributed/)."""
+
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       ParallelAxis, get_hybrid_communicate_group)
+from .strategy import DistributedStrategy  # noqa: F401
+from .collective import (ReduceOp, all_reduce, all_gather,  # noqa: F401
+                         all_gather_object, reduce_scatter, alltoall,
+                         alltoall_single, broadcast, reduce, scatter,
+                         barrier, send, recv, new_group, wait)
+from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from . import fleet as _fleet_mod  # noqa: F401
+from .fleet import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import sharding_utils  # noqa: F401
+from . import pipelining  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy heavy submodules
+    if name in ("auto_parallel", "checkpoint", "launch", "sharding", "moe",
+                "spawn"):
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

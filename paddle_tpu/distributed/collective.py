@@ -1,0 +1,338 @@
+"""Communication API — paddle.distributed collectives on XLA.
+
+Reference: python/paddle/distributed/communication/ — all_reduce.py,
+all_gather.py, reduce_scatter.py, alltoall.py, broadcast.py, send/recv,
+stream/* variants, group.py; backed by ProcessGroupNCCL
+(paddle/fluid/distributed/collective/process_group_nccl.cc) with dedicated
+comm streams + ncclGroupStart batching.
+
+TPU-native (the heart of the north-star port, SURVEY.md §5): there is no
+NCCL — collectives are XLA HLO ops scheduled onto ICI.  Two usage modes:
+
+  1. **Traced** (inside shard_map/pjit): functions lower directly to
+     jax.lax.psum / all_gather / psum_scatter / all_to_all / ppermute with
+     the group's axis name.  This is the hot path — zero Python overhead at
+     run time, collectives fused and double-buffered by XLA.
+  2. **Eager parity**: called outside a trace with an array sharded over the
+     group's mesh axis, the op wraps itself in a cached jitted shard_map
+     over the group mesh — each device's shard plays the role of a rank's
+     local tensor.  Replicated inputs behave like every rank holding the
+     same value (matching the reference when all ranks enter with equal
+     data).
+
+``ReduceOp`` and function signatures mirror the reference, including
+``sync_op``/``use_calc_stream`` kwargs (accepted, meaningless under XLA's
+scheduler — documented no-ops, like paddle's on single-stream backends).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .topology import ParallelAxis, get_hybrid_communicate_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "reduce_scatter", "alltoall", "alltoall_single", "broadcast",
+           "reduce", "scatter", "barrier", "send", "recv", "new_group",
+           "get_group", "wait", "get_rank", "get_world_size"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.AVG: jax.lax.pmean,
+}
+
+_GROUPS: dict[int, ParallelAxis] = {}
+_NEXT_GID = [1]
+
+
+def _default_group() -> ParallelAxis:
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_data_parallel_group()
+    # world group over all devices on one axis
+    if 0 not in _GROUPS:
+        devs = jax.devices()
+        import numpy as np
+        mesh = Mesh(np.asarray(devs), ("world",))
+        _GROUPS[0] = ParallelAxis("world", len(devs), mesh, 0)
+    return _GROUPS[0]
+
+
+def _resolve(group) -> ParallelAxis:
+    if group is None:
+        return _default_group()
+    if isinstance(group, ParallelAxis):
+        return group
+    if isinstance(group, int):
+        return _GROUPS[group]
+    raise TypeError(f"bad group {group!r}")
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None) -> ParallelAxis:
+    """Create a group over the given device ids (reference:
+    paddle.distributed.new_group creating a sub-communicator)."""
+    import numpy as np
+    devs = jax.devices()
+    sel = [devs[r] for r in ranks] if ranks else list(devs)
+    gid = _NEXT_GID[0]
+    _NEXT_GID[0] += 1
+    name = f"g{gid}"
+    mesh = Mesh(np.asarray(sel), (name,))
+    g = ParallelAxis(name, len(sel), mesh, gid)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> ParallelAxis:
+    return _GROUPS.get(gid) or _default_group()
+
+
+def get_rank(group=None) -> int:
+    from . import env
+    return env.get_rank()
+
+
+def get_world_size(group=None) -> int:
+    g = _resolve(group) if group is not None else None
+    if g is not None:
+        return g.nranks
+    from . import env
+    return env.get_world_size()
+
+
+def _eager_collective(g: ParallelAxis, per_shard_fn, x, out_specs_rank=None):
+    """Run per_shard_fn over x's shards along g's axis via shard_map.
+
+    x sharded on axis -> shards are rank-local tensors; x replicated ->
+    every 'rank' sees the same tensor (shard_map with replicated in_spec).
+    """
+    from jax import shard_map
+    mesh = g.mesh
+    axis = g.name
+    # determine whether x is sharded over this axis already
+    in_spec = P()
+    if hasattr(x, "sharding") and isinstance(x.sharding, NamedSharding):
+        in_spec = x.sharding.spec
+        if x.sharding.mesh.shape != dict(mesh.shape):
+            in_spec = P()
+    out_spec = out_specs_rank if out_specs_rank is not None else in_spec
+
+    fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
+                   out_specs=out_spec, check_vma=False)
+    return jax.jit(fn)(x)
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True,
+               use_calc_stream: bool = False):
+    """psum/pmax/pmin over the group axis.  Traced: lowers inline.  Eager:
+    returns the reduced array (replicated on the axis)."""
+    g = _resolve(group)
+    red = _REDUCERS[op if op != ReduceOp.PROD else ReduceOp.SUM]
+    if op == ReduceOp.PROD:
+        def body(x):
+            return jnp.exp(jax.lax.psum(jnp.log(x), g.name))
+    else:
+        def body(x):
+            return red(x, g.name)
+    if _in_trace(tensor):
+        return body(tensor)
+    if g.nranks == 1:
+        return tensor
+    # eager: result replicated over the axis
+    def per_shard(x):
+        return body(x)
+    out = _eager_collective(g, per_shard, tensor, out_specs_rank=_drop_axis_spec(tensor, g))
+    return out
+
+
+def _drop_axis_spec(x, g: ParallelAxis):
+    """Output spec with g's axis removed (result replicated on that axis)."""
+    if hasattr(x, "sharding") and isinstance(x.sharding, NamedSharding) and \
+            x.sharding.mesh.shape == dict(g.mesh.shape):
+        spec = list(x.sharding.spec)
+        spec = [None if s == g.name else s for s in spec]
+        return P(*spec)
+    return P()
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None,
+           sync_op: bool = True):
+    """All ranks compute the reduction; under SPMD the 'dst-only' result is
+    the same array (documented deviation: no asymmetric storage)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op: bool = True,
+               axis: int = 0):
+    """Traced: lax.all_gather over axis name (concatenated along ``axis``).
+    Eager parity: list-output form fills tensor_list like the reference."""
+    out_list = None
+    if isinstance(tensor_or_list, list):
+        out_list = tensor_or_list
+        x = tensor
+    else:
+        x = tensor_or_list
+    g = _resolve(group)
+    if _in_trace(x):
+        out = jax.lax.all_gather(x, g.name, axis=axis, tiled=True)
+        return out
+    if g.nranks == 1:
+        out = x
+        if out_list is not None:
+            out_list.append(x)
+            return out_list
+        return out
+    def per_shard(v):
+        return jax.lax.all_gather(v, g.name, axis=axis, tiled=True)
+    out = _eager_collective(g, per_shard, x,
+                            out_specs_rank=_drop_axis_spec(x, g))
+    if out_list is not None:
+        out_list.extend(jnp.split(out, g.nranks, axis=axis))
+        return out_list
+    return out
+
+
+def all_gather_object(obj_list, obj, group=None):
+    """Host-object gather: single-controller processes share the object."""
+    g = _resolve(group)
+    obj_list.extend([obj] * g.nranks)
+    return obj_list
+
+
+def reduce_scatter(output=None, input=None, op: str = ReduceOp.SUM, group=None,
+                   sync_op: bool = True, axis: int = 0):
+    """Traced: lax.psum_scatter (tiled).  input may be passed positionally
+    first for reference parity reduce_scatter(out, in)."""
+    x = input if input is not None else output
+    g = _resolve(group)
+    if _in_trace(x):
+        return jax.lax.psum_scatter(x, g.name, scatter_dimension=axis,
+                                    tiled=True)
+    if g.nranks == 1:
+        return x
+    def per_shard(v):
+        return jax.lax.psum_scatter(v, g.name, scatter_dimension=axis,
+                                    tiled=True)
+    # result is sharded over the group axis on the scatter dimension
+    if hasattr(x, "sharding") and isinstance(x.sharding, NamedSharding) and \
+            x.sharding.mesh.shape == dict(g.mesh.shape):
+        s = list(x.sharding.spec)
+    else:
+        s = []
+    while len(s) <= axis:
+        s.append(None)
+    s[axis] = g.name
+    return _eager_collective(g, per_shard, x, out_specs_rank=P(*s))
+
+
+def alltoall(out_tensor_list=None, in_tensor_list=None, group=None,
+             sync_op: bool = True):
+    """List form (reference paddle.distributed.alltoall): splits stacked."""
+    g = _resolve(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.concatenate([jnp.asarray(t) for t in in_tensor_list], axis=0)
+    else:
+        x = in_tensor_list
+    out = alltoall_single(None, x, group=g)
+    if out_tensor_list is not None:
+        out_tensor_list.extend(jnp.split(out, g.nranks, axis=0))
+        return out_tensor_list
+    return out
+
+
+def alltoall_single(output=None, input=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op: bool = True,
+                    split_axis: int = 0, concat_axis: int = 0):
+    x = input if input is not None else output
+    g = _resolve(group)
+    if _in_trace(x):
+        return jax.lax.all_to_all(x, g.name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+    if g.nranks == 1:
+        return x
+    def per_shard(v):
+        return jax.lax.all_to_all(v, g.name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+    return _eager_collective(g, per_shard, x)
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
+    """Traced: select src's shard and broadcast along the axis."""
+    g = _resolve(group)
+    if _in_trace(tensor):
+        # gather all shards, take src's (compiles to a broadcast from src)
+        gathered = jax.lax.all_gather(tensor, g.name)
+        return gathered[src]
+    if g.nranks == 1:
+        return tensor
+    def per_shard(v):
+        return jax.lax.all_gather(v, g.name)[src]
+    return _eager_collective(g, per_shard, tensor,
+                             out_specs_rank=_drop_axis_spec(tensor, g))
+
+
+def scatter(tensor=None, tensor_list=None, src: int = 0, group=None,
+            sync_op: bool = True):
+    """Reference scatter: src rank's list is split across ranks.  Under
+    SPMD: reshard the stacked tensor across the axis."""
+    g = _resolve(group)
+    if tensor_list is not None:
+        stacked = jnp.stack([jnp.asarray(t) for t in tensor_list], axis=0)
+    else:
+        stacked = tensor
+    if g.nranks == 1:
+        return stacked[0] if tensor_list is not None else stacked
+    mesh = g.mesh
+    spec = [None] * stacked.ndim
+    spec[0] = g.name
+    sharded = jax.device_put(stacked, NamedSharding(mesh, P(*spec)))
+    return sharded
+
+
+def send(tensor, dst: int = 0, group=None, sync_op: bool = True):
+    raise RuntimeError(
+        "point-to-point send/recv outside a traced region is not expressible "
+        "under single-controller SPMD; use shard_map with jax.lax.ppermute "
+        "(see distributed.p2p.send_recv) — the pipeline runtime does this")
+
+
+def recv(tensor, src: int = 0, group=None, sync_op: bool = True):
+    raise RuntimeError(
+        "point-to-point send/recv outside a traced region is not expressible "
+        "under single-controller SPMD; use shard_map with jax.lax.ppermute "
+        "(see distributed.p2p.send_recv) — the pipeline runtime does this")
+
+
+def barrier(group=None):
+    """Device barrier: block host until pending work completes (the XLA
+    runtime orders device work; host sync is what barrier means here)."""
+    for d in jax.live_arrays():
+        pass
+    jax.effects_barrier()
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    if hasattr(tensor, "block_until_ready"):
+        tensor.block_until_ready()
+    return tensor

@@ -1,0 +1,60 @@
+"""Process-level distributed environment.
+
+Reference env contract (SURVEY.md §2.4 Launcher): PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT —
+the launcher exports these; here they map onto jax.distributed process
+indices.  Single-process (one host, N local devices) is the common TPU
+case: rank 0, world size 1 at the *process* level, with device-level
+parallelism expressed through the mesh instead.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["get_rank", "get_world_size", "get_local_rank", "is_initialized",
+           "init_process_env"]
+
+_initialized = False
+
+
+def get_rank() -> int:
+    if jax.process_count() > 1:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size() -> int:
+    if jax.process_count() > 1:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+
+def is_initialized() -> bool:
+    return _initialized or jax.process_count() > 1
+
+
+def init_process_env(coordinator_address=None, num_processes=None,
+                     process_id=None) -> None:
+    """Multi-host bring-up: jax.distributed.initialize (replaces TCPStore +
+    ncclCommInitRank rendezvous — SURVEY.md §5 'Distributed communication
+    backend')."""
+    global _initialized
+    if _initialized:
+        return
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    nproc = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if nproc > 1 and addr:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True

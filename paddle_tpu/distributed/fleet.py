@@ -1,0 +1,118 @@
+"""fleet — the hybrid-parallel front door.
+
+Reference: python/paddle/distributed/fleet/fleet.py — Fleet.init(strategy)
+builds HybridCommunicateGroup + per-axis NCCL groups;
+fleet.distributed_model() wraps the model per enabled axes
+(PipelineParallel ⊃ TensorParallel ⊃ DataParallel);
+fleet.distributed_optimizer() wraps the optimizer (sharding, grad clip
+aggregation) — SURVEY.md §3.1.
+
+TPU-native: init() constructs the global Mesh (topology.py) and records the
+strategy; distributed_model() returns a wrapper that (a) annotates parameter
+shardings for tp/sharding axes, (b) for pp wraps PipelineLayer scheduling;
+distributed_optimizer() attaches opt-state sharding specs (ZeRO).  The
+actual collective insertion is XLA's job once shardings are declared.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .strategy import DistributedStrategy
+from .topology import (HybridCommunicateGroup, set_hybrid_communicate_group,
+                       get_hybrid_communicate_group)
+
+__all__ = ["init", "get_hybrid_communicate_group", "distributed_model",
+           "distributed_optimizer", "worker_index", "worker_num",
+           "is_first_worker", "barrier_worker", "fleet"]
+
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO",
+         devices=None):
+    """Build the device mesh from strategy.hybrid_configs."""
+    global _strategy
+    strategy = strategy or DistributedStrategy()
+    _strategy = strategy
+    h = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=h["dp_degree"], mp_degree=h["mp_degree"],
+        pp_degree=h["pp_degree"], sharding_degree=h["sharding_degree"],
+        sep_degree=h["sep_degree"], devices=devices)
+    set_hybrid_communicate_group(hcg)
+    return fleet
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def distributed_model(model):
+    """Wrap per enabled axes (reference: meta_parallel factory)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init(...) first")
+    from .meta_parallel.pp_layers import PipelineLayer
+    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.tensor_parallel import TensorParallel
+    from .parallel import DataParallel
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError("pp_degree>1 requires a PipelineLayer model "
+                            "(reference behavior)")
+        return PipelineParallel(model, hcg, strategy=_strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy=_strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, hcg=hcg)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Attach hybrid semantics to the optimizer: ZeRO opt-state sharding
+    specs when sharding_degree>1 (reference: DygraphShardingOptimizer)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+        from .meta_parallel.sharding import ShardingOptimizer
+        return ShardingOptimizer(optimizer, hcg)
+    return optimizer
+
+
+def worker_index() -> int:
+    from . import env
+    return env.get_rank()
+
+
+def worker_num() -> int:
+    from . import env
+    return env.get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from .collective import barrier
+    barrier()
+
+
+class _FleetModule:
+    """`fleet` object parity: fleet.init / fleet.distributed_model ..."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+    DistributedStrategy = DistributedStrategy
+
+
+fleet = _FleetModule()

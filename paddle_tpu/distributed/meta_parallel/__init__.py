@@ -1,0 +1,12 @@
+"""fleet.meta_parallel parity surface."""
+
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
+                        VocabParallelEmbedding, ParallelCrossEntropy,
+                        parallel_cross_entropy)
+from .tensor_parallel import TensorParallel, MetaParallelBase  # noqa: F401
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .sharding import (ShardingOptimizer, DygraphShardingOptimizer,  # noqa: F401
+                       GroupShardedStage2, GroupShardedStage3,
+                       group_sharded_parallel, build_sharded_specs)
+from . import sequence_parallel  # noqa: F401

@@ -1,0 +1,172 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py — ColumnParallelLinear, RowParallelLinear,
+VocabParallelEmbedding, ParallelCrossEntropy (backed by
+c_softmax_with_cross_entropy CUDA op and identity-fwd/allreduce-bwd
+PyLayers).
+
+TPU-native: the layers hold FULL (global-shape) weights annotated with
+PartitionSpecs over the ``mp`` mesh axis; forward is the plain math plus
+``with_sharding_constraint`` on activations.  XLA GSPMD partitions the
+matmuls and inserts the all-reduce/all-gather the reference hand-writes
+(mp_ops._IdentityInFwdAllReduceInBwd etc.).  API (gather_output,
+input_is_parallel, has_bias, mp_group) matches the reference so fleet
+scripts port unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ..topology import get_hybrid_communicate_group
+from ..sharding_utils import set_param_spec
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "parallel_cross_entropy"]
+
+
+def _mp_axis(mp_group) -> str:
+    if mp_group is not None and hasattr(mp_group, "name"):
+        return mp_group.name
+    return "mp"
+
+
+def _maybe_constraint(x, spec: P):
+    """Apply a sharding constraint when running under jit with a mesh in
+    scope; harmless no-op in plain eager."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x
+
+
+class ColumnParallelLinear(Layer):
+    """Y = X W, W [in, out] split along out (columns).  Output stays
+    mp-sharded when gather_output=False (feeding RowParallelLinear)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 gather_output: bool = True, fuse_matmul_bias: bool = False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self._axis = _mp_axis(mp_group)
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        set_param_spec(self, "weight", P(None, self._axis))
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            set_param_spec(self, "bias", P(self._axis))
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _maybe_constraint(y, P(*([None] * y.ndim)))
+        else:
+            y = _maybe_constraint(y, P(*([None] * (y.ndim - 1)), self._axis))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Y = X W, W [in, out] split along in (rows).  Input is expected
+    mp-sharded on the last dim when input_is_parallel=True; the partial
+    products are all-reduced (by GSPMD) into a replicated output."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 input_is_parallel: bool = False, fuse_matmul_bias: bool = False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self._axis = _mp_axis(mp_group)
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        set_param_spec(self, "weight", P(self._axis, None))
+        if has_bias:
+            # bias added after the reduction -> replicated (reference: bias
+            # added post-allreduce on rank path)
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            set_param_spec(self, "bias", P())
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _maybe_constraint(x, P(*([None] * (x.ndim - 1)), self._axis))
+        y = jnp.matmul(x, self.weight)
+        y = _maybe_constraint(y, P(*([None] * y.ndim)))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table split along vocab.  GSPMD turns the gather into a
+    partial lookup + all-reduce (reference: masked local lookup + allreduce
+    in mp_ops)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self._axis = _mp_axis(mp_group)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        set_param_spec(self, "weight", P(self._axis, None))
+
+    def forward(self, x):
+        out = jnp.take(self.weight, x.astype(jnp.int32), axis=0)
+        return _maybe_constraint(out, P(*([None] * (x.ndim + 1))))
+
+
+def parallel_cross_entropy(logits, label, ignore_index: int = -100,
+                           mp_axis: str = "mp"):
+    """Vocab-parallel softmax cross-entropy.
+
+    Reference: paddle/fluid/operators/collective/
+    c_softmax_with_cross_entropy_op.cu — per-shard max/sum with two
+    allreduces, never materializing the full softmax.  Under GSPMD we write
+    the stable logsumexp on (constraint-)sharded logits; XLA performs the
+    reductions over the sharded vocab axis with exactly those collectives.
+    """
+    vocab_sharded = P(*([None] * (logits.ndim - 1)), mp_axis)
+    logits = _maybe_constraint(logits, vocab_sharded)
+    x = logits.astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(x, safe[..., None], axis=-1)
+    loss = (lse - picked)[..., 0]
+    return jnp.where(valid, loss, 0.0)
+
+
+class ParallelCrossEntropy(Layer):
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return parallel_cross_entropy(input, label, self.ignore_index,
+                                      self._axis)

@@ -1,0 +1,147 @@
+"""PipelineLayer — stage partitioning of a layer list.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py — LayerDesc, SharedLayerDesc, PipelineLayer (partition by
+uniform layer count or by flops via seg_method, builds only the local
+stage's layers, handles shared embeddings across stages).
+
+TPU-native: all stages are built (single-controller sees the whole model);
+partitioning assigns layers to stages and the runtime places each stage's
+params on its pp-mesh slice.  When every stage is structurally identical
+the runtime uses the fused scan+ppermute schedule (pipelining.py); general
+stage lists fall back to the sequential-stages program (still one jit,
+correct semantics, no overlap — documented).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...nn.layer import Layer
+from ...nn.layers.container import LayerList, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle_tpu.nn.Layer")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages (reference use: tied embeddings between
+    first and last stage; grads for the shared weight are summed over the
+    owning stages)."""
+
+    def __init__(self, key, layer_cls, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 recompute_ctx=None, num_virtual_pipeline_stages: int = 1):
+        super().__init__()
+        from ..topology import get_hybrid_communicate_group
+        self._descs = list(layers)
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.seg_method = seg_method
+        self.recompute_interval = recompute_interval
+        self._shared_layers = {}
+
+        built: List[Layer] = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_layers:
+                    layer = self._shared_layers[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared_layers[d.layer_name] = layer
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        self.run_function = LayerList(built)
+        self._segment()
+
+    def _segment(self):
+        n = len(self.run_function)
+        s = self.num_stages
+        if self.seg_method.startswith("layer:"):
+            # segment at boundaries of the named layer class (reference:
+            # seg_method='layer:TransformerBlock')
+            cls_name = self.seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(self.run_function)
+                     if type(l).__name__ == cls_name]
+            per = max(len(marks) // s, 1)
+            bounds = [0]
+            for k in range(1, s):
+                bounds.append(marks[min(k * per, len(marks) - 1)])
+            bounds.append(n)
+        else:  # uniform by layer count
+            per = n // s
+            extra = n % s
+            bounds = [0]
+            for k in range(s):
+                bounds.append(bounds[-1] + per + (1 if k < extra else 0))
+        self.segment_parts = bounds
+
+    def get_stage_layers(self, stage_id: int) -> List[Layer]:
+        lo = self.segment_parts[stage_id]
+        hi = self.segment_parts[stage_id + 1]
+        return [self.run_function[i] for i in range(lo, hi)]
+
+    def stages_uniform(self) -> bool:
+        """True when every stage has the same layer-type sequence (enables
+        the fused scan+ppermute runtime)."""
+        sigs = []
+        for sid in range(self.num_stages):
+            sigs.append(tuple(type(l).__name__
+                              for l in self.get_stage_layers(sid)))
+        return len(set(sigs)) == 1
+
+    def forward(self, x, *args):
+        """Non-pipelined reference semantics (used for parity tests and the
+        single-stage case): run all layers in order."""
+        for layer in self.run_function:
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    def allreduce_shared_weight_gradients(self):
+        """Under SPMD shared-weight grads are already summed (same value
+        used twice => autodiff adds contributions); parity no-op."""
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)

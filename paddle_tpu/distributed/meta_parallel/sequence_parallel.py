@@ -1,0 +1,102 @@
+"""Megatron sequence parallelism (SP) utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+— ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
+ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter: activations between TP blocks sharded
+on the sequence dim, swapping TP's allreduce for allgather+reduce-scatter.
+
+TPU-native: SP is an activation PartitionSpec — sequence dim carries the
+``mp`` axis between the Row->Column boundaries.  GSPMD then chooses
+all-gather/reduce-scatter exactly where the reference hand-placed them.
+The Op classes survive as sharding-constraint markers so ported model code
+keeps its structure.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer import Layer
+from .mp_layers import ColumnParallelLinear, RowParallelLinear, _maybe_constraint
+
+__all__ = ["scatter", "all_gather", "reduce_scatter", "ScatterOp", "GatherOp",
+           "AllGatherOp", "ReduceScatterOp", "ColumnSequenceParallelLinear",
+           "RowSequenceParallelLinear", "mark_as_sequence_parallel_parameter",
+           "seq_sharded", "seq_replicated"]
+
+# layout convention matches the reference: activations are [s, b, h] in SP
+# regions (seq first), sharded on dim 0 over mp.
+
+
+def seq_sharded(x, axis: str = "mp"):
+    """Constrain activation to sequence-sharded layout [s/mp, b, h]."""
+    return _maybe_constraint(x, P(axis, *([None] * (x.ndim - 1))))
+
+
+def seq_replicated(x):
+    return _maybe_constraint(x, P(*([None] * x.ndim)))
+
+
+def scatter(x, axis: str = "mp"):
+    """Reference ScatterOp fwd: split seq dim across mp; bwd: all-gather."""
+    return seq_sharded(x, axis)
+
+
+def all_gather(x, axis: str = "mp"):
+    """Reference AllGatherOp fwd: gather seq dim; bwd: reduce-scatter."""
+    return seq_replicated(x)
+
+
+def reduce_scatter(x, axis: str = "mp"):
+    """Reference ReduceScatterOp fwd: reduce + scatter over seq; under
+    GSPMD constraining a partial result to seq-sharded does exactly this."""
+    return seq_sharded(x, axis)
+
+
+# marker classes for ported code
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(all_gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(reduce_scatter)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Reference marks LN params inside SP regions so their grads get
+    allreduced over mp.  Under SPMD replicated params already produce
+    psum'd grads; kept for parity (no-op)."""
+    return parameter
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Input arrives seq-sharded [s/mp, b, h]; weight column-split; the
+    all-gather of activations happens at entry (GSPMD inserts it)."""
+
+    def forward(self, x):
+        x = seq_replicated(x)  # gather sequence shards for the matmul
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Output leaves seq-sharded (reduce-scatter instead of all-reduce)."""
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _maybe_constraint(x, P(*([None] * (x.ndim - 1)), self._axis))
+        y = jnp.matmul(x, self.weight)
+        y = seq_sharded(y, self._axis)
+        if self.bias is not None:
+            y = y + self.bias
+        return y

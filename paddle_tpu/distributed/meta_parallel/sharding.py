@@ -1,0 +1,145 @@
+"""ZeRO / GroupSharded stages.
+
+Reference (SURVEY.md §2.3 "Sharding / ZeRO"):
+  - stage 1: fleet/meta_optimizers/dygraph_optimizer/
+    dygraph_sharding_optimizer.py — DygraphShardingOptimizer partitions
+    optimizer states across the sharding group; updated shards broadcast.
+  - stage 2: meta_parallel/sharding/group_sharded_stage2.py — gradient
+    sharding via reduce-scatter hooks.
+  - stage 3: group_sharded_stage3.py — parameter sharding, gather-on-use.
+  - entry: python/paddle/distributed/sharding/group_sharded.py —
+    group_sharded_parallel(model, optimizer, level="os"/"os_g"/"p_g_os").
+
+TPU-native: each stage is a *layout policy* on the same train step —
+  stage 1 ("os"):    opt-state slots sharded over the ``sharding`` axis
+  stage 2 ("os_g"):  + gradients materialized sharded (XLA reduce-scatters)
+  stage 3 ("p_g_os"):+ parameters sharded, all-gathered on use by GSPMD
+No hooks, no broadcast pass: declaring the shardings in the jitted step's
+in/out_shardings makes XLA emit exactly the reduce-scatter + all-gather
+pattern ZeRO papers describe.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..sharding_utils import shard_opt_state_specs
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["ShardingOptimizer", "build_sharded_specs", "group_sharded_parallel",
+           "DygraphShardingOptimizer", "GroupShardedOptimizerStage2",
+           "GroupShardedStage2", "GroupShardedStage3"]
+
+
+def build_sharded_specs(param_specs: Dict[str, P],
+                        param_shapes: Dict[str, tuple],
+                        level: str = "os", axis: str = "sharding",
+                        degree: Optional[int] = None):
+    """Returns (param_specs, grad_specs, slot_specs) per ZeRO level."""
+    hcg = get_hybrid_communicate_group()
+    if degree is None:
+        degree = hcg.get_sharding_parallel_world_size() if hcg else 1
+    slot_specs = shard_opt_state_specs(param_specs, param_shapes, axis, degree)
+    if level in ("p_g_os", "stage3", 3):
+        p_specs = slot_specs  # params sharded like slots
+        g_specs = slot_specs
+    elif level in ("os_g", "stage2", 2):
+        p_specs = dict(param_specs)
+        g_specs = slot_specs
+    else:  # "os" / stage 1
+        p_specs = dict(param_specs)
+        g_specs = dict(param_specs)
+    return p_specs, g_specs, slot_specs
+
+
+class ShardingOptimizer:
+    """Optimizer wrapper carrying ZeRO layout (reference:
+    DygraphShardingOptimizer).  ``update`` is the inner rule; ``state_specs``
+    tells the train-step author (or fleet helpers) how to place the state."""
+
+    def __init__(self, optimizer, hcg=None, level: str = "os"):
+        self.inner = optimizer
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self.level = level
+
+    # passthrough functional surface
+    def init(self, params):
+        return self.inner.init(params)
+
+    def update(self, grads, state, params, lr=None):
+        return self.inner.update(grads, state, params, lr=lr)
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def state_specs(self, param_specs: Dict[str, P],
+                    param_shapes: Dict[str, tuple]):
+        """PartitionSpecs for the optimizer state pytree produced by
+        init(): {'step': P(), 'slots': {name: {slot: spec}}, 'master': ...}"""
+        _, _, slot_specs = build_sharded_specs(param_specs, param_shapes,
+                                               self.level)
+        # each param's slot dict shares the param's slot spec
+        example = {}
+        return {
+            "step": P(),
+            "slots": {k: slot_specs[k] for k in param_specs},
+            "master": {k: slot_specs[k] for k in param_specs},
+        }
+
+
+# ---- reference-named aliases (API parity) -----------------------------
+DygraphShardingOptimizer = ShardingOptimizer
+
+
+class GroupShardedStage2:
+    """Model wrapper marker for stage 2 (grad sharding).  The functional
+    train step reads .level to pick grad out_shardings."""
+
+    def __init__(self, model, optimizer=None, group=None, sync_buffers=False,
+                 buffer_max_size=2**23, auto_refresh_trainable=True,
+                 device="tpu"):
+        self.model = model
+        self.level = "os_g"
+
+    def __call__(self, *a, **k):
+        return self.model(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self.model, name)
+
+
+class GroupShardedStage3(GroupShardedStage2):
+    def __init__(self, model, optimizer=None, group=None, sync_buffers=False,
+                 segment_size=2**20, device="tpu", **kw):
+        self.model = model
+        self.level = "p_g_os"
+
+
+GroupShardedOptimizerStage2 = ShardingOptimizer
+
+
+def group_sharded_parallel(model, optimizer, level: str = "os", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2**23, segment_size=2**20,
+                           sync_comm=False):
+    """Entry point parity: python/paddle/distributed/sharding/group_sharded.py.
+
+    Returns (model_wrapper, sharding_optimizer, scaler).
+    """
+    level_map = {"os": "os", "os_g": "os_g", "p_g_os": "p_g_os",
+                 "stage1": "os", "stage2": "os_g", "stage3": "p_g_os"}
+    lvl = level_map[level]
+    opt = ShardingOptimizer(optimizer, level=lvl)
+    if lvl == "os":
+        wrapper = model
+    elif lvl == "os_g":
+        wrapper = GroupShardedStage2(model, opt)
+    else:
+        wrapper = GroupShardedStage3(model, opt)
+    return wrapper, opt, scaler

@@ -1,0 +1,64 @@
+"""TensorParallel model wrapper.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/tensor_parallel.py
+— TensorParallel(MetaParallelBase): broadcasts non-mp params across the mp
+group at init and syncs gradients of shared params.
+
+TPU-native: broadcasting/replication is a sharding property, not a runtime
+action.  The wrapper's job is to provide the jit-ready state: collect
+per-parameter PartitionSpecs (mp layers annotated theirs; everything else
+replicated), lay the state out on the mesh, and build train steps whose
+in/out shardings carry the specs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn.functional_call import state
+from ...nn.layer import Layer
+from ..sharding_utils import get_param_specs, shard_state
+
+__all__ = ["TensorParallel", "MetaParallelBase"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @property
+    def mesh(self):
+        return self._hcg.get_mesh()
+
+    def param_specs(self):
+        """Flat name->PartitionSpec for every parameter of the wrapped
+        model, prefixed to match this wrapper's state_dict keys."""
+        inner = get_param_specs(self._layers)
+        return {f"_layers.{k}": v for k, v in inner.items()}
+
+    def buffer_specs(self):
+        _, buffers = state(self)
+        return {k: P() for k in buffers}
+
+    def sharded_state(self):
+        """(params, buffers) laid out on the mesh per spec."""
+        params, buffers = state(self)
+        specs = self.param_specs()
+        params = shard_state(self.mesh, params,
+                             {k: specs.get(k, P()) for k in params})
+        buffers = shard_state(self.mesh, buffers,
+                              {k: P() for k in buffers})
+        return params, buffers
+
+
+class TensorParallel(MetaParallelBase):
+    """mp-degree>1 wrapper; see module docstring."""

@@ -1,0 +1,102 @@
+"""DataParallel + init_parallel_env.
+
+Reference: python/paddle/distributed/parallel.py — DataParallel wraps the
+model, EagerReducer (C++, paddle/fluid/distributed/collective/reducer.cc)
+buckets gradients and overlaps allreduce with backward; init_parallel_env
+boots TCPStore + ProcessGroupNCCL (SURVEY.md §2.3 DP, §3.3).
+
+TPU-native: gradient synchronization is not an event-driven runtime — with
+the batch sharded over the ``dp`` mesh axis and parameters replicated, the
+grad psum appears in the compiled program and XLA overlaps it with the
+backward automatically (bucketing = XLA collective combining).  The wrapper
+therefore only:
+  * records specs: params replicated, batch inputs sharded on dim 0;
+  * provides scale_loss (reference API) as identity (mean semantics come
+    from the loss itself under global-batch SPMD);
+  * exposes no_sync() for parity (a no-op context: grads are pure values).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layer import Layer
+from .topology import HybridCommunicateGroup, get_hybrid_communicate_group, \
+    set_hybrid_communicate_group
+from . import env as dist_env
+
+__all__ = ["DataParallel", "init_parallel_env", "get_rank", "get_world_size"]
+
+
+def init_parallel_env():
+    """Reference: dist.init_parallel_env — reads env contract, boots the
+    comm backend.  Here: jax.distributed for multi-host, plus a default
+    all-device dp mesh if none is set."""
+    dist_env.init_process_env()
+    if get_hybrid_communicate_group() is None:
+        hcg = HybridCommunicateGroup(dp_degree=len(jax.devices()))
+        set_hybrid_communicate_group(hcg)
+    return get_hybrid_communicate_group()
+
+
+def get_rank() -> int:
+    return dist_env.get_rank()
+
+
+def get_world_size() -> int:
+    return dist_env.get_world_size()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters=False,
+                 group=None, hcg=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @property
+    def mesh(self):
+        return self._hcg.get_mesh() if self._hcg else None
+
+    def batch_spec(self, ndim: int) -> P:
+        """Input batch sharded on dim0 over dp (and sharding, which also
+        carries data in fleet's hybrid view)."""
+        axes = []
+        if self._hcg is not None:
+            if self._hcg.get_data_parallel_world_size() > 1:
+                axes.append("dp")
+            if self._hcg.get_sharding_parallel_world_size() > 1:
+                axes.append("sharding")
+        first = tuple(axes) if axes else None
+        return P(first, *([None] * (ndim - 1)))
+
+    def param_specs(self):
+        from .sharding_utils import get_param_specs
+        inner = get_param_specs(self._layers)
+        return {f"_layers.{k}": v for k, v in inner.items()}
+
+    def scale_loss(self, loss):
+        """Reference scales loss by 1/nranks before backward; with a
+        mean-reduced loss over the global (sharded) batch that scaling is
+        built in, so this is identity — kept for API parity."""
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad-sync-free microbatch accumulation: gradients here are pure
+        values the caller accumulates; nothing to suppress."""
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)

@@ -1,0 +1,108 @@
+"""Parameter/activation sharding utilities shared by all parallel wrappers.
+
+The reference implements TP/ZeRO/SP as hand-written layers and hooked
+optimizers (SURVEY.md §2.3); here every strategy reduces to *which
+PartitionSpec each pytree leaf carries*.  These helpers attach, collect and
+apply those specs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["set_param_spec", "get_param_specs", "shard_state",
+           "named_sharding", "replicate_spec", "shard_opt_state_specs",
+           "constraint"]
+
+
+def set_param_spec(layer, name: str, spec: P) -> None:
+    """Record a PartitionSpec for layer's parameter ``name``."""
+    specs = layer.__dict__.setdefault("_param_specs", {})
+    specs[name] = spec
+
+
+def get_param_specs(layer, prefix: str = "") -> Dict[str, P]:
+    """Flat dotted-name -> PartitionSpec for every parameter (default P())."""
+    out = {}
+    for lname, sub in layer.named_sublayers(include_self=True):
+        specs = sub.__dict__.get("_param_specs", {})
+        for pname, p in sub._parameters.items():
+            if p is None:
+                continue
+            key = f"{lname}.{pname}" if lname else pname
+            out[key] = specs.get(pname, P())
+    return out
+
+
+def replicate_spec(tree):
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def named_sharding(mesh: Mesh, tree_of_specs):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), tree_of_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_state(mesh: Mesh, tree, specs):
+    """device_put each leaf with its NamedSharding (host->mesh layout).
+
+    ``specs`` mirrors ``tree``'s structure down to array leaves; each
+    corresponding spec (a PartitionSpec, passed whole) labels that leaf.
+    """
+    def rec(t, s):
+        if isinstance(t, dict):
+            return {k: rec(v, s[k] if isinstance(s, dict) else s)
+                    for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            ss = s if isinstance(s, (list, tuple)) and not isinstance(s, P) \
+                else [s] * len(t)
+            vals = [rec(v, si) for v, si in zip(t, ss)]
+            return type(t)(vals)
+        if t is None:
+            return None
+        return jax.device_put(t, NamedSharding(mesh, s if isinstance(s, P)
+                                               else P()))
+    return rec(tree, specs)
+
+
+def constraint(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint shortcut used inside forward fns."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _shardable_dim(shape, degree: int, taken: Optional[str]) -> Optional[int]:
+    for i, s in enumerate(shape):
+        if s % degree == 0 and s >= degree:
+            return i
+    return None
+
+
+def shard_opt_state_specs(param_specs: Dict[str, P], param_shapes: Dict[str, tuple],
+                          axis: str, degree: int):
+    """ZeRO-1 spec builder: optimizer slots sharded over ``axis`` along the
+    first dimension divisible by the degree that isn't already sharded by
+    another axis (reference: DygraphShardingOptimizer partitioning params
+    by numel across the sharding group — SURVEY.md §2.3 Sharding/ZeRO).
+
+    Returns name -> PartitionSpec to apply to each per-param slot tensor.
+    """
+    out = {}
+    for name, spec in param_specs.items():
+        shape = param_shapes[name]
+        spec_t = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+        dim = None
+        for i, s in enumerate(shape):
+            if spec_t[i] is None and s % degree == 0 and s >= degree:
+                dim = i
+                break
+        if dim is None:
+            out[name] = P(*spec_t) if len(spec_t) else P()
+            continue
+        new = list(spec_t)
+        new[dim] = axis if new[dim] is None else new[dim]
+        out[name] = P(*new)
+    return out

@@ -1,0 +1,81 @@
+"""DistributedStrategy — the user-facing parallelism config.
+
+Reference: python/paddle/distributed/fleet/base/distributed_strategy.py —
+protobuf-backed (framework/distributed_strategy.proto) with ~50 sub-configs;
+BASELINE's configs are expressed in it (SURVEY.md §5 "Config").
+
+TPU-native: a plain typed config tree with the same field names; the fields
+that configured NCCL/executor behavior are accepted and recorded (so
+reference scripts run) but marked no-op — XLA owns those decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+__all__ = ["DistributedStrategy"]
+
+
+@dataclasses.dataclass
+class _HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    ep_degree: int = 1
+    pp_configs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    mp_configs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._hybrid = _HybridConfig()
+        # amp
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {"init_loss_scaling": 65536.0,
+                                            "use_pure_fp16": False,
+                                            "use_bf16": True}
+        # recompute
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        # sharding (static-graph style config kept for parity)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {"stage": 1}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1,
+                                                 "schedule_mode": "1F1B"}
+        # grad fusion / overlap knobs: recorded, no-op under XLA
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {}
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = False
+
+    @property
+    def hybrid_configs(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self._hybrid)
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, cfg: Dict[str, Any]):
+        for k, v in cfg.items():
+            if hasattr(self._hybrid, k):
+                setattr(self._hybrid, k, v)
+            else:
+                raise ValueError(f"unknown hybrid config {k!r}")
+
+    def __repr__(self):
+        h = self._hybrid
+        return (f"DistributedStrategy(hybrid=dp{h.dp_degree}/mp{h.mp_degree}/"
+                f"pp{h.pp_degree}/sharding{h.sharding_degree}/sep{h.sep_degree},"
+                f" amp={self.amp}, recompute={self.recompute})")

@@ -1,0 +1,245 @@
+"""Hybrid-parallel topology -> jax.sharding.Mesh.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology, HybridCommunicateGroup: builds the rank hypercube in
+axis order [dp, pp, sharding, sep, mp] and one NCCL comm group per axis per
+slice (SURVEY.md §2.3 "Hybrid").
+
+TPU-native: the entire topology IS one ``jax.sharding.Mesh`` with named
+axes; "creating a comm group" costs nothing because collectives compile to
+ICI programs addressed by axis name.  Axis order matters for performance the
+same way the reference's does for NCCL ring construction: the LAST mesh
+axes map to the fastest (most-local) device dimensions, so ``mp`` (highest
+bandwidth demand) goes last, ``dp``/``pp`` (least) first — matching both
+fleet's [dp, pp, sharding, sep, mp] order and TPU ICI layout practice.
+
+Device-level "rank" only exists inside a shard_map/pjit region (via
+``jax.lax.axis_index``); host-level accessors report the process-view
+coordinates, which on a single-controller TPU job are the mesh structure
+itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelAxis",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+           "AXIS_ORDER"]
+
+# fleet's canonical order (reference: HybridCommunicateGroup._parallel_names)
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    """Rank-coordinate math over the named hypercube (reference:
+    CommunicateTopology — get_coord/get_rank/get_comm_list)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = AXIS_ORDER,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims))
+        self._coord_map = {}
+        coords = np.indices(self._dims).reshape(len(self._dims), -1).T
+        for rank, c in enumerate(coords):
+            self._coord_map[tuple(c)] = rank
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **coords) -> int:
+        c = tuple(coords[n] for n in self._parallel_names)
+        return self._coord_map[c]
+
+    def get_coord(self, rank: int):
+        coords = np.indices(self._dims).reshape(len(self._dims), -1).T
+        return tuple(coords[rank])
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        coords = np.indices(self._dims).reshape(len(self._dims), -1).T
+        return [self._coord_map[tuple(c)] for c in coords if c[axis] == index]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All rank groups along ``axis_name`` (one per slice of the other
+        axes)."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for other in np.indices(other_dims).reshape(len(other_dims), -1).T \
+                if other_dims else [()]:
+            grp = []
+            for k in range(self._dims[axis]):
+                c = list(other[:axis]) + [k] + list(other[axis:])
+                grp.append(self._coord_map[tuple(c)])
+            groups.append(grp)
+        return groups
+
+
+@dataclasses.dataclass
+class ParallelAxis:
+    """A comm 'group' in the TPU world: a named mesh axis.  Collectives over
+    it use the axis name inside shard_map / pjit; degree and a stable id
+    mirror the reference Group object."""
+
+    name: str          # mesh axis name ("mp", "dp", ...)
+    degree: int
+    mesh: Mesh
+    id: int = 0
+
+    @property
+    def nranks(self) -> int:
+        return self.degree
+
+    @property
+    def world_size(self) -> int:
+        return self.degree
+
+    def rank_in_group(self):
+        """Traced device rank along this axis — valid inside shard_map."""
+        return jax.lax.axis_index(self.name)
+
+    # host-side parity helpers (single-controller: the process sees coord 0)
+    @property
+    def rank(self) -> int:
+        return 0
+
+    def __repr__(self):
+        return f"ParallelAxis({self.name}, degree={self.degree})"
+
+
+class HybridCommunicateGroup:
+    """Parity surface of fleet's HybridCommunicateGroup over one Mesh."""
+
+    def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
+                 pp_degree: int = 1, sharding_degree: int = 1,
+                 sep_degree: int = 1, devices: Optional[Sequence] = None,
+                 topology: Optional[CommunicateTopology] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        degrees = dict(dp=dp_degree, pp=pp_degree, sharding=sharding_degree,
+                       sep=sep_degree, mp=mp_degree)
+        want = int(np.prod(list(degrees.values())))
+        if want < n:
+            # reference semantics: world size == product of degrees; with
+            # more local devices than requested, use the first `want`
+            devices = devices[:want]
+            n = want
+        elif want > n:
+            raise ValueError(
+                f"product of degrees {want} > device count {n}")
+        self._degrees = degrees
+        self._topo = topology or CommunicateTopology(
+            AXIS_ORDER, [degrees[a] for a in AXIS_ORDER])
+        dev_array = np.asarray(devices).reshape(
+            [degrees[a] for a in AXIS_ORDER])
+        self._mesh = Mesh(dev_array, AXIS_ORDER)
+        self._axes = {a: ParallelAxis(a, degrees[a], self._mesh, i)
+                      for i, a in enumerate(AXIS_ORDER)}
+        self.nranks = n
+        self.global_rank = 0
+
+    # --- mesh access (TPU-native surface) ------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    def get_mesh(self) -> Mesh:
+        return self._mesh
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        if self._degrees["pp"] > 1:
+            return "pipeline"
+        if self._degrees["sharding"] > 1:
+            return "sharding_parallel"
+        if self._degrees["mp"] > 1:
+            return "model"
+        return "data_parallel"
+
+    # --- per-axis accessors (reference API names) ----------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self._degrees["dp"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._degrees["mp"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._degrees["pp"]
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._degrees["sharding"]
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._degrees["sep"]
+
+    def get_data_parallel_group(self) -> ParallelAxis:
+        return self._axes["dp"]
+
+    def get_model_parallel_group(self) -> ParallelAxis:
+        return self._axes["mp"]
+
+    def get_pipe_parallel_group(self) -> ParallelAxis:
+        return self._axes["pp"]
+
+    def get_sharding_parallel_group(self) -> ParallelAxis:
+        return self._axes["sharding"]
+
+    def get_sep_parallel_group(self) -> ParallelAxis:
+        return self._axes["sep"]
+
+    # traced ranks, valid inside shard_map regions
+    def get_data_parallel_rank(self):
+        return jax.lax.axis_index("dp")
+
+    def get_model_parallel_rank(self):
+        return jax.lax.axis_index("mp")
+
+    def get_stage_id(self):
+        return jax.lax.axis_index("pp")
+
+    def get_sharding_parallel_rank(self):
+        return jax.lax.axis_index("sharding")
+
+    def get_sep_parallel_rank(self):
+        return jax.lax.axis_index("sep")
+
+    # group-id helpers kept for API parity
+    def get_check_parallel_group(self, *a, **k):
+        return self._axes["mp"]
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
+        return self._topo.get_rank(dp=0, pp=stage_id, sharding=0, sep=0, mp=0)
+
+    def __repr__(self):
+        d = self._degrees
+        return (f"HybridCommunicateGroup(dp={d['dp']}, pp={d['pp']}, "
+                f"sharding={d['sharding']}, sep={d['sep']}, mp={d['mp']})")
+
+
+_HCG: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG

@@ -1,0 +1,174 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py — Callback,
+ProgBarLogger, ModelCheckpoint, LRScheduler callback, EarlyStopping,
+VisualDL)."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRSchedulerCallback",
+           "EarlyStopping", "CallbackList"]
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def dispatch(*args, **kwargs):
+                for cb in self.callbacks:
+                    getattr(cb, name)(*args, **kwargs)
+            return dispatch
+        raise AttributeError(name)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.t0 = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and step % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
+                               f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"  step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self.t0
+            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
+                               f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"  epoch {epoch + 1} done in {dt:.1f}s: {items}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
+                               f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"  eval: {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRSchedulerCallback(Callback):
+    """Steps the optimizer's LRScheduler per epoch (reference default) or per
+    batch."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True):
+        self.by_step = by_step
+        self.by_epoch = by_epoch and not by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "_lr_sched", None) if opt else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "loss", mode: str = "auto",
+                 patience: int = 0, verbose: int = 1, min_delta: float = 0,
+                 baseline=None, save_best_model: bool = True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.best = None
+        self.wait = 0
+        self.stopped = False
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor else "max"
+        self.mode = mode
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur if not isinstance(cur, (list, tuple)) else cur[0])
+        better = (self.best is None or
+                  (self.mode == "min" and cur < self.best - self.min_delta) or
+                  (self.mode == "max" and cur > self.best + self.min_delta))
+        if better:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped = True
+                self.model.stop_training = True

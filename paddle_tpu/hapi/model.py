@@ -1,0 +1,253 @@
+"""paddle.Model — the high-level train/eval/predict API.
+
+Reference: python/paddle/hapi/model.py — Model.prepare/fit/evaluate/predict/
+save/load, driving DynamicGraphAdapter (eager) per batch.
+
+TPU-native: prepare() builds ONE jitted train step (forward + loss + grad +
+optimizer update, buffers threaded) and one jitted eval step; fit() is a
+host loop feeding numpy batches.  This is the shape the reference needs its
+whole executor stack for — here it's jax.jit around functional_call.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import io as fio
+from ..metric import Metric
+from ..nn.functional_call import functional_call, state, _index_stores, _write
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._params, self._buffers = state(network)
+        self._opt_state = None
+        self._train_step = None
+        self._eval_step = None
+        self._rng = jax.random.key(np.random.randint(0, 2**31 - 1))
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._metrics = list(self._metrics)
+        if optimizer is not None:
+            self._opt_state = optimizer.init(self._params)
+        net, opt, loss_fn = self.network, optimizer, loss
+
+        def train_step(params, buffers, opt_state, key, lr, *batch):
+            *inputs, label = batch
+
+            def compute_loss(p):
+                out, new_buf = functional_call(net, p, buffers, tuple(inputs),
+                                               rng=key, train=True)
+                l = loss_fn(out, label)
+                return l, (new_buf, out)
+
+            (l, (new_buf, out)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
+            return new_params, new_buf, new_opt, l, out
+
+        def eval_step(params, buffers, *batch):
+            *inputs, label = batch
+            out, _ = functional_call(net, params, buffers, tuple(inputs),
+                                     train=False)
+            l = loss_fn(out, label) if loss_fn is not None else jnp.zeros(())
+            return l, out
+
+        def predict_step(params, buffers, *inputs):
+            out, _ = functional_call(net, params, buffers, tuple(inputs),
+                                     train=False)
+            return out
+
+        if optimizer is not None:
+            self._train_step = jax.jit(train_step)
+        self._eval_step = jax.jit(eval_step)
+        self._predict_step = jax.jit(predict_step)
+
+    # ------------------------------------------------------------------
+    def _sync_network(self):
+        """Write current params/buffers back into the Layer tree."""
+        pindex, bindex = _index_stores(self.network)
+        _write(pindex, self._params)
+        _write(bindex, {k: v for k, v in self._buffers.items() if k in bindex},
+               strict=False)
+
+    def train_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if labels is not None:
+            labels = labels if isinstance(labels, (list, tuple)) else [labels]
+            batch = [*inputs, *labels]
+        else:
+            batch = list(inputs)
+        self._rng, sub = jax.random.split(self._rng)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        jbatch = [jnp.asarray(b) for b in batch]
+        (self._params, self._buffers, self._opt_state, loss, out) = \
+            self._train_step(self._params, self._buffers, self._opt_state,
+                             sub, lr, *jbatch)
+        return loss, out
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        batch = [*inputs, *(labels if isinstance(labels, (list, tuple))
+                            else [labels])] if labels is not None else list(inputs)
+        jbatch = [jnp.asarray(b) for b in batch]
+        return self._eval_step(self._params, self._buffers, *jbatch)
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self._predict_step(self._params, self._buffers,
+                                  *[jnp.asarray(b) for b in inputs])
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir=None, save_freq: int = 1, verbose: int = 2,
+            drop_last: bool = False, shuffle: bool = True, num_workers: int = 0,
+            callbacks: Optional[Sequence[Callback]] = None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = DataLoader(eval_data, batch_size=batch_size) \
+                if isinstance(eval_data, Dataset) else eval_data
+
+        cbks = CallbackList(list(callbacks or []) or [ProgBarLogger(log_freq,
+                                                                    verbose)])
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "verbose": verbose})
+        cbks.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            if hasattr(train_loader, "batch_sampler") and \
+                    hasattr(train_loader.batch_sampler, "set_epoch"):
+                train_loader.batch_sampler.set_epoch(epoch)
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss, out = self.train_batch(inputs, labels)
+                logs = {"loss": float(loss)}
+                for m in self._metrics:
+                    res = m.compute(np.asarray(out), np.asarray(labels[0]))
+                    v = m.update(np.asarray(res))
+                    names = m.name()
+                    logs[names[0]] = float(v) if np.ndim(v) == 0 else v
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, callbacks=cbks, _nested=True)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        self._sync_network()
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None,
+                 _nested=False):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size) \
+            if isinstance(eval_data, Dataset) else eval_data
+        cbks = callbacks if isinstance(callbacks, CallbackList) else \
+            CallbackList(list(callbacks or []))
+        if not _nested:
+            cbks.set_model(self)
+            cbks.set_params({"verbose": verbose})
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            loss, out = self.eval_batch(inputs, labels)
+            losses.append(float(loss))
+            for m in self._metrics:
+                res = m.compute(np.asarray(out), np.asarray(labels[0]))
+                m.update(np.asarray(res))
+            cbks.on_eval_batch_end(step, {"loss": float(loss)})
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name()
+            acc = m.accumulate()
+            logs[names[0]] = acc
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size) \
+            if isinstance(test_data, Dataset) else test_data
+        outs = []
+        for batch in loader:
+            # labeled datasets: drop the trailing label like fit/evaluate
+            inputs, _ = self._split_batch(batch)
+            outs.append(np.asarray(self.predict_batch(inputs)))
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    @staticmethod
+    def _split_batch(batch, has_label: bool = True):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2 and has_label:
+            return list(batch[:-1]), [batch[-1]]
+        if isinstance(batch, (list, tuple)):
+            return list(batch), []
+        return [batch], []
+
+    # ------------------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        self._sync_network()
+        fio.save(dict(self.network.state_dict()), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save({"opt_state": self._opt_state}, path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        sd = fio.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        self._params, self._buffers = state(self.network)
+        if not reset_optimizer and os.path.exists(path + ".pdopt"):
+            self._opt_state = fio.load(path + ".pdopt")["opt_state"]
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: {n_params:,} parameters"]
+        for name, p in self.network.named_parameters():
+            lines.append(f"  {name}: {tuple(p.shape)}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}

@@ -1,0 +1,9 @@
+"""paddle_tpu.io (parity: python/paddle/io/)."""
+
+from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
+                      ComposeDataset, ChainDataset, ConcatDataset, Subset,
+                      random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler, SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

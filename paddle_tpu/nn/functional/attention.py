@@ -34,6 +34,10 @@ def sdpa_reference(query, key, value, attn_mask=None, dropout_p: float = 0.0,
                    is_causal: bool = False, scale: Optional[float] = None,
                    training: bool = True):
     """Pure-XLA reference path. q/k/v: [B, S, H, D] (paddle layout)."""
+    from ...amp.auto_cast import maybe_cast
+    query = maybe_cast(query, "attention")
+    key = maybe_cast(key, "attention")
+    value = maybe_cast(value, "attention")
     b, sq, h, d = query.shape
     sk = key.shape[1]
     kh = key.shape[2]

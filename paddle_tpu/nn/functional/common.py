@@ -26,6 +26,9 @@ def linear(x, weight, bias=None, name=None):
     the bias add (the reference needs cuBLASLt epilogues for that —
     paddle/phi/kernels/fusion — fused_linear).
     """
+    from ...amp.auto_cast import maybe_cast
+    x = maybe_cast(x, "linear")
+    weight = maybe_cast(weight, "linear")
     y = jnp.matmul(x, weight)
     if bias is not None:
         y = y + bias

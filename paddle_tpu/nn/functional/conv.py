@@ -53,6 +53,9 @@ def _dimnums(n, channel_last):
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    from ...amp.auto_cast import maybe_cast
+    x = maybe_cast(x, f"conv{n}d")
+    weight = maybe_cast(weight, f"conv{n}d")
     channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
     dn = lax.conv_dimension_numbers(x.shape, weight.shape,
                                     _dimnums(n, channel_last))

@@ -95,12 +95,14 @@ def bind_state(layer: Layer, params: Optional[Dict[str, Any]] = None,
         yield collect
     finally:
         _write(pindex, saved_p)
-        # restore buffers, including any registered mid-trace, to concrete saves
+        # restore buffers to the pre-call snapshot; buffers registered
+        # mid-trace are REMOVED (they'd otherwise hold leaked tracers)
         _, bindex3 = _index_stores(layer)
-        for k in _read(bindex3):
+        for k, (store, name) in bindex3.items():
             if k in saved_b:
-                store, name = bindex3[k]
                 store[name] = saved_b[k]
+            else:
+                del store[name]
 
 
 def functional_call(layer: Layer, params: Dict[str, Any],

@@ -1,0 +1,1 @@
+from .registry import OpDef, register_op, get_op, all_ops, coverage  # noqa: F401

@@ -1,0 +1,340 @@
+"""Op registry entries: jax impl + numpy reference + sampler per op.
+
+The numpy references are the test oracles (reference analog: the inline
+numpy implementations inside each test/legacy_test/test_*_op.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from ..nn import functional as F
+from .registry import register_op
+
+_rng = np.random.RandomState(2024)
+
+
+def _mk(*shape, dtype=np.float32, lo=-1.0, hi=1.0):
+    return (_rng.uniform(lo, hi, size=shape)).astype(dtype)
+
+
+def _pos(*shape, dtype=np.float32):
+    return _rng.uniform(0.1, 2.0, size=shape).astype(dtype)
+
+
+def _sample(*makers, **kw):
+    def s():
+        return tuple(m() for m in makers), dict(kw)
+    return s
+
+
+# ---------------------------------------------------------------- unary math
+def _unary(name, fn, ref, sampler=None, grad=True, **kw):
+    register_op(name, fn, ref, sampler or _sample(lambda: _mk(3, 4)),
+                grad_args=(0,) if grad else (), **kw)
+
+
+_unary("abs", T.abs, np.abs)
+_unary("neg", T.neg, np.negative)
+_unary("exp", T.exp, np.exp)
+_unary("expm1", T.expm1, np.expm1)
+_unary("log", T.log, np.log, _sample(lambda: _pos(3, 4)))
+_unary("log2", T.log2, np.log2, _sample(lambda: _pos(3, 4)))
+_unary("log10", T.log10, np.log10, _sample(lambda: _pos(3, 4)))
+_unary("log1p", T.log1p, np.log1p, _sample(lambda: _pos(3, 4)))
+_unary("sqrt", T.sqrt, np.sqrt, _sample(lambda: _pos(3, 4)))
+_unary("rsqrt", T.rsqrt, lambda x: 1 / np.sqrt(x), _sample(lambda: _pos(3, 4)))
+_unary("square", T.square, np.square)
+_unary("sin", T.sin, np.sin)
+_unary("cos", T.cos, np.cos)
+_unary("tan", T.tan, np.tan)
+_unary("asin", T.asin, np.arcsin)
+_unary("acos", T.acos, np.arccos)
+_unary("atan", T.atan, np.arctan)
+_unary("sinh", T.sinh, np.sinh)
+_unary("cosh", T.cosh, np.cosh)
+_unary("tanh", T.tanh, np.tanh)
+_unary("asinh", T.asinh, np.arcsinh)
+_unary("atanh", T.atanh, np.arctanh, _sample(lambda: _mk(3, 4, lo=-0.9, hi=0.9)))
+_unary("acosh", T.acosh, np.arccosh, _sample(lambda: _mk(3, 4, lo=1.1, hi=3.0)))
+_unary("ceil", T.ceil, np.ceil, grad=False)
+_unary("floor", T.floor, np.floor, grad=False)
+_unary("round", T.round, np.round, grad=False)
+_unary("trunc", T.trunc, np.trunc, grad=False)
+_unary("frac", T.frac, lambda x: x - np.trunc(x))
+_unary("reciprocal", T.reciprocal, lambda x: 1.0 / x, _sample(lambda: _pos(3, 4)))
+_unary("sign", T.sign, np.sign, grad=False)
+_unary("erf", T.erf, None)  # no numpy erf w/o scipy: fwd-only smoke
+_unary("isnan", T.isnan, np.isnan, grad=False)
+_unary("isinf", T.isinf, np.isinf, grad=False)
+_unary("isfinite", T.isfinite, np.isfinite, grad=False)
+_unary("rad2deg", T.rad2deg, np.rad2deg, grad=False)
+_unary("deg2rad", T.deg2rad, np.deg2rad, grad=False)
+_unary("digamma", T.digamma, None, _sample(lambda: _pos(3, 4)))
+_unary("lgamma", T.lgamma, None, _sample(lambda: _pos(3, 4)))
+
+
+# --------------------------------------------------------------- binary math
+def _binary(name, fn, ref, sampler=None, grad=(0, 1), **kw):
+    register_op(name, fn, ref,
+                sampler or _sample(lambda: _mk(3, 4), lambda: _mk(3, 4)),
+                grad_args=grad, **kw)
+
+
+_binary("add", T.add, np.add)
+_binary("subtract", T.subtract, np.subtract)
+_binary("multiply", T.multiply, np.multiply)
+_binary("divide", T.divide, np.divide,
+        _sample(lambda: _mk(3, 4), lambda: _pos(3, 4)))
+_binary("pow_op", T.pow, np.power,
+        _sample(lambda: _pos(3, 4), lambda: _mk(3, 4, lo=0.5, hi=2.0)))
+_binary("maximum", T.maximum, np.maximum)
+_binary("minimum", T.minimum, np.minimum)
+_binary("fmax", T.fmax, np.fmax)
+_binary("fmin", T.fmin, np.fmin)
+_binary("atan2", T.atan2, np.arctan2)
+_binary("mod", T.mod, np.mod, _sample(lambda: _mk(3, 4), lambda: _pos(3, 4)),
+        grad=())
+_binary("floor_divide", T.floor_divide, np.floor_divide,
+        _sample(lambda: _pos(3, 4), lambda: _pos(3, 4)), grad=())
+_binary("heaviside", T.heaviside, np.heaviside, grad=())
+_binary("logaddexp", T.logaddexp, np.logaddexp)
+_binary("hypot", T.hypot, np.hypot)
+_binary("copysign", T.copysign, np.copysign, grad=())
+_binary("outer", T.outer, np.outer, _sample(lambda: _mk(3), lambda: _mk(4)))
+_binary("kron", T.kron, np.kron, _sample(lambda: _mk(2, 2), lambda: _mk(3, 3)))
+
+# broadcast variants
+_binary("add_bcast", T.add, np.add, _sample(lambda: _mk(3, 1, 4), lambda: _mk(2, 4)))
+_binary("mul_bcast", T.multiply, np.multiply,
+        _sample(lambda: _mk(5, 1), lambda: _mk(1, 6)))
+
+
+# ------------------------------------------------------------------- matmul
+register_op("matmul", T.matmul, np.matmul,
+            _sample(lambda: _mk(4, 5), lambda: _mk(5, 3)), grad_args=(0, 1),
+            dtypes=("float32", "bfloat16"), rtol=1e-4, atol=1e-5)
+register_op("matmul_batched", T.matmul, np.matmul,
+            _sample(lambda: _mk(2, 4, 5), lambda: _mk(2, 5, 3)),
+            grad_args=(0, 1), rtol=1e-4, atol=1e-5)
+register_op("matmul_tt", lambda x, y: T.matmul(x, y, True, True),
+            lambda x, y: np.matmul(x.swapaxes(-1, -2), y.swapaxes(-1, -2)),
+            _sample(lambda: _mk(5, 4), lambda: _mk(3, 5)), grad_args=(0, 1),
+            rtol=1e-4, atol=1e-5)
+register_op("bmm", T.bmm, np.matmul,
+            _sample(lambda: _mk(2, 3, 4), lambda: _mk(2, 4, 5)),
+            grad_args=(0, 1), rtol=1e-4, atol=1e-5)
+register_op("einsum_ij", lambda x, y: T.einsum("ij,jk->ik", x, y),
+            lambda x, y: x @ y, _sample(lambda: _mk(3, 4), lambda: _mk(4, 5)),
+            grad_args=(0, 1), rtol=1e-4, atol=1e-5)
+register_op("addmm", T.addmm,
+            lambda i, x, y: i + x @ y,
+            _sample(lambda: _mk(3, 5), lambda: _mk(3, 4), lambda: _mk(4, 5)),
+            grad_args=(0, 1, 2), rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------- reductions
+def _reduction(name, fn, ref, **kw):
+    register_op(name, fn, ref, _sample(lambda: _mk(3, 4, 5)), grad_args=(0,), **kw)
+
+
+_reduction("sum", T.sum, lambda x: np.sum(x))
+_reduction("mean", T.mean, lambda x: np.mean(x))
+register_op("max_red", T.max, lambda x: np.max(x), _sample(lambda: _mk(3, 4, 5)))
+register_op("min_red", T.min, lambda x: np.min(x), _sample(lambda: _mk(3, 4, 5)))
+_reduction("prod", T.prod, lambda x: np.prod(x), grad_rtol=1e-1)
+_reduction("logsumexp", T.logsumexp,
+           lambda x: np.log(np.sum(np.exp(x))))
+register_op("sum_axis", lambda x: T.sum(x, axis=1),
+            lambda x: np.sum(x, axis=1), _sample(lambda: _mk(3, 4, 5)),
+            grad_args=(0,))
+register_op("mean_keepdim", lambda x: T.mean(x, axis=[0, 2], keepdim=True),
+            lambda x: np.mean(x, axis=(0, 2), keepdims=True),
+            _sample(lambda: _mk(3, 4, 5)), grad_args=(0,))
+register_op("cumsum", T.cumsum, None, _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("cumsum_axis", lambda x: T.cumsum(x, axis=1),
+            lambda x: np.cumsum(x, axis=1), _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("cumprod", lambda x: T.cumprod(x, dim=1),
+            lambda x: np.cumprod(x, axis=1), _sample(lambda: _pos(3, 4)),
+            grad_args=(0,), grad_rtol=1e-1)
+register_op("std", T.std, lambda x: np.std(x, ddof=1),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("var", T.var, lambda x: np.var(x, ddof=1),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("median", T.median, np.median, _sample(lambda: _mk(3, 5)))
+register_op("count_nonzero", T.count_nonzero,
+            lambda x: np.count_nonzero(x), _sample(lambda: _mk(3, 4)))
+
+
+# ------------------------------------------------------------- manipulation
+register_op("reshape", lambda x: T.reshape(x, [2, 6]),
+            lambda x: x.reshape(2, 6), _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("transpose", lambda x: T.transpose(x, [1, 0, 2]),
+            lambda x: x.transpose(1, 0, 2), _sample(lambda: _mk(2, 3, 4)),
+            grad_args=(0,))
+register_op("concat", lambda x, y: T.concat([x, y], axis=1),
+            lambda x, y: np.concatenate([x, y], 1),
+            _sample(lambda: _mk(2, 3), lambda: _mk(2, 4)), grad_args=(0, 1))
+register_op("stack", lambda x, y: T.stack([x, y], axis=0),
+            lambda x, y: np.stack([x, y], 0),
+            _sample(lambda: _mk(2, 3), lambda: _mk(2, 3)), grad_args=(0, 1))
+register_op("split_0", lambda x: T.split(x, 2, axis=1)[0],
+            lambda x: np.split(x, 2, axis=1)[0], _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("split_sections", lambda x: T.split(x, [1, -1], axis=1)[1],
+            lambda x: x[:, 1:], _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("squeeze", lambda x: T.squeeze(x, axis=1),
+            lambda x: np.squeeze(x, 1), _sample(lambda: _mk(3, 1, 4)),
+            grad_args=(0,))
+register_op("unsqueeze", lambda x: T.unsqueeze(x, [0, 2]),
+            lambda x: np.expand_dims(np.expand_dims(x, 0), 2),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("tile", lambda x: T.tile(x, [2, 3]), lambda x: np.tile(x, (2, 3)),
+            _sample(lambda: _mk(2, 2)), grad_args=(0,))
+register_op("expand", lambda x: T.expand(x, [3, 2, 4]),
+            lambda x: np.broadcast_to(x, (3, 2, 4)),
+            _sample(lambda: _mk(2, 4)), grad_args=(0,))
+register_op("flip", lambda x: T.flip(x, axis=[0, 1]),
+            lambda x: np.flip(x, (0, 1)), _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("roll", lambda x: T.roll(x, 2, axis=1),
+            lambda x: np.roll(x, 2, axis=1), _sample(lambda: _mk(3, 5)),
+            grad_args=(0,))
+register_op("flatten_op", lambda x: T.flatten(x, 1, 2),
+            lambda x: x.reshape(x.shape[0], -1, x.shape[3]),
+            _sample(lambda: _mk(2, 3, 4, 5)), grad_args=(0,))
+register_op("tril", T.tril, np.tril, _sample(lambda: _mk(4, 4)), grad_args=(0,))
+register_op("triu", T.triu, np.triu, _sample(lambda: _mk(4, 4)), grad_args=(0,))
+register_op("gather", lambda x: T.gather(x, __import__("jax.numpy", fromlist=["asarray"]).asarray([0, 2]), axis=0),
+            lambda x: x[[0, 2]], _sample(lambda: _mk(4, 3)), grad_args=(0,))
+register_op("index_select", lambda x: T.index_select(x, __import__("jax.numpy", fromlist=["asarray"]).asarray([1, 1, 0]), axis=1),
+            lambda x: x[:, [1, 1, 0]], _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("pad_constant", lambda x: F.pad(x, [1, 2, 0, 1], value=0.5),
+            lambda x: np.pad(x, [(0, 0), (0, 0), (0, 1), (1, 2)],
+                             constant_values=0.5),
+            _sample(lambda: _mk(1, 1, 3, 4)), grad_args=(0,))
+register_op("masked_fill", lambda x: T.masked_fill(x, x > 0, 0.0),
+            lambda x: np.where(x > 0, 0.0, x), _sample(lambda: _mk(3, 4)))
+register_op("where_op", lambda c, x, y: T.where(c, x, y),
+            lambda c, x, y: np.where(c, x, y),
+            _sample(lambda: _mk(3, 4) > 0, lambda: _mk(3, 4), lambda: _mk(3, 4)),
+            grad_args=(1, 2))
+register_op("take_along_axis", lambda x: T.take_along_axis(
+                x, __import__("jax.numpy", fromlist=["argsort"]).argsort(x, axis=1), 1),
+            lambda x: np.take_along_axis(x, np.argsort(x, 1), 1),
+            _sample(lambda: _mk(3, 4)))
+
+
+# ------------------------------------------------------------------ linalg
+register_op("norm_fro", T.norm, lambda x: np.linalg.norm(x),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("det", T.det, np.linalg.det,
+            _sample(lambda: _mk(3, 3) + 2 * np.eye(3, dtype=np.float32)),
+            grad_args=(0,), grad_rtol=1e-1)
+register_op("inv", T.inv, np.linalg.inv,
+            _sample(lambda: _mk(3, 3) + 2 * np.eye(3, dtype=np.float32)),
+            grad_args=(0,), grad_rtol=1e-1)
+register_op("solve", T.solve, np.linalg.solve,
+            _sample(lambda: _mk(3, 3) + 2 * np.eye(3, dtype=np.float32),
+                    lambda: _mk(3, 2)), grad_args=(0, 1), grad_rtol=1e-1)
+register_op("cholesky", T.cholesky,
+            lambda x: np.linalg.cholesky(x),
+            _sample(lambda: (lambda a: (a @ a.T + 3 * np.eye(3)).astype(np.float32))(_mk(3, 3))),
+            grad_args=(0,), grad_rtol=2e-1)
+register_op("trace_op", T.trace, np.trace, _sample(lambda: _mk(4, 4)),
+            grad_args=(0,))
+register_op("slogdet", lambda x: T.slogdet(x)[1],
+            lambda x: np.linalg.slogdet(x)[1],
+            _sample(lambda: _mk(3, 3) + 2 * np.eye(3, dtype=np.float32)))
+
+
+# ------------------------------------------------------------------ search
+register_op("argmax", lambda x: T.argmax(x, axis=1),
+            lambda x: np.argmax(x, 1), _sample(lambda: _mk(3, 5)))
+register_op("argmin", lambda x: T.argmin(x, axis=-1),
+            lambda x: np.argmin(x, -1), _sample(lambda: _mk(3, 5)))
+register_op("argsort", lambda x: T.argsort(x, axis=1),
+            lambda x: np.argsort(x, 1, kind="stable"), _sample(lambda: _mk(3, 5)))
+register_op("sort_vals", lambda x: T.sort(x, axis=1),
+            lambda x: np.sort(x, 1), _sample(lambda: _mk(3, 5)), grad_args=(0,))
+register_op("topk_vals", lambda x: T.topk(x, 3, axis=-1)[0],
+            lambda x: -np.sort(-x, -1)[..., :3], _sample(lambda: _mk(3, 8)))
+register_op("searchsorted", lambda s, v: T.searchsorted(s, v),
+            lambda s, v: np.searchsorted(s, v),
+            _sample(lambda: np.sort(_mk(8)), lambda: _mk(5)))
+register_op("kthvalue", lambda x: T.kthvalue(x, 2, axis=1)[0],
+            lambda x: np.sort(x, 1)[:, 1], _sample(lambda: _mk(3, 5)))
+
+
+# ------------------------------------------------------------------- logic
+register_op("equal", T.equal, np.equal,
+            _sample(lambda: _mk(3, 4), lambda: _mk(3, 4)))
+register_op("less_than", T.less_than, np.less,
+            _sample(lambda: _mk(3, 4), lambda: _mk(3, 4)))
+register_op("logical_and", T.logical_and, np.logical_and,
+            _sample(lambda: _mk(3, 4) > 0, lambda: _mk(3, 4) > 0))
+register_op("allclose_op", T.allclose, np.allclose,
+            _sample(lambda: _mk(3, 4), lambda: _mk(3, 4)))
+register_op("isin", T.isin, np.isin,
+            _sample(lambda: _rng.randint(0, 5, (4, 4)),
+                    lambda: _rng.randint(0, 5, (3,))))
+
+
+# -------------------------------------------------------------- activations
+def _act(name, fn, ref, sampler=None, **kw):
+    register_op("act_" + name, fn, ref, sampler or _sample(lambda: _mk(3, 4)),
+                grad_args=(0,), **kw)
+
+
+_act("relu", F.relu, lambda x: np.maximum(x, 0))
+_act("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)))
+_act("silu", F.silu, lambda x: x / (1 + np.exp(-x)))
+_act("softplus", F.softplus, lambda x: np.log1p(np.exp(x)))
+_act("softsign", F.softsign, lambda x: x / (1 + np.abs(x)))
+_act("hardswish", F.hardswish, lambda x: x * np.clip(x + 3, 0, 6) / 6)
+_act("hardsigmoid", F.hardsigmoid, lambda x: np.clip(x / 6 + 0.5, 0, 1))
+_act("leaky_relu", F.leaky_relu, lambda x: np.where(x > 0, x, 0.01 * x))
+_act("elu", F.elu, lambda x: np.where(x > 0, x, np.expm1(x)))
+_act("relu6", F.relu6, lambda x: np.clip(x, 0, 6))
+_act("mish", F.mish, lambda x: x * np.tanh(np.log1p(np.exp(x))))
+_act("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x))
+_act("hardshrink", F.hardshrink, lambda x: np.where(np.abs(x) > 0.5, x, 0))
+_act("softmax", F.softmax,
+     lambda x: np.exp(x - x.max(-1, keepdims=True)) /
+     np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))
+_act("log_softmax", F.log_softmax,
+     lambda x: x - x.max(-1, keepdims=True) -
+     np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)))
+_act("glu", F.glu, lambda x: np.split(x, 2, -1)[0] *
+     (1 / (1 + np.exp(-np.split(x, 2, -1)[1]))))
+
+
+# ------------------------------------------------------------------ nn core
+register_op("linear", F.linear, lambda x, w, b: x @ w + b,
+            _sample(lambda: _mk(4, 6), lambda: _mk(6, 3), lambda: _mk(3)),
+            grad_args=(0, 1, 2), rtol=1e-4, atol=1e-5)
+register_op("layer_norm", lambda x, w, b: F.layer_norm(x, x.shape[-1], w, b),
+            lambda x, w, b: (x - x.mean(-1, keepdims=True)) /
+            np.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b,
+            _sample(lambda: _mk(4, 8), lambda: _pos(8), lambda: _mk(8)),
+            grad_args=(0, 1, 2), rtol=1e-4, atol=1e-5)
+register_op("rms_norm", lambda x, w: F.rms_norm(x, w),
+            lambda x, w: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w,
+            _sample(lambda: _mk(4, 8), lambda: _pos(8)), grad_args=(0, 1),
+            rtol=1e-4, atol=1e-5)
+register_op("embedding", lambda w: F.embedding(
+                __import__("jax.numpy", fromlist=["asarray"]).asarray([[0, 2], [1, 1]]), w),
+            lambda w: w[np.array([[0, 2], [1, 1]])],
+            _sample(lambda: _mk(5, 3)), grad_args=(0,))
+register_op("cross_entropy_op",
+            lambda x: F.cross_entropy(
+                x, __import__("jax.numpy", fromlist=["asarray"]).asarray([0, 1, 2])),
+            lambda x: -np.log(
+                (np.exp(x - x.max(-1, keepdims=True)) /
+                 np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))
+                [np.arange(3), [0, 1, 2]]).mean(),
+            _sample(lambda: _mk(3, 5)), grad_args=(0,), rtol=1e-4)

@@ -1,0 +1,72 @@
+"""Declarative op registry.
+
+Reference idea (SURVEY.md §1): the op surface is YAML-defined
+(paddle/phi/api/yaml/ops.yaml + backward.yaml) and code-generated into many
+surfaces (C++ API, eager fns, pybind, static ops, SPMD rules).  Here the
+registry is Python-declarative (dataclass entries instead of YAML — same
+single-source idea, no codegen step needed because Python IS the binding
+surface) and drives:
+
+  * the OpTest-equivalent numeric harness (tests/op_test.py) — every entry
+    gets jax-vs-numpy forward checks and numeric-vs-autodiff grad checks
+    across dtypes, like test/legacy_test/op_test.py — OpTest;
+  * introspection for docs/coverage (``paddle_tpu.ops.coverage()``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["OpDef", "register_op", "get_op", "all_ops", "coverage"]
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable                      # the jax implementation
+    ref: Optional[Callable] = None    # numpy reference; None -> fwd-only vs itself
+    sample: Optional[Callable] = None  # () -> (args, kwargs) with numpy arrays
+    grad_args: Tuple[int, ...] = ()   # positional indices to grad-check
+    dtypes: Tuple[str, ...] = ("float32",)
+    # this environment's CPU libm/matmul deviate ~4e-5 from numpy; the
+    # reference's fp32 OpTest default is 1e-5 relative on CUDA
+    rtol: float = 2e-4
+    atol: float = 1e-5
+    grad_rtol: float = 5e-2
+    grad_atol: float = 5e-3
+    skip_dtypes_grad: Tuple[str, ...] = ("float16", "bfloat16")
+    tags: Tuple[str, ...] = ()
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Callable, ref: Optional[Callable] = None,
+                sample: Optional[Callable] = None,
+                grad_args: Sequence[int] = (), **kw) -> OpDef:
+    if name in _REGISTRY:
+        raise ValueError(f"op {name!r} already registered")
+    od = OpDef(name=name, fn=fn, ref=ref, sample=sample,
+               grad_args=tuple(grad_args), **kw)
+    _REGISTRY[name] = od
+    return od
+
+
+def get_op(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def all_ops() -> List[OpDef]:
+    from . import defs  # noqa: F401  (populate on first access)
+    return list(_REGISTRY.values())
+
+
+def coverage() -> Dict[str, Any]:
+    ops = all_ops()
+    return {
+        "n_ops": len(ops),
+        "with_ref": sum(1 for o in ops if o.ref is not None),
+        "with_grad": sum(1 for o in ops if o.grad_args),
+        "names": sorted(o.name for o in ops),
+    }

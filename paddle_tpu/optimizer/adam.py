@@ -84,9 +84,13 @@ class AdamW(Adam):
                     p_old = params[k]
                     master = state["master"][k] if isinstance(state["master"], dict) else None
                     base = master if master is not None else p_old
-                    new_params[k] = (new_params[k].astype(jnp.float32) -
-                                     lr * coef * base.astype(jnp.float32)
-                                     ).astype(p_old.dtype)
+                    decayed32 = (new_params[k].astype(jnp.float32) -
+                                 lr * coef * base.astype(jnp.float32))
+                    new_params[k] = decayed32.astype(p_old.dtype)
+                    # decay must persist in the fp32 master, else the next
+                    # step recomputes from the undecayed copy
+                    if master is not None:
+                        new_state["master"][k] = decayed32
         return new_params, new_state
 
 

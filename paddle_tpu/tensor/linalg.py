@@ -163,23 +163,17 @@ def dist(x, y, p=2, name=None):
 
 
 def householder_product(x, tau, name=None):
-    m, n = x.shape[-2], x.shape[-1]
-    eye = jnp.eye(m, dtype=x.dtype)
-    q = jnp.broadcast_to(eye, x.shape[:-2] + (m, m)).copy() if x.ndim > 2 else eye
-
-    def apply_one(q, i):
-        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i])
-        v = v.at[..., i].set(1.0) if v.ndim == 1 else v
-        h = jnp.eye(m, dtype=x.dtype) - tau[..., i] * jnp.outer(v, v)
-        return q @ h, None
-
+    if x.ndim != 2:
+        # batched inputs would need per-batch v/tau indexing; vmap the 2-D case
+        return jax.vmap(householder_product)(x, tau)
+    m, n = x.shape
+    q = jnp.eye(m, dtype=x.dtype)
     for i in range(n):
-        v = x[..., :, i]
-        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[:, i])
         v = v.at[i].set(1.0)
         h = jnp.eye(m, dtype=x.dtype) - tau[i] * jnp.outer(v, v)
         q = q @ h
-    return q[..., :, :n]
+    return q[:, :n]
 
 
 def corrcoef(x, rowvar=True, name=None):

@@ -80,8 +80,9 @@ def unsqueeze(x, axis, name=None):
     if isinstance(axis, int):
         axis = (axis,)
     out = x
-    for a in sorted(a % (out.ndim + 1) for a in axis):
-        out = jnp.expand_dims(out, a)
+    # paddle applies axes sequentially against the growing rank
+    for a in axis:
+        out = jnp.expand_dims(out, a % (out.ndim + 1))
     return out
 
 
@@ -265,10 +266,7 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
     if reduce == "assign":
         return jnp.put_along_axis(arr, idx, values, axis=axis, inplace=False)
     if reduce in ("add", "sum"):
-        moved = jnp.moveaxis(arr, axis, -1)
-        return arr.at[tuple(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
-                                         indexing="ij")[:axis]) + (idx,)].add(values) \
-            if False else _put_add(arr, idx, values, axis)
+        return _put_add(arr, idx, values, axis)
     if reduce in ("mul", "multiply"):
         return _put_mul(arr, idx, values, axis)
     raise ValueError(reduce)

@@ -22,8 +22,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
-    out = jnp.argsort(x, axis=axis, stable=stable or True,
-                      descending=descending)
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
     return out.astype(jnp.int64)
 
 

@@ -1,0 +1,171 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+Cifar10/100, FashionMNIST, Flowers, ImageFolder/DatasetFolder).
+
+Offline environment: download-backed datasets raise with guidance; local
+folder/array-backed datasets work fully.  FakeData mirrors torchvision's for
+benchmarks/tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "FakeData", "MNIST", "Cifar10"]
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset (deterministic per index)."""
+
+    def __init__(self, size: int = 1000, image_shape=(3, 224, 224),
+                 num_classes: int = 1000, transform: Optional[Callable] = None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = rng.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+def _find_classes(root):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    return classes, {c: i for i, c in enumerate(classes)}
+
+
+def _load_image(path):
+    """npy/npz or PIL-readable images (PIL optional)."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(f"cannot load {path}: PIL unavailable; use .npy") from e
+
+
+class DatasetFolder(Dataset):
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or self.IMG_EXTENSIONS
+        self.classes, self.class_to_idx = _find_classes(root)
+        self.samples = []
+        for cls in self.classes:
+            d = os.path.join(root, cls)
+            for fname in sorted(os.listdir(d)):
+                path = os.path.join(d, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[cls]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabeled flat folder of images."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or self.IMG_EXTENSIONS
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(extensions))]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+class _ArchiveBacked(Dataset):
+    _NAME = "dataset"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if image_path is None or not os.path.exists(image_path):
+            raise RuntimeError(
+                f"{self._NAME}: no network access in this environment — "
+                f"provide image_path/label_path to local files")
+
+
+class MNIST(_ArchiveBacked):
+    """Local-file MNIST (idx format) or guidance error when absent."""
+
+    _NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        super().__init__(image_path, label_path, mode, transform, download)
+        with open(image_path, "rb") as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], "big")
+        self.images = np.frombuffer(data, np.uint8, offset=16).reshape(n, 28, 28)
+        with open(label_path, "rb") as f:
+            ldata = f.read()
+        self.labels = np.frombuffer(ldata, np.uint8, offset=8)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class Cifar10(_ArchiveBacked):
+    _NAME = "Cifar10"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, data_file, mode, transform, download)
+        import pickle
+        with open(data_file, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = d[b"data"].reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"labels"])
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])

@@ -1,0 +1,2 @@
+from .resnet import *  # noqa: F401,F403
+from .simple import *  # noqa: F401,F403

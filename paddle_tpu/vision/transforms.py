@@ -1,0 +1,257 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/ —
+transforms.py, functional.py).  Host-side numpy ops on HWC uint8/float
+images; Compose pipelines feed the DataLoader.  TPU note: heavy per-sample
+preprocessing stays on host CPU by design — the device sees batched,
+normalized arrays.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop",
+           "crop", "pad"]
+
+
+def _is_chw(img: np.ndarray) -> bool:
+    return img.ndim == 3 and img.shape[0] in (1, 3, 4) and img.shape[0] < img.shape[2]
+
+
+def resize(img: np.ndarray, size, interpolation="bilinear") -> np.ndarray:
+    """HWC resize via numpy (nearest / bilinear)."""
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    oh, ow = size
+    h, w = img.shape[:2]
+    if interpolation == "nearest":
+        ri = (np.arange(oh) * h / oh).astype(np.int32)
+        ci = (np.arange(ow) * w / ow).astype(np.int32)
+        return img[ri][:, ci]
+    # bilinear
+    ry = (np.arange(oh) + 0.5) * h / oh - 0.5
+    rx = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ry).astype(np.int32), 0, h - 1)
+    x0 = np.clip(np.floor(rx).astype(np.int32), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ry - y0, 0, 1)[:, None, None] if img.ndim == 3 else np.clip(ry - y0, 0, 1)[:, None]
+    wx = np.clip(rx - x0, 0, 1)[None, :, None] if img.ndim == 3 else np.clip(rx - x0, 0, 1)[None, :]
+    f = img.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if img.dtype == np.float32 else \
+        np.clip(out, 0, 255).astype(img.dtype)
+
+
+def hflip(img):
+    return img[:, ::-1].copy()
+
+
+def vflip(img):
+    return img[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    l, t, r, b = padding if len(padding) == 4 else (padding[0], padding[1],
+                                                   padding[0], padding[1])
+    cfg = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, cfg, constant_values=fill)
+    return np.pad(img, cfg, mode={"edge": "edge", "reflect": "reflect",
+                                  "symmetric": "symmetric"}[padding_mode])
+
+
+def to_tensor(img, data_format="CHW") -> np.ndarray:
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    if data_format == "CHW" and not _is_chw(arr):
+        arr = arr.transpose(2, 0, 1)
+    return np.ascontiguousarray(arr, dtype=np.float32)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+class _Transform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(_Transform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(_Transform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(_Transform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(_Transform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(_Transform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, max(th - h, 0), 0, max(tw - w, 0)), self.fill,
+                      self.padding_mode)
+            h, w = img.shape[:2]
+        top = pyrandom.randint(0, h - th)
+        left = pyrandom.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(_Transform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = np.exp(pyrandom.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                top = pyrandom.randint(0, h - ch)
+                left = pyrandom.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(_Transform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if pyrandom.random() < self.prob else img
+
+
+class RandomVerticalFlip(_Transform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if pyrandom.random() < self.prob else img
+
+
+class Transpose(_Transform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.ascontiguousarray(np.transpose(img, self.order))
+
+
+class BrightnessTransform(_Transform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + pyrandom.uniform(-self.value, self.value)
+        dtype = img.dtype
+        out = img.astype(np.float32) * alpha
+        if dtype == np.uint8:
+            out = np.clip(out, 0, 255)
+        return out.astype(dtype)
+
+
+class Pad(_Transform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)

@@ -1,0 +1,111 @@
+"""OpTest harness — the framework's numeric oracle.
+
+Reference: test/legacy_test/op_test.py — OpTest: each op declares
+inputs/attrs + a numpy reference; check_output() compares across
+places/dtypes; check_grad() does numeric gradient checking against the
+registered grad kernel (SURVEY.md §4 "the single most important thing to
+replicate").
+
+Ours: check_output = jax impl vs numpy ref per dtype (with per-dtype
+tolerance scaling, like the reference's fp16/bf16 tables); check_grad =
+central-difference numeric gradient vs jax.grad on a scalarized output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import OpDef
+
+_DTYPE_TOL = {
+    "float32": (1.0, 1.0),
+    "float64": (1.0, 1.0),
+    "float16": (300.0, 300.0),
+    "bfloat16": (2000.0, 2000.0),
+}
+
+
+def _cast_sample(args, dtype):
+    out = []
+    for a in args:
+        if isinstance(a, np.ndarray) and a.dtype in (np.float32, np.float64):
+            out.append(a.astype(dtype))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def check_output(op: OpDef):
+    args, kwargs = op.sample()
+    for dtype in op.dtypes:
+        f_r, f_a = _DTYPE_TOL.get(dtype, (1.0, 1.0))
+        cargs = _cast_sample(args, np.float32 if dtype in ("float16", "bfloat16")
+                             else dtype)
+        jargs = tuple(jnp.asarray(a).astype(dtype) if isinstance(a, np.ndarray)
+                      and np.issubdtype(a.dtype, np.floating) else
+                      (jnp.asarray(a) if isinstance(a, np.ndarray) else a)
+                      for a in cargs)
+        out = op.fn(*jargs, **kwargs)
+        if op.ref is None:
+            # smoke: finite & shaped
+            for leaf in jax.tree.leaves(out):
+                assert np.all(np.isfinite(np.asarray(leaf, dtype=np.float32))), \
+                    f"{op.name}: non-finite output"
+            continue
+        ref = op.ref(*cargs, **kwargs)
+        out_np = np.asarray(out).astype(np.float32) if hasattr(out, "dtype") else out
+        ref_np = np.asarray(ref, dtype=out_np.dtype if hasattr(out_np, "dtype") else None)
+        np.testing.assert_allclose(
+            out_np, ref_np.astype(np.float32) if hasattr(ref_np, "dtype") and
+            np.issubdtype(ref_np.dtype, np.floating) else ref_np,
+            rtol=op.rtol * f_r, atol=op.atol * f_a,
+            err_msg=f"op {op.name} dtype {dtype}")
+
+
+def check_grad(op: OpDef, eps: float = 1e-3):
+    """Numeric central-difference vs autodiff, on sum(out * cotangent)."""
+    if not op.grad_args:
+        return
+    args, kwargs = op.sample()
+    jargs = [jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args]
+    out0 = op.fn(*jargs, **kwargs)
+    cot = np.random.RandomState(7).uniform(0.5, 1.5,
+                                           np.shape(out0)).astype(np.float32)
+
+    def scalar_fn(*gargs):
+        full = list(jargs)
+        for slot, val in zip(op.grad_args, gargs):
+            full[slot] = val
+        out = op.fn(*full, **kwargs)
+        return jnp.sum(out * jnp.asarray(cot))
+
+    grad_inputs = tuple(jargs[i] for i in op.grad_args)
+    auto = jax.jit(jax.grad(scalar_fn, argnums=tuple(range(len(grad_inputs)))))(
+        *grad_inputs)
+
+    for slot_idx, (slot, g_auto) in enumerate(zip(op.grad_args, auto)):
+        base = np.asarray(args[slot], dtype=np.float32)
+        n = base.size
+        # vectorized central differences: two vmapped evals over N perturbed
+        # copies each (element-wise host loops like the reference OpTest are
+        # too slow on this CPU backend)
+        eye = (np.eye(n, dtype=np.float32) * eps).reshape((n,) + base.shape)
+        plus = base[None] + eye
+        minus = base[None] - eye
+
+        def eval_slot(x):
+            vals = list(grad_inputs)
+            vals[slot_idx] = x
+            return scalar_fn(*vals)
+
+        batched = jax.jit(jax.vmap(eval_slot))
+        f_plus = batched(jnp.asarray(plus))
+        f_minus = batched(jnp.asarray(minus))
+        g_num = (np.asarray(f_plus, np.float64) -
+                 np.asarray(f_minus, np.float64)).reshape(base.shape) / (2 * eps)
+        np.testing.assert_allclose(
+            np.asarray(g_auto, dtype=np.float64), g_num,
+            rtol=op.grad_rtol, atol=op.grad_atol,
+            err_msg=f"op {op.name} grad arg {slot}")

@@ -1,0 +1,166 @@
+"""ResNet / DataLoader / hapi.Model tests (BASELINE config #1 path;
+reference analogs: test/legacy_test/test_resnet*.py, test_dataloader*.py,
+test/legacy_test/test_model.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (DataLoader, TensorDataset, DistributedBatchSampler,
+                           BatchSampler)
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import FakeData
+
+
+def test_resnet18_forward_shapes():
+    m = models.resnet18(num_classes=10)
+    m.eval()
+    x = jnp.asarray(np.random.randn(2, 3, 64, 64).astype(np.float32))
+    out = m(x)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_structure():
+    m = models.resnet50(num_classes=10)
+    names = [n for n, _ in m.named_parameters()]
+    # bottleneck structure: layer1.0 has conv1/2/3 + downsample
+    assert "layer1.0.conv3.weight" in names
+    assert "layer1.0.downsample.0.weight" in names
+    assert m.fc.weight.shape == (2048, 10)
+    n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+    # reference resnet50 (1000 classes) has 25.6M; with 10 classes ~23.5M
+    assert 23e6 < n_params < 24.2e6
+
+
+def test_resnet_trains():
+    m = models.resnet18(num_classes=4)
+    from paddle_tpu.nn import functional_call, state
+    import paddle_tpu.optimizer as opt
+    params, buffers = state(m)
+    o = opt.Momentum(learning_rate=0.05, momentum=0.9)
+    os_ = o.init(params)
+    x = jnp.asarray(np.random.randn(8, 3, 32, 32).astype(np.float32))
+    y = jnp.asarray(np.arange(8) % 4)
+
+    @jax.jit
+    def step(p, b, s):
+        def loss_fn(p):
+            out, nb = functional_call(m, p, b, (x,), train=True)
+            return nn.functional.cross_entropy(out, y), nb
+        (l, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        np_, ns = o.update(g, s, p)
+        return np_, nb, ns, l
+
+    losses = []
+    for _ in range(8):
+        params, buffers, os_, l = step(params, buffers, os_)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+    # batchnorm stats moved
+    assert float(jnp.abs(buffers["bn1._mean"]).sum()) > 0
+
+
+def test_dataloader_single_process():
+    xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ys = np.arange(10, dtype=np.int64)
+    ds = TensorDataset([xs, ys])
+    loader = DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[2][0].shape == (2, 2)
+    np.testing.assert_array_equal(batches[0][1], [0, 1, 2, 3])
+
+
+def test_dataloader_shuffle_epochwise():
+    ds = TensorDataset([np.arange(16, dtype=np.float32)])
+    loader = DataLoader(ds, batch_size=16, shuffle=True)
+    a = next(iter(loader))[0]
+    assert sorted(a.tolist()) == list(range(16))
+
+
+def test_dataloader_multiprocess():
+    xs = np.arange(40, dtype=np.float32).reshape(20, 2)
+    ys = np.arange(20, dtype=np.int64)
+    ds = TensorDataset([xs, ys])
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 5
+    got = np.concatenate([b[1] for b in batches])
+    np.testing.assert_array_equal(got, np.arange(20))
+
+
+def test_distributed_batch_sampler_shards():
+    ds = TensorDataset([np.arange(10, dtype=np.float32)])
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=rank)
+        idxs = [i for b in s for i in b]
+        assert len(idxs) == 3  # ceil(10/4) padded
+        seen.extend(idxs)
+    # union covers the dataset (padding duplicates allowed)
+    assert set(range(10)).issubset(set(seen))
+    # same number of batches per rank
+    assert len(DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)) == \
+        len(DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=3))
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(16),
+        transforms.CenterCrop(12),
+        transforms.ToTensor(),
+        transforms.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.rand(24, 32, 3) * 255).astype(np.uint8)
+    out = t(img)
+    assert out.shape == (3, 12, 12)
+    assert out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(16, 32), nn.ReLU(),
+                        nn.Linear(32, 4))
+    model = paddle_tpu.Model(net)
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.metric import Accuracy
+    model.prepare(opt.Adam(learning_rate=0.01),
+                  nn.CrossEntropyLoss(), Accuracy())
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 4, 4).astype(np.float32)
+    ys = (xs.reshape(64, -1).sum(-1) > 0).astype(np.int64)
+    ds = TensorDataset([xs, ys])
+
+    model.fit(ds, epochs=3, batch_size=16, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.7
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 4)
+
+    # save/load roundtrip
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    net2 = nn.Sequential(nn.Flatten(), nn.Linear(16, 32), nn.ReLU(),
+                         nn.Linear(32, 4))
+    model2 = paddle_tpu.Model(net2)
+    model2.prepare(opt.Adam(learning_rate=0.01), nn.CrossEntropyLoss(),
+                   Accuracy())
+    model2.load(path)
+    logs2 = model2.evaluate(ds, batch_size=16, verbose=0)
+    np.testing.assert_allclose(logs2["loss"], logs["loss"], rtol=1e-4)
+
+
+def test_fake_data_with_transform():
+    ds = FakeData(size=8, image_shape=(3, 8, 8), num_classes=5)
+    img, label = ds[3]
+    assert img.shape == (3, 8, 8)
+    assert 0 <= int(label) < 5
+    # deterministic per index
+    img2, label2 = ds[3]
+    np.testing.assert_array_equal(img, img2)
