@@ -20,6 +20,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
+# The axon sitecustomize eagerly initializes the single-chip TPU backend at
+# interpreter startup, before this conftest runs, so the env vars above are
+# too late.  Reset to an 8-device virtual CPU mesh (SURVEY.md §4: all
+# distributed tests run single-host on virtual devices).
+if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+    import jax.extend.backend as _jeb
+    _jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu"
+
 # this environment's CPU backend defaults to low-precision matmul; tests
 # compare against float64/float32 numpy references
 jax.config.update("jax_default_matmul_precision", "highest")
