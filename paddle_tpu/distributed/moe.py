@@ -1,0 +1,408 @@
+"""Mixture-of-Experts / expert parallelism.
+
+Reference surface (SURVEY.md §2.3 EP row):
+  - python/paddle/incubate/distributed/models/moe/moe_layer.py — MoELayer
+  - .../moe/gate/{naive,gshard,switch}_gate.py — NaiveGate, GShardGate,
+    SwitchGate
+  - routing device kernels: number_count, limit_by_capacity,
+    prune_gate_by_capacity, assign_pos (paddle/fluid/operators/*_op.cu)
+  - NCCL all-to-all ops: global_scatter / global_gather
+    (paddle/fluid/operators/collective/global_scatter_op.cu)
+
+TPU-native design: the reference routes with data-dependent shapes
+(counts -> NCCL alltoall with per-rank splits).  Under XLA everything is
+static, so we use capacity-padded GShard dispatch: one-hot dispatch /
+combine tensors of shape [tokens, experts, capacity] contracted with
+einsums.  When the expert dimension is sharded over a mesh axis (the
+"expert-parallel group"), XLA GSPMD compiles those einsums into exactly the
+all-to-all + local-expert-compute + all-to-all pattern that
+global_scatter/global_gather hand-write — riding ICI instead of NCCL.
+
+Routing helpers (number_count & co.) are provided as static-shape jnp
+functions with the reference kernels' semantics so ported gate code works.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn import initializer as I
+from .sharding_utils import set_param_spec
+from .topology import get_hybrid_communicate_group
+
+__all__ = [
+    "NaiveGate", "GShardGate", "SwitchGate", "MoELayer", "ExpertFFN",
+    "number_count", "limit_by_capacity", "prune_gate_by_capacity",
+    "assign_pos", "global_scatter", "global_gather", "default_capacity",
+]
+
+
+# --------------------------------------------------------------------------
+# Routing utils — static-shape equivalents of the reference CUDA kernels.
+# --------------------------------------------------------------------------
+
+def number_count(gate_idx, upper_range: int):
+    """Per-expert token counts.  Reference: number_count_op.cu — histogram
+    of ``gate_idx`` values in [0, upper_range)."""
+    gate_idx = jnp.asarray(gate_idx).reshape(-1)
+    # pruned tokens carry -1 (see prune_gate_by_capacity); one_hot maps
+    # out-of-range to all-zeros so they are NOT counted (bincount would
+    # clamp them into expert 0)
+    return jnp.sum(jax.nn.one_hot(gate_idx, upper_range, dtype=jnp.int32),
+                   axis=0)
+
+
+def assign_pos(gate_idx, upper_range: int):
+    """Stable positions of tokens grouped by expert.  Reference:
+    assign_pos_op.cu — returns token indices sorted by expert id (stable),
+    i.e. the permutation used to lay tokens out expert-contiguously."""
+    gate_idx = jnp.asarray(gate_idx).reshape(-1)
+    # stable argsort by expert id keeps intra-expert token order
+    return jnp.argsort(gate_idx, stable=True)
+
+
+def limit_by_capacity(expert_count, capacity, n_worker: int = 1):
+    """Clamp per-expert counts by per-worker capacity.  Reference:
+    limit_by_capacity_op.cu."""
+    expert_count = jnp.asarray(expert_count)
+    cap = jnp.asarray(capacity)
+    return jnp.minimum(expert_count, cap * n_worker)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int,
+                           capacity=None):
+    """Set gate index to -1 for tokens overflowing their expert's capacity
+    (position within the expert decided by arrival order).  Reference:
+    prune_gate_by_capacity_op.cu."""
+    gate_idx = jnp.asarray(gate_idx).reshape(-1)
+    one_hot = jax.nn.one_hot(gate_idx, n_expert, dtype=jnp.int32)
+    # arrival-order position of each token within its expert
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot  # 1-based where selected
+    pos_in_expert = jnp.sum(pos, axis=-1) - 1
+    if capacity is None:
+        cap_per_expert = jnp.asarray(expert_count)
+    else:
+        cap_per_expert = jnp.minimum(jnp.asarray(expert_count),
+                                     jnp.asarray(capacity))
+    keep = pos_in_expert < cap_per_expert[gate_idx]
+    return jnp.where(keep, gate_idx, -1)
+
+
+def default_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    """GShard capacity: ceil(top_k * tokens / experts * factor), padded to a
+    multiple of 4 so the [E, C, M] dispatch lays out well on the MXU."""
+    cap = int(math.ceil(top_k * num_tokens / num_experts * capacity_factor))
+    return max(4, ((cap + 3) // 4) * 4)
+
+
+# --------------------------------------------------------------------------
+# global_scatter / global_gather parity (shard_map alltoall form)
+# --------------------------------------------------------------------------
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Parity stub of the NCCL global_scatter op.  In this framework MoE
+    dispatch happens through the capacity-padded einsums inside MoELayer
+    (GSPMD emits the all-to-all); a count-based ragged alltoall has no
+    static-shape equivalent, so this raises with guidance.  Reference:
+    global_scatter_op.cu."""
+    raise NotImplementedError(
+        "global_scatter is subsumed by MoELayer's capacity-padded dispatch "
+        "(XLA all-to-all); use MoELayer or dist.alltoall for dense transfers")
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """See global_scatter."""
+    raise NotImplementedError(
+        "global_gather is subsumed by MoELayer's capacity-padded combine; "
+        "use MoELayer or dist.alltoall for dense transfers")
+
+
+# --------------------------------------------------------------------------
+# Gates
+# --------------------------------------------------------------------------
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_expert: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.loss = None  # aux load-balance loss, read by MoELayer
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear: bool = True):
+        l = self.loss
+        if clear:
+            self.loss = None
+        return l
+
+
+class NaiveGate(BaseGate):
+    """Plain learned top-k softmax gate (reference: naive_gate.py).
+    Returns (gate_probs [S, k], gate_idx [S, k])."""
+
+    def __init__(self, d_model: int, num_expert: int, topk: int = 2):
+        super().__init__(d_model, num_expert)
+        self.top_k = topk
+        self.gate_weight = self.create_parameter(
+            (d_model, num_expert), default_initializer=I.XavierUniform())
+
+    def logits(self, x):
+        return jnp.matmul(x.astype(jnp.float32),
+                          self.gate_weight.astype(jnp.float32))
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_val, gate_idx = jax.lax.top_k(probs, self.top_k)
+        self.set_loss(jnp.zeros((), jnp.float32))
+        return gate_val, gate_idx
+
+
+def _load_balance_loss(probs, gate_idx, num_expert: int):
+    """GShard/Switch aux loss: E * sum_e mean_prob_e * frac_tokens_e over
+    top-1 assignment."""
+    me = jnp.mean(probs, axis=0)                      # [E] mean router prob
+    top1 = gate_idx[..., 0] if gate_idx.ndim > 1 else gate_idx
+    ce = jnp.mean(jax.nn.one_hot(top1, num_expert, dtype=probs.dtype),
+                  axis=0)                             # [E] token fraction
+    return jnp.sum(me * ce) * num_expert
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balance aux loss and probabilistic 2nd-expert
+    (random routing) as in GShard (reference: gshard_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, topk: int = 2,
+                 capacity=(1.2, 2.4), random_routing: bool = True,
+                 group=None):
+        assert topk == 2, "GShardGate is top-2 (reference asserts the same)"
+        super().__init__(d_model, num_expert, topk=2)
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_val, gate_idx = jax.lax.top_k(probs, 2)
+        self.set_loss(_load_balance_loss(probs, gate_idx, self.num_expert))
+        if self.random_routing and self.training:
+            # keep 2nd expert with prob ∝ its gate weight (reference:
+            # random_routing op): drop when 2*p2 < U(0,1)
+            from ..framework.random import next_rng_key
+            key = next_rng_key()
+            if key is not None:
+                u = jax.random.uniform(key, gate_val[..., 1].shape)
+                keep = 2.0 * gate_val[..., 1] > u
+                gate_idx = gate_idx.at[..., 1].set(
+                    jnp.where(keep, gate_idx[..., 1], -1))
+        return gate_val, gate_idx
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 gate (Switch Transformer) with jitter noise + aux loss
+    (reference: switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, topk: int = 1,
+                 switch_eps: float = 0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.logits(x)
+        if self.training and self.switch_eps > 0:
+            from ..framework.random import next_rng_key
+            key = next_rng_key()
+            if key is not None:
+                noise = jax.random.uniform(
+                    key, logits.shape, minval=1.0 - self.switch_eps,
+                    maxval=1.0 + self.switch_eps)
+                logits = logits * noise
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_val, gate_idx = jax.lax.top_k(probs, 1)
+        self.set_loss(_load_balance_loss(probs, gate_idx, self.num_expert))
+        return gate_val, gate_idx
+
+
+# --------------------------------------------------------------------------
+# Experts + MoELayer
+# --------------------------------------------------------------------------
+
+class ExpertFFN(Layer):
+    """One FFN expert (Linear -> act -> Linear), the reference's standard
+    expert module (ExpertLayer in moe test/models)."""
+
+    def __init__(self, d_model: int, d_hidden: int, activation: str = "gelu"):
+        super().__init__()
+        self.w0 = self.create_parameter((d_model, d_hidden),
+                                        default_initializer=I.XavierNormal())
+        self.b0 = self.create_parameter((d_hidden,), is_bias=True)
+        self.w1 = self.create_parameter((d_hidden, d_model),
+                                        default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter((d_model,), is_bias=True)
+        self.activation = activation
+
+    def forward(self, x):
+        h = jnp.matmul(x, self.w0) + self.b0
+        h = getattr(F, self.activation)(h)
+        return jnp.matmul(h, self.w1) + self.b1
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer (reference: moe_layer.py — MoELayer).
+
+    Args mirror the reference: ``d_model``, ``experts`` (list of homogeneous
+    Layers — one per *global* expert), ``gate`` (a BaseGate or config dict
+    with ``type`` in {naive, gshard, switch}), ``moe_group`` (the
+    expert-parallel group; a ParallelAxis or mesh-axis name — experts are
+    sharded over it), ``recompute_interval`` accepted for parity.
+
+    Dispatch is capacity-padded GShard style; with ``moe_group`` set, the
+    [tokens(sharded), experts(sharded)] einsums compile to all-to-all over
+    the group's mesh axis.
+    """
+
+    def __init__(self, d_model: int, experts: Sequence[Layer],
+                 gate=None, moe_group=None, mp_group=None,
+                 capacity_factor: float = 1.25,
+                 eval_capacity_factor: float = 2.0,
+                 recompute_interval: int = 0, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = len(experts)
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        if gate is None or isinstance(gate, dict):
+            cfg = dict(gate or {})
+            gtype = cfg.pop("type", "gshard")
+            gcls = {"naive": NaiveGate, "gshard": GShardGate,
+                    "switch": SwitchGate}[gtype]
+            gate = gcls(d_model, self.num_expert, **cfg)
+        self.gate = gate
+        self.experts = ExpertStack(experts, moe_group=moe_group)
+        self._axis = _ep_axis(moe_group)
+        self._token_axis = "dp"
+
+    @property
+    def top_k(self) -> int:
+        return self.gate.top_k
+
+    def forward(self, x):
+        orig_shape = x.shape
+        S = int(math.prod(orig_shape[:-1]))
+        M, E = self.d_model, self.num_expert
+        k = self.top_k
+        tokens = x.reshape(S, M)
+
+        gate_val, gate_idx = self.gate(tokens)        # [S,k], [S,k]
+        factor = (self.capacity_factor if self.training
+                  else self.eval_capacity_factor)
+        C = default_capacity(S, E, k, factor)
+
+        # position of each (token, slot) within its expert, arrival order
+        sel = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)   # [S,k,E]
+        flat_sel = sel.reshape(S * k, E)
+        pos = jnp.cumsum(flat_sel, axis=0) * flat_sel - 1     # [S*k,E]
+        pos_in_expert = jnp.max(pos, axis=-1).reshape(S, k)   # [S,k]
+        keep = (pos_in_expert >= 0) & (pos_in_expert < C) & (gate_idx >= 0)
+
+        # normalize kept gate weights per token (reference normalizes top-k)
+        gv = jnp.where(keep, gate_val, 0.0)
+        denom = jnp.maximum(jnp.sum(gv, axis=-1, keepdims=True), 1e-9)
+        gv = gv / denom
+
+        onehot_e = jax.nn.one_hot(jnp.where(keep, gate_idx, 0), E,
+                                  dtype=tokens.dtype)         # [S,k,E]
+        onehot_c = jax.nn.one_hot(jnp.where(keep, pos_in_expert, 0), C,
+                                  dtype=tokens.dtype)         # [S,k,C]
+        dispatch = jnp.einsum("ske,skc->sec",
+                              onehot_e * keep[..., None].astype(tokens.dtype),
+                              onehot_c)                       # [S,E,C]
+        combine = jnp.einsum("sk,ske,skc->sec",
+                             gv.astype(tokens.dtype), onehot_e, onehot_c)
+
+        # dispatch: [S,E,C]x[S,M] -> [E,C,M]; with S sharded over dp and E
+        # over the ep axis this einsum IS global_scatter (XLA all-to-all)
+        expert_in = jnp.einsum("sec,sm->ecm", dispatch, tokens)
+        expert_in = _maybe_constraint(expert_in, P(self._axis, None, None))
+        expert_out = self.experts(expert_in)                  # [E,C,M]
+        expert_out = _maybe_constraint(expert_out, P(self._axis, None, None))
+        # combine: global_gather
+        out = jnp.einsum("sec,ecm->sm", combine, expert_out)
+        return out.reshape(orig_shape)
+
+
+class ExpertStack(Layer):
+    """Holds N homogeneous expert Layers and runs them batched over a
+    leading expert dim via vmap of the functional call — the TPU-native
+    replacement for the reference's per-rank expert loop."""
+
+    def __init__(self, experts: Sequence[Layer], moe_group=None):
+        super().__init__()
+        experts = list(experts)
+        if not experts:
+            raise ValueError("need at least one expert")
+        self._n = len(experts)
+        self._axis = _ep_axis(moe_group)
+        # the template runs the per-expert math under vmap; keep it OUT of
+        # the sublayer tree so its (unstacked) params don't shadow the
+        # stacked ones below
+        object.__setattr__(self, "_template", experts[0])
+        # stack per-expert params into [E, ...] leaves owned by this layer
+        names = [n for n, _ in experts[0].named_parameters()]
+        for name in names:
+            leaves = [dict(e.named_parameters())[name] for e in experts]
+            stacked = jnp.stack(leaves, axis=0)
+            pname = "stacked__" + name.replace(".", "__")
+            self._parameters[pname] = stacked
+            spec = P(self._axis, *([None] * leaves[0].ndim))
+            set_param_spec(self, pname, spec)
+        self._param_names = names
+
+    @property
+    def num_experts(self) -> int:
+        return self._n
+
+    def forward(self, x):
+        """x: [E, C, M] -> [E, C, M]."""
+        from ..nn.functional_call import functional_call
+        stacked = {n: self._parameters["stacked__" + n.replace(".", "__")]
+                   for n in self._param_names}
+
+        def one(params, xe):
+            out, _ = functional_call(self._template, params, {}, (xe,),
+                                     train=self.training)
+            return out
+
+        return jax.vmap(one, in_axes=(0, 0))(stacked, x)
+
+
+def _ep_axis(moe_group) -> Optional[str]:
+    if moe_group is None:
+        hcg = get_hybrid_communicate_group()
+        # reference default: experts ride the data-parallel/world group
+        return "dp" if hcg is not None else None
+    if hasattr(moe_group, "name"):
+        return moe_group.name
+    if isinstance(moe_group, str):
+        return moe_group
+    return None
+
+
+def _maybe_constraint(x, spec: P):
+    if spec is None or all(s is None for s in spec):
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x

@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — parity namespace for paddle.incubate.
+
+Hosts the experimental surfaces the reference keeps under incubate:
+distributed MoE models (python/paddle/incubate/distributed/models/moe/) and
+fused nn layers (python/paddle/incubate/nn/).
+"""
+
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

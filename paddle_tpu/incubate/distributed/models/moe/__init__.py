@@ -1,0 +1,7 @@
+"""Parity re-exports of the MoE stack (reference:
+python/paddle/incubate/distributed/models/moe/__init__.py)."""
+
+from paddle_tpu.distributed.moe import (  # noqa: F401
+    MoELayer, ExpertFFN, NaiveGate, GShardGate, SwitchGate,
+    number_count, limit_by_capacity, prune_gate_by_capacity, assign_pos)
+from paddle_tpu.distributed.moe import BaseGate  # noqa: F401

@@ -1,0 +1,18 @@
+"""paddle_tpu.incubate.nn — fused transformer layers.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py —
+FusedMultiHeadAttention, FusedFeedForward, FusedMultiTransformer (the
+Python wrappers over the fused CUDA ops fused_attention_op.cu /
+fused_feedforward_op.cu / fused_multi_transformer_op.cu, SURVEY.md §2.1).
+
+TPU-native: the CUDA "fusion" exists to dodge kernel-launch and HBM
+round-trips; XLA already fuses these compositions, so the layers here are
+the plain math with the same parameter layout / constructor surface, KV
+cache decode included.  The Pallas tier (paddle_tpu.ops.pallas) supplies
+hand-tuned attention kernels underneath F.scaled_dot_product_attention
+where they beat XLA.
+"""
+
+from .layer import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
+                    FusedMultiTransformer)
+from . import functional  # noqa: F401

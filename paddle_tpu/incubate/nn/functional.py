@@ -1,0 +1,161 @@
+"""Functional fused ops (parity: python/paddle/incubate/nn/functional/).
+
+Each maps a fused CUDA op to its XLA-fused composition; same signatures so
+ported code runs.  fused_linear's GEMM-epilogue fusion and the
+bias+dropout+residual+LN epilogue are exactly the fusions XLA performs
+automatically on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+
+__all__ = ["fused_linear", "fused_matmul_bias", "fused_feedforward",
+           "fused_multi_head_attention",
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_rotary_position_embedding", "fused_rms_norm",
+           "fused_layer_norm", "swiglu"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight: bool = False,
+                 name=None):
+    """Reference: fused_linear (cuBLASLt epilogue fusion)."""
+    w = weight.T if transpose_weight else weight
+    return F.linear(x, w, bias)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    return out if bias is None else out + bias
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate: float = 0.5, ln_epsilon: float = 1e-5,
+        training: bool = True, mode="upscale_in_train", name=None):
+    """Reference: fused_bias_dropout_residual_layer_norm op."""
+    h = x if bias is None else x + bias
+    h = F.dropout(h, dropout_rate, training=training, mode=mode)
+    h = h + residual
+    return F.layer_norm(h, (h.shape[-1],), ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate: float = 0.5,
+                      dropout2_rate: float = 0.5, activation: str = "relu",
+                      ln1_epsilon: float = 1e-5, ln2_epsilon: float = 1e-5,
+                      pre_layer_norm: bool = False, training: bool = True,
+                      mode="upscale_in_train", ring_id: int = -1, name=None):
+    """Reference: fused_feedforward_op.cu."""
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, (d,), ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (d,), ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm: bool = False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon: float = 1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate: float = 0.5,
+        attn_dropout_rate: float = 0.5, ln_epsilon: float = 1e-5,
+        training: bool = True, mode="upscale_in_train", ring_id: int = -1,
+        name=None):
+    """Reference: fused_attention_op.cu.  qkv_weight [3,H,D,M]."""
+    residual = x
+    M = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, (M,), pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkv = jnp.einsum("bsm,thdm->bsthd", x, qkv_weight)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    out = out.reshape(*out.shape[:2], M)
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (M,), ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major: bool = False, name=None):
+    """Reference: fused_rope op.  q/k/v [B,S,H,D]; returns rotated (q,k,v)."""
+    def rope(x):
+        if x is None:
+            return None
+        B, S, H, D = x.shape
+        if sin is None or cos is None:
+            pos = jnp.arange(S)[:, None]
+            inv = 1.0 / (10000 ** (jnp.arange(0, D, 2) / D))
+            ang = pos * inv[None, :]
+            s, c = jnp.sin(ang), jnp.cos(ang)            # [S, D/2]
+        else:
+            # sin/cos given as [1, S, 1, D] (reference layout): take pairs
+            s = sin.reshape(sin.shape[1], -1)[:, ::2]
+            c = cos.reshape(cos.shape[1], -1)[:, ::2]
+        if position_ids is not None:
+            s = s[position_ids]                          # [B,S,D/2]
+            c = c[position_ids]
+            s = s[:, :, None, :]
+            c = c[:, :, None, :]
+        else:
+            s = s[None, :, None, :]
+            c = c[None, :, None, :]
+        if use_neox_rotary_style:
+            x1, x2 = x[..., : D // 2], x[..., D // 2:]
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        ro = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+        return ro.reshape(x.shape)
+
+    return rope(q), rope(k), rope(v)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
+                   begin_norm_axis: int = -1, name=None):
+    """Reference: rms_norm fused op (PaddleNLP/incubate)."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    out = out * norm_weight
+    return out if norm_bias is None else out + norm_bias
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
+                     residual=None, bias=None, name=None):
+    h = x
+    if bias is not None:
+        h = h + bias
+    if residual is not None:
+        h = h + residual
+    return F.layer_norm(h, (h.shape[-1],), norm_weight, norm_bias, epsilon)
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: incubate F.swiglu — silu(x) * y (y defaults to split)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y

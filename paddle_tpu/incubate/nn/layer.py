@@ -1,0 +1,337 @@
+"""Fused transformer layers (parity:
+python/paddle/incubate/nn/layer/fused_transformer.py).
+
+Parameter layout matches the reference ops:
+  - qkv_weight [3, num_heads, head_dim, embed_dim] (fused_attention layout)
+  - per-layer lists in FusedMultiTransformer (qkv_weights[i], ...)
+  - cache_kvs [2, batch, num_heads, max_seq, head_dim] per layer for decode
+    (fused_multi_transformer_op.cu cache layout), written at ``time_step``.
+
+nranks/ring_id args are accepted: instead of an in-kernel NCCL allreduce
+(reference: ring_id attr), tensor parallelism is expressed as PartitionSpecs
+on the fused weights over the ``mp`` mesh axis; GSPMD inserts the same
+collective at the same point.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...distributed.sharding_utils import set_param_spec
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with fused residual+dropout
+    epilogue (reference: FusedMultiHeadAttention — fused_attention op)."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout_rate: float = 0.5,
+                 attn_dropout_rate: float = 0.5, kdim=None, vdim=None,
+                 normalize_before: bool = False, need_weights: bool = False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon: float = 1e-5,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim), attr=qkv_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            (3, num_heads, self.head_dim), attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            (embed_dim,), attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=ln_bias_attr,
+                                             is_bias=True)
+        if nranks > 1:
+            # TP: heads split over mp; out-proj row-split (reference ring_id
+            # allreduce becomes the GSPMD reduction of the row matmul)
+            set_param_spec(self, "qkv_weight", P(None, "mp", None, None))
+            set_param_spec(self, "qkv_bias", P(None, "mp", None))
+            set_param_spec(self, "linear_weight", P("mp", None))
+
+    def forward(self, x, attn_mask=None, cache=None):
+        """cache: [2, B, H, T_prev, D] KV history (reference cache_kv).
+        When given, new K/V are appended and (out, new_cache) is returned."""
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, (self.embed_dim,), self.pre_ln_scale,
+                             self.pre_ln_bias, self._epsilon)
+        # qkv: [B,S,M] x [3,H,D,M] -> [B,S,3,H,D]
+        qkv = jnp.einsum("bsm,thdm->bsthd", x, self.qkv_weight)
+        qkv = qkv + self.qkv_bias
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])  # [B,S,H,D]
+        new_cache = None
+        if cache is not None:
+            k_hist = jnp.swapaxes(cache[0], 1, 2)   # [B,T_prev,H,D]
+            v_hist = jnp.swapaxes(cache[1], 1, 2)
+            k = jnp.concatenate([k_hist.astype(k.dtype), k], axis=1)
+            v = jnp.concatenate([v_hist.astype(v.dtype), v], axis=1)
+            new_cache = jnp.stack([jnp.swapaxes(k, 1, 2),
+                                   jnp.swapaxes(v, 1, 2)], axis=0)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training)
+        out = out.reshape(*out.shape[:2], self.embed_dim)
+        out = F.linear(out, self.linear_weight, self.linear_bias)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, (self.embed_dim,), self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class FusedFeedForward(Layer):
+    """LN + linear + act + dropout + linear + residual (reference:
+    FusedFeedForward — fused_feedforward op)."""
+
+    def __init__(self, d_model: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, epsilon: float = 1e-5,
+                 activation: str = "relu", act_dropout_rate=None,
+                 normalize_before: bool = False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None,
+                 ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.dim_feedforward = dim_feedforward
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter((d_model,), attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), attr=ln2_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter((d_model,), attr=ln2_bias_attr,
+                                              is_bias=True)
+        if nranks > 1:
+            set_param_spec(self, "linear1_weight", P(None, "mp"))
+            set_param_spec(self, "linear1_bias", P("mp"))
+            set_param_spec(self, "linear2_weight", P("mp", None))
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, (self.d_model,), self.ln1_scale, self.ln1_bias,
+                             self._epsilon)
+        h = F.linear(x, self.linear1_weight, self.linear1_bias)
+        h = getattr(F, self.activation)(h)
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = F.linear(h, self.linear2_weight, self.linear2_bias)
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = F.layer_norm(out, (self.d_model,), self.ln2_scale,
+                               self.ln2_bias, self._epsilon)
+        return out
+
+
+class FusedMultiTransformer(Layer):
+    """Whole decoder stack in one layer with KV-cache decode (reference:
+    FusedMultiTransformer — fused_multi_transformer_op.cu, the inference
+    workhorse).  normalize_before=True only, like the reference.
+
+    forward(src, attn_mask=None, caches=None, time_step=None):
+      - prefill (time_step=None): full self-attention over src; if caches
+        given, returns them filled at [0:seq].
+      - decode (time_step=t int/array): src is [B,1,M]; attends over
+        caches[:, :, :t+1]; returns updated caches.
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, dim_feedforward: int,
+                 dropout_rate: float = 0.0, activation: str = "gelu",
+                 normalize_before: bool = True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon: float = 1e-5, num_layers: int = -1,
+                 nranks: int = 1, trans_qkvw: bool = True, ring_id: int = -1,
+                 name=None):
+        super().__init__()
+        assert normalize_before, \
+            "FusedMultiTransformer is pre-LN only (reference constraint)"
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dim_feedforward = dim_feedforward
+        self.num_layers = num_layers
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self._epsilon = epsilon
+
+        self.trans_qkvw = trans_qkvw
+
+        def attr(lst, i):
+            return lst[i] if lst is not None else None
+
+        H, D, M, FF = num_heads, self.head_dim, embed_dim, dim_feedforward
+        qkv_shape = (3, H, D, M) if trans_qkvw else (M, 3, H, D)
+        for i in range(num_layers):
+            self.add_parameter(f"ln_scale_{i}", self.create_parameter(
+                (M,), attr=attr(ln_scale_attrs, i),
+                default_initializer=I.Constant(1.0)))
+            self.add_parameter(f"ln_bias_{i}", self.create_parameter(
+                (M,), attr=attr(ln_bias_attrs, i), is_bias=True))
+            self.add_parameter(f"qkv_weight_{i}", self.create_parameter(
+                qkv_shape, attr=attr(qkv_weight_attrs, i),
+                default_initializer=I.XavierUniform()))
+            self.add_parameter(f"qkv_bias_{i}", self.create_parameter(
+                (3, H, D), attr=attr(qkv_bias_attrs, i), is_bias=True))
+            self.add_parameter(f"linear_weight_{i}", self.create_parameter(
+                (M, M), attr=attr(linear_weight_attrs, i),
+                default_initializer=I.XavierUniform()))
+            self.add_parameter(f"linear_bias_{i}", self.create_parameter(
+                (M,), attr=attr(linear_bias_attrs, i), is_bias=True))
+            self.add_parameter(f"ffn_ln_scale_{i}", self.create_parameter(
+                (M,), attr=attr(ffn_ln_scale_attrs, i),
+                default_initializer=I.Constant(1.0)))
+            self.add_parameter(f"ffn_ln_bias_{i}", self.create_parameter(
+                (M,), attr=attr(ffn_ln_bias_attrs, i), is_bias=True))
+            self.add_parameter(f"ffn1_weight_{i}", self.create_parameter(
+                (M, FF), attr=attr(ffn1_weight_attrs, i),
+                default_initializer=I.XavierUniform()))
+            self.add_parameter(f"ffn1_bias_{i}", self.create_parameter(
+                (FF,), attr=attr(ffn1_bias_attrs, i), is_bias=True))
+            self.add_parameter(f"ffn2_weight_{i}", self.create_parameter(
+                (FF, M), attr=attr(ffn2_weight_attrs, i),
+                default_initializer=I.XavierUniform()))
+            self.add_parameter(f"ffn2_bias_{i}", self.create_parameter(
+                (M,), attr=attr(ffn2_bias_attrs, i), is_bias=True))
+            if nranks > 1:
+                set_param_spec(self, f"qkv_weight_{i}", P(None, "mp", None, None))
+                set_param_spec(self, f"qkv_bias_{i}", P(None, "mp", None))
+                set_param_spec(self, f"linear_weight_{i}", P("mp", None))
+                set_param_spec(self, f"ffn1_weight_{i}", P(None, "mp"))
+                set_param_spec(self, f"ffn1_bias_{i}", P("mp"))
+                set_param_spec(self, f"ffn2_weight_{i}", P("mp", None))
+
+    def init_cache(self, batch: int, max_seq: int, dtype=jnp.float32):
+        """Allocate [2, B, H, max_seq, D] KV caches, one per layer."""
+        return [jnp.zeros((2, batch, self.num_heads, max_seq, self.head_dim),
+                          dtype) for _ in range(self.num_layers)]
+
+    def _layer(self, i, x, attn_mask, cache, time_step):
+        p = self._parameters
+        M = self.embed_dim
+        residual = x
+        h = F.layer_norm(x, (M,), p[f"ln_scale_{i}"], p[f"ln_bias_{i}"],
+                         self._epsilon)
+        if self.trans_qkvw:
+            qkv = jnp.einsum("bsm,thdm->bsthd", h, p[f"qkv_weight_{i}"])
+        else:
+            qkv = jnp.einsum("bsm,mthd->bsthd", h, p[f"qkv_weight_{i}"])
+        qkv = qkv + p[f"qkv_bias_{i}"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [B,S,H,D]
+        new_cache = None
+        if cache is not None:
+            # cache layout [2, B, H, T, D]
+            kc, vc = cache[0], cache[1]
+            k_t = jnp.swapaxes(k, 1, 2)   # [B,H,S,D]
+            v_t = jnp.swapaxes(v, 1, 2)
+            if time_step is None:
+                kc = jax.lax.dynamic_update_slice(
+                    kc, k_t.astype(kc.dtype), (0, 0, 0, 0))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, v_t.astype(vc.dtype), (0, 0, 0, 0))
+                att_k, att_v = k, v
+            else:
+                t = jnp.asarray(time_step, jnp.int32)
+                kc = jax.lax.dynamic_update_slice(
+                    kc, k_t.astype(kc.dtype), (0, 0, t, 0))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, v_t.astype(vc.dtype), (0, 0, t, 0))
+                # attend over the full cache with a length mask
+                att_k = jnp.swapaxes(kc, 1, 2)   # [B,T,H,D]
+                att_v = jnp.swapaxes(vc, 1, 2)
+                Tmax = att_k.shape[1]
+                pos = jnp.arange(Tmax)
+                lmask = (pos <= t).astype(h.dtype)
+                neg = jnp.asarray(-1e9, h.dtype)
+                length_mask = (1.0 - lmask)[None, None, None, :] * neg
+                # combine with a user padding mask instead of dropping it
+                attn_mask = (length_mask if attn_mask is None
+                             else length_mask + attn_mask.astype(h.dtype))
+            new_cache = jnp.stack([kc, vc], axis=0)
+        else:
+            att_k, att_v = k, v
+        causal = cache is None or time_step is None
+        out = F.scaled_dot_product_attention(
+            q, att_k, att_v, attn_mask=attn_mask,
+            is_causal=causal and attn_mask is None, training=self.training)
+        out = out.reshape(*out.shape[:2], M)
+        out = F.linear(out, p[f"linear_weight_{i}"], p[f"linear_bias_{i}"])
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        x = residual + out
+        # FFN
+        residual = x
+        h = F.layer_norm(x, (M,), p[f"ffn_ln_scale_{i}"],
+                         p[f"ffn_ln_bias_{i}"], self._epsilon)
+        h = F.linear(h, p[f"ffn1_weight_{i}"], p[f"ffn1_bias_{i}"])
+        h = getattr(F, self.activation)(h)
+        h = F.linear(h, p[f"ffn2_weight_{i}"], p[f"ffn2_bias_{i}"])
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        return residual + h, new_cache
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims: int = 0, seq_lens=None,
+                time_step=None):
+        x = src
+        new_caches = [] if caches is not None else None
+        for i in range(self.num_layers):
+            cache_i = caches[i] if caches is not None else None
+            x, nc = self._layer(i, x, attn_mask, cache_i, time_step)
+            if new_caches is not None:
+                new_caches.append(nc)
+        if caches is not None:
+            return x, new_caches
+        return x
