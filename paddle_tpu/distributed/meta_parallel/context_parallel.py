@@ -1,0 +1,232 @@
+"""Context parallelism (the hybrid topology's ``sep`` axis): long-sequence
+attention sharded across devices.
+
+Reference surface (SURVEY.md §5 "long-context"):
+  - sep axis: fleet/base/topology.py — HybridCommunicateGroup(sep_degree),
+    splitting activations on the sequence dim across the sep group.
+  - Ulysses all-to-all (head<->seq swap) utilities in fleet/utils.
+  - Ring flash attention: PaddleNLP ring_flash_attention layered on core
+    send/recv — implemented natively here since it is a first-class
+    capability of this framework.
+
+TPU-native: both schemes are shard_map programs over the ``sep`` mesh axis.
+Ring attention rotates K/V blocks around the ICI ring with
+``jax.lax.ppermute`` while accumulating a numerically-stable online
+softmax (the flash-attention recurrence), so peak memory is O(S/n) and the
+transfer rides neighbor links.  Ulysses swaps which dim is sharded
+(seq -> heads) with ``jax.lax.all_to_all``, runs ordinary attention on
+full-length sequences for H/n heads, and swaps back.
+
+Both functions work in two modes:
+  - eager/top-level: pass ``mesh`` (or rely on the fleet HCG mesh); they
+    wrap themselves in shard_map.
+  - already inside a shard_map/jit with the axis in scope: pass
+    ``inside_shard_map=True`` and they use the collectives directly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..topology import get_hybrid_communicate_group
+
+
+def _shard_map(body, mesh, in_specs, out_specs, manual_axes):
+    """jax.shard_map in partial-manual mode: only ``manual_axes`` are
+    manual (collectives address them); other mesh axes stay GSPMD-auto so
+    this composes inside a pjit program sharded over dp/mp/etc."""
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs,
+                         axis_names=frozenset(manual_axes), check_vma=False)
+
+__all__ = ["ring_attention", "ulysses_attention", "RingAttention",
+           "split_sequence", "gather_sequence"]
+
+
+def _resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
+    if mesh is not None:
+        return mesh
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise ValueError("no mesh: pass mesh= or fleet.init first")
+    return hcg.get_mesh()
+
+
+def split_sequence(x, axis_name: str = "sep", seq_dim: int = 1, mesh=None):
+    """Constrain x to sequence-sharded layout over the sep axis (reference:
+    the sep group's scatter of activations along seq)."""
+    spec = [None] * x.ndim
+    spec[seq_dim] = axis_name
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except Exception:
+        return x
+
+
+def gather_sequence(x, axis_name: str = "sep", seq_dim: int = 1, mesh=None):
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*([None] * x.ndim)))
+    except Exception:
+        return x
+
+
+# --------------------------------------------------------------------------
+# Ring attention
+# --------------------------------------------------------------------------
+
+def _ring_attention_local(q, k, v, axis_name: str, axis_size: int,
+                          causal: bool, scale: float):
+    """Per-device body: q,k,v are the LOCAL sequence blocks [B,Sl,H,D].
+
+    Classic flash/ring recurrence: for each of the ``axis_size`` steps,
+    attend local q against the current K/V block (with global-position
+    causal masking), then rotate K/V one hop around the ring.
+    """
+    B, Sl, H, D = q.shape
+    my = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    # accumulators in fp32: running max m, denom l, numerator o
+    m = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sl), jnp.float32)
+    o = jnp.zeros((B, H, Sl, D), jnp.float32)
+
+    q_pos = my * Sl + jnp.arange(Sl)                     # global q positions
+
+    def step(carry, _):
+        m, l, o, k_blk, v_blk, src = carry
+        # src = ring index whose block we currently hold
+        s = jnp.einsum("bshd,bthd->bhst", qf, k_blk.astype(jnp.float32))
+        s = s * scale
+        if causal:
+            k_pos = src * Sl + jnp.arange(Sl)
+            mask = q_pos[:, None] >= k_pos[None, :]       # [Sl, Sl]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)                     # [B,H,Sl]
+        m_new = jnp.maximum(m, blk_max)
+        # guard -inf rows (fully masked block): exp(-inf - -inf) -> use safe m
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhst,bthd->bhsd", p, v_blk.astype(jnp.float32))
+        # rotate K/V: receive the next lower rank's block (ring walk)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_nxt = (src - 1) % axis_size
+        return (m_new, l_new, o_new, k_nxt, v_nxt, src_nxt), None
+
+    carry = (m, l, o, k, v, my)
+    for _ in range(axis_size):            # static unroll over ring hops
+        carry, _ = step(carry, None)
+    m, l, o, _, _, _ = carry
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe[..., None]                           # [B,H,Sl,D]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)        # [B,Sl,H,D]
+
+
+def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
+                   mesh: Optional[Mesh] = None, batch_spec: P = None,
+                   inside_shard_map: bool = False, scale: Optional[float] = None):
+    """Ring attention over the ``sep`` mesh axis.  q/k/v: [B, S, H, D]
+    (global shapes at top level; local blocks when inside_shard_map)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if inside_shard_map:
+        size = jax.lax.axis_size(axis_name)
+        return _ring_attention_local(q, k, v, axis_name, size, causal, scale)
+
+    mesh = _resolve_mesh(mesh)
+    size = mesh.shape[axis_name]
+    if q.shape[1] % size:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by "
+                         f"{axis_name} degree {size}")
+    b_axis = batch_spec if batch_spec is not None else None
+    spec = P(b_axis, axis_name, None, None)
+    manual = {axis_name} | ({b_axis} if b_axis else set())
+    fn = _shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          axis_size=size, causal=causal, scale=scale),
+        mesh, (spec, spec, spec), spec, manual)
+    return fn(q, k, v)
+
+
+class RingAttention:
+    """Layer-ish wrapper for ported code (PaddleNLP RingFlashAttention)."""
+
+    def __init__(self, axis_name: str = "sep", causal: bool = True):
+        self.axis_name = axis_name
+        self.causal = causal
+
+    def __call__(self, q, k, v, **kw):
+        return ring_attention(q, k, v, causal=self.causal,
+                              axis_name=self.axis_name, **kw)
+
+
+# --------------------------------------------------------------------------
+# Ulysses (DeepSpeed-style) all-to-all attention
+# --------------------------------------------------------------------------
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, scale: float,
+                   attn_fn=None):
+    """Per-device body: [B, Sl, H, D] -> all_to_all -> [B, S, Hl, D] ->
+    attention -> swap back."""
+    def seq2head(x):
+        # split heads (dim 2) across the axis, concat seq (dim 1)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)    # [B, S, H/n, D]
+    if attn_fn is None:
+        qf = qg.astype(jnp.float32)
+        s = jnp.einsum("bshd,bthd->bhst", qf, kg.astype(jnp.float32)) * scale
+        if causal:
+            S = s.shape[-1]
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhst,bthd->bshd", p,
+                         vg.astype(jnp.float32)).astype(q.dtype)
+    else:
+        out = attn_fn(qg, kg, vg)
+    return head2seq(out)                                   # [B, Sl, H, D]
+
+
+def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
+                      mesh: Optional[Mesh] = None, batch_spec: P = None,
+                      inside_shard_map: bool = False,
+                      scale: Optional[float] = None):
+    """Ulysses context parallelism: all-to-all head<->seq swap, full-seq
+    attention on H/n heads, swap back.  Requires num_heads % sep == 0."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if inside_shard_map:
+        return _ulysses_local(q, k, v, axis_name, causal, scale)
+
+    mesh = _resolve_mesh(mesh)
+    size = mesh.shape[axis_name]
+    if q.shape[1] % size or q.shape[2] % size:
+        raise ValueError(
+            f"seq {q.shape[1]} and heads {q.shape[2]} must divide "
+            f"{axis_name} degree {size}")
+    b_axis = batch_spec if batch_spec is not None else None
+    spec = P(b_axis, axis_name, None, None)
+    manual = {axis_name} | ({b_axis} if b_axis else set())
+    fn = _shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh, (spec, spec, spec), spec, manual)
+    return fn(q, k, v)

@@ -69,28 +69,37 @@ def assign_pos(gate_idx, upper_range: int):
 
 
 def limit_by_capacity(expert_count, capacity, n_worker: int = 1):
-    """Clamp per-expert counts by per-worker capacity.  Reference:
-    limit_by_capacity_op.cu."""
+    """Reference limit_by_capacity_op.cu semantics: ``expert_count`` is the
+    per-(worker, expert) token count, flat [n_worker*n_expert] or shaped
+    [n_worker, n_expert]; ``capacity`` is per-expert [n_expert] and is
+    consumed worker-by-worker, so the total admitted per expert across all
+    workers never exceeds capacity[e]."""
     expert_count = jnp.asarray(expert_count)
     cap = jnp.asarray(capacity)
-    return jnp.minimum(expert_count, cap * n_worker)
+    flat_in = expert_count.ndim == 1
+    ec = expert_count.reshape(n_worker, -1)
+    if cap.ndim == 0:
+        cap = jnp.broadcast_to(cap, (ec.shape[1],))
+    before = jnp.cumsum(ec, axis=0) - ec          # tokens consumed earlier
+    remaining = jnp.maximum(cap[None, :] - before, 0)
+    out = jnp.minimum(ec, remaining)
+    return out.reshape(-1) if flat_in else out
 
 
 def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int,
-                           capacity=None):
-    """Set gate index to -1 for tokens overflowing their expert's capacity
-    (position within the expert decided by arrival order).  Reference:
-    prune_gate_by_capacity_op.cu."""
+                           n_worker: int = 1):
+    """Set gate index to -1 for tokens overflowing their expert's admitted
+    count (arrival order), matching prune_gate_by_capacity_op.cu: the 4th
+    arg is n_worker (as in the reference op), ``expert_count`` is the
+    (already capacity-limited) per-expert admitted count of length
+    n_expert * n_worker."""
     gate_idx = jnp.asarray(gate_idx).reshape(-1)
-    one_hot = jax.nn.one_hot(gate_idx, n_expert, dtype=jnp.int32)
+    total = n_expert * n_worker
+    one_hot = jax.nn.one_hot(gate_idx, total, dtype=jnp.int32)
     # arrival-order position of each token within its expert
     pos = jnp.cumsum(one_hot, axis=0) * one_hot  # 1-based where selected
     pos_in_expert = jnp.sum(pos, axis=-1) - 1
-    if capacity is None:
-        cap_per_expert = jnp.asarray(expert_count)
-    else:
-        cap_per_expert = jnp.minimum(jnp.asarray(expert_count),
-                                     jnp.asarray(capacity))
+    cap_per_expert = jnp.asarray(expert_count).reshape(-1)
     keep = pos_in_expert < cap_per_expert[gate_idx]
     return jnp.where(keep, gate_idx, -1)
 
@@ -130,20 +139,43 @@ def global_gather(x, local_count, global_count, group=None):
 # --------------------------------------------------------------------------
 
 class BaseGate(Layer):
+    """Gate base.  The aux load-balance loss is written to a non-persistent
+    BUFFER (not a Python attribute): under jit, functional_call collects the
+    mutated buffer and returns it with the step outputs, so the loss crosses
+    the trace boundary functionally instead of leaking a tracer."""
+
     def __init__(self, d_model: int, num_expert: int):
         super().__init__()
         self.d_model = d_model
         self.num_expert = num_expert
-        self.loss = None  # aux load-balance loss, read by MoELayer
+        self.register_buffer("aux_loss", jnp.zeros((), jnp.float32),
+                             persistable=False)
 
     def set_loss(self, loss):
-        self.loss = loss
+        self.aux_loss = loss
 
     def get_loss(self, clear: bool = True):
-        l = self.loss
+        """Eager-mode accessor (reference BaseGate.get_loss).  Under jit,
+        read the 'aux_loss' buffer from functional_call's returned buffers
+        instead."""
+        l = self.aux_loss
         if clear:
-            self.loss = None
+            self.aux_loss = jnp.zeros((), jnp.float32)
         return l
+
+
+def _routing_key(x):
+    """Fresh PRNG key for routing noise; refuses to bake a concrete key
+    into a traced program (same guard as F.dropout)."""
+    from ..framework.random import has_rng_context, next_rng_key
+    import jax.core as _core
+    if not has_rng_context() and isinstance(x, _core.Tracer):
+        raise RuntimeError(
+            "MoE gate randomness traced under jit without an RNG context: "
+            "pass rng=key to nn.functional_call (or wrap with "
+            "paddle_tpu.rng_context(key)) so each step draws fresh routing "
+            "noise")
+    return next_rng_key()
 
 
 class NaiveGate(BaseGate):
@@ -198,13 +230,11 @@ class GShardGate(NaiveGate):
         if self.random_routing and self.training:
             # keep 2nd expert with prob ∝ its gate weight (reference:
             # random_routing op): drop when 2*p2 < U(0,1)
-            from ..framework.random import next_rng_key
-            key = next_rng_key()
-            if key is not None:
-                u = jax.random.uniform(key, gate_val[..., 1].shape)
-                keep = 2.0 * gate_val[..., 1] > u
-                gate_idx = gate_idx.at[..., 1].set(
-                    jnp.where(keep, gate_idx[..., 1], -1))
+            key = _routing_key(x)
+            u = jax.random.uniform(key, gate_val[..., 1].shape)
+            keep = 2.0 * gate_val[..., 1] > u
+            gate_idx = gate_idx.at[..., 1].set(
+                jnp.where(keep, gate_idx[..., 1], -1))
         return gate_val, gate_idx
 
 
@@ -221,13 +251,11 @@ class SwitchGate(NaiveGate):
     def forward(self, x):
         logits = self.logits(x)
         if self.training and self.switch_eps > 0:
-            from ..framework.random import next_rng_key
-            key = next_rng_key()
-            if key is not None:
-                noise = jax.random.uniform(
-                    key, logits.shape, minval=1.0 - self.switch_eps,
-                    maxval=1.0 + self.switch_eps)
-                logits = logits * noise
+            key = _routing_key(x)
+            noise = jax.random.uniform(
+                key, logits.shape, minval=1.0 - self.switch_eps,
+                maxval=1.0 + self.switch_eps)
+            logits = logits * noise
         probs = jax.nn.softmax(logits, axis=-1)
         gate_val, gate_idx = jax.lax.top_k(probs, 1)
         self.set_loss(_load_balance_loss(probs, gate_idx, self.num_expert))
@@ -291,7 +319,6 @@ class MoELayer(Layer):
         self.gate = gate
         self.experts = ExpertStack(experts, moe_group=moe_group)
         self._axis = _ep_axis(moe_group)
-        self._token_axis = "dp"
 
     @property
     def top_k(self) -> int:
@@ -402,7 +429,5 @@ def _ep_axis(moe_group) -> Optional[str]:
 def _maybe_constraint(x, spec: P):
     if spec is None or all(s is None for s in spec):
         return x
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except Exception:
-        return x
+    from .meta_parallel.mp_layers import _maybe_constraint as _mc
+    return _mc(x, spec)

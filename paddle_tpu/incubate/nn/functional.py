@@ -88,6 +88,16 @@ def fused_multi_head_attention(
     if qkv_bias is not None:
         qkv = qkv + qkv_bias
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    cache_kv_out = None
+    if cache_kv is not None:
+        # cache_kv [2, B, H, T_prev, D]: append new K/V, attend over history
+        # (reference fused_attention decode path returns (out, cache_kv_out))
+        k_hist = jnp.swapaxes(cache_kv[0], 1, 2)
+        v_hist = jnp.swapaxes(cache_kv[1], 1, 2)
+        k = jnp.concatenate([k_hist.astype(k.dtype), k], axis=1)
+        v = jnp.concatenate([v_hist.astype(v.dtype), v], axis=1)
+        cache_kv_out = jnp.stack([jnp.swapaxes(k, 1, 2),
+                                  jnp.swapaxes(v, 1, 2)], axis=0)
     out = F.scaled_dot_product_attention(
         q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
         training=training)
@@ -97,6 +107,8 @@ def fused_multi_head_attention(
     out = residual + out
     if not pre_layer_norm:
         out = F.layer_norm(out, (M,), ln_scale, ln_bias, ln_epsilon)
+    if cache_kv_out is not None:
+        return out, cache_kv_out
     return out
 
 
@@ -114,9 +126,16 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             ang = pos * inv[None, :]
             s, c = jnp.sin(ang), jnp.cos(ang)            # [S, D/2]
         else:
-            # sin/cos given as [1, S, 1, D] (reference layout): take pairs
-            s = sin.reshape(sin.shape[1], -1)[:, ::2]
-            c = cos.reshape(cos.shape[1], -1)[:, ::2]
+            # sin/cos given as [1, S, 1, D] (reference layout).  Recover the
+            # D/2 base frequencies per the style's duplication scheme:
+            # neox concatenates halves [f0..f_{D/2-1}, f0..f_{D/2-1}];
+            # interleaved ("GPT-J") repeats pairwise [f0,f0,f1,f1,...].
+            s2 = sin.reshape(sin.shape[1], -1)
+            c2 = cos.reshape(cos.shape[1], -1)
+            if use_neox_rotary_style:
+                s, c = s2[:, : D // 2], c2[:, : D // 2]
+            else:
+                s, c = s2[:, ::2], c2[:, ::2]
         if position_ids is not None:
             s = s[position_ids]                          # [B,S,D/2]
             c = c[position_ids]

@@ -260,7 +260,30 @@ class FusedMultiTransformer(Layer):
         return [jnp.zeros((2, batch, self.num_heads, max_seq, self.head_dim),
                           dtype) for _ in range(self.num_layers)]
 
-    def _layer(self, i, x, attn_mask, cache, time_step):
+    @staticmethod
+    def _apply_rotary(q, k, rotary_embs, time_step):
+        """rotary_embs [2, B, 1, S_max, D] = (cos, sin), reference layout.
+        Neox-style rotation x*cos + rotate_half(x)*sin at the positions the
+        current q/k occupy (0..S-1 at prefill, time_step at decode)."""
+        cos = jnp.swapaxes(rotary_embs[0], 1, 2)   # [B, S_max, 1, D]
+        sin = jnp.swapaxes(rotary_embs[1], 1, 2)
+        S = q.shape[1]
+        if time_step is None:
+            cos, sin = cos[:, :S], sin[:, :S]
+        else:
+            t = jnp.asarray(time_step, jnp.int32)
+            cos = jax.lax.dynamic_slice_in_dim(cos, t, S, axis=1)
+            sin = jax.lax.dynamic_slice_in_dim(sin, t, S, axis=1)
+
+        def rot(x):
+            D = x.shape[-1]
+            x1, x2 = x[..., : D // 2], x[..., D // 2:]
+            half = jnp.concatenate([-x2, x1], axis=-1)
+            return x * cos + half * sin
+
+        return rot(q), rot(k)
+
+    def _layer(self, i, x, attn_mask, cache, time_step, rotary_embs=None):
         p = self._parameters
         M = self.embed_dim
         residual = x
@@ -272,6 +295,8 @@ class FusedMultiTransformer(Layer):
             qkv = jnp.einsum("bsm,mthd->bsthd", h, p[f"qkv_weight_{i}"])
         qkv = qkv + p[f"qkv_bias_{i}"]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [B,S,H,D]
+        if rotary_embs is not None:
+            q, k = self._apply_rotary(q, k, rotary_embs, time_step)
         new_cache = None
         if cache is not None:
             # cache layout [2, B, H, T, D]
@@ -304,10 +329,16 @@ class FusedMultiTransformer(Layer):
             new_cache = jnp.stack([kc, vc], axis=0)
         else:
             att_k, att_v = k, v
-        causal = cache is None or time_step is None
+        prefill = time_step is None
+        if prefill and attn_mask is not None:
+            # the stack is causal by construction; a user/seq_lens mask adds
+            # padding on top of (not instead of) causality
+            Sq, Sk = q.shape[1], att_k.shape[1]
+            cmask = jnp.where(jnp.tril(jnp.ones((Sq, Sk), bool)), 0.0, -1e9)
+            attn_mask = attn_mask + cmask[None, None]
         out = F.scaled_dot_product_attention(
             q, att_k, att_v, attn_mask=attn_mask,
-            is_causal=causal and attn_mask is None, training=self.training)
+            is_causal=prefill and attn_mask is None, training=self.training)
         out = out.reshape(*out.shape[:2], M)
         out = F.linear(out, p[f"linear_weight_{i}"], p[f"linear_bias_{i}"])
         out = F.dropout(out, self.dropout_rate, training=self.training)
@@ -325,11 +356,25 @@ class FusedMultiTransformer(Layer):
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 rotary_embs=None, rotary_emb_dims: int = 0, seq_lens=None,
                 time_step=None):
+        if pre_caches is not None:
+            raise NotImplementedError(
+                "pre_caches (prefix caching) is not supported; prefill with "
+                "caches= instead")
+        if seq_lens is not None:
+            # per-sequence valid lengths -> additive padding mask over keys
+            T = src.shape[1] if time_step is None else None
+            if T is not None:
+                pos = jnp.arange(T)
+                pad = (pos[None, :] >= jnp.asarray(seq_lens)[:, None])
+                pmask = jnp.where(pad, -1e9, 0.0)[:, None, None, :]
+                attn_mask = pmask if attn_mask is None else attn_mask + pmask
+            # decode path: the time_step length-mask already bounds keys
         x = src
         new_caches = [] if caches is not None else None
         for i in range(self.num_layers):
             cache_i = caches[i] if caches is not None else None
-            x, nc = self._layer(i, x, attn_mask, cache_i, time_step)
+            x, nc = self._layer(i, x, attn_mask, cache_i, time_step,
+                                rotary_embs=rotary_embs)
             if new_caches is not None:
                 new_caches.append(nc)
         if caches is not None:

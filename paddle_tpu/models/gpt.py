@@ -60,6 +60,9 @@ class GPTConfig:
     # parallel/runtime knobs
     sp: bool = False          # sequence-parallel activations between blocks
     remat: bool = True        # jax.checkpoint per block
+    # context parallelism over the sep mesh axis: None | "ring" | "ulysses"
+    # (reference: sep_degree in hybrid_configs; ring attn from PaddleNLP)
+    cp: "str | None" = None
 
     @property
     def head_dim(self):
@@ -118,6 +121,14 @@ class GPTBlock(Layer):
             mask = (kpos[None, None, None, :] <= (pos + s - 1))
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=mask, training=self.training)
+        elif cfg.cp:
+            # long-context: sequence sharded over the sep axis; ring or
+            # Ulysses attention instead of local sdpa (attn dropout is not
+            # supported across the ring, matching the ring-flash reference)
+            from ..distributed.meta_parallel.context_parallel import (
+                ring_attention, ulysses_attention)
+            attn = {"ring": ring_attention, "ulysses": ulysses_attention}[cfg.cp]
+            out = attn(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  dropout_p=cfg.attn_dropout,

@@ -135,7 +135,9 @@ class GPTHybridTrainer:
         pos = jnp.arange(ids.shape[1])[None, :]
         x = jnp.take(pnb["gpt.wte.weight"], ids.astype(jnp.int32), axis=0) + \
             jnp.take(pnb["gpt.wpe.weight"], pos, axis=0)
-        return _maybe_constraint(x, P(None, None, None))
+        # context parallel: activations ride the sep axis on the seq dim
+        seq_axis = "sep" if cfg.cp else None
+        return _maybe_constraint(x, P(None, seq_axis, None))
 
     def _final(self, pnb, x):
         cfg = self.cfg
@@ -206,7 +208,8 @@ class GPTHybridTrainer:
         ids = rng.randint(0, self.cfg.vocab_size, (batch, seq + 1))
         x = jnp.asarray(ids[:, :-1])
         y = jnp.asarray(ids[:, 1:])
-        bs = NamedSharding(self.mesh, P(self.batch_spec()[0]))
+        seq_axis = "sep" if self.cfg.cp else None
+        bs = NamedSharding(self.mesh, P(self.batch_spec()[0], seq_axis))
         return jax.device_put(x, bs), jax.device_put(y, bs)
 
     def train_step(self, state_tuple, ids, labels):

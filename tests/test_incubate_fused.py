@@ -178,3 +178,16 @@ def test_fused_matmul_bias_batched_transpose():
     out = IF.fused_matmul_bias(x, y, transpose_x=True)
     ref = jnp.einsum("bsi,bsj->bij", x, y)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_fused_mt_seq_lens_keeps_causality():
+    """seq_lens padding must ADD to causality, not replace it (regression:
+    passing any mask at prefill used to disable the causal mask)."""
+    paddle_tpu.seed(1)
+    m = FusedMultiTransformer(16, 4, 32, dropout_rate=0.0, num_layers=1)
+    m.eval()
+    x = rand(2, 6, 16, seed=5)
+    causal_only = m(x)
+    with_lens = m(x, seq_lens=jnp.asarray([6, 6]))  # no actual padding
+    np.testing.assert_allclose(np.asarray(with_lens),
+                               np.asarray(causal_only), rtol=1e-5, atol=1e-6)

@@ -173,3 +173,52 @@ def test_moe_expert_parallel_matches_serial(gate_type):
         x_sh = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
         parallel = np.asarray(run(params, x_sh))
     np.testing.assert_allclose(parallel, serial, rtol=2e-4, atol=2e-5)
+
+
+def test_limit_by_capacity_multi_worker():
+    # 2 workers x 3 experts; capacity per expert shared across workers
+    counts = np.array([[3, 1, 4], [2, 5, 1]])
+    out = np.asarray(limit_by_capacity(counts, np.array([4, 4, 4]),
+                                       n_worker=2))
+    np.testing.assert_array_equal(out, [[3, 1, 4], [1, 3, 0]])
+    flat = np.asarray(limit_by_capacity(counts.reshape(-1),
+                                        np.array([4, 4, 4]), n_worker=2))
+    np.testing.assert_array_equal(flat, [3, 1, 4, 1, 3, 0])
+
+
+def test_prune_gate_by_capacity_n_worker_positional():
+    # reference call shape: (gate_idx, expert_count, n_expert, n_worker)
+    gate_idx = np.array([0, 2, 2, 1, 0])
+    out = np.asarray(prune_gate_by_capacity(gate_idx, np.array([1, 1, 1, 0]),
+                                            2, 2))
+    np.testing.assert_array_equal(out, [0, 2, -1, 1, -1])
+
+
+def test_gate_aux_loss_functional_under_jit():
+    """Aux loss crosses the jit boundary via the buffer pytree (no tracer
+    leak)."""
+    import paddle_tpu
+    from paddle_tpu.nn.functional_call import state, functional_call
+    paddle_tpu.seed(0)
+    moe = _make_moe(topk=2)
+    moe.gate = GShardGate(16, 4, random_routing=False)
+    moe.train()
+    params, buffers = state(moe)
+    x = jnp.asarray(np.random.RandomState(0).randn(10, 16).astype(np.float32))
+
+    @jax.jit
+    def run(p, b):
+        out, nb = functional_call(moe, p, b, (x,), train=True)
+        return out, nb["gate.aux_loss"]
+
+    _, aux = run(params, buffers)
+    assert float(aux) > 0.0
+
+
+def test_switch_gate_traced_without_rng_raises():
+    gate = SwitchGate(8, 4)
+    gate.train()
+    x = jnp.ones((4, 8))
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="RNG context"):
+        jax.jit(lambda v: gate(v))(x)
