@@ -18,6 +18,17 @@ from . import sharding_utils  # noqa: F401
 from . import pipelining  # noqa: F401
 
 
+# semi-auto parallel symbols re-exported at top level (reference:
+# paddle.distributed.shard_tensor / ProcessMesh / Shard / ... from
+# auto_parallel/api.py)
+_AUTO_PARALLEL_NAMES = (
+    "ProcessMesh", "Shard", "Replicate", "Partial", "Placement",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "unshard_dtensor", "get_placements",
+    "shard_dataloader", "to_static", "DistModel", "Engine",
+)
+
+
 def __getattr__(name):
     # lazy heavy submodules
     if name in ("auto_parallel", "checkpoint", "launch", "sharding", "moe",
@@ -26,4 +37,9 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in _AUTO_PARALLEL_NAMES:
+        from . import auto_parallel as _ap
+        val = getattr(_ap, name)
+        globals()[name] = val
+        return val
     raise AttributeError(name)

@@ -1,0 +1,37 @@
+"""Semi-auto parallel API (paddle.distributed semi-auto surface).
+
+Reference: python/paddle/distributed/auto_parallel/ — api.py (shard_tensor,
+reshard, shard_layer, to_static), process_mesh.py (ProcessMesh),
+placement_type.py (Shard/Replicate/Partial), static/engine.py (Engine)
+(SURVEY.md §2.3 "Semi-auto parallel", §3.4 call stack).
+
+TPU-native design (SURVEY.md §7 step 6): the reference reimplements SPMD
+propagation + partitioning + reshard insertion over its own IR (~80k LoC);
+on JAX, GSPMD already does all three inside XLA.  What remains is the thin
+user surface: placements -> NamedSharding, shard_tensor == device_put,
+reshard == device_put (+ psum for Partial), Engine == pjit'd train step.
+The SPMD rule *planner* (spmd_rules.py) is kept as pure shape logic so the
+reference's rule unit tests (test/auto_parallel/spmd_rules/) have a parity
+target.
+"""
+
+from .placement import (ProcessMesh, Placement, Shard, Replicate, Partial,
+                        compute_placements_spec, placements_to_spec)
+from .api import (shard_tensor, dtensor_from_fn, reshard, shard_layer,
+                  shard_optimizer, unshard_dtensor, get_placements,
+                  shard_dataloader)
+from .spmd_rules import (DistTensorSpec, matmul_spmd, elementwise_spmd,
+                         reduction_spmd, embedding_spmd, softmax_spmd,
+                         transpose_spmd, split_spmd)
+from .engine import Engine, to_static, DistModel
+from .strategy import Strategy
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "unshard_dtensor", "get_placements", "shard_dataloader",
+    "DistTensorSpec", "matmul_spmd", "elementwise_spmd", "reduction_spmd",
+    "embedding_spmd", "softmax_spmd", "transpose_spmd", "split_spmd",
+    "Engine", "to_static", "DistModel", "Strategy",
+    "compute_placements_spec", "placements_to_spec",
+]

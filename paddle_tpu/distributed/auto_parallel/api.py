@@ -1,0 +1,281 @@
+"""Semi-auto parallel eager API.
+
+Reference: python/paddle/distributed/auto_parallel/api.py — shard_tensor,
+dtensor_from_fn, reshard, shard_layer, shard_optimizer, unshard_dtensor
+(SURVEY.md §3.4 call stack).  There, shard_tensor builds a C++ DistTensor
+(local shard + TensorDistAttr) and every eager op consults SPMD rules +
+reshard functions.
+
+TPU-native: a "DistTensor" IS a jax.Array with a NamedSharding —
+shard_tensor is one ``jax.device_put`` and op-level propagation/reshard is
+XLA GSPMD's job.  Only ``Partial`` needs framework help (NamedSharding has
+no partial state): we track it in a WeakValueDictionary and materialise the
+pending reduction as a shard_map psum when resharding, mirroring the
+reference's PToRReshardFunction / PToSReshardFunction
+(paddle/phi/core/distributed/auto_parallel/reshard/).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .placement import (ProcessMesh, Placement, Shard, Replicate, Partial,
+                        compute_placements_spec, placements_to_spec)
+
+__all__ = ["shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "shard_optimizer", "unshard_dtensor", "get_placements",
+           "shard_dataloader"]
+
+# id(array) -> (weakref(array), mesh, placements) for arrays carrying Partial
+#
+# LIMITATION (documented, deliberate): partial-ness rides on the *exact
+# array object* returned by shard_tensor/reshard.  Deriving a new array
+# from a partial one (y = d * 2) drops the pending reduction — reshard(y)
+# will NOT re-sum.  The reference avoids this by subclassing Tensor
+# (DistTensor carries dist_attr through every op); JAX arrays cannot be
+# subclassed, so Partial tensors are strictly create->reshard/unshard
+# handles.  Inside jit, partial values never exist at the API boundary:
+# GSPMD inserts the reduction itself (see matmul test).
+_partial_registry: dict = {}
+
+
+def _register_partial(x, mesh: ProcessMesh, placements: List[Placement]):
+    ref = weakref.ref(x, lambda _, k=id(x): _partial_registry.pop(k, None))
+    _partial_registry[id(x)] = (ref, mesh, placements)
+
+
+def _lookup_partial(x):
+    ent = _partial_registry.get(id(x))
+    if ent is None or ent[0]() is not x:
+        return None
+    return ent[1], ent[2]
+
+
+def get_placements(x, mesh: Optional[ProcessMesh] = None) -> List[Placement]:
+    """Recover the placements of a dist tensor (reference:
+    Tensor.placements).  Partial beats sharding-derived info."""
+    ent = _lookup_partial(x)
+    if ent is not None:
+        return list(ent[1])
+    if not isinstance(getattr(x, "sharding", None), NamedSharding):
+        raise ValueError("not a dist tensor (no NamedSharding)")
+    ns: NamedSharding = x.sharding
+    names = list(ns.mesh.axis_names)
+    placements: List[Placement] = [Replicate() for _ in names]
+    for tdim, entry in enumerate(ns.spec):
+        if entry is None:
+            continue
+        for name in (entry if isinstance(entry, tuple) else (entry,)):
+            placements[names.index(name)] = Shard(tdim)
+    return placements
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, stop_gradient: Optional[bool] = None):
+    """Place ``data`` on ``mesh`` with ``placements``.
+
+    Reference: auto_parallel/api.py — shard_tensor.  Partial placements
+    split the value so shards re-sum to the original (sum) or replicate it
+    (max/min), matching DistTensor partial semantics.
+    """
+    x = jnp.asarray(data, dtype=dtype)
+    sharding, placements = compute_placements_spec(x.ndim, mesh, placements)
+    partial_dims = [i for i, p in enumerate(placements) if p.is_partial()]
+    if partial_dims:
+        n = int(np.prod([mesh.shape[i] for i in partial_dims]))
+        rt = next(p.reduce_type for p in placements if p.is_partial())
+        if rt in ("sum", "avg"):
+            x = x / n
+        out = jax.device_put(x, sharding)
+        _register_partial(out, mesh, list(placements))
+        return out
+    return jax.device_put(x, sharding)
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs):
+    """Build a dist tensor from a creation fn (reference: dtensor_from_fn).
+    The fn runs under jit with output sharding constrained, so each shard
+    is materialised directly (no full-size host array)."""
+    sample = jax.eval_shape(lambda: fn(*args, **kwargs))
+    sharding, placements = compute_placements_spec(len(sample.shape), mesh,
+                                                   placements)
+    if any(p.is_partial() for p in placements):
+        raise ValueError("dtensor_from_fn does not accept Partial placements")
+    return jax.jit(lambda: fn(*args, **kwargs), out_shardings=sharding)()
+
+
+def _psum_partial(x, mesh: ProcessMesh, placements: List[Placement]):
+    """Materialise pending partial reductions (reference:
+    PToRReshardFunction — inserts allreduce).  Runs a shard_map reduction
+    over the partial mesh axes; the result is Replicate on those axes."""
+    from jax import shard_map
+
+    jm = mesh.get_mesh()
+    names = jm.axis_names
+    partial_axes = tuple(names[i] for i, p in enumerate(placements)
+                         if p.is_partial())
+    rt = next(p.reduce_type for p in placements if p.is_partial())
+    in_spec = placements_to_spec(placements, x.ndim, names)
+
+    def local(v):
+        if rt in ("sum", "avg"):
+            return jax.lax.psum(v, partial_axes)
+        if rt == "max":
+            return jax.lax.pmax(v, partial_axes)
+        if rt == "min":
+            return jax.lax.pmin(v, partial_axes)
+        raise ValueError(f"unknown reduce_type {rt!r}")
+
+    out = jax.jit(shard_map(local, mesh=jm, in_specs=(in_spec,),
+                            out_specs=in_spec))(x)
+    new_placements = [Replicate() if p.is_partial() else p for p in placements]
+    return out, new_placements
+
+
+def reshard(x, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Change a dist tensor's placements (reference: dist.reshard →
+    ReshardFunction dispatch: SToR/RToS/PToR/SameStatus...).
+
+    On JAX every S<->R transition is one device_put (XLA emits the
+    all-gather / slice); only Partial needs an explicit reduction first.
+    """
+    ent = _lookup_partial(x)
+    if ent is not None:
+        src_mesh, src_placements = ent
+        x, _ = _psum_partial(x, src_mesh, src_placements)
+    sharding, placements = compute_placements_spec(x.ndim, mesh, placements)
+    if any(p.is_partial() for p in placements):
+        # R -> P: split the value so shards re-reduce to the original —
+        # divide for sum/avg, replicate for max/min (matching shard_tensor).
+        partial_axes = [i for i, p in enumerate(placements) if p.is_partial()]
+        n = int(np.prod([mesh.shape[i] for i in partial_axes]))
+        rt = next(p.reduce_type for p in placements if p.is_partial())
+        if rt in ("sum", "avg"):
+            x = x / n
+        out = jax.device_put(x, sharding)
+        _register_partial(out, mesh, list(placements))
+        return out
+    return jax.device_put(x, sharding)
+
+
+def unshard_dtensor(x):
+    """Gather to a fully-replicated array (reference: unshard_dtensor)."""
+    ent = _lookup_partial(x)
+    if ent is not None:
+        mesh, placements = ent
+        x, _ = _psum_partial(x, mesh, placements)
+    if isinstance(getattr(x, "sharding", None), NamedSharding):
+        ns = x.sharding
+        return jax.device_put(x, NamedSharding(ns.mesh, P()))
+    return x
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard a Layer's parameters in place (reference: dist.shard_layer).
+
+    ``shard_fn(sublayer_name, sublayer, process_mesh)`` mutates the
+    sublayer's params via shard_tensor; default replicates everything.
+    input_fn/output_fn are registered as forward pre/post hooks, matching
+    the reference's semantics of resharding activations at the boundary.
+    """
+    def default_shard_fn(name, sub, mesh):
+        for pname, p in list(sub._parameters.items()):
+            if p is not None:
+                sub._parameters[pname] = shard_tensor(
+                    p, mesh, [Replicate()] * mesh.ndim)
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
+    """Make optimizer slot states inherit each parameter's sharding
+    (reference: dist.shard_optimizer — wraps _create_accumulators).
+
+    JAX-native: slots are created by tree-mapping over params, so they
+    already inherit shardings structurally; this wrapper additionally
+    applies ``shard_fn(slot_name, param, slot) -> sharded slot`` (e.g. for
+    ZeRO-style opt-state sharding that differs from the param sharding).
+    """
+    if shard_fn is None:
+        return optimizer
+    orig_init = optimizer.init
+
+    def init(params):
+        st = orig_init(params)
+        if isinstance(st, dict) and "slots" in st:
+            # per-param slot groups are nested tuples/dicts; hand shard_fn
+            # the real slot key (e.g. 'moment1') via the tree path
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_s = treedef.flatten_up_to(st["slots"])
+
+            def path_name(path):
+                return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                                for k in path) or "slot"
+
+            new_s = []
+            for p, slots in zip(flat_p, flat_s):
+                new_s.append(jax.tree_util.tree_map_with_path(
+                    lambda path, s, pp=p: shard_fn(path_name(path), pp, s),
+                    slots))
+            st["slots"] = treedef.unflatten(new_s)
+        if isinstance(st, dict) and "master" in st:
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_m = treedef.flatten_up_to(st["master"])
+            st["master"] = treedef.unflatten(
+                [shard_fn("master", p, m) if m is not None else None
+                 for p, m in zip(flat_p, flat_m)])
+        return st
+
+    optimizer.init = init
+    return optimizer
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """Wrap a DataLoader so each batch is placed on the mesh sharded along
+    ``shard_dims`` (reference: dist.shard_dataloader)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    dim = shard_dims if isinstance(shard_dims, str) else (
+        shard_dims[0] if shard_dims else mesh.dim_names[0])
+
+    class _ShardedLoader:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __len__(self):
+            return len(self._dl)
+
+        def __iter__(self):
+            axis = mesh.dim_names.index(dim)
+            for batch in self._dl:
+                def place(x):
+                    x = jnp.asarray(x)
+                    pl = [Replicate()] * mesh.ndim
+                    pl[axis] = Shard(0)
+                    return shard_tensor(x, mesh, pl)
+                if isinstance(batch, dict):
+                    yield {k: place(v) for k, v in batch.items()}
+                elif isinstance(batch, (list, tuple)):
+                    yield type(batch)(place(v) for v in batch)
+                else:
+                    yield place(batch)
+
+    return _ShardedLoader(dataloader)

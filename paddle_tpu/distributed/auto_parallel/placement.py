@@ -1,0 +1,230 @@
+"""ProcessMesh and placement types.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py —
+ProcessMesh; placement_type.py — Shard, Replicate, Partial (SURVEY.md §2.3
+"Semi-auto parallel", §3.4: ``dist.ProcessMesh([[0,1],[2,3]],
+dim_names=["dp","mp"])``).
+
+TPU-native: a ProcessMesh is a named view over ``jax.devices()`` that
+lowers to ``jax.sharding.Mesh``; a placements list (one entry per MESH dim,
+paddle convention) lowers to a ``PartitionSpec`` (one entry per TENSOR
+dim).  ``Partial`` has no NamedSharding encoding — partial-ness is carried
+out-of-band by api.py's registry and materialised as a psum on reshard,
+mirroring how the reference's reshard P->R rule inserts an allreduce
+(paddle/phi/core/distributed/auto_parallel/reshard/ — PToRReshardFunction).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "placements_to_spec", "compute_placements_spec"]
+
+
+class Placement:
+    """Base placement type (reference: placement_type.py — Placement)."""
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim ``dim`` is split across this mesh dimension."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Each shard holds a partial reduction; reduce on reshard.
+
+    reduce_type: 'sum' | 'avg' | 'max' | 'min' (reference ReduceType).
+    """
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type.lower()
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """An n-D array of process/device ranks with named dimensions.
+
+    Reference: process_mesh.py — ProcessMesh(mesh, dim_names).  Ranks index
+    into ``jax.devices()``; ``get_mesh()`` materialises the corresponding
+    ``jax.sharding.Mesh`` (cached).
+    """
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[Sequence[str]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- reference-parity accessors ------------------------------------
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(r) for r in self._mesh.flatten()]
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name: str, process_id: int) -> int:
+        coords = np.argwhere(self._mesh == process_id)
+        if len(coords) == 0:
+            return -1
+        return int(coords[0][self._dim_names.index(dim_name)])
+
+    def get_submesh(self, dim_name: str, index: int) -> "ProcessMesh":
+        axis = self._dim_names.index(dim_name)
+        sub = np.take(self._mesh, index, axis=axis)
+        names = [n for n in self._dim_names if n != dim_name]
+        return ProcessMesh(sub, names)
+
+    # -- lowering -------------------------------------------------------
+    def get_mesh(self) -> Mesh:
+        """Lower to jax.sharding.Mesh over the referenced devices."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if self.size > len(devices):
+                raise RuntimeError(
+                    f"ProcessMesh needs {self.size} devices, only "
+                    f"{len(devices)} visible")
+            dev = np.asarray(devices, dtype=object)[self._mesh.reshape(-1)]
+            self._jax_mesh = Mesh(dev.reshape(self._mesh.shape),
+                                  tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+
+def placements_to_spec(placements: Sequence[Placement], ndim: int,
+                       dim_names: Sequence[str]) -> P:
+    """Convert a per-MESH-dim placements list to a per-TENSOR-dim
+    PartitionSpec.
+
+    Paddle convention: ``placements[i]`` describes how the tensor relates
+    to mesh dimension ``i``.  Multiple mesh dims sharding the same tensor
+    dim become a tuple entry (mesh-dim order preserved — matches GSPMD
+    major-to-minor tiling).
+    """
+    entries: List[list] = [[] for _ in range(ndim)]
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim if pl.dim >= 0 else pl.dim + ndim
+            if not (0 <= d < ndim):
+                raise ValueError(f"Shard dim {pl.dim} out of range for ndim {ndim}")
+            entries[d].append(dim_names[mesh_dim])
+    spec = []
+    for names in entries:
+        if not names:
+            spec.append(None)
+        elif len(names) == 1:
+            spec.append(names[0])
+        else:
+            spec.append(tuple(names))
+    return P(*spec)
+
+
+def compute_placements_spec(x_ndim: int, mesh: ProcessMesh,
+                            placements: Sequence[Placement]
+                            ) -> Tuple[NamedSharding, List[Placement]]:
+    """Validate placements against mesh, return (NamedSharding, normalized
+    placements).  Partial entries are treated as Replicate in the sharding
+    (caller tracks partial-ness separately)."""
+    placements = list(placements)
+    if len(placements) < mesh.ndim:
+        placements += [Replicate()] * (mesh.ndim - len(placements))
+    if len(placements) != mesh.ndim:
+        raise ValueError(
+            f"{len(placements)} placements for mesh with {mesh.ndim} dims")
+    spec = placements_to_spec(placements, x_ndim, mesh.dim_names)
+    return NamedSharding(mesh.get_mesh(), spec), placements
