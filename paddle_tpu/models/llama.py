@@ -1,0 +1,269 @@
+"""Llama family — the semi-auto-parallel flagship (BASELINE config #4).
+
+Reference model surface: the semi-auto Llama used by
+test/auto_parallel/hybrid_strategy/ (semi-auto Llama-2 tests, SURVEY.md §4)
+and PaddleNLP's LlamaForCausalLM: RMSNorm, rotary position embeddings,
+grouped-query attention, SwiGLU MLP, no biases, untied lm_head.
+
+TPU-native design: the model is written as plain Layers (no hand-rolled
+parallel layers) and parallelised the semi-auto way —
+``llama_shard_fn(mesh)`` places weights via dist.shard_tensor and GSPMD
+partitions the jitted step (SURVEY.md §3.4; the reference path
+dist.shard_tensor -> DistTensor -> SPMD rules + reshard is all inside XLA
+here).  For the hand-written hybrid path, GPT (models/gpt.py) is the
+flagship; Llama is the auto-parallel one, mirroring how the reference
+splits its two baselines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import RMSNorm
+
+__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
+           "LlamaModel", "LlamaForCausalLM", "llama_shard_fn", "llama_tiny",
+           "llama_7b"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None      # None -> MHA; < num_heads -> GQA
+    max_seq_len: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    dtype: str = "float32"
+    remat: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, l, v = self.hidden_size, self.num_layers, self.vocab_size
+        kvh = self.kv_heads * self.head_dim
+        attn = h * h + 2 * h * kvh + h * h          # q, k, v, o
+        mlp = 3 * h * self.intermediate_size        # gate, up, down
+        norms = 2 * h
+        return 2 * v * h + l * (attn + mlp + norms) + h
+
+
+def _rope_tables(positions, head_dim: int, theta: float, dtype):
+    """cos/sin tables [*, head_dim/2] for the given positions."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv  # [..., d/2]
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rotary_pos_emb(x, cos, sin):
+    """x [b, s, heads, d]; cos/sin [s, d/2] (or broadcastable).  Llama
+    pairing: (x1, x2) = halves (reference fused_rope neox-style)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, d = cfg.hidden_size, cfg.head_dim
+        self.q_proj = Linear(h, cfg.num_heads * d, bias_attr=False)
+        self.k_proj = Linear(h, cfg.kv_heads * d, bias_attr=False)
+        self.v_proj = Linear(h, cfg.kv_heads * d, bias_attr=False)
+        self.o_proj = Linear(cfg.num_heads * d, h, bias_attr=False)
+
+    def forward(self, x, cos, sin, cache=None):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        q = apply_rotary_pos_emb(q, cos, sin)
+        k = apply_rotary_pos_emb(k, cos, sin)
+        new_cache = None
+        if cache is not None:
+            pk, pv, pos = cache
+            k = jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1)
+            new_cache = (k, v, pos + s)
+        # GQA: repeat kv heads up to q heads (XLA turns this into a
+        # broadcast inside the attention einsum — no real copy)
+        rep = cfg.num_heads // k.shape[2]
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if cache is not None:
+            kpos = jnp.arange(k.shape[1])
+            mask = (kpos[None, None, None, :] <= (cache[2] + s - 1))
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                                 training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 training=self.training)
+        out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
+        return self.o_proj(out), new_cache
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        self.gate_proj = Linear(h, m, bias_attr=False)
+        self.up_proj = Linear(h, m, bias_attr=False)
+        self.down_proj = Linear(m, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.input_layernorm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, x, cos, sin, cache=None):
+        a, new_cache = self.self_attn(self.input_layernorm(x), cos, sin, cache)
+        x = x + self.drop(a)
+        x = x + self.drop(self.mlp(self.post_attention_layernorm(x)))
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, caches=None, position_offset: int = 0):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        x = self.embed_tokens(input_ids)
+        pos = jnp.arange(position_offset, position_offset + s)
+        cos, sin = _rope_tables(pos, cfg.head_dim, cfg.rope_theta, x.dtype)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if caches is None:
+                if cfg.remat:
+                    x = jax.checkpoint(
+                        lambda x_, lyr=layer: lyr(x_, cos, sin))(x)
+                else:
+                    x = layer(x, cos, sin)
+            else:
+                x, c = layer(x, cos, sin, caches[i])
+                new_caches.append(c)
+        x = self.norm(x)
+        return x if caches is None else (x, new_caches)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.llama = LlamaModel(cfg)
+        self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids):
+        return self.lm_head(self.llama(input_ids))
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(tok)
+
+    # ---- incremental decode -------------------------------------------
+    def init_cache(self, batch: int, max_len: int, dtype=None):
+        cfg = self.cfg
+        dt = jnp.dtype(dtype or cfg.dtype)
+        return [(jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim), dt),
+                 jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim), dt),
+                 jnp.asarray(0, jnp.int32)) for _ in range(cfg.num_layers)]
+
+    def decode_step(self, input_ids, caches, position: int):
+        hidden, new_caches = self.llama(input_ids, caches,
+                                        position_offset=position)
+        return self.lm_head(hidden), new_caches
+
+
+# ---------------------------------------------------------------------------
+# semi-auto sharding plan (reference: the hybrid_strategy llama tests call
+# dist.shard_tensor on q/k/v/o and gate/up/down with [Replicate, Shard(...)])
+# ---------------------------------------------------------------------------
+
+def llama_shard_fn(mesh, dp_axis: str = "dp", mp_axis: str = "mp"):
+    """Build a shard_fn for dist.shard_layer: Megatron-style TP placement
+    over ``mp_axis``; everything else replicated (dp comes from the batch).
+    """
+    from ..distributed.auto_parallel import shard_tensor, Shard, Replicate
+
+    mp_dim = mesh.dim_names.index(mp_axis)
+
+    def place(sub, pname, tensor_dim):
+        p = sub._parameters.get(pname)
+        if p is None:
+            return
+        pl = [Replicate()] * mesh.ndim
+        pl[mp_dim] = Shard(tensor_dim)
+        sub._parameters[pname] = shard_tensor(p, mesh, pl)
+
+    def shard_fn(name, sub, m):
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"):
+            place(sub, "weight", 1)   # column parallel: [h, out/mp]
+        elif leaf in ("o_proj", "down_proj"):
+            place(sub, "weight", 0)   # row parallel: [in/mp, h]
+        elif leaf == "embed_tokens":
+            place(sub, "weight", 1)   # hidden-sharded embedding
+        elif leaf == "lm_head":
+            place(sub, "weight", 1)   # vocab-parallel logits
+
+    return shard_fn
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    return LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=176,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       max_seq_len=128, **kw)
+
+
+def llama_7b(**kw) -> LlamaConfig:
+    # Llama-2-7B: 32 layers, 4096 hidden, 11008 ffn, 32 heads, MHA
+    return LlamaConfig(vocab_size=32000, hidden_size=4096,
+                       intermediate_size=11008, num_layers=32, num_heads=32,
+                       max_seq_len=4096, dtype="bfloat16", **kw)

@@ -1,0 +1,163 @@
+"""Llama family tests: numerics, GQA, RoPE, decode-cache parity, and the
+semi-auto-parallel path (BASELINE #4) — distributed step == serial step,
+the reference's core oracle (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM, llama_tiny,
+                               llama_shard_fn, llama_7b)
+from paddle_tpu.models.llama import apply_rotary_pos_emb, _rope_tables
+from paddle_tpu.nn.functional_call import functional_call, state
+
+
+def test_rope_rotation_properties():
+    # rotating by position 0 is identity
+    x = np.random.RandomState(0).randn(2, 3, 4, 8).astype(np.float32)
+    cos, sin = _rope_tables(jnp.zeros((3,)), 8, 10000.0, jnp.float32)
+    out = apply_rotary_pos_emb(jnp.asarray(x), cos, sin)
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-6)
+    # norm-preserving at any position
+    cos, sin = _rope_tables(jnp.arange(3.0) * 7, 8, 10000.0, jnp.float32)
+    out = apply_rotary_pos_emb(jnp.asarray(x), cos, sin)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=1e-5)
+
+
+def test_rope_relative_dot_product():
+    """q.k after RoPE depends only on relative distance."""
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(1, 1, 1, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 1, 1, 16).astype(np.float32))
+
+    def dot_at(pq, pk):
+        cq, sq = _rope_tables(jnp.asarray([float(pq)]), 16, 10000.0, jnp.float32)
+        ck, sk = _rope_tables(jnp.asarray([float(pk)]), 16, 10000.0, jnp.float32)
+        qq = apply_rotary_pos_emb(q, cq, sq)
+        kk = apply_rotary_pos_emb(k, ck, sk)
+        return float(jnp.sum(qq * kk))
+
+    assert abs(dot_at(5, 3) - dot_at(12, 10)) < 1e-4
+
+
+def test_llama_forward_shapes_gqa():
+    paddle_tpu.seed(0)
+    cfg = llama_tiny()
+    assert cfg.kv_heads == 2 and cfg.num_heads == 4
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+    logits = model(ids)
+    assert logits.shape == (2, 16, 256)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_llama_num_params_matches():
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    n = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
+    assert n == cfg.num_params()
+
+
+def test_llama_7b_config_size():
+    # Llama-2-7B ~= 6.74B params
+    n = llama_7b().num_params()
+    assert 6.5e9 < n < 7.0e9, n
+
+
+def test_llama_decode_cache_parity():
+    paddle_tpu.seed(1)
+    cfg = llama_tiny()
+    cfg.dropout = 0.0
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 256, (2, 12)))
+    full = model(ids)
+    caches = model.init_cache(2, 32)
+    outs = []
+    for t in range(12):
+        lg, caches = model.decode_step(ids[:, t:t + 1], caches, t)
+        outs.append(lg)
+    step = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(step), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llama_training_learns():
+    paddle_tpu.seed(3)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    params, buffers = state(model)
+    o = opt.AdamW(learning_rate=3e-3)
+    ostate = o.init(params)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 256, (4, 17)))
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    @jax.jit
+    def step_fn(p, os_):
+        def loss_fn(p):
+            out, _ = functional_call(model, p, buffers, (x,))
+            logp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+            return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
+        l, g = jax.value_and_grad(loss_fn)(p)
+        np_, nos = o.update(g, os_, p)
+        return np_, nos, l
+
+    l0 = None
+    for _ in range(30):
+        params, ostate, l = step_fn(params, ostate)
+        if l0 is None:
+            l0 = float(l)
+    assert float(l) < l0 * 0.5, (l0, float(l))
+
+
+def test_llama_semi_auto_matches_serial():
+    """BASELINE #4 oracle: semi-auto dp x mp step == serial step."""
+    data_batches = []
+    rs = np.random.RandomState(7)
+    for _ in range(4):
+        ids = rs.randint(0, 256, (8, 13)).astype(np.int32)
+        data_batches.append((ids[:, :-1], ids[:, 1:]))
+
+    def xent(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+
+    def run(shard):
+        paddle_tpu.seed(5)
+        cfg = llama_tiny()
+        model = LlamaForCausalLM(cfg)
+        mesh = None
+        if shard:
+            mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                                    dim_names=["dp", "mp"])
+            dist.shard_layer(model, mesh, llama_shard_fn(mesh))
+        eng = dist.Engine(model, loss=xent,
+                          optimizer=opt.SGD(learning_rate=0.1),
+                          process_mesh=mesh)
+        return eng.fit(data_batches, epochs=2)
+
+    serial = run(False)
+    parallel = run(True)
+    np.testing.assert_allclose(serial, parallel, rtol=2e-4, atol=2e-5)
+
+
+def test_llama_semi_auto_param_placement():
+    paddle_tpu.seed(0)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), dim_names=["dp", "mp"])
+    dist.shard_layer(model, mesh, llama_shard_fn(mesh))
+    params = dict(model.named_parameters())
+    qw = params["llama.layers.0.self_attn.q_proj.weight"]
+    ow = params["llama.layers.0.self_attn.o_proj.weight"]
+    assert qw.sharding.spec == P(None, "mp")
+    assert ow.sharding.spec == P("mp", None)
+    gw = params["llama.layers.0.mlp.gate_proj.weight"]
+    assert gw.sharding.spec == P(None, "mp")
