@@ -57,7 +57,7 @@ def _lookup_partial(x):
     return ent[1], ent[2]
 
 
-def get_placements(x, mesh: Optional[ProcessMesh] = None) -> List[Placement]:
+def get_placements(x) -> List[Placement]:
     """Recover the placements of a dist tensor (reference:
     Tensor.placements).  Partial beats sharding-derived info."""
     ent = _lookup_partial(x)
@@ -265,11 +265,15 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
 
         def __iter__(self):
             axis = mesh.dim_names.index(dim)
+            n = mesh.shape[axis]
             for batch in self._dl:
                 def place(x):
                     x = jnp.asarray(x)
                     pl = [Replicate()] * mesh.ndim
-                    pl[axis] = Shard(0)
+                    # final partial batches may not divide the axis; keep
+                    # them replicated rather than crash mid-epoch
+                    if x.ndim and x.shape[0] % n == 0:
+                        pl[axis] = Shard(0)
                     return shard_tensor(x, mesh, pl)
                 if isinstance(batch, dict):
                     yield {k: place(v) for k, v in batch.items()}

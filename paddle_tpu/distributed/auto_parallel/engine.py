@@ -179,25 +179,41 @@ class Engine:
 
     def _write_back(self):
         """Sync trained params/buffers into the user's Layer (the reference
-        keeps model and engine state unified; we re-bind after training)."""
+        keeps model and engine state unified; we re-bind after training).
+        Writes COPIES: the engine's own buffers are donated by the next
+        train step, and the Layer must never alias donated arrays."""
         from ...nn.functional_call import _index_stores, _write
         pindex, bindex = _index_stores(self.model)
-        _write(pindex, self._params, strict=False)
-        _write(bindex, self._buffers, strict=False)
+        _write(pindex, {k: jnp.array(v, copy=True)
+                        for k, v in self._params.items()}, strict=False)
+        _write(bindex, {k: jnp.array(v, copy=True)
+                        for k, v in self._buffers.items()}, strict=False)
 
     def evaluate(self, eval_data, steps: Optional[int] = None):
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         losses = []
+        for m in self.metrics:
+            m.reset()
         for it, batch in enumerate(eval_data):
             if steps is not None and it >= steps:
                 break
             inputs, labels = self._split_batch(batch)
             inputs = self._data_sharding(tuple(jnp.asarray(v) for v in inputs))
             labels = self._data_sharding(tuple(jnp.asarray(v) for v in labels))
-            l, _ = self._eval_step(self._params, self._buffers, inputs, labels)
+            l, out = self._eval_step(self._params, self._buffers, inputs,
+                                     labels)
             losses.append(float(l))
-        return {"loss": float(np.mean(losses)) if losses else 0.0}
+            if labels:
+                for m in self.metrics:
+                    m.update(m.compute(out, labels[0]))
+        result = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self.metrics:
+            n = m.name() if callable(getattr(m, "name", None)) else str(m)
+            if isinstance(n, (list, tuple)):  # paddle Metric.name() -> list
+                n = n[0]
+            result[n] = m.accumulate()
+        return result
 
     def predict(self, data, steps: Optional[int] = None):
         if self._pred_step is None:

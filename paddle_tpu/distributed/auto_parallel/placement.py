@@ -158,6 +158,9 @@ class ProcessMesh:
         axis = self._dim_names.index(dim_name)
         sub = np.take(self._mesh, index, axis=axis)
         names = [n for n in self._dim_names if n != dim_name]
+        if sub.ndim == 0:  # 1-D mesh -> single-rank submesh
+            sub = sub.reshape(1)
+            names = ["r"]
         return ProcessMesh(sub, names)
 
     # -- lowering -------------------------------------------------------

@@ -125,9 +125,14 @@ def matmul_spmd(x: DistTensorSpec, y: DistTensorSpec,
     m_dim, n_dim = xm[-2], ym[-1]
     out = batch + [m_dim, n_dim]
     _dedup([out])
-    m_dim, n_dim = out[-2], out[-1]
-    partial = [k] if k != -1 and k not in (m_dim, n_dim) else []
-    # write aligned mappings back through any transposes
+    batch, (m_dim, n_dim) = out[:-2], out[-2:]
+    # the contracted mesh dim must not also shard a batch/M/N dim — that
+    # would put one mesh dim on two tensor dims of the same input; force
+    # the contraction replicated on conflict
+    if k != -1 and k in out:
+        k = -1
+    partial = [k] if k != -1 else []
+    # write aligned (deduped) mappings back through any transposes
     nxm = batch[nb - len(xb):] + [m_dim, k]
     nym = batch[nb - len(yb):] + [k, n_dim]
     if trans_x:

@@ -156,7 +156,17 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
                    begin_norm_axis: int = -1, name=None):
-    """Reference: rms_norm fused op (PaddleNLP/incubate)."""
+    """Reference: rms_norm fused op (PaddleNLP/incubate).  Routes to the
+    Pallas fused kernel (paddle_tpu/kernels/fused_norm.py) when the shape
+    is the standard last-axis case; XLA expression otherwise."""
+    if (norm_bias is None and begin_norm_axis in (-1, x.ndim - 1)
+            and norm_weight.ndim == 1
+            and x.shape[-1] % 128 == 0):
+        try:
+            from ...kernels.fused_norm import fused_rms_norm_pallas
+            return fused_rms_norm_pallas(x, norm_weight, epsilon)
+        except Exception:
+            pass
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
     out = out * norm_weight

@@ -1,0 +1,16 @@
+"""Pallas TPU kernel tier.
+
+Reference equivalents (SURVEY.md §2.1): the CUDA fused-kernel zoo —
+flash-attn integration (paddle/phi/kernels/gpu/flash_attn_kernel.cu),
+fused adamw (phi/kernels/gpu/adamw_kernel.cu), fused transformer ops
+(phi/kernels/fusion/gpu/).  Here each is one Pallas kernel compiled onto
+the MXU/VPU; everything falls back to the pure-XLA path off-TPU (the
+kernels also run under ``interpret=True`` for CPU tests).
+"""
+
+from .flash_attention import flash_attention, flash_attention_with_lse
+from .fused_adamw import fused_adamw_update
+from .fused_norm import fused_rms_norm_pallas
+
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "fused_adamw_update", "fused_rms_norm_pallas"]

@@ -1,0 +1,319 @@
+"""Pallas TPU flash attention (fwd + bwd, custom_vjp).
+
+Reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu — FlashAttnKernel /
+FlashAttnGradKernel wrapping the external CUTLASS flash-attn-2 library
+(cmake/external/flashattn.cmake), exposed as
+F.scaled_dot_product_attention (SURVEY.md §2.1 "FlashAttention
+integration").
+
+TPU-native: the classic online-softmax blockwise algorithm written directly
+in Pallas — q blocks stream over k/v blocks held in VMEM, logits never
+materialise in HBM; the MXU does the two matmuls per block in f32
+accumulation.  Backward is the standard two-kernel flash bwd (dq by q-block
+rows; dk/dv by k-block columns) using the saved LSE and the
+delta = rowsum(dO ⊙ O) trick.
+
+Layout is paddle's [batch, seq, heads, head_dim]; internally [B*H, S, D].
+Falls back onto interpret mode automatically off-TPU so CPU tests exercise
+the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "flash_attention_with_lse"]
+
+_NEG_INF = float("-inf")
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    nk = seq_k // block_k
+    if causal:
+        # only blocks whose first row index <= last q index participate
+        hi = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
+    else:
+        hi = nk
+
+    d = q.shape[-1]
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    a0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        coef = jnp.exp(m - m_new)
+        l_new = l * coef + jnp.sum(p, axis=-1)
+        acc_new = acc * coef[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    grid = (bh, sq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    nk = seq_k // block_k
+    hi = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k) \
+        if causal else nk
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros_like(q))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_q):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                    # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    nq = seq_q // block_q
+    lo = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                    # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+    q3, k3, v3, out, lse = res
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _pick_block(seq: int, want: int) -> int:
+    b = min(want, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _flash_core_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(query, key, value, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Flash attention over paddle layout [B, S, H, D]; differentiable.
+
+    GQA (kv heads < q heads) is handled by head repetition before the
+    kernel (broadcast, not copy, under XLA).
+    """
+    b, sq, h, d = query.shape
+    kh = key.shape[2]
+    if kh != h:
+        rep = h // kh
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    sk = key.shape[1]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+
+    def to3(x):
+        return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    out3 = _flash_core(to3(query), to3(key), to3(value), scale, causal,
+                       bq, bk, interpret)
+    return jnp.moveaxis(out3.reshape(b, h, sq, d), 1, 2)
+
+
+def flash_attention_with_lse(query, key, value, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Forward-only variant that also returns logsumexp [B, H, S] (used by
+    ring attention to combine per-shard partial attentions)."""
+    b, sq, h, d = query.shape
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    sk = key.shape[1]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+
+    def to3(x):
+        return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    out3, lse = _flash_fwd(to3(query), to3(key), to3(value), scale, causal,
+                           bq, bk, interpret)
+    return (jnp.moveaxis(out3.reshape(b, h, sq, d), 1, 2),
+            lse.reshape(b, h, sq))

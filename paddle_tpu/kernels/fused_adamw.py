@@ -1,0 +1,107 @@
+"""Fused AdamW update as one Pallas kernel.
+
+Reference: paddle/phi/kernels/gpu/adamw_kernel.cu — the in-place fused
+`_C_ops.adamw_` op every optimizer.step() dispatches to (SURVEY.md §3.2).
+
+TPU-native: one VPU pass reads (p, g, m, v) tiles from VMEM and writes
+(p', m', v') — no intermediate HBM round trips between the moment updates
+and the parameter write.  XLA usually fuses the unfused lax ops nearly as
+well; this kernel exists to (a) guarantee the fusion at any size, (b) halve
+peak residency via input/output aliasing.  Scalars ride in SMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_adamw_update"]
+
+
+def _adamw_kernel(sc_ref, p_ref, g_ref, m_ref, v_ref,
+                  po_ref, mo_ref, vo_ref):
+    lr = sc_ref[0]
+    beta1 = sc_ref[1]
+    beta2 = sc_ref[2]
+    eps = sc_ref[3]
+    wd = sc_ref[4]
+    bc1 = sc_ref[5]          # 1 - beta1^t
+    bc2 = sc_ref[6]          # 1 - beta2^t
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    mhat = m / bc1
+    vhat = v / bc2
+    new_p = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p)
+    po_ref[:] = new_p.astype(po_ref.dtype)
+    mo_ref[:] = m
+    vo_ref[:] = v
+
+
+def fused_adamw_update(p, g, m, v, step, lr, beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, weight_decay=0.0, interpret=None):
+    """One fused AdamW step on a single tensor.  m/v must be float32.
+    Returns (new_p, new_m, new_v).  ``step`` is the 1-based step index
+    (traced ok); scalars may be traced values."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    orig_shape = p.shape
+    n = int(p.size)
+    lane = 128
+    rows = max((n + lane - 1) // lane, 1)
+    pad = rows * lane - n
+
+    def flat(x, dt):
+        x = x.reshape(-1).astype(dt)
+        if pad:
+            x = jnp.pad(x, (0, pad))
+        return x.reshape(rows, lane)
+
+    t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(epsilon, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        1.0 - jnp.asarray(beta1, jnp.float32) ** t,
+        1.0 - jnp.asarray(beta2, jnp.float32) ** t,
+    ])
+
+    p2 = flat(p, p.dtype)
+    g2 = flat(g, p.dtype)
+    m2 = flat(m, jnp.float32)
+    v2 = flat(v, jnp.float32)
+
+    block_rows = min(rows, 512)
+    while rows % block_rows:
+        block_rows -= 1
+    grid = (rows // block_rows,)
+    bs = lambda: pl.BlockSpec((block_rows, lane), lambda i: (i, 0))
+    new_p, new_m, new_v = pl.pallas_call(
+        _adamw_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  bs(), bs(), bs(), bs()],
+        out_specs=[bs(), bs(), bs()],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, lane), p.dtype),
+            jax.ShapeDtypeStruct((rows, lane), jnp.float32),
+            jax.ShapeDtypeStruct((rows, lane), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scalars, p2, g2, m2, v2)
+
+    def unflat(x, dt):
+        x = x.reshape(-1)
+        if pad:
+            x = x[:n]
+        return x.reshape(orig_shape).astype(dt)
+
+    return (unflat(new_p, p.dtype), unflat(new_m, jnp.float32),
+            unflat(new_v, jnp.float32))

@@ -1,0 +1,107 @@
+"""Fused RMSNorm Pallas kernel.
+
+Reference: paddle/phi/kernels/fusion/gpu — fused_rms_norm / the norm stage
+of fused_multi_transformer_op.cu (SURVEY.md §2.1 "PHI fused kernels").
+
+One VPU pass per row block: mean-square, rsqrt and scale without writing
+the intermediate variance to HBM.  Differentiable via jax.custom_vjp with
+a closed-form backward (also one fused pass).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fused_rms_norm_pallas"]
+
+
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, r_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    r_ref[:] = rstd[:, 0]
+
+
+def _rms_bwd_kernel(x_ref, w_ref, r_ref, g_ref, dx_ref, dwp_ref):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    rstd = r_ref[:][:, None]
+    xhat = x * rstd
+    gw = g * w
+    # dx = rstd * (gw - xhat * mean(gw * xhat))
+    mean_gx = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (gw - xhat * mean_gx)).astype(dx_ref.dtype)
+    # per-block partial dw (summed over rows); caller sums over blocks
+    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
+
+
+def _run_fwd(x2, w, eps, block_rows, interpret):
+    rows, h = x2.shape
+    grid = (rows // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((rows, h), x2.dtype),
+                   jax.ShapeDtypeStruct((rows,), jnp.float32)],
+        interpret=interpret,
+    )(x2, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rms_core(x2, w, eps, block_rows, interpret):
+    out, _ = _run_fwd(x2, w, eps, block_rows, interpret)
+    return out
+
+
+def _rms_core_fwd(x2, w, eps, block_rows, interpret):
+    out, rstd = _run_fwd(x2, w, eps, block_rows, interpret)
+    return out, (x2, w, rstd)
+
+
+def _rms_core_bwd(eps, block_rows, interpret, res, g):
+    x2, w, rstd = res
+    rows, h = x2.shape
+    nblk = rows // block_rows
+    dx, dw_part = pl.pallas_call(
+        _rms_bwd_kernel,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, h), x2.dtype),
+                   jax.ShapeDtypeStruct((nblk, h), jnp.float32)],
+        interpret=interpret,
+    )(x2, w, rstd, g)
+    return dx, jnp.sum(dw_part, axis=0).astype(w.dtype)
+
+
+_rms_core.defvjp(_rms_core_fwd, _rms_core_bwd)
+
+
+def fused_rms_norm_pallas(x, weight, epsilon: float = 1e-5,
+                          interpret=None):
+    """RMSNorm over the last dim; x [..., H], weight [H]."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    orig = x.shape
+    h = orig[-1]
+    x2 = x.reshape(-1, h)
+    rows = x2.shape[0]
+    block = min(rows, 256)
+    while rows % block:
+        block -= 1
+    out = _rms_core(x2, weight, float(epsilon), block, interpret)
+    return out.reshape(orig)

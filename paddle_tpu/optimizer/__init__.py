@@ -2,7 +2,7 @@
 
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp,  # noqa: F401
                         Adadelta, Adamax)
-from .adam import Adam, AdamW, Lamb  # noqa: F401
+from .adam import Adam, AdamW, FusedAdamW, Lamb  # noqa: F401
 from . import lr  # noqa: F401
 from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                    ClipGradByGlobalNorm)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from .optimizer import Optimizer
 
-__all__ = ["Adam", "AdamW", "Lamb"]
+__all__ = ["Adam", "AdamW", "FusedAdamW", "Lamb"]
 
 
 class Adam(Optimizer):
@@ -127,3 +127,29 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * trust * r).astype(p.dtype), \
             {"moment1": m, "moment2": v}
+
+
+class FusedAdamW(AdamW):
+    """AdamW whose per-tensor update is ONE Pallas kernel
+    (paddle_tpu/kernels/fused_adamw.py) — the TPU equivalent of the
+    reference's in-place fused `_C_ops.adamw_`
+    (phi/kernels/gpu/adamw_kernel.cu).  Semantics identical to AdamW with
+    fused (non-decoupled-filtered) decay folded into the kernel; the
+    apply_decay_param_fun path falls back to the generic update."""
+
+    _l2_mode = "none"  # decay handled inside the kernel
+
+    def _update_param(self, g, p, slots, lr, step):
+        from ..kernels.fused_adamw import fused_adamw_update
+        wd = self._decay_coef() if self._should_decay(p) else 0.0
+        new_p, new_m, new_v = fused_adamw_update(
+            g=g, p=p, m=slots["moment1"], v=slots["moment2"],
+            step=step + 1, lr=lr, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, weight_decay=wd)
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
+    def update(self, grads, state, params, lr=None):
+        if self.apply_decay_param_fun is not None:
+            return super().update(grads, state, params, lr=lr)
+        # bypass AdamW's decoupled-decay post-pass: kernel does the decay
+        return Optimizer.update(self, grads, state, params, lr=lr)
