@@ -30,9 +30,12 @@ _AUTO_PARALLEL_NAMES = (
 
 
 def __getattr__(name):
+    if name == "spawn":  # paddle.distributed.spawn is the FUNCTION
+        from .spawn import spawn as fn
+        globals()[name] = fn
+        return fn
     # lazy heavy submodules
-    if name in ("auto_parallel", "checkpoint", "launch", "sharding", "moe",
-                "spawn"):
+    if name in ("auto_parallel", "checkpoint", "launch", "sharding", "moe"):
         import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

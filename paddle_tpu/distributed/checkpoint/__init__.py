@@ -1,0 +1,15 @@
+"""Distributed (shard-aware) checkpointing.
+
+Reference: python/paddle/distributed/checkpoint/ — save_state_dict.py,
+load_state_dict.py, metadata.py (SURVEY.md §2.4, §5 "Checkpoint/resume"):
+each rank writes the shards it owns plus a metadata file mapping global
+tensor -> (file, global offset); load reshards so a checkpoint written on
+one mesh/world-size restores onto another.
+"""
+
+from .save_state_dict import save_state_dict
+from .load_state_dict import load_state_dict
+from .metadata import Metadata, TensorMeta, ShardMeta
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata", "TensorMeta",
+           "ShardMeta"]

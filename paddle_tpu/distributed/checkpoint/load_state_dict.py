@@ -1,0 +1,138 @@
+"""Shard-aware load with reshard-on-load.
+
+Reference: python/paddle/distributed/checkpoint/load_state_dict.py —
+load_state_dict: reads the metadata shard map and gathers/reslices so the
+checkpoint restores onto a different mesh or world size (SURVEY.md §5).
+
+TPU-native: for every *target* shard (from the destination array's
+NamedSharding) we assemble exactly the overlapping regions of the *source*
+shards via ``jax.make_array_from_callback`` — memory stays proportional to
+the local shard, and XLA never sees the full tensor on one host unless the
+target is replicated.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .metadata import Metadata, TensorMeta
+
+__all__ = ["load_state_dict"]
+
+
+def _merged_metadata(path: str) -> Metadata:
+    frags = sorted(glob.glob(os.path.join(path, "metadata_p*.json")))
+    # accept the legacy single metadata.json name too
+    legacy = os.path.join(path, "metadata.json")
+    if os.path.exists(legacy):
+        frags.append(legacy)
+    if not frags:
+        raise FileNotFoundError(f"no checkpoint metadata under {path!r}")
+    merged = Metadata()
+    for frag in frags:
+        with open(frag) as f:
+            md = Metadata.from_json(f.read())
+        merged.extra.update(md.extra)
+        for name, tm in md.tensors.items():
+            if name in merged.tensors:
+                merged.tensors[name].shards.extend(tm.shards)
+            else:
+                merged.tensors[name] = tm
+    return merged
+
+
+class _ShardReader:
+    """Lazily-opened npz files keyed by file name."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files: dict = {}
+
+    def get(self, file: str, key: str) -> np.ndarray:
+        if file not in self._files:
+            self._files[file] = np.load(os.path.join(self.path, file))
+        return self._files[file][key]
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def _assemble_region(tm: TensorMeta, reader: _ShardReader, region):
+    """Build the numpy block for ``region`` (tuple of slices in global
+    coords) by pasting every overlapping saved shard."""
+    rshape = tuple(
+        (s.stop if s.stop is not None else tm.global_shape[d]) -
+        (s.start or 0)
+        for d, s in enumerate(region))
+    out = np.zeros(rshape, dtype=np.dtype(tm.dtype))
+    covered = np.zeros(rshape, dtype=bool) if tm.shards else None
+    r_start = [s.start or 0 for s in region]
+    for sh in tm.shards:
+        src_lo = sh.global_offset
+        src_hi = [o + n for o, n in zip(src_lo, sh.local_shape)]
+        # overlap in global coords
+        lo = [max(a, b) for a, b in zip(src_lo, r_start)]
+        hi = [min(a, b + n) for a, b, n in zip(src_hi, r_start, rshape)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        data = reader.get(sh.file, sh.key)
+        src_sel = tuple(slice(l - o, h - o) for l, h, o in
+                        zip(lo, hi, src_lo))
+        dst_sel = tuple(slice(l - r, h - r) for l, h, r in
+                        zip(lo, hi, r_start))
+        out[dst_sel] = data[src_sel]
+        if covered is not None:
+            covered[dst_sel] = True
+    if covered is not None and not covered.all():
+        raise ValueError(
+            f"checkpoint does not fully cover tensor {tm.name!r} region "
+            f"{region} (missing {int((~covered).sum())} elements)")
+    return out
+
+
+def load_state_dict(state_dict: Dict[str, object], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    strict: bool = True) -> Dict[str, object]:
+    """Fill ``state_dict`` (name -> destination array, used for shape,
+    dtype AND sharding) from the checkpoint at ``path``; returns a new
+    dict (functional — callers rebind).  Tensors present in the target but
+    absent from the checkpoint raise under ``strict``."""
+    md = _merged_metadata(path)
+    reader = _ShardReader(path)
+    out: Dict[str, object] = {}
+    try:
+        for name, dst in state_dict.items():
+            tm = md.tensors.get(name)
+            if tm is None:
+                if strict:
+                    raise KeyError(f"tensor {name!r} not in checkpoint {path!r}")
+                out[name] = dst
+                continue
+            dshape = tuple(getattr(dst, "shape", np.asarray(dst).shape))
+            if tuple(tm.global_shape) != dshape:
+                raise ValueError(
+                    f"shape mismatch for {name!r}: checkpoint "
+                    f"{tm.global_shape} vs target {list(dshape)}")
+            dtype = getattr(dst, "dtype", None) or np.dtype(tm.dtype)
+            sharding = getattr(dst, "sharding", None)
+            if sharding is not None and hasattr(sharding, "mesh"):
+                arr = jax.make_array_from_callback(
+                    dshape, sharding,
+                    lambda region, tm=tm: jnp.asarray(
+                        _assemble_region(tm, reader, region), dtype=dtype))
+            else:
+                full = _assemble_region(
+                    tm, reader, tuple(slice(0, n) for n in dshape))
+                arr = jnp.asarray(full, dtype=dtype)
+            out[name] = arr
+    finally:
+        reader.close()
+    return out

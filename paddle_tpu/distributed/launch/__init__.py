@@ -1,0 +1,10 @@
+"""Launcher package: ``python -m paddle_tpu.distributed.launch``.
+
+Reference: python/paddle/distributed/launch/ — main.py, context/,
+controllers/ (CollectiveController, master rendezvous), job/container.py
+(SURVEY.md §2.4 "Launcher", §3.3 call stack).
+"""
+
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

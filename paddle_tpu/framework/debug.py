@@ -1,0 +1,60 @@
+"""NaN/Inf debugging utilities.
+
+Reference: FLAGS_check_nan_inf -> per-kernel output scanning
+(paddle/fluid/framework/details/nan_inf_utils_detail.*,
+phi/kernels/check_numerics_kernel) and paddle.amp.debugging.check_numerics
+(SURVEY.md §5 "Race detection / sanitizers").
+
+TPU-native: the global flag maps to jax_debug_nans (core/flags.py);
+``check_numerics`` here is the explicit op — jit-safe via
+jax.debug.callback, so it can sit inside a compiled train step and abort
+with the offending tensor's name and stats, like the reference's
+CheckNumericsKernel error message.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["check_numerics", "check_tree_numerics"]
+
+
+def _host_check(name, op_type, num_nan, num_inf, amax, amin):
+    if int(num_nan) or int(num_inf):
+        raise FloatingPointError(
+            f"[check_numerics] {op_type}:{name} contains "
+            f"{int(num_nan)} NaN / {int(num_inf)} Inf "
+            f"(finite range [{float(amin):.4g}, {float(amax):.4g}])")
+
+
+def check_numerics(x, op_type: str = "", var_name: str = "",
+                   debug_mode=None):
+    """Abort (at host sync) if x has NaN/Inf.  Returns x unchanged so it
+    can be threaded through compiled code:  x = check_numerics(x, 'matmul',
+    'out')."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return x
+    xf = jnp.asarray(x).astype(jnp.float32)
+    num_nan = jnp.sum(jnp.isnan(xf))
+    num_inf = jnp.sum(jnp.isinf(xf))
+    finite = jnp.where(jnp.isfinite(xf), xf, 0.0)
+    jax.debug.callback(_host_check, var_name or "tensor", op_type or "op",
+                       num_nan, num_inf, jnp.max(finite), jnp.min(finite))
+    return x
+
+
+def check_tree_numerics(tree: Any, op_type: str = "step"):
+    """check_numerics over every floating leaf of a pytree (grads, params).
+    Returns the tree unchanged."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        if leaf is not None and hasattr(leaf, "dtype") and \
+                jnp.issubdtype(leaf.dtype, jnp.floating):
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            check_numerics(leaf, op_type, name)
+    return tree

@@ -1,0 +1,19 @@
+"""Profiler facade.
+
+Reference: python/paddle/profiler/profiler.py — Profiler, make_scheduler,
+RecordEvent, export_chrome_tracing; C++ HostTracer/CudaTracer merged into
+an event tree -> ChromeTracingLogger (SURVEY.md §5 "Tracing/profiling").
+
+TPU-native: the device side is jax.profiler (XPlane/TensorBoard,
+perfetto) — Profiler wraps it; the host side is our own RecordEvent tree
+with chrome-trace export and op-summary tables, preserving the reference's
+user API (scheduler states, step(), summary()).
+"""
+
+from .profiler import (Profiler, ProfilerState, ProfilerTarget,
+                       make_scheduler, export_chrome_tracing,
+                       export_protobuf, RecordEvent, load_profiler_result)
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf", "RecordEvent",
+           "load_profiler_result"]

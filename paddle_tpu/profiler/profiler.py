@@ -1,0 +1,286 @@
+"""Profiler implementation (host event tree + jax.profiler device trace).
+
+Reference symbols kept 1:1 (python/paddle/profiler/profiler.py):
+Profiler(targets, scheduler, on_trace_ready, timer_only), ProfilerState
+(CLOSED/READY/RECORD/RECORD_AND_RETURN), make_scheduler, RecordEvent,
+export_chrome_tracing, summary().
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf", "RecordEvent",
+           "load_profiler_result"]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class _HostEvent:
+    __slots__ = ("name", "start_us", "end_us", "tid")
+
+    def __init__(self, name, start_us, end_us, tid):
+        self.name = name
+        self.start_us = start_us
+        self.end_us = end_us
+        self.tid = tid
+
+
+class _HostTracer:
+    """Collects RecordEvent intervals (reference: C++ HostTracer)."""
+
+    def __init__(self):
+        self.events: List[_HostEvent] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def add(self, ev: _HostEvent):
+        if self.enabled:
+            with self._lock:
+                self.events.append(ev)
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+
+_tracer = _HostTracer()
+
+
+class RecordEvent:
+    """User annotation (reference: paddle.profiler.RecordEvent); also
+    forwards to jax.profiler.TraceAnnotation so device traces carry the
+    same names."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+        self._jax_ann = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns() // 1000
+        try:
+            import jax
+            self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
+
+    def end(self):
+        if self._start is None:
+            return
+        end = time.perf_counter_ns() // 1000
+        _tracer.add(_HostEvent(self.name, self._start, end,
+                               threading.get_ident()))
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Reference: make_scheduler — step_num -> ProfilerState cycle
+    [skip_first][closed][ready][record...(last returns RECORD_AND_RETURN)]
+    repeated ``repeat`` times (0 = forever)."""
+    cycle = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Reference: on_trace_ready=export_chrome_tracing(dir) callback."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
+        prof._export_chrome(path)
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """Parity alias: device-side XPlane protos are written by
+    jax.profiler into the trace dir; host events go as chrome JSON."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Reference: paddle.profiler.Profiler.
+
+    timer_only=True skips the jax device trace (host timing only) — the
+    analog of the reference's benchmark mode.
+    """
+
+    def __init__(self, *, targets: Optional[Sequence[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, trace_dir: Optional[str] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        else:
+            raise ValueError(f"bad scheduler {scheduler!r}")
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir or "profiler_log"
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_tracing = False
+
+    # -- state machine --------------------------------------------------
+    def _transition(self, new_state: ProfilerState):
+        old = self.current_state
+        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if old in (ProfilerState.CLOSED, ProfilerState.READY):
+                self._start_record()
+        if old in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
+                new_state in (ProfilerState.CLOSED, ProfilerState.READY):
+            self._stop_record()
+        self.current_state = new_state
+
+    def _start_record(self):
+        _tracer.clear()
+        _tracer.enabled = True
+        if not self.timer_only:
+            try:
+                import jax
+                os.makedirs(self.trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self.trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_record(self):
+        _tracer.enabled = False
+        if self._device_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # -- public API -----------------------------------------------------
+    def start(self):
+        self._transition(self._scheduler(self.step_num))
+
+    def stop(self):
+        was_recording = self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        self._transition(ProfilerState.CLOSED)
+        if was_recording and self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self):
+        prev = self.current_state
+        self.step_num += 1
+        new = self._scheduler(self.step_num)
+        if prev == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+            # closing edge of a record window: hand the trace out
+            if new not in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+                self._transition(new)
+                self.on_trace_ready(self)
+                return
+        self._transition(new)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results --------------------------------------------------------
+    def events(self) -> List[_HostEvent]:
+        return list(_tracer.events)
+
+    def _export_chrome(self, path: str):
+        traceEvents = [{
+            "name": e.name, "ph": "X", "ts": e.start_us,
+            "dur": max(e.end_us - e.start_us, 1), "pid": os.getpid(),
+            "tid": e.tid % 100000, "cat": "host",
+        } for e in _tracer.events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": traceEvents}, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms") -> str:
+        """Aggregate host events by name (reference: summary tables)."""
+        agg: Dict[str, List[float]] = {}
+        for e in _tracer.events:
+            agg.setdefault(e.name, []).append((e.end_us - e.start_us) / 1e3)
+        rows = []
+        for name, ds in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+            rows.append((name, len(ds), sum(ds), sum(ds) / len(ds),
+                         max(ds), min(ds)))
+        hdr = f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg':>10}" \
+              f"{'Max':>10}{'Min':>10}"
+        lines = [hdr, "-" * len(hdr)]
+        for r in rows:
+            lines.append(f"{r[0][:39]:<40}{r[1]:>8}{r[2]:>12.3f}"
+                         f"{r[3]:>10.3f}{r[4]:>10.3f}{r[5]:>10.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table

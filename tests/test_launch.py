@@ -1,0 +1,97 @@
+"""Launcher tests: env contract, watchdog, elastic restart, spawn.
+
+Mirrors the reference's launcher tests (test/legacy_test/test_run.py
+pattern): shell out to ``python -m paddle_tpu.distributed.launch`` with a
+tiny script, assert the env contract and restart behavior.  Workers are
+plain python (no JAX import) so tests stay fast.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, extra_args=(), returncode=0):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env["PYTHONPATH"] = REPO
+    env["PADDLE_PORT"] = "62000"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log"), *extra_args, str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == returncode, (r.stdout, r.stderr)
+    return r
+
+
+def test_launch_env_contract(tmp_path):
+    _run_launch(tmp_path, """
+        import os, json
+        info = {k: os.environ[k] for k in
+                ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                 "PADDLE_TRAINER_ENDPOINTS", "PADDLE_CURRENT_ENDPOINT",
+                 "PADDLE_LOCAL_RANK")}
+        with open(f"out_{os.environ['PADDLE_TRAINER_ID']}.json", "w") as f:
+            json.dump(info, f)
+    """, extra_args=("--nproc_per_node", "2"))
+    import json
+    o0 = json.load(open(tmp_path / "out_0.json"))
+    o1 = json.load(open(tmp_path / "out_1.json"))
+    assert o0["PADDLE_TRAINERS_NUM"] == "2"
+    assert o0["PADDLE_TRAINER_ENDPOINTS"] == o1["PADDLE_TRAINER_ENDPOINTS"]
+    assert len(o0["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 2
+    assert o0["PADDLE_CURRENT_ENDPOINT"] != o1["PADDLE_CURRENT_ENDPOINT"]
+    assert {o0["PADDLE_TRAINER_ID"], o1["PADDLE_TRAINER_ID"]} == {"0", "1"}
+
+
+def test_launch_elastic_restart_then_success(tmp_path):
+    """Worker fails on first run, succeeds after restart (the max_restart
+    loop — reference: ElasticManager/controller watch)."""
+    _run_launch(tmp_path, """
+        import os, sys
+        marker = "attempt.txt"
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        restart = int(os.environ["PADDLE_RESTART_COUNT"])
+        sys.exit(1 if n == 0 else 0)
+    """, extra_args=("--max_restart", "2"))
+    assert (tmp_path / "attempt.txt").read_text() == "2"
+
+
+def test_launch_gives_up_after_max_restart(tmp_path):
+    r = _run_launch(tmp_path, """
+        import sys
+        sys.exit(7)
+    """, extra_args=("--max_restart", "1"), returncode=7)
+    assert "giving up" in r.stderr
+
+
+def test_launch_worker_logs(tmp_path):
+    _run_launch(tmp_path, """
+        print("hello from worker")
+    """)
+    log = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "hello from worker" in log
+
+
+def test_spawn_function():
+    from paddle_tpu.distributed.spawn import spawn
+    import multiprocessing as mp
+
+    q = mp.get_context("spawn").Queue()
+    spawn(_spawn_target, args=(q,), nprocs=2)
+    got = sorted([q.get(timeout=10), q.get(timeout=10)])
+    assert got == [0, 1]
+
+
+def _spawn_target(q):
+    import os
+    q.put(int(os.environ["PADDLE_TRAINER_ID"]))
