@@ -1,0 +1,120 @@
+"""Device + memory-stats facade.
+
+Reference: python/paddle/device/ — paddle.device.cuda.max_memory_allocated
+etc., backed by paddle/fluid/memory/stats.cc (DEVICE_MEMORY_STAT macros)
+over the allocator facade (SURVEY.md §2.1 "Memory/allocators", §5
+"Metrics/logging").
+
+TPU-native: allocation is PJRT's job; the stats come from
+``Device.memory_stats()`` (bytes_in_use, peak_bytes_in_use, ...).  The
+facade keeps the reference's function names and byte semantics.  The
+``cuda`` alias namespace exists so ported code calling
+``paddle.device.cuda.max_memory_allocated()`` keeps working on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["get_device", "set_device", "device_count", "is_compiled_with_cuda",
+           "memory_allocated", "memory_reserved", "max_memory_allocated",
+           "max_memory_reserved", "memory_stats", "empty_cache", "cuda",
+           "synchronize"]
+
+_current = None
+
+
+def _dev(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str) and ":" in device:
+        return devs[int(device.rsplit(":", 1)[1])]
+    return devs[0]
+
+
+def get_device() -> str:
+    d = _dev()
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str) -> str:
+    """Parity shim: JAX places by sharding, not a global current device;
+    records the choice for get_device symmetry."""
+    global _current
+    _current = device
+    return device
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT stats dict ({} on backends that expose none, e.g. CPU)."""
+    try:
+        return dict(_dev(device).memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Reference: paddle.device.cuda.memory_allocated — live bytes."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Reference: paddle.device.cuda.max_memory_allocated — peak bytes."""
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("pool_bytes", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved",
+                     s.get("largest_alloc_size", 0)))
+
+
+def empty_cache() -> None:
+    """Parity no-op: PJRT owns its pools (documented deviation)."""
+
+
+def synchronize(device=None) -> None:
+    """Block host until device work completes (reference:
+    paddle.device.synchronize)."""
+    jax.effects_barrier()
+    for x in jax.live_arrays():
+        try:
+            x.block_until_ready()
+        except Exception:
+            pass
+
+
+class _CudaNamespace:
+    """paddle.device.cuda.* alias surface for ported code."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    synchronize = staticmethod(synchronize)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+
+cuda = _CudaNamespace()

@@ -16,6 +16,7 @@ from .fleet import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import sharding_utils  # noqa: F401
 from . import pipelining  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
 
 
 # semi-auto parallel symbols re-exported at top level (reference:

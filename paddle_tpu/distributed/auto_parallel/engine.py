@@ -66,6 +66,7 @@ class Engine:
         self._params = {k: jnp.array(v, copy=True)
                         for k, v in self._params.items()}
         self._opt_state = None
+        self._merge_state = None
         self._train_step = None
         self._eval_step = None
         self._pred_step = None
@@ -111,18 +112,50 @@ class Engine:
 
     def _build_train_step(self):
         opt = self.optimizer
+        gm = self.strategy.gradient_merge
+        k = int(gm.k_steps) if gm.enable else 1
+        avg = bool(getattr(gm, "avg", True))
 
-        def step_fn(params, buffers, opt_state, inputs, labels):
+        def step_fn(params, buffers, opt_state, merge, inputs, labels):
             def loss_fn(p):
                 out, new_buf = self._forward(p, buffers, inputs, True)
                 l = self.loss(out, *labels)
                 return jnp.asarray(l, jnp.float32), (new_buf, out)
             (l, (new_buf, _)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_p, new_opt = opt.update(grads, opt_state, params)
-            return l, new_p, new_buf, new_opt
+            if k <= 1:
+                new_p, new_opt = opt.update(grads, opt_state, params)
+                return l, new_p, new_buf, new_opt, merge
+            # gradient merge (reference: passes/auto_parallel_gradient_
+            # merge.py — accumulate k_steps of grads, apply once): the
+            # accumulator + counter live in ``merge`` and the conditional
+            # update is a lax.cond inside the SAME compiled program
+            acc, cnt = merge
+            acc = jax.tree.map(lambda a, g: a + g, acc, grads)
+            cnt = cnt + 1
 
-        return jax.jit(step_fn, donate_argnums=(0, 2))
+            def do_update(_):
+                g = jax.tree.map(lambda a: a / k if avg else a, acc)
+                new_p, new_opt = opt.update(g, opt_state, params)
+                return (new_p, new_opt,
+                        jax.tree.map(jnp.zeros_like, acc),
+                        jnp.zeros((), jnp.int32))
+
+            def hold(_):
+                return params, opt_state, acc, cnt
+
+            new_p, new_opt, acc, cnt = jax.lax.cond(cnt >= k, do_update,
+                                                    hold, None)
+            return l, new_p, new_buf, new_opt, (acc, cnt)
+
+        return jax.jit(step_fn, donate_argnums=(0, 2, 3))
+
+    def _init_merge_state(self):
+        gm = self.strategy.gradient_merge
+        if not gm.enable or int(gm.k_steps) <= 1:
+            return ()
+        return (jax.tree.map(jnp.zeros_like, self._params),
+                jnp.zeros((), jnp.int32))
 
     def _build_eval_step(self):
         def step_fn(params, buffers, inputs, labels):
@@ -151,6 +184,8 @@ class Engine:
         prepare only initialises optimizer state."""
         if self.optimizer is not None and self._opt_state is None:
             self._opt_state = self.optimizer.init(self._params)
+        if getattr(self, "_merge_state", None) is None:
+            self._merge_state = self._init_merge_state()
 
     def fit(self, train_data, epochs: int = 1, batch_size: Optional[int] = None,
             steps_per_epoch: Optional[int] = None, log_freq: int = 10,
@@ -167,9 +202,10 @@ class Engine:
                 inputs, labels = self._split_batch(batch)
                 inputs = self._data_sharding(tuple(jnp.asarray(v) for v in inputs))
                 labels = self._data_sharding(tuple(jnp.asarray(v) for v in labels))
-                l, self._params, self._buffers, self._opt_state = \
-                    self._train_step(self._params, self._buffers,
-                                     self._opt_state, inputs, labels)
+                (l, self._params, self._buffers, self._opt_state,
+                 self._merge_state) = self._train_step(
+                    self._params, self._buffers, self._opt_state,
+                    self._merge_state, inputs, labels)
                 self._step_count += 1
                 history.append(l)
                 if verbose and it % log_freq == 0:
@@ -282,8 +318,10 @@ class DistModel:
             e.prepare()
             if e._train_step is None:
                 e._train_step = e._build_train_step()
-            l, e._params, e._buffers, e._opt_state = e._train_step(
-                e._params, e._buffers, e._opt_state, inputs, labels)
+            (l, e._params, e._buffers, e._opt_state,
+             e._merge_state) = e._train_step(
+                e._params, e._buffers, e._opt_state, e._merge_state,
+                inputs, labels)
             return l
         if e._eval_step is None:
             e._eval_step = e._build_eval_step()

@@ -73,7 +73,9 @@ def _assemble_region(tm: TensorMeta, reader: _ShardReader, region):
         (s.start or 0)
         for d, s in enumerate(region))
     out = np.zeros(rshape, dtype=np.dtype(tm.dtype))
-    covered = np.zeros(rshape, dtype=bool) if tm.shards else None
+    # always track coverage: a tensor with metadata but NO saved shards
+    # must raise the incomplete-coverage error, not load as zeros
+    covered = np.zeros(rshape, dtype=bool)
     r_start = [s.start or 0 for s in region]
     for sh in tm.shards:
         src_lo = sh.global_offset
@@ -89,9 +91,8 @@ def _assemble_region(tm: TensorMeta, reader: _ShardReader, region):
         dst_sel = tuple(slice(l - r, h - r) for l, h, r in
                         zip(lo, hi, r_start))
         out[dst_sel] = data[src_sel]
-        if covered is not None:
-            covered[dst_sel] = True
-    if covered is not None and not covered.all():
+        covered[dst_sel] = True
+    if not covered.all():
         raise ValueError(
             f"checkpoint does not fully cover tensor {tm.name!r} region "
             f"{region} (missing {int((~covered).sum())} elements)")

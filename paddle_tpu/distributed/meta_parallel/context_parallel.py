@@ -80,58 +80,129 @@ def gather_sequence(x, axis_name: str = "sep", seq_dim: int = 1, mesh=None):
 # Ring attention
 # --------------------------------------------------------------------------
 
-def _ring_attention_local(q, k, v, axis_name: str, axis_size: int,
-                          causal: bool, scale: float):
-    """Per-device body: q,k,v are the LOCAL sequence blocks [B,Sl,H,D].
+def _ring_fwd_impl(q, k, v, axis_name: str, axis_size: int, causal: bool,
+                   scale: float):
+    """Per-device fwd: q,k,v are the LOCAL sequence blocks [B,Sl,H,D].
 
-    Classic flash/ring recurrence: for each of the ``axis_size`` steps,
-    attend local q against the current K/V block (with global-position
-    causal masking), then rotate K/V one hop around the ring.
+    Ring flash recurrence: each of the ``axis_size`` hops runs the Pallas
+    flash kernel (paddle_tpu/kernels/flash_attention.py) on the local q
+    against the K/V block currently held, then combines the normalized
+    per-hop results with their logsumexps — block logits never materialise
+    (round-2: the previous jnp path built full [B,H,Sl,Sl] logits per hop).
+
+    Causal structure under the ring: at hop t the block held came from rank
+    src = (my - t) mod n.  t == 0 is the diagonal (causal flash); t >= 1 is
+    valid iff src < my, i.e. my >= t (then it is a fully-unmasked block);
+    otherwise the hop contributes nothing (lse = -inf).
+
+    Returns (out [B,Sl,H,D], lse [B,H,Sl] f32).
     """
+    from ...kernels.flash_attention import flash_attention_with_lse
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def flash(k_blk, v_blk, causal_):
+        o, lse = flash_attention_with_lse(q, k_blk, v_blk, causal=causal_,
+                                          scale=scale)
+        return o.astype(jnp.float32), lse      # [B,Sl,H,D], [B,H,Sl]
+
+    out, lse = flash(k, v, causal)
+    k_blk, v_blk = k, v
+    for t in range(1, axis_size):              # static unroll over ring hops
+        # receive the next lower rank's block (ring walk over ICI neighbors)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        o_t, lse_t = flash(k_blk, v_blk, False)
+        if causal:
+            lse_t = jnp.where(my >= t, lse_t, -jnp.inf)
+        lse_new = jnp.logaddexp(lse, lse_t)
+        safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+
+        def w(ls):                              # [B,H,Sl] -> [B,Sl,H,1]
+            wt = jnp.where(jnp.isneginf(ls), 0.0, jnp.exp(ls - safe))
+            return jnp.swapaxes(wt, 1, 2)[..., None]
+
+        out = out * w(lse) + o_t * w(lse_t)
+        lse = lse_new
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_core(q, k, v, axis_name, axis_size, causal, scale):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, axis_size, causal, scale)
+    return out
+
+
+def _ring_core_fwd(q, k, v, axis_name, axis_size, causal, scale):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, axis_size, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_core_bwd(axis_name, axis_size, causal, scale, res, g):
+    """Reverse ring pass (classic ring-flash bwd): per hop, run the flash
+    backward kernels against the K/V block currently held using the GLOBAL
+    lse (p = exp(s·scale - lse_global) is then the exact softmax slice),
+    accumulate dq locally while dk/dv travel WITH their block — after the
+    full cycle (+1 closing rotation) they are back at the owner rank."""
+    from ...kernels.flash_attention import _flash_bwd, _pick_block, \
+        _interpret_default
+    q, k, v, out, lse = res
     B, Sl, H, D = q.shape
     my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    interpret = _interpret_default()
+    bq = _pick_block(Sl, 256)
+    bk = _pick_block(Sl, 512)
 
-    qf = q.astype(jnp.float32)
-    # accumulators in fp32: running max m, denom l, numerator o
-    m = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
-    l = jnp.zeros((B, H, Sl), jnp.float32)
-    o = jnp.zeros((B, H, Sl, D), jnp.float32)
+    def to3(x):
+        return jnp.moveaxis(x, 1, 2).reshape(B * H, x.shape[1], D)
 
-    q_pos = my * Sl + jnp.arange(Sl)                     # global q positions
+    def from3(x3):
+        return jnp.moveaxis(x3.reshape(B, H, Sl, D), 1, 2)
 
-    def step(carry, _):
-        m, l, o, k_blk, v_blk, src = carry
-        # src = ring index whose block we currently hold
-        s = jnp.einsum("bshd,bthd->bhst", qf, k_blk.astype(jnp.float32))
-        s = s * scale
-        if causal:
-            k_pos = src * Sl + jnp.arange(Sl)
-            mask = q_pos[:, None] >= k_pos[None, :]       # [Sl, Sl]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        blk_max = jnp.max(s, axis=-1)                     # [B,H,Sl]
-        m_new = jnp.maximum(m, blk_max)
-        # guard -inf rows (fully masked block): exp(-inf - -inf) -> use safe m
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[..., None])
-        p = jnp.where(jnp.isneginf(s), 0.0, p)
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bhst,bthd->bhsd", p, v_blk.astype(jnp.float32))
-        # rotate K/V: receive the next lower rank's block (ring walk)
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        src_nxt = (src - 1) % axis_size
-        return (m_new, l_new, o_new, k_nxt, v_nxt, src_nxt), None
+    q3, o3, g3 = to3(q), to3(out), to3(g.astype(q.dtype))
+    lse3 = lse.reshape(B * H, Sl)
 
-    carry = (m, l, o, k, v, my)
-    for _ in range(axis_size):            # static unroll over ring hops
-        carry, _ = step(carry, None)
-    m, l, o, _, _, _ = carry
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = o / l_safe[..., None]                           # [B,H,Sl,D]
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)        # [B,Sl,H,D]
+    dq3 = jnp.zeros_like(q3, jnp.float32)
+    dk = jnp.zeros_like(k, jnp.float32)
+    dv = jnp.zeros_like(v, jnp.float32)
+    k_blk, v_blk = k, v
+    for t in range(axis_size):
+        if t > 0:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            dk = jax.lax.ppermute(dk, axis_name, perm)
+            dv = jax.lax.ppermute(dv, axis_name, perm)
+        dq_t, dk_t, dv_t = _flash_bwd(
+            (q3, to3(k_blk), to3(v_blk), o3, lse3), g3, scale,
+            causal and t == 0, bq, bk, interpret)
+        if causal and t > 0:
+            w = (my >= t).astype(jnp.float32)
+            dq_t, dk_t, dv_t = dq_t * w, dk_t * w, dv_t * w
+        dq3 = dq3 + dq_t.astype(jnp.float32)
+        dk = dk + from3(dk_t).astype(jnp.float32)
+        dv = dv + from3(dv_t).astype(jnp.float32)
+    # closing rotation: dk/dv for the block seen at hop t have now had
+    # (axis_size-1-t) rotations; one more completes the cycle home
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return (from3(dq3).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+def _ring_attention_local(q, k, v, axis_name: str, axis_size: int,
+                          causal: bool, scale: float):
+    """Differentiable per-device ring attention body (see _ring_fwd_impl);
+    requires kv heads == q heads (repeat before calling for GQA — the ring
+    bwd returns grads in the repeated layout otherwise)."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return _ring_core(q, k, v, axis_name, axis_size, causal, scale)
 
 
 def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
@@ -191,15 +262,11 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool, scale: float,
 
     qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)    # [B, S, H/n, D]
     if attn_fn is None:
-        qf = qg.astype(jnp.float32)
-        s = jnp.einsum("bshd,bthd->bhst", qf, kg.astype(jnp.float32)) * scale
-        if causal:
-            S = s.shape[-1]
-            mask = jnp.tril(jnp.ones((S, S), bool))
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhst,bthd->bshd", p,
-                         vg.astype(jnp.float32)).astype(q.dtype)
+        # full-length attention over H/n heads via the Pallas flash kernel
+        # (differentiable custom_vjp; interpret mode on CPU) — logits never
+        # materialise at the long post-all-to-all sequence length
+        from ...kernels.flash_attention import flash_attention
+        out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
     else:
         out = attn_fn(qg, kg, vg)
     return head2seq(out)                                   # [B, Sl, H, D]

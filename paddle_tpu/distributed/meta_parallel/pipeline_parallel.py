@@ -3,16 +3,20 @@
 Reference: python/paddle/distributed/fleet/meta_parallel/
 pipeline_parallel.py — PipelineParallel.train_batch splits the batch into
 micro-batches and runs forward_backward_pipeline (FThenB / 1F1B /
-interleaved), exchanging activations over NCCL p2p and accumulating grads;
-optimizer step at the end.
+interleaved via PipelineParallelWithInterleave), exchanging activations
+over NCCL p2p and accumulating grads; optimizer step at the end.
 
 TPU-native: train_batch builds ONE jitted program:
-  * uniform stages -> fused scan+ppermute schedule (pipelining.py); the
-    backward through the scan reproduces 1F1B's mirrored communication;
-  * general stages -> sequential-stage microbatch loop (lax control flow via
-    python unroll over a static microbatch count) with grad accumulation —
-    correct PP semantics (params live on their stage's mesh slice, GSPMD
-    moves activations), without tick-level overlap.
+  * uniform stages -> the fused scan+ppermute schedule
+    (distributed/pipelining.py — pipeline_apply); the backward through the
+    scan reproduces 1F1B's mirrored communication;
+  * uniform stages + num_virtual_pipeline_stages > 1 -> the interleaved
+    (VPP) schedule (pipeline_apply_interleaved): V chunks per device
+    round-robin, bubble shrinks by V;
+  * general (non-uniform) stages -> sequential microbatch loop with grad
+    accumulation — correct PP semantics (params live on their stage's mesh
+    slice, GSPMD moves activations) without tick-level overlap; documented
+    fallback.
 
 schedule_mode "FThenB"/"1F1B" are accepted; under the fused SPMD schedule
 they compile to the same program (the distinction is a host-scheduling
@@ -27,7 +31,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ...nn.functional_call import functional_call, state
+from ...nn.functional_call import functional_call, state, _index_stores, \
+    _write
 from ..sharding_utils import get_param_specs
 from .pp_layers import PipelineLayer
 from .tensor_parallel import MetaParallelBase
@@ -45,17 +50,108 @@ class PipelineParallel(MetaParallelBase):
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.schedule_mode = cfg.get("schedule_mode", "1F1B")
         self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.num_chunks = getattr(layers, "num_virtual_stages", 1)
         self._jit_train = None
         self._opt = None
+
+    # -- fused-schedule eligibility -------------------------------------
+    def _fused_plan(self):
+        """Per-chunk {local_key -> global param name} maps when every chunk
+        is structurally identical (the fused schedule's requirement);
+        None otherwise.  local_key = '{layer_idx_in_chunk}.{param_name}'."""
+        model = self._layers
+        S, V = self.num_stages, self.num_chunks
+        if S <= 1 or not model.stages_uniform() or model._shared_layers:
+            return None
+        try:
+            if self.mesh.shape.get("pp") != S:
+                return None
+        except Exception:
+            return None
+        maps = []
+        for c in range(S * V):
+            lo = model.segment_parts[c]
+            layers = model.get_chunk_layers(c)
+            m = {}
+            for j, layer in enumerate(layers):
+                if any(True for _ in layer.named_buffers()):
+                    # fused run_chunk freezes buffers (run with buffers=None
+                    # and returned unchanged) — a BatchNorm-style stage must
+                    # take the sequential path, which threads them
+                    return None
+                for pname, _ in layer.named_parameters():
+                    m[f"{j}.{pname}"] = f"run_function.{lo + j}.{pname}"
+            maps.append(m)
+        keys0 = set(maps[0])
+        if any(set(m) != keys0 for m in maps[1:]):
+            return None
+        return maps
 
     # -- functional program builders ------------------------------------
     def build_train_step(self, optimizer, loss_fn=None):
         """Returns step(params, buffers, opt_state, x, y, lr) -> (...) as a
-        pure function; caller jits with mesh shardings."""
+        pure function over state(self._layers); caller jits."""
+        plan = self._fused_plan()
+        if plan is not None and (self.num_chunks == 1
+                                 or self.accumulate_steps % self.num_stages
+                                 == 0):
+            return self._build_fused_step(optimizer, plan, loss_fn)
+        return self._build_sequential_step(optimizer, loss_fn)
+
+    def _build_fused_step(self, optimizer, plan, loss_fn=None):
+        from ..pipelining import pipeline_apply, pipeline_apply_interleaved
         model = self._layers
         loss_fn = loss_fn or model.loss_fn
         M = self.accumulate_steps
         S = self.num_stages
+        V = self.num_chunks
+        mesh = self.mesh
+        template = model.get_chunk_layers(0)
+
+        def run_chunk(chunk_params, x):
+            h = x
+            for j, layer in enumerate(template):
+                pref = f"{j}."
+                sub = {k[len(pref):]: v for k, v in chunk_params.items()
+                       if k.startswith(pref)}
+                h, _ = functional_call(layer, sub, None, (h,))
+            return h
+
+        def step(params, buffers, opt_state, x, y, lr):
+            mb_x = jnp.reshape(x, (M, x.shape[0] // M) + x.shape[1:])
+            mb_y = jnp.reshape(y, (M, y.shape[0] // M) + y.shape[1:])
+
+            def total_loss(p):
+                if V == 1:
+                    stacked = {lk: jnp.stack([p[plan[s][lk]]
+                                              for s in range(S)])
+                               for lk in plan[0]}
+                    outs = pipeline_apply(
+                        lambda cp, h: run_chunk(
+                            jax.tree.map(lambda a: a[0], cp), h),
+                        stacked, mb_x, mesh, S)
+                else:
+                    order = [v * S + s for s in range(S) for v in range(V)]
+                    stacked = {lk: jnp.stack([p[plan[c][lk]]
+                                              for c in order])
+                               for lk in plan[0]}
+                    outs = pipeline_apply_interleaved(
+                        run_chunk, stacked, mb_x, mesh, S, V)
+                losses = [loss_fn(outs[m], mb_y[m]) for m in range(M)]
+                return jnp.mean(jnp.stack(losses))
+
+            loss, grads = jax.value_and_grad(total_loss)(params)
+            new_params, new_opt = optimizer.update(grads, opt_state, params,
+                                                   lr=lr)
+            # uniform chunk stages carry no mutable buffers (documented)
+            return new_params, buffers, new_opt, loss
+
+        return step
+
+    def _build_sequential_step(self, optimizer, loss_fn=None):
+        model = self._layers
+        loss_fn = loss_fn or model.loss_fn
+        M = self.accumulate_steps
 
         def step(params, buffers, opt_state, x, y, lr):
             mb_x = jnp.reshape(x, (M, x.shape[0] // M) + x.shape[1:])
@@ -82,7 +178,7 @@ class PipelineParallel(MetaParallelBase):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Reference signature: data=[x, y]; returns the batch loss."""
         x, y = data
-        params, buffers = state(self)
+        params, buffers = state(self._layers)
         if self._opt is not optimizer or self._jit_train is None:
             self._opt = optimizer
             step = self.build_train_step(optimizer)
@@ -92,9 +188,8 @@ class PipelineParallel(MetaParallelBase):
         new_params, new_buf, self._opt_state, loss = self._jit_train(
             params, buffers, self._opt_state, jnp.asarray(x), jnp.asarray(y),
             lr)
-        # write back
-        from ...nn.functional_call import _index_stores, _write
-        pindex, bindex = _index_stores(self)
+        # write back into the wrapped model's stores
+        pindex, bindex = _index_stores(self._layers)
         _write(pindex, new_params)
         _write(bindex, {k: v for k, v in new_buf.items() if k in bindex},
                strict=False)
@@ -104,8 +199,9 @@ class PipelineParallel(MetaParallelBase):
 
     def eval_batch(self, data, compute_loss: bool = True):
         x, y = data
-        params, buffers = state(self)
-        out, _ = functional_call(self, params, buffers, (x,), train=False)
+        params, buffers = state(self._layers)
+        out, _ = functional_call(self._layers, params, buffers, (x,),
+                                 train=False)
         if compute_loss and self._layers.loss_fn is not None:
             return self._layers.loss_fn(out, jnp.asarray(y))
         return out

@@ -65,6 +65,9 @@ class PipelineLayer(Layer):
         if num_stages is None:
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self.num_stages = num_stages
+        # VPP (reference: PipelineParallelWithInterleave): V chunks per
+        # stage, segmented round-robin — chunk c lives on device c % S
+        self.num_virtual_stages = max(num_virtual_pipeline_stages, 1)
         self.loss_fn = loss_fn
         self.seg_method = seg_method
         self.recompute_interval = recompute_interval
@@ -92,7 +95,8 @@ class PipelineLayer(Layer):
 
     def _segment(self):
         n = len(self.run_function)
-        s = self.num_stages
+        # with VPP the unit of placement is the chunk: S*V segments
+        s = self.num_stages * self.num_virtual_stages
         if self.seg_method.startswith("layer:"):
             # segment at boundaries of the named layer class (reference:
             # seg_method='layer:TransformerBlock')
@@ -112,18 +116,27 @@ class PipelineLayer(Layer):
                 bounds.append(bounds[-1] + per + (1 if k < extra else 0))
         self.segment_parts = bounds
 
-    def get_stage_layers(self, stage_id: int) -> List[Layer]:
-        lo = self.segment_parts[stage_id]
-        hi = self.segment_parts[stage_id + 1]
+    def get_chunk_layers(self, chunk_id: int) -> List[Layer]:
+        """Layers of global chunk ``chunk_id`` (S*V chunks; == stage when
+        V == 1).  Chunk c is placed on device c % S (round-robin, VPP)."""
+        lo = self.segment_parts[chunk_id]
+        hi = self.segment_parts[chunk_id + 1]
         return [self.run_function[i] for i in range(lo, hi)]
 
+    def get_stage_layers(self, stage_id: int) -> List[Layer]:
+        """All layers living on device ``stage_id`` (its V chunks)."""
+        out: List[Layer] = []
+        for v in range(self.num_virtual_stages):
+            out.extend(self.get_chunk_layers(v * self.num_stages + stage_id))
+        return out
+
     def stages_uniform(self) -> bool:
-        """True when every stage has the same layer-type sequence (enables
+        """True when every chunk has the same layer-type sequence (enables
         the fused scan+ppermute runtime)."""
         sigs = []
-        for sid in range(self.num_stages):
+        for cid in range(self.num_stages * self.num_virtual_stages):
             sigs.append(tuple(type(l).__name__
-                              for l in self.get_stage_layers(sid)))
+                              for l in self.get_chunk_layers(cid)))
         return len(set(sigs)) == 1
 
     def forward(self, x, *args):

@@ -30,7 +30,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "stack_stage_params", "stage_param_specs"]
+__all__ = ["pipeline_apply", "pipeline_apply_interleaved",
+           "stack_stage_params", "stack_interleaved_stage_params",
+           "stage_param_specs"]
 
 
 def stack_stage_params(per_stage_params: list):
@@ -121,6 +123,107 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         pipelined, mesh=mesh,
         in_specs=(param_specs, in_x_spec),
         out_specs=in_x_spec,
+        check_vma=False,
+        axis_names={"pp"})
+    return fn(stacked_params, x_microbatches)
+
+
+def stack_interleaved_stage_params(per_chunk_params: list, n_stages: int,
+                                   n_chunks: int):
+    """[{name: arr}, ...] for S*V chunks (global chunk order) -> stacked
+    {name: arr[S*V, ...]} laid out so a P('pp') sharding gives device ``s``
+    the contiguous slice [s*V, (s+1)*V) = its round-robin chunks
+    {s, s+S, ..., s+(V-1)S} (reference VPP placement:
+    PipelineParallelWithInterleave's model chunks)."""
+    S, V = n_stages, n_chunks
+    order = [v * S + s for s in range(S) for v in range(V)]
+    out = {}
+    for name in per_chunk_params[0]:
+        out[name] = jnp.stack([per_chunk_params[c][name] for c in order],
+                              axis=0)
+    return out
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
+                               x_microbatches, mesh: Mesh, n_stages: int,
+                               n_chunks: int, extra_args=(),
+                               remat: bool = True):
+    """Interleaved (VPP) schedule: S devices × V chunks per device
+    (reference: meta_parallel/pipeline_parallel.py —
+    PipelineParallelWithInterleave; SURVEY.md §2.3 PP row).
+
+    ``stacked_params`` leaves are [S*V, ...] in the layout produced by
+    stack_interleaved_stage_params; ``stage_fn(chunk_params, x) -> y`` runs
+    ONE chunk and must preserve activation shape.
+
+    Schedule derivation (one compute + one neighbor ppermute per device per
+    tick, like pipeline_apply): number device-local work slots n = t - s.
+    Slot n decodes as group g = n // (S*V), local chunk v = (n // S) % V,
+    within-group microbatch j = n % S, microbatch m = g*S + j.  Device s's
+    slot-n input is exactly device s-1's slot-n output from tick t-1 (the
+    same microbatch one global chunk earlier), so the ring carry works
+    unchanged; chunk-0 slots inject fresh microbatches at stage 0 and
+    chunk-(V-1) slots emit at stage S-1.  Total ticks T = M*V + S - 1: the
+    pipeline bubble is (S-1) thin-chunk ticks — V× smaller than the
+    non-interleaved schedule's, which is the point of VPP.
+
+    Requires M % S == 0 (reference imposes the same for interleave).
+    """
+    from jax import shard_map
+
+    M = x_microbatches.shape[0]
+    S = n_stages
+    V = n_chunks
+    if M % S:
+        raise ValueError(f"interleaved schedule needs microbatches ({M}) "
+                         f"divisible by pp degree ({S})")
+    T = M * V + S - 1
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+
+    def pipelined(params, xs):
+        # local leaves: [V, ...] — this device's chunks, local index v
+        stage_id = jax.lax.axis_index("pp")
+
+        def tick(carry, t):
+            state = carry
+            n = jnp.maximum(t - stage_id, 0)        # device-local slot
+            v = (n // S) % V                        # local chunk this tick
+            chunk_params = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, v, axis=0,
+                                                       keepdims=False),
+                params)
+            # stage-0 chunk-0 slots consume fresh microbatches
+            m_in = jnp.clip((n // (S * V)) * S + n % S, 0, M - 1)
+            inject = jax.lax.dynamic_index_in_dim(xs, m_in, axis=0,
+                                                  keepdims=False)
+            take_fresh = jnp.logical_and(stage_id == 0, n % (S * V) < S)
+            x_in = jnp.where(take_fresh, inject, state)
+            y = body(chunk_params, x_in, *extra_args)
+            # stage-(S-1) chunk-(V-1) slots are final outputs
+            emit = jnp.logical_and(stage_id == S - 1,
+                                   n % (S * V) >= S * (V - 1))
+            out = jnp.where(emit, y, jnp.zeros_like(y))
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            nxt = jax.lax.ppermute(y, "pp", perm)
+            return nxt, out
+
+        chunk_shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), params)
+        out_shape = jax.eval_shape(body, chunk_shapes, xs[0], *extra_args)
+        init = jnp.zeros(out_shape.shape, out_shape.dtype)
+        _, outs = jax.lax.scan(tick, init, jnp.arange(T))
+        outs = jax.lax.psum(outs, "pp")             # [T, mb, ...]
+        # microbatch m finishes at tick (m//S)*S*V + (V-1)*S + m%S + S-1
+        import numpy as _np
+        ms = _np.arange(M)
+        ticks = (ms // S) * S * V + (V - 1) * S + ms % S + S - 1
+        return jnp.take(outs, jnp.asarray(ticks), axis=0)
+
+    fn = shard_map(
+        pipelined, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
         check_vma=False,
         axis_names={"pp"})
     return fn(stacked_params, x_microbatches)

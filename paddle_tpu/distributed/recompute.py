@@ -1,0 +1,62 @@
+"""Standalone recompute (activation checkpointing) parity functions.
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py —
+RecomputeFunction (a PyLayer stashing inputs, re-running forward during
+backward with the RNG-state tracker restored so dropout masks match) and
+recompute_sequential.
+
+TPU-native: jax.checkpoint IS the recompute engine — it rematerializes the
+wrapped computation in the backward pass, and because JAX RNG is explicit
+(keys are values, threaded by rng_context / RNGStatesTracker), replayed
+dropout draws the SAME mask by construction: no state juggling needed.
+``preserve_rng_state`` is therefore accepted and always true in effect.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """Reference: fleet.utils.recompute(fn, *args) — run fn now, recompute
+    its activations during backward.
+
+    Accepted kwargs (parity): ``use_reentrant`` (ignored; jax.checkpoint
+    has one semantics), ``preserve_rng_state`` (always effectively True).
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    policy = kwargs.pop("checkpoint_policy", None)
+    fn = jax.checkpoint(function, policy=policy)
+    return fn(*args, **kwargs)
+
+
+def recompute_sequential(ctx: dict, functions: Sequence[Callable], *args):
+    """Reference: recompute_sequential({'segments': k}, nn.Sequential(...))
+    — checkpoint a layer list in k segments."""
+    segments = int(ctx.get("segments", 1)) if ctx else 1
+    funcs = list(functions)
+    n = len(funcs)
+    per = max(n // max(segments, 1), 1)
+
+    def seg_fn(fs):
+        def run(*xs):
+            out = xs
+            for f in fs:
+                out = f(*out) if isinstance(out, tuple) else f(out)
+                out = out if isinstance(out, tuple) else (out,)
+            return out[0] if len(out) == 1 else out
+        return run
+
+    out = args
+    i = 0
+    while i < n:
+        fs = funcs[i:i + per]
+        out = out if isinstance(out, tuple) else (out,)
+        out = (recompute(seg_fn(fs), *out),)
+        i += per
+    return out[0]

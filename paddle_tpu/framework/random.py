@@ -51,7 +51,11 @@ class Generator:
     """
 
     def __init__(self, seed_: int = 0):
-        self._key = jax.random.key(seed_)
+        # key creation is LAZY: materializing a PRNG key initializes the
+        # XLA backend, and this class is instantiated at import time — an
+        # eager key would break jax.distributed.initialize() (which must
+        # run before any backend touch) for every importer
+        self._key = None
         self._seed = seed_
         self._lock = threading.Lock()
 
@@ -62,11 +66,16 @@ class Generator:
 
     def next_key(self) -> jax.Array:
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return self._key
 
     def set_state(self, key) -> None:
         with self._lock:

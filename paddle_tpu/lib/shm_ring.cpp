@@ -1,0 +1,181 @@
+// Shared-memory ring buffer for the DataLoader's multiprocess path.
+//
+// Reference role: paddle/fluid/operators/reader/ — the C++ blocking queue
+// (BufferedReader/BlockingQueue) that worker subprocesses push decoded
+// samples into via shared memory (SURVEY.md §2.2 DataLoader row; §7 names
+// this the natural native component of the TPU build).
+//
+// Design: one anonymous MAP_SHARED region created by the parent BEFORE
+// fork(), so worker children inherit the same physical pages — no
+// shm_open namespace, nothing to clean up on crash.  Fixed-size slots in
+// a classic bounded ring guarded by PROCESS_SHARED + ROBUST pthread
+// primitives: if a worker dies mid-push the consumer recovers the mutex
+// (EOWNERDEAD -> pthread_mutex_consistent) instead of deadlocking.
+// Payloads are opaque bytes (the Python side writes pickle-protocol-5
+// frames straight into the slot — one copy, no pipe syscalls, vs. the
+// three copies of multiprocessing.Queue).
+//
+// C ABI (ctypes-consumed; see paddle_tpu/io/shm_ring.py):
+//   rb_create(slot_size, n_slots) -> handle (mmap base) or NULL
+//   rb_push(h, data, len, timeout_ms) -> 0 ok / -1 timeout / -2 oversize
+//   rb_pop(h, out, cap, timeout_ms) -> payload len / -1 timeout / -3 small
+//   rb_size(h) -> filled slot count
+//   rb_destroy(h) -> munmap
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <pthread.h>
+#include <sys/mman.h>
+
+namespace {
+
+struct Header {
+  uint64_t slot_size;
+  uint64_t n_slots;
+  uint64_t head;   // next slot to write
+  uint64_t tail;   // next slot to read
+  uint64_t count;  // filled slots
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+};
+
+inline uint64_t* lengths(Header* h) {
+  return reinterpret_cast<uint64_t*>(reinterpret_cast<char*>(h) +
+                                     sizeof(Header));
+}
+
+inline char* slot(Header* h, uint64_t i) {
+  return reinterpret_cast<char*>(h) + sizeof(Header) +
+         h->n_slots * sizeof(uint64_t) + i * h->slot_size;
+}
+
+inline void abstime_in(int timeout_ms, timespec* ts) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += static_cast<long>(timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// Lock handling robust-mutex recovery; returns 0 or an errno.
+inline int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    // previous owner died: state is a counter ring, always consistent
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rb_create(uint64_t slot_size, uint64_t n_slots) {
+  uint64_t bytes = sizeof(Header) + n_slots * sizeof(uint64_t) +
+                   slot_size * n_slots;
+  void* base = mmap(nullptr, bytes, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+  if (base == MAP_FAILED) return nullptr;
+  Header* h = static_cast<Header*>(base);
+  h->slot_size = slot_size;
+  h->n_slots = n_slots;
+  h->head = h->tail = h->count = 0;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_mutexattr_destroy(&ma);
+
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_full, &ca);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_condattr_destroy(&ca);
+  return base;
+}
+
+uint64_t rb_total_bytes(void* base) {
+  Header* h = static_cast<Header*>(base);
+  return sizeof(Header) + h->n_slots * sizeof(uint64_t) +
+         h->slot_size * h->n_slots;
+}
+
+int rb_push(void* base, const void* data, uint64_t len, int timeout_ms) {
+  Header* h = static_cast<Header*>(base);
+  if (len > h->slot_size) return -2;
+  if (lock(h) != 0) return -4;
+  while (h->count == h->n_slots) {
+    timespec ts;
+    abstime_in(timeout_ms, &ts);
+    int rc = pthread_cond_timedwait(&h->not_full, &h->mu, &ts);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+    else if (rc == ETIMEDOUT && h->count == h->n_slots) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint64_t i = h->head;
+  memcpy(slot(h, i), data, len);
+  lengths(h)[i] = len;
+  h->head = (i + 1) % h->n_slots;
+  h->count += 1;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int64_t rb_pop(void* base, void* out, uint64_t cap, int timeout_ms) {
+  Header* h = static_cast<Header*>(base);
+  if (lock(h) != 0) return -4;
+  while (h->count == 0) {
+    timespec ts;
+    abstime_in(timeout_ms, &ts);
+    int rc = pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+    else if (rc == ETIMEDOUT && h->count == 0) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint64_t i = h->tail;
+  uint64_t len = lengths(h)[i];
+  if (len > cap) {
+    pthread_mutex_unlock(&h->mu);
+    return -3;
+  }
+  memcpy(out, slot(h, i), len);
+  h->tail = (i + 1) % h->n_slots;
+  h->count -= 1;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+uint64_t rb_size(void* base) {
+  Header* h = static_cast<Header*>(base);
+  if (lock(h) != 0) return 0;
+  uint64_t c = h->count;
+  pthread_mutex_unlock(&h->mu);
+  return c;
+}
+
+uint64_t rb_slot_size(void* base) {
+  return static_cast<Header*>(base)->slot_size;
+}
+
+void rb_destroy(void* base) {
+  munmap(base, rb_total_bytes(base));
+}
+
+}  // extern "C"

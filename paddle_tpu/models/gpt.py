@@ -116,9 +116,12 @@ class GPTBlock(Layer):
             k = jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1)
             v = jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1)
             new_cache = (k, v, pos + s)
-            # decode: mask out positions beyond pos+s via explicit mask
+            # decode: per-query causal mask (query at chunk offset t sees
+            # keys up to pos+t) so multi-token chunked prefill is causal
+            # within the chunk
             kpos = jnp.arange(k.shape[1])
-            mask = (kpos[None, None, None, :] <= (pos + s - 1))
+            qpos = pos + jnp.arange(s)
+            mask = (kpos[None, None, None, :] <= qpos[None, None, :, None])
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=mask, training=self.training)
         elif cfg.cp:

@@ -116,8 +116,12 @@ class LlamaAttention(Layer):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         if cache is not None:
+            # per-query causal mask: query at chunk offset t sees keys up to
+            # absolute position pos+t, so multi-token (chunked) prefill via
+            # decode_step stays causal WITHIN the chunk too
             kpos = jnp.arange(k.shape[1])
-            mask = (kpos[None, None, None, :] <= (cache[2] + s - 1))
+            qpos = cache[2] + jnp.arange(s)
+            mask = (kpos[None, None, None, :] <= qpos[None, None, :, None])
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
                                                  training=self.training)
         else:

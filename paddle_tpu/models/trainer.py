@@ -86,6 +86,29 @@ class GPTHybridTrainer:
         self.specs_blocks = stacked_specs
         self.template_block = self.model.gpt.h[0]
 
+        # ZeRO slot specs (stage >= 1) — also grad specs for stage >= 2 and
+        # param specs for stage 3 (reference: GroupShardedStage2/3 grad
+        # reduce-scatter + param gather-on-use; here: sharding declarations
+        # XLA lowers to exactly that collective pattern)
+        shard_deg = self.hcg.get_sharding_parallel_world_size()
+        if shard_deg > 1:
+            self.slot_specs_nb = shard_opt_state_specs(
+                self.specs_nonblock,
+                {k: tuple(v.shape) for k, v in nonblock.items()},
+                "sharding", shard_deg)
+            self.slot_specs_blk = shard_opt_state_specs(
+                self.specs_blocks,
+                {k: tuple(v.shape) for k, v in stacked.items()},
+                "sharding", shard_deg)
+        else:
+            self.slot_specs_nb = self.specs_nonblock
+            self.slot_specs_blk = self.specs_blocks
+        if self.zero >= 3 and shard_deg > 1:
+            # stage 3: parameters THEMSELVES live sharded; GSPMD inserts
+            # the all-gather at each use site
+            self.specs_nonblock = self.slot_specs_nb
+            self.specs_blocks = self.slot_specs_blk
+
     def batch_spec(self):
         axes = []
         if self.hcg.get_data_parallel_world_size() > 1:
@@ -105,14 +128,8 @@ class GPTHybridTrainer:
         opt_blk = self.opt.init(pblk)
         shard_deg = self.hcg.get_sharding_parallel_world_size()
         if self.zero >= 1 and shard_deg > 1:
-            slot_nb = shard_opt_state_specs(
-                self.specs_nonblock,
-                {k: tuple(v.shape) for k, v in self.params_nonblock.items()},
-                "sharding", shard_deg)
-            slot_blk = shard_opt_state_specs(
-                self.specs_blocks,
-                {k: tuple(v.shape) for k, v in self.params_blocks.items()},
-                "sharding", shard_deg)
+            slot_nb = self.slot_specs_nb
+            slot_blk = self.slot_specs_blk
         else:
             slot_nb = self.specs_nonblock
             slot_blk = self.specs_blocks
@@ -184,12 +201,29 @@ class GPTHybridTrainer:
 
     def build_step(self):
         opt = self.opt
+        zero2 = (self.zero >= 2 and
+                 self.hcg.get_sharding_parallel_world_size() > 1)
 
         def step(pnb, pblk, opt_nb, opt_blk, ids, labels, lr):
             loss, (g_nb, g_blk) = jax.value_and_grad(
                 self.loss_fn, argnums=(0, 1))(pnb, pblk, ids, labels)
+            if zero2:
+                # stage 2: materialize grads SHARDED — XLA turns the dp/
+                # sharding grad all-reduce into reduce-scatter + the update
+                # math runs on 1/degree of each tensor
+                g_nb = {k: _maybe_constraint(v, self.slot_specs_nb[k])
+                        for k, v in g_nb.items()}
+                g_blk = {k: _maybe_constraint(v, self.slot_specs_blk[k])
+                         for k, v in g_blk.items()}
             new_nb, opt_nb = opt.update(g_nb, opt_nb, pnb, lr=lr)
             new_blk, opt_blk = opt.update(g_blk, opt_blk, pblk, lr=lr)
+            if zero2 and self.zero < 3:
+                # params stay unsharded in stages 1/2: bring the updated
+                # values back to their declared layout
+                new_nb = {k: _maybe_constraint(v, self.specs_nonblock[k])
+                          for k, v in new_nb.items()}
+                new_blk = {k: _maybe_constraint(v, self.specs_blocks[k])
+                           for k, v in new_blk.items()}
             return new_nb, new_blk, opt_nb, opt_blk, loss
 
         return step

@@ -1,0 +1,46 @@
+"""Static-graph API surface (thin on TPU).
+
+Reference: python/paddle/static/ — Program/Executor/InputSpec and
+save/load_inference_model (SURVEY.md §2.2 "static API", §1 L2/L9).
+
+TPU-native: there is no separate static graph — jit tracing IS the static
+path (jaxpr/StableHLO stand in for ProgramDesc/PIR).  What survives of the
+reference surface here is what users actually carry across: ``InputSpec``
+(shape/dtype declarations for export) and the inference-model save/load
+entry points, which delegate to paddle_tpu.jit's jax.export-based
+serialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+@dataclasses.dataclass
+class InputSpec:
+    """Reference: paddle.static.InputSpec(shape, dtype, name); None dims are
+    dynamic (exported as symbolic dimensions)."""
+    shape: Sequence[Optional[int]]
+    dtype: str = "float32"
+    name: Optional[str] = None
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(tuple(t.shape), str(t.dtype), name)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Parity shim: paddle.static.save_inference_model.  ``feed_vars`` must
+    be InputSpecs and ``fetch_vars`` a jittable fn or Layer here (the
+    program-based form has no TPU analog)."""
+    from ..jit import save
+    save(fetch_vars, path_prefix, input_spec=list(feed_vars))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from ..jit import load
+    return load(path_prefix)

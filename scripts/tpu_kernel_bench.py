@@ -1,0 +1,151 @@
+"""Pallas kernel tier: correctness + speedup vs XLA-composed equivalents,
+run UNINTERPRETED on the real chip (round-2 VERDICT item 2: prove the
+kernels on hardware, not just interpret mode).
+
+Prints one JSON line:
+  {"kernels": {name: {"ok": bool, "max_err": float, "pallas_ms": float,
+                      "xla_ms": float, "speedup": float}}}
+
+Usage: python scripts/tpu_kernel_bench.py   (on the TPU host)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time(fn, *args, iters=10):
+    out = fn(*args)          # compile
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _force(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _force(x):
+    import jax
+    # float() on one element forces real completion on the axon backend
+    # (block_until_ready alone is a weak sync there)
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(leaf.reshape(-1)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import (flash_attention, fused_adamw_update,
+                                    fused_rms_norm_pallas)
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+
+    results = {}
+    rs = np.random.RandomState(0)
+
+    # ---- flash attention fwd+bwd, causal, bf16, b4 h16 s2048 d128 -------
+    b, s, h, d = 4, 2048, 16, 128
+    q = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+
+    @jax.jit
+    def fa_fwdbwd(q, k, v):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=False) ** 2)
+        l, g = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return l, g
+
+    @jax.jit
+    def xla_fwdbwd(q, k, v):
+        def f(q, k, v):
+            return jnp.sum(sdpa_reference(q, k, v, is_causal=True,
+                                          training=False) ** 2)
+        l, g = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return l, g
+
+    lp, gp = fa_fwdbwd(q, k, v)
+    lx, gx = xla_fwdbwd(q, k, v)
+    err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                    b_.astype(jnp.float32))))
+              for a, b_ in zip(gp, gx))
+    rel = abs(float(lp) - float(lx)) / max(abs(float(lx)), 1e-6)
+    t_p = _time(fa_fwdbwd, q, k, v)
+    t_x = _time(xla_fwdbwd, q, k, v)
+    results["flash_attention_fwdbwd"] = {
+        "ok": bool(rel < 2e-2 and err < 1.0), "loss_rel_err": round(rel, 5),
+        "grad_max_err": round(err, 4),
+        "pallas_ms": round(t_p, 2), "xla_ms": round(t_x, 2),
+        "speedup": round(t_x / t_p, 3)}
+
+    # ---- fused AdamW, 64M params ---------------------------------------
+    n = 64 * 1024 * 1024
+    p = jnp.asarray(rs.randn(n), jnp.float32)
+    g = jnp.asarray(rs.randn(n), jnp.float32) * 0.01
+    m = jnp.zeros(n, jnp.float32)
+    v2 = jnp.zeros(n, jnp.float32)
+
+    @jax.jit
+    def adamw_pallas(p, g, m, v2):
+        return fused_adamw_update(p, g, m, v2, step=1, lr=1e-3, beta1=0.9,
+                                  beta2=0.999, epsilon=1e-8,
+                                  weight_decay=0.01, interpret=False)
+
+    @jax.jit
+    def adamw_xla(p, g, m, v2):
+        b1, b2, lr, eps, wd = 0.9, 0.999, 1e-3, 1e-8, 0.01
+        m2 = b1 * m + (1 - b1) * g
+        v3 = b2 * v2 + (1 - b2) * g * g
+        mh = m2 / (1 - b1)
+        vh = v3 / (1 - b2)
+        p2 = p - lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
+        return p2, m2, v3
+
+    outs_p = adamw_pallas(p, g, m, v2)
+    outs_x = adamw_xla(p, g, m, v2)
+    err = max(float(jnp.max(jnp.abs(a - b_))) for a, b_ in
+              zip(outs_p, outs_x))
+    t_p = _time(adamw_pallas, p, g, m, v2)
+    t_x = _time(adamw_xla, p, g, m, v2)
+    results["fused_adamw"] = {
+        "ok": bool(err < 1e-5), "max_err": float(err),
+        "pallas_ms": round(t_p, 2), "xla_ms": round(t_x, 2),
+        "speedup": round(t_x / t_p, 3)}
+
+    # ---- fused RMSNorm, [8192, 4096] bf16 ------------------------------
+    x = jnp.asarray(rs.randn(8192, 4096), jnp.bfloat16)
+    w = jnp.asarray(rs.randn(4096), jnp.float32)
+
+    @jax.jit
+    def rms_pallas(x, w):
+        return fused_rms_norm_pallas(x, w, 1e-6, interpret=False)
+
+    @jax.jit
+    def rms_xla(x, w):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * w
+        return out.astype(x.dtype)
+
+    op = rms_pallas(x, w)
+    ox = rms_xla(x, w)
+    err = float(jnp.max(jnp.abs(op.astype(jnp.float32) -
+                                ox.astype(jnp.float32))))
+    t_p = _time(rms_pallas, x, w)
+    t_x = _time(rms_xla, x, w)
+    results["fused_rms_norm"] = {
+        "ok": bool(err < 0.1), "max_err": round(err, 4),
+        "pallas_ms": round(t_p, 3), "xla_ms": round(t_x, 3),
+        "speedup": round(t_x / t_p, 3)}
+
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "kernels": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,14 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CRITICAL for every CHILD process tests spawn (DataLoader workers,
+# dist.spawn, launcher containers, jit fresh-process checks): the axon
+# sitecustomize registers the TPU backend at interpreter startup when
+# PALLAS_AXON_POOL_IPS is set, which can block ~100s per child on a
+# contended chip.  Without it, children skip axon and honor
+# JAX_PLATFORMS=cpu from this env.  (The CURRENT process already ran
+# sitecustomize — the clear_backends below handles it.)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -20,16 +28,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
-# The axon sitecustomize eagerly initializes the single-chip TPU backend at
-# interpreter startup, before this conftest runs, so the env vars above are
-# too late.  Reset to an 8-device virtual CPU mesh (SURVEY.md §4: all
-# distributed tests run single-host on virtual devices).
-if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
-    import jax.extend.backend as _jeb
-    _jeb.clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-    assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu"
+# The axon sitecustomize eagerly registers the TPU backend at interpreter
+# startup, before this conftest runs, so the env vars above are too late —
+# and probing via jax.devices() would INITIALIZE that backend (which can
+# hang indefinitely on a contended chip; round-1 VERDICT).  Force the
+# 8-device virtual CPU mesh unconditionally: config.update + clear_backends
+# never touch hardware (SURVEY.md §4: all distributed tests single-host).
+import jax.extend.backend as _jeb  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+_jeb.clear_backends()
+assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu"
 
 # this environment's CPU backend defaults to low-precision matmul; tests
 # compare against float64/float32 numpy references

@@ -1,0 +1,36 @@
+"""Worker for the multi-process launch smoke test: boots jax.distributed
+from the launcher's env contract (PADDLE_MASTER/TRAINER_ID/TRAINERS_NUM),
+then all_reduces a rank-dependent value across the 2-process world
+(SURVEY.md §3.3 call stack, exercised for real)."""
+
+import os
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+import jax.extend.backend as jeb
+jeb.clear_backends()
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+assert jax.process_count() == 2, jax.process_count()
+rank = dist.get_rank()
+assert rank == int(os.environ["PADDLE_TRAINER_ID"])
+
+group = dist.collective._default_group()
+mesh = group.mesh
+arr = jax.make_array_from_callback(
+    (2,), NamedSharding(mesh, P(group.name)),
+    lambda idx: np.asarray([idx[0].start + 1.0], np.float32))
+out = dist.all_reduce(arr)
+local = float(np.asarray(out.addressable_shards[0].data)[0])
+assert local == 3.0, local
+print(f"ALLREDUCE_OK rank={rank} value={local}")

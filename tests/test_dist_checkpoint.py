@@ -148,3 +148,15 @@ def test_model_state_roundtrip_with_training(tmp_path):
     e2._params = dict(load_state_dict(e2._params, str(tmp_path)))
     got = e2.fit(data, epochs=1)
     np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_metadata_without_shards_raises(tmp_path):
+    # a tensor present in metadata but with zero saved shards must raise,
+    # not silently load as zeros (ADVICE r1)
+    from paddle_tpu.distributed.checkpoint.load_state_dict import (
+        _assemble_region, _ShardReader)
+    from paddle_tpu.distributed.checkpoint.metadata import TensorMeta
+    tm = TensorMeta(name="w", global_shape=(4, 4), dtype="float32", shards=[])
+    reader = _ShardReader(str(tmp_path))
+    with pytest.raises(ValueError, match="cover"):
+        _assemble_region(tm, reader, (slice(0, 4), slice(0, 4)))

@@ -191,3 +191,15 @@ def test_fused_mt_seq_lens_keeps_causality():
     with_lens = m(x, seq_lens=jnp.asarray([6, 6]))  # no actual padding
     np.testing.assert_allclose(np.asarray(with_lens),
                                np.asarray(causal_only), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_rms_norm_dtype_consistent_across_routes():
+    # bf16 x with f32 weight must return bf16 on BOTH the Pallas route
+    # (hidden % 128 == 0) and the XLA fallback (ADVICE r1)
+    rs = np.random.RandomState(3)
+    w128 = jnp.ones(128, jnp.float32)
+    w96 = jnp.ones(96, jnp.float32)
+    x128 = jnp.asarray(rs.randn(2, 4, 128), jnp.bfloat16)
+    x96 = jnp.asarray(rs.randn(2, 4, 96), jnp.bfloat16)
+    assert IF.fused_rms_norm(x128, w128).dtype == jnp.bfloat16
+    assert IF.fused_rms_norm(x96, w96).dtype == jnp.bfloat16

@@ -1,0 +1,134 @@
+"""jit.to_static / jit.save / jit.load / inference predictor tests
+(reference: paddle.jit.save+load round-trip and AnalysisPredictor smoke —
+SURVEY.md §1 L9, §3.5; VERDICT r1 missing item: export path)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static, save, load, StaticFunction
+from paddle_tpu.static import InputSpec
+from paddle_tpu.nn.functional_call import state
+
+
+class SmallNet(nn.Layer):
+    def __init__(self, d=8, h=16):
+        super().__init__()
+        self.fc1 = nn.Linear(d, h)
+        self.fc2 = nn.Linear(h, 4)
+
+    def forward(self, x):
+        return self.fc2(jnp.tanh(self.fc1(x)))
+
+
+def test_to_static_matches_eager():
+    paddle_tpu.seed(0)
+    net = SmallNet()
+    net.eval()
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 8), jnp.float32)
+    eager = net(x)
+    st = to_static(net)
+    assert isinstance(st, StaticFunction)
+    np.testing.assert_allclose(np.asarray(st(x)), np.asarray(eager),
+                               rtol=1e-6, atol=1e-6)
+    # decorator form on a plain function
+    @to_static
+    def f(a):
+        return jnp.sin(a) * 2
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(jnp.sin(x) * 2),
+                               rtol=1e-6)
+
+
+def test_save_load_roundtrip_same_process(tmp_path):
+    paddle_tpu.seed(1)
+    net = SmallNet()
+    net.eval()
+    x = jnp.asarray(np.random.RandomState(1).randn(5, 8), jnp.float32)
+    ref = np.asarray(net(x))
+    prefix = str(tmp_path / "model")
+    save(net, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    assert os.path.exists(prefix + ".pdiparams.npz")
+    loaded = load(prefix)
+    got = np.asarray(loaded(x))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    # dynamic batch: a different batch size runs through the same artifact
+    x2 = jnp.asarray(np.random.RandomState(2).randn(9, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(loaded(x2)), np.asarray(net(x2)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_save_load_fresh_process(tmp_path):
+    """The VERDICT's oracle: train -> save -> FRESH process load -> same
+    logits (no Python model class available in the loader)."""
+    paddle_tpu.seed(2)
+    net = SmallNet()
+    net.eval()
+    x = np.random.RandomState(3).randn(4, 8).astype(np.float32)
+    ref = np.asarray(net(jnp.asarray(x)))
+    prefix = str(tmp_path / "m")
+    save(net, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    np.save(str(tmp_path / "x.npy"), x)
+
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as jeb
+jeb.clear_backends()
+import sys, numpy as np
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from paddle_tpu.jit import load
+m = load({prefix!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+out = np.asarray(m(x))
+np.save({str(tmp_path / 'out.npy')!r}, out)
+print("OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=240)
+    assert "OK" in r.stdout, r.stderr[-800:]
+    got = np.load(str(tmp_path / "out.npy"))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    paddle_tpu.seed(4)
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "pred")
+    save(net, prefix, input_spec=[InputSpec([None, 8], "float32",
+                                            name="input")])
+    cfg = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert names == ["input"]
+    x = np.random.RandomState(5).randn(2, 8).astype(np.float32)
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    assert pred.run()
+    out_names = pred.get_output_names()
+    out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, np.asarray(net(jnp.asarray(x))),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_static_save_load_inference_model(tmp_path):
+    import paddle_tpu.static as static
+    paddle_tpu.seed(5)
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "im")
+    static.save_inference_model(prefix, [InputSpec([None, 8], "float32")],
+                                net)
+    m = static.load_inference_model(prefix)
+    x = jnp.asarray(np.random.RandomState(6).randn(3, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(net(x)),
+                               rtol=1e-6, atol=1e-6)

@@ -1,0 +1,86 @@
+"""Native runtime components: the C++ shared-memory ring buffer
+(paddle_tpu/lib/shm_ring.cpp) and the device/memory-stats facade
+(reference: operators/reader blocking queue; memory/stats.cc —
+SURVEY.md §2.1/§2.2)."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.shm_ring import ShmRing, available
+
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="no g++/toolchain for shm ring")
+
+
+def test_ring_roundtrip_objects():
+    r = ShmRing(slot_size=1 << 20, n_slots=4)
+    payload = {"a": np.arange(1000), "b": "hello"}
+    assert r.put((1, payload)) == 0
+    seq, got = r.get(timeout_ms=500)
+    assert seq == 1
+    np.testing.assert_array_equal(got["a"], payload["a"])
+    assert got["b"] == "hello"
+    r.close()
+
+
+def test_ring_timeout_and_capacity():
+    r = ShmRing(slot_size=4096, n_slots=2)
+    assert r.get(timeout_ms=20) is None          # empty -> timeout
+    assert r.put("x") == 0
+    assert r.put("y") == 0
+    assert r.put("z", timeout_ms=20) == -1       # full -> timeout
+    assert r.put_bytes(b"0" * 8192) == ShmRing.PUSH_OVERSIZE
+    assert r.qsize() == 2
+    assert r.get() == "x"                        # FIFO order
+    assert r.get() == "y"
+    r.close()
+
+
+def test_ring_cross_process_fork():
+    r = ShmRing(slot_size=1 << 20, n_slots=4)
+
+    def child():
+        r.put(("from-child", os.getpid()))
+
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=child)
+    p.start()
+    p.join(10)
+    tag, pid = r.get(timeout_ms=2000)
+    assert tag == "from-child" and pid == p.pid
+    r.close()
+
+
+def test_dataloader_uses_ring():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Ds(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return np.full((4,), i, np.float32)
+
+    dl = DataLoader(Ds(), batch_size=4, num_workers=2, shuffle=False,
+                    use_shared_memory=True)
+    it = iter(dl)
+    assert it.ring is not None                   # native path engaged
+    seen = [b for b in it]
+    assert len(seen) == 8
+    np.testing.assert_array_equal(seen[0][0], np.zeros(4, np.float32))
+    np.testing.assert_array_equal(seen[7][3], np.full(4, 31, np.float32))
+
+
+def test_device_memory_stats_facade():
+    import paddle_tpu.device as device
+    assert device.device_count() >= 1
+    assert isinstance(device.memory_allocated(), int)
+    assert isinstance(device.max_memory_allocated(), int)
+    assert device.cuda.max_memory_allocated() == device.max_memory_allocated()
+    assert not device.is_compiled_with_cuda()
+    device.synchronize()

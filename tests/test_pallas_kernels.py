@@ -177,3 +177,45 @@ def test_fused_adamw_optimizer_matches_adamw():
     for k in p1:
         np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+@pytest.mark.parametrize("sq,sk", [(64, 128), (32, 96)])
+def test_flash_attention_causal_cross_length(sq, sk):
+    # bottom-right-aligned causal mask for seq_q != seq_k must match the
+    # sdpa_reference convention (ADVICE r1: mask was top-left aligned)
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(2, sq, 2, 64).astype(np.float32) * 0.5)
+    k = jnp.asarray(rs.randn(2, sk, 2, 64).astype(np.float32) * 0.5)
+    v = jnp.asarray(rs.randn(2, sk, 2, 64).astype(np.float32) * 0.5)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = sdpa_reference(q, k, v, is_causal=True, training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_causal_cross_length_grad():
+    rs = np.random.RandomState(8)
+    q = jnp.asarray(rs.randn(1, 64, 2, 64).astype(np.float32) * 0.5)
+    k = jnp.asarray(rs.randn(1, 128, 2, 64).astype(np.float32) * 0.5)
+    v = jnp.asarray(rs.randn(1, 128, 2, 64).astype(np.float32) * 0.5)
+
+    def f(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_fa = jax.grad(f(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f(lambda q, k, v: sdpa_reference(
+        q, k, v, is_causal=True, training=False)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_with_lse_gqa():
+    # kv heads < q heads must be repeated, not crash (ADVICE r1)
+    q, k, v = _qkv(h=4, kh=2)
+    out, lse = flash_attention_with_lse(q, k, v, causal=True, interpret=True)
+    ref = sdpa_reference(q, k, v, is_causal=True, training=False)
+    assert lse.shape == (q.shape[0], q.shape[2], q.shape[1])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

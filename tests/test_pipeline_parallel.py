@@ -1,0 +1,159 @@
+"""Fleet PipelineParallel.train_batch tests: fused schedule, interleaved
+(VPP) schedule, and the sequential fallback — each against a serial oracle
+(reference pattern: test/collective/fleet hybrid_parallel_pp_* runners
+assert pipelined loss == non-pipelined loss)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.distributed as dist
+import paddle_tpu.optimizer as opt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.meta_parallel.pp_layers import (LayerDesc,
+                                                            PipelineLayer)
+from paddle_tpu.distributed.meta_parallel.pipeline_parallel import (
+    PipelineParallel)
+from paddle_tpu.nn.functional_call import functional_call, state
+
+
+class Block(nn.Layer):
+    def __init__(self, d=16):
+        super().__init__()
+        self.fc = nn.Linear(d, d)
+
+    def forward(self, x):
+        return jnp.tanh(self.fc(x))
+
+
+def _loss_fn(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _init_fleet(pp):
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": pp}
+    s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
+    dist.fleet.init(is_collective=True, strategy=s)
+    return s, dist.get_hybrid_communicate_group()
+
+
+def teardown_function(_fn):
+    dist.topology.set_hybrid_communicate_group(None)
+
+
+def _serial_losses(n_blocks, xs, ys, steps, lr, seed, accumulate=4):
+    paddle_tpu.seed(seed)
+    model = PipelineLayer([LayerDesc(Block) for _ in range(n_blocks)],
+                          num_stages=1, loss_fn=_loss_fn)
+    o = opt.SGD(learning_rate=lr)
+    params, buffers = state(model)
+    ostate = o.init(params)
+    M = accumulate
+    losses = []
+    for t in range(steps):
+        x, y = xs[t], ys[t]
+        mb_x = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        mb_y = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+
+        def total(p):
+            ls = []
+            for m in range(M):
+                out, _ = functional_call(model, p, buffers, (mb_x[m],))
+                ls.append(_loss_fn(out, mb_y[m]))
+            return jnp.mean(jnp.stack(ls))
+
+        loss, g = jax.value_and_grad(total)(params)
+        params, ostate = o.update(g, ostate, params)
+        losses.append(float(loss))
+    return losses
+
+
+def _pipe_losses(n_blocks, xs, ys, steps, lr, seed, pp, vpp=1):
+    strategy, hcg = _init_fleet(pp)
+    paddle_tpu.seed(seed)
+    model = PipelineLayer([LayerDesc(Block) for _ in range(n_blocks)],
+                          num_stages=pp, loss_fn=_loss_fn,
+                          num_virtual_pipeline_stages=vpp)
+    pipe = PipelineParallel(model, hcg, strategy)
+    o = opt.SGD(learning_rate=lr)
+    losses = []
+    for t in range(steps):
+        losses.append(float(pipe.train_batch([xs[t], ys[t]], o)))
+    return losses, pipe
+
+
+def _data(steps, batch=8, d=16, seed=0):
+    rs = np.random.RandomState(seed)
+    xs = [jnp.asarray(rs.randn(batch, d), jnp.float32) for _ in range(steps)]
+    ys = [jnp.asarray(rs.randn(batch, d), jnp.float32) for _ in range(steps)]
+    return xs, ys
+
+
+def test_fused_pipeline_train_batch_matches_serial():
+    xs, ys = _data(3)
+    ref = _serial_losses(4, xs, ys, 3, 0.1, seed=21)
+    got, pipe = _pipe_losses(4, xs, ys, 3, 0.1, seed=21, pp=2)
+    assert pipe._fused_plan() is not None      # fused path really taken
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_interleaved_pipeline_train_batch_matches_serial():
+    xs, ys = _data(3, seed=1)
+    ref = _serial_losses(4, xs, ys, 3, 0.1, seed=22)
+    got, pipe = _pipe_losses(4, xs, ys, 3, 0.1, seed=22, pp=2, vpp=2)
+    assert pipe.num_chunks == 2
+    assert pipe._fused_plan() is not None
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+class Head(nn.Layer):
+    def __init__(self, d=16):
+        super().__init__()
+        self.fc = nn.Linear(d, d)
+
+    def forward(self, x):
+        return self.fc(x)       # no tanh -> stages not uniform
+
+
+def test_nonuniform_falls_back_to_sequential():
+    xs, ys = _data(2, seed=2)
+    strategy, hcg = _init_fleet(2)
+    paddle_tpu.seed(23)
+    model = PipelineLayer([LayerDesc(Block), LayerDesc(Block),
+                           LayerDesc(Block), LayerDesc(Head)],
+                          num_stages=2, loss_fn=_loss_fn)
+    pipe = PipelineParallel(model, hcg, strategy)
+    assert pipe._fused_plan() is None
+    o = opt.SGD(learning_rate=0.1)
+    got = [float(pipe.train_batch([xs[t], ys[t]], o)) for t in range(2)]
+    dist.topology.set_hybrid_communicate_group(None)
+
+    # serial oracle with identical init
+    paddle_tpu.seed(23)
+    model2 = PipelineLayer([LayerDesc(Block), LayerDesc(Block),
+                            LayerDesc(Block), LayerDesc(Head)],
+                           num_stages=1, loss_fn=_loss_fn)
+    o2 = opt.SGD(learning_rate=0.1)
+    params, buffers = state(model2)
+    ostate = o2.init(params)
+    M = 4
+    ref = []
+    for t in range(2):
+        x, y = xs[t], ys[t]
+        mb_x = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        mb_y = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+
+        def total(p):
+            ls = []
+            for m in range(M):
+                out, _ = functional_call(model2, p, buffers, (mb_x[m],))
+                ls.append(_loss_fn(out, mb_y[m]))
+            return jnp.mean(jnp.stack(ls))
+
+        loss, g = jax.value_and_grad(total)(params)
+        params, ostate = o2.update(g, ostate, params)
+        ref.append(float(loss))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
