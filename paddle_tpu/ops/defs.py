@@ -338,3 +338,336 @@ register_op("cross_entropy_op",
                  np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))
                 [np.arange(3), [0, 1, 2]]).mean(),
             _sample(lambda: _mk(3, 5)), grad_args=(0,), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# round-2 breadth tranche: oracle registrations for the remaining public
+# tensor surface (reference analog: test/legacy_test/test_*_op.py per-op
+# numpy references — SURVEY.md §4 OpTest harness)
+# ---------------------------------------------------------------------------
+import jax.numpy as _jnp
+
+
+def _ints(*shape, lo=0, hi=5):
+    return _rng.randint(lo, hi, size=shape).astype(np.int64)
+
+
+# ---- logic / comparison ---------------------------------------------------
+_binary("less_equal", T.less_equal, np.less_equal, grad=())
+_binary("greater_than", T.greater_than, np.greater, grad=())
+_binary("greater_equal", T.greater_equal, np.greater_equal, grad=())
+_binary("not_equal", T.not_equal, np.not_equal, grad=())
+_binary("equal_all", T.equal_all, lambda x, y: np.array_equal(x, y), grad=())
+_binary("isclose", T.isclose, np.isclose, grad=())
+_binary("logical_or", T.logical_or, np.logical_or,
+        _sample(lambda: _mk(3, 4) > 0, lambda: _mk(3, 4) > 0), grad=())
+_binary("logical_xor", T.logical_xor, np.logical_xor,
+        _sample(lambda: _mk(3, 4) > 0, lambda: _mk(3, 4) > 0), grad=())
+_unary("logical_not", T.logical_not, np.logical_not,
+       _sample(lambda: _mk(3, 4) > 0), grad=False)
+_unary("signbit", T.signbit, np.signbit, grad=False)
+_unary("all_red", T.all, lambda x: np.all(x), _sample(lambda: _mk(3, 4) > -2),
+       grad=False)
+_unary("any_red", T.any, lambda x: np.any(x), _sample(lambda: _mk(3, 4) > 2),
+       grad=False)
+
+# ---- bitwise --------------------------------------------------------------
+_binary("bitwise_and", T.bitwise_and, np.bitwise_and,
+        _sample(lambda: _ints(3, 4), lambda: _ints(3, 4)), grad=())
+_binary("bitwise_or", T.bitwise_or, np.bitwise_or,
+        _sample(lambda: _ints(3, 4), lambda: _ints(3, 4)), grad=())
+_binary("bitwise_xor", T.bitwise_xor, np.bitwise_xor,
+        _sample(lambda: _ints(3, 4), lambda: _ints(3, 4)), grad=())
+_unary("bitwise_not", T.bitwise_not, np.bitwise_not,
+       _sample(lambda: _ints(3, 4)), grad=False)
+_binary("bitwise_left_shift", T.bitwise_left_shift, np.left_shift,
+        _sample(lambda: _ints(3, 4), lambda: _ints(3, 4, hi=3)), grad=())
+_binary("bitwise_right_shift", T.bitwise_right_shift, np.right_shift,
+        _sample(lambda: _ints(3, 4, hi=64), lambda: _ints(3, 4, hi=3)),
+        grad=())
+_binary("gcd", T.gcd, np.gcd, _sample(lambda: _ints(3, 4, hi=30),
+                                      lambda: _ints(3, 4, hi=30)), grad=())
+_binary("lcm", T.lcm, np.lcm, _sample(lambda: _ints(3, 4, lo=1, hi=12),
+                                      lambda: _ints(3, 4, lo=1, hi=12)),
+        grad=())
+
+# ---- more elementwise math ------------------------------------------------
+_binary("remainder", T.remainder, np.remainder,
+        _sample(lambda: _mk(3, 4), lambda: _pos(3, 4)), grad=())
+_binary("float_power", T.float_power, np.float_power,
+        _sample(lambda: _pos(3, 4), lambda: _mk(3, 4, lo=0.5, hi=2.0)))
+_binary("nextafter", T.nextafter, np.nextafter, grad=())
+_binary("ldexp", T.ldexp, np.ldexp,
+        _sample(lambda: _mk(3, 4), lambda: _ints(3, 4, hi=4)), grad=())
+_binary("dot", T.dot, np.dot, _sample(lambda: _mk(5), lambda: _mk(5)))
+_binary("inner", T.inner, np.inner, _sample(lambda: _mk(3, 4),
+                                            lambda: _mk(5, 4)))
+_binary("cross", T.cross, lambda x, y: np.cross(x, y),
+        _sample(lambda: _mk(4, 3), lambda: _mk(4, 3)))
+_binary("mv", T.mv, lambda m, v: m @ v, _sample(lambda: _mk(3, 4),
+                                                lambda: _mk(4)))
+_unary("erfinv", T.erfinv, None, _sample(lambda: _mk(3, 4, lo=-0.9, hi=0.9)))
+_unary("logit", T.logit, lambda x: np.log(x / (1 - x)),
+       _sample(lambda: _mk(3, 4, lo=0.1, hi=0.9)))
+_unary("i0", T.i0, None, _sample(lambda: _pos(3, 4)))
+_unary("i0e", T.i0e, None, _sample(lambda: _pos(3, 4)))
+_unary("i1", T.i1, None, _sample(lambda: _pos(3, 4)))
+_unary("i1e", T.i1e, None, _sample(lambda: _pos(3, 4)))
+_unary("gammaln", T.gammaln, None, _sample(lambda: _pos(3, 4)))
+_unary("angle", T.angle, np.angle, grad=False)
+_unary("conj", T.conj, np.conj)
+_unary("real", T.real, np.real, grad=False)
+_unary("imag", T.imag, np.imag, grad=False)
+_unary("sgn", T.sgn, np.sign, grad=False)
+_unary("stanh", T.stanh, lambda x: np.tanh(0.67 * x) * 1.7159)
+_unary("nan_to_num", T.nan_to_num, np.nan_to_num, grad=False)
+register_op("lerp", T.lerp, lambda x, y, w: x + w * (y - x),
+            _sample(lambda: _mk(3, 4), lambda: _mk(3, 4), lambda: _mk(3, 4)),
+            grad_args=(0, 1, 2))
+register_op("clip_op", lambda x: T.clip(x, -0.5, 0.5),
+            lambda x: np.clip(x, -0.5, 0.5), _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("scale_op", lambda x: T.scale(x, scale=2.5, bias=1.0),
+            lambda x: 2.5 * x + 1.0, _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("frexp_m", lambda x: T.frexp(x)[0],
+            lambda x: np.frexp(x)[0], _sample(lambda: _pos(3, 4)))
+_unary("polygamma1", lambda x: T.polygamma(x, 1), None,
+       _sample(lambda: _pos(3, 4)))
+
+# ---- reductions / statistics ---------------------------------------------
+register_op("amax", lambda x: T.amax(x, axis=1), lambda x: np.max(x, 1),
+            _sample(lambda: _mk(3, 5)), grad_args=(0,))
+register_op("amin", lambda x: T.amin(x, axis=1), lambda x: np.min(x, 1),
+            _sample(lambda: _mk(3, 5)), grad_args=(0,))
+register_op("nansum", T.nansum, np.nansum, _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("nanmean", T.nanmean, np.nanmean, _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("nanmedian", T.nanmedian, np.nanmedian,
+            _sample(lambda: _mk(3, 5)))
+register_op("quantile", lambda x: T.quantile(x, 0.25, axis=1),
+            lambda x: np.quantile(x, 0.25, axis=1),
+            _sample(lambda: _mk(3, 5)))
+register_op("nanquantile", lambda x: T.nanquantile(x, 0.5, axis=1),
+            lambda x: np.nanquantile(x, 0.5, axis=1),
+            _sample(lambda: _mk(3, 5)))
+register_op("logcumsumexp", lambda x: T.logcumsumexp(x, axis=1),
+            lambda x: np.log(np.cumsum(np.exp(x), axis=1)),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("cummax_v", lambda x: T.cummax(x, axis=1)[0],
+            lambda x: np.maximum.accumulate(x, axis=1),
+            _sample(lambda: _mk(3, 5)))
+register_op("cummin_v", lambda x: T.cummin(x, axis=1)[0],
+            lambda x: np.minimum.accumulate(x, axis=1),
+            _sample(lambda: _mk(3, 5)))
+register_op("diff_op", lambda x: T.diff(x, axis=1),
+            lambda x: np.diff(x, axis=1), _sample(lambda: _mk(3, 5)),
+            grad_args=(0,))
+register_op("bincount", T.bincount, np.bincount,
+            _sample(lambda: _ints(20, hi=6)))
+register_op("histogram_op", lambda x: T.histogram(x, bins=5, min=-1, max=1),
+            lambda x: np.histogram(x, bins=5, range=(-1, 1))[0],
+            _sample(lambda: _mk(30)))
+register_op("cov_op", T.cov, lambda x: np.cov(x),
+            _sample(lambda: _mk(3, 10)), grad_args=(0,), grad_rtol=1e-1)
+register_op("corrcoef_op", T.corrcoef, lambda x: np.corrcoef(x),
+            _sample(lambda: _mk(3, 10)))
+register_op("mode_v", lambda x: T.mode(x, axis=1)[0], None,
+            _sample(lambda: _ints(3, 5, hi=3).astype(np.float32)))
+register_op("dist_op", lambda x, y: T.dist(x, y, p=2),
+            lambda x, y: np.linalg.norm((x - y).ravel()),
+            _sample(lambda: _mk(3, 4), lambda: _mk(3, 4)), grad_args=(0, 1))
+
+# ---- creation -------------------------------------------------------------
+register_op("arange_op", lambda: T.arange(0, 10, 2),
+            lambda: np.arange(0, 10, 2), _sample())
+register_op("linspace_op", lambda: T.linspace(0.0, 1.0, 5),
+            lambda: np.linspace(0, 1, 5), _sample())
+register_op("logspace_op", lambda: T.logspace(0.0, 2.0, 3),
+            lambda: np.logspace(0, 2, 3), _sample())
+register_op("eye_op", lambda: T.eye(3, 4), lambda: np.eye(3, 4), _sample())
+register_op("full_op", lambda: T.full([2, 3], 1.5),
+            lambda: np.full((2, 3), 1.5), _sample())
+register_op("ones_op", lambda x: T.ones_like(x), np.ones_like,
+            _sample(lambda: _mk(2, 3)))
+register_op("zeros_op", lambda x: T.zeros_like(x), np.zeros_like,
+            _sample(lambda: _mk(2, 3)))
+register_op("full_like_op", lambda x: T.full_like(x, 7.0),
+            lambda x: np.full_like(x, 7.0), _sample(lambda: _mk(2, 3)))
+register_op("diag_op", T.diag, np.diag, _sample(lambda: _mk(4)))
+register_op("diagflat_op", T.diagflat, np.diagflat, _sample(lambda: _mk(2, 2)))
+register_op("vander_op", lambda x: T.vander(x, 3),
+            lambda x: np.vander(x, 3),
+            _sample(lambda: _mk(4)))
+register_op("tril_indices_op", lambda: T.tril_indices(3, 3),
+            lambda: np.stack(np.tril_indices(3, 0, 3)), _sample())
+register_op("triu_indices_op", lambda: T.triu_indices(3, 3),
+            lambda: np.stack(np.triu_indices(3, 0, 3)), _sample())
+register_op("meshgrid_op", lambda x, y: T.meshgrid(x, y)[0],
+            lambda x, y: np.meshgrid(x, y, indexing="ij")[0],
+            _sample(lambda: _mk(3), lambda: _mk(4)))
+
+# ---- manipulation ---------------------------------------------------------
+register_op("broadcast_to_op", lambda x: T.broadcast_to(x, [3, 2, 4]),
+            lambda x: np.broadcast_to(x, (3, 2, 4)),
+            _sample(lambda: _mk(2, 4)), grad_args=(0,))
+register_op("chunk_op", lambda x: T.chunk(x, 2, axis=1)[1],
+            lambda x: np.split(x, 2, axis=1)[1], _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("unbind_op", lambda x: T.unbind(x, axis=0)[1],
+            lambda x: x[1], _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("unstack_op", lambda x: T.unstack(x, axis=1)[0],
+            lambda x: x[:, 0], _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("hstack_op", lambda x, y: T.hstack([x, y]),
+            lambda x, y: np.hstack([x, y]),
+            _sample(lambda: _mk(3, 2), lambda: _mk(3, 4)), grad_args=(0, 1))
+register_op("vstack_op", lambda x, y: T.vstack([x, y]),
+            lambda x, y: np.vstack([x, y]),
+            _sample(lambda: _mk(2, 3), lambda: _mk(4, 3)), grad_args=(0, 1))
+register_op("dstack_op", lambda x, y: T.dstack([x, y]),
+            lambda x, y: np.dstack([x, y]),
+            _sample(lambda: _mk(2, 3), lambda: _mk(2, 3)), grad_args=(0, 1))
+register_op("hsplit_op", lambda x: T.hsplit(x, 2)[0],
+            lambda x: np.hsplit(x, 2)[0], _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("vsplit_op", lambda x: T.vsplit(x, 2)[1],
+            lambda x: np.vsplit(x, 2)[1], _sample(lambda: _mk(4, 3)),
+            grad_args=(0,))
+register_op("dsplit_op", lambda x: T.dsplit(x, 2)[0],
+            lambda x: np.dsplit(x, 2)[0], _sample(lambda: _mk(2, 3, 4)),
+            grad_args=(0,))
+register_op("tensor_split_op", lambda x: T.tensor_split(x, 3, axis=1)[2],
+            lambda x: np.array_split(x, 3, axis=1)[2],
+            _sample(lambda: _mk(3, 7)), grad_args=(0,))
+register_op("moveaxis_op", lambda x: T.moveaxis(x, 0, 2),
+            lambda x: np.moveaxis(x, 0, 2), _sample(lambda: _mk(2, 3, 4)),
+            grad_args=(0,))
+register_op("swapaxes_op", lambda x: T.swapaxes(x, 0, 1),
+            lambda x: np.swapaxes(x, 0, 1), _sample(lambda: _mk(2, 3)),
+            grad_args=(0,))
+register_op("rot90_op", lambda x: T.rot90(x, 1, [0, 1]),
+            lambda x: np.rot90(x, 1, (0, 1)), _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("rollaxis_op", lambda x: T.rollaxis(x, 2, 0),
+            lambda x: np.rollaxis(x, 2, 0), _sample(lambda: _mk(2, 3, 4)),
+            grad_args=(0,))
+register_op("t_op", T.t, np.transpose, _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("atleast_2d_op", lambda x: T.atleast_2d(x),
+            np.atleast_2d, _sample(lambda: _mk(4)))
+register_op("repeat_interleave_op", lambda x: T.repeat_interleave(x, 2, axis=1),
+            lambda x: np.repeat(x, 2, axis=1), _sample(lambda: _mk(2, 3)),
+            grad_args=(0,))
+register_op("expand_as_op", lambda x, y: T.expand_as(x, y),
+            lambda x, y: np.broadcast_to(x, y.shape),
+            _sample(lambda: _mk(1, 4), lambda: _mk(3, 4)), grad_args=(0,))
+register_op("crop_op", lambda x: T.crop(x, shape=[2, 2], offsets=[1, 1]),
+            lambda x: x[1:3, 1:3], _sample(lambda: _mk(4, 4)),
+            grad_args=(0,))
+register_op("masked_select_op",
+            lambda x: T.masked_select(x, _jnp.asarray(
+                np.array([[True, False, True, False]] * 3))),
+            lambda x: x[np.array([[True, False, True, False]] * 3)],
+            _sample(lambda: _mk(3, 4)))
+register_op("gather_nd_op",
+            lambda x: T.gather_nd(x, _jnp.asarray([[0, 1], [2, 0]])),
+            lambda x: x[[0, 2], [1, 0]], _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("take_op", lambda x: T.take(x, _jnp.asarray([0, 3, 5])),
+            lambda x: x.ravel()[[0, 3, 5]], _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("index_sample_op",
+            lambda x: T.index_sample(x, _jnp.asarray([[0, 2], [1, 0], [2, 2]])),
+            lambda x: np.take_along_axis(x, np.array([[0, 2], [1, 0], [2, 2]]), 1),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("index_add_op",
+            lambda x: T.index_add(x, _jnp.asarray([0, 2]), 0,
+                                  _jnp.ones((2, 4), _jnp.float32)),
+            lambda x: x + np.array([[1.0]] * 1 * 4).T.reshape(1, 4) *
+            np.array([[1], [0], [1]], np.float32),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+def _put_along_ref(x):
+    c = x.copy()
+    np.put_along_axis(c, np.array([[1], [0], [2]]), 9.0, 1)
+    return c
+
+
+register_op("put_along_axis_op",
+            lambda x: T.put_along_axis(x, _jnp.asarray([[1], [0], [2]]),
+                                       9.0, 1),
+            _put_along_ref, _sample(lambda: _mk(3, 4)))
+register_op("scatter_op",
+            lambda x: T.scatter(x, _jnp.asarray([0, 2]),
+                                _jnp.zeros((2, 4), _jnp.float32),
+                                overwrite=True),
+            lambda x: (lambda c: (c.__setitem__([0, 2],
+                                                np.zeros((2, 4))), c)[1])(
+                x.copy()),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("scatter_nd_add_op",
+            lambda x: T.scatter_nd_add(x, _jnp.asarray([[1], [1]]),
+                                       _jnp.ones((2, 4), _jnp.float32)),
+            lambda x: (lambda c: (np.add.at(c, [1, 1], np.ones(4)), c)[1])(
+                x.copy()),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("nonzero_op", lambda x: T.nonzero(x)[0] if isinstance(
+                T.nonzero(x), (list, tuple)) else T.nonzero(x),
+            None, _sample(lambda: (_mk(3, 4) > 0).astype(np.float32)))
+register_op("unique_op", lambda x: T.unique(x),
+            lambda x: np.unique(x), _sample(lambda: _ints(12, hi=5)
+                                            .astype(np.float32)))
+register_op("bucketize_op",
+            lambda v: T.bucketize(v, _jnp.asarray([0.0, 0.5, 1.0])),
+            lambda v: np.searchsorted(np.array([0.0, 0.5, 1.0]), v),
+            _sample(lambda: _mk(8, lo=-1, hi=2)))
+register_op("diagonal_op", lambda x: T.diagonal(x, 0, 0, 1),
+            lambda x: np.diagonal(x, 0, 0, 1), _sample(lambda: _mk(3, 3)),
+            grad_args=(0,))
+
+# ---- linalg ---------------------------------------------------------------
+register_op("qr_q", lambda x: abs(T.qr(x)[1]),
+            lambda x: np.abs(np.linalg.qr(x)[1]),
+            _sample(lambda: _mk(4, 3)), rtol=1e-3, atol=1e-4)
+register_op("svdvals_op", lambda x: T.svdvals(x),
+            lambda x: np.linalg.svd(x, compute_uv=False),
+            _sample(lambda: _mk(4, 3)), rtol=1e-3, atol=1e-4)
+register_op("eigvalsh_op", lambda x: T.eigvalsh(x @ x.T + 2 * _jnp.eye(3)),
+            lambda x: np.linalg.eigvalsh(x @ x.T + 2 * np.eye(3, dtype=np.float32)),
+            _sample(lambda: _mk(3, 3)), rtol=1e-3, atol=1e-4)
+register_op("matrix_power_op", lambda x: T.matrix_power(x, 3),
+            lambda x: np.linalg.matrix_power(x, 3),
+            _sample(lambda: _mk(3, 3)), rtol=1e-3, atol=1e-4)
+register_op("matrix_rank_op", lambda x: T.matrix_rank(x),
+            lambda x: np.linalg.matrix_rank(x), _sample(lambda: _mk(4, 3)))
+register_op("pinv_op", T.pinv, np.linalg.pinv,
+            _sample(lambda: _mk(3, 3) + 2 * np.eye(3, dtype=np.float32)),
+            rtol=1e-3, atol=1e-4)
+register_op("multi_dot_op", lambda a, b, c: T.multi_dot([a, b, c]),
+            lambda a, b, c: a @ b @ c,
+            _sample(lambda: _mk(2, 3), lambda: _mk(3, 4), lambda: _mk(4, 2)),
+            grad_args=(0, 1, 2), rtol=1e-4, atol=1e-5)
+register_op("matrix_norm_op", lambda x: T.matrix_norm(x, "fro"),
+            lambda x: np.linalg.norm(x, "fro"), _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("vector_norm_op", lambda x: T.vector_norm(x, 2),
+            lambda x: np.linalg.norm(x.ravel(), 2),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("householder_product_op",
+            lambda x, tau: T.householder_product(x, tau), None,
+            _sample(lambda: _mk(4, 3), lambda: _mk(3)))
+register_op("triangular_solve_op",
+            lambda a, b: T.triangular_solve(a, b, upper=False),
+            lambda a, b: np.linalg.solve(np.tril(a), b),
+            _sample(lambda: np.tril(_mk(3, 3)) + 2 * np.eye(3, dtype=np.float32),
+                    lambda: _mk(3, 2)), grad_args=(0, 1), grad_rtol=1e-1)
+register_op("cholesky_solve_op",
+            lambda b, l: T.cholesky_solve(b, l, upper=False), None,
+            _sample(lambda: _mk(3, 2),
+                    lambda: np.tril(_mk(3, 3)) + 2 * np.eye(3, dtype=np.float32)))
+register_op("lu_op", lambda x: T.lu(x)[0], None,
+            _sample(lambda: _mk(3, 3) + 2 * np.eye(3, dtype=np.float32)))
+register_op("lstsq_op", lambda a, b: T.lstsq(a, b)[0],
+            lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+            _sample(lambda: _mk(4, 3), lambda: _mk(4, 2)),
+            rtol=1e-3, atol=1e-3)

@@ -10,7 +10,8 @@ from ..framework.random import next_rng_key
 
 __all__ = ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
            "full_like", "arange", "linspace", "logspace", "eye", "empty",
-           "empty_like", "meshgrid", "diag", "diagflat", "tril", "triu",
+           "empty_like", "meshgrid", "diag", "diagflat", "diagonal",
+           "tril", "triu",
            "tril_indices", "triu_indices", "assign", "clone", "complex",
            "create_parameter"]
 
@@ -92,6 +93,12 @@ def diag(x, offset=0, padding_value=0, name=None):
 
 def diagflat(x, offset=0, name=None):
     return jnp.diagflat(x, k=offset)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Parity: paddle.diagonal — extract diagonals over (axis1, axis2)."""
+    return jnp.diagonal(jnp.asarray(x), offset=offset, axis1=axis1,
+                        axis2=axis2)
 
 
 def tril(x, diagonal=0, name=None):
