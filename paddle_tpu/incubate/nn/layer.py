@@ -315,17 +315,26 @@ class FusedMultiTransformer(Layer):
                     kc, k_t.astype(kc.dtype), (0, 0, t, 0))
                 vc = jax.lax.dynamic_update_slice(
                     vc, v_t.astype(vc.dtype), (0, 0, t, 0))
-                # attend over the full cache with a length mask
                 att_k = jnp.swapaxes(kc, 1, 2)   # [B,T,H,D]
                 att_v = jnp.swapaxes(vc, 1, 2)
+                if attn_mask is None:
+                    # hot decode path: stream the cache once through the
+                    # Pallas decode kernel (the fused_multi_transformer
+                    # attention core) instead of building a [B,1,1,Tmax]
+                    # additive mask + full sdpa
+                    from ...kernels.decode_attention import decode_attention
+                    sq = q.shape[1]
+                    lens = jnp.full((q.shape[0],), t + sq, jnp.int32)
+                    out = decode_attention(q, att_k, att_v, lens)
+                    new_cache = jnp.stack([kc, vc], axis=0)
+                    return self._finish_layer(i, out, residual), new_cache
+                # user padding mask: dense path with length mask on top
                 Tmax = att_k.shape[1]
                 pos = jnp.arange(Tmax)
                 lmask = (pos <= t).astype(h.dtype)
                 neg = jnp.asarray(-1e9, h.dtype)
                 length_mask = (1.0 - lmask)[None, None, None, :] * neg
-                # combine with a user padding mask instead of dropping it
-                attn_mask = (length_mask if attn_mask is None
-                             else length_mask + attn_mask.astype(h.dtype))
+                attn_mask = length_mask + attn_mask.astype(h.dtype)
             new_cache = jnp.stack([kc, vc], axis=0)
         else:
             att_k, att_v = k, v
@@ -339,7 +348,14 @@ class FusedMultiTransformer(Layer):
         out = F.scaled_dot_product_attention(
             q, att_k, att_v, attn_mask=attn_mask,
             is_causal=prefill and attn_mask is None, training=self.training)
-        out = out.reshape(*out.shape[:2], M)
+        return self._finish_layer(i, out, residual), new_cache
+
+    def _finish_layer(self, i, attn_out, residual):
+        """Shared epilogue: out-proj + dropout + residual, then the FFN
+        block (the tail of the fused_multi_transformer op)."""
+        p = self._parameters
+        M = self.embed_dim
+        out = attn_out.reshape(*attn_out.shape[:2], M)
         out = F.linear(out, p[f"linear_weight_{i}"], p[f"linear_bias_{i}"])
         out = F.dropout(out, self.dropout_rate, training=self.training)
         x = residual + out
@@ -351,7 +367,7 @@ class FusedMultiTransformer(Layer):
         h = getattr(F, self.activation)(h)
         h = F.linear(h, p[f"ffn2_weight_{i}"], p[f"ffn2_bias_{i}"])
         h = F.dropout(h, self.dropout_rate, training=self.training)
-        return residual + h, new_cache
+        return residual + h
 
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 rotary_embs=None, rotary_emb_dims: int = 0, seq_lens=None,

@@ -11,6 +11,7 @@ kernels also run under ``interpret=True`` for CPU tests).
 from .flash_attention import flash_attention, flash_attention_with_lse
 from .fused_adamw import fused_adamw_update
 from .fused_norm import fused_rms_norm_pallas
+from .decode_attention import decode_attention
 
-__all__ = ["flash_attention", "flash_attention_with_lse",
+__all__ = ["flash_attention", "flash_attention_with_lse", "decode_attention",
            "fused_adamw_update", "fused_rms_norm_pallas"]

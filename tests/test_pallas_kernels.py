@@ -219,3 +219,75 @@ def test_flash_attention_with_lse_gqa():
     assert lse.shape == (q.shape[0], q.shape[2], q.shape[1])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def _decode_ref(q, k_cache, v_cache, seq_lens, causal_tail=True):
+    b, sq, h, d = q.shape
+    s_max = k_cache.shape[1]
+    kh = k_cache.shape[2]
+    if kh != h:
+        k_cache = np.repeat(np.asarray(k_cache), h // kh, axis=2)
+        v_cache = np.repeat(np.asarray(v_cache), h // kh, axis=2)
+    qn = np.asarray(q, np.float32)
+    kn = np.asarray(k_cache, np.float32)
+    vn = np.asarray(v_cache, np.float32)
+    out = np.zeros((b, sq, h, d), np.float32)
+    for bi in range(b):
+        L = int(seq_lens[bi])
+        for hi in range(h):
+            s = qn[bi, :, hi] @ kn[bi, :, hi].T / np.sqrt(d)  # [sq, s_max]
+            mask = np.arange(s_max)[None, :] < L
+            if causal_tail:
+                mask = mask & (np.arange(s_max)[None, :] <=
+                               L - sq + np.arange(sq)[:, None])
+            s = np.where(mask, s, -np.inf)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[bi, :, hi] = p @ vn[bi, :, hi]
+    return out
+
+
+def test_decode_attention_single_token():
+    from paddle_tpu.kernels import decode_attention
+    rs = np.random.RandomState(0)
+    b, s_max, h, d = 3, 256, 4, 64
+    q = jnp.asarray(rs.randn(b, 1, h, d).astype(np.float32) * 0.5)
+    kc = jnp.asarray(rs.randn(b, s_max, h, d).astype(np.float32) * 0.5)
+    vc = jnp.asarray(rs.randn(b, s_max, h, d).astype(np.float32) * 0.5)
+    lens = jnp.asarray([17, 256, 130], jnp.int32)
+    out = decode_attention(q, kc, vc, lens, block_k=128, interpret=True)
+    ref = _decode_ref(q, kc, vc, np.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_attention_chunked_tail_and_gqa():
+    from paddle_tpu.kernels import decode_attention
+    rs = np.random.RandomState(1)
+    b, s_max, h, kh, d, sq = 2, 128, 4, 2, 64, 8
+    q = jnp.asarray(rs.randn(b, sq, h, d).astype(np.float32) * 0.5)
+    kc = jnp.asarray(rs.randn(b, s_max, kh, d).astype(np.float32) * 0.5)
+    vc = jnp.asarray(rs.randn(b, s_max, kh, d).astype(np.float32) * 0.5)
+    lens = jnp.asarray([40, 128], jnp.int32)
+    out = decode_attention(q, kc, vc, lens, block_k=64, interpret=True)
+    ref = _decode_ref(q, kc, vc, np.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_attention_matches_model_cache_semantics():
+    """Parity vs F.scaled_dot_product_attention with the per-query mask the
+    models build for chunked prefill (gpt.py/llama.py decode path)."""
+    from paddle_tpu.kernels import decode_attention
+    rs = np.random.RandomState(2)
+    b, s_max, h, d, sq = 2, 64, 2, 64, 4
+    pos = 10                       # cache already holds 10 tokens
+    q = jnp.asarray(rs.randn(b, sq, h, d).astype(np.float32) * 0.5)
+    kc = jnp.asarray(rs.randn(b, s_max, h, d).astype(np.float32) * 0.5)
+    vc = jnp.asarray(rs.randn(b, s_max, h, d).astype(np.float32) * 0.5)
+    lens = jnp.full((b,), pos + sq, jnp.int32)
+    out = decode_attention(q, kc, vc, lens, block_k=32, interpret=True)
+    kpos = jnp.arange(s_max)
+    qpos = pos + jnp.arange(sq)
+    mask = (kpos[None, None, None, :] <= qpos[None, None, :, None])
+    ref = sdpa_reference(q, kc, vc, attn_mask=mask, training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
