@@ -57,4 +57,28 @@ def init_process_env(coordinator_address=None, num_processes=None,
     if nproc > 1 and addr:
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nproc, process_id=pid)
+    _start_heartbeat()
     _initialized = True
+
+
+def _start_heartbeat(interval: float = 2.0) -> None:
+    """Touch $PADDLE_HEARTBEAT_FILE periodically so the launcher's
+    --heartbeat_timeout watchdog can tell hung from alive (the local-file
+    analog of the reference ElasticManager's etcd heartbeats)."""
+    hb = os.environ.get("PADDLE_HEARTBEAT_FILE")
+    if not hb:
+        return
+    import threading
+
+    def beat():
+        while True:
+            try:
+                os.makedirs(os.path.dirname(hb) or ".", exist_ok=True)
+                with open(hb, "a"):
+                    os.utime(hb, None)
+            except OSError:
+                pass
+            import time as _t
+            _t.sleep(interval)
+
+    threading.Thread(target=beat, daemon=True).start()

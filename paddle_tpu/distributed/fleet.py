@@ -104,6 +104,8 @@ def barrier_worker():
 class _FleetModule:
     """`fleet` object parity: fleet.init / fleet.distributed_model ..."""
 
+    from . import fleet_utils as utils  # fleet.utils.{logger, LocalFS, ...}
+
     init = staticmethod(init)
     distributed_model = staticmethod(distributed_model)
     distributed_optimizer = staticmethod(distributed_optimizer)

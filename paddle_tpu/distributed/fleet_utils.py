@@ -1,0 +1,225 @@
+"""fleet.utils: per-rank structured logging, filesystem clients, and
+checkpoint auto-resume.
+
+Reference surfaces (SURVEY.md §2.4 "fleet utils", §5 "Metrics/logging" and
+"Failure detection"):
+  - python/paddle/distributed/fleet/utils/log_util.py — rank-tagged logger
+    used by the hybrid-parallel stack.
+  - python/paddle/distributed/fleet/utils/fs.py — LocalFS + HDFSClient
+    (hadoop-shell backed) used to push checkpoints to shared storage.
+  - elastic restarts resume from the latest checkpoint; the reference
+    leaves "find the latest" to user scripts.  TPU slices fail whole
+    (SURVEY.md §7 hard part (d)), so restart-from-checkpoint is THE
+    elasticity story here and gets a first-class helper.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+
+__all__ = ["logger", "get_logger", "set_log_level", "LocalFS", "HDFSClient",
+           "latest_checkpoint", "save_auto_resume", "load_auto_resume"]
+
+
+# ---------------------------------------------------------------- logging
+def _rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_logger(name: str = "paddle_tpu", level=logging.INFO,
+               fmt: Optional[str] = None) -> logging.Logger:
+    """Per-host structured logger; every record carries the trainer rank so
+    aggregated logs stay attributable (reference log_util.logger)."""
+    log = logging.getLogger(name)
+    if not log.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            fmt or f"%(asctime)s [rank {_rank()}] %(levelname)s "
+                   f"%(name)s: %(message)s"))
+        log.addHandler(h)
+        log.propagate = False
+    log.setLevel(level)
+    return log
+
+
+logger = get_logger()
+
+
+def set_log_level(level) -> None:
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    logger.setLevel(level)
+
+
+# ------------------------------------------------------------- filesystems
+class ExecuteError(RuntimeError):
+    pass
+
+
+class LocalFS:
+    """Reference: fleet.utils.fs.LocalFS — same method surface."""
+
+    def ls_dir(self, path: str):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for n in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, n)) else files).append(n)
+        return dirs, files
+
+    def mkdirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def is_dir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def is_file(self, path: str) -> bool:
+        return os.path.isfile(path)
+
+    def is_exist(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src: str, dst: str):
+        os.replace(src, dst)
+
+    mv = rename
+
+    def touch(self, path: str, exist_ok: bool = True):
+        if os.path.exists(path) and not exist_ok:
+            raise ExecuteError(f"{path} exists")
+        open(path, "a").close()
+
+    def upload(self, local: str, remote: str):
+        self.mkdirs(os.path.dirname(remote) or ".")
+        if os.path.isdir(local):
+            shutil.copytree(local, remote, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local, remote)
+
+    def download(self, remote: str, local: str):
+        self.upload(remote, local)
+
+    def list_dirs(self, path: str):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient:
+    """Reference: fleet.utils.fs.HDFSClient — shells out to the hadoop CLI.
+    This environment has no hadoop binary and zero egress; the surface is
+    kept (ported CTR scripts import it) and every call raises a clear
+    error unless ``hadoop`` is actually on PATH."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out: int = 300, sleep_inter: int = 1000):
+        self._hadoop = None
+        cand = os.path.join(hadoop_home, "bin", "hadoop") if hadoop_home \
+            else "hadoop"
+        if shutil.which(cand):
+            self._hadoop = cand
+        self._configs = configs or {}
+
+    def _run(self, *args) -> str:
+        if self._hadoop is None:
+            raise ExecuteError(
+                "HDFSClient: no hadoop binary on PATH — this TPU environment "
+                "has no HDFS; use LocalFS or distributed.checkpoint")
+        cfg = []
+        for k, v in self._configs.items():
+            cfg += ["-D", f"{k}={v}"]
+        r = subprocess.run([self._hadoop, "fs", *cfg, *args],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise ExecuteError(r.stderr.strip()[-400:])
+        return r.stdout
+
+    def is_exist(self, path: str) -> bool:
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def ls_dir(self, path: str):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path: str):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path: str):
+        self._run("-rm", "-r", "-f", path)
+
+    def upload(self, local: str, remote: str):
+        self._run("-put", "-f", local, remote)
+
+    def download(self, remote: str, local: str):
+        self._run("-get", remote, local)
+
+
+# --------------------------------------------------------- auto-resume
+def latest_checkpoint(ckpt_dir: str, prefix: str = "step_") -> Optional[str]:
+    """Newest complete checkpoint directory under ``ckpt_dir`` (named
+    ``{prefix}{N}``; a ``.complete`` marker gates half-written saves)."""
+    fs = LocalFS()
+    best, best_step = None, -1
+    for d in fs.list_dirs(ckpt_dir):
+        if not d.startswith(prefix):
+            continue
+        try:
+            step = int(d[len(prefix):])
+        except ValueError:
+            continue
+        full = os.path.join(ckpt_dir, d)
+        if step > best_step and os.path.exists(
+                os.path.join(full, ".complete")):
+            best, best_step = full, step
+    return best
+
+
+def save_auto_resume(state_dict, ckpt_dir: str, step: int,
+                     prefix: str = "step_", keep_last: int = 2) -> str:
+    """Shard-aware save + completion marker + retention (the elastic
+    restart-from-checkpoint write side; uses distributed.checkpoint so a
+    resumed job may even load onto a different mesh)."""
+    from .checkpoint import save_state_dict
+    fs = LocalFS()
+    path = os.path.join(ckpt_dir, f"{prefix}{step}")
+    fs.mkdirs(path)
+    save_state_dict(state_dict, path)
+    fs.touch(os.path.join(path, ".complete"))
+    # retention: drop older complete checkpoints beyond keep_last
+    steps = sorted(
+        (int(d[len(prefix):]) for d in fs.list_dirs(ckpt_dir)
+         if d.startswith(prefix) and d[len(prefix):].isdigit()),
+        reverse=True)
+    for s in steps[keep_last:]:
+        fs.delete(os.path.join(ckpt_dir, f"{prefix}{s}"))
+    return path
+
+
+def load_auto_resume(state_dict, ckpt_dir: str, prefix: str = "step_"):
+    """(state_dict, step) from the newest complete checkpoint, or
+    (state_dict, None) when there is nothing to resume from."""
+    from .checkpoint import load_state_dict
+    path = latest_checkpoint(ckpt_dir, prefix)
+    if path is None:
+        return state_dict, None
+    step = int(os.path.basename(path)[len(prefix):])
+    return load_state_dict(state_dict, path), step

@@ -48,6 +48,13 @@ def _parse_args(argv):
                    help="visible device ids (informational on TPU)")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="restart workers whose heartbeat file goes stale "
+                        "for this many seconds (0 = disabled).  Replaces "
+                        "the reference's etcd heartbeats (fleet/elastic/"
+                        "manager.py — ElasticManager) with a local-file "
+                        "liveness contract: workers touch "
+                        "$PADDLE_HEARTBEAT_FILE via distributed.env.")
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -67,6 +74,10 @@ class Container:
 
     def start(self):
         os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        hb = self.env.get("PADDLE_HEARTBEAT_FILE")
+        if hb and os.path.exists(hb):
+            os.remove(hb)          # a stale mtime from a previous attempt
+        self.started_at = time.time()
         self._log_f = open(self.log_path, "ab")
         self.proc = subprocess.Popen(self.cmd, env=self.env,
                                      stdout=self._log_f,
@@ -125,6 +136,9 @@ class CollectiveController:
             })
             if args.master:
                 env["PADDLE_MASTER"] = args.master
+            if args.heartbeat_timeout > 0:
+                env["PADDLE_HEARTBEAT_FILE"] = os.path.join(
+                    args.log_dir, f"heartbeat.{local_rank}")
             cmd = [sys.executable, "-u", args.training_script,
                    *args.training_script_args]
             log = os.path.join(args.log_dir, f"workerlog.{local_rank}")
@@ -138,11 +152,40 @@ class CollectiveController:
         for c in self.containers:
             c.terminate()
 
+    def _stale_worker(self) -> Optional[int]:
+        """Index of a live worker whose heartbeat went stale, else None."""
+        t = self.args.heartbeat_timeout
+        if t <= 0:
+            return None
+        now = time.time()
+        for i, c in enumerate(self.containers):
+            hb = c.env.get("PADDLE_HEARTBEAT_FILE")
+            if not hb or c.poll() is not None:
+                continue
+            if now - getattr(c, "started_at", now) < t:
+                continue  # startup grace: first beat may not be due yet
+            try:
+                age = now - os.path.getmtime(hb)
+            except OSError:
+                continue  # worker hasn't opted in yet
+            if age > t:
+                return i
+        return None
+
     def watch(self) -> int:
-        """Poll until all exit 0, or a failure triggers teardown (+elastic
-        restart up to --max_restart).  Returns final exit code."""
+        """Poll until all exit 0, or a failure/stale-heartbeat triggers
+        teardown (+elastic restart up to --max_restart).  Returns final
+        exit code."""
         while True:
             states = [c.poll() for c in self.containers]
+            stale = self._stale_worker()
+            if stale is not None:
+                print(f"[launch] worker {stale} heartbeat stale "
+                      f"(> {self.args.heartbeat_timeout}s); treating as "
+                      f"hung", file=sys.stderr)
+                self.containers[stale].terminate()
+                states = [c.poll() for c in self.containers]
+                states[stale] = states[stale] or 1
             if any(s not in (None, 0) for s in states):
                 bad = next(i for i, s in enumerate(states)
                            if s not in (None, 0))

@@ -1,0 +1,187 @@
+"""Minimal parameter-server runtime (dense + sparse tables over RPC).
+
+Reference: paddle/fluid/distributed/ps/ — brpc PSServer/PSClient with
+dense/sparse tables, sync/async push-pull for CTR workloads
+(SURVEY.md §2.1 "Parameter server", §2.3 PS).  The reference stack is
+~100k LoC of C++ serving brpc at datacenter scale; SURVEY §7 scoped it out
+of the TPU north star.  What IS kept here is the programming model, so PS
+scripts port: a server role hosting tables, workers pulling params and
+pushing grads (sync SGD or async), sparse tables growing on first touch —
+implemented over paddle_tpu.distributed.rpc on the launcher env contract.
+
+Deliberate deviations (documented): single server process (no table
+sharding across servers), numpy-resident tables (the PS role is a host
+process — TPU compute stays in the workers), geo-SGD not implemented.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["Table", "PSServer", "init_server", "init_worker", "pull",
+           "push", "pull_sparse", "push_sparse", "shutdown", "barrier"]
+
+
+class Table:
+    """Dense or sparse (hash) table with SGD apply on push."""
+
+    def __init__(self, name: str, shape=None, initializer=None,
+                 sparse_dim: Optional[int] = None, lr: float = 0.01):
+        self.name = name
+        self.lr = lr
+        self.sparse_dim = sparse_dim
+        self._lock = threading.Lock()
+        if sparse_dim is None:
+            init = initializer if initializer is not None else \
+                (lambda s: np.zeros(s, np.float32))
+            self.value = init(tuple(shape)).astype(np.float32)
+            self.rows: Dict[int, np.ndarray] = {}
+        else:
+            self.value = None
+            self.rows = {}
+            self._init_row = initializer or (
+                lambda: np.zeros(sparse_dim, np.float32))
+
+    # --- dense ---------------------------------------------------------
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray, lr: Optional[float] = None):
+        with self._lock:
+            self.value -= (lr if lr is not None else self.lr) * grad
+
+    # --- sparse --------------------------------------------------------
+    def pull_rows(self, ids) -> np.ndarray:
+        with self._lock:
+            out = []
+            for i in ids:
+                i = int(i)
+                if i not in self.rows:
+                    self.rows[i] = self._init_row().astype(np.float32)
+                out.append(self.rows[i])
+            return np.stack(out)
+
+    def push_rows(self, ids, grads: np.ndarray, lr: Optional[float] = None):
+        step = lr if lr is not None else self.lr
+        with self._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                if i not in self.rows:
+                    self.rows[i] = self._init_row().astype(np.float32)
+                self.rows[i] -= step * np.asarray(g, np.float32)
+
+
+class PSServer:
+    """Table host.  Lives on the server rank; workers reach it via rpc."""
+
+    def __init__(self):
+        self.tables: Dict[str, Table] = {}
+
+    def create_table(self, name, **kw):
+        self.tables[name] = Table(name, **kw)
+        return True
+
+    def pull(self, name):
+        return self.tables[name].pull()
+
+    def push(self, name, grad, lr=None):
+        self.tables[name].push(grad, lr)
+        return True
+
+    def pull_sparse(self, name, ids):
+        return self.tables[name].pull_rows(ids)
+
+    def push_sparse(self, name, ids, grads, lr=None):
+        self.tables[name].push_rows(ids, grads, lr)
+        return True
+
+
+_SERVER: Optional[PSServer] = None
+_SERVER_RANK = 0
+
+
+def _srv():
+    global _SERVER
+    if _SERVER is None:
+        _SERVER = PSServer()
+    return _SERVER
+
+
+# ---- module-level handlers executed ON the server via rpc --------------
+def _h_create(name, kw):
+    return _srv().create_table(name, **kw)
+
+
+def _h_pull(name):
+    return _srv().pull(name)
+
+
+def _h_push(name, grad, lr):
+    return _srv().push(name, grad, lr)
+
+
+def _h_pull_sparse(name, ids):
+    return _srv().pull_sparse(name, ids)
+
+
+def _h_push_sparse(name, ids, grads, lr):
+    return _srv().push_sparse(name, ids, grads, lr)
+
+
+def init_server(server_rank: int = 0, name: str = "ps_server") -> PSServer:
+    """Start the RPC endpoint and host tables on this process (reference:
+    fleet.init_server + run_server)."""
+    global _SERVER_RANK
+    _SERVER_RANK = server_rank
+    rpc.init_rpc(name)
+    return _srv()
+
+
+def init_worker(server_rank: int = 0, name: Optional[str] = None) -> None:
+    """Reference: fleet.init_worker — connect to the server."""
+    global _SERVER_RANK
+    _SERVER_RANK = server_rank
+    import os
+    rpc.init_rpc(name or f"trainer{os.environ.get('PADDLE_TRAINER_ID', 0)}")
+
+
+def create_table(name: str, **kw) -> None:
+    rpc.rpc_sync(_SERVER_RANK, _h_create, (name, kw))
+
+
+def pull(name: str) -> np.ndarray:
+    return rpc.rpc_sync(_SERVER_RANK, _h_pull, (name,))
+
+
+def push(name: str, grad, lr: Optional[float] = None) -> None:
+    rpc.rpc_sync(_SERVER_RANK, _h_push, (name, np.asarray(grad), lr))
+
+
+def pull_sparse(name: str, ids) -> np.ndarray:
+    return rpc.rpc_sync(_SERVER_RANK, _h_pull_sparse,
+                        (name, [int(i) for i in np.asarray(ids).ravel()]))
+
+
+def push_sparse(name: str, ids, grads, lr: Optional[float] = None) -> None:
+    rpc.rpc_sync(_SERVER_RANK, _h_push_sparse,
+                 (name, [int(i) for i in np.asarray(ids).ravel()],
+                  np.asarray(grads), lr))
+
+
+def _h_ping() -> bool:
+    return True
+
+
+def barrier() -> None:
+    """Worker barrier through the server (cheap rendezvous)."""
+    rpc.rpc_sync(_SERVER_RANK, _h_ping, ())
+
+
+def shutdown() -> None:
+    rpc.shutdown()

@@ -1,0 +1,242 @@
+"""paddle.distributed.rpc parity — lightweight TCP RPC between workers.
+
+Reference: python/paddle/distributed/rpc/ (brpc-backed in C++,
+SURVEY.md §2.4 RPC row): init_rpc / rpc_sync / rpc_async / shutdown /
+get_worker_info over the trainer-env worker table.
+
+TPU-native: the SPMD compute path never needs RPC (collectives are
+compiled), so this exists for the reference's control-plane uses
+(coordination, light metadata exchange between host processes).  Design:
+one daemon listener thread per process on the worker's endpoint
+(PADDLE_TRAINER_ENDPOINTS slot, port offset +1000 to avoid the trainer
+port); requests are length-prefixed pickles of (fn, args, kwargs) executed
+in the listener's worker pool, results pickled back.  Same trust model as
+the reference (pickled callables across a private cluster network).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as futures
+import os
+import pickle
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+           "WorkerInfo"]
+
+_PORT_OFFSET = 1000
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _State:
+    def __init__(self):
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.by_rank: Dict[int, WorkerInfo] = {}
+        self.me: Optional[WorkerInfo] = None
+        self.server: Optional[socket.socket] = None
+        self.pool: Optional[futures.ThreadPoolExecutor] = None
+        self.thread: Optional[threading.Thread] = None
+        self.stop = threading.Event()
+
+
+_S = _State()
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def _serve(server: socket.socket, pool: futures.ThreadPoolExecutor,
+           stop: threading.Event) -> None:
+    # timeout-polling accept: a thread parked in a blocking accept keeps
+    # the listening fd alive in the kernel past close(), leaving the port
+    # bound (EADDRINUSE on re-init) — poll + stop-flag instead
+    server.settimeout(0.2)
+    while not stop.is_set():
+        try:
+            conn, _ = server.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            return  # closed by shutdown()
+
+        def handle(conn=conn):
+            try:
+                fn, args, kwargs = _recv_msg(conn)
+                try:
+                    result = ("ok", fn(*args, **(kwargs or {})))
+                except Exception as e:  # ship the failure back
+                    result = ("err", e)
+                _send_msg(conn, result)
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+        pool.submit(handle)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC listener and build the worker table from
+    the launcher env contract (reference signature)."""
+    if _S.me is not None:
+        return
+    rank = rank if rank is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:61000")
+    ep_list = eps.split(",")
+    world_size = world_size if world_size is not None else len(ep_list)
+
+    infos: List[WorkerInfo] = []
+    for r in range(world_size):
+        ip, port = ep_list[r % len(ep_list)].rsplit(":", 1)
+        wname = name if r == rank else f"worker{r}"
+        infos.append(WorkerInfo(wname, r, ip, int(port) + _PORT_OFFSET))
+    for w in infos:
+        _S.workers[w.name] = w
+        _S.by_rank[w.rank] = w
+    _S.me = infos[rank]
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    # bind exactly the configured interface — the listener unpickles and
+    # executes payloads, so a loopback config must never listen on 0.0.0.0
+    server.bind((_S.me.ip, _S.me.port))
+    server.listen(16)
+    _S.server = server
+    _S.pool = futures.ThreadPoolExecutor(max_workers=8)
+    _S.stop = threading.Event()
+    _S.thread = threading.Thread(target=_serve,
+                                 args=(server, _S.pool, _S.stop),
+                                 daemon=True)
+    _S.thread.start()
+
+
+def _whoami() -> str:
+    return _S.me.name if _S.me else ""
+
+
+def _resolve(to) -> WorkerInfo:
+    if isinstance(to, WorkerInfo):
+        return to
+    if isinstance(to, int):
+        return _S.by_rank[to]
+    if to not in _S.workers:
+        # peers register themselves under THEIR chosen init_rpc name, which
+        # this process can't know a priori — resolve lazily by asking each
+        # rank for its name over the always-valid rank addressing
+        for r in sorted(_S.by_rank):
+            w = _S.by_rank[r]
+            if w is _S.me:
+                continue
+            try:
+                name = rpc_sync(r, _whoami, timeout=10.0)
+            except (OSError, ConnectionError):
+                continue
+            if name:
+                _S.workers[name] = w
+                _S.by_rank[r] = WorkerInfo(name, w.rank, w.ip, w.port)
+                if name != f"worker{r}":
+                    _S.workers.pop(f"worker{r}", None)
+            if name == to:
+                break
+    return _S.workers[to]
+
+
+def rpc_sync(to, fn, args: tuple = (), kwargs: Optional[dict] = None,
+             timeout: float = 120.0):
+    """Run ``fn(*args, **kwargs)`` on worker ``to`` (name, rank or
+    WorkerInfo); returns the result (reference: rpc.rpc_sync)."""
+    if _S.me is None:
+        raise RuntimeError("call init_rpc first")
+    w = _resolve(to)
+    if w.rank == _S.me.rank:  # local fast path
+        return fn(*args, **(kwargs or {}))
+    with socket.create_connection((w.ip, w.port), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _send_msg(sock, (fn, args, kwargs))
+        status, payload = _recv_msg(sock)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_async(to, fn, args: tuple = (), kwargs: Optional[dict] = None,
+              timeout: float = 120.0):
+    """Future-returning variant (reference: rpc.rpc_async -> FutureWrapper;
+    .wait() / .result() both work)."""
+    ex = futures.ThreadPoolExecutor(max_workers=1)
+    fut = ex.submit(rpc_sync, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle calls .wait()
+    ex.shutdown(wait=False)
+    return fut
+
+
+def get_worker_info(name=None) -> Optional[WorkerInfo]:
+    if name is None:
+        return _S.me
+    return _resolve(name)
+
+
+def get_current_worker_info() -> Optional[WorkerInfo]:
+    return _S.me
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return [
+
+        _S.by_rank[r] for r in sorted(_S.by_rank)
+    ]
+
+
+def shutdown() -> None:
+    """Close the listener (reference: rpc.shutdown; graceful barrier is the
+    caller's job in this implementation — documented deviation)."""
+    _S.stop.set()
+    if _S.thread is not None and _S.thread.is_alive():
+        _S.thread.join(timeout=2.0)
+    if _S.server is not None:
+        try:
+            _S.server.close()
+        except OSError:
+            pass
+    if _S.pool is not None:
+        _S.pool.shutdown(wait=False)
+    _S.server = None
+    _S.pool = None
+    _S.thread = None
+    _S.me = None
+    _S.workers.clear()
+    _S.by_rank.clear()

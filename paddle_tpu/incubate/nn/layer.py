@@ -328,12 +328,17 @@ class FusedMultiTransformer(Layer):
                     out = decode_attention(q, att_k, att_v, lens)
                     new_cache = jnp.stack([kc, vc], axis=0)
                     return self._finish_layer(i, out, residual), new_cache
-                # user padding mask: dense path with length mask on top
+                # user padding mask: dense path with the SAME causal-tail
+                # semantics as the kernel path (query j of the fresh chunk
+                # sees cache slots <= t + j), so adding a no-op padding
+                # mask never changes the attention
                 Tmax = att_k.shape[1]
+                sq_c = q.shape[1]
                 pos = jnp.arange(Tmax)
-                lmask = (pos <= t).astype(h.dtype)
+                qpos = t + jnp.arange(sq_c)
+                lmask = (pos[None, :] <= qpos[:, None]).astype(h.dtype)
                 neg = jnp.asarray(-1e9, h.dtype)
-                length_mask = (1.0 - lmask)[None, None, None, :] * neg
+                length_mask = (1.0 - lmask)[None, None, :, :] * neg
                 attn_mask = length_mask + attn_mask.astype(h.dtype)
             new_cache = jnp.stack([kc, vc], axis=0)
         else:

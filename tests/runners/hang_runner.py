@@ -1,0 +1,20 @@
+"""Worker that heartbeats then hangs (restart 0) or succeeds (restart>=1):
+exercises the launcher's stale-heartbeat hang detection."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+from paddle_tpu.distributed import env
+
+env._start_heartbeat(interval=0.2)
+restart = int(os.environ.get("PADDLE_RESTART_COUNT", 0))
+if restart == 0 and os.environ["PADDLE_TRAINER_ID"] == "0":
+    # stop beating and hang: overwrite mtime once, then sleep forever
+    time.sleep(1.0)
+    # kill our own heartbeat by removing the env file path's updates:
+    # simplest hang = block the main thread AND stop the beat thread by
+    # removing write permission on the file's directory is overkill —
+    # instead exec a beatless sleep
+    os.execv(sys.executable, [sys.executable, "-c", "import time; time.sleep(600)"])
+print("HANG_RUNNER_OK", os.environ["PADDLE_TRAINER_ID"])

@@ -1,0 +1,28 @@
+"""2-process PS smoke: rank 0 = server, rank 1 = worker."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+import paddle_tpu.distributed.ps as ps
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+if rank == 0:
+    ps.init_server()
+    ps.create_table("w", shape=(2, 2), lr=0.1)
+    time.sleep(5.0)                  # serve while the worker runs
+    final = ps.pull("w")             # local read of the updated table
+    assert abs(float(final[0, 0]) + 0.1) < 1e-6, final
+    print("PS_SERVER_OK")
+else:
+    time.sleep(1.0)                  # let the server table exist
+    ps.init_worker()
+    w = ps.pull("w")
+    assert w.shape == (2, 2) and float(w.sum()) == 0.0
+    ps.push("w", np.ones((2, 2), np.float32))
+    w2 = ps.pull("w")
+    assert abs(float(w2[0, 0]) + 0.1) < 1e-6, w2
+    print("PS_WORKER_OK")
+ps.shutdown()

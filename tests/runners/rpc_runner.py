@@ -1,0 +1,35 @@
+"""Worker for the 2-process RPC test: rank 1 serves under a custom name,
+rank 0 addresses it BY NAME (reference addressing mode)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+
+from paddle_tpu.distributed import rpc
+
+
+def add(a, b):
+    return a + b
+
+
+def whoami():
+    return int(os.environ["PADDLE_TRAINER_ID"])
+
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+name = "master_worker" if rank == 0 else "side_worker"
+rpc.init_rpc(name)
+time.sleep(1.0)          # let both listeners come up
+if rank == 0:
+    # name addressing must resolve even though rank 1 chose its own name
+    assert rpc.rpc_sync("side_worker", add, (2, 3)) == 5
+    fut = rpc.rpc_async(1, whoami)
+    assert fut.result() == 1
+    assert rpc.rpc_sync(0, add, (1, 1)) == 2     # local fast path
+    assert rpc.get_worker_info("side_worker").rank == 1
+    print("RPC_OK")
+else:
+    time.sleep(4.0)      # serve until rank 0 is done
+    print("RPC_OK")
+rpc.shutdown()

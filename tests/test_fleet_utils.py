@@ -1,0 +1,78 @@
+"""fleet.utils: logging, LocalFS/HDFSClient surface, checkpoint
+auto-resume (reference: fleet/utils/log_util.py, fs.py; elastic
+restart-from-checkpoint — SURVEY.md §2.4/§5)."""
+
+import logging
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet_utils import (
+    LocalFS, HDFSClient, ExecuteError, get_logger, latest_checkpoint,
+    save_auto_resume, load_auto_resume)
+
+
+def test_fleet_utils_attached():
+    assert dist.fleet.utils.LocalFS is LocalFS
+    log = get_logger("t_fleet")
+    assert isinstance(log, logging.Logger)
+    log.info("hello from tests")
+
+
+def test_localfs_surface(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a/b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f) and fs.is_exist(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == []
+    fs.upload(f, str(tmp_path / "c/x.txt"))
+    assert fs.is_file(str(tmp_path / "c/x.txt"))
+    fs.mv(f, os.path.join(d, "y.txt"))
+    assert not fs.is_exist(f)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_raises_clearly_without_hadoop():
+    c = HDFSClient()
+    with pytest.raises(ExecuteError, match="hadoop"):
+        c.mkdirs("/tmp/x")
+    assert c.is_exist("/anything") is False
+
+
+def test_auto_resume_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    state = {"w": jnp.asarray(np.arange(8, dtype=np.float32)),
+             "b": jnp.asarray(np.ones(3, np.float32))}
+    assert latest_checkpoint(ckpt) is None
+    save_auto_resume(state, ckpt, step=10)
+    save_auto_resume({k: v * 2 for k, v in state.items()}, ckpt, step=20)
+    save_auto_resume({k: v * 3 for k, v in state.items()}, ckpt, step=30,
+                     keep_last=2)
+    # retention: step_10 evicted, newest two kept
+    fs = LocalFS()
+    assert sorted(fs.list_dirs(ckpt)) == ["step_20", "step_30"]
+    fresh = {"w": jnp.zeros(8, jnp.float32), "b": jnp.zeros(3, jnp.float32)}
+    loaded, step = load_auto_resume(fresh, ckpt)
+    assert step == 30
+    np.testing.assert_allclose(np.asarray(loaded["w"]),
+                               np.arange(8, dtype=np.float32) * 3)
+
+
+def test_auto_resume_ignores_incomplete(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    state = {"w": jnp.ones(4, jnp.float32)}
+    save_auto_resume(state, ckpt, step=1)
+    # a half-written checkpoint: directory without the .complete marker
+    os.makedirs(os.path.join(ckpt, "step_2"))
+    got = latest_checkpoint(ckpt)
+    assert got is not None and got.endswith("step_1")

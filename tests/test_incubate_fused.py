@@ -203,3 +203,26 @@ def test_fused_rms_norm_dtype_consistent_across_routes():
     x96 = jnp.asarray(rs.randn(2, 4, 96), jnp.bfloat16)
     assert IF.fused_rms_norm(x128, w128).dtype == jnp.bfloat16
     assert IF.fused_rms_norm(x96, w96).dtype == jnp.bfloat16
+
+
+def test_fused_mt_noop_padding_mask_matches_no_mask_chunked_decode():
+    """Chunked decode (sq>1 at time_step t): a semantically-empty padding
+    mask must not change attention vs attn_mask=None (code-review r2: the
+    dense fallback used whole-chunk length masking while the kernel path
+    was causal within the chunk)."""
+    paddle_tpu.seed(17)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4, dim_feedforward=64,
+                              num_layers=1)
+    m.eval()
+    rs = np.random.RandomState(3)
+    B, sq, t, Tmax = 2, 4, 6, 32
+    caches = m.init_cache(B, Tmax)
+    # prefill t tokens first so the cache is warm
+    warm = jnp.asarray(rs.randn(B, t, 32), jnp.float32)
+    _, caches = m(warm, caches=caches, time_step=None)
+    x = jnp.asarray(rs.randn(B, sq, 32), jnp.float32)
+    out_none, _ = m(x, caches=caches, time_step=t)
+    zero_mask = jnp.zeros((B, 1, 1, Tmax), jnp.float32)
+    out_zero, _ = m(x, caches=caches, time_step=t, attn_mask=zero_mask)
+    np.testing.assert_allclose(np.asarray(out_none), np.asarray(out_zero),
+                               rtol=2e-5, atol=2e-5)

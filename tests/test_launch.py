@@ -126,3 +126,54 @@ def test_launch_two_process_jax_distributed_allreduce(tmp_path):
             logs += open(p).read()
     assert r.returncode == 0, (r.stderr[-500:], logs[-1000:])
     assert logs.count("ALLREDUCE_OK") == 2, logs[-1000:]
+
+
+def test_rpc_two_processes(tmp_path):
+    """distributed.rpc across 2 real processes via the launcher env
+    contract (reference: python/paddle/distributed/rpc)."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runner = os.path.join(repo, "tests", "runners", "rpc_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PADDLE_TPU_REPO"] = repo
+    env["PADDLE_PORT"] = "62450"
+    log_dir = str(tmp_path / "log")
+    r = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir,
+         "--max_restart", "0", runner],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=180)
+    logs = ""
+    for i in (0, 1):
+        p = os.path.join(log_dir, f"workerlog.{i}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    assert r.returncode == 0, (r.stderr[-400:], logs[-800:])
+    assert logs.count("RPC_OK") == 2, logs[-800:]
+
+
+def test_launch_heartbeat_detects_hang(tmp_path):
+    """A worker that stops heartbeating is treated as hung, killed, and the
+    job restarts; the retry succeeds (elastic hang detection — reference:
+    ElasticManager heartbeats)."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runner = os.path.join(repo, "tests", "runners", "hang_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PADDLE_TPU_REPO"] = repo
+    log_dir = str(tmp_path / "log")
+    r = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--log_dir", log_dir,
+         "--heartbeat_timeout", "2", "--max_restart", "1", runner],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stderr[-500:],)
+    assert "heartbeat stale" in r.stderr
+    logs = open(os.path.join(log_dir, "workerlog.0")).read()
+    assert "HANG_RUNNER_OK" in logs

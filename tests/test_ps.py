@@ -1,0 +1,70 @@
+"""Parameter-server runtime tests (reference: fluid/distributed/ps —
+dense/sparse push-pull; scoped single-server per module docstring)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.ps as ps
+from paddle_tpu.distributed import rpc
+
+
+def teardown_function(_fn):
+    ps.shutdown()
+    ps._SERVER = None
+
+
+def test_dense_table_push_pull_local():
+    ps.init_server()
+    ps.create_table("w", shape=(4, 3), lr=0.1)
+    w0 = ps.pull("w")
+    np.testing.assert_allclose(w0, np.zeros((4, 3)))
+    g = np.ones((4, 3), np.float32)
+    ps.push("w", g)                    # w -= 0.1 * g
+    np.testing.assert_allclose(ps.pull("w"), -0.1 * g, rtol=1e-6)
+    ps.push("w", g, lr=1.0)
+    np.testing.assert_allclose(ps.pull("w"), -1.1 * g, rtol=1e-6)
+
+
+def test_sparse_table_grows_on_touch():
+    ps.init_server()
+    ps.create_table("emb", sparse_dim=5, lr=0.5)
+    rows = ps.pull_sparse("emb", [3, 7, 3])
+    assert rows.shape == (3, 5)
+    np.testing.assert_allclose(rows, 0.0)
+    ps.push_sparse("emb", [3], np.ones((1, 5), np.float32))
+    got = ps.pull_sparse("emb", [3, 7])
+    np.testing.assert_allclose(got[0], -0.5 * np.ones(5), rtol=1e-6)
+    np.testing.assert_allclose(got[1], np.zeros(5))
+
+
+def test_ps_two_processes(tmp_path):
+    """Server on rank 0, worker on rank 1 pushing/pulling over real RPC."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runner = os.path.join(repo, "tests", "runners", "ps_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PADDLE_TPU_REPO"] = repo
+    env["PADDLE_PORT"] = "62710"
+    log_dir = str(tmp_path / "log")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir,
+         "--max_restart", "0", runner],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=180)
+    logs = ""
+    for i in (0, 1):
+        p = os.path.join(log_dir, f"workerlog.{i}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    assert r.returncode == 0, (r.stderr[-400:], logs[-800:])
+    assert "PS_WORKER_OK" in logs and "PS_SERVER_OK" in logs, logs[-800:]
+
+
+def test_ps_barrier_local():
+    ps.init_server()
+    ps.barrier()          # must not rely on unpicklable payloads
