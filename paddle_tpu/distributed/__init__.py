@@ -19,6 +19,7 @@ from . import pipelining  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import rpc  # noqa: F401
 from . import fleet_utils  # noqa: F401
+from .store import TCPStore  # noqa: F401
 
 
 # semi-auto parallel symbols re-exported at top level (reference:

@@ -174,13 +174,41 @@ def push_sparse(name: str, ids, grads, lr: Optional[float] = None) -> None:
                   np.asarray(grads), lr))
 
 
-def _h_ping() -> bool:
-    return True
+_BARRIER_LOCK = threading.Lock()
+_BARRIER_STATE = {"gen": 0, "count": 0}
+_BARRIER_CV = threading.Condition(_BARRIER_LOCK)
 
 
-def barrier() -> None:
-    """Worker barrier through the server (cheap rendezvous)."""
-    rpc.rpc_sync(_SERVER_RANK, _h_ping, ())
+def _h_barrier(n: int, timeout: float = 60.0) -> bool:
+    """Server-side counting barrier: blocks until ``n`` arrivals of the
+    current generation."""
+    with _BARRIER_CV:
+        gen = _BARRIER_STATE["gen"]
+        _BARRIER_STATE["count"] += 1
+        if _BARRIER_STATE["count"] >= n:
+            _BARRIER_STATE["gen"] += 1
+            _BARRIER_STATE["count"] = 0
+            _BARRIER_CV.notify_all()
+            return True
+        import time as _t
+        deadline = _t.time() + timeout
+        while _BARRIER_STATE["gen"] == gen:
+            rem = deadline - _t.time()
+            if rem <= 0:
+                raise TimeoutError("ps.barrier timed out")
+            _BARRIER_CV.wait(rem)
+        return True
+
+
+def barrier(num_workers: Optional[int] = None, timeout: float = 60.0) -> None:
+    """Real rendezvous across workers THROUGH the server: each caller
+    blocks until ``num_workers`` (default: PADDLE_TRAINERS_NUM) have
+    arrived."""
+    import os
+    n = num_workers if num_workers is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    rpc.rpc_sync(_SERVER_RANK, _h_barrier, (n, timeout),
+                 timeout=timeout + 10.0)
 
 
 def shutdown() -> None:

@@ -166,8 +166,9 @@ def _resolve(to) -> WorkerInfo:
             except (OSError, ConnectionError):
                 continue
             if name:
-                _S.workers[name] = w
-                _S.by_rank[r] = WorkerInfo(name, w.rank, w.ip, w.port)
+                fixed = WorkerInfo(name, w.rank, w.ip, w.port)
+                _S.workers[name] = fixed
+                _S.by_rank[r] = fixed
                 if name != f"worker{r}":
                     _S.workers.pop(f"worker{r}", None)
             if name == to:

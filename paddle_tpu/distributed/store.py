@@ -1,0 +1,221 @@
+"""TCPStore — the rank-bootstrap KV store.
+
+Reference: paddle/phi/core/distributed/store/tcp_store.cc — master rank
+binds, peers connect; set/get/add/wait drive ncclUniqueId distribution and
+barriers (SURVEY.md §2.1 TCPStore row, §3.3 call stack).
+
+TPU-native note: the jit compute path needs no store (jax.distributed's
+coordination service replaces it for process bring-up), but the reference
+API is used directly by ported launch/elastic scripts, so a real
+implementation lives here: a threaded master server holding the dict, a
+thin client elsewhere; values are opaque bytes like the reference.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["TCPStore"]
+
+
+def _send(sock, obj):
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        c = sock.recv(8 - len(hdr))
+        if not c:
+            raise ConnectionError("store peer closed")
+        hdr += c
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        c = sock.recv(min(1 << 20, n - len(buf)))
+        if not c:
+            raise ConnectionError("store peer closed")
+        buf += c
+    return pickle.loads(bytes(buf))
+
+
+class TCPStore:
+    """Reference ctor: TCPStore(host, port, is_master, world_size, timeout).
+
+    Master hosts the KV dict and serves peers; every instance (master
+    included) uses the same client API: set/get/add/wait/delete_key.
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 30.0):
+        self.host, self.port = host, int(port)
+        self.is_master = is_master
+        self.timeout = timeout
+        self._kv: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+        self._server: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        if is_master:
+            self._serve()
+        else:
+            self._wait_master_up()
+
+    # ----- master side --------------------------------------------------
+    def _serve(self):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(64)
+        srv.settimeout(0.2)
+        self._server = srv
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                # the wire carries a RELATIVE timeout: an absolute client
+                # deadline would break under inter-host clock skew
+                op, key, val, rel_timeout = _recv(conn)
+                deadline = time.time() + rel_timeout
+                if op == "set":
+                    with self._cv:
+                        self._kv[key] = val
+                        self._cv.notify_all()
+                    _send(conn, ("ok", None))
+                elif op == "get":
+                    ok = self._wait_local([key], deadline)
+                    _send(conn, ("ok", self._kv[key]) if ok
+                          else ("timeout", None))
+                elif op == "add":
+                    with self._cv:
+                        cur = int(self._kv.get(key, b"0"))
+                        cur += int(val)
+                        self._kv[key] = str(cur).encode()
+                        self._cv.notify_all()
+                    _send(conn, ("ok", cur))
+                elif op == "wait":
+                    ok = self._wait_local(key, deadline)
+                    _send(conn, ("ok", None) if ok else ("timeout", None))
+                elif op == "del":
+                    with self._cv:
+                        existed = self._kv.pop(key, None) is not None
+                    _send(conn, ("ok", existed))
+                else:
+                    _send(conn, ("err", f"bad op {op}"))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _wait_local(self, keys, deadline) -> bool:
+        with self._cv:
+            while any(k not in self._kv for k in keys):
+                rem = deadline - time.time()
+                if rem <= 0:
+                    return False
+                self._cv.wait(timeout=min(rem, 0.5))
+            return True
+
+    # ----- client side --------------------------------------------------
+    def _wait_master_up(self):
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            try:
+                with socket.create_connection((self.host, self.port),
+                                              timeout=1.0):
+                    return
+            except OSError:
+                time.sleep(0.1)
+        raise TimeoutError(f"TCPStore master {self.host}:{self.port} "
+                           f"not reachable")
+
+    def _rpc(self, op, key, val=None, timeout=None):
+        deadline = time.time() + (timeout or self.timeout)
+        if self.is_master:
+            # local fast path against the same dict the server serves
+            if op == "set":
+                with self._cv:
+                    self._kv[key] = val
+                    self._cv.notify_all()
+                return None
+            if op == "get":
+                if not self._wait_local([key], deadline):
+                    raise TimeoutError(f"get({key!r}) timed out")
+                return self._kv[key]
+            if op == "add":
+                with self._cv:
+                    cur = int(self._kv.get(key, b"0")) + int(val)
+                    self._kv[key] = str(cur).encode()
+                    self._cv.notify_all()
+                return cur
+            if op == "wait":
+                if not self._wait_local(key, deadline):
+                    raise TimeoutError(f"wait({key!r}) timed out")
+                return None
+            if op == "del":
+                with self._cv:
+                    return self._kv.pop(key, None) is not None
+        rel = max(deadline - time.time(), 0.0)
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout) as sock:
+            sock.settimeout(rel + 2.0)
+            _send(sock, (op, key, val, rel))
+            status, payload = _recv(sock)
+        if status == "timeout":
+            raise TimeoutError(f"{op}({key!r}) timed out")
+        if status == "err":
+            raise RuntimeError(payload)
+        return payload
+
+    # ----- reference API -----------------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._rpc("set", key, bytes(value))
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        return self._rpc("get", key, timeout=timeout)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._rpc("add", key, amount)
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        self._rpc("wait", list(keys), timeout=timeout)
+
+    def delete_key(self, key: str) -> bool:
+        return self._rpc("del", key)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

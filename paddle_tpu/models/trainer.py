@@ -38,18 +38,25 @@ __all__ = ["GPTHybridTrainer"]
 
 class GPTHybridTrainer:
     def __init__(self, cfg: GPTConfig, hcg, optimizer, microbatches: int = 1,
-                 zero_stage: int = 1):
+                 zero_stage: int = 1, vpp: int = 1):
         self.cfg = cfg
         self.hcg = hcg
         self.mesh = hcg.get_mesh()
         self.opt = optimizer
         self.M = microbatches
         self.S = hcg.get_pipe_parallel_world_size()
-        if self.S > 1 and cfg.num_layers % self.S:
+        # interleaved (VPP) schedule: V chunks per stage round-robin
+        # (reference: PipelineParallelWithInterleave)
+        self.V = max(vpp, 1)
+        if self.S > 1 and cfg.num_layers % (self.S * self.V):
             raise ValueError(
                 f"num_layers={cfg.num_layers} must divide evenly into "
-                f"pp_degree={self.S} stages (reference PipelineLayer uniform "
-                f"segmentation has the same requirement)")
+                f"pp_degree={self.S} x vpp={self.V} chunks (reference "
+                f"PipelineLayer uniform segmentation has the same "
+                f"requirement)")
+        if self.V > 1 and self.S > 1 and microbatches % self.S:
+            raise ValueError("interleaved schedule needs microbatches "
+                             "divisible by pp_degree")
         self.zero = zero_stage
         self.model = GPTForCausalLM(cfg)
         self._build_state_layout()
@@ -71,15 +78,28 @@ class GPTHybridTrainer:
             else:
                 nonblock[k] = v
         self.block_names = sorted(blocks0)
-        # stacked block params [L, ...]
+        # stacked block params: [L, ...] for the plain schedule; for VPP,
+        # [S*V, K, ...] with the chunk dim in stack_interleaved order
+        # (device s's P('pp') slice = its round-robin chunks) and K = blocks
+        # per chunk scanned by the stage body
         stacked = {}
         stacked_specs = {}
+        interleave = self.S > 1 and self.V > 1
+        K = L // (self.S * self.V) if interleave else None
         for suffix in self.block_names:
             per = [params[f"gpt.h.{i}.{suffix}"] for i in range(L)]
-            stacked[suffix] = jnp.stack(per, axis=0)
             inner = specs.get(f"gpt.h.0.{suffix}", P())
-            stacked_specs[suffix] = P("pp" if self.S > 1 else None,
-                                      *tuple(inner))
+            if interleave:
+                order = [v * self.S + s for s in range(self.S)
+                         for v in range(self.V)]
+                stacked[suffix] = jnp.stack(
+                    [jnp.stack(per[c * K:(c + 1) * K], axis=0)
+                     for c in order], axis=0)
+                stacked_specs[suffix] = P("pp", None, *tuple(inner))
+            else:
+                stacked[suffix] = jnp.stack(per, axis=0)
+                stacked_specs[suffix] = P("pp" if self.S > 1 else None,
+                                          *tuple(inner))
         self.params_nonblock = nonblock
         self.params_blocks = stacked
         self.specs_nonblock = {k: specs.get(k, P()) for k in nonblock}
@@ -184,10 +204,17 @@ class GPTHybridTrainer:
             b, s, h = x.shape
             M = self.M
             mb = x.reshape(M, b // M, s, h)
-            out = pipeline_apply(self._body, pblk, mb, self.mesh, self.S,
-                                 remat=cfg.remat,
-                                 x_spec=P(None, self.batch_spec()[0]),
-                                 param_inner_specs=self.specs_blocks)
+            if self.V > 1:
+                from ..distributed.pipelining import \
+                    pipeline_apply_interleaved
+                out = pipeline_apply_interleaved(
+                    self._body, pblk, mb, self.mesh, self.S, self.V,
+                    remat=cfg.remat)
+            else:
+                out = pipeline_apply(self._body, pblk, mb, self.mesh, self.S,
+                                     remat=cfg.remat,
+                                     x_spec=P(None, self.batch_spec()[0]),
+                                     param_inner_specs=self.specs_blocks)
             x = out.reshape(b, s, h)
         else:
             body = jax.checkpoint(self._block_apply) if cfg.remat else \

@@ -671,3 +671,82 @@ register_op("lstsq_op", lambda a, b: T.lstsq(a, b)[0],
             lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
             _sample(lambda: _mk(4, 3), lambda: _mk(4, 2)),
             rtol=1e-3, atol=1e-3)
+
+
+# ---- conv / pooling (vision core; numpy loop oracles at tiny sizes) -------
+def _conv2d_ref(x, w):
+    # x [N,C,H,W], w [O,C,kh,kw], stride 1, no pad
+    n, c, hh, ww = x.shape
+    o, _, kh, kw = w.shape
+    out = np.zeros((n, o, hh - kh + 1, ww - kw + 1), np.float32)
+    for ni in range(n):
+        for oi in range(o):
+            for i in range(out.shape[2]):
+                for j in range(out.shape[3]):
+                    out[ni, oi, i, j] = np.sum(
+                        x[ni, :, i:i + kh, j:j + kw] * w[oi])
+    return out
+
+
+register_op("conv2d", lambda x, w: F.conv2d(x, w), _conv2d_ref,
+            _sample(lambda: _mk(2, 3, 6, 6), lambda: _mk(4, 3, 3, 3)),
+            grad_args=(0, 1), rtol=1e-4, atol=1e-4)
+register_op("conv2d_stride_pad",
+            lambda x, w: F.conv2d(x, w, stride=2, padding=1),
+            lambda x, w: _conv2d_ref(
+                np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)]), w)[:, :, ::2, ::2],
+            _sample(lambda: _mk(1, 2, 5, 5), lambda: _mk(3, 2, 3, 3)),
+            grad_args=(0, 1), rtol=1e-4, atol=1e-4)
+
+
+def _conv1d_ref(x, w):
+    n, c, L = x.shape
+    o, _, k = w.shape
+    out = np.zeros((n, o, L - k + 1), np.float32)
+    for ni in range(n):
+        for oi in range(o):
+            for i in range(out.shape[2]):
+                out[ni, oi, i] = np.sum(x[ni, :, i:i + k] * w[oi])
+    return out
+
+
+register_op("conv1d", lambda x, w: F.conv1d(x, w), _conv1d_ref,
+            _sample(lambda: _mk(2, 3, 8), lambda: _mk(4, 3, 3)),
+            grad_args=(0, 1), rtol=1e-4, atol=1e-4)
+
+
+def _pool2d_ref(x, k, mode):
+    n, c, hh, ww = x.shape
+    oh, ow = hh // k, ww // k
+    out = np.zeros((n, c, oh, ow), np.float32)
+    red = np.max if mode == "max" else np.mean
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = red(
+                x[:, :, i * k:(i + 1) * k, j * k:(j + 1) * k], axis=(2, 3))
+    return out
+
+
+register_op("max_pool2d", lambda x: F.max_pool2d(x, 2, stride=2),
+            lambda x: _pool2d_ref(x, 2, "max"),
+            _sample(lambda: _mk(2, 3, 6, 6)), grad_args=(0,))
+register_op("avg_pool2d", lambda x: F.avg_pool2d(x, 2, stride=2),
+            lambda x: _pool2d_ref(x, 2, "avg"),
+            _sample(lambda: _mk(2, 3, 6, 6)), grad_args=(0,))
+register_op("adaptive_avg_pool2d",
+            lambda x: F.adaptive_avg_pool2d(x, 1),
+            lambda x: x.mean(axis=(2, 3), keepdims=True),
+            _sample(lambda: _mk(2, 3, 5, 5)), grad_args=(0,))
+register_op("interpolate_nearest",
+            lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+            lambda x: x.repeat(2, axis=2).repeat(2, axis=3),
+            _sample(lambda: _mk(1, 2, 3, 3)), grad_args=(0,))
+register_op("batch_norm_infer",
+            lambda x, w, b, m, v: F.batch_norm(x, m, v, w, b, training=False),
+            lambda x, w, b, m, v: ((x - m[None, :, None, None]) /
+                                   np.sqrt(v[None, :, None, None] + 1e-5) *
+                                   w[None, :, None, None] +
+                                   b[None, :, None, None]),
+            _sample(lambda: _mk(2, 3, 4, 4), lambda: _pos(3), lambda: _mk(3),
+                    lambda: _mk(3), lambda: _pos(3)),
+            rtol=1e-4, atol=1e-4)

@@ -44,3 +44,64 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
 def load_inference_model(path_prefix: str, executor=None, **kwargs):
     from ..jit import load
     return load(path_prefix)
+
+
+# --- static.nn control flow (reference: paddle.static.nn.cond/while_loop/
+# case/switch_case — dy2static's targets).  Under jit these ARE lax ops. ---
+class _StaticNN:
+    @staticmethod
+    def cond(pred, true_fn, false_fn=None, name=None):
+        import jax
+        return jax.lax.cond(pred, true_fn, false_fn or (lambda: None))
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        import jax
+        vars_t = tuple(loop_vars)
+        out = jax.lax.while_loop(lambda vs: cond(*vs),
+                                 lambda vs: tuple(body(*vs)), vars_t)
+        return list(out)
+
+    @staticmethod
+    def case(pred_fn_pairs, default=None, name=None):
+        import jax
+        import jax.numpy as jnp
+        preds = [p for p, _ in pred_fn_pairs]
+        fns = [f for _, f in pred_fn_pairs]
+        if default is not None:
+            fns = fns + [default]
+        # first true predicate wins (reference semantics)
+        idx = jnp.argmax(jnp.stack([jnp.asarray(p, jnp.int32)
+                                    for p in preds] + [jnp.asarray(1)]))
+        return jax.lax.switch(jnp.minimum(idx, len(fns) - 1), fns)
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        import jax
+        import jax.numpy as jnp
+        if isinstance(branch_fns, dict):
+            keys = sorted(branch_fns)
+            fns = [branch_fns[k] for k in keys]
+            table = {k: i for i, k in enumerate(keys)}
+            idx = sum(jnp.where(branch_index == k, i, 0)
+                      for k, i in table.items())
+            known = sum((branch_index == k).astype(jnp.int32)
+                        for k in keys)
+            if default is not None:
+                fns = fns + [default]
+            # unmatched key -> default if given, else the LAST branch
+            # (reference switch_case semantics)
+            idx = jnp.where(known > 0, idx, len(fns) - 1)
+        else:
+            fns = list(branch_fns)
+            n = len(fns)
+            if default is not None:
+                fns = fns + [default]
+            in_range = jnp.logical_and(branch_index >= 0, branch_index < n)
+            # out-of-range -> default if given, else the last branch
+            idx = jnp.where(in_range, branch_index, len(fns) - 1)
+        return jax.lax.switch(idx, fns)
+
+
+nn = _StaticNN()
+__all__ += ["nn"]

@@ -1,4 +1,5 @@
-"""2-process PS smoke: rank 0 = server, rank 1 = worker."""
+"""2-process PS smoke: rank 0 = server, rank 1 = worker; sequenced by the
+REAL ps.barrier (counting rendezvous through the server)."""
 import os
 import sys
 import time
@@ -12,17 +13,20 @@ rank = int(os.environ["PADDLE_TRAINER_ID"])
 if rank == 0:
     ps.init_server()
     ps.create_table("w", shape=(2, 2), lr=0.1)
-    time.sleep(5.0)                  # serve while the worker runs
-    final = ps.pull("w")             # local read of the updated table
+    ps.barrier(2)                    # table exists -> release worker
+    ps.barrier(2)                    # worker finished its push
+    final = ps.pull("w")
     assert abs(float(final[0, 0]) + 0.1) < 1e-6, final
     print("PS_SERVER_OK")
 else:
-    time.sleep(1.0)                  # let the server table exist
+    time.sleep(0.5)                  # let the server socket come up
     ps.init_worker()
+    ps.barrier(2)
     w = ps.pull("w")
     assert w.shape == (2, 2) and float(w.sum()) == 0.0
     ps.push("w", np.ones((2, 2), np.float32))
     w2 = ps.pull("w")
     assert abs(float(w2[0, 0]) + 0.1) < 1e-6, w2
+    ps.barrier(2)
     print("PS_WORKER_OK")
 ps.shutdown()

@@ -1,0 +1,77 @@
+"""static.nn control flow + distributed TCPStore
+(reference: paddle.static.nn.cond/while_loop; phi TCPStore)."""
+
+import socket
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.static as static
+from paddle_tpu.distributed.store import TCPStore
+
+
+def _freeport():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_static_nn_cond_and_while():
+    out = static.nn.cond(jnp.asarray(True), lambda: jnp.asarray(1.0),
+                         lambda: jnp.asarray(2.0))
+    assert float(out) == 1.0
+
+    i, s = static.nn.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (i + 1, s + i),
+        [jnp.asarray(0), jnp.asarray(0)])
+    assert int(i) == 5 and int(s) == 10
+
+
+def test_static_nn_switch_case():
+    fns = [lambda: jnp.asarray(10.0), lambda: jnp.asarray(20.0)]
+    assert float(static.nn.switch_case(jnp.asarray(1), fns)) == 20.0
+    got = static.nn.switch_case(jnp.asarray(7), {0: fns[0], 3: fns[1]},
+                                default=lambda: jnp.asarray(-1.0))
+    assert float(got) == -1.0
+
+
+def test_tcp_store_master_and_client():
+    port = _freeport()
+    master = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    client = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+    client.set("uid", b"nccl-id-bytes")
+    assert master.get("uid") == b"nccl-id-bytes"
+    assert client.add("counter", 1) == 1
+    assert master.add("counter", 2) == 3
+
+    # wait unblocks when another party sets the key
+    def later():
+        import time
+        time.sleep(0.3)
+        master.set("go", b"1")
+
+    t = threading.Thread(target=later)
+    t.start()
+    client.wait(["go"], timeout=5.0)
+    t.join()
+    assert client.delete_key("go") is True
+    with pytest.raises(TimeoutError):
+        client.get("absent", timeout=0.5)
+    master.close()
+
+
+def test_static_nn_switch_case_unmatched_semantics():
+    # code-review r2: unmatched dict key / out-of-range index must take the
+    # default when given, else the LAST branch (reference semantics)
+    f = lambda: jnp.asarray(10.0)
+    g = lambda: jnp.asarray(20.0)
+    assert float(static.nn.switch_case(jnp.asarray(7), {0: f, 3: g})) == 20.0
+    assert float(static.nn.switch_case(jnp.asarray(-1), [f, g],
+                                       default=lambda: jnp.asarray(-5.0))
+                 ) == -5.0
+    assert float(static.nn.switch_case(jnp.asarray(5), [f, g])) == 20.0
