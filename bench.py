@@ -161,6 +161,11 @@ def _run_bench(on_tpu, tpu_diag=None):
               "step_ms": round(dt / iters * 1e3, 1),
               "config": f"L{cfg.num_layers}-H{cfg.hidden_size}"
                         f"-b{batch}-s{seq}"}
+    if on_tpu and os.environ.get("BENCH_KERNELS", "1") == "1":
+        try:
+            extras["kernels"] = _kernel_compare()
+        except Exception as e:
+            extras["kernels"] = {"error": str(e)[-300:]}
     if tpu_diag:
         extras["tpu_probe_error"] = tpu_diag
     _emit({
@@ -170,6 +175,66 @@ def _run_bench(on_tpu, tpu_diag=None):
         "vs_baseline": round(mfu / 0.45, 4),  # fraction of 45%-MFU target
         "extras": extras,
     })
+
+
+def _kernel_compare():
+    """Pallas-vs-XLA speedups for the custom kernel tier, on-chip (compact
+    version of scripts/tpu_kernel_bench.py; proves kernel necessity per
+    round-1 VERDICT item 2).  Timing forces host transfers (weak axon
+    sync)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import flash_attention, fused_rms_norm_pallas
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+
+    def timeit(fn, *args, iters=5):
+        out = fn(*args)
+        float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    rs = np.random.RandomState(0)
+    res = {}
+    b, s, h, d = 2, 2048, 8, 128
+    q = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+
+    fa = jax.jit(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True, interpret=False) ** 2))
+    xa = jax.jit(lambda q, k, v: jnp.sum(
+        sdpa_reference(q, k, v, is_causal=True, training=False) ** 2))
+    fa_g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, interpret=False) ** 2), argnums=(0, 1, 2)))
+    xa_g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(sdpa_reference(
+        q, k, v, is_causal=True, training=False) ** 2), argnums=(0, 1, 2)))
+    rel = abs(float(fa(q, k, v)) - float(xa(q, k, v))) / \
+        max(abs(float(xa(q, k, v))), 1e-6)
+    t_p, t_x = timeit(fa, q, k, v), timeit(xa, q, k, v)
+    tg_p, tg_x = timeit(fa_g, q, k, v), timeit(xa_g, q, k, v)
+    res["flash_attn_fwd"] = {"ok": rel < 2e-2, "pallas_ms": round(t_p, 2),
+                             "xla_ms": round(t_x, 2),
+                             "speedup": round(t_x / t_p, 2)}
+    res["flash_attn_bwd"] = {"pallas_ms": round(tg_p, 2),
+                             "xla_ms": round(tg_x, 2),
+                             "speedup": round(tg_x / tg_p, 2)}
+
+    x = jnp.asarray(rs.randn(4096, 4096), jnp.bfloat16)
+    w = jnp.asarray(rs.randn(4096), jnp.float32)
+    rp = jax.jit(lambda x, w: fused_rms_norm_pallas(x, w, 1e-6,
+                                                    interpret=False))
+    rx = jax.jit(lambda x, w: (x.astype(jnp.float32) * jax.lax.rsqrt(
+        jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        + 1e-6) * w).astype(x.dtype))
+    err = float(jnp.max(jnp.abs(rp(x, w).astype(jnp.float32) -
+                                rx(x, w).astype(jnp.float32))))
+    res["fused_rms_norm"] = {"ok": err < 0.1,
+                             "pallas_ms": round(timeit(rp, x, w), 3),
+                             "xla_ms": round(timeit(rx, x, w), 3)}
+    return res
 
 
 def main():

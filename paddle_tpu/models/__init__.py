@@ -6,3 +6,5 @@ from .trainer import GPTHybridTrainer  # noqa: F401
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
                     LlamaAttention, LlamaMLP, LlamaDecoderLayer,
                     llama_shard_fn, llama_tiny, llama_7b)
+from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM,  # noqa: F401
+                      gpt_moe_tiny)

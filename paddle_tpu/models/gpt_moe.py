@@ -1,0 +1,144 @@
+"""GPT-MoE — expert-parallel decoder LM (BASELINE config #5).
+
+Reference model surface: paddle.incubate.distributed.models.moe —
+MoELayer-based GPT variants (the expert-parallel baseline config), gates
+from gate/gshard_gate.py / switch_gate.py, dispatch via
+global_scatter/global_gather (SURVEY.md §2.3 EP row).
+
+TPU-native design: standard GPT blocks with every ``moe_every``-th FFN
+replaced by distributed.moe.MoELayer; experts shard over the ``ep`` (or
+given) mesh axis, dispatch einsums compile to all-to-all; the gates' aux
+load-balance losses cross jit functionally as buffers and are summed into
+the LM loss with ``aux_weight``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import LayerNorm
+from ..distributed.moe import MoELayer, ExpertFFN
+
+__all__ = ["GPTMoEConfig", "GPTMoEForCausalLM", "gpt_moe_tiny"]
+
+
+@dataclasses.dataclass
+class GPTMoEConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_mult: int = 4
+    num_experts: int = 8
+    top_k: int = 2
+    moe_every: int = 2            # every k-th block uses the MoE FFN
+    gate: str = "gshard"          # naive | gshard | switch
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+    # ParallelAxis / mesh-axis name for expert parallelism (EP)
+    moe_group: Optional[object] = None
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.hidden_size * self.ffn_mult
+
+
+class _MoEBlock(Layer):
+    def __init__(self, cfg: GPTMoEConfig, use_moe: bool):
+        super().__init__()
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.use_moe = use_moe
+        self.ln_1 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.qkv = Linear(h, 3 * h)
+        self.out_proj = Linear(h, h)
+        self.ln_2 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        if use_moe:
+            experts = [ExpertFFN(h, cfg.ffn_size)
+                       for _ in range(cfg.num_experts)]
+            self.ffn = MoELayer(h, experts,
+                                gate={"type": cfg.gate, "topk": cfg.top_k}
+                                if cfg.gate != "switch" else
+                                {"type": "switch"},
+                                moe_group=cfg.moe_group,
+                                capacity_factor=cfg.capacity_factor)
+        else:
+            self.fc_in = Linear(h, cfg.ffn_size)
+            self.fc_out = Linear(cfg.ffn_size, h)
+        self.drop = Dropout(cfg.dropout)
+
+    def _attn(self, x):
+        cfg = self.cfg
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        return self.out_proj(out.reshape(b, s, h))
+
+    def forward(self, x):
+        x = x + self.drop(self._attn(self.ln_1(x)))
+        h = self.ln_2(x)
+        if self.use_moe:
+            m = self.ffn(h)
+        else:
+            m = self.fc_out(F.gelu(self.fc_in(h), approximate=True))
+        return x + self.drop(m)
+
+
+class GPTMoEForCausalLM(Layer):
+    def __init__(self, cfg: GPTMoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.h = LayerList([
+            _MoEBlock(cfg, use_moe=(i % cfg.moe_every == cfg.moe_every - 1))
+            for i in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = jnp.arange(s)[None, :]
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.h:
+            x = blk(x)
+        x = self.ln_f(x)
+        return jnp.einsum("bsh,vh->bsv", x, self.wte.weight)
+
+    def loss(self, input_ids, labels, aux_from_buffers=None):
+        """LM cross-entropy + aux load-balance losses.  Under jit, pass the
+        buffers dict functional_call returned (``aux_from_buffers``) so the
+        gates' aux terms are the CURRENT step's values."""
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        lm = -jnp.mean(tok)
+        if aux_from_buffers is not None:
+            aux = sum(v for k, v in aux_from_buffers.items()
+                      if k.endswith("aux_loss"))
+            return lm + self.cfg.aux_weight * aux
+        return lm
+
+
+def gpt_moe_tiny(**kw) -> GPTMoEConfig:
+    return GPTMoEConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=128, num_experts=4,
+                        **kw)
