@@ -231,9 +231,11 @@ def _kernel_compare():
         + 1e-6) * w).astype(x.dtype))
     err = float(jnp.max(jnp.abs(rp(x, w).astype(jnp.float32) -
                                 rx(x, w).astype(jnp.float32))))
+    t_rp, t_rx = timeit(rp, x, w), timeit(rx, x, w)
     res["fused_rms_norm"] = {"ok": err < 0.1,
-                             "pallas_ms": round(timeit(rp, x, w), 3),
-                             "xla_ms": round(timeit(rx, x, w), 3)}
+                             "pallas_ms": round(t_rp, 3),
+                             "xla_ms": round(t_rx, 3),
+                             "speedup": round(t_rx / max(t_rp, 1e-9), 2)}
     return res
 
 

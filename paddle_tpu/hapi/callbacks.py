@@ -8,7 +8,8 @@ import os
 import time
 from typing import List, Optional
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRSchedulerCallback",
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "AutoResume",
+           "LRSchedulerCallback",
            "EarlyStopping", "CallbackList"]
 
 
@@ -172,3 +173,65 @@ class EarlyStopping(Callback):
             if self.wait >= self.patience:
                 self.stopped = True
                 self.model.stop_training = True
+
+
+class AutoResume(Callback):
+    """Elastic restart-from-checkpoint bridge (reference stance: TPU slices
+    fail whole — SURVEY.md §7(d); pairs with the launcher's heartbeat
+    restart).  On train begin, loads the newest complete checkpoint under
+    ``ckpt_dir`` (fleet_utils.latest_checkpoint contract) — parameters,
+    buffers AND optimizer state — and records it in ``resumed_epoch``;
+    post-resume checkpoints continue the GLOBAL epoch numbering
+    (resumed_epoch + local epoch) so retention never evicts newer state.
+
+    Epoch-count semantics (documented): a callback cannot shrink
+    Model.fit's loop, so after a resume ``fit(epochs=N)`` runs N MORE
+    epochs; pass the remaining count (the reference leaves the same
+    decision to user scripts)."""
+
+    def __init__(self, ckpt_dir: str = "auto_resume", save_freq: int = 1,
+                 keep_last: int = 2):
+        self.ckpt_dir = ckpt_dir
+        self.save_freq = save_freq
+        self.keep_last = keep_last
+        self.resumed_epoch = None
+
+    def _state(self):
+        # hapi.Model trains on its OWN _params/_buffers/_opt_state pytrees
+        # (not the network's live stores), so resume must target those.
+        # Optimizer slots (Adam moments, step count) are part of the
+        # trajectory: omitting them silently changes post-resume updates.
+        import jax as _jax
+        st = {**{f"p::{k}": v for k, v in self.model._params.items()},
+              **{f"b::{k}": v for k, v in self.model._buffers.items()}}
+        if self.model._opt_state is not None:
+            leaves = _jax.tree_util.tree_leaves(self.model._opt_state)
+            st.update({f"o::{i}": v for i, v in enumerate(leaves)})
+        return st
+
+    def on_train_begin(self, logs=None):
+        from ..distributed.fleet_utils import load_auto_resume
+        import jax as _jax
+        loaded, step = load_auto_resume(self._state(), self.ckpt_dir,
+                                        prefix="epoch_")
+        if step is None:
+            return
+        self.resumed_epoch = step
+        self.model._params = {k[3:]: v for k, v in loaded.items()
+                              if k.startswith("p::")}
+        self.model._buffers = {k[3:]: v for k, v in loaded.items()
+                               if k.startswith("b::")}
+        if self.model._opt_state is not None:
+            treedef = _jax.tree_util.tree_structure(self.model._opt_state)
+            n = treedef.num_leaves
+            leaves = [loaded[f"o::{i}"] for i in range(n)]
+            self.model._opt_state = _jax.tree_util.tree_unflatten(treedef,
+                                                                  leaves)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            from ..distributed.fleet_utils import save_auto_resume
+            base = self.resumed_epoch or 0
+            save_auto_resume(self._state(), self.ckpt_dir,
+                             step=base + epoch + 1,
+                             prefix="epoch_", keep_last=self.keep_last)

@@ -123,19 +123,34 @@ class GPTMoEForCausalLM(Layer):
         x = self.ln_f(x)
         return jnp.einsum("bsh,vh->bsv", x, self.wte.weight)
 
-    def loss(self, input_ids, labels, aux_from_buffers=None):
-        """LM cross-entropy + aux load-balance losses.  Under jit, pass the
-        buffers dict functional_call returned (``aux_from_buffers``) so the
-        gates' aux terms are the CURRENT step's values."""
+    def loss(self, input_ids, labels):
+        """LM cross-entropy + aux load-balance losses in ONE forward pass:
+        right after ``self(input_ids)`` the gates' ``aux_loss`` buffers
+        hold THIS pass's traced values (functional_call's bind keeps them
+        live for the duration of the call), so no second forward and no
+        RNG mismatch between the lm and aux terms."""
         logits = self(input_ids).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         lm = -jnp.mean(tok)
-        if aux_from_buffers is not None:
-            aux = sum(v for k, v in aux_from_buffers.items()
-                      if k.endswith("aux_loss"))
-            return lm + self.cfg.aux_weight * aux
-        return lm
+        return lm + self.cfg.aux_weight * self.gate_aux_loss()
+
+    def gate_aux_loss(self):
+        """Sum of the gates' aux buffers from the most recent forward."""
+        from ..distributed.moe import BaseGate
+        total = jnp.zeros((), jnp.float32)
+        for _, sub in self.named_sublayers(include_self=False):
+            if isinstance(sub, BaseGate):
+                total = total + sub.aux_loss
+        return total
+
+    @staticmethod
+    def loss_from_logits(logits, labels, buffers, aux_weight: float):
+        """Variant for callers holding functional_call's (out, buffers)."""
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        aux = sum(v for k, v in buffers.items() if k.endswith("aux_loss"))
+        return -jnp.mean(tok) + aux_weight * aux
 
 
 def gpt_moe_tiny(**kw) -> GPTMoEConfig:

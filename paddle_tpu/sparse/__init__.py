@@ -1,0 +1,157 @@
+"""paddle.sparse facade — COO/CSR sparse tensors.
+
+Reference: python/paddle/sparse/ backed by phi sparse kernels
+(paddle/phi/kernels/sparse/ — part of the PHI kernel library row,
+SURVEY.md §2.1).
+
+TPU-native: sparse storage/compute delegates to jax.experimental.sparse
+(BCOO/BCSR — XLA-lowered gather/scatter/dot_general).  Note the honest
+perf stance: TPUs have no sparse MXU path, so XLA executes these as
+gather/scatter programs — fine for sparse IO/embedding-style use, not a
+CUDA-cusparse replacement; dense paddle_tpu ops remain the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "is_sparse",
+           "is_sparse_coo", "is_sparse_csr", "to_dense", "to_sparse_coo",
+           "add", "subtract", "multiply", "matmul", "masked_matmul",
+           "relu", "tanh", "transpose", "nn"]
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient: bool = True):
+    """Reference: paddle.sparse.sparse_coo_tensor(indices [ndim, nnz],
+    values [nnz], shape)."""
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values, dtype=dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in jnp.max(indices, axis=1))
+    return jsparse.BCOO((values, indices.T), shape=tuple(shape))
+
+
+def _tag_csr(x):
+    x._paddle_csr = True
+    return x
+
+
+def _copy_fmt(src, dst):
+    if getattr(src, "_paddle_csr", False):
+        dst._paddle_csr = True
+    return dst
+
+
+def sparse_csr_tensor(crows, cols, values, shape,
+                      dtype=None, place=None, stop_gradient: bool = True):
+    """Reference: paddle.sparse.sparse_csr_tensor.  Stored as BCOO
+    internally (jax's CSR support is narrower); numeric semantics
+    preserved.  The CSR identity is a creation-time tag that this facade's
+    own ops propagate, but pytree reconstruction (jit/grad/tree_map)
+    normalizes to COO — is_sparse_csr is therefore best-effort, documented
+    deviation (our single internal storage IS coordinate format)."""
+    crows = jnp.asarray(crows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    values = jnp.asarray(values, dtype=dtype)
+    # expand crow pointers to row indices
+    counts = crows[1:] - crows[:-1]
+    rows = jnp.repeat(jnp.arange(counts.shape[0], dtype=jnp.int32), counts,
+                      total_repeat_length=values.shape[0])
+    idx = jnp.stack([rows, cols], axis=1)
+    return _tag_csr(jsparse.BCOO((values, idx), shape=tuple(shape)))
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (jsparse.BCOO, jsparse.BCSR))
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, jsparse.BCOO) and not getattr(x, "_paddle_csr",
+                                                       False)
+
+
+def is_sparse_csr(x) -> bool:
+    return getattr(x, "_paddle_csr", False) or isinstance(x, jsparse.BCSR)
+
+
+def to_dense(x):
+    return x.todense() if is_sparse(x) else jnp.asarray(x)
+
+
+def to_sparse_coo(x, sparse_dim: Optional[int] = None):
+    return jsparse.BCOO.fromdense(jnp.asarray(x))
+
+
+def _binop(op, x, y):
+    xd = to_dense(x)
+    yd = to_dense(y)
+    out = op(xd, yd)
+    if is_sparse(x) or is_sparse(y):
+        res = jsparse.BCOO.fromdense(out)
+        return _copy_fmt(x if is_sparse(x) else y, res)
+    return out
+
+
+def add(x, y, name=None):
+    return _binop(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binop(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binop(jnp.multiply, x, y)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (reference semantics); lowered via
+    BCOO dot_general (XLA gather/scatter)."""
+    if is_sparse(x):
+        return x @ jnp.asarray(to_dense(y) if is_sparse(y) else y)
+    return jnp.asarray(x) @ to_dense(y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at ``mask``'s nonzero pattern
+    (reference: paddle.sparse.masked_matmul; SDDMM)."""
+    dense = jnp.asarray(x) @ jnp.asarray(y)
+    idx = mask.indices
+    vals = dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    return jsparse.BCOO((vals, idx), shape=dense.shape)
+
+
+def _unary(op):
+    def f(x, name=None):
+        if is_sparse(x):
+            return _copy_fmt(x, jsparse.BCOO((op(x.data), x.indices),
+                                             shape=x.shape))
+        return op(jnp.asarray(x))
+    return f
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+tanh = _unary(jnp.tanh)
+
+
+def transpose(x, perm, name=None):
+    if is_sparse(x):
+        return _copy_fmt(x, jsparse.BCOO.fromdense(
+            jnp.transpose(to_dense(x), perm)))
+    return jnp.transpose(x, perm)
+
+
+class _SparseNN:
+    """paddle.sparse.nn subset: ReLU layer parity."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+nn = _SparseNN()

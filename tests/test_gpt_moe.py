@@ -90,3 +90,36 @@ def test_gpt_moe_expert_parallel_matches_serial():
     out_p, _ = functional_call(par, pp, bp, (x,), train=False)
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_p),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_moe_loss_single_forward_with_aux():
+    """model.loss = lm + aux from ONE forward: the gates' aux buffers are
+    read right after self() inside the same bind (code-review r2: the old
+    signature forced a second forward or stale aux)."""
+    paddle_tpu.seed(5)
+    cfg = gpt_moe_tiny(gate="gshard")
+    model = GPTMoEForCausalLM(cfg)
+    model.train()
+    params, buffers = state(model)
+    x, y = _data(seed=6)
+    key = jax.random.PRNGKey(1)
+
+    from paddle_tpu.nn.functional_call import bind_state
+    from paddle_tpu.framework.random import rng_context
+
+    @jax.jit
+    def run(p, b):
+        with bind_state(model, p, b):
+            with rng_context(key):
+                return model.loss(x, y)
+
+    total = float(run(params, buffers))
+    # oracle: the two-output route with the SAME rng -> lm + w*aux
+    @jax.jit
+    def parts(p, b):
+        out, nb = functional_call(model, p, b, (x,), rng=key, train=True)
+        return GPTMoEForCausalLM.loss_from_logits(out, y, nb,
+                                                  cfg.aux_weight)
+
+    np.testing.assert_allclose(total, float(parts(params, buffers)),
+                               rtol=1e-5)

@@ -1,0 +1,61 @@
+"""paddle.sparse facade over jax.experimental.sparse (reference:
+python/paddle/sparse backed by phi sparse kernels)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as sparse
+
+
+def test_coo_roundtrip_and_ops():
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+    assert sparse.is_sparse(s) and sparse.is_sparse_coo(s)
+    d = np.zeros((3, 3), np.float32)
+    d[idx[0], idx[1]] = vals
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), d)
+    # add + relu keep sparsity semantics
+    out = sparse.add(s, s)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(out)), 2 * d)
+    neg = sparse.sparse_coo_tensor(idx, -vals, [3, 3])
+    np.testing.assert_allclose(
+        np.asarray(sparse.to_dense(sparse.relu(neg))), np.zeros((3, 3)))
+
+
+def test_csr_and_matmul():
+    # csr for [[1,0],[0,2]]
+    s = sparse.sparse_csr_tensor([0, 1, 2], [0, 1], [1.0, 2.0], [2, 2])
+    assert sparse.is_sparse_csr(s)
+    y = jnp.asarray(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.array([[1, 2], [6, 8]], np.float32))
+
+
+def test_masked_matmul_sddmm():
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(4, 5).astype(np.float32))
+    b = jnp.asarray(rs.randn(5, 4).astype(np.float32))
+    idx = np.array([[0, 0], [1, 3], [2, 2]])
+    mask = sparse.sparse_coo_tensor(idx.T, np.ones(3, np.float32), [4, 4])
+    out = sparse.masked_matmul(a, b, mask)
+    dense = np.asarray(a) @ np.asarray(b)
+    got = np.asarray(sparse.to_dense(out))
+    for r, c in idx:
+        np.testing.assert_allclose(got[r, c], dense[r, c], rtol=1e-5)
+    assert got[0, 1] == 0.0
+
+
+def test_to_sparse_and_dense_passthrough():
+    x = np.array([[0.0, 1.0], [2.0, 0.0]], np.float32)
+    s = sparse.to_sparse_coo(jnp.asarray(x))
+    assert sparse.is_sparse(s)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), x)
+
+
+def test_csr_tag_survives_facade_ops():
+    s = sparse.sparse_csr_tensor([0, 1, 2], [0, 1], [1.0, -2.0], [2, 2])
+    assert sparse.is_sparse_csr(sparse.relu(s))
+    assert sparse.is_sparse_csr(sparse.add(s, s))
+    assert sparse.is_sparse_csr(sparse.transpose(s, [1, 0]))
