@@ -166,6 +166,13 @@ def _run_bench(on_tpu, tpu_diag=None):
             extras["kernels"] = _kernel_compare()
         except Exception as e:
             extras["kernels"] = {"error": str(e)[-300:]}
+    if on_tpu and os.environ.get("BENCH_FULL", "0") == "1":
+        # secondary BASELINE configs (#1 resnet, #2 transformer, #4 llama,
+        # #5 moe) — opt-in: they add compile time to the driver run
+        try:
+            extras["secondary"] = _secondary_benches()
+        except Exception as e:
+            extras["secondary"] = {"error": str(e)[-300:]}
     if tpu_diag:
         extras["tpu_probe_error"] = tpu_diag
     _emit({
@@ -237,6 +244,94 @@ def _kernel_compare():
                              "xla_ms": round(t_rx, 3),
                              "speedup": round(t_rx / max(t_rp, 1e-9), 2)}
     return res
+
+
+def _secondary_benches():
+    """BASELINE configs #1/#2/#4/#5 at single-chip scale: steady-state
+    step time + items/sec each (host-transfer-synced)."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.nn.functional_call import functional_call, state
+
+    def train_tput(model, batch_args, loss_fn, items_per_step, iters=8):
+        params, buffers = state(model)
+        o = opt.AdamW(learning_rate=1e-4)
+        ostate = o.init(params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(p, os_):
+            def lf(p):
+                out, _ = functional_call(model, p, buffers, batch_args,
+                                         train=True)
+                return loss_fn(out)
+            l, g = jax.value_and_grad(lf)(p)
+            newp, nos = o.update(g, os_, p)
+            return newp, nos, l
+
+        params, ostate, l = step(params, ostate)
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, ostate, l = step(params, ostate)
+        float(l)
+        dt = (time.perf_counter() - t0) / iters
+        return {"step_ms": round(dt * 1e3, 1),
+                "items_per_sec": round(items_per_step / dt, 1)}
+
+    rs = np.random.RandomState(0)
+    out = {}
+
+    # 1 ResNet50 (img/sec)
+    from paddle_tpu.vision.models import resnet50
+    img = jnp.asarray(rs.randn(16, 3, 224, 224), jnp.float32)
+    lbl = jnp.asarray(rs.randint(0, 1000, (16,)))
+    import paddle_tpu.nn.functional as F
+    out["resnet50"] = train_tput(
+        resnet50(), (img,), lambda o: F.cross_entropy(o, lbl), 16)
+
+    # 2 nn.Transformer encoder-decoder (tokens/sec)
+    import paddle_tpu.nn as nn
+    tr = nn.Transformer(d_model=256, nhead=8, num_encoder_layers=3,
+                        num_decoder_layers=3, dim_feedforward=1024)
+    src = jnp.asarray(rs.randn(8, 128, 256), jnp.float32)
+    tgt = jnp.asarray(rs.randn(8, 128, 256), jnp.float32)
+    out["transformer"] = train_tput(
+        tr, (src, tgt), lambda o: jnp.mean(o ** 2), 8 * 128)
+
+    # 4 Llama (tokens/sec, bf16 remat)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    lcfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                       intermediate_size=2816, num_layers=8, num_heads=16,
+                       max_seq_len=1024, dtype="bfloat16", remat=True)
+    lm = LlamaForCausalLM(lcfg)
+    lm.to(dtype="bfloat16")
+    ids = jnp.asarray(rs.randint(0, 32000, (4, 1025)))
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    def llama_loss(logits):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
+
+    out["llama"] = train_tput(lm, (x,), llama_loss, 4 * 1024)
+
+    # 5 GPT-MoE (tokens/sec)
+    from paddle_tpu.models import GPTMoEForCausalLM, GPTMoEConfig
+    mcfg = GPTMoEConfig(vocab_size=32000, hidden_size=512, num_layers=4,
+                        num_heads=8, max_seq_len=512, num_experts=8,
+                        gate="naive")
+    mm = GPTMoEForCausalLM(mcfg)
+    mids = jnp.asarray(rs.randint(0, 32000, (8, 513)))
+    mx, my = mids[:, :-1], mids[:, 1:]
+
+    def moe_loss(logits):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(logp, my[..., None], -1))
+
+    out["gpt_moe"] = train_tput(mm, (mx,), moe_loss, 8 * 512)
+    return out
 
 
 def main():

@@ -8,7 +8,8 @@ the MXU/VPU; everything falls back to the pure-XLA path off-TPU (the
 kernels also run under ``interpret=True`` for CPU tests).
 """
 
-from .flash_attention import flash_attention, flash_attention_with_lse
+from .flash_attention import (flash_attention, flash_attention_with_lse,
+                              flash_attention_varlen)
 from .fused_adamw import fused_adamw_update
 from .fused_norm import fused_rms_norm_pallas
 from .decode_attention import decode_attention

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "flash_attention_varlen"]
 
 _NEG_INF = float("-inf")
 _LANES = 128
@@ -73,8 +74,12 @@ def _causal_lo(ki, block_q, block_k, off, nq):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
-                scale, causal, block_q, block_k, nk, off):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+                nk, off, seg=False):
+    if seg:
+        qs_ref, ks_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -100,6 +105,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos + off, s, _NEG_INF)
+        if seg:
+            # varlen/packed sequences: only same-segment pairs attend
+            s = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, block_k),
+                          s, _NEG_INF)
         m_prev = m_sc[...]                              # [bq, 128]
         l_prev = l_sc[...]
         m_curr = jnp.max(s, axis=1)[:, None]            # [bq, 1]
@@ -127,13 +136,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
         lse_ref[0] = lse.astype(jnp.float32)
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+               qs3=None, ks3=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     off = sk - sq
     nq = sq // block_q
     nk = sk // block_k
     grid = (bh, nq, nk)
+    seg = qs3 is not None
 
     if causal:
         def kv_idx(b, qi, ki):
@@ -143,15 +154,25 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
         def kv_idx(b, qi, ki):
             return (b, ki, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+    ]
+    args = [q3, k3, v3]
+    if seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, 1), kv_idx),
+        ]
+        args += [qs3, ks3]
+
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk, off=off),
+                          block_q=block_q, block_k=block_k, nk=nk, off=off,
+                          seg=seg),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_idx),
-            pl.BlockSpec((1, block_k, d), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -167,7 +188,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_dimension_semantics(3, interpret),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
     return out, lse[..., 0]
 
 
@@ -175,8 +196,12 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_sc, *, scale, causal, block_q, block_k, nk, off):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, block_q, block_k, nk, off, seg=False):
+    if seg:
+        qs_ref, ks_ref, dq_ref, acc_sc = rest
+    else:
+        dq_ref, acc_sc = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -203,6 +228,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos + off, s, _NEG_INF)
+        if seg:
+            s = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, block_k),
+                          s, _NEG_INF)
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         p = jnp.exp(s - lse_safe)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -217,9 +245,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (acc_sc[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
-                    block_q, block_k, nq, off):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, block_q, block_k, nq, off, seg=False):
+    if seg:
+        qs_ref, ks_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+    else:
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -247,6 +278,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos + off, s, _NEG_INF)
+        if seg:
+            s = jnp.where(qs_ref[0] == ks_ref[0].reshape(1, block_k),
+                          s, _NEG_INF)
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         p = jnp.exp(s - lse_safe)                       # [bq, bk]
         dv_sc[...] += jax.lax.dot_general(
@@ -265,13 +299,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret,
+               qs3=None, ks3=None):
     q3, k3, v3, out, lse = res
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     off = sk - sq
     nq = sq // block_q
     nk = sk // block_k
+    seg = qs3 is not None
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     lse3 = lse[..., None]                               # [bh, sq, 1]
     delta3 = delta[..., None]
@@ -291,37 +327,55 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
         def q_idx_kv(b, ki, qi):
             return (b, qi, 0)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+    ]
+    dq_args = [q3, k3, v3, g, lse3, delta3]
+    if seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, 1), kv_idx),
+        ]
+        dq_args += [qs3, ks3]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk, off=off),
+                          block_q=block_q, block_k=block_k, nk=nk, off=off,
+                          seg=seg),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_idx),
-            pl.BlockSpec((1, block_k, d), kv_idx),
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_dimension_semantics(3, interpret),
         interpret=interpret,
-    )(q3, k3, v3, g, lse3, delta3)
+    )(*dq_args)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), q_idx_kv),
+        pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        pl.BlockSpec((1, block_q, d), q_idx_kv),
+        pl.BlockSpec((1, block_q, 1), q_idx_kv),
+        pl.BlockSpec((1, block_q, 1), q_idx_kv),
+    ]
+    dkv_args = [q3, k3, v3, g, lse3, delta3]
+    if seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q, 1), q_idx_kv),
+            pl.BlockSpec((1, block_k, 1), lambda b, ki, qi: (b, ki, 0)),
+        ]
+        dkv_args += [qs3, ks3]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq, off=off),
+                          block_q=block_q, block_k=block_k, nq=nq, off=off,
+                          seg=seg),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_idx_kv),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_q, d), q_idx_kv),
-            pl.BlockSpec((1, block_q, 1), q_idx_kv),
-            pl.BlockSpec((1, block_q, 1), q_idx_kv),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -336,7 +390,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_dimension_semantics(3, interpret),
         interpret=interpret,
-    )(q3, k3, v3, g, lse3, delta3)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -371,6 +425,35 @@ def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core_seg(q3, k3, v3, qs3, ks3, scale, causal, block_q, block_k,
+                    interpret):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                        interpret, qs3=qs3, ks3=ks3)
+    return out
+
+
+def _flash_core_seg_fwd(q3, k3, v3, qs3, ks3, scale, causal, block_q,
+                        block_k, interpret):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                          interpret, qs3=qs3, ks3=ks3)
+    return out, (q3, k3, v3, out, lse, qs3, ks3)
+
+
+def _flash_core_seg_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q3, k3, v3, out, lse, qs3, ks3 = res
+    dq, dk, dv = _flash_bwd((q3, k3, v3, out, lse), g, scale, causal,
+                            block_q, block_k, interpret, qs3=qs3, ks3=ks3)
+    # int segment ids take float0 cotangents (non-differentiable)
+    import numpy as _np
+    zq = _np.zeros(qs3.shape, dtype=jax.dtypes.float0)
+    zk = _np.zeros(ks3.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zq, zk
+
+
+_flash_core_seg.defvjp(_flash_core_seg_fwd, _flash_core_seg_bwd)
+
+
 def flash_attention(query, key, value, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 256,
                     block_k: int = 512, interpret: Optional[bool] = None):
@@ -397,6 +480,45 @@ def flash_attention(query, key, value, causal: bool = False,
 
     out3 = _flash_core(to3(query), to3(key), to3(value), scale, causal,
                        bq, bk, interpret)
+    return jnp.moveaxis(out3.reshape(b, h, sq, d), 1, 2)
+
+
+def flash_attention_varlen(query, key, value, q_segments, k_segments,
+                           causal: bool = False,
+                           scale: Optional[float] = None,
+                           block_q: int = 256, block_k: int = 512,
+                           interpret: Optional[bool] = None):
+    """Segment-masked (varlen/packed) flash attention; differentiable.
+
+    query [B, Sq, H, D], key/value [B, Sk, H, D]; q_segments [B, Sq] /
+    k_segments [B, Sk] int32 — only same-segment (query, key) pairs
+    attend (reference varlen semantics: flash_attn_unpadded's cu_seqlens
+    become segment ids).  Use a distinct id (e.g. -1) for padding.  With
+    ``causal`` the bottom-right-aligned causal mask composes on top.
+    """
+    b, sq, h, d = query.shape
+    kh = key.shape[2]
+    if kh != h:
+        rep = h // kh
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    sk = key.shape[1]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+
+    def to3(x):
+        return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    def seg3(s, n):
+        s = jnp.asarray(s, jnp.int32)
+        return jnp.repeat(s[:, None, :], h, axis=1).reshape(b * h, n, 1)
+
+    out3 = _flash_core_seg(to3(query), to3(key), to3(value),
+                           seg3(q_segments, sq), seg3(k_segments, sk),
+                           scale, causal, bq, bk, interpret)
     return jnp.moveaxis(out3.reshape(b, h, sq, d), 1, 2)
 
 

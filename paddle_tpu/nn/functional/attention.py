@@ -106,20 +106,61 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale: float,
                         dropout: float = 0.0, causal: bool = False,
                         return_softmax: bool = False, name=None):
-    """Varlen API parity: total-token packed layout [T, H, D] with cu_seqlens.
-
-    Implemented by segment-masking the dense path (static shapes for XLA);
-    fine for tests; perf path should batch fixed shapes.
-    """
+    """Varlen API parity: total-token packed layout [T, H, D] with
+    cu_seqlens.  Routes to the segment-masked Pallas flash kernel
+    (kernels/flash_attention.py — flash_attention_varlen) when the flag
+    allows, padding T to a lane multiple with an unmatched segment id;
+    dense segment-masked path otherwise (the test oracle)."""
     t, h, d = query.shape
+    tk = key.shape[0]
     seg_q = jnp.cumsum(jnp.zeros(t, jnp.int32).at[cu_seqlens_q[1:-1]].add(1))
-    seg_k = jnp.cumsum(jnp.zeros(key.shape[0], jnp.int32).at[cu_seqlens_k[1:-1]].add(1))
+    seg_k = jnp.cumsum(jnp.zeros(tk, jnp.int32).at[cu_seqlens_k[1:-1]].add(1))
+    # kernel route: global-causal ∧ same-segment == per-segment causal only
+    # when q/k packs are aligned (self-attention) — gate causal cross packs
+    # onto the dense path.  Alignment check: value equality when both
+    # cu_seqlens are concrete, object identity under trace.
+    def _aligned():
+        if not causal:
+            return True
+        if t != tk:
+            return False
+        if cu_seqlens_q is cu_seqlens_k:
+            return True
+        try:
+            import numpy as _np
+            return bool(_np.array_equal(_np.asarray(cu_seqlens_q),
+                                        _np.asarray(cu_seqlens_k)))
+        except Exception:        # traced values: can't prove alignment
+            return False
+
+    kernel_ok = (
+        flags.use_pallas_attention
+        and dropout == 0.0
+        and d in (64, 128, 256)
+        and jax.default_backend() not in ("cpu",)   # dense XLA wins on CPU
+        and _aligned())
+    if kernel_ok:
+        try:
+            from ...kernels.flash_attention import flash_attention_varlen
+            pad_q = (-t) % 128
+            pad_k = (-tk) % 128
+            qp = jnp.pad(query, [(0, pad_q), (0, 0), (0, 0)])
+            kp = jnp.pad(key, [(0, pad_k), (0, 0), (0, 0)])
+            vp = jnp.pad(value, [(0, pad_k), (0, 0), (0, 0)])
+            # padding rows: ids that match nothing real (nor each other)
+            sq = jnp.pad(seg_q, (0, pad_q), constant_values=-1)[None]
+            sk_ = jnp.pad(seg_k, (0, pad_k), constant_values=-2)[None]
+            out = flash_attention_varlen(qp[None], kp[None], vp[None], sq,
+                                         sk_, causal=causal, scale=scale)[0]
+            return out[:t], None
+        except Exception:
+            pass  # unsupported shape/platform: dense fallback below
     logits = jnp.einsum("qhd,khd->hqk", query, key,
                         preferred_element_type=jnp.float32) * scale
     mask = seg_q[:, None] == seg_k[None, :]
     if causal:
         pos_q = jnp.arange(t) - jnp.take(cu_seqlens_q, seg_q)
-        pos_k = jnp.arange(key.shape[0]) - jnp.take(cu_seqlens_k, seg_k)
+        pos_k = jnp.arange(tk) - jnp.take(cu_seqlens_k, seg_k)
         mask = mask & (pos_k[None, :] <= pos_q[:, None])
     logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(value.dtype)

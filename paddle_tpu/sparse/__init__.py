@@ -29,10 +29,18 @@ def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
                       dtype=None, place=None, stop_gradient: bool = True):
     """Reference: paddle.sparse.sparse_coo_tensor(indices [ndim, nnz],
     values [nnz], shape)."""
+    import numpy as _np
+    import jax.core as _core
+    if shape is None:
+        # shape inference needs CONCRETE indices (and at least one entry);
+        # under jit or with nnz=0 the caller must pass shape explicitly
+        if isinstance(indices, _core.Tracer) or _np.asarray(indices).size == 0:
+            raise ValueError("sparse_coo_tensor: pass `shape` explicitly "
+                             "under jit or for empty tensors")
+        shape = tuple(int(m) + 1 for m in _np.max(_np.asarray(indices),
+                                                  axis=1))
     indices = jnp.asarray(indices)
     values = jnp.asarray(values, dtype=dtype)
-    if shape is None:
-        shape = tuple(int(m) + 1 for m in jnp.max(indices, axis=1))
     return jsparse.BCOO((values, indices.T), shape=tuple(shape))
 
 
@@ -119,11 +127,14 @@ def matmul(x, y, name=None):
 
 def masked_matmul(x, y, mask, name=None):
     """dense @ dense evaluated only at ``mask``'s nonzero pattern
-    (reference: paddle.sparse.masked_matmul; SDDMM)."""
-    dense = jnp.asarray(x) @ jnp.asarray(y)
+    (reference: paddle.sparse.masked_matmul; SDDMM) — O(nnz * K) gather
+    form, never materialising the dense product."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
     idx = mask.indices
-    vals = dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
-    return jsparse.BCOO((vals, idx), shape=dense.shape)
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.sum(x[rows, :] * y[:, cols].T, axis=-1)     # [nnz]
+    return jsparse.BCOO((vals, idx), shape=(x.shape[0], y.shape[1]))
 
 
 def _unary(op):
@@ -141,8 +152,12 @@ tanh = _unary(jnp.tanh)
 
 def transpose(x, perm, name=None):
     if is_sparse(x):
-        return _copy_fmt(x, jsparse.BCOO.fromdense(
-            jnp.transpose(to_dense(x), perm)))
+        # O(nnz): permute the coordinate columns, no densify
+        perm = tuple(perm)
+        new_idx = x.indices[:, jnp.asarray(perm, jnp.int32)]
+        new_shape = tuple(x.shape[p] for p in perm)
+        return _copy_fmt(x, jsparse.BCOO((x.data, new_idx),
+                                         shape=new_shape))
     return jnp.transpose(x, perm)
 
 

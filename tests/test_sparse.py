@@ -59,3 +59,18 @@ def test_csr_tag_survives_facade_ops():
     assert sparse.is_sparse_csr(sparse.relu(s))
     assert sparse.is_sparse_csr(sparse.add(s, s))
     assert sparse.is_sparse_csr(sparse.transpose(s, [1, 0]))
+
+
+def test_sparse_review_fixes():
+    # shape required under jit / for empty
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="shape"):
+        sparse.sparse_coo_tensor(np.zeros((2, 0), np.int64),
+                                 np.zeros((0,), np.float32))
+    # O(nnz) transpose keeps values/structure
+    s = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                 np.array([3.0, 4.0], np.float32), [2, 3])
+    st = sparse.transpose(s, [1, 0])
+    assert st.shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(st)),
+                               np.asarray(sparse.to_dense(s)).T)
