@@ -264,9 +264,9 @@ def _secondary_benches():
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(p, os_):
             def lf(p):
-                out, _ = functional_call(model, p, buffers, batch_args,
-                                         train=True)
-                return loss_fn(out)
+                out, nb = functional_call(model, p, buffers, batch_args,
+                                          train=True)
+                return loss_fn(out, nb)
             l, g = jax.value_and_grad(lf)(p)
             newp, nos = o.update(g, os_, p)
             return newp, nos, l
@@ -290,7 +290,7 @@ def _secondary_benches():
     lbl = jnp.asarray(rs.randint(0, 1000, (16,)))
     import paddle_tpu.nn.functional as F
     out["resnet50"] = train_tput(
-        resnet50(), (img,), lambda o: F.cross_entropy(o, lbl), 16)
+        resnet50(), (img,), lambda o, nb: F.cross_entropy(o, lbl), 16)
 
     # 2 nn.Transformer encoder-decoder (tokens/sec)
     import paddle_tpu.nn as nn
@@ -299,7 +299,7 @@ def _secondary_benches():
     src = jnp.asarray(rs.randn(8, 128, 256), jnp.float32)
     tgt = jnp.asarray(rs.randn(8, 128, 256), jnp.float32)
     out["transformer"] = train_tput(
-        tr, (src, tgt), lambda o: jnp.mean(o ** 2), 8 * 128)
+        tr, (src, tgt), lambda o, nb: jnp.mean(o ** 2), 8 * 128)
 
     # 4 Llama (tokens/sec, bf16 remat)
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -311,7 +311,7 @@ def _secondary_benches():
     ids = jnp.asarray(rs.randint(0, 32000, (4, 1025)))
     x, y = ids[:, :-1], ids[:, 1:]
 
-    def llama_loss(logits):
+    def llama_loss(logits, nb):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
 
@@ -326,9 +326,11 @@ def _secondary_benches():
     mids = jnp.asarray(rs.randint(0, 32000, (8, 513)))
     mx, my = mids[:, :-1], mids[:, 1:]
 
-    def moe_loss(logits):
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        return -jnp.mean(jnp.take_along_axis(logp, my[..., None], -1))
+    def moe_loss(logits, nb):
+        # include the gate aux term so the measured graph matches real
+        # MoE training (code-review r2)
+        return GPTMoEForCausalLM.loss_from_logits(logits, my, nb,
+                                                  mcfg.aux_weight)
 
     out["gpt_moe"] = train_tput(mm, (mx,), moe_loss, 8 * 512)
     return out

@@ -87,7 +87,7 @@ def stop_gradient(x):
 # are imported on attribute access to keep `import paddle_tpu` fast.
 _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
-         "inference", "device", "ops"}
+         "inference", "device", "ops", "fft"}
 
 
 def __getattr__(name):

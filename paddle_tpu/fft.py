@@ -1,0 +1,94 @@
+"""paddle.fft parity — discrete Fourier transforms.
+
+Reference: python/paddle/fft.py backed by phi fft kernels (cuFFT/pocketfft
+under the PHI kernel-library row, SURVEY.md §2.1).
+
+TPU-native: jnp.fft (XLA's FFT HLO).  The reference's ``norm`` argument
+("backward"/"ortho"/"forward") maps directly onto numpy conventions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return None if norm == "backward" else norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes=axes)

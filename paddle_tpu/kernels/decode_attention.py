@@ -48,7 +48,7 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    seq_len = len_ref[0, 0]                              # [1,1] SMEM-ish tile
+    seq_len = len_ref[0, 0, 0]                           # [1,1,1] tile
     should = ki * block_k < seq_len
 
     @pl.when(should)
@@ -120,8 +120,10 @@ def decode_attention(q, k_cache, v_cache, seq_lens,
     def to3(x):
         return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
 
-    # per-(b,h) program: lens broadcast over heads -> [B*H, 1]
-    lens3 = jnp.repeat(seq_lens.astype(jnp.int32), h)[:, None]
+    # per-(b,h) program: lens broadcast over heads -> [B*H, 1, 1]
+    # (the trailing dims are both 1 so the (1, 1, 1) block satisfies the
+    # mosaic last-two-dims rule by equality — a [B*H, 1] layout would not)
+    lens3 = jnp.repeat(seq_lens.astype(jnp.int32), h)[:, None, None]
 
     compiler_params = None if interpret else pltpu.CompilerParams(
         dimension_semantics=("parallel", "arbitrary"))
@@ -130,7 +132,7 @@ def decode_attention(q, k_cache, v_cache, seq_lens,
                           causal_tail=causal_tail),
         grid=(b * h, nk),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, 1, 1), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),

@@ -24,6 +24,28 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdpa_reference"]
 
 
+_WARNED = set()
+
+
+def _warn_once(key: str, msg: str):
+    if key not in _WARNED:
+        _WARNED.add(key)
+        import logging
+        logging.getLogger("paddle_tpu").warning(msg)
+
+
+def _warn_traced_fallback():
+    _warn_once("varlen_traced",
+               "flash_attn_unpadded: causal varlen with traced, distinct "
+               "cu_seqlens cannot prove q/k alignment — using the dense "
+               "path; pass assume_aligned=True if the packs match")
+
+
+def _warn_kernel_fallback(e: Exception):
+    _warn_once("varlen_kernel", f"flash_attn_unpadded: Pallas varlen route "
+               f"failed ({type(e).__name__}: {e}); using the dense path")
+
+
 def _causal_mask(sq, sk, dtype):
     i = jnp.arange(sq)[:, None]
     j = jnp.arange(sk)[None, :]
@@ -105,7 +127,8 @@ def flash_attention(query, key, value, dropout: float = 0.0,
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale: float,
                         dropout: float = 0.0, causal: bool = False,
-                        return_softmax: bool = False, name=None):
+                        return_softmax: bool = False, name=None,
+                        assume_aligned: Optional[bool] = None):
     """Varlen API parity: total-token packed layout [T, H, D] with
     cu_seqlens.  Routes to the segment-masked Pallas flash kernel
     (kernels/flash_attention.py — flash_attention_varlen) when the flag
@@ -122,6 +145,10 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     def _aligned():
         if not causal:
             return True
+        if assume_aligned is not None:
+            # explicit caller contract (extension kwarg): under jit the
+            # values are traced and alignment is unprovable here
+            return bool(assume_aligned) and t == tk
         if t != tk:
             return False
         if cu_seqlens_q is cu_seqlens_k:
@@ -130,7 +157,11 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             import numpy as _np
             return bool(_np.array_equal(_np.asarray(cu_seqlens_q),
                                         _np.asarray(cu_seqlens_k)))
-        except Exception:        # traced values: can't prove alignment
+        except Exception:
+            # traced, distinct arrays: fall back to the dense path, but
+            # say so once — callers who KNOW q/k packs match should pass
+            # assume_aligned=True to keep the kernel route under jit
+            _warn_traced_fallback()
             return False
 
     kernel_ok = (
@@ -153,8 +184,11 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             out = flash_attention_varlen(qp[None], kp[None], vp[None], sq,
                                          sk_, causal=causal, scale=scale)[0]
             return out[:t], None
-        except Exception:
-            pass  # unsupported shape/platform: dense fallback below
+        except Exception as e:
+            # fall back to the dense path for robustness, but never
+            # silently: a broken kernel masquerading as a perf regression
+            # is undiagnosable
+            _warn_kernel_fallback(e)
     logits = jnp.einsum("qhd,khd->hqk", query, key,
                         preferred_element_type=jnp.float32) * scale
     mask = seg_q[:, None] == seg_k[None, :]

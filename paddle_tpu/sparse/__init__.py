@@ -128,13 +128,24 @@ def matmul(x, y, name=None):
 def masked_matmul(x, y, mask, name=None):
     """dense @ dense evaluated only at ``mask``'s nonzero pattern
     (reference: paddle.sparse.masked_matmul; SDDMM) — O(nnz * K) gather
-    form, never materialising the dense product."""
+    form, never materialising the dense product.  Supports the reference's
+    2-D ([M,K]@[K,N], 2-col indices) and batched 3-D ([B,M,K]@[B,K,N],
+    3-col indices) forms."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     idx = mask.indices
-    rows, cols = idx[:, 0], idx[:, 1]
-    vals = jnp.sum(x[rows, :] * y[:, cols].T, axis=-1)     # [nnz]
-    return jsparse.BCOO((vals, idx), shape=(x.shape[0], y.shape[1]))
+    if idx.shape[1] == 2:
+        rows, cols = idx[:, 0], idx[:, 1]
+        vals = jnp.sum(x[rows, :] * y[:, cols].T, axis=-1)      # [nnz]
+        shape = (x.shape[0], y.shape[1])
+    elif idx.shape[1] == 3:
+        b_, rows, cols = idx[:, 0], idx[:, 1], idx[:, 2]
+        vals = jnp.sum(x[b_, rows, :] * y[b_, :, cols], axis=-1)
+        shape = (x.shape[0], x.shape[1], y.shape[2])
+    else:
+        raise ValueError(f"masked_matmul: {idx.shape[1]}-d mask indices "
+                         f"unsupported (2-D or batched 3-D)")
+    return jsparse.BCOO((vals, idx), shape=shape)
 
 
 def _unary(op):

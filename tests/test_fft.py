@@ -1,0 +1,37 @@
+"""paddle.fft facade (reference: python/paddle/fft.py over phi fft
+kernels; here: XLA FFT HLO via jnp.fft)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import fft
+
+
+def test_fft_roundtrip_and_norms():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 16).astype(np.float32)
+    X = fft.fft(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(X), np.fft.fft(x), rtol=1e-4,
+                               atol=1e-4)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back.real), x, rtol=1e-4,
+                               atol=1e-4)
+    Xo = fft.fft(jnp.asarray(x), norm="ortho")
+    np.testing.assert_allclose(np.asarray(Xo), np.fft.fft(x, norm="ortho"),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_2d_shift():
+    rs = np.random.RandomState(1)
+    x = rs.randn(8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fft.rfft2(jnp.asarray(x))),
+                               np.fft.rfft2(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(fft.fftshift(jnp.asarray(x))),
+                               np.fft.fftshift(x))
+    np.testing.assert_allclose(np.asarray(fft.fftfreq(10, d=0.5)),
+                               np.fft.fftfreq(10, d=0.5), rtol=1e-6)
+
+
+def test_fft_lazy_attr():
+    assert paddle_tpu.fft is fft

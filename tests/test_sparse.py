@@ -74,3 +74,18 @@ def test_sparse_review_fixes():
     assert st.shape == (3, 2)
     np.testing.assert_allclose(np.asarray(sparse.to_dense(st)),
                                np.asarray(sparse.to_dense(s)).T)
+
+
+def test_masked_matmul_batched_3d():
+    rs = np.random.RandomState(3)
+    a = jnp.asarray(rs.randn(2, 3, 4).astype(np.float32))
+    b = jnp.asarray(rs.randn(2, 4, 3).astype(np.float32))
+    idx = np.array([[0, 1, 2], [1, 0, 0], [1, 2, 1]])
+    mask = sparse.sparse_coo_tensor(idx.T, np.ones(3, np.float32),
+                                    [2, 3, 3])
+    out = np.asarray(sparse.to_dense(sparse.masked_matmul(a, b, mask)))
+    dense = np.einsum("bmk,bkn->bmn", np.asarray(a), np.asarray(b))
+    for bb, r, c in idx:
+        np.testing.assert_allclose(out[bb, r, c], dense[bb, r, c],
+                                   rtol=1e-5)
+    assert out[0, 0, 0] == 0.0
