@@ -1,0 +1,72 @@
+"""Train -> export -> serve, the inference workflow end to end.
+
+Reference analog: train a dygraph model, paddle.jit.save with InputSpec,
+deploy with paddle.inference (AnalysisPredictor).
+
+    JAX_PLATFORMS=cpu python examples/infer_export.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.nn.functional_call import functional_call, state
+    from paddle_tpu.jit import save
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.inference import Config, create_predictor
+
+    # 1. train a small classifier
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 4))
+    params, buffers = state(net)
+    o = opt.AdamW(learning_rate=0.01)
+    ostate = o.init(params)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(256, 16), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 4, (256,)))
+
+    @jax.jit
+    def step(p, os_):
+        def lf(p):
+            out, _ = functional_call(net, p, buffers, (x,))
+            return nn.functional.cross_entropy(out, y)
+        l, g = jax.value_and_grad(lf)(p)
+        newp, nos = o.update(g, os_, p)
+        return newp, nos, l
+
+    for i in range(100):
+        params, ostate, loss = step(params, ostate)
+    print(f"final train loss: {float(loss):.4f}")
+
+    # 2. write trained weights back + export AOT artifact
+    from paddle_tpu.nn.functional_call import _index_stores, _write
+    pindex, _ = _index_stores(net)
+    _write(pindex, params)
+    net.eval()
+    prefix = os.path.join(tempfile.mkdtemp(), "clf")
+    save(net, prefix, input_spec=[InputSpec([None, 16], "float32",
+                                            name="features")])
+    print("exported:", prefix + ".pdmodel")
+
+    # 3. serve through the predictor facade (no Python model class needed)
+    pred = create_predictor(Config(prefix))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(np.asarray(x[:8]))
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    print("served logits shape:", out.shape)
+    assert out.shape == (8, 4)
+
+
+if __name__ == "__main__":
+    main()

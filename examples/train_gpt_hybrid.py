@@ -1,0 +1,94 @@
+"""Hybrid-parallel GPT training — the fleet workflow end to end.
+
+Reference analog: the test/collective/fleet hybrid runner scripts
+(hybrid_parallel_sharding_model.py pattern): fleet.init with
+hybrid_configs, one train loop, checkpoint-resume.
+
+Run (single host, CPU simulation of an 8-chip slice):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_gpt_hybrid.py --dp 2 --mp 2 --pp 2
+
+On a real slice, launch one process per host with the launcher:
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node 1 \
+        --master <host0>:<port> --heartbeat_timeout 60 \
+        examples/train_gpt_hybrid.py --dp 2 --mp 2 --pp 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--mp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--sharding", type=int, default=2)
+    ap.add_argument("--zero", type=int, default=1, choices=[1, 2, 3])
+    ap.add_argument("--vpp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--ckpt", type=str, default="")
+    args = ap.parse_args()
+
+    import paddle_tpu
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet_utils import (get_logger,
+                                                    save_auto_resume,
+                                                    load_auto_resume)
+    from paddle_tpu.models import gpt_tiny, GPTHybridTrainer
+
+    log = get_logger("train_gpt")
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": args.dp, "mp_degree": args.mp,
+                        "pp_degree": args.pp,
+                        "sharding_degree": args.sharding}
+    dist.fleet.init(is_collective=True, strategy=s)
+    hcg = dist.get_hybrid_communicate_group()
+    log.info("mesh axes: %s", dict(hcg.get_mesh().shape))
+
+    paddle_tpu.seed(0)
+    cfg = gpt_tiny(sp=args.mp > 1, remat=True)
+    trainer = GPTHybridTrainer(
+        cfg, hcg,
+        opt.AdamW(learning_rate=1e-3,
+                  grad_clip=opt.ClipGradByGlobalNorm(1.0)),
+        microbatches=max(2 * args.pp, 2), zero_stage=args.zero,
+        vpp=args.vpp)
+    state = trainer.init_state()
+
+    start = 0
+    if args.ckpt:
+        flat = {f"{i}": v for i, v in
+                enumerate(__import__("jax").tree_util.tree_leaves(state))}
+        flat, step = load_auto_resume(flat, args.ckpt)
+        if step is not None:
+            import jax
+            treedef = jax.tree_util.tree_structure(state)
+            state = jax.tree_util.tree_unflatten(
+                treedef, [flat[f"{i}"] for i in range(len(flat))])
+            start = step
+            log.info("resumed from step %d", start)
+
+    x, y = trainer.make_batch(batch=args.batch, seq=args.seq)
+    for it in range(start, args.steps):
+        state, loss = trainer.train_step(state, x, y)
+        if it % 5 == 0 or it == args.steps - 1:
+            log.info("step %d loss %.4f", it, float(loss))
+        if args.ckpt and (it + 1) % 10 == 0:
+            import jax
+            flat = {f"{i}": v for i, v in
+                    enumerate(jax.tree_util.tree_leaves(state))}
+            save_auto_resume(flat, args.ckpt, step=it + 1)
+    log.info("done")
+
+
+if __name__ == "__main__":
+    main()
