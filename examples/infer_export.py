@@ -48,10 +48,8 @@ def main():
         params, ostate, loss = step(params, ostate)
     print(f"final train loss: {float(loss):.4f}")
 
-    # 2. write trained weights back + export AOT artifact
-    from paddle_tpu.nn.functional_call import _index_stores, _write
-    pindex, _ = _index_stores(net)
-    _write(pindex, params)
+    # 2. write trained weights back (public API) + export AOT artifact
+    net.set_state_dict(params)
     net.eval()
     prefix = os.path.join(tempfile.mkdtemp(), "clf")
     save(net, prefix, input_spec=[InputSpec([None, 16], "float32",

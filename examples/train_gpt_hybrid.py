@@ -23,12 +23,19 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _flat(tree):
+    """Pytree -> {index: leaf} dict for the shard-aware checkpointer."""
+    import jax
+    return {f"{i}": v for i, v in
+            enumerate(jax.tree_util.tree_leaves(tree))}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--mp", type=int, default=2)
     ap.add_argument("--pp", type=int, default=2)
-    ap.add_argument("--sharding", type=int, default=2)
+    ap.add_argument("--sharding", type=int, default=1)
     ap.add_argument("--zero", type=int, default=1, choices=[1, 2, 3])
     ap.add_argument("--vpp", type=int, default=1)
     ap.add_argument("--steps", type=int, default=20)
@@ -64,13 +71,11 @@ def main():
         vpp=args.vpp)
     state = trainer.init_state()
 
+    import jax
     start = 0
     if args.ckpt:
-        flat = {f"{i}": v for i, v in
-                enumerate(__import__("jax").tree_util.tree_leaves(state))}
-        flat, step = load_auto_resume(flat, args.ckpt)
+        flat, step = load_auto_resume(_flat(state), args.ckpt)
         if step is not None:
-            import jax
             treedef = jax.tree_util.tree_structure(state)
             state = jax.tree_util.tree_unflatten(
                 treedef, [flat[f"{i}"] for i in range(len(flat))])
@@ -83,10 +88,7 @@ def main():
         if it % 5 == 0 or it == args.steps - 1:
             log.info("step %d loss %.4f", it, float(loss))
         if args.ckpt and (it + 1) % 10 == 0:
-            import jax
-            flat = {f"{i}": v for i, v in
-                    enumerate(jax.tree_util.tree_leaves(state))}
-            save_auto_resume(flat, args.ckpt, step=it + 1)
+            save_auto_resume(_flat(state), args.ckpt, step=it + 1)
     log.info("done")
 
 

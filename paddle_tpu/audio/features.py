@@ -1,0 +1,105 @@
+"""audio.features layers (reference: python/paddle/audio/features/layers.py
+— Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length: int, hop_length: int, center: bool,
+           pad_mode: str = "reflect"):
+    """x [..., T] -> frames [..., n_frames, frame_length]."""
+    if center:
+        pad = frame_length // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype=None):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        win = AF.get_window(window, self.win_length)
+        if dtype is not None:
+            win = win.astype(dtype)
+        self.register_buffer("window", win)
+
+    def forward(self, x):
+        """x [..., T] -> [..., n_fft//2+1, n_frames] (reference layout)."""
+        frames = _frame(x, self.win_length, self.hop_length, self.center,
+                        self.pad_mode)
+        frames = frames * self.window
+        if self.win_length < self.n_fft:
+            padlen = self.n_fft - self.win_length
+            frames = jnp.pad(frames,
+                             [(0, 0)] * (frames.ndim - 1) + [(0, padlen)])
+        spec = jnp.fft.rfft(frames, n=self.n_fft, axis=-1)
+        mag = jnp.abs(spec) ** self.power
+        return jnp.swapaxes(mag, -1, -2)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype=None):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center)
+        self.register_buffer(
+            "fbank", AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                             f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)             # [..., bins, frames]
+        return jnp.einsum("mb,...bt->...mt", self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **mel_kw):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **mel_kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, **mel_kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, **mel_kw)
+        n_mels = self.log_mel.mel.fbank.shape[0]
+        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.log_mel(x)                    # [..., mels, frames]
+        return jnp.einsum("mk,...mt->...kt", self.dct, lm)

@@ -1,0 +1,101 @@
+"""audio.functional: windows, mel filterbank, power/db conversion
+(reference: python/paddle/audio/functional/window.py, functional.py)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+           "power_to_db", "create_dct"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/ones — periodic (fftbins) like the ref."""
+    n = jnp.arange(win_length)
+    N = win_length if fftbins else win_length - 1
+    if window in ("hann", "hanning"):
+        return 0.5 - 0.5 * jnp.cos(2 * math.pi * n / N)
+    if window == "hamming":
+        return 0.54 - 0.46 * jnp.cos(2 * math.pi * n / N)
+    if window == "blackman":
+        return (0.42 - 0.5 * jnp.cos(2 * math.pi * n / N)
+                + 0.08 * jnp.cos(4 * math.pi * n / N))
+    if window in ("ones", "rectangular", "boxcar"):
+        return jnp.ones(win_length)
+    raise ValueError(f"unsupported window {window!r}")
+
+
+def hz_to_mel(f, htk: bool = False):
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + f / 700.0)
+    # slaney scale (reference default)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(m, htk: bool = False):
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(m >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                     freqs)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    n_bins = n_fft // 2 + 1
+    fft_freqs = jnp.linspace(0.0, sr / 2.0, n_bins)
+    mel_pts = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                           n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    lower = hz_pts[:-2][:, None]
+    center = hz_pts[1:-1][:, None]
+    upper = hz_pts[2:][:, None]
+    up = (fft_freqs[None, :] - lower) / jnp.maximum(center - lower, 1e-10)
+    down = (upper - fft_freqs[None, :]) / jnp.maximum(upper - center, 1e-10)
+    fb = jnp.maximum(0.0, jnp.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb = fb * enorm[:, None]
+    return fb
+
+
+def power_to_db(x, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    x = jnp.asarray(x)
+    db = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    db = db - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        db = jnp.maximum(db, jnp.max(db) - top_db)
+    return db
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return dct

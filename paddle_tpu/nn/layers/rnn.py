@@ -1,0 +1,303 @@
+"""Recurrent layers — SimpleRNN/LSTM/GRU cells and runners.
+
+Reference: python/paddle/nn/layer/rnn.py — SimpleRNNCell/LSTMCell/GRUCell,
+RNN/BiRNN runners, SimpleRNN/LSTM/GRU stacks (backed by cudnn kernels on
+GPU; SURVEY.md §2.2 nn layers row).
+
+TPU-native: one ``lax.scan`` over time per direction — the step body is a
+dense cell whose matmuls hit the MXU; XLA fuses gate elementwise ops into
+them.  Parameter names/layouts match the reference (weight_ih
+[gates*H, I], weight_hh [gates*H, H], bias_ih/bias_hh [gates*H]; LSTM gate
+order i,f,g,o; GRU gate order r,z,c) so state_dicts port.
+``sequence_length`` freezes states and zeroes outputs past each sequence's
+length, like the reference's variable-length handling.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layer import Layer
+from .. import initializer as I
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size: int, hidden_size: int, gates: int,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        g = gates * hidden_size
+        self.weight_ih = self.create_parameter((g, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((g, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter((g,), attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((g,), attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def get_initial_states(self, batch):
+        raise NotImplementedError
+
+
+class SimpleRNNCell(_RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh); activation tanh|relu."""
+
+    def __init__(self, input_size, hidden_size, activation: str = "tanh",
+                 **kw):
+        super().__init__(input_size, hidden_size, gates=1, **kw)
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"bad activation {activation!r}")
+        self.activation = activation
+
+    def forward(self, x, state=None):
+        h = self.get_initial_states(x.shape[0]) if state is None else state
+        z = x @ self.weight_ih.T + self.bias_ih + \
+            h @ self.weight_hh.T + self.bias_hh
+        h2 = jnp.tanh(z) if self.activation == "tanh" else jnp.maximum(z, 0)
+        return h2, h2
+
+    def get_initial_states(self, batch):
+        return jnp.zeros((batch, self.hidden_size),
+                         self.weight_ih.dtype)
+
+
+class LSTMCell(_RNNCellBase):
+    """Gate order i, f, g(cell), o (reference layout)."""
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, gates=4, **kw)
+
+    def forward(self, x, state=None):
+        h, c = self.get_initial_states(x.shape[0]) if state is None \
+            else state
+        z = x @ self.weight_ih.T + self.bias_ih + \
+            h @ self.weight_hh.T + self.bias_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, (h2, c2)
+
+    def get_initial_states(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), self.weight_ih.dtype)
+        return (z, z)
+
+
+class GRUCell(_RNNCellBase):
+    """Gate order r, z, c; candidate uses r * (W_hc h + b_hc) (reference
+    convention)."""
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, gates=3, **kw)
+
+    def forward(self, x, state=None):
+        h = self.get_initial_states(x.shape[0]) if state is None else state
+        gi = x @ self.weight_ih.T + self.bias_ih
+        gh = h @ self.weight_hh.T + self.bias_hh
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        c = jnp.tanh(ic + r * hc)
+        h2 = (1.0 - z) * c + z * h
+        return h2, h2
+
+    def get_initial_states(self, batch):
+        return jnp.zeros((batch, self.hidden_size),
+                         self.weight_ih.dtype)
+
+
+def _scan_cell(cell, inputs, init_state, seq_lens=None, reverse=False):
+    """inputs [B, T, I] -> (outputs [B, T, H], final_state).  States past
+    ``seq_lens`` freeze; their outputs zero (reference varlen handling).
+    """
+    T = inputs.shape[1]
+    xs = jnp.moveaxis(inputs, 1, 0)                     # [T, B, I]
+    steps = jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T)
+
+    def body(state, t):
+        x_t = xs[t]
+        out, new_state = cell(x_t, state)
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]
+            out = jnp.where(valid, out, jnp.zeros_like(out))
+            new_state = jax.tree.map(
+                lambda n, s: jnp.where(valid, n, s), new_state, state)
+        return new_state, out
+
+    final, outs = jax.lax.scan(body, init_state, steps)
+    outs = jnp.moveaxis(outs, 0, 1)                     # [B, T, H]
+    if reverse:
+        # scan emitted t = T-1..0 at positions 0..T-1; flip restores the
+        # original time axis.  With seq_lens, invalid steps were already
+        # zeroed/frozen in the body, so positions align correctly as-is.
+        outs = jnp.flip(outs, axis=1)
+    return outs, final
+
+
+class RNN(Layer):
+    """Runner: scans ``cell`` over the time dim (reference: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if self.time_major:
+            inputs = jnp.moveaxis(inputs, 0, 1)
+        init = self.cell.get_initial_states(inputs.shape[0]) \
+            if initial_states is None else initial_states
+        outs, final = _scan_cell(self.cell, inputs, init,
+                                 seq_lens=sequence_length,
+                                 reverse=self.is_reverse)
+        if self.time_major:
+            outs = jnp.moveaxis(outs, 0, 1)
+        return outs, final
+
+
+class BiRNN(Layer):
+    """Two runners, outputs concatenated (reference: nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if self.time_major:
+            inputs = jnp.moveaxis(inputs, 0, 1)
+        init_fw, init_bw = (initial_states if initial_states is not None
+                            else (self.cell_fw.get_initial_states(
+                                      inputs.shape[0]),
+                                  self.cell_bw.get_initial_states(
+                                      inputs.shape[0])))
+        out_f, fin_f = _scan_cell(self.cell_fw, inputs, init_fw,
+                                  seq_lens=sequence_length, reverse=False)
+        out_b, fin_b = _scan_cell(self.cell_bw, inputs, init_bw,
+                                  seq_lens=sequence_length, reverse=True)
+        outs = jnp.concatenate([out_f, out_b], axis=-1)
+        if self.time_major:
+            outs = jnp.moveaxis(outs, 0, 1)
+        return outs, (fin_f, fin_b)
+
+
+class _RNNStack(Layer):
+    """Multi-layer (optionally bidirectional) stack shared by
+    SimpleRNN/LSTM/GRU (reference behavior incl. inter-layer dropout)."""
+
+    CELL = None
+    _cell_kwargs: dict = {}
+
+    def __init__(self, input_size, hidden_size, num_layers: int = 1,
+                 direction: str = "forward", time_major: bool = False,
+                 dropout: float = 0.0, **cell_kw):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"bad direction {direction!r}")
+        self.bidirect = direction != "forward"
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        mult = 2 if self.bidirect else 1
+        layers = []
+        for li in range(num_layers):
+            in_sz = input_size if li == 0 else hidden_size * mult
+            if self.bidirect:
+                layers.append(BiRNN(self.CELL(in_sz, hidden_size, **cell_kw),
+                                    self.CELL(in_sz, hidden_size, **cell_kw)))
+            else:
+                layers.append(RNN(self.CELL(in_sz, hidden_size, **cell_kw)))
+        from .container import LayerList
+        self.layers = LayerList(layers)
+
+    @property
+    def _is_lstm(self):
+        return self.CELL is LSTMCell
+
+    def _split_initial(self, initial_states, li):
+        """Reference contract: stacked [L*D, B, H] tensors (a (h, c) pair
+        of them for LSTM) -> this layer's per-direction cell states."""
+        if initial_states is None:
+            return None
+        D = 2 if self.bidirect else 1
+
+        def pick(s, idx):
+            return s[idx]
+
+        if self._is_lstm:
+            h, c = initial_states
+            if self.bidirect:
+                return ((pick(h, D * li), pick(c, D * li)),
+                        (pick(h, D * li + 1), pick(c, D * li + 1)))
+            return (pick(h, li), pick(c, li))
+        h = initial_states
+        if self.bidirect:
+            return (pick(h, D * li), pick(h, D * li + 1))
+        return pick(h, li)
+
+    def _stack_finals(self, finals):
+        """Per-layer finals -> reference stacked [L*D, B, H] (pair for
+        LSTM)."""
+        hs, cs = [], []
+        for fin in finals:
+            per_dir = fin if self.bidirect else (fin,)
+            for f in per_dir:
+                if self._is_lstm:
+                    hs.append(f[0])
+                    cs.append(f[1])
+                else:
+                    hs.append(f)
+        h = jnp.stack(hs, axis=0)
+        if self._is_lstm:
+            return (h, jnp.stack(cs, axis=0))
+        return h
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = jnp.moveaxis(inputs, 0, 1) if self.time_major else inputs
+        finals = []
+        for li, layer in enumerate(self.layers):
+            x, fin = layer(x, self._split_initial(initial_states, li),
+                           sequence_length=sequence_length)
+            finals.append(fin)
+            if self.dropout and li < self.num_layers - 1 and self.training:
+                from ..functional.common import dropout as _dropout
+                x = _dropout(x, p=self.dropout, training=True)
+        if self.time_major:
+            x = jnp.moveaxis(x, 0, 1)
+        return x, self._stack_finals(finals)
+
+
+class SimpleRNN(_RNNStack):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNStack):
+    CELL = LSTMCell
+
+
+class GRU(_RNNStack):
+    CELL = GRUCell

@@ -1,0 +1,108 @@
+"""paddle.text surface (reference: python/paddle/text/ — dataset
+downloaders: Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14,
+WMT16, ViterbiDecoder).
+
+This environment is zero-egress (no downloads), so the dataset classes
+raise a clear error pointing at paddle_tpu.io.Dataset for local data; the
+ViterbiDecoder — the one compute component — is implemented natively
+(lax.scan dynamic program)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "Conll05st", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transitions, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Reference: paddle.text.viterbi_decode — best tag path under a CRF.
+
+    potentials [B, T, N] emission scores; transitions [N(+2), N(+2)] with
+    the last two rows/cols as BOS/EOS when include_bos_eos_tag.
+    Returns (scores [B], paths [B, T]).
+    """
+    potentials = jnp.asarray(potentials, jnp.float32)
+    B, T, N = potentials.shape
+    trans = jnp.asarray(transitions, jnp.float32)
+    if include_bos_eos_tag:
+        bos = trans[N, :N]
+        eos = trans[:N, N + 1]
+        trans = trans[:N, :N]
+    else:
+        bos = jnp.zeros((N,), jnp.float32)
+        eos = jnp.zeros((N,), jnp.float32)
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+
+    alpha0 = bos[None, :] + potentials[:, 0]             # [B, N]
+
+    def step(alpha, t):
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, t, j]
+        scores = alpha[:, :, None] + trans[None, :, :] + \
+            potentials[:, t][:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)           # [B, N]
+        new_alpha = jnp.max(scores, axis=1)
+        # sequences already finished keep their alpha (mask by length)
+        keep = (t < lengths)[:, None]
+        new_alpha = jnp.where(keep, new_alpha, alpha)
+        return new_alpha, best_prev
+
+    alpha, backptrs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # backptrs [T-1, B, N]
+    final = alpha + eos[None, :]
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)                # [B]
+
+    def backtrack(carry, t):
+        tag = carry
+        bp = backptrs[t]                                 # [B, N]
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # positions beyond a sequence's length keep the same tag
+        prev = jnp.where(t + 1 < lengths, prev, tag)
+        return prev, tag
+
+    first, tags_rev = jax.lax.scan(backtrack, last_tag,
+                                   jnp.arange(T - 2, -1, -1))
+    paths = jnp.concatenate([first[None], jnp.flip(tags_rev, 0)], axis=0)
+    return scores, jnp.moveaxis(paths, 0, 1)
+
+
+class ViterbiDecoder(Layer):
+    """Layer form (reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.register_buffer("transitions", jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _no_download(name):
+    class _DS:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"paddle_tpu.text.{name}: dataset download is unavailable "
+                f"in this zero-egress environment; load local files with "
+                f"paddle_tpu.io.Dataset instead")
+    _DS.__name__ = name
+    return _DS
+
+
+Conll05st = _no_download("Conll05st")
+Imdb = _no_download("Imdb")
+Imikolov = _no_download("Imikolov")
+Movielens = _no_download("Movielens")
+UCIHousing = _no_download("UCIHousing")
+WMT14 = _no_download("WMT14")
+WMT16 = _no_download("WMT16")

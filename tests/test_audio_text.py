@@ -1,0 +1,84 @@
+"""paddle.audio features + paddle.text ViterbiDecoder
+(reference: python/paddle/audio/features, python/paddle/text)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu.audio.features import (Spectrogram, MelSpectrogram,
+                                       LogMelSpectrogram, MFCC)
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode, Imdb
+
+
+def test_spectrogram_matches_numpy_stft():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 2048).astype(np.float32)
+    n_fft, hop = 256, 128
+    spec = Spectrogram(n_fft=n_fft, hop_length=hop, window="hann",
+                       power=2.0, center=False)
+    out = np.asarray(spec(jnp.asarray(x)))
+    # numpy oracle
+    win = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+    n_frames = 1 + (2048 - n_fft) // hop
+    ref = np.zeros((2, n_fft // 2 + 1, n_frames), np.float32)
+    for b in range(2):
+        for t in range(n_frames):
+            seg = x[b, t * hop:t * hop + n_fft] * win
+            ref[b, :, t] = np.abs(np.fft.rfft(seg)) ** 2
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_mel_pipeline_shapes_and_monotone_db():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(1, 4096).astype(np.float32))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+    m = mel(x)
+    assert m.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)
+    lm = logmel(x)
+    assert lm.shape == m.shape
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+    c = mfcc(x)
+    assert c.shape[1] == 13
+
+
+def test_fbank_rows_sum_positive_and_cover():
+    fb = np.asarray(AF.compute_fbank_matrix(16000, 512, n_mels=26))
+    assert fb.shape == (26, 257)
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_viterbi_matches_bruteforce():
+    rs = np.random.RandomState(2)
+    B, T, N = 2, 5, 3
+    pot = rs.randn(B, T, N).astype(np.float32)
+    trans_full = rs.randn(N + 2, N + 2).astype(np.float32)
+    scores, paths = viterbi_decode(jnp.asarray(pot),
+                                   jnp.asarray(trans_full))
+    # brute force over all tag paths
+    import itertools
+    bos, eos = trans_full[N, :N], trans_full[:N, N + 1]
+    tr = trans_full[:N, :N]
+    for b in range(B):
+        best, best_path = -1e30, None
+        for path in itertools.product(range(N), repeat=T):
+            s = bos[path[0]] + pot[b, 0, path[0]]
+            for t in range(1, T):
+                s += tr[path[t - 1], path[t]] + pot[b, t, path[t]]
+            s += eos[path[-1]]
+            if s > best:
+                best, best_path = s, path
+        np.testing.assert_allclose(float(scores[b]), best, rtol=1e-5)
+        assert tuple(np.asarray(paths[b])) == best_path
+
+
+def test_viterbi_layer_and_dataset_guidance():
+    dec = ViterbiDecoder(np.zeros((5, 5), np.float32),
+                         include_bos_eos_tag=False)
+    pot = jnp.asarray(np.random.RandomState(3).randn(1, 4, 5), jnp.float32)
+    scores, paths = dec(pot)
+    assert paths.shape == (1, 4)
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        Imdb()

@@ -1,0 +1,156 @@
+"""RNN family (reference: python/paddle/nn/layer/rnn.py — cells, RNN/BiRNN
+runners, SimpleRNN/LSTM/GRU stacks).  Oracles: numpy step loops with the
+reference gate orders."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.nn.functional_call import functional_call, state
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm_steps(x, h, c, wih, whh, bih, bhh):
+    """x [B,T,I]; returns outs [B,T,H], (h, c). Gate order i,f,g,o."""
+    B, T, _ = x.shape
+    H = h.shape[1]
+    outs = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        z = x[:, t] @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = np.split(z, 4, axis=-1)
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+        h = _sigmoid(o) * np.tanh(c)
+        outs[:, t] = h
+    return outs, (h, c)
+
+
+def test_lstm_cell_matches_numpy():
+    paddle_tpu.seed(0)
+    cell = nn.LSTMCell(6, 8)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 5, 6).astype(np.float32))
+    rnn = nn.RNN(cell)
+    outs, (h, c) = rnn(x)
+    ref_outs, (rh, rc) = _np_lstm_steps(
+        np.asarray(x), np.zeros((3, 8), np.float32),
+        np.zeros((3, 8), np.float32),
+        np.asarray(cell.weight_ih), np.asarray(cell.weight_hh),
+        np.asarray(cell.bias_ih), np.asarray(cell.bias_hh))
+    np.testing.assert_allclose(np.asarray(outs), ref_outs, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), rh, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c), rc, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    paddle_tpu.seed(1)
+    cell = nn.GRUCell(4, 5)
+    rs = np.random.RandomState(1)
+    x = np.asarray(rs.randn(2, 4).astype(np.float32))
+    h = np.zeros((2, 5), np.float32)
+    out, h2 = cell(jnp.asarray(x), jnp.asarray(h))
+    gi = x @ np.asarray(cell.weight_ih).T + np.asarray(cell.bias_ih)
+    gh = h @ np.asarray(cell.weight_hh).T + np.asarray(cell.bias_hh)
+    ir, iz, ic = np.split(gi, 3, -1)
+    hr, hz, hc = np.split(gh, 3, -1)
+    r = _sigmoid(ir + hr)
+    z = _sigmoid(iz + hz)
+    cand = np.tanh(ic + r * hc)
+    ref = (1 - z) * cand + z * h
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_simple_rnn_reverse_equals_flipped_forward():
+    paddle_tpu.seed(2)
+    cell = nn.SimpleRNNCell(3, 4)
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(2, 6, 3).astype(np.float32))
+    fwd = nn.RNN(cell)
+    rev = nn.RNN(cell, is_reverse=True)
+    out_rev, _ = rev(x)
+    out_fwd_on_flip, _ = fwd(jnp.flip(x, axis=1))
+    np.testing.assert_allclose(np.asarray(out_rev),
+                               np.asarray(jnp.flip(out_fwd_on_flip, 1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_birnn_concats_directions():
+    paddle_tpu.seed(3)
+    bi = nn.BiRNN(nn.GRUCell(3, 4), nn.GRUCell(3, 4))
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 5, 3).astype(np.float32))
+    outs, (fin_f, fin_b) = bi(x)
+    assert outs.shape == (2, 5, 8)
+    np.testing.assert_allclose(np.asarray(outs[:, -1, :4]),
+                               np.asarray(fin_f), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[:, 0, 4:]),
+                               np.asarray(fin_b), rtol=1e-5)
+
+
+def test_lstm_stack_sequence_length_masks():
+    paddle_tpu.seed(4)
+    lstm = nn.LSTM(3, 4, num_layers=2)
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 6, 3).astype(np.float32))
+    lens = jnp.asarray([4, 6], jnp.int32)
+    outs, (h, c) = lstm(x, sequence_length=lens)
+    assert outs.shape == (2, 6, 4)
+    # reference contract: stacked [num_layers, B, H] state tensors
+    assert h.shape == (2, 2, 4) and c.shape == (2, 2, 4)
+    # outputs past each length are zero
+    np.testing.assert_allclose(np.asarray(outs[0, 4:]), 0.0)
+    assert float(jnp.abs(outs[1, 5]).sum()) > 0
+    # final state equals the state at t=len-1: recompute on truncated input
+    _, (h_t, c_t) = lstm(x[:1, :4], sequence_length=None)
+    np.testing.assert_allclose(np.asarray(h[-1, :1]),
+                               np.asarray(h_t[-1]), rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_stack_initial_states_roundtrip():
+    """Reference contract: pass stacked (h0, c0) [L*D, B, H]; a second call
+    seeded with the first call's finals continues the sequence exactly."""
+    paddle_tpu.seed(6)
+    lstm = nn.LSTM(3, 4, num_layers=2)
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(2, 8, 3).astype(np.float32))
+    full_outs, _ = lstm(x)
+    o1, st1 = lstm(x[:, :5])
+    o2, _ = lstm(x[:, 5:], initial_states=st1)
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate([o1, o2], axis=1)),
+        np.asarray(full_outs), rtol=1e-5, atol=1e-5)
+
+
+def test_gru_bidirect_stack_shapes_and_training():
+    paddle_tpu.seed(5)
+    gru = nn.GRU(4, 8, num_layers=2, direction="bidirect")
+    params, buffers = state(gru)
+    o = opt.AdamW(learning_rate=5e-3)
+    ostate = o.init(params)
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(4, 10, 4).astype(np.float32))
+    # learn to output the mean of the inputs at every position
+    target = jnp.broadcast_to(jnp.mean(x, axis=(1, 2), keepdims=True),
+                              (4, 10, 16))
+
+    @jax.jit
+    def step(p, os_):
+        def lf(p):
+            (outs, _finals), _ = functional_call(gru, p, buffers, (x,),
+                                                 train=True)
+            return jnp.mean((outs - target) ** 2)
+        l, g = jax.value_and_grad(lf)(p)
+        newp, nos = o.update(g, os_, p)
+        return newp, nos, l
+
+    losses = []
+    for _ in range(30):
+        params, ostate, loss = step(params, ostate)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
