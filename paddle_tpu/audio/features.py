@@ -49,10 +49,7 @@ class Spectrogram(Layer):
         frames = _frame(x, self.win_length, self.hop_length, self.center,
                         self.pad_mode)
         frames = frames * self.window
-        if self.win_length < self.n_fft:
-            padlen = self.n_fft - self.win_length
-            frames = jnp.pad(frames,
-                             [(0, 0)] * (frames.ndim - 1) + [(0, padlen)])
+        # rfft's n= zero-pads win_length -> n_fft itself
         spec = jnp.fft.rfft(frames, n=self.n_fft, axis=-1)
         mag = jnp.abs(spec) ** self.power
         return jnp.swapaxes(mag, -1, -2)
@@ -63,15 +60,18 @@ class MelSpectrogram(Layer):
                  hop_length: Optional[int] = None,
                  win_length: Optional[int] = None, window: str = "hann",
                  power: float = 2.0, center: bool = True,
-                 n_mels: int = 64, f_min: float = 50.0,
-                 f_max: Optional[float] = None, htk: bool = False,
-                 norm: str = "slaney", dtype=None):
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney", dtype=None):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
-                                       window, power, center)
-        self.register_buffer(
-            "fbank", AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
-                                             f_max, htk, norm))
+                                       window, power, center, pad_mode,
+                                       dtype=dtype)
+        fb = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                     norm)
+        if dtype is not None:
+            fb = fb.astype(dtype)
+        self.register_buffer("fbank", fb)
 
     def forward(self, x):
         spec = self.spectrogram(x)             # [..., bins, frames]

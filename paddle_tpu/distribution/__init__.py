@@ -1,0 +1,313 @@
+"""paddle.distribution parity — probability distributions.
+
+Reference: python/paddle/distribution/ — Distribution base with
+sample/log_prob/entropy/kl_divergence, Normal/Uniform/Bernoulli/
+Categorical/Beta/Dirichlet/... (pure-Python math over framework ops).
+
+TPU-native: math over jnp (jits and differentiates); sampling draws from
+the framework RNG (paddle_tpu.seed / rng_context) via jax.random, so
+samples inside jitted code are reproducible the same way dropout is.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_rng_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "LogNormal", "Laplace", "Gumbel",
+           "kl_divergence", "register_kl"]
+
+
+def _key(given=None):
+    return given if given is not None else next_rng_key()
+
+
+class Distribution:
+    def sample(self, shape: Sequence[int] = (), key=None):
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = (), key=None):
+        return self.sample(shape, key)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> jax.Array:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.normal(_key(key), shape)
+
+    def log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2.0))))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.base.loc + self.base.scale ** 2 / 2)
+
+    def sample(self, shape=(), key=None):
+        return jnp.exp(self.base.sample(shape, key))
+
+    def log_prob(self, value):
+        return self.base.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return self.base.entropy() + self.base.loc
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_key(key), shape)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        inside = jnp.logical_and(value >= self.low, value < self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = jnp.asarray(probs, jnp.float32)
+        else:
+            self.probs = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.probs.shape
+        return jax.random.bernoulli(_key(key), self.probs,
+                                    shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = jnp.asarray(logits, jnp.float32)
+        else:
+            self.logits = jnp.log(jnp.asarray(probs, jnp.float32))
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.categorical(_key(key), self.logits,
+                                      shape=tuple(shape) +
+                                      self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        value = jnp.asarray(value, jnp.int32)
+        logp = jnp.broadcast_to(logp, value.shape + logp.shape[-1:])
+        return jnp.take_along_axis(logp, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                    self.beta.shape)
+        return jax.random.beta(_key(key), self.alpha, self.beta, shape)
+
+    def log_prob(self, value):
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return ((self.alpha - 1) * jnp.log(value)
+                + (self.beta - 1) * jnp.log1p(-value) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return c / jnp.sum(c, axis=-1, keepdims=True)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.dirichlet(_key(key), self.concentration,
+                                    tuple(shape) +
+                                    self.concentration.shape[:-1])
+
+    def log_prob(self, value):
+        c = self.concentration
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(c), axis=-1)
+                 - jax.scipy.special.gammaln(jnp.sum(c, axis=-1)))
+        return jnp.sum((c - 1) * jnp.log(value), axis=-1) - lnorm
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.laplace(_key(key), shape)
+
+    def log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return 1 + jnp.log(2 * self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.gumbel(_key(key), shape)
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.log(self.scale) + 1.0 + jnp.euler_gamma
+
+
+_KL_TABLE = {}
+
+
+def register_kl(type_p, type_q):
+    """Decorator parity: paddle.distribution.register_kl."""
+    def deco(fn):
+        _KL_TABLE[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_TABLE.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__}) not "
+            f"registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, axis=-1)
+    logq = jax.nn.log_softmax(q.logits, axis=-1)
+    return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return pp * jnp.log(pp / qq) + (1 - pp) * jnp.log((1 - pp) / (1 - qq))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    # KL is +inf when p's support is not contained in q's
+    contained = jnp.logical_and(p.low >= q.low, p.high <= q.high)
+    return jnp.where(contained,
+                     jnp.log((q.high - q.low) / (p.high - p.low)),
+                     jnp.inf)

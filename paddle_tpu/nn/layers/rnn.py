@@ -132,10 +132,9 @@ def _scan_cell(cell, inputs, init_state, seq_lens=None, reverse=False):
     """
     T = inputs.shape[1]
     xs = jnp.moveaxis(inputs, 1, 0)                     # [T, B, I]
-    steps = jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T)
 
-    def body(state, t):
-        x_t = xs[t]
+    def body(state, tx):
+        t, x_t = tx
         out, new_state = cell(x_t, state)
         if seq_lens is not None:
             valid = (t < seq_lens)[:, None]
@@ -144,14 +143,12 @@ def _scan_cell(cell, inputs, init_state, seq_lens=None, reverse=False):
                 lambda n, s: jnp.where(valid, n, s), new_state, state)
         return new_state, out
 
-    final, outs = jax.lax.scan(body, init_state, steps)
-    outs = jnp.moveaxis(outs, 0, 1)                     # [B, T, H]
-    if reverse:
-        # scan emitted t = T-1..0 at positions 0..T-1; flip restores the
-        # original time axis.  With seq_lens, invalid steps were already
-        # zeroed/frozen in the body, so positions align correctly as-is.
-        outs = jnp.flip(outs, axis=1)
-    return outs, final
+    # lax.scan threads xs per step natively; reverse=True walks t=T-1..0
+    # and still stacks outputs in ORIGINAL time order — no index gather,
+    # no post-hoc flip
+    final, outs = jax.lax.scan(body, init_state, (jnp.arange(T), xs),
+                               reverse=reverse)
+    return jnp.moveaxis(outs, 0, 1), final              # [B, T, H]
 
 
 class RNN(Layer):

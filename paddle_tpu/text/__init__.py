@@ -24,17 +24,21 @@ def viterbi_decode(potentials, transitions, lengths=None,
                    include_bos_eos_tag: bool = True, name=None):
     """Reference: paddle.text.viterbi_decode — best tag path under a CRF.
 
-    potentials [B, T, N] emission scores; transitions [N(+2), N(+2)] with
-    the last two rows/cols as BOS/EOS when include_bos_eos_tag.
-    Returns (scores [B], paths [B, T]).
+    potentials [B, T, N]; transitions [N, N] with the SAME tag dimension
+    as the emissions.  With ``include_bos_eos_tag`` the reference treats
+    the LAST row as the start (BOS->tag) scores and the SECOND-TO-LAST
+    column as the stop (tag->EOS) scores (paddle convention:
+    start_idx = -1, stop_idx = -2).  Returns (scores [B], paths [B, T]).
     """
     potentials = jnp.asarray(potentials, jnp.float32)
     B, T, N = potentials.shape
     trans = jnp.asarray(transitions, jnp.float32)
+    if trans.shape != (N, N):
+        raise ValueError(f"transitions must be [{N}, {N}] to match the "
+                         f"emission tag dim, got {trans.shape}")
     if include_bos_eos_tag:
-        bos = trans[N, :N]
-        eos = trans[:N, N + 1]
-        trans = trans[:N, :N]
+        bos = trans[-1, :]          # start_idx = -1 (last row)
+        eos = trans[:, -2]          # stop_idx  = -2 (second-to-last col)
     else:
         bos = jnp.zeros((N,), jnp.float32)
         eos = jnp.zeros((N,), jnp.float32)

@@ -51,27 +51,33 @@ def test_fbank_rows_sum_positive_and_cover():
 
 
 def test_viterbi_matches_bruteforce():
+    # reference convention (include_bos_eos_tag): transitions [N, N] with
+    # the last ROW = start scores and the second-to-last COLUMN = stop
+    # scores (start_idx=-1, stop_idx=-2)
     rs = np.random.RandomState(2)
-    B, T, N = 2, 5, 3
+    B, T, N = 2, 5, 4
     pot = rs.randn(B, T, N).astype(np.float32)
-    trans_full = rs.randn(N + 2, N + 2).astype(np.float32)
-    scores, paths = viterbi_decode(jnp.asarray(pot),
-                                   jnp.asarray(trans_full))
-    # brute force over all tag paths
+    trans = rs.randn(N, N).astype(np.float32)
+    scores, paths = viterbi_decode(jnp.asarray(pot), jnp.asarray(trans))
     import itertools
-    bos, eos = trans_full[N, :N], trans_full[:N, N + 1]
-    tr = trans_full[:N, :N]
+    bos, eos = trans[-1, :], trans[:, -2]
     for b in range(B):
         best, best_path = -1e30, None
         for path in itertools.product(range(N), repeat=T):
             s = bos[path[0]] + pot[b, 0, path[0]]
             for t in range(1, T):
-                s += tr[path[t - 1], path[t]] + pot[b, t, path[t]]
+                s += trans[path[t - 1], path[t]] + pot[b, t, path[t]]
             s += eos[path[-1]]
             if s > best:
                 best, best_path = s, path
         np.testing.assert_allclose(float(scores[b]), best, rtol=1e-5)
         assert tuple(np.asarray(paths[b])) == best_path
+
+
+def test_viterbi_shape_mismatch_raises():
+    with pytest.raises(ValueError, match="transitions must be"):
+        viterbi_decode(np.zeros((1, 3, 4), np.float32),
+                       np.zeros((6, 6), np.float32))
 
 
 def test_viterbi_layer_and_dataset_guidance():
@@ -82,3 +88,11 @@ def test_viterbi_layer_and_dataset_guidance():
     assert paths.shape == (1, 4)
     with pytest.raises(RuntimeError, match="zero-egress"):
         Imdb()
+
+
+def test_mel_pad_mode_and_dtype_forwarded():
+    x = jnp.asarray(np.random.RandomState(7).randn(1, 1024), jnp.float32)
+    m = MelSpectrogram(sr=8000, n_fft=256, n_mels=20, pad_mode="constant")
+    assert m.spectrogram.pad_mode == "constant"
+    out = m(x)
+    assert out.shape[1] == 20

@@ -1,0 +1,87 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distribution import (Normal, Uniform, Bernoulli, Categorical,
+                                     Beta, Dirichlet, Laplace, Gumbel,
+                                     LogNormal, kl_divergence)
+
+
+def test_normal_logprob_entropy_sample_stats():
+    paddle_tpu.seed(0)
+    d = Normal(1.0, 2.0)
+    lp = float(d.log_prob(jnp.asarray(1.0)))
+    np.testing.assert_allclose(lp, -np.log(2.0) - 0.5 * np.log(2 * np.pi),
+                               rtol=1e-6)
+    s = d.sample((20000,))
+    assert abs(float(jnp.mean(s)) - 1.0) < 0.1
+    assert abs(float(jnp.std(s)) - 2.0) < 0.1
+    np.testing.assert_allclose(float(d.entropy()),
+                               0.5 * np.log(2 * np.pi * np.e * 4), rtol=1e-5)
+    assert abs(float(d.cdf(jnp.asarray(1.0))) - 0.5) < 1e-6
+
+
+def test_categorical_and_bernoulli():
+    paddle_tpu.seed(1)
+    c = Categorical(logits=jnp.log(jnp.asarray([0.2, 0.3, 0.5])))
+    lp = np.asarray(c.log_prob(jnp.asarray([0, 2])))
+    np.testing.assert_allclose(lp, np.log([0.2, 0.5]), rtol=1e-5)
+    samp = np.asarray(c.sample((8000,)))
+    frac2 = (samp == 2).mean()
+    assert abs(frac2 - 0.5) < 0.05
+    b = Bernoulli(probs=0.7)
+    np.testing.assert_allclose(float(b.log_prob(jnp.asarray(1.0))),
+                               np.log(0.7), rtol=1e-5)
+
+
+def test_beta_dirichlet_mean_logprob():
+    be = Beta(2.0, 3.0)
+    np.testing.assert_allclose(float(be.mean), 0.4, rtol=1e-6)
+    # log_prob integrates ~ to 1 (trapezoid over grid)
+    xs = np.linspace(1e-3, 1 - 1e-3, 2001)
+    ps = np.exp(np.asarray(be.log_prob(jnp.asarray(xs))))
+    np.testing.assert_allclose(np.trapezoid(ps, xs), 1.0, rtol=1e-3)
+    di = Dirichlet(jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(di.mean),
+                               [1 / 6, 2 / 6, 3 / 6], rtol=1e-6)
+
+
+def test_kl_registrations():
+    kl = kl_divergence(Normal(0.0, 1.0), Normal(0.0, 1.0))
+    np.testing.assert_allclose(float(kl), 0.0, atol=1e-7)
+    kl2 = kl_divergence(Normal(1.0, 1.0), Normal(0.0, 1.0))
+    np.testing.assert_allclose(float(kl2), 0.5, rtol=1e-6)
+    c1 = Categorical(logits=jnp.zeros(4))
+    c2 = Categorical(logits=jnp.log(jnp.asarray([0.7, 0.1, 0.1, 0.1])))
+    assert float(kl_divergence(c1, c2)) > 0
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Normal(0.0, 1.0), Beta(1.0, 1.0))
+
+
+def test_samples_reproducible_with_seed():
+    paddle_tpu.seed(42)
+    a = Normal(0.0, 1.0).sample((4,))
+    paddle_tpu.seed(42)
+    b = Normal(0.0, 1.0).sample((4,))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_laplace_gumbel_lognormal_logprob_finite():
+    for d, v in [(Laplace(0.0, 1.0), 0.5), (Gumbel(0.0, 1.0), 0.3),
+                 (LogNormal(0.0, 1.0), 1.5)]:
+        assert np.isfinite(float(d.log_prob(jnp.asarray(v))))
+        s = d.sample((100,))
+        assert np.isfinite(np.asarray(s)).all()
+
+
+def test_kl_uniform_support_guard():
+    from paddle_tpu.distribution import Uniform
+    assert np.isinf(float(kl_divergence(Uniform(0.0, 2.0),
+                                        Uniform(0.0, 1.0))))
+    np.testing.assert_allclose(
+        float(kl_divergence(Uniform(0.25, 0.75), Uniform(0.0, 1.0))),
+        np.log(2.0), rtol=1e-6)
