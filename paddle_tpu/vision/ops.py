@@ -1,0 +1,187 @@
+"""paddle.vision.ops — detection primitives.
+
+Reference: python/paddle/vision/ops.py (nms, roi_align, roi_pool,
+box_iou-style utilities over phi CUDA kernels).
+
+TPU-native/staticshape notes: NMS runs a fixed-trip-count suppression loop
+(lax.fori over the sorted candidates, masked — no dynamic shapes, jits
+cleanly); callers slice by the returned count.  RoIAlign is bilinear
+gather + mean over a static sampling grid — pure MXU/VPU-friendly
+tensor math.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["box_area", "box_iou", "nms", "roi_align", "roi_pool"]
+
+
+def box_area(boxes):
+    """boxes [N, 4] (x1, y1, x2, y2) -> areas [N]."""
+    boxes = jnp.asarray(boxes)
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix [N, M] for two (x1, y1, x2, y2) box sets."""
+    boxes1 = jnp.asarray(boxes1)
+    boxes2 = jnp.asarray(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(boxes1)[:, None] + box_area(boxes2)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Reference: paddle.vision.ops.nms — greedy IoU suppression.
+
+    Returns the kept indices sorted by descending score (all boxes when
+    ``scores`` is None, in input order like the reference).  When
+    ``category_idxs`` is given suppression is per category (batched NMS
+    via the coordinate-offset trick).  Static-shape under jit: the loop
+    runs N fixed iterations over a keep mask.
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    n = boxes.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.int64)
+    if category_idxs is not None:
+        # shift each category into a disjoint coordinate region so cross-
+        # category IoU is zero (standard batched-NMS trick)
+        span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+        off = jnp.asarray(category_idxs, jnp.float32)[:, None] * span
+        shifted = boxes + off
+    else:
+        shifted = boxes
+    order = jnp.argsort(-jnp.asarray(scores, jnp.float32)) \
+        if scores is not None else jnp.arange(n)
+    sboxes = shifted[order]
+    iou = box_iou(sboxes, sboxes)
+
+    def body(i, keep):
+        # suppress j > i iff i is still kept and IoU(i, j) > thr
+        sup = jnp.logical_and(keep[i], iou[i] > iou_threshold)
+        sup = jnp.logical_and(sup, jnp.arange(n) > i)
+        return jnp.logical_and(keep, jnp.logical_not(sup))
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # gather kept indices in score order without dynamic shapes
+    idx_in_order = jnp.nonzero(keep, size=n, fill_value=-1)[0]
+    kept = jnp.where(idx_in_order >= 0, order[idx_in_order], -1)
+    count = jnp.sum(keep)
+    if top_k is not None:
+        kept = kept[:top_k]
+        count = jnp.minimum(count, top_k)
+    # outside jit, trim to the true count for reference-shaped output
+    try:
+        c = int(count)
+        return kept[:c]
+    except Exception:               # traced: fixed-size with -1 padding
+        return kept
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """Reference: paddle.vision.ops.roi_align.
+
+    x [N, C, H, W]; boxes [R, 4] (x1, y1, x2, y2) in input-image coords;
+    boxes_num [N] — how many rois belong to each batch element
+    (cumulative split, reference contract).  Returns [R, C, oh, ow].
+    """
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    # map each roi to its batch image
+    counts = jnp.asarray(boxes_num, jnp.int32)
+    img_idx = jnp.repeat(jnp.arange(N), counts, total_repeat_length=R)
+
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+
+    bin_w = rw / ow
+    bin_h = rh / oh
+    # sample grid: [oh*ratio] x [ow*ratio] points per roi
+    gy = (jnp.arange(oh * ratio) + 0.5) / ratio      # in bin units
+    gx = (jnp.arange(ow * ratio) + 0.5) / ratio
+    sy = y1[:, None] + bin_h[:, None] * gy[None, :]  # [R, oh*ratio]
+    sx = x1[:, None] + bin_w[:, None] * gx[None, :]  # [R, ow*ratio]
+
+    def bilinear(img, ys, xs):
+        """img [C, H, W]; ys [P], xs [Q] -> [C, P, Q]."""
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        wy1 = jnp.clip(ys - y0, 0, 1)
+        wx1 = jnp.clip(xs - x0, 0, 1)
+        wy0 = 1 - wy1
+        wx0 = 1 - wx1
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        out = (v00 * (wy0[:, None] * wx0[None, :])
+               + v01 * (wy0[:, None] * wx1[None, :])
+               + v10 * (wy1[:, None] * wx0[None, :])
+               + v11 * (wy1[:, None] * wx1[None, :]))
+        # out-of-image samples contribute zero (reference behavior)
+        valid = ((ys >= -1) & (ys <= H))[:, None] & \
+            ((xs >= -1) & (xs <= W))[None, :]
+        return out * valid[None]
+
+    def per_roi(r):
+        img = x[img_idx[r]]
+        samples = bilinear(img, sy[r], sx[r])        # [C, oh*k, ow*k]
+        s = samples.reshape(C, oh, ratio, ow, ratio)
+        return jnp.mean(s, axis=(2, 4))              # [C, oh, ow]
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """Reference: paddle.vision.ops.roi_pool (max pooling per bin).
+    Implemented via a dense sampling max (adaptive approximation with a
+    4x4 grid per bin, documented deviation from exact integer binning)."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    counts = jnp.asarray(boxes_num, jnp.int32)
+    img_idx = jnp.repeat(jnp.arange(N), counts, total_repeat_length=R)
+    k = 4
+
+    def per_roi(r):
+        img = x[img_idx[r]]
+        x1, y1, x2, y2 = boxes[r] * spatial_scale
+        ys = y1 + (y2 - y1) * (jnp.arange(oh * k) + 0.5) / (oh * k)
+        xs = x1 + (x2 - x1) * (jnp.arange(ow * k) + 0.5) / (ow * k)
+        yi = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+        samples = img[:, yi][:, :, xi].reshape(C, oh, k, ow, k)
+        return jnp.max(samples, axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))

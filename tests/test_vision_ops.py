@@ -1,0 +1,110 @@
+"""paddle.vision.ops detection primitives (reference: python/paddle/
+vision/ops.py — nms/roi_align/roi_pool over phi kernels)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.vision.ops import box_iou, box_area, nms, roi_align, roi_pool
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or sup[j]:
+                continue
+            # iou
+            lt = np.maximum(boxes[i, :2], boxes[j, :2])
+            rb = np.minimum(boxes[i, 2:], boxes[j, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[0] * wh[1]
+            a = ((boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1]) +
+                 (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1]) -
+                 inter)
+            if inter / max(a, 1e-10) > thr:
+                sup[j] = True
+    return keep
+
+
+def test_box_iou_known_values():
+    b1 = np.array([[0, 0, 2, 2]], np.float32)
+    b2 = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]], np.float32)
+    iou = np.asarray(box_iou(b1, b2))
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_nms_matches_numpy_greedy():
+    rs = np.random.RandomState(0)
+    centers = rs.rand(30, 2) * 10
+    sizes = rs.rand(30, 2) * 3 + 0.5
+    boxes = np.concatenate([centers - sizes / 2, centers + sizes / 2],
+                           1).astype(np.float32)
+    scores = rs.rand(30).astype(np.float32)
+    got = np.asarray(nms(jnp.asarray(boxes), 0.4,
+                         scores=jnp.asarray(scores)))
+    want = _np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nms_per_category():
+    boxes = np.array([[0, 0, 2, 2], [0.1, 0.1, 2.1, 2.1]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    # same box, different categories -> both kept
+    kept = np.asarray(nms(jnp.asarray(boxes), 0.3,
+                          scores=jnp.asarray(scores),
+                          category_idxs=jnp.asarray([0, 1]),
+                          categories=[0, 1]))
+    assert len(kept) == 2
+    # same category -> one suppressed
+    kept2 = np.asarray(nms(jnp.asarray(boxes), 0.3,
+                           scores=jnp.asarray(scores)))
+    assert len(kept2) == 1 and kept2[0] == 0
+
+
+def test_roi_align_constant_field():
+    # constant feature map: any roi pools to the constant
+    x = jnp.full((1, 3, 16, 16), 5.0)
+    boxes = jnp.asarray([[2.0, 2.0, 10.0, 10.0]], jnp.float32)
+    out = roi_align(x, boxes, jnp.asarray([1]), output_size=4)
+    assert out.shape == (1, 3, 4, 4)
+    np.testing.assert_allclose(np.asarray(out), 5.0, rtol=1e-6)
+
+
+def test_roi_align_linear_field_center_exact():
+    # f(x, y) = x: bilinear sampling of a linear field is exact
+    H = W = 16
+    xv = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32)[None, :], (H, W))
+    x = xv[None, None]
+    boxes = jnp.asarray([[4.0, 4.0, 12.0, 12.0]], jnp.float32)
+    out = np.asarray(roi_align(x, boxes, jnp.asarray([1]), output_size=2,
+                               aligned=True))
+    # bin centers along x: 4 + 8*(0.25, 0.75) - 0.5 = (5.5, 9.5)
+    np.testing.assert_allclose(out[0, 0, 0], [5.5, 9.5], rtol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 3, 3].set(9.0)
+    boxes = jnp.asarray([[0.0, 0.0, 8.0, 8.0]], jnp.float32)
+    out = np.asarray(roi_pool(x, boxes, jnp.asarray([1]), output_size=2))
+    assert out.max() == 9.0
+
+
+def test_nms_under_jit_fixed_shape():
+    boxes = jnp.asarray([[0, 0, 2, 2], [0.1, 0.1, 2.1, 2.1],
+                         [5, 5, 6, 6]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+
+    @jax.jit
+    def run(b, s):
+        return nms(b, 0.3, scores=s)
+
+    kept = np.asarray(run(boxes, scores))
+    assert kept.shape == (3,)          # fixed-size, -1 padded under jit
+    assert set(kept.tolist()) == {0, 2, -1}
