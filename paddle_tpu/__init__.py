@@ -87,7 +87,8 @@ def stop_gradient(x):
 # are imported on attribute access to keep `import paddle_tpu` fast.
 _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
-         "inference", "device", "ops", "fft", "distribution"}
+         "inference", "device", "ops", "fft", "distribution",
+         "signal", "regularizer"}
 
 
 def __getattr__(name):

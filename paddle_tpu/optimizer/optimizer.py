@@ -90,12 +90,20 @@ class Optimizer:
         if lr is None:
             lr = self._lr_sched.lr_at(step)
         l2 = self._decay_coef()
+        # L1Decay regularizer: coeff * sign(param) added to the gradient
+        # (reference: paddle.regularizer.L1Decay)
+        l1 = 0.0
+        wd_obj = self.weight_decay
+        if wd_obj is not None and type(wd_obj).__name__ == "L1Decay":
+            l1, l2 = l2, 0.0
 
         def upd(g, p, slots, master):
             if g is None:
                 return p, slots, master
             compute_p = master if master is not None else p
             g32 = g.astype(jnp.float32) if master is not None else g
+            if l1:
+                g32 = g32 + l1 * jnp.sign(compute_p)
             if l2 and self._l2_mode == "l2":
                 g32 = g32 + l2 * compute_p
             new_p, new_slots = self._update_param(g32, compute_p, slots, lr, step)

@@ -1,0 +1,28 @@
+"""paddle.regularizer parity — L1Decay / L2Decay.
+
+Reference: python/paddle/regularizer.py — regularizers passed as
+``weight_decay=`` to optimizers (or per-parameter via ParamAttr);
+L2 adds coeff*param to the gradient, L1 adds coeff*sign(param).
+"""
+
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        self._coeff = self.coeff
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        self._coeff = self.coeff
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

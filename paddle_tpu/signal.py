@@ -1,0 +1,114 @@
+"""paddle.signal parity — stft / istft.
+
+Reference: python/paddle/signal.py (frame/overlap_add over phi kernels,
+stft returning [..., n_fft//2+1, num_frames] complex for onesided).
+
+TPU-native: framing is a gather, FFT is the XLA FFT HLO (jnp.fft), and
+istft's overlap-add is a segment-sum scatter — all jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .audio.functional import get_window as _get_window
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Reference: paddle.signal.frame -> [..., frame_length, num_frames]
+    (for axis=-1)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    T = x.shape[-1]
+    n_frames = 1 + (T - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+    return x[..., idx]                     # [..., frame_length, n_frames]
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Reference: paddle.signal.overlap_add — inverse of frame.
+    x [..., frame_length, n_frames] -> [..., T]."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    frame_length, n_frames = x.shape[-2], x.shape[-1]
+    T = frame_length + hop_length * (n_frames - 1)
+    out = jnp.zeros(x.shape[:-2] + (T,), x.dtype)
+    for f in range(n_frames):              # static unroll; n_frames static
+        out = out.at[..., f * hop_length:f * hop_length + frame_length].add(
+            x[..., f])
+    return out
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Reference layout: [..., n_fft//2+1 (or n_fft), num_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length)
+    elif isinstance(window, str):
+        win = _get_window(window, win_length)
+    else:
+        win = jnp.asarray(window)
+    if win_length < n_fft:                 # center-pad window to n_fft
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        win_length = n_fft
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(x, n_fft, hop_length)   # [..., n_fft, n_frames]
+    frames = frames * win[:, None]
+    spec = jnp.fft.fft(frames, n=n_fft, axis=-2)
+    if onesided:
+        spec = spec[..., : n_fft // 2 + 1, :]
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return spec
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None,
+          return_complex: bool = False, name=None):
+    """Inverse STFT with window-envelope normalization (reference istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length)
+    elif isinstance(window, str):
+        win = _get_window(window, win_length)
+    else:
+        win = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2)
+    else:
+        frames = jnp.fft.ifft(x, n=n_fft, axis=-2)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * win[:, None]
+    y = overlap_add(frames, hop_length)
+    # normalize by the summed squared-window envelope
+    env = overlap_add(jnp.broadcast_to((win ** 2)[:, None],
+                                       (n_fft, x.shape[-1])), hop_length)
+    y = y / jnp.maximum(env, 1e-10)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad:y.shape[-1] - pad]
+    if length is not None:
+        y = y[..., :length]
+    return y

@@ -1,0 +1,63 @@
+"""paddle.signal (stft/istft/frame/overlap_add) + paddle.regularizer
+(reference: python/paddle/signal.py, regularizer.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import signal
+from paddle_tpu.regularizer import L1Decay, L2Decay
+import paddle_tpu.optimizer as opt
+
+
+def test_frame_overlap_add_roundtrip():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 64).astype(np.float32))
+    f = signal.frame(x, 16, 16)           # non-overlapping
+    assert f.shape == (3, 16, 4)
+    back = signal.overlap_add(f, 16)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-6)
+
+
+def test_stft_matches_numpy_and_istft_reconstructs():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 512).astype(np.float32))
+    n_fft, hop = 64, 16
+    S = signal.stft(x, n_fft, hop_length=hop, window="hann")
+    assert S.shape == (2, n_fft // 2 + 1, 1 + 512 // hop)
+    # numpy check of one frame (center pad reflect)
+    xp = np.pad(np.asarray(x), [(0, 0), (n_fft // 2, n_fft // 2)],
+                mode="reflect")
+    win = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+    ref0 = np.fft.fft(xp[0, :n_fft] * win)[: n_fft // 2 + 1]
+    np.testing.assert_allclose(np.asarray(S[0, :, 0]), ref0, rtol=1e-3,
+                               atol=1e-3)
+    # reconstruction
+    y = signal.istft(S, n_fft, hop_length=hop, window="hann", length=512)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_l2decay_equals_float_weight_decay():
+    paddle_tpu.seed(0)
+    w = jnp.asarray(np.random.RandomState(2).randn(4, 4), jnp.float32)
+    g = jnp.asarray(np.random.RandomState(3).randn(4, 4), jnp.float32)
+    o1 = opt.Momentum(learning_rate=0.1, weight_decay=0.01)
+    o2 = opt.Momentum(learning_rate=0.1, weight_decay=L2Decay(0.01))
+    s1, s2 = o1.init({"w": w}), o2.init({"w": w})
+    p1, _ = o1.update({"w": g}, s1, {"w": w})
+    p2, _ = o2.update({"w": g}, s2, {"w": w})
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
+
+
+def test_l1decay_adds_sign_penalty():
+    w = jnp.asarray([[1.0, -2.0]], jnp.float32)
+    g = jnp.zeros((1, 2), jnp.float32)
+    o = opt.SGD(learning_rate=0.1, weight_decay=L1Decay(0.5))
+    st = o.init({"w": w})
+    p, _ = o.update({"w": g}, st, {"w": w})
+    # p = w - lr * coeff * sign(w)
+    np.testing.assert_allclose(np.asarray(p["w"]), [[0.95, -1.95]],
+                               rtol=1e-6)
