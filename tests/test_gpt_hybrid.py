@@ -248,3 +248,32 @@ def test_vpp_trainer_with_mp_matches_serial():
 
     np.testing.assert_allclose(float(l1a), float(l2a), rtol=2e-4)
     np.testing.assert_allclose(float(l1b), float(l2b), rtol=2e-3)
+
+
+def test_vpp_with_zero3_trains_and_shards():
+    """VPP interleaving composed with ZeRO-3 param sharding: trains, and
+    the two-level stacked block leaves are actually sharded."""
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+                        "sharding_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=s)
+    paddle_tpu.seed(51)
+    cfg = gpt_tiny(remat=False)
+    cfg.num_layers = 4
+    tr = GPTHybridTrainer(cfg, dist.get_hybrid_communicate_group(),
+                          opt.AdamW(learning_rate=1e-3), microbatches=2,
+                          zero_stage=3, vpp=2)
+    st = tr.init_state()
+    pblk = st[1]
+    leaf = pblk["qkv.weight"]          # [S*V, K, h, 3h]
+    assert leaf.ndim == 4
+    spec = tr.specs_blocks["qkv.weight"]
+    assert "sharding" in str(spec)     # zero-3 sharded stacked leaf
+    assert leaf.addressable_shards[0].data.size < leaf.size
+    x, y = tr.make_batch(batch=4, seq=16, seed=3)
+    l0 = None
+    for _ in range(4):
+        st, loss = tr.train_step(st, x, y)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0
