@@ -52,6 +52,22 @@ complex128 = _jnp.complex128
 
 Tensor = _jax.Array
 
+__version__ = "0.2.0"
+
+
+class version:
+    """paddle.version parity (full_version/major/minor/patch/commit)."""
+    full_version = __version__
+    major, minor, patch = "0", "2", "0"
+    rc = "0"
+    commit = "tpu-native"
+
+    @staticmethod
+    def show():
+        print(f"full_version: {version.full_version}")
+        print(f"commit: {version.commit}")
+
+
 
 def is_compiled_with_cuda() -> bool:
     return False
@@ -88,7 +104,7 @@ def stop_gradient(x):
 _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
          "inference", "device", "ops", "fft", "distribution",
-         "signal", "regularizer"}
+         "signal", "regularizer", "utils"}
 
 
 def __getattr__(name):

@@ -1,0 +1,112 @@
+"""paddle.utils parity — run_check, deprecated, try_import, unique_name.
+
+Reference: python/paddle/utils/ — install self-check (run_check spins a
+tiny train step on the available device), deprecation decorator, lazy
+imports, unique name generator.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+from typing import Optional
+
+__all__ = ["run_check", "deprecated", "try_import", "unique_name"]
+
+
+def run_check():
+    """Reference: paddle.utils.run_check — verify the install end to end
+    (one tiny jitted train step on the default backend) and report."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..nn.functional_call import functional_call, state
+    from .. import nn
+    from .. import optimizer as opt
+
+    devs = jax.devices()
+    model = nn.Linear(4, 2)
+    params, buffers = state(model)
+    o = opt.SGD(learning_rate=0.1)
+    ostate = o.init(params)
+    x = jnp.asarray(np.ones((2, 4), np.float32))
+
+    @jax.jit
+    def step(p, os_):
+        def lf(p):
+            out, _ = functional_call(model, p, buffers, (x,))
+            return jnp.mean(out ** 2)
+        l, g = jax.value_and_grad(lf)(p)
+        newp, nos = o.update(g, os_, p)
+        return newp, nos, l
+
+    params, ostate, loss = step(params, ostate)
+    float(loss)
+    print(f"PaddleTPU works well on {len(devs)} {devs[0].platform} "
+          f"device(s).")
+    print("PaddleTPU is installed successfully!")
+    return True
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    """Decorator parity: warns on call (level>=2 raises)."""
+    def deco(fn):
+        msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated since "
+               f"{since or 'this release'}"
+               + (f", use '{update_to}' instead" if update_to else "")
+               + (f". Reason: {reason}" if reason else "."))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def try_import(module_name: str, err_msg: Optional[str] = None):
+    """Reference: paddle.utils.try_import — import or raise with guidance."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required but not "
+                       f"installed (pip install {module_name})")
+
+
+class _UniqueName:
+    """paddle.utils.unique_name namespace: generate/guard/switch."""
+
+    def __init__(self):
+        self._counters = {}
+        self._prefix = ""
+
+    def generate(self, key: str) -> str:
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{self._prefix}{key}_{n}"
+
+    def switch(self, new_generator=None):
+        old = self._counters
+        self._counters = {}
+        return old
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _g():
+            old = self._counters
+            self._counters = {}
+            try:
+                yield
+            finally:
+                self._counters = old
+        return _g()
+
+
+unique_name = _UniqueName()

@@ -363,3 +363,25 @@ def test_flash_attn_unpadded_padded_kernel_path_matches_dense():
                                    scale=scale, interpret=True)[0][:t]
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("s,d,kh,causal", [
+    (64, 64, 2, True), (96, 64, 1, False), (192, 128, 2, True),
+    (320, 128, 1, True), (128, 256, 2, False)])
+def test_flash_attention_shape_sweep(s, d, kh, causal):
+    """Random-shape sweep (odd block splits, GQA, both masks): kernel ==
+    dense reference, fwd + grad, for every combination."""
+    rs = np.random.RandomState(s + d)
+    q = jnp.asarray(rs.randn(2, s, 2, d).astype(np.float32) * 0.4)
+    k = jnp.asarray(rs.randn(2, s, kh, d).astype(np.float32) * 0.4)
+    v = jnp.asarray(rs.randn(2, s, kh, d).astype(np.float32) * 0.4)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = sdpa_reference(q, k, v, is_causal=causal, training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
+    g1 = jax.grad(lambda a: jnp.sum(flash_attention(
+        a, k, v, causal=causal, interpret=True) ** 2))(q)
+    g2 = jax.grad(lambda a: jnp.sum(sdpa_reference(
+        a, k, v, is_causal=causal, training=False) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=3e-4, atol=3e-4)

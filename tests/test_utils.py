@@ -1,0 +1,49 @@
+"""paddle.utils + paddle.version parity."""
+
+import warnings
+
+import pytest
+
+import paddle_tpu
+from paddle_tpu.utils import run_check, deprecated, try_import, unique_name
+
+
+def test_run_check_prints_success(capsys):
+    assert run_check() is True
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_version_surface():
+    assert paddle_tpu.__version__ == paddle_tpu.version.full_version
+    paddle_tpu.version.show()
+
+
+def test_deprecated_warns_and_raises():
+    @deprecated(update_to="new_fn", since="0.2")
+    def old_fn():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+
+    @deprecated(level=2)
+    def dead_fn():
+        return 0
+
+    with pytest.raises(RuntimeError, match="deprecated"):
+        dead_fn()
+
+
+def test_try_import_and_unique_name():
+    assert try_import("math").sqrt(4) == 2
+    with pytest.raises(ImportError, match="not_a_module"):
+        try_import("not_a_module_xyz", "not_a_module_xyz missing")
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+        assert c == "fc_0"
