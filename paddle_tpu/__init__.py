@@ -58,7 +58,7 @@ __version__ = "0.2.0"
 class version:
     """paddle.version parity (full_version/major/minor/patch/commit)."""
     full_version = __version__
-    major, minor, patch = "0", "2", "0"
+    major, minor, patch = __version__.split(".")
     rc = "0"
     commit = "tpu-native"
 

@@ -1,0 +1,68 @@
+"""Porting shims: paddle-style Tensor methods on jax arrays.
+
+Reference context: paddle Tensors carry eager methods
+(``.numpy()/.item()/.detach()/.clone()/.cpu()/.astype()...``, generated
+from the op registry onto the pybind Tensor — SURVEY.md §2.2 Tensor API).
+jax Arrays already provide most of the surface (reshape/astype/item/
+mean/sum/...); this module patches in the paddle-specific remainder so
+ported scripts run unchanged.
+
+Opt-in: call ``enable_tensor_methods()`` (idempotent).  Methods are added
+to the CONCRETE ArrayImpl class only — traced values inside jit keep
+failing loudly on eager-only methods like ``.numpy()``, which is the
+correct behavior (the reference raises under static graph too).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["enable_tensor_methods"]
+
+_DONE = False
+
+
+def enable_tensor_methods() -> None:
+    global _DONE
+    if _DONE:
+        return
+    import jax
+    import jax.numpy as jnp
+    from jax._src.array import ArrayImpl
+
+    # trace-safe methods go on BOTH the concrete array and the Tracer base
+    # (paddle's equivalents work under static graph too); eager-only
+    # methods stay ArrayImpl-only so jit fails loudly like the reference.
+    both = (ArrayImpl, jax.core.Tracer)
+
+    def _add(name, fn, classes=both, overwrite=False):
+        for cls in classes:
+            if overwrite or not hasattr(cls, name):
+                setattr(cls, name, fn)
+
+    _add("numpy", lambda self: np.asarray(self), classes=(ArrayImpl,))
+    _add("cpu", lambda self: jax.device_get(self), classes=(ArrayImpl,))
+    _add("detach", lambda self: jax.lax.stop_gradient(self))
+    _add("clone", lambda self: self + jnp.zeros((), self.dtype))
+    _add("cuda", lambda self: self)          # placement is sharding's job
+    _add("numel", lambda self: int(np.prod(self.shape)))
+    _add("dim", lambda self: self.ndim)
+    _add("stop_gradient_", lambda self: jax.lax.stop_gradient(self))
+    _add("add", lambda self, y: self + y)
+    _add("subtract", lambda self, y: self - y)
+    _add("multiply", lambda self, y: self * y)
+    _add("divide", lambda self, y: self / y)
+    _add("scale", lambda self, s, bias=0.0: self * s + bias)
+    _add("matmul", lambda self, y: self @ y)
+    _add("t", lambda self: jnp.transpose(self))
+    _add("unsqueeze", lambda self, axis: jnp.expand_dims(self, axis))
+    _add("pow", lambda self, e: self ** e)
+    _add("abs", lambda self: jnp.abs(self))
+    _add("exp", lambda self: jnp.exp(self))
+    _add("log", lambda self: jnp.log(self))
+    _add("tanh", lambda self: jnp.tanh(self))
+    _add("sigmoid", lambda self: 1.0 / (1.0 + jnp.exp(-self)))
+    _add("equal_all", lambda self, y: jnp.array_equal(self, y),
+         classes=(ArrayImpl,))
+    _add("is_tensor", lambda self: True)
+    _DONE = True

@@ -76,7 +76,8 @@ class AdamW(Adam):
         finally:
             self.weight_decay = wd
         coef = self._decay_coef()
-        if coef:
+        l1 = self._l1_coef()
+        if coef or l1:
             if lr is None:
                 lr = self._lr_sched.lr_at(state["step"])
             for k in list(new_params.keys()):
@@ -84,8 +85,12 @@ class AdamW(Adam):
                     p_old = params[k]
                     master = state["master"][k] if isinstance(state["master"], dict) else None
                     base = master if master is not None else p_old
+                    base32 = base.astype(jnp.float32)
+                    # L1Decay: sign penalty; L2Decay/float: proportional
+                    penalty = (l1 * jnp.sign(base32) if l1
+                               else coef * base32)
                     decayed32 = (new_params[k].astype(jnp.float32) -
-                                 lr * coef * base.astype(jnp.float32))
+                                 lr * penalty)
                     new_params[k] = decayed32.astype(p_old.dtype)
                     # decay must persist in the fp32 master, else the next
                     # step recomputes from the undecayed copy

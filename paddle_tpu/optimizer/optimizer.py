@@ -68,13 +68,21 @@ class Optimizer:
         return state
 
     def _decay_coef(self) -> float:
+        """L2-style decay coefficient; 0 for L1Decay (see _l1_coef) so no
+        subclass/fused path double-applies an L1 regularizer as L2."""
         wd = self.weight_decay
-        if wd is None:
+        if wd is None or type(wd).__name__ == "L1Decay":
             return 0.0
         if isinstance(wd, float):
             return wd
         # L2Decay-like object with a coeff attribute
         return float(getattr(wd, "_coeff", getattr(wd, "coeff", 0.0)))
+
+    def _l1_coef(self) -> float:
+        wd = self.weight_decay
+        if wd is not None and type(wd).__name__ == "L1Decay":
+            return float(getattr(wd, "coeff", 0.0))
+        return 0.0
 
     def update(self, grads, state, params, lr=None):
         """Returns (new_params, new_state).  Pure; jit/pjit-safe.
@@ -92,10 +100,7 @@ class Optimizer:
         l2 = self._decay_coef()
         # L1Decay regularizer: coeff * sign(param) added to the gradient
         # (reference: paddle.regularizer.L1Decay)
-        l1 = 0.0
-        wd_obj = self.weight_decay
-        if wd_obj is not None and type(wd_obj).__name__ == "L1Decay":
-            l1, l2 = l2, 0.0
+        l1 = self._l1_coef()
 
         def upd(g, p, slots, master):
             if g is None:

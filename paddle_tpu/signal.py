@@ -19,28 +19,40 @@ __all__ = ["stft", "istft", "frame", "overlap_add"]
 
 
 def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
-    """Reference: paddle.signal.frame -> [..., frame_length, num_frames]
-    (for axis=-1)."""
-    if axis not in (-1, x.ndim - 1):
-        x = jnp.moveaxis(x, axis, -1)
+    """Reference: paddle.signal.frame.  axis=-1 (time last):
+    [..., T] -> [..., frame_length, num_frames]; axis=0 (time first):
+    [T, ...] -> [num_frames, frame_length, ...]."""
+    x = jnp.asarray(x)
+    if axis == 0 and x.ndim > 1:
+        x = jnp.moveaxis(x, 0, -1)
     T = x.shape[-1]
     n_frames = 1 + (T - frame_length) // hop_length
     starts = jnp.arange(n_frames) * hop_length
     idx = starts[None, :] + jnp.arange(frame_length)[:, None]
-    return x[..., idx]                     # [..., frame_length, n_frames]
+    out = x[..., idx]                      # [..., frame_length, n_frames]
+    if axis == 0:
+        # -> [num_frames, frame_length, ...]
+        out = jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+    return out
 
 
 def overlap_add(x, hop_length: int, axis: int = -1, name=None):
     """Reference: paddle.signal.overlap_add — inverse of frame.
-    x [..., frame_length, n_frames] -> [..., T]."""
-    if axis not in (-1, x.ndim - 1):
-        x = jnp.moveaxis(x, axis, -1)
+    axis=-1: [..., frame_length, n_frames] -> [..., T];
+    axis=0:  [n_frames, frame_length, ...] -> [T, ...].
+    Single scatter-add over precomputed indices (O(1) op count)."""
+    x = jnp.asarray(x)
+    if axis == 0:
+        # [nf, fl, ...] -> [..., fl, nf]
+        x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2)
     frame_length, n_frames = x.shape[-2], x.shape[-1]
     T = frame_length + hop_length * (n_frames - 1)
-    out = jnp.zeros(x.shape[:-2] + (T,), x.dtype)
-    for f in range(n_frames):              # static unroll; n_frames static
-        out = out.at[..., f * hop_length:f * hop_length + frame_length].add(
-            x[..., f])
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(n_frames)[None, :]).reshape(-1)
+    vals = x.reshape(x.shape[:-2] + (frame_length * n_frames,))
+    out = jnp.zeros(x.shape[:-2] + (T,), x.dtype).at[..., idx].add(vals)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
     return out
 
 

@@ -91,8 +91,12 @@ class _UniqueName:
         return f"{self._prefix}{key}_{n}"
 
     def switch(self, new_generator=None):
+        """Install ``new_generator`` (a counter state from a previous
+        switch; fresh when None) and return the previous state — the
+        paddle round-trip ``old = switch(); ...; switch(old)`` restores."""
         old = self._counters
-        self._counters = {}
+        self._counters = dict(new_generator) if new_generator is not None \
+            else {}
         return old
 
     def guard(self, new_generator=None):
@@ -100,12 +104,11 @@ class _UniqueName:
 
         @contextlib.contextmanager
         def _g():
-            old = self._counters
-            self._counters = {}
+            old = self.switch(new_generator)
             try:
                 yield
             finally:
-                self._counters = old
+                self.switch(old)
         return _g()
 
 

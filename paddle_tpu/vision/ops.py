@@ -94,6 +94,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     x [N, C, H, W]; boxes [R, 4] (x1, y1, x2, y2) in input-image coords;
     boxes_num [N] — how many rois belong to each batch element
     (cumulative split, reference contract).  Returns [R, C, oh, ow].
+
+    Documented deviation: with ``sampling_ratio <= 0`` the reference picks
+    ceil(roi_size/output_size) samples per bin PER ROI (a dynamic shape);
+    under jit we use a fixed 4x4 grid per bin instead — exact for
+    bilinear-smooth features, approximate on sharp ones.  Pass an explicit
+    positive ``sampling_ratio`` to control it.
     """
     x = jnp.asarray(x, jnp.float32)
     boxes = jnp.asarray(boxes, jnp.float32)
@@ -101,7 +107,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     R = boxes.shape[0]
     oh, ow = (output_size if isinstance(output_size, (tuple, list))
               else (output_size, output_size))
-    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    ratio = sampling_ratio if sampling_ratio > 0 else 4
     # map each roi to its batch image
     counts = jnp.asarray(boxes_num, jnp.int32)
     img_idx = jnp.repeat(jnp.arange(N), counts, total_repeat_length=R)

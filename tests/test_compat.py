@@ -1,0 +1,39 @@
+"""Porting shims: paddle-style methods on jax arrays (opt-in)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu.compat import enable_tensor_methods
+
+
+def test_tensor_methods_after_enable():
+    enable_tensor_methods()
+    enable_tensor_methods()          # idempotent
+    x = jnp.asarray([[1.0, -2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(x.numpy(), np.asarray(x))
+    assert x.numel() == 4 and x.dim() == 2
+    np.testing.assert_allclose(np.asarray(x.abs()), np.abs(np.asarray(x)))
+    np.testing.assert_allclose(np.asarray(x.add(1.0)), np.asarray(x) + 1)
+    np.testing.assert_allclose(np.asarray(x.t()), np.asarray(x).T)
+    np.testing.assert_allclose(np.asarray(x.scale(2.0, 1.0)),
+                               np.asarray(x) * 2 + 1)
+    assert x.unsqueeze(0).shape == (1, 2, 2)
+    # detach blocks gradients
+    g = jax.grad(lambda a: jnp.sum(a.detach() * a))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(x))
+
+
+def test_numpy_method_raises_under_trace():
+    enable_tensor_methods()
+
+    @jax.jit
+    def f(a):
+        a.numpy()                    # eager-only: must fail loudly
+        return a
+
+    with pytest.raises((AttributeError, jax.errors.TracerArrayConversionError,
+                        jax.errors.ConcretizationTypeError)):
+        f(jnp.ones(3))

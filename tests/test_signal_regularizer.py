@@ -61,3 +61,45 @@ def test_l1decay_adds_sign_penalty():
     # p = w - lr * coeff * sign(w)
     np.testing.assert_allclose(np.asarray(p["w"]), [[0.95, -1.95]],
                                rtol=1e-6)
+
+
+def test_frame_overlap_axis0_reference_layout():
+    # paddle contract: axis=0 -> frame [num_frames, frame_length, ...]
+    x1 = jnp.asarray(np.arange(8, dtype=np.float32))
+    f = signal.frame(x1, 4, 4, axis=0)
+    assert f.shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(f),
+                               [[0, 1, 2, 3], [4, 5, 6, 7]])
+    np.testing.assert_allclose(np.asarray(signal.overlap_add(f, 4, axis=0)),
+                               np.arange(8))
+    x2 = jnp.asarray(np.arange(24, dtype=np.float32).reshape(12, 2))
+    f2 = signal.frame(x2, 4, 4, axis=0)
+    assert f2.shape == (3, 4, 2)
+    back = signal.overlap_add(f2, 4, axis=0)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x2))
+
+
+def test_fused_adamw_l1decay_matches_adamw():
+    # code-review r2: FusedAdamW must not double-apply L1 as L2
+    w = {"w": jnp.asarray([[1.0, -2.0]], jnp.float32)}
+    g = {"w": jnp.zeros((1, 2), jnp.float32)}
+    o1 = opt.AdamW(learning_rate=0.1, weight_decay=L1Decay(0.5))
+    o2 = opt.FusedAdamW(learning_rate=0.1, weight_decay=L1Decay(0.5))
+    p1, _ = o1.update(g, o1.init(w), dict(w))
+    p2, _ = o2.update(g, o2.init(w), dict(w))
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
+
+
+def test_adamw_apply_decay_param_fun_l1():
+    # per-name decay path must keep the SIGN penalty for L1Decay
+    w = {"a": jnp.asarray([[1.0, -2.0]], jnp.float32),
+         "b": jnp.asarray([[4.0, -4.0]], jnp.float32)}
+    g = {k: jnp.zeros((1, 2), jnp.float32) for k in w}
+    o = opt.AdamW(learning_rate=0.1, weight_decay=L1Decay(0.5),
+                  apply_decay_param_fun=lambda n: n == "a")
+    p, _ = o.update(g, o.init(w), dict(w))
+    np.testing.assert_allclose(np.asarray(p["a"]), [[0.95, -1.95]],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p["b"]), [[4.0, -4.0]],
+                               rtol=1e-6)  # excluded name: no decay
