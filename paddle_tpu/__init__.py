@@ -104,7 +104,7 @@ def stop_gradient(x):
 _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
          "inference", "device", "ops", "fft", "distribution",
-         "signal", "regularizer", "utils"}
+         "signal", "regularizer", "utils", "onnx", "compat"}
 
 
 def __getattr__(name):

@@ -1,0 +1,16 @@
+"""paddle.onnx surface (reference: python/paddle/onnx/export.py -> paddle2onnx).
+
+No onnx runtime exists in this environment (zero egress); the supported
+export path is paddle_tpu.jit.save (jax.export AOT StableHLO artifact),
+which this module points at with a clear error.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise RuntimeError(
+        "paddle_tpu.onnx.export: ONNX export is unavailable (no onnx/"
+        "paddle2onnx in this environment).  Use paddle_tpu.jit.save(layer, "
+        "path, input_spec=...) for a portable AOT artifact "
+        "(StableHLO via jax.export) and paddle_tpu.inference to serve it.")
