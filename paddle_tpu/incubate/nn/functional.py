@@ -14,6 +14,8 @@ import jax.numpy as jnp
 from ...nn import functional as F
 
 __all__ = ["fused_linear", "fused_matmul_bias", "fused_feedforward",
+           "fused_dropout_add", "fused_linear_activation",
+           "masked_multihead_attention", "fused_multi_transformer",
            "fused_multi_head_attention",
            "fused_bias_dropout_residual_layer_norm",
            "fused_rotary_position_embedding", "fused_rms_norm",
@@ -193,3 +195,115 @@ def swiglu(x, y=None, name=None):
     if y is None:
         x, y = jnp.split(x, 2, axis=-1)
     return jax.nn.silu(x) * y
+
+
+def fused_dropout_add(x, y, p: float = 0.5, training: bool = True,
+                      mode: str = "upscale_in_train", name=None):
+    """Reference: incubate fused dropout(x) + y epilogue."""
+    from ...nn.functional.common import dropout as _dropout
+    return _dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear_activation(x, y, bias=None, trans_x: bool = False,
+                            trans_y: bool = False, activation: str = "gelu",
+                            name=None):
+    """Reference: fused GEMM + bias + activation epilogue (cuBLASLt);
+    XLA fuses the same chain."""
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    from ...nn import functional as _F
+    act = {"gelu": lambda t: _F.gelu(t, approximate=True),
+           "relu": _F.relu, "none": lambda t: t,
+           "identity": lambda t: t}[activation]
+    return act(out)
+
+
+def masked_multihead_attention(x, cache_kv, src_mask=None, bias=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None,
+                               seq_len: int = 1, rotary_emb_dims: int = 0,
+                               use_neox_rotary_style: bool = False,
+                               compute_dtype: str = "default",
+                               out_scale: float = -1, quant_round_type=1,
+                               quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Reference: incubate masked_multihead_attention — the single-token
+    decode attention op of fused_multi_transformer.
+
+    x [B, 3*H*D] fused qkv for ONE new token; cache_kv [2, B, H, T_max, D]
+    holding ``sequence_lengths`` valid entries per batch (int tensor [B];
+    when None the cache is assumed full up to the written position 0).
+    Returns (out [B, H*D], updated cache_kv).  Quantization knobs are
+    accepted no-ops (documented; XLA path is bf16/f32).
+    """
+    import jax
+    cache_kv = jnp.asarray(cache_kv)
+    _, B, H, T, D = cache_kv.shape
+    qkv = jnp.asarray(x).reshape(B, 3, H, D)
+    if bias is not None:
+        qkv = qkv + jnp.asarray(bias).reshape(1, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, H, D]
+    lens = (jnp.asarray(sequence_lengths, jnp.int32)
+            if sequence_lengths is not None else jnp.zeros((B,), jnp.int32))
+    # write the new k/v at each sequence's current length (per-batch)
+    t_idx = jnp.clip(lens, 0, T - 1)
+    kc = cache_kv[0]
+    vc = cache_kv[1]
+    b_idx = jnp.arange(B)
+    kc = kc.at[b_idx, :, t_idx, :].set(k)
+    vc = vc.at[b_idx, :, t_idx, :].set(v)
+    new_cache = jnp.stack([kc, vc], axis=0)
+    from ...kernels.decode_attention import decode_attention
+    out = decode_attention(q[:, None],                  # [B, 1, H, D]
+                           jnp.swapaxes(kc, 1, 2),      # [B, T, H, D]
+                           jnp.swapaxes(vc, 1, 2),
+                           lens + 1)
+    return out.reshape(B, H * D), new_cache
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon: float = 1e-5, cache_kvs=None,
+                            pre_caches=None, rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate: float = 0.0,
+                            rotary_emb_dims: int = 0, activation="gelu",
+                            training: bool = False, mode="upscale_in_train",
+                            trans_qkvw: bool = True, ring_id: int = -1,
+                            name=None):
+    """Functional form of the fused_multi_transformer op: weight LISTS in
+    (the reference op signature), one decoder stack pass out.  Reuses the
+    FusedMultiTransformer layer's math by binding the given weights onto a
+    template instance (traced values flow through; nothing is copied)."""
+    from .layer import FusedMultiTransformer as _Layer
+    qkv0 = jnp.asarray(qkv_weights[0])
+    if trans_qkvw:
+        _, H, D, M = qkv0.shape
+    else:
+        M, _, H, D = qkv0.shape
+    FF = jnp.asarray(ffn1_weights[0]).shape[-1]
+    L = len(qkv_weights)
+    layer = _Layer(embed_dim=M, num_heads=H, dim_feedforward=FF,
+                   dropout_rate=dropout_rate, activation=activation
+                   if isinstance(activation, str) else "gelu",
+                   epsilon=epsilon, num_layers=L, trans_qkvw=trans_qkvw)
+    if not training:
+        layer.eval()
+    p = layer._parameters
+    for i in range(L):
+        p[f"ln_scale_{i}"] = jnp.asarray(ln_scales[i])
+        p[f"ln_bias_{i}"] = jnp.asarray(ln_biases[i])
+        p[f"qkv_weight_{i}"] = jnp.asarray(qkv_weights[i])
+        p[f"qkv_bias_{i}"] = jnp.asarray(qkv_biases[i])
+        p[f"linear_weight_{i}"] = jnp.asarray(linear_weights[i])
+        p[f"linear_bias_{i}"] = jnp.asarray(linear_biases[i])
+        p[f"ffn_ln_scale_{i}"] = jnp.asarray(ffn_ln_scales[i])
+        p[f"ffn_ln_bias_{i}"] = jnp.asarray(ffn_ln_biases[i])
+        p[f"ffn1_weight_{i}"] = jnp.asarray(ffn1_weights[i])
+        p[f"ffn1_bias_{i}"] = jnp.asarray(ffn1_biases[i])
+        p[f"ffn2_weight_{i}"] = jnp.asarray(ffn2_weights[i])
+        p[f"ffn2_bias_{i}"] = jnp.asarray(ffn2_biases[i])
+    out = layer(x, attn_mask=attn_mask, caches=cache_kvs,
+                time_step=time_step, rotary_embs=rotary_embs)
+    return out

@@ -226,3 +226,79 @@ def test_fused_mt_noop_padding_mask_matches_no_mask_chunked_decode():
     out_zero, _ = m(x, caches=caches, time_step=t, attn_mask=zero_mask)
     np.testing.assert_allclose(np.asarray(out_none), np.asarray(out_zero),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_fused_dropout_add_and_linear_activation():
+    rs = np.random.RandomState(21)
+    x = jnp.asarray(rs.randn(3, 8), jnp.float32)
+    y = jnp.asarray(rs.randn(3, 8), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(IF.fused_dropout_add(x, y, p=0.5, training=False)),
+        np.asarray(x + y), rtol=1e-6)
+    w = jnp.asarray(rs.randn(8, 4), jnp.float32)
+    b = jnp.asarray(rs.randn(4), jnp.float32)
+    out = IF.fused_linear_activation(x, w, b, activation="relu")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(np.asarray(x) @ np.asarray(w)
+                                          + np.asarray(b), 0), rtol=1e-5)
+
+
+def test_masked_multihead_attention_matches_decode_ref():
+    paddle_tpu.seed(23)
+    rs = np.random.RandomState(23)
+    B, H, D, T = 2, 2, 64, 16
+    lens = np.array([3, 7], np.int32)
+    cache = rs.randn(2, B, H, T, D).astype(np.float32) * 0.5
+    # zero out invalid cache positions for clarity
+    x = rs.randn(B, 3 * H * D).astype(np.float32) * 0.5
+    out, new_cache = IF.masked_multihead_attention(
+        jnp.asarray(x), jnp.asarray(cache),
+        sequence_lengths=jnp.asarray(lens))
+    assert out.shape == (B, H * D)
+    # the new k/v must be written at position lens[b]
+    qkv = x.reshape(B, 3, H, D)
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(new_cache[0, b, :, lens[b]]), qkv[b, 1], rtol=1e-6)
+    # numpy attention oracle over the first lens[b]+1 positions
+    for b in range(B):
+        L = lens[b] + 1
+        kc = np.asarray(new_cache[0, b])    # [H, T, D]
+        vc = np.asarray(new_cache[1, b])
+        q = qkv[b, 0]
+        for h in range(H):
+            s = (q[h] @ kc[h, :L].T) / np.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            ref = p @ vc[h, :L]
+            np.testing.assert_allclose(
+                np.asarray(out[b]).reshape(H, D)[h], ref, rtol=2e-4,
+                atol=2e-4)
+
+
+def test_fused_multi_transformer_functional_matches_layer():
+    paddle_tpu.seed(24)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4, dim_feedforward=64,
+                              num_layers=2)
+    m.eval()
+    rs = np.random.RandomState(24)
+    x = jnp.asarray(rs.randn(2, 6, 32), jnp.float32)
+    ref = m(x)
+    p = m._parameters
+    L = 2
+    out = IF.fused_multi_transformer(
+        x,
+        [p[f"ln_scale_{i}"] for i in range(L)],
+        [p[f"ln_bias_{i}"] for i in range(L)],
+        [p[f"qkv_weight_{i}"] for i in range(L)],
+        [p[f"qkv_bias_{i}"] for i in range(L)],
+        [p[f"linear_weight_{i}"] for i in range(L)],
+        [p[f"linear_bias_{i}"] for i in range(L)],
+        [p[f"ffn_ln_scale_{i}"] for i in range(L)],
+        [p[f"ffn_ln_bias_{i}"] for i in range(L)],
+        [p[f"ffn1_weight_{i}"] for i in range(L)],
+        [p[f"ffn1_bias_{i}"] for i in range(L)],
+        [p[f"ffn2_weight_{i}"] for i in range(L)],
+        [p[f"ffn2_bias_{i}"] for i in range(L)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
