@@ -1,0 +1,111 @@
+"""Profiler facade + AMP auto_cast/GradScaler (reference:
+python/paddle/profiler/profiler.py; python/paddle/amp/) — previously
+untested subsystems."""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.nn.functional_call import functional_call, state
+
+
+def test_record_event_and_chrome_trace(tmp_path):
+    from paddle_tpu.profiler import (Profiler, RecordEvent,
+                                     export_chrome_tracing, make_scheduler)
+    prof = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=3),
+                    on_trace_ready=export_chrome_tracing(str(tmp_path)))
+    prof.start()
+    for _ in range(3):
+        with RecordEvent("my_step"):
+            with RecordEvent("inner"):
+                _ = jnp.sum(jnp.ones((8, 8)))
+        prof.step()
+    prof.stop()
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert files, "no chrome trace written"
+    data = json.load(open(os.path.join(str(tmp_path), files[0])))
+    names = {e.get("name") for e in data.get("traceEvents", data)}
+    assert "my_step" in names and "inner" in names
+
+
+def test_profiler_summary_runs(capsys):
+    from paddle_tpu.profiler import Profiler, RecordEvent
+    prof = Profiler(scheduler=lambda step: __import__(
+        "paddle_tpu.profiler.profiler", fromlist=["ProfilerState"]
+    ).ProfilerState.RECORD)
+    prof.start()
+    with RecordEvent("work"):
+        pass
+    prof.step()
+    prof.stop()
+    prof.summary()
+    assert "work" in capsys.readouterr().out
+
+
+def test_auto_cast_o1_casts_matmul_inputs():
+    from paddle_tpu.amp import auto_cast
+    from paddle_tpu.amp.auto_cast import maybe_cast
+    x = jnp.ones((4, 4), jnp.float32)
+    with auto_cast(True, dtype="bfloat16"):
+        assert maybe_cast(x, "matmul").dtype == jnp.bfloat16
+        # black-list ops stay f32
+        assert maybe_cast(x, "softmax").dtype == jnp.float32
+    assert maybe_cast(x, "matmul").dtype == jnp.float32   # outside ctx
+
+
+def test_grad_scaler_dynamic_loss_scaling():
+    from paddle_tpu.amp import GradScaler
+    s = GradScaler(init_loss_scaling=16.0, incr_every_n_steps=2,
+                   decr_every_n_nan_or_inf=1)
+    loss = jnp.asarray(2.0)
+    assert float(s.scale(loss)) == 32.0
+    g = {"w": jnp.asarray([4.0, 8.0]) * 16.0}
+    un, found = s.unscale(g)
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(un["w"]), [4.0, 8.0])
+    # inf grads detected + scale halves
+    bad = {"w": jnp.asarray([jnp.inf, 1.0])}
+    _, found_bad = s.unscale(bad)
+    assert bool(found_bad)
+    s.update(found_bad)
+    assert s.get_loss_scaling() == 8.0
+    # two good steps -> scale doubles
+    s.update(jnp.asarray(False))
+    s.update(jnp.asarray(False))
+    assert s.get_loss_scaling() == 16.0
+
+
+def test_grad_scaler_training_loop_skips_bad_step():
+    """Reference pattern: scale -> backward -> unscale -> skip on inf."""
+    from paddle_tpu.amp import GradScaler
+    paddle_tpu.seed(0)
+    model = nn.Linear(4, 2)
+    params, buffers = state(model)
+    o = opt.SGD(learning_rate=0.1)
+    ostate = o.init(params)
+    scaler = GradScaler(init_loss_scaling=4.0)
+    x = jnp.ones((2, 4))
+    y = jnp.zeros((2, 2))
+
+    def loss_fn(p):
+        out, _ = functional_call(model, p, buffers, (x,))
+        return scaler.scale(jnp.mean((out - y) ** 2))
+
+    g = jax.grad(loss_fn)(params)
+    un, found = scaler.unscale(g)
+    assert not bool(found)
+    p2, _ = o.update(un, ostate, params)
+    # parameters moved by the UNSCALED gradient
+    ref_g = jax.grad(lambda p: jnp.mean(
+        (functional_call(model, p, buffers, (x,))[0] - y) ** 2))(params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p2[k]),
+            np.asarray(params[k] - 0.1 * ref_g[k]), rtol=1e-5, atol=1e-6)
