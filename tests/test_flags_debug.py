@@ -1,0 +1,45 @@
+"""core.flags + framework.debug (check_numerics) — reference:
+FLAGS_check_nan_inf / set_flags (SURVEY.md §5 race/numerics debugging)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu import set_flags, get_flags
+from paddle_tpu.framework.debug import check_numerics
+
+
+def test_flags_roundtrip_and_unknown():
+    orig = get_flags("benchmark")["benchmark"]
+    try:
+        set_flags({"benchmark": True})
+        assert get_flags("benchmark")["benchmark"] is True
+        assert get_flags(["benchmark", "deterministic"])["deterministic"] \
+            in (True, False)
+    finally:
+        set_flags({"benchmark": orig})
+    with pytest.raises((KeyError, ValueError)):
+        set_flags({"not_a_flag_xyz": 1})
+
+
+def test_check_numerics_passes_clean_and_raises_on_nan():
+    x = jnp.asarray([1.0, 2.0])
+    y = check_numerics(x, op_type="t", var_name="x")
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    bad = jnp.asarray([1.0, jnp.nan])
+    with pytest.raises(Exception):
+        jax.block_until_ready(check_numerics(bad, op_type="t",
+                                             var_name="bad"))
+
+
+def test_check_numerics_under_jit():
+    @jax.jit
+    def f(a):
+        return check_numerics(a * 2, op_type="mul", var_name="out")
+
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2.0)
+    with pytest.raises(Exception):
+        jax.block_until_ready(f(jnp.asarray([jnp.inf, 1.0, 1.0]) * 0.0
+                                / 0.0))
