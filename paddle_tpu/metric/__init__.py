@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["accuracy", "Metric", "Accuracy", "Precision", "Recall", "Auc"]
 
 
 class Metric:
@@ -154,3 +154,14 @@ class Auc(Metric):
 
     def name(self):
         return [self._name]
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """Functional parity: paddle.metric.accuracy — top-k accuracy of
+    ``input`` [N, C] probabilities/logits vs ``label`` [N] or [N, 1]."""
+    import jax.numpy as jnp
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(-1)
+    topk = jnp.argsort(-input, axis=-1)[:, :k]
+    hit = jnp.any(topk == label[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))

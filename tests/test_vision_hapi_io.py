@@ -164,3 +164,12 @@ def test_fake_data_with_transform():
     # deterministic per index
     img2, label2 = ds[3]
     np.testing.assert_array_equal(img, img2)
+
+
+def test_metric_accuracy_functional():
+    import paddle_tpu.metric as M
+    logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = jnp.asarray([1, 0, 0])
+    np.testing.assert_allclose(float(M.accuracy(logits, label)), 2 / 3,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(M.accuracy(logits, label, k=2)), 1.0)
