@@ -23,8 +23,7 @@ from ..framework.random import next_rng_key
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "calculate_gain",
-]
+    "Assign", "calculate_gain", "Orthogonal", "Dirac"]
 
 
 def calculate_gain(nonlinearity: str, param: Optional[float] = None) -> float:
@@ -163,3 +162,48 @@ class Assign(Initializer):
         if tuple(arr.shape) != tuple(shape):
             raise ValueError(f"Assign shape {arr.shape} != requested {tuple(shape)}")
         return arr
+
+
+class Orthogonal(Initializer):
+    """Reference: paddle.nn.initializer.Orthogonal — (semi-)orthogonal
+    matrix init via QR of a normal draw (rows/cols orthonormal depending
+    on shape), scaled by ``gain``."""
+
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def init(self, key, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal requires >= 2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        flat = (rows, cols) if rows >= cols else (cols, rows)
+        a = jax.random.normal(key, flat, dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        # sign correction for a unique decomposition
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if rows < cols:
+            q = q.T
+        return (self.gain * q).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Reference: paddle.nn.initializer.Dirac — identity-preserving conv
+    kernels ([out, in, *k] with a centered impulse per channel pair)."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def init(self, key, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Dirac requires a conv kernel shape")
+        out_c, in_c = shape[0], shape[1]
+        w = jnp.zeros(shape, dtype)
+        centers = tuple(s // 2 for s in shape[2:])
+        per = out_c // self.groups
+        for o in range(out_c):
+            i = (o % per) % in_c
+            w = w.at[(o, i) + centers].set(1.0)
+        return w

@@ -179,3 +179,20 @@ def test_dropout_under_jit_requires_rng():
     o1 = f(params, jnp.ones((2, 4)), jax.random.key(0))
     o2 = f(params, jnp.ones((2, 4)), jax.random.key(1))
     assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_orthogonal_and_dirac_initializers():
+    import paddle_tpu.nn.initializer as I
+    key = jax.random.PRNGKey(0)
+    w = I.Orthogonal().init(key, (8, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(w.T @ w), np.eye(4), atol=1e-5)
+    w2 = I.Orthogonal(gain=2.0).init(key, (4, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(w2 @ w2.T), 4 * np.eye(4),
+                               atol=1e-4)
+    k = I.Dirac().init(key, (2, 2, 3, 3), jnp.float32)
+    # impulse at kernel center, channel-matched
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 5, 5), jnp.float32)
+    import paddle_tpu.nn.functional as F
+    y = F.conv2d(x, k, padding=1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-5,
+                               atol=1e-5)
