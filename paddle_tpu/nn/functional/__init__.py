@@ -11,3 +11,4 @@ from .input import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 
 from . import activation, common, conv, norm, pooling, loss, input, attention  # noqa: F401
+from .vision import *  # noqa: F401,F403

@@ -1,0 +1,170 @@
+"""Vision/sequence functionals: grid_sample, affine_grid, temporal_shift,
+sequence_mask, gather_tree, npair_loss.
+
+Reference: python/paddle/nn/functional/vision.py (grid_sample/affine_grid
+— the spatial-transformer pair over phi kernels), common.py
+(sequence_mask), extension.py (temporal_shift, gather_tree, npair_loss).
+
+TPU-native: bilinear grid sampling is gather + lerp (vectorized, jits);
+gather_tree is a reverse lax.scan over beam parents.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grid_sample", "affine_grid", "temporal_shift", "sequence_mask",
+           "gather_tree", "npair_loss"]
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True,
+                name=None):
+    """x [N, C, H, W]; grid [N, Ho, Wo, 2] in [-1, 1] (xy order).
+    Returns [N, C, Ho, Wo]."""
+    x = jnp.asarray(x, jnp.float32)
+    grid = jnp.asarray(grid, jnp.float32)
+    N, C, H, W = x.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnorm(grid[..., 0], W)                   # [N, Ho, Wo]
+    gy = unnorm(grid[..., 1], H)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+    elif padding_mode == "reflection":
+        def reflect(c, size):
+            span = 2.0 * (size - 1) if align_corners else 2.0 * size
+            c = jnp.abs(jnp.mod(c, span))
+            return jnp.minimum(c, span - c) if align_corners else \
+                jnp.clip(jnp.minimum(c, span - c) - 0.5, 0, size - 1)
+        gx = reflect(gx, W)
+        gy = reflect(gy, H)
+
+    if mode == "nearest":
+        xi = jnp.clip(jnp.round(gx), 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(jnp.round(gy), 0, H - 1).astype(jnp.int32)
+        out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yi, xi)
+        valid = ((gx >= -0.5) & (gx <= W - 0.5) &
+                 (gy >= -0.5) & (gy <= H - 0.5))
+        if padding_mode == "zeros":
+            out = out * valid[:, None]
+        return out
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx1 = gx - x0
+    wy1 = gy - y0
+
+    def take(img, yy, xx):
+        """img [C,H,W]; integer index maps with zero outside."""
+        inside = ((xx >= 0) & (xx < W) & (yy >= 0) & (yy < H))
+        xs = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        ys = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        v = img[:, ys, xs]                         # [C, Ho, Wo]
+        if padding_mode == "zeros":
+            v = v * inside[None]
+        return v
+
+    def per_image(img, x0, y0, wx1, wy1):
+        v00 = take(img, y0, x0)
+        v01 = take(img, y0, x0 + 1)
+        v10 = take(img, y0 + 1, x0)
+        v11 = take(img, y0 + 1, x0 + 1)
+        wx0 = 1 - wx1
+        wy0 = 1 - wy1
+        return (v00 * (wy0 * wx0)[None] + v01 * (wy0 * wx1)[None]
+                + v10 * (wy1 * wx0)[None] + v11 * (wy1 * wx1)[None])
+
+    return jax.vmap(per_image)(x, x0, y0, wx1, wy1)
+
+
+def affine_grid(theta, out_shape: Sequence[int], align_corners: bool = True,
+                name=None):
+    """theta [N, 2, 3]; out_shape [N, C, H, W] -> grid [N, H, W, 2]."""
+    theta = jnp.asarray(theta, jnp.float32)
+    N, _, H, W = (int(s) for s in out_shape)
+
+    def lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        half = 1.0 - 1.0 / size
+        return jnp.linspace(-half, half, size)
+
+    ys = lin(H)
+    xs = lin(W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")    # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)       # [H, W, 3]
+    return jnp.einsum("nij,hwj->nhwi", theta, base)  # [N, H, W, 2]
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW", name=None):
+    """Reference: TSM temporal shift.  x [N*T, C, H, W]."""
+    x = jnp.asarray(x)
+    NT, C, H, W = x.shape
+    T = seg_num
+    Nb = NT // T
+    v = x.reshape(Nb, T, C, H, W)
+    fold = int(C * shift_ratio)
+    left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])],
+                           axis=1)
+    right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                             v[:, :-1, fold:2 * fold]], axis=1)
+    rest = v[:, :, 2 * fold:]
+    out = jnp.concatenate([left, right, rest], axis=2)
+    return out.reshape(NT, C, H, W)
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="int64",
+                  name=None):
+    """Reference: mask [..., maxlen] with 1 where pos < length."""
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        import numpy as _np
+        maxlen = int(_np.asarray(jax.device_get(jnp.max(lengths))))
+    pos = jnp.arange(maxlen)
+    mask = pos[None, :] < lengths[..., None]
+    return mask.astype(dtype)
+
+
+def gather_tree(ids, parents):
+    """Reference: beam-search finalize — walk parent pointers backward.
+    ids/parents [T, B, beam] -> full sequences [T, B, beam]."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T = ids.shape[0]
+
+    def step(beam_idx, t):
+        # beam_idx [B, beam]: which beam each final hypothesis sits on at
+        # step t+1; walk to step t
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return parent, tok
+
+    last = jnp.broadcast_to(jnp.arange(ids.shape[-1]), ids.shape[1:])
+    _, toks = jax.lax.scan(step, last, jnp.arange(T), reverse=True)
+    return toks
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002, name=None):
+    """Reference: paddle.nn.functional.npair_loss (NIPS16 N-pair loss)."""
+    anchor = jnp.asarray(anchor, jnp.float32)
+    positive = jnp.asarray(positive, jnp.float32)
+    labels = jnp.asarray(labels).reshape(-1)
+    sim = anchor @ positive.T                       # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(same * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor ** 2, 1))
+                    + jnp.mean(jnp.sum(positive ** 2, 1))) / 2.0
+    return ce + reg

@@ -188,3 +188,60 @@ def test_interpolate_nearest():
     assert out.shape == (1, 1, 4, 4)
     np.testing.assert_array_equal(_np(out)[0, 0], np.repeat(
         np.repeat(np.arange(4).reshape(2, 2), 2, 0), 2, 1))
+
+
+def test_grid_sample_identity_and_affine_grid():
+    from paddle_tpu.nn.functional import grid_sample, affine_grid
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 3, 5, 7).astype(np.float32))
+    # identity theta -> identity grid -> identity sampling
+    theta = jnp.broadcast_to(jnp.asarray([[1.0, 0, 0], [0, 1.0, 0]]),
+                             (2, 2, 3))
+    grid = affine_grid(theta, (2, 3, 5, 7), align_corners=True)
+    out = grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-4,
+                               atol=1e-5)
+    # horizontal flip theta
+    flip = jnp.broadcast_to(jnp.asarray([[-1.0, 0, 0], [0, 1.0, 0]]),
+                            (2, 2, 3))
+    out_f = grid_sample(x, affine_grid(flip, (2, 3, 5, 7)))
+    np.testing.assert_allclose(np.asarray(out_f),
+                               np.asarray(x)[:, :, :, ::-1], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sequence_mask_and_temporal_shift():
+    from paddle_tpu.nn.functional import sequence_mask, temporal_shift
+    m = sequence_mask(jnp.asarray([1, 3]), maxlen=4)
+    np.testing.assert_array_equal(np.asarray(m),
+                                  [[1, 0, 0, 0], [1, 1, 1, 0]])
+    x = jnp.asarray(np.arange(2 * 4 * 2 * 1 * 1, dtype=np.float32)
+                    .reshape(8, 2, 1, 1))
+    out = temporal_shift(x, seg_num=4, shift_ratio=0.25)
+    assert out.shape == x.shape
+
+
+def test_gather_tree_walks_parents():
+    from paddle_tpu.nn.functional import gather_tree
+    # T=3, B=1, beam=2; parents define the backward walk
+    ids = jnp.asarray([[[1, 2]], [[3, 4]], [[5, 6]]])
+    parents = jnp.asarray([[[0, 0]], [[0, 0]], [[1, 0]]])
+    out = np.asarray(gather_tree(ids, parents))
+    # final beam 0 at t=2 came from beam 1 at t=1 (parent=1), which came
+    # from beam 0 at t=0
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_npair_loss_positive_and_sane():
+    from paddle_tpu.nn.functional import npair_loss
+    rs = np.random.RandomState(1)
+    a = jnp.asarray(rs.randn(4, 8).astype(np.float32))
+    p = jnp.asarray(rs.randn(4, 8).astype(np.float32))
+    l = jnp.asarray([0, 1, 2, 3])
+    loss = float(npair_loss(a, p, l))
+    assert np.isfinite(loss) and loss > 0
+    # perfectly aligned embeddings with distinct labels -> small ce
+    eye = jnp.eye(4, 8) * 10
+    small = float(npair_loss(eye, eye, l, l2_reg=0.0))
+    assert small < 0.01
