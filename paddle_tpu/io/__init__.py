@@ -6,4 +6,5 @@ from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
 from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler, SubsetRandomSampler)
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
+                         get_worker_info, WorkerInfo)

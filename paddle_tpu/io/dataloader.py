@@ -50,8 +50,28 @@ def default_collate_fn(batch: List[Any]):
     return batch
 
 
+class WorkerInfo:
+    """Reference: paddle.io.get_worker_info() inside DataLoader workers."""
+
+    def __init__(self, id: int, num_workers: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """None in the main process; a WorkerInfo inside worker processes
+    (reference contract — IterableDataset sharding uses it)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, data_queue, ring, collate_fn,
-                 worker_id, worker_init_fn):
+                 worker_id, worker_init_fn, num_workers: int = 0):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -100,7 +120,7 @@ class _MultiProcessIter:
             w = ctx.Process(target=_worker_loop,
                             args=(loader.dataset, iq, self.data_queue,
                                   self.ring, self.collate_fn, wid,
-                                  loader.worker_init_fn),
+                                  loader.worker_init_fn, n),
                             daemon=True)
             w.start()
             self.workers.append(w)

@@ -84,3 +84,26 @@ def test_device_memory_stats_facade():
     assert device.cuda.max_memory_allocated() == device.max_memory_allocated()
     assert not device.is_compiled_with_cuda()
     device.synchronize()
+
+
+def test_get_worker_info_in_workers_and_main():
+    from paddle_tpu.io import DataLoader, get_worker_info
+    from paddle_tpu.io.dataset import Dataset
+
+    assert get_worker_info() is None       # main process
+
+    class Ds(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            wi = get_worker_info()
+            assert wi is not None and wi.num_workers == 2
+            return np.asarray([i, wi.id], np.int64)
+
+    dl = DataLoader(Ds(), batch_size=2, num_workers=2, shuffle=False)
+    batches = list(iter(dl))
+    ids = np.concatenate([b[:, 0] for b in batches])
+    np.testing.assert_array_equal(np.sort(ids), np.arange(8))
+    workers = {int(w) for b in batches for w in b[:, 1]}
+    assert workers <= {0, 1}
