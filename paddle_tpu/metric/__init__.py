@@ -158,7 +158,14 @@ class Auc(Metric):
 
 def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
     """Functional parity: paddle.metric.accuracy — top-k accuracy of
-    ``input`` [N, C] probabilities/logits vs ``label`` [N] or [N, 1]."""
+    ``input`` [N, C] probabilities/logits vs ``label`` [N] or [N, 1].
+
+    The reference's ``correct``/``total`` out-tensors have no functional
+    analog here; passing them raises instead of silently ignoring."""
+    if correct is not None or total is not None:
+        raise ValueError(
+            "metric.accuracy: correct/total out-tensors are not supported "
+            "in the functional TPU port — read the returned accuracy")
     import jax.numpy as jnp
     input = jnp.asarray(input)
     label = jnp.asarray(label).reshape(-1)

@@ -41,10 +41,18 @@ def grid_sample(x, grid, mode: str = "bilinear",
         gy = jnp.clip(gy, 0, H - 1)
     elif padding_mode == "reflection":
         def reflect(c, size):
-            span = 2.0 * (size - 1) if align_corners else 2.0 * size
-            c = jnp.abs(jnp.mod(c, span))
-            return jnp.minimum(c, span - c) if align_corners else \
-                jnp.clip(jnp.minimum(c, span - c) - 0.5, 0, size - 1)
+            if size == 1:
+                return jnp.zeros_like(c)     # single pixel: no span
+            if align_corners:
+                # reflect over [0, size-1]
+                span = 2.0 * (size - 1)
+                c = jnp.abs(jnp.mod(c, span))
+                return jnp.minimum(c, span - c)
+            # reference boundaries are the pixel EDGES [-0.5, size-0.5]
+            span = 2.0 * size
+            c = jnp.mod(c + 0.5, span)
+            c = jnp.minimum(c, span - c) - 0.5
+            return jnp.clip(c, 0, size - 1)
         gx = reflect(gx, W)
         gy = reflect(gy, H)
 
@@ -108,8 +116,14 @@ def affine_grid(theta, out_shape: Sequence[int], align_corners: bool = True,
 
 def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
                    data_format: str = "NCHW", name=None):
-    """Reference: TSM temporal shift.  x [N*T, C, H, W]."""
+    """Reference: TSM temporal shift.  x [N*T, C, H, W] (or NHWC)."""
     x = jnp.asarray(x)
+    if data_format == "NHWC":
+        out = temporal_shift(jnp.transpose(x, (0, 3, 1, 2)), seg_num,
+                             shift_ratio, "NCHW")
+        return jnp.transpose(out, (0, 2, 3, 1))
+    if data_format != "NCHW":
+        raise ValueError(f"bad data_format {data_format!r}")
     NT, C, H, W = x.shape
     T = seg_num
     Nb = NT // T
@@ -165,6 +179,7 @@ def npair_loss(anchor, positive, labels, l2_reg: float = 0.002, name=None):
     same = same / jnp.sum(same, axis=1, keepdims=True)
     logp = jax.nn.log_softmax(sim, axis=1)
     ce = -jnp.mean(jnp.sum(same * logp, axis=1))
-    reg = l2_reg * (jnp.mean(jnp.sum(anchor ** 2, 1))
-                    + jnp.mean(jnp.sum(positive ** 2, 1))) / 2.0
+    # reference Beta = 0.25 on the summed squared norms
+    reg = 0.25 * l2_reg * (jnp.mean(jnp.sum(anchor ** 2, 1))
+                           + jnp.mean(jnp.sum(positive ** 2, 1)))
     return ce + reg

@@ -203,7 +203,10 @@ class Dirac(Initializer):
         w = jnp.zeros(shape, dtype)
         centers = tuple(s // 2 for s in shape[2:])
         per = out_c // self.groups
-        for o in range(out_c):
-            i = (o % per) % in_c
-            w = w.at[(o, i) + centers].set(1.0)
+        # reference semantics: within each group, only the first
+        # min(per, in_c) out-channels carry an impulse (channel-matched);
+        # the rest stay zero — never duplicate input channels
+        for g in range(self.groups):
+            for d in range(min(per, in_c)):
+                w = w.at[(g * per + d, d) + centers].set(1.0)
         return w

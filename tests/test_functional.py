@@ -245,3 +245,23 @@ def test_npair_loss_positive_and_sane():
     eye = jnp.eye(4, 8) * 10
     small = float(npair_loss(eye, eye, l, l2_reg=0.0))
     assert small < 0.01
+
+
+def test_review_fixes_dirac_npair_reflection():
+    import paddle_tpu.nn.initializer as I
+    from paddle_tpu.nn.functional import npair_loss, grid_sample
+    key = jax.random.PRNGKey(1)
+    # Dirac with out_c > in_c: extra channels stay ZERO (no duplication)
+    k = I.Dirac().init(key, (4, 2, 3, 3), jnp.float32)
+    np.testing.assert_allclose(np.asarray(k[2:]), 0.0)
+    assert float(k[0, 0, 1, 1]) == 1.0 and float(k[1, 1, 1, 1]) == 1.0
+    # npair reg uses Beta=0.25
+    a = jnp.eye(2, 4)
+    got = float(npair_loss(a, a, jnp.asarray([0, 1]), l2_reg=1.0))
+    base = float(npair_loss(a, a, jnp.asarray([0, 1]), l2_reg=0.0))
+    np.testing.assert_allclose(got - base, 0.25 * 2.0, rtol=1e-5)
+    # reflection with a size-1 dim must not NaN
+    x = jnp.ones((1, 1, 1, 4))
+    g = jnp.zeros((1, 1, 4, 2)).at[..., 1].set(-1.5)
+    out = grid_sample(x, g, padding_mode="reflection", align_corners=True)
+    assert np.isfinite(np.asarray(out)).all()

@@ -20,7 +20,8 @@ def test_record_event_and_chrome_trace(tmp_path):
     from paddle_tpu.profiler import (Profiler, RecordEvent,
                                      export_chrome_tracing, make_scheduler)
     prof = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=3),
-                    on_trace_ready=export_chrome_tracing(str(tmp_path)))
+                    on_trace_ready=export_chrome_tracing(str(tmp_path)),
+                    trace_dir=str(tmp_path), timer_only=True)
     prof.start()
     for _ in range(3):
         with RecordEvent("my_step"):
@@ -39,7 +40,7 @@ def test_profiler_summary_runs(capsys):
     from paddle_tpu.profiler import Profiler, RecordEvent
     prof = Profiler(scheduler=lambda step: __import__(
         "paddle_tpu.profiler.profiler", fromlist=["ProfilerState"]
-    ).ProfilerState.RECORD)
+    ).ProfilerState.RECORD, timer_only=True)
     prof.start()
     with RecordEvent("work"):
         pass
