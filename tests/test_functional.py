@@ -248,6 +248,7 @@ def test_npair_loss_positive_and_sane():
 
 
 def test_review_fixes_dirac_npair_reflection():
+    import jax
     import paddle_tpu.nn.initializer as I
     from paddle_tpu.nn.functional import npair_loss, grid_sample
     key = jax.random.PRNGKey(1)
