@@ -1,0 +1,150 @@
+"""paddle.nn.utils parity — parameter vector round-trips, weight_norm /
+spectral_norm reparameterizations, clip_grad_norm_ / clip_grad_value_.
+
+Reference: python/paddle/nn/utils/ — transform_parameters.py
+(parameters_to_vector / vector_to_parameters), weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_norm_.py.
+
+TPU-native notes: clipping is FUNCTIONAL (returns the clipped pytree — a
+jit-safe value; the reference mutates .grad in place, which has no analog
+here).  weight_norm/spectral_norm recompute the effective weight in a
+forward pre-hook, exactly like the reference's hook mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer
+
+__all__ = ["parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list (or dict values) into one 1-D vector."""
+    if isinstance(parameters, dict):
+        parameters = list(parameters.values())
+    return jnp.concatenate([jnp.reshape(p, (-1,)) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Split ``vec`` back into arrays shaped like ``parameters``; returns
+    the new list (functional — the reference copies in place)."""
+    if isinstance(parameters, dict):
+        keys = list(parameters)
+        vals = vector_to_parameters(vec, list(parameters.values()))
+        return dict(zip(keys, vals))
+    out: List = []
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        out.append(jnp.reshape(vec[offset:offset + n], p.shape)
+                   .astype(p.dtype))
+        offset += n
+    return out
+
+
+def clip_grad_norm_(grads, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """Returns (clipped_grads, total_norm).  Functional form of the
+    reference's in-place grad clipping."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in leaves])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite:
+        import numpy as _np
+        if not bool(_np.isfinite(jax.device_get(total))):
+            raise RuntimeError("non-finite grad norm")
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree.map(lambda g: g * scale, grads), total
+
+
+def clip_grad_value_(grads, clip_value: float):
+    """Elementwise clamp to [-clip_value, clip_value] (functional)."""
+    return jax.tree.map(lambda g: jnp.clip(g, -clip_value, clip_value),
+                        grads)
+
+
+def _norm_except(w, dim: int):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize ``layer.name`` as g * v/||v|| (reference
+    weight_norm): v and g become the parameters; the effective weight is
+    recomputed in a forward pre-hook."""
+    w = layer._parameters[name]
+    g0 = _norm_except(w, dim)
+    del layer._parameters[name]
+    layer._parameters[name + "_v"] = w
+    layer._parameters[name + "_g"] = g0
+
+    def pre_hook(lyr, inputs):
+        v = lyr._parameters[name + "_v"]
+        g = lyr._parameters[name + "_g"]
+        n = _norm_except(v, dim)
+        object.__setattr__(lyr, "_wn_cached", True)
+        lyr._parameters[name] = g * v / jnp.maximum(n, 1e-12)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer.__dict__["_weight_norm_handle"] = (handle, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold the reparameterization back into a single weight."""
+    handle, nm, dim = layer.__dict__.pop("_weight_norm_handle")
+    handle.remove() if hasattr(handle, "remove") else None
+    v = layer._parameters.pop(nm + "_v")
+    g = layer._parameters.pop(nm + "_g")
+    n = _norm_except(v, dim)
+    layer._parameters[nm] = g * v / jnp.maximum(n, 1e-12)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0) -> Layer:
+    """Reference spectral_norm: weight / sigma_max, sigma estimated by
+    power iteration on buffers u/v updated per forward."""
+    w = layer._parameters[name]
+    h = w.shape[dim]
+    rest = int(np.prod(w.shape)) // h
+    key = jax.random.PRNGKey(0)
+    layer.register_buffer(name + "_u",
+                          jax.random.normal(key, (h,)), persistable=True)
+    layer.register_buffer(name + "_v",
+                          jax.random.normal(jax.random.fold_in(key, 1),
+                                            (rest,)), persistable=True)
+    del layer._parameters[name]
+    layer._parameters[name + "_orig"] = w
+
+    def pre_hook(lyr, inputs):
+        w0 = lyr._parameters[name + "_orig"]
+        wm = jnp.moveaxis(w0, dim, 0).reshape(h, rest)
+        u = lyr._buffers[name + "_u"]
+        v = lyr._buffers[name + "_v"]
+        for _ in range(n_power_iterations):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wm @ v
+        lyr._buffers[name + "_u"] = jax.lax.stop_gradient(u)
+        lyr._buffers[name + "_v"] = jax.lax.stop_gradient(v)
+        lyr._parameters[name] = w0 / jnp.maximum(sigma, eps)
+        return inputs
+
+    layer.register_forward_pre_hook(pre_hook)
+    return layer

@@ -1,0 +1,64 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/ — parameter vector
+transforms, weight/spectral norm hooks, grad clipping)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.utils import (parameters_to_vector, vector_to_parameters,
+                                 clip_grad_norm_, clip_grad_value_,
+                                 weight_norm, remove_weight_norm,
+                                 spectral_norm)
+
+
+def test_parameter_vector_roundtrip():
+    ps = [jnp.ones((2, 3)), jnp.arange(4.0)]
+    v = parameters_to_vector(ps)
+    assert v.shape == (10,)
+    back = vector_to_parameters(v, ps)
+    for a, b in zip(back, ps):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_clip_grad_norm_and_value():
+    g = {"a": jnp.asarray([3.0, 4.0])}
+    clipped, total = clip_grad_norm_(g, max_norm=1.0)
+    np.testing.assert_allclose(float(total), 5.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(clipped["a"]),
+                               [0.6, 0.8], rtol=1e-4)
+    cv = clip_grad_value_({"a": jnp.asarray([-2.0, 0.5])}, 1.0)
+    np.testing.assert_allclose(np.asarray(cv["a"]), [-1.0, 0.5])
+
+
+def test_weight_norm_preserves_function_and_removes():
+    paddle_tpu.seed(0)
+    lin = nn.Linear(4, 3)
+    w0 = np.asarray(lin.weight)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4), jnp.float32)
+    y0 = np.asarray(lin(x))
+    weight_norm(lin, name="weight", dim=0)
+    assert "weight_v" in lin._parameters and "weight_g" in lin._parameters
+    y1 = np.asarray(lin(x))
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-6)
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin.weight), w0, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lin(x)), y0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle_tpu.seed(1)
+    lin = nn.Linear(6, 6)
+    # scale the weight up so sigma >> 1
+    lin._parameters["weight"] = lin.weight * 10.0
+    spectral_norm(lin, name="weight", n_power_iterations=5)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6), jnp.float32)
+    for _ in range(5):
+        lin(x)                       # power iterations refine u/v
+    w_eff = np.asarray(lin._parameters["weight"])
+    s = np.linalg.svd(w_eff, compute_uv=False)
+    assert s.max() < 1.2             # spectral norm ~1
