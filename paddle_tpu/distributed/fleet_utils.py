@@ -223,3 +223,8 @@ def load_auto_resume(state_dict, ckpt_dir: str, prefix: str = "step_"):
         return state_dict, None
     step = int(os.path.basename(path)[len(prefix):])
     return load_state_dict(state_dict, path), step
+
+
+# reference path: paddle.distributed.fleet.utils.recompute
+from .recompute import recompute, recompute_sequential  # noqa: F401,E402
+__all__ += ["recompute", "recompute_sequential"]
