@@ -16,6 +16,7 @@ from typing import List, Optional
 import jax.numpy as jnp
 
 __all__ = ["LRScheduler", "NoamDecay", "StepDecay", "MultiStepDecay",
+           "ConstantLR", "LinearLR", "CyclicLR",
            "ExponentialDecay", "NaturalExpDecay", "InverseTimeDecay",
            "PolynomialDecay", "LinearWarmup", "CosineAnnealingDecay",
            "LambdaDecay", "PiecewiseDecay", "OneCycleLR", "ReduceOnPlateau",
@@ -316,3 +317,76 @@ class ReduceOnPlateau(LRScheduler):
                 self.current_lr = max(self.current_lr * self.factor, self.min_lr)
                 self.cooldown_counter = self.cooldown
                 self.num_bad = 0
+
+
+class ConstantLR(LRScheduler):
+    """Reference: lr * factor for the first total_steps, then lr."""
+
+    def __init__(self, learning_rate: float, factor: float = 1.0 / 3,
+                 total_steps: int = 5, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.factor = factor
+        self.total_steps = total_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.int32)
+        return jnp.where(s < self.total_steps,
+                         self.base_lr * self.factor, self.base_lr)
+
+
+class LinearLR(LRScheduler):
+    """Reference: linearly interpolate lr*start_factor -> lr*end_factor
+    over total_steps."""
+
+    def __init__(self, learning_rate: float, total_steps: int,
+                 start_factor: float = 1.0 / 3, end_factor: float = 1.0,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.clip(jnp.asarray(step, jnp.float32), 0, self.total_steps)
+        frac = s / self.total_steps
+        factor = self.start_factor + (self.end_factor -
+                                      self.start_factor) * frac
+        return self.base_lr * factor
+
+
+class CyclicLR(LRScheduler):
+    """Reference: triangular cyclic lr between base_learning_rate and
+    max_learning_rate (modes: triangular, triangular2, exp_range)."""
+
+    def __init__(self, base_learning_rate: float, max_learning_rate: float,
+                 step_size_up: int, step_size_down: int = None,
+                 mode: str = "triangular", exp_gamma: float = 1.0,
+                 scale_fn=None, scale_mode: str = "cycle",
+                 last_epoch: int = -1, verbose: bool = False):
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down if step_size_down is not None \
+            else step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        total = self.up + self.down
+        cycle = jnp.floor(s / total)
+        pos = s - cycle * total
+        frac = jnp.where(pos < self.up, pos / self.up,
+                         1.0 - (pos - self.up) / self.down)
+        amp = self.max_lr - self.base_lr
+        if self.scale_fn is not None:
+            x = cycle + 1 if self.scale_mode == "cycle" else s
+            amp = amp * self.scale_fn(x)
+        elif self.mode == "triangular2":
+            amp = amp / jnp.power(2.0, cycle)
+        elif self.mode == "exp_range":
+            amp = amp * jnp.power(self.exp_gamma, s)
+        return self.base_lr + amp * frac

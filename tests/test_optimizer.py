@@ -159,3 +159,20 @@ def test_eager_step_binding():
     o.step(g)
     np.testing.assert_allclose(np.asarray(model.weight), w_before - 0.1,
                                rtol=1e-6)
+
+
+def test_constant_linear_cyclic_lr():
+    from paddle_tpu.optimizer.lr import ConstantLR, LinearLR, CyclicLR
+    c = ConstantLR(0.3, factor=1 / 3, total_steps=4)
+    np.testing.assert_allclose(float(c.lr_at(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(c.lr_at(4)), 0.3, rtol=1e-6)
+    l = LinearLR(0.4, total_steps=4, start_factor=0.5, end_factor=1.0)
+    np.testing.assert_allclose(float(l.lr_at(0)), 0.2, rtol=1e-6)
+    np.testing.assert_allclose(float(l.lr_at(2)), 0.3, rtol=1e-6)
+    np.testing.assert_allclose(float(l.lr_at(10)), 0.4, rtol=1e-6)
+    cy = CyclicLR(0.1, 0.5, step_size_up=4)
+    np.testing.assert_allclose(float(cy.lr_at(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(cy.lr_at(4)), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(cy.lr_at(8)), 0.1, rtol=1e-6)
+    cy2 = CyclicLR(0.1, 0.5, step_size_up=4, mode="triangular2")
+    np.testing.assert_allclose(float(cy2.lr_at(12)), 0.3, rtol=1e-6)
