@@ -113,6 +113,10 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name == "flops":
+        from .hapi.flops import flops
+        globals()["flops"] = flops
+        return flops
     if name == "Model":  # paddle.Model parity
         from .hapi import Model
         globals()["Model"] = Model

@@ -1,0 +1,84 @@
+"""paddle.flops parity — static FLOPs estimate for a Layer.
+
+Reference: python/paddle/hapi/dynamic_flops.py — per-layer-type handlers
+driven by forward hooks capturing io shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["flops"]
+
+
+def _numel(shape) -> int:
+    return int(np.prod(shape))
+
+
+def _layer_flops(layer, in_shape, out_shape) -> int:
+    name = type(layer).__name__
+    if name == "Linear":
+        w = layer.weight
+        return 2 * _numel(out_shape[:-1]) * w.shape[0] * w.shape[1]
+    if name.startswith("Conv"):
+        w = layer.weight                       # [O, I/groups, *k]
+        return 2 * _numel(out_shape) * _numel(w.shape[1:])
+    if name in ("BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+                "LayerNorm", "GroupNorm", "InstanceNorm2D", "RMSNorm"):
+        return 2 * _numel(in_shape)
+    if name in ("ReLU", "GELU", "Sigmoid", "Tanh", "SiLU", "LeakyReLU",
+                "Softmax"):
+        return _numel(in_shape)
+    if name.endswith("Pool1D") or name.endswith("Pool2D") or \
+            name.endswith("Pool3D"):
+        return _numel(out_shape)
+    return 0
+
+
+def flops(net, input_size: Sequence[int], custom_ops: Optional[dict] = None,
+          print_detail: bool = False) -> int:
+    """Total multiply-add FLOPs of ``net`` on ``input_size`` (reference:
+    paddle.flops).  Leaf layers are measured via forward hooks; unknown
+    types contribute 0 (custom_ops: {LayerCls: fn(layer, in, out) -> int}
+    overrides, like the reference)."""
+    records = []
+    handles = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, outputs):
+            in_shape = tuple(jnp.asarray(inputs[0]).shape) if inputs else ()
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            out_shape = tuple(jnp.asarray(out).shape)
+            if custom_ops and type(lyr) in custom_ops:
+                n = int(custom_ops[type(lyr)](lyr, in_shape, out_shape))
+            else:
+                n = _layer_flops(lyr, in_shape, out_shape)
+            records.append((type(lyr).__name__, in_shape, out_shape, n))
+
+        return hook
+
+    for _, sub in net.named_sublayers(include_self=False):
+        if not any(True for _ in sub.named_sublayers()):   # leaves only
+            handles.append(sub.register_forward_post_hook(make_hook(sub)))
+    was_training = net.training
+    net.eval()
+    try:
+        x = jnp.zeros(tuple(input_size), jnp.float32)
+        net(x)
+    finally:
+        for h in handles:
+            if hasattr(h, "remove"):
+                h.remove()
+        if was_training:
+            net.train()
+    total = sum(r[3] for r in records)
+    if print_detail:
+        for name, i, o, n in records:
+            print(f"{name:: <20} in={i} out={o} flops={n:,}")
+    print(f"Total Flops: {total}     Total Params: "
+          f"{sum(int(np.prod(p.shape)) for p in net.parameters())}")
+    return total

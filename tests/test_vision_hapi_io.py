@@ -173,3 +173,14 @@ def test_metric_accuracy_functional():
     np.testing.assert_allclose(float(M.accuracy(logits, label)), 2 / 3,
                                rtol=1e-6)
     np.testing.assert_allclose(float(M.accuracy(logits, label, k=2)), 1.0)
+
+
+def test_flops_counts_linear_and_conv(capsys):
+    import paddle_tpu
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 4 * 4, 10))
+    total = paddle_tpu.flops(net, (1, 3, 4, 4))
+    # conv: 2*out_numel*(3*3*3) = 2*(8*4*4)*27 = 6912; relu: 128;
+    # linear: 2*1*128*10 = 2560
+    assert total == 6912 + 128 + 2560, total
+    assert "Total Flops" in capsys.readouterr().out
