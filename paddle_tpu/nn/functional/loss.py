@@ -18,7 +18,9 @@ __all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss",
            "binary_cross_entropy_with_logits", "smooth_l1_loss", "kl_div",
            "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
            "ctc_loss", "sigmoid_focal_loss", "square_error_cost",
-           "log_loss", "triplet_margin_loss"]
+           "log_loss", "triplet_margin_loss",
+           "dice_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
+           "gaussian_nll_loss", "poisson_nll_loss"]
 
 
 def _reduce(loss, reduction: str):
@@ -222,3 +224,70 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
                  ).astype(jnp.float32)
     loss = optax.ctc_loss(logits, logit_pad, labels, label_pad, blank_id=blank)
     return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None):
+    """Reference: F.dice_loss — input [N, ..., C] probabilities, label
+    [N, ..., 1] int class ids; 1 - dice coefficient per batch row."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label)
+    C = input.shape[-1]
+    one_hot = jax.nn.one_hot(label[..., 0], C, dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one_hot, axis=red)
+    union = jnp.sum(input, axis=red) + jnp.sum(one_hot, axis=red)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None):
+    """Reference: log(1 + exp(-label * input)), label in {-1, 1}."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    out = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(out, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean", name=None):
+    """Reference: mean over classes of BCE-with-logits vs multi-hot label."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    logsig = jax.nn.log_sigmoid
+    per = -(label * logsig(input) + (1 - label) * logsig(-input))
+    if weight is not None:
+        per = per * jnp.asarray(weight, input.dtype)
+    out = jnp.mean(per, axis=-1)
+    return _reduce(out, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean",
+                      name=None):
+    """Reference: 0.5*(log(var) + (x-mu)^2/var) (+ const when full)."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    var = jnp.maximum(jnp.asarray(variance, input.dtype), epsilon)
+    out = 0.5 * (jnp.log(var) + (label - input) ** 2 / var)
+    if full:
+        import math as _m
+        out = out + 0.5 * _m.log(2 * _m.pi)
+    return _reduce(out, reduction)
+
+
+def poisson_nll_loss(input, label, log_input: bool = True,
+                     full: bool = False, epsilon: float = 1e-8,
+                     reduction: str = "mean", name=None):
+    """Reference: exp(x) - y*x (log_input) or x - y*log(x+eps)."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    if log_input:
+        out = jnp.exp(input) - label * input
+    else:
+        out = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (label > 1)
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2 * jnp.pi * label))
+        out = out + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(out, reduction)

@@ -266,3 +266,36 @@ def test_review_fixes_dirac_npair_reflection():
     g = jnp.zeros((1, 1, 4, 2)).at[..., 1].set(-1.5)
     out = grid_sample(x, g, padding_mode="reflection", align_corners=True)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_additional_losses_oracles():
+    import math
+    from paddle_tpu.nn import functional as F
+    rs = np.random.RandomState(3)
+    # soft margin
+    x = jnp.asarray([0.5, -1.0])
+    y = jnp.asarray([1.0, -1.0])
+    ref = np.mean(np.log1p(np.exp(-np.asarray(y) * np.asarray(x))))
+    np.testing.assert_allclose(float(F.soft_margin_loss(x, y)), ref,
+                               rtol=1e-6)
+    # gaussian nll
+    g = float(F.gaussian_nll_loss(jnp.asarray([1.0]), jnp.asarray([2.0]),
+                                  jnp.asarray([4.0])))
+    np.testing.assert_allclose(g, 0.5 * (math.log(4.0) + 1.0 / 4.0),
+                               rtol=1e-6)
+    # poisson nll (log input)
+    pl = float(F.poisson_nll_loss(jnp.asarray([0.0]), jnp.asarray([2.0])))
+    np.testing.assert_allclose(pl, 1.0 - 0.0, rtol=1e-6)
+    # dice on a perfect prediction -> ~0
+    probs = jnp.asarray([[[0.0, 1.0], [1.0, 0.0]]])    # [1, 2, C=2]
+    lbl = jnp.asarray([[[1], [0]]])
+    assert float(F.dice_loss(probs, lbl)) < 1e-4
+    # multi-label soft margin matches manual bce mean
+    inp = jnp.asarray([[0.2, -0.4]])
+    tgt = jnp.asarray([[1.0, 0.0]])
+    import jax as _j
+    manual = -np.mean(np.asarray(tgt) * np.asarray(_j.nn.log_sigmoid(inp))
+                      + (1 - np.asarray(tgt)) *
+                      np.asarray(_j.nn.log_sigmoid(-inp)))
+    np.testing.assert_allclose(
+        float(F.multi_label_soft_margin_loss(inp, tgt)), manual, rtol=1e-5)
