@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from ...framework.random import next_rng_key
 
-__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "feature_alpha_dropout",
            "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
            "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
            "label_smooth", "unfold", "fold", "zeropad2d"]
@@ -86,6 +86,22 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
     key = next_rng_key()
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Reference: alpha dropout over whole CHANNELS ([N, C, ...] — one
+    draw per (n, c), SELU-compatible statistics like alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = next_rng_key()
+    mask_shape = x.shape[:2] + (1,) * (x.ndim - 2)
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
     a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
     b = -a * alpha_p * p
     return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)

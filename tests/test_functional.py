@@ -299,3 +299,16 @@ def test_additional_losses_oracles():
                       np.asarray(_j.nn.log_sigmoid(-inp)))
     np.testing.assert_allclose(
         float(F.multi_label_soft_margin_loss(inp, tgt)), manual, rtol=1e-5)
+
+
+def test_feature_alpha_dropout_channelwise():
+    import paddle_tpu
+    from paddle_tpu.nn import functional as F
+    paddle_tpu.seed(0)
+    x = jnp.ones((2, 8, 4, 4))
+    out = F.feature_alpha_dropout(x, p=0.5, training=True)
+    # whole channels share one fate: each [n, c] slice is constant
+    o = np.asarray(out)
+    per_channel_std = o.reshape(2, 8, -1).std(axis=-1)
+    np.testing.assert_allclose(per_channel_std, 0.0, atol=1e-6)
+    assert F.feature_alpha_dropout(x, p=0.5, training=False) is x
