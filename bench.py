@@ -31,37 +31,56 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # bf16 peak per chip
 PEAK_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12}
 
-PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+# total wall budget for TPU acquisition (round-2 VERDICT item 1a: adaptive
+# retry loop with backoff instead of a fixed 2-attempt probe)
+PROBE_BUDGET_S = int(os.environ.get("BENCH_PROBE_BUDGET", "600"))
 
 
 def _probe_tpu():
     """Check the TPU backend comes up, in a subprocess with a timeout.
 
     Returns (platform, None) on success or (None, diagnostic) on failure.
-    The subprocess also runs one tiny matmul so a backend that initializes
-    but cannot compile is caught here, not mid-bench.
+    The subprocess runs a tiny matmul AND a device->host transfer: on the
+    axon path jax.devices() — and even dispatch — can succeed while the
+    execution leg is wedged, so only a value read proves the chip works.
+    Retries with backoff until PROBE_BUDGET_S is spent.
     """
     code = ("import jax, jax.numpy as jnp;"
             "d = jax.devices();"
             "x = jnp.ones((128, 128), jnp.bfloat16);"
-            "(x @ x).block_until_ready();"
+            "v = float((x @ x)[0, 0]);"
             "print('PLATFORM=' + d[0].platform)")
     err = "unknown"
-    for attempt in range(2):
+    t_start = time.time()
+    attempt = 0
+    backoff = 20
+    while True:
+        attempt += 1
+        left = PROBE_BUDGET_S - (time.time() - t_start)
+        if left <= 5:
+            return None, err + f" (budget {PROBE_BUDGET_S}s exhausted, " \
+                               f"{attempt - 1} attempts)"
+        eff_timeout = min(PROBE_TIMEOUT_S, left)
         try:
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
-                               timeout=PROBE_TIMEOUT_S)
+                               timeout=eff_timeout)
         except subprocess.TimeoutExpired:
-            err = (f"attempt {attempt + 1}: backend init/compile exceeded "
-                   f"{PROBE_TIMEOUT_S}s (chip contended/stale?)")
-            continue
-        for line in r.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1], None
-        err = f"attempt {attempt + 1}: rc={r.returncode}: " + \
-            r.stderr.strip()[-400:]
-    return None, err
+            err = (f"attempt {attempt}: backend init/exec exceeded "
+                   f"{eff_timeout:.0f}s (chip contended/stale?)")
+        else:
+            for line in r.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1], None
+            err = f"attempt {attempt}: rc={r.returncode}: " + \
+                r.stderr.strip()[-400:]
+        left = PROBE_BUDGET_S - (time.time() - t_start)
+        if left <= 5:
+            return None, err + f" (budget {PROBE_BUDGET_S}s exhausted, " \
+                               f"{attempt} attempts)"
+        time.sleep(min(backoff, left))
+        backoff = min(backoff * 2, 120)
 
 
 def _emit(payload):
@@ -175,15 +194,35 @@ def _run_bench(on_tpu, tpu_diag=None):
             extras["kernels"] = _kernel_compare()
         except Exception as e:
             extras["kernels"] = {"error": str(e)[-300:]}
-    if on_tpu and os.environ.get("BENCH_FULL", "0") == "1":
+    if os.environ.get("BENCH_FULL", "1") == "1":
         # secondary BASELINE configs (#1 resnet, #2 transformer, #4 llama,
-        # #5 moe) — opt-in: they add compile time to the driver run
+        # #5 moe) — default-on since round 3 (VERDICT r2 item 2); on the
+        # CPU fallback they run at smoke scale so *some* number exists
+        # every round
         try:
-            extras["secondary"] = _secondary_benches()
+            extras["secondary"] = _secondary_benches(smoke=not on_tpu)
         except Exception as e:
             extras["secondary"] = {"error": str(e)[-300:]}
     if tpu_diag:
         extras["tpu_probe_error"] = tpu_diag
+    # durable hardware evidence captured earlier in the session (written by
+    # scripts/tpu_evidence_bench.py the moment the chip was reachable) —
+    # referenced here so a late-round tunnel wedge cannot erase the proof
+    ev_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_TPU_EVIDENCE.json")
+    if os.path.exists(ev_path):
+        try:
+            with open(ev_path) as f:
+                ev = json.load(f)
+            extras["tpu_evidence"] = {
+                "file": "BENCH_TPU_EVIDENCE.json",
+                "status": ev.get("status"),
+                "mfu": ev.get("mfu"),
+                "tokens_per_sec_per_chip": ev.get("tokens_per_sec_per_chip"),
+                "n_params": ev.get("config", {}).get("n_params"),
+            }
+        except Exception:
+            pass
     _emit({
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -266,9 +305,11 @@ def _kernel_compare():
     return res
 
 
-def _secondary_benches():
-    """BASELINE configs #1/#2/#4/#5 at single-chip scale: steady-state
-    step time + items/sec each (host-transfer-synced)."""
+def _secondary_benches(smoke=False):
+    """BASELINE configs #1/#2/#4/#5: steady-state step time + items/sec
+    each (host-transfer-synced).  ``smoke=True`` (CPU fallback) shrinks
+    every config so the whole set stays inside the driver's patience while
+    still exercising the real model/training graph."""
     import functools
     import jax
     import jax.numpy as jnp
@@ -276,16 +317,25 @@ def _secondary_benches():
     import paddle_tpu.optimizer as opt
     from paddle_tpu.nn.functional_call import functional_call, state
 
-    def train_tput(model, batch_args, loss_fn, items_per_step, iters=8):
+    budget_s = float(os.environ.get("BENCH_SECONDARY_BUDGET",
+                                    "120" if smoke else "420"))
+    t_start = time.perf_counter()
+
+    def over_budget():
+        return time.perf_counter() - t_start > budget_s
+
+    def train_tput(model, batch_args, loss_fn, items_per_step,
+                   iters=2 if smoke else 8):
         params, buffers = state(model)
         o = opt.AdamW(learning_rate=1e-4)
         ostate = o.init(params)
+        key = jax.random.PRNGKey(0)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(p, os_):
             def lf(p):
                 out, nb = functional_call(model, p, buffers, batch_args,
-                                          train=True)
+                                          rng=key, train=True)
                 return loss_fn(out, nb)
             l, g = jax.value_and_grad(lf)(p)
             newp, nos = o.update(g, os_, p)
@@ -302,48 +352,71 @@ def _secondary_benches():
                 "items_per_sec": round(items_per_step / dt, 1)}
 
     rs = np.random.RandomState(0)
-    out = {}
+    out = {"scale": "smoke_cpu" if smoke else "single_chip"}
 
-    # 1 ResNet50 (img/sec)
+    # 1 ResNet50 (img/sec) — smoke keeps resnet50 (the BASELINE model) but
+    # shrinks batch/resolution
     from paddle_tpu.vision.models import resnet50
-    img = jnp.asarray(rs.randn(16, 3, 224, 224), jnp.float32)
-    lbl = jnp.asarray(rs.randint(0, 1000, (16,)))
+    rb, rres = (2, 64) if smoke else (16, 224)
+    img = jnp.asarray(rs.randn(rb, 3, rres, rres), jnp.float32)
+    lbl = jnp.asarray(rs.randint(0, 1000, (rb,)))
     import paddle_tpu.nn.functional as F
     out["resnet50"] = train_tput(
-        resnet50(), (img,), lambda o, nb: F.cross_entropy(o, lbl), 16)
+        resnet50(), (img,), lambda o, nb: F.cross_entropy(o, lbl), rb)
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
 
     # 2 nn.Transformer encoder-decoder (tokens/sec)
     import paddle_tpu.nn as nn
-    tr = nn.Transformer(d_model=256, nhead=8, num_encoder_layers=3,
-                        num_decoder_layers=3, dim_feedforward=1024)
-    src = jnp.asarray(rs.randn(8, 128, 256), jnp.float32)
-    tgt = jnp.asarray(rs.randn(8, 128, 256), jnp.float32)
+    td, tb, ts = (128, 2, 64) if smoke else (256, 8, 128)
+    tr = nn.Transformer(d_model=td, nhead=8, num_encoder_layers=3,
+                        num_decoder_layers=3, dim_feedforward=4 * td)
+    src = jnp.asarray(rs.randn(tb, ts, td), jnp.float32)
+    tgt = jnp.asarray(rs.randn(tb, ts, td), jnp.float32)
     out["transformer"] = train_tput(
-        tr, (src, tgt), lambda o, nb: jnp.mean(o ** 2), 8 * 128)
+        tr, (src, tgt), lambda o, nb: jnp.mean(o ** 2), tb * ts)
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
 
     # 4 Llama (tokens/sec, bf16 remat)
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    lcfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                       intermediate_size=2816, num_layers=8, num_heads=16,
-                       max_seq_len=1024, dtype="bfloat16", remat=True)
+    if smoke:
+        lcfg = LlamaConfig(vocab_size=2048, hidden_size=128,
+                           intermediate_size=352, num_layers=2, num_heads=4,
+                           max_seq_len=128, remat=False)
+        lb, ls = 2, 128
+    else:
+        lcfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                           intermediate_size=2816, num_layers=8,
+                           num_heads=16, max_seq_len=1024,
+                           dtype="bfloat16", remat=True)
+        lb, ls = 4, 1024
     lm = LlamaForCausalLM(lcfg)
-    lm.to(dtype="bfloat16")
-    ids = jnp.asarray(rs.randint(0, 32000, (4, 1025)))
+    if not smoke:
+        lm.to(dtype="bfloat16")
+    ids = jnp.asarray(rs.randint(0, lcfg.vocab_size, (lb, ls + 1)))
     x, y = ids[:, :-1], ids[:, 1:]
 
     def llama_loss(logits, nb):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
 
-    out["llama"] = train_tput(lm, (x,), llama_loss, 4 * 1024)
+    out["llama"] = train_tput(lm, (x,), llama_loss, lb * ls)
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
 
     # 5 GPT-MoE (tokens/sec)
     from paddle_tpu.models import GPTMoEForCausalLM, GPTMoEConfig
-    mcfg = GPTMoEConfig(vocab_size=32000, hidden_size=512, num_layers=4,
-                        num_heads=8, max_seq_len=512, num_experts=8,
-                        gate="naive")
+    mv, mh, ml, ms, mb = (2048, 128, 2, 128, 2) if smoke else \
+        (32000, 512, 4, 512, 8)
+    mcfg = GPTMoEConfig(vocab_size=mv, hidden_size=mh, num_layers=ml,
+                        num_heads=8 if not smoke else 4, max_seq_len=ms,
+                        num_experts=8, gate="naive")
     mm = GPTMoEForCausalLM(mcfg)
-    mids = jnp.asarray(rs.randint(0, 32000, (8, 513)))
+    mids = jnp.asarray(rs.randint(0, mv, (mb, ms + 1)))
     mx, my = mids[:, :-1], mids[:, 1:]
 
     def moe_loss(logits, nb):
@@ -352,7 +425,7 @@ def _secondary_benches():
         return GPTMoEForCausalLM.loss_from_logits(logits, my, nb,
                                                   mcfg.aux_weight)
 
-    out["gpt_moe"] = train_tput(mm, (mx,), moe_loss, 8 * 512)
+    out["gpt_moe"] = train_tput(mm, (mx,), moe_loss, mb * ms)
     return out
 
 

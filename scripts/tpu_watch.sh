@@ -1,0 +1,43 @@
+#!/bin/bash
+# Background TPU watchdog for the round: probe the chip with a hard timeout;
+# the moment it is reachable, run the evidence bench and commit the raw
+# artifact (VERDICT r2 item 1: evidence must be durable the moment the chip
+# is up).  Probes are subprocesses with timeouts because axon backend init
+# can hang indefinitely on a contended/stale chip, and jax.devices() can
+# return while the execution leg is wedged — the probe includes a matmul
+# plus a host transfer.
+cd /root/repo || exit 1
+LOG=/tmp/tpu_watch.log
+PROBE=/tmp/tpu_watch_probe.py
+cat > $PROBE <<'PYEOF'
+import time, jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+v = float((x @ x)[0, 0])
+print(f"PROBE_OK platform={d[0].platform} val={v}")
+PYEOF
+DEADLINE=$(( $(date +%s) + 11*3600 ))
+ATTEMPT=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  ATTEMPT=$((ATTEMPT+1))
+  echo "$(date -u +%H:%M:%S) probe attempt $ATTEMPT" >> $LOG
+  if timeout 150 python $PROBE >> $LOG 2>&1; then
+    echo "$(date -u +%H:%M:%S) chip ALIVE -> evidence bench" >> $LOG
+    EVIDENCE_BUDGET_S=1200 timeout 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1
+    ST=$(python -c "import json;print(json.load(open('BENCH_TPU_EVIDENCE.json')).get('status','?'))" 2>/dev/null)
+    echo "$(date -u +%H:%M:%S) evidence status=$ST" >> $LOG
+    if [ "$ST" = "done" ] || [ "$ST" = "bench_done" ]; then
+      git add BENCH_TPU_EVIDENCE.json
+      git commit -m "On-chip bench evidence: raw per-iteration timings, loss series, kernel-compare table" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
+      echo "$(date -u +%H:%M:%S) evidence committed; watchdog exiting" >> $LOG
+      exit 0
+    fi
+    # partial/failed: commit whatever evidence exists, keep trying
+    if [ -f BENCH_TPU_EVIDENCE.json ]; then
+      git add BENCH_TPU_EVIDENCE.json
+      git commit -m "Partial on-chip bench evidence (run interrupted; see status field)" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
+    fi
+  fi
+  sleep 420
+done
+echo "$(date -u +%H:%M:%S) deadline reached without full evidence" >> $LOG
