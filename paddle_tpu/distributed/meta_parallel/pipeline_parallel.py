@@ -61,13 +61,18 @@ class PipelineParallel(MetaParallelBase):
         None otherwise.  local_key = '{layer_idx_in_chunk}.{param_name}'."""
         model = self._layers
         S, V = self.num_stages, self.num_chunks
-        if S <= 1 or not model.stages_uniform() or model._shared_layers:
+        if S <= 1:
             return None
+        if not model.stages_uniform():
+            return self._downgrade("stages are not structurally uniform")
+        if model._shared_layers:
+            return self._downgrade("model uses SharedLayerDesc layers")
         try:
             if self.mesh.shape.get("pp") != S:
-                return None
+                return self._downgrade(
+                    f"mesh pp axis != pp degree {S}")
         except Exception:
-            return None
+            return self._downgrade("no mesh with a pp axis in scope")
         maps = []
         for c in range(S * V):
             lo = model.segment_parts[c]
@@ -78,14 +83,27 @@ class PipelineParallel(MetaParallelBase):
                     # fused run_chunk freezes buffers (run with buffers=None
                     # and returned unchanged) — a BatchNorm-style stage must
                     # take the sequential path, which threads them
-                    return None
+                    return self._downgrade(
+                        f"stage layer {type(layer).__name__} carries "
+                        f"buffers (e.g. BatchNorm running stats)")
                 for pname, _ in layer.named_parameters():
                     m[f"{j}.{pname}"] = f"run_function.{lo + j}.{pname}"
             maps.append(m)
         keys0 = set(maps[0])
         if any(set(m) != keys0 for m in maps[1:]):
-            return None
+            return self._downgrade("chunks differ in parameter structure")
         return maps
+
+    @staticmethod
+    def _downgrade(reason):
+        """The model quietly losing tick-level pipelining is a perf cliff
+        worth a loud signal (round-2 review)."""
+        import warnings
+        warnings.warn(
+            f"PipelineParallel: falling back to the sequential microbatch "
+            f"schedule (correct, but no tick-level overlap): {reason}",
+            RuntimeWarning, stacklevel=4)
+        return None
 
     # -- functional program builders ------------------------------------
     def build_train_step(self, optimizer, loss_fn=None):

@@ -53,6 +53,24 @@ def stage_param_specs(stacked_params, extra_spec: Optional[dict] = None):
     return {k: spec_for(k) for k in stacked_params}
 
 
+def _boundary_constrain(mesh, x, spec):
+    """Pin a value's layout on the non-pp (automatic) mesh axes right at the
+    shard_map boundary.  Inside the partial-manual shard_map only ``pp`` may
+    appear in in/out_specs; the automatic axes' sharding is whatever layout
+    the operand ENTERS with — so honoring a caller-provided spec means
+    constraining here, outside, not in in_specs."""
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except Exception as e:
+        # a dropped constraint silently reintroduces the replicated-batch/
+        # weights cliff this parameter exists to prevent — warn, don't hide
+        import warnings
+        warnings.warn(f"pipeline boundary constraint {spec} not applied "
+                      f"({e}); value enters the schedule with its incoming "
+                      f"layout", RuntimeWarning, stacklevel=3)
+        return x
+
+
 def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
                    mesh: Mesh, n_stages: int, extra_args=(),
                    remat: bool = True, x_spec: Optional[P] = None,
@@ -66,8 +84,16 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
 
     Works on any mesh containing a ``pp`` axis; other axes stay 'auto' so
     tp/dp shardings inside stage_fn keep working (GSPMD handles them).
+    ``x_spec`` / ``param_inner_specs`` (full PartitionSpecs including any
+    dp/mp axes) pin the boundary layout on those automatic axes so GSPMD
+    does not reshard entering the schedule.
+
+    Output collection: every stage's tick outputs are returned pp-stacked
+    (out_specs ``P('pp')``) and the caller-side slice takes the last
+    stage's row — ONE gather of the M valid outputs at the end instead of a
+    per-tick ``psum`` broadcast of activation-sized garbage (round-2 review:
+    the per-tick psum cost T all-reduces of which only M carried data).
     """
-    from jax.sharding import AxisType
     from jax import shard_map
 
     M = x_microbatches.shape[0]
@@ -75,14 +101,20 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     T = M + S - 1
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
+    if x_spec is not None:
+        x_microbatches = _boundary_constrain(mesh, x_microbatches, x_spec)
+    if param_inner_specs is not None:
+        stacked_params = {
+            k: _boundary_constrain(mesh, v, param_inner_specs[k])
+            if k in param_inner_specs else v
+            for k, v in stacked_params.items()}
+
     # specs: with axis_names={"pp"} only the manual axis may appear in
     # in/out_specs — stacked params carry pp on dim 0, everything else is
     # None; the auto axes' sharding (mp/dp/...) rides on the arrays and is
     # still handled by GSPMD inside the body.
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
     in_x_spec = P()
-
-    other_axes = tuple(a for a in mesh.axis_names if a != "pp")
 
     def pipelined(params, xs):
         # inside shard_map over pp each device holds its stage's slice of the
@@ -99,12 +131,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
                                                   keepdims=False)
             x_in = jnp.where(stage_id == 0, inject, state)
             y = body(local_params, x_in, *extra_args)
-            # collect last stage's output (valid when t >= S-1)
-            out = jnp.where(stage_id == S - 1, y, jnp.zeros_like(y))
             # rotate: stage s -> s+1 (last stage's send wraps to 0, ignored)
             perm = [(i, (i + 1) % S) for i in range(S)]
             nxt = jax.lax.ppermute(y, "pp", perm)
-            return nxt, out
+            # collect the local y — the caller slices out the last stage's
+            # row, so no masking/zeroing or per-tick broadcast is needed
+            return nxt, y
 
         # initial carry: zeros with the OUTPUT shape of a stage (the body
         # must preserve activation shape — true for transformer blocks)
@@ -112,20 +144,20 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         init = jnp.zeros(out_shape.shape, out_shape.dtype)
 
         _, outs = jax.lax.scan(tick, init, jnp.arange(T))
-        # outs: [T, mb, ...]; valid outputs at ticks S-1 .. T-1 are
-        # microbatches 0..M-1 — psum over pp makes them visible everywhere
-        outs = jax.lax.psum(outs, "pp")
-        return jax.lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+        return outs[None]  # [1, T, mb, ...] local -> [S, T, ...] stacked
 
     # axis_names={"pp"}: only pp is manual; tp/dp/sp axes stay automatic so
     # GSPMD keeps partitioning the math inside the stage body
     fn = shard_map(
         pipelined, mesh=mesh,
         in_specs=(param_specs, in_x_spec),
-        out_specs=in_x_spec,
+        out_specs=P("pp"),
         check_vma=False,
         axis_names={"pp"})
-    return fn(stacked_params, x_microbatches)
+    res = fn(stacked_params, x_microbatches)      # [S, T, mb, ...]
+    last = jax.lax.index_in_dim(res, S - 1, axis=0, keepdims=False)
+    # valid outputs at ticks S-1 .. T-1 are microbatches 0..M-1
+    return jax.lax.dynamic_slice_in_dim(last, S - 1, M, axis=0)
 
 
 def stack_interleaved_stage_params(per_chunk_params: list, n_stages: int,
@@ -147,7 +179,9 @@ def stack_interleaved_stage_params(per_chunk_params: list, n_stages: int,
 def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
                                x_microbatches, mesh: Mesh, n_stages: int,
                                n_chunks: int, extra_args=(),
-                               remat: bool = True):
+                               remat: bool = True,
+                               x_spec: Optional[P] = None,
+                               param_inner_specs: Optional[dict] = None):
     """Interleaved (VPP) schedule: S devices × V chunks per device
     (reference: meta_parallel/pipeline_parallel.py —
     PipelineParallelWithInterleave; SURVEY.md §2.3 PP row).
@@ -168,6 +202,11 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     non-interleaved schedule's, which is the point of VPP.
 
     Requires M % S == 0 (reference imposes the same for interleave).
+
+    ``x_spec`` / ``param_inner_specs`` pin the boundary layout on the
+    automatic (non-pp) mesh axes, exactly as in ``pipeline_apply`` — without
+    them a dp/mp-partitioned caller would see its batch and tp weights
+    replicated through the schedule (round-2 advisor finding).
     """
     from jax import shard_map
 
@@ -179,6 +218,13 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
                          f"divisible by pp degree ({S})")
     T = M * V + S - 1
     body = jax.checkpoint(stage_fn) if remat else stage_fn
+    if x_spec is not None:
+        x_microbatches = _boundary_constrain(mesh, x_microbatches, x_spec)
+    if param_inner_specs is not None:
+        stacked_params = {
+            k: _boundary_constrain(mesh, v, param_inner_specs[k])
+            if k in param_inner_specs else v
+            for k, v in stacked_params.items()}
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
 
     def pipelined(params, xs):
@@ -200,30 +246,30 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
             take_fresh = jnp.logical_and(stage_id == 0, n % (S * V) < S)
             x_in = jnp.where(take_fresh, inject, state)
             y = body(chunk_params, x_in, *extra_args)
-            # stage-(S-1) chunk-(V-1) slots are final outputs
-            emit = jnp.logical_and(stage_id == S - 1,
-                                   n % (S * V) >= S * (V - 1))
-            out = jnp.where(emit, y, jnp.zeros_like(y))
             perm = [(i, (i + 1) % S) for i in range(S)]
             nxt = jax.lax.ppermute(y, "pp", perm)
-            return nxt, out
+            # collect local y; the caller slices the last stage's row at the
+            # exact emit ticks (stage-(S-1) chunk-(V-1) slots), so no
+            # masking or per-tick psum broadcast is needed
+            return nxt, y
 
         chunk_shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), params)
         out_shape = jax.eval_shape(body, chunk_shapes, xs[0], *extra_args)
         init = jnp.zeros(out_shape.shape, out_shape.dtype)
         _, outs = jax.lax.scan(tick, init, jnp.arange(T))
-        outs = jax.lax.psum(outs, "pp")             # [T, mb, ...]
-        # microbatch m finishes at tick (m//S)*S*V + (V-1)*S + m%S + S-1
-        import numpy as _np
-        ms = _np.arange(M)
-        ticks = (ms // S) * S * V + (V - 1) * S + ms % S + S - 1
-        return jnp.take(outs, jnp.asarray(ticks), axis=0)
+        return outs[None]  # [1, T, mb, ...] local -> [S, T, ...] stacked
 
     fn = shard_map(
         pipelined, mesh=mesh,
         in_specs=(param_specs, P()),
-        out_specs=P(),
+        out_specs=P("pp"),
         check_vma=False,
         axis_names={"pp"})
-    return fn(stacked_params, x_microbatches)
+    res = fn(stacked_params, x_microbatches)        # [S, T, mb, ...]
+    last = jax.lax.index_in_dim(res, S - 1, axis=0, keepdims=False)
+    # microbatch m finishes at tick (m//S)*S*V + (V-1)*S + m%S + S-1
+    import numpy as _np
+    ms = _np.arange(M)
+    ticks = (ms // S) * S * V + (V - 1) * S + ms % S + S - 1
+    return jnp.take(last, jnp.asarray(ticks), axis=0)

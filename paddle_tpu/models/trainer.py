@@ -67,6 +67,28 @@ class GPTHybridTrainer:
         params, _ = state(self.model)
         specs = get_param_specs(self.model)
         L = self.cfg.num_layers
+        # Stage-assign the embedding/head the SPMD way (reference:
+        # meta_parallel/pp_layers.py — SharedLayerDesc ties wte between the
+        # first and last stage and allreduces its grad between them).  In
+        # the one-program schedule "ownership" is sharding: the vocab (and
+        # position) tables extend their row sharding over the pp axis, so
+        # each pipeline stage holds 1/S of the table instead of a full
+        # replica, and the tied-weight grad merge (embed use + head use)
+        # falls out of AD + GSPMD as exactly the reference's allreduce.
+        self._vocab_axes = "mp"
+        if self.S > 1:
+            self._vocab_axes = ("mp", "pp")
+            for k in ("gpt.wte.weight", "gpt.wpe.weight"):
+                if k in specs:
+                    old = tuple(specs[k])  # P(mp, None) from the embedding
+                    d0 = old[0] if old else None
+                    if d0 is None:
+                        d0 = "pp"
+                    elif isinstance(d0, tuple):
+                        d0 = d0 + ("pp",)
+                    else:
+                        d0 = (d0, "pp")
+                    specs[k] = P(d0, *old[1:])
         self.block_names = []   # suffix names within a block
         nonblock, blocks0 = {}, {}
         for k, v in params.items():
@@ -181,8 +203,11 @@ class GPTHybridTrainer:
         w = pnb.get("gpt.ln_f.weight")
         b = pnb.get("gpt.ln_f.bias")
         x = F.layer_norm(x, cfg.hidden_size, w, b, cfg.layer_norm_eps)
+        # tied head: second use of the wte table (grads from both uses are
+        # summed by AD — SharedLayerDesc semantics); logits stay sharded on
+        # vocab over mp AND pp so no stage materializes the full [b,s,V]
         logits = jnp.einsum("bsh,vh->bsv", x, pnb["gpt.wte.weight"])
-        return _maybe_constraint(logits, P(None, None, "mp"))
+        return _maybe_constraint(logits, P(None, None, self._vocab_axes))
 
     def _block_apply(self, blk_params, x):
         out, _ = functional_call(self.template_block, blk_params, {}, (x,),
@@ -209,7 +234,9 @@ class GPTHybridTrainer:
                     pipeline_apply_interleaved
                 out = pipeline_apply_interleaved(
                     self._body, pblk, mb, self.mesh, self.S, self.V,
-                    remat=cfg.remat)
+                    remat=cfg.remat,
+                    x_spec=P(None, self.batch_spec()[0]),
+                    param_inner_specs=self.specs_blocks)
             else:
                 out = pipeline_apply(self._body, pblk, mb, self.mesh, self.S,
                                      remat=cfg.remat,
@@ -223,7 +250,8 @@ class GPTHybridTrainer:
                 return body(bp, carry), None
             x, _ = jax.lax.scan(one, x, pblk)
         logits = self._final(pnb, x)
-        per_tok = parallel_cross_entropy(logits, labels)
+        per_tok = parallel_cross_entropy(logits, labels,
+                                         mp_axis=self._vocab_axes)
         return jnp.mean(per_tok)
 
     def build_step(self):

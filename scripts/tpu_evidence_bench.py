@@ -168,8 +168,14 @@ def main():
             break
 
     # block timing: one closing sync over the whole block (the headline —
-    # allows host/device overlap like a real training loop)
-    n_block = min(iters, len(per_iter_ms))
+    # allows host/device overlap like a real training loop).  Sized to fit
+    # the remaining wall budget (measured per-iter pace + margin) so the
+    # process exits cleanly instead of being SIGTERM'd by the watchdog's
+    # outer timeout (which can re-wedge the chip claim).
+    avg_s = max(sum(per_iter_ms) / len(per_iter_ms) / 1e3, 1e-3) \
+        if per_iter_ms else 1.0
+    n_block = min(iters, len(per_iter_ms),
+                  max(1, int((remaining() - 60) / avg_s)))
     t0 = time.perf_counter()
     for _ in range(n_block):
         params, ostate, loss = step(params, ostate, x, y)

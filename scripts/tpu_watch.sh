@@ -27,9 +27,16 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     ST=$(python -c "import json;print(json.load(open('BENCH_TPU_EVIDENCE.json')).get('status','?'))" 2>/dev/null)
     echo "$(date -u +%H:%M:%S) evidence status=$ST" >> $LOG
     if [ "$ST" = "done" ] || [ "$ST" = "bench_done" ]; then
-      git add BENCH_TPU_EVIDENCE.json
-      git commit -m "On-chip bench evidence: raw per-iteration timings, loss series, kernel-compare table" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
-      echo "$(date -u +%H:%M:%S) evidence committed; watchdog exiting" >> $LOG
+      # the main session may transiently hold .git/index.lock — retry
+      for i in 1 2 3 4 5 6; do
+        if git commit -m "On-chip bench evidence: raw per-iteration timings, loss series, kernel-compare table" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1; then
+          echo "$(date -u +%H:%M:%S) evidence committed; watchdog exiting" >> $LOG
+          exit 0
+        fi
+        echo "$(date -u +%H:%M:%S) commit attempt $i failed, retrying" >> $LOG
+        sleep 30
+      done
+      echo "$(date -u +%H:%M:%S) evidence READY but commit failed 6x; file is on disk" >> $LOG
       exit 0
     fi
     # partial/failed: commit whatever evidence exists, keep trying
