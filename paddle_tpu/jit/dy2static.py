@@ -1,0 +1,614 @@
+"""Dynamic-to-static conversion of Python control flow (dy2static).
+
+Reference: python/paddle/jit/dy2static/program_translator.py —
+ProgramTranslator rewrites the function's AST so that ``if``/``while``
+over tensor values become framework control-flow ops
+(convert_operators.py — convert_ifelse / convert_while_loop), dispatching
+at RUNTIME between the Python branch (plain bool) and the graph branch
+(tensor predicate).
+
+TPU-native: the same two-layer architecture, retargeted at XLA's traced
+control flow —
+
+  * an AST transformer rewrites ``if`` / ``while`` / ``for i in range``
+    statements into calls to the runtime ops below, hoisting each branch
+    or loop body into a local function over the variables it modifies;
+  * the runtime ops check whether the predicate is a JAX tracer: concrete
+    values run ordinary Python (zero overhead, exact Python semantics,
+    short-circuit preserved), traced values lower to ``lax.cond`` /
+    ``lax.while_loop`` — the compiler-friendly control flow XLA requires
+    (SURVEY.md §7: no data-dependent Python branching inside jit).
+
+Supported subset (documented; the reference converts a larger one):
+  * ``if``/``elif``/``else`` over tensor predicates, including ``and`` /
+    ``or`` / ``not`` in the condition (short-circuit kept on the Python
+    path) and the both-branches-return pattern;
+  * ``while`` over tensor predicates (loop-carried variables are the
+    names assigned in the body — their shape/dtype must be loop
+    invariant, the usual ``lax.while_loop`` contract);
+  * ``for <i> in range(...)`` with traced bounds (rewritten to a while);
+  * arbitrary nesting of the above.
+
+NOT converted — left as plain Python, which stays correct for concrete
+values and raises a clear error if the predicate is traced:
+  * loops containing ``break``/``continue`` (the reference converts these
+    via flag rewriting; here the loop raises at trace time with guidance
+    to use ``lax``/masking directly);
+  * ``return`` inside only one branch of a data-dependent ``if``;
+  * ``for x in <tensor>`` needs no conversion (static trip count —
+    tracing unrolls it).
+
+Functions whose source is unavailable (C extensions, REPL) pass through
+unconverted — tracing alone already handles tensor-free control flow.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_to_static", "convert_if", "convert_while",
+           "Dy2StaticError"]
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+class _Undefined:
+    """Placeholder for a name not bound before a converted statement
+    (reference: dy2static's UndefinedVar).  Registered as a ZERO-LEAF
+    pytree so it can ride through lax.cond/while_loop operands untouched:
+    a variable first bound inside both branches enters as Undefined and
+    leaves as an array; one bound in only one branch produces a branch
+    structure mismatch, which we diagnose into a clear error."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"<undefined '{self.name}'>"
+
+
+jax.tree_util.register_pytree_node(
+    _Undefined, lambda u: ((), u.name),
+    lambda name, _children: _Undefined(name))
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _contains_tracer(tree) -> bool:
+    return any(_is_tracer(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _diagnose_undefined(outs_a, outs_b, names, what, cause):
+    """If per-variable outputs differ in Undefined-ness between two
+    evaluations, raise the specific 'may be undefined' error."""
+    for i, n in enumerate(names or ()):
+        try:
+            ua = isinstance(outs_a[i], _Undefined)
+            ub = isinstance(outs_b[i], _Undefined)
+        except Exception:
+            return
+        if ua != ub:
+            raise Dy2StaticError(
+                f"variable '{n}' may be undefined after this {what}: it is "
+                f"bound on only one path; bind it before the "
+                f"tensor-dependent statement") from cause
+
+
+# ---------------------------------------------------------------------------
+# runtime ops (the convert_operators.py equivalents)
+# ---------------------------------------------------------------------------
+
+def convert_if(pred, true_fn, false_fn, args=(), names=()):
+    """Dispatch an ``if``: tensor predicate -> lax.cond, else Python."""
+    if _is_tracer(pred):
+        try:
+            return jax.lax.cond(pred, true_fn, false_fn, *args)
+        except (TypeError, ValueError) as e:
+            try:
+                ot = jax.eval_shape(true_fn, *args)
+                of = jax.eval_shape(false_fn, *args)
+            except Exception:
+                ot = of = None
+            if ot is not None:
+                _diagnose_undefined(ot, of, names, "if", e)
+            raise Dy2StaticError(
+                f"branches of a tensor-dependent if must produce matching "
+                f"shapes/dtypes for {tuple(names)}: {e}") from e
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def convert_while(cond_fn, body_fn, init=(), names=()):
+    """Dispatch a ``while``: traced condition -> lax.while_loop."""
+    first = cond_fn(*init)
+    if _is_tracer(first) or _contains_tracer(init):
+        try:
+            return jax.lax.while_loop(lambda vs: cond_fn(*vs),
+                                      lambda vs: body_fn(*vs), tuple(init))
+        except (TypeError, ValueError) as e:
+            try:
+                out = jax.eval_shape(lambda vs: body_fn(*vs), tuple(init))
+            except Exception:
+                out = None
+            if out is not None:
+                _diagnose_undefined(tuple(init), out, names,
+                                    "while (first bound inside the loop "
+                                    "body)", e)
+            raise Dy2StaticError(
+                f"loop-carried variables {tuple(names)} of a "
+                f"tensor-dependent while must keep stable shapes/dtypes "
+                f"across iterations: {e}") from e
+    vals = tuple(init)
+    while cond_fn(*vals):
+        vals = tuple(body_fn(*vals))
+    return vals
+
+
+def convert_and(first, second_fn):
+    """``a and b`` with short-circuit on the Python path."""
+    if _is_tracer(first):
+        return jnp.logical_and(first, second_fn())
+    return first and second_fn()
+
+
+def convert_or(first, second_fn):
+    if _is_tracer(first):
+        return jnp.logical_or(first, second_fn())
+    return first or second_fn()
+
+
+def convert_not(x):
+    return jnp.logical_not(x) if _is_tracer(x) else (not x)
+
+
+def py_only(value, reason):
+    """Guard for constructs the converter intentionally leaves in Python:
+    raises a clear error if the value turns out to be traced."""
+    if _is_tracer(value):
+        raise Dy2StaticError(
+            f"this control flow stays in Python ({reason}) but its "
+            f"condition is a traced tensor; rewrite with paddle_tpu.static"
+            f".nn.cond/while_loop or restructure to the supported subset")
+    return value
+
+
+def range_cond(i, stop, step):
+    """Continuation test for a for-range rewritten as while (sign-aware)."""
+    if _is_tracer(i) or _is_tracer(stop) or _is_tracer(step):
+        return jnp.where(step > 0, i < stop, i > stop)
+    return i < stop if step > 0 else i > stop
+
+
+_JST = types.SimpleNamespace(
+    convert_if=convert_if, convert_while=convert_while,
+    convert_and=convert_and, convert_or=convert_or, convert_not=convert_not,
+    py_only=py_only, range_cond=range_cond, Undefined=_Undefined)
+
+
+# ---------------------------------------------------------------------------
+# AST analysis helpers
+# ---------------------------------------------------------------------------
+
+_GEN = "__dy2s"
+
+
+def _assigned_names(nodes) -> list:
+    """Names bound by a list of statements, in first-appearance order.
+    Skips nested function/class scopes and generated helper defs."""
+    out = []
+
+    def add(name):
+        if name.startswith(_GEN):
+            return
+        if name not in out:
+            out.append(name)
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+
+    def walk(stmts):
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    collect_target(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                collect_target(node.target)
+            elif isinstance(node, ast.For):
+                collect_target(node.target)
+                walk(node.body)
+                walk(node.orelse)
+                continue
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        collect_target(item.optional_vars)
+            # descend into compound statements
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(node, field, None)
+                if sub:
+                    walk([s for s in sub if isinstance(s, ast.stmt)])
+            if isinstance(node, ast.Try):
+                for h in node.handlers:
+                    walk(h.body)
+    walk(nodes)
+    return out
+
+
+def _loaded_names(node) -> set:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _walk_same_scope(nodes):
+    """Walk statements without descending into nested function/class
+    scopes (whose returns/breaks belong to themselves — including the
+    helper functions generated by inner conversions)."""
+    scopes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+              ast.ClassDef)
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, scopes):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _has_stmt(nodes, kinds) -> bool:
+    return any(isinstance(sub, kinds) for sub in _walk_same_scope(nodes))
+
+
+def _has_loop_jump(body) -> bool:
+    """break/continue belonging to THIS loop (not nested loops)."""
+    for node in body:
+        for sub in _walk_same_scope([node]):
+            if isinstance(sub, (ast.Break, ast.Continue)):
+                # belongs to a nested loop?
+                if not _enclosed_in_loop(node, sub):
+                    return True
+    return False
+
+
+def _enclosed_in_loop(root, target) -> bool:
+    """True if target sits inside a loop that is itself inside root."""
+    found = [False]
+
+    def visit(node, in_loop):
+        if node is target:
+            found[0] = found[0] or in_loop
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_loop or isinstance(node, (ast.For, ast.While)))
+    visit(root, False)
+    return found[0]
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self, func_assigned: set):
+        self.func_assigned = func_assigned  # every name bound in the fn
+        self.counter = 0
+
+    def _name(self, kind):
+        self.counter += 1
+        return f"{_GEN}_{kind}_{self.counter}"
+
+    # -- conditions: and/or/not get runtime dispatch --------------------
+    def _convert_cond_expr(self, test: ast.expr) -> ast.expr:
+        if isinstance(test, ast.BoolOp):
+            op = "convert_and" if isinstance(test.op, ast.And) else \
+                "convert_or"
+            expr = self._convert_cond_expr(test.values[0])
+            for v in test.values[1:]:
+                expr = ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id=_GEN + "_jst", ctx=ast.Load()),
+                        attr=op, ctx=ast.Load()),
+                    args=[expr,
+                          ast.Lambda(
+                              args=ast.arguments(
+                                  posonlyargs=[], args=[], kwonlyargs=[],
+                                  kw_defaults=[], defaults=[]),
+                              body=self._convert_cond_expr(v))],
+                    keywords=[])
+            return expr
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_GEN + "_jst", ctx=ast.Load()),
+                    attr="convert_not", ctx=ast.Load()),
+                args=[self._convert_cond_expr(test.operand)], keywords=[])
+        return test
+
+    def _jst(self, attr):
+        return ast.Attribute(value=ast.Name(id=_GEN + "_jst", ctx=ast.Load()),
+                             attr=attr, ctx=ast.Load())
+
+    def _py_only_wrap(self, test, reason):
+        return ast.Call(func=self._jst("py_only"),
+                        args=[test, ast.Constant(reason)], keywords=[])
+
+    def _undef_preamble(self, names):
+        """try: v\nexcept NameError: v = Undefined('v') for each name."""
+        stmts = []
+        for n in names:
+            stmts.append(ast.Try(
+                body=[ast.Expr(ast.Name(id=n, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()), name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=n, ctx=ast.Store())],
+                        value=ast.Call(func=self._jst("Undefined"),
+                                       args=[ast.Constant(n)],
+                                       keywords=[]))])],
+                orelse=[], finalbody=[]))
+        return stmts
+
+    def _make_fn(self, name, argnames, body, returns):
+        """def name(argnames): body; return (returns,)"""
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in returns],
+            ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=a) for a in argnames],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=(body or [ast.Pass()]) + [ret],
+            decorator_list=[])
+
+    # -- If -------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        test = self._convert_cond_expr(node.test)
+
+        has_ret_t = _has_stmt(node.body, ast.Return)
+        has_ret_f = _has_stmt(node.orelse, ast.Return)
+        if has_ret_t or has_ret_f:
+            # supported pattern: BOTH branches end in a Return and contain
+            # no other returns
+            def tail_return_only(stmts):
+                return (stmts and isinstance(stmts[-1], ast.Return)
+                        and not _has_stmt(stmts[:-1], ast.Return))
+            if tail_return_only(node.body) and tail_return_only(node.orelse):
+                tname, fname = self._name("true"), self._name("false")
+                t_fn = ast.FunctionDef(
+                    name=tname,
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=node.body, decorator_list=[])
+                f_fn = ast.FunctionDef(
+                    name=fname,
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=node.orelse, decorator_list=[])
+                call = ast.Call(func=self._jst("convert_if"),
+                                args=[test,
+                                      ast.Name(id=tname, ctx=ast.Load()),
+                                      ast.Name(id=fname, ctx=ast.Load())],
+                                keywords=[])
+                return [t_fn, f_fn, ast.Return(value=call)]
+            # unsupported return shape: stay Python, guard the predicate
+            node.test = self._py_only_wrap(
+                test, "return inside only one branch of this if")
+            return node
+
+        modified = _assigned_names(node.body + node.orelse)
+        if not modified:
+            # pure side-effect-free-on-locals branch (e.g. list.append):
+            # python semantics; guard against traced predicates
+            node.test = self._py_only_wrap(
+                test, "branch assigns no local variables")
+            return node
+
+        tname, fname = self._name("true"), self._name("false")
+        t_fn = self._make_fn(tname, modified, node.body, modified)
+        f_fn = self._make_fn(fname, modified, node.orelse, modified)
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in modified],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=self._jst("convert_if"),
+                args=[test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                      for n in modified], ctx=ast.Load()),
+                      ast.Constant(tuple(modified))],
+                keywords=[]))
+        return self._undef_preamble(modified) + [t_fn, f_fn, assign]
+
+    # -- While ----------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        return self._convert_while_node(node)
+
+    def _convert_while_node(self, node: ast.While):
+        """Core while conversion; ``node``'s children must already be
+        transformed (visit_For builds a synthetic, pre-transformed While
+        and calls this directly to avoid double-visiting)."""
+        test = self._convert_cond_expr(node.test)
+        if node.orelse:
+            node.test = self._py_only_wrap(test, "while/else not converted")
+            return node
+        if _has_loop_jump(node.body):
+            node.test = self._py_only_wrap(
+                test, "loop contains break/continue")
+            return node
+        if _has_stmt(node.body, ast.Return):
+            node.test = self._py_only_wrap(
+                test, "return inside loop body not converted")
+            return node
+
+        body_assigned = _assigned_names(node.body)
+        cond_reads = [n for n in sorted(_loaded_names(node.test))
+                      if n in self.func_assigned]
+        loop_vars = body_assigned + [n for n in cond_reads
+                                     if n not in body_assigned]
+        if not loop_vars:
+            node.test = self._py_only_wrap(
+                test, "loop carries no local variables")
+            return node
+
+        cname, bname = self._name("cond"), self._name("body")
+        c_fn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=a) for a in loop_vars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=test)], decorator_list=[])
+        b_fn = self._make_fn(bname, loop_vars, node.body, loop_vars)
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=self._jst("convert_while"),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                      for n in loop_vars], ctx=ast.Load()),
+                      ast.Constant(tuple(loop_vars))],
+                keywords=[]))
+        return self._undef_preamble(loop_vars) + [c_fn, b_fn, assign]
+
+    # -- For over range(...) --------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if not is_range or node.orelse or _has_loop_jump(node.body) or \
+                _has_stmt(node.body, ast.Return):
+            return node  # plain python (tracing unrolls static iterables)
+        a = node.iter.args
+        if len(a) == 1:
+            start, stop, step = ast.Constant(0), a[0], ast.Constant(1)
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], ast.Constant(1)
+        else:
+            start, stop, step = a
+        ivar = node.target.id
+        svar, evar = self._name("stop"), self._name("step")
+        init = [ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                           value=start),
+                ast.Assign(targets=[ast.Name(id=svar, ctx=ast.Store())],
+                           value=stop),
+                ast.Assign(targets=[ast.Name(id=evar, ctx=ast.Store())],
+                           value=step)]
+        # while range_cond(i, stop, step): <body>; i = i + step
+        self.func_assigned.update({ivar, svar, evar})
+        w = ast.While(
+            test=ast.Call(func=self._jst("range_cond"),
+                          args=[ast.Name(id=ivar, ctx=ast.Load()),
+                                ast.Name(id=svar, ctx=ast.Load()),
+                                ast.Name(id=evar, ctx=ast.Load())],
+                          keywords=[]),
+            body=node.body + [ast.Assign(
+                targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                value=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                                op=ast.Add(),
+                                right=ast.Name(id=evar, ctx=ast.Load())))],
+            orelse=[])
+        converted = self._convert_while_node(w)
+        return init + (converted if isinstance(converted, list)
+                       else [converted])
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+_CACHE: dict = {}
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert ``fn``'s tensor-dependent control flow; returns a new
+    function (or ``fn`` itself when conversion is impossible/unneeded).
+
+    Free variables are captured by value at conversion time (the reference
+    rebinds closures the same way when rebuilding the function)."""
+    if fn in _CACHE:
+        return _CACHE[fn]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _has_stmt(fdef.body, (ast.If, ast.While, ast.For, ast.BoolOp)):
+        _CACHE[fn] = fn
+        return fn
+
+    fdef.decorator_list = []  # don't re-apply @to_static & co
+    arg_names = {a.arg for a in fdef.args.args + fdef.args.kwonlyargs}
+    if fdef.args.vararg:
+        arg_names.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        arg_names.add(fdef.args.kwarg.arg)
+    func_assigned = set(_assigned_names(fdef.body)) | arg_names
+    _Transformer(func_assigned).visit(fdef)
+    ast.fix_missing_locations(tree)
+
+    freevars = fn.__code__.co_freevars
+    factory_name = _GEN + "_factory"
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                              ctx=ast.Load()))],
+        decorator_list=[])
+    mod = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(mod)
+
+    # execute against the function's LIVE module globals (plus one
+    # stable injected name) — a snapshot copy would silently diverge if
+    # the module later rebinds a helper the converted body references
+    glb = fn.__globals__
+    glb[_GEN + "_jst"] = _JST
+    code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)
+    cells = [c.cell_contents for c in (fn.__closure__ or ())]
+    new_fn = ns[factory_name](*cells)
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__wrapped_dy2static__ = fn
+    _CACHE[fn] = new_fn
+    return new_fn

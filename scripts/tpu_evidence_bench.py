@@ -208,15 +208,23 @@ def main():
     return 0
 
 
-def _kernel_compare(budget_s):
+def _kernel_compare(budget_s, seq=2048):
     """Pallas vs XLA-default on-chip: flash fwd/bwd, decode attn, fused
-    AdamW, fused RMSNorm (SURVEY §7 step 5: prove kernel necessity)."""
+    AdamW, fused RMSNorm (SURVEY §7 step 5: prove kernel necessity).
+
+    ``seq`` sizes the attention compare; the driver bench passes 1024 —
+    the dense-XLA bwd at s2048 can compile for minutes on the
+    remote-compile path and would starve the driver run (round-2 lesson);
+    the evidence run keeps the full 2048.  Section cutoffs scale with the
+    budget so a small driver budget still yields all rows when compiles
+    are cache-warm."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.kernels import flash_attention, fused_rms_norm_pallas
     from paddle_tpu.nn.functional.attention import sdpa_reference
 
     t_start = time.perf_counter()
+    need = min(90.0, 0.25 * budget_s)  # time to leave for the next section
 
     def left():
         return budget_s - (time.perf_counter() - t_start)
@@ -232,7 +240,7 @@ def _kernel_compare(budget_s):
 
     rs = np.random.RandomState(0)
     res = {}
-    b, s, h, d = 2, 2048, 8, 128
+    b, s, h, d = 2, seq, 8, 128
     q = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
     k = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
     v = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
@@ -243,13 +251,13 @@ def _kernel_compare(budget_s):
         sdpa_reference(q, k, v, is_causal=True, training=False) ** 2))
     rel = abs(float(fa(q, k, v)) - float(xa(q, k, v))) / \
         max(abs(float(xa(q, k, v))), 1e-6)
-    res["flash_attn_fwd_s2048"] = {
+    res[f"flash_attn_fwd_s{s}"] = {
         "ok": rel < 2e-2, "pallas_ms": round(timeit(fa, q, k, v), 2),
         "xla_ms": round(timeit(xa, q, k, v), 2)}
-    res["flash_attn_fwd_s2048"]["speedup"] = round(
-        res["flash_attn_fwd_s2048"]["xla_ms"] /
-        res["flash_attn_fwd_s2048"]["pallas_ms"], 2)
-    if left() < 120:
+    res[f"flash_attn_fwd_s{s}"]["speedup"] = round(
+        res[f"flash_attn_fwd_s{s}"]["xla_ms"] /
+        res[f"flash_attn_fwd_s{s}"]["pallas_ms"], 2)
+    if left() < need:
         res["truncated"] = "budget"
         return res
 
@@ -257,13 +265,13 @@ def _kernel_compare(budget_s):
         q, k, v, causal=True, interpret=False) ** 2), argnums=(0, 1, 2)))
     xa_g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(sdpa_reference(
         q, k, v, is_causal=True, training=False) ** 2), argnums=(0, 1, 2)))
-    res["flash_attn_bwd_s2048"] = {
+    res[f"flash_attn_bwd_s{s}"] = {
         "pallas_ms": round(timeit(fa_g, q, k, v), 2),
         "xla_ms": round(timeit(xa_g, q, k, v), 2)}
-    res["flash_attn_bwd_s2048"]["speedup"] = round(
-        res["flash_attn_bwd_s2048"]["xla_ms"] /
-        res["flash_attn_bwd_s2048"]["pallas_ms"], 2)
-    if left() < 90:
+    res[f"flash_attn_bwd_s{s}"]["speedup"] = round(
+        res[f"flash_attn_bwd_s{s}"]["xla_ms"] /
+        res[f"flash_attn_bwd_s{s}"]["pallas_ms"], 2)
+    if left() < need:
         res["truncated"] = "budget"
         return res
 
@@ -293,7 +301,7 @@ def _kernel_compare(budget_s):
             max(res["decode_attn_kv4096"]["pallas_ms"], 1e-9), 2)
     except Exception as e:
         res["decode_attn_kv4096"] = {"error": repr(e)[-200:]}
-    if left() < 90:
+    if left() < need:
         res["truncated"] = "budget"
         return res
 
@@ -310,7 +318,7 @@ def _kernel_compare(budget_s):
     res["fused_rms_norm_8192x4096"]["speedup"] = round(
         res["fused_rms_norm_8192x4096"]["xla_ms"] /
         max(res["fused_rms_norm_8192x4096"]["pallas_ms"], 1e-9), 2)
-    if left() < 90:
+    if left() < need:
         res["truncated"] = "budget"
         return res
 

@@ -28,7 +28,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "$(date -u +%H:%M:%S) evidence status=$ST" >> $LOG
     if [ "$ST" = "done" ] || [ "$ST" = "bench_done" ]; then
       # the main session may transiently hold .git/index.lock — retry
+      # (git add first: the file starts untracked, and `commit -- path`
+      # alone errors on untracked paths)
       for i in 1 2 3 4 5 6; do
+        git add BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
         if git commit -m "On-chip bench evidence: raw per-iteration timings, loss series, kernel-compare table" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1; then
           echo "$(date -u +%H:%M:%S) evidence committed; watchdog exiting" >> $LOG
           exit 0

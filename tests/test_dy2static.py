@@ -1,0 +1,264 @@
+"""dy2static conversion oracles: an eager function with data-dependent
+Python control flow must match its converted static (jitted) version
+(reference test model: test/dygraph_to_static/ — each op-level converter
+is checked eager-vs-static).
+
+Eager oracle = run the ORIGINAL function on concrete numpy-backed arrays
+(Python control flow executes natively); static = to_static(fn) under jit
+where args are tracers, forcing the lax.cond/while_loop path.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu import jit as pjit
+from paddle_tpu.jit.dy2static import (convert_to_static, Dy2StaticError)
+
+
+def _check(fn, *argsets, atol=1e-6):
+    """converted+jitted fn == original eager fn on every argset."""
+    static = pjit.to_static(fn)
+    for args in argsets:
+        want = fn(*args)
+        got = static(*args)
+        jax.tree.map(
+            lambda w, g: np.testing.assert_allclose(
+                np.asarray(w), np.asarray(g), atol=atol, rtol=1e-6),
+            want, got)
+
+
+def test_data_dependent_if():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y + 1.0
+
+    _check(f, (jnp.ones(4),), (-jnp.ones(4),))
+
+
+def test_if_without_else():
+    def f(x):
+        y = x + 1.0
+        if y.sum() > 3.0:
+            y = y * 10.0
+        return y
+
+    _check(f, (jnp.ones(4),), (jnp.zeros(4) - 5.0,))
+
+
+def test_elif_chain():
+    def f(x):
+        s = x.sum()
+        if s > 10.0:
+            r = x * 3.0
+        elif s > 0.0:
+            r = x * 2.0
+        else:
+            r = x * 0.5
+        return r
+
+    _check(f, (jnp.full(4, 5.0),), (jnp.full(4, 0.5),), (-jnp.ones(4),))
+
+
+def test_both_branches_return():
+    def f(x):
+        if x.mean() > 0:
+            return x - x.mean()
+        else:
+            return x + 1.0
+
+    _check(f, (jnp.arange(4.0),), (-jnp.arange(4.0) - 1,))
+
+
+def test_bool_ops_in_condition():
+    def f(x):
+        if x.sum() > 0 and x.max() < 10.0:
+            y = x + 5.0
+        else:
+            y = x - 5.0
+        if not (x.min() > -100.0) or x.sum() > 1.0:
+            y = y * 2.0
+        return y
+
+    _check(f, (jnp.ones(3),), (jnp.full(3, 20.0),), (-jnp.ones(3),))
+
+
+def test_tensor_while_loop():
+    def f(x):
+        n = jnp.asarray(0, jnp.int32)
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+            n = n + 1
+        return s, n
+
+    _check(f, (jnp.ones(4),), (jnp.full(4, 30.0),))
+
+
+def test_nested_if_in_while():
+    def f(x):
+        s = x
+        while s.sum() < 50.0:
+            if s.max() > 4.0:
+                s = s + 10.0
+            else:
+                s = s * 3.0
+        return s
+
+    _check(f, (jnp.ones(4),), (jnp.full(4, 5.0),))
+
+
+def test_for_range_traced_bound():
+    def f(x, n):
+        acc = jnp.zeros_like(x)
+        for i in range(n):
+            acc = acc + x * (i + 1)
+        return acc
+
+    # n as a traced int forces the while_loop path; concrete python int
+    # in eager runs the plain range
+    static = pjit.to_static(f)
+    x = jnp.arange(3.0)
+    for n in (0, 1, 4):
+        want = f(x, n)
+        got = static(x, jnp.asarray(n, jnp.int32))
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   atol=1e-6)
+
+
+def test_python_control_flow_still_python():
+    """Concrete (non-tensor) predicates keep exact Python semantics
+    through the converted function — including short-circuit."""
+    def f(x, flag):
+        if flag:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        # short-circuit: the second operand would raise if evaluated
+        if (not flag) or x.shape[0] > 0:
+            y = y * 2.0
+        return y
+
+    conv = convert_to_static(f)
+    x = jnp.ones(2)
+    np.testing.assert_allclose(np.asarray(conv(x, True)),
+                               np.asarray(f(x, True)))
+    np.testing.assert_allclose(np.asarray(conv(x, False)),
+                               np.asarray(f(x, False)))
+
+
+def test_break_in_tensor_loop_clear_error():
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+            if s.max() > 50.0:
+                break
+        return s
+
+    static = pjit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="break/continue"):
+        static(jnp.ones(4))
+    # eager-style concrete use still fine (python path)
+    out = convert_to_static(f)(np.ones(4))
+    assert float(np.asarray(out).sum()) >= 100.0
+
+
+def test_single_branch_return_clear_error():
+    def f(x):
+        if x.sum() > 0:
+            return x
+        x = x * 2.0
+        return x
+
+    static = pjit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="return"):
+        static(jnp.ones(4))
+
+
+def test_layer_forward_converted():
+    import paddle_tpu.nn as nn
+
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                return h * 2.0
+            else:
+                return h - 1.0
+
+    paddle_tpu.seed(0)
+    layer = Gate()
+    x = jnp.ones((2, 4))
+    eager = layer(x)            # converted forward, concrete-value path...
+    static = pjit.to_static(layer)
+    out = static(x)             # ...vs traced lax.cond path
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(out),
+                               atol=1e-6)
+
+
+def test_loop_carried_shape_change_clear_error():
+    def f(x):
+        while x.sum() < 10.0:
+            x = jnp.concatenate([x, x])
+        return x
+
+    static = pjit.to_static(f)
+    with pytest.raises((Dy2StaticError, TypeError)):
+        static(jnp.ones(2))
+
+
+def test_undefined_after_branch_clear_error():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        return y  # noqa: F821 — defined only on one path
+
+    static = pjit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="undefined"):
+        static(jnp.ones(4))
+
+
+def test_enable_to_static_toggle():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2.0
+        else:
+            return -x
+
+    static = pjit.to_static(f)
+    try:
+        pjit.enable_to_static(False)
+        out = static(jnp.ones(2))   # runs the original eagerly
+        np.testing.assert_allclose(np.asarray(out), 2 * np.ones(2))
+    finally:
+        pjit.enable_to_static(True)
+
+
+def test_save_load_converted_function(tmp_path):
+    """jit.save must export the CONVERTED program (lax.cond), not the raw
+    Python function (which cannot trace data-dependent branches)."""
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y
+
+    static = pjit.to_static(f)
+    path = str(tmp_path / "dy2s_model")
+    from paddle_tpu.static import InputSpec
+    pjit.save(static, path, input_spec=[InputSpec((4,), "float32")])
+    loaded = pjit.load(path)
+    for x in (jnp.ones(4), -jnp.ones(4)):
+        np.testing.assert_allclose(np.asarray(loaded(x)),
+                                   np.asarray(f(x)), atol=1e-6)
