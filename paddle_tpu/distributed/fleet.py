@@ -42,7 +42,8 @@ def init(role_maker=None, is_collective: bool = True,
     hcg = HybridCommunicateGroup(
         dp_degree=h["dp_degree"], mp_degree=h["mp_degree"],
         pp_degree=h["pp_degree"], sharding_degree=h["sharding_degree"],
-        sep_degree=h["sep_degree"], devices=devices)
+        sep_degree=h["sep_degree"], ep_degree=h.get("ep_degree", 1),
+        devices=devices)
     set_hybrid_communicate_group(hcg)
     return fleet
 

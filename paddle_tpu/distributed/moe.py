@@ -417,8 +417,16 @@ class ExpertStack(Layer):
 def _ep_axis(moe_group) -> Optional[str]:
     if moe_group is None:
         hcg = get_hybrid_communicate_group()
-        # reference default: experts ride the data-parallel/world group
-        return "dp" if hcg is not None else None
+        if hcg is None:
+            return None
+        # first-class expert axis: with ep_degree > 1 in the hybrid config
+        # the experts ride the fleet expert group (reference:
+        # HCG.expert_parallel_group); otherwise the reference default of
+        # the data-parallel/world group
+        if hasattr(hcg, "get_expert_parallel_world_size") and \
+                hcg.get_expert_parallel_world_size() > 1:
+            return "ep"
+        return "dp"
     if hasattr(moe_group, "name"):
         return moe_group.name
     if isinstance(moe_group, str):

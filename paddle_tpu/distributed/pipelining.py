@@ -71,6 +71,17 @@ def _boundary_constrain(mesh, x, spec):
         return x
 
 
+def _apply_x_spec(mesh, xs, x_spec):
+    """Constrain the microbatched activation pytree: ``x_spec`` mirrors the
+    activation structure with a PartitionSpec per leaf, or None to skip a
+    leaf (intentional skips never warn — the warning is reserved for
+    constraints that FAIL to apply)."""
+    return jax.tree.map(
+        lambda s, a: a if s is None else _boundary_constrain(mesh, a, s),
+        x_spec, xs,
+        is_leaf=lambda v: v is None or isinstance(v, P))
+
+
 def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
                    mesh: Mesh, n_stages: int, extra_args=(),
                    remat: bool = True, x_spec: Optional[P] = None,
@@ -93,16 +104,22 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     stage's row — ONE gather of the M valid outputs at the end instead of a
     per-tick ``psum`` broadcast of activation-sized garbage (round-2 review:
     the per-tick psum cost T all-reduces of which only M carried data).
+
+    Activations may be PYTREES (every leaf microbatched on dim 0): a stage
+    body that threads auxiliary state alongside the hidden tensor — e.g. the
+    MoE gate-balance loss accumulating across stages — carries a dict and
+    each leaf rides the ring independently.  ``x_spec`` then must be a
+    matching pytree of PartitionSpecs (or None).
     """
     from jax import shard_map
 
-    M = x_microbatches.shape[0]
+    M = jax.tree_util.tree_leaves(x_microbatches)[0].shape[0]
     S = n_stages
     T = M + S - 1
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
     if x_spec is not None:
-        x_microbatches = _boundary_constrain(mesh, x_microbatches, x_spec)
+        x_microbatches = _apply_x_spec(mesh, x_microbatches, x_spec)
     if param_inner_specs is not None:
         stacked_params = {
             k: _boundary_constrain(mesh, v, param_inner_specs[k])
@@ -114,7 +131,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     # None; the auto axes' sharding (mp/dp/...) rides on the arrays and is
     # still handled by GSPMD inside the body.
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
-    in_x_spec = P()
+    in_x_spec = jax.tree.map(lambda _: P(), x_microbatches)
 
     def pipelined(params, xs):
         # inside shard_map over pp each device holds its stage's slice of the
@@ -124,27 +141,31 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         stage_id = jax.lax.axis_index("pp")
 
         def tick(carry, t):
-            state = carry  # [mb, ...] activation at this stage
+            state = carry  # [mb, ...] activation pytree at this stage
             # stage 0 pulls microbatch t (clamped) from the queue
             mb_idx = jnp.clip(t, 0, M - 1)
-            inject = jax.lax.dynamic_index_in_dim(xs, mb_idx, axis=0,
-                                                  keepdims=False)
-            x_in = jnp.where(stage_id == 0, inject, state)
+            inject = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, mb_idx, axis=0,
+                                                       keepdims=False), xs)
+            x_in = jax.tree.map(
+                lambda i, s: jnp.where(stage_id == 0, i, s), inject, state)
             y = body(local_params, x_in, *extra_args)
             # rotate: stage s -> s+1 (last stage's send wraps to 0, ignored)
             perm = [(i, (i + 1) % S) for i in range(S)]
-            nxt = jax.lax.ppermute(y, "pp", perm)
+            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, "pp", perm), y)
             # collect the local y — the caller slices out the last stage's
             # row, so no masking/zeroing or per-tick broadcast is needed
             return nxt, y
 
         # initial carry: zeros with the OUTPUT shape of a stage (the body
         # must preserve activation shape — true for transformer blocks)
-        out_shape = jax.eval_shape(body, local_params, xs[0], *extra_args)
-        init = jnp.zeros(out_shape.shape, out_shape.dtype)
+        x0 = jax.tree.map(lambda a: a[0], xs)
+        out_shape = jax.eval_shape(body, local_params, x0, *extra_args)
+        init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
 
         _, outs = jax.lax.scan(tick, init, jnp.arange(T))
-        return outs[None]  # [1, T, mb, ...] local -> [S, T, ...] stacked
+        # [1, T, mb, ...] local -> [S, T, ...] stacked over pp
+        return jax.tree.map(lambda a: a[None], outs)
 
     # axis_names={"pp"}: only pp is manual; tp/dp/sp axes stay automatic so
     # GSPMD keeps partitioning the math inside the stage body
@@ -155,9 +176,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         check_vma=False,
         axis_names={"pp"})
     res = fn(stacked_params, x_microbatches)      # [S, T, mb, ...]
-    last = jax.lax.index_in_dim(res, S - 1, axis=0, keepdims=False)
     # valid outputs at ticks S-1 .. T-1 are microbatches 0..M-1
-    return jax.lax.dynamic_slice_in_dim(last, S - 1, M, axis=0)
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(
+            jax.lax.index_in_dim(a, S - 1, axis=0, keepdims=False),
+            S - 1, M, axis=0), res)
 
 
 def stack_interleaved_stage_params(per_chunk_params: list, n_stages: int,
@@ -210,7 +233,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     """
     from jax import shard_map
 
-    M = x_microbatches.shape[0]
+    M = jax.tree_util.tree_leaves(x_microbatches)[0].shape[0]
     S = n_stages
     V = n_chunks
     if M % S:
@@ -219,13 +242,14 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     T = M * V + S - 1
     body = jax.checkpoint(stage_fn) if remat else stage_fn
     if x_spec is not None:
-        x_microbatches = _boundary_constrain(mesh, x_microbatches, x_spec)
+        x_microbatches = _apply_x_spec(mesh, x_microbatches, x_spec)
     if param_inner_specs is not None:
         stacked_params = {
             k: _boundary_constrain(mesh, v, param_inner_specs[k])
             if k in param_inner_specs else v
             for k, v in stacked_params.items()}
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    in_x_spec = jax.tree.map(lambda _: P(), x_microbatches)
 
     def pipelined(params, xs):
         # local leaves: [V, ...] — this device's chunks, local index v
@@ -241,13 +265,15 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
                 params)
             # stage-0 chunk-0 slots consume fresh microbatches
             m_in = jnp.clip((n // (S * V)) * S + n % S, 0, M - 1)
-            inject = jax.lax.dynamic_index_in_dim(xs, m_in, axis=0,
-                                                  keepdims=False)
+            inject = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, m_in, axis=0,
+                                                       keepdims=False), xs)
             take_fresh = jnp.logical_and(stage_id == 0, n % (S * V) < S)
-            x_in = jnp.where(take_fresh, inject, state)
+            x_in = jax.tree.map(
+                lambda i, s: jnp.where(take_fresh, i, s), inject, state)
             y = body(chunk_params, x_in, *extra_args)
             perm = [(i, (i + 1) % S) for i in range(S)]
-            nxt = jax.lax.ppermute(y, "pp", perm)
+            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, "pp", perm), y)
             # collect local y; the caller slices the last stage's row at the
             # exact emit ticks (stage-(S-1) chunk-(V-1) slots), so no
             # masking or per-tick psum broadcast is needed
@@ -255,21 +281,25 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
 
         chunk_shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), params)
-        out_shape = jax.eval_shape(body, chunk_shapes, xs[0], *extra_args)
-        init = jnp.zeros(out_shape.shape, out_shape.dtype)
+        x0 = jax.tree.map(lambda a: a[0], xs)
+        out_shape = jax.eval_shape(body, chunk_shapes, x0, *extra_args)
+        init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
         _, outs = jax.lax.scan(tick, init, jnp.arange(T))
-        return outs[None]  # [1, T, mb, ...] local -> [S, T, ...] stacked
+        # [1, T, mb, ...] local -> [S, T, ...] stacked over pp
+        return jax.tree.map(lambda a: a[None], outs)
 
     fn = shard_map(
         pipelined, mesh=mesh,
-        in_specs=(param_specs, P()),
+        in_specs=(param_specs, in_x_spec),
         out_specs=P("pp"),
         check_vma=False,
         axis_names={"pp"})
     res = fn(stacked_params, x_microbatches)        # [S, T, mb, ...]
-    last = jax.lax.index_in_dim(res, S - 1, axis=0, keepdims=False)
     # microbatch m finishes at tick (m//S)*S*V + (V-1)*S + m%S + S-1
     import numpy as _np
     ms = _np.arange(M)
-    ticks = (ms // S) * S * V + (V - 1) * S + ms % S + S - 1
-    return jnp.take(last, jnp.asarray(ticks), axis=0)
+    ticks = jnp.asarray((ms // S) * S * V + (V - 1) * S + ms % S + S - 1)
+    return jax.tree.map(
+        lambda a: jnp.take(
+            jax.lax.index_in_dim(a, S - 1, axis=0, keepdims=False),
+            ticks, axis=0), res)

@@ -77,5 +77,6 @@ class DistributedStrategy:
     def __repr__(self):
         h = self._hybrid
         return (f"DistributedStrategy(hybrid=dp{h.dp_degree}/mp{h.mp_degree}/"
-                f"pp{h.pp_degree}/sharding{h.sharding_degree}/sep{h.sep_degree},"
+                f"pp{h.pp_degree}/sharding{h.sharding_degree}/"
+                f"sep{h.sep_degree}/ep{h.ep_degree},"
                 f" amp={self.amp}, recompute={self.recompute})")

@@ -33,7 +33,12 @@ __all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelAxis",
            "AXIS_ORDER"]
 
 # fleet's canonical order (reference: HybridCommunicateGroup._parallel_names)
-AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+# + a first-class expert axis (reference: the fleet expert group moe_layer.py
+# routes MoELayer dispatch over; round-2 VERDICT item 5).  ``ep`` sits
+# between sep and mp: expert all-to-alls are bandwidth-heavy but less
+# latency-critical than mp's per-layer allreduces, which keep the innermost
+# (fastest ICI) placement.
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
 
 class CommunicateTopology:
@@ -41,7 +46,7 @@ class CommunicateTopology:
     CommunicateTopology — get_coord/get_rank/get_comm_list)."""
 
     def __init__(self, hybrid_group_names: Sequence[str] = AXIS_ORDER,
-                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+                 dims: Sequence[int] = (1,) * len(AXIS_ORDER)):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(dims)
         self._world_size = int(np.prod(self._dims))
@@ -127,12 +132,13 @@ class HybridCommunicateGroup:
 
     def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
                  pp_degree: int = 1, sharding_degree: int = 1,
-                 sep_degree: int = 1, devices: Optional[Sequence] = None,
+                 sep_degree: int = 1, ep_degree: int = 1,
+                 devices: Optional[Sequence] = None,
                  topology: Optional[CommunicateTopology] = None):
         devices = list(devices if devices is not None else jax.devices())
         n = len(devices)
         degrees = dict(dp=dp_degree, pp=pp_degree, sharding=sharding_degree,
-                       sep=sep_degree, mp=mp_degree)
+                       sep=sep_degree, ep=ep_degree, mp=mp_degree)
         want = int(np.prod(list(degrees.values())))
         if want < n:
             # reference semantics: world size == product of degrees; with
@@ -189,6 +195,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self) -> int:
         return self._degrees["sep"]
 
+    def get_expert_parallel_world_size(self) -> int:
+        return self._degrees["ep"]
+
     def get_data_parallel_group(self) -> ParallelAxis:
         return self._axes["dp"]
 
@@ -203,6 +212,12 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self) -> ParallelAxis:
         return self._axes["sep"]
+
+    def get_expert_parallel_group(self) -> ParallelAxis:
+        """The fleet expert group (reference: HCG.expert_parallel_group used
+        by incubate MoELayer); MoELayer defaults its moe_group to this axis
+        when ep_degree > 1."""
+        return self._axes["ep"]
 
     # traced ranks, valid inside shard_map regions
     def get_data_parallel_rank(self):
@@ -220,17 +235,22 @@ class HybridCommunicateGroup:
     def get_sep_parallel_rank(self):
         return jax.lax.axis_index("sep")
 
+    def get_expert_parallel_rank(self):
+        return jax.lax.axis_index("ep")
+
     # group-id helpers kept for API parity
     def get_check_parallel_group(self, *a, **k):
         return self._axes["mp"]
 
     def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
-        return self._topo.get_rank(dp=0, pp=stage_id, sharding=0, sep=0, mp=0)
+        return self._topo.get_rank(dp=0, pp=stage_id, sharding=0, sep=0,
+                                   ep=0, mp=0)
 
     def __repr__(self):
         d = self._degrees
         return (f"HybridCommunicateGroup(dp={d['dp']}, pp={d['pp']}, "
-                f"sharding={d['sharding']}, sep={d['sep']}, mp={d['mp']})")
+                f"sharding={d['sharding']}, sep={d['sep']}, ep={d['ep']}, "
+                f"mp={d['mp']})")
 
 
 _HCG: Optional[HybridCommunicateGroup] = None

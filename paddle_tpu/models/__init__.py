@@ -2,7 +2,7 @@
 
 from .gpt import (GPTConfig, GPTBlock, GPTModel, GPTForCausalLM,  # noqa: F401
                   gpt_tiny, gpt_small, gpt3_6_7b)
-from .trainer import GPTHybridTrainer  # noqa: F401
+from .trainer import GPTHybridTrainer, GPTMoEHybridTrainer  # noqa: F401
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
                     LlamaAttention, LlamaMLP, LlamaDecoderLayer,
                     llama_shard_fn, llama_tiny, llama_7b)

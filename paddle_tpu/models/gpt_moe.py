@@ -42,6 +42,9 @@ class GPTMoEConfig:
     top_k: int = 2
     moe_every: int = 2            # every k-th block uses the MoE FFN
     gate: str = "gshard"          # naive | gshard | switch
+    gate_kwargs: Optional[dict] = None   # extra gate args (e.g.
+    # random_routing=False for deterministic gshard)
+    remat: bool = False
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
     dropout: float = 0.0
@@ -72,10 +75,10 @@ class _MoEBlock(Layer):
         if use_moe:
             experts = [ExpertFFN(h, cfg.ffn_size)
                        for _ in range(cfg.num_experts)]
-            self.ffn = MoELayer(h, experts,
-                                gate={"type": cfg.gate, "topk": cfg.top_k}
-                                if cfg.gate != "switch" else
-                                {"type": "switch"},
+            gate_cfg = ({"type": cfg.gate, "topk": cfg.top_k}
+                        if cfg.gate != "switch" else {"type": "switch"})
+            gate_cfg.update(cfg.gate_kwargs or {})
+            self.ffn = MoELayer(h, experts, gate=gate_cfg,
                                 moe_group=cfg.moe_group,
                                 capacity_factor=cfg.capacity_factor)
         else:

@@ -33,10 +33,18 @@ from ..distributed.meta_parallel.mp_layers import (parallel_cross_entropy,
                                                    _maybe_constraint)
 from .gpt import GPTConfig, GPTForCausalLM
 
-__all__ = ["GPTHybridTrainer"]
+__all__ = ["GPTHybridTrainer", "GPTMoEHybridTrainer"]
 
 
 class GPTHybridTrainer:
+    # state-layout key map — subclasses (GPTMoEHybridTrainer) remap these
+    # to their model's parameter names
+    BLOCK_PREFIX = "gpt.h."
+    KEY_WTE = "gpt.wte.weight"
+    KEY_WPE = "gpt.wpe.weight"
+    KEY_LNF_W = "gpt.ln_f.weight"
+    KEY_LNF_B = "gpt.ln_f.bias"
+
     def __init__(self, cfg: GPTConfig, hcg, optimizer, microbatches: int = 1,
                  zero_stage: int = 1, vpp: int = 1):
         self.cfg = cfg
@@ -58,9 +66,15 @@ class GPTHybridTrainer:
             raise ValueError("interleaved schedule needs microbatches "
                              "divisible by pp_degree")
         self.zero = zero_stage
-        self.model = GPTForCausalLM(cfg)
+        self.model = self._make_model(cfg)
         self._build_state_layout()
         self._jit_step = None
+
+    def _make_model(self, cfg):
+        return GPTForCausalLM(cfg)
+
+    def _get_template_block(self):
+        return self.model.gpt.h[0]
 
     # ------------------------------------------------------------------
     def _build_state_layout(self):
@@ -75,10 +89,10 @@ class GPTHybridTrainer:
         # each pipeline stage holds 1/S of the table instead of a full
         # replica, and the tied-weight grad merge (embed use + head use)
         # falls out of AD + GSPMD as exactly the reference's allreduce.
-        self._vocab_axes = "mp"
+        wte_spec = tuple(specs[self.KEY_WTE])
+        self._vocab_axes = wte_spec[0] if wte_spec else None
         if self.S > 1:
-            self._vocab_axes = ("mp", "pp")
-            for k in ("gpt.wte.weight", "gpt.wpe.weight"):
+            for k in (self.KEY_WTE, self.KEY_WPE):
                 if k in specs:
                     old = tuple(specs[k])  # P(mp, None) from the embedding
                     d0 = old[0] if old else None
@@ -89,11 +103,12 @@ class GPTHybridTrainer:
                     else:
                         d0 = (d0, "pp")
                     specs[k] = P(d0, *old[1:])
+            self._vocab_axes = specs[self.KEY_WTE][0]
         self.block_names = []   # suffix names within a block
         nonblock, blocks0 = {}, {}
         for k, v in params.items():
-            if k.startswith("gpt.h."):
-                rest = k[len("gpt.h."):]
+            if k.startswith(self.BLOCK_PREFIX):
+                rest = k[len(self.BLOCK_PREFIX):]
                 idx, suffix = rest.split(".", 1)
                 if idx == "0":
                     blocks0[suffix] = None
@@ -109,8 +124,9 @@ class GPTHybridTrainer:
         interleave = self.S > 1 and self.V > 1
         K = L // (self.S * self.V) if interleave else None
         for suffix in self.block_names:
-            per = [params[f"gpt.h.{i}.{suffix}"] for i in range(L)]
-            inner = specs.get(f"gpt.h.0.{suffix}", P())
+            per = [params[f"{self.BLOCK_PREFIX}{i}.{suffix}"]
+                   for i in range(L)]
+            inner = specs.get(f"{self.BLOCK_PREFIX}0.{suffix}", P())
             if interleave:
                 order = [v * self.S + s for s in range(self.S)
                          for v in range(self.V)]
@@ -126,7 +142,7 @@ class GPTHybridTrainer:
         self.params_blocks = stacked
         self.specs_nonblock = {k: specs.get(k, P()) for k in nonblock}
         self.specs_blocks = stacked_specs
-        self.template_block = self.model.gpt.h[0]
+        self.template_block = self._get_template_block()
 
         # ZeRO slot specs (stage >= 1) — also grad specs for stage >= 2 and
         # param specs for stage 3 (reference: GroupShardedStage2/3 grad
@@ -192,21 +208,21 @@ class GPTHybridTrainer:
     def _embed(self, pnb, ids):
         cfg = self.cfg
         pos = jnp.arange(ids.shape[1])[None, :]
-        x = jnp.take(pnb["gpt.wte.weight"], ids.astype(jnp.int32), axis=0) + \
-            jnp.take(pnb["gpt.wpe.weight"], pos, axis=0)
+        x = jnp.take(pnb[self.KEY_WTE], ids.astype(jnp.int32), axis=0) + \
+            jnp.take(pnb[self.KEY_WPE], pos, axis=0)
         # context parallel: activations ride the sep axis on the seq dim
-        seq_axis = "sep" if cfg.cp else None
+        seq_axis = "sep" if getattr(cfg, "cp", False) else None
         return _maybe_constraint(x, P(None, seq_axis, None))
 
     def _final(self, pnb, x):
         cfg = self.cfg
-        w = pnb.get("gpt.ln_f.weight")
-        b = pnb.get("gpt.ln_f.bias")
+        w = pnb.get(self.KEY_LNF_W)
+        b = pnb.get(self.KEY_LNF_B)
         x = F.layer_norm(x, cfg.hidden_size, w, b, cfg.layer_norm_eps)
         # tied head: second use of the wte table (grads from both uses are
         # summed by AD — SharedLayerDesc semantics); logits stay sharded on
         # vocab over mp AND pp so no stage materializes the full [b,s,V]
-        logits = jnp.einsum("bsh,vh->bsv", x, pnb["gpt.wte.weight"])
+        logits = jnp.einsum("bsh,vh->bsv", x, pnb[self.KEY_WTE])
         return _maybe_constraint(logits, P(None, None, self._vocab_axes))
 
     def _block_apply(self, blk_params, x):
@@ -221,6 +237,26 @@ class GPTHybridTrainer:
         out, _ = jax.lax.scan(one, x, pblk_local)
         return out
 
+    # ---- pipeline carry hooks (overridden by GPTMoEHybridTrainer to
+    # thread the gate aux loss through the schedule) --------------------
+    def _pack_microbatches(self, mb):
+        """[M, mb, s, h] hidden -> (activation pytree, x_spec pytree)."""
+        return mb, P(None, self.batch_spec()[0])
+
+    def _unpack_pipeline_output(self, out):
+        """activation pytree -> ([M, mb, s, h] hidden, extra loss term)."""
+        return out, 0.0
+
+    def _serial_forward(self, pblk, x):
+        """S == 1 path: scan all blocks; -> (hidden, extra loss term)."""
+        body = jax.checkpoint(self._block_apply) if self.cfg.remat else \
+            self._block_apply
+
+        def one(carry, bp):
+            return body(bp, carry), None
+        x, _ = jax.lax.scan(one, x, pblk)
+        return x, 0.0
+
     # ------------------------------------------------------------------
     def loss_fn(self, pnb, pblk, ids, labels):
         cfg = self.cfg
@@ -228,31 +264,26 @@ class GPTHybridTrainer:
         if self.S > 1:
             b, s, h = x.shape
             M = self.M
-            mb = x.reshape(M, b // M, s, h)
+            mb, x_spec = self._pack_microbatches(x.reshape(M, b // M, s, h))
             if self.V > 1:
                 from ..distributed.pipelining import \
                     pipeline_apply_interleaved
                 out = pipeline_apply_interleaved(
                     self._body, pblk, mb, self.mesh, self.S, self.V,
-                    remat=cfg.remat,
-                    x_spec=P(None, self.batch_spec()[0]),
+                    remat=cfg.remat, x_spec=x_spec,
                     param_inner_specs=self.specs_blocks)
             else:
                 out = pipeline_apply(self._body, pblk, mb, self.mesh, self.S,
-                                     remat=cfg.remat,
-                                     x_spec=P(None, self.batch_spec()[0]),
+                                     remat=cfg.remat, x_spec=x_spec,
                                      param_inner_specs=self.specs_blocks)
-            x = out.reshape(b, s, h)
+            hidden, extra = self._unpack_pipeline_output(out)
+            x = hidden.reshape(b, s, h)
         else:
-            body = jax.checkpoint(self._block_apply) if cfg.remat else \
-                self._block_apply
-            def one(carry, bp):
-                return body(bp, carry), None
-            x, _ = jax.lax.scan(one, x, pblk)
+            x, extra = self._serial_forward(pblk, x)
         logits = self._final(pnb, x)
         per_tok = parallel_cross_entropy(logits, labels,
                                          mp_axis=self._vocab_axes)
-        return jnp.mean(per_tok)
+        return jnp.mean(per_tok) + extra
 
     def build_step(self):
         opt = self.opt
@@ -297,7 +328,7 @@ class GPTHybridTrainer:
         ids = rng.randint(0, self.cfg.vocab_size, (batch, seq + 1))
         x = jnp.asarray(ids[:, :-1])
         y = jnp.asarray(ids[:, 1:])
-        seq_axis = "sep" if self.cfg.cp else None
+        seq_axis = "sep" if getattr(self.cfg, "cp", False) else None
         bs = NamedSharding(self.mesh, P(self.batch_spec()[0], seq_axis))
         return jax.device_put(x, bs), jax.device_put(y, bs)
 
@@ -307,3 +338,83 @@ class GPTHybridTrainer:
         pnb, pblk, onb, oblk, loss = self.jit_step()(
             pnb, pblk, onb, oblk, ids, labels, lr)
         return (pnb, pblk, onb, oblk), loss
+
+
+class GPTMoEHybridTrainer(GPTHybridTrainer):
+    """Hybrid-parallel GPT-MoE trainer: dp x pp x ZeRO x EP in ONE jitted
+    step (reference: paddle.incubate.distributed.models.moe GPT over the
+    fleet expert group, composed with PipelineParallel /
+    DygraphShardingOptimizer — SURVEY.md §2.3 EP + Hybrid rows).
+
+    Experts shard over the first-class ``ep`` mesh axis (MoELayer defaults
+    its group to HCG.get_expert_parallel_group() when ep_degree > 1), so
+    expert dispatch einsums compile to all-to-all over ep while blocks
+    pipeline over pp and the batch shards over dp/sharding.
+
+    Blocks must be uniform (``cfg.moe_every == 1``) — the fused pipeline
+    schedule's requirement, same as the reference PipelineLayer uniform
+    segmentation.
+
+    The gate load-balance aux losses ride the pipeline INSIDE the
+    activation pytree ({"h": hidden, "aux": scalar}): each stage adds its
+    blocks' aux terms as the microbatch flows through, and the last stage
+    emits the per-microbatch totals — the one-program SPMD form of the
+    reference's cross-stage aux-loss reduction.  With microbatches > 1 the
+    batch aux is the mean of per-microbatch aux values (a documented,
+    standard estimator deviation: the balance loss is nonlinear in the
+    token set; with M=1 it equals the serial value exactly).
+    """
+
+    BLOCK_PREFIX = "h."
+    KEY_WTE = "wte.weight"
+    KEY_WPE = "wpe.weight"
+    KEY_LNF_W = "ln_f.weight"
+    KEY_LNF_B = "ln_f.bias"
+
+    def __init__(self, cfg, hcg, optimizer, microbatches: int = 1,
+                 zero_stage: int = 1, vpp: int = 1):
+        if cfg.moe_every != 1:
+            raise ValueError(
+                "GPTMoEHybridTrainer needs uniform blocks: set "
+                "cfg.moe_every = 1 (every block MoE) — the fused pipeline "
+                "schedule requires structurally identical stages, like the "
+                "reference PipelineLayer's uniform segmentation")
+        super().__init__(cfg, hcg, optimizer, microbatches=microbatches,
+                         zero_stage=zero_stage, vpp=vpp)
+
+    def _make_model(self, cfg):
+        from .gpt_moe import GPTMoEForCausalLM
+        return GPTMoEForCausalLM(cfg)
+
+    def _get_template_block(self):
+        return self.model.h[0]
+
+    # ---- MoE stage body: hidden + aux accumulator --------------------
+    def _block_apply(self, blk_params, x):
+        out, nb = functional_call(self.template_block, blk_params, None,
+                                  (x,), train=True)
+        aux = jnp.zeros((), jnp.float32)
+        for k, v in nb.items():
+            if k.endswith("aux_loss"):
+                aux = aux + v
+        return out, aux
+
+    def _body(self, pblk_local, carry):
+        def one(c, bp):
+            out, aux_inc = self._block_apply(bp, c["h"])
+            return {"h": out, "aux": c["aux"] + aux_inc}, None
+        out, _ = jax.lax.scan(one, carry, pblk_local)
+        return out
+
+    def _pack_microbatches(self, mb):
+        M = mb.shape[0]
+        return ({"h": mb, "aux": jnp.zeros((M,), jnp.float32)},
+                {"h": P(None, self.batch_spec()[0]), "aux": None})
+
+    def _unpack_pipeline_output(self, out):
+        return out["h"], self.cfg.aux_weight * jnp.mean(out["aux"])
+
+    def _serial_forward(self, pblk, x):
+        body = jax.checkpoint(self._body) if self.cfg.remat else self._body
+        carry = body(pblk, {"h": x, "aux": jnp.zeros((), jnp.float32)})
+        return carry["h"], self.cfg.aux_weight * carry["aux"]

@@ -30,7 +30,8 @@ def mp_mesh():
 
 
 def test_topology_comm_lists():
-    topo = dist.CommunicateTopology(dims=[2, 1, 1, 1, 4])  # dp=2, mp=4
+    # dp=2, mp=4 over the 6-axis order (dp, pp, sharding, sep, ep, mp)
+    topo = dist.CommunicateTopology(dims=[2, 1, 1, 1, 1, 4])
     assert topo.world_size() == 8
     mp_groups = topo.get_comm_list("mp")
     assert len(mp_groups) == 2 and all(len(g) == 4 for g in mp_groups)
