@@ -7,9 +7,9 @@ tensor -> (file, global offset); load reshards so a checkpoint written on
 one mesh/world-size restores onto another.
 """
 
-from .save_state_dict import save_state_dict
+from .save_state_dict import save_state_dict, wait_for_pending_saves
 from .load_state_dict import load_state_dict
 from .metadata import Metadata, TensorMeta, ShardMeta
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata", "TensorMeta",
-           "ShardMeta"]
+           "ShardMeta", "wait_for_pending_saves"]

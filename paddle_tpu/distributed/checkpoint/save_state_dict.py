@@ -8,6 +8,15 @@ TPU-native: shard ownership comes from ``jax.Array.addressable_shards``
 (the NamedSharding already IS the shard map the reference reconstructs by
 hand); replica_id==0 filtering gives exactly-once coverage of the global
 tensor.  Data files are .npz per process; metadata is JSON.
+
+Async save (SURVEY §5 Checkpoint — "TPU equiv: Orbax-style async"): with
+``async_save=True`` the device->host snapshot happens synchronously at the
+step boundary (so the saved state is exactly the boundary state, immune to
+later donated-buffer updates), the file write runs on a background thread,
+and the NEXT save to the same path RENDEZVOUSES (joins the in-flight
+write) before starting — training overlaps the write instead of blocking
+for the full device->host+disk time.  ``wait_for_pending_saves()`` drains
+everything (call before exit/restore).
 """
 
 from __future__ import annotations
@@ -21,9 +30,48 @@ import jax
 
 from .metadata import Metadata, TensorMeta, ShardMeta
 
-__all__ = ["save_state_dict"]
+__all__ = ["save_state_dict", "wait_for_pending_saves"]
 
 _META_FILE = "metadata.json"
+
+
+class _PendingSave:
+    """An in-flight async write: its thread plus any exception it hit —
+    a background failure must surface at the rendezvous/join point, not
+    vanish into threading's default excepthook."""
+
+    def __init__(self):
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    def join_and_raise(self):
+        self.thread.join()
+        if self.error is not None:
+            raise RuntimeError(
+                "async checkpoint write failed; the checkpoint on disk is "
+                "incomplete") from self.error
+
+
+# in-flight async writes, keyed by absolute save path.  _SAVE_LOCK guards
+# the registry AND spans each saver's rendezvous+registration, so two
+# concurrent save_state_dict calls to one path serialize instead of both
+# passing the rendezvous and interleaving files.
+_INFLIGHT: Dict[str, _PendingSave] = {}
+_SAVE_LOCK = threading.Lock()
+
+
+def wait_for_pending_saves(path: Optional[str] = None):
+    """Join the in-flight async save for ``path`` (or all of them); raises
+    if a joined write failed."""
+    with _SAVE_LOCK:
+        if path is not None:
+            pending = [_INFLIGHT.pop(os.path.abspath(path), None)]
+        else:
+            pending = list(_INFLIGHT.values())
+            _INFLIGHT.clear()
+    for p in pending:
+        if p is not None:
+            p.join_and_raise()
 
 
 def _shard_entries(name: str, x):
@@ -48,7 +96,29 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
                     extra: Optional[dict] = None):
     """Write ``state_dict`` (flat dict name -> array) under directory
     ``path``.  Returns a ``threading.Thread`` when ``async_save`` (join it
-    to guarantee durability), else None."""
+    — or call ``wait_for_pending_saves`` — to guarantee durability), else
+    None.  A save to a path with an in-flight async write joins that write
+    first (rendezvous), so successive checkpoints never interleave."""
+    apath = os.path.abspath(path)
+    # rendezvous: never let two writers race on the same directory (the
+    # lock spans join + snapshot + registration — see _SAVE_LOCK)
+    _SAVE_LOCK.acquire()
+    try:
+        prev = _INFLIGHT.pop(apath, None)
+        if prev is not None:
+            prev.join_and_raise()
+        # prune finished successful writes to other paths (step-numbered
+        # checkpoint dirs would otherwise accumulate dead entries forever)
+        for k in [k for k, v in _INFLIGHT.items()
+                  if v.thread is not None and not v.thread.is_alive()
+                  and v.error is None]:
+            del _INFLIGHT[k]
+        return _save_locked(state_dict, path, apath, async_save, extra)
+    finally:
+        _SAVE_LOCK.release()
+
+
+def _save_locked(state_dict, path, apath, async_save, extra):
     os.makedirs(path, exist_ok=True)
     pidx = jax.process_index()
     md = Metadata(extra=extra or {})
@@ -78,7 +148,17 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
         os.replace(tmp, frag)
 
     if async_save:
-        t = threading.Thread(target=write, daemon=True)
+        pending = _PendingSave()
+
+        def guarded_write():
+            try:
+                write()
+            except BaseException as e:  # surfaced at join_and_raise
+                pending.error = e
+
+        t = threading.Thread(target=guarded_write, daemon=True)
+        pending.thread = t
+        _INFLIGHT[apath] = pending         # registered under _SAVE_LOCK
         t.start()
         return t
     write()

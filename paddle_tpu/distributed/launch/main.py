@@ -55,6 +55,17 @@ def _parse_args(argv):
                         "manager.py — ElasticManager) with a local-file "
                         "liveness contract: workers touch "
                         "$PADDLE_HEARTBEAT_FILE via distributed.env.")
+    p.add_argument("--elastic_devices_file", type=str, default=None,
+                   help="path to a file holding the CURRENTLY available "
+                        "device count; re-read on every (re)launch and "
+                        "exported to workers as "
+                        "PADDLE_ELASTIC_DEVICE_COUNT.  This is the TPU "
+                        "recast of the reference ElasticManager's etcd "
+                        "node-set watch (fleet/elastic/manager.py): the "
+                        "resource set is re-evaluated at restart, workers "
+                        "rebuild their mesh at the new size and resume "
+                        "from the distributed checkpoint (reshard-on-load "
+                        "moves the shards onto the new mesh).")
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -136,6 +147,13 @@ class CollectiveController:
             })
             if args.master:
                 env["PADDLE_MASTER"] = args.master
+            if args.elastic_devices_file:
+                try:
+                    with open(args.elastic_devices_file) as f:
+                        env["PADDLE_ELASTIC_DEVICE_COUNT"] = \
+                            str(int(f.read().strip()))
+                except (OSError, ValueError):
+                    pass  # no file yet: workers use their own default
             if args.heartbeat_timeout > 0:
                 env["PADDLE_HEARTBEAT_FILE"] = os.path.join(
                     args.log_dir, f"heartbeat.{local_rank}")

@@ -415,6 +415,15 @@ class GPTMoEHybridTrainer(GPTHybridTrainer):
         return out["h"], self.cfg.aux_weight * jnp.mean(out["aux"])
 
     def _serial_forward(self, pblk, x):
-        body = jax.checkpoint(self._body) if self.cfg.remat else self._body
-        carry = body(pblk, {"h": x, "aux": jnp.zeros((), jnp.float32)})
+        # per-block remat inside the scan — same granularity as the base
+        # class (one recompute chunk per block, not one for all L blocks)
+        blk = jax.checkpoint(self._block_apply) if self.cfg.remat else \
+            self._block_apply
+
+        def one(c, bp):
+            out, aux_inc = blk(bp, c["h"])
+            return {"h": out, "aux": c["aux"] + aux_inc}, None
+
+        carry, _ = jax.lax.scan(
+            one, {"h": x, "aux": jnp.zeros((), jnp.float32)}, pblk)
         return carry["h"], self.cfg.aux_weight * carry["aux"]

@@ -160,3 +160,49 @@ def test_metadata_without_shards_raises(tmp_path):
     reader = _ShardReader(str(tmp_path))
     with pytest.raises(ValueError, match="cover"):
         _assemble_region(tm, reader, (slice(0, 4), slice(0, 4)))
+
+
+def test_async_save_overlaps_training_and_matches_boundary(tmp_path):
+    """Orbax-style async save (SURVEY §5, round-2 VERDICT item 6): the
+    device->host snapshot happens AT the save boundary, the write runs in
+    the background while further (donated-buffer) train steps mutate the
+    live state, and the loaded checkpoint equals the boundary state — not
+    the later one."""
+    import functools
+    m = _mesh((8,), ["dp"])
+    w = shard_tensor(np.arange(32, dtype=np.float32).reshape(8, 4),
+                     m, [Shard(0)])
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(w):
+        return w * 2.0 + 1.0
+
+    boundary = np.asarray(w)             # reference copy of the save state
+    t = save_state_dict({"w": w}, str(tmp_path), async_save=True)
+    assert t is not None
+    # keep training while the write is (possibly) in flight; donation means
+    # the old device buffer is dead — only a boundary-time host snapshot
+    # can be correct
+    for _ in range(5):
+        w = step(w)
+    from paddle_tpu.distributed.checkpoint import wait_for_pending_saves
+    wait_for_pending_saves(str(tmp_path))
+    got = load_state_dict({"w": jax.ShapeDtypeStruct((8, 4), jnp.float32)},
+                          str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(got["w"]), boundary)
+    # and the live state really moved on
+    assert not np.allclose(np.asarray(w), boundary)
+
+
+def test_async_save_rendezvous_on_next_save(tmp_path):
+    """A second save to the same path joins the in-flight write first —
+    successive checkpoints never interleave their files."""
+    m = _mesh((8,), ["dp"])
+    w1 = shard_tensor(np.ones((8, 4), np.float32), m, [Shard(0)])
+    w2 = shard_tensor(np.full((8, 4), 7.0, np.float32), m, [Shard(0)])
+    t1 = save_state_dict({"w": w1}, str(tmp_path), async_save=True)
+    save_state_dict({"w": w2}, str(tmp_path))  # sync save: must rendezvous
+    assert not t1.is_alive()                   # first write was joined
+    got = load_state_dict({"w": jax.ShapeDtypeStruct((8, 4), jnp.float32)},
+                          str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(got["w"]), 7.0)

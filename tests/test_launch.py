@@ -177,3 +177,108 @@ def test_launch_heartbeat_detects_hang(tmp_path):
     assert "heartbeat stale" in r.stderr
     logs = open(os.path.join(log_dir, "workerlog.0")).read()
     assert "HANG_RUNNER_OK" in logs
+
+
+def test_elastic_remesh_restart_8_to_4(tmp_path):
+    """Scale-in elastic restart (round-2 VERDICT item 8): run starts on an
+    8-device mesh, 'loses half the slice' (crashes after writing the new
+    device count to the elastic devices file), the watchdog relaunches,
+    the worker rebuilds a 4-device mesh and resumes from the distributed
+    checkpoint via reshard-on-load — final weights equal the uninterrupted
+    serial trajectory (dp math is degree-invariant for a fixed batch)."""
+    devfile = tmp_path / "devices.txt"
+    devfile.write_text("8")
+    script = """
+        import os, sys
+        import numpy as np
+        n = int(os.environ.get("PADDLE_ELASTIC_DEVICE_COUNT", "8"))
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+        jax.config.update("jax_default_matmul_precision", "highest")
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import paddle_tpu
+        from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                          Shard, Replicate,
+                                                          shard_tensor)
+        from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                       load_state_dict)
+
+        assert len(jax.devices()) == n, (n, jax.devices())
+        mesh = ProcessMesh(np.arange(n), dim_names=["dp"])
+        restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+
+        rs = np.random.RandomState(0)
+        xs = rs.randn(16, 8).astype(np.float32)      # fixed global batch
+        TOTAL = 6
+
+        ckpt = "ckpt"
+        if restart == 0:
+            w = shard_tensor(np.zeros((8, 1), np.float32), mesh,
+                             [Replicate()])
+            start = 0
+        else:
+            got = load_state_dict(
+                {"w": jax.ShapeDtypeStruct((8, 1), jnp.float32),
+                 "step": jax.ShapeDtypeStruct((), jnp.int32)}, ckpt)
+            # reshard-on-load: shards written by the 8-dev mesh land on
+            # the 4-dev mesh
+            w = shard_tensor(np.asarray(got["w"]), mesh, [Replicate()])
+            start = int(np.asarray(got["step"]))
+
+        x_sh = shard_tensor(xs, mesh, [Shard(0)])    # batch over dp
+
+        @jax.jit
+        def step(w, x):
+            # mean-squared push toward 1.0: grad averaged over the global
+            # batch -> identical math at any dp degree
+            y = x @ w
+            g = x.T @ (y - 1.0) / x.shape[0]
+            return w - 0.1 * g
+
+        w_cur = w
+        for s in range(start, TOTAL):
+            w_cur = step(w_cur, x_sh)
+            if restart == 0 and s == 2:
+                save_state_dict({"w": w_cur,
+                                 "step": jnp.asarray(s + 1, jnp.int32)},
+                                ckpt)
+                with open(os.environ["ELASTIC_DEVFILE"], "w") as f:
+                    f.write("4")     # half the slice 'dies'
+                os._exit(1)
+
+        # oracle: uninterrupted serial trajectory
+        w_ref = np.zeros((8, 1), np.float32)
+        for _ in range(TOTAL):
+            y = xs @ w_ref
+            w_ref = w_ref - 0.1 * (xs.T @ (y - 1.0) / xs.shape[0])
+        np.testing.assert_allclose(np.asarray(w_cur), w_ref,
+                                   rtol=1e-5, atol=1e-6)
+        with open("elastic_result.txt", "w") as f:
+            f.write(f"OK ndev={n} restart={restart}")
+    """
+    import textwrap
+    sp = tmp_path / "worker.py"
+    sp.write_text(textwrap.dedent(script))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO
+    env["PADDLE_PORT"] = "62400"
+    env["ELASTIC_DEVFILE"] = str(devfile)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log"),
+         "--max_restart", "2",
+         "--elastic_devices_file", str(devfile), str(sp)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr,
+                               open(tmp_path / "log" / "workerlog.0").read()
+                               if (tmp_path / "log" / "workerlog.0").exists()
+                               else "")
+    out = (tmp_path / "elastic_result.txt").read_text()
+    assert out == "OK ndev=4 restart=1", out
