@@ -126,7 +126,18 @@ def get_world_size(group=None) -> int:
 # cached callable can be reused across calls; jax.jit's own cache handles
 # shape/dtype specialization underneath.  Without this every eager
 # collective re-traced + re-jitted per invocation (round-1 VERDICT weak 6).
-_EAGER_CACHE: dict = {}
+# BOUNDED (FIFO eviction): keys include the Mesh, so repeated group/HCG
+# re-inits would otherwise leak every prior mesh's jitted closures +
+# compiled executables (advisor r2).
+_EAGER_CACHE_MAX = 256
+_EAGER_CACHE: "dict" = {}
+
+
+def _eager_cache_put(key, fn):
+    if len(_EAGER_CACHE) >= _EAGER_CACHE_MAX:
+        oldest = next(iter(_EAGER_CACHE))
+        _EAGER_CACHE.pop(oldest, None)
+    _EAGER_CACHE[key] = fn
 
 
 def _eager_collective(g: ParallelAxis, kind: str, per_shard_fn, x,
@@ -154,7 +165,7 @@ def _eager_collective(g: ParallelAxis, kind: str, per_shard_fn, x,
     if fn is None:
         fn = jax.jit(shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
                                out_specs=out_spec, check_vma=False))
-        _EAGER_CACHE[key] = fn
+        _eager_cache_put(key, fn)
     return fn(x)
 
 

@@ -119,6 +119,12 @@ class CollectiveController:
         self.args = args
         self.containers: List[Container] = []
         self.restarts = 0
+        # per-job RPC auth token: workers HMAC-handshake before the rpc
+        # layer unpickles anything (advisor r2: the listener executes
+        # pickled callables — gate it on a launcher-scoped secret)
+        import secrets
+        self.rpc_token = os.environ.get("PADDLE_RPC_TOKEN") or \
+            secrets.token_hex(16)
 
     def _endpoints(self) -> List[str]:
         base_port = int(os.environ.get("PADDLE_PORT", 61000))
@@ -144,6 +150,7 @@ class CollectiveController:
                 "PADDLE_LOCAL_RANK": str(local_rank),
                 "PADDLE_NNODES": str(nnodes),
                 "PADDLE_RESTART_COUNT": str(self.restarts),
+                "PADDLE_RPC_TOKEN": self.rpc_token,
             })
             if args.master:
                 env["PADDLE_MASTER"] = args.master

@@ -10,15 +10,25 @@ compiled), so this exists for the reference's control-plane uses
 one daemon listener thread per process on the worker's endpoint
 (PADDLE_TRAINER_ENDPOINTS slot, port offset +1000 to avoid the trainer
 port); requests are length-prefixed pickles of (fn, args, kwargs) executed
-in the listener's worker pool, results pickled back.  Same trust model as
-the reference (pickled callables across a private cluster network).
+each in its OWN handler thread (like TCPStore — a bounded pool would let
+blocking handlers such as ps.barrier starve arrivals beyond the pool size
+and deadlock, round-2 advisor finding), results pickled back.
+
+Authentication: when ``PADDLE_RPC_TOKEN`` is set (the launcher generates
+one per job), every connection starts with a nonce/HMAC-SHA256 handshake
+BEFORE any payload is unpickled — unauthenticated peers are dropped.
+Without a token the legacy trust model applies (pickled callables across a
+private cluster network, as in the reference's brpc transport).
 """
 
 from __future__ import annotations
 
 import concurrent.futures as futures
+import hmac
+import hashlib
 import os
 import pickle
+import secrets
 import socket
 import struct
 import threading
@@ -46,7 +56,6 @@ class _State:
         self.by_rank: Dict[int, WorkerInfo] = {}
         self.me: Optional[WorkerInfo] = None
         self.server: Optional[socket.socket] = None
-        self.pool: Optional[futures.ThreadPoolExecutor] = None
         self.thread: Optional[threading.Thread] = None
         self.stop = threading.Event()
 
@@ -76,8 +85,43 @@ def _recv_msg(sock: socket.socket) -> Any:
     return pickle.loads(bytes(buf))
 
 
-def _serve(server: socket.socket, pool: futures.ThreadPoolExecutor,
-           stop: threading.Event) -> None:
+def _token() -> Optional[bytes]:
+    t = os.environ.get("PADDLE_RPC_TOKEN")
+    return t.encode() if t else None
+
+
+def _server_handshake(conn: socket.socket) -> bool:
+    """Nonce/HMAC challenge before any unpickling; True = authenticated
+    (trivially true when no token is configured)."""
+    tok = _token()
+    if tok is None:
+        return True
+    nonce = secrets.token_bytes(16)
+    conn.sendall(nonce)
+    mac = b""
+    while len(mac) < 32:
+        chunk = conn.recv(32 - len(mac))
+        if not chunk:
+            return False
+        mac += chunk
+    want = hmac.new(tok, nonce, hashlib.sha256).digest()
+    return hmac.compare_digest(mac, want)
+
+
+def _client_handshake(sock: socket.socket) -> None:
+    tok = _token()
+    if tok is None:
+        return
+    nonce = b""
+    while len(nonce) < 16:
+        chunk = sock.recv(16 - len(nonce))
+        if not chunk:
+            raise ConnectionError("rpc server closed during handshake")
+        nonce += chunk
+    sock.sendall(hmac.new(tok, nonce, hashlib.sha256).digest())
+
+
+def _serve(server: socket.socket, stop: threading.Event) -> None:
     # timeout-polling accept: a thread parked in a blocking accept keeps
     # the listening fd alive in the kernel past close(), leaving the port
     # bound (EADDRINUSE on re-init) — poll + stop-flag instead
@@ -92,7 +136,16 @@ def _serve(server: socket.socket, pool: futures.ThreadPoolExecutor,
 
         def handle(conn=conn):
             try:
+                # handshake + request read are timed: with one thread per
+                # connection, a peer that connects and stalls must not
+                # park a thread+fd forever
+                conn.settimeout(30.0)
+                if not _server_handshake(conn):
+                    return  # unauthenticated peer: drop before unpickling
                 fn, args, kwargs = _recv_msg(conn)
+                # the handler itself may block legitimately (ps.barrier
+                # waits for all workers) — no timeout past this point
+                conn.settimeout(None)
                 try:
                     result = ("ok", fn(*args, **(kwargs or {})))
                 except Exception as e:  # ship the failure back
@@ -103,7 +156,10 @@ def _serve(server: socket.socket, pool: futures.ThreadPoolExecutor,
             finally:
                 conn.close()
 
-        pool.submit(handle)
+        # one thread per connection: handlers may legitimately BLOCK for a
+        # long time (ps.barrier parks until all workers arrive) — a shared
+        # pool would deadlock once blocked handlers exhaust it
+        threading.Thread(target=handle, daemon=True).start()
 
 
 def init_rpc(name: str, rank: Optional[int] = None,
@@ -134,12 +190,11 @@ def init_rpc(name: str, rank: Optional[int] = None,
     # bind exactly the configured interface — the listener unpickles and
     # executes payloads, so a loopback config must never listen on 0.0.0.0
     server.bind((_S.me.ip, _S.me.port))
-    server.listen(16)
+    server.listen(128)
     _S.server = server
-    _S.pool = futures.ThreadPoolExecutor(max_workers=8)
     _S.stop = threading.Event()
     _S.thread = threading.Thread(target=_serve,
-                                 args=(server, _S.pool, _S.stop),
+                                 args=(server, _S.stop),
                                  daemon=True)
     _S.thread.start()
 
@@ -187,6 +242,7 @@ def rpc_sync(to, fn, args: tuple = (), kwargs: Optional[dict] = None,
         return fn(*args, **(kwargs or {}))
     with socket.create_connection((w.ip, w.port), timeout=timeout) as sock:
         sock.settimeout(timeout)
+        _client_handshake(sock)
         _send_msg(sock, (fn, args, kwargs))
         status, payload = _recv_msg(sock)
     if status == "err":
@@ -233,10 +289,7 @@ def shutdown() -> None:
             _S.server.close()
         except OSError:
             pass
-    if _S.pool is not None:
-        _S.pool.shutdown(wait=False)
     _S.server = None
-    _S.pool = None
     _S.thread = None
     _S.me = None
     _S.workers.clear()

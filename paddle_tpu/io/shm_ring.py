@@ -76,7 +76,12 @@ class ShmRing:
     inherit the mapping, so the same handle works in workers.  Objects are
     pickled (protocol 5) straight into a slot."""
 
+    PUSH_TIMEOUT = -1
     PUSH_OVERSIZE = -2
+    # permanent failures (robust-mutex lock failure / unexpected cond-wait
+    # error, e.g. EINVAL): the ring is dead, not merely full/empty
+    LOCK_FAIL = -4
+    WAIT_ERROR = -5
 
     def __init__(self, slot_size: int = 16 << 20, n_slots: int = 8):
         lib = _load()
@@ -93,14 +98,19 @@ class ShmRing:
         return self._lib.rb_push(self._h, data, len(data), timeout_ms)
 
     def put(self, obj, timeout_ms: int = 100) -> int:
-        """0 on success, -1 timeout, -2 oversize (caller falls back)."""
+        """0 on success, PUSH_TIMEOUT, PUSH_OVERSIZE (caller falls back),
+        or LOCK_FAIL/WAIT_ERROR for a dead ring."""
         return self.put_bytes(pickle.dumps(obj, protocol=5), timeout_ms)
 
     def get(self, timeout_ms: int = 100):
-        """Returns the object, or None on timeout."""
+        """Returns the object, or None on timeout.  A permanent ring
+        failure (LOCK_FAIL/WAIT_ERROR) raises instead of masquerading as
+        an endless sequence of timeouts."""
         if self._buf is None:
             self._buf = ctypes.create_string_buffer(self.slot_size)
         n = self._lib.rb_pop(self._h, self._buf, self.slot_size, timeout_ms)
+        if n in (self.LOCK_FAIL, self.WAIT_ERROR):
+            raise RuntimeError(f"shm ring is dead (rb_pop rc={n})")
         if n < 0:
             return None
         return pickle.loads(self._buf.raw[:n])

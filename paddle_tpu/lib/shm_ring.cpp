@@ -18,7 +18,9 @@
 // C ABI (ctypes-consumed; see paddle_tpu/io/shm_ring.py):
 //   rb_create(slot_size, n_slots) -> handle (mmap base) or NULL
 //   rb_push(h, data, len, timeout_ms) -> 0 ok / -1 timeout / -2 oversize
+//                                          / -4 lock fail / -5 wait error
 //   rb_pop(h, out, cap, timeout_ms) -> payload len / -1 timeout / -3 small
+//                                      / -4 lock fail / -5 wait error
 //   rb_size(h) -> filled slot count
 //   rb_destroy(h) -> munmap
 
@@ -115,14 +117,22 @@ int rb_push(void* base, const void* data, uint64_t len, int timeout_ms) {
   Header* h = static_cast<Header*>(base);
   if (len > h->slot_size) return -2;
   if (lock(h) != 0) return -4;
+  // absolute deadline computed ONCE: spurious wakeups / EOWNERDEAD must
+  // not extend the wait (advisor r2)
+  timespec ts;
+  abstime_in(timeout_ms, &ts);
   while (h->count == h->n_slots) {
-    timespec ts;
-    abstime_in(timeout_ms, &ts);
     int rc = pthread_cond_timedwait(&h->not_full, &h->mu, &ts);
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
-    else if (rc == ETIMEDOUT && h->count == h->n_slots) {
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
+      if (h->count == h->n_slots) {
+        pthread_mutex_unlock(&h->mu);
+        return -1;
+      }
+    } else if (rc != 0) {  // EINVAL etc.: error out, never spin forever
       pthread_mutex_unlock(&h->mu);
-      return -1;
+      return -5;
     }
   }
   uint64_t i = h->head;
@@ -138,14 +148,20 @@ int rb_push(void* base, const void* data, uint64_t len, int timeout_ms) {
 int64_t rb_pop(void* base, void* out, uint64_t cap, int timeout_ms) {
   Header* h = static_cast<Header*>(base);
   if (lock(h) != 0) return -4;
+  timespec ts;
+  abstime_in(timeout_ms, &ts);
   while (h->count == 0) {
-    timespec ts;
-    abstime_in(timeout_ms, &ts);
     int rc = pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
-    else if (rc == ETIMEDOUT && h->count == 0) {
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
+      if (h->count == 0) {
+        pthread_mutex_unlock(&h->mu);
+        return -1;
+      }
+    } else if (rc != 0) {  // EINVAL etc.: error out, never spin forever
       pthread_mutex_unlock(&h->mu);
-      return -1;
+      return -5;
     }
   }
   uint64_t i = h->tail;

@@ -68,3 +68,59 @@ def test_ps_two_processes(tmp_path):
 def test_ps_barrier_local():
     ps.init_server()
     ps.barrier()          # must not rely on unpicklable payloads
+
+
+def test_rpc_handshake_auth(tmp_path, monkeypatch):
+    """With PADDLE_RPC_TOKEN set, a peer with the wrong token is dropped
+    BEFORE any payload is unpickled; the right token round-trips
+    (advisor r2: the listener executes pickled callables — gate it)."""
+    import hashlib
+    import hmac as hmac_mod
+    import operator
+    import pickle
+    import socket
+    import struct
+    from paddle_tpu.distributed import rpc
+
+    monkeypatch.setenv("PADDLE_RPC_TOKEN", "s3cret")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:62890")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    rpc.init_rpc("w0", rank=0, world_size=1)
+    try:
+        addr = ("127.0.0.1", 63890)  # endpoint port + rpc offset
+
+        def send_req(sock, payload):
+            data = pickle.dumps(payload, protocol=5)
+            sock.sendall(struct.pack("<Q", len(data)) + data)
+
+        # wrong mac: server closes without executing or replying — the
+        # close may surface as EOF or as RST (reset/broken pipe) depending
+        # on timing; all three mean "dropped"
+        s = socket.create_connection(addr, timeout=10)
+        nonce = s.recv(16)
+        assert len(nonce) == 16
+        s.sendall(b"x" * 32)
+        try:
+            send_req(s, (operator.add, (1, 2), {}))
+            s.settimeout(10)
+            assert s.recv(1) == b""
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        s.close()
+
+        # right mac: full round trip
+        s2 = socket.create_connection(addr, timeout=10)
+        nonce2 = s2.recv(16)
+        s2.sendall(hmac_mod.new(b"s3cret", nonce2,
+                                hashlib.sha256).digest())
+        send_req(s2, (operator.add, (1, 2), {}))
+        hdr = s2.recv(8)
+        n = struct.unpack("<Q", hdr)[0]
+        buf = b""
+        while len(buf) < n:
+            buf += s2.recv(n - len(buf))
+        status, val = pickle.loads(buf)
+        assert (status, val) == ("ok", 3)
+        s2.close()
+    finally:
+        rpc.shutdown()
