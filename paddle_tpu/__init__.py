@@ -126,3 +126,69 @@ def __getattr__(name):
         globals()["DataParallel"] = DataParallel
         return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+from . import linalg  # noqa: F401,E402
+
+import builtins as _builtins  # noqa: E402
+_py_bool = _builtins.bool
+_static_mode = [False]
+
+
+def set_grad_enabled(mode):
+    """Context manager parity: paddle.set_grad_enabled(bool)."""
+    from .autograd import no_grad as _ng, enable_grad as _eg
+    return _eg() if mode else _ng()
+
+
+def in_dynamic_mode() -> _py_bool:
+    """True — eager (dygraph) is the only mode; jit.to_static compiles
+    functions without a global static-graph switch (documented stance:
+    Program/Executor have no analog, SURVEY §2.2)."""
+    return not _static_mode[0]
+
+
+def enable_static():
+    """Records static-mode intent for API parity.  The TPU-native stack
+    compiles through jit.to_static / jax.jit rather than a global
+    program-builder mode; this flag only flips in_dynamic_mode()."""
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-by-layer parameter summary (reference: paddle.summary).
+    Prints a table and returns {"total_params", "trainable_params"}."""
+    _sum = _builtins.sum   # paddle.sum shadows the builtin here
+    rows = []
+    for name, sub in net.named_sublayers(include_self=False):
+        n = _sum(int(p.size) for p in sub._parameters.values()
+                 if p is not None)
+        if n or not _has_sublayers(sub):
+            rows.append((name or "(root)", type(sub).__name__, n))
+    total = _sum(int(p.size) for _, p in net.named_parameters())
+    frozen = 0
+    for _, sub in net.named_sublayers(include_self=True):
+        for pname in getattr(sub, "_non_trainable", ()):
+            par = sub._parameters.get(pname)
+            if par is not None:
+                frozen += int(par.size)
+    width = _builtins.max([len(r[0]) for r in rows] + [10])
+    print(f"{'Layer':<{width}}  {'Type':<24}  Params")
+    print("-" * (width + 34))
+    for nm, ty, n in rows:
+        print(f"{nm:<{width}}  {ty:<24}  {n}")
+    print("-" * (width + 34))
+    print(f"Total params: {total}")
+    return {"total_params": total, "trainable_params": total - frozen}
+
+
+def _has_sublayers(layer):
+    for _ in layer.named_sublayers(include_self=False):
+        return True
+    return False

@@ -79,18 +79,33 @@ def jvp(func: Callable, xs, v=None):
 @contextlib.contextmanager
 def no_grad():
     """Parity context: in a functional engine nothing records by default;
-    provided so reference code runs unchanged.  For actually stopping
-    gradient flow use jax.lax.stop_gradient / Tensor stop_gradient."""
-    yield
+    provided so reference code runs unchanged (the flag it flips is
+    observable via is_grad_enabled, matching the reference contract).
+    For actually stopping gradient flow use jax.lax.stop_gradient /
+    Tensor stop_gradient."""
+    prev = _GRAD_MODE[0]
+    _GRAD_MODE[0] = False
+    try:
+        yield
+    finally:
+        _GRAD_MODE[0] = prev
 
 
 @contextlib.contextmanager
 def enable_grad():
-    yield
+    prev = _GRAD_MODE[0]
+    _GRAD_MODE[0] = True
+    try:
+        yield
+    finally:
+        _GRAD_MODE[0] = prev
+
+
+_GRAD_MODE = [True]
 
 
 def is_grad_enabled() -> bool:
-    return True
+    return _GRAD_MODE[0]
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):

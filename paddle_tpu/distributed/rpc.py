@@ -150,6 +150,9 @@ def _serve(server: socket.socket, stop: threading.Event) -> None:
                     result = ("ok", fn(*args, **(kwargs or {})))
                 except Exception as e:  # ship the failure back
                     result = ("err", e)
+                # reply send is timed too: a peer that stops reading must
+                # not park this thread in sendall forever
+                conn.settimeout(30.0)
                 _send_msg(conn, result)
             except Exception:
                 pass

@@ -92,3 +92,57 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return jnp.fft.ifftshift(x, axes=axes)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a Hermitian-symmetric signal (real output)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D FFT of a Hermitian-symmetric signal -> real output.  Identity
+    (validated vs scipy.fft.hfftn): hfftn(x) = irfftn(conj(x)) * scale,
+    scale = N / sqrt(N) / 1 for backward/ortho/forward, N = prod of output
+    sizes over ``axes``."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    out = jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes)
+    n_total = 1
+    for a in axes:
+        n_total *= out.shape[a]
+    scale = {"backward": float(n_total),
+             "ortho": float(n_total) ** 0.5,
+             "forward": 1.0}[norm]
+    return out * scale
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn (validated vs scipy.fft.ihfftn):
+    ihfftn(y) = conj(rfftn(y)) / scale over the INPUT sizes."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    if s is not None:
+        sizes = tuple(int(v) for v in s)
+    else:
+        sizes = tuple(x.shape[a] for a in axes)
+    out = jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes))
+    m_total = 1
+    for v in sizes:
+        m_total *= v
+    scale = {"backward": float(m_total),
+             "ortho": float(m_total) ** 0.5,
+             "forward": 1.0}[norm]
+    return out / scale
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

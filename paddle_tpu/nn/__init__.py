@@ -5,6 +5,7 @@ from .functional_call import (  # noqa: F401
     functional_call, state, parameters_dict, buffers_dict, bind_state,
     TrainState)
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from . import functional  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import (  # noqa: F401

@@ -200,3 +200,49 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     probs = jax.nn.softmax(logits, axis=-1).astype(value.dtype)
     out = jnp.einsum("hqk,khd->qhd", probs, value)
     return (out, None)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """CSR-masked attention (reference: F.sparse_attention,
+    sparse_attention_op): softmax runs only over each query row's CSR
+    column set.  q/k/v [B, H, S, D]; offset [B, H, S+1]; columns
+    [B, H, nnz].
+
+    TPU-native: static-shape mask materialization + dense MXU matmuls —
+    on TPU the structured-sparsity win comes from blockwise masking
+    inside the flash kernel (flash_attention_varlen covers the varlen
+    case); this op exists for API/semantics parity at CSR granularity.
+    """
+    q = jnp.asarray(query).astype(jnp.float32)
+    k = jnp.asarray(key).astype(jnp.float32)
+    v = jnp.asarray(value).astype(jnp.float32)
+    b, h, s, d = q.shape
+    off = jnp.asarray(sparse_csr_offset).reshape(b * h, s + 1)
+    cols = jnp.asarray(sparse_csr_columns).reshape(b * h, -1)
+    nnz = cols.shape[-1]
+
+    def row_mask(off_i, cols_i):
+        rows = jnp.searchsorted(off_i, jnp.arange(nnz),
+                                side="right") - 1
+        rows = jnp.clip(rows, 0, s - 1)
+        valid = jnp.arange(nnz) < off_i[-1]
+        m = jnp.zeros((s, s), bool)
+        return m.at[rows, cols_i].max(valid)
+
+    mask = jax.vmap(row_mask)(off, cols).reshape(b, h, s, s)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / (d ** 0.5)
+    if attn_mask is not None:
+        scores = scores + jnp.asarray(attn_mask)
+    if key_padding_mask is not None:
+        kp = jnp.asarray(key_padding_mask).astype(bool)
+        mask = mask & kp[:, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    p = jnp.where(mask, p, 0.0)   # rows with empty column sets -> 0
+    out = jnp.einsum("bhst,bhtd->bhsd", p, v)
+    return out.astype(jnp.asarray(query).dtype)
+
+
+__all__ += ["sparse_attention"]

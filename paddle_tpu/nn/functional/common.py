@@ -276,3 +276,61 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
     img_shape = (n, c, oh, ow)
     _, vjp = jax.vjp(_unfold_fn, jnp.zeros(img_shape, x.dtype))
     return vjp(x)[0]
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False, name=None):
+    """p-norm of x - y along the last dim (reference:
+    F.pairwise_distance)."""
+    d = jnp.asarray(x) - jnp.asarray(y) + epsilon
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+    elif p == 2.0:
+        out = jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1,
+                                           keepdims=keepdim), 0.0))
+    else:
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1,
+                      keepdims=keepdim) ** (1.0 / p)
+    return out
+
+
+def class_center_sample(label, num_classes: int, num_samples: int,
+                        group=None, seed=None, name=None):
+    """Sample class centers for partial-FC training (reference:
+    class_center_sample op): every positive class is kept, negatives fill
+    up to ``num_samples``; returns (remapped_label, sampled_class_center)
+    with the sampled centers sorted ascending.  Static shapes: the output
+    is always [num_samples].  Fresh negatives are drawn per call from the
+    global RNG stream; pass ``seed`` for a deterministic draw.
+
+    The batch must not contain more than ``num_samples`` distinct labels
+    (the reference grows its output instead; here shapes are static, so
+    overflow raises when detectable eagerly)."""
+    import numpy as _np
+    import jax as _jax
+    lbl = jnp.asarray(label).astype(jnp.int32).reshape(-1)
+    if not isinstance(lbl, _jax.core.Tracer):
+        n_pos = len(_np.unique(_np.asarray(lbl)))
+        if n_pos > num_samples:
+            raise ValueError(
+                f"batch has {n_pos} distinct classes > num_samples="
+                f"{num_samples}; raise num_samples (static-shape output "
+                f"cannot grow like the reference's)")
+    pos = jnp.zeros((num_classes,), jnp.float32).at[lbl].set(1.0)
+    if seed is not None:
+        key = _jax.random.PRNGKey(seed)
+    else:
+        from ...framework.random import next_rng_key
+        key = next_rng_key()
+    u = _jax.random.uniform(key, (num_classes,))
+    score = pos * 2.0 + u            # positives always beat negatives
+    _, picked = _jax.lax.top_k(score, num_samples)
+    sampled = jnp.sort(picked)
+    # remap: position of each label inside the sorted sample
+    remapped = jnp.searchsorted(sampled, lbl).astype(jnp.int32)
+    return remapped, sampled
+
+
+__all__ += ["pairwise_distance", "class_center_sample"]

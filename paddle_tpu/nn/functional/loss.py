@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -291,3 +292,160 @@ def poisson_nll_loss(input, label, log_input: bool = True,
                     + 0.5 * jnp.log(2 * jnp.pi * label))
         out = out + jnp.where(label > 1, stirling, 0.0)
     return _reduce(out, reduction)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction: str = "mean", name=None):
+    """Multi-class margin loss (reference: multi_margin_loss; torch
+    semantics: mean over classes of max(0, margin - x_y + x_i)^p)."""
+    x = jnp.asarray(input)
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    n, c = x.shape
+    x_y = jnp.take_along_axis(x, lbl[:, None], axis=1)       # [N, 1]
+    m = jnp.maximum(0.0, margin - x_y + x)                   # [N, C]
+    if p != 1:
+        m = m ** p
+    if weight is not None:
+        m = m * jnp.asarray(weight)[lbl][:, None]
+    # the true-class term contributes margin^p; zero it like the reference
+    m = m * (1.0 - jax.nn.one_hot(lbl, c, dtype=x.dtype))
+    out = jnp.sum(m, axis=1) / c
+    return _reduce(out, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin: float = 1.0,
+                                      swap: bool = False,
+                                      reduction: str = "mean", name=None):
+    """Triplet loss with a custom distance callable (reference:
+    triplet_margin_with_distance_loss)."""
+    if distance_function is None:
+        def distance_function(a, b):
+            return jnp.sqrt(jnp.maximum(
+                jnp.sum((a - b) ** 2, axis=-1), 1e-12))
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, distance_function(positive, negative))
+    out = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce(out, reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: F.hsigmoid_loss /
+    hierarchical_sigmoid_op).  Default tree: complete binary tree over
+    ``num_classes`` leaves, depth D = ceil(log2(C)); internal node ids are
+    the heap path of (label + C) >> k, matching the reference's default
+    coding (code = bit, node index = heap parent - 1).
+
+    weight: [num_classes - 1, D_in]; bias: [num_classes - 1].
+    Custom trees ride path_table [N, L] (node ids, -1 padded) and
+    path_code [N, L] (0/1 codes).
+    """
+    x = jnp.asarray(input)
+    lbl = jnp.asarray(label).astype(jnp.int32).reshape(-1)
+    if path_table is None:
+        c = int(num_classes)
+        depth = max(int(np.ceil(np.log2(c))), 1)
+        heap = lbl + c                                  # leaf heap id
+        ks = jnp.arange(depth, 0, -1)                   # D..1
+        anc = (heap[:, None] >> ks[None, :])            # ancestors, root..  
+        codes = (heap[:, None] >> (ks[None, :] - 1)) & 1
+        nodes = anc - 1                                 # node index
+        valid = anc >= 1
+    else:
+        nodes = jnp.asarray(path_table).astype(jnp.int32)
+        codes = jnp.asarray(path_code).astype(jnp.int32)
+        valid = nodes >= 0
+        nodes = jnp.maximum(nodes, 0)
+    w = jnp.asarray(weight)[nodes]                      # [N, L, D_in]
+    logits = jnp.einsum("nld,nd->nl", w, x)
+    if bias is not None:
+        logits = logits + jnp.asarray(bias).reshape(-1)[nodes]
+    # code 1 -> sigmoid(logit), 0 -> 1 - sigmoid(logit); NLL over the path
+    ll = jax.nn.log_sigmoid(logits) * codes + \
+        jax.nn.log_sigmoid(-logits) * (1 - codes)
+    # reference returns the per-sample cost [N, 1], NO reduction
+    return -jnp.sum(jnp.where(valid, ll, 0.0), axis=1, keepdims=True)
+
+
+def margin_cross_entropy(logits, label, margin1: float = 1.0,
+                         margin2: float = 0.5, margin3: float = 0.0,
+                         scale: float = 64.0, group=None,
+                         return_softmax: bool = False,
+                         reduction: str = "mean", name=None):
+    """ArcFace/CosFace-style margin softmax CE (reference:
+    margin_cross_entropy_op; PLSC's headline loss).  cos(theta) logits get
+    cos(m1*theta + m2) - m3 on the true class, then scaled CE.  ``group``
+    names a mesh axis for class-parallel logits (vocab-sharded semantics —
+    GSPMD reduces over it)."""
+    x = jnp.asarray(logits).astype(jnp.float32)
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    cos_t = jnp.clip(jnp.take_along_axis(x, lbl[:, None], axis=1),
+                     -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(lbl, x.shape[-1], dtype=x.dtype)
+    adjusted = x * (1 - onehot) + target * onehot
+    z = adjusted * scale
+    m = jnp.max(z, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(z - m), axis=-1, keepdims=True))
+    picked = jnp.take_along_axis(z, lbl[:, None], axis=1)
+    loss = (lse - picked)[:, 0]
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(z, axis=-1)
+    return loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive (clustered) softmax (reference:
+    F.adaptive_log_softmax_with_loss; Grave et al. 2017).
+
+    ``cutoffs`` INCLUDES the total class count as its last element
+    ([shortlist, c1, ..., n_classes]); head_weight [D, H] with
+    H = cutoffs[0] + n_clusters; each tail_weights[i] is the pair
+    ([D, d_i], [d_i, size_i]) low-rank projection, matching the reference
+    layer's parameter layout.  Returns (per-sample log-prob of the true
+    class, mean NLL loss).
+    """
+    x = jnp.asarray(input)
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    cutoffs = list(cutoffs)
+    n_clusters = len(cutoffs) - 1
+    shortlist = cutoffs[0]
+    head_logits = x @ jnp.asarray(head_weight)
+    if head_bias is not None:
+        head_logits = head_logits + jnp.asarray(head_bias)
+    head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+
+    in_short = lbl < shortlist
+    safe_short = jnp.where(in_short, lbl, 0)
+    out = jnp.take_along_axis(head_logp, safe_short[:, None], axis=1)[:, 0]
+    out = jnp.where(in_short, out, 0.0)
+
+    # cluster i covers label span [cutoffs[i], cutoffs[i+1])
+    spans = [(cutoffs[i], cutoffs[i + 1]) for i in range(n_clusters)]
+    for i, (lo_i, hi_i) in enumerate(spans):
+        proj, emb = tail_weights[i]
+        tail_logp = jax.nn.log_softmax(
+            (x @ jnp.asarray(proj)) @ jnp.asarray(emb), axis=-1)
+        in_c = (lbl >= lo_i) & (lbl < hi_i)
+        safe = jnp.where(in_c, lbl - lo_i, 0)
+        cluster_lp = head_logp[:, shortlist + i]
+        lp = cluster_lp + jnp.take_along_axis(
+            tail_logp, safe[:, None], axis=1)[:, 0]
+        out = jnp.where(in_c, lp, out)
+    loss = -jnp.mean(out)
+    return out, loss
+
+
+__all__ += ["multi_margin_loss", "triplet_margin_with_distance_loss",
+            "hsigmoid_loss", "margin_cross_entropy",
+            "adaptive_log_softmax_with_loss"]

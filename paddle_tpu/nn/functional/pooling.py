@@ -83,19 +83,34 @@ def _pool(x, n, kernel, stride, padding, kind, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if data_format == "NLC":
+            raise ValueError("return_mask needs channel-first layout")
+        return _max_pool_with_mask(x, 1, kernel_size, stride, padding,
+                                   ceil_mode)
     df = "NWC" if data_format == "NLC" else "NCW"
-    out = _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode, data_format=df)
-    return out
+    return _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode,
+                 data_format=df)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask needs channel-first layout")
+        return _max_pool_with_mask(x, 2, kernel_size, stride, padding,
+                                   ceil_mode)
     return _pool(x, 2, kernel_size, stride, padding, "max", ceil_mode,
                  data_format=data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError("return_mask needs channel-first layout")
+        return _max_pool_with_mask(x, 3, kernel_size, stride, padding,
+                                   ceil_mode)
     return _pool(x, 3, kernel_size, stride, padding, "max", ceil_mode,
                  data_format=data_format)
 
@@ -185,3 +200,103 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, "max", "NCDHW")
+
+
+# --- round-3 additions: max-pool argmax masks + max_unpool family --------
+# (reference: paddle/phi unpool kernels; mask = flat index into the input
+# spatial map, exactly what max_poolXd(return_mask=True) hands out)
+
+def _max_pool_with_mask(x, n, kernel, stride, padding, ceil_mode=False):
+    """Channel-first max pool returning (out, mask).  Pads with the dtype
+    minimum and extracts windows via conv_general_dilated_patches so the
+    argmax is taken over real elements only."""
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_cfg(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("return_mask needs explicit int padding")
+    pad = [tuple(pp) for pp in pad]
+    if ceil_mode:
+        # extend upper padding so the last partial window is included
+        # (same rule as _pool's ceil_mode branch)
+        for i in range(n):
+            size = x.shape[2 + i] + pad[i][0] + pad[i][1]
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                pad[i] = (pad[i][0], pad[i][1] + stride[i] - rem)
+    # finite minimum, NOT -inf: patch extraction lowers to a conv and
+    # -inf * 0 = nan would poison padded windows
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype) if \
+        jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + list(pad), constant_values=neg)
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=kernel, window_strides=stride,
+        padding=[(0, 0)] * n)
+    N, C = x.shape[0], x.shape[1]
+    OS = patches.shape[2:]
+    K = int(np.prod(kernel))
+    pat = patches.reshape((N, C, K) + OS)
+    out = jnp.max(pat, axis=2)
+    loc = jnp.argmax(pat, axis=2)          # local flat idx within window
+    S = x.shape[2:]
+    rem = loc
+    flat_global = jnp.zeros_like(loc)
+    for i in range(n):
+        kprod = int(np.prod(kernel[i + 1:]))
+        ki = rem // kprod
+        rem = rem % kprod
+        origin = jnp.arange(OS[i]) * stride[i] - pad[i][0]
+        shape = [1] * (2 + n)
+        shape[2 + i] = OS[i]
+        gi = ki + origin.reshape(shape)
+        flat_global = flat_global * S[i] + gi
+    return out, flat_global.astype(jnp.int32)
+
+
+def _unpool_out_size(in_size, kernel, stride, pad):
+    return (in_size - 1) * stride - 2 * pad + kernel
+
+
+def _max_unpool(x, indices, n, kernel_size, stride, padding, output_size,
+                data_format):
+    if not data_format.startswith("NC"):
+        raise ValueError("max_unpool supports channel-first only "
+                         "(reference restriction)")
+    kernel = _tuple(kernel_size, n)
+    stride_t = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _tuple(padding, n)
+    N, C = x.shape[0], x.shape[1]
+    if output_size is None:
+        out_s = tuple(_unpool_out_size(x.shape[2 + i], kernel[i],
+                                       stride_t[i], pad[i])
+                      for i in range(n))
+    else:
+        out_s = tuple(output_size)[-n:]
+    total = int(np.prod(out_s))
+    vals = x.reshape(N, C, -1)
+    idx = jnp.asarray(indices).reshape(N, C, -1).astype(jnp.int32)
+    flat = jnp.zeros((N, C, total), x.dtype)
+    flat = flat.at[jnp.arange(N)[:, None, None],
+                   jnp.arange(C)[None, :, None], idx].set(vals)
+    return flat.reshape((N, C) + out_s)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+__all__ += ["max_unpool1d", "max_unpool2d", "max_unpool3d"]

@@ -184,3 +184,28 @@ class ThresholdedReLU(Layer):
 
     def forward(self, x):
         return F.thresholded_relu(x, self.threshold)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference:
+    nn.Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3-D or 4-D input")
+        return F.softmax(x, axis=-3)
+
+
+class GumbelSoftmax(Layer):
+    def __init__(self, temperature=1.0, hard=False, axis=-1, name=None):
+        super().__init__()
+        self.temperature, self.hard, self.axis = temperature, hard, axis
+
+    def forward(self, x):
+        return F.gumbel_softmax(x, temperature=self.temperature,
+                                hard=self.hard, axis=self.axis)
+
+
+__all__ += ["Softmax2D", "GumbelSoftmax"]

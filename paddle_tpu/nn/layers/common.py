@@ -273,3 +273,39 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+class CircularPad2D(_PadND):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "circular", 0.0, data_format, name)
+
+
+class CircularPad3D(_PadND):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "circular", 0.0, data_format, name)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                   keepdim=self.keepdim)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten as _unflatten
+        return _unflatten(x, self.axis, self.shape)
+
+
+__all__ += ["CircularPad2D", "CircularPad3D", "PairwiseDistance",
+            "Unflatten"]

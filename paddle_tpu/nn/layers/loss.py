@@ -163,3 +163,169 @@ class TripletMarginLoss(Layer):
         return F.triplet_margin_loss(input, positive, negative, self.margin,
                                      self.p, self.epsilon, self.swap,
                                      self.reduction)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, reduction=self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon = full, epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, full=self.full,
+                                   epsilon=self.epsilon,
+                                   reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, log_input=self.log_input,
+                                  full=self.full, epsilon=self.epsilon,
+                                  reduction=self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self.p,
+                                   margin=self.margin, weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference: nn.HSigmoidLoss): owns the
+    [num_classes-1, feature_size] internal-node weight table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_classes - 1,), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Clustered softmax head (reference: nn.AdaptiveLogSoftmaxWithLoss;
+    Grave et al.).  ``cutoffs`` EXCLUDES n_classes (reference signature);
+    tail cluster i projects to dim in_features / div_value**(i+1)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        shortlist = self.cutoffs[0]
+        head_size = shortlist + self.n_clusters
+        self.head_weight = self.create_parameter(
+            (in_features, head_size), default_initializer=I.XavierUniform())
+        self.head_bias = None
+        if head_bias:
+            self.head_bias = self.create_parameter(
+                (head_size,), is_bias=True)
+        self._tails = []
+        for i in range(self.n_clusters):
+            d = max(1, int(in_features / (div_value ** (i + 1))))
+            size = self.cutoffs[i + 1] - self.cutoffs[i]
+            setattr(self, f"tail_{i}_proj", self.create_parameter(
+                (in_features, d), default_initializer=I.XavierUniform()))
+            setattr(self, f"tail_{i}_emb", self.create_parameter(
+                (d, size), default_initializer=I.XavierUniform()))
+            self._tails.append((f"tail_{i}_proj", f"tail_{i}_emb"))
+
+    def forward(self, input, label):
+        tails = [(self._parameters[p], self._parameters[e])
+                 for p, e in self._tails]
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, tails, self.cutoffs,
+            head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+        x = jnp.asarray(input)
+        head_logits = jnp.matmul(x, jnp.asarray(self.head_weight))
+        if self.head_bias is not None:
+            head_logits = head_logits + jnp.asarray(self.head_bias)
+        head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+        shortlist = self.cutoffs[0]
+        parts = [head_logp[:, :shortlist]]
+        for i in range(self.n_clusters):
+            pw = jnp.asarray(self._parameters[f"tail_{i}_proj"])
+            ew = jnp.asarray(self._parameters[f"tail_{i}_emb"])
+            tail_logp = jax.nn.log_softmax(
+                jnp.matmul(jnp.matmul(x, pw), ew), axis=-1)
+            parts.append(head_logp[:, shortlist + i:shortlist + i + 1]
+                         + tail_logp)
+        return jnp.concatenate(parts, axis=-1)
+
+    def predict(self, input):
+        import jax.numpy as jnp
+        return jnp.argmax(self.log_prob(input), axis=-1)
+
+
+__all__ += ["SoftMarginLoss", "MultiLabelSoftMarginLoss", "GaussianNLLLoss",
+            "PoissonNLLLoss", "MultiMarginLoss",
+            "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+            "AdaptiveLogSoftmaxWithLoss"]

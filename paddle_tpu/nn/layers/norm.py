@@ -222,3 +222,44 @@ class LocalResponseNorm(Layer):
     def forward(self, x):
         return F.local_response_norm(x, self.size, self.alpha, self.beta,
                                      self.k, self.data_format)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a WEIGHT tensor (reference:
+    nn.SpectralNorm — forward(weight) returns weight / sigma, with the
+    power-iteration vectors carried as buffers)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        import numpy as _np
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = int(weight_shape[dim])
+        w = int(_np.prod(weight_shape)) // h
+        rs = _np.random.RandomState(0)
+        self.register_buffer("weight_u", jnp.asarray(
+            rs.randn(h).astype(_np.float32)))
+        self.register_buffer("weight_v", jnp.asarray(
+            rs.randn(w).astype(_np.float32)))
+
+    def forward(self, weight):
+        w = jnp.moveaxis(jnp.asarray(weight), self.dim, 0)
+        mat = w.reshape(w.shape[0], -1)
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.epsilon)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.epsilon)
+        self.weight_u = u
+        self.weight_v = v
+        sigma = u @ mat @ v
+        out = mat / sigma
+        return jnp.moveaxis(out.reshape(w.shape), 0, self.dim)
+
+
+__all__ += ["SpectralNorm"]

@@ -141,3 +141,52 @@ class AdaptiveMaxPool3D(_AdaptivePool):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+class _MaxUnPoolND(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.output_size = padding, output_size
+        self.data_format = data_format
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self.kernel_size,
+                              stride=self.stride, padding=self.padding,
+                              data_format=self.data_format,
+                              output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolND):
+    _fn = staticmethod(F.max_unpool1d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
+
+class MaxUnPool2D(_MaxUnPoolND):
+    _fn = staticmethod(F.max_unpool2d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
+
+class MaxUnPool3D(_MaxUnPoolND):
+    _fn = staticmethod(F.max_unpool3d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
+
+__all__ += ["MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]

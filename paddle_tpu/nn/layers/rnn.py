@@ -298,3 +298,94 @@ class LSTM(_RNNStack):
 
 class GRU(_RNNStack):
     CELL = GRUCell
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+RNNCellBase = _RNNCellBase  # public alias (reference: nn.RNNCellBase)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell (reference:
+    nn.BeamSearchDecoder + dynamic_decode, seq2seq text generation).
+
+    TPU-native: the whole decode is one ``lax.scan`` over time with a
+    static ``beam_size`` — beams live on a leading [B*K] batch axis,
+    length-penalty-free log-prob accumulation, finished beams propagate
+    END tokens.  ``decode(init_cell_states, max_steps)`` returns
+    (token ids [B, K, T], scores [B, K]) sorted best-first.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def decode(self, init_states, max_steps: int):
+        import jax
+        K = self.beam_size
+
+        def expand(t):
+            return jnp.repeat(t, K, axis=0)  # [B,...] -> [B*K,...]
+
+        states = jax.tree.map(expand, init_states)
+        leaf0 = jax.tree_util.tree_leaves(init_states)[0]
+        B = leaf0.shape[0]
+        neg_inf = jnp.asarray(-1e9, jnp.float32)
+        # only beam 0 of each batch row is live at t=0 (others -inf so the
+        # first top-k doesn't pick duplicate roots)
+        scores = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1),
+                                      jnp.float32), (B,))     # [B*K]
+        tokens0 = jnp.full((B * K,), self.start_token, jnp.int32)
+        finished0 = jnp.zeros((B * K,), bool)
+
+        def step(carry, _):
+            tokens, scores, finished, states = carry
+            inp = self.embedding_fn(tokens) if self.embedding_fn \
+                else jax.nn.one_hot(tokens, self.cell.input_size)
+            out, new_states = self.cell(inp, states)
+            logits = self.output_fn(out) if self.output_fn else out
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1)     # [B*K, V]
+            V = logp.shape[-1]
+            # finished beams only extend with END at zero cost
+            end_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+            logp = jnp.where(finished[:, None], end_only[None, :], logp)
+            total = scores[:, None] + logp               # [B*K, V]
+            flat = total.reshape(B, K * V)
+            top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+            beam_idx = top_idx // V                       # source beam
+            tok = (top_idx % V).astype(jnp.int32)
+            src = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+            new_states = jax.tree.map(lambda s: s[src], new_states)
+            new_tokens = tok.reshape(-1)
+            new_scores = top_scores.reshape(-1)
+            new_finished = finished[src] | (new_tokens == self.end_token)
+            return ((new_tokens, new_scores, new_finished, new_states),
+                    (new_tokens, src))
+
+        (tokens, scores, finished, _), (toks, srcs) = jax.lax.scan(
+            step, (tokens0, scores, finished0, states), None,
+            length=max_steps)
+        # backtrace: follow src pointers from the last step
+        T = max_steps
+
+        def back(carry, t_rev):
+            ptr = carry                                  # [B*K]
+            tok = toks[t_rev][ptr]
+            ptr = srcs[t_rev][ptr]
+            return ptr, tok
+
+        ptr0 = jnp.arange(B * self.beam_size)
+        _, rev = jax.lax.scan(back, ptr0, jnp.arange(T - 1, -1, -1))
+        seq = jnp.flip(rev, axis=0).T                    # [B*K, T]
+        return (seq.reshape(B, self.beam_size, T),
+                scores.reshape(B, self.beam_size))
+
+
+__all__ += ["RNNCellBase", "BeamSearchDecoder"]

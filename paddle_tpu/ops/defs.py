@@ -750,3 +750,81 @@ register_op("batch_norm_infer",
             _sample(lambda: _mk(2, 3, 4, 4), lambda: _pos(3), lambda: _mk(3),
                     lambda: _mk(3), lambda: _pos(3)),
             rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------- round-3 ops
+# OP_COVERAGE.md additions: numpy oracles per op
+
+register_op("add_n", lambda a, b, c: T.add_n([a, b, c]),
+            lambda a, b, c: a + b + c,
+            _sample(lambda: _mk(3, 4), lambda: _mk(3, 4), lambda: _mk(3, 4)),
+            grad_args=(0, 1, 2))
+_unary("sinc", T.sinc, np.sinc)
+register_op("floor_mod", T.floor_mod, np.mod,
+            _sample(lambda: _mk(3, 4), lambda: _pos(3, 4)))
+register_op("mm", T.mm, np.matmul,
+            _sample(lambda: _mk(3, 4), lambda: _mk(4, 5)), grad_args=(0, 1))
+register_op("trapezoid", lambda y: T.trapezoid(y, dx=0.5),
+            lambda y: np.trapezoid(y, dx=0.5), _sample(lambda: _mk(3, 8)),
+            grad_args=(0,))
+register_op("cumulative_trapezoid", lambda y: T.cumulative_trapezoid(y, dx=0.5),
+            lambda y: np.cumsum((y[..., :-1] + y[..., 1:]) * 0.25, axis=-1),
+            _sample(lambda: _mk(3, 8)), grad_args=(0,))
+register_op("pdist", T.pdist,
+            lambda x: np.sqrt(np.maximum((
+                (x[:, None, :] - x[None, :, :]) ** 2).sum(-1), 0))[
+                np.triu_indices(x.shape[0], k=1)],
+            _sample(lambda: _mk(5, 3)))
+register_op("tensordot", lambda x, y: T.tensordot(x, y, axes=1),
+            lambda x, y: np.tensordot(x, y, axes=1),
+            _sample(lambda: _mk(3, 4), lambda: _mk(4, 5)), grad_args=(0, 1))
+register_op("isneginf", T.isneginf, np.isneginf, _sample(lambda: _mk(3, 4)))
+register_op("isposinf", T.isposinf, np.isposinf, _sample(lambda: _mk(3, 4)))
+register_op("gammainc", T.gammainc,
+            lambda x, y: __import__("scipy.special", fromlist=["x"]).gammainc(x, y),
+            _sample(lambda: _pos(3, 4), lambda: _pos(3, 4)))
+register_op("gammaincc", T.gammaincc,
+            lambda x, y: __import__("scipy.special", fromlist=["x"]).gammaincc(x, y),
+            _sample(lambda: _pos(3, 4), lambda: _pos(3, 4)))
+register_op("multigammaln", lambda x: T.multigammaln(x, 3),
+            lambda x: __import__("scipy.special", fromlist=["x"]).multigammaln(x, 3),
+            _sample(lambda: _mk(3, 4, lo=2.0, hi=5.0)))
+register_op("cat", lambda a, b: T.cat([a, b], axis=1),
+            lambda a, b: np.concatenate([a, b], axis=1),
+            _sample(lambda: _mk(3, 2), lambda: _mk(3, 5)), grad_args=(0, 1))
+register_op("column_stack", lambda a, b: T.column_stack([a, b]),
+            lambda a, b: np.column_stack([a, b]),
+            _sample(lambda: _mk(4,), lambda: _mk(4,)), grad_args=(0, 1))
+register_op("fliplr", T.fliplr, np.fliplr, _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("flipud", T.flipud, np.flipud, _sample(lambda: _mk(3, 4)),
+            grad_args=(0,))
+register_op("permute", lambda x: T.permute(x, 2, 0, 1),
+            lambda x: np.transpose(x, (2, 0, 1)),
+            _sample(lambda: _mk(2, 3, 4)), grad_args=(0,))
+register_op("unflatten", lambda x: T.unflatten(x, 1, (2, 3)),
+            lambda x: x.reshape(x.shape[0], 2, 3),
+            _sample(lambda: _mk(4, 6)), grad_args=(0,))
+register_op("diag_embed", T.diag_embed,
+            lambda x: np.stack([np.diag(r) for r in x]),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("index_fill",
+            lambda x: T.index_fill(x, np.array([0, 2]), 1, 9.0),
+            lambda x: _index_fill_ref(x, [0, 2], 1, 9.0),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,))
+register_op("histogram_bin_edges",
+            lambda x: T.histogram_bin_edges(x, bins=8, min=-1, max=1),
+            lambda x: np.histogram_bin_edges(x, bins=8, range=(-1, 1)),
+            _sample(lambda: _mk(20,)))
+register_op("pairwise_distance", F.pairwise_distance,
+            lambda x, y: np.sqrt(np.maximum(
+                ((x - y + 1e-6) ** 2).sum(-1), 0)),
+            _sample(lambda: _mk(4, 6), lambda: _mk(4, 6)), grad_args=(0, 1))
+
+
+def _index_fill_ref(x, idx, axis, value):
+    out = np.array(x)
+    sl = [slice(None)] * out.ndim
+    sl[axis] = idx
+    out[tuple(sl)] = value
+    return out

@@ -192,3 +192,87 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         x = x - jnp.mean(x, axis=-2, keepdims=True)
     u, s, vh = jnp.linalg.svd(x, full_matrices=False)
     return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+def matrix_exp(x, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.expm(x)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu output: packed LU ``x`` [.., M, N] and
+    1-based pivots ``y`` [.., K] -> (P, L, U)."""
+    x = jnp.asarray(x)
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(x[..., :, :k], -1) + jnp.eye(m, k, dtype=x.dtype)
+    U = jnp.triu(x[..., :k, :])
+    P = None
+    if unpack_pivots:
+        piv = jnp.asarray(y).astype(jnp.int32) - 1   # 0-based
+
+        def perm_of(p1):
+            perm = jnp.arange(m)
+
+            def body(i, perm):
+                j = p1[i]
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj)
+                return perm.at[j].set(pi)
+            return jax.lax.fori_loop(0, p1.shape[0], body, perm)
+
+        flat_piv = piv.reshape((-1, piv.shape[-1]))
+        perms = jax.vmap(perm_of)(flat_piv)
+        perms = perms.reshape(piv.shape[:-1] + (m,))
+        P = jax.nn.one_hot(perms, m, dtype=x.dtype)
+        P = jnp.swapaxes(P, -2, -1)
+    if not unpack_ludata:
+        L = U = None
+    return P, L, U
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by the Q of a householder QR (reference:
+    paddle.linalg.ormqr).  Q here is the FULL m x m product of the
+    reflectors (not the reduced first-n-columns householder_product
+    returns), matching LAPACK ormqr semantics.  Batched inputs vmap over
+    the leading dims."""
+    x = jnp.asarray(x)
+    if x.ndim > 2:
+        return jax.vmap(lambda xi, ti, oi: ormqr(xi, ti, oi, left,
+                                                 transpose))(
+            x, jnp.asarray(tau), jnp.asarray(other))
+    m, n = x.shape
+    q = jnp.eye(m, dtype=x.dtype)
+    for i in range(n):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[:, i])
+        v = v.at[i].set(1.0)
+        h = jnp.eye(m, dtype=x.dtype) - tau[i] * jnp.outer(v, v)
+        q = q @ h
+    qm = q.T if transpose else q
+    return jnp.matmul(qm, other) if left else jnp.matmul(other, qm)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: paddle.linalg.svd_lowrank;
+    Halko et al. subspace iteration, like pca_lowrank without centering)."""
+    x = jnp.asarray(x)
+    if M is not None:
+        x = x - jnp.asarray(M)
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(q, m, n)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, x.shape[:-2] + (n, q), dtype=x.dtype)
+    y = jnp.matmul(x, omega)
+    Q, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        Q, _ = jnp.linalg.qr(jnp.matmul(jnp.swapaxes(x, -2, -1), Q))
+        Q, _ = jnp.linalg.qr(jnp.matmul(x, Q))
+    B = jnp.matmul(jnp.swapaxes(Q, -2, -1), x)
+    u_b, s, vh = jnp.linalg.svd(B, full_matrices=False)
+    return jnp.matmul(Q, u_b), s, jnp.swapaxes(vh, -2, -1)
+
+
+__all__ += ["matrix_exp", "lu_unpack", "ormqr", "svd_lowrank"]

@@ -72,3 +72,24 @@ def any(x, axis=None, keepdim=False, name=None):
     if isinstance(axis, (list, tuple)):
         axis = tuple(axis)
     return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+def is_complex(x, name=None):
+    return bool(jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x, name=None):
+    return bool(jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating))
+
+
+def is_integer(x, name=None):
+    return bool(jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer))
+
+
+def isreal(x, name=None):
+    return jnp.isreal(jnp.asarray(x))
+
+
+__all__ += ["is_complex", "is_floating_point", "is_integer", "isreal"]

@@ -400,3 +400,108 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
     for a, s, e, st in zip(axes, starts, ends, strides):
         idx[a] = builtins_slice(int(s), int(e), int(st))
     return x.at[tuple(idx)].set(value)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md) ----------------------
+
+def cat(x, axis=0, name=None):
+    return jnp.concatenate([jnp.asarray(t) for t in x], axis=axis)
+
+
+def column_stack(x, name=None):
+    return jnp.column_stack([jnp.asarray(t) for t in x])
+
+
+def fliplr(x, name=None):
+    return jnp.fliplr(x)
+
+
+def flipud(x, name=None):
+    return jnp.flipud(x)
+
+
+def permute(x, *perm, name=None):
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return jnp.transpose(x, perm)
+
+
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape = tuple(x.shape[axis] // known if s == -1 else s
+                      for s in shape)
+    new_shape = x.shape[:axis] + shape + x.shape[axis + 1:]
+    return x.reshape(new_shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis``: result gains a trailing window dim
+    (reference: paddle.unfold / Tensor.unfold)."""
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    starts = jnp.arange(0, n - size + 1, step)
+    def win(s):
+        return jax.lax.dynamic_slice_in_dim(x, s, size, axis=axis)
+    out = jax.vmap(win)(starts)          # [W, ..., size at axis, ...]
+    # move the window-count dim next to axis, window content trailing
+    out = jnp.moveaxis(out, 0, axis)     # [..., W, ...size...]
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """View-by-strides over the flattened tensor (reference:
+    paddle.as_strided).  Implemented as a gather over computed flat
+    indices — functional, not aliasing."""
+    flat = x.reshape(-1)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return flat[idx.reshape(-1)].reshape(shape)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference: paddle.diag_embed)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1] + abs(int(offset))
+    base_ndim = x.ndim + 1
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    r = jnp.arange(x.shape[-1])
+    rows = r + (-offset if offset < 0 else 0)
+    cols = r + (offset if offset > 0 else 0)
+    out = out.at[..., rows, cols].set(x)
+    d1 = dim1 % base_ndim
+    d2 = dim2 % base_ndim
+    if (d1, d2) != (base_ndim - 2, base_ndim - 1):
+        src_rows, src_cols = base_ndim - 2, base_ndim - 1
+        full = list(range(base_ndim - 2))
+        order = []
+        k = 0
+        for i in range(base_ndim):
+            if i == d1:
+                order.append(src_rows)
+            elif i == d2:
+                order.append(src_cols)
+            else:
+                order.append(full[k])
+                k += 1
+        out = jnp.transpose(out, order)
+    return out
+
+
+def index_fill(x, index, axis, value, name=None):
+    index = jnp.asarray(index).astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+__all__ += ["cat", "column_stack", "fliplr", "flipud", "permute",
+            "unflatten", "unfold", "as_strided", "diag_embed", "index_fill"]

@@ -601,3 +601,110 @@ def signbit(x, name=None):
 
 def nextafter(x, y, name=None):
     return jnp.nextafter(x, y)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md; reference:
+# python/paddle/tensor/math.py) ------------------------------------------
+
+def add_n(inputs, name=None):
+    """Sum of a list of tensors (reference: paddle.add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        return jnp.asarray(inputs)
+    out = jnp.asarray(inputs[0])
+    for x in inputs[1:]:
+        out = out + jnp.asarray(x)
+    return out
+
+
+def floor_mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+def mm(input, mat2, name=None):
+    return jnp.matmul(input, mat2)
+
+
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+def multigammaln(x, p, name=None):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(y, x=jnp.asarray(x), axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = jnp.asarray(y)
+    n = y.shape[axis]
+    ya = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    yb = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = jax.lax.slice_in_dim(x, 1, n, axis=axis) - \
+            jax.lax.slice_in_dim(x, 0, n - 1, axis=axis)
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum((ya + yb) * d / 2.0, axis=axis)
+
+
+def pdist(x, p: float = 2.0, name=None):
+    """Condensed pairwise distances of rows (reference: paddle.pdist)."""
+    n = x.shape[0]
+    diff = x[:, None, :] - x[None, :, :]
+    if p == 2.0:
+        dm = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+    elif p == float("inf"):
+        dm = jnp.max(jnp.abs(diff), axis=-1)
+    else:
+        dm = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu = jnp.triu_indices(n, k=1)
+    return dm[iu]
+
+
+def polar(abs, angle, name=None):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 and \
+            all(isinstance(a, (list, tuple)) for a in axes):
+        axes = tuple(tuple(a) for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def isneginf(x, name=None):
+    return jnp.isneginf(x)
+
+
+def isposinf(x, name=None):
+    return jnp.isposinf(x)
+
+
+def tolist(x, name=None):
+    """Python nested list of the tensor's values (host transfer)."""
+    import numpy as _np
+    return _np.asarray(x).tolist()
+
+
+__all__ += ["add_n", "floor_mod", "mm", "sinc", "multigammaln", "gammainc",
+            "gammaincc", "trapezoid", "cumulative_trapezoid", "pdist",
+            "polar", "tensordot", "isneginf", "isposinf", "tolist"]

@@ -85,3 +85,18 @@ def binomial(count, prob, name=None):
 
 def standard_gamma(x, name=None):
     return jax.random.gamma(next_rng_key(), x)
+
+
+def geometric_(x, probs, name=None):
+    """Geometric(probs) samples with x's shape (reference: Tensor.
+    geometric_; functional here — jax arrays are immutable, the sampled
+    array is RETURNED, same convention as exponential_)."""
+    p = jnp.broadcast_to(jnp.asarray(probs, jnp.float32), jnp.shape(x))
+    u = jax.random.uniform(next_rng_key(), jnp.shape(x), minval=1e-7,
+                           maxval=1.0)
+    # support {1, 2, ...}: number of Bernoulli(p) trials to first success
+    return jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(
+        jnp.asarray(x).dtype)
+
+
+__all__ += ["geometric_"]

@@ -54,3 +54,18 @@ def bincount(x, weights=None, minlength=0, name=None):
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
     return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
                         method=interpolation)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """Bin edges matching paddle.histogram's range convention (min==max==0
+    -> data range)."""
+    x = jnp.asarray(input)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = float(jnp.min(x)), float(jnp.max(x))
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5
+    return jnp.linspace(lo, hi, int(bins) + 1)
+
+
+__all__ += ["histogram_bin_edges"]

@@ -1,0 +1,203 @@
+"""Generate OP_COVERAGE.md: repo public surface vs the reference op list.
+
+Round-2 VERDICT item 9: "commit a generated OP_COVERAGE.md diffing the
+repo's public tensor/nn surface against the reference's op list".
+
+Provenance: the reference mount (/root/reference) has been EMPTY for three
+rounds, so the reference list below is CURATED from the reference's
+published stable-2.x Python API documentation (the YAML-generated op
+surface exposed through python/paddle/*), not extracted from a tree.  It
+deliberately covers the user-facing namespaces a migrating user touches
+(paddle.*, paddle.linalg, paddle.nn, paddle.nn.functional, paddle.fft,
+paddle.signal) rather than internal _C_ops.  Names that are pure aliases
+in the reference (e.g. paddle.max vs Tensor.max) appear once.
+
+Run:  python scripts/gen_op_coverage.py   (writes OP_COVERAGE.md)
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# --------------------------------------------------------------------------
+# curated reference surface (paddle 2.x docs), by namespace
+# --------------------------------------------------------------------------
+
+PADDLE_TOP = """
+abs acos acosh add add_n addmm all allclose amax amin angle any arange
+argmax argmin argsort as_complex as_real as_strided asin asinh assign atan
+atan2 atanh atleast_1d atleast_2d atleast_3d bernoulli bincount bitwise_and
+bitwise_left_shift bitwise_not bitwise_or bitwise_right_shift bitwise_xor
+bmm broadcast_shape broadcast_tensors broadcast_to bucketize cast cat ceil
+chunk clip clone column_stack combinations complex concat conj cos cosh
+count_nonzero cross cummax cummin cumprod cumsum cumulative_trapezoid deg2rad
+diag diag_embed diagflat diagonal diagonal_scatter diff digamma dist divide
+dot dsplit dstack einsum empty empty_like equal equal_all erf erfinv exp
+expand expand_as expm1 eye flatten flip fliplr flipud floor floor_divide
+floor_mod fmax fmin frac frexp full full_like gammainc gammaincc gammaln
+gather gather_nd gcd geometric_ greater_equal greater_than heaviside
+histogram histogram_bin_edges histogramdd hsplit hstack hypot i0 i0e i1 i1e
+imag increment index_add index_fill index_put index_sample index_select
+inner is_complex is_empty is_floating_point is_grad_enabled is_integer
+is_tensor isclose isfinite isin isinf isnan isneginf isposinf isreal kron
+kthvalue lcm ldexp lerp less_equal less_than lgamma linspace log log10
+log1p log2 logaddexp logcumsumexp logical_and logical_not logical_or
+logical_xor logit logspace logsumexp masked_fill masked_scatter
+masked_select matmul max maximum mean median meshgrid min minimum mm mod
+mode moveaxis multigammaln multinomial multiplex multiply mv nan_to_num
+nanmean nanmedian nanquantile nansum neg nextafter nonzero norm normal
+not_equal numel ones ones_like outer pdist permute poisson polar polygamma
+pow prod put_along_axis quantile rad2deg rand randint randint_like randn
+randperm rank real reciprocal remainder renorm repeat_interleave reshape
+roll rot90 round rsqrt scale scatter scatter_nd scatter_nd_add
+searchsorted select_scatter sgn shape shard_index sign signbit sin sinc
+sinh slice slice_scatter sort split sqrt square squeeze stack stanh std
+strided_slice subtract sum t take take_along_axis tan tanh tensor_split
+tensordot tile to_tensor tolist topk trace transpose trapezoid tril
+tril_indices triu triu_indices trunc unbind unflatten unfold uniform
+unique unique_consecutive unsqueeze unstack vander var view view_as vsplit
+vstack where zeros zeros_like
+save load seed no_grad set_grad_enabled get_default_dtype
+set_default_dtype is_compiled_with_cuda in_dynamic_mode enable_static
+disable_static grad flops summary
+"""
+
+PADDLE_LINALG = """
+cholesky cholesky_solve cond corrcoef cov det eig eigh eigvals eigvalsh
+householder_product inv lstsq lu lu_unpack matrix_exp matrix_norm
+matrix_power matrix_rank multi_dot norm ormqr pca_lowrank pinv qr slogdet
+solve svd svd_lowrank triangular_solve vector_norm
+"""
+
+PADDLE_NN = """
+AdaptiveAvgPool1D AdaptiveAvgPool2D AdaptiveAvgPool3D AdaptiveLogSoftmaxWithLoss
+AdaptiveMaxPool1D AdaptiveMaxPool2D AdaptiveMaxPool3D AlphaDropout AvgPool1D
+AvgPool2D AvgPool3D BCELoss BCEWithLogitsLoss BatchNorm BatchNorm1D
+BatchNorm2D BatchNorm3D BeamSearchDecoder Bilinear CELU CTCLoss ChannelShuffle
+CircularPad2D CircularPad3D Conv1D Conv1DTranspose Conv2D Conv2DTranspose
+Conv3D Conv3DTranspose CosineEmbeddingLoss CosineSimilarity CrossEntropyLoss
+Dropout Dropout2D Dropout3D ELU Embedding Flatten Fold GELU GLU GRU GRUCell
+GaussianNLLLoss GroupNorm GumbelSoftmax Hardshrink Hardsigmoid Hardswish
+Hardtanh HingeEmbeddingLoss HSigmoidLoss Identity InstanceNorm1D
+InstanceNorm2D InstanceNorm3D KLDivLoss L1Loss LSTM LSTMCell LayerDict
+LayerList LayerNorm LeakyReLU Linear LocalResponseNorm LogSigmoid LogSoftmax
+MSELoss MarginRankingLoss MaxPool1D MaxPool2D MaxPool3D MaxUnPool1D
+MaxUnPool2D MaxUnPool3D Maxout MultiHeadAttention MultiLabelSoftMarginLoss
+MultiMarginLoss NLLLoss Pad1D Pad2D Pad3D PairwiseDistance ParameterList
+PixelShuffle PixelUnshuffle PoissonNLLLoss PReLU RNN RNNCellBase RReLU ReLU
+ReLU6 SELU Sequential SiLU Sigmoid SimpleRNN SimpleRNNCell SmoothL1Loss
+Softmax Softmax2D SoftMarginLoss Softplus Softshrink Softsign
+SpectralNorm SyncBatchNorm Tanh Tanhshrink Transformer TransformerDecoder
+TransformerDecoderLayer TransformerEncoder TransformerEncoderLayer
+TripletMarginLoss TripletMarginWithDistanceLoss Unflatten Unfold Upsample
+UpsamplingBilinear2D UpsamplingNearest2D ZeroPad2D
+Layer initializer utils functional
+"""
+
+PADDLE_NN_F = """
+adaptive_avg_pool1d adaptive_avg_pool2d adaptive_avg_pool3d
+adaptive_log_softmax_with_loss adaptive_max_pool1d adaptive_max_pool2d
+adaptive_max_pool3d affine_grid alpha_dropout avg_pool1d avg_pool2d
+avg_pool3d batch_norm bilinear binary_cross_entropy
+binary_cross_entropy_with_logits celu channel_shuffle class_center_sample
+conv1d conv1d_transpose conv2d conv2d_transpose conv3d conv3d_transpose
+cosine_embedding_loss cosine_similarity cross_entropy ctc_loss dice_loss
+dropout dropout2d dropout3d elu embedding feature_alpha_dropout fold
+gather_tree gaussian_nll_loss gelu glu grid_sample group_norm
+gumbel_softmax hardshrink hardsigmoid hardswish hardtanh hinge_embedding_loss
+hsigmoid_loss instance_norm interpolate kl_div l1_loss label_smooth
+layer_norm leaky_relu linear local_response_norm log_loss log_sigmoid
+log_softmax margin_cross_entropy margin_ranking_loss max_pool1d max_pool2d
+max_pool3d max_unpool1d max_unpool2d max_unpool3d maxout mish mse_loss
+multi_label_soft_margin_loss multi_margin_loss nll_loss normalize
+npair_loss one_hot pad pairwise_distance pixel_shuffle pixel_unshuffle
+poisson_nll_loss prelu relu relu6 rrelu scaled_dot_product_attention selu
+sequence_mask sigmoid sigmoid_focal_loss silu smooth_l1_loss soft_margin_loss
+softmax softmax_with_cross_entropy softplus softshrink softsign
+sparse_attention square_error_cost swish tanhshrink temporal_shift
+triplet_margin_loss triplet_margin_with_distance_loss unfold upsample
+zeropad2d
+"""
+
+PADDLE_FFT = """
+fft fft2 fftfreq fftn fftshift hfft hfft2 hfftn ifft ifft2 ifftn ifftshift
+ihfft ihfft2 ihfftn irfft irfft2 irfftn rfft rfft2 rfftfreq rfftn
+"""
+
+PADDLE_SIGNAL = """
+istft stft
+"""
+
+REFERENCE = {
+    "paddle": PADDLE_TOP,
+    "paddle.linalg": PADDLE_LINALG,
+    "paddle.nn": PADDLE_NN,
+    "paddle.nn.functional": PADDLE_NN_F,
+    "paddle.fft": PADDLE_FFT,
+    "paddle.signal": PADDLE_SIGNAL,
+}
+
+# repo namespace that answers for each reference namespace
+TARGETS = {
+    "paddle": "paddle_tpu",
+    "paddle.linalg": "paddle_tpu.linalg",
+    "paddle.nn": "paddle_tpu.nn",
+    "paddle.nn.functional": "paddle_tpu.nn.functional",
+    "paddle.fft": "paddle_tpu.fft",
+    "paddle.signal": "paddle_tpu.signal",
+}
+
+
+def main():
+    out = ["# OP coverage vs reference public API",
+           "",
+           "Generated by `python scripts/gen_op_coverage.py` — do not edit.",
+           "",
+           "Reference list provenance: curated from the reference's stable",
+           "2.x Python API docs (the mount at /root/reference is empty; see",
+           "SURVEY.md §0).  One row per public callable a migrating user",
+           "would import.",
+           ""]
+    total_ref = total_have = 0
+    details = []
+    for ns, names_blob in REFERENCE.items():
+        names = sorted(set(names_blob.split()))
+        tmod_name = TARGETS[ns]
+        try:
+            tmod = __import__(tmod_name, fromlist=["x"])
+        except Exception as e:
+            out.append(f"## {ns} -> {tmod_name}: IMPORT FAILED: {e}")
+            continue
+        missing = [n for n in names if not hasattr(tmod, n)]
+        have = len(names) - len(missing)
+        total_ref += len(names)
+        total_have += have
+        pct = 100.0 * have / len(names)
+        details.append((ns, tmod_name, len(names), have, pct, missing))
+    out.append("| reference namespace | repo module | ops | covered | % |")
+    out.append("|---|---|---|---|---|")
+    for ns, tm, n, have, pct, _m in details:
+        out.append(f"| {ns} | {tm} | {n} | {have} | {pct:.1f} |")
+    out.append(f"| **total** | | **{total_ref}** | **{total_have}** | "
+               f"**{100.0 * total_have / max(total_ref, 1):.1f}** |")
+    out.append("")
+    for ns, tm, n, have, pct, missing in details:
+        if not missing:
+            continue
+        out.append(f"## Missing in {tm} ({len(missing)})")
+        out.append("")
+        out.append(", ".join(f"`{m}`" for m in missing))
+        out.append("")
+    path = os.path.join(ROOT, "OP_COVERAGE.md")
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"wrote {path}: {total_have}/{total_ref} "
+          f"({100.0 * total_have / max(total_ref, 1):.1f}%)")
+    for ns, tm, n, have, pct, missing in details:
+        print(f"  {ns}: {have}/{n} ({pct:.1f}%) missing={len(missing)}")
+
+
+if __name__ == "__main__":
+    main()
