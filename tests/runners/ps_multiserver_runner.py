@@ -1,0 +1,59 @@
+"""3-process PS: ranks 0,1 = sharded servers, rank 2 = worker exercising
+dense routing, hash-sharded sparse rows, async push, and geo-SGD."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+import paddle_tpu.distributed.ps as ps
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+SERVERS = [0, 1]
+if rank in SERVERS:
+    ps.init_server(server_rank=rank, name=f"ps_server{rank}",
+                   server_ranks=SERVERS)
+    if rank != 0:
+        time.sleep(0.5)              # rank 0's listener hosts the barrier
+    ps.barrier(3)                    # all endpoints up
+    ps.barrier(3)                    # worker done
+    print(f"PS_SERVER{rank}_OK")
+else:
+    time.sleep(0.8)                  # let server sockets come up
+    ps.init_worker(server_ranks=SERVERS)
+    ps.barrier(3)
+
+    # dense: stable routing + push/pull round trip
+    ps.create_table("w", shape=(2, 2), lr=0.1)
+    ps.push("w", np.ones((2, 2), np.float32))
+    w = ps.pull("w")
+    assert abs(float(w[0, 0]) + 0.1) < 1e-6, w
+
+    # sparse: rows hash-shard over BOTH servers; ids 0..5 hit both
+    ps.create_table("emb", sparse_dim=3, lr=0.5)
+    ids = np.arange(6)
+    rows = ps.pull_sparse("emb", ids)
+    assert rows.shape == (6, 3) and float(rows.sum()) == 0.0
+    ps.push_sparse("emb", ids, np.ones((6, 3), np.float32))
+    rows2 = ps.pull_sparse("emb", ids)
+    assert np.allclose(rows2, -0.5), rows2
+
+    # async push: drains and lands
+    ps.create_table("a", shape=(4,), lr=1.0)
+    for _ in range(5):
+        ps.push_async("a", np.ones(4, np.float32))
+    ps.wait_async()
+    assert np.allclose(ps.pull("a"), -5.0), ps.pull("a")
+
+    # geo-SGD: local steps + delta sync reach the server
+    ps.create_table("g", shape=(3,), lr=0.1)
+    geo = ps.GeoWorker("g", geo_steps=4, lr=0.1)
+    for _ in range(8):
+        geo.step(np.ones(3, np.float32))
+    # 8 local steps of -0.1 -> delta -0.8 pushed in two syncs
+    assert np.allclose(ps.pull("g"), -0.8), ps.pull("g")
+
+    ps.barrier(3)
+    print("PS_MULTI_WORKER_OK")
+ps.shutdown()
