@@ -113,7 +113,6 @@ class PSServer:
 
 
 _SERVER: Optional[PSServer] = None
-_SERVER_RANK = 0
 _SERVER_RANKS = [0]          # multi-server set; table/row routing below
 
 
@@ -162,9 +161,10 @@ def init_server(server_rank: int = 0, name: str = "ps_server",
     """Start the RPC endpoint and host tables on this process (reference:
     fleet.init_server + run_server).  ``server_ranks`` lists the FULL
     server set for sharded deployments (default: just this one)."""
-    global _SERVER_RANK, _SERVER_RANKS
-    _SERVER_RANK = server_rank
-    _SERVER_RANKS = list(server_ranks) if server_ranks else [server_rank]
+    global _SERVER_RANKS
+    # sorted: routing is positional, so every participant must see the
+    # server set in the SAME order regardless of how they passed it
+    _SERVER_RANKS = sorted(server_ranks) if server_ranks else [server_rank]
     rpc.init_rpc(name)
     return _srv()
 
@@ -172,9 +172,8 @@ def init_server(server_rank: int = 0, name: str = "ps_server",
 def init_worker(server_rank: int = 0, name: Optional[str] = None,
                 server_ranks=None) -> None:
     """Reference: fleet.init_worker — connect to the server set."""
-    global _SERVER_RANK, _SERVER_RANKS
-    _SERVER_RANK = server_rank
-    _SERVER_RANKS = list(server_ranks) if server_ranks else [server_rank]
+    global _SERVER_RANKS
+    _SERVER_RANKS = sorted(server_ranks) if server_ranks else [server_rank]
     import os
     rpc.init_rpc(name or f"trainer{os.environ.get('PADDLE_TRAINER_ID', 0)}")
 
@@ -230,12 +229,12 @@ def _split_by_server(ids):
 
 def pull_sparse(name: str, ids) -> np.ndarray:
     flat, groups = _split_by_server(ids)
+    # fan out to all shard servers concurrently, then reassemble
+    futs = [(poss, rpc.rpc_async(r, _h_pull_sparse, (name, rids)))
+            for r, (rids, poss) in groups.items() if rids]
     out = [None] * len(flat)
-    for r, (rids, poss) in groups.items():
-        if not rids:
-            continue
-        rows = rpc.rpc_sync(r, _h_pull_sparse, (name, rids))
-        for p, row in zip(poss, rows):
+    for poss, fut in futs:
+        for p, row in zip(poss, fut.result()):
             out[p] = row
     return np.stack(out)
 
@@ -243,10 +242,10 @@ def pull_sparse(name: str, ids) -> np.ndarray:
 def push_sparse(name: str, ids, grads, lr: Optional[float] = None) -> None:
     flat, groups = _split_by_server(ids)
     g = np.asarray(grads).reshape(len(flat), -1)
-    for r, (rids, poss) in groups.items():
-        if not rids:
-            continue
-        rpc.rpc_sync(r, _h_push_sparse, (name, rids, g[poss], lr))
+    futs = [rpc.rpc_async(r, _h_push_sparse, (name, rids, g[poss], lr))
+            for r, (rids, poss) in groups.items() if rids]
+    for fut in futs:
+        fut.result()
 
 
 _BARRIER_LOCK = threading.Lock()
@@ -333,5 +332,7 @@ class GeoWorker:
 
 
 def shutdown() -> None:
-    wait_async()
-    rpc.shutdown()
+    try:
+        wait_async()
+    finally:
+        rpc.shutdown()
