@@ -25,16 +25,21 @@ Supported subset (documented; the reference converts a larger one):
     path) and the both-branches-return pattern;
   * ``while`` over tensor predicates (loop-carried variables are the
     names assigned in the body — their shape/dtype must be loop
-    invariant, the usual ``lax.while_loop`` contract);
+    invariant, the usual ``lax.while_loop`` contract), INCLUDING
+    ``break``/``continue`` via the reference's flag rewriting
+    (BreakContinueTransformer): jumps become carried boolean flags, the
+    statements after a potential jump run under a not-jumped guard, and
+    ``break`` kills the loop condition;
   * ``for <i> in range(...)`` with traced bounds (rewritten to a while);
   * arbitrary nesting of the above.
 
 NOT converted — left as plain Python, which stays correct for concrete
 values and raises a clear error if the predicate is traced:
-  * loops containing ``break``/``continue`` (the reference converts these
-    via flag rewriting; here the loop raises at trace time with guidance
-    to use ``lax``/masking directly);
-  * ``return`` inside only one branch of a data-dependent ``if``;
+  * ``for``-loops containing ``break``/``continue`` with traced bounds
+    (the increment interleaves with continue guards; plain-Python ranges
+    are unaffected);
+  * ``return`` inside only one branch of a data-dependent ``if``, or
+    inside a loop body;
   * ``for x in <tensor>`` needs no conversion (static trip count —
     tracing unrolls it).
 
@@ -105,7 +110,10 @@ def _diagnose_undefined(outs_a, outs_b, names, what, cause):
             raise Dy2StaticError(
                 f"variable '{n}' may be undefined after this {what}: it is "
                 f"bound on only one path; bind it before the "
-                f"tensor-dependent statement") from cause
+                f"tensor-dependent statement (note: break/continue "
+                f"rewriting guards the statements after a jump with an "
+                f"if — a temporary first bound after a jump needs a "
+                f"pre-loop binding)") from cause
 
 
 # ---------------------------------------------------------------------------
@@ -132,9 +140,28 @@ def convert_if(pred, true_fn, false_fn, args=(), names=()):
 
 
 def convert_while(cond_fn, body_fn, init=(), names=()):
-    """Dispatch a ``while``: traced condition -> lax.while_loop."""
+    """Dispatch a ``while``: traced condition -> lax.while_loop.
+
+    Loop-local temporaries (vars first bound INSIDE the body, entering as
+    Undefined) are materialized as zeros of the body's output shape —
+    the reference's dy2static does the same with fill-constant
+    placeholders.  Sound because the body provably writes them before the
+    value is observed (a read-before-write of an Undefined fails the
+    eval_shape probe and falls through to the clear diagnosis); if the
+    loop runs zero iterations the variable is zeros instead of unbound
+    (documented deviation, same as the reference)."""
     first = cond_fn(*init)
     if _is_tracer(first) or _contains_tracer(init):
+        if any(isinstance(v, _Undefined) for v in init):
+            try:
+                out = jax.eval_shape(lambda vs: body_fn(*vs), tuple(init))
+                init = tuple(
+                    jnp.zeros(o.shape, o.dtype)
+                    if isinstance(v, _Undefined)
+                    and not isinstance(o, _Undefined) else v
+                    for v, o in zip(init, out))
+            except Exception:
+                pass  # let while_loop raise into the diagnosis below
         try:
             return jax.lax.while_loop(lambda vs: cond_fn(*vs),
                                       lambda vs: body_fn(*vs), tuple(init))
@@ -354,18 +381,25 @@ class _Transformer(ast.NodeTransformer):
                         args=[test, ast.Constant(reason)], keywords=[])
 
     def _undef_preamble(self, names):
-        """try: v\nexcept NameError: v = Undefined('v') for each name."""
+        """try: v\nexcept NameError: v = Undefined('v') for each name.
+        Jump-rewrite flags (``_jstflag_*``) initialize to False instead:
+        they are plain booleans owned by the converter, and an inner
+        loop's flags legitimately first bind inside an OUTER loop's body
+        (they must be carryable, not Undefined)."""
         stmts = []
         for n in names:
+            if n.startswith("_jstflag_"):
+                default = ast.Constant(False)
+            else:
+                default = ast.Call(func=self._jst("Undefined"),
+                                   args=[ast.Constant(n)], keywords=[])
             stmts.append(ast.Try(
                 body=[ast.Expr(ast.Name(id=n, ctx=ast.Load()))],
                 handlers=[ast.ExceptHandler(
                     type=ast.Name(id="NameError", ctx=ast.Load()), name=None,
                     body=[ast.Assign(
                         targets=[ast.Name(id=n, ctx=ast.Store())],
-                        value=ast.Call(func=self._jst("Undefined"),
-                                       args=[ast.Constant(n)],
-                                       keywords=[]))])],
+                        value=default)])],
                 orelse=[], finalbody=[]))
         return stmts
 
@@ -423,10 +457,14 @@ class _Transformer(ast.NodeTransformer):
 
         modified = _assigned_names(node.body + node.orelse)
         if not modified:
-            # pure side-effect-free-on-locals branch (e.g. list.append):
-            # python semantics; guard against traced predicates
-            node.test = self._py_only_wrap(
-                test, "branch assigns no local variables")
+            # pure side-effect-only branch (e.g. list.append): python
+            # semantics; guard against traced predicates
+            reason = "branch assigns no local variables"
+            if getattr(node, "_dy2s_guard", False):
+                reason = ("the statements after a break/continue only have "
+                          "Python side effects (no local assignments), "
+                          "which cannot run under a traced jump guard")
+            node.test = self._py_only_wrap(test, reason)
             return node
 
         tname, fname = self._name("true"), self._name("false")
@@ -447,10 +485,107 @@ class _Transformer(ast.NodeTransformer):
                 keywords=[]))
         return self._undef_preamble(modified) + [t_fn, f_fn, assign]
 
+    # -- break/continue flag rewriting ----------------------------------
+    # (reference: dy2static BreakContinueTransformer — jumps become flag
+    # assignments, the statements after a potential jump run under a
+    # not-jumped guard, and the loop condition gains `not broken`)
+
+    def _rewrite_loop_jumps(self, node: ast.While):
+        """Rewrite break/continue belonging to THIS loop into flag
+        variables; returns (init_stmts, rewritten_while).  Must run on the
+        ORIGINAL statements, before nested-if conversion hoists branch
+        bodies into functions (where break would be a SyntaxError)."""
+        self.counter += 1
+        brk = f"_jstflag_brk_{self.counter}"   # NOT _GEN-prefixed: these
+        cont = f"_jstflag_cont_{self.counter}"  # are real loop-carried vars
+
+        def flag_guard():
+            return ast.UnaryOp(
+                op=ast.Not(),
+                operand=ast.BoolOp(op=ast.Or(),
+                                   values=[ast.Name(id=brk, ctx=ast.Load()),
+                                           ast.Name(id=cont,
+                                                    ctx=ast.Load())]))
+
+        def set_flag(name):
+            return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                              value=ast.Constant(True))
+
+        def rewrite_stmt(st):
+            """-> (new_stmt, may_set_flag)."""
+            if isinstance(st, ast.Break):
+                return set_flag(brk), True
+            if isinstance(st, ast.Continue):
+                return set_flag(cont), True
+            if isinstance(st, (ast.For, ast.While, ast.FunctionDef,
+                               ast.AsyncFunctionDef, ast.ClassDef)):
+                return st, False   # jumps inside belong to the inner scope
+            if isinstance(st, ast.If):
+                b, sb = rewrite_stmts(st.body)
+                o, so = rewrite_stmts(st.orelse)
+                st.body, st.orelse = b, o or []
+                return st, sb or so
+            if isinstance(st, (ast.With, ast.Try)):
+                sets = False
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, field, None)
+                    if sub:
+                        new, s = rewrite_stmts(sub)
+                        setattr(st, field, new)
+                        sets = sets or s
+                for h in getattr(st, "handlers", []):
+                    new, s = rewrite_stmts(h.body)
+                    h.body = new
+                    sets = sets or s
+                return st, sets
+            return st, False
+
+        def rewrite_stmts(stmts):
+            out = []
+            sets_any = False
+            for i, st in enumerate(stmts):
+                new, sets = rewrite_stmt(st)
+                out.append(new)
+                sets_any = sets_any or sets
+                if sets and i < len(stmts) - 1:
+                    rest, rs = rewrite_stmts(stmts[i + 1:])
+                    sets_any = sets_any or rs
+                    guard = ast.If(test=flag_guard(), body=rest, orelse=[])
+                    guard._dy2s_guard = True   # for tailored error text
+                    out.append(guard)
+                    break
+            return out, sets_any
+
+        body, _ = rewrite_stmts(node.body)
+        # continue resets every iteration; break persists (and kills the
+        # loop condition below)
+        node.body = [ast.Assign(
+            targets=[ast.Name(id=cont, ctx=ast.Store())],
+            value=ast.Constant(False))] + body
+        node.test = ast.BoolOp(
+            op=ast.And(),
+            values=[ast.UnaryOp(op=ast.Not(),
+                                operand=ast.Name(id=brk, ctx=ast.Load())),
+                    node.test])
+        self.func_assigned.update({brk, cont})
+        init = [ast.Assign(targets=[ast.Name(id=brk, ctx=ast.Store())],
+                           value=ast.Constant(False)),
+                ast.Assign(targets=[ast.Name(id=cont, ctx=ast.Store())],
+                           value=ast.Constant(False))]
+        return init, node
+
     # -- While ----------------------------------------------------------
     def visit_While(self, node: ast.While):
+        init = []
+        if not node.orelse and not _has_stmt(node.body, ast.Return) and \
+                _has_loop_jump(node.body):
+            init, node = self._rewrite_loop_jumps(node)
         self.generic_visit(node)
-        return self._convert_while_node(node)
+        converted = self._convert_while_node(node)
+        if init:
+            return init + (converted if isinstance(converted, list)
+                           else [converted])
+        return converted
 
     def _convert_while_node(self, node: ast.While):
         """Core while conversion; ``node``'s children must already be

@@ -152,21 +152,50 @@ def test_python_control_flow_still_python():
                                np.asarray(f(x, False)))
 
 
-def test_break_in_tensor_loop_clear_error():
+def test_break_in_tensor_loop_converts():
+    """break via flag rewriting (reference BreakContinueTransformer):
+    converted/traced == original eager."""
     def f(x):
         s = x
+        n = jnp.asarray(0, jnp.int32)
         while s.sum() < 100.0:
             s = s * 2.0
-            if s.max() > 50.0:
+            if s.max() > 11.0:
                 break
-        return s
+            n = n + 1
+        return s, n
 
-    static = pjit.to_static(f)
-    with pytest.raises(Dy2StaticError, match="break/continue"):
-        static(jnp.ones(4))
-    # eager-style concrete use still fine (python path)
-    out = convert_to_static(f)(np.ones(4))
-    assert float(np.asarray(out).sum()) >= 100.0
+    _check(f, (jnp.ones(4),), (jnp.full(4, 50.0),))
+
+
+def test_continue_in_tensor_loop_converts():
+    def f(x):
+        i = jnp.asarray(0, jnp.int32)
+        acc = jnp.zeros_like(x)
+        while i < 6:
+            i = i + 1
+            if jnp.sum(x) * i % 2.0 < 1.0:
+                continue
+            acc = acc + x * i
+        return acc, i
+
+    _check(f, (jnp.ones(3),), (jnp.full(3, 2.0),))
+
+
+def test_break_and_continue_combined():
+    def f(x):
+        i = jnp.asarray(0, jnp.int32)
+        total = jnp.zeros((), x.dtype)
+        while i < 100:
+            i = i + 1
+            if i % 3 == 0:
+                continue
+            if total > 20.0:
+                break
+            total = total + x.sum()
+        return total, i
+
+    _check(f, (jnp.ones(4),), (jnp.full(4, 0.5),))
 
 
 def test_single_branch_return_clear_error():
@@ -262,3 +291,38 @@ def test_save_load_converted_function(tmp_path):
     for x in (jnp.ones(4), -jnp.ones(4)):
         np.testing.assert_allclose(np.asarray(loaded(x)),
                                    np.asarray(f(x)), atol=1e-6)
+
+
+def test_break_nested_while_converts():
+    """break inside a while nested in another converted while (the inner
+    loop's flags first bind inside the outer body — they must carry)."""
+    def f(x):
+        i = jnp.asarray(0, jnp.int32)
+        total = jnp.zeros((), x.dtype)
+        while i < 3:
+            i = i + 1
+            j = jnp.asarray(0, jnp.int32)
+            while j < 10:
+                j = j + 1
+                if j > 2:
+                    break
+            total = total + j.astype(x.dtype) * x.sum()
+        return total, i
+
+    _check(f, (jnp.ones(4),), (jnp.full(4, 0.25),))
+
+
+def test_break_loop_eager_python_path():
+    """Flag-rewritten loops keep exact Python semantics on concrete
+    values (the convert_while eager branch)."""
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+            if s.max() > 50.0:
+                break
+        return s
+
+    out = convert_to_static(f)(np.ones(4))
+    want = f(np.ones(4))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want))

@@ -84,8 +84,12 @@ def test_gpt_decode_cache_matches_full():
             lg, caches = model.decode_step(ids[:, t:t + 1], caches, t)
             step_logits.append(lg[:, 0])
     stepped = jnp.stack(step_logits, axis=1)
+    # measured max abs diff ~3e-7 on the CPU highest-precision path; the
+    # only "large" relative errors sit at near-zero logits, which atol
+    # absorbs (round-2 review asked for the old rtol=2e-2 to be justified
+    # or tightened — tightened)
     np.testing.assert_allclose(np.asarray(stepped), np.asarray(full_logits),
-                               rtol=2e-2, atol=2e-3)
+                               rtol=1e-3, atol=1e-5)
 
 
 def test_gpt_tie_embeddings_single_table():
