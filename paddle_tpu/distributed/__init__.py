@@ -2,11 +2,18 @@
 (parity: python/paddle/distributed/)."""
 
 from . import env  # noqa: F401
-from .env import get_rank, get_world_size  # noqa: F401
+from .env import (get_rank, get_world_size, ParallelEnv,  # noqa: F401
+                  is_initialized)
+from . import stream  # noqa: F401
+from .meta_parallel.mp_layers import split  # noqa: F401
 from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
                        ParallelAxis, get_hybrid_communicate_group)
 from .strategy import DistributedStrategy  # noqa: F401
 from .collective import (ReduceOp, all_reduce, all_gather,  # noqa: F401
+                         gather, broadcast_object_list,  # noqa: F401
+                         scatter_object_list, isend, irecv,  # noqa: F401
+                         get_backend, get_group,  # noqa: F401
+                         destroy_process_group,  # noqa: F401
                          all_gather_object, reduce_scatter, alltoall,
                          alltoall_single, broadcast, reduce, scatter,
                          barrier, send, recv, new_group, wait)
@@ -38,6 +45,18 @@ def __getattr__(name):
         from .spawn import spawn as fn
         globals()[name] = fn
         return fn
+    # checkpoint API + auto-parallel Strategy stay lazy (the eager import
+    # would pull the whole auto_parallel/orbax-style surface into every
+    # `import paddle_tpu.distributed`)
+    if name in ("save_state_dict", "load_state_dict"):
+        from . import checkpoint as _ckpt
+        val = getattr(_ckpt, name)
+        globals()[name] = val
+        return val
+    if name == "Strategy":
+        from .auto_parallel.strategy import Strategy as val
+        globals()[name] = val
+        return val
     # lazy heavy submodules
     if name in ("auto_parallel", "checkpoint", "launch", "sharding", "moe"):
         import importlib

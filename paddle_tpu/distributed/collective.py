@@ -385,3 +385,71 @@ def wait(tensor, group=None, use_calc_stream: bool = True):
     if hasattr(tensor, "block_until_ready"):
         tensor.block_until_ready()
     return tensor
+
+
+# --- round-3 API completion (OP_COVERAGE paddle.distributed) -------------
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op: bool = True):
+    """Gather shards to ``dst`` (reference: paddle.distributed.gather).
+    Single-controller: the gathered list is visible to the (one) process,
+    which owns every rank's view."""
+    g = _resolve(group)
+    out = all_gather(tensor, group=g)
+    parts = list(jnp.split(out, g.nranks, axis=0))
+    if gather_list is not None:
+        gather_list.extend(parts)
+        return gather_list
+    return parts
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None):
+    """Host-object broadcast (reference semantics).  Single-controller:
+    one process already holds the authoritative list."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list, src: int = 0,
+                        group=None):
+    """Each rank takes its slot (reference: scatter_object_list);
+    single-controller processes index by their process rank."""
+    from . import env as _env
+    out_object_list.append(in_object_list[_env.get_rank()
+                                          % len(in_object_list)])
+    return out_object_list
+
+
+def isend(tensor, dst: int = 0, group=None):
+    """Async p2p stance matches send(): not expressible eagerly under
+    single-controller SPMD — raises with the shard_map/ppermute
+    guidance."""
+    send(tensor, dst, group)
+
+
+def irecv(tensor, src: int = 0, group=None):
+    recv(tensor, src, group)
+
+
+def get_backend(group=None) -> str:
+    """Reference: the comm backend name; here collectives compile to XLA
+    programs over ICI/DCN."""
+    return "XLA"
+
+
+def destroy_process_group(group=None):
+    """Drop cached groups / jitted collectives (reference:
+    destroy_process_group).  With no ``group``, the whole registry and
+    the hybrid topology reset."""
+    global _GROUPS
+    if group is None:
+        _GROUPS.clear()
+        _EAGER_CACHE.clear()
+        from .meta_parallel.mp_layers import _SPLIT_CACHE
+        _SPLIT_CACHE.clear()   # split() layers bake the old topology
+        from .topology import set_hybrid_communicate_group
+        set_hybrid_communicate_group(None)
+    else:
+        g = _resolve(group)
+        _GROUPS.pop(g.id, None)
+        for k in [k for k in _EAGER_CACHE if k[0] == g.id]:
+            _EAGER_CACHE.pop(k, None)

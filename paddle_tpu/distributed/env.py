@@ -82,3 +82,40 @@ def _start_heartbeat(interval: float = 2.0) -> None:
             _t.sleep(interval)
 
     threading.Thread(target=beat, daemon=True).start()
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv — env-contract view of
+    this process's place in the job."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_local_rank()
+
+    @property
+    def device_id(self) -> int:
+        return get_local_rank()
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:61000")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                              "127.0.0.1:61000").split(",")
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+
+__all__ += ["ParallelEnv"]

@@ -170,3 +170,62 @@ class ParallelCrossEntropy(Layer):
     def forward(self, input, label):
         return parallel_cross_entropy(input, label, self.ignore_index,
                                       self._axis)
+
+
+# --- paddle.distributed.split (OP_COVERAGE round 3) ----------------------
+
+_SPLIT_CACHE: dict = {}
+
+
+def split(x, size, operation: str = "linear", axis: int = 0,
+          num_partitions: int = 1, gather_out: bool = True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style parallel op factory (reference:
+    paddle.distributed.split): builds a column/row-parallel Linear or a
+    vocab-parallel Embedding over the mp axis and applies it.
+
+    Porting shim semantics: the underlying layer (and its parameters) is
+    CREATED ON FIRST CALL and cached under the REQUIRED ``name`` — two
+    unnamed call sites with the same shapes must not silently share
+    weights, so ``name`` is mandatory here (the reference's static-graph
+    unique-naming plays that role upstream).  Training code should prefer
+    the explicit ColumnParallelLinear/RowParallelLinear/
+    VocabParallelEmbedding layers.  The cache clears on
+    destroy_process_group (layers bake the mesh of the topology they were
+    built under)."""
+    if name is None:
+        raise ValueError(
+            "distributed.split needs an explicit name= (it caches the "
+            "created parallel layer; unnamed call sites with equal shapes "
+            "would silently share parameters)")
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and num_partitions not in (
+            1, hcg.get_model_parallel_world_size()):
+        raise ValueError(
+            f"num_partitions={num_partitions} does not match the "
+            f"initialized mp degree "
+            f"{hcg.get_model_parallel_world_size()} (reference validates "
+            f"the same)")
+    key = name
+    layer = _SPLIT_CACHE.get(key)
+    if layer is None:
+        if operation == "linear":
+            in_f, out_f = size
+            if axis == 1:
+                layer = ColumnParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            else:
+                layer = RowParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    input_is_parallel=False)
+        elif operation == "embedding":
+            num_emb, emb_dim = size
+            layer = VocabParallelEmbedding(num_emb, emb_dim,
+                                           weight_attr=weight_attr)
+        else:
+            raise ValueError(f"unknown split operation {operation!r}")
+        _SPLIT_CACHE[key] = layer
+    return layer(x)

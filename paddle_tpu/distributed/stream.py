@@ -1,0 +1,15 @@
+"""paddle.distributed.stream namespace (reference:
+python/paddle/distributed/communication/stream/*): the stream-explicit
+variants of every collective.  Under XLA there are no user-managed comm
+streams — the compiler schedules collectives onto ICI with its own
+overlap — so these delegate to the standard ops, accepting and ignoring
+``sync_op``/``use_calc_stream`` exactly like the reference does on
+single-stream backends (documented no-op knobs)."""
+
+from .collective import (all_reduce, all_gather, reduce_scatter,  # noqa: F401
+                         alltoall, alltoall_single, broadcast, reduce,
+                         scatter, send, recv)
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "alltoall",
+           "alltoall_single", "broadcast", "reduce", "scatter", "send",
+           "recv"]

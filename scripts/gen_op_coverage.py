@@ -130,8 +130,21 @@ PADDLE_SIGNAL = """
 istft stft
 """
 
+PADDLE_DISTRIBUTED = """
+ReduceOp all_gather all_gather_object all_reduce alltoall alltoall_single
+barrier broadcast broadcast_object_list destroy_process_group get_backend
+get_group get_rank get_world_size gather init_parallel_env irecv isend
+is_initialized new_group recv reduce reduce_scatter scatter
+scatter_object_list send spawn wait stream
+ParallelEnv DistributedStrategy fleet get_hybrid_communicate_group
+ProcessMesh shard_tensor shard_layer reshard Shard Replicate Partial
+Strategy to_static shard_optimizer unshard_dtensor dtensor_from_fn
+split rpc launch recompute save_state_dict load_state_dict
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
+    "paddle.distributed": PADDLE_DISTRIBUTED,
     "paddle.linalg": PADDLE_LINALG,
     "paddle.nn": PADDLE_NN,
     "paddle.nn.functional": PADDLE_NN_F,
@@ -142,6 +155,7 @@ REFERENCE = {
 # repo namespace that answers for each reference namespace
 TARGETS = {
     "paddle": "paddle_tpu",
+    "paddle.distributed": "paddle_tpu.distributed",
     "paddle.linalg": "paddle_tpu.linalg",
     "paddle.nn": "paddle_tpu.nn",
     "paddle.nn.functional": "paddle_tpu.nn.functional",

@@ -111,3 +111,39 @@ def test_interleaved_pipeline_grads_match_serial():
         for k in gp:
             np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
                                        rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_forward_lowers_without_allreduce():
+    """Compile-level oracle for the round-3 output-collection rewrite:
+    the FORWARD pipeline program contains collective-permutes (the ring)
+    but NO all-reduce — the old per-tick psum broadcast is gone from the
+    lowered HLO, not just from the Python source."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as np
+    from paddle_tpu.distributed.pipelining import pipeline_apply
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("pp",))
+    S, M, mb, h = 4, 4, 2, 8
+    params = {"w": jnp.stack([jnp.eye(h) * (i + 1) for i in range(S)])}
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"][0])
+
+    xs = jnp.ones((M, mb, h))
+
+    def fwd(params, xs):
+        return pipeline_apply(stage, params, xs, mesh, S, remat=False)
+
+    txt = jax.jit(fwd).lower(params, xs).compile().as_text()
+    assert "collective-permute" in txt       # the ppermute ring is there
+    import re
+    ars = [ln for ln in re.findall(r"all-reduce[^\n]*", txt)
+           if "= f32" in ln or ln.startswith("all-reduce = ")]
+    # exactly ONE all-reduce: the end-of-schedule gather of the last
+    # stage's rows (lowered from the caller-side dynamic_slice over the
+    # pp-stacked output).  The old design all-reduced INSIDE the scan —
+    # T per-tick activation broadcasts; that pattern would show up here
+    # as an all-reduce within the while-loop body.
+    assert len(ars) == 1, ars
