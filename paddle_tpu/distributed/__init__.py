@@ -5,7 +5,6 @@ from . import env  # noqa: F401
 from .env import (get_rank, get_world_size, ParallelEnv,  # noqa: F401
                   is_initialized)
 from . import stream  # noqa: F401
-from .meta_parallel.mp_layers import split  # noqa: F401
 from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
                        ParallelAxis, get_hybrid_communicate_group)
 from .strategy import DistributedStrategy  # noqa: F401
@@ -55,6 +54,10 @@ def __getattr__(name):
         return val
     if name == "Strategy":
         from .auto_parallel.strategy import Strategy as val
+        globals()[name] = val
+        return val
+    if name == "split":   # lazy: mp_layers pulls the whole nn stack
+        from .meta_parallel.mp_layers import split as val
         globals()[name] = val
         return val
     # lazy heavy submodules

@@ -414,8 +414,13 @@ def scatter_object_list(out_object_list, in_object_list, src: int = 0,
     """Each rank takes its slot (reference: scatter_object_list);
     single-controller processes index by their process rank."""
     from . import env as _env
-    out_object_list.append(in_object_list[_env.get_rank()
-                                          % len(in_object_list)])
+    rank = _env.get_rank()
+    if rank >= len(in_object_list):
+        raise ValueError(
+            f"scatter_object_list got {len(in_object_list)} objects for "
+            f"rank {rank} (world size {_env.get_world_size()}); the "
+            f"reference raises on the same mismatch")
+    out_object_list.append(in_object_list[rank])
     return out_object_list
 
 

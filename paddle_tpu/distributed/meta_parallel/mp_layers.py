@@ -207,7 +207,13 @@ def split(x, size, operation: str = "linear", axis: int = 0,
             f"{hcg.get_model_parallel_world_size()} (reference validates "
             f"the same)")
     key = name
-    layer = _SPLIT_CACHE.get(key)
+    cfg = (operation, tuple(size), axis)
+    cached = _SPLIT_CACHE.get(key)
+    if cached is not None and cached[1] != cfg:
+        raise ValueError(
+            f"distributed.split name {name!r} was first used with config "
+            f"{cached[1]}, now called with {cfg}; one name = one layer")
+    layer = cached[0] if cached is not None else None
     if layer is None:
         if operation == "linear":
             in_f, out_f = size
@@ -227,5 +233,5 @@ def split(x, size, operation: str = "linear", axis: int = 0,
                                            weight_attr=weight_attr)
         else:
             raise ValueError(f"unknown split operation {operation!r}")
-        _SPLIT_CACHE[key] = layer
+        _SPLIT_CACHE[key] = (layer, cfg)
     return layer(x)

@@ -259,6 +259,13 @@ _HCG: Optional[HybridCommunicateGroup] = None
 def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
     global _HCG
     _HCG = hcg
+    # split() layers bake the previous topology's mesh into their param
+    # shardings — a topology change invalidates them
+    try:
+        from .meta_parallel.mp_layers import _SPLIT_CACHE
+        _SPLIT_CACHE.clear()
+    except ImportError:
+        pass
 
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:

@@ -2,7 +2,9 @@
 
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp,  # noqa: F401
                         Adadelta, Adamax)
-from .adam import Adam, AdamW, FusedAdamW, Lamb  # noqa: F401
+from .adam import (Adam, AdamW, FusedAdamW, Lamb, NAdam, RAdam,  # noqa: F401
+                   Rprop)
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401
 from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                    ClipGradByGlobalNorm)

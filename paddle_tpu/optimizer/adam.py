@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from .optimizer import Optimizer
 
-__all__ = ["Adam", "AdamW", "FusedAdamW", "Lamb"]
+__all__ = ["Adam", "AdamW", "FusedAdamW", "Lamb", "NAdam",
+           "RAdam", "Rprop"]
 
 
 class Adam(Optimizer):
@@ -158,3 +159,96 @@ class FusedAdamW(AdamW):
             return super().update(grads, state, params, lr=lr)
         # bypass AdamW's decoupled-decay post-pass: kernel does the decay
         return Optimizer.update(self, grads, state, params, lr=lr)
+
+
+class NAdam(Adam):
+    """Nesterov Adam (reference: paddle.optimizer.NAdam; Dozat 2016 with
+    the reference's momentum-decay product schedule)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self.momentum_decay = momentum_decay
+
+    def _init_slot(self, p):
+        s = super()._init_slot(p)
+        s["mu_product"] = jnp.ones((), jnp.float32)
+        return s
+
+    def _update_param(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        t = step.astype(jnp.float32) + 1.0
+        mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.momentum_decay))
+        mu_t1 = self.beta1 * (
+            1.0 - 0.5 * 0.96 ** ((t + 1.0) * self.momentum_decay))
+        mu_prod = slots["mu_product"] * mu_t
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) + \
+            (1 - mu_t) * g32 / (1 - mu_prod)
+        v_hat = v / (1 - jnp.power(self.beta2, t))
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Adam):
+    """Rectified Adam (reference: paddle.optimizer.RAdam; Liu et al. 2020
+    — falls back to un-adapted momentum while the variance estimate's
+    degrees of freedom are too low)."""
+
+    def _update_param(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        m_hat = m / (1 - jnp.power(self.beta1, t))
+        beta2_t = jnp.power(self.beta2, t)
+        rho_inf = 2.0 / (1 - self.beta2) - 1.0
+        rho_t = rho_inf - 2.0 * t * beta2_t / (1 - beta2_t)
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf /
+            jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12), 0.0))
+        v_hat = jnp.sqrt(v / (1 - beta2_t)) + self.epsilon
+        adaptive = r * m_hat / v_hat
+        upd = lr * jnp.where(rho_t > 5.0, adaptive, m_hat)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class Rprop(Adam):
+    """Resilient backprop (reference: paddle.optimizer.Rprop): per-weight
+    step sizes grown/shrunk by the sign agreement of successive
+    gradients; full-batch regime only (the reference documents the
+    same)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters=parameters,
+                         grad_clip=grad_clip)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_minus, self.eta_plus = etas
+
+    def _init_slot(self, p):
+        return {"prev_grad": jnp.zeros(p.shape, jnp.float32),
+                "step_size": jnp.full(p.shape, float(self._base_lr_value()),
+                                      jnp.float32)}
+
+    def _base_lr_value(self):
+        return float(self.get_lr())
+
+    def _update_param(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * slots["prev_grad"])
+        factor = jnp.where(sign > 0, self.eta_plus,
+                           jnp.where(sign < 0, self.eta_minus, 1.0))
+        size = jnp.clip(slots["step_size"] * factor, self.lr_min,
+                        self.lr_max)
+        # on sign flip the reference zeroes the gradient (skip the step)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        newp = p.astype(jnp.float32) - jnp.sign(g_eff) * size
+        return newp.astype(p.dtype), {"prev_grad": g_eff,
+                                      "step_size": size}

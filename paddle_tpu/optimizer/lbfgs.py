@@ -1,0 +1,115 @@
+"""L-BFGS (reference: python/paddle/optimizer/lbfgs.py — LBFGS with
+two-loop recursion + strong-Wolfe line search, closure-driven).
+
+TPU-native deviation (documented): there is no imperative tape, so the
+closure cannot call ``loss.backward()``.  ``step`` instead takes the loss
+FUNCTION over the parameter pytree and the current params, computes grads
+with ``jax.value_and_grad``, runs up to ``max_iter`` quasi-Newton
+iterations, and returns ``(new_params, loss)`` — the functional shape of
+the reference's ``opt.step(closure)``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LBFGS"]
+
+
+def _flat(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(l.size) for l in leaves]
+    vec = jnp.concatenate([jnp.ravel(l).astype(jnp.float32)
+                           for l in leaves]) if leaves else jnp.zeros((0,))
+    # meta must be hashable: it rides jit as a static argument
+    return vec, (treedef, tuple(shapes), tuple(sizes),
+                 tuple(str(l.dtype) for l in leaves))
+
+
+def _unflat(vec, meta):
+    treedef, shapes, sizes, dtypes = meta
+    out, off = [], 0
+    for shp, sz, dt in zip(shapes, sizes, dtypes):
+        out.append(vec[off:off + sz].reshape(shp).astype(dt))
+        off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class LBFGS:
+    def __init__(self, learning_rate: float = 1.0, max_iter: int = 20,
+                 tolerance_grad: float = 1e-7,
+                 tolerance_change: float = 1e-9, history_size: int = 100,
+                 line_search_fn: Optional[str] = None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn  # None | 'strong_wolfe'
+
+    def step(self, loss_fn: Callable, params):
+        """Run up to ``max_iter`` L-BFGS iterations of ``loss_fn(params)``;
+        returns (new_params, final_loss)."""
+        vg = jax.jit(jax.value_and_grad(
+            lambda v, meta: loss_fn(_unflat(v, meta)), argnums=0),
+            static_argnums=1)
+        x, meta = _flat(params)
+        loss, g = vg(x, meta)
+        history = []          # list of (s, y, rho)
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in reversed(history):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if history:
+                s, y, _ = history[-1]
+                gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-12)
+                q = q * gamma
+            for (s, y, rho), a in zip(history, reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-15:   # not a descent direction: reset
+                history = []
+                d = -g
+                gtd = float(jnp.dot(g, d))
+            # backtracking (Armijo) line search; with 'strong_wolfe' also
+            # require the curvature condition
+            t = float(self.learning_rate)
+            c1, c2 = 1e-4, 0.9
+            ok = False
+            for _ls in range(25):
+                x_new = x + t * d
+                loss_new, g_new = vg(x_new, meta)
+                if float(loss_new) <= float(loss) + c1 * t * gtd:
+                    if self.line_search_fn != "strong_wolfe" or \
+                            abs(float(jnp.dot(g_new, d))) <= \
+                            -c2 * gtd + 1e-12:
+                        ok = True
+                        break
+                t *= 0.5
+            if not ok:
+                break
+            s_vec = x_new - x
+            y_vec = g_new - g
+            sy = float(jnp.dot(s_vec, y_vec))
+            if sy > 1e-10:
+                history.append((s_vec, y_vec, 1.0 / sy))
+                if len(history) > self.history_size:
+                    history.pop(0)
+            if float(jnp.max(jnp.abs(s_vec))) < self.tolerance_change:
+                x, loss, g = x_new, loss_new, g_new
+                break
+            x, loss, g = x_new, loss_new, g_new
+        return _unflat(x, meta), loss

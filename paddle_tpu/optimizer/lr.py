@@ -390,3 +390,25 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * jnp.power(self.exp_gamma, s)
         return self.base_lr + amp * frac
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_{t} = lr_{t-1} * lr_lambda(t) — cumulative multiplicative decay
+    (reference: paddle.optimizer.lr.MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate: float, lr_lambda, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        # jit-safe (step may be traced): cumulative product via fori_loop;
+        # the user lambda sees a (possibly traced) int t
+        import jax as _jax
+        s = jnp.asarray(step, jnp.int32)
+        return _jax.lax.fori_loop(
+            1, s + 1, lambda t, lr: lr * self.lr_lambda(t),
+            jnp.asarray(self.base_lr, jnp.float32))
+
+
+__all__ += ["MultiplicativeDecay"]

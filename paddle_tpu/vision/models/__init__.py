@@ -1,2 +1,5 @@
 from .resnet import *  # noqa: F401,F403
 from .simple import *  # noqa: F401,F403
+
+from .zoo_extra import *  # noqa: F401,F403
+from .resnet import resnext101_32x8d  # noqa: F401

@@ -180,3 +180,9 @@ def resnext101_32x4d(pretrained=False, **kwargs):
     kwargs.setdefault("groups", 32)
     kwargs.setdefault("width", 4)
     return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext101_32x8d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 32)
+    kwargs.setdefault("width", 8)
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)

@@ -1,0 +1,413 @@
+"""Remaining classic model families (reference: python/paddle/vision/
+models/ — mobilenetv1.py, squeezenet.py, densenet.py, googlenet.py,
+shufflenetv2.py).  Channel-first NCHW like the reference; pretrained
+weights are out of scope in the zero-egress environment (pretrained=True
+raises with guidance, same stance as the rest of the zoo)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...nn.layers.common import Linear, Dropout
+from ...nn.layers.container import Sequential, LayerList
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn.layers.activation import ReLU
+from ...nn.layers.pooling import MaxPool2D, AdaptiveAvgPool2D, AvgPool2D
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "DenseNet", "densenet121", "densenet161",
+           "densenet169", "densenet201", "GoogLeNet", "googlenet",
+           "ShuffleNetV2", "shufflenet_v2_x1_0"]
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights are unavailable in the zero-egress "
+            "environment; load a converted checkpoint with "
+            "paddle_tpu.load + set_state_dict instead")
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1):
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=padding, groups=groups,
+               bias_attr=False),
+        BatchNorm2D(cout), ReLU())
+
+
+# ------------------------------------------------------------ MobileNetV1
+
+class MobileNetV1(Layer):
+    """Depthwise-separable stack (reference: mobilenetv1.py)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        def c(v):
+            return max(int(v * scale), 8)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        for cin, cout, s in cfg:
+            layers.append(_conv_bn(c(cin), c(cin), 3, stride=s, padding=1,
+                                   groups=c(cin)))      # depthwise
+            layers.append(_conv_bn(c(cin), c(cout), 1))  # pointwise
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# ------------------------------------------------------------- SqueezeNet
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(cin, squeeze, 1), ReLU())
+        self.e1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.e3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        s = self.squeeze(x)
+        return jnp.concatenate([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(Layer):
+    """Fire modules (reference: squeezenet.py; version '1.0'/'1.1')."""
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Sequential(Conv2D(3, 96, 7, stride=2), ReLU()),
+                MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Sequential(Conv2D(3, 64, 3, stride=2), ReLU()),
+                MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kwargs)
+
+
+# --------------------------------------------------------------- DenseNet
+
+class _DenseLayer(Layer):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(cin)
+        self.conv1 = Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return jnp.concatenate([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.bn = BatchNorm2D(cin)
+        self.conv = Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (64, 32, (6, 12, 24, 16)),
+              161: (96, 48, (6, 12, 36, 24)),
+              169: (64, 32, (6, 12, 32, 32)),
+              201: (64, 32, (6, 12, 48, 32))}
+
+
+class DenseNet(Layer):
+    """Dense blocks + transitions (reference: densenet.py)."""
+
+    def __init__(self, layers: int = 121, bn_size: int = 4,
+                 dropout: float = 0.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        num_init, growth, block_cfg = _DENSE_CFG[layers]
+        feats = [Sequential(
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1))]
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch = ch // 2
+        feats.append(BatchNorm2D(ch))
+        feats.append(ReLU())
+        self.features = Sequential(*feats)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape(x.shape[0], -1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(201, **kw)
+
+
+# --------------------------------------------------------------- GoogLeNet
+
+class _Inception(Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = Sequential(Conv2D(cin, c1, 1), ReLU())
+        self.b2 = Sequential(Conv2D(cin, c3r, 1), ReLU(),
+                             Conv2D(c3r, c3, 3, padding=1), ReLU())
+        self.b3 = Sequential(Conv2D(cin, c5r, 1), ReLU(),
+                             Conv2D(c5r, c5, 5, padding=2), ReLU())
+        self.b4 = Sequential(MaxPool2D(3, 1, padding=1),
+                             Conv2D(cin, proj, 1), ReLU())
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    """Inception v1 (reference: googlenet.py).  Returns (out, aux1, aux2)
+    in train mode like the reference; eval returns the main head only."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, stride=2, padding=3), ReLU(),
+            MaxPool2D(3, 2, padding=1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+            # aux heads (train-mode deep supervision, reference layout)
+            self.aux1_pool = AdaptiveAvgPool2D(4)
+            self.aux1 = Sequential(Conv2D(512, 128, 1), ReLU())
+            self.aux1_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
+                                      Dropout(0.7), Linear(1024, num_classes))
+            self.aux2_pool = AdaptiveAvgPool2D(4)
+            self.aux2 = Sequential(Conv2D(528, 128, 1), ReLU())
+            self.aux2_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
+                                      Dropout(0.7), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1_in = x
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2_in = x
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            out = self.fc(self.dropout(x.reshape(x.shape[0], -1)))
+            if self.training:
+                a1 = self.aux1(self.aux1_pool(aux1_in))
+                a1 = self.aux1_fc(a1.reshape(a1.shape[0], -1))
+                a2 = self.aux2(self.aux2_pool(aux2_in))
+                a2 = self.aux2_fc(a2.reshape(a2.shape[0], -1))
+                return out, a1, a2
+            return out
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)
+
+
+# ------------------------------------------------------------ ShuffleNetV2
+
+def _channel_shuffle(x, groups: int):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.right = Sequential(
+                _conv_bn(cin // 2, branch, 1),
+                Sequential(Conv2D(branch, branch, 3, stride=1, padding=1,
+                                  groups=branch, bias_attr=False),
+                           BatchNorm2D(branch)),
+                _conv_bn(branch, branch, 1))
+        else:
+            self.left = Sequential(
+                Sequential(Conv2D(cin, cin, 3, stride=stride, padding=1,
+                                  groups=cin, bias_attr=False),
+                           BatchNorm2D(cin)),
+                _conv_bn(cin, branch, 1))
+            self.right = Sequential(
+                _conv_bn(cin, branch, 1),
+                Sequential(Conv2D(branch, branch, 3, stride=stride,
+                                  padding=1, groups=branch,
+                                  bias_attr=False),
+                           BatchNorm2D(branch)),
+                _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            left, right = x[:, :c], x[:, c:]
+            out = jnp.concatenate([left, self.right(right)], axis=1)
+        else:
+            out = jnp.concatenate([self.left(x), self.right(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """reference: shufflenetv2.py (scale 1.0 stage widths)."""
+
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        widths = {0.5: (24, 48, 96, 192, 1024),
+                  1.0: (24, 116, 232, 464, 1024),
+                  1.5: (24, 176, 352, 704, 1024),
+                  2.0: (24, 244, 488, 976, 2048)}[scale]
+        c0, c1, c2, c3, c4 = widths
+        self.stem = Sequential(_conv_bn(3, c0, 3, stride=2, padding=1),
+                               MaxPool2D(3, 2, padding=1))
+        stages = []
+        cin = c0
+        for cout, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            units = [_ShuffleUnit(cin, cout, 2)]
+            for _ in range(repeat - 1):
+                units.append(_ShuffleUnit(cout, cout, 1))
+            stages.append(Sequential(*units))
+            cin = cout
+        self.stages = Sequential(*stages)
+        self.tail = _conv_bn(c3, c4, 1)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c4, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=1.0, **kw)

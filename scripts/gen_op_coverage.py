@@ -142,6 +142,51 @@ Strategy to_static shard_optimizer unshard_dtensor dtensor_from_fn
 split rpc launch recompute save_state_dict load_state_dict
 """
 
+PADDLE_OPTIMIZER = """
+Adadelta Adagrad Adam Adamax AdamW LBFGS Lamb Momentum NAdam Optimizer
+RAdam RMSProp Rprop SGD lr
+"""
+
+PADDLE_OPT_LR = """
+LRScheduler NoamDecay PiecewiseDecay NaturalExpDecay InverseTimeDecay
+PolynomialDecay LinearWarmup ExponentialDecay MultiStepDecay StepDecay
+LambdaDecay ReduceOnPlateau CosineAnnealingDecay MultiplicativeDecay
+OneCycleLR CyclicLR ConstantLR LinearLR CosineAnnealingWarmRestarts
+"""
+
+PADDLE_VISION_MODELS = """
+LeNet AlexNet VGG vgg11 vgg13 vgg16 vgg19 ResNet resnet18 resnet34
+resnet50 resnet101 resnet152 resnext50_32x4d resnext101_32x8d
+wide_resnet50_2 wide_resnet101_2 MobileNetV1 mobilenet_v1 MobileNetV2
+mobilenet_v2 SqueezeNet squeezenet1_0 squeezenet1_1 DenseNet densenet121
+densenet161 densenet169 densenet201 GoogLeNet googlenet ShuffleNetV2
+shufflenet_v2_x1_0
+"""
+
+PADDLE_IO = """
+BatchSampler ChainDataset ComposeDataset ConcatDataset DataLoader Dataset
+DistributedBatchSampler IterableDataset RandomSampler Sampler
+SequenceSampler Subset TensorDataset WeightedRandomSampler get_worker_info
+random_split
+"""
+
+PADDLE_METRIC = """
+Accuracy Auc Metric Precision Recall accuracy
+"""
+
+PADDLE_AMP = """
+GradScaler auto_cast decorate
+"""
+
+PADDLE_JIT = """
+TranslatedLayer enable_to_static ignore_module load not_to_static save
+to_static
+"""
+
+PADDLE_STATIC = """
+InputSpec load_inference_model save_inference_model
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -150,6 +195,14 @@ REFERENCE = {
     "paddle.nn.functional": PADDLE_NN_F,
     "paddle.fft": PADDLE_FFT,
     "paddle.signal": PADDLE_SIGNAL,
+    "paddle.optimizer": PADDLE_OPTIMIZER,
+    "paddle.optimizer.lr": PADDLE_OPT_LR,
+    "paddle.vision.models": PADDLE_VISION_MODELS,
+    "paddle.io": PADDLE_IO,
+    "paddle.metric": PADDLE_METRIC,
+    "paddle.amp": PADDLE_AMP,
+    "paddle.jit": PADDLE_JIT,
+    "paddle.static": PADDLE_STATIC,
 }
 
 # repo namespace that answers for each reference namespace
@@ -161,6 +214,14 @@ TARGETS = {
     "paddle.nn.functional": "paddle_tpu.nn.functional",
     "paddle.fft": "paddle_tpu.fft",
     "paddle.signal": "paddle_tpu.signal",
+    "paddle.optimizer": "paddle_tpu.optimizer",
+    "paddle.optimizer.lr": "paddle_tpu.optimizer.lr",
+    "paddle.vision.models": "paddle_tpu.vision.models",
+    "paddle.io": "paddle_tpu.io",
+    "paddle.metric": "paddle_tpu.metric",
+    "paddle.amp": "paddle_tpu.amp",
+    "paddle.jit": "paddle_tpu.jit",
+    "paddle.static": "paddle_tpu.static",
 }
 
 

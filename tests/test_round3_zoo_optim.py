@@ -1,0 +1,126 @@
+"""Round-3 zoo/optimizer completions: torch-oracle optimizer checks,
+LBFGS convergence, model-family forward shapes + train smoke."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+import paddle_tpu.optimizer as opt
+
+rs = np.random.RandomState(0)
+
+
+def _run_opt(mine_cls, torch_cls, steps=5, **kw):
+    """Apply both optimizers to the same quadratic; compare trajectories."""
+    w0 = rs.randn(6).astype(np.float32)
+    target = rs.randn(6).astype(np.float32)
+
+    o = mine_cls(learning_rate=0.05, **kw.get("mine", {}))
+    p = {"w": jnp.asarray(w0)}
+    st = o.init(p)
+    for _ in range(steps):
+        g = {"w": 2.0 * (p["w"] - jnp.asarray(target))}
+        p, st = o.update(g, st, p)
+
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    to = torch_cls([tw], lr=0.05, **kw.get("torch", {}))
+    for _ in range(steps):
+        to.zero_grad()
+        loss = ((tw - torch.tensor(target)) ** 2).sum()
+        loss.backward()
+        to.step()
+    np.testing.assert_allclose(np.asarray(p["w"]), tw.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_nadam_matches_torch():
+    _run_opt(opt.NAdam, torch.optim.NAdam)
+
+
+def test_radam_matches_torch():
+    # include steps beyond the rectification warmup threshold
+    _run_opt(opt.RAdam, torch.optim.RAdam, steps=8)
+
+
+def test_rprop_matches_torch():
+    _run_opt(opt.Rprop, torch.optim.Rprop,
+             mine={"learning_rate_range": (1e-6, 50.0)},
+             torch={"step_sizes": (1e-6, 50.0)})
+
+
+def test_lbfgs_converges_on_quadratic():
+    A = rs.randn(8, 8).astype(np.float32)
+    A = A @ A.T + 0.5 * np.eye(8, dtype=np.float32)  # SPD
+    b = rs.randn(8).astype(np.float32)
+
+    def loss_fn(p):
+        w = p["w"]
+        return 0.5 * w @ jnp.asarray(A) @ w - jnp.asarray(b) @ w
+
+    o = opt.LBFGS(learning_rate=1.0, max_iter=50,
+                  line_search_fn="strong_wolfe")
+    p, loss = o.step(loss_fn, {"w": jnp.zeros(8)})
+    w_star = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(p["w"]), w_star, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_multiplicative_decay():
+    sch = opt.lr.MultiplicativeDecay(1.0, lambda t: 0.5)
+    vals = []
+    for _ in range(4):
+        vals.append(float(sch.get_lr()))
+        sch.step()
+    np.testing.assert_allclose(vals, [1.0, 0.5, 0.25, 0.125], rtol=1e-6)
+
+
+@pytest.mark.parametrize("factory,size", [
+    ("mobilenet_v1", 64), ("squeezenet1_0", 64), ("squeezenet1_1", 64),
+    ("densenet121", 64), ("shufflenet_v2_x1_0", 64),
+    ("resnext101_32x8d", 64)])
+def test_new_vision_models_forward(factory, size):
+    from paddle_tpu.vision import models as M
+    paddle_tpu.seed(0)
+    m = getattr(M, factory)(num_classes=7)
+    m.eval()
+    x = jnp.asarray(rs.randn(1, 3, size, size).astype(np.float32))
+    assert m(x).shape == (1, 7)
+
+
+def test_googlenet_aux_heads_and_training():
+    """GoogLeNet trains through its aux heads (reference deep
+    supervision) — loss over all three outputs decreases."""
+    from paddle_tpu.vision import models as M
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional_call import functional_call, state
+    paddle_tpu.seed(1)
+    m = M.googlenet(num_classes=4)
+    m.train()
+    params, buffers = state(m)
+    x = jnp.asarray(rs.randn(2, 3, 96, 96).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 4, (2,)))
+    o = opt.Adam(learning_rate=3e-4)
+    ostate = o.init(params)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def step(p, os_, b):
+        def loss_fn(p):
+            (out, a1, a2), nb = functional_call(m, p, b, (x,), rng=key,
+                                                train=True)
+            return (F.cross_entropy(out, y)
+                    + 0.3 * F.cross_entropy(a1, y)
+                    + 0.3 * F.cross_entropy(a2, y)), nb
+        (l, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        newp, nos = o.update(g, os_, p)
+        return newp, nos, nb, l
+
+    losses = []
+    for _ in range(6):
+        params, ostate, buffers, loss = step(params, ostate, buffers)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
