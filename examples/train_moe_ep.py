@@ -1,0 +1,70 @@
+"""Expert-parallel GPT-MoE training — the fleet EP workflow end to end.
+
+Reference analog: paddle.incubate.distributed.models.moe examples — MoE
+GPT over the fleet expert group composed with pipeline + sharding.
+
+Run (single host, CPU simulation of an 8-chip slice):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_moe_ep.py --ep 2 --pp 2 --sharding 2
+
+The experts ride the first-class ``ep`` mesh axis (expert dispatch
+compiles to all-to-all over it), transformer blocks pipeline over ``pp``,
+and optimizer state shards ZeRO-1 style over ``sharding``; the gate
+load-balance aux loss accumulates ACROSS pipeline stages inside the
+activation pytree.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ep", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--sharding", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=32)
+    args = ap.parse_args()
+
+    import numpy as np
+    import paddle_tpu
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPTMoEHybridTrainer, gpt_moe_tiny
+
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": args.dp, "pp_degree": args.pp,
+                        "sharding_degree": args.sharding,
+                        "ep_degree": args.ep}
+    dist.fleet.init(is_collective=True, strategy=s)
+    hcg = dist.get_hybrid_communicate_group()
+    print(f"topology: {hcg}")
+
+    paddle_tpu.seed(0)
+    cfg = gpt_moe_tiny(gate="gshard", moe_every=1,
+                       gate_kwargs={"random_routing": False})
+    trainer = GPTMoEHybridTrainer(
+        cfg, hcg, opt.AdamW(learning_rate=3e-3),
+        microbatches=args.pp, zero_stage=1)
+    state = trainer.init_state()
+
+    losses = []
+    for step in range(args.steps):
+        x, y = trainer.make_batch(batch=args.batch, seq=args.seq, seed=step)
+        state, loss = trainer.train_step(state, x, y)
+        losses.append(float(loss))
+        print(f"step {step}: loss={losses[-1]:.4f}")
+    assert losses[-1] < losses[0], "MoE training did not learn"
+    print("OK: expert-parallel MoE trained "
+          f"(loss {losses[0]:.3f} -> {losses[-1]:.3f})")
+
+
+if __name__ == "__main__":
+    main()
