@@ -3,9 +3,12 @@
 Reference analog: paddle.incubate.distributed.models.moe examples — MoE
 GPT over the fleet expert group composed with pipeline + sharding.
 
-Run (single host, CPU simulation of an 8-chip slice):
+Run (single host, CPU simulation of an 8-chip slice; on machines with a
+registered TPU plugin, unset its pool var so JAX_PLATFORMS=cpu wins —
+same convention as tests/conftest.py):
 
-    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/train_moe_ep.py --ep 2 --pp 2 --sharding 2
 
 The experts ride the first-class ``ep`` mesh axis (expert dispatch
@@ -28,12 +31,15 @@ def main():
     ap.add_argument("--pp", type=int, default=2)
     ap.add_argument("--sharding", type=int, default=2)
     ap.add_argument("--dp", type=int, default=1)
-    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=5,
+                    help="train steps (>= 2: the final learning assert "
+                         "compares last vs first loss)")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=32)
     args = ap.parse_args()
+    if args.steps < 2:
+        ap.error("--steps must be >= 2")
 
-    import numpy as np
     import paddle_tpu
     import paddle_tpu.distributed as dist
     import paddle_tpu.optimizer as opt
