@@ -311,3 +311,249 @@ def _kl_uniform(p, q):
     return jnp.where(contained,
                      jnp.log((q.high - q.low) / (p.high - p.low)),
                      jnp.inf)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md; reference:
+# python/paddle/distribution/) --------------------------------------------
+
+class ExponentialFamily(Distribution):
+    """Base marker for exponential-family distributions (reference:
+    paddle.distribution.ExponentialFamily — provides the Bregman
+    entropy via natural parameters; concrete classes here override
+    entropy directly)."""
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / jnp.square(self.rate)
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_rng_key()
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.exponential(key, shape) / self.rate
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        return jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v,
+                         -jnp.inf)
+
+    def entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / jnp.square(self.rate)
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_rng_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)
+        return jax.random.gamma(key, jnp.broadcast_to(
+            self.concentration, shape)) / self.rate
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        a, b = self.concentration, self.rate
+        return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - \
+            jax.scipy.special.gammaln(a)
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return a - jnp.log(b) + jax.scipy.special.gammaln(a) + \
+            (1 - a) * jax.scipy.special.digamma(a)
+
+
+class Geometric(Distribution):
+    """pmf (1-p)^k p over k in {0, 1, ...} (reference convention)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / jnp.square(self.probs)
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_rng_key()
+        shape = tuple(shape) + self.probs.shape
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1.0)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def log_prob(self, value):
+        k = jnp.asarray(value, jnp.float32)
+        return k * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_rng_key()
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.poisson(key, self.rate, shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        return v * jnp.log(self.rate) - self.rate - \
+            jax.scipy.special.gammaln(v + 1.0)
+
+    def entropy(self):
+        # small rates: exact -sum p log p over the mass-carrying support;
+        # large rates: the standard asymptotic series (the exact sum would
+        # need an unbounded support window)
+        lam = self.rate
+        ks = jnp.arange(64, dtype=jnp.float32)
+        logp = ks * jnp.log(jnp.maximum(lam[..., None], 1e-12)) - \
+            lam[..., None] - jax.scipy.special.gammaln(ks + 1.0)
+        exact = -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        series = 0.5 * jnp.log(2 * jnp.pi * jnp.e * lam) - \
+            1 / (12 * lam) - 1 / (24 * lam ** 2)
+        return jnp.where(lam < 16.0, exact, series)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_rng_key()
+        shape = tuple(shape)
+        batch = self.probs.shape[:-1]
+        k = self.probs.shape[-1]
+        # leading count axis broadcasts against any probs batch shape
+        draws = jax.random.categorical(
+            key, jnp.log(self.probs), axis=-1,
+            shape=(self.total_count,) + shape + batch)
+        return jax.nn.one_hot(draws, k).sum(axis=0)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        coef = jax.scipy.special.gammaln(
+            jnp.asarray(self.total_count + 1.0)) - \
+            jnp.sum(jax.scipy.special.gammaln(v + 1.0), axis=-1)
+        # xlogy: a zero count against a zero probability contributes 0,
+        # not nan (masked/one-hot prob vectors are common)
+        return coef + jnp.sum(jax.scipy.special.xlogy(v, self.probs),
+                              axis=-1)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = jnp.asarray(df, jnp.float32)
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    @property
+    def mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    @property
+    def variance(self):
+        return jnp.where(self.df > 2,
+                         jnp.square(self.scale) * self.df / (self.df - 2),
+                         jnp.nan)
+
+    def sample(self, shape=(), key=None):
+        key = key if key is not None else next_rng_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)
+        return self.loc + self.scale * jax.random.t(key, self.df, shape)
+
+    def log_prob(self, value):
+        v = (jnp.asarray(value, jnp.float32) - self.loc) / self.scale
+        d = self.df
+        lg = jax.scipy.special.gammaln
+        return lg((d + 1) / 2) - lg(d / 2) - 0.5 * jnp.log(d * jnp.pi) - \
+            jnp.log(self.scale) - (d + 1) / 2 * jnp.log1p(v * v / d)
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of invertible transforms
+    (reference: paddle.distribution.TransformedDistribution).  Each
+    transform exposes forward / inverse / forward_log_det_jacobian."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=(), key=None):
+        x = self.base.sample(shape, key)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        logp = jnp.zeros_like(v)
+        for t in reversed(self.transforms):
+            x = t.inverse(v)
+            logp = logp - t.forward_log_det_jacobian(x)
+            v = x
+        return logp + self.base.log_prob(v)
+
+
+class AffineTransform:
+    """y = loc + scale * x (the reference's basic transform; used with
+    TransformedDistribution)."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+__all__ += ["ExponentialFamily", "Exponential", "Gamma", "Geometric",
+            "Poisson", "Multinomial", "StudentT", "TransformedDistribution",
+            "AffineTransform"]

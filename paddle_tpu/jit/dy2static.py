@@ -30,14 +30,14 @@ Supported subset (documented; the reference converts a larger one):
     (BreakContinueTransformer): jumps become carried boolean flags, the
     statements after a potential jump run under a not-jumped guard, and
     ``break`` kills the loop condition;
-  * ``for <i> in range(...)`` with traced bounds (rewritten to a while);
+  * ``for <i> in range(...)`` with traced bounds (rewritten to a while),
+    including ``break``/``continue`` (the index increment runs as a
+    not-broken epilogue, so ``continue`` advances the iterator and
+    ``break`` freezes the index — python for semantics);
   * arbitrary nesting of the above.
 
 NOT converted — left as plain Python, which stays correct for concrete
 values and raises a clear error if the predicate is traced:
-  * ``for``-loops containing ``break``/``continue`` with traced bounds
-    (the increment interleaves with continue guards; plain-Python ranges
-    are unaffected);
   * ``return`` inside only one branch of a data-dependent ``if``, or
     inside a loop body;
   * ``for x in <tensor>`` needs no conversion (static trip count —
@@ -490,11 +490,16 @@ class _Transformer(ast.NodeTransformer):
     # assignments, the statements after a potential jump run under a
     # not-jumped guard, and the loop condition gains `not broken`)
 
-    def _rewrite_loop_jumps(self, node: ast.While):
+    def _rewrite_loop_jumps(self, node: ast.While, epilogue=None):
         """Rewrite break/continue belonging to THIS loop into flag
         variables; returns (init_stmts, rewritten_while).  Must run on the
         ORIGINAL statements, before nested-if conversion hoists branch
-        bodies into functions (where break would be a SyntaxError)."""
+        bodies into functions (where break would be a SyntaxError).
+
+        ``epilogue`` statements (a for-range's index increment) append
+        AFTER the jump-guarded body, themselves guarded on NOT-break only:
+        Python's ``continue`` still advances the iterator, ``break``
+        leaves the index at its at-break value."""
         self.counter += 1
         brk = f"_jstflag_brk_{self.counter}"   # NOT _GEN-prefixed: these
         cont = f"_jstflag_cont_{self.counter}"  # are real loop-carried vars
@@ -557,6 +562,11 @@ class _Transformer(ast.NodeTransformer):
             return out, sets_any
 
         body, _ = rewrite_stmts(node.body)
+        if epilogue:
+            body = body + [ast.If(
+                test=ast.UnaryOp(op=ast.Not(),
+                                 operand=ast.Name(id=brk, ctx=ast.Load())),
+                body=list(epilogue), orelse=[])]
         # continue resets every iteration; break persists (and kills the
         # loop condition below)
         node.body = [ast.Assign(
@@ -638,16 +648,17 @@ class _Transformer(ast.NodeTransformer):
 
     # -- For over range(...) --------------------------------------------
     def visit_For(self, node: ast.For):
-        self.generic_visit(node)
         is_range = (isinstance(node.iter, ast.Call)
                     and isinstance(node.iter.func, ast.Name)
                     and node.iter.func.id == "range"
                     and not node.iter.keywords
                     and 1 <= len(node.iter.args) <= 3
                     and isinstance(node.target, ast.Name))
-        if not is_range or node.orelse or _has_loop_jump(node.body) or \
+        if not is_range or node.orelse or \
                 _has_stmt(node.body, ast.Return):
-            return node  # plain python (tracing unrolls static iterables)
+            # plain python (tracing unrolls static iterables)
+            self.generic_visit(node)
+            return node
         a = node.iter.args
         if len(a) == 1:
             start, stop, step = ast.Constant(0), a[0], ast.Constant(1)
@@ -665,21 +676,32 @@ class _Transformer(ast.NodeTransformer):
                            value=step)]
         # while range_cond(i, stop, step): <body>; i = i + step
         self.func_assigned.update({ivar, svar, evar})
+        increment = ast.Assign(
+            targets=[ast.Name(id=ivar, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=evar, ctx=ast.Load())))
         w = ast.While(
             test=ast.Call(func=self._jst("range_cond"),
                           args=[ast.Name(id=ivar, ctx=ast.Load()),
                                 ast.Name(id=svar, ctx=ast.Load()),
                                 ast.Name(id=evar, ctx=ast.Load())],
                           keywords=[]),
-            body=node.body + [ast.Assign(
-                targets=[ast.Name(id=ivar, ctx=ast.Store())],
-                value=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
-                                op=ast.Add(),
-                                right=ast.Name(id=evar, ctx=ast.Load())))],
+            body=list(node.body),
             orelse=[])
+        jump_init = []
+        if _has_loop_jump(w.body):
+            # break/continue: flag-rewrite with the increment as the
+            # not-break epilogue (continue still advances the index,
+            # break freezes it at its at-break value — python for
+            # semantics)
+            jump_init, w = self._rewrite_loop_jumps(w, epilogue=[increment])
+        else:
+            w.body = w.body + [increment]
+        self.generic_visit(w)       # convert nested constructs in the body
         converted = self._convert_while_node(w)
-        return init + (converted if isinstance(converted, list)
-                       else [converted])
+        return init + jump_init + (converted if isinstance(converted, list)
+                                   else [converted])
 
 
 # ---------------------------------------------------------------------------

@@ -191,3 +191,308 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         return jnp.max(samples, axis=(2, 4))
 
     return jax.vmap(per_roi)(jnp.arange(R))
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md; reference:
+# python/paddle/vision/ops.py) --------------------------------------------
+
+class RoIAlign:
+    """Layer wrapper (reference: paddle.vision.ops.RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: psroi_pool_op): input
+    channels C = out_c * oh * ow; bin (i, j) of output channel c averages
+    input channel c*oh*ow + i*ow + j over that bin's spatial extent."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c_in, h, w = x.shape
+    out_c = c_in // (oh * ow)
+    counts = jnp.asarray(boxes_num, jnp.int32)
+    batch_idx = jnp.repeat(jnp.arange(n), counts,
+                           total_repeat_length=boxes.shape[0])
+
+    def one(roi, bi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1) / oh
+        rw = jnp.maximum(x2 - x1, 0.1) / ow
+        fmap = x[bi]                                   # [C, H, W]
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        out = jnp.zeros((out_c, oh, ow), jnp.float32)
+        for i in range(oh):
+            for j in range(ow):
+                y_lo, y_hi = y1 + i * rh, y1 + (i + 1) * rh
+                x_lo, x_hi = x1 + j * rw, x1 + (j + 1) * rw
+                my = ((ys >= jnp.floor(y_lo)) &
+                      (ys < jnp.ceil(y_hi))).astype(jnp.float32)
+                mx = ((xs >= jnp.floor(x_lo)) &
+                      (xs < jnp.ceil(x_hi))).astype(jnp.float32)
+                mask = my[:, None] * mx[None, :]
+                denom = jnp.maximum(mask.sum(), 1.0)
+                ch = jnp.arange(out_c) * (oh * ow) + i * ow + j
+                vals = jnp.sum(fmap[ch] * mask[None], axis=(1, 2)) / denom
+                out = out.at[:, i, j].set(vals)
+        return out
+
+    return jax.vmap(one)(boxes, batch_idx)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: deform_conv2d_op; v2 when
+    ``mask`` given).  Implemented TPU-style as bilinear gather at the
+    offset sampling locations + a dense matmul over the unfolded patches
+    (the MXU-friendly formulation of the CUDA kernel's im2col+offsets).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, OH, OW] (paired (dy, dx) per
+    kernel tap); weight [Cout, Cin/groups, kh, kw]; mask
+    [N, dg*kh*kw, OH, OW]."""
+    x = jnp.asarray(x, jnp.float32)
+    offset = jnp.asarray(offset, jnp.float32)
+    weight = jnp.asarray(weight, jnp.float32)
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    oh_, ow_ = offset.shape[2], offset.shape[3]
+    dg = deformable_groups
+    k = kh * kw
+
+    # base sampling grid per output position and tap
+    oy = jnp.arange(oh_) * sh - ph
+    ox = jnp.arange(ow_) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # [OH,1,kh,1]
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # [1,OW,1,kw]
+    off = offset.reshape(n, dg, k, 2, oh_, ow_)
+    dy = off[:, :, :, 0].reshape(n, dg, kh, kw, oh_, ow_)
+    dx = off[:, :, :, 1].reshape(n, dg, kh, kw, oh_, ow_)
+    sy = base_y.transpose(2, 3, 0, 1)[None, None] + dy  # [N,dg,kh,kw,OH,OW]
+    sx = base_x.transpose(2, 3, 0, 1)[None, None] + dx
+
+    def bilinear(fmap, yy, xx):
+        """fmap [C, H, W]; yy/xx [...]: gather with zero outside."""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+
+        def at(yi, xi):
+            valid = ((yi >= 0) & (yi < h) & (xi >= 0) &
+                     (xi < w)).astype(jnp.float32)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            return fmap[:, yc, xc] * valid[None]
+
+        return (at(y0, x0) * ((1 - wy) * (1 - wx))[None] +
+                at(y0, x0 + 1) * ((1 - wy) * wx)[None] +
+                at(y0 + 1, x0) * (wy * (1 - wx))[None] +
+                at(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+    cg = cin // dg          # channels per deformable group
+
+    def sample_img(xi, syi, sxi, mi):
+        # per deformable group, gather its channel slice at its offsets
+        cols = []
+        for g in range(dg):
+            fmap = xi[g * cg:(g + 1) * cg]
+            col = bilinear(fmap, syi[g], sxi[g])   # [cg, kh, kw, OH, OW]
+            if mi is not None:
+                col = col * mi[g][None]
+            cols.append(col)
+        return jnp.concatenate(cols, axis=0)       # [Cin, kh, kw, OH, OW]
+
+    if mask is not None:
+        m = jnp.asarray(mask, jnp.float32).reshape(n, dg, kh, kw, oh_, ow_)
+        cols = jax.vmap(sample_img)(x, sy, sx, m)
+    else:
+        cols = jax.vmap(lambda a, b, c: sample_img(a, b, c, None))(x, sy, sx)
+
+    # dense contraction: [N, Cin, kh, kw, OH, OW] x [Cout, Cin/g, kh, kw]
+    gsz_in = cin // groups
+    gsz_out = cout // groups
+    outs = []
+    for g in range(groups):
+        cg_cols = cols[:, g * gsz_in:(g + 1) * gsz_in]
+        wg = weight[g * gsz_out:(g + 1) * gsz_out]
+        outs.append(jnp.einsum("nckhij,ockh->noij", cg_cols, wg))
+    out = jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)[None, :, None, None]
+    return out
+
+
+class DeformConv2D:
+    """Layer wrapper owning weight/bias (reference: nn-style
+    paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        import numpy as _np
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else kernel_size
+        rs = _np.random.RandomState(0)
+        fan_in = in_channels * kh * kw
+        bound = 1.0 / _np.sqrt(fan_in)
+        self.weight = jnp.asarray(rs.uniform(
+            -bound, bound, (out_channels, in_channels // groups, kh, kw))
+            .astype(_np.float32))
+        self.bias = None if bias_attr is False else jnp.asarray(
+            rs.uniform(-bound, bound, (out_channels,)).astype(_np.float32))
+        self.stride, self.padding = stride, padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference:
+    distribute_fpn_proposals_op): level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)), clipped to [min, max].
+
+    Static-shape return: one [R, 4] tensor per level with non-member rows
+    zeroed, a [R] boolean mask per level packed into restore order, and
+    ``restore_ind`` mapping concatenated level order back to input order
+    (here identity-composable via the masks).  Callers under jit keep the
+    fixed R rows and mask; eager callers may compress with the masks."""
+    rois = jnp.asarray(fpn_rois, jnp.float32)
+    ws = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    hs = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    if pixel_offset:
+        ws, hs = ws + 1.0, hs + 1.0
+    scale = jnp.sqrt(ws * hs)
+    lvl = jnp.floor(refer_level + jnp.log2(
+        jnp.maximum(scale, 1e-6) / refer_scale))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, masks = [], []
+    for L in range(min_level, max_level + 1):
+        m = lvl == L
+        outs.append(jnp.where(m[:, None], rois, 0.0))
+        masks.append(m)
+    # restore_ind: position of each input roi in the concatenated
+    # level-major ordering.  Invalid (padded) slots scatter to index R,
+    # which mode="drop" discards — they must never clobber roi 0.
+    r = rois.shape[0]
+    order = jnp.concatenate([jnp.nonzero(m, size=r, fill_value=r)[0]
+                             for m in masks])
+    positions = jnp.arange(order.shape[0], dtype=jnp.int32)
+    valid = order < r
+    restore = jnp.zeros((r,), jnp.int32).at[
+        jnp.where(valid, order, r)].set(positions, mode="drop")
+    return outs, restore, masks
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (reference: generate_proposals_v2_op):
+    decode anchor deltas -> clip to image -> filter tiny boxes (masked,
+    static shapes) -> top-k by score -> NMS -> top post_nms_top_n.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; anchors [H*W*A, 4];
+    variances like anchors.  Returns (rois [N*post, 4], roi_probs
+    [N*post, 1][, rois_num [N]]); suppressed/invalid slots are zeroed
+    (static-shape contract, like the repo's nms)."""
+    scores = jnp.asarray(scores, jnp.float32)
+    deltas = jnp.asarray(bbox_deltas, jnp.float32)
+    anchors_f = jnp.asarray(anchors, jnp.float32).reshape(-1, 4)
+    var = jnp.asarray(variances, jnp.float32).reshape(-1, 4)
+    n, a, h, w = scores.shape
+    total = h * w * a
+
+    def one(sc, dl, im):
+        s = sc.transpose(1, 2, 0).reshape(-1)              # [H*W*A]
+        d = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        off = 1.0 if pixel_offset else 0.0
+        aw = anchors_f[:, 2] - anchors_f[:, 0] + off
+        ah = anchors_f[:, 3] - anchors_f[:, 1] + off
+        acx = anchors_f[:, 0] + aw * 0.5
+        acy = anchors_f[:, 1] + ah * 0.5
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = aw * jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0))
+        bh = ah * jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0))
+        x1 = jnp.clip(cx - bw * 0.5, 0, im[1] - off)
+        y1 = jnp.clip(cy - bh * 0.5, 0, im[0] - off)
+        x2 = jnp.clip(cx + bw * 0.5, 0, im[1] - off)
+        y2 = jnp.clip(cy + bh * 0.5, 0, im[0] - off)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        keep_size = ((x2 - x1 + off) >= min_size) & \
+                    ((y2 - y1 + off) >= min_size)
+        s = jnp.where(keep_size, s, -jnp.inf)
+        k = min(pre_nms_top_n, total)
+        top_s, top_i = jax.lax.top_k(s, k)
+        top_boxes = boxes[top_i]
+        keep_idx = jnp.asarray(
+            nms(top_boxes, nms_thresh, scores=top_s,
+                top_k=post_nms_top_n))        # score-ordered, -1 padded
+        pad = post_nms_top_n - keep_idx.shape[0]
+        if pad > 0:
+            keep_idx = jnp.pad(keep_idx, (0, pad), constant_values=-1)
+        keep_idx = keep_idx[:post_nms_top_n]
+        valid = keep_idx >= 0
+        safe = jnp.maximum(keep_idx, 0)
+        sel_s = top_s[safe]
+        valid = valid & jnp.isfinite(sel_s)
+        sel = jnp.where(valid[:, None], top_boxes[safe], 0.0)
+        sel_s = jnp.where(valid, sel_s, 0.0)
+        return sel, sel_s, jnp.sum(valid.astype(jnp.int32))
+
+    im = jnp.asarray(img_size, jnp.float32).reshape(n, -1)
+    rois, probs, counts = jax.vmap(one)(scores, deltas, im)
+    rois = rois.reshape(-1, 4)
+    probs = probs.reshape(-1, 1)
+    if return_rois_num:
+        return rois, probs, counts
+    return rois, probs
+
+
+__all__ += ["RoIAlign", "RoIPool", "PSRoIPool", "psroi_pool",
+            "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+            "generate_proposals"]

@@ -255,3 +255,220 @@ class Pad(_Transform):
 
     def __call__(self, img):
         return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+# --- round-3 op-coverage additions (OP_COVERAGE.md; reference:
+# python/paddle/vision/transforms/functional.py + transforms.py) ----------
+
+def adjust_brightness(img, brightness_factor):
+    """out = img * factor (reference semantics)."""
+    dtype = img.dtype
+    out = img.astype(np.float32) * brightness_factor
+    if dtype == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the mean of the grayscale image (reference formula)."""
+    dtype = img.dtype
+    f = img.astype(np.float32)
+    gray = _rgb_to_gray(f) if f.ndim == 3 and f.shape[-1] == 3 else f
+    mean = gray.mean()
+    out = (1 - contrast_factor) * mean + contrast_factor * f
+    if dtype == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dtype)
+
+
+def _rgb_to_gray(f):
+    return f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round trip
+    (reference: F.adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    dtype = img.dtype
+    f = img.astype(np.float32)
+    if dtype == np.uint8:
+        f = f / 255.0
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dd = np.maximum(d, 1e-12)
+    h = np.where(maxc == r, ((g - b) / dd) % 6,
+                 np.where(maxc == g, (b - r) / dd + 2, (r - g) / dd + 4))
+    h = np.where(d == 0, 0.0, h) / 6.0
+    h = (h + hue_factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    fpart = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - fpart * s)
+    t = v * (1 - (1 - fpart) * s)
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if dtype == np.uint8:
+        out = np.clip(out * 255.0, 0, 255)
+    return out.astype(dtype)
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    f = img.astype(np.float32)
+    gray = _rgb_to_gray(f)[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    if img.dtype == np.uint8:
+        gray = np.clip(gray, 0, 255)
+    return gray.astype(img.dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees about ``center``
+    (reference: F.rotate; nearest/bilinear inverse mapping)."""
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    theta = np.deg2rad(angle)
+    cos_t, sin_t = np.cos(theta), np.sin(theta)
+    if expand:
+        corners = np.array([[-cx, -cy], [w - 1 - cx, -cy],
+                            [-cx, h - 1 - cy], [w - 1 - cx, h - 1 - cy]])
+        rot = np.stack([corners[:, 0] * cos_t - corners[:, 1] * sin_t,
+                        corners[:, 0] * sin_t + corners[:, 1] * cos_t], 1)
+        ow = int(np.ceil(rot[:, 0].max() - rot[:, 0].min() + 1))
+        oh = int(np.ceil(rot[:, 1].max() - rot[:, 1].min() + 1))
+        ocx, ocy = (ow - 1) / 2.0, (oh - 1) / 2.0
+    else:
+        oh, ow, ocx, ocy = h, w, cx, cy
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    # inverse rotation: output pixel -> source coordinate
+    dx, dy = xs - ocx, ys - ocy
+    sx = cos_t * dx + sin_t * dy + cx
+    sy = -sin_t * dx + cos_t * dy + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int32)
+        y0 = np.floor(sy).astype(np.int32)
+        wx, wy = sx - x0, sy - y0
+        def at(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yy2, xx2 = np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)
+            px = img[yy2, xx2].astype(np.float32)
+            if img.ndim == 3:
+                return np.where(valid[..., None], px, float(fill))
+            return np.where(valid, px, float(fill))
+        wxe = wx[..., None] if img.ndim == 3 else wx
+        wye = wy[..., None] if img.ndim == 3 else wy
+        out = (at(y0, x0) * (1 - wxe) * (1 - wye) +
+               at(y0, x0 + 1) * wxe * (1 - wye) +
+               at(y0 + 1, x0) * (1 - wxe) * wye +
+               at(y0 + 1, x0 + 1) * wxe * wye)
+    else:
+        xr = np.round(sx).astype(np.int32)
+        yr = np.round(sy).astype(np.int32)
+        valid = (yr >= 0) & (yr < h) & (xr >= 0) & (xr < w)
+        out = img[np.clip(yr, 0, h - 1),
+                  np.clip(xr, 0, w - 1)].astype(np.float32)
+        mask = valid[..., None] if img.ndim == 3 else valid
+        out = np.where(mask, out, float(fill))
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(img.dtype)
+
+
+class ContrastTransform(_Transform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(
+            img, 1 + pyrandom.uniform(-self.value, self.value))
+
+
+class SaturationTransform(_Transform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + pyrandom.uniform(-self.value, self.value)
+        f = img.astype(np.float32)
+        gray = _rgb_to_gray(f)[..., None]
+        out = (1 - alpha) * gray + alpha * f
+        if img.dtype == np.uint8:
+            out = np.clip(out, 0, 255)
+        return out.astype(img.dtype)
+
+
+class HueTransform(_Transform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, pyrandom.uniform(-self.value, self.value))
+
+
+class ColorJitter(_Transform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference: transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation),
+                   HueTransform(hue)]
+
+    def __call__(self, img):
+        order = list(range(4))
+        pyrandom.shuffle(order)
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale(_Transform):
+    def __init__(self, num_output_channels: int = 1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(_Transform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def __call__(self, img):
+        angle = pyrandom.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+__all__ += ["adjust_brightness", "adjust_contrast", "adjust_hue",
+            "to_grayscale", "rotate", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "ColorJitter",
+            "Grayscale", "RandomRotation"]

@@ -187,6 +187,37 @@ PADDLE_STATIC = """
 InputSpec load_inference_model save_inference_model
 """
 
+PADDLE_DISTRIBUTION = """
+Bernoulli Beta Categorical Dirichlet Distribution Exponential
+ExponentialFamily Gamma Geometric Gumbel Laplace LogNormal Multinomial
+Normal Poisson StudentT TransformedDistribution Uniform kl_divergence
+register_kl
+"""
+
+PADDLE_SPARSE = """
+add is_sparse_coo is_sparse_csr masked_matmul matmul multiply nn relu
+sparse_coo_tensor sparse_csr_tensor subtract tanh transpose
+"""
+
+PADDLE_INCUBATE_NN = """
+FusedFeedForward FusedMultiHeadAttention FusedMultiTransformer functional
+"""
+
+PADDLE_VISION_TRANSFORMS = """
+BrightnessTransform CenterCrop ColorJitter Compose ContrastTransform
+Grayscale HueTransform Normalize Pad RandomCrop RandomHorizontalFlip
+RandomResizedCrop RandomRotation RandomVerticalFlip Resize
+SaturationTransform ToTensor Transpose adjust_brightness adjust_contrast
+adjust_hue center_crop crop hflip normalize pad resize rotate to_grayscale
+to_tensor vflip
+"""
+
+PADDLE_VISION_OPS = """
+DeformConv2D PSRoIPool RoIAlign RoIPool box_area box_iou deform_conv2d
+distribute_fpn_proposals generate_proposals nms psroi_pool roi_align
+roi_pool
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -203,6 +234,11 @@ REFERENCE = {
     "paddle.amp": PADDLE_AMP,
     "paddle.jit": PADDLE_JIT,
     "paddle.static": PADDLE_STATIC,
+    "paddle.distribution": PADDLE_DISTRIBUTION,
+    "paddle.sparse": PADDLE_SPARSE,
+    "paddle.incubate.nn": PADDLE_INCUBATE_NN,
+    "paddle.vision.transforms": PADDLE_VISION_TRANSFORMS,
+    "paddle.vision.ops": PADDLE_VISION_OPS,
 }
 
 # repo namespace that answers for each reference namespace
@@ -222,6 +258,11 @@ TARGETS = {
     "paddle.amp": "paddle_tpu.amp",
     "paddle.jit": "paddle_tpu.jit",
     "paddle.static": "paddle_tpu.static",
+    "paddle.distribution": "paddle_tpu.distribution",
+    "paddle.sparse": "paddle_tpu.sparse",
+    "paddle.incubate.nn": "paddle_tpu.incubate.nn",
+    "paddle.vision.transforms": "paddle_tpu.vision.transforms",
+    "paddle.vision.ops": "paddle_tpu.vision.ops",
 }
 
 

@@ -326,3 +326,29 @@ def test_break_loop_eager_python_path():
     out = convert_to_static(f)(np.ones(4))
     want = f(np.ones(4))
     np.testing.assert_allclose(np.asarray(out), np.asarray(want))
+
+
+def test_for_range_break_continue():
+    """for-range with break/continue over a traced bound: the increment
+    runs as the not-broken epilogue (python for semantics — continue
+    advances the index, break freezes it)."""
+    def f(x, n):
+        acc = jnp.zeros_like(x)
+        last = jnp.asarray(-1, jnp.int32)
+        for i in range(n):
+            if i % 2 == 1:
+                continue
+            if jnp.sum(acc) > 6.0:
+                break
+            acc = acc + x * (i + 1)
+            last = jnp.asarray(i, jnp.int32)
+        return acc, last
+
+    static = pjit.to_static(f)
+    x = jnp.ones(2)
+    for n in (0, 1, 5, 9):
+        want = f(x, n)
+        got = static(x, jnp.asarray(n, jnp.int32))
+        np.testing.assert_allclose(np.asarray(want[0]), np.asarray(got[0]),
+                                   atol=1e-6)
+        assert int(want[1]) == int(np.asarray(got[1])), (n, want[1], got[1])

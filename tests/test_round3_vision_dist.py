@@ -1,0 +1,197 @@
+"""Round-3 vision-ops/transforms/distribution completions (torch/scipy/
+analytic oracles)."""
+
+import math
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.vision import ops as O
+from paddle_tpu.vision import transforms as T
+import paddle_tpu.distribution as D
+
+rs = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------- deform conv
+
+def test_deform_conv_zero_offset_equals_conv():
+    x = rs.randn(2, 4, 8, 8).astype(np.float32)
+    w = rs.randn(6, 4, 3, 3).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+    off = np.zeros((2, 18, 8, 8), np.float32)
+    mine = np.asarray(O.deform_conv2d(x, off, w, b, stride=1, padding=1))
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     torch.tensor(b), padding=1).numpy()
+    np.testing.assert_allclose(mine, ref, atol=1e-4)
+
+
+def test_deform_conv_integer_offset_equals_shifted_conv():
+    x = rs.randn(2, 4, 8, 8).astype(np.float32)
+    w = rs.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.zeros((2, 18, 8, 8), np.float32)
+    off[:, 0::2] = 1.0          # dy = 1 for every tap
+    mine = np.asarray(O.deform_conv2d(x, off, w, None, stride=1, padding=1))
+    xs = np.zeros_like(x)
+    xs[:, :, :-1] = x[:, :, 1:]
+    ref = torch.nn.functional.conv2d(torch.tensor(xs), torch.tensor(w),
+                                     padding=1).numpy()
+    np.testing.assert_allclose(mine[:, :, 1:-2, 1:-1],
+                               ref[:, :, 1:-2, 1:-1], atol=1e-3)
+
+
+def test_deform_conv_v2_mask_scales():
+    """v2: mask of 0.5 on every tap halves the zero-offset output."""
+    x = rs.randn(1, 2, 6, 6).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    mask = np.full((1, 9, 6, 6), 0.5, np.float32)
+    full = np.asarray(O.deform_conv2d(x, off, w, None, padding=1))
+    half = np.asarray(O.deform_conv2d(x, off, w, None, padding=1,
+                                      mask=mask))
+    np.testing.assert_allclose(half, 0.5 * full, atol=1e-5)
+
+
+# ------------------------------------------------------------- psroi / fpn
+
+def test_psroi_pool_position_sensitive():
+    xp = np.zeros((1, 8, 6, 6), np.float32)
+    for c in range(8):
+        xp[0, c] = c
+    out = np.asarray(O.psroi_pool(xp, np.array([[0., 0., 6., 6.]],
+                                               np.float32), [1], 2, 1.0))
+    want = np.array([[[0., 1.], [2., 3.]],
+                     [[4., 5.], [6., 7.]]], np.float32)[None]
+    np.testing.assert_allclose(out, want)
+
+
+def test_distribute_fpn_levels():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 500, 500]],
+                    np.float32)
+    outs, restore, masks = O.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    got = []
+    for i in range(3):
+        for li, m in enumerate(masks):
+            if bool(np.asarray(m)[i]):
+                got.append(li + 2)
+    want = [min(max(int(math.floor(4 + math.log2(s / 224))), 2), 5)
+            for s in (10, 100, 500)]
+    assert got == want
+
+
+def test_generate_proposals_static_shapes():
+    H, W, A = 4, 4, 3
+    scores = rs.rand(1, A, H, W).astype(np.float32)
+    deltas = (rs.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+    anchors = np.array([[x * 4 - s / 2, y * 4 - s / 2,
+                         x * 4 + s / 2, y * 4 + s / 2]
+                        for y in range(H) for x in range(W)
+                        for s in (8, 16, 32)], np.float32)
+    rois, probs, num = O.generate_proposals(
+        scores, deltas, [[16., 16.]], anchors, np.ones_like(anchors),
+        pre_nms_top_n=48, post_nms_top_n=10, nms_thresh=0.7)
+    assert rois.shape == (10, 4) and probs.shape == (10, 1)
+    assert 1 <= int(num[0]) <= 10
+    # kept boxes stay inside the image
+    kept = np.asarray(rois)[:int(num[0])]
+    assert (kept >= 0).all() and (kept <= 16).all()
+
+
+def test_roi_layer_wrappers():
+    feat = rs.randn(1, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0., 0., 4., 4.]], np.float32)
+    assert O.RoIAlign(2, 1.0)(feat, rois, [1]).shape == (1, 3, 2, 2)
+    assert O.RoIPool(2, 1.0)(feat, rois, [1]).shape == (1, 3, 2, 2)
+    xp = rs.randn(1, 8, 8, 8).astype(np.float32)
+    assert O.PSRoIPool(2, 1.0)(xp, rois, [1]).shape == (1, 2, 2, 2)
+
+
+# --------------------------------------------------------------- transforms
+
+def test_adjust_brightness_and_grayscale():
+    img = rs.randint(0, 256, (8, 10, 3)).astype(np.uint8)
+    out = T.adjust_brightness(img, 1.5)
+    want = np.clip(img.astype(np.float32) * 1.5, 0, 255).astype(np.uint8)
+    assert np.array_equal(out, want)
+    g = T.to_grayscale(img, 3)
+    assert g.shape == img.shape and np.all(g[..., 0] == g[..., 1])
+
+
+def test_adjust_hue_roundtrip():
+    img = rs.randint(0, 256, (8, 10, 3)).astype(np.uint8)
+    assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                  - img.astype(int)).max() <= 2
+    h1 = T.adjust_hue(T.adjust_hue(img, 0.5), 0.5)
+    assert np.abs(h1.astype(int) - img.astype(int)).max() <= 3
+
+
+def test_rotate_quarter_turns():
+    sq = rs.randint(0, 256, (9, 9, 3)).astype(np.uint8)
+    assert np.array_equal(T.rotate(sq, 0), sq)
+    r = sq
+    for _ in range(4):
+        r = T.rotate(r, 90)
+    assert np.array_equal(r, sq)
+
+
+def test_color_jitter_and_random_rotation_smoke():
+    import random as pyr
+    pyr.seed(0)
+    img = rs.randint(0, 256, (8, 10, 3)).astype(np.uint8)
+    assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+    assert T.RandomRotation(30)(img).shape == img.shape
+    assert T.Grayscale(1)(img).shape == (8, 10, 1)
+
+
+# ------------------------------------------------------------- distributions
+
+def test_distribution_log_probs_vs_scipy():
+    import scipy.stats as st
+    checks = [
+        (D.Exponential(1.7), st.expon(scale=1 / 1.7), [0.3, 2.0]),
+        (D.Gamma(2.5, 1.3), st.gamma(2.5, scale=1 / 1.3), [0.5, 3.0]),
+        (D.Poisson(3.0), st.poisson(3.0), [0., 2., 5.]),
+        (D.Geometric(0.3), st.geom(0.3, loc=-1), [0., 1., 4.]),
+        (D.StudentT(5.0, 1.0, 2.0), st.t(5.0, loc=1.0, scale=2.0),
+         [0., 2.5]),
+    ]
+    for d, ref, v in checks:
+        v = np.asarray(v)
+        mine = np.asarray(d.log_prob(v))
+        want = ref.logpdf(v) if hasattr(ref, "logpdf") and \
+            not isinstance(d, (D.Poisson, D.Geometric)) else ref.logpmf(v)
+        np.testing.assert_allclose(mine, want, atol=1e-5,
+                                   err_msg=type(d).__name__)
+
+
+def test_multinomial_and_transformed():
+    import scipy.stats as st
+    k = jax.random.PRNGKey(0)
+    m = D.Multinomial(5, np.array([0.2, 0.3, 0.5]))
+    v = np.array([1., 2., 2.])
+    np.testing.assert_allclose(
+        float(m.log_prob(v)),
+        st.multinomial(5, [0.2, 0.3, 0.5]).logpmf(v), atol=1e-5)
+    s = np.asarray(m.sample((4,), key=k))
+    assert s.shape == (4, 3) and (s.sum(-1) == 5).all()
+
+    td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                   [D.AffineTransform(2.0, 3.0)])
+    v = np.array([1.0, 4.0])
+    np.testing.assert_allclose(np.asarray(td.log_prob(v)),
+                               st.norm(2.0, 3.0).logpdf(v), atol=1e-5)
+    samp = np.asarray(td.sample((20000,), key=k))
+    assert abs(samp.mean() - 2.0) < 0.1 and abs(samp.std() - 3.0) < 0.1
+
+
+def test_distribution_sampling_means():
+    k = jax.random.PRNGKey(1)
+    for d, mean in [(D.Exponential(2.0), 0.5), (D.Gamma(3.0, 2.0), 1.5),
+                    (D.Poisson(4.0), 4.0), (D.Geometric(0.25), 3.0)]:
+        s = np.asarray(d.sample((20000,), key=k))
+        assert abs(s.mean() - mean) < 0.15 * max(mean, 1), type(d).__name__
