@@ -235,3 +235,73 @@ class AutoResume(Callback):
             save_auto_resume(self._state(), self.ckpt_dir,
                              step=base + epoch + 1,
                              prefix="epoch_", keep_last=self.keep_last)
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric plateaus
+    (reference: paddle.callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+
+    def _is_improvement(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "max" or (self.mode == "auto" and
+                                  "acc" in self.monitor):
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._is_improvement(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            # cooldown evaluations neither count toward patience nor
+            # reduce (reference/keras semantics)
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                sched = getattr(opt, "_lr_sched", None)
+                if sched is not None and hasattr(sched, "base_lr"):
+                    # scale the SCHEDULE's base, not the decayed value —
+                    # writing the current (already-decayed) lr back as
+                    # base would compound the scheduler's own decay
+                    old = float(sched.base_lr)
+                    new = max(old * self.factor, self.min_lr)
+                    if new < old:
+                        sched.base_lr = new
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: base lr "
+                                  f"{old:.2e} -> {new:.2e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+__all__ += ["ReduceLROnPlateau"]
+
+
+LRScheduler = LRSchedulerCallback   # reference name: paddle.callbacks.LRScheduler
+__all__ += ["LRScheduler"]

@@ -7,3 +7,77 @@ fused nn layers (python/paddle/incubate/nn/).
 
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+
+
+# --- round-3 op-coverage additions (reference: python/paddle/incubate/
+# tensor/math.py segment ops + operators/softmax_mask_fuse*.py) -----------
+
+def segment_sum(data, segment_ids, name=None):
+    """Sum rows with equal segment id (reference: incubate.segment_sum;
+    output has max(segment_ids)+1 rows — eager computes it from the data,
+    traced callers should prefer jax.ops.segment_sum with num_segments)."""
+    import jax
+    import jax.numpy as jnp
+    ids = jnp.asarray(segment_ids, jnp.int32)
+    n = int(jnp.max(ids)) + 1
+    return jax.ops.segment_sum(jnp.asarray(data), ids, num_segments=n)
+
+
+def _segment_reduce(data, segment_ids, kind):
+    import jax
+    import jax.numpy as jnp
+    ids = jnp.asarray(segment_ids, jnp.int32)
+    n = int(jnp.max(ids)) + 1
+    fn = {"mean": None, "max": jax.ops.segment_max,
+          "min": jax.ops.segment_min}[kind]
+    if kind == "mean":
+        s = jax.ops.segment_sum(jnp.asarray(data, jnp.float32), ids,
+                                num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.float32),
+                                ids, num_segments=n)
+        c = jnp.maximum(c, 1.0).reshape((n,) + (1,) * (s.ndim - 1))
+        return s / c
+    return fn(jnp.asarray(data), ids, num_segments=n)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused graph (reference:
+    softmax_mask_fuse_op — XLA fuses this anyway; provided for API
+    parity)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.nn.softmax(jnp.asarray(x) + jnp.asarray(mask), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the causal upper-triangle masked (reference:
+    softmax_mask_fuse_upper_triangle_op): x [..., S, S]."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    s = x.shape[-1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    return jax.nn.softmax(jnp.where(causal, x, -jnp.inf), axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (reference: incubate.identity_loss)."""
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    if reduction in (0, "sum"):
+        return jnp.sum(x)
+    if reduction in (1, "mean"):
+        return jnp.mean(x)
+    return x

@@ -203,6 +203,16 @@ PADDLE_INCUBATE_NN = """
 FusedFeedForward FusedMultiHeadAttention FusedMultiTransformer functional
 """
 
+PADDLE_INCUBATE = """
+segment_sum segment_mean segment_max segment_min softmax_mask_fuse
+softmax_mask_fuse_upper_triangle identity_loss nn
+"""
+
+PADDLE_CALLBACKS = """
+Callback EarlyStopping LRScheduler ModelCheckpoint ProgBarLogger
+ReduceLROnPlateau
+"""
+
 PADDLE_VISION_TRANSFORMS = """
 BrightnessTransform CenterCrop ColorJitter Compose ContrastTransform
 Grayscale HueTransform Normalize Pad RandomCrop RandomHorizontalFlip
@@ -236,7 +246,9 @@ REFERENCE = {
     "paddle.static": PADDLE_STATIC,
     "paddle.distribution": PADDLE_DISTRIBUTION,
     "paddle.sparse": PADDLE_SPARSE,
+    "paddle.incubate": PADDLE_INCUBATE,
     "paddle.incubate.nn": PADDLE_INCUBATE_NN,
+    "paddle.callbacks": PADDLE_CALLBACKS,
     "paddle.vision.transforms": PADDLE_VISION_TRANSFORMS,
     "paddle.vision.ops": PADDLE_VISION_OPS,
 }
@@ -260,7 +272,9 @@ TARGETS = {
     "paddle.static": "paddle_tpu.static",
     "paddle.distribution": "paddle_tpu.distribution",
     "paddle.sparse": "paddle_tpu.sparse",
+    "paddle.incubate": "paddle_tpu.incubate",
     "paddle.incubate.nn": "paddle_tpu.incubate.nn",
+    "paddle.callbacks": "paddle_tpu.hapi.callbacks",
     "paddle.vision.transforms": "paddle_tpu.vision.transforms",
     "paddle.vision.ops": "paddle_tpu.vision.ops",
 }

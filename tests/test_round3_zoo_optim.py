@@ -124,3 +124,54 @@ def test_googlenet_aux_heads_and_training():
         params, ostate, buffers, loss = step(params, ostate, buffers)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_incubate_segment_ops():
+    from paddle_tpu import incubate as inc
+    data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    ids = np.array([0, 0, 1, 2])
+    np.testing.assert_allclose(np.asarray(inc.segment_sum(data, ids)),
+                               [[4., 6.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(np.asarray(inc.segment_mean(data, ids)),
+                               [[2., 3.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(np.asarray(inc.segment_max(data, ids)),
+                               [[3., 4.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(np.asarray(inc.segment_min(data, ids)),
+                               [[1., 2.], [5., 6.], [7., 8.]])
+    # N-D data along axis 0 (review fix: count broadcast)
+    d3 = np.ones((4, 2, 3), np.float32)
+    m3 = np.asarray(inc.segment_mean(d3, ids))
+    assert m3.shape == (3, 2, 3) and np.allclose(m3, 1.0)
+    x = rs.randn(2, 4, 4).astype(np.float32)
+    out = np.asarray(inc.softmax_mask_fuse_upper_triangle(x))
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-6)
+    assert (np.triu(out[0], 1) == 0).all()   # causal: no future mass
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+    import paddle_tpu.optimizer as popt
+
+    class FakeModel:
+        pass
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    m = FakeModel()
+    m._optimizer = popt.SGD(learning_rate=0.1)
+    cb.model = m
+    for loss in (1.0, 0.9, 0.9, 0.9, 0.9):   # plateaus after step 2
+        cb.on_eval_end({"loss": loss})
+    assert abs(float(m._optimizer.get_lr()) - 0.05) < 1e-9
+    # scales the SCHEDULE base, not the decayed value (review fix):
+    # with a decaying scheduler the reduction must not compound decay
+    sched = popt.lr.ExponentialDecay(0.1, gamma=0.5)
+    m2 = FakeModel()
+    m2._optimizer = popt.SGD(learning_rate=sched)
+    cb2 = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                            verbose=0)
+    cb2.model = m2
+    sched.step()                     # decayed lr now 0.05, base 0.1
+    cb2.on_eval_end({"loss": 1.0})
+    cb2.on_eval_end({"loss": 1.0})   # plateau -> base 0.1 -> 0.05
+    assert abs(float(sched.base_lr) - 0.05) < 1e-9
