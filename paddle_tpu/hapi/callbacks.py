@@ -270,16 +270,18 @@ class ReduceLROnPlateau(Callback):
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        # cooldown ticks down on EVERY evaluation (keras semantics),
+        # before the improvement check
+        in_cooldown = self.cooldown_counter > 0
+        if in_cooldown:
+            self.cooldown_counter -= 1
+            self.wait = 0
         if self._is_improvement(cur):
             self.best = cur
             self.wait = 0
             return
-        if self.cooldown_counter > 0:
-            # cooldown evaluations neither count toward patience nor
-            # reduce (reference/keras semantics)
-            self.cooldown_counter -= 1
-            self.wait = 0
-            return
+        if in_cooldown:
+            return      # non-improving cooldown evals don't count either
         self.wait += 1
         if self.wait >= self.patience:
             opt = getattr(self.model, "_optimizer", None)
@@ -290,10 +292,19 @@ class ReduceLROnPlateau(Callback):
                     # writing the current (already-decayed) lr back as
                     # base would compound the scheduler's own decay
                     old = float(sched.base_lr)
+                    before = float(opt.get_lr())
                     new = max(old * self.factor, self.min_lr)
                     if new < old:
                         sched.base_lr = new
-                        if self.verbose:
+                        after = float(opt.get_lr())
+                        if after >= before and before > self.min_lr:
+                            import warnings
+                            warnings.warn(
+                                f"ReduceLROnPlateau: scheduler "
+                                f"{type(sched).__name__} ignores base_lr "
+                                f"— the reduction had no effect",
+                                RuntimeWarning)
+                        elif self.verbose:
                             print(f"ReduceLROnPlateau: base lr "
                                   f"{old:.2e} -> {new:.2e}")
             self.cooldown_counter = self.cooldown

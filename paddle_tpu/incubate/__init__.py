@@ -26,18 +26,21 @@ def segment_sum(data, segment_ids, name=None):
 def _segment_reduce(data, segment_ids, kind):
     import jax
     import jax.numpy as jnp
+    data = jnp.asarray(data)
     ids = jnp.asarray(segment_ids, jnp.int32)
     n = int(jnp.max(ids)) + 1
-    fn = {"mean": None, "max": jax.ops.segment_max,
-          "min": jax.ops.segment_min}[kind]
+    counts = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.float32),
+                                 ids, num_segments=n)
+    present = (counts > 0).reshape((n,) + (1,) * (data.ndim - 1))
     if kind == "mean":
-        s = jax.ops.segment_sum(jnp.asarray(data, jnp.float32), ids,
+        s = jax.ops.segment_sum(data.astype(jnp.float32), ids,
                                 num_segments=n)
-        c = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.float32),
-                                ids, num_segments=n)
-        c = jnp.maximum(c, 1.0).reshape((n,) + (1,) * (s.ndim - 1))
-        return s / c
-    return fn(jnp.asarray(data), ids, num_segments=n)
+        c = jnp.maximum(counts, 1.0).reshape((n,) + (1,) * (s.ndim - 1))
+        return (s / c).astype(data.dtype)   # dtype-preserving, like sum
+    fn = {"max": jax.ops.segment_max, "min": jax.ops.segment_min}[kind]
+    out = fn(data, ids, num_segments=n)
+    # reference fills ABSENT segments with 0, not the reduction identity
+    return jnp.where(present, out, jnp.zeros((), data.dtype))
 
 
 def segment_mean(data, segment_ids, name=None):

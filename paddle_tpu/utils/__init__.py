@@ -113,3 +113,5 @@ class _UniqueName:
 
 
 unique_name = _UniqueName()
+
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,119 @@
+"""C++ custom-op extensions (reference: python/paddle/utils/
+cpp_extension/ — CppExtension + load() JIT-compiling user C++ into
+loadable custom operators).
+
+TPU-native design: the op's C++ runs on the HOST (there is no user CUDA
+on TPU; device compute belongs to XLA/Pallas).  ``load`` compiles the
+sources with g++ into a shared library (same lazy-build pattern as the
+native DataLoader ring, paddle_tpu/lib/shm_ring.cpp) and binds exported
+functions through ctypes.  ``custom_op`` wraps an exported function as a
+JAX-callable that WORKS UNDER JIT via ``jax.pure_callback`` — the
+reference's "custom op usable inside the compiled program" contract, with
+the host round-trip as the documented cost.
+
+Exported C ABI (documented convention, replacing the reference's
+PD_BUILD_OP macro machinery): each op is
+
+    extern "C" void <name>(const float* in, float* out, int64_t n);
+
+elementwise over ``n`` floats (in and out may have the same length), or
+any richer signature the caller binds manually via ``lib.fn``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "custom_op", "CppExtension"]
+
+
+class CppExtension:
+    """Build-spec carrier (reference signature parity; ``setup(ext_modules=
+    [CppExtension(...)])`` maps onto load())."""
+
+    def __init__(self, sources: Sequence[str], extra_compile_args=None,
+                 include_dirs=None, name: Optional[str] = None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.include_dirs = list(include_dirs or [])
+        self.name = name
+
+
+class _Loaded:
+    def __init__(self, name: str, lib: ctypes.CDLL, path: str):
+        self.name = name
+        self._lib = lib
+        self.lib_path = path
+
+    def __getattr__(self, fn_name):
+        return getattr(self._lib, fn_name)
+
+
+def load(name: str, sources: Sequence[str], extra_cflags=None,
+         extra_include_paths=None, build_directory: Optional[str] = None,
+         verbose: bool = False) -> _Loaded:
+    """Compile ``sources`` (paths to .cc/.cpp files) into ``lib<name>.so``
+    and load it (reference: cpp_extension.load)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_cpp_ext")
+    os.makedirs(build_dir, exist_ok=True)
+    srcs = [os.path.abspath(s) for s in sources]
+    # content-hashed artifact name: dlopen caches by PATH within a
+    # process, so rebuilding in place would silently keep executing the
+    # OLD image — a changed source must map to a fresh .so path
+    import hashlib
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    so_path = os.path.join(build_dir,
+                           f"lib{name}_{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", so_path, *srcs]
+        for inc in (extra_include_paths or []):
+            cmd.append(f"-I{inc}")
+        cmd.extend(extra_cflags or [])
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{r.stderr[-2000:]}")
+    return _Loaded(name, ctypes.CDLL(so_path), so_path)
+
+
+def custom_op(loaded: _Loaded, fn_name: str) -> Callable:
+    """Bind exported ``void fn(const float*, float*, int64_t)`` as a
+    jit-compatible JAX callable (host callback; float32 elementwise
+    contract — see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfn = getattr(loaded, fn_name)
+    cfn.restype = None
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host(x):
+        x = np.ascontiguousarray(np.asarray(x, np.float32))
+        out = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(x.size))
+        return out
+
+    def apply(x):
+        x = jnp.asarray(x, jnp.float32)
+        return jax.pure_callback(
+            host, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+            vmap_method="sequential")
+
+    apply.__name__ = fn_name
+    return apply

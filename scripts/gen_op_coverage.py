@@ -213,6 +213,10 @@ Callback EarlyStopping LRScheduler ModelCheckpoint ProgBarLogger
 ReduceLROnPlateau
 """
 
+PADDLE_UTILS = """
+cpp_extension deprecated run_check try_import unique_name
+"""
+
 PADDLE_VISION_TRANSFORMS = """
 BrightnessTransform CenterCrop ColorJitter Compose ContrastTransform
 Grayscale HueTransform Normalize Pad RandomCrop RandomHorizontalFlip
@@ -249,6 +253,7 @@ REFERENCE = {
     "paddle.incubate": PADDLE_INCUBATE,
     "paddle.incubate.nn": PADDLE_INCUBATE_NN,
     "paddle.callbacks": PADDLE_CALLBACKS,
+    "paddle.utils": PADDLE_UTILS,
     "paddle.vision.transforms": PADDLE_VISION_TRANSFORMS,
     "paddle.vision.ops": PADDLE_VISION_OPS,
 }
@@ -275,6 +280,7 @@ TARGETS = {
     "paddle.incubate": "paddle_tpu.incubate",
     "paddle.incubate.nn": "paddle_tpu.incubate.nn",
     "paddle.callbacks": "paddle_tpu.hapi.callbacks",
+    "paddle.utils": "paddle_tpu.utils",
     "paddle.vision.transforms": "paddle_tpu.vision.transforms",
     "paddle.vision.ops": "paddle_tpu.vision.ops",
 }
