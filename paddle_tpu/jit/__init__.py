@@ -43,7 +43,8 @@ from .dy2static import convert_to_static, Dy2StaticError
 
 __all__ = ["to_static", "save", "load", "StaticFunction", "TranslatedLayer",
            "not_to_static", "ignore_module", "enable_to_static",
-           "convert_to_static", "Dy2StaticError", "dy2static"]
+           "convert_to_static", "Dy2StaticError", "dy2static",
+           "save_program", "load_program"]
 
 _TO_STATIC_ENABLED = True
 
@@ -249,3 +250,30 @@ def load(path: str) -> TranslatedLayer:
         with open(meta_path) as f:
             meta = json.load(f)
     return TranslatedLayer(exported, params, buffers, meta)
+
+
+def save_program(fn, path: str, *example_args):
+    """AOT-export an arbitrary jitted program — including MULTI-DEVICE
+    programs (shard_map/pjit over a Mesh): the serialized artifact pins
+    the device count and carries the input shardings, so a pp×mp×dp
+    hybrid TRAIN STEP can be exported and later executed without any of
+    the Python that built it (reference analog: saving the distributed
+    static Program; jax.export is the StableHLO-based equivalent).
+
+    Writes {path}.pdprog.  example_args may be arrays OR
+    jax.ShapeDtypeStruct pytrees."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    exported = jax.export.export(jitted)(*example_args)
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    with open(path + ".pdprog", "wb") as f:
+        f.write(exported.serialize())
+    return exported
+
+
+def load_program(path: str):
+    """Load a save_program artifact; returns an object whose ``call``
+    runs the compiled program (the current process must expose at least
+    the exported device count)."""
+    with open(path + ".pdprog", "rb") as f:
+        return jax.export.deserialize(bytearray(f.read()))

@@ -60,23 +60,40 @@ def load(name: str, sources: Sequence[str], extra_cflags=None,
          verbose: bool = False) -> _Loaded:
     """Compile ``sources`` (paths to .cc/.cpp files) into ``lib<name>.so``
     and load it (reference: cpp_extension.load)."""
+    # per-user private build dir: the artifact is dlopen'd, so a shared
+    # world-writable location would let another local user pre-plant a
+    # library at the predictable path
     build_dir = build_directory or os.path.join(
-        tempfile.gettempdir(), "paddle_tpu_cpp_ext")
+        tempfile.gettempdir(), f"paddle_tpu_cpp_ext_{os.getuid()}")
     os.makedirs(build_dir, exist_ok=True)
+    try:
+        os.chmod(build_dir, 0o700)
+    except OSError:
+        pass
     srcs = [os.path.abspath(s) for s in sources]
     # content-hashed artifact name: dlopen caches by PATH within a
     # process, so rebuilding in place would silently keep executing the
-    # OLD image — a changed source must map to a fresh .so path
+    # OLD image — changed sources OR build flags must map to a fresh
+    # .so path
     import hashlib
     h = hashlib.sha256()
     for s in srcs:
+        h.update(s.encode() + b"\0")
         with open(s, "rb") as f:
             h.update(f.read())
+        h.update(b"\0")
+    for flag in (extra_cflags or []):
+        h.update(flag.encode() + b"\0")
+    for inc in (extra_include_paths or []):
+        h.update(inc.encode() + b"\0")
     so_path = os.path.join(build_dir,
                            f"lib{name}_{h.hexdigest()[:12]}.so")
     if not os.path.exists(so_path):
+        # compile to a unique temp name, rename atomically: concurrent
+        # loaders must never dlopen a half-written artifact
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               "-o", so_path, *srcs]
+               "-o", tmp_path, *srcs]
         for inc in (extra_include_paths or []):
             cmd.append(f"-I{inc}")
         cmd.extend(extra_cflags or [])
@@ -86,6 +103,7 @@ def load(name: str, sources: Sequence[str], extra_cflags=None,
         if r.returncode != 0:
             raise RuntimeError(
                 f"cpp_extension build failed:\n{r.stderr[-2000:]}")
+        os.replace(tmp_path, so_path)
     return _Loaded(name, ctypes.CDLL(so_path), so_path)
 
 

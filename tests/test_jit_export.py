@@ -132,3 +132,42 @@ def test_static_save_load_inference_model(tmp_path):
     x = jnp.asarray(np.random.RandomState(6).randn(3, 8), jnp.float32)
     np.testing.assert_allclose(np.asarray(m(x)), np.asarray(net(x)),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_save_load_multi_device_program(tmp_path):
+    """AOT export of the FULL hybrid-parallel train step (dp2 x mp2 x pp2
+    over 8 devices): serialize, reload, execute — bit-equal loss.  The
+    deployment story for distributed programs (round-3 addition)."""
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.models import gpt_tiny, GPTHybridTrainer
+    from paddle_tpu import jit as pjit
+
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=s)
+    try:
+        paddle_tpu.seed(0)
+        tr = GPTHybridTrainer(gpt_tiny(remat=False),
+                              dist.get_hybrid_communicate_group(),
+                              popt.SGD(learning_rate=0.1), microbatches=2)
+        state = tr.init_state()
+        x, y = tr.make_batch(batch=4, seq=16)
+        step = tr.jit_step(donate=False)
+        lr = jnp.asarray(0.1, jnp.float32)
+        want = step(*state, x, y, lr)
+
+        path = str(tmp_path / "hybrid_step")
+        exp = pjit.save_program(step, path, *state, x, y, lr)
+        assert exp.nr_devices == 8
+
+        back = pjit.load_program(path)
+        got = back.call(*state, x, y, lr)
+        np.testing.assert_allclose(np.asarray(got[-1]),
+                                   np.asarray(want[-1]), rtol=1e-6)
+        # updated params match too (spot check one leaf)
+        k = next(iter(want[0]))
+        np.testing.assert_allclose(np.asarray(got[0][k]),
+                                   np.asarray(want[0][k]), rtol=1e-6)
+    finally:
+        dist.topology.set_hybrid_communicate_group(None)
