@@ -121,14 +121,46 @@ def _diagnose_undefined(outs_a, outs_b, names, what, cause):
 # ---------------------------------------------------------------------------
 
 def convert_if(pred, true_fn, false_fn, args=(), names=()):
-    """Dispatch an ``if``: tensor predicate -> lax.cond, else Python."""
+    """Dispatch an ``if``: tensor predicate -> lax.cond, else Python.
+
+    A variable bound in only ONE branch (e.g. a loop counter declared
+    inside the branch) is materialized as zeros of the binding branch's
+    shape on the other path — the reference's UndefinedVar/fill-constant
+    placeholder semantics.  Reading it on the not-taken path therefore
+    yields zeros instead of eager Python's NameError (documented
+    deviation, same as the reference)."""
     if _is_tracer(pred):
-        try:
-            return jax.lax.cond(pred, true_fn, false_fn, *args)
-        except (TypeError, ValueError) as e:
+        t_fn, f_fn = true_fn, false_fn
+        # probe only when a binding CAN be one-sided (an Undefined rides
+        # the operands) — unconditional probing would re-trace both
+        # branches per if, compounding exponentially with nesting
+        if names and any(isinstance(a, _Undefined) for a in args):
             try:
                 ot = jax.eval_shape(true_fn, *args)
                 of = jax.eval_shape(false_fn, *args)
+                patch = {
+                    i: (of[i] if isinstance(ot[i], _Undefined) else ot[i])
+                    for i in range(len(names))
+                    if isinstance(ot[i], _Undefined)
+                    != isinstance(of[i], _Undefined)}
+                if patch:
+                    def _fill(fn):
+                        def g(*a):
+                            out = list(fn(*a))
+                            for i, s in patch.items():
+                                if isinstance(out[i], _Undefined):
+                                    out[i] = jnp.zeros(s.shape, s.dtype)
+                            return tuple(out)
+                        return g
+                    t_fn, f_fn = _fill(true_fn), _fill(false_fn)
+            except Exception:
+                pass  # fall through; lax.cond raises into the diagnosis
+        try:
+            return jax.lax.cond(pred, t_fn, f_fn, *args)
+        except (TypeError, ValueError) as e:
+            try:
+                ot = jax.eval_shape(t_fn, *args)
+                of = jax.eval_shape(f_fn, *args)
             except Exception:
                 ot = of = None
             if ot is not None:

@@ -246,15 +246,22 @@ def test_loop_carried_shape_change_clear_error():
         static(jnp.ones(2))
 
 
-def test_undefined_after_branch_clear_error():
+def test_one_sided_binding_materializes_placeholder():
+    """A variable bound in only one branch gets the reference's
+    UndefinedVar/fill-constant placeholder on the other path: the taken
+    branch's value when the predicate holds, zeros otherwise (eager
+    Python would raise NameError on the false path — documented
+    deviation, same as the reference)."""
     def f(x):
         if x.sum() > 0:
             y = x * 2.0
         return y  # noqa: F821 — defined only on one path
 
     static = pjit.to_static(f)
-    with pytest.raises(Dy2StaticError, match="undefined"):
-        static(jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(static(jnp.ones(4))),
+                               2.0 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(static(-jnp.ones(4))),
+                               np.zeros(4))
 
 
 def test_enable_to_static_toggle():
@@ -352,3 +359,19 @@ def test_for_range_break_continue():
         np.testing.assert_allclose(np.asarray(want[0]), np.asarray(got[0]),
                                    atol=1e-6)
         assert int(want[1]) == int(np.asarray(got[1])), (n, want[1], got[1])
+
+
+def test_read_before_write_one_sided_clear_error():
+    """A branch that READS a one-sided variable before writing it cannot
+    be materialized (the probe fails on the Undefined read) — the clear
+    Dy2StaticError diagnosis must surface, not a raw JAX error."""
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = y + 1.0  # noqa: F821 — read before any binding
+        return y
+
+    static = pjit.to_static(f)
+    with pytest.raises(Dy2StaticError):
+        static(jnp.ones(4))
