@@ -157,7 +157,20 @@ def convert_if(pred, true_fn, false_fn, args=(), names=()):
                 pass  # fall through; lax.cond raises into the diagnosis
         try:
             return jax.lax.cond(pred, t_fn, f_fn, *args)
-        except (TypeError, ValueError) as e:
+        except Dy2StaticError:
+            raise
+        except Exception as e:
+            # AttributeError/TypeError from an op on an _Undefined (a
+            # read-before-write of a one-sided variable) must surface as
+            # the clear diagnosis, not a raw JAX/attribute error
+            if any("_Undefined" in str(a) or "undefined" in str(a).lower()
+                   for a in e.args if isinstance(a, str)) or                     "_Undefined" in repr(e):
+                raise Dy2StaticError(
+                    f"a branch of this tensor-dependent if READS a "
+                    f"variable that is bound on only one path before "
+                    f"writing it ({e}); bind it before the if") from e
+            if not isinstance(e, (TypeError, ValueError)):
+                raise
             try:
                 ot = jax.eval_shape(t_fn, *args)
                 of = jax.eval_shape(f_fn, *args)

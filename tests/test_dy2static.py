@@ -375,3 +375,18 @@ def test_read_before_write_one_sided_clear_error():
     static = pjit.to_static(f)
     with pytest.raises(Dy2StaticError):
         static(jnp.ones(4))
+
+
+def test_read_before_write_attribute_clear_error():
+    """Attribute access on a one-sided variable (y.sum() before binding)
+    surfaces the clear diagnosis, not a raw AttributeError."""
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = y.sum() * x  # noqa: F821 — read before any binding
+        return y
+
+    static = pjit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="one path"):
+        static(jnp.ones(4))
