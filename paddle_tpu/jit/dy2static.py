@@ -34,6 +34,10 @@ Supported subset (documented; the reference converts a larger one):
     including ``break``/``continue`` (the index increment runs as a
     not-broken epilogue, so ``continue`` advances the iterator and
     ``break`` freezes the index — python for semantics);
+  * ternary ``a if c else b`` (lazy on concrete c, lax.cond on traced);
+  * ``print`` with traced args -> jax.debug.print (the reference's Print
+    op); ``assert`` keeps python semantics on concrete values and raises
+    guidance (use checkify) on traced ones;
   * arbitrary nesting of the above.
 
 NOT converted — left as plain Python, which stays correct for concrete
@@ -229,6 +233,44 @@ def convert_while(cond_fn, body_fn, init=(), names=()):
     return vals
 
 
+def convert_ifexp(pred, true_fn, false_fn):
+    """Ternary ``a if c else b``: traced c -> lax.cond over no-arg
+    branches; concrete c keeps Python's lazy evaluation."""
+    if _is_tracer(pred):
+        return jax.lax.cond(pred, true_fn, false_fn)
+    return true_fn() if pred else false_fn()
+
+
+def convert_assert(pred, msg_fn=None):
+    """``assert`` over a traced value cannot halt a compiled program —
+    raise the clear guidance instead of a TracerBoolConversionError
+    (reference converts to an Assert op; the runtime check equivalent
+    here is framework.debug.check_numerics / jax.experimental.checkify).
+    Concrete values keep exact Python assert semantics, including the
+    LAZY message (``msg_fn`` is a thunk evaluated only on failure)."""
+    if _is_tracer(pred):
+        raise Dy2StaticError(
+            "assert over a traced tensor cannot run inside the compiled "
+            "program; use paddle_tpu.framework.debug.check_numerics or "
+            "jax.experimental.checkify for runtime checks")
+    if not pred:
+        raise AssertionError(msg_fn() if msg_fn is not None else "")
+
+
+def convert_print(*args, **kwargs):
+    """``print`` with traced arguments becomes jax.debug.print (the
+    reference converts print to its Print op); concrete args print
+    normally.  Traced path honors ``sep``; ``end``/``file``/``flush``
+    are host-print concepts jax.debug.print cannot express (documented
+    deviation — output goes to the debug stream with a newline)."""
+    if any(_is_tracer(a) for a in args):
+        sep = kwargs.get("sep", " ")
+        fmt = sep.join("{}" for _ in args)
+        jax.debug.print(fmt, *args)
+    else:
+        print(*args, **kwargs)
+
+
 def convert_and(first, second_fn):
     """``a and b`` with short-circuit on the Python path."""
     if _is_tracer(first):
@@ -267,6 +309,8 @@ def range_cond(i, stop, step):
 _JST = types.SimpleNamespace(
     convert_if=convert_if, convert_while=convert_while,
     convert_and=convert_and, convert_or=convert_or, convert_not=convert_not,
+    convert_ifexp=convert_ifexp, convert_assert=convert_assert,
+    convert_print=convert_print,
     py_only=py_only, range_cond=range_cond, Undefined=_Undefined)
 
 
@@ -530,6 +574,41 @@ class _Transformer(ast.NodeTransformer):
                 keywords=[]))
         return self._undef_preamble(modified) + [t_fn, f_fn, assign]
 
+    # -- ternary / assert / print ---------------------------------------
+    def visit_IfExp(self, node: ast.IfExp):
+        self.generic_visit(node)
+        def thunk(expr):
+            return ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=expr)
+        return ast.Call(func=self._jst("convert_ifexp"),
+                        args=[self._convert_cond_expr(node.test),
+                              thunk(node.body), thunk(node.orelse)],
+                        keywords=[])
+
+    def visit_Assert(self, node: ast.Assert):
+        self.generic_visit(node)
+        args = [self._convert_cond_expr(node.test)]
+        if node.msg is not None:
+            # thunk: python evaluates the assert message LAZILY
+            args.append(ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=node.msg))
+        return ast.Expr(value=ast.Call(func=self._jst("convert_assert"),
+                                       args=args, keywords=[]))
+
+    def visit_Expr(self, node: ast.Expr):
+        self.generic_visit(node)
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and \
+                v.func.id == "print" and \
+                "print" not in self.func_assigned and not any(
+                    isinstance(a, ast.Starred) for a in v.args):
+            v.func = self._jst("convert_print")
+        return node
+
     # -- break/continue flag rewriting ----------------------------------
     # (reference: dy2static BreakContinueTransformer — jumps become flag
     # assignments, the statements after a potential jump run under a
@@ -772,7 +851,18 @@ def convert_to_static(fn: Callable) -> Callable:
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    if not _has_stmt(fdef.body, (ast.If, ast.While, ast.For, ast.BoolOp)):
+    def _has_print(nodes):
+        for node in nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name) and \
+                        sub.func.id == "print":
+                    return True
+        return False
+
+    if not _has_stmt(fdef.body, (ast.If, ast.While, ast.For, ast.BoolOp,
+                                 ast.IfExp, ast.Assert)) and \
+            not _has_print(fdef.body):
         _CACHE[fn] = fn
         return fn
 

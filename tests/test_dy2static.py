@@ -390,3 +390,65 @@ def test_read_before_write_attribute_clear_error():
     static = pjit.to_static(f)
     with pytest.raises(Dy2StaticError, match="one path"):
         static(jnp.ones(4))
+
+
+def test_ternary_ifexp_converts():
+    def f(x):
+        y = (x * 2.0) if x.sum() > 0 else (-x)
+        z = 1.0 if x.max() > 100.0 else 0.5   # stays cond-dispatched
+        return y * z
+
+    _check(f, (jnp.ones(4),), (-jnp.ones(4),))
+
+
+def test_assert_on_tensor_clear_error_and_python_assert_kept():
+    def f(x):
+        assert x.sum() > 0, "neg"
+        return x * 2.0
+
+    static = pjit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="checkify"):
+        static(jnp.ones(4))
+    # concrete path keeps python assert semantics
+    conv = convert_to_static(f)
+    np.testing.assert_allclose(np.asarray(conv(np.ones(4))), 2 * np.ones(4))
+    with pytest.raises(AssertionError, match="neg"):
+        conv(np.full(4, -1.0))
+
+
+def test_print_converts_to_debug_print(capfd):
+    def f(x):
+        print("value:", x.sum())
+        return x + 1.0
+
+    static = pjit.to_static(f)
+    out = static(jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones(3))
+    import jax
+    jax.effects_barrier()
+    captured = capfd.readouterr()
+    assert "3.0" in captured.out, captured.out
+
+
+def test_assert_msg_lazy_and_print_shadow_respected():
+    """Assert messages stay LAZY (only evaluated on failure), and a
+    locally rebound ``print`` is NOT hijacked by the conversion."""
+    def f(x):
+        errors = []
+        assert x.shape[0] == 3, errors[0]   # msg would raise if evaluated
+        return x * 2.0
+
+    conv = convert_to_static(f)
+    np.testing.assert_allclose(np.asarray(conv(np.ones(3))), 2 * np.ones(3))
+
+    def g(x):
+        logs = []
+        print = logs.append   # noqa: A001 — deliberate shadow
+        print("recorded")
+        if x.sum() > 0:
+            x = x * 1.0
+        return x, logs
+
+    conv_g = convert_to_static(g)
+    _, logs = conv_g(np.ones(2))
+    assert logs == ["recorded"]
