@@ -8,8 +8,10 @@ rounds, so the reference list below is CURATED from the reference's
 published stable-2.x Python API documentation (the YAML-generated op
 surface exposed through python/paddle/*), not extracted from a tree.  It
 deliberately covers the user-facing namespaces a migrating user touches
-(paddle.*, paddle.linalg, paddle.nn, paddle.nn.functional, paddle.fft,
-paddle.signal) rather than internal _C_ops.  Names that are pure aliases
+(23 namespaces: paddle.*, distributed, linalg, nn, nn.functional, fft,
+signal, optimizer(+lr), vision.{models,transforms,ops}, io, metric, amp,
+jit, static, distribution, sparse, incubate(+nn), callbacks, utils)
+rather than internal _C_ops.  Names that are pure aliases
 in the reference (e.g. paddle.max vs Tensor.max) appear once.
 
 Run:  python scripts/gen_op_coverage.py   (writes OP_COVERAGE.md)
