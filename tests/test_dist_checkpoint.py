@@ -206,3 +206,30 @@ def test_async_save_rendezvous_on_next_save(tmp_path):
     got = load_state_dict({"w": jax.ShapeDtypeStruct((8, 4), jnp.float32)},
                           str(tmp_path))
     np.testing.assert_array_equal(np.asarray(got["w"]), 7.0)
+
+
+def test_async_save_failure_surfaces_at_rendezvous(tmp_path):
+    """A background write failure must raise at wait_for_pending_saves,
+    not vanish into the thread (review r3: the durability guarantee)."""
+    from paddle_tpu.distributed.checkpoint import wait_for_pending_saves
+
+    target = tmp_path / "ck"
+    m = _mesh((8,), ["dp"])
+    w = shard_tensor(np.ones((8, 2), np.float32), m, [Shard(0)])
+
+    t = save_state_dict({"w": w}, str(target), async_save=True)
+    t.join()
+    # inject the failure by replacing np.savez (numpy is shared with the
+    # implementation module, so the background write hits the stub)
+    real_savez = np.savez
+
+    def boom(*a, **k):
+        raise OSError("disk full (injected)")
+
+    np.savez = boom
+    try:
+        save_state_dict({"w": w}, str(target), async_save=True)
+        with pytest.raises(RuntimeError, match="incomplete"):
+            wait_for_pending_saves(str(target))
+    finally:
+        np.savez = real_savez

@@ -184,3 +184,31 @@ def test_flops_counts_linear_and_conv(capsys):
     # linear: 2*1*128*10 = 2560
     assert total == 6912 + 128 + 2560, total
     assert "Total Flops" in capsys.readouterr().out
+
+
+def test_hapi_fit_with_reduce_lr_on_plateau():
+    """ReduceLROnPlateau wired through Model.fit's eval hook: a plateaued
+    eval loss halves the base lr during training."""
+    import numpy as np
+    import paddle_tpu
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+    from paddle_tpu.io import TensorDataset
+
+    paddle_tpu.seed(0)
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 4).astype(np.float32)
+    # constant labels disconnected from x -> loss plateaus fast
+    y = np.zeros((32,), np.int64)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    m = Model(net)
+    sgd = opt.SGD(learning_rate=0.1)
+    m.prepare(optimizer=sgd, loss=nn.CrossEntropyLoss())
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           verbose=0, min_delta=10.0)  # everything "plateaus"
+    ds = TensorDataset([x, y])
+    m.fit(ds, eval_data=ds, batch_size=8, epochs=4, eval_freq=1,
+          verbose=0, callbacks=[cb])
+    assert float(sgd.get_lr()) < 0.1 - 1e-9, float(sgd.get_lr())
