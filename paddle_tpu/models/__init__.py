@@ -8,3 +8,5 @@ from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
                     llama_shard_fn, llama_tiny, llama_7b)
 from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM,  # noqa: F401
                       gpt_moe_tiny)
+from .bert import (BertConfig, BertModel, BertForMaskedLM,  # noqa: F401
+                   BertForSequenceClassification, bert_tiny)

@@ -1,0 +1,195 @@
+"""BERT family (reference ecosystem: PaddleNLP's bert modeling, the
+second pillar model family next to GPT; architecture: Devlin et al.,
+post-LN encoder).
+
+TPU-native: pure functional blocks over jnp with the repo's Layer system;
+attention routes through nn.functional.scaled_dot_product_attention (the
+Pallas flash kernel on TPU; fp32-softmax reference path with additive
+masks).  Architectural EXACTNESS is oracle-tested
+against a weight-mapped `transformers.BertModel` (tests/test_bert.py) —
+the strongest parity check available in this image.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import LayerNorm
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "bert_tiny"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class _BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = jnp.arange(s)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(pos) + \
+            self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class _BertSelfAttention(Layer):
+    """Hand-rolled q/k/v/out projections (rather than nn.MultiHeadAttention)
+    so parameter names map one-to-one onto HF/PaddleNLP BERT checkpoints —
+    the weight-mapped parity oracle depends on that naming.  The attention
+    MATH routes through the shared F.scaled_dot_product_attention (flash
+    kernel on TPU, fp32-softmax reference path otherwise)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.cfg = cfg
+        self.query = Linear(h, h)
+        self.key = Linear(h, h)
+        self.value = Linear(h, h)
+        self.out = Linear(h, h)
+
+    def forward(self, x, attn_mask=None):
+        cfg = self.cfg
+        b, s, h = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+        def split(t):
+            return t.reshape(b, s, nh, hd)
+
+        q, k, v = split(self.query(x)), split(self.key(x)), split(self.value(x))
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=cfg.attention_probs_dropout_prob,
+            is_causal=False, training=self.training)
+        return self.out(ctx.reshape(b, s, h))
+
+
+class _BertLayer(Layer):
+    """Post-LN block (BERT): x = LN(x + attn(x)); x = LN(x + ffn(x))."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = _BertSelfAttention(cfg)
+        self.attn_norm = LayerNorm(cfg.hidden_size,
+                                   epsilon=cfg.layer_norm_eps)
+        self.intermediate = Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.output = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ffn_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps)
+        self.drop = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.drop(self.attention(x, attn_mask)))
+        ffn = self.output(F.gelu(self.intermediate(x), approximate=False))
+        return self.ffn_norm(x + self.drop(ffn))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = _BertEmbeddings(cfg)
+        self.encoder = LayerList([_BertLayer(cfg)
+                                  for _ in range(cfg.num_hidden_layers)])
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        """Returns (sequence_output [b,s,h], pooled_output [b,h]).
+        ``attention_mask``: [b, s] with 1 = attend (reference contract);
+        converted to the additive -inf form internally."""
+        add_mask = None
+        if attention_mask is not None:
+            m = jnp.asarray(attention_mask, jnp.float32)
+            add_mask = (1.0 - m)[:, None, None, :] * -1e9
+        x = self.embeddings(input_ids, token_type_ids)
+        for blk in self.encoder:
+            x = blk(x, add_mask)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(Layer):
+    """MLM head tied to the word embedding table (BERT convention)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = LayerNorm(cfg.hidden_size,
+                                        epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            (cfg.vocab_size,), is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq),
+                                       approximate=False))
+        table = self.bert.embeddings.word_embeddings.weight
+        return jnp.einsum("bsh,vh->bsv", h, table) + self.decoder_bias
+
+    def loss(self, input_ids, labels, ignore_index: int = -100, **kw):
+        logits = self(input_ids, **kw)
+        return F.cross_entropy(logits.reshape(-1, self.cfg.vocab_size),
+                               jnp.asarray(labels).reshape(-1),
+                               ignore_index=ignore_index)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2,
+                 dropout: Optional[float] = None):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob
+                               if dropout is None else dropout)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_tiny(**kw) -> BertConfig:
+    return BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=128,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0, **kw)
