@@ -182,3 +182,52 @@ def test_llama_chunked_prefill_parity():
     step = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(step), np.asarray(full),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_llama_matches_transformers_weight_mapped():
+    """Architectural exactness vs a weight-mapped transformers.LlamaModel
+    (config-only, GQA, no network) — same oracle pattern as BERT."""
+    import torch
+    from transformers import LlamaConfig as HFConfig, LlamaModel as HFModel
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.nn.functional_call import functional_call, state
+
+    hf_cfg = HFConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=176, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128, rms_norm_eps=1e-5,
+                      rope_theta=10000.0, attention_bias=False,
+                      mlp_bias=False, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFModel(hf_cfg).eval()
+
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    mine = LlamaForCausalLM(llama_tiny())
+    mine.eval()
+
+    # map straight into the BACKBONE's parameter dict (no prefix games)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    mapped, _ = state(mine.llama)
+    mapped = dict(mapped)
+    mapped["embed_tokens.weight"] = jnp.asarray(sd["embed_tokens.weight"])
+    mapped["norm.weight"] = jnp.asarray(sd["norm.weight"])
+    for i in range(2):
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            mapped[f"layers.{i}.self_attn.{name}.weight"] = \
+                jnp.asarray(sd[f"layers.{i}.self_attn.{name}.weight"].T)
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            mapped[f"layers.{i}.mlp.{name}.weight"] = \
+                jnp.asarray(sd[f"layers.{i}.mlp.{name}.weight"].T)
+        for name in ("input_layernorm", "post_attention_layernorm"):
+            mapped[f"layers.{i}.{name}.weight"] = \
+                jnp.asarray(sd[f"layers.{i}.{name}.weight"])
+
+    ids = np.random.RandomState(3).randint(0, 256, (2, 12))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids)).last_hidden_state.numpy()
+
+    hidden, _ = functional_call(mine.llama, mapped, {},
+                                (jnp.asarray(ids),), train=False)
+    np.testing.assert_allclose(np.asarray(hidden), ref, rtol=2e-4,
+                               atol=2e-4)
