@@ -308,3 +308,64 @@ def test_vocab_table_not_replicated_across_pp():
     x, y = tr.make_batch(batch=4, seq=16)
     _, loss = tr.train_step(tr.init_state(), x, y)
     assert np.isfinite(float(loss))
+
+
+def test_gpt_matches_transformers_gpt2_weight_mapped():
+    """Architectural exactness vs a weight-mapped transformers.GPT2Model
+    (config-only, no network): pre-LN blocks, fused c_attn == our fused
+    qkv ([h, 3h], Conv1D stores [in, out] so no transpose), tanh-gelu."""
+    import torch
+    from transformers import GPT2Config as HFConfig, GPT2Model as HFModel
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    hf_cfg = HFConfig(vocab_size=256, n_positions=64, n_embd=64,
+                      n_layer=2, n_head=4, resid_pdrop=0.0,
+                      embd_pdrop=0.0, attn_pdrop=0.0,
+                      activation_function="gelu_new")
+    torch.manual_seed(0)
+    hf = HFModel(hf_cfg).eval()
+
+    paddle_tpu.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0, remat=False)
+    mine = GPTForCausalLM(cfg)
+    mine.eval()
+
+    # map straight into the BACKBONE's parameter dict (same shape as the
+    # llama parity test)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    mapped, _ = state(mine.gpt)
+    mapped = dict(mapped)
+    mapped["wte.weight"] = jnp.asarray(sd["wte.weight"])
+    mapped["wpe.weight"] = jnp.asarray(sd["wpe.weight"])
+    mapped["ln_f.weight"] = jnp.asarray(sd["ln_f.weight"])
+    mapped["ln_f.bias"] = jnp.asarray(sd["ln_f.bias"])
+    for i in range(2):
+        hp, mp = f"h.{i}", f"h.{i}"
+        for ln in ("ln_1", "ln_2"):
+            mapped[f"{mp}.{ln}.weight"] = jnp.asarray(
+                sd[f"{hp}.{ln}.weight"])
+            mapped[f"{mp}.{ln}.bias"] = jnp.asarray(sd[f"{hp}.{ln}.bias"])
+        # GPT-2 Conv1D weights are [in, out] — our Linear layout exactly
+        mapped[f"{mp}.qkv.weight"] = jnp.asarray(
+            sd[f"{hp}.attn.c_attn.weight"])
+        mapped[f"{mp}.qkv.bias"] = jnp.asarray(sd[f"{hp}.attn.c_attn.bias"])
+        mapped[f"{mp}.out_proj.weight"] = jnp.asarray(
+            sd[f"{hp}.attn.c_proj.weight"])
+        mapped[f"{mp}.out_proj.bias"] = jnp.asarray(
+            sd[f"{hp}.attn.c_proj.bias"])
+        mapped[f"{mp}.fc_in.weight"] = jnp.asarray(
+            sd[f"{hp}.mlp.c_fc.weight"])
+        mapped[f"{mp}.fc_in.bias"] = jnp.asarray(sd[f"{hp}.mlp.c_fc.bias"])
+        mapped[f"{mp}.fc_out.weight"] = jnp.asarray(
+            sd[f"{hp}.mlp.c_proj.weight"])
+        mapped[f"{mp}.fc_out.bias"] = jnp.asarray(
+            sd[f"{hp}.mlp.c_proj.bias"])
+
+    ids = np.random.RandomState(5).randint(0, 256, (2, 12))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids)).last_hidden_state.numpy()
+    hidden, _ = functional_call(mine.gpt, mapped, {},
+                                (jnp.asarray(ids),), train=False)
+    np.testing.assert_allclose(np.asarray(hidden), ref, rtol=2e-4,
+                               atol=2e-4)

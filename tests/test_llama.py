@@ -190,7 +190,6 @@ def test_llama_matches_transformers_weight_mapped():
     import torch
     from transformers import LlamaConfig as HFConfig, LlamaModel as HFModel
     from paddle_tpu.models import LlamaForCausalLM, llama_tiny
-    from paddle_tpu.nn.functional_call import functional_call, state
 
     hf_cfg = HFConfig(vocab_size=256, hidden_size=64,
                       intermediate_size=176, num_hidden_layers=2,
@@ -201,7 +200,6 @@ def test_llama_matches_transformers_weight_mapped():
     torch.manual_seed(0)
     hf = HFModel(hf_cfg).eval()
 
-    import paddle_tpu
     paddle_tpu.seed(0)
     mine = LlamaForCausalLM(llama_tiny())
     mine.eval()
