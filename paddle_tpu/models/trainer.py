@@ -67,6 +67,12 @@ class GPTHybridTrainer:
                              "divisible by pp_degree")
         self.zero = zero_stage
         self.model = self._make_model(cfg)
+        dt = getattr(cfg, "dtype", "float32")
+        if dt != "float32":
+            # cast BEFORE the layout snapshot so the stacked/sharded
+            # state carries the configured dtype (masters stay f32 via
+            # multi_precision); Layer.to validates the dtype string
+            self.model.to(dtype=dt)
         self._build_state_layout()
         self._jit_step = None
 
