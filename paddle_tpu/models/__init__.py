@@ -10,3 +10,5 @@ from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM,  # noqa: F401
                       gpt_moe_tiny)
 from .bert import (BertConfig, BertModel, BertForMaskedLM,  # noqa: F401
                    BertForSequenceClassification, bert_tiny)
+from .t5 import (T5Config, T5Model, T5ForConditionalGeneration,  # noqa: F401
+                 t5_tiny)

@@ -1,0 +1,274 @@
+"""T5 encoder-decoder family (reference ecosystem: PaddleNLP t5 modeling;
+architecture: Raffel et al. — pre-LN RMS norms, relative position-bucket
+attention biases, unscaled dot-product attention, relu/gated FFN).
+
+TPU-native: functional blocks over jnp; the relative-bias tables make the
+attention additive-mask path the natural fit (biases fold into the same
+[b, h, q, k] additive term the flash kernel's masked path consumes).
+Architectural EXACTNESS is oracle-tested against a weight-mapped
+`transformers.T5Model` (tests/test_t5.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.container import LayerList
+
+__all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration", "t5_tiny"]
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"      # "relu" | "gated-gelu"
+    tie_word_embeddings: bool = True
+    pad_token_id: int = 0
+    decoder_start_token_id: int = 0
+
+
+class T5LayerNorm(Layer):
+    """RMS norm, NO mean subtraction, NO bias; fp32 accumulation (T5)."""
+
+    def __init__(self, hidden_size: int, epsilon: float = 1e-6):
+        super().__init__()
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            (hidden_size,), default_initializer=I.Constant(1.0))
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.epsilon)
+        return (self.weight * out).astype(x.dtype)
+
+
+def _relative_position_bucket(rel_pos, bidirectional: bool,
+                              num_buckets: int, max_distance: int):
+    """HF/T5 bucketing: log-spaced distance buckets, mirrored when
+    bidirectional (rel_pos = key_pos - query_pos)."""
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+class _T5Attention(Layer):
+    def __init__(self, cfg: T5Config, has_relative_bias: bool,
+                 bidirectional: bool):
+        super().__init__()
+        inner = cfg.num_heads * cfg.d_kv
+        self.cfg = cfg
+        self.bidirectional = bidirectional
+        self.q = Linear(cfg.d_model, inner, bias_attr=False)
+        self.k = Linear(cfg.d_model, inner, bias_attr=False)
+        self.v = Linear(cfg.d_model, inner, bias_attr=False)
+        self.o = Linear(inner, cfg.d_model, bias_attr=False)
+        self.attn_drop = Dropout(cfg.dropout_rate)
+        self.has_relative_bias = has_relative_bias
+        if has_relative_bias:
+            self.relative_attention_bias = Embedding(
+                cfg.relative_attention_num_buckets, cfg.num_heads)
+
+    def compute_bias(self, q_len: int, k_len: int):
+        """[1, h, q, k] additive bias from the bucket table."""
+        cfg = self.cfg
+        qpos = jnp.arange(q_len)[:, None]
+        kpos = jnp.arange(k_len)[None, :]
+        buckets = _relative_position_bucket(
+            kpos - qpos, self.bidirectional,
+            cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance)
+        vals = self.relative_attention_bias(buckets)   # [q, k, h]
+        return jnp.transpose(vals, (2, 0, 1))[None]
+
+    def forward(self, x, kv=None, position_bias=None, mask=None):
+        """x [b, q, d]; kv defaults to x (self-attn).  position_bias and
+        mask are additive [*, h|1, q, k] terms.  T5: NO 1/sqrt(d_kv)
+        scaling."""
+        cfg = self.cfg
+        kv = x if kv is None else kv
+        b, qn, _ = x.shape
+        kn = kv.shape[1]
+        nh, dk = cfg.num_heads, cfg.d_kv
+        q = self.q(x).reshape(b, qn, nh, dk)
+        k = self.k(kv).reshape(b, kn, nh, dk)
+        v = self.v(kv).reshape(b, kn, nh, dk)
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            q.astype(jnp.float32), k.astype(jnp.float32))
+        if position_bias is not None:
+            scores = scores + position_bias
+        if mask is not None:
+            scores = scores + mask
+        probs = jax.nn.softmax(scores, axis=-1)
+        # reference applies dropout to the attention PROBABILITIES too
+        probs = self.attn_drop(probs)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v.astype(jnp.float32)).astype(x.dtype)
+        return self.o(ctx.reshape(b, qn, nh * dk))
+
+
+class _T5FF(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.feed_forward_proj.startswith("gated"):
+            self.wi_0 = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+            self.wi_1 = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        else:
+            self.wi = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        self.wo = Linear(cfg.d_ff, cfg.d_model, bias_attr=False)
+
+    def forward(self, x):
+        if self.cfg.feed_forward_proj.startswith("gated"):
+            h = F.gelu(self.wi_0(x), approximate=True) * self.wi_1(x)
+        else:
+            h = F.relu(self.wi(x))
+        return self.wo(h)
+
+
+class _T5Block(Layer):
+    def __init__(self, cfg: T5Config, is_decoder: bool,
+                 has_relative_bias: bool):
+        super().__init__()
+        self.is_decoder = is_decoder
+        self.self_attn = _T5Attention(
+            cfg, has_relative_bias, bidirectional=not is_decoder)
+        self.self_norm = T5LayerNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        if is_decoder:
+            self.cross_attn = _T5Attention(cfg, False, bidirectional=True)
+            self.cross_norm = T5LayerNorm(cfg.d_model,
+                                          cfg.layer_norm_epsilon)
+        self.ff = _T5FF(cfg)
+        self.ff_norm = T5LayerNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        self.drop = Dropout(cfg.dropout_rate)
+
+    def forward(self, x, enc=None, position_bias=None, self_mask=None,
+                cross_mask=None):
+        x = x + self.drop(self.self_attn(self.self_norm(x),
+                                         position_bias=position_bias,
+                                         mask=self_mask))
+        if self.is_decoder:
+            x = x + self.drop(self.cross_attn(self.cross_norm(x), kv=enc,
+                                              mask=cross_mask))
+        return x + self.drop(self.ff(self.ff_norm(x)))
+
+
+class _T5Stack(Layer):
+    def __init__(self, cfg: T5Config, is_decoder: bool, n_layers: int):
+        super().__init__()
+        self.cfg = cfg
+        self.is_decoder = is_decoder
+        self.block = LayerList([
+            _T5Block(cfg, is_decoder, has_relative_bias=(i == 0))
+            for i in range(n_layers)])
+        self.final_layer_norm = T5LayerNorm(cfg.d_model,
+                                            cfg.layer_norm_epsilon)
+        self.drop = Dropout(cfg.dropout_rate)
+
+    def forward(self, x, enc=None, attention_mask=None, enc_mask=None):
+        b, s, _ = x.shape
+        # shared relative bias computed once from block 0 (T5 convention)
+        bias = self.block[0].self_attn.compute_bias(s, s)
+        self_mask = None
+        if self.is_decoder:
+            causal = jnp.tril(jnp.ones((s, s), bool))
+            self_mask = jnp.where(causal, 0.0, -1e9)[None, None]
+        if attention_mask is not None:
+            am = (1.0 - jnp.asarray(attention_mask, jnp.float32)) * -1e9
+            am = am[:, None, None, :]
+            self_mask = am if self_mask is None else self_mask + am
+        cross_mask = None
+        if enc_mask is not None:
+            cm = (1.0 - jnp.asarray(enc_mask, jnp.float32)) * -1e9
+            cross_mask = cm[:, None, None, :]
+        x = self.drop(x)
+        for blk in self.block:
+            x = blk(x, enc=enc, position_bias=bias, self_mask=self_mask,
+                    cross_mask=cross_mask)
+        return self.drop(self.final_layer_norm(x))
+
+
+class T5Model(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.shared = Embedding(cfg.vocab_size, cfg.d_model)
+        self.encoder = _T5Stack(cfg, is_decoder=False,
+                                n_layers=cfg.num_layers)
+        self.decoder = _T5Stack(cfg, is_decoder=True,
+                                n_layers=cfg.num_decoder_layers)
+
+    def encode(self, input_ids, attention_mask=None):
+        return self.encoder(self.shared(input_ids),
+                            attention_mask=attention_mask)
+
+    def forward(self, input_ids, decoder_input_ids, attention_mask=None,
+                decoder_attention_mask=None):
+        """Returns (decoder_hidden [b, td, d], encoder_hidden [b, te, d])."""
+        enc = self.encode(input_ids, attention_mask)
+        dec = self.decoder(self.shared(decoder_input_ids), enc=enc,
+                           attention_mask=decoder_attention_mask,
+                           enc_mask=attention_mask)
+        return dec, enc
+
+
+class T5ForConditionalGeneration(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.t5 = T5Model(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = Linear(cfg.d_model, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, decoder_input_ids, **kw):
+        dec, _ = self.t5(input_ids, decoder_input_ids, **kw)
+        if self.cfg.tie_word_embeddings:
+            # T5 rescales tied logits by d_model^-0.5
+            dec = dec * (self.cfg.d_model ** -0.5)
+            return jnp.einsum("bsd,vd->bsv", dec, self.t5.shared.weight)
+        return self.lm_head(dec)
+
+    def loss(self, input_ids, decoder_input_ids, labels, **kw):
+        logits = self(input_ids, decoder_input_ids, **kw)
+        return F.cross_entropy(
+            logits.reshape(-1, self.cfg.vocab_size),
+            jnp.asarray(labels).reshape(-1), ignore_index=-100)
+
+
+def t5_tiny(**kw) -> T5Config:
+    return T5Config(vocab_size=256, d_model=64, d_kv=16, d_ff=128,
+                    num_layers=2, num_decoder_layers=2, num_heads=4,
+                    relative_attention_num_buckets=8,
+                    relative_attention_max_distance=20,
+                    dropout_rate=0.0, **kw)
