@@ -40,10 +40,16 @@ Supported subset (documented; the reference converts a larger one):
     guidance (use checkify) on traced ones;
   * arbitrary nesting of the above.
 
+  * EARLY ``return`` anywhere inside if/while constructs, via the
+    reference's ReturnTransformer flag rewriting (a set return-flag
+    skips the remaining statements and stops enclosing whiles; the
+    function tail returns the carried value) — requires the function's
+    last statement to be a return so every path binds the value;
+
 NOT converted — left as plain Python, which stays correct for concrete
 values and raises a clear error if the predicate is traced:
-  * ``return`` inside only one branch of a data-dependent ``if``, or
-    inside a loop body;
+  * ``return`` inside a ``for`` body (the iterator epilogue interleaves
+    badly with return guards) or in a function without a tail return;
   * ``for x in <tensor>`` needs no conversion (static trip count —
     tracing unrolls it).
 
@@ -101,6 +107,12 @@ def _contains_tracer(tree) -> bool:
     return any(_is_tracer(l) for l in jax.tree_util.tree_leaves(tree))
 
 
+def _zeros_like_struct(s):
+    """Materialize a ShapeDtypeStruct PYTREE (a carried variable may hold
+    a tuple — e.g. the rewritten-return value) as zeros."""
+    return jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), s)
+
+
 def _diagnose_undefined(outs_a, outs_b, names, what, cause):
     """If per-variable outputs differ in Undefined-ness between two
     evaluations, raise the specific 'may be undefined' error."""
@@ -153,7 +165,7 @@ def convert_if(pred, true_fn, false_fn, args=(), names=()):
                             out = list(fn(*a))
                             for i, s in patch.items():
                                 if isinstance(out[i], _Undefined):
-                                    out[i] = jnp.zeros(s.shape, s.dtype)
+                                    out[i] = _zeros_like_struct(s)
                             return tuple(out)
                         return g
                     t_fn, f_fn = _fill(true_fn), _fill(false_fn)
@@ -205,7 +217,7 @@ def convert_while(cond_fn, body_fn, init=(), names=()):
             try:
                 out = jax.eval_shape(lambda vs: body_fn(*vs), tuple(init))
                 init = tuple(
-                    jnp.zeros(o.shape, o.dtype)
+                    _zeros_like_struct(o)
                     if isinstance(v, _Undefined)
                     and not isinstance(o, _Undefined) else v
                     for v, o in zip(init, out))
@@ -407,8 +419,10 @@ def _has_loop_jump(body) -> bool:
     return False
 
 
-def _enclosed_in_loop(root, target) -> bool:
-    """True if target sits inside a loop that is itself inside root."""
+def _enclosed_in_loop(root, target,
+                      kinds=(ast.For, ast.While)) -> bool:
+    """True if target sits inside a ``kinds`` loop that is itself inside
+    root."""
     found = [False]
 
     def visit(node, in_loop):
@@ -416,7 +430,7 @@ def _enclosed_in_loop(root, target) -> bool:
             found[0] = found[0] or in_loop
             return
         for child in ast.iter_child_nodes(node):
-            visit(child, in_loop or isinstance(node, (ast.For, ast.While)))
+            visit(child, in_loop or isinstance(node, kinds))
     visit(root, False)
     return found[0]
 
@@ -608,6 +622,124 @@ class _Transformer(ast.NodeTransformer):
                     isinstance(a, ast.Starred) for a in v.args):
             v.func = self._jst("convert_print")
         return node
+
+    # -- early-return flag rewriting (function level) --------------------
+    # (reference: dy2static ReturnTransformer — every return becomes a
+    # flag + value assignment, statements after a potential return run
+    # under a not-returned guard, while conditions gain the flag, and the
+    # function tail returns the carried value)
+
+    RET_FLAG = "_jstret_flag"
+    RET_VAL = "_jstret_val"
+
+    def rewrite_returns(self, fdef):
+        """Apply when returns appear inside if/while constructs AND the
+        last top-level statement is a return (so every path provably sets
+        the flag).  Returns True when applied.  Returns inside for-loops
+        or nested defs stay unsupported (the for's iterator epilogue
+        interleaves badly; py_only guards fire as before)."""
+
+        def returns_in(nodes):
+            hits, in_for_hits = 0, 0
+            for node in nodes:
+                for sub in _walk_same_scope([node]):
+                    if isinstance(sub, ast.Return):
+                        hits += 1
+                        if _enclosed_in_loop(node, sub, kinds=(ast.For,)):
+                            in_for_hits += 1
+            return hits, in_for_hits
+
+        body = fdef.body
+        if not body or not isinstance(body[-1], ast.Return):
+            return False
+        n_total, n_in_for = returns_in(body)
+        # n_total counts the tail return too; rewrite only when some
+        # return is NON-tail (i.e. nested) and none sit inside a for
+        if n_total <= 1 or n_in_for:
+            return False
+
+        flag, val = self.RET_FLAG, self.RET_VAL
+
+        def set_ret(node):
+            v = node.value if node.value is not None else ast.Constant(None)
+            return [ast.Assign(targets=[ast.Name(id=flag, ctx=ast.Store())],
+                               value=ast.Constant(True)),
+                    ast.Assign(targets=[ast.Name(id=val, ctx=ast.Store())],
+                               value=v)]
+
+        def guard():
+            return ast.UnaryOp(op=ast.Not(),
+                               operand=ast.Name(id=flag, ctx=ast.Load()))
+
+        def rw_stmt(st):
+            """-> (list_of_stmts, may_set_flag)."""
+            if isinstance(st, ast.Return):
+                return set_ret(st), True
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.For)):
+                return [st], False          # different scope / unsupported
+            if isinstance(st, ast.If):
+                b, sb = rw_block(st.body)
+                o, so = rw_block(st.orelse)
+                st.body, st.orelse = b, o or []
+                return [st], sb or so
+            if isinstance(st, ast.While):
+                if st.orelse:
+                    # while/else: python SKIPS else on return; the flag
+                    # rewrite would run it (flag-false loop exit looks
+                    # like normal termination) — keep raw returns
+                    return [st], False
+                b, sb = rw_block(st.body)
+                st.body = b
+                if sb:
+                    # a set flag must ALSO stop the loop, or a tensor
+                    # cond whose vars stop updating would spin forever
+                    st.test = ast.BoolOp(op=ast.And(),
+                                         values=[guard(), st.test])
+                return [st], sb
+            if isinstance(st, (ast.With, ast.Try)):
+                sets = False
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, field, None)
+                    if sub:
+                        new, s = rw_block(sub)
+                        setattr(st, field, new)
+                        sets = sets or s
+                for h in getattr(st, "handlers", []):
+                    new, s = rw_block(h.body)
+                    h.body = new
+                    sets = sets or s
+                return [st], sets
+            return [st], False
+
+        def rw_block(stmts):
+            # NOTE: structurally parallel to _rewrite_loop_jumps'
+            # rewrite_stmts (break/continue) — the two differ in loop
+            # semantics (returns must STOP whiles; jumps must not cross
+            # them); keep fixes in sync
+            out, sets_any = [], False
+            for i, st in enumerate(stmts):
+                new, sets = rw_stmt(st)
+                out.extend(new)
+                sets_any = sets_any or sets
+                if sets and i < len(stmts) - 1:
+                    rest, rs = rw_block(stmts[i + 1:])
+                    sets_any = sets_any or rs
+                    out.append(ast.If(test=guard(), body=rest, orelse=[]))
+                    break
+            return out, sets_any
+
+        new_body, _ = rw_block(body)
+        # every path sets the flag (tail return guaranteed), so the
+        # function ends with the carried value
+        fdef.body = [
+            ast.Assign(targets=[ast.Name(id=flag, ctx=ast.Store())],
+                       value=ast.Constant(False)),
+        ] + new_body + [
+            ast.Return(value=ast.Name(id=val, ctx=ast.Load())),
+        ]
+        self.func_assigned.update({flag, val})
+        return True
 
     # -- break/continue flag rewriting ----------------------------------
     # (reference: dy2static BreakContinueTransformer — jumps become flag
@@ -873,7 +1005,9 @@ def convert_to_static(fn: Callable) -> Callable:
     if fdef.args.kwarg:
         arg_names.add(fdef.args.kwarg.arg)
     func_assigned = set(_assigned_names(fdef.body)) | arg_names
-    _Transformer(func_assigned).visit(fdef)
+    transformer = _Transformer(func_assigned)
+    transformer.rewrite_returns(fdef)   # early returns -> flag + value
+    transformer.visit(fdef)
     ast.fix_missing_locations(tree)
 
     freevars = fn.__code__.co_freevars

@@ -198,12 +198,25 @@ def test_break_and_continue_combined():
     _check(f, (jnp.ones(4),), (jnp.full(4, 0.5),))
 
 
-def test_single_branch_return_clear_error():
+def test_single_branch_return_now_converts():
+    """Previously out-of-subset; the function-level ReturnTransformer
+    rewrite handles it (round-3 late addition)."""
     def f(x):
         if x.sum() > 0:
             return x
         x = x * 2.0
         return x
+
+    _check(f, (jnp.ones(4),), (-jnp.ones(4),))
+
+
+def test_return_without_tail_clear_error():
+    """No tail return -> out of subset: the rewrite cannot prove every
+    path binds the value; the traced if still errors clearly."""
+    def f(x):
+        if x.sum() > 0:
+            return x * 2.0
+        x = x + 1.0   # falls off the end on this path
 
     static = pjit.to_static(f)
     with pytest.raises(Dy2StaticError, match="return"):
@@ -452,3 +465,77 @@ def test_assert_msg_lazy_and_print_shadow_respected():
     conv_g = convert_to_static(g)
     _, logs = conv_g(np.ones(2))
     assert logs == ["recorded"]
+
+
+def test_early_return_in_if_converts():
+    """General early-return rewriting (reference ReturnTransformer): a
+    return buried in a nested if converts; remaining statements are
+    skipped on the returned path."""
+    def f(x):
+        s = x * 1.0
+        if s.sum() > 10.0:
+            if s.max() > 6.0:
+                return s * 100.0
+            s = s + 1.0
+        s = s * 2.0
+        return s
+
+    _check(f, (jnp.ones(4),),            # no return taken
+           (jnp.full(4, 3.0),),          # outer if, inner not -> +1 *2
+           (jnp.full(4, 7.0),))          # early return *100
+
+
+def test_early_return_in_while_converts():
+    """Early return inside a tensor while: the flag stops the loop (a
+    spinning cond whose vars stop updating would otherwise hang)."""
+    def f(x):
+        s = x
+        n = jnp.asarray(0, jnp.int32)
+        while s.sum() < 1000.0:
+            s = s * 2.0
+            n = n + 1
+            if n >= 3:
+                return s + 0.5
+        return s
+
+    _check(f, (jnp.ones(4),),            # early return at n==3
+           (jnp.full(4, 300.0),))        # cond exits first
+
+
+def test_early_return_mixed_paths_match_python():
+    def f(x, k):
+        t = x.sum()
+        if t < 0:
+            return -x
+        while t < 10.0:
+            t = t + k
+            if t > 5.0:
+                return x * t
+        return x * 0.0
+
+    static = pjit.to_static(f)
+    for xv, kv in ((jnp.ones(3), 2.0), (-jnp.ones(3), 1.0),
+                   (jnp.full(3, 4.0), 0.5)):
+        want = f(xv, float(kv))
+        got = static(xv, jnp.asarray(kv, jnp.float32))
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_while_else_with_return_keeps_python_semantics():
+    """while/else: python skips the else clause on return; the rewrite
+    must not convert such loops (review r3 repro)."""
+    def f(x):
+        log = []
+        n = 3
+        while n > 0:
+            return (x, tuple(log))
+        else:  # noqa: SIM500
+            log.append("else ran")
+        return (x * 0.0, tuple(log))
+
+    conv = convert_to_static(f)
+    want = f(np.ones(2))
+    got = conv(np.ones(2))
+    assert want[1] == got[1] == ()
+    np.testing.assert_allclose(np.asarray(want[0]), np.asarray(got[0]))

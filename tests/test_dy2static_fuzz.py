@@ -21,16 +21,27 @@ from paddle_tpu import jit as pjit
 N_PROGRAMS = 40
 
 
-def _gen_block(rng, depth, indent, loop_id):
+def _gen_block(rng, depth, indent, loop_id, in_for=False):
     """Returns (lines, loop_id).  Every branch/loop body assigns at least
     one of acc/t (converted ifs need a carried local)."""
     pad = "    " * indent
     lines = []
     n_stmts = rng.randint(1, 4)
     for _ in range(n_stmts):
-        kind = rng.choice(["assign", "if", "while", "for"],
-                          p=[0.45, 0.25, 0.15, 0.15] if depth > 0
-                          else [1.0, 0, 0, 0])
+        kind = rng.choice(["assign", "if", "while", "for", "ret"],
+                          p=[0.40, 0.25, 0.13, 0.13, 0.09] if depth > 0
+                          else [1.0, 0, 0, 0, 0])
+        if kind == "ret":
+            # early return matching the tail structure (acc, t) — but
+            # never inside a for (out of the return-rewrite subset)
+            if in_for:
+                kind = "assign"   # fall through to a plain statement
+            else:
+                c = round(float(rng.uniform(0.5, 2.0)), 3)
+                lines.append(
+                    pad + f"if t > {round(float(rng.uniform(0, 2)), 2)}:")
+                lines.append(pad + f"    return acc * {c}, t")
+                continue
         if kind == "assign":
             c = round(float(rng.uniform(0.2, 1.5)), 3)
             stmt = rng.choice([
@@ -43,11 +54,13 @@ def _gen_block(rng, depth, indent, loop_id):
         elif kind == "if":
             cond = _gen_cond(rng)
             lines.append(pad + f"if {cond}:")
-            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id)
+            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id,
+                                    in_for)
             lines.extend(b)
             if rng.rand() < 0.7:
                 lines.append(pad + "else:")
-                b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id)
+                b, loop_id = _gen_block(rng, depth - 1, indent + 1,
+                                        loop_id, in_for)
                 lines.extend(b)
         elif kind == "while":
             loop_id += 1
@@ -57,7 +70,8 @@ def _gen_block(rng, depth, indent, loop_id):
             lines.append(pad + f"{i} = jnp.asarray(0, jnp.int32)")
             lines.append(pad + f"while ({i} < {bound}) and ({cond}):")
             lines.append(pad + f"    {i} = {i} + 1")
-            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id)
+            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id,
+                                    in_for)
             lines.extend(b)
             if rng.rand() < 0.3:
                 lines.append(pad + f"    if t > {round(float(rng.uniform(1, 4)), 2)}:")
@@ -75,7 +89,8 @@ def _gen_block(rng, depth, indent, loop_id):
                 lines.append(pad + f"    if acc.sum() > "
                              f"{round(float(rng.uniform(3, 8)), 2)}:")
                 lines.append(pad + "        break")
-            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id)
+            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id,
+                                    in_for=True)
             lines.extend(b)
     return lines, loop_id
 
