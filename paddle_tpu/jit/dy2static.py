@@ -389,6 +389,69 @@ def _loaded_names(node) -> set:
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
 
 
+def _guarded_flag_walk(stmts, leaf, opaque, guard_expr, on_while=None,
+                       mark_guard=False):
+    """Shared scaffold for the flag-rewrite transforms (break/continue in
+    _rewrite_loop_jumps; return in rewrite_returns).
+
+    Walks a statement list in its own scope: ``leaf(st)`` returns a
+    replacement list for flag-setting leaves (or None), ``opaque(st)``
+    marks statements whose interior must not be rewritten, ``on_while``
+    (if given) post-processes a While whose body set a flag.  After any
+    statement that may set a flag, the remaining statements are wrapped
+    in ``if <guard_expr()>:``.  Returns (new_stmts, sets_any)."""
+
+    def rw_stmt(st):
+        rep = leaf(st)
+        if rep is not None:
+            return rep, True
+        if opaque(st):
+            return [st], False
+        if isinstance(st, ast.If):
+            b, sb = rw_block(st.body)
+            o, so = rw_block(st.orelse)
+            st.body, st.orelse = b, o or []
+            return [st], sb or so
+        if isinstance(st, ast.While):
+            b, sb = rw_block(st.body)
+            st.body = b
+            if sb and on_while is not None:
+                on_while(st)
+            return [st], sb
+        if isinstance(st, (ast.With, ast.Try)):
+            sets = False
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if sub:
+                    new, s = rw_block(sub)
+                    setattr(st, field, new)
+                    sets = sets or s
+            for h in getattr(st, "handlers", []):
+                new, s = rw_block(h.body)
+                h.body = new
+                sets = sets or s
+            return [st], sets
+        return [st], False
+
+    def rw_block(block):
+        out, sets_any = [], False
+        for i, st in enumerate(block):
+            new, sets = rw_stmt(st)
+            out.extend(new)
+            sets_any = sets_any or sets
+            if sets and i < len(block) - 1:
+                rest, rs = rw_block(block[i + 1:])
+                sets_any = sets_any or rs
+                g = ast.If(test=guard_expr(), body=rest, orelse=[])
+                if mark_guard:
+                    g._dy2s_guard = True
+                out.append(g)
+                break
+        return out, sets_any
+
+    return rw_block(stmts)
+
+
 def _walk_same_scope(nodes):
     """Walk statements without descending into nested function/class
     scopes (whose returns/breaks belong to themselves — including the
@@ -671,65 +734,26 @@ class _Transformer(ast.NodeTransformer):
             return ast.UnaryOp(op=ast.Not(),
                                operand=ast.Name(id=flag, ctx=ast.Load()))
 
-        def rw_stmt(st):
-            """-> (list_of_stmts, may_set_flag)."""
+        def leaf(st):
             if isinstance(st, ast.Return):
-                return set_ret(st), True
-            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
-                               ast.ClassDef, ast.For)):
-                return [st], False          # different scope / unsupported
-            if isinstance(st, ast.If):
-                b, sb = rw_block(st.body)
-                o, so = rw_block(st.orelse)
-                st.body, st.orelse = b, o or []
-                return [st], sb or so
-            if isinstance(st, ast.While):
-                if st.orelse:
-                    # while/else: python SKIPS else on return; the flag
-                    # rewrite would run it (flag-false loop exit looks
-                    # like normal termination) — keep raw returns
-                    return [st], False
-                b, sb = rw_block(st.body)
-                st.body = b
-                if sb:
-                    # a set flag must ALSO stop the loop, or a tensor
-                    # cond whose vars stop updating would spin forever
-                    st.test = ast.BoolOp(op=ast.And(),
-                                         values=[guard(), st.test])
-                return [st], sb
-            if isinstance(st, (ast.With, ast.Try)):
-                sets = False
-                for field in ("body", "orelse", "finalbody"):
-                    sub = getattr(st, field, None)
-                    if sub:
-                        new, s = rw_block(sub)
-                        setattr(st, field, new)
-                        sets = sets or s
-                for h in getattr(st, "handlers", []):
-                    new, s = rw_block(h.body)
-                    h.body = new
-                    sets = sets or s
-                return [st], sets
-            return [st], False
+                return set_ret(st)
+            return None
 
-        def rw_block(stmts):
-            # NOTE: structurally parallel to _rewrite_loop_jumps'
-            # rewrite_stmts (break/continue) — the two differ in loop
-            # semantics (returns must STOP whiles; jumps must not cross
-            # them); keep fixes in sync
-            out, sets_any = [], False
-            for i, st in enumerate(stmts):
-                new, sets = rw_stmt(st)
-                out.extend(new)
-                sets_any = sets_any or sets
-                if sets and i < len(stmts) - 1:
-                    rest, rs = rw_block(stmts[i + 1:])
-                    sets_any = sets_any or rs
-                    out.append(ast.If(test=guard(), body=rest, orelse=[]))
-                    break
-            return out, sets_any
+        def opaque(st):
+            # nested defs: different scope.  For-bodies: the iterator
+            # epilogue interleaves badly.  while/else: python SKIPS the
+            # else on return; the flag rewrite would run it.
+            return isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef, ast.For)) or \
+                (isinstance(st, ast.While) and bool(st.orelse))
 
-        new_body, _ = rw_block(body)
+        def on_while(st):
+            # a set flag must ALSO stop the loop, or a tensor cond whose
+            # vars stop updating would spin forever
+            st.test = ast.BoolOp(op=ast.And(), values=[guard(), st.test])
+
+        new_body, _ = _guarded_flag_walk(body, leaf, opaque, guard,
+                                         on_while=on_while)
         # every path sets the flag (tail return guaranteed), so the
         # function ends with the carried value
         fdef.body = [
@@ -772,52 +796,20 @@ class _Transformer(ast.NodeTransformer):
             return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
                               value=ast.Constant(True))
 
-        def rewrite_stmt(st):
-            """-> (new_stmt, may_set_flag)."""
+        def leaf(st):
             if isinstance(st, ast.Break):
-                return set_flag(brk), True
+                return [set_flag(brk)]
             if isinstance(st, ast.Continue):
-                return set_flag(cont), True
-            if isinstance(st, (ast.For, ast.While, ast.FunctionDef,
-                               ast.AsyncFunctionDef, ast.ClassDef)):
-                return st, False   # jumps inside belong to the inner scope
-            if isinstance(st, ast.If):
-                b, sb = rewrite_stmts(st.body)
-                o, so = rewrite_stmts(st.orelse)
-                st.body, st.orelse = b, o or []
-                return st, sb or so
-            if isinstance(st, (ast.With, ast.Try)):
-                sets = False
-                for field in ("body", "orelse", "finalbody"):
-                    sub = getattr(st, field, None)
-                    if sub:
-                        new, s = rewrite_stmts(sub)
-                        setattr(st, field, new)
-                        sets = sets or s
-                for h in getattr(st, "handlers", []):
-                    new, s = rewrite_stmts(h.body)
-                    h.body = new
-                    sets = sets or s
-                return st, sets
-            return st, False
+                return [set_flag(cont)]
+            return None
 
-        def rewrite_stmts(stmts):
-            out = []
-            sets_any = False
-            for i, st in enumerate(stmts):
-                new, sets = rewrite_stmt(st)
-                out.append(new)
-                sets_any = sets_any or sets
-                if sets and i < len(stmts) - 1:
-                    rest, rs = rewrite_stmts(stmts[i + 1:])
-                    sets_any = sets_any or rs
-                    guard = ast.If(test=flag_guard(), body=rest, orelse=[])
-                    guard._dy2s_guard = True   # for tailored error text
-                    out.append(guard)
-                    break
-            return out, sets_any
+        def opaque(st):
+            # jumps inside nested loops/scopes belong to THEM
+            return isinstance(st, (ast.For, ast.While, ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef))
 
-        body, _ = rewrite_stmts(node.body)
+        body, _ = _guarded_flag_walk(node.body, leaf, opaque, flag_guard,
+                                     mark_guard=True)
         if epilogue:
             body = body + [ast.If(
                 test=ast.UnaryOp(op=ast.Not(),
