@@ -11,8 +11,10 @@ kernels also run under ``interpret=True`` for CPU tests).
 from .flash_attention import (flash_attention, flash_attention_with_lse,
                               flash_attention_varlen)
 from .fused_adamw import fused_adamw_update
-from .fused_norm import fused_rms_norm_pallas
+from .fused_norm import (fused_rms_norm_pallas,
+                         fused_layer_norm_pallas)
 from .decode_attention import decode_attention
 
 __all__ = ["flash_attention", "flash_attention_with_lse", "decode_attention",
-           "fused_adamw_update", "fused_rms_norm_pallas"]
+           "fused_adamw_update", "fused_rms_norm_pallas",
+           "fused_layer_norm_pallas"]

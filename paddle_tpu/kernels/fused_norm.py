@@ -91,17 +91,136 @@ def _rms_core_bwd(eps, block_rows, interpret, res, g):
 _rms_core.defvjp(_rms_core_fwd, _rms_core_bwd)
 
 
+def _flatten_and_pick_block(x):
+    """[..., H] -> ([rows, H], block_rows) with block dividing rows;
+    empty inputs return block 0 (callers short-circuit)."""
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    rows = x2.shape[0]
+    if rows == 0:
+        return x2, 0
+    block = min(rows, 256)
+    while rows % block:
+        block -= 1
+    return x2, block
+
+
 def fused_rms_norm_pallas(x, weight, epsilon: float = 1e-5,
                           interpret=None):
     """RMSNorm over the last dim; x [..., H], weight [H]."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     orig = x.shape
-    h = orig[-1]
-    x2 = x.reshape(-1, h)
-    rows = x2.shape[0]
-    block = min(rows, 256)
-    while rows % block:
-        block -= 1
+    x2, block = _flatten_and_pick_block(x)
+    if block == 0:
+        return x
     out = _rms_core(x2, weight, float(epsilon), block, interpret)
     return out.reshape(orig)
+
+
+# ---------------------------------------------------------------- LayerNorm
+# (same blocking as RMSNorm; reference: phi fused layer_norm kernels —
+# one VPU pass computes mean/var/affine without HBM intermediates; the
+# backward is the closed-form xhat projection, also one pass per block)
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, m_ref, r_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (xc * rstd * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    m_ref[:] = mu[:, 0]
+    r_ref[:] = rstd[:, 0]
+
+
+def _ln_bwd_kernel(x_ref, w_ref, m_ref, r_ref, g_ref, dx_ref, dwp_ref,
+                   dbp_ref):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    mu = m_ref[:][:, None]
+    rstd = r_ref[:][:, None]
+    xhat = (x - mu) * rstd
+    gw = g * w
+    mean_gw = jnp.mean(gw, axis=-1, keepdims=True)
+    mean_gx = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (gw - mean_gw - xhat * mean_gx)).astype(
+        dx_ref.dtype)
+    dwp_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
+    dbp_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+
+
+def _ln_run_fwd(x2, w, b, eps, block_rows, interpret):
+    rows, h = x2.shape
+    grid = (rows // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows,), lambda i: (i,)),
+                   pl.BlockSpec((block_rows,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((rows, h), x2.dtype),
+                   jax.ShapeDtypeStruct((rows,), jnp.float32),
+                   jax.ShapeDtypeStruct((rows,), jnp.float32)],
+        interpret=interpret,
+    )(x2, w, b)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln_core(x2, w, b, eps, block_rows, interpret):
+    out, _, _ = _ln_run_fwd(x2, w, b, eps, block_rows, interpret)
+    return out
+
+
+def _ln_core_fwd(x2, w, b, eps, block_rows, interpret):
+    out, mu, rstd = _ln_run_fwd(x2, w, b, eps, block_rows, interpret)
+    # residuals must be JAX types: carry the bias dtype on an empty array
+    return out, (x2, w, jnp.zeros((0,), b.dtype), mu, rstd)
+
+
+def _ln_core_bwd(eps, block_rows, interpret, res, g):
+    x2, w, b_proto, mu, rstd = res
+    rows, h = x2.shape
+    nblk = rows // block_rows
+    dx, dw_part, db_part = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, h), x2.dtype),
+                   jax.ShapeDtypeStruct((nblk, h), jnp.float32),
+                   jax.ShapeDtypeStruct((nblk, h), jnp.float32)],
+        interpret=interpret,
+    )(x2, w, mu, rstd, g)
+    return (dx, jnp.sum(dw_part, axis=0).astype(w.dtype),
+            jnp.sum(db_part, axis=0).astype(b_proto.dtype))
+
+
+_ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)
+
+
+def fused_layer_norm_pallas(x, weight, bias, epsilon: float = 1e-5,
+                            interpret=None):
+    """LayerNorm over the last dim; x [..., H], weight/bias [H]."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    orig = x.shape
+    x2, block = _flatten_and_pick_block(x)
+    if block == 0:
+        return x
+    out = _ln_core(x2, weight, bias, float(epsilon), block, interpret)
+    return out.reshape(orig)
+
+
+__all__.append("fused_layer_norm_pallas")

@@ -307,6 +307,27 @@ def _kernel_compare(budget_s, seq=2048):
 
     x = jnp.asarray(rs.randn(8192, 4096), jnp.bfloat16)
     w = jnp.asarray(rs.randn(4096), jnp.float32)
+    bln = jnp.asarray(rs.randn(4096), jnp.float32)
+    try:
+        from paddle_tpu.kernels import fused_layer_norm_pallas
+        lp = jax.jit(lambda x, w, b: fused_layer_norm_pallas(
+            x, w, b, 1e-5, interpret=False))
+
+        def lref(x, w, b):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, -1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + b).astype(
+                x.dtype)
+        lx = jax.jit(lref)
+        res["fused_layer_norm_8192x4096"] = {
+            "pallas_ms": round(timeit(lp, x, w, bln), 3),
+            "xla_ms": round(timeit(lx, x, w, bln), 3)}
+        res["fused_layer_norm_8192x4096"]["speedup"] = round(
+            res["fused_layer_norm_8192x4096"]["xla_ms"] /
+            max(res["fused_layer_norm_8192x4096"]["pallas_ms"], 1e-9), 2)
+    except Exception as e:
+        res["fused_layer_norm_8192x4096"] = {"error": repr(e)[-200:]}
     rp = jax.jit(lambda x, w: fused_rms_norm_pallas(x, w, 1e-6,
                                                     interpret=False))
     rx = jax.jit(lambda x, w: (x.astype(jnp.float32) * jax.lax.rsqrt(

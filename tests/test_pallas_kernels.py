@@ -385,3 +385,79 @@ def test_flash_attention_shape_sweep(s, d, kh, causal):
         a, k, v, is_causal=causal, training=False) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=3e-4, atol=3e-4)
+
+
+def test_fused_layer_norm_fwd_bwd_matches_reference():
+    """Fused LayerNorm kernel (interpret mode on CPU): forward + both
+    weight grads match the XLA reference to fp32 precision."""
+    import numpy as np
+    from paddle_tpu.kernels import fused_layer_norm_pallas
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(6, 128).astype(np.float32))
+    w = jnp.asarray(rs.randn(128).astype(np.float32))
+    b = jnp.asarray(rs.randn(128).astype(np.float32))
+
+    def ref(x, w, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+
+    out = fused_layer_norm_pallas(x, w, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, w, b)),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_p(x, w, b):
+        return jnp.sum(fused_layer_norm_pallas(x, w, b, 1e-5) ** 2)
+
+    def loss_r(x, w, b):
+        return jnp.sum(ref(x, w, b) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fused_norms_multi_block_grid():
+    """rows > 256 forces nblk > 1: cross-block dw/db accumulation and the
+    per-block mu/rstd index maps must hold for i > 0 (both kernels), and
+    mixed weight/bias dtypes keep their own grad dtypes."""
+    import numpy as np
+    from paddle_tpu.kernels import (fused_layer_norm_pallas,
+                                    fused_rms_norm_pallas)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(512, 128).astype(np.float32))
+    w = jnp.asarray(rs.randn(128).astype(np.float32))
+    b = jnp.asarray(rs.randn(128).astype(np.float32))
+
+    def lref(x, w, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+
+    np.testing.assert_allclose(
+        np.asarray(fused_layer_norm_pallas(x, w, b, 1e-5)),
+        np.asarray(lref(x, w, b)), rtol=1e-5, atol=1e-5)
+    gp = jax.grad(lambda *a: jnp.sum(
+        fused_layer_norm_pallas(*a, 1e-5) ** 2), argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(lambda *a: jnp.sum(lref(*a) ** 2),
+                  argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-4)
+
+    def rref(x, w):
+        ms = jnp.mean(x * x, axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + 1e-6) * w
+
+    np.testing.assert_allclose(
+        np.asarray(fused_rms_norm_pallas(x, w, 1e-6)),
+        np.asarray(rref(x, w)), rtol=1e-5, atol=1e-5)
+    grp = jax.grad(lambda *a: jnp.sum(
+        fused_rms_norm_pallas(*a, 1e-6) ** 2), argnums=(0, 1))(x, w)
+    grr = jax.grad(lambda *a: jnp.sum(rref(*a) ** 2),
+                   argnums=(0, 1))(x, w)
+    for a, c in zip(grp, grr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-4)
