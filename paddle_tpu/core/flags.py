@@ -124,3 +124,6 @@ define_flag("log_level", "INFO", "Framework log level")
 define_flag("use_pallas_attention", True,
             "Route scaled_dot_product_attention to the Pallas flash kernel "
             "on TPU when shapes allow")
+define_flag("use_pallas_norm", True,
+            "Route last-dim layer_norm (full weight+bias) to the fused "
+            "Pallas kernel on TPU")

@@ -179,12 +179,11 @@ def _ln_core(x2, w, b, eps, block_rows, interpret):
 
 def _ln_core_fwd(x2, w, b, eps, block_rows, interpret):
     out, mu, rstd = _ln_run_fwd(x2, w, b, eps, block_rows, interpret)
-    # residuals must be JAX types: carry the bias dtype on an empty array
-    return out, (x2, w, jnp.zeros((0,), b.dtype), mu, rstd)
+    return out, (x2, w, b, mu, rstd)
 
 
 def _ln_core_bwd(eps, block_rows, interpret, res, g):
-    x2, w, b_proto, mu, rstd = res
+    x2, w, b, mu, rstd = res
     rows, h = x2.shape
     nblk = rows // block_rows
     dx, dw_part, db_part = pl.pallas_call(
@@ -204,7 +203,7 @@ def _ln_core_bwd(eps, block_rows, interpret, res, g):
         interpret=interpret,
     )(x2, w, mu, rstd, g)
     return (dx, jnp.sum(dw_part, axis=0).astype(w.dtype),
-            jnp.sum(db_part, axis=0).astype(b_proto.dtype))
+            jnp.sum(db_part, axis=0).astype(b.dtype))
 
 
 _ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)

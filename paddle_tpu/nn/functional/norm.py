@@ -18,11 +18,35 @@ __all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
            "normalize", "rms_norm", "local_response_norm"]
 
 
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-5,
                name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # fused Pallas path: the common last-dim affine case on TPU (one VPU
+    # pass, no HBM intermediates).  Constraints keep it strictly better
+    # than XLA: dtype-preserving params (no public dtype change vs the
+    # promoting XLA path), lane-aligned h bounded for VMEM, and row
+    # counts that tile into real blocks (no degenerate 1-row grids).
+    h_last = x.shape[-1]
+    rows = x.size // h_last if h_last else 0
+    if (len(axes) == 1 and axes[0] == x.ndim - 1 and weight is not None
+            and bias is not None and h_last % 128 == 0 and h_last <= 8192
+            and rows and rows % 8 == 0
+            and getattr(weight, "dtype", None) == x.dtype
+            and getattr(bias, "dtype", None) == x.dtype):
+        from ...core.flags import flags as _flags
+        if _flags.use_pallas_norm and _on_tpu():
+            try:
+                import paddle_tpu.kernels as _k
+                return _k.fused_layer_norm_pallas(x, weight, bias,
+                                                  epsilon, interpret=False)
+            except Exception:
+                pass   # fall back to the XLA form (same pattern as sdpa)
     x32 = x.astype(jnp.float32) if x.dtype in (jnp.float16, jnp.bfloat16) else x
     mean = jnp.mean(x32, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)

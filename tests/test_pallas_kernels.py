@@ -461,3 +461,40 @@ def test_fused_norms_multi_block_grid():
     for a, c in zip(grp, grr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_layer_norm_flag_routing(monkeypatch):
+    """The routing gate really reaches the fused kernel when on 'TPU'
+    (backend shim + recorder kernel), matches the XLA form, and the flag
+    disables it."""
+    import paddle_tpu
+    import paddle_tpu.kernels as K
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional import norm as norm_mod
+    import numpy as np
+
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 128)
+                    .astype(np.float32))
+    w = jnp.asarray(np.random.RandomState(1).randn(128).astype(np.float32))
+    b = jnp.asarray(np.random.RandomState(2).randn(128).astype(np.float32))
+
+    calls = []
+    real = K.fused_layer_norm_pallas
+
+    def recorder(x, w, b, eps, interpret=None):
+        calls.append(1)
+        return real(x, w, b, eps, interpret=True)   # CPU-safe
+
+    monkeypatch.setattr(norm_mod, "_on_tpu", lambda: True)
+    monkeypatch.setattr(K, "fused_layer_norm_pallas", recorder)
+    out_fused = F.layer_norm(x, 128, w, b)
+    assert calls, "routing gate never reached the fused kernel"
+
+    paddle_tpu.set_flags({"FLAGS_use_pallas_norm": False})
+    try:
+        out_xla = F.layer_norm(x, 128, w, b)
+    finally:
+        paddle_tpu.set_flags({"FLAGS_use_pallas_norm": True})
+    assert len(calls) == 1                           # flag really gates
+    np.testing.assert_allclose(np.asarray(out_fused),
+                               np.asarray(out_xla), rtol=1e-5, atol=1e-5)
