@@ -280,10 +280,24 @@ def barrier(num_workers: Optional[int] = None, timeout: float = 60.0) -> None:
     arrived.  Always coordinated by the FIRST server rank — with a
     sharded server set every participant must count on the same host."""
     import os
+    import time as _t
     n = num_workers if num_workers is not None else \
         int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-    rpc.rpc_sync(min(_SERVER_RANKS), _h_barrier, (n, timeout),
-                 timeout=timeout + 10.0)
+    # first contact IS the rendezvous: the coordinator's listener may
+    # still be binding under load, so connection failures retry with
+    # backoff inside the same deadline
+    deadline = _t.time() + timeout
+    delay = 0.2
+    while True:
+        try:
+            rpc.rpc_sync(min(_SERVER_RANKS), _h_barrier, (n, timeout),
+                         timeout=timeout + 10.0)
+            return
+        except (ConnectionError, OSError):
+            if _t.time() + delay > deadline:
+                raise
+            _t.sleep(delay)
+            delay = min(delay * 2, 2.0)
 
 
 def _h_push_delta(name, delta):
