@@ -62,7 +62,13 @@ _unary("ceil", T.ceil, np.ceil, grad=False)
 _unary("floor", T.floor, np.floor, grad=False)
 _unary("round", T.round, np.round, grad=False)
 _unary("trunc", T.trunc, np.trunc, grad=False)
-_unary("frac", T.frac, lambda x: x - np.trunc(x))
+# frac's gradient is 1 away from integers but the op is discontinuous AT
+# them — keep samples' fractional parts in [0.15, 0.85] so the numeric
+# grad never straddles a jump (seed-soak finding)
+_unary("frac", T.frac, lambda x: x - np.trunc(x),
+       _sample(lambda: (np.trunc(_mk(3, 4, lo=-3, hi=3))
+                        + _rng.uniform(0.15, 0.85, (3, 4))
+                        ).astype(np.float32)))
 _unary("reciprocal", T.reciprocal, lambda x: 1.0 / x, _sample(lambda: _pos(3, 4)))
 _unary("sign", T.sign, np.sign, grad=False)
 _unary("erf", T.erf, None)  # no numpy erf w/o scipy: fwd-only smoke
