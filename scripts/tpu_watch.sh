@@ -16,37 +16,80 @@ x = jnp.ones((256, 256), dtype=jnp.bfloat16)
 v = float((x @ x)[0, 0])
 print(f"PROBE_OK platform={d[0].platform} val={v}")
 PYEOF
+
+# evidence state, shared with the bench script (single source of truth):
+#   full       — bench numbers + complete kernel-compare table
+#   bench_only — good MFU evidence, table still missing
+#   <status>   — anything else
+ev_state() {
+  python - <<'PYST' 2>/dev/null
+import sys
+sys.path.insert(0, "scripts")
+from tpu_evidence_bench import _load, _is_good, _is_full, CANONICAL_PATH
+d = _load(CANONICAL_PATH)
+if d is None:
+    print("absent")
+elif _is_full(d):
+    print("full")
+elif _is_good(d):
+    print("bench_only")
+else:
+    print(d.get("status", "?"))
+PYST
+}
+
+commit_evidence() {  # $1 = commit message; retries around index.lock
+  for i in 1 2 3 4 5 6; do
+    git add BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
+    if git commit -m "$1" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1; then
+      return 0
+    fi
+    echo "$(date -u +%H:%M:%S) commit attempt $i failed, retrying" >> $LOG
+    sleep 30
+  done
+  return 1
+}
+
 DEADLINE=$(( $(date +%s) + 11*3600 ))
 ATTEMPT=0
+KC_TRIES=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  ST=$(ev_state)
+  if [ "$ST" = "full" ]; then
+    commit_evidence "On-chip bench evidence: raw per-iteration timings, loss series, kernel-compare table" \
+      && echo "$(date -u +%H:%M:%S) full evidence committed; watchdog exiting" >> $LOG \
+      || echo "$(date -u +%H:%M:%S) full evidence on disk but commit failed 6x" >> $LOG
+    exit 0
+  fi
   ATTEMPT=$((ATTEMPT+1))
-  echo "$(date -u +%H:%M:%S) probe attempt $ATTEMPT" >> $LOG
+  echo "$(date -u +%H:%M:%S) probe attempt $ATTEMPT (state=$ST)" >> $LOG
   if timeout 150 python $PROBE >> $LOG 2>&1; then
-    echo "$(date -u +%H:%M:%S) chip ALIVE -> evidence bench" >> $LOG
-    EVIDENCE_BUDGET_S=1200 timeout 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1
-    ST=$(python -c "import json;print(json.load(open('BENCH_TPU_EVIDENCE.json')).get('status','?'))" 2>/dev/null)
-    echo "$(date -u +%H:%M:%S) evidence status=$ST" >> $LOG
-    if [ "$ST" = "done" ] || [ "$ST" = "bench_done" ]; then
-      # the main session may transiently hold .git/index.lock — retry
-      # (git add first: the file starts untracked, and `commit -- path`
-      # alone errors on untracked paths)
-      for i in 1 2 3 4 5 6; do
-        git add BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
-        if git commit -m "On-chip bench evidence: raw per-iteration timings, loss series, kernel-compare table" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1; then
-          echo "$(date -u +%H:%M:%S) evidence committed; watchdog exiting" >> $LOG
-          exit 0
-        fi
-        echo "$(date -u +%H:%M:%S) commit attempt $i failed, retrying" >> $LOG
-        sleep 30
-      done
-      echo "$(date -u +%H:%M:%S) evidence READY but commit failed 6x; file is on disk" >> $LOG
-      exit 0
+    if [ "$ST" = "bench_only" ]; then
+      # only the kernel table is missing: refresh it without re-burning a
+      # full train run; give up on the table after 3 tries and accept the
+      # bench-only evidence rather than looping for hours
+      KC_TRIES=$((KC_TRIES+1))
+      echo "$(date -u +%H:%M:%S) chip ALIVE -> kernel-compare only (try $KC_TRIES)" >> $LOG
+      BENCH_SKIP_TRAIN=1 EVIDENCE_BUDGET_S=900 timeout 1800 \
+        python scripts/tpu_evidence_bench.py >> $LOG 2>&1
+      if [ "$KC_TRIES" -ge 3 ] && [ "$(ev_state)" != "full" ]; then
+        commit_evidence "On-chip bench evidence (kernel-compare unavailable after 3 tries)"
+        echo "$(date -u +%H:%M:%S) accepting bench-only evidence; watchdog exiting" >> $LOG
+        exit 0
+      fi
+    else
+      echo "$(date -u +%H:%M:%S) chip ALIVE -> evidence bench" >> $LOG
+      EVIDENCE_BUDGET_S=1200 timeout 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1
     fi
-    # partial/failed: commit whatever evidence exists, keep trying
-    if [ -f BENCH_TPU_EVIDENCE.json ]; then
-      git add BENCH_TPU_EVIDENCE.json
-      git commit -m "Partial on-chip bench evidence (run interrupted; see status field)" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
+    NEW=$(ev_state)
+    echo "$(date -u +%H:%M:%S) evidence state=$NEW" >> $LOG
+    # commit whatever the canonical file now holds (the bench's promotion
+    # logic guarantees it never got weaker); exit handled at loop top
+    if [ -f BENCH_TPU_EVIDENCE.json ] && ! git diff --quiet -- BENCH_TPU_EVIDENCE.json 2>/dev/null; then
+      commit_evidence "On-chip bench evidence update (state=$NEW)"
     fi
+    sleep 180
+    continue
   fi
   sleep 420
 done
