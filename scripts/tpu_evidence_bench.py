@@ -94,19 +94,26 @@ def _is_full(ev):
 def _maybe_promote():
     """Replace the canonical evidence with this run if it is stronger:
     higher MFU, or comparable MFU plus a kernel-compare table the old
-    run lacks."""
+    run lacks.  Never demotes: a complete kernel-compare table survives
+    promotion by a bench-only run (the table is carried over), so the
+    canonical file monotonically improves."""
     if EVIDENCE_PATH == CANONICAL_PATH or not _is_good(EV):
         return
     old = _load(CANONICAL_PATH)
     better = (not _is_good(old) or EV["mfu"] >= old["mfu"]
               or (_kc_ok(EV) and not _kc_ok(old)
                   and EV["mfu"] >= 0.9 * old["mfu"]))
-    if better:
-        import shutil
-        if os.path.exists(CANONICAL_PATH):
-            shutil.copyfile(CANONICAL_PATH, CANONICAL_PATH + ".prev")
-        os.replace(CANDIDATE_PATH, CANONICAL_PATH)   # single atomic swap
-        print("candidate promoted to canonical evidence")
+    if not better:
+        return
+    if _is_good(old) and _kc_ok(old) and not _kc_ok(EV):
+        EV["kernel_compare"] = old["kernel_compare"]
+        EV["kernel_compare_carried_from_unix"] = old.get("finished_unix")
+        flush()
+    import shutil
+    if os.path.exists(CANONICAL_PATH):
+        shutil.copyfile(CANONICAL_PATH, CANONICAL_PATH + ".prev")
+    os.replace(CANDIDATE_PATH, CANONICAL_PATH)   # single atomic swap
+    print("candidate promoted to canonical evidence")
 
 
 def main():

@@ -39,6 +39,11 @@ PYST
 }
 
 commit_evidence() {  # $1 = commit message; retries around index.lock
+  # already committed at HEAD (and not untracked/modified)? success.
+  if git ls-files --error-unmatch BENCH_TPU_EVIDENCE.json >/dev/null 2>&1 \
+      && [ -z "$(git status --porcelain -- BENCH_TPU_EVIDENCE.json)" ]; then
+    return 0
+  fi
   for i in 1 2 3 4 5 6; do
     git add BENCH_TPU_EVIDENCE.json >> $LOG 2>&1
     if git commit -m "$1" -- BENCH_TPU_EVIDENCE.json >> $LOG 2>&1; then
@@ -84,8 +89,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     NEW=$(ev_state)
     echo "$(date -u +%H:%M:%S) evidence state=$NEW" >> $LOG
     # commit whatever the canonical file now holds (the bench's promotion
-    # logic guarantees it never got weaker); exit handled at loop top
-    if [ -f BENCH_TPU_EVIDENCE.json ] && ! git diff --quiet -- BENCH_TPU_EVIDENCE.json 2>/dev/null; then
+    # logic guarantees it never got weaker); commit_evidence is a no-op
+    # when HEAD already carries it, and handles the untracked first run
+    if [ -f BENCH_TPU_EVIDENCE.json ]; then
       commit_evidence "On-chip bench evidence update (state=$NEW)"
     fi
     sleep 180
