@@ -104,7 +104,8 @@ def stop_gradient(x):
 _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
          "inference", "device", "ops", "fft", "distribution",
-         "signal", "regularizer", "utils", "onnx", "compat"}
+         "signal", "regularizer", "utils", "onnx", "compat",
+         "quantization"}
 
 
 def __getattr__(name):

@@ -7,6 +7,7 @@ from .functional_call import (  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import (  # noqa: F401
     container, common, conv, norm, pooling, activation, loss, transformer)

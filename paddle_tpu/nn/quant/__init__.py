@@ -1,0 +1,119 @@
+"""paddle.nn.quant parity — LLM weight-only quantization.
+
+Reference: python/paddle/nn/quant/quantized_linear.py —
+``weight_quantize``, ``weight_dequantize``, ``weight_only_linear``,
+``llm_int8_linear`` (backed by paddle/phi/kernels/fusion/gpu
+weight_only_linear kernels and cutlass int8 GEMMs).
+
+TPU-native design: weight-only int8/int4 keeps activations in
+bf16/f32 and stores weights quantized per output channel; the forward
+dequantizes at use — XLA fuses the ``w_int * scale`` rescale into the
+matmul so HBM traffic drops by 2-4x (the decode-time bottleneck) while
+the MXU still runs the contraction in bf16.  ``llm_int8_linear``
+implements the LLM.int8 outlier decomposition (arXiv 2208.07339): the
+few activation columns above ``threshold`` run in float, the rest in
+int8 x int8 -> int32 on the MXU's double-rate integer path.
+
+Deviations from the reference, documented: weights are stored in the
+natural ``[in, out]`` layout with scale ``[out]`` (the reference packs
+arch-specific CUTLASS tile layouts — meaningless on TPU); int4 packs
+two nibbles per int8 byte along the input axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", group_size: int = -1):
+    """Quantize a ``[in, out]`` weight per output channel.
+
+    Returns ``(quantized, scale)``: int8 ``[in, out]`` (int4: packed
+    ``[in//2, out]``) and f32 scale ``[out]``.
+    """
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported algo: {algo}")
+    if group_size != -1:
+        raise NotImplementedError(
+            "groupwise quantization not implemented; use per-channel "
+            "(group_size=-1)")
+    from ...quantization.quanters import absmax_quantize
+    if algo == "weight_only_int4":
+        q, scale = absmax_quantize(x, channel_axis=1, bit_length=4)
+        if q.shape[0] % 2:
+            raise ValueError("int4 packing needs an even input dim")
+        lo = q[0::2] & 0xF
+        hi = (q[1::2] & 0xF) << 4
+        return (lo | hi).astype(jnp.int8), scale
+    return absmax_quantize(x, channel_axis=1, bit_length=8)
+
+
+def _unpack_int4(q):
+    """[in//2, out] packed -> [in, out] int8 in [-8, 7]."""
+    lo = (q & 0xF).astype(jnp.int8)
+    hi = ((q >> 4) & 0xF).astype(jnp.int8)
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=1)           # [in//2, 2, out]
+    return out.reshape(-1, q.shape[-1])         # [in, out]
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype=jnp.float32):
+    """Inverse of :func:`weight_quantize`."""
+    if algo == "weight_only_int4":
+        w = _unpack_int4(x).astype(jnp.float32) / 7.0
+    else:
+        w = x.astype(jnp.float32) / 127.0
+    return (w * scale).astype(out_dtype)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None,
+                       group_size: int = -1):
+    """y = x @ dequant(weight) + bias — weights stay quantized in HBM,
+    the dequant fuses into the matmul."""
+    algo = "weight_only_int4" if weight_dtype == "int4" else \
+        "weight_only_int8"
+    w = weight_dequantize(weight, weight_scale, algo, out_dtype=x.dtype)
+    y = x @ w
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0):
+    """LLM.int8: split activation columns by magnitude; outlier columns
+    multiply the dequantized float weights, the rest take the
+    int8 x int8 -> int32 MXU path.
+
+    ``weight`` int8 ``[in, out]``, ``weight_scale`` ``[out]``.
+    """
+    xf = x.astype(jnp.float32)
+    # per-input-feature outlier mask over all leading dims (static shape:
+    # the mask is data-dependent but dense — no gather/scatter)
+    colmax = jnp.max(jnp.abs(xf), axis=tuple(range(x.ndim - 1)))
+    outlier = colmax >= threshold                             # [in]
+    x_out = jnp.where(outlier, xf, 0.0)
+    x_int_part = jnp.where(outlier, 0.0, xf)
+    # int8 path: per-tensor absmax of the non-outlier part
+    s_a = jnp.maximum(jnp.max(jnp.abs(x_int_part)), 1e-8)
+    xq = jnp.clip(jnp.round(x_int_part / s_a * 127), -127,
+                  127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, weight,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (s_a * weight_scale / (127.0 * 127.0))
+    # float path for outliers
+    w_f = weight.astype(jnp.float32) / 127.0 * weight_scale
+    y = y + x_out @ w_f
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)

@@ -1,0 +1,210 @@
+"""paddle.quantization parity — QAT, PTQ, observers, quanters.
+
+Reference: python/paddle/quantization/ — ``QAT`` (qat.py), ``PTQ``
+(ptq.py), ``QuantConfig`` (config.py), observers/, quanters/; the
+simulated-quant CUDA kernels live in paddle/phi/kernels
+(fake_quantize_op) and the deployed int8 operators in the inference
+engine.  SURVEY.md §2.2 (public 2.x surface).
+
+TPU-native redesign, not a port:
+
+* fake-quant is pure jnp with an STE backward — XLA fuses the
+  round/clip chain into adjacent ops (the reference needs dedicated
+  CUDA kernels for the same);
+* observer/EMA state lives in Layer **buffers**, so QAT training and
+  PTQ calibration run inside ``jax.jit`` via ``functional_call``'s
+  buffer threading — calibration at full device speed;
+* ``convert`` produces layers whose matmul really contracts
+  int8 x int8 -> int32 on the MXU (``QuantizedLinear``) — deployment
+  means the double-rate integer systolic path, not a simulation.
+
+Workflow parity with the reference::
+
+    q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                    weight=FakeQuanterChannelWiseAbsMax())
+    qat = QAT(q)
+    model = qat.quantize(model)      # swap Linear/Conv2D -> QAT forms
+    ... train ...
+    infer = qat.convert(model)       # int8 inference model
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer import Layer
+from .config import QuantConfig
+from .observers import (AbsmaxObserver, BaseObserver,
+                        MovingAverageAbsmaxObserver,
+                        PerChannelAbsmaxObserver)
+from .qlayers import (QuantedConv2D, QuantedLinear, QuantizedConv2D,
+                      QuantizedLinear, quantized_linear)
+from .quanters import (BaseQuanter, FakeQuanterChannelWiseAbsMax,
+                       FakeQuanterWithAbsMaxObserver, fake_quant_dequant)
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "BaseObserver", "AbsmaxObserver",
+           "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
+           "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMax", "fake_quant_dequant",
+           "QuantedLinear", "QuantedConv2D", "QuantizedLinear",
+           "QuantizedConv2D", "quantized_linear"]
+
+
+def _replace_sublayer(root: Layer, dotted: str, new_layer: Layer):
+    parts = dotted.split(".")
+    parent = root
+    for p in parts[:-1]:
+        parent = parent._sub_layers[p]
+    parent._sub_layers[parts[-1]] = new_layer
+
+
+def _walk_quantizable(model: Layer, config: QuantConfig):
+    """Yield (dotted_name, layer) for layers the config quantizes,
+    skipping the inside of customized leaves and already-wrapped
+    layers."""
+    skip_prefixes = []
+    for name, layer in model.named_sublayers():
+        if any(name.startswith(p) for p in skip_prefixes):
+            continue
+        if config.is_leaf(layer):
+            skip_prefixes.append(name + ".")
+            continue
+        if isinstance(layer, (QuantedLinear, QuantedConv2D,
+                              QuantizedLinear, QuantizedConv2D,
+                              BaseQuanter, BaseObserver)):
+            skip_prefixes.append(name + ".")
+            continue
+        yield name, layer
+
+
+class QAT:
+    """Quantization-aware training driver (reference: qat.py)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self._config = q_config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        # resolve rules against the ORIGINAL layers first: instance-id
+        # rules (add_layer_config) must keep matching when the model is
+        # deepcopied for the not-inplace path
+        mapping = self._config.qat_mapping()
+        plan = []
+        for name, layer in _walk_quantizable(model, self._config):
+            target = mapping.get(type(layer))
+            if target is None:
+                continue
+            bound = self._config.resolve(name, layer)
+            if bound is not None:
+                plan.append((name, target, bound))
+        if not inplace:
+            model = copy.deepcopy(model)
+        for name, target, bound in plan:
+            layer = model
+            for p in name.split("."):
+                layer = layer._sub_layers[p]
+            _replace_sublayer(model, name, target(layer, bound))
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze a trained QAT model into the int8 inference form."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        for name, layer in list(model.named_sublayers()):
+            if isinstance(layer, QuantedLinear):
+                act = layer.activation_quanter
+                scale = float(act.scales()) if act is not None else 0.0
+                bits = act.bit_length() if act is not None else 8
+                new = QuantizedLinear(layer.weight, layer.bias, scale, bits)
+                _replace_sublayer(model, name, new)
+            elif isinstance(layer, QuantedConv2D):
+                act = layer.activation_quanter
+                scale = float(act.scales()) if act is not None else 0.0
+                bits = act.bit_length() if act is not None else 8
+                _replace_sublayer(model, name,
+                                  QuantizedConv2D(layer, scale, bits))
+        return model
+
+
+class _ObservedLayer(Layer):
+    """PTQ wrapper: observer on the input activation, float forward."""
+
+    def __init__(self, layer: Layer, observer):
+        super().__init__()
+        self._inner = layer
+        self.activation_observer = observer
+
+    def forward(self, *args, **kwargs):
+        if self.activation_observer is not None and args:
+            self.activation_observer(args[0])
+        return self._inner(*args, **kwargs)
+
+
+class PTQ:
+    """Post-training quantization driver (reference: ptq.py).
+
+    ``quantize`` wraps matched layers with input observers; run
+    calibration batches through the model (eagerly, or jitted via
+    ``functional_call`` — observer state is buffers), then ``convert``
+    freezes the observed ranges into int8 inference layers.
+    """
+
+    def __init__(self, q_config: QuantConfig):
+        self._config = q_config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from ..nn.layers.common import Linear
+        from ..nn.layers.conv import Conv2D
+        plan = []
+        for name, layer in _walk_quantizable(model, self._config):
+            if not isinstance(layer, (Linear, Conv2D)):
+                continue
+            bound = self._config.resolve(name, layer)
+            if bound is not None:
+                plan.append((name, bound))
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        for name, bound in plan:
+            layer = model
+            for p in name.split("."):
+                layer = layer._sub_layers[p]
+            obs = bound.make_activation_quanter()
+            _replace_sublayer(model, name, _ObservedLayer(layer, obs))
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        from ..nn.layers.common import Linear
+        from ..nn.layers.conv import Conv2D
+        for name, layer in list(model.named_sublayers()):
+            if not isinstance(layer, _ObservedLayer):
+                continue
+            obs = layer.activation_observer
+            scale = float(obs.scales()) if obs is not None else 0.0
+            bits = obs.bit_length() if obs is not None else 8
+            inner = layer._inner
+            if isinstance(inner, Linear):
+                new = QuantizedLinear(inner.weight, inner.bias, scale, bits)
+            elif isinstance(inner, Conv2D):
+                shim = _ConvShim(inner)
+                new = QuantizedConv2D(shim, scale, bits)
+            else:
+                new = inner
+            _replace_sublayer(model, name, new)
+        return model
+
+
+class _ConvShim:
+    """Adapts a float Conv2D to the attribute set QuantizedConv2D
+    expects from a QuantedConv2D."""
+
+    def __init__(self, conv):
+        self._stride = conv.stride
+        self._padding = conv.padding
+        self._dilation = conv.dilation
+        self._groups = conv.groups
+        self._data_format = conv.data_format
+        self.weight = conv.weight
+        self.bias = conv.bias

@@ -1,0 +1,112 @@
+"""QuantConfig — declarative mapping from layers to quanters/observers.
+
+Reference: python/paddle/quantization/config.py — ``QuantConfig``
+(add_layer_config / add_name_config / add_type_config /
+add_qat_layer_mapping, default qat mappings).
+
+The reference stores *factory* objects and stamps a fresh quanter per
+attached layer; here the prototypes are Layers and attachment is
+``copy.deepcopy`` — same semantics, no extra factory machinery.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+__all__ = ["QuantConfig"]
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        """``activation``/``weight`` are prototype quanters (e.g.
+        :class:`FakeQuanterWithAbsMaxObserver`) applied as the global
+        default; ``None`` leaves that side unquantized."""
+        self._global = {"activation": activation, "weight": weight}
+        self._layer_cfg = []     # (predicate, cfg) in registration order
+        self._qat_mapping = {}
+        self._customized_leaves = []
+
+    # ---- rules ----------------------------------------------------------
+    def add_layer_config(self, layer, activation=None, weight=None):
+        """Rule for specific layer INSTANCES (highest precedence)."""
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        ids = {id(l) for l in layers}
+        self._layer_cfg.append((lambda name, l, ids=ids: id(l) in ids,
+                                {"activation": activation, "weight": weight}))
+
+    def add_name_config(self, name, activation=None, weight=None):
+        """Rule by dotted sublayer name (exact match or prefix)."""
+        names = name if isinstance(name, (list, tuple)) else [name]
+        names = tuple(names)
+        self._layer_cfg.append(
+            (lambda n, l, names=names: any(
+                n == p or n.startswith(p + ".") for p in names),
+             {"activation": activation, "weight": weight}))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        """Rule by layer class."""
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        types = tuple(types)
+        self._layer_cfg.append(
+            (lambda n, l, types=types: isinstance(l, types),
+             {"activation": activation, "weight": weight}))
+
+    def add_qat_layer_mapping(self, source, target):
+        """Map a float layer class to its QAT wrapper class (the wrapper
+        is constructed as ``target(layer, bound_config)``)."""
+        self._qat_mapping[source] = target
+
+    def add_customized_leaves(self, layer_type):
+        """Types treated as leaves: their sublayers are not visited."""
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        self._customized_leaves.extend(types)
+
+    # ---- resolution -----------------------------------------------------
+    def qat_mapping(self):
+        from ..nn.layers.common import Linear
+        from ..nn.layers.conv import Conv2D
+        from .qlayers import QuantedConv2D, QuantedLinear
+        mapping = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+        mapping.update(self._qat_mapping)
+        return mapping
+
+    def is_leaf(self, layer) -> bool:
+        return self._customized_leaves and \
+            isinstance(layer, tuple(self._customized_leaves))
+
+    def resolve(self, name, layer) -> Optional["_BoundConfig"]:
+        """The first matching rule wins (registration order), falling
+        back to the global default; returns None when neither side is
+        quantized for this layer."""
+        for pred, cfg in self._layer_cfg:
+            if pred(name, layer):
+                chosen = cfg
+                break
+        else:
+            chosen = self._global
+        if chosen["activation"] is None and chosen["weight"] is None:
+            return None
+        return _BoundConfig(chosen["activation"], chosen["weight"])
+
+
+class _BoundConfig:
+    """Per-layer view handed to the QAT wrapper: stamps fresh quanter
+    copies so no state is shared across layers."""
+
+    def __init__(self, activation_proto, weight_proto):
+        self._act = activation_proto
+        self._w = weight_proto
+
+    def make_activation_quanter(self):
+        return copy.deepcopy(self._act) if self._act is not None else None
+
+    def make_weight_quanter(self, quant_axis: int = 0):
+        if self._w is None:
+            return None
+        q = copy.deepcopy(self._w)
+        if hasattr(q, "_axis"):
+            q._axis = quant_axis
+        return q

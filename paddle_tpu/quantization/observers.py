@@ -1,0 +1,128 @@
+"""Quantization observers — range statistics collectors.
+
+Reference: python/paddle/quantization/observers/ — ``AbsmaxObserver``
+(abs_max.py), per-channel/groupwise variants; the C++ runtime kernels they
+drive live in paddle/phi/kernels (fake_quantize_op).  SURVEY.md §2.2
+(paddle.quantization is part of the public 2.x surface).
+
+TPU-native design: an observer IS a :class:`~paddle_tpu.nn.Layer` whose
+state (running max) lives in **buffers**, so calibration works both
+eagerly and inside a jitted program — ``functional_call`` threads the
+updated buffers out of the trace exactly like BatchNorm running stats.
+Forward is the identity on the data path; only the statistics update.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "PerChannelAbsmaxObserver"]
+
+
+class BaseObserver(Layer):
+    """Identity layer that tracks quantization ranges in buffers.
+
+    Subclasses update their buffers in ``forward`` and implement
+    :meth:`scales`.  ``quant_axis()`` is ``None`` for per-tensor scales,
+    an integer channel axis for per-channel.
+    """
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self) -> int:
+        return self._quant_bits
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        raise NotImplementedError
+
+    def forward(self, x):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of ``|x|`` (reference: observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self.register_buffer("_max", jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        self._max = jnp.maximum(self._max, cur)
+        return x
+
+    def scales(self):
+        return jnp.maximum(self._max, 1e-8)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """Debias-corrected EMA of per-batch absmax.
+
+    Reference semantics (fake_quantize_op FakeQuantMovingAverageAbsMax):
+    ``state = rate*state + 1; accum = rate*accum + absmax;
+    scale = accum/state`` — an exponential moving average with the
+    warm-up bias removed.
+    """
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self._moving_rate = moving_rate
+        self.register_buffer("_state", jnp.zeros((), jnp.float32))
+        self.register_buffer("_accum", jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        self._state = self._moving_rate * self._state + 1.0
+        self._accum = self._moving_rate * self._accum + cur
+        return x
+
+    def scales(self):
+        return jnp.maximum(self._accum / jnp.maximum(self._state, 1.0), 1e-8)
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-channel running absmax (for weights).
+
+    ``quant_axis`` follows the reference convention: the output-channel
+    axis — 1 for Linear weights ``[in, out]``, 0 for Conv weights
+    ``[out, in, kh, kw]``.
+    """
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 0,
+                 num_channels: int = None):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        # buffers must exist BEFORE a traced call so functional_call can
+        # thread them; pass num_channels to use this observer under jit
+        if num_channels is not None:
+            self.register_buffer("_max", jnp.zeros((num_channels,),
+                                                   jnp.float32))
+
+    def quant_axis(self):
+        return self._axis
+
+    def forward(self, x):
+        ax = tuple(i for i in range(x.ndim) if i != self._axis % x.ndim)
+        cur = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=ax)
+        if "_max" not in self._buffers:
+            import jax.core
+            if isinstance(cur, jax.core.Tracer):
+                raise RuntimeError(
+                    "PerChannelAbsmaxObserver with unknown channel count "
+                    "cannot initialize inside a traced function; pass "
+                    "num_channels= at construction to calibrate under jit")
+            self.register_buffer("_max", cur)
+        else:
+            self._max = jnp.maximum(self._max, cur)
+        return x
+
+    def scales(self):
+        return jnp.maximum(self._max, 1e-8)

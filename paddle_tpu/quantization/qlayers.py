@@ -1,0 +1,171 @@
+"""QAT layer wrappers and converted int8 inference layers.
+
+Reference: python/paddle/nn/quant/qat/linear.py — ``QuantedLinear``;
+conv.py — ``QuantedConv2D``; the converted inference form corresponds to
+the reference's quantized operators (paddle/phi/kernels/fusion —
+quantized matmul/conv paths).
+
+TPU-native inference design: ``QuantizedLinear`` stores int8 weights and
+runs the matmul as **int8 x int8 -> int32** via
+``lax.dot_general(preferred_element_type=int32)`` — on v5e the MXU
+executes int8 contractions at double the bf16 rate, which is the whole
+point of deploying a quantized model on TPU.  Convs convert to the
+weight-only form (int8 storage, dequantized at use — XLA fuses the
+dequant into the conv) because integer convolution is not a profitable
+Mosaic/XLA path today; documented deviation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer, Parameter
+from .quanters import absmax_quantize, fake_quant_dequant
+
+__all__ = ["QuantedLinear", "QuantedConv2D", "QuantizedLinear",
+           "QuantizedConv2D", "quantized_linear"]
+
+
+class QuantedLinear(Layer):
+    """QAT Linear: fake-quant the input activation and the weight, then
+    the ordinary float matmul (reference nn/quant/qat/linear.py)."""
+
+    def __init__(self, linear, q_config):
+        super().__init__()
+        self.in_features = linear.in_features
+        self.out_features = linear.out_features
+        self.weight = Parameter(linear.weight)
+        if linear.bias is None:
+            self.add_parameter("bias", None)
+        else:
+            self.bias = Parameter(linear.bias)
+        self.activation_quanter = q_config.make_activation_quanter()
+        # weight=None in the config means the weight side is NOT
+        # fake-quantized during training (activation-only QAT)
+        self.weight_quanter = q_config.make_weight_quanter(quant_axis=1)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT Conv2D (weight quant_axis 0 — ``[out, in, kh, kw]``)."""
+
+    def __init__(self, conv, q_config):
+        super().__init__()
+        self._stride = conv.stride
+        self._padding = conv.padding
+        self._dilation = conv.dilation
+        self._groups = conv.groups
+        self._data_format = conv.data_format
+        self.weight = Parameter(conv.weight)
+        if conv.bias is None:
+            self.add_parameter("bias", None)
+        else:
+            self.bias = Parameter(conv.bias)
+        self.activation_quanter = q_config.make_activation_quanter()
+        self.weight_quanter = q_config.make_weight_quanter(quant_axis=0)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+def quantized_linear(x, w_int8, w_scale, act_scale, bias=None,
+                     bit_length: int = 8):
+    """int8 MXU matmul: quantize ``x`` with ``act_scale``, contract
+    int8 x int8 into int32, rescale per output channel.
+
+    w_int8 ``[in, out]`` int8; w_scale ``[out]`` (absmax); act_scale
+    scalar (absmax).
+    """
+    bnt = (1 << (bit_length - 1)) - 1
+    s_a = jnp.maximum(jnp.asarray(act_scale, jnp.float32), 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_a * bnt),
+                  -bnt, bnt).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, w_int8,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (s_a * w_scale / (bnt * bnt))
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)
+
+
+class QuantizedLinear(Layer):
+    """Converted inference Linear: int8 weights + frozen scales."""
+
+    def __init__(self, weight, bias, act_scale, bit_length: int = 8):
+        super().__init__()
+        q, w_scale = absmax_quantize(weight, channel_axis=1,
+                                     bit_length=bit_length)
+        self._bits = bit_length
+        # act_scale <= 0: no activation quanter was attached — run the
+        # weight-only form (float activations, dequant fused into the
+        # matmul) instead of saturating everything against a 0 scale
+        self._act_quant = float(act_scale) > 0.0
+        self.register_buffer("w_int8", q)
+        self.register_buffer("w_scale", w_scale)
+        self.register_buffer("act_scale",
+                             jnp.asarray(act_scale, jnp.float32))
+        self.register_buffer("bias", bias)
+
+    def forward(self, x):
+        if self._act_quant:
+            return quantized_linear(x, self.w_int8, self.w_scale,
+                                    self.act_scale, self.bias, self._bits)
+        bnt = (1 << (self._bits - 1)) - 1
+        w = (self.w_int8.astype(jnp.float32) * self.w_scale / bnt
+             ).astype(x.dtype)
+        y = x @ w
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class QuantizedConv2D(Layer):
+    """Converted inference Conv2D: int8 weight storage, dequantized at
+    use (weight-only form — see module docstring); input fake-quantized
+    with the frozen activation scale so the numerics match the QAT
+    graph."""
+
+    def __init__(self, quanted_conv, act_scale, bit_length: int = 8):
+        super().__init__()
+        src = quanted_conv
+        self._stride = src._stride
+        self._padding = src._padding
+        self._dilation = src._dilation
+        self._groups = src._groups
+        self._data_format = src._data_format
+        self._bits = bit_length
+        q, w_scale = absmax_quantize(src.weight, channel_axis=0,
+                                     bit_length=bit_length)
+        self._act_quant = float(act_scale) > 0.0
+        self.register_buffer("w_int8", q)
+        self.register_buffer("w_scale", w_scale)
+        self.register_buffer("act_scale",
+                             jnp.asarray(act_scale, jnp.float32))
+        self.register_buffer("bias", src.bias)
+
+    def forward(self, x):
+        bnt = (1 << (self._bits - 1)) - 1
+        if self._act_quant:
+            x = fake_quant_dequant(x, self.act_scale, self._bits)
+        wsb = self.w_scale.reshape(
+            (-1,) + (1,) * (self.w_int8.ndim - 1))
+        w = (self.w_int8.astype(jnp.float32) * wsb / bnt).astype(x.dtype)
+        return F.conv2d(x, w, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)

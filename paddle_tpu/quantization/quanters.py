@@ -1,0 +1,134 @@
+"""Fake quantizers (QAT) — straight-through-estimator simulated quant.
+
+Reference: python/paddle/quantization/quanters/abs_max.py —
+``FakeQuanterWithAbsMaxObserver`` (activation EMA absmax) and the
+channel-wise weight fake-quant the QAT layers apply
+(nn/quant/qat/*).  The reference backs these with CUDA fake_quantize
+kernels; here the math is pure jnp — XLA fuses the round/clip/scale
+chain into neighbouring ops, which IS the TPU-native form.
+
+Semantics: ``bnt = 2^(bits-1) - 1``; quant ``q = clip(round(x/s*bnt),
+±bnt)``; dequant ``q*s/bnt``.  The backward is the straight-through
+estimator: identity inside the clip range (implemented as
+``x + stop_gradient(dq - x)``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from .observers import MovingAverageAbsmaxObserver
+
+__all__ = ["BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMax", "fake_quant_dequant",
+           "absmax_quantize"]
+
+
+def absmax_quantize(w, channel_axis: int, bit_length: int = 8):
+    """Symmetric per-channel int quantization — the single shared
+    recipe behind QuantizedLinear/QuantizedConv2D storage and
+    ``nn.quant.weight_quantize``.
+
+    Returns ``(q_int8, scale)`` with ``scale`` shaped ``[channels]``
+    (absmax along every other axis).
+    """
+    bnt = (1 << (bit_length - 1)) - 1
+    wf = jnp.asarray(w, jnp.float32)
+    ax = tuple(i for i in range(wf.ndim) if i != channel_axis % wf.ndim)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=ax), 1e-8)
+    shape = [1] * wf.ndim
+    shape[channel_axis % wf.ndim] = scale.shape[0]
+    q = jnp.clip(jnp.round(wf / scale.reshape(shape) * bnt), -bnt,
+                 bnt).astype(jnp.int8)
+    return q, scale
+
+
+def fake_quant_dequant(x, scale, bit_length: int = 8, quant_axis=None):
+    """Simulated symmetric quantization with an STE backward.
+
+    ``scale`` is the absmax (per tensor, or per channel along
+    ``quant_axis``).
+    """
+    bnt = (1 << (bit_length - 1)) - 1
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-8)
+    if quant_axis is not None and s.ndim == 1:
+        shape = [1] * x.ndim
+        shape[quant_axis % x.ndim] = s.shape[0]
+        s = s.reshape(shape)
+    xf = x.astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / s * bnt), -bnt, bnt)
+    dq = (q * s / bnt).astype(x.dtype)
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+class BaseQuanter(Layer):
+    """A quanter is an observer that also fake-quantizes the data path."""
+
+    def bit_length(self) -> int:
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Activation fake-quant with a debias-corrected EMA absmax range.
+
+    Training mode updates the EMA buffers (threaded through jit by
+    ``functional_call``) and quantizes with the CURRENT batch absmax
+    (reference behaviour); eval mode quantizes with the frozen EMA
+    scale.
+    """
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8):
+        super().__init__()
+        self._observer = MovingAverageAbsmaxObserver(
+            quant_bits=bit_length, moving_rate=moving_rate)
+        self._bits = bit_length
+
+    def bit_length(self) -> int:
+        return self._bits
+
+    def scales(self):
+        return self._observer.scales()
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32))), 1e-8)
+            self._observer(x)
+            return fake_quant_dequant(x, cur, self._bits)
+        return fake_quant_dequant(x, self.scales(), self._bits)
+
+
+class FakeQuanterChannelWiseAbsMax(BaseQuanter):
+    """Weight fake-quant: per-output-channel absmax of the CURRENT
+    weight (stateless — the scale follows the weight as it trains).
+
+    ``quant_axis``: 1 for Linear ``[in, out]``, 0 for Conv
+    ``[out, in, ...]`` (reference convention).
+    """
+
+    def __init__(self, bit_length: int = 8, quant_axis: int = 0):
+        super().__init__()
+        self._bits = bit_length
+        self._axis = quant_axis
+
+    def bit_length(self) -> int:
+        return self._bits
+
+    def quant_axis(self):
+        return self._axis
+
+    def scales_for(self, w):
+        ax = tuple(i for i in range(w.ndim) if i != self._axis % w.ndim)
+        return jnp.maximum(jnp.max(jnp.abs(w.astype(jnp.float32)), axis=ax),
+                           1e-8)
+
+    def forward(self, w):
+        return fake_quant_dequant(w, self.scales_for(w), self._bits,
+                                  quant_axis=self._axis)

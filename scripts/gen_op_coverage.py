@@ -8,9 +8,10 @@ rounds, so the reference list below is CURATED from the reference's
 published stable-2.x Python API documentation (the YAML-generated op
 surface exposed through python/paddle/*), not extracted from a tree.  It
 deliberately covers the user-facing namespaces a migrating user touches
-(23 namespaces: paddle.*, distributed, linalg, nn, nn.functional, fft,
+(25 namespaces: paddle.*, distributed, linalg, nn, nn.functional, fft,
 signal, optimizer(+lr), vision.{models,transforms,ops}, io, metric, amp,
-jit, static, distribution, sparse, incubate(+nn), callbacks, utils)
+jit, static, distribution, sparse, incubate(+nn), callbacks, utils,
+quantization, nn.quant)
 rather than internal _C_ops.  Names that are pure aliases
 in the reference (e.g. paddle.max vs Tensor.max) appear once.
 
@@ -234,6 +235,16 @@ distribute_fpn_proposals generate_proposals nms psroi_pool roi_align
 roi_pool
 """
 
+PADDLE_QUANTIZATION = """
+QuantConfig QAT PTQ BaseObserver AbsmaxObserver MovingAverageAbsmaxObserver
+PerChannelAbsmaxObserver BaseQuanter FakeQuanterWithAbsMaxObserver
+FakeQuanterChannelWiseAbsMax
+"""
+
+PADDLE_NN_QUANT = """
+weight_quantize weight_dequantize weight_only_linear llm_int8_linear
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -258,6 +269,8 @@ REFERENCE = {
     "paddle.utils": PADDLE_UTILS,
     "paddle.vision.transforms": PADDLE_VISION_TRANSFORMS,
     "paddle.vision.ops": PADDLE_VISION_OPS,
+    "paddle.quantization": PADDLE_QUANTIZATION,
+    "paddle.nn.quant": PADDLE_NN_QUANT,
 }
 
 # repo namespace that answers for each reference namespace
@@ -285,6 +298,8 @@ TARGETS = {
     "paddle.utils": "paddle_tpu.utils",
     "paddle.vision.transforms": "paddle_tpu.vision.transforms",
     "paddle.vision.ops": "paddle_tpu.vision.ops",
+    "paddle.quantization": "paddle_tpu.quantization",
+    "paddle.nn.quant": "paddle_tpu.nn.quant",
 }
 
 
