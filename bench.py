@@ -258,7 +258,11 @@ def _kernel_compare():
     evidence artifact carries, so the driver bench and the evidence file
     cannot diverge."""
     from scripts.tpu_evidence_bench import _kernel_compare as kc
-    return kc(float(os.environ.get("BENCH_KERNELS_BUDGET", "150")))
+    # seq=1024: the dense-XLA bwd at s2048 can compile for minutes on the
+    # remote-compile path and would starve the driver budget (round-2
+    # lesson); the evidence-bench run keeps the full 2048
+    return kc(float(os.environ.get("BENCH_KERNELS_BUDGET", "150")),
+              seq=int(os.environ.get("BENCH_KERNELS_SEQ", "1024")))
 
 
 def _secondary_benches(smoke=False):

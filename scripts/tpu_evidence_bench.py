@@ -75,16 +75,30 @@ def flush():
     os.replace(tmp, EVIDENCE_PATH)
 
 
-def _kc_ok(ev):
-    """A kernel-compare table counts only when it is substantially
-    complete: no top-level error, not budget-truncated, and at least
-    four sections measured without their own nested error."""
+def _kc_structural(ev):
+    """Structurally complete table: no top-level error, not
+    budget-truncated, and at least four sections measured without their
+    own nested error (timing methodology not considered)."""
     kc = ev.get("kernel_compare") if ev else None
     if not isinstance(kc, dict) or "error" in kc or "truncated" in kc:
         return False
     rows = [v for v in kc.values()
             if isinstance(v, dict) and "error" not in v]
     return len(rows) >= 4
+
+
+def _kc_ok(ev):
+    """A kernel-compare table counts only when it is structurally
+    complete AND measured with the scan-chained timing method.  The
+    first captured table (round 3) timed each iteration as its own
+    dispatch; the axon tunnel's tens-of-ms per-dispatch/sync overhead
+    dominated the sub-3ms kernels and flipped ratios (flash fwd read
+    0.44x when the overhead-free measurement is ~1.5x).  Requiring the
+    marker makes the watchdog recapture with honest timing."""
+    kc = ev.get("kernel_compare") if ev else None
+    return (_kc_structural(ev)
+            and isinstance(kc, dict)
+            and kc.get("timing") == "scan-chained")
 
 
 def _is_full(ev):
@@ -105,7 +119,23 @@ def _maybe_promote():
                   and EV["mfu"] >= 0.9 * old["mfu"]))
     if not better:
         return
-    if _is_good(old) and _kc_ok(old) and not _kc_ok(EV):
+    # Carry the old table forward only when it does not replace fresher
+    # honest data: an honest-but-partial scan-chained table from THIS run
+    # beats a complete per-dispatch table whose ratios are documented
+    # invalid (_kc_ok), so the old table replaces it only when the old
+    # one is itself scan-chained, or this run measured nothing at all.
+    def _rows(ev):
+        kc = ev.get("kernel_compare") if ev else None
+        if not isinstance(kc, dict):
+            return 0
+        return len([v for v in kc.values()
+                    if isinstance(v, dict) and "error" not in v])
+
+    old_kc = (old or {}).get("kernel_compare") or {}
+    ok_to_carry = (_kc_structural(old)
+                   and (old_kc.get("timing") == "scan-chained"
+                        or _rows(EV) == 0))
+    if _is_good(old) and ok_to_carry and not _kc_structural(EV):
         EV["kernel_compare"] = old["kernel_compare"]
         EV["kernel_compare_carried_from_unix"] = old.get("finished_unix")
         flush()
@@ -314,6 +344,13 @@ def _kernel_compare(budget_s, seq=2048):
     import jax.numpy as jnp
     from paddle_tpu.kernels import flash_attention, fused_rms_norm_pallas
     from paddle_tpu.nn.functional.attention import sdpa_reference
+    # single source of the timing methodology (scan-chained; see module
+    # docstring there for why per-dispatch timing is invalid on axon) and
+    # of the attention chain construction
+    try:
+        from tpu_microbench import timeit_chain, _attn_steps
+    except ImportError:
+        from scripts.tpu_microbench import timeit_chain, _attn_steps
 
     t_start = time.perf_counter()
     need = min(90.0, 0.25 * budget_s)  # time to leave for the next section
@@ -321,48 +358,41 @@ def _kernel_compare(budget_s, seq=2048):
     def left():
         return budget_s - (time.perf_counter() - t_start)
 
-    def timeit(fn, *args, iters=5):
-        out = fn(*args)
-        float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        float(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
-        return (time.perf_counter() - t0) / iters * 1e3
+    def row(name, pallas_step, xla_step, init, extra=None, nd=3):
+        r = dict(extra or {})
+        r["pallas_ms"] = round(timeit_chain(pallas_step, init), nd)
+        r["xla_ms"] = round(timeit_chain(xla_step, init), nd)
+        r["speedup"] = round(r["xla_ms"] / max(r["pallas_ms"], 1e-9), 2)
+        res[name] = r
 
     rs = np.random.RandomState(0)
-    res = {}
+    res = {"timing": "scan-chained"}
     b, s, h, d = 2, seq, 8, 128
     q = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
     k = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
     v = jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
 
-    fa = jax.jit(lambda q, k, v: jnp.sum(
-        flash_attention(q, k, v, causal=True, interpret=False) ** 2))
-    xa = jax.jit(lambda q, k, v: jnp.sum(
-        sdpa_reference(q, k, v, is_causal=True, training=False) ** 2))
-    rel = abs(float(fa(q, k, v)) - float(xa(q, k, v))) / \
-        max(abs(float(xa(q, k, v))), 1e-6)
-    res[f"flash_attn_fwd_s{s}"] = {
-        "ok": rel < 2e-2, "pallas_ms": round(timeit(fa, q, k, v), 2),
-        "xla_ms": round(timeit(xa, q, k, v), 2)}
-    res[f"flash_attn_fwd_s{s}"]["speedup"] = round(
-        res[f"flash_attn_fwd_s{s}"]["xla_ms"] /
-        res[f"flash_attn_fwd_s{s}"]["pallas_ms"], 2)
+    def fa(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False)
+
+    def xa(q, k, v):
+        return sdpa_reference(q, k, v, is_causal=True,
+                              training=False).astype(q.dtype)
+
+    # fwd chains out->q, bwd chains grads->(q,k,v): real dependence,
+    # zero extra traffic (shared construction with tpu_microbench)
+    pa_fwd, pa_bwd = _attn_steps(fa)
+    xa_fwd, xa_bwd = _attn_steps(xa)
+    pal = float(jax.jit(lambda q, k, v: jnp.sum(fa(q, k, v) ** 2))(q, k, v))
+    xref = float(jax.jit(lambda q, k, v: jnp.sum(xa(q, k, v) ** 2))(q, k, v))
+    rel = abs(pal - xref) / max(abs(xref), 1e-6)
+    row(f"flash_attn_fwd_s{s}", pa_fwd, xa_fwd,
+        (q, k, v), extra={"ok": rel < 2e-2}, nd=2)
     if left() < need:
         res["truncated"] = "budget"
         return res
 
-    fa_g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(flash_attention(
-        q, k, v, causal=True, interpret=False) ** 2), argnums=(0, 1, 2)))
-    xa_g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(sdpa_reference(
-        q, k, v, is_causal=True, training=False) ** 2), argnums=(0, 1, 2)))
-    res[f"flash_attn_bwd_s{s}"] = {
-        "pallas_ms": round(timeit(fa_g, q, k, v), 2),
-        "xla_ms": round(timeit(xa_g, q, k, v), 2)}
-    res[f"flash_attn_bwd_s{s}"]["speedup"] = round(
-        res[f"flash_attn_bwd_s{s}"]["xla_ms"] /
-        res[f"flash_attn_bwd_s{s}"]["pallas_ms"], 2)
+    row(f"flash_attn_bwd_s{s}", pa_bwd, xa_bwd, (q, k, v), nd=2)
     if left() < need:
         res["truncated"] = "budget"
         return res
@@ -375,22 +405,19 @@ def _kernel_compare(budget_s, seq=2048):
         kc = jnp.asarray(rs.randn(4, sk, 8, 128), jnp.bfloat16)
         vc = jnp.asarray(rs.randn(4, sk, 8, 128), jnp.bfloat16)
         ln = jnp.full((4,), sk, jnp.int32)
-        dp = jax.jit(lambda q, k, v: jnp.sum(
-            decode_attention(q, k, v, ln, interpret=False) ** 2))
 
         def xdec(q, k, v):
             s_ = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
                             k.astype(jnp.float32)) / np.sqrt(128)
             p = jax.nn.softmax(s_, -1)
-            return jnp.sum(jnp.einsum(
-                "bhqs,bshd->bqhd", p, v.astype(jnp.float32)) ** 2)
-        dx = jax.jit(xdec)
-        res["decode_attn_kv4096"] = {
-            "pallas_ms": round(timeit(dp, q1, kc, vc), 3),
-            "xla_ms": round(timeit(dx, q1, kc, vc), 3)}
-        res["decode_attn_kv4096"]["speedup"] = round(
-            res["decode_attn_kv4096"]["xla_ms"] /
-            max(res["decode_attn_kv4096"]["pallas_ms"], 1e-9), 2)
+            return jnp.einsum("bhqs,bshd->bqhd", p,
+                              v.astype(jnp.float32)).astype(q.dtype)
+
+        row("decode_attn_kv4096",
+            lambda q, k, v: (decode_attention(q, k, v, ln,
+                                              interpret=False), k, v),
+            lambda q, k, v: (xdec(q, k, v), k, v),
+            (q1, kc, vc))
     except Exception as e:
         res["decode_attn_kv4096"] = {"error": repr(e)[-200:]}
     if left() < need:
@@ -402,40 +429,36 @@ def _kernel_compare(budget_s, seq=2048):
     bln = jnp.asarray(rs.randn(4096), jnp.float32)
     try:
         from paddle_tpu.kernels import fused_layer_norm_pallas
-        lp = jax.jit(lambda x, w, b: fused_layer_norm_pallas(
-            x, w, b, 1e-5, interpret=False))
 
-        def lref(x, w, b):
+        def lref(x):
             xf = x.astype(jnp.float32)
             mu = jnp.mean(xf, -1, keepdims=True)
             var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
-            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + b).astype(
+            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + bln).astype(
                 x.dtype)
-        lx = jax.jit(lref)
-        res["fused_layer_norm_8192x4096"] = {
-            "pallas_ms": round(timeit(lp, x, w, bln), 3),
-            "xla_ms": round(timeit(lx, x, w, bln), 3)}
-        res["fused_layer_norm_8192x4096"]["speedup"] = round(
-            res["fused_layer_norm_8192x4096"]["xla_ms"] /
-            max(res["fused_layer_norm_8192x4096"]["pallas_ms"], 1e-9), 2)
+
+        # chain y->x (normalized output is numerically stable as an input)
+        row("fused_layer_norm_8192x4096",
+            lambda x: (fused_layer_norm_pallas(x, w, bln, 1e-5,
+                                               interpret=False),),
+            lambda x: (lref(x),), (x,))
     except Exception as e:
         res["fused_layer_norm_8192x4096"] = {"error": repr(e)[-200:]}
-    rp = jax.jit(lambda x, w: fused_rms_norm_pallas(x, w, 1e-6,
-                                                    interpret=False))
-    rx = jax.jit(lambda x, w: (x.astype(jnp.float32) * jax.lax.rsqrt(
-        jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-        + 1e-6) * w).astype(x.dtype))
-    res["fused_rms_norm_8192x4096"] = {
-        "pallas_ms": round(timeit(rp, x, w), 3),
-        "xla_ms": round(timeit(rx, x, w), 3)}
-    res["fused_rms_norm_8192x4096"]["speedup"] = round(
-        res["fused_rms_norm_8192x4096"]["xla_ms"] /
-        max(res["fused_rms_norm_8192x4096"]["pallas_ms"], 1e-9), 2)
+
+    def rref(x):
+        return (x.astype(jnp.float32) * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+            + 1e-6) * w).astype(x.dtype)
+
+    row("fused_rms_norm_8192x4096",
+        lambda x: (fused_rms_norm_pallas(x, w, 1e-6, interpret=False),),
+        lambda x: (rref(x),), (x,))
     if left() < need:
         res["truncated"] = "budget"
         return res
 
-    # fused AdamW vs XLA (optax-style tree update)
+    # fused AdamW vs XLA (optax-style tree update); chain (p,m,v) through
+    # the update like a real optimizer loop, g constant
     try:
         from paddle_tpu.kernels import fused_adamw_update
         n = 8 * 1024 * 1024
@@ -443,21 +466,18 @@ def _kernel_compare(budget_s, seq=2048):
         g = jnp.asarray(rs.randn(n), jnp.float32)
         m = jnp.zeros((n,), jnp.float32)
         v2 = jnp.zeros((n,), jnp.float32)
-        ap = jax.jit(lambda p, g, m, v: fused_adamw_update(
-            p, g, m, v, 1, 1e-4, 0.9, 0.999, 1e-8, 0.01, interpret=False))
 
-        def xadam(p, g, m, v):
+        def xadam(p, m, v):
             m2 = 0.9 * m + 0.1 * g
             v3 = 0.999 * v + 0.001 * g * g
             up = m2 / (1 - 0.9) / (jnp.sqrt(v3 / (1 - 0.999)) + 1e-8)
             return p - 1e-4 * (up + 0.01 * p), m2, v3
-        ax = jax.jit(xadam)
-        res["fused_adamw_8M"] = {
-            "pallas_ms": round(timeit(ap, p, g, m, v2), 3),
-            "xla_ms": round(timeit(ax, p, g, m, v2), 3)}
-        res["fused_adamw_8M"]["speedup"] = round(
-            res["fused_adamw_8M"]["xla_ms"] /
-            max(res["fused_adamw_8M"]["pallas_ms"], 1e-9), 2)
+
+        row("fused_adamw_8M",
+            lambda p, m, v: fused_adamw_update(
+                p, g, m, v, 1, 1e-4, 0.9, 0.999, 1e-8, 0.01,
+                interpret=False),
+            xadam, (p, m, v2))
     except Exception as e:
         res["fused_adamw_8M"] = {"error": repr(e)[-200:]}
     return res

@@ -1,0 +1,119 @@
+"""On-chip microbenchmark harness with dispatch-overhead-free timing.
+
+The axon tunnel adds tens of ms of per-dispatch/sync overhead, which
+dwarfs sub-ms kernels and *flips* Pallas-vs-XLA ratios when each timed
+iteration is its own dispatch (the first round-3 kernel-compare table's
+flash fwd 0.44x was this artifact; the overhead-free measurement is
+~1.5x).  ``timeit_chain`` chains ``iters`` invocations inside ONE jitted
+``lax.scan`` whose carry IS the step's output fed back as the next
+input — a real data dependence with ZERO extra memory traffic on either
+side (a perturbation add would fuse for free into the XLA reference but
+not across a pallas_call boundary, biasing Pallas down — found in
+review), so one dispatch + one device->host sync amortizes over all
+iterations.
+
+This module is the single source of the timing methodology;
+scripts/tpu_evidence_bench.py imports it for the kernel-compare table.
+
+Usage:  python scripts/tpu_microbench.py [sweep|compare]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timeit_chain(step, init, iters=20):
+    """ms per iteration of ``step``, chained inside one jit.
+
+    ``step`` maps a tuple of arrays to a tuple of arrays with the SAME
+    shapes/dtypes (the scan carry); constants ride in its closure.
+    Feeding outputs back as inputs makes every iteration depend on the
+    previous one (XLA cannot hoist or elide the body) without adding
+    any memory traffic to either side of a Pallas/XLA comparison.
+    """
+
+    def body(c, _):
+        return tuple(step(*c)), None
+
+    @jax.jit
+    def chained(*init):
+        final, _ = lax.scan(body, tuple(init), None, length=iters)
+        # collapse to one scalar so the closing sync transfers O(1) bytes
+        return jnp.real(jax.tree_util.tree_leaves(final)[0].reshape(-1)[0])
+
+    chained(*init).block_until_ready()        # compile
+    # one timed dispatch; sync via host transfer (axon block_until_ready
+    # is a weak sync — the host transfer is the reliable barrier)
+    t0 = time.perf_counter()
+    float(chained(*init))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def flash_inputs(b=2, s=2048, h=8, d=128, dtype=jnp.bfloat16):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, s, h, d), dtype)
+    k = jnp.asarray(rs.randn(b, s, h, d), dtype)
+    v = jnp.asarray(rs.randn(b, s, h, d), dtype)
+    return q, k, v
+
+
+def _attn_steps(attn_fn):
+    """fwd chains out->q; bwd chains (dq,dk,dv)->(q,k,v)."""
+
+    def fwd(q, k, v):
+        return attn_fn(q, k, v), k, v
+
+    g = jax.grad(lambda q, k, v: jnp.sum(attn_fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))
+
+    def bwd(q, k, v):
+        return g(q, k, v)
+
+    return fwd, bwd
+
+
+def compare(iters=20):
+    from paddle_tpu.kernels import flash_attention
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+
+    q, k, v = flash_inputs()
+    pf, pb = _attn_steps(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=False))
+    xf, xb = _attn_steps(lambda q, k, v: sdpa_reference(
+        q, k, v, is_causal=True, training=False).astype(q.dtype))
+    for name, f in [("pallas_fwd", pf), ("xla_fwd", xf),
+                    ("pallas_bwd", pb), ("xla_bwd", xb)]:
+        print(f"{name:14s} {timeit_chain(f, (q, k, v), iters=iters):8.3f} ms",
+              flush=True)
+
+
+def sweep(iters=20):
+    from paddle_tpu.kernels import flash_attention
+
+    q, k, v = flash_inputs()
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512, 1024):
+            f, _ = _attn_steps(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                interpret=False))
+            try:
+                ms = timeit_chain(f, (q, k, v), iters=iters)
+                print(f"bq={bq:4d} bk={bk:4d}  {ms:8.3f} ms", flush=True)
+            except Exception as e:
+                print(f"bq={bq:4d} bk={bk:4d}  ERROR {repr(e)[:120]}",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "compare"
+    print("devices:", jax.devices(), flush=True)
+    if mode == "compare":
+        compare()
+    elif mode == "sweep":
+        sweep()
