@@ -1,0 +1,258 @@
+"""paddle_tpu.geometric — graph-learning primitives.
+
+Parity namespace for the reference's ``paddle.geometric``
+(python/paddle/geometric/: message_passing/send_recv.py,
+message_passing/send_uv.py, math.py segment ops, sampling/neighbors.py,
+reindex.py).
+
+TPU-native design notes
+-----------------------
+* The message-passing ops (``send_u_recv`` / ``send_ue_recv`` /
+  ``send_uv``) are gather + segment-reduce compositions: XLA lowers the
+  gather and the sorted/unsorted segment reduction to fused dynamic-slice
+  / scatter-add loops that tile well on TPU.  Under ``jit``, pass
+  ``out_size`` (a static int) so the output shape is static; the eager
+  path derives it from ``dst_index`` like the reference's kernels do.
+* The sampling/reindex ops are host-side graph-preprocessing utilities in
+  the reference (CPU kernels driving the GPU trainer); here they are
+  plain numpy on host, feeding device steps with static shapes.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..incubate import (_segment_reduce, segment_max, segment_mean,
+                        segment_min, segment_sum)
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "sample_neighbors", "weighted_sample_neighbors",
+    "reindex_graph", "reindex_heter_graph",
+]
+
+_MESSAGE_OPS = ("add", "sub", "mul", "div")
+_REDUCE_OPS = ("sum", "mean", "max", "min")
+
+
+def _reduce_onto(msg, dst, out_size, reduce_op):
+    """Reduce per-edge messages onto destination rows.  out_size=None
+    derives the row count from dst (eager only).  Absent destinations are
+    0 for every reduce_op — incubate._segment_reduce implements those
+    reference semantics; sum delegates to segment_sum."""
+    n = None if out_size is None else int(out_size)
+    if reduce_op == "sum":
+        return segment_sum(msg, dst, num_segments=n)
+    return _segment_reduce(msg, dst, reduce_op, num_segments=n)
+
+
+def _combine(a, b, message_op):
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(
+            f"message_op must be one of {_MESSAGE_OPS}, got {message_op!r}")
+    if message_op == "add":
+        return a + b
+    if message_op == "sub":
+        return a - b
+    if message_op == "mul":
+        return a * b
+    return a / b
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather ``x[src_index]`` and reduce onto ``dst_index`` rows.
+
+    Reference: python/paddle/geometric/message_passing/send_recv.py —
+    ``send_u_recv`` (graph_send_recv op).  ``out_size`` must be a static
+    int under jit; eager derives it from ``dst_index``.
+    """
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(
+            f"reduce_op must be one of {_REDUCE_OPS}, got {reduce_op!r}")
+    x = jnp.asarray(x)
+    src = jnp.asarray(src_index, jnp.int32)
+    dst = jnp.asarray(dst_index, jnp.int32)
+    return _reduce_onto(jnp.take(x, src, axis=0), dst, out_size, reduce_op)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Per-edge message ``x[src] (message_op) y`` reduced onto dst rows.
+
+    ``y`` holds edge features (one row per edge, broadcastable against the
+    gathered node features).  Reference: send_recv.py — ``send_ue_recv``
+    (graph_send_ue_recv op).
+    """
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(
+            f"reduce_op must be one of {_REDUCE_OPS}, got {reduce_op!r}")
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    src = jnp.asarray(src_index, jnp.int32)
+    dst = jnp.asarray(dst_index, jnp.int32)
+    msg = _combine(jnp.take(x, src, axis=0), y, message_op)
+    return _reduce_onto(msg, dst, out_size, reduce_op)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge combination of source and destination node features:
+    ``x[src] (message_op) y[dst]`` — one output row per edge.
+
+    Reference: python/paddle/geometric/message_passing/send_uv.py
+    (graph_send_uv op).
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    src = jnp.asarray(src_index, jnp.int32)
+    dst = jnp.asarray(dst_index, jnp.int32)
+    return _combine(jnp.take(x, src, axis=0), jnp.take(y, dst, axis=0),
+                    message_op)
+
+
+# ---------------------------------------------------------------------------
+# sampling + reindex (host-side preprocessing, numpy)
+# ---------------------------------------------------------------------------
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weight):
+    """Shared CSC neighbor-sampling body (uniform when weight is None,
+    else probability proportional to weight, without replacement).
+
+    Weighted selection uses Efraimidis–Spirakis keys (key = u^(1/w),
+    take the top ``sample_size``): zero-weight edges get a negative key
+    so they are only chosen when there are fewer positive-weight edges
+    than requested — matching the reference's weighted-reservoir kernel,
+    which always returns ``sample_size`` items.
+    """
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    nodes = np.atleast_1d(np.asarray(input_nodes))
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    eids_np = None if eids is None else np.asarray(eids)
+    w = None if weight is None else np.asarray(weight, np.float64)
+
+    rng = np.random.default_rng()
+    out_neighbors, out_eids, counts = [], [], np.empty(len(nodes), np.int64)
+    for i, node in enumerate(nodes):
+        beg, end = int(colptr[node]), int(colptr[node + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            take = np.arange(beg, end)
+        elif w is None:
+            take = beg + rng.choice(deg, size=sample_size, replace=False)
+        else:
+            pw = np.maximum(w[beg:end], 0.0)
+            u = rng.random(deg)
+            # Efraimidis–Spirakis keys for positive weights; zero-weight
+            # edges get a negative (randomly ordered) key so they rank
+            # below every positive-weight edge and only fill the sample
+            # when positive-weight edges run out
+            keys = np.where(pw > 0,
+                            u ** (1.0 / np.where(pw > 0, pw, 1.0)), -u)
+            take = beg + np.argsort(-keys, kind="stable")[:sample_size]
+        counts[i] = take.size
+        out_neighbors.append(row[take])
+        if return_eids:
+            out_eids.append(eids_np[take])
+    neigh = (np.concatenate(out_neighbors) if out_neighbors
+             else np.empty((0,), row.dtype))
+    if return_eids:
+        e = (np.concatenate(out_eids) if out_eids
+             else np.empty((0,), eids_np.dtype))
+        return neigh, counts, e
+    return neigh, counts
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors of each input
+    node from a CSC graph (``row`` = neighbor ids, ``colptr`` = per-node
+    offsets into row).
+
+    Returns ``(out_neighbors, out_count)`` — the sampled neighbor ids
+    (flat) and the per-input-node counts — plus the sampled edge ids when
+    ``return_eids`` (requires ``eids``).  Reference:
+    python/paddle/geometric/sampling/neighbors.py — ``sample_neighbors``
+    (graph_sample_neighbors op).  Host op: runs in numpy; feed results to
+    ``reindex_graph`` to build the device-side subgraph.
+    """
+    return _sample(row, colptr, input_nodes, sample_size, eids,
+                   return_eids, weight=None)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling without replacement (probability
+    proportional to ``edge_weight``; zero-weight edges fill in only when
+    positive-weight edges run out).  Reference: sampling/neighbors.py —
+    ``weighted_sample_neighbors`` (weighted_sample_neighbors op).
+    """
+    return _sample(row, colptr, input_nodes, sample_size, eids,
+                   return_eids, weight=edge_weight)
+
+
+def _build_mapping(x, flat):
+    """Contiguous local ids: x first (in order), then unseen neighbor ids
+    in first-appearance order.  Returns (out_nodes, reindex_src)."""
+    mapping = {}
+    for v in x.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    for v in flat.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    out_nodes = np.fromiter(mapping.keys(), dtype=x.dtype,
+                            count=len(mapping))
+    reindex_src = np.array([mapping[int(v)] for v in flat.tolist()],
+                           dtype=np.int64)
+    return out_nodes, reindex_src
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Map sampled node ids to contiguous local ids: input nodes first,
+    then new neighbors in first-appearance order.  Returns
+    ``(reindex_src, reindex_dst, out_nodes)``.
+
+    Reference: python/paddle/geometric/reindex.py — ``reindex_graph``
+    (graph_reindex op).  The hashtable buffers are a GPU concern; ignored
+    here (host numpy).
+    """
+    x = np.asarray(x)
+    flat = np.asarray(neighbors)
+    counts = np.asarray(count)
+    if counts.sum() != flat.size:
+        raise ValueError(
+            f"count sums to {counts.sum()} but neighbors has {flat.size} "
+            "entries")
+    out_nodes, reindex_src = _build_mapping(x, flat)
+    # dst edge endpoint i is repeated count[i] times (CSC expansion)
+    reindex_dst = np.repeat(np.arange(len(x), dtype=np.int64), counts)
+    return reindex_src, reindex_dst, out_nodes
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: ``neighbors``/``count`` are per-edge-type
+    lists sharing one id space.  Same contract as the reference's
+    ``reindex_heter_graph``: one mapping over all types, per-type edge
+    arrays concatenated in type order.
+    """
+    x = np.asarray(x)
+    neighbors = [np.asarray(n) for n in neighbors]
+    counts = [np.asarray(c) for c in count]
+    flat = (np.concatenate(neighbors) if neighbors
+            else np.empty((0,), np.int64))
+    allc = (np.concatenate(counts) if counts
+            else np.empty((0,), np.int64))
+    if allc.sum() != flat.size:
+        raise ValueError(
+            f"count sums to {allc.sum()} but neighbors has {flat.size} "
+            "entries")
+    out_nodes, reindex_src = _build_mapping(x, flat)
+    dsts = [np.repeat(np.arange(len(c), dtype=np.int64), c) for c in counts]
+    reindex_dst = (np.concatenate(dsts) if dsts
+                   else np.empty((0,), np.int64))
+    return reindex_src, reindex_dst, out_nodes

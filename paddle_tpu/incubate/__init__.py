@@ -12,23 +12,27 @@ from . import nn  # noqa: F401
 # --- round-3 op-coverage additions (reference: python/paddle/incubate/
 # tensor/math.py segment ops + operators/softmax_mask_fuse*.py) -----------
 
-def segment_sum(data, segment_ids, name=None):
+def segment_sum(data, segment_ids, name=None, num_segments=None):
     """Sum rows with equal segment id (reference: incubate.segment_sum;
     output has max(segment_ids)+1 rows — eager computes it from the data,
-    traced callers should prefer jax.ops.segment_sum with num_segments)."""
+    traced callers pass ``num_segments`` for a static output shape)."""
     import jax
     import jax.numpy as jnp
     ids = jnp.asarray(segment_ids, jnp.int32)
-    n = int(jnp.max(ids)) + 1
+    n = int(jnp.max(ids)) + 1 if num_segments is None else int(num_segments)
     return jax.ops.segment_sum(jnp.asarray(data), ids, num_segments=n)
 
 
-def _segment_reduce(data, segment_ids, kind):
+def _segment_reduce(data, segment_ids, kind, num_segments=None):
+    """Shared segment mean/max/min with the reference's absent-segment
+    semantics (untouched output rows are 0, not the reduction identity).
+    ``num_segments`` makes the output shape static for jit callers
+    (paddle_tpu.geometric reuses this for its message-passing reduces)."""
     import jax
     import jax.numpy as jnp
     data = jnp.asarray(data)
     ids = jnp.asarray(segment_ids, jnp.int32)
-    n = int(jnp.max(ids)) + 1
+    n = int(jnp.max(ids)) + 1 if num_segments is None else int(num_segments)
     counts = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.float32),
                                  ids, num_segments=n)
     present = (counts > 0).reshape((n,) + (1,) * (data.ndim - 1))
@@ -43,16 +47,16 @@ def _segment_reduce(data, segment_ids, kind):
     return jnp.where(present, out, jnp.zeros((), data.dtype))
 
 
-def segment_mean(data, segment_ids, name=None):
-    return _segment_reduce(data, segment_ids, "mean")
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    return _segment_reduce(data, segment_ids, "mean", num_segments)
 
 
-def segment_max(data, segment_ids, name=None):
-    return _segment_reduce(data, segment_ids, "max")
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    return _segment_reduce(data, segment_ids, "max", num_segments)
 
 
-def segment_min(data, segment_ids, name=None):
-    return _segment_reduce(data, segment_ids, "min")
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    return _segment_reduce(data, segment_ids, "min", num_segments)
 
 
 def softmax_mask_fuse(x, mask, name=None):

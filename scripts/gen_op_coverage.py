@@ -245,6 +245,12 @@ PADDLE_NN_QUANT = """
 weight_quantize weight_dequantize weight_only_linear llm_int8_linear
 """
 
+PADDLE_GEOMETRIC = """
+send_u_recv send_ue_recv send_uv segment_sum segment_mean segment_max
+segment_min sample_neighbors weighted_sample_neighbors reindex_graph
+reindex_heter_graph
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -271,6 +277,7 @@ REFERENCE = {
     "paddle.vision.ops": PADDLE_VISION_OPS,
     "paddle.quantization": PADDLE_QUANTIZATION,
     "paddle.nn.quant": PADDLE_NN_QUANT,
+    "paddle.geometric": PADDLE_GEOMETRIC,
 }
 
 # repo namespace that answers for each reference namespace
@@ -300,6 +307,7 @@ TARGETS = {
     "paddle.vision.ops": "paddle_tpu.vision.ops",
     "paddle.quantization": "paddle_tpu.quantization",
     "paddle.nn.quant": "paddle_tpu.nn.quant",
+    "paddle.geometric": "paddle_tpu.geometric",
 }
 
 

@@ -1,0 +1,230 @@
+"""paddle.geometric parity: message passing + segment reduce + sampling.
+
+Oracles are plain numpy recomputations of the reference semantics
+(python/paddle/geometric/): gather-by-src, combine with edge/dst
+features, scatter-reduce onto dst with absent-destination rows = 0.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import geometric as G
+
+
+def _np_scatter_reduce(msg, dst, n, op):
+    out = np.zeros((n,) + msg.shape[1:], np.float64)
+    touched = np.zeros(n, bool)
+    for e, d in enumerate(dst):
+        if not touched[d]:
+            out[d] = msg[e]
+            touched[d] = True
+        elif op == "sum" or op == "mean":
+            out[d] += msg[e]
+        elif op == "max":
+            out[d] = np.maximum(out[d], msg[e])
+        elif op == "min":
+            out[d] = np.minimum(out[d], msg[e])
+    if op == "mean":
+        cnt = np.bincount(dst, minlength=n).reshape(
+            (n,) + (1,) * (msg.ndim - 1))
+        out = out / np.maximum(cnt, 1)
+    return out
+
+
+@pytest.fixture
+def graph():
+    rs = np.random.RandomState(7)
+    num_nodes, num_edges, f = 10, 40, 8
+    x = rs.randn(num_nodes, f).astype(np.float32)
+    src = rs.randint(0, num_nodes, num_edges).astype(np.int64)
+    dst = rs.randint(0, num_nodes, num_edges).astype(np.int64)
+    return x, src, dst
+
+
+@pytest.mark.parametrize("reduce_op", ["sum", "mean", "max", "min"])
+def test_send_u_recv(graph, reduce_op):
+    x, src, dst = graph
+    got = np.asarray(G.send_u_recv(x, src, dst, reduce_op=reduce_op,
+                                   out_size=x.shape[0]))
+    want = _np_scatter_reduce(x[src], dst, x.shape[0], reduce_op)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_send_u_recv_absent_dst_rows_are_zero():
+    x = np.array([[1.0, -2.0], [3.0, 4.0]], np.float32)
+    src = np.array([0, 1])
+    dst = np.array([2, 2])  # rows 0,1,3 untouched
+    for op in ("sum", "mean", "max", "min"):
+        got = np.asarray(G.send_u_recv(x, src, dst, reduce_op=op, out_size=4))
+        assert got.shape == (4, 2)
+        np.testing.assert_array_equal(got[[0, 1, 3]], 0.0)
+
+
+def test_send_u_recv_eager_out_size_from_dst(graph):
+    x, src, dst = graph
+    got = G.send_u_recv(x, src, dst)
+    assert got.shape[0] == int(dst.max()) + 1
+
+
+@pytest.mark.parametrize("message_op", ["add", "sub", "mul", "div"])
+def test_send_ue_recv(graph, message_op):
+    x, src, dst = graph
+    rs = np.random.RandomState(3)
+    y = (rs.rand(len(src), x.shape[1]).astype(np.float32) + 0.5)  # no /0
+    got = np.asarray(G.send_ue_recv(x, y, src, dst, message_op=message_op,
+                                    reduce_op="sum", out_size=x.shape[0]))
+    m = {"add": x[src] + y, "sub": x[src] - y,
+         "mul": x[src] * y, "div": x[src] / y}[message_op]
+    want = _np_scatter_reduce(m, dst, x.shape[0], "sum")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_send_ue_recv_edge_broadcast(graph):
+    x, src, dst = graph
+    y = np.arange(1, len(src) + 1, dtype=np.float32).reshape(-1, 1)
+    got = np.asarray(G.send_ue_recv(x, y, src, dst, "mul", "sum",
+                                    out_size=x.shape[0]))
+    want = _np_scatter_reduce(x[src] * y, dst, x.shape[0], "sum")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_send_uv(graph):
+    x, src, dst = graph
+    y = np.random.RandomState(1).randn(*x.shape).astype(np.float32)
+    got = np.asarray(G.send_uv(x, y, src, dst, message_op="mul"))
+    np.testing.assert_allclose(got, x[src] * y[dst], rtol=1e-5, atol=1e-6)
+
+
+def test_send_u_recv_jit_and_grad(graph):
+    x, src, dst = graph
+    n = x.shape[0]
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(G.send_u_recv(x, src, dst, "sum", out_size=n) ** 2)
+
+    g = jax.grad(f)(jnp.asarray(x))
+    # numeric check on one coordinate
+    eps = 1e-3
+    xp = x.copy()
+    xp[2, 3] += eps
+    xm = x.copy()
+    xm[2, 3] -= eps
+    num = (float(f(jnp.asarray(xp))) - float(f(jnp.asarray(xm)))) / (2 * eps)
+    assert abs(float(g[2, 3]) - num) < 5e-2 * max(1.0, abs(num))
+
+
+def test_segment_ops_reexported():
+    data = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+    ids = np.array([0, 0, 2])
+    s = np.asarray(G.segment_sum(data, ids))
+    np.testing.assert_allclose(s[0], [4.0, 6.0])
+    np.testing.assert_allclose(s[1], [0.0, 0.0])  # absent segment -> 0
+    np.testing.assert_allclose(np.asarray(G.segment_mean(data, ids))[0],
+                               [2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(G.segment_max(data, ids))[0],
+                               [3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(G.segment_min(data, ids))[2],
+                               [5.0, 6.0])
+
+
+def _csc(num_nodes, edges):
+    """edges = list of (src_neighbor, dst_node) -> CSC (row, colptr)."""
+    by_dst = [[] for _ in range(num_nodes)]
+    for s, d in edges:
+        by_dst[d].append(s)
+    row, colptr = [], [0]
+    for d in range(num_nodes):
+        row.extend(by_dst[d])
+        colptr.append(len(row))
+    return np.asarray(row, np.int64), np.asarray(colptr, np.int64)
+
+
+def test_sample_neighbors_full_and_capped():
+    edges = [(1, 0), (2, 0), (3, 0), (4, 0), (0, 1), (2, 1), (3, 4)]
+    row, colptr = _csc(5, edges)
+    neigh, cnt = G.sample_neighbors(row, colptr, np.array([0, 1, 2]),
+                                    sample_size=-1)
+    assert list(cnt) == [4, 2, 0]
+    assert sorted(neigh[:4].tolist()) == [1, 2, 3, 4]
+    assert sorted(neigh[4:6].tolist()) == [0, 2]
+
+    neigh2, cnt2 = G.sample_neighbors(row, colptr, np.array([0]),
+                                      sample_size=2)
+    assert list(cnt2) == [2]
+    assert set(neigh2.tolist()) <= {1, 2, 3, 4}
+    assert len(set(neigh2.tolist())) == 2  # without replacement
+
+
+def test_sample_neighbors_eids():
+    edges = [(1, 0), (2, 0), (0, 1)]
+    row, colptr = _csc(3, edges)
+    eids = np.array([100, 101, 102])
+    neigh, cnt, got_eids = G.sample_neighbors(
+        row, colptr, np.array([0, 1]), sample_size=-1, eids=eids,
+        return_eids=True)
+    assert list(cnt) == [2, 1]
+    assert sorted(got_eids[:2].tolist()) == [100, 101]
+    assert got_eids[2] == 102
+    with pytest.raises(ValueError):
+        G.sample_neighbors(row, colptr, np.array([0]), return_eids=True)
+
+
+def test_weighted_sample_neighbors_prefers_heavy_edges():
+    # node 0 has 4 neighbors; one carries ~all the weight
+    edges = [(1, 0), (2, 0), (3, 0), (4, 0)]
+    row, colptr = _csc(5, edges)
+    w = np.array([1e6, 1e-6, 1e-6, 1e-6])
+    hits = 0
+    for _ in range(20):
+        neigh, cnt = G.weighted_sample_neighbors(
+            row, colptr, w, np.array([0]), sample_size=1)
+        assert cnt[0] == 1
+        hits += int(neigh[0] == 1)
+    assert hits >= 18  # overwhelming probability mass on neighbor 1
+
+
+def test_weighted_sample_zero_weight_edges_fill_last():
+    # 4 neighbors, only one positive-weight; sample_size=2 must not crash
+    # (review finding: Generator.choice(p=...) raised with fewer non-zero
+    # p entries than size) and must always include the positive edge
+    edges = [(1, 0), (2, 0), (3, 0), (4, 0)]
+    row, colptr = _csc(5, edges)
+    w = np.array([5.0, 0.0, 0.0, 0.0])
+    seen_fill = set()
+    for _ in range(10):
+        neigh, cnt = G.weighted_sample_neighbors(
+            row, colptr, w, np.array([0]), sample_size=2)
+        assert cnt[0] == 2
+        got = set(neigh.tolist())
+        assert 1 in got                      # the positive-weight edge
+        seen_fill |= got - {1}
+    assert seen_fill <= {2, 3, 4} and seen_fill  # zero-weight edges fill
+
+
+def test_reindex_graph():
+    x = np.array([10, 20, 30])
+    neighbors = np.array([20, 40, 30, 50, 40])
+    count = np.array([2, 2, 1])
+    src, dst, nodes = G.reindex_graph(x, neighbors, count)
+    # input nodes keep order 10,20,30 -> 0,1,2; new: 40 -> 3, 50 -> 4
+    np.testing.assert_array_equal(nodes, [10, 20, 30, 40, 50])
+    np.testing.assert_array_equal(src, [1, 3, 2, 4, 3])
+    np.testing.assert_array_equal(dst, [0, 0, 1, 1, 2])
+    with pytest.raises(ValueError):
+        G.reindex_graph(x, neighbors, np.array([1, 1, 1]))
+
+
+def test_reindex_heter_graph():
+    x = np.array([10, 20])
+    n1 = np.array([20, 30])   # type-A neighbors of [10, 20]
+    c1 = np.array([1, 1])
+    n2 = np.array([30, 40])   # type-B neighbors of [10, 20]
+    c2 = np.array([1, 1])
+    src, dst, nodes = G.reindex_heter_graph(x, [n1, n2], [c1, c2])
+    np.testing.assert_array_equal(nodes, [10, 20, 30, 40])
+    np.testing.assert_array_equal(src, [1, 2, 2, 3])
+    np.testing.assert_array_equal(dst, [0, 1, 0, 1])
