@@ -221,6 +221,12 @@ def _run_bench(on_tpu, tpu_diag=None):
             "mfu": ev.get("mfu"),
             "tokens_per_sec_per_chip": ev.get("tokens_per_sec_per_chip"),
             "n_params": ev.get("config", {}).get("n_params"),
+            "kernel_compare_rows": sorted(
+                k for k, v in (ev.get("kernel_compare") or {}).items()
+                if isinstance(v, dict) and "error" not in v),
+            "secondary_tpu_rows": sorted(
+                k for k, v in (ev.get("secondary_tpu") or {}).items()
+                if isinstance(v, dict) and "step_ms" in v),
         }
     value, vs_baseline = round(tokens_per_sec, 1), round(mfu / 0.45, 4)
     if not on_tpu and ev:

@@ -146,13 +146,17 @@ def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
         else:
             pw = np.maximum(w[beg:end], 0.0)
             u = rng.random(deg)
-            # Efraimidis–Spirakis keys for positive weights; zero-weight
-            # edges get a negative (randomly ordered) key so they rank
-            # below every positive-weight edge and only fill the sample
-            # when positive-weight edges run out
-            keys = np.where(pw > 0,
-                            u ** (1.0 / np.where(pw > 0, pw, 1.0)), -u)
-            take = beg + np.argsort(-keys, kind="stable")[:sample_size]
+            pos = pw > 0
+            # Efraimidis–Spirakis in LOG space (u**(1/w) underflows to a
+            # deterministic all-zero tie for w below ~1e-3): E-S picks the
+            # largest u**(1/w) <=> the smallest -log(u)/w.  lexsort's last
+            # key is primary: positive-weight edges first (by the E-S
+            # order), zero-weight edges after (randomly ordered by u) so
+            # they only fill the sample when positives run out
+            sec = np.where(pos,
+                           -np.log(np.maximum(u, 1e-300))
+                           / np.where(pos, pw, 1.0), u)
+            take = beg + np.lexsort((sec, ~pos))[:sample_size]
         counts[i] = take.size
         out_neighbors.append(row[take])
         if return_eids:
@@ -197,16 +201,19 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
 
 def _build_mapping(x, flat):
     """Contiguous local ids: x first (in order), then unseen neighbor ids
-    in first-appearance order.  Returns (out_nodes, reindex_src)."""
-    mapping = {}
-    for v in x.tolist():
-        mapping.setdefault(int(v), len(mapping))
-    for v in flat.tolist():
-        mapping.setdefault(int(v), len(mapping))
-    out_nodes = np.fromiter(mapping.keys(), dtype=x.dtype,
-                            count=len(mapping))
-    reindex_src = np.array([mapping[int(v)] for v in flat.tolist()],
-                           dtype=np.int64)
+    in first-appearance order.  Returns (out_nodes, reindex_src).
+
+    Vectorized (np.unique + first-appearance ranking) — sampled batches
+    carry 1e5–1e7 edges per step and a per-edge Python loop would stall
+    the device on host preprocessing."""
+    all_ids = np.concatenate([x, flat]) if flat.size else x
+    _, first_idx, inverse = np.unique(all_ids, return_index=True,
+                                      return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order))
+    out_nodes = all_ids[np.sort(first_idx)].astype(x.dtype, copy=False)
+    reindex_src = rank[inverse][len(x):]
     return out_nodes, reindex_src
 
 
@@ -251,8 +258,13 @@ def reindex_heter_graph(x, neighbors, count, value_buffer=None,
         raise ValueError(
             f"count sums to {allc.sum()} but neighbors has {flat.size} "
             "entries")
+    for i, c in enumerate(counts):
+        if len(c) != len(x):
+            raise ValueError(
+                f"count[{i}] has {len(c)} entries but x has {len(x)} "
+                "nodes (one count per input node per edge type)")
     out_nodes, reindex_src = _build_mapping(x, flat)
-    dsts = [np.repeat(np.arange(len(c), dtype=np.int64), c) for c in counts]
+    dsts = [np.repeat(np.arange(len(x), dtype=np.int64), c) for c in counts]
     reindex_dst = (np.concatenate(dsts) if dsts
                    else np.empty((0,), np.int64))
     return reindex_src, reindex_dst, out_nodes

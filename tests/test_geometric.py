@@ -205,6 +205,29 @@ def test_weighted_sample_zero_weight_edges_fill_last():
     assert seen_fill <= {2, 3, 4} and seen_fill  # zero-weight edges fill
 
 
+def test_weighted_sample_tiny_equal_weights_not_index_biased():
+    # u**(1/w) underflows to an all-zero tie for w < ~1e-3, which made the
+    # old implementation deterministically return the first k edges; the
+    # log-space keys must keep equal weights ~uniform
+    row = np.arange(100)
+    colptr = np.array([0, 100])
+    w = np.full(100, 1e-6)
+    seen = set()
+    for _ in range(30):
+        n, c = G.weighted_sample_neighbors(row, colptr, w, np.array([0]),
+                                           sample_size=3)
+        assert c[0] == 3
+        seen |= set(n.tolist())
+    assert len(seen) > 20
+
+
+def test_reindex_heter_graph_misaligned_count_raises():
+    with pytest.raises(ValueError):
+        G.reindex_heter_graph(np.array([10, 20]),
+                              [np.array([20, 30, 40])],
+                              [np.array([1, 1, 1])])
+
+
 def test_reindex_graph():
     x = np.array([10, 20, 30])
     neighbors = np.array([20, 40, 30, 50, 40])
