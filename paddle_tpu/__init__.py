@@ -105,7 +105,7 @@ _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
          "inference", "device", "ops", "fft", "distribution",
          "signal", "regularizer", "utils", "onnx", "compat",
-         "quantization", "geometric"}
+         "quantization", "geometric", "hub"}
 
 
 def __getattr__(name):

@@ -9,7 +9,24 @@ from typing import Optional
 import jax.numpy as jnp
 
 __all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
-           "power_to_db", "create_dct"]
+           "power_to_db", "create_dct", "fft_frequencies",
+           "mel_frequencies"]
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Center frequencies of rFFT bins: linspace(0, sr/2, 1 + n_fft//2)
+    (reference: audio/functional/functional.py fft_frequencies)."""
+    return jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """``n_mels`` frequencies evenly spaced on the mel scale between
+    ``f_min`` and ``f_max`` (reference: functional.py mel_frequencies)."""
+    mels = jnp.linspace(hz_to_mel(f_min, htk=htk),
+                        hz_to_mel(f_max, htk=htk), n_mels)
+    return mel_to_hz(mels, htk=htk).astype(dtype)
 
 
 def get_window(window: str, win_length: int, fftbins: bool = True):
@@ -61,11 +78,8 @@ def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
                          htk: bool = False, norm: str = "slaney"):
     """[n_mels, n_fft//2 + 1] triangular mel filterbank."""
     f_max = f_max if f_max is not None else sr / 2.0
-    n_bins = n_fft // 2 + 1
-    fft_freqs = jnp.linspace(0.0, sr / 2.0, n_bins)
-    mel_pts = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
-                           n_mels + 2)
-    hz_pts = mel_to_hz(mel_pts, htk)
+    fft_freqs = fft_frequencies(sr, n_fft)
+    hz_pts = mel_frequencies(n_mels + 2, f_min, f_max, htk)
     lower = hz_pts[:-2][:, None]
     center = hz_pts[1:-1][:, None]
     upper = hz_pts[2:][:, None]

@@ -1,0 +1,98 @@
+"""paddle_tpu.hub — hubconf-driven model loading.
+
+Parity namespace for the reference's ``paddle.hub`` (python/paddle/hub.py):
+a repo directory exposes entrypoint callables in a ``hubconf.py``;
+``list``/``help``/``load`` discover, document, and invoke them.
+
+``source='local'`` is fully supported (the contract is a directory on
+disk).  ``'github'``/``'gitee'`` need network access — this environment is
+zero-egress, so they raise a clear error pointing at the local workflow
+instead of hanging on a dead socket.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {_HUBCONF} in {repo_dir!r} — a hub repo directory must "
+            "define its entrypoints there (reference: paddle.hub)")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(path)))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    # hubconf may import siblings from its repo dir
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(mod, "dependencies", None)
+    if deps:
+        missing = [d for d in deps
+                   if importlib.util.find_spec(d) is None]
+        if missing:
+            raise RuntimeError(
+                f"hubconf at {repo_dir!r} requires missing packages: "
+                f"{missing}")
+    return mod
+
+
+def _check_source(source: str):
+    if source == "local":
+        return
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"source={source!r} needs network access, which this "
+            "environment does not have; clone the repo yourself and use "
+            "source='local' with the checkout directory")
+    raise ValueError(
+        f"source must be 'github', 'gitee' or 'local', got {source!r}")
+
+
+def _entrypoints(mod):
+    return {name: fn for name, fn in vars(mod).items()
+            if callable(fn) and not name.startswith("_")}
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entrypoint names exported by the repo's hubconf.py.
+
+    Reference: python/paddle/hub.py — ``list``.
+    """
+    _check_source(source)
+    return sorted(_entrypoints(_load_hubconf(repo_dir)))
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """Docstring of one entrypoint.  Reference: hub.py — ``help``."""
+    _check_source(source)
+    eps = _entrypoints(_load_hubconf(repo_dir))
+    if model not in eps:
+        raise ValueError(
+            f"unknown entrypoint {model!r}; available: {sorted(eps)}")
+    return eps[model].__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Invoke one entrypoint and return its result (typically a Layer).
+
+    Reference: hub.py — ``load``.
+    """
+    _check_source(source)
+    eps = _entrypoints(_load_hubconf(repo_dir))
+    if model not in eps:
+        raise ValueError(
+            f"unknown entrypoint {model!r}; available: {sorted(eps)}")
+    return eps[model](**kwargs)

@@ -251,6 +251,33 @@ segment_min sample_neighbors weighted_sample_neighbors reindex_graph
 reindex_heter_graph
 """
 
+PADDLE_AUDIO_FEATURES = """
+LogMelSpectrogram MFCC MelSpectrogram Spectrogram
+"""
+
+PADDLE_AUDIO_FUNCTIONAL = """
+compute_fbank_matrix create_dct fft_frequencies get_window hz_to_mel
+mel_frequencies mel_to_hz power_to_db
+"""
+
+PADDLE_TEXT = """
+Conll05st Imdb Imikolov Movielens UCIHousing ViterbiDecoder WMT14 WMT16
+viterbi_decode
+"""
+
+PADDLE_HUB = """
+help list load
+"""
+
+PADDLE_STATIC_NN = """
+case cond switch_case while_loop
+"""
+
+PADDLE_DISTRIBUTED_FLEET = """
+DistributedStrategy barrier_worker distributed_model distributed_optimizer
+init is_first_worker worker_index worker_num
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -278,6 +305,12 @@ REFERENCE = {
     "paddle.quantization": PADDLE_QUANTIZATION,
     "paddle.nn.quant": PADDLE_NN_QUANT,
     "paddle.geometric": PADDLE_GEOMETRIC,
+    "paddle.audio.features": PADDLE_AUDIO_FEATURES,
+    "paddle.audio.functional": PADDLE_AUDIO_FUNCTIONAL,
+    "paddle.text": PADDLE_TEXT,
+    "paddle.hub": PADDLE_HUB,
+    "paddle.static.nn": PADDLE_STATIC_NN,
+    "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
 }
 
 # repo namespace that answers for each reference namespace
@@ -308,6 +341,12 @@ TARGETS = {
     "paddle.quantization": "paddle_tpu.quantization",
     "paddle.nn.quant": "paddle_tpu.nn.quant",
     "paddle.geometric": "paddle_tpu.geometric",
+    "paddle.audio.features": "paddle_tpu.audio.features",
+    "paddle.audio.functional": "paddle_tpu.audio.functional",
+    "paddle.text": "paddle_tpu.text",
+    "paddle.hub": "paddle_tpu.hub",
+    "paddle.static.nn": "paddle_tpu.static.nn",
+    "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
 }
 
 
@@ -328,9 +367,17 @@ def main():
         tmod_name = TARGETS[ns]
         try:
             tmod = __import__(tmod_name, fromlist=["x"])
-        except Exception as e:
-            out.append(f"## {ns} -> {tmod_name}: IMPORT FAILED: {e}")
-            continue
+        except Exception as e1:
+            # namespaces exposed as attributes rather than submodules
+            # (e.g. paddle_tpu.static.nn): import the parent, getattr down
+            try:
+                parent, _, leaf = tmod_name.rpartition(".")
+                tmod = getattr(__import__(parent, fromlist=["x"]), leaf)
+            except Exception as e2:
+                msg = f"IMPORT FAILED: {e1!r}; attribute fallback: {e2!r}"
+                out.append(f"## {ns} -> {tmod_name}: {msg}")
+                print(f"  {ns}: {msg}")
+                continue
         missing = [n for n in names if not hasattr(tmod, n)]
         have = len(names) - len(missing)
         total_ref += len(names)

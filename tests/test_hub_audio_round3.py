@@ -1,0 +1,89 @@
+"""paddle.hub local-source workflow + audio.functional frequency grids."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import hub
+from paddle_tpu.audio.functional import (fft_frequencies, hz_to_mel,
+                                         mel_frequencies)
+
+
+@pytest.fixture
+def hub_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent("""
+        dependencies = ["numpy"]
+
+        from helpers import HIDDEN
+
+
+        def tiny_mlp(hidden=HIDDEN):
+            \"\"\"A two-layer MLP entrypoint.\"\"\"
+            import paddle_tpu.nn as nn
+            return nn.Sequential(nn.Linear(4, hidden), nn.ReLU(),
+                                 nn.Linear(hidden, 2))
+
+
+        def _private_helper():
+            return None
+    """))
+    # hubconf may import siblings from its own repo dir
+    (tmp_path / "helpers.py").write_text("HIDDEN = 8\n")
+    return str(tmp_path)
+
+
+def test_hub_list_and_help(hub_repo):
+    assert hub.list(hub_repo, source="local") == ["tiny_mlp"]
+    assert "two-layer MLP" in hub.help(hub_repo, "tiny_mlp", source="local")
+
+
+def test_hub_load_invokes_entrypoint(hub_repo):
+    model = hub.load(hub_repo, "tiny_mlp", source="local", hidden=16)
+    import jax.numpy as jnp
+    out = model(jnp.ones((3, 4)))
+    assert out.shape == (3, 2)
+
+
+def test_hub_errors(hub_repo, tmp_path):
+    with pytest.raises(RuntimeError, match="network"):
+        hub.list(hub_repo, source="github")
+    with pytest.raises(ValueError, match="source"):
+        hub.list(hub_repo, source="ftp")
+    with pytest.raises(ValueError, match="tiny_mlp"):
+        hub.load(hub_repo, "nonexistent", source="local")
+    empty = tmp_path / "empty_repo"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError, match="hubconf"):
+        hub.list(str(empty), source="local")
+
+
+def test_hub_missing_dependency(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['definitely_not_installed_xyz']\n"
+        "def m():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="definitely_not_installed_xyz"):
+        hub.list(str(tmp_path), source="local")
+
+
+def test_hub_lazy_attribute():
+    assert paddle_tpu.hub.load is hub.load
+
+
+def test_fft_frequencies_matches_numpy():
+    got = np.asarray(fft_frequencies(sr=16000, n_fft=512))
+    want = np.linspace(0, 8000, 257)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_mel_frequencies_endpoints_and_monotonic():
+    got = np.asarray(mel_frequencies(n_mels=40, f_min=20.0, f_max=7600.0))
+    assert got.shape == (40,)
+    np.testing.assert_allclose(got[0], 20.0, atol=0.5)
+    np.testing.assert_allclose(got[-1], 7600.0, rtol=1e-4)
+    assert np.all(np.diff(got) > 0)
+    # evenly spaced in mel space
+    mels = np.asarray(hz_to_mel(got))
+    np.testing.assert_allclose(np.diff(mels), np.diff(mels)[0], rtol=1e-3)
