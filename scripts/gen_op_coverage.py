@@ -350,7 +350,23 @@ TARGETS = {
 }
 
 
-def main():
+def resolve_target(tmod_name):
+    """Import a TARGETS module, falling back to attribute access off the
+    parent for namespaces exposed as attributes rather than submodules
+    (e.g. paddle_tpu.static.nn).  Raises with BOTH errors on failure."""
+    try:
+        return __import__(tmod_name, fromlist=["x"])
+    except Exception as e1:
+        parent, _, leaf = tmod_name.rpartition(".")
+        try:
+            return getattr(__import__(parent, fromlist=["x"]), leaf)
+        except Exception as e2:
+            raise ImportError(
+                f"direct import failed: {e1!r}; attribute fallback "
+                f"failed: {e2!r}") from e2
+
+
+def main(out_path=None):
     out = ["# OP coverage vs reference public API",
            "",
            "Generated by `python scripts/gen_op_coverage.py` — do not edit.",
@@ -366,18 +382,11 @@ def main():
         names = sorted(set(names_blob.split()))
         tmod_name = TARGETS[ns]
         try:
-            tmod = __import__(tmod_name, fromlist=["x"])
-        except Exception as e1:
-            # namespaces exposed as attributes rather than submodules
-            # (e.g. paddle_tpu.static.nn): import the parent, getattr down
-            try:
-                parent, _, leaf = tmod_name.rpartition(".")
-                tmod = getattr(__import__(parent, fromlist=["x"]), leaf)
-            except Exception as e2:
-                msg = f"IMPORT FAILED: {e1!r}; attribute fallback: {e2!r}"
-                out.append(f"## {ns} -> {tmod_name}: {msg}")
-                print(f"  {ns}: {msg}")
-                continue
+            tmod = resolve_target(tmod_name)
+        except ImportError as e:
+            out.append(f"## {ns} -> {tmod_name}: IMPORT FAILED: {e}")
+            print(f"  {ns}: IMPORT FAILED: {e}")
+            continue
         missing = [n for n in names if not hasattr(tmod, n)]
         have = len(names) - len(missing)
         total_ref += len(names)
@@ -398,7 +407,7 @@ def main():
         out.append("")
         out.append(", ".join(f"`{m}`" for m in missing))
         out.append("")
-    path = os.path.join(ROOT, "OP_COVERAGE.md")
+    path = out_path or os.path.join(ROOT, "OP_COVERAGE.md")
     with open(path, "w") as f:
         f.write("\n".join(out) + "\n")
     print(f"wrote {path}: {total_have}/{total_ref} "

@@ -1,0 +1,32 @@
+"""Generated artifacts (OP_COVERAGE.md, docs/api_reference.md) stay in
+sync with the live package surface: regenerate into a temp path and
+compare byte-for-byte with the committed file, and assert full coverage
+(no MISSING rows)."""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+
+def test_op_coverage_in_sync(tmp_path):
+    import gen_op_coverage
+    out = tmp_path / "OP_COVERAGE.md"
+    gen_op_coverage.main(str(out))
+    committed = open(os.path.join(ROOT, "OP_COVERAGE.md")).read()
+    assert out.read_text() == committed, \
+        "OP_COVERAGE.md is stale — run python scripts/gen_op_coverage.py"
+    assert "missing" not in committed.split("| **total** |")[1].lower()
+    assert "IMPORT FAILED" not in committed
+
+
+def test_api_reference_in_sync(tmp_path):
+    import gen_api_reference
+    out = tmp_path / "api_reference.md"
+    gen_api_reference.main(str(out))
+    committed = open(
+        os.path.join(ROOT, "docs", "api_reference.md")).read()
+    assert out.read_text() == committed, \
+        "api_reference.md is stale — run python scripts/gen_api_reference.py"
+    assert "MISSING" not in committed
