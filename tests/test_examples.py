@@ -25,6 +25,13 @@ def _run(script, *args, timeout=600):
     return r.stdout + r.stderr
 
 
+def test_example_train_gnn():
+    out = _run("train_gnn.py", "--steps", "25", "--nodes", "128",
+               "--edges", "1024", "--hidden", "32")
+    assert "train accuracy" in out
+    assert "sampled-subgraph forward" in out
+
+
 def test_example_train_gpt_hybrid():
     out = _run("train_gpt_hybrid.py", "--dp", "1", "--mp", "2", "--pp", "2",
                "--steps", "3", "--batch", "4", "--seq", "32")
