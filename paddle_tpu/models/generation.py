@@ -1,0 +1,129 @@
+"""Autoregressive generation as ONE compiled loop.
+
+Reference analog: the decoding loop the reference serves through
+``fused_multi_transformer`` + PaddleNLP's ``model.generate`` (greedy /
+sampling with temperature, top-k, top-p, eos early-stop).
+
+TPU-native design: the whole token-by-token loop is a single
+``lax.scan`` over the functional KV-cache ``decode_step`` — one compiled
+program for the entire generation instead of one dispatch per token
+(per-dispatch latency dominates small decode steps on a remote-attached
+chip; the same lesson as scripts/tpu_microbench).  The prompt is
+prefilled in one chunked ``decode_step`` call (causal within the chunk),
+then the scan carries ``(caches, last_token, position, rng, finished)``;
+shapes are static throughout (``max_new_tokens`` is a trace-time int).
+
+Works on any model exposing ``init_cache(batch, max_len)`` and
+``decode_step(input_ids, caches, position)`` (GPTForCausalLM,
+LlamaForCausalLM).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["generate"]
+
+
+def _filter_top_k(logits, k: int):
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _filter_top_p(logits, p: float):
+    """Nucleus filtering: keep the smallest prefix of the probability-
+    sorted vocab whose mass reaches ``p`` (the top token always stays)."""
+    sorted_idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, sorted_idx, -1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # token i is kept while the mass BEFORE it is < p
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = before < p
+    inv = jnp.argsort(sorted_idx, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, -1)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
+             temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: Optional[int] = None,
+             pad_token_id: Optional[int] = None, seed: int = 0,
+             output_scores: bool = False):
+    """Generate ``max_new_tokens`` continuations of ``input_ids``
+    ([batch, prompt_len], dense — no padding) and return the full
+    sequences [batch, prompt_len + max_new_tokens].
+
+    ``do_sample=False`` is greedy; sampling applies ``temperature`` then
+    ``top_k`` (0 = off) then ``top_p`` (1.0 = off).  With
+    ``eos_token_id`` set, rows that emit it keep emitting
+    ``pad_token_id`` (default: the eos id) for the remaining steps.
+    ``output_scores=True`` additionally returns the pre-sampling float32
+    logits of every generated position [batch, max_new_tokens, vocab].
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if do_sample and temperature <= 0:
+        raise ValueError("temperature must be > 0 when sampling")
+    b, s0 = input_ids.shape
+    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    if max_seq is not None and s0 + max_new_tokens > max_seq:
+        raise ValueError(
+            f"prompt_len {s0} + max_new_tokens {max_new_tokens} exceeds "
+            f"the model's max_seq_len {max_seq} (position table size) — "
+            "out-of-range positions would silently clamp")
+    input_ids = jnp.asarray(input_ids)
+    pad = eos_token_id if pad_token_id is None else pad_token_id
+
+    def pick(key, logits):
+        logits = logits.astype(jnp.float32)
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(input_ids.dtype)
+        logits = logits / temperature
+        if top_k:
+            logits = _filter_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _filter_top_p(logits, top_p)
+        return jax.random.categorical(key, logits,
+                                      axis=-1).astype(input_ids.dtype)
+
+    caches = model.init_cache(b, s0 + max_new_tokens)
+    logits, caches = model.decode_step(input_ids, caches, 0)
+    first_scores = logits[:, -1].astype(jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    first = pick(sub, logits[:, -1])
+    if eos_token_id is not None:
+        finished = first == eos_token_id
+    else:
+        finished = jnp.zeros((b,), bool)
+
+    def body(carry, _):
+        caches, tok, pos, key, finished = carry
+        # ``pos`` is the sequence index of ``tok``, the token being fed
+        logits, caches = model.decode_step(tok[:, None], caches, pos)
+        key, sub = jax.random.split(key)
+        scores = logits[:, 0].astype(jnp.float32)
+        nxt = pick(sub, logits[:, 0])
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, jnp.asarray(pad, nxt.dtype), nxt)
+            finished = finished | (nxt == eos_token_id)
+        return (caches, nxt, pos + 1, key, finished), (nxt, scores)
+
+    if max_new_tokens > 1:
+        # ``first`` sits at sequence index s0 — that is the position the
+        # first scan step feeds it at
+        carry = (caches, first, jnp.asarray(s0, jnp.int32), key, finished)
+        _, (rest, rest_scores) = jax.lax.scan(body, carry, None,
+                                              length=max_new_tokens - 1)
+        new_tokens = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        scores = jnp.concatenate(
+            [first_scores[:, None], jnp.moveaxis(rest_scores, 0, 1)], axis=1)
+    else:
+        new_tokens = first[:, None]
+        scores = first_scores[:, None]
+    seq = jnp.concatenate([input_ids, new_tokens], axis=1)
+    return (seq, scores) if output_scores else seq

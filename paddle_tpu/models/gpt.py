@@ -167,7 +167,9 @@ class GPTModel(Layer):
 
     def embed(self, input_ids, position_offset: int = 0):
         b, s = input_ids.shape
-        pos = jnp.arange(position_offset, position_offset + s)[None, :]
+        # written as offset + static arange so position_offset may be a
+        # traced value (the generate() scan carries it)
+        pos = (position_offset + jnp.arange(s))[None, :]
         x = self.wte(input_ids) + self.wpe(pos)
         return self.drop(x)
 
@@ -228,6 +230,11 @@ class GPTForCausalLM(Layer):
             new_caches.append(c)
         x = self.gpt.ln_f(x)
         return self.logits(x), new_caches
+
+    def generate(self, input_ids, max_new_tokens: int, **kw):
+        """Single-scan autoregressive decoding (models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens, **kw)
 
 
 def gpt_tiny(**kw) -> GPTConfig:

@@ -178,7 +178,8 @@ class LlamaModel(Layer):
         cfg = self.cfg
         b, s = input_ids.shape
         x = self.embed_tokens(input_ids)
-        pos = jnp.arange(position_offset, position_offset + s)
+        # offset + static arange: position_offset may be traced (generate)
+        pos = position_offset + jnp.arange(s)
         cos, sin = _rope_tables(pos, cfg.head_dim, cfg.rope_theta, x.dtype)
         new_caches = []
         for i, layer in enumerate(self.layers):
@@ -223,6 +224,11 @@ class LlamaForCausalLM(Layer):
         hidden, new_caches = self.llama(input_ids, caches,
                                         position_offset=position)
         return self.lm_head(hidden), new_caches
+
+    def generate(self, input_ids, max_new_tokens: int, **kw):
+        """Single-scan autoregressive decoding (models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens, **kw)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,207 @@
+"""model.generate: single-scan decoding vs a python-loop oracle.
+
+The greedy oracle drives the SAME ``decode_step`` path one token at a
+time from Python, so generate's lax.scan must match it exactly (same
+arithmetic, different control plane).  Cache-vs-full-forward numerics are
+checked separately with a tolerance: on a random-init model near-tied
+logits make argmax CHAINS diverge under float noise, so chain equality
+against the no-cache forward is not a sound oracle (the per-step logits
+are — see test_decode_logits_match_full_forward).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import (GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM, gpt_tiny)
+from paddle_tpu.models.generation import _filter_top_k, _filter_top_p
+
+
+def _greedy_oracle(model, ids, n):
+    """Token-at-a-time greedy loop over decode_step (the path generate
+    scans over), driven from Python."""
+    ids = jnp.asarray(ids)
+    b, s0 = ids.shape
+    caches = model.init_cache(b, s0 + n)
+    logits, caches = model.decode_step(ids, caches, 0)
+    out = [ids]
+    tok = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(ids.dtype)
+    for t in range(1, n):
+        out.append(tok[:, None])
+        # tok sits at sequence index s0 + t - 1: feed it at that position
+        logits, caches = model.decode_step(tok[:, None], caches, s0 + t - 1)
+        tok = jnp.argmax(logits[:, 0].astype(jnp.float32),
+                         -1).astype(ids.dtype)
+    out.append(tok[:, None])
+    return jnp.concatenate(out, axis=1)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    with jax.default_prng_impl("rbg"):
+        return GPTForCausalLM(gpt_tiny())
+
+
+def test_greedy_matches_no_cache_oracle(gpt):
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 7)))
+    got = gpt.generate(ids, max_new_tokens=6)
+    want = _greedy_oracle(gpt, ids, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_single_token(gpt):
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 256, (1, 4)))
+    got = gpt.generate(ids, max_new_tokens=1)
+    assert got.shape == (1, 5)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(_greedy_oracle(gpt, ids, 1)))
+
+
+def test_top_k_1_equals_greedy(gpt):
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 256, (2, 5)))
+    greedy = gpt.generate(ids, max_new_tokens=5)
+    sampled = gpt.generate(ids, max_new_tokens=5, do_sample=True,
+                           top_k=1, seed=123)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_sampling_seed_determinism_and_variation(gpt):
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 256, (2, 5)))
+    a = gpt.generate(ids, max_new_tokens=8, do_sample=True,
+                     temperature=2.0, seed=7)
+    b = gpt.generate(ids, max_new_tokens=8, do_sample=True,
+                     temperature=2.0, seed=7)
+    c = gpt.generate(ids, max_new_tokens=8, do_sample=True,
+                     temperature=2.0, seed=8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_decode_logits_match_full_forward(gpt):
+    """Cache-path logits == full-forward logits at every generated
+    position (the numeric parity that argmax-chain comparison cannot
+    assert soundly)."""
+    rs = np.random.RandomState(9)
+    ids = gpt.generate(jnp.asarray(rs.randint(0, 256, (2, 5))),
+                       max_new_tokens=4)
+    full = gpt(ids)  # no cache
+    b, s = ids.shape
+    caches = gpt.init_cache(b, s)
+    dec, caches = gpt.decode_step(ids[:, :5], caches, 0)
+    decs = [dec]
+    for t in range(5, s):
+        lg, caches = gpt.decode_step(ids[:, t:t + 1], caches, t)
+        decs.append(lg)
+    dec_all = jnp.concatenate(decs, axis=1)
+    np.testing.assert_allclose(np.asarray(dec_all, np.float32),
+                               np.asarray(full, np.float32),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_scores_match_full_forward(gpt):
+    """output_scores logits == full no-cache forward logits at every
+    generated position — THE positional-correctness oracle: a position
+    off-by-one in the scan carry (wrong wpe/RoPE index) shifts every
+    post-first score well beyond tolerance (caught a real s0+1 bug in
+    review)."""
+    rs = np.random.RandomState(11)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 5)))
+    seq, scores = gpt.generate(ids, max_new_tokens=4, output_scores=True)
+    full = gpt(seq).astype(jnp.float32)
+    # the logits that produced generated token i live at position
+    # (5 + i) - 1 of the full forward
+    want = full[:, 4:-1]
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llama_generate_scores_match_full_forward():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(12).randint(0, 128, (2, 6)))
+    seq, scores = model.generate(ids, max_new_tokens=3, output_scores=True)
+    full = model(seq).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(scores),
+                               np.asarray(full[:, 5:-1]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_rejects_overlong(gpt):
+    ids = jnp.zeros((1, 120), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        gpt.generate(ids, max_new_tokens=20)  # 140 > gpt_tiny's 128
+
+
+def test_eos_rows_pad_after_finish(gpt):
+    rs = np.random.RandomState(4)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 6)))
+    # pick the token greedy emits at step 2 for row 0 as the "eos"
+    free = np.asarray(gpt.generate(ids, max_new_tokens=6))
+    eos = int(free[0, 6 + 2])
+    got = np.asarray(gpt.generate(ids, max_new_tokens=6, eos_token_id=eos,
+                                  pad_token_id=0))
+    # row 0: identical up to and including its FIRST eos, then all pad
+    stop = 6 + int(np.flatnonzero(free[0, 6:] == eos)[0])
+    np.testing.assert_array_equal(got[0, :stop + 1], free[0, :stop + 1])
+    assert np.all(got[0, stop + 1:] == 0)
+    # any row that never emitted eos must match the unconstrained run
+    for r in range(free.shape[0]):
+        row_free = free[r, 6:]
+        if eos not in row_free.tolist():
+            np.testing.assert_array_equal(got[r], free[r])
+
+
+def test_generate_under_jit(gpt):
+    ids = jnp.asarray(np.random.RandomState(5).randint(0, 256, (2, 5)))
+
+    @jax.jit
+    def run(ids):
+        return gpt.generate(ids, max_new_tokens=4)
+
+    np.testing.assert_array_equal(
+        np.asarray(run(ids)), np.asarray(gpt.generate(ids, 4)))
+
+
+def test_llama_greedy_matches_oracle():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(6).randint(0, 128, (2, 6)))
+    got = model.generate(ids, max_new_tokens=5)
+    want = _greedy_oracle(model, ids, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_filter_top_k():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5]])
+    out = np.asarray(_filter_top_k(logits, 2))
+    assert np.isfinite(out[0, [1, 2]]).all()
+    assert np.isinf(out[0, [0, 3]]).all()
+
+
+def test_filter_top_p():
+    # probs ~ [0.643, 0.237, 0.087, 0.032] for logits [3,2,1,0]
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+    out = np.asarray(_filter_top_p(logits, 0.7))
+    # mass before token0 = 0 < .7 (keep); before token1 = .643 < .7 (keep);
+    # before token2 = .88 >= .7 (drop)
+    assert np.isfinite(out[0, [0, 1]]).all()
+    assert np.isinf(out[0, [2, 3]]).all()
+    # top token survives even with tiny p
+    out2 = np.asarray(_filter_top_p(logits, 1e-6))
+    assert np.isfinite(out2[0, 0]) and np.isinf(out2[0, 1:]).all()
+
+
+def test_bad_args(gpt):
+    ids = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        gpt.generate(ids, 0)
+    with pytest.raises(ValueError, match="temperature"):
+        gpt.generate(ids, 2, do_sample=True, temperature=0.0)
