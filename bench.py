@@ -200,9 +200,9 @@ def _run_bench(on_tpu, tpu_diag=None):
             extras["kernels"] = {"error": str(e)[-300:]}
     if os.environ.get("BENCH_FULL", "1") == "1":
         # secondary BASELINE configs (#1 resnet, #2 transformer, #4 llama,
-        # #5 moe) — default-on since round 3 (VERDICT r2 item 2); on the
-        # CPU fallback they run at smoke scale so *some* number exists
-        # every round
+        # #5 moe) plus the generate-loop decode bench — default-on since
+        # round 3 (VERDICT r2 item 2); on the CPU fallback they run at
+        # smoke scale so *some* number exists every round
         try:
             extras["secondary"] = _secondary_benches(smoke=not on_tpu)
         except Exception as e:
@@ -272,10 +272,11 @@ def _kernel_compare():
 
 
 def _secondary_benches(smoke=False):
-    """BASELINE configs #1/#2/#4/#5: steady-state step time + items/sec
-    each (host-transfer-synced).  ``smoke=True`` (CPU fallback) shrinks
-    every config so the whole set stays inside the driver's patience while
-    still exercising the real model/training graph."""
+    """BASELINE configs #1/#2/#4/#5 plus a generate-loop decode bench:
+    steady-state step time + items/sec each (host-transfer-synced).
+    ``smoke=True`` (CPU fallback) shrinks every config so the whole set
+    stays inside the driver's patience while still exercising the real
+    model/training graph."""
     import functools
     import jax
     import jax.numpy as jnp
@@ -392,6 +393,54 @@ def _secondary_benches(smoke=False):
                                                   mcfg.aux_weight)
 
     out["gpt_moe"] = train_tput(mm, (mx,), moe_loss, mb * ms)
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
+    # 6 decode throughput — model.generate: the whole KV-cache loop is one
+    # compiled lax.scan (models/generation.py), so this measures steady
+    # autoregressive tokens/sec, not per-token dispatch latency
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    if smoke:
+        dcfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                         num_heads=4, max_seq_len=64)
+        db, dprompt, dnew = 2, 16, 16
+    else:
+        dcfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                         num_heads=12, max_seq_len=1024, dtype="bfloat16")
+        db, dprompt, dnew = 8, 128, 256
+    dm = GPTForCausalLM(dcfg)
+    if not smoke:
+        dm.to(dtype="bfloat16")
+    dids = jnp.asarray(rs.randint(0, dcfg.vocab_size, (db, dprompt)))
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def gen(ids, n):
+        return dm.generate(ids, n)
+
+    def timed(n, iters):
+        seq = gen(dids, n)                          # compile
+        float(seq[0, -1].astype(jnp.float32))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            seq = gen(dids, n)
+        float(seq[0, -1].astype(jnp.float32))
+        return (time.perf_counter() - t0) / iters
+
+    iters_d = 1 if smoke else 3
+    dt = timed(dnew, iters_d)                       # prefill + dnew tokens
+    pdt = timed(1, iters_d)                         # prefill + 1 token
+    # steady-state decode rate: the (dnew - 1) extra tokens cost dt - pdt
+    decode_tps = (db * (dnew - 1) / (dt - pdt)) if dt > pdt else None
+    out["gpt_decode"] = {
+        "step_ms": round(dt * 1e3, 1),
+        # new tokens/sec over the whole call (prefill amortized in)
+        "items_per_sec": round(db * dnew / dt, 1),
+        "prefill_ms": round(pdt * 1e3, 1),
+        "decode_tokens_per_sec": (round(decode_tps, 1)
+                                  if decode_tps else "noise-dominated"),
+        "config": f"b{db}-prompt{dprompt}-new{dnew}-h{dcfg.hidden_size}"
+                  f"-L{dcfg.num_layers}"}
     return out
 
 
