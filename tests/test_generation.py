@@ -199,6 +199,22 @@ def test_filter_top_p():
     assert np.isfinite(out2[0, 0]) and np.isinf(out2[0, 1:]).all()
 
 
+def test_generate_aot_export_roundtrip(gpt, tmp_path):
+    """The single-scan decode loop survives jax.export AOT: serialize the
+    jitted generate program, reload, execute — identical sequences (the
+    deployment path for autoregressive serving)."""
+    from paddle_tpu import jit as pjit
+
+    ids = jnp.asarray(np.random.RandomState(8).randint(0, 256, (2, 6)))
+    fn = jax.jit(lambda ids: gpt.generate(ids, max_new_tokens=5))
+    want = np.asarray(fn(ids))
+    path = str(tmp_path / "gen.bin")
+    pjit.save_program(fn, path, ids)
+    loaded = pjit.load_program(path)
+    got = np.asarray(loaded.call(ids))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_bad_args(gpt):
     ids = jnp.zeros((1, 3), jnp.int32)
     with pytest.raises(ValueError, match="max_new_tokens"):
