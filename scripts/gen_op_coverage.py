@@ -278,6 +278,11 @@ DistributedStrategy barrier_worker distributed_model distributed_optimizer
 init is_first_worker worker_index worker_num
 """
 
+PADDLE_AUTOGRAD = """
+PyLayer PyLayerContext backward grad hessian is_grad_enabled jacobian jvp
+no_grad vjp
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -311,6 +316,7 @@ REFERENCE = {
     "paddle.hub": PADDLE_HUB,
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
+    "paddle.autograd": PADDLE_AUTOGRAD,
 }
 
 # repo namespace that answers for each reference namespace
@@ -347,6 +353,7 @@ TARGETS = {
     "paddle.hub": "paddle_tpu.hub",
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
+    "paddle.autograd": "paddle_tpu.autograd",
 }
 
 
