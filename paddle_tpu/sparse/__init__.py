@@ -21,8 +21,11 @@ from jax.experimental import sparse as jsparse
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "is_sparse",
            "is_sparse_coo", "is_sparse_csr", "to_dense", "to_sparse_coo",
-           "add", "subtract", "multiply", "matmul", "masked_matmul",
-           "relu", "tanh", "transpose", "nn"]
+           "add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul", "mv", "relu", "tanh", "sin", "sinh", "tan",
+           "asin", "asinh", "atan", "atanh", "sqrt", "square", "log1p",
+           "expm1", "abs", "neg", "pow", "deg2rad", "rad2deg", "cast",
+           "sum", "coalesce", "is_same_shape", "transpose", "nn"]
 
 
 def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
@@ -159,6 +162,78 @@ def _unary(op):
 
 relu = _unary(lambda v: jnp.maximum(v, 0))
 tanh = _unary(jnp.tanh)
+# the reference exposes exactly the ZERO-PRESERVING unary family on
+# sparse tensors (python/paddle/sparse/unary.py) — f(0)=0, so mapping
+# stored values preserves the pattern
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+abs = _unary(jnp.abs)  # noqa: A001 — mirrors the reference name
+neg = _unary(jnp.negative)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Reference: paddle.sparse.cast — cast indices and/or values."""
+    if not is_sparse(x):
+        return jnp.asarray(x, value_dtype) if value_dtype else jnp.asarray(x)
+    idx = x.indices if index_dtype is None else x.indices.astype(index_dtype)
+    val = x.data if value_dtype is None else x.data.astype(value_dtype)
+    return _copy_fmt(x, jsparse.BCOO((val, idx), shape=x.shape))
+
+
+def divide(x, y, name=None):
+    """Elementwise divide (dense-union semantics like the reference's
+    sparse divide: entries where both are zero produce the stored
+    0/0 = nan of the dense computation)."""
+    return _binop(jnp.divide, x, y)
+
+
+def mv(x, vec, name=None):
+    """sparse [M, N] @ dense vector [N] -> dense [M]."""
+    return matmul(x, jnp.asarray(vec))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reduce-sum.  axis=None returns the dense scalar; an int axis
+    returns a sparse result (jsparse bcoo_reduce_sum).  keepdim is
+    unsupported on the sparse path (documented deviation)."""
+    if not is_sparse(x):
+        return jnp.sum(jnp.asarray(x), axis=axis, dtype=dtype,
+                       keepdims=keepdim)
+    if keepdim:  # both branches: the deviation is enforced, not silent
+        raise ValueError("sparse sum: keepdim=True is not supported")
+    if axis is None:
+        return jnp.sum(x.data, dtype=dtype)
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % len(x.shape) for a in axes)
+    out = jsparse.bcoo_reduce_sum(x, axes=axes)
+    if dtype is not None:
+        out = jsparse.BCOO((out.data.astype(dtype), out.indices),
+                           shape=out.shape)
+    return _copy_fmt(x, out)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (reference: paddle.sparse.coalesce)."""
+    return _copy_fmt(x, x.sum_duplicates())
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
 
 
 def transpose(x, perm, name=None):

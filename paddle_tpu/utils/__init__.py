@@ -12,7 +12,10 @@ import importlib
 import warnings
 from typing import Optional
 
-__all__ = ["run_check", "deprecated", "try_import", "unique_name"]
+from . import dlpack  # noqa: F401
+
+__all__ = ["run_check", "deprecated", "try_import", "unique_name",
+           "dlpack"]
 
 
 def run_check():

@@ -1,0 +1,36 @@
+"""paddle.utils.dlpack — zero-copy tensor exchange via the DLPack
+protocol (reference: python/paddle/utils/dlpack.py to_dlpack/from_dlpack).
+
+TPU note: DLPack exchange is a HOST-memory protocol here — jax arrays on
+CPU export/import without copying; arrays living on a TPU device are
+transferred to host by jax before export (the reference's GPU path has
+the same device-boundary caveat with non-CUDA consumers).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a tensor as a DLPack capsule consumable by torch/numpy/
+    cupy (``torch.utils.dlpack.from_dlpack`` etc.)."""
+    x = jnp.asarray(x)
+    try:
+        if any(d.platform != "cpu" for d in x.devices()):
+            # jax only exports CPU/GPU buffers over DLPack: bring
+            # TPU-resident arrays to host first (docstring contract)
+            import numpy as np
+            return np.asarray(jax.device_get(x)).__dlpack__()
+    except AttributeError:
+        pass  # tracers/non-committed values: fall through
+    return x.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack capsule OR any object implementing
+    ``__dlpack__`` (torch tensors, numpy arrays) as a jax array."""
+    return jax.dlpack.from_dlpack(dlpack)

@@ -198,8 +198,10 @@ register_kl
 """
 
 PADDLE_SPARSE = """
-add is_sparse_coo is_sparse_csr masked_matmul matmul multiply nn relu
-sparse_coo_tensor sparse_csr_tensor subtract tanh transpose
+abs add asin asinh atan atanh cast coalesce deg2rad divide expm1
+is_same_shape is_sparse_coo is_sparse_csr log1p masked_matmul matmul
+multiply mv neg nn pow rad2deg relu sin sinh sparse_coo_tensor
+sparse_csr_tensor sqrt square subtract sum tan tanh transpose
 """
 
 PADDLE_INCUBATE_NN = """
@@ -217,7 +219,7 @@ ReduceLROnPlateau
 """
 
 PADDLE_UTILS = """
-cpp_extension deprecated run_check try_import unique_name
+cpp_extension deprecated dlpack run_check try_import unique_name
 """
 
 PADDLE_VISION_TRANSFORMS = """

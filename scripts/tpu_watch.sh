@@ -75,10 +75,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if [ ! -f /tmp/tpu_b8_tried ] && timeout 150 python $PROBE >> $LOG 2>&1; then
       touch /tmp/tpu_b8_tried
       echo "$(date -u +%H:%M:%S) complete; trying BENCH_BATCH=8 experiment" >> $LOG
-      BENCH_BATCH=8 BENCH_KERNELS=0 BENCH_SECONDARY=0 EVIDENCE_BUDGET_S=1200 \
-        timeout 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1
-      commit_evidence "On-chip bench evidence: larger-batch experiment (promotion keeps the max MFU)" \
-        || { COMMIT_OK=0; echo "$(date -u +%H:%M:%S) b8 experiment commit failed 6x" >> $LOG; }
+      if BENCH_BATCH=8 BENCH_KERNELS=0 BENCH_SECONDARY=0 EVIDENCE_BUDGET_S=1200 \
+          timeout 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1; then
+        commit_evidence "On-chip bench evidence: larger-batch experiment (promotion keeps the max MFU)" \
+          || { COMMIT_OK=0; echo "$(date -u +%H:%M:%S) b8 experiment commit failed 6x" >> $LOG; }
+      else
+        echo "$(date -u +%H:%M:%S) b8 experiment run FAILED (rc=$?); canonical evidence untouched" >> $LOG
+      fi
     fi
     if [ "$COMMIT_OK" = "1" ]; then
       echo "$(date -u +%H:%M:%S) complete evidence committed; watchdog exiting" >> $LOG

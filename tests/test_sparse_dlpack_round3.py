@@ -1,0 +1,120 @@
+"""Round-3 widenings: paddle.sparse unary/util family + utils.dlpack."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as sp
+from paddle_tpu.utils import dlpack
+
+
+def _coo(rs, m=6, n=5, nnz=8, base=None):
+    idx = np.stack([rs.randint(0, m, nnz), rs.randint(0, n, nnz)])
+    vals = (rs.rand(nnz) * 0.8 + 0.1 if base is None else base).astype(
+        np.float32)
+    return sp.sparse_coo_tensor(idx, vals, (m, n)), idx, vals
+
+
+UNARIES = [
+    ("sin", np.sin), ("sinh", np.sinh), ("tan", np.tan),
+    ("asin", np.arcsin), ("asinh", np.arcsinh), ("atan", np.arctan),
+    ("atanh", np.arctanh), ("sqrt", np.sqrt), ("square", np.square),
+    ("log1p", np.log1p), ("expm1", np.expm1), ("abs", np.abs),
+    ("neg", np.negative), ("deg2rad", np.deg2rad), ("rad2deg", np.rad2deg),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARIES, ids=[u[0] for u in UNARIES])
+def test_sparse_unary_matches_dense(name, ref):
+    rs = np.random.RandomState(0)
+    x, idx, vals = _coo(rs)
+    out = getattr(sp, name)(x)
+    assert sp.is_sparse(out) and out.shape == x.shape
+    dense = np.asarray(sp.to_dense(out))
+    want = np.zeros((6, 5), np.float32)
+    # duplicate coords accumulate on densify; apply ref to each stored
+    # value first (the op maps stored values, pattern preserved)
+    np.add.at(want, (idx[0], idx[1]), ref(vals))
+    np.testing.assert_allclose(dense, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_pow_cast():
+    rs = np.random.RandomState(1)
+    x, idx, vals = _coo(rs)
+    p = sp.pow(x, 3)
+    np.testing.assert_allclose(np.asarray(p.data), vals ** 3, rtol=1e-5)
+    c = sp.cast(x, index_dtype="int64", value_dtype="float64")
+    assert c.indices.dtype == jnp.int64 or c.indices.dtype == jnp.int32
+    assert c.data.dtype == jnp.float64 or c.data.dtype == jnp.float32
+    # values roundtrip regardless of x64 availability
+    np.testing.assert_allclose(np.asarray(c.data, np.float32), vals)
+
+
+def test_sparse_mv_and_sum():
+    rs = np.random.RandomState(2)
+    x, idx, vals = _coo(rs)
+    xd = np.asarray(sp.to_dense(x))
+    v = rs.randn(5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sp.mv(x, v)), xd @ v,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(sp.sum(x)), xd.sum(), rtol=1e-5)
+    s0 = sp.sum(x, axis=0)
+    assert sp.is_sparse(s0)
+    np.testing.assert_allclose(np.asarray(sp.to_dense(s0)), xd.sum(0),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="keepdim"):
+        sp.sum(x, axis=0, keepdim=True)
+    with pytest.raises(ValueError, match="keepdim"):
+        sp.sum(x, keepdim=True)  # enforced on the axis=None branch too
+
+
+def test_sparse_sum_preserves_csr_tag():
+    crows = np.array([0, 2, 3])
+    cols = np.array([0, 2, 1])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    x = sp.sparse_csr_tensor(crows, cols, vals, (2, 3))
+    assert sp.is_sparse_csr(x)
+    s = sp.sum(x, axis=0)
+    assert sp.is_sparse_csr(s)  # _copy_fmt propagates like every other op
+
+
+def test_sparse_coalesce_merges_duplicates():
+    idx = np.array([[0, 0, 1], [1, 1, 2]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    x = sp.sparse_coo_tensor(idx, vals, (2, 3))
+    c = sp.coalesce(x)
+    assert c.nse == 2
+    np.testing.assert_allclose(np.asarray(sp.to_dense(c)),
+                               np.asarray(sp.to_dense(x)))
+
+
+def test_sparse_divide_and_is_same_shape():
+    rs = np.random.RandomState(3)
+    x, _, _ = _coo(rs)
+    y, _, _ = _coo(rs)
+    assert sp.is_same_shape(x, y)
+    out = sp.divide(sp.multiply(x, y), y)
+    # where y's dense value is 0 the quotient is nan/0-pattern; compare on
+    # y's nonzero mask only
+    xd = np.asarray(sp.to_dense(x))
+    yd = np.asarray(sp.to_dense(y))
+    od = np.asarray(sp.to_dense(out))
+    mask = yd != 0
+    np.testing.assert_allclose(od[mask], (xd * yd)[mask] / yd[mask],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dlpack_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4) * 0.5
+    j = dlpack.from_dlpack(t)
+    np.testing.assert_allclose(np.asarray(j), t.numpy())
+    back = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(j + 1))
+    np.testing.assert_allclose(back.numpy(), t.numpy() + 1)
+
+
+def test_dlpack_numpy():
+    a = np.arange(6, dtype=np.float32)
+    j = dlpack.from_dlpack(a)
+    np.testing.assert_allclose(np.asarray(j), a)
