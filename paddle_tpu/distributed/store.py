@@ -7,13 +7,26 @@ barriers (SURVEY.md §2.1 TCPStore row, §3.3 call stack).
 TPU-native note: the jit compute path needs no store (jax.distributed's
 coordination service replaces it for process bring-up), but the reference
 API is used directly by ported launch/elastic scripts, so a real
-implementation lives here: a threaded master server holding the dict, a
-thin client elsewhere; values are opaque bytes like the reference.
+implementation lives here — with the server half NATIVE like the
+reference's: ``paddle_tpu/lib/tcp_store.cpp`` (thread-per-connection C++
+daemon, built lazily with g++) hosts the map when available, and a Python
+server with identical behavior is the fallback.  Both speak one
+language-neutral wire protocol (no pickle):
+
+    request : u8 op | u32le klen | key | u64le vlen | val | u64le timeout_ms
+    response: u8 status | u64le plen | payload
+    ops 1=set 2=get 3=add 4=wait 5=del; status 0=ok 1=timeout 2=err;
+    ``wait`` packs its key list length-prefixed (u32 count, then u32 len +
+    bytes per key — arbitrary key bytes stay representable); ``add``
+    carries an ascii integer delta and returns the ascii total.
+
+The wire carries a RELATIVE timeout: an absolute client deadline would
+break under inter-host clock skew.
 """
 
 from __future__ import annotations
 
-import pickle
+import os
 import socket
 import struct
 import threading
@@ -22,55 +35,167 @@ from typing import Dict, Optional
 
 __all__ = ["TCPStore"]
 
-
-def _send(sock, obj):
-    data = pickle.dumps(obj, protocol=5)
-    sock.sendall(struct.pack("<Q", len(data)) + data)
+_OPS = {"set": 1, "get": 2, "add": 3, "wait": 4, "del": 5}
 
 
-def _recv(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        c = sock.recv(8 - len(hdr))
-        if not c:
-            raise ConnectionError("store peer closed")
-        hdr += c
-    n = struct.unpack("<Q", hdr)[0]
+def _pack_keys(keys) -> bytes:
+    """wait's key field: u32 count, then per key u32 len + bytes
+    (length-prefixed so arbitrary key bytes stay representable)."""
+    out = [struct.pack("<I", len(keys))]
+    for k in keys:
+        out.append(struct.pack("<I", len(k)) + k)
+    return b"".join(out)
+
+
+def _unpack_keys(blob: bytes):
+    (count,) = struct.unpack_from("<I", blob, 0)
+    off, keys = 4, []
+    for _ in range(count):
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        keys.append(blob[off:off + n])
+        off += n
+    if off != len(blob):
+        raise ValueError("malformed wait key list")
+    return keys
+
+
+def _send_req(sock, op: str, key: bytes, val: bytes, rel_timeout: float):
+    frame = (struct.pack("<B", _OPS[op])
+             + struct.pack("<I", len(key)) + key
+             + struct.pack("<Q", len(val)) + val
+             + struct.pack("<Q", max(int(rel_timeout * 1000), 0)))
+    sock.sendall(frame)
+
+
+def _read_n(sock, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         c = sock.recv(min(1 << 20, n - len(buf)))
         if not c:
             raise ConnectionError("store peer closed")
         buf += c
-    return pickle.loads(bytes(buf))
+    return bytes(buf)
+
+
+def _recv_resp(sock):
+    status = _read_n(sock, 1)[0]
+    plen = struct.unpack("<Q", _read_n(sock, 8))[0]
+    payload = _read_n(sock, plen) if plen else b""
+    return status, payload
+
+
+def _recv_req(sock):
+    op = _read_n(sock, 1)[0]
+    klen = struct.unpack("<I", _read_n(sock, 4))[0]
+    key = _read_n(sock, klen) if klen else b""
+    vlen = struct.unpack("<Q", _read_n(sock, 8))[0]
+    val = _read_n(sock, vlen) if vlen else b""
+    timeout_ms = struct.unpack("<Q", _read_n(sock, 8))[0]
+    return op, key, val, timeout_ms / 1000.0
+
+
+def _send_resp(sock, status: int, payload: bytes = b""):
+    sock.sendall(struct.pack("<B", status)
+                 + struct.pack("<Q", len(payload)) + payload)
+
+
+def _native_lib():
+    """ctypes handle to the C++ server, built lazily (None if no g++)."""
+    import ctypes
+    import subprocess
+    lib_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lib")
+    src = os.path.join(lib_dir, "tcp_store.cpp")
+    so = os.path.join(lib_dir, "libtcpstore.so")
+    if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(so) < os.path.getmtime(src)):
+        # compile to a private temp name, then atomic-rename: concurrent
+        # masters must never CDLL a half-written .so, and a rebuild must
+        # not truncate a file another live process has mapped (the same
+        # pattern as utils/cpp_extension.load)
+        tmp = f"{so}.tmp.{os.getpid()}"
+        try:
+            r = subprocess.run(
+                ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", tmp,
+                 src, "-lpthread"], capture_output=True, timeout=120)
+            if r.returncode != 0:
+                return None
+            os.replace(tmp, so)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.ts_start.restype = ctypes.c_void_p
+    lib.ts_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ts_port.restype = ctypes.c_int
+    lib.ts_port.argtypes = [ctypes.c_void_p]
+    lib.ts_stop.restype = None
+    lib.ts_stop.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 class TCPStore:
     """Reference ctor: TCPStore(host, port, is_master, world_size, timeout).
 
-    Master hosts the KV dict and serves peers; every instance (master
+    Master hosts the KV map and serves peers; every instance (master
     included) uses the same client API: set/get/add/wait/delete_key.
+    ``native`` selects the C++ server (default: env
+    ``PADDLE_NATIVE_STORE``, else try-native-fall-back-to-Python).
     """
 
     def __init__(self, host: str, port: int, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 30.0):
+                 world_size: int = 1, timeout: float = 30.0,
+                 native: Optional[bool] = None):
         self.host, self.port = host, int(port)
         self.is_master = is_master
         self.timeout = timeout
-        self._kv: Dict[str, bytes] = {}
+        self.backend = "client"
+        self._kv: Dict[bytes, bytes] = {}
         self._cv = threading.Condition()
         self._server: Optional[socket.socket] = None
+        self._native_handle = None
+        self._native_lib = None
         self._stop = threading.Event()
+        if native is None:
+            env = os.environ.get("PADDLE_NATIVE_STORE")
+            native = None if env is None else env == "1"
         if is_master:
-            self._serve()
+            lib = _native_lib() if native in (None, True) else None
+            if lib is not None:
+                h = lib.ts_start(self.host.encode(), self.port)
+                if h:
+                    self._native_lib, self._native_handle = lib, h
+                    self.port = lib.ts_port(h)
+                    self.backend = "native"
+                elif native:
+                    raise OSError(
+                        f"native TCPStore could not bind "
+                        f"{self.host}:{self.port}")
+            if self._native_handle is None:
+                if native:
+                    raise RuntimeError(
+                        "native TCPStore requested but the C++ server is "
+                        "unavailable (no g++?)")
+                self._serve()
+                self.backend = "python"
         else:
             self._wait_master_up()
 
-    # ----- master side --------------------------------------------------
+    # ----- python-server side -------------------------------------------
     def _serve(self):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((self.host, self.port))
+        if self.port == 0:
+            self.port = srv.getsockname()[1]
         srv.listen(64)
         srv.settimeout(0.2)
         self._server = srv
@@ -91,35 +216,39 @@ class TCPStore:
     def _handle(self, conn):
         try:
             while True:
-                # the wire carries a RELATIVE timeout: an absolute client
-                # deadline would break under inter-host clock skew
-                op, key, val, rel_timeout = _recv(conn)
+                op, key, val, rel_timeout = _recv_req(conn)
                 deadline = time.time() + rel_timeout
-                if op == "set":
+                if op == _OPS["set"]:
                     with self._cv:
                         self._kv[key] = val
                         self._cv.notify_all()
-                    _send(conn, ("ok", None))
-                elif op == "get":
-                    ok = self._wait_local([key], deadline)
-                    _send(conn, ("ok", self._kv[key]) if ok
-                          else ("timeout", None))
-                elif op == "add":
+                    _send_resp(conn, 0)
+                elif op == _OPS["get"]:
+                    value = self._get_local(key, deadline)
+                    if value is not None:
+                        _send_resp(conn, 0, value)
+                    else:
+                        _send_resp(conn, 1)
+                elif op == _OPS["add"]:
                     with self._cv:
-                        cur = int(self._kv.get(key, b"0"))
-                        cur += int(val)
+                        cur = int(self._kv.get(key, b"0")) + int(val)
                         self._kv[key] = str(cur).encode()
                         self._cv.notify_all()
-                    _send(conn, ("ok", cur))
-                elif op == "wait":
-                    ok = self._wait_local(key, deadline)
-                    _send(conn, ("ok", None) if ok else ("timeout", None))
-                elif op == "del":
+                    _send_resp(conn, 0, str(cur).encode())
+                elif op == _OPS["wait"]:
+                    try:
+                        keys = _unpack_keys(key)
+                    except (ValueError, struct.error):
+                        _send_resp(conn, 2, b"malformed wait key list")
+                        continue
+                    ok = self._wait_local(keys, deadline)
+                    _send_resp(conn, 0 if ok else 1)
+                elif op == _OPS["del"]:
                     with self._cv:
                         existed = self._kv.pop(key, None) is not None
-                    _send(conn, ("ok", existed))
+                    _send_resp(conn, 0, b"1" if existed else b"0")
                 else:
-                    _send(conn, ("err", f"bad op {op}"))
+                    _send_resp(conn, 2, b"bad op")
         except (ConnectionError, OSError):
             pass
         finally:
@@ -134,6 +263,18 @@ class TCPStore:
                 self._cv.wait(timeout=min(rem, 0.5))
             return True
 
+    def _get_local(self, key, deadline):
+        """Blocking read that returns the value from INSIDE the critical
+        section (a wait-then-read-outside-the-lock races with
+        delete_key — review finding).  None = timeout."""
+        with self._cv:
+            while key not in self._kv:
+                rem = deadline - time.time()
+                if rem <= 0:
+                    return None
+                self._cv.wait(timeout=min(rem, 0.5))
+            return self._kv[key]
+
     # ----- client side --------------------------------------------------
     def _wait_master_up(self):
         deadline = time.time() + self.timeout
@@ -147,9 +288,9 @@ class TCPStore:
         raise TimeoutError(f"TCPStore master {self.host}:{self.port} "
                            f"not reachable")
 
-    def _rpc(self, op, key, val=None, timeout=None):
+    def _rpc(self, op, key: bytes, val: bytes = b"", timeout=None):
         deadline = time.time() + (timeout or self.timeout)
-        if self.is_master:
+        if self.is_master and self.backend == "python":
             # local fast path against the same dict the server serves
             if op == "set":
                 with self._cv:
@@ -157,9 +298,10 @@ class TCPStore:
                     self._cv.notify_all()
                 return None
             if op == "get":
-                if not self._wait_local([key], deadline):
+                value = self._get_local(key, deadline)
+                if value is None:
                     raise TimeoutError(f"get({key!r}) timed out")
-                return self._kv[key]
+                return value
             if op == "add":
                 with self._cv:
                     cur = int(self._kv.get(key, b"0")) + int(val)
@@ -167,8 +309,8 @@ class TCPStore:
                     self._cv.notify_all()
                 return cur
             if op == "wait":
-                if not self._wait_local(key, deadline):
-                    raise TimeoutError(f"wait({key!r}) timed out")
+                if not self._wait_local(_unpack_keys(key), deadline):
+                    raise TimeoutError("wait timed out")
                 return None
             if op == "del":
                 with self._cv:
@@ -177,36 +319,49 @@ class TCPStore:
         with socket.create_connection((self.host, self.port),
                                       timeout=self.timeout) as sock:
             sock.settimeout(rel + 2.0)
-            _send(sock, (op, key, val, rel))
-            status, payload = _recv(sock)
-        if status == "timeout":
+            _send_req(sock, op, key, val, rel)
+            status, payload = _recv_resp(sock)
+        if status == 1:
             raise TimeoutError(f"{op}({key!r}) timed out")
-        if status == "err":
-            raise RuntimeError(payload)
-        return payload
+        if status == 2:
+            raise RuntimeError(payload.decode(errors="replace"))
+        if op == "add":
+            return int(payload)
+        if op == "del":
+            return payload == b"1"
+        if op == "get":
+            return payload
+        return None
 
     # ----- reference API -----------------------------------------------
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
-        self._rpc("set", key, bytes(value))
+        self._rpc("set", key.encode(), bytes(value))
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
-        return self._rpc("get", key, timeout=timeout)
+        return self._rpc("get", key.encode(), timeout=timeout)
 
     def add(self, key: str, amount: int = 1) -> int:
-        return self._rpc("add", key, amount)
+        return self._rpc("add", key.encode(), str(int(amount)).encode())
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         if isinstance(keys, str):
             keys = [keys]
-        self._rpc("wait", list(keys), timeout=timeout)
+        keys = list(keys)
+        if not keys:
+            return  # vacuous wait returns immediately (old list semantics)
+        self._rpc("wait", _pack_keys([k.encode() for k in keys]),
+                  timeout=timeout)
 
     def delete_key(self, key: str) -> bool:
-        return self._rpc("del", key)
+        return self._rpc("del", key.encode())
 
     def close(self) -> None:
         self._stop.set()
+        if self._native_handle is not None:
+            self._native_lib.ts_stop(self._native_handle)
+            self._native_handle = None
         if self._server is not None:
             try:
                 self._server.close()

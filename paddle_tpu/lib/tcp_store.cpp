@@ -1,0 +1,270 @@
+// Native TCPStore server — the rank-bootstrap KV store's hot half.
+//
+// Reference analog: paddle/phi/core/distributed/store/tcp_store.cc
+// (MasterDaemon): the master rank binds a socket, holds the KV map, and
+// serves set/get/add/wait/delete with deadline blocking.  This is the
+// same design in ~250 lines of C++17: accept thread + thread per
+// connection, one mutex + condition_variable over an unordered_map,
+// deadline waits via wait_until.
+//
+// Wire protocol (shared with the Python client/server in
+// paddle_tpu/distributed/store.py — language-neutral, no pickle):
+//   request : u8 op | u32le klen | key | u64le vlen | val | u64le timeout_ms
+//   response: u8 status | u64le plen | payload
+//   ops     : 1=set 2=get 3=add 4=wait 5=del
+//   status  : 0=ok 1=timeout 2=err
+//   wait    : key field carries a length-prefixed list —
+//             u32le count, then per key u32le len + bytes (arbitrary key
+//             bytes stay representable; review found '\x1f'-joining lossy)
+//   add     : val is an ascii signed integer delta; stored value and the
+//             response payload are ascii decimal (matches the Python
+//             server's int(b"0") semantics)
+//
+// Exposed C API (ctypes): ts_start(host, port) -> handle, ts_port,
+// ts_stop.  Built lazily with g++ like lib/shm_ring.cpp.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct State {
+    int listen_fd = -1;
+    std::atomic<bool> stop{false};
+    std::thread accept_thread;
+    std::mutex m;
+    std::condition_variable cv;
+    std::unordered_map<std::string, std::string> kv;
+    int port = 0;
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n > 0) {
+        ssize_t r = ::recv(fd, p, n, 0);
+        if (r <= 0) return false;
+        p += r;
+        n -= static_cast<size_t>(r);
+    }
+    return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n > 0) {
+        ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+        if (r <= 0) return false;
+        p += r;
+        n -= static_cast<size_t>(r);
+    }
+    return true;
+}
+
+bool reply(int fd, uint8_t status, const std::string& payload) {
+    std::vector<char> out(1 + 8 + payload.size());
+    out[0] = static_cast<char>(status);
+    uint64_t plen = payload.size();
+    std::memcpy(out.data() + 1, &plen, 8);
+    std::memcpy(out.data() + 9, payload.data(), payload.size());
+    return write_n(fd, out.data(), out.size());
+}
+
+// parse the wait op's length-prefixed key list; false on malformed input
+bool split_keys(const std::string& s, std::vector<std::string>* keys) {
+    if (s.size() < 4) return false;
+    uint32_t count;
+    std::memcpy(&count, s.data(), 4);
+    size_t off = 4;
+    for (uint32_t i = 0; i < count; ++i) {
+        if (off + 4 > s.size()) return false;
+        uint32_t len;
+        std::memcpy(&len, s.data() + off, 4);
+        off += 4;
+        if (off + len > s.size()) return false;
+        keys->emplace_back(s.data() + off, len);
+        off += len;
+    }
+    return off == s.size();
+}
+
+// wait until every key exists or the deadline passes (holds the lock)
+bool wait_keys(State& st, const std::vector<std::string>& keys,
+               Clock::time_point deadline,
+               std::unique_lock<std::mutex>& lk) {
+    auto have_all = [&] {
+        for (const auto& k : keys)
+            if (st.kv.find(k) == st.kv.end()) return false;
+        return true;
+    };
+    while (!have_all()) {
+        if (st.stop.load() ||
+            st.cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+            return have_all();
+        }
+    }
+    return true;
+}
+
+void handle_conn(std::shared_ptr<State> st, int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+        uint8_t op;
+        uint32_t klen;
+        uint64_t vlen, timeout_ms;
+        if (!read_n(fd, &op, 1) || !read_n(fd, &klen, 4)) break;
+        std::string key(klen, '\0');
+        if (klen && !read_n(fd, key.data(), klen)) break;
+        if (!read_n(fd, &vlen, 8)) break;
+        std::string val(vlen, '\0');
+        if (vlen && !read_n(fd, val.data(), vlen)) break;
+        if (!read_n(fd, &timeout_ms, 8)) break;
+        auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+
+        // every reply is sent OUTSIDE the store lock: a stalled client
+        // must never block other ranks' ops on a held mutex (review
+        // finding — write_n can block on a full socket buffer)
+        uint8_t status = 0;
+        std::string payload;
+        switch (op) {
+            case 1: {  // set
+                {
+                    std::lock_guard<std::mutex> lk(st->m);
+                    st->kv[key] = std::move(val);
+                }
+                st->cv.notify_all();
+                break;
+            }
+            case 2: {  // get (blocks until the key exists)
+                std::unique_lock<std::mutex> lk(st->m);
+                if (wait_keys(*st, {key}, deadline, lk))
+                    payload = st->kv[key];   // copy under the lock
+                else
+                    status = 1;
+                break;
+            }
+            case 3: {  // add
+                long long delta = std::strtoll(val.c_str(), nullptr, 10);
+                long long cur = 0;
+                {
+                    std::lock_guard<std::mutex> lk(st->m);
+                    auto it = st->kv.find(key);
+                    if (it != st->kv.end())
+                        cur = std::strtoll(it->second.c_str(), nullptr, 10);
+                    cur += delta;
+                    st->kv[key] = std::to_string(cur);
+                }
+                st->cv.notify_all();
+                payload = std::to_string(cur);
+                break;
+            }
+            case 4: {  // wait (length-prefixed multi-key)
+                std::vector<std::string> keys;
+                if (!split_keys(key, &keys)) {
+                    status = 2;
+                    payload = "malformed wait key list";
+                    break;
+                }
+                std::unique_lock<std::mutex> lk(st->m);
+                if (!wait_keys(*st, keys, deadline, lk)) status = 1;
+                break;
+            }
+            case 5: {  // del
+                bool existed;
+                {
+                    std::lock_guard<std::mutex> lk(st->m);
+                    existed = st->kv.erase(key) > 0;
+                }
+                payload = existed ? "1" : "0";
+                break;
+            }
+            default:
+                status = 2;
+                payload = "bad op";
+        }
+        if (!reply(fd, status, payload) || st->stop.load()) break;
+    }
+    ::close(fd);
+}
+
+void accept_loop(std::shared_ptr<State> st) {
+    while (!st->stop.load()) {
+        struct pollfd pfd{st->listen_fd, POLLIN, 0};
+        int r = ::poll(&pfd, 1, 200);
+        if (r <= 0) continue;
+        int fd = ::accept(st->listen_fd, nullptr, nullptr);
+        if (fd < 0) continue;
+        std::thread(handle_conn, st, fd).detach();
+    }
+}
+
+// handles passed to Python hold a shared_ptr so detached connection
+// threads can never use freed state
+struct Handle {
+    std::shared_ptr<State> st;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_start(const char* host, int port) {
+    auto st = std::make_shared<State>();
+    st->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (st->listen_fd < 0) return nullptr;
+    int one = 1;
+    ::setsockopt(st->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1)
+        addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (::bind(st->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(st->listen_fd, 128) != 0) {
+        ::close(st->listen_fd);
+        return nullptr;
+    }
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    ::getsockname(st->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+    st->port = ntohs(bound.sin_port);
+    st->accept_thread = std::thread(accept_loop, st);
+    return new Handle{std::move(st)};
+}
+
+int ts_port(void* h) {
+    return h ? static_cast<Handle*>(h)->st->port : -1;
+}
+
+void ts_stop(void* h) {
+    if (!h) return;
+    auto* handle = static_cast<Handle*>(h);
+    auto st = handle->st;
+    st->stop.store(true);
+    st->cv.notify_all();
+    ::shutdown(st->listen_fd, SHUT_RDWR);
+    if (st->accept_thread.joinable()) st->accept_thread.join();
+    ::close(st->listen_fd);
+    delete handle;
+}
+
+}  // extern "C"

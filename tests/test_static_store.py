@@ -40,9 +40,16 @@ def test_static_nn_switch_case():
     assert float(got) == -1.0
 
 
-def test_tcp_store_master_and_client():
+@pytest.mark.parametrize("native", [False, True], ids=["python", "native"])
+def test_tcp_store_master_and_client(native):
+    if native:
+        from paddle_tpu.distributed.store import _native_lib
+        if _native_lib() is None:
+            pytest.skip("no g++ toolchain for the native store")
     port = _freeport()
-    master = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    master = TCPStore("127.0.0.1", port, is_master=True, world_size=2,
+                      native=native)
+    assert master.backend == ("native" if native else "python")
     client = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
     client.set("uid", b"nccl-id-bytes")
     assert master.get("uid") == b"nccl-id-bytes"
@@ -62,6 +69,56 @@ def test_tcp_store_master_and_client():
     assert client.delete_key("go") is True
     with pytest.raises(TimeoutError):
         client.get("absent", timeout=0.5)
+    master.close()
+
+
+@pytest.mark.parametrize("native", [False, True], ids=["python", "native"])
+def test_store_wait_edge_cases(native):
+    """wait([]) returns immediately; keys with arbitrary bytes (incl. the
+    0x1f byte an older join-based packing would have split on) work."""
+    if native:
+        from paddle_tpu.distributed.store import _native_lib
+        if _native_lib() is None:
+            pytest.skip("no g++ toolchain for the native store")
+    master = TCPStore("127.0.0.1", 0, is_master=True, native=native)
+    client = TCPStore("127.0.0.1", master.port)
+    client.wait([], timeout=0.5)   # must NOT block or time out
+    weird = "a\x1fb"
+    client.set(weird, b"v")
+    client.wait([weird], timeout=2.0)
+    assert client.get(weird) == b"v"
+    master.close()
+
+
+def test_native_store_cross_process_and_large_values():
+    """C++ server (lib/tcp_store.cpp): port-0 auto-assign, a REAL child
+    process speaking the shared wire protocol, and a multi-MB value."""
+    from paddle_tpu.distributed.store import _native_lib
+    if _native_lib() is None:
+        pytest.skip("no g++ toolchain for the native store")
+    import subprocess
+    import sys
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, native=True)
+    assert master.backend == "native" and master.port > 0
+    # master's own ops ride loopback into the C++ map
+    master.set("big", b"x" * (3 << 20))
+    assert master.add("n", 7) == 7
+
+    code = (
+        "from paddle_tpu.distributed.store import TCPStore\n"
+        f"c = TCPStore('127.0.0.1', {master.port})\n"
+        "assert len(c.get('big')) == 3 << 20\n"
+        "assert c.add('n', 5) == 12\n"
+        "c.set('child_done', b'1')\n"
+        "print('CHILD_OK')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60,
+                       env={**__import__('os').environ,
+                            "JAX_PLATFORMS": "cpu"})
+    assert "CHILD_OK" in r.stdout, (r.stdout, r.stderr)
+    master.wait(["child_done"], timeout=5.0)
+    assert master.get("n") == b"12"
     master.close()
 
 
