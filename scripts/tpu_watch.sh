@@ -9,6 +9,14 @@
 cd /root/repo || exit 1
 LOG=/tmp/tpu_watch.log
 PROBE=/tmp/tpu_watch_probe.py
+PIDFILE=/tmp/tpu_watch.pid
+# single-instance guard + pidfile so restarts can target the exact pid
+# (pkill -f patterns match unrelated shells quoting the script name)
+if [ -f "$PIDFILE" ] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+  echo "$(date -u +%H:%M:%S) another watchdog ($(cat $PIDFILE)) is live; exiting" >> $LOG
+  exit 0
+fi
+echo $$ > $PIDFILE
 cat > $PROBE <<'PYEOF'
 import time, jax, jax.numpy as jnp
 d = jax.devices()

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Start/stop/status for the TPU evidence watchdog, pidfile-based.
+# (pkill -f on the script name is unsafe: the pattern text appears in
+# wrapper shells quoting it, so pkill kills the caller too.)
+cd /root/repo || exit 1
+PIDFILE=/tmp/tpu_watch.pid
+
+case "${1:-status}" in
+  start)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+      echo "already running (pid $(cat $PIDFILE))"
+      exit 0
+    fi
+    setsid nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 < /dev/null &
+    sleep 1
+    echo "started (pid $(cat $PIDFILE 2>/dev/null || echo '?'))"
+    ;;
+  stop)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+      kill "$(cat $PIDFILE)"
+      rm -f "$PIDFILE"
+      echo "stopped"
+    else
+      echo "not running"
+    fi
+    ;;
+  restart)
+    "$0" stop
+    sleep 1
+    "$0" start
+    ;;
+  status)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+      echo "running (pid $(cat $PIDFILE))"
+    else
+      echo "not running"
+    fi
+    ;;
+esac
