@@ -1,0 +1,200 @@
+"""Declarative fault injection for failure-path testing.
+
+SURVEY §5 "Failure detection / elastic / fault injection": the reference
+validates its elastic and debugging machinery with ad-hoc failure
+scripts; this module makes the failures first-class and reusable so the
+repo's own recovery paths (launcher heartbeat hang detection, restart +
+auto-resume, check_numerics, checkpoint load validation) are exercised by
+declared faults instead of hand-rolled runner hacks.
+
+A :class:`FaultPlan` holds faults of the form *at step S on rank R during
+incarnation I, do X*:
+
+* ``exception`` — raise :class:`FaultInjected` (tests recovery in-process)
+* ``exit``      — ``os._exit(code)`` (tests launcher restart)
+* ``hang``      — stop heartbeating and block (tests hang detection);
+  the sleep is re-exec'd beatless like tests/runners/hang_runner.py
+* ``slow``      — inject latency, then continue (straggler simulation)
+* ``nan``       — poison the wrapped step's float outputs with NaN
+  (tests check_numerics / GradScaler inf-skip paths)
+
+Plans come from code or from the ``PADDLE_FAULT_SPEC`` env var
+(``"step=3,kind=exit,rank=1,code=7;step=5,kind=nan"`` —
+';'-separated faults, ','-separated key=value fields), so
+launcher-spawned workers inject faults without code changes.  ``restart``
+gates on ``PADDLE_RESTART_COUNT`` (default 0: fire only in the first
+incarnation, so an exit fault doesn't re-kill the relaunched worker).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Fault", "FaultPlan", "FaultInjected", "wrap",
+           "corrupt_file"]
+
+_KINDS = ("exception", "exit", "hang", "slow", "nan")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by ``kind='exception'`` faults."""
+
+
+@dataclass
+class Fault:
+    step: int
+    kind: str = "exception"
+    rank: Optional[int] = None      # None = every rank
+    restart: Optional[int] = 0      # incarnation filter; None = any
+    code: int = 1                   # exit code for kind='exit'
+    seconds: float = 600.0          # hang/slow duration
+    once: bool = True
+    fired: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"fault kind must be one of {_KINDS}, got {self.kind!r}")
+
+    def matches(self, step: int, rank: int, restart: int) -> bool:
+        if self.once and self.fired:
+            return False
+        return (step == self.step
+                and (self.rank is None or self.rank == rank)
+                and (self.restart is None or self.restart == restart))
+
+
+def _parse_one(spec: str) -> Fault:
+    kw = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad fault field {part!r} (want key=value)")
+        k, v = part.split("=", 1)
+        k = k.strip()
+        v = v.strip()
+        if k in ("step", "code"):
+            kw[k] = int(v)
+        elif k in ("rank", "restart"):
+            kw[k] = None if v in ("any", "*") else int(v)
+        elif k == "seconds":
+            kw[k] = float(v)
+        elif k == "kind":
+            kw[k] = v
+        elif k == "once":
+            kw[k] = v not in ("0", "false", "False")
+        else:
+            raise ValueError(f"unknown fault field {k!r}")
+    if "step" not in kw:
+        raise ValueError(f"fault spec {spec!r} needs step=")
+    return Fault(**kw)
+
+
+class FaultPlan:
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = list(faults or [])
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        spec = spec.strip()
+        if not spec:
+            return cls()
+        return cls([_parse_one(s) for s in spec.split(";") if s.strip()])
+
+    @classmethod
+    def from_env(cls, var: str = "PADDLE_FAULT_SPEC") -> "FaultPlan":
+        return cls.parse(os.environ.get(var, ""))
+
+    def pick(self, step: int, rank: int, restart: int) -> Optional[Fault]:
+        for f in self.faults:
+            if f.matches(step, rank, restart):
+                f.fired += 1
+                return f
+        return None
+
+
+def _poison_nan(out):
+    """NaN every float leaf of the step's output pytree."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(a):
+        try:
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+                return jnp.asarray(a) * jnp.nan
+        except TypeError:
+            pass
+        return a
+
+    return jax.tree_util.tree_map(leaf, out)
+
+
+def _fire(fault: Fault):
+    if fault.kind == "exception":
+        raise FaultInjected(
+            f"injected exception at step {fault.step}")
+    if fault.kind == "exit":
+        os._exit(fault.code)
+    if fault.kind == "hang":
+        # beatless re-exec: the heartbeat thread dies with this image,
+        # so the launcher's stale-heartbeat detector fires (same
+        # mechanism tests/runners/hang_runner.py used by hand)
+        import sys
+        os.execv(sys.executable, [
+            sys.executable, "-c",
+            f"import time; time.sleep({float(fault.seconds)})"])
+    if fault.kind == "slow":
+        time.sleep(fault.seconds)
+
+
+def wrap(step_fn, plan: Optional[FaultPlan] = None, rank: Optional[int]
+         = None):
+    """Wrap a train-step callable; faults fire by invocation index.
+
+    ``plan=None`` reads ``PADDLE_FAULT_SPEC``; ``rank=None`` reads
+    ``PADDLE_TRAINER_ID`` (0 if unset).  The wrapped callable exposes
+    ``.plan`` and ``.state`` (``state["step"]`` is the next invocation
+    index).
+    """
+    plan = FaultPlan.from_env() if plan is None else plan
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) \
+        if rank is None else rank
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", 0))
+    state = {"step": 0}
+
+    def stepped(*args, **kwargs):
+        s = state["step"]
+        state["step"] += 1
+        fault = plan.pick(s, rank, restart)
+        if fault is not None and fault.kind != "nan":
+            _fire(fault)
+        out = step_fn(*args, **kwargs)
+        if fault is not None and fault.kind == "nan":
+            out = _poison_nan(out)
+        return out
+
+    stepped.plan = plan
+    stepped.state = state
+    return stepped
+
+
+def corrupt_file(path: str, offset: int = 0, nbytes: int = 64,
+                 pattern: int = 0xA5):
+    """Flip ``nbytes`` of a file in place (checkpoint-corruption fault);
+    pair with a load call to test that corruption is DETECTED, not
+    silently consumed."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty")
+    offset = min(offset, max(size - 1, 0))
+    nbytes = min(nbytes, size - offset)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        data = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ pattern for b in data))

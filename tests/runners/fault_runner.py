@@ -1,0 +1,24 @@
+"""Worker driven entirely by PADDLE_FAULT_SPEC: trains a tiny loop with
+fault.wrap; the declared exit fault kills incarnation 0 at step 2, the
+launcher restarts, and the fault's restart=0 gate lets the retry finish.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+
+from paddle_tpu.distributed import env
+from paddle_tpu.framework import fault
+
+env._start_heartbeat(interval=0.2)
+
+
+def step(i):
+    return i * 2
+
+
+run = fault.wrap(step)
+for i in range(5):
+    run(i)
+print("FAULT_RUNNER_OK restart=%s" % os.environ.get(
+    "PADDLE_RESTART_COUNT", 0))
