@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear"]
+           "llm_int8_linear", "WeightOnlyLinear", "convert_to_weight_only"]
 
 
 def weight_quantize(x, algo: str = "weight_only_int8", group_size: int = -1):
@@ -117,3 +117,100 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     if bias is not None:
         y = y + bias
     return y.astype(x.dtype)
+
+
+from ..layer import Layer as _Layer
+
+
+class WeightOnlyLinear(_Layer):
+    """Drop-in inference replacement for a dense linear: the weight lives
+    in HBM quantized (int8, or int4 nibble-packed); forward is
+    :func:`weight_only_linear`, so the dequant fuses into the matmul."""
+
+    def __init__(self, weight, bias, weight_dtype: str = "int8"):
+        super().__init__()
+        if weight_dtype not in ("int8", "int4"):
+            raise ValueError(
+                f"weight_dtype must be int8 or int4, got {weight_dtype!r}")
+        algo = ("weight_only_int4" if weight_dtype == "int4"
+                else "weight_only_int8")
+        q, scale = weight_quantize(weight, algo=algo)
+        self.in_features = int(weight.shape[0])
+        self.out_features = int(weight.shape[1])
+        self.weight_dtype = weight_dtype
+        self.register_buffer("w_quant", q)
+        self.register_buffer("w_scale", scale)
+        self.register_buffer("bias", bias)
+
+    def forward(self, x):
+        return weight_only_linear(x, self.w_quant, self.bias, self.w_scale,
+                                  weight_dtype=self.weight_dtype)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, "
+                f"weight_dtype={self.weight_dtype}")
+
+
+def convert_to_weight_only(model, weight_dtype: str = "int8",
+                           inplace: bool = False):
+    """Swap every dense linear in ``model`` — ``nn.Linear`` AND the
+    Megatron ``ColumnParallelLinear``/``RowParallelLinear`` (their
+    single-device forward is the same ``x @ W + b``) — for a
+    :class:`WeightOnlyLinear` holding its quantized weight: the
+    LLM-deployment path, convert once and ``model.generate`` (or any
+    forward) runs with 2-4x less weight HBM traffic.
+
+    SINGLE-DEVICE inference transform (like the reference's weight-only
+    pipeline, which rewrites the inference program): the parallel
+    layers' mp sharding constraints/collectives are dropped by the swap,
+    so convert the dense model you deploy, not a live mp>1 trainer.
+    Embeddings, norms, and tied output heads are untouched.  int4
+    requires every converted linear's input dim to be even.
+    """
+    import copy
+
+    from ..layer import Layer
+    from ..layers.common import Linear
+    from ...distributed.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    if not isinstance(model, Layer):
+        raise TypeError("convert_to_weight_only expects an nn.Layer")
+    # isinstance (not exact type): sequence-parallel variants subclass the
+    # mp layers and share the same dense single-device forward
+    kinds = (Linear, ColumnParallelLinear, RowParallelLinear)
+
+    def quantize(layer, cache):
+        if id(layer) not in cache:
+            cache[id(layer)] = WeightOnlyLinear(
+                layer.weight, layer.bias, weight_dtype=weight_dtype)
+        return cache[id(layer)]
+
+    if isinstance(model, kinds):
+        # bare linear: convert it directly instead of a silent no-op
+        return quantize(model, {})
+    if not inplace:
+        model = copy.deepcopy(model)
+    # walk parent slots directly (NOT named_sublayers, which dedups by
+    # id): a linear shared between two parents must be swapped at EVERY
+    # slot, and the id-keyed cache keeps the quantized copy shared too
+    cache = {}
+    seen = set()
+
+    def walk(parent):
+        if id(parent) in seen:
+            return
+        seen.add(id(parent))
+        for key, child in list(parent._sub_layers.items()):
+            if child is None:
+                continue
+            if isinstance(child, WeightOnlyLinear):
+                continue
+            if isinstance(child, kinds):
+                parent._sub_layers[key] = quantize(child, cache)
+            else:
+                walk(child)
+
+    walk(model)
+    return model

@@ -285,6 +285,12 @@ PyLayer PyLayerContext backward grad hessian is_grad_enabled jacobian jvp
 no_grad vjp
 """
 
+PADDLE_NN_INITIALIZER = """
+Assign Constant Dirac Initializer KaimingNormal KaimingUniform Normal
+Orthogonal TruncatedNormal Uniform XavierNormal XavierUniform
+calculate_gain
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -319,6 +325,7 @@ REFERENCE = {
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
     "paddle.autograd": PADDLE_AUTOGRAD,
+    "paddle.nn.initializer": PADDLE_NN_INITIALIZER,
 }
 
 # repo namespace that answers for each reference namespace
@@ -356,6 +363,7 @@ TARGETS = {
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
     "paddle.autograd": "paddle_tpu.autograd",
+    "paddle.nn.initializer": "paddle_tpu.nn.initializer",
 }
 
 

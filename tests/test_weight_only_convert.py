@@ -1,0 +1,141 @@
+"""nn.quant.convert_to_weight_only: the LLM weight-only deployment path —
+swap Linears for quantized-weight layers and run the model (incl. the
+single-scan generate loop) unchanged."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.quant as Q
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+
+def _grid_weight(rs, shape):
+    """A weight that already sits exactly on the int8 per-channel grid
+    (each channel's absmax pinned to 127 so absmax requantization
+    reproduces the same grid), making quantization LOSSLESS."""
+    scale = (rs.rand(shape[1]) + 0.5).astype(np.float32)
+    q = rs.randint(-126, 127, shape).astype(np.float32)
+    q[0, :] = 127.0
+    return q / 127.0 * scale
+
+
+def test_weight_only_linear_close_and_exact_on_grid():
+    rs = np.random.RandomState(0)
+    lin = nn.Linear(16, 24)
+    x = jnp.asarray(rs.randn(5, 16), jnp.float32)
+    wol = Q.WeightOnlyLinear(lin.weight, lin.bias)
+    a, b = np.asarray(lin(x)), np.asarray(wol(x))
+    assert np.abs(a - b).max() / np.abs(a).max() < 2e-2  # int8 error bound
+    # exactness on the int8 grid
+    lin.weight = jnp.asarray(_grid_weight(rs, (16, 24)))
+    wol2 = Q.WeightOnlyLinear(lin.weight, lin.bias)
+    np.testing.assert_allclose(np.asarray(wol2(x)), np.asarray(lin(x)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_int4_shapes_and_bound():
+    rs = np.random.RandomState(1)
+    lin = nn.Linear(16, 24)
+    wol = Q.WeightOnlyLinear(lin.weight, lin.bias, weight_dtype="int4")
+    assert wol.w_quant.shape == (8, 24)  # nibble-packed along input dim
+    x = jnp.asarray(rs.randn(5, 16), jnp.float32)
+    a, b = np.asarray(lin(x)), np.asarray(wol(x))
+    assert np.abs(a - b).max() / np.abs(a).max() < 0.15  # int4 bound
+    with pytest.raises(ValueError, match="weight_dtype"):
+        Q.WeightOnlyLinear(lin.weight, lin.bias, weight_dtype="int2")
+
+
+def test_convert_swaps_all_dense_linears():
+    from paddle_tpu.distributed.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    model = GPTForCausalLM(gpt_tiny())
+    kinds = (nn.Linear, ColumnParallelLinear, RowParallelLinear)
+    n_linear = sum(1 for _, l in model.named_sublayers()
+                   if type(l) in kinds)
+    assert n_linear > 0
+    qm = Q.convert_to_weight_only(model)
+    swapped = [l for _, l in qm.named_sublayers()
+               if type(l) is Q.WeightOnlyLinear]
+    assert len(swapped) == n_linear
+    assert all(l.w_quant.dtype == jnp.int8 for l in swapped)
+    # embeddings/norms untouched; original model untouched (deepcopy)
+    assert sum(1 for _, l in model.named_sublayers()
+               if type(l) in kinds) == n_linear
+
+
+def test_convert_shared_linear_stays_shared():
+    """A linear tied into two parent slots converts at BOTH slots to ONE
+    shared WeightOnlyLinear (review: named_sublayers dedups by id and
+    used to leave the second slot dense)."""
+
+    class Tied(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            lin = nn.Linear(8, 8)
+            self.a = lin
+            self.b = lin
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    qm = Q.convert_to_weight_only(Tied())
+    assert type(qm.a) is Q.WeightOnlyLinear
+    assert qm.a is qm.b  # sharing preserved
+
+
+def test_convert_bare_linear_and_seq_parallel_subclass():
+    lin = nn.Linear(8, 4)
+    q = Q.convert_to_weight_only(lin)
+    assert type(q) is Q.WeightOnlyLinear  # not a silent no-op
+
+    from paddle_tpu.distributed.meta_parallel.sequence_parallel import (
+        ColumnSequenceParallelLinear)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.p = ColumnSequenceParallelLinear(8, 8)
+
+        def forward(self, x):
+            return self.p(x)
+
+    qm = Q.convert_to_weight_only(M())
+    assert type(qm.p) is Q.WeightOnlyLinear  # subclass converted too
+
+
+def test_converted_gpt_generates_and_tracks_fp_scores():
+    rs = np.random.RandomState(2)
+    model = GPTForCausalLM(gpt_tiny())
+    qm = Q.convert_to_weight_only(model)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 6)))
+    seq, scores = qm.generate(ids, max_new_tokens=4, output_scores=True)
+    assert seq.shape == (2, 10)
+    _, fp_scores = model.generate(ids, max_new_tokens=4, output_scores=True)
+    # first-step scores (same prompt) agree to int8 weight error
+    a, b = np.asarray(scores[:, 0]), np.asarray(fp_scores[:, 0])
+    rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-6)
+    assert rel < 0.1, rel
+
+
+def test_converted_model_grid_weights_exact_generation():
+    """With every Linear weight ON the int8 grid, conversion is lossless
+    and the converted model's greedy generation is token-identical."""
+    from paddle_tpu.distributed.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    rs = np.random.RandomState(3)
+    model = GPTForCausalLM(gpt_tiny())
+    for _, layer in model.named_sublayers():
+        if type(layer) in (nn.Linear, ColumnParallelLinear,
+                           RowParallelLinear):
+            layer.weight = jnp.asarray(
+                _grid_weight(rs, tuple(layer.weight.shape)) * 0.05)
+    qm = Q.convert_to_weight_only(model)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 5)))
+    np.testing.assert_array_equal(
+        np.asarray(qm.generate(ids, max_new_tokens=5)),
+        np.asarray(model.generate(ids, max_new_tokens=5)))
