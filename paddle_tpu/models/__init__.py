@@ -1,6 +1,6 @@
 """Model zoo (LLM family; vision models live in paddle_tpu.vision.models)."""
 
-from .generation import generate  # noqa: F401
+from .generation import beam_search, generate  # noqa: F401
 from .gpt import (GPTConfig, GPTBlock, GPTModel, GPTForCausalLM,  # noqa: F401
                   gpt_tiny, gpt_small, gpt3_6_7b)
 from .trainer import GPTHybridTrainer, GPTMoEHybridTrainer  # noqa: F401

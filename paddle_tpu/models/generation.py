@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
 def _filter_top_k(logits, k: int):
@@ -127,3 +127,135 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
         scores = first_scores[:, None]
     seq = jnp.concatenate([input_ids, new_tokens], axis=1)
     return (seq, scores) if output_scores else seq
+
+
+def _repeat_beams(tree, k: int, batch: int):
+    """Tile every batch-leading leaf of a cache pytree k times
+    ([b, ...] -> [b*k, ...]); scalars (e.g. position counters) pass
+    through."""
+    def leaf(a):
+        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == batch:
+            return jnp.repeat(a, k, axis=0)
+        return a
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _gather_beams(tree, flat_idx, bk: int):
+    """Reorder batch-leading leaves by ancestor beam indices."""
+    def leaf(a):
+        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == bk:
+            return jnp.take(a, flat_idx, axis=0)
+        return a
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def beam_search(model, input_ids, max_new_tokens: int, beam_size: int = 4,
+                length_penalty: float = 0.0,
+                eos_token_id: Optional[int] = None,
+                pad_token_id: Optional[int] = None):
+    """Beam-search decoding as ONE compiled loop (the expansion step and
+    ancestor reordering live inside a single ``lax.scan``; KV caches are
+    tiled to ``batch*beam`` rows and gathered per step by beam index).
+
+    Reference analog: the beam decode the reference ships through
+    ``nn.BeamSearchDecoder`` / PaddleNLP ``model.generate(
+    decode_strategy='beam_search')``.  Finished beams (emitted
+    ``eos_token_id``) are frozen: they continue with ``pad_token_id``
+    (default: eos) at no score change.  Final ranking uses
+    ``score / (n_generated ** length_penalty)`` (0 = raw log-prob).
+
+    Returns ``(sequences [batch, prompt+max_new], scores [batch])`` for
+    the best beam of each batch row.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if beam_size < 1:
+        raise ValueError("beam_size must be >= 1")
+    b, s0 = input_ids.shape
+    k = beam_size
+    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    if max_seq is not None and s0 + max_new_tokens > max_seq:
+        raise ValueError(
+            f"prompt_len {s0} + max_new_tokens {max_new_tokens} exceeds "
+            f"the model's max_seq_len {max_seq}")
+    input_ids = jnp.asarray(input_ids)
+    pad = eos_token_id if pad_token_id is None else pad_token_id
+    if pad is None:
+        pad = 0  # buffer fill only; without eos every slot is written
+
+    # prefill once at batch b, then tile caches to b*k beam rows
+    caches = model.init_cache(b, s0 + max_new_tokens)
+    logits, caches = model.decode_step(input_ids, caches, 0)
+    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    vocab = logp.shape[-1]
+    # the prefill can seed at most `vocab` distinct beams; wider widths
+    # (e.g. an exhaustive beam in tests) fill the rest with -inf scores
+    # that real candidates displace in later expansion steps
+    k0 = min(k, vocab)
+    scores, first = jax.lax.top_k(logp, k0)          # [b, k0] each
+    if k0 < k:
+        scores = jnp.concatenate(
+            [scores, jnp.full((b, k - k0), -jnp.inf, scores.dtype)], 1)
+        first = jnp.concatenate(
+            [first, jnp.repeat(first[:, :1], k - k0, axis=1)], 1)
+    caches = _repeat_beams(caches, k, b)
+    bk = b * k
+
+    tokens0 = jnp.full((b, k, max_new_tokens), pad, input_ids.dtype)
+    tokens0 = tokens0.at[:, :, 0].set(first.astype(input_ids.dtype))
+    if eos_token_id is not None:
+        finished0 = first == eos_token_id
+    else:
+        finished0 = jnp.zeros((b, k), bool)
+
+    def body(carry, t):
+        caches, tokens, last, scores, finished = carry
+        # ``last`` (buffer slot t-1) sits at sequence index s0 + t - 1 —
+        # that is the position it must be fed at (same convention the
+        # review pinned for generate())
+        logits, caches = model.decode_step(
+            last.reshape(bk, 1), caches, s0 + t - 1)
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), -1).reshape(b, k, vocab)
+        if eos_token_id is not None:
+            # frozen beams: pad continues at zero cost, all else -inf
+            frozen = jnp.full((vocab,), -jnp.inf).at[pad].set(0.0)
+            logp = jnp.where(finished[..., None], frozen, logp)
+        cand = scores[..., None] + logp               # [b, k, V]
+        scores, idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+        beam_idx = idx // vocab                       # ancestor beam
+        tok = (idx % vocab).astype(tokens.dtype)      # new token
+        flat = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)
+        caches = _gather_beams(caches, flat, bk)
+        tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
+        tokens = tokens.at[:, :, t].set(tok)
+        if eos_token_id is not None:
+            finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+            finished = finished | (tok == eos_token_id)
+        return (caches, tokens, tok, scores, finished), None
+
+    carry = (caches, tokens0, first.astype(input_ids.dtype), scores,
+             finished0)
+    if max_new_tokens > 1:
+        carry, _ = jax.lax.scan(body, carry,
+                                jnp.arange(1, max_new_tokens))
+    _, tokens, _, scores, _ = carry
+
+    if length_penalty != 0.0:
+        if eos_token_id is not None:
+            # generated length up to and including the first eos
+            pos = jnp.argmax(tokens == eos_token_id, axis=-1)
+            has = jnp.any(tokens == eos_token_id, axis=-1)
+            n_gen = jnp.where(has, pos + 1, max_new_tokens)
+        else:
+            n_gen = jnp.full((b, k), max_new_tokens)
+        final = scores / (n_gen.astype(jnp.float32) ** length_penalty)
+    else:
+        final = scores
+    best = jnp.argmax(final, axis=1)                  # [b]
+    best_tokens = jnp.take_along_axis(
+        tokens, best[:, None, None], axis=1)[:, 0]    # [b, max_new]
+    best_scores = jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+    seq = jnp.concatenate(
+        [input_ids, best_tokens.astype(input_ids.dtype)], axis=1)
+    return seq, best_scores
