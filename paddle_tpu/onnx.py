@@ -1,16 +1,313 @@
-"""paddle.onnx surface (reference: python/paddle/onnx/export.py -> paddle2onnx).
+"""paddle.onnx — ONNX export (reference: python/paddle/onnx/export.py,
+which delegates to paddle2onnx).
 
-No onnx runtime exists in this environment (zero egress); the supported
-export path is paddle_tpu.jit.save (jax.export AOT StableHLO artifact),
-which this module points at with a clear error.
+Environment: no onnx/paddle2onnx/onnxruntime packages exist here (zero
+egress), so this module implements the export path itself:
+
+* a minimal protobuf wire-format writer (varint + length-delimited
+  messages against the public onnx.proto3 field numbers), and
+* a Layer-tree walker mapping a bounded, explicit layer subset onto ONNX
+  ops (opset 17): Linear -> MatMul+Add, Conv2D -> Conv,
+  MaxPool2D/AvgPool2D -> MaxPool/AveragePool, BatchNorm2D ->
+  BatchNormalization, LayerNorm -> LayerNormalization, ReLU/ReLU6/
+  Sigmoid/Tanh/Softmax/GELU (erf or tanh decomposition), Flatten,
+  Dropout (identity at inference), Sequential chains.
+
+That covers the classic CNN/MLP zoo (LeNet/AlexNet/VGG-style bodies).
+Anything outside the subset raises with the layer path and a pointer at
+``paddle_tpu.jit.save`` (the general-purpose AOT StableHLO artifact).
+
+Validation stance (documented): conformance against onnxruntime cannot
+be tested in this environment; tests/test_onnx_export.py instead parses
+the emitted protobuf back with an independent reader and EXECUTES the
+graph with torch ops, asserting numeric parity with the source model.
 """
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
 
 __all__ = ["export"]
 
+# ---------------------------------------------------------------------------
+# protobuf wire-format writer (the subset onnx.proto needs)
+# ---------------------------------------------------------------------------
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise RuntimeError(
-        "paddle_tpu.onnx.export: ONNX export is unavailable (no onnx/"
-        "paddle2onnx in this environment).  Use paddle_tpu.jit.save(layer, "
-        "path, input_spec=...) for a portable AOT artifact "
-        "(StableHLO via jax.export) and paddle_tpu.inference to serve it.")
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, value) -> bytes:
+    if isinstance(value, str):
+        value = value.encode()
+    return _key(field, 2) + _varint(len(value)) + value
+
+
+def _f_float(field: int, value: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", float(value))
+
+
+def _msg(field: int, payload: bytes) -> bytes:
+    return _f_bytes(field, payload)
+
+
+# onnx.TensorProto.DataType
+_FLOAT, _INT64 = 1, 7
+
+
+def _tensor(name: str, arr) -> bytes:
+    arr = np.asarray(arr)
+    if arr.dtype == np.int64:
+        dt = _INT64
+    else:
+        arr = arr.astype(np.float32)
+        dt = _FLOAT
+    out = b"".join(_f_varint(1, d) for d in arr.shape)   # dims
+    out += _f_varint(2, dt)                              # data_type
+    out += _f_bytes(8, name)                             # name
+    out += _f_bytes(9, arr.tobytes())                    # raw_data
+    return out
+
+
+def _attr_i(name, v):
+    return _msg(5, _f_bytes(1, name) + _f_varint(3, v) + _f_varint(20, 2))
+
+
+def _attr_f(name, v):
+    return _msg(5, _f_bytes(1, name) + _f_float(2, v) + _f_varint(20, 1))
+
+
+def _attr_ints(name, vs):
+    return _msg(5, _f_bytes(1, name)
+                + b"".join(_f_varint(8, v) for v in vs) + _f_varint(20, 7))
+
+
+def _node(op_type: str, inputs, outputs, name: str, attrs: bytes = b""):
+    out = b"".join(_f_bytes(1, i) for i in inputs)
+    out += b"".join(_f_bytes(2, o) for o in outputs)
+    out += _f_bytes(3, name) + _f_bytes(4, op_type) + attrs
+    return _msg(1, out)                                  # GraphProto.node
+
+
+def _value_info(name: str, shape, elem_type: int = _FLOAT) -> bytes:
+    dims = b""
+    for d in shape:
+        if d is None:
+            dims += _msg(1, _f_bytes(2, "N"))            # dim_param
+        else:
+            dims += _msg(1, _f_varint(1, int(d)))        # dim_value
+    ttype = _f_varint(1, elem_type) + _msg(2, dims)      # elem_type, shape
+    return _f_bytes(1, name) + _msg(2, _msg(1, ttype))   # name, type.tensor
+
+
+# ---------------------------------------------------------------------------
+# layer walker
+# ---------------------------------------------------------------------------
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        if len(v) != 2:
+            raise ValueError(f"expected a 2-element tuple, got {v!r}")
+        return [int(v[0]), int(v[1])]
+    return [int(v), int(v)]
+
+
+class _Graph:
+    def __init__(self):
+        self.nodes = []
+        self.inits = []
+        self.n = 0
+
+    def name(self, base):
+        self.n += 1
+        return f"{base}_{self.n}"
+
+    def init(self, base, arr):
+        nm = self.name(base)
+        self.inits.append(_tensor(nm, arr))
+        return nm
+
+    def add(self, op, inputs, attrs: bytes = b""):
+        out = self.name(op.lower())
+        self.nodes.append(_node(op, inputs, [out], self.name(op), attrs))
+        return out
+
+
+def _emit(layer, g: _Graph, x: str, path: str) -> str:
+    """Append ``layer``'s ONNX nodes; returns the output value name."""
+    kind = type(layer).__name__
+
+    if kind == "Sequential":
+        for i, sub in enumerate(layer):
+            x = _emit(sub, g, x, f"{path}.{i}")
+        return x
+    if kind in ("Dropout", "Identity"):
+        return x                                     # inference: identity
+    if kind == "Linear":
+        w = g.init("weight", layer.weight)           # [in, out]
+        x = g.add("MatMul", [x, w])
+        if layer.bias is not None:
+            x = g.add("Add", [x, g.init("bias", layer.bias)])
+        return x
+    if kind == "ReLU":
+        return g.add("Relu", [x])
+    if kind == "ReLU6":
+        return g.add("Clip", [x, g.init("min", np.float32(0.0)),
+                              g.init("max", np.float32(6.0))])
+    if kind == "Sigmoid":
+        return g.add("Sigmoid", [x])
+    if kind == "Tanh":
+        return g.add("Tanh", [x])
+    if kind == "Softmax":
+        return g.add("Softmax", [x], attrs=_attr_i("axis", layer.axis))
+    if kind == "GELU":
+        if getattr(layer, "approximate", False):
+            # 0.5x(1+tanh(sqrt(2/pi)(x+0.044715x^3)))
+            c3 = g.init("c", np.float32(0.044715))
+            k = g.init("k", np.float32(np.sqrt(2.0 / np.pi)))
+            x3 = g.add("Mul", [x, g.add("Mul", [x, x])])
+            inner = g.add(
+                "Mul", [g.add("Add", [x, g.add("Mul", [c3, x3])]), k])
+            t = g.add("Tanh", [inner])
+            one = g.init("one", np.float32(1.0))
+            half = g.init("half", np.float32(0.5))
+            return g.add(
+                "Mul", [g.add("Mul", [x, g.add("Add", [t, one])]), half])
+        inv = g.init("invsqrt2", np.float32(1.0 / np.sqrt(2.0)))
+        e = g.add("Erf", [g.add("Mul", [x, inv])])
+        one = g.init("one", np.float32(1.0))
+        half = g.init("half", np.float32(0.5))
+        return g.add(
+            "Mul", [g.add("Mul", [x, g.add("Add", [e, one])]), half])
+    if kind == "Flatten":
+        if layer.start_axis != 1 or layer.stop_axis != -1:
+            raise ValueError(
+                f"{path}: only Flatten(1, -1) maps to ONNX Flatten")
+        return g.add("Flatten", [x], attrs=_attr_i("axis", 1))
+    if kind == "LayerNorm":
+        shape = tuple(layer.normalized_shape)
+        # elementwise_affine=False stores None weight/bias — synthesize
+        # the identity affine (ONNX LayerNormalization requires scale)
+        scale = layer.weight if layer.weight is not None \
+            else np.ones(shape, np.float32)
+        bias = layer.bias if layer.bias is not None \
+            else np.zeros(shape, np.float32)
+        attrs = _attr_i("axis", -len(shape)) + \
+            _attr_f("epsilon", layer.epsilon)
+        return g.add("LayerNormalization",
+                     [x, g.init("scale", scale), g.init("bias", bias)],
+                     attrs=attrs)
+    if kind == "Conv2D":
+        if layer.padding_mode != "zeros":
+            raise ValueError(f"{path}: only zero padding exports")
+        pads = _pair(layer.padding)
+        attrs = (_attr_ints("strides", _pair(layer.stride))
+                 + _attr_ints("pads", pads + pads)
+                 + _attr_ints("dilations", _pair(layer.dilation))
+                 + _attr_i("group", layer.groups))
+        ins = [x, g.init("weight", layer.weight)]    # [out, in, kh, kw]
+        if layer.bias is not None:
+            ins.append(g.init("bias", layer.bias))
+        return g.add("Conv", ins, attrs=attrs)
+    if kind in ("MaxPool2D", "AvgPool2D"):
+        if getattr(layer, "ceil_mode", False):
+            raise ValueError(f"{path}: ceil_mode pooling not supported")
+        k = _pair(layer.kernel_size)
+        s = _pair(layer.stride if layer.stride is not None
+                  else layer.kernel_size)
+        p = _pair(layer.padding)
+        attrs = (_attr_ints("kernel_shape", k) + _attr_ints("strides", s)
+                 + _attr_ints("pads", p + p))
+        if kind == "AvgPool2D":
+            # exclusive/divisor_override live in layer.kw (not attrs)
+            kw = getattr(layer, "kw", {})
+            if kw.get("divisor_override") is not None:
+                raise ValueError(
+                    f"{path}: divisor_override has no ONNX equivalent")
+            # paddle's exclusive=False counts padding in the mean
+            attrs += _attr_i("count_include_pad",
+                             0 if kw.get("exclusive", True) else 1)
+            return g.add("AveragePool", [x], attrs=attrs)
+        return g.add("MaxPool", [x], attrs=attrs)
+    if kind == "BatchNorm2D":
+        attrs = _attr_f("epsilon", layer.epsilon)
+        return g.add("BatchNormalization",
+                     [x, g.init("scale", layer.weight),
+                      g.init("bias", layer.bias),
+                      g.init("mean", layer._mean),
+                      g.init("var", layer._variance)], attrs=attrs)
+    raise ValueError(
+        f"paddle_tpu.onnx.export: layer {path} ({kind}) is outside the "
+        "supported subset (Linear/Conv2D/pooling/norms/activations/"
+        "Flatten/Dropout/Sequential); use paddle_tpu.jit.save for the "
+        "general AOT path")
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 17,
+           **configs):
+    """Export ``layer`` to ``{path}.onnx``.
+
+    ``input_spec``: one shape tuple/list (or a ``static.InputSpec``) for
+    the single graph input; a leading ``None`` dim becomes the dynamic
+    batch dim ``"N"``.  Returns the output path.
+    """
+    if input_spec is None:
+        raise ValueError("input_spec (the input shape) is required")
+    spec = input_spec[0] if (isinstance(input_spec, (list, tuple))
+                             and input_spec
+                             and hasattr(input_spec[0], "shape")) \
+        else input_spec
+    shape = list(getattr(spec, "shape", spec))
+    if opset_version < 17:
+        raise ValueError(
+            "opset_version >= 17 required (LayerNormalization)")
+
+    g = _Graph()
+    out_name = _emit(layer, g, "input", "model")
+    # output shape: abstract trace, no compile/execute
+    import jax
+    import jax.numpy as jnp
+    probe = jax.ShapeDtypeStruct(
+        tuple(1 if d is None else int(d) for d in shape), jnp.float32)
+    was_training = getattr(layer, "training", False)
+    try:
+        layer.eval()
+        out_shape = list(jax.eval_shape(layer, probe).shape)
+    finally:
+        if was_training:
+            layer.train()
+    if shape and shape[0] is None:
+        out_shape[0] = None
+
+    graph = b"".join(g.nodes)
+    graph += _f_bytes(2, "paddle_tpu")
+    graph += b"".join(_msg(5, t) for t in g.inits)
+    graph += _msg(11, _value_info("input", shape))
+    graph += _msg(12, _value_info(out_name, out_shape))
+    model = (_f_varint(1, 8)                             # ir_version
+             + _f_bytes(2, "paddle_tpu")                 # producer_name
+             + _msg(7, graph)
+             + _msg(8, _f_bytes(1, "") + _f_varint(2, opset_version)))
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

@@ -1,0 +1,237 @@
+"""paddle.onnx.export oracle: parse the emitted protobuf back with an
+INDEPENDENT generic wire-format reader and EXECUTE the graph with torch
+ops — numeric parity with the source paddle_tpu model proves the bytes
+encode the same function (onnxruntime conformance is untestable here;
+documented stance in paddle_tpu/onnx.py)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu import onnx as ponnx
+
+torch = pytest.importorskip("torch")
+
+
+# --------------------------- generic pb reader ---------------------------
+
+def _read_varint(buf, i):
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def parse_pb(buf):
+    """bytes -> {field: [values]}; length-delimited values stay bytes."""
+    out = {}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        else:
+            raise ValueError(f"wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _tensor_np(tb):
+    t = parse_pb(tb)
+    dims = t.get(1, [])
+    dt = t.get(2, [1])[0]
+    raw = t.get(9, [b""])[0]
+    dtype = np.float32 if dt == 1 else np.int64
+    return np.frombuffer(raw, dtype).reshape(dims), t[8][0].decode()
+
+
+def _signed(v):
+    """protobuf int64 varints are two's-complement 64-bit."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _attrs(node):
+    out = {}
+    for ab in node.get(5, []):
+        a = parse_pb(ab)
+        name = a[1][0].decode()
+        atype = a.get(20, [0])[0]
+        if atype == 1:
+            out[name] = a[2][0]
+        elif atype == 2:
+            out[name] = _signed(a[3][0])
+        elif atype == 7:
+            out[name] = [_signed(v) for v in a.get(8, [])]
+        else:
+            raise ValueError(f"attr type {atype}")
+    return out
+
+
+def run_onnx(path, x):
+    """Execute the exported graph on torch CPU tensors."""
+    model = parse_pb(open(path, "rb").read())
+    assert model[1][0] >= 8                       # ir_version
+    opset = parse_pb(model[8][0])
+    assert opset[2][0] >= 17
+    graph = parse_pb(model[7][0])
+    env = {"input": torch.from_numpy(np.asarray(x, np.float32))}
+    for ib in graph.get(5, []):
+        arr, name = _tensor_np(ib)
+        env[name] = torch.from_numpy(arr.copy())
+    for nb in graph[1]:
+        node = parse_pb(nb)
+        ins = [env[s.decode()] for s in node.get(1, [])]
+        (out_name,) = [s.decode() for s in node[2]]
+        op = node[4][0].decode()
+        at = _attrs(node)
+        if op == "MatMul":
+            r = ins[0] @ ins[1]
+        elif op == "Add":
+            r = ins[0] + ins[1]
+        elif op == "Mul":
+            r = ins[0] * ins[1]
+        elif op == "Relu":
+            r = torch.relu(ins[0])
+        elif op == "Clip":
+            r = torch.clamp(ins[0], ins[1].item(), ins[2].item())
+        elif op == "Sigmoid":
+            r = torch.sigmoid(ins[0])
+        elif op == "Tanh":
+            r = torch.tanh(ins[0])
+        elif op == "Erf":
+            r = torch.erf(ins[0])
+        elif op == "Softmax":
+            r = torch.softmax(ins[0], dim=int(at["axis"]))
+        elif op == "Flatten":
+            r = torch.flatten(ins[0], start_dim=int(at["axis"]))
+        elif op == "LayerNormalization":
+            shape = tuple(ins[1].shape)
+            r = torch.nn.functional.layer_norm(
+                ins[0], shape, ins[1], ins[2], eps=at["epsilon"])
+        elif op == "Conv":
+            p = at["pads"]
+            assert p[0] == p[2] and p[1] == p[3]
+            r = torch.nn.functional.conv2d(
+                ins[0], ins[1], ins[2] if len(ins) > 2 else None,
+                stride=tuple(at["strides"]), padding=(p[0], p[1]),
+                dilation=tuple(at["dilations"]), groups=int(at["group"]))
+        elif op == "MaxPool":
+            p = at["pads"]
+            r = torch.nn.functional.max_pool2d(
+                ins[0], tuple(at["kernel_shape"]),
+                stride=tuple(at["strides"]), padding=(p[0], p[1]))
+        elif op == "AveragePool":
+            p = at["pads"]
+            r = torch.nn.functional.avg_pool2d(
+                ins[0], tuple(at["kernel_shape"]),
+                stride=tuple(at["strides"]), padding=(p[0], p[1]),
+                count_include_pad=bool(at.get("count_include_pad", 0)))
+        elif op == "BatchNormalization":
+            r = torch.nn.functional.batch_norm(
+                ins[0], ins[3], ins[4], ins[1], ins[2],
+                training=False, eps=at["epsilon"])
+        else:
+            raise ValueError(f"unexpected op {op}")
+        env[out_name] = r
+    out_vi = parse_pb(graph[12][0])
+    return env[out_vi[1][0].decode()].numpy()
+
+
+# ------------------------------- tests -----------------------------------
+
+def test_export_mlp_numeric_parity(tmp_path):
+    model = nn.Sequential(
+        nn.Linear(12, 32), nn.GELU(), nn.LayerNorm(32), nn.Dropout(0.5),
+        nn.Linear(32, 7), nn.Softmax(-1))
+    path = ponnx.export(model, str(tmp_path / "mlp"),
+                        input_spec=(None, 12))
+    x = np.random.RandomState(0).randn(5, 12).astype(np.float32)
+    model.eval()
+    want = np.asarray(model(jnp.asarray(x)))
+    got = run_onnx(path, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_export_lenet_style_cnn(tmp_path):
+    model = nn.Sequential(
+        nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 16, 5), nn.ReLU(), nn.AvgPool2D(2, 2),
+        nn.Flatten(), nn.Linear(16 * 5 * 5, 10))
+    path = ponnx.export(model, str(tmp_path / "lenet"),
+                        input_spec=(None, 1, 28, 28))
+    x = np.random.RandomState(1).randn(3, 1, 28, 28).astype(np.float32)
+    model.eval()
+    want = np.asarray(model(jnp.asarray(x)))
+    got = run_onnx(path, x)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_export_bn_tanh_gelu_variants(tmp_path):
+    model = nn.Sequential(
+        nn.Conv2D(3, 4, 3, stride=2, padding=1), nn.BatchNorm2D(4),
+        nn.Tanh(), nn.Flatten(), nn.Linear(4 * 4 * 4, 6),
+        nn.GELU(approximate=True), nn.Linear(6, 3), nn.ReLU6())
+    # make BN stats non-trivial
+    model[1]._mean = jnp.asarray(np.random.RandomState(2).randn(4) * 0.1)
+    model[1]._variance = jnp.asarray(
+        np.random.RandomState(3).rand(4) + 0.5)
+    path = ponnx.export(model, str(tmp_path / "bn"),
+                        input_spec=(None, 3, 8, 8))
+    x = np.random.RandomState(4).randn(2, 3, 8, 8).astype(np.float32)
+    model.eval()
+    want = np.asarray(model(jnp.asarray(x)))
+    got = run_onnx(path, x)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_export_avgpool_exclusive_false_and_plain_layernorm(tmp_path):
+    model = nn.Sequential(
+        nn.Conv2D(2, 3, 3, padding=1), nn.AvgPool2D(2, padding=1,
+                                                    exclusive=False),
+        nn.Flatten(), nn.LayerNorm(3 * 5 * 5, weight_attr=False,
+                                   bias_attr=False))
+    path = ponnx.export(model, str(tmp_path / "ap"),
+                        input_spec=(None, 2, 8, 8))
+    x = np.random.RandomState(5).randn(2, 2, 8, 8).astype(np.float32)
+    model.eval()
+    want = np.asarray(model(jnp.asarray(x)))
+    got = run_onnx(path, x)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="divisor_override"):
+        ponnx.export(nn.Sequential(
+            nn.AvgPool2D(2, divisor_override=3)),
+            str(tmp_path / "dv"), input_spec=(None, 1, 4, 4))
+
+
+def test_export_unsupported_layer_clear_error(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 4), nn.LSTM(4, 4))
+    with pytest.raises(ValueError, match="LSTM"):
+        ponnx.export(model, str(tmp_path / "bad"), input_spec=(None, 4))
+    with pytest.raises(ValueError, match="input_spec"):
+        ponnx.export(nn.Linear(2, 2), str(tmp_path / "x"))
+
+
+def test_export_initializers_roundtrip(tmp_path):
+    lin = nn.Linear(3, 5)
+    path = ponnx.export(nn.Sequential(lin), str(tmp_path / "w"),
+                        input_spec=(None, 3))
+    graph = parse_pb(parse_pb(open(path, "rb").read())[7][0])
+    arrs = dict(_tensor_np(t)[::-1] for t in graph[5])
+    weights = [a for a in arrs.values() if a.shape == (3, 5)]
+    np.testing.assert_allclose(weights[0], np.asarray(lin.weight))
