@@ -15,7 +15,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["DatasetFolder", "ImageFolder", "FakeData", "MNIST", "Cifar10"]
+__all__ = ["DatasetFolder", "ImageFolder", "FakeData", "MNIST",
+           "FashionMNIST", "Cifar10", "Cifar100", "Flowers", "VOC2012"]
 
 
 class FakeData(Dataset):
@@ -115,10 +116,11 @@ class _ArchiveBacked(Dataset):
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=True, backend=None):
-        if image_path is None or not os.path.exists(image_path):
-            raise RuntimeError(
-                f"{self._NAME}: no network access in this environment — "
-                f"provide image_path/label_path to local files")
+        for p in (image_path, label_path):
+            if p is None or not os.path.exists(p):
+                raise RuntimeError(
+                    f"{self._NAME}: no network access in this environment "
+                    f"— provide image_path/label_path to local files")
 
 
 class MNIST(_ArchiveBacked):
@@ -148,8 +150,15 @@ class MNIST(_ArchiveBacked):
         return img, np.int64(self.labels[idx])
 
 
+class FashionMNIST(MNIST):
+    """Same idx format as MNIST, different archive contents."""
+
+    _NAME = "FashionMNIST"
+
+
 class Cifar10(_ArchiveBacked):
     _NAME = "Cifar10"
+    _LABEL_KEY = b"labels"
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
@@ -158,7 +167,7 @@ class Cifar10(_ArchiveBacked):
         with open(data_file, "rb") as f:
             d = pickle.load(f, encoding="bytes")
         self.images = d[b"data"].reshape(-1, 3, 32, 32)
-        self.labels = np.asarray(d[b"labels"])
+        self.labels = np.asarray(d[self._LABEL_KEY])
         self.transform = transform
 
     def __len__(self):
@@ -169,3 +178,37 @@ class Cifar10(_ArchiveBacked):
         if self.transform is not None:
             img = self.transform(img)
         return img, np.int64(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 python-format batch (fine labels)."""
+
+    _NAME = "Cifar100"
+    _LABEL_KEY = b"fine_labels"
+
+
+class Flowers(_ArchiveBacked):
+    """Flowers-102 needs downloaded .mat archives: raises with guidance
+    (zero egress; reference: vision/datasets/flowers.py)."""
+
+    _NAME = "Flowers"
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        raise RuntimeError(
+            "Flowers: the reference loader parses downloaded .mat archives;"
+            " no network access here — use DatasetFolder over an extracted "
+            "local copy")
+
+
+class VOC2012(_ArchiveBacked):
+    """VOC segmentation needs the downloaded archive: raises with
+    guidance (zero egress; reference: vision/datasets/voc2012.py)."""
+
+    _NAME = "VOC2012"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        raise RuntimeError(
+            "VOC2012: needs the downloaded archive; no network access "
+            "here — use DatasetFolder/ImageFolder over an extracted copy")

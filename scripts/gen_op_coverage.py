@@ -291,6 +291,19 @@ Orthogonal TruncatedNormal Uniform XavierNormal XavierUniform
 calculate_gain
 """
 
+PADDLE_VISION_DATASETS = """
+Cifar10 Cifar100 DatasetFolder FashionMNIST Flowers ImageFolder MNIST
+VOC2012
+"""
+
+PADDLE_INCUBATE_NN_F = """
+fused_bias_dropout_residual_layer_norm fused_dropout_add
+fused_feedforward fused_layer_norm fused_linear fused_linear_activation
+fused_matmul_bias fused_multi_head_attention fused_multi_transformer
+fused_rms_norm fused_rotary_position_embedding
+masked_multihead_attention swiglu
+"""
+
 REFERENCE = {
     "paddle": PADDLE_TOP,
     "paddle.distributed": PADDLE_DISTRIBUTED,
@@ -326,6 +339,8 @@ REFERENCE = {
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
     "paddle.autograd": PADDLE_AUTOGRAD,
     "paddle.nn.initializer": PADDLE_NN_INITIALIZER,
+    "paddle.vision.datasets": PADDLE_VISION_DATASETS,
+    "paddle.incubate.nn.functional": PADDLE_INCUBATE_NN_F,
 }
 
 # repo namespace that answers for each reference namespace
@@ -364,6 +379,8 @@ TARGETS = {
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
     "paddle.autograd": "paddle_tpu.autograd",
     "paddle.nn.initializer": "paddle_tpu.nn.initializer",
+    "paddle.vision.datasets": "paddle_tpu.vision.datasets",
+    "paddle.incubate.nn.functional": "paddle_tpu.incubate.nn.functional",
 }
 
 

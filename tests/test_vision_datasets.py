@@ -1,0 +1,97 @@
+"""vision.datasets: local-file parsers against synthesized archives
+(idx-format MNIST bytes, CIFAR python pickles, class folders) — no
+network involved, matching the module's documented offline stance."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import datasets as D
+
+
+def _write_idx(tmp_path, images, labels):
+    n = len(images)
+    img_path = os.path.join(tmp_path, "images-idx3")
+    lbl_path = os.path.join(tmp_path, "labels-idx1")
+    with open(img_path, "wb") as f:
+        f.write((2051).to_bytes(4, "big") + n.to_bytes(4, "big")
+                + (28).to_bytes(4, "big") + (28).to_bytes(4, "big")
+                + np.asarray(images, np.uint8).tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write((2049).to_bytes(4, "big") + n.to_bytes(4, "big")
+                + np.asarray(labels, np.uint8).tobytes())
+    return img_path, lbl_path
+
+
+@pytest.mark.parametrize("cls", [D.MNIST, D.FashionMNIST])
+def test_mnist_family_parses_idx(cls, tmp_path):
+    rs = np.random.RandomState(0)
+    images = rs.randint(0, 256, (5, 28, 28), np.uint8)
+    labels = np.arange(5, dtype=np.uint8)
+    ip, lp = _write_idx(str(tmp_path), images, labels)
+    ds = cls(image_path=ip, label_path=lp)
+    assert len(ds) == 5
+    img, lbl = ds[3]
+    np.testing.assert_array_equal(img, images[3])
+    assert int(lbl) == 3
+    with pytest.raises(RuntimeError, match="local files"):
+        cls(image_path=str(tmp_path / "missing"))
+
+
+def test_cifar10_and_100(tmp_path):
+    rs = np.random.RandomState(1)
+    data = rs.randint(0, 256, (4, 3 * 32 * 32), np.uint8)
+    p10 = str(tmp_path / "c10")
+    with open(p10, "wb") as f:
+        pickle.dump({b"data": data, b"labels": [0, 1, 2, 3]}, f)
+    ds = D.Cifar10(data_file=p10)
+    img, lbl = ds[2]
+    assert img.shape == (3, 32, 32) and int(lbl) == 2
+
+    p100 = str(tmp_path / "c100")
+    with open(p100, "wb") as f:
+        pickle.dump({b"data": data, b"fine_labels": [9, 8, 7, 6]}, f)
+    ds100 = D.Cifar100(data_file=p100)
+    assert int(ds100[1][1]) == 8
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    for cls_name, vals in [("cat", [0.1, 0.2]), ("dog", [0.3])]:
+        d = tmp_path / "root" / cls_name
+        d.mkdir(parents=True)
+        for i, v in enumerate(vals):
+            np.save(str(d / f"{i}.npy"), np.full((2, 2), v, np.float32))
+    ds = D.DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cat", "dog"] and len(ds) == 3
+    img, lbl = ds[2]
+    assert int(lbl) == 1 and float(img[0, 0]) == np.float32(0.3)
+
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    np.save(str(flat / "a.npy"), np.zeros((2, 2), np.float32))
+    (imf,) = [D.ImageFolder(str(flat))[0]]
+    assert imf[0].shape == (2, 2)
+
+
+def test_fakedata_deterministic():
+    ds = D.FakeData(size=10, image_shape=(3, 8, 8), num_classes=4)
+    a_img, a_lbl = ds[7]
+    b_img, b_lbl = ds[7]
+    np.testing.assert_array_equal(a_img, b_img)
+    assert a_lbl == b_lbl and a_img.shape == (3, 8, 8)
+
+
+def test_download_backed_raise_with_guidance(tmp_path):
+    with pytest.raises(RuntimeError, match="DatasetFolder"):
+        D.Flowers()
+    with pytest.raises(RuntimeError, match="DatasetFolder"):
+        D.VOC2012()
+    # label_path missing must ALSO give the guidance error, not TypeError
+    rs = np.random.RandomState(2)
+    ip, _ = _write_idx(str(tmp_path),
+                       rs.randint(0, 256, (2, 28, 28), np.uint8),
+                       np.zeros(2, np.uint8))
+    with pytest.raises(RuntimeError, match="local files"):
+        D.FashionMNIST(image_path=ip)
