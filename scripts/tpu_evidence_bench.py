@@ -528,6 +528,32 @@ def _kernel_compare(budget_s, seq=2048):
         res["truncated"] = "budget"
         return res
 
+    # long-context flash fwd (s8192): the dense XLA path materializes the
+    # S^2 score tensor — this row shows the streamed kernel where the
+    # dense path slows or OOMs (SURVEY §7 "prove necessity"; the
+    # long-context claim's single-chip evidence)
+    try:
+        sl = 8192
+        ql = jnp.asarray(rs.randn(1, sl, 8, 128), jnp.bfloat16)
+        kl = jnp.asarray(rs.randn(1, sl, 8, 128), jnp.bfloat16)
+        vl = jnp.asarray(rs.randn(1, sl, 8, 128), jnp.bfloat16)
+        pl_fwd, _ = _attn_steps(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=False))
+        r = {"pallas_ms": round(timeit_chain(pl_fwd, (ql, kl, vl)), 2)}
+        try:
+            xl_fwd, _ = _attn_steps(lambda q, k, v: sdpa_reference(
+                q, k, v, is_causal=True, training=False).astype(q.dtype))
+            r["xla_ms"] = round(timeit_chain(xl_fwd, (ql, kl, vl)), 2)
+            r["speedup"] = round(r["xla_ms"] / max(r["pallas_ms"], 1e-9), 2)
+        except Exception as e:  # dense S^2 path ran out of HBM
+            r["xla_ms"] = f"failed: {repr(e)[-120:]}"
+        res["flash_attn_fwd_s8192"] = r
+    except Exception as e:
+        res["flash_attn_fwd_s8192"] = {"error": repr(e)[-200:]}
+    if left() < need:
+        res["truncated"] = "budget"
+        return res
+
     # fused AdamW vs XLA (optax-style tree update); chain (p,m,v) through
     # the update like a real optimizer loop, g constant
     try:
