@@ -21,6 +21,8 @@ from . import fleet as _fleet_mod  # noqa: F401
 from .fleet import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import sharding_utils  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
 from . import pipelining  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import rpc  # noqa: F401

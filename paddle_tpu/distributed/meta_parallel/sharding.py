@@ -130,16 +130,11 @@ def group_sharded_parallel(model, optimizer, level: str = "os", scaler=None,
                            sync_comm=False):
     """Entry point parity: python/paddle/distributed/sharding/group_sharded.py.
 
-    Returns (model_wrapper, sharding_optimizer, scaler).
-    """
-    level_map = {"os": "os", "os_g": "os_g", "p_g_os": "p_g_os",
-                 "stage1": "os", "stage2": "os_g", "stage3": "p_g_os"}
-    lvl = level_map[level]
-    opt = ShardingOptimizer(optimizer, level=lvl)
-    if lvl == "os":
-        wrapper = model
-    elif lvl == "os_g":
-        wrapper = GroupShardedStage2(model, opt)
-    else:
-        wrapper = GroupShardedStage3(model, opt)
-    return wrapper, opt, scaler
+    Delegates to the canonical layout-applying implementation in
+    ``distributed.sharding`` (one entry point, one behavior); the
+    ShardingOptimizer/GroupSharded* classes above remain for fleet's
+    spec-reporting flows (fleet.distributed_optimizer)."""
+    from ..sharding import group_sharded_parallel as _canonical
+    return _canonical(model, optimizer, level=level, scaler=scaler,
+                      group=group, offload=offload,
+                      sync_buffers=sync_buffers)
