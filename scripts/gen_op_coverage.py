@@ -136,7 +136,7 @@ istft stft
 PADDLE_DISTRIBUTED = """
 ReduceOp all_gather all_gather_object all_reduce alltoall alltoall_single
 barrier broadcast broadcast_object_list destroy_process_group get_backend
-get_group get_rank get_world_size gather init_parallel_env irecv isend
+get_group get_rank get_world_size group_sharded_parallel gather init_parallel_env irecv isend
 is_initialized new_group recv reduce reduce_scatter scatter
 scatter_object_list send spawn wait stream
 ParallelEnv DistributedStrategy fleet get_hybrid_communicate_group
