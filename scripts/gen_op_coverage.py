@@ -280,6 +280,10 @@ DistributedStrategy barrier_worker distributed_model distributed_optimizer
 init is_first_worker worker_index worker_num
 """
 
+PADDLE_FLEET_UTILS = """
+HDFSClient LocalFS recompute recompute_sequential
+"""
+
 PADDLE_AUTOGRAD = """
 PyLayer PyLayerContext backward grad hessian is_grad_enabled jacobian jvp
 no_grad vjp
@@ -337,6 +341,7 @@ REFERENCE = {
     "paddle.hub": PADDLE_HUB,
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
+    "paddle.distributed.fleet.utils": PADDLE_FLEET_UTILS,
     "paddle.autograd": PADDLE_AUTOGRAD,
     "paddle.nn.initializer": PADDLE_NN_INITIALIZER,
     "paddle.vision.datasets": PADDLE_VISION_DATASETS,
@@ -377,6 +382,7 @@ TARGETS = {
     "paddle.hub": "paddle_tpu.hub",
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
+    "paddle.distributed.fleet.utils": "paddle_tpu.distributed.fleet_utils",
     "paddle.autograd": "paddle_tpu.autograd",
     "paddle.nn.initializer": "paddle_tpu.nn.initializer",
     "paddle.vision.datasets": "paddle_tpu.vision.datasets",
