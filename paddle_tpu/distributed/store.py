@@ -289,7 +289,9 @@ class TCPStore:
                            f"not reachable")
 
     def _rpc(self, op, key: bytes, val: bytes = b"", timeout=None):
-        deadline = time.time() + (timeout or self.timeout)
+        # explicit timeout=0 is a non-blocking probe, not "use default"
+        deadline = time.time() + (self.timeout if timeout is None
+                                  else timeout)
         if self.is_master and self.backend == "python":
             # local fast path against the same dict the server serves
             if op == "set":

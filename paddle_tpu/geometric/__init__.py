@@ -133,6 +133,10 @@ def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
         raise ValueError("return_eids=True requires eids")
     eids_np = None if eids is None else np.asarray(eids)
     w = None if weight is None else np.asarray(weight, np.float64)
+    if w is not None and (w < 0).any():
+        raise ValueError(
+            "edge_weight must be non-negative (weights are sampling "
+            "probabilities, not scores)")
 
     rng = np.random.default_rng()
     out_neighbors, out_eids, counts = [], [], np.empty(len(nodes), np.int64)

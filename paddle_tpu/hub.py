@@ -19,23 +19,37 @@ import sys
 __all__ = ["list", "help", "load"]
 
 _HUBCONF = "hubconf.py"
+_CACHE: dict = {}   # abspath -> (mtime, module)
 
 
-def _load_hubconf(repo_dir: str):
-    path = os.path.join(repo_dir, _HUBCONF)
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
+    path = os.path.abspath(os.path.join(repo_dir, _HUBCONF))
     if not os.path.isfile(path):
         raise FileNotFoundError(
             f"no {_HUBCONF} in {repo_dir!r} — a hub repo directory must "
             "define its entrypoints there (reference: paddle.hub)")
+    mtime = os.path.getmtime(path)
+    cached = _CACHE.get(path)
+    if cached is not None and cached[0] == mtime and not force_reload:
+        return cached[1]   # one exec per repo (list/help/load share it)
     spec = importlib.util.spec_from_file_location(
-        f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(path)))}", path)
+        f"paddle_tpu_hubconf_{abs(hash(path))}", path)
     mod = importlib.util.module_from_spec(spec)
-    # hubconf may import siblings from its repo dir
+    # hubconf may import siblings from its repo dir — but those imports
+    # must not leak: two repos with same-named helpers.py would otherwise
+    # silently share the first one's cached module
     sys.path.insert(0, repo_dir)
+    before = set(sys.modules)
     try:
         spec.loader.exec_module(mod)
     finally:
         sys.path.remove(repo_dir)
+        for name in set(sys.modules) - before:
+            m = sys.modules[name]
+            f = getattr(m, "__file__", None)
+            if f and os.path.abspath(f).startswith(
+                    os.path.abspath(repo_dir) + os.sep):
+                del sys.modules[name]
     deps = getattr(mod, "dependencies", None)
     if deps:
         missing = [d for d in deps
@@ -44,6 +58,7 @@ def _load_hubconf(repo_dir: str):
             raise RuntimeError(
                 f"hubconf at {repo_dir!r} requires missing packages: "
                 f"{missing}")
+    _CACHE[path] = (mtime, mod)
     return mod
 
 
@@ -70,14 +85,14 @@ def list(repo_dir: str, source: str = "github", force_reload: bool = False):
     Reference: python/paddle/hub.py — ``list``.
     """
     _check_source(source)
-    return sorted(_entrypoints(_load_hubconf(repo_dir)))
+    return sorted(_entrypoints(_load_hubconf(repo_dir, force_reload)))
 
 
 def help(repo_dir: str, model: str, source: str = "github",
          force_reload: bool = False):
     """Docstring of one entrypoint.  Reference: hub.py — ``help``."""
     _check_source(source)
-    eps = _entrypoints(_load_hubconf(repo_dir))
+    eps = _entrypoints(_load_hubconf(repo_dir, force_reload))
     if model not in eps:
         raise ValueError(
             f"unknown entrypoint {model!r}; available: {sorted(eps)}")
@@ -91,7 +106,7 @@ def load(repo_dir: str, model: str, source: str = "github",
     Reference: hub.py — ``load``.
     """
     _check_source(source)
-    eps = _entrypoints(_load_hubconf(repo_dir))
+    eps = _entrypoints(_load_hubconf(repo_dir, force_reload))
     if model not in eps:
         raise ValueError(
             f"unknown entrypoint {model!r}; available: {sorted(eps)}")

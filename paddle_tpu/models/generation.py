@@ -166,6 +166,13 @@ def beam_search(model, input_ids, max_new_tokens: int, beam_size: int = 4,
 
     Returns ``(sequences [batch, prompt+max_new], scores [batch])`` for
     the best beam of each batch row.
+
+    Cache contract: beam tiling/reordering identifies batch-leading cache
+    leaves by ``shape[0] == batch`` — every cache leaf must either lead
+    with the batch dimension or have a leading dim different from the
+    batch size (a non-batch leaf whose leading dim coincidentally equals
+    the batch would be mis-tiled; the shipped GPT/Llama caches satisfy
+    the contract by construction).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -218,8 +225,13 @@ def beam_search(model, input_ids, max_new_tokens: int, beam_size: int = 4,
         logp = jax.nn.log_softmax(
             logits[:, 0].astype(jnp.float32), -1).reshape(b, k, vocab)
         if eos_token_id is not None:
-            # frozen beams: pad continues at zero cost, all else -inf
-            frozen = jnp.full((vocab,), -jnp.inf).at[pad].set(0.0)
+            # frozen beams: exactly one zero-cost continuation slot, all
+            # else -inf.  The slot's INDEX is clamped into vocab (pad may
+            # legitimately sit past the base vocab — appended pad ids);
+            # the actually-emitted token is rewritten to ``pad`` below,
+            # so the clamp never leaks into the output.
+            slot = min(pad, vocab - 1)
+            frozen = jnp.full((vocab,), -jnp.inf).at[slot].set(0.0)
             logp = jnp.where(finished[..., None], frozen, logp)
         cand = scores[..., None] + logp               # [b, k, V]
         scores, idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)
@@ -228,10 +240,11 @@ def beam_search(model, input_ids, max_new_tokens: int, beam_size: int = 4,
         flat = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)
         caches = _gather_beams(caches, flat, bk)
         tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
-        tokens = tokens.at[:, :, t].set(tok)
         if eos_token_id is not None:
-            finished = jnp.take_along_axis(finished, beam_idx, axis=1)
-            finished = finished | (tok == eos_token_id)
+            prev_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+            tok = jnp.where(prev_finished, jnp.asarray(pad, tok.dtype), tok)
+            finished = prev_finished | (tok == eos_token_id)
+        tokens = tokens.at[:, :, t].set(tok)
         return (caches, tokens, tok, scores, finished), None
 
     carry = (caches, tokens0, first.astype(input_ids.dtype), scores,

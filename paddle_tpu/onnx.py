@@ -124,11 +124,9 @@ def _value_info(name: str, shape, elem_type: int = _FLOAT) -> bytes:
 
 
 def _pair(v):
-    if isinstance(v, (tuple, list)):
-        if len(v) != 2:
-            raise ValueError(f"expected a 2-element tuple, got {v!r}")
-        return [int(v[0]), int(v[1])]
-    return [int(v), int(v)]
+    # shared with the conv/pool layers' constructor normalization
+    from .nn.layers.conv import _ntuple
+    return [int(x) for x in _ntuple(v, 2)]
 
 
 class _Graph:
@@ -272,11 +270,22 @@ def export(layer, path: str, input_spec=None, opset_version: int = 17,
     """
     if input_spec is None:
         raise ValueError("input_spec (the input shape) is required")
-    spec = input_spec[0] if (isinstance(input_spec, (list, tuple))
-                             and input_spec
-                             and hasattr(input_spec[0], "shape")) \
-        else input_spec
+    spec = input_spec
+    # accept the reference's list-wrapped forms: [InputSpec(...)] and
+    # [(None, 3, 32, 32)]
+    if isinstance(spec, (list, tuple)) and spec and (
+            hasattr(spec[0], "shape")
+            or isinstance(spec[0], (list, tuple))):
+        if len(spec) != 1:
+            raise ValueError(
+                "onnx.export supports exactly one graph input; got "
+                f"{len(spec)} specs")
+        spec = spec[0]
     shape = list(getattr(spec, "shape", spec))
+    if not shape or not all(d is None or isinstance(d, int)
+                            for d in shape):
+        raise ValueError(
+            f"input_spec must be a shape of ints/None, got {shape!r}")
     if opset_version < 17:
         raise ValueError(
             "opset_version >= 17 required (LayerNormalization)")

@@ -17,7 +17,12 @@ case "${1:-status}" in
     ;;
   stop)
     if [ -f "$PIDFILE" ] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
-      kill "$(cat $PIDFILE)"
+      PID=$(cat $PIDFILE)
+      # the watchdog runs under setsid, so its pid == its process-group
+      # id: kill the whole group so an in-flight evidence bench child
+      # dies too (a restart would otherwise run TWO benches writing the
+      # same candidate file)
+      kill -- "-$PID" 2>/dev/null || kill "$PID"
       rm -f "$PIDFILE"
       echo "stopped"
     else

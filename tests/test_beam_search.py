@@ -131,3 +131,19 @@ def test_beam_under_jit():
     np.testing.assert_array_equal(np.asarray(seq), np.asarray(seq2))
     np.testing.assert_allclose(np.asarray(score), np.asarray(score2),
                                rtol=1e-6)
+
+
+def test_beam_pad_token_past_vocab():
+    """pad ids appended past the base vocab must be EMITTED verbatim by
+    frozen beams (the in-vocab scoring slot is an internal detail)."""
+    rs = np.random.RandomState(5)
+    V, eos, pad = 5, 3, 7            # pad >= vocab
+    table = rs.randn(V, V).astype(np.float32)
+    table[:, eos] += 3.0             # eos very likely -> beams finish
+    model = MarkovLM(table)
+    seq, _ = beam_search(model, jnp.asarray([[0]]), max_new_tokens=4,
+                         beam_size=2, eos_token_id=eos, pad_token_id=pad)
+    row = np.asarray(seq)[0, 1:].tolist()
+    assert eos in row
+    after = row[row.index(eos) + 1:]
+    assert all(t == pad for t in after), row   # pad, not vocab-1

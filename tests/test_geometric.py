@@ -251,3 +251,12 @@ def test_reindex_heter_graph():
     np.testing.assert_array_equal(nodes, [10, 20, 30, 40])
     np.testing.assert_array_equal(src, [1, 2, 2, 3])
     np.testing.assert_array_equal(dst, [0, 1, 0, 1])
+
+
+def test_weighted_sample_rejects_negative_weights():
+    row = np.arange(4)
+    colptr = np.array([0, 4])
+    with pytest.raises(ValueError, match="non-negative"):
+        G.weighted_sample_neighbors(row, colptr,
+                                    np.array([1.0, -0.5, 1.0, 1.0]),
+                                    np.array([0]), sample_size=2)

@@ -87,3 +87,35 @@ def test_mel_frequencies_endpoints_and_monotonic():
     # evenly spaced in mel space
     mels = np.asarray(hz_to_mel(got))
     np.testing.assert_allclose(np.diff(mels), np.diff(mels)[0], rtol=1e-3)
+
+
+def test_hub_two_repos_same_sibling_name_isolated(tmp_path):
+    """Sibling imports must not leak between repos: each repo's hubconf
+    sees ITS OWN helpers.py (review: sys.modules pollution)."""
+    for name, val in [("repo_a", 1), ("repo_b", 2)]:
+        d = tmp_path / name
+        d.mkdir()
+        (d / "helpers.py").write_text(f"VALUE = {val}\n")
+        (d / "hubconf.py").write_text(
+            "from helpers import VALUE\n"
+            "def value():\n    return VALUE\n")
+    assert hub.load(str(tmp_path / "repo_a"), "value", source="local") == 1
+    assert hub.load(str(tmp_path / "repo_b"), "value", source="local") == 2
+
+
+def test_hub_cache_and_force_reload(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "import count_side\ndef n():\n    return count_side.N\n")
+    (tmp_path / "count_side.py").write_text(
+        "import os\nN = int(os.environ.get('HUB_N', '0'))\n")
+    import os as _os
+    _os.environ["HUB_N"] = "1"
+    try:
+        assert hub.load(str(tmp_path), "n", source="local") == 1
+        _os.environ["HUB_N"] = "2"
+        # cached: same mtime -> no re-exec
+        assert hub.load(str(tmp_path), "n", source="local") == 1
+        assert hub.load(str(tmp_path), "n", source="local",
+                        force_reload=True) == 2
+    finally:
+        _os.environ.pop("HUB_N")

@@ -235,3 +235,16 @@ def test_export_initializers_roundtrip(tmp_path):
     arrs = dict(_tensor_np(t)[::-1] for t in graph[5])
     weights = [a for a in arrs.values() if a.shape == (3, 5)]
     np.testing.assert_allclose(weights[0], np.asarray(lin.weight))
+
+
+def test_export_accepts_plain_shape_list(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 2))
+    path = ponnx.export(model, str(tmp_path / "l"),
+                        input_spec=[(None, 4)])   # list-wrapped tuple
+    x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+    model.eval()
+    np.testing.assert_allclose(run_onnx(path, x),
+                               np.asarray(model(jnp.asarray(x))),
+                               rtol=2e-5, atol=2e-6)
+    with pytest.raises(ValueError, match="shape"):
+        ponnx.export(model, str(tmp_path / "bad"), input_spec="nope")

@@ -132,3 +132,13 @@ def test_static_nn_switch_case_unmatched_semantics():
                                        default=lambda: jnp.asarray(-5.0))
                  ) == -5.0
     assert float(static.nn.switch_case(jnp.asarray(5), [f, g])) == 20.0
+
+
+def test_store_timeout_zero_is_nonblocking_probe():
+    import time as _time
+    master = TCPStore("127.0.0.1", 0, is_master=True, native=False)
+    t0 = _time.time()
+    with pytest.raises(TimeoutError):
+        master.get("absent", timeout=0)
+    assert _time.time() - t0 < 2.0   # not the 30s default
+    master.close()
