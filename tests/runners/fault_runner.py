@@ -14,7 +14,9 @@ env._start_heartbeat(interval=0.2)
 
 
 def step(i):
-    return i * 2
+    import time
+    time.sleep(0.3)  # give the heartbeat thread beats on disk before any
+    return i * 2     # fault fires (stale detection needs a first beat)
 
 
 run = fault.wrap(step)

@@ -109,6 +109,28 @@ def test_corrupt_checkpoint_is_detected_at_load(tmp_path):
         "corrupted checkpoint silently round-tripped"
 
 
+def test_hang_fault_through_heartbeat_detector(tmp_path):
+    """kind=hang re-execs a beatless sleep; the launcher's stale-heartbeat
+    detector kills and restarts, and the restart=0 gate lets the retry
+    finish — the declarative form of the hang_runner scenario."""
+    runner = os.path.join(REPO, "tests", "runners", "fault_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PADDLE_TPU_REPO"] = REPO
+    env["PADDLE_FAULT_SPEC"] = "step=1,kind=hang,seconds=600"
+    log_dir = str(tmp_path / "log")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--log_dir", log_dir,
+         "--heartbeat_timeout", "2", "--max_restart", "1", runner],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=150)
+    assert r.returncode == 0, (r.stdout[-300:], r.stderr[-500:])
+    assert "heartbeat stale" in r.stderr
+    logs = open(os.path.join(log_dir, "workerlog.0")).read()
+    assert "FAULT_RUNNER_OK restart=1" in logs
+
+
 def test_exit_fault_through_launcher_restart(tmp_path):
     """Incarnation 0 dies via the declared exit fault at step 2; the
     launcher restarts; restart=0 gating lets incarnation 1 finish."""
