@@ -55,6 +55,15 @@ def _parse_args(argv):
                         "manager.py — ElasticManager) with a local-file "
                         "liveness contract: workers touch "
                         "$PADDLE_HEARTBEAT_FILE via distributed.env.")
+    p.add_argument("--heartbeat_startup_grace", type=float, default=0.0,
+                   help="with --heartbeat_timeout set: a worker that has "
+                        "written NO heartbeat after this many seconds is "
+                        "treated as hung at startup (0 = 10x the "
+                        "timeout).  Catches workers that wedge during "
+                        "import/backend-init, BEFORE their first beat — "
+                        "a plain staleness check can never see those.  "
+                        "Negative disables the check (never-opted-in "
+                        "workers tolerated forever).")
     p.add_argument("--elastic_devices_file", type=str, default=None,
                    help="path to a file holding the CURRENTLY available "
                         "device count; re-read on every (re)launch and "
@@ -69,7 +78,12 @@ def _parse_args(argv):
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if 0 < args.heartbeat_startup_grace <= args.heartbeat_timeout:
+        p.error("--heartbeat_startup_grace must exceed "
+                "--heartbeat_timeout (the staleness pre-check already "
+                "covers the first timeout window)")
+    return args
 
 
 class Container:
@@ -177,8 +191,8 @@ class CollectiveController:
         for c in self.containers:
             c.terminate()
 
-    def _stale_worker(self) -> Optional[int]:
-        """Index of a live worker whose heartbeat went stale, else None."""
+    def _stale_worker(self) -> Optional[tuple]:
+        """(index, reason) of a live worker judged hung, else None."""
         t = self.args.heartbeat_timeout
         if t <= 0:
             return None
@@ -187,14 +201,28 @@ class CollectiveController:
             hb = c.env.get("PADDLE_HEARTBEAT_FILE")
             if not hb or c.poll() is not None:
                 continue
-            if now - getattr(c, "started_at", now) < t:
-                continue  # startup grace: first beat may not be due yet
+            start_age = now - getattr(c, "started_at", now)
+            if start_age < t:
+                continue  # first beat may not be due yet
             try:
                 age = now - os.path.getmtime(hb)
             except OSError:
-                continue  # worker hasn't opted in yet
+                # no beat ever written: hung at startup vs not-opted-in
+                # is undecidable from staleness alone — give a startup
+                # grace, then treat as hung (the import/backend-init
+                # wedge is precisely the failure that never beats).
+                # grace < 0 disables this check (workers that never opt
+                # in are tolerated forever, the pre-round-3 behavior).
+                grace = self.args.heartbeat_startup_grace
+                if grace < 0:
+                    continue
+                grace = grace or 10 * t
+                if start_age > grace:
+                    return i, (f"no heartbeat ever written within the "
+                               f"{grace:.1f}s startup grace")
+                continue
             if age > t:
-                return i
+                return i, f"heartbeat stale (> {t}s)"
         return None
 
     def watch(self) -> int:
@@ -203,11 +231,11 @@ class CollectiveController:
         exit code."""
         while True:
             states = [c.poll() for c in self.containers]
-            stale = self._stale_worker()
-            if stale is not None:
-                print(f"[launch] worker {stale} heartbeat stale "
-                      f"(> {self.args.heartbeat_timeout}s); treating as "
-                      f"hung", file=sys.stderr)
+            hung = self._stale_worker()
+            if hung is not None:
+                stale, why = hung
+                print(f"[launch] worker {stale} heartbeat stale: {why}; "
+                      f"treating as hung", file=sys.stderr)
                 self.containers[stale].terminate()
                 states = [c.poll() for c in self.containers]
                 states[stale] = states[stale] or 1

@@ -149,3 +149,32 @@ def test_exit_fault_through_launcher_restart(tmp_path):
     assert r.returncode == 0, (r.stdout[-300:], r.stderr[-500:])
     logs = open(os.path.join(log_dir, "workerlog.0")).read()
     assert "FAULT_RUNNER_OK restart=1" in logs
+
+
+def test_startup_wedge_detected_without_any_heartbeat(tmp_path):
+    """A worker that wedges BEFORE its first heartbeat (the import/
+    backend-init failure mode) trips the startup grace and restarts."""
+    runner = tmp_path / "wedge_runner.py"
+    runner.write_text(
+        "import os, sys, time\n"
+        "sys.path.insert(0, os.environ['PADDLE_TPU_REPO'])\n"
+        "from paddle_tpu.distributed import env\n"
+        "if int(os.environ.get('PADDLE_RESTART_COUNT', 0)) == 0:\n"
+        "    time.sleep(600)   # wedged before _start_heartbeat\n"
+        "env._start_heartbeat(interval=0.2)\n"
+        "print('WEDGE_RUNNER_OK')\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PADDLE_TPU_REPO"] = REPO
+    log_dir = str(tmp_path / "log")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--log_dir", log_dir,
+         "--heartbeat_timeout", "1", "--heartbeat_startup_grace", "3",
+         "--max_restart", "1", str(runner)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout[-300:], r.stderr[-500:])
+    assert "heartbeat stale" in r.stderr
+    logs = open(os.path.join(log_dir, "workerlog.0")).read()
+    assert "WEDGE_RUNNER_OK" in logs
