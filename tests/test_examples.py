@@ -25,6 +25,12 @@ def _run(script, *args, timeout=600):
     return r.stdout + r.stderr
 
 
+def test_example_deploy_generate():
+    out = _run("deploy_generate.py", "--steps", "60")
+    assert "quantized" in out
+    assert "AOT artifact reloaded, tokens bit-equal" in out
+
+
 def test_example_train_gnn():
     out = _run("train_gnn.py", "--steps", "25", "--nodes", "128",
                "--edges", "1024", "--hidden", "32")
