@@ -127,3 +127,8 @@ define_flag("use_pallas_attention", True,
 define_flag("use_pallas_norm", True,
             "Route last-dim layer_norm (full weight+bias) to the fused "
             "Pallas kernel on TPU")
+define_flag("flash_block_q", 256,
+            "Flash-attention query block rows (kernel tile size); "
+            "env-tunable so on-chip sweeps need no code edits")
+define_flag("flash_block_k", 512,
+            "Flash-attention key/value block rows streamed through VMEM")

@@ -398,7 +398,13 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret,
 # public API
 # ---------------------------------------------------------------------------
 
-def _pick_block(seq: int, want: int) -> int:
+def _pick_block(seq: int, want: Optional[int], flag: str) -> int:
+    """Resolve a block size: explicit arg wins, else the FLAGS_* value
+    (env-tunable so on-chip block sweeps need no code edits), clamped to
+    a divisor of ``seq``."""
+    if want is None:
+        from ..core.flags import get_flags
+        want = int(get_flags(flag)[flag])
     b = min(want, seq)
     while seq % b:
         b //= 2
@@ -455,8 +461,10 @@ _flash_core_seg.defvjp(_flash_core_seg_fwd, _flash_core_seg_bwd)
 
 
 def flash_attention(query, key, value, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 256,
-                    block_k: int = 512, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None):
     """Flash attention over paddle layout [B, S, H, D]; differentiable.
 
     GQA (kv heads < q heads) is handled by head repetition before the
@@ -472,8 +480,8 @@ def flash_attention(query, key, value, causal: bool = False,
         interpret = _interpret_default()
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     sk = key.shape[1]
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
+    bq = _pick_block(sq, block_q, "flash_block_q")
+    bk = _pick_block(sk, block_k, "flash_block_k")
 
     def to3(x):
         return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
@@ -486,7 +494,8 @@ def flash_attention(query, key, value, causal: bool = False,
 def flash_attention_varlen(query, key, value, q_segments, k_segments,
                            causal: bool = False,
                            scale: Optional[float] = None,
-                           block_q: int = 256, block_k: int = 512,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None,
                            interpret: Optional[bool] = None):
     """Segment-masked (varlen/packed) flash attention; differentiable.
 
@@ -506,8 +515,8 @@ def flash_attention_varlen(query, key, value, q_segments, k_segments,
         interpret = _interpret_default()
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     sk = key.shape[1]
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
+    bq = _pick_block(sq, block_q, "flash_block_q")
+    bk = _pick_block(sk, block_k, "flash_block_k")
 
     def to3(x):
         return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
@@ -524,7 +533,8 @@ def flash_attention_varlen(query, key, value, q_segments, k_segments,
 
 def flash_attention_with_lse(query, key, value, causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 256, block_k: int = 512,
+                             block_q: Optional[int] = None,
+                           block_k: Optional[int] = None,
                              interpret: Optional[bool] = None):
     """Forward-only variant that also returns logsumexp [B, H, S] (used by
     ring attention to combine per-shard partial attentions).
@@ -541,8 +551,8 @@ def flash_attention_with_lse(query, key, value, causal: bool = False,
         interpret = _interpret_default()
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     sk = key.shape[1]
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
+    bq = _pick_block(sq, block_q, "flash_block_q")
+    bk = _pick_block(sk, block_k, "flash_block_k")
 
     def to3(x):
         return jnp.moveaxis(x, 1, 2).reshape(b * h, x.shape[1], d)
