@@ -441,6 +441,33 @@ def _secondary_benches(smoke=False):
                                   if decode_tps else "noise-dominated"),
         "config": f"b{db}-prompt{dprompt}-new{dnew}-h{dcfg.hidden_size}"
                   f"-L{dcfg.num_layers}"}
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
+    # 7 int8 weight-only decode — the same loop with quantized weight
+    # storage (decode is weight-HBM-bound; this row measures the payoff)
+    try:
+        import paddle_tpu.nn.quant as Q
+        qm = Q.convert_to_weight_only(dm, weight_dtype="int8")
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def qgen(ids, n):
+            return qm.generate(ids, n)
+
+        seq = qgen(dids, dnew)
+        float(seq[0, -1].astype(jnp.float32))
+        t0 = time.perf_counter()
+        for _ in range(iters_d):
+            seq = qgen(dids, dnew)
+        float(seq[0, -1].astype(jnp.float32))
+        qdt = (time.perf_counter() - t0) / iters_d
+        out["gpt_decode_int8"] = {
+            "step_ms": round(qdt * 1e3, 1),
+            "items_per_sec": round(db * dnew / qdt, 1),
+            "speedup_vs_fp": round(dt / qdt, 2)}
+    except Exception as e:
+        out["gpt_decode_int8"] = {"error": repr(e)[-200:]}
     return out
 
 
