@@ -284,6 +284,11 @@ PADDLE_FLEET_UTILS = """
 HDFSClient LocalFS recompute recompute_sequential
 """
 
+PADDLE_DISTRIBUTED_RPC = """
+WorkerInfo get_all_worker_infos get_current_worker_info get_worker_info
+init_rpc rpc_async rpc_sync shutdown
+"""
+
 PADDLE_AUTOGRAD = """
 PyLayer PyLayerContext backward grad hessian is_grad_enabled jacobian jvp
 no_grad vjp
@@ -342,6 +347,7 @@ REFERENCE = {
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
     "paddle.distributed.fleet.utils": PADDLE_FLEET_UTILS,
+    "paddle.distributed.rpc": PADDLE_DISTRIBUTED_RPC,
     "paddle.autograd": PADDLE_AUTOGRAD,
     "paddle.nn.initializer": PADDLE_NN_INITIALIZER,
     "paddle.vision.datasets": PADDLE_VISION_DATASETS,
@@ -383,6 +389,7 @@ TARGETS = {
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
     "paddle.distributed.fleet.utils": "paddle_tpu.distributed.fleet_utils",
+    "paddle.distributed.rpc": "paddle_tpu.distributed.rpc",
     "paddle.autograd": "paddle_tpu.autograd",
     "paddle.nn.initializer": "paddle_tpu.nn.initializer",
     "paddle.vision.datasets": "paddle_tpu.vision.datasets",
