@@ -289,6 +289,10 @@ def main():
         "tpu_gen": gen, "peak_flops": peak,
         "flops_per_token_formula": "6*N + 12*L*E*S (BASELINE.md)",
         "flops_per_token": flops_per_tok,
+        # kernel-tuning provenance: block sizes the flash kernel resolves
+        # from flags when no explicit args are passed
+        "flash_block_q": os.environ.get("FLAGS_flash_block_q", "256"),
+        "flash_block_k": os.environ.get("FLAGS_flash_block_k", "512"),
     }
     flush()
 
