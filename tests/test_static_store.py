@@ -142,3 +142,42 @@ def test_store_timeout_zero_is_nonblocking_probe():
         master.get("absent", timeout=0)
     assert _time.time() - t0 < 2.0   # not the 30s default
     master.close()
+
+
+def test_native_store_survives_garbage_bytes():
+    """Malformed frames must not crash or wedge the C++ server: it may
+    error-reply or drop the connection, but it keeps serving others."""
+    from paddle_tpu.distributed.store import _native_lib
+    if _native_lib() is None:
+        pytest.skip("no g++ toolchain for the native store")
+    import os
+    import struct
+    master = TCPStore("127.0.0.1", 0, is_master=True, native=True)
+    rs = np.random.RandomState(0)
+    for i in range(20):
+        try:
+            with socket.create_connection(("127.0.0.1", master.port),
+                                          timeout=2.0) as s:
+                s.sendall(bytes(rs.randint(0, 256, rs.randint(1, 64),
+                                           dtype=np.uint8)))
+                s.settimeout(1.0)
+                try:
+                    s.recv(64)
+                except (socket.timeout, ConnectionError, OSError):
+                    pass
+        except OSError:
+            pass
+    # malformed wait key list gets the error status, not a hang
+    with socket.create_connection(("127.0.0.1", master.port),
+                                  timeout=2.0) as s:
+        key = b"\xff\xff\xff\xff"          # count=4G, no payload
+        s.sendall(struct.pack("<B", 4) + struct.pack("<I", len(key)) + key
+                  + struct.pack("<Q", 0) + struct.pack("<Q", 100))
+        s.settimeout(3.0)
+        status = s.recv(1)
+        assert status == b"\x02"           # err, not timeout/hang
+    # server still serves normal clients afterwards
+    client = TCPStore("127.0.0.1", master.port)
+    client.set("alive", b"1")
+    assert client.get("alive") == b"1"
+    master.close()
