@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear", "WeightOnlyLinear", "convert_to_weight_only"]
+           "llm_int8_linear", "WeightOnlyLinear", "LLMInt8Linear",
+           "convert_to_weight_only"]
 
 
 def weight_quantize(x, algo: str = "weight_only_int8", group_size: int = -1):
@@ -152,8 +153,34 @@ class WeightOnlyLinear(_Layer):
                 f"weight_dtype={self.weight_dtype}")
 
 
+class LLMInt8Linear(_Layer):
+    """Inference linear running the LLM.int8 outlier decomposition
+    (arXiv 2208.07339): activations split by column magnitude — outlier
+    columns multiply dequantized float weights, the rest ride the
+    int8 x int8 -> int32 MXU path (:func:`llm_int8_linear`)."""
+
+    def __init__(self, weight, bias, threshold: float = 6.0):
+        super().__init__()
+        q, scale = weight_quantize(weight, algo="weight_only_int8")
+        self.in_features = int(weight.shape[0])
+        self.out_features = int(weight.shape[1])
+        self.threshold = float(threshold)
+        self.register_buffer("w_quant", q)
+        self.register_buffer("w_scale", scale)
+        self.register_buffer("bias", bias)
+
+    def forward(self, x):
+        return llm_int8_linear(x, self.w_quant, self.bias, self.w_scale,
+                               threshold=self.threshold)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, "
+                f"threshold={self.threshold}")
+
+
 def convert_to_weight_only(model, weight_dtype: str = "int8",
-                           inplace: bool = False):
+                           inplace: bool = False, threshold: float = 6.0):
     """Swap every dense linear in ``model`` — ``nn.Linear`` AND the
     Megatron ``ColumnParallelLinear``/``RowParallelLinear`` (their
     single-device forward is the same ``x @ W + b``) — for a
@@ -167,7 +194,14 @@ def convert_to_weight_only(model, weight_dtype: str = "int8",
     so convert the dense model you deploy, not a live mp>1 trainer.
     Embeddings, norms, and tied output heads are untouched.  int4
     requires every converted linear's input dim to be even.
+    ``weight_dtype="llm.int8"`` swaps in :class:`LLMInt8Linear`
+    (outlier-decomposed int8 matmuls, ``threshold`` controlling the
+    outlier column cut).
     """
+    if weight_dtype not in ("int8", "int4", "llm.int8"):
+        raise ValueError(
+            f"weight_dtype must be int8/int4/llm.int8, got "
+            f"{weight_dtype!r}")
     import copy
 
     from ..layer import Layer
@@ -183,8 +217,12 @@ def convert_to_weight_only(model, weight_dtype: str = "int8",
 
     def quantize(layer, cache):
         if id(layer) not in cache:
-            cache[id(layer)] = WeightOnlyLinear(
-                layer.weight, layer.bias, weight_dtype=weight_dtype)
+            if weight_dtype == "llm.int8":
+                cache[id(layer)] = LLMInt8Linear(layer.weight, layer.bias,
+                                                 threshold=threshold)
+            else:
+                cache[id(layer)] = WeightOnlyLinear(
+                    layer.weight, layer.bias, weight_dtype=weight_dtype)
         return cache[id(layer)]
 
     if isinstance(model, kinds):
@@ -205,7 +243,7 @@ def convert_to_weight_only(model, weight_dtype: str = "int8",
         for key, child in list(parent._sub_layers.items()):
             if child is None:
                 continue
-            if isinstance(child, WeightOnlyLinear):
+            if isinstance(child, (WeightOnlyLinear, LLMInt8Linear)):
                 continue
             if isinstance(child, kinds):
                 parent._sub_layers[key] = quantize(child, cache)

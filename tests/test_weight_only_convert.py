@@ -139,3 +139,27 @@ def test_converted_model_grid_weights_exact_generation():
     np.testing.assert_array_equal(
         np.asarray(qm.generate(ids, max_new_tokens=5)),
         np.asarray(model.generate(ids, max_new_tokens=5)))
+
+
+def test_llm_int8_conversion_mode():
+    rs = np.random.RandomState(6)
+    lin = nn.Linear(16, 24)
+    x = jnp.asarray(rs.randn(5, 16), jnp.float32)
+    # make one input column an outlier so both paths run
+    x = x.at[:, 3].set(20.0)
+    m = Q.convert_to_weight_only(nn.Sequential(lin),
+                                 weight_dtype="llm.int8", threshold=6.0)
+    assert type(m[0]) is Q.LLMInt8Linear
+    a, b = np.asarray(lin(x)), np.asarray(m(x))
+    assert np.abs(a - b).max() / np.abs(a).max() < 2e-2
+    with pytest.raises(ValueError, match="weight_dtype"):
+        Q.convert_to_weight_only(lin, weight_dtype="int2")
+
+
+def test_llm_int8_model_generates():
+    rs = np.random.RandomState(7)
+    model = GPTForCausalLM(gpt_tiny())
+    qm = Q.convert_to_weight_only(model, weight_dtype="llm.int8")
+    ids = jnp.asarray(rs.randint(0, 256, (2, 5)))
+    seq = qm.generate(ids, max_new_tokens=3)
+    assert seq.shape == (2, 8)
