@@ -163,3 +163,24 @@ def test_llm_int8_model_generates():
     ids = jnp.asarray(rs.randint(0, 256, (2, 5)))
     seq = qm.generate(ids, max_new_tokens=3)
     assert seq.shape == (2, 8)
+
+
+def test_llama_weight_only_generates():
+    """Llama's bias-free projections convert too; generation runs and the
+    first-step scores track fp within int8 error."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    qm = Q.convert_to_weight_only(model)
+    n_q = sum(1 for _, l in qm.named_sublayers()
+              if type(l) is Q.WeightOnlyLinear)
+    assert n_q >= 2 * 7  # q/k/v/o + gate/up/down per layer
+    ids = jnp.asarray(np.random.RandomState(8).randint(0, 128, (2, 6)))
+    seq, scores = qm.generate(ids, max_new_tokens=3, output_scores=True)
+    _, fp = model.generate(ids, max_new_tokens=3, output_scores=True)
+    rel = np.abs(np.asarray(scores[:, 0]) - np.asarray(fp[:, 0])).max() / \
+        max(float(np.abs(np.asarray(fp[:, 0])).max()), 1e-6)
+    assert seq.shape == (2, 9) and rel < 0.1, rel
