@@ -398,7 +398,8 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret,
 # public API
 # ---------------------------------------------------------------------------
 
-def _pick_block(seq: int, want: Optional[int], flag: str) -> int:
+def _pick_block(seq: int, want: Optional[int] = None,
+                flag: str = "flash_block_q") -> int:
     """Resolve a block size: explicit arg wins, else the FLAGS_* value
     (env-tunable so on-chip block sweeps need no code edits), clamped to
     a divisor of ``seq``."""
