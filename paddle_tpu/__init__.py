@@ -145,21 +145,48 @@ def set_grad_enabled(mode):
 
 
 def in_dynamic_mode() -> _py_bool:
-    """True — eager (dygraph) is the only mode; jit.to_static compiles
-    functions without a global static-graph switch (documented stance:
-    Program/Executor have no analog, SURVEY §2.2)."""
+    """True unless enable_static() was called (reference parity).  Static
+    programs record on Variables regardless of the flag — recording is
+    Variable-driven, so eager code keeps working under enable_static()."""
     return not _static_mode[0]
 
 
 def enable_static():
-    """Records static-mode intent for API parity.  The TPU-native stack
-    compiles through jit.to_static / jax.jit rather than a global
-    program-builder mode; this flag only flips in_dynamic_mode()."""
+    """Enters static-graph mode: installs the Variable-recording dispatch
+    over the public API (static.Program/Executor become usable) and flips
+    in_dynamic_mode().  See paddle_tpu/static/program.py."""
     _static_mode[0] = True
+    from .static import program as _prog
+    _prog._STATIC_ACTIVE[0] = True
+    _prog._install_static_dispatch()
 
 
 def disable_static():
     _static_mode[0] = False
+    from .static import program as _prog
+    _prog._STATIC_ACTIVE[0] = False
+
+
+class CPUPlace:
+    """Reference: paddle.CPUPlace — device placement token.  Under XLA,
+    placement is backend-global (jax default device); Executors accept any
+    Place and run on the active platform."""
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class CUDAPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"CUDAPlace({self.device_id})"
+
+
+class TPUPlace(CUDAPlace):
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
 
 
 def summary(net, input_size=None, dtypes=None, input=None):

@@ -1,0 +1,288 @@
+"""Auto-parallel pass framework.
+
+Reference: python/paddle/distributed/passes/ — PassBase/PassManager/
+new_pass + the auto_parallel pass zoo (auto_parallel_amp.py,
+auto_parallel_recompute.py, auto_parallel_gradient_merge.py,
+fused_linear_promotion; SURVEY.md §2.3 "Auto-parallel passes").
+
+TPU-native recast: the reference's passes rewrite static ProgramDescs
+(insert cast ops, recompute subgraphs, grad-accumulate ops, fuse
+matmul+add).  Under XLA there is no program to rewrite — the jitted step
+IS the program — so a pass here transforms the *step recipe*:
+
+- strategy passes (amp / recompute / gradient_merge) set the Engine's
+  Strategy knobs, which the Engine compiles into the step (cast-at-trace,
+  ``jax.checkpoint``, lax.cond-gated accumulate — the same semantics the
+  reference reaches by op insertion);
+- structural passes (fused_linear_promotion) rewrite the Layer tree in
+  place, preserving parameters (the reference rewrites matmul+elementwise-
+  add into fused_gemm_epilogue ops).
+
+``new_pass(name, attrs)`` / ``PassManager([...]).apply(...)`` keep the
+reference's construction surface; ``apply`` accepts an Engine (strategy
+passes need one) or a bare Layer (structural passes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["PassBase", "PassContext", "PassManager", "new_pass",
+           "register_pass", "PASS_REGISTRY"]
+
+PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    """Reference: paddle.distributed.passes.register_pass decorator."""
+
+    def deco(cls):
+        cls.name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+class PassContext:
+    """Carries attrs + the record of applied passes (reference:
+    PassContext.apply(...) bookkeeping)."""
+
+    def __init__(self):
+        self._attrs: Dict[str, Any] = {}
+        self.applied: List[str] = []
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self, attrs: Optional[dict] = None):
+        self._attrs: Dict[str, Any] = dict(attrs or {})
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+        return self
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+    # reference: _check_self/_check_conflict
+    def check_enable(self, target) -> bool:
+        return True
+
+    def apply(self, target, context: Optional[PassContext] = None):
+        """Transform ``target`` (Engine or Layer) in place; returns it."""
+        context = context or PassContext()
+        if self.check_enable(target):
+            self._apply_impl(target, context)
+            context.applied.append(self.name)
+        return target
+
+    def _apply_impl(self, target, context):
+        raise NotImplementedError
+
+
+def new_pass(name: str, pass_attrs: Optional[dict] = None) -> PassBase:
+    """Reference: paddle.distributed.passes.new_pass(name, attrs)."""
+    cls = PASS_REGISTRY.get(name)
+    if cls is None:
+        known = ", ".join(sorted(PASS_REGISTRY))
+        raise ValueError(f"unknown pass {name!r}; registered: {known}")
+    return cls(pass_attrs)
+
+
+class PassManager:
+    """Reference: paddle.distributed.passes.PassManager([pass...])."""
+
+    def __init__(self, passes: List[PassBase]):
+        for p in passes:
+            if not isinstance(p, PassBase):
+                raise TypeError(f"{p!r} is not a PassBase")
+        self._passes = list(passes)
+        self.context = PassContext()
+
+    @property
+    def names(self) -> List[str]:
+        return [p.name for p in self._passes]
+
+    def apply(self, target):
+        for p in self._passes:
+            target = p.apply(target, self.context)
+        return target
+
+
+# --- the pass zoo ---------------------------------------------------------
+
+def _invalidate_steps(engine):
+    """Drop ALL compiled step closures (train/eval/predict) — a stale
+    _pred_step would silently replay the pre-pass trace."""
+    engine._train_step = None
+    engine._eval_step = None
+    engine._pred_step = None
+
+
+def _engine_of(target):
+    from ..auto_parallel.engine import Engine
+    if isinstance(target, Engine):
+        return target
+    raise TypeError(
+        f"pass needs an auto_parallel Engine target, got {type(target).__name__}")
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """Reference: passes/auto_parallel_amp.py — inserts cast ops per the
+    white/black list.  Here: flips Strategy.amp so the Engine traces the
+    forward in the amp dtype (XLA propagates the casts)."""
+
+    def _apply_impl(self, target, context):
+        e = _engine_of(target)
+        e.strategy.amp.enable = True
+        e.strategy.amp.dtype = self.get_attr("dtype", "bfloat16")
+        e.strategy.amp.level = self.get_attr("level", "O2")
+        _invalidate_steps(e)
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(AMPPass):
+    """Reference: passes/auto_parallel_fp16.py — pure-fp16 variant."""
+
+    def _apply_impl(self, target, context):
+        self.set_attr("dtype", self.get_attr("dtype", "float16"))
+        super()._apply_impl(target, context)
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Reference: passes/auto_parallel_recompute.py — re-forwards checkpoint
+    segments in backward.  Here: Strategy.recompute → jax.checkpoint with
+    the named policy."""
+
+    def _apply_impl(self, target, context):
+        e = _engine_of(target)
+        e.strategy.recompute.enable = True
+        e.strategy.recompute.policy = self.get_attr("policy", "full")
+        _invalidate_steps(e)
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """Reference: passes/auto_parallel_gradient_merge.py — accumulate
+    k_steps of grads, apply once.  Engine compiles it as a lax.cond-gated
+    update inside the same program."""
+
+    def _apply_impl(self, target, context):
+        e = _engine_of(target)
+        e.strategy.gradient_merge.enable = True
+        e.strategy.gradient_merge.k_steps = int(self.get_attr("k_steps", 2))
+        e.strategy.gradient_merge.avg = bool(self.get_attr("avg", True))
+        _invalidate_steps(e)
+        e._merge_state = None
+
+
+@register_pass("fused_linear_promotion")
+class FusedLinearPromotionPass(PassBase):
+    """Reference: fused-linear-promotion (matmul+add → fused_gemm_epilogue;
+    with an adjacent activation, the epilogue takes it too).
+
+    TPU recast: rewrites ``nn.Linear`` followed by an activation layer
+    inside Sequential-like containers into one :class:`FusedLinearAct`
+    module calling ``incubate.nn.functional.fused_linear_activation`` —
+    one call site for XLA's GEMM-epilogue fusion, parameters reused (not
+    copied).  Works on a bare Layer or an Engine (rewrites engine.model
+    and refreshes its captured state)."""
+
+    @classmethod
+    def _act_name(cls, layer) -> Optional[str]:
+        from ...nn.layers import activation as A
+        if type(layer) is A.ReLU:
+            return "relu"
+        # fused epilogue gelu is the tanh approximation — promote only the
+        # matching exact-numerics case (reference epilogues do the same)
+        if type(layer) is A.GELU and getattr(layer, "approximate", False):
+            return "gelu"
+        return None
+
+    def _apply_impl(self, target, context):
+        from ..auto_parallel.engine import Engine
+        if isinstance(target, Engine):
+            n = self._rewrite(target.model)
+            # refresh the engine's captured param/buffer state
+            from ...nn.functional_call import state as _state
+            import jax.numpy as jnp
+            p, b = _state(target.model)
+            target._params = {k: jnp.array(v, copy=True) for k, v in p.items()}
+            target._buffers = b
+            _invalidate_steps(target)
+        else:
+            n = self._rewrite(target)
+        context.set_attr("fused_linear_count", n)
+
+    def _rewrite(self, root) -> int:
+        from ...nn.layers.common import Linear
+        count = 0
+        for sub in self._sequentials(root):
+            items = list(sub._sub_layers.items())
+            i = 0
+            while i + 1 < len(items):
+                (k1, l1), (k2, l2) = items[i], items[i + 1]
+                act = self._act_name(l2)
+                if type(l1) is Linear and act is not None:
+                    fused = FusedLinearAct(l1, act)
+                    sub._sub_layers[k1] = fused
+                    sub._sub_layers[k2] = _Identity()
+                    count += 1
+                    i += 2
+                else:
+                    i += 1
+        return count
+
+    def _sequentials(self, root):
+        """ONLY Sequential containers: adjacency in _sub_layers implies
+        composition order there and nowhere else — rewriting a generic
+        Layer whose forward wires children differently would silently
+        change its math."""
+        from ...nn.layers.container import Sequential
+        seen = []
+
+        def walk(layer):
+            if isinstance(layer, Sequential):
+                seen.append(layer)
+            for c in layer._sub_layers.values():
+                walk(c)
+
+        walk(root)
+        return seen
+
+
+from ...nn.layer import Layer as _Layer  # noqa: E402
+
+
+class _Identity(_Layer):
+    def forward(self, x):
+        return x
+
+
+class FusedLinearAct(_Layer):
+    """Linear + activation in one call (promotion target).  Reuses the
+    source Linear's parameters — state_dict keys keep the ``weight``/
+    ``bias`` names under the original sublayer path."""
+
+    def __init__(self, linear, act: str):
+        super().__init__()
+        from ...nn.layer import Parameter
+        self.add_parameter("weight", Parameter(linear.weight))
+        self.add_parameter(
+            "bias", None if linear.bias is None else Parameter(linear.bias))
+        self.act = act
+
+    def forward(self, x):
+        from ...incubate.nn.functional import fused_linear_activation
+        return fused_linear_activation(x, self.weight, self.bias,
+                                       activation=self.act)

@@ -179,6 +179,20 @@ class Optimizer:
             store, name = index[k]
             store[name] = v
 
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Static-graph entry (reference: Optimizer.minimize).  Marks the
+        loss Variable's Program as a training program; Executor.run then
+        replays forward + AD + this optimizer's pure update as one jitted
+        step.  Returns the reference's (ops, params_grads) tuple shape."""
+        from ..static.program import Variable
+        if isinstance(loss, Variable):
+            loss.program._set_train(loss, self)
+            return None, []
+        raise ValueError(
+            "minimize() takes a static-graph loss Variable; in eager mode "
+            "compute grads functionally and call update()/step()")
+
     def clear_grad(self):
         pass  # grads are values here, nothing to zero (parity no-op)
 

@@ -16,7 +16,16 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+from .program import (  # noqa: F401
+    Executor, Program, StaticGraphError, Variable, create_parameter, data,
+    default_main_program, default_startup_program, global_scope, load,
+    program_guard, save)
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Executor", "Program", "StaticGraphError", "Variable",
+           "create_parameter", "data", "default_main_program",
+           "default_startup_program", "global_scope", "load",
+           "program_guard", "save"]
 
 
 @dataclasses.dataclass
@@ -49,6 +58,27 @@ def load_inference_model(path_prefix: str, executor=None, **kwargs):
 # --- static.nn control flow (reference: paddle.static.nn.cond/while_loop/
 # case/switch_case — dy2static's targets).  Under jit these ARE lax ops. ---
 class _StaticNN:
+    # param-creating builders (reference: paddle.static.nn.fc/conv2d/...)
+    @staticmethod
+    def fc(*a, **k):
+        from .nn_builders import fc as _fc
+        return _fc(*a, **k)
+
+    @staticmethod
+    def conv2d(*a, **k):
+        from .nn_builders import conv2d as _conv2d
+        return _conv2d(*a, **k)
+
+    @staticmethod
+    def batch_norm(*a, **k):
+        from .nn_builders import batch_norm as _bn
+        return _bn(*a, **k)
+
+    @staticmethod
+    def embedding(*a, **k):
+        from .nn_builders import embedding as _emb
+        return _emb(*a, **k)
+
     @staticmethod
     def cond(pred, true_fn, false_fn=None, name=None):
         import jax

@@ -1,0 +1,188 @@
+"""Param-creating static.nn builders.
+
+Reference: paddle.static.nn — fc, conv2d, batch_norm, embedding
+(SURVEY.md §2.2 "static API"; the reference's builders append ops + create
+persistable parameters in the startup program).  Here each builder declares
+its parameters via :func:`create_parameter` (initializers recorded on the
+startup program) and records the functional op on the main tape.
+
+Scope notes (documented deviations):
+- ``batch_norm`` records a training-form node that also yields updated
+  moving stats; the Executor writes them back to the scope after each run
+  (the reference mutates the moving-stat variables in place).
+  ``Program.clone(for_test=True)`` rewrites recorded batch_norm nodes to
+  inference form (moving stats, no write-back) — the reference's op-attr
+  flip.
+- dropout under static replay would fix its mask at trace time; author
+  stochastic-regularized nets in eager mode and convert with
+  ``jit.to_static`` instead (documented in tests).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import program as P
+
+
+def _resolve_init(attr, default=None):
+    """Initializer from a bare Initializer, a ParamAttr(initializer=...),
+    or None -> the builder's default (reference: builders accept both
+    forms; silently ignoring ParamAttr would diverge from the reference's
+    initialization)."""
+    from ..nn import initializer as I
+    from ..nn.layer import ParamAttr
+    if isinstance(attr, I.Initializer):
+        return attr
+    if isinstance(attr, ParamAttr) and attr.initializer is not None:
+        return attr.initializer
+    return default
+
+
+def _act(name):
+    if name is None:
+        return None
+    import paddle_tpu.nn.functional as F
+    fn = getattr(F, name, None)
+    if fn is None:
+        raise P.StaticGraphError(f"unknown activation {name!r}")
+    return fn
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation: Optional[str] = None, name=None):
+    """Reference: paddle.static.nn.fc — flattens trailing dims, y = xW + b.
+    Weight is [in_features, size] (paddle convention)."""
+    from ..nn import initializer as I
+    in_dims = x.shape[num_flatten_dims:]
+    if any(d is None for d in in_dims):
+        raise P.StaticGraphError(
+            "fc needs concrete feature dims (only leading dims may be "
+            f"dynamic); got {x.shape}")
+    in_features = int(math.prod(in_dims))
+    base = name or P.unique_name("fc")
+    w = P.create_parameter([in_features, size], x.dtype, name=f"{base}.w_0",
+                           default_initializer=_resolve_init(weight_attr))
+    bias = None
+    if bias_attr is not False:
+        bias = P.create_parameter([size], x.dtype, name=f"{base}.b_0",
+                                  is_bias=True,
+                                  default_initializer=_resolve_init(bias_attr))
+
+    def _fc(xv, wv, bv=None, _nfd=num_flatten_dims, _inf=in_features):
+        lead = xv.shape[:_nfd]
+        y = xv.reshape(lead + (_inf,)) @ wv
+        if bv is not None:
+            y = y + bv
+        return y
+
+    args = (x, w) if bias is None else (x, w, bias)
+    y = P.record_call(_fc, args, {})
+    a = _act(activation)
+    if a is not None:
+        y = P.record_call(a, (y,), {})
+    return y
+
+
+def embedding(input, size: Sequence[int], is_sparse: bool = False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """Reference: paddle.static.nn.embedding — size=[vocab, dim]."""
+    from ..nn import initializer as I
+    import paddle_tpu.nn.functional as F
+    base = name or P.unique_name("embedding")
+    w = P.create_parameter(list(size), dtype, name=f"{base}.w_0",
+                           default_initializer=_resolve_init(
+                               param_attr, I.Normal(0.0, 0.02)))
+    return P.record_call(F.embedding, (input, w),
+                         {"padding_idx": padding_idx})
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups: int = 1, param_attr=None, bias_attr=None,
+           act: Optional[str] = None, data_format="NCHW", name=None):
+    """Reference: paddle.static.nn.conv2d.  Weight [out_c, in_c/groups, kh, kw]."""
+    from ..nn import initializer as I
+    import paddle_tpu.nn.functional as F
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    ch_axis = 1 if data_format == "NCHW" else input.ndim - 1
+    in_c = input.shape[ch_axis]
+    if in_c is None:
+        raise P.StaticGraphError("conv2d needs a concrete channel dim")
+    base = name or P.unique_name("conv2d")
+    fan_in = (in_c // groups) * filter_size[0] * filter_size[1]
+    default_w = I.Normal(0.0, math.sqrt(2.0 / fan_in))
+    w = P.create_parameter(
+        [num_filters, in_c // groups, *filter_size], input.dtype,
+        name=f"{base}.w_0",
+        default_initializer=_resolve_init(param_attr, default_w))
+    bias = None
+    if bias_attr is not False:
+        bias = P.create_parameter([num_filters], input.dtype,
+                                  name=f"{base}.b_0", is_bias=True,
+                                  default_initializer=_resolve_init(bias_attr))
+    kwargs = {"stride": stride, "padding": padding, "dilation": dilation,
+              "groups": groups, "data_format": data_format}
+    args = (input, w) if bias is None else (input, w, bias)
+    y = P.record_call(F.conv2d, args, kwargs)
+    a = _act(act)
+    if a is not None:
+        y = P.record_call(a, (y,), {})
+    return y
+
+
+def _static_batch_norm(x, w, b, mean, var, momentum, epsilon, data_format,
+                       is_test):
+    """Replay target for static batch_norm nodes; clone(for_test=True)
+    rewrites is_test on recorded nodes (see Program.clone)."""
+    import paddle_tpu.nn.functional as F
+    if is_test:
+        y = F.batch_norm(x, mean, var, w, b, training=False,
+                         momentum=momentum, epsilon=epsilon,
+                         data_format=data_format)
+        return y, mean, var
+    return F.batch_norm(x, mean, var, w, b, training=True,
+                        momentum=momentum, epsilon=epsilon,
+                        data_format=data_format)
+
+
+def batch_norm(input, act: Optional[str] = None, is_test: bool = False,
+               momentum: float = 0.9, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, data_format="NCHW",
+               name=None):
+    """Reference: paddle.static.nn.batch_norm — affine params + moving
+    stats; training form updates the moving stats (scope write-back)."""
+    from ..nn import initializer as I
+    ch_axis = 1 if data_format == "NCHW" else input.ndim - 1
+    c = input.shape[ch_axis]
+    if c is None:
+        raise P.StaticGraphError("batch_norm needs a concrete channel dim")
+    base = name or P.unique_name("batch_norm")
+    w = P.create_parameter([c], "float32", name=f"{base}.w_0",
+                           default_initializer=_resolve_init(
+                               param_attr, I.Constant(1.0)))
+    b = P.create_parameter([c], "float32", name=f"{base}.b_0", is_bias=True,
+                           default_initializer=_resolve_init(bias_attr))
+    # moving stats: parameters with stop_gradient (persistable, not trained)
+    mean = P.create_parameter([c], "float32", name=f"{base}.w_1",
+                              stop_gradient=True,
+                              default_initializer=I.Constant(0.0))
+    var = P.create_parameter([c], "float32", name=f"{base}.w_2",
+                             stop_gradient=True,
+                             default_initializer=I.Constant(1.0))
+    out = P.record_call(
+        _static_batch_norm, (input, w, b, mean, var),
+        {"momentum": momentum, "epsilon": epsilon,
+         "data_format": data_format, "is_test": is_test})
+    y, new_mean, new_var = out
+    if not is_test:
+        prog = P.default_main_program()
+        prog._writebacks.append((new_mean.vid, f"{base}.w_1"))
+        prog._writebacks.append((new_var.vid, f"{base}.w_2"))
+    a = _act(act)
+    if a is not None:
+        y = P.record_call(a, (y,), {})
+    return y

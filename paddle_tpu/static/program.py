@@ -1,0 +1,944 @@
+"""Static-graph Program/Executor — the reference's program-builder mode.
+
+Reference: python/paddle/static/ — Program, program_guard, data, Executor,
+global_scope (SURVEY.md §2.2 "static API": ``paddle.static.Program/Executor``,
+``python/paddle/base/executor.py — Executor``); param-creating builders
+mirror ``paddle.static.nn.fc/conv2d/batch_norm/embedding``.
+
+TPU-native design — a *tape*, not a ProgramDesc:
+
+- ``static.data`` returns a symbolic :class:`Variable`.  Any paddle_tpu API
+  called with a Variable among its arguments records one node
+  ``(fn, arg-template)`` on the current main Program instead of executing;
+  output shapes/dtypes come from ``jax.eval_shape`` (the InferMeta analog —
+  op errors surface at build time, like the reference).  The generic
+  recorder is installed over the public namespaces once, at first static
+  use: the op registry IS the binding surface (SURVEY §1 "one declarative
+  op registry, many generated surfaces").
+- ``Executor.run`` topologically prunes the tape to the fetch set, binds
+  feeds + scope parameters, and replays it as ONE jitted function (the
+  whole program compiles to a single XLA executable — the reference's
+  InterpreterCore instruction stream collapses into XLA's schedule).
+- ``Optimizer.minimize(loss)`` marks the program as a training program;
+  ``Executor.run`` then replays under ``jax.value_and_grad`` over the
+  program's parameters and applies the optimizer's pure ``update`` rule,
+  i.e. the recorded forward + AD + optimizer fuse into one step — the
+  reference's appended backward/optimize ops with no op-by-op interpreter.
+
+Out-of-subset constructs (data-dependent Python control flow at build
+time, Variable-valued indices, eager-only methods) raise
+:class:`StaticGraphError` at build time with the op named.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Variable", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "global_scope",
+    "StaticGraphError", "create_parameter", "save", "load",
+]
+
+# Probe size substituted for None (dynamic) dims when running eval_shape at
+# build time.  Shape metadata on Variables is cosmetic — replay re-executes
+# with the real feed shapes — so an unlikely odd value keeps the
+# restore-None heuristic from colliding with real layer widths.
+_PROBE = 191
+
+
+class StaticGraphError(RuntimeError):
+    pass
+
+
+import itertools as _itertools
+
+_UNIQ = _itertools.count()
+
+
+def unique_name(prefix: str) -> str:
+    """Process-global unique name (reference: paddle.utils.unique_name) —
+    parameters live in the global scope, so names must not collide across
+    programs."""
+    return f"{prefix}_{next(_UNIQ)}"
+
+
+# --------------------------------------------------------------------------
+# Variable: symbolic handle on a Program's tape
+# --------------------------------------------------------------------------
+
+class Variable:
+    """Symbolic tensor in a static Program (reference: framework.Variable).
+
+    Carries (shape, dtype, name); all computation on it is recorded, not
+    executed.  ``None`` dims are dynamic (the reference's -1).
+    """
+
+    __slots__ = ("program", "vid", "name", "shape", "dtype", "stop_gradient",
+                 "is_data", "param_name")
+
+    def __init__(self, program, vid, name, shape, dtype, *, stop_gradient=True,
+                 is_data=False, param_name=None):
+        self.program = program
+        self.vid = vid
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.param_name = param_name  # set when this var IS a parameter
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __len__(self):
+        if self.shape and self.shape[0] is not None:
+            return self.shape[0]
+        raise StaticGraphError("len() of a Variable with dynamic dim 0")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={list(self.shape)}, "
+                f"dtype={self.dtype.name})")
+
+    # -- recording helpers ------------------------------------------------
+    def _rec(self, fn, *args, **kwargs):
+        return record_call(fn, args, kwargs)
+
+    # arithmetic dunders: route through the public ops so the tape replays
+    # the same code eager mode runs
+    def __add__(self, o):
+        return self._rec(_ops().add, self, o)
+
+    def __radd__(self, o):
+        return self._rec(_ops().add, o, self)
+
+    def __sub__(self, o):
+        return self._rec(_ops().subtract, self, o)
+
+    def __rsub__(self, o):
+        return self._rec(_ops().subtract, o, self)
+
+    def __mul__(self, o):
+        return self._rec(_ops().multiply, self, o)
+
+    def __rmul__(self, o):
+        return self._rec(_ops().multiply, o, self)
+
+    def __truediv__(self, o):
+        return self._rec(_ops().divide, self, o)
+
+    def __rtruediv__(self, o):
+        return self._rec(_ops().divide, o, self)
+
+    def __matmul__(self, o):
+        return self._rec(_ops().matmul, self, o)
+
+    def __neg__(self):
+        return self._rec(_ops().scale, self, -1.0)
+
+    def __pow__(self, o):
+        return self._rec(_ops().pow, self, o)
+
+    def __mod__(self, o):
+        return self._rec(_ops().mod, self, o)
+
+    def __gt__(self, o):
+        return self._rec(_ops().greater_than, self, o)
+
+    def __lt__(self, o):
+        return self._rec(_ops().less_than, self, o)
+
+    def __ge__(self, o):
+        return self._rec(_ops().greater_equal, self, o)
+
+    def __le__(self, o):
+        return self._rec(_ops().less_equal, self, o)
+
+    def __eq__(self, o):  # noqa: D105 — elementwise, reference semantics
+        # scalars record too (x == 0.0 builds a mask like __gt__ does);
+        # non-numeric objects (None, strings, list membership probes) keep
+        # Python identity semantics via NotImplemented
+        if isinstance(o, (Variable, int, float, bool)) or _is_tensorish(o):
+            return self._rec(_ops().equal, self, o)
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Variable, int, float, bool)) or _is_tensorish(o):
+            return self._rec(_ops().not_equal, self, o)
+        return NotImplemented
+
+    __hash__ = object.__hash__  # __eq__ is elementwise; keep identity hash
+
+    def __getitem__(self, idx):
+        if _contains_variable(idx):
+            raise StaticGraphError(
+                "Variable-valued indices are out of the static subset; use "
+                "paddle.gather / paddle.index_select")
+        return self._rec(lambda x: x[idx], self)
+
+    # -- eager-only surface fails loudly ----------------------------------
+    def numpy(self):
+        raise StaticGraphError(
+            f"Variable {self.name!r} has no concrete value at build time; "
+            "fetch it through Executor.run(..., fetch_list=[var])")
+
+    item = numpy
+
+    def __bool__(self):
+        raise StaticGraphError(
+            "Python control flow on a Variable's value is out of the static "
+            "subset; use paddle.static.nn.cond / while_loop (or author in "
+            "eager mode and convert with jit.to_static)")
+
+    def __float__(self):
+        self.__bool__()
+
+    def __int__(self):
+        self.__bool__()
+
+    # -- method parity: resolve paddle.<name> and record ------------------
+    def __getattr__(self, name):
+        fn = _method_table().get(name)
+        if fn is None:
+            raise AttributeError(
+                f"Variable has no method {name!r} (not found in the "
+                "paddle_tpu public API)")
+        return functools.partial(record_call_method, fn, self)
+
+    def astype(self, dtype):
+        return self._rec(_ops().cast, self, dtype)
+
+    @property
+    def T(self):
+        perm = list(range(len(self.shape)))[::-1]
+        return self._rec(_ops().transpose, self, perm)
+
+
+def _is_tensorish(o):
+    return isinstance(o, (jax.Array, np.ndarray, jnp.ndarray))
+
+
+def _contains_variable(tree) -> bool:
+    found = [False]
+
+    def look(x):
+        if isinstance(x, Variable):
+            found[0] = True
+        return x
+
+    jax.tree.map(look, tree, is_leaf=lambda x: isinstance(x, Variable))
+    return found[0]
+
+
+@functools.lru_cache(maxsize=1)
+def _ops():
+    import paddle_tpu
+    return paddle_tpu
+
+
+@functools.lru_cache(maxsize=1)
+def _method_table() -> Dict[str, Callable]:
+    """Tensor-method parity table: every public top-level callable is
+    available as a recorded Variable method (x.mean(), x.reshape(...), …) —
+    the registry-drives-bindings stance."""
+    import paddle_tpu
+    table: Dict[str, Callable] = {}
+    for mod in (paddle_tpu,):
+        for n in dir(mod):
+            if n.startswith("_"):
+                continue
+            f = getattr(mod, n)
+            if callable(f) and not isinstance(f, type):
+                table[n] = f
+    return table
+
+
+# --------------------------------------------------------------------------
+# Program
+# --------------------------------------------------------------------------
+
+class _Ref:
+    __slots__ = ("vid",)
+
+    def __init__(self, vid):
+        self.vid = vid
+
+
+class _Node:
+    __slots__ = ("fn", "args", "kwargs", "out_vids", "out_treedef", "label")
+
+    def __init__(self, fn, args, kwargs, out_vids, out_treedef, label):
+        self.fn = fn
+        self.args = args          # pytree with _Ref leaves for Variables
+        self.kwargs = kwargs
+        self.out_vids = out_vids  # flat list of produced vids
+        self.out_treedef = out_treedef
+        self.label = label
+
+    def in_vids(self):
+        ids = []
+
+        def look(x):
+            if isinstance(x, _Ref):
+                ids.append(x.vid)
+            return x
+
+        jax.tree.map(look, (self.args, self.kwargs),
+                     is_leaf=lambda x: isinstance(x, _Ref))
+        return ids
+
+
+class _ParamDecl:
+    __slots__ = ("name", "shape", "dtype", "init_fn", "stop_gradient")
+
+    def __init__(self, name, shape, dtype, init_fn, stop_gradient=False):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.init_fn = init_fn          # key -> concrete array
+        self.stop_gradient = stop_gradient
+
+
+class Program:
+    """An append-only tape of recorded ops (reference: static.Program).
+
+    The startup program holds parameter declarations + initializers; the
+    main program holds compute nodes.  ``clone(for_test=True)`` shares the
+    tape but drops the training attachment (the reference prunes backward
+    ops; here backward ops are never recorded — they are generated by AD at
+    run time — so dropping the optimizer IS the prune).
+    """
+
+    _counter = [0]
+
+    def __init__(self, name=None):
+        Program._counter[0] += 1
+        self.name = name or f"program_{Program._counter[0]}"
+        self.nodes: List[_Node] = []
+        self.vars: Dict[int, Variable] = {}
+        self.datas: Dict[str, Variable] = {}
+        self.params: Dict[str, _ParamDecl] = {}
+        self.param_vids: Dict[str, int] = {}
+        self._next_vid = [0]
+        self._version = 0
+        self._train: Optional[Tuple[int, Any]] = None  # (loss_vid, optimizer)
+        self._opt_state = None
+        self.random_seed = None
+        # (vid, scope-name) pairs written back after each run — the static
+        # batch_norm moving-stat mutation (reference: in-place var update)
+        self._writebacks: List[Tuple[int, str]] = []
+        # set by create_parameter on the startup program it declares into;
+        # Executor.run dispatches startup handling on this, not a heuristic
+        self._is_startup = False
+
+    # -- construction -----------------------------------------------------
+    def _new_var(self, name, shape, dtype, **kw) -> Variable:
+        vid = self._next_vid[0]
+        self._next_vid[0] += 1
+        v = Variable(self, vid, name, shape, dtype, **kw)
+        self.vars[vid] = v
+        self._version += 1
+        return v
+
+    def _append(self, node: _Node):
+        self.nodes.append(node)
+        self._version += 1
+
+    def _set_train(self, loss: Variable, optimizer):
+        if self._train is not None:
+            raise StaticGraphError(
+                "minimize() called twice on the same Program; build a "
+                "separate Program (each program carries one optimizer)")
+        self._train = (loss.vid, optimizer)
+        self._opt_state = None
+        self._version += 1
+
+    # -- reference surface ------------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        c = Program(name=f"{self.name}_clone")
+        c.nodes = list(self.nodes)
+        c.vars = dict(self.vars)
+        c.datas = dict(self.datas)
+        c.params = dict(self.params)
+        c.param_vids = dict(self.param_vids)
+        c._next_vid = self._next_vid      # shared: tape append stays coherent
+        c._version = self._version
+        c._writebacks = list(self._writebacks)
+        if not for_test:
+            c._train = self._train
+        else:
+            # the reference flips batch_norm ops to inference form and
+            # prunes backward ops; here: rewrite recorded bn nodes to
+            # is_test=True and drop the moving-stat write-backs
+            from .nn_builders import _static_batch_norm
+            new_nodes = []
+            for node in c.nodes:
+                if node.fn is _static_batch_norm:
+                    kw = dict(node.kwargs)
+                    kw["is_test"] = True
+                    node = _Node(node.fn, node.args, kw, node.out_vids,
+                                 node.out_treedef, node.label)
+                new_nodes.append(node)
+            c.nodes = new_nodes
+            c._writebacks = []
+        return c
+
+    def all_parameters(self) -> List[Variable]:
+        return [self.vars[vid] for vid in self.param_vids.values()]
+
+    def list_vars(self) -> List[Variable]:
+        return list(self.vars.values())
+
+    def block(self, _i=0):
+        return self
+
+    def global_block(self):
+        return self
+
+    @property
+    def var_names(self):
+        return {v.name: v for v in self.vars.values()}
+
+    def var(self, name: str) -> Variable:
+        for v in self.vars.values():
+            if v.name == name:
+                return v
+        raise KeyError(name)
+
+    def __str__(self):
+        lines = [f"Program {self.name}: {len(self.nodes)} ops, "
+                 f"{len(self.params)} params"]
+        for n in self.nodes:
+            outs = ", ".join(self.vars[v].name for v in n.out_vids)
+            lines.append(f"  {outs} = {n.label}")
+        return "\n".join(lines)
+
+
+# thread-local current (main, startup) pair -------------------------------
+
+class _Tls(threading.local):
+    def __init__(self):
+        self.stack: List[Tuple[Program, Program]] = []
+
+
+_TLS = _Tls()
+_DEFAULTS: List[Tuple[Program, Program]] = []
+
+
+def _default_pair() -> Tuple[Program, Program]:
+    if not _DEFAULTS:
+        _DEFAULTS.append((Program("default_main"), Program("default_startup")))
+    return _DEFAULTS[0]
+
+
+def default_main_program() -> Program:
+    if _TLS.stack:
+        return _TLS.stack[-1][0]
+    return _default_pair()[0]
+
+
+def default_startup_program() -> Program:
+    if _TLS.stack:
+        return _TLS.stack[-1][1]
+    return _default_pair()[1]
+
+
+class program_guard:
+    """Reference: paddle.static.program_guard(main, startup)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.pair = (main_program, startup_program or default_startup_program())
+
+    def __enter__(self):
+        _install_static_dispatch()
+        _TLS.stack.append(self.pair)
+        return self.pair[0]
+
+    def __exit__(self, *exc):
+        _TLS.stack.pop()
+        return False
+
+
+# --------------------------------------------------------------------------
+# data / parameters
+# --------------------------------------------------------------------------
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level=0) -> Variable:
+    """Reference: paddle.static.data — a feed slot; -1/None dims dynamic."""
+    _install_static_dispatch()
+    if not _TLS.stack:
+        _DEFAULT_DIRTY[0] = True  # authoring on the default program
+    prog = default_main_program()
+    shape = tuple(None if (d is None or d == -1) else int(d) for d in shape)
+    if name in prog.datas:
+        raise StaticGraphError(f"data name {name!r} already used in {prog.name}")
+    v = prog._new_var(name, shape, dtype, is_data=True)
+    prog.datas[name] = v
+    return v
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     default_initializer=None, is_bias=False,
+                     stop_gradient=False) -> Variable:
+    """Reference: paddle.static.create_parameter.  Declares the init in the
+    current STARTUP program; the main program sees a named input."""
+    from ..nn import initializer as I
+    prog = default_main_program()
+    startup = default_startup_program()
+    if name is None:
+        name = unique_name("param")
+    if name in startup.params:
+        raise StaticGraphError(f"parameter {name!r} already declared")
+    init = default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    shape = tuple(int(d) for d in shape)
+    jdtype = jnp.dtype(dtype)
+
+    def init_fn(key, _init=init, _shape=shape, _dt=jdtype):
+        return _init.init(key, _shape, _dt)
+
+    startup.params[name] = _ParamDecl(name, shape, jdtype, init_fn,
+                                      stop_gradient)
+    startup._is_startup = True  # explicit marker Executor.run dispatches on
+    # params are also visible on the main program
+    prog.params[name] = startup.params[name]
+    v = prog._new_var(name, shape, jdtype, stop_gradient=stop_gradient,
+                      param_name=name)
+    prog.param_vids[name] = v.vid
+    return v
+
+
+# --------------------------------------------------------------------------
+# recording
+# --------------------------------------------------------------------------
+
+def _resolve_program(args, kwargs) -> Program:
+    vars_seen = []
+
+    def look(x):
+        if isinstance(x, Variable):
+            vars_seen.append(x)
+        return x
+
+    jax.tree.map(look, (args, kwargs),
+                 is_leaf=lambda x: isinstance(x, Variable))
+    if not vars_seen:
+        raise StaticGraphError("record_call without any Variable argument")
+    # an active program_guard wins when it can see the operands — this is
+    # what lets ops append to a clone() (cloned tapes share Variable
+    # objects whose .program still points at the original)
+    if _TLS.stack:
+        guard_main = _TLS.stack[-1][0]
+        if all(v.vid in guard_main.vars for v in vars_seen):
+            return guard_main
+    return vars_seen[0].program
+
+
+def record_call(fn: Callable, args: tuple, kwargs: dict):
+    """Append ``fn(*args, **kwargs)`` to the tape; return output Variables.
+
+    Output structure mirrors fn's actual output pytree (tuples of vars for
+    multi-output ops)."""
+    prog = _resolve_program(args, kwargs)
+    is_var = lambda x: isinstance(x, Variable)
+
+    def to_aval(x):
+        if isinstance(x, Variable):
+            shape = tuple(_PROBE if d is None else d for d in x.shape)
+            return jax.ShapeDtypeStruct(shape, x.dtype)
+        return x
+
+    def to_ref(x):
+        return _Ref(x.vid) if isinstance(x, Variable) else x
+
+    # abstract ONLY the Variable leaves — static ints/lists/dtypes must stay
+    # concrete (eval_shape would otherwise trace them as arguments)
+    flat_all, tree_ak = jax.tree.flatten((args, kwargs), is_leaf=is_var)
+    var_idx = [i for i, x in enumerate(flat_all) if isinstance(x, Variable)]
+
+    def fn_on_vars(*vals):
+        flat = list(flat_all)
+        for i, v in zip(var_idx, vals):
+            flat[i] = v
+        a, k = jax.tree.unflatten(tree_ak, flat)
+        return fn(*a, **k)
+
+    label = getattr(fn, "__name__", str(fn))
+    try:
+        out_shape = jax.eval_shape(
+            fn_on_vars, *[to_aval(flat_all[i]) for i in var_idx])
+    except StaticGraphError:
+        raise
+    except Exception as e:  # noqa: BLE001 — surface the op + build context
+        raise StaticGraphError(
+            f"op {label!r} failed shape inference at build time: {e}") from e
+
+    had_dynamic = _contains_dynamic(args, kwargs)
+    flat_out, treedef = jax.tree.flatten(out_shape)
+    out_vars = []
+    for aval in flat_out:
+        shape = tuple(
+            None if (had_dynamic and d == _PROBE) else int(d)
+            for d in aval.shape)
+        out_vars.append(prog._new_var(f"{label}_{prog._next_vid[0]}",
+                                      shape, aval.dtype,
+                                      stop_gradient=False))
+    node = _Node(fn, jax.tree.map(to_ref, args, is_leaf=is_var),
+                 jax.tree.map(to_ref, kwargs, is_leaf=is_var),
+                 [v.vid for v in out_vars], treedef, label)
+    prog._append(node)
+    return treedef.unflatten(out_vars)
+
+
+def record_call_method(fn, self_var, *args, **kwargs):
+    return record_call(fn, (self_var,) + args, kwargs)
+
+
+def _contains_dynamic(args, kwargs) -> bool:
+    dyn = [False]
+
+    def look(x):
+        if isinstance(x, Variable) and any(d is None for d in x.shape):
+            dyn[0] = True
+        return x
+
+    jax.tree.map(look, (args, kwargs),
+                 is_leaf=lambda x: isinstance(x, Variable))
+    return dyn[0]
+
+
+# --------------------------------------------------------------------------
+# generic dispatch install: wrap the public namespaces once
+# --------------------------------------------------------------------------
+
+_DISPATCH_DONE = [False]
+# mirrors paddle_tpu.enable_static/disable_static; plus "a data() Variable
+# was created outside any guard" — the two states in which a Variable can
+# legitimately reach a public call.  When ALL are off, wrapped functions
+# skip the per-call pytree scan entirely (eager hot paths stay free even
+# after static mode has been used once).
+_STATIC_ACTIVE = [False]
+_DEFAULT_DIRTY = [False]
+_NO_WRAP = {
+    # program machinery + modes + anything that takes no tensors by contract
+    "enable_static", "disable_static", "program_guard", "data", "save",
+    "load", "set_device", "get_device", "seed", "to_tensor", "set_flags",
+    "get_flags", "set_default_dtype", "get_default_dtype", "is_grad_enabled",
+    "set_grad_enabled", "no_grad", "enable_grad", "summary", "set_printoptions",
+}
+
+
+def _wrap_callable(f):
+    @functools.wraps(f)
+    def g(*args, **kwargs):
+        if ((_TLS.stack or _STATIC_ACTIVE[0] or _DEFAULT_DIRTY[0])
+                and _contains_variable((args, kwargs))):
+            return record_call(f, args, kwargs)
+        return f(*args, **kwargs)
+
+    g.__wrapped_static__ = f
+    return g
+
+
+def _install_static_dispatch():
+    """Idemponent: route every public callable through the static recorder
+    when (and only when) a Variable flows in.  Installed lazily at first
+    static use so eager-only sessions never pay for it."""
+    if _DISPATCH_DONE[0]:
+        return
+    _DISPATCH_DONE[0] = True
+    import paddle_tpu
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.linalg as linalg
+    import paddle_tpu.fft as fft
+    import paddle_tpu.signal as signal
+    for mod in (paddle_tpu, F, linalg, fft, signal):
+        for n in dir(mod):
+            if n.startswith("_") or n in _NO_WRAP:
+                continue
+            f = getattr(mod, n)
+            if (callable(f) and not isinstance(f, type)
+                    and not hasattr(f, "__wrapped_static__")
+                    and getattr(f, "__module__", "").startswith("paddle_tpu")):
+                try:
+                    setattr(mod, n, _wrap_callable(f))
+                except (AttributeError, TypeError):
+                    pass
+    _method_table.cache_clear()
+
+
+# --------------------------------------------------------------------------
+# Scope + Executor
+# --------------------------------------------------------------------------
+
+class _VarFacade:
+    def __init__(self, scope, name):
+        self._scope, self._name = scope, name
+
+    def get_tensor(self):
+        return self._scope._store[self._name]
+
+    def set(self, value, place=None):
+        self._scope._store[self._name] = jnp.asarray(value)
+
+
+class Scope:
+    """Reference: paddle.static.global_scope() — name → concrete value."""
+
+    def __init__(self):
+        self._store: Dict[str, jax.Array] = {}
+
+    def find_var(self, name):
+        return _VarFacade(self, name) if name in self._store else None
+
+    def var(self, name):
+        self._store.setdefault(name, None)
+        return _VarFacade(self, name)
+
+    def keys(self):
+        return self._store.keys()
+
+
+_GLOBAL_SCOPE = Scope()
+
+
+def global_scope() -> Scope:
+    return _GLOBAL_SCOPE
+
+
+class Executor:
+    """Reference: paddle.static.Executor(place).run(program, feed, fetch_list).
+
+    Startup programs materialize parameters into the global scope; main
+    programs replay (pruned to the fetch set) as one jitted function.
+    Training programs (after ``optimizer.minimize(loss)``) replay under
+    ``value_and_grad`` and apply the optimizer update — parameters and
+    optimizer state live in the scope between calls."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Callable] = {}
+
+    # -- startup ----------------------------------------------------------
+    def _run_startup(self, program: Program, scope: "Scope" = None):
+        from ..framework.random import next_rng_key
+        scope = scope or global_scope()
+        for name, decl in program.params.items():
+            if scope.find_var(name) is None or scope._store.get(name) is None:
+                if program.random_seed is not None:
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(program.random_seed),
+                        _stable_hash(name))
+                else:
+                    key = next_rng_key()
+                scope._store[name] = decl.init_fn(key)
+        return []
+
+    # -- main -------------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True,
+            scope: Optional[Scope] = None):
+        program = program or default_main_program()
+        if getattr(program, "_is_startup", False) and fetch_list is None:
+            return self._run_startup(program, scope)
+        if not program.nodes:
+            return []
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_vars = [program.var(f) if isinstance(f, str) else f
+                      for f in fetch_list]
+        for f in fetch_vars:
+            if not isinstance(f, Variable):
+                raise StaticGraphError(f"fetch entry {f!r} is not a Variable")
+        scope = scope or global_scope()
+
+        # parameters this program needs, from the scope
+        params = {}
+        for name in program.param_vids:
+            val = scope._store.get(name)
+            if val is None:
+                raise StaticGraphError(
+                    f"parameter {name!r} is uninitialized; run the startup "
+                    "program first")
+            params[name] = val
+
+        train = program._train is not None
+        fetch_vids = tuple(f.vid for f in fetch_vars)
+        def _dt(v):  # no device transfer just to read a dtype
+            d = getattr(v, "dtype", None)
+            return str(d) if d is not None else str(np.result_type(v))
+
+        feed_sig = tuple(sorted(
+            (k, tuple(np.shape(v)), _dt(v)) for k, v in feed.items()))
+        key = (id(program), program._version, train, fetch_vids, feed_sig)
+        runner = self._cache.get(key)
+        if runner is None:
+            runner = self._build_runner(program, fetch_vids, train)
+            self._cache[key] = runner
+
+        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        if train:
+            loss_vid, opt = program._train
+            if program._opt_state is None:
+                program._opt_state = opt.init(
+                    {n: v for n, v in params.items()
+                     if not program.params[n].stop_gradient})
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            (outs, wb_vals), new_params, program._opt_state = runner(
+                params, program._opt_state, feeds, lr)
+            for name, v in new_params.items():
+                scope._store[name] = v
+        else:
+            outs, wb_vals = runner(params, feeds)
+        for (vid, name), val in zip(program._writebacks, wb_vals):
+            scope._store[name] = val
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    # -- tape replay ------------------------------------------------------
+    def _build_runner(self, program: Program, fetch_vids: Tuple[int, ...],
+                      train: bool):
+        # prune: walk back from fetches (+ loss when training, + write-backs)
+        wb_vids = tuple(vid for vid, _ in program._writebacks)
+        needed_vids = set(fetch_vids) | set(wb_vids)
+        if train:
+            needed_vids.add(program._train[0])
+        nodes = []
+        for node in reversed(program.nodes):
+            if any(v in needed_vids for v in node.out_vids):
+                nodes.append(node)
+                needed_vids.update(node.in_vids())
+        nodes.reverse()
+
+        # every needed leaf must be a feed or a param
+        produced_vids = {v for n in nodes for v in n.out_vids}
+        missing = []
+        for vid in needed_vids:
+            v = program.vars.get(vid)
+            if v is None:
+                continue
+            if vid not in produced_vids and not v.is_data \
+                    and v.param_name is None:
+                missing.append(v.name)
+        if missing:
+            raise StaticGraphError(
+                f"variables {missing} are neither produced, fed, nor "
+                "parameters — incomplete program")
+
+        name_by_vid = {v.vid: v for v in program.vars.values()}
+
+        def replay(env):
+            is_ref = lambda x: isinstance(x, _Ref)
+            for node in nodes:
+                def resolve(x):
+                    if isinstance(x, _Ref):
+                        if x.vid not in env:
+                            v = name_by_vid[x.vid]
+                            hint = (
+                                "; note: a training program (after "
+                                "minimize) always replays through the "
+                                "loss — for label-free inference run "
+                                "program.clone(for_test=True)"
+                            ) if train else ""
+                            raise StaticGraphError(
+                                f"feed for {v.name!r} is missing{hint}")
+                        return env[x.vid]
+                    return x
+
+                a = jax.tree.map(resolve, node.args, is_leaf=is_ref)
+                k = jax.tree.map(resolve, node.kwargs, is_leaf=is_ref)
+                out = node.fn(*a, **k)
+                flat = node.out_treedef.flatten_up_to(out) \
+                    if node.out_treedef.num_leaves > 1 else [out]
+                flat = jax.tree.leaves(flat)
+                for vid, val in zip(node.out_vids, flat):
+                    env[vid] = val
+            return env
+
+        def seed_env(params, feeds):
+            env = {}
+            for name, vid in program.param_vids.items():
+                env[vid] = params[name]
+            for name, v in program.datas.items():
+                if name in feeds:
+                    env[v.vid] = feeds[name]
+            return env
+
+        if not train:
+            @jax.jit
+            def forward(params, feeds):
+                env = replay(seed_env(params, feeds))
+                return ([env[vid] for vid in fetch_vids],
+                        [env[vid] for vid in wb_vids])
+
+            return forward
+
+        loss_vid, opt = program._train
+        trainable = {n for n, d in program.params.items()
+                     if not d.stop_gradient}
+
+        @jax.jit
+        def step(params, opt_state, feeds, lr):
+            t_params = {n: p for n, p in params.items() if n in trainable}
+            frozen = {n: p for n, p in params.items() if n not in trainable}
+
+            def loss_fn(tp):
+                env = replay(seed_env({**frozen, **tp}, feeds))
+                loss = env[loss_vid]
+                fetches = [env[vid] for vid in fetch_vids]
+                wbs = [env[vid] for vid in wb_vids]
+                return jnp.asarray(loss, jnp.float32).sum(), (fetches, wbs)
+
+            (_, out), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(t_params)
+            new_t, new_state = opt.update(grads, opt_state, t_params, lr=lr)
+            return out, {**frozen, **new_t}, new_state
+
+        return step
+
+
+def _stable_hash(s: str) -> int:
+    h = 2166136261
+    for ch in s.encode():
+        h = (h ^ ch) * 16777619 & 0xFFFFFFFF
+    return h
+
+
+# --------------------------------------------------------------------------
+# save / load of a static program's state (reference: paddle.static.save)
+# --------------------------------------------------------------------------
+
+def save(program: Program, path_prefix: str):
+    """Reference: paddle.static.save(prog, path) — persists the program's
+    parameters (.pdparams) and optimizer state (.pdopt) from the scope."""
+    from ..framework.io import save as _save
+    scope = global_scope()
+    params = {n: scope._store[n] for n in program.params
+              if scope._store.get(n) is not None}
+    _save(params, path_prefix + ".pdparams")
+    if program._opt_state is not None:
+        _save(program._opt_state, path_prefix + ".pdopt")
+
+
+def load(program: Program, path_prefix: str, executor=None):
+    from ..framework.io import load as _load
+    import os
+    params = _load(path_prefix + ".pdparams")
+    scope = global_scope()
+    for n in program.params:
+        if n in params:
+            scope._store[n] = jnp.asarray(params[n])
+    if os.path.exists(path_prefix + ".pdopt"):
+        program._opt_state = _load(path_prefix + ".pdopt")

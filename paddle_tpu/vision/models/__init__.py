@@ -3,3 +3,4 @@ from .simple import *  # noqa: F401,F403
 
 from .zoo_extra import *  # noqa: F401,F403
 from .resnet import resnext101_32x8d  # noqa: F401
+from .v3_inception import *  # noqa: F401,F403

@@ -1,0 +1,342 @@
+"""MobileNetV3 + InceptionV3 — the last two reference zoo families.
+
+Reference: python/paddle/vision/models/mobilenetv3.py — MobileNetV3Small/
+MobileNetV3Large, and inceptionv3.py — InceptionV3 (SURVEY.md §2.2
+"vision").  Architectures follow the papers exactly (Howard et al. 2019;
+Szegedy et al. 2015), which both the reference and torchvision implement —
+the tests pin total parameter counts to the published architecture.
+NCHW, no pretrained weights (zero-egress; same stance as the rest of the
+zoo)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...nn.layers.common import Linear, Dropout
+from ...nn.layers.container import Sequential
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn.layers.pooling import AdaptiveAvgPool2D, MaxPool2D, AvgPool2D
+from ...nn.layers.activation import ReLU, Hardswish, Hardsigmoid
+
+from .zoo_extra import _no_pretrained
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large", "InceptionV3", "inception_v3"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    """Channel rounding used throughout v3 (paper appendix)."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _cbn(cin, cout, k, stride=1, groups=1, act=None):
+    pad = (k - 1) // 2
+    layers = [Conv2D(cin, cout, k, stride=stride, padding=pad, groups=groups,
+                     bias_attr=False), BatchNorm2D(cout)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class _SqueezeExcite(Layer):
+    """v3 SE block: squeeze to make_divisible(c/4), relu, hardsigmoid."""
+
+    def __init__(self, channels):
+        super().__init__()
+        squeeze = _make_divisible(channels // 4)
+        self.fc1 = Conv2D(channels, squeeze, 1)
+        self.fc2 = Conv2D(squeeze, channels, 1)
+        self.act = ReLU()
+        self.gate = Hardsigmoid()
+
+    def forward(self, x):
+        s = jnp.mean(x, axis=(2, 3), keepdims=True)
+        s = self.gate(self.fc2(self.act(self.fc1(s))))
+        return x * s
+
+
+class _Bneck(Layer):
+    def __init__(self, cin, k, exp, cout, use_se, act, stride):
+        super().__init__()
+        self.residual = stride == 1 and cin == cout
+        A = Hardswish if act == "HS" else ReLU
+        body = []
+        if exp != cin:
+            body.append(_cbn(cin, exp, 1, act=A))
+        body.append(_cbn(exp, exp, k, stride=stride, groups=exp, act=A))
+        if use_se:
+            body.append(_SqueezeExcite(exp))
+        body.append(_cbn(exp, cout, 1, act=None))  # linear projection
+        self.body = Sequential(*body)
+
+    def forward(self, x):
+        out = self.body(x)
+        if self.residual:
+            out = out + x
+        return out
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, rows, last_conv, last_channel, scale=1.0,
+                 num_classes=1000, with_pool=True, dropout=0.2):
+        super().__init__()
+        s = lambda c: _make_divisible(c * scale)
+        cin = s(16)
+        self.stem = _cbn(3, cin, 3, stride=2, act=Hardswish)
+        blocks = []
+        for (k, exp, cout, use_se, act, stride) in rows:
+            blocks.append(_Bneck(cin, k, s(exp), s(cout), use_se, act, stride))
+            cin = s(cout)
+        self.blocks = Sequential(*blocks)
+        self.tail = _cbn(cin, s(last_conv), 1, act=Hardswish)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(s(last_conv), last_channel), Hardswish(),
+                Dropout(dropout), Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.tail(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """reference: mobilenetv3.py — MobileNetV3Small (paper Table 2)."""
+
+    ROWS = [
+        (3, 16, 16, True, "RE", 2),
+        (3, 72, 24, False, "RE", 2),
+        (3, 88, 24, False, "RE", 1),
+        (5, 96, 40, True, "HS", 2),
+        (5, 240, 40, True, "HS", 1),
+        (5, 240, 40, True, "HS", 1),
+        (5, 120, 48, True, "HS", 1),
+        (5, 144, 48, True, "HS", 1),
+        (5, 288, 96, True, "HS", 2),
+        (5, 576, 96, True, "HS", 1),
+        (5, 576, 96, True, "HS", 1),
+    ]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(self.ROWS, last_conv=576, last_channel=1024,
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """reference: mobilenetv3.py — MobileNetV3Large (paper Table 1)."""
+
+    ROWS = [
+        (3, 16, 16, False, "RE", 1),
+        (3, 64, 24, False, "RE", 2),
+        (3, 72, 24, False, "RE", 1),
+        (5, 72, 40, True, "RE", 2),
+        (5, 120, 40, True, "RE", 1),
+        (5, 120, 40, True, "RE", 1),
+        (3, 240, 80, False, "HS", 2),
+        (3, 200, 80, False, "HS", 1),
+        (3, 184, 80, False, "HS", 1),
+        (3, 184, 80, False, "HS", 1),
+        (3, 480, 112, True, "HS", 1),
+        (3, 672, 112, True, "HS", 1),
+        (5, 672, 160, True, "HS", 2),
+        (5, 960, 160, True, "HS", 1),
+        (5, 960, 160, True, "HS", 1),
+    ]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(self.ROWS, last_conv=960, last_channel=1280,
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------- InceptionV3
+
+def _bconv(cin, cout, k, stride=1, padding=0):
+    """BasicConv2d: conv(bias=False) + bn + relu."""
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=padding, bias_attr=False),
+        BatchNorm2D(cout), ReLU())
+
+
+class _InceptionA(Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1x1 = _bconv(cin, 64, 1)
+        self.b5x5 = Sequential(_bconv(cin, 48, 1), _bconv(48, 64, 5, padding=2))
+        self.b3x3dbl = Sequential(_bconv(cin, 64, 1),
+                                  _bconv(64, 96, 3, padding=1),
+                                  _bconv(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bpool = _bconv(cin, pool_features, 1)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1x1(x), self.b5x5(x), self.b3x3dbl(x),
+             self.bpool(self.pool(x))], axis=1)
+
+
+class _InceptionB(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3x3 = _bconv(cin, 384, 3, stride=2)
+        self.b3x3dbl = Sequential(_bconv(cin, 64, 1),
+                                  _bconv(64, 96, 3, padding=1),
+                                  _bconv(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b3x3(x), self.b3x3dbl(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1x1 = _bconv(cin, 192, 1)
+        self.b7x7 = Sequential(
+            _bconv(cin, c7, 1),
+            _bconv(c7, c7, (1, 7), padding=(0, 3)),
+            _bconv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7x7dbl = Sequential(
+            _bconv(cin, c7, 1),
+            _bconv(c7, c7, (7, 1), padding=(3, 0)),
+            _bconv(c7, c7, (1, 7), padding=(0, 3)),
+            _bconv(c7, c7, (7, 1), padding=(3, 0)),
+            _bconv(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bpool = _bconv(cin, 192, 1)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1x1(x), self.b7x7(x), self.b7x7dbl(x),
+             self.bpool(self.pool(x))], axis=1)
+
+
+class _InceptionD(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3x3 = Sequential(_bconv(cin, 192, 1), _bconv(192, 320, 3, stride=2))
+        self.b7x7x3 = Sequential(
+            _bconv(cin, 192, 1),
+            _bconv(192, 192, (1, 7), padding=(0, 3)),
+            _bconv(192, 192, (7, 1), padding=(3, 0)),
+            _bconv(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b3x3(x), self.b7x7x3(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1x1 = _bconv(cin, 320, 1)
+        self.b3x3_1 = _bconv(cin, 384, 1)
+        self.b3x3_2a = _bconv(384, 384, (1, 3), padding=(0, 1))
+        self.b3x3_2b = _bconv(384, 384, (3, 1), padding=(1, 0))
+        self.b3x3dbl_1 = Sequential(_bconv(cin, 448, 1),
+                                    _bconv(448, 384, 3, padding=1))
+        self.b3x3dbl_2a = _bconv(384, 384, (1, 3), padding=(0, 1))
+        self.b3x3dbl_2b = _bconv(384, 384, (3, 1), padding=(1, 0))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bpool = _bconv(cin, 192, 1)
+
+    def forward(self, x):
+        a = self.b3x3_1(x)
+        a = jnp.concatenate([self.b3x3_2a(a), self.b3x3_2b(a)], axis=1)
+        b = self.b3x3dbl_1(x)
+        b = jnp.concatenate([self.b3x3dbl_2a(b), self.b3x3dbl_2b(b)], axis=1)
+        return jnp.concatenate(
+            [self.b1x1(x), a, b, self.bpool(self.pool(x))], axis=1)
+
+
+class _InceptionAux(Layer):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.pool = AvgPool2D(5, stride=3)
+        self.conv0 = _bconv(cin, 128, 1)
+        self.conv1 = _bconv(128, 768, 5)
+        self.fc = Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.conv1(self.conv0(self.pool(x)))
+        x = jnp.mean(x, axis=(2, 3))
+        return self.fc(x)
+
+
+class InceptionV3(Layer):
+    """reference: inceptionv3.py — InceptionV3 (299×299 input).  Aux head
+    present in training mode when aux_logits=True (paper §4); forward
+    returns (logits, aux_logits) then, logits otherwise."""
+
+    def __init__(self, num_classes=1000, with_pool=True, aux_logits=True,
+                 dropout=0.5):
+        super().__init__()
+        self.aux_logits = aux_logits
+        self.stem = Sequential(
+            _bconv(3, 32, 3, stride=2), _bconv(32, 32, 3),
+            _bconv(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _bconv(64, 80, 1), _bconv(80, 192, 3), MaxPool2D(3, 2))
+        self.mixed = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192))
+        if aux_logits:
+            self.aux = _InceptionAux(768, num_classes)
+        self.head = Sequential(_InceptionD(768),
+                               _InceptionE(1280), _InceptionE(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(dropout)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.mixed(self.stem(x))
+        aux = None
+        if self.aux_logits and self.training:
+            aux = self.aux(x)
+        x = self.head(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(self.dropout(x))
+        if aux is not None:
+            return x, aux
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kwargs)

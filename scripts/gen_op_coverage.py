@@ -163,7 +163,8 @@ resnet50 resnet101 resnet152 resnext50_32x4d resnext101_32x8d
 wide_resnet50_2 wide_resnet101_2 MobileNetV1 mobilenet_v1 MobileNetV2
 mobilenet_v2 SqueezeNet squeezenet1_0 squeezenet1_1 DenseNet densenet121
 densenet161 densenet169 densenet201 GoogLeNet googlenet ShuffleNetV2
-shufflenet_v2_x1_0
+shufflenet_v2_x1_0 MobileNetV3Small MobileNetV3Large mobilenet_v3_small
+mobilenet_v3_large InceptionV3 inception_v3
 """
 
 PADDLE_IO = """
@@ -188,6 +189,8 @@ to_static
 
 PADDLE_STATIC = """
 InputSpec load_inference_model save_inference_model
+Program Executor program_guard data default_main_program
+default_startup_program global_scope create_parameter save load
 """
 
 PADDLE_DISTRIBUTION = """
@@ -273,6 +276,7 @@ help list load
 
 PADDLE_STATIC_NN = """
 case cond switch_case while_loop
+fc conv2d batch_norm embedding
 """
 
 PADDLE_DISTRIBUTED_FLEET = """
@@ -282,6 +286,10 @@ init is_first_worker worker_index worker_num
 
 PADDLE_FLEET_UTILS = """
 HDFSClient LocalFS recompute recompute_sequential
+"""
+
+PADDLE_DISTRIBUTED_PASSES = """
+PassBase PassContext PassManager new_pass register_pass
 """
 
 PADDLE_DISTRIBUTED_RPC = """
@@ -347,6 +355,7 @@ REFERENCE = {
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
     "paddle.distributed.fleet.utils": PADDLE_FLEET_UTILS,
+    "paddle.distributed.passes": PADDLE_DISTRIBUTED_PASSES,
     "paddle.distributed.rpc": PADDLE_DISTRIBUTED_RPC,
     "paddle.autograd": PADDLE_AUTOGRAD,
     "paddle.nn.initializer": PADDLE_NN_INITIALIZER,
@@ -389,6 +398,7 @@ TARGETS = {
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
     "paddle.distributed.fleet.utils": "paddle_tpu.distributed.fleet_utils",
+    "paddle.distributed.passes": "paddle_tpu.distributed.passes",
     "paddle.distributed.rpc": "paddle_tpu.distributed.rpc",
     "paddle.autograd": "paddle_tpu.autograd",
     "paddle.nn.initializer": "paddle_tpu.nn.initializer",

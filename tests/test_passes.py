@@ -1,0 +1,194 @@
+"""Auto-parallel pass framework tests.
+
+Reference test model: test/distributed_passes/ — each pass applied to a
+program and checked against the unmodified run (SURVEY.md §4
+"test/distributed_passes").  Here: passes transform an Engine's step
+recipe or a Layer tree; oracles are the directly-configured equivalents.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.passes import (
+    FusedLinearAct, PassBase, PassContext, PassManager, new_pass,
+    register_pass)
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(),
+        nn.Linear(16, 16), nn.GELU(approximate=True),
+        nn.Linear(16, 2))
+
+
+def _engine(model, lr=0.05):
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    import paddle_tpu.nn.functional as F
+    loss = lambda out, y: paddle.mean(F.cross_entropy(out, y))
+    return Engine(model, loss=loss, optimizer=paddle.optimizer.SGD(lr))
+
+
+class TestFramework:
+    def test_new_pass_unknown_raises(self):
+        with pytest.raises(ValueError, match="registered"):
+            new_pass("definitely_not_a_pass")
+
+    def test_registry_has_reference_names(self):
+        from paddle_tpu.distributed.passes import PASS_REGISTRY
+        for name in ("auto_parallel_amp", "auto_parallel_fp16",
+                     "auto_parallel_recompute",
+                     "auto_parallel_gradient_merge",
+                     "fused_linear_promotion"):
+            assert name in PASS_REGISTRY
+
+    def test_pass_manager_rejects_non_pass(self):
+        with pytest.raises(TypeError):
+            PassManager([object()])
+
+    def test_pass_manager_order_and_context(self):
+        applied = []
+
+        @register_pass("_test_probe_a")
+        class A(PassBase):
+            def _apply_impl(self, target, context):
+                applied.append("a")
+
+        @register_pass("_test_probe_b")
+        class B(PassBase):
+            def _apply_impl(self, target, context):
+                applied.append("b")
+
+        pm = PassManager([new_pass("_test_probe_a"), new_pass("_test_probe_b")])
+        assert pm.names == ["_test_probe_a", "_test_probe_b"]
+        pm.apply(object())
+        assert applied == ["a", "b"]
+        assert pm.context.applied == ["_test_probe_a", "_test_probe_b"]
+
+    def test_attrs_roundtrip(self):
+        p = new_pass("auto_parallel_amp", {"dtype": "float16"})
+        assert p.get_attr("dtype") == "float16"
+        p.set_attr("level", "O1")
+        assert p.get_attr("level") == "O1"
+
+
+class TestStrategyPasses:
+    def test_amp_pass_flips_strategy(self):
+        e = _engine(_mlp())
+        new_pass("auto_parallel_amp", {"dtype": "bfloat16"}).apply(e)
+        assert e.strategy.amp.enable
+        assert e.strategy.amp.dtype == "bfloat16"
+
+    def test_fp16_pass_defaults_to_float16(self):
+        e = _engine(_mlp())
+        new_pass("auto_parallel_fp16").apply(e)
+        assert e.strategy.amp.enable
+        assert e.strategy.amp.dtype == "float16"
+
+    def test_recompute_pass(self):
+        e = _engine(_mlp())
+        new_pass("auto_parallel_recompute", {"policy": "dots_saveable"}).apply(e)
+        assert e.strategy.recompute.enable
+        assert e.strategy.recompute.policy == "dots_saveable"
+
+    def test_strategy_pass_on_layer_raises(self):
+        with pytest.raises(TypeError, match="Engine"):
+            new_pass("auto_parallel_amp").apply(_mlp())
+
+    def test_gradient_merge_pass_matches_direct_strategy(self):
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(8, 8)).astype(np.float32)
+        ys = rng.integers(0, 2, size=(8,)).astype(np.int64)
+
+        # engine A: pass-applied gradient merge
+        ea = _engine(_mlp(seed=7))
+        new_pass("auto_parallel_gradient_merge", {"k_steps": 2}).apply(ea)
+        # engine B: strategy set directly
+        eb = _engine(_mlp(seed=7))
+        eb.strategy.gradient_merge.enable = True
+        eb.strategy.gradient_merge.k_steps = 2
+
+        la = [ea.fit([(xs, ys)])[0] for _ in range(4)]
+        lb = [eb.fit([(xs, ys)])[0] for _ in range(4)]
+        np.testing.assert_allclose(la, lb, rtol=1e-6)
+
+    def test_amp_pass_trains(self):
+        rng = np.random.default_rng(1)
+        xs = rng.normal(size=(8, 8)).astype(np.float32)
+        ys = rng.integers(0, 2, size=(8,)).astype(np.int64)
+        e = _engine(_mlp(seed=3))
+        new_pass("auto_parallel_amp").apply(e)
+        losses = [e.fit([(xs, ys)])[0] for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+
+class TestFusedLinearPromotion:
+    def test_promotion_preserves_numerics_and_params(self):
+        import jax.numpy as jnp
+        model = _mlp(seed=11)
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 8)),
+                        jnp.float32)
+        before = np.asarray(model(x))
+        w0 = np.asarray(model[0].weight)
+
+        ctx = PassContext()
+        new_pass("fused_linear_promotion").apply(model, ctx)
+        assert ctx.get_attr("fused_linear_count") == 2  # relu + approx-gelu
+
+        after = np.asarray(model(x))
+        np.testing.assert_allclose(before, after, rtol=1e-6, atol=1e-6)
+        # parameters are reused, not copied
+        assert isinstance(model[0], FusedLinearAct)
+        np.testing.assert_allclose(np.asarray(model[0].weight), w0)
+
+    def test_exact_gelu_not_promoted(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 4), nn.GELU())  # approximate=False
+        ctx = PassContext()
+        new_pass("fused_linear_promotion").apply(model, ctx)
+        assert ctx.get_attr("fused_linear_count") == 0
+
+    def test_promotion_on_engine_retrains_consistently(self):
+        rng = np.random.default_rng(5)
+        xs = rng.normal(size=(8, 8)).astype(np.float32)
+        ys = rng.integers(0, 2, size=(8,)).astype(np.int64)
+        ea = _engine(_mlp(seed=21))
+        eb = _engine(_mlp(seed=21))
+        new_pass("fused_linear_promotion").apply(eb)
+        la = [ea.fit([(xs, ys)])[0] for _ in range(5)]
+        lb = [eb.fit([(xs, ys)])[0] for _ in range(5)]
+        np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+
+    def test_non_sequential_adjacency_not_promoted(self):
+        """Attribute adjacency in a custom Layer does NOT imply composition
+        — the pass must only rewrite Sequential containers (review
+        finding: promoting here silently changed the math)."""
+        import jax.numpy as jnp
+
+        class Branchy(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                paddle.seed(1)
+                self.proj = nn.Linear(4, 4)
+                self.act = nn.ReLU()   # applied to the SKIP, not to proj
+
+            def forward(self, x):
+                return self.act(x) + self.proj(x)
+
+        m = Branchy()
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 4)),
+                        jnp.float32)
+        before = np.asarray(m(x))
+        ctx = PassContext()
+        new_pass("fused_linear_promotion").apply(m, ctx)
+        assert ctx.get_attr("fused_linear_count") == 0
+        np.testing.assert_allclose(np.asarray(m(x)), before)
+
+    def test_state_dict_keys_preserved(self):
+        model = _mlp(seed=13)
+        keys_before = set(model.state_dict().keys())
+        new_pass("fused_linear_promotion").apply(model)
+        keys_after = set(model.state_dict().keys())
+        assert keys_before == keys_after

@@ -1,0 +1,489 @@
+"""Static-graph Program/Executor tests.
+
+Reference test model: test/legacy_test static-graph usage —
+program_guard + static.data + static.nn builders + optimizer.minimize +
+Executor.run(startup/main, feed, fetch_list) (SURVEY.md §2.2 "static API").
+Oracles: eager replays with the same initial parameters.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+from paddle_tpu.static import StaticGraphError
+
+
+def _fresh_pair():
+    return static.Program(), static.Program()
+
+
+class TestBuild:
+    def test_data_and_record(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            y = paddle.mean(x)
+        assert isinstance(y, static.Variable)
+        assert y.shape == ()
+        assert len(main.nodes) == 1
+
+    def test_dunder_arithmetic_records(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [3])
+            y = (x + 1.0) * 2.0 - x / 4.0
+        exe = static.Executor()
+        out, = exe.run(main, feed={"x": np.array([1., 2., 3.], np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, [3.75, 5.5, 7.25], rtol=1e-6)
+
+    def test_method_parity_and_matmul(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            a = static.data("a", [2, 3])
+            b = static.data("b", [3, 2])
+            c = (a @ b).sum()
+            d = a.reshape([3, 2]).T
+        exe = static.Executor()
+        an = np.arange(6, dtype=np.float32).reshape(2, 3)
+        bn = np.ones((3, 2), np.float32)
+        c_v, d_v = exe.run(main, feed={"a": an, "b": bn}, fetch_list=[c, d])
+        np.testing.assert_allclose(c_v, (an @ bn).sum(), rtol=1e-6)
+        np.testing.assert_allclose(d_v, an.reshape(3, 2).T)
+
+    def test_shape_inference_dynamic_batch(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8])
+            h = static.nn.fc(x, 16)
+        assert h.shape == (None, 16)
+
+    def test_build_time_op_error(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            a = static.data("a", [2, 3])
+            b = static.data("b", [4, 5])
+            with pytest.raises(StaticGraphError, match="matmul"):
+                paddle.matmul(a, b)
+
+    def test_bool_of_variable_raises(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2])
+            with pytest.raises(StaticGraphError, match="control flow"):
+                bool(x > 0)
+
+    def test_numpy_of_variable_raises(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2])
+            with pytest.raises(StaticGraphError, match="fetch"):
+                x.numpy()
+
+    def test_variable_index_raises(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4])
+            i = static.data("i", [1], "int64")
+            with pytest.raises(StaticGraphError, match="indices"):
+                x[i]
+
+    def test_duplicate_data_name_raises(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            static.data("x", [2])
+            with pytest.raises(StaticGraphError, match="already used"):
+                static.data("x", [2])
+
+    def test_default_programs_and_guard_isolation(self):
+        base_main = static.default_main_program()
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            assert static.default_main_program() is main
+            assert static.default_startup_program() is startup
+        assert static.default_main_program() is base_main
+
+    def test_program_str_and_vars(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 2])
+            y = paddle.mean(x)
+        s = str(main)
+        assert "mean" in s
+        assert main.var("x") is x
+
+
+class TestExecutor:
+    def test_forward_and_fetch_by_name(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            y = paddle.nn.functional.relu(x)
+        exe = static.Executor(paddle.CPUPlace())
+        xv = np.array([[-1, 0, 2]], np.float32)
+        out, = exe.run(main, feed={"x": xv}, fetch_list=["x"])
+        np.testing.assert_allclose(out, xv)
+        out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, np.maximum(xv, 0))
+
+    def test_prune_skips_unneeded_feeds(self):
+        """clone(for_test)-style usage: fetching pred must not require the
+        label feed (fetch-driven tape pruning)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            label = static.data("y", [None, 1], "int64")
+            pred = static.nn.fc(x, 3)
+            loss = paddle.mean(F.cross_entropy(pred, label))
+        exe = static.Executor()
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[pred])
+        assert out.shape == (2, 3)
+
+    def test_missing_feed_error_names_var(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            y = paddle.mean(x)
+        exe = static.Executor()
+        with pytest.raises(StaticGraphError, match="'x'"):
+            exe.run(main, feed={}, fetch_list=[y])
+
+    def test_uninitialized_param_error(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            h = static.nn.fc(x, 2)
+        exe = static.Executor()
+        with pytest.raises(StaticGraphError, match="startup"):
+            exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                    fetch_list=[h])
+
+    def test_batch_size_change_reruns(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 2])
+            y = paddle.sum(x, axis=1)
+        exe = static.Executor()
+        for b in (1, 5, 3):
+            out, = exe.run(main, feed={"x": np.ones((b, 2), np.float32)},
+                           fetch_list=[y])
+            assert out.shape == (b,)
+
+
+class TestTraining:
+    def test_linear_regression_matches_eager_sgd(self):
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(16, 3)).astype(np.float32)
+        ys = (xs @ np.array([[1.], [2.], [-1.]], np.float32) + 0.5)
+
+        main, startup = _fresh_pair()
+        main.random_seed = 7
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            y = static.data("y", [None, 1])
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean(paddle.square(pred - y))
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+
+        # eager oracle: same initial weights, hand-rolled SGD
+        import jax
+        import jax.numpy as jnp
+        scope = static.global_scope()
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        bname = [n for n in main.params if n.endswith(".b_0")][0]
+        w = jnp.asarray(scope.find_var(wname).get_tensor())
+        b = jnp.asarray(scope.find_var(bname).get_tensor())
+
+        def loss_fn(p, xv, yv):
+            return jnp.mean((xv @ p[0] + p[1] - yv) ** 2)
+
+        p = (w, b)
+        losses_eager = []
+        for _ in range(5):
+            l, g = jax.value_and_grad(loss_fn)(p, jnp.asarray(xs), jnp.asarray(ys))
+            losses_eager.append(float(l))
+            p = tuple(pi - 0.1 * gi for pi, gi in zip(p, g))
+
+        losses_static = []
+        for _ in range(5):
+            lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            losses_static.append(float(lv))
+        np.testing.assert_allclose(losses_static, losses_eager, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(wname).get_tensor()),
+            np.asarray(p[0]), rtol=1e-5)
+
+    def test_mlp_classification_loss_decreases(self):
+        rng = np.random.default_rng(1)
+        xs = rng.normal(size=(32, 10)).astype(np.float32)
+        labels = rng.integers(0, 3, size=(32, 1)).astype(np.int64)
+
+        main, startup = _fresh_pair()
+        main.random_seed = 3
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 10])
+            y = static.data("y", [None, 1], "int64")
+            h = static.nn.fc(x, 32, activation="relu")
+            logits = static.nn.fc(h, 3)
+            loss = paddle.mean(F.cross_entropy(logits, y))
+            paddle.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        first = last = None
+        for i in range(30):
+            lv, = exe.run(main, feed={"x": xs, "y": labels},
+                          fetch_list=[loss])
+            first = lv if first is None else first
+            last = lv
+        assert last < first * 0.7, (first, last)
+
+    def test_train_program_without_label_feed_hints_clone(self):
+        main, startup = _fresh_pair()
+        main.random_seed = 19
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean(paddle.square(pred - y))
+            paddle.optimizer.SGD(0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        with pytest.raises(StaticGraphError, match="for_test"):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[pred])
+        # the canonical path works: clone(for_test=True) prunes to pred
+        out, = exe.run(main.clone(for_test=True),
+                       feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[pred])
+        assert out.shape == (2, 1)
+
+    def test_minimize_twice_raises(self):
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 2])
+            loss = paddle.mean(x)
+            paddle.optimizer.SGD(0.1).minimize(loss)
+            with pytest.raises(StaticGraphError, match="twice"):
+                paddle.optimizer.SGD(0.1).minimize(loss)
+
+    def test_eager_minimize_raises(self):
+        import jax.numpy as jnp
+        with pytest.raises(ValueError, match="static-graph"):
+            paddle.optimizer.SGD(0.1).minimize(jnp.ones(()))
+
+    def test_fetch_intermediate_during_training(self):
+        main, startup = _fresh_pair()
+        main.random_seed = 11
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            h = static.nn.fc(x, 8, activation="tanh")
+            pred = static.nn.fc(h, 1)
+            loss = paddle.mean(paddle.square(pred - y))
+            paddle.optimizer.SGD(0.05).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        lv, hv, pv = exe.run(
+            main, feed={"x": np.ones((2, 4), np.float32),
+                        "y": np.zeros((2, 1), np.float32)},
+            fetch_list=[loss, h, pred])
+        assert hv.shape == (2, 8) and pv.shape == (2, 1)
+        assert np.isfinite(lv)
+
+
+class TestBatchNormAndClone:
+    def test_bn_train_updates_moving_stats_and_clone_for_test(self):
+        rng = np.random.default_rng(2)
+        xs = rng.normal(loc=3.0, scale=2.0, size=(16, 4, 5, 5)).astype(np.float32)
+
+        main, startup = _fresh_pair()
+        main.random_seed = 5
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4, 5, 5])
+            y = static.nn.batch_norm(x, momentum=0.5)
+            loss = paddle.mean(paddle.square(y))
+            paddle.optimizer.SGD(0.0).minimize(loss)
+        test_prog = main.clone(for_test=True)
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        name = [n for n in main.params if n.endswith(".w_1")][0]
+        base = np.asarray(scope.find_var(name).get_tensor())
+        exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        after = np.asarray(scope.find_var(name).get_tensor())
+        assert not np.allclose(base, after)  # moving mean moved
+
+        # test clone: uses moving stats, does NOT change them
+        out, = exe.run(test_prog, feed={"x": xs}, fetch_list=[y])
+        again = np.asarray(scope.find_var(name).get_tensor())
+        np.testing.assert_allclose(after, again)
+        # inference form normalizes with moving stats, not batch stats
+        mean = after.reshape(1, 4, 1, 1)
+        var = np.asarray(scope.find_var(name[:-1] + "2").get_tensor()).reshape(1, 4, 1, 1)
+        np.testing.assert_allclose(
+            out, (xs - mean) / np.sqrt(var + 1e-5), rtol=2e-3, atol=2e-3)
+
+    def test_conv_bn_net_trains(self):
+        rng = np.random.default_rng(4)
+        xs = rng.normal(size=(8, 1, 8, 8)).astype(np.float32)
+        labels = rng.integers(0, 2, size=(8, 1)).astype(np.int64)
+        main, startup = _fresh_pair()
+        main.random_seed = 9
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 1, 8, 8])
+            y = static.data("y", [None, 1], "int64")
+            h = static.nn.conv2d(x, num_filters=4, filter_size=3, act="relu")
+            h = static.nn.batch_norm(h)
+            logits = static.nn.fc(h, 2)
+            loss = paddle.mean(F.cross_entropy(logits, y))
+            paddle.optimizer.Adam(0.01).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        first = last = None
+        for _ in range(15):
+            lv, = exe.run(main, feed={"x": xs, "y": labels}, fetch_list=[loss])
+            first = lv if first is None else first
+            last = lv
+        assert last < first, (first, last)
+
+
+class TestSaveLoad:
+    def test_static_save_load_roundtrip(self, tmp_path):
+        main, startup = _fresh_pair()
+        main.random_seed = 13
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            pred = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        orig = np.asarray(scope.find_var(wname).get_tensor())
+        static.save(main, str(tmp_path / "model"))
+        scope._store[wname] = np.zeros_like(orig)
+        static.load(main, str(tmp_path / "model"))
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(wname).get_tensor()), orig)
+
+    def test_embedding_builder(self):
+        main, startup = _fresh_pair()
+        main.random_seed = 17
+        with static.program_guard(main, startup):
+            ids = static.data("ids", [None, 4], "int64")
+            emb = static.nn.embedding(ids, size=[10, 6])
+        exe = static.Executor()
+        exe.run(startup)
+        out, = exe.run(main, feed={"ids": np.zeros((2, 4), np.int64)},
+                       fetch_list=[emb])
+        assert out.shape == (2, 4, 6)
+
+
+class TestReviewRegressions:
+    def test_startup_with_custom_scope(self):
+        """Executor.run(startup, scope=...) must initialize THAT scope
+        (review finding: it hardcoded global_scope)."""
+        from paddle_tpu.static.program import Scope
+        main, startup = _fresh_pair()
+        main.random_seed = 23
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            pred = static.nn.fc(x, 2)
+        my_scope = Scope()
+        exe = static.Executor()
+        exe.run(startup, scope=my_scope)
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        assert my_scope.find_var(wname) is not None
+        out, = exe.run(main, feed={"x": np.ones((1, 3), np.float32)},
+                       fetch_list=[pred], scope=my_scope)
+        assert out.shape == (1, 2)
+
+    def test_param_attr_initializer_honored(self):
+        """ParamAttr(initializer=...) is the documented reference idiom —
+        builders must honor it (review finding: silently dropped)."""
+        from paddle_tpu.nn.layer import ParamAttr
+        from paddle_tpu.nn import initializer as I
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            static.nn.fc(x, 2, weight_attr=ParamAttr(
+                initializer=I.Constant(0.125)), bias_attr=I.Constant(0.5))
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        bname = [n for n in main.params if n.endswith(".b_0")][0]
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(wname).get_tensor()), 0.125)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(bname).get_tensor()), 0.5)
+
+    def test_eq_with_scalar_records_elementwise(self):
+        """x == 0.0 must build a mask Variable, not Python False (review
+        finding: __eq__ returned NotImplemented for scalars)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4])
+            m = paddle.cast(x == 0.0, "float32")
+            n = x != 1.0
+        assert isinstance(m, static.Variable)
+        exe = static.Executor()
+        mv, nv = exe.run(
+            main, feed={"x": np.array([0., 1., 0., 2.], np.float32)},
+            fetch_list=[m, n])
+        np.testing.assert_allclose(mv, [1, 0, 1, 0])
+        np.testing.assert_allclose(nv, [True, False, True, True])
+        # identity semantics survive for non-numeric probes
+        with static.program_guard(main, startup):
+            assert (x == None) is False  # noqa: E711
+
+    def test_clone_append_under_guard(self):
+        """Ops recorded under program_guard(clone) land on the CLONE, not
+        the original (review finding: .program followed the original)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2])
+            y = x * 2.0
+        n_orig = len(main.nodes)
+        c = main.clone()
+        with static.program_guard(c, startup):
+            z = y + 1.0
+        assert len(main.nodes) == n_orig          # original untouched
+        assert len(c.nodes) == n_orig + 1
+        exe = static.Executor()
+        zv, = exe.run(c, feed={"x": np.array([1., 2.], np.float32)},
+                      fetch_list=[z])
+        np.testing.assert_allclose(zv, [3., 5.])
+
+    def test_empty_main_program_run_is_noop_not_reinit(self):
+        """A node-less main program must not be mistaken for a startup
+        program (review finding: heuristic reinitialized params)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            static.nn.fc(static.data("x", [None, 2]), 1)
+        exe = static.Executor()
+        exe.run(startup)
+        empty = static.Program()
+        assert exe.run(empty) == []
+
+
+class TestModes:
+    def test_enable_disable_static_flag(self):
+        try:
+            paddle.enable_static()
+            assert not paddle.in_dynamic_mode()
+        finally:
+            paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+
+    def test_eager_calls_unaffected_by_dispatch(self):
+        # dispatch is installed by the tests above; eager calls pass through
+        import jax.numpy as jnp
+        out = paddle.mean(jnp.arange(4.0))
+        assert float(out) == 1.5
