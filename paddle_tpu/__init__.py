@@ -165,6 +165,10 @@ def disable_static():
     _static_mode[0] = False
     from .static import program as _prog
     _prog._STATIC_ACTIVE[0] = False
+    # authoring on the default program (data() outside any guard) keeps
+    # the recording scan armed; disable_static ends that session too, so
+    # eager hot paths go back to the zero-cost fast path
+    _prog._DEFAULT_DIRTY[0] = False
 
 
 class CPUPlace:

@@ -247,12 +247,4 @@ def transpose(x, perm, name=None):
     return jnp.transpose(x, perm)
 
 
-class _SparseNN:
-    """paddle.sparse.nn subset: ReLU layer parity."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-
-nn = _SparseNN()
+from . import nn  # noqa: E402  (paddle.sparse.nn — conv stack, sparse/nn.py)

@@ -1,0 +1,443 @@
+"""paddle.sparse.nn — sparse 3D convolution stack.
+
+Reference: python/paddle/sparse/nn/ — Conv3D, SubmConv3D, BatchNorm,
+MaxPool3D, ReLU/ReLU6/LeakyReLU (+ functional/conv.py subm_conv3d/conv3d),
+backed by phi sparse GPU kernels (`paddle/phi/kernels/sparse/gpu/
+conv_kernel.cu` rulebook + gather/scatter GEMMs; SURVEY.md §2.1 "PHI
+kernel library" sparse/ row).
+
+TPU-native design — a STATIC-SHAPE rulebook, no dynamic nnz:
+
+- A sparse activation is a BCOO with ``n_dense=1``: ``indices [nnz, 4]``
+  over (N, D, H, W) and ``values [nnz, C]`` (NDHWC, the reference's
+  sparse conv layout).  nnz is a static trace-time constant.
+- The rulebook is built with sorted linearized coordinates +
+  ``searchsorted`` — O(K · nnz log nnz) vectorized ops, all static
+  shapes, fully jittable.  Each kernel offset contributes one
+  ``[nnz, Cin] @ [Cin, Cout]`` matmul (MXU work), masked where the
+  neighbor is absent — the reference's gather-GEMM-scatter rulebook
+  without the dynamic row counts CUDA can afford.
+- Strided Conv3D's output coordinate set is data-dependent; it is
+  capacity-padded to ``nnz`` candidates per offset and deduplicated by
+  sort (the MoE capacity-padding stance, SURVEY §7 hard part (f)).
+- **Padding rows use BCOO's out-of-range-index convention**: their
+  indices are the shape itself (all coords out of range), values zero.
+  ``todense`` drops them natively, and every op in this module treats
+  any row with an out-of-range coordinate as absent — so Conv3D →
+  BatchNorm → SubmConv3D chains stay correct (stats and neighbor lookups
+  never see padding).
+
+Perf stance (honest): TPUs have no sparse MXU path; this is for
+point-cloud-style workloads where nnz ≪ dense volume, where the K
+masked matmuls beat materializing the dense volume.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..nn.layer import Layer
+from ..nn import initializer as I
+
+__all__ = ["Conv3D", "SubmConv3D", "BatchNorm", "MaxPool3D", "ReLU",
+           "ReLU6", "LeakyReLU", "Softmax", "functional"]
+
+_INT_MAX = jnp.int32(2 ** 31 - 1)
+
+
+# ------------------------------------------------------------------ utils
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _coerce(x) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[int, ...]]:
+    """(indices [nnz,4] int32, values [nnz,C], full shape) from a BCOO in
+    NDHWC layout.  Accepts n_dense=1 (fast path) or an all-sparse BCOO
+    (converted; documented slow path)."""
+    if not isinstance(x, jsparse.BCOO):
+        raise TypeError("sparse.nn expects a SparseCooTensor (BCOO); got "
+                        f"{type(x).__name__}")
+    if x.ndim != 5:
+        raise ValueError(f"sparse conv input must be 5-D NDHWC, got {x.ndim}-D")
+    if x.n_dense == 1:
+        return x.indices.astype(jnp.int32), x.data, tuple(x.shape)
+    # all-sparse fallback: round-trip through dense to get channel-dense form
+    dense = x.todense()
+    y = jsparse.BCOO.fromdense(dense, n_dense=1)
+    return y.indices.astype(jnp.int32), y.data, tuple(x.shape)
+
+
+def _valid_rows(idx, dims) -> jnp.ndarray:
+    """True for real rows; False for BCOO padding (any coord out of
+    range — the module-wide padding convention)."""
+    ok = jnp.ones(idx.shape[:1], bool)
+    for a, ext in enumerate(dims):
+        ok = ok & (idx[:, a] >= 0) & (idx[:, a] < ext)
+    return ok
+
+
+def _sentinel(out_dims) -> jnp.ndarray:
+    """The padding index row: the shape itself (all out of range)."""
+    return jnp.asarray(out_dims, jnp.int32)
+
+
+def _linearize(idx, dims) -> jnp.ndarray:
+    """[nnz,4] coords -> int32 keys (row-major over (N,D,H,W))."""
+    if int(np.prod(dims)) >= 2 ** 31:
+        raise ValueError(f"sparse volume {dims} exceeds int32 key space")
+    n, d, h, w = dims
+    return ((idx[:, 0] * d + idx[:, 1]) * h + idx[:, 2]) * w + idx[:, 3]
+
+
+def _delinearize(keys, dims) -> jnp.ndarray:
+    w_ = keys % dims[3]
+    rest = keys // dims[3]
+    h_ = rest % dims[2]
+    rest = rest // dims[2]
+    d_ = rest % dims[1]
+    n_ = rest // dims[1]
+    return jnp.stack([n_, d_, h_, w_], axis=1).astype(jnp.int32)
+
+
+def _result_dtype(vals, weight):
+    return jnp.result_type(vals.dtype, weight.dtype)
+
+
+# -------------------------------------------------------------- rulebook
+
+def _candidates(idx, valid_in, dims, out_dims, kernel, stride, padding,
+                dilation):
+    """Per (input row, kernel offset): the target output coordinate.
+
+    Returns (keys [nnz*K], src [nnz*K], widx [nnz*K], ok [nnz*K]) with
+    invalid candidates carrying key INT_MAX.  ``ok`` already excludes
+    padding input rows."""
+    kd, kh, kw = kernel
+    sd, sh, sw = stride
+    pd, ph, pw = padding
+    dd, dh, dw = dilation
+    do, ho, wo = out_dims[1], out_dims[2], out_dims[3]
+    nnz = idx.shape[0]
+    keys_l, src_l, widx_l, ok_l = [], [], [], []
+    k = 0
+    for od in range(kd):
+        for oh in range(kh):
+            for ow in range(kw):
+                # output o receives input p at offset (od,oh,ow) iff
+                # o*s = p + pad - off*dil exactly
+                td = idx[:, 1] + pd - od * dd
+                th = idx[:, 2] + ph - oh * dh
+                tw = idx[:, 3] + pw - ow * dw
+                ok = valid_in & (td % sd == 0) & (th % sh == 0) \
+                    & (tw % sw == 0)
+                qd, qh, qw = td // sd, th // sh, tw // sw
+                ok = ok & (qd >= 0) & (qd < do) & (qh >= 0) & (qh < ho) \
+                    & (qw >= 0) & (qw < wo)
+                q = jnp.stack([idx[:, 0], qd, qh, qw], axis=1)
+                kkey = _linearize(jnp.where(ok[:, None], q, 0), out_dims)
+                keys_l.append(jnp.where(ok, kkey, _INT_MAX))
+                src_l.append(jnp.arange(nnz, dtype=jnp.int32))
+                widx_l.append(jnp.full((nnz,), k, jnp.int32))
+                ok_l.append(ok)
+                k += 1
+    return (jnp.concatenate(keys_l), jnp.concatenate(src_l),
+            jnp.concatenate(widx_l), jnp.concatenate(ok_l), k)
+
+
+def _rulebook(idx, valid_in, dims, out_dims, kernel, stride, padding,
+              dilation):
+    """Sorted, segment-grouped candidate table.
+
+    Returns (src_s, widx_s, ok_s, seg, n_rows, seg_valid, out_idx):
+    candidates sorted by output key, ``seg`` mapping each candidate to an
+    output row, output indices per row (sentinel — all-out-of-range — for
+    padding rows, the module convention)."""
+    keys, src, widx, okm = _candidates(idx, valid_in, dims, out_dims,
+                                       kernel, stride, padding, dilation)[:4]
+    order = jnp.argsort(keys)
+    keys_s, src_s, widx_s, ok_s = (keys[order], src[order], widx[order],
+                                   okm[order])
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (keys_s[1:] != keys_s[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(new_seg) - 1
+    n_rows = keys.shape[0]                   # static capacity = nnz*K
+    seg_valid = jax.ops.segment_max(ok_s.astype(jnp.int32), seg,
+                                    num_segments=n_rows) > 0
+    first_of_seg = jax.ops.segment_min(keys_s, seg, num_segments=n_rows)
+    out_idx = jnp.where(seg_valid[:, None],
+                        _delinearize(jnp.where(seg_valid, first_of_seg, 0),
+                                     out_dims),
+                        _sentinel(out_dims)[None, :])
+    return src_s, widx_s, ok_s, seg, n_rows, seg_valid, out_idx
+
+
+# ------------------------------------------------------- functional forms
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups: int = 1, data_format: str = "NDHWC", key=None):
+    """Reference: paddle.sparse.nn.functional.subm_conv3d — submanifold
+    convolution: output indices == input indices (no dilation of the
+    active set).  ``weight`` is [kd, kh, kw, Cin/groups, Cout]."""
+    if groups != 1:
+        raise NotImplementedError("sparse subm_conv3d: groups must be 1")
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv is NDHWC (reference layout)")
+    idx, vals, shape = _coerce(x)
+    kd, kh, kw, cin, cout = weight.shape
+    sd, sh, sw = _triple(stride)
+    if (sd, sh, sw) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 (reference "
+                         "constraint: the active set must be preserved)")
+    dd, dh, dw = _triple(dilation)
+    dims = (shape[0], shape[1], shape[2], shape[3])
+    valid = _valid_rows(idx, dims)
+    # padding rows are excluded from the searchable key set
+    keys = jnp.where(valid, _linearize(jnp.where(valid[:, None], idx, 0),
+                                       dims), _INT_MAX)
+    perm = jnp.argsort(keys)
+    sorted_keys = keys[perm]
+
+    cd, ch, cw = (kd - 1) // 2, (kh - 1) // 2, (kw - 1) // 2
+    out = jnp.zeros((vals.shape[0], cout), _result_dtype(vals, weight))
+    for od in range(kd):
+        for oh in range(kh):
+            for ow in range(kw):
+                off = jnp.asarray(
+                    [0, (od - cd) * dd, (oh - ch) * dh, (ow - cw) * dw],
+                    jnp.int32)
+                nbr = idx + off
+                nb_ok = valid & _valid_rows(nbr, dims)
+                nkey = jnp.where(
+                    nb_ok, _linearize(jnp.where(nb_ok[:, None], nbr, 0),
+                                      dims), _INT_MAX - 1)
+                pos = jnp.clip(jnp.searchsorted(sorted_keys, nkey), 0,
+                               sorted_keys.shape[0] - 1)
+                hit = nb_ok & (sorted_keys[pos] == nkey)
+                src = perm[pos]
+                contrib = vals[src] @ weight[od, oh, ow]
+                out = out + jnp.where(hit[:, None], contrib, 0)
+    if bias is not None:
+        out = out + jnp.where(valid[:, None], bias, 0)
+    out = jnp.where(valid[:, None], out, 0)
+    return jsparse.BCOO((out, idx), shape=shape[:4] + (cout,))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NDHWC"):
+    """Reference: paddle.sparse.nn.functional.conv3d — strided sparse
+    conv.  Output coordinates are the data-dependent active set,
+    capacity-padded to nnz·K candidates and deduplicated by sort; padding
+    rows carry out-of-range indices (dropped by todense, ignored by every
+    op here)."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv3d: groups must be 1")
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv is NDHWC (reference layout)")
+    idx, vals, shape = _coerce(x)
+    kd, kh, kw, cin, cout = weight.shape
+    stride3, pad3, dil3 = _triple(stride), _triple(padding), _triple(dilation)
+    n, d, h, w = shape[0], shape[1], shape[2], shape[3]
+    do = (d + 2 * pad3[0] - dil3[0] * (kd - 1) - 1) // stride3[0] + 1
+    ho = (h + 2 * pad3[1] - dil3[1] * (kh - 1) - 1) // stride3[1] + 1
+    wo = (w + 2 * pad3[2] - dil3[2] * (kw - 1) - 1) // stride3[2] + 1
+    out_dims = (n, do, ho, wo)
+    valid = _valid_rows(idx, (n, d, h, w))
+
+    src_s, widx_s, ok_s, seg, n_rows, seg_valid, out_idx = _rulebook(
+        idx, valid, (n, d, h, w), out_dims, (kd, kh, kw), stride3, pad3,
+        dil3)
+    wmat = weight.reshape(kd * kh * kw, cin, cout)
+    contrib = jnp.einsum("qc,qco->qo", vals[src_s],
+                         wmat[widx_s]).astype(_result_dtype(vals, weight))
+    contrib = jnp.where(ok_s[:, None], contrib, 0)
+    out_vals = jax.ops.segment_sum(contrib, seg, num_segments=n_rows)
+    if bias is not None:
+        out_vals = out_vals + jnp.where(seg_valid[:, None], bias, 0)
+    out_vals = jnp.where(seg_valid[:, None], out_vals, 0)
+    return jsparse.BCOO((out_vals, out_idx), shape=out_dims + (cout,))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NDHWC"):
+    """Reference: paddle.sparse.nn.functional.max_pool3d — max over the
+    stored (active) points covered by each pooling window."""
+    idx, vals, shape = _coerce(x)
+    k3 = _triple(kernel_size)
+    s3 = _triple(stride) if stride is not None else k3
+    p3 = _triple(padding)
+    n, d, h, w = shape[0], shape[1], shape[2], shape[3]
+    c = vals.shape[1]
+    do = (d + 2 * p3[0] - k3[0]) // s3[0] + 1
+    ho = (h + 2 * p3[1] - k3[1]) // s3[1] + 1
+    wo = (w + 2 * p3[2] - k3[2]) // s3[2] + 1
+    out_dims = (n, do, ho, wo)
+    valid = _valid_rows(idx, (n, d, h, w))
+
+    src_s, _, ok_s, seg, n_rows, seg_valid, out_idx = _rulebook(
+        idx, valid, (n, d, h, w), out_dims, k3, s3, p3, (1, 1, 1))
+    neg = jnp.finfo(vals.dtype).min
+    contrib = jnp.where(ok_s[:, None], vals[src_s], neg)
+    out_vals = jax.ops.segment_max(contrib, seg, num_segments=n_rows)
+    out_vals = jnp.where(seg_valid[:, None], out_vals, 0)
+    return jsparse.BCOO((out_vals, out_idx), shape=out_dims + (c,))
+
+
+class _Functional:
+    subm_conv3d = staticmethod(subm_conv3d)
+    conv3d = staticmethod(conv3d)
+    max_pool3d = staticmethod(max_pool3d)
+
+    @staticmethod
+    def relu(x):
+        from . import relu as _r
+        return _r(x)
+
+
+functional = _Functional()
+
+
+# --------------------------------------------------------------- layers
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        kd, kh, kw = _triple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        fan_in = in_channels * kd * kh * kw
+        init = weight_attr if isinstance(weight_attr, I.Initializer) \
+            else I.Normal(0.0, math.sqrt(2.0 / fan_in))
+        self.weight = self.create_parameter(
+            [kd, kh, kw, in_channels // groups, out_channels],
+            default_initializer=init)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], is_bias=True,
+                default_initializer=(bias_attr if isinstance(bias_attr, I.Initializer)
+                                     else I.Constant(0.0)))
+        else:
+            self.bias = None
+
+
+class Conv3D(_SparseConvBase):
+    """Reference: paddle.sparse.nn.Conv3D."""
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                      self.dilation, self.groups, self.data_format)
+
+
+class SubmConv3D(_SparseConvBase):
+    """Reference: paddle.sparse.nn.SubmConv3D (submanifold: output active
+    set == input active set)."""
+
+    def forward(self, x):
+        return subm_conv3d(x, self.weight, self.bias, self.stride,
+                           self.padding, self.dilation, self.groups,
+                           self.data_format)
+
+
+class BatchNorm(Layer):
+    """Reference: paddle.sparse.nn.BatchNorm — normalizes the stored
+    values per channel.  Statistics run over the VALID rows only (padding
+    rows from a strided Conv3D upstream are excluded — the reference's
+    statistics over the actually-stored points)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], is_bias=True, default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance",
+                             jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        idx, vals, shape = _coerce(x)
+        valid = _valid_rows(idx, shape[:4])
+        v32 = jnp.where(valid[:, None], vals.astype(jnp.float32), 0)
+        cnt = jnp.maximum(valid.sum(), 1).astype(jnp.float32)
+        if self.training:
+            mean = v32.sum(axis=0) / cnt
+            var = (jnp.where(valid[:, None], (v32 - mean) ** 2, 0).sum(axis=0)
+                   / cnt)
+            unbiased = var * (cnt / jnp.maximum(cnt - 1, 1))
+            self._mean = self.momentum * self._mean + (1 - self.momentum) * mean
+            self._variance = (self.momentum * self._variance
+                              + (1 - self.momentum) * unbiased)
+        else:
+            mean, var = self._mean, self._variance
+        y = (vals.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = (y * self.weight + self.bias).astype(vals.dtype)
+        y = jnp.where(valid[:, None], y, 0)
+        return jsparse.BCOO((y, idx), shape=shape)
+
+
+class MaxPool3D(Layer):
+    """Reference: paddle.sparse.nn.MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class _ValsAct(Layer):
+    def _apply(self, vals):
+        raise NotImplementedError
+
+    def forward(self, x):
+        idx, vals, shape = _coerce(x)
+        valid = _valid_rows(idx, shape[:4])
+        # padding rows stay exactly zero (softmax would otherwise paint
+        # them with 1/C)
+        y = jnp.where(valid[:, None], self._apply(vals), 0)
+        return jsparse.BCOO((y, idx), shape=shape)
+
+
+class ReLU(_ValsAct):
+    def _apply(self, vals):
+        return jnp.maximum(vals, 0)
+
+
+class ReLU6(_ValsAct):
+    def _apply(self, vals):
+        return jnp.clip(vals, 0, 6)
+
+
+class LeakyReLU(_ValsAct):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def _apply(self, vals):
+        return jnp.where(vals >= 0, vals, self.negative_slope * vals)
+
+
+class Softmax(_ValsAct):
+    """Softmax over the channel (dense) axis of the stored values."""
+
+    def _apply(self, vals):
+        return jax.nn.softmax(vals, axis=-1)

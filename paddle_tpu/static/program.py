@@ -46,11 +46,16 @@ __all__ = [
     "StaticGraphError", "create_parameter", "save", "load",
 ]
 
-# Probe size substituted for None (dynamic) dims when running eval_shape at
-# build time.  Shape metadata on Variables is cosmetic — replay re-executes
-# with the real feed shapes — so an unlikely odd value keeps the
-# restore-None heuristic from colliding with real layer widths.
+# Probe sizes substituted for None (dynamic) dims when running eval_shape
+# at build time.  Shape metadata on Variables is cosmetic — replay
+# re-executes with the real feed shapes.  Dynamic output dims are detected
+# by DIFFERENCING two eval_shape runs with different probes: a dim that
+# changes with the probe is dynamic (robust against real widths equal to
+# a probe and against probe arithmetic like concat doubling); if the
+# second probe fails to trace (e.g. a static reshape only consistent with
+# one size) the single-probe == heuristic is the fallback.
 _PROBE = 191
+_PROBE2 = 193
 
 
 class StaticGraphError(RuntimeError):
@@ -297,14 +302,20 @@ class _Node:
 
 
 class _ParamDecl:
-    __slots__ = ("name", "shape", "dtype", "init_fn", "stop_gradient")
+    __slots__ = ("name", "shape", "dtype", "init_fn", "stop_gradient",
+                 "owner_main")
 
-    def __init__(self, name, shape, dtype, init_fn, stop_gradient=False):
+    def __init__(self, name, shape, dtype, init_fn, stop_gradient=False,
+                 owner_main=None):
         self.name = name
         self.shape = tuple(shape)
         self.dtype = jnp.dtype(dtype)
         self.init_fn = init_fn          # key -> concrete array
         self.stop_gradient = stop_gradient
+        # the main program the declaration was authored under: users set
+        # random_seed there (reference habit), so startup init falls back
+        # to it when the startup program itself carries no seed
+        self.owner_main = owner_main
 
 
 class Program:
@@ -509,7 +520,7 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
         return _init.init(key, _shape, _dt)
 
     startup.params[name] = _ParamDecl(name, shape, jdtype, init_fn,
-                                      stop_gradient)
+                                      stop_gradient, owner_main=prog)
     startup._is_startup = True  # explicit marker Executor.run dispatches on
     # params are also visible on the main program
     prog.params[name] = startup.params[name]
@@ -586,11 +597,31 @@ def record_call(fn: Callable, args: tuple, kwargs: dict):
 
     had_dynamic = _contains_dynamic(args, kwargs)
     flat_out, treedef = jax.tree.flatten(out_shape)
+    flat_out2 = None
+    if had_dynamic:
+        def to_aval2(x):
+            if isinstance(x, Variable):
+                shape = tuple(_PROBE2 if d is None else d for d in x.shape)
+                return jax.ShapeDtypeStruct(shape, x.dtype)
+            return x
+        try:
+            out_shape2 = jax.eval_shape(
+                fn_on_vars, *[to_aval2(flat_all[i]) for i in var_idx])
+            flat_out2 = jax.tree.leaves(out_shape2)
+            if len(flat_out2) != len(flat_out):
+                flat_out2 = None
+        except Exception:  # noqa: BLE001 — fall back to the == heuristic
+            flat_out2 = None
     out_vars = []
-    for aval in flat_out:
-        shape = tuple(
-            None if (had_dynamic and d == _PROBE) else int(d)
-            for d in aval.shape)
+    for j, aval in enumerate(flat_out):
+        if flat_out2 is not None:
+            shape = tuple(
+                None if int(d) != int(d2) else int(d)
+                for d, d2 in zip(aval.shape, flat_out2[j].shape))
+        else:
+            shape = tuple(
+                None if (had_dynamic and d == _PROBE) else int(d)
+                for d in aval.shape)
         out_vars.append(prog._new_var(f"{label}_{prog._next_vid[0]}",
                                       shape, aval.dtype,
                                       stop_gradient=False))
@@ -734,12 +765,18 @@ class Executor:
     def _run_startup(self, program: Program, scope: "Scope" = None):
         from ..framework.random import next_rng_key
         scope = scope or global_scope()
-        for name, decl in program.params.items():
+        for pos, (name, decl) in enumerate(program.params.items()):
             if scope.find_var(name) is None or scope._store.get(name) is None:
-                if program.random_seed is not None:
-                    key = jax.random.fold_in(
-                        jax.random.PRNGKey(program.random_seed),
-                        _stable_hash(name))
+                seed = program.random_seed
+                if seed is None and decl.owner_main is not None:
+                    # users set random_seed on the MAIN program (reference
+                    # habit); honor it for the decls authored under it
+                    seed = decl.owner_main.random_seed
+                if seed is not None:
+                    # keyed by declaration ORDER, not name: names are
+                    # globally unique across programs, so identical nets
+                    # built twice with the same seed must still match
+                    key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
                 else:
                     key = next_rng_key()
                 scope._store[name] = decl.init_fn(key)
@@ -907,13 +944,6 @@ class Executor:
             return out, {**frozen, **new_t}, new_state
 
         return step
-
-
-def _stable_hash(s: str) -> int:
-    h = 2166136261
-    for ch in s.encode():
-        h = (h ^ ch) * 16777619 & 0xFFFFFFFF
-    return h
 
 
 # --------------------------------------------------------------------------

@@ -288,6 +288,15 @@ PADDLE_FLEET_UTILS = """
 HDFSClient LocalFS recompute recompute_sequential
 """
 
+PADDLE_SPARSE_NN = """
+Conv3D SubmConv3D BatchNorm MaxPool3D ReLU ReLU6 LeakyReLU Softmax
+functional
+"""
+
+PADDLE_SPARSE_NN_F = """
+conv3d subm_conv3d max_pool3d relu
+"""
+
 PADDLE_DISTRIBUTED_PASSES = """
 PassBase PassContext PassManager new_pass register_pass
 """
@@ -355,6 +364,8 @@ REFERENCE = {
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
     "paddle.distributed.fleet.utils": PADDLE_FLEET_UTILS,
+    "paddle.sparse.nn": PADDLE_SPARSE_NN,
+    "paddle.sparse.nn.functional": PADDLE_SPARSE_NN_F,
     "paddle.distributed.passes": PADDLE_DISTRIBUTED_PASSES,
     "paddle.distributed.rpc": PADDLE_DISTRIBUTED_RPC,
     "paddle.autograd": PADDLE_AUTOGRAD,
@@ -398,6 +409,8 @@ TARGETS = {
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
     "paddle.distributed.fleet.utils": "paddle_tpu.distributed.fleet_utils",
+    "paddle.sparse.nn": "paddle_tpu.sparse.nn",
+    "paddle.sparse.nn.functional": "paddle_tpu.sparse.nn.functional",
     "paddle.distributed.passes": "paddle_tpu.distributed.passes",
     "paddle.distributed.rpc": "paddle_tpu.distributed.rpc",
     "paddle.autograd": "paddle_tpu.autograd",

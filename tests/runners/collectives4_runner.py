@@ -1,0 +1,69 @@
+"""Worker for the 4-process eager-collective breadth test: boots
+jax.distributed from the launcher env contract, then drives all_gather,
+broadcast, reduce_scatter and barrier ACROSS the process boundary
+(round-2 review: eager multi-process semantics beyond the 2-proc
+all_reduce were unexercised — SURVEY.md §2.3 "Communication API").
+"""
+
+import os
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+import jax.extend.backend as jeb
+jeb.clear_backends()
+
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+W = jax.process_count()
+assert W == 4, W
+rank = dist.get_rank()
+
+group = dist.collective._default_group()
+mesh = group.mesh
+
+
+def dist_arr(per_rank_fn, per_shape=(2,)):
+    """Global array whose shard on rank r is per_rank_fn(r)."""
+    global_shape = (W * per_shape[0],) + per_shape[1:]
+    return jax.make_array_from_callback(
+        global_shape, NamedSharding(mesh, P(group.name)),
+        lambda idx: per_rank_fn(idx[0].start // per_shape[0]).astype(np.float32))
+
+
+# all_reduce: sum of rank+1 = 10
+x = dist_arr(lambda r: np.full((2,), r + 1.0))
+out = dist.all_reduce(x)
+v = float(np.asarray(out.addressable_shards[0].data)[0])
+assert v == 10.0, v
+
+# all_gather: every rank sees [1, 2, 3, 4] (one slot per rank)
+x = dist_arr(lambda r: np.full((1,), r + 1.0), per_shape=(1,))
+gathered = dist.all_gather(x)
+g = np.asarray(gathered.addressable_shards[0].data).ravel()
+assert np.allclose(np.sort(g), [1, 2, 3, 4]), g
+
+# broadcast from rank 2: everyone ends with rank-2's payload
+x = dist_arr(lambda r: np.full((2,), 100.0 * r))
+b = dist.broadcast(x, src=2)
+bv = np.asarray(b.addressable_shards[0].data)
+assert np.allclose(bv, 200.0), bv
+
+# reduce_scatter: global input of 4 slots, each rank keeps the sum of its slot
+x = dist_arr(lambda r: np.arange(4, dtype=np.float32) + r,
+             per_shape=(4,))
+rs = dist.reduce_scatter(None, x)
+rv = np.asarray(rs.addressable_shards[0].data)
+# slot i holds sum over ranks of (i + r) = 4*i + 6
+assert np.allclose(rv, 4.0 * rank + 6.0), (rank, rv)
+
+dist.barrier()
+print(f"COLLECTIVES4_OK rank={rank}")

@@ -56,6 +56,11 @@ def test_example_train_moe_ep():
     assert "OK: expert-parallel MoE trained" in out, out[-400:]
 
 
+def test_example_train_static():
+    out = _run("train_static.py", "--steps", "60")
+    assert "STATIC_EXAMPLE_OK" in out
+
+
 def test_example_infer_export():
     out = _run("infer_export.py")
     low = out.lower()

@@ -128,6 +128,37 @@ def test_launch_two_process_jax_distributed_allreduce(tmp_path):
     assert logs.count("ALLREDUCE_OK") == 2, logs[-1000:]
 
 
+def test_launch_four_process_collective_breadth(tmp_path):
+    """4 REAL processes drive all_gather / broadcast(src=2) /
+    reduce_scatter / barrier across the process boundary (round-2 review:
+    eager multi-process semantics beyond 2-proc all_reduce were
+    unexercised)."""
+    import socket
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runner = os.path.join(repo, "tests", "runners", "collectives4_runner.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PADDLE_TPU_REPO"] = repo
+    log_dir = str(tmp_path / "log")
+    r = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--master", f"127.0.0.1:{port}",
+         "--log_dir", log_dir, "--max_restart", "0", runner],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=420)
+    logs = ""
+    for i in range(4):
+        p = os.path.join(log_dir, f"workerlog.{i}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    assert r.returncode == 0, (r.stderr[-500:], logs[-1200:])
+    assert logs.count("COLLECTIVES4_OK") == 4, logs[-1200:]
+
+
 def test_rpc_two_processes(tmp_path):
     """distributed.rpc across 2 real processes via the launcher env
     contract (reference: python/paddle/distributed/rpc)."""

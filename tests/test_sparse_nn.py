@@ -1,0 +1,329 @@
+"""paddle.sparse.nn tests — sparse 3D conv stack vs dense oracles.
+
+Reference: python/paddle/sparse/nn (Conv3D/SubmConv3D/BatchNorm/
+MaxPool3D); test model: the reference's sparse-conv unit tests compare
+against dense convolution on the densified input (test/legacy_test
+sparse conv tests).  Here: every op is checked against the dense
+F.conv3d / max_pool3d / batch-norm computation restricted to the active
+set.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.sparse import nn as snn
+
+
+def _random_sparse(rng, N=2, D=6, H=6, W=6, C=4, nnz=30):
+    dense = np.zeros((N, D, H, W, C), np.float32)
+    pts = rng.choice(N * D * H * W, nnz, replace=False)
+    for p in pts:
+        n, r = divmod(int(p), D * H * W)
+        d, r = divmod(r, H * W)
+        h, w = divmod(r, W)
+        dense[n, d, h, w] = rng.normal(size=C)
+    return dense, jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+
+
+def _dense_conv_ref(dense, weight, bias, stride=1, padding=0, dilation=1):
+    """NDHWC dense conv3d via the dense functional path (NCDHW)."""
+    w = jnp.transpose(weight, (4, 3, 0, 1, 2))
+    xd = jnp.transpose(jnp.asarray(dense), (0, 4, 1, 2, 3))
+    ref = F.conv3d(xd, w, bias, stride=stride, padding=padding,
+                   dilation=dilation)
+    return jnp.transpose(ref, (0, 2, 3, 4, 1))
+
+
+class TestSubmConv3D:
+    def test_matches_dense_conv_on_active_set(self):
+        rng = np.random.default_rng(0)
+        dense, x = _random_sparse(rng)
+        paddle.seed(0)
+        conv = snn.SubmConv3D(4, 8, 3)
+        y = conv(x)
+        assert y.shape == (2, 6, 6, 6, 8)
+        ref = _dense_conv_ref(dense, conv.weight, conv.bias, padding=1)
+        mask = (np.abs(dense).sum(-1, keepdims=True) > 0)
+        np.testing.assert_allclose(np.asarray(ref) * mask,
+                                   np.asarray(y.todense()),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_active_set_preserved(self):
+        rng = np.random.default_rng(1)
+        dense, x = _random_sparse(rng, nnz=12)
+        conv = snn.SubmConv3D(4, 4, 3, bias_attr=False)
+        y = conv(x)
+        np.testing.assert_array_equal(np.asarray(y.indices),
+                                      np.asarray(x.indices))
+
+    def test_jit_and_grad(self):
+        rng = np.random.default_rng(2)
+        dense, x = _random_sparse(rng, nnz=10)
+        paddle.seed(1)
+        conv = snn.SubmConv3D(4, 4, 3)
+
+        @jax.jit
+        def loss(w, b):
+            y = snn.functional.subm_conv3d(x, w, b, padding=0)
+            return (y.data ** 2).sum()
+
+        g = jax.grad(loss, argnums=(0, 1))(conv.weight, conv.bias)
+        assert np.isfinite(np.asarray(g[0])).all()
+        assert float(jnp.abs(g[0]).sum()) > 0
+
+    def test_stride_rejected(self):
+        rng = np.random.default_rng(3)
+        _, x = _random_sparse(rng)
+        conv = snn.SubmConv3D(4, 4, 3, stride=2)
+        with pytest.raises(ValueError, match="stride 1"):
+            conv(x)
+
+    def test_dilation(self):
+        rng = np.random.default_rng(4)
+        dense, x = _random_sparse(rng, D=8, H=8, W=8, nnz=25)
+        paddle.seed(2)
+        conv = snn.SubmConv3D(4, 6, 3, dilation=2)
+        y = conv(x)
+        ref = _dense_conv_ref(dense, conv.weight, conv.bias, padding=2,
+                              dilation=2)
+        mask = (np.abs(dense).sum(-1, keepdims=True) > 0)
+        np.testing.assert_allclose(np.asarray(ref) * mask,
+                                   np.asarray(y.todense()),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestConv3D:
+    @pytest.mark.parametrize("stride,padding", [(1, 0), (2, 0), (2, 1),
+                                                (1, 1)])
+    def test_matches_dense_conv_at_active_outputs(self, stride, padding):
+        rng = np.random.default_rng(5)
+        dense, x = _random_sparse(rng, nnz=20)
+        paddle.seed(3)
+        conv = snn.Conv3D(4, 5, 3, stride=stride, padding=padding)
+        y = conv(x)
+        ref = np.asarray(_dense_conv_ref(dense, conv.weight, conv.bias,
+                                         stride=stride, padding=padding))
+        out = np.asarray(y.todense())
+        assert out.shape == ref.shape
+        # active output positions match the dense conv (incl. bias); the
+        # remaining positions are zero in the sparse result
+        active = np.abs(np.asarray(y.data)).sum(-1) > 0
+        idxs = np.asarray(y.indices)[active]
+        for (n, d, h, w) in idxs:
+            np.testing.assert_allclose(out[n, d, h, w], ref[n, d, h, w],
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_output_coords_are_window_cover(self):
+        """Every input point must land in ceil-div windows: the sparse
+        output active set equals the dense conv's nonzero support for a
+        no-bias conv with all-ones weights and positive inputs."""
+        rng = np.random.default_rng(6)
+        dense = np.zeros((1, 5, 5, 5, 1), np.float32)
+        dense[0, 1, 2, 3, 0] = 1.0
+        dense[0, 4, 4, 4, 0] = 2.0
+        x = jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+        w = jnp.ones((2, 2, 2, 1, 1), jnp.float32)
+        y = snn.functional.conv3d(x, w, stride=2, padding=1)
+        out = np.asarray(y.todense())
+        ref = np.asarray(_dense_conv_ref(dense, w, None, stride=2, padding=1))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_jit_compiles(self):
+        rng = np.random.default_rng(7)
+        _, x = _random_sparse(rng, nnz=8)
+        paddle.seed(4)
+        conv = snn.Conv3D(4, 4, 2, stride=2)
+        y = jax.jit(lambda v: snn.functional.conv3d(
+            x, v, stride=2).data.sum())(conv.weight)
+        assert np.isfinite(float(y))
+
+
+class TestMaxPool3D:
+    def test_matches_dense_pool_at_active_outputs(self):
+        rng = np.random.default_rng(8)
+        # positive values so dense max-pool (which sees zeros) agrees with
+        # sparse max over stored points at windows containing points
+        dense, _ = _random_sparse(rng, nnz=25)
+        dense = np.abs(dense) + 0.1 * (np.abs(dense).sum(-1, keepdims=True) > 0)
+        x = jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+        pool = snn.MaxPool3D(2, stride=2)
+        y = pool(x)
+        xd = jnp.transpose(jnp.asarray(dense), (0, 4, 1, 2, 3))
+        ref = F.max_pool3d(xd, 2, stride=2)
+        ref = np.asarray(jnp.transpose(ref, (0, 2, 3, 4, 1)))
+        out = np.asarray(y.todense())
+        active = np.abs(np.asarray(y.data)).sum(-1) > 0
+        idxs = np.asarray(y.indices)[active]
+        assert len(idxs)
+        for (n, d, h, w) in idxs:
+            np.testing.assert_allclose(out[n, d, h, w], ref[n, d, h, w],
+                                       rtol=1e-5)
+
+
+class TestBatchNormAndActs:
+    def test_batch_norm_normalizes_values(self):
+        rng = np.random.default_rng(9)
+        dense, x = _random_sparse(rng, nnz=40)
+        bn = snn.BatchNorm(4)
+        bn.train()
+        y = bn(x)
+        v = np.asarray(y.data, np.float64)
+        np.testing.assert_allclose(v.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(v.std(0), 1, atol=1e-2)
+        # moving stats moved toward the batch stats
+        assert not np.allclose(np.asarray(bn._mean), 0)
+
+    def test_batch_norm_eval_uses_moving_stats(self):
+        rng = np.random.default_rng(10)
+        _, x = _random_sparse(rng, nnz=40)
+        bn = snn.BatchNorm(4)
+        bn.train(); bn(x)
+        bn.eval()
+        mean_before = np.asarray(bn._mean).copy()
+        bn(x)
+        np.testing.assert_allclose(np.asarray(bn._mean), mean_before)
+
+    def test_relu_family(self):
+        rng = np.random.default_rng(11)
+        _, x = _random_sparse(rng, nnz=15)
+        for layer, fn in [(snn.ReLU(), lambda v: np.maximum(v, 0)),
+                          (snn.ReLU6(), lambda v: np.clip(v, 0, 6)),
+                          (snn.LeakyReLU(0.1),
+                           lambda v: np.where(v >= 0, v, 0.1 * v))]:
+            y = layer(x)
+            np.testing.assert_allclose(np.asarray(y.data),
+                                       fn(np.asarray(x.data)), rtol=1e-6)
+
+    def test_softmax_channels(self):
+        rng = np.random.default_rng(12)
+        _, x = _random_sparse(rng, nnz=10)
+        y = snn.Softmax()(x)
+        np.testing.assert_allclose(np.asarray(y.data).sum(-1), 1, rtol=1e-5)
+
+
+class TestPaddingRowChaining:
+    """Strided Conv3D output carries capacity-padding rows (out-of-range
+    indices); downstream ops must treat them as absent (review finding:
+    they previously polluted BatchNorm stats and SubmConv3D lookups)."""
+
+    def _chain_input(self):
+        rng = np.random.default_rng(20)
+        dense = np.zeros((1, 6, 6, 6, 3), np.float32)
+        pts = rng.choice(6 * 6 * 6, 15, replace=False)
+        for p in pts:
+            d, r = divmod(int(p), 36)
+            h, w = divmod(r, 6)
+            dense[0, d, h, w] = rng.normal(size=3) + 0.5
+        return dense, jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+
+    def test_conv3d_padding_rows_are_out_of_range(self):
+        dense, x = self._chain_input()
+        paddle.seed(6)
+        conv = snn.Conv3D(3, 4, 3, stride=2, padding=1)
+        y = conv(x)
+        idxs = np.asarray(y.indices)
+        shape = np.asarray(y.shape[:4])
+        in_range = (idxs >= 0).all(1) & (idxs < shape).all(1)
+        # padding rows exist (capacity > active set) and carry zero values
+        assert (~in_range).any()
+        np.testing.assert_allclose(np.asarray(y.data)[~in_range], 0)
+
+    def test_bias_does_not_accumulate_at_origin(self):
+        dense, x = self._chain_input()
+        paddle.seed(6)
+        conv = snn.Conv3D(3, 4, 3, stride=2, padding=1,
+                          bias_attr=paddle.nn.initializer.Constant(5.0))
+        y = conv(x)
+        out = np.asarray(y.todense())
+        ref = np.asarray(_dense_conv_ref(dense, conv.weight, conv.bias,
+                                         stride=2, padding=1))
+        # origin cell must match the dense conv exactly — no padding-bias
+        # pileup at (0,0,0,0)
+        np.testing.assert_allclose(out[0, 0, 0, 0], ref[0, 0, 0, 0],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv_bn_subm_chain_matches_dense_oracle(self):
+        dense, x = self._chain_input()
+        paddle.seed(7)
+        conv = snn.Conv3D(3, 4, 2, stride=2)
+        bn = snn.BatchNorm(4)
+        bn.train()
+        subm = snn.SubmConv3D(4, 4, 3)
+        y = subm(bn(conv(x)))
+        out = np.asarray(y.todense())
+
+        # oracle: same chain on the densified tensors, masked to the
+        # active set at each sparse stage
+        h1 = np.asarray(_dense_conv_ref(dense, conv.weight, conv.bias,
+                                        stride=2))
+        y1 = np.asarray(conv(x).todense())
+        active1 = np.abs(y1).sum(-1, keepdims=True) > 0
+        # bn oracle over active rows of the conv output
+        rows = y1[active1[..., 0]]
+        mean = rows.mean(0)
+        var = rows.var(0)
+        h2 = (y1 - mean) / np.sqrt(var + 1e-5) * active1
+        h3 = np.asarray(_dense_conv_ref(
+            h2, subm.weight, subm.bias, padding=1))
+        np.testing.assert_allclose(out, h3 * active1, rtol=1e-3, atol=1e-3)
+
+    def test_activations_keep_padding_rows_zero(self):
+        dense, x = self._chain_input()
+        paddle.seed(8)
+        conv = snn.Conv3D(3, 4, 3, stride=2, padding=1)
+        y = conv(x)
+        idxs = np.asarray(y.indices)
+        shape = np.asarray(y.shape[:4])
+        pad_rows = ~((idxs >= 0).all(1) & (idxs < shape).all(1))
+        for layer in (snn.Softmax(), snn.ReLU6(), snn.LeakyReLU(0.2)):
+            z = layer(y)
+            np.testing.assert_allclose(np.asarray(z.data)[pad_rows], 0)
+
+
+class TestEndToEnd:
+    def test_sparse_cnn_trains(self):
+        """SubmConv3D -> BatchNorm -> ReLU -> global sum readout learns a
+        2-class point-cloud problem end-to-end under jit."""
+        rng = np.random.default_rng(13)
+        xs, labels = [], []
+        for i in range(8):
+            dense = np.zeros((1, 6, 6, 6, 2), np.float32)
+            cls = i % 2
+            # class decides WHERE mass concentrates
+            lo, hi = (0, 3) if cls == 0 else (3, 6)
+            for _ in range(10):
+                d, h, w = rng.integers(lo, hi, 3)
+                dense[0, d, h, w] = rng.normal(size=2) + 1.0
+            xs.append(jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1))
+            labels.append(cls)
+
+        paddle.seed(5)
+        conv = snn.SubmConv3D(2, 8, 3)
+        head_w = jnp.asarray(rng.normal(size=(8 + 3, 2)) * 0.1, jnp.float32)
+
+        def logits(w, b, hw, x):
+            y = snn.functional.subm_conv3d(x, w, b)
+            feat = jnp.maximum(y.data, 0).mean(0)
+            # position summary: mean active coordinate (normalized)
+            pos = x.indices[:, 1:].astype(jnp.float32).mean(0) / 6.0
+            return jnp.concatenate([feat, pos]) @ hw
+
+        def loss_fn(params):
+            w, b, hw = params
+            ls = [F.cross_entropy(logits(w, b, hw, x)[None],
+                                  jnp.asarray([c]))
+                  for x, c in zip(xs, labels)]
+            return jnp.stack(ls).mean()
+
+        params = (conv.weight, conv.bias, head_w)
+        val0 = float(loss_fn(params))
+        g_fn = jax.value_and_grad(loss_fn)
+        for _ in range(30):
+            l, g = g_fn(params)
+            params = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        assert float(l) < val0 * 0.5, (val0, float(l))

@@ -461,6 +461,53 @@ class TestReviewRegressions:
                       fetch_list=[z])
         np.testing.assert_allclose(zv, [3., 5.])
 
+    def test_random_seed_on_main_program_is_honored(self):
+        """Users set random_seed on the MAIN program (the reference habit
+        and what every test here does) — startup init must honor it
+        (review finding: only the startup program's seed was read)."""
+        weights = []
+        for _ in range(2):
+            main, startup = _fresh_pair()
+            main.random_seed = 99
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 4])
+                static.nn.fc(x, 3)
+            exe = static.Executor()
+            exe.run(startup)
+            wname = [n for n in main.params if n.endswith(".w_0")][0]
+            weights.append(np.asarray(
+                static.global_scope().find_var(wname).get_tensor()))
+        np.testing.assert_allclose(weights[0], weights[1])
+
+    def test_width_191_not_mistaken_for_dynamic(self):
+        """A real dim equal to the probe size must stay concrete (review
+        finding: the single-probe heuristic rewrote it to None)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 191])
+            y = paddle.nn.functional.relu(x)
+            assert y.shape == (None, 191)
+            h = static.nn.fc(y, 10)   # needs the concrete feature dim
+            assert h.shape == (None, 10)
+
+    def test_probe_arithmetic_dims_detected_dynamic(self):
+        """concat along the dynamic axis: the output dim is dynamic even
+        though it equals 2*probe, not probe."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            y = paddle.concat([x, x], axis=0)
+        assert y.shape == (None, 4)
+
+    def test_disable_static_rearms_fast_path(self):
+        """data() outside a guard arms the recording scan; disable_static
+        must dis-arm it (review finding: it stayed armed forever)."""
+        from paddle_tpu.static import program as prog_mod
+        static.data(f"fastpath_probe_{np.random.randint(1e9)}", [2])
+        assert prog_mod._DEFAULT_DIRTY[0]
+        paddle.disable_static()
+        assert not prog_mod._DEFAULT_DIRTY[0]
+
     def test_empty_main_program_run_is_noop_not_reinit(self):
         """A node-less main program must not be mistaken for a startup
         program (review finding: heuristic reinitialized params)."""
