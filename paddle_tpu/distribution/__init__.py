@@ -557,3 +557,18 @@ class AffineTransform:
 __all__ += ["ExponentialFamily", "Exponential", "Gamma", "Geometric",
             "Poisson", "Multinomial", "StudentT", "TransformedDistribution",
             "AffineTransform"]
+
+
+from .extra import (  # noqa: E402,F401
+    AbsTransform, Binomial, Cauchy, ChainTransform, Chi2,
+    ContinuousBernoulli, ExpTransform, Independent, IndependentTransform,
+    MultivariateNormal, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform)
+
+__all__ += ["AbsTransform", "Binomial", "Cauchy", "ChainTransform", "Chi2",
+            "ContinuousBernoulli", "ExpTransform", "Independent",
+            "IndependentTransform", "MultivariateNormal", "PowerTransform",
+            "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+            "StackTransform", "StickBreakingTransform", "TanhTransform",
+            "Transform"]

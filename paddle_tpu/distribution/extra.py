@@ -1,0 +1,479 @@
+"""paddle.distribution — the remaining reference families + transforms.
+
+Reference: python/paddle/distribution/ — binomial.py, cauchy.py, chi2.py,
+continuous_bernoulli.py, independent.py, multivariate_normal.py, and
+transform.py's zoo (AbsTransform, ChainTransform, ExpTransform,
+IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)
+(SURVEY.md §2.2 Python front end — paddle.distribution rides the tensor
+API).  Oracles in tests: scipy.stats / torch.distributions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import Distribution, Gamma, _key
+
+__all__ = [
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli", "Independent",
+    "MultivariateNormal", "AbsTransform", "ChainTransform", "ExpTransform",
+    "IndependentTransform", "PowerTransform", "ReshapeTransform",
+    "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+    "StickBreakingTransform", "TanhTransform", "Transform"]
+
+
+class Binomial(Distribution):
+    """Reference: paddle.distribution.Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count, jnp.int32)
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        n = int(jnp.max(self.total_count))
+        k = _key(key)
+        draws = jax.random.bernoulli(
+            k, self.probs,
+            tuple(shape) + (n,) + jnp.shape(self.probs))
+        # mask counts beyond each element's total_count
+        steps = jnp.arange(n).reshape((1,) * len(tuple(shape)) + (n,)
+                                      + (1,) * self.probs.ndim)
+        mask = steps < self.total_count
+        return (draws & mask).sum(axis=len(tuple(shape))).astype(jnp.float32)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        n = self.total_count.astype(jnp.float32)
+        logc = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        return (logc + v * jnp.log(self.probs)
+                + (n - v) * jnp.log1p(-self.probs))
+
+    def entropy(self):
+        """Exact via summation over the support (static total_count)."""
+        n = int(jnp.max(self.total_count))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        ks = ks.reshape((n + 1,) + (1,) * self.probs.ndim)
+        logp = Binomial(self.total_count, self.probs).log_prob(ks)
+        valid = ks <= self.total_count
+        p = jnp.where(valid, jnp.exp(logp), 0)
+        return -(p * jnp.where(valid, logp, 0)).sum(axis=0)
+
+
+class Cauchy(Distribution):
+    """Reference: paddle.distribution.Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        u = jax.random.uniform(
+            _key(key), tuple(shape) + jnp.broadcast_shapes(
+                jnp.shape(self.loc), jnp.shape(self.scale)))
+        return self.loc + self.scale * jnp.tan(math.pi * (u - 0.5))
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        z = (v - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z * z))
+
+    def cdf(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        return jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                jnp.broadcast_shapes(jnp.shape(self.loc),
+                                                     jnp.shape(self.scale)))
+
+
+class Chi2(Gamma):
+    """Reference: paddle.distribution.Chi2(df) = Gamma(df/2, rate 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = jnp.asarray(df, jnp.float32)
+        super().__init__(self.df / 2.0, 0.5)
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference: paddle.distribution.ContinuousBernoulli(probs) — the
+    [0,1]-supported exponential family with density
+    C(p) p^x (1-p)^(1-x) (Loaiza-Ganem & Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        self._lims = lims
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _safe_p(self):
+        # clamp p near 1/2 for the singular normalizer (reference tactic)
+        return jnp.where(self._outside(), self.probs, self._lims[0])
+
+    def _log_norm(self):
+        p = self._safe_p()
+        out = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * p))
+                      / jnp.abs(1 - 2 * p))
+        # Taylor at p=1/2: C -> 2 + O((p-1/2)^2)
+        taylor = math.log(2.0) + 4.0 / 3.0 * (self.probs - 0.5) ** 2
+        return jnp.where(self._outside(), out, taylor)
+
+    @property
+    def mean(self):
+        p = self._safe_p()
+        m = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        taylor = 0.5 + (self.probs - 0.5) / 3.0
+        return jnp.where(self._outside(), m, taylor)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        return (self._log_norm() + v * jnp.log(self.probs)
+                + (1 - v) * jnp.log1p(-self.probs))
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        # inverse CDF: F^-1(u) = (log1p(u(2p-1)/(1-p) ... ) standard form
+        u = jax.random.uniform(_key(key),
+                               tuple(shape) + jnp.shape(self.probs))
+        p = self._safe_p()
+        icdf = (jnp.log1p(u * (2 * p - 1) / (1 - p))
+                / (jnp.log(p) - jnp.log1p(-p)))
+        return jnp.where(self._outside(), icdf, u)
+
+    def cdf(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        p = self._safe_p()
+        c = ((p ** v * (1 - p) ** (1 - v) + p - 1)
+             / (2 * p - 1))
+        return jnp.clip(jnp.where(self._outside(), c, v), 0, 1)
+
+
+class Independent(Distribution):
+    """Reference: paddle.distribution.Independent — reinterprets the last
+    ``reinterpreted_batch_rank`` batch dims as event dims (log_prob sums
+    over them)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int,
+                 name=None):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        return self.base.sample(shape, key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(lp.ndim - self.reinterpreted_batch_rank, lp.ndim))
+        return lp.sum(axis=axes) if axes else lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        axes = tuple(range(e.ndim - self.reinterpreted_batch_rank, e.ndim))
+        return e.sum(axis=axes) if axes else e
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+
+class MultivariateNormal(Distribution):
+    """Reference: paddle.distribution.MultivariateNormal(loc,
+    covariance_matrix=None, scale_tril=None)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 precision_matrix=None, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        if scale_tril is not None:
+            self._tril = jnp.asarray(scale_tril, jnp.float32)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.asarray(covariance_matrix, jnp.float32))
+        elif precision_matrix is not None:
+            prec = jnp.asarray(precision_matrix, jnp.float32)
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError("one of covariance_matrix/scale_tril/"
+                             "precision_matrix is required")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return self._tril @ self._tril.mT
+
+    @property
+    def variance(self):
+        return jnp.square(self._tril).sum(-1)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        z = jax.random.normal(
+            _key(key), tuple(shape) + self.loc.shape)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._tril, z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        d = self.loc.shape[-1]
+        diff = v - self.loc
+        L = jnp.broadcast_to(self._tril,
+                             diff.shape[:-1] + self._tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.square(sol).sum(-1)
+        logdet = jnp.log(jnp.abs(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1))).sum(-1)
+        return -0.5 * (d * math.log(2 * math.pi) + maha) - logdet
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.log(jnp.abs(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1))).sum(-1)
+        return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+
+
+# ------------------------------------------------------------- transforms
+
+class Transform:
+    """Base invertible map (reference: paddle.distribution.Transform)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (non-injective; inverse returns the positive branch, the
+    reference convention)."""
+
+    def forward(self, x):
+        return jnp.abs(x)
+
+    def inverse(self, y):
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(jnp.asarray(x, jnp.float32))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.asarray(x, jnp.float32)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power, jnp.float32)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2) = 2 (log2 - x - softplus(-2x))
+        x = jnp.asarray(x, jnp.float32)
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Sums the wrapped transform's log-det over trailing event dims."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        j = self.base.forward_log_det_jacobian(x)
+        axes = tuple(range(j.ndim - self.reinterpreted_batch_rank, j.ndim))
+        return j.sum(axis=axes) if axes else j
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(jnp.prod(jnp.asarray(self.in_event_shape))) != \
+                int(jnp.prod(jnp.asarray(self.out_event_shape))):
+            raise ValueError("in/out event shapes must have equal size")
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, jnp.float32)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) via exp-normalize; inverse is log (up to the
+    additive constant the reference also drops)."""
+
+    def forward(self, x):
+        return jax.nn.softmax(jnp.asarray(x, jnp.float32), axis=-1)
+
+    def inverse(self, y):
+        return jnp.log(jnp.asarray(y, jnp.float32))
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not bijective on R^n (reference raises "
+            "too); use StickBreakingTransform for densities")
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] to slices along ``axis`` (reference:
+    paddle.distribution.StackTransform)."""
+
+    def __init__(self, transforms, axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, method, x):
+        parts = jnp.split(jnp.asarray(x, jnp.float32),
+                          len(self.transforms), axis=self.axis)
+        outs = [getattr(t, method)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} -> interior of the n-simplex (n+1 coords), the reference's
+    stick-breaking construction."""
+
+    def forward(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=jnp.float32))
+        z = jax.nn.sigmoid(x - offset)
+        zp = jnp.concatenate([jnp.zeros_like(z[..., :1]), z], axis=-1)
+        cum = jnp.cumprod(1 - zp, axis=-1)
+        y_head = z * cum[..., :-1]
+        y_tail = cum[..., -1:]
+        return jnp.concatenate([y_head, y_tail], axis=-1)
+
+    def inverse(self, y):
+        y = jnp.asarray(y, jnp.float32)
+        n = y.shape[-1] - 1
+        cum = 1 - jnp.cumsum(y[..., :-1], axis=-1)
+        rest = jnp.concatenate([jnp.ones_like(y[..., :1]),
+                                cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=jnp.float32))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=jnp.float32))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        zp = jnp.concatenate([jnp.zeros_like(z[..., :1]), z[..., :-1]],
+                             axis=-1)
+        cum = jnp.cumprod(1 - zp, axis=-1)
+        # d y_i / d z_i = cumprod, d z_i / d x_i = sigmoid'(t)
+        return (jnp.log(cum) - jax.nn.softplus(-t)
+                - jax.nn.softplus(t)).sum(-1)

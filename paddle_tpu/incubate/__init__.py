@@ -88,3 +88,5 @@ def identity_loss(x, reduction="none"):
     if reduction in (1, "mean"):
         return jnp.mean(x)
     return x
+
+from . import optimizer  # noqa: E402,F401  (LookAhead / ModelAverage)

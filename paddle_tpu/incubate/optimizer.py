@@ -1,0 +1,151 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage wrappers.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py — LookAhead
+(Zhang et al. 2019: fast weights stepped by the inner optimizer, slow
+weights pulled toward them every k steps), and modelaverage.py —
+ModelAverage (running average of parameters applied for evaluation,
+restored after; SURVEY.md §2.2 "Optimizers" row).
+
+TPU-native: both are pure pytree update rules layered over the inner
+optimizer's ``init/update`` so the whole composite stays jittable; the
+slow/average state rides in the optimizer state dict (the reference
+stores it on the optimizer via _add_accumulator)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """Reference: paddle.incubate.optimizer.LookAhead(inner, alpha, k).
+
+    Every ``k`` inner steps: slow += alpha * (fast - slow); fast = slow.
+    """
+
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("LookAhead wraps a paddle_tpu Optimizer")
+        super().__init__(learning_rate=inner_optimizer.get_lr())
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def init(self, params) -> Dict[str, Any]:
+        return {
+            "inner": self.inner_optimizer.init(params),
+            "slow": jax.tree.map(jnp.asarray, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, state, params, lr=None):
+        new_params, new_inner = self.inner_optimizer.update(
+            grads, state["inner"], params, lr=lr)
+        step = state["step"] + 1
+        sync = (step % self.k) == 0
+
+        def pull(slow, fast):
+            new_slow = slow + self.alpha * (fast - slow)
+            merged_fast = jnp.where(sync, new_slow, fast)
+            merged_slow = jnp.where(sync, new_slow, slow)
+            return merged_fast, merged_slow
+
+        pulled = jax.tree.map(pull, state["slow"], new_params)
+        fast = jax.tree.map(lambda pr: pr[0], pulled,
+                            is_leaf=lambda x: isinstance(x, tuple))
+        slow = jax.tree.map(lambda pr: pr[1], pulled,
+                            is_leaf=lambda x: isinstance(x, tuple))
+        return fast, {"inner": new_inner, "slow": slow, "step": step}
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+
+class ModelAverage(Optimizer):
+    """Reference: paddle.incubate.optimizer.ModelAverage(average_window_rate,
+    parameters, min_average_window, max_average_window).
+
+    Maintains the running sum of parameter values per step;
+    ``apply(params, state)`` returns the averaged weights for evaluation,
+    ``restore`` is the identity on the held originals (functional recast
+    of the reference's in-place apply()/restore() pair)."""
+
+    def __init__(self, average_window_rate: float = 0.15, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None,
+                 inner_optimizer: Optional[Optimizer] = None):
+        super().__init__(learning_rate=0.0)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self.inner_optimizer = inner_optimizer
+
+    def init(self, params) -> Dict[str, Any]:
+        st = {
+            "sum": jax.tree.map(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32),
+            # sum of the decayed weights: apply() divides by this, so the
+            # window semantics are exact whatever the decay schedule
+            "wsum": jnp.zeros((), jnp.float32),
+        }
+        if self.inner_optimizer is not None:
+            st["inner"] = self.inner_optimizer.init(params)
+        return st
+
+    def _window(self, count):
+        """Effective window = clip(rate·count, min, max) — the reference's
+        average_window_rate / min / max semantics."""
+        w = self.rate * count.astype(jnp.float32)
+        return jnp.clip(w, float(max(self.min_window, 1)),
+                        float(self.max_window))
+
+    def update(self, grads, state, params, lr=None):
+        """With an inner optimizer: step it, then accumulate the NEW
+        params.  Without one (reference usage: ModelAverage runs beside
+        the main optimizer), call ``accumulate`` instead."""
+        if self.inner_optimizer is None:
+            raise ValueError(
+                "ModelAverage without inner_optimizer does not step; call "
+                "accumulate(params, state) after your optimizer update")
+        new_params, new_inner = self.inner_optimizer.update(
+            grads, state["inner"], params, lr=lr)
+        st = self.accumulate(new_params, {k: v for k, v in state.items()
+                                          if k != "inner"})
+        st["inner"] = new_inner
+        return new_params, st
+
+    def accumulate(self, params, state) -> Dict[str, Any]:
+        count = state["count"] + 1
+        # sliding window of width clip(rate·count, min, max): decay the
+        # running sum by (1 - 1/w) once the accumulated weight reaches the
+        # window (the reference restarts accumulator blocks; the
+        # exponential form is the jit-stable equivalent, documented).
+        # wsum tracks the decayed weight total so apply() is exact.
+        w = self._window(count)
+        decay = jnp.where(state["wsum"] >= w, 1.0 - 1.0 / w, 1.0)
+        new_sum = jax.tree.map(lambda s, p: s * decay + p, state["sum"],
+                               params)
+        out = dict(state)
+        out["sum"] = new_sum
+        out["count"] = count
+        out["wsum"] = state["wsum"] * decay + 1.0
+        return out
+
+    def apply(self, params, state):
+        """Averaged parameters for evaluation (reference: with
+        model_average.apply(): ...)."""
+        n = jnp.maximum(state["wsum"], 1.0)
+        return jax.tree.map(lambda s: (s / n).astype(s.dtype), state["sum"])
+
+    @staticmethod
+    def restore(params):
+        """Reference parity: restore() returns the un-averaged weights —
+        functional, so the originals were never overwritten."""
+        return params

@@ -283,7 +283,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
 
     src_s, _, ok_s, seg, n_rows, seg_valid, out_idx = _rulebook(
         idx, valid, (n, d, h, w), out_dims, k3, s3, p3, (1, 1, 1))
-    neg = jnp.finfo(vals.dtype).min
+    neg = (jnp.finfo(vals.dtype).min if jnp.issubdtype(vals.dtype, jnp.floating)
+           else jnp.iinfo(vals.dtype).min)
     contrib = jnp.where(ok_s[:, None], vals[src_s], neg)
     out_vals = jax.ops.segment_max(contrib, seg, num_segments=n_rows)
     out_vals = jnp.where(seg_valid[:, None], out_vals, 0)
@@ -409,12 +410,26 @@ class _ValsAct(Layer):
         raise NotImplementedError
 
     def forward(self, x):
-        idx, vals, shape = _coerce(x)
-        valid = _valid_rows(idx, shape[:4])
-        # padding rows stay exactly zero (softmax would otherwise paint
-        # them with 1/C)
-        y = jnp.where(valid[:, None], self._apply(vals), 0)
-        return jsparse.BCOO((y, idx), shape=shape)
+        if isinstance(x, jsparse.BCOO) and x.ndim == 5 and x.n_dense == 1:
+            # conv-stack path: padding rows stay exactly zero (softmax
+            # would otherwise paint them with 1/C)
+            idx, vals, shape = _coerce(x)
+            valid = _valid_rows(idx, shape[:4])
+            y = jnp.where(valid[:, None], self._apply(vals), 0)
+            return jsparse.BCOO((y, idx), shape=shape)
+        # generic sparse tensors (any rank, COO or CSR): elementwise on the
+        # stored values — the pre-conv-stack sparse.nn.ReLU behavior
+        if isinstance(x, (jsparse.BCOO, jsparse.BCSR)):
+            return _rebuild_with_values(x, self._apply(x.data))
+        raise TypeError(
+            f"sparse.nn activation expects a sparse tensor, got "
+            f"{type(x).__name__}")
+
+
+def _rebuild_with_values(x, new_vals):
+    if isinstance(x, jsparse.BCOO):
+        return jsparse.BCOO((new_vals, x.indices), shape=x.shape)
+    return jsparse.BCSR((new_vals, x.indices, x.indptr), shape=x.shape)
 
 
 class ReLU(_ValsAct):

@@ -198,6 +198,10 @@ Bernoulli Beta Categorical Dirichlet Distribution Exponential
 ExponentialFamily Gamma Geometric Gumbel Laplace LogNormal Multinomial
 Normal Poisson StudentT TransformedDistribution Uniform kl_divergence
 register_kl
+Binomial Cauchy Chi2 ContinuousBernoulli Independent MultivariateNormal
+Transform AbsTransform AffineTransform ChainTransform ExpTransform
+IndependentTransform PowerTransform ReshapeTransform SigmoidTransform
+SoftmaxTransform StackTransform StickBreakingTransform TanhTransform
 """
 
 PADDLE_SPARSE = """
@@ -213,7 +217,11 @@ FusedFeedForward FusedMultiHeadAttention FusedMultiTransformer functional
 
 PADDLE_INCUBATE = """
 segment_sum segment_mean segment_max segment_min softmax_mask_fuse
-softmax_mask_fuse_upper_triangle identity_loss nn
+softmax_mask_fuse_upper_triangle identity_loss nn optimizer
+"""
+
+PADDLE_INCUBATE_OPT = """
+LookAhead ModelAverage
 """
 
 PADDLE_CALLBACKS = """
@@ -349,6 +357,7 @@ REFERENCE = {
     "paddle.distribution": PADDLE_DISTRIBUTION,
     "paddle.sparse": PADDLE_SPARSE,
     "paddle.incubate": PADDLE_INCUBATE,
+    "paddle.incubate.optimizer": PADDLE_INCUBATE_OPT,
     "paddle.incubate.nn": PADDLE_INCUBATE_NN,
     "paddle.callbacks": PADDLE_CALLBACKS,
     "paddle.utils": PADDLE_UTILS,
@@ -394,6 +403,7 @@ TARGETS = {
     "paddle.distribution": "paddle_tpu.distribution",
     "paddle.sparse": "paddle_tpu.sparse",
     "paddle.incubate": "paddle_tpu.incubate",
+    "paddle.incubate.optimizer": "paddle_tpu.incubate.optimizer",
     "paddle.incubate.nn": "paddle_tpu.incubate.nn",
     "paddle.callbacks": "paddle_tpu.hapi.callbacks",
     "paddle.utils": "paddle_tpu.utils",

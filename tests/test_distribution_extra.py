@@ -1,0 +1,252 @@
+"""Oracle tests for the round-3 distribution/transform additions.
+
+Reference: python/paddle/distribution/ — binomial.py, cauchy.py, chi2.py,
+continuous_bernoulli.py, independent.py, multivariate_normal.py,
+transform.py.  Oracles: scipy.stats and torch.distributions (the same
+strategy as tests/test_distribution.py).
+"""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+import torch
+
+import paddle_tpu.distribution as D
+
+
+class TestBinomial:
+    def test_log_prob_vs_scipy(self):
+        d = D.Binomial(10, 0.3)
+        ks = np.arange(0, 11, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(ks)),
+                                   st.binom.logpmf(ks, 10, 0.3),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_moments(self):
+        d = D.Binomial(7, np.array([0.2, 0.8], np.float32))
+        np.testing.assert_allclose(np.asarray(d.mean), [1.4, 5.6], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(d.variance),
+                                   7 * np.array([0.2 * 0.8, 0.8 * 0.2]),
+                                   rtol=1e-5)
+
+    def test_entropy_vs_scipy(self):
+        d = D.Binomial(12, 0.35)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   st.binom.entropy(12, 0.35), rtol=1e-5)
+
+    def test_sample_mean(self):
+        import jax
+        d = D.Binomial(20, 0.4)
+        s = d.sample((4000,), key=jax.random.PRNGKey(0))
+        assert abs(float(s.mean()) - 8.0) < 0.25
+        assert float(s.max()) <= 20 and float(s.min()) >= 0
+
+
+class TestCauchy:
+    def test_log_prob_and_cdf_vs_scipy(self):
+        d = D.Cauchy(1.5, 2.0)
+        xs = np.linspace(-8, 8, 23).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(xs)),
+                                   st.cauchy.logpdf(xs, 1.5, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(d.cdf(xs)),
+                                   st.cauchy.cdf(xs, 1.5, 2.0), rtol=1e-5)
+
+    def test_entropy_vs_scipy(self):
+        np.testing.assert_allclose(float(D.Cauchy(0.0, 3.0).entropy()),
+                                   st.cauchy.entropy(0.0, 3.0), rtol=1e-6)
+
+    def test_sample_median(self):
+        import jax
+        s = D.Cauchy(2.0, 1.0).sample((8001,), key=jax.random.PRNGKey(1))
+        assert abs(float(np.median(np.asarray(s))) - 2.0) < 0.1
+
+
+class TestChi2:
+    def test_log_prob_vs_scipy(self):
+        d = D.Chi2(5.0)
+        xs = np.linspace(0.2, 12, 15).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(xs)),
+                                   st.chi2.logpdf(xs, 5), rtol=1e-4)
+
+    def test_mean_via_gamma(self):
+        d = D.Chi2(8.0)
+        np.testing.assert_allclose(float(d.mean), 8.0, rtol=1e-6)
+
+
+class TestContinuousBernoulli:
+    def test_log_prob_vs_torch(self):
+        for p in (0.2, 0.5, 0.77):
+            d = D.ContinuousBernoulli(p)
+            t = torch.distributions.ContinuousBernoulli(probs=torch.tensor(p))
+            xs = np.linspace(0.01, 0.99, 17).astype(np.float32)
+            np.testing.assert_allclose(
+                np.asarray(d.log_prob(xs)),
+                t.log_prob(torch.tensor(xs)).numpy(), rtol=2e-4, atol=2e-4)
+
+    def test_mean_vs_torch(self):
+        for p in (0.15, 0.5, 0.9):
+            d = D.ContinuousBernoulli(p)
+            t = torch.distributions.ContinuousBernoulli(probs=torch.tensor(p))
+            np.testing.assert_allclose(float(d.mean), float(t.mean),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_cdf_matches_sampling(self):
+        import jax
+        d = D.ContinuousBernoulli(0.3)
+        s = np.asarray(d.sample((6000,), key=jax.random.PRNGKey(2)))
+        for q in (0.25, 0.5, 0.75):
+            emp = (s <= q).mean()
+            np.testing.assert_allclose(emp, float(d.cdf(q)), atol=0.02)
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(ind.log_prob(x)),
+                                   np.asarray(base.log_prob(x)).sum(-1),
+                                   rtol=1e-6)
+
+    def test_vs_torch(self):
+        rng = np.random.default_rng(1)
+        loc = rng.normal(size=(2, 3)).astype(np.float32)
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        ours = D.Independent(D.Normal(loc, np.ones_like(loc)), 1)
+        theirs = torch.distributions.Independent(
+            torch.distributions.Normal(torch.tensor(loc), 1.0), 1)
+        np.testing.assert_allclose(np.asarray(ours.log_prob(x)),
+                                   theirs.log_prob(torch.tensor(x)).numpy(),
+                                   rtol=1e-5)
+
+
+class TestMultivariateNormal:
+    def _cov(self, rng, d=3):
+        a = rng.normal(size=(d, d))
+        return (a @ a.T + d * np.eye(d)).astype(np.float32)
+
+    def test_log_prob_vs_scipy(self):
+        rng = np.random.default_rng(2)
+        cov = self._cov(rng)
+        loc = rng.normal(size=3).astype(np.float32)
+        d = D.MultivariateNormal(loc, covariance_matrix=cov)
+        xs = rng.normal(size=(5, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(xs)),
+            st.multivariate_normal.logpdf(xs, loc, cov), rtol=2e-4)
+
+    def test_entropy_vs_scipy(self):
+        rng = np.random.default_rng(3)
+        cov = self._cov(rng)
+        d = D.MultivariateNormal(np.zeros(3, np.float32),
+                                 covariance_matrix=cov)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   st.multivariate_normal.entropy(None, cov),
+                                   rtol=1e-5)
+
+    def test_scale_tril_and_sampling(self):
+        import jax
+        rng = np.random.default_rng(4)
+        cov = self._cov(rng)
+        tril = np.linalg.cholesky(cov)
+        d = D.MultivariateNormal(np.zeros(3, np.float32), scale_tril=tril)
+        np.testing.assert_allclose(np.asarray(d.covariance_matrix), cov,
+                                   rtol=1e-5)
+        s = np.asarray(d.sample((20000,), key=jax.random.PRNGKey(3)))
+        np.testing.assert_allclose(np.cov(s.T), cov, rtol=0.15, atol=0.3)
+
+
+class TestTransforms:
+    def _roundtrip(self, t, xs, torch_t=None):
+        ys = np.asarray(t.forward(xs))
+        back = np.asarray(t.inverse(ys))
+        np.testing.assert_allclose(back, xs, rtol=1e-4, atol=1e-5)
+        if torch_t is not None:
+            np.testing.assert_allclose(
+                ys, torch_t(torch.tensor(xs)).numpy(), rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(t.forward_log_det_jacobian(xs)),
+                torch_t.log_abs_det_jacobian(
+                    torch.tensor(xs), torch_t(torch.tensor(xs))).numpy(),
+                rtol=1e-4, atol=1e-5)
+
+    def test_exp_power_sigmoid_tanh_vs_torch(self):
+        xs = np.linspace(-2, 2, 9).astype(np.float32)
+        self._roundtrip(D.ExpTransform(), xs,
+                        torch.distributions.transforms.ExpTransform())
+        self._roundtrip(D.SigmoidTransform(), xs,
+                        torch.distributions.transforms.SigmoidTransform())
+        self._roundtrip(D.TanhTransform(), xs * 0.9,
+                        torch.distributions.transforms.TanhTransform())
+        pos = np.linspace(0.3, 3, 9).astype(np.float32)
+        self._roundtrip(D.PowerTransform(2.0), pos,
+                        torch.distributions.transforms.PowerTransform(
+                            torch.tensor(2.0)))
+
+    def test_chain(self):
+        xs = np.linspace(-1, 1, 7).astype(np.float32)
+        chain = D.ChainTransform([D.ExpTransform(),
+                                  D.PowerTransform(2.0)])
+        np.testing.assert_allclose(np.asarray(chain.forward(xs)),
+                                   np.exp(xs) ** 2, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(chain.inverse(
+            chain.forward(xs))), xs, rtol=1e-4, atol=1e-5)
+        tc = torch.distributions.transforms.ComposeTransform(
+            [torch.distributions.transforms.ExpTransform(),
+             torch.distributions.transforms.PowerTransform(torch.tensor(2.0))])
+        np.testing.assert_allclose(
+            np.asarray(chain.forward_log_det_jacobian(xs)),
+            tc.log_abs_det_jacobian(torch.tensor(xs),
+                                    tc(torch.tensor(xs))).numpy(),
+            rtol=1e-4)
+
+    def test_stick_breaking_vs_torch(self):
+        rng = np.random.default_rng(5)
+        xs = rng.normal(size=(4, 3)).astype(np.float32)
+        t = D.StickBreakingTransform()
+        tt = torch.distributions.transforms.StickBreakingTransform()
+        ys = np.asarray(t.forward(xs))
+        np.testing.assert_allclose(ys, tt(torch.tensor(xs)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ys.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(t.inverse(ys)), xs,
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(t.forward_log_det_jacobian(xs)),
+            tt.log_abs_det_jacobian(torch.tensor(xs),
+                                    tt(torch.tensor(xs))).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_independent_and_reshape_and_stack(self):
+        rng = np.random.default_rng(6)
+        xs = rng.normal(size=(2, 6)).astype(np.float32)
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        np.testing.assert_allclose(np.asarray(it.forward_log_det_jacobian(xs)),
+                                   xs.sum(-1), rtol=1e-5)
+        rt = D.ReshapeTransform((6,), (2, 3))
+        assert rt.forward(xs).shape == (2, 2, 3)
+        np.testing.assert_allclose(np.asarray(rt.inverse(rt.forward(xs))), xs)
+        with pytest.raises(ValueError):
+            D.ReshapeTransform((6,), (4, 2))
+        stk = D.StackTransform([D.ExpTransform(), D.AbsTransform()], axis=0)
+        ys = np.asarray(stk.forward(xs))
+        np.testing.assert_allclose(ys[0], np.exp(xs[0]), rtol=1e-5)
+        np.testing.assert_allclose(ys[1], np.abs(xs[1]), rtol=1e-5)
+
+    def test_softmax_transform(self):
+        xs = np.random.default_rng(7).normal(size=(3, 4)).astype(np.float32)
+        t = D.SoftmaxTransform()
+        ys = np.asarray(t.forward(xs))
+        np.testing.assert_allclose(ys.sum(-1), 1.0, rtol=1e-5)
+        with pytest.raises(NotImplementedError):
+            t.forward_log_det_jacobian(xs)
+
+    def test_transformed_distribution_with_new_transforms(self):
+        """log N(x;0,1) through exp = lognormal density (reference
+        TransformedDistribution composition check)."""
+        base = D.Normal(0.0, 1.0)
+        logn = D.TransformedDistribution(base, [D.ExpTransform()])
+        xs = np.linspace(0.2, 4, 9).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(logn.log_prob(xs)),
+                                   st.lognorm.logpdf(xs, 1.0), rtol=1e-4)

@@ -199,6 +199,37 @@ class TestBatchNormAndActs:
             np.testing.assert_allclose(np.asarray(y.data),
                                        fn(np.asarray(x.data)), rtol=1e-6)
 
+    def test_activations_accept_generic_sparse_tensors(self):
+        """sparse.nn.ReLU keeps working on any-rank COO/CSR tensors (the
+        pre-conv-stack behavior; review finding: it had narrowed to 5-D)."""
+        import paddle_tpu.sparse as sp
+        dense = jnp.asarray([[-1.0, 0.0, 2.0], [3.0, -4.0, 0.0]])
+        coo = sp.to_sparse_coo(dense)
+        y = snn.ReLU()(coo)
+        np.testing.assert_allclose(np.asarray(y.todense()),
+                                   np.maximum(np.asarray(dense), 0))
+        csr = sp.sparse_csr_tensor([0, 2, 3], [0, 2, 1],
+                                   [-1.0, 2.0, -3.0], (2, 3))
+        z = snn.LeakyReLU(0.1)(csr)
+        np.testing.assert_allclose(
+            np.asarray(z.todense()),
+            np.where(np.asarray(csr.todense()) >= 0,
+                     np.asarray(csr.todense()),
+                     0.1 * np.asarray(csr.todense())), rtol=1e-6)
+        with pytest.raises(TypeError, match="sparse tensor"):
+            snn.ReLU()(jnp.ones((2, 3)))
+
+    def test_max_pool_integer_values(self):
+        """Integer-valued volumes pool without the finfo crash (review
+        finding)."""
+        dense = np.zeros((1, 4, 4, 4, 1), np.int32)
+        dense[0, 0, 0, 0, 0] = 7
+        dense[0, 1, 1, 1, 0] = 3
+        x = jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+        y = snn.functional.max_pool3d(x, 2, stride=2)
+        out = np.asarray(y.todense())
+        assert out[0, 0, 0, 0, 0] == 7
+
     def test_softmax_channels(self):
         rng = np.random.default_rng(12)
         _, x = _random_sparse(rng, nnz=10)
