@@ -32,12 +32,13 @@ __all__ = ["Engine", "to_static", "DistModel"]
 
 
 def _remat_policy(name: str):
-    pol = {
-        "full": None,
-        "dots_saveable": jax.checkpoint_policies.dots_saveable,
-        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
-    }
-    return pol.get(name)
+    # "full" = plain jax.checkpoint (policy None); anything else resolves
+    # through the shared registry (unknown names raise there — a silent
+    # fallback would invalidate memory/perf comparisons)
+    if name == "full":
+        return None
+    from ..recompute import resolve_remat_policy
+    return resolve_remat_policy(name)
 
 
 class Engine:

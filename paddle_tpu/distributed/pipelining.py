@@ -116,7 +116,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     M = jax.tree_util.tree_leaves(x_microbatches)[0].shape[0]
     S = n_stages
     T = M + S - 1
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    from .recompute import remat_wrap
+    body = remat_wrap(stage_fn, remat)
 
     if x_spec is not None:
         x_microbatches = _apply_x_spec(mesh, x_microbatches, x_spec)
@@ -240,7 +241,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
         raise ValueError(f"interleaved schedule needs microbatches ({M}) "
                          f"divisible by pp degree ({S})")
     T = M * V + S - 1
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    from .recompute import remat_wrap
+    body = remat_wrap(stage_fn, remat)
     if x_spec is not None:
         x_microbatches = _apply_x_spec(mesh, x_microbatches, x_spec)
     if param_inner_specs is not None:

@@ -18,7 +18,36 @@ from typing import Callable, Sequence
 
 import jax
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "remat_wrap",
+           "resolve_remat_policy"]
+
+_POLICY_NAMES = ("dots_saveable", "nothing_saveable",
+                 "dots_with_no_batch_dims_saveable",
+                 "everything_saveable", "checkpoint_dots",
+                 "checkpoint_dots_with_no_batch_dims")
+
+
+def resolve_remat_policy(name: str):
+    """jax.checkpoint_policies entry for ``name`` — the ONE resolver for
+    every remat knob (model configs, Engine strategy, bench).  Unknown
+    names raise with the known list (silent fallback to full checkpoint
+    would invalidate memory/perf comparisons)."""
+    pol = getattr(jax.checkpoint_policies, name, None)
+    if pol is None or name.startswith("_"):
+        raise ValueError(
+            f"unknown remat policy {name!r}; known: {', '.join(_POLICY_NAMES)}"
+            " (or True for full checkpoint, False for none)")
+    return pol
+
+
+def remat_wrap(fn: Callable, remat) -> Callable:
+    """Apply the remat knob: False -> fn; True -> full jax.checkpoint;
+    a string names a jax.checkpoint_policies policy."""
+    if not remat:
+        return fn
+    if isinstance(remat, str):
+        return jax.checkpoint(fn, policy=resolve_remat_policy(remat))
+    return jax.checkpoint(fn)
 
 
 def recompute(function: Callable, *args, **kwargs):

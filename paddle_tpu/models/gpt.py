@@ -59,7 +59,9 @@ class GPTConfig:
     use_bias: bool = True
     # parallel/runtime knobs
     sp: bool = False          # sequence-parallel activations between blocks
-    remat: bool = True        # jax.checkpoint per block
+    # jax.checkpoint per block: False | True (full) | a
+    # jax.checkpoint_policies name (e.g. "dots_saveable")
+    remat: "bool | str" = True
     # context parallelism over the sep mesh axis: None | "ring" | "ulysses"
     # (reference: sep_degree in hybrid_configs; ring attn from PaddleNLP)
     cp: "str | None" = None
@@ -174,11 +176,14 @@ class GPTModel(Layer):
         return self.drop(x)
 
     def forward(self, input_ids, caches=None):
+        from ..distributed.recompute import remat_wrap
         x = self.embed(input_ids)
         new_caches = []
         for i, block in enumerate(self.h):
             if caches is None:
-                x = block(x)
+                # cfg.remat applies per block in the training forward
+                # (decode/cached path never rematerializes)
+                x = remat_wrap(block, self.cfg.remat)(x)
             else:
                 x, c = block(x, caches[i])
                 new_caches.append(c)

@@ -44,7 +44,9 @@ class GPTMoEConfig:
     gate: str = "gshard"          # naive | gshard | switch
     gate_kwargs: Optional[dict] = None   # extra gate args (e.g.
     # random_routing=False for deterministic gshard)
-    remat: bool = False
+    # False | True (full jax.checkpoint) | a
+    # jax.checkpoint_policies name (shared remat_wrap knob)
+    remat: "bool | str" = False
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
     dropout: float = 0.0

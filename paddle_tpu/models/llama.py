@@ -48,7 +48,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     dropout: float = 0.0
     dtype: str = "float32"
-    remat: bool = False
+    # False | True (full jax.checkpoint) | a
+    # jax.checkpoint_policies name (shared remat_wrap knob)
+    remat: "bool | str" = False
 
     @property
     def kv_heads(self) -> int:
@@ -184,11 +186,9 @@ class LlamaModel(Layer):
         new_caches = []
         for i, layer in enumerate(self.layers):
             if caches is None:
-                if cfg.remat:
-                    x = jax.checkpoint(
-                        lambda x_, lyr=layer: lyr(x_, cos, sin))(x)
-                else:
-                    x = layer(x, cos, sin)
+                from ..distributed.recompute import remat_wrap
+                x = remat_wrap(lambda x_, lyr=layer: lyr(x_, cos, sin),
+                               cfg.remat)(x)
             else:
                 x, c = layer(x, cos, sin, caches[i])
                 new_caches.append(c)

@@ -36,6 +36,9 @@ from .gpt import GPTConfig, GPTForCausalLM
 __all__ = ["GPTHybridTrainer", "GPTMoEHybridTrainer"]
 
 
+from ..distributed.recompute import remat_wrap as _remat_wrap  # noqa: E402
+
+
 class GPTHybridTrainer:
     # state-layout key map — subclasses (GPTMoEHybridTrainer) remap these
     # to their model's parameter names
@@ -255,8 +258,7 @@ class GPTHybridTrainer:
 
     def _serial_forward(self, pblk, x):
         """S == 1 path: scan all blocks; -> (hidden, extra loss term)."""
-        body = jax.checkpoint(self._block_apply) if self.cfg.remat else \
-            self._block_apply
+        body = _remat_wrap(self._block_apply, self.cfg.remat)
 
         def one(carry, bp):
             return body(bp, carry), None
@@ -423,8 +425,7 @@ class GPTMoEHybridTrainer(GPTHybridTrainer):
     def _serial_forward(self, pblk, x):
         # per-block remat inside the scan — same granularity as the base
         # class (one recompute chunk per block, not one for all L blocks)
-        blk = jax.checkpoint(self._block_apply) if self.cfg.remat else \
-            self._block_apply
+        blk = _remat_wrap(self._block_apply, self.cfg.remat)
 
         def one(c, bp):
             out, aux_inc = blk(bp, c["h"])

@@ -210,19 +210,21 @@ def test_launch_heartbeat_detects_hang(tmp_path):
     assert "HANG_RUNNER_OK" in logs
 
 
-def test_elastic_remesh_restart_8_to_4(tmp_path):
-    """Scale-in elastic restart (round-2 VERDICT item 8): run starts on an
-    8-device mesh, 'loses half the slice' (crashes after writing the new
-    device count to the elastic devices file), the watchdog relaunches,
-    the worker rebuilds a 4-device mesh and resumes from the distributed
-    checkpoint via reshard-on-load — final weights equal the uninterrupted
-    serial trajectory (dp math is degree-invariant for a fixed batch)."""
+@pytest.mark.parametrize("start_n,end_n", [(8, 4), (4, 8)])
+def test_elastic_remesh_restart(tmp_path, start_n, end_n):
+    """Elastic re-mesh restart, both directions (round-2 VERDICT item 8 +
+    scale-OUT): the run starts on a start_n-device mesh, the device count
+    changes (crash after writing the elastic devices file), the watchdog
+    relaunches, the worker rebuilds an end_n-device mesh and resumes from
+    the distributed checkpoint via reshard-on-load — final weights equal
+    the uninterrupted serial trajectory (dp math is degree-invariant for
+    a fixed global batch)."""
     devfile = tmp_path / "devices.txt"
-    devfile.write_text("8")
+    devfile.write_text(str(start_n))
     script = """
         import os, sys
         import numpy as np
-        n = int(os.environ.get("PADDLE_ELASTIC_DEVICE_COUNT", "8"))
+        n = int(os.environ.get("PADDLE_ELASTIC_DEVICE_COUNT", "%START%"))
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         import jax
@@ -255,8 +257,8 @@ def test_elastic_remesh_restart_8_to_4(tmp_path):
             got = load_state_dict(
                 {"w": jax.ShapeDtypeStruct((8, 1), jnp.float32),
                  "step": jax.ShapeDtypeStruct((), jnp.int32)}, ckpt)
-            # reshard-on-load: shards written by the 8-dev mesh land on
-            # the 4-dev mesh
+            # reshard-on-load: shards written by the pre-resize mesh land
+            # on the new device count (either direction)
             w = shard_tensor(np.asarray(got["w"]), mesh, [Replicate()])
             start = int(np.asarray(got["step"]))
 
@@ -278,7 +280,7 @@ def test_elastic_remesh_restart_8_to_4(tmp_path):
                                  "step": jnp.asarray(s + 1, jnp.int32)},
                                 ckpt)
                 with open(os.environ["ELASTIC_DEVFILE"], "w") as f:
-                    f.write("4")     # half the slice 'dies'
+                    f.write("%END%")   # the slice is resized
                 os._exit(1)
 
         # oracle: uninterrupted serial trajectory
@@ -292,6 +294,8 @@ def test_elastic_remesh_restart_8_to_4(tmp_path):
             f.write(f"OK ndev={n} restart={restart}")
     """
     import textwrap
+    script = script.replace("%START%", str(start_n)).replace(
+        "%END%", str(end_n))
     sp = tmp_path / "worker.py"
     sp.write_text(textwrap.dedent(script))
     env = {k: v for k, v in os.environ.items()
@@ -312,4 +316,4 @@ def test_elastic_remesh_restart_8_to_4(tmp_path):
                                if (tmp_path / "log" / "workerlog.0").exists()
                                else "")
     out = (tmp_path / "elastic_result.txt").read_text()
-    assert out == "OK ndev=4 restart=1", out
+    assert out == f"OK ndev={end_n} restart=1", out
