@@ -41,9 +41,9 @@ PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 PROBE_BUDGET_S = int(os.environ.get("BENCH_PROBE_BUDGET", "450"))
 
 
-def _remat_env():
-    v = os.environ.get("BENCH_REMAT", "0")
-    return True if v == "1" else (False if v == "0" else v)
+def _bench_remat():
+    from paddle_tpu.distributed.recompute import remat_from_env
+    return remat_from_env()
 
 
 def _probe_tpu():
@@ -146,7 +146,7 @@ def _run_bench(on_tpu, tpu_diag=None):
             # recorded evidence was measured in this configuration (the
             # model only began honoring cfg.remat in round 3 — see
             # ROUND3_NOTES "remat provenance correction")
-            remat=_remat_env())
+            remat=_bench_remat())
         batch = int(os.environ.get("BENCH_BATCH", 4))
         seq = cfg.max_seq_len
         iters, warmup = 20, 3

@@ -10,3 +10,7 @@ from .sharding import (ShardingOptimizer, DygraphShardingOptimizer,  # noqa: F40
                        GroupShardedStage2, GroupShardedStage3,
                        group_sharded_parallel, build_sharded_specs)
 from . import sequence_parallel  # noqa: F401
+# reference import path: fleet.meta_parallel.parallel_layers.random —
+# RNGStatesTracker lives in framework.random here (one RNG system)
+from ...framework.random import (RNGStatesTracker,  # noqa: F401
+                                 get_rng_state_tracker)

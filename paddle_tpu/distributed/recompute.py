@@ -19,7 +19,7 @@ from typing import Callable, Sequence
 import jax
 
 __all__ = ["recompute", "recompute_sequential", "remat_wrap",
-           "resolve_remat_policy"]
+           "resolve_remat_policy", "remat_from_env"]
 
 _POLICY_NAMES = ("dots_saveable", "nothing_saveable",
                  "dots_with_no_batch_dims_saveable",
@@ -32,12 +32,23 @@ def resolve_remat_policy(name: str):
     every remat knob (model configs, Engine strategy, bench).  Unknown
     names raise with the known list (silent fallback to full checkpoint
     would invalidate memory/perf comparisons)."""
-    pol = getattr(jax.checkpoint_policies, name, None)
-    if pol is None or name.startswith("_"):
+    # allowlist, not getattr: jax.checkpoint_policies also exposes
+    # argument-taking FACTORIES (save_only_these_names, ...) which are not
+    # policies themselves — passing one to jax.checkpoint silently saves
+    # everything, exactly the misconfiguration this resolver must prevent
+    if name not in _POLICY_NAMES:
         raise ValueError(
             f"unknown remat policy {name!r}; known: {', '.join(_POLICY_NAMES)}"
             " (or True for full checkpoint, False for none)")
-    return pol
+    return getattr(jax.checkpoint_policies, name)
+
+
+def remat_from_env(var: str = "BENCH_REMAT", default: str = "0"):
+    """Shared env parsing for the bench entry points: '0' -> False,
+    '1' -> True (full checkpoint), anything else -> policy name."""
+    import os
+    v = os.environ.get(var, default)
+    return True if v == "1" else (False if v == "0" else v)
 
 
 def remat_wrap(fn: Callable, remat) -> Callable:

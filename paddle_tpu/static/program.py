@@ -62,16 +62,14 @@ class StaticGraphError(RuntimeError):
     pass
 
 
-import itertools as _itertools
-
-_UNIQ = _itertools.count()
-
-
 def unique_name(prefix: str) -> str:
-    """Process-global unique name (reference: paddle.utils.unique_name) —
-    parameters live in the global scope, so names must not collide across
-    programs."""
-    return f"{prefix}_{next(_UNIQ)}"
+    """Unique name via paddle.utils.unique_name (parameters live in the
+    global scope, so names must not collide across programs).  Delegating
+    to the utils generator means ``paddle.utils.unique_name.guard()``
+    isolates static-graph param names exactly like the reference's test
+    pattern."""
+    from ..utils import unique_name as _un
+    return _un.generate(prefix)
 
 
 # --------------------------------------------------------------------------
@@ -729,6 +727,11 @@ class Scope:
 
     def __init__(self):
         self._store: Dict[str, jax.Array] = {}
+        # which declaration initialized each name: re-running the SAME
+        # startup program is an idempotent no-op, but a DIFFERENT program
+        # declaring the same name (unique_name.guard() reuse) must
+        # re-initialize instead of silently aliasing the old weights
+        self._init_src: Dict[str, int] = {}
 
     def find_var(self, name):
         return _VarFacade(self, name) if name in self._store else None
@@ -766,7 +769,8 @@ class Executor:
         from ..framework.random import next_rng_key
         scope = scope or global_scope()
         for pos, (name, decl) in enumerate(program.params.items()):
-            if scope.find_var(name) is None or scope._store.get(name) is None:
+            if (scope._store.get(name) is None
+                    or scope._init_src.get(name) != id(decl)):
                 seed = program.random_seed
                 if seed is None and decl.owner_main is not None:
                     # users set random_seed on the MAIN program (reference
@@ -780,6 +784,7 @@ class Executor:
                 else:
                     key = next_rng_key()
                 scope._store[name] = decl.init_fn(key)
+                scope._init_src[name] = id(decl)
         return []
 
     # -- main -------------------------------------------------------------
@@ -967,8 +972,11 @@ def load(program: Program, path_prefix: str, executor=None):
     import os
     params = _load(path_prefix + ".pdparams")
     scope = global_scope()
-    for n in program.params:
+    for n, decl in program.params.items():
         if n in params:
             scope._store[n] = jnp.asarray(params[n])
+            # mark as initialized by this program's decl so a later
+            # exe.run(startup) is a no-op instead of clobbering the load
+            scope._init_src[n] = id(decl)
     if os.path.exists(path_prefix + ".pdopt"):
         program._opt_state = _load(path_prefix + ".pdopt")

@@ -292,6 +292,12 @@ DistributedStrategy barrier_worker distributed_model distributed_optimizer
 init is_first_worker worker_index worker_num
 """
 
+PADDLE_FLEET_META_PARALLEL = """
+ColumnParallelLinear RowParallelLinear VocabParallelEmbedding
+ParallelCrossEntropy TensorParallel PipelineLayer LayerDesc
+SharedLayerDesc PipelineParallel RNGStatesTracker get_rng_state_tracker
+"""
+
 PADDLE_FLEET_UTILS = """
 HDFSClient LocalFS recompute recompute_sequential
 """
@@ -372,6 +378,7 @@ REFERENCE = {
     "paddle.hub": PADDLE_HUB,
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
+    "paddle.distributed.fleet.meta_parallel": PADDLE_FLEET_META_PARALLEL,
     "paddle.distributed.fleet.utils": PADDLE_FLEET_UTILS,
     "paddle.sparse.nn": PADDLE_SPARSE_NN,
     "paddle.sparse.nn.functional": PADDLE_SPARSE_NN_F,
@@ -418,6 +425,7 @@ TARGETS = {
     "paddle.hub": "paddle_tpu.hub",
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
+    "paddle.distributed.fleet.meta_parallel": "paddle_tpu.distributed.meta_parallel",
     "paddle.distributed.fleet.utils": "paddle_tpu.distributed.fleet_utils",
     "paddle.sparse.nn": "paddle_tpu.sparse.nn",
     "paddle.sparse.nn.functional": "paddle_tpu.sparse.nn.functional",

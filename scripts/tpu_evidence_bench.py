@@ -185,15 +185,8 @@ def _run_secondary():
 
 
 def _remat_env():
-    """BENCH_REMAT: '0' (default — the b4 config fits HBM without remat
-    and this matches how the recorded evidence was measured), '1' (full
-    checkpoint), or a jax.checkpoint_policies name ('dots_saveable')."""
-    v = os.environ.get("BENCH_REMAT", "0")
-    if v == "1":
-        return True
-    if v == "0":
-        return False
-    return v
+    from paddle_tpu.distributed.recompute import remat_from_env
+    return remat_from_env()
 
 
 def main():

@@ -72,10 +72,13 @@ def test_remat_actually_applied_and_policy_parity():
     np.testing.assert_allclose(l_on, l_off, rtol=1e-6)
     np.testing.assert_allclose(l_pol, l_off, rtol=1e-6)
 
-    # unknown policy names fail loudly with the known list
+    # unknown policy names fail loudly with the known list — including
+    # jax.checkpoint_policies FACTORY attrs, which are not policies and
+    # would silently save everything (review finding)
     from paddle_tpu.distributed.recompute import remat_wrap
-    with pytest.raises(ValueError, match="known:"):
-        remat_wrap(lambda x: x, "definitely_not_a_policy")(jnp.ones(()))
+    for bad in ("definitely_not_a_policy", "save_only_these_names"):
+        with pytest.raises(ValueError, match="known:"):
+            remat_wrap(lambda x: x, bad)(jnp.ones(()))
 
 
 def test_pipeline_loss_matches_serial():

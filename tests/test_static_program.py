@@ -499,6 +499,85 @@ class TestReviewRegressions:
             y = paddle.concat([x, x], axis=0)
         assert y.shape == (None, 4)
 
+    def test_unique_name_guard_isolates_param_names(self):
+        """paddle.utils.unique_name.guard() gives fresh name counters —
+        the reference's pattern for reproducible static param names."""
+        import paddle_tpu.utils as U
+        names = []
+        for _ in range(2):
+            with U.unique_name.guard():
+                main, startup = _fresh_pair()
+                with static.program_guard(main, startup):
+                    static.nn.fc(static.data("x", [None, 3]), 2)
+                names.append(sorted(main.params))
+        assert names[0] == names[1]
+        # both program instances declared under the SAME names — the
+        # guard scopes the collision the global counter otherwise avoids
+        assert any(n.endswith(".w_0") for n in names[0])
+
+    def test_guard_name_collision_reinitializes_not_aliases(self):
+        """Two programs built under separate unique_name.guard()s share
+        names; the second startup must RE-initialize, not silently train
+        on the first program's weights (review finding)."""
+        import paddle_tpu.utils as U
+
+        def build():
+            with U.unique_name.guard():
+                main, startup = _fresh_pair()
+                with static.program_guard(main, startup):
+                    x = static.data("x", [None, 3])
+                    static.nn.fc(x, 2, weight_attr=None)
+                return main, startup
+
+        exe = static.Executor()
+        m1, s1 = build()
+        exe.run(s1)
+        scope = static.global_scope()
+        wname = [n for n in m1.params if n.endswith(".w_0")][0]
+        # simulate training on program 1
+        scope._store[wname] = np.full((3, 2), 7.0, np.float32)
+
+        m2, s2 = build()
+        assert sorted(m2.params) == sorted(m1.params)  # names collide
+        exe.run(s2)
+        w2 = np.asarray(scope.find_var(wname).get_tensor())
+        assert not np.allclose(w2, 7.0)  # fresh init, not program 1's
+
+    def test_startup_rerun_is_idempotent_for_same_program(self):
+        """Re-running the SAME startup must not clobber trained weights."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        # a train step writes the store (Executor.run does exactly this)
+        # without touching _init_src — provenance stays with the decl
+        scope._store[wname] = np.full((3, 2), 5.0, np.float32)
+        exe.run(startup)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(wname).get_tensor()), 5.0)
+
+    def test_load_then_startup_keeps_loaded_weights(self, tmp_path):
+        main, startup = _fresh_pair()
+        main.random_seed = 31
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        scope._store[wname] = np.full((3, 2), 9.0, np.float32)
+        static.save(main, str(tmp_path / "m"))
+        scope._store[wname] = np.zeros((3, 2), np.float32)
+        static.load(main, str(tmp_path / "m"))
+        exe.run(startup)   # must NOT clobber the load
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(wname).get_tensor()), 9.0)
+
     def test_disable_static_rearms_fast_path(self):
         """data() outside a guard arms the recording scan; disable_static
         must dis-arm it (review finding: it stayed armed forever)."""
