@@ -711,6 +711,10 @@ def _install_static_dispatch():
 # Scope + Executor
 # --------------------------------------------------------------------------
 
+# sentinel for user-injected scope values (see _VarFacade.set)
+_USER_SET = object()
+
+
 class _VarFacade:
     def __init__(self, scope, name):
         self._scope, self._name = scope, name
@@ -720,6 +724,9 @@ class _VarFacade:
 
     def set(self, value, place=None):
         self._scope._store[self._name] = jnp.asarray(value)
+        # user-injected values survive a later startup run (pretrained
+        # weight injection); any declaration accepts them as initialized
+        self._scope._init_src[self._name] = _USER_SET
 
 
 class Scope:
@@ -727,11 +734,14 @@ class Scope:
 
     def __init__(self):
         self._store: Dict[str, jax.Array] = {}
-        # which declaration initialized each name: re-running the SAME
-        # startup program is an idempotent no-op, but a DIFFERENT program
-        # declaring the same name (unique_name.guard() reuse) must
-        # re-initialize instead of silently aliasing the old weights
-        self._init_src: Dict[str, int] = {}
+        # which declaration initialized each name (the DECL OBJECT, not
+        # its id — a freed decl's id can be reused by CPython, which
+        # would resurrect the aliasing bug): re-running the SAME startup
+        # program is an idempotent no-op; a DIFFERENT program declaring
+        # the same name (unique_name.guard() reuse) re-initializes;
+        # user-injected values (_VarFacade.set) carry _USER_SET and are
+        # accepted by any declaration
+        self._init_src: Dict[str, Any] = {}
 
     def find_var(self, name):
         return _VarFacade(self, name) if name in self._store else None
@@ -769,8 +779,9 @@ class Executor:
         from ..framework.random import next_rng_key
         scope = scope or global_scope()
         for pos, (name, decl) in enumerate(program.params.items()):
+            src = scope._init_src.get(name)
             if (scope._store.get(name) is None
-                    or scope._init_src.get(name) != id(decl)):
+                    or (src is not decl and src is not _USER_SET)):
                 seed = program.random_seed
                 if seed is None and decl.owner_main is not None:
                     # users set random_seed on the MAIN program (reference
@@ -784,7 +795,7 @@ class Executor:
                 else:
                     key = next_rng_key()
                 scope._store[name] = decl.init_fn(key)
-                scope._init_src[name] = id(decl)
+                scope._init_src[name] = decl
         return []
 
     # -- main -------------------------------------------------------------
@@ -977,6 +988,6 @@ def load(program: Program, path_prefix: str, executor=None):
             scope._store[n] = jnp.asarray(params[n])
             # mark as initialized by this program's decl so a later
             # exe.run(startup) is a no-op instead of clobbering the load
-            scope._init_src[n] = id(decl)
+            scope._init_src[n] = decl
     if os.path.exists(path_prefix + ".pdopt"):
         program._opt_state = _load(path_prefix + ".pdopt")

@@ -292,6 +292,16 @@ DistributedStrategy barrier_worker distributed_model distributed_optimizer
 init is_first_worker worker_index worker_num
 """
 
+PADDLE_NN_UTILS = """
+clip_grad_norm_ clip_grad_value_ parameters_to_vector
+vector_to_parameters weight_norm remove_weight_norm spectral_norm
+"""
+
+PADDLE_DEVICE = """
+get_device set_device device_count synchronize cuda empty_cache
+max_memory_allocated max_memory_reserved memory_allocated memory_reserved
+"""
+
 PADDLE_FLEET_META_PARALLEL = """
 ColumnParallelLinear RowParallelLinear VocabParallelEmbedding
 ParallelCrossEntropy TensorParallel PipelineLayer LayerDesc
@@ -378,6 +388,8 @@ REFERENCE = {
     "paddle.hub": PADDLE_HUB,
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
+    "paddle.nn.utils": PADDLE_NN_UTILS,
+    "paddle.device": PADDLE_DEVICE,
     "paddle.distributed.fleet.meta_parallel": PADDLE_FLEET_META_PARALLEL,
     "paddle.distributed.fleet.utils": PADDLE_FLEET_UTILS,
     "paddle.sparse.nn": PADDLE_SPARSE_NN,
@@ -425,6 +437,8 @@ TARGETS = {
     "paddle.hub": "paddle_tpu.hub",
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
+    "paddle.nn.utils": "paddle_tpu.nn.utils",
+    "paddle.device": "paddle_tpu.device",
     "paddle.distributed.fleet.meta_parallel": "paddle_tpu.distributed.meta_parallel",
     "paddle.distributed.fleet.utils": "paddle_tpu.distributed.fleet_utils",
     "paddle.sparse.nn": "paddle_tpu.sparse.nn",

@@ -61,6 +61,11 @@ def test_example_train_static():
     assert "STATIC_EXAMPLE_OK" in out
 
 
+def test_example_train_sparse_pointcloud():
+    out = _run("train_sparse_pointcloud.py", "--steps", "120")
+    assert "SPARSE_POINTCLOUD_OK" in out
+
+
 def test_example_infer_export():
     out = _run("infer_export.py")
     low = out.lower()

@@ -543,6 +543,21 @@ class TestReviewRegressions:
         w2 = np.asarray(scope.find_var(wname).get_tensor())
         assert not np.allclose(w2, 7.0)  # fresh init, not program 1's
 
+    def test_user_set_scope_value_survives_startup(self):
+        """scope.var(name).set(pretrained) before the first startup run
+        must survive it (review finding: the provenance check clobbered
+        user-injected weights)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3])
+            static.nn.fc(x, 2)
+        scope = static.global_scope()
+        wname = [n for n in main.params if n.endswith(".w_0")][0]
+        scope.var(wname).set(np.full((3, 2), 4.5, np.float32))
+        static.Executor().run(startup)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(wname).get_tensor()), 4.5)
+
     def test_startup_rerun_is_idempotent_for_same_program(self):
         """Re-running the SAME startup must not clobber trained weights."""
         main, startup = _fresh_pair()
