@@ -5,12 +5,13 @@ Reference: python/paddle/audio/features/layers.py + functional/ (window +
 mel filterbank math on the framework's fft ops).
 
 TPU-native: framing is a gather, the STFT is jnp.fft over frames, mel
-banks are one [n_mels, n_bins] matmul — everything jits.  Dataset
-downloads (paddle.audio.datasets) are out of scope in this zero-egress
-environment; the feature layers are the API surface models consume.
+banks are one [n_mels, n_bins] matmul — everything jits.  Datasets
+(paddle.audio.datasets TESS/ESC50) parse the extracted reference archive
+layouts from explicit LOCAL paths (zero-egress stance; see datasets.py).
 """
 
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
+from . import datasets  # noqa: F401
 
-__all__ = ["features", "functional"]
+__all__ = ["features", "functional", "datasets"]

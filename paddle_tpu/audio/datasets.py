@@ -1,0 +1,159 @@
+"""paddle.audio.datasets — TESS and ESC50 over local files.
+
+Reference: python/paddle/audio/datasets/ — tess.py (emotion folders of
+OAF_word_emotion.wav files, seeded split), esc50.py (audio/*.wav +
+meta/esc50.csv, fold-based split); both yield (feature|waveform, label)
+with feature_type 'raw' | 'mfcc' | 'spectrogram' | 'melspectrogram' |
+'logmelspectrogram' computed by paddle.audio.features (SURVEY.md §2.2).
+Zero-egress stance: explicit local paths to the extracted archive layout,
+guidance error when absent (the vision/text datasets pattern).  WAV
+reading is stdlib `wave` (PCM16/PCM8), which the reference archives use.
+"""
+
+from __future__ import annotations
+
+import os
+import wave
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50", "load_wav"]
+
+
+def _need(path, name, what):
+    if path is None or not os.path.exists(path):
+        raise RuntimeError(
+            f"paddle_tpu.audio.{name}: no network access in this "
+            f"environment — provide {what} (extracted archive layout)")
+
+
+def load_wav(path: str) -> Tuple[np.ndarray, int]:
+    """(waveform float32 [-1, 1] mono, sample_rate) from a PCM wav."""
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        x = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 1:
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    if ch > 1:
+        x = x.reshape(-1, ch).mean(1)
+    return x, sr
+
+
+class _AudioBase(Dataset):
+    _FEATS = ("raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+              "mfcc")
+
+    def __init__(self, feature_type: str, archive_dir: str, **feat_kw):
+        if feature_type not in self._FEATS:
+            raise ValueError(
+                f"feature_type must be one of {self._FEATS}")
+        self.feature_type = feature_type
+        self._feat_kw = feat_kw
+        self._extractors = {}
+        self._files: List[str] = []
+        self._labels: List[int] = []
+
+    def _extract(self, waveform: np.ndarray, sr: int):
+        if self.feature_type == "raw":
+            return waveform
+        import jax.numpy as jnp
+        x = jnp.asarray(waveform)[None, :]
+        return np.asarray(self._extractor(sr)(x)[0])
+
+    def _extractor(self, sr: int):
+        """One feature layer per sample rate (the fbank/DCT matrices and
+        the layer's jit identity are reused across __getitem__ calls)."""
+        layer = self._extractors.get(sr)
+        if layer is None:
+            from . import features as AF
+            cls = {"spectrogram": AF.Spectrogram,
+                   "melspectrogram": AF.MelSpectrogram,
+                   "logmelspectrogram": AF.LogMelSpectrogram,
+                   "mfcc": AF.MFCC}[self.feature_type]
+            kw = dict(self._feat_kw)
+            if self.feature_type != "spectrogram":
+                kw.setdefault("sr", sr)
+            layer = cls(**kw)
+            self._extractors[sr] = layer
+        return layer
+
+    def __len__(self):
+        return len(self._files)
+
+    def __getitem__(self, idx):
+        wav, sr = load_wav(self._files[idx])
+        return self._extract(wav, sr), np.int64(self._labels[idx])
+
+
+class TESS(_AudioBase):
+    """Reference: tess.py — TESS emotional speech: files named
+    <speaker>_<word>_<emotion>.wav; label = emotion index over the sorted
+    emotion set; seeded shuffle then n_folds split (mode train = all but
+    the held-out fold, dev = the fold)."""
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feature_type: str = "raw", archive_dir: Optional[str] = None,
+                 seed: int = 0, **feat_kw):
+        super().__init__(feature_type, archive_dir, **feat_kw)
+        _need(archive_dir, "TESS", "archive_dir (folder of emotion wavs)")
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        files = []
+        for root, _dirs, names in os.walk(archive_dir):
+            for nm in sorted(names):
+                if nm.lower().endswith(".wav"):
+                    files.append(os.path.join(root, nm))
+        files.sort()
+        emotions = sorted({os.path.splitext(os.path.basename(f))[0]
+                           .rsplit("_", 1)[-1].lower() for f in files})
+        self.emotions = emotions
+        lab = {e: i for i, e in enumerate(emotions)}
+        rng = np.random.RandomState(seed)
+        order = rng.permutation(len(files))
+        fold = np.arange(len(files)) % n_folds + 1  # over the shuffled order
+        keep = (fold != split) if mode == "train" else (fold == split)
+        for pos, take in zip(order, keep):
+            if take:
+                f = files[pos]
+                self._files.append(f)
+                self._labels.append(
+                    lab[os.path.splitext(os.path.basename(f))[0]
+                        .rsplit("_", 1)[-1].lower()])
+
+
+class ESC50(_AudioBase):
+    """Reference: esc50.py — audio/*.wav + meta/esc50.csv
+    (filename,fold,target,...); mode train = folds != split, dev = fold
+    == split."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feature_type: str = "raw", archive_dir: Optional[str] = None,
+                 **feat_kw):
+        super().__init__(feature_type, archive_dir, **feat_kw)
+        _need(archive_dir, "ESC50", "archive_dir (audio/ + meta/esc50.csv)")
+        meta = os.path.join(archive_dir, "meta", "esc50.csv")
+        _need(meta, "ESC50", "meta/esc50.csv")
+        with open(meta) as f:
+            header = f.readline().strip().split(",")
+            fn_i = header.index("filename")
+            fold_i = header.index("fold")
+            tgt_i = header.index("target")
+            for ln in f:
+                cells = ln.strip().split(",")
+                if not cells or len(cells) <= max(fn_i, fold_i, tgt_i):
+                    continue
+                fold = int(cells[fold_i])
+                if (mode == "train") == (fold != split):
+                    self._files.append(
+                        os.path.join(archive_dir, "audio", cells[fn_i]))
+                    self._labels.append(int(cells[tgt_i]))

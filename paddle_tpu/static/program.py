@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -301,7 +302,7 @@ class _Node:
 
 class _ParamDecl:
     __slots__ = ("name", "shape", "dtype", "init_fn", "stop_gradient",
-                 "owner_main")
+                 "owner_main", "__weakref__")
 
     def __init__(self, name, shape, dtype, init_fn, stop_gradient=False,
                  owner_main=None):
@@ -734,13 +735,14 @@ class Scope:
 
     def __init__(self):
         self._store: Dict[str, jax.Array] = {}
-        # which declaration initialized each name (the DECL OBJECT, not
-        # its id — a freed decl's id can be reused by CPython, which
-        # would resurrect the aliasing bug): re-running the SAME startup
-        # program is an idempotent no-op; a DIFFERENT program declaring
-        # the same name (unique_name.guard() reuse) re-initializes;
-        # user-injected values (_VarFacade.set) carry _USER_SET and are
-        # accepted by any declaration
+        # which declaration initialized each name, held by WEAKREF (a
+        # freed decl's id can be reused by CPython — bare ids would
+        # resurrect the aliasing bug — while a strong ref would pin every
+        # Program ever built via decl.owner_main): re-running the SAME
+        # startup program is an idempotent no-op; a DIFFERENT program
+        # declaring the same name (unique_name.guard() reuse) or a dead
+        # ref re-initializes; user-injected values (_VarFacade.set) carry
+        # _USER_SET and are accepted by any declaration
         self._init_src: Dict[str, Any] = {}
 
     def find_var(self, name):
@@ -780,8 +782,9 @@ class Executor:
         scope = scope or global_scope()
         for pos, (name, decl) in enumerate(program.params.items()):
             src = scope._init_src.get(name)
+            src_obj = src() if isinstance(src, weakref.ref) else src
             if (scope._store.get(name) is None
-                    or (src is not decl and src is not _USER_SET)):
+                    or (src_obj is not decl and src_obj is not _USER_SET)):
                 seed = program.random_seed
                 if seed is None and decl.owner_main is not None:
                     # users set random_seed on the MAIN program (reference
@@ -795,7 +798,7 @@ class Executor:
                 else:
                     key = next_rng_key()
                 scope._store[name] = decl.init_fn(key)
-                scope._init_src[name] = decl
+                scope._init_src[name] = weakref.ref(decl)
         return []
 
     # -- main -------------------------------------------------------------
@@ -988,6 +991,6 @@ def load(program: Program, path_prefix: str, executor=None):
             scope._store[n] = jnp.asarray(params[n])
             # mark as initialized by this program's decl so a later
             # exe.run(startup) is a no-op instead of clobbering the load
-            scope._init_src[n] = decl
+            scope._init_src[n] = weakref.ref(decl)
     if os.path.exists(path_prefix + ".pdopt"):
         program._opt_state = _load(path_prefix + ".pdopt")

@@ -292,6 +292,14 @@ DistributedStrategy barrier_worker distributed_model distributed_optimizer
 init is_first_worker worker_index worker_num
 """
 
+PADDLE_TEXT_DATASETS = """
+Conll05st Imdb Imikolov Movielens UCIHousing WMT14 WMT16
+"""
+
+PADDLE_AUDIO_DATASETS = """
+TESS ESC50
+"""
+
 PADDLE_NN_UTILS = """
 clip_grad_norm_ clip_grad_value_ parameters_to_vector
 vector_to_parameters weight_norm remove_weight_norm spectral_norm
@@ -388,6 +396,8 @@ REFERENCE = {
     "paddle.hub": PADDLE_HUB,
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
+    "paddle.text.datasets": PADDLE_TEXT_DATASETS,
+    "paddle.audio.datasets": PADDLE_AUDIO_DATASETS,
     "paddle.nn.utils": PADDLE_NN_UTILS,
     "paddle.device": PADDLE_DEVICE,
     "paddle.distributed.fleet.meta_parallel": PADDLE_FLEET_META_PARALLEL,
@@ -437,6 +447,8 @@ TARGETS = {
     "paddle.hub": "paddle_tpu.hub",
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
+    "paddle.text.datasets": "paddle_tpu.text.datasets",
+    "paddle.audio.datasets": "paddle_tpu.audio.datasets",
     "paddle.nn.utils": "paddle_tpu.nn.utils",
     "paddle.device": "paddle_tpu.device",
     "paddle.distributed.fleet.meta_parallel": "paddle_tpu.distributed.meta_parallel",

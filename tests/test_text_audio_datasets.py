@@ -1,0 +1,300 @@
+"""Text + audio dataset parser tests over synthesized archives.
+
+Reference: python/paddle/text/datasets/*, python/paddle/audio/datasets/*.
+Test model: the vision.datasets synthesized-archive oracles — build tiny
+archives in the EXACT reference formats, assert parsing, splits, vocab
+and label semantics.
+"""
+
+import io
+import os
+import tarfile
+import wave
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                             UCIHousing, WMT14, WMT16)
+from paddle_tpu.audio.datasets import ESC50, TESS, load_wav
+
+
+# --------------------------------------------------------------- helpers
+
+def _tar_with(tmp_path, name, members):
+    path = tmp_path / name
+    with tarfile.open(path, "w:gz") as tf:
+        for mname, text in members.items():
+            data = text.encode()
+            info = tarfile.TarInfo(mname)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+def _write_wav(path, samples, sr=16000):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes((np.clip(samples, -1, 1) * 32767)
+                      .astype(np.int16).tobytes())
+
+
+# ------------------------------------------------------------------ text
+
+class TestUCIHousing:
+    def test_parse_normalize_split(self, tmp_path):
+        rng = np.random.default_rng(0)
+        table = rng.normal(size=(10, 14)).astype(np.float32)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, table)
+        tr = UCIHousing(data_file=str(f), mode="train")
+        te = UCIHousing(data_file=str(f), mode="test")
+        assert len(tr) == 8 and len(te) == 2
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # normalization: (x - avg) / (max - min) over the whole table
+        feats = table[:, :-1]
+        want = (feats[0] - feats.mean(0)) / (feats.max(0) - feats.min(0))
+        np.testing.assert_allclose(x, want, rtol=1e-5)
+        np.testing.assert_allclose(y, table[0, -1:], rtol=1e-6)
+
+    def test_guidance_error(self):
+        with pytest.raises(RuntimeError, match="local file"):
+            UCIHousing()
+
+
+class TestImdb:
+    def test_labels_shared_vocab_and_modes(self, tmp_path):
+        f = _tar_with(tmp_path, "aclImdb.tar.gz", {
+            "aclImdb/train/pos/0.txt": "great great movie",
+            "aclImdb/train/neg/0.txt": "bad movie",
+            "aclImdb/test/pos/0.txt": "great fun",
+        })
+        tr = Imdb(data_file=f, mode="train", cutoff=0)
+        assert len(tr) == 2
+        labels = sorted(int(tr[i][1]) for i in range(2))
+        assert labels == [0, 1]  # pos=0, neg=1
+        # frequency-sorted: 'great'(3 incl. test split) first
+        assert tr.word_idx["great"] < tr.word_idx["bad"]
+        te = Imdb(data_file=f, mode="test", cutoff=0)
+        assert len(te) == 1 and int(te[0][1]) == 0
+        # ONE vocab across splits (reference build_dict): ids align
+        assert te.word_idx == tr.word_idx
+        # './'-prefixed tar members parse too
+        f2 = _tar_with(tmp_path, "b.tar.gz", {
+            "./aclImdb/train/pos/0.txt": "nice movie",
+        })
+        assert len(Imdb(data_file=f2, mode="train", cutoff=0)) == 1
+
+    def test_cutoff_is_frequency_threshold(self, tmp_path):
+        f = _tar_with(tmp_path, "a.tar.gz", {
+            "aclImdb/train/pos/0.txt": "alpha alpha alpha beta beta gamma",
+        })
+        # keep words with freq > 1 (reference semantics), not top-1
+        ds = Imdb(data_file=f, mode="train", cutoff=1)
+        assert set(ds.word_idx) == {"alpha", "beta", "<unk>"}
+        unk = ds.word_idx["<unk>"]
+        assert (ds[0][0] == unk).sum() == 1  # gamma -> <unk>
+
+    def test_bad_mode_rejected(self, tmp_path):
+        f = _tar_with(tmp_path, "a.tar.gz", {
+            "aclImdb/train/pos/0.txt": "x",
+        })
+        with pytest.raises(ValueError, match="mode"):
+            Imdb(data_file=f, mode="dev")
+
+
+class TestImikolov:
+    def _tar(self, tmp_path):
+        return _tar_with(tmp_path, "ptb.tar.gz", {
+            "simple-examples/data/ptb.train.txt": "a b c\na b",
+            "simple-examples/data/ptb.valid.txt": "c b a",
+        })
+
+    def test_ngram_windows(self, tmp_path):
+        ds = Imikolov(data_file=self._tar(tmp_path), data_type="NGRAM",
+                      window_size=3, mode="train")
+        # line1: <s> a b c <e> -> 3 windows; line2: <s> a b <e> -> 2
+        assert len(ds) == 5
+        s, e = ds.word_idx["<s>"], ds.word_idx["<e>"]
+        assert ds[0][0] == s and ds[2][-1] == e
+
+    def test_seq_mode_and_valid_split(self, tmp_path):
+        tar = self._tar(tmp_path)
+        ds = Imikolov(data_file=tar, data_type="SEQ", mode="valid")
+        assert len(ds) == 1
+        ids = ds[0]
+        assert ids[0] == ds.word_idx["<s>"] and ids[-1] == ds.word_idx["<e>"]
+        assert len(ids) == 5
+        # vocab comes from the TRAIN split in both modes -> ids align
+        tr = Imikolov(data_file=tar, data_type="SEQ", mode="train")
+        assert tr.word_idx == ds.word_idx
+
+
+class TestMovielens:
+    def test_zip_parse(self, tmp_path):
+        z = tmp_path / "ml-1m.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("ml-1m/users.dat", "1::M::25::4::00000\n"
+                                           "2::F::35::7::11111\n")
+            zf.writestr("ml-1m/movies.dat",
+                        "10::Toy Story (1995)::Animation|Comedy\n"
+                        "20::Heat (1995)::Action\n")
+            zf.writestr("ml-1m/ratings.dat",
+                        "1::10::5::978300760\n2::20::3::978302109\n")
+        ds = Movielens(data_file=str(z), mode="train", test_ratio=0.0)
+        assert len(ds) == 2
+        uid, gender, age, job, mid, cats, title, rating = ds[0]
+        assert int(uid) == 1 and int(gender) == 0 and int(mid) == 10
+        assert cats.sum() == 2  # Animation + Comedy multi-hot
+        assert float(rating) == 5.0
+        assert len(ds.categories_dict) == 3
+
+    def test_dir_layout_too(self, tmp_path):
+        d = tmp_path / "ml"
+        d.mkdir()
+        (d / "users.dat").write_text("1::M::25::4::0\n")
+        (d / "movies.dat").write_text("5::Alien (1979)::Horror\n")
+        (d / "ratings.dat").write_text("1::5::4::1\n")
+        ds = Movielens(data_file=str(d), test_ratio=0.0)
+        assert len(ds) == 1
+
+
+class TestWMT:
+    def test_wmt14_pairs_and_dicts(self, tmp_path):
+        f = _tar_with(tmp_path, "wmt14.tar.gz", {
+            "train/part-00": "le chat\tthe cat\nle chien\tthe dog",
+            "test/part-00": "le chat\tthe cat",
+        })
+        ds = WMT14(data_file=f, mode="train", dict_size=30)
+        assert len(ds) == 2
+        src, trg_in, trg_out = ds[0]
+        assert trg_in[0] == ds.trg_ids["<s>"]
+        assert trg_out[-1] == ds.trg_ids["<e>"]
+        assert len(trg_in) == len(trg_out)
+        # reserved ids first
+        assert ds.src_ids["<s>"] == 0 and ds.src_ids["<unk>"] == 2
+        rev = ds.get_dict("src", reverse=True)
+        assert rev[ds.src_ids["le"]] == "le"
+        # bare boolean positional = the reference's reverse flag (src)
+        assert ds.get_dict(False) is ds.src_ids
+        assert ds.get_dict(True)[ds.src_ids["le"]] == "le"
+
+    def test_wmt16_lang_sides(self, tmp_path):
+        f = _tar_with(tmp_path, "wmt16.tar.gz", {
+            "wmt16/train.en": "the cat\nthe dog",
+            "wmt16/train.de": "die katze\nder hund",
+            "wmt16/val.en": "a cat",
+            "wmt16/val.de": "eine katze",
+        })
+        en = WMT16(data_file=f, mode="train", lang="en")
+        assert "the" in en.src_ids and "die" in en.trg_ids
+        de = WMT16(data_file=f, mode="val", lang="de")
+        assert "eine" in de.src_ids and "a" in de.trg_ids
+        assert len(de) == 1
+
+
+class TestConll05st:
+    def test_spans_to_bio_and_samples(self, tmp_path):
+        words = tmp_path / "words.txt"
+        props = tmp_path / "props.txt"
+        words.write_text("The\ncat\nsat\n\nDogs\nbark\n")
+        # sentence 1: predicate 'sat' with A0 span over 'The cat'
+        props.write_text(
+            "-\t(A0*\n-\t*)\nsat\t(V*)\n\n-\t(A0*)\nbark\t(V*)\n")
+        ds = Conll05st(words_file=str(words), props_file=str(props))
+        assert len(ds) == 2
+        w_ids, pred, labels = ds[0]
+        assert len(w_ids) == 3 and len(labels) == 3
+        rev = {i: t for t, i in ds.label_dict.items()}
+        assert [rev[int(l)] for l in labels] == ["B-A0", "I-A0", "B-V"]
+        assert int(pred) == ds.word_dict["sat"]
+        w2, p2, l2 = ds[1]
+        rev2 = [rev[int(l)] for l in l2]
+        assert rev2 == ["B-A0", "B-V"]
+
+
+# ----------------------------------------------------------------- audio
+
+class TestLoadWav:
+    def test_pcm16_roundtrip(self, tmp_path):
+        t = np.linspace(0, 1, 1600, endpoint=False)
+        sig = 0.5 * np.sin(2 * np.pi * 440 * t)
+        p = tmp_path / "a.wav"
+        _write_wav(p, sig)
+        x, sr = load_wav(str(p))
+        assert sr == 16000 and x.shape == (1600,)
+        np.testing.assert_allclose(x, sig, atol=1e-3)
+
+
+class TestTESS:
+    def _make(self, tmp_path):
+        d = tmp_path / "TESS"
+        emotions = ["angry", "happy", "sad"]
+        for e in emotions:
+            sub = d / f"OAF_{e}"
+            sub.mkdir(parents=True)
+            for w in ("back", "bar", "base", "bean"):
+                _write_wav(sub / f"OAF_{w}_{e}.wav",
+                           np.random.default_rng(0).normal(size=800) * 0.1)
+        return str(d)
+
+    def test_split_and_labels(self, tmp_path):
+        d = self._make(tmp_path)
+        tr = TESS(mode="train", n_folds=4, split=1, archive_dir=d)
+        dv = TESS(mode="dev", n_folds=4, split=1, archive_dir=d)
+        assert len(tr) + len(dv) == 12
+        assert len(dv) == 3
+        assert tr.emotions == ["angry", "happy", "sad"]
+        wav, lab = tr[0]
+        assert wav.ndim == 1 and 0 <= int(lab) < 3
+
+    def test_mfcc_feature(self, tmp_path):
+        d = self._make(tmp_path)
+        ds = TESS(mode="dev", n_folds=4, split=2, archive_dir=d,
+                  feature_type="mfcc", n_mfcc=13, n_fft=256)
+        feat, lab = ds[0]
+        assert feat.shape[0] == 13
+
+    def test_bad_split_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="split"):
+            TESS(split=9, n_folds=5, archive_dir=self._make(tmp_path))
+
+    def test_guidance(self):
+        with pytest.raises(RuntimeError, match="archive"):
+            TESS()
+
+
+class TestESC50:
+    def test_fold_split(self, tmp_path):
+        d = tmp_path / "esc"
+        (d / "audio").mkdir(parents=True)
+        (d / "meta").mkdir()
+        rows = ["filename,fold,target,category"]
+        for i in range(6):
+            fn = f"clip{i}.wav"
+            _write_wav(d / "audio" / fn,
+                       np.random.default_rng(i).normal(size=400) * 0.1)
+            rows.append(f"{fn},{i % 3 + 1},{i % 2},cls")
+        (d / "meta" / "esc50.csv").write_text("\n".join(rows) + "\n")
+        tr = ESC50(mode="train", split=1, archive_dir=str(d))
+        dv = ESC50(mode="dev", split=1, archive_dir=str(d))
+        assert len(tr) == 4 and len(dv) == 2
+        wav, lab = dv[0]
+        assert wav.shape == (400,) and int(lab) in (0, 1)
+
+    def test_spectrogram_feature(self, tmp_path):
+        d = tmp_path / "esc"
+        (d / "audio").mkdir(parents=True)
+        (d / "meta").mkdir()
+        _write_wav(d / "audio" / "c.wav",
+                   np.random.default_rng(0).normal(size=1024) * 0.1)
+        (d / "meta" / "esc50.csv").write_text(
+            "filename,fold,target\nc.wav,1,3\n")
+        ds = ESC50(mode="dev", split=1, archive_dir=str(d),
+                   feature_type="spectrogram", n_fft=256)
+        feat, lab = ds[0]
+        assert feat.shape[0] == 129 and int(lab) == 3  # n_fft//2+1 bins
