@@ -140,6 +140,8 @@ class ESC50(_AudioBase):
                  feature_type: str = "raw", archive_dir: Optional[str] = None,
                  **feat_kw):
         super().__init__(feature_type, archive_dir, **feat_kw)
+        if not 1 <= split <= 5:
+            raise ValueError(f"split must be in [1, 5], got {split}")
         _need(archive_dir, "ESC50", "archive_dir (audio/ + meta/esc50.csv)")
         meta = os.path.join(archive_dir, "meta", "esc50.csv")
         _need(meta, "ESC50", "meta/esc50.csv")

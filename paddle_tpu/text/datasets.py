@@ -122,19 +122,20 @@ class Imikolov(Dataset):
         _need(data_file, "Imikolov", "data_file (simple-examples tar.gz)")
         split = "train" if mode == "train" else "valid"
 
-        def read(which):
-            name = f"simple-examples/data/ptb.{which}.txt"
-            with tarfile.open(data_file) as tf:
-                member = next((m for m in tf.getmembers()
-                               if m.name.lstrip("./") == name), None)
+        with tarfile.open(data_file) as tf:
+            members = {m.name.lstrip("./"): m for m in tf.getmembers()}
+
+            def read(which):
+                name = f"simple-examples/data/ptb.{which}.txt"
+                member = members.get(name)
                 if member is None:
                     raise RuntimeError(f"{name} not in archive")
                 return tf.extractfile(member).read().decode().splitlines()
 
-        # the vocab ALWAYS comes from the train split (reference:
-        # build_dict reads ptb.train.txt) so train/valid ids align
-        train_lines = read("train")
-        lines = train_lines if split == "train" else read(split)
+            # the vocab ALWAYS comes from the train split (reference:
+            # build_dict reads ptb.train.txt) so train/valid ids align
+            train_lines = read("train")
+            lines = train_lines if split == "train" else read(split)
         counter: Counter = Counter()
         for ln in train_lines:
             counter.update(ln.split())
@@ -184,7 +185,12 @@ class Movielens(Dataset):
                 with open(os.path.join(data_file, name), "rb") as f:
                     return f.read().decode("latin1")
             with zipfile.ZipFile(data_file) as z:
-                inner = next(n for n in z.namelist() if n.endswith(name))
+                inner = next((n for n in z.namelist() if n.endswith(name)),
+                             None)
+                if inner is None:
+                    raise RuntimeError(
+                        f"paddle_tpu.text.Movielens: {name} not found in "
+                        f"{data_file} (expected the ml-1m layout)")
                 return z.read(inner).decode("latin1")
 
         users = {}
@@ -237,6 +243,7 @@ class _WMTBase(Dataset):
 
     def __init__(self, data_file, mode, src_dict_size, trg_dict_size, lang):
         _need(data_file, self._NAME, "data_file (parallel-corpus tar.gz)")
+        self._src_lang = lang          # None for WMT14 (unlabeled sides)
         pairs = self._read_pairs(data_file, mode, lang)
         src_c: Counter = Counter()
         trg_c: Counter = Counter()
@@ -277,10 +284,17 @@ class _WMTBase(Dataset):
     def get_dict(self, lang="src", reverse=False):
         """Reference surface: src/trg dicts (optionally id->word).  A
         bare boolean positional is the reference's reverse flag for the
-        SOURCE dict (wmt14.get_dict(reverse))."""
+        SOURCE dict (wmt14.get_dict(reverse)).  Language names resolve
+        against the dataset's OWN source side (WMT16(lang='de') makes
+        'de' the source dict)."""
         if isinstance(lang, bool):
             lang, reverse = "src", lang
-        d = self.src_ids if lang in ("en", "source", "src") else self.trg_ids
+        if self._src_lang is not None and lang not in ("src", "source",
+                                                       "trg", "target"):
+            src = lang == self._src_lang
+        else:
+            src = lang in ("en", "source", "src")
+        d = self.src_ids if src else self.trg_ids
         if reverse:
             return {i: w for w, i in d.items()}
         return d
@@ -294,6 +308,9 @@ class WMT14(_WMTBase):
 
     def __init__(self, data_file=None, mode="train", dict_size=30000,
                  download=True):
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(
+                f"mode must be train/test/gen, got {mode!r}")
         super().__init__(data_file, mode, dict_size, dict_size, None)
 
     def _read_pairs(self, data_file, mode, lang):
@@ -334,6 +351,10 @@ class WMT16(_WMTBase):
                 return tf.extractfile(member).read().decode(
                     "utf-8", "ignore").splitlines()
             src_lines, trg_lines = read(lang), read(other)
+        if len(src_lines) != len(trg_lines):
+            raise RuntimeError(
+                f"parallel corpus misaligned: {len(src_lines)} {lang} lines"
+                f" vs {len(trg_lines)} {other} lines")
         return [(s.split(), t.split())
                 for s, t in zip(src_lines, trg_lines)]
 
@@ -364,8 +385,12 @@ class Conll05st(Dataset):
                 tags = self._spans_to_bio([c[p] for c in cols])
                 for t in tags:
                     self.label_dict.setdefault(t, len(self.label_dict))
-                pred_idx = next(i for i, c in enumerate(cols)
-                                if c[p].startswith("(V"))
+                pred_idx = next((i for i, c in enumerate(cols)
+                                 if c[p].startswith("(V")), None)
+                if pred_idx is None:
+                    raise ValueError(
+                        f"props column {p} has no (V* predicate span "
+                        f"(sentence starting {words[0]!r})")
                 samples.append((
                     np.asarray([self.word_dict[w.lower()] for w in words],
                                np.int64),

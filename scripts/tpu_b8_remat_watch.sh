@@ -19,13 +19,13 @@ x = jnp.ones((256, 256), dtype=jnp.bfloat16)
 print("PROBE_OK", jax.devices()[0].platform, float((x @ x)[0, 0]))
 PYEOF
 for i in $(seq 1 40); do
-  if timeout 150 python $PROBE >> $LOG 2>&1; then
+  if timeout -k 10 150 python $PROBE >> $LOG 2>&1; then
     echo "$(date -u +%H:%M:%S) chip alive; trying b8 + remat experiments" >> $LOG
     for conf in "1 8" "dots_saveable 8" "1 6"; do
       set -- $conf
       echo "$(date -u +%H:%M:%S) BENCH_REMAT=$1 BENCH_BATCH=$2" >> $LOG
       if BENCH_REMAT=$1 BENCH_BATCH=$2 BENCH_KERNELS=0 BENCH_SECONDARY=0 \
-          EVIDENCE_BUDGET_S=1100 timeout 1500 \
+          EVIDENCE_BUDGET_S=1100 timeout -k 15 1500 \
           python scripts/tpu_evidence_bench.py >> $LOG 2>&1; then
         echo "$(date -u +%H:%M:%S) run ok (promotion decides)" >> $LOG
       else

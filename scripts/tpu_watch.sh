@@ -80,11 +80,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # one-shot experiment while the chip is up: a larger-batch full run
     # can only RAISE the canonical MFU (promotion keeps the max); marker
     # file stops repeats across watchdog restarts
-    if [ ! -f /tmp/tpu_b8_tried ] && timeout 150 python $PROBE >> $LOG 2>&1; then
+    if [ ! -f /tmp/tpu_b8_tried ] && timeout -k 10 150 python $PROBE >> $LOG 2>&1; then
       touch /tmp/tpu_b8_tried
       echo "$(date -u +%H:%M:%S) complete; trying BENCH_BATCH=8 experiment" >> $LOG
       if BENCH_BATCH=8 BENCH_KERNELS=0 BENCH_SECONDARY=0 EVIDENCE_BUDGET_S=1200 \
-          timeout 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1; then
+          timeout -k 15 2400 python scripts/tpu_evidence_bench.py >> $LOG 2>&1; then
         commit_evidence "On-chip bench evidence: larger-batch experiment (promotion keeps the max MFU)" \
           || { COMMIT_OK=0; echo "$(date -u +%H:%M:%S) b8 experiment commit failed 6x" >> $LOG; }
       else
@@ -101,7 +101,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   ATTEMPT=$((ATTEMPT+1))
   echo "$(date -u +%H:%M:%S) probe attempt $ATTEMPT (state=$ST)" >> $LOG
-  if timeout 150 python $PROBE >> $LOG 2>&1; then
+  if timeout -k 10 150 python $PROBE >> $LOG 2>&1; then
     if [ "$ST" = "bench_only" ] || [ "$ST" = "full" ]; then
       # bench numbers exist: top-up only the missing sections (honest
       # kernel table and/or on-chip secondary configs) without re-burning

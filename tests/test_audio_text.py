@@ -86,7 +86,8 @@ def test_viterbi_layer_and_dataset_guidance():
     pot = jnp.asarray(np.random.RandomState(3).randn(1, 4, 5), jnp.float32)
     scores, paths = dec(pot)
     assert paths.shape == (1, 4)
-    with pytest.raises(RuntimeError, match="zero-egress"):
+    # datasets now parse local files; absence raises guidance naming them
+    with pytest.raises(RuntimeError, match="local file"):
         Imdb()
 
 

@@ -183,6 +183,33 @@ class TestWMT:
         assert ds.get_dict(False) is ds.src_ids
         assert ds.get_dict(True)[ds.src_ids["le"]] == "le"
 
+    def test_wmt16_get_dict_respects_source_lang(self, tmp_path):
+        """get_dict('de') on a lang='de' dataset must return the GERMAN
+        dict (review finding: language selection was inverted)."""
+        f = _tar_with(tmp_path, "w16.tar.gz", {
+            "wmt16/train.en": "the cat",
+            "wmt16/train.de": "die katze",
+        })
+        de = WMT16(data_file=f, mode="train", lang="de")
+        assert "die" in de.get_dict("de")      # German words
+        assert "the" in de.get_dict("en")      # English words
+        assert de.get_dict("de") is de.src_ids
+
+    def test_wmt14_bad_mode_rejected(self, tmp_path):
+        f = _tar_with(tmp_path, "w14.tar.gz", {
+            "train/p": "a	b",
+        })
+        with pytest.raises(ValueError, match="train/test/gen"):
+            WMT14(data_file=f, mode="valid")
+
+    def test_wmt16_misaligned_corpus_rejected(self, tmp_path):
+        f = _tar_with(tmp_path, "w16b.tar.gz", {
+            "wmt16/train.en": "a\nb",
+            "wmt16/train.de": "x",
+        })
+        with pytest.raises(RuntimeError, match="misaligned"):
+            WMT16(data_file=f, mode="train")
+
     def test_wmt16_lang_sides(self, tmp_path):
         f = _tar_with(tmp_path, "wmt16.tar.gz", {
             "wmt16/train.en": "the cat\nthe dog",
@@ -285,6 +312,10 @@ class TestESC50:
         assert len(tr) == 4 and len(dv) == 2
         wav, lab = dv[0]
         assert wav.shape == (400,) and int(lab) in (0, 1)
+
+    def test_bad_split_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="split"):
+            ESC50(split=99, archive_dir="/nonexistent")
 
     def test_spectrogram_feature(self, tmp_path):
         d = tmp_path / "esc"
