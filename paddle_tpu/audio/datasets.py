@@ -105,6 +105,8 @@ class TESS(_AudioBase):
                  feature_type: str = "raw", archive_dir: Optional[str] = None,
                  seed: int = 0, **feat_kw):
         super().__init__(feature_type, archive_dir, **feat_kw)
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
         _need(archive_dir, "TESS", "archive_dir (folder of emotion wavs)")
         if not 1 <= split <= n_folds:
             raise ValueError(f"split must be in [1, {n_folds}]")
@@ -140,6 +142,8 @@ class ESC50(_AudioBase):
                  feature_type: str = "raw", archive_dir: Optional[str] = None,
                  **feat_kw):
         super().__init__(feature_type, archive_dir, **feat_kw)
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
         if not 1 <= split <= 5:
             raise ValueError(f"split must be in [1, 5], got {split}")
         _need(archive_dir, "ESC50", "archive_dir (audio/ + meta/esc50.csv)")

@@ -291,6 +291,12 @@ class _WMTBase(Dataset):
             lang, reverse = "src", lang
         if self._src_lang is not None and lang not in ("src", "source",
                                                        "trg", "target"):
+            other = "de" if self._src_lang == "en" else "en"
+            if lang not in (self._src_lang, other):
+                raise ValueError(
+                    f"unknown dict language {lang!r}; this dataset has "
+                    f"source={self._src_lang!r}, target={other!r} (or use "
+                    "'src'/'trg')")
             src = lang == self._src_lang
         else:
             src = lang in ("en", "source", "src")
@@ -339,8 +345,12 @@ class WMT16(_WMTBase):
         super().__init__(data_file, mode, src_dict_size, trg_dict_size, lang)
 
     def _read_pairs(self, data_file, mode, lang):
-        split = {"train": "train", "test": "test", "val": "val",
-                 "dev": "val"}[mode]
+        splits = {"train": "train", "test": "test", "val": "val",
+                  "dev": "val"}
+        if mode not in splits:
+            raise ValueError(
+                f"mode must be one of {sorted(splits)}, got {mode!r}")
+        split = splits[mode]
         other = "de" if lang == "en" else "en"
         with tarfile.open(data_file) as tf:
             def read(suffix):
@@ -381,6 +391,10 @@ class Conll05st(Dataset):
                 self.word_dict.setdefault(w.lower(), len(self.word_dict))
             cols = [ln.split() for ln in prop]
             n_pred = len(cols[0]) - 1
+            if any(len(c) != len(cols[0]) for c in cols):
+                raise ValueError(
+                    f"ragged props block (sentence starting {words[0]!r}): "
+                    f"rows have differing column counts")
             for p in range(1, n_pred + 1):
                 tags = self._spans_to_bio([c[p] for c in cols])
                 for t in tags:

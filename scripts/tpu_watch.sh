@@ -121,7 +121,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       fi
     else
       echo "$(date -u +%H:%M:%S) chip ALIVE -> evidence bench" >> $LOG
-      EVIDENCE_BUDGET_S=1800 timeout 3000 python scripts/tpu_evidence_bench.py >> $LOG 2>&1
+      EVIDENCE_BUDGET_S=1800 timeout -k 15 3000 python scripts/tpu_evidence_bench.py >> $LOG 2>&1
     fi
     NEW=$(ev_state)
     echo "$(date -u +%H:%M:%S) evidence state=$NEW" >> $LOG

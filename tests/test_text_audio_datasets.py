@@ -202,6 +202,24 @@ class TestWMT:
         with pytest.raises(ValueError, match="train/test/gen"):
             WMT14(data_file=f, mode="valid")
 
+    def test_wmt16_bad_mode_and_lang_rejected(self, tmp_path):
+        f = _tar_with(tmp_path, "w16c.tar.gz", {
+            "wmt16/train.en": "a", "wmt16/train.de": "b",
+        })
+        with pytest.raises(ValueError, match="mode"):
+            WMT16(data_file=f, mode="gen")
+        ds = WMT16(data_file=f, mode="train", lang="de")
+        with pytest.raises(ValueError, match="language"):
+            ds.get_dict("deu")
+
+    def test_conll_ragged_props_rejected(self, tmp_path):
+        words = tmp_path / "w.txt"
+        props = tmp_path / "p.txt"
+        words.write_text("A\nB\n")
+        props.write_text("-\t(A0*\nsat\n")   # second row short
+        with pytest.raises(ValueError, match="ragged"):
+            Conll05st(words_file=str(words), props_file=str(props))
+
     def test_wmt16_misaligned_corpus_rejected(self, tmp_path):
         f = _tar_with(tmp_path, "w16b.tar.gz", {
             "wmt16/train.en": "a\nb",
@@ -316,6 +334,12 @@ class TestESC50:
     def test_bad_split_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="split"):
             ESC50(split=99, archive_dir="/nonexistent")
+
+    def test_bad_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="mode"):
+            ESC50(mode="test", archive_dir="/nonexistent")
+        with pytest.raises(ValueError, match="mode"):
+            TESS(mode="test", archive_dir="/nonexistent")
 
     def test_spectrogram_feature(self, tmp_path):
         d = tmp_path / "esc"
