@@ -193,6 +193,12 @@ class TPUPlace(CUDAPlace):
         return f"TPUPlace({self.device_id})"
 
 
+# Reference: paddle.CustomPlace(device_type, device_id) — the
+# plugin-backend placement token (paddle/phi/backends/custom/).
+# Resolved through paddle_tpu.device.custom's registry.
+from .device.custom import CustomPlace  # noqa: E402
+
+
 def summary(net, input_size=None, dtypes=None, input=None):
     """Layer-by-layer parameter summary (reference: paddle.summary).
     Prints a table and returns {"total_params", "trainable_params"}."""

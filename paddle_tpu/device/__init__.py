@@ -118,3 +118,15 @@ class _CudaNamespace:
 
 
 cuda = _CudaNamespace()
+
+# custom-device plugin seam (reference: paddle/phi/backends/custom/) —
+# registry surface over PJRT plugins; see device/custom.py for the stance
+from . import custom  # noqa: E402
+from .custom import (  # noqa: E402
+    CustomPlace, register_custom_device, unregister_custom_device,
+    get_all_custom_device_type, is_compiled_with_custom_device,
+    custom_device_count)
+
+__all__ += ["custom", "CustomPlace", "register_custom_device",
+            "unregister_custom_device", "get_all_custom_device_type",
+            "is_compiled_with_custom_device", "custom_device_count"]

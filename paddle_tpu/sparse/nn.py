@@ -20,6 +20,11 @@ TPU-native design — a STATIC-SHAPE rulebook, no dynamic nnz:
 - Strided Conv3D's output coordinate set is data-dependent; it is
   capacity-padded to ``nnz`` candidates per offset and deduplicated by
   sort (the MoE capacity-padding stance, SURVEY §7 hard part (f)).
+  Output capacity is capped at ``min(nnz*K, prod(out_dims)+1)`` so
+  stacked strided layers cannot compound stored rows by K per layer;
+  when the spatial volume is large and nnz small, capacity still grows
+  up to K-fold per strided layer — interleave SubmConv3D (which keeps
+  the input coordinate set) or pooling to keep chains bounded.
 - **Padding rows use BCOO's out-of-range-index convention**: their
   indices are the shape itself (all coords out of range), values zero.
   ``todense`` drops them natively, and every op in this module treats
@@ -168,7 +173,13 @@ def _rulebook(idx, valid_in, dims, out_dims, kernel, stride, padding,
         [jnp.ones((1,), jnp.int32),
          (keys_s[1:] != keys_s[:-1]).astype(jnp.int32)])
     seg = jnp.cumsum(new_seg) - 1
-    n_rows = keys.shape[0]                   # static capacity = nnz*K
+    # Static output capacity.  Distinct valid keys are bounded both by the
+    # candidate count (nnz*K) and by the number of output cells; invalid
+    # candidates all share key INT_MAX and collapse into at most ONE extra
+    # segment.  Capping at min(nnz*K, prod(out_dims)+1) keeps stacked
+    # strided layers from compounding capacity by K per layer
+    # (nnz*K -> nnz*K^2 -> ...) while provably never dropping a segment.
+    n_rows = min(keys.shape[0], int(np.prod([int(s) for s in out_dims])) + 1)
     seg_valid = jax.ops.segment_max(ok_s.astype(jnp.int32), seg,
                                     num_segments=n_rows) > 0
     first_of_seg = jax.ops.segment_min(keys_s, seg, num_segments=n_rows)
@@ -234,9 +245,9 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
            groups: int = 1, data_format: str = "NDHWC"):
     """Reference: paddle.sparse.nn.functional.conv3d — strided sparse
     conv.  Output coordinates are the data-dependent active set,
-    capacity-padded to nnz·K candidates and deduplicated by sort; padding
-    rows carry out-of-range indices (dropped by todense, ignored by every
-    op here)."""
+    capacity-padded to min(nnz·K, prod(out_dims)+1) rows and deduplicated
+    by sort; padding rows carry out-of-range indices (dropped by todense,
+    ignored by every op here)."""
     if groups != 1:
         raise NotImplementedError("sparse conv3d: groups must be 1")
     if data_format != "NDHWC":

@@ -19,13 +19,13 @@ from typing import Optional, Sequence
 from .program import (  # noqa: F401
     Executor, Program, StaticGraphError, Variable, create_parameter, data,
     default_main_program, default_startup_program, global_scope, load,
-    program_guard, save)
+    program_guard, reset_default_programs, save)
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "Executor", "Program", "StaticGraphError", "Variable",
            "create_parameter", "data", "default_main_program",
            "default_startup_program", "global_scope", "load",
-           "program_guard", "save"]
+           "program_guard", "reset_default_programs", "save"]
 
 
 @dataclasses.dataclass

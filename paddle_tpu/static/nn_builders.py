@@ -179,7 +179,11 @@ def batch_norm(input, act: Optional[str] = None, is_test: bool = False,
          "data_format": data_format, "is_test": is_test})
     y, new_mean, new_var = out
     if not is_test:
-        prog = P.default_main_program()
+        # register on the program that actually recorded the node (ADVICE
+        # r3 medium: _resolve_program may pick the input Variable's
+        # program, not the default one — a write-back registered elsewhere
+        # would orphan the vids at Executor.run)
+        prog = new_mean.program
         prog._writebacks.append((new_mean.vid, f"{base}.w_1"))
         prog._writebacks.append((new_var.vid, f"{base}.w_2"))
     a = _act(act)

@@ -32,7 +32,9 @@ time, Variable-valued indices, eager-only methods) raise
 
 from __future__ import annotations
 
+import collections
 import functools
+import itertools
 import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -56,6 +58,12 @@ __all__ = [
 # second probe fails to trace (e.g. a static reshape only consistent with
 # one size) the single-probe == heuristic is the fallback.
 _PROBE = 191
+
+# process-global vid counter (see Program.__init__): one id space across all
+# programs so cross-program visibility checks can never collide.
+# itertools.count.__next__ is atomic in CPython — safe for multi-threaded
+# authoring (the _TLS guard stack explicitly supports it).
+_GLOBAL_VID = itertools.count()
 _PROBE2 = 193
 
 
@@ -337,7 +345,12 @@ class Program:
         self.datas: Dict[str, Variable] = {}
         self.params: Dict[str, _ParamDecl] = {}
         self.param_vids: Dict[str, int] = {}
-        self._next_vid = [0]
+        # vids come from _GLOBAL_VID (process-global) so they are unique
+        # ACROSS programs: _resolve_program's guard-visibility check (`vid
+        # in guard_main.vars`) would otherwise pass spuriously when two
+        # unrelated programs both start numbering at 0, silently recording
+        # a node against the wrong program with dangling input refs
+        # (found while fixing ADVICE r3's batch_norm write-back item).
         self._version = 0
         self._train: Optional[Tuple[int, Any]] = None  # (loss_vid, optimizer)
         self._opt_state = None
@@ -351,8 +364,11 @@ class Program:
 
     # -- construction -----------------------------------------------------
     def _new_var(self, name, shape, dtype, **kw) -> Variable:
-        vid = self._next_vid[0]
-        self._next_vid[0] += 1
+        vid = next(_GLOBAL_VID)
+        if name is None:  # record_call outputs: label + vid keeps it unique
+            name = f"{kw.pop('label', 'var')}_{vid}"
+        else:
+            kw.pop("label", None)
         v = Variable(self, vid, name, shape, dtype, **kw)
         self.vars[vid] = v
         self._version += 1
@@ -379,7 +395,6 @@ class Program:
         c.datas = dict(self.datas)
         c.params = dict(self.params)
         c.param_vids = dict(self.param_vids)
-        c._next_vid = self._next_vid      # shared: tape append stays coherent
         c._version = self._version
         c._writebacks = list(self._writebacks)
         if not for_test:
@@ -621,8 +636,7 @@ def record_call(fn: Callable, args: tuple, kwargs: dict):
             shape = tuple(
                 None if (had_dynamic and d == _PROBE) else int(d)
                 for d in aval.shape)
-        out_vars.append(prog._new_var(f"{label}_{prog._next_vid[0]}",
-                                      shape, aval.dtype,
+        out_vars.append(prog._new_var(None, shape, aval.dtype, label=label,
                                       stop_gradient=False))
     node = _Node(fn, jax.tree.map(to_ref, args, is_leaf=is_var),
                  jax.tree.map(to_ref, kwargs, is_leaf=is_var),
@@ -669,10 +683,30 @@ _NO_WRAP = {
 }
 
 
+def _default_live() -> bool:
+    """True while the default main program holds live feed slots or params
+    — the only state in which a stray Variable can reach a public call
+    outside any guard.  Keeps _DEFAULT_DIRTY scoped instead of a one-way
+    latch (ADVICE r3): once the default programs are reset, eager code
+    returns to the zero-cost fast path."""
+    return bool(_DEFAULTS) and bool(_DEFAULTS[0][0].datas
+                                    or _DEFAULTS[0][0].params)
+
+
+def reset_default_programs() -> None:
+    """Drop the default (main, startup) pair — the analog of the
+    reference's ``paddle.base.framework.switch_main_program(Program())``
+    session reset.  Variables minted on the old defaults become inert;
+    the recording scan disarms for eager code."""
+    _DEFAULTS.clear()
+    _DEFAULT_DIRTY[0] = False
+
+
 def _wrap_callable(f):
     @functools.wraps(f)
     def g(*args, **kwargs):
-        if ((_TLS.stack or _STATIC_ACTIVE[0] or _DEFAULT_DIRTY[0])
+        if ((_TLS.stack or _STATIC_ACTIVE[0]
+             or (_DEFAULT_DIRTY[0] and _default_live()))
                 and _contains_variable((args, kwargs))):
             return record_call(f, args, kwargs)
         return f(*args, **kwargs)
@@ -772,9 +806,15 @@ class Executor:
     ``value_and_grad`` and apply the optimizer update — parameters and
     optimizer state live in the scope between calls."""
 
+    # compiled runners kept per Executor; bounded because each entry pins
+    # its Program and a jitted executable — long sessions with varying
+    # batch shapes would otherwise leak compiled programs (ADVICE r3)
+    _CACHE_CAP = 64
+
     def __init__(self, place=None):
         self.place = place
-        self._cache: Dict[tuple, Callable] = {}
+        self._cache: "collections.OrderedDict[tuple, Callable]" = \
+            collections.OrderedDict()
 
     # -- startup ----------------------------------------------------------
     def _run_startup(self, program: Program, scope: "Scope" = None):
@@ -841,7 +881,16 @@ class Executor:
         runner = self._cache.get(key)
         if runner is None:
             runner = self._build_runner(program, fetch_vids, train)
+            # evict runners compiled against stale versions of this program
+            # (a mutated tape can never be replayed through them again)
+            for k in [k for k in self._cache
+                      if k[0] == id(program) and k[1] != program._version]:
+                del self._cache[k]
             self._cache[key] = runner
+            while len(self._cache) > self._CACHE_CAP:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
 
         feeds = {k: jnp.asarray(v) for k, v in feed.items()}
         if train:

@@ -117,9 +117,13 @@ class Imikolov(Dataset):
     .txt; NGRAM windows framed by <s>/<e> or SEQ id lists; vocab by
     min-word-freq, '<unk>' mapped from PTB's own token."""
 
-    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
-                 mode="train", min_word_freq=1, download=True):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
         _need(data_file, "Imikolov", "data_file (simple-examples tar.gz)")
+        if data_type.upper() == "NGRAM" and window_size <= 0:
+            raise ValueError(
+                "Imikolov NGRAM mode needs window_size > 0 (the reference "
+                "default window_size=-1 is only valid for data_type='SEQ')")
         split = "train" if mode == "train" else "valid"
 
         with tarfile.open(data_file) as tf:

@@ -1,0 +1,84 @@
+"""Custom-device plugin registry tests.
+
+Reference test model: test/custom_runtime — installs a fake CustomDevice
+plugin (CPU masquerading as a device) and drives the discovery +
+placement surface end-to-end (SURVEY.md §4 fixtures).  Here the fake
+plugin is the CPU platform registered under a custom type name; a real
+out-of-tree backend would instead ship a PJRT plugin whose platform name
+is registered the same way (see paddle_tpu/device/custom.py stance).
+"""
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.device import custom as C
+
+
+@pytest.fixture
+def fake_dev():
+    C.register_custom_device("fake_dev", "cpu")
+    yield "fake_dev"
+    C.unregister_custom_device("fake_dev")
+
+
+class TestRegistry:
+    def test_discovery_surface(self, fake_dev):
+        assert "fake_dev" in paddle.device.get_all_custom_device_type()
+        assert paddle.device.is_compiled_with_custom_device("fake_dev")
+        assert not paddle.device.is_compiled_with_custom_device("absent")
+        assert paddle.device.custom_device_count("fake_dev") == \
+            len(jax.devices("cpu"))
+        assert paddle.device.custom_device_count("absent") == 0
+
+    def test_unregister(self):
+        C.register_custom_device("tmp_dev", "cpu")
+        C.unregister_custom_device("tmp_dev")
+        assert "tmp_dev" not in C.get_all_custom_device_type()
+        # unregistering twice is a no-op, not an error
+        C.unregister_custom_device("tmp_dev")
+
+    def test_default_platform_is_type_name(self):
+        C.register_custom_device("cpu")          # platform name == type
+        try:
+            assert C.is_compiled_with_custom_device("cpu")
+        finally:
+            C.unregister_custom_device("cpu")
+
+    def test_bad_name_rejected(self):
+        with pytest.raises(ValueError):
+            C.register_custom_device("")
+
+
+class TestCustomPlace:
+    def test_token_and_equality(self, fake_dev):
+        p = paddle.CustomPlace("fake_dev", 1)
+        assert p == paddle.CustomPlace("fake_dev", 1)
+        assert p != paddle.CustomPlace("fake_dev", 0)
+        assert "fake_dev" in repr(p)
+
+    def test_resolve_to_jax_device(self, fake_dev):
+        d = C.resolve(paddle.CustomPlace("fake_dev", 0))
+        assert d is jax.devices("cpu")[0]
+        # string form, reference 'type:id' style
+        d1 = C.resolve("fake_dev:1")
+        assert d1 is jax.devices("cpu")[1]
+
+    def test_unknown_type_errors_with_registry_hint(self):
+        with pytest.raises(ValueError, match="register"):
+            C.resolve(paddle.CustomPlace("never_registered", 0))
+
+    def test_out_of_range_id(self, fake_dev):
+        n = len(jax.devices("cpu"))
+        with pytest.raises(ValueError, match="out of range"):
+            C.resolve(paddle.CustomPlace("fake_dev", n))
+
+    def test_placement_end_to_end(self, fake_dev):
+        """Computation actually lands on the resolved device — the fake
+        plugin runs a real op, the reference test/custom_runtime oracle."""
+        dev = C.resolve("fake_dev:1")
+        x = jax.device_put(np.arange(8.0, dtype=np.float32), dev)
+        y = paddle.mean(x)
+        assert float(y) == 3.5
+        assert list(x.devices())[0] is dev

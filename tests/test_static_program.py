@@ -614,6 +614,73 @@ class TestReviewRegressions:
         assert exe.run(empty) == []
 
 
+class TestAdviceR3Regressions:
+    def test_vids_globally_unique_across_programs(self):
+        """Per-program vid counters collided across programs, making the
+        guard-visibility check in _resolve_program pass spuriously and
+        silently recording nodes against the wrong program (found while
+        fixing ADVICE r3's batch_norm write-back item)."""
+        A, sA = _fresh_pair()
+        with static.program_guard(A, sA):
+            x = static.data("x", [4])
+        B, sB = _fresh_pair()
+        with static.program_guard(B, sB):
+            y = static.data("y", [4])
+            z = y + 1.0
+        # x's vid must not exist in B: the guard check cannot be fooled
+        assert x.vid not in B.vars
+        assert z.program is B
+
+    def test_batch_norm_writebacks_follow_recording_program(self):
+        """ADVICE r3 medium: write-backs must land on the program that
+        recorded the node, and the executing program must update moving
+        stats (the existing pipeline covers the normal path; this pins the
+        invariant directly)."""
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 4])
+            y = static.nn.batch_norm(x, momentum=0.5)
+        assert len(main._writebacks) == 2
+        wb_vids = {vid for vid, _ in main._writebacks}
+        assert wb_vids <= set(main.vars), "write-back vids orphaned"
+
+    def test_executor_cache_bounded_and_stale_versions_evicted(self):
+        exe = static.Executor()
+        main, startup = _fresh_pair()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 2])
+            y = paddle.mean(x)
+        # varying feed shapes mint distinct cache keys; cap must hold
+        for n in range(1, exe._CACHE_CAP + 10):
+            exe.run(main, feed={"x": np.ones((n, 2), np.float32)},
+                    fetch_list=[y])
+        assert len(exe._cache) <= exe._CACHE_CAP
+        # mutating the tape bumps the version; stale runners evicted
+        with static.program_guard(main, startup):
+            z = y + 1.0
+        exe.run(main, feed={"x": np.ones((3, 2), np.float32)},
+                fetch_list=[z])
+        assert all(k[1] == main._version for k in exe._cache
+                   if k[0] == id(main))
+
+    def test_default_dirty_not_a_one_way_latch(self):
+        """ADVICE r3 low: a stray data() outside any guard armed the
+        recording scan for the whole session; resetting the default
+        programs must restore the eager fast path."""
+        from paddle_tpu.static import program as P
+        import jax.numpy as jnp
+        try:
+            static.data(f"stray_{np.random.randint(1 << 30)}", [2])
+            assert P._DEFAULT_DIRTY[0] and P._default_live()
+            static.reset_default_programs()   # the exported surface
+            assert not P._DEFAULT_DIRTY[0]
+            # eager calls skip the recording scan again
+            out = paddle.mean(jnp.arange(4.0))
+            assert float(out) == 1.5
+        finally:
+            P.reset_default_programs()
+
+
 class TestModes:
     def test_enable_disable_static_flag(self):
         try:

@@ -122,6 +122,20 @@ class TestImikolov:
         s, e = ds.word_idx["<s>"], ds.word_idx["<e>"]
         assert ds[0][0] == s and ds[2][-1] == e
 
+    def test_reference_defaults(self, tmp_path):
+        """Reference imikolov defaults: window_size=-1, min_word_freq=50
+        (ADVICE r3).  NGRAM with the -1 default must fail loudly; the
+        freq-50 default prunes a tiny vocab to the specials."""
+        import inspect
+        sig = inspect.signature(Imikolov.__init__)
+        assert sig.parameters["window_size"].default == -1
+        assert sig.parameters["min_word_freq"].default == 50
+        tar = self._tar(tmp_path)
+        with pytest.raises(ValueError, match="window_size"):
+            Imikolov(data_file=tar, data_type="NGRAM")
+        ds = Imikolov(data_file=tar, data_type="SEQ")
+        assert set(ds.word_idx) == {"<unk>", "<s>", "<e>"}
+
     def test_seq_mode_and_valid_split(self, tmp_path):
         tar = self._tar(tmp_path)
         ds = Imikolov(data_file=tar, data_type="SEQ", mode="valid")
