@@ -95,6 +95,14 @@ class _FlagsNamespace:
         except KeyError:
             raise AttributeError(name) from None
 
+    def __setattr__(self, name: str, value: Any) -> None:
+        # write through to the registry: a plain instance attribute would
+        # permanently shadow the flag for every later set_flags() call
+        if name in _REGISTRY:
+            set_flags({name: value})
+        else:
+            raise AttributeError(f"unknown flag {name!r}")
+
 
 flags = _FlagsNamespace()
 
@@ -127,6 +135,10 @@ define_flag("use_pallas_attention", True,
 define_flag("use_pallas_norm", True,
             "Route last-dim layer_norm (full weight+bias) to the fused "
             "Pallas kernel on TPU")
+define_flag("pallas_routing", "auto",
+            "Pallas-vs-XLA kernel routing: 'auto' follows the measured "
+            "per-shape table (paddle_tpu/kernels/routing.py), 'always' "
+            "forces every flag-enabled kernel, 'never' disables Pallas")
 define_flag("flash_block_q", 256,
             "Flash-attention query block rows (kernel tile size); "
             "env-tunable so on-chip sweeps need no code edits")

@@ -161,9 +161,12 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
     """Reference: rms_norm fused op (PaddleNLP/incubate).  Routes to the
     Pallas fused kernel (paddle_tpu/kernels/fused_norm.py) when the shape
     is the standard last-axis case; XLA expression otherwise."""
+    from ...kernels.routing import use_pallas as _route
     if (norm_bias is None and begin_norm_axis in (-1, x.ndim - 1)
             and norm_weight.ndim == 1
-            and x.shape[-1] % 128 == 0):
+            and x.shape[-1] % 128 == 0
+            and _route("rms_norm", rows=x.size // max(x.shape[-1], 1),
+                       h=x.shape[-1])):
         try:
             from ...kernels.fused_norm import fused_rms_norm_pallas
             return fused_rms_norm_pallas(x, norm_weight, epsilon)
@@ -253,11 +256,11 @@ def masked_multihead_attention(x, cache_kv, src_mask=None, bias=None,
     kc = kc.at[b_idx, :, t_idx, :].set(k)
     vc = vc.at[b_idx, :, t_idx, :].set(v)
     new_cache = jnp.stack([kc, vc], axis=0)
-    from ...kernels.decode_attention import decode_attention
-    out = decode_attention(q[:, None],                  # [B, 1, H, D]
-                           jnp.swapaxes(kc, 1, 2),      # [B, T, H, D]
-                           jnp.swapaxes(vc, 1, 2),
-                           lens + 1)
+    from ...kernels.decode_attention import decode_attention_auto
+    out = decode_attention_auto(q[:, None],             # [B, 1, H, D]
+                                jnp.swapaxes(kc, 1, 2),  # [B, T, H, D]
+                                jnp.swapaxes(vc, 1, 2),
+                                lens + 1)
     return out.reshape(B, H * D), new_cache
 
 

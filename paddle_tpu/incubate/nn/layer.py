@@ -322,10 +322,11 @@ class FusedMultiTransformer(Layer):
                     # Pallas decode kernel (the fused_multi_transformer
                     # attention core) instead of building a [B,1,1,Tmax]
                     # additive mask + full sdpa
-                    from ...kernels.decode_attention import decode_attention
+                    from ...kernels.decode_attention import \
+                        decode_attention_auto
                     sq = q.shape[1]
                     lens = jnp.full((q.shape[0],), t + sq, jnp.int32)
-                    out = decode_attention(q, att_k, att_v, lens)
+                    out = decode_attention_auto(q, att_k, att_v, lens)
                     new_cache = jnp.stack([kc, vc], axis=0)
                     return self._finish_layer(i, out, residual), new_cache
                 # user padding mask: dense path with the SAME causal-tail

@@ -13,7 +13,9 @@ from .flash_attention import (flash_attention, flash_attention_with_lse,
 from .fused_adamw import fused_adamw_update
 from .fused_norm import (fused_rms_norm_pallas,
                          fused_layer_norm_pallas)
-from .decode_attention import decode_attention
+from .decode_attention import (decode_attention, decode_attention_auto,
+                               decode_attention_reference)
+from .routing import use_pallas as route_use_pallas
 
 __all__ = ["flash_attention", "flash_attention_with_lse", "decode_attention",
            "fused_adamw_update", "fused_rms_norm_pallas",

@@ -115,12 +115,17 @@ def _flatten_and_pick_block(x):
     rows = x2.shape[0]
     if rows == 0:
         return x2, 0
-    if rows <= 256:
+    # cap block x h x 4B (the f32 working copy) at 4 MiB: the r4 on-chip
+    # sweep showed Mosaic scoped-vmem failures for blocks past that (e.g.
+    # any legal block at h=8192 with the old flat 256 cap), which forced
+    # a compile-error fallback instead of a working kernel
+    cap = max(8, min(256, (4 * 1024 * 1024) // (4 * h)))
+    if rows <= cap:
         return x2, rows          # one block == full array: always legal
     # sublane tile is 16 for 2-byte dtypes, 8 for f32
     align = 16 if x.dtype.itemsize == 2 else 8
     best = 0
-    for b in range(align, 257, align):
+    for b in range(align, cap + 1, align):
         if rows % b == 0:
             best = b
     # no aligned divisor <= 256: a single full-array block would be
@@ -130,12 +135,17 @@ def _flatten_and_pick_block(x):
 
 
 def fused_rms_norm_pallas(x, weight, epsilon: float = 1e-5,
-                          interpret=None):
-    """RMSNorm over the last dim; x [..., H], weight [H]."""
+                          interpret=None, block_rows=None):
+    """RMSNorm over the last dim; x [..., H], weight [H].
+
+    ``block_rows`` overrides the auto-picked tile height (sweep tuning
+    knob); it must divide the flattened row count."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     orig = x.shape
     x2, block = _flatten_and_pick_block(x)
+    if block_rows and x2.shape[0] % block_rows == 0:
+        block = block_rows
     if block == 0:
         if x.size == 0:
             return x
@@ -252,12 +262,17 @@ _ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)
 
 
 def fused_layer_norm_pallas(x, weight, bias, epsilon: float = 1e-5,
-                            interpret=None):
-    """LayerNorm over the last dim; x [..., H], weight/bias [H]."""
+                            interpret=None, block_rows=None):
+    """LayerNorm over the last dim; x [..., H], weight/bias [H].
+
+    ``block_rows`` overrides the auto-picked tile height (sweep tuning
+    knob); it must divide the flattened row count."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     orig = x.shape
     x2, block = _flatten_and_pick_block(x)
+    if block_rows and x2.shape[0] % block_rows == 0:
+        block = block_rows
     if block == 0:
         if x.size == 0:
             return x

@@ -96,11 +96,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     Routes to the Pallas TPU flash kernel when profitable, else pure XLA.
     """
+    from ...kernels.routing import use_pallas as _route
     use_pallas = (
         flags.use_pallas_attention
         and attn_mask is None
         and dropout_p == 0.0
-        and query.shape[1] >= 512 and key.shape[1] >= 512
+        and _route("flash_attention", seq_q=query.shape[1],
+                   seq_k=key.shape[1])
         and query.shape[-1] in (64, 128, 256)
         and jax.default_backend() not in ("cpu",)
     )

@@ -40,7 +40,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-
             and getattr(weight, "dtype", None) == x.dtype
             and getattr(bias, "dtype", None) == x.dtype):
         from ...core.flags import flags as _flags
-        if _flags.use_pallas_norm and _on_tpu():
+        from ...kernels.routing import use_pallas as _route
+        if (_flags.use_pallas_norm and _on_tpu()
+                and _route("layer_norm", rows=rows, h=h_last)):
             try:
                 import paddle_tpu.kernels as _k
                 return _k.fused_layer_norm_pallas(x, weight, bias,

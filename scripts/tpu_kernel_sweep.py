@@ -1,0 +1,210 @@
+"""Multi-shape on-chip kernel sweep: Pallas vs XLA across shapes and
+tile-size variants, feeding the empirical routing table
+(paddle_tpu/kernels/routing.py) and the >=2-shapes-per-kernel
+kernel_compare requirement.
+
+Rows are written INCREMENTALLY (fsync'd atomic replace after each
+measurement) to the output JSON so a mid-run tunnel wedge still leaves
+every completed row on disk.
+
+Timing uses scripts/tpu_microbench.timeit_chain (scan-chained single
+dispatch — per-dispatch timing is invalid on the axon tunnel; see that
+module's docstring).
+
+Usage: python scripts/tpu_kernel_sweep.py [out.json]
+Env:   SWEEP_BUDGET_S (default 600) — stop adding rows when exceeded.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/kernel_sweep_r4.json"
+BUDGET = float(os.environ.get("SWEEP_BUDGET_S", "600"))
+T0 = time.perf_counter()
+RES = {"started_unix": int(time.time()), "rows": {}}
+
+
+def flush():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(RES, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, OUT)
+
+
+def left():
+    return BUDGET - (time.perf_counter() - T0)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import (decode_attention, flash_attention,
+                                    fused_adamw_update,
+                                    fused_layer_norm_pallas,
+                                    fused_rms_norm_pallas)
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+    from tpu_microbench import timeit_chain, _attn_steps
+
+    RES["platform"] = jax.devices()[0].platform
+    rs = np.random.RandomState(0)
+
+    def row(name, pallas_step, xla_step, init, iters=20):
+        if left() < 30:
+            RES["truncated"] = "budget"
+            flush()
+            return False
+        r = {}
+        try:
+            r["pallas_ms"] = round(timeit_chain(pallas_step, init, iters), 3)
+        except Exception as e:
+            r["pallas_ms"] = f"failed: {repr(e)[-160:]}"
+        if xla_step is not None:
+            try:
+                r["xla_ms"] = round(timeit_chain(xla_step, init, iters), 3)
+            except Exception as e:
+                r["xla_ms"] = f"failed: {repr(e)[-160:]}"
+            if isinstance(r.get("pallas_ms"), float) and \
+                    isinstance(r.get("xla_ms"), float):
+                r["speedup"] = round(r["xla_ms"] / max(r["pallas_ms"], 1e-9), 3)
+        RES["rows"][name] = r
+        flush()
+        print(name, r, flush=True)
+        return True
+
+    # ---------------- decode attention: kv x block_k --------------------
+    b, h, d = 4, 8, 128
+    for sk in (2048, 4096, 8192, 16384):
+        q1 = jnp.asarray(rs.randn(b, 1, h, d), jnp.bfloat16)
+        kc = jnp.asarray(rs.randn(b, sk, h, d), jnp.bfloat16)
+        vc = jnp.asarray(rs.randn(b, sk, h, d), jnp.bfloat16)
+        ln = jnp.full((b,), sk, jnp.int32)
+
+        def xdec(q, k, v):
+            s_ = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / np.sqrt(d)
+            p = jax.nn.softmax(s_, -1)
+            return jnp.einsum("bhqs,bshd->bqhd", p,
+                              v.astype(jnp.float32)).astype(q.dtype)
+
+        if not row(f"decode_attn_kv{sk}",
+                   lambda q, k, v: (decode_attention(q, k, v, ln,
+                                                     interpret=False), k, v),
+                   lambda q, k, v: (xdec(q, k, v), k, v), (q1, kc, vc)):
+            return
+        for bk in (1024, 2048):
+            if bk >= sk:
+                continue
+            if not row(f"decode_attn_kv{sk}_bk{bk}",
+                       lambda q, k, v, bk=bk: (decode_attention(
+                           q, k, v, ln, block_k=bk, interpret=False), k, v),
+                       None, (q1, kc, vc)):
+                return
+
+    # ---------------- fused AdamW: n x block_rows x alias ---------------
+    for nm in (1, 8, 64):
+        n = nm * 1024 * 1024
+        p = jnp.asarray(rs.randn(n), jnp.float32)
+        g = jnp.asarray(rs.randn(n), jnp.float32)
+        m = jnp.zeros((n,), jnp.float32)
+        v2 = jnp.zeros((n,), jnp.float32)
+
+        def xadam(p, m, v):
+            m2 = 0.9 * m + 0.1 * g
+            v3 = 0.999 * v + 0.001 * g * g
+            up = m2 / (1 - 0.9) / (jnp.sqrt(v3 / (1 - 0.999)) + 1e-8)
+            return p - 1e-4 * (up + 0.01 * p), m2, v3
+
+        if not row(f"fused_adamw_{nm}M",
+                   lambda p, m, v: fused_adamw_update(
+                       p, g, m, v, 1, 1e-4, 0.9, 0.999, 1e-8, 0.01,
+                       interpret=False),
+                   xadam, (p, m, v2)):
+            return
+        for br in (2048, 8192):
+            if not row(f"fused_adamw_{nm}M_br{br}",
+                       lambda p, m, v, br=br: fused_adamw_update(
+                           p, g, m, v, 1, 1e-4, 0.9, 0.999, 1e-8, 0.01,
+                           interpret=False, block_rows=br),
+                       None, (p, m, v2)):
+                return
+        if not row(f"fused_adamw_{nm}M_noalias",
+                   lambda p, m, v: fused_adamw_update(
+                       p, g, m, v, 1, 1e-4, 0.9, 0.999, 1e-8, 0.01,
+                       interpret=False, alias=False),
+                   None, (p, m, v2)):
+            return
+
+    # ---------------- norms: shape x block_rows -------------------------
+    for rows_, hdim in ((2048, 1024), (8192, 4096), (32768, 2048),
+                        (4096, 8192)):
+        x = jnp.asarray(rs.randn(rows_, hdim), jnp.bfloat16)
+        w = jnp.asarray(rs.randn(hdim), jnp.float32)
+        bln = jnp.asarray(rs.randn(hdim), jnp.float32)
+
+        def lref(x):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, -1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + bln).astype(
+                x.dtype)
+
+        def rref(x):
+            return (x.astype(jnp.float32) * jax.lax.rsqrt(
+                jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                         keepdims=True) + 1e-6) * w).astype(x.dtype)
+
+        nm = f"{rows_}x{hdim}"
+        if not row(f"fused_layer_norm_{nm}",
+                   lambda x: (fused_layer_norm_pallas(x, w, bln, 1e-5,
+                                                      interpret=False),),
+                   lambda x: (lref(x),), (x,)):
+            return
+        if not row(f"fused_rms_norm_{nm}",
+                   lambda x: (fused_rms_norm_pallas(x, w, 1e-6,
+                                                    interpret=False),),
+                   lambda x: (rref(x),), (x,)):
+            return
+        for br in (512, 1024):
+            if rows_ % br:
+                continue
+            if not row(f"fused_layer_norm_{nm}_br{br}",
+                       lambda x, br=br: (fused_layer_norm_pallas(
+                           x, w, bln, 1e-5, interpret=False,
+                           block_rows=br),),
+                       None, (x,)):
+                return
+
+    # ---------------- flash attention: extra seq points -----------------
+    for s in (1024, 4096):
+        q = jnp.asarray(rs.randn(2, s, 8, 128), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(2, s, 8, 128), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(2, s, 8, 128), jnp.bfloat16)
+        pa_fwd, pa_bwd = _attn_steps(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=False))
+        xa_fwd, xa_bwd = _attn_steps(lambda q, k, v: sdpa_reference(
+            q, k, v, is_causal=True, training=False).astype(q.dtype))
+        if not row(f"flash_attn_fwd_s{s}", pa_fwd, xa_fwd, (q, k, v)):
+            return
+        if not row(f"flash_attn_bwd_s{s}", pa_bwd, xa_bwd, (q, k, v)):
+            return
+
+    RES["finished_unix"] = int(time.time())
+    flush()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as e:
+        RES["error"] = repr(e)[-600:]
+        flush()
+        raise

@@ -1,0 +1,176 @@
+"""Refinement pass over the r4 kernel sweep: the contested rows re-timed
+with iters=100 (the first sweep's iters=20 left a ~3.4 ms/iter dispatch
+floor that drowned sub-ms kernels), with the NEW defaults picked from
+sweep 1 (adamw block_rows 8192, decode block_k 1024, norm vmem cap), and
+the 64M AdamW row fixed to thread g through the scan carry (closing over
+a 256 MB gradient baked it into the HLO as a constant -> remote-compile
+HTTP 413 in sweep 1).
+
+Usage: python scripts/tpu_kernel_sweep2.py [out.json]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/kernel_sweep2_r4.json"
+BUDGET = float(os.environ.get("SWEEP_BUDGET_S", "600"))
+T0 = time.perf_counter()
+RES = {"started_unix": int(time.time()), "iters": 100, "rows": {}}
+
+
+def flush():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(RES, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, OUT)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import (decode_attention, flash_attention,
+                                    fused_adamw_update,
+                                    fused_layer_norm_pallas,
+                                    fused_rms_norm_pallas)
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+    from tpu_microbench import timeit_chain, _attn_steps
+
+    RES["platform"] = jax.devices()[0].platform
+    rs = np.random.RandomState(0)
+
+    def row(name, pallas_step, xla_step, init, iters=100):
+        if BUDGET - (time.perf_counter() - T0) < 30:
+            RES["truncated"] = "budget"
+            flush()
+            return False
+        r = {}
+        for key, step in (("pallas_ms", pallas_step), ("xla_ms", xla_step)):
+            if step is None:
+                continue
+            try:
+                r[key] = round(timeit_chain(step, init, iters), 3)
+            except Exception as e:
+                r[key] = f"failed: {repr(e)[-160:]}"
+        if isinstance(r.get("pallas_ms"), float) and \
+                isinstance(r.get("xla_ms"), float):
+            r["speedup"] = round(r["xla_ms"] / max(r["pallas_ms"], 1e-9), 3)
+        RES["rows"][name] = r
+        flush()
+        print(name, r, flush=True)
+        return True
+
+    # -------- decode attention with NEW default bk=1024 -----------------
+    b, h, d = 4, 8, 128
+    for sk in (4096, 8192, 16384):
+        q1 = jnp.asarray(rs.randn(b, 1, h, d), jnp.bfloat16)
+        kc = jnp.asarray(rs.randn(b, sk, h, d), jnp.bfloat16)
+        vc = jnp.asarray(rs.randn(b, sk, h, d), jnp.bfloat16)
+        ln = jnp.full((b,), sk, jnp.int32)
+
+        def xdec(q, k, v):
+            s_ = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / np.sqrt(d)
+            p = jax.nn.softmax(s_, -1)
+            return jnp.einsum("bhqs,bshd->bqhd", p,
+                              v.astype(jnp.float32)).astype(q.dtype)
+
+        if not row(f"decode_attn_kv{sk}",
+                   lambda q, k, v: (decode_attention(q, k, v, ln,
+                                                     interpret=False), k, v),
+                   lambda q, k, v: (xdec(q, k, v), k, v), (q1, kc, vc)):
+            return
+
+    # -------- fused AdamW with NEW default block (8192 rows) ------------
+    # g rides the carry (constant in value, but a real argument) so the
+    # HLO stays small at 64M
+    for nm in (8, 64):
+        n = nm * 1024 * 1024
+        p = jnp.asarray(rs.randn(n), jnp.float32)
+        g0 = jnp.asarray(rs.randn(n), jnp.float32) * 0.01
+        m = jnp.zeros((n,), jnp.float32)
+        v2 = jnp.zeros((n,), jnp.float32)
+
+        def padam(p, g, m, v):
+            np_, nm_, nv_ = fused_adamw_update(
+                p, g, m, v, 1, 1e-4, 0.9, 0.999, 1e-8, 0.01,
+                interpret=False)
+            return np_, g, nm_, nv_
+
+        def xadam(p, g, m, v):
+            m2 = 0.9 * m + 0.1 * g
+            v3 = 0.999 * v + 0.001 * g * g
+            up = m2 / (1 - 0.9) / (jnp.sqrt(v3 / (1 - 0.999)) + 1e-8)
+            return p - 1e-4 * (up + 0.01 * p), g, m2, v3
+
+        iters = 100 if nm <= 8 else 40
+        if not row(f"fused_adamw_{nm}M", padam, xadam, (p, g0, m, v2),
+                   iters=iters):
+            return
+
+    # -------- norms at the contested shapes with the vmem-capped picker -
+    for rows_, hdim in ((2048, 1024), (8192, 4096), (32768, 2048),
+                        (4096, 8192)):
+        x = jnp.asarray(rs.randn(rows_, hdim), jnp.bfloat16)
+        w = jnp.asarray(rs.randn(hdim), jnp.float32)
+        bln = jnp.asarray(rs.randn(hdim), jnp.float32)
+
+        def lref(x):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, -1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + bln).astype(
+                x.dtype)
+
+        def rref(x):
+            return (x.astype(jnp.float32) * jax.lax.rsqrt(
+                jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                         keepdims=True) + 1e-6) * w).astype(x.dtype)
+
+        nm = f"{rows_}x{hdim}"
+        if not row(f"fused_layer_norm_{nm}",
+                   lambda x: (fused_layer_norm_pallas(x, w, bln, 1e-5,
+                                                      interpret=False),),
+                   lambda x: (lref(x),), (x,)):
+            return
+        if not row(f"fused_rms_norm_{nm}",
+                   lambda x: (fused_rms_norm_pallas(x, w, 1e-6,
+                                                    interpret=False),),
+                   lambda x: (rref(x),), (x,)):
+            return
+
+    # -------- flash attention small-seq check ---------------------------
+    for s in (1024, 2048):
+        q = jnp.asarray(rs.randn(2, s, 8, 128), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(2, s, 8, 128), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(2, s, 8, 128), jnp.bfloat16)
+        pa_fwd, pa_bwd = _attn_steps(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=False))
+        xa_fwd, xa_bwd = _attn_steps(lambda q, k, v: sdpa_reference(
+            q, k, v, is_causal=True, training=False).astype(q.dtype))
+        if not row(f"flash_attn_fwd_s{s}", pa_fwd, xa_fwd, (q, k, v),
+                   iters=50):
+            return
+        if not row(f"flash_attn_bwd_s{s}", pa_bwd, xa_bwd, (q, k, v),
+                   iters=50):
+            return
+
+    RES["finished_unix"] = int(time.time())
+    flush()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as e:
+        RES["error"] = repr(e)[-600:]
+        flush()
+        raise
