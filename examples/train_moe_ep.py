@@ -62,8 +62,10 @@ def main():
     state = trainer.init_state()
 
     losses = []
+    # fixed batch: the learning assertion below needs same-data steps
+    # (with fresh random batches per step, 2-step loss deltas are noise)
+    x, y = trainer.make_batch(batch=args.batch, seq=args.seq, seed=0)
     for step in range(args.steps):
-        x, y = trainer.make_batch(batch=args.batch, seq=args.seq, seed=step)
         state, loss = trainer.train_step(state, x, y)
         losses.append(float(loss))
         print(f"step {step}: loss={losses[-1]:.4f}")

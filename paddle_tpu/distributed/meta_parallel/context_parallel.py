@@ -40,10 +40,33 @@ from ..topology import get_hybrid_communicate_group
 def _shard_map(body, mesh, in_specs, out_specs, manual_axes):
     """jax.shard_map in partial-manual mode: only ``manual_axes`` are
     manual (collectives address them); other mesh axes stay GSPMD-auto so
-    this composes inside a pjit program sharded over dp/mp/etc."""
+    this composes inside a pjit program sharded over dp/mp/etc.
+
+    When already tracing inside an enclosing shard_map (e.g. the fused
+    pipeline schedule with pp manual), the nested map must be built on the
+    AMBIENT abstract mesh — passing the concrete Mesh raises a context-
+    mismatch because the ambient mesh carries Manual axis types.  This is
+    the cp-inside-pp composition seam (r4 dryrun leg 4)."""
     return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs,
                          axis_names=frozenset(manual_axes), check_vma=False)
+
+
+def _axis_is_manual(axis_name: str) -> bool:
+    """True when tracing inside a shard_map that already binds
+    ``axis_name`` as manual (e.g. the fused pipeline schedule running with
+    sep in its manual set) — the attention entry points then use the
+    collectives directly instead of opening their own shard_map (nested
+    binding is rejected by the sdy lowering)."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        names = getattr(am, "axis_names", None) or ()
+        if axis_name not in names:
+            return False
+        types = dict(zip(names, getattr(am, "axis_types", ())))
+        return types[axis_name] == jax.sharding.AxisType.Manual
+    except Exception:
+        return False
 
 __all__ = ["ring_attention", "ulysses_attention", "RingAttention",
            "split_sequence", "gather_sequence"]
@@ -212,7 +235,7 @@ def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
     (global shapes at top level; local blocks when inside_shard_map)."""
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    if inside_shard_map:
+    if inside_shard_map or _axis_is_manual(axis_name):
         size = jax.lax.axis_size(axis_name)
         return _ring_attention_local(q, k, v, axis_name, size, causal, scale)
 
@@ -280,7 +303,7 @@ def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
     attention on H/n heads, swap back.  Requires num_heads % sep == 0."""
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    if inside_shard_map:
+    if inside_shard_map or _axis_is_manual(axis_name):
         return _ulysses_local(q, k, v, axis_name, causal, scale)
 
     mesh = _resolve_mesh(mesh)

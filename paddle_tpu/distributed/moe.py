@@ -268,9 +268,18 @@ class SwitchGate(NaiveGate):
 
 class ExpertFFN(Layer):
     """One FFN expert (Linear -> act -> Linear), the reference's standard
-    expert module (ExpertLayer in moe test/models)."""
+    expert module (ExpertLayer in moe test/models).
 
-    def __init__(self, d_model: int, d_hidden: int, activation: str = "gelu"):
+    With ``mp_group`` set the expert is internally tensor-parallel
+    (reference: MoELayer(mp_group) — expert weights split over the model-
+    parallel group alongside the expert split over the moe group): w0 is
+    column-split and w1 row-split over the mp axis, so the expert's hidden
+    activation shards over mp and the w1 contraction's partial sums are
+    all-reduced by GSPMD exactly where the reference calls mp allreduce.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, activation: str = "gelu",
+                 mp_group=None):
         super().__init__()
         self.w0 = self.create_parameter((d_model, d_hidden),
                                         default_initializer=I.XavierNormal())
@@ -279,6 +288,9 @@ class ExpertFFN(Layer):
                                         default_initializer=I.XavierNormal())
         self.b1 = self.create_parameter((d_model,), is_bias=True)
         self.activation = activation
+        mp_axis = _moe_mp_axis(mp_group)
+        if mp_axis:
+            _apply_ffn_mp_specs(self, mp_axis)
 
     def forward(self, x):
         h = jnp.matmul(x, self.w0) + self.b0
@@ -317,6 +329,17 @@ class MoELayer(Layer):
                     "switch": SwitchGate}[gtype]
             gate = gcls(d_model, self.num_expert, **cfg)
         self.gate = gate
+        # expert-internal tensor parallelism (reference: MoELayer takes the
+        # mp group alongside the moe group): when requested, ExpertFFN
+        # experts that don't already carry specs get the standard
+        # column/row split; experts with their own specs keep them and
+        # ExpertStack inherits either way
+        mp_axis = _moe_mp_axis(mp_group)
+        if mp_axis:
+            for e in experts:
+                if isinstance(e, ExpertFFN) and \
+                        not e.__dict__.get("_param_specs"):
+                    _apply_ffn_mp_specs(e, mp_axis)
         self.experts = ExpertStack(experts, moe_group=moe_group)
         self._axis = _ep_axis(moe_group)
 
@@ -385,14 +408,21 @@ class ExpertStack(Layer):
         # the sublayer tree so its (unstacked) params don't shadow the
         # stacked ones below
         object.__setattr__(self, "_template", experts[0])
-        # stack per-expert params into [E, ...] leaves owned by this layer
+        # stack per-expert params into [E, ...] leaves owned by this layer;
+        # each stacked leaf's spec is the ep axis prepended to the
+        # template's own spec, so internally-sharded experts (e.g. the
+        # mp-split ExpertFFN) compose as P(ep, <expert's own sharding>)
+        from .sharding_utils import get_param_specs
+        tspecs = get_param_specs(experts[0])
         names = [n for n, _ in experts[0].named_parameters()]
         for name in names:
             leaves = [dict(e.named_parameters())[name] for e in experts]
             stacked = jnp.stack(leaves, axis=0)
             pname = "stacked__" + name.replace(".", "__")
             self._parameters[pname] = stacked
-            spec = P(self._axis, *([None] * leaves[0].ndim))
+            inner = tuple(tspecs.get(name, P()))
+            inner = inner + (None,) * (leaves[0].ndim - len(inner))
+            spec = P(self._axis, *inner)
             set_param_spec(self, pname, spec)
         self._param_names = names
 
@@ -412,6 +442,33 @@ class ExpertStack(Layer):
             return out
 
         return jax.vmap(one, in_axes=(0, 0))(stacked, x)
+
+
+def _apply_ffn_mp_specs(layer, mp_axis: str) -> None:
+    """The Megatron column->row split for the standard FFN expert: w0
+    column-parallel, w1 row-parallel, biases following their outputs.
+    Single definition — ExpertFFN(mp_group=...) and
+    MoELayer(mp_group=...) must produce byte-identical shardings."""
+    set_param_spec(layer, "w0", P(None, mp_axis))
+    set_param_spec(layer, "b0", P(mp_axis))
+    set_param_spec(layer, "w1", P(mp_axis, None))
+    set_param_spec(layer, "b1", P())
+
+
+def _moe_mp_axis(mp_group) -> Optional[str]:
+    """Mesh axis for expert-internal tensor parallelism.  Explicit group ->
+    its axis name; True -> the canonical "mp" axis; None/False -> off
+    (callers wire hcg.get_model_parallel_group() explicitly, mirroring the
+    reference MoELayer(mp_group=fleet mp group) call sites)."""
+    if mp_group is None or mp_group is False:
+        return None
+    if mp_group is True:
+        return "mp"
+    if hasattr(mp_group, "name"):
+        return mp_group.name
+    if isinstance(mp_group, str):
+        return mp_group
+    return None
 
 
 def _ep_axis(moe_group) -> Optional[str]:

@@ -82,10 +82,51 @@ def _apply_x_spec(mesh, xs, x_spec):
         is_leaf=lambda v: v is None or isinstance(v, P))
 
 
+def _manual_boundary_specs(x_microbatches, x_spec, extra_manual_axes):
+    """(in_x_spec, out_specs) for the pipeline shard_map.
+
+    With only pp manual, activations enter/leave with P() specs and the
+    auto axes ride GSPMD.  When the stage body itself runs collectives
+    over another axis (ring/Ulysses context parallelism over ``sep``),
+    that axis must ALSO be manual in the same shard_map — a nested
+    shard_map binding sep under the pp one is rejected by the sdy
+    lowering ("axis pp already bound").  The activation specs then keep
+    exactly the extra manual axes' components (sep on the seq dim) and
+    drop the auto ones, since manual in/out_specs may only name manual
+    axes."""
+    if not extra_manual_axes:
+        return jax.tree.map(lambda _: P(), x_microbatches), P("pp")
+    if x_spec is None:
+        raise ValueError("extra_manual_axes requires x_spec so the "
+                         "boundary knows which dims ride the manual axes")
+    extra = set(extra_manual_axes)
+
+    def restrict(spec):
+        if spec is None:
+            return P()
+        out = []
+        for e in tuple(spec):
+            if isinstance(e, tuple):
+                kept = tuple(a for a in e if a in extra)
+                out.append(kept if kept else None)
+            else:
+                out.append(e if e in extra else None)
+        return P(*out)
+
+    is_leaf = lambda v: v is None or isinstance(v, P)
+    in_x = jax.tree.map(restrict, x_spec, is_leaf=is_leaf)
+    # per-leaf out rank is [pp(S), T, <leaf dims after M>]: pp on dim 0,
+    # ticks unsharded, then the restricted per-microbatch tail
+    outs = jax.tree.map(lambda s: P("pp", None, *tuple(restrict(s))[1:]),
+                        x_spec, is_leaf=is_leaf)
+    return in_x, outs
+
+
 def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
                    mesh: Mesh, n_stages: int, extra_args=(),
                    remat: bool = True, x_spec: Optional[P] = None,
-                   param_inner_specs: Optional[dict] = None):
+                   param_inner_specs: Optional[dict] = None,
+                   extra_manual_axes=frozenset()):
     """Run ``stage_fn(params_for_stage, x) -> y`` as an S-stage pipeline.
 
     x_microbatches: [M, mb, ...] microbatched input to stage 0 (activations
@@ -132,7 +173,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     # None; the auto axes' sharding (mp/dp/...) rides on the arrays and is
     # still handled by GSPMD inside the body.
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
-    in_x_spec = jax.tree.map(lambda _: P(), x_microbatches)
+    in_x_spec, out_specs = _manual_boundary_specs(
+        x_microbatches, x_spec, extra_manual_axes)
 
     def pipelined(params, xs):
         # inside shard_map over pp each device holds its stage's slice of the
@@ -168,14 +210,15 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         # [1, T, mb, ...] local -> [S, T, ...] stacked over pp
         return jax.tree.map(lambda a: a[None], outs)
 
-    # axis_names={"pp"}: only pp is manual; tp/dp/sp axes stay automatic so
+    # axis_names={"pp"} (+ any extra manual axes the body's collectives
+    # need, e.g. sep for ring attention): other axes stay automatic so
     # GSPMD keeps partitioning the math inside the stage body
     fn = shard_map(
         pipelined, mesh=mesh,
         in_specs=(param_specs, in_x_spec),
-        out_specs=P("pp"),
+        out_specs=out_specs,
         check_vma=False,
-        axis_names={"pp"})
+        axis_names={"pp"} | set(extra_manual_axes))
     res = fn(stacked_params, x_microbatches)      # [S, T, mb, ...]
     # valid outputs at ticks S-1 .. T-1 are microbatches 0..M-1
     return jax.tree.map(
@@ -205,7 +248,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
                                n_chunks: int, extra_args=(),
                                remat: bool = True,
                                x_spec: Optional[P] = None,
-                               param_inner_specs: Optional[dict] = None):
+                               param_inner_specs: Optional[dict] = None,
+                               extra_manual_axes=frozenset()):
     """Interleaved (VPP) schedule: S devices × V chunks per device
     (reference: meta_parallel/pipeline_parallel.py —
     PipelineParallelWithInterleave; SURVEY.md §2.3 PP row).
@@ -251,7 +295,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
             if k in param_inner_specs else v
             for k, v in stacked_params.items()}
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
-    in_x_spec = jax.tree.map(lambda _: P(), x_microbatches)
+    in_x_spec, out_specs = _manual_boundary_specs(
+        x_microbatches, x_spec, extra_manual_axes)
 
     def pipelined(params, xs):
         # local leaves: [V, ...] — this device's chunks, local index v
@@ -293,9 +338,9 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     fn = shard_map(
         pipelined, mesh=mesh,
         in_specs=(param_specs, in_x_spec),
-        out_specs=P("pp"),
+        out_specs=out_specs,
         check_vma=False,
-        axis_names={"pp"})
+        axis_names={"pp"} | set(extra_manual_axes))
     res = fn(stacked_params, x_microbatches)        # [S, T, mb, ...]
     # microbatch m finishes at tick (m//S)*S*V + (V-1)*S + m%S + S-1
     import numpy as _np

@@ -54,6 +54,9 @@ class GPTMoEConfig:
     dtype: str = "float32"
     # ParallelAxis / mesh-axis name for expert parallelism (EP)
     moe_group: Optional[object] = None
+    # expert-internal tensor parallelism (reference: MoELayer(mp_group)):
+    # True -> the canonical "mp" mesh axis; or a group/axis name
+    mp_group: Optional[object] = None
 
     @property
     def head_dim(self):
@@ -82,6 +85,7 @@ class _MoEBlock(Layer):
             gate_cfg.update(cfg.gate_kwargs or {})
             self.ffn = MoELayer(h, experts, gate=gate_cfg,
                                 moe_group=cfg.moe_group,
+                                mp_group=cfg.mp_group,
                                 capacity_factor=cfg.capacity_factor)
         else:
             self.fc_in = Linear(h, cfg.ffn_size)
@@ -111,8 +115,18 @@ class GPTMoEForCausalLM(Layer):
     def __init__(self, cfg: GPTMoEConfig):
         super().__init__()
         self.cfg = cfg
-        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
-        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size)
+        # GPT-2 init convention (std 0.02), matching the dense GPT's
+        # VocabParallelEmbedding: the default N(0,1) embedding init with
+        # the TIED head blows the logit scale to sqrt(h) at step 0 (the
+        # r3 dryrun's MoE leg loss of 41 vs the dense leg's 5.6 was
+        # exactly ln V + sigma^2/2 with sigma ~ 8)
+        from ..nn.layer import ParamAttr
+        from ..nn import initializer as I
+        emb_init = lambda: ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size,
+                             weight_attr=emb_init())
+        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size,
+                             weight_attr=emb_init())
         self.h = LayerList([
             _MoEBlock(cfg, use_moe=(i % cfg.moe_every == cfg.moe_every - 1))
             for i in range(cfg.num_layers)])

@@ -250,7 +250,17 @@ class GPTHybridTrainer:
     # thread the gate aux loss through the schedule) --------------------
     def _pack_microbatches(self, mb):
         """[M, mb, s, h] hidden -> (activation pytree, x_spec pytree)."""
-        return mb, P(None, self.batch_spec()[0])
+        seq_axis = "sep" if getattr(self.cfg, "cp", False) else None
+        return mb, P(None, self.batch_spec()[0], seq_axis, None)
+
+    def _pipeline_manual_axes(self):
+        """Extra manual axes the pipeline shard_map must bind: the stage
+        body runs ring/Ulysses collectives over sep when context
+        parallelism is on (nested shard_map under pp is illegal)."""
+        if getattr(self.cfg, "cp", False) and \
+                self.hcg.get_sep_parallel_world_size() > 1:
+            return frozenset({"sep"})
+        return frozenset()
 
     def _unpack_pipeline_output(self, out):
         """activation pytree -> ([M, mb, s, h] hidden, extra loss term)."""
@@ -279,11 +289,13 @@ class GPTHybridTrainer:
                 out = pipeline_apply_interleaved(
                     self._body, pblk, mb, self.mesh, self.S, self.V,
                     remat=cfg.remat, x_spec=x_spec,
-                    param_inner_specs=self.specs_blocks)
+                    param_inner_specs=self.specs_blocks,
+                    extra_manual_axes=self._pipeline_manual_axes())
             else:
                 out = pipeline_apply(self._body, pblk, mb, self.mesh, self.S,
                                      remat=cfg.remat, x_spec=x_spec,
-                                     param_inner_specs=self.specs_blocks)
+                                     param_inner_specs=self.specs_blocks,
+                                     extra_manual_axes=self._pipeline_manual_axes())
             hidden, extra = self._unpack_pipeline_output(out)
             x = hidden.reshape(b, s, h)
         else:
@@ -387,6 +399,12 @@ class GPTMoEHybridTrainer(GPTHybridTrainer):
                 "cfg.moe_every = 1 (every block MoE) — the fused pipeline "
                 "schedule requires structurally identical stages, like the "
                 "reference PipelineLayer's uniform segmentation")
+        # ep x mp composition: with a model-parallel degree in the fleet
+        # config, experts default to internal tensor parallelism over the
+        # mp axis (reference: the fleet call site passes
+        # hcg.get_model_parallel_group() into MoELayer(mp_group))
+        if cfg.mp_group is None and hcg.get_model_parallel_world_size() > 1:
+            cfg.mp_group = "mp"
         super().__init__(cfg, hcg, optimizer, microbatches=microbatches,
                          zero_stage=zero_stage, vpp=vpp)
 

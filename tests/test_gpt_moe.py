@@ -233,3 +233,64 @@ def test_moe_trainer_requires_uniform_blocks():
             GPTMoEHybridTrainer(cfg, hcg, opt.SGD(learning_rate=0.1))
     finally:
         _teardown_hcg()
+
+def test_ep_mp_parity():
+    """ep x mp in ONE mesh (round-3 VERDICT item 5): experts shard over ep
+    with weights additionally split over mp (expert-internal tensor
+    parallelism — reference: MoELayer(mp_group) alongside the moe group);
+    dp x ep x mp == serial loss over two steps."""
+    tr1 = _mk_moe_trainer({"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                           "sharding_degree": 1, "ep_degree": 1},
+                          microbatches=1)
+    st1 = tr1.init_state()
+    x, y = tr1.make_batch(batch=4, seq=16, seed=21)
+    st1, loss1 = tr1.train_step(st1, x, y)
+    st1, loss1b = tr1.train_step(st1, x, y)
+    _teardown_hcg()
+
+    tr2 = _mk_moe_trainer({"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                           "sharding_degree": 1, "ep_degree": 2},
+                          microbatches=1)
+    assert tr2.cfg.mp_group == "mp"      # trainer wired the mp group in
+    st2 = tr2.init_state()
+    x2, y2 = tr2.make_batch(batch=4, seq=16, seed=21)
+    st2, loss2 = tr2.train_step(st2, x2, y2)
+    st2, loss2b = tr2.train_step(st2, x2, y2)
+    _teardown_hcg()
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=2e-4)
+    np.testing.assert_allclose(float(loss1b), float(loss2b), rtol=2e-3)
+
+
+def test_ep_mp_expert_params_shard_over_both_axes():
+    """Stacked expert weight bytes per device shrink by ep x mp: the
+    stacked w0 leaf carries P('ep', None, 'mp') — no device holds a full
+    expert bank NOR a full expert's weight."""
+    tr = _mk_moe_trainer({"dp_degree": 1, "mp_degree": 2, "pp_degree": 1,
+                          "sharding_degree": 1, "ep_degree": 4},
+                         microbatches=1)
+    _, pblk, _, _ = tr.init_state()
+    key = next(k for k in pblk if k.endswith("stacked__w0"))
+    arr = pblk[key]
+    total = arr.size * arr.dtype.itemsize
+    shard = arr.addressable_shards[0].data
+    per_dev = shard.size * shard.dtype.itemsize
+    # experts over ep(4) x inner columns over mp(2) -> each device holds 1/8
+    assert per_dev * 8 == total, (key, per_dev, total)
+    _teardown_hcg()
+
+
+def test_expert_stack_inherits_template_specs():
+    """ExpertStack prepends the ep axis to each expert param's OWN spec —
+    the composition seam that makes any internally-sharded expert
+    (not just ExpertFFN) ride ep x mp."""
+    from paddle_tpu.distributed.moe import ExpertFFN, ExpertStack
+    from paddle_tpu.distributed.sharding_utils import get_param_specs
+    paddle_tpu.seed(0)
+    experts = [ExpertFFN(8, 16, mp_group="mp") for _ in range(2)]
+    stack = ExpertStack(experts, moe_group="ep")
+    specs = get_param_specs(stack)
+    assert tuple(specs["stacked__w0"]) == ("ep", None, "mp")
+    assert tuple(specs["stacked__w1"]) == ("ep", "mp", None)
+    assert tuple(specs["stacked__b0"]) == ("ep", "mp")
+    assert tuple(specs["stacked__b1"]) == ("ep", None)
