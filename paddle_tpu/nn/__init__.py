@@ -11,3 +11,8 @@ from . import quant  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import (  # noqa: F401
     container, common, conv, norm, pooling, activation, loss, transformer)
+
+# gradient-clip classes at their reference location (python/paddle/nn/
+# clip.py re-exports them; optimizer(grad_clip=...) is the use site)
+from ..optimizer.clip import (  # noqa: F401,E402
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)

@@ -15,6 +15,8 @@ from .logic import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
+from .inplace import *  # noqa: F401,F403
+from .to_string import set_printoptions, get_printoptions  # noqa: F401
 
 from . import (creation, math, manipulation, linalg, search, logic,  # noqa: F401
                random, stat)

@@ -136,3 +136,76 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     from ..nn import initializer as I
     init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
     return init(shape, dtype=dtype)
+
+
+def block_diag(inputs, name=None):
+    """Reference: python/paddle/tensor/creation.py — block_diag.  Stacks
+    2-D (or promotable) tensors into a block-diagonal matrix."""
+    mats = [jnp.atleast_2d(jnp.asarray(m)) for m in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), dtype=jnp.result_type(*mats))
+    r = c = 0
+    for m in mats:
+        out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype), (r, c))
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Reference: Tensor.fill_diagonal_ — functional here (returns the
+    filled array; jax arrays are immutable, same convention as add_)."""
+    x = jnp.asarray(x)
+    if x.ndim == 2:
+        n, m = x.shape
+        i = jnp.arange(n)
+        j = i + offset
+        if wrap and n > m:
+            # torch/paddle wrap semantics: the diagonal restarts every
+            # m+1 rows in tall matrices
+            j = (i + offset) % (m + 1)
+            valid = j < m
+        else:
+            valid = (j >= 0) & (j < m) & (i < n)
+        ii = jnp.clip(i, 0, n - 1)
+        jj = jnp.clip(j, 0, m - 1)
+        upd = jnp.where(valid, jnp.asarray(value, x.dtype), x[ii, jj])
+        return x.at[ii, jj].set(upd)
+    idx = jnp.arange(min(x.shape))
+    return x.at[tuple([idx] * x.ndim)].set(jnp.asarray(value, x.dtype))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Reference: paddle.fill_diagonal_tensor — write y along the
+    (dim1, dim2) diagonal of x."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y, x.dtype)
+    xm = jnp.moveaxis(x, (dim1, dim2), (-2, -1))
+    n, m = xm.shape[-2], xm.shape[-1]
+    k = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+    i = jnp.arange(k) + max(-offset, 0)
+    j = jnp.arange(k) + max(offset, 0)
+    # y's layout is batch-dims-then-diag (x.shape minus dim1/dim2, with
+    # the diagonal length appended) — exactly the [..., k] the advanced
+    # index slot takes, no axis shuffle needed (review r4: a moveaxis
+    # here crashed every batched call)
+    xm = xm.at[..., i, j].set(y)
+    return jnp.moveaxis(xm, (-2, -1), (dim1, dim2))
+
+
+fill_diagonal_tensor_ = fill_diagonal_tensor
+
+
+def zero_(x, name=None):
+    """Reference: Tensor.zero_ (functional; see add_)."""
+    return jnp.zeros_like(x)
+
+
+def fill_(x, value, name=None):
+    """Reference: Tensor.fill_ (functional; see add_)."""
+    return jnp.full_like(x, value)
+
+
+__all__ += ["block_diag", "fill_diagonal_", "fill_diagonal_tensor",
+            "fill_diagonal_tensor_", "zero_", "fill_"]

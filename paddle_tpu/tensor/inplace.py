@@ -1,0 +1,73 @@
+"""The ``op_`` inplace-named surface.
+
+Reference: the paddle.Tensor inplace API family (python/paddle/tensor/ —
+every ``<op>_`` listed in the inplace-APIs doc table).  jax arrays are
+immutable, so each alias RETURNS the result instead of mutating; callers
+write ``x = x.clip_(0, 1)``-style reassignment (the documented deviation,
+established at tensor/math.py — add_).  Keeping the full alias set means
+ported reference code resolves every inplace name.
+
+Aliases are generated from the out-of-place ops so the two surfaces can
+never drift; ops with no out-of-place base (uniform_ & co.) live in
+random.py / creation.py with real sampling implementations.
+"""
+
+from __future__ import annotations
+
+from . import creation, linalg, logic, manipulation, search, stat
+from . import math as _math
+from . import random as _random
+
+__all__ = []
+
+# every name maps to the identically-named out-of-place op
+_ALIASED = [
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "cast",
+    "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "erf", "erfinv", "exp", "expm1", "floor",
+    "floor_divide", "gcd", "lcm", "greater_equal", "greater_than", "i0",
+    "index_add", "index_fill", "index_put", "ldexp", "lerp", "less_equal",
+    "less_than", "lgamma", "log", "log10", "log1p", "log2", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "logit", "masked_fill",
+    "masked_scatter", "mod", "multigammaln", "neg", "not_equal", "pow",
+    "put_along_axis", "reciprocal", "remainder", "renorm", "reshape",
+    "round", "rsqrt", "scale", "scatter", "sin", "sinh",
+    "sqrt", "squeeze", "subtract", "tan", "tanh", "tril", "triu",
+    "trunc", "unsqueeze",
+]
+
+_MODULES = (creation, linalg, logic, manipulation, _math, _random, search,
+            stat)
+
+
+def _resolve(name):
+    for mod in _MODULES:
+        fn = getattr(mod, name, None)
+        if fn is not None:
+            return fn
+    return None
+
+
+_missing = []
+for _name in _ALIASED:
+    _fn = _resolve(_name)
+    if _fn is None:
+        _missing.append(_name)
+        continue
+    _alias = _name + "_"
+    globals()[_alias] = _fn
+    __all__.append(_alias)
+
+# a silent hole here would quietly shrink the surface on refactors
+assert not _missing, f"inplace aliases lost their base ops: {_missing}"
+
+
+def sigmoid_(x, name=None):
+    """Reference: Tensor.sigmoid_ (the out-of-place op lives on the nn
+    functional surface, which this package must not import — cycle)."""
+    import jax
+    return jax.nn.sigmoid(x)
+
+
+__all__.append("sigmoid_")

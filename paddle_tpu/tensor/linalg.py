@@ -276,3 +276,51 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
 
 
 __all__ += ["matrix_exp", "lu_unpack", "ormqr", "svd_lowrank"]
+
+
+def cdist(x, y, p=2.0,
+          compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Reference: python/paddle/tensor/linalg.py — cdist.  Pairwise
+    p-norm distance between row batches x [..., P, M] and y [..., R, M].
+
+    The euclidean fast path uses the gram-matrix form (one batched matmul
+    — the MXU path) exactly like the reference's use_mm_for_euclid_dist
+    mode; other p fall back to the broadcast form."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    P_, R_ = x.shape[-2], y.shape[-2]
+    use_mm = (compute_mode == "use_mm_for_euclid_dist"
+              or (compute_mode == "use_mm_for_euclid_dist_if_necessary"
+                  and (P_ > 25 or R_ > 25)))  # the reference's cutoff
+    if p == 2.0 and use_mm:
+        x2 = jnp.sum(x * x, axis=-1, keepdims=True)           # [..., P, 1]
+        y2 = jnp.sum(y * y, axis=-1, keepdims=True)           # [..., R, 1]
+        gram = jnp.matmul(x, jnp.swapaxes(y, -2, -1))         # [..., P, R]
+        sq = x2 - 2.0 * gram + jnp.swapaxes(y2, -2, -1)
+        return jnp.sqrt(jnp.maximum(sq, 0.0))
+    if p == 2.0:
+        diff = x[..., :, None, :] - y[..., None, :, :]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == float("inf"):
+        return jnp.max(diff, axis=-1)
+    if p == 0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Reference: paddle.linalg.vecdot — batched vector dot product."""
+    return jnp.sum(jnp.asarray(x) * jnp.asarray(y), axis=axis)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Reference: paddle.linalg.cholesky_inverse — inverse of A from its
+    Cholesky factor: A^-1 with A = L L^T (or U^T U)."""
+    from jax.scipy.linalg import cho_solve
+    x = jnp.asarray(x)
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    return cho_solve((x, not upper), eye)
+
+
+__all__ += ["cdist", "vecdot", "cholesky_inverse"]

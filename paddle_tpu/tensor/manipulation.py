@@ -505,3 +505,11 @@ def index_fill(x, index, axis, value, name=None):
 
 __all__ += ["cat", "column_stack", "fliplr", "flipud", "permute",
             "unflatten", "unfold", "as_strided", "diag_embed", "index_fill"]
+
+
+def row_stack(x, name=None):
+    """Reference: paddle.row_stack — alias of vstack."""
+    return vstack(x, name=name)
+
+
+__all__ += ["row_stack"]

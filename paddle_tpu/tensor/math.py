@@ -708,3 +708,32 @@ def tolist(x, name=None):
 __all__ += ["add_n", "floor_mod", "mm", "sinc", "multigammaln", "gammainc",
             "gammaincc", "trapezoid", "cumulative_trapezoid", "pdist",
             "polar", "tensordot", "isneginf", "isposinf", "tolist"]
+
+
+def positive(x, name=None):
+    """Reference: paddle.positive — identity on numeric tensors, error on
+    bool (matching the reference's dtype check)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        raise TypeError("positive does not support bool tensors")
+    return x
+
+
+def erfc(x, name=None):
+    """Reference: paddle.erfc — complementary error function."""
+    from jax.scipy.special import erfc as _erfc
+    return _erfc(jnp.asarray(x))
+
+
+erfc_ = erfc
+
+
+def bitwise_invert(x, name=None):
+    """Reference: paddle.bitwise_invert — alias of bitwise_not."""
+    return bitwise_not(x)
+
+
+bitwise_invert_ = bitwise_invert
+
+__all__ += ["positive", "erfc", "erfc_", "bitwise_invert",
+            "bitwise_invert_"]

@@ -100,3 +100,42 @@ def geometric_(x, probs, name=None):
 
 
 __all__ += ["geometric_"]
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """Reference: Tensor.uniform_ — functional (returns the sampled array;
+    see exponential_)."""
+    return jax.random.uniform(next_rng_key(), jnp.shape(x),
+                              _float_dtype(x), minval=min, maxval=max)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Reference: Tensor.normal_ (functional; see exponential_)."""
+    return mean + std * jax.random.normal(next_rng_key(), jnp.shape(x),
+                                          _float_dtype(x))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Reference: Tensor.cauchy_ (functional; see exponential_)."""
+    return loc + scale * jax.random.cauchy(next_rng_key(), jnp.shape(x),
+                                           _float_dtype(x))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Reference: Tensor.log_normal_ (functional; see exponential_)."""
+    return jnp.exp(mean + std * jax.random.normal(
+        next_rng_key(), jnp.shape(x), _float_dtype(x)))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """Reference: Tensor.bernoulli_ (functional; see exponential_)."""
+    return jax.random.bernoulli(next_rng_key(), p, jnp.shape(x)).astype(
+        _float_dtype(x))
+
+
+def _float_dtype(x):
+    dt = jnp.asarray(x).dtype
+    return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
+
+
+__all__ += ["uniform_", "normal_", "cauchy_", "log_normal_", "bernoulli_"]

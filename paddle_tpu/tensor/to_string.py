@@ -1,0 +1,40 @@
+"""Tensor printing options.
+
+Reference: python/paddle/tensor/to_string.py — set_printoptions /
+get_printoptions.  jax arrays print through numpy's formatter, so the
+options map onto numpy's printoptions process-wide (the same global-state
+semantics the reference has).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["set_printoptions", "get_printoptions"]
+
+_DEFAULTS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+             "linewidth": 80, "sci_mode": False}
+_OPTIONS = dict(_DEFAULTS)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: paddle.set_printoptions.  ``None`` keeps the current
+    value (paddle semantics, unlike numpy's reset-to-default)."""
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("sci_mode", sci_mode),
+                 ("linewidth", linewidth)):
+        if v is not None:
+            _OPTIONS[k] = v
+    np.set_printoptions(
+        precision=_OPTIONS["precision"],
+        threshold=_OPTIONS["threshold"],
+        edgeitems=_OPTIONS["edgeitems"],
+        linewidth=_OPTIONS["linewidth"],
+        suppress=not _OPTIONS["sci_mode"],
+        floatmode="fixed" if _OPTIONS["sci_mode"] is False else "maxprec")
+
+
+def get_printoptions():
+    """Current print options as a dict (paddle parity helper)."""
+    return dict(_OPTIONS)

@@ -1,0 +1,164 @@
+"""Long-tail tensor APIs added in round 4 (VERDICT r3 item 6: close the
+found coverage gaps and test them — torch oracles where torch has the
+same op, hand oracles elsewhere)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def test_block_diag_matches_torch():
+    a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(1, 4).astype(np.float32)
+    c = np.random.RandomState(2).randn(3, 2).astype(np.float32)
+    mine = np.asarray(paddle.block_diag(
+        [jnp.asarray(a), jnp.asarray(b), jnp.asarray(c)]))
+    ref = torch.block_diag(torch.tensor(a), torch.tensor(b),
+                           torch.tensor(c)).numpy()
+    np.testing.assert_allclose(mine, ref)
+
+
+@pytest.mark.parametrize("p", [2.0, 1.0, float("inf"), 0.5])
+def test_cdist_matches_torch(p):
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 6).astype(np.float32)
+    y = rs.randn(5, 6).astype(np.float32)
+    mine = np.asarray(paddle.cdist(jnp.asarray(x), jnp.asarray(y), p=p))
+    ref = torch.cdist(torch.tensor(x), torch.tensor(y), p=p).numpy()
+    np.testing.assert_allclose(mine, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_cdist_batched_mm_path():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 30, 8).astype(np.float32)   # >25 rows: gram path
+    y = rs.randn(2, 40, 8).astype(np.float32)
+    mine = np.asarray(paddle.cdist(jnp.asarray(x), jnp.asarray(y)))
+    ref = torch.cdist(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_fill_diagonal_basic_and_offsetless_wide():
+    fd = np.asarray(paddle.fill_diagonal_(jnp.zeros((3, 5)), 7.0))
+    ref = torch.zeros(3, 5)
+    ref.fill_diagonal_(7.0)
+    np.testing.assert_allclose(fd, ref.numpy())
+
+
+def test_fill_diagonal_wrap_tall():
+    fd = np.asarray(paddle.fill_diagonal_(jnp.zeros((7, 3)), 1.0,
+                                          wrap=True))
+    ref = torch.zeros(7, 3)
+    ref.fill_diagonal_(1.0, wrap=True)
+    np.testing.assert_allclose(fd, ref.numpy())
+
+
+def test_fill_diagonal_tensor_2d():
+    y = jnp.arange(3.0)
+    out = np.asarray(paddle.fill_diagonal_tensor(jnp.zeros((3, 4)), y))
+    assert out[0, 0] == 0 and out[1, 1] == 1 and out[2, 2] == 2
+    assert out.sum() == 3.0
+
+
+def test_fill_diagonal_tensor_batched():
+    """Batched layout: y = x.shape minus (dim1, dim2) plus diag length
+    (review r4: the first cut crashed on every batched call)."""
+    x = jnp.zeros((2, 3, 4))
+    y = jnp.asarray(np.arange(6.0).reshape(2, 3))
+    out = np.asarray(paddle.fill_diagonal_tensor(x, y, dim1=1, dim2=2))
+    for b in range(2):
+        for i in range(3):
+            assert out[b, i, i] == b * 3 + i
+    assert out.sum() == 15.0
+
+
+def test_cholesky_inverse():
+    rs = np.random.RandomState(2)
+    A = rs.randn(4, 4)
+    A = A @ A.T + 4 * np.eye(4)
+    L = np.linalg.cholesky(A)
+    inv = np.asarray(paddle.tensor.linalg.cholesky_inverse(jnp.asarray(L)))
+    np.testing.assert_allclose(inv, np.linalg.inv(A), rtol=1e-6, atol=1e-6)
+    U = L.T
+    inv_u = np.asarray(paddle.tensor.linalg.cholesky_inverse(
+        jnp.asarray(U), upper=True))
+    np.testing.assert_allclose(inv_u, np.linalg.inv(A), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_vecdot():
+    v = paddle.tensor.linalg.vecdot(jnp.ones((2, 3)), 2 * jnp.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(v), [6.0, 6.0])
+
+
+def test_positive_and_bool_error():
+    assert float(paddle.positive(jnp.asarray(-2.5))) == -2.5
+    with pytest.raises(TypeError):
+        paddle.positive(jnp.asarray([True]))
+
+
+def test_erfc():
+    x = jnp.asarray([0.0, 0.5, -1.0])
+    np.testing.assert_allclose(
+        np.asarray(paddle.erfc(x)),
+        torch.special.erfc(torch.tensor([0.0, 0.5, -1.0])).numpy(),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_bitwise_invert():
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_invert(jnp.asarray([0, 5], jnp.int32))),
+        [-1, -6])
+
+
+def test_printoptions_roundtrip():
+    old = paddle.get_printoptions()
+    try:
+        paddle.set_printoptions(precision=3, threshold=10)
+        got = paddle.get_printoptions()
+        assert got["precision"] == 3 and got["threshold"] == 10
+        # None keeps current values (paddle semantics)
+        paddle.set_printoptions(edgeitems=2)
+        assert paddle.get_printoptions()["precision"] == 3
+    finally:
+        paddle.set_printoptions(**old)
+
+
+def test_inplace_alias_surface():
+    """Every generated alias resolves and computes the out-of-place op."""
+    import paddle_tpu.tensor.inplace as ip
+    assert len(ip.__all__) >= 70
+    x = jnp.asarray([4.0])
+    assert float(paddle.sqrt_(x)[0]) == 2.0
+    assert float(paddle.rsqrt_(x)[0]) == 0.5
+    assert float(paddle.clip_(jnp.asarray([5.0]), 0.0, 1.0)[0]) == 1.0
+    np.testing.assert_allclose(np.asarray(paddle.triu_(jnp.ones((2, 2)))),
+                               [[1, 1], [0, 1]])
+    assert float(paddle.scale_(jnp.asarray([2.0]), scale=3.0)[0]) == 6.0
+    assert float(paddle.sigmoid_(jnp.asarray(0.0))) == 0.5
+
+
+def test_inplace_random_family():
+    paddle.seed(0)
+    u = paddle.uniform_(jnp.zeros((200,)), min=2.0, max=3.0)
+    assert float(u.min()) >= 2.0 and float(u.max()) <= 3.0
+    n = paddle.normal_(jnp.zeros((2000,)), mean=5.0, std=0.1)
+    assert 4.9 < float(n.mean()) < 5.1
+    b = paddle.bernoulli_(jnp.zeros((10,)), p=1.0)
+    assert float(b.sum()) == 10.0
+    c = paddle.cauchy_(jnp.zeros((100,)))
+    assert np.isfinite(np.asarray(c)).all()
+    ln = paddle.log_normal_(jnp.zeros((100,)))
+    assert float(ln.min()) > 0.0
+    z = paddle.zero_(jnp.ones((3,)))
+    assert float(z.sum()) == 0.0
+    f = paddle.fill_(jnp.zeros((3,)), 2.5)
+    assert float(f.sum()) == 7.5
+
+
+def test_row_stack_alias():
+    out = paddle.row_stack([jnp.ones((2,)), jnp.zeros((2,))])
+    assert out.shape == (2, 2)
