@@ -46,12 +46,21 @@ Supported subset (documented; the reference converts a larger one):
     function tail returns the carried value) — requires the function's
     last statement to be a return so every path binds the value;
 
+  * ``for x in <jax array>`` (Name target, no else/return): runtime-
+    dispatched to an index-driven while — ONE traced loop body via
+    ``lax.while_loop`` instead of shape[0] unrolled copies, with
+    ``break``/``continue`` riding the same flag rewriting; non-array
+    iterables keep the plain Python for (tracing unrolls them);
+  * ``try``/``except``/``finally`` passes through as Python — correct
+    under tracing (trace-time exceptions follow Python semantics; traced
+    ops never raise data-dependent exceptions at run time, the standard
+    JAX contract), and converted constructs inside try bodies still
+    convert (the return/jump flag rewrites descend into Try blocks).
+
 NOT converted — left as plain Python, which stays correct for concrete
 values and raises a clear error if the predicate is traced:
   * ``return`` inside a ``for`` body (the iterator epilogue interleaves
-    badly with return guards) or in a function without a tail return;
-  * ``for x in <tensor>`` needs no conversion (static trip count —
-    tracing unrolls it).
+    badly with return guards) or in a function without a tail return.
 
 Functions whose source is unavailable (C extensions, REPL) pass through
 unconverted — tracing alone already handles tensor-free control flow.
@@ -318,12 +327,36 @@ def range_cond(i, stop, step):
     return i < stop if step > 0 else i > stop
 
 
+def is_tensor_seq(x) -> bool:
+    """True for jax arrays/tracers with a leading dim — the for-over-
+    tensor path (reference: convert_operators.py — the Iterable branch of
+    for conversion).  Python sequences, numpy arrays and generators stay
+    on the plain-Python for (tracing unrolls them)."""
+    return isinstance(x, jax.Array) and getattr(x, "ndim", 0) >= 1
+
+
+def seq_len(x) -> int:
+    return x.shape[0]
+
+
+def tensor_loop_start():
+    return jnp.asarray(0, jnp.int32)
+
+
+def tensor_index(seq, i):
+    """seq[i] with a (possibly traced) index, keepdims dropped — the
+    loop-body element read of the converted for-over-tensor."""
+    return jax.lax.dynamic_index_in_dim(seq, i, 0, keepdims=False)
+
+
 _JST = types.SimpleNamespace(
     convert_if=convert_if, convert_while=convert_while,
     convert_and=convert_and, convert_or=convert_or, convert_not=convert_not,
     convert_ifexp=convert_ifexp, convert_assert=convert_assert,
     convert_print=convert_print,
-    py_only=py_only, range_cond=range_cond, Undefined=_Undefined)
+    py_only=py_only, range_cond=range_cond, Undefined=_Undefined,
+    is_tensor_seq=is_tensor_seq, seq_len=seq_len,
+    tensor_loop_start=tensor_loop_start, tensor_index=tensor_index)
 
 
 # ---------------------------------------------------------------------------
@@ -904,6 +937,10 @@ class _Transformer(ast.NodeTransformer):
                     and isinstance(node.target, ast.Name))
         if not is_range or node.orelse or \
                 _has_stmt(node.body, ast.Return):
+            if (not is_range and isinstance(node.target, ast.Name)
+                    and not node.orelse
+                    and not _has_stmt(node.body, ast.Return)):
+                return self._convert_for_iter(node)
             # plain python (tracing unrolls static iterables)
             self.generic_visit(node)
             return node
@@ -950,6 +987,76 @@ class _Transformer(ast.NodeTransformer):
         converted = self._convert_while_node(w)
         return init + jump_init + (converted if isinstance(converted, list)
                                    else [converted])
+
+    # -- For over a tensor ----------------------------------------------
+    def _convert_for_iter(self, node: ast.For):
+        """``for x in <expr>`` with a Name target: runtime-dispatched.
+        A jax array/tracer iterates as an index-driven while (ONE traced
+        loop body instead of shape[0] unrolled copies — the reference's
+        for-over-tensor conversion); any other iterable keeps the plain
+        Python for.  Both paths share the original body (deep-copied for
+        the tensor branch since conversion mutates the AST)."""
+        import copy
+        seqv = self._name("seq")
+        # the index/stop are REAL loop-carried vars (like the jump flags):
+        # a _GEN prefix would hide them from _assigned_names and the
+        # not-break epilogue's if would see "no local assignments"
+        self.counter += 1
+        idxv = f"_jstidx_{self.counter}"
+        stopv = f"_jststop_{self.counter}"
+        xname = node.target.id
+        body_tensor = copy.deepcopy(node.body)
+        self.func_assigned.update({seqv, idxv, stopv, xname})
+
+        assign_seq = ast.Assign(
+            targets=[ast.Name(id=seqv, ctx=ast.Store())], value=node.iter)
+        t_init = [
+            ast.Assign(targets=[ast.Name(id=idxv, ctx=ast.Store())],
+                       value=ast.Call(func=self._jst("tensor_loop_start"),
+                                      args=[], keywords=[])),
+            ast.Assign(targets=[ast.Name(id=stopv, ctx=ast.Store())],
+                       value=ast.Call(func=self._jst("seq_len"),
+                                      args=[ast.Name(id=seqv,
+                                                     ctx=ast.Load())],
+                                      keywords=[])),
+        ]
+        read = ast.Assign(
+            targets=[ast.Name(id=xname, ctx=ast.Store())],
+            value=ast.Call(func=self._jst("tensor_index"),
+                           args=[ast.Name(id=seqv, ctx=ast.Load()),
+                                 ast.Name(id=idxv, ctx=ast.Load())],
+                           keywords=[]))
+        increment = ast.Assign(
+            targets=[ast.Name(id=idxv, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=idxv, ctx=ast.Load()),
+                            op=ast.Add(), right=ast.Constant(1)))
+        w = ast.While(
+            test=ast.Call(func=self._jst("range_cond"),
+                          args=[ast.Name(id=idxv, ctx=ast.Load()),
+                                ast.Name(id=stopv, ctx=ast.Load()),
+                                ast.Constant(1)],
+                          keywords=[]),
+            body=[read] + body_tensor, orelse=[])
+        jump_init = []
+        if _has_loop_jump(w.body):
+            jump_init, w = self._rewrite_loop_jumps(w, epilogue=[increment])
+        else:
+            w.body = w.body + [increment]
+        self.generic_visit(w)
+        conv = self._convert_while_node(w)
+        tensor_stmts = t_init + jump_init + (
+            conv if isinstance(conv, list) else [conv])
+
+        pfor = ast.For(target=node.target,
+                       iter=ast.Name(id=seqv, ctx=ast.Load()),
+                       body=node.body, orelse=[])
+        self.generic_visit(pfor)    # nested constructs still convert
+        dispatch = ast.If(
+            test=ast.Call(func=self._jst("is_tensor_seq"),
+                          args=[ast.Name(id=seqv, ctx=ast.Load())],
+                          keywords=[]),
+            body=tensor_stmts, orelse=[pfor])
+        return [assign_seq, dispatch]
 
 
 # ---------------------------------------------------------------------------

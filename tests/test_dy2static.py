@@ -539,3 +539,132 @@ def test_while_else_with_return_keeps_python_semantics():
     got = conv(np.ones(2))
     assert want[1] == got[1] == ()
     np.testing.assert_allclose(np.asarray(want[0]), np.asarray(got[0]))
+
+
+# -------------------- for over a tensor (round-4) ------------------------
+
+def test_for_over_tensor_accumulates():
+    """for x in <jax array> converts to ONE traced while body (not
+    shape[0] unrolled copies) and matches eager python iteration."""
+    def fn(xs):
+        acc = jnp.zeros(xs.shape[1:])
+        for row in xs:
+            acc = acc + row * row
+        return acc
+
+    xs = jnp.asarray(np.random.RandomState(0).randn(6, 4).astype(np.float32))
+    _check(fn, (xs,))
+
+    # structural proof of non-unrolling: the jaxpr carries a while_loop
+    static = pjit.to_static(fn)
+    jaxpr = jax.make_jaxpr(static)(xs)
+    assert "while" in str(jaxpr), "for-over-tensor should lower to while"
+
+
+def test_for_over_tensor_break_continue():
+    def fn(xs, t):
+        acc = jnp.zeros(())
+        for v in xs:
+            if v < 0:
+                continue
+            if acc > t:
+                break
+            acc = acc + v
+        return acc
+
+    rs = np.random.RandomState(1)
+    xs = jnp.asarray(rs.randn(8).astype(np.float32))
+    _check(fn, (xs, jnp.asarray(0.5)), (xs, jnp.asarray(100.0)))
+
+
+def test_for_over_python_list_stays_python():
+    """Non-array iterables keep the plain Python for (unrolled trace)."""
+    def fn(x):
+        acc = x
+        for c in [1.0, 2.0, 3.0]:
+            acc = acc + c
+        return acc
+
+    _check(fn, (jnp.asarray(1.0),))
+    static = pjit.to_static(fn)
+    jaxpr = jax.make_jaxpr(static)(jnp.asarray(1.0))
+    assert "while" not in str(jaxpr)      # unrolled, no loop primitive
+
+
+def test_for_over_tensor_first_bound_inside():
+    """The loop element var and a body-local both first bind inside the
+    converted loop — convert_while materializes them."""
+    def fn(xs):
+        total = jnp.zeros(())
+        for item in xs:
+            doubled = item * 2
+            total = total + doubled
+        return total
+
+    xs = jnp.asarray(np.arange(5, dtype=np.float32))
+    _check(fn, (xs,))
+
+
+def test_for_over_tensor_2d_rows_matmul():
+    def fn(xs, w):
+        out = jnp.zeros((xs.shape[0], w.shape[1]))
+        i = 0
+        for row in xs:
+            out = out.at[i].set(row @ w)
+            i = i + 1
+        return out
+
+    rs = np.random.RandomState(2)
+    xs = jnp.asarray(rs.randn(3, 4).astype(np.float32))
+    w = jnp.asarray(rs.randn(4, 2).astype(np.float32))
+    _check(fn, (xs, w), atol=1e-5)
+
+
+# -------------------- try/except passthrough (round-4) -------------------
+
+def test_try_except_passthrough_with_converted_if_inside():
+    """Converted tensor control flow INSIDE a try body still converts;
+    the try/except itself stays Python (trace-time semantics)."""
+    def fn(x):
+        try:
+            if jnp.sum(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+        except ValueError:       # never fires under tracing
+            y = x
+        return y
+
+    _check(fn, (jnp.ones(3),), (-jnp.ones(3),))
+
+
+def test_try_except_catches_python_error_at_trace_time():
+    """A genuine Python exception raised while tracing follows Python
+    try semantics — the handler's (traced) computation is what lands in
+    the program."""
+    def fn(x):
+        try:
+            bad = x.shape[99]          # IndexError at trace time
+            y = x * bad
+        except IndexError:
+            y = x + 1.0
+        return y
+
+    _check(fn, (jnp.ones(3),))
+
+
+def test_try_finally_with_early_return_rewrite():
+    """return-flag rewriting descends into Try bodies (the guarded-flag
+    walk handles Try); finally still runs."""
+    ran = []
+
+    def fn(x):
+        try:
+            if jnp.sum(x) > 0:
+                return x * 2.0
+        finally:
+            ran.append(1)
+        return x - 1.0
+
+    _check(fn, (jnp.ones(3),), (-jnp.ones(3),))
+    assert ran
