@@ -24,6 +24,9 @@ from . import sharding_utils  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel  # noqa: F401
 from . import pipelining  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .meta_optimizers import (LocalSGDOptimizer,  # noqa: F401
+                              DGCMomentumOptimizer)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import rpc  # noqa: F401
 from . import fleet_utils  # noqa: F401

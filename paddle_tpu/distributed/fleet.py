@@ -75,7 +75,31 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     """Attach hybrid semantics to the optimizer: ZeRO opt-state sharding
-    specs when sharding_degree>1 (reference: DygraphShardingOptimizer)."""
+    specs when sharding_degree>1 (reference: DygraphShardingOptimizer);
+    LocalSGD / DGC wrapping when the strategy enables them (reference:
+    fleet/meta_optimizers/{localsgd,dgc}_optimizer.py — here optimizer
+    algorithms for the shard_map dp world, see
+    distributed/meta_optimizers.py)."""
+    s = strategy if strategy is not None else _strategy
+    if s is not None and getattr(s, "dgc", False):
+        from .meta_optimizers import DGCMomentumOptimizer
+        cfg = dict(getattr(s, "dgc_configs", {}) or {})
+        sparsity = cfg.get("sparsity", [0.999])
+        if isinstance(sparsity, (list, tuple)):
+            sparsity = sparsity[-1]
+        optimizer = DGCMomentumOptimizer(
+            learning_rate=getattr(optimizer, "learning_rate", 1e-3),
+            momentum=getattr(optimizer, "momentum", 0.9),
+            sparsity=float(sparsity),
+            rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+            weight_decay=getattr(optimizer, "weight_decay", None),
+            grad_clip=getattr(optimizer, "grad_clip", None))
+    if s is not None and getattr(s, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+        cfg = dict(getattr(s, "localsgd_configs", {}) or {})
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            begin_step=int(cfg.get("begin_step", 1)))
     hcg = get_hybrid_communicate_group()
     if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
         from .meta_parallel.sharding import ShardingOptimizer

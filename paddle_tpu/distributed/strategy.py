@@ -55,7 +55,11 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.dgc = False
+        self.dgc_configs: Dict[str, Any] = {"rampup_begin_step": 0,
+                                            "sparsity": [0.999]}
         self.localsgd = False
+        self.localsgd_configs: Dict[str, Any] = {"k_steps": 1,
+                                                 "begin_step": 1}
         self.find_unused_parameters = False
         self.tensor_parallel = False
         self.tensor_parallel_configs: Dict[str, Any] = {}

@@ -1,0 +1,225 @@
+"""LocalSGD + DGC training algorithms (round-3 VERDICT Missing #6;
+reference: fleet/meta_optimizers/{localsgd,dgc}_optimizer.py).
+
+Oracles: LocalSGD(k=1)+SGD == synchronous data parallelism exactly;
+DGC(sparsity=0) == plain Momentum; top-k/residual accounting; learning
+inside a real shard_map-over-dp program with per-replica gradients."""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.meta_optimizers import (DGCMomentumOptimizer,
+                                                    LocalSGDOptimizer)
+
+
+def _dp_mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def _quadratic_data(n_dev=4, dim=8, seed=0):
+    """Per-replica least-squares problem; the global optimum is the
+    solution of the AVERAGED normal equations."""
+    rs = np.random.RandomState(seed)
+    A = jnp.asarray(rs.randn(n_dev, 16, dim).astype(np.float32))
+    b = jnp.asarray(rs.randn(n_dev, 16).astype(np.float32))
+    return A, b
+
+
+def _local_grad(w, A_l, b_l):
+    r = A_l @ w - b_l
+    return A_l.T @ r / A_l.shape[0]
+
+
+def test_localsgd_k1_equals_sync_dp():
+    """k_steps=1 + SGD: mean(p - lr g_i) == p - lr mean(g_i)."""
+    mesh = _dp_mesh()
+    A, b = _quadratic_data()
+    dim = A.shape[-1]
+    w0 = jnp.zeros((dim,))
+    lsgd = LocalSGDOptimizer(opt.SGD(learning_rate=0.05), k_steps=1)
+    state0 = lsgd.init(w0)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P("dp"), P("dp")), out_specs=P(),
+                       check_vma=False, axis_names={"dp"})
+    def run(w, A_l, b_l):
+        st = jax.tree.map(lambda x: x, state0)
+        for _ in range(5):
+            g = _local_grad(w, A_l[0], b_l[0])
+            w, st = lsgd.update(g, st, w)
+        return w
+
+    w_local = run(w0, A, b)
+
+    # sync-DP oracle: SGD on the mean gradient
+    w_ref = w0
+    for _ in range(5):
+        g = jnp.mean(jnp.stack([_local_grad(w_ref, A[i], b[i])
+                                for i in range(4)]), 0)
+        w_ref = w_ref - 0.05 * g
+    np.testing.assert_allclose(np.asarray(w_local), np.asarray(w_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_k4_replicas_agree_and_learn():
+    mesh = _dp_mesh()
+    A, b = _quadratic_data(seed=3)
+    dim = A.shape[-1]
+    w0 = jnp.zeros((dim,))
+    lsgd = LocalSGDOptimizer(opt.SGD(learning_rate=0.05), k_steps=4,
+                             begin_step=0)
+    state0 = lsgd.init(w0)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P("dp"), P("dp")),
+                       out_specs=(P("dp"), P()),
+                       check_vma=False, axis_names={"dp"})
+    def run(w, A_l, b_l):
+        st = jax.tree.map(lambda x: x, state0)
+        loss0 = jnp.mean((A_l[0] @ w - b_l[0]) ** 2)
+        for _ in range(8):            # 2 full sync cycles
+            g = _local_grad(w, A_l[0], b_l[0])
+            w, st = lsgd.update(g, st, w)
+        loss1 = jax.lax.pmean(jnp.mean((A_l[0] @ w - b_l[0]) ** 2), "dp")
+        return w[None], loss1 - jax.lax.pmean(loss0, "dp")
+
+    w_all, dloss = run(w0, A, b)
+    # after a sync step (8 % 4 == 0) every replica holds the average
+    w_np = np.asarray(w_all)
+    for i in range(1, 4):
+        np.testing.assert_allclose(w_np[0], w_np[i], rtol=1e-6)
+    assert float(dloss) < 0.0       # learned
+
+
+def test_dgc_sparsity_zero_is_plain_momentum():
+    """sparsity=0 (send everything) == Momentum, single process."""
+    rs = np.random.RandomState(1)
+    w0 = jnp.asarray(rs.randn(64, 64).astype(np.float32))
+    gs = [jnp.asarray(rs.randn(64, 64).astype(np.float32))
+          for _ in range(4)]
+
+    dgc = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               sparsity=0.0, axis=None, min_size=1)
+    mom = opt.Momentum(learning_rate=0.1, momentum=0.9)
+    wd, sd = w0, dgc.init(w0)
+    wm, sm = w0, mom.init(w0)
+    for g in gs:
+        wd, sd = dgc.update(g, sd, wd)
+        wm, sm = mom.update(g, sm, wm)
+    np.testing.assert_allclose(np.asarray(wd), np.asarray(wm), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_dgc_topk_and_residual_accounting():
+    """Exactly k entries applied; unsent mass stays in v; sent entries
+    cleared from u and v (the reference clears both)."""
+    rs = np.random.RandomState(2)
+    n = 1 << 14
+    w0 = jnp.zeros((n,))
+    g = jnp.asarray(rs.randn(n).astype(np.float32))
+    sparsity = 0.99
+    k = int(round(n * (1 - sparsity)))
+    dgc = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               sparsity=sparsity, axis=None, min_size=1)
+    st = dgc.init(w0)
+    w1, st1 = dgc.update(g, st, w0)
+    sent = -np.asarray(w1)          # lr=1, p0=0 -> p1 = -sent
+    nnz = int((sent != 0).sum())
+    assert nnz == k, (nnz, k)
+    # sent entries are the top-k |g| (momentum=0 -> v == g at step 1)
+    top = np.sort(np.abs(np.asarray(g)))[-k:]
+    np.testing.assert_allclose(np.sort(np.abs(sent[sent != 0])), top,
+                               rtol=1e-6)
+    v1 = np.asarray(st1["slots"]["v"])
+    # residual + sent reconstructs the full accumulated gradient
+    np.testing.assert_allclose(v1 + sent, np.asarray(g), rtol=1e-6,
+                               atol=1e-7)
+    u1 = np.asarray(st1["slots"]["u"])
+    assert np.all(u1[sent != 0] == 0)      # cleared where sent
+
+
+def test_dgc_small_params_stay_dense():
+    w0 = jnp.zeros((8,))
+    g = jnp.asarray(np.arange(8, dtype=np.float32))
+    dgc = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               sparsity=0.999, axis=None, min_size=64)
+    st = dgc.init(w0)
+    w1, _ = dgc.update(g, st, w0)
+    assert int((np.asarray(w1) != 0).sum()) == 7    # dense (g[0] is 0)
+
+
+def test_dgc_rampup_dense_before_begin():
+    rs = np.random.RandomState(4)
+    n = 1 << 14
+    w0 = jnp.zeros((n,))
+    g = jnp.asarray(rs.randn(n).astype(np.float32))
+    dgc = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               sparsity=0.999, rampup_begin_step=2,
+                               axis=None, min_size=1)
+    st = dgc.init(w0)
+    w1, st = dgc.update(g, st, w0)          # step 0 < 2: dense
+    assert int((np.asarray(w1) != np.asarray(w0)).sum()) > n // 2
+    w2, st = dgc.update(g, st, w1)          # step 1 < 2: dense
+    w3, st = dgc.update(g, st, w2)          # step 2: sparse
+    delta = np.asarray(w3) - np.asarray(w2)
+    assert int((delta != 0).sum()) <= int(round(n * 0.001)) * 2
+
+
+def test_dgc_learns_under_shard_map_dp():
+    """End-to-end: DGC inside shard_map over dp=4 with per-replica grads
+    — replicas stay identical (same masked global update) and the global
+    loss decreases despite 95% of coordinates held back per step."""
+    mesh = _dp_mesh()
+    A, b = _quadratic_data(seed=5, dim=512)
+    dim = A.shape[-1]
+    w0 = jnp.zeros((dim,))
+    dgc = DGCMomentumOptimizer(learning_rate=0.01, momentum=0.9,
+                               sparsity=0.95, axis="dp", min_size=1)
+    st0 = dgc.init(w0)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P("dp"), P("dp")),
+                       out_specs=(P("dp"), P()),
+                       check_vma=False, axis_names={"dp"})
+    def run(w, A_l, b_l):
+        st = jax.tree.map(lambda x: x, st0)
+        loss0 = jax.lax.pmean(jnp.mean((A_l[0] @ w - b_l[0]) ** 2), "dp")
+        for _ in range(20):
+            g = _local_grad(w, A_l[0], b_l[0])
+            w, st = dgc.update(g, st, w)
+        loss1 = jax.lax.pmean(jnp.mean((A_l[0] @ w - b_l[0]) ** 2), "dp")
+        return w[None], loss1 - loss0
+
+    w_all, dloss = run(w0, A, b)
+    w_np = np.asarray(w_all)
+    for i in range(1, 4):
+        np.testing.assert_allclose(w_np[0], w_np[i], rtol=1e-5, atol=1e-6)
+    assert float(dloss) < 0.0
+
+
+def test_fleet_distributed_optimizer_wires_strategy_flags():
+    s = dist.DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 3, "begin_step": 2}
+    o = dist.fleet.distributed_optimizer(opt.SGD(learning_rate=0.1),
+                                         strategy=s)
+    assert isinstance(o, LocalSGDOptimizer) and o.k_steps == 3
+
+    s2 = dist.DistributedStrategy()
+    s2.dgc = True
+    s2.dgc_configs = {"rampup_begin_step": 5, "sparsity": [0.9, 0.999]}
+    o2 = dist.fleet.distributed_optimizer(
+        opt.Momentum(learning_rate=0.1, momentum=0.8), strategy=s2)
+    assert isinstance(o2, DGCMomentumOptimizer)
+    assert o2.momentum == 0.8 and o2.sparsity == 0.999
+    assert o2.rampup_begin_step == 5
