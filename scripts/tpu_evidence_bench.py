@@ -261,7 +261,8 @@ def main():
                     EV["kernel_compare"] = {"error": repr(e)[-400:]}
             flush()
         if os.environ.get("BENCH_SECONDARY", "1") == "1":
-            if _sec_ok(_EXISTING):
+            if _sec_ok(_EXISTING) and \
+                    os.environ.get("BENCH_SECONDARY_FORCE") != "1":
                 EV["secondary_tpu"] = _EXISTING["secondary_tpu"]
                 EV["secondary_carried_from_unix"] = \
                     _EXISTING.get("finished_unix")

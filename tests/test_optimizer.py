@@ -176,3 +176,29 @@ def test_constant_linear_cyclic_lr():
     np.testing.assert_allclose(float(cy.lr_at(8)), 0.1, rtol=1e-6)
     cy2 = CyclicLR(0.1, 0.5, step_size_up=4, mode="triangular2")
     np.testing.assert_allclose(float(cy2.lr_at(12)), 0.3, rtol=1e-6)
+
+
+def test_update_preserves_param_dtype_all_optimizers():
+    """bf16 params stay bf16 through update WITHOUT multi_precision: the
+    f32 lr scalar silently promoted params to f32 (p - lr*g), the jitted
+    step recompiled for the new dtypes, and every later step ran the
+    whole model in f32 — measured 13x slower on the v5e for the Llama
+    secondary bench (r4)."""
+    import jax.numpy as jnp
+    params = {"w": jnp.ones((8, 8), jnp.bfloat16),
+              "b": jnp.ones((8,), jnp.float32)}
+    grads = {"w": jnp.ones((8, 8), jnp.bfloat16) * 0.1,
+             "b": jnp.ones((8,), jnp.float32) * 0.1}
+    for o in (opt.SGD(learning_rate=0.1), opt.Momentum(learning_rate=0.1),
+              opt.Adam(learning_rate=0.1), opt.AdamW(learning_rate=0.1),
+              opt.Adamax(learning_rate=0.1),
+              opt.Adagrad(learning_rate=0.1),
+              opt.Adadelta(learning_rate=0.1),
+              opt.RMSProp(learning_rate=0.1),
+              opt.Lamb(learning_rate=0.1)):
+        st = o.init(params)
+        p2, st = o.update(grads, st, params)
+        assert p2["w"].dtype == jnp.bfloat16, type(o).__name__
+        assert p2["b"].dtype == jnp.float32, type(o).__name__
+        p3, _ = o.update(grads, st, p2)
+        assert p3["w"].dtype == jnp.bfloat16, (type(o).__name__, "step 2")
