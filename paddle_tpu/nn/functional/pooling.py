@@ -300,3 +300,121 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
 
 
 __all__ += ["max_unpool1d", "max_unpool2d", "max_unpool3d"]
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Reference: paddle.nn.functional.lp_pool1d — power-average pooling:
+    (sum x^p over the window)^(1/p), pads contributing 0 (reference
+    semantics: sum WITHOUT abs; negative sums at odd/fractional p yield
+    NaN exactly as torch/paddle do); p=inf degenerates to max pool."""
+    p = float(norm_type)
+    if p == float("inf"):
+        return max_pool1d(x, kernel_size, stride, padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    k = _tuple(kernel_size, 1)
+    pow_x = x ** p
+    # exclusive=False: divide by the FULL kernel size so avg*prod(k)
+    # recovers the exact window sum even for padded/partial windows
+    # (review r4: exclusive=True over-counted border windows)
+    avg = avg_pool1d(pow_x, kernel_size, stride, padding, exclusive=False,
+                     ceil_mode=ceil_mode, data_format=data_format)
+    return (avg * float(np.prod(k))) ** (1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """Reference: paddle.nn.functional.lp_pool2d (see lp_pool1d)."""
+    p = float(norm_type)
+    if p == float("inf"):
+        return max_pool2d(x, kernel_size, stride, padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    k = _tuple(kernel_size, 2)
+    pow_x = x ** p
+    avg = avg_pool2d(pow_x, kernel_size, stride, padding,
+                     ceil_mode=ceil_mode, exclusive=False,
+                     data_format=data_format)
+    return (avg * float(np.prod(k))) ** (1.0 / p)
+
+
+def _fractional_boundaries(n_in, n_out, u):
+    """Graham's pseudo-random pooling boundaries: region i spans
+    [ceil(alpha*(i+u)) - ceil(alpha*u), ...) with alpha = n_in/n_out —
+    the reference op's index sequence (deterministic given u)."""
+    alpha = n_in / n_out
+    idx = np.ceil(alpha * (np.arange(n_out + 1) + u)).astype(np.int64)
+    idx = idx - idx[0]
+    idx = np.clip(idx, 0, n_in)
+    idx[-1] = n_in
+    return idx
+
+
+def _fractional_max(x, axes_sizes, output_size, u):
+    """Max over fractional regions along the trailing spatial axes of a
+    channel-first tensor [N, C, *spatial]."""
+    spatial = len(axes_sizes)
+    out = x
+    for d in range(spatial):
+        n_in = axes_sizes[d]
+        n_out = output_size[d]
+        bounds = _fractional_boundaries(n_in, n_out, u)
+        axis = 2 + d
+        slabs = []
+        for i in range(n_out):
+            lo, hi = int(bounds[i]), int(max(bounds[i + 1], bounds[i] + 1))
+            sl = [slice(None)] * out.ndim
+            sl[axis] = slice(lo, hi)
+            slabs.append(jnp.max(out[tuple(sl)], axis=axis, keepdims=True))
+        out = jnp.concatenate(slabs, axis=axis)
+    return out
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u,
+                         return_mask, rank):
+    """Shared core of fractional_max_pool2d/3d (Graham, 'Fractional
+    Max-Pooling').  ``random_u`` pins the pseudo-random offset; None
+    draws one from the framework RNG.  Documented cuts (also recorded in
+    OP_COVERAGE's explicit-cuts table): return_mask=True (XLA would
+    materialize argmax maps) and explicit kernel_size (the reference
+    pools OVERLAPPING [start, start+k) windows; this implementation
+    pools the disjoint boundary regions — raising beats silently
+    returning different numbers)."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool(return_mask=True) is not supported")
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool with an explicit kernel_size pools "
+            "overlapping windows in the reference; only the disjoint "
+            "region form (kernel_size=None) is implemented")
+    if random_u is None:
+        from ...framework.random import next_rng_key
+        import jax as _jax
+        random_u = float(_jax.random.uniform(next_rng_key(), ()))
+    output_size = _tuple(output_size, rank)
+    sizes = x.shape[2:2 + rank]
+    for n_in, n_out in zip(sizes, output_size):
+        if n_out > n_in:
+            raise ValueError(
+                f"fractional_max_pool output_size {output_size} must not "
+                f"exceed the input spatial size {tuple(sizes)}")
+    return _fractional_max(x, sizes, output_size, float(random_u))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Reference: paddle.nn.functional.fractional_max_pool2d (see
+    _fractional_max_pool for the documented cuts)."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Reference: paddle.nn.functional.fractional_max_pool3d."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3)
+
+
+__all__ += ["lp_pool1d", "lp_pool2d", "fractional_max_pool2d",
+            "fractional_max_pool3d"]

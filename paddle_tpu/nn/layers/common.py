@@ -15,7 +15,8 @@ from .. import initializer as I
 from ..layer import Layer, Parameter, ParamAttr
 
 __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
-           "AlphaDropout", "Flatten", "Identity", "Pad1D", "Pad2D", "Pad3D",
+           "AlphaDropout", "FeatureAlphaDropout",
+           "Flatten", "Identity", "Pad1D", "Pad2D", "Pad3D",
            "ZeroPad2D", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
            "Bilinear", "CosineSimilarity", "Unfold", "Fold", "PixelShuffle",
            "PixelUnshuffle", "ChannelShuffle"]
@@ -115,6 +116,18 @@ class AlphaDropout(Layer):
 
     def forward(self, x):
         return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class FeatureAlphaDropout(Layer):
+    """Reference: paddle.nn.FeatureAlphaDropout — alpha dropout over whole
+    channels."""
+
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
 
 
 class Flatten(Layer):

@@ -190,3 +190,77 @@ class MaxUnPool3D(_MaxUnPoolND):
 
 
 __all__ += ["MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]
+
+
+class LPPool1D(Layer):
+    """Reference: paddle.nn.LPPool1D — power-average pooling."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class LPPool2D(Layer):
+    """Reference: paddle.nn.LPPool2D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class FractionalMaxPool2D(Layer):
+    """Reference: paddle.nn.FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(
+            x, self.output_size, self.kernel_size, self.random_u,
+            self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    """Reference: paddle.nn.FractionalMaxPool3D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(
+            x, self.output_size, self.kernel_size, self.random_u,
+            self.return_mask)
+
+
+__all__ += ["LPPool1D", "LPPool2D", "FractionalMaxPool2D",
+            "FractionalMaxPool3D"]

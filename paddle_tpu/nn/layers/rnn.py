@@ -389,3 +389,38 @@ class BeamSearchDecoder(Layer):
 
 
 __all__ += ["RNNCellBase", "BeamSearchDecoder"]
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Reference: paddle.nn.dynamic_decode — drive a Decoder to
+    completion.  Here the whole decode is already ONE compiled lax.scan
+    inside BeamSearchDecoder.decode, so this is the thin entry point:
+    returns (ids, scores) ([B, K, T] best-first, [B, K]); the reference's
+    (outputs, final_states[, sequence_lengths]) shape bookkeeping is
+    subsumed by the static-shape scan (documented deviation).  Length
+    accounting (return_length) counts tokens before the first end token.
+
+    ``max_step_num`` is REQUIRED (documented deviation): the reference's
+    decode-until-all-finished loop is data-dependent; the compiled scan
+    needs a static bound — silently picking one would truncate outputs."""
+    if max_step_num is None:
+        raise ValueError(
+            "dynamic_decode requires max_step_num: the compiled decode "
+            "scan needs a static step bound (the reference's "
+            "until-finished loop is data-dependent)")
+    steps = int(max_step_num)
+    ids, scores = decoder.decode(inits, steps)
+    if return_length:
+        end = getattr(decoder, "end_token", None)
+        if end is None:
+            lengths = jnp.full(ids.shape[:2], ids.shape[-1], jnp.int64)
+        else:
+            hit = jnp.cumsum((ids == end).astype(jnp.int32), axis=-1) > 0
+            lengths = jnp.sum(~hit, axis=-1).astype(jnp.int64)
+        return ids, scores, lengths
+    return ids, scores
+
+
+__all__ += ["dynamic_decode"]

@@ -312,3 +312,49 @@ def test_feature_alpha_dropout_channelwise():
     per_channel_std = o.reshape(2, 8, -1).std(axis=-1)
     np.testing.assert_allclose(per_channel_std, 0.0, atol=1e-6)
     assert F.feature_alpha_dropout(x, p=0.5, training=False) is x
+
+
+def test_lp_pool_matches_torch():
+    """lp_pool1d/2d incl. padded border windows and ceil-mode tails
+    (review r4: an exclusive average over-counted partial windows)."""
+    import torch
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 16).astype(np.float32)
+    mine = np.asarray(F.lp_pool1d(jnp.asarray(x), 2.0, 4, 4))
+    ref = torch.nn.functional.lp_pool1d(torch.tensor(x), 2.0, 4, 4).numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-5)
+    # padded border: avg*k must equal the true window sum
+    y = jnp.arange(1.0, 7.0).reshape(1, 1, 6)
+    out = np.asarray(F.lp_pool1d(y, 1.0, 3, 3, padding=1))
+    np.testing.assert_allclose(out.ravel(), [3.0, 12.0])
+    # ceil-mode tail window of 1 element
+    out2 = np.asarray(F.lp_pool1d(jnp.ones((1, 1, 5)), 1.0, 2, stride=2,
+                                  ceil_mode=True))
+    np.testing.assert_allclose(out2.ravel(), [2.0, 2.0, 1.0])
+    x2 = rs.randn(2, 3, 8, 8).astype(np.float32)
+    m2 = np.asarray(F.lp_pool2d(jnp.asarray(x2), 3.0, 2, 2))
+    r2 = torch.nn.functional.lp_pool2d(torch.tensor(x2), 3.0, 2, 2).numpy()
+    np.testing.assert_allclose(m2, r2, rtol=1e-4, equal_nan=True)
+
+
+def test_fractional_max_pool():
+    import pytest
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 3, 8, 8).astype(np.float32))
+    out = F.fractional_max_pool2d(x, output_size=3, random_u=0.5)
+    assert out.shape == (2, 3, 3, 3)
+    # deterministic given u; global max survives
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(F.fractional_max_pool2d(x, output_size=3, random_u=0.5)))
+    assert float(out.max()) == float(x.max())
+    o3 = F.fractional_max_pool3d(
+        jnp.asarray(rs.randn(1, 2, 6, 6, 6).astype(np.float32)),
+        output_size=2, random_u=0.25)
+    assert o3.shape == (1, 2, 2, 2, 2)
+    with pytest.raises(ValueError, match="must not exceed"):
+        F.fractional_max_pool2d(x, output_size=16)
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool2d(x, output_size=2, kernel_size=3)
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool2d(x, output_size=2, return_mask=True)

@@ -1,0 +1,49 @@
+"""OP_COVERAGE integrity (round-3 VERDICT item 6).
+
+Two checks: (a) every symbol the coverage generator claims covered
+actually resolves by import — the claim is re-derived live, not trusted
+from the committed MD; (b) the committed OP_COVERAGE.md is byte-synced
+with the generator, so the table cannot drift from the code."""
+
+import importlib
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gen():
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import gen_op_coverage
+    return gen_op_coverage
+
+
+def test_every_claimed_symbol_resolves():
+    g = _gen()
+    failures = []
+    for ns, blob in g.REFERENCE.items():
+        tmod = g.resolve_target(g.TARGETS[ns])
+        for name in sorted(set(blob.split())):
+            if not hasattr(tmod, name):
+                failures.append(f"{g.TARGETS[ns]}.{name}")
+    # the generator records misses honestly; this test pins the CURRENT
+    # miss set so a regression (a symbol vanishing) fails loudly
+    assert failures == [], failures
+
+
+def test_committed_md_matches_generator(tmp_path):
+    g = _gen()
+    out = tmp_path / "OP_COVERAGE.md"
+    g.main(str(out))
+    committed = open(os.path.join(REPO, "OP_COVERAGE.md")).read()
+    assert out.read_text() == committed, (
+        "OP_COVERAGE.md is stale: run python scripts/gen_op_coverage.py")
+
+
+def test_sweep_and_cuts_sections_present():
+    md = open(os.path.join(REPO, "OP_COVERAGE.md")).read()
+    assert "Adversarial sweep" in md
+    assert "Explicit cuts" in md
+    assert "LocalSGDOptimizer" in md          # sweep additions recorded
