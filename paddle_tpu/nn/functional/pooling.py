@@ -391,6 +391,11 @@ def _fractional_max_pool(x, output_size, kernel_size, random_u,
         from ...framework.random import next_rng_key
         import jax as _jax
         random_u = float(_jax.random.uniform(next_rng_key(), ()))
+    elif not 0.0 <= float(random_u) < 1.0:
+        raise ValueError(
+            f"fractional_max_pool random_u must be in [0, 1), got "
+            f"{random_u} (the reference validates the same range; an "
+            f"out-of-range offset would silently shift every region)")
     output_size = _tuple(output_size, rank)
     sizes = x.shape[2:2 + rank]
     for n_in, n_out in zip(sizes, output_size):

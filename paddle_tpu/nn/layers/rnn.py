@@ -410,6 +410,11 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
             "dynamic_decode requires max_step_num: the compiled decode "
             "scan needs a static step bound (the reference's "
             "until-finished loop is data-dependent)")
+    if inits is None:
+        raise ValueError(
+            "dynamic_decode requires inits (the decoder cell's initial "
+            "states); the reference's decoder.initialize() fallback needs "
+            "a batch size this static-shape API cannot infer")
     steps = int(max_step_num)
     ids, scores = decoder.decode(inits, steps)
     if return_length:

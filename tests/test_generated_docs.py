@@ -17,7 +17,10 @@ def test_op_coverage_in_sync(tmp_path):
     committed = open(os.path.join(ROOT, "OP_COVERAGE.md")).read()
     assert out.read_text() == committed, \
         "OP_COVERAGE.md is stale — run python scripts/gen_op_coverage.py"
-    assert "missing" not in committed.split("| **total** |")[1].lower()
+    # no "## Missing in <module>" section may follow the totals row (the
+    # round-4 adversarial-sweep prose legitimately contains the word
+    # "missing", so match the heading, not the bare word)
+    assert "## Missing in" not in committed.split("| **total** |")[1]
     assert "IMPORT FAILED" not in committed
 
 
