@@ -43,9 +43,14 @@ def _rows(n, **extra):
 
 
 V1 = _rows(6)                                    # per-dispatch (no marker)
-V2 = _rows(6, timing="scan-chained")             # honest complete
-V2_PARTIAL = _rows(3, timing="scan-chained", truncated="budget")
-SEC = {m: {"step_ms": 5.0, "items_per_sec": 1.0}
+# honest complete: scan-chained AND table_version >= 2 (the r4 format
+# with routed-default columns; _kc_ok requires both markers)
+V2 = _rows(6, timing="scan-chained", table_version=2)
+V2_PARTIAL = _rows(3, timing="scan-chained", table_version=2,
+                   truncated="budget")
+# r4 secondary format: training rows must carry {config, mfu}
+SEC = {m: {"step_ms": 5.0, "items_per_sec": 1.0, "config": "b1-test",
+           "mfu": 0.5}
        for m in ("resnet50", "transformer", "llama")}
 
 
@@ -131,3 +136,22 @@ def test_no_clobber_when_writing_canonical_directly(tmp_path):
         json.dump(eb.EV, f)
     eb._maybe_promote()                          # must not raise/move
     assert os.path.exists(eb.CANONICAL_PATH)
+
+
+def test_v1_scan_chained_table_no_longer_counts_as_ok(tmp_path):
+    """r4 gate: scan-chained WITHOUT table_version 2 (no routed-default
+    column) must read as not-ok so the watchdog refreshes it."""
+    eb = _bench(tmp_path)
+    old_format = _good(kc=_rows(6, timing="scan-chained"))
+    assert not eb._kc_ok(old_format)
+    assert eb._kc_ok(_good(kc=V2))
+
+
+def test_configless_secondary_no_longer_counts_as_ok(tmp_path):
+    """r4 gate: training rows without {config, mfu} don't count (the r3
+    llama row's unexplained 4561 ms had no config recorded)."""
+    eb = _bench(tmp_path)
+    old_sec = {m: {"step_ms": 5.0, "items_per_sec": 1.0}
+               for m in ("resnet50", "transformer", "llama")}
+    assert not eb._sec_ok(_good(sec=old_sec))
+    assert eb._sec_ok(_good(sec=SEC))
