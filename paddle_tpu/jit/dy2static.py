@@ -328,11 +328,14 @@ def range_cond(i, stop, step):
 
 
 def is_tensor_seq(x) -> bool:
-    """True for jax arrays/tracers with a leading dim — the for-over-
-    tensor path (reference: convert_operators.py — the Iterable branch of
-    for conversion).  Python sequences, numpy arrays and generators stay
-    on the plain-Python for (tracing unrolls them)."""
-    return isinstance(x, jax.Array) and getattr(x, "ndim", 0) >= 1
+    """True for jax arrays/tracers with a NON-EMPTY leading dim — the
+    for-over-tensor path (reference: convert_operators.py — the Iterable
+    branch of for conversion).  Python sequences, numpy arrays,
+    generators and zero-length arrays stay on the plain-Python for
+    (tracing unrolls them; a zero-length array unrolls to nothing, while
+    the traced loop body could not even index it)."""
+    return (isinstance(x, jax.Array) and getattr(x, "ndim", 0) >= 1
+            and x.shape[0] > 0)
 
 
 def seq_len(x) -> int:

@@ -22,10 +22,12 @@ DEADLINE=$(( $(date +%s) + 4*3600 ))
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout -k 10 150 python $PROBE >> $LOG 2>&1; then
     echo "$(date -u +%H:%M:%S) chip alive; b5/b6 push" >> $LOG
-    for conf in "5 0" "6 dots_saveable"; do
+    # batch/remat/seq triples: the two untried memory points plus the
+    # long-context angle (flash's relative win grows with S)
+    for conf in "5 0 2048" "6 dots_saveable 2048" "2 0 4096"; do
       set -- $conf
-      echo "$(date -u +%H:%M:%S) BENCH_BATCH=$1 BENCH_REMAT=$2" >> $LOG
-      if BENCH_BATCH=$1 BENCH_REMAT=$2 BENCH_KERNELS=0 BENCH_SECONDARY=0 \
+      echo "$(date -u +%H:%M:%S) BENCH_BATCH=$1 BENCH_REMAT=$2 BENCH_SEQ=$3" >> $LOG
+      if BENCH_BATCH=$1 BENCH_REMAT=$2 BENCH_SEQ=$3 BENCH_KERNELS=0 BENCH_SECONDARY=0 \
           EVIDENCE_BUDGET_S=1500 timeout -k 15 1900 \
           python scripts/tpu_evidence_bench.py >> $LOG 2>&1; then
         echo "$(date -u +%H:%M:%S) run ok (promotion decides)" >> $LOG

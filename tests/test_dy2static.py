@@ -668,3 +668,43 @@ def test_try_finally_with_early_return_rewrite():
 
     _check(fn, (jnp.ones(3),), (-jnp.ones(3),))
     assert ran
+
+
+def test_for_over_tensor_nested_in_converted_while():
+    """Composition: for-over-tensor INSIDE a tensor-dependent while."""
+    def fn(xs, n):
+        total = jnp.zeros(())
+        i = jnp.zeros((), jnp.int32)
+        while i < n:
+            for v in xs:
+                total = total + v
+            i = i + 1
+        return total
+
+    xs = jnp.asarray(np.arange(4, dtype=np.float32))
+    _check(fn, (xs, jnp.asarray(3, jnp.int32)),
+           (xs, jnp.asarray(0, jnp.int32)))
+
+
+def test_converted_if_inside_for_over_tensor():
+    def fn(xs):
+        pos = jnp.zeros(())
+        for v in xs:
+            if v > 0:
+                pos = pos + v
+        return pos
+
+    rs = np.random.RandomState(3)
+    _check(fn, (jnp.asarray(rs.randn(7).astype(np.float32)),))
+
+
+def test_for_over_tensor_zero_length():
+    """Zero-length leading dim: the converted loop runs zero iterations
+    (matches Python's empty-for)."""
+    def fn(xs):
+        acc = jnp.zeros(())
+        for v in xs:
+            acc = acc + v
+        return acc
+
+    _check(fn, (jnp.zeros((0, 3)).sum(axis=1),))
