@@ -834,3 +834,52 @@ def _index_fill_ref(x, idx, axis, value):
     sl[axis] = idx
     out[tuple(sl)] = value
     return out
+
+
+# ---- round-4 long-tail additions (reference: tensor/creation.py —
+# block_diag; tensor/linalg.py — cdist, vecdot; Tensor.fill_diagonal_) ----
+
+register_op("block_diag",
+            lambda a, b: T.block_diag([a, b]),
+            lambda a, b: _block_diag_ref(a, b),
+            _sample(lambda: _mk(2, 3), lambda: _mk(3, 2)),
+            grad_args=(0, 1))
+register_op("cdist", T.cdist,
+            lambda x, y: np.sqrt(np.maximum(
+                ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1), 0)),
+            _sample(lambda: _mk(4, 6), lambda: _mk(5, 6)),
+            grad_args=(0,), rtol=1e-3, atol=1e-4)
+register_op("vecdot",
+            lambda x, y: T.linalg.vecdot(x, y),
+            lambda x, y: (x * y).sum(-1),
+            _sample(lambda: _mk(3, 5), lambda: _mk(3, 5)),
+            grad_args=(0, 1))
+register_op("fill_diagonal_",
+            lambda x: T.fill_diagonal_(x, 7.0),
+            lambda x: _fill_diag_ref(x, 7.0),
+            _sample(lambda: _mk(4, 6)))
+register_op("erfc", T.erfc,
+            lambda x: 1.0 - _erf_ref(x),
+            _sample(lambda: _mk(3, 4)), grad_args=(0,),
+            rtol=1e-4, atol=1e-5)
+register_op("positive", T.positive, lambda x: x,
+            _sample(lambda: _mk(3, 3)), grad_args=(0,))
+
+
+def _block_diag_ref(a, b):
+    out = np.zeros((a.shape[0] + b.shape[0], a.shape[1] + b.shape[1]),
+                   dtype=a.dtype)
+    out[:a.shape[0], :a.shape[1]] = a
+    out[a.shape[0]:, a.shape[1]:] = b
+    return out
+
+
+def _fill_diag_ref(x, v):
+    out = np.array(x)
+    np.fill_diagonal(out, v)
+    return out
+
+
+def _erf_ref(x):
+    from scipy.special import erf as _erf
+    return _erf(x)
