@@ -118,14 +118,14 @@ class GPTBlock(Layer):
             k = jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1)
             v = jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1)
             new_cache = (k, v, pos + s)
-            # decode: per-query causal mask (query at chunk offset t sees
-            # keys up to pos+t) so multi-token chunked prefill is causal
-            # within the chunk
-            kpos = jnp.arange(k.shape[1])
-            qpos = pos + jnp.arange(s)
-            mask = (kpos[None, None, None, :] <= qpos[None, None, :, None])
-            out = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=mask, training=self.training)
+            # decode: the routed decode-attention path (pallas streaming
+            # kernel or its exact-semantics dense form, kernels/routing.py)
+            # — seq_lens = pos + s with the causal tail gives precisely
+            # the per-query mask (query at chunk offset t sees keys up to
+            # pos + t), without materializing a [*, s, S_max] mask tensor
+            from ..kernels.decode_attention import decode_attention_auto
+            lens = jnp.full((b,), pos + s, jnp.int32)
+            out = decode_attention_auto(q, k, v, lens)
         elif cfg.cp:
             # long-context: sequence sharded over the sep axis; ring or
             # Ulysses attention instead of local sdpa (attn dropout is not

@@ -118,14 +118,12 @@ class LlamaAttention(Layer):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         if cache is not None:
-            # per-query causal mask: query at chunk offset t sees keys up to
-            # absolute position pos+t, so multi-token (chunked) prefill via
-            # decode_step stays causal WITHIN the chunk too
-            kpos = jnp.arange(k.shape[1])
-            qpos = cache[2] + jnp.arange(s)
-            mask = (kpos[None, None, None, :] <= qpos[None, None, :, None])
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
-                                                 training=self.training)
+            # routed decode attention (see gpt.py _attn): seq_lens =
+            # pos + s with the causal tail IS the per-query chunked-
+            # prefill mask, with no [*, s, S_max] mask materialization
+            from ..kernels.decode_attention import decode_attention_auto
+            lens = jnp.full((b,), cache[2] + s, jnp.int32)
+            out = decode_attention_auto(q, k, v, lens)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  training=self.training)
