@@ -30,6 +30,7 @@ from .tensor import creation as _creation
 # framework-level API
 from .framework import (seed, save, load, get_rng_state, set_rng_state,  # noqa: F401
                         set_default_dtype, get_default_dtype)
+from .framework.dtype_info import iinfo, finfo  # noqa: F401
 from .framework.random import rng_context, next_rng_key  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
 from .autograd import no_grad, grad, enable_grad, is_grad_enabled  # noqa: F401

@@ -9,6 +9,7 @@ from .random import (seed, get_rng_state, set_rng_state,  # noqa: F401
                      get_rng_state_tracker, rng_context, next_rng_key)
 from .io import save, load  # noqa: F401
 from . import debug  # noqa: F401
+from .dtype_info import iinfo, finfo  # noqa: F401
 from . import fault  # noqa: F401
 
 _default_dtype = jnp.float32

@@ -1,0 +1,71 @@
+"""paddle.iinfo / paddle.finfo parity.
+
+Reference: the pybind-level ``paddle.iinfo(dtype)`` / ``paddle.finfo(dtype)``
+machine-limit objects (paddle/fluid/pybind/pybind.cc — iinfo/finfo
+bindings).  Backed by numpy/ml_dtypes limits, which is what the reference's
+C++ ``std::numeric_limits`` reports for the same storage formats; bfloat16
+limits come from jax's ml_dtypes registration.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["iinfo", "finfo"]
+
+
+def _canon_dtype(dtype):
+    """Accept a jnp dtype alias, numpy dtype, string, or array-like with a
+    ``.dtype`` attribute (the reference accepts paddle dtypes and Tensors)."""
+    if hasattr(dtype, "dtype") and not isinstance(dtype, type):
+        dtype = dtype.dtype
+    return jnp.dtype(dtype)
+
+
+class iinfo:
+    """Integer machine limits: ``bits``, ``min``, ``max``, ``dtype``."""
+
+    def __init__(self, dtype):
+        d = _canon_dtype(dtype)
+        if not jnp.issubdtype(d, jnp.integer) and d != jnp.dtype(bool):
+            raise ValueError(
+                f"paddle.iinfo expects an integer dtype, got {d.name}; use "
+                f"paddle.finfo for floating types")
+        if d == jnp.dtype(bool):
+            self.bits, self.min, self.max = 8, 0, 1
+        else:
+            info = np.iinfo(d)
+            self.bits, self.min, self.max = info.bits, int(info.min), int(info.max)
+        self.dtype = d.name
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class finfo:
+    """Floating machine limits: ``bits``, ``eps``, ``min``, ``max``,
+    ``tiny``, ``smallest_normal``, ``resolution``, ``dtype``."""
+
+    def __init__(self, dtype):
+        d = _canon_dtype(dtype)
+        if not (jnp.issubdtype(d, jnp.floating)
+                or jnp.issubdtype(d, jnp.complexfloating)):
+            raise ValueError(
+                f"paddle.finfo expects a floating/complex dtype, got "
+                f"{d.name}; use paddle.iinfo for integer types")
+        info = jnp.finfo(d)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.dtype = d.name
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, resolution={self.resolution}, "
+                f"bits={self.bits}, dtype={self.dtype})")

@@ -89,4 +89,65 @@ def identity_loss(x, reduction="none"):
         return jnp.mean(x)
     return x
 
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop uniform neighbor sampling over a CSC graph (reference:
+    python/paddle/incubate/operators/graph_khop_sampler.py —
+    graph_khop_sampler op).
+
+    One :func:`paddle_tpu.geometric.sample_neighbors` round per entry of
+    ``sample_sizes`` starting from ``input_nodes``, with the union of seen
+    nodes reindexed to contiguous local ids (input nodes first, then new
+    neighbors in first-appearance order — the reference's hashtable order).
+
+    Returns ``(edge_src, edge_dst, sample_index, reindex_nodes)`` plus
+    ``edge_eids`` when ``return_eids`` (requires ``sorted_eids``):
+    reindexed edge endpoints over all hops, the original ids of the local
+    node table, and the positions of ``input_nodes`` in that table.  Host
+    op (numpy), like the samplers it composes.
+    """
+    import numpy as np
+    from .. import geometric as G
+    if return_eids and sorted_eids is None:
+        raise ValueError("return_eids=True requires sorted_eids")
+    input_nodes = np.asarray(input_nodes).reshape(-1)
+    # dedup (first-appearance order) so the local-id table has one row per
+    # node; reindex_nodes maps every ORIGINAL input position to its row.
+    # _build_mapping with an empty base IS that dedup+rank operation.
+    uniq_inputs, reindex_nodes = G._build_mapping(
+        np.empty(0, input_nodes.dtype), input_nodes)
+    frontier = uniq_inputs
+    src_parts, dst_parts, eid_parts = [], [], []
+    for k in sample_sizes:
+        res = G.sample_neighbors(row, colptr, frontier, sample_size=int(k),
+                                 eids=sorted_eids,
+                                 return_eids=return_eids)
+        if return_eids:
+            neighbors, counts, eids = res
+            eid_parts.append(np.asarray(eids))
+        else:
+            neighbors, counts = res
+        neighbors = np.asarray(neighbors)
+        counts = np.asarray(counts)
+        src_parts.append(neighbors)
+        dst_parts.append(np.repeat(frontier, counts))
+        frontier = np.unique(neighbors)
+    all_src = np.concatenate(src_parts) if src_parts else np.zeros(0, np.int64)
+    all_dst = np.concatenate(dst_parts) if dst_parts else np.zeros(0, np.int64)
+    # local id table: input nodes first, then new nodes in first-appearance
+    # order — the vectorized mapping geometric's reindex_graph uses (a
+    # per-edge host loop would stall the device on sampled batches)
+    out_nodes, flat_local = G._build_mapping(
+        uniq_inputs, np.concatenate([all_src, all_dst]))
+    edge_src = flat_local[:all_src.size]
+    edge_dst = flat_local[all_src.size:]
+    sample_index = np.asarray(out_nodes, dtype=np.int64)
+    if return_eids:
+        edge_eids = (np.concatenate(eid_parts) if eid_parts
+                     else np.zeros(0, np.int64))
+        return edge_src, edge_dst, sample_index, reindex_nodes, edge_eids
+    return edge_src, edge_dst, sample_index, reindex_nodes
+
+
 from . import optimizer  # noqa: E402,F401  (LookAhead / ModelAverage)
+from . import autograd  # noqa: E402,F401  (jvp/vjp/Jacobian/Hessian)

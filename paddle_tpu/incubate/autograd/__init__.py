@@ -1,0 +1,174 @@
+"""paddle.incubate.autograd parity — functional jvp/vjp and the lazy
+Jacobian/Hessian matrix views.
+
+Reference: python/paddle/incubate/autograd/ — ``jvp``, ``vjp`` (functional.py)
+and ``Jacobian``, ``Hessian`` (the lazily-evaluated 2D matrix views over
+jacrev results).  The reference's "prim" mode (enable_prim/disable_prim:
+decompose ops into primitive ops so the static AD works on a closed set) is
+what jaxprs are natively — JAX traces to a fixed primitive set and
+differentiates that — so the toggles here only record the flag for parity
+while the behavior is always-on.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd import jvp, vjp  # noqa: F401  (same contract)
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled"]
+
+_PRIM = [True]
+
+
+def enable_prim():
+    _PRIM[0] = True
+
+
+def disable_prim():
+    """Parity no-op: JAX AD always runs over primitive jaxprs; the flag is
+    recorded so reference code observing prim_enabled() behaves."""
+    _PRIM[0] = False
+
+
+def prim_enabled() -> bool:
+    return _PRIM[0]
+
+
+def _as_tuple(xs):
+    return (tuple(xs), True) if isinstance(xs, (list, tuple)) else ((xs,), False)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix view (reference:
+    python/paddle/incubate/autograd/functional.py — Jacobian).
+
+    ``Jacobian(func, xs)[i, j]`` indexes the (M, N) matrix of
+    d flat_out[i] / d flat_in[j]; with ``is_batched=True`` the first axis is
+    the batch and the view is (B, M, N) over per-sample flattenings.
+    Evaluation happens once on first index access (jax.jacrev), matching the
+    reference's cache-on-first-use contract.  Multiple inputs concatenate
+    along the last (input) axis, reference-style.
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs, self._multi_in = _as_tuple(xs)
+        self._batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        argnums = tuple(range(len(self._xs)))
+        if self._batched:
+            # per-sample output shape — batched mode's contract is that
+            # func applies per sample, so shapes come from a sample slice
+            y = jax.eval_shape(self._func,
+                               *(jnp.asarray(x)[0] for x in self._xs))
+        else:
+            y = jax.eval_shape(self._func, *self._xs)
+        if self._batched:
+            # vmap computes the per-sample (diagonal) blocks directly —
+            # jacrev over the batched function would build the full
+            # (B, M, B, N) cross-batch tensor only to discard all but the
+            # diagonal
+            jac = jax.vmap(jax.jacrev(self._func, argnums=argnums))(*self._xs)
+        else:
+            jac = jax.jacrev(self._func, argnums=argnums)(*self._xs)
+        if not isinstance(jac, tuple):
+            jac = (jac,)
+        blocks = []
+        for xi, ji in zip(self._xs, jac):
+            xi = jnp.asarray(xi)
+            ji = jnp.asarray(ji)
+            if self._batched:
+                b = int(xi.shape[0])
+                m = int(np.prod(y.shape))
+                n = int(xi.size // b)
+                blocks.append(ji.reshape(b, m, n))
+            else:
+                blocks.append(ji.reshape(int(np.prod(y.shape)),
+                                         int(xi.size)))
+        self._mat = jnp.concatenate(blocks, axis=-1)
+        return self._mat
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        return np.asarray(self._materialize(), dtype=dtype)
+
+
+class Hessian:
+    """Lazy Hessian view of a scalar-output function (reference:
+    python/paddle/incubate/autograd/functional.py — Hessian): (N, N) over
+    the flattened inputs, or (B, N, N) with ``is_batched=True`` for
+    per-sample scalar outputs of batched inputs."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs, self._multi_in = _as_tuple(xs)
+        self._batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        xs = [jnp.asarray(x) for x in self._xs]
+        if self._batched:
+            # vmap(hessian) yields the per-sample (N, N) blocks directly;
+            # batched mode therefore requires func to apply per sample
+            # (the reference's batched contract)
+            b = int(xs[0].shape[0])
+            per = [int(x.size // b) for x in xs]
+
+            def from_flat(v):
+                outs, off = [], 0
+                for x, p in zip(xs, per):
+                    outs.append(v[off:off + p].reshape(x.shape[1:]))
+                    off += p
+                return outs
+
+            def f(v):
+                return jnp.asarray(self._func(*from_flat(v))).reshape(())
+
+            flat = jnp.concatenate([x.reshape(b, -1) for x in xs], axis=1)
+            self._mat = jax.vmap(jax.hessian(f))(flat)
+        else:
+            sizes = [int(x.size) for x in xs]
+
+            def from_flat(v):
+                outs, off = [], 0
+                for x, s in zip(xs, sizes):
+                    outs.append(v[off:off + s].reshape(x.shape))
+                    off += s
+                return outs
+
+            def f(v):
+                return jnp.asarray(self._func(*from_flat(v))).reshape(())
+
+            flat = jnp.concatenate([x.reshape(-1) for x in xs])
+            self._mat = jax.hessian(f)(flat)
+        return self._mat
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        return np.asarray(self._materialize(), dtype=dtype)

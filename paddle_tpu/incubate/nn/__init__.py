@@ -14,5 +14,6 @@ where they beat XLA.
 """
 
 from .layer import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
-                    FusedMultiTransformer)
+                    FusedMultiTransformer, FusedLinear,
+                    FusedBiasDropoutResidualLayerNorm)
 from . import functional  # noqa: F401

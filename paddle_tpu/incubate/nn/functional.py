@@ -19,7 +19,8 @@ __all__ = ["fused_linear", "fused_matmul_bias", "fused_feedforward",
            "fused_multi_head_attention",
            "fused_bias_dropout_residual_layer_norm",
            "fused_rotary_position_embedding", "fused_rms_norm",
-           "fused_layer_norm", "swiglu"]
+           "fused_layer_norm", "swiglu",
+           "variable_length_memory_efficient_attention"]
 
 
 def fused_linear(x, weight, bias=None, transpose_weight: bool = False,
@@ -310,3 +311,61 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     out = layer(x, attn_mask=attn_mask, caches=cache_kvs,
                 time_step=time_step, rotary_embs=rotary_embs)
     return out
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal: bool = False, pre_cache_length: int = 0, name=None):
+    """Length-masked attention over padded batches (reference:
+    python/paddle/incubate/nn/functional/
+    variable_length_memory_efficient_attention.py — the CUTLASS
+    memory-efficient kernel).  q [B, H, M, D], k/v [B, KH, N, D] with
+    KH | H (grouped KV heads broadcast); ``seq_lens``/``kv_seq_lens`` [B]
+    (or [B, 1]) valid lengths.  On TPU the masked softmax composition is
+    XLA-fused; the "memory efficient" property (never materializing the
+    full S^2 scores) is supplied by the Pallas flash kernel underneath
+    F.scaled_dot_product_attention for the uniform-length fast path —
+    this entry keeps the reference's ragged semantics.
+    """
+    if pre_cache_length:
+        raise NotImplementedError(
+            "pre_cache_length > 0 (prefix caching) is not supported; "
+            "prepend the prefix to key/value and extend kv_seq_lens instead")
+    q = jnp.asarray(query)
+    k = jnp.asarray(key)
+    v = jnp.asarray(value)
+    b, h, m, d = q.shape
+    kh, n = k.shape[1], k.shape[2]
+    if h % kh:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {kh}")
+    if kh != h:
+        rep = h // kh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qlen = jnp.asarray(seq_lens).reshape(b).astype(jnp.int32)
+    klen = jnp.asarray(kv_seq_lens).reshape(b).astype(jnp.int32)
+    # masking + softmax in f32: a finite f32 min would overflow to -inf in
+    # a bf16 scores tensor
+    scores = jnp.einsum("bhmd,bhnd->bhmn", q, k).astype(jnp.float32) * scale
+    valid = (jnp.arange(n)[None, :] < klen[:, None])[:, None, None, :]
+    if causal:
+        # decode-style alignment PER SAMPLE: valid query i of batch b
+        # attends keys <= i + (kv_len_b - q_len_b) — the offset comes from
+        # the true lengths, not the padded tensor dims
+        offs = (jnp.arange(m)[None, :, None] + (klen - qlen)[:, None, None]
+                >= jnp.arange(n)[None, None, :])            # [B, M, N]
+        valid = valid & offs[:, None]
+    scores = jnp.where(valid, scores, -jnp.inf)
+    if mask is not None:
+        scores = scores + jnp.asarray(mask, scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # a fully-masked row (kv_len 0, causal window before the first key, or
+    # a user mask of -inf across all valid keys) softmaxes 0/0 -> NaN;
+    # zero those rows instead
+    row_ok = jnp.isfinite(scores).any(-1, keepdims=True)
+    probs = jnp.where(row_ok, probs, 0.0)
+    out = jnp.einsum("bhmn,bhnd->bhmd", probs.astype(q.dtype), v)
+    q_valid = (jnp.arange(m)[None, :] < qlen[:, None])[:, None, :, None]
+    return jnp.where(q_valid, out, jnp.zeros((), out.dtype))

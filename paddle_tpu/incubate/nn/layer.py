@@ -402,3 +402,63 @@ class FusedMultiTransformer(Layer):
         if caches is not None:
             return x, new_caches
         return x
+
+
+class FusedLinear(Layer):
+    """Linear layer routed through the fused GEMM-epilogue path (reference:
+    python/paddle/incubate/nn/layer/fused_linear.py — FusedLinear over the
+    fused_linear / fused_gemm_epilogue op).  Weight layout [in, out]
+    (or [out, in] with ``transpose_weight=True``, the cuBLASLt-friendly
+    layout the reference keeps); on TPU the bias add fuses into the matmul
+    epilogue by XLA."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, transpose_weight: bool = False, name=None):
+        super().__init__()
+        self.transpose_weight = bool(transpose_weight)
+        wshape = ((out_features, in_features) if transpose_weight
+                  else (in_features, out_features))
+        self.weight = self.create_parameter(wshape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_features,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        from . import functional as FF
+        return FF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """bias + dropout + residual-add + LayerNorm in one epilogue (reference:
+    python/paddle/incubate/nn/layer/fused_dropout_add.py sibling —
+    FusedBiasDropoutResidualLayerNorm over
+    fused_bias_dropout_residual_layer_norm op)."""
+
+    def __init__(self, embed_dim: int, dropout_rate: float = 0.5,
+                 weight_attr=None, bias_attr=None, epsilon: float = 1e-5,
+                 name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = (None if bias_attr is False else
+                            self.create_parameter((embed_dim,),
+                                                  is_bias=True))
+        self.ln_scale = (None if weight_attr is False else
+                         self.create_parameter(
+                             (embed_dim,), attr=weight_attr,
+                             default_initializer=I.Constant(1.0)))
+        self.ln_bias = (None if bias_attr is False else
+                        self.create_parameter((embed_dim,), attr=bias_attr,
+                                              is_bias=True))
+
+    def forward(self, x, residual):
+        from . import functional as FF
+        return FF.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self._epsilon,
+            training=self.training)
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, seed=None"

@@ -1,0 +1,66 @@
+"""Static-graph metric ops (reference: python/paddle/static/nn/metric.py —
+accuracy, auc).
+
+Both are pure jnp compositions, so they record cleanly on the static tape
+and run under jit.  Deviation (documented): the reference's ``auc`` creates
+persistable stat variables inside the program and accumulates across
+``Executor.run`` calls; here the returned stat tensors are THIS batch's
+threshold histograms — cross-batch accumulation is the job of the stateful
+:class:`paddle_tpu.metric.Auc`, matching how the eager API splits the same
+concern.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """Top-k accuracy of ``input`` logits/probs vs integer ``label``
+    (reference: static.accuracy; same math as paddle.metric.accuracy)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k, correct=correct, total=total)
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 2 ** 12 - 1,
+        topk: int = 1, slide_steps: int = 1, ins_tag_weight=None, name=None):
+    """Area under the ROC curve via the reference's thresholded-histogram
+    algorithm (reference: static.auc — auc op with stat_pos/stat_neg
+    bucket arrays).
+
+    ``input`` [N, 2] two-class probabilities (positive class = column 1) or
+    [N, 1]/[N] positive-class scores; ``label`` [N] / [N, 1] in {0, 1}.
+    Returns ``(auc_out, [stat_pos, stat_neg])`` where the stats are the
+    per-bucket positive/negative counts for this batch (see module note on
+    accumulation).  Only ``curve='ROC'`` is supported, like the op.
+    """
+    if curve != "ROC":
+        raise ValueError(f"auc supports curve='ROC' only, got {curve!r}")
+    x = jnp.asarray(input)
+    if x.ndim == 2 and x.shape[1] == 2:
+        score = x[:, 1]
+    else:
+        score = x.reshape(-1)
+    y = jnp.asarray(label).reshape(-1)
+    w = (jnp.ones_like(score) if ins_tag_weight is None
+         else jnp.asarray(ins_tag_weight).reshape(-1).astype(score.dtype))
+    bucket = jnp.clip((score * num_thresholds).astype(jnp.int32),
+                      0, num_thresholds)
+    nb = num_thresholds + 1
+    pos_w = jnp.where(y > 0, w, 0.0)
+    neg_w = jnp.where(y > 0, 0.0, w)
+    stat_pos = jnp.zeros((nb,), jnp.float64 if score.dtype == jnp.float64
+                         else jnp.float32).at[bucket].add(pos_w)
+    stat_neg = jnp.zeros_like(stat_pos).at[bucket].add(neg_w)
+    # sweep thresholds high->low: trapezoid over (FP, TP) increments
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tp_prev = jnp.concatenate([jnp.zeros((1,), tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros((1,), fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    denom = tp[-1] * fp[-1]
+    auc_out = jnp.where(denom > 0, area / jnp.where(denom > 0, denom, 1.0),
+                        0.0)
+    return auc_out, [stat_pos, stat_neg]

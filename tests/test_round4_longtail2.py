@@ -1,0 +1,360 @@
+"""Round-4 second adversarial-sweep batch: iinfo/finfo,
+incubate.autograd (jvp/vjp/Jacobian/Hessian), incubate.nn fused additions,
+static.accuracy/auc, graph_khop_sampler.
+
+Oracles: numpy closed forms; sklearn-free AUC cross-check by
+rank-statistic; Jacobian/Hessian vs hand derivatives.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.incubate.autograd as iauto
+import paddle_tpu.incubate.nn.functional as IF
+from paddle_tpu.incubate.nn import (FusedLinear,
+                                    FusedBiasDropoutResidualLayerNorm)
+
+
+class TestDtypeInfo:
+    def test_iinfo_ranges(self):
+        for dt, lo, hi, bits in [("int8", -128, 127, 8),
+                                 ("int32", -2**31, 2**31 - 1, 32),
+                                 ("uint8", 0, 255, 8),
+                                 ("int64", -2**63, 2**63 - 1, 64)]:
+            info = paddle.iinfo(dt)
+            assert (info.min, info.max, info.bits) == (lo, hi, bits)
+            assert info.dtype == dt
+
+    def test_finfo_float32(self):
+        info = paddle.finfo(paddle.float32)
+        assert info.bits == 32
+        assert info.eps == pytest.approx(np.finfo(np.float32).eps)
+        assert info.max == pytest.approx(np.finfo(np.float32).max)
+        assert info.tiny == info.smallest_normal
+
+    def test_finfo_bfloat16(self):
+        info = paddle.finfo(paddle.bfloat16)
+        assert info.bits == 16
+        assert info.eps == pytest.approx(0.0078125)
+        assert info.max == pytest.approx(3.3895314e38, rel=1e-4)
+
+    def test_accepts_tensor_and_rejects_wrong_kind(self):
+        assert paddle.finfo(jnp.ones(3, jnp.float16)).bits == 16
+        with pytest.raises(ValueError):
+            paddle.iinfo(paddle.float32)
+        with pytest.raises(ValueError):
+            paddle.finfo(paddle.int32)
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp(self):
+        f = lambda x: x ** 3
+        x = jnp.array([1.0, 2.0])
+        y, t = iauto.jvp(f, x, jnp.ones(2))
+        np.testing.assert_allclose(np.asarray(t), 3 * np.array([1.0, 4.0]))
+        y, vjp_out = iauto.vjp(f, x, jnp.ones(2))
+        np.testing.assert_allclose(np.asarray(vjp_out[0]),
+                                   3 * np.array([1.0, 4.0]))
+
+    def test_jacobian_matrix_view(self):
+        A = np.arange(6.0).reshape(2, 3)
+        J = iauto.Jacobian(lambda x: jnp.asarray(A) @ x, jnp.ones(3))
+        assert J.shape == (2, 3)
+        np.testing.assert_allclose(np.asarray(J[:]), A)
+        # row/element indexing on the lazy view
+        np.testing.assert_allclose(np.asarray(J[1]), A[1])
+        assert float(J[1, 2]) == A[1, 2]
+
+    def test_jacobian_batched_diagonal(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 3))
+        J = iauto.Jacobian(lambda x: x ** 2, x, is_batched=True)
+        assert J.shape == (4, 3, 3)
+        for b in range(4):
+            np.testing.assert_allclose(np.asarray(J[b]),
+                                       np.diag(2 * np.asarray(x[b])),
+                                       rtol=1e-6)
+
+    def test_hessian(self):
+        # f(x) = x^T A x  ->  H = A + A^T
+        A = np.random.RandomState(1).randn(3, 3)
+        H = iauto.Hessian(lambda x: x @ jnp.asarray(A) @ x, jnp.ones(3))
+        assert H.shape == (3, 3)
+        np.testing.assert_allclose(np.asarray(H[:]), A + A.T, rtol=1e-5)
+
+    def test_hessian_batched(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(5, 3))
+        H = iauto.Hessian(lambda x: (x ** 3).sum(axis=-1), x,
+                          is_batched=True)
+        assert H.shape == (5, 3, 3)
+        for b in range(5):
+            np.testing.assert_allclose(np.asarray(H[b]),
+                                       np.diag(6 * np.asarray(x[b])),
+                                       rtol=1e-5)
+
+    def test_multi_input_jacobian_concats(self):
+        J = iauto.Jacobian(lambda a, b: a * 2 + b * 3,
+                           [jnp.ones(2), jnp.ones(2)])
+        assert J.shape == (2, 4)
+        np.testing.assert_allclose(
+            np.asarray(J[:]),
+            np.concatenate([2 * np.eye(2), 3 * np.eye(2)], axis=1))
+
+    def test_prim_toggles(self):
+        assert iauto.prim_enabled()
+        iauto.disable_prim()
+        assert not iauto.prim_enabled()
+        iauto.enable_prim()
+        assert iauto.prim_enabled()
+
+
+class TestFusedAdditions:
+    def test_fused_linear_layer(self):
+        fl = FusedLinear(4, 8)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 4).astype("float32"))
+        out = fl(x)
+        ref = np.asarray(x) @ np.asarray(fl.weight) + np.asarray(fl.bias)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+    def test_fused_linear_transpose_weight(self):
+        fl = FusedLinear(4, 8, transpose_weight=True)
+        assert tuple(fl.weight.shape) == (8, 4)
+        x = jnp.ones((3, 4))
+        assert fl(x).shape == (3, 8)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        layer = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        layer.eval()
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(2, 5, 8).astype("float32"))
+        res = jnp.asarray(rng.randn(2, 5, 8).astype("float32"))
+        out = np.asarray(layer(x, res))
+        h = np.asarray(x) + np.asarray(layer.linear_bias) + np.asarray(res)
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(var + 1e-5)
+        ref = ref * np.asarray(layer.ln_scale) + np.asarray(layer.ln_bias)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_varlen_attention_matches_dense_per_sample(self):
+        rng = np.random.RandomState(4)
+        b, h, m, n, d = 2, 4, 5, 6, 8
+        q = rng.randn(b, h, m, d).astype("float32")
+        k = rng.randn(b, h, n, d).astype("float32")
+        v = rng.randn(b, h, n, d).astype("float32")
+        qlen = np.array([5, 3])
+        klen = np.array([6, 4])
+        out = np.asarray(IF.variable_length_memory_efficient_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(qlen), jnp.asarray(klen)))
+        for bi in range(b):
+            kv = klen[bi]
+            s = q[bi] @ k[bi, :, :kv].transpose(0, 2, 1) / np.sqrt(d)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            ref = p @ v[bi, :, :kv]
+            np.testing.assert_allclose(out[bi, :, :qlen[bi]],
+                                       ref[:, :qlen[bi]], rtol=1e-4,
+                                       atol=1e-5)
+            # out-of-range query rows are zeroed
+            assert np.all(out[bi, :, qlen[bi]:] == 0)
+
+    def test_varlen_attention_gqa_and_causal(self):
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 4, 6, 8).astype("float32"))
+        k = jnp.asarray(rng.randn(1, 2, 6, 8).astype("float32"))
+        v = jnp.asarray(rng.randn(1, 2, 6, 8).astype("float32"))
+        lens = jnp.array([6])
+        out = IF.variable_length_memory_efficient_attention(
+            q, k, v, lens, lens, causal=True)
+        assert out.shape == (1, 4, 6, 8)
+        # causal: first query attends only the first key
+        qh = np.asarray(q)[0, 0, 0]
+        ref0 = np.asarray(v)[0, 0, 0]
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 0], ref0,
+                                   rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            IF.variable_length_memory_efficient_attention(
+                q, jnp.ones((1, 3, 6, 8)), jnp.ones((1, 3, 6, 8)),
+                lens, lens)
+
+
+class TestStaticMetrics:
+    def test_accuracy(self):
+        logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        label = jnp.asarray([1, 1, 1])
+        acc = paddle.static.accuracy(logits, label, k=1)
+        assert float(acc) == pytest.approx(2 / 3)
+
+    def test_auc_matches_rank_statistic(self):
+        rng = np.random.RandomState(6)
+        score = rng.rand(200).astype("float32")
+        label = (rng.rand(200) < 0.4).astype("int64")
+        inp = np.stack([1 - score, score], axis=1)
+        auc_out, (sp, sn) = paddle.static.auc(jnp.asarray(inp),
+                                              jnp.asarray(label))
+        pos = score[label == 1]
+        neg = score[label == 0]
+        # Mann-Whitney U / (n_pos * n_neg) == ROC AUC
+        ref = ((pos[:, None] > neg[None, :]).sum()
+               + 0.5 * (pos[:, None] == neg[None, :]).sum()) / (
+                   len(pos) * len(neg))
+        assert float(auc_out) == pytest.approx(float(ref), abs=2e-3)
+        assert float(sp.sum()) == label.sum()
+        assert float(sn.sum()) == (1 - label).sum()
+
+    def test_auc_rejects_pr_curve(self):
+        with pytest.raises(ValueError):
+            paddle.static.auc(jnp.ones((4, 2)), jnp.ones(4), curve="PR")
+
+
+class TestGraphKhopSampler:
+    def _graph(self):
+        # 0 <-> 1, 0 <-> 2, 1 <-> 2 (CSC: in-neighbors per column)
+        row = np.array([1, 2, 0, 2, 0, 1])
+        colptr = np.array([0, 2, 4, 6])
+        return row, colptr
+
+    def test_two_hop_structure(self):
+        row, colptr = self._graph()
+        es, ed, si, ri = incubate.graph_khop_sampler(
+            row, colptr, np.array([0]), [2, 2])
+        # hop1: both neighbors of 0; hop2: neighbors of {1, 2}
+        assert es.shape == ed.shape
+        assert es.size == 2 + 4
+        # local-id table starts with the input node
+        assert si[0] == 0
+        np.testing.assert_array_equal(ri, [0])
+        # every edge endpoint resolves through the table to a real neighbor
+        for s, d in zip(es, ed):
+            src, dst = si[s], si[d]
+            ins = row[colptr[dst]:colptr[dst + 1]]
+            assert src in ins
+
+    def test_eids(self):
+        row, colptr = self._graph()
+        es, ed, si, ri, eids = incubate.graph_khop_sampler(
+            row, colptr, np.array([1]), [2], sorted_eids=np.arange(6),
+            return_eids=True)
+        assert eids.size == es.size
+        # edge ids index the CSC row positions that were sampled
+        assert set(int(e) for e in eids) <= set(range(6))
+
+    def test_eids_requires_sorted(self):
+        row, colptr = self._graph()
+        with pytest.raises(ValueError):
+            incubate.graph_khop_sampler(row, colptr, np.array([0]), [1],
+                                        return_eids=True)
+
+
+class TestReviewRegressions:
+    """Round-4 review findings on this batch (ragged causal window,
+    fractional-weight AUC denominator, pre_cache guard, vmap'd batched
+    views)."""
+
+    def test_varlen_causal_ragged_offset_is_per_sample(self):
+        rng = np.random.RandomState(7)
+        b, h, m, n, d = 2, 1, 4, 8, 4
+        q = rng.randn(b, h, m, d).astype("float32")
+        k = rng.randn(b, h, n, d).astype("float32")
+        v = rng.randn(b, h, n, d).astype("float32")
+        qlen = np.array([4, 2])
+        klen = np.array([4, 6])   # batch 0 offset 0, batch 1 offset 4
+        out = np.asarray(IF.variable_length_memory_efficient_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(qlen), jnp.asarray(klen), causal=True))
+        for bi in range(b):
+            off = klen[bi] - qlen[bi]
+            for qi in range(qlen[bi]):
+                kv = min(qi + off + 1, klen[bi])
+                s = (q[bi, 0, qi] @ k[bi, 0, :kv].T) / np.sqrt(d)
+                p = np.exp(s - s.max()); p /= p.sum()
+                np.testing.assert_allclose(out[bi, 0, qi], p @ v[bi, 0, :kv],
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_varlen_pre_cache_raises(self):
+        with pytest.raises(NotImplementedError):
+            IF.variable_length_memory_efficient_attention(
+                jnp.ones((1, 1, 2, 4)), jnp.ones((1, 1, 2, 4)),
+                jnp.ones((1, 1, 2, 4)), jnp.array([2]), jnp.array([2]),
+                pre_cache_length=8)
+
+    def test_auc_fractional_weights_denominator(self):
+        # one positive, one negative, each weight 0.1: perfect ranking
+        # must still give AUC 1.0 (denom 0.01 must not be clamped to 1)
+        inp = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+        label = jnp.asarray([1, 0])
+        w = jnp.asarray([0.1, 0.1])
+        auc_out, _ = paddle.static.auc(inp, label, ins_tag_weight=w)
+        assert float(auc_out) == pytest.approx(1.0)
+
+    def test_batched_views_scale_without_cross_batch_blowup(self):
+        # B*N large enough that the old (B, N, B, N) intermediate would be
+        # ~4 GiB; the vmap'd path computes (B, N, N) directly
+        b, nfeat = 64, 64
+        x = jnp.asarray(np.random.RandomState(8).randn(b, nfeat)
+                        .astype("float32"))
+        H = iauto.Hessian(lambda v: (v ** 2).sum(axis=-1), x, is_batched=True)
+        assert H.shape == (b, nfeat, nfeat)
+        np.testing.assert_allclose(np.asarray(H[0]), 2 * np.eye(nfeat),
+                                   atol=1e-5)
+
+    def test_batched_jacobian_reducing_func(self):
+        J = iauto.Jacobian(lambda x: x.sum(), jnp.ones((4, 3)),
+                           is_batched=True)
+        assert J.shape == (4, 1, 3)
+        np.testing.assert_allclose(np.asarray(J[:]), np.ones((4, 1, 3)))
+
+    def test_varlen_zero_length_sample_yields_zeros_not_nan(self):
+        out = IF.variable_length_memory_efficient_attention(
+            jnp.ones((2, 1, 3, 4), jnp.bfloat16),
+            jnp.ones((2, 1, 5, 4), jnp.bfloat16),
+            jnp.ones((2, 1, 5, 4), jnp.bfloat16),
+            jnp.array([3, 2]), jnp.array([0, 5]))
+        arr = np.asarray(out.astype(jnp.float32))
+        assert np.isfinite(arr).all()
+        assert np.all(arr[0] == 0)          # kv_len 0 -> zeros
+        assert np.any(arr[1] != 0)
+
+    def test_fused_bdrln_attr_false(self):
+        layer = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0,
+                                                  weight_attr=False,
+                                                  bias_attr=False)
+        layer.eval()
+        assert layer.ln_scale is None and layer.ln_bias is None
+        out = layer(jnp.ones((2, 3, 8)), jnp.ones((2, 3, 8)))
+        assert out.shape == (2, 3, 8)
+        # identity-affine LN of a constant row is 0
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
+
+    def test_khop_duplicate_input_nodes(self):
+        row = np.array([1, 2, 0, 2, 0, 1])
+        colptr = np.array([0, 2, 4, 6])
+        es, ed, si, ri = incubate.graph_khop_sampler(
+            row, colptr, np.array([0, 0, 1]), [2])
+        # table dedups: node 0 at row 0, node 1 at row 1
+        assert si[0] == 0 and si[1] == 1
+        np.testing.assert_array_equal(ri, [0, 0, 1])
+        assert es.max() < si.size and ed.max() < si.size
+
+    def test_fused_bdrln_bias_attr_false_drops_linear_bias(self):
+        layer = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0,
+                                                  bias_attr=False)
+        assert layer.linear_bias is None
+        assert "linear_bias" not in layer.state_dict()
+        out = layer(jnp.ones((1, 2, 8)), jnp.ones((1, 2, 8)))
+        assert out.shape == (1, 2, 8)
+
+    def test_varlen_user_mask_fully_masked_row_is_zero(self):
+        m, n = 3, 4
+        mask = np.zeros((1, 1, m, n), "float32")
+        mask[0, 0, 1, :] = -np.inf        # query row 1 fully masked
+        out = np.asarray(IF.variable_length_memory_efficient_attention(
+            jnp.ones((1, 1, m, 4)), jnp.ones((1, 1, n, 4)),
+            jnp.ones((1, 1, n, 4)), jnp.array([m]), jnp.array([n]),
+            mask=jnp.asarray(mask)))
+        assert np.isfinite(out).all()
+        assert np.all(out[0, 0, 1] == 0)
+        assert np.any(out[0, 0, 0] != 0)
