@@ -41,7 +41,7 @@ def prim_enabled() -> bool:
 
 
 def _as_tuple(xs):
-    return (tuple(xs), True) if isinstance(xs, (list, tuple)) else ((xs,), False)
+    return tuple(xs) if isinstance(xs, (list, tuple)) else (xs,)
 
 
 class Jacobian:
@@ -58,7 +58,7 @@ class Jacobian:
 
     def __init__(self, func: Callable, xs, is_batched: bool = False):
         self._func = func
-        self._xs, self._multi_in = _as_tuple(xs)
+        self._xs = _as_tuple(xs)
         self._batched = is_batched
         self._mat = None
 
@@ -100,7 +100,16 @@ class Jacobian:
 
     @property
     def shape(self):
-        return tuple(self._materialize().shape)
+        # static metadata — eval_shape only, no jacobian compute (the
+        # reference's lazy view also answers shape without evaluating)
+        xs = [jnp.asarray(x) for x in self._xs]
+        if self._batched:
+            b = int(xs[0].shape[0])
+            y = jax.eval_shape(self._func, *(x[0] for x in xs))
+            n = sum(int(x.size // b) for x in xs)
+            return (b, int(np.prod(y.shape)), n)
+        y = jax.eval_shape(self._func, *xs)
+        return (int(np.prod(y.shape)), sum(int(x.size) for x in xs))
 
     def __getitem__(self, idx):
         return self._materialize()[idx]
@@ -118,7 +127,7 @@ class Hessian:
 
     def __init__(self, func: Callable, xs, is_batched: bool = False):
         self._func = func
-        self._xs, self._multi_in = _as_tuple(xs)
+        self._xs = _as_tuple(xs)
         self._batched = is_batched
         self._mat = None
 
@@ -164,7 +173,14 @@ class Hessian:
 
     @property
     def shape(self):
-        return tuple(self._materialize().shape)
+        # static metadata, no hessian compute
+        xs = [jnp.asarray(x) for x in self._xs]
+        if self._batched:
+            b = int(xs[0].shape[0])
+            n = sum(int(x.size // b) for x in xs)
+            return (b, n, n)
+        n = sum(int(x.size) for x in xs)
+        return (n, n)
 
     def __getitem__(self, idx):
         return self._materialize()[idx]

@@ -12,10 +12,12 @@ tensor math.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["box_area", "box_iou", "nms", "roi_align", "roi_pool"]
 
@@ -496,3 +498,429 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 __all__ += ["RoIAlign", "RoIPool", "PSRoIPool", "psroi_pool",
             "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
             "generate_proposals"]
+
+
+# --- round-4 detection long tail: SSD / YOLO ops -------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """Caffe/SSD expansion: 1.0 first, then each new ratio (+ reciprocal
+    when flip), deduplicated with 1e-6 tolerance (reference:
+    phi ExpandAspectRatios)."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        ar = float(ar)
+        if any(abs(ar - e) < 1e-6 for e in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset: float = 0.5,
+              min_max_aspect_ratios_order: bool = False, name=None):
+    """SSD prior (anchor) boxes for one feature map (reference:
+    paddle.vision.ops.prior_box — phi prior_box kernel).
+
+    ``input`` [N, C, H, W] feature map, ``image`` [N, C, imH, imW].
+    Returns ``(boxes, variances)`` both [H, W, num_priors, 4]; boxes are
+    normalized (x1, y1, x2, y2) around cell centers ``(j + offset) * step``
+    with the reference's prior ordering (per min_size: aspect-ratio boxes
+    then the sqrt(min*max) box, or min/max/ratios when
+    ``min_max_aspect_ratios_order``).
+    """
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    imh, imw = int(image.shape[2]), int(image.shape[3])
+    step_w = float(steps[0]) if steps and steps[0] else imw / fw
+    step_h = float(steps[1]) if steps and steps[1] else imh / fh
+    min_sizes = [float(m) for m in (min_sizes if isinstance(
+        min_sizes, (list, tuple)) else [min_sizes])]
+    max_sizes = [float(m) for m in (max_sizes or [])]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("max_sizes must pair 1:1 with min_sizes")
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+
+    wh = []                                  # per-prior (w, h) in pixels
+    for i, ms in enumerate(min_sizes):
+        ratio_whs = [(ms * math.sqrt(ar), ms / math.sqrt(ar)) for ar in ars]
+        big = ([(math.sqrt(ms * max_sizes[i]),) * 2] if max_sizes else [])
+        if min_max_aspect_ratios_order:
+            # min, max, then the non-1 ratios (reference flag semantics)
+            wh += [ratio_whs[0]] + big + ratio_whs[1:]
+        else:
+            wh += ratio_whs + big
+    wh = jnp.asarray(wh, jnp.float32)                      # [P, 2]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                        # [H, W]
+    half_w = wh[:, 0] / 2.0
+    half_h = wh[:, 1] / 2.0
+    boxes = jnp.stack([
+        (cxg[..., None] - half_w) / imw,
+        (cyg[..., None] - half_h) / imh,
+        (cxg[..., None] + half_w) / imw,
+        (cyg[..., None] + half_h) / imh,
+    ], axis=-1)                                            # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                                 boxes.shape)
+    return boxes, variances
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0, name=None):
+    """Encode/decode boxes against priors (reference:
+    paddle.vision.ops.box_coder — phi box_coder kernel).
+
+    encode_center_size: ``target_box`` [N, 4] x ``prior_box`` [M, 4] ->
+    [N, M, 4] offsets ((tc - pc)/pw / var, log(tw/pw) / var).
+    decode_center_size: ``target_box`` [N, M, 4] with priors broadcast
+    along ``axis`` -> corner boxes.  ``prior_box_var`` may be None, a
+    [M, 4] tensor, or 4 floats.
+    """
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2.0
+    pcy = pb[:, 1] + ph / 2.0
+    if prior_box_var is None:
+        var = jnp.ones((pb.shape[0], 4), jnp.float32)
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+        if var.ndim == 1:
+            var = jnp.broadcast_to(var, (pb.shape[0], 4))
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2.0
+        tcy = tb[:, 1] + th / 2.0
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / var[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / var[None, :, 3]
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+    if code_type == "decode_center_size":
+        if tb.ndim != 3:
+            raise ValueError("decode_center_size expects target_box [N,M,4]")
+        # priors broadcast along the chosen axis (reference axis semantics)
+        ex = (None, slice(None)) if axis == 0 else (slice(None), None)
+        pcx_b, pcy_b = pcx[ex], pcy[ex]
+        pw_b, ph_b = pw[ex], ph[ex]
+        var_b = var[ex + (slice(None),)]
+        cx = var_b[..., 0] * tb[..., 0] * pw_b + pcx_b
+        cy = var_b[..., 1] * tb[..., 1] * ph_b + pcy_b
+        w = jnp.exp(var_b[..., 2] * tb[..., 2]) * pw_b
+        h = jnp.exp(var_b[..., 3] * tb[..., 3]) * ph_b
+        return jnp.stack([cx - w / 2.0, cy - h / 2.0,
+                          cx + w / 2.0 - norm, cy + h / 2.0 - norm], axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float,
+             downsample_ratio: int, clip_bbox: bool = True, name=None,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5):
+    """Decode one YOLOv3 head into boxes + scores (reference:
+    paddle.vision.ops.yolo_box — phi yolo_box kernel).
+
+    ``x`` [N, C, H, W] with C = len(anchors)/2 * (5 + class_num)
+    (+ len(anchors)/2 leading iou channels when ``iou_aware``);
+    ``img_size`` [N, 2] as (h, w).  Returns ``boxes`` [N, H*W*A, 4] in
+    pixel (x1, y1, x2, y2) and ``scores`` [N, H*W*A, class_num]; boxes
+    with objectness below ``conf_thresh`` are zeroed like the kernel.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n, c, h, w = x.shape
+    an = len(anchors) // 2
+    anchor_wh = jnp.asarray(anchors, jnp.float32).reshape(an, 2)
+    if iou_aware:
+        iou_pred = jax.nn.sigmoid(x[:, :an])        # [N, A, H, W]
+        x = x[:, an:]
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    img = jnp.asarray(img_size, jnp.float32)        # [N, 2] (h, w)
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+    cx = (sx + grid_x) / w                                     # normalized
+    cy = (sy + grid_y) / h
+    bw = jnp.exp(x[:, :, 2]) * anchor_wh[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * anchor_wh[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = (conf ** (1.0 - iou_aware_factor)
+                * iou_pred ** iou_aware_factor)
+    cls = jax.nn.sigmoid(x[:, :, 5:])                          # [N,A,nc,H,W]
+
+    imh = img[:, 0][:, None, None, None]
+    imw = img[:, 1][:, None, None, None]
+    x1 = (cx - bw / 2.0) * imw
+    y1 = (cy - bh / 2.0) * imh
+    x2 = (cx + bw / 2.0) * imw
+    y2 = (cy + bh / 2.0) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1.0)
+        y1 = jnp.clip(y1, 0.0, imh - 1.0)
+        x2 = jnp.clip(x2, 0.0, imw - 1.0)
+        y2 = jnp.clip(y2, 0.0, imh - 1.0)
+    keep = conf >= conf_thresh                                 # [N,A,H,W]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = cls * (conf * keep)[:, :, None]
+    # [N, A, H, W, *] -> [N, A*H*W, *] (anchor-major, the kernel's order)
+    boxes = boxes.reshape(n, an * h * w, 4)
+    scores = jnp.moveaxis(scores, 2, -1).reshape(n, an * h * w, class_num)
+    return boxes, scores
+
+
+def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
+               nms_top_k: int, keep_top_k: int, use_gaussian: bool = False,
+               gaussian_sigma: float = 2.0, background_label: int = 0,
+               normalized: bool = True, return_index: bool = False,
+               return_rois_num: bool = True, name=None):
+    """SOLOv2 matrix NMS — soft suppression by score decay (reference:
+    paddle.vision.ops.matrix_nms — the CPU-only matrix_nms kernel; like
+    the reference this is a HOST op: its output is inherently ragged).
+
+    ``bboxes`` [N, M, 4], ``scores`` [N, C, M].  Per class (skipping
+    ``background_label``): take the ``nms_top_k`` highest scores above
+    ``score_threshold``, decay each score by the worst higher-scored
+    overlap (linear ``(1-iou)/(1-max_iou)`` or gaussian), keep decayed
+    scores above ``post_threshold``, then the best ``keep_top_k`` per
+    image.  Returns ``out`` [No, 6] (class, score, x1, y1, x2, y2)
+    [+ index] [+ rois_num].
+    """
+    bboxes = np.asarray(bboxes, np.float32)
+    scores_np = np.asarray(scores, np.float32)
+    n, cnum, m = scores_np.shape
+    norm = 0.0 if normalized else 1.0
+
+    def iou_mat(b):
+        area = (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+        lt = np.maximum(b[:, None, :2], b[None, :, :2])
+        rb = np.minimum(b[:, None, 2:], b[None, :, 2:])
+        whs = np.clip(rb - lt + norm, 0, None)
+        inter = whs[..., 0] * whs[..., 1]
+        return inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+
+    all_out, all_idx, rois_num = [], [], []
+    for b in range(n):
+        dets = []                     # (score, class, box_idx)
+        for c in range(cnum):
+            if c == background_label:
+                continue
+            sc = scores_np[b, c]
+            sel = np.nonzero(sc > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-sc[sel], kind="stable")][:nms_top_k]
+            boxes_c = bboxes[b, order]
+            iou = np.triu(iou_mat(boxes_c), k=1)      # iou[i, j], i < j
+            # compensation term of the matrix-NMS paper: each suppressor i
+            # is itself discounted by ITS worst overlap with any
+            # higher-scored box (max_iou[i] = max_{k<i} iou[k, i])
+            max_iou = (iou.max(axis=0) if order.size > 1
+                       else np.zeros(order.size))
+            if use_gaussian:
+                # SOLOv2 gaussian kernel exp(-sigma * iou^2): decay is the
+                # RATIO of suppressor/compensation kernels, sigma MULTIPLIES
+                decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - max_iou[:, None],
+                                                 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay,
+                             np.inf).min(axis=0)
+            decay = np.where(np.isfinite(decay), decay, 1.0)
+            dec_sc = sc[order] * decay
+            for j, oi in enumerate(order):
+                if dec_sc[j] >= post_threshold:
+                    dets.append((float(dec_sc[j]), c, int(oi)))
+        dets.sort(key=lambda t: -t[0])
+        if keep_top_k > -1:
+            dets = dets[:keep_top_k]
+        for s, c, oi in dets:
+            box = bboxes[b, oi]
+            all_out.append([c, s, box[0], box[1], box[2], box[3]])
+            all_idx.append(b * m + oi)
+        rois_num.append(len(dets))
+    out = np.asarray(all_out, np.float32).reshape(-1, 6)
+    ret = [jnp.asarray(out)]
+    if return_index:
+        ret.append(jnp.asarray(np.asarray(all_idx, np.int64)))
+    if return_rois_num:
+        ret.append(jnp.asarray(np.asarray(rois_num, np.int32)))
+    return tuple(ret) if len(ret) > 1 else ret[0]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num: int,
+              ignore_thresh: float, downsample_ratio: int, gt_score=None,
+              use_label_smooth: bool = True, name=None,
+              scale_x_y: float = 1.0):
+    """YOLOv3 training loss for one detection head (reference:
+    paddle.vision.ops.yolo_loss — phi yolov3_loss kernel).
+
+    ``x`` [N, A*(5+class_num), H, W] raw head output (A = len(anchor_mask));
+    ``gt_box`` [N, B, 4] normalized (cx, cy, w, h) with zero-area rows as
+    padding; ``gt_label`` [N, B] ints; ``gt_score`` [N, B] optional
+    per-box weights (mixup).  Returns per-sample loss [N].
+
+    Semantics matched to the kernel: each gt picks its best anchor over
+    ALL ``anchors`` by shape-only IoU and contributes targets only when
+    that anchor is in ``anchor_mask``; location loss is sigmoid-CE on
+    (tx, ty) and L1 on (tw, th), weighted by ``2 - w*h``; objectness is
+    sigmoid-CE with negatives whose best gt-IoU exceeds ``ignore_thresh``
+    masked out; class loss is per-class sigmoid-CE with the reference's
+    1/class_num label smoothing.  Static shapes: the gt dimension is a
+    fixed-trip ``fori_loop`` whose sequential writes reproduce the
+    kernel's last-gt-wins overwrite order.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n, c, h, w = x.shape
+    mask = [int(m) for m in anchor_mask]
+    an = len(mask)
+    if c != an * (5 + class_num):
+        raise ValueError(
+            f"x has {c} channels, expected len(anchor_mask)*(5+class_num)="
+            f"{an * (5 + class_num)}")
+    anchors_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    anchors_m = anchors_all[jnp.asarray(mask)]             # [A, 2]
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gt_label = jnp.asarray(gt_label, jnp.int32)
+    bcap = gt_box.shape[1]
+    tscore = (jnp.ones((n, bcap), jnp.float32) if gt_score is None
+              else jnp.asarray(gt_score, jnp.float32))
+    input_h = float(downsample_ratio * h)
+    input_w = float(downsample_ratio * w)
+
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]                                     # [N,A,nc,H,W]
+
+    def sce(logit, label):
+        # sigmoid cross entropy with soft labels, the kernel's exact form
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    # ---- ignore mask: predictions overlapping ANY gt above the threshold
+    # are not penalized as negatives ------------------------------------
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sxy = lambda t: (jax.nn.sigmoid(t) * scale_x_y
+                     - 0.5 * (scale_x_y - 1.0))
+    pred_cx = (sxy(px) + grid_x) / w
+    pred_cy = (sxy(py) + grid_y) / h
+    pred_w = jnp.exp(pw) * anchors_m[None, :, 0, None, None] / input_w
+    pred_h = jnp.exp(ph) * anchors_m[None, :, 1, None, None] / input_h
+    # corner form, [N, A*H*W, 4] vs gt corner form [N, B, 4]
+    pb = jnp.stack([pred_cx - pred_w / 2, pred_cy - pred_h / 2,
+                    pred_cx + pred_w / 2, pred_cy + pred_h / 2],
+                   axis=-1).reshape(n, -1, 4)
+    gb = jnp.stack([gt_box[..., 0] - gt_box[..., 2] / 2,
+                    gt_box[..., 1] - gt_box[..., 3] / 2,
+                    gt_box[..., 0] + gt_box[..., 2] / 2,
+                    gt_box[..., 1] + gt_box[..., 3] / 2], axis=-1)
+    lt = jnp.maximum(pb[:, :, None, :2], gb[:, None, :, :2])
+    rb = jnp.minimum(pb[:, :, None, 2:], gb[:, None, :, 2:])
+    inter = jnp.prod(jnp.clip(rb - lt, 0.0, None), axis=-1)
+    area_p = jnp.prod(pb[:, :, 2:] - pb[:, :, :2], axis=-1)
+    area_g = jnp.prod(jnp.clip(gb[:, :, 2:] - gb[:, :, :2], 0.0, None),
+                      axis=-1)
+    iou = inter / jnp.maximum(area_p[:, :, None] + area_g[:, None]
+                              - inter, 1e-10)
+    # padding gts have zero area -> zero iou, harmless
+    best_iou = iou.max(axis=-1).reshape(n, an, h, w)
+    ignore = best_iou > ignore_thresh
+
+    # ---- gt target assignment (sequential over the gt capacity dim, the
+    # kernel's overwrite order) ------------------------------------------
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)
+    # best anchor over ALL anchors by shape-only IoU
+    gw_px = gt_box[..., 2] * input_w                       # [N, B]
+    gh_px = gt_box[..., 3] * input_h
+    inter_a = (jnp.minimum(gw_px[..., None], anchors_all[None, None, :, 0])
+               * jnp.minimum(gh_px[..., None], anchors_all[None, None, :, 1]))
+    union_a = (gw_px[..., None] * gh_px[..., None]
+               + anchors_all[None, None, :, 0] * anchors_all[None, None, :, 1]
+               - inter_a)
+    best_anchor = jnp.argmax(inter_a / jnp.maximum(union_a, 1e-10), axis=-1)
+    mask_arr = jnp.asarray(mask)
+    in_mask = (best_anchor[..., None] == mask_arr[None, None]).any(-1)
+    mask_idx = jnp.argmax(best_anchor[..., None] == mask_arr[None, None],
+                          axis=-1)                         # [N, B]
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    zeros = jnp.zeros((n, an, h, w), jnp.float32)
+    state = dict(tx=zeros, ty=zeros, tw=zeros, th=zeros, tweight=zeros,
+                 obj=zeros, score=zeros,
+                 tcls=jnp.zeros((n, an, class_num, h, w), jnp.float32))
+
+    batch_ix = jnp.arange(n)
+
+    def assign(b, st):
+        use = valid[:, b] & in_mask[:, b]                  # [N]
+        a = mask_idx[:, b]
+        i_, j_ = gi[:, b], gj[:, b]
+        tx = gt_box[:, b, 0] * w - i_.astype(jnp.float32)
+        ty = gt_box[:, b, 1] * h - j_.astype(jnp.float32)
+        tw_ = jnp.log(jnp.maximum(
+            gw_px[:, b] / anchors_all[best_anchor[:, b], 0], 1e-10))
+        th_ = jnp.log(jnp.maximum(
+            gh_px[:, b] / anchors_all[best_anchor[:, b], 1], 1e-10))
+        wgt = 2.0 - gt_box[:, b, 2] * gt_box[:, b, 3]
+
+        def put(t, vals):
+            cur = t[batch_ix, a, j_, i_]
+            return t.at[batch_ix, a, j_, i_].set(
+                jnp.where(use, vals, cur))
+
+        st = dict(st)
+        st["tx"] = put(st["tx"], tx)
+        st["ty"] = put(st["ty"], ty)
+        st["tw"] = put(st["tw"], tw_)
+        st["th"] = put(st["th"], th_)
+        st["tweight"] = put(st["tweight"], wgt)
+        st["obj"] = put(st["obj"], jnp.ones((n,), jnp.float32))
+        st["score"] = put(st["score"], tscore[:, b])
+        onehot = jax.nn.one_hot(gt_label[:, b], class_num)  # [N, nc]
+        cur = st["tcls"][batch_ix, a, :, j_, i_]
+        st["tcls"] = st["tcls"].at[batch_ix, a, :, j_, i_].set(
+            jnp.where(use[:, None], onehot, cur))
+        return st
+
+    state = jax.lax.fori_loop(0, bcap, assign, state)
+
+    pos = state["obj"] > 0                                 # [N, A, H, W]
+    wpos = state["tweight"] * pos
+    loss_xy = (sce(px, state["tx"]) + sce(py, state["ty"])) * wpos
+    loss_wh = (jnp.abs(pw - state["tw"])
+               + jnp.abs(ph - state["th"])) * wpos
+    loss_obj = (sce(pobj, jnp.ones_like(pobj)) * state["score"] * pos
+                + sce(pobj, jnp.zeros_like(pobj))
+                * (~pos & ~ignore))
+    if use_label_smooth:
+        # kernel smoothing: positive class 1 - 1/nc, negatives 1/nc
+        delta = 1.0 / max(class_num, 1)
+        label_cls = jnp.where(state["tcls"] > 0, 1.0 - delta, delta)
+    else:
+        label_cls = state["tcls"]
+    # positives only, weighted by the gt score like the kernel
+    loss_cls = sce(pcls, label_cls) * (pos * state["score"])[:, :, None]
+    per_sample = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                  + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return per_sample
+
+
+__all__ += ["prior_box", "box_coder", "yolo_box", "matrix_nms", "yolo_loss"]

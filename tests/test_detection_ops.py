@@ -1,0 +1,403 @@
+"""Round-4 detection long tail: prior_box, box_coder, yolo_box,
+matrix_nms, yolo_loss.
+
+Oracles: hand/loop-based numpy re-implementations (independent code
+paths: the ops are vectorized jnp/host code, the oracles are per-element
+python loops), plus closed-form spot values.
+"""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.vision import ops as V
+
+
+class TestPriorBox:
+    def _feature(self, fh, fw, imh, imw):
+        return jnp.zeros((1, 8, fh, fw)), jnp.zeros((1, 3, imh, imw))
+
+    def test_counts_and_centers(self):
+        feat, img = self._feature(2, 3, 64, 96)
+        boxes, var = V.prior_box(feat, img, min_sizes=[16.0],
+                                 aspect_ratios=[2.0], flip=True)
+        # ars expand to [1, 2, 0.5] -> 3 priors per cell
+        assert boxes.shape == (2, 3, 3, 4)
+        assert var.shape == boxes.shape
+        # cell (0,0) center: (0.5*step)/im; square prior of size 16
+        b = np.asarray(boxes)[0, 0, 0]
+        step_w, step_h = 96 / 3, 64 / 2
+        cx, cy = 0.5 * step_w / 96, 0.5 * step_h / 64
+        np.testing.assert_allclose(
+            b, [cx - 8 / 96, cy - 8 / 64, cx + 8 / 96, cy + 8 / 64],
+            rtol=1e-6)
+
+    def test_max_sizes_and_order_flag(self):
+        feat, img = self._feature(1, 1, 32, 32)
+        kw = dict(min_sizes=[8.0], max_sizes=[16.0], aspect_ratios=[2.0],
+                  flip=False)
+        b_default, _ = V.prior_box(feat, img, **kw)
+        b_mm, _ = V.prior_box(feat, img, min_max_aspect_ratios_order=True,
+                              **kw)
+        assert b_default.shape == (1, 1, 3, 4)
+        w = lambda t, p: float(t[0, 0, p, 2] - t[0, 0, p, 0]) * 32
+        # default: [min(8), ar2, sqrt(8*16)]; flag: [min, max, ar2]
+        assert w(b_default, 0) == pytest.approx(8)
+        assert w(b_default, 1) == pytest.approx(8 * math.sqrt(2))
+        assert w(b_default, 2) == pytest.approx(math.sqrt(128))
+        assert w(b_mm, 1) == pytest.approx(math.sqrt(128))
+        assert w(b_mm, 2) == pytest.approx(8 * math.sqrt(2))
+
+    def test_clip_and_variance(self):
+        feat, img = self._feature(1, 1, 16, 16)
+        boxes, var = V.prior_box(feat, img, min_sizes=[32.0], clip=True,
+                                 variance=[0.1, 0.2, 0.3, 0.4])
+        assert float(boxes.min()) >= 0 and float(boxes.max()) <= 1
+        np.testing.assert_allclose(np.asarray(var)[0, 0, 0],
+                                   [0.1, 0.2, 0.3, 0.4])
+
+    def test_mismatched_max_sizes_rejected(self):
+        feat, img = self._feature(1, 1, 16, 16)
+        with pytest.raises(ValueError):
+            V.prior_box(feat, img, min_sizes=[8.0, 16.0], max_sizes=[32.0])
+
+
+class TestBoxCoder:
+    def test_encode_hand_formula(self):
+        prior = jnp.asarray([[0.0, 0.0, 2.0, 2.0]])
+        var = jnp.asarray([[0.1, 0.1, 0.2, 0.2]])
+        target = jnp.asarray([[1.0, 1.0, 3.0, 3.0]])
+        out = np.asarray(V.box_coder(prior, var, target))
+        # prior c=(1,1) wh=(2,2); target c=(2,2) wh=(2,2)
+        np.testing.assert_allclose(
+            out[0, 0], [1 / 2 / 0.1, 1 / 2 / 0.1, 0.0, 0.0], atol=1e-6)
+
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = np.sort(rng.rand(5, 4).astype("float32"), axis=-1)
+        targets = np.sort(rng.rand(3, 4).astype("float32"), axis=-1)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = V.box_coder(jnp.asarray(priors), var, jnp.asarray(targets))
+        # enc is [N_targets, M_priors, 4] with priors varying on dim 1 ->
+        # axis=0 broadcast (the reference's "PriorBox has shape [M, 4]")
+        dec = V.box_coder(jnp.asarray(priors), var, enc,
+                          code_type="decode_center_size", axis=0)
+        np.testing.assert_allclose(
+            np.asarray(dec), np.broadcast_to(targets[:, None], dec.shape),
+            rtol=1e-4, atol=1e-5)
+
+    def test_decode_axis0_broadcast(self):
+        priors = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 2.0, 2.0]])
+        codes = jnp.zeros((2, 2, 4))
+        dec = np.asarray(V.box_coder(priors, None, codes,
+                                     code_type="decode_center_size", axis=0))
+        # zero offsets with unit variance decode to the priors themselves
+        np.testing.assert_allclose(dec[0], np.asarray(priors), atol=1e-6)
+
+    def test_unnormalized_pixel_convention(self):
+        prior = jnp.asarray([[0.0, 0.0, 9.0, 9.0]])   # 10px wide boxes
+        target = jnp.asarray([[0.0, 0.0, 9.0, 9.0]])
+        out = np.asarray(V.box_coder(prior, None, target,
+                                     box_normalized=False))
+        np.testing.assert_allclose(out[0, 0], 0.0, atol=1e-6)
+
+    def test_bad_code_type(self):
+        with pytest.raises(ValueError):
+            V.box_coder(jnp.ones((1, 4)), None, jnp.ones((1, 4)),
+                        code_type="nope")
+
+
+class TestYoloBox:
+    def _oracle(self, x, img_size, anchors, class_num, conf_thresh, ds,
+                clip=True, scale=1.0):
+        n, c, h, w = x.shape
+        an = len(anchors) // 2
+        boxes = np.zeros((n, an, h, w, 4), "float32")
+        scores = np.zeros((n, an, h, w, class_num), "float32")
+        sig = lambda t: 1.0 / (1.0 + np.exp(-t))
+        for b in range(n):
+            imh, imw = img_size[b]
+            for a in range(an):
+                aw, ah = anchors[2 * a], anchors[2 * a + 1]
+                for i in range(h):
+                    for j in range(w):
+                        base = a * (5 + class_num)
+                        tx, ty, tw, th, to = x[b, base:base + 5, i, j]
+                        conf = sig(to)
+                        if conf < conf_thresh:
+                            continue
+                        cx = (sig(tx) * scale - 0.5 * (scale - 1) + j) / w
+                        cy = (sig(ty) * scale - 0.5 * (scale - 1) + i) / h
+                        bw = math.exp(tw) * aw / (ds * w)
+                        bh = math.exp(th) * ah / (ds * h)
+                        x1 = (cx - bw / 2) * imw
+                        y1 = (cy - bh / 2) * imh
+                        x2 = (cx + bw / 2) * imw
+                        y2 = (cy + bh / 2) * imh
+                        if clip:
+                            x1, x2 = np.clip([x1, x2], 0, imw - 1)
+                            y1, y2 = np.clip([y1, y2], 0, imh - 1)
+                        boxes[b, a, i, j] = [x1, y1, x2, y2]
+                        scores[b, a, i, j] = conf * sig(
+                            x[b, base + 5:base + 5 + class_num, i, j])
+        return (boxes.reshape(n, -1, 4),
+                scores.reshape(n, -1, class_num))
+
+    def test_matches_loop_oracle(self):
+        rng = np.random.RandomState(1)
+        anchors = [10, 14, 23, 27]
+        nc = 3
+        x = rng.randn(2, 2 * (5 + nc), 3, 4).astype("float32")
+        img = np.asarray([[48, 64], [96, 128]], "float32")
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                   anchors, nc, conf_thresh=0.3,
+                                   downsample_ratio=16)
+        ob, osc = self._oracle(x, img, anchors, nc, 0.3, 16)
+        np.testing.assert_allclose(np.asarray(boxes), ob, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(scores), osc, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_scale_x_y_and_noclip(self):
+        rng = np.random.RandomState(2)
+        anchors = [8, 8]
+        x = rng.randn(1, 6, 2, 2).astype("float32")
+        img = np.asarray([[32, 32]], "float32")
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                   anchors, 1, conf_thresh=0.0,
+                                   downsample_ratio=8, clip_bbox=False,
+                                   scale_x_y=1.2)
+        ob, osc = self._oracle(x, img, anchors, 1, 0.0, 8, clip=False,
+                               scale=1.2)
+        np.testing.assert_allclose(np.asarray(boxes), ob, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_iou_aware_head(self):
+        rng = np.random.RandomState(3)
+        an, nc = 2, 2
+        x = rng.randn(1, an + an * (5 + nc), 2, 2).astype("float32")
+        img = np.asarray([[16, 16]], "float32")
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                   [4, 4, 8, 8], nc, conf_thresh=0.0,
+                                   downsample_ratio=8, iou_aware=True,
+                                   iou_aware_factor=0.4)
+        sig = lambda t: 1.0 / (1.0 + np.exp(-t))
+        # check one score cell: conf = sig(obj)^0.6 * sig(iou)^0.4
+        iou0 = sig(x[0, 0, 0, 0])
+        obj0 = sig(x[0, an + 4, 0, 0])
+        cls0 = sig(x[0, an + 5, 0, 0])
+        assert float(scores[0, 0, 0]) == pytest.approx(
+            obj0 ** 0.6 * iou0 ** 0.4 * cls0, rel=1e-4)
+
+
+class TestMatrixNms:
+    def test_duplicate_box_fully_decayed_linear(self):
+        # two identical boxes: decay (1-1)/(1-0) = 0 kills the second;
+        # a disjoint box is untouched
+        bboxes = jnp.asarray([[[0, 0, 10, 10], [0, 0, 10, 10],
+                               [20, 20, 30, 30]]], jnp.float32)
+        scores = jnp.asarray([[[0.0, 0.0, 0.0],
+                               [0.9, 0.8, 0.7]]], jnp.float32)
+        out, rois = V.matrix_nms(bboxes, scores, score_threshold=0.1,
+                                 post_threshold=0.1, nms_top_k=10,
+                                 keep_top_k=10)
+        out = np.asarray(out)
+        assert rois[0] == 2 and out.shape == (2, 6)
+        np.testing.assert_allclose(out[:, 1], [0.9, 0.7], atol=1e-6)
+        assert out[0, 0] == 1.0       # class id (background 0 skipped)
+
+    def test_gaussian_partial_decay(self):
+        bboxes = jnp.asarray([[[0, 0, 10, 10], [0, 0, 10, 5]]], jnp.float32)
+        scores = jnp.asarray([[[0.0, 0.0], [0.9, 0.8]]], jnp.float32)
+        out, rois = V.matrix_nms(bboxes, scores, 0.1, 0.0, 10, 10,
+                                 use_gaussian=True, gaussian_sigma=2.0)
+        out = np.asarray(out)
+        iou = 0.5
+        # SOLOv2 kernel exp(-sigma * iou^2), sigma multiplies
+        expect = 0.8 * math.exp(-(iou ** 2) * 2.0)
+        assert float(out[1, 1]) == pytest.approx(expect, rel=1e-4)
+
+    def test_post_threshold_and_topk_and_index(self):
+        rng = np.random.RandomState(4)
+        bboxes = jnp.asarray(rng.rand(2, 6, 4).astype("float32") * 50)
+        b = np.sort(np.asarray(bboxes), axis=-1)
+        scores = jnp.asarray(rng.rand(2, 3, 6).astype("float32"))
+        out, idx, rois = V.matrix_nms(jnp.asarray(b), scores, 0.2, 0.3,
+                                      nms_top_k=4, keep_top_k=2,
+                                      return_index=True)
+        rois = np.asarray(rois)
+        assert rois.sum() == np.asarray(out).shape[0] == np.asarray(idx).size
+        assert (rois <= 2).all()
+        # every reported score above post_threshold, descending per image
+        off = 0
+        for nb in rois:
+            s = np.asarray(out)[off:off + nb, 1]
+            assert (s >= 0.3).all()
+            assert (np.diff(s) <= 1e-6).all()
+            off += nb
+
+
+class TestYoloLoss:
+    def _oracle(self, x, gt_box, gt_label, anchors, mask, nc, ignore, ds,
+                smooth=True, scale=1.0, gt_score=None):
+        n, _, h, w = x.shape
+        an = len(mask)
+        aa = np.asarray(anchors, "float32").reshape(-1, 2)
+        in_h, in_w = ds * h, ds * w
+        sig = lambda t: 1.0 / (1.0 + np.exp(-t))
+        sce = lambda l, t: max(l, 0) - l * t + math.log1p(math.exp(-abs(l)))
+        xr = x.reshape(n, an, 5 + nc, h, w)
+        if gt_score is None:
+            gt_score = np.ones(gt_label.shape, "float32")
+        losses = []
+        for b in range(n):
+            # ignore mask from decoded pred boxes
+            ign = np.zeros((an, h, w), bool)
+            for a in range(an):
+                for i in range(h):
+                    for j in range(w):
+                        cx = (sig(xr[b, a, 0, i, j]) * scale
+                              - 0.5 * (scale - 1) + j) / w
+                        cy = (sig(xr[b, a, 1, i, j]) * scale
+                              - 0.5 * (scale - 1) + i) / h
+                        bw = math.exp(xr[b, a, 2, i, j]) * aa[mask[a], 0] / in_w
+                        bh = math.exp(xr[b, a, 3, i, j]) * aa[mask[a], 1] / in_h
+                        best = 0.0
+                        for g in range(gt_box.shape[1]):
+                            gx, gy, gw, gh = gt_box[b, g]
+                            if gw <= 0 or gh <= 0:
+                                continue
+                            ix = (min(cx + bw / 2, gx + gw / 2)
+                                  - max(cx - bw / 2, gx - gw / 2))
+                            iy = (min(cy + bh / 2, gy + gh / 2)
+                                  - max(cy - bh / 2, gy - gh / 2))
+                            inter = max(ix, 0) * max(iy, 0)
+                            u = bw * bh + gw * gh - inter
+                            best = max(best, inter / max(u, 1e-10))
+                        ign[a, i, j] = best > ignore
+            # targets, last gt wins
+            tobj = np.zeros((an, h, w), "float32")
+            tsc = np.zeros((an, h, w), "float32")
+            tgt = {}
+            for g in range(gt_box.shape[1]):
+                gx, gy, gw, gh = gt_box[b, g]
+                if gw <= 0 or gh <= 0:
+                    continue
+                best_a, best_iou = -1, 0
+                for a in range(aa.shape[0]):
+                    inter = (min(gw * in_w, aa[a, 0])
+                             * min(gh * in_h, aa[a, 1]))
+                    u = gw * in_w * gh * in_h + aa[a, 0] * aa[a, 1] - inter
+                    if inter / max(u, 1e-10) > best_iou:
+                        best_a, best_iou = a, inter / max(u, 1e-10)
+                if best_a not in mask:
+                    continue
+                a = mask.index(best_a)
+                gi, gj = min(int(gx * w), w - 1), min(int(gy * h), h - 1)
+                tgt[(a, gj, gi)] = (gx * w - gi, gy * h - gj,
+                                    math.log(gw * in_w / aa[best_a, 0]),
+                                    math.log(gh * in_h / aa[best_a, 1]),
+                                    2.0 - gw * gh, gt_label[b, g],
+                                    gt_score[b, g])
+                tobj[a, gj, gi] = 1.0
+                tsc[a, gj, gi] = gt_score[b, g]
+            total = 0.0
+            delta = 1.0 / nc if smooth else 0.0
+            for a in range(an):
+                for i in range(h):
+                    for j in range(w):
+                        if tobj[a, i, j] > 0:
+                            tx, ty, tw, th, wt, lab, sc = tgt[(a, i, j)]
+                            total += (sce(xr[b, a, 0, i, j], tx)
+                                      + sce(xr[b, a, 1, i, j], ty)) * wt
+                            total += (abs(xr[b, a, 2, i, j] - tw)
+                                      + abs(xr[b, a, 3, i, j] - th)) * wt
+                            total += sce(xr[b, a, 4, i, j], 1.0) * sc
+                            for cc in range(nc):
+                                lbl = (1 - delta) if cc == lab else delta
+                                if not smooth:
+                                    lbl = 1.0 if cc == lab else 0.0
+                                total += sce(xr[b, a, 5 + cc, i, j],
+                                             lbl) * sc
+                        elif not ign[a, i, j]:
+                            total += sce(xr[b, a, 4, i, j], 0.0)
+            losses.append(total)
+        return np.asarray(losses, "float32")
+
+    def test_matches_loop_oracle(self):
+        rng = np.random.RandomState(5)
+        anchors = [10, 14, 23, 27, 37, 58]
+        mask = [0, 1]
+        nc = 4
+        h = wdim = 4
+        x = rng.randn(2, 2 * (5 + nc), h, wdim).astype("float32") * 0.5
+        gt_box = np.zeros((2, 3, 4), "float32")
+        gt_box[0, 0] = [0.3, 0.4, 0.2, 0.3]
+        gt_box[0, 1] = [0.7, 0.6, 0.4, 0.5]
+        gt_box[1, 0] = [0.5, 0.5, 0.6, 0.6]     # row 2+ padding (zeros)
+        gt_label = np.asarray([[1, 3, 0], [2, 0, 0]], "int64")
+        loss = V.yolo_loss(jnp.asarray(x), jnp.asarray(gt_box),
+                           jnp.asarray(gt_label), anchors, mask, nc,
+                           ignore_thresh=0.5, downsample_ratio=8)
+        ref = self._oracle(x, gt_box, gt_label, anchors, mask, nc, 0.5, 8)
+        np.testing.assert_allclose(np.asarray(loss), ref, rtol=2e-4)
+
+    def test_no_label_smooth_and_gt_score(self):
+        rng = np.random.RandomState(6)
+        anchors = [8, 8, 16, 16]
+        mask = [0, 1]
+        nc = 2
+        x = rng.randn(1, 2 * (5 + nc), 3, 3).astype("float32") * 0.5
+        gt_box = np.asarray([[[0.5, 0.5, 0.3, 0.3]]], "float32")
+        gt_label = np.asarray([[1]], "int64")
+        gt_score = np.asarray([[0.6]], "float32")
+        loss = V.yolo_loss(jnp.asarray(x), jnp.asarray(gt_box),
+                           jnp.asarray(gt_label), anchors, mask, nc,
+                           ignore_thresh=0.6, downsample_ratio=8,
+                           gt_score=jnp.asarray(gt_score),
+                           use_label_smooth=False)
+        ref = self._oracle(x, gt_box, gt_label, anchors, mask, nc, 0.6, 8,
+                           smooth=False, gt_score=gt_score)
+        np.testing.assert_allclose(np.asarray(loss), ref, rtol=2e-4)
+
+    def test_good_prediction_beats_bad(self):
+        # logits encoding the gt exactly must cost less than logits
+        # pointing elsewhere
+        anchors = [16, 16]
+        mask = [0]
+        nc = 2
+        h = w = 4
+        ds = 8
+        gt = np.asarray([[[0.55, 0.55, 0.25, 0.25]]], "float32")
+        lab = np.asarray([[1]], "int64")
+        good = np.zeros((1, 5 + nc, h, w), "float32")
+        good[:, 4] = -8.0                        # background everywhere
+        gi = gj = 2
+        logit = lambda p: math.log(p / (1 - p))
+        good[0, 0, gj, gi] = logit(0.55 * w - gi)
+        good[0, 1, gj, gi] = logit(0.55 * h - gj)
+        good[0, 2, gj, gi] = math.log(0.25 * ds * w / 16)
+        good[0, 3, gj, gi] = math.log(0.25 * ds * h / 16)
+        good[0, 4, gj, gi] = 8.0
+        good[0, 5, gj, gi] = -8.0
+        good[0, 6, gj, gi] = 8.0
+        bad = good.copy()
+        bad[0, 4, gj, gi] = -8.0                 # object missed
+        args = (anchors, mask, nc)
+        lg = float(V.yolo_loss(jnp.asarray(good), jnp.asarray(gt),
+                               jnp.asarray(lab), *args, ignore_thresh=0.7,
+                               downsample_ratio=ds,
+                               use_label_smooth=False)[0])
+        lb = float(V.yolo_loss(jnp.asarray(bad), jnp.asarray(gt),
+                               jnp.asarray(lab), *args, ignore_thresh=0.7,
+                               downsample_ratio=ds,
+                               use_label_smooth=False)[0])
+        assert lg < lb
+
+    def test_channel_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            V.yolo_loss(jnp.ones((1, 7, 2, 2)), jnp.ones((1, 1, 4)),
+                        jnp.ones((1, 1), jnp.int32), [8, 8], [0], 3,
+                        0.5, 8)
