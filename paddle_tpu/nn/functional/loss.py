@@ -449,3 +449,122 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
 __all__ += ["multi_margin_loss", "triplet_margin_with_distance_loss",
             "hsigmoid_loss", "margin_cross_entropy",
             "adaptive_log_softmax_with_loss"]
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank: int = 0,
+              fastemit_lambda: float = 0.001, reduction: str = "mean",
+              name=None):
+    """RNN-Transducer loss (reference: paddle.nn.functional.rnnt_loss over
+    the warprnnt kernel).
+
+    ``input`` [B, T, U+1, V] joint-network LOGITS (log-softmax applied
+    internally, like warprnnt); ``label`` [B, U] ints; ``input_lengths``
+    [B], ``label_lengths`` [B].  Forward DP over the (T, U) lattice:
+
+        alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                                alpha[t, u-1] + emit(t, u-1))
+        -logP = -(alpha[T-1, U] + blank(T-1, U))
+
+    run as a lax.scan over t with an inner scan over u — static shapes,
+    ragged lengths handled by masking.  FastEmit regularization follows
+    the warp-transducer gradient contract exactly: the reported loss is
+    ``L`` while the emission-path gradient is scaled by ``1 + lambda``,
+    via a value-neutral ``lambda * (L_emit - stop_gradient(L_emit))``
+    term where ``L_emit`` recomputes the DP with the blank scores
+    stop-gradiented.
+    """
+    x = jnp.asarray(input)
+    if x.ndim != 4:
+        raise ValueError(f"rnnt_loss expects input [B, T, U+1, V], got "
+                         f"shape {tuple(x.shape)}")
+    b, t_max, u1, v = x.shape
+    labels = jnp.asarray(label, jnp.int32)
+    if labels.shape[1] + 1 != u1:
+        raise ValueError(
+            f"label dim {labels.shape[1]} must be input.shape[2]-1="
+            f"{u1 - 1}")
+    t_len = jnp.asarray(input_lengths, jnp.int32)
+    u_len = jnp.asarray(label_lengths, jnp.int32)
+    try:                       # eager: reject lengths past the tensor dims
+        if int(jnp.max(t_len)) > t_max or int(jnp.max(u_len)) > u1 - 1:
+            raise ValueError(
+                f"input_lengths/label_lengths exceed input dims "
+                f"(T={t_max}, U={u1 - 1}) — the kernel would silently "
+                f"truncate")
+    except jax.errors.ConcretizationTypeError:
+        pass                   # traced: lengths are dynamic, caller's duty
+    logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+
+    def neg_log_like(lp):
+        # lp [B, T, U+1, V]
+        blank_lp = lp[..., blank]                          # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], labels[:, None, :, None], axis=-1
+        )[..., 0]                                          # [B, T, U]
+        neg_inf = jnp.float32(-1e30)
+
+        def row(alpha_prev, inputs):
+            # alpha_prev [B, U+1] = alpha[t-1, :]; returns alpha[t, :]
+            t, (blank_t, emit_t) = inputs
+            from_below = jnp.where(
+                t == 0,
+                jnp.where(jnp.arange(u1)[None] == 0, 0.0, neg_inf),
+                alpha_prev + blank_t)                      # via blank(t-1, u)
+
+            def cell(carry, uin):
+                u, below = uin                             # below [B]
+                left = carry + emit_t_prev_col(u)
+                val = jnp.where(u == 0, below,
+                                jnp.logaddexp(below, left))
+                # t == 0 row: only u == 0 is reachable via the init above;
+                # left transitions use emit(t=0, u-1) which IS valid
+                return val, val
+
+            def emit_t_prev_col(u):
+                # emit(t, u-1) for the left transition into (t, u); the
+                # u == 0 read of the pad column is discarded by the where
+                return emit_row[jnp.arange(b), jnp.maximum(u - 1, 0)]
+
+            # pad one column so U == 0 (empty labels) still indexes
+            emit_row = jnp.concatenate(
+                [emit_t, jnp.full((b, 1), neg_inf)], axis=1)
+            _, cols = jax.lax.scan(
+                cell, jnp.full((b,), neg_inf),
+                (jnp.arange(u1), jnp.moveaxis(from_below, 1, 0)))
+            alpha_t = jnp.moveaxis(cols, 0, 1)             # [B, U+1]
+            return alpha_t, alpha_t
+
+        ts = jnp.arange(t_max)
+        blanks = jnp.moveaxis(blank_lp, 1, 0)              # [T, B, U+1]
+        emits = jnp.moveaxis(emit_lp, 1, 0)                # [T, B, U]
+        # row t consumes blank(t-1, u): shift the blank rows by one
+        blanks_prev = jnp.concatenate(
+            [jnp.zeros((1, b, u1), jnp.float32), blanks[:-1]], axis=0)
+        _, alphas = jax.lax.scan(row, jnp.full((b, u1), neg_inf),
+                                 (ts, (blanks_prev, emits)))
+        # alphas [T, B, U+1]; terminal: alpha[T_b-1, U_b] + blank(T_b-1, U_b)
+        bt = jnp.clip(t_len - 1, 0, t_max - 1)
+        alpha_final = alphas[bt, jnp.arange(b), u_len]
+        blank_final = blank_lp[jnp.arange(b), bt, u_len]
+        return -(alpha_final + blank_final)
+
+    nll = neg_log_like(logp)
+    if fastemit_lambda:
+        # gradient-level FastEmit: lambda extra copies of the emission-path
+        # gradient (values identical, blank path stop-gradiented)
+        blank_only = logp[..., blank:blank + 1]
+        lp_fe = jnp.concatenate(
+            [logp[..., :blank],
+             jax.lax.stop_gradient(blank_only),
+             logp[..., blank + 1:]], axis=-1) \
+            if blank != 0 else jnp.concatenate(
+                [jax.lax.stop_gradient(blank_only), logp[..., 1:]], axis=-1)
+        # value-neutral: the extra term is zero in value (so the reported
+        # loss is exactly L, the warprnnt contract) but contributes the
+        # lambda-scaled emission-path gradient
+        fe = neg_log_like(lp_fe)
+        nll = nll + fastemit_lambda * (fe - jax.lax.stop_gradient(fe))
+    return _reduce(nll, reduction)
+
+
+__all__ += ["rnnt_loss"]

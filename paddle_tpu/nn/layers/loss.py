@@ -329,3 +329,23 @@ __all__ += ["SoftMarginLoss", "MultiLabelSoftMarginLoss", "GaussianNLLLoss",
             "PoissonNLLLoss", "MultiMarginLoss",
             "TripletMarginWithDistanceLoss", "HSigmoidLoss",
             "AdaptiveLogSoftmaxWithLoss"]
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer (reference: paddle.nn.RNNTLoss over the
+    warprnnt kernel; see functional.rnnt_loss for the DP + FastEmit
+    contract)."""
+
+    def __init__(self, blank: int = 0, fastemit_lambda: float = 0.001,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+__all__ += ["RNNTLoss"]

@@ -1,0 +1,146 @@
+"""RNN-Transducer loss (functional.rnnt_loss / nn.RNNTLoss).
+
+Oracle: independent numpy forward-DP over the (T, U) lattice per sample.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def np_rnnt(x, labels, t_len, u_len, blank=0):
+    lp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    out = []
+    for b in range(x.shape[0]):
+        Tb, Ub = int(t_len[b]), int(u_len[b])
+        alpha = np.full((Tb, Ub + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(Tb):
+            for u in range(Ub + 1):
+                if t == 0 and u == 0:
+                    continue
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + lp[b, t - 1, u, blank])
+                if u > 0:
+                    cands.append(alpha[t, u - 1]
+                                 + lp[b, t, u - 1, labels[b, u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(cands)
+        out.append(-(alpha[Tb - 1, Ub] + lp[b, Tb - 1, Ub, blank]))
+    return np.asarray(out, "float32")
+
+
+class TestRNNTLoss:
+    def test_matches_dp_oracle(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 6, 4, 5
+        x = rng.randn(B, T, U + 1, V).astype("float32")
+        labels = rng.randint(1, V, (B, U))
+        tl = np.array([6, 4, 5])
+        ul = np.array([4, 2, 0])
+        out = F.rnnt_loss(jnp.asarray(x), jnp.asarray(labels),
+                          jnp.asarray(tl), jnp.asarray(ul),
+                          fastemit_lambda=0.0, reduction="none")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np_rnnt(x, labels, tl, ul), rtol=1e-4)
+
+    def test_nonzero_blank_index(self):
+        rng = np.random.RandomState(1)
+        B, T, U, V = 2, 4, 2, 4
+        x = rng.randn(B, T, U + 1, V).astype("float32")
+        labels = rng.randint(0, V - 1, (B, U))
+        labels = np.where(labels >= 2, labels + 1, labels)   # avoid blank=2
+        tl = np.array([4, 3])
+        ul = np.array([2, 1])
+        out = F.rnnt_loss(jnp.asarray(x), jnp.asarray(labels),
+                          jnp.asarray(tl), jnp.asarray(ul), blank=2,
+                          fastemit_lambda=0.0, reduction="none")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np_rnnt(x, labels, tl, ul, blank=2),
+                                   rtol=1e-4)
+
+    def test_degenerate_empty_label(self):
+        x = np.zeros((1, 1, 1, 3), "float32")
+        x[0, 0, 0] = [2.0, 0.0, -1.0]
+        out = F.rnnt_loss(jnp.asarray(x), jnp.zeros((1, 0), jnp.int32),
+                          jnp.asarray([1]), jnp.asarray([0]),
+                          fastemit_lambda=0.0, reduction="none")
+        ref = -(2.0 - np.log(np.exp(x[0, 0, 0]).sum()))
+        assert float(out[0]) == pytest.approx(float(ref), abs=1e-5)
+
+    def test_fastemit_value_and_gradient_split(self):
+        rng = np.random.RandomState(2)
+        B, T, U, V = 1, 3, 2, 4
+        x = jnp.asarray(rng.randn(B, T, U + 1, V).astype("float32"))
+        labels = jnp.asarray(rng.randint(1, V, (B, U)))
+        tl, ul = jnp.asarray([T]), jnp.asarray([U])
+        lam = 0.3
+        f0 = lambda x: F.rnnt_loss(x, labels, tl, ul, fastemit_lambda=0.0,
+                                   reduction="sum")
+        fl = lambda x: F.rnnt_loss(x, labels, tl, ul, fastemit_lambda=lam,
+                                   reduction="sum")
+        # value contract: FastEmit is gradient-only — reported loss is
+        # exactly the standard loss (warprnnt behavior)
+        assert float(fl(x)) == pytest.approx(float(f0(x)), rel=1e-6)
+        g0 = np.asarray(jax.grad(f0)(x))
+        gl = np.asarray(jax.grad(fl)(x))
+        # the regularized gradient adds lambda copies of the emission-path
+        # gradient only: it differs from both the standard gradient and a
+        # uniform (1 + lambda) scaling
+        assert not np.allclose(gl, g0, rtol=1e-3)
+        assert not np.allclose(gl, (1 + lam) * g0, rtol=1e-3)
+        assert np.isfinite(gl).all()
+
+    def test_reductions_and_layer(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(2, 3, 3, 4).astype("float32"))
+        labels = jnp.asarray(rng.randint(1, 4, (2, 2)))
+        tl, ul = jnp.asarray([3, 3]), jnp.asarray([2, 2])
+        per = F.rnnt_loss(x, labels, tl, ul, fastemit_lambda=0.0,
+                          reduction="none")
+        assert per.shape == (2,)
+        s = F.rnnt_loss(x, labels, tl, ul, fastemit_lambda=0.0,
+                        reduction="sum")
+        m = F.rnnt_loss(x, labels, tl, ul, fastemit_lambda=0.0,
+                        reduction="mean")
+        assert float(s) == pytest.approx(float(per.sum()), rel=1e-6)
+        assert float(m) == pytest.approx(float(per.mean()), rel=1e-6)
+        layer = paddle.nn.RNNTLoss(fastemit_lambda=0.0, reduction="sum")
+        assert float(layer(x, labels, tl, ul)) == pytest.approx(
+            float(s), rel=1e-6)
+
+    def test_jit_and_grad_descends(self):
+        # a short optimization on the loss must decrease it
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(1, 4, 3, 5).astype("float32"))
+        labels = jnp.asarray([[1, 2]])
+        tl, ul = jnp.asarray([4]), jnp.asarray([2])
+        loss_fn = jax.jit(lambda x: F.rnnt_loss(
+            x, labels, tl, ul, fastemit_lambda=0.0, reduction="sum"))
+        g = jax.jit(jax.grad(lambda x: F.rnnt_loss(
+            x, labels, tl, ul, fastemit_lambda=0.0, reduction="sum")))
+        l0 = float(loss_fn(x))
+        for _ in range(50):
+            x = x - 0.5 * g(x)
+        assert float(loss_fn(x)) < 0.3 * l0
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError):
+            F.rnnt_loss(jnp.ones((2, 3, 4)), jnp.ones((2, 2), jnp.int32),
+                        jnp.asarray([3, 3]), jnp.asarray([2, 2]))
+        with pytest.raises(ValueError):
+            F.rnnt_loss(jnp.ones((1, 3, 3, 4)),
+                        jnp.ones((1, 4), jnp.int32),
+                        jnp.asarray([3]), jnp.asarray([4]))
+
+    def test_overlong_lengths_rejected_eagerly(self):
+        x = jnp.ones((1, 3, 3, 4))
+        labels = jnp.ones((1, 2), jnp.int32)
+        with pytest.raises(ValueError):
+            F.rnnt_loss(x, labels, jnp.asarray([5]), jnp.asarray([2]))
+        with pytest.raises(ValueError):
+            F.rnnt_loss(x, labels, jnp.asarray([3]), jnp.asarray([3]))
