@@ -478,6 +478,9 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank: int = 0,
         raise ValueError(f"rnnt_loss expects input [B, T, U+1, V], got "
                          f"shape {tuple(x.shape)}")
     b, t_max, u1, v = x.shape
+    if not 0 <= blank < v:
+        raise ValueError(f"blank={blank} outside [0, V={v}) — JAX index "
+                         f"clamping would silently retarget it")
     labels = jnp.asarray(label, jnp.int32)
     if labels.shape[1] + 1 != u1:
         raise ValueError(
@@ -552,13 +555,10 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank: int = 0,
     if fastemit_lambda:
         # gradient-level FastEmit: lambda extra copies of the emission-path
         # gradient (values identical, blank path stop-gradiented)
-        blank_only = logp[..., blank:blank + 1]
         lp_fe = jnp.concatenate(
             [logp[..., :blank],
-             jax.lax.stop_gradient(blank_only),
-             logp[..., blank + 1:]], axis=-1) \
-            if blank != 0 else jnp.concatenate(
-                [jax.lax.stop_gradient(blank_only), logp[..., 1:]], axis=-1)
+             jax.lax.stop_gradient(logp[..., blank:blank + 1]),
+             logp[..., blank + 1:]], axis=-1)
         # value-neutral: the extra term is zero in value (so the reported
         # loss is exactly L, the warprnnt contract) but contributes the
         # lambda-scaled emission-path gradient

@@ -42,7 +42,7 @@ expand expand_as expm1 eye flatten flip fliplr flipud floor floor_divide
 floor_mod fmax fmin frac frexp full full_like gammainc gammaincc gammaln
 gather gather_nd gcd geometric_ greater_equal greater_than heaviside
 histogram histogram_bin_edges histogramdd hsplit hstack hypot i0 i0e i1 i1e
-imag increment index_add index_fill index_put index_sample index_select
+iinfo finfo imag increment index_add index_fill index_put index_sample index_select
 inner is_complex is_empty is_floating_point is_grad_enabled is_integer
 is_tensor isclose isfinite isin isinf isnan isneginf isposinf isreal kron
 kthvalue lcm ldexp lerp less_equal less_than lgamma linspace log log10
@@ -92,7 +92,7 @@ PADDLE_NN = """
 AdaptiveAvgPool1D AdaptiveAvgPool2D AdaptiveAvgPool3D AdaptiveLogSoftmaxWithLoss
 AdaptiveMaxPool1D AdaptiveMaxPool2D AdaptiveMaxPool3D AlphaDropout AvgPool1D
 AvgPool2D AvgPool3D BCELoss BCEWithLogitsLoss BatchNorm BatchNorm1D
-BatchNorm2D BatchNorm3D BeamSearchDecoder Bilinear CELU CTCLoss ChannelShuffle
+BatchNorm2D BatchNorm3D BeamSearchDecoder Bilinear CELU CTCLoss RNNTLoss ChannelShuffle
 CircularPad2D CircularPad3D Conv1D Conv1DTranspose Conv2D Conv2DTranspose
 Conv3D Conv3DTranspose CosineEmbeddingLoss CosineSimilarity CrossEntropyLoss
 Dropout Dropout2D Dropout3D ELU Embedding Flatten Fold GELU GLU GRU GRUCell
@@ -123,7 +123,7 @@ adaptive_max_pool3d affine_grid alpha_dropout avg_pool1d avg_pool2d
 avg_pool3d batch_norm bilinear binary_cross_entropy
 binary_cross_entropy_with_logits celu channel_shuffle class_center_sample
 conv1d conv1d_transpose conv2d conv2d_transpose conv3d conv3d_transpose
-cosine_embedding_loss cosine_similarity cross_entropy ctc_loss dice_loss
+cosine_embedding_loss cosine_similarity cross_entropy ctc_loss rnnt_loss dice_loss
 dropout dropout2d dropout3d elu embedding feature_alpha_dropout fold
 gather_tree gaussian_nll_loss gelu glu grid_sample group_norm
 gumbel_softmax hardshrink hardsigmoid hardswish hardtanh hinge_embedding_loss
@@ -205,7 +205,7 @@ to_static
 """
 
 PADDLE_STATIC = """
-InputSpec load_inference_model save_inference_model
+InputSpec accuracy auc load_inference_model save_inference_model
 Program Executor program_guard data default_main_program
 default_startup_program global_scope create_parameter save load
 """
@@ -229,12 +229,18 @@ sparse_csr_tensor sqrt square subtract sum tan tanh transpose
 """
 
 PADDLE_INCUBATE_NN = """
-FusedFeedForward FusedMultiHeadAttention FusedMultiTransformer functional
+FusedFeedForward FusedMultiHeadAttention FusedMultiTransformer
+FusedLinear FusedBiasDropoutResidualLayerNorm functional
 """
 
 PADDLE_INCUBATE = """
 segment_sum segment_mean segment_max segment_min softmax_mask_fuse
-softmax_mask_fuse_upper_triangle identity_loss nn optimizer
+softmax_mask_fuse_upper_triangle identity_loss graph_khop_sampler
+autograd nn optimizer
+"""
+
+PADDLE_INCUBATE_AUTOGRAD = """
+jvp vjp Jacobian Hessian enable_prim disable_prim prim_enabled
 """
 
 PADDLE_INCUBATE_OPT = """
@@ -260,9 +266,9 @@ to_tensor vflip
 """
 
 PADDLE_VISION_OPS = """
-DeformConv2D PSRoIPool RoIAlign RoIPool box_area box_iou deform_conv2d
-distribute_fpn_proposals generate_proposals nms psroi_pool roi_align
-roi_pool
+DeformConv2D PSRoIPool RoIAlign RoIPool box_area box_coder box_iou
+deform_conv2d distribute_fpn_proposals generate_proposals matrix_nms
+nms prior_box psroi_pool roi_align roi_pool yolo_box yolo_loss
 """
 
 PADDLE_QUANTIZATION = """
@@ -381,6 +387,7 @@ fused_feedforward fused_layer_norm fused_linear fused_linear_activation
 fused_matmul_bias fused_multi_head_attention fused_multi_transformer
 fused_rms_norm fused_rotary_position_embedding
 masked_multihead_attention swiglu
+variable_length_memory_efficient_attention
 """
 
 REFERENCE = {
@@ -432,6 +439,7 @@ REFERENCE = {
     "paddle.nn.initializer": PADDLE_NN_INITIALIZER,
     "paddle.vision.datasets": PADDLE_VISION_DATASETS,
     "paddle.incubate.nn.functional": PADDLE_INCUBATE_NN_F,
+    "paddle.incubate.autograd": PADDLE_INCUBATE_AUTOGRAD,
 }
 
 # repo namespace that answers for each reference namespace
@@ -485,6 +493,7 @@ TARGETS = {
     "paddle.nn.initializer": "paddle_tpu.nn.initializer",
     "paddle.vision.datasets": "paddle_tpu.vision.datasets",
     "paddle.incubate.nn.functional": "paddle_tpu.incubate.nn.functional",
+    "paddle.incubate.autograd": "paddle_tpu.incubate.autograd",
 }
 
 

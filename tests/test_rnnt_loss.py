@@ -144,3 +144,12 @@ class TestRNNTLoss:
             F.rnnt_loss(x, labels, jnp.asarray([5]), jnp.asarray([2]))
         with pytest.raises(ValueError):
             F.rnnt_loss(x, labels, jnp.asarray([3]), jnp.asarray([3]))
+
+    def test_blank_out_of_range_rejected(self):
+        x = jnp.ones((1, 3, 3, 4))
+        labels = jnp.ones((1, 2), jnp.int32)
+        tl, ul = jnp.asarray([3]), jnp.asarray([2])
+        with pytest.raises(ValueError):
+            F.rnnt_loss(x, labels, tl, ul, blank=4)
+        with pytest.raises(ValueError):
+            F.rnnt_loss(x, labels, tl, ul, blank=-1)
