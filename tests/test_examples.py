@@ -70,3 +70,10 @@ def test_example_infer_export():
     out = _run("infer_export.py")
     low = out.lower()
     assert "export" in low or "predict" in low or "ok" in low, out[-400:]
+
+
+def test_example_train_detection():
+    out = _run("train_detection.py", "--steps", "150")
+    # the example enforces its own localization/class thresholds
+    assert "localized" in out
+    assert "OK" in out
