@@ -29,7 +29,8 @@ from .tensor import creation as _creation
 
 # framework-level API
 from .framework import (seed, save, load, get_rng_state, set_rng_state,  # noqa: F401
-                        set_default_dtype, get_default_dtype)
+                        set_default_dtype, get_default_dtype,
+                        batch, get_cuda_rng_state, set_cuda_rng_state)
 from .framework.dtype_info import iinfo, finfo  # noqa: F401
 from .framework.random import rng_context, next_rng_key  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
@@ -80,6 +81,15 @@ def is_compiled_with_xpu() -> bool:
 
 def is_compiled_with_tpu() -> bool:
     return True
+
+
+def is_compiled_with_cinn() -> bool:
+    """False literally (no CINN); XLA is the fusion compiler here."""
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
 
 
 def device_count() -> int:

@@ -15,3 +15,18 @@ parameters.
 from .auto_cast import (auto_cast, amp_guard, is_auto_cast_enabled,  # noqa: F401
                         amp_state, decorate, white_list, black_list)
 from .grad_scaler import GradScaler  # noqa: F401
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """Reference: paddle.amp.is_bfloat16_supported — bfloat16 is the TPU's
+    native matmul dtype."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    """Reference: paddle.amp.is_float16_supported — XLA supports f16 on
+    every backend here (bf16 is still the recommended TPU dtype)."""
+    return True
+
+
+from . import debugging  # noqa: E402,F401

@@ -1,0 +1,80 @@
+"""paddle.amp.debugging parity.
+
+Reference: python/paddle/amp/debugging.py — check_numerics (per-tensor
+NaN/Inf abort), operator-stats collection (per-op dtype call counts from
+the eager dispatch layer), and the DebugMode enum.
+
+Stance for the stats collectors (documented, loud): the reference counts
+op calls by hooking eager kernel dispatch; under jit there is no per-op
+Python dispatch to hook — XLA executes a fused program.  The collectors
+therefore warn once and record nothing rather than pretending; use
+``paddle_tpu.profiler`` (jax.profiler traces) to see what actually ran,
+or ``check_numerics``/debug-NaNs for numerics.
+"""
+
+from __future__ import annotations
+
+import enum
+import warnings
+
+from ..framework.debug import check_numerics  # noqa: F401
+
+__all__ = ["check_numerics", "DebugMode",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker"]
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+_WARNED = [False]
+_TENSOR_CHECKER = [False]
+
+
+def _warn_once():
+    if not _WARNED[0]:
+        warnings.warn(
+            "operator-stats collection counts eager kernel dispatches in "
+            "the reference; under XLA there is no per-op dispatch to hook "
+            "— nothing is recorded.  Use paddle_tpu.profiler for the real "
+            "execution timeline.", stacklevel=3)
+        _WARNED[0] = True
+
+
+def enable_operator_stats_collection():
+    _warn_once()
+
+
+def disable_operator_stats_collection():
+    _warn_once()
+
+
+class collect_operator_stats:
+    def __enter__(self):
+        _warn_once()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def enable_tensor_checker(checker_config=None):
+    """Reference: turn on per-op NaN/Inf checking.  Maps to JAX's
+    debug-NaNs AND debug-Infs modes (the reference CHECK_NAN_INF traps
+    both), which check every compiled computation's outputs."""
+    import jax
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_debug_infs", True)
+    _TENSOR_CHECKER[0] = True
+
+
+def disable_tensor_checker():
+    import jax
+    jax.config.update("jax_debug_nans", False)
+    jax.config.update("jax_debug_infs", False)
+    _TENSOR_CHECKER[0] = False

@@ -65,4 +65,71 @@ def enable_tensor_methods() -> None:
     _add("equal_all", lambda self, y: jnp.array_equal(self, y),
          classes=(ArrayImpl,))
     _add("is_tensor", lambda self: True)
+
+    # --- generated delegation: Tensor.op(...) -> paddle.op(tensor, ...) --
+    # The reference generates its Tensor methods from the op registry onto
+    # the pybind Tensor; here the same idea delegates to the top-level
+    # functions (one behavior, one oracle).  The inplace-suffixed names
+    # keep the registry's documented deviation: jax arrays are immutable,
+    # so `x.add_(y)` RETURNS the result instead of mutating x — compiled
+    # paddle code that rebinds (`x = x.add_(y)`) is unchanged, code that
+    # relies on aliasing must rebind.
+    # NOT delegated (jax already provides them): conj/trace/searchsorted
+    # are callable methods with matching semantics; real/imag are numpy
+    # PROPERTIES — patching paddle's method form over them would break
+    # the ubiquitous `x.real` attribute contract, so paddle's `x.real()`
+    # spelling stays unsupported (use paddle.real(x)).
+    import paddle_tpu as _pd
+    _DELEGATED = """cast sqrt floor ceil sign topk gather scatter
+        index_select masked_select split chunk expand tile
+        repeat_interleave broadcast_to flip roll norm dist allclose isnan
+        isfinite isinf unbind put_along_axis take_along_axis kron
+        bincount diff lerp frac deg2rad rad2deg logcumsumexp nanmean
+        nansum nanmedian quantile median mode kthvalue histogram
+        index_sample index_add diagonal_scatter select_scatter
+        slice_scatter masked_fill masked_scatter bucketize
+        moveaxis rot90 tensor_split hsplit vsplit dsplit atleast_1d
+        atleast_2d atleast_3d unflatten as_complex as_real angle
+        trunc add_ subtract_ multiply_ scale_ clip_ zero_
+        fill_ exponential_ normal_ uniform_ bernoulli_ fill_diagonal_
+        floor_divide remainder fmax fmin inner outer cross mv
+        logical_and logical_or logical_xor logical_not bitwise_and
+        bitwise_or bitwise_xor bitwise_not greater_than greater_equal
+        less_than less_equal not_equal heaviside nan_to_num""".split()
+    for _name in _DELEGATED:
+        _fn = getattr(_pd, _name, None)
+        if _fn is None:
+            continue
+
+        def _method(self, *a, _fn=_fn, **k):
+            return _fn(self, *a, **k)
+
+        _add(_name, _method)
+    _add("ndimension", lambda self: self.ndim)
+    _add("element_size", lambda self: jnp.dtype(self.dtype).itemsize)
+    _add("is_contiguous", lambda self: True)   # XLA layout is opaque/dense
+    _add("contiguous", lambda self: self)
+    _add("value", lambda self: self)
+    _add("get_tensor", lambda self: self)
+    _add("pin_memory", lambda self: self)
+
+    def _no_tape(name, guidance):
+        def method(self, *a, **k):
+            raise RuntimeError(
+                f"Tensor.{name}() does not exist in the TPU-native engine: "
+                + guidance)
+        return method
+
+    _add("backward", _no_tape(
+        "backward", "build the step as jax.value_and_grad over "
+        "nn.functional_call (see docs/migration.md)"), classes=(ArrayImpl,))
+    _add("register_hook", _no_tape(
+        "register_hook", "use jax.custom_vjp / autograd.PyLayer for "
+        "gradient interception"), classes=(ArrayImpl,))
+    _add("set_value", _no_tape(
+        "set_value", "jax arrays are immutable — use x.at[...].set(v) and "
+        "rebind"), classes=(ArrayImpl,))
+    _add("copy_", _no_tape(
+        "copy_", "jax arrays are immutable — rebind the new value"),
+        classes=(ArrayImpl,))
     _DONE = True

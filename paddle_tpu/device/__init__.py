@@ -130,3 +130,87 @@ from .custom import (  # noqa: E402
 __all__ += ["custom", "CustomPlace", "register_custom_device",
             "unregister_custom_device", "get_all_custom_device_type",
             "is_compiled_with_custom_device", "custom_device_count"]
+
+
+class Stream:
+    """Reference: paddle.device.Stream.  XLA owns stream scheduling (the
+    compiler orders device work); this facade keeps the API so ported
+    code runs — wait_event/wait_stream/synchronize order HOST progress
+    the way record/wait order device streams in the reference."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def query(self) -> bool:
+        synchronize(self.device)
+        return True
+
+
+class Event:
+    """Reference: paddle.device.Event over the stream facade."""
+
+    def __init__(self, device=None, enable_timing: bool = False,
+                 blocking: bool = False, interprocess: bool = False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def stream_guard(stream):
+    """Reference: paddle.device.stream_guard — ops issued in the guard run
+    on the given stream.  XLA schedules streams itself; the guard keeps
+    scope semantics (the stream is synchronized on exit, matching the
+    reference's ordering guarantee at the guard boundary)."""
+    try:
+        yield stream
+    finally:
+        if stream is not None:
+            stream.synchronize()
+
+
+def current_stream(device=None) -> "Stream":
+    return Stream(device)
+
+
+def get_available_device():
+    """Reference: paddle.device.get_available_device — every visible
+    device, tagged the reference way."""
+    import jax
+    out = []
+    for i, d in enumerate(jax.devices()):
+        out.append("cpu" if d.platform == "cpu" else f"{d.platform}:{i}")
+    return out
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device() if not d.startswith(("cpu",))]
+
+
+__all__ += ["Stream", "Event", "stream_guard", "current_stream",
+            "get_available_device", "get_available_custom_device"]

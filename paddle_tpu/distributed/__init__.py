@@ -15,7 +15,8 @@ from .collective import (ReduceOp, all_reduce, all_gather,  # noqa: F401
                          destroy_process_group,  # noqa: F401
                          all_gather_object, reduce_scatter, alltoall,
                          alltoall_single, broadcast, reduce, scatter,
-                         barrier, send, recv, new_group, wait)
+                         barrier, send, recv, new_group, wait,
+                         P2POp, batch_isend_irecv, is_available)
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
 from . import fleet as _fleet_mod  # noqa: F401
 from .fleet import fleet  # noqa: F401
@@ -41,6 +42,7 @@ _AUTO_PARALLEL_NAMES = (
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
     "shard_optimizer", "unshard_dtensor", "get_placements",
     "shard_dataloader", "to_static", "DistModel", "Engine",
+    "set_mesh", "get_mesh",
 )
 
 

@@ -19,7 +19,7 @@ from .placement import (ProcessMesh, Placement, Shard, Replicate, Partial,
                         compute_placements_spec, placements_to_spec)
 from .api import (shard_tensor, dtensor_from_fn, reshard, shard_layer,
                   shard_optimizer, unshard_dtensor, get_placements,
-                  shard_dataloader)
+                  shard_dataloader, set_mesh, get_mesh)
 from .spmd_rules import (DistTensorSpec, matmul_spmd, elementwise_spmd,
                          reduction_spmd, embedding_spmd, softmax_spmd,
                          transpose_spmd, split_spmd)

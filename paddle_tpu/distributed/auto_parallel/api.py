@@ -283,3 +283,19 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
                     yield place(batch)
 
     return _ShardedLoader(dataloader)
+
+
+# --- global default mesh (reference: paddle.distributed.set_mesh/get_mesh,
+# auto_parallel/api.py — the process-global mesh the sharding APIs fall
+# back to when no mesh is passed) ------------------------------------------
+
+_GLOBAL_MESH = [None]
+
+
+def set_mesh(mesh):
+    _GLOBAL_MESH[0] = mesh
+    return mesh
+
+
+def get_mesh():
+    return _GLOBAL_MESH[0]

@@ -458,3 +458,47 @@ def destroy_process_group(group=None):
         _GROUPS.pop(g.id, None)
         for k in [k for k in _EAGER_CACHE if k[0] == g.id]:
             _EAGER_CACHE.pop(k, None)
+
+
+class P2POp:
+    """One operation of a batched p2p exchange (reference:
+    paddle.distributed.P2POp(op, tensor, peer, group)).  Constructible so
+    ported code that builds op lists imports cleanly; execution follows
+    the send/recv stance (see batch_isend_irecv)."""
+
+    def __init__(self, op, tensor, peer: int, group=None):
+        name = getattr(op, "__name__", str(op))
+        if name not in ("isend", "irecv"):
+            raise ValueError(
+                f"P2POp expects isend or irecv, got {name!r} (the "
+                f"reference rejects other ops the same way)")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Reference: paddle.distributed.batch_isend_irecv — a batch of
+    isend/irecv launched as one grouped NCCL call.  Under single-
+    controller SPMD an eager p2p batch is not expressible: the exchange
+    IS a collective-permute, so it must run inside a traced region.  Use
+    ``distributed.p2p.send_recv`` (shard_map + lax.ppermute — the
+    pipeline runtime's path) with the (src, dst) pairs from the op list.
+    """
+    if not p2p_op_list:
+        raise ValueError("batch_isend_irecv requires a non-empty op list")
+    for op in p2p_op_list:
+        if not isinstance(op, P2POp):
+            raise ValueError(f"expected P2POp, got {type(op).__name__}")
+    raise RuntimeError(
+        "batch_isend_irecv outside a traced region is not expressible "
+        "under single-controller SPMD; express the exchange as "
+        "distributed.p2p.send_recv (shard_map + lax.ppermute) — the "
+        "pipeline runtime does exactly this")
+
+
+def is_available() -> bool:
+    """Reference: paddle.distributed.is_available — the distributed
+    package is always compiled into this framework."""
+    return True

@@ -26,7 +26,9 @@ from .topology import (HybridCommunicateGroup, set_hybrid_communicate_group,
 
 __all__ = ["init", "get_hybrid_communicate_group", "distributed_model",
            "distributed_optimizer", "worker_index", "worker_num",
-           "is_first_worker", "barrier_worker", "fleet"]
+           "is_first_worker", "barrier_worker", "fleet",
+           "UserDefinedRoleMaker", "PaddleCloudRoleMaker", "Role",
+           "is_worker", "is_server", "server_num"]
 
 _strategy: Optional[DistributedStrategy] = None
 
@@ -38,6 +40,8 @@ def init(role_maker=None, is_collective: bool = True,
     global _strategy
     strategy = strategy or DistributedStrategy()
     _strategy = strategy
+    if role_maker is not None:
+        _set_role_maker(role_maker)
     h = strategy.hybrid_configs
     hcg = HybridCommunicateGroup(
         dp_degree=h["dp_degree"], mp_degree=h["mp_degree"],
@@ -141,5 +145,107 @@ class _FleetModule:
     barrier_worker = staticmethod(barrier_worker)
     DistributedStrategy = DistributedStrategy
 
+    # role-maker surface resolves lazily (the classes are defined below
+    # this class in the module)
+    def __getattr__(self, name):
+        if name in ("UserDefinedRoleMaker", "PaddleCloudRoleMaker", "Role",
+                    "is_worker", "is_server", "server_num"):
+            import sys
+            return getattr(sys.modules[__name__], name)
+        raise AttributeError(name)
+
 
 fleet = _FleetModule()
+
+
+# --- role makers (reference: python/paddle/distributed/fleet/base/
+# role_maker.py — the PS-era role config objects fleet.init accepts) ------
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class UserDefinedRoleMaker:
+    """Explicit role table (reference: UserDefinedRoleMaker(current_id,
+    role, worker_num, server_endpoints)).  Drives the parameter-server
+    runtime (paddle_tpu.distributed.ps); collective training derives its
+    topology from the mesh instead."""
+
+    def __init__(self, is_collective: bool = False, init_gloo: bool = False,
+                 current_id: int = 0, role=Role.WORKER,
+                 worker_num: int = 1, server_endpoints=None, **kwargs):
+        self._current_id = int(current_id)
+        self._role = role
+        self._worker_num = int(worker_num)
+        self._server_endpoints = list(server_endpoints or [])
+        self._is_collective = is_collective
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    """Role from the launcher env contract (reference:
+    PaddleCloudRoleMaker reads PADDLE_TRAINER_ID / TRAINING_ROLE /
+    PADDLE_PSERVERS_IP_PORT_LIST — the env our launch/main.py sets)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        import os
+        role_s = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        role = Role.SERVER if role_s == "PSERVER" else Role.WORKER
+        servers = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        cur = int(os.environ.get(
+            "PADDLE_PSERVER_ID" if role == Role.SERVER
+            else "PADDLE_TRAINER_ID", 0))
+        n_work = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                    os.environ.get("PADDLE_WORLD_SIZE", 1)))
+        super().__init__(is_collective=is_collective, current_id=cur,
+                         role=role, worker_num=n_work,
+                         server_endpoints=servers)
+
+
+_role_maker = [None]
+
+
+def _set_role_maker(rm):
+    _role_maker[0] = rm
+
+
+def is_worker() -> bool:
+    rm = _role_maker[0]
+    return rm.is_worker() if rm is not None else True
+
+
+def is_server() -> bool:
+    rm = _role_maker[0]
+    return rm.is_server() if rm is not None else False
+
+
+def server_num() -> int:
+    rm = _role_maker[0]
+    return rm.server_num() if rm is not None else 0

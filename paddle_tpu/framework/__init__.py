@@ -22,3 +22,32 @@ def set_default_dtype(d) -> None:
 
 def get_default_dtype():
     return _default_dtype
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Reference: paddle.batch — wrap a sample reader into a batch reader
+    (the legacy reader-decorator API; DataLoader is the modern path)."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader
+
+
+def get_cuda_rng_state():
+    """Reference: paddle.get_cuda_rng_state — the device generator state.
+    One key-based generator drives every device here (threefry keys, not
+    a curand state vector)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)

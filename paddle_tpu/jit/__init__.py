@@ -277,3 +277,43 @@ def load_program(path: str):
     the exported device count)."""
     with open(path + ".pdprog", "rb") as f:
         return jax.export.deserialize(bytearray(f.read()))
+
+
+def _apply_jit_log_level(also_to_stdout: bool = False):
+    """The two knobs are independent (reference contract): the effective
+    logger level is the most verbose either one requests — code dumps
+    need DEBUG, verbosity 1 needs INFO."""
+    import logging
+    logger = logging.getLogger("paddle_tpu.dy2static")
+    want = logging.WARNING
+    if _JIT_LOG["verbosity"] >= 2:
+        want = logging.DEBUG
+    elif _JIT_LOG["verbosity"] == 1:
+        want = logging.INFO
+    if _JIT_LOG["code_level"] > 0:
+        want = min(want, logging.DEBUG)
+    logger.setLevel(want)
+    if also_to_stdout and not logger.handlers:
+        import sys
+        logger.addHandler(logging.StreamHandler(sys.stdout))
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Reference: paddle.jit.set_code_level — dump converted code when the
+    dy2static log level reaches ``level``.  Routed to the dy2static
+    converter's logger (converted source is what it prints)."""
+    _JIT_LOG["code_level"] = level
+    _apply_jit_log_level(also_to_stdout)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Reference: paddle.jit.set_verbosity — dy2static transform logging.
+    Independent of set_code_level: lowering verbosity does not cancel
+    code dumps."""
+    _JIT_LOG["verbosity"] = level
+    _apply_jit_log_level(also_to_stdout)
+
+
+_JIT_LOG = {"code_level": -1, "verbosity": 0}
+
+__all__ += ["set_code_level", "set_verbosity"]

@@ -1130,6 +1130,12 @@ def convert_to_static(fn: Callable) -> Callable:
     # the module later rebinds a helper the converted body references
     glb = fn.__globals__
     glb[_GEN + "_jst"] = _JST
+    import logging
+    _logger = logging.getLogger("paddle_tpu.dy2static")
+    if _logger.isEnabledFor(logging.DEBUG):
+        # jit.set_code_level: show the converted source
+        _logger.debug("converted %s:\n%s", fn.__qualname__,
+                      ast.unparse(fdef))
     code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
                    mode="exec")
     ns: dict = {}

@@ -210,3 +210,29 @@ class Dirac(Initializer):
             for d in range(min(per, in_c)):
                 w = w.at[(g * per + d, d) + centers].set(1.0)
         return w
+
+
+# --- global default initializer (reference: paddle.nn.initializer.
+# set_global_initializer — the process-wide default create_parameter
+# falls back to when neither attr nor the layer passes one) ---------------
+
+_GLOBAL_INIT = [None, None]          # [weight_init, bias_init]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: set_global_initializer(weight_init, bias_init); pass
+    ``None, None`` to reset to the built-in defaults (XavierNormal /
+    zeros)."""
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_INIT[1] if is_bias else _GLOBAL_INIT[0]
+
+
+__all__ += ["set_global_initializer"]

@@ -92,9 +92,14 @@ class Layer:
         global default initializer).
         """
         dtype = jnp.dtype(dtype) if dtype is not None else self._dtype
-        init = default_initializer
         if attr is not None and attr.initializer is not None:
+            # explicit ParamAttr wins over everything (reference contract)
             init = attr.initializer
+        else:
+            # set_global_initializer overrides layer defaults for params
+            # created WITHOUT an explicit initializer (reference:
+            # nn/initializer/__init__.py — set_global_initializer)
+            init = I._global_initializer(is_bias) or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init(tuple(shape), dtype=dtype)

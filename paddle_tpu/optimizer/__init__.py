@@ -1,6 +1,6 @@
 """paddle_tpu.optimizer (parity surface: python/paddle/optimizer/)."""
 
-from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp,  # noqa: F401
+from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp, ASGD,  # noqa: F401
                         Adadelta, Adamax)
 from .adam import (Adam, AdamW, FusedAdamW, Lamb, NAdam, RAdam,  # noqa: F401
                    Rprop)

@@ -27,7 +27,7 @@ from .clip import GradClipBase, clip_grads
 from .lr import LRScheduler, make_scheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adadelta",
-           "Adamax"]
+           "Adamax", "ASGD"]
 
 
 def _tree_cast(tree, dtype):
@@ -346,3 +346,46 @@ class Adamax(Optimizer):
         upd = lr_t * m / (u + self.epsilon)
         return (p - upd.astype(p.dtype)).astype(p.dtype), \
             {"moment": m, "inf_norm": u}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference: paddle.optimizer.ASGD —
+    asgd op; Schmidt et al., "Minimizing Finite Sums with the Stochastic
+    Average Gradient").  Keeps the running gradient sum ``d`` and the
+    last seen gradient per batch slot ``y`` (``batch_num`` slots, rotated
+    by step):
+
+        d       <- d - y[slot] + g
+        y[slot] <- g
+        param   <- param - lr * d / min(seen, batch_num)
+
+    With batch_num=1 this reduces to plain SGD.  Slot memory is
+    ``batch_num`` gradient copies per parameter, faithful to the
+    reference's accumulator layout.
+    """
+
+    def __init__(self, learning_rate=0.001, batch_num: int = 1,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        if batch_num <= 0:
+            raise ValueError(f"batch_num must be positive, got {batch_num}")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self.batch_num = int(batch_num)
+
+    def _init_slot(self, p):
+        return {"d": jnp.zeros(p.shape, jnp.float32),
+                "y": jnp.zeros((self.batch_num,) + tuple(p.shape),
+                               jnp.float32)}
+
+    def _update_param(self, g, p, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        slot = (step % self.batch_num).astype(jnp.int32)
+        d = slots["d"] - slots["y"][slot] + g32
+        y = slots["y"].at[slot].set(g32)
+        # average over gradients actually SEEN, not the slot capacity —
+        # otherwise the first batch_num-1 steps are up to batch_num x too
+        # small (reference: n = min(step, m) in the asgd kernel)
+        n = jnp.minimum(step + 1, self.batch_num).astype(jnp.float32)
+        new_p = p - lr * (d / n).astype(p.dtype)
+        return new_p.astype(p.dtype), {"d": d, "y": y}

@@ -40,7 +40,8 @@ diag diag_embed diagflat diagonal diagonal_scatter diff digamma dist divide
 dot dsplit dstack einsum empty empty_like equal equal_all erf erfinv exp
 expand expand_as expm1 eye flatten flip fliplr flipud floor floor_divide
 floor_mod fmax fmin frac frexp full full_like gammainc gammaincc gammaln
-gather gather_nd gcd geometric_ greater_equal greater_than heaviside
+gather gather_nd gcd batch get_cuda_rng_state set_cuda_rng_state
+is_compiled_with_cinn is_compiled_with_rocm geometric_ greater_equal greater_than heaviside
 histogram histogram_bin_edges histogramdd hsplit hstack hypot i0 i0e i1 i1e
 iinfo finfo imag increment index_add index_fill index_put index_sample index_select
 inner is_complex is_empty is_floating_point is_grad_enabled is_integer
@@ -155,7 +156,8 @@ ReduceOp all_gather all_gather_object all_reduce alltoall alltoall_single
 barrier broadcast broadcast_object_list destroy_process_group get_backend
 get_group get_rank get_world_size group_sharded_parallel gather init_parallel_env irecv isend
 is_initialized new_group recv reduce reduce_scatter scatter
-scatter_object_list send spawn wait stream
+scatter_object_list send spawn wait stream P2POp batch_isend_irecv
+is_available set_mesh get_mesh
 ParallelEnv DistributedStrategy fleet get_hybrid_communicate_group
 ProcessMesh shard_tensor shard_layer reshard Shard Replicate Partial
 Strategy to_static shard_optimizer unshard_dtensor dtensor_from_fn
@@ -163,8 +165,8 @@ split rpc launch recompute save_state_dict load_state_dict
 """
 
 PADDLE_OPTIMIZER = """
-Adadelta Adagrad Adam Adamax AdamW LBFGS Lamb Momentum NAdam Optimizer
-RAdam RMSProp Rprop SGD lr
+ASGD Adadelta Adagrad Adam Adamax AdamW LBFGS Lamb Momentum NAdam
+Optimizer RAdam RMSProp Rprop SGD lr
 """
 
 PADDLE_OPT_LR = """
@@ -196,12 +198,19 @@ Accuracy Auc Metric Precision Recall accuracy
 """
 
 PADDLE_AMP = """
-GradScaler auto_cast decorate
+GradScaler auto_cast decorate debugging is_bfloat16_supported
+is_float16_supported
+"""
+
+PADDLE_AMP_DEBUGGING = """
+DebugMode check_numerics collect_operator_stats
+disable_operator_stats_collection disable_tensor_checker
+enable_operator_stats_collection enable_tensor_checker
 """
 
 PADDLE_JIT = """
 TranslatedLayer enable_to_static ignore_module load not_to_static save
-to_static
+set_code_level set_verbosity to_static
 """
 
 PADDLE_STATIC = """
@@ -311,8 +320,9 @@ fc conv2d batch_norm embedding
 """
 
 PADDLE_DISTRIBUTED_FLEET = """
-DistributedStrategy barrier_worker distributed_model distributed_optimizer
-init is_first_worker worker_index worker_num
+DistributedStrategy PaddleCloudRoleMaker UserDefinedRoleMaker
+barrier_worker distributed_model distributed_optimizer init
+is_first_worker is_server is_worker server_num worker_index worker_num
 """
 
 PADDLE_FLEET_META_OPTIMIZERS = """
@@ -333,7 +343,9 @@ vector_to_parameters weight_norm remove_weight_norm spectral_norm
 """
 
 PADDLE_DEVICE = """
-get_device set_device device_count synchronize cuda empty_cache
+Event Stream current_stream get_available_custom_device
+get_available_device get_device set_device device_count stream_guard
+synchronize cuda empty_cache
 max_memory_allocated max_memory_reserved memory_allocated memory_reserved
 """
 
@@ -373,7 +385,7 @@ no_grad vjp
 PADDLE_NN_INITIALIZER = """
 Assign Constant Dirac Initializer KaimingNormal KaimingUniform Normal
 Orthogonal TruncatedNormal Uniform XavierNormal XavierUniform
-calculate_gain
+calculate_gain set_global_initializer
 """
 
 PADDLE_VISION_DATASETS = """
@@ -440,6 +452,7 @@ REFERENCE = {
     "paddle.vision.datasets": PADDLE_VISION_DATASETS,
     "paddle.incubate.nn.functional": PADDLE_INCUBATE_NN_F,
     "paddle.incubate.autograd": PADDLE_INCUBATE_AUTOGRAD,
+    "paddle.amp.debugging": PADDLE_AMP_DEBUGGING,
 }
 
 # repo namespace that answers for each reference namespace
@@ -494,6 +507,7 @@ TARGETS = {
     "paddle.vision.datasets": "paddle_tpu.vision.datasets",
     "paddle.incubate.nn.functional": "paddle_tpu.incubate.nn.functional",
     "paddle.incubate.autograd": "paddle_tpu.incubate.autograd",
+    "paddle.amp.debugging": "paddle_tpu.amp.debugging",
 }
 
 

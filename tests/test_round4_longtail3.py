@@ -1,0 +1,289 @@
+"""Round-4 third adversarial-sweep batch: distributed p2p batch API,
+role makers, ASGD, global initializer, device stream facades,
+amp.debugging, jit logging knobs, paddle.batch, cuda-rng aliases, mesh
+globals, and the generated Tensor-method compat surface.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.initializer as I
+import paddle_tpu.optimizer as opt
+from paddle_tpu.nn.layer import ParamAttr
+
+
+class TestDistributedAdditions:
+    def test_is_available(self):
+        assert paddle.distributed.is_available() is True
+
+    def test_p2pop_validation(self):
+        op = paddle.distributed.P2POp(paddle.distributed.isend,
+                                      jnp.ones(2), 1)
+        assert op.peer == 1
+        with pytest.raises(ValueError):
+            paddle.distributed.P2POp(print, jnp.ones(2), 1)
+
+    def test_batch_isend_irecv_stance(self):
+        op = paddle.distributed.P2POp(paddle.distributed.irecv,
+                                      jnp.ones(2), 0)
+        with pytest.raises(RuntimeError, match="ppermute"):
+            paddle.distributed.batch_isend_irecv([op])
+        with pytest.raises(ValueError):
+            paddle.distributed.batch_isend_irecv([])
+        with pytest.raises(ValueError):
+            paddle.distributed.batch_isend_irecv(["nope"])
+
+    def test_set_get_mesh(self):
+        mesh = paddle.distributed.ProcessMesh([0], dim_names=["x"])
+        paddle.distributed.set_mesh(mesh)
+        assert paddle.distributed.get_mesh() is mesh
+        paddle.distributed.set_mesh(None)
+        assert paddle.distributed.get_mesh() is None
+
+
+class TestRoleMakers:
+    def test_user_defined(self):
+        fleet = paddle.distributed.fleet
+        rm = fleet.UserDefinedRoleMaker(
+            current_id=1, role=fleet.Role.WORKER, worker_num=4,
+            server_endpoints=["h:1", "h:2"])
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.worker_index() == 1 and rm.worker_num() == 4
+        assert rm.server_num() == 2
+        assert not rm.is_first_worker()
+
+    def test_paddlecloud_from_env(self):
+        env = {"TRAINING_ROLE": "PSERVER", "PADDLE_PSERVER_ID": "1",
+               "PADDLE_PSERVERS_IP_PORT_LIST": "127.0.0.1:1,127.0.0.1:2"}
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            rm = paddle.distributed.fleet.PaddleCloudRoleMaker()
+            assert rm.is_server()
+            assert rm.server_index() == 1
+            assert rm.get_pserver_endpoints() == ["127.0.0.1:1",
+                                                  "127.0.0.1:2"]
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def test_fleet_init_records_role(self):
+        fleet = paddle.distributed.fleet
+        rm = fleet.UserDefinedRoleMaker(current_id=0, role=fleet.Role.WORKER,
+                                        worker_num=2)
+        fleet.init(role_maker=rm)
+        assert fleet.is_worker() and not fleet.is_server()
+
+
+class TestASGD:
+    def test_batch_num_1_is_sgd(self):
+        params = {"w": jnp.ones(3)}
+        o = opt.ASGD(learning_rate=0.1, batch_num=1)
+        st = o.init(params)
+        p, st = o.update({"w": jnp.full(3, 2.0)}, st, params)
+        np.testing.assert_allclose(np.asarray(p["w"]), 0.8, rtol=1e-6)
+
+    def test_average_over_slots(self):
+        # averages over gradients SEEN (min(step, m)), not slot capacity:
+        # step1 d=2 n=1 -> p=0.8; step2 d=6 n=2 -> 0.8-0.3=0.5;
+        # step3 replaces slot0 (2->6): d=10 n=2 -> 0.5-0.5=0.0
+        params = {"w": jnp.ones(3)}
+        o = opt.ASGD(learning_rate=0.1, batch_num=2)
+        st = o.init(params)
+        p = params
+        p, st = o.update({"w": jnp.full(3, 2.0)}, st, p)
+        np.testing.assert_allclose(np.asarray(p["w"]), 0.8, rtol=1e-5)
+        p, st = o.update({"w": jnp.full(3, 4.0)}, st, p)
+        np.testing.assert_allclose(np.asarray(p["w"]), 0.5, rtol=1e-5)
+        p, st = o.update({"w": jnp.full(3, 6.0)}, st, p)
+        np.testing.assert_allclose(np.asarray(p["w"]), 0.0, atol=1e-5)
+
+    def test_rejects_bad_batch_num(self):
+        with pytest.raises(ValueError):
+            opt.ASGD(batch_num=0)
+
+
+class TestGlobalInitializer:
+    def teardown_method(self, m):
+        I.set_global_initializer(None, None)
+
+    def test_overrides_defaults_not_explicit_attr(self):
+        I.set_global_initializer(I.Constant(0.5), I.Constant(0.25))
+        lin = nn.Linear(3, 4)
+        assert float(lin.weight[0, 0]) == 0.5
+        assert float(lin.bias[0]) == 0.25
+        explicit = nn.Linear(3, 4,
+                             weight_attr=ParamAttr(initializer=I.Constant(2.0)))
+        assert float(explicit.weight[0, 0]) == 2.0
+
+    def test_reset(self):
+        I.set_global_initializer(I.Constant(0.5))
+        I.set_global_initializer(None, None)
+        lin = nn.Linear(3, 4)
+        assert float(lin.bias[0]) == 0.0
+
+    def test_type_checked(self):
+        with pytest.raises(TypeError):
+            I.set_global_initializer("xavier")
+
+
+class TestMiscTopLevel:
+    def test_batch_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter(range(3)), 0)
+
+    def test_stream_guard_syncs_on_exception(self):
+        synced = []
+
+        class S(paddle.device.Stream):
+            def synchronize(self):
+                synced.append(1)
+
+        with pytest.raises(RuntimeError):
+            with paddle.device.stream_guard(S()):
+                raise RuntimeError("boom")
+        assert synced
+
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(b) for b in r()] == [3, 3]
+
+    def test_cuda_rng_aliases(self):
+        st = paddle.get_cuda_rng_state()
+        paddle.seed(123)
+        a = paddle.rand([3])
+        paddle.set_cuda_rng_state(st)
+        assert paddle.get_cuda_rng_state() is not None
+
+    def test_compiled_with(self):
+        assert paddle.is_compiled_with_cinn() is False
+        assert paddle.is_compiled_with_rocm() is False
+
+    def test_jit_logging_knobs_independent(self):
+        import logging
+        logger = logging.getLogger("paddle_tpu.dy2static")
+        paddle.jit.set_verbosity(1)
+        paddle.jit.set_code_level(-1)
+        assert logger.level == logging.INFO
+        paddle.jit.set_code_level(100)
+        assert logger.level == logging.DEBUG
+        # lowering verbosity must NOT cancel the code-dump level
+        paddle.jit.set_verbosity(0)
+        assert logger.level == logging.DEBUG
+        paddle.jit.set_code_level(-1)
+        assert logger.level == logging.WARNING
+
+
+class TestDeviceStreamFacade:
+    def test_stream_event_protocol(self):
+        s = paddle.device.Stream()
+        e = s.record_event()
+        assert e.query() is True
+        e2 = paddle.device.Event()
+        e2.record(s)
+        s.wait_event(e2)
+        s2 = paddle.device.Stream()
+        s2.wait_stream(s)
+        assert s.query() is True
+
+    def test_stream_guard_and_current(self):
+        s = paddle.device.current_stream()
+        with paddle.device.stream_guard(s) as g:
+            assert g is s
+
+    def test_get_available_device(self):
+        devs = paddle.device.get_available_device()
+        assert isinstance(devs, list) and devs
+
+
+class TestAmpAdditions:
+    def test_supported_flags(self):
+        assert paddle.amp.is_bfloat16_supported() is True
+        assert paddle.amp.is_float16_supported() is True
+
+    def test_debugging_warn_once_and_check_numerics(self):
+        from paddle_tpu.amp import debugging as adbg
+        adbg._WARNED[0] = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            adbg.enable_operator_stats_collection()
+            adbg.disable_operator_stats_collection()
+            with adbg.collect_operator_stats():
+                pass
+        assert len(w) == 1
+        out = adbg.check_numerics(jnp.ones(3), "op", "var")
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+    def test_tensor_checker_toggles(self):
+        import jax
+        from paddle_tpu.amp import debugging as adbg
+        adbg.enable_tensor_checker()
+        assert jax.config.jax_debug_nans
+        adbg.disable_tensor_checker()
+        assert not jax.config.jax_debug_nans
+
+
+class TestCompatGeneratedMethods:
+    @classmethod
+    def setup_class(cls):
+        from paddle_tpu.compat import enable_tensor_methods
+        enable_tensor_methods()
+
+    def test_delegated_functional_methods(self):
+        t = jnp.asarray(np.arange(6.0).reshape(2, 3))
+        vals, idx = t.topk(2)
+        assert vals.shape == (2, 2)
+        assert len(t.split(3, axis=1)) == 3
+        assert float(t.norm()) == pytest.approx(
+            np.linalg.norm(np.arange(6.0)))
+        assert t.cast("int32").dtype == jnp.int32
+        assert t.flip(0).shape == (2, 3)
+        assert t.unbind(0)[0].shape == (3,)
+        assert t.broadcast_to([2, 2, 3]).shape == (2, 2, 3)
+        assert bool(t.isfinite().all())
+
+    def test_inplace_names_return_result(self):
+        t = jnp.ones((2, 2))
+        out = t.add_(jnp.ones((2, 2)))
+        assert float(out[0, 0]) == 2.0
+        assert float(t[0, 0]) == 1.0          # immutability documented
+        assert float(t.zero_()[0, 0]) == 0.0
+
+    def test_meta_methods(self):
+        t = jnp.ones((2, 3), jnp.float32)
+        assert t.element_size() == 4
+        assert t.ndimension() == 2
+        assert t.is_contiguous() is True
+        assert t.contiguous() is t
+        assert t.value() is t
+
+    def test_tape_methods_raise_with_guidance(self):
+        t = jnp.ones(3)
+        with pytest.raises(RuntimeError, match="value_and_grad"):
+            t.backward()
+        with pytest.raises(RuntimeError, match="custom_vjp"):
+            t.register_hook(lambda g: g)
+        with pytest.raises(RuntimeError, match="immutable"):
+            t.set_value(jnp.zeros(3))
+        with pytest.raises(RuntimeError, match="immutable"):
+            t.copy_(jnp.zeros(3))
+
+    def test_trace_safe_under_jit(self):
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.add_(x).norm()
+
+        assert float(f(jnp.ones(4))) == pytest.approx(4.0)
