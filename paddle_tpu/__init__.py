@@ -34,6 +34,7 @@ from .framework import (seed, save, load, get_rng_state, set_rng_state,  # noqa:
 from .framework.dtype_info import iinfo, finfo  # noqa: F401
 from .framework.random import rng_context, next_rng_key  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
+from . import sysconfig  # noqa: F401
 from .autograd import no_grad, grad, enable_grad, is_grad_enabled  # noqa: F401
 from .nn.layer import ParamAttr  # noqa: F401
 
@@ -133,6 +134,10 @@ def __getattr__(name):
         from .hapi import Model
         globals()["Model"] = Model
         return Model
+    if name == "callbacks":  # paddle.callbacks lives in hapi
+        from .hapi import callbacks
+        globals()["callbacks"] = callbacks
+        return callbacks
     if name == "DataParallel":
         from .distributed.parallel import DataParallel
         globals()["DataParallel"] = DataParallel

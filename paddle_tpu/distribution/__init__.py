@@ -560,13 +560,15 @@ __all__ += ["ExponentialFamily", "Exponential", "Gamma", "Geometric",
 
 
 from .extra import (  # noqa: E402,F401
+    Weibull, LKJCholesky,
     AbsTransform, Binomial, Cauchy, ChainTransform, Chi2,
     ContinuousBernoulli, ExpTransform, Independent, IndependentTransform,
     MultivariateNormal, PowerTransform, ReshapeTransform, SigmoidTransform,
     SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
     Transform)
 
-__all__ += ["AbsTransform", "Binomial", "Cauchy", "ChainTransform", "Chi2",
+__all__ += ["Weibull", "LKJCholesky",
+            "AbsTransform", "Binomial", "Cauchy", "ChainTransform", "Chi2",
             "ContinuousBernoulli", "ExpTransform", "Independent",
             "IndependentTransform", "MultivariateNormal", "PowerTransform",
             "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",

@@ -24,7 +24,8 @@ __all__ = [
     "MultivariateNormal", "AbsTransform", "ChainTransform", "ExpTransform",
     "IndependentTransform", "PowerTransform", "ReshapeTransform",
     "SigmoidTransform", "SoftmaxTransform", "StackTransform",
-    "StickBreakingTransform", "TanhTransform", "Transform"]
+    "StickBreakingTransform", "TanhTransform", "Transform", "Weibull",
+    "LKJCholesky"]
 
 
 class Binomial(Distribution):
@@ -477,3 +478,112 @@ class StickBreakingTransform(Transform):
         # d y_i / d z_i = cumprod, d z_i / d x_i = sigmoid'(t)
         return (jnp.log(cum) - jax.nn.softplus(-t)
                 - jax.nn.softplus(t)).sum(-1)
+
+
+class Weibull(Distribution):
+    """Weibull(scale, concentration) — reference:
+    python/paddle/distribution/weibull.py (a TransformedDistribution of
+    Exponential via PowerTransform in the reference; direct closed forms
+    here).  scale = lambda, concentration = k."""
+
+    def __init__(self, scale, concentration, name=None):
+        self.scale = jnp.asarray(scale, jnp.float32)
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+
+    @property
+    def mean(self):
+        return self.scale * jnp.exp(
+            jax.scipy.special.gammaln(1 + 1 / self.concentration))
+
+    @property
+    def variance(self):
+        g1 = jnp.exp(jax.scipy.special.gammaln(1 + 1 / self.concentration))
+        g2 = jnp.exp(jax.scipy.special.gammaln(1 + 2 / self.concentration))
+        return self.scale ** 2 * (g2 - g1 ** 2)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.scale.shape, self.concentration.shape)
+        u = jax.random.uniform(_key(key), shape, minval=1e-7, maxval=1.0)
+        return self.scale * (-jnp.log(u)) ** (1 / self.concentration)
+
+    def log_prob(self, value):
+        x = jnp.asarray(value, jnp.float32)
+        k, lam = self.concentration, self.scale
+        z = x / lam
+        # safe-where both branches: log(z) at z <= 0 would poison the
+        # selected branch's value (x == 0, k == 1) and gradients (x < 0)
+        zsafe = jnp.where(x > 0, z, 1.0)
+        lp = (jnp.log(k / lam) + (k - 1) * jnp.log(zsafe)
+              - jnp.where(x > 0, z, 0.0) ** k)
+        at0 = jnp.where(k == 1.0, -jnp.log(lam),
+                        jnp.where(k > 1.0, -jnp.inf, jnp.inf))
+        return jnp.where(x > 0, lp, jnp.where(x == 0, at0, -jnp.inf))
+
+    def entropy(self):
+        # Euler-Mascheroni gamma
+        em = 0.5772156649015329
+        k, lam = self.concentration, self.scale
+        return em * (1 - 1 / k) + jnp.log(lam / k) + 1
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices
+    (reference: python/paddle/distribution/lkj_cholesky.py; Lewandowski-
+    Kurowicka-Joe 2009).  ``concentration`` (eta) = 1 is uniform over
+    correlation matrices; sampling uses the onion method (per-row Beta
+    radius x uniform hypersphere direction)."""
+
+    def __init__(self, dim: int, concentration=1.0,
+                 sample_method: str = "onion", name=None):
+        if dim < 2:
+            raise ValueError(f"dim must be >= 2, got {dim}")
+        if sample_method != "onion":
+            raise NotImplementedError(
+                f"sample_method {sample_method!r} is not implemented; the "
+                f"onion method draws from the same LKJ(eta) distribution")
+        self.dim = int(dim)
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        # onion per-row Beta parameters: row i (= off + 1, off = 0..d-2)
+        # has m = i sub-diagonal entries, its squared radius is
+        # Beta(m/2, eta + (d-2)/2 - off/2)
+        off = jnp.arange(dim - 1, dtype=jnp.float32)
+        self._b1 = 0.5 * off + 0.5
+        self._b0 = (self.concentration + 0.5 * (dim - 2) - 0.5 * off)
+
+    def sample(self, shape=(), key=None):
+        d = self.dim
+        k1, k2 = jax.random.split(_key(key))
+        shape = tuple(shape)
+        # squared radius of each row block below the diagonal
+        y = jax.random.beta(k1, self._b1, self._b0,
+                            shape + (d - 1,))               # [.., d-1]
+        normal = jax.random.normal(k2, shape + (d - 1, d - 1))
+        # row i uses its first i entries as the direction vector
+        tri_mask = (jnp.arange(d - 1)[None, :]
+                    <= jnp.arange(d - 1)[:, None])          # [d-1, d-1]
+        masked = normal * tri_mask
+        norm = jnp.linalg.norm(masked, axis=-1, keepdims=True)
+        direction = masked / jnp.maximum(norm, 1e-12)
+        w = jnp.sqrt(y)[..., None] * direction              # rows 1..d-1
+        L = jnp.zeros(shape + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        L = L.at[..., 1:, :-1].set(w)
+        diag = jnp.sqrt(jnp.clip(1.0 - y, 1e-12, None))
+        L = L.at[..., jnp.arange(1, d), jnp.arange(1, d)].set(diag)
+        return L
+
+    def log_prob(self, value):
+        L = jnp.asarray(value, jnp.float32)
+        d = self.dim
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        # exponent per diagonal entry i (1-based): 2(eta-1) + d - 1 - i
+        order = (2.0 * (self.concentration - 1.0)
+                 + d - 1 - jnp.arange(1, d, dtype=jnp.float32))
+        unnorm = jnp.sum(order * jnp.log(diag), axis=-1)
+        dm1 = d - 1
+        alpha = self.concentration + 0.5 * dm1
+        denom = jax.scipy.special.gammaln(alpha) * dm1
+        numer = jax.scipy.special.multigammaln(alpha - 0.5, dm1)
+        pi_const = 0.5 * dm1 * math.log(math.pi)
+        return unnorm - (pi_const + numer - denom)

@@ -4,6 +4,7 @@ VisualDL)."""
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import List, Optional
@@ -316,3 +317,108 @@ __all__ += ["ReduceLROnPlateau"]
 
 LRScheduler = LRSchedulerCallback   # reference name: paddle.callbacks.LRScheduler
 __all__ += ["LRScheduler"]
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: paddle.callbacks.VisualDL —
+    writes VisualDL event files; VisualDL is a separate pip in the
+    reference too).  Deviation (documented): records are written as
+    JSON lines (`{tag, step, value}` per line, one file per run) — a
+    stable, greppable format any dashboard can ingest; point TensorBoard
+    users at paddle_tpu.profiler for trace-viewer output instead."""
+
+    def __init__(self, log_dir: str = "vdl_log"):
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _writer(self):
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                            "a", buffering=1)
+        return self._fh
+
+    def _emit(self, prefix, logs, step):
+        w = self._writer()
+        for k, v in (logs or {}).items():
+            try:
+                v = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            w.write(json.dumps({"tag": f"{prefix}/{k}", "step": int(step),
+                                "value": v}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._emit("train", logs, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._emit("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._emit("eval", logs, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference: paddle.callbacks.WandbCallback).
+    Requires the ``wandb`` package — absent from this environment, so
+    construction raises with guidance instead of silently no-oping."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback needs the `wandb` package; it is not "
+                "installed in this environment.  Use callbacks.VisualDL "
+                "(JSONL scalars) for local logging.") from e
+        self._wandb = wandb
+        self._run = None
+        self._step = 0
+        self._settings = dict(project=project, entity=entity, name=name,
+                              dir=dir, mode=mode, job_type=job_type,
+                              **kwargs)
+
+    def _log(self, prefix, logs):
+        if self._run is None:
+            return
+        payload = {}
+        for k, v in (logs or {}).items():
+            try:
+                payload[f"{prefix}/{k}"] = float(
+                    v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+        if payload:
+            self._run.log(payload, step=self._step)
+
+    def on_train_begin(self, logs=None):
+        if self._run is None:
+            self._run = self._wandb.init(
+                **{k: v for k, v in self._settings.items()
+                   if v is not None})
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._log("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("train_epoch", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+__all__ += ["VisualDL", "WandbCallback"]

@@ -1,0 +1,26 @@
+"""paddle.sysconfig parity — header/library paths for extension builds.
+
+Reference: python/paddle/sysconfig.py — get_include()/get_lib() feed
+custom-op build scripts.  Here the native pieces live in
+``paddle_tpu/lib`` (C++ TCPStore server, shm ring); there are no C++
+headers to compile against (the extension seam is
+paddle_tpu.device.register_custom_device + ctypes), so get_include
+returns the package's include dir, creating the convention even while
+empty.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return os.path.join(_PKG, "include")
+
+
+def get_lib() -> str:
+    return os.path.join(_PKG, "lib")

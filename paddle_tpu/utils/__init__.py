@@ -118,3 +118,60 @@ class _UniqueName:
 unique_name = _UniqueName()
 
 from . import cpp_extension  # noqa: F401
+
+
+def require_version(min_version: str, max_version: str = None):
+    """Reference: paddle.utils.require_version — assert the installed
+    framework version is inside [min_version, max_version].  Raises
+    ValueError on malformed inputs and RuntimeError (not ImportError —
+    the reference's choice) on mismatch."""
+    from .. import __version__
+
+    def parse(v, what):
+        if not isinstance(v, str) or not v:
+            raise ValueError(f"{what} must be a non-empty str, got {v!r}")
+        parts = v.split(".")
+        if not all(p.isdigit() for p in parts):
+            raise ValueError(f"{what} {v!r} is not a dotted integer version")
+        return tuple(int(p) for p in parts)
+
+    cur = parse(__version__, "installed version")
+    lo = parse(min_version, "min_version")
+    if cur < lo:
+        raise RuntimeError(
+            f"installed version {__version__} < required min_version "
+            f"{min_version}")
+    if max_version is not None:
+        hi = parse(max_version, "max_version")
+        if cur > hi:
+            raise RuntimeError(
+                f"installed version {__version__} > allowed max_version "
+                f"{max_version}")
+
+
+class _LegacyProfilerModule:
+    """paddle.utils.profiler parity (the legacy profiler entry points,
+    python/paddle/utils/profiler.py) — thin aliases over
+    paddle_tpu.profiler."""
+
+    @staticmethod
+    def start_profiler(state="All", tracer_option="Default"):
+        from .. import profiler as P
+        prof = P.Profiler()
+        prof.start()
+        _LegacyProfilerModule._active = prof
+        return prof
+
+    @staticmethod
+    def stop_profiler(sorted_key=None, profile_path=None):
+        prof = getattr(_LegacyProfilerModule, "_active", None)
+        if prof is not None:
+            prof.stop()
+            if profile_path:
+                prof.export(profile_path)
+            _LegacyProfilerModule._active = None
+
+
+profiler = _LegacyProfilerModule()
+
+__all__ += ["require_version", "profiler"]

@@ -225,6 +225,7 @@ ExponentialFamily Gamma Geometric Gumbel Laplace LogNormal Multinomial
 Normal Poisson StudentT TransformedDistribution Uniform kl_divergence
 register_kl
 Binomial Cauchy Chi2 ContinuousBernoulli Independent MultivariateNormal
+Weibull LKJCholesky
 Transform AbsTransform AffineTransform ChainTransform ExpTransform
 IndependentTransform PowerTransform ReshapeTransform SigmoidTransform
 SoftmaxTransform StackTransform StickBreakingTransform TanhTransform
@@ -258,11 +259,16 @@ LookAhead ModelAverage
 
 PADDLE_CALLBACKS = """
 Callback EarlyStopping LRScheduler ModelCheckpoint ProgBarLogger
-ReduceLROnPlateau
+ReduceLROnPlateau VisualDL WandbCallback
 """
 
 PADDLE_UTILS = """
-cpp_extension deprecated dlpack run_check try_import unique_name
+cpp_extension deprecated dlpack profiler require_version run_check
+try_import unique_name
+"""
+
+PADDLE_SYSCONFIG = """
+get_include get_lib
 """
 
 PADDLE_VISION_TRANSFORMS = """
@@ -453,6 +459,7 @@ REFERENCE = {
     "paddle.incubate.nn.functional": PADDLE_INCUBATE_NN_F,
     "paddle.incubate.autograd": PADDLE_INCUBATE_AUTOGRAD,
     "paddle.amp.debugging": PADDLE_AMP_DEBUGGING,
+    "paddle.sysconfig": PADDLE_SYSCONFIG,
 }
 
 # repo namespace that answers for each reference namespace
@@ -508,6 +515,7 @@ TARGETS = {
     "paddle.incubate.nn.functional": "paddle_tpu.incubate.nn.functional",
     "paddle.incubate.autograd": "paddle_tpu.incubate.autograd",
     "paddle.amp.debugging": "paddle_tpu.amp.debugging",
+    "paddle.sysconfig": "paddle_tpu.sysconfig",
 }
 
 

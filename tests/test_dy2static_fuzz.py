@@ -1,6 +1,7 @@
 """Differential fuzzing for dy2static: seeded random programs over the
-supported subset (nested tensor-dependent if/while/for-range with
-break/continue and and/or conditions) must produce identical results
+supported subset (nested tensor-dependent if/while/for-range,
+for-over-tensor, try/except/finally passthrough, break/continue and
+and/or conditions) must produce identical results
 eagerly and converted+jitted — the reference validates its
 ProgramTranslator the same way, with a fixed corpus of dygraph models.
 
@@ -18,7 +19,7 @@ import jax.numpy as jnp
 import paddle_tpu  # noqa: F401
 from paddle_tpu import jit as pjit
 
-N_PROGRAMS = 40
+N_PROGRAMS = 60
 
 
 def _gen_block(rng, depth, indent, loop_id, in_for=False):
@@ -28,9 +29,10 @@ def _gen_block(rng, depth, indent, loop_id, in_for=False):
     lines = []
     n_stmts = rng.randint(1, 4)
     for _ in range(n_stmts):
-        kind = rng.choice(["assign", "if", "while", "for", "ret"],
-                          p=[0.40, 0.25, 0.13, 0.13, 0.09] if depth > 0
-                          else [1.0, 0, 0, 0, 0])
+        kind = rng.choice(
+            ["assign", "if", "while", "for", "ret", "for_tensor", "try"],
+            p=[0.32, 0.21, 0.11, 0.11, 0.08, 0.09, 0.08] if depth > 0
+            else [1.0, 0, 0, 0, 0, 0, 0])
         if kind == "ret":
             # early return matching the tail structure (acc, t) — but
             # never inside a for (out of the return-rewrite subset)
@@ -76,6 +78,41 @@ def _gen_block(rng, depth, indent, loop_id, in_for=False):
             if rng.rand() < 0.3:
                 lines.append(pad + f"    if t > {round(float(rng.uniform(1, 4)), 2)}:")
                 lines.append(pad + "        break")
+        elif kind == "for_tensor":
+            # round-4 statement form: for-over-tensor converts to ONE
+            # traced while_loop; break/continue ride the flag rewrite
+            loop_id += 1
+            v = f"v{loop_id}"
+            lines.append(pad + f"for {v} in x:")
+            jump = rng.rand()
+            if jump < 0.25:
+                lines.append(pad + f"    if {v} > "
+                             f"{round(float(rng.uniform(-0.5, 0.5)), 2)}:")
+                lines.append(pad + "        continue")
+            elif jump < 0.45:
+                lines.append(pad + f"    if acc.sum() > "
+                             f"{round(float(rng.uniform(3, 8)), 2)}:")
+                lines.append(pad + "        break")
+            c = round(float(rng.uniform(0.05, 0.4)), 3)
+            lines.append(pad + f"    acc = acc + {v} * {c}")
+            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id,
+                                    in_for=True)
+            lines.extend(b)
+        elif kind == "try":
+            # round-4 statement form: try/except passthrough (the body
+            # never raises, the handler must stay dead in BOTH modes);
+            # finally always runs
+            lines.append(pad + "try:")
+            b, loop_id = _gen_block(rng, depth - 1, indent + 1, loop_id,
+                                    in_for)
+            lines.extend(b)   # _gen_block always emits >= 1 statement
+            lines.append(pad + "except (ValueError, RuntimeError):")
+            lines.append(pad + "    t = t + 1000.0")
+            if rng.rand() < 0.5:
+                lines.append(pad + "finally:")
+                lines.append(
+                    pad + f"    t = t * "
+                    f"{round(float(rng.uniform(0.9, 0.999)), 3)}")
         else:  # for-range
             loop_id += 1
             k = f"k{loop_id}"

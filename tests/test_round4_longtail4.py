@@ -1,0 +1,162 @@
+"""Round-4 fourth sweep: Weibull/LKJCholesky distributions, VisualDL and
+Wandb callbacks, sysconfig, utils.require_version, the legacy
+utils.profiler shim, and paddle.callbacks top-level wiring.
+
+Oracles: closed-form moments and densities (Weibull integral == 1, LKJ
+d=2 uniform-correlation facts), real Model.fit logging for VisualDL.
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Weibull, LKJCholesky
+
+
+class TestWeibull:
+    def test_moments_and_density(self):
+        w = Weibull(2.0, 1.5)
+        s = np.asarray(w.sample((20000,)))
+        assert abs(s.mean() - float(w.mean)) < 0.05
+        assert abs(s.var() - float(w.variance)) < 0.15
+        xs = np.linspace(1e-3, 12, 4000)
+        p = np.exp(np.asarray(w.log_prob(jnp.asarray(xs))))
+        assert abs(np.trapezoid(p, xs) - 1) < 1e-3
+
+    def test_edge_values_and_gradients(self):
+        # x == 0 with k == 1 is the exponential density at 0: log(1/lam)
+        w1 = Weibull(2.0, 1.0)
+        assert float(w1.log_prob(jnp.asarray(0.0))) == pytest.approx(
+            -np.log(2.0))
+        assert float(Weibull(2.0, 2.0).log_prob(jnp.asarray(0.0))) == -np.inf
+        # negative support: -inf value AND finite (zero) gradient — the
+        # unselected log(z) branch must not poison grads
+        import jax
+        g = jax.grad(lambda x: jnp.where(
+            jnp.isfinite(w1.log_prob(x)), w1.log_prob(x), 0.0))(
+                jnp.asarray(-1.0))
+        assert np.isfinite(float(g))
+
+    def test_support_and_entropy(self):
+        w = Weibull(1.0, 2.0)
+        assert float(w.log_prob(jnp.asarray(-0.5))) == -np.inf
+        # k=1 reduces to Exponential(1/lambda): entropy = 1 + ln(lambda)
+        e = Weibull(3.0, 1.0)
+        assert float(e.entropy()) == pytest.approx(1 + np.log(3.0), rel=1e-5)
+
+
+class TestLKJCholesky:
+    def test_d2_eta1_uniform_correlation(self):
+        l = LKJCholesky(2, 1.0)
+        L = np.asarray(l.sample((20000,)))
+        np.testing.assert_allclose((L ** 2).sum(-1), 1.0, atol=1e-5)
+        r = L[:, 1, 0]
+        assert abs(r.var() - 1 / 3) < 0.02        # r ~ U(-1, 1)
+        # analytic density: p(r) = 1/2 -> log_prob = -ln 2
+        assert float(l.log_prob(jnp.asarray(L[0]))) == pytest.approx(
+            -np.log(2), abs=1e-5)
+
+    def test_d2_eta2_variance(self):
+        # p(r) \propto (1 - r^2)^{eta-1}: Var(r) = 1/(2 eta + 1)
+        r = np.asarray(LKJCholesky(2, 2.0).sample((20000,)))[:, 1, 0]
+        assert abs(r.var() - 0.2) < 0.02
+
+    def test_d3_marginal_correlation_variance(self):
+        # known LKJ fact: a single correlation's marginal density is
+        # p(r) \propto (1 - r^2)^(eta - 1 + (d-2)/2), so
+        # Var(r) = 1 / (2*(eta + (d-2)/2) + 1); for d=3, eta=1 -> 1/4.
+        # This is the oracle that catches wrong per-row Beta parameters
+        # in the onion sampler (rows beyond the first).
+        L = np.asarray(LKJCholesky(3, 1.0).sample((30000,)))
+        corr = L @ np.swapaxes(L, -1, -2)
+        for (i, j) in ((1, 0), (2, 0), (2, 1)):
+            assert abs(corr[:, i, j].var() - 0.25) < 0.02, (i, j)
+
+    def test_cvine_rejected_not_silently_swapped(self):
+        with pytest.raises(NotImplementedError):
+            LKJCholesky(3, sample_method="cvine")
+
+    def test_d4_valid_choleskys(self):
+        l = LKJCholesky(4, 1.5)
+        L = np.asarray(l.sample((500,)))
+        np.testing.assert_allclose((L ** 2).sum(-1), 1.0, atol=1e-4)
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-4)
+        # positive diagonal (proper cholesky) and finite density
+        assert (np.diagonal(L, axis1=-2, axis2=-1) > 0).all()
+        assert np.isfinite(np.asarray(l.log_prob(jnp.asarray(L)))).all()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LKJCholesky(1)
+        with pytest.raises(NotImplementedError):
+            LKJCholesky(3, sample_method="nope")
+
+
+class TestCallbacks:
+    def _fit(self, cb):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.io import TensorDataset
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        rng = np.random.RandomState(0)
+        ds = TensorDataset([jnp.asarray(rng.randn(32, 4).astype("float32")),
+                            jnp.asarray(rng.randint(0, 2, (32, 1)))])
+        model.fit(ds, epochs=2, batch_size=16, verbose=0, callbacks=[cb])
+
+    def test_visualdl_logs_fit_scalars(self):
+        with tempfile.TemporaryDirectory() as d:
+            self._fit(paddle.callbacks.VisualDL(log_dir=d))
+            lines = [json.loads(l)
+                     for l in open(os.path.join(d, "scalars.jsonl"))]
+        assert lines
+        tags = {l["tag"] for l in lines}
+        assert any(t.startswith("train/") for t in tags)
+        assert any(t.startswith("train_epoch/") for t in tags)
+        assert all(np.isfinite(l["value"]) for l in lines)
+        steps = [l["step"] for l in lines if l["tag"] == "train/loss"]
+        assert steps == sorted(steps)
+
+    def test_wandb_raises_with_guidance(self):
+        with pytest.raises(ImportError, match="VisualDL"):
+            paddle.callbacks.WandbCallback(project="p")
+
+
+class TestSysconfigAndUtils:
+    def test_sysconfig_paths(self):
+        lib = paddle.sysconfig.get_lib()
+        assert os.path.basename(lib) == "lib"
+        # the native pieces actually live there
+        assert os.path.isdir(lib)
+        assert os.path.basename(paddle.sysconfig.get_include()) == "include"
+
+    def test_require_version(self):
+        paddle.utils.require_version("0.1.0")
+        paddle.utils.require_version("0.1", "9.9")
+        with pytest.raises(RuntimeError):
+            paddle.utils.require_version("99.0")
+        with pytest.raises(RuntimeError):
+            paddle.utils.require_version("0.0.1", "0.0.2")
+        with pytest.raises(ValueError):
+            paddle.utils.require_version("abc")
+        with pytest.raises(ValueError):
+            paddle.utils.require_version("")
+
+    def test_legacy_profiler_shim(self):
+        paddle.utils.profiler.start_profiler()
+        _ = paddle.ones([4]) * 2
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "trace.json")
+            paddle.utils.profiler.stop_profiler(profile_path=path)
+            assert os.path.exists(path)
+            json.load(open(path))           # valid chrome-trace JSON
