@@ -544,6 +544,12 @@ class LKJCholesky(Distribution):
                 f"onion method draws from the same LKJ(eta) distribution")
         self.dim = int(dim)
         self.concentration = jnp.asarray(concentration, jnp.float32)
+        if self.concentration.ndim != 0:
+            # a batch axis would silently fold into the per-row Beta
+            # parameters below; construct one distribution per eta instead
+            raise ValueError(
+                "LKJCholesky takes a scalar concentration; vmap or build "
+                "one instance per batch element for batched etas")
         # onion per-row Beta parameters: row i (= off + 1, off = 0..d-2)
         # has m = i sub-diagonal entries, its squared radius is
         # Beta(m/2, eta + (d-2)/2 - off/2)

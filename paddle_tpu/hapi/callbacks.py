@@ -14,6 +14,21 @@ __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "AutoResume",
            "EarlyStopping", "CallbackList"]
 
 
+
+
+def _scalar(v):
+    """Metric value -> float, or None when it isn't scalar-like (the
+    single unwrap policy for every logging callback in this module)."""
+    if isinstance(v, (list, tuple)):
+        if not v:
+            return None
+        v = v[0]
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
 class Callback:
     def set_params(self, params):
         self.params = params
@@ -342,9 +357,8 @@ class VisualDL(Callback):
     def _emit(self, prefix, logs, step):
         w = self._writer()
         for k, v in (logs or {}).items():
-            try:
-                v = float(v[0] if isinstance(v, (list, tuple)) else v)
-            except (TypeError, ValueError):
+            v = _scalar(v)
+            if v is None:
                 continue
             w.write(json.dumps({"tag": f"{prefix}/{k}", "step": int(step),
                                 "value": v}) + "\n")
@@ -391,11 +405,9 @@ class WandbCallback(Callback):
             return
         payload = {}
         for k, v in (logs or {}).items():
-            try:
-                payload[f"{prefix}/{k}"] = float(
-                    v[0] if isinstance(v, (list, tuple)) else v)
-            except (TypeError, ValueError):
-                continue
+            v = _scalar(v)
+            if v is not None:
+                payload[f"{prefix}/{k}"] = v
         if payload:
             self._run.log(payload, step=self._step)
 

@@ -883,3 +883,43 @@ def _fill_diag_ref(x, v):
 def _erf_ref(x):
     from scipy.special import erf as _erf
     return _erf(x)
+
+
+# ---- round-4 differentiable loss heads: the OpTest central-difference
+# grad check is the strongest correctness signal for DP/assignment-based
+# losses (reference: test_yolov3_loss_op.py / warprnnt grad tests) --------
+
+def _rnnt_sample():
+    rng = np.random.RandomState(11)
+    x = (rng.randn(2, 4, 3, 5) * 0.7).astype("float32")
+    labels = rng.randint(1, 5, (2, 2)).astype("int32")
+    tl = np.array([4, 3], "int32")
+    ul = np.array([2, 1], "int32")
+    return (x, labels, tl, ul), {"fastemit_lambda": 0.0,
+                                 "reduction": "none"}
+
+
+def _yolo_loss_sample():
+    rng = np.random.RandomState(12)
+    x = (rng.randn(1, 2 * (5 + 3), 4, 4) * 0.5).astype("float32")
+    gt = np.array([[[0.4, 0.4, 0.3, 0.3], [0.7, 0.6, 0.2, 0.2]]],
+                  "float32")
+    lab = np.array([[1, 2]], "int64")
+    # ignore_thresh=2.0 keeps the ignore indicator empty so the loss is
+    # smooth in x everywhere the finite-difference probe looks
+    return (x, gt, lab), {"anchors": [10, 14, 20, 24],
+                          "anchor_mask": [0, 1], "class_num": 3,
+                          "ignore_thresh": 2.0, "downsample_ratio": 8,
+                          "use_label_smooth": False}
+
+
+def _register_loss_heads():
+    from ..nn import functional as _F
+    from ..vision import ops as _V
+    register_op("rnnt_loss", _F.rnnt_loss, None, _rnnt_sample,
+                grad_args=(0,), rtol=1e-4, atol=1e-5)
+    register_op("yolo_loss", _V.yolo_loss, None, _yolo_loss_sample,
+                grad_args=(0,), rtol=1e-4, atol=1e-5)
+
+
+_register_loss_heads()

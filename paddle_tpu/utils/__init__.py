@@ -137,13 +137,21 @@ def require_version(min_version: str, max_version: str = None):
 
     cur = parse(__version__, "installed version")
     lo = parse(min_version, "min_version")
-    if cur < lo:
+
+    def pad(a, b):
+        # zero-pad to equal length (reference semantics: "0.2" == "0.2.0")
+        n = max(len(a), len(b))
+        return a + (0,) * (n - len(a)), b + (0,) * (n - len(b))
+
+    cur_lo, lo = pad(cur, lo)
+    if cur_lo < lo:
         raise RuntimeError(
             f"installed version {__version__} < required min_version "
             f"{min_version}")
     if max_version is not None:
         hi = parse(max_version, "max_version")
-        if cur > hi:
+        cur_hi, hi = pad(cur, hi)
+        if cur_hi > hi:
             raise RuntimeError(
                 f"installed version {__version__} > allowed max_version "
                 f"{max_version}")

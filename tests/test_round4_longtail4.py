@@ -160,3 +160,24 @@ class TestSysconfigAndUtils:
             paddle.utils.profiler.stop_profiler(profile_path=path)
             assert os.path.exists(path)
             json.load(open(path))           # valid chrome-trace JSON
+
+
+class TestReviewRegressions4:
+    def test_require_version_prefix_padding(self):
+        # "0.2" must accept installed 0.2.x (zero-padded comparison)
+        import paddle_tpu
+        major_minor = ".".join(paddle_tpu.__version__.split(".")[:2])
+        paddle.utils.require_version("0.1", major_minor)
+
+    def test_lkj_rejects_batched_concentration(self):
+        with pytest.raises(ValueError, match="scalar concentration"):
+            LKJCholesky(3, jnp.asarray([1.0, 2.0]))
+
+    def test_scalar_helper_handles_odd_metric_values(self):
+        from paddle_tpu.hapi.callbacks import _scalar
+        assert _scalar(1.5) == 1.5
+        assert _scalar([2.0]) == 2.0
+        assert _scalar(np.float32(3.0)) == 3.0
+        assert _scalar([]) is None
+        assert _scalar("nan-ish-string") is None
+        assert _scalar(np.asarray(4.0)) == 4.0
