@@ -208,16 +208,18 @@ def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None,
     return all_reduce(tensor, op=op, group=group)
 
 
-def all_gather(tensor_or_list, tensor=None, group=None, sync_op: bool = True,
+def all_gather(tensor_list, tensor=None, group=None, sync_op: bool = True,
                axis: int = 0):
     """Traced: lax.all_gather over axis name (concatenated along ``axis``).
-    Eager parity: list-output form fills tensor_list like the reference."""
+    Eager parity: list-output form fills ``tensor_list`` like the
+    reference (the first parameter keeps the reference's keyword name;
+    passing a bare tensor first instead is also accepted)."""
     out_list = None
-    if isinstance(tensor_or_list, list):
-        out_list = tensor_or_list
+    if isinstance(tensor_list, list):
+        out_list = tensor_list
         x = tensor
     else:
-        x = tensor_or_list
+        x = tensor_list
     g = _resolve(group)
     if _in_trace(x):
         out = jax.lax.all_gather(x, g.name, axis=axis, tiled=True)
