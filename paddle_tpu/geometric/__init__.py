@@ -29,7 +29,7 @@ __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_max", "segment_min",
     "sample_neighbors", "weighted_sample_neighbors",
-    "reindex_graph", "reindex_heter_graph",
+    "reindex_graph", "reindex_heter_graph", "segment_softmax",
 ]
 
 _MESSAGE_OPS = ("add", "sub", "mul", "div")
@@ -272,3 +272,19 @@ def reindex_heter_graph(x, neighbors, count, value_buffer=None,
     reindex_dst = (np.concatenate(dsts) if dsts
                    else np.empty((0,), np.int64))
     return reindex_src, reindex_dst, out_nodes
+
+
+def segment_softmax(data, segment_ids, name=None, num_segments=None):
+    """Softmax over the rows of each segment (reference:
+    python/paddle/geometric/math.py — segment_softmax; the attention-
+    normalizer of GAT-style message passing).  Numerically stable: per-
+    segment max subtraction."""
+    import jax
+    data = jnp.asarray(data)
+    ids = jnp.asarray(segment_ids, jnp.int32)
+    n = int(jnp.max(ids)) + 1 if num_segments is None else int(num_segments)
+    seg_max = jax.ops.segment_max(data, ids, num_segments=n)
+    # empty segments produce -inf max; gathered rows never reference them
+    e = jnp.exp(data - seg_max[ids])
+    denom = jax.ops.segment_sum(e, ids, num_segments=n)
+    return e / jnp.maximum(denom[ids], 1e-38)

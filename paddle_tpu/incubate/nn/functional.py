@@ -20,7 +20,8 @@ __all__ = ["fused_linear", "fused_matmul_bias", "fused_feedforward",
            "fused_bias_dropout_residual_layer_norm",
            "fused_rotary_position_embedding", "fused_rms_norm",
            "fused_layer_norm", "swiglu",
-           "variable_length_memory_efficient_attention"]
+           "variable_length_memory_efficient_attention",
+           "fused_dot_product_attention"]
 
 
 def fused_linear(x, weight, bias=None, transpose_weight: bool = False,
@@ -369,3 +370,16 @@ def variable_length_memory_efficient_attention(
     out = jnp.einsum("bhmn,bhnd->bhmd", probs.astype(q.dtype), v)
     q_valid = (jnp.arange(m)[None, :] < qlen[:, None])[:, None, :, None]
     return jnp.where(q_valid, out, jnp.zeros((), out.dtype))
+
+
+def fused_dot_product_attention(query, key, value, attn_mask=None,
+                                dropout_rate: float = 0.0,
+                                causal: bool = False, training: bool = True,
+                                name=None):
+    """Reference: incubate.nn.functional.fused_dot_product_attention (the
+    cuDNN-frontend fused attention op).  q/k/v [B, S, H, D] — same layout
+    as F.scaled_dot_product_attention, which this routes to (the Pallas
+    flash kernel underneath supplies the fusion on TPU)."""
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_rate,
+        is_causal=causal, training=training)

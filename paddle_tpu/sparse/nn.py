@@ -319,21 +319,32 @@ functional = _Functional()
 # --------------------------------------------------------------- layers
 
 class _SparseConvBase(Layer):
+    # spatial rank hook: 3 -> [kd, kh, kw, ...] weights, 2 -> [kh, kw, ...]
+    _spatial_rank = 3
+    _default_format = "NDHWC"
+
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, padding_mode="zeros",
-                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+                 weight_attr=None, bias_attr=None, data_format=None):
         super().__init__()
-        kd, kh, kw = _triple(kernel_size)
+        if isinstance(kernel_size, int):
+            kdims = (kernel_size,) * self._spatial_rank
+        else:
+            kdims = tuple(kernel_size)
+            if len(kdims) != self._spatial_rank:
+                raise ValueError(
+                    f"kernel_size must have {self._spatial_rank} dims, got "
+                    f"{kernel_size!r}")
         self.stride = stride
         self.padding = padding
         self.dilation = dilation
         self.groups = groups
-        self.data_format = data_format
-        fan_in = in_channels * kd * kh * kw
+        self.data_format = data_format or self._default_format
+        fan_in = in_channels * math.prod(kdims)
         init = weight_attr if isinstance(weight_attr, I.Initializer) \
             else I.Normal(0.0, math.sqrt(2.0 / fan_in))
         self.weight = self.create_parameter(
-            [kd, kh, kw, in_channels // groups, out_channels],
+            list(kdims) + [in_channels // groups, out_channels],
             default_initializer=init)
         if bias_attr is not False:
             self.bias = self.create_parameter(
@@ -467,3 +478,105 @@ class Softmax(_ValsAct):
 
     def _apply(self, vals):
         return jax.nn.softmax(vals, axis=-1)
+
+
+# ---- 2-D sparse conv family (reference: paddle.sparse.nn.Conv2D /
+# SubmConv2D over NHWC SparseCooTensors) — implemented by lifting to the
+# 3-D rulebook with a unit depth axis (kd = 1, depth stride 1): the
+# sorted-searchsorted machinery is dimension-agnostic, so the 2-D ops
+# inherit its oracle coverage ------------------------------------------
+
+def _lift_nhwc(x):
+    """NHWC BCOO [N, H, W, C] -> NDHWC BCOO [N, 1, H, W, C]."""
+    if not isinstance(x, jsparse.BCOO):
+        raise TypeError("sparse.nn expects a SparseCooTensor (BCOO); got "
+                        f"{type(x).__name__}")
+    if x.ndim != 4:
+        raise ValueError(f"sparse conv2d input must be 4-D NHWC, got "
+                         f"{x.ndim}-D")
+    if x.n_dense != 1:
+        x = jsparse.BCOO.fromdense(x.todense(), n_dense=1)
+    idx = x.indices.astype(jnp.int32)
+    # out-of-range padding rows stay out of range in the untouched coords
+    lifted = jnp.concatenate(
+        [idx[:, :1], jnp.zeros((idx.shape[0], 1), jnp.int32), idx[:, 1:]],
+        axis=1)
+    n, h, w, c = x.shape
+    return jsparse.BCOO((x.data, lifted), shape=(n, 1, h, w, c))
+
+
+def _squeeze_depth(y):
+    """NDHWC BCOO [N, 1, H, W, C] -> NHWC BCOO (padding rows keep their
+    out-of-range N/H/W sentinel coords)."""
+    idx = y.indices
+    out_idx = jnp.concatenate([idx[:, :1], idx[:, 2:]], axis=1)
+    n, d, h, w, c = y.shape
+    return jsparse.BCOO((y.data, out_idx), shape=(n, h, w, c))
+
+
+def _pair3(v, lead):
+    """2-D int-or-pair -> 3-tuple with ``lead`` on the depth axis."""
+    if isinstance(v, int):
+        return (lead, v, v)
+    vv = tuple(v)
+    if len(vv) != 2:
+        raise ValueError(f"expected an int or a pair, got {v!r}")
+    return (lead,) + vv
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NHWC"):
+    """Reference: paddle.sparse.nn.functional.conv2d; ``weight``
+    [kh, kw, Cin/groups, Cout]."""
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d is NHWC (reference layout)")
+    w = jnp.asarray(weight)
+    if w.ndim != 4:
+        raise ValueError(f"conv2d weight must be [kh, kw, Cin, Cout], got "
+                         f"{w.ndim}-D")
+    out = conv3d(_lift_nhwc(x), w[None], bias, _pair3(stride, 1),
+                 _pair3(padding, 0), _pair3(dilation, 1), groups, "NDHWC")
+    return _squeeze_depth(out)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups: int = 1, data_format: str = "NHWC", key=None):
+    """Reference: paddle.sparse.nn.functional.subm_conv2d (submanifold:
+    output active set == input active set)."""
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d is NHWC (reference layout)")
+    w = jnp.asarray(weight)
+    if w.ndim != 4:
+        raise ValueError(f"subm_conv2d weight must be [kh, kw, Cin, Cout], "
+                         f"got {w.ndim}-D")
+    out = subm_conv3d(_lift_nhwc(x), w[None], bias, _pair3(stride, 1),
+                      _pair3(padding, 0), _pair3(dilation, 1), groups,
+                      "NDHWC")
+    return _squeeze_depth(out)
+
+
+class _SparseConv2DBase(_SparseConvBase):
+    _spatial_rank = 2
+    _default_format = "NHWC"
+
+
+class Conv2D(_SparseConv2DBase):
+    """Reference: paddle.sparse.nn.Conv2D."""
+
+    def forward(self, x):
+        return conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                      self.dilation, self.groups, self.data_format)
+
+
+class SubmConv2D(_SparseConv2DBase):
+    """Reference: paddle.sparse.nn.SubmConv2D."""
+
+    def forward(self, x):
+        return subm_conv2d(x, self.weight, self.bias, self.stride,
+                           self.padding, self.dilation, self.groups,
+                           self.data_format)
+
+
+_Functional.conv2d = staticmethod(conv2d)
+_Functional.subm_conv2d = staticmethod(subm_conv2d)
+__all__ += ["Conv2D", "SubmConv2D"]

@@ -298,8 +298,8 @@ weight_quantize weight_dequantize weight_only_linear llm_int8_linear
 
 PADDLE_GEOMETRIC = """
 send_u_recv send_ue_recv send_uv segment_sum segment_mean segment_max
-segment_min sample_neighbors weighted_sample_neighbors reindex_graph
-reindex_heter_graph
+segment_min segment_softmax sample_neighbors weighted_sample_neighbors
+reindex_graph reindex_heter_graph
 """
 
 PADDLE_AUDIO_FEATURES = """
@@ -366,12 +366,13 @@ HDFSClient LocalFS recompute recompute_sequential
 """
 
 PADDLE_SPARSE_NN = """
+Conv2D SubmConv2D
 Conv3D SubmConv3D BatchNorm MaxPool3D ReLU ReLU6 LeakyReLU Softmax
 functional
 """
 
 PADDLE_SPARSE_NN_F = """
-conv3d subm_conv3d max_pool3d relu
+conv2d subm_conv2d conv3d subm_conv3d max_pool3d relu
 """
 
 PADDLE_DISTRIBUTED_PASSES = """
@@ -405,7 +406,7 @@ fused_feedforward fused_layer_norm fused_linear fused_linear_activation
 fused_matmul_bias fused_multi_head_attention fused_multi_transformer
 fused_rms_norm fused_rotary_position_embedding
 masked_multihead_attention swiglu
-variable_length_memory_efficient_attention
+variable_length_memory_efficient_attention fused_dot_product_attention
 """
 
 REFERENCE = {

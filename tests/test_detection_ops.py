@@ -401,3 +401,32 @@ class TestYoloLoss:
             V.yolo_loss(jnp.ones((1, 7, 2, 2)), jnp.ones((1, 1, 4)),
                         jnp.ones((1, 1), jnp.int32), [8, 8], [0], 3,
                         0.5, 8)
+
+
+class TestShardedParity:
+    """The new loss heads under dp-sharded batches on the 8-device mesh
+    must equal the serial computation (the suite's core SPMD oracle)."""
+
+    def test_yolo_loss_sharded_batch_matches_serial(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        rng = np.random.RandomState(21)
+        anchors = [10, 14]
+        nc = 3
+        x = rng.randn(8, 1 * (5 + nc), 4, 4).astype("float32") * 0.5
+        gt = np.zeros((8, 2, 4), "float32")
+        gt[:, 0] = [0.4, 0.5, 0.3, 0.3]
+        lab = np.zeros((8, 2), "int64")
+        serial = np.asarray(V.yolo_loss(
+            jnp.asarray(x), jnp.asarray(gt), jnp.asarray(lab), anchors,
+            [0], nc, 0.6, 8))
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        sh = NamedSharding(mesh, P("dp"))
+        xs = jax.device_put(jnp.asarray(x), sh)
+        gts = jax.device_put(jnp.asarray(gt), sh)
+        labs = jax.device_put(jnp.asarray(lab), sh)
+        f = jax.jit(lambda a, b, c: V.yolo_loss(a, b, c, anchors, [0],
+                                                nc, 0.6, 8),
+                    out_shardings=sh)
+        out = np.asarray(f(xs, gts, labs))
+        np.testing.assert_allclose(out, serial, rtol=2e-4, atol=1e-5)

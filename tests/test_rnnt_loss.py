@@ -153,3 +153,26 @@ class TestRNNTLoss:
             F.rnnt_loss(x, labels, tl, ul, blank=4)
         with pytest.raises(ValueError):
             F.rnnt_loss(x, labels, tl, ul, blank=-1)
+
+    def test_sharded_batch_matches_serial(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        rng = np.random.RandomState(22)
+        B, T, U, V = 8, 5, 3, 6
+        x = rng.randn(B, T, U + 1, V).astype("float32")
+        labels = rng.randint(1, V, (B, U))
+        tl = np.full((B,), T, "int32")
+        ul = np.full((B,), U, "int32")
+        serial = np.asarray(F.rnnt_loss(
+            jnp.asarray(x), jnp.asarray(labels), jnp.asarray(tl),
+            jnp.asarray(ul), fastemit_lambda=0.0, reduction="none"))
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        sh = NamedSharding(mesh, P("dp"))
+        f = jax.jit(lambda a, b, c, d: F.rnnt_loss(
+            a, b, c, d, fastemit_lambda=0.0, reduction="none"),
+            out_shardings=sh)
+        out = np.asarray(f(jax.device_put(jnp.asarray(x), sh),
+                           jax.device_put(jnp.asarray(labels), sh),
+                           jax.device_put(jnp.asarray(tl), sh),
+                           jax.device_put(jnp.asarray(ul), sh)))
+        np.testing.assert_allclose(out, serial, rtol=2e-4)

@@ -1,0 +1,116 @@
+"""Round-4 fifth sweep: 2-D sparse conv family (lifted onto the 3-D
+rulebook), geometric.segment_softmax, fused_dot_product_attention.
+
+Oracles: dense lax.conv at active positions; per-segment closed-form
+softmax; exact match vs scaled_dot_product_attention.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.experimental.sparse as jsparse
+import pytest
+
+import paddle_tpu.geometric as G
+import paddle_tpu.incubate.nn.functional as IF
+import paddle_tpu.nn.functional as F
+import paddle_tpu.sparse.nn as snn
+
+
+def _sparse_image(rng, pts, img=6, c=2):
+    dense = np.zeros((1, img, img, c), "float32")
+    for (i, j) in pts:
+        dense[0, i, j] = rng.randn(c)
+    return dense, jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+
+
+class TestSparseConv2D:
+    def test_conv2d_matches_dense_at_active_outputs(self):
+        rng = np.random.RandomState(0)
+        dense, x = _sparse_image(rng, [(1, 1), (2, 4), (4, 2), (5, 5)])
+        w = rng.randn(3, 3, 2, 4).astype("float32")
+        b = rng.randn(4).astype("float32")
+        out = snn.functional.conv2d(x, jnp.asarray(w), jnp.asarray(b),
+                                    stride=2, padding=1)
+        got = np.asarray(out.todense())
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w), (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))) + b
+        assert got.shape == ref.shape == (1, 3, 3, 4)
+        mask = np.abs(got).sum(-1, keepdims=True) > 0
+        np.testing.assert_allclose(got * mask, np.where(mask, ref, 0),
+                                   rtol=1e-4, atol=1e-4)
+        # sparse semantics: at least the input points' receptive outputs
+        assert mask.sum() >= 4
+
+    def test_subm_conv2d_preserves_active_set(self):
+        rng = np.random.RandomState(1)
+        dense, x = _sparse_image(rng, [(1, 1), (3, 3)])
+        w = rng.randn(3, 3, 2, 3).astype("float32")
+        out = snn.functional.subm_conv2d(x, jnp.asarray(w), None, padding=1)
+        d = np.asarray(out.todense())
+        active = np.abs(d).sum(-1) > 0
+        # output actives subset of input actives (values can be zero)
+        assert active.sum() <= 2
+        assert d.shape == (1, 6, 6, 3)
+
+    def test_layer_classes(self):
+        rng = np.random.RandomState(2)
+        _, x = _sparse_image(rng, [(0, 0), (2, 2)])
+        conv = snn.Conv2D(2, 4, 3, stride=2, padding=1)
+        assert conv(x).shape == (1, 3, 3, 4)
+        subm = snn.SubmConv2D(2, 4, 3, padding=1)
+        assert subm(x).shape == (1, 6, 6, 4)
+        assert tuple(conv.weight.shape) == (3, 3, 2, 4)
+
+    def test_rejects_wrong_layout(self):
+        rng = np.random.RandomState(3)
+        _, x = _sparse_image(rng, [(0, 0)])
+        with pytest.raises(ValueError):
+            snn.functional.conv2d(x, jnp.ones((3, 3, 2, 4)),
+                                  data_format="NCHW")
+        with pytest.raises(ValueError):
+            snn.functional.conv2d(x, jnp.ones((1, 3, 3, 2, 4)))
+
+
+class TestSegmentSoftmax:
+    def test_per_segment_closed_form(self):
+        rng = np.random.RandomState(4)
+        data = jnp.asarray(rng.randn(8).astype("float32"))
+        ids = jnp.asarray([0, 0, 1, 1, 1, 3, 3, 3])
+        out = np.asarray(G.segment_softmax(data, ids, num_segments=4))
+        for s in (0, 1, 3):
+            m = np.asarray(ids) == s
+            ref = np.exp(np.asarray(data)[m])
+            ref /= ref.sum()
+            np.testing.assert_allclose(out[m], ref, rtol=1e-5)
+            np.testing.assert_allclose(out[m].sum(), 1.0, rtol=1e-5)
+
+    def test_rows_and_stability(self):
+        # large logits must not overflow (per-segment max subtraction)
+        data = jnp.asarray([1000.0, 1001.0, -1000.0])
+        ids = jnp.asarray([0, 0, 1])
+        out = np.asarray(G.segment_softmax(data, ids, num_segments=2))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out[2], 1.0, rtol=1e-6)
+
+    def test_2d_rows(self):
+        rng = np.random.RandomState(5)
+        data = jnp.asarray(rng.randn(5, 3).astype("float32"))
+        ids = jnp.asarray([0, 1, 1, 2, 2])
+        out = np.asarray(G.segment_softmax(data, ids, num_segments=3))
+        # softmax per segment PER COLUMN (rows reduce within segment)
+        np.testing.assert_allclose(out[1] + out[2], np.ones(3), rtol=1e-5)
+
+
+class TestFusedSdpa:
+    def test_matches_scaled_dot_product_attention(self):
+        rng = np.random.RandomState(6)
+        q = jnp.asarray(rng.randn(2, 5, 4, 8).astype("float32"))
+        k = jnp.asarray(rng.randn(2, 5, 4, 8).astype("float32"))
+        v = jnp.asarray(rng.randn(2, 5, 4, 8).astype("float32"))
+        a = IF.fused_dot_product_attention(q, k, v, causal=True,
+                                           training=False)
+        b = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                           training=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -78,3 +78,52 @@ def test_all_gather_keyword_call_form():
     res = paddle.distributed.all_gather(tensor_list=out,
                                         tensor=jnp.ones((2,)))
     assert res is out and len(out) >= 1
+
+
+DEFAULT_CHECKS = [
+    (F.dropout, {"p": 0.5, "mode": "upscale_in_train"}),
+    (F.leaky_relu, {"negative_slope": 0.01}),
+    (F.softmax, {"axis": -1}),
+    (F.cross_entropy, {"reduction": "mean", "ignore_index": -100,
+                       "soft_label": False}),
+    (F.interpolate, {"mode": "nearest", "align_corners": False}),
+    (F.gelu, {"approximate": False}),
+    (nn.BatchNorm2D.__init__, {"momentum": 0.9, "epsilon": 1e-5}),
+    (nn.LayerNorm.__init__, {"epsilon": 1e-5}),
+    (nn.Dropout.__init__, {"p": 0.5}),
+    (paddle.optimizer.Adam.__init__, {"learning_rate": 0.001, "beta1": 0.9,
+                                      "beta2": 0.999, "epsilon": 1e-8}),
+    (paddle.optimizer.AdamW.__init__, {"learning_rate": 0.001,
+                                       "weight_decay": 0.01}),
+    (paddle.optimizer.Momentum.__init__, {"learning_rate": 0.001,
+                                          "momentum": 0.9,
+                                          "use_nesterov": False}),
+    (paddle.topk, {"largest": True, "sorted": True}),
+    (paddle.argsort, {"axis": -1, "descending": False}),
+    # reference: p=None selects fro (matrix) / 2-norm (vector)
+    (paddle.norm, {"p": None}),
+    (paddle.matmul, {"transpose_x": False, "transpose_y": False}),
+    (nn.MultiHeadAttention.__init__, {"dropout": 0.0}),
+    (nn.TransformerEncoderLayer.__init__, {"dropout": 0.1,
+                                           "activation": "relu"}),
+]
+
+
+@pytest.mark.parametrize(
+    "fn,want", DEFAULT_CHECKS,
+    ids=[fn.__qualname__ for fn, _ in DEFAULT_CHECKS])
+def test_default_values_match_reference(fn, want):
+    sig = inspect.signature(fn)
+    for k, v in want.items():
+        assert k in sig.parameters, f"{fn.__qualname__} lost param {k}"
+        assert sig.parameters[k].default == v, (
+            f"{fn.__qualname__}.{k} default "
+            f"{sig.parameters[k].default!r} != reference {v!r}")
+
+
+def test_transformer_encoder_dim_feedforward_required():
+    # the reference REQUIRES dim_feedforward (torch defaults it; ported
+    # paddle code always passes it, torch-ported code must adapt loudly)
+    p = inspect.signature(
+        nn.TransformerEncoderLayer.__init__).parameters["dim_feedforward"]
+    assert p.default is inspect.Parameter.empty
