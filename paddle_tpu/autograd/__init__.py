@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 __all__ = ["grad", "value_and_grad", "jacobian", "hessian", "vjp", "jvp",
            "no_grad", "enable_grad", "is_grad_enabled", "PyLayer",
-           "PyLayerContext", "backward"]
+           "PyLayerContext", "backward", "saved_tensors_hooks"]
 
 grad_fn = jax.grad
 
@@ -194,3 +194,26 @@ class PyLayer(metaclass=_PyLayerMeta):
         if kwargs:
             raise ValueError("PyLayer.apply takes positional args only")
         return cls._fn(*args)
+
+
+@contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """Reference: paddle.autograd.saved_tensors_hooks(pack, unpack) —
+    intercepts activation stashing for memory tricks (CPU offload,
+    compression).  Under XLA there is no Python-visible activation stash
+    to hook: residuals live inside the compiled program, and the memory
+    trade-offs the hooks exist for are expressed as remat policies
+    (paddle_tpu.distributed.recompute / jax.checkpoint).  Because
+    pack/unpack must be inverses, ignoring them is value-correct; this
+    context warns once and runs the body unchanged."""
+    if not _STH_WARNED[0]:
+        import warnings
+        warnings.warn(
+            "saved_tensors_hooks has no effect under XLA: residuals are "
+            "managed by the compiler; use recompute()/jax.checkpoint for "
+            "the memory trade-off these hooks implement.", stacklevel=3)
+        _STH_WARNED[0] = True
+    yield
+
+
+_STH_WARNED = [False]

@@ -110,6 +110,9 @@ def enable_tensor_methods() -> None:
     _add("is_contiguous", lambda self: True)   # XLA layout is opaque/dense
     _add("contiguous", lambda self: self)
     _add("value", lambda self: self)
+    # reference: Tensor.apply(fn) returns fn(tensor) (dtype-preserving
+    # user transform; NOT elementwise python)
+    _add("apply", lambda self, fn: fn(self))
     _add("get_tensor", lambda self: self)
     _add("pin_memory", lambda self: self)
 

@@ -151,3 +151,4 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 
 from . import optimizer  # noqa: E402,F401  (LookAhead / ModelAverage)
 from . import autograd  # noqa: E402,F401  (jvp/vjp/Jacobian/Hessian)
+from . import multiprocessing  # noqa: E402,F401  (shm-tensor mp stance)

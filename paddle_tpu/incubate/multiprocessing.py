@@ -1,0 +1,24 @@
+"""paddle.incubate.multiprocessing parity.
+
+Reference: python/paddle/incubate/multiprocessing/ — a multiprocessing
+wrapper whose reductions pass Tensors through shared memory instead of
+pickling copies.  Here jax arrays are immutable device values: sending
+one to another process is a host copy by definition (the receiving
+process holds its own buffers), so the standard library semantics are
+already correct — this module re-exports `multiprocessing` so ported
+imports run, and documents that the zero-copy shm fast path does not
+apply to device arrays.  For the DataLoader's worker transport, the
+native shared-memory ring (paddle_tpu/lib/shm_ring.cpp) IS the shm
+path.
+"""
+
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import get_context, get_start_method  # noqa: F401
+
+
+def set_sharing_strategy(strategy: str = "file_system"):
+    """Accepted for parity; jax arrays pickle by value (see module note)."""
+
+
+def get_sharing_strategy() -> str:
+    return "file_system"

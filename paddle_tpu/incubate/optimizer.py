@@ -149,3 +149,84 @@ class ModelAverage(Optimizer):
         """Reference parity: restore() returns the un-averaged weights —
         functional, so the originals were never overwritten."""
         return params
+
+
+class _FunctionalOptimizers:
+    """paddle.incubate.optimizer.functional parity — the functional
+    quasi-Newton minimizers (reference:
+    python/paddle/incubate/optimizer/functional/{lbfgs,bfgs}.py).
+
+    Both return the reference's 5-tuple
+    ``(is_converge, num_func_calls, position, objective_value,
+    objective_gradient)``.  Deviation (documented): num_func_calls counts
+    PYTHON-level objective evaluations — under jit the objective is traced
+    once and re-executed compiled, so the count under-reports the
+    reference's eager per-evaluation number.
+    """
+
+    @staticmethod
+    def minimize_lbfgs(objective_func, initial_position,
+                       history_size: int = 100, max_iters: int = 50,
+                       tolerance_grad: float = 1e-8,
+                       tolerance_change: float = 1e-9,
+                       initial_inverse_hessian_estimate=None,
+                       line_search_fn: str = "strong_wolfe",
+                       max_line_search_iters: int = 50,
+                       initial_step_length: float = 1.0,
+                       dtype: str = "float32", name=None):
+        import jax
+        import jax.numpy as jnp
+        from ..optimizer.lbfgs import LBFGS
+        if initial_inverse_hessian_estimate is not None:
+            raise NotImplementedError(
+                "initial_inverse_hessian_estimate is a dense-H seed; "
+                "L-BFGS here always starts from the scaled identity "
+                "(use minimize_bfgs for a dense estimate)")
+        x0 = jnp.asarray(initial_position, dtype)
+        calls = [0]
+
+        def counted(x):
+            calls[0] += 1
+            return objective_func(x)
+
+        opt = LBFGS(learning_rate=initial_step_length, max_iter=max_iters,
+                    tolerance_grad=tolerance_grad,
+                    tolerance_change=tolerance_change,
+                    history_size=history_size,
+                    line_search_fn=line_search_fn)
+        pos, loss = opt.step(counted, x0)
+        grad = jax.grad(objective_func)(pos)
+        is_converge = jnp.max(jnp.abs(grad)) <= tolerance_grad
+        return (is_converge, jnp.asarray(calls[0], jnp.int32), pos,
+                jnp.asarray(loss, dtype), grad)
+
+    @staticmethod
+    def minimize_bfgs(objective_func, initial_position,
+                      max_iters: int = 50, tolerance_grad: float = 1e-8,
+                      tolerance_change: float = 1e-9,
+                      initial_inverse_hessian_estimate=None,
+                      line_search_fn: str = "strong_wolfe",
+                      max_line_search_iters: int = 50,
+                      initial_step_length: float = 1.0,
+                      dtype: str = "float32", name=None):
+        import jax
+        import jax.numpy as jnp
+        x0 = jnp.asarray(initial_position, dtype).reshape(-1)
+        calls = [0]
+
+        def counted(x):
+            calls[0] += 1
+            return objective_func(x)
+
+        import jax.scipy.optimize as _jso
+        res = _jso.minimize(
+            counted, x0, method="BFGS",
+            options={"maxiter": max_iters, "gtol": tolerance_grad})
+        pos = res.x.reshape(jnp.shape(jnp.asarray(initial_position)))
+        grad = jax.grad(objective_func)(pos)
+        is_converge = jnp.max(jnp.abs(grad)) <= tolerance_grad
+        return (is_converge, jnp.asarray(calls[0], jnp.int32), pos,
+                jnp.asarray(res.fun, dtype), grad)
+
+
+functional = _FunctionalOptimizers()

@@ -6,14 +6,16 @@ from .tensor.linalg import (  # noqa: F401
     det, dist, eig, eigh, eigvals, eigvalsh, householder_product, inv,
     lstsq, lu, lu_unpack, matmul, matrix_exp, matrix_norm, matrix_power,
     matrix_rank, multi_dot, mv, norm, ormqr, pca_lowrank, pinv, qr,
-    slogdet, solve, svd, svd_lowrank, svdvals, triangular_solve, vecdot,
-    vector_norm)
+    lu_solve, slogdet, solve, svd, svd_lowrank, svdvals,
+    triangular_solve, vecdot, vector_norm)
 
 __all__ = ["bmm", "cholesky", "cholesky_inverse", "cholesky_solve", "cond",
            "corrcoef", "cov", "det", "dist", "eig", "eigh", "eigvals",
            "eigvalsh", "householder_product", "inv", "lstsq", "lu",
-           "lu_unpack", "matmul", "matrix_exp", "matrix_norm",
+           "lu_solve", "lu_unpack", "matmul", "matrix_exp", "matrix_norm",
            "matrix_power", "matrix_rank", "multi_dot", "mv", "norm",
            "ormqr", "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd",
            "svd_lowrank", "svdvals", "triangular_solve", "vecdot",
            "vector_norm"]
+
+

@@ -21,7 +21,8 @@ from .program import (  # noqa: F401
     default_main_program, default_startup_program, global_scope, load,
     program_guard, reset_default_programs, save)
 
-__all__ = ["InputSpec", "accuracy", "auc", "save_inference_model", "load_inference_model",
+__all__ = ["InputSpec", "accuracy", "auc", "Print", "py_func",
+           "WeightNormParamAttr", "ExponentialMovingAverage", "save_inference_model", "load_inference_model",
            "Executor", "Program", "StaticGraphError", "Variable",
            "create_parameter", "data", "default_main_program",
            "default_startup_program", "global_scope", "load",
@@ -135,5 +136,7 @@ class _StaticNN:
 
 nn = _StaticNN()
 from .metrics import accuracy, auc  # noqa: E402,F401
+from .extras import (Print, py_func, WeightNormParamAttr,  # noqa: E402,F401
+                     ExponentialMovingAverage)
 
 __all__ += ["nn"]

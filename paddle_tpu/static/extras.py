@@ -1,0 +1,196 @@
+"""Static-graph long tail: Print, py_func, WeightNormParamAttr,
+ExponentialMovingAverage.
+
+Reference: python/paddle/static/nn/control_flow.py — Print;
+python/paddle/static/nn/common.py — py_func;
+python/paddle/base/param_attr.py — WeightNormParamAttr;
+python/paddle/static/ema.py — ExponentialMovingAverage.
+
+TPU-native mappings: Print is jax.debug.print (works inside traced
+programs, exactly the role of the reference's print op); py_func is
+jax.pure_callback (host-python op embedded in the compiled program —
+the same contract as the reference's py_func, incl. the "func must be
+pure" caveat for correctness under compilation).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import ParamAttr
+
+__all__ = ["Print", "py_func", "WeightNormParamAttr",
+           "ExponentialMovingAverage"]
+
+
+def Print(input, first_n: int = -1, message: str = None,
+          summarize: int = 20, print_tensor_name: bool = True,
+          print_tensor_type: bool = True, print_tensor_shape: bool = True,
+          print_tensor_layout: bool = True, print_tensor_lod: bool = True):
+    """Debug-print a tensor from inside a (possibly traced) program and
+    return it unchanged (reference: static.Print — the print op is an
+    identity with a host-print side effect; jax.debug.print is that op).
+    ``first_n``/``summarize`` accepted; jax.debug.print prints the full
+    value per XLA's debug-callback contract."""
+    x = jnp.asarray(input)
+    prefix = (message + " ") if message else ""
+    meta = []
+    if print_tensor_shape:
+        meta.append(f"shape={tuple(x.shape)}")
+    if print_tensor_type:
+        meta.append(f"dtype={x.dtype}")
+    header = prefix + " ".join(meta) + " value="
+    # jax.debug.callback (not debug.print): the user message is literal
+    # text, and debug.print's format parser cannot carry brace characters
+
+    def _host_print(v, _header=header):
+        print(_header + str(v), flush=True)
+
+    jax.debug.callback(_host_print, x)
+    return x
+
+
+def py_func(func: Callable, x, out, backward_func: Callable = None,
+            skip_vars_in_backward_input=None):
+    """Embed a host-python function as an op (reference: static.py_func
+    over the py_func op).  ``out`` declares the result's shape/dtype —
+    here a template array (or list of them), matching the reference's
+    out-variable declaration.  Maps to jax.pure_callback, so it works
+    inside jit/static programs; ``backward_func`` supplies the custom
+    VJP with the REFERENCE's argument contract:
+    ``backward_func(*inputs, *outputs, *output_grads)``, where any
+    input/output listed in ``skip_vars_in_backward_input`` (matched by
+    identity against the passed ``x``/``out`` templates) is omitted."""
+    xs = x if isinstance(x, (list, tuple)) else (x,)
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    result_shape = tuple(
+        jax.ShapeDtypeStruct(jnp.shape(o), jnp.asarray(o).dtype)
+        for o in outs)
+    single = not isinstance(out, (list, tuple))
+
+    def host(*args):
+        r = func(*args)
+        rs = r if isinstance(r, (list, tuple)) else (r,)
+        import numpy as np
+        return tuple(np.asarray(v) for v in rs)
+
+    if backward_func is None:
+        res = jax.pure_callback(host, result_shape, *xs)
+        return res[0] if single else list(res)
+
+    skip = tuple(skip_vars_in_backward_input or ())
+    keep_in = [not any(t is s_ for s_ in skip) for t in xs]
+    keep_out = [not any(t is s_ for s_ in skip) for t in outs]
+
+    @jax.custom_vjp
+    def op(*args):
+        return jax.pure_callback(host, result_shape, *args)
+
+    def fwd(*args):
+        res = jax.pure_callback(host, result_shape, *args)
+        return res, (args, res)
+
+    def bwd(residual, cots):
+        args, fwd_outs = residual
+
+        def bhost(*flat):
+            r = backward_func(*flat)
+            rs = r if isinstance(r, (list, tuple)) else (r,)
+            import numpy as np
+            return tuple(np.asarray(v) for v in rs)
+
+        bwd_in = (tuple(a for a, k in zip(args, keep_in) if k)
+                  + tuple(o for o, k in zip(fwd_outs, keep_out) if k)
+                  + tuple(cots))
+        in_shapes = tuple(jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.asarray(a).dtype)
+                          for a in args)
+        grads = jax.pure_callback(bhost, in_shapes, *bwd_in)
+        return tuple(grads)
+
+    op.defvjp(fwd, bwd)
+    res = op(*xs)
+    return res[0] if single else list(res)
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Reference: paddle.static.WeightNormParamAttr(dim, name,
+    initializer, ...) — static-graph weight-norm reparameterization
+    (w = g * v / ||v||) applied by the builder.  Here the decomposition
+    is the dygraph utility's job: apply paddle_tpu.nn.utils.weight_norm
+    to the layer (warned once; the attr still carries initializer /
+    regularizer / trainable so parameter creation works unchanged)."""
+
+    _warned = False
+
+    def __init__(self, dim: int = None, name=None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = False,
+                 need_clip: bool = True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+        if not WeightNormParamAttr._warned:
+            warnings.warn(
+                "WeightNormParamAttr: the static-graph weight-norm "
+                "rewrite maps to paddle_tpu.nn.utils.weight_norm(layer, "
+                "dim=...) here; the attr's initializer/trainable fields "
+                "are honored, the g*v/||v|| decomposition is not applied "
+                "implicitly.", stacklevel=2)
+            WeightNormParamAttr._warned = True
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: static.ExponentialMovingAverage —
+    maintains shadow variables updated as
+    ``shadow = decay * shadow + (1 - decay) * param`` with optional
+    ``thres_steps`` decay ramp, and apply()/restore() swaps).
+
+    Functional form: ``update(params)`` folds a pytree of current
+    parameters into the shadow state; ``apply(params)`` returns a
+    context manager yielding the EMA parameters (restore is the
+    context exit, like the reference's guard usage).
+    """
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self.thres_steps = thres_steps
+        self._shadow = None
+        self._step = 0
+
+    def update(self, params):
+        self._step += 1
+        if self.thres_steps is not None:
+            d = min(self.decay, (1.0 + self._step) / (10.0 + self._step))
+        else:
+            d = self.decay
+        if self._shadow is None:
+            self._shadow = jax.tree_util.tree_map(jnp.asarray, params)
+        else:
+            self._shadow = jax.tree_util.tree_map(
+                lambda s, p: d * s + (1.0 - d) * jnp.asarray(p),
+                self._shadow, params)
+        return self._shadow
+
+    def shadow(self):
+        return self._shadow
+
+    def apply(self, params=None):
+        """Context manager yielding the EMA parameters (the reference's
+        apply()/restore() pair as a guard)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            yield self._shadow
+        return _guard()
+
+    def restore(self, executor=None):
+        # parity no-op: the functional guard never mutated live params
+        return None

@@ -324,3 +324,52 @@ def cholesky_inverse(x, upper=False, name=None):
 
 
 __all__ += ["cdist", "vecdot", "cholesky_inverse"]
+
+
+def lu_solve(b, lu, pivots, trans: str = "N", name=None):
+    """Solve A x = b given the packed LU factorization from
+    :func:`paddle_tpu.linalg.lu` (reference: paddle.linalg.lu_solve).
+    ``pivots`` are the 1-based sequential row swaps lu() returns; they are
+    converted to a permutation and the two triangular solves run on the
+    packed factor."""
+    import jax
+    b = jnp.asarray(b)
+    lu_m = jnp.asarray(lu)
+    piv = jnp.asarray(pivots, jnp.int32) - 1          # 0-based swaps
+    n = lu_m.shape[-1]
+
+    def seq_to_perm(p):
+        # sequential swap vector -> permutation of rows
+        perm = jnp.arange(n)
+
+        def body(i, perm):
+            j = p[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi)
+        return jax.lax.fori_loop(0, p.shape[-1], body, perm)
+
+    def solve_one(lum, p, rhs):
+        perm = seq_to_perm(p)
+        if trans in ("T", "H"):
+            # A^T x = b: U^T y = b; L^T z = y; x = P^T z
+            # (H uses the conjugate-transpose solves, trans=2)
+            t = 2 if trans == "H" else 1
+            y = jax.scipy.linalg.solve_triangular(lum, rhs, lower=False,
+                                                  trans=t)
+            z = jax.scipy.linalg.solve_triangular(lum, y, lower=True,
+                                                  unit_diagonal=True,
+                                                  trans=t)
+            inv = jnp.argsort(perm)
+            return z[inv]
+        pb = rhs[perm]
+        y = jax.scipy.linalg.solve_triangular(lum, pb, lower=True,
+                                              unit_diagonal=True)
+        return jax.scipy.linalg.solve_triangular(lum, y, lower=False)
+
+    if lu_m.ndim == 2:
+        return solve_one(lu_m, piv, b)
+    flat_lu = lu_m.reshape((-1,) + lu_m.shape[-2:])
+    flat_p = piv.reshape((-1, piv.shape[-1]))
+    flat_b = b.reshape((-1,) + b.shape[-2:])
+    out = jax.vmap(solve_one)(flat_lu, flat_p, flat_b)
+    return out.reshape(b.shape)

@@ -214,7 +214,8 @@ set_code_level set_verbosity to_static
 """
 
 PADDLE_STATIC = """
-InputSpec accuracy auc load_inference_model save_inference_model
+ExponentialMovingAverage InputSpec Print WeightNormParamAttr accuracy
+auc py_func load_inference_model save_inference_model
 Program Executor program_guard data default_main_program
 default_startup_program global_scope create_parameter save load
 """
@@ -246,7 +247,7 @@ FusedLinear FusedBiasDropoutResidualLayerNorm functional
 PADDLE_INCUBATE = """
 segment_sum segment_mean segment_max segment_min softmax_mask_fuse
 softmax_mask_fuse_upper_triangle identity_loss graph_khop_sampler
-autograd nn optimizer
+autograd multiprocessing nn optimizer
 """
 
 PADDLE_INCUBATE_AUTOGRAD = """
@@ -254,7 +255,11 @@ jvp vjp Jacobian Hessian enable_prim disable_prim prim_enabled
 """
 
 PADDLE_INCUBATE_OPT = """
-LookAhead ModelAverage
+LookAhead ModelAverage functional
+"""
+
+PADDLE_INCUBATE_OPT_F = """
+minimize_bfgs minimize_lbfgs
 """
 
 PADDLE_CALLBACKS = """
@@ -385,7 +390,7 @@ init_rpc rpc_async rpc_sync shutdown
 """
 
 PADDLE_AUTOGRAD = """
-PyLayer PyLayerContext backward grad hessian is_grad_enabled jacobian jvp
+saved_tensors_hooks PyLayer PyLayerContext backward grad hessian is_grad_enabled jacobian jvp
 no_grad vjp
 """
 
@@ -461,6 +466,7 @@ REFERENCE = {
     "paddle.incubate.autograd": PADDLE_INCUBATE_AUTOGRAD,
     "paddle.amp.debugging": PADDLE_AMP_DEBUGGING,
     "paddle.sysconfig": PADDLE_SYSCONFIG,
+    "paddle.incubate.optimizer.functional": PADDLE_INCUBATE_OPT_F,
 }
 
 # repo namespace that answers for each reference namespace
@@ -517,6 +523,8 @@ TARGETS = {
     "paddle.incubate.autograd": "paddle_tpu.incubate.autograd",
     "paddle.amp.debugging": "paddle_tpu.amp.debugging",
     "paddle.sysconfig": "paddle_tpu.sysconfig",
+    "paddle.incubate.optimizer.functional":
+        "paddle_tpu.incubate.optimizer.functional",
 }
 
 
@@ -610,6 +618,11 @@ EXPLICIT_CUTS = {
         "is the TPU-world extension point",
     "paddle.Tensor.data_ptr / __cuda_array_interface__":
         "raw device pointers are not exposed by PJRT",
+    "paddle.nn.functional.flash_attention_with_sparse_mask":
+        "the sparse start-row mask layout is an input format of the CUDA "
+        "flash-attn kernel; the causal/varlen/dense-mask paths cover the "
+        "semantics — guessing the packed layout silently would risk wrong "
+        "attention, so the name is a documented cut",
     "paddle.nn.dynamic_decode(output_time_major/impute_finished)":
         "shape bookkeeping subsumed by the static-shape scan decoder; "
         "accepted and ignored with the (ids, scores) return documented",

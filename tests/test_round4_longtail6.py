@@ -1,0 +1,189 @@
+"""Round-4 sixth sweep: functional quasi-Newton minimizers, static
+Print/py_func/WeightNormParamAttr/ExponentialMovingAverage,
+linalg.lu_solve, Tensor.apply, saved_tensors_hooks,
+incubate.multiprocessing.
+
+Oracles: scipy (erf + derivative through py_func's custom vjp), direct
+solve residuals for lu_solve, closed-form quadratic minima.
+"""
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.initializer as I
+from paddle_tpu.incubate.optimizer import functional as fopt
+
+
+class TestFunctionalMinimizers:
+    def _quad(self):
+        return lambda x: (x[0] - 1.0) ** 2 + 2.0 * (x[1] - 2.0) ** 2
+
+    @pytest.mark.parametrize("minimize", [fopt.minimize_lbfgs,
+                                          fopt.minimize_bfgs],
+                             ids=["lbfgs", "bfgs"])
+    def test_quadratic_minimum(self, minimize):
+        conv, ncalls, pos, val, grad = minimize(self._quad(),
+                                                jnp.asarray([0.0, 0.0]))
+        assert bool(conv)
+        np.testing.assert_allclose(np.asarray(pos), [1.0, 2.0], atol=1e-4)
+        assert float(val) == pytest.approx(0.0, abs=1e-6)
+        np.testing.assert_allclose(np.asarray(grad), 0.0, atol=1e-3)
+        assert int(ncalls) >= 1
+
+    def test_logcosh_nonquadratic_lbfgs(self):
+        # smooth strongly-convex non-quadratic, min at (1, 2)
+        f = lambda x: (jnp.logaddexp(x[0] - 1.0, -(x[0] - 1.0))
+                       + jnp.logaddexp(2.0 * (x[1] - 2.0),
+                                       -2.0 * (x[1] - 2.0)))
+        conv, _, pos, val, _ = fopt.minimize_lbfgs(
+            f, jnp.asarray([-1.2, 4.0]), max_iters=100,
+            tolerance_grad=1e-5)
+        np.testing.assert_allclose(np.asarray(pos), [1.0, 2.0], atol=1e-3)
+
+    def test_lbfgs_rejects_dense_h0(self):
+        with pytest.raises(NotImplementedError):
+            fopt.minimize_lbfgs(self._quad(), jnp.zeros(2),
+                                initial_inverse_hessian_estimate=jnp.eye(2))
+
+
+class TestStaticExtras:
+    def test_print_message_with_braces(self, capfd):
+        out = paddle.static.Print(jnp.ones(2), message="step {i} {}")
+        jax.effects_barrier()
+        assert float(out[0]) == 1.0
+        captured = capfd.readouterr()
+        assert "step {i} {}" in (captured.out + captured.err)
+
+    def test_print_is_identity_under_jit(self, capfd):
+        f = jax.jit(lambda x: paddle.static.Print(x, message="dbg") * 2)
+        out = f(jnp.ones(3))
+        jax.effects_barrier()
+        assert float(out[0]) == 2.0
+        captured = capfd.readouterr()
+        assert "dbg" in captured.out or "dbg" in captured.err
+
+    def test_py_func_forward_and_custom_vjp(self):
+        def host_fn(x):
+            return sp.erf(x)
+
+        # the REFERENCE backward contract: (inputs..., outputs..., grads)
+        def host_bwd(x, out, g):
+            assert np.allclose(np.asarray(out), sp.erf(np.asarray(x)))
+            return g * 2.0 / np.sqrt(np.pi) * np.exp(-np.asarray(x) ** 2)
+
+        x = jnp.asarray([0.3, -0.7])
+        y = paddle.static.py_func(host_fn, x, out=jnp.zeros(2))
+        np.testing.assert_allclose(np.asarray(y), sp.erf(np.asarray(x)),
+                                   rtol=1e-6)
+        lossg = jax.grad(lambda x: paddle.static.py_func(
+            host_fn, x, out=jnp.zeros(2), backward_func=host_bwd).sum())
+        want = 2 / np.sqrt(np.pi) * np.exp(-np.asarray(x) ** 2)
+        np.testing.assert_allclose(np.asarray(lossg(x)), want, rtol=1e-5)
+        # the same op inside jit (pure_callback's whole point)
+        np.testing.assert_allclose(np.asarray(jax.jit(lossg)(x)), want,
+                                   rtol=1e-5)
+
+    def test_py_func_skip_vars_in_backward_input(self):
+        out_t = jnp.zeros(2)
+
+        def host_bwd(x, g):     # out skipped -> (inputs..., grads)
+            return g * np.cos(np.asarray(x))
+
+        x = jnp.asarray([0.2, 1.1])
+        g = jax.grad(lambda x: paddle.static.py_func(
+            lambda a: np.sin(a), x, out=out_t, backward_func=host_bwd,
+            skip_vars_in_backward_input=[out_t]).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), np.cos(np.asarray(x)),
+                                   rtol=1e-5)
+
+    def test_py_func_multi_output(self):
+        outs = paddle.static.py_func(
+            lambda a: (np.asarray(a) + 1, np.asarray(a) * 2),
+            jnp.ones(3), out=[jnp.zeros(3), jnp.zeros(3)])
+        assert isinstance(outs, list) and len(outs) == 2
+        np.testing.assert_allclose(np.asarray(outs[0]), 2.0)
+        np.testing.assert_allclose(np.asarray(outs[1]), 2.0)
+
+    def test_weight_norm_param_attr(self):
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            attr = paddle.static.WeightNormParamAttr(
+                dim=0, initializer=I.Constant(0.3))
+        lin = nn.Linear(3, 4, weight_attr=attr)
+        assert float(lin.weight[0, 0]) == pytest.approx(0.3)
+        assert attr.dim == 0
+
+    def test_ema_update_and_apply(self):
+        ema = paddle.static.ExponentialMovingAverage(0.5)
+        ema.update({"w": jnp.asarray(2.0)})
+        ema.update({"w": jnp.asarray(4.0)})
+        with ema.apply() as shadow:
+            assert float(shadow["w"]) == pytest.approx(3.0)
+        ema.restore()
+
+
+class TestLuSolve:
+    def test_conjugate_transpose_complex(self):
+        rng = np.random.RandomState(2)
+        A = (rng.randn(3, 3) + 1j * rng.randn(3, 3)).astype("complex64")
+        A = A + 4 * np.eye(3, dtype="complex64")
+        b = (rng.randn(3, 1) + 1j * rng.randn(3, 1)).astype("complex64")
+        lu, piv = paddle.linalg.lu(jnp.asarray(A))
+        xh = paddle.linalg.lu_solve(jnp.asarray(b), lu, piv, trans="H")
+        np.testing.assert_allclose(np.asarray(jnp.conj(jnp.asarray(A)).T
+                                              @ xh), b, rtol=2e-3,
+                                   atol=1e-3)
+
+    def test_solves_and_transpose(self):
+        rng = np.random.RandomState(0)
+        A = rng.randn(4, 4).astype("float32") + 4 * np.eye(4, dtype="float32")
+        b = rng.randn(4, 2).astype("float32")
+        lu, piv = paddle.linalg.lu(jnp.asarray(A))
+        x = paddle.linalg.lu_solve(jnp.asarray(b), lu, piv)
+        np.testing.assert_allclose(np.asarray(jnp.asarray(A) @ x), b,
+                                   rtol=2e-4, atol=1e-4)
+        xt = paddle.linalg.lu_solve(jnp.asarray(b), lu, piv, trans="T")
+        np.testing.assert_allclose(np.asarray(jnp.asarray(A).T @ xt), b,
+                                   rtol=2e-4, atol=1e-4)
+
+    def test_batched(self):
+        rng = np.random.RandomState(1)
+        A = rng.randn(3, 4, 4).astype("float32") + 4 * np.eye(
+            4, dtype="float32")
+        b = rng.randn(3, 4, 1).astype("float32")
+        lu, piv = paddle.linalg.lu(jnp.asarray(A))
+        x = paddle.linalg.lu_solve(jnp.asarray(b), lu, piv)
+        np.testing.assert_allclose(np.asarray(jnp.asarray(A) @ x), b,
+                                   rtol=2e-4, atol=1e-4)
+
+
+class TestMiscWave6:
+    def test_tensor_apply(self):
+        from paddle_tpu.compat import enable_tensor_methods
+        enable_tensor_methods()
+        t = jnp.ones(3)
+        assert float(t.apply(lambda v: v * 3)[0]) == 3.0
+
+    def test_saved_tensors_hooks_warn_once_noop(self):
+        import paddle_tpu.autograd as AG
+        AG._STH_WARNED[0] = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with AG.saved_tensors_hooks(lambda x: x, lambda x: x):
+                pass
+            with AG.saved_tensors_hooks(lambda x: x, lambda x: x):
+                pass
+        assert sum("saved_tensors_hooks" in str(x.message) for x in w) == 1
+
+    def test_incubate_multiprocessing(self):
+        import paddle_tpu.incubate.multiprocessing as pmp
+        assert hasattr(pmp, "Process")
+        pmp.set_sharing_strategy("file_system")
+        assert pmp.get_sharing_strategy() == "file_system"
