@@ -16,7 +16,8 @@ from .collective import (ReduceOp, all_reduce, all_gather,  # noqa: F401
                          all_gather_object, reduce_scatter, alltoall,
                          alltoall_single, broadcast, reduce, scatter,
                          barrier, send, recv, new_group, wait,
-                         P2POp, batch_isend_irecv, is_available)
+                         P2POp, batch_isend_irecv, is_available,
+                         ReduceType)
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
 from . import fleet as _fleet_mod  # noqa: F401
 from .fleet import fleet  # noqa: F401

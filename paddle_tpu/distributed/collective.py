@@ -504,3 +504,15 @@ def is_available() -> bool:
     """Reference: paddle.distributed.is_available — the distributed
     package is always compiled into this framework."""
     return True
+
+
+class ReduceType:
+    """Reference: paddle.distributed.ReduceType — the reduction kind a
+    Partial placement carries (auto_parallel/placement_type.py)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6

@@ -4,3 +4,42 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_IMAGE_BACKEND = ["pil"]
+
+
+def set_image_backend(backend: str):
+    """Reference: paddle.vision.set_image_backend('pil'|'cv2'|'tensor').
+    PIL is the available decoder in this environment; 'cv2' raises like
+    the reference does for an uninstalled backend."""
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be 'pil', 'cv2' or 'tensor', got {backend!r}")
+    if backend == "cv2":
+        raise ImportError("cv2 is not installed in this environment; "
+                          "use the 'pil' backend")
+    _IMAGE_BACKEND[0] = backend
+
+
+def get_image_backend() -> str:
+    return _IMAGE_BACKEND[0]
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference: paddle.vision.image_load) with the
+    active backend; 'pil' returns a PIL.Image, 'tensor' an HWC uint8
+    numpy array (the CHW float conversion is ToTensor's job, like the
+    reference)."""
+    backend = backend or _IMAGE_BACKEND[0]
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be 'pil', 'cv2' or 'tensor', got {backend!r}")
+    if backend == "cv2":
+        raise ImportError("cv2 is not installed in this environment")
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+        return np.asarray(img.convert("RGB"))
+    return img

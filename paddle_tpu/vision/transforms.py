@@ -355,34 +355,7 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     dx, dy = xs - ocx, ys - ocy
     sx = cos_t * dx + sin_t * dy + cx
     sy = -sin_t * dx + cos_t * dy + cy
-    if interpolation == "bilinear":
-        x0 = np.floor(sx).astype(np.int32)
-        y0 = np.floor(sy).astype(np.int32)
-        wx, wy = sx - x0, sy - y0
-        def at(yy, xx):
-            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
-            yy2, xx2 = np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)
-            px = img[yy2, xx2].astype(np.float32)
-            if img.ndim == 3:
-                return np.where(valid[..., None], px, float(fill))
-            return np.where(valid, px, float(fill))
-        wxe = wx[..., None] if img.ndim == 3 else wx
-        wye = wy[..., None] if img.ndim == 3 else wy
-        out = (at(y0, x0) * (1 - wxe) * (1 - wye) +
-               at(y0, x0 + 1) * wxe * (1 - wye) +
-               at(y0 + 1, x0) * (1 - wxe) * wye +
-               at(y0 + 1, x0 + 1) * wxe * wye)
-    else:
-        xr = np.round(sx).astype(np.int32)
-        yr = np.round(sy).astype(np.int32)
-        valid = (yr >= 0) & (yr < h) & (xr >= 0) & (xr < w)
-        out = img[np.clip(yr, 0, h - 1),
-                  np.clip(xr, 0, w - 1)].astype(np.float32)
-        mask = valid[..., None] if img.ndim == 3 else valid
-        out = np.where(mask, out, float(fill))
-    if img.dtype == np.uint8:
-        out = np.clip(out, 0, 255)
-    return out.astype(img.dtype)
+    return _sample_inverse(img, sy, sx, interpolation, fill)
 
 
 class ContrastTransform(_Transform):
@@ -472,3 +445,243 @@ __all__ += ["adjust_brightness", "adjust_contrast", "adjust_hue",
             "to_grayscale", "rotate", "ContrastTransform",
             "SaturationTransform", "HueTransform", "ColorJitter",
             "Grayscale", "RandomRotation"]
+
+
+# ---- round-4 geometric/erasing transform family -------------------------
+
+def _sample_inverse(img, sy, sx, interpolation, fill):
+    """Sample ``img`` at float source coords (inverse-mapped output grid);
+    out-of-image samples take ``fill`` (scalar or per-channel sequence) —
+    the shared warp kernel for rotate/affine/perspective."""
+    h, w = img.shape[:2]
+    fillv = np.asarray(fill, np.float32)   # scalar or (C,) broadcast
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int32)
+        y0 = np.floor(sy).astype(np.int32)
+        wx, wy = sx - x0, sy - y0
+
+        def at(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yy2, xx2 = np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)
+            px = img[yy2, xx2].astype(np.float32)
+            if img.ndim == 3:
+                return np.where(valid[..., None], px, fillv)
+            return np.where(valid, px, fillv)
+
+        wxe = wx[..., None] if img.ndim == 3 else wx
+        wye = wy[..., None] if img.ndim == 3 else wy
+        out = (at(y0, x0) * (1 - wxe) * (1 - wye) +
+               at(y0, x0 + 1) * wxe * (1 - wye) +
+               at(y0 + 1, x0) * (1 - wxe) * wye +
+               at(y0 + 1, x0 + 1) * wxe * wye)
+    else:
+        xr = np.round(sx).astype(np.int32)
+        yr = np.round(sy).astype(np.int32)
+        valid = (yr >= 0) & (yr < h) & (xr >= 0) & (xr < w)
+        out = img[np.clip(yr, 0, h - 1),
+                  np.clip(xr, 0, w - 1)].astype(np.float32)
+        mask = valid[..., None] if img.ndim == 3 else valid
+        out = np.where(mask, out, fillv)
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(img.dtype)
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine-warp an HWC image (reference: transforms.functional.affine
+    — rotation + shear + scale about ``center``, then translate; the
+    torchvision-compatible parameterization the reference documents)."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    if isinstance(shear, numbers.Number):
+        shear = (float(shear), 0.0)
+    rot = np.deg2rad(angle)
+    sx_, sy_ = (np.deg2rad(s) for s in shear)
+    # forward matrix: T(center) R(rot) Shear Scale T(-center) + translate
+    a = np.cos(rot - sy_) / max(np.cos(sy_), 1e-12)
+    b = -np.cos(rot - sy_) * np.tan(sx_) / max(np.cos(sy_), 1e-12) \
+        - np.sin(rot)
+    c = np.sin(rot - sy_) / max(np.cos(sy_), 1e-12)
+    d = -np.sin(rot - sy_) * np.tan(sx_) / max(np.cos(sy_), 1e-12) \
+        + np.cos(rot)
+    m = scale * np.array([[a, b], [c, d]], np.float64)
+    tx, ty = translate
+    # inverse map: out pixel -> src
+    minv = np.linalg.inv(m)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    dx = xs - cx - tx
+    dy = ys - cy - ty
+    sxm = minv[0, 0] * dx + minv[0, 1] * dy + cx
+    sym = minv[1, 0] * dx + minv[1, 1] * dy + cy
+    return _sample_inverse(img, sym, sxm, interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective-warp mapping ``startpoints`` (4 corners [x, y]) onto
+    ``endpoints`` (reference: transforms.functional.perspective; the
+    8-DOF homography solved from the 4 point pairs)."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    src = np.asarray(endpoints, np.float64)   # inverse map: out -> in
+    dst = np.asarray(startpoints, np.float64)
+    A = []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    A = np.asarray(A, np.float64)
+    rhs = dst.reshape(-1)
+    coef, *_ = np.linalg.lstsq(A, rhs, rcond=None)
+    ha, hb, hc, hd, he, hf, hg, hh = coef
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    denom = hg * xs + hh * ys + 1.0
+    sxm = (ha * xs + hb * ys + hc) / denom
+    sym = (hd * xs + he * ys + hf) / denom
+    return _sample_inverse(img, sym, sxm, interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the region [i:i+h, j:j+w] with value ``v`` (reference:
+    transforms.functional.erase).  numpy images support true in-place."""
+    out = np.asarray(img)
+    if not inplace:
+        out = out.copy()
+    elif not out.flags.writeable:
+        raise ValueError(
+            "erase(inplace=True) needs a writable array; PIL-backed "
+            "inputs are read-only views — convert with np.array(img) "
+            "first or use inplace=False")
+    out[i:i + h, j:j + w] = np.broadcast_to(
+        np.asarray(v, out.dtype), out[i:i + h, j:j + w].shape)
+    return out
+
+
+def adjust_gamma(img, gamma, gain: float = 1.0):
+    """out = gain * (img/max)^gamma rescaled (reference: adjust_gamma)."""
+    if gamma < 0:
+        raise ValueError("gamma must be non-negative")
+    img = np.asarray(img)
+    dtype = img.dtype
+    if dtype == np.uint8:
+        f = img.astype(np.float32) / 255.0
+        out = gain * (f ** gamma) * 255.0
+        return np.clip(out, 0, 255).astype(dtype)
+    return (gain * img.astype(np.float32) ** gamma).astype(dtype)
+
+
+class RandomErasing(_Transform):
+    """Reference: transforms.RandomErasing(prob, scale, ratio, value)."""
+
+    def __init__(self, prob: float = 0.5, scale=(0.02, 0.33),
+                 ratio=(0.3, 3.3), value=0, inplace: bool = False,
+                 keys=None):
+        if not 0 <= prob <= 1:
+            raise ValueError("prob must be in [0, 1]")
+        self.prob = prob
+        self.scale = tuple(scale)
+        self.ratio = tuple(ratio)
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if pyrandom.random() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = np.exp(pyrandom.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = pyrandom.randint(0, h - eh)
+                j = pyrandom.randint(0, w - ew)
+                if self.value == "random":
+                    rng = np.random.default_rng(pyrandom.getrandbits(32))
+                    shape = (eh, ew) + img.shape[2:]
+                    # dtype-appropriate noise: uint8 gets its full range,
+                    # float keeps the reference's N(0, 1)
+                    v = (rng.integers(0, 256, shape)
+                         if img.dtype == np.uint8
+                         else rng.standard_normal(shape))
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img
+
+
+class RandomAffine(_Transform):
+    """Reference: transforms.RandomAffine(degrees, translate, scale,
+    shear, ...)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.translate = translate
+        self.scale_range = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        angle = pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        sc = (pyrandom.uniform(*self.scale_range)
+              if self.scale_range is not None else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                s = (-abs(s), abs(s))
+            if len(s) == 2:
+                sh = (pyrandom.uniform(s[0], s[1]), 0.0)
+            else:
+                sh = (pyrandom.uniform(s[0], s[1]),
+                      pyrandom.uniform(s[2], s[3]))
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(_Transform):
+    """Reference: transforms.RandomPerspective(prob, distortion_scale)."""
+
+    def __init__(self, prob: float = 0.5, distortion_scale: float = 0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if pyrandom.random() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        hw, hh = int(w * d / 2), int(h * d / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [
+            [pyrandom.randint(0, hw), pyrandom.randint(0, hh)],
+            [w - 1 - pyrandom.randint(0, hw), pyrandom.randint(0, hh)],
+            [w - 1 - pyrandom.randint(0, hw),
+             h - 1 - pyrandom.randint(0, hh)],
+            [pyrandom.randint(0, hw), h - 1 - pyrandom.randint(0, hh)],
+        ]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+__all__ += ["affine", "perspective", "erase", "adjust_gamma",
+            "RandomErasing", "RandomAffine", "RandomPerspective"]

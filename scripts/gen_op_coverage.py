@@ -152,7 +152,7 @@ istft stft
 """
 
 PADDLE_DISTRIBUTED = """
-ReduceOp all_gather all_gather_object all_reduce alltoall alltoall_single
+ReduceOp ReduceType all_gather all_gather_object all_reduce alltoall alltoall_single
 barrier broadcast broadcast_object_list destroy_process_group get_backend
 get_group get_rank get_world_size group_sharded_parallel gather init_parallel_env irecv isend
 is_initialized new_group recv reduce reduce_scatter scatter
@@ -281,8 +281,14 @@ BrightnessTransform CenterCrop ColorJitter Compose ContrastTransform
 Grayscale HueTransform Normalize Pad RandomCrop RandomHorizontalFlip
 RandomResizedCrop RandomRotation RandomVerticalFlip Resize
 SaturationTransform ToTensor Transpose adjust_brightness adjust_contrast
-adjust_hue center_crop crop hflip normalize pad resize rotate to_grayscale
-to_tensor vflip
+adjust_gamma adjust_hue affine center_crop crop erase hflip normalize
+pad perspective resize rotate to_grayscale to_tensor vflip
+RandomAffine RandomErasing RandomPerspective
+"""
+
+PADDLE_VISION = """
+get_image_backend set_image_backend image_load models transforms ops
+datasets
 """
 
 PADDLE_VISION_OPS = """
@@ -467,6 +473,7 @@ REFERENCE = {
     "paddle.amp.debugging": PADDLE_AMP_DEBUGGING,
     "paddle.sysconfig": PADDLE_SYSCONFIG,
     "paddle.incubate.optimizer.functional": PADDLE_INCUBATE_OPT_F,
+    "paddle.vision": PADDLE_VISION,
 }
 
 # repo namespace that answers for each reference namespace
@@ -525,6 +532,7 @@ TARGETS = {
     "paddle.sysconfig": "paddle_tpu.sysconfig",
     "paddle.incubate.optimizer.functional":
         "paddle_tpu.incubate.optimizer.functional",
+    "paddle.vision": "paddle_tpu.vision",
 }
 
 
@@ -618,6 +626,12 @@ EXPLICIT_CUTS = {
         "is the TPU-world extension point",
     "paddle.Tensor.data_ptr / __cuda_array_interface__":
         "raw device pointers are not exposed by PJRT",
+    "paddle.distributed.parallelize / to_distributed":
+        "3.0-beta preview front-ends over the semi-auto engine; the "
+        "capability ships as shard_tensor/shard_layer/shard_optimizer/"
+        "Engine/DistModel + fleet.distributed_model — the plan-class "
+        "surface is not finalized upstream, so a guessed signature would "
+        "be worse than the documented mapping",
     "paddle.nn.functional.flash_attention_with_sparse_mask":
         "the sparse start-row mask layout is an input format of the CUDA "
         "flash-attn kernel; the causal/varlen/dense-mask paths cover the "

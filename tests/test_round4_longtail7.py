@@ -1,0 +1,158 @@
+"""Round-4 seventh sweep: affine/perspective/erase/adjust_gamma
+transforms (+Random* classes), the image-backend trio, ReduceType.
+
+Oracles: identity-parameter warps must reproduce the input exactly;
+pure-translation affine against np.roll; perspective corner mapping;
+PIL roundtrip for image_load.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision as vision
+import paddle_tpu.vision.transforms as T
+
+
+def _img(h=8, w=10, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 255, (h, w, c)).astype("uint8")
+
+
+class TestAffine:
+    def test_identity(self):
+        img = _img()
+        out = T.affine(img, angle=0.0)
+        np.testing.assert_array_equal(out, img)
+
+    def test_pure_translation_matches_roll(self):
+        img = _img()
+        out = T.affine(img, angle=0.0, translate=(2, 1), fill=0)
+        # shifted content: out[y+1, x+2] == img[y, x] inside bounds
+        np.testing.assert_array_equal(out[1:, 2:], img[:-1, :-2])
+        assert (out[0] == 0).all() and (out[:, :2] == 0).all()
+
+    def test_rotation_matches_rotate(self):
+        img = _img()
+        np.testing.assert_array_equal(
+            T.affine(img, angle=90.0), T.rotate(img, 90.0))
+
+    def test_scale_about_center(self):
+        img = np.zeros((9, 9), "uint8")
+        img[4, 4] = 255
+        out = T.affine(img, angle=0.0, scale=2.0)
+        assert out[4, 4] == 255      # center fixed point
+
+
+class TestPerspective:
+    def test_identity_corners(self):
+        img = _img()
+        pts = [[0, 0], [9, 0], [9, 7], [0, 7]]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_array_equal(out, img)
+
+    def test_translation_homography(self):
+        img = _img()
+        start = [[0, 0], [9, 0], [9, 7], [0, 7]]
+        end = [[1, 0], [10, 0], [10, 7], [1, 7]]   # shift right by 1
+        out = T.perspective(img, start, end)
+        np.testing.assert_array_equal(out[:, 1:], img[:, :-1])
+
+
+class TestEraseGamma:
+    def test_erase_region_and_inplace(self):
+        img = _img()
+        out = T.erase(img, 2, 3, 4, 5, 7)
+        assert (out[2:6, 3:8] == 7).all()
+        assert (img[2:6, 3:8] != 7).any()          # original untouched
+        T.erase(img, 0, 0, 2, 2, 9, inplace=True)
+        assert (img[:2, :2] == 9).all()
+
+    def test_adjust_gamma(self):
+        img = _img()
+        out = T.adjust_gamma(img, 1.0)
+        np.testing.assert_allclose(out, img, atol=1)
+        dark = T.adjust_gamma(img, 2.0)
+        assert dark.mean() < img.mean()
+        with pytest.raises(ValueError):
+            T.adjust_gamma(img, -1.0)
+
+    def test_random_classes_shapes(self):
+        img = _img()
+        assert T.RandomErasing(prob=1.0)(img).shape == img.shape
+        assert T.RandomErasing(prob=0.0)(img) is not None
+        assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                              shear=5)(img).shape == img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+        with pytest.raises(ValueError):
+            T.RandomErasing(prob=2.0)
+
+
+class TestImageBackend:
+    def test_get_set_and_load(self):
+        assert vision.get_image_backend() == "pil"
+        with pytest.raises(ValueError):
+            vision.set_image_backend("nope")
+        with pytest.raises(ImportError):
+            vision.set_image_backend("cv2")
+        from PIL import Image
+        img = _img()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.png")
+            Image.fromarray(img).save(path)
+            loaded = vision.image_load(path)
+            np.testing.assert_array_equal(np.asarray(loaded), img)
+            arr = vision.image_load(path, backend="tensor")
+            assert isinstance(arr, np.ndarray) and arr.shape == img.shape
+        vision.set_image_backend("pil")
+
+
+class TestReduceType:
+    def test_enum_values(self):
+        rt = paddle.distributed.ReduceType
+        assert rt.kRedSum == 0
+        assert rt.kRedAvg == 4
+        assert len({rt.kRedSum, rt.kRedMax, rt.kRedMin, rt.kRedProd,
+                    rt.kRedAvg, rt.kRedAny, rt.kRedAll}) == 7
+
+
+class TestReviewRegressions7:
+    def test_zero_distortion_is_identity(self):
+        img = _img()
+        out = T.RandomPerspective(prob=1.0, distortion_scale=0.0)(img)
+        np.testing.assert_array_equal(out, img)
+
+    def test_sequence_fill(self):
+        img = _img()
+        out = T.affine(img, angle=0.0, translate=(3, 0),
+                       fill=(255, 0, 0))
+        # vacated left columns take the per-channel fill
+        assert (out[:, :3, 0] == 255).all()
+        assert (out[:, :3, 1] == 0).all()
+        # rotate inherits through the shared kernel
+        out2 = T.rotate(img, 45.0, fill=7)
+        assert out2.shape == img.shape
+
+    def test_erase_inplace_readonly_guarded(self):
+        ro = _img()
+        ro.setflags(write=False)
+        with pytest.raises(ValueError, match="writable"):
+            T.erase(ro, 0, 0, 2, 2, 5, inplace=True)
+
+    def test_random_value_uint8_in_range(self):
+        img = _img(16, 16)
+        out = T.RandomErasing(prob=1.0, scale=(0.2, 0.4),
+                              value="random")(img)
+        diff = out != img
+        assert diff.any()
+        # uint8 noise spans the range without wraparound artifacts of a
+        # float->uint8 C-cast (which lands almost everything at 0/255)
+        vals = out[diff.any(-1)]
+        assert vals.std() > 20
+
+    def test_image_load_rejects_bad_backend(self):
+        with pytest.raises(ValueError):
+            vision.image_load("nope.png", backend="bogus")
