@@ -214,3 +214,13 @@ def get_available_custom_device():
 
 __all__ += ["Stream", "Event", "stream_guard", "current_stream",
             "get_available_device", "get_available_custom_device"]
+
+
+def get_all_device_type():
+    """Reference: paddle.device.get_all_device_type — every device type
+    the build supports."""
+    import jax
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+__all__ += ["get_all_device_type"]

@@ -12,3 +12,7 @@ from .attention import *  # noqa: F401,F403
 
 from . import activation, common, conv, norm, pooling, loss, input, attention  # noqa: F401
 from .vision import *  # noqa: F401,F403
+
+
+# reference exposes diag_embed at F as well as paddle top level
+from ...tensor.manipulation import diag_embed  # noqa: E402,F401

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "relu", "relu6", "relu_", "gelu", "silu", "swish", "sigmoid", "tanh",
-    "softmax", "log_softmax", "leaky_relu", "elu", "selu", "celu",
+    "softmax", "log_softmax", "leaky_relu", "leaky_relu_", "elu", "elu_", "selu", "celu",
     "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "softshrink",
     "tanhshrink", "softplus", "softsign", "mish", "glu", "swiglu",
     "prelu", "rrelu", "maxout", "thresholded_relu", "log_sigmoid",
@@ -177,3 +177,13 @@ def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False, axis: int = 
         # straight-through: forward = one-hot, backward = soft
         y = jax.lax.stop_gradient(y_hard - y) + y
     return y
+
+
+def elu_(x, alpha: float = 1.0):
+    """Inplace-named elu (reference: F.elu_); returns the result."""
+    return elu(x, alpha)
+
+
+def leaky_relu_(x, negative_slope: float = 0.01):
+    """Inplace-named leaky_relu (reference: F.leaky_relu_)."""
+    return leaky_relu(x, negative_slope)

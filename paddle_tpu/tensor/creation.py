@@ -8,7 +8,7 @@ import numpy as np
 
 from ..framework.random import next_rng_key
 
-__all__ = ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+__all__ = ["to_tensor", "as_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
            "full_like", "arange", "linspace", "logspace", "eye", "empty",
            "empty_like", "meshgrid", "diag", "diagflat", "diagonal",
            "tril", "triu",
@@ -19,6 +19,13 @@ __all__ = ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     arr = jnp.asarray(data, dtype=jnp.dtype(dtype) if dtype else None)
     return arr
+
+
+def as_tensor(data, dtype=None, place=None):
+    """Reference: paddle.as_tensor — like to_tensor but shares memory
+    when possible; jnp.asarray is already copy-avoiding on matching
+    dtypes, so both entries are the same op here."""
+    return to_tensor(data, dtype=dtype, place=place)
 
 
 def zeros(shape, dtype="float32", name=None):

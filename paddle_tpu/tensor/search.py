@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "where", "where_", "nonzero",
            "searchsorted", "kthvalue", "mode", "median", "nanmedian",
            "quantile", "nanquantile", "bucketize", "index_of", "masked_scatter"]
 
@@ -148,3 +148,9 @@ def masked_scatter(x, mask, value, name=None):
     cum = jnp.cumsum(mask_b.reshape(-1)) - 1
     gathered = jnp.take(flat_val, jnp.clip(cum, 0, flat_val.shape[0] - 1))
     return jnp.where(mask_b, gathered.reshape(x.shape), x)
+
+
+def where_(condition, x=None, y=None, name=None):
+    """Inplace-named variant (reference: paddle.where_); returns the
+    result — the registry-wide immutability deviation."""
+    return where(condition, x, y)

@@ -580,11 +580,16 @@ class RandomErasing(_Transform):
                  keys=None):
         if not 0 <= prob <= 1:
             raise ValueError("prob must be in [0, 1]")
+        if isinstance(value, str) and value != "random":
+            raise ValueError(
+                f"value must be a number, a per-channel sequence, or the "
+                f"string 'random', got {value!r}")
         self.prob = prob
         self.scale = tuple(scale)
         self.ratio = tuple(ratio)
         self.value = value
         self.inplace = inplace
+        self._random_value = isinstance(value, str)
 
     def __call__(self, img):
         img = np.asarray(img)
@@ -601,7 +606,7 @@ class RandomErasing(_Transform):
             if eh < h and ew < w:
                 i = pyrandom.randint(0, h - eh)
                 j = pyrandom.randint(0, w - ew)
-                if self.value == "random":
+                if self._random_value:
                     rng = np.random.default_rng(pyrandom.getrandbits(32))
                     shape = (eh, ew) + img.shape[2:]
                     # dtype-appropriate noise: uint8 gets its full range,

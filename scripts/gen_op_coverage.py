@@ -30,7 +30,7 @@ sys.path.insert(0, ROOT)
 
 PADDLE_TOP = """
 abs acos acosh add add_n addmm all allclose amax amin angle any arange
-argmax argmin argsort as_complex as_real as_strided asin asinh assign atan
+argmax argmin argsort as_complex as_real as_strided as_tensor asin asinh assign atan
 atan2 atanh atleast_1d atleast_2d atleast_3d bernoulli bincount bitwise_and
 bitwise_left_shift bitwise_not bitwise_or bitwise_right_shift bitwise_xor
 bmm broadcast_shape broadcast_tensors broadcast_to bucketize cast cat ceil
@@ -57,6 +57,7 @@ pow prod put_along_axis quantile rad2deg rand randint randint_like randn
 randperm rank real reciprocal remainder renorm repeat_interleave reshape
 roll rot90 round rsqrt scale scatter scatter_nd scatter_nd_add
 searchsorted select_scatter sgn shape shard_index sign signbit sin sinc
+where_
 sinh slice slice_scatter sort split sqrt square squeeze stack stanh std
 strided_slice subtract sum t take take_along_axis tan tanh tensor_split
 tensordot tile to_tensor tolist topk trace transpose trapezoid tril
@@ -124,12 +125,12 @@ adaptive_max_pool3d affine_grid alpha_dropout avg_pool1d avg_pool2d
 avg_pool3d batch_norm bilinear binary_cross_entropy
 binary_cross_entropy_with_logits celu channel_shuffle class_center_sample
 conv1d conv1d_transpose conv2d conv2d_transpose conv3d conv3d_transpose
-cosine_embedding_loss cosine_similarity cross_entropy ctc_loss rnnt_loss dice_loss
-dropout dropout2d dropout3d elu embedding feature_alpha_dropout fold
+cosine_embedding_loss cosine_similarity cross_entropy ctc_loss rnnt_loss diag_embed dice_loss
+dropout dropout2d dropout3d elu elu_ embedding feature_alpha_dropout fold
 gather_tree gaussian_nll_loss gelu glu grid_sample group_norm
 gumbel_softmax hardshrink hardsigmoid hardswish hardtanh hinge_embedding_loss
 hsigmoid_loss instance_norm interpolate kl_div l1_loss label_smooth
-layer_norm leaky_relu linear local_response_norm log_loss log_sigmoid
+layer_norm leaky_relu leaky_relu_ linear local_response_norm log_loss log_sigmoid
 log_softmax margin_cross_entropy margin_ranking_loss max_pool1d max_pool2d
 max_pool3d max_unpool1d max_unpool2d max_unpool3d maxout mish mse_loss
 multi_label_soft_margin_loss multi_margin_loss nll_loss normalize
@@ -360,7 +361,8 @@ vector_to_parameters weight_norm remove_weight_norm spectral_norm
 """
 
 PADDLE_DEVICE = """
-Event Stream current_stream get_available_custom_device
+Event Stream current_stream get_all_custom_device_type
+get_all_device_type get_available_custom_device
 get_available_device get_device set_device device_count stream_guard
 synchronize cuda empty_cache
 max_memory_allocated max_memory_reserved memory_allocated memory_reserved

@@ -156,3 +156,43 @@ class TestReviewRegressions7:
     def test_image_load_rejects_bad_backend(self):
         with pytest.raises(ValueError):
             vision.image_load("nope.png", backend="bogus")
+
+
+class TestWaveEight:
+    def test_as_tensor_and_where_(self):
+        import jax.numpy as jnp
+        t = paddle.as_tensor([1.0, 2.0], dtype="float32")
+        assert t.dtype == jnp.float32
+        out = paddle.where_(jnp.asarray([True, False]),
+                            jnp.asarray([1.0, 1.0]),
+                            jnp.asarray([2.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(out), [1.0, 2.0])
+
+    def test_inplace_named_activations(self):
+        import jax.numpy as jnp
+        import paddle_tpu.nn.functional as F
+        x = jnp.asarray([-1.0, 1.0])
+        np.testing.assert_allclose(np.asarray(F.elu_(x)),
+                                   np.asarray(F.elu(x)))
+        np.testing.assert_allclose(np.asarray(F.leaky_relu_(x)),
+                                   np.asarray(F.leaky_relu(x)))
+
+    def test_f_diag_embed(self):
+        import jax.numpy as jnp
+        import paddle_tpu.nn.functional as F
+        out = F.diag_embed(jnp.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(out), [[1, 0], [0, 2]])
+
+    def test_device_type_listings(self):
+        types = paddle.device.get_all_device_type()
+        assert "cpu" in types
+        assert isinstance(paddle.device.get_all_custom_device_type(), list)
+
+    def test_random_erasing_validates_value(self):
+        with pytest.raises(ValueError, match="random"):
+            T.RandomErasing(value="randm")
+        # array values work (per-channel fill, no ambiguous-truth crash)
+        img = _img()
+        out = T.RandomErasing(prob=1.0, scale=(0.2, 0.4),
+                              value=np.asarray([1, 2, 3]))(img)
+        assert out.shape == img.shape
