@@ -66,7 +66,54 @@ EV = {"status": "starting", "started_unix": T_START,
       "argv": sys.argv, "pid": os.getpid()}
 
 
+def _stamp_provenance():
+    """Derive a per-section fresh-vs-carried summary FROM the carry keys
+    so prose/notes can quote one field and never drift from the file
+    (round-4 VERDICT Weak #7: notes claimed `bench_carried_from_unix`
+    absent while the artifact carried it).  Computed at every flush —
+    it is a projection of the keys, never independently editable.
+    States: "fresh" (section measured this run), "carried" (copied from
+    a prior artifact; from_unix is the ORIGINAL capture time, surviving
+    chained carries via _carry), "carried-unknown-age" (carry key is
+    None — prior artifact died before its finished_unix flush), and
+    "absent" (section never measured and not carried)."""
+    present = {"bench": "mfu" in EV,
+               "kernel_compare": "kernel_compare" in EV,
+               "secondary_tpu": "secondary_tpu" in EV}
+    prov = {}
+    for section, key in (("bench", "bench_carried_from_unix"),
+                         ("kernel_compare",
+                          "kernel_compare_carried_from_unix"),
+                         ("secondary_tpu",
+                          "secondary_carried_from_unix")):
+        if key in EV:
+            if isinstance(EV[key], (int, float)):
+                prov[section] = {
+                    "state": "carried", "from_unix": EV[key],
+                    "age_s_at_start": round(T_START - EV[key], 1)}
+            else:
+                prov[section] = {"state": "carried-unknown-age"}
+        elif present[section]:
+            prov[section] = {"state": "fresh"}
+        else:
+            prov[section] = {"state": "absent"}
+    EV["provenance"] = prov
+
+
+def _carry(src, carry_key):
+    """Timestamp to record when copying a section from artifact `src`:
+    if the section was ALREADY a carry there, propagate its original
+    capture time (chained carries must not reset the quoted age — the
+    whole point of the provenance audit trail)."""
+    if not src:
+        return None
+    if carry_key in src:
+        return src[carry_key]   # may be None: unknown age stays unknown
+    return src.get("finished_unix")
+
+
 def flush():
+    _stamp_provenance()
     tmp = EVIDENCE_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(EV, f, indent=1, default=str)
@@ -169,11 +216,13 @@ def _maybe_promote():
                         or _rows(EV) == 0))
     if _is_good(old) and ok_to_carry and not _kc_structural(EV):
         EV["kernel_compare"] = old["kernel_compare"]
-        EV["kernel_compare_carried_from_unix"] = old.get("finished_unix")
+        EV["kernel_compare_carried_from_unix"] = _carry(
+            old, "kernel_compare_carried_from_unix")
         flush()
     if _is_good(old) and _sec_ok(old) and not _sec_ok(EV):
         EV["secondary_tpu"] = old["secondary_tpu"]
-        EV["secondary_carried_from_unix"] = old.get("finished_unix")
+        EV["secondary_carried_from_unix"] = _carry(
+            old, "secondary_carried_from_unix")
         flush()
     import shutil
     if os.path.exists(CANONICAL_PATH):
@@ -244,15 +293,16 @@ def main():
                   "mfu", "vs_baseline_045_mfu"):
             if k in _EXISTING:
                 EV[k] = _EXISTING[k]
-        EV["bench_carried_from_unix"] = _EXISTING.get("finished_unix")
+        EV["bench_carried_from_unix"] = _carry(
+            _EXISTING, "bench_carried_from_unix")
         EV["status"] = "bench_done"
         flush()
         if os.environ.get("BENCH_KERNELS", "1") == "1":
             if _kc_ok(_EXISTING):
                 # already honest-complete: don't re-burn chip time
                 EV["kernel_compare"] = _EXISTING["kernel_compare"]
-                EV["kernel_compare_carried_from_unix"] = \
-                    _EXISTING.get("finished_unix")
+                EV["kernel_compare_carried_from_unix"] = _carry(
+                    _EXISTING, "kernel_compare_carried_from_unix")
             else:
                 try:
                     EV["kernel_compare"] = _kernel_compare(
@@ -264,8 +314,8 @@ def main():
             if _sec_ok(_EXISTING) and \
                     os.environ.get("BENCH_SECONDARY_FORCE") != "1":
                 EV["secondary_tpu"] = _EXISTING["secondary_tpu"]
-                EV["secondary_carried_from_unix"] = \
-                    _EXISTING.get("finished_unix")
+                EV["secondary_carried_from_unix"] = _carry(
+                    _EXISTING, "secondary_carried_from_unix")
             elif remaining() > 240:
                 _run_secondary()
             flush()
