@@ -96,13 +96,35 @@ def enable_tensor_methods() -> None:
         logical_and logical_or logical_xor logical_not bitwise_and
         bitwise_or bitwise_xor bitwise_not greater_than greater_equal
         less_than less_equal not_equal heaviside nan_to_num""".split()
+    # Mutation-ONLY inplace names: unlike add_/clip_ etc. (where the
+    # returned value is the point and reference code already rebinds),
+    # these are called purely for the side effect — ported code that
+    # doesn't rebind keeps stale values with no signal.  Warn once per
+    # name instead of raising (copy_/set_value raise because they have
+    # no value to rebind at all).
+    _MUTATION_ONLY = {"zero_", "fill_", "exponential_", "normal_",
+                      "uniform_", "bernoulli_", "fill_diagonal_"}
+    _warned_inplace = set()
     for _name in _DELEGATED:
         _fn = getattr(_pd, _name, None)
         if _fn is None:
             continue
 
-        def _method(self, *a, _fn=_fn, **k):
-            return _fn(self, *a, **k)
+        if _name in _MUTATION_ONLY:
+            def _method(self, *a, _fn=_fn, _name=_name, **k):
+                if _name not in _warned_inplace:
+                    _warned_inplace.add(_name)
+                    import warnings
+                    warnings.warn(
+                        f"Tensor.{_name}() cannot mutate in place on "
+                        f"immutable jax arrays: it RETURNS the result — "
+                        f"rebind it (x = x.{_name}(...)), or the original "
+                        f"keeps its old values", RuntimeWarning,
+                        stacklevel=2)
+                return _fn(self, *a, **k)
+        else:
+            def _method(self, *a, _fn=_fn, **k):
+                return _fn(self, *a, **k)
 
         _add(_name, _method)
     _add("ndimension", lambda self: self.ndim)

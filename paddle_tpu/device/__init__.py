@@ -200,16 +200,38 @@ def current_stream(device=None) -> "Stream":
 
 def get_available_device():
     """Reference: paddle.device.get_available_device — every visible
-    device, tagged the reference way."""
+    device, tagged the reference way (indices count PER PLATFORM, so a
+    mixed cpu+tpu listing yields tpu:0/tpu:1, not global enumeration
+    positions)."""
     import jax
     out = []
-    for i, d in enumerate(jax.devices()):
-        out.append("cpu" if d.platform == "cpu" else f"{d.platform}:{i}")
+    per_platform = {}
+    for d in jax.devices():
+        i = per_platform.setdefault(d.platform, 0)
+        per_platform[d.platform] = i + 1
+        if d.platform == "cpu":
+            if i == 0:           # reference lists the host cpu once
+                out.append("cpu")
+        else:
+            out.append(f"{d.platform}:{i}")
     return out
 
 
 def get_available_custom_device():
-    return [d for d in get_available_device() if not d.startswith(("cpu",))]
+    """Reference: paddle.device.get_available_custom_device — ONLY
+    plugin (custom) devices, not ordinary accelerators: each type
+    registered via device.custom.register_custom_device is listed as
+    ``type:i`` per device of its backing JAX platform."""
+    import jax
+    from .custom import _REGISTRY
+    out = []
+    for dev_type in sorted(_REGISTRY):
+        try:
+            n = len(jax.devices(_REGISTRY[dev_type]))
+        except RuntimeError:
+            n = 0
+        out.extend(f"{dev_type}:{i}" for i in range(n))
+    return out
 
 
 __all__ += ["Stream", "Event", "stream_guard", "current_stream",

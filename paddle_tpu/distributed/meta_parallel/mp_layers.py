@@ -138,6 +138,87 @@ class VocabParallelEmbedding(Layer):
         return _maybe_constraint(out, P(*([None] * (x.ndim + 1))))
 
 
+def _take_rows_f32grad(table, ids):
+    """take(table, ids, axis=0) whose bwd scatter-add runs in f32.
+
+    XLA's SPMD partitioner CHECK-fails partitioning a bf16 scatter-add
+    in modules that also contain a pipeline shard_map (the operand-
+    upcaster's convert pattern trips the b/433785288 involuntary-remat
+    path — round-5 notes; the identical f32 program compiles).  Doing
+    the accumulation in f32 ourselves sidesteps the upcaster AND is the
+    numerically better program: embedding-row grads accumulate many
+    updates, exactly what multi_precision masters exist for."""
+    import numpy as _np
+    shape, dt = table.shape, table.dtype
+
+    @jax.custom_vjp
+    def tk(t, i):
+        return jnp.take(t, i, axis=0)
+
+    def fwd(t, i):
+        return jnp.take(t, i, axis=0), i
+
+    def bwd(i, g):
+        gt = jnp.zeros(shape, jnp.float32).at[i].add(
+            g.astype(jnp.float32))
+        return (gt.astype(dt),
+                _np.zeros(i.shape, jax.dtypes.float0))
+
+    tk.defvjp(fwd, bwd)
+    return tk(table, ids.astype(jnp.int32))
+
+
+def sharded_row_take(table, ids, row_axes, mesh):
+    """``jnp.take(table, ids, axis=0)`` for a table whose ROW dim is
+    sharded over mesh axes ``row_axes`` — as an explicit Megatron-style
+    masked local lookup + psum inside a partial-manual shard_map
+    (reference: VocabParallelEmbedding's range mask + allreduce in
+    mp_ops.py).
+
+    The manual form never shows the partitioner a sharded scatter: the
+    bwd is a local dense scatter + the psum transpose, and the mask+psum
+    is one fused elementwise over the lookup result.  Suitable for
+    single-group row shardings (e.g. a vocab table over mp); NOTE: in
+    hybrid meshes where OTHER auto axes shard the indices AND the row
+    axes carry subgroup structure (the pp-extended tables of the hybrid
+    trainer), XLA's partitioner fails a psum replica-group CHECK
+    (spmd_partitioner_util.cc:495) — the trainer therefore uses
+    _take_rows_f32grad (GSPMD gather with f32 scatter-accumulate bwd),
+    which compiles on every tested hybrid config (round-5 notes).
+
+    Falls back to the GSPMD-gather form when the rows don't divide
+    evenly over the axes (shard_map needs exact tiling)."""
+    axes = ((row_axes,) if isinstance(row_axes, str)
+            else tuple(row_axes))
+    n_shards = 1
+    for ax in axes:
+        n_shards *= mesh.shape[ax]
+    if table.shape[0] % n_shards:
+        return _take_rows_f32grad(table, ids)
+    from jax import shard_map
+
+    def body(tbl, ids_):
+        lin = 0
+        for ax in axes:
+            lin = lin * mesh.shape[ax] + jax.lax.axis_index(ax)
+        v_local = tbl.shape[0]
+        local = ids_ - lin * v_local
+        ok = (local >= 0) & (local < v_local)
+        out = _take_rows_f32grad(tbl, jnp.clip(local, 0, v_local - 1))
+        out = jnp.where(ok[..., None], out, jnp.zeros((), tbl.dtype))
+        # psum in f32: shardy's HLO round-trip corrupts BF16 reduction
+        # combiners (copy-rooted add), which later XLA passes CHECK-fail
+        # on — and the f32 accumulation is numerically right anyway
+        return jax.lax.psum(out.astype(jnp.float32), axes).astype(
+            tbl.dtype)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axes if len(axes) > 1 else axes[0], None), P()),
+        out_specs=P(), check_vma=False,
+        axis_names=set(axes))(table, ids.astype(jnp.int32))
+
+
 def parallel_cross_entropy(logits, label, ignore_index: int = -100,
                            mp_axis: str = "mp"):
     """Vocab-parallel softmax cross-entropy.

@@ -71,6 +71,32 @@ def _boundary_constrain(mesh, x, spec):
         return x
 
 
+def _f32_queue(xs):
+    """(widened xs, narrow fn): low-precision float leaves of the
+    microbatch queue cross the pipeline shard_map boundary as f32.
+
+    The queue enters with in_spec P() (replicated over pp — every tick
+    indexes it, only stage 0's read is live), so shard_map AD inserts a
+    ``psum`` over pp for its cotangent.  Shardy's HLO round-trip emits
+    BF16 reduction combiners with a copy-rooted add, which downstream
+    XLA passes CHECK-fail on ("Invalid binary instruction opcode copy",
+    the b/433785288 family — reproduced round 5 on every bf16 pp>1
+    config).  An f32 queue keeps that psum f32 (unaffected) and costs
+    one widened copy of the microbatch stack; compute dtype is restored
+    at injection so the stage math is unchanged."""
+    dts = jax.tree.map(lambda a: a.dtype, xs)
+
+    def widen(a):
+        if a.dtype in (jnp.bfloat16, jnp.float16):
+            return a.astype(jnp.float32)
+        return a
+
+    def narrow(tree):
+        return jax.tree.map(lambda a, d: a.astype(d), tree, dts)
+
+    return jax.tree.map(widen, xs), narrow
+
+
 def _apply_x_spec(mesh, xs, x_spec):
     """Constrain the microbatched activation pytree: ``x_spec`` mirrors the
     activation structure with a PartitionSpec per leaf, or None to skip a
@@ -175,6 +201,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
     in_x_spec, out_specs = _manual_boundary_specs(
         x_microbatches, x_spec, extra_manual_axes)
+    x_microbatches, _narrow = _f32_queue(x_microbatches)
 
     def pipelined(params, xs):
         # inside shard_map over pp each device holds its stage's slice of the
@@ -187,9 +214,9 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
             state = carry  # [mb, ...] activation pytree at this stage
             # stage 0 pulls microbatch t (clamped) from the queue
             mb_idx = jnp.clip(t, 0, M - 1)
-            inject = jax.tree.map(
+            inject = _narrow(jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(a, mb_idx, axis=0,
-                                                       keepdims=False), xs)
+                                                       keepdims=False), xs))
             x_in = jax.tree.map(
                 lambda i, s: jnp.where(stage_id == 0, i, s), inject, state)
             y = body(local_params, x_in, *extra_args)
@@ -202,7 +229,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
 
         # initial carry: zeros with the OUTPUT shape of a stage (the body
         # must preserve activation shape — true for transformer blocks)
-        x0 = jax.tree.map(lambda a: a[0], xs)
+        x0 = _narrow(jax.tree.map(lambda a: a[0], xs))
         out_shape = jax.eval_shape(body, local_params, x0, *extra_args)
         init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
 
@@ -297,6 +324,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
     in_x_spec, out_specs = _manual_boundary_specs(
         x_microbatches, x_spec, extra_manual_axes)
+    x_microbatches, _narrow = _f32_queue(x_microbatches)
 
     def pipelined(params, xs):
         # local leaves: [V, ...] — this device's chunks, local index v
@@ -312,9 +340,9 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
                 params)
             # stage-0 chunk-0 slots consume fresh microbatches
             m_in = jnp.clip((n // (S * V)) * S + n % S, 0, M - 1)
-            inject = jax.tree.map(
+            inject = _narrow(jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(a, m_in, axis=0,
-                                                       keepdims=False), xs)
+                                                       keepdims=False), xs))
             take_fresh = jnp.logical_and(stage_id == 0, n % (S * V) < S)
             x_in = jax.tree.map(
                 lambda i, s: jnp.where(take_fresh, i, s), inject, state)
@@ -328,7 +356,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
 
         chunk_shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), params)
-        x0 = jax.tree.map(lambda a: a[0], xs)
+        x0 = _narrow(jax.tree.map(lambda a: a[0], xs))
         out_shape = jax.eval_shape(body, chunk_shapes, x0, *extra_args)
         init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
         _, outs = jax.lax.scan(tick, init, jnp.arange(T))

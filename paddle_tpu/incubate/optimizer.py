@@ -211,18 +211,22 @@ class _FunctionalOptimizers:
                       dtype: str = "float32", name=None):
         import jax
         import jax.numpy as jnp
+        # jax.scipy BFGS works on a flat vector; the objective must keep
+        # seeing the caller's original shape in BOTH phases (optimization
+        # AND the final gradient), so un-flatten inside the wrapper
+        orig_shape = jnp.shape(jnp.asarray(initial_position))
         x0 = jnp.asarray(initial_position, dtype).reshape(-1)
         calls = [0]
 
         def counted(x):
             calls[0] += 1
-            return objective_func(x)
+            return objective_func(x.reshape(orig_shape))
 
         import jax.scipy.optimize as _jso
         res = _jso.minimize(
             counted, x0, method="BFGS",
             options={"maxiter": max_iters, "gtol": tolerance_grad})
-        pos = res.x.reshape(jnp.shape(jnp.asarray(initial_position)))
+        pos = res.x.reshape(orig_shape)
         grad = jax.grad(objective_func)(pos)
         is_converge = jnp.max(jnp.abs(grad)) <= tolerance_grad
         return (is_converge, jnp.asarray(calls[0], jnp.int32), pos,

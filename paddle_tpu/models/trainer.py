@@ -100,7 +100,9 @@ class GPTHybridTrainer:
         # falls out of AD + GSPMD as exactly the reference's allreduce.
         wte_spec = tuple(specs[self.KEY_WTE])
         self._vocab_axes = wte_spec[0] if wte_spec else None
-        if self.S > 1:
+        import os as _os
+        if self.S > 1 and _os.environ.get("PADDLE_TPU_PP_EXTEND_EMBED",
+                                          "1") == "1":
             for k in (self.KEY_WTE, self.KEY_WPE):
                 if k in specs:
                     old = tuple(specs[k])  # P(mp, None) from the embedding
@@ -214,11 +216,25 @@ class GPTHybridTrainer:
         return pnb, pblk, opt_nb, opt_blk
 
     # ---- functional model pieces (non-block params used directly) ------
+    def _take_table(self, pnb, key, idx):
+        """Row lookup honoring the table's row sharding: row-sharded
+        tables go through the GSPMD gather with an f32 scatter-
+        accumulate bwd (_take_rows_f32grad) — a plain bf16 take's
+        scatter-add bwd CHECK-crashes XLA in bf16 pp>1 hybrids, and the
+        manual masked-lookup alternative (sharded_row_take) trips a psum
+        replica-group CHECK on hybrid meshes (round-5 notes)."""
+        spec = (self.specs_nonblock.get(key) or P())
+        row_axes = tuple(spec)[0] if tuple(spec) else None
+        if row_axes is None:
+            return jnp.take(pnb[key], idx.astype(jnp.int32), axis=0)
+        from ..distributed.meta_parallel.mp_layers import _take_rows_f32grad
+        return _take_rows_f32grad(pnb[key], idx)
+
     def _embed(self, pnb, ids):
         cfg = self.cfg
         pos = jnp.arange(ids.shape[1])[None, :]
-        x = jnp.take(pnb[self.KEY_WTE], ids.astype(jnp.int32), axis=0) + \
-            jnp.take(pnb[self.KEY_WPE], pos, axis=0)
+        x = self._take_table(pnb, self.KEY_WTE, ids) + \
+            self._take_table(pnb, self.KEY_WPE, pos)
         # context parallel: activations ride the sep axis on the seq dim
         seq_axis = "sep" if getattr(cfg, "cp", False) else None
         return _maybe_constraint(x, P(None, seq_axis, None))

@@ -96,21 +96,39 @@ def py_func(func: Callable, x, out, backward_func: Callable = None,
 
     def bwd(residual, cots):
         args, fwd_outs = residual
+        # custom_vjp rejects integer-dtype tangents: non-floating primal
+        # args get symbolic-zero float0 cotangents, and only the floating
+        # args' grads are requested from the host callback (backward_func
+        # still returns one grad per primal arg, reference contract)
+        import numpy as np
+        is_fl = [jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+                 for a in args]
 
         def bhost(*flat):
             r = backward_func(*flat)
             rs = r if isinstance(r, (list, tuple)) else (r,)
-            import numpy as np
-            return tuple(np.asarray(v) for v in rs)
+            return tuple(np.asarray(v, dtype=jnp.asarray(a).dtype)
+                         for a, v, f in zip(args, rs, is_fl) if f)
 
+        # non-inexact OUTPUTS carry float0 cotangents, which cannot be
+        # pure_callback operands — hand the host zeros in the output's
+        # own dtype instead (mirror of the float0 handling for inputs)
+        cots = tuple(
+            jnp.zeros(jnp.shape(o), jnp.asarray(o).dtype)
+            if getattr(c, "dtype", None) == jax.dtypes.float0 else c
+            for c, o in zip(cots, fwd_outs))
         bwd_in = (tuple(a for a, k in zip(args, keep_in) if k)
                   + tuple(o for o, k in zip(fwd_outs, keep_out) if k)
                   + tuple(cots))
         in_shapes = tuple(jax.ShapeDtypeStruct(jnp.shape(a),
                                                jnp.asarray(a).dtype)
-                          for a in args)
-        grads = jax.pure_callback(bhost, in_shapes, *bwd_in)
-        return tuple(grads)
+                          for a, f in zip(args, is_fl) if f)
+        fl_grads = iter(jax.pure_callback(bhost, in_shapes, *bwd_in)
+                        if in_shapes else ())
+        return tuple(
+            next(fl_grads) if f
+            else np.zeros(jnp.shape(a), jax.dtypes.float0)
+            for a, f in zip(args, is_fl))
 
     op.defvjp(fwd, bwd)
     res = op(*xs)
@@ -164,10 +182,26 @@ class ExponentialMovingAverage:
         self._shadow = None
         self._step = 0
 
-    def update(self, params):
+    def update(self, params, step=None):
+        """Fold current params into the shadow.  The decay ramp follows
+        the passed global step: ``step`` argument first, else the VALUE
+        of ``thres_steps`` (the reference ties the ramp to that global-
+        step variable, not to an internal counter — a constant
+        thres_steps therefore holds the ramp constant, exactly like a
+        non-advancing global-step variable would).  With neither, the
+        flat ``decay`` applies."""
         self._step += 1
-        if self.thres_steps is not None:
-            d = min(self.decay, (1.0 + self._step) / (10.0 + self._step))
+        if step is not None or self.thres_steps is not None:
+            t = step if step is not None else self.thres_steps
+            try:
+                t = float(t)
+            except (TypeError, ValueError) as e:
+                raise TypeError(
+                    f"ExponentialMovingAverage decay-ramp step must be a "
+                    f"scalar convertible to float, got {t!r} — pass the "
+                    f"global step as a host int (a traced or batched "
+                    f"value cannot drive the Python-side ramp)") from e
+            d = min(self.decay, (1.0 + t) / (10.0 + t))
         else:
             d = self.decay
         if self._shadow is None:
@@ -183,7 +217,9 @@ class ExponentialMovingAverage:
 
     def apply(self, params=None):
         """Context manager yielding the EMA parameters (the reference's
-        apply()/restore() pair as a guard)."""
+        apply()/restore() pair as a guard).  ``params`` is accepted for
+        signature parity but unused — the guard always yields the shadow
+        state; restore is the context exit."""
         import contextlib
 
         @contextlib.contextmanager

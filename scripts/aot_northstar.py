@@ -1,0 +1,482 @@
+"""AOT-compile the north-star configs against a virtual 128-device mesh.
+
+Round-5 VERDICT item 1: nothing had ever proven that the BASELINE target
+model (GPT-3 6.7B hybrid tp x pp x dp x ZeRO x sp — the workload the
+reference's fleet hot loop `meta_parallel/pipeline_parallel.py —
+PipelineParallel.forward_backward_pipeline` exists to run) even compiles
+or fits HBM at v5p-128 scale.  This harness converts "tiny-shape parity"
+into "the target model exists":
+
+  - builds the REAL 6.7B hybrid train step (the same GPTHybridTrainer the
+    MULTICHIP gate runs at tiny shapes) over a 128-device mesh,
+  - AOT-lowers it with abstract sharded avals (no 27 GB of host params:
+    block params are synthesized from a full-width pp-degree-layer
+    scaffold, optimizer state via jax.eval_shape),
+  - compiles it through XLA's SPMD partitioner (CPU backend — the
+    partitioning pass is backend-independent; this box has no v5p
+    libtpu, see topology_attempt in the artifact),
+  - counts the per-step collectives in the post-partitioning HLO,
+  - does exact per-device parameter/optimizer/gradient byte accounting
+    from the sharding specs + an explicit activation model, vs v5p HBM,
+  - emits a pass/fail fit verdict per leg into AOT_NORTHSTAR.json.
+
+Also runs the same for BASELINE config #4 (semi-auto Llama-2-7B over
+dp x mp, `llama_shard_fn` placements — reference:
+`distributed.auto_parallel` shard_tensor API).
+
+Run (serialized legs, CPU env):
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python scripts/aot_northstar.py [gpt] [llama]
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+N_DEV = 128
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", N_DEV)
+import jax.extend.backend as _jeb  # noqa: E402
+_jeb.clear_backends()
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+ARTIFACT = os.path.join(ROOT, "AOT_NORTHSTAR.json")
+
+# v5p chip datasheet numbers (public: cloud.google.com/tpu/docs/v5p):
+# 95 GB HBM2e per chip, 459 bf16 TFLOP/s, 2765 GB/s HBM BW.
+V5P_HBM_BYTES = 95 * 1024**3
+V5P_BF16_TFLOPS = 459.0
+FIT_HEADROOM = 0.85     # pass iff total <= 85% of HBM (XLA workspace slack)
+
+COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all")
+
+
+def _flush(leg, data):
+    art = {}
+    if os.path.exists(ARTIFACT):
+        try:
+            with open(ARTIFACT) as f:
+                art = json.load(f)
+        except Exception:
+            art = {}
+    art[leg] = data
+    art["generated_unix"] = time.time()
+    art["n_virtual_devices"] = N_DEV
+    tmp = ARTIFACT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1, default=str)
+    os.replace(tmp, ARTIFACT)
+    print(f"[flush] {leg}: {list(data.keys())}", flush=True)
+
+
+def _count_collectives(hlo_text):
+    """Count collective ops in HLO/StableHLO text, bucketed by kind."""
+    out = {}
+    for kind in COLLECTIVES:
+        # HLO: `all-reduce(` / `all-reduce-start(` (don't count the
+        # paired `-done`); StableHLO: `stablehlo.all_reduce %...` or
+        # `"stablehlo.all_reduce"(...)`.
+        pat = kind.replace("-", "[-_]")
+        n = len(re.findall(rf"(?<![\w-]){pat}(?:-start)?(?![\w-])",
+                           hlo_text))
+        if n:
+            out[kind] = n
+    out["total"] = sum(v for k, v in out.items() if k != "total")
+    return out
+
+
+def _spec_div(spec, shape, mesh_shape):
+    """Number of shards a leaf of `shape` is split into under `spec`."""
+    div = 1
+    for dim_axes in tuple(spec)[: len(shape)]:
+        if dim_axes is None:
+            continue
+        axes = dim_axes if isinstance(dim_axes, tuple) else (dim_axes,)
+        for ax in axes:
+            div *= mesh_shape[ax]
+    return div
+
+
+def _tree_bytes_per_device(tree, specs, mesh_shape, get_spec):
+    """Sum per-device bytes over a {name: leaf-or-subtree} dict where
+    get_spec(name) yields the PartitionSpec applied to every leaf."""
+    total = 0
+    for name, sub in tree.items():
+        spec = get_spec(name)
+        for leaf in jax.tree.leaves(sub):
+            if leaf is None or not hasattr(leaf, "shape"):
+                continue
+            n = int(np.prod(leaf.shape)) if leaf.shape else 1
+            total += n * leaf.dtype.itemsize // _spec_div(
+                spec, leaf.shape, mesh_shape)
+    return total
+
+
+def _sds(tree, specs, mesh, get_spec):
+    """Mirror a pytree of array-likes as sharded ShapeDtypeStructs."""
+    out = {}
+    for name, sub in tree.items():
+        sh = NamedSharding(mesh, get_spec(name))
+        out[name] = jax.tree.map(
+            lambda leaf: None if leaf is None else jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sh),
+            sub, is_leaf=lambda x: x is None)
+    return out
+
+
+def _topology_attempt():
+    """Try a true detached-topology TPU compile (deviceless AOT).  The
+    axon stack tunnels one v5e chip; there is no v5p libtpu on this box,
+    so this documents WHY the CPU-partitioner path below is the fallback
+    (it is the same SPMD partitioning pass, minus TPU codegen)."""
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(
+            "v5p-128", platform="tpu",
+            topology="8x8x2", chips_per_host_bounds="2,2,1",
+            num_slices=1, wrap="true,true,true")
+        return {"ok": True, "devices": len(topo.devices)}
+    except Exception as e:
+        return {"ok": False, "error": repr(e)[:300]}
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: GPT-3 6.7B hybrid (BASELINE config #3 at north-star scale)
+# ---------------------------------------------------------------------------
+
+def run_gpt():
+    import paddle_tpu  # noqa: F401
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPTHybridTrainer
+    from paddle_tpu.models.gpt import gpt3_6_7b
+
+    DP, SHARD, PP, MP = 2, 2, 4, 8          # 2*2*4*8 = 128
+    MICRO = 8                                # 2 * pp
+    BATCH, SEQ = 512, 2048                   # ~1.05M tokens / step
+
+    leg = {"model": "gpt3-6.7b", "status": "building",
+           "mesh": {"dp": DP, "sharding": SHARD, "pp": PP, "mp": MP},
+           "config": {"batch": BATCH, "seq": SEQ, "microbatches": MICRO,
+                      "zero_stage": 1, "sp": True, "remat": True,
+                      "dtype": "bfloat16"},
+           "topology_attempt": _topology_attempt()}
+    _flush("gpt_6_7b_hybrid", leg)
+
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": DP, "mp_degree": MP, "pp_degree": PP,
+                        "sharding_degree": SHARD}
+    dist.fleet.init(is_collective=True, strategy=s,
+                    devices=jax.devices()[:N_DEV])
+    hcg = dist.get_hybrid_communicate_group()
+    mesh = hcg.get_mesh()
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    # Full-width scaffold at num_layers == pp_degree: harvests the exact
+    # per-block parameter shapes/specs and the non-block (embedding/norm)
+    # state without materializing all 32 layers (32 * 805 MB f32).  The
+    # traced step never reads cfg.num_layers — the stage-local block count
+    # comes from the leading axis of the stacked abstract params.
+    cfg = gpt3_6_7b(sp=True, remat=True)
+    full_L = cfg.num_layers
+    cfg.num_layers = PP
+    n_params = gpt3_6_7b().num_params()
+    leg["config"]["num_params"] = n_params
+    adamw = opt.AdamW(learning_rate=1e-4, multi_precision=True,
+                      grad_clip=opt.ClipGradByGlobalNorm(1.0))
+    t0 = time.time()
+    trainer = GPTHybridTrainer(cfg, hcg, adamw, microbatches=MICRO,
+                               zero_stage=1)
+    leg["scaffold_build_s"] = round(time.time() - t0, 1)
+
+    # synthesize the full-depth abstract state
+    def widen(x):
+        return jax.ShapeDtypeStruct((full_L,) + tuple(x.shape[1:]), x.dtype)
+    pblk_full = {k: widen(v) for k, v in trainer.params_blocks.items()}
+    pnb_sds = _sds(trainer.params_nonblock, trainer.specs_nonblock, mesh,
+                   lambda n: trainer.specs_nonblock[n])
+    pblk_sds = _sds(pblk_full, trainer.specs_blocks, mesh,
+                    lambda n: trainer.specs_blocks[n])
+
+    onb_shape = jax.eval_shape(adamw.init, pnb_sds)
+    oblk_shape = jax.eval_shape(adamw.init, pblk_sds)
+
+    def opt_sds(oshape, slot_specs):
+        return {
+            "step": jax.ShapeDtypeStruct(
+                (), jnp.int32, sharding=NamedSharding(mesh, P())),
+            "slots": _sds(oshape["slots"], slot_specs, mesh,
+                          lambda n: slot_specs[n]),
+            "master": _sds(oshape["master"], slot_specs, mesh,
+                           lambda n: slot_specs[n]),
+        }
+    onb_sds = opt_sds(onb_shape, trainer.slot_specs_nb)
+    oblk_sds = opt_sds(oblk_shape, trainer.slot_specs_blk)
+
+    bspec = trainer.batch_spec()
+    ids_sds = jax.ShapeDtypeStruct(
+        (BATCH, SEQ), jnp.int32, sharding=NamedSharding(mesh, bspec))
+    lr_sds = jax.ShapeDtypeStruct((), jnp.float32,
+                                  sharding=NamedSharding(mesh, P()))
+
+    # ---- exact per-device state bytes from the sharding specs ----------
+    hbm = {}
+    hbm["params_bf16"] = (
+        _tree_bytes_per_device(trainer.params_nonblock,
+                               trainer.specs_nonblock, mesh_shape,
+                               lambda n: trainer.specs_nonblock[n])
+        + _tree_bytes_per_device(pblk_full, trainer.specs_blocks, mesh_shape,
+                                 lambda n: trainer.specs_blocks[n]))
+    for sec in ("slots", "master"):
+        hbm[f"opt_{sec}_f32"] = (
+            _tree_bytes_per_device(onb_shape[sec], trainer.slot_specs_nb,
+                                   mesh_shape,
+                                   lambda n: trainer.slot_specs_nb[n])
+            + _tree_bytes_per_device(oblk_shape[sec], trainer.slot_specs_blk,
+                                     mesh_shape,
+                                     lambda n: trainer.slot_specs_blk[n]))
+    hbm["grads_bf16_transient"] = hbm["params_bf16"]
+
+    # Activation model (itemized, bf16 unless noted).  remat=True saves
+    # only block-boundary activations; sp shards them over mp on seq.
+    mb_local = BATCH // MICRO // (DP * SHARD)       # per-device microbatch
+    h, v = 4096, 50304
+    K = full_L // PP                                 # blocks per stage
+    boundary = mb_local * SEQ * h * 2 // MP          # one sp-sharded save
+    inflight = PP                                    # 1F1B stage-0 depth
+    act = {
+        "boundary_saves": boundary * K * inflight,
+        # recompute working set: one block's internals, mp-sharded
+        # (qkv 3h + attn-out h + ffn 8h + norms 2h ~ 14h per token)
+        "recompute_peak": mb_local * SEQ * 14 * h * 2 // MP,
+        "logits_f32": mb_local * SEQ * (v // (MP * PP)) * 4,
+        "embed_and_carry": mb_local * SEQ * h * 2 * 2,
+        "batch_ids": 2 * BATCH // (DP * SHARD) * SEQ * 4,
+    }
+    hbm["activations"] = sum(act.values())
+    hbm["activation_terms"] = act
+    total = sum(val for key, val in hbm.items()
+                if isinstance(val, int) and key != "activation_terms")
+    hbm["total_per_device"] = total
+    hbm["v5p_hbm"] = V5P_HBM_BYTES
+    hbm["utilization"] = round(total / V5P_HBM_BYTES, 4)
+    hbm["fit"] = bool(total <= FIT_HEADROOM * V5P_HBM_BYTES)
+    leg["hbm_accounting"] = dict(hbm)
+    leg["hbm_accounting_gb"] = {
+        k: round(val / 1024**3, 3) for k, val in hbm.items()
+        if isinstance(val, int)}
+
+    # step FLOPs -> what 45% MFU would mean on this slice
+    flops_tok = 6 * n_params + 12 * full_L * h * SEQ
+    leg["perf_model"] = {
+        "flops_per_token": flops_tok,
+        "tokens_per_step": BATCH * SEQ,
+        "step_tflops_total": round(flops_tok * BATCH * SEQ / 1e12, 1),
+        "v5p128_step_ms_at_0.45_mfu": round(
+            flops_tok * BATCH * SEQ
+            / (0.45 * V5P_BF16_TFLOPS * 1e12 * N_DEV) * 1e3, 1)}
+    leg["status"] = "lowering"
+    _flush("gpt_6_7b_hybrid", leg)
+
+    # ---- AOT lower + compile ------------------------------------------
+    step = trainer.build_step()
+    t0 = time.time()
+    lowered = jax.jit(step, donate_argnums=(0, 1, 2, 3)).lower(
+        pnb_sds, pblk_sds, onb_sds, oblk_sds, ids_sds, ids_sds, lr_sds)
+    leg["lower_s"] = round(time.time() - t0, 1)
+    shlo = lowered.as_text()
+    leg["stablehlo_manual_collectives"] = _count_collectives(shlo)
+    leg["stablehlo_bytes"] = len(shlo)
+    del shlo
+    leg["status"] = "compiling"
+    _flush("gpt_6_7b_hybrid", leg)
+
+    t0 = time.time()
+    compiled = lowered.compile()
+    leg["compile_s"] = round(time.time() - t0, 1)
+    try:
+        hlo = compiled.as_text()
+        leg["spmd_collectives_per_step"] = _count_collectives(hlo)
+        leg["spmd_hlo_bytes"] = len(hlo)
+        del hlo
+    except Exception as e:
+        leg["spmd_collectives_per_step"] = {"error": repr(e)[:200]}
+    try:
+        ma = compiled.memory_analysis()
+        leg["xla_memory_analysis"] = {
+            k: getattr(ma, k) for k in
+            ("argument_size_in_bytes", "output_size_in_bytes",
+             "temp_size_in_bytes", "generated_code_size_in_bytes")
+            if hasattr(ma, k)}
+    except Exception as e:
+        leg["xla_memory_analysis"] = {"error": repr(e)[:200]}
+    leg["status"] = "done"
+    leg["fit_verdict"] = "PASS" if hbm["fit"] else "FAIL"
+    _flush("gpt_6_7b_hybrid", leg)
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: Llama-2-7B semi-auto (BASELINE config #4)
+# ---------------------------------------------------------------------------
+
+def run_llama():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_7b
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.nn.functional_call import functional_call, state
+
+    DP, MP = 16, 8
+    BATCH, SEQ = 128, 4096                    # 524k tokens / step
+    devices = np.asarray(jax.devices()[:N_DEV]).reshape(DP, MP)
+    mesh = Mesh(devices, ("dp", "mp"))
+    mesh_shape = {"dp": DP, "mp": MP}
+
+    leg = {"model": "llama2-7b", "status": "building",
+           "mesh": {"dp": DP, "mp": MP},
+           "config": {"batch": BATCH, "seq": SEQ, "remat": True,
+                      "dtype": "bfloat16",
+                      "placement_source": "models/llama.py llama_shard_fn"}}
+    _flush("llama_7b_semi_auto", leg)
+
+    cfg = llama_7b(remat=True)
+    leg["config"]["num_params"] = cfg.num_params() \
+        if hasattr(cfg, "num_params") else None
+    t0 = time.time()
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    params, buffers = state(model)
+    leg["scaffold_build_s"] = round(time.time() - t0, 1)
+
+    # the same placements llama_shard_fn assigns via shard_tensor
+    # (Shard(1) on column-parallel + embeddings/head, Shard(0) on row-
+    # parallel), expressed as PartitionSpecs keyed by leaf layer name
+    def spec_for(name):
+        leaf = name.rsplit(".", 2)[-2] if "." in name else name
+        if name.endswith(".weight"):
+            if leaf in ("q_proj", "k_proj", "v_proj", "gate_proj",
+                        "up_proj", "embed_tokens", "lm_head"):
+                return P(None, "mp")
+            if leaf in ("o_proj", "down_proj"):
+                return P("mp", None)
+        return P()
+
+    specs = {k: spec_for(k) for k in params}
+    params_sds = {k: jax.ShapeDtypeStruct(
+        v.shape, v.dtype, sharding=NamedSharding(mesh, specs[k]))
+        for k, v in params.items()}
+
+    adamw = opt.AdamW(learning_rate=1e-4, multi_precision=True,
+                      grad_clip=opt.ClipGradByGlobalNorm(1.0))
+    oshape = jax.eval_shape(adamw.init, params_sds)
+    ostate_sds = {
+        "step": jax.ShapeDtypeStruct((), jnp.int32,
+                                     sharding=NamedSharding(mesh, P())),
+        "slots": _sds(oshape["slots"], specs, mesh, lambda n: specs[n]),
+        "master": _sds(oshape["master"], specs, mesh, lambda n: specs[n]),
+    }
+    ids_sds = jax.ShapeDtypeStruct(
+        (BATCH, SEQ), jnp.int32,
+        sharding=NamedSharding(mesh, P("dp", None)))
+    lr_sds = jax.ShapeDtypeStruct((), jnp.float32,
+                                  sharding=NamedSharding(mesh, P()))
+
+    # exact per-device state bytes
+    hbm = {}
+    hbm["params_bf16"] = _tree_bytes_per_device(
+        params, specs, mesh_shape, lambda n: specs[n])
+    for sec in ("slots", "master"):
+        hbm[f"opt_{sec}_f32"] = _tree_bytes_per_device(
+            oshape[sec], specs, mesh_shape, lambda n: specs[n])
+    hbm["grads_bf16_transient"] = hbm["params_bf16"]
+    b_local = BATCH // DP
+    h, inter, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    L = cfg.num_layers
+    act = {
+        # per-block boundary saves (remat=True), replicated over mp
+        "boundary_saves": b_local * SEQ * h * 2 * L,
+        # recompute working set: one block's internals mp-sharded
+        # (qkv+o 4h + gate/up/down 3*inter per token)
+        "recompute_peak": b_local * SEQ * (4 * h + 3 * inter) * 2 // MP,
+        "logits_f32": b_local * SEQ * (v // MP) * 4,
+        "batch_ids": 2 * b_local * SEQ * 4,
+    }
+    hbm["activations"] = sum(act.values())
+    hbm["activation_terms"] = act
+    total = sum(val for key, val in hbm.items()
+                if isinstance(val, int) and key != "activation_terms")
+    hbm["total_per_device"] = total
+    hbm["v5p_hbm"] = V5P_HBM_BYTES
+    hbm["utilization"] = round(total / V5P_HBM_BYTES, 4)
+    hbm["fit"] = bool(total <= FIT_HEADROOM * V5P_HBM_BYTES)
+    leg["hbm_accounting_gb"] = {
+        k: round(val / 1024**3, 3) for k, val in hbm.items()
+        if isinstance(val, int)}
+    leg["hbm_accounting"] = hbm
+    leg["status"] = "lowering"
+    _flush("llama_7b_semi_auto", leg)
+
+    def loss_fn(p, ids, labels):
+        logits, _ = functional_call(model, p, buffers, (ids,), train=True)
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P("dp", None, "mp")))
+        return jnp.mean(F.cross_entropy(
+            logits.astype(jnp.float32).reshape(-1, logits.shape[-1]),
+            labels.reshape(-1)))
+
+    def train_step(p, ostate, ids, labels, lr):
+        loss, g = jax.value_and_grad(loss_fn)(p, ids, labels)
+        newp, new_os = adamw.update(g, ostate, p, lr=lr)
+        return newp, new_os, loss
+
+    t0 = time.time()
+    lowered = jax.jit(train_step, donate_argnums=(0, 1)).lower(
+        params_sds, ostate_sds, ids_sds, ids_sds, lr_sds)
+    leg["lower_s"] = round(time.time() - t0, 1)
+    shlo = lowered.as_text()
+    leg["stablehlo_manual_collectives"] = _count_collectives(shlo)
+    leg["stablehlo_bytes"] = len(shlo)
+    del shlo
+    leg["status"] = "compiling"
+    _flush("llama_7b_semi_auto", leg)
+
+    t0 = time.time()
+    compiled = lowered.compile()
+    leg["compile_s"] = round(time.time() - t0, 1)
+    try:
+        hlo = compiled.as_text()
+        leg["spmd_collectives_per_step"] = _count_collectives(hlo)
+        leg["spmd_hlo_bytes"] = len(hlo)
+        del hlo
+    except Exception as e:
+        leg["spmd_collectives_per_step"] = {"error": repr(e)[:200]}
+    leg["status"] = "done"
+    leg["fit_verdict"] = "PASS" if hbm["fit"] else "FAIL"
+    _flush("llama_7b_semi_auto", leg)
+
+
+if __name__ == "__main__":
+    legs = sys.argv[1:] or ["gpt", "llama"]
+    for name in legs:
+        t0 = time.time()
+        try:
+            {"gpt": run_gpt, "llama": run_llama}[name]()
+            print(f"[{name}] done in {time.time() - t0:.0f}s", flush=True)
+        except Exception:
+            import traceback
+            key = ("gpt_6_7b_hybrid" if name == "gpt"
+                   else "llama_7b_semi_auto")
+            _flush(key + "_error",
+                   {"traceback": traceback.format_exc()[-2000:]})
+            traceback.print_exc()

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 chip-health watcher: probe the axon TPU every 4 min and append
+# one line per probe to /tmp/chip_health_r5.log.  Probe = subprocess with
+# SIGKILL-fallback timeout running matmul + device->host read (bench.py
+# _probe_tpu pattern; weak-sync gotcha means only a value read counts).
+# Exits after 11 h.  Idempotent: refuses to start if the pidfile's
+# process is alive.
+PIDFILE=/tmp/tpu_r5_watch.pid
+LOG=/tmp/chip_health_r5.log
+if [ -f "$PIDFILE" ] && kill -0 "$(cat $PIDFILE)" 2>/dev/null; then
+  echo "watcher already running ($(cat $PIDFILE))"; exit 0
+fi
+echo $$ > "$PIDFILE"
+END=$(( $(date +%s) + 39600 ))
+while [ "$(date +%s)" -lt "$END" ]; do
+  T0=$(date +%s)
+  OUT=$(timeout -k 10 100 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+v = float((x @ x)[0, 0])
+print('HEALTHY', jax.devices()[0].platform, v)" 2>&1 | tail -1)
+  T1=$(date +%s)
+  echo "$(date -u +%FT%TZ) probe_s=$((T1-T0)) $OUT" >> "$LOG"
+  sleep 240
+done
+rm -f "$PIDFILE"

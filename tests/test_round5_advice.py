@@ -1,0 +1,203 @@
+"""Round-5 ADVICE regression tests: the five round-4 advisor findings.
+
+Each test pins the corrected behavior so it cannot regress:
+  1. compat mutation-only inplace methods warn (once) about rebinding.
+  2. incubate minimize_bfgs accepts non-1D initial_position consistently.
+  3. static.ExponentialMovingAverage ramps decay off the passed global
+     step (thres_steps VALUE / update(step=...)), reference semantics.
+  4. static.py_func with an integer input and a backward_func works
+     (float0 cotangents for non-floating primals; custom_vjp rejects
+     integer tangents).
+  5. device listings: per-platform indices; custom listing restricted to
+     registered plugin device types.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn  # noqa: F401
+
+paddle.compat.enable_tensor_methods()
+
+
+class TestInplaceNamedMethods:
+    def test_mutation_only_method_warns_and_returns(self):
+        x = jnp.ones((3,))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            y = x.zero_()
+        assert any("rebind" in str(m.message) for m in w), \
+            "zero_() must warn that jax arrays cannot mutate in place"
+        assert float(y.sum()) == 0.0
+        assert float(x.sum()) == 3.0     # original untouched — the trap
+
+    def test_value_returning_inplace_does_not_warn(self):
+        x = jnp.ones((3,))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            y = x.add_(jnp.ones((3,)))
+        assert not [m for m in w if "rebind" in str(m.message)]
+        assert float(y.sum()) == 6.0
+
+
+class TestMinimizeBfgsShapes:
+    def test_non_1d_initial_position(self):
+        # objective over a [2, 2] matrix: min at A = eye
+        def obj(a):
+            return jnp.sum((a - jnp.eye(2)) ** 2)
+
+        x0 = jnp.zeros((2, 2))
+        res = paddle.incubate.optimizer.functional.minimize_bfgs(
+            obj, x0, max_iters=50)
+        is_conv, calls, pos, loss, grad = res
+        assert pos.shape == (2, 2) and grad.shape == (2, 2)
+        np.testing.assert_allclose(np.asarray(pos), np.eye(2), atol=1e-4)
+        assert float(loss) < 1e-6
+
+    def test_1d_still_works(self):
+        def obj(v):
+            return jnp.sum((v - 3.0) ** 2)
+
+        res = paddle.incubate.optimizer.functional.minimize_bfgs(
+            obj, jnp.zeros(4), max_iters=50)
+        np.testing.assert_allclose(np.asarray(res[2]), 3.0, atol=1e-4)
+
+
+class TestEmaThresSteps:
+    def test_ramp_follows_passed_global_step(self):
+        ema = paddle.static.ExponentialMovingAverage(
+            decay=0.999, thres_steps=0)
+        p = {"w": jnp.ones(2)}
+        # step 0: ramp (1+0)/(10+0) = 0.1, far below decay
+        ema.update(p, step=0)
+        ema.update({"w": jnp.zeros(2)}, step=0)
+        # shadow = 0.1 * 1 + 0.9 * 0 = 0.1
+        np.testing.assert_allclose(np.asarray(ema.shadow()["w"]), 0.1,
+                                   rtol=1e-6)
+        # a large global step saturates the ramp at `decay`
+        ema2 = paddle.static.ExponentialMovingAverage(
+            decay=0.5, thres_steps=10**9)
+        ema2.update(p)
+        ema2.update({"w": jnp.zeros(2)})
+        np.testing.assert_allclose(np.asarray(ema2.shadow()["w"]), 0.5,
+                                   rtol=1e-6)
+
+    def test_no_thres_steps_uses_flat_decay(self):
+        ema = paddle.static.ExponentialMovingAverage(decay=0.9)
+        ema.update({"w": jnp.ones(2)})
+        ema.update({"w": jnp.zeros(2)})
+        np.testing.assert_allclose(np.asarray(ema.shadow()["w"]), 0.9,
+                                   rtol=1e-6)
+
+
+class TestPyFuncIntInputs:
+    def test_int_input_with_backward(self):
+        # gather-like host op: float table + int index; grad flows to the
+        # table only, the int index gets a float0 symbolic zero
+        def host(table, idx):
+            return np.asarray(table)[np.asarray(idx)]
+
+        def host_bwd(table, idx, out, g):
+            gt = np.zeros_like(np.asarray(table))
+            np.add.at(gt, np.asarray(idx), np.asarray(g))
+            return gt, np.zeros_like(np.asarray(idx))
+
+        table = jnp.asarray([1.0, 2.0, 3.0])
+        idx = jnp.asarray([2, 0], jnp.int32)
+        out = paddle.static.py_func(host, [table, idx],
+                                    out=jnp.zeros(2),
+                                    backward_func=host_bwd)
+        np.testing.assert_allclose(np.asarray(out), [3.0, 1.0])
+        g = jax.grad(lambda t: paddle.static.py_func(
+            host, [t, idx], out=jnp.zeros(2),
+            backward_func=host_bwd).sum())(table)
+        np.testing.assert_allclose(np.asarray(g), [1.0, 0.0, 1.0])
+
+
+class TestDeviceListings:
+    def test_per_platform_indices(self, monkeypatch):
+        class FakeDev:
+            def __init__(self, platform):
+                self.platform = platform
+
+        fakes = [FakeDev("cpu"), FakeDev("tpu"), FakeDev("tpu")]
+        monkeypatch.setattr(jax, "devices",
+                            lambda *a, **k: fakes)
+        devs = paddle.device.get_available_device()
+        assert devs == ["cpu", "tpu:0", "tpu:1"], devs
+
+    def test_custom_listing_only_registered(self):
+        from paddle_tpu.device import custom
+        assert paddle.device.get_available_custom_device() == []
+        custom.register_custom_device("fake_npu", "cpu")
+        try:
+            listed = paddle.device.get_available_custom_device()
+            assert listed and all(
+                t.startswith("fake_npu:") for t in listed)
+            assert listed[0] == "fake_npu:0"
+        finally:
+            custom.unregister_custom_device("fake_npu")
+        assert paddle.device.get_available_custom_device() == []
+
+
+class TestShardedRowTake:
+    """mp_layers.sharded_row_take — the manual Megatron masked-lookup
+    form (exported utility; the hybrid trainer itself uses
+    _take_rows_f32grad, see its docstring for why)."""
+
+    def test_parity_and_grad_single_axis(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.meta_parallel.mp_layers import (
+            sharded_row_take)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("mp",))
+        table = jax.device_put(
+            jnp.arange(32.0, dtype=jnp.float32).reshape(8, 4),
+            NamedSharding(mesh, P("mp", None)))
+        ids = jnp.asarray([[1, 7], [3, 0]], jnp.int32)
+        with mesh:
+            out = jax.jit(lambda t: sharded_row_take(
+                t, ids, "mp", mesh))(table)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)))
+
+        def loss(t):
+            return jnp.sum(sharded_row_take(t, ids, "mp", mesh) ** 2)
+        with mesh:
+            g = jax.jit(jax.grad(loss))(table)
+        want = jax.grad(
+            lambda t: jnp.sum(jnp.take(t, ids, axis=0) ** 2))(table)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(want))
+
+    def test_uneven_rows_fall_back(self):
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.meta_parallel.mp_layers import (
+            sharded_row_take)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("mp",))
+        table = jnp.ones((7, 4))      # 7 % 4 != 0 -> GSPMD fallback
+        ids = jnp.asarray([2, 5], jnp.int32)
+        out = sharded_row_take(table, ids, "mp", mesh)
+        assert out.shape == (2, 4)
+
+
+class TestPyFuncIntOutputs:
+    def test_int_output_with_backward(self):
+        # host op returning (float, int) — the int output's float0
+        # cotangent must not reach the host callback
+        def host(x):
+            a = np.asarray(x)
+            return a * 2.0, np.argmax(a).astype(np.int32)
+
+        def host_bwd(x, out_f, out_i, g_f, g_i):
+            return np.asarray(g_f) * 2.0
+
+        x = jnp.asarray([0.5, 1.5, 1.0])
+        g = jax.grad(lambda v: paddle.static.py_func(
+            host, v, out=[jnp.zeros(3), jnp.zeros((), jnp.int32)],
+            backward_func=host_bwd)[0].sum())(x)
+        np.testing.assert_allclose(np.asarray(g), 2.0)
