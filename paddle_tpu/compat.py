@@ -21,6 +21,10 @@ __all__ = ["enable_tensor_methods"]
 
 _DONE = False
 
+# names whose rebind warning already fired (module-level so tests can
+# reset it; the warning is once-per-name-per-process)
+_WARNED_INPLACE = set()
+
 
 def enable_tensor_methods() -> None:
     global _DONE
@@ -104,7 +108,7 @@ def enable_tensor_methods() -> None:
     # no value to rebind at all).
     _MUTATION_ONLY = {"zero_", "fill_", "exponential_", "normal_",
                       "uniform_", "bernoulli_", "fill_diagonal_"}
-    _warned_inplace = set()
+    _warned_inplace = _WARNED_INPLACE
     for _name in _DELEGATED:
         _fn = getattr(_pd, _name, None)
         if _fn is None:

@@ -342,6 +342,16 @@ class MoELayer(Layer):
                     _apply_ffn_mp_specs(e, mp_axis)
         self.experts = ExpertStack(experts, moe_group=moe_group)
         self._axis = _ep_axis(moe_group)
+        # routing health metrics, refreshed every forward (BASELINE
+        # config #5 asks for expert utilization explicitly): occupancy =
+        # filled capacity slots / (E*C); keep rate = tokens routed
+        # without capacity drop / (S*k).  Non-persistable buffers, read
+        # from functional_call's returned buffers like aux_loss.
+        self.register_buffer("expert_util", jnp.zeros((), jnp.float32),
+                             persistable=False)
+        self.register_buffer("token_keep_rate",
+                             jnp.ones((), jnp.float32),
+                             persistable=False)
 
     @property
     def top_k(self) -> int:
@@ -365,6 +375,9 @@ class MoELayer(Layer):
         pos = jnp.cumsum(flat_sel, axis=0) * flat_sel - 1     # [S*k,E]
         pos_in_expert = jnp.max(pos, axis=-1).reshape(S, k)   # [S,k]
         keep = (pos_in_expert >= 0) & (pos_in_expert < C) & (gate_idx >= 0)
+        n_kept = jnp.sum(keep.astype(jnp.float32))
+        self.expert_util = n_kept / float(E * C)
+        self.token_keep_rate = n_kept / float(S * k)
 
         # normalize kept gate weights per token (reference normalizes top-k)
         gv = jnp.where(keep, gate_val, 0.0)

@@ -47,8 +47,26 @@ def named_sharding(mesh: Mesh, tree_of_specs):
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def put_global(x, sharding: NamedSharding):
+    """Host value -> array with ``sharding``, multi-controller-safe.
+
+    Single-process meshes use plain device_put.  On a multi-host mesh
+    (pipeline stages split across processes — SURVEY §3.3's multi-node
+    fleet launch) device_put rejects non-fully-addressable shardings;
+    every process holds the SAME host value (replicated init / batch),
+    so each contributes its addressable shards via
+    make_array_from_callback — the standard multi-controller JAX
+    ingest."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    host = np.asarray(x)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
 def shard_state(mesh: Mesh, tree, specs):
-    """device_put each leaf with its NamedSharding (host->mesh layout).
+    """Lay out each leaf with its NamedSharding (host->mesh layout,
+    multi-controller-safe via put_global).
 
     ``specs`` mirrors ``tree``'s structure down to array leaves; each
     corresponding spec (a PartitionSpec, passed whole) labels that leaf.
@@ -64,8 +82,8 @@ def shard_state(mesh: Mesh, tree, specs):
             return type(t)(vals)
         if t is None:
             return None
-        return jax.device_put(t, NamedSharding(mesh, s if isinstance(s, P)
-                                               else P()))
+        return put_global(t, NamedSharding(mesh, s if isinstance(s, P)
+                                           else P()))
     return rec(tree, specs)
 
 

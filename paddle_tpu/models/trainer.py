@@ -362,11 +362,15 @@ class GPTHybridTrainer:
         seq = seq or self.cfg.max_seq_len
         rng = np.random.RandomState(seed)
         ids = rng.randint(0, self.cfg.vocab_size, (batch, seq + 1))
-        x = jnp.asarray(ids[:, :-1])
-        y = jnp.asarray(ids[:, 1:])
+        # keep the batch on host: put_global ingests numpy directly
+        # (jnp.asarray first would bounce host->device->host on the
+        # multi-controller path)
+        x = np.ascontiguousarray(ids[:, :-1])
+        y = np.ascontiguousarray(ids[:, 1:])
         seq_axis = "sep" if getattr(self.cfg, "cp", False) else None
+        from ..distributed.sharding_utils import put_global
         bs = NamedSharding(self.mesh, P(self.batch_spec()[0], seq_axis))
-        return jax.device_put(x, bs), jax.device_put(y, bs)
+        return put_global(x, bs), put_global(y, bs)
 
     def train_step(self, state_tuple, ids, labels):
         pnb, pblk, onb, oblk = state_tuple

@@ -6,6 +6,8 @@ MXU dispatch (matmul).  Ops keep paddle's (x, y, name=None) convention.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -669,15 +671,17 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
 def pdist(x, p: float = 2.0, name=None):
     """Condensed pairwise distances of rows (reference: paddle.pdist)."""
     n = x.shape[0]
-    diff = x[:, None, :] - x[None, :, :]
+    # gather the upper-triangle row pairs FIRST: the full n x n form puts
+    # sqrt(0) on the diagonal, whose inf derivative poisons the whole
+    # gradient with NaNs even though the diagonal never reaches the
+    # output (round-5 grad-audit finding)
+    iu, ju = np.triu_indices(n, k=1)
+    diff = x[iu, :] - x[ju, :]
     if p == 2.0:
-        dm = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
-    elif p == float("inf"):
-        dm = jnp.max(jnp.abs(diff), axis=-1)
-    else:
-        dm = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
-    iu = jnp.triu_indices(n, k=1)
-    return dm[iu]
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
 
 
 def polar(abs, angle, name=None):

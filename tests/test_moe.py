@@ -222,3 +222,28 @@ def test_switch_gate_traced_without_rng_raises():
     import pytest as _pytest
     with _pytest.raises(RuntimeError, match="RNG context"):
         jax.jit(lambda v: gate(v))(x)
+
+
+def test_moe_expert_util_metrics_emitted():
+    """MoELayer publishes routing-health buffers every forward (BASELINE
+    config #5 asks for expert utilization): expert_util = filled
+    capacity slots / (E*C) in (0, 1]; token_keep_rate = tokens kept
+    after the capacity cut / (S*k), 1.0 when nothing is dropped."""
+    moe = _make_moe()
+    moe.train()
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 16),
+                    jnp.float32)
+    from paddle_tpu.nn.functional_call import state, functional_call
+    params, buffers = state(moe)
+    _, nb = functional_call(moe, params, buffers, (x,), train=True)
+    util = {k: float(v) for k, v in nb.items()
+            if k.endswith("expert_util")}
+    keep = {k: float(v) for k, v in nb.items()
+            if k.endswith("token_keep_rate")}
+    assert util and keep, sorted(nb)
+    for v in util.values():
+        assert 0.0 < v <= 1.0, v
+    for v in keep.values():
+        assert 0.0 < v <= 1.0, v
+    # with generous capacity nothing should be dropped
+    assert all(v > 0.5 for v in keep.values()), keep

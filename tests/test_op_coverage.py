@@ -43,3 +43,14 @@ def test_sweep_and_cuts_sections_present():
     assert "Adversarial sweep" in md
     assert "Explicit cuts" in md
     assert "LocalSGDOptimizer" in md          # sweep additions recorded
+
+
+def test_grad_audit_complete():
+    """Round-5 grad audit (VERDICT r4 Weak #8): every registry op either
+    carries grad_args (numeric-vs-autodiff checked by test_ops.py) or an
+    explicit grad_exempt reason.  No silent stragglers, ever again."""
+    from paddle_tpu.ops import coverage
+    c = coverage()
+    assert c["grad_unaccounted"] == [], c["grad_unaccounted"]
+    assert c["with_grad"] >= 234, c["with_grad"]
+    assert c["with_grad"] + c["grad_exempt"] == c["n_ops"]

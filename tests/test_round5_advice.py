@@ -28,6 +28,7 @@ paddle.compat.enable_tensor_methods()
 
 class TestInplaceNamedMethods:
     def test_mutation_only_method_warns_and_returns(self):
+        paddle.compat._WARNED_INPLACE.clear()   # once-per-process set
         x = jnp.ones((3,))
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
