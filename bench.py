@@ -32,6 +32,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # bf16 peak per chip
 PEAK_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12}
+# HBM bandwidth per chip (public datasheets), for bandwidth-bound rows
+HBM_BW_BY_GEN = {"v5e": 819e9, "v5p": 2765e9, "v4": 1228e9}
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 # total wall budget for TPU acquisition (round-2 VERDICT item 1a: adaptive
@@ -521,11 +523,26 @@ def _secondary_benches(smoke=False):
     pdt = timed(1, iters_d)                         # prefill + 1 token
     # steady-state decode rate: the (dnew - 1) extra tokens cost dt - pdt
     decode_tps = (db * (dnew - 1) / (dt - pdt)) if dt > pdt else None
+    # decode is HBM-bandwidth-bound, so the honest efficiency metric is
+    # BW utilization, not MFU (VERDICT r4 item 8): per decode STEP the
+    # chip reads every weight once (batch amortizes it) plus each
+    # sequence's live KV prefix, and writes one KV entry per layer.
+    bw_util = None
+    if decode_tps and not smoke:
+        hbm_bw = HBM_BW_BY_GEN.get(
+            os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 819e9)
+        avg_ctx = dprompt + dnew / 2
+        kv_read = 2 * dcfg.num_layers * avg_ctx * dcfg.hidden_size * 2
+        w_read = 2 * dcfg.num_params()
+        bytes_per_step = w_read + db * kv_read
+        steps_per_sec = decode_tps / db
+        bw_util = round(bytes_per_step * steps_per_sec / hbm_bw, 4)
     out["gpt_decode"] = {
         "step_ms": round(dt * 1e3, 1),
         # new tokens/sec over the whole call (prefill amortized in)
         "items_per_sec": round(db * dnew / dt, 1),
         "prefill_ms": round(pdt * 1e3, 1),
+        "hbm_bw_util": bw_util,
         "decode_tokens_per_sec": (round(decode_tps, 1)
                                   if decode_tps else "noise-dominated"),
         "config": f"b{db}-prompt{dprompt}-new{dnew}-h{dcfg.hidden_size}"

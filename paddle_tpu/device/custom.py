@@ -128,3 +128,88 @@ def resolve(place: "CustomPlace | str"):
             f"device id {place.device_id} out of range: platform "
             f"{platform!r} has {len(devs)} device(s)")
     return devs[place.device_id]
+
+
+# ---------------------------------------------------------------------------
+# C-ABI plugin loading (reference: device_ext.h InitPlugin + the
+# CUSTOM_DEVICE_ROOT directory scan in phi/backends/custom/custom_device.cc)
+# ---------------------------------------------------------------------------
+
+def load_custom_device_plugin(so_path: str) -> str:
+    """dlopen a plugin .so built against lib/custom_device_ext.h, call
+    its ``InitPlugin``, and register the declared device type.
+
+    Returns the registered device type.  When the plugin names a
+    ``pjrt_library``, it is handed to JAX's PJRT plugin discovery so
+    ``jax.devices(platform)`` can initialize it (best-effort: an already
+    -registered platform is fine)."""
+    import ctypes
+
+    class _Params(ctypes.Structure):
+        _fields_ = [("size", ctypes.c_size_t),
+                    ("abi_version", ctypes.c_int),
+                    ("device_type", ctypes.c_char_p),
+                    ("pjrt_platform", ctypes.c_char_p),
+                    ("pjrt_library", ctypes.c_char_p)]
+
+    lib = ctypes.CDLL(so_path)
+    try:
+        init = lib.InitPlugin
+    except AttributeError:
+        raise RuntimeError(
+            f"custom-device plugin {so_path!r} exports no InitPlugin "
+            f"(see paddle_tpu/lib/custom_device_ext.h)")
+    init.argtypes = [ctypes.POINTER(_Params)]
+    init.restype = None
+    params = _Params(size=ctypes.sizeof(_Params), abi_version=0,
+                     device_type=None, pjrt_platform=None,
+                     pjrt_library=None)
+    init(ctypes.byref(params))
+    if params.abi_version != 1:
+        raise RuntimeError(
+            f"custom-device plugin {so_path!r} declares ABI version "
+            f"{params.abi_version}; this build supports 1")
+    if not params.device_type:
+        raise RuntimeError(
+            f"custom-device plugin {so_path!r} set no device_type")
+    dev_type = params.device_type.decode()
+    platform = (params.pjrt_platform or params.device_type).decode()
+    pjrt_lib = (params.pjrt_library or b"").decode()
+    if pjrt_lib:
+        try:
+            from jax._src import xla_bridge
+            xla_bridge.register_plugin(platform, library_path=pjrt_lib)
+        except Exception as e:  # already registered / unavailable API
+            import warnings
+            warnings.warn(
+                f"could not register PJRT library {pjrt_lib!r} for "
+                f"platform {platform!r} ({e}); jax.devices({platform!r}) "
+                f"must be made available by other means",
+                RuntimeWarning, stacklevel=2)
+    register_custom_device(dev_type, platform)
+    return dev_type
+
+
+def load_custom_device_plugins_from_dir(root: Optional[str] = None):
+    """Scan ``root`` (default: $CUSTOM_DEVICE_ROOT) for ``*.so`` plugins
+    and load each — the reference's startup discovery flow."""
+    import glob
+    import os
+    root = root or os.environ.get("CUSTOM_DEVICE_ROOT", "")
+    if not root or not os.path.isdir(root):
+        return []
+    loaded = []
+    for p in sorted(glob.glob(os.path.join(root, "*.so"))):
+        try:
+            loaded.append(load_custom_device_plugin(p))
+        except Exception as e:
+            # reference startup discovery degrades per bad plugin, it
+            # does not abort the scan
+            import warnings
+            warnings.warn(f"skipping custom-device plugin {p!r}: {e}",
+                          RuntimeWarning, stacklevel=2)
+    return loaded
+
+
+__all__ += ["load_custom_device_plugin",
+            "load_custom_device_plugins_from_dir"]

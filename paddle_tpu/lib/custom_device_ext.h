@@ -1,0 +1,54 @@
+/* Custom-device plugin C ABI — the framework side of the plugin seam.
+ *
+ * Reference: paddle/phi/backends/custom/device_ext.h — a C struct of
+ * ~100 function pointers (alloc, copy, stream, event, ccl, ...) that a
+ * plugin fills in InitPlugin(), because the reference framework owns a
+ * per-backend kernel library, allocator and comm layer.
+ *
+ * TPU-native stance (COMPONENTS.md "Custom-device plugin API"): under
+ * JAX/XLA none of those live in the framework — a hardware backend
+ * plugs in BELOW as a PJRT C-API plugin, bringing its own compiler,
+ * allocator and collectives.  What remains framework-side is DISCOVERY:
+ * a plugin .so declares its device type and the PJRT platform (and
+ * optionally the PJRT C-API library to load) through this struct, and
+ * paddle_tpu.device.custom.load_custom_device_plugin() dlopens it and
+ * registers the mapping — the same dlopen/InitPlugin flow as the
+ * reference, with the runtime surface delegated to PJRT.
+ */
+#ifndef PADDLE_TPU_CUSTOM_DEVICE_EXT_H_
+#define PADDLE_TPU_CUSTOM_DEVICE_EXT_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PADDLE_TPU_CUSTOM_RUNTIME_ABI_VERSION 1
+
+typedef struct {
+  /* set by the loader before calling InitPlugin: sizeof(this struct) —
+   * plugins must check it covers the fields they write */
+  size_t size;
+  /* set by the plugin: */
+  int abi_version;            /* must be PADDLE_TPU_CUSTOM_RUNTIME_ABI_VERSION */
+  const char* device_type;    /* e.g. "my_npu" — the paddle device name  */
+  const char* pjrt_platform;  /* JAX/PJRT platform backing it (e.g. the
+                               * plugin's own platform name, or "cpu" for
+                               * the reference's fake-plugin test pattern) */
+  const char* pjrt_library;   /* optional path to a PJRT C-API plugin .so
+                               * for jax to load, or NULL/"" when the
+                               * platform is registered by other means
+                               * (pip-installed jax plugin entry point) */
+} PaddleTpuCustomRuntimeParams;
+
+/* The single symbol a plugin must export:
+ *   void InitPlugin(PaddleTpuCustomRuntimeParams* params);
+ * (same name as the reference's entry point.)
+ */
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PADDLE_TPU_CUSTOM_DEVICE_EXT_H_ */

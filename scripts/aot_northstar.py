@@ -268,7 +268,8 @@ def run_gpt():
     hbm["activations"] = sum(act.values())
     hbm["activation_terms"] = act
     total = sum(val for key, val in hbm.items()
-                if isinstance(val, int) and key != "activation_terms")
+                if isinstance(val, int) and not isinstance(val, bool)
+                and key != "activation_terms")
     hbm["total_per_device"] = total
     hbm["v5p_hbm"] = V5P_HBM_BYTES
     hbm["utilization"] = round(total / V5P_HBM_BYTES, 4)
@@ -276,7 +277,7 @@ def run_gpt():
     leg["hbm_accounting"] = dict(hbm)
     leg["hbm_accounting_gb"] = {
         k: round(val / 1024**3, 3) for k, val in hbm.items()
-        if isinstance(val, int)}
+        if isinstance(val, int) and not isinstance(val, bool)}
 
     # step FLOPs -> what 45% MFU would mean on this slice
     flops_tok = 6 * n_params + 12 * full_L * h * SEQ
@@ -292,27 +293,9 @@ def run_gpt():
 
     # ---- AOT lower + compile ------------------------------------------
     step = trainer.build_step()
-    t0 = time.time()
-    lowered = jax.jit(step, donate_argnums=(0, 1, 2, 3)).lower(
-        pnb_sds, pblk_sds, onb_sds, oblk_sds, ids_sds, ids_sds, lr_sds)
-    leg["lower_s"] = round(time.time() - t0, 1)
-    shlo = lowered.as_text()
-    leg["stablehlo_manual_collectives"] = _count_collectives(shlo)
-    leg["stablehlo_bytes"] = len(shlo)
-    del shlo
-    leg["status"] = "compiling"
-    _flush("gpt_6_7b_hybrid", leg)
-
-    t0 = time.time()
-    compiled = lowered.compile()
-    leg["compile_s"] = round(time.time() - t0, 1)
-    try:
-        hlo = compiled.as_text()
-        leg["spmd_collectives_per_step"] = _count_collectives(hlo)
-        leg["spmd_hlo_bytes"] = len(hlo)
-        del hlo
-    except Exception as e:
-        leg["spmd_collectives_per_step"] = {"error": repr(e)[:200]}
+    compiled = _lower_and_compile(
+        leg, "gpt_6_7b_hybrid", step,
+        (pnb_sds, pblk_sds, onb_sds, oblk_sds, ids_sds, ids_sds, lr_sds))
     try:
         ma = compiled.memory_analysis()
         leg["xla_memory_analysis"] = {
@@ -325,6 +308,150 @@ def run_gpt():
     leg["status"] = "done"
     leg["fit_verdict"] = "PASS" if hbm["fit"] else "FAIL"
     _flush("gpt_6_7b_hybrid", leg)
+
+
+def _lower_and_compile(leg, key, step, args, donate=(0, 1, 2, 3)):
+    t0 = time.time()
+    lowered = jax.jit(step, donate_argnums=donate).lower(*args)
+    leg["lower_s"] = round(time.time() - t0, 1)
+    shlo = lowered.as_text()
+    leg["stablehlo_manual_collectives"] = _count_collectives(shlo)
+    leg["stablehlo_bytes"] = len(shlo)
+    del shlo
+    leg["status"] = "compiling"
+    _flush(key, leg)
+    t0 = time.time()
+    compiled = lowered.compile()
+    leg["compile_s"] = round(time.time() - t0, 1)
+    try:
+        hlo = compiled.as_text()
+        leg["spmd_collectives_per_step"] = _count_collectives(hlo)
+        leg["spmd_hlo_bytes"] = len(hlo)
+        del hlo
+    except Exception as e:
+        leg["spmd_collectives_per_step"] = {"error": repr(e)[:200]}
+    return compiled
+
+
+# ---------------------------------------------------------------------------
+# Leg 3: GPT-MoE at Switch/GShard scale — the full production MoE layout
+# (ep x mp x pp x ZeRO x dp in ONE mesh; SURVEY §2.3 EP row's end state)
+# ---------------------------------------------------------------------------
+
+def run_moe():
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPTMoEHybridTrainer
+    from paddle_tpu.models.gpt_moe import GPTMoEConfig
+
+    DP, SHARD, PP, MP, EP = 2, 2, 2, 2, 8       # 2*2*2*2*8 = 128
+    MICRO = 4
+    BATCH, SEQ = 256, 2048
+    H, L, E = 4096, 32, 8                        # ~36B total, ~6.9B active
+
+    leg = {"model": f"gpt-moe-h{H}-L{L}-E{E}", "status": "building",
+           "mesh": {"dp": DP, "sharding": SHARD, "pp": PP, "mp": MP,
+                    "ep": EP},
+           "config": {"batch": BATCH, "seq": SEQ, "microbatches": MICRO,
+                      "zero_stage": 1, "dtype": "bfloat16",
+                      "note": "every-layer top-1 MoE, experts sharded "
+                              "over ep with expert-internal mp"}}
+    _flush("gpt_moe_hybrid", leg)
+
+    dist.topology.set_hybrid_communicate_group(None)
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": DP, "mp_degree": MP, "pp_degree": PP,
+                        "sharding_degree": SHARD, "ep_degree": EP}
+    dist.fleet.init(is_collective=True, strategy=s,
+                    devices=jax.devices()[:N_DEV])
+    hcg = dist.get_hybrid_communicate_group()
+    mesh = hcg.get_mesh()
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    cfg = GPTMoEConfig(vocab_size=50304, hidden_size=H, num_layers=PP,
+                       num_heads=32, max_seq_len=SEQ, num_experts=E,
+                       gate="naive", moe_every=1, dtype="bfloat16")
+    adamw = opt.AdamW(learning_rate=1e-4, multi_precision=True,
+                      grad_clip=opt.ClipGradByGlobalNorm(1.0))
+    t0 = time.time()
+    trainer = GPTMoEHybridTrainer(cfg, hcg, adamw, microbatches=MICRO,
+                                  zero_stage=1)
+    leg["scaffold_build_s"] = round(time.time() - t0, 1)
+
+    def widen(x):
+        return jax.ShapeDtypeStruct((L,) + tuple(x.shape[1:]), x.dtype)
+    pblk_full = {k: widen(v) for k, v in trainer.params_blocks.items()}
+    pnb_sds = _sds(trainer.params_nonblock, trainer.specs_nonblock, mesh,
+                   lambda n: trainer.specs_nonblock[n])
+    pblk_sds = _sds(pblk_full, trainer.specs_blocks, mesh,
+                    lambda n: trainer.specs_blocks[n])
+    onb_shape = jax.eval_shape(adamw.init, pnb_sds)
+    oblk_shape = jax.eval_shape(adamw.init, pblk_sds)
+
+    def opt_sds(oshape, slot_specs):
+        return {"step": jax.ShapeDtypeStruct(
+                    (), jnp.int32, sharding=NamedSharding(mesh, P())),
+                "slots": _sds(oshape["slots"], slot_specs, mesh,
+                              lambda n: slot_specs[n]),
+                "master": _sds(oshape["master"], slot_specs, mesh,
+                               lambda n: slot_specs[n])}
+    onb_sds = opt_sds(onb_shape, trainer.slot_specs_nb)
+    oblk_sds = opt_sds(oblk_shape, trainer.slot_specs_blk)
+    ids_sds = jax.ShapeDtypeStruct(
+        (BATCH, SEQ), jnp.int32,
+        sharding=NamedSharding(mesh, trainer.batch_spec()))
+    lr_sds = jax.ShapeDtypeStruct((), jnp.float32,
+                                  sharding=NamedSharding(mesh, P()))
+
+    hbm = {}
+    hbm["params_bf16"] = (
+        _tree_bytes_per_device(trainer.params_nonblock,
+                               trainer.specs_nonblock, mesh_shape,
+                               lambda n: trainer.specs_nonblock[n])
+        + _tree_bytes_per_device(pblk_full, trainer.specs_blocks,
+                                 mesh_shape,
+                                 lambda n: trainer.specs_blocks[n]))
+    for sec in ("slots", "master"):
+        hbm[f"opt_{sec}_f32"] = (
+            _tree_bytes_per_device(onb_shape[sec], trainer.slot_specs_nb,
+                                   mesh_shape,
+                                   lambda n: trainer.slot_specs_nb[n])
+            + _tree_bytes_per_device(oblk_shape[sec],
+                                     trainer.slot_specs_blk, mesh_shape,
+                                     lambda n: trainer.slot_specs_blk[n]))
+    hbm["grads_bf16_transient"] = hbm["params_bf16"]
+    mb_local = BATCH // MICRO // (DP * SHARD)
+    cap = int(1.25 * mb_local * SEQ / E + 4)
+    act = {
+        "boundary_saves": mb_local * SEQ * H * 2 * (L // PP) * PP,
+        "dispatch_ecm": 2 * (E // EP) * cap * H * 2,   # in+out, ep-sharded
+        "recompute_peak": mb_local * SEQ * 14 * H * 2 // MP,
+        "logits_f32": mb_local * SEQ * (50304 // (MP * PP)) * 4,
+        "batch_ids": 2 * BATCH // (DP * SHARD) * SEQ * 4,
+    }
+    hbm["activations"] = sum(act.values())
+    hbm["activation_terms"] = act
+    total = sum(v for k, v in hbm.items()
+                if isinstance(v, int) and not isinstance(v, bool)
+                and k != "activation_terms")
+    hbm["total_per_device"] = total
+    hbm["v5p_hbm"] = V5P_HBM_BYTES
+    hbm["utilization"] = round(total / V5P_HBM_BYTES, 4)
+    hbm["fit"] = bool(total <= FIT_HEADROOM * V5P_HBM_BYTES)
+    leg["hbm_accounting_gb"] = {
+        k: round(v / 1024**3, 3) for k, v in hbm.items()
+        if isinstance(v, int) and not isinstance(v, bool)}
+    leg["hbm_accounting"] = hbm
+    leg["status"] = "lowering"
+    _flush("gpt_moe_hybrid", leg)
+
+    step = trainer.build_step()
+    _lower_and_compile(
+        leg, "gpt_moe_hybrid", step,
+        (pnb_sds, pblk_sds, onb_sds, oblk_sds, ids_sds, ids_sds, lr_sds))
+    leg["status"] = "done"
+    leg["fit_verdict"] = "PASS" if hbm["fit"] else "FAIL"
+    _flush("gpt_moe_hybrid", leg)
 
 
 # ---------------------------------------------------------------------------
@@ -415,14 +542,15 @@ def run_llama():
     hbm["activations"] = sum(act.values())
     hbm["activation_terms"] = act
     total = sum(val for key, val in hbm.items()
-                if isinstance(val, int) and key != "activation_terms")
+                if isinstance(val, int) and not isinstance(val, bool)
+                and key != "activation_terms")
     hbm["total_per_device"] = total
     hbm["v5p_hbm"] = V5P_HBM_BYTES
     hbm["utilization"] = round(total / V5P_HBM_BYTES, 4)
     hbm["fit"] = bool(total <= FIT_HEADROOM * V5P_HBM_BYTES)
     leg["hbm_accounting_gb"] = {
         k: round(val / 1024**3, 3) for k, val in hbm.items()
-        if isinstance(val, int)}
+        if isinstance(val, int) and not isinstance(val, bool)}
     leg["hbm_accounting"] = hbm
     leg["status"] = "lowering"
     _flush("llama_7b_semi_auto", leg)
@@ -440,43 +568,26 @@ def run_llama():
         newp, new_os = adamw.update(g, ostate, p, lr=lr)
         return newp, new_os, loss
 
-    t0 = time.time()
-    lowered = jax.jit(train_step, donate_argnums=(0, 1)).lower(
-        params_sds, ostate_sds, ids_sds, ids_sds, lr_sds)
-    leg["lower_s"] = round(time.time() - t0, 1)
-    shlo = lowered.as_text()
-    leg["stablehlo_manual_collectives"] = _count_collectives(shlo)
-    leg["stablehlo_bytes"] = len(shlo)
-    del shlo
-    leg["status"] = "compiling"
-    _flush("llama_7b_semi_auto", leg)
-
-    t0 = time.time()
-    compiled = lowered.compile()
-    leg["compile_s"] = round(time.time() - t0, 1)
-    try:
-        hlo = compiled.as_text()
-        leg["spmd_collectives_per_step"] = _count_collectives(hlo)
-        leg["spmd_hlo_bytes"] = len(hlo)
-        del hlo
-    except Exception as e:
-        leg["spmd_collectives_per_step"] = {"error": repr(e)[:200]}
+    _lower_and_compile(
+        leg, "llama_7b_semi_auto", train_step,
+        (params_sds, ostate_sds, ids_sds, ids_sds, lr_sds),
+        donate=(0, 1))
     leg["status"] = "done"
     leg["fit_verdict"] = "PASS" if hbm["fit"] else "FAIL"
     _flush("llama_7b_semi_auto", leg)
 
 
 if __name__ == "__main__":
-    legs = sys.argv[1:] or ["gpt", "llama"]
+    legs = sys.argv[1:] or ["gpt", "llama", "moe"]
+    KEYS = {"gpt": "gpt_6_7b_hybrid", "llama": "llama_7b_semi_auto",
+            "moe": "gpt_moe_hybrid"}
     for name in legs:
         t0 = time.time()
         try:
-            {"gpt": run_gpt, "llama": run_llama}[name]()
+            {"gpt": run_gpt, "llama": run_llama, "moe": run_moe}[name]()
             print(f"[{name}] done in {time.time() - t0:.0f}s", flush=True)
         except Exception:
             import traceback
-            key = ("gpt_6_7b_hybrid" if name == "gpt"
-                   else "llama_7b_semi_auto")
-            _flush(key + "_error",
+            _flush(KEYS[name] + "_error",
                    {"traceback": traceback.format_exc()[-2000:]})
             traceback.print_exc()

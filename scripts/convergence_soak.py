@@ -46,8 +46,10 @@ TOTAL_STEPS = int(os.environ.get("SOAK_STEPS", "2000"))
 KILL_AT = TOTAL_STEPS // 2
 VAL_EVERY = min(100, max(1, TOTAL_STEPS // 6))
 TARGET_VAL_CE = 1.75          # nats/byte, pre-registered above
-B, S = 8, 128
-LR_PEAK, WARMUP = 3e-3, 100
+B = int(os.environ.get("SOAK_BATCH", "8"))
+S = 128
+LR_PEAK = float(os.environ.get("SOAK_LR", "3e-3"))
+WARMUP = 100
 
 
 def build_corpus():

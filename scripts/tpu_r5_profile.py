@@ -34,9 +34,11 @@ import jax.numpy as jnp
 
 OUT_PATH = os.path.join(ROOT, "TPU_R5_PROFILE.json")
 TRACE_DIR = os.path.join(ROOT, "profiler_log", "r5")
-PEAK = {"v5e": 197e12, "v5p": 459e12}.get(
+from bench import HBM_BW_BY_GEN, PEAK_FLOPS  # noqa: E402  (repo root)
+
+PEAK = PEAK_FLOPS.get(
     os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 197e12)
-HBM_BW = {"v5e": 819e9, "v5p": 2765e9}.get(
+HBM_BW = HBM_BW_BY_GEN.get(
     os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 819e9)
 
 # R5_SMOKE=1: shrink every config for a CPU syntax/shape validation run

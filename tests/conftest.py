@@ -63,3 +63,12 @@ def _seed_all():
     paddle_tpu.seed(1234)
     np.random.seed(1234)
     yield
+
+
+def free_local_port() -> int:
+    """Bind-to-zero free-port helper shared by the multi-process tests
+    (launcher / PS / RPC runners all need an unused rendezvous port)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

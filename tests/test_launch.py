@@ -170,7 +170,8 @@ def test_rpc_two_processes(tmp_path):
     env.pop("XLA_FLAGS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PADDLE_TPU_REPO"] = repo
-    env["PADDLE_PORT"] = "62450"
+    from conftest import free_local_port
+    env["PADDLE_PORT"] = str(free_local_port())
     log_dir = str(tmp_path / "log")
     r = subprocess.run(
         [_sys.executable, "-m", "paddle_tpu.distributed.launch",

@@ -41,6 +41,9 @@ def test_sparse_table_grows_on_touch():
     np.testing.assert_allclose(got[1], np.zeros(5))
 
 
+from conftest import free_local_port
+
+
 def test_ps_two_processes(tmp_path):
     """Server on rank 0, worker on rank 1 pushing/pulling over real RPC."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -49,13 +52,13 @@ def test_ps_two_processes(tmp_path):
     env.pop("XLA_FLAGS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PADDLE_TPU_REPO"] = repo
-    env["PADDLE_PORT"] = "62710"
+    env["PADDLE_PORT"] = str(free_local_port())
     log_dir = str(tmp_path / "log")
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", "--log_dir", log_dir,
          "--max_restart", "0", runner],
-        env=env, cwd=repo, capture_output=True, text=True, timeout=180)
+        env=env, cwd=repo, capture_output=True, text=True, timeout=420)
     logs = ""
     for i in (0, 1):
         p = os.path.join(log_dir, f"workerlog.{i}")
@@ -136,13 +139,13 @@ def test_ps_multiserver_async_geo(tmp_path):
     env.pop("XLA_FLAGS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PADDLE_TPU_REPO"] = repo
-    env["PADDLE_PORT"] = "62840"
+    env["PADDLE_PORT"] = str(free_local_port())
     log_dir = str(tmp_path / "log")
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "3", "--log_dir", log_dir,
          "--max_restart", "0", runner],
-        env=env, cwd=repo, capture_output=True, text=True, timeout=180)
+        env=env, cwd=repo, capture_output=True, text=True, timeout=420)
     logs = ""
     for i in (0, 1, 2):
         p = os.path.join(log_dir, f"workerlog.{i}")

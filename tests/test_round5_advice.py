@@ -12,6 +12,7 @@ Each test pins the corrected behavior so it cannot regress:
      registered plugin device types.
 """
 
+import os
 import warnings
 
 import numpy as np
@@ -202,3 +203,59 @@ class TestPyFuncIntOutputs:
             host, v, out=[jnp.zeros(3), jnp.zeros((), jnp.int32)],
             backward_func=host_bwd)[0].sum())(x)
         np.testing.assert_allclose(np.asarray(g), 2.0)
+
+
+class TestCustomDevicePluginCABI:
+    """The C-ABI seam (reference: device_ext.h InitPlugin + the
+    CUSTOM_DEVICE_ROOT scan, exercised upstream by test/custom_runtime's
+    CPU-masquerading fake plugin): build a real plugin .so against
+    paddle_tpu/lib/custom_device_ext.h, load it, and use the device."""
+
+    @pytest.fixture()
+    def plugin_so(self, tmp_path):
+        import shutil
+        import subprocess
+        if shutil.which("gcc") is None:
+            pytest.skip("no C compiler on host")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src = tmp_path / "fake_plugin.c"
+        src.write_text(
+            '#include "custom_device_ext.h"\n'
+            'void InitPlugin(PaddleTpuCustomRuntimeParams* p) {\n'
+            '  if (p->size < sizeof(PaddleTpuCustomRuntimeParams)) return;\n'
+            '  p->abi_version = PADDLE_TPU_CUSTOM_RUNTIME_ABI_VERSION;\n'
+            '  p->device_type = "fake_cabi_npu";\n'
+            '  p->pjrt_platform = "cpu";\n'
+            '  p->pjrt_library = "";\n'
+            '}\n')
+        so = tmp_path / "libfake_plugin.so"
+        subprocess.run(
+            ["gcc", "-shared", "-fPIC",
+             "-I", os.path.join(repo, "paddle_tpu", "lib"),
+             str(src), "-o", str(so)], check=True)
+        return str(so)
+
+    def test_load_register_and_resolve(self, plugin_so):
+        from paddle_tpu.device import custom
+        try:
+            dev_type = custom.load_custom_device_plugin(plugin_so)
+            assert dev_type == "fake_cabi_npu"
+            assert "fake_cabi_npu" in custom.get_all_custom_device_type()
+            assert custom.is_compiled_with_custom_device("fake_cabi_npu")
+            assert custom.custom_device_count("fake_cabi_npu") >= 1
+            dev = custom.resolve("fake_cabi_npu:0")
+            assert dev.platform == "cpu"
+            listed = paddle.device.get_available_custom_device()
+            assert any(t.startswith("fake_cabi_npu:") for t in listed)
+        finally:
+            custom.unregister_custom_device("fake_cabi_npu")
+
+    def test_dir_scan(self, plugin_so, monkeypatch):
+        from paddle_tpu.device import custom
+        monkeypatch.setenv("CUSTOM_DEVICE_ROOT",
+                           os.path.dirname(plugin_so))
+        try:
+            loaded = custom.load_custom_device_plugins_from_dir()
+            assert loaded == ["fake_cabi_npu"]
+        finally:
+            custom.unregister_custom_device("fake_cabi_npu")
