@@ -156,6 +156,15 @@ def main():
             vals.append({"step": i, "val_ce": round(v, 4)})
             print(f"step {i} train {float(l):.4f} val {v:.4f}",
                   flush=True)
+            # incremental flush: a killed/timed-out run still leaves an
+            # inspectable partial artifact (status: running)
+            res["status"] = "running"
+            res["train_series"] = losses
+            res["val_series"] = vals
+            with open(OUT + ".tmp", "w") as f:
+                json.dump(res, f, indent=1)
+            os.replace(OUT + ".tmp", OUT)   # atomic: a kill mid-dump
+                                            # can't truncate the artifact
         if i == KILL_AT:
             # fault injection: persist, THROW AWAY the live state, and
             # restore from disk — the resume must reproduce the next
@@ -184,6 +193,7 @@ def main():
                   f"{float(l_resume):.6f} vs {killed_loss_next:.6f}",
                   flush=True)
 
+    res["status"] = "done"
     res["train_series"] = losses
     res["val_series"] = vals
     res["wall_s"] = round(time.time() - t0, 1)
@@ -199,8 +209,9 @@ def main():
         "resume_exact": res.get("resume_equivalence", {}).get("equal"),
     }
     res["finished_unix"] = time.time()
-    with open(OUT, "w") as f:
+    with open(OUT + ".tmp", "w") as f:
         json.dump(res, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
     print(json.dumps(res["verdict"]), flush=True)
     assert res["verdict"]["target_met"], final
     assert res["verdict"]["val_thirds_decreasing"], thirds

@@ -1,0 +1,53 @@
+"""AOT_NORTHSTAR.json integrity: the committed scale-proof artifact
+(round-5 VERDICT item 1) keeps its load-bearing claims.
+
+The artifact is produced by scripts/aot_northstar.py on a virtual
+128-device mesh; this test pins that the committed file says what the
+notes/README quote: all three legs compiled through the SPMD
+partitioner, passed their HBM-fit verdicts, and the hybrid legs carry
+the pipeline's collective-permute ring plus (for MoE) expert-dispatch
+all-to-alls.
+"""
+
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    with open(os.path.join(ROOT, "AOT_NORTHSTAR.json")) as f:
+        return json.load(f)
+
+
+def test_all_legs_compiled_and_fit():
+    art = _load()
+    assert art["n_virtual_devices"] == 128
+    for leg in ("gpt_6_7b_hybrid", "llama_7b_semi_auto",
+                "gpt_moe_hybrid"):
+        d = art[leg]
+        assert d["status"] == "done", (leg, d["status"])
+        assert d["fit_verdict"] == "PASS", leg
+        assert d["compile_s"] > 0, leg
+        assert d["spmd_collectives_per_step"]["total"] > 0, leg
+        hbm = d["hbm_accounting"]
+        assert hbm["total_per_device"] <= 0.85 * hbm["v5p_hbm"], leg
+        # the GB presentation block mirrors the byte block, sans bools
+        assert "fit" not in d["hbm_accounting_gb"], leg
+
+
+def test_structural_collectives():
+    art = _load()
+    gpt = art["gpt_6_7b_hybrid"]["spmd_collectives_per_step"]
+    assert gpt.get("collective-permute", 0) >= 2, gpt   # pp ring
+    moe = art["gpt_moe_hybrid"]["spmd_collectives_per_step"]
+    assert moe.get("all-to-all", 0) >= 2, moe           # expert dispatch
+    assert moe.get("collective-permute", 0) >= 2, moe   # pp ring
+
+
+def test_gpt_leg_is_the_baseline_config():
+    d = _load()["gpt_6_7b_hybrid"]
+    assert d["config"]["num_params"] > 6.5e9
+    assert d["config"]["seq"] == 2048
+    assert d["mesh"] == {"dp": 2, "sharding": 2, "pp": 4, "mp": 8}
+    assert d["config"]["zero_stage"] == 1 and d["config"]["sp"]
