@@ -1050,3 +1050,20 @@ _exempt("multi-output pytree; the harness scalarizes single arrays",
 _exempt("boolean-gather output; not vmappable under the vectorized "
         "central-difference probe (autodiff path itself is exercised by "
         "tests/test_round4_longtail tensor suites)", "masked_select_op")
+
+
+# -- low-precision gradient tiers (reference: OpTest fp16/bf16 tables) -----
+# checked by tests/test_ops_bf16_grad.py: bf16 autodiff grad vs the f32
+# grad within the tier.  Training-hot-path ops; softmax gets the loosest
+# tier (its grads are differences of O(eps) probabilities — bf16
+# rounding of the probabilities dominates, ~6.5% measured).
+for _name, _tol in {
+        "matmul": 2e-2, "mm": 2e-2, "bmm": 2e-2, "linear": 2e-2,
+        "conv2d": 4e-2, "layer_norm": 4e-2, "rms_norm": 4e-2,
+        "act_softmax": 1e-1, "act_relu": 1e-2, "act_silu": 2e-2,
+        "act_mish": 2e-2, "mean": 1e-2, "sum": 1e-2, "logsumexp": 2e-2,
+        "cross_entropy_op": 4e-2, "embedding": 1e-2, "tanh": 2e-2,
+}.items():
+    _op = __import__("paddle_tpu.ops.registry",
+                     fromlist=["get_op"]).get_op(_name)
+    _op.grad_bf16_rtol = _tol

@@ -42,6 +42,11 @@ class OpDef:
     # round-4 VERDICT Weak #8).  E.g. "integer/boolean output",
     # "piecewise-constant", "constructor (no differentiable inputs)".
     grad_exempt: str = ""
+    # low-precision gradient tier (reference: OpTest's fp16/bf16 dtype
+    # tables): when set, tests/test_ops_bf16_grad.py checks the op's
+    # bf16 autodiff gradient against its f32 gradient within this
+    # normalized tolerance.  Set on training-hot-path ops.
+    grad_bf16_rtol: Optional[float] = None
 
 
 _REGISTRY: Dict[str, OpDef] = {}

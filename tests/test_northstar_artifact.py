@@ -51,3 +51,27 @@ def test_gpt_leg_is_the_baseline_config():
     assert d["config"]["seq"] == 2048
     assert d["mesh"] == {"dp": 2, "sharding": 2, "pp": 4, "mp": 8}
     assert d["config"]["zero_stage"] == 1 and d["config"]["sp"]
+
+
+def test_convergence_soak_artifact_complete_when_committed():
+    """CONVERGENCE_SOAK.json is quoted by the README as evidence of the
+    full-stack soak (pre-registered target + mid-run kill/restore with
+    exact resume equivalence).  When the artifact is present it must be
+    a COMPLETE run carrying that evidence — a partial status:running
+    snapshot must never ship as the canonical artifact.  (Run 1 lives in
+    CONVERGENCE_SOAK_r1_calibration.json with its own honest verdict.)"""
+    path = os.path.join(ROOT, "CONVERGENCE_SOAK.json")
+    import subprocess
+    tracked = subprocess.run(
+        ["git", "ls-files", "--error-unmatch", path],
+        cwd=ROOT, capture_output=True).returncode == 0
+    if not (tracked and os.path.exists(path)):
+        import pytest
+        pytest.skip("soak artifact not committed yet (run in progress)")
+    with open(path) as f:
+        d = json.load(f)
+    assert d.get("status") == "done", d.get("status")
+    v = d["verdict"]
+    assert v["target_met"] is True and v["resume_exact"] is True, v
+    assert v["final_val_ce"] < d["target_val_ce_nats"], v
+    assert d["resume_equivalence"]["equal"] is True

@@ -1,0 +1,70 @@
+"""Low-precision gradient tiers (SURVEY §4: the reference OpTest checks
+fp16/bf16 gradients with relaxed per-dtype tolerance tables).
+
+The central-difference harness is meaningless at bf16 resolution
+(eps=1e-3 is below bf16's ulp at typical magnitudes), so the low-
+precision tier checks AUTODIFF-vs-AUTODIFF: the bf16 gradient of each
+op declaring a ``grad_bf16_rtol`` tier (set in the registry — the
+single source driving the numeric harnesses) must match its f32
+gradient within that normalized tolerance.  This catches dtype-handling
+bugs in an op's vjp (e.g. an accumulation done in bf16 that should be
+f32) — the failure mode the reference's fp16 OpTest tables exist for.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import all_ops
+import paddle_tpu.ops.defs  # noqa: F401  (populate registry)
+
+TIERED = sorted(o.name for o in all_ops()
+                if o.grad_bf16_rtol is not None)
+
+
+def test_tier_table_nonempty():
+    assert len(TIERED) >= 15, TIERED
+
+
+@pytest.mark.parametrize("name", TIERED)
+def test_bf16_grad_matches_f32(name):
+    from paddle_tpu.ops.registry import get_op
+    op = get_op(name)
+    assert op.grad_args, f"{name} declares a bf16 tier but no grad_args"
+    args, kwargs = op.sample()
+    jargs_f32 = [jnp.asarray(a) if isinstance(a, np.ndarray) else a
+                 for a in args]
+    out0 = op.fn(*jargs_f32, **kwargs)
+    # fixed random cotangent, O(1) everywhere: sum(out*cot) keeps every
+    # op's gradient O(1) (a squared loss makes e.g. mean's gradient
+    # cancel toward 0 and the comparison scale collapse)
+    cot = jnp.asarray(np.random.RandomState(3).uniform(
+        0.5, 1.5, np.shape(out0)), jnp.float32)
+
+    def scalar(dtype):
+        def fn(*gargs):
+            full = list(jargs_f32)
+            for slot, val in zip(op.grad_args, gargs):
+                full[slot] = val.astype(dtype) if hasattr(val, "astype") \
+                    else val
+            out = op.fn(*full, **kwargs)
+            return jnp.sum(out.astype(jnp.float32) * cot)
+        return fn
+
+    grad_inputs_f32 = tuple(jargs_f32[i] for i in op.grad_args)
+    argnums = tuple(range(len(grad_inputs_f32)))
+    g32 = jax.grad(scalar(jnp.float32), argnums=argnums)(*grad_inputs_f32)
+    gbf = jax.grad(scalar(jnp.bfloat16), argnums=argnums)(
+        *tuple(a.astype(jnp.bfloat16)
+               if np.issubdtype(np.asarray(a).dtype, np.floating) else a
+               for a in grad_inputs_f32))
+    rtol = op.grad_bf16_rtol
+    for slot, a, b in zip(op.grad_args, g32, gbf):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32).astype(np.float32)
+        scale = np.maximum(np.abs(a).max(), 1e-3)
+        np.testing.assert_allclose(
+            b / scale, a / scale, atol=rtol,
+            err_msg=f"{name} bf16 grad diverges from f32 (arg {slot})")
