@@ -171,9 +171,21 @@ def _run_bench(on_tpu, tpu_diag=None):
 
     import functools
 
+    # BENCH_CHUNKED_CE=k: head + CE chunked over the vocab (no [b,s,V]
+    # logits materialization — nn.functional.chunked_softmax_cross_
+    # entropy); frees ~3.3 GB at the flagship shape, the lever for
+    # larger single-chip batches
+    chunk_ce = int(os.environ.get("BENCH_CHUNKED_CE", "0"))
+    if chunk_ce > 1:
+        model.train()
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(p, os_, x, y):
         def loss_fn(p):
+            if chunk_ce > 1:
+                from paddle_tpu.nn.functional_call import bind_state
+                with bind_state(model, p, buffers):
+                    return model.chunked_loss(x, y, n_chunks=chunk_ce)
             out, _ = functional_call(model, p, buffers, (x,), train=True)
             return jnp.mean(parallel_cross_entropy(out, y))
         loss, g = jax.value_and_grad(loss_fn)(p)

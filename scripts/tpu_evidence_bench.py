@@ -376,9 +376,20 @@ def main():
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq + 1)))
     x, y = ids[:, :-1], ids[:, 1:]
 
+    # BENCH_CHUNKED_CE=k: chunked-vocab head+CE (no [b,s,V] logits
+    # materialization) — the single-chip batch lever; recorded in config
+    chunk_ce = int(os.environ.get("BENCH_CHUNKED_CE", "0"))
+    if chunk_ce > 1:
+        model.train()
+    EV["config"]["chunked_ce"] = chunk_ce
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(p, os_, x, y):
         def loss_fn(p):
+            if chunk_ce > 1:
+                from paddle_tpu.nn.functional_call import bind_state
+                with bind_state(model, p, buffers):
+                    return model.chunked_loss(x, y, n_chunks=chunk_ce)
             out, _ = functional_call(model, p, buffers, (x,), train=True)
             return jnp.mean(parallel_cross_entropy(out, y))
         loss, g = jax.value_and_grad(loss_fn)(p)

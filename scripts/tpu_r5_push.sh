@@ -61,6 +61,21 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     done
     commit_artifacts "On-chip flash block sweep (promotion keeps the max MFU)"
 
+    echo "$(date -u +%H:%M:%S) stage 2b: chunked-CE batch push" >> $LOG
+    # chunked vocab CE frees the [b,s,V] logits (~3.3 GB at b4): try the
+    # batches that previously OOMed / lost to remat (r4: b8 remat=0.506,
+    # b4 no-remat=0.6324).  Promotion keeps the max MFU.
+    for bc in "6 8" "8 8" "4 8"; do
+      set -- $bc
+      BENCH_BATCH=$1 BENCH_CHUNKED_CE=$2 BENCH_ITERS=16 BENCH_KERNELS=0 \
+        BENCH_SECONDARY=0 EVIDENCE_BUDGET_S=600 timeout -k 15 800 \
+        python scripts/tpu_evidence_bench.py >> $LOG 2>&1 \
+        && echo "$(date -u +%H:%M:%S) chunked-ce b$1 ok" >> $LOG \
+        || { echo "$(date -u +%H:%M:%S) chunked-ce b$1 failed rc=$?" >> $LOG; \
+             timeout -k 10 150 python $PROBE >> $LOG 2>&1 || break; }
+    done
+    commit_artifacts "On-chip chunked-CE batch sweep (no-logits LM loss; promotion keeps max)"
+
     echo "$(date -u +%H:%M:%S) stage 3: r5 profile suite" >> $LOG
     timeout -k 15 2400 python scripts/tpu_r5_profile.py >> $LOG 2>&1 \
       && echo "$(date -u +%H:%M:%S) profile suite ok" >> $LOG \
