@@ -210,6 +210,19 @@ class LlamaForCausalLM(Layer):
         tok = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(tok)
 
+    def chunked_loss(self, input_ids, labels, n_chunks: int = 8):
+        """Causal LM loss without materializing [b, s, V] logits (the
+        chunked-vocab head+CE — see GPTForCausalLM.chunked_loss).  The
+        untied lm_head's [h, V] weight enters transposed; XLA fuses the
+        transpose into the chunk matmuls."""
+        from ..nn.functional import chunked_softmax_cross_entropy
+        hidden = self.llama(input_ids)
+        b, s, h = hidden.shape
+        per_tok = chunked_softmax_cross_entropy(
+            hidden.reshape(b * s, h), self.lm_head.weight.T,
+            labels.reshape(-1), n_chunks=n_chunks)
+        return jnp.mean(per_tok)
+
     # ---- incremental decode -------------------------------------------
     def init_cache(self, batch: int, max_len: int, dtype=None):
         cfg = self.cfg

@@ -598,6 +598,14 @@ def chunked_softmax_cross_entropy(hidden, weight, labels, n_chunks=8,
     valid = labels.astype(jnp.int32) != ignore_index
     lbl = jnp.where(valid, labels.astype(jnp.int32), 0)
     if n_chunks <= 1 or V % n_chunks:
+        if n_chunks > 1:
+            import warnings
+            warnings.warn(
+                f"chunked_softmax_cross_entropy: vocab {V} not divisible "
+                f"by n_chunks={n_chunks} — falling back to the DENSE "
+                f"path (full [N, V] logits materialized); pick a chunk "
+                f"count dividing the vocab to get the memory saving",
+                RuntimeWarning, stacklevel=2)
         logits = (hidden.astype(jnp.float32)
                   @ weight.astype(jnp.float32).T)
         m = jnp.max(logits, axis=-1)

@@ -382,6 +382,10 @@ def main():
     if chunk_ce > 1:
         model.train()
     EV["config"]["chunked_ce"] = chunk_ce
+    # honest provenance: the kernel falls back to dense when the
+    # vocab does not divide — record the path actually taken
+    EV["config"]["chunked_ce_active"] = bool(
+        chunk_ce > 1 and cfg.vocab_size % chunk_ce == 0)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(p, os_, x, y):

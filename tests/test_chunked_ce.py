@@ -129,3 +129,16 @@ def test_ignore_index_masks_loss_and_grads():
     out_fb = chunked_softmax_cross_entropy(hidden, weight, lbl,
                                            n_chunks=3)  # 20 % 3 != 0
     assert float(out_fb[3]) == 0.0
+
+
+def test_llama_chunked_loss_matches_loss():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    import paddle_tpu
+    paddle_tpu.seed(6)
+    m = LlamaForCausalLM(llama_tiny())
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 17)))
+    x, y = ids[:, :-1], ids[:, 1:]
+    dense = float(m.loss(x, y))
+    chunked = float(m.chunked_loss(x, y, n_chunks=4))
+    assert abs(dense - chunked) < 1e-4, (dense, chunked)
