@@ -35,7 +35,9 @@ class OpDef:
     atol: float = 1e-5
     grad_rtol: float = 5e-2
     grad_atol: float = 5e-3
-    skip_dtypes_grad: Tuple[str, ...] = ("float16", "bfloat16")
+    # numeric (central-difference) grad checks run in f32 only — the
+    # probe eps is below low-precision ulp; low-precision gradient
+    # coverage is the autodiff-vs-autodiff tier via grad_bf16_rtol below
     tags: Tuple[str, ...] = ()
     # ops with NO grad_args must say why (reference: OpTest grad-checks
     # every differentiable op; the exemption list is the audit trail —
