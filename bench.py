@@ -543,10 +543,14 @@ def _secondary_benches(smoke=False):
     if decode_tps and not smoke:
         hbm_bw = HBM_BW_BY_GEN.get(
             os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 819e9)
+        # weights and KV cache both live in dcfg.dtype (init_cache
+        # defaults to cfg.dtype; the model was .to()'d above)
+        bpe = jnp.dtype(dcfg.dtype).itemsize
         avg_ctx = dprompt + dnew / 2
-        kv_read = 2 * dcfg.num_layers * avg_ctx * dcfg.hidden_size * 2
-        w_read = 2 * dcfg.num_params()
-        bytes_per_step = w_read + db * kv_read
+        kv_read = 2 * dcfg.num_layers * avg_ctx * dcfg.hidden_size * bpe
+        kv_write = 2 * dcfg.num_layers * dcfg.hidden_size * bpe
+        w_read = dcfg.num_params() * bpe
+        bytes_per_step = w_read + db * (kv_read + kv_write)
         steps_per_sec = decode_tps / db
         bw_util = round(bytes_per_step * steps_per_sec / hbm_bw, 4)
     out["gpt_decode"] = {

@@ -108,14 +108,15 @@ def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
                                                    placements)
     if any(p.is_partial() for p in placements):
         raise ValueError("dtensor_from_fn does not accept Partial placements")
-    return jax.jit(lambda: fn(*args, **kwargs), out_shardings=sharding)()
+    return jax.jit(lambda: fn(*args, **kwargs),  # graftlint: disable=recompile-hazard -- one-shot creation: the jitted thunk is called exactly once, right here, to materialise shards in place; there is no steady-state cache to miss
+                   out_shardings=sharding)()
 
 
 def _psum_partial(x, mesh: ProcessMesh, placements: List[Placement]):
     """Materialise pending partial reductions (reference:
     PToRReshardFunction — inserts allreduce).  Runs a shard_map reduction
     over the partial mesh axes; the result is Replicate on those axes."""
-    from jax import shard_map
+    from .._jax_compat import shard_map
 
     jm = mesh.get_mesh()
     names = jm.axis_names

@@ -71,7 +71,6 @@ class Engine:
         self._train_step = None
         self._eval_step = None
         self._pred_step = None
-        self._step_count = 0
 
     # ------------------------------------------------------------------
     def _mesh(self):
@@ -207,7 +206,6 @@ class Engine:
                  self._merge_state) = self._train_step(
                     self._params, self._buffers, self._opt_state,
                     self._merge_state, inputs, labels)
-                self._step_count += 1
                 history.append(l)
                 if verbose and it % log_freq == 0:
                     print(f"epoch {epoch} step {it}: loss {float(l):.4f}")

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._jax_compat import axis_size as _axis_size
 from .topology import ParallelAxis, get_hybrid_communicate_group
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
@@ -147,7 +148,7 @@ def _eager_collective(g: ParallelAxis, kind: str, per_shard_fn, x,
     x sharded on axis -> shards are rank-local tensors; x replicated ->
     every 'rank' sees the same tensor (shard_map with replicated in_spec).
     """
-    from jax import shard_map
+    from ._jax_compat import shard_map
     mesh = g.mesh
     # determine whether x is sharded over this axis already
     in_spec = P()
@@ -255,9 +256,9 @@ def _reduce_scatter_body(v, op: str, axis_name: str, axis: int):
         out = jax.lax.psum_scatter(v, axis_name, scatter_dimension=axis,
                                    tiled=True)
         if op == ReduceOp.AVG:
-            out = out / jax.lax.axis_size(axis_name)
+            out = out / _axis_size(axis_name)
         return out
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     tiles = jnp.moveaxis(
         v.reshape(v.shape[:axis] + (n, v.shape[axis] // n) +
                   v.shape[axis + 1:]), axis, 0)       # [n, ..., tile, ...]

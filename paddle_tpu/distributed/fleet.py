@@ -181,7 +181,6 @@ class UserDefinedRoleMaker:
         self._role = role
         self._worker_num = int(worker_num)
         self._server_endpoints = list(server_endpoints or [])
-        self._is_collective = is_collective
 
     def is_worker(self) -> bool:
         return self._role == Role.WORKER

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .._jax_compat import axis_size as _axis_size
 from ..topology import get_hybrid_communicate_group
 
 
@@ -47,9 +48,10 @@ def _shard_map(body, mesh, in_specs, out_specs, manual_axes):
     AMBIENT abstract mesh — passing the concrete Mesh raises a context-
     mismatch because the ambient mesh carries Manual axis types.  This is
     the cp-inside-pp composition seam (r4 dryrun leg 4)."""
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs,
-                         axis_names=frozenset(manual_axes), check_vma=False)
+    from .._jax_compat import shard_map
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs,
+                     axis_names=frozenset(manual_axes), check_vma=False)
 
 
 def _axis_is_manual(axis_name: str) -> bool:
@@ -236,7 +238,7 @@ def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if inside_shard_map or _axis_is_manual(axis_name):
-        size = jax.lax.axis_size(axis_name)
+        size = _axis_size(axis_name)
         return _ring_attention_local(q, k, v, axis_name, size, causal, scale)
 
     mesh = _resolve_mesh(mesh)

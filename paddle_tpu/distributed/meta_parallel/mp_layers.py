@@ -195,7 +195,7 @@ def sharded_row_take(table, ids, row_axes, mesh):
         n_shards *= mesh.shape[ax]
     if table.shape[0] % n_shards:
         return _take_rows_f32grad(table, ids)
-    from jax import shard_map
+    from .._jax_compat import shard_map
 
     def body(tbl, ids_):
         lin = 0

@@ -30,7 +30,6 @@ class MetaParallelBase(Layer):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
-        self._strategy = strategy
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

@@ -178,7 +178,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
     each leaf rides the ring independently.  ``x_spec`` then must be a
     matching pytree of PartitionSpecs (or None).
     """
-    from jax import shard_map
+    from ._jax_compat import shard_map
 
     M = jax.tree_util.tree_leaves(x_microbatches)[0].shape[0]
     S = n_stages
@@ -303,7 +303,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     them a dp/mp-partitioned caller would see its batch and tp weights
     replicated through the schedule (round-2 advisor finding).
     """
-    from jax import shard_map
+    from ._jax_compat import shard_map
 
     M = jax.tree_util.tree_leaves(x_microbatches)[0].shape[0]
     S = n_stages

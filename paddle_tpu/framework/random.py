@@ -147,7 +147,6 @@ class RNGStatesTracker:
 
     def __init__(self):
         self._streams: dict[str, int] = {}
-        self._base_seed = 0
 
     def reset(self) -> None:
         self._streams.clear()

@@ -31,7 +31,6 @@ class Model:
         self.network = network
         self.stop_training = False
         self._optimizer = None
-        self._loss = None
         self._metrics: List[Metric] = []
         self._params, self._buffers = state(network)
         self._opt_state = None
@@ -42,7 +41,6 @@ class Model:
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
-        self._loss = loss
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]

@@ -46,20 +46,16 @@ class Config:
         if model is not None and model.endswith(".pdmodel"):
             model = model[:-len(".pdmodel")]
         self.prefix = model
-        self._mem_pool_mb = 0
-        self._device = "tpu"
 
     # --- accepted-knob parity (warn-once no-ops under XLA) --------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         _warn_ignored("enable_use_gpu",
                       "the program runs on the JAX default backend; memory "
                       "pools and device ids are managed by PJRT")
-        self._device = "tpu"
 
     def disable_gpu(self):
         _warn_ignored("disable_gpu",
                       "set JAX_PLATFORMS=cpu to force CPU execution")
-        self._device = "cpu"
 
     def enable_memory_optim(self):
         _warn_ignored("enable_memory_optim",

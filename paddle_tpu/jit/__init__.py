@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from ..nn.layer import Layer
 from ..nn.functional_call import functional_call, state
 from ..static import InputSpec
+from . import _export_compat as _jx
 from . import dy2static
 from .dy2static import convert_to_static, Dy2StaticError
 
@@ -67,7 +68,8 @@ class StaticFunction:
     def __init__(self, fn_or_layer, input_spec=None, build_strategy=None,
                  full_graph=True):
         self._target = fn_or_layer
-        self._input_spec = input_spec
+        # public: the reference's StaticFunction exposes its input_spec
+        self.input_spec = input_spec
         if isinstance(fn_or_layer, Layer):
             layer = fn_or_layer
             # dy2static: convert the layer's forward so data-dependent
@@ -139,7 +141,7 @@ def _spec_struct(spec: InputSpec, scope, sym_cache):
         if d is None or (isinstance(d, int) and d < 0):
             name = "batch" if i == 0 else f"dyn{i}"
             if name not in sym_cache:
-                sym_cache[name] = jax.export.symbolic_shape(
+                sym_cache[name] = _jx.symbolic_shape(
                     name, scope=scope)[0]
             dims.append(sym_cache[name])
         else:
@@ -181,7 +183,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
         def fwd(params, buffers, *xs):
             return layer(*xs)
 
-    scope = jax.export.SymbolicScope()
+    scope = _jx.SymbolicScope()
     sym_cache: dict = {}
     arg_structs = [_spec_struct(s, scope, sym_cache) for s in specs]
     p_structs = jax.tree.map(
@@ -189,8 +191,8 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     b_structs = jax.tree.map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
 
-    exported = jax.export.export(jax.jit(fwd))(p_structs, b_structs,
-                                               *arg_structs)
+    exported = _jx.export(jax.jit(fwd))(p_structs, b_structs,
+                                        *arg_structs)
 
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
@@ -236,7 +238,7 @@ class TranslatedLayer(Layer):
 def load(path: str) -> TranslatedLayer:
     """Reference: paddle.jit.load(path) -> TranslatedLayer."""
     with open(path + ".pdmodel", "rb") as f:
-        exported = jax.export.deserialize(bytearray(f.read()))
+        exported = _jx.deserialize(bytearray(f.read()))
     data = np.load(path + ".pdiparams.npz")
     params, buffers = {}, {}
     for k in data.files:
@@ -263,7 +265,7 @@ def save_program(fn, path: str, *example_args):
     Writes {path}.pdprog.  example_args may be arrays OR
     jax.ShapeDtypeStruct pytrees."""
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    exported = jax.export.export(jitted)(*example_args)
+    exported = _jx.export(jitted)(*example_args)
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                 exist_ok=True)
     with open(path + ".pdprog", "wb") as f:
@@ -276,7 +278,7 @@ def load_program(path: str):
     runs the compiled program (the current process must expose at least
     the exported device count)."""
     with open(path + ".pdprog", "rb") as f:
-        return jax.export.deserialize(bytearray(f.read()))
+        return _jx.deserialize(bytearray(f.read()))
 
 
 def _apply_jit_log_level(also_to_stdout: bool = False):

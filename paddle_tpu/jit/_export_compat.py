@@ -1,0 +1,19 @@
+"""jax.export version shim for the AOT save/load paths.
+
+jit.save / save_program are written against the public ``jax.export``
+module (jax >= 0.5 surface).  Some older pins ship the identical
+functionality only under ``jax._src.export`` (the public alias is
+absent).  Everything in this package resolves the four symbols it needs
+through here so both pins work.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax.export import (SymbolicScope, deserialize, export,
+                            symbolic_shape)
+except ImportError:
+    from jax._src.export._export import deserialize, export
+    from jax._src.export.shape_poly import SymbolicScope, symbolic_shape
+
+__all__ = ["export", "deserialize", "symbolic_shape", "SymbolicScope"]

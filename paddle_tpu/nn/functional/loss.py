@@ -596,7 +596,12 @@ def chunked_softmax_cross_entropy(hidden, weight, labels, n_chunks=8,
     N, h = hidden.shape
     V = weight.shape[0]
     valid = labels.astype(jnp.int32) != ignore_index
-    lbl = jnp.where(valid, labels.astype(jnp.int32), 0)
+    # clamp to [0, V-1] so out-of-range labels (not ignore_index) pick the
+    # same (clamped) logit on BOTH the chunked and dense paths — before
+    # this, the chunked path silently returned loss=lse (picked=0) while
+    # the dense path clamped via take_along_axis: two different wrong
+    # answers for one invalid input (ADVICE r5)
+    lbl = jnp.clip(jnp.where(valid, labels.astype(jnp.int32), 0), 0, V - 1)
     if n_chunks <= 1 or V % n_chunks:
         if n_chunks > 1:
             import warnings

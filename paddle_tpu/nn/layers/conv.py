@@ -40,8 +40,6 @@ class _ConvNd(Layer):
         self.padding_mode = padding_mode
         self.data_format = data_format
         self.output_padding = output_padding
-        self._ndim = ndim
-        self._transpose = transpose
         if transpose:
             wshape = (in_channels, out_channels // groups) + self.kernel_size
         else:

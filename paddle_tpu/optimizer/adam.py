@@ -58,7 +58,6 @@ class AdamW(Adam):
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name=name)
         self.apply_decay_param_fun = apply_decay_param_fun
-        self._current_param_name = None
 
     def update(self, grads, state, params, lr=None):
         # track names for apply_decay_param_fun when params is a flat dict

@@ -13,7 +13,6 @@ __all__ = ["L1Decay", "L2Decay"]
 class L2Decay:
     def __init__(self, coeff: float = 0.0):
         self.coeff = float(coeff)
-        self._coeff = self.coeff
 
     def __repr__(self):
         return f"L2Decay(coeff={self.coeff})"
@@ -22,7 +21,6 @@ class L2Decay:
 class L1Decay:
     def __init__(self, coeff: float = 0.0):
         self.coeff = float(coeff)
-        self._coeff = self.coeff
 
     def __repr__(self):
         return f"L1Decay(coeff={self.coeff})"

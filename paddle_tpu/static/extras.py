@@ -180,7 +180,6 @@ class ExponentialMovingAverage:
         self.decay = float(decay)
         self.thres_steps = thres_steps
         self._shadow = None
-        self._step = 0
 
     def update(self, params, step=None):
         """Fold current params into the shadow.  The decay ramp follows
@@ -190,7 +189,6 @@ class ExponentialMovingAverage:
         thres_steps therefore holds the ramp constant, exactly like a
         non-advancing global-step variable would).  With neither, the
         flat ``decay`` applies."""
-        self._step += 1
         if step is not None or self.thres_steps is not None:
             t = step if step is not None else self.thres_steps
             try:

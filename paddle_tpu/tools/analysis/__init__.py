@@ -1,0 +1,27 @@
+"""graftlint — JAX/TPU-aware static analysis for the paddle_tpu tree.
+
+The oracle test tier catches numeric wrongness; this package catches the
+SILENT failure classes of a jax codebase: tracer leaks, recompilation
+hazards, host syncs in hot paths, collective axis-name drift, registry/
+API drift, and dead state.  Pure-AST — linting never imports the code
+under analysis.
+
+Entry points:
+  * ``python scripts/graftlint.py paddle_tpu`` — the CLI;
+  * ``tests/test_static_analysis.py`` — the CI gate (zero unsuppressed
+    findings over ``paddle_tpu/``) plus per-rule fixture tests;
+  * ``run_analysis([...])`` — the library API both of those use.
+
+Suppression syntax (reason REQUIRED — see suppress.py):
+    # graftlint: disable=<rule>[,<rule>...] -- <why this is safe>
+"""
+
+from .findings import Finding, ERROR, WARNING
+from .suppress import parse_suppressions, Suppressions
+from .walker import AnalysisResult, FileContext, run_analysis
+from .report import format_json, format_text
+from .checkers import default_checkers
+
+__all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
+           "Suppressions", "AnalysisResult", "FileContext", "run_analysis",
+           "format_json", "format_text", "default_checkers"]

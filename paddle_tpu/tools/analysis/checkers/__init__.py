@@ -1,0 +1,26 @@
+"""Checker registry.  ``default_checkers()`` is THE rule set the CLI and
+the CI gate run; adding a checker = appending it here (see
+docs/static_analysis.md for the how-to)."""
+
+from .base import Checker
+from .tracer_leak import TracerLeakChecker
+from .recompile import RecompileChecker
+from .host_sync import HostSyncChecker
+from .collectives import AxisNameChecker
+from .registry_drift import RegistryDriftChecker
+from .dead_state import DeadStateChecker
+
+__all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
+           "HostSyncChecker", "AxisNameChecker", "RegistryDriftChecker",
+           "DeadStateChecker", "default_checkers"]
+
+
+def default_checkers():
+    return [
+        TracerLeakChecker(),
+        RecompileChecker(),
+        HostSyncChecker(),
+        AxisNameChecker(),
+        RegistryDriftChecker(),
+        DeadStateChecker(),
+    ]

@@ -1,0 +1,226 @@
+"""Checker protocol + shared AST helpers.
+
+Every checker is a class with a ``name`` (the rule id used in reports and
+suppression comments), a default ``severity``, and a ``check(ctx)`` method
+returning ``list[Finding]``.  ``ctx`` is ``walker.FileContext``.
+
+The helpers here answer the questions several rules share: "is this
+function jit-traced?", "what does this dotted call resolve to, textually?",
+"which params are static?".  All answers are intraprocedural and textual —
+graftlint never imports the code it analyses (so a module with a hard
+accelerator dependency can still be linted on any host).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+
+class Checker:
+    name: str = "base"
+    severity: str = "error"
+
+    def check(self, ctx) -> List:  # -> List[Finding]
+        raise NotImplementedError
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# names under which jax.jit / pjit commonly appear after import
+JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit", "api.jit"}
+TO_STATIC_NAMES = {"to_static", "jit.to_static", "paddle_tpu.jit.to_static"}
+PARTIAL_NAMES = {"functools.partial", "partial", "ft.partial"}
+
+
+def _partial_of_jit(call: ast.Call) -> Optional[ast.Call]:
+    """If ``call`` is partial(jax.jit, ...), return it, else None."""
+    fn = dotted_name(call.func)
+    if fn in PARTIAL_NAMES and call.args:
+        inner = dotted_name(call.args[0])
+        if inner in JIT_NAMES:
+            return call
+    return None
+
+
+def jit_decorator_info(fn: ast.AST) -> Optional[ast.Call]:
+    """If the function is jit-decorated, return the configuring Call node
+    (the partial/jit call carrying static_argnums etc.), or the marker
+    ``ast.Name`` wrapped in a bare Call-less sentinel.
+
+    Returns:
+      * an ``ast.Call`` when the decorator is ``partial(jax.jit, ...)`` or
+        ``jax.jit(...)`` used as a decorator factory;
+      * ``fn`` itself (truthy sentinel with no kwargs) for a bare
+        ``@jax.jit``;
+      * None when not jit-decorated.
+    """
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        if dotted_name(dec) in JIT_NAMES:
+            return fn  # bare @jax.jit — no static args
+        if isinstance(dec, ast.Call):
+            if _partial_of_jit(dec) is not None:
+                return dec
+            if dotted_name(dec.func) in JIT_NAMES:
+                return dec
+    return None
+
+
+def is_to_static_decorated(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name in TO_STATIC_NAMES:
+            return True
+        if isinstance(dec, ast.Call) and dotted_name(dec.func) in TO_STATIC_NAMES:
+            return True
+    return False
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def static_params(fn: ast.AST, jit_call) -> Set[str]:
+    """Param names excluded from tracing via static_argnums/static_argnames
+    on the jit decorator (only literal specs are understood)."""
+    out: Set[str] = set()
+    if not isinstance(jit_call, ast.Call):
+        return out
+    positional = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for lit in _iter_str_literals(kw.value):
+                out.add(lit)
+        elif kw.arg == "static_argnums":
+            for idx in _iter_int_literals(kw.value):
+                if 0 <= idx < len(positional):
+                    out.add(positional[idx])
+    return out
+
+
+def _iter_str_literals(node: ast.AST) -> Iterable[str]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value
+
+
+def _iter_int_literals(node: ast.AST) -> Iterable[int]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int):
+            yield n.value
+
+
+def jitted_local_defs(tree: ast.AST) -> Set[str]:
+    """Names of functions later wrapped as ``g = jax.jit(f)`` (or partial
+    form) anywhere in the module — marks ``f`` as jit-traced."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_jit = dotted_name(node.func) in JIT_NAMES
+        if not is_jit and _partial_of_jit(node) is not None:
+            # partial(jax.jit, f) — f is args[1] if present
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Name):
+                wrapped.add(node.args[1].id)
+            continue
+        if is_jit and node.args and isinstance(node.args[0], ast.Name):
+            wrapped.add(node.args[0].id)
+    return wrapped
+
+
+# ------------------------------------------------------------------ taint
+# Expression-level "is this value derived from a traced input" analysis,
+# shared by tracer-leak and host-sync.  Attributes that are static under
+# tracing (shapes/dtypes are Python values at trace time) break the chain.
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                "aval", "weak_type", "name", "device"}
+# calls whose RESULT is host/static even on traced args
+UNTAINTING_CALLS = {"len", "isinstance", "hasattr", "callable", "type",
+                    "id", "repr", "str", "format", "getattr"}
+
+
+def expr_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """True if the expression's value may be a traced array derived from
+    one of the ``tainted`` names."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        # x.shape[0] is static; x[0] is traced
+        return expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname is not None and fname.split(".")[-1] in UNTAINTING_CALLS:
+            return False
+        args: List[ast.AST] = list(node.args) + [k.value for k in node.keywords]
+        if isinstance(node.func, ast.Attribute):
+            # method call: receiver counts (x.astype(...), x.sum())
+            args.append(node.func.value)
+        return any(expr_tainted(a, tainted) for a in args)
+    if isinstance(node, (ast.BinOp,)):
+        return expr_tainted(node.left, tainted) or expr_tainted(node.right, tainted)
+    if isinstance(node, ast.UnaryOp):
+        return expr_tainted(node.operand, tainted)
+    if isinstance(node, ast.BoolOp):
+        return any(expr_tainted(v, tainted) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return (expr_tainted(node.left, tainted)
+                or any(expr_tainted(c, tainted) for c in node.comparators))
+    if isinstance(node, ast.IfExp):
+        return (expr_tainted(node.body, tainted)
+                or expr_tainted(node.orelse, tainted))
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(expr_tainted(e, tainted) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return any(expr_tainted(v, tainted) for v in node.values if v is not None)
+    if isinstance(node, ast.Starred):
+        return expr_tainted(node.value, tainted)
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return (expr_tainted(node.elt, tainted)
+                or any(expr_tainted(g.iter, tainted) for g in node.generators))
+    if isinstance(node, ast.DictComp):
+        return (expr_tainted(node.value, tainted)
+                or any(expr_tainted(g.iter, tainted) for g in node.generators))
+    if isinstance(node, ast.JoinedStr):
+        # an f-string renders to a host str (formatting a tracer is legal)
+        return False
+    return False
+
+
+def assigned_names(target: ast.AST) -> List[str]:
+    """Flat Name ids bound by an assignment target (tuple unpack included)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out.extend(assigned_names(e))
+        return out
+    if isinstance(target, ast.Starred):
+        return assigned_names(target.value)
+    return []

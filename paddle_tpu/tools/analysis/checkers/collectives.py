@@ -1,0 +1,113 @@
+"""axis-name: collective axis names must be declared in the same module.
+
+A literal axis name at a ``ppermute``/``psum``/``all_gather``/... call
+site that no mesh/pmap/shard_map construct in the same module declares is
+either a typo (fails only when that code path finally runs on a mesh) or
+a hidden cross-module contract.  The checker:
+
+  * collects DECLARED axis names: string literals inside ``Mesh(...)`` /
+    ``make_mesh(...)`` / ``create_device_mesh`` calls, ``axis_name=`` /
+    ``axis_names=`` keywords anywhere (pmap, shard_map wrappers, function
+    defaults that document the expected axis), and ``PartitionSpec``/
+    ``P(...)`` literals inside ``shard_map``/``NamedSharding`` calls;
+  * checks USED axis names: literal axis args of ``jax.lax`` collectives
+    (second positional or ``axis_name=``).  Non-literal axis args (the
+    common ``g.name`` / ``axis_name`` parameter pattern) are out of scope
+    by design — the caller owns those.
+
+A module whose collectives are all parameterized never reports.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..findings import Finding, ERROR
+from .base import Checker, dotted_name
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+                "all_gather", "all_to_all", "psum_scatter", "axis_index",
+                "axis_size", "pbroadcast"}
+# call roots that declare mesh axes when string literals appear inside
+_DECL_CALLS = {"Mesh", "make_mesh", "create_device_mesh", "shard_map",
+               "NamedSharding", "pmap", "xmap"}
+_DECL_KWARGS = {"axis_name", "axis_names"}
+
+
+class AxisNameChecker(Checker):
+    name = "axis-name"
+    severity = ERROR
+
+    def check(self, ctx) -> List[Finding]:
+        declared = self._declared(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None or fname.split(".")[-1] not in _COLLECTIVES:
+                continue
+            # jax.lax only — a method named all_gather on a comm group
+            # object has its own axis resolution
+            if not (fname.startswith("jax.lax.") or fname.startswith("lax.")
+                    or fname in _COLLECTIVES):
+                continue
+            axis_arg = self._axis_arg(node)
+            if axis_arg is None:
+                continue
+            for lit in _str_literals(axis_arg):
+                if lit not in declared:
+                    findings.append(Finding(
+                        self.name, ctx.relpath, axis_arg.lineno,
+                        axis_arg.col_offset,
+                        f"collective axis {lit!r} is not declared by any "
+                        f"mesh/pmap/shard_map in this module (typo, or a "
+                        f"cross-module mesh contract that should be "
+                        f"threaded as a parameter)", self.severity))
+        return findings
+
+    def _axis_arg(self, call: ast.Call) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+        return None
+
+    def _declared(self, tree: ast.Module) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                leaf = fname.split(".")[-1] if fname else None
+                if leaf in _DECL_CALLS:
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            out.add(sub.value)
+                for kw in node.keywords:
+                    if kw.arg in _DECL_KWARGS:
+                        for sub in ast.walk(kw.value):
+                            if isinstance(sub, ast.Constant) \
+                                    and isinstance(sub.value, str):
+                                out.add(sub.value)
+            # axis_name="dp" style function-signature defaults document
+            # the module's expected axes
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                pos = args.posonlyargs + args.args
+                for p, d in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+                    if p.arg in _DECL_KWARGS or p.arg.startswith("axis"):
+                        for sub in ast.walk(d):
+                            if isinstance(sub, ast.Constant) \
+                                    and isinstance(sub.value, str):
+                                out.add(sub.value)
+        return out
+
+
+def _str_literals(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value

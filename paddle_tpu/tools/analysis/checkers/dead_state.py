@@ -1,0 +1,120 @@
+"""dead-state: instance attributes written but never read.
+
+The bug class behind ``OpDef.skip_dtypes_grad`` (a field nothing
+consumed) and ``ExponentialMovingAverage._step`` (a counter incremented
+forever, read never): state that LOOKS live invites someone to trust it.
+
+Scope is deliberately conservative to stay false-positive-free on a real
+tree:
+
+  * only ``self._private`` attributes (public attrs are API surface that
+    external code may read);
+  * a read anywhere in the whole PROJECT (scan root) keeps the attribute
+    alive — friend modules reading private state (e.g. quantization's
+    ``_ConvShim._stride`` consumed by ``qlayers``) and tests both count;
+  * the attribute name appearing as a string literal anywhere in the
+    project (getattr/hasattr/setattr introspection) keeps it alive;
+  * classes defining ``__getattr__``/``__getattribute__``/``__setattr__``
+    are skipped wholesale;
+  * an AugAssign (``self._n += 1``) counts as a WRITE only — the embedded
+    read feeds nothing but the write itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..findings import Finding, WARNING
+from .base import Checker
+
+
+class DeadStateChecker(Checker):
+    name = "dead-state"
+    severity = WARNING
+
+    def __init__(self):
+        self._index_root = None
+        self._index: Tuple[Set[str], Set[str]] = (set(), set())
+
+    def _project_mentions(self, ctx) -> Tuple[Set[str], Set[str]]:
+        """(attr reads, string literals) across every .py under the scan
+        root, built once per root and cached."""
+        if self._index_root == ctx.root:
+            return self._index
+        from ..walker import iter_py_files
+        reads: Set[str] = set()
+        strings: Set[str] = set()
+        for f in iter_py_files([ctx.root]):
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8",
+                                             errors="replace"))
+            except SyntaxError:
+                continue
+            r, s = _module_mentions(tree)
+            reads |= r
+            strings |= s
+        self._index_root = ctx.root
+        self._index = (reads, strings)
+        return self._index
+
+    def check(self, ctx) -> List[Finding]:
+        module_reads, module_strings = self._project_mentions(ctx)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if _has_attr_hooks(node):
+                continue
+            writes = _self_writes(node)
+            for attr, wnode in sorted(writes.items()):
+                if not attr.startswith("_") or attr.startswith("__"):
+                    continue
+                if attr in module_reads or attr in module_strings:
+                    continue
+                findings.append(Finding(
+                    self.name, ctx.relpath, wnode.lineno, wnode.col_offset,
+                    f"instance attribute {attr!r} of class {node.name} is "
+                    f"written but never read; dead state — delete it or "
+                    f"wire it to a consumer", self.severity))
+        return findings
+
+
+def _has_attr_hooks(cls: ast.ClassDef) -> bool:
+    for n in cls.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name in ("__getattr__", "__getattribute__",
+                               "__setattr__"):
+            return True
+    return False
+
+
+def _self_writes(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    """attr -> first write node, for self.attr assignment targets."""
+    writes: Dict[str, ast.AST] = {}
+    for n in ast.walk(cls):
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+            targets = [n.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, ast.Store) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id == "self":
+                    writes.setdefault(sub.attr, sub)
+    return writes
+
+
+def _module_mentions(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(attribute names READ anywhere in the module, string literals)."""
+    reads: Set[str] = set()
+    strings: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            reads.add(n.attr)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            strings.add(n.value)
+    return reads, strings

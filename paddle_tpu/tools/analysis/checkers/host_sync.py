@@ -1,0 +1,156 @@
+"""host-sync: device-to-host transfers inside hot paths.
+
+Hot paths (configurable; defaults below) are where a blocking transfer
+stalls the accelerator pipeline: Pallas kernel modules, the trainer's
+step builders, and the pipeline-schedule scan bodies.  Within them the
+checker flags:
+
+  * ``.item()`` / ``.tolist()`` — synchronous readback;
+  * ``.block_until_ready()`` — an explicit barrier (benchmarks belong in
+    bench harnesses, not library hot paths);
+  * ``jax.device_get(...)``;
+  * ``np.asarray/np.array/np.ascontiguousarray`` on a computed value —
+    a host copy (fine at module import or in data loading, not here);
+  * ``float()/int()/bool()`` wrapped directly around a ``jnp.``/``jax.``
+    computation or an indexed array — the classic "print the loss every
+    step" sync.
+
+Which functions count as hot: in ``kernels/`` every function; elsewhere
+only jit-traced functions and bodies passed to ``lax.scan`` /
+``fori_loop`` / ``while_loop`` / ``cond`` — module-level helpers and data
+prep in the same file stay free to touch the host.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional, Sequence, Set
+
+from ..findings import Finding, ERROR
+from .base import (Checker, dotted_name, jit_decorator_info,
+                   jitted_local_defs, param_names)
+
+DEFAULT_HOT_PATHS = (
+    "paddle_tpu/kernels/*.py",
+    "paddle_tpu/models/trainer.py",
+    "paddle_tpu/distributed/pipelining.py",
+)
+_ALL_FUNCTIONS_PATHS = ("paddle_tpu/kernels/*.py",)
+
+_LOOP_HOSTS = {"jax.lax.scan", "lax.scan", "jax.lax.while_loop",
+               "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
+               "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
+               "jax.lax.map", "lax.map"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_NP_COPY = {"asarray", "array", "ascontiguousarray"}
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    severity = ERROR
+
+    def __init__(self, hot_paths: Optional[Sequence[str]] = None,
+                 all_functions_paths: Optional[Sequence[str]] = None):
+        self.hot_paths = tuple(hot_paths or DEFAULT_HOT_PATHS)
+        self.all_fn_paths = tuple(
+            all_functions_paths
+            if all_functions_paths is not None else _ALL_FUNCTIONS_PATHS)
+
+    def check(self, ctx) -> List[Finding]:
+        if not any(fnmatch.fnmatch(ctx.relpath, pat) for pat in self.hot_paths):
+            return []
+        everything_hot = any(fnmatch.fnmatch(ctx.relpath, pat)
+                             for pat in self.all_fn_paths)
+        np_aliases = _numpy_aliases(ctx.tree)
+        wrapped = jitted_local_defs(ctx.tree)
+        loop_bodies = _loop_body_names(ctx.tree)
+
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            hot = (everything_hot
+                   or jit_decorator_info(node) is not None
+                   or node.name in wrapped
+                   or node.name in loop_bodies)
+            if not hot:
+                continue
+            self._scan_fn(ctx, node, np_aliases, findings)
+        return findings
+
+    def _scan_fn(self, ctx, fn, np_aliases, findings):
+        emit = lambda node, msg: findings.append(
+            Finding(self.name, ctx.relpath, node.lineno, node.col_offset,
+                    msg, self.severity))
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = dotted_name(sub.func)
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _SYNC_METHODS:
+                # ".item" etc. on a module (np.asarray handled below), not
+                # on np itself — receivers that are plain numpy aliases
+                # are host-side already
+                recv = dotted_name(sub.func.value)
+                if recv not in np_aliases:
+                    emit(sub, f".{sub.func.attr}() is a blocking "
+                              f"device->host sync in a hot path")
+                continue
+            if fname in _DEVICE_GET:
+                emit(sub, "jax.device_get in a hot path is a blocking "
+                          "device->host transfer")
+                continue
+            if fname is not None and "." in fname:
+                root, leaf = fname.split(".", 1)
+                if root in np_aliases and leaf in _NP_COPY \
+                        and _has_nonliteral_arg(sub):
+                    emit(sub, f"{fname}() copies a computed value to host "
+                              f"in a hot path; use jnp.{leaf} to stay on "
+                              f"device")
+                    continue
+            if fname in _CONCRETIZERS and sub.args \
+                    and _is_device_expr(sub.args[0]):
+                emit(sub, f"{fname}() around a device computation forces "
+                          f"a host sync in a hot path")
+        return findings
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _loop_body_names(tree: ast.Module) -> Set[str]:
+    """Local function names passed (positionally) to lax loop primitives."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _LOOP_HOSTS:
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+def _has_nonliteral_arg(call: ast.Call) -> bool:
+    return any(not isinstance(a, ast.Constant) for a in call.args)
+
+
+def _is_device_expr(node: ast.AST) -> bool:
+    """Does the expression textually involve a jnp./jax. computation —
+    i.e. is the float() almost certainly wrapping a device value rather
+    than a Python scalar?  (Bare names and host-side subscripts like a
+    flags dict stay out of scope — the tracer-leak rule owns taint.)"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func)
+            if d is not None and d.split(".")[0] in ("jnp", "jax"):
+                return True
+    return False

@@ -1,0 +1,145 @@
+"""recompile-hazard: patterns that defeat jit's compilation cache.
+
+Sub-rules:
+
+  * jit-in-loop — a ``jax.jit(...)`` / ``partial(jax.jit, ...)`` call in a
+    ``for``/``while`` body creates a NEW wrapped callable every iteration,
+    so every call recompiles;
+  * jit-of-lambda — ``jax.jit(lambda ...: ...)`` inside a function body:
+    a fresh lambda object per invocation, same cache miss.  The memoized
+    idiom ``if self._f is None: self._f = jax.jit(lambda ...)`` is exempt
+    — the lambda is built once per instance;
+  * unhashable-static — a param named by static_argnums/static_argnames
+    whose default is a list/dict/set: static args key the compile cache by
+    hash, and an unhashable default throws at first call (a hashable but
+    mutable-by-convention spec recompiles per distinct value);
+  * shape-loop — a Python ``for`` over ``range(... .shape ...)`` inside a
+    ``@to_static`` body: the loop unrolls at trace time and retraces for
+    every new input shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..findings import Finding, WARNING
+from .base import (Checker, dotted_name, is_to_static_decorated,
+                   jit_decorator_info, static_params, JIT_NAMES,
+                   _partial_of_jit)
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (dotted_name(node.func) in JIT_NAMES
+                 or _partial_of_jit(node) is not None))
+
+
+class RecompileChecker(Checker):
+    name = "recompile-hazard"
+    severity = WARNING
+
+    def check(self, ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        emit = lambda node, msg: findings.append(
+            Finding(self.name, ctx.relpath, node.lineno, node.col_offset,
+                    msg, self.severity))
+
+        for node in ast.walk(ctx.tree):
+            # (a) jit construction inside a loop body
+            if isinstance(node, (ast.For, ast.While)):
+                for sub in ast.walk(node):
+                    if sub is node:
+                        continue
+                    if _is_jit_call(sub):
+                        emit(sub, "jax.jit called inside a loop builds a "
+                                  "new callable (and compile-cache entry) "
+                                  "every iteration; hoist the jit out")
+            # (b) jit of an inline lambda inside a function
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                memoized = _memoized_jit_calls(node)
+                for sub in ast.walk(node):
+                    if (_is_jit_call(sub) and sub.args
+                            and isinstance(sub.args[0], ast.Lambda)
+                            and id(sub) not in memoized):
+                        emit(sub, "jax.jit(lambda ...) inside a function "
+                                  "creates a fresh callable per call — "
+                                  "every invocation recompiles; define the "
+                                  "function once at module/class scope")
+                # (c) unhashable static-arg defaults
+                jit_info = jit_decorator_info(node)
+                if jit_info is not None:
+                    statics = static_params(node, jit_info)
+                    defaults = _default_map(node)
+                    for pname in sorted(statics):
+                        d = defaults.get(pname)
+                        if d is not None and _is_mutable_literal(d):
+                            emit(d, f"static arg {pname!r} has an "
+                                    f"unhashable {type(d).__name__.lower()} "
+                                    f"default; static args must be "
+                                    f"hashable (use a tuple)")
+                # (d) shape-dependent Python loop in to_static bodies
+                if is_to_static_decorated(node):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.For) and _range_over_shape(sub.iter):
+                            emit(sub, "Python loop over a traced shape in a "
+                                      "@to_static body unrolls at trace "
+                                      "time and retraces per input shape; "
+                                      "use lax.fori_loop/scan")
+        return findings
+
+
+def _memoized_jit_calls(fn) -> set:
+    """ids of jit Call nodes inside the build-once idiom
+    ``if <target> is None: <target> = jax.jit(...)`` — those construct the
+    callable once per instance/module, not once per invocation."""
+    out = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        if not (isinstance(t, ast.Compare) and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Is)
+                and isinstance(t.comparators[0], ast.Constant)
+                and t.comparators[0].value is None):
+            continue
+        guard = ast.unparse(t.left)
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) \
+                    and any(ast.unparse(tg) == guard
+                            for tg in stmt.targets):
+                for sub in ast.walk(stmt.value):
+                    if _is_jit_call(sub):
+                        out.add(id(sub))
+    return out
+
+
+def _default_map(fn):
+    """param name -> default expr node (positional + kw-only)."""
+    out = {}
+    pos = fn.args.posonlyargs + fn.args.args
+    for p, d in zip(pos[len(pos) - len(fn.args.defaults):], fn.args.defaults):
+        out[p.arg] = d
+    for p, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in {"list", "dict", "set"}
+    return False
+
+
+def _range_over_shape(iter_node: ast.AST) -> bool:
+    if not (isinstance(iter_node, ast.Call)
+            and dotted_name(iter_node.func) == "range"):
+        return False
+    for a in iter_node.args:
+        for sub in ast.walk(a):
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                return True
+    return False

@@ -1,0 +1,160 @@
+"""tracer-leak: concretization hazards inside jit-traced functions.
+
+Flags, within a function that is jit-traced (``@jax.jit``, ``@partial(
+jax.jit, ...)``, or later wrapped as ``g = jax.jit(f)``):
+
+  * ``float()/int()/bool()/complex()`` applied to a value derived from a
+    traced parameter (raises TracerConversionError at trace time, or —
+    worse — silently freezes a value if tracing is bypassed);
+  * ``.item()`` / ``.tolist()`` on such a value;
+  * ``np.asarray`` / ``np.array`` on such a value (host round-trip that
+    breaks tracing);
+  * ``jax.device_get`` on such a value;
+  * Python ``if`` / ``while`` / ``assert`` branching on such a value
+    (data-dependent control flow must go through ``lax.cond`` /
+    ``jnp.where``).
+
+Taint = function params minus static_argnums/static_argnames; assignments
+propagate it; ``.shape``/``.dtype``/``len()``/``is None`` etc. break it
+(those are static at trace time).  The analysis is intraprocedural and
+order-insensitive within branches (a union over both arms).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..findings import Finding, ERROR
+from .base import (Checker, assigned_names, dotted_name, expr_tainted,
+                   jit_decorator_info, jitted_local_defs, param_names,
+                   static_params)
+
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+class TracerLeakChecker(Checker):
+    name = "tracer-leak"
+    severity = ERROR
+
+    def check(self, ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        np_aliases = _numpy_aliases(ctx.tree)
+        wrapped = jitted_local_defs(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jit_info = jit_decorator_info(node)
+            if jit_info is None and node.name not in wrapped:
+                continue
+            taint = set(param_names(node)) - static_params(node, jit_info)
+            self._scan(ctx, node.body, taint, np_aliases, findings)
+        return findings
+
+    # ---------------------------------------------------------- body scan
+    def _scan(self, ctx, body, taint: Set[str], np_aliases, findings):
+        for stmt in body:
+            self._stmt(ctx, stmt, taint, np_aliases, findings)
+
+    def _stmt(self, ctx, stmt, taint, np_aliases, findings):
+        emit = lambda node, msg: findings.append(
+            Finding(self.name, ctx.relpath, node.lineno, node.col_offset,
+                    msg, self.severity))
+
+        # sinks inside any expressions of this statement
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue  # nested callables handled separately below
+            if isinstance(sub, ast.Call):
+                self._call_sink(ctx, sub, taint, np_aliases, emit)
+
+        if isinstance(stmt, ast.Assign):
+            tainted_rhs = expr_tainted(stmt.value, taint)
+            for t in stmt.targets:
+                for name in assigned_names(t):
+                    (taint.add if tainted_rhs else taint.discard)(name)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            tainted_rhs = expr_tainted(stmt.value, taint)
+            for name in assigned_names(stmt.target):
+                (taint.add if tainted_rhs else taint.discard)(name)
+        elif isinstance(stmt, ast.AugAssign):
+            if expr_tainted(stmt.value, taint):
+                for name in assigned_names(stmt.target):
+                    taint.add(name)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            kind = "if" if isinstance(stmt, ast.If) else "while"
+            if expr_tainted(stmt.test, taint):
+                emit(stmt, f"Python `{kind}` on a traced value; use "
+                           f"lax.cond/jnp.where (or mark the arg static)")
+            self._scan(ctx, stmt.body, taint, np_aliases, findings)
+            self._scan(ctx, stmt.orelse, taint, np_aliases, findings)
+        elif isinstance(stmt, ast.Assert):
+            if expr_tainted(stmt.test, taint):
+                emit(stmt, "assert on a traced value concretizes it at "
+                           "trace time; use checkify or a host-side check")
+        elif isinstance(stmt, ast.For):
+            # iterating a tainted PYTREE (dict of arrays) is legal; only
+            # propagate taint to the loop targets, don't flag the loop
+            if expr_tainted(stmt.iter, taint):
+                for name in assigned_names(stmt.target):
+                    taint.add(name)
+            else:
+                for name in assigned_names(stmt.target):
+                    taint.discard(name)
+            self._scan(ctx, stmt.body, taint, np_aliases, findings)
+            self._scan(ctx, stmt.orelse, taint, np_aliases, findings)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    tainted = expr_tainted(item.context_expr, taint)
+                    for name in assigned_names(item.optional_vars):
+                        (taint.add if tainted else taint.discard)(name)
+            self._scan(ctx, stmt.body, taint, np_aliases, findings)
+        elif isinstance(stmt, ast.Try):
+            self._scan(ctx, stmt.body, taint, np_aliases, findings)
+            for h in stmt.handlers:
+                self._scan(ctx, h.body, taint, np_aliases, findings)
+            self._scan(ctx, stmt.orelse, taint, np_aliases, findings)
+            self._scan(ctx, stmt.finalbody, taint, np_aliases, findings)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def (scan body / helper): closure taint applies, the
+            # nested params shadow it
+            inner = set(taint) - set(param_names(stmt))
+            self._scan(ctx, stmt.body, inner, np_aliases, findings)
+
+    def _call_sink(self, ctx, call: ast.Call, taint, np_aliases, emit):
+        fname = dotted_name(call.func)
+        args = list(call.args) + [k.value for k in call.keywords]
+        any_tainted = any(expr_tainted(a, taint) for a in args)
+        if fname in _CONCRETIZERS and any_tainted:
+            emit(call, f"{fname}() concretizes a traced value inside a "
+                       f"jit-traced function")
+            return
+        if fname in _DEVICE_GET and any_tainted:
+            emit(call, "jax.device_get inside a jit-traced function")
+            return
+        if fname is not None and "." in fname:
+            root, leaf = fname.split(".", 1)
+            if root in np_aliases and leaf in ("asarray", "array") \
+                    and any_tainted:
+                emit(call, f"{fname}() forces a host transfer of a traced "
+                           f"value; use jnp.{leaf} or keep it on device")
+                return
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _SYNC_METHODS \
+                and expr_tainted(call.func.value, taint):
+            emit(call, f".{call.func.attr}() on a traced value inside a "
+                       f"jit-traced function")

@@ -1,0 +1,32 @@
+"""Finding model for graftlint.
+
+A finding is one diagnostic anchored to a file:line.  Severity is
+informational layering only — the CI gate treats EVERY unsuppressed
+finding as fatal (tests/test_static_analysis.py), so severities exist to
+help a human triage a long report, not to let warnings rot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Dict
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # checker id, e.g. "tracer-leak"
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    message: str
+    severity: str = ERROR
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity}[{self.rule}] {self.message}")
+
+    def to_json(self) -> Dict:
+        return asdict(self)

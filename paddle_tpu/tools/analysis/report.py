@@ -1,0 +1,33 @@
+"""Text / JSON reporters for graftlint results."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+from .walker import AnalysisResult
+
+
+def format_text(result: AnalysisResult, verbose: bool = False) -> str:
+    lines = [f.format() for f in result.findings]
+    if verbose and result.suppressed:
+        lines.append("")
+        lines.append(f"-- {len(result.suppressed)} suppressed:")
+        lines.extend("   " + f.format() for f in result.suppressed)
+    by_rule = Counter(f.rule for f in result.findings)
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items())) or "clean"
+    lines.append("")
+    lines.append(
+        f"graftlint: {result.files_scanned} files, "
+        f"{len(result.findings)} findings "
+        f"({summary}), {len(result.suppressed)} suppressed")
+    return "\n".join(lines)
+
+
+def format_json(result: AnalysisResult) -> str:
+    return json.dumps({
+        "files_scanned": result.files_scanned,
+        "findings": [f.to_json() for f in result.findings],
+        "suppressed": [f.to_json() for f in result.suppressed],
+        "ok": result.ok,
+    }, indent=2)

@@ -1,0 +1,104 @@
+"""Suppression comments for graftlint.
+
+Syntax (the ``-- reason`` is MANDATORY — an undocumented suppression is
+itself reported under the ``bad-suppression`` rule):
+
+    x = float(loss)  # graftlint: disable=tracer-leak -- eval loop, host sync intended
+
+    # graftlint: disable-next=host-sync -- one-shot init readback
+    n = int(count)
+
+    # graftlint: disable-file=axis-name -- axes come from the caller's mesh
+
+``disable``       suppresses the named rule(s) on ITS line.
+``disable-next``  suppresses them on the following line.
+``disable-file``  suppresses them for the whole file (top-of-file audit
+                  trail; use sparingly).
+
+Rule lists are comma-separated; ``all`` matches every rule.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .findings import Finding, ERROR
+
+_PAT = re.compile(
+    r"#\s*graftlint:\s*(?P<kind>disable(?:-next|-file)?)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclass
+class Suppressions:
+    """Parsed suppression directives for one file."""
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)   # 1-based
+    file_wide: Set[str] = field(default_factory=set)
+    # findings about malformed directives (missing reason, empty rules)
+    errors: List[Finding] = field(default_factory=list)
+    # (line, rules) of every well-formed directive, for audit/unused checks
+    directives: List[Tuple[int, Set[str]]] = field(default_factory=list)
+
+    def matches(self, finding: Finding) -> bool:
+        rules = self.by_line.get(finding.line, set()) | self.file_wide
+        return finding.rule in rules or "all" in rules
+
+
+def _iter_comments(src: str) -> Iterable[Tuple[int, str]]:
+    """(lineno, comment text) for every real COMMENT token — docstrings
+    and string literals that merely MENTION the directive syntax never
+    count.  Falls back to a line scan if the file does not tokenize."""
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        for lineno, line in enumerate(src.splitlines(), start=1):
+            if "#" in line:
+                yield lineno, line[line.index("#"):]
+        return
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            yield tok.start[0], tok.string
+
+
+def parse_suppressions(path: str, src: str) -> Suppressions:
+    sup = Suppressions()
+    for lineno, line in _iter_comments(src):
+        m = _PAT.search(line)
+        if m is None:
+            # catch directives that LOOK like graftlint markers but do not
+            # parse (e.g. missing '=') so a typo cannot silently disable
+            # nothing while the author believes the rule is off
+            if re.search(r"#\s*graftlint:", line):
+                sup.errors.append(Finding(
+                    "bad-suppression", path, lineno, 0,
+                    "unparseable graftlint directive; expected "
+                    "'# graftlint: disable[-next|-file]=<rules> -- reason'",
+                    ERROR))
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        reason = m.group("reason")
+        if not rules:
+            sup.errors.append(Finding(
+                "bad-suppression", path, lineno, 0,
+                "graftlint directive names no rules", ERROR))
+            continue
+        if not reason:
+            sup.errors.append(Finding(
+                "bad-suppression", path, lineno, 0,
+                "graftlint suppression without a reason; append "
+                "' -- <why this is safe>'", ERROR))
+            continue
+        kind = m.group("kind")
+        if kind == "disable-file":
+            sup.file_wide |= rules
+        elif kind == "disable-next":
+            sup.by_line.setdefault(lineno + 1, set()).update(rules)
+        else:
+            sup.by_line.setdefault(lineno, set()).update(rules)
+        sup.directives.append((lineno, rules))
+    return sup

@@ -1,0 +1,104 @@
+"""File discovery + checker driver for graftlint.
+
+``run_analysis(paths)`` walks every ``.py`` file under the given paths,
+parses it once, hands the tree to each checker, and filters findings
+through the file's suppression directives.  Nothing is imported — the
+analysis is robust to modules that need an accelerator to import.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .findings import Finding, ERROR
+from .suppress import Suppressions, parse_suppressions
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+
+@dataclass
+class FileContext:
+    root: str          # scan root (absolute)
+    path: str          # absolute file path
+    relpath: str       # posix path relative to root — used in findings
+    src: str
+    tree: ast.Module
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)   # unsuppressed
+    suppressed: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    yield f
+
+
+def run_analysis(paths: Sequence[str], checkers: Sequence = None,
+                 root: Optional[str] = None,
+                 rules: Optional[Sequence[str]] = None) -> AnalysisResult:
+    """Run ``checkers`` over every python file under ``paths``.
+
+    ``root`` anchors the relative paths used in findings and suppression
+    matching; it defaults to the common parent of the scan paths' repo
+    (the cwd).  ``rules`` optionally restricts to a subset of rule names.
+    """
+    if checkers is None:
+        from .checkers import default_checkers
+        checkers = default_checkers()
+    if rules:
+        wanted = set(rules)
+        checkers = [c for c in checkers if c.name in wanted]
+    root_path = Path(root) if root else Path.cwd()
+    root_str = str(root_path.resolve())
+
+    result = AnalysisResult()
+    raw: List[Finding] = []
+    sup_by_path: Dict[str, Suppressions] = {}
+
+    for f in iter_py_files(paths):
+        fabs = f.resolve()
+        try:
+            rel = fabs.relative_to(root_str).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        src = fabs.read_text(encoding="utf-8", errors="replace")
+        sup = parse_suppressions(rel, src)
+        sup_by_path[rel] = sup
+        raw.extend(sup.errors)       # malformed directives are findings
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            raw.append(Finding("parse-error", rel, e.lineno or 1, 0,
+                               f"syntax error: {e.msg}", ERROR))
+            result.files_scanned += 1
+            continue
+        ctx = FileContext(root=root_str, path=str(fabs), relpath=rel,
+                          src=src, tree=tree)
+        for checker in checkers:
+            raw.extend(checker.check(ctx))
+        result.files_scanned += 1
+
+    for finding in sorted(raw, key=lambda x: (x.path, x.line, x.rule)):
+        sup = sup_by_path.get(finding.path)
+        if sup is not None and sup.matches(finding):
+            result.suppressed.append(finding)
+        else:
+            result.findings.append(finding)
+    return result

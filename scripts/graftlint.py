@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""graftlint CLI — JAX/TPU-aware static analysis over the repo.
+
+Usage:
+    python scripts/graftlint.py [paths...]        # default: paddle_tpu
+    python scripts/graftlint.py --json paddle_tpu
+    python scripts/graftlint.py --rule tracer-leak paddle_tpu
+    python scripts/graftlint.py --list-rules
+
+Exit code 0 iff there are zero unsuppressed findings (the CI contract —
+tests/test_static_analysis.py pins this over paddle_tpu/).
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load paddle_tpu/tools/analysis WITHOUT importing the paddle_tpu
+    package: ``import paddle_tpu.tools.analysis`` would execute the whole
+    framework __init__ (jax included), so a broken tree — exactly what a
+    linter must be able to diagnose — would crash the linter itself.  The
+    analysis package is pure relative imports, so it loads standalone."""
+    pkg_dir = os.path.join(ROOT, "paddle_tpu", "tools", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["graftlint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_analysis = _load_analysis()
+default_checkers = _analysis.default_checkers
+format_json = _analysis.format_json
+format_text = _analysis.format_text
+run_analysis = _analysis.run_analysis
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                    help="files/directories to scan (default: paddle_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--rule", action="append", dest="rules", default=None,
+                    metavar="RULE", help="run only the named rule(s)")
+    ap.add_argument("--verbose", "-v", action="store_true",
+                    help="also list suppressed findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for c in default_checkers():
+            doc = (sys.modules[type(c).__module__].__doc__ or "").strip()
+            first = doc.splitlines()[0] if doc else ""
+            print(f"{c.name:20s} [{c.severity}] {first}")
+        return 0
+
+    paths = [p if os.path.isabs(p) else os.path.join(ROOT, p)
+             for p in args.paths]
+    result = run_analysis(paths, root=ROOT, rules=args.rules)
+    print(format_json(result) if args.as_json
+          else format_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

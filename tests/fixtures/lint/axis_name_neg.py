@@ -1,0 +1,15 @@
+"""NEGATIVE fixture: declared or parameterized axis names — ZERO findings."""
+import jax
+from jax.sharding import Mesh
+
+
+def build_mesh(devices):
+    return Mesh(devices, ("dp", "mp"))
+
+
+def good_psum(x):
+    return jax.lax.psum(x, "dp")        # declared by the Mesh above
+
+
+def param_axis(x, axis_name="mp"):
+    return jax.lax.psum(x, axis_name)   # non-literal axis — caller owns it

@@ -1,0 +1,10 @@
+"""POSITIVE fixture: collective axis names no mesh in this module declares."""
+import jax
+
+
+def bad_psum(x):
+    return jax.lax.psum(x, "dp")        # (1) 'dp' declared nowhere here
+
+
+def bad_gather(x):
+    return jax.lax.all_gather(x, "mp")  # (2) 'mp' declared nowhere here

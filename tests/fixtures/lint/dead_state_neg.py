@@ -1,0 +1,30 @@
+"""NEGATIVE fixture: private state that IS consumed — ZERO findings."""
+
+
+class Ema:
+    def __init__(self, decay):
+        self._decay = decay
+        self._shadow = None
+
+    def update(self, value):
+        if self._shadow is None:
+            self._shadow = value
+        self._shadow = (self._decay * self._shadow
+                        + (1 - self._decay) * value)
+        return self._shadow
+
+
+class Introspected:
+    def __init__(self):
+        self._hint = "cache"
+
+    def get(self):
+        return getattr(self, "_hint")   # string-literal access keeps it alive
+
+
+class Hooked:
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def __init__(self):
+        self._managed_elsewhere = 1     # attr-hook classes are skipped

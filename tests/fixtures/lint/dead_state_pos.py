@@ -1,0 +1,12 @@
+"""POSITIVE fixture: a private attribute written everywhere, read nowhere."""
+
+
+class Accumulator:
+    def __init__(self):
+        self.total = 0.0
+        self._zzq_dead_count = 0        # write-only counter: finding
+
+    def add(self, v):
+        self.total = self.total + v
+        self._zzq_dead_count += 1       # AugAssign is still write-only
+        return self.total

@@ -1,0 +1,17 @@
+"""NEGATIVE fixture: host work OUTSIDE hot functions — ZERO findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def pure_step(params, batch):
+    return jnp.mean(batch)              # stays on device
+
+
+def load_batch(raw):
+    return np.asarray(raw, dtype=np.float32)    # data prep, not a hot fn
+
+
+def summarize(history):
+    return float(np.mean(history))      # host-side metrics helper

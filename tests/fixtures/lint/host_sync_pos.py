@@ -1,0 +1,25 @@
+"""POSITIVE fixture: host-sync findings (scanned as a configured hot path)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def train_step(params, batch):
+    loss = jnp.mean(batch)
+    print(float(jnp.mean(batch)))       # (1) float(jnp...) forces a sync
+    return loss.item()                  # (2) blocking .item() readback
+
+
+@jax.jit
+def fetch(x):
+    return jax.device_get(x)            # (3) device->host transfer
+
+
+def scan_body(carry, x):
+    host = np.asarray(x)                # (4) host copy of a computed value
+    return carry, host
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)

@@ -1,0 +1,27 @@
+"""NEGATIVE fixture: cache-friendly jit idioms — ZERO findings."""
+import jax
+from functools import partial
+
+_jitted = jax.jit(lambda v: v * 2)      # module scope: built exactly once
+
+
+@partial(jax.jit, static_argnames=("dims",))
+def hashable_static(x, dims=(0, 1)):    # tuple default — hashable cache key
+    return x.sum(dims)
+
+
+class Stepper:
+    def __init__(self):
+        self._fn = None
+
+    def step(self, x):
+        if self._fn is None:            # memoized build-once idiom is exempt
+            self._fn = jax.jit(lambda v: v + 1)
+        return self._fn(x)
+
+
+def loop_calls_prebuilt(xs):
+    out = []
+    for x in xs:
+        out.append(_jitted(x))          # CALLING a jitted fn in a loop is fine
+    return out

@@ -1,0 +1,27 @@
+"""POSITIVE fixture: every numbered construct must trip recompile-hazard."""
+import jax
+from functools import partial
+
+
+def jit_in_loop(fns, x):
+    outs = []
+    for f in fns:
+        outs.append(jax.jit(f)(x))      # (1) fresh callable every iteration
+    return outs
+
+
+def jit_of_lambda(x):
+    return jax.jit(lambda v: v * 2)(x)  # (2) fresh lambda per invocation
+
+
+@partial(jax.jit, static_argnames=("dims",))
+def unhashable_static(x, dims=[0, 1]):  # (3) list default on a static arg
+    return x.sum(dims)
+
+
+@to_static                              # noqa: F821 — AST-only fixture
+def shape_loop(x):
+    acc = 0.0
+    for i in range(x.shape[0]):         # (4) unrolls + retraces per shape
+        acc = acc + x[i]
+    return acc

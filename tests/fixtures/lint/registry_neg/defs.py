@@ -1,0 +1,7 @@
+"""Mini op registry in sync with its surface: ZERO findings (the one
+unreferenced public function is allow-listed by the test)."""
+
+OPS = {
+    "abs": T.abs,                   # noqa: F821 — AST-only fixture
+    "vecdot": T.linalg.vecdot,      # noqa: F821
+}

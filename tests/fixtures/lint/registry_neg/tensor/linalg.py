@@ -1,0 +1,2 @@
+def vecdot(a, b):
+    return a @ b

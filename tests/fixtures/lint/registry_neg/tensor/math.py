@@ -1,0 +1,6 @@
+def abs(x):  # noqa: A001 — mirrors the real T.abs surface
+    return x
+
+
+def allowed_extra(x):               # exempted via the test's allowlist
+    return x

@@ -1,0 +1,9 @@
+"""Mini op registry WITH drift: one ref resolves to nothing, and the
+surface below has one public function that is neither referenced here
+nor allow-listed."""
+
+OPS = {
+    "abs": T.abs,                   # noqa: F821 — AST-only fixture
+    "vecdot": T.linalg.vecdot,      # noqa: F821
+    "missing": T.missing_op,        # noqa: F821 — (1) resolves to nothing
+}

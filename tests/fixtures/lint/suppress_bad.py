@@ -1,0 +1,7 @@
+"""A reasonless directive: bad-suppression fires AND the finding stays live."""
+import jax
+
+
+@jax.jit
+def probe(x):
+    return float(x)  # graftlint: disable=tracer-leak

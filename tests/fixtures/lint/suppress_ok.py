@@ -1,0 +1,7 @@
+"""A documented suppression: the finding must land in the SUPPRESSED list."""
+import jax
+
+
+@jax.jit
+def debug_probe(x):
+    return float(x)  # graftlint: disable=tracer-leak -- fixture: exercises the suppression syntax end-to-end

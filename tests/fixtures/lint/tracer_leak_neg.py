@@ -1,0 +1,28 @@
+"""NEGATIVE fixture: tracer-safe idioms that must produce ZERO findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def shape_is_static(x):
+    if x.shape[0] > 1:                  # .shape is a Python value at trace time
+        return x * 2
+    return x
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def static_branch(x, flag):
+    if flag:                            # static arg — branching is legal
+        return x + 1
+    return x
+
+
+@jax.jit
+def where_not_if(x):
+    return jnp.where(x > 0, x, -x)      # data-dependent select stays on device
+
+
+def host_helper(arr):
+    return float(np.asarray(arr).mean())    # never jit-traced — host code is free

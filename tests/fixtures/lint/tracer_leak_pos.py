@@ -1,0 +1,33 @@
+"""POSITIVE fixture: every numbered construct must trip tracer-leak.
+
+Never imported — parsed by tests/test_static_analysis.py only.
+"""
+import jax
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def leaks_float(x):
+    return float(x) + 1.0               # (1) float() on a traced param
+
+
+@partial(jax.jit, static_argnames=("n",))
+def leaks_branch(x, n):
+    y = x * 2
+    if y > 0:                           # (2) Python `if` on a traced value
+        return y
+    return -y
+
+
+def wrapped_later(x):
+    return np.asarray(x)                # (3) host round-trip of a traced value
+
+
+wrapped = jax.jit(wrapped_later)
+
+
+@jax.jit
+def leaks_item(x):
+    s = x.sum()
+    return s.item()                     # (4) .item() on a traced value

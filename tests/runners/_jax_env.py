@@ -1,0 +1,37 @@
+"""Shared CPU-mesh bring-up for the multi-process runner scripts.
+
+Each launcher-spawned worker needs its OWN per-process device count,
+independent of whatever XLA_FLAGS the pytest parent exported, on both
+jax pins (>= 0.5: jax_num_cpu_devices config; < 0.5: the
+--xla_force_host_platform_device_count flag read at backend init).
+Import this module's ``setup_cpu_devices(n)`` BEFORE any jax array or
+device call — the runner directory is on sys.path because the worker is
+executed as a script.
+"""
+
+import os
+import re
+
+
+def setup_cpu_devices(n: int) -> None:
+    # REPLACE any inherited device-count flag rather than appending: the
+    # pytest parent exports count=8 and the last flag does not reliably
+    # win across jaxlib versions
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = \
+        (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        pass  # jax < 0.5: the XLA_FLAGS replacement above sets the count
+    try:
+        # jax < 0.5 CPU cross-process computations need the gloo
+        # collectives implementation selected explicitly
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+    import jax.extend.backend as jeb
+    jeb.clear_backends()

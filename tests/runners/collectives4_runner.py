@@ -8,11 +8,9 @@ all_reduce were unexercised — SURVEY.md §2.3 "Communication API").
 import os
 import sys
 
+from _jax_env import setup_cpu_devices
+setup_cpu_devices(1)
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
-import jax.extend.backend as jeb
-jeb.clear_backends()
 
 sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
 

@@ -15,11 +15,9 @@ multi-process; `global_rank = 0` would have been the first casualty.
 import os
 import sys
 
+from _jax_env import setup_cpu_devices
+setup_cpu_devices(4)
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
-import jax.extend.backend as jeb
-jeb.clear_backends()
 
 sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
 

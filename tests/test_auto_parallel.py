@@ -354,7 +354,10 @@ def test_engine_save_load_roundtrip(tmp_path):
     e.fit(data, epochs=1)
     path = str(tmp_path / "ckpt.pdparams")
     e.save(path)
-    trained = {k: np.asarray(v) for k, v in e.state_dict().items()}
+    # np.array(copy=True), NOT np.asarray: on the CPU backend np.asarray
+    # can be a zero-copy view of the device buffer, and the next fit()
+    # DONATES that buffer — the snapshot would silently mutate in place
+    trained = {k: np.array(v, copy=True) for k, v in e.state_dict().items()}
     e.fit(data, epochs=1)  # move away from saved state
     e.load(path)
     for k, v in e.state_dict().items():

@@ -142,3 +142,41 @@ def test_llama_chunked_loss_matches_loss():
     dense = float(m.loss(x, y))
     chunked = float(m.chunked_loss(x, y, n_chunks=4))
     assert abs(dense - chunked) < 1e-4, (dense, chunked)
+
+
+def test_out_of_range_labels_chunked_matches_dense():
+    """Out-of-range labels (not ignore_index) clamp to [0, V-1] on BOTH
+    paths — before the fix the chunked path silently returned loss = lse
+    (picked nothing) while the dense path clamped via take_along_axis:
+    two different wrong answers for the same invalid input (ADVICE r5)."""
+    rs = np.random.RandomState(9)
+    N, h, V = 10, 8, 20
+    hidden = jnp.asarray(rs.randn(N, h), jnp.float32)
+    weight = jnp.asarray(rs.randn(V, h) * 0.2, jnp.float32)
+    labels = np.asarray(rs.randint(0, V, (N,)))
+    labels[1] = V + 3          # just past the vocab end -> clamps to V - 1
+    labels[4] = 250            # far past -> V - 1
+    labels[6] = -7             # negative but NOT ignore_index -> clamps to 0
+    labels[8] = -100           # ignore_index stays masked to zero loss
+    lbl = jnp.asarray(labels)
+
+    chunked = chunked_softmax_cross_entropy(hidden, weight, lbl, n_chunks=4)
+    dense = chunked_softmax_cross_entropy(hidden, weight, lbl, n_chunks=1)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=1e-5, atol=1e-6)
+    assert float(chunked[8]) == 0.0
+
+    # both agree with an explicitly clamped dense oracle on non-ignored rows
+    ref = _dense_ce(hidden, weight,
+                    jnp.clip(jnp.where(lbl == -100, 0, lbl), 0, V - 1))
+    keep = labels != -100
+    np.testing.assert_allclose(np.asarray(chunked)[keep],
+                               np.asarray(ref)[keep], rtol=1e-5)
+
+    # and the custom-vjp chunked gradient matches the dense-path gradient
+    gc = jax.grad(lambda hd: jnp.sum(chunked_softmax_cross_entropy(
+        hd, weight, lbl, n_chunks=4)))(hidden)
+    gd = jax.grad(lambda hd: jnp.sum(chunked_softmax_cross_entropy(
+        hd, weight, lbl, n_chunks=1)))(hidden)
+    np.testing.assert_allclose(np.asarray(gc), np.asarray(gd),
+                               rtol=1e-5, atol=1e-6)

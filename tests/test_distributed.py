@@ -74,7 +74,7 @@ def test_eager_allgather_and_reduce_scatter():
 
 
 def test_collectives_inside_shard_map():
-    from jax import shard_map
+    from paddle_tpu.distributed._jax_compat import shard_map
     g = dist.new_group(list(range(8)))
     mesh = g.mesh
 

@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+from conftest import requires_modern_jax
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -38,6 +40,7 @@ def test_example_train_gnn():
     assert "sampled-subgraph forward" in out
 
 
+@requires_modern_jax
 def test_example_train_gpt_hybrid():
     out = _run("train_gpt_hybrid.py", "--dp", "1", "--mp", "2", "--pp", "2",
                "--steps", "3", "--batch", "4", "--seq", "32")
@@ -50,6 +53,7 @@ def test_example_train_llama_semi_auto():
     assert "loss" in out.lower(), out[-400:]
 
 
+@requires_modern_jax
 def test_example_train_moe_ep():
     out = _run("train_moe_ep.py", "--ep", "2", "--pp", "2", "--sharding",
                "1", "--steps", "2", "--batch", "4", "--seq", "16")

@@ -9,9 +9,12 @@ import sys
 
 import pytest
 
+from conftest import requires_modern_jax
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@requires_modern_jax
 @pytest.mark.parametrize("n", [8, 16])
 def test_dryrun_multichip(n):
     env = dict(os.environ)

@@ -13,6 +13,8 @@ import textwrap
 
 import pytest
 
+from conftest import requires_modern_jax
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -228,9 +230,19 @@ def test_elastic_remesh_restart(tmp_path, start_n, end_n):
         n = int(os.environ.get("PADDLE_ELASTIC_DEVICE_COUNT", "%START%"))
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=[0-9]+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = \\
+            (flags + " --xla_force_host_platform_device_count=%d" % n).strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except AttributeError:
+            pass  # jax < 0.5: the XLA_FLAGS line above sets the count
+        import jax.extend.backend as _jeb
+        _jeb.clear_backends()
         jax.config.update("jax_default_matmul_precision", "highest")
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -320,6 +332,7 @@ def test_elastic_remesh_restart(tmp_path, start_n, end_n):
     assert out == f"OK ndev={end_n} restart=1", out
 
 
+@requires_modern_jax
 def test_launch_two_process_hybrid_trainer(tmp_path):
     """The FULL hybrid GPT trainer (dp x mp x pp x ZeRO, sp) runs across
     2 real processes with the pipeline axis split on the process

@@ -15,6 +15,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 import paddle_tpu
 import paddle_tpu.optimizer as opt
 import paddle_tpu.distributed as dist
+from paddle_tpu.distributed._jax_compat import shard_map as _shard_map
 from paddle_tpu.distributed.meta_optimizers import (DGCMomentumOptimizer,
                                                     LocalSGDOptimizer)
 
@@ -47,7 +48,7 @@ def test_localsgd_k1_equals_sync_dp():
     state0 = lsgd.init(w0)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(_shard_map, mesh=mesh,
                        in_specs=(P(), P("dp"), P("dp")), out_specs=P(),
                        check_vma=False, axis_names={"dp"})
     def run(w, A_l, b_l):
@@ -79,7 +80,7 @@ def test_localsgd_k4_replicas_agree_and_learn():
     state0 = lsgd.init(w0)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(_shard_map, mesh=mesh,
                        in_specs=(P(), P("dp"), P("dp")),
                        out_specs=(P("dp"), P()),
                        check_vma=False, axis_names={"dp"})
@@ -187,7 +188,7 @@ def test_dgc_learns_under_shard_map_dp():
     st0 = dgc.init(w0)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(_shard_map, mesh=mesh,
                        in_specs=(P(), P("dp"), P("dp")),
                        out_specs=(P("dp"), P()),
                        check_vma=False, axis_names={"dp"})

