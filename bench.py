@@ -567,6 +567,20 @@ def _secondary_benches(smoke=False):
         out["truncated"] = "budget"
         return out
 
+    # 6b continuous-batching serving — the same decode model behind the
+    # slot-pooled engine (paddle_tpu.serving) under a MIXED-ARRIVAL
+    # workload: staggered submissions, varied prompt lengths and
+    # max_new_tokens.  Reported next to the static gpt_decode row so the
+    # batching payoff (batch fill under ragged finish times, TTFT) is
+    # tracked per round.
+    try:
+        out["serving_continuous"] = _serving_bench(dm, smoke=smoke)
+    except Exception as e:
+        out["serving_continuous"] = {"error": repr(e)[-300:]}
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
     # 7 int8 weight-only decode — the same loop with quantized weight
     # storage (decode is weight-HBM-bound; this row measures the payoff)
     try:
@@ -591,6 +605,58 @@ def _secondary_benches(smoke=False):
     except Exception as e:
         out["gpt_decode_int8"] = {"error": repr(e)[-200:]}
     return out
+
+
+def _serving_bench(model, smoke=False):
+    """Mixed-arrival continuous-batching row: submit a first wave, start
+    stepping, inject a second wave mid-flight (the arrival pattern static
+    batching cannot absorb), drain, and report the engine's own metrics.
+    A compile warmup run (same buckets, same decode program) goes first
+    so tok/s and TTFT measure steady-state serving, not tracing."""
+    from paddle_tpu.serving import ServingEngine
+
+    rs = np.random.RandomState(7)
+    vocab = model.cfg.vocab_size
+    if smoke:
+        slots, n_reqs, base_new = 2, 4, 6
+        lens = [3, 9, 5, 12]
+    else:
+        slots, n_reqs, base_new = 8, 24, 96
+        lens = list(rs.randint(16, 257, size=n_reqs))
+
+    def workload(engine):
+        prompts = [rs.randint(0, vocab, (int(L),)) for L in lens]
+        news = [base_new + (i % 3) * (2 if smoke else 32)
+                for i in range(n_reqs)]
+        first = [engine.submit(p, max_new_tokens=n)
+                 for p, n in zip(prompts[:n_reqs // 2], news[:n_reqs // 2])]
+        for _ in range(3):          # second wave arrives mid-decode
+            engine.step()
+        late = [engine.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts[n_reqs // 2:], news[n_reqs // 2:])]
+        engine.run_until_complete(max_steps=20000)
+        return [engine.result(i) for i in first + late]
+
+    eng = ServingEngine(model, num_slots=slots)
+    workload(eng)                   # compiles every bucket + decode step
+    eng.metrics.reset()             # same engine, same compiled programs
+    t0 = time.perf_counter()
+    outs = workload(eng)
+    wall = time.perf_counter() - t0
+    done = sum(1 for o in outs if o.finished)
+    m = eng.metrics_dict()
+    return {
+        "requests": n_reqs,
+        "finished": done,
+        "num_slots": slots,
+        "tokens_per_sec": m["tokens_per_sec"],
+        "mean_ttft_ms": m["mean_ttft_ms"],
+        "batch_fill_ratio": m["batch_fill_ratio"],
+        "mean_queue_depth": m["mean_queue_depth"],
+        "steps": m["steps"],
+        "wall_s": round(wall, 2),
+        "config": f"slots{slots}-reqs{n_reqs}-mixed-arrival",
+    }
 
 
 def main():

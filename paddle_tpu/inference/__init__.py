@@ -10,6 +10,12 @@ runner: load once, zero-copy handles in/out, jit-cached execution.  GPU/TRT
 config knobs are accepted for porting ease but warn once per process that
 the XLA path ignores them (VERDICT r3 weak 6: silent no-ops make porting
 users chase phantom perf knobs).
+
+Causal-LM route: ``Config(model=<LM with init_cache/decode_step>)`` +
+``create_predictor`` return a ``ServingPredictor`` backed by the
+continuous-batching engine (paddle_tpu.serving) — batched ragged-prompt
+generation through the same handle API, instead of requiring an AOT
+artifact for an autoregressive loop.
 """
 
 from __future__ import annotations
@@ -20,7 +26,8 @@ from typing import Dict, List, Optional
 import numpy as np
 import jax.numpy as jnp
 
-__all__ = ["Config", "create_predictor", "Predictor", "Tensor"]
+__all__ = ["Config", "create_predictor", "Predictor", "ServingPredictor",
+           "Tensor"]
 
 # knobs that already warned this process (one warning per knob, not per call)
 _WARNED_KNOBS = set()
@@ -38,14 +45,47 @@ def _warn_ignored(knob: str, detail: str) -> None:
 
 class Config:
     """Reference: paddle_infer::Config(prog_file, params_file) or
-    Config(model_dir).  Here both forms resolve to the jit.save prefix."""
+    Config(model_dir).  A string resolves to the jit.save prefix; a live
+    causal-LM OBJECT (anything with ``init_cache``/``decode_step``)
+    routes onto the continuous-batching serving engine
+    (paddle_tpu.serving) instead of the AOT-program runner — the
+    generation knobs below then apply."""
 
-    def __init__(self, model: Optional[str] = None,
-                 params: Optional[str] = None):
-        # Config("prefix") or Config("prefix.pdmodel", "prefix.pdiparams")
-        if model is not None and model.endswith(".pdmodel"):
-            model = model[:-len(".pdmodel")]
-        self.prefix = model
+    def __init__(self, model=None, params: Optional[str] = None):
+        self.prefix = None
+        self.model = None
+        if isinstance(model, str):
+            # Config("prefix") or Config("prefix.pdmodel", "prefix.pdiparams")
+            if model.endswith(".pdmodel"):
+                model = model[:-len(".pdmodel")]
+            self.prefix = model
+        elif model is not None:
+            if not (hasattr(model, "init_cache")
+                    and hasattr(model, "decode_step")):
+                raise TypeError(
+                    "Config(model=...) takes a jit.save path prefix or a "
+                    "causal-LM exposing init_cache/decode_step; got "
+                    f"{type(model).__name__}")
+            self.model = model
+        # serving-engine generation knobs (used only on the engine route)
+        self.serving_num_slots = 8
+        self.serving_max_new_tokens = 16
+        self.serving_eos_token_id: Optional[int] = None
+        self.serving_sampling = None           # serving.SamplingParams
+
+    def set_serving_options(self, num_slots: Optional[int] = None,
+                            max_new_tokens: Optional[int] = None,
+                            eos_token_id: Optional[int] = None,
+                            sampling=None):
+        if num_slots is not None:
+            self.serving_num_slots = num_slots
+        if max_new_tokens is not None:
+            self.serving_max_new_tokens = max_new_tokens
+        if eos_token_id is not None:
+            self.serving_eos_token_id = eos_token_id
+        if sampling is not None:
+            self.serving_sampling = sampling
+        return self
 
     # --- accepted-knob parity (warn-once no-ops under XLA) --------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -135,5 +175,71 @@ class Predictor:
         return self._outputs[name]
 
 
-def create_predictor(config: Config) -> Predictor:
+class ServingPredictor:
+    """Predictor facade over the continuous-batching engine: the
+    paddle_infer handle API (input_ids [+ optional prompt_lens] in,
+    sequences out) backed by ``serving.ServingEngine.serve_batch`` —
+    Config(model=<causal-LM>) routes here instead of warning-and-failing
+    on a non-path model."""
+
+    def __init__(self, config: Config):
+        from ..serving import ServingEngine
+        self._config = config
+        self._engine = ServingEngine(config.model,
+                                     num_slots=config.serving_num_slots)
+        self._inputs = {"input_ids": Tensor("input_ids"),
+                        "prompt_lens": Tensor("prompt_lens")}
+        self._outputs: Dict[str, Tensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self):
+        cfg = self._config
+        ids = np.asarray(self._inputs["input_ids"]._value)
+        if ids.ndim != 2:
+            raise ValueError("input_ids must be [batch, prompt_len]")
+        lens_t = self._inputs["prompt_lens"]._value
+        if lens_t is None:
+            lens = np.full((ids.shape[0],), ids.shape[1], np.int32)
+        else:
+            lens = np.asarray(lens_t, np.int32).reshape(-1)
+            if lens.shape[0] != ids.shape[0]:
+                raise ValueError(f"prompt_lens must be [{ids.shape[0]}], "
+                                 f"got {lens.shape}")
+            if lens.min() < 1 or lens.max() > ids.shape[1]:
+                raise ValueError("prompt_lens entries must lie in "
+                                 f"[1, {ids.shape[1]}]")
+        prompts = [ids[i, :lens[i]] for i in range(ids.shape[0])]
+        outs = self._engine.serve_batch(
+            prompts, max_new_tokens=cfg.serving_max_new_tokens,
+            sampling=cfg.serving_sampling,
+            eos_token_id=cfg.serving_eos_token_id)
+        n = cfg.serving_max_new_tokens
+        toks = np.zeros((ids.shape[0], n), np.int64)
+        tok_lens = np.zeros((ids.shape[0],), np.int32)
+        for i, o in enumerate(outs):
+            tok_lens[i] = len(o.tokens)
+            toks[i, :len(o.tokens)] = o.tokens
+        self._outputs = {}
+        for name, val in (("generated_ids", toks),
+                          ("generated_lens", tok_lens)):
+            t = Tensor(name)
+            t._value = jnp.asarray(val)
+            self._outputs[name] = t
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config):
+    if config.model is not None:
+        return ServingPredictor(config)
     return Predictor(config)

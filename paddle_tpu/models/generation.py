@@ -51,7 +51,7 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None,
              pad_token_id: Optional[int] = None, seed: int = 0,
-             output_scores: bool = False):
+             output_scores: bool = False, prompt_lens=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([batch, prompt_len], dense — no padding) and return the full
     sequences [batch, prompt_len + max_new_tokens].
@@ -62,6 +62,15 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
     ``pad_token_id`` (default: the eos id) for the remaining steps.
     ``output_scores=True`` additionally returns the pre-sampling float32
     logits of every generated position [batch, max_new_tokens, vocab].
+
+    ``prompt_lens`` ([batch] int32, optional) admits RAGGED right-padded
+    prompts: row r's real prompt is ``input_ids[r, :prompt_lens[r]]``.
+    Prefill masks the pad tail (the ragged decode-attention seq_lens mask
+    — pad keys are never attended by live queries) and each row's decode
+    starts at its OWN length, overwriting the pad region of the cache
+    token by token.  The generated tokens still land in the trailing
+    ``max_new_tokens`` columns of the result; row r's true sequence is
+    ``concat(input_ids[r, :prompt_lens[r]], result[r, prompt_len:])``.
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -76,6 +85,16 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
             "out-of-range positions would silently clamp")
     input_ids = jnp.asarray(input_ids)
     pad = eos_token_id if pad_token_id is None else pad_token_id
+    if prompt_lens is not None:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        if lens.shape != (b,):
+            raise ValueError(f"prompt_lens must be [{b}], got {lens.shape}")
+        import numpy as _np
+        if not isinstance(lens, jax.core.Tracer):
+            host = _np.asarray(lens)
+            if host.min() < 1 or host.max() > s0:
+                raise ValueError("prompt_lens entries must lie in "
+                                 f"[1, {s0}]")
 
     def pick(key, logits):
         logits = logits.astype(jnp.float32)
@@ -91,10 +110,17 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
 
     caches = model.init_cache(b, s0 + max_new_tokens)
     logits, caches = model.decode_step(input_ids, caches, 0)
-    first_scores = logits[:, -1].astype(jnp.float32)
+    if prompt_lens is None:
+        last_logits = logits[:, -1]
+    else:
+        # each row's last VALID prompt position carries its next-token
+        # distribution; pad-tail logits are garbage and are skipped
+        last_logits = jnp.take_along_axis(
+            logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+    first_scores = last_logits.astype(jnp.float32)
     key = jax.random.PRNGKey(seed)
     key, sub = jax.random.split(key)
-    first = pick(sub, logits[:, -1])
+    first = pick(sub, last_logits)
     if eos_token_id is not None:
         finished = first == eos_token_id
     else:
@@ -103,6 +129,8 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
     def body(carry, _):
         caches, tok, pos, key, finished = carry
         # ``pos`` is the sequence index of ``tok``, the token being fed
+        # (a [b] vector when prompts are ragged — each row decodes at its
+        # own offset; models/kv_cache.py handles the per-row cache write)
         logits, caches = model.decode_step(tok[:, None], caches, pos)
         key, sub = jax.random.split(key)
         scores = logits[:, 0].astype(jnp.float32)
@@ -112,10 +140,17 @@ def generate(model, input_ids, max_new_tokens: int, do_sample: bool = False,
             finished = finished | (nxt == eos_token_id)
         return (caches, nxt, pos + 1, key, finished), (nxt, scores)
 
+    if prompt_lens is not None:
+        # prefill ran at scalar offset 0, so each layer's cache tuple
+        # carries the scalar position s0; re-anchor it to the per-row
+        # lengths so decode WRITES land at each row's own offset and the
+        # attention lens mask the pad tail (models/kv_cache.py semantics)
+        caches = [(c[0], c[1], lens) for c in caches]
     if max_new_tokens > 1:
-        # ``first`` sits at sequence index s0 — that is the position the
-        # first scan step feeds it at
-        carry = (caches, first, jnp.asarray(s0, jnp.int32), key, finished)
+        # ``first`` sits at sequence index s0 (row r: prompt_lens[r]) —
+        # that is the position the first scan step feeds it at
+        pos0 = jnp.asarray(s0, jnp.int32) if prompt_lens is None else lens
+        carry = (caches, first, pos0, key, finished)
         _, (rest, rest_scores) = jax.lax.scan(body, carry, None,
                                               length=max_new_tokens - 1)
         new_tokens = jnp.concatenate(

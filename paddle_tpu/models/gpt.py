@@ -115,8 +115,10 @@ class GPTBlock(Layer):
         new_cache = None
         if cache is not None:
             pk, pv, pos = cache
-            k = jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1)
-            v = jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1)
+            # pos may be a scalar (dense batch) or a [b] vector of per-row
+            # offsets (ragged continuous batching) — models/kv_cache.py
+            from .kv_cache import append_kv, cache_lens
+            k, v = append_kv(pk, pv, k, v, pos)
             new_cache = (k, v, pos + s)
             # decode: the routed decode-attention path (pallas streaming
             # kernel or its exact-semantics dense form, kernels/routing.py)
@@ -124,8 +126,7 @@ class GPTBlock(Layer):
             # the per-query mask (query at chunk offset t sees keys up to
             # pos + t), without materializing a [*, s, S_max] mask tensor
             from ..kernels.decode_attention import decode_attention_auto
-            lens = jnp.full((b,), pos + s, jnp.int32)
-            out = decode_attention_auto(q, k, v, lens)
+            out = decode_attention_auto(q, k, v, cache_lens(pos, s, b))
         elif cfg.cp:
             # long-context: sequence sharded over the sep axis; ring or
             # Ulysses attention instead of local sdpa (attn dropout is not
@@ -170,8 +171,12 @@ class GPTModel(Layer):
     def embed(self, input_ids, position_offset: int = 0):
         b, s = input_ids.shape
         # written as offset + static arange so position_offset may be a
-        # traced value (the generate() scan carries it)
-        pos = (position_offset + jnp.arange(s))[None, :]
+        # traced value (the generate() scan carries it); a [b] offset
+        # vector gives per-row positions (ragged continuous batching)
+        off = jnp.asarray(position_offset)
+        pos = off[..., None] + jnp.arange(s)
+        if pos.ndim == 1:
+            pos = pos[None, :]
         x = self.wte(input_ids) + self.wpe(pos)
         return self.drop(x)
 

@@ -1,0 +1,54 @@
+"""Shared KV-cache position plumbing for the decoder models.
+
+The functional cache every causal LM here carries is a per-layer tuple
+``(k_buf, v_buf, pos)`` with ``k_buf/v_buf [batch, max_len, heads, dim]``.
+Historically ``pos`` was a single scalar — every row of the batch sat at
+the same context length.  Continuous batching (paddle_tpu.serving) packs
+requests of DIFFERENT lengths into one fixed-shape batch, so ``pos`` may
+now also be an int32 VECTOR ``[batch]`` of per-row cache positions:
+
+  * scalar ``pos``  — the whole chunk lands at one offset
+    (``dynamic_update_slice``), the classic dense-batch decode;
+  * vector ``pos``  — row r's chunk lands at ``pos[r]`` (a vmapped
+    per-row ``dynamic_update_slice``), and the attention mask uses row
+    r's own length.
+
+Both forms stay fixed-shape: the cache buffers never reallocate, only
+the write offset and the masking length vary — graftlint's
+recompile-hazard rule is the design constraint.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["append_kv", "cache_lens"]
+
+
+def _is_per_row(pos) -> bool:
+    return getattr(pos, "ndim", 0) >= 1
+
+
+def append_kv(pk, pv, k, v, pos):
+    """Write the fresh chunk ``k/v [b, s, h, d]`` into the cache buffers
+    ``pk/pv [b, max_len, h, d]`` at ``pos`` (scalar, or ``[b]`` int32 for
+    per-row offsets).  Returns the updated full buffers."""
+    if _is_per_row(pos):
+        def row(buf, new, p):
+            return jax.lax.dynamic_update_slice_in_dim(buf, new, p, axis=0)
+        upd = jax.vmap(row)
+        p = jnp.asarray(pos, jnp.int32)
+        return upd(pk, k, p), upd(pv, v, p)
+    return (jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1))
+
+
+def cache_lens(pos, s: int, batch: int):
+    """Per-row valid cache lengths AFTER appending an ``s``-token chunk at
+    ``pos`` — the ``seq_lens`` the ragged decode-attention kernel masks
+    by.  A scalar ``pos`` broadcasts to every row; a ``[batch]`` vector is
+    each row's own context length (ragged continuous-batching decode)."""
+    if _is_per_row(pos):
+        return (jnp.asarray(pos, jnp.int32) + s).astype(jnp.int32)
+    return jnp.full((batch,), pos + s, jnp.int32)

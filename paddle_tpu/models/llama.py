@@ -78,12 +78,15 @@ def _rope_tables(positions, head_dim: int, theta: float, dtype):
 
 
 def apply_rotary_pos_emb(x, cos, sin):
-    """x [b, s, heads, d]; cos/sin [s, d/2] (or broadcastable).  Llama
+    """x [b, s, heads, d]; cos/sin [s, d/2] (shared positions) or
+    [b, s, d/2] (per-row positions — ragged continuous batching).  Llama
     pairing: (x1, x2) = halves (reference fused_rope neox-style)."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
@@ -108,8 +111,10 @@ class LlamaAttention(Layer):
         new_cache = None
         if cache is not None:
             pk, pv, pos = cache
-            k = jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1)
-            v = jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1)
+            # pos may be a scalar (dense batch) or a [b] vector of per-row
+            # offsets (ragged continuous batching) — models/kv_cache.py
+            from .kv_cache import append_kv
+            k, v = append_kv(pk, pv, k, v, pos)
             new_cache = (k, v, pos + s)
         # GQA: repeat kv heads up to q heads (XLA turns this into a
         # broadcast inside the attention einsum — no real copy)
@@ -120,10 +125,14 @@ class LlamaAttention(Layer):
         if cache is not None:
             # routed decode attention (see gpt.py _attn): seq_lens =
             # pos + s with the causal tail IS the per-query chunked-
-            # prefill mask, with no [*, s, S_max] mask materialization
+            # prefill mask, with no [*, s, S_max] mask materialization.
+            # lens derive from the cache POSITION per row (a scalar pos
+            # broadcasts; a [b] vector keeps each row's own context
+            # length — ragged batches were silently wrong under the old
+            # jnp.full((b,), pos + s) which assumed uniform lengths)
             from ..kernels.decode_attention import decode_attention_auto
-            lens = jnp.full((b,), cache[2] + s, jnp.int32)
-            out = decode_attention_auto(q, k, v, lens)
+            from .kv_cache import cache_lens
+            out = decode_attention_auto(q, k, v, cache_lens(cache[2], s, b))
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  training=self.training)
@@ -178,8 +187,9 @@ class LlamaModel(Layer):
         cfg = self.cfg
         b, s = input_ids.shape
         x = self.embed_tokens(input_ids)
-        # offset + static arange: position_offset may be traced (generate)
-        pos = position_offset + jnp.arange(s)
+        # offset + static arange: position_offset may be traced (generate);
+        # a [b] offset vector gives per-row positions (ragged batching)
+        pos = jnp.asarray(position_offset)[..., None] + jnp.arange(s)
         cos, sin = _rope_tables(pos, cfg.head_dim, cfg.rope_theta, x.dtype)
         new_caches = []
         for i, layer in enumerate(self.layers):

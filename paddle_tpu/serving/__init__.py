@@ -1,0 +1,20 @@
+"""TPU-native continuous-batching serving engine (pure JAX, fixed shapes).
+
+Reference analog: the serving stack the reference feeds through
+fused_multi_transformer — PaddleNLP's predictor loop batching concurrent
+generation requests over one shared decoder.  Here the same capability is
+built TPU-natively: a slot-pooled KV cache (kv_pool), FCFS admission with
+pow2 prefill buckets (scheduler), one compiled fixed-shape decode step
+with per-slot sampling (engine), a submit/step/stream surface (api), and
+off-hot-path serving metrics (metrics).  See docs/serving.md.
+"""
+
+from .api import Request, RequestOutput, SamplingParams, ServingEngine
+from .engine import EngineCore, sample_rows
+from .kv_pool import KVPool
+from .metrics import ServingMetrics
+from .scheduler import Scheduler, bucket_length
+
+__all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
+           "EngineCore", "sample_rows", "KVPool", "ServingMetrics",
+           "Scheduler", "bucket_length"]

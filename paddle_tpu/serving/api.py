@@ -1,0 +1,169 @@
+"""Public serving surface: ``ServingEngine.submit()/step()/stream()``.
+
+The facade over ``engine.EngineCore``: request construction, streaming
+token callbacks, the synchronous ``serve_batch()`` convenience, and the
+metrics dict.  Typical use::
+
+    from paddle_tpu.serving import ServingEngine, SamplingParams
+
+    eng = ServingEngine(model, num_slots=8)
+    h = eng.submit([12, 7, 99], max_new_tokens=32,
+                   sampling=SamplingParams(do_sample=True, top_p=0.9),
+                   eos_token_id=0)
+    for tok in eng.stream(h):          # steps the engine as it yields
+        ...
+    out = eng.result(h)                # RequestOutput
+
+or, batch-synchronous::
+
+    outs = eng.serve_batch(prompts, max_new_tokens=32)  # list per prompt
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import EngineCore
+from .metrics import ServingMetrics
+from .scheduler import Request, SamplingParams
+
+__all__ = ["ServingEngine", "RequestOutput", "Request", "SamplingParams"]
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """Completed (or in-flight) view of one request."""
+    request_id: int
+    prompt: np.ndarray
+    tokens: List[int]
+    finished: bool
+    finish_reason: Optional[str]      # "eos" | "length" | None
+    ttft_s: Optional[float]           # submit -> first token
+
+    @property
+    def sequence(self) -> np.ndarray:
+        """prompt + generated tokens, the ``generate()``-shaped result."""
+        return np.concatenate(
+            [np.asarray(self.prompt, np.int64),
+             np.asarray(self.tokens, np.int64)])
+
+
+class ServingEngine:
+    """Continuous-batching serving over any causal LM exposing
+    ``init_cache``/``decode_step`` (GPTForCausalLM, LlamaForCausalLM).
+
+    ``num_slots`` fixes the decode batch; ``max_seq`` the per-slot KV
+    budget (default: the model's max_seq_len).  All shapes are static:
+    admission cost is bounded by the pow2 prefill buckets, decode is one
+    compiled program for the engine's lifetime.
+    """
+
+    def __init__(self, model, num_slots: int = 8,
+                 max_seq: Optional[int] = None, min_bucket: int = 16,
+                 max_prefills_per_step: Optional[int] = None,
+                 record_events: bool = False):
+        self.core = EngineCore(
+            model, num_slots=num_slots, max_seq=max_seq,
+            min_bucket=min_bucket,
+            max_prefills_per_step=max_prefills_per_step,
+            metrics=ServingMetrics(record_events=record_events))
+        self._requests = {}
+
+    # -------------------------------------------------------- submission
+    def submit(self, prompt, max_new_tokens: int = 16,
+               sampling: Optional[SamplingParams] = None,
+               eos_token_id: Optional[int] = None,
+               stream: Optional[Callable] = None) -> int:
+        """Queue one request; returns its id (admission happens inside a
+        later ``step()`` — submit never blocks on the device).
+
+        ``stream`` is called as ``stream(request, token)`` the moment
+        each token is harvested, while other requests keep decoding."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        sched = self.core.scheduler
+        req = Request(request_id=sched.next_request_id(),
+                      prompt=prompt, max_new_tokens=max_new_tokens,
+                      sampling=sampling or SamplingParams(),
+                      eos_token_id=eos_token_id, stream=stream)
+        sched.submit(req)
+        self._requests[req.request_id] = req
+        self.core.metrics.on_submit()
+        return req.request_id
+
+    # -------------------------------------------------------- execution
+    def step(self) -> int:
+        """One engine iteration (admit -> decode -> harvest/evict);
+        returns the number of requests still in flight."""
+        return self.core.step()
+
+    def stream(self, request_id: int) -> Iterator[int]:
+        """Yield ``request_id``'s tokens as they are generated, stepping
+        the engine whenever the request has no unseen tokens yet.  Other
+        in-flight requests advance on the same steps."""
+        req = self._requests[request_id]
+        seen = 0
+        while True:
+            while seen < len(req.tokens):
+                yield req.tokens[seen]
+                seen += 1
+            if req.finished:
+                return
+            self.core.step()
+
+    def run_until_complete(self, max_steps: Optional[int] = None) -> int:
+        return self.core.run_until_complete(max_steps)
+
+    # ----------------------------------------------------------- results
+    def result(self, request_id: int) -> RequestOutput:
+        req = self._requests[request_id]
+        ttft = None
+        if req.first_token_time is not None:
+            ttft = req.first_token_time - req.arrival_time
+        return RequestOutput(request_id=req.request_id, prompt=req.prompt,
+                             tokens=list(req.tokens), finished=req.finished,
+                             finish_reason=req.finish_reason, ttft_s=ttft)
+
+    def purge(self, request_id: int) -> RequestOutput:
+        """``result()`` + drop the engine's reference to the finished
+        request.  Long-running servers MUST consume results this way (or
+        call it after ``result()``): the engine otherwise keeps every
+        prompt/token list for its whole lifetime."""
+        req = self._requests[request_id]
+        if not req.finished:
+            raise ValueError(f"request {request_id} is still in flight")
+        out = self.result(request_id)
+        del self._requests[request_id]
+        return out
+
+    def serve_batch(self, prompts: Sequence, max_new_tokens: int = 16,
+                    sampling: Optional[SamplingParams] = None,
+                    eos_token_id: Optional[int] = None,
+                    max_steps: Optional[int] = None) -> List[RequestOutput]:
+        """Submit every prompt, run to completion, return outputs in
+        submission order — the synchronous convenience for offline batch
+        inference (ragged prompts welcome; no padding needed).  A shared
+        ``sampling`` spec is copied per request with the seed offset by
+        the prompt index, so equal prompts still decode independently.
+        The returned outputs are PURGED from the engine (they carry the
+        full result) — batch after batch never accumulates state."""
+        ids = [self.submit(p, max_new_tokens=max_new_tokens,
+                           sampling=dataclasses.replace(
+                               sampling, seed=sampling.seed + i)
+                           if sampling is not None else None,
+                           eos_token_id=eos_token_id)
+               for i, p in enumerate(prompts)]
+        self.run_until_complete(max_steps)
+        return [self.purge(i) for i in ids]
+
+    # ----------------------------------------------------------- metrics
+    @property
+    def metrics(self) -> ServingMetrics:
+        return self.core.metrics
+
+    def metrics_dict(self) -> dict:
+        return self.core.metrics.snapshot()

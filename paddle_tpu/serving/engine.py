@@ -1,0 +1,295 @@
+"""Continuous-batching engine core: the fixed-shape step loop.
+
+Device plane (all jitted, all fixed-shape — graftlint's recompile-hazard
+rule is the design constraint):
+
+  * ``prefill``  — one program per LENGTH BUCKET: ``[1, bucket]`` prompt
+    into a fresh ``[1, max_seq]`` cache, returning the last-valid-token
+    logits (a traced prompt length selects the row, so padding never
+    recompiles) and the cache the pool adopts into the request's slot;
+  * ``decode``   — ONE program, period: ``[num_slots, 1]`` tokens against
+    the whole pool with per-slot positions (models/kv_cache.py), per-slot
+    sampling params as traced row values, and per-slot PRNG keys.  Free
+    slots ride along as no-ops: their rows decode garbage that nothing
+    reads, their writes land at position 0 of a row the next adopt
+    overwrites wholesale.
+
+Host plane: ONE device->host readback per step phase — the decode
+harvest reads the sampled token vector once, and a step that admits
+requests reads their batched first tokens once (all prefill dispatches
+stay async until then).  Admission, eviction, eos/length bookkeeping and
+metrics all run on host ints the engine already holds.
+
+Per-slot sampling reuses ``generation._filter_top_p`` directly (its
+threshold broadcasts over rows) and generalises ``_filter_top_k`` to a
+per-row traced k via rank masking (``_filter_top_k_rows`` — the static-k
+form cannot vary k within one compiled step).  Each slot draws from its
+OWN PRNG key with the same split discipline as ``generate``, so a
+single-request engine run reproduces ``generate(seed=...)`` token for
+token, sampling included.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import _filter_top_p
+from .kv_pool import KVPool
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler
+
+__all__ = ["EngineCore", "sample_rows"]
+
+
+def _filter_top_k_rows(logits, top_k):
+    """Per-row top-k: keep each row's ``top_k[r]`` highest logits
+    (``top_k[r] == 0`` keeps the whole row).  Rank masking — argsort of
+    the descending argsort — matches ``generation._filter_top_k`` for
+    distinct values and resolves ties by vocab order (the stable-sort
+    winner), which is also what argmax picks for k=1."""
+    order = jnp.argsort(-logits, axis=-1)
+    rank = jnp.argsort(order, axis=-1)
+    k = jnp.asarray(top_k, jnp.int32)[:, None]
+    keep = jnp.where(k > 0, rank < k, True)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def sample_rows(keys, logits, do_sample, temperature, top_k, top_p):
+    """Per-row token selection over ``logits [rows, vocab]``.
+
+    ``do_sample [rows] bool`` picks greedy argmax vs sampling per row;
+    sampling rows apply ``temperature -> top_k -> top_p`` (the exact
+    pipeline of ``generation.generate``) and draw from their OWN key row
+    of ``keys [rows, key_dim]``, so one request's randomness never
+    depends on its slot neighbours."""
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    scaled = logits / temp[:, None]
+    filtered = _filter_top_k_rows(scaled, top_k)
+    p = jnp.asarray(top_p, jnp.float32)[:, None]
+    # rows with top_p == 1.0 skip the nucleus filter EXACTLY, matching
+    # generate()'s static skip; filtered rows take the nucleus lane
+    filtered = jnp.where(p >= 1.0, filtered, _filter_top_p(filtered, p))
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(jnp.asarray(do_sample, bool), sampled, greedy_tok)
+
+
+class _Slot:
+    """Host mirror of one pool slot's request progress."""
+
+    __slots__ = ("req", "pos")
+
+    def __init__(self, req: Request, prompt_len: int):
+        self.req = req
+        self.pos = prompt_len       # cache length == next write offset
+
+
+class EngineCore:
+    """Owns the pool, the per-slot device state and the compiled step
+    functions.  The public request/streaming surface lives in
+    ``serving.api.ServingEngine``."""
+
+    def __init__(self, model, num_slots: int = 8,
+                 max_seq: Optional[int] = None,
+                 min_bucket: int = 16,
+                 max_prefills_per_step: Optional[int] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self.model = model
+        self.pool = KVPool.create(model, num_slots, max_seq)
+        self.scheduler = Scheduler(num_slots, self.pool.max_seq,
+                                   min_bucket=min_bucket,
+                                   max_prefills_per_step=max_prefills_per_step)
+        self.metrics = metrics or ServingMetrics()
+        self.num_slots = num_slots
+        self._slots: Dict[int, _Slot] = {}
+        # per-slot device row state (fixed [num_slots] shapes)
+        self._last_tok = jnp.zeros((num_slots,), jnp.int32)
+        key0 = jax.random.PRNGKey(0)
+        self._keys = jnp.tile(key0[None], (num_slots,) + (1,) * key0.ndim)
+        # per-slot sampling params: host numpy mirrors, re-uploaded to a
+        # cached device copy only when admission/eviction dirties them
+        # (values are traced row data — changing them never recompiles)
+        self._do_sample = np.zeros((num_slots,), bool)
+        self._temperature = np.ones((num_slots,), np.float32)
+        self._top_k = np.zeros((num_slots,), np.int32)
+        self._top_p = np.ones((num_slots,), np.float32)
+        self._sampling_dev: Optional[Tuple] = None
+        # compiled programs: ONE decode fn + ONE prefill fn whose jit
+        # cache is keyed by the [1, bucket] input shape (one program per
+        # bucket, nothing per length); the trace counters are what the
+        # compile-count guard test asserts on
+        self._decode_fn = None
+        self._prefill_fn: Optional[Callable] = None
+        self.trace_counts = {"prefill": 0, "decode": 0}
+
+    # ----------------------------------------------------------- prefill
+    def _build_prefill_fn(self) -> Callable:
+        model, max_seq = self.model, self.pool.max_seq
+
+        def prefill(ids, length):
+            self.trace_counts["prefill"] += 1  # trace-time side effect
+            caches = model.init_cache(1, max_seq)
+            logits, caches = model.decode_step(ids, caches, 0)
+            last = jnp.take_along_axis(
+                logits, (length - 1)[None, None, None], axis=1)[0, 0]
+            return last.astype(jnp.float32), caches
+
+        return jax.jit(prefill)
+
+    def _admit(self, admitted: List[Tuple[Request, int]]) -> int:
+        """Prefill each admitted request into a pool slot and sample its
+        first token with the request's own key.  All dispatches stay
+        async; the admitted first tokens come back in ONE readback at the
+        end (the decode harvest is the step's other one).  Returns tokens
+        emitted."""
+        if self._prefill_fn is None:
+            self._prefill_fn = self._build_prefill_fn()
+        staged: List[Tuple[int, jax.Array]] = []
+        for req, bucket in admitted:
+            slot = self.pool.alloc()
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :req.prompt_len] = np.asarray(req.prompt, np.int32)
+            last_logits, caches = self._prefill_fn(
+                jnp.asarray(ids), jnp.asarray(req.prompt_len, jnp.int32))
+            self.pool.adopt(slot, caches, req.prompt_len)
+            key = jax.random.PRNGKey(req.sampling.seed)
+            key, sub = jax.random.split(key)
+            s = req.sampling
+            first = sample_rows(
+                sub[None], last_logits[None],
+                jnp.asarray([s.do_sample]),
+                jnp.asarray([s.temperature], jnp.float32),
+                jnp.asarray([s.top_k], jnp.int32),
+                jnp.asarray([s.top_p], jnp.float32))
+            self.scheduler.place(req, slot)
+            self._slots[slot] = _Slot(req, req.prompt_len)
+            self._last_tok = self._last_tok.at[slot].set(first[0])
+            self._keys = self._keys.at[slot].set(key)
+            self._do_sample[slot] = s.do_sample
+            self._temperature[slot] = s.temperature
+            self._top_k[slot] = s.top_k
+            self._top_p[slot] = s.top_p
+            self._sampling_dev = None
+            self.metrics.on_prefill(req.prompt_len)
+            staged.append((slot, first))
+        if staged:
+            toks = np.asarray(jnp.concatenate([f for _, f in staged]))
+            for (slot, _), tok in zip(staged, toks):
+                self._emit(slot, int(tok), first_token=True)
+        return len(staged)
+
+    # ------------------------------------------------------------ decode
+    def _build_decode_fn(self) -> Callable:
+        model = self.model
+
+        def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
+                   temperature, top_k, top_p):
+            self.trace_counts["decode"] += 1  # trace-time side effect
+            caches = [(k, v, seq_pos) for k, v in zip(ks, vs)]
+            logits, caches = model.decode_step(last_tok[:, None], caches,
+                                               seq_pos)
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            nxt = sample_rows(split[:, 1], logits[:, 0], do_sample,
+                              temperature, top_k, top_p)
+            new_ks = [c[0] for c in caches]
+            new_vs = [c[1] for c in caches]
+            return (new_ks, new_vs, caches[0][2], nxt.astype(jnp.int32),
+                    split[:, 0])
+
+        # donating the KV slabs aliases them in place — pool memory stays
+        # a single allocation across the whole serving run
+        return jax.jit(decode, donate_argnums=(0, 1))
+
+    def _decode_all_slots(self) -> np.ndarray:
+        """ONE fixed-shape decode step over every slot; returns the
+        sampled token per slot (the step's single host readback)."""
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode_fn()
+        if self._sampling_dev is None:
+            self._sampling_dev = (jnp.asarray(self._do_sample),
+                                  jnp.asarray(self._temperature),
+                                  jnp.asarray(self._top_k),
+                                  jnp.asarray(self._top_p))
+        ks, vs, pos, nxt, self._keys = self._decode_fn(
+            self.pool.ks, self.pool.vs, self.pool.seq_pos,
+            self._last_tok, self._keys, *self._sampling_dev)
+        self.pool.ks, self.pool.vs, self.pool.seq_pos = ks, vs, pos
+        self._last_tok = nxt
+        return np.asarray(nxt)
+
+    # -------------------------------------------------------- step loop
+    def step(self) -> int:
+        """One engine iteration: admit+prefill, one decode step over all
+        active slots, harvest tokens / evict finished.  Returns the
+        number of requests still in flight (running + queued)."""
+        t0 = time.perf_counter()
+        ann = None
+        if self.metrics.record_events:
+            from ..profiler import RecordEvent
+            ann = RecordEvent("serving.step")
+            ann.begin()
+        new_tokens = self._admit(self.scheduler.admit(self.pool.free_slots))
+        if self._slots:
+            toks = self._decode_all_slots()
+            for slot in sorted(self._slots):
+                new_tokens += self._harvest(slot, int(toks[slot]))
+        self._evict_finished()
+        if ann is not None:
+            ann.end()
+        self.metrics.record_step(
+            active_slots=len(self._slots), num_slots=self.num_slots,
+            queue_depth=self.scheduler.queue_depth,
+            new_tokens=new_tokens,
+            step_seconds=time.perf_counter() - t0)
+        return len(self._slots) + self.scheduler.queue_depth
+
+    def _emit(self, slot: int, tok: int, first_token: bool = False) -> None:
+        req = self._slots[slot].req
+        req.tokens.append(tok)
+        if first_token:
+            req.first_token_time = time.perf_counter()
+            self.metrics.on_first_token(req.arrival_time)
+        if req.stream is not None:
+            req.stream(req, tok)
+        eos = req.eos_token_id
+        if eos is not None and tok == eos:
+            req.finished, req.finish_reason = True, "eos"
+        elif len(req.tokens) >= req.max_new_tokens:
+            req.finished, req.finish_reason = True, "length"
+
+    def _harvest(self, slot: int, tok: int) -> int:
+        st = self._slots[slot]
+        if st.req.finished:
+            return 0  # finished at admit (eos/length on the first token)
+        st.pos += 1
+        self._emit(slot, tok)
+        return 1
+
+    def _evict_finished(self) -> None:
+        for slot in [s for s, st in self._slots.items() if st.req.finished]:
+            req = self.scheduler.release(slot)
+            req.finish_time = time.perf_counter()
+            self.pool.free(slot)
+            del self._slots[slot]
+            self._do_sample[slot] = False
+            self._sampling_dev = None
+            self.metrics.on_finish()
+
+    # ----------------------------------------------------- conveniences
+    def run_until_complete(self, max_steps: Optional[int] = None) -> int:
+        """Step until queue and slots drain; returns steps taken."""
+        steps = 0
+        while self.scheduler.has_work():
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"serving did not drain within {max_steps} steps")
+            self.step()
+            steps += 1
+        return steps

@@ -1,0 +1,131 @@
+"""Slot-pooled KV cache for continuous batching.
+
+One fixed allocation ``[num_slots, max_seq, kv_heads, head_dim]`` per
+layer per k/v holds EVERY in-flight request's context; a slot is one
+request's row.  The pool never reallocates: admission writes a freshly
+prefilled context into a free slot (``adopt``), eviction just returns the
+slot index to the free list (the stale rows are overwritten by the next
+occupant — and masked until then by the per-slot ``seq_lens`` feeding the
+ragged decode-attention kernel, kernels/decode_attention.py).
+
+The pool's per-layer view ``(k, v, pos_vector)`` is EXACTLY the models'
+functional cache tuple with a per-row position (models/kv_cache.py), so
+``model.decode_step`` runs over all slots unchanged — one fixed-shape
+compiled program regardless of which slots are live.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KVPool"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _adopt_row(buf, row, slot):
+    """Write a [1, max_seq, h, d] prefilled row into slab row ``slot``.
+    One compiled program per (shape, dtype) — ``slot`` stays traced."""
+    return jax.lax.dynamic_update_slice(buf, row, (slot, 0, 0, 0))
+
+
+class KVPool:
+    """Fixed-shape KV slab + free-list slot accounting.
+
+    Device state:
+      * ``ks/vs``   — per-layer [num_slots, max_seq, kv_heads, head_dim];
+      * ``seq_pos`` — [num_slots] int32, each slot's current cache length
+        (the per-row ``pos`` the models append at AND the ``seq_lens`` the
+        ragged attention masks by, after the in-step +1).
+
+    Host state: the free list.  Alloc/free/reset are host-side list ops —
+    no device sync, no reallocation.
+    """
+
+    def __init__(self, num_slots: int, max_seq: int, num_layers: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = num_slots
+        self.max_seq = max_seq
+        self.num_layers = num_layers
+        shape = (num_slots, max_seq, kv_heads, head_dim)
+        self.ks: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                    for _ in range(num_layers)]
+        self.vs: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                    for _ in range(num_layers)]
+        self.seq_pos = jnp.zeros((num_slots,), jnp.int32)
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+
+    @classmethod
+    def create(cls, model, num_slots: int,
+               max_seq: Optional[int] = None) -> "KVPool":
+        """Size the pool from a causal-LM's config (kv_heads falls back
+        to num_heads for MHA models like GPT)."""
+        cfg = model.cfg
+        max_seq = max_seq or cfg.max_seq_len
+        kv_heads = getattr(cfg, "kv_heads", None) or cfg.num_heads
+        return cls(num_slots, max_seq, cfg.num_layers, kv_heads,
+                   cfg.head_dim, dtype=jnp.dtype(cfg.dtype))
+
+    # ------------------------------------------------------------ slots
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def alloc(self) -> int:
+        """Claim a free slot (lowest index first, so slot churn reuses a
+        warm row).  Raises if the pool is full — the scheduler gates
+        admission on ``free_slots``."""
+        if not self._free:
+            raise RuntimeError("KVPool exhausted: no free slot")
+        return self._free.pop()
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} already free (double free)")
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        # park the freed row at position 0 so its ride-along decode writes
+        # stay at the row head (bounded) until the next adopt overwrites it
+        self.seq_pos = self.seq_pos.at[slot].set(0)
+
+    def reset(self) -> None:
+        """Return every slot to the free list; buffers stay allocated
+        (stale rows are masked by seq_pos=0 until overwritten)."""
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self.seq_pos = jnp.zeros((self.num_slots,), jnp.int32)
+
+    def adopt(self, slot: int, layer_caches, length: int) -> None:
+        """Move a freshly prefilled single-request cache (per-layer
+        ``(k [1, max_seq, h, d], v, _)`` tuples) into ``slot`` and record
+        its ``length`` valid positions.  The copy is a jitted
+        dynamic_update_slice with a traced slot index — admitting to a
+        different slot never recompiles."""
+        s = jnp.asarray(slot, jnp.int32)
+        for i, layer in enumerate(layer_caches):
+            self.ks[i] = _adopt_row(self.ks[i], layer[0], s)
+            self.vs[i] = _adopt_row(self.vs[i], layer[1], s)
+        self.seq_pos = self.seq_pos.at[slot].set(length)
+
+    # ------------------------------------------------------- cache views
+    def caches(self) -> List[Tuple[jax.Array, jax.Array, jax.Array]]:
+        """The models' cache pytree over all slots: per-layer
+        ``(k, v, seq_pos)`` with the SHARED per-slot position vector."""
+        return [(k, v, self.seq_pos) for k, v in zip(self.ks, self.vs)]
+
+    def update(self, new_caches) -> None:
+        """Absorb the cache pytree a decode step returned (every layer
+        advanced the shared position vector identically — keep layer 0's)."""
+        self.ks = [c[0] for c in new_caches]
+        self.vs = [c[1] for c in new_caches]
+        self.seq_pos = new_caches[0][2]

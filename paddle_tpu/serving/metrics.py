@@ -1,0 +1,111 @@
+"""Serving counters, updated by the engine OFF the hot path.
+
+Every update is a host-side float/int op on values the engine already
+holds (no extra device syncs: the engine's single per-step token readback
+feeds everything).  Exposed as a plain dict (``snapshot()``) and logged
+through the profiler's host-event tree: with ``record_events=True`` the
+engine wraps each step's prefill/decode phases in
+``profiler.RecordEvent`` annotations, so ``export_chrome_tracing``
+timelines show the serving loop alongside device activity.
+
+Glossary (docs/serving.md has the full definitions):
+  * ttft            — submit -> first generated token, per request;
+  * tokens/s        — generated tokens over the engine's busy wall time;
+  * queue depth     — waiting requests at each step;
+  * slot occupancy  — occupied/total slots at each step;
+  * batch fill      — mean occupancy over steps: the fraction of the
+    fixed-shape decode batch doing useful work (THE continuous-batching
+    payoff metric — static batching idles slots that finished early).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    def __init__(self, record_events: bool = False):
+        # record_events=True wraps each step in a profiler.RecordEvent so
+        # host traces (profiler.export_chrome_tracing) carry serving steps
+        self.record_events = record_events
+        self.reset()
+
+    def reset(self) -> None:
+        self.requests_submitted = 0
+        self.requests_finished = 0
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.prefill_tokens = 0
+        self.steps = 0
+        self._busy_s = 0.0
+        self._ttfts: List[float] = []
+        self._queue_depth_sum = 0
+        self._occupancy_sum = 0.0
+
+    # ------------------------------------------------------------ events
+    def on_submit(self, n: int = 1) -> None:
+        self.requests_submitted += n
+
+    def on_prefill(self, prompt_len: int) -> None:
+        self.prefills += 1
+        self.prefill_tokens += prompt_len
+
+    def on_first_token(self, arrival_time: float) -> None:
+        self._ttfts.append(time.perf_counter() - arrival_time)
+
+    def on_finish(self, n: int = 1) -> None:
+        self.requests_finished += n
+
+    def record_step(self, active_slots: int, num_slots: int,
+                    queue_depth: int, new_tokens: int,
+                    step_seconds: float) -> None:
+        """One engine step's accounting (called after the token harvest —
+        never between device dispatches)."""
+        self.steps += 1
+        self.tokens_generated += new_tokens
+        self._busy_s += step_seconds
+        self._queue_depth_sum += queue_depth
+        self._occupancy_sum += active_slots / max(num_slots, 1)
+
+    # ---------------------------------------------------------- snapshot
+    @property
+    def mean_ttft_ms(self) -> Optional[float]:
+        if not self._ttfts:
+            return None
+        return 1e3 * sum(self._ttfts) / len(self._ttfts)
+
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        if self._busy_s <= 0:
+            return None
+        return self.tokens_generated / self._busy_s
+
+    @property
+    def batch_fill_ratio(self) -> Optional[float]:
+        if self.steps == 0:
+            return None
+        return self._occupancy_sum / self.steps
+
+    @property
+    def mean_queue_depth(self) -> Optional[float]:
+        if self.steps == 0:
+            return None
+        return self._queue_depth_sum / self.steps
+
+    def snapshot(self) -> Dict[str, object]:
+        r = lambda v, nd=4: None if v is None else round(v, nd)
+        return {
+            "requests_submitted": self.requests_submitted,
+            "requests_finished": self.requests_finished,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "prefill_tokens": self.prefill_tokens,
+            "steps": self.steps,
+            "tokens_per_sec": r(self.tokens_per_sec, 1),
+            "mean_ttft_ms": r(self.mean_ttft_ms, 2),
+            "batch_fill_ratio": r(self.batch_fill_ratio),
+            "mean_queue_depth": r(self.mean_queue_depth, 2),
+        }

@@ -1,0 +1,166 @@
+"""Request admission + FCFS queue + prefill/decode interleaving policy.
+
+The scheduler is pure host-side control plane: it owns the waiting queue,
+the slot -> request map, and the BUCKETING policy that keeps the compile
+cache bounded.  Nothing here touches device arrays — the engine asks
+"what should run this step" and the scheduler answers with host ints.
+
+Bucketing: prefill runs at the prompt's length rounded UP to a power of
+two (floor ``min_bucket``), so a mixed-length workload lowers at most
+``O(log2(max_seq / min_bucket))`` distinct prefill programs instead of
+one per length — graftlint's recompile-hazard rule applied to serving.
+Decode is always the single ``[num_slots, 1]`` program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SamplingParams", "Request", "Scheduler", "bucket_length"]
+
+DEFAULT_MIN_BUCKET = 16
+
+
+def bucket_length(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
+                  max_len: Optional[int] = None) -> int:
+    """Smallest power-of-two >= ``n`` (floored at ``min_bucket``, capped
+    at ``max_len``).  The cap may round DOWN below the pow2 — a prompt of
+    0.9*max_seq still pads only to max_len, never past the cache."""
+    if n < 1:
+        raise ValueError("length must be >= 1")
+    if max_len is not None and n > max_len:
+        raise ValueError(f"length {n} exceeds max_len {max_len}")
+    b = max(min_bucket, 1)
+    while b < n:
+        b *= 2
+    if max_len is not None:
+        b = min(b, max_len)
+    return b
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request decode policy.  ``do_sample=False`` is greedy (the
+    temperature/top_k/top_p knobs are then inert); sampling applies
+    temperature, then top-k (0 = off), then top-p (1.0 = off) — the same
+    order and semantics as ``models.generation.generate``."""
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.do_sample and self.temperature <= 0:
+            raise ValueError("temperature must be > 0 when sampling")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must lie in (0, 1]")
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request (control-plane state; the KV
+    context lives in the pool slot while the request is running)."""
+    request_id: int
+    prompt: np.ndarray                       # [prompt_len] int token ids
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token_id: Optional[int] = None
+    stream: Optional[object] = None          # callable(request, token)
+    arrival_time: float = 0.0
+    # engine-owned progress
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None      # "eos" | "length"
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+
+class Scheduler:
+    """FCFS admission over a fixed slot budget.
+
+    ``admit()`` pops waiting requests in arrival order while free slots
+    remain — the engine prefills each admitted request (one bucketed
+    program) and then runs ONE decode step over all occupied slots, so
+    prefill and decode interleave at step granularity."""
+
+    def __init__(self, num_slots: int, max_seq: int,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 max_prefills_per_step: Optional[int] = None):
+        self.num_slots = num_slots
+        self.max_seq = max_seq
+        self.min_bucket = min_bucket
+        # None = admit as many as slots allow each step; a small cap
+        # trades TTFT of queued requests against decode stalls of the
+        # already-running ones (prefill blocks the shared step loop)
+        self.max_prefills_per_step = max_prefills_per_step
+        self.waiting: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}      # slot -> request
+        self._ids = itertools.count()
+
+    # -------------------------------------------------------- submission
+    def submit(self, req: Request) -> Request:
+        req.sampling.validate()
+        if req.prompt_len < 1:
+            raise ValueError("prompt must hold at least one token")
+        if req.prompt_len + req.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt_len {req.prompt_len} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds the pool max_seq "
+                f"{self.max_seq}")
+        if req.arrival_time == 0.0:
+            req.arrival_time = time.perf_counter()
+        self.waiting.append(req)
+        return req
+
+    def next_request_id(self) -> int:
+        return next(self._ids)
+
+    # --------------------------------------------------------- admission
+    def bucket(self, prompt_len: int) -> int:
+        return bucket_length(prompt_len, self.min_bucket, self.max_seq)
+
+    def admit(self, free_slots: int) -> List[Tuple[Request, int]]:
+        """FCFS: pop up to ``free_slots`` (and the per-step prefill cap)
+        waiting requests, returning ``(request, prefill_bucket)`` pairs in
+        arrival order.  Slot indices are assigned by the caller (the pool
+        owns the free list)."""
+        cap = free_slots if self.max_prefills_per_step is None else \
+            min(free_slots, self.max_prefills_per_step)
+        out: List[Tuple[Request, int]] = []
+        while self.waiting and len(out) < cap:
+            req = self.waiting.popleft()
+            out.append((req, self.bucket(req.prompt_len)))
+        return out
+
+    def place(self, req: Request, slot: int) -> None:
+        if slot in self.running:
+            raise ValueError(f"slot {slot} already occupied")
+        self.running[slot] = req
+
+    def release(self, slot: int) -> Request:
+        return self.running.pop(slot)
+
+    # ------------------------------------------------------------- state
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)

@@ -35,6 +35,9 @@ DEFAULT_HOT_PATHS = (
     "paddle_tpu/kernels/*.py",
     "paddle_tpu/models/trainer.py",
     "paddle_tpu/distributed/pipelining.py",
+    # serving step loop: the engine's contract is ONE readback per step,
+    # host-side — its jitted prefill/decode bodies must never sync
+    "paddle_tpu/serving/*.py",
 )
 _ALL_FUNCTIONS_PATHS = ("paddle_tpu/kernels/*.py",)
 

@@ -36,6 +36,8 @@ _PREDICATE = "host predicate/introspection helper, not an array op"
 _COMPOSITE = "composite wrapper over registered primitives; covered by module-level tests"
 _NN_LAYER_PATH = "exercised through its nn.Layer wrapper in layer tests"
 _SPECIALIZED = "specialized op with dedicated tests outside the registry harness"
+_SERVING = ("serving control-plane API (request lifecycle / scheduling / "
+            "metrics), not an array op; covered by tests/test_serving.py")
 
 ALLOWLIST: Dict[str, str] = {
     # ---- stochastic samplers (tensor/random.py + dropout family)
@@ -129,6 +131,15 @@ ALLOWLIST: Dict[str, str] = {
         "flash_attention", "flash_attn_unpadded",
         "scaled_dot_product_attention", "sdpa_reference", "swiglu",
     )},
+    # ---- paddle_tpu.serving public surface (the SRV registry surface:
+    #      engine/scheduler/pool classes and their helpers are request
+    #      lifecycle, not numeric ops — the OpTest harness has no oracle
+    #      for them; tests/test_serving.py is their contract)
+    **{n: _SERVING for n in (
+        "ServingEngine", "EngineCore", "Request", "RequestOutput",
+        "SamplingParams", "Scheduler", "KVPool", "ServingMetrics",
+        "bucket_length", "sample_rows",
+    )},
 }
 
 
@@ -145,6 +156,7 @@ class RegistryDriftChecker(Checker):
         self.surfaces = surfaces or {
             "T": "paddle_tpu/tensor",
             "F": "paddle_tpu/nn/functional",
+            "SRV": "paddle_tpu/serving",
         }
         self.allowlist = ALLOWLIST if allowlist is None else allowlist
 

@@ -332,6 +332,14 @@ PADDLE_HUB = """
 help list load
 """
 
+# Paddle-Serving / PaddleNLP predictor analog: the TPU-native
+# continuous-batching serving engine (docs/serving.md) — slot-pooled KV
+# cache, FCFS scheduler with pow2 prefill buckets, per-slot sampling
+PADDLE_SERVING = """
+ServingEngine Request RequestOutput SamplingParams
+EngineCore KVPool Scheduler ServingMetrics bucket_length sample_rows
+"""
+
 PADDLE_STATIC_NN = """
 case cond switch_case while_loop
 fc conv2d batch_norm embedding
@@ -454,6 +462,7 @@ REFERENCE = {
     "paddle.audio.functional": PADDLE_AUDIO_FUNCTIONAL,
     "paddle.text": PADDLE_TEXT,
     "paddle.hub": PADDLE_HUB,
+    "paddle.serving": PADDLE_SERVING,
     "paddle.static.nn": PADDLE_STATIC_NN,
     "paddle.distributed.fleet": PADDLE_DISTRIBUTED_FLEET,
     "paddle.distributed.fleet.meta_optimizers": PADDLE_FLEET_META_OPTIMIZERS,
@@ -511,6 +520,7 @@ TARGETS = {
     "paddle.audio.functional": "paddle_tpu.audio.functional",
     "paddle.text": "paddle_tpu.text",
     "paddle.hub": "paddle_tpu.hub",
+    "paddle.serving": "paddle_tpu.serving",
     "paddle.static.nn": "paddle_tpu.static.nn",
     "paddle.distributed.fleet": "paddle_tpu.distributed.fleet",
     "paddle.distributed.fleet.meta_optimizers":

@@ -1,0 +1,32 @@
+"""POSITIVE fixture: serving hot-loop host syncs (scanned as a hot path).
+
+The continuous-batching contract is ONE host readback per engine step,
+performed by the host-side harvest — never inside the compiled step
+bodies.  This scheduler step commits the classic violations.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decode_step(caches, last_tok, seq_pos):
+    logits = jnp.einsum("s,sv->sv", last_tok.astype(jnp.float32), caches)
+    nxt = jnp.argmax(logits, axis=-1)
+    # (1) per-step .item() readback stalls the whole decode batch
+    first = nxt[0].item()
+    # (2) float() around a traced computation — the "log every step" sync
+    depth = float(jnp.sum(seq_pos))
+    # (3) full device_get of the cache slab inside the step
+    host_caches = jax.device_get(caches)
+    return nxt, first, depth, host_caches
+
+
+def scheduler_loop_body(carry, tok):
+    # (4) host copy of a computed value inside a lax.scan body
+    emitted = np.asarray(tok * 2)
+    return carry, emitted
+
+
+def drain(tokens):
+    return jax.lax.scan(scheduler_loop_body, 0, tokens)

@@ -1,0 +1,20 @@
+"""POSITIVE fixture: unbucketed prefill — the serving recompile hazard.
+
+Jitting a fresh callable per arriving prompt length compiles one program
+PER DISTINCT LENGTH: an open-world workload (every prompt length
+different) grows the compile cache without bound and stalls admission on
+every novel length.  The engine's rule: pad prompts to pow2 buckets and
+build one jitted prefill per bucket, outside the admission loop.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def admit_all(model, prompts):
+    outs = []
+    for prompt in prompts:                      # admission loop
+        # jit built INSIDE the loop: a new callable (and compile-cache
+        # entry) for every request — the unbucketed dynamic-shape hazard
+        prefill = jax.jit(lambda ids: model(ids[None, :]))
+        outs.append(prefill(jnp.asarray(prompt)))
+    return outs

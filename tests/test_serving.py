@@ -1,0 +1,277 @@
+"""Continuous-batching serving engine (paddle_tpu.serving).
+
+The load-bearing contracts:
+  * engine greedy output == ``model.generate`` token-for-token on
+    mixed-length prompts (the engine is a scheduler around the SAME
+    decode arithmetic, so exact equality is the bar, not tolerance);
+  * per-slot sampling reproduces ``generate(seed=...)`` exactly for a
+    single request (same key-split discipline);
+  * slot eviction/reuse and FCFS admission under over-subscription;
+  * the compile-count guard: a mixed-length workload lowers at most
+    O(num_buckets) prefill programs + ONE decode program.
+
+Most GPT tests share one module-scoped engine (every test drains the
+requests it submits, so the pool is empty between tests) and a standard
+prompt-length set, so jit caches amortize across the file; the
+compile-count test builds its own instance because it asserts on trace
+counters from a cold start.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import (GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM, gpt_tiny)
+from paddle_tpu.serving import (KVPool, SamplingParams, Scheduler,
+                                ServingEngine, bucket_length)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    with jax.default_prng_impl("rbg"):
+        return GPTForCausalLM(gpt_tiny())
+
+
+@pytest.fixture(scope="module")
+def eng(gpt):
+    return ServingEngine(gpt, num_slots=3, min_bucket=8)
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _want_tokens(model, prompt, n=5, **kw):
+    """Oracle: the single-request generate() tail for the same prompt."""
+    seq = model.generate(jnp.asarray(prompt)[None], max_new_tokens=n, **kw)
+    return np.asarray(seq)[0, len(prompt):]
+
+
+# ------------------------------------------------------------ correctness
+
+def test_greedy_matches_generate_mixed_lengths(gpt, eng):
+    prompts = _prompts(0, (3, 7, 12, 5))
+    outs = eng.serve_batch(prompts, max_new_tokens=5, max_steps=200)
+    for p, o in zip(prompts, outs):
+        assert o.finished and o.finish_reason == "length"
+        np.testing.assert_array_equal(np.asarray(o.tokens),
+                                      _want_tokens(gpt, p))
+        np.testing.assert_array_equal(
+            o.sequence, np.concatenate([p, _want_tokens(gpt, p)]))
+
+
+def test_sampling_matches_generate_single_request(gpt, eng):
+    """Per-slot keys follow generate()'s split discipline, so a lone
+    sampled request reproduces generate(seed=...) exactly."""
+    p = _prompts(1, (7,))[0]
+    sp = SamplingParams(do_sample=True, temperature=1.7, top_k=9,
+                        top_p=0.85, seed=11)
+    rid = eng.submit(p, max_new_tokens=5, sampling=sp)
+    eng.run_until_complete(100)
+    want = _want_tokens(gpt, p, do_sample=True, temperature=1.7,
+                        top_k=9, top_p=0.85, seed=11)
+    np.testing.assert_array_equal(np.asarray(eng.result(rid).tokens), want)
+
+
+def test_sampling_per_slot_isolation(gpt, eng):
+    """Concurrent requests with DIFFERENT sampling params each match
+    their solo generate() run — one slot's randomness/filters never
+    leak into a neighbour."""
+    prompts = _prompts(2, (3, 7, 5))
+    params = [SamplingParams(),                                   # greedy
+              SamplingParams(do_sample=True, temperature=2.0, seed=3),
+              SamplingParams(do_sample=True, top_k=5, top_p=0.7, seed=4)]
+    rids = [eng.submit(p, max_new_tokens=5, sampling=s)
+            for p, s in zip(prompts, params)]
+    eng.run_until_complete(100)
+    wants = [_want_tokens(gpt, prompts[0]),
+             _want_tokens(gpt, prompts[1], do_sample=True,
+                          temperature=2.0, seed=3),
+             _want_tokens(gpt, prompts[2], do_sample=True, top_k=5,
+                          top_p=0.7, seed=4)]
+    for rid, want in zip(rids, wants):
+        np.testing.assert_array_equal(np.asarray(eng.result(rid).tokens),
+                                      want)
+
+
+def test_eos_finishes_early(gpt, eng):
+    p = _prompts(3, (7,))[0]
+    free = _want_tokens(gpt, p)
+    eos = int(free[2])              # greedy emits this at step 2 of 5
+    rid = eng.submit(p, max_new_tokens=5, eos_token_id=eos)
+    eng.run_until_complete(100)
+    out = eng.result(rid)
+    assert out.finish_reason == "eos"
+    stop = int(np.flatnonzero(free == eos)[0])
+    np.testing.assert_array_equal(np.asarray(out.tokens), free[:stop + 1])
+
+
+def test_llama_engine_greedy_parity():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    prompts = _prompts(4, (2, 9, 5), vocab=128)
+    engine = ServingEngine(model, num_slots=2, min_bucket=8)
+    outs = engine.serve_batch(prompts, max_new_tokens=4, max_steps=100)
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(np.asarray(o.tokens),
+                                      _want_tokens(model, p, 4))
+
+
+# ----------------------------------------------------- scheduling / slots
+
+def test_slot_eviction_reuse_and_oversubscription(gpt, eng):
+    """8 requests through 3 slots: every slot is reused, admission stays
+    FCFS, the queue drains, and outputs still match generate()."""
+    eng.metrics.reset()
+    prompts = _prompts(5, (3, 5, 7, 5, 9, 7, 3, 5))
+    rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    assert eng.core.scheduler.queue_depth == 8    # nothing admitted yet
+    eng.step()
+    assert eng.core.scheduler.active == 3         # all slots filled
+    assert eng.core.scheduler.queue_depth == 5
+    eng.run_until_complete(200)
+    assert eng.core.pool.free_slots == 3
+    assert eng.core.scheduler.queue_depth == 0
+    m = eng.metrics_dict()
+    assert m["requests_finished"] == 8
+    assert m["prefills"] == 8                     # every slot re-prefilled
+    # FCFS: with equal max_new_tokens, the first submission finishes
+    # before the last (later arrivals wait for freed slots)
+    times = [eng._requests[r].finish_time for r in rids]
+    assert all(t is not None for t in times)
+    assert times[0] < times[-1]
+    for p, rid in zip(prompts, rids):
+        np.testing.assert_array_equal(
+            np.asarray(eng.result(rid).tokens), _want_tokens(gpt, p))
+
+
+def test_pool_alloc_free_cycle():
+    pool = KVPool(num_slots=2, max_seq=16, num_layers=1, kv_heads=2,
+                  head_dim=4)
+    a, b = pool.alloc(), pool.alloc()
+    assert {a, b} == {0, 1} and pool.free_slots == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc()
+    pool.free(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(a)
+    assert pool.alloc() == a                      # lowest slot reused
+    pool.reset()
+    assert pool.free_slots == 2
+
+
+def test_scheduler_validation_and_buckets():
+    sched = Scheduler(num_slots=2, max_seq=128, min_bucket=16)
+    assert sched.bucket(1) == 16
+    assert sched.bucket(16) == 16
+    assert sched.bucket(17) == 32
+    assert sched.bucket(100) == 128               # pow2 capped at max_seq
+    assert bucket_length(100, 16, None) == 128
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_length(200, 16, 128)
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingParams(do_sample=True, temperature=0.0).validate()
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(top_p=0.0).validate()
+
+
+def test_submit_rejects_overlong(gpt, eng):
+    with pytest.raises(ValueError, match="max_seq"):
+        eng.submit(np.zeros(120, np.int32), max_new_tokens=20)  # > 128
+
+
+# ------------------------------------------------------- compile bounding
+
+def test_compile_count_is_bucket_bounded(gpt):
+    """THE fixed-shape contract: a mixed-length mixed-arrival workload
+    lowers at most one prefill program per pow2 bucket plus ONE decode
+    program — prompt length diversity must never leak into the compile
+    cache (trace counters tick only when jit actually traces)."""
+    lengths = (3, 5, 8, 9, 13, 17, 20, 31, 6, 11)
+    buckets = {bucket_length(L, 8, 128) for L in lengths}   # {8, 16, 32}
+    engine = ServingEngine(gpt, num_slots=3, min_bucket=8)
+    rids = [engine.submit(p, max_new_tokens=3 + (i % 3))
+            for i, p in enumerate(_prompts(6, lengths))]
+    engine.run_until_complete(500)
+    assert all(engine.result(r).finished for r in rids)
+    assert engine.core.trace_counts["decode"] == 1
+    assert engine.core.trace_counts["prefill"] == len(buckets) == 3
+
+
+# ------------------------------------------------------ streaming / misc
+
+def test_stream_yields_tokens_incrementally(gpt, eng):
+    p = _prompts(7, (5,))[0]
+    rid = eng.submit(p, max_new_tokens=5)
+    got = list(eng.stream(rid))
+    np.testing.assert_array_equal(np.asarray(got), _want_tokens(gpt, p))
+    assert eng.result(rid).finished
+
+
+def test_stream_callback_fires_per_token(gpt, eng):
+    p = _prompts(8, (7,))[0]
+    seen = []
+    rid = eng.submit(p, max_new_tokens=5,
+                     stream=lambda req, tok: seen.append(tok))
+    eng.run_until_complete(100)
+    assert seen == eng.result(rid).tokens
+
+
+def test_metrics_snapshot_and_purge(gpt, eng):
+    eng.metrics.reset()
+    before = set(eng._requests)
+    outs = eng.serve_batch(_prompts(9, (3, 5, 9)), max_new_tokens=3,
+                           max_steps=100)
+    m = eng.metrics_dict()
+    assert m["requests_submitted"] == m["requests_finished"] == 3
+    assert m["tokens_generated"] == 9
+    assert m["prefill_tokens"] == 17
+    assert 0 < m["batch_fill_ratio"] <= 1.0
+    assert m["tokens_per_sec"] > 0
+    assert m["mean_ttft_ms"] > 0
+    assert all(o.ttft_s is not None and o.ttft_s >= 0 for o in outs)
+    # serve_batch purges its requests — batch after batch, no growth
+    assert set(eng._requests) == before
+
+
+def test_run_until_complete_max_steps_guard(gpt, eng):
+    rid = eng.submit(_prompts(10, (4,))[0], max_new_tokens=10)
+    with pytest.raises(RuntimeError, match="did not drain"):
+        eng.run_until_complete(max_steps=2)
+    eng.run_until_complete(100)                   # drain for later tests
+    assert eng.result(rid).finished
+
+
+def test_inference_predictor_routes_to_engine(gpt):
+    """Config(model=<causal-LM>) serves through the engine instead of
+    requiring a jit.save artifact; ragged prompt_lens round-trip."""
+    from paddle_tpu import inference
+    cfg = inference.Config(model=gpt).set_serving_options(
+        num_slots=2, max_new_tokens=4)
+    pred = inference.create_predictor(cfg)
+    assert isinstance(pred, inference.ServingPredictor)
+    prompts = _prompts(11, (3, 7))
+    ids = np.zeros((2, 7), np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, :len(p)] = p
+    pred.get_input_handle("input_ids").copy_from_cpu(ids)
+    pred.get_input_handle("prompt_lens").copy_from_cpu(
+        np.asarray([3, 7], np.int32))
+    assert pred.run()
+    toks = pred.get_output_handle("generated_ids").copy_to_cpu()
+    lens = pred.get_output_handle("generated_lens").copy_to_cpu()
+    assert toks.shape == (2, 4) and list(lens) == [4, 4]
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(toks[i], _want_tokens(gpt, p, 4))
+
+
+def test_inference_config_rejects_non_model():
+    from paddle_tpu import inference
+    with pytest.raises(TypeError, match="init_cache"):
+        inference.Config(model=object())

@@ -98,6 +98,62 @@ def test_host_sync_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def _serving_host_sync_checker():
+    return HostSyncChecker(hot_paths=("serving_host_sync_pos.py",
+                                      "serving_host_sync_neg.py"),
+                           all_functions_paths=())
+
+
+def test_serving_host_sync_positive():
+    """Serving hot-loop idiom: per-step host syncs inside the compiled
+    decode/scheduler bodies (the engine's one-readback-per-step contract
+    violated four ways)."""
+    res = run_analysis([str(LINT / "serving_host_sync_pos.py")],
+                       checkers=[_serving_host_sync_checker()],
+                       root=str(LINT))
+    found = only_rule(res, "host-sync")
+    assert len(found) == 4, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert ".item()" in msgs
+    assert "float()" in msgs
+    assert "device_get" in msgs
+    assert "copies a computed value" in msgs
+
+
+def test_serving_host_sync_negative():
+    """The engine's legal shape: one host readback AFTER the dispatch,
+    admission bookkeeping in plain host code — silent."""
+    res = run_analysis([str(LINT / "serving_host_sync_neg.py")],
+                       checkers=[_serving_host_sync_checker()],
+                       root=str(LINT))
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_serving_package_is_a_default_hot_path():
+    """The shipped rule config must keep covering the serving step loop
+    (the fixtures above prove the rule catches the idioms; this pins the
+    production glob so the coverage cannot silently regress)."""
+    from paddle_tpu.tools.analysis.checkers.host_sync import \
+        DEFAULT_HOT_PATHS
+    assert "paddle_tpu/serving/*.py" in DEFAULT_HOT_PATHS
+
+
+def test_serving_recompile_positive():
+    """Unbucketed prefill: a fresh jit per arriving prompt length — one
+    compiled program per distinct length (jit-in-loop + jit-of-lambda)."""
+    res = run_rule("serving_recompile_pos.py", "recompile-hazard")
+    found = only_rule(res, "recompile-hazard")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "inside a loop" in msgs
+    assert "lambda" in msgs
+
+
+def test_serving_recompile_negative():
+    res = run_rule("serving_recompile_neg.py", "recompile-hazard")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_axis_name_positive():
     res = run_rule("axis_name_pos.py", "axis-name")
     found = only_rule(res, "axis-name")
