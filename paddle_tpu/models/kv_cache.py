@@ -23,7 +23,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["append_kv", "cache_lens"]
+__all__ = ["append_kv", "cache_lens", "gather_block_rows",
+           "scatter_block_rows"]
 
 
 def _is_per_row(pos) -> bool:
@@ -42,6 +43,33 @@ def append_kv(pk, pv, k, v, pos):
         return upd(pk, k, p), upd(pv, v, p)
     return (jax.lax.dynamic_update_slice_in_dim(pk, k, pos, axis=1),
             jax.lax.dynamic_update_slice_in_dim(pv, v, pos, axis=1))
+
+
+def gather_block_rows(block_buf, idx):
+    """Assemble a contiguous cache row from block-pool rows: gather
+    ``idx`` ([n] int32 block ids, clamped in bounds) out of ``block_buf``
+    ([num_blocks, block_len, h, d]) and flatten to ``[n * block_len, h,
+    d]`` — the cache-view a slot adopts its shared prefix from.  Entries
+    past the true match count gather stale rows; callers mask them via
+    the per-row ``seq_lens`` (exactly the slot-reuse discipline of
+    ``KVPool``), so no in-kernel validity select is needed."""
+    rows = jnp.take(block_buf, jnp.asarray(idx, jnp.int32), axis=0,
+                    mode="clip")
+    n, bl, h, d = rows.shape
+    return rows.reshape(n * bl, h, d)
+
+
+def scatter_block_rows(block_buf, row, dest):
+    """Inverse of :func:`gather_block_rows`: split a contiguous cache row
+    ``[n * block_len, h, d]`` into block_len pieces and scatter piece j
+    into ``block_buf[dest[j]]``.  ``dest`` entries >= num_blocks are
+    DROPPED (out-of-bounds scatter mode) — the one-program way to write
+    an arbitrary SUBSET of a prompt's blocks (only the freshly computed
+    ones; already-cached prefix blocks stay untouched)."""
+    nb, bl, h, d = block_buf.shape
+    pieces = row.reshape(-1, bl, h, d)
+    return block_buf.at[jnp.asarray(dest, jnp.int32)].set(pieces,
+                                                          mode="drop")
 
 
 def cache_lens(pos, s: int, batch: int):

@@ -7,9 +7,11 @@ weight_only_linear kernels and cutlass int8 GEMMs).
 
 TPU-native design: weight-only int8/int4 keeps activations in
 bf16/f32 and stores weights quantized per output channel; the forward
-dequantizes at use — XLA fuses the ``w_int * scale`` rescale into the
-matmul so HBM traffic drops by 2-4x (the decode-time bottleneck) while
-the MXU still runs the contraction in bf16.  ``llm_int8_linear``
+contracts against the raw integer weights and applies the per-channel
+scale AFTER the dot (exact for per-output-channel scales), so HBM
+traffic drops by 2-4x (the decode-time bottleneck) and no full-size
+dequantized weight is ever materialized, while the MXU still runs the
+contraction in bf16.  ``llm_int8_linear``
 implements the LLM.int8 outlier decomposition (arXiv 2208.07339): the
 few activation columns above ``threshold`` run in float, the rest in
 int8 x int8 -> int32 on the MXU's double-rate integer path.
@@ -77,12 +79,24 @@ def weight_dequantize(x, scale, algo: str = "weight_only_int8",
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype: str = "int8", arch=None,
                        group_size: int = -1):
-    """y = x @ dequant(weight) + bias — weights stay quantized in HBM,
-    the dequant fuses into the matmul."""
-    algo = "weight_only_int4" if weight_dtype == "int4" else \
-        "weight_only_int8"
-    w = weight_dequantize(weight, weight_scale, algo, out_dtype=x.dtype)
-    y = x @ w
+    """y = (x @ w_int) * scale + bias — weights stay quantized in HBM
+    and the rescale runs AFTER the contraction.
+
+    Scale-after-dot is exact for per-output-channel scales
+    (``sum_i x_i * (q_ij * s_j) == (sum_i x_i * q_ij) * s_j``) and is
+    what makes int8 decode actually beat fp: dequantize-then-matmul
+    rebuilds the full [in, out] float weight every step — an O(in*out)
+    multiply XLA does NOT reliably sink into the dot, which made the
+    bench's gpt_decode_int8 row SLOWER than fp (0.87x in BENCH_r05).
+    After the dot the rescale is O(out) per row."""
+    if weight_dtype == "int4":
+        w_int = _unpack_int4(weight).astype(x.dtype)
+        denom = 7.0
+    else:
+        w_int = weight.astype(x.dtype)
+        denom = 127.0
+    y = (x @ w_int).astype(jnp.float32) * (weight_scale / denom)
+    y = y.astype(x.dtype)
     if bias is not None:
         y = y + bias
     return y

@@ -3,18 +3,23 @@
 Reference analog: the serving stack the reference feeds through
 fused_multi_transformer — PaddleNLP's predictor loop batching concurrent
 generation requests over one shared decoder.  Here the same capability is
-built TPU-natively: a slot-pooled KV cache (kv_pool), FCFS admission with
-pow2 prefill buckets (scheduler), one compiled fixed-shape decode step
-with per-slot sampling (engine), a submit/step/stream surface (api), and
-off-hot-path serving metrics (metrics).  See docs/serving.md.
+built TPU-natively: a slot-pooled KV cache + shared-prefix block pool
+(kv_pool), a radix tree reusing cached prefixes across requests
+(prefix_cache), FCFS admission with pow2 prefill buckets, chunked
+prefill and a bounded head-of-line skip (scheduler), one compiled
+fixed-shape decode step with per-slot sampling (engine), a
+submit/step/stream surface (api), and off-hot-path serving metrics
+(metrics).  See docs/serving.md.
 """
 
 from .api import Request, RequestOutput, SamplingParams, ServingEngine
 from .engine import EngineCore, sample_rows
-from .kv_pool import KVPool
+from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
+from .prefix_cache import MatchResult, PrefixCache
 from .scheduler import Scheduler, bucket_length
 
 __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
-           "EngineCore", "sample_rows", "KVPool", "ServingMetrics",
+           "EngineCore", "sample_rows", "KVPool", "BlockPool",
+           "PrefixCache", "MatchResult", "ServingMetrics",
            "Scheduler", "bucket_length"]

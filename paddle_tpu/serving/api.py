@@ -42,6 +42,7 @@ class RequestOutput:
     finished: bool
     finish_reason: Optional[str]      # "eos" | "length" | None
     ttft_s: Optional[float]           # submit -> first token
+    prefix_hit_tokens: int = 0        # prompt tokens served from cache
 
     @property
     def sequence(self) -> np.ndarray:
@@ -59,16 +60,36 @@ class ServingEngine:
     budget (default: the model's max_seq_len).  All shapes are static:
     admission cost is bounded by the pow2 prefill buckets, decode is one
     compiled program for the engine's lifetime.
+
+    Prefix reuse (``enable_prefix_cache``, default on): prompts sharing a
+    block-aligned prefix with earlier traffic skip its recompute — the
+    radix cache (serving/prefix_cache.py) copies the cached KV blocks
+    into the slot and only the suffix prefills, so TTFT is O(suffix).
+    ``prefill_chunk`` additionally splits long suffixes into fixed-width
+    chunks interleaved with decode (one chunk per step), bounding the
+    decode stall an 8k admission can inject.
+    ``max_prefill_tokens_per_step`` caps admission prefill work per step;
+    when the queue head exceeds it a later small request may be admitted
+    first (bounded skip — see ``Scheduler``).
     """
 
     def __init__(self, model, num_slots: int = 8,
                  max_seq: Optional[int] = None, min_bucket: int = 16,
                  max_prefills_per_step: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 max_prefill_tokens_per_step: Optional[int] = None,
+                 enable_prefix_cache: bool = True,
+                 block_len: int = 16,
+                 prefix_blocks: Optional[int] = None,
                  record_events: bool = False):
         self.core = EngineCore(
             model, num_slots=num_slots, max_seq=max_seq,
             min_bucket=min_bucket,
             max_prefills_per_step=max_prefills_per_step,
+            prefill_chunk=prefill_chunk,
+            max_prefill_tokens_per_step=max_prefill_tokens_per_step,
+            enable_prefix_cache=enable_prefix_cache,
+            block_len=block_len, prefix_blocks=prefix_blocks,
             metrics=ServingMetrics(record_events=record_events))
         self._requests = {}
 
@@ -126,7 +147,8 @@ class ServingEngine:
             ttft = req.first_token_time - req.arrival_time
         return RequestOutput(request_id=req.request_id, prompt=req.prompt,
                              tokens=list(req.tokens), finished=req.finished,
-                             finish_reason=req.finish_reason, ttft_s=ttft)
+                             finish_reason=req.finish_reason, ttft_s=ttft,
+                             prefix_hit_tokens=req.prefix_hit_tokens)
 
     def purge(self, request_id: int) -> RequestOutput:
         """``result()`` + drop the engine's reference to the finished
@@ -166,4 +188,9 @@ class ServingEngine:
         return self.core.metrics
 
     def metrics_dict(self) -> dict:
-        return self.core.metrics.snapshot()
+        out = self.core.metrics.snapshot()
+        if self.core.prefix_cache is not None:
+            # lifetime radix-cache state (block occupancy, evictions) —
+            # unlike the engine counters these survive metrics.reset()
+            out["prefix_cache"] = self.core.prefix_cache.stats()
+        return out

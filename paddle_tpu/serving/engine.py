@@ -3,22 +3,34 @@
 Device plane (all jitted, all fixed-shape — graftlint's recompile-hazard
 rule is the design constraint):
 
-  * ``prefill``  — one program per LENGTH BUCKET: ``[1, bucket]`` prompt
-    into a fresh ``[1, max_seq]`` cache, returning the last-valid-token
-    logits (a traced prompt length selects the row, so padding never
-    recompiles) and the cache the pool adopts into the request's slot;
+  * ``prefill``  — one program per CHUNK WIDTH: ``[1, width]`` tokens
+    appended into a ``[1, max_seq]`` staging cache at a traced offset,
+    returning the last-valid-token logits (a traced valid count selects
+    the row, so padding never recompiles).  Width comes from the
+    scheduler's chunk plan: without chunking, one pow2-bucketed chunk
+    covers the whole uncached suffix (the classic shape); with
+    ``prefill_chunk`` set, long suffixes run as fixed-width pieces
+    interleaved with decode, so one 8k admission never stalls the
+    in-flight streams for more than one chunk;
+  * ``block copy`` — the radix prefix cache's two programs
+    (kv_pool.BlockPool): gather matched prefix blocks into the staging
+    cache at admission, scatter freshly computed blocks out of the slot
+    at prefill completion.  A cache-hit request prefills ONLY its
+    suffix — prefill FLOPs drop by the shared-prefix fraction and TTFT
+    becomes O(suffix);
   * ``decode``   — ONE program, period: ``[num_slots, 1]`` tokens against
     the whole pool with per-slot positions (models/kv_cache.py), per-slot
     sampling params as traced row values, and per-slot PRNG keys.  Free
-    slots ride along as no-ops: their rows decode garbage that nothing
-    reads, their writes land at position 0 of a row the next adopt
+    and mid-prefill slots ride along as no-ops: their rows decode garbage
+    that nothing reads, their writes land at positions a later adopt
     overwrites wholesale.
 
 Host plane: ONE device->host readback per step phase — the decode
-harvest reads the sampled token vector once, and a step that admits
-requests reads their batched first tokens once (all prefill dispatches
-stay async until then).  Admission, eviction, eos/length bookkeeping and
-metrics all run on host ints the engine already holds.
+harvest reads the sampled token vector once, and a step that completes
+prefills reads their batched first tokens once (all prefill dispatches
+stay async until then).  Admission, radix-tree matching, eviction,
+eos/length bookkeeping and metrics all run on host ints the engine
+already holds.
 
 Per-slot sampling reuses ``generation._filter_top_p`` directly (its
 threshold broadcasts over rows) and generalises ``_filter_top_k`` to a
@@ -40,8 +52,9 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import _filter_top_p
-from .kv_pool import KVPool
+from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
+from .prefix_cache import MatchResult, PrefixCache
 from .scheduler import Request, Scheduler
 
 __all__ = ["EngineCore", "sample_rows"]
@@ -84,31 +97,94 @@ def sample_rows(keys, logits, do_sample, temperature, top_k, top_p):
 class _Slot:
     """Host mirror of one pool slot's request progress."""
 
-    __slots__ = ("req", "pos")
+    __slots__ = ("req", "pos", "match")
 
-    def __init__(self, req: Request, prompt_len: int):
+    def __init__(self, req: Request, prompt_len: int,
+                 match: Optional[MatchResult] = None):
         self.req = req
         self.pos = prompt_len       # cache length == next write offset
+        self.match = match          # pinned radix-cache path, if any
+
+
+class _Prefill:
+    """A request mid-prefill: its slot is allocated, its context grows in
+    a per-request staging cache (per-layer [1, max_seq] k/v rows seeded
+    from the radix cache's matched blocks), and the scheduler's chunk
+    plan drives one decode_step append per chunk."""
+
+    __slots__ = ("req", "slot", "ks", "vs", "plan", "next_chunk", "match",
+                 "last_logits")
+
+    def __init__(self, req: Request, slot: int, ks, vs, plan,
+                 match: Optional[MatchResult]):
+        self.req = req
+        self.slot = slot
+        self.ks = ks                # staging caches, threaded per chunk
+        self.vs = vs
+        self.plan = plan            # [(offset, width, valid), ...]
+        self.next_chunk = 0
+        self.match = match
+        self.last_logits = None     # final chunk's last-token logits
+
+    @property
+    def done(self) -> bool:
+        return self.next_chunk >= len(self.plan)
 
 
 class EngineCore:
-    """Owns the pool, the per-slot device state and the compiled step
-    functions.  The public request/streaming surface lives in
-    ``serving.api.ServingEngine``."""
+    """Owns the pool, the radix prefix cache, the per-slot device state
+    and the compiled step functions.  The public request/streaming
+    surface lives in ``serving.api.ServingEngine``."""
 
     def __init__(self, model, num_slots: int = 8,
                  max_seq: Optional[int] = None,
                  min_bucket: int = 16,
                  max_prefills_per_step: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 max_prefill_tokens_per_step: Optional[int] = None,
+                 enable_prefix_cache: bool = True,
+                 block_len: int = 16,
+                 prefix_blocks: Optional[int] = None,
                  metrics: Optional[ServingMetrics] = None):
+        if prefill_chunk is not None and prefill_chunk < min_bucket:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be >= min_bucket "
+                f"{min_bucket}")
+        if max_prefill_tokens_per_step is not None \
+                and max_prefill_tokens_per_step < 1:
+            raise ValueError("max_prefill_tokens_per_step must be >= 1")
         self.model = model
         self.pool = KVPool.create(model, num_slots, max_seq)
         self.scheduler = Scheduler(num_slots, self.pool.max_seq,
                                    min_bucket=min_bucket,
                                    max_prefills_per_step=max_prefills_per_step)
+        self.prefill_chunk = prefill_chunk
+        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
+        self.prefix_cache: Optional[PrefixCache] = None
+        self.block_pool: Optional[BlockPool] = None
+        if enable_prefix_cache:
+            if block_len < 1:
+                raise ValueError("block_len must be >= 1")
+            # block_len must tile the slot row; shrink to the largest
+            # pow2 divisor of max_seq when the requested size doesn't
+            # (pow2 max_seqs — the common case — keep a pow2 request
+            # verbatim).  Round DOWN to a pow2 first: halving a non-pow2
+            # like 12 would otherwise walk 12->6->3->1 past the perfectly
+            # good 8 and quietly build a per-token tree.
+            block_len = 1 << (block_len.bit_length() - 1)
+            while block_len > 1 and self.pool.max_seq % block_len:
+                block_len //= 2
+            # default pool size: as many blocks as the slot pool has rows
+            # of context — a second slab the size of the first
+            nb = prefix_blocks if prefix_blocks is not None else \
+                num_slots * (self.pool.max_seq // block_len)
+            self.block_pool = BlockPool.create(model, nb, block_len,
+                                               self.pool.max_seq)
+            self.prefix_cache = PrefixCache(self.block_pool)
         self.metrics = metrics or ServingMetrics()
         self.num_slots = num_slots
         self._slots: Dict[int, _Slot] = {}
+        self._prefills: List[_Prefill] = []      # FCFS, mid-prefill
         # per-slot device row state (fixed [num_slots] shapes)
         self._last_tok = jnp.zeros((num_slots,), jnp.int32)
         key0 = jax.random.PRNGKey(0)
@@ -122,63 +198,141 @@ class EngineCore:
         self._top_p = np.ones((num_slots,), np.float32)
         self._sampling_dev: Optional[Tuple] = None
         # compiled programs: ONE decode fn + ONE prefill fn whose jit
-        # cache is keyed by the [1, bucket] input shape (one program per
-        # bucket, nothing per length); the trace counters are what the
-        # compile-count guard test asserts on
+        # cache is keyed by the [1, width] chunk shape (one program per
+        # chunk width / pow2 bucket, nothing per length); the trace
+        # counters (plus BlockPool.trace_counts for the two block-copy
+        # programs) are what the compile-count guard tests assert on
         self._decode_fn = None
         self._prefill_fn: Optional[Callable] = None
+        self._staging_init_fn: Optional[Callable] = None
         self.trace_counts = {"prefill": 0, "decode": 0}
 
     # ----------------------------------------------------------- prefill
     def _build_prefill_fn(self) -> Callable:
-        model, max_seq = self.model, self.pool.max_seq
+        model = self.model
 
-        def prefill(ids, length):
+        def prefill(ks, vs, ids, pos, valid):
             self.trace_counts["prefill"] += 1  # trace-time side effect
-            caches = model.init_cache(1, max_seq)
-            logits, caches = model.decode_step(ids, caches, 0)
+            caches = [(k, v, pos) for k, v in zip(ks, vs)]
+            logits, caches = model.decode_step(ids, caches, pos)
             last = jnp.take_along_axis(
-                logits, (length - 1)[None, None, None], axis=1)[0, 0]
-            return last.astype(jnp.float32), caches
+                logits, (valid - 1)[None, None, None], axis=1)[0, 0]
+            return (last.astype(jnp.float32),
+                    [c[0] for c in caches], [c[1] for c in caches])
 
-        return jax.jit(prefill)
+        # donating the staging rows threads them chunk to chunk in place
+        return jax.jit(prefill, donate_argnums=(0, 1))
 
-    def _admit(self, admitted: List[Tuple[Request, int]]) -> int:
-        """Prefill each admitted request into a pool slot and sample its
-        first token with the request's own key.  All dispatches stay
-        async; the admitted first tokens come back in ONE readback at the
-        end (the decode harvest is the step's other one).  Returns tokens
-        emitted."""
+    def _prefill_cost(self, req: Request) -> int:
+        """Tokens of prefill work admitting ``req`` costs THIS step: the
+        width of its first chunk, after the radix-cache match shrinks the
+        suffix.  This is what the scheduler's head-of-line budget check
+        sees — a long-prompt head with a long cached prefix is cheap."""
+        matched = self.prefix_cache.match_length(req.prompt) \
+            if self.prefix_cache is not None else 0
+        plan = self.scheduler.chunk_plan(matched, req.prompt_len,
+                                         self.prefill_chunk)
+        return plan[0][1]
+
+    def _begin_prefill(self, req: Request) -> None:
+        """Claim a slot, match + pin the longest cached prefix, seed the
+        staging cache from its block rows (one gather program), and queue
+        the suffix's chunk plan.  No model FLOPs run here."""
+        slot = self.pool.alloc()
+        match = None
+        matched = 0
+        if self.prefix_cache is not None:
+            match = self.prefix_cache.match(req.prompt)
+            matched = match.tokens
+        if matched:
+            ks, vs = self.prefix_cache.load_staging(match)
+            req.prefix_hit_tokens = matched
+            self.metrics.on_prefix_hit(matched)
+        else:
+            # ONE compiled zero-staging builder instead of 2*num_layers
+            # eager jnp.zeros dispatches per miss admission
+            if self._staging_init_fn is None:
+                model, max_seq = self.model, self.pool.max_seq
+
+                def fresh_staging():
+                    caches = model.init_cache(1, max_seq)
+                    return ([c[0] for c in caches],
+                            [c[1] for c in caches])
+
+                self._staging_init_fn = jax.jit(fresh_staging)
+            ks, vs = self._staging_init_fn()
+        plan = self.scheduler.chunk_plan(matched, req.prompt_len,
+                                         self.prefill_chunk)
+        self.scheduler.place(req, slot)
+        self._prefills.append(_Prefill(req, slot, ks, vs, plan, match))
+
+    def _run_chunk(self, st: _Prefill) -> None:
+        """Dispatch one prefill chunk of ``st`` (async — no readback)."""
         if self._prefill_fn is None:
             self._prefill_fn = self._build_prefill_fn()
+        off, width, valid = st.plan[st.next_chunk]
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :valid] = np.asarray(st.req.prompt[off:off + valid],
+                                    np.int32)
+        last_logits, st.ks, st.vs = self._prefill_fn(
+            st.ks, st.vs, jnp.asarray(ids),
+            jnp.asarray(off, jnp.int32), jnp.asarray(valid, jnp.int32))
+        st.next_chunk += 1
+        st.req.prefill_chunks += 1
+        self.metrics.on_prefill_chunk(valid)
+        if st.done:
+            st.last_logits = last_logits
+
+    def _complete_prefill(self, st: _Prefill):
+        """Final chunk done: sample the first token with the request's
+        own key, adopt the staging row into the pool slot, and publish
+        the freshly computed prompt blocks to the radix cache.  Returns
+        ``(slot, first_token_array)`` — the caller batches the
+        readbacks."""
+        req, slot = st.req, st.slot
+        key = jax.random.PRNGKey(req.sampling.seed)
+        key, sub = jax.random.split(key)
+        s = req.sampling
+        first = sample_rows(
+            sub[None], st.last_logits[None],
+            jnp.asarray([s.do_sample]),
+            jnp.asarray([s.temperature], jnp.float32),
+            jnp.asarray([s.top_k], jnp.int32),
+            jnp.asarray([s.top_p], jnp.float32))
+        self.pool.adopt(slot, list(zip(st.ks, st.vs)), req.prompt_len)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, self.pool, slot)
+        self._slots[slot] = _Slot(req, req.prompt_len, match=st.match)
+        self._last_tok = self._last_tok.at[slot].set(first[0])
+        self._keys = self._keys.at[slot].set(key)
+        self._do_sample[slot] = s.do_sample
+        self._temperature[slot] = s.temperature
+        self._top_k[slot] = s.top_k
+        self._top_p[slot] = s.top_p
+        self._sampling_dev = None
+        self.metrics.on_prefill(req.prompt_len - req.prefix_hit_tokens)
+        return slot, first
+
+    def _advance_prefills(self) -> int:
+        """Run this step's prefill work.  Without chunking every pending
+        prefill completes (the legacy admit-then-decode shape); with
+        ``prefill_chunk`` set, exactly ONE chunk runs per step, so the
+        per-step decode stall is bounded by one chunk regardless of how
+        long the admitted prompt is.  Completed requests' first tokens
+        come back in ONE batched readback.  Returns tokens emitted."""
         staged: List[Tuple[int, jax.Array]] = []
-        for req, bucket in admitted:
-            slot = self.pool.alloc()
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :req.prompt_len] = np.asarray(req.prompt, np.int32)
-            last_logits, caches = self._prefill_fn(
-                jnp.asarray(ids), jnp.asarray(req.prompt_len, jnp.int32))
-            self.pool.adopt(slot, caches, req.prompt_len)
-            key = jax.random.PRNGKey(req.sampling.seed)
-            key, sub = jax.random.split(key)
-            s = req.sampling
-            first = sample_rows(
-                sub[None], last_logits[None],
-                jnp.asarray([s.do_sample]),
-                jnp.asarray([s.temperature], jnp.float32),
-                jnp.asarray([s.top_k], jnp.int32),
-                jnp.asarray([s.top_p], jnp.float32))
-            self.scheduler.place(req, slot)
-            self._slots[slot] = _Slot(req, req.prompt_len)
-            self._last_tok = self._last_tok.at[slot].set(first[0])
-            self._keys = self._keys.at[slot].set(key)
-            self._do_sample[slot] = s.do_sample
-            self._temperature[slot] = s.temperature
-            self._top_k[slot] = s.top_k
-            self._top_p[slot] = s.top_p
-            self._sampling_dev = None
-            self.metrics.on_prefill(req.prompt_len)
-            staged.append((slot, first))
+        if self.prefill_chunk is None:
+            while self._prefills:
+                st = self._prefills.pop(0)
+                while not st.done:
+                    self._run_chunk(st)
+                staged.append(self._complete_prefill(st))
+        elif self._prefills:
+            st = self._prefills[0]
+            self._run_chunk(st)
+            if st.done:
+                self._prefills.pop(0)
+                staged.append(self._complete_prefill(st))
         if staged:
             toks = np.asarray(jnp.concatenate([f for _, f in staged]))
             for (slot, _), tok in zip(staged, toks):
@@ -226,16 +380,22 @@ class EngineCore:
 
     # -------------------------------------------------------- step loop
     def step(self) -> int:
-        """One engine iteration: admit+prefill, one decode step over all
-        active slots, harvest tokens / evict finished.  Returns the
-        number of requests still in flight (running + queued)."""
+        """One engine iteration: admit (radix match + staging), advance
+        prefill chunks, one decode step over all active slots, harvest
+        tokens / evict finished.  Returns the number of requests still
+        in flight (prefilling + running + queued)."""
         t0 = time.perf_counter()
         ann = None
         if self.metrics.record_events:
             from ..profiler import RecordEvent
             ann = RecordEvent("serving.step")
             ann.begin()
-        new_tokens = self._admit(self.scheduler.admit(self.pool.free_slots))
+        for req, _ in self.scheduler.admit(
+                self.pool.free_slots,
+                token_budget=self.max_prefill_tokens_per_step,
+                cost=self._prefill_cost):
+            self._begin_prefill(req)
+        new_tokens = self._advance_prefills()
         if self._slots:
             toks = self._decode_all_slots()
             for slot in sorted(self._slots):
@@ -248,7 +408,7 @@ class EngineCore:
             queue_depth=self.scheduler.queue_depth,
             new_tokens=new_tokens,
             step_seconds=time.perf_counter() - t0)
-        return len(self._slots) + self.scheduler.queue_depth
+        return self.scheduler.active + self.scheduler.queue_depth
 
     def _emit(self, slot: int, tok: int, first_token: bool = False) -> None:
         req = self._slots[slot].req
@@ -276,6 +436,10 @@ class EngineCore:
         for slot in [s for s, st in self._slots.items() if st.req.finished]:
             req = self.scheduler.release(slot)
             req.finish_time = time.perf_counter()
+            if self._slots[slot].match is not None:
+                # unpin the request's radix path — its blocks become
+                # LRU-evictable again
+                self.prefix_cache.release(self._slots[slot].match)
             self.pool.free(slot)
             del self._slots[slot]
             self._do_sample[slot] = False

@@ -22,7 +22,9 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["KVPool"]
+from ..models.kv_cache import gather_block_rows, scatter_block_rows
+
+__all__ = ["KVPool", "BlockPool"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -129,3 +131,123 @@ class KVPool:
         self.ks = [c[0] for c in new_caches]
         self.vs = [c[1] for c in new_caches]
         self.seq_pos = new_caches[0][2]
+
+
+class BlockPool:
+    """The SECOND fixed-shape KV slab: per-layer
+    ``[num_blocks, block_len, kv_heads, head_dim]`` block rows holding
+    cached PREFIX context, shared across requests.  The radix tree
+    (serving/prefix_cache.py) owns which block holds which token span —
+    this class owns only the device memory and the two compiled copy
+    programs:
+
+      * ``load_row(idx)``   — gather ``max_seq // block_len`` block rows
+        into a fresh ``[1, max_seq]`` cache row (the staging cache a
+        matched request prefills its suffix into).  ``idx`` is traced row
+        data padded arbitrarily past the true match count (stale gathers
+        are masked downstream by ``seq_lens``), so ONE program serves
+        every match length;
+      * ``store_row(ks, vs, slot, dest)`` — split pool slot ``slot``'s
+        row into blocks and scatter block j to ``dest[j]``; ``dest``
+        entries set to ``num_blocks`` are out-of-bounds and DROPPED, so
+        the same single program writes any subset of a prompt's blocks.
+
+    Like ``KVPool``, buffers never reallocate; block lifecycle (alloc /
+    free / refcount / LRU) is host-side list accounting.
+    """
+
+    def __init__(self, num_blocks: int, block_len: int, max_seq: int,
+                 num_layers: int, kv_heads: int, head_dim: int,
+                 dtype=jnp.float32):
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        if block_len < 1:
+            raise ValueError("block_len must be >= 1")
+        if max_seq % block_len:
+            raise ValueError(
+                f"block_len {block_len} must divide max_seq {max_seq} "
+                f"(block boundaries must tile the slot row)")
+        self.num_blocks = num_blocks
+        self.block_len = block_len
+        self.max_seq = max_seq
+        self.num_layers = num_layers
+        self.blocks_per_row = max_seq // block_len
+        shape = (num_blocks, block_len, kv_heads, head_dim)
+        self.bks: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                     for _ in range(num_layers)]
+        self.bvs: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                     for _ in range(num_layers)]
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self.trace_counts = {"gather": 0, "scatter": 0}
+        self._load_fn = None
+        self._store_fn = None
+
+    @classmethod
+    def create(cls, model, num_blocks: int, block_len: int,
+               max_seq: int) -> "BlockPool":
+        cfg = model.cfg
+        kv_heads = getattr(cfg, "kv_heads", None) or cfg.num_heads
+        return cls(num_blocks, block_len, max_seq, cfg.num_layers,
+                   kv_heads, cfg.head_dim, dtype=jnp.dtype(cfg.dtype))
+
+    # ------------------------------------------------------------ blocks
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("BlockPool exhausted: no free block")
+        return self._free.pop()
+
+    def free(self, block: int) -> None:
+        if not 0 <= block < self.num_blocks:
+            raise ValueError(f"block {block} out of range")
+        if block in self._free:
+            raise ValueError(f"block {block} already free (double free)")
+        self._free.append(block)
+
+    # ---------------------------------------------------- copy programs
+    def load_row(self, idx) -> Tuple[List[jax.Array], List[jax.Array]]:
+        """Gather blocks ``idx`` ([blocks_per_row] int32, padded past the
+        match with any in-bounds value) into per-layer ``[1, max_seq, h,
+        d]`` staging rows."""
+        if self._load_fn is None:
+            def load(bks, bvs, idx):
+                self.trace_counts["gather"] += 1   # trace-time tick
+                ks = [gather_block_rows(b, idx)[None] for b in bks]
+                vs = [gather_block_rows(b, idx)[None] for b in bvs]
+                return ks, vs
+
+            self._load_fn = jax.jit(load)
+        return self._load_fn(self.bks, self.bvs,
+                             jnp.asarray(idx, jnp.int32))
+
+    def store_row(self, pool: KVPool, slot: int, dest) -> None:
+        """Scatter pool slot ``slot``'s row into block rows ``dest``
+        ([blocks_per_row] int32; entries == num_blocks are dropped).
+        Donates the block slabs — cache memory stays one allocation."""
+        if self._store_fn is None:
+            n = (1, self.max_seq) + self.bks[0].shape[2:]
+
+            def store(bks, bvs, ks, vs, slot, dest):
+                self.trace_counts["scatter"] += 1  # trace-time tick
+                start = (slot, 0, 0, 0)
+                new_bks = [
+                    scatter_block_rows(
+                        b, jax.lax.dynamic_slice(k, start, n)[0], dest)
+                    for b, k in zip(bks, ks)]
+                new_bvs = [
+                    scatter_block_rows(
+                        b, jax.lax.dynamic_slice(v, start, n)[0], dest)
+                    for b, v in zip(bvs, vs)]
+                return new_bks, new_bvs
+
+            self._store_fn = jax.jit(store, donate_argnums=(0, 1))
+        self.bks, self.bvs = self._store_fn(
+            self.bks, self.bvs, pool.ks, pool.vs,
+            jnp.asarray(slot, jnp.int32), jnp.asarray(dest, jnp.int32))

@@ -39,6 +39,10 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.prefills = 0
         self.prefill_tokens = 0
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
         self.steps = 0
         self._busy_s = 0.0
         self._ttfts: List[float] = []
@@ -50,8 +54,23 @@ class ServingMetrics:
         self.requests_submitted += n
 
     def on_prefill(self, prompt_len: int) -> None:
+        """One request's prefill completed; ``prompt_len`` counts only
+        the tokens the model actually ran (the uncached suffix) — the
+        FLOPs-saved story is ``prefix_hit_tokens`` vs this."""
         self.prefills += 1
         self.prefill_tokens += prompt_len
+
+    def on_prefill_chunk(self, tokens: int) -> None:
+        """One chunk program dispatched, covering ``tokens`` real (non-
+        padding) prompt tokens."""
+        self.prefill_chunks += 1
+        self.prefill_chunk_tokens += tokens
+
+    def on_prefix_hit(self, tokens: int) -> None:
+        """Admission matched ``tokens`` prompt tokens in the radix cache
+        (their KV was copied, not recomputed)."""
+        self.prefix_hits += 1
+        self.prefix_hit_tokens += tokens
 
     def on_first_token(self, arrival_time: float) -> None:
         self._ttfts.append(time.perf_counter() - arrival_time)
@@ -103,6 +122,10 @@ class ServingMetrics:
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "prefill_tokens": self.prefill_tokens,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
             "steps": self.steps,
             "tokens_per_sec": r(self.tokens_per_sec, 1),
             "mean_ttft_ms": r(self.mean_ttft_ms, 2),

@@ -9,7 +9,10 @@ Bucketing: prefill runs at the prompt's length rounded UP to a power of
 two (floor ``min_bucket``), so a mixed-length workload lowers at most
 ``O(log2(max_seq / min_bucket))`` distinct prefill programs instead of
 one per length — graftlint's recompile-hazard rule applied to serving.
-Decode is always the single ``[num_slots, 1]`` program.
+With chunked prefill (``chunk_plan``) the suffix instead runs as fixed
+``prefill_chunk``-token pieces plus one bucketed tail, interleaved with
+decode at step granularity.  Decode is always the single
+``[num_slots, 1]`` program.
 """
 
 from __future__ import annotations
@@ -82,6 +85,8 @@ class Request:
     finish_reason: Optional[str] = None      # "eos" | "length"
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    prefix_hit_tokens: int = 0               # prompt tokens served from
+    prefill_chunks: int = 0                  # the radix cache / chunks run
 
     @property
     def prompt_len(self) -> int:
@@ -89,16 +94,27 @@ class Request:
 
 
 class Scheduler:
-    """FCFS admission over a fixed slot budget.
+    """FCFS admission over a fixed slot budget, with a BOUNDED
+    head-of-line escape hatch.
 
     ``admit()`` pops waiting requests in arrival order while free slots
-    remain — the engine prefills each admitted request (one bucketed
-    program) and then runs ONE decode step over all occupied slots, so
-    prefill and decode interleave at step granularity."""
+    (and the optional per-step prefill token budget) remain — the engine
+    prefills each admitted request and then runs ONE decode step over all
+    occupied slots, so prefill and decode interleave at step granularity.
+
+    Head-of-line fix: when the head request's prefill cost (its UNCACHED
+    suffix bucket — the ``cost`` callable, prefix-cache aware) exceeds
+    the remaining token budget but a later queued request fits, the later
+    one is admitted instead of idling free slots.  The skip is bounded
+    two ways: only the first ``skip_window`` queue positions are eligible
+    to jump, and after ``max_head_skips`` total jumps over the same head
+    the window collapses to the head alone — admission then waits for the
+    budget the head needs, so no request starves."""
 
     def __init__(self, num_slots: int, max_seq: int,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
-                 max_prefills_per_step: Optional[int] = None):
+                 max_prefills_per_step: Optional[int] = None,
+                 skip_window: int = 4, max_head_skips: int = 16):
         self.num_slots = num_slots
         self.max_seq = max_seq
         self.min_bucket = min_bucket
@@ -106,6 +122,9 @@ class Scheduler:
         # trades TTFT of queued requests against decode stalls of the
         # already-running ones (prefill blocks the shared step loop)
         self.max_prefills_per_step = max_prefills_per_step
+        self.skip_window = skip_window
+        self.max_head_skips = max_head_skips
+        self._head_skips = 0
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> request
         self._ids = itertools.count()
@@ -132,17 +151,91 @@ class Scheduler:
     def bucket(self, prompt_len: int) -> int:
         return bucket_length(prompt_len, self.min_bucket, self.max_seq)
 
-    def admit(self, free_slots: int) -> List[Tuple[Request, int]]:
-        """FCFS: pop up to ``free_slots`` (and the per-step prefill cap)
-        waiting requests, returning ``(request, prefill_bucket)`` pairs in
-        arrival order.  Slot indices are assigned by the caller (the pool
-        owns the free list)."""
+    def chunk_plan(self, start: int, prompt_len: int,
+                   prefill_chunk: Optional[int]) -> List[Tuple[int, int, int]]:
+        """Split the uncached suffix ``[start, prompt_len)`` into prefill
+        chunks: ``(offset, width, valid)`` triples where ``width`` is the
+        compiled program's token width and ``valid <= width`` the real
+        tokens in the chunk.
+
+        ``prefill_chunk=None`` -> ONE chunk at the suffix's pow2 bucket
+        (the pre-chunking behavior).  Otherwise every chunk except the
+        last runs at exactly ``prefill_chunk`` tokens and the tail runs
+        at its own pow2 bucket (capped at the chunk size), so the
+        compiled-program set stays {chunk} + O(log2(prefill_chunk /
+        min_bucket)) regardless of prompt lengths — and the engine can
+        interleave one chunk per step with the all-slots decode program
+        instead of stalling every stream behind a whole-prompt prefill."""
+        out: List[Tuple[int, int, int]] = []
+        pos = start
+        while pos < prompt_len:
+            rem = prompt_len - pos
+            if prefill_chunk is not None and rem > prefill_chunk:
+                w = v = prefill_chunk
+            else:
+                w = bucket_length(rem, self.min_bucket, self.max_seq - pos)
+                if prefill_chunk is not None:
+                    w = min(w, prefill_chunk)
+                v = rem
+            out.append((pos, w, v))
+            pos += v
+        return out
+
+    def admit(self, free_slots: int, token_budget: Optional[int] = None,
+              cost=None) -> List[Tuple[Request, int]]:
+        """Pop up to ``free_slots`` (and the per-step prefill cap)
+        waiting requests, returning ``(request, prefill_cost)`` pairs.
+        Slot indices are assigned by the caller (the pool owns the free
+        list).
+
+        ``cost(req)`` is the prefill work the request needs THIS step in
+        tokens (the engine passes its prefix-cache-aware suffix bucket,
+        capped at one chunk); default: the full-prompt pow2 bucket.
+        ``token_budget`` caps the summed cost per call (None = unbounded
+        -> pure FCFS).  When the head doesn't fit the remaining budget, a
+        later request within ``skip_window`` may jump it — see the class
+        docstring for the no-starvation bound."""
         cap = free_slots if self.max_prefills_per_step is None else \
             min(free_slots, self.max_prefills_per_step)
+        if cost is None:
+            cost = lambda r: self.bucket(r.prompt_len)
+        if token_budget is not None and token_budget < 1:
+            # a budget the loop gate can never open would silently starve
+            # every request (the over-budget head escape sits INSIDE the
+            # gate) — reject loudly instead
+            raise ValueError(
+                f"token_budget must be >= 1, got {token_budget}")
+        budget = float("inf") if token_budget is None else int(token_budget)
         out: List[Tuple[Request, int]] = []
-        while self.waiting and len(out) < cap:
-            req = self.waiting.popleft()
-            out.append((req, self.bucket(req.prompt_len)))
+        while self.waiting and len(out) < cap and budget > 0:
+            window = 1 if self._head_skips >= self.max_head_skips \
+                else 1 + self.skip_window
+            pick = None
+            for j, req in enumerate(
+                    itertools.islice(self.waiting, window)):
+                c = cost(req)
+                if c <= budget:
+                    pick, pick_cost = j, c
+                    break
+            if pick is None:
+                head_cost = cost(self.waiting[0])
+                if not out and token_budget is not None \
+                        and head_cost > token_budget:
+                    # the head exceeds even a FULL step budget, so
+                    # deferring it can never end: the budget is a stall
+                    # bound, not a correctness bound — admit it anyway
+                    # (one over-budget step) instead of idling forever
+                    pick, pick_cost = 0, head_cost
+                else:
+                    break
+            if pick == 0:
+                self._head_skips = 0
+            else:
+                self._head_skips += 1
+            req = self.waiting[pick]
+            del self.waiting[pick]
+            budget -= pick_cost
+            out.append((req, pick_cost))
         return out
 
     def place(self, req: Request, slot: int) -> None:

@@ -134,11 +134,13 @@ ALLOWLIST: Dict[str, str] = {
     # ---- paddle_tpu.serving public surface (the SRV registry surface:
     #      engine/scheduler/pool classes and their helpers are request
     #      lifecycle, not numeric ops — the OpTest harness has no oracle
-    #      for them; tests/test_serving.py is their contract)
+    #      for them; tests/test_serving.py + test_prefix_cache.py are
+    #      their contract)
     **{n: _SERVING for n in (
         "ServingEngine", "EngineCore", "Request", "RequestOutput",
         "SamplingParams", "Scheduler", "KVPool", "ServingMetrics",
-        "bucket_length", "sample_rows",
+        "bucket_length", "sample_rows", "BlockPool", "PrefixCache",
+        "MatchResult",
     )},
 }
 

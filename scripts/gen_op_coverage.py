@@ -334,10 +334,12 @@ help list load
 
 # Paddle-Serving / PaddleNLP predictor analog: the TPU-native
 # continuous-batching serving engine (docs/serving.md) — slot-pooled KV
-# cache, FCFS scheduler with pow2 prefill buckets, per-slot sampling
+# cache, radix prefix cache over a shared block pool, FCFS scheduler
+# with pow2 prefill buckets + chunked prefill, per-slot sampling
 PADDLE_SERVING = """
 ServingEngine Request RequestOutput SamplingParams
 EngineCore KVPool Scheduler ServingMetrics bucket_length sample_rows
+BlockPool PrefixCache MatchResult
 """
 
 PADDLE_STATIC_NN = """

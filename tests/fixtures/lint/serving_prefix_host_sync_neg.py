@@ -1,0 +1,43 @@
+"""NEGATIVE fixture: the prefix cache's legal shape — ZERO findings.
+
+The radix tree is HOST data (token-bytes keys, refcounts, LRU ticks):
+matching, pinning and eviction bookkeeping run in plain host methods and
+may use numpy freely.  Only the two compiled block-copy programs touch
+the device, and they are pure dataflow.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def gather_blocks(block_slab, idx):
+    rows = jnp.take(block_slab, idx, axis=0, mode="clip")
+    return rows.reshape(1, -1, 4, 32)
+
+
+@jax.jit
+def scatter_blocks(block_slab, row, dest):
+    pieces = row.reshape(-1, 8, 4, 32)
+    return block_slab.at[dest].set(pieces, mode="drop")
+
+
+def match(children, tokens, block_len):
+    # host radix walk: numpy token keys, host ints, host dict — no device
+    toks = np.asarray(tokens, np.int32)
+    blocks = []
+    node = children
+    for i in range(len(toks) // block_len):
+        key = toks[i * block_len:(i + 1) * block_len].tobytes()
+        if key not in node:
+            break
+        block, node = node[key]
+        blocks.append(block)
+    return blocks
+
+
+def admit(block_slab, children, tokens):
+    blocks = match(children, tokens, 8)
+    idx = np.zeros(4, np.int32)
+    idx[:len(blocks)] = blocks
+    return gather_blocks(block_slab, jnp.asarray(idx))

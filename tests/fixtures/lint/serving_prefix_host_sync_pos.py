@@ -1,0 +1,31 @@
+"""POSITIVE fixture: prefix-cache block-copy host syncs (hot path).
+
+The radix prefix cache's contract splits cleanly: tree walking is host
+code, but the two block-copy programs (gather matched blocks into a
+staging row, scatter fresh blocks out of a slot) are compiled and must
+stay pure device dataflow.  This version commits the classic
+violations inside them.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def gather_blocks(block_slab, idx):
+    rows = jnp.take(block_slab, idx, axis=0)
+    # (1) reading the matched block count back per admission
+    n = idx[0].item()
+    # (2) float() around a traced value — "log the hit fraction" sync
+    hit_frac = float(jnp.mean(idx >= 0))
+    return rows, n, hit_frac
+
+
+@jax.jit
+def scatter_blocks(block_slab, row, dest):
+    pieces = row.reshape(-1, 8, 4, 32)
+    # (3) host copy of the scattered slab inside the compiled program
+    checksum = np.asarray(pieces.sum())
+    # (4) device_get of the slab to "verify" the insert
+    host_slab = jax.device_get(block_slab)
+    return block_slab.at[dest].set(pieces, mode="drop"), checksum, host_slab

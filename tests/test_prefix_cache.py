@@ -1,0 +1,436 @@
+"""Radix prefix cache + chunked prefill (serving/prefix_cache.py).
+
+The load-bearing contracts:
+  * PARITY — with the cache enabled, greedy and seeded-sampled engine
+    outputs are token-for-token identical to the cache-off engine (and
+    to ``model.generate``) for full hits, partial hits, misses, and
+    re-admission after LRU eviction.  The cache moves KV bytes, never
+    changes them;
+  * COMPILE BOUNDING — chunked prefill keeps the program count
+    O(log2(max_seq / min_bucket)) + ONE decode program, plus ONE block
+    gather and ONE block scatter, regardless of prompt lengths or hit
+    patterns;
+  * LIFECYCLE — refcounts pin matched paths while their requests run,
+    eviction only ever takes LRU unpinned leaves, and the block pool's
+    accounting survives slot over-subscription stress;
+  * SCHEDULING — the head-of-line skip admits a fitting later request
+    past an oversized head, bounded by the skip window and the
+    no-starvation counter.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import (GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM, gpt_tiny)
+from paddle_tpu.serving import (BlockPool, PrefixCache, SamplingParams,
+                                Scheduler, ServingEngine)
+from paddle_tpu.serving.scheduler import Request
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    with jax.default_prng_impl("rbg"):
+        return GPTForCausalLM(gpt_tiny())
+
+
+@pytest.fixture(scope="module")
+def eng(gpt):
+    """Shared cache-on engine: block_len 8 so short test prompts hit."""
+    return ServingEngine(gpt, num_slots=3, min_bucket=8, block_len=8)
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _shared_prefix_prompts(seed, pref_len, suffix_lens, vocab=256):
+    rs = np.random.RandomState(seed)
+    pref = rs.randint(0, vocab, (pref_len,))
+    return [np.concatenate([pref, rs.randint(0, vocab, (s,))])
+            for s in suffix_lens]
+
+
+def _want_tokens(model, prompt, n=5, **kw):
+    seq = model.generate(jnp.asarray(prompt)[None], max_new_tokens=n, **kw)
+    return np.asarray(seq)[0, len(prompt):]
+
+
+# ---------------------------------------------------------------- parity
+
+def test_full_hit_parity_and_accounting(gpt, eng):
+    """The same prompt twice: the repeat matches every full block except
+    the one holding the last token (at least one token must prefill) and
+    still reproduces generate() exactly."""
+    p = _prompts(0, (41,))[0]
+    o1 = eng.serve_batch([p], max_new_tokens=5, max_steps=200)[0]
+    o2 = eng.serve_batch([p], max_new_tokens=5, max_steps=200)[0]
+    want = _want_tokens(gpt, p)
+    np.testing.assert_array_equal(np.asarray(o1.tokens), want)
+    np.testing.assert_array_equal(np.asarray(o2.tokens), want)
+    assert o1.prefix_hit_tokens == 0
+    assert o2.prefix_hit_tokens == (41 - 1) // 8 * 8 == 40
+
+
+def test_partial_hit_parity(gpt, eng):
+    """Prompts sharing a 24-token prefix with divergent tails: each
+    later request hits exactly the shared blocks and its output still
+    matches its own solo generate()."""
+    prompts = _shared_prefix_prompts(1, 24, (7, 12, 3))
+    outs = eng.serve_batch(prompts, max_new_tokens=5, max_steps=300)
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(np.asarray(o.tokens),
+                                      _want_tokens(gpt, p))
+    # all three were admitted together (3 slots): the first inserts, the
+    # later two may match depending on admission order — re-serving the
+    # same prompts must now hit the shared prefix on every request
+    outs2 = eng.serve_batch(prompts, max_new_tokens=5, max_steps=300)
+    for p, o in zip(prompts, outs2):
+        np.testing.assert_array_equal(np.asarray(o.tokens),
+                                      _want_tokens(gpt, p))
+        assert o.prefix_hit_tokens >= 24 // 8 * 8
+
+
+def test_sampled_parity_with_prefix_hit(gpt, eng):
+    """Seeded sampling through the cache-hit path reproduces
+    generate(seed=...) exactly — the copied KV is bit-identical, so the
+    sampled trajectory is too."""
+    p = _shared_prefix_prompts(2, 32, (9,))[0]
+    kw = dict(do_sample=True, temperature=1.6, top_k=7, top_p=0.9, seed=13)
+    eng.serve_batch([p], max_new_tokens=4, max_steps=200)   # seed the tree
+    rid = eng.submit(p, max_new_tokens=5, sampling=SamplingParams(**kw))
+    eng.run_until_complete(200)
+    out = eng.result(rid)
+    assert out.prefix_hit_tokens > 0
+    np.testing.assert_array_equal(np.asarray(out.tokens),
+                                  _want_tokens(gpt, p, 5, **kw))
+
+
+def test_cache_on_off_identical_outputs(gpt):
+    """The same mixed workload through cache-on and cache-off engines:
+    byte-identical token streams."""
+    prompts = _shared_prefix_prompts(3, 16, (2, 9, 20)) + \
+        _prompts(4, (5, 30))
+    on = ServingEngine(gpt, num_slots=2, min_bucket=8, block_len=8)
+    off = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                        enable_prefix_cache=False)
+    a = on.serve_batch(prompts, max_new_tokens=4, max_steps=400)
+    b = off.serve_batch(prompts, max_new_tokens=4, max_steps=400)
+    for oa, ob in zip(a, b):
+        assert oa.tokens == ob.tokens
+    # and a second pass (now with hits) still agrees
+    a2 = on.serve_batch(prompts, max_new_tokens=4, max_steps=400)
+    for oa, ob in zip(a2, b):
+        assert oa.tokens == ob.tokens
+    assert on.metrics_dict()["prefix_hit_tokens"] > 0
+
+
+def test_post_eviction_readmission_parity(gpt):
+    """A pool too small for two prompts' blocks: inserting the second
+    evicts the first's LRU leaves; re-admitting the first recomputes and
+    still matches generate()."""
+    engine = ServingEngine(gpt, num_slots=1, min_bucket=8, block_len=8,
+                           prefix_blocks=4)               # 32 tokens max
+    pa, pb = _prompts(5, (33, 40))
+    want_a, want_b = _want_tokens(gpt, pa), _want_tokens(gpt, pb)
+    o = engine.serve_batch([pa], max_new_tokens=5, max_steps=200)[0]
+    np.testing.assert_array_equal(np.asarray(o.tokens), want_a)
+    o = engine.serve_batch([pb], max_new_tokens=5, max_steps=200)[0]
+    np.testing.assert_array_equal(np.asarray(o.tokens), want_b)
+    stats = engine.metrics_dict()["prefix_cache"]
+    assert stats["prefix_evictions"] > 0                  # pa's blocks
+    o = engine.serve_batch([pa], max_new_tokens=5, max_steps=200)[0]
+    np.testing.assert_array_equal(np.asarray(o.tokens), want_a)
+
+
+def test_llama_gqa_prefix_parity():
+    """The block slab uses kv_heads (GQA: fewer KV heads than query
+    heads) — gather/scatter must round-trip that layout exactly."""
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    prompts = _shared_prefix_prompts(6, 16, (3, 6), vocab=128)
+    engine = ServingEngine(model, num_slots=2, min_bucket=8, block_len=8)
+    engine.serve_batch(prompts, max_new_tokens=4, max_steps=200)
+    outs = engine.serve_batch(prompts, max_new_tokens=4, max_steps=200)
+    for p, o in zip(prompts, outs):
+        assert o.prefix_hit_tokens == 16
+        np.testing.assert_array_equal(np.asarray(o.tokens),
+                                      _want_tokens(model, p, 4))
+
+
+# ------------------------------------------------------- chunked prefill
+
+def test_chunked_prefill_parity(gpt):
+    """A long prompt split into fixed chunks decodes identically to the
+    whole-suffix prefill."""
+    p = _prompts(7, (100,))[0]
+    engine = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                           prefill_chunk=16, block_len=8)
+    o = engine.serve_batch([p], max_new_tokens=5, max_steps=500)[0]
+    np.testing.assert_array_equal(np.asarray(o.tokens), _want_tokens(gpt, p))
+    m = engine.metrics_dict()
+    assert m["prefill_chunks"] == math.ceil(100 / 16)
+
+
+def test_chunked_prefill_interleaves_with_decode(gpt):
+    """THE stall bound: while a long prompt chunks through prefill, an
+    in-flight stream keeps emitting one token per engine step — decode
+    never waits for the whole admission."""
+    engine = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                           prefill_chunk=16, block_len=8)
+    short = _prompts(8, (5,))[0]
+    rid_s = engine.submit(short, max_new_tokens=30)
+    engine.step()                                  # short is decoding
+    base = len(engine.core._slots[next(iter(engine.core._slots))].req.tokens)
+    long_p = _prompts(9, (90,))[0]
+    rid_l = engine.submit(long_p, max_new_tokens=2)
+    n_chunks = math.ceil(90 / 16)
+    for i in range(n_chunks):
+        engine.step()
+        # the running stream advanced EVERY step of the long prefill
+        assert len(engine._requests[rid_s].tokens) == base + i + 1
+    assert len(engine._requests[rid_l].tokens) >= 1   # first token landed
+    engine.run_until_complete(200)
+    np.testing.assert_array_equal(
+        np.asarray(engine.result(rid_s).tokens),
+        _want_tokens(gpt, short, 30))
+    np.testing.assert_array_equal(
+        np.asarray(engine.result(rid_l).tokens),
+        _want_tokens(gpt, long_p, 2))
+
+
+def test_chunk_plan_covers_suffix_exactly():
+    s = Scheduler(num_slots=2, max_seq=128, min_bucket=8)
+    # legacy: one pow2-bucketed chunk
+    assert s.chunk_plan(0, 50, None) == [(0, 64, 50)]
+    # chunked: fixed pieces + bucketed tail
+    plan = s.chunk_plan(0, 50, 16)
+    assert plan == [(0, 16, 16), (16, 16, 16), (32, 16, 16), (48, 8, 2)]
+    assert sum(v for _, _, v in plan) == 50
+    # suffix after a 40-token cache hit
+    plan = s.chunk_plan(40, 50, 16)
+    assert plan == [(40, 16, 10)]
+    # widths never overrun the cache row
+    plan = s.chunk_plan(120, 125, None)
+    assert plan == [(120, 8, 5)]
+
+
+def test_compile_count_bounded_with_cache_and_chunks(gpt):
+    """The fixed-shape contract, extended: mixed lengths + cache hits +
+    chunked prefill lower at most {chunk width} + O(log2 buckets)
+    prefill programs, ONE decode program, ONE block gather and ONE block
+    scatter — hit patterns and prompt diversity never leak into the
+    compile cache."""
+    engine = ServingEngine(gpt, num_slots=3, min_bucket=8,
+                           prefill_chunk=16, block_len=16)
+    lengths = (3, 9, 17, 33, 50)
+    prompts = _prompts(10, lengths)
+    rids = [engine.submit(p, max_new_tokens=3) for p in prompts]
+    engine.run_until_complete(500)
+    # re-serve the longest prompt now that its blocks are cached: the
+    # hit path (block gather) must not add programs either
+    rids.append(engine.submit(prompts[-1].copy(), max_new_tokens=3))
+    engine.run_until_complete(100)
+    out = engine.result(rids[-1])
+    assert out.prefix_hit_tokens == 48              # 3 of 3 full blocks
+    assert all(engine.result(r).finished for r in rids)
+    core = engine.core
+    assert core.trace_counts["decode"] == 1
+    # widths: 16 (the chunk) and 8 (tails + short prompts)
+    assert core.trace_counts["prefill"] == 2
+    assert core.block_pool.trace_counts == {"gather": 1, "scatter": 1}
+    bound = math.log2(core.pool.max_seq / 8) + 1
+    assert core.trace_counts["prefill"] <= bound
+
+
+# --------------------------------------------- refcounts / LRU / stress
+
+def test_refcount_pins_and_releases(gpt):
+    engine = ServingEngine(gpt, num_slots=1, min_bucket=8, block_len=8)
+    p = _prompts(11, (25,))[0]
+    engine.serve_batch([p], max_new_tokens=3, max_steps=100)
+    cache = engine.core.prefix_cache
+    # drained: nothing pinned
+    stack = list(cache.root.children.values())
+    assert stack, "prompt blocks were inserted"
+    while stack:
+        n = stack.pop()
+        assert n.refcount == 0
+        stack.extend(n.children.values())
+
+
+def test_match_never_covers_last_token(gpt):
+    engine = ServingEngine(gpt, num_slots=1, min_bucket=8, block_len=8)
+    p = _prompts(12, (32,))[0]                     # exactly 4 blocks
+    engine.serve_batch([p], max_new_tokens=3, max_steps=100)
+    cache = engine.core.prefix_cache
+    # 32 full-block tokens cached, but a repeat may match at most 24:
+    # the last token's logits must come from a real prefill
+    assert cache.match_length(p) == (32 - 1) // 8 * 8 == 24
+
+
+def test_eviction_stress_under_oversubscription(gpt):
+    """Many shared-prefix requests through few slots and a starved block
+    pool: refcounts must pin live paths, eviction must recycle the rest,
+    accounting must balance, and every output must stay exact."""
+    engine = ServingEngine(gpt, num_slots=2, min_bucket=8, block_len=8,
+                           prefix_blocks=6)        # 48 cached tokens max
+    prompts = _shared_prefix_prompts(13, 24, (2, 5, 9, 12, 3, 7)) + \
+        _prompts(14, (30, 41, 26))
+    outs = engine.serve_batch(prompts, max_new_tokens=4, max_steps=1000)
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(np.asarray(o.tokens),
+                                      _want_tokens(gpt, p, 4))
+    pool = engine.core.block_pool
+    cache = engine.core.prefix_cache
+    assert pool.free_blocks + pool.used_blocks == pool.num_blocks
+    assert pool.used_blocks <= pool.num_blocks
+    stats = cache.stats()
+    assert stats["prefix_evictions"] > 0
+    # tree block ownership matches pool accounting exactly
+    owned = []
+    stack = list(cache.root.children.values())
+    while stack:
+        n = stack.pop()
+        assert n.refcount == 0                      # all requests done
+        owned.append(n.block)
+        stack.extend(n.children.values())
+    assert len(owned) == len(set(owned)) == pool.used_blocks
+
+
+def test_blockpool_validation_and_accounting():
+    with pytest.raises(ValueError, match="divide"):
+        BlockPool(num_blocks=4, block_len=10, max_seq=64, num_layers=1,
+                  kv_heads=2, head_dim=4)
+    pool = BlockPool(num_blocks=2, block_len=8, max_seq=16, num_layers=1,
+                     kv_heads=2, head_dim=4)
+    a, b = pool.alloc(), pool.alloc()
+    assert {a, b} == {0, 1} and pool.free_blocks == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc()
+    pool.free(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(a)
+
+
+def test_match_release_is_idempotent_and_guarded():
+    pool = BlockPool(num_blocks=4, block_len=2, max_seq=8, num_layers=1,
+                     kv_heads=1, head_dim=2)
+    cache = PrefixCache(pool)
+
+    class _FakeKV:
+        ks = [jnp.zeros((1, 8, 1, 2))]
+        vs = [jnp.zeros((1, 8, 1, 2))]
+
+    toks = np.arange(6, dtype=np.int32)
+    cache.insert(toks, _FakeKV(), 0)
+    mr = cache.match(toks)
+    assert mr.tokens == 4 and len(mr.blocks) == 2   # (6-1)//2 blocks
+    assert all(n.refcount == 1 for n in mr._nodes)
+    cache.release(mr)
+    cache.release(mr)                               # idempotent
+    assert all(n.refcount == 0 for n in mr._nodes)
+    mr2 = cache.match(toks)
+    cache.release(mr2)
+    with pytest.raises(RuntimeError, match="underflow"):
+        mr2._released = False
+        cache.release(mr2)
+
+
+# -------------------------------------------------- head-of-line skip
+
+def _req(rid, n, arrival=1.0):
+    return Request(request_id=rid, prompt=np.zeros(n, np.int32),
+                   max_new_tokens=4, sampling=SamplingParams(),
+                   arrival_time=arrival)
+
+
+def test_budget_validation_rejects_unsatisfiable(gpt):
+    """A budget the admission gate can never open would starve every
+    request (the over-budget escape sits inside the gate) — both layers
+    reject it loudly instead."""
+    s = Scheduler(num_slots=2, max_seq=128, min_bucket=16)
+    s.submit(_req(0, 10))
+    with pytest.raises(ValueError, match="token_budget"):
+        s.admit(1, token_budget=0)
+    with pytest.raises(ValueError, match="max_prefill_tokens_per_step"):
+        ServingEngine(gpt, max_prefill_tokens_per_step=0)
+
+
+def test_block_len_rounds_down_to_pow2_divisor(gpt):
+    """A non-pow2 block_len lands on the largest pow2 divisor <= it, not
+    on a degenerate per-token tree."""
+    engine = ServingEngine(gpt, num_slots=1, block_len=12)  # max_seq 128
+    assert engine.core.block_pool.block_len == 8
+
+
+def test_admit_skips_oversized_head():
+    s = Scheduler(num_slots=4, max_seq=256, min_bucket=16, skip_window=2)
+    s.submit(_req(0, 200))                          # bucket 256
+    s.submit(_req(1, 10))                           # bucket 16
+    out = s.admit(2, token_budget=64)
+    assert [r.request_id for r, _ in out] == [1]
+    assert s.waiting[0].request_id == 0             # head kept its place
+    # with budget for the head, FCFS order resumes
+    out = s.admit(2, token_budget=512)
+    assert [r.request_id for r, _ in out] == [0]
+
+
+def test_admit_skip_window_bounds_lookahead():
+    """The window bounds how far a fitting request may jump from: with
+    skip_window=1 the fit at position 2 is invisible — and since nothing
+    else was admitted and the head can NEVER fit the full budget, the
+    head goes through over-budget (the budget is a stall bound, not a
+    correctness bound) instead of idling the slots forever."""
+    s = Scheduler(num_slots=4, max_seq=256, min_bucket=16, skip_window=1)
+    for rid, n in enumerate((200, 200, 10)):        # fit is past window
+        s.submit(_req(rid, n))
+    out = s.admit(2, token_budget=64)
+    assert [r.request_id for r, _ in out] == [0]
+    assert s.waiting[0].request_id == 1
+
+
+def test_admit_no_starvation_bound():
+    """After max_head_skips jumps the window collapses to the head; a
+    head that can never fit the full budget is then admitted over-budget
+    — every request gets through in bounded time."""
+    s = Scheduler(num_slots=4, max_seq=256, min_bucket=16,
+                  skip_window=4, max_head_skips=3)
+    s.submit(_req(0, 200))
+    for rid in range(1, 10):
+        s.submit(_req(rid, 10))
+    got = []
+    for _ in range(6):
+        got += [r.request_id for r, _ in s.admit(1, token_budget=64)]
+    # exactly max_head_skips small requests jumped the head, then the
+    # head went through (over-budget) and FCFS resumed
+    assert got == [1, 2, 3, 0, 4, 5]
+
+
+def test_engine_budget_admits_small_past_big(gpt):
+    """End-to-end: with a per-step prefill token budget, a small prompt
+    behind an 8x-bigger head starts decoding first — slots never idle —
+    and both outputs stay exact."""
+    engine = ServingEngine(gpt, num_slots=2, min_bucket=8, block_len=8,
+                           max_prefill_tokens_per_step=32)
+    big = _prompts(15, (100,))[0]                   # bucket 128 > 32
+    small = _prompts(16, (9,))[0]                   # bucket 16 <= 32
+    rid_b = engine.submit(big, max_new_tokens=3)
+    rid_s = engine.submit(small, max_new_tokens=3)
+    engine.step()
+    assert len(engine._requests[rid_s].tokens) >= 1
+    assert len(engine._requests[rid_b].tokens) == 0
+    engine.run_until_complete(500)
+    np.testing.assert_array_equal(
+        np.asarray(engine.result(rid_b).tokens), _want_tokens(gpt, big, 3))
+    np.testing.assert_array_equal(
+        np.asarray(engine.result(rid_s).tokens),
+        _want_tokens(gpt, small, 3))

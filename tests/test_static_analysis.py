@@ -133,9 +133,45 @@ def test_serving_package_is_a_default_hot_path():
     """The shipped rule config must keep covering the serving step loop
     (the fixtures above prove the rule catches the idioms; this pins the
     production glob so the coverage cannot silently regress)."""
+    import fnmatch
     from paddle_tpu.tools.analysis.checkers.host_sync import \
         DEFAULT_HOT_PATHS
     assert "paddle_tpu/serving/*.py" in DEFAULT_HOT_PATHS
+    # the radix prefix cache ships block-copy programs on the admission
+    # hot path — the glob must keep it covered
+    assert any(fnmatch.fnmatch("paddle_tpu/serving/prefix_cache.py", p)
+               for p in DEFAULT_HOT_PATHS)
+
+
+def _prefix_host_sync_checker():
+    return HostSyncChecker(hot_paths=("serving_prefix_host_sync_pos.py",
+                                      "serving_prefix_host_sync_neg.py"),
+                           all_functions_paths=())
+
+
+def test_prefix_cache_host_sync_positive():
+    """Prefix-cache idiom gone wrong: host syncs inside the compiled
+    block gather/scatter programs (per-admission readbacks of matched
+    counts / slab checksums)."""
+    res = run_analysis([str(LINT / "serving_prefix_host_sync_pos.py")],
+                       checkers=[_prefix_host_sync_checker()],
+                       root=str(LINT))
+    found = only_rule(res, "host-sync")
+    assert len(found) == 4, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert ".item()" in msgs
+    assert "float()" in msgs
+    assert "device_get" in msgs
+    assert "copies a computed value" in msgs
+
+
+def test_prefix_cache_host_sync_negative():
+    """The legal split: host radix walk (numpy keys, refcounts) + pure
+    compiled block copies — silent."""
+    res = run_analysis([str(LINT / "serving_prefix_host_sync_neg.py")],
+                       checkers=[_prefix_host_sync_checker()],
+                       root=str(LINT))
+    assert res.findings == [], [f.format() for f in res.findings]
 
 
 def test_serving_recompile_positive():
