@@ -237,34 +237,46 @@ class EngineCore:
     def _begin_prefill(self, req: Request) -> None:
         """Claim a slot, match + pin the longest cached prefix, seed the
         staging cache from its block rows (one gather program), and queue
-        the suffix's chunk plan.  No model FLOPs run here."""
+        the suffix's chunk plan.  No model FLOPs run here.  The slot and
+        the pinned radix path are returned to their pools if anything
+        between claim and placement raises — admission failure must not
+        bleed capacity (resource-lifecycle rule)."""
         slot = self.pool.alloc()
         match = None
-        matched = 0
-        if self.prefix_cache is not None:
-            match = self.prefix_cache.match(req.prompt)
-            matched = match.tokens
-        if matched:
-            ks, vs = self.prefix_cache.load_staging(match)
-            req.prefix_hit_tokens = matched
-            self.metrics.on_prefix_hit(matched)
-        else:
-            # ONE compiled zero-staging builder instead of 2*num_layers
-            # eager jnp.zeros dispatches per miss admission
-            if self._staging_init_fn is None:
-                model, max_seq = self.model, self.pool.max_seq
+        try:
+            matched = 0
+            if self.prefix_cache is not None:
+                match = self.prefix_cache.match(req.prompt)
+                matched = match.tokens
+            if matched:
+                ks, vs = self.prefix_cache.load_staging(match)
+            else:
+                # ONE compiled zero-staging builder instead of 2*num_layers
+                # eager jnp.zeros dispatches per miss admission
+                if self._staging_init_fn is None:
+                    model, max_seq = self.model, self.pool.max_seq
 
-                def fresh_staging():
-                    caches = model.init_cache(1, max_seq)
-                    return ([c[0] for c in caches],
-                            [c[1] for c in caches])
+                    def fresh_staging():
+                        caches = model.init_cache(1, max_seq)
+                        return ([c[0] for c in caches],
+                                [c[1] for c in caches])
 
-                self._staging_init_fn = jax.jit(fresh_staging)
-            ks, vs = self._staging_init_fn()
-        plan = self.scheduler.chunk_plan(matched, req.prompt_len,
-                                         self.prefill_chunk)
-        self.scheduler.place(req, slot)
-        self._prefills.append(_Prefill(req, slot, ks, vs, plan, match))
+                    self._staging_init_fn = jax.jit(fresh_staging)
+                ks, vs = self._staging_init_fn()
+            plan = self.scheduler.chunk_plan(matched, req.prompt_len,
+                                             self.prefill_chunk)
+            self.scheduler.place(req, slot)
+            # hit accounting only after placement: a failed admission is
+            # requeued and retried, and must not count its hit twice
+            if matched:
+                req.prefix_hit_tokens = matched
+                self.metrics.on_prefix_hit(matched)
+            self._prefills.append(_Prefill(req, slot, ks, vs, plan, match))
+        except BaseException:
+            if match is not None:
+                self.prefix_cache.release(match)
+            self.pool.free(slot)
+            raise
 
     def _run_chunk(self, st: _Prefill) -> None:
         """Dispatch one prefill chunk of ``st`` (async — no readback)."""
@@ -390,19 +402,33 @@ class EngineCore:
             from ..profiler import RecordEvent
             ann = RecordEvent("serving.step")
             ann.begin()
-        for req, _ in self.scheduler.admit(
+        try:
+            admitted = self.scheduler.admit(
                 self.pool.free_slots,
                 token_budget=self.max_prefill_tokens_per_step,
-                cost=self._prefill_cost):
-            self._begin_prefill(req)
-        new_tokens = self._advance_prefills()
-        if self._slots:
-            toks = self._decode_all_slots()
-            for slot in sorted(self._slots):
-                new_tokens += self._harvest(slot, int(toks[slot]))
-        self._evict_finished()
-        if ann is not None:
-            ann.end()
+                cost=self._prefill_cost)
+            for i, (req, _) in enumerate(admitted):
+                try:
+                    self._begin_prefill(req)
+                except BaseException:
+                    # admission failure must not LOSE requests: the
+                    # failing one and the rest of the popped batch go
+                    # back to the queue head (their slots/pins were
+                    # already returned)
+                    self.scheduler.requeue_front(
+                        [r for r, _ in admitted[i:]])
+                    raise
+            new_tokens = self._advance_prefills()
+            if self._slots:
+                toks = self._decode_all_slots()
+                for slot in sorted(self._slots):
+                    new_tokens += self._harvest(slot, int(toks[slot]))
+            self._evict_finished()
+        finally:
+            # a raised step must still close the trace annotation, or
+            # every later event nests inside a phantom serving.step
+            if ann is not None:
+                ann.end()
         self.metrics.record_step(
             active_slots=len(self._slots), num_slots=self.num_slots,
             queue_depth=self.scheduler.queue_depth,

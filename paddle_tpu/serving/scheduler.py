@@ -238,6 +238,14 @@ class Scheduler:
             out.append((req, pick_cost))
         return out
 
+    def requeue_front(self, reqs: List[Request]) -> None:
+        """Push admitted-but-not-started requests back onto the HEAD of
+        the waiting queue, preserving their relative order — the engine
+        uses this when admission fails partway through a batch so no
+        popped request is ever lost."""
+        for req in reversed(reqs):
+            self.waiting.appendleft(req)
+
     def place(self, req: Request, slot: int) -> None:
         if slot in self.running:
             raise ValueError(f"slot {slot} already occupied")

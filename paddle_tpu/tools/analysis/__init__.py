@@ -2,14 +2,17 @@
 
 The oracle test tier catches numeric wrongness; this package catches the
 SILENT failure classes of a jax codebase: tracer leaks, recompilation
-hazards, host syncs in hot paths, collective axis-name drift, registry/
-API drift, and dead state.  Pure-AST — linting never imports the code
-under analysis.
+hazards, host syncs in hot paths (inline AND transitive), collective
+axis-name drift, registry/API drift, dead state, use-after-donate, and
+resource-lifecycle leaks.  Pure-AST — linting never imports the code
+under analysis.  v2 adds a whole-program symbol index + call graph
+(``project.py``) that interprocedural rules resolve through.
 
 Entry points:
-  * ``python scripts/graftlint.py paddle_tpu`` — the CLI;
+  * ``python scripts/graftlint.py`` — the CLI (default scope:
+    ``paddle_tpu`` + the perf-critical entrypoints);
   * ``tests/test_static_analysis.py`` — the CI gate (zero unsuppressed
-    findings over ``paddle_tpu/``) plus per-rule fixture tests;
+    findings over the default scope) plus per-rule fixture tests;
   * ``run_analysis([...])`` — the library API both of those use.
 
 Suppression syntax (reason REQUIRED — see suppress.py):
@@ -19,9 +22,11 @@ Suppression syntax (reason REQUIRED — see suppress.py):
 from .findings import Finding, ERROR, WARNING
 from .suppress import parse_suppressions, Suppressions
 from .walker import AnalysisResult, FileContext, run_analysis
-from .report import format_json, format_text
+from .report import format_json, format_sarif, format_text
+from .project import Project, build_project
 from .checkers import default_checkers
 
 __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "Suppressions", "AnalysisResult", "FileContext", "run_analysis",
-           "format_json", "format_text", "default_checkers"]
+           "format_json", "format_sarif", "format_text", "Project",
+           "build_project", "default_checkers"]

@@ -9,10 +9,14 @@ from .host_sync import HostSyncChecker
 from .collectives import AxisNameChecker
 from .registry_drift import RegistryDriftChecker
 from .dead_state import DeadStateChecker
+from .donation import UseAfterDonateChecker
+from .lifecycle import ResourceLifecycleChecker, ResourcePair, DEFAULT_PAIRS
 
 __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "HostSyncChecker", "AxisNameChecker", "RegistryDriftChecker",
-           "DeadStateChecker", "default_checkers"]
+           "DeadStateChecker", "UseAfterDonateChecker",
+           "ResourceLifecycleChecker", "ResourcePair", "DEFAULT_PAIRS",
+           "default_checkers"]
 
 
 def default_checkers():
@@ -23,4 +27,6 @@ def default_checkers():
         AxisNameChecker(),
         RegistryDriftChecker(),
         DeadStateChecker(),
+        UseAfterDonateChecker(),
+        ResourceLifecycleChecker(),
     ]

@@ -25,6 +25,19 @@ class Checker:
         raise NotImplementedError
 
 
+def walk_with_class(tree: ast.AST):
+    """Iterative (node, enclosing_class_name) walk over the whole tree —
+    the class context several interprocedural rules need for ``self.x``
+    resolution, without the cost of nested generators."""
+    stack = [(child, None) for child in ast.iter_child_nodes(tree)]
+    while stack:
+        node, cls = stack.pop()
+        yield node, cls
+        child_cls = node.name if isinstance(node, ast.ClassDef) else cls
+        stack.extend((child, child_cls)
+                     for child in ast.iter_child_nodes(node))
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """'jax.lax.psum' for Attribute/Name chains, else None."""
     parts = []

@@ -1,15 +1,19 @@
-"""axis-name: collective axis names must be declared in the same module.
+"""axis-name: collective axis names must be declared where visible.
 
 A literal axis name at a ``ppermute``/``psum``/``all_gather``/... call
-site that no mesh/pmap/shard_map construct in the same module declares is
-either a typo (fails only when that code path finally runs on a mesh) or
-a hidden cross-module contract.  The checker:
+site that no mesh/pmap/shard_map construct IN SCOPE declares is either a
+typo (fails only when that code path finally runs on a mesh) or a hidden
+cross-module contract.  The checker:
 
   * collects DECLARED axis names: string literals inside ``Mesh(...)`` /
     ``make_mesh(...)`` / ``create_device_mesh`` calls, ``axis_name=`` /
     ``axis_names=`` keywords anywhere (pmap, shard_map wrappers, function
     defaults that document the expected axis), and ``PartitionSpec``/
     ``P(...)`` literals inside ``shard_map``/``NamedSharding`` calls;
+  * resolves declarations CROSS-MODULE through the project index (v2):
+    a module that imports its mesh builder sees the axes that builder
+    declares — same-module-only matching used to force ``disable-file``
+    suppressions for perfectly sound layering;
   * checks USED axis names: literal axis args of ``jax.lax`` collectives
     (second positional or ``axis_name=``).  Non-literal axis args (the
     common ``g.name`` / ``axis_name`` parameter pattern) are out of scope
@@ -21,7 +25,7 @@ A module whose collectives are all parameterized never reports.
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from ..findings import Finding, ERROR
 from .base import Checker, dotted_name
@@ -39,8 +43,35 @@ class AxisNameChecker(Checker):
     name = "axis-name"
     severity = ERROR
 
+    def __init__(self):
+        # (project, {module: axes}) — identity-compared, holding the
+        # project reference so a recycled id can never serve stale axes
+        self._decl_cache = None
+
+    def _imported_declarations(self, ctx) -> Set[str]:
+        """Axis names declared by the modules this file DIRECTLY imports,
+        resolved through the project index (empty without a project)."""
+        if ctx.project is None:
+            return set()
+        mi = ctx.project.module_for(ctx.relpath)
+        if mi is None:
+            return set()
+        if self._decl_cache is None or self._decl_cache[0] is not ctx.project:
+            self._decl_cache = (ctx.project, {})
+        per_mod: Dict[str, Set[str]] = self._decl_cache[1]
+        out: Set[str] = set()
+        for dep in ctx.project.imported_modules(mi.name):
+            hit = per_mod.get(dep)
+            if hit is None:
+                dm = ctx.project.modules.get(dep)
+                hit = self._declared(dm.tree) if dm is not None else set()
+                per_mod[dep] = hit
+            out |= hit
+        return out
+
     def check(self, ctx) -> List[Finding]:
-        declared = self._declared(ctx.tree)
+        declared = self._declared(ctx.tree) \
+            | self._imported_declarations(ctx)
         findings: List[Finding] = []
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
@@ -62,9 +93,10 @@ class AxisNameChecker(Checker):
                         self.name, ctx.relpath, axis_arg.lineno,
                         axis_arg.col_offset,
                         f"collective axis {lit!r} is not declared by any "
-                        f"mesh/pmap/shard_map in this module (typo, or a "
-                        f"cross-module mesh contract that should be "
-                        f"threaded as a parameter)", self.severity))
+                        f"mesh/pmap/shard_map in this module or its "
+                        f"direct imports (typo, or a mesh contract that "
+                        f"should be threaded as a parameter)",
+                        self.severity))
         return findings
 
     def _axis_arg(self, call: ast.Call) -> Optional[ast.AST]:

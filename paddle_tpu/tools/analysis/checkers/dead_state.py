@@ -39,18 +39,32 @@ class DeadStateChecker(Checker):
 
     def _project_mentions(self, ctx) -> Tuple[Set[str], Set[str]]:
         """(attr reads, string literals) across every .py under the scan
-        root, built once per root and cached."""
+        root, built once per root and cached.  Files the project index
+        already parsed (the scan scope) reuse their trees; only files
+        OUTSIDE it — tests/, examples/ — are parsed here, since a read
+        from a test keeps an attribute alive too."""
         if self._index_root == ctx.root:
             return self._index
         from ..walker import iter_py_files
         reads: Set[str] = set()
         strings: Set[str] = set()
+        indexed = {}
+        if ctx.project is not None:
+            indexed = ctx.project.by_relpath
         for f in iter_py_files([ctx.root]):
             try:
-                tree = ast.parse(f.read_text(encoding="utf-8",
-                                             errors="replace"))
-            except SyntaxError:
-                continue
+                rel = f.resolve().relative_to(ctx.root).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            mi = indexed.get(rel)
+            if mi is not None:
+                tree = mi.tree
+            else:
+                try:
+                    tree = ast.parse(f.read_text(encoding="utf-8",
+                                                 errors="replace"))
+                except SyntaxError:
+                    continue
             r, s = _module_mentions(tree)
             reads |= r
             strings |= s

@@ -1,0 +1,518 @@
+"""use-after-donate: reading a buffer after ``donate_argnums`` gave it away.
+
+``jax.jit(..., donate_argnums=...)`` tells XLA it may reuse the donated
+input's memory for outputs; after the call the Python reference still
+LOOKS alive but the array is deleted — touching it raises (or, on some
+backends, silently reads garbage).  Numeric tests rarely catch this
+because the happy path rebinds the name; the bug ships on the branch
+that doesn't.
+
+The checker builds a per-file donation table — decorated functions
+(``@partial(jax.jit, donate_argnums=...)``), wrapped callables
+(``g = jax.jit(f, donate_argnums=...)``), attributes holding them
+(``self._fn = jax.jit(...)``), and FACTORY methods whose return value is
+a donating jit (``self._fn = self._build()`` where ``_build`` returns
+one) — then flags, at every call site, any later read of a donated
+argument expression:
+
+  * a read in a following statement before the name is rebound
+    (``out = f(buf)`` ... ``buf.sum()``);
+  * a second donation of the same value (double-donate);
+  * a loop-carried read: ``for _: out = f(buf)`` donates ``buf`` on
+    iteration 1 and reads the corpse on iteration 2.
+
+Rebinding clears the taint — the engine's threading idiom
+(``last, st.ks, st.vs = self._prefill_fn(st.ks, st.vs, ...)``) and the
+pool's ``self.ks[i] = _adopt_row(self.ks[i], ...)`` are the LEGAL
+shapes and stay silent, as does rebinding in the immediately following
+statement.  Imported donating functions resolve through the project
+index when available.  Only literal donate specs are understood;
+conditional specs (``donate_argnums=x if y else ()``) are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..findings import Finding, ERROR
+from .base import (Checker, JIT_NAMES, STATIC_ATTRS, dotted_name,
+                   jit_decorator_info, param_names, walk_with_class,
+                   _partial_of_jit)
+
+
+@dataclass(frozen=True)
+class DonSpec:
+    """Donation contract of one jitted callable."""
+    positions: Tuple[int, ...]        # donated positional indices
+    names: Tuple[str, ...]            # donated param names (argnames)
+    params: Tuple[str, ...]           # wrapped fn's params, () if unknown
+    label: str                        # human name for messages
+
+
+def _literal_ints(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def spec_from_jit_call(call: ast.Call, params: Sequence[str],
+                       label: str) -> Optional[DonSpec]:
+    """DonSpec carried by a jit/partial-of-jit Call node, or None when no
+    (literal) donation keywords are present."""
+    positions: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            ints = _literal_ints(kw.value)
+            if ints is None:
+                return None          # conditional spec: unknowable
+            positions = ints
+        elif kw.arg == "donate_argnames":
+            strs = _literal_strs(kw.value)
+            if strs is None:
+                return None
+            names = strs
+    if not positions and not names:
+        return None
+    return DonSpec(positions=positions, names=names,
+                   params=tuple(params), label=label)
+
+
+def spec_for_function_node(fn: ast.AST) -> Optional[DonSpec]:
+    """DonSpec of a (possibly imported) function def, via its decorator."""
+    info = jit_decorator_info(fn)
+    if not isinstance(info, ast.Call):
+        return None
+    return spec_from_jit_call(info, param_names(fn), fn.name)
+
+
+def _is_jit_wrap(call: ast.Call) -> Optional[ast.AST]:
+    """If ``call`` is ``jax.jit(f, ...)`` or ``partial(jax.jit, f, ...)``,
+    return the wrapped-callable node, else None."""
+    if dotted_name(call.func) in JIT_NAMES and call.args:
+        return call.args[0]
+    if _partial_of_jit(call) is not None and len(call.args) > 1:
+        return call.args[1]
+    return None
+
+
+
+
+class _DonationTables:
+    """Per-file donation contracts, keyed by callable name and by
+    (class, attribute)."""
+
+    def __init__(self, tree: ast.Module):
+        self.by_name: Dict[str, DonSpec] = {}
+        self.by_attr: Dict[Tuple[str, str], DonSpec] = {}
+        local_defs: Dict[str, ast.AST] = {}
+        assigns: List[Tuple[ast.Assign, Optional[str]]] = []
+        fns: List[Tuple[ast.AST, Optional[str]]] = []
+
+        for node, cls in walk_with_class(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[node.name] = node
+                fns.append((node, cls))
+                spec = spec_for_function_node(node)
+                if spec is not None:
+                    self.by_name[node.name] = spec
+            elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                            ast.Call):
+                assigns.append((node, cls))
+
+        def wrap_spec(call: ast.Call) -> Optional[DonSpec]:
+            wrapped = _is_jit_wrap(call)
+            if wrapped is None:
+                return None
+            params: Sequence[str] = ()
+            label = "jax.jit(...)"
+            if isinstance(wrapped, ast.Name):
+                label = wrapped.id
+                d = local_defs.get(wrapped.id)
+                if d is not None:
+                    params = param_names(d)
+            elif isinstance(wrapped, ast.Lambda):
+                params = [a.arg for a in wrapped.args.args]
+            return spec_from_jit_call(call, params, label)
+
+        # g = jax.jit(f, donate...) / self._fn = jax.jit(f, donate...)
+        for node, cls in assigns:
+            spec = wrap_spec(node.value)
+            if spec is not None:
+                self._bind_targets(node.targets, cls, spec)
+
+        # factories: functions whose returned value is a donating jit
+        factory: Dict[Tuple[Optional[str], str], DonSpec] = {}
+        for fn, cls in fns:
+            spec = self._factory_spec(fn, cls, wrap_spec)
+            if spec is not None:
+                factory[(cls, fn.name)] = spec
+        # t = self._build() / t = build() where the factory donates
+        for node, cls in assigns:
+            fname = dotted_name(node.value.func)
+            if fname is None:
+                continue
+            parts = fname.split(".")
+            spec = None
+            if len(parts) == 2 and parts[0] in ("self", "cls"):
+                spec = factory.get((cls, parts[1]))
+            elif len(parts) == 1:
+                spec = factory.get((cls, parts[0])) \
+                    or factory.get((None, parts[0]))
+            if spec is not None:
+                self._bind_targets(node.targets, cls, spec)
+
+    def _bind_targets(self, targets, cls: Optional[str],
+                      spec: DonSpec) -> None:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.by_name[t.id] = spec
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id in ("self", "cls") and cls is not None:
+                self.by_attr[(cls, t.attr)] = spec
+
+    def _factory_spec(self, fn, cls, wrap_spec) -> Optional[DonSpec]:
+        local_jit: Dict[str, DonSpec] = {}
+        attr_jit: Dict[str, DonSpec] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                spec = wrap_spec(node.value)
+                if spec is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_jit[t.id] = spec
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in ("self", "cls"):
+                        attr_jit[t.attr] = spec
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if isinstance(node.value, ast.Call):
+                spec = wrap_spec(node.value)
+                if spec is not None:
+                    return spec
+            elif isinstance(node.value, ast.Name):
+                spec = local_jit.get(node.value.id)
+                if spec is not None:
+                    return spec
+            elif isinstance(node.value, ast.Attribute) \
+                    and isinstance(node.value.value, ast.Name) \
+                    and node.value.value.id in ("self", "cls"):
+                spec = attr_jit.get(node.value.attr) \
+                    or self.by_attr.get((cls, node.value.attr))
+                if spec is not None:
+                    return spec
+        return None
+
+
+def _trackable_text(node: ast.AST) -> Optional[str]:
+    """Unparsed text for arguments whose later reads we can track: bare
+    names and attribute/subscript chains.  Anything else (temporaries,
+    call results) cannot be re-read by name."""
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+        try:
+            return ast.unparse(node)
+        except Exception:
+            return None
+    return None
+
+
+@dataclass
+class _Donated:
+    label: str
+    line: int
+
+
+def _walk_pruned(root: ast.AST):
+    """ast.walk that does NOT descend into nested lambdas/defs: their
+    bodies execute later, under shadowed parameter scopes — a donation or
+    a read inside one is not an effect of the current statement."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+class UseAfterDonateChecker(Checker):
+    name = "use-after-donate"
+    severity = ERROR
+
+    def __init__(self):
+        self._donmod_cache = None     # (project, set-of-module-names)
+
+    def _donating_modules(self, project) -> Set[str]:
+        """Modules containing ANY donate spec — computed once per project
+        so the 97% of files with no donation anywhere skip the (costly)
+        table build and statement scan entirely."""
+        if self._donmod_cache is not None \
+                and self._donmod_cache[0] is project:
+            return self._donmod_cache[1]
+        out: Set[str] = set()
+        for name, mi in project.modules.items():
+            for node in ast.walk(mi.tree):
+                if isinstance(node, ast.Call) \
+                        and any(kw.arg in _DONATE_KWARGS
+                                for kw in node.keywords):
+                    out.add(name)
+                    break
+        self._donmod_cache = (project, out)
+        return out
+
+    def _relevant(self, ctx, module: Optional[str]) -> bool:
+        if "donate" in ctx.src:
+            return True
+        if ctx.project is None or module is None:
+            return False
+        donmods = self._donating_modules(ctx.project)
+        if module in donmods:
+            return True
+        mi = ctx.project.modules.get(module)
+        if mi is None:
+            return False
+        return any(ctx.project._longest_module_prefix(t) in donmods
+                   for t in mi.imports.values())
+
+    def check(self, ctx) -> List[Finding]:
+        module = None
+        if ctx.project is not None:
+            mi = ctx.project.module_for(ctx.relpath)
+            module = mi.name if mi is not None else None
+        if not self._relevant(ctx, module):
+            return []
+        tables = _DonationTables(ctx.tree)
+        findings: Dict[Tuple[int, int, str], Finding] = {}
+        for node, cls in walk_with_class(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(ctx, node, cls, tables, module, findings)
+        return list(findings.values())
+
+    # ------------------------------------------------------- resolution
+    def _spec_for_call(self, call: ast.Call, cls: Optional[str],
+                       tables: _DonationTables, ctx,
+                       module: Optional[str]) -> Optional[DonSpec]:
+        fname = dotted_name(call.func)
+        if fname is None:
+            return None
+        parts = fname.split(".")
+        if len(parts) == 2 and parts[0] in ("self", "cls"):
+            if cls is not None:
+                spec = tables.by_attr.get((cls, parts[1]))
+                if spec is not None:
+                    return spec
+            return None
+        if len(parts) == 1:
+            spec = tables.by_name.get(parts[0])
+            if spec is not None:
+                return spec
+        # imported donating function, via the project index
+        if ctx.project is not None and module is not None:
+            fi = ctx.project.resolve_call(module, fname, cls=cls)
+            if fi is not None:
+                return spec_for_function_node(fi.node)
+        return None
+
+    def _donated_args(self, call: ast.Call,
+                      spec: DonSpec) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        starred_at = next((i for i, a in enumerate(call.args)
+                           if isinstance(a, ast.Starred)), None)
+        positions = set(spec.positions)
+        names = set(spec.names)
+        for i in spec.positions:
+            if 0 <= i < len(spec.params):
+                names.add(spec.params[i])
+        for n in spec.names:
+            if n in spec.params:
+                positions.add(spec.params.index(n))
+        for i in sorted(positions):
+            if i < len(call.args) and (starred_at is None
+                                       or i < starred_at):
+                out.append(call.args[i])
+        for kw in call.keywords:
+            if kw.arg in names:
+                out.append(kw.value)
+        return out
+
+    # ------------------------------------------------------------ scan
+    def _scan_fn(self, ctx, fn, cls, tables, module, findings) -> None:
+        live: Dict[str, _Donated] = {}
+        self._scan_suite(ctx, fn.body, cls, tables, module, live, findings)
+
+    def _scan_suite(self, ctx, stmts, cls, tables, module,
+                    live: Dict[str, _Donated], findings) -> None:
+        for stmt in stmts:
+            self._scan_stmt(ctx, stmt, cls, tables, module, live, findings)
+
+    def _scan_stmt(self, ctx, stmt, cls, tables, module, live,
+                   findings) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return    # nested defs are scanned as their own functions
+        if isinstance(stmt, ast.If):
+            self._check_reads(ctx, stmt.test, live, findings)
+            b1, b2 = dict(live), dict(live)
+            self._scan_suite(ctx, stmt.body, cls, tables, module, b1,
+                             findings)
+            self._scan_suite(ctx, stmt.orelse, cls, tables, module, b2,
+                             findings)
+            live.clear()
+            live.update(b2)
+            live.update(b1)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            head = stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                else stmt.test
+            self._check_reads(ctx, head, live, findings)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._kill(live, self._store_texts(stmt.target))
+            body = dict(live)
+            self._scan_suite(ctx, stmt.body, cls, tables, module, body,
+                             findings)
+            # second pass over the body with the loop-carried state: a
+            # value donated at the bottom of iteration N is read at the
+            # top of iteration N+1
+            carried = dict(live)
+            carried.update(body)
+            self._scan_suite(ctx, stmt.body, cls, tables, module, carried,
+                             findings)
+            self._scan_suite(ctx, stmt.orelse, cls, tables, module,
+                             carried, findings)
+            live.clear()
+            live.update(carried)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(ctx, item.context_expr, live, findings)
+                if item.optional_vars is not None:
+                    self._kill(live, self._store_texts(item.optional_vars))
+            self._scan_suite(ctx, stmt.body, cls, tables, module, live,
+                             findings)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_suite(ctx, stmt.body, cls, tables, module, live,
+                             findings)
+            for h in stmt.handlers:
+                self._scan_suite(ctx, h.body, cls, tables, module, live,
+                                 findings)
+            self._scan_suite(ctx, stmt.orelse, cls, tables, module, live,
+                             findings)
+            self._scan_suite(ctx, stmt.finalbody, cls, tables, module,
+                             live, findings)
+            return
+
+        # ---- simple statement: reads, then kills, then new donations
+        self._check_reads(ctx, stmt, live, findings)
+        kills = self._store_texts(stmt)
+        self._kill(live, kills)
+        for call in self._calls_in(stmt):
+            spec = self._spec_for_call(call, cls, tables, ctx, module)
+            if spec is None:
+                continue
+            for arg in self._donated_args(call, spec):
+                text = _trackable_text(arg)
+                if text is None or text in kills:
+                    continue    # rebound in the same statement: legal
+                live[text] = _Donated(label=spec.label, line=call.lineno)
+
+    # --------------------------------------------------------- helpers
+    def _calls_in(self, stmt) -> List[ast.Call]:
+        return [sub for sub in _walk_pruned(stmt)
+                if isinstance(sub, ast.Call)]
+
+    def _store_texts(self, node: ast.AST) -> Set[str]:
+        """Texts of every Store-context target in the statement."""
+        out: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute, ast.Subscript)) \
+                    and isinstance(getattr(sub, "ctx", None), ast.Store):
+                try:
+                    out.add(ast.unparse(sub))
+                except Exception:
+                    pass
+            elif isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    try:
+                        out.add(ast.unparse(t))
+                    except Exception:
+                        pass
+        return out
+
+    def _kill(self, live: Dict[str, _Donated], texts: Set[str]) -> None:
+        if not texts or not live:
+            return
+        for donated in list(live):
+            for t in texts:
+                if donated == t or donated.startswith(t + ".") \
+                        or donated.startswith(t + "["):
+                    live.pop(donated, None)
+                    break
+
+    def _check_reads(self, ctx, node, live: Dict[str, _Donated],
+                     findings) -> None:
+        if not live:
+            return
+        # metadata access survives donation: jax keeps the aval of a
+        # deleted array, so donated.shape / .dtype / .ndim ... are legal
+        static_reads = {id(a.value) for a in _walk_pruned(node)
+                        if isinstance(a, ast.Attribute)
+                        and a.attr in STATIC_ATTRS}
+        for sub in _walk_pruned(node):
+            if not isinstance(sub, (ast.Name, ast.Attribute,
+                                    ast.Subscript)):
+                continue
+            if isinstance(getattr(sub, "ctx", None), ast.Store):
+                continue
+            if id(sub) in static_reads:
+                continue
+            try:
+                text = ast.unparse(sub)
+            except Exception:
+                continue
+            info = live.get(text)
+            if info is None:
+                continue
+            key = (sub.lineno, sub.col_offset, text)
+            if key in findings:
+                continue
+            findings[key] = Finding(
+                self.name, ctx.relpath, sub.lineno, sub.col_offset,
+                f"`{text}` was donated to jitted `{info.label}` "
+                f"(line {info.line}) and is read afterwards — a donated "
+                f"buffer is deleted by XLA; rebind the result or drop "
+                f"the donation", self.severity)

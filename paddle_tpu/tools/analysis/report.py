@@ -1,11 +1,15 @@
-"""Text / JSON reporters for graftlint results."""
+"""Text / JSON / SARIF reporters for graftlint results."""
 
 from __future__ import annotations
 
 import json
 from collections import Counter
+from typing import Optional, Sequence
 
 from .walker import AnalysisResult
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def format_text(result: AnalysisResult, verbose: bool = False) -> str:
@@ -31,3 +35,48 @@ def format_json(result: AnalysisResult) -> str:
         "suppressed": [f.to_json() for f in result.suppressed],
         "ok": result.ok,
     }, indent=2)
+
+
+def format_sarif(result: AnalysisResult,
+                 checkers: Optional[Sequence] = None) -> str:
+    """SARIF 2.1.0 — the interchange format CI annotators (GitHub code
+    scanning, VS Code SARIF viewers) ingest.  One run, one result per
+    unsuppressed finding; suppressed findings are emitted with a SARIF
+    ``suppressions`` entry so the audit trail survives the export."""
+    rule_ids = sorted({f.rule for f in result.findings}
+                      | {f.rule for f in result.suppressed}
+                      | ({c.name for c in checkers} if checkers else set()))
+
+    def to_result(f, suppressed: bool) -> dict:
+        res = {
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               # SARIF columns are 1-based; ast's are 0-based
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if suppressed:
+            res["suppressions"] = [{"kind": "inSource"}]
+        return res
+
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                # no informationUri: SARIF requires an absolute URI there
+                # and the rule docs live in-repo (docs/static_analysis.md)
+                "name": "graftlint",
+                "rules": [{"id": r} for r in rule_ids],
+            }},
+            "results": ([to_result(f, False) for f in result.findings]
+                        + [to_result(f, True) for f in result.suppressed]),
+        }],
+    }
+    return json.dumps(doc, indent=2)

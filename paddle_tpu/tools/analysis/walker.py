@@ -4,19 +4,56 @@
 parses it once, hands the tree to each checker, and filters findings
 through the file's suppression directives.  Nothing is imported — the
 analysis is robust to modules that need an accelerator to import.
+
+v2 additions:
+
+  * a whole-program :class:`~.project.Project` (symbol index + call
+    graph) is built over ``project_paths`` (default: the scan paths) and
+    handed to every checker on ``FileContext.project`` — interprocedural
+    rules (use-after-donate, transitive host-sync, cross-module
+    axis-name) resolve through it while per-file rules ignore it;
+  * an on-disk parse cache keyed by ``(path, mtime_ns, size)`` —
+    re-parsing ~350 files dominates a warm scan, so pre-commit (and the
+    ``--changed`` flow, which still indexes the whole project) stays
+    fast.  Pass ``cache_path`` to enable; a corrupt/stale cache is
+    silently rebuilt.
 """
 
 from __future__ import annotations
 
 import ast
+import os
+import pickle
+import sys
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .findings import Finding, ERROR
 from .suppress import Suppressions, parse_suppressions
 
-_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist",
+              ".graftlint_cache"}
+# bump the leading int when the parse-cache payload layout changes; the
+# interpreter version is part of the key because pickled ast nodes from
+# one Python do not round-trip into another's node classes, and the
+# analysis package's own fingerprint is too because cached Suppressions
+# bake in the parser's behaviour at cache-write time
+def _analysis_fingerprint() -> int:
+    latest = 0
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, _, names in os.walk(pkg):
+        for n in names:
+            if n.endswith(".py"):
+                try:
+                    latest = max(latest,
+                                 os.stat(os.path.join(dirpath, n)).st_mtime_ns)
+                except OSError:
+                    pass
+    return latest
+
+
+_CACHE_VERSION = (2, sys.version_info[:2], _analysis_fingerprint())
 
 
 @dataclass
@@ -26,6 +63,7 @@ class FileContext:
     relpath: str       # posix path relative to root — used in findings
     src: str
     tree: ast.Module
+    project: Optional[object] = None   # project.Project when built
 
 
 @dataclass
@@ -50,14 +88,168 @@ def iter_py_files(paths: Sequence[str]) -> Iterable[Path]:
                     yield f
 
 
+# ----------------------------------------------------------- parse cache
+
+def _sup_to_data(sup: Suppressions):
+    """Primitive-only payload: the cache must stay loadable whether the
+    package was imported as ``paddle_tpu.tools.analysis`` or via the
+    CLI's standalone ``graftlint_analysis`` loader — pickling our own
+    classes would bind it to one module identity (and unpickling could
+    even import the jax-heavy package from the import-free CLI)."""
+    return (
+        {ln: sorted(rules) for ln, rules in sup.by_line.items()},
+        sorted(sup.file_wide),
+        [(f.rule, f.path, f.line, f.col, f.message, f.severity)
+         for f in sup.errors],
+        [(ln, sorted(rules)) for ln, rules in sup.directives],
+    )
+
+
+def _sup_from_data(data) -> Suppressions:
+    by_line, file_wide, errors, directives = data
+    return Suppressions(
+        by_line={ln: set(rules) for ln, rules in by_line.items()},
+        file_wide=set(file_wide),
+        errors=[Finding(*t) for t in errors],
+        directives=[(ln, set(rules)) for ln, rules in directives],
+    )
+
+
+class _ParseCache:
+    """{abspath: (mtime_ns, size, relpath, src, tree, suppressions,
+    parse_error)} pickled to one file.  Keyed by stat identity; relpath
+    participates in validation because suppressions embed it in their
+    Finding records."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.entries: Dict[str, Tuple] = {}
+        self.touched: set = set()      # keys used this run; rest evicted
+        self.dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path, "rb") as fh:
+                    payload = pickle.load(fh)
+                if payload.get("version") == _CACHE_VERSION:
+                    self.entries = payload.get("entries", {})
+            except Exception:
+                self.entries = {}    # corrupt cache: rebuild silently
+
+    def get(self, abspath: str, relpath: str):
+        if self.path is None:
+            return None
+        try:
+            st = os.stat(abspath)
+        except OSError:
+            return None
+        hit = self.entries.get(abspath)
+        if hit and hit[0] == st.st_mtime_ns and hit[1] == st.st_size \
+                and hit[2] == relpath:
+            try:
+                err = Finding(*hit[6]) if hit[6] is not None else None
+                self.touched.add(abspath)
+                return hit[3], hit[4], _sup_from_data(hit[5]), err
+            except Exception:
+                return None
+        return None
+
+    def put(self, abspath: str, relpath: str, src: str, tree, sup,
+            err: Optional[Finding]) -> None:
+        if self.path is None:
+            return
+        try:
+            st = os.stat(abspath)
+        except OSError:
+            return
+        errdata = None if err is None else (err.rule, err.path, err.line,
+                                            err.col, err.message,
+                                            err.severity)
+        self.entries[abspath] = (st.st_mtime_ns, st.st_size, relpath,
+                                 src, tree, _sup_to_data(sup), errdata)
+        self.touched.add(abspath)
+        self.dirty = True
+
+    def save(self) -> None:
+        if self.path is None:
+            return
+        # evict entries this run never touched (deleted/renamed files,
+        # one-off ad-hoc paths) — each carries its source + pickled AST,
+        # so an append-only cache would grow without bound
+        stale = set(self.entries) - self.touched
+        if stale:
+            for k in stale:
+                del self.entries[k]
+            self.dirty = True
+        if not self.dirty:
+            return
+        try:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as fh:
+                pickle.dump({"version": _CACHE_VERSION,
+                             "entries": self.entries}, fh,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        except Exception:
+            pass    # a cache that cannot be written is just a slow scan
+
+
+@dataclass
+class _ParsedFile:
+    abspath: str
+    relpath: str
+    src: str
+    tree: Optional[ast.Module]
+    sup: Suppressions
+    parse_error: Optional[Finding]
+
+
+def _parse_files(paths: Sequence[str], root_str: str,
+                 cache: _ParseCache) -> Dict[str, _ParsedFile]:
+    out: Dict[str, _ParsedFile] = {}
+    for f in iter_py_files(paths):
+        fabs = str(f.resolve())
+        if fabs in out:
+            continue
+        try:
+            rel = Path(fabs).relative_to(root_str).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        hit = cache.get(fabs, rel)
+        if hit is not None:
+            src, tree, sup, err = hit
+            out[fabs] = _ParsedFile(fabs, rel, src, tree, sup, err)
+            continue
+        src = Path(fabs).read_text(encoding="utf-8", errors="replace")
+        sup = parse_suppressions(rel, src)
+        err = None
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            tree = None
+            err = Finding("parse-error", rel, e.lineno or 1, 0,
+                          f"syntax error: {e.msg}", ERROR)
+        out[fabs] = _ParsedFile(fabs, rel, src, tree, sup, err)
+        cache.put(fabs, rel, src, tree, sup, err)
+    return out
+
+
 def run_analysis(paths: Sequence[str], checkers: Sequence = None,
                  root: Optional[str] = None,
-                 rules: Optional[Sequence[str]] = None) -> AnalysisResult:
+                 rules: Optional[Sequence[str]] = None,
+                 project_paths: Optional[Sequence[str]] = None,
+                 cache_path: Optional[str] = None) -> AnalysisResult:
     """Run ``checkers`` over every python file under ``paths``.
 
     ``root`` anchors the relative paths used in findings and suppression
-    matching; it defaults to the common parent of the scan paths' repo
-    (the cwd).  ``rules`` optionally restricts to a subset of rule names.
+    matching; it defaults to the cwd.  ``rules`` optionally restricts to
+    a subset of rule names.  ``project_paths`` widens the PROJECT INDEX
+    beyond the scan set (``--changed`` lints two files but indexes the
+    whole tree so interprocedural rules keep their vision); findings are
+    only emitted for files in ``paths``.  ``cache_path`` enables the
+    on-disk parse cache.
     """
     if checkers is None:
         from .checkers import default_checkers
@@ -68,32 +260,36 @@ def run_analysis(paths: Sequence[str], checkers: Sequence = None,
     root_path = Path(root) if root else Path.cwd()
     root_str = str(root_path.resolve())
 
+    cache = _ParseCache(cache_path)
+    scan = _parse_files(paths, root_str, cache)
+    indexed = dict(scan)
+    if project_paths:
+        for k, v in _parse_files(project_paths, root_str, cache).items():
+            indexed.setdefault(k, v)
+    cache.save()
+
+    from .project import build_project
+    project = build_project((pf.relpath, pf.tree, pf.sup)
+                            for pf in indexed.values()
+                            if pf.tree is not None)
+
     result = AnalysisResult()
     raw: List[Finding] = []
     sup_by_path: Dict[str, Suppressions] = {}
 
-    for f in iter_py_files(paths):
-        fabs = f.resolve()
-        try:
-            rel = fabs.relative_to(root_str).as_posix()
-        except ValueError:
-            rel = f.as_posix()
-        src = fabs.read_text(encoding="utf-8", errors="replace")
-        sup = parse_suppressions(rel, src)
-        sup_by_path[rel] = sup
-        raw.extend(sup.errors)       # malformed directives are findings
-        try:
-            tree = ast.parse(src)
-        except SyntaxError as e:
-            raw.append(Finding("parse-error", rel, e.lineno or 1, 0,
-                               f"syntax error: {e.msg}", ERROR))
-            result.files_scanned += 1
+    for pf in scan.values():
+        sup_by_path[pf.relpath] = pf.sup
+        raw.extend(pf.sup.errors)    # malformed directives are findings
+        result.files_scanned += 1
+        if pf.tree is None:
+            if pf.parse_error is not None:
+                raw.append(pf.parse_error)
             continue
-        ctx = FileContext(root=root_str, path=str(fabs), relpath=rel,
-                          src=src, tree=tree)
+        ctx = FileContext(root=root_str, path=pf.abspath,
+                          relpath=pf.relpath, src=pf.src, tree=pf.tree,
+                          project=project)
         for checker in checkers:
             raw.extend(checker.check(ctx))
-        result.files_scanned += 1
 
     for finding in sorted(raw, key=lambda x: (x.path, x.line, x.rule)):
         sup = sup_by_path.get(finding.path)

@@ -1,0 +1,15 @@
+"""Uses the axes its imported mesh builder declares — the cross-module
+contract the project index resolves; same-module-only matching would
+have forced a disable-file suppression here."""
+import jax
+
+from mesh import build_mesh
+
+
+def allreduce(x, mesh=None):
+    mesh = mesh or build_mesh([])
+    return jax.lax.psum(x, "dp")
+
+
+def gather(x):
+    return jax.lax.all_gather(x, "mp")

@@ -1,0 +1,14 @@
+"""EXACTLY 1 finding: axis 'ep' is declared neither here nor by any
+module this file imports — a typo (or an undeclared contract)."""
+import jax
+
+from mesh import build_mesh
+
+
+def allreduce(x, mesh=None):
+    mesh = mesh or build_mesh([])
+    return jax.lax.psum(x, "dp")      # fine: declared by the import
+
+
+def expert_reduce(x):
+    return jax.lax.psum(x, "ep")      # BAD: nobody declares 'ep'

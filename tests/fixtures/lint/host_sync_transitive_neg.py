@@ -1,0 +1,35 @@
+"""NEGATIVE fixture: clean helpers and host-side syncs must stay silent.
+
+The jitted body calls helpers that never sync (shape/dtype access is
+static at trace time), and the function that DOES sync is only reached
+from plain host code — taint without a hot caller is not a finding.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def clean_helper(x):
+    return x * 2
+
+
+def shape_helper(x):
+    return x.shape[0]                # static at trace time — no sync
+
+
+@jax.jit
+def hot_step(x):
+    n = shape_helper(x)
+    return clean_helper(x) / n
+
+
+def harvest(x):
+    # a host-plane readback: syncing here is the CONTRACT (one readback
+    # per step); harvest is not hot and nothing hot calls it
+    return float(jnp.sum(x))
+
+
+def drive(xs):
+    total = 0.0
+    for x in xs:
+        total += harvest(hot_step(x))
+    return total

@@ -1,0 +1,33 @@
+"""POSITIVE fixture: interprocedural host-sync must fire EXACTLY 2 times.
+
+The sink (``.item()``) lives in a helper that is NOT itself hot — the
+old per-file rule was blind to it.  A jitted body reaches it two hops
+down (via ``middle``), and a ``lax.scan`` body reaches it in one hop;
+both call sites must fire.  The inline sink itself stays silent: the
+helper is host code until someone hot calls it.
+"""
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def leaf_sync(x):
+    return x.item()                  # the sink — not hot by itself
+
+
+def middle(x):
+    return leaf_sync(x) + 1          # one hop from the sink
+
+
+@jax.jit
+def hot_step(x):
+    y = jnp.sum(x)
+    return middle(y)                 # BAD: reaches .item() two hops down
+
+
+def body(c, x):
+    return c + leaf_sync(x), None    # BAD: scan body reaches the sink
+
+
+def run(xs):
+    return lax.scan(body, 0.0, xs)

@@ -1,0 +1,54 @@
+"""NEGATIVE fixture: sound lifecycle shapes must stay silent.
+
+The engine's fixed admission shape (release in an except path), the
+checkpoint lock's try/finally, immediate-return hand-off, adjacent
+alloc/free with nothing raisable between, balanced pins, and a release
+of a handle acquired elsewhere (not this function's to track).
+"""
+
+
+def protected_admit(pool, scheduler, req):
+    slot = pool.alloc()
+    try:
+        plan = scheduler.plan(req)
+        scheduler.place(req, slot, plan)
+    except Exception:
+        pool.free(slot)
+        raise
+
+
+def with_finally(lock, work):
+    lock.acquire()
+    try:
+        work()
+    finally:
+        lock.release()
+
+
+def immediate_handoff(pool):
+    return pool.alloc()
+
+
+def adjacent(pool):
+    slot = pool.alloc()
+    pool.free(slot)
+    return slot
+
+
+def balanced_pin(cache, node):
+    cache.pin(node)
+    cache.unpin(node)
+
+
+def release_only(pool, slot):
+    pool.free(slot)
+
+
+def release_on_both_paths(pool, work):
+    slot = pool.alloc()
+    try:
+        work(slot)
+        pool.free(slot)
+    except Exception:
+        pool.free(slot)     # NOT a double free: the body's free did not run
+        raise

@@ -1,0 +1,26 @@
+"""POSITIVE fixture: resource-lifecycle must fire EXACTLY 3 times.
+
+Plants the three failure shapes the rule owns, on the serving stack's
+registered pairs: a BlockPool row that leaks when an exception fires
+between ``alloc`` and the hand-off, a double ``free`` of the same row,
+and a refcount ``pin`` that exits the function unbalanced.
+"""
+
+
+def leaky_insert(block_pool, tree, tokens):
+    row = block_pool.alloc()        # BAD: leaks if block_key() raises
+    key = tree.block_key(tokens)
+    tree.attach(key, row)
+    return key
+
+
+def double_free(block_pool):
+    row = block_pool.alloc()
+    block_pool.free(row)
+    block_pool.free(row)            # BAD: double free
+
+
+def pin_leak(cache, node):
+    cache.pin(node)                 # BAD: never unpinned, never escapes
+    count = node.refcount
+    return count

@@ -1,0 +1,56 @@
+"""NEGATIVE fixture: the LEGAL donation idioms must stay silent.
+
+Mirrors the serving engine's real shapes: rebinding the donated value in
+the same statement (threading), rebinding attribute rows in a loop,
+rebinding in the immediately following statement, and keyword-donated
+params rebound at the call.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def step(p, o, x):
+    return p + x, o
+
+
+def train(p, o, xs):
+    for x in xs:
+        p, o = step(p, o, x)        # rebound in the same statement
+    return p
+
+
+class Pool:
+    def __init__(self, rows):
+        self.ks = rows
+        self._adopt = jax.jit(lambda b, r: b + r, donate_argnums=(0,))
+
+    def adopt_all(self, row):
+        for i in range(2):
+            self.ks[i] = self._adopt(self.ks[i], row)   # same-stmt rebind
+        return self.ks
+
+
+def deferred_rebind(p, o, x):
+    np_, no = step(p, o, x)
+    p, o = np_, no                  # rebound before any read
+    return p, o
+
+
+@functools.partial(jax.jit, donate_argnames=("buf",))
+def consume(buf, x):
+    return buf * x
+
+
+def kwarg_donation(buf, x):
+    buf = consume(buf=buf, x=x)     # kwarg-donated, rebound at the call
+    return jnp.sum(buf)
+
+
+def metadata_after_donate(p, o, x):
+    np_, no = step(p, o, x)
+    rows = p.shape[0]               # aval survives donation: legal
+    kind = o.dtype
+    return np_, no, rows, kind

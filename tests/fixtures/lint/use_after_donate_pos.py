@@ -1,0 +1,46 @@
+"""POSITIVE fixture: use-after-donate must fire EXACTLY 3 times.
+
+Plants the three shapes the rule owns: a straight-line read after a
+donating call, a read after a call through a donating-factory attribute
+(the engine's ``self._fn = self._build()`` pattern), and a loop-carried
+donation where iteration N+1 reads the buffer iteration N gave away.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buf, x):
+    return buf + x
+
+
+def read_after_donate(buf, x):
+    out = update(buf, x)
+    return out + buf.sum()          # BAD: buf was donated to update()
+
+
+class Stepper:
+    def __init__(self):
+        self._fn = None
+
+    def _build(self):
+        def step(state, x):
+            return state * x
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def run(self, state, x):
+        if self._fn is None:
+            self._fn = self._build()
+        new_state = self._fn(state, x)
+        debug = jnp.linalg.norm(state)   # BAD: state donated via self._fn
+        return new_state, debug
+
+
+def loop_carried(buf, xs):
+    out = None
+    for x in xs:
+        out = update(buf, x)        # BAD: buf donated on iter 1, read on iter 2
+    return out

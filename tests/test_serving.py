@@ -275,3 +275,56 @@ def test_inference_config_rejects_non_model():
     from paddle_tpu import inference
     with pytest.raises(TypeError, match="init_cache"):
         inference.Config(model=object())
+
+
+def test_admission_failure_releases_resources_and_requeues(gpt, eng):
+    """If anything raises after the slot claim (scheduler.place here —
+    called only inside _begin_prefill, AFTER alloc + radix match), the
+    engine must (a) propagate, (b) return the slot and any radix pins,
+    and (c) push the failed + unstarted batch back onto the queue so no
+    submitted request is ever lost — then serve them fine once the
+    fault clears."""
+    core = eng.core
+    free_before = core.pool.free_slots
+    prompts = _prompts(23, (6, 9))
+    rids = [eng.submit(p, max_new_tokens=3) for p in prompts]
+    orig = core.scheduler.place
+    core.scheduler.place = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("induced admission failure"))
+    try:
+        with pytest.raises(RuntimeError, match="induced admission"):
+            eng.step()
+    finally:
+        core.scheduler.place = orig
+    assert core.pool.free_slots == free_before
+    assert core.scheduler.queue_depth == len(prompts)   # nothing lost
+    if core.prefix_cache is not None:                   # no leaked pins
+        stack = list(core.prefix_cache.root.children.values())
+        while stack:
+            n = stack.pop()
+            assert n.refcount == 0
+            stack.extend(n.children.values())
+    eng.run_until_complete(max_steps=200)
+    for rid, p in zip(rids, prompts):
+        out = eng.purge(rid)
+        assert out.finished
+        np.testing.assert_array_equal(out.tokens, _want_tokens(gpt, p, 3))
+
+    # a failed-then-retried admission with a CACHED prefix must count
+    # its hit once, not once per attempt (accounting moved after place)
+    long_p = _prompts(29, (40,))[0]
+    rid = eng.submit(long_p, max_new_tokens=2)
+    eng.run_until_complete(max_steps=200)
+    eng.purge(rid)                      # prefix now cached
+    hits_before = core.metrics.prefix_hits
+    rid = eng.submit(long_p, max_new_tokens=2)
+    core.scheduler.place = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("induced admission failure"))
+    try:
+        with pytest.raises(RuntimeError, match="induced admission"):
+            eng.step()
+    finally:
+        core.scheduler.place = orig
+    eng.run_until_complete(max_steps=200)
+    assert eng.purge(rid).finished
+    assert core.metrics.prefix_hits == hits_before + 1
