@@ -42,6 +42,60 @@ FLAGSHIP_DECODE = {"vocab": 32768, "hidden": 768, "layers": 12,
 # HBM bandwidth per chip (public datasheets), for bandwidth-bound rows
 HBM_BW_BY_GEN = {"v5e": 819e9, "v5p": 2765e9, "v4": 1228e9}
 
+
+def decode_bw_util(tps, b, prompt, new, n_params, layers, hidden, bpe,
+                   gen="v5e"):
+    """HBM bandwidth utilization of a decode step: per step the chip
+    reads every weight once (batch amortizes it) plus each sequence's
+    live KV prefix, and writes one KV entry per layer.  Decode is
+    bandwidth-bound, so this — not MFU — is the honest efficiency
+    metric (VERDICT r4 item 8)."""
+    hbm_bw = HBM_BW_BY_GEN.get(gen, 819e9)
+    avg_ctx = prompt + new / 2
+    kv_read = 2 * layers * avg_ctx * hidden * bpe
+    kv_write = 2 * layers * hidden * bpe
+    bytes_per_step = n_params * bpe + b * (kv_read + kv_write)
+    return round(bytes_per_step * (tps / b) / hbm_bw, 4)
+
+
+def decode_bw_projection(evidence_path=None):
+    """(hbm_bw_util, note) projected from the committed TPU evidence
+    file's gpt_decode row — the CPU-smoke stand-in for a live HBM
+    figure.  Returns (None, None) when the evidence is missing or has
+    no decode row.  Reads the JSON directly (no scripts/ import): the
+    projection must fire in any harness that can open the file."""
+    if evidence_path is None:
+        evidence_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_TPU_EVIDENCE.json")
+    try:
+        with open(evidence_path) as fh:
+            ev = json.load(fh)
+        # the traversal stays inside the guard: a structurally-malformed
+        # evidence file (list top level, truncated rewrite) must degrade
+        # this one metric, not take down the whole secondary bench block
+        ev_row = (ev.get("secondary_tpu") or {}).get("gpt_decode", {})
+        ev_tps = ev_row.get("decode_tokens_per_sec")
+    except (OSError, ValueError, AttributeError, TypeError):
+        return None, None
+    if not isinstance(ev_tps, (int, float)) or not ev_tps:
+        return None, None
+    # the evidence row was measured at the flagship decode shape —
+    # single source of truth: FLAGSHIP_DECODE
+    import jax.numpy as jnp
+    from paddle_tpu.models import GPTConfig
+    fd = FLAGSHIP_DECODE
+    ecfg = GPTConfig(vocab_size=fd["vocab"], hidden_size=fd["hidden"],
+                     num_layers=fd["layers"], num_heads=fd["heads"],
+                     max_seq_len=fd["max_seq"], dtype=fd["dtype"])
+    util = decode_bw_util(
+        float(ev_tps), fd["batch"], fd["prompt"], fd["new"],
+        ecfg.num_params(), ecfg.num_layers, ecfg.hidden_size,
+        jnp.dtype(ecfg.dtype).itemsize, "v5e")
+    note = (f"projected from {os.path.basename(evidence_path)} v5e "
+            f"gpt_decode (CPU smoke has no HBM)")
+    return util, note
+
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 # total wall budget for TPU acquisition (round-2 VERDICT item 1a: adaptive
 # retry loop with backoff instead of a fixed 2-attempt probe).  Default
@@ -544,19 +598,6 @@ def _secondary_benches(smoke=False):
     pdt = timed(1, iters_d)                         # prefill + 1 token
     # steady-state decode rate: the (dnew - 1) extra tokens cost dt - pdt
     decode_tps = (db * (dnew - 1) / (dt - pdt)) if dt > pdt else None
-    # decode is HBM-bandwidth-bound, so the honest efficiency metric is
-    # BW utilization, not MFU (VERDICT r4 item 8): per decode STEP the
-    # chip reads every weight once (batch amortizes it) plus each
-    # sequence's live KV prefix, and writes one KV entry per layer.
-    def decode_bw_util(tps, b, prompt, new, n_params, layers, hidden,
-                      bpe, gen="v5e"):
-        hbm_bw = HBM_BW_BY_GEN.get(gen, 819e9)
-        avg_ctx = prompt + new / 2
-        kv_read = 2 * layers * avg_ctx * hidden * bpe
-        kv_write = 2 * layers * hidden * bpe
-        bytes_per_step = n_params * bpe + b * (kv_read + kv_write)
-        return round(bytes_per_step * (tps / b) / hbm_bw, 4)
-
     bw_util, bw_note = None, None
     if decode_tps and not smoke:
         # weights and KV cache both live in dcfg.dtype (init_cache
@@ -570,30 +611,11 @@ def _secondary_benches(smoke=False):
         # a CPU smoke has no HBM figure — rather than silently dropping
         # the metric, project it from the committed v5e hardware run
         # (BENCH_TPU_EVIDENCE.json gpt_decode: the flagship decode config
-        # measured on-chip) and mark it as such
-        try:
-            from scripts.tpu_evidence_bench import CANONICAL_PATH, _load
-            ev = _load(CANONICAL_PATH) or {}
-            ev_row = (ev.get("secondary_tpu") or {}).get("gpt_decode", {})
-            ev_tps = ev_row.get("decode_tokens_per_sec")
-            if ev_tps:
-                # the evidence row was measured at the flagship decode
-                # shape — single source of truth: FLAGSHIP_DECODE
-                fd = FLAGSHIP_DECODE
-                ecfg = GPTConfig(vocab_size=fd["vocab"],
-                                 hidden_size=fd["hidden"],
-                                 num_layers=fd["layers"],
-                                 num_heads=fd["heads"],
-                                 max_seq_len=fd["max_seq"],
-                                 dtype=fd["dtype"])
-                bw_util = decode_bw_util(
-                    float(ev_tps), fd["batch"], fd["prompt"], fd["new"],
-                    ecfg.num_params(), ecfg.num_layers, ecfg.hidden_size,
-                    jnp.dtype(ecfg.dtype).itemsize, "v5e")
-                bw_note = ("projected from BENCH_TPU_EVIDENCE.json v5e "
-                           "gpt_decode (CPU smoke has no HBM)")
-        except Exception:
-            pass
+        # measured on-chip) and mark it as such.  decode_bw_projection
+        # reads the evidence JSON directly and is unit-tested against a
+        # stub file — BENCH_r05 shipped a null here because the old
+        # scripts/-import path silently swallowed its failure
+        bw_util, bw_note = decode_bw_projection()
     out["gpt_decode"] = {
         "step_ms": round(dt * 1e3, 1),
         # new tokens/sec over the whole call (prefill amortized in)

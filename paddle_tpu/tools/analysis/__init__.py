@@ -6,7 +6,10 @@ hazards, host syncs in hot paths (inline AND transitive), collective
 axis-name drift, registry/API drift, dead state, use-after-donate, and
 resource-lifecycle leaks.  Pure-AST — linting never imports the code
 under analysis.  v2 adds a whole-program symbol index + call graph
-(``project.py``) that interprocedural rules resolve through.
+(``project.py``) that interprocedural rules resolve through.  v3 adds
+graftshape (``absint.py`` + ``signatures.py``): abstract shape/dtype/
+sharding interpretation powering the recompile-shape, dtype-flow, and
+sharding-consistency rule families.
 
 Entry points:
   * ``python scripts/graftlint.py`` — the CLI (default scope:
@@ -25,8 +28,14 @@ from .walker import AnalysisResult, FileContext, run_analysis
 from .report import format_json, format_sarif, format_text
 from .project import Project, build_project
 from .checkers import default_checkers
+from .absint import (Arr, Const, DYN, SpecVal, Sym, Tup, UNKNOWN,
+                     Interpreter, interpret_function)
+from .signatures import register_signature, register_method_signature
 
 __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "Suppressions", "AnalysisResult", "FileContext", "run_analysis",
            "format_json", "format_sarif", "format_text", "Project",
-           "build_project", "default_checkers"]
+           "build_project", "default_checkers", "Arr", "Const", "DYN",
+           "SpecVal", "Sym", "Tup", "UNKNOWN", "Interpreter",
+           "interpret_function", "register_signature",
+           "register_method_signature"]

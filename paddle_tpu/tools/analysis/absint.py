@@ -1,0 +1,879 @@
+"""graftshape: abstract shape/dtype/sharding interpretation (import-free).
+
+The syntactic rules of graftlint v1/v2 see *calls*; this layer sees
+*values*.  A small abstract domain — symbolic or concrete dims, dtype,
+optional PartitionSpec — is propagated through function bodies by an
+AST-level interpreter, with ``jnp``/``lax`` semantics supplied by the
+registrable signature table in :mod:`.signatures` and repo functions
+summarized interprocedurally through the PR-4 project index.  Three
+checker families consume it (recompile-shape, dtype-flow,
+sharding-consistency); anything value-level a future rule needs should
+land here, not in a checker.
+
+Domain (everything immutable-by-convention):
+
+  * dims — a shape entry is an ``int`` (concrete), a :class:`Sym`
+    (trace-static but unknown: batch size, seq len), or :data:`DYN`
+    (data-dependent under jit: the extent ``nonzero``/bool-mask produces
+    — existence of a DYN dim is exactly the recompile hazard);
+  * :class:`Arr` — shape (tuple of dims, or ``None`` = unknown rank),
+    dtype name (``None`` = unknown), optional PartitionSpec axes, and a
+    ``traced`` bit (derived from a jit-traced argument);
+  * :class:`Const` — a concrete Python value (int/float/str/bool/None,
+    and dtype names: ``jnp.float32`` evaluates to ``Const("float32")``);
+  * :class:`Tup` — tuples/lists of abstract values;
+  * :class:`SpecVal` — a ``PartitionSpec``/``P(...)`` value;
+  * :data:`UNKNOWN` — top.
+
+Soundness contract (same as the project index): the interpreter is
+best-effort — anything it cannot evaluate becomes UNKNOWN and produces
+no event, so rules built on it can miss but what they see is real.  It
+never imports the code under analysis and never executes user
+expressions; constant arithmetic is folded over a small operator table.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .checkers.base import dotted_name, param_names
+
+__all__ = ["Sym", "DYN", "Arr", "Const", "Tup", "SpecVal", "UNKNOWN",
+           "AbstractValue", "ShapeEvent", "CallRecord", "Interpreter",
+           "promote_dtypes", "dtype_width", "interpret_function"]
+
+
+# ------------------------------------------------------------------ dims
+
+class Sym:
+    """A trace-static but statically-unknown extent (named for messages)."""
+
+    __slots__ = ("name",)
+    _counter = [0]
+
+    def __init__(self, name: Optional[str] = None):
+        if name is None:
+            Sym._counter[0] += 1
+            name = f"s{Sym._counter[0]}"
+        self.name = name
+
+    def __repr__(self):
+        return self.name
+
+
+class _Dynamic:
+    """Sentinel: a data-dependent extent (illegal under jit)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dyn>"
+
+
+DYN = _Dynamic()
+
+
+# ---------------------------------------------------------------- values
+
+class AbstractValue:
+    """Base of the domain; rich equality is deliberately NOT defined —
+    joins compare structurally via :func:`join`."""
+
+    __slots__ = ()
+
+
+class _Unknown(AbstractValue):
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<unknown>"
+
+
+UNKNOWN = _Unknown()
+
+
+@dataclass(frozen=True)
+class Const(AbstractValue):
+    """A concrete Python value known at analysis time."""
+    value: object
+
+
+@dataclass(frozen=True)
+class Tup(AbstractValue):
+    elts: Tuple[AbstractValue, ...]
+
+
+@dataclass(frozen=True)
+class SpecVal(AbstractValue):
+    """A PartitionSpec literal: per-dim entry is an axis-name string, a
+    tuple of axis names, or None; UNKNOWN entries mark non-literal axes."""
+    axes: Tuple[object, ...]
+
+
+@dataclass(frozen=True)
+class Arr(AbstractValue):
+    """An array (or traced scalar): the workhorse of the domain."""
+    shape: Optional[Tuple[object, ...]] = None   # None = unknown rank
+    dtype: Optional[str] = None
+    spec: Optional[Tuple[object, ...]] = None
+    traced: bool = False
+    # dtype this value was explicitly narrowed FROM (astype f32->bf16);
+    # lets dtype-flow see a down-cast feeding a reduction
+    narrowed_from: Optional[str] = None
+
+    @property
+    def rank(self) -> Optional[int]:
+        return None if self.shape is None else len(self.shape)
+
+    def with_(self, **kw) -> "Arr":
+        d = dict(shape=self.shape, dtype=self.dtype, spec=self.spec,
+                 traced=self.traced, narrowed_from=self.narrowed_from)
+        d.update(kw)
+        return Arr(**d)
+
+
+# --------------------------------------------------------------- dtypes
+
+_DTYPE_ALIASES = {
+    "bf16": "bfloat16", "fp16": "float16", "half": "float16",
+    "single": "float32", "double": "float64", "fp32": "float32",
+    "fp64": "float64", "bool_": "bool",
+}
+_FLOATS = ("float16", "bfloat16", "float32", "float64")
+_INTS = ("int8", "uint8", "int16", "uint16", "int32", "uint32",
+         "int64", "uint64")
+
+
+def canon_dtype(name: Optional[str]) -> Optional[str]:
+    if name is None:
+        return None
+    return _DTYPE_ALIASES.get(name, name)
+
+
+def dtype_width(name: Optional[str]) -> Optional[int]:
+    name = canon_dtype(name)
+    if name is None:
+        return None
+    if name == "bool":
+        return 1
+    # "bfloat" before "float": "bfloat16" startswith neither plain stem
+    for stem in ("bfloat", "float", "int", "uint", "complex"):
+        if name.startswith(stem) and name[len(stem):].isdigit():
+            return int(name[len(stem):])
+    return None
+
+
+def promote_dtypes(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """JAX-style binary promotion, reduced to what the rules need: two
+    unequal 16-bit floats meet at f32; float beats int; unknown is
+    viral."""
+    a, b = canon_dtype(a), canon_dtype(b)
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    fa, fb = a in _FLOATS, b in _FLOATS
+    if fa and fb:
+        if {a, b} == {"float16", "bfloat16"}:
+            return "float32"
+        return a if _FLOATS.index(a) > _FLOATS.index(b) else b
+    if fa:
+        return a
+    if fb:
+        return b
+    if a in _INTS and b in _INTS:
+        wa, wb = dtype_width(a) or 0, dtype_width(b) or 0
+        return a if wa >= wb else b
+    return None
+
+
+# ----------------------------------------------------------------- joins
+
+def join(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    """Least upper bound used when control-flow paths merge."""
+    if a is b:
+        return a
+    if isinstance(a, Const) and isinstance(b, Const) and a.value == b.value:
+        return a
+    if isinstance(a, Arr) and isinstance(b, Arr):
+        if a.shape is not None and b.shape is not None \
+                and len(a.shape) == len(b.shape):
+            shape = tuple(
+                da if (da is db or (isinstance(da, int) and da == db))
+                else (DYN if (da is DYN or db is DYN) else Sym())
+                for da, db in zip(a.shape, b.shape))
+        else:
+            shape = None
+        return Arr(shape=shape,
+                   dtype=a.dtype if a.dtype == b.dtype else None,
+                   spec=a.spec if a.spec == b.spec else None,
+                   traced=a.traced or b.traced)
+    if isinstance(a, Tup) and isinstance(b, Tup) \
+            and len(a.elts) == len(b.elts):
+        return Tup(tuple(join(x, y) for x, y in zip(a.elts, b.elts)))
+    return UNKNOWN
+
+
+def join_envs(dst: Dict[str, AbstractValue],
+              src: Dict[str, AbstractValue]) -> Dict[str, AbstractValue]:
+    out: Dict[str, AbstractValue] = {}
+    for k in set(dst) | set(src):
+        va, vb = dst.get(k), src.get(k)
+        if va is None or vb is None:
+            out[k] = va if vb is None else vb
+        else:
+            out[k] = join(va, vb)
+    return out
+
+
+def is_traced(v: AbstractValue) -> bool:
+    if isinstance(v, Arr):
+        return v.traced
+    if isinstance(v, Tup):
+        return any(is_traced(e) for e in v.elts)
+    return False
+
+
+# ---------------------------------------------------------------- events
+
+@dataclass(frozen=True)
+class ShapeEvent:
+    """One value-level hazard the interpreter observed."""
+    node: ast.AST                 # where (in the TOP-LEVEL function's file
+    #                               when direct; the call site when the
+    #                               hazard is inside a summarized callee)
+    kind: str                     # "bool-mask" | "dynamic-call" |
+    #                               "traced-slice"
+    detail: str
+    chain: Tuple[str, ...] = ()   # callee qnames, outermost first
+
+
+@dataclass(frozen=True)
+class CallRecord:
+    """Every evaluated call, for rules that scan operands (dtype-flow)."""
+    node: ast.Call
+    fname: Optional[str]          # dotted textual target ("jnp.sum")
+    leaf: Optional[str]           # last path component ("sum")
+    args: Tuple[AbstractValue, ...]
+    kwargs: Dict[str, AbstractValue]
+    recv: Optional[AbstractValue]  # abstract receiver for method calls
+
+
+@dataclass
+class _LocalFn(AbstractValue):
+    """A function defined (or closed over) in the interpreted body."""
+    node: ast.AST
+    closure: Dict[str, AbstractValue] = field(default_factory=dict)
+
+
+# ----------------------------------------------------------- interpreter
+
+class Interpreter:
+    """Forward abstract interpretation of one function body.
+
+    ``project``/``module_name``/``cls`` enable interprocedural summaries:
+    a call that neither the signature table nor the local scope resolves
+    is looked up in the project index and its body interpreted (depth-
+    bounded, cycle-guarded) with the abstract arguments — events found
+    inside surface at the *call site* with the callee chain attached.
+    """
+
+    MAX_DEPTH = 2          # summary nesting bound
+    MAX_LOOP_PASSES = 2    # fixpoint-ish: enough for loop-carried shapes
+
+    def __init__(self, module_name: Optional[str] = None,
+                 project=None, cls: Optional[str] = None):
+        self.module_name = module_name
+        self.project = project
+        self.cls = cls
+        self.events: List[ShapeEvent] = []
+        self.calls: List[CallRecord] = []
+        # (node, left Arr, right Arr) for every ``a @ b`` — the operator
+        # spelling produces no CallRecord but dtype rules still need it
+        self.matmul_ops: List[Tuple[ast.AST, "Arr", "Arr"]] = []
+        self._depth = 0
+        self._active: Set[str] = set()    # qnames on the summary stack
+
+    # ------------------------------------------------------------ driver
+    def run(self, fn: ast.AST,
+            env: Dict[str, AbstractValue]) -> AbstractValue:
+        """Interpret ``fn``'s body under ``env``; returns the joined
+        abstract return value."""
+        returns: List[AbstractValue] = []
+        self._exec_block(fn.body, env, returns)
+        out = UNKNOWN if not returns else returns[0]
+        for r in returns[1:]:
+            out = join(out, r)
+        return out
+
+    # -------------------------------------------------------- statements
+    def _exec_block(self, body: Sequence[ast.stmt],
+                    env: Dict[str, AbstractValue],
+                    returns: List[AbstractValue]) -> None:
+        for stmt in body:
+            self._exec_stmt(stmt, env, returns)
+
+    def _exec_stmt(self, stmt: ast.stmt, env, returns) -> None:
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value, env)
+            for t in stmt.targets:
+                self._bind(t, val, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self.eval(stmt.value, env), env)
+        elif isinstance(stmt, ast.AugAssign):
+            cur = self.eval(stmt.target, env)
+            rhs = self.eval(stmt.value, env)
+            self._bind(stmt.target,
+                       self._binop(stmt.op, cur, rhs, stmt), env)
+        elif isinstance(stmt, ast.Return):
+            returns.append(UNKNOWN if stmt.value is None
+                           else self.eval(stmt.value, env))
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            env_t = dict(env)
+            env_f = dict(env)
+            self._exec_block(stmt.body, env_t, returns)
+            self._exec_block(stmt.orelse, env_f, returns)
+            merged = join_envs(env_t, env_f)
+            env.clear()
+            env.update(merged)
+        elif isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                it = self.eval(stmt.iter, env)
+                self._bind(stmt.target, self._iter_element(it), env)
+            else:
+                self.eval(stmt.test, env)
+            # two passes expose loop-carried shape drift without a full
+            # fixpoint; events dedupe on (node, kind) at report time
+            for _ in range(self.MAX_LOOP_PASSES):
+                self._exec_block(stmt.body, env, returns)
+            self._exec_block(stmt.orelse, env, returns)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                v = self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, v, env)
+            self._exec_block(stmt.body, env, returns)
+        elif isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, env, returns)
+            for h in stmt.handlers:
+                self._exec_block(h.body, env, returns)
+            self._exec_block(stmt.orelse, env, returns)
+            self._exec_block(stmt.finalbody, env, returns)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[stmt.name] = _LocalFn(stmt, dict(env))
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, (ast.Delete,)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    env.pop(t.id, None)
+        # pass/import/global/assert/raise: no value flow we track
+
+    def _bind(self, target: ast.AST, val: AbstractValue, env) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, UNKNOWN, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = (val.elts if isinstance(val, Tup)
+                    and len(val.elts) == len(target.elts) else None)
+            for i, t in enumerate(target.elts):
+                self._bind(t, elts[i] if elts else UNKNOWN, env)
+        # attribute/subscript stores: no env entry to update
+
+    def _iter_element(self, it: AbstractValue) -> AbstractValue:
+        if isinstance(it, Tup) and it.elts:
+            out = it.elts[0]
+            for e in it.elts[1:]:
+                out = join(out, e)
+            return out
+        if isinstance(it, Arr):
+            shape = None if it.shape is None else tuple(it.shape[1:])
+            if it.shape is not None and len(it.shape) == 0:
+                shape = None
+            return it.with_(shape=shape)
+        return UNKNOWN
+
+    # ------------------------------------------------------- expressions
+    def eval(self, node: ast.AST, env) -> AbstractValue:
+        if isinstance(node, ast.Constant):
+            return Const(node.value)
+        if isinstance(node, ast.Name):
+            return env.get(node.id, UNKNOWN)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return Tup(tuple(self.eval(e, env) for e in node.elts))
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node, env)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env)
+        if isinstance(node, ast.BinOp):
+            return self._binop(node.op, self.eval(node.left, env),
+                               self.eval(node.right, env), node)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, env)
+            if isinstance(v, Const) and isinstance(node.op, ast.USub) \
+                    and isinstance(v.value, (int, float)):
+                return Const(-v.value)
+            return v if isinstance(v, Arr) else UNKNOWN
+        if isinstance(node, ast.Compare):
+            return self._compare(node, env)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.eval(v, env)
+            return UNKNOWN
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test, env)
+            return join(self.eval(node.body, env),
+                        self.eval(node.orelse, env))
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Lambda):
+            return _LocalFn(node, dict(env))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            for g in node.generators:
+                self.eval(g.iter, env)
+            return UNKNOWN
+        return UNKNOWN
+
+    # dtype attributes that evaluate to a dtype-name Const ("jnp.float32")
+    _DTYPE_ROOTS = {"jnp", "np", "numpy", "jax"}
+
+    def _attribute(self, node: ast.Attribute, env) -> AbstractValue:
+        attr = node.attr
+        if canon_dtype(attr) in _FLOATS + _INTS + ("bool",) \
+                or attr in _DTYPE_ALIASES:
+            root = dotted_name(node.value)
+            if root is not None \
+                    and root.split(".")[0] in self._DTYPE_ROOTS:
+                return Const(canon_dtype(attr))
+        base = self.eval(node.value, env)
+        if isinstance(base, Arr):
+            if attr == "at":
+                # x.at[idx].set(v) is a FIXED-SHAPE scatter even with a
+                # boolean index — modelling .at as an array would make
+                # the subscript look like bool-mask gathering
+                return UNKNOWN
+            if attr == "shape":
+                if base.shape is None:
+                    return UNKNOWN
+                return Tup(tuple(
+                    Const(d) if isinstance(d, int) else _dim_val(d)
+                    for d in base.shape))
+            if attr == "ndim":
+                return UNKNOWN if base.rank is None else Const(base.rank)
+            if attr == "dtype":
+                return Const(base.dtype) if base.dtype else UNKNOWN
+            if attr == "T":
+                shape = (None if base.shape is None
+                         else tuple(reversed(base.shape)))
+                return base.with_(shape=shape, spec=None)
+            if attr in ("size", "itemsize", "nbytes"):
+                return UNKNOWN
+            # an unknown attribute of a traced pytree stays traced
+            return Arr(traced=base.traced)
+        return UNKNOWN
+
+    def _compare(self, node: ast.Compare, env) -> AbstractValue:
+        left = self.eval(node.left, env)
+        rights = [self.eval(c, env) for c in node.comparators]
+        arrs = [v for v in [left] + rights if isinstance(v, Arr)]
+        if arrs:
+            shape = None
+            for a in arrs:
+                if a.shape is not None:
+                    shape = a.shape if shape is None else \
+                        _broadcast(shape, a.shape)
+            return Arr(shape=shape, dtype="bool",
+                       traced=any(a.traced for a in arrs))
+        return UNKNOWN
+
+    def _binop(self, op, a: AbstractValue, b: AbstractValue,
+               node) -> AbstractValue:
+        if isinstance(a, Const) and isinstance(b, Const):
+            return _const_binop(op, a.value, b.value)
+        if isinstance(a, Arr) or isinstance(b, Arr):
+            aa = a if isinstance(a, Arr) else Arr(shape=())
+            bb = b if isinstance(b, Arr) else Arr(shape=())
+            if isinstance(op, ast.MatMult):
+                self.matmul_ops.append((node, aa, bb))
+                return _matmul_shape(aa, bb)
+            shape = None
+            if aa.shape is not None and bb.shape is not None:
+                shape = _broadcast(aa.shape, bb.shape)
+            if isinstance(a, Arr) and isinstance(b, Arr):
+                dtype = promote_dtypes(aa.dtype, bb.dtype)
+            else:
+                # array op Python scalar: weak typing keeps the array's
+                # dtype (x_bf16 * 2.0 stays bf16)
+                arr = aa if isinstance(a, Arr) else bb
+                dtype = arr.dtype
+            return Arr(shape=shape, dtype=dtype,
+                       traced=aa.traced or bb.traced)
+        # tuple concatenation / repetition for shape math
+        if isinstance(op, ast.Add) and isinstance(a, Tup) \
+                and isinstance(b, Tup):
+            return Tup(a.elts + b.elts)
+        if isinstance(op, ast.Mult) and isinstance(a, Tup) \
+                and isinstance(b, Const) and isinstance(b.value, int):
+            return Tup(a.elts * b.value)
+        return UNKNOWN
+
+    # -------------------------------------------------------- subscripts
+    def _subscript(self, node: ast.Subscript, env) -> AbstractValue:
+        base = self.eval(node.value, env)
+        idx = node.slice
+        if isinstance(base, Tup):
+            iv = self.eval(idx, env)
+            if isinstance(iv, Const) and isinstance(iv.value, int):
+                try:
+                    return base.elts[iv.value]
+                except IndexError:
+                    return UNKNOWN
+            return UNKNOWN
+        if isinstance(base, SpecVal):
+            return UNKNOWN
+        if not isinstance(base, Arr):
+            return UNKNOWN
+        parts = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+        out_dims: List[object] = []
+        pos = 0
+        shape = base.shape
+        for part in parts:
+            if isinstance(part, ast.Slice):
+                d = shape[pos] if shape is not None and pos < len(shape) \
+                    else Sym()
+                out_dims.append(self._slice_dim(part, d, env, node))
+                pos += 1
+            elif isinstance(part, ast.Constant) and part.value is None:
+                out_dims.append(1)          # newaxis
+            elif isinstance(part, ast.Constant) \
+                    and part.value is Ellipsis:
+                # keep the dims the remaining explicit parts don't eat;
+                # newaxis (None) parts consume NO source dim, so they
+                # must not count as explicit either
+                explicit = sum(1 for p in parts
+                               if not (isinstance(p, ast.Constant)
+                                       and (p.value is Ellipsis
+                                            or p.value is None)))
+                if shape is not None:
+                    # bounds-guarded: a multi-dim bool mask advances pos
+                    # by its rank while `explicit` counted it once, so
+                    # the keep estimate can overshoot the source shape
+                    keep = len(shape) - explicit
+                    for _ in range(max(keep, 0)):
+                        if pos >= len(shape):
+                            break
+                        out_dims.append(shape[pos])
+                        pos += 1
+                else:
+                    return Arr(dtype=base.dtype, traced=base.traced)
+            else:
+                iv = self.eval(part, env)
+                if isinstance(iv, Arr) and canon_dtype(iv.dtype) == "bool":
+                    # boolean-mask indexing: output extent = number of
+                    # True entries — data-dependent ONLY when the mask
+                    # itself is traced (a concrete trace-time-constant
+                    # mask has a static popcount and compiles fine even
+                    # on a traced base)
+                    if iv.traced:
+                        self._event(node, "bool-mask",
+                                    "boolean-mask indexing of a traced "
+                                    "array produces a data-dependent "
+                                    "shape under jit (use jnp.where(mask,"
+                                    " x, fill) or nonzero(..., size=))")
+                    out_dims.append(DYN)
+                    ndims = iv.rank if iv.rank is not None else 1
+                    pos += ndims
+                elif isinstance(iv, Arr) and iv.rank is not None \
+                        and iv.rank > 0:
+                    # integer fancy indexing: index shape replaces dim —
+                    # static, no event
+                    out_dims.extend(iv.shape)
+                    pos += 1
+                else:
+                    # scalar index: drops the dim
+                    pos += 1
+        if shape is not None:
+            out_dims.extend(shape[pos:])
+            return base.with_(shape=tuple(out_dims), spec=None)
+        return base.with_(shape=None, spec=None)
+
+    def _slice_dim(self, sl: ast.Slice, dim, env, node) -> object:
+        """Resulting extent of one slice part; a traced bound makes the
+        width data-dependent (and raises under jit)."""
+        vals = {}
+        for name in ("lower", "upper", "step"):
+            sub = getattr(sl, name)
+            if sub is None:
+                vals[name] = None
+                continue
+            v = self.eval(sub, env)
+            if is_traced(v):
+                self._event(node, "traced-slice",
+                            "slice bound derived from a traced value "
+                            "makes the result width data-dependent under "
+                            "jit (use lax.dynamic_slice with a static "
+                            "size, or mark the bound static)")
+                return DYN
+            vals[name] = v
+        lo = vals["lower"].value if isinstance(vals["lower"], Const) \
+            and isinstance(vals["lower"].value, int) else None
+        hi = vals["upper"].value if isinstance(vals["upper"], Const) \
+            and isinstance(vals["upper"].value, int) else None
+        step = vals["step"].value if isinstance(vals["step"], Const) \
+            and isinstance(vals["step"].value, int) else \
+            (1 if vals["step"] is None else None)
+        if step is not None and step < 0:
+            # x[::-1] keeps the extent; bounded negative slices degrade
+            return dim if (vals["lower"] is None
+                           and vals["upper"] is None) else Sym()
+        if isinstance(dim, int) and step is not None and step != 0:
+            lo2 = 0 if vals["lower"] is None else lo
+            hi2 = dim if vals["upper"] is None else hi
+            if lo2 is not None and hi2 is not None:
+                lo2 = max(lo2 + dim, 0) if lo2 < 0 else min(lo2, dim)
+                hi2 = max(hi2 + dim, 0) if hi2 < 0 else min(hi2, dim)
+                span = max(hi2 - lo2, 0)
+                return -(-span // step) if span else 0
+        if vals["lower"] is None and vals["upper"] is None:
+            return dim                       # x[:] keeps the extent
+        return Sym()
+
+    # ------------------------------------------------------------- calls
+    def _call(self, node: ast.Call, env) -> AbstractValue:
+        fname = dotted_name(node.func)
+        args = tuple(self.eval(a, env) for a in node.args
+                     if not isinstance(a, ast.Starred))
+        kwargs = {k.arg: self.eval(k.value, env)
+                  for k in node.keywords if k.arg is not None}
+        recv = None
+        if isinstance(node.func, ast.Attribute):
+            recv = self.eval(node.func.value, env)
+        leaf = fname.split(".")[-1] if fname else (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else None)
+        rec = CallRecord(node=node, fname=fname, leaf=leaf, args=args,
+                         kwargs=kwargs, recv=recv)
+        self.calls.append(rec)
+
+        from .signatures import lookup_signature
+        handler = lookup_signature(fname, leaf,
+                                   recv if isinstance(recv, Arr) else None)
+        if handler is None and fname is not None \
+                and self.project is not None and self.module_name:
+            # an imported name used bare/aliased: rewrite the root
+            # through the module's import table so both registry keys
+            # work — definition-site dotted names (repo functionals) and
+            # numeric-root leaves (``from jax.numpy import zeros``)
+            m = self.project.modules.get(self.module_name)
+            if m is not None:
+                parts = fname.split(".")
+                target = m.imports.get(parts[0])
+                if target is not None:
+                    handler = lookup_signature(
+                        ".".join([target] + parts[1:]), leaf, None)
+        if handler is not None:
+            try:
+                return handler(self, rec)
+            except Exception:
+                return UNKNOWN
+
+        # a locally-defined function (nested def / lambda)
+        if isinstance(node.func, ast.Name):
+            target = env.get(node.func.id)
+            if isinstance(target, _LocalFn):
+                return self._summarize_local(target, rec)
+
+        # interprocedural summary through the project index
+        return self._summarize_project(fname, rec)
+
+    def _summarize_local(self, fn: _LocalFn,
+                         rec: CallRecord) -> AbstractValue:
+        if self._depth >= self.MAX_DEPTH:
+            return UNKNOWN
+        node = fn.node
+        if isinstance(node, ast.Lambda):
+            names = [a.arg for a in node.args.args]
+            cenv = dict(fn.closure)
+            for n, v in zip(names, rec.args):
+                cenv[n] = v
+            self._depth += 1
+            try:
+                return self.eval(node.body, cenv)
+            finally:
+                self._depth -= 1
+        cenv = dict(fn.closure)
+        self._bind_params(node, rec, cenv)
+        self._depth += 1
+        try:
+            returns: List[AbstractValue] = []
+            self._exec_block(node.body, cenv, returns)
+            out = UNKNOWN if not returns else returns[0]
+            for r in returns[1:]:
+                out = join(out, r)
+            return out
+        finally:
+            self._depth -= 1
+
+    def _summarize_project(self, fname: Optional[str],
+                           rec: CallRecord) -> AbstractValue:
+        if self.project is None or self.module_name is None \
+                or self._depth >= self.MAX_DEPTH:
+            return UNKNOWN
+        fi = self.project.resolve_call(self.module_name, fname,
+                                       cls=self.cls)
+        if fi is None or fi.qname in self._active:
+            return UNKNOWN
+        sub = Interpreter(module_name=fi.module, project=self.project,
+                          cls=fi.cls)
+        sub._depth = self._depth + 1
+        sub._active = self._active | {fi.qname}
+        env: Dict[str, AbstractValue] = {}
+        sub._bind_params(fi.node, rec, env,
+                         skip_self=fi.cls is not None)
+        out = sub.run(fi.node, env)
+        # hazards inside the callee surface at THIS call site, with the
+        # chain naming where the sink lives
+        for ev in sub.events:
+            self.events.append(ShapeEvent(
+                node=rec.node, kind=ev.kind, detail=ev.detail,
+                chain=(fi.qname,) + ev.chain))
+        return out
+
+    def _bind_params(self, fn: ast.AST, rec: CallRecord, env,
+                     skip_self: bool = False) -> None:
+        names = param_names(fn)
+        if skip_self and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        for n, v in zip(names, rec.args):
+            env[n] = v
+        for n in names[len(rec.args):]:
+            if n in rec.kwargs:
+                env[n] = rec.kwargs[n]
+
+    # ------------------------------------------------------------ events
+    def _event(self, node: ast.AST, kind: str, detail: str) -> None:
+        self.events.append(ShapeEvent(node=node, kind=kind, detail=detail))
+
+
+# ----------------------------------------------------- shared shape math
+
+def _dim_val(d) -> AbstractValue:
+    """Wrap a non-int dim for .shape tuples: stays symbolic but NOT
+    traced (shapes are Python values at trace time)."""
+    return Arr(shape=(), dtype="int32", traced=False) \
+        if isinstance(d, (Sym, _Dynamic)) else Const(d)
+
+
+def _broadcast(a: Tuple, b: Tuple) -> Optional[Tuple]:
+    """NumPy broadcasting over abstract dims; incompatibility degrades to
+    symbolic rather than erroring (the oracle tier owns numeric bugs)."""
+    out: List[object] = []
+    la, lb = len(a), len(b)
+    for i in range(max(la, lb)):
+        da = a[la - 1 - i] if i < la else 1
+        db = b[lb - 1 - i] if i < lb else 1
+        if isinstance(da, int) and da == 1:
+            out.append(db)
+        elif isinstance(db, int) and db == 1:
+            out.append(da)
+        elif isinstance(da, int) and isinstance(db, int):
+            out.append(da if da == db else Sym())
+        elif da is DYN or db is DYN:
+            out.append(DYN)
+        elif da is db:
+            out.append(da)
+        else:
+            out.append(Sym())
+    return tuple(reversed(out))
+
+
+def _matmul_shape(a: Arr, b: Arr) -> Arr:
+    dtype = promote_dtypes(a.dtype, b.dtype)
+    traced = a.traced or b.traced
+    if a.shape is None or b.shape is None or len(a.shape) < 1 \
+            or len(b.shape) < 1:
+        return Arr(dtype=dtype, traced=traced)
+    la, lb = len(a.shape), len(b.shape)
+    if la == 1 and lb == 1:
+        return Arr(shape=(), dtype=dtype, traced=traced)
+    if la == 1:
+        # (k) @ (..., k, n) -> (..., n): the prepended dim is dropped
+        return Arr(shape=tuple(b.shape[:-2]) + (b.shape[-1],),
+                   dtype=dtype, traced=traced)
+    if lb == 1:
+        # (..., m, k) @ (k) -> (..., m): the appended dim is dropped
+        return Arr(shape=tuple(a.shape[:-1]), dtype=dtype, traced=traced)
+    if la == 2 and lb == 2:
+        return Arr(shape=(a.shape[0], b.shape[1]), dtype=dtype,
+                   traced=traced)
+    # batched: leading dims broadcast, trailing two contract
+    batch = _broadcast(a.shape[:-2], b.shape[:-2]) or ()
+    return Arr(shape=tuple(batch) + (a.shape[-2], b.shape[-1]),
+               dtype=dtype, traced=traced)
+
+
+def _const_binop(op, a, b) -> AbstractValue:
+    try:
+        if isinstance(op, ast.Add):
+            return Const(a + b)
+        if isinstance(op, ast.Sub):
+            return Const(a - b)
+        if isinstance(op, ast.Mult):
+            return Const(a * b)
+        if isinstance(op, ast.FloorDiv):
+            return Const(a // b)
+        if isinstance(op, ast.Div):
+            return Const(a / b)
+        if isinstance(op, ast.Mod):
+            return Const(a % b)
+        if isinstance(op, ast.Pow):
+            return Const(a ** b)
+    except Exception:
+        pass
+    return UNKNOWN
+
+
+# -------------------------------------------------------------- frontend
+
+def interpret_function(fn: ast.AST, traced: Sequence[str] = (),
+                       module_name: Optional[str] = None, project=None,
+                       cls: Optional[str] = None,
+                       env: Optional[Dict[str, AbstractValue]] = None,
+                       params_as_arrays: bool = False,
+                       memo: Optional[Dict] = None) -> Interpreter:
+    """Interpret one function: parameters named in ``traced`` start as
+    rank-unknown traced arrays, the rest as UNKNOWN (or, with
+    ``params_as_arrays``, as unknown NON-traced arrays — dtype/rank
+    rules want method chains like ``x.astype(...)`` to evaluate even on
+    untraced params); extra pre-bound values (closures, self-attrs) come
+    in via ``env``.  Returns the Interpreter carrying ``events`` and
+    ``calls``.  ``memo`` (a per-file dict, e.g. ``FileContext.memo``)
+    lets several checkers share one interpretation of the same function
+    under the same initial conditions."""
+    key = None
+    if memo is not None and env is None:
+        key = (id(fn), tuple(sorted(traced)), params_as_arrays)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+    interp = Interpreter(module_name=module_name, project=project, cls=cls)
+    init: Dict[str, AbstractValue] = dict(env or {})
+    for name in param_names(fn):
+        if name in init:
+            continue
+        if name in traced:
+            init[name] = Arr(traced=True)
+        else:
+            init[name] = Arr() if params_as_arrays else UNKNOWN
+    interp.run(fn, init)
+    if key is not None:
+        memo[key] = interp
+    return interp

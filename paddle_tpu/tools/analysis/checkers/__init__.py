@@ -11,12 +11,16 @@ from .registry_drift import RegistryDriftChecker
 from .dead_state import DeadStateChecker
 from .donation import UseAfterDonateChecker
 from .lifecycle import ResourceLifecycleChecker, ResourcePair, DEFAULT_PAIRS
+from .shape_recompile import ShapeRecompileChecker
+from .dtype_flow import DtypeFlowChecker
+from .sharding_consistency import ShardingConsistencyChecker
 
 __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "HostSyncChecker", "AxisNameChecker", "RegistryDriftChecker",
            "DeadStateChecker", "UseAfterDonateChecker",
            "ResourceLifecycleChecker", "ResourcePair", "DEFAULT_PAIRS",
-           "default_checkers"]
+           "ShapeRecompileChecker", "DtypeFlowChecker",
+           "ShardingConsistencyChecker", "default_checkers"]
 
 
 def default_checkers():
@@ -29,4 +33,7 @@ def default_checkers():
         DeadStateChecker(),
         UseAfterDonateChecker(),
         ResourceLifecycleChecker(),
+        ShapeRecompileChecker(),
+        DtypeFlowChecker(),
+        ShardingConsistencyChecker(),
     ]

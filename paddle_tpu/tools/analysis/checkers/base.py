@@ -144,10 +144,13 @@ def _iter_int_literals(node: ast.AST) -> Iterable[int]:
             yield n.value
 
 
-def jitted_local_defs(tree: ast.AST) -> Set[str]:
-    """Names of functions later wrapped as ``g = jax.jit(f)`` (or partial
-    form) anywhere in the module — marks ``f`` as jit-traced."""
-    wrapped: Set[str] = set()
+def jitted_local_def_calls(tree: ast.AST) -> dict:
+    """{function name: the wrapping jit/partial Call} for every function
+    later wrapped as ``g = jax.jit(f, ...)`` (or partial form) anywhere
+    in the module.  The Call is kept so static_argnums/static_argnames
+    on the WRAP SITE apply exactly like decorator-form specs — dropping
+    them marks static params as traced and yields false positives."""
+    wrapped: dict = {}
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -155,11 +158,36 @@ def jitted_local_defs(tree: ast.AST) -> Set[str]:
         if not is_jit and _partial_of_jit(node) is not None:
             # partial(jax.jit, f) — f is args[1] if present
             if len(node.args) > 1 and isinstance(node.args[1], ast.Name):
-                wrapped.add(node.args[1].id)
+                wrapped.setdefault(node.args[1].id, node)
             continue
         if is_jit and node.args and isinstance(node.args[0], ast.Name):
-            wrapped.add(node.args[0].id)
+            wrapped.setdefault(node.args[0].id, node)
     return wrapped
+
+
+def jitted_local_defs(tree: ast.AST) -> Set[str]:
+    """Names of functions later wrapped as ``g = jax.jit(f)`` (or partial
+    form) anywhere in the module — marks ``f`` as jit-traced."""
+    return set(jitted_local_def_calls(tree))
+
+
+# loop primitives whose body argument is compiled (and therefore hot /
+# traced) — shared by host-sync and recompile-shape
+LOOP_HOSTS = {"jax.lax.scan", "lax.scan", "jax.lax.while_loop",
+              "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
+              "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
+              "jax.lax.map", "lax.map"}
+
+
+def loop_body_names(tree: ast.AST) -> Set[str]:
+    """Local function names passed (positionally) to lax loop primitives."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in LOOP_HOSTS:
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
 
 
 # ------------------------------------------------------------------ taint

@@ -15,9 +15,13 @@ cross-module contract.  The checker:
     declares — same-module-only matching used to force ``disable-file``
     suppressions for perfectly sound layering;
   * checks USED axis names: literal axis args of ``jax.lax`` collectives
-    (second positional or ``axis_name=``).  Non-literal axis args (the
-    common ``g.name`` / ``axis_name`` parameter pattern) are out of scope
-    by design — the caller owns those.
+    (second positional or ``axis_name=``), plus UPPERCASE module-level
+    string constants (``AXIS = "tp"`` then ``psum(x, AXIS)``) resolved
+    through the project index — locally and through imports.  Other
+    non-literal axis args (the common ``g.name`` / ``axis_name``
+    parameter pattern) are out of scope by design — the caller owns
+    those.  The uppercase convention is the shadowing guard: a lowercase
+    name could be a function parameter rebinding the module constant.
 
 A module whose collectives are all parameterized never reports.
 """
@@ -39,38 +43,96 @@ _DECL_CALLS = {"Mesh", "make_mesh", "create_device_mesh", "shard_map",
 _DECL_KWARGS = {"axis_name", "axis_names"}
 
 
+def _const_resolver(project, mod_name: Optional[str]):
+    """A ``resolve(dotted) -> Optional[str]`` closure over the project's
+    string-constant table for one module, or None without a project —
+    declaration- and use-side axis resolution share it."""
+    if project is None or mod_name is None:
+        return None
+    return lambda dotted: project.resolve_str_const(mod_name, dotted)
+
+
+def collect_axis_strings(root: ast.AST, out: Set[str],
+                         consts: Optional[Dict[str, str]] = None,
+                         resolve=None) -> None:
+    """Collect declared axis names under ``root`` into ``out``: string
+    literals, UPPERCASE module-level constants (bare names via
+    ``consts``, dotted ones via ``resolve``).  The ONE string-walking
+    policy shared by axis-name and sharding-consistency — the uppercase
+    guard applies to constants on both rules identically."""
+    for sub in ast.walk(root):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.add(sub.value)
+        elif isinstance(sub, ast.Name) and sub.id.isupper():
+            if consts is not None and sub.id in consts:
+                out.add(consts[sub.id])
+            elif resolve is not None:
+                # a bare FROM-IMPORTED constant (``from axes import TP``
+                # then ``Mesh(devs, (TP,))``) resolves through the
+                # project import chain, same as the use side
+                hit = resolve(sub.id)
+                if hit is not None:
+                    out.add(hit)
+        elif resolve is not None and isinstance(sub, ast.Attribute):
+            dotted = dotted_name(sub)
+            if dotted and dotted.split(".")[-1].isupper():
+                hit = resolve(dotted)
+                if hit is not None:
+                    out.add(hit)
+
+
+def imported_axis_declarations(ctx, cache_holder, attr: str,
+                               declared_of) -> Set[str]:
+    """Axis names declared by the modules ``ctx``'s file DIRECTLY
+    imports, resolved through the project index (empty without one).
+    Shared by axis-name and sharding-consistency — each passes its own
+    ``declared_of(module_info) -> set`` so the rules keep their distinct
+    notions of what declares an axis, while the import walk and the
+    per-(project, module) memo live in one place.  ``cache_holder``
+    stores the memo on ``attr`` as a (project, {module: axes}) pair —
+    identity-compared so a recycled project id can never serve stale
+    axes."""
+    if ctx.project is None:
+        return set()
+    mi = ctx.project.module_for(ctx.relpath)
+    if mi is None:
+        return set()
+    cache = getattr(cache_holder, attr, None)
+    if cache is None or cache[0] is not ctx.project:
+        cache = (ctx.project, {})
+        setattr(cache_holder, attr, cache)
+    per_mod: Dict[str, Set[str]] = cache[1]
+    out: Set[str] = set()
+    for dep in ctx.project.imported_modules(mi.name):
+        hit = per_mod.get(dep)
+        if hit is None:
+            dm = ctx.project.modules.get(dep)
+            hit = declared_of(dm) if dm is not None else set()
+            per_mod[dep] = hit
+        out |= hit
+    return out
+
+
 class AxisNameChecker(Checker):
     name = "axis-name"
     severity = ERROR
 
     def __init__(self):
-        # (project, {module: axes}) — identity-compared, holding the
-        # project reference so a recycled id can never serve stale axes
-        self._decl_cache = None
+        self._decl_cache = None    # see imported_axis_declarations
 
     def _imported_declarations(self, ctx) -> Set[str]:
-        """Axis names declared by the modules this file DIRECTLY imports,
-        resolved through the project index (empty without a project)."""
-        if ctx.project is None:
-            return set()
-        mi = ctx.project.module_for(ctx.relpath)
-        if mi is None:
-            return set()
-        if self._decl_cache is None or self._decl_cache[0] is not ctx.project:
-            self._decl_cache = (ctx.project, {})
-        per_mod: Dict[str, Set[str]] = self._decl_cache[1]
-        out: Set[str] = set()
-        for dep in ctx.project.imported_modules(mi.name):
-            hit = per_mod.get(dep)
-            if hit is None:
-                dm = ctx.project.modules.get(dep)
-                hit = self._declared(dm.tree) if dm is not None else set()
-                per_mod[dep] = hit
-            out |= hit
-        return out
+        return imported_axis_declarations(
+            ctx, self, "_decl_cache",
+            lambda dm: self._declared(dm.tree,
+                                      getattr(dm, "consts", None),
+                                      _const_resolver(ctx.project,
+                                                      dm.name)))
 
     def check(self, ctx) -> List[Finding]:
-        declared = self._declared(ctx.tree) \
+        mi = ctx.project.module_for(ctx.relpath) if ctx.project else None
+        declared = self._declared(
+            ctx.tree, getattr(mi, "consts", None),
+            _const_resolver(ctx.project, mi.name if mi else None)) \
             | self._imported_declarations(ctx)
         findings: List[Finding] = []
         for node in ast.walk(ctx.tree):
@@ -87,7 +149,8 @@ class AxisNameChecker(Checker):
             axis_arg = self._axis_arg(node)
             if axis_arg is None:
                 continue
-            for lit in _str_literals(axis_arg):
+            used = self._used_axes(ctx, mi, axis_arg)
+            for lit in used:
                 if lit not in declared:
                     findings.append(Finding(
                         self.name, ctx.relpath, axis_arg.lineno,
@@ -99,6 +162,31 @@ class AxisNameChecker(Checker):
                         self.severity))
         return findings
 
+    def _used_axes(self, ctx, mi, axis_arg) -> List[str]:
+        """Axis names this arg references, element-wise over tuples: a
+        string literal counts directly; a non-literal element resolves
+        through UPPERCASE module-level string constants (``psum(x,
+        AXIS)`` / ``psum(x, topo.TP_AXIS)``) — the uppercase convention
+        guards against resolving names a function parameter shadows.  A
+        mixed tuple ``("dp", AXIS)`` checks both halves."""
+        nodes = axis_arg.elts if isinstance(axis_arg, (ast.Tuple, ast.List)) \
+            else [axis_arg]
+        out: List[str] = []
+        for n in nodes:
+            lits = list(_str_literals(n))
+            if lits:
+                out.extend(lits)
+                continue
+            if ctx.project is None or mi is None:
+                continue
+            dotted = dotted_name(n)
+            if dotted is None or not dotted.split(".")[-1].isupper():
+                continue
+            hit = ctx.project.resolve_str_const(mi.name, dotted)
+            if hit is not None:
+                out.append(hit)
+        return out
+
     def _axis_arg(self, call: ast.Call) -> Optional[ast.AST]:
         for kw in call.keywords:
             if kw.arg == "axis_name":
@@ -107,23 +195,23 @@ class AxisNameChecker(Checker):
             return call.args[1]
         return None
 
-    def _declared(self, tree: ast.Module) -> Set[str]:
+    def _declared(self, tree: ast.Module,
+                  consts: Optional[Dict[str, str]] = None,
+                  resolve=None) -> Set[str]:
         out: Set[str] = set()
+
+        def strings(root):
+            collect_axis_strings(root, out, consts, resolve)
+
         for node in ast.walk(tree):
             if isinstance(node, ast.Call):
                 fname = dotted_name(node.func)
                 leaf = fname.split(".")[-1] if fname else None
                 if leaf in _DECL_CALLS:
-                    for sub in ast.walk(node):
-                        if isinstance(sub, ast.Constant) \
-                                and isinstance(sub.value, str):
-                            out.add(sub.value)
+                    strings(node)
                 for kw in node.keywords:
                     if kw.arg in _DECL_KWARGS:
-                        for sub in ast.walk(kw.value):
-                            if isinstance(sub, ast.Constant) \
-                                    and isinstance(sub.value, str):
-                                out.add(sub.value)
+                        strings(kw.value)
             # axis_name="dp" style function-signature defaults document
             # the module's expected axes
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

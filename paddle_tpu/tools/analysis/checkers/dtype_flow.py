@@ -1,0 +1,162 @@
+"""dtype-flow: 16-bit accumulation / implicit down-casts on hot reduction paths.
+
+bf16 is the right *storage and matmul input* dtype on TPU, but letting a
+REDUCTION accumulate in 16 bits (loss sums, norm squares, Adam moments)
+silently loses ~8 bits of mantissa exactly where the framework promises
+f32 masters.  This rule runs every function in the configured hot paths
+(default: ``kernels/`` and ``optimizer/``) through the graftshape
+abstract interpreter and warns when a value whose dtype is PROVABLY
+16-bit float reaches an accumulation without a widening override:
+
+  * ``jnp.sum``/``mean``/``prod``/``cumsum``/``var``/``std``/… (function
+    or method form) on a bf16/f16 operand with no ``dtype=`` — XLA
+    accumulates in the operand dtype;
+  * ``jnp.dot``/``matmul``/``einsum``/``dot_general``/``tensordot`` with
+    a 16-bit operand and no ``preferred_element_type=`` — the MXU can
+    accumulate in f32 but only if asked;
+  * a reduction whose ``dtype=`` is NARROWER than the operand
+    (``jnp.sum(x32, dtype=bf16)``), or whose operand was just explicitly
+    down-cast from f32/f64 (``jnp.sum(x32.astype(bf16))``) — the
+    down-cast defeats the master-weight discipline.
+
+Values of unknown dtype never fire — the rule is quiet unless the code
+itself pins the 16-bit type, which keeps it precise on generic kernels
+(``q.astype(q.dtype)`` chains stay unknown).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional, Sequence
+
+from ..findings import Finding, WARNING
+from .base import Checker
+
+DEFAULT_HOT_PATHS = (
+    "paddle_tpu/kernels/*.py",
+    "paddle_tpu/optimizer/*.py",
+    # the rule's own fixtures: outside the CI-gate scope, but lets the
+    # CLI (and its SARIF smoke test) exercise the rule end-to-end —
+    # anchored globs (see shape_recompile.py) so no repo file can match
+    "tests/fixtures/lint/dtype_flow_*.py",
+    "dtype_flow_*.py",
+)
+
+_ACCUM_REDUCTIONS = {"sum", "mean", "prod", "cumsum", "cumprod", "var",
+                     "std", "logsumexp", "nansum", "nanmean", "average"}
+_CONTRACTIONS = {"matmul", "dot", "einsum", "dot_general", "tensordot",
+                 "conv_general_dilated"}
+_HALF = ("bfloat16", "float16")
+
+
+def _is_numeric_call(rec) -> bool:
+    from ..absint import Arr
+    from ..signatures import _NUMERIC_ROOTS
+    if rec.fname is not None \
+            and rec.fname.split(".")[0] in _NUMERIC_ROOTS:
+        return True
+    return isinstance(rec.recv, Arr)
+
+
+class DtypeFlowChecker(Checker):
+    name = "dtype-flow"
+    severity = WARNING
+
+    def __init__(self, hot_paths: Optional[Sequence[str]] = None):
+        self.hot_paths = tuple(hot_paths or DEFAULT_HOT_PATHS)
+
+    def check(self, ctx) -> List[Finding]:
+        if not any(fnmatch.fnmatch(ctx.relpath, p) for p in self.hot_paths):
+            return []
+        from ..absint import Arr, interpret_function, canon_dtype
+        mi = ctx.project.module_for(ctx.relpath) if ctx.project else None
+
+        findings: List[Finding] = []
+        seen = set()
+
+        def emit(node, msg):
+            key = (node.lineno, node.col_offset, msg)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(self.name, ctx.relpath,
+                                        node.lineno, node.col_offset,
+                                        msg, self.severity))
+
+        from .base import walk_with_class
+        for node, cls in walk_with_class(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            interp = interpret_function(
+                node, traced=(), params_as_arrays=True,
+                module_name=mi.name if mi else None, cls=cls,
+                project=ctx.project, memo=getattr(ctx, "memo", None))
+            for rec in interp.calls:
+                if not _is_numeric_call(rec):
+                    continue
+                if rec.leaf in _ACCUM_REDUCTIONS:
+                    self._check_reduction(rec, emit, canon_dtype, Arr)
+                elif rec.leaf in _CONTRACTIONS:
+                    self._check_contraction(rec, emit, canon_dtype, Arr)
+            for op_node, a, b in interp.matmul_ops:
+                # the @ spelling of a contraction — same 16-bit
+                # accumulation hazard, no preferred_element_type spelling
+                # available at all
+                if a.dtype in _HALF and b.dtype in _HALF:
+                    emit(op_node,
+                         f"@ on {a.dtype} operands accumulates (and "
+                         f"emits) in 16-bit float; use "
+                         f"jnp.matmul(..., preferred_element_type="
+                         f"jnp.float32) on hot reduction paths")
+        return findings
+
+    # ----------------------------------------------------------- helpers
+    def _check_reduction(self, rec, emit, canon_dtype, Arr):
+        from ..signatures import _operand
+        x = _operand(rec)
+        if not isinstance(x, Arr):
+            return
+        out_dtype = None
+        dv = rec.kwargs.get("dtype")
+        if dv is None:
+            # positional dtype: jnp.sum(x, axis, dtype) / x.sum(axis,
+            # dtype) — jax accepts both and accumulates accordingly
+            idx = 1 if isinstance(rec.recv, Arr) else 2
+            if len(rec.args) > idx:
+                dv = rec.args[idx]
+        from ..absint import Const
+        if isinstance(dv, Const) and isinstance(dv.value, str):
+            out_dtype = canon_dtype(dv.value)
+        op = rec.leaf
+        if x.narrowed_from is not None and out_dtype is None:
+            emit(rec.node,
+                 f"{op}() consumes a value just down-cast from "
+                 f"{x.narrowed_from} to {x.dtype} — the cast defeats the "
+                 f"f32 accumulation; reduce first, then narrow")
+        elif x.dtype in _HALF and out_dtype is None:
+            emit(rec.node,
+                 f"{op}() accumulates in {x.dtype} — 16-bit reduction on "
+                 f"a hot path loses mantissa where f32 masters/loss are "
+                 f"expected; pass dtype=jnp.float32 (cast back after)")
+        elif out_dtype in _HALF and x.dtype not in (None,) + _HALF:
+            emit(rec.node,
+                 f"{op}(dtype={out_dtype}) narrows a {x.dtype} operand — "
+                 f"the accumulation itself runs in {out_dtype}; "
+                 f"accumulate in f32 and cast the result instead")
+
+    def _check_contraction(self, rec, emit, canon_dtype, Arr):
+        if "preferred_element_type" in rec.kwargs:
+            return
+        arrs = [a for a in rec.args if isinstance(a, Arr)]
+        if isinstance(rec.recv, Arr):
+            arrs.insert(0, rec.recv)
+        dtypes = [a.dtype for a in arrs if a.dtype is not None]
+        if not arrs or len(dtypes) != len(arrs):
+            return   # any unknown operand: promotion may already widen
+        if not all(d in _HALF for d in dtypes):
+            return   # mixed with f32: promotion already widens
+        emit(rec.node,
+             f"{rec.leaf}() on {dtypes[0]} operands without "
+             f"preferred_element_type= accumulates (and emits) in 16-bit "
+             f"float; pass preferred_element_type=jnp.float32 on hot "
+             f"reduction paths")

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..findings import Finding, ERROR
 from .base import (Checker, dotted_name, jit_decorator_info,
-                   jitted_local_defs, walk_with_class)
+                   jitted_local_defs, loop_body_names, walk_with_class)
 
 DEFAULT_HOT_PATHS = (
     "paddle_tpu/kernels/*.py",
@@ -54,10 +54,6 @@ DEFAULT_HOT_PATHS = (
 _ALL_FUNCTIONS_PATHS = ("paddle_tpu/kernels/*.py",)
 DEFAULT_MAX_DEPTH = 4
 
-_LOOP_HOSTS = {"jax.lax.scan", "lax.scan", "jax.lax.while_loop",
-               "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
-               "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
-               "jax.lax.map", "lax.map"}
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _DEVICE_GET = {"jax.device_get", "device_get"}
 _NP_COPY = {"asarray", "array", "ascontiguousarray"}
@@ -71,17 +67,6 @@ def _numpy_aliases(tree: ast.Module) -> Set[str]:
             for a in node.names:
                 if a.name == "numpy":
                     out.add(a.asname or "numpy")
-    return out
-
-
-def _loop_body_names(tree: ast.Module) -> Set[str]:
-    """Local function names passed (positionally) to lax loop primitives."""
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and dotted_name(node.func) in _LOOP_HOSTS:
-            for a in node.args:
-                if isinstance(a, ast.Name):
-                    out.add(a.id)
     return out
 
 
@@ -235,7 +220,7 @@ class HostSyncChecker(Checker):
                              for pat in self.all_fn_paths)
         np_aliases = _numpy_aliases(ctx.tree)
         wrapped = jitted_local_defs(ctx.tree)
-        loop_bodies = _loop_body_names(ctx.tree)
+        loop_bodies = loop_body_names(ctx.tree)
         taint = self._project_taint(ctx)
 
         findings: List[Finding] = []

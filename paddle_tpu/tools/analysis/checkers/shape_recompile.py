@@ -1,0 +1,95 @@
+"""recompile-shape: data-dependent shapes under jit in fixed-shape hot paths.
+
+The serving engine's whole performance story rests on a fixed-shape
+discipline (one compiled decode program, O(log) prefill buckets) that
+until now only the compile-count tests probed at runtime.  This rule
+verifies it statically: every jit-traced function in the configured hot
+paths (default: ``serving/`` and ``kernels/``) is run through the
+graftshape abstract interpreter (:mod:`..absint`) with its non-static
+parameters marked traced, and any operation whose RESULT SHAPE depends
+on traced *data* is an error:
+
+  * boolean-mask indexing ``x[mask]`` — output extent = popcount(mask);
+  * ``jnp.nonzero`` / 1-arg ``jnp.where`` / ``argwhere`` / ``unique`` /
+    ``compress`` / ``flatnonzero`` without the fixed-shape ``size=``
+    escape hatch;
+  * slice bounds derived from traced values (``x[:n]`` with ``n``
+    traced) — the width is data-dependent (and raises at trace time).
+
+Interprocedural: hazards inside project functions a hot body calls are
+reported at the hot call site with the callee chain (the summary depth
+is bounded; see ``absint.Interpreter.MAX_DEPTH``).  Static args, shapes
+(``x.shape[0]``), and host-side helpers never fire — shapes are Python
+values at trace time and non-jitted code is free to be dynamic.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional, Sequence, Set
+
+from ..findings import Finding, ERROR
+from .base import (Checker, jit_decorator_info, jitted_local_def_calls,
+                   loop_body_names, param_names, static_params,
+                   walk_with_class)
+
+DEFAULT_HOT_PATHS = (
+    "paddle_tpu/serving/*.py",
+    "paddle_tpu/kernels/*.py",
+    # the rule's own fixtures: outside the CI-gate scope, but lets the
+    # CLI (and its SARIF smoke test) exercise the rule end-to-end.  The
+    # globs are anchored (fixture dir for CLI runs, bare basename for
+    # the fixture-rooted library tests) so a repo file that merely
+    # CONTAINS the substring can never become hot by accident
+    "tests/fixtures/lint/shape_recompile_*.py",
+    "shape_recompile_*.py",
+)
+
+
+class ShapeRecompileChecker(Checker):
+    name = "recompile-shape"
+    severity = ERROR
+
+    def __init__(self, hot_paths: Optional[Sequence[str]] = None):
+        self.hot_paths = tuple(hot_paths or DEFAULT_HOT_PATHS)
+
+    def check(self, ctx) -> List[Finding]:
+        if not any(fnmatch.fnmatch(ctx.relpath, p) for p in self.hot_paths):
+            return []
+        from ..absint import interpret_function
+        wrapped = jitted_local_def_calls(ctx.tree)
+        loop_bodies = loop_body_names(ctx.tree)
+        mi = ctx.project.module_for(ctx.relpath) if ctx.project else None
+
+        findings: List[Finding] = []
+        seen: Set = set()
+        for node, cls in walk_with_class(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jit_info = jit_decorator_info(node) or wrapped.get(node.name)
+            if jit_info is None and node.name not in loop_bodies:
+                continue
+            traced = set(param_names(node)) - static_params(node, jit_info)
+            traced.discard("self")
+            interp = interpret_function(
+                node, traced=traced,
+                module_name=mi.name if mi else None, cls=cls,
+                project=ctx.project, memo=getattr(ctx, "memo", None))
+            for ev in interp.events:
+                key = (ev.node.lineno, ev.node.col_offset, ev.kind)
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = ""
+                if ev.chain:
+                    via = " (inside " + " -> ".join(
+                        q.rsplit(".", 1)[-1] + "()"
+                        for q in ev.chain) + ")"
+                findings.append(Finding(
+                    self.name, ctx.relpath, ev.node.lineno,
+                    ev.node.col_offset,
+                    f"{ev.detail}{via} — jit recompiles (or fails to "
+                    f"trace) per distinct runtime value",
+                    self.severity))
+        return findings

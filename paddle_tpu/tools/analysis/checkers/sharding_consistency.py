@@ -1,0 +1,281 @@
+"""sharding-consistency: PartitionSpec/mesh/collective agreement, statically.
+
+Sharding bugs are the worst class of distributed failure: a spec naming
+a mesh axis that doesn't exist, or a collective over an axis the
+enclosing ``shard_map`` never bound, compiles fine on one host and dies
+(or silently computes garbage) on the real mesh.  Three sub-rules, all
+literal-driven — parameterized specs/axes are the caller's contract and
+stay out of scope:
+
+  * **unknown-axis** — a literal axis name inside ``P(...)`` /
+    ``PartitionSpec(...)`` that no mesh construction visible from this
+    module (same file or a directly-imported module, through the project
+    index) declares.  Modules with NO visible mesh declaration are
+    skipped entirely: their specs are checked where the mesh lives.
+  * **rank-mismatch** — ``with_sharding_constraint(x, P(...))`` /
+    ``device_put(x, NamedSharding(mesh, P(...)))`` where the graftshape
+    interpreter knows ``x``'s rank and the literal spec has MORE entries
+    than the array has dims (jax raises only when the constraint is
+    actually applied on a mesh).
+  * **unbound-collective** — a collective over a literal axis name
+    inside a function mapped by a ``shard_map`` whose ``axis_names=`` /
+    manual-axes set is literal and does NOT contain that axis: the axis
+    may exist on the mesh, but this shard_map never bound it, so the
+    collective either fails to trace or addresses the wrong group.
+    This upgrades axis-name from name-existence to binding-correctness.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..findings import Finding, ERROR
+from .base import Checker, dotted_name
+from .collectives import (_COLLECTIVES, _const_resolver,
+                          collect_axis_strings,
+                          imported_axis_declarations)
+
+_SPEC_CALLS = {"P", "PartitionSpec"}
+_MESH_CALLS = {"Mesh", "make_mesh", "create_device_mesh", "AbstractMesh"}
+_CONSTRAIN_CALLS = {"with_sharding_constraint", "device_put"}
+
+
+def _literal_str_set(node: ast.AST) -> Optional[Set[str]]:
+    """The literal axis-name set of an ``axis_names=`` value, or None if
+    any component is non-literal (``frozenset(manual_axes)`` — skip)."""
+    if isinstance(node, ast.Constant):
+        return {node.value} if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Call) \
+            and dotted_name(node.func) in ("frozenset", "set", "tuple") \
+            and len(node.args) == 1 and not node.keywords:
+        return _literal_str_set(node.args[0])
+    return None
+
+
+def _spec_literal_axes(call: ast.Call) -> List[ast.Constant]:
+    """String-literal axis entries of a P(...) call (tuple entries for
+    multi-axis dims included; non-literal entries are simply absent)."""
+    out: List[ast.Constant] = []
+    for a in list(call.args) + [k.value for k in call.keywords]:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            out.append(a)
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            for e in a.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append(e)
+    return out
+
+
+def _mesh_axes(tree: ast.Module, consts: Optional[Dict[str, str]] = None,
+               resolve=None) -> Set[str]:
+    """Axis names DECLARED by actual mesh CONSTRUCTION in this tree:
+    strings inside Mesh/make_mesh/create_device_mesh/AbstractMesh calls
+    only.  Deliberately narrower than axis-name's declaration set —
+    ``axis_name=`` kwargs and ``axis*`` parameter defaults document an
+    expected axis but do NOT make a module the mesh's home, and counting
+    them would defeat the 'no visible mesh → specs are the caller's
+    contract → skip' gate (a mesh-free module with one axis default
+    would suddenly have all its P literals checked against it).
+    Module-level string constants resolve through ``consts`` (bare
+    names) and ``resolve`` (dotted, via the project index)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            leaf = fname.split(".")[-1] if fname else None
+            if leaf in _MESH_CALLS:
+                collect_axis_strings(node, out, consts, resolve)
+    return out
+
+
+class ShardingConsistencyChecker(Checker):
+    name = "sharding-consistency"
+    severity = ERROR
+
+    def __init__(self, paths: Optional[Sequence[str]] = None):
+        # default scope: everywhere — the rule is literal-driven and
+        # quiet by construction; ``paths`` exists for fixture isolation
+        self.paths = tuple(paths) if paths else None
+        self._axes_cache = None    # see imported_axis_declarations
+
+    def check(self, ctx) -> List[Finding]:
+        if self.paths is not None and not any(
+                fnmatch.fnmatch(ctx.relpath, p) for p in self.paths):
+            return []
+        findings: List[Finding] = []
+        self._check_unknown_axes(ctx, findings)
+        self._check_rank(ctx, findings)
+        self._check_unbound_collectives(ctx, findings)
+        return findings
+
+    # -------------------------------------------------- (a) unknown axis
+    def _module_consts(self, ctx) -> Dict[str, str]:
+        if ctx.project is None:
+            return {}
+        mi = ctx.project.module_for(ctx.relpath)
+        return dict(getattr(mi, "consts", {}) or {}) if mi else {}
+
+    def _visible_axes(self, ctx) -> Set[str]:
+        mi = ctx.project.module_for(ctx.relpath) if ctx.project else None
+        declared = _mesh_axes(
+            ctx.tree, self._module_consts(ctx),
+            _const_resolver(ctx.project, mi.name if mi else None))
+        return declared | imported_axis_declarations(
+            ctx, self, "_axes_cache",
+            lambda dm: _mesh_axes(dm.tree,
+                                  dict(getattr(dm, "consts", {}) or {}),
+                                  _const_resolver(ctx.project, dm.name)))
+
+    def _check_unknown_axes(self, ctx, findings: List[Finding]) -> None:
+        declared = self._visible_axes(ctx)
+        if not declared:
+            return     # no mesh in sight: specs are the caller's contract
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            leaf = fname.split(".")[-1] if fname else None
+            if leaf not in _SPEC_CALLS:
+                continue
+            for lit in _spec_literal_axes(node):
+                if lit.value not in declared:
+                    findings.append(Finding(
+                        self.name, ctx.relpath, lit.lineno,
+                        lit.col_offset,
+                        f"PartitionSpec names mesh axis {lit.value!r} "
+                        f"but the meshes visible from this module "
+                        f"declare {sorted(declared)} — typo, or a mesh "
+                        f"contract that should be threaded as a "
+                        f"parameter", self.severity))
+
+    # ------------------------------------------------- (b) rank mismatch
+    def _check_rank(self, ctx, findings: List[Finding]) -> None:
+        if not any(name in ctx.src for name in _CONSTRAIN_CALLS):
+            return
+        from ..absint import Arr, SpecVal, UNKNOWN, interpret_function
+        from .base import walk_with_class
+        mi = ctx.project.module_for(ctx.relpath) if ctx.project else None
+        seen = set()
+        for node, cls in walk_with_class(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            interp = interpret_function(
+                node, traced=(), params_as_arrays=True,
+                module_name=mi.name if mi else None, cls=cls,
+                project=ctx.project, memo=getattr(ctx, "memo", None))
+            for rec in interp.calls:
+                if rec.leaf not in _CONSTRAIN_CALLS or not rec.args:
+                    continue
+                x = rec.args[0]
+                spec = rec.args[1] if len(rec.args) > 1 else (
+                    rec.kwargs.get("shardings") or rec.kwargs.get("device"))
+                if not (isinstance(x, Arr) and x.rank is not None
+                        and isinstance(spec, SpecVal)):
+                    continue
+                if len(spec.axes) > x.rank:
+                    key = (rec.node.lineno, rec.node.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        self.name, ctx.relpath, rec.node.lineno,
+                        rec.node.col_offset,
+                        f"PartitionSpec has {len(spec.axes)} entries but "
+                        f"the array it constrains has rank {x.rank} — "
+                        f"jax raises when this constraint is applied on "
+                        f"a real mesh", self.severity))
+
+    # ------------------------------------- (c) collective vs shard_map
+    def _check_unbound_collectives(self, ctx,
+                                   findings: List[Finding]) -> None:
+        if "shard_map" not in ctx.src:
+            return
+        local_defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(node.name, node)
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None or fname.split(".")[-1] != "shard_map":
+                continue
+            bound = None
+            for kw in node.keywords:
+                if kw.arg in ("axis_names", "manual_axes"):
+                    bound = _literal_str_set(kw.value)
+            if bound is None:
+                continue   # full-manual or non-literal: all axes bound
+            body = self._body_node(node, local_defs)
+            if body is None:
+                continue
+            for coll, axes in self._literal_collectives(body):
+                for ax in axes:
+                    if ax.value in bound:
+                        continue
+                    key = (coll.lineno, coll.col_offset, ax.value)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        self.name, ctx.relpath, coll.lineno,
+                        coll.col_offset,
+                        f"collective over axis {ax.value!r} inside a "
+                        f"shard_map that only binds "
+                        f"{sorted(bound)} as manual — the axis is not "
+                        f"addressable here even if the mesh has it",
+                        self.severity))
+
+    def _body_node(self, call: ast.Call,
+                   local_defs: Dict[str, ast.AST]) -> Optional[ast.AST]:
+        if not call.args:
+            return None
+        body = call.args[0]
+        if isinstance(body, ast.Call):      # functools.partial(f, ...)
+            fn = dotted_name(body.func)
+            if fn is not None and fn.split(".")[-1] == "partial" \
+                    and body.args:
+                body = body.args[0]
+        if isinstance(body, ast.Lambda):
+            return body
+        if isinstance(body, ast.Name):
+            return local_defs.get(body.id)
+        return None
+
+    def _literal_collectives(self, body: ast.AST):
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = dotted_name(sub.func)
+            if fname is None \
+                    or fname.split(".")[-1] not in _COLLECTIVES:
+                continue
+            axis_arg = None
+            for kw in sub.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+            if axis_arg is None:
+                if fname.split(".")[-1] in ("axis_index", "axis_size"):
+                    axis_arg = sub.args[0] if sub.args else None
+                elif len(sub.args) >= 2:
+                    axis_arg = sub.args[1]
+            if axis_arg is None:
+                continue
+            axes = []
+            for lit in ast.walk(axis_arg):
+                if isinstance(lit, ast.Constant) \
+                        and isinstance(lit.value, str):
+                    axes.append(lit)
+            if axes:
+                yield sub, axes

@@ -27,8 +27,8 @@ from typing import List, Set
 
 from ..findings import Finding, ERROR
 from .base import (Checker, assigned_names, dotted_name, expr_tainted,
-                   jit_decorator_info, jitted_local_defs, param_names,
-                   static_params)
+                   jit_decorator_info, jitted_local_def_calls,
+                   param_names, static_params)
 
 _CONCRETIZERS = {"float", "int", "bool", "complex"}
 _SYNC_METHODS = {"item", "tolist"}
@@ -52,12 +52,15 @@ class TracerLeakChecker(Checker):
     def check(self, ctx) -> List[Finding]:
         findings: List[Finding] = []
         np_aliases = _numpy_aliases(ctx.tree)
-        wrapped = jitted_local_defs(ctx.tree)
+        wrapped = jitted_local_def_calls(ctx.tree)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            jit_info = jit_decorator_info(node)
-            if jit_info is None and node.name not in wrapped:
+            # wrap-site jit calls carry static specs too — g = jax.jit(f,
+            # static_argnums=...) must exempt those params like the
+            # decorator form does
+            jit_info = jit_decorator_info(node) or wrapped.get(node.name)
+            if jit_info is None:
                 continue
             taint = set(param_names(node)) - static_params(node, jit_info)
             self._scan(ctx, node.body, taint, np_aliases, findings)

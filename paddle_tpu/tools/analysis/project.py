@@ -74,6 +74,9 @@ class ModuleInfo:
     functions: Dict[str, FunctionInfo] = field(default_factory=dict)
     classes: Dict[str, ClassInfo] = field(default_factory=dict)
     aliases: Dict[str, str] = field(default_factory=dict)  # g = f rebinds
+    # module-level NAME = "literal" string constants (AXIS = "tp") —
+    # axis-name/sharding rules resolve non-literal axis args through them
+    consts: Dict[str, str] = field(default_factory=dict)
 
 
 def module_name_for(relpath: str) -> Tuple[str, bool]:
@@ -215,6 +218,43 @@ class Project:
                         return hit
         return None
 
+    def resolve_str_const(self, mod_name: str,
+                          dotted: Optional[str]) -> Optional[str]:
+        """Resolve a textual reference seen in ``mod_name`` to a
+        module-level string constant: bare names through local consts /
+        ``g = f`` aliases / ``from m import C`` targets, dotted names
+        (``topo.AXIS``) through the import table."""
+        if not dotted:
+            return None
+        m = self.modules.get(mod_name)
+        if m is None:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            seen: Set[str] = set()
+            while name not in seen:
+                seen.add(name)
+                if name in m.consts:
+                    return m.consts[name]
+                if name in m.aliases:
+                    name = m.aliases[name]
+                    continue
+                target = m.imports.get(name)
+                if target is not None and "." in target:
+                    owner, leaf = target.rsplit(".", 1)
+                    om = self.modules.get(owner)
+                    if om is not None and leaf in om.consts:
+                        return om.consts[leaf]
+                return None
+            return None
+        target = m.imports.get(parts[0])
+        if target is not None and len(parts) == 2:
+            om = self.modules.get(target)
+            if om is not None:
+                return om.consts.get(parts[1])
+        return None
+
     # -------------------------------------------------------- call graph
     def callees(self, fn: FunctionInfo) -> Tuple[FunctionInfo, ...]:
         """Resolved project functions called (textually) inside ``fn``,
@@ -293,9 +333,12 @@ def _index_module(mod: ModuleInfo) -> None:
                         name=sub.name, node=sub, cls=node.name)
             mod.classes[node.name] = ci
         elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Name):
-            mod.aliases[node.targets[0].id] = node.value.id
+                and isinstance(node.targets[0], ast.Name):
+            if isinstance(node.value, ast.Name):
+                mod.aliases[node.targets[0].id] = node.value.id
+            elif isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                mod.consts[node.targets[0].id] = node.value.value
 
 
 def build_project(entries: Iterable[Tuple]) -> Project:

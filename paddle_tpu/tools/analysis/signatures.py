@@ -1,0 +1,682 @@
+"""Signature table for graftshape: abstract semantics of jnp/lax ops.
+
+Each entry maps a textual call target (dotted name, leaf name, or array
+method) to a handler ``(interp, rec) -> AbstractValue`` where ``rec`` is
+an :class:`~.absint.CallRecord`.  The table is REGISTRABLE — a repo
+functional whose shape behaviour the interpreter should understand gets
+one line::
+
+    from paddle_tpu.tools.analysis.signatures import register_signature
+
+    register_signature("paddle_tpu.nn.functional.fused_rms_norm",
+                       lambda interp, rec: rec.args[0])   # shape-preserving
+
+Handlers must be total over abstract inputs: anything surprising returns
+UNKNOWN (never raise — the interpreter catches and degrades, but a
+handler that throws routinely is a bug).  Dynamic-shape producers
+(``nonzero``, 1-arg ``where``, ``unique`` …) emit the "dynamic-call"
+event when fed traced data WITHOUT the fixed-shape ``size=`` escape
+hatch — that event is what the recompile-shape rule reports.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+from .absint import (Arr, Const, DYN, SpecVal, Sym, Tup, UNKNOWN,
+                     AbstractValue, canon_dtype, is_traced,
+                     promote_dtypes, _broadcast, _matmul_shape)
+
+__all__ = ["SIGNATURES", "METHOD_SIGNATURES", "register_signature",
+           "register_method_signature", "lookup_signature"]
+
+# dotted / leaf call target -> handler
+SIGNATURES: Dict[str, Callable] = {}
+# array-method name -> handler (receiver is rec.recv, an Arr)
+METHOD_SIGNATURES: Dict[str, Callable] = {}
+
+# module roots under which a LEAF name is trusted to mean the jnp/lax op
+# ("jnp.sum", "jax.numpy.sum", "lax.psum", ...); a bare call like
+# ``sum(xs)`` is Python and never routed here
+_NUMERIC_ROOTS = ("jnp", "jax", "lax", "np", "numpy")
+
+
+def register_signature(name: str, handler: Callable) -> None:
+    """Register/override the abstract semantics of a dotted call target.
+    ``name`` may be fully dotted ("paddle_tpu.nn.functional.relu") or a
+    jnp/lax leaf ("relu" — matched under the numeric roots only)."""
+    SIGNATURES[name] = handler
+
+
+def register_method_signature(name: str, handler: Callable) -> None:
+    METHOD_SIGNATURES[name] = handler
+
+
+def lookup_signature(fname: Optional[str], leaf: Optional[str],
+                     recv: Optional[Arr]) -> Optional[Callable]:
+    if fname is not None and "." in fname:
+        # exact dotted keys first (repo functionals), then leaf names
+        # under the numeric roots only — a DOTTED name is required here
+        # so a bare local call like ``compress(xs, keep)`` never matches
+        # the jnp leaf entry (it resolves through the project instead)
+        hit = SIGNATURES.get(fname)
+        if hit is not None:
+            return hit
+        if fname.split(".")[0] in _NUMERIC_ROOTS and leaf is not None:
+            hit = SIGNATURES.get(leaf)
+            if hit is not None:
+                return hit
+    if recv is not None and leaf is not None:
+        return METHOD_SIGNATURES.get(leaf)
+    # bare-name constructors that carry their own registration (P, ...)
+    if fname is not None and "." not in fname:
+        return _BARE_SIGNATURES.get(fname)
+    return None
+
+
+def _sig(*names):
+    def deco(fn):
+        for n in names:
+            SIGNATURES[n] = fn
+        return fn
+    return deco
+
+
+def _method(*names):
+    def deco(fn):
+        for n in names:
+            METHOD_SIGNATURES[n] = fn
+        return fn
+    return deco
+
+
+# ----------------------------------------------------------- small utils
+
+def _dims_from(v: AbstractValue) -> Optional[Tuple]:
+    """A shape argument: Tup/Const of ints (symbolic entries allowed)."""
+    if isinstance(v, Const) and isinstance(v.value, int):
+        return (v.value,)
+    if isinstance(v, Tup):
+        out = []
+        for e in v.elts:
+            if isinstance(e, Const) and isinstance(e.value, int):
+                out.append(e.value)
+            elif isinstance(e, Arr) and not e.traced:
+                out.append(Sym())
+            elif is_traced(e):
+                return None
+            else:
+                out.append(Sym())
+        return tuple(out)
+    return None
+
+
+def _dtype_from(v: Optional[AbstractValue]) -> Optional[str]:
+    if isinstance(v, Const) and isinstance(v.value, str):
+        return canon_dtype(v.value)
+    return None
+
+
+def _arg(rec, i: int, name: str) -> Optional[AbstractValue]:
+    if len(rec.args) > i:
+        return rec.args[i]
+    return rec.kwargs.get(name)
+
+
+def _operand(rec) -> AbstractValue:
+    """First data operand: the receiver for methods, arg0 otherwise."""
+    if rec.recv is not None and isinstance(rec.recv, Arr):
+        return rec.recv
+    return rec.args[0] if rec.args else UNKNOWN
+
+
+def _as_arr(v: AbstractValue) -> Arr:
+    return v if isinstance(v, Arr) else Arr()
+
+
+# ------------------------------------------------------------- creation
+
+@_sig("zeros", "ones", "empty", "full")
+def _creation(interp, rec):
+    shape = _dims_from(rec.args[0]) if rec.args else None
+    di = 2 if rec.leaf == "full" else 1
+    dtype = _dtype_from(_arg(rec, di, "dtype")) or "float32"
+    return Arr(shape=shape, dtype=dtype)
+
+
+@_sig("zeros_like", "ones_like", "empty_like", "full_like")
+def _creation_like(interp, rec):
+    src = _as_arr(rec.args[0]) if rec.args else Arr()
+    dtype = _dtype_from(_arg(rec, 2 if rec.leaf == "full_like" else 1,
+                             "dtype")) or src.dtype
+    return Arr(shape=src.shape, dtype=dtype, traced=False)
+
+
+@_sig("arange", "linspace")
+def _arange(interp, rec):
+    dtype = _dtype_from(rec.kwargs.get("dtype"))
+    return Arr(shape=(Sym(),), dtype=dtype)
+
+
+@_sig("eye", "identity")
+def _eye(interp, rec):
+    def dim_of(v):
+        return v.value if isinstance(v, Const) \
+            and isinstance(v.value, int) else Sym()
+    n = rec.args[0] if rec.args else None
+    rows = dim_of(n)
+    m = _arg(rec, 1, "M")
+    cols = rows if m is None else dim_of(m)     # eye(N, M) is N x M
+    return Arr(shape=(rows, cols),
+               dtype=_dtype_from(rec.kwargs.get("dtype")) or "float32")
+
+
+@_sig("asarray", "array")
+def _asarray(interp, rec):
+    src = _as_arr(rec.args[0]) if rec.args else Arr()
+    dtype = _dtype_from(_arg(rec, 1, "dtype")) or src.dtype
+    if rec.args and isinstance(rec.args[0], Tup):
+        if any(isinstance(e, (Tup, Arr)) for e in rec.args[0].elts):
+            # nested lists / array elements: rank > 1, degrade to
+            # unknown rather than claiming a flat vector
+            return Arr(dtype=dtype, traced=is_traced(rec.args[0]))
+        return Arr(shape=(len(rec.args[0].elts),), dtype=dtype,
+                   traced=is_traced(rec.args[0]))
+    return src.with_(dtype=dtype)
+
+
+# ------------------------------------------------------- shape movement
+
+def _is_method(rec) -> bool:
+    """True for the ``x.op(...)`` form — the receiver must be a KNOWN
+    array; a dotted call like ``jnp.op(x, ...)`` has recv = the module
+    value (UNKNOWN), never None, so ``recv is not None`` is the wrong
+    test."""
+    return isinstance(rec.recv, Arr)
+
+
+@_sig("reshape")
+@_method("reshape")
+def _reshape(interp, rec):
+    x = _as_arr(_operand(rec))
+    shape_args = rec.args if _is_method(rec) else rec.args[1:]
+    if not shape_args:
+        # keyword form: jnp.reshape(a, newshape=...) / shape= — an empty
+        # positional list must NOT read as reshape-to-scalar
+        kw = rec.kwargs.get("shape") or rec.kwargs.get("newshape")
+        dims = _dims_from(kw) if kw is not None else None
+    elif len(shape_args) == 1:
+        dims = _dims_from(shape_args[0])
+    else:
+        dims = _dims_from(Tup(tuple(shape_args)))
+    if dims is None:
+        return x.with_(shape=None, spec=None)
+    # resolve a single -1 when the total extent is concrete
+    if dims.count(-1) == 1 and x.shape is not None \
+            and all(isinstance(d, int) for d in x.shape) \
+            and all(isinstance(d, int) for d in dims):
+        total = 1
+        for d in x.shape:
+            total *= d
+        rest = 1
+        for d in dims:
+            if d != -1:
+                rest *= d
+        dims = tuple(total // rest if d == -1 and rest else d
+                     for d in dims)
+    else:
+        dims = tuple(Sym() if d == -1 else d for d in dims)
+    return x.with_(shape=dims, spec=None)
+
+
+@_sig("transpose")
+@_method("transpose")
+def _transpose(interp, rec):
+    x = _as_arr(_operand(rec))
+    if x.shape is None:
+        return x
+    # an explicit axes argument is a permutation we don't model — a
+    # WRONG concrete shape is worse than an unknown one
+    has_axes = "axes" in rec.kwargs or (
+        rec.args if _is_method(rec) else rec.args[1:])
+    if has_axes:
+        return x.with_(shape=None, spec=None)
+    return x.with_(shape=tuple(reversed(x.shape)), spec=None)
+
+
+@_sig("swapaxes", "moveaxis")
+@_method("swapaxes")
+def _swapaxes(interp, rec):
+    x = _as_arr(_operand(rec))
+    if x.shape is None:
+        return x
+    if rec.leaf == "moveaxis":
+        # moveaxis is a rotation, not a swap — degrade rather than fold
+        # a wrong permutation
+        return x.with_(shape=None, spec=None)
+    off = 0 if _is_method(rec) else 1
+    a = _arg(rec, off, "axis1")
+    b = _arg(rec, off + 1, "axis2")
+    if isinstance(a, Const) and isinstance(b, Const) \
+            and isinstance(a.value, int) and isinstance(b.value, int):
+        dims = list(x.shape)
+        try:
+            dims[a.value], dims[b.value] = dims[b.value], dims[a.value]
+            return x.with_(shape=tuple(dims), spec=None)
+        except IndexError:
+            pass
+    return x.with_(shape=None, spec=None)
+
+
+@_sig("expand_dims")
+def _expand_dims(interp, rec):
+    x = _as_arr(_operand(rec))
+    ax = _arg(rec, 1, "axis")
+    if x.shape is not None and isinstance(ax, Const) \
+            and isinstance(ax.value, int):
+        dims = list(x.shape)
+        i = ax.value if ax.value >= 0 else len(dims) + 1 + ax.value
+        if 0 <= i <= len(dims):
+            dims.insert(i, 1)
+            return x.with_(shape=tuple(dims), spec=None)
+    return x.with_(shape=None, spec=None)
+
+
+@_sig("squeeze")
+@_method("squeeze")
+def _squeeze(interp, rec):
+    x = _as_arr(_operand(rec))
+    return x.with_(shape=None, spec=None)
+
+
+@_sig("broadcast_to")
+def _broadcast_to(interp, rec):
+    x = _as_arr(_operand(rec))
+    dims = _dims_from(_arg(rec, 1, "shape"))
+    return x.with_(shape=dims, spec=None)
+
+
+@_sig("concatenate", "stack", "hstack", "vstack")
+def _concat(interp, rec):
+    parts = rec.args[0] if rec.args else None
+    traced = is_traced(parts) if parts is not None else False
+    dtype = None
+    if isinstance(parts, Tup):
+        dtype = _fold_dtype([e for e in parts.elts if isinstance(e, Arr)])
+    return Arr(dtype=dtype, traced=traced)
+
+
+@_sig("repeat", "tile", "flip", "roll", "sort", "argsort")
+def _shapeish(interp, rec):
+    x = _as_arr(_operand(rec))
+    return x.with_(shape=None if rec.leaf in ("repeat", "tile") else
+                   x.shape, spec=None)
+
+
+@_sig("take", "take_along_axis")
+def _take(interp, rec):
+    x = _as_arr(_operand(rec))
+    return Arr(dtype=x.dtype, traced=x.traced or is_traced(_arg(rec, 1,
+                                                                "indices")))
+
+
+# --------------------------------------------------------- element-wise
+
+_UNARY = ("exp", "log", "log1p", "expm1", "sqrt", "rsqrt", "abs",
+          "negative", "sin", "cos", "tanh", "sigmoid", "relu", "erf",
+          "floor", "ceil", "round", "sign", "square", "logaddexp",
+          "maximum", "minimum", "clip", "where", "nan_to_num", "isnan",
+          "isinf", "isfinite", "isneginf", "isposinf", "logical_not",
+          "logical_and", "logical_or", "add", "subtract", "multiply",
+          "divide", "power", "mod", "exp2", "softmax", "log_softmax")
+
+
+def _fold_dtype(arrs):
+    """Result dtype over operands: unknown is VIRAL (an untyped operand
+    could be f64 and dominate the promotion) — same contract as
+    promote_dtypes itself."""
+    if not arrs:
+        return None
+    dtype = arrs[0].dtype
+    for a in arrs[1:]:
+        dtype = promote_dtypes(dtype, a.dtype)
+    return dtype
+
+
+@_sig(*_UNARY)
+def _elementwise(interp, rec):
+    if rec.leaf == "where" and len(rec.args) == 1:
+        # 1-arg where is the nonzero form WITH OR WITHOUT size= — the
+        # producer models both (index tuple; event only when size is
+        # missing), so the size= escape hatch must not fall through to
+        # the element-wise bool-array model
+        return _dynamic_producer(interp, rec)
+    arrs = [a for a in rec.args if isinstance(a, Arr)]
+    if isinstance(rec.recv, Arr):
+        arrs.insert(0, rec.recv)
+    if not arrs:
+        return UNKNOWN
+    shape = None
+    for a in arrs:
+        if a.shape is not None:
+            shape = a.shape if shape is None else _broadcast(shape, a.shape)
+    if rec.leaf == "where" and len(rec.args) >= 3:
+        # the condition's bool dtype never reaches the result
+        dtype = _fold_dtype([a for a in rec.args[1:3]
+                             if isinstance(a, Arr)])
+    else:
+        dtype = _fold_dtype(arrs)
+    if rec.leaf in ("isnan", "isinf", "isfinite", "isneginf", "isposinf",
+                    "logical_not", "logical_and", "logical_or"):
+        dtype = "bool"
+    return Arr(shape=shape, dtype=dtype,
+               traced=any(a.traced for a in arrs))
+
+
+@_sig("astype")
+@_method("astype")
+def _astype(interp, rec):
+    x = _as_arr(_operand(rec))
+    dtype = _dtype_from(_arg(rec, 0 if _is_method(rec) else 1, "dtype"))
+    narrowed = None
+    if x.dtype in ("float32", "float64") and dtype in ("bfloat16",
+                                                       "float16"):
+        narrowed = x.dtype
+    return x.with_(dtype=dtype or None, narrowed_from=narrowed)
+
+
+# ------------------------------------------------------------ reductions
+
+_REDUCTIONS = ("sum", "mean", "prod", "cumsum", "cumprod", "var", "std",
+               "logsumexp", "amax", "amin", "max", "min", "argmax",
+               "argmin", "any", "all", "count_nonzero", "median",
+               "average", "nansum", "nanmean")
+
+
+@_sig(*_REDUCTIONS)
+@_method("sum", "mean", "prod", "max", "min", "any", "all", "var", "std",
+         "cumsum", "argmax", "argmin")
+def _reduction(interp, rec):
+    x = _as_arr(_operand(rec))
+    dtype_arg = rec.kwargs.get("dtype")
+    if dtype_arg is None:
+        # positional dtype: jnp.sum(x, axis, dtype) / x.sum(axis, dtype)
+        idx = 1 if _is_method(rec) else 2
+        if len(rec.args) > idx:
+            dtype_arg = rec.args[idx]
+    dtype = _dtype_from(dtype_arg) or x.dtype
+    if rec.leaf in ("any", "all"):
+        dtype = "bool"
+    elif rec.leaf in ("argmax", "argmin", "count_nonzero"):
+        dtype = "int32"
+    ax = rec.kwargs.get("axis")
+    if _is_method(rec):
+        if rec.args:
+            ax = rec.args[0]
+    elif len(rec.args) > 1:
+        ax = rec.args[1]
+    keep = rec.kwargs.get("keepdims")
+    keepdims = isinstance(keep, Const) and keep.value is True
+    shape = None
+    if x.shape is not None:
+        if rec.leaf in ("cumsum", "cumprod"):
+            shape = x.shape
+        elif ax is None:
+            shape = x.shape if keepdims else ()
+        elif isinstance(ax, Const) and isinstance(ax.value, int):
+            i = ax.value if ax.value >= 0 else len(x.shape) + ax.value
+            if 0 <= i < len(x.shape):
+                dims = list(x.shape)
+                if keepdims:
+                    dims[i] = 1
+                else:
+                    del dims[i]
+                shape = tuple(dims)
+    return Arr(shape=shape, dtype=dtype, traced=x.traced)
+
+
+# ------------------------------------------------------ contraction ops
+
+@_sig("matmul", "dot")
+def _matmul(interp, rec):
+    a = _as_arr(rec.args[0]) if rec.args else Arr()
+    b = _as_arr(rec.args[1]) if len(rec.args) > 1 else Arr()
+    out = _matmul_shape(a, b)
+    pet = _dtype_from(rec.kwargs.get("preferred_element_type"))
+    return out.with_(dtype=pet) if pet else out
+
+
+@_sig("einsum", "dot_general", "conv_general_dilated", "tensordot")
+def _contraction(interp, rec):
+    arrs = [a for a in rec.args if isinstance(a, Arr)]
+    pet = _dtype_from(rec.kwargs.get("preferred_element_type"))
+    return Arr(dtype=pet or _fold_dtype(arrs),
+               traced=any(a.traced for a in arrs))
+
+
+# ----------------------------------------------- dynamic-shape producers
+
+def _dynamic_producer(interp, rec):
+    """nonzero & friends: the output extent is the number of matching
+    elements — data-dependent, the canonical jit recompile/trace error.
+    ``size=`` fixes the shape and silences the event."""
+    x = _operand(rec)
+    if "size" not in rec.kwargs and is_traced(x):
+        interp._event(
+            rec.node, "dynamic-call",
+            f"{rec.fname or rec.leaf}() on a traced value produces a "
+            f"data-dependent shape under jit; pass size= (with "
+            f"fill_value=) for a fixed-shape variant")
+    dims = (DYN,)
+    if "size" in rec.kwargs:
+        sz = rec.kwargs["size"]
+        dims = ((sz.value,) if isinstance(sz, Const)
+                and isinstance(sz.value, int) else (Sym(),))
+    xr = _as_arr(x)
+    if rec.leaf in ("nonzero", "where"):
+        n = xr.rank if xr.rank is not None else 1
+        return Tup(tuple(Arr(shape=dims, dtype="int32", traced=xr.traced)
+                         for _ in range(max(n, 1))))
+    return Arr(shape=dims, dtype=xr.dtype if rec.leaf in
+               ("unique", "compress", "extract") else "int32",
+               traced=xr.traced)
+
+
+for _name in ("nonzero", "flatnonzero", "argwhere", "unique", "compress",
+              "extract"):
+    SIGNATURES[_name] = _dynamic_producer
+METHOD_SIGNATURES["nonzero"] = _dynamic_producer
+METHOD_SIGNATURES["compress"] = _dynamic_producer
+
+
+# ------------------------------------------------------------- lax layer
+
+@_sig("dynamic_slice", "dynamic_slice_in_dim")
+def _dynamic_slice(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    if rec.leaf == "dynamic_slice":
+        sizes = _dims_from(rec.args[-1]) if len(rec.args) >= 2 else None
+        return x.with_(shape=sizes, spec=None)
+    return x.with_(shape=None, spec=None)
+
+
+@_sig("dynamic_update_slice", "dynamic_update_slice_in_dim")
+def _dynamic_update(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    return x
+
+
+@_sig("psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+      "psum_scatter", "pbroadcast")
+def _collective(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    return x.with_(spec=None)
+
+
+@_sig("all_gather")
+def _all_gather(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    tiled = rec.kwargs.get("tiled")
+    if isinstance(tiled, Const) and tiled.value is True:
+        return x.with_(shape=None, spec=None)
+    if x.shape is not None:
+        return x.with_(shape=(Sym(),) + tuple(x.shape), spec=None)
+    return x.with_(spec=None)
+
+
+@_sig("all_to_all")
+def _all_to_all(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    return x.with_(shape=None, spec=None)
+
+
+@_sig("axis_index", "axis_size")
+def _axis_scalar(interp, rec):
+    return Arr(shape=(), dtype="int32", traced=True)
+
+
+@_sig("stop_gradient")
+def _stop_gradient(interp, rec):
+    return _operand(rec)
+
+
+@_sig("with_sharding_constraint")
+def _wsc(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    spec = rec.args[1] if len(rec.args) > 1 else rec.kwargs.get("shardings")
+    if isinstance(spec, SpecVal):
+        return x.with_(spec=spec.axes)
+    return x
+
+
+@_sig("device_put")
+def _device_put(interp, rec):
+    x = _as_arr(rec.args[0]) if rec.args else Arr()
+    tgt = _arg(rec, 1, "device")
+    if isinstance(tgt, SpecVal):
+        return x.with_(spec=tgt.axes)
+    return x
+
+
+# ----------------------------------------------- higher-order primitives
+
+def _call_abstract(interp, fn_val, args):
+    from .absint import CallRecord, _LocalFn
+    if not isinstance(fn_val, _LocalFn):
+        return UNKNOWN
+    rec = CallRecord(node=fn_val.node, fname=None, leaf=None,
+                     args=tuple(args), kwargs={}, recv=None)
+    return interp._summarize_local(fn_val, rec)
+
+
+@_sig("scan")
+def _scan(interp, rec):
+    body = rec.args[0] if rec.args else None
+    init = rec.args[1] if len(rec.args) > 1 else UNKNOWN
+    _call_abstract(interp, body, [init, Arr(traced=True)])
+    return UNKNOWN
+
+
+@_sig("fori_loop")
+def _fori(interp, rec):
+    body = rec.args[2] if len(rec.args) > 2 else None
+    init = rec.args[3] if len(rec.args) > 3 else UNKNOWN
+    _call_abstract(interp, body,
+                   [Arr(shape=(), dtype="int32", traced=True), init])
+    return init if isinstance(init, Arr) else UNKNOWN
+
+
+@_sig("while_loop")
+def _while(interp, rec):
+    cond = rec.args[0] if rec.args else None
+    body = rec.args[1] if len(rec.args) > 1 else None
+    init = rec.args[2] if len(rec.args) > 2 else UNKNOWN
+    _call_abstract(interp, cond, [init])
+    _call_abstract(interp, body, [init])
+    return init if isinstance(init, Arr) else UNKNOWN
+
+
+@_sig("cond")
+def _cond(interp, rec):
+    ops = list(rec.args[3:])
+    a = _call_abstract(interp, rec.args[1] if len(rec.args) > 1 else None,
+                       ops)
+    b = _call_abstract(interp, rec.args[2] if len(rec.args) > 2 else None,
+                       ops)
+    from .absint import join
+    return join(a, b)
+
+
+@_sig("jit", "pjit")
+def _jit(interp, rec):
+    # jax.jit(f) evaluates to f for summary purposes (donation and
+    # compile-cache concerns live in their own rules)
+    return rec.args[0] if rec.args else UNKNOWN
+
+
+# --------------------------------------------------------- partitioning
+
+def _pspec(interp, rec):
+    axes = []
+    for a in rec.args:
+        if isinstance(a, Const):
+            axes.append(a.value)      # str or None
+        elif isinstance(a, Tup) and all(isinstance(e, Const)
+                                        for e in a.elts):
+            axes.append(tuple(e.value for e in a.elts))
+        else:
+            axes.append(UNKNOWN)
+    return SpecVal(tuple(axes))
+
+
+SIGNATURES["PartitionSpec"] = _pspec
+SIGNATURES["jax.sharding.PartitionSpec"] = _pspec
+SIGNATURES["sharding.PartitionSpec"] = _pspec
+# bare-name constructors resolved without a module root (P is the
+# conventional PartitionSpec alias; adding here keeps lookup_signature's
+# numeric-root guard intact for everything else)
+_BARE_SIGNATURES: Dict[str, Callable] = {"P": _pspec,
+                                         "PartitionSpec": _pspec}
+
+
+def _named_sharding(interp, rec):
+    spec = _arg(rec, 1, "spec")
+    return spec if isinstance(spec, SpecVal) else UNKNOWN
+
+
+SIGNATURES["NamedSharding"] = _named_sharding
+SIGNATURES["jax.sharding.NamedSharding"] = _named_sharding
+_BARE_SIGNATURES["NamedSharding"] = _named_sharding
+
+
+# ------------------------------------------------------ repo functionals
+# The registrable half of the table: repo kernels whose shape/dtype
+# behaviour matters to the rules.  Call sites usually import these bare
+# (``from ..kernels.flash_attention import flash_attention``); the
+# interpreter resolves such names to their dotted targets through the
+# project import table before consulting this registry, so keys are the
+# DEFINITION-SITE qualified names.
+
+def _first_arg_like(interp, rec):
+    """Shape-, dtype- and tracedness-preserving on the first operand —
+    attention outputs and fused norms look like their primary input."""
+    return rec.args[0] if rec.args and isinstance(rec.args[0], Arr) \
+        else UNKNOWN
+
+
+def _attention_with_lse(interp, rec):
+    q = rec.args[0] if rec.args and isinstance(rec.args[0], Arr) else Arr()
+    return Tup((q, Arr(dtype="float32", traced=q.traced)))
+
+
+register_signature(
+    "paddle_tpu.kernels.flash_attention.flash_attention", _first_arg_like)
+register_signature(
+    "paddle_tpu.kernels.flash_attention.flash_attention_varlen",
+    _first_arg_like)
+register_signature(
+    "paddle_tpu.kernels.flash_attention.flash_attention_with_lse",
+    _attention_with_lse)
+register_signature(
+    "paddle_tpu.kernels.fused_norm.fused_rms_norm_pallas",
+    _first_arg_like)

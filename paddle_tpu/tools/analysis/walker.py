@@ -64,6 +64,10 @@ class FileContext:
     src: str
     tree: ast.Module
     project: Optional[object] = None   # project.Project when built
+    # per-file scratch shared by the checkers that run over this file —
+    # graftshape rules memoize abstract interpretations here so the same
+    # function body is never interpreted twice under identical inputs
+    memo: Dict = field(default_factory=dict)
 
 
 @dataclass

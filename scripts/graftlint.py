@@ -61,8 +61,10 @@ run_analysis = _analysis.run_analysis
 
 def _changed_files(since):
     """Repo-relative .py paths from ``git diff --name-only <since>``
-    (default HEAD — staged AND unstaged, so the pre-commit hook sees the
-    index it is about to commit), plus untracked .py files."""
+    (default HEAD — staged AND unstaged), plus untracked .py files.
+    Linting reads the ON-DISK content of those files, so an unstaged fix
+    can mask a staged violation; the full-scope CI gate is the
+    authority."""
     out = []
     cmds = [["git", "diff", "--name-only", since or "HEAD"],
             ["git", "ls-files", "--others", "--exclude-standard"]]

@@ -1,0 +1,10 @@
+"""The module that OWNS the axis constant AND the mesh: the declaration
+itself goes through the constant (Mesh built from TP_AXIS)."""
+import numpy as np
+from jax.sharding import Mesh
+
+TP_AXIS = "tp"
+
+
+def build_mesh(devices):
+    return Mesh(np.array(devices), (TP_AXIS, "dp"))

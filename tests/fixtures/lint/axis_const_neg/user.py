@@ -1,0 +1,36 @@
+"""Collectives over module-level string constants — both the locally
+re-exported name and the dotted ``topo.TP_AXIS`` form resolve through
+the project index to "tp", which the imported mesh builder declares."""
+import jax
+
+import topo
+from topo import TP_AXIS, build_mesh
+
+LOCAL_AXIS = "dp"
+
+
+def reduce_tp(x, mesh=None):
+    mesh = mesh or build_mesh([])
+    return jax.lax.psum(x, TP_AXIS)
+
+
+def reduce_dotted(x):
+    return jax.lax.pmean(x, topo.TP_AXIS)
+
+
+def reduce_local(x):
+    return jax.lax.psum(x, LOCAL_AXIS)
+
+
+def reduce_param(x, axis_name):
+    # a lowercase name is never resolved as a constant — it may be a
+    # parameter shadowing one
+    return jax.lax.psum(x, axis_name)
+
+
+def build_local(devices):
+    # declaration side resolves dotted constants too: this mesh declares
+    # "tp" through topo.TP_AXIS exactly like the use side reads it
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices), (topo.TP_AXIS, "dp"))

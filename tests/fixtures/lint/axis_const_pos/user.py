@@ -1,0 +1,19 @@
+"""A constant axis name no mesh in scope declares: the docstring
+carve-out used to skip ALL non-literal axis args; constant resolution
+makes this a finding instead of a blind spot."""
+import jax
+
+from topo import build_mesh
+
+EXPERT_AXIS = "ep"
+
+
+def reduce_expert(x, mesh=None):
+    mesh = mesh or build_mesh([])
+    return jax.lax.psum(x, EXPERT_AXIS)      # "ep" is declared nowhere
+
+
+def reduce_mixed(x):
+    # a mixed tuple must resolve element-wise: "tp" is declared, the
+    # constant's "ep" is not — exactly one finding here
+    return jax.lax.psum(x, ("tp", EXPERT_AXIS))

@@ -1,0 +1,64 @@
+"""dtype-flow negative: the blessed accumulation idioms — widen before
+(or during) every reduction, or keep the dtype generic."""
+
+import jax.numpy as jnp
+
+
+def widened_first(x):
+    return jnp.sum(x.astype(jnp.float32))      # cast UP before reducing
+
+
+def widened_inline(x):
+    y = x.astype(jnp.bfloat16)
+    return jnp.sum(y, dtype=jnp.float32)       # dtype= overrides the accum
+
+
+def mxu_f32_accum(a):
+    a16 = a.astype(jnp.bfloat16)
+    return jnp.dot(a16, a16, preferred_element_type=jnp.float32)
+
+
+def generic_dtype(x):
+    return jnp.sum(x)                          # dtype unknown: quiet
+
+
+def mixed_promotes(a, b):
+    # bf16 x f32 promotes to f32 before the contraction — already wide
+    return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.float32))
+
+
+def storage_cast_only(x):
+    # narrowing for STORAGE (no reduction consumes it here) is the
+    # intended bf16 use
+    return x.astype(jnp.bfloat16)
+
+
+def unknown_times_half(w, h):
+    # w's dtype is unknown — it could be f32 and dominate the promotion,
+    # so neither the product nor the reduce is provably 16-bit
+    z = jnp.multiply(w, h.astype(jnp.bfloat16))
+    return jnp.sum(z)
+
+
+def unknown_dot_operand(w, x):
+    # same for contractions: one untyped operand means promotion may
+    # already widen — the rule must stay quiet
+    return jnp.dot(w, x.astype(jnp.bfloat16))
+
+
+def dotted_reduce_with_axis(x):
+    # dotted (non-method) call with a positional axis: the axis arg must
+    # not be mistaken for the operand
+    a = jnp.zeros((4, 8), jnp.float32)
+    return jnp.sum(a, 0)
+
+
+def unknown_matmul_op(w, x):
+    # one untyped @ operand: promotion may widen — quiet
+    return w @ x.astype(jnp.bfloat16)
+
+
+def positional_widening_dtype(h):
+    # jax accepts dtype positionally too — this ALREADY accumulates in
+    # f32 and must stay quiet
+    return jnp.sum(h.astype(jnp.bfloat16), 0, jnp.float32)

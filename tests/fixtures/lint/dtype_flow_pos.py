@@ -1,0 +1,33 @@
+"""dtype-flow positive: five planted 16-bit accumulation bugs (bf16 sum,
+bf16 matmul without preferred_element_type, a narrowing dtype= reduce,
+an explicit down-cast feeding a reduction, and the @-operator spelling
+of a bf16 contraction)."""
+
+import jax.numpy as jnp
+
+
+def block_loss(x):
+    y = x.astype(jnp.bfloat16)
+    return jnp.sum(y)                     # 1: accumulates in bf16
+
+
+def block_dot(a):
+    a16 = a.astype(jnp.bfloat16)
+    return jnp.dot(a16, a16)              # 2: MXU accumulates in bf16
+
+
+def narrowed_total():
+    acc = jnp.zeros((128,), jnp.float32)
+    acc = acc + 1.0
+    return jnp.sum(acc, dtype=jnp.bfloat16)   # 3: dtype= narrows f32
+
+
+def cast_then_mean(x):
+    x32 = x.astype(jnp.float32)
+    return jnp.mean(x32.astype(jnp.bfloat16))  # 4: down-cast feeds reduce
+
+
+def block_matmul_op(q, k):
+    q16 = q.astype(jnp.bfloat16)
+    k16 = k.astype(jnp.bfloat16)
+    return q16 @ k16                      # 5: the @ spelling, same hazard

@@ -1,0 +1,73 @@
+"""recompile-shape negative: the fixed-shape discipline, expressed the
+legal ways — every body here compiles to one program per input shape."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def masked_fill(x):
+    return jnp.where(x > 0, x, 0.0)       # 3-arg where keeps the shape
+
+
+@jax.jit
+def sized_hits(x):
+    return jnp.nonzero(x, size=4, fill_value=0)   # fixed-shape variant
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_head(x, n):
+    return x[:n]                          # n is static: width is concrete
+
+
+def wrapped_head(x, n):
+    return x[:n]                          # n static via the WRAP site
+
+
+wrapped_head_fast = jax.jit(wrapped_head, static_argnums=(1,))
+
+
+@jax.jit
+def shape_half(x):
+    return x[: x.shape[0] // 2]           # shapes are trace-static
+
+
+@jax.jit
+def fixed_window(x):
+    return jax.lax.dynamic_slice(x, (0,), (8,))   # static size, traced start
+
+
+def host_filter(x):
+    return x[x > 0]                       # host code is free to be dynamic
+
+
+@jax.jit
+def masked_zero(x, eos):
+    m = x == eos
+    return x.at[m].set(0.0)               # .at scatter is fixed-shape
+
+
+@jax.jit
+def sized_where_gather(x):
+    # the rule's own recommended escape hatch must stay silent
+    idx = jnp.where(x > 0, size=4, fill_value=0)
+    return x[idx[0]]
+
+
+@jax.jit
+def const_mask_select(x):
+    mask = jnp.arange(8) > 4              # trace-time constant: static
+    return x[mask]                        # popcount, fixed shape
+
+
+def compress(xs, keep):
+    # a LOCAL function shadowing a jnp leaf name: must resolve through
+    # the project summary, not the jnp.compress signature
+    return xs
+
+
+@jax.jit
+def local_compress(x):
+    return compress(x, 3)

@@ -1,0 +1,42 @@
+"""recompile-shape positive: five planted dynamic-shape hazards under
+jit (bool-mask indexing, nonzero, a traced slice bound, a 1-arg where
+reached through an interprocedural summary, and a nonzero reached
+through a ``self.method()`` summary)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mask_select(x):
+    return x[x > 0]                       # 1: boolean-mask indexing
+
+
+@jax.jit
+def first_hits(x):
+    return jnp.nonzero(x)                 # 2: data-dependent extent
+
+
+@jax.jit
+def head(x, n):
+    return x[:n]                          # 3: traced slice width
+
+
+def _active_rows(v):
+    # the sink lives in a host-callable helper; it only becomes a hazard
+    # when a jitted body reaches it
+    return jnp.where(v > 0)
+
+
+@jax.jit
+def gather_active(v):
+    return _active_rows(v)                # 4: fires here, via summary
+
+
+class Engine:
+    def _scatter_rows(self, v):
+        return jnp.nonzero(v)
+
+    @jax.jit
+    def step(self, v):
+        return self._scatter_rows(v)      # 5: via self-method summary

@@ -1,0 +1,48 @@
+"""sharding-consistency negative: specs that agree with the mesh, ranks
+that match, collectives over bound axes, and the parameterized forms the
+rule leaves to the caller by design."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def build_mesh(devs):
+    return Mesh(devs, ("dp", "mp"))
+
+
+def good_spec(x, mesh):
+    return jax.device_put(x, NamedSharding(mesh, P("dp", "mp")))
+
+
+def matched_rank():
+    y = jnp.zeros((4, 8), jnp.float32)
+    return jax.lax.with_sharding_constraint(y, P("dp", None))
+
+
+def _bound_body(x):
+    return jax.lax.psum(x, "dp")          # dp IS in the manual set
+
+
+def partial_manual(x, mesh):
+    f = shard_map(_bound_body, mesh=mesh, in_specs=P("dp"),
+                  out_specs=P(), axis_names=frozenset({"dp"}))
+    return f(x)
+
+
+def _param_body(x, axis_name="dp"):
+    return jax.lax.psum(x, axis_name)     # parameterized: caller's contract
+
+
+def full_manual(x, mesh, manual_axes):
+    # non-literal axis_names (and no axis_names at all) bind every mesh
+    # axis — out of scope
+    f = shard_map(_param_body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                  axis_names=frozenset(manual_axes))
+    return f(x)
+
+
+def dynamic_spec(x, axes):
+    # P(*axes): nothing literal to check
+    return jax.lax.with_sharding_constraint(x, P(*axes))

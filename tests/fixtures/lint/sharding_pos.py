@@ -1,0 +1,34 @@
+"""sharding-consistency positive: three planted mesh/spec/collective
+mismatches (unknown axis in a spec, spec rank > array rank, collective
+over an axis the enclosing shard_map never bound)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def build_mesh(devs):
+    return Mesh(devs, ("dp", "mp"))
+
+
+def misnamed_spec(x, mesh):
+    # 1: the meshes here declare dp/mp — "tp" is a typo
+    return jax.device_put(x, NamedSharding(mesh, P("tp")))
+
+
+def overlong_spec():
+    y = jnp.zeros((4, 8), jnp.float32)
+    # 2: a 3-entry spec on a rank-2 array
+    return jax.lax.with_sharding_constraint(y, P("dp", None, "mp"))
+
+
+def _psum_body(x):
+    # 3: mp exists on the mesh, but the shard_map below binds only dp
+    return jax.lax.psum(x, "mp")
+
+
+def partial_manual(x, mesh):
+    f = shard_map(_psum_body, mesh=mesh, in_specs=P("dp"),
+                  out_specs=P("dp"), axis_names=frozenset({"dp"}))
+    return f(x)

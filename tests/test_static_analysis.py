@@ -45,7 +45,12 @@ def test_rule_catalogue_is_complete():
     names = {c.name for c in default_checkers()}
     assert names == {"tracer-leak", "recompile-hazard", "host-sync",
                      "axis-name", "registry-drift", "dead-state",
-                     "use-after-donate", "resource-lifecycle"}
+                     "use-after-donate", "resource-lifecycle",
+                     "recompile-shape", "dtype-flow",
+                     "sharding-consistency"}
+    # ISSUE 5: the catalogue is now eleven rules — a checker silently
+    # dropping out of default_checkers() must fail loudly
+    assert len(names) == 11 and len(default_checkers()) == 11
 
 
 # ------------------------------------------------- per-rule fixture pairs
@@ -412,6 +417,278 @@ def test_project_index_import_and_call_resolution():
     assert fi2 is not None and fi2.qname == "pkg.mod_a.f"
 
 
+# --------------------------------------- ISSUE 5: graftshape rule families
+
+def test_recompile_shape_positive():
+    """Exactly 5 planted fixed-shape violations: bool-mask indexing,
+    nonzero, a traced slice bound, a 1-arg where reached through an
+    interprocedural summary (chain in the message), and a nonzero
+    reached through a ``self.method()`` summary inside a class."""
+    res = run_rule("shape_recompile_pos.py", "recompile-shape")
+    found = only_rule(res, "recompile-shape")
+    assert len(found) == 5, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "boolean-mask" in msgs
+    assert "jnp.nonzero()" in msgs
+    assert "slice bound" in msgs
+    assert "inside _active_rows()" in msgs     # the summary chain
+    assert "inside _scatter_rows()" in msgs    # the self-method chain
+
+
+def test_recompile_shape_negative():
+    """3-arg where, size= variants, static slice bounds, shape-derived
+    widths, dynamic_slice with static sizes, host code — silent."""
+    res = run_rule("shape_recompile_neg.py", "recompile-shape")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_recompile_shape_default_hot_paths_cover_serving_and_kernels():
+    import fnmatch
+    from paddle_tpu.tools.analysis.checkers.shape_recompile import \
+        DEFAULT_HOT_PATHS
+    for probe in ("paddle_tpu/serving/engine.py",
+                  "paddle_tpu/kernels/flash_attention.py"):
+        assert any(fnmatch.fnmatch(probe, p) for p in DEFAULT_HOT_PATHS)
+
+
+def test_dtype_flow_positive():
+    """Exactly 5 planted 16-bit accumulation bugs: bf16 sum, bf16 dot
+    without preferred_element_type, a narrowing dtype= reduce, a
+    down-cast feeding a reduction, and the @-operator contraction."""
+    res = run_rule("dtype_flow_pos.py", "dtype-flow")
+    found = only_rule(res, "dtype-flow")
+    assert len(found) == 5, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "accumulates in bfloat16" in msgs
+    assert "preferred_element_type" in msgs
+    assert "narrows a float32 operand" in msgs
+    assert "down-cast from float32" in msgs
+    assert "@ on bfloat16 operands" in msgs
+
+
+def test_dtype_flow_negative():
+    """Widen-before-reduce, dtype=f32 overrides, preferred_element_type,
+    unknown dtypes, promoting mixes, storage-only casts — silent."""
+    res = run_rule("dtype_flow_neg.py", "dtype-flow")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_dtype_flow_default_hot_paths_cover_kernels_and_optimizer():
+    import fnmatch
+    from paddle_tpu.tools.analysis.checkers.dtype_flow import \
+        DEFAULT_HOT_PATHS
+    for probe in ("paddle_tpu/kernels/fused_norm.py",
+                  "paddle_tpu/optimizer/adamw.py"):
+        assert any(fnmatch.fnmatch(probe, p) for p in DEFAULT_HOT_PATHS)
+
+
+def test_sharding_consistency_positive():
+    """Exactly 3 planted mismatches: unknown mesh axis in a spec, spec
+    rank > array rank, collective over an axis the enclosing shard_map
+    does not bind."""
+    res = run_rule("sharding_pos.py", "sharding-consistency")
+    found = only_rule(res, "sharding-consistency")
+    assert len(found) == 3, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "'tp'" in msgs
+    assert "3 entries" in msgs and "rank 2" in msgs
+    assert "only binds ['dp']" in msgs
+
+
+def test_sharding_consistency_negative():
+    res = run_rule("sharding_neg.py", "sharding-consistency")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_sharding_consistency_no_mesh_module_is_skipped(tmp_path):
+    """A module with NO visible mesh CONSTRUCTION never has its specs
+    checked — the axes are the caller's contract.  An ``axis_name=``
+    parameter default documents an axis but does not make the module the
+    mesh's home, so it must not defeat the skip."""
+    f = tmp_path / "specs_only.py"
+    f.write_text(
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "def spec_for(param):\n"
+        "    return P('anything', 'goes')\n\n"
+        "def allreduce(x, axis_name='dp'):\n"
+        "    return jax.lax.psum(x, axis_name)\n")
+    res = run_analysis([str(f)], root=str(tmp_path),
+                       rules=["sharding-consistency"])
+    assert res.findings == [], [x.format() for x in res.findings]
+
+
+# ------------------------------------- ISSUE 5: graftshape infrastructure
+
+def test_signature_table_registration():
+    """The documented API: a repo functional registered in the signature
+    table participates in shape/dtype propagation — its handler's return
+    value flows through the interpreted body."""
+    from paddle_tpu.tools.analysis.absint import Arr, Interpreter
+    from paddle_tpu.tools.analysis.signatures import (SIGNATURES,
+                                                      register_signature)
+    name = "zzq_fixture.fused_thing"
+    assert name not in SIGNATURES
+    register_signature(
+        name, lambda interp, rec: rec.args[0].with_(dtype="float32"))
+    try:
+        fn = ast.parse(
+            "def f(x):\n"
+            "    import zzq_fixture\n"
+            "    y = zzq_fixture.fused_thing(x)\n"
+            "    return y\n").body[0]
+        interp = Interpreter()
+        ret = interp.run(fn, {"x": Arr(traced=True)})
+        assert any(r.fname == name for r in interp.calls)
+        assert isinstance(ret, Arr) and ret.dtype == "float32" \
+            and ret.traced
+    finally:
+        del SIGNATURES[name]
+
+
+def test_signature_resolves_through_import_table():
+    """A registered repo functional keyed by its DEFINITION-SITE dotted
+    name is found even when the call site imports it bare — the
+    interpreter rewrites the root through the project import table."""
+    from paddle_tpu.tools.analysis.absint import Arr, Interpreter
+    from paddle_tpu.tools.analysis.project import build_project
+    from paddle_tpu.tools.analysis.signatures import (SIGNATURES,
+                                                      register_signature)
+    name = "pkgz.ops.fused_zzq"
+    register_signature(
+        name, lambda interp, rec: rec.args[0].with_(dtype="bfloat16"))
+    try:
+        ops = ast.parse("def fused_zzq(x):\n    return x\n")
+        user = ast.parse("from pkgz.ops import fused_zzq\n\n"
+                         "def f(x):\n    return fused_zzq(x)\n")
+        proj = build_project([("pkgz/ops.py", ops), ("user.py", user)])
+        interp = Interpreter(module_name="user", project=proj)
+        ret = interp.run(user.body[1], {"x": Arr(traced=True)})
+        assert isinstance(ret, Arr) and ret.dtype == "bfloat16" \
+            and ret.traced
+    finally:
+        del SIGNATURES[name]
+
+
+def test_repo_kernel_signatures_shipped():
+    """The in-tree registrations for the Pallas kernels exist under
+    their definition-site names."""
+    from paddle_tpu.tools.analysis.signatures import SIGNATURES
+    for key in ("paddle_tpu.kernels.flash_attention.flash_attention",
+                "paddle_tpu.kernels.flash_attention"
+                ".flash_attention_with_lse",
+                "paddle_tpu.kernels.fused_norm.fused_rms_norm_pallas"):
+        assert key in SIGNATURES, key
+
+
+def test_dotted_call_arg_layout():
+    """Dotted (non-method) jnp calls must read positional args with the
+    function-call layout — the receiver of ``jnp.reshape`` is the MODULE
+    (an unknown value, not None), so the method/function split keys on
+    the receiver being a known array."""
+    from paddle_tpu.tools.analysis.absint import Arr, Interpreter, Tup
+    fn = ast.parse(
+        "def f():\n"
+        "    import jax.numpy as jnp\n"
+        "    a = jnp.zeros((4, 8, 2), jnp.float32)\n"
+        "    b = jnp.reshape(a, (8, 4, 2))\n"
+        "    c = jnp.sum(a)\n"
+        "    d = jnp.swapaxes(a, 0, 1)\n"
+        "    return (b, c, d)\n").body[0]
+    ret = Interpreter().run(fn, {})
+    assert isinstance(ret, Tup)
+    b, c, d = ret.elts
+    assert b.shape == (8, 4, 2), b
+    assert c.shape == (), c            # full reduce, not axis=a
+    assert d.shape == (8, 4, 2), d
+
+
+def test_matmul_and_newaxis_shape_folding():
+    """1-D matmul operands follow @ semantics (no crash — a bad fold
+    here used to IndexError the whole lint run), and x[..., None]
+    appends the new axis instead of splicing it mid-shape."""
+    from paddle_tpu.tools.analysis.absint import Arr, Interpreter, Tup
+    fn = ast.parse(
+        "def f():\n"
+        "    import jax.numpy as jnp\n"
+        "    v = jnp.zeros((8,), jnp.float32)\n"
+        "    M = jnp.zeros((8, 4), jnp.float32)\n"
+        "    a = v @ M\n"
+        "    b = M.T @ v\n"
+        "    c = v @ v\n"
+        "    d = M[..., None]\n"
+        "    return (a, b, c, d)\n").body[0]
+    ret = Interpreter().run(fn, {})
+    assert isinstance(ret, Tup)
+    a, b, c, d = ret.elts
+    assert a.shape == (4,), a
+    assert b.shape == (4,), b
+    assert c.shape == (), c
+    assert d.shape == (8, 4, 1), d
+
+
+def test_abstract_interpreter_shape_and_dtype_propagation():
+    """Direct domain check: shapes fold through creation/reshape/matmul,
+    dtypes through astype, and traced-ness is viral."""
+    from paddle_tpu.tools.analysis.absint import Arr, Interpreter
+    fn = ast.parse(
+        "def f(x):\n"
+        "    import jax.numpy as jnp\n"
+        "    a = jnp.zeros((4, 8), jnp.float32)\n"
+        "    b = a.reshape(8, 4)\n"
+        "    c = a @ b\n"
+        "    d = c.astype(jnp.bfloat16)\n"
+        "    e = x + d\n"
+        "    return e\n").body[0]
+    interp = Interpreter()
+    ret = interp.run(fn, {"x": Arr(traced=True)})
+    assert isinstance(ret, Arr) and ret.traced
+    # c = (4,8) @ (8,4) -> (4,4) f32; the astype receiver proves the
+    # whole chain folded
+    cast = [r for r in interp.calls if r.leaf == "astype"][0]
+    assert isinstance(cast.recv, Arr) and cast.recv.shape == (4, 4)
+    assert cast.recv.dtype == "float32"
+
+
+def test_axis_name_module_constant_negative():
+    """AXIS = "tp" constants (local, re-exported, and dotted) resolve
+    through the project index to declared axes — no finding, where the
+    old carve-out skipped them blind."""
+    root = LINT / "axis_const_neg"
+    res = run_analysis([str(root)], root=str(root), rules=["axis-name"])
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_axis_name_bare_imported_constant_declares(tmp_path):
+    """A mesh built from a BARE from-imported constant (``from axes
+    import TP`` then ``Mesh(devs, (TP, "dp"))``) declares that axis —
+    declaration- and use-side resolution share the import chain."""
+    (tmp_path / "axes.py").write_text('TP = "tp"\n')
+    (tmp_path / "user.py").write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "from axes import TP\n\n"
+        "def build(devices):\n"
+        "    return Mesh(np.array(devices), (TP, 'dp'))\n\n"
+        "def allreduce(x):\n"
+        "    return jax.lax.psum(x, 'tp')\n")
+    res = run_analysis([str(tmp_path)], root=str(tmp_path),
+                       rules=["axis-name"])
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_axis_name_module_constant_positive():
+    """A constant naming an axis NO module declares fires — once for the
+    bare use, once more through a mixed ("literal", CONST) tuple, whose
+    declared half stays silent."""
+    root = LINT / "axis_const_pos"
+    res = run_analysis([str(root)], root=str(root), rules=["axis-name"])
+    found = only_rule(res, "axis-name")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    assert all("'ep'" in f.message for f in found)
+
+
 # ------------------------------------------------------------ suppression
 
 def test_suppression_with_reason_moves_finding_to_suppressed():
@@ -546,6 +823,42 @@ def test_sarif_output_schema_smoke():
         assert loc["artifactLocation"]["uri"].endswith("lifecycle_pos.py")
         assert loc["region"]["startLine"] >= 1
         assert loc["region"]["startColumn"] >= 1
+
+
+def test_sarif_covers_graftshape_rules():
+    """--sarif over the three graftshape fixture positives: structurally
+    valid SARIF 2.1.0 with all three rule ids and the exact planted
+    finding counts (5 + 5 + 3)."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftlint.py", "--sarif",
+         "--rule", "recompile-shape", "--rule", "dtype-flow",
+         "--rule", "sharding-consistency",
+         "tests/fixtures/lint/shape_recompile_pos.py",
+         "tests/fixtures/lint/dtype_flow_pos.py",
+         "tests/fixtures/lint/sharding_pos.py"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"recompile-shape", "dtype-flow",
+            "sharding-consistency"} <= rule_ids
+    live = [r for r in run["results"] if "suppressions" not in r]
+    by_rule = {}
+    for r in live:
+        by_rule.setdefault(r["ruleId"], []).append(r)
+        assert r["message"]["text"]
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+    assert len(by_rule["recompile-shape"]) == 5
+    assert len(by_rule["dtype-flow"]) == 5
+    assert len(by_rule["sharding-consistency"]) == 3
+    levels = {r["level"] for r in live}
+    assert levels == {"error", "warning"}   # dtype-flow warns, rest error
 
 
 def test_scan_performance_budget_with_warm_cache():
