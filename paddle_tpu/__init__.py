@@ -117,7 +117,7 @@ _LAZY = {"distributed", "vision", "io", "jit", "hapi", "metric", "incubate",
          "profiler", "static", "kernels", "text", "audio", "sparse",
          "inference", "device", "ops", "fft", "distribution",
          "signal", "regularizer", "utils", "onnx", "compat",
-         "quantization", "geometric", "hub", "serving"}
+         "quantization", "geometric", "hub", "serving", "obs"}
 
 
 def __getattr__(name):

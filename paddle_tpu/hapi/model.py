@@ -12,6 +12,7 @@ whole executor stack for — here it's jax.jit around functional_call.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -37,6 +38,25 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._rng = jax.random.key(np.random.randint(0, 2**31 - 1))
+        self._telemetry = None
+
+    @property
+    def telemetry(self):
+        """The model's ``obs.MetricsRegistry``: ``fit()`` records
+        ``train.step_s`` / ``train.examples_per_s`` histograms into it
+        (p50/p99 via ``.snapshot()``, Prometheus text via
+        ``.prometheus()``) — the same registry type the serving engine
+        uses, so one scrape surface covers training and serving.  Pass
+        nothing, share everything: assign a common registry to several
+        models to aggregate."""
+        if self._telemetry is None:
+            from ..obs import MetricsRegistry
+            self._telemetry = MetricsRegistry()
+        return self._telemetry
+
+    @telemetry.setter
+    def telemetry(self, registry):
+        self._telemetry = registry
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -139,6 +159,15 @@ class Model:
         cbks.set_params({"epochs": epochs, "verbose": verbose})
         cbks.on_train_begin()
         self.stop_training = False
+        # step-time/throughput telemetry — handles hoisted out of the
+        # loop; the float(loss) readback below already syncs each step,
+        # so the measured wall time covers real device work
+        h_step = self.telemetry.histogram(
+            "train.step_s", "fit() train step wall time (forward + "
+            "backward + update + loss readback)", unit="s")
+        h_tput = self.telemetry.histogram(
+            "train.examples_per_s", "examples/s per train step",
+            lo=1e-2, hi=1e8)
         for epoch in range(epochs):
             if hasattr(train_loader, "batch_sampler") and \
                     hasattr(train_loader.batch_sampler, "set_epoch"):
@@ -150,8 +179,14 @@ class Model:
             for step, batch in enumerate(train_loader):
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
+                bt0 = time.perf_counter()
                 loss, out = self.train_batch(inputs, labels)
-                logs = {"loss": float(loss)}
+                logs = {"loss": float(loss)}     # device sync
+                bdt = time.perf_counter() - bt0
+                h_step.observe(bdt)
+                shape = np.shape(inputs[0]) if inputs else ()
+                if shape and bdt > 0:
+                    h_tput.observe(shape[0] / bdt)
                 for m in self._metrics:
                     res = m.compute(np.asarray(out), np.asarray(labels[0]))
                     v = m.update(np.asarray(res))

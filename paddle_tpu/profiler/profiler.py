@@ -17,7 +17,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "export_protobuf", "RecordEvent",
-           "load_profiler_result"]
+           "load_profiler_result", "register_trace_source",
+           "unregister_trace_source"]
 
 
 class ProfilerState(enum.Enum):
@@ -64,6 +65,27 @@ class _HostTracer:
 
 
 _tracer = _HostTracer()
+
+# external chrome-event providers merged into every _export_chrome: each
+# source is a zero-arg callable returning catapult event dicts.  The obs
+# layer registers Tracer.chrome_events here so per-request lifecycle
+# lanes render alongside RecordEvent host phases and device activity.
+_trace_sources: List[Callable[[], List[dict]]] = []
+
+
+def register_trace_source(source: Callable[[], List[dict]]) -> None:
+    """Merge ``source()``'s chrome trace events into every later chrome
+    export (idempotent — registering the same callable twice is a no-op;
+    pair with :func:`unregister_trace_source` for bounded lifetimes)."""
+    if source not in _trace_sources:
+        _trace_sources.append(source)
+
+
+def unregister_trace_source(source: Callable[[], List[dict]]) -> None:
+    try:
+        _trace_sources.remove(source)
+    except ValueError:
+        pass
 
 
 class RecordEvent:
@@ -259,6 +281,13 @@ class Profiler:
             "dur": max(e.end_us - e.start_us, 1), "pid": os.getpid(),
             "tid": e.tid % 100000, "cat": "host",
         } for e in _tracer.events]
+        for source in list(_trace_sources):
+            # a broken provider must not take the whole export down —
+            # the host-event trace is still worth writing
+            try:
+                traceEvents.extend(source())
+            except Exception:
+                pass
         with open(path, "w") as f:
             json.dump({"traceEvents": traceEvents}, f)
 

@@ -8,8 +8,9 @@ built TPU-natively: a slot-pooled KV cache + shared-prefix block pool
 (prefix_cache), FCFS admission with pow2 prefill buckets, chunked
 prefill and a bounded head-of-line skip (scheduler), one compiled
 fixed-shape decode step with per-slot sampling (engine), a
-submit/step/stream surface (api), and off-hot-path serving metrics
-(metrics).  See docs/serving.md.
+submit/step/stream surface (api), and off-hot-path telemetry — metrics
+registry + request-lifecycle tracing via paddle_tpu.obs (metrics).
+See docs/serving.md and docs/observability.md.
 """
 
 from .api import Request, RequestOutput, SamplingParams, ServingEngine

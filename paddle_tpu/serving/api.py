@@ -81,7 +81,11 @@ class ServingEngine:
                  enable_prefix_cache: bool = True,
                  block_len: int = 16,
                  prefix_blocks: Optional[int] = None,
-                 record_events: bool = False):
+                 record_events: bool = False,
+                 registry=None, tracer=None):
+        # registry/tracer (paddle_tpu.obs) may be shared across engines
+        # (a fleet scraping one Prometheus surface: shared instruments
+        # aggregate, lanes come from per-engine blocks); default: private
         self.core = EngineCore(
             model, num_slots=num_slots, max_seq=max_seq,
             min_bucket=min_bucket,
@@ -90,7 +94,8 @@ class ServingEngine:
             max_prefill_tokens_per_step=max_prefill_tokens_per_step,
             enable_prefix_cache=enable_prefix_cache,
             block_len=block_len, prefix_blocks=prefix_blocks,
-            metrics=ServingMetrics(record_events=record_events))
+            metrics=ServingMetrics(record_events=record_events,
+                                   registry=registry, tracer=tracer))
         self._requests = {}
 
     # -------------------------------------------------------- submission
@@ -187,10 +192,34 @@ class ServingEngine:
     def metrics(self) -> ServingMetrics:
         return self.core.metrics
 
+    @property
+    def registry(self):
+        """The engine's ``obs.MetricsRegistry`` — full instrument dump
+        via ``.snapshot()``, Prometheus text via ``.prometheus()``."""
+        return self.core.metrics.registry
+
+    @property
+    def tracer(self):
+        """The engine's ``obs.Tracer`` — request-lifecycle spans and the
+        compile/eviction/skip event log; ``.chrome_events()`` exports
+        request lanes for ``profiler.export_chrome_tracing`` merges."""
+        return self.core.metrics.tracer
+
+    def close(self) -> None:
+        """Detach this engine's telemetry from process-global hooks (the
+        profiler chrome-export source ``record_events=True`` registered).
+        Long-lived processes that churn engines must close them, or every
+        later trace export merges the dead engines' lanes too."""
+        self.core.metrics.close()
+
     def metrics_dict(self) -> dict:
         out = self.core.metrics.snapshot()
         if self.core.prefix_cache is not None:
             # lifetime radix-cache state (block occupancy, evictions) —
             # unlike the engine counters these survive metrics.reset()
             out["prefix_cache"] = self.core.prefix_cache.stats()
+        # lifetime slot churn (KVPool free-list traffic) — same reset
+        # semantics as the prefix-cache block
+        out["slot_churn"] = {"allocs": self.core.pool.alloc_count,
+                             "frees": self.core.pool.free_count}
         return out

@@ -43,6 +43,7 @@ token, sampling included.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -206,6 +207,23 @@ class EngineCore:
         self._prefill_fn: Optional[Callable] = None
         self._staging_init_fn: Optional[Callable] = None
         self.trace_counts = {"prefill": 0, "decode": 0}
+        # telemetry plumbing: the step index keys every phase span, the
+        # compile baseline turns trace-counter ticks into discrete
+        # events, and the prefix cache reports evictions through a hook
+        self._step_index = 0
+        self._compile_seen: Dict[str, int] = {}
+        if self.prefix_cache is not None:
+            # evictions land on THIS engine's timeline lane, not the
+            # tracer's default lane 0 (another engine's, under sharing)
+            self.prefix_cache.on_event = functools.partial(
+                self.metrics.tracer.event, lane=self.metrics.engine_lane)
+
+    def _lane(self, req: Request) -> int:
+        """Tracer lane for one request's lifecycle spans (the engine's
+        own step-phase timeline sits on ``metrics.engine_lane``; lanes
+        are per-engine blocks, so engines sharing a tracer never
+        collide)."""
+        return self.metrics.request_lane(req.request_id)
 
     # ----------------------------------------------------------- prefill
     def _build_prefill_fn(self) -> Callable:
@@ -241,13 +259,18 @@ class EngineCore:
         the pinned radix path are returned to their pools if anything
         between claim and placement raises — admission failure must not
         bleed capacity (resource-lifecycle rule)."""
+        t_admit = time.perf_counter()
         slot = self.pool.alloc()
         match = None
         try:
             matched = 0
+            t_match0 = t_match1 = t_admit
             if self.prefix_cache is not None:
+                t_match0 = time.perf_counter()
                 match = self.prefix_cache.match(req.prompt)
                 matched = match.tokens
+                t_match1 = time.perf_counter()
+            t_gather0 = time.perf_counter()
             if matched:
                 ks, vs = self.prefix_cache.load_staging(match)
             else:
@@ -263,14 +286,30 @@ class EngineCore:
 
                     self._staging_init_fn = jax.jit(fresh_staging)
                 ks, vs = self._staging_init_fn()
+            t_gather1 = time.perf_counter()
             plan = self.scheduler.chunk_plan(matched, req.prompt_len,
                                              self.prefill_chunk)
             self.scheduler.place(req, slot)
-            # hit accounting only after placement: a failed admission is
-            # requeued and retried, and must not count its hit twice
+            # hit/telemetry accounting only after placement: a failed
+            # admission is requeued and retried, and must not count its
+            # hit (or record its lifecycle spans) twice
             if matched:
                 req.prefix_hit_tokens = matched
                 self.metrics.on_prefix_hit(matched)
+            req.admit_time = t_admit
+            self.metrics.on_queue_wait(t_admit - req.arrival_time)
+            self.metrics.on_gather(t_gather1 - t_gather0)
+            tracer = self.metrics.tracer
+            if tracer.enabled:
+                lane = self._lane(req)
+                tracer.set_lane_name(lane, f"request {req.request_id}")
+                tracer.add_span("queued", lane, req.arrival_time, t_admit,
+                                prompt_len=req.prompt_len)
+                if self.prefix_cache is not None:
+                    tracer.add_span("prefix_match", lane, t_match0,
+                                    t_match1, hit_tokens=matched)
+                tracer.add_span("gather", lane, t_gather0, t_gather1,
+                                hit=bool(matched))
             self._prefills.append(_Prefill(req, slot, ks, vs, plan, match))
         except BaseException:
             if match is not None:
@@ -283,15 +322,20 @@ class EngineCore:
         if self._prefill_fn is None:
             self._prefill_fn = self._build_prefill_fn()
         off, width, valid = st.plan[st.next_chunk]
+        t0 = time.perf_counter()
         ids = np.zeros((1, width), np.int32)
         ids[0, :valid] = np.asarray(st.req.prompt[off:off + valid],
                                     np.int32)
         last_logits, st.ks, st.vs = self._prefill_fn(
             st.ks, st.vs, jnp.asarray(ids),
             jnp.asarray(off, jnp.int32), jnp.asarray(valid, jnp.int32))
+        t1 = time.perf_counter()
         st.next_chunk += 1
         st.req.prefill_chunks += 1
-        self.metrics.on_prefill_chunk(valid)
+        self.metrics.on_prefill_chunk(valid, seconds=t1 - t0)
+        self.metrics.tracer.add_span(
+            "prefill_chunk", self._lane(st.req), t0, t1,
+            chunk=st.next_chunk - 1, width=width, tokens=valid)
         if st.done:
             st.last_logits = last_logits
 
@@ -373,9 +417,11 @@ class EngineCore:
         # a single allocation across the whole serving run
         return jax.jit(decode, donate_argnums=(0, 1))
 
-    def _decode_all_slots(self) -> np.ndarray:
+    def _decode_dispatch(self) -> jax.Array:
         """ONE fixed-shape decode step over every slot; returns the
-        sampled token per slot (the step's single host readback)."""
+        sampled token vector STILL ON DEVICE — the caller performs the
+        step's single host readback (step() times dispatch and readback
+        as separate timeline phases)."""
         if self._decode_fn is None:
             self._decode_fn = self._build_decode_fn()
         if self._sampling_dev is None:
@@ -388,20 +434,34 @@ class EngineCore:
             self._last_tok, self._keys, *self._sampling_dev)
         self.pool.ks, self.pool.vs, self.pool.seq_pos = ks, vs, pos
         self._last_tok = nxt
-        return np.asarray(nxt)
+        return nxt
 
     # -------------------------------------------------------- step loop
     def step(self) -> int:
         """One engine iteration: admit (radix match + staging), advance
         prefill chunks, one decode step over all active slots, harvest
         tokens / evict finished.  Returns the number of requests still
-        in flight (prefilling + running + queued)."""
+        in flight (prefilling + running + queued).
+
+        Telemetry rides the loop off the hot path: the step's phase
+        breakdown (admission / prefill / decode dispatch / readback)
+        lands as ``step.*`` spans on the engine lane + per-phase
+        histograms, and trace-counter deltas / head-of-line skips /
+        evictions become discrete events.  The per-slot token readback
+        stays the step's ONLY device sync."""
         t0 = time.perf_counter()
+        tracer = self.metrics.tracer
+        step_i = self._step_index
+        self._step_index += 1
+        skips_before = self.scheduler.total_head_skips
         ann = None
         if self.metrics.record_events:
             from ..profiler import RecordEvent
             ann = RecordEvent("serving.step")
             ann.begin()
+        sp = tracer.begin_span("serving.step",
+                               lane=self.metrics.engine_lane,
+                               step=step_i)
         try:
             admitted = self.scheduler.admit(
                 self.pool.free_slots,
@@ -418,30 +478,83 @@ class EngineCore:
                     self.scheduler.requeue_front(
                         [r for r, _ in admitted[i:]])
                     raise
+            t_admit = time.perf_counter()
             new_tokens = self._advance_prefills()
+            t_prefill = time.perf_counter()
+            phases = [("admission", t0, t_admit),
+                      ("prefill", t_admit, t_prefill)]
             if self._slots:
-                toks = self._decode_all_slots()
+                nxt = self._decode_dispatch()
+                t_decode = time.perf_counter()
+                toks = np.asarray(nxt)     # THE per-step device readback
+                t_readback = time.perf_counter()
                 for slot in sorted(self._slots):
                     new_tokens += self._harvest(slot, int(toks[slot]))
+                # decode phases exist only on steps that decoded — a
+                # prefill-only step must not feed 0.0 into their
+                # histograms and fake slices into the timeline
+                phases += [("decode_dispatch", t_prefill, t_decode),
+                           ("readback", t_decode, t_readback)]
             self._evict_finished()
         finally:
-            # a raised step must still close the trace annotation, or
-            # every later event nests inside a phantom serving.step
+            # a raised step must still close the span and the trace
+            # annotation, or every later event nests inside a phantom
+            # serving.step (resource-lifecycle rule: begin_span/end_span)
+            tracer.end_span(sp)
             if ann is not None:
                 ann.end()
+        self._record_events(step_i, skips_before)
         self.metrics.record_step(
             active_slots=len(self._slots), num_slots=self.num_slots,
             queue_depth=self.scheduler.queue_depth,
             new_tokens=new_tokens,
-            step_seconds=time.perf_counter() - t0)
+            step_seconds=time.perf_counter() - t0,
+            step_index=step_i,
+            phases=phases)
         return self.scheduler.active + self.scheduler.queue_depth
+
+    def _record_events(self, step_i: int, skips_before: int) -> None:
+        """Turn this step's discrete happenings into event-log entries:
+        trace-counter deltas = program compiles, scheduler skip-counter
+        delta = head-of-line jumps (prefix-cache evictions report
+        themselves through the ``on_event`` hook as they happen)."""
+        tracer = self.metrics.tracer
+        counts = dict(self.trace_counts)
+        if self.block_pool is not None:
+            counts.update({f"block_{k}": v
+                           for k, v in self.block_pool.trace_counts.items()})
+        for prog, n in counts.items():
+            seen = self._compile_seen.get(prog, 0)
+            if n > seen:
+                self.metrics.on_compile(prog, n - seen)
+                tracer.event("compile", lane=self.metrics.engine_lane,
+                             program=prog,
+                             count=n - seen, step=step_i)
+        self._compile_seen = counts
+        skips = self.scheduler.total_head_skips
+        if skips > skips_before:
+            tracer.event("head_of_line_skip",
+                         lane=self.metrics.engine_lane,
+                         count=skips - skips_before, step=step_i)
 
     def _emit(self, slot: int, tok: int, first_token: bool = False) -> None:
         req = self._slots[slot].req
         req.tokens.append(tok)
+        now = time.perf_counter()
         if first_token:
-            req.first_token_time = time.perf_counter()
-            self.metrics.on_first_token(req.arrival_time)
+            req.first_token_time = now
+            self.metrics.on_first_token(req.arrival_time, now=now)
+            tracer = self.metrics.tracer
+            if tracer.enabled:
+                lane = self._lane(req)
+                tracer.add_span("prefill", lane,
+                                req.admit_time or req.arrival_time, now,
+                                chunks=req.prefill_chunks,
+                                hit_tokens=req.prefix_hit_tokens)
+                tracer.event("first_token", lane=lane, t=now)
+        elif req.last_token_time is not None:
+            self.metrics.on_output_token(now - req.last_token_time)
+        req.last_token_time = now
         if req.stream is not None:
             req.stream(req, tok)
         eos = req.eos_token_id
@@ -461,7 +574,8 @@ class EngineCore:
     def _evict_finished(self) -> None:
         for slot in [s for s, st in self._slots.items() if st.req.finished]:
             req = self.scheduler.release(slot)
-            req.finish_time = time.perf_counter()
+            now = time.perf_counter()
+            req.finish_time = now
             if self._slots[slot].match is not None:
                 # unpin the request's radix path — its blocks become
                 # LRU-evictable again
@@ -471,6 +585,19 @@ class EngineCore:
             self._do_sample[slot] = False
             self._sampling_dev = None
             self.metrics.on_finish()
+            tracer = self.metrics.tracer
+            if tracer.enabled:
+                lane = self._lane(req)
+                first = req.first_token_time or now
+                tracer.add_span("decode", lane, first, now,
+                                tokens=len(req.tokens))
+                tracer.add_span("request", lane, req.arrival_time, now,
+                                tokens=len(req.tokens),
+                                finish_reason=req.finish_reason)
+                tracer.event("slot_release",
+                             lane=self.metrics.engine_lane, t=now,
+                             slot=slot, request=req.request_id,
+                             reason=req.finish_reason)
 
     # ----------------------------------------------------- conveniences
     def run_until_complete(self, max_steps: Optional[int] = None) -> int:

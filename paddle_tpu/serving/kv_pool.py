@@ -61,6 +61,10 @@ class KVPool:
                                     for _ in range(num_layers)]
         self.seq_pos = jnp.zeros((num_slots,), jnp.int32)
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        # lifetime slot-churn counters (telemetry: metrics_dict reports
+        # them; high churn relative to finished requests = thrashing)
+        self.alloc_count = 0
+        self.free_count = 0
 
     @classmethod
     def create(cls, model, num_slots: int,
@@ -88,6 +92,7 @@ class KVPool:
         admission on ``free_slots``."""
         if not self._free:
             raise RuntimeError("KVPool exhausted: no free slot")
+        self.alloc_count += 1
         return self._free.pop()
 
     def free(self, slot: int) -> None:
@@ -95,6 +100,7 @@ class KVPool:
             raise ValueError(f"slot {slot} out of range")
         if slot in self._free:
             raise ValueError(f"slot {slot} already free (double free)")
+        self.free_count += 1
         self._free.append(slot)
         self._free.sort(reverse=True)
         # park the freed row at position 0 so its ride-along decode writes

@@ -1,120 +1,359 @@
-"""Serving counters, updated by the engine OFF the hot path.
+"""Serving telemetry facade, updated by the engine OFF the hot path.
 
-Every update is a host-side float/int op on values the engine already
-holds (no extra device syncs: the engine's single per-step token readback
-feeds everything).  Exposed as a plain dict (``snapshot()``) and logged
-through the profiler's host-event tree: with ``record_events=True`` the
-engine wraps each step's prefill/decode phases in
-``profiler.RecordEvent`` annotations, so ``export_chrome_tracing``
-timelines show the serving loop alongside device activity.
+Rebased onto ``paddle_tpu.obs``: every counter/gauge/histogram lives in
+an :class:`~paddle_tpu.obs.MetricsRegistry` (Prometheus text exposition,
+JSON snapshot, windowed rates) and every request carries a lifecycle
+span trace in a ring-buffered :class:`~paddle_tpu.obs.Tracer` — while
+``snapshot()`` keeps the exact dict shape earlier rounds shipped, plus
+p50/p99 TTFT and TPOT from the new log-bucketed histograms.
 
-Glossary (docs/serving.md has the full definitions):
-  * ttft            — submit -> first generated token, per request;
-  * tokens/s        — generated tokens over the engine's busy wall time;
-  * queue depth     — waiting requests at each step;
-  * slot occupancy  — occupied/total slots at each step;
-  * batch fill      — mean occupancy over steps: the fraction of the
-    fixed-shape decode batch doing useful work (THE continuous-batching
-    payoff metric — static batching idles slots that finished early).
+Every update is a host-side op on values the engine already holds (no
+extra device syncs: the engine's single per-step token readback feeds
+everything — pinned by tests/test_observability.py).  With
+``record_events=True`` the engine additionally wraps each step in a
+``profiler.RecordEvent`` and the tracer's request lanes merge into
+``profiler.export_chrome_tracing`` output.
+
+CLOCK BASE: all timestamps entering this class MUST be
+``time.perf_counter()`` readings — ``Scheduler.submit`` stamps
+``Request.arrival_time`` from that clock and :meth:`on_first_token`
+rejects arrivals from any other base (a ``time.time()`` arrival used to
+silently corrupt the TTFT mean; now it raises).
+
+The metric glossary lives in docs/observability.md.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..obs import Histogram, MetricsRegistry, Tracer
 
 __all__ = ["ServingMetrics"]
 
 
 class ServingMetrics:
-    def __init__(self, record_events: bool = False):
-        # record_events=True wraps each step in a profiler.RecordEvent so
-        # host traces (profiler.export_chrome_tracing) carry serving steps
+    def __init__(self, record_events: bool = False,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
+        # record_events=True wraps each step in a profiler.RecordEvent
+        # AND merges the tracer's request lanes into chrome exports
         self.record_events = record_events
-        self.reset()
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else Tracer()
+        # disjoint lane block per engine: the step timeline sits on
+        # engine_lane, request r on engine_lane + 1 + r — two engines
+        # sharing one tracer never collide on a lane
+        self.engine_lane = self.tracer.claim_lane_block()
+        self.tracer.set_lane_name(self.engine_lane, "serving.engine",
+                                  pin=True)
+        if record_events:
+            self.tracer.install_profiler_source()
+        self._bind()
 
-    def reset(self) -> None:
-        self.requests_submitted = 0
-        self.requests_finished = 0
-        self.tokens_generated = 0
-        self.prefills = 0
-        self.prefill_tokens = 0
-        self.prefill_chunks = 0
-        self.prefill_chunk_tokens = 0
-        self.prefix_hits = 0
-        self.prefix_hit_tokens = 0
-        self.steps = 0
+    def close(self) -> None:
+        """Detach from the profiler's chrome-export source list (the one
+        global this object registers into).  Long-lived processes that
+        churn ``record_events=True`` engines MUST close them, or every
+        later export merges the dead engines' lanes too.  Only balances
+        what __init__ installed — a record_events=False engine's close
+        must not decrement a shared tracer's refcount for its peers."""
+        if self.record_events:
+            self.record_events = False      # idempotent: one remove
+            self.tracer.remove_profiler_source()
+
+    def request_lane(self, request_id: int) -> int:
+        """Tracer lane for one request, folded into this engine's lane
+        block (ids are unbounded; lanes wrap inside the block so they
+        can never walk into a neighbour engine's reservation — the span
+        ring is far smaller than the block, so a wrapped lane's previous
+        tenant has long been evicted)."""
+        return self.engine_lane + 1 + request_id % (Tracer.LANE_BLOCK - 1)
+
+    def _bind(self) -> None:
+        """Get-or-create this engine's instruments in the registry.
+        Binding never zeroes anything — constructing a second engine
+        onto a SHARED registry/tracer must not wipe the first one's
+        accumulated data (the instruments are then shared and both
+        engines aggregate into them).  Everything bound here lands in
+        ``self._own`` — the single list reset() iterates, so a new
+        instrument can never be forgotten by reset."""
+        reg = self.registry
+        self._own = []
+        own = self._own.append
+
+        def c(*a, **kw):
+            inst = reg.counter(*a, **kw)
+            own(inst)
+            return inst
+
+        def h(*a, **kw):
+            inst = reg.histogram(*a, **kw)
+            own(inst)
+            return inst
+
+        def g(*a, **kw):
+            inst = reg.gauge(*a, **kw)
+            own(inst)
+            return inst
+
+        self._c_submitted = c("serving.requests_submitted",
+                              "requests accepted by submit()")
+        self._c_finished = c("serving.requests_finished",
+                             "requests that reached eos/length")
+        self._c_tokens = c("serving.tokens_generated",
+                           "output tokens harvested")
+        self._c_prefills = c("serving.prefills",
+                             "completed request prefills")
+        self._c_prefill_tokens = c("serving.prefill_tokens",
+                                   "prompt tokens actually prefilled "
+                                   "(uncached suffixes)")
+        self._c_prefill_chunks = c("serving.prefill_chunks",
+                                   "prefill chunk programs dispatched")
+        self._c_prefill_chunk_tokens = c("serving.prefill_chunk_tokens",
+                                         "real tokens covered by chunks")
+        self._c_prefix_hits = c("serving.prefix_hits",
+                                "admissions with a radix-cache match")
+        self._c_prefix_hit_tokens = c("serving.prefix_hit_tokens",
+                                      "prompt tokens served from cache")
+        self._c_steps = c("serving.steps", "engine step() iterations")
+        self._c_compiles = c("serving.compiles",
+                             "program (re)traces seen by trace counters")
+        self._h_ttft = h("serving.ttft_s",
+                         "submit -> first generated token", unit="s")
+        self._h_tpot = h("serving.tpot_s",
+                         "per-output-token latency after the first",
+                         unit="s")
+        self._h_step = h("serving.step_s", "engine step wall time",
+                         unit="s")
+        self._h_chunk = h("serving.prefill_chunk_s",
+                          "prefill chunk dispatch wall time", unit="s")
+        self._h_queue_wait = h("serving.queue_wait_s",
+                               "submit -> admission", unit="s")
+        self._h_gather = h("serving.gather_s",
+                           "prefix block gather / staging init", unit="s")
+        self._g_queue_depth = g("serving.queue_depth",
+                                "waiting requests at the last step")
+        self._g_occupancy = g("serving.slot_occupancy",
+                              "occupied/total slots at the last step")
+        self._phase_h: Dict[str, Histogram] = {}
+        self._zero_local()
+
+    def _zero_local(self) -> None:
+        # per-ENGINE tallies feeding the derived rates: with a shared
+        # registry the counters aggregate the whole fleet, so dividing
+        # them by this engine's busy time would inflate every rate —
+        # rates and ratios always describe THIS engine
         self._busy_s = 0.0
-        self._ttfts: List[float] = []
         self._queue_depth_sum = 0
         self._occupancy_sum = 0.0
+        self._tokens_local = 0
+        self._steps_local = 0
+
+    def reset(self) -> None:
+        """Zero THIS engine's instruments and drop the tracer's recorded
+        spans/events (fresh measurement window — bench warmup vs
+        measure).  Only the serving instruments bound here reset; other
+        producers' metrics in a shared registry (a trainer's ``train.*``
+        histograms) are untouched.  A shared TRACER's ring is one buffer,
+        so its clear does drop every producer's spans — give each engine
+        its own tracer when traces must survive a neighbour's reset."""
+        for inst in (*self._own, *self._phase_h.values()):
+            inst.reset()
+        self.tracer.clear()
+        self._zero_local()
 
     # ------------------------------------------------------------ events
     def on_submit(self, n: int = 1) -> None:
-        self.requests_submitted += n
+        self._c_submitted.inc(n)
 
     def on_prefill(self, prompt_len: int) -> None:
         """One request's prefill completed; ``prompt_len`` counts only
         the tokens the model actually ran (the uncached suffix) — the
         FLOPs-saved story is ``prefix_hit_tokens`` vs this."""
-        self.prefills += 1
-        self.prefill_tokens += prompt_len
+        self._c_prefills.inc()
+        self._c_prefill_tokens.inc(prompt_len)
 
-    def on_prefill_chunk(self, tokens: int) -> None:
+    def on_prefill_chunk(self, tokens: int,
+                         seconds: Optional[float] = None) -> None:
         """One chunk program dispatched, covering ``tokens`` real (non-
-        padding) prompt tokens."""
-        self.prefill_chunks += 1
-        self.prefill_chunk_tokens += tokens
+        padding) prompt tokens over ``seconds`` of host dispatch time."""
+        self._c_prefill_chunks.inc()
+        self._c_prefill_chunk_tokens.inc(tokens)
+        if seconds is not None:
+            self._h_chunk.observe(seconds)
 
     def on_prefix_hit(self, tokens: int) -> None:
         """Admission matched ``tokens`` prompt tokens in the radix cache
         (their KV was copied, not recomputed)."""
-        self.prefix_hits += 1
-        self.prefix_hit_tokens += tokens
+        self._c_prefix_hits.inc()
+        self._c_prefix_hit_tokens.inc(tokens)
 
-    def on_first_token(self, arrival_time: float) -> None:
-        self._ttfts.append(time.perf_counter() - arrival_time)
+    def on_queue_wait(self, seconds: float) -> None:
+        self._h_queue_wait.observe(seconds)
+
+    def on_gather(self, seconds: float) -> None:
+        self._h_gather.observe(seconds)
+
+    def on_compile(self, program: str, n: int = 1) -> None:
+        self._c_compiles.inc(n)
+
+    def on_first_token(self, arrival_t: float,
+                       now: Optional[float] = None) -> None:
+        """Record one TTFT sample.  ``arrival_t`` MUST be a
+        ``time.perf_counter()`` reading (``Request.arrival_time`` as
+        ``Scheduler.submit`` stamps it).  A ``time.time()`` arrival sits
+        decades ahead of the perf_counter epoch, so the mismatch is
+        detected and raised instead of silently feeding a garbage mean
+        (the pre-obs bug this signature change fixes)."""
+        if now is None:
+            now = time.perf_counter()
+        ttft = now - arrival_t
+        if ttft < 0:
+            raise ValueError(
+                f"on_first_token: arrival_t {arrival_t!r} is ahead of "
+                f"perf_counter now {now!r} — arrival timestamps must be "
+                f"time.perf_counter() readings, not time.time() (mixed "
+                f"clock bases corrupt TTFT)")
+        self._h_ttft.observe(ttft)
+
+    def on_output_token(self, seconds: float) -> None:
+        """One decode token's latency since the request's previous
+        token (TPOT — the steady-state per-token serving cost)."""
+        self._h_tpot.observe(seconds)
 
     def on_finish(self, n: int = 1) -> None:
-        self.requests_finished += n
+        self._c_finished.inc(n)
 
     def record_step(self, active_slots: int, num_slots: int,
                     queue_depth: int, new_tokens: int,
-                    step_seconds: float) -> None:
+                    step_seconds: float, step_index: int = 0,
+                    phases: Optional[Sequence[Tuple[str, float, float]]]
+                    = None) -> None:
         """One engine step's accounting (called after the token harvest —
-        never between device dispatches)."""
-        self.steps += 1
-        self.tokens_generated += new_tokens
+        never between device dispatches).  ``phases`` is the step's
+        timeline breakdown as ``(name, start, end)`` perf_counter
+        triples; each lands in a ``serving.phase.<name>_s`` histogram
+        and as a ``step.<name>`` span on the engine lane."""
+        occupancy = active_slots / max(num_slots, 1)
+        self._c_steps.inc()
+        self._c_tokens.inc(new_tokens)
         self._busy_s += step_seconds
         self._queue_depth_sum += queue_depth
-        self._occupancy_sum += active_slots / max(num_slots, 1)
+        self._occupancy_sum += occupancy
+        self._tokens_local += new_tokens
+        self._steps_local += 1
+        self._g_queue_depth.set(queue_depth)
+        self._g_occupancy.set(occupancy)
+        self._h_step.observe(step_seconds)
+        if phases:
+            for name, start, end in phases:
+                hp = self._phase_h.get(name)
+                if hp is None:
+                    hp = self.registry.histogram(
+                        f"serving.phase.{name}_s",
+                        f"step phase: {name}", unit="s")
+                    self._phase_h[name] = hp
+                hp.observe(end - start)
+                self.tracer.add_span(f"step.{name}", self.engine_lane,
+                                     start, end, step=step_index)
 
-    # ---------------------------------------------------------- snapshot
+    # --------------------------------------------------------- counters
+    # lifetime counts read as plain ints (the pre-registry attribute API)
+    @property
+    def requests_submitted(self) -> int:
+        return self._c_submitted.value
+
+    @property
+    def requests_finished(self) -> int:
+        return self._c_finished.value
+
+    @property
+    def tokens_generated(self) -> int:
+        return self._c_tokens.value
+
+    @property
+    def prefills(self) -> int:
+        return self._c_prefills.value
+
+    @property
+    def prefill_tokens(self) -> int:
+        return self._c_prefill_tokens.value
+
+    @property
+    def prefill_chunks(self) -> int:
+        return self._c_prefill_chunks.value
+
+    @property
+    def prefill_chunk_tokens(self) -> int:
+        return self._c_prefill_chunk_tokens.value
+
+    @property
+    def prefix_hits(self) -> int:
+        return self._c_prefix_hits.value
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return self._c_prefix_hit_tokens.value
+
+    @property
+    def steps(self) -> int:
+        return self._c_steps.value
+
+    # ---------------------------------------------------------- derived
     @property
     def mean_ttft_ms(self) -> Optional[float]:
-        if not self._ttfts:
-            return None
-        return 1e3 * sum(self._ttfts) / len(self._ttfts)
+        m = self._h_ttft.mean
+        return None if m is None else 1e3 * m
 
+    def _q_ms(self, hist: Histogram, q: float) -> Optional[float]:
+        v = hist.quantile(q)
+        return None if v is None else 1e3 * v
+
+    @property
+    def ttft_p50_ms(self) -> Optional[float]:
+        return self._q_ms(self._h_ttft, 0.50)
+
+    @property
+    def ttft_p99_ms(self) -> Optional[float]:
+        return self._q_ms(self._h_ttft, 0.99)
+
+    @property
+    def tpot_p50_ms(self) -> Optional[float]:
+        return self._q_ms(self._h_tpot, 0.50)
+
+    @property
+    def tpot_p99_ms(self) -> Optional[float]:
+        return self._q_ms(self._h_tpot, 0.99)
+
+    # rates/ratios divide per-engine tallies by per-engine denominators:
+    # under a shared registry the counter properties above aggregate the
+    # fleet, and mixing the two would inflate every derived value
     @property
     def tokens_per_sec(self) -> Optional[float]:
         if self._busy_s <= 0:
             return None
-        return self.tokens_generated / self._busy_s
+        return self._tokens_local / self._busy_s
 
     @property
     def batch_fill_ratio(self) -> Optional[float]:
-        if self.steps == 0:
+        if self._steps_local == 0:
             return None
-        return self._occupancy_sum / self.steps
+        return self._occupancy_sum / self._steps_local
 
     @property
     def mean_queue_depth(self) -> Optional[float]:
-        if self.steps == 0:
+        if self._steps_local == 0:
             return None
-        return self._queue_depth_sum / self.steps
+        return self._queue_depth_sum / self._steps_local
 
+    # ---------------------------------------------------------- snapshot
     def snapshot(self) -> Dict[str, object]:
+        """The engine-counter dict earlier rounds shipped, extended with
+        the histogram quantiles (keys only ever ADD — consumers pin on
+        key presence).  The full instrument dump (every histogram's
+        count/sum/p50/p90/p99) is ``self.registry.snapshot()``."""
         r = lambda v, nd=4: None if v is None else round(v, nd)
         return {
             "requests_submitted": self.requests_submitted,
@@ -129,6 +368,10 @@ class ServingMetrics:
             "steps": self.steps,
             "tokens_per_sec": r(self.tokens_per_sec, 1),
             "mean_ttft_ms": r(self.mean_ttft_ms, 2),
+            "ttft_p50_ms": r(self.ttft_p50_ms, 2),
+            "ttft_p99_ms": r(self.ttft_p99_ms, 2),
+            "tpot_p50_ms": r(self.tpot_p50_ms, 3),
+            "tpot_p99_ms": r(self.tpot_p99_ms, 3),
             "batch_fill_ratio": r(self.batch_fill_ratio),
             "mean_queue_depth": r(self.mean_queue_depth, 2),
         }

@@ -85,6 +85,9 @@ class PrefixCache:
         self.max_match_blocks = pool.blocks_per_row
         self.root = _Node(None, None, None)
         self._tick = 0
+        # optional event sink ``fn(name, **attrs)`` — the engine points
+        # this at its tracer so LRU evictions land in the event log
+        self.on_event = None
         # observability (engine merges these into its metrics snapshot)
         self.hits = 0
         self.misses = 0
@@ -239,6 +242,9 @@ class PrefixCache:
         del node.parent.children[node.key]
         self.pool.free(node.block)
         self.evictions += 1
+        if self.on_event is not None:
+            self.on_event("prefix_evict", block=node.block,
+                          last_use=node.last_use)
 
     # ------------------------------------------------------------- state
     @property

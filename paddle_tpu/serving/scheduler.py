@@ -85,6 +85,8 @@ class Request:
     finish_reason: Optional[str] = None      # "eos" | "length"
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    admit_time: Optional[float] = None       # queue exit (telemetry)
+    last_token_time: Optional[float] = None  # previous emit (TPOT)
     prefix_hit_tokens: int = 0               # prompt tokens served from
     prefill_chunks: int = 0                  # the radix cache / chunks run
 
@@ -125,6 +127,9 @@ class Scheduler:
         self.skip_window = skip_window
         self.max_head_skips = max_head_skips
         self._head_skips = 0
+        # lifetime jump count (never reset by a head admission) — the
+        # engine turns per-step deltas into head_of_line_skip events
+        self.total_head_skips = 0
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> request
         self._ids = itertools.count()
@@ -232,6 +237,7 @@ class Scheduler:
                 self._head_skips = 0
             else:
                 self._head_skips += 1
+                self.total_head_skips += 1
             req = self.waiting[pick]
             del self.waiting[pick]
             budget -= pick_cost

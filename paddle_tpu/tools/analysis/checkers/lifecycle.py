@@ -70,6 +70,16 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
     # prefix_cache.PrefixCache.match pins the radix path until release
     ResourcePair("match", "release", "radix prefix pin",
                  receiver_hint=("cache",)),
+    # obs.Tracer spans (paddle_tpu/obs/tracing.py): a begun span must be
+    # ended on exception edges too, or every later span nests inside a
+    # phantom (the engine's serving.step pattern — end_span in finally)
+    ResourcePair("begin_span", "end_span", "trace span",
+                 receiver_hint=("tracer", "obs")),
+    # obs.Tracer capture sessions: an enable without a guaranteed
+    # disable leaves a tracer recording (and its profiler source live)
+    # after the workload raised
+    ResourcePair("enable", "disable", "tracer capture",
+                 receiver_hint=("tracer",)),
 )
 
 _ACQ, _REL = "acq", "rel"

@@ -38,6 +38,9 @@ _NN_LAYER_PATH = "exercised through its nn.Layer wrapper in layer tests"
 _SPECIALIZED = "specialized op with dedicated tests outside the registry harness"
 _SERVING = ("serving control-plane API (request lifecycle / scheduling / "
             "metrics), not an array op; covered by tests/test_serving.py")
+_OBS = ("observability control-plane (metrics registry / spans / event "
+        "log), pure host code with no array inputs; covered by "
+        "tests/test_observability.py")
 
 ALLOWLIST: Dict[str, str] = {
     # ---- stochastic samplers (tensor/random.py + dropout family)
@@ -142,6 +145,14 @@ ALLOWLIST: Dict[str, str] = {
         "bucket_length", "sample_rows", "BlockPool", "PrefixCache",
         "MatchResult",
     )},
+    # ---- paddle_tpu.obs public surface (the OBS registry surface:
+    #      counters/gauges/histograms and the span tracer are telemetry
+    #      plumbing with no numeric oracle; tests/test_observability.py
+    #      is their contract)
+    **{n: _OBS for n in (
+        "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span",
+        "Tracer",
+    )},
 }
 
 
@@ -159,6 +170,7 @@ class RegistryDriftChecker(Checker):
             "T": "paddle_tpu/tensor",
             "F": "paddle_tpu/nn/functional",
             "SRV": "paddle_tpu/serving",
+            "OBS": "paddle_tpu/obs",
         }
         self.allowlist = ALLOWLIST if allowlist is None else allowlist
 

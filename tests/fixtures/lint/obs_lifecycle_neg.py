@@ -1,0 +1,30 @@
+"""resource-lifecycle negatives for the obs pairs — every span/capture
+closes on all paths (or has no raise window), so zero findings."""
+
+
+def span_closed_on_every_path(tracer, payload):
+    sp = tracer.begin_span("prefill")
+    try:
+        transform(payload)
+    finally:
+        tracer.end_span(sp)
+
+
+def capture_closed_on_every_path(tracer, batch):
+    tracer.enable()
+    try:
+        run_workload(batch)
+    finally:
+        tracer.disable()
+
+
+def span_without_raise_window(tracer):
+    sp = tracer.begin_span("noop")
+    tracer.end_span(sp)
+
+
+def span_from_untracked_receiver(widget, payload):
+    # receiver_hint: a non-tracer receiver's begin_span is not tracked
+    sp = widget.begin_span("other")
+    transform(payload)
+    return sp

@@ -1,0 +1,20 @@
+"""resource-lifecycle positives for the obs pairs (begin_span/end_span,
+enable/disable) — 3 planted leaks, each caught by the registered
+ResourcePairs (receiver_hint requires a tracer-ish receiver)."""
+
+
+def span_leaks_on_exception(tracer, payload):
+    sp = tracer.begin_span("prefill")        # POS 1: transform() can
+    transform(payload)                       # raise before the end_span
+    tracer.end_span(sp)
+
+
+def span_never_ended(tracer):
+    sp = tracer.begin_span("decode")         # POS 2: plain leak — no
+    return 1                                 # end_span on any path
+
+
+def capture_leaks_on_exception(tracer, batch):
+    tracer.enable()                          # POS 3: run_workload() can
+    run_workload(batch)                      # raise before the disable
+    tracer.disable()

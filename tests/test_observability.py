@@ -1,0 +1,564 @@
+"""Telemetry layer (paddle_tpu.obs + serving/profiler/hapi wiring).
+
+The load-bearing contracts (ISSUE 6):
+  * a mixed-arrival serving run yields a per-request span tree
+    (queued -> admitted -> prefix-match -> gather -> prefill chunk xN ->
+    first-token -> decode -> finish) with monotonic timestamps;
+  * p50/p99 TTFT and TPOT from the log-bucketed histograms track the
+    exact per-request values;
+  * chrome-trace export is valid JSON with request lanes merged next to
+    the profiler's RecordEvent host events, nesting intact;
+  * HARD CONSTRAINTS: telemetry adds ZERO device syncs (the per-step
+    token readback stays the only one) and costs <3% of step wall time;
+    memory is bounded (ring-buffered spans, fixed histogram buckets);
+  * the obs layer is pure host code — it never imports jax.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.obs import Histogram, MetricsRegistry, Tracer
+from paddle_tpu.serving import ServingEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    with jax.default_prng_impl("rbg"):
+        return GPTForCausalLM(gpt_tiny())
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _mixed_run(eng, seed=3, n=6, new=5):
+    """Staggered mixed-length workload; returns outputs in submit order."""
+    prompts = _prompts(seed, [3 + (i * 7) % 17 for i in range(n)])
+    ids = [eng.submit(p, max_new_tokens=new) for p in prompts[:n // 2]]
+    for _ in range(2):
+        eng.step()
+    ids += [eng.submit(p, max_new_tokens=new) for p in prompts[n // 2:]]
+    eng.run_until_complete(max_steps=5000)
+    return [eng.result(i) for i in ids]
+
+
+# --------------------------------------------------- obs unit: histogram
+
+def test_histogram_quantiles_track_exact_values():
+    h = Histogram("t", lo=1e-5, hi=1e2)
+    rs = np.random.RandomState(0)
+    xs = np.exp(rs.normal(np.log(0.02), 0.8, size=2000))   # lognormal
+    for x in xs:
+        h.observe(float(x))
+    for q in (0.5, 0.9, 0.99):
+        exact = float(np.percentile(xs, 100 * q))
+        est = h.quantile(q)
+        # one log bucket is ~26% wide; interpolation keeps us inside it
+        assert abs(est - exact) <= 0.30 * exact, (q, est, exact)
+    assert h.quantile(0.0) == pytest.approx(float(xs.min()), rel=0.3)
+    assert h.quantile(1.0) == pytest.approx(float(xs.max()), rel=1e-6)
+    assert h.count == 2000 and h.mean == pytest.approx(float(xs.mean()))
+
+
+def test_histogram_bounded_memory_and_edge_cases():
+    h = Histogram("t", lo=1e-3, hi=1.0, per_decade=5)
+    n_buckets = len(h._counts)
+    for v in (0.0, -1.0, 1e-9, 5.0, 1e9):    # under/overflow both land
+        h.observe(v)
+    assert len(h._counts) == n_buckets        # fixed storage, always
+    assert h.count == 5
+    assert h.quantile(1.0) == 1e9
+    assert h.quantile(0.5) is not None
+    empty = Histogram("e")
+    assert empty.quantile(0.5) is None and empty.mean is None
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    with pytest.raises(ValueError):
+        Histogram("bad", lo=1.0, hi=0.5)
+
+
+def test_counter_windowed_rate_and_gauge():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs")
+    now = time.perf_counter()
+    for _ in range(30):
+        c.inc()
+    assert c.value == 30
+    assert c.rate(window_s=60.0, now=now + 1) == pytest.approx(0.5)
+    assert c.rate(window_s=1.0, now=now + 100) == 0.0   # aged out
+    g = reg.gauge("depth")
+    g.set(7)
+    assert g.value == 7.0
+    reg.reset()
+    assert c.value == 0 and g.value == 0.0
+
+
+def test_registry_get_or_create_and_kind_clash():
+    reg = MetricsRegistry()
+    assert reg.counter("a") is reg.counter("a")
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+    snap = reg.snapshot()
+    assert snap == {"a": 0}
+
+
+def test_registry_prometheus_exposition():
+    reg = MetricsRegistry()
+    reg.counter("serving.requests", "total requests").inc(3)
+    h = reg.histogram("serving.ttft_s", "ttft", unit="s")
+    for v in (0.01, 0.02, 5.0):
+        h.observe(v)
+    text = reg.prometheus()
+    lines = text.strip().splitlines()
+    assert "# TYPE serving_requests counter" in lines
+    assert "serving_requests 3" in lines
+    assert "# TYPE serving_ttft_s histogram" in lines
+    assert "serving_ttft_s_count 3" in lines
+    # cumulative buckets end at +Inf == count
+    assert 'serving_ttft_s_bucket{le="+Inf"} 3' in lines
+    buckets = [int(l.rsplit(" ", 1)[1]) for l in lines
+               if l.startswith("serving_ttft_s_bucket")]
+    assert buckets == sorted(buckets)         # cumulative = monotone
+
+
+# ------------------------------------------------------ obs unit: tracer
+
+def test_tracer_ring_bounded_and_span_api():
+    tr = Tracer(max_spans=8, max_events=4)
+    sp = tr.begin_span("a", lane=1, k=2)
+    assert sp.attrs == {"k": 2}
+    tr.end_span(sp)
+    assert tr.spans(lane=1)[0].duration >= 0
+    for i in range(50):
+        tr.add_span("s", 0, float(i), float(i) + 0.5)
+        tr.event("e", step=i)
+    assert len(tr.spans()) == 8 and len(tr.events()) == 4
+    tr.disable()
+    assert tr.begin_span("x") is None
+    tr.end_span(None)                          # no-op by contract
+    tr.add_span("x", 0, 0.0, 1.0)
+    assert len(tr.spans(name="x")) == 0
+    tr.enable()
+    tr.clear()
+    assert tr.spans() == [] and tr.events() == []
+
+
+def test_obs_layer_never_imports_jax():
+    """The telemetry layer is pure host code: no jax import means no
+    accidental device op can ever hide in a metrics update."""
+    obs_dir = os.path.join(REPO, "paddle_tpu", "obs")
+    for fn in os.listdir(obs_dir):
+        if fn.endswith(".py"):
+            src = open(os.path.join(obs_dir, fn)).read()
+            assert "import jax" not in src, fn
+
+
+# ------------------------------------------------- serving: span lifecycle
+
+def test_request_span_tree_monotonic(gpt):
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8)
+    outs = _mixed_run(eng)
+    assert all(o.finished for o in outs)
+    tr = eng.tracer
+    for o in outs:
+        lane = 1 + o.request_id
+        spans = {s.name: s for s in tr.spans(lane=lane)}
+        for name in ("queued", "prefix_match", "gather", "prefill",
+                     "decode", "request"):
+            assert name in spans, (o.request_id, sorted(spans))
+        q, pm, g = spans["queued"], spans["prefix_match"], spans["gather"]
+        pf, dec, req = spans["prefill"], spans["decode"], spans["request"]
+        chunks = tr.spans(lane=lane, name="prefill_chunk")
+        assert len(chunks) >= 1
+        # lifecycle ordering, every timestamp monotone
+        assert q.start <= q.end <= pm.start <= pm.end <= g.start <= g.end
+        assert q.end <= pf.start <= pf.end <= dec.start <= dec.end
+        for c in chunks:
+            assert pf.start <= c.start <= c.end <= pf.end
+        # the umbrella request span covers arrival -> finish
+        assert req.start == q.start and req.end == dec.end
+        assert req.attrs["tokens"] == len(o.tokens)
+        # first-token instant sits at the prefill/decode boundary
+        evs = [e for e in tr.events("first_token") if e[1] == lane]
+        assert len(evs) == 1 and evs[0][2] == pytest.approx(pf.end)
+
+
+def test_step_timeline_phases_and_event_log(gpt):
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8)
+    _mixed_run(eng, seed=4)
+    tr, reg = eng.tracer, eng.registry
+    # engine lane: one serving.step + phase spans per step
+    steps = tr.spans(lane=0, name="serving.step")
+    assert steps, "no step spans on the engine lane"
+    for phase in ("admission", "prefill", "decode_dispatch", "readback"):
+        h = reg.get(f"serving.phase.{phase}_s")
+        assert h is not None and h.count > 0, phase
+        assert tr.spans(lane=0, name=f"step.{phase}")
+    # compile events rode the trace counters; slot churn rode eviction
+    assert tr.events("compile")
+    assert tr.events("slot_release")
+    assert reg.get("serving.compiles").value >= 2   # prefill + decode
+    d = eng.metrics_dict()
+    assert d["slot_churn"]["allocs"] == d["slot_churn"]["frees"] > 0
+
+
+def test_quantiles_match_exact_request_values(gpt):
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8)
+    _mixed_run(eng, seed=5)                   # warm every program
+    eng.metrics.reset()
+    tpot_obs = {}
+
+    def stream(req, tok):
+        tpot_obs.setdefault(req.request_id, []).append(time.perf_counter())
+
+    prompts = _prompts(6, (3, 9, 14, 6, 11, 4, 8, 5))
+    ids = [eng.submit(p, max_new_tokens=8, stream=stream) for p in prompts]
+    eng.run_until_complete(max_steps=5000)
+    outs = [eng.result(i) for i in ids]
+    m = eng.metrics_dict()
+
+    exact_ttft = np.array([o.ttft_s for o in outs]) * 1e3
+    for key, q in (("ttft_p50_ms", 50), ("ttft_p99_ms", 99)):
+        exact = float(np.percentile(exact_ttft, q))
+        # one log bucket is ~26% wide; rank-definition differences on a
+        # small sample add a little more — 50% is the honesty bar, the
+        # tight accuracy contract is the synthetic-histogram unit test
+        assert m[key] == pytest.approx(exact, rel=0.5), (key, m[key], exact)
+    assert m["ttft_p50_ms"] <= m["ttft_p99_ms"]
+
+    exact_tpot = np.concatenate(
+        [np.diff(ts) for ts in tpot_obs.values() if len(ts) > 1]) * 1e3
+    assert m["tpot_p50_ms"] == pytest.approx(
+        float(np.percentile(exact_tpot, 50)), rel=0.5)
+    assert m["tpot_p50_ms"] <= m["tpot_p99_ms"]
+    assert m["tpot_p99_ms"] == pytest.approx(
+        float(np.percentile(exact_tpot, 99)), rel=0.75)
+
+
+def test_snapshot_shape_preserved_and_extended(gpt):
+    """The pre-obs snapshot keys all survive the registry rebase (BENCH
+    and earlier tests pin on them); the quantiles only ADD."""
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8)
+    eng.serve_batch(_prompts(7, (3, 5)), max_new_tokens=3, max_steps=500)
+    m = eng.metrics_dict()
+    for key in ("requests_submitted", "requests_finished",
+                "tokens_generated", "prefills", "prefill_tokens",
+                "prefill_chunks", "prefill_chunk_tokens", "prefix_hits",
+                "prefix_hit_tokens", "steps", "tokens_per_sec",
+                "mean_ttft_ms", "batch_fill_ratio", "mean_queue_depth",
+                "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                "tpot_p99_ms", "prefix_cache", "slot_churn"):
+        assert key in m, key
+    assert m["requests_finished"] == 2
+    json.dumps(m)                              # snapshot stays JSON-able
+    json.dumps(eng.registry.snapshot())
+
+
+def test_on_first_token_rejects_mixed_clock_bases():
+    from paddle_tpu.serving.metrics import ServingMetrics
+    sm = ServingMetrics()
+    sm.on_first_token(time.perf_counter() - 0.25)
+    assert sm.mean_ttft_ms == pytest.approx(250.0, rel=0.05)
+    with pytest.raises(ValueError, match="clock bases"):
+        sm.on_first_token(time.time())          # epoch seconds: wrong base
+
+
+def test_shared_registry_and_tracer_across_engines(gpt):
+    """Fleet pattern: a second engine binding the same registry/tracer
+    must not wipe the first one's data; its lanes come from a disjoint
+    block; an engine's reset() leaves other producers' metrics alone."""
+    reg, tr = MetricsRegistry(), Tracer()
+    e1 = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                       registry=reg, tracer=tr)
+    e1.serve_batch(_prompts(20, (4, 6)), max_new_tokens=3, max_steps=500)
+    finished = e1.metrics.requests_finished
+    spans_before = len(tr.spans())
+    assert finished == 2 and spans_before > 0
+
+    e2 = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                       registry=reg, tracer=tr)
+    # constructing e2 wiped nothing
+    assert e1.metrics.requests_finished == finished
+    assert len(tr.spans()) == spans_before
+    # disjoint lane blocks: e2's engine lane sits in its own block
+    assert e2.metrics.engine_lane > e1.metrics.engine_lane
+    fill1 = e1.metrics.batch_fill_ratio
+    tps1 = e1.metrics.tokens_per_sec
+    e2.serve_batch(_prompts(21, (5,)), max_new_tokens=4, max_steps=500)
+    lanes1 = {s.lane for s in tr.spans() if s.lane < e2.metrics.engine_lane}
+    lanes2 = {s.lane for s in tr.spans() if s.lane >= e2.metrics.engine_lane}
+    assert lanes1 and lanes2 and not (lanes1 & lanes2)
+    # shared instruments aggregate (same names -> same counters)...
+    assert e2.metrics.requests_finished == finished + 1
+    # ...but derived rates stay PER-ENGINE: e2's traffic must not move
+    # e1's ratios (shared-counter/private-denominator mixing regression)
+    assert e1.metrics.batch_fill_ratio == fill1
+    assert e1.metrics.tokens_per_sec == tps1
+    assert 0 < e2.metrics.batch_fill_ratio <= 1.0
+
+    # a trainer's metrics in the same registry survive an engine reset
+    reg.histogram("train.step_s").observe(0.5)
+    e1.metrics.reset()
+    assert reg.get("train.step_s").count == 1
+    assert e1.metrics.requests_finished == 0
+
+
+def test_profiler_source_install_is_refcounted():
+    """Two engines sharing one tracer each install/remove the chrome
+    source; the first close() must not blind the still-running second."""
+    from paddle_tpu.profiler.profiler import _trace_sources
+    tr = Tracer()
+    before = len(_trace_sources)
+    tr.install_profiler_source()
+    tr.install_profiler_source()        # second engine, same tracer
+    assert len(_trace_sources) == before + 1
+    tr.remove_profiler_source()         # first engine closes
+    assert len(_trace_sources) == before + 1, "shared source dropped early"
+    tr.remove_profiler_source()         # last engine closes
+    assert len(_trace_sources) == before
+    tr.remove_profiler_source()         # idempotent past zero
+
+
+def test_histogram_bucket_param_conflict_raises():
+    reg = MetricsRegistry()
+    reg.histogram("x", lo=1e-5, hi=1e3)
+    reg.histogram("x")                   # same params: fine
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("x", lo=1e-2, hi=1e8)
+    assert reg.get("x") is not None      # fetch-only path needs no params
+
+
+def test_engine_lane_label_survives_many_requests():
+    """The pinned engine-lane label outlives the unpinned request-label
+    LRU (a long-running server names thousands of request lanes)."""
+    tr = Tracer(max_spans=16)
+    tr.set_lane_name(0, "serving.engine", pin=True)
+    tr.add_span("serving.step", 0, 0.0, 1.0)
+    for i in range(3000):
+        tr.set_lane_name(1 + i, f"request {i}")
+    meta = {e["tid"]: e["args"]["name"]
+            for e in tr.chrome_events(pid=1) if e["ph"] == "M"}
+    assert meta[100000] == "serving.engine"
+
+
+# ------------------------------------------- profiler: chrome trace merge
+
+def test_chrome_trace_schema_request_lanes_and_nesting(gpt, tmp_path):
+    from paddle_tpu.profiler import Profiler
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                        record_events=True)
+    try:
+        prof = Profiler(timer_only=True, trace_dir=str(tmp_path))
+        prof.start()
+        outs = _mixed_run(eng, seed=8, n=4)
+        prof.stop()
+        path = str(tmp_path / "trace.json")
+        prof.export(path)
+        data = json.load(open(path))            # (a) valid chrome JSON
+        evs = data["traceEvents"]
+        assert isinstance(evs, list) and evs
+        for e in evs:
+            assert "ph" in e and "pid" in e and "tid" in e
+            if e["ph"] in ("X", "i"):
+                assert isinstance(e["ts"], (int, float))
+        # (b) request lanes present, labelled via thread_name metadata
+        lane_names = {e["args"]["name"] for e in evs
+                      if e["ph"] == "M" and e["name"] == "thread_name"}
+        for o in outs:
+            assert f"request {o.request_id}" in lane_names
+        # (c) host RecordEvents from the SAME export (merged timeline)
+        assert any(e.get("cat") == "host" and e["name"] == "serving.step"
+                   for e in evs)
+        # (d) nesting intact: each request lane's prefill/decode slices
+        # sit inside its request slice
+        by_lane = {}
+        for e in evs:
+            if e["ph"] == "X" and e.get("cat") == "request":
+                by_lane.setdefault(e["tid"], {}).setdefault(
+                    e["name"], []).append(e)
+        for tid, named in by_lane.items():
+            if "request" not in named:
+                continue
+            r = named["request"][0]
+            for inner in ("prefill", "decode"):
+                for e in named.get(inner, []):
+                    assert e["ts"] >= r["ts"] - 1
+                    assert e["ts"] + e["dur"] <= r["ts"] + r["dur"] + 2
+    finally:
+        eng.tracer.remove_profiler_source()
+
+
+def test_record_event_closed_on_raise(gpt, monkeypatch):
+    """Regression: a raising step must still close its RecordEvent AND
+    its serving.step span — later events may not nest inside phantoms."""
+    from paddle_tpu.profiler import Profiler
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                        record_events=True)
+    try:
+        eng.submit(_prompts(9, (4,))[0], max_new_tokens=2)
+        prof = Profiler(timer_only=True)
+        prof.start()
+        monkeypatch.setattr(eng.core.scheduler, "admit",
+                            lambda *a, **kw: (_ for _ in ()).throw(
+                                RuntimeError("boom")))
+        with pytest.raises(RuntimeError, match="boom"):
+            eng.step()
+        prof.stop()
+        closed = [e for e in prof.events() if e.name == "serving.step"]
+        assert closed and all(e.end_us >= e.start_us for e in closed)
+        spans = eng.tracer.spans(lane=0, name="serving.step")
+        assert spans and all(s.end >= s.start for s in spans)
+    finally:
+        eng.tracer.remove_profiler_source()
+
+
+# ------------------------------------------------- the two hard constraints
+
+class _CountingNp:
+    """numpy proxy counting asarray() calls on DEVICE arrays — i.e. the
+    engine's host readbacks (device syncs)."""
+
+    def __init__(self, real):
+        self._real = real
+        self.device_syncs = 0
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def asarray(self, x, *args, **kw):
+        if isinstance(x, jax.Array):
+            self.device_syncs += 1
+        return self._real.asarray(x, *args, **kw)
+
+
+def _count_syncs(gpt, monkeypatch, tracing_on):
+    import paddle_tpu.serving.engine as engine_mod
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8)
+    if not tracing_on:
+        eng.tracer.disable()
+    # warm compile OUTSIDE the counting window (identical both sides)
+    eng.serve_batch(_prompts(11, (4, 6)), max_new_tokens=2, max_steps=200)
+    proxy = _CountingNp(np)
+    monkeypatch.setattr(engine_mod, "np", proxy)
+    try:
+        outs = _mixed_run(eng, seed=12, n=4, new=4)
+    finally:
+        monkeypatch.setattr(engine_mod, "np", proxy._real)
+    return proxy.device_syncs, outs, eng
+
+
+def test_zero_added_device_syncs(gpt, monkeypatch):
+    """Telemetry ON and OFF perform the IDENTICAL number of device->host
+    readbacks on the identical workload: the per-step token harvest (+
+    one batched first-token read per completing step) stays the only
+    sync — the obs layer never touches a device array."""
+    syncs_on, outs_on, eng_on = _count_syncs(gpt, monkeypatch, True)
+    syncs_off, outs_off, _ = _count_syncs(gpt, monkeypatch, False)
+    assert [o.tokens for o in outs_on] == [o.tokens for o in outs_off]
+    assert syncs_on == syncs_off
+    # and the budget itself: <= decode harvest + prefill-completion
+    # readback per step
+    assert syncs_on <= 2 * eng_on.metrics.steps
+
+
+def test_telemetry_overhead_under_3pct_of_step(gpt):
+    """Overhead-budget pin: the per-step telemetry work (counters,
+    histograms, spans, events — measured as a pure-host microbench of
+    MORE calls than a real step makes) costs <3% of the measured decode
+    step wall time on the CPU-smoke loop."""
+    eng = ServingEngine(gpt, num_slots=2, min_bucket=8,
+                        prefill_chunk=None)
+    ids = [eng.submit(p, max_new_tokens=100)
+           for p in _prompts(13, (6, 9))]
+    for _ in range(10):                        # compile + warm
+        eng.step()
+    t0 = time.perf_counter()
+    k = 0
+    while eng.core._slots and k < 60:
+        eng.step()
+        k += 1
+    step_wall = (time.perf_counter() - t0) / max(k, 1)
+
+    m, tr = eng.metrics, eng.tracer
+    reps = 2000
+    t0 = time.perf_counter()
+    for i in range(reps):
+        # exactly the telemetry one steady-state 2-slot decode step
+        # performs: a TPOT sample per slot, the step span pair, the
+        # trace-counter scan, and record_step with the phase timeline
+        m.on_output_token(1e-3)
+        m.on_output_token(1e-3)
+        sp = tr.begin_span("serving.step", lane=0, step=i)
+        tr.end_span(sp)
+        eng.core._record_events(i, eng.core.scheduler.total_head_skips)
+        m.record_step(2, 2, 1, 2, 1e-3, step_index=i,
+                      phases=(("admission", 0.0, 1e-5),
+                              ("prefill", 0.0, 1e-4),
+                              ("decode_dispatch", 0.0, 1e-3),
+                              ("readback", 0.0, 1e-5)))
+    obs_per_step = (time.perf_counter() - t0) / reps
+    assert obs_per_step < 0.03 * step_wall, (obs_per_step, step_wall)
+
+
+# ----------------------------------------------- hapi training histograms
+
+def test_hapi_fit_records_step_histograms():
+    import paddle_tpu
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle_tpu.Model(net)
+    model.prepare(opt.SGD(learning_rate=0.01), nn.CrossEntropyLoss())
+    rs = np.random.RandomState(0)
+    xs = rs.randn(32, 8).astype(np.float32)
+    ys = (xs.sum(-1) > 0).astype(np.int64)
+    from paddle_tpu.io import TensorDataset
+    model.fit(TensorDataset([xs, ys]), epochs=2, batch_size=8, verbose=0)
+
+    reg = model.telemetry
+    h = reg.get("train.step_s")
+    assert h is not None and h.count == 8          # 2 epochs x 4 batches
+    assert h.quantile(0.5) > 0
+    tput = reg.get("train.examples_per_s")
+    assert tput.count == 8 and tput.quantile(0.5) > 0
+    # same registry type as serving -> same exports
+    assert "train_step_s_count 8" in reg.prometheus()
+
+
+# ----------------------------------------------- exporter smoke (obs_dump)
+
+def test_obs_dump_artifacts(tmp_path):
+    """Tier-1-adjacent exporter smoke: scripts/obs_dump.py must emit a
+    parsing metrics.prom + trace.json on a CPU-smoke serving run."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "obs_dump", os.path.join(REPO, "scripts", "obs_dump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "artifacts")
+    assert mod.main(["--out", out, "--requests", "4"]) == 0
+
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "# TYPE serving_ttft_s histogram" in prom
+    assert "serving_requests_finished 4" in prom
+    for line in prom.strip().splitlines():
+        assert line.startswith("#") or " " in line   # name value pairs
+
+    data = json.load(open(os.path.join(out, "trace.json")))
+    names = {e.get("name") for e in data["traceEvents"]}
+    assert "serving.step" in names                   # host RecordEvent
+    lanes = {e["args"]["name"] for e in data["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert any(n.startswith("request ") for n in lanes)

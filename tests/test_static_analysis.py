@@ -344,6 +344,41 @@ def test_resource_lifecycle_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_obs_span_pairs_registered():
+    """ISSUE 6: the obs tracer's span and capture-session protocols are
+    registered ResourcePairs, so the lifecycle rule proves spans close
+    on exception edges across the whole scan scope."""
+    from paddle_tpu.tools.analysis.checkers.lifecycle import DEFAULT_PAIRS
+    pairs = {(p.acquire, p.release) for p in DEFAULT_PAIRS}
+    assert ("begin_span", "end_span") in pairs
+    assert ("enable", "disable") in pairs
+    hints = {p.acquire: p.receiver_hint for p in DEFAULT_PAIRS}
+    # hinted to tracer-ish receivers so `re.match`-style name collisions
+    # (or any enable() on a non-tracer object) stay untracked
+    assert "tracer" in hints["begin_span"]
+    assert "tracer" in hints["enable"]
+
+
+def test_obs_span_lifecycle_positive():
+    """Exactly 3 planted obs leaks: a span leaked on an exception edge,
+    a span never ended, and an enable without a guaranteed disable."""
+    res = run_rule("obs_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 3, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "trace span" in msgs
+    assert "tracer capture" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+
+
+def test_obs_span_lifecycle_negative():
+    """try/finally-closed spans/captures, raise-window-free pairs, and
+    non-tracer receivers (the hint gate) — silent."""
+    res = run_rule("obs_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_resource_pair_registration_api():
     """Custom pairs plug in via the constructor — the documented
     registration API for new alloc/free protocols."""
