@@ -58,12 +58,32 @@ def decode_bw_util(tps, b, prompt, new, n_params, layers, hidden, bpe,
     return round(bytes_per_step * (tps / b) / hbm_bw, 4)
 
 
+def decode_path_info(model, batch, kv_len):
+    """Which decode implementation a row's numbers came from, as a
+    dict: ``path`` names what actually ran (callers override the
+    "unfused" default when the fused engine path produced the row), and
+    ``fused_available``/``fused_fallback_reason`` report whether the
+    decode-block megakernel (kernels/decode_block.py) WOULD engage at
+    this shape — a bench row must never be a bare number that leaves
+    the reader guessing which kernel it measured (ISSUE 7)."""
+    from paddle_tpu.kernels.decode_block import resolve_fused_decode
+    info = {"path": "unfused"}
+    ok, reason = resolve_fused_decode(model, batch=batch, kv_len=kv_len)
+    info["fused_available"] = bool(ok)
+    if not ok:
+        info["fused_fallback_reason"] = reason
+    return info
+
+
 def decode_bw_projection(evidence_path=None):
     """(hbm_bw_util, note) projected from the committed TPU evidence
     file's gpt_decode row — the CPU-smoke stand-in for a live HBM
     figure.  Returns (None, None) when the evidence is missing or has
     no decode row.  Reads the JSON directly (no scripts/ import): the
-    projection must fire in any harness that can open the file."""
+    projection must fire in any harness that can open the file.  The
+    note names the decode path the evidence row ran (fused decode-block
+    vs the composed unfused step) so the projection's provenance never
+    detaches from the kernel that produced it."""
     if evidence_path is None:
         evidence_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
@@ -92,8 +112,13 @@ def decode_bw_projection(evidence_path=None):
         float(ev_tps), fd["batch"], fd["prompt"], fd["new"],
         ecfg.num_params(), ecfg.num_layers, ecfg.hidden_size,
         jnp.dtype(ecfg.dtype).itemsize, "v5e")
+    # pre-ISSUE-7 evidence rows carry no decode_path key: they predate
+    # the fused kernel, so "unfused" is the truthful default
+    ev_path = ev_row.get("decode_path") or "unfused (pre-decode_block)"
+    if isinstance(ev_path, dict):
+        ev_path = ev_path.get("path", "unfused")
     note = (f"projected from {os.path.basename(evidence_path)} v5e "
-            f"gpt_decode (CPU smoke has no HBM)")
+            f"gpt_decode [decode_path={ev_path}] (CPU smoke has no HBM)")
     return util, note
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
@@ -616,6 +641,16 @@ def _secondary_benches(smoke=False):
         # stub file — BENCH_r05 shipped a null here because the old
         # scripts/-import path silently swallowed its failure
         bw_util, bw_note = decode_bw_projection()
+    # which decode implementation produced these numbers: generate()'s
+    # scan runs the composed per-op step, so the row is "unfused" — and
+    # the fused decode-block availability/fallback-reason at this shape
+    # rides along so the reader knows what the serving engine would pick
+    try:
+        dpath = decode_path_info(dm, db, dcfg.max_seq_len)
+    except Exception as e:  # never let the rider wipe the whole section
+        dpath = {"path": "unfused", "error": repr(e)[-200:]}
+    dpath["path"] = "unfused (generate scan; fused decode-block is the " \
+                    "serving engine's fused_decode flag)"
     out["gpt_decode"] = {
         "step_ms": round(dt * 1e3, 1),
         # new tokens/sec over the whole call (prefill amortized in)
@@ -624,10 +659,27 @@ def _secondary_benches(smoke=False):
         "hbm_bw_util": bw_util,
         "decode_tokens_per_sec": (round(decode_tps, 1)
                                   if decode_tps else "noise-dominated"),
+        "decode_path": dpath,
         "config": f"b{db}-prompt{dprompt}-new{dnew}-h{dcfg.hidden_size}"
                   f"-L{dcfg.num_layers}"}
     if bw_note:
         out["gpt_decode"]["bw_note"] = bw_note
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
+    # 6a fused-vs-unfused decode block: the ISSUE 7 kernel_compare row.
+    # On CPU the Pallas pair runs in interpret mode, so the wall times
+    # measure the interpreter, not the kernel — the row still proves
+    # numerical parity and wiring on every run, and carries a note
+    # saying exactly that; the honest on-chip perf row is the
+    # decode_block_* entries scripts/tpu_evidence_bench._kernel_compare
+    # writes into BENCH_TPU_EVIDENCE.json.
+    try:
+        out["kernel_compare_decode_block"] = _decode_block_compare(
+            smoke=smoke)
+    except Exception as e:
+        out["kernel_compare_decode_block"] = {"error": repr(e)[-300:]}
     if over_budget():
         out["truncated"] = "budget"
         return out
@@ -693,6 +745,81 @@ def _secondary_benches(smoke=False):
     except Exception as e:
         out["gpt_decode_int8"] = {"error": repr(e)[-200:]}
     return out
+
+
+def _decode_block_compare(smoke=False):
+    """Fused-vs-unfused decode layer step (ISSUE 7 kernel_compare row):
+    one transformer layer's decode through the Pallas decode-block pair
+    (kernels/decode_block.py) against the composed-op form at a GQA +
+    SwiGLU + rotary shape, reporting both wall times, the speedup, and
+    max-abs parity.  On CPU the Pallas side runs under ``interpret=True``
+    so the times measure the interpreter, not the kernel — the emitted
+    ``note`` says so and points at the on-chip row
+    (scripts/tpu_evidence_bench._kernel_compare ``decode_block_*``)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.decode_block import (decode_block_layer,
+                                                 decode_block_reference,
+                                                 fusion_legal)
+    on_cpu = jax.default_backend() == "cpu"
+    if smoke or on_cpu:
+        b, s, h, kh, dh, f, iters = 2, 64, 4, 2, 16, 128, 3
+        dt = jnp.float32
+    else:
+        b, s, h, kh, dh, f, iters = 8, 2048, 8, 2, 128, 4096, 30
+        dt = jnp.bfloat16
+    d = h * dh
+    rs = np.random.RandomState(11)
+    A = lambda *sh: jnp.asarray(rs.randn(*sh), dt) * 0.05
+    kw = dict(kv_heads=kh, head_dim=dh, norm="rms", eps1=1e-5, eps2=1e-5,
+              norm1_w=A(d) + 1, norm1_b=None, wq=A(d, h * dh),
+              wk=A(d, kh * dh), wv=A(d, kh * dh), bq=None, bkv=None,
+              bv=None, wo=A(h * dh, d), bo=None, norm2_w=A(d) + 1,
+              norm2_b=None, w1=A(d, f), b1=None, w2=A(f, d), b2=None,
+              w_gate=A(d, f),
+              rope_cos=jnp.ones((b, dh), jnp.float32),
+              rope_sin=jnp.zeros((b, dh), jnp.float32))
+    x = A(b, 1, d)
+    k = A(b, s, kh, dh)
+    v = A(b, s, kh, dh)
+    pos = jnp.asarray(rs.randint(0, s, size=b), jnp.int32)
+    # graftlint: disable-next=recompile-hazard -- one-shot compare: each jitted closure is built once per bench run and reused across the whole timing loop; there is no steady-state compile cache to protect
+    fused = jax.jit(lambda x, k, v: decode_block_layer(x, k, v, pos, **kw))
+    # graftlint: disable-next=recompile-hazard -- one-shot compare: same single-build closure as the fused side above
+    unfused = jax.jit(lambda x, k, v: decode_block_reference(x, k, v, pos,
+                                                             **kw))
+
+    def timed(fn):
+        y, k2, v2 = fn(x, k, v)                       # compile
+        float(jnp.sum(y.astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y, k2, v2 = fn(x, k, v)
+        float(jnp.sum(y.astype(jnp.float32)))
+        return (time.perf_counter() - t0) / iters * 1e3, y
+
+    f_ms, fy = timed(fused)
+    u_ms, uy = timed(unfused)
+    diff = float(jnp.max(jnp.abs(fy.astype(jnp.float32)
+                                 - uy.astype(jnp.float32))))
+    legal, why = fusion_legal(max_seq=s, hidden=d, heads=h, kv_heads=kh,
+                              head_dim=dh, ffn=f, batch=b, dtype=dt,
+                              gated=True)
+    row = {"fused_ms": round(f_ms, 3), "unfused_ms": round(u_ms, 3),
+           "speedup": round(u_ms / max(f_ms, 1e-9), 3),
+           "max_abs_diff": round(diff, 6), "ok": diff < 5e-2,
+           "fusion_legal": legal,
+           "config": f"b{b}-kv{s}-h{h}-kvh{kh}-dh{dh}-ffn{f}-"
+                     f"{jnp.dtype(dt).name}"}
+    if not legal:
+        row["fusion_fallback_reason"] = why
+    if on_cpu:
+        row["note"] = ("cpu interpret-mode: times measure the Pallas "
+                       "interpreter, not the kernel — parity is the "
+                       "signal here; the on-chip perf row is "
+                       "BENCH_TPU_EVIDENCE.json kernel_compare "
+                       "decode_block_*")
+    return row
 
 
 def _serving_bench(model, smoke=False):

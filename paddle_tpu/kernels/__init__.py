@@ -15,8 +15,13 @@ from .fused_norm import (fused_rms_norm_pallas,
                          fused_layer_norm_pallas)
 from .decode_attention import (decode_attention, decode_attention_auto,
                                decode_attention_reference)
+from .decode_block import (decode_block_attn, decode_block_layer,
+                           decode_block_mlp, decode_block_reference,
+                           fusion_legal as decode_block_legal)
 from .routing import use_pallas as route_use_pallas
 
 __all__ = ["flash_attention", "flash_attention_with_lse", "decode_attention",
            "fused_adamw_update", "fused_rms_norm_pallas",
-           "fused_layer_norm_pallas"]
+           "fused_layer_norm_pallas", "decode_block_attn",
+           "decode_block_mlp", "decode_block_layer",
+           "decode_block_reference", "decode_block_legal"]

@@ -1,0 +1,741 @@
+"""Decode-block megakernel: a transformer layer's decode step as two
+VMEM-resident Pallas TPU kernels.
+
+Reference: the whole-layer fusion of
+paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu — the
+reference's decode path runs norm -> qkv -> cache write -> masked decode
+attention -> out-proj -> ffn as ONE fused op per layer, not a kernel per
+op (SURVEY.md §2.1).  FlashFuser / ClusterFusion++ (PAPERS.md) make the
+same point for modern serving: decode latency lives at BLOCK-level
+fusion, because the [B, 1, D] activation is tiny and every per-op HBM
+round-trip costs more than the compute it carries.
+
+Kernel pair (one grid for the whole layer would have to keep QKV +
+out-proj + both MLP matrices resident at once — infeasible past small
+hidden sizes under the ~16 MB VMEM budget, so the layer splits at its
+natural seam):
+
+  * **attention block** — grid ``(KH, B)`` (kv-head outer so each
+    weight slice streams from HBM exactly ONCE; slot inner).  Per
+    program: fused LayerNorm/RMSNorm of the slot's [1, D] row -> q/k/v
+    projection for this kv-head's query group (GQA: ``rep`` q heads per
+    program as one [1, D] x [D, rep*Dh] matmul) -> optional rotary
+    embedding (matrix form: ``x*cos + (x@R)*sin`` with a constant
+    rotate-half matrix — no lane-slicing, Mosaic-friendly at any head
+    dim) -> the fresh K/V row is DMA'd **in-kernel** into the
+    ``serving.kv_pool`` slot slab at this slot's ``seq_pos`` (the slab
+    rides through as an aliased ANY-space operand, so the pool buffer
+    is updated in place — no extra copy of the slab, ever) -> decode
+    attention streams the slab's live tiles through a double-buffered
+    VMEM window ONCE with the same online-softmax recurrence and
+    masking semantics as ``kernels/decode_attention.py`` (ragged
+    per-slot ``seq_pos``; tiles past the live length are never even
+    DMA'd — a strict improvement over the BlockSpec pipeline, which
+    streams dead tiles and masks them) -> the fresh token's own K/V
+    folds in last, always valid.
+  * **proj+MLP block** — grid ``(F // bf,)``: out-projection
+    (+residual) at step 0 with the [H*Dh, D] weight resident, fused
+    norm2 into f32 scratch, then the MLP streams its two (three for
+    SwiGLU) weight matrices tile-by-tile, accumulating the down-
+    projection in a [B, D] f32 scratch; the second residual lands in
+    the final tile.  The activation never leaves VMEM between the
+    out-projection and the layer output.
+
+Masking contract (exactly ``decode_attention``'s semantics specialised
+to sq=1, matching the unfused ``append_kv`` + ``decode_attention_auto``
+path token-for-token): with ``pos`` = the slot's cache length BEFORE the
+step, streamed positions ``kpos < min(pos, S-1)`` are valid and the
+fresh token is appended at ``min(pos, S-1)`` (``dynamic_update_slice``'s
+clamp) and always attends to itself.  A full slot (``pos >= S``)
+therefore overwrites its last row, and a free slot (``pos == 0``)
+attends only to its own ride-along token — byte-identical lifecycle
+behaviour to the unfused engine path.
+
+VMEM budgeting (``plan_decode_block``): the kv tile ``block_k`` and MLP
+tile ``block_f`` shrink until the working set fits ``vmem_budget``
+(default 12 MiB of the 16 MiB core budget, headroom for Mosaic's own
+temporaries); if the irreducible residents (the per-head weight slices,
+the out-projection matrix) cannot fit at ANY tile size the plan refuses
+and ``fusion_legal`` reports the reason — the routed fallback is the
+composed unfused path (see kernels/routing.py and docs/serving.md's
+fallback matrix).
+
+CPU tier-1 runs the exact same kernels under ``interpret=True``
+(default off-TPU), including the in-kernel DMA append and the aliased
+slab update, so every contract here is exercised on every CPU test run.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_block_attn", "decode_block_mlp", "decode_block_layer",
+           "decode_block_reference", "plan_decode_block", "fusion_legal",
+           "decode_block_route", "resolve_fused_decode"]
+
+_NEG_INF = float("-inf")
+# default VMEM working-set budget: 16 MiB/core minus headroom for
+# Mosaic's own spills/temporaries (same posture as fused_norm's 4 MiB
+# per-block cap, scaled to a whole-layer working set)
+VMEM_BUDGET = 12 * 1024 * 1024
+
+_ROT_CACHE = {}
+
+
+def _rotate_half_matrix(dh: int):
+    """Constant R with ``x @ R == rotate_half(x)`` (= concat(-x2, x1)).
+    Lets the kernel apply rotary as ``x*cos + (x@R)*sin`` — one tiny MXU
+    op instead of lane-granular slicing, which Mosaic cannot tile for
+    head dims below the 128-lane register width.  The cache holds the
+    HOST matrix: a cached ``jnp.asarray`` built inside one jit trace
+    would leak that trace's tracer into every later program."""
+    m = _ROT_CACHE.get(dh)
+    if m is None:
+        half = dh // 2
+        m = np.zeros((dh, dh), np.float32)
+        for j in range(half):
+            m[j + half, j] = -1.0       # out[:half] = -x2
+            m[j, j + half] = 1.0        # out[half:] = x1
+        _ROT_CACHE[dh] = m
+    return jnp.asarray(m)
+
+
+def _norm_f32(x, w, b, norm: str, eps: float):
+    """The models' norm numerics (f32 math, affine after the rsqrt)."""
+    if norm == "layer":
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        xc = x - mu
+        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+        y = xc * jax.lax.rsqrt(var + eps) * w
+        return y + b if b is not None else y
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps) * w
+    return y + b if b is not None else y
+
+
+# ======================================================== planning / legality
+
+def plan_decode_block(*, max_seq: int, hidden: int, heads: int,
+                      kv_heads: int, head_dim: int, ffn: int, batch: int,
+                      itemsize: int, gated: bool = False,
+                      vmem_budget: int = VMEM_BUDGET):
+    """Pick (block_k, block_f) under the VMEM budget, or explain why no
+    tiling fits.  Returns ``(plan_dict, None)`` or ``(None, reason)``.
+
+    The attention kernel's residents: the kv-head's weight slices
+    (q group + k + v), the double-buffered kv tile window, and small f32
+    scratch.  The MLP kernel's residents: the FULL out-projection matrix
+    (it cannot tile without a second cross-program reduction), the
+    double-buffered MLP weight tiles, and three [B, D] f32 scratch rows.
+    Shrinking the tiles is the only lever; when the irreducible parts
+    alone bust the budget the layer cannot fuse at this shape."""
+    rep = heads // kv_heads
+    dh = head_dim
+
+    # ---- attention kernel: fixed residents
+    attn_fixed = (hidden * (rep + 2) * dh * itemsize      # wq slice, wk, wv
+                  + hidden * itemsize                     # x row
+                  + 2 * hidden * 4                        # norm params (f32 work)
+                  + (rep + 2) * 128 * 4                   # m/l scratch rows
+                  + rep * dh * 4 + 2 * dh * 4             # acc + fresh k/v
+                  + 2 * dh * dh * 4)                      # rope tables + R
+    bk = min(1024, max_seq)
+    while max_seq % bk:
+        bk //= 2
+    while bk > 8 and attn_fixed + 2 * 2 * bk * dh * itemsize > vmem_budget:
+        bk //= 2
+    if attn_fixed + 2 * 2 * bk * dh * itemsize > vmem_budget:
+        return None, (f"vmem: attention residents "
+                      f"{attn_fixed + 4 * bk * dh * itemsize} bytes exceed "
+                      f"budget {vmem_budget} even at block_k={bk}")
+
+    # ---- MLP kernel: the out-projection must be fully resident
+    mlp_fixed = (heads * dh * hidden * itemsize           # wo
+                 + batch * (hidden + heads * dh) * itemsize   # x + attn rows
+                 + 3 * batch * hidden * 4                 # xmid/h/acc scratch
+                 + 4 * hidden * 4)                        # norm/bias params
+    n_mats = 3 if gated else 2
+    # candidate tiles: divisors of ffn that are 128-multiples (Mosaic
+    # lane rule for a [D, bf] block), or the whole ffn when it is small
+    cands = [f for f in range(128, ffn + 1, 128) if ffn % f == 0]
+    if not cands:
+        cands = [ffn]                   # tiny configs: one full tile
+    bf = None
+    for c in sorted(cands, reverse=True):
+        if mlp_fixed + n_mats * 2 * hidden * c * itemsize <= vmem_budget:
+            bf = c
+            break
+    if bf is None:
+        need = mlp_fixed + n_mats * 2 * hidden * min(cands) * itemsize
+        return None, (f"vmem: proj+MLP residents {need} bytes exceed "
+                      f"budget {vmem_budget} even at block_f={min(cands)} "
+                      f"(out-projection [{heads * dh}, {hidden}] must stay "
+                      f"resident)")
+    return {"block_k": bk, "block_f": bf,
+            "vmem_attn": attn_fixed + 4 * bk * dh * itemsize,
+            "vmem_mlp": mlp_fixed + n_mats * 2 * hidden * bf * itemsize}, None
+
+
+def fusion_legal(*, max_seq: int, hidden: int, heads: int, kv_heads: int,
+                 head_dim: int, ffn: int, batch: int, dtype,
+                 gated: bool = False,
+                 vmem_budget: int = VMEM_BUDGET):
+    """Static legality of the fused decode block for this shape/dtype.
+    Returns ``(ok, reason)``; ``reason`` names the first failing check —
+    the engine surfaces it in the ``decode_block`` obs event and bench
+    rows report it as the fallback cause."""
+    dt = jnp.dtype(dtype)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False, f"dtype {dt.name} not in (float32, bfloat16)"
+    if heads * head_dim != hidden:
+        return False, (f"hidden {hidden} != heads*head_dim "
+                       f"{heads}*{head_dim}")
+    if kv_heads < 1 or heads % kv_heads:
+        return False, f"heads {heads} not a multiple of kv_heads {kv_heads}"
+    if head_dim % 2:
+        return False, f"head_dim {head_dim} must be even (rotary halves)"
+    plan, why = plan_decode_block(
+        max_seq=max_seq, hidden=hidden, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, ffn=ffn, batch=batch, itemsize=dt.itemsize,
+        gated=gated, vmem_budget=vmem_budget)
+    if plan is None:
+        return False, why
+    return True, None
+
+
+def decode_block_route(kv_len: int):
+    """Routing policy for the fused path (on top of ``fusion_legal``):
+    ``FLAGS_pallas_routing`` "never" wins everywhere including CPU (the
+    flag's all-Pallas-off contract); otherwise CPU always takes the
+    interpreted kernel (tier-1 exercises it), and on-chip the measured
+    decode-attention crossover (Pallas wins at kv <= 6144, statistical
+    tie beyond — kernels/routing.py) gates the fused path too, since
+    its inner loop is the same KV streaming pattern.  The fused-vs-
+    unfused `kernel_compare` row is the pending evidence to widen this.
+    Returns ``(ok, reason)``."""
+    from ..core.flags import flags
+    from .routing import use_pallas
+    if getattr(flags, "pallas_routing", "auto") == "never":
+        return False, "FLAGS_pallas_routing=never"
+    if jax.default_backend() == "cpu":
+        return True, None
+    if not use_pallas("decode_block", kv_len=kv_len):
+        return False, (f"routing: kv_len {kv_len} beyond the measured "
+                       f"pallas win region (<= 6144)")
+    return True, None
+
+
+def resolve_fused_decode(model, *, batch: int, kv_len: int):
+    """The full fused-vs-unfused fallback chain for a model at
+    ``(batch, kv_len)``: model support (``fused_decode_step`` +
+    ``fused_decode_supported``) -> routing policy
+    (:func:`decode_block_route`) -> shape/dtype/VMEM legality (the
+    model's ``fused_decode_supported`` -> :func:`fusion_legal`).
+    Shared by ``engine._resolve_decode_path`` and bench's
+    ``decode_path_info`` so the fallback matrix lives in exactly one
+    place.  Returns ``(ok, reason)``; ``reason`` is None when the
+    fused path may engage."""
+    supported = getattr(model, "fused_decode_supported", None)
+    if supported is None or not hasattr(model, "fused_decode_step"):
+        return False, "model has no fused_decode_step"
+    ok, reason = decode_block_route(kv_len)
+    if not ok:
+        return False, reason
+    return supported(batch=batch, kv_len=kv_len)
+
+
+# ============================================================ attention block
+
+def _attn_kernel(pos_ref, x_ref, nw_ref, nb_ref, wq_ref, wk_ref, wv_ref,
+                 bq_ref, bk_ref, bv_ref, cos_ref, sin_ref, rot_ref,
+                 k_any, v_any,
+                 attn_ref, ko_any, vo_any,
+                 m_sc, l_sc, acc_sc, knew_sc, vnew_sc, kbuf, vbuf,
+                 rsem, wsem, *,
+                 S, rep, dh, bk, eps, scale, norm, has_bias, use_rope):
+    kh = pl.program_id(0)
+    b = pl.program_id(1)
+    pos = pos_ref[0]
+
+    # ---- fused norm + this kv-head group's q/k/v projection (f32)
+    xr = x_ref[0].astype(jnp.float32)                       # [1, D]
+    nb = nb_ref[...].astype(jnp.float32) if norm == "layer" else None
+    xn = _norm_f32(xr, nw_ref[...].astype(jnp.float32), nb, norm, eps)
+    dims = (((1,), (0,)), ((), ()))
+    q = jax.lax.dot_general(xn, wq_ref[0].astype(jnp.float32), dims,
+                            preferred_element_type=jnp.float32)
+    kx = jax.lax.dot_general(xn, wk_ref[0].astype(jnp.float32), dims,
+                             preferred_element_type=jnp.float32)
+    vx = jax.lax.dot_general(xn, wv_ref[0].astype(jnp.float32), dims,
+                             preferred_element_type=jnp.float32)
+    if has_bias:
+        q = q + bq_ref[0].astype(jnp.float32)
+        kx = kx + bk_ref[0].astype(jnp.float32)
+        vx = vx + bv_ref[0].astype(jnp.float32)
+    qm = q.reshape(rep, dh)
+    if use_rope:
+        c = cos_ref[...].astype(jnp.float32)                # [1, dh]
+        s = sin_ref[...].astype(jnp.float32)
+        rot = rot_ref[...]
+        qm = qm * c + jax.lax.dot_general(qm, rot, dims,
+                                          preferred_element_type=jnp.float32) * s
+        kx = kx * c + jax.lax.dot_general(kx, rot, dims,
+                                          preferred_element_type=jnp.float32) * s
+    qm = qm * scale
+
+    # ---- in-kernel KV append: DMA the fresh row into the slot slab at
+    # this slot's position (clamped exactly like dynamic_update_slice —
+    # a full slot overwrites its last row, matching the unfused path)
+    posw = jnp.minimum(pos, S - 1)
+    knew_sc[...] = kx.astype(knew_sc.dtype)
+    vnew_sc[...] = vx.astype(vnew_sc.dtype)
+    kw_cp = pltpu.make_async_copy(knew_sc, ko_any.at[b, pl.ds(posw, 1), kh],
+                                  wsem.at[0])
+    vw_cp = pltpu.make_async_copy(vnew_sc, vo_any.at[b, pl.ds(posw, 1), kh],
+                                  wsem.at[1])
+    kw_cp.start()
+    vw_cp.start()
+
+    # ---- stream the live tiles once, double-buffered; tiles wholly
+    # past the live prefix are never fetched (pos, not S, bounds the loop)
+    lim = posw                                              # valid: kpos < lim
+    nlive = jax.lax.div(lim + bk - 1, bk)
+    m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+    l_sc[...] = jnp.zeros_like(l_sc)
+    acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    def k_cp(slot, ki):
+        return pltpu.make_async_copy(
+            k_any.at[b, pl.ds(ki * bk, bk), kh], kbuf.at[slot],
+            rsem.at[0, slot])
+
+    def v_cp(slot, ki):
+        return pltpu.make_async_copy(
+            v_any.at[b, pl.ds(ki * bk, bk), kh], vbuf.at[slot],
+            rsem.at[1, slot])
+
+    @pl.when(nlive > 0)
+    def _prefetch():
+        k_cp(0, 0).start()
+        v_cp(0, 0).start()
+
+    def _update(s_blk, v_blk, kpos_valid):
+        """One online-softmax step (decode_attention's recurrence)."""
+        s_blk = jnp.where(kpos_valid, s_blk, _NEG_INF)
+        m_prev = m_sc[...]
+        l_prev = l_sc[...]
+        m_curr = jnp.max(s_blk, axis=1)[:, None]
+        m_next = jnp.maximum(m_prev, m_curr)
+        m_safe = jnp.where(m_next == _NEG_INF, 0.0, m_next)
+        p = jnp.exp(s_blk - m_safe[:, :1])
+        alpha = jnp.exp(m_prev - m_safe)
+        l_sc[...] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_sc[...] = m_next
+        acc_sc[...] = acc_sc[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _body(ki, carry):
+        slot = jax.lax.rem(ki, 2)
+
+        @pl.when(ki + 1 < nlive)
+        def _next():
+            k_cp(1 - slot, ki + 1).start()
+            v_cp(1 - slot, ki + 1).start()
+
+        k_cp(slot, ki).wait()
+        v_cp(slot, ki).wait()
+        kt = kbuf[slot].astype(jnp.float32)                 # [bk, dh]
+        vt = vbuf[slot].astype(jnp.float32)
+        s_blk = jax.lax.dot_general(qm, kt, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (rep, bk), 1)
+        _update(s_blk, vt, kpos < lim)
+        return carry
+
+    jax.lax.fori_loop(0, nlive, _body, 0)
+
+    # ---- the fresh token folds in last, always valid (it reads its own
+    # STORED k/v so storage-dtype rounding matches the unfused path)
+    kq = knew_sc[...].astype(jnp.float32)                   # [1, dh]
+    vq = vnew_sc[...].astype(jnp.float32)
+    s_new = jax.lax.dot_general(qm, kq, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    _update(s_new, vq, jnp.full((rep, 1), True))
+
+    l = l_sc[...][:, :1]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    attn_ref[0, 0] = (acc_sc[...] / l_safe).astype(attn_ref.dtype)
+    kw_cp.wait()
+    vw_cp.wait()
+
+
+def decode_block_attn(x, k_slab, v_slab, seq_pos, norm_w, norm_b,
+                      wq, wk, wv, bq=None, bkv=None, bv=None, *,
+                      kv_heads: int, head_dim: int, norm: str = "layer",
+                      eps: float = 1e-5, scale: Optional[float] = None,
+                      rope_cos=None, rope_sin=None,
+                      block_k: Optional[int] = None,
+                      interpret: Optional[bool] = None):
+    """Fused norm -> QKV -> in-kernel KV append -> streaming decode
+    attention over the slot slabs.
+
+    x [B, 1, D]; k_slab/v_slab [B, S, KH, Dh] (the ``KVPool`` slabs,
+    updated IN PLACE via kernel aliasing); seq_pos [B] int32 cache
+    lengths BEFORE this token; wq [D, H*Dh], wk/wv [D, KH*Dh];
+    rope_cos/rope_sin [B, Dh] full-width tables (halves duplicated) or
+    None.  Returns ``(attn [B, 1, H*Dh], k_slab', v_slab')`` — attn is
+    the pre-out-projection head concat, fed to
+    :func:`decode_block_mlp`."""
+    b, sq, d = x.shape
+    if sq != 1:
+        raise ValueError(f"decode_block_attn is a decode kernel (sq=1), "
+                         f"got sq={sq}")
+    s_max, kh_, dh = k_slab.shape[1], k_slab.shape[2], k_slab.shape[3]
+    assert kh_ == kv_heads and dh == head_dim
+    heads = wq.shape[1] // head_dim
+    rep = heads // kv_heads
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    scale = scale if scale is not None else 1.0 / (head_dim ** 0.5)
+    # scalar seq_pos (single-request decode_step caches) broadcasts to
+    # the per-slot vector the kernel grid indexes by
+    pos1 = jnp.asarray(seq_pos, jnp.int32)
+    if pos1.ndim == 0:
+        pos1 = jnp.broadcast_to(pos1, (b,))
+    bk = block_k or min(1024, s_max)
+    bk = min(bk, s_max)
+    while s_max % bk:
+        bk //= 2
+    has_bias = bq is not None or bkv is not None or bv is not None
+    use_rope = rope_cos is not None
+
+    # head-blocked weight views: [KH, D, rep*Dh] / [KH, D, Dh] so every
+    # block's trailing dims equal the array dims (Mosaic-legal at any
+    # head_dim, incl. the flagship's 64).  Trace-time transposes — the
+    # engine's decode program sees them as constants and folds them.
+    wq3 = wq.reshape(d, kv_heads, rep * dh).transpose(1, 0, 2)
+    wk3 = wk.reshape(d, kv_heads, dh).transpose(1, 0, 2)
+    wv3 = wv.reshape(d, kv_heads, dh).transpose(1, 0, 2)
+    # each bias is independently optional (the reference applies them
+    # independently too); absent ones ride as zeros
+    zq = jnp.zeros((kv_heads, rep * dh), x.dtype)
+    zk = jnp.zeros((kv_heads, dh), x.dtype)
+    bq2 = bq.reshape(kv_heads, rep * dh) if bq is not None else zq
+    bk2 = bkv.reshape(kv_heads, dh) if bkv is not None else zk
+    bv2 = bv.reshape(kv_heads, dh) if bv is not None else zk
+    if use_rope:
+        cosf, sinf = rope_cos, rope_sin
+        rot = _rotate_half_matrix(dh)
+    else:
+        cosf = jnp.ones((b, dh), jnp.float32)
+        sinf = jnp.zeros((b, dh), jnp.float32)
+        rot = jnp.zeros((dh, dh), jnp.float32)
+    if norm == "layer":
+        nb = norm_b
+    else:
+        nb = jnp.zeros_like(norm_w)
+
+    kernel = functools.partial(
+        _attn_kernel, S=s_max, rep=rep, dh=dh, bk=bk, eps=float(eps),
+        scale=scale, norm=norm, has_bias=has_bias, use_rope=use_rope)
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("arbitrary", "arbitrary"))
+    grid = (kv_heads, b)
+    attn4, k2, v2 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda kh, bi: (bi,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, d), lambda kh, bi: (bi, 0, 0)),
+            pl.BlockSpec((d,), lambda kh, bi: (0,)),
+            pl.BlockSpec((d,), lambda kh, bi: (0,)),
+            pl.BlockSpec((1, d, rep * dh), lambda kh, bi: (kh, 0, 0)),
+            pl.BlockSpec((1, d, dh), lambda kh, bi: (kh, 0, 0)),
+            pl.BlockSpec((1, d, dh), lambda kh, bi: (kh, 0, 0)),
+            pl.BlockSpec((1, rep * dh), lambda kh, bi: (kh, 0)),
+            pl.BlockSpec((1, dh), lambda kh, bi: (kh, 0)),
+            pl.BlockSpec((1, dh), lambda kh, bi: (kh, 0)),
+            pl.BlockSpec((1, dh), lambda kh, bi: (bi, 0)),
+            pl.BlockSpec((1, dh), lambda kh, bi: (bi, 0)),
+            pl.BlockSpec((dh, dh), lambda kh, bi: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, rep, dh), lambda kh, bi: (bi, kh, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv_heads, rep, dh), x.dtype),
+            jax.ShapeDtypeStruct(k_slab.shape, k_slab.dtype),
+            jax.ShapeDtypeStruct(v_slab.shape, v_slab.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rep, 128), jnp.float32),
+            pltpu.VMEM((rep, 128), jnp.float32),
+            pltpu.VMEM((rep, dh), jnp.float32),
+            pltpu.VMEM((1, dh), k_slab.dtype),
+            pltpu.VMEM((1, dh), v_slab.dtype),
+            pltpu.VMEM((2, bk, dh), k_slab.dtype),
+            pltpu.VMEM((2, bk, dh), v_slab.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={13: 1, 14: 2},
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(pos1, x, norm_w, nb, wq3, wk3, wv3,
+      bq2, bk2, bv2, cosf, sinf, rot, k_slab, v_slab)
+    attn = attn4.reshape(b, 1, heads * dh)
+    return attn, k2, v2
+
+
+# ============================================================= proj+MLP block
+
+def _mlp_kernel(x_ref, attn_ref, wo_ref, bo_ref, n2w_ref, n2b_ref,
+                w1_ref, b1_ref, wg_ref, w2_ref, b2_ref, o_ref,
+                xmid_sc, h_sc, acc_sc, *,
+                nf, eps, norm, act, has_bias, gated):
+    f = pl.program_id(0)
+    dims = (((1,), (0,)), ((), ()))
+
+    @pl.when(f == 0)
+    def _proj():
+        x = x_ref[:, 0].astype(jnp.float32)                 # [B, D]
+        a = attn_ref[:, 0].astype(jnp.float32)              # [B, H*Dh]
+        xm = x + jax.lax.dot_general(a, wo_ref[...].astype(jnp.float32),
+                                     dims,
+                                     preferred_element_type=jnp.float32)
+        if has_bias:
+            xm = xm + bo_ref[...].astype(jnp.float32)
+        xmid_sc[...] = xm
+        n2b = n2b_ref[...].astype(jnp.float32) if norm == "layer" else None
+        h_sc[...] = _norm_f32(xm, n2w_ref[...].astype(jnp.float32), n2b,
+                              norm, eps)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    h = h_sc[...]
+    t = jax.lax.dot_general(h, w1_ref[...].astype(jnp.float32), dims,
+                            preferred_element_type=jnp.float32)
+    if has_bias:
+        t = t + b1_ref[...].astype(jnp.float32)
+    if gated:
+        g = jax.lax.dot_general(h, wg_ref[...].astype(jnp.float32), dims,
+                                preferred_element_type=jnp.float32)
+        a = jax.nn.silu(g) * t
+    elif act == "gelu_tanh":
+        a = jax.nn.gelu(t, approximate=True)
+    else:
+        a = jax.nn.gelu(t, approximate=False)
+    acc_sc[...] = acc_sc[...] + jax.lax.dot_general(
+        a, w2_ref[...].astype(jnp.float32), dims,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(f == nf - 1)
+    def _emit():
+        y = xmid_sc[...] + acc_sc[...]
+        if has_bias:
+            y = y + b2_ref[...].astype(jnp.float32)
+        o_ref[:, 0] = y.astype(o_ref.dtype)
+
+
+def decode_block_mlp(x, attn, wo, bo, norm_w, norm_b, w1, b1, w2, b2,
+                     w_gate=None, *, norm: str = "layer",
+                     eps: float = 1e-5, act: str = "gelu_tanh",
+                     block_f: Optional[int] = None,
+                     interpret: Optional[bool] = None):
+    """Fused out-projection (+residual) -> norm2 -> MLP (+residual).
+
+    x [B, 1, D] is the layer input (the residual stream); attn is
+    :func:`decode_block_attn`'s output.  ``w_gate`` switches the MLP to
+    SwiGLU (``down(silu(gate)*up)`` with w1=up, w2=down).  The [B, D]
+    activation stays in VMEM scratch from the out-projection to the
+    final residual; MLP weights stream tile-by-tile."""
+    b, sq, d = x.shape
+    hd = attn.shape[-1]
+    ffn = w1.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    gated = w_gate is not None
+    has_bias = bo is not None or b1 is not None or b2 is not None
+    bf = min(block_f or ffn, ffn)
+    if ffn % bf:
+        # never escalate toward full residency (that is the exact
+        # failure plan_decode_block's budget exists to prevent): shrink
+        # to the largest dividing tile <= the request, preferring
+        # 128-multiples (Mosaic lane rule), else any divisor
+        cand = (bf // 128) * 128
+        while cand >= 128 and ffn % cand:
+            cand -= 128
+        if cand < 128:
+            cand = bf
+            while ffn % cand:
+                cand -= 1
+        bf = cand
+    nf = ffn // bf
+    zd = jnp.zeros((d,), x.dtype)
+    # each bias independently optional, matching the reference's
+    # per-bias application; absent ones ride as zeros
+    bo2 = bo if bo is not None else zd
+    b12 = b1 if b1 is not None else jnp.zeros((ffn,), x.dtype)
+    b22 = b2 if b2 is not None else zd
+    n2b = norm_b if norm == "layer" else jnp.zeros_like(norm_w)
+    if gated:
+        wg = w_gate
+        wg_spec = pl.BlockSpec((d, bf), lambda f: (0, f))
+    else:
+        # the kernel body never reads wg when not gated, but the grid
+        # pipeline DMAs every spec'd block regardless — a one-tile
+        # placeholder with a CONSTANT index map keeps the dead operand
+        # from re-streaming the full [D, ffn] up-projection each step
+        wg = jnp.zeros((d, bf), x.dtype)
+        wg_spec = pl.BlockSpec((d, bf), lambda f: (0, 0))
+
+    kernel = functools.partial(
+        _mlp_kernel, nf=nf, eps=float(eps), norm=norm, act=act,
+        has_bias=has_bias, gated=gated)
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",))
+    out = pl.pallas_call(
+        kernel,
+        grid=(nf,),
+        in_specs=[
+            pl.BlockSpec((b, 1, d), lambda f: (0, 0, 0)),
+            pl.BlockSpec((b, 1, hd), lambda f: (0, 0, 0)),
+            pl.BlockSpec((hd, d), lambda f: (0, 0)),
+            pl.BlockSpec((d,), lambda f: (0,)),
+            pl.BlockSpec((d,), lambda f: (0,)),
+            pl.BlockSpec((d,), lambda f: (0,)),
+            pl.BlockSpec((d, bf), lambda f: (0, f)),
+            pl.BlockSpec((bf,), lambda f: (f,)),
+            wg_spec,
+            pl.BlockSpec((bf, d), lambda f: (f, 0)),
+            pl.BlockSpec((d,), lambda f: (0,)),
+        ],
+        out_specs=pl.BlockSpec((b, 1, d), lambda f: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1, d), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((b, d), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(x, attn, wo, bo2, norm_w, n2b, w1, b12, wg, w2, b22)
+    return out
+
+
+# ============================================================== layer wrapper
+
+def decode_block_layer(x, k_slab, v_slab, seq_pos, *, kv_heads, head_dim,
+                       norm, eps1, eps2, norm1_w, norm1_b, wq, wk, wv,
+                       bq, bkv, bv, wo, bo, norm2_w, norm2_b,
+                       w1, b1, w2, b2, w_gate=None, act="gelu_tanh",
+                       rope_cos=None, rope_sin=None,
+                       block_k=None, block_f=None, interpret=None):
+    """One full transformer layer decode step through the fused kernel
+    pair.  Returns ``(y [B, 1, D], k_slab', v_slab')`` with the slabs
+    updated in place (kernel aliasing) at each slot's ``seq_pos``.
+
+    When ``block_k``/``block_f`` are not given they come from
+    :func:`plan_decode_block` at THIS call's shapes — the budgeted
+    tiles, not the kernels' untiled defaults — so every caller of the
+    layer wrapper (models' ``fused_decode_step``, the engine's decode
+    program, bench) launches exactly the working set the legality
+    check approved.  Raises if no tiling fits: callers are contracted
+    to gate on :func:`fusion_legal` / ``fused_decode_supported``
+    first, so reaching the raise means the gate was skipped."""
+    if block_k is None or block_f is None:
+        b = x.shape[0]
+        heads = wq.shape[1] // head_dim
+        plan, why = plan_decode_block(
+            max_seq=k_slab.shape[1], hidden=x.shape[-1], heads=heads,
+            kv_heads=kv_heads, head_dim=head_dim, ffn=w1.shape[1],
+            batch=b, itemsize=jnp.dtype(x.dtype).itemsize,
+            gated=w_gate is not None)
+        if plan is None:
+            raise ValueError(
+                f"decode_block_layer: no VMEM tiling fits this shape "
+                f"({why}) — gate on fusion_legal/fused_decode_supported "
+                f"before calling the fused path")
+        block_k = block_k if block_k is not None else plan["block_k"]
+        block_f = block_f if block_f is not None else plan["block_f"]
+    attn, k2, v2 = decode_block_attn(
+        x, k_slab, v_slab, seq_pos, norm1_w, norm1_b, wq, wk, wv,
+        bq, bkv, bv, kv_heads=kv_heads, head_dim=head_dim, norm=norm,
+        eps=eps1, rope_cos=rope_cos, rope_sin=rope_sin, block_k=block_k,
+        interpret=interpret)
+    y = decode_block_mlp(
+        x, attn, wo, bo, norm2_w, norm2_b, w1, b1, w2, b2, w_gate,
+        norm=norm, eps=eps2, act=act, block_f=block_f,
+        interpret=interpret)
+    return y, k2, v2
+
+
+def decode_block_reference(x, k_slab, v_slab, seq_pos, *, kv_heads,
+                           head_dim, norm, eps1, eps2, norm1_w, norm1_b,
+                           wq, wk, wv, bq, bkv, bv, wo, bo, norm2_w,
+                           norm2_b, w1, b1, w2, b2, w_gate=None,
+                           act="gelu_tanh", rope_cos=None, rope_sin=None):
+    """Composed-op XLA form with EXACTLY the kernel's masking semantics
+    and f32 rounding — the parity oracle for tests, mirroring how the
+    models' unfused layer path composes append_kv +
+    decode_attention_auto (same math, op by op)."""
+    from ..models.kv_cache import append_kv
+    from .decode_attention import decode_attention_reference
+    b, sq, d = x.shape
+    heads = wq.shape[1] // head_dim
+    dt = jnp.float32
+    xr = x.astype(dt)
+    xn = _norm_f32(xr, norm1_w.astype(dt),
+                   norm1_b.astype(dt) if norm == "layer" else None,
+                   norm, eps1)
+    q = (xn @ wq.astype(dt)).reshape(b, 1, heads, head_dim)
+    kx = (xn @ wk.astype(dt)).reshape(b, 1, kv_heads, head_dim)
+    vx = (xn @ wv.astype(dt)).reshape(b, 1, kv_heads, head_dim)
+    if bq is not None:
+        q = q + bq.astype(dt).reshape(heads, head_dim)
+    if bkv is not None:
+        kx = kx + bkv.astype(dt).reshape(kv_heads, head_dim)
+    if bv is not None:
+        vx = vx + bv.astype(dt).reshape(kv_heads, head_dim)
+    if rope_cos is not None:
+        c = rope_cos.astype(dt)[:, None, None, :]
+        s = rope_sin.astype(dt)[:, None, None, :]
+        rot = _rotate_half_matrix(head_dim)
+        q = q * c + (q @ rot) * s
+        kx = kx * c + (kx @ rot) * s
+    pos = jnp.asarray(seq_pos, jnp.int32)
+    k2, v2 = append_kv(k_slab, v_slab, kx.astype(k_slab.dtype),
+                       vx.astype(v_slab.dtype), pos)
+    lens = pos + 1
+    out = decode_attention_reference(q.astype(x.dtype), k2, v2, lens)
+    attn = out.reshape(b, 1, heads * head_dim)
+    xm = xr + attn.astype(dt) @ wo.astype(dt)
+    if bo is not None:
+        xm = xm + bo.astype(dt)
+    h = _norm_f32(xm, norm2_w.astype(dt),
+                  norm2_b.astype(dt) if norm == "layer" else None,
+                  norm, eps2)
+    t = h @ w1.astype(dt)
+    if b1 is not None:
+        t = t + b1.astype(dt)
+    if w_gate is not None:
+        a = jax.nn.silu(h @ w_gate.astype(dt)) * t
+    else:
+        a = jax.nn.gelu(t, approximate=act == "gelu_tanh")
+    y = xm + a @ w2.astype(dt)
+    if b2 is not None:
+        y = y + b2.astype(dt)
+    return y.astype(x.dtype), k2, v2

@@ -64,6 +64,17 @@ def _rule(kernel: str, f: dict) -> bool:
         return min(f.get("seq_q", 0), f.get("seq_k", 0)) >= 2048
     if kernel == "decode_attention":
         return f.get("kv_len", 0) <= 6144
+    if kernel == "decode_block":
+        # fused decode block (kernels/decode_block.py): no dedicated
+        # on-chip measurement yet — the path is opt-in (the engine's
+        # fused_decode flag) and its inner loop is decode_attention's KV
+        # streaming, so it inherits that kernel's measured win region
+        # (pallas <= 6144, statistical tie beyond -> composed XLA path).
+        # The fused-vs-unfused `kernel_compare` row
+        # (scripts/tpu_evidence_bench.py) is the pending evidence that
+        # will widen or narrow this; shape legality is checked
+        # separately by decode_block.fusion_legal.
+        return _rule("decode_attention", f)
     if kernel in ("layer_norm", "rms_norm"):
         return False
     if kernel == "fused_adamw":

@@ -157,6 +157,35 @@ class GPTBlock(Layer):
             return x, new_cache
         return x
 
+    def fused_decode_step(self, x, cache):
+        """One decode token through the fused decode-block kernel pair
+        (kernels/decode_block.py): norm -> QKV -> in-kernel KV append ->
+        streaming attention -> out-proj -> MLP, activations VMEM-
+        resident.  ``cache`` is the slot-slab tuple ``(k, v, pos)`` with
+        per-row positions; the slabs are updated in place via kernel
+        aliasing.  Same contract as the ``forward(cache=...)`` path for
+        sq=1 — callers gate on ``fused_decode_supported``."""
+        from ..kernels.decode_block import decode_block_layer
+        cfg = self.cfg
+        h = cfg.hidden_size
+        pk, pv, pos = cache
+        wqkv = self.qkv.weight                  # [h, 3h]: q | k | v cols
+        bqkv = self.qkv.bias
+        bq, bk, bv = ((bqkv[:h], bqkv[h:2 * h], bqkv[2 * h:])
+                      if bqkv is not None else (None, None, None))
+        y, k2, v2 = decode_block_layer(
+            x, pk, pv, pos, kv_heads=cfg.num_heads, head_dim=cfg.head_dim,
+            norm="layer", eps1=cfg.layer_norm_eps, eps2=cfg.layer_norm_eps,
+            norm1_w=self.ln_1.weight, norm1_b=self.ln_1.bias,
+            wq=wqkv[:, :h], wk=wqkv[:, h:2 * h], wv=wqkv[:, 2 * h:],
+            bq=bq, bkv=bk, bv=bv,
+            wo=self.out_proj.weight, bo=self.out_proj.bias,
+            norm2_w=self.ln_2.weight, norm2_b=self.ln_2.bias,
+            w1=self.fc_in.weight, b1=self.fc_in.bias,
+            w2=self.fc_out.weight, b2=self.fc_out.bias,
+            act="gelu_tanh")
+        return y, (k2, v2, pos + 1)
+
 
 class GPTModel(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -254,6 +283,34 @@ class GPTForCausalLM(Layer):
         new_caches = []
         for block, cache in zip(self.gpt.h, caches):
             x, c = block(x, cache)
+            new_caches.append(c)
+        x = self.gpt.ln_f(x)
+        return self.logits(x), new_caches
+
+    def fused_decode_supported(self, batch: int = 1,
+                               kv_len: Optional[int] = None):
+        """Static legality of the fused decode-block path for this
+        config at ``(batch, kv_len)``.  Returns ``(ok, reason)``."""
+        from ..kernels.decode_block import fusion_legal
+        cfg = self.cfg
+        if cfg.dropout and self.training:
+            return False, "dropout active (training mode)"
+        return fusion_legal(
+            max_seq=kv_len or cfg.max_seq_len, hidden=cfg.hidden_size,
+            heads=cfg.num_heads, kv_heads=cfg.num_heads,
+            head_dim=cfg.head_dim, ffn=cfg.ffn_size, batch=batch,
+            dtype=cfg.dtype)
+
+    def fused_decode_step(self, input_ids, caches, position):
+        """``decode_step`` through the fused decode-block kernels: the
+        embed / final-norm / logits legs are shared code, each layer
+        body runs as the Pallas kernel pair with the KV slabs updated
+        in-kernel.  Per-row ``position`` vectors (continuous batching)
+        and scalars both work."""
+        x = self.gpt.embed(input_ids, position)
+        new_caches = []
+        for block, cache in zip(self.gpt.h, caches):
+            x, c = block.fused_decode_step(x, cache)
             new_caches.append(c)
         x = self.gpt.ln_f(x)
         return self.logits(x), new_caches

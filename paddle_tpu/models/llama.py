@@ -173,6 +173,31 @@ class LlamaDecoderLayer(Layer):
             return x, new_cache
         return x
 
+    def fused_decode_step(self, x, cos_full, sin_full, cache):
+        """One decode token through the fused decode-block kernel pair
+        (kernels/decode_block.py): RMSNorm -> QKV (+rotary) -> in-kernel
+        KV append -> GQA streaming attention -> o_proj -> SwiGLU MLP.
+        ``cos_full``/``sin_full`` are [B, head_dim] full-width rotary
+        tables (halves duplicated) at each row's position; the KV slabs
+        in ``cache`` update in place via kernel aliasing."""
+        from ..kernels.decode_block import decode_block_layer
+        cfg = self.cfg
+        pk, pv, pos = cache
+        at, mlp = self.self_attn, self.mlp
+        y, k2, v2 = decode_block_layer(
+            x, pk, pv, pos, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            norm="rms", eps1=cfg.rms_norm_eps, eps2=cfg.rms_norm_eps,
+            norm1_w=self.input_layernorm.weight, norm1_b=None,
+            wq=at.q_proj.weight, wk=at.k_proj.weight, wv=at.v_proj.weight,
+            bq=None, bkv=None, bv=None,
+            wo=at.o_proj.weight, bo=None,
+            norm2_w=self.post_attention_layernorm.weight, norm2_b=None,
+            w1=mlp.up_proj.weight, b1=None,
+            w2=mlp.down_proj.weight, b2=None,
+            w_gate=mlp.gate_proj.weight,
+            rope_cos=cos_full, rope_sin=sin_full)
+        return y, (k2, v2, pos + 1)
+
 
 class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -245,6 +270,41 @@ class LlamaForCausalLM(Layer):
         hidden, new_caches = self.llama(input_ids, caches,
                                         position_offset=position)
         return self.lm_head(hidden), new_caches
+
+    def fused_decode_supported(self, batch: int = 1,
+                               kv_len: Optional[int] = None):
+        """Static legality of the fused decode-block path (GQA aware).
+        Returns ``(ok, reason)``."""
+        from ..kernels.decode_block import fusion_legal
+        cfg = self.cfg
+        if cfg.dropout and self.training:
+            return False, "dropout active (training mode)"
+        return fusion_legal(
+            max_seq=kv_len or cfg.max_seq_len, hidden=cfg.hidden_size,
+            heads=cfg.num_heads, kv_heads=cfg.kv_heads,
+            head_dim=cfg.head_dim, ffn=cfg.intermediate_size, batch=batch,
+            dtype=cfg.dtype, gated=True)
+
+    def fused_decode_step(self, input_ids, caches, position):
+        """``decode_step`` through the fused decode-block kernels —
+        shared embed/final-norm/head legs, fused layer bodies, rotary
+        tables computed once at each row's position (full-width, halves
+        duplicated: the kernel applies rotary in matrix form)."""
+        cfg = self.cfg
+        x = self.llama.embed_tokens(input_ids)
+        pos = jnp.asarray(position, jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.full((x.shape[0],), pos, jnp.int32)
+        cos, sin = _rope_tables(pos, cfg.head_dim, cfg.rope_theta,
+                                jnp.float32)                 # [B, d/2]
+        cos_full = jnp.concatenate([cos, cos], axis=-1)
+        sin_full = jnp.concatenate([sin, sin], axis=-1)
+        new_caches = []
+        for layer, cache in zip(self.llama.layers, caches):
+            x, c = layer.fused_decode_step(x, cos_full, sin_full, cache)
+            new_caches.append(c)
+        x = self.llama.norm(x)
+        return self.lm_head(x), new_caches
 
     def generate(self, input_ids, max_new_tokens: int, **kw):
         """Single-scan autoregressive decoding (models/generation.py)."""

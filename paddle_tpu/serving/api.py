@@ -82,7 +82,8 @@ class ServingEngine:
                  block_len: int = 16,
                  prefix_blocks: Optional[int] = None,
                  record_events: bool = False,
-                 registry=None, tracer=None):
+                 registry=None, tracer=None,
+                 fused_decode: bool = False):
         # registry/tracer (paddle_tpu.obs) may be shared across engines
         # (a fleet scraping one Prometheus surface: shared instruments
         # aggregate, lanes come from per-engine blocks); default: private
@@ -95,7 +96,8 @@ class ServingEngine:
             enable_prefix_cache=enable_prefix_cache,
             block_len=block_len, prefix_blocks=prefix_blocks,
             metrics=ServingMetrics(record_events=record_events,
-                                   registry=registry, tracer=tracer))
+                                   registry=registry, tracer=tracer),
+            fused_decode=fused_decode)
         self._requests = {}
 
     # -------------------------------------------------------- submission
@@ -197,6 +199,18 @@ class ServingEngine:
         """The engine's ``obs.MetricsRegistry`` — full instrument dump
         via ``.snapshot()``, Prometheus text via ``.prometheus()``."""
         return self.core.metrics.registry
+
+    @property
+    def decode_path(self) -> str:
+        """``"fused"`` or ``"unfused"`` — which decode step this engine
+        compiled (resolved once at construction; see docs/serving.md)."""
+        return self.core.decode_path
+
+    @property
+    def decode_fallback_reason(self):
+        """Why ``fused_decode=True`` fell back to the composed path
+        (``None`` when fused is active or the flag is off)."""
+        return self.core.decode_fallback_reason
 
     @property
     def tracer(self):

@@ -146,7 +146,8 @@ class EngineCore:
                  enable_prefix_cache: bool = True,
                  block_len: int = 16,
                  prefix_blocks: Optional[int] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 fused_decode: bool = False):
         if prefill_chunk is not None and prefill_chunk < min_bucket:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= min_bucket "
@@ -207,10 +208,22 @@ class EngineCore:
         self._prefill_fn: Optional[Callable] = None
         self._staging_init_fn: Optional[Callable] = None
         self.trace_counts = {"prefill": 0, "decode": 0}
+        # fused decode-block path (kernels/decode_block.py): opt-in flag,
+        # resolved STATICALLY here — legality (shape/dtype/VMEM plan) and
+        # routing never depend on runtime values, so the decode program
+        # set stays {chunk} + buckets + ONE decode either way.  The
+        # resolution lands in the decode_block obs event at compile time.
+        self.fused_decode = fused_decode
+        self.decode_path, self.decode_fallback_reason = \
+            self._resolve_decode_path()
         # telemetry plumbing: the step index keys every phase span, the
         # compile baseline turns trace-counter ticks into discrete
         # events, and the prefix cache reports evictions through a hook
         self._step_index = 0
+        # the step currently executing — lazily-built programs (e.g. the
+        # decode fn on the first dispatch) tag their obs events with
+        # this so they correlate with the surrounding serving.step span
+        self._step_in_flight = 0
         self._compile_seen: Dict[str, int] = {}
         if self.prefix_cache is not None:
             # evictions land on THIS engine's timeline lane, not the
@@ -396,15 +409,40 @@ class EngineCore:
         return len(staged)
 
     # ------------------------------------------------------------ decode
+    def _resolve_decode_path(self):
+        """Statically resolve fused-vs-unfused for THIS engine's shapes:
+        the flag opts in, ``decode_block_route`` applies the routing
+        policy (flags + measured win region), and the model's
+        ``fused_decode_supported`` checks shape/dtype/VMEM legality.
+        Returns ``(path, fallback_reason)``; reason is None when fused
+        engages (or the flag is simply off)."""
+        if not self.fused_decode:
+            return "unfused", None
+        from ..kernels.decode_block import resolve_fused_decode
+        ok, reason = resolve_fused_decode(self.model,
+                                          batch=self.num_slots,
+                                          kv_len=self.pool.max_seq)
+        return ("fused", None) if ok else ("unfused", reason)
+
     def _build_decode_fn(self) -> Callable:
         model = self.model
+        fused = self.decode_path == "fused"
+        # the discrete obs event marks WHICH path this engine's single
+        # decode program compiled with (and why, on fallback) — traces
+        # distinguish fused from unfused steps without diffing configs
+        self.metrics.on_decode_block(
+            active=fused,
+            reason=None if not self.fused_decode
+            else self.decode_fallback_reason,
+            step=self._step_in_flight)
 
         def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
                    temperature, top_k, top_p):
             self.trace_counts["decode"] += 1  # trace-time side effect
             caches = [(k, v, seq_pos) for k, v in zip(ks, vs)]
-            logits, caches = model.decode_step(last_tok[:, None], caches,
-                                               seq_pos)
+            step_fn = model.fused_decode_step if fused else \
+                model.decode_step
+            logits, caches = step_fn(last_tok[:, None], caches, seq_pos)
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             nxt = sample_rows(split[:, 1], logits[:, 0], do_sample,
                               temperature, top_k, top_p)
@@ -453,6 +491,7 @@ class EngineCore:
         tracer = self.metrics.tracer
         step_i = self._step_index
         self._step_index += 1
+        self._step_in_flight = step_i
         skips_before = self.scheduler.total_head_skips
         ann = None
         if self.metrics.record_events:
@@ -495,6 +534,11 @@ class EngineCore:
                 # histograms and fake slices into the timeline
                 phases += [("decode_dispatch", t_prefill, t_decode),
                            ("readback", t_decode, t_readback)]
+                if self.decode_path == "fused":
+                    # fused-path dispatch cost, separable from unfused
+                    # runs in the same registry (glossary:
+                    # kernel.decode_block_s, docs/observability.md)
+                    self.metrics.on_decode_block_step(t_decode - t_prefill)
             self._evict_finished()
         finally:
             # a raised step must still close the span and the trace

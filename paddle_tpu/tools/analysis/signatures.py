@@ -680,3 +680,49 @@ register_signature(
 register_signature(
     "paddle_tpu.kernels.fused_norm.fused_rms_norm_pallas",
     _first_arg_like)
+
+
+def _decode_block_arr(rec, i: int, name: str) -> Arr:
+    v = _arg(rec, i, name)
+    return v if isinstance(v, Arr) else Arr()
+
+
+def _decode_block_triple(interp, rec):
+    """``decode_block_layer`` / ``decode_block_reference``:
+    ``(y, k_slab', v_slab')`` — the fused layer step is shape/dtype
+    preserving on the activation (arg 0) and returns the slot slabs
+    (args 1/2) updated in place, so the engine's fixed-shape decode
+    discipline is provable straight through the call."""
+    return Tup((_decode_block_arr(rec, 0, "x"),
+                _decode_block_arr(rec, 1, "k_slab"),
+                _decode_block_arr(rec, 2, "v_slab")))
+
+
+def _decode_block_attn_sig(interp, rec):
+    """``decode_block_attn``: ``(attn [B, 1, H*Dh], k_slab', v_slab')``
+    — attn keeps x's dtype/tracedness; its head-concat width comes from
+    ``wq``'s trailing dim when known."""
+    x = _decode_block_arr(rec, 0, "x")
+    wq = _arg(rec, 6, "wq")
+    shape = None
+    if isinstance(x, Arr) and x.shape is not None and len(x.shape) == 3 \
+            and isinstance(wq, Arr) and wq.shape is not None \
+            and len(wq.shape) == 2:
+        shape = (x.shape[0], 1, wq.shape[1])
+    attn = Arr(shape=shape, dtype=x.dtype, traced=x.traced)
+    return Tup((attn, _decode_block_arr(rec, 1, "k_slab"),
+                _decode_block_arr(rec, 2, "v_slab")))
+
+
+register_signature(
+    "paddle_tpu.kernels.decode_block.decode_block_layer",
+    _decode_block_triple)
+register_signature(
+    "paddle_tpu.kernels.decode_block.decode_block_reference",
+    _decode_block_triple)
+register_signature(
+    "paddle_tpu.kernels.decode_block.decode_block_attn",
+    _decode_block_attn_sig)
+register_signature(
+    "paddle_tpu.kernels.decode_block.decode_block_mlp",
+    _first_arg_like)

@@ -148,12 +148,19 @@ def _kc_ok(ev):
     table carries >=2 shapes per kernel plus the routed-default column
     (which implementation kernels/routing.py actually picks, and its
     speedup over the alternative) — the round-3 verdict's item-1 "done"
-    criterion.  Requiring v2 makes the watchdog refresh v1 tables."""
+    criterion.  Requiring v2 makes the watchdog refresh v1 tables.
+
+    ISSUE 7 bumps the requirement to table_version >= 3: the v3 table
+    adds the fused-vs-unfused decode-block rows (``decode_block_kv*`` —
+    kernels/decode_block.py against the composed per-op decode step),
+    the evidence the ROADMAP names for the hbm_bw_util ceiling.
+    Requiring v3 makes the watchdog recapture v2 tables next time the
+    chip is reachable."""
     kc = ev.get("kernel_compare") if ev else None
     return (_kc_structural(ev)
             and isinstance(kc, dict)
             and kc.get("timing") == "scan-chained"
-            and kc.get("table_version", 1) >= 2)
+            and kc.get("table_version", 1) >= 3)
 
 
 def _is_full(ev):
@@ -519,7 +526,8 @@ def _kernel_compare(budget_s, seq=2048):
     rs = np.random.RandomState(0)
     res = {
         "timing": "scan-chained",
-        "table_version": 2,
+        # v3: + fused-vs-unfused decode-block rows (ISSUE 7)
+        "table_version": 3,
         "routing": "empirical per-shape table (paddle_tpu/kernels/"
                    "routing.py); default column = the router's pick",
         # VERDICT r2 item 7 tick-cost note (kept for the judge): the fused
@@ -634,6 +642,51 @@ def _kernel_compare(budget_s, seq=2048):
                    (q1, kc, vc),
                    _route("decode_attention", kv_len=sk),
                    extra={"ok": diff < 0.05, "max_abs_diff": round(diff, 4)}):
+            return res
+
+    # ---- fused decode block vs the composed unfused layer step at two
+    # cache lengths (ISSUE 7: the whole-layer decode megakernel —
+    # norm -> QKV -> in-kernel KV append -> streaming GQA attention ->
+    # out-proj -> SwiGLU MLP as the Pallas pair, against exactly the
+    # same math composed op-by-op).  The chain carries (x, k, v) ->
+    # (y, k2, v2): the activation feeds forward so XLA cannot elide a
+    # layer, and the slabs thread like the engine's donated pool
+    from paddle_tpu.kernels.decode_block import (decode_block_layer,
+                                                 decode_block_reference)
+    bq, hq, khq, dhq, ffq = 8, 8, 2, 128, 4096
+    dq = hq * dhq
+    for sk in (2048, 4096):
+        A = lambda *sh: jnp.asarray(rs.randn(*sh), jnp.bfloat16) * 0.05
+        kwb = dict(kv_heads=khq, head_dim=dhq, norm="rms", eps1=1e-5,
+                   eps2=1e-5, norm1_w=A(dq) + 1, norm1_b=None,
+                   wq=A(dq, hq * dhq), wk=A(dq, khq * dhq),
+                   wv=A(dq, khq * dhq), bq=None, bkv=None, bv=None,
+                   wo=A(hq * dhq, dq), bo=None, norm2_w=A(dq) + 1,
+                   norm2_b=None, w1=A(dq, ffq), b1=None, w2=A(ffq, dq),
+                   b2=None, w_gate=A(dq, ffq),
+                   rope_cos=jnp.ones((bq, dhq), jnp.float32),
+                   rope_sin=jnp.zeros((bq, dhq), jnp.float32))
+        xb = A(bq, 1, dq)
+        kb = A(bq, sk, khq, dhq)
+        vb = A(bq, sk, khq, dhq)
+        posb = jnp.asarray(rs.randint(sk // 2, sk, size=bq), jnp.int32)
+
+        def pstep(x, k, v):
+            return decode_block_layer(x, k, v, posb, interpret=False,
+                                      **kwb)
+
+        def xstep(x, k, v):
+            return decode_block_reference(x, k, v, posb, **kwb)
+
+        bdiff = float(jnp.max(jnp.abs(
+            jax.jit(pstep)(xb, kb, vb)[0].astype(jnp.float32)
+            - jax.jit(xstep)(xb, kb, vb)[0].astype(jnp.float32))))
+        if not row(f"decode_block_kv{sk}", pstep, xstep, (xb, kb, vb),
+                   _route("decode_block", kv_len=sk), iters=50,
+                   extra={"ok": bdiff < 0.05,
+                          "max_abs_diff": round(bdiff, 4),
+                          "config": f"b{bq}-h{hq}-kvh{khq}-dh{dhq}"
+                                    f"-ffn{ffq}-bf16"}):
             return res
 
     # ---- norms at two shapes (router: XLA wins everywhere measured)

@@ -1,0 +1,33 @@
+"""dtype-flow negative for the decode_block signatures: widened
+reductions and f32-preferred contractions downstream of the fused layer
+stay silent, as does an f32 activation."""
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block
+
+
+def logit_energy(k_slab, v_slab, pos, w, head):
+    x = jnp.zeros((4, 1, 64), jnp.bfloat16)
+    y, k2, v2 = paddle_tpu.kernels.decode_block.decode_block_layer(
+        x, k_slab, v_slab, pos, kv_heads=2, head_dim=16, norm="rms",
+        eps1=1e-5, eps2=1e-5, norm1_w=w, norm1_b=None, wq=w, wk=w, wv=w,
+        bq=None, bkv=None, bv=None, wo=w, bo=None, norm2_w=w,
+        norm2_b=None, w1=w, b1=None, w2=w, b2=None)
+    total = jnp.sum(y, dtype=jnp.float32)          # widened reduce
+    logits = jax.lax.dot_general(
+        y[:, 0], head.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # f32-preferred dot
+    return total, logits
+
+
+def f32_path(k_slab, v_slab, pos, w):
+    x = jnp.zeros((4, 1, 64), jnp.float32)
+    y, k2, v2 = paddle_tpu.kernels.decode_block.decode_block_layer(
+        x, k_slab, v_slab, pos, kv_heads=2, head_dim=16, norm="rms",
+        eps1=1e-5, eps2=1e-5, norm1_w=w, norm1_b=None, wq=w, wk=w, wv=w,
+        bq=None, bkv=None, bv=None, wo=w, bo=None, norm2_w=w,
+        norm2_b=None, w1=w, b1=None, w2=w, b2=None)
+    return jnp.sum(y)                              # f32 reduce: fine

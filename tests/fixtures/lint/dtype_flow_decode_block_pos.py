@@ -1,0 +1,21 @@
+"""dtype-flow positives THROUGH the decode_block signatures: the
+registered summaries carry the activation's dtype onto the kernel's
+outputs, so 16-bit accumulation hazards downstream of the fused layer
+are provable.  Two planted bugs: a bf16 sum of the fused activation
+without a widening dtype=, and a bf16 @-contraction of it."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block
+
+
+def logit_energy(k_slab, v_slab, pos, w, head):
+    x = jnp.zeros((4, 1, 64), jnp.bfloat16)
+    y, k2, v2 = paddle_tpu.kernels.decode_block.decode_block_layer(
+        x, k_slab, v_slab, pos, kv_heads=2, head_dim=16, norm="rms",
+        eps1=1e-5, eps2=1e-5, norm1_w=w, norm1_b=None, wq=w, wk=w, wv=w,
+        bq=None, bkv=None, bv=None, wo=w, bo=None, norm2_w=w,
+        norm2_b=None, w1=w, b1=None, w2=w, b2=None)
+    total = jnp.sum(y)                    # 1: bf16 accumulation
+    head16 = head.astype(jnp.bfloat16)
+    return total, y[:, 0] @ head16        # 2: bf16 @ contraction

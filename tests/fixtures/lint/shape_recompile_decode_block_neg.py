@@ -1,0 +1,21 @@
+"""recompile-shape negative for the decode_block signatures: the
+engine's real usage pattern — fixed-shape threading of the returned
+``(y, k_slab', v_slab')`` triple, static slicing, shape-derived
+reshapes — stays silent."""
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block
+
+
+@jax.jit
+def decode_step(x, k_slab, v_slab, pos, w):
+    y, k2, v2 = paddle_tpu.kernels.decode_block.decode_block_layer(
+        x, k_slab, v_slab, pos, kv_heads=2, head_dim=16, norm="rms",
+        eps1=1e-5, eps2=1e-5, norm1_w=w, norm1_b=None, wq=w, wk=w, wv=w,
+        bq=None, bkv=None, bv=None, wo=w, bo=None, norm2_w=w,
+        norm2_b=None, w1=w, b1=None, w2=w, b2=None)
+    b = y.shape[0]
+    logits = y.reshape(b, -1)             # shape-derived: static
+    return logits[:, :8], k2, v2          # static slice bounds

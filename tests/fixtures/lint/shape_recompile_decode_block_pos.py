@@ -1,0 +1,30 @@
+"""recompile-shape positives THROUGH the decode_block signatures: the
+registered summaries return ``(y, k_slab', v_slab')`` with the inputs'
+shapes/tracedness, so hazards on the kernel's OUTPUTS are provable at
+the call site.  Two planted violations: a boolean-mask index on the
+returned slab, and a traced slice bound on the fused activation."""
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block
+
+
+@jax.jit
+def live_rows(x, k_slab, v_slab, pos, w):
+    y, k2, v2 = paddle_tpu.kernels.decode_block.decode_block_layer(
+        x, k_slab, v_slab, pos, kv_heads=2, head_dim=16, norm="rms",
+        eps1=1e-5, eps2=1e-5, norm1_w=w, norm1_b=None, wq=w, wk=w, wv=w,
+        bq=None, bkv=None, bv=None, wo=w, bo=None, norm2_w=w,
+        norm2_b=None, w1=w, b1=None, w2=w, b2=None)
+    return k2[k2 > 0]                     # 1: boolean-mask on the slab
+
+
+@jax.jit
+def head_of(x, k_slab, v_slab, pos, w, n):
+    y, k2, v2 = paddle_tpu.kernels.decode_block.decode_block_layer(
+        x, k_slab, v_slab, pos, kv_heads=2, head_dim=16, norm="rms",
+        eps1=1e-5, eps2=1e-5, norm1_w=w, norm1_b=None, wq=w, wk=w, wv=w,
+        bq=None, bkv=None, bv=None, wo=w, bo=None, norm2_w=w,
+        norm2_b=None, w1=w, b1=None, w2=w, b2=None)
+    return y[:n]                          # 2: traced slice width

@@ -43,10 +43,13 @@ def _rows(n, **extra):
 
 
 V1 = _rows(6)                                    # per-dispatch (no marker)
-# honest complete: scan-chained AND table_version >= 2 (the r4 format
-# with routed-default columns; _kc_ok requires both markers)
+# r4 format: scan-chained + routed-default columns, but no decode-block
+# rows — ISSUE 7 demotes it to "needs refresh"
 V2 = _rows(6, timing="scan-chained", table_version=2)
-V2_PARTIAL = _rows(3, timing="scan-chained", table_version=2,
+# honest complete: scan-chained AND table_version >= 3 (the ISSUE 7
+# format with the fused-vs-unfused decode_block_* rows)
+V3 = _rows(6, timing="scan-chained", table_version=3)
+V3_PARTIAL = _rows(3, timing="scan-chained", table_version=3,
                    truncated="budget")
 # r4 secondary format: training rows must carry {config, mfu}
 SEC = {m: {"step_ms": 5.0, "items_per_sec": 1.0, "config": "b1-test",
@@ -62,9 +65,9 @@ def _promote(eb):
         return json.load(f)
 
 
-def test_v2_table_upgrades_over_v1(tmp_path):
+def test_v3_table_upgrades_over_v1(tmp_path):
     eb = _bench(tmp_path, canonical=_good(kc=V1))
-    eb.EV = _good(kc=V2)
+    eb.EV = _good(kc=V3)
     out = _promote(eb)
     assert out["kernel_compare"].get("timing") == "scan-chained"
     assert eb._is_full(out)
@@ -75,7 +78,7 @@ def test_honest_partial_not_replaced_by_dispatch_complete(tmp_path):
     the old per-dispatch table (documented invalid) may NOT overwrite
     them via carry."""
     eb = _bench(tmp_path, canonical=_good(kc=V1))
-    eb.EV = _good(kc=V2_PARTIAL)
+    eb.EV = _good(kc=V3_PARTIAL)
     out = _promote(eb)
     assert out["kernel_compare"].get("timing") == "scan-chained"
     assert "truncated" in out["kernel_compare"]
@@ -91,8 +94,8 @@ def test_zero_row_run_carries_old_table(tmp_path):
 
 def test_scan_chained_complete_carries_over_new_partial(tmp_path):
     """Old HONEST-complete beats a fresh truncated run: carry."""
-    eb = _bench(tmp_path, canonical=_good(kc=V2))
-    eb.EV = _good(kc=V2_PARTIAL)
+    eb = _bench(tmp_path, canonical=_good(kc=V3))
+    eb.EV = _good(kc=V3_PARTIAL)
     out = _promote(eb)
     assert "truncated" not in out["kernel_compare"]
     assert len([v for v in out["kernel_compare"].values()
@@ -100,7 +103,7 @@ def test_scan_chained_complete_carries_over_new_partial(tmp_path):
 
 
 def test_lower_mfu_does_not_promote(tmp_path):
-    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V2, sec=SEC))
+    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V3, sec=SEC))
     eb.EV = _good(mfu=0.40)
     out = _promote(eb)
     assert out["mfu"] == 0.63
@@ -109,7 +112,7 @@ def test_lower_mfu_does_not_promote(tmp_path):
 def test_higher_mfu_promotes_and_carries_sections(tmp_path):
     """The b8-experiment shape: a bench-only higher-MFU run keeps the
     old kernel table AND secondary."""
-    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V2, sec=SEC))
+    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V3, sec=SEC))
     eb.EV = _good(mfu=0.70)
     out = _promote(eb)
     assert out["mfu"] == 0.70
@@ -119,8 +122,8 @@ def test_higher_mfu_promotes_and_carries_sections(tmp_path):
 
 
 def test_new_secondary_promotes_at_comparable_mfu(tmp_path):
-    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V2))
-    eb.EV = _good(mfu=0.60, kc=V2, sec=SEC)
+    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V3))
+    eb.EV = _good(mfu=0.60, kc=V3, sec=SEC)
     out = _promote(eb)
     assert eb._sec_ok(out)
 
@@ -144,7 +147,17 @@ def test_v1_scan_chained_table_no_longer_counts_as_ok(tmp_path):
     eb = _bench(tmp_path)
     old_format = _good(kc=_rows(6, timing="scan-chained"))
     assert not eb._kc_ok(old_format)
-    assert eb._kc_ok(_good(kc=V2))
+    assert eb._kc_ok(_good(kc=V3))
+
+
+def test_v2_table_no_longer_counts_as_ok(tmp_path):
+    """ISSUE 7 gate: a v2 table (routed-default columns but no
+    fused-vs-unfused decode_block_* rows) reads as not-ok, so the
+    watchdog recaptures the kernel table — with the new rows — next
+    time the chip is reachable."""
+    eb = _bench(tmp_path)
+    assert not eb._kc_ok(_good(kc=V2))
+    assert eb._kc_ok(_good(kc=V3))
 
 
 def test_configless_secondary_no_longer_counts_as_ok(tmp_path):

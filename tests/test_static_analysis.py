@@ -508,6 +508,45 @@ def test_dtype_flow_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_recompile_shape_through_decode_block_signature():
+    """ISSUE 7: the decode_block signatures flow ``(y, k_slab', v_slab')``
+    through call sites, so fixed-shape hazards on the fused kernel's
+    OUTPUTS are provable — exactly 2 planted (bool-mask on the returned
+    slab, traced slice bound on the activation)."""
+    res = run_rule("shape_recompile_decode_block_pos.py",
+                   "recompile-shape")
+    found = only_rule(res, "recompile-shape")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "boolean-mask" in msgs
+    assert "slice bound" in msgs
+
+
+def test_recompile_shape_decode_block_negative():
+    """The engine's real decode_block usage — fixed-shape triple
+    threading, shape-derived reshape, static slices — stays silent."""
+    res = run_rule("shape_recompile_decode_block_neg.py",
+                   "recompile-shape")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_dtype_flow_through_decode_block_signature():
+    """The decode_block summaries carry the activation dtype onto the
+    outputs: exactly 2 planted bf16 accumulation bugs downstream of the
+    fused layer (bf16 sum, bf16 @-contraction)."""
+    res = run_rule("dtype_flow_decode_block_pos.py", "dtype-flow")
+    found = only_rule(res, "dtype-flow")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "accumulates in bfloat16" in msgs
+    assert "@ on bfloat16" in msgs
+
+
+def test_dtype_flow_decode_block_negative():
+    res = run_rule("dtype_flow_decode_block_neg.py", "dtype-flow")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_dtype_flow_default_hot_paths_cover_kernels_and_optimizer():
     import fnmatch
     from paddle_tpu.tools.analysis.checkers.dtype_flow import \
@@ -612,7 +651,11 @@ def test_repo_kernel_signatures_shipped():
     for key in ("paddle_tpu.kernels.flash_attention.flash_attention",
                 "paddle_tpu.kernels.flash_attention"
                 ".flash_attention_with_lse",
-                "paddle_tpu.kernels.fused_norm.fused_rms_norm_pallas"):
+                "paddle_tpu.kernels.fused_norm.fused_rms_norm_pallas",
+                "paddle_tpu.kernels.decode_block.decode_block_layer",
+                "paddle_tpu.kernels.decode_block.decode_block_attn",
+                "paddle_tpu.kernels.decode_block.decode_block_mlp",
+                "paddle_tpu.kernels.decode_block.decode_block_reference"):
         assert key in SIGNATURES, key
 
 
