@@ -712,6 +712,20 @@ def _secondary_benches(smoke=False):
         out["truncated"] = "budget"
         return out
 
+    # 6d fault-tolerant serving — the serving_continuous workload with
+    # one injected fault burst mid-run (ISSUE 8): the watchdog retries,
+    # quarantines, rebuilds the device plane and re-serves queued work.
+    # Reported next to serving_continuous so the robustness tax (recovery
+    # wall time, requests sacrificed, tok/s across the rebuild) is
+    # tracked per round.
+    try:
+        out["serving_degraded"] = _serving_degraded_bench(dm, smoke=smoke)
+    except Exception as e:
+        out["serving_degraded"] = {"error": repr(e)[-300:]}
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
     # 7 int8 weight-only decode — the same loop with quantized weight
     # storage (decode is weight-HBM-bound; this row measures the payoff)
     try:
@@ -878,6 +892,112 @@ def _serving_bench(model, smoke=False):
         "steps": m["steps"],
         "wall_s": round(wall, 2),
         "config": f"slots{slots}-reqs{n_reqs}-mixed-arrival",
+    }
+
+
+def _serving_degraded_bench(model, smoke=False):
+    """Fault-tolerant serving row: the serving_continuous mixed-arrival
+    workload replayed with one injected step-fault burst mid-run, sized
+    to spend the retry budget and force a QUARANTINE rebuild (the most
+    expensive rung of the recovery matrix in docs/serving.md).  Reports
+    recovery wall time (first fault -> first token after the rebuild),
+    requests failed vs completed, and tok/s before the fault vs after
+    recovery.  A warmup pass (no faults) compiles every program first, so
+    the recovery time measures the rebuild + re-trace, not cold tracing."""
+    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                    ServingEngine)
+
+    rs = np.random.RandomState(7)
+    vocab = model.cfg.vocab_size
+    if smoke:
+        slots, n_reqs, base_new = 2, 6, 8
+        lens = [3, 9, 5, 12, 7, 4]
+        fault_at = 6               # mid-run: both waves submitted
+    else:
+        slots, n_reqs, base_new = 8, 24, 96
+        lens = list(rs.randint(16, 257, size=n_reqs))
+        fault_at = 40
+    retries = 2
+    ft = FaultToleranceConfig(max_step_retries=retries,
+                              backoff_base_s=0.0)
+    faults = FaultInjector()
+    eng = ServingEngine(model, num_slots=slots, fault_tolerance=ft,
+                        faults=faults)
+    prompts = [rs.randint(0, vocab, (int(L),)) for L in lens]
+    news = [base_new + (i % 3) * (2 if smoke else 32)
+            for i in range(n_reqs)]
+
+    def toks(ids):
+        return sum(len(eng._requests[i].tokens) for i in ids)
+
+    def run_armed():
+        first = [eng.submit(p, max_new_tokens=n)
+                 for p, n in zip(prompts[:n_reqs // 2],
+                                 news[:n_reqs // 2])]
+        for _ in range(3):          # second wave arrives mid-decode
+            eng.step()
+        ids = first + [eng.submit(p, max_new_tokens=n)
+                       for p, n in zip(prompts[n_reqs // 2:],
+                                       news[n_reqs // 2:])]
+        t0 = time.perf_counter()
+        t_fault = t_recovered = None
+        toks_at_fault = 0
+        steps = 0
+        while eng.core.scheduler.has_work():
+            steps += 1
+            if steps > 20000:
+                raise RuntimeError("degraded workload did not drain")
+            before = toks(ids)
+            eng.step()
+            now = time.perf_counter()
+            if t_fault is None and faults.fired["step"]:
+                t_fault, toks_at_fault = now, before
+            elif t_fault is not None and t_recovered is None \
+                    and toks(ids) > toks_at_fault:
+                t_recovered = now   # first token on the rebuilt plane
+        return ids, t0, t_fault, t_recovered, toks_at_fault
+
+    # warmup (unarmed): compile every bucket + the decode program
+    w = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_complete(max_steps=20000)
+    for i in w:
+        eng.purge(i)
+    eng.metrics.reset()
+    # retries + 1 consecutive step faults -> one quarantine rebuild
+    faults.enable("step", at=fault_at, times=retries + 1)
+    try:
+        ids, t0, t_fault, t_recovered, toks_at_fault = run_armed()
+    finally:
+        faults.disable("step")
+    t_end = time.perf_counter()
+    outs = [eng.purge(i) for i in ids]
+    m = eng.metrics_dict()
+    completed = sum(1 for o in outs if o.status == "finished")
+    failed = sum(1 for o in outs if o.status == "failed")
+    total = sum(len(o.tokens) for o in outs)
+    tps_before = (round(toks_at_fault / (t_fault - t0), 1)
+                  if t_fault is not None and t_fault > t0 else None)
+    tps_after = (round((total - toks_at_fault) / (t_end - t_recovered), 1)
+                 if t_recovered is not None and t_end > t_recovered
+                 else None)
+    return {
+        "requests": n_reqs,
+        "completed": completed,
+        "failed": failed,
+        "num_slots": slots,
+        "fault": f"step@{fault_at} x{retries + 1} (-> quarantine)",
+        "faults_observed": m["faults"],
+        "step_retries": m["step_retries"],
+        "quarantines": m["quarantines"],
+        "recovery_s": (round(t_recovered - t_fault, 3)
+                       if t_recovered is not None and t_fault is not None
+                       else None),
+        "tokens_per_sec_before_fault": tps_before,
+        "tokens_per_sec_after_recovery": tps_after,
+        "tokens_per_sec_overall": m["tokens_per_sec"],
+        "health": eng.health.state,
+        "wall_s": round(t_end - t0, 2),
+        "config": f"slots{slots}-reqs{n_reqs}-mixed-arrival-1-fault",
     }
 
 

@@ -14,13 +14,21 @@ See docs/serving.md and docs/observability.md.
 """
 
 from .api import Request, RequestOutput, SamplingParams, ServingEngine
-from .engine import EngineCore, sample_rows
+from .engine import EngineCore, finite_or_sentinel, sample_rows
+from .errors import EngineStalledError, RequestRejected
+from .faults import FaultError, FaultInjector
+from .health import (DegradationLadder, EngineHealth,
+                     FaultToleranceConfig)
 from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
 from .prefix_cache import MatchResult, PrefixCache
 from .scheduler import Scheduler, bucket_length
 
 __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
-           "EngineCore", "sample_rows", "KVPool", "BlockPool",
-           "PrefixCache", "MatchResult", "ServingMetrics",
-           "Scheduler", "bucket_length"]
+           "EngineCore", "sample_rows", "finite_or_sentinel", "KVPool",
+           "BlockPool", "PrefixCache", "MatchResult", "ServingMetrics",
+           "Scheduler", "bucket_length",
+           # fault-tolerance surface (docs/serving.md "Fault tolerance")
+           "FaultToleranceConfig", "EngineHealth", "DegradationLadder",
+           "FaultInjector", "FaultError", "RequestRejected",
+           "EngineStalledError"]

@@ -27,6 +27,8 @@ from typing import Callable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from .engine import EngineCore
+from .errors import RequestRejected
+from .health import FaultToleranceConfig
 from .metrics import ServingMetrics
 from .scheduler import Request, SamplingParams
 
@@ -43,6 +45,13 @@ class RequestOutput:
     finish_reason: Optional[str]      # "eos" | "length" | None
     ttft_s: Optional[float]           # submit -> first token
     prefix_hit_tokens: int = 0        # prompt tokens served from cache
+    # terminal disposition (docs/serving.md "Fault tolerance"): exactly
+    # one of "finished" | "cancelled" | "deadline_exceeded" |
+    # "rejected" | "failed" once the request is done (None in flight);
+    # status_reason carries the why ("eos", "TTFT deadline ...", the
+    # fault repr, ...) so no request ever ends ambiguously
+    status: Optional[str] = None
+    status_reason: Optional[str] = None
 
     @property
     def sequence(self) -> np.ndarray:
@@ -83,7 +92,10 @@ class ServingEngine:
                  prefix_blocks: Optional[int] = None,
                  record_events: bool = False,
                  registry=None, tracer=None,
-                 fused_decode: bool = False):
+                 fused_decode: bool = False,
+                 fault_tolerance: Optional[FaultToleranceConfig] = None,
+                 faults=None,
+                 max_queue: Optional[int] = None):
         # registry/tracer (paddle_tpu.obs) may be shared across engines
         # (a fleet scraping one Prometheus surface: shared instruments
         # aggregate, lanes come from per-engine blocks); default: private
@@ -97,31 +109,87 @@ class ServingEngine:
             block_len=block_len, prefix_blocks=prefix_blocks,
             metrics=ServingMetrics(record_events=record_events,
                                    registry=registry, tracer=tracer),
-            fused_decode=fused_decode)
+            fused_decode=fused_decode,
+            fault_tolerance=fault_tolerance, faults=faults,
+            max_queue=max_queue)
         self._requests = {}
 
     # -------------------------------------------------------- submission
     def submit(self, prompt, max_new_tokens: int = 16,
                sampling: Optional[SamplingParams] = None,
                eos_token_id: Optional[int] = None,
-               stream: Optional[Callable] = None) -> int:
+               stream: Optional[Callable] = None,
+               deadline_s: Optional[float] = None,
+               ttft_deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its id (admission happens inside a
         later ``step()`` — submit never blocks on the device).
 
         ``stream`` is called as ``stream(request, token)`` the moment
-        each token is harvested, while other requests keep decoding."""
+        each token is harvested, while other requests keep decoding.
+
+        Everything knowable at submit time is validated HERE, before the
+        request enters the system (``ValueError`` — caller bug), and
+        backpressure is applied here too (:class:`RequestRejected` with
+        a retry-after hint — healthy-system flow control): bounded queue
+        (``max_queue``), SLO-aware rejection when the projected TTFT
+        already exceeds ``ttft_deadline_s``, circuit-open fail-fast.
+        ``deadline_s``/``ttft_deadline_s`` are seconds relative to this
+        call, checked host-side every step; a blown deadline unwinds the
+        request with terminal status ``deadline_exceeded``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError(
+                "prompt is empty (no tokens survive int32 flattening) — "
+                "at least one token is required")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        max_seq = self.core.pool.max_seq
+        if prompt.size + max_new_tokens > max_seq:
+            raise ValueError(
+                f"prompt_len {prompt.size} + max_new_tokens "
+                f"{max_new_tokens} exceeds the pool max_seq {max_seq} — "
+                f"the request could never be placed; truncate the "
+                f"prompt or lower max_new_tokens")
+        for name, d in (("deadline_s", deadline_s),
+                        ("ttft_deadline_s", ttft_deadline_s)):
+            if d is not None and d < 0:
+                raise ValueError(f"{name} must be >= 0, got {d}")
+        sampling = sampling or SamplingParams()
+        sampling.validate()
         sched = self.core.scheduler
         req = Request(request_id=sched.next_request_id(),
                       prompt=prompt, max_new_tokens=max_new_tokens,
-                      sampling=sampling or SamplingParams(),
-                      eos_token_id=eos_token_id, stream=stream)
+                      sampling=sampling,
+                      eos_token_id=eos_token_id, stream=stream,
+                      deadline_s=deadline_s,
+                      ttft_deadline_s=ttft_deadline_s)
+        try:
+            self.core.check_admission(req)
+        except RequestRejected as e:
+            e.output = RequestOutput(
+                request_id=req.request_id, prompt=req.prompt, tokens=[],
+                finished=True, finish_reason=None, ttft_s=None,
+                status="rejected", status_reason=e.reason)
+            raise
         sched.submit(req)
         self._requests[req.request_id] = req
         self.core.metrics.on_submit()
         return req.request_id
+
+    def cancel(self, request_id: int) -> RequestOutput:
+        """Cleanly unwind one request in any state — queued, mid-
+        (chunked-)prefill, or decoding — freeing its pool slot, staging
+        rows and pinned radix path immediately; returns the terminal
+        view (status ``cancelled``, or the earlier terminal status if
+        the request had already ended: cancellation is idempotent)."""
+        req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(
+                f"unknown request_id {request_id} — never submitted to "
+                f"this engine, or already purged")
+        if not req.finished:
+            self.core.cancel(request_id)
+        return self.result(request_id)
 
     # -------------------------------------------------------- execution
     def step(self) -> int:
@@ -143,8 +211,10 @@ class ServingEngine:
                 return
             self.core.step()
 
-    def run_until_complete(self, max_steps: Optional[int] = None) -> int:
-        return self.core.run_until_complete(max_steps)
+    def run_until_complete(self, max_steps: Optional[int] = None,
+                           stall_steps: Optional[int] = 64) -> int:
+        return self.core.run_until_complete(max_steps,
+                                            stall_steps=stall_steps)
 
     # ----------------------------------------------------------- results
     def result(self, request_id: int) -> RequestOutput:
@@ -155,16 +225,23 @@ class ServingEngine:
         return RequestOutput(request_id=req.request_id, prompt=req.prompt,
                              tokens=list(req.tokens), finished=req.finished,
                              finish_reason=req.finish_reason, ttft_s=ttft,
-                             prefix_hit_tokens=req.prefix_hit_tokens)
+                             prefix_hit_tokens=req.prefix_hit_tokens,
+                             status=req.status,
+                             status_reason=req.status_reason)
 
     def purge(self, request_id: int) -> RequestOutput:
-        """``result()`` + drop the engine's reference to the finished
-        request.  Long-running servers MUST consume results this way (or
-        call it after ``result()``): the engine otherwise keeps every
-        prompt/token list for its whole lifetime."""
+        """``result()`` + drop the engine's reference to the request.
+        Long-running servers MUST consume results this way (or call it
+        after ``result()``): the engine otherwise keeps every
+        prompt/token list for its whole lifetime.  Purging a request
+        that is STILL IN FLIGHT cancels it first (queued, mid-chunked-
+        prefill, or decoding — slot, staging rows and radix pin are all
+        returned), so an abandoning client always leaves the engine
+        clean."""
         req = self._requests[request_id]
         if not req.finished:
-            raise ValueError(f"request {request_id} is still in flight")
+            self.core.cancel(request_id,
+                             reason="purged while in flight")
         out = self.result(request_id)
         del self._requests[request_id]
         return out
@@ -218,6 +295,20 @@ class ServingEngine:
         compile/eviction/skip event log; ``.chrome_events()`` exports
         request lanes for ``profiler.export_chrome_tracing`` merges."""
         return self.core.metrics.tracer
+
+    @property
+    def health(self):
+        """The engine's :class:`~paddle_tpu.serving.health.EngineHealth`
+        state machine (``.state`` is ``healthy | degraded | quarantined
+        | circuit_open``); see docs/serving.md "Fault tolerance"."""
+        return self.core.health
+
+    @property
+    def degraded_subsystems(self):
+        """Optional subsystems the degradation ladder has disabled
+        (subset of ``("prefix_cache", "chunked_prefill",
+        "fused_decode")``; empty = full service)."""
+        return self.core.ladder.disabled_subsystems
 
     def close(self) -> None:
         """Detach this engine's telemetry from process-global hooks (the

@@ -53,12 +53,32 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import _filter_top_p
+from .errors import EngineStalledError, RequestRejected
+from .health import (DegradationLadder, EngineHealth,
+                     FaultToleranceConfig)
 from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
 from .prefix_cache import MatchResult, PrefixCache
 from .scheduler import Request, Scheduler
 
-__all__ = ["EngineCore", "sample_rows"]
+__all__ = ["EngineCore", "sample_rows", "finite_or_sentinel",
+           "NONFINITE_SENTINEL"]
+
+# token-readback encoding of the device-side health check: a decode row
+# whose logits hold a non-finite value reads back as this instead of a
+# token id (ids are always >= 0, so the sentinel is unambiguous) — the
+# watchdog detects poisoned steps without adding a second device sync
+NONFINITE_SENTINEL = -1
+
+
+def finite_or_sentinel(logits, toks):
+    """Encode per-row logits health into the sampled-token vector:
+    ``toks[r]`` when ``logits[r]`` is all-finite, else
+    :data:`NONFINITE_SENTINEL`.  Runs inside the decode program (and on
+    the prefill first-token path), so non-finite detection rides the
+    step's existing single readback."""
+    ok = jnp.all(jnp.isfinite(logits), axis=-1)
+    return jnp.where(ok, toks, NONFINITE_SENTINEL)
 
 
 def _filter_top_k_rows(logits, top_k):
@@ -147,7 +167,10 @@ class EngineCore:
                  block_len: int = 16,
                  prefix_blocks: Optional[int] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 fused_decode: bool = False):
+                 fused_decode: bool = False,
+                 fault_tolerance: Optional[FaultToleranceConfig] = None,
+                 faults=None,
+                 max_queue: Optional[int] = None):
         if prefill_chunk is not None and prefill_chunk < min_bucket:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= min_bucket "
@@ -155,18 +178,89 @@ class EngineCore:
         if max_prefill_tokens_per_step is not None \
                 and max_prefill_tokens_per_step < 1:
             raise ValueError("max_prefill_tokens_per_step must be >= 1")
+        if enable_prefix_cache and block_len < 1:
+            raise ValueError("block_len must be >= 1")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None)")
         self.model = model
-        self.pool = KVPool.create(model, num_slots, max_seq)
+        self.num_slots = num_slots
+        self.prefill_chunk = prefill_chunk
+        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
+        self.metrics = metrics or ServingMetrics()
+        # ---- robustness plumbing (docs/serving.md "Fault tolerance"):
+        # the watchdog (step retry/backoff, degradation ladder,
+        # quarantine rebuild, circuit breaker) engages only with an
+        # explicit fault_tolerance config — without one the engine
+        # raises exactly as before, so callers that own recovery keep
+        # their semantics.  Deadlines, cancel() and backpressure are
+        # always available.
+        self.faults = faults                    # serving/faults.py hook
+        self.fault_tolerant = fault_tolerance is not None
+        self.ft = fault_tolerance if fault_tolerance is not None \
+            else FaultToleranceConfig()
+        self.health = EngineHealth(self.ft)
+        self.ladder = DegradationLadder(self.ft.ladder_threshold)
+        self.prefix_bypass = False              # ladder: cache disabled
+        self.max_queue = max_queue if max_queue is not None \
+            else self.ft.max_queue
+        # monotone work marker: tokens emitted, admissions, prefill
+        # chunks and terminal dispositions all bump it — the
+        # run_until_complete stall detector watches it flatline
+        self.progress_counter = 0
+        self._deadlines_possible = False        # skip the per-step scan
+        self._fault_phase: Optional[str] = None  # watchdog attribution
+        # device-plane construction args, kept verbatim so a quarantine
+        # rebuild (_build_device_plane) re-runs the same construction
+        self._max_seq_arg = max_seq
+        self._enable_prefix_cache = enable_prefix_cache
+        self._block_len_arg = block_len
+        self._prefix_blocks_arg = prefix_blocks
+        # compiled-program trace counters: ONE decode fn + ONE prefill
+        # fn whose jit cache is keyed by the [1, width] chunk shape (one
+        # program per chunk width / pow2 bucket, nothing per length);
+        # these (plus BlockPool.trace_counts for the two block-copy
+        # programs) are what the compile-count guard tests assert on.
+        # Engine-lifetime: a quarantine rebuild re-traces ON TOP of them
+        # (exactly one more decode program, the same bucket set).
+        self.trace_counts = {"prefill": 0, "decode": 0}
+        self._compile_seen: Dict[str, int] = {}
+        # telemetry plumbing: the step index keys every phase span; the
+        # step currently executing tags lazily-built programs' obs
+        # events so they correlate with the surrounding serving.step span
+        self._step_index = 0
+        self._step_in_flight = 0
+        self._build_device_plane()
         self.scheduler = Scheduler(num_slots, self.pool.max_seq,
                                    min_bucket=min_bucket,
                                    max_prefills_per_step=max_prefills_per_step)
-        self.prefill_chunk = prefill_chunk
-        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
+        # fused decode-block path (kernels/decode_block.py): opt-in flag,
+        # resolved STATICALLY here — legality (shape/dtype/VMEM plan) and
+        # routing never depend on runtime values, so the decode program
+        # set stays {chunk} + buckets + ONE decode either way.  The
+        # resolution lands in the decode_block obs event at compile time.
+        self.fused_decode = fused_decode
+        self.decode_path, self.decode_fallback_reason = \
+            self._resolve_decode_path()
+
+    def _build_device_plane(self) -> None:
+        """Construct (or, on quarantine, RECONSTRUCT) everything that
+        lives on the device or mirrors it: the KV pools, the prefix
+        cache, per-slot row state and the compiled-program handles.  The
+        scheduler, metrics, health state and queue are deliberately NOT
+        touched — a rebuild must preserve queued work and telemetry.
+        Fresh handles mean the jit wrappers re-trace on next use; the
+        program SET stays {chunk} + buckets + ONE decode (pinned by the
+        chaos suite's post-quarantine compile test)."""
+        model, num_slots = self.model, self.num_slots
+        self.pool = KVPool.create(model, num_slots, self._max_seq_arg)
+        self.pool.faults = self.faults
         self.prefix_cache: Optional[PrefixCache] = None
         self.block_pool: Optional[BlockPool] = None
-        if enable_prefix_cache:
-            if block_len < 1:
-                raise ValueError("block_len must be >= 1")
+        # once the degradation ladder bypassed the cache, a quarantine
+        # rebuild must not re-allocate its block slab: _cache_active
+        # guarantees nothing would ever read or write it again
+        if self._enable_prefix_cache and not self.prefix_bypass:
+            block_len = self._block_len_arg
             # block_len must tile the slot row; shrink to the largest
             # pow2 divisor of max_seq when the requested size doesn't
             # (pow2 max_seqs — the common case — keep a pow2 request
@@ -178,13 +272,18 @@ class EngineCore:
                 block_len //= 2
             # default pool size: as many blocks as the slot pool has rows
             # of context — a second slab the size of the first
-            nb = prefix_blocks if prefix_blocks is not None else \
+            nb = self._prefix_blocks_arg \
+                if self._prefix_blocks_arg is not None else \
                 num_slots * (self.pool.max_seq // block_len)
             self.block_pool = BlockPool.create(model, nb, block_len,
                                                self.pool.max_seq)
+            self.block_pool.faults = self.faults
             self.prefix_cache = PrefixCache(self.block_pool)
-        self.metrics = metrics or ServingMetrics()
-        self.num_slots = num_slots
+            self.prefix_cache.faults = self.faults
+            # evictions land on THIS engine's timeline lane, not the
+            # tracer's default lane 0 (another engine's, under sharing)
+            self.prefix_cache.on_event = functools.partial(
+                self.metrics.tracer.event, lane=self.metrics.engine_lane)
         self._slots: Dict[int, _Slot] = {}
         self._prefills: List[_Prefill] = []      # FCFS, mid-prefill
         # per-slot device row state (fixed [num_slots] shapes)
@@ -199,37 +298,13 @@ class EngineCore:
         self._top_k = np.zeros((num_slots,), np.int32)
         self._top_p = np.ones((num_slots,), np.float32)
         self._sampling_dev: Optional[Tuple] = None
-        # compiled programs: ONE decode fn + ONE prefill fn whose jit
-        # cache is keyed by the [1, width] chunk shape (one program per
-        # chunk width / pow2 bucket, nothing per length); the trace
-        # counters (plus BlockPool.trace_counts for the two block-copy
-        # programs) are what the compile-count guard tests assert on
         self._decode_fn = None
         self._prefill_fn: Optional[Callable] = None
         self._staging_init_fn: Optional[Callable] = None
-        self.trace_counts = {"prefill": 0, "decode": 0}
-        # fused decode-block path (kernels/decode_block.py): opt-in flag,
-        # resolved STATICALLY here — legality (shape/dtype/VMEM plan) and
-        # routing never depend on runtime values, so the decode program
-        # set stays {chunk} + buckets + ONE decode either way.  The
-        # resolution lands in the decode_block obs event at compile time.
-        self.fused_decode = fused_decode
-        self.decode_path, self.decode_fallback_reason = \
-            self._resolve_decode_path()
-        # telemetry plumbing: the step index keys every phase span, the
-        # compile baseline turns trace-counter ticks into discrete
-        # events, and the prefix cache reports evictions through a hook
-        self._step_index = 0
-        # the step currently executing — lazily-built programs (e.g. the
-        # decode fn on the first dispatch) tag their obs events with
-        # this so they correlate with the surrounding serving.step span
-        self._step_in_flight = 0
-        self._compile_seen: Dict[str, int] = {}
-        if self.prefix_cache is not None:
-            # evictions land on THIS engine's timeline lane, not the
-            # tracer's default lane 0 (another engine's, under sharing)
-            self.prefix_cache.on_event = functools.partial(
-                self.metrics.tracer.event, lane=self.metrics.engine_lane)
+        # a rebuilt BlockPool's trace counters restart at zero: drop the
+        # stale baseline so its re-traces still emit compile events
+        self._compile_seen = {k: v for k, v in self._compile_seen.items()
+                              if not k.startswith("block_")}
 
     def _lane(self, req: Request) -> int:
         """Tracer lane for one request's lifecycle spans (the engine's
@@ -260,10 +335,27 @@ class EngineCore:
         suffix.  This is what the scheduler's head-of-line budget check
         sees — a long-prompt head with a long cached prefix is cheap."""
         matched = self.prefix_cache.match_length(req.prompt) \
-            if self.prefix_cache is not None else 0
+            if self._cache_active else 0
         plan = self.scheduler.chunk_plan(matched, req.prompt_len,
                                          self.prefill_chunk)
         return plan[0][1]
+
+    @property
+    def _cache_active(self) -> bool:
+        """Prefix cache exists AND the degradation ladder has not
+        bypassed it."""
+        return self.prefix_cache is not None and not self.prefix_bypass
+
+    def _contained_cache_fault(self, match: Optional[MatchResult],
+                               exc: Exception) -> None:
+        """A prefix-cache operation raised under the watchdog: unpin
+        whatever was matched, count the fault toward the ladder (which
+        bypasses the cache entirely at threshold) and let the admission
+        continue as a plain cache miss — the cache is an optimization,
+        never a correctness dependency."""
+        if match is not None:
+            self.prefix_cache.release(match)
+        self._subsystem_fault("prefix_cache", exc)
 
     def _begin_prefill(self, req: Request) -> None:
         """Claim a slot, match + pin the longest cached prefix, seed the
@@ -278,15 +370,29 @@ class EngineCore:
         try:
             matched = 0
             t_match0 = t_match1 = t_admit
-            if self.prefix_cache is not None:
+            if self._cache_active:
                 t_match0 = time.perf_counter()
-                match = self.prefix_cache.match(req.prompt)
-                matched = match.tokens
+                try:
+                    match = self.prefix_cache.match(req.prompt)
+                    matched = match.tokens
+                except Exception as e:
+                    if not self.fault_tolerant:
+                        raise
+                    self._contained_cache_fault(match, e)
+                    match, matched = None, 0
                 t_match1 = time.perf_counter()
             t_gather0 = time.perf_counter()
             if matched:
-                ks, vs = self.prefix_cache.load_staging(match)
-            else:
+                try:
+                    ks, vs = self.prefix_cache.load_staging(match)
+                except Exception as e:
+                    if not self.fault_tolerant:
+                        raise
+                    # degrade THIS admission to a miss (fresh staging,
+                    # full-prompt prefill) and keep serving
+                    self._contained_cache_fault(match, e)
+                    match, matched = None, 0
+            if not matched:
                 # ONE compiled zero-staging builder instead of 2*num_layers
                 # eager jnp.zeros dispatches per miss admission
                 if self._staging_init_fn is None:
@@ -318,12 +424,13 @@ class EngineCore:
                 tracer.set_lane_name(lane, f"request {req.request_id}")
                 tracer.add_span("queued", lane, req.arrival_time, t_admit,
                                 prompt_len=req.prompt_len)
-                if self.prefix_cache is not None:
+                if self._cache_active:
                     tracer.add_span("prefix_match", lane, t_match0,
                                     t_match1, hit_tokens=matched)
                 tracer.add_span("gather", lane, t_gather0, t_gather1,
                                 hit=bool(matched))
             self._prefills.append(_Prefill(req, slot, ks, vs, plan, match))
+            self.progress_counter += 1          # admission = progress
         except BaseException:
             if match is not None:
                 self.prefix_cache.release(match)
@@ -345,6 +452,7 @@ class EngineCore:
         t1 = time.perf_counter()
         st.next_chunk += 1
         st.req.prefill_chunks += 1
+        self.progress_counter += 1              # chunk ran = progress
         self.metrics.on_prefill_chunk(valid, seconds=t1 - t0)
         self.metrics.tracer.add_span(
             "prefill_chunk", self._lane(st.req), t0, t1,
@@ -354,10 +462,13 @@ class EngineCore:
 
     def _complete_prefill(self, st: _Prefill):
         """Final chunk done: sample the first token with the request's
-        own key, adopt the staging row into the pool slot, and publish
-        the freshly computed prompt blocks to the radix cache.  Returns
-        ``(slot, first_token_array)`` — the caller batches the
-        readbacks."""
+        own key and adopt the staging row into the pool slot.  Returns
+        ``(st, first_token_array)`` — the caller batches the readbacks
+        (``_flush_staged``), and only THEN publishes the prompt blocks
+        to the radix cache: the first token doubles as the device-side
+        finiteness probe, and KV whose prefill produced non-finite
+        logits must never be inserted where future admissions would
+        copy it."""
         req, slot = st.req, st.slot
         key = jax.random.PRNGKey(req.sampling.seed)
         key, sub = jax.random.split(key)
@@ -368,9 +479,8 @@ class EngineCore:
             jnp.asarray([s.temperature], jnp.float32),
             jnp.asarray([s.top_k], jnp.int32),
             jnp.asarray([s.top_p], jnp.float32))
+        first = finite_or_sentinel(st.last_logits[None], first)
         self.pool.adopt(slot, list(zip(st.ks, st.vs)), req.prompt_len)
-        if self.prefix_cache is not None:
-            self.prefix_cache.insert(req.prompt, self.pool, slot)
         self._slots[slot] = _Slot(req, req.prompt_len, match=st.match)
         self._last_tok = self._last_tok.at[slot].set(first[0])
         self._keys = self._keys.at[slot].set(key)
@@ -380,7 +490,35 @@ class EngineCore:
         self._top_p[slot] = s.top_p
         self._sampling_dev = None
         self.metrics.on_prefill(req.prompt_len - req.prefix_hit_tokens)
-        return slot, first
+        return st, first
+
+    def _advance_one(self, st: _Prefill, staged: List) -> None:
+        """Advance one mid-prefill request — to completion without
+        chunking, by exactly one chunk with it — appending the completed
+        ``(st, first_token)`` to ``staged``.  Under the watchdog, a
+        prefill-execution fault is PRECISELY attributable (unlike a
+        decode fault, which spans every slot): the implicated request is
+        failed terminally and the engine keeps serving the rest."""
+        try:
+            if self.prefill_chunk is None:
+                while not st.done:
+                    self._run_chunk(st)
+            else:
+                self._run_chunk(st)
+            if st.done:
+                self._prefills.remove(st)
+                staged.append(self._complete_prefill(st))
+        except Exception as e:
+            if not self.fault_tolerant:
+                raise
+            # the staging rows were donated into the raising dispatch —
+            # this prefill's state is unrecoverable, the engine's isn't
+            self._abort_prefill(st, "failed", f"prefill fault: {e!r}")
+            if self.prefill_chunk is not None:
+                self._subsystem_fault("chunked_prefill", e)
+            else:
+                self.metrics.on_fault("prefill", repr(e),
+                                      step=self._step_in_flight)
 
     def _advance_prefills(self) -> int:
         """Run this step's prefill work.  Without chunking every pending
@@ -389,24 +527,69 @@ class EngineCore:
         per-step decode stall is bounded by one chunk regardless of how
         long the admitted prompt is.  Completed requests' first tokens
         come back in ONE batched readback.  Returns tokens emitted."""
-        staged: List[Tuple[int, jax.Array]] = []
-        if self.prefill_chunk is None:
-            while self._prefills:
-                st = self._prefills.pop(0)
-                while not st.done:
-                    self._run_chunk(st)
-                staged.append(self._complete_prefill(st))
-        elif self._prefills:
-            st = self._prefills[0]
-            self._run_chunk(st)
-            if st.done:
-                self._prefills.pop(0)
-                staged.append(self._complete_prefill(st))
-        if staged:
-            toks = np.asarray(jnp.concatenate([f for _, f in staged]))
-            for (slot, _), tok in zip(staged, toks):
-                self._emit(slot, int(tok), first_token=True)
-        return len(staged)
+        staged: List[Tuple[_Prefill, jax.Array]] = []
+        try:
+            if self.prefill_chunk is None:
+                while self._prefills:
+                    n = len(self._prefills)
+                    self._advance_one(self._prefills[0], staged)
+                    if len(self._prefills) >= n:
+                        break    # defensive: no progress, stop looping
+            elif self._prefills:
+                self._advance_one(self._prefills[0], staged)
+        finally:
+            # even if a later prefill raised, tokens already staged must
+            # be emitted — a sampled first token the host forgets would
+            # silently desync the request from generate() parity
+            emitted = self._flush_staged(staged)
+        return emitted
+
+    def _flush_staged(self, staged: List[Tuple[_Prefill, jax.Array]]) -> int:
+        """THE batched first-token readback for this step's completed
+        prefills, then per request: non-finite containment (fail the
+        request, skip the radix insert — the poison must not be cached),
+        the deferred prefix-cache insert, and the first-token emit."""
+        if not staged:
+            return 0
+        toks = np.asarray(jnp.concatenate([f for _, f in staged]))
+        emitted = 0
+        flush_exc = None
+        for (st, _), tok in zip(staged, toks):
+            tok = int(tok)
+            if tok == NONFINITE_SENTINEL:
+                self.metrics.on_fault(
+                    "nan_logits", "non-finite logits at prefill "
+                    "completion", step=self._step_in_flight)
+                self._finalize(st.req, "failed",
+                               "non-finite logits at prefill completion")
+                continue   # slot reclaimed by _evict_finished this step
+            if self._cache_active:
+                try:
+                    self.prefix_cache.insert(st.req.prompt, self.pool,
+                                             st.slot)
+                except Exception as e:
+                    if not self.fault_tolerant:
+                        raise
+                    # the insert is an optimization — count the fault
+                    # (ladder may bypass the cache) and keep the request
+                    self._subsystem_fault("prefix_cache", e)
+            # same containment as the decode-harvest loop: these slots
+            # were already adopted and their first tokens sampled — a
+            # raise for one must not drop the others' first tokens
+            try:
+                self._emit(st.slot, tok, first_token=True)
+            except Exception as e:
+                self.metrics.on_fault("harvest", repr(e),
+                                      step=self._step_in_flight)
+                self._finalize(st.req, "failed",
+                               f"token emit failed: {e!r}")
+                if flush_exc is None:
+                    flush_exc = e
+                continue
+            emitted += 1
+        if flush_exc is not None and not self.fault_tolerant:
+            raise flush_exc
+        return emitted
 
     # ------------------------------------------------------------ decode
     def _resolve_decode_path(self):
@@ -446,6 +629,10 @@ class EngineCore:
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             nxt = sample_rows(split[:, 1], logits[:, 0], do_sample,
                               temperature, top_k, top_p)
+            # device-side health probe: a poisoned row reads back as the
+            # sentinel through the step's EXISTING single readback (a
+            # no-op on finite logits, so token parity is untouched)
+            nxt = finite_or_sentinel(logits[:, 0], nxt)
             new_ks = [c[0] for c in caches]
             new_vs = [c[1] for c in caches]
             return (new_ks, new_vs, caches[0][2], nxt.astype(jnp.int32),
@@ -481,18 +668,48 @@ class EngineCore:
         tokens / evict finished.  Returns the number of requests still
         in flight (prefilling + running + queued).
 
-        Telemetry rides the loop off the hot path: the step's phase
-        breakdown (admission / prefill / decode dispatch / readback)
-        lands as ``step.*`` spans on the engine lane + per-phase
-        histograms, and trace-counter deltas / head-of-line skips /
-        evictions become discrete events.  The per-slot token readback
-        stays the step's ONLY device sync."""
+        With ``fault_tolerance`` configured this is the WATCHDOG
+        boundary: a step exception is caught, attributed (optional
+        subsystem → degradation ladder; core → bounded exponential-
+        backoff retry → quarantine rebuild), and never propagates — the
+        recovery matrix is in docs/serving.md.  Without the config the
+        engine raises exactly as before."""
+        if not self.fault_tolerant:
+            return self._step_impl()
+        if self.health.circuit_open:
+            # fail-fast mode: the breaker already failed all work and
+            # submit() rejects — stepping is a no-op, never a rebuild
+            return self.scheduler.active + self.scheduler.queue_depth
+        try:
+            out = self._step_impl()
+        except Exception as e:
+            return self._on_step_fault(e)
+        self.health.on_step_ok()
+        self._publish_health()
+        return out
+
+    def _step_impl(self) -> int:
+        """The raw step.  Telemetry rides the loop off the hot path: the
+        step's phase breakdown (admission / prefill / decode dispatch /
+        readback) lands as ``step.*`` spans on the engine lane +
+        per-phase histograms, and trace-counter deltas / head-of-line
+        skips / evictions become discrete events.  The per-slot token
+        readback stays the step's ONLY device sync."""
         t0 = time.perf_counter()
         tracer = self.metrics.tracer
         step_i = self._step_index
         self._step_index += 1
         self._step_in_flight = step_i
+        self._fault_phase = None
         skips_before = self.scheduler.total_head_skips
+        faults = self.faults
+        if faults is not None:
+            armed = faults.check("slow_step")
+            if armed is not None:
+                self.metrics.on_fault(
+                    "slow_step", f"injected {armed.seconds}s stall",
+                    step=step_i)
+                time.sleep(armed.seconds)
         ann = None
         if self.metrics.record_events:
             from ..profiler import RecordEvent
@@ -502,6 +719,8 @@ class EngineCore:
                                lane=self.metrics.engine_lane,
                                step=step_i)
         try:
+            if self._deadlines_possible:
+                self._expire_deadlines(time.perf_counter())
             admitted = self.scheduler.admit(
                 self.pool.free_slots,
                 token_budget=self.max_prefill_tokens_per_step,
@@ -523,12 +742,49 @@ class EngineCore:
             phases = [("admission", t0, t_admit),
                       ("prefill", t_admit, t_prefill)]
             if self._slots:
+                if faults is not None:
+                    armed = faults.check("nan_logits")
+                    if armed is not None:
+                        self._poison_slot(min(self._slots), step_i)
+                # decode faults cannot be pinned on one slot — the
+                # watchdog attributes them to the decode path (ladder
+                # candidate when fused, retry/quarantine otherwise)
+                self._fault_phase = "fused_decode" \
+                    if self.decode_path == "fused" else "decode"
+                if faults is not None:
+                    faults.fire("step")
                 nxt = self._decode_dispatch()
                 t_decode = time.perf_counter()
                 toks = np.asarray(nxt)     # THE per-step device readback
                 t_readback = time.perf_counter()
+                self._fault_phase = None
+                # the readback already advanced EVERY slot's device
+                # state: a raise mid-loop (a user stream callback, an
+                # emit bug) must not drop the LATER slots' tokens — on
+                # the watchdog's retry they would silently skip one
+                # token and desync from generate() parity.  Finish the
+                # loop, fail the implicated request, re-raise only
+                # outside the watchdog (inside it the containment is
+                # already complete — no retry needed).
+                harvest_exc = None
                 for slot in sorted(self._slots):
-                    new_tokens += self._harvest(slot, int(toks[slot]))
+                    # a stream callback may REENTRANTLY cancel/purge a
+                    # sibling (first-of-N-wins clients): re-fetch, and
+                    # skip slots that vanished mid-loop
+                    st = self._slots.get(slot)
+                    if st is None:
+                        continue
+                    try:
+                        new_tokens += self._harvest(slot, int(toks[slot]))
+                    except Exception as e:
+                        self.metrics.on_fault("harvest", repr(e),
+                                              step=step_i)
+                        self._finalize(st.req, "failed",
+                                       f"token emit failed: {e!r}")
+                        if harvest_exc is None:
+                            harvest_exc = e
+                if harvest_exc is not None and not self.fault_tolerant:
+                    raise harvest_exc
                 # decode phases exist only on steps that decoded — a
                 # prefill-only step must not feed 0.0 into their
                 # histograms and fake slices into the timeline
@@ -557,6 +813,130 @@ class EngineCore:
             phases=phases)
         return self.scheduler.active + self.scheduler.queue_depth
 
+    def _poison_slot(self, slot: int, step_i: int) -> None:
+        """Chaos-only: overwrite position 0 of ``slot``'s layer-0 K row
+        with NaN.  Decode attention propagates it into that slot's
+        logits, the in-program finiteness probe encodes the sentinel,
+        and the harvest fails exactly the implicated request — the
+        honest end-to-end drive of the non-finite recovery path (the
+        poisoned position is re-written wholesale by the next adopt)."""
+        self.pool.ks[0] = self.pool.ks[0].at[slot, 0].set(jnp.nan)
+        self.metrics.on_fault("nan_logits",
+                              f"injected NaN into slot {slot} KV",
+                              step=step_i)
+
+    # ---------------------------------------------- watchdog / recovery
+    def _publish_health(self) -> None:
+        self.health.degraded = self.ladder.level > 0
+        self.metrics.on_health_state(self.health.state,
+                                     self.health.state_code,
+                                     step=self._step_in_flight)
+
+    def _on_step_fault(self, exc: Exception) -> int:
+        """A step raised under the watchdog.  Attribution decides the
+        response: a fault in the fused decode path feeds the ladder
+        (composed decode is the always-available fallback); anything
+        else consumes one retry from the backoff budget, and a spent
+        budget quarantines.  State was already unwound by the step's own
+        exception handling (admission requeues its batch, prefill faults
+        abort their request), so 'retry' simply means the next step()
+        runs normally after the backoff sleep."""
+        step_i = self._step_in_flight
+        phase = self._fault_phase or "step"
+        if phase == "fused_decode" and self.decode_path == "fused":
+            self._subsystem_fault("fused_decode", exc)
+        else:
+            self.metrics.on_fault(phase, repr(exc), step=step_i)
+            backoff = self.health.record_step_fault(repr(exc))
+            if backoff is None:
+                self._quarantine(f"{phase} fault: {exc!r}")
+            else:
+                self.metrics.on_retry(self.health.consecutive_faults,
+                                      backoff, step=step_i)
+                if backoff > 0:
+                    time.sleep(backoff)
+        self._publish_health()
+        return self.scheduler.active + self.scheduler.queue_depth
+
+    def _subsystem_fault(self, subsystem: str, exc: Exception) -> None:
+        """Count one fault against an OPTIONAL subsystem; at the ladder
+        threshold the subsystem is disabled and the engine keeps serving
+        without it (the fault site already contained the failure)."""
+        self.metrics.on_fault(subsystem, repr(exc),
+                              step=self._step_in_flight)
+        if not self.ladder.disabled(subsystem) \
+                and self.ladder.record_fault(subsystem):
+            self._disable_subsystem(subsystem, repr(exc))
+
+    def _disable_subsystem(self, subsystem: str, reason: str) -> None:
+        """Apply one degradation-ladder rung (docs/serving.md ladder
+        table).  Disabling is engine-lifetime — a subsystem that proved
+        unreliable is not silently re-armed by a later rebuild."""
+        if subsystem == "prefix_cache":
+            self.prefix_bypass = True     # matches/inserts stop; live
+            # pins release normally as their requests finish
+        elif subsystem == "chunked_prefill":
+            self.prefill_chunk = None     # whole-bucket prefill; plans
+            # already computed keep their compiled chunk widths
+        elif subsystem == "fused_decode":
+            self.decode_path = "unfused"
+            self.decode_fallback_reason = f"degraded: {reason}"
+            self._decode_fn = None        # re-trace composed on next use
+        else:
+            raise ValueError(f"unknown subsystem {subsystem!r}")
+        self.health.degraded = True
+        self.metrics.on_degrade(subsystem, self.ladder.level, reason)
+
+    def _quarantine(self, reason: str) -> None:
+        """The step-retry budget is spent: fail the implicated in-flight
+        requests terminally (their device state may hold donated
+        garbage), rebuild the device plane, and leave queued work intact
+        for re-serving.  ``enter_quarantine``/``leave_quarantine`` is a
+        registered graftlint ``ResourcePair`` — the window closes on
+        every path."""
+        step_i = self._step_in_flight
+        q = self.health.enter_quarantine(reason)
+        try:
+            self.metrics.on_quarantine("enter", reason, step=step_i)
+            now = time.perf_counter()
+            for st in list(self._prefills):
+                self._abort_prefill(st, "failed", f"quarantine: {reason}")
+            for slot in list(self._slots):
+                req = self._slots[slot].req
+                if not req.finished:
+                    self._finalize(req, "failed",
+                                   f"quarantine: {reason}", now=now)
+                elif req.status is None:
+                    # completed normally (eos/length) this very step but
+                    # not yet evicted when the fault hit: stamp the
+                    # NORMAL terminal accounting — quarantining an
+                    # already-finished request must not fail it, nor
+                    # leave it terminal with no status at all
+                    self._finalize(req, "finished", req.finish_reason,
+                                   now=now)
+                self._release_slot(slot, now)
+            self._build_device_plane()
+            if self.health.circuit_open:
+                self._open_circuit(reason)
+        finally:
+            seconds = self.health.leave_quarantine(q)
+            self.metrics.on_quarantine("leave", reason, step=step_i,
+                                       seconds=seconds)
+
+    def _open_circuit(self, reason: str) -> None:
+        """Too many quarantines inside the breaker window: stop
+        flapping.  Everything queued fails terminally (nothing is ever
+        silently dropped), submit() rejects with ``circuit_open``, and
+        step() becomes a no-op — an operator decision (restart, new
+        engine) is required past this point."""
+        self.metrics.tracer.event("circuit_open",
+                                  lane=self.metrics.engine_lane,
+                                  reason=reason[:200],
+                                  step=self._step_in_flight)
+        while self.scheduler.waiting:
+            req = self.scheduler.waiting.popleft()
+            self._finalize(req, "failed", f"circuit open: {reason}")
+
     def _record_events(self, step_i: int, skips_before: int) -> None:
         """Turn this step's discrete happenings into event-log entries:
         trace-counter deltas = program compiles, scheduler skip-counter
@@ -584,6 +964,7 @@ class EngineCore:
     def _emit(self, slot: int, tok: int, first_token: bool = False) -> None:
         req = self._slots[slot].req
         req.tokens.append(tok)
+        self.progress_counter += 1              # token out = progress
         now = time.perf_counter()
         if first_token:
             req.first_token_time = now
@@ -600,7 +981,21 @@ class EngineCore:
             self.metrics.on_output_token(now - req.last_token_time)
         req.last_token_time = now
         if req.stream is not None:
-            req.stream(req, tok)
+            try:
+                req.stream(req, tok)
+            except Exception as e:
+                # the CLIENT's sink broke, not the engine: fail exactly
+                # this request (its token is already recorded) and keep
+                # serving — a raising callback must never reach the
+                # watchdog, where the step retry would silently desync
+                # every OTHER slot from the already-advanced device state
+                if not self.fault_tolerant:
+                    raise
+                self.metrics.on_fault("stream", repr(e),
+                                      step=self._step_in_flight)
+                self._finalize(req, "failed",
+                               f"stream callback raised: {e!r}")
+                return
         eos = req.eos_token_id
         if eos is not None and tok == eos:
             req.finished, req.finish_reason = True, "eos"
@@ -608,49 +1003,235 @@ class EngineCore:
             req.finished, req.finish_reason = True, "length"
 
     def _harvest(self, slot: int, tok: int) -> int:
-        st = self._slots[slot]
+        st = self._slots.get(slot)
+        if st is None:
+            return 0  # reentrantly cancelled by a callback mid-harvest
         if st.req.finished:
             return 0  # finished at admit (eos/length on the first token)
+        if tok == NONFINITE_SENTINEL:
+            # the in-program finiteness probe tripped for THIS row: fail
+            # exactly the implicated request (slot reclaimed by
+            # _evict_finished this same step; the poisoned row is
+            # overwritten wholesale by the next adopt)
+            self.metrics.on_fault("nan_logits",
+                                  f"non-finite logits in decode "
+                                  f"(slot {slot})",
+                                  step=self._step_in_flight)
+            self._finalize(st.req, "failed",
+                           "non-finite logits in decode")
+            return 0
         st.pos += 1
         self._emit(slot, tok)
         return 1
 
+    # --------------------------------------------- terminal dispositions
+    def _finalize(self, req: Request, status: str, reason: str,
+                  now: Optional[float] = None) -> None:
+        """Stamp one request's TERMINAL disposition — every submitted
+        request passes through here exactly once (normal completions
+        arrive from ``_evict_finished``/``_quarantine`` with
+        ``status="finished"``), which is what the chaos suite's
+        total-accounting invariant pins.  Does NOT touch slots/pins:
+        the call site owns whatever unwinding its state demands."""
+        if req.finished and req.status is not None:
+            return                        # already terminal (idempotent)
+        if now is None:
+            now = time.perf_counter()
+        req.finished = True
+        req.status = status
+        req.status_reason = reason
+        req.finish_time = now
+        self.progress_counter += 1        # a disposition is progress
+        if status == "finished":
+            self.metrics.on_finish()
+        else:
+            self.metrics.on_terminal(status, reason, req.request_id,
+                                     now=now)
+        self._close_request_telemetry(req, now)
+
+    def _close_request_telemetry(self, req: Request, now: float) -> None:
+        tracer = self.metrics.tracer
+        if not tracer.enabled:
+            return
+        lane = self._lane(req)
+        if req.first_token_time is not None:
+            tracer.add_span("decode", lane, req.first_token_time, now,
+                            tokens=len(req.tokens))
+        tracer.add_span("request", lane, req.arrival_time, now,
+                        tokens=len(req.tokens),
+                        finish_reason=req.finish_reason or req.status)
+
+    def _release_slot(self, slot: int, now: float) -> Request:
+        """Return one occupied slot's resources — scheduler entry, radix
+        pin, pool slot, sampling row — in one place, so cancellation,
+        deadline expiry, quarantine and normal eviction cannot drift
+        apart in what they free."""
+        req = self.scheduler.release(slot)
+        st = self._slots.pop(slot)
+        if st.match is not None:
+            # unpin the request's radix path — its blocks become
+            # LRU-evictable again (release is idempotent)
+            self.prefix_cache.release(st.match)
+        self.pool.free(slot)
+        self._do_sample[slot] = False
+        self._sampling_dev = None
+        tracer = self.metrics.tracer
+        if tracer.enabled:
+            tracer.event("slot_release", lane=self.metrics.engine_lane,
+                         t=now, slot=slot, request=req.request_id,
+                         reason=req.status_reason or req.finish_reason)
+        return req
+
+    def _abort_prefill(self, st: _Prefill, status: str,
+                       reason: str) -> None:
+        """Unwind one MID-PREFILL request (cancel / deadline / fault /
+        quarantine): drop it from the prefill queue, return its slot and
+        radix pin, stamp the terminal status.  The staging rows die with
+        the last reference — they were never adopted into the pool."""
+        if st in self._prefills:
+            self._prefills.remove(st)
+        self._slots.pop(st.slot, None)    # defensive: adopt may have run
+        if st.match is not None:
+            self.prefix_cache.release(st.match)
+        self.scheduler.release(st.slot)
+        self.pool.free(st.slot)
+        self._do_sample[st.slot] = False
+        self._sampling_dev = None
+        self._finalize(st.req, status, reason)
+
+    def cancel(self, request_id: int, status: str = "cancelled",
+               reason: str = "cancelled by client") -> bool:
+        """Cleanly unwind one request in ANY state — queued,
+        mid-(chunked-)prefill, or decoding — freeing its pool slot,
+        staging rows and pinned radix path immediately.  Returns True
+        when the request was found in flight (False: unknown id or
+        already terminal — cancellation is idempotent)."""
+        req = self.scheduler.remove_waiting(request_id)
+        if req is not None:
+            self._finalize(req, status, reason)
+            return True
+        for st in list(self._prefills):
+            if st.req.request_id == request_id:
+                self._abort_prefill(st, status, reason)
+                return True
+        for slot, sl in list(self._slots.items()):
+            if sl.req.request_id == request_id and not sl.req.finished:
+                now = time.perf_counter()
+                self._finalize(sl.req, status, reason, now=now)
+                self._release_slot(slot, now)
+                return True
+        return False
+
+    def _expire_deadlines(self, now: float) -> None:
+        """Host-side per-step deadline sweep (runs only once any
+        submitted request has carried a deadline): queued requests whose
+        budget is already blown never consume a slot; in-flight ones are
+        unwound exactly like a cancel, with status
+        ``deadline_exceeded``."""
+        for req in self.scheduler.expired_waiting(now):
+            self._finalize(req, "deadline_exceeded",
+                           req.deadline_violation(now) or
+                           "deadline exceeded", now=now)
+        for st in list(self._prefills):
+            v = st.req.deadline_violation(now)
+            if v is not None:
+                self._abort_prefill(st, "deadline_exceeded", v)
+        for slot, sl in list(self._slots.items()):
+            if sl.req.finished:
+                continue
+            v = sl.req.deadline_violation(now)
+            if v is not None:
+                self._finalize(sl.req, "deadline_exceeded", v, now=now)
+                self._release_slot(slot, now)
+
+    # ------------------------------------------------ submit-time gates
+    def check_admission(self, req: Request) -> None:
+        """Submit-time backpressure (docs/serving.md): bounded queue,
+        SLO-aware rejection when the projected TTFT already exceeds the
+        request's deadline, and fail-fast once the circuit is open.
+        Raises :class:`RequestRejected` with a live-metrics retry hint;
+        on acceptance, just records whether deadline sweeps are needed."""
+        if self.fault_tolerant and self.health.circuit_open:
+            self._reject(req, "circuit_open", None)
+        if self.max_queue is not None \
+                and self.scheduler.queue_depth >= self.max_queue:
+            excess = self.scheduler.queue_depth - self.max_queue + 1
+            self._reject(req, "queue_full",
+                         self.metrics.retry_after_hint(excess))
+        if req.ttft_deadline_s is not None:
+            projected = self.metrics.projected_ttft_s(
+                self.scheduler.queue_depth)
+            if projected is not None \
+                    and projected > req.ttft_deadline_s:
+                self._reject(req, "slo_unattainable",
+                             self.metrics.retry_after_hint())
+        if req.deadline_s is not None or req.ttft_deadline_s is not None:
+            self._deadlines_possible = True
+
+    def _reject(self, req: Request, reason: str,
+                retry_after_s: Optional[float]) -> None:
+        req.finished = True
+        req.status = "rejected"
+        req.status_reason = reason
+        req.finish_time = time.perf_counter()
+        self.metrics.on_terminal("rejected", reason, req.request_id)
+        raise RequestRejected(reason, retry_after_s)
+
     def _evict_finished(self) -> None:
         for slot in [s for s, st in self._slots.items() if st.req.finished]:
-            req = self.scheduler.release(slot)
             now = time.perf_counter()
-            req.finish_time = now
-            if self._slots[slot].match is not None:
-                # unpin the request's radix path — its blocks become
-                # LRU-evictable again
-                self.prefix_cache.release(self._slots[slot].match)
-            self.pool.free(slot)
-            del self._slots[slot]
-            self._do_sample[slot] = False
-            self._sampling_dev = None
-            self.metrics.on_finish()
-            tracer = self.metrics.tracer
-            if tracer.enabled:
-                lane = self._lane(req)
-                first = req.first_token_time or now
-                tracer.add_span("decode", lane, first, now,
-                                tokens=len(req.tokens))
-                tracer.add_span("request", lane, req.arrival_time, now,
-                                tokens=len(req.tokens),
-                                finish_reason=req.finish_reason)
-                tracer.event("slot_release",
-                             lane=self.metrics.engine_lane, t=now,
-                             slot=slot, request=req.request_id,
-                             reason=req.finish_reason)
+            req = self._release_slot(slot, now)
+            if req.status is None:
+                # normal completion (eos/length): abnormal statuses were
+                # settled at their _finalize site, this loop reclaims
+                self._finalize(req, "finished", req.finish_reason,
+                               now=now)
 
     # ----------------------------------------------------- conveniences
-    def run_until_complete(self, max_steps: Optional[int] = None) -> int:
-        """Step until queue and slots drain; returns steps taken."""
+    def stall_snapshot(self) -> Dict[str, object]:
+        """Host-state diagnostic attached to
+        :class:`~paddle_tpu.serving.errors.EngineStalledError` (and
+        useful on its own for operator dumps)."""
+        return {
+            "queue_depth": self.scheduler.queue_depth,
+            "active": self.scheduler.active,
+            "mid_prefill": len(self._prefills),
+            "free_slots": self.pool.free_slots,
+            "free_blocks": None if self.block_pool is None
+            else self.block_pool.free_blocks,
+            "seq_pos": np.asarray(self.pool.seq_pos).tolist(),
+            "health": self.health.state,
+            "degraded_subsystems": list(self.ladder.disabled_subsystems),
+            "progress_counter": self.progress_counter,
+            "steps": self._step_index,
+        }
+
+    def run_until_complete(self, max_steps: Optional[int] = None,
+                           stall_steps: Optional[int] = 64) -> int:
+        """Step until queue and slots drain; returns steps taken.
+
+        ``stall_steps`` arms the no-progress detector: if that many
+        CONSECUTIVE steps emit no token, admit no request, run no
+        prefill chunk and settle no request while work is still queued,
+        :class:`EngineStalledError` is raised with a diagnostic snapshot
+        instead of spinning forever (None disables — the pre-robustness
+        behavior)."""
         steps = 0
+        stalled = 0
+        last_progress = self.progress_counter
         while self.scheduler.has_work():
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(
                     f"serving did not drain within {max_steps} steps")
             self.step()
             steps += 1
+            if self.progress_counter != last_progress:
+                last_progress = self.progress_counter
+                stalled = 0
+            else:
+                stalled += 1
+                if stall_steps is not None and stalled >= stall_steps \
+                        and self.scheduler.has_work():
+                    raise EngineStalledError(stalled,
+                                             self.stall_snapshot())
         return steps

@@ -1,0 +1,140 @@
+"""Deterministic fault injection for the serving engine's chaos suite.
+
+The engine's recovery paths (deadline unwind, cancellation, degradation
+ladder, watchdog quarantine — serving/health.py) are only trustworthy if
+they are *driven*, not just written.  This module provides the drive
+shaft: named injection points threaded through the engine, the KV pools
+and the prefix cache, each firing deterministically on a configured hit
+count, behind a hook that is zero-overhead when off (every site guards
+with ``if faults is None`` on a plain attribute — no injector object is
+even constructed in production).
+
+Injection points (``POINTS``):
+
+  =================  ====================================================
+  ``kv_alloc``        ``KVPool.alloc`` raises (admission-time slot
+                      claim failure)
+  ``block_alloc``     ``BlockPool.alloc`` raises (radix-cache block
+                      claim failure)
+  ``block_exhausted`` ``PrefixCache._alloc_block`` reports an exhausted
+                      pool (graceful-partial-insert path, no raise)
+  ``gather``          ``BlockPool.load_row`` raises before dispatching
+                      the prefix gather program
+  ``scatter``         ``BlockPool.store_row`` raises before dispatching
+                      the block scatter program
+  ``step``            the engine raises inside the decode region of
+                      ``step()`` (watchdog retry/quarantine driver)
+  ``nan_logits``      the engine poisons one live slot's KV row with NaN
+                      so the *device-side* non-finite detector fires
+  ``slow_step``       the engine sleeps ``seconds`` at the top of the
+                      step (straggler simulation; deadline driver)
+  =================  ====================================================
+
+Faults are armed per site with ``enable(site, at=..., times=...)``: the
+site's hit counter increments on every pass through the hook, and the
+fault fires on hits ``at, at+1, ..., at+times-1`` — the same workload
+replayed with the same arming hits the same faults, which is what makes
+the chaos suite's token-parity invariant checkable.  ``enable`` /
+``disable`` is a registered graftlint ``ResourcePair``: wrap the faulted
+window in try/finally so a raising scenario cannot leave a fault armed
+for the next test.
+
+``FaultError`` carries ``.site`` so recovery code and tests can assert
+*which* injected fault an unwind came from.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["FaultError", "FaultInjector", "POINTS"]
+
+POINTS = ("kv_alloc", "block_alloc", "block_exhausted", "gather",
+          "scatter", "step", "nan_logits", "slow_step")
+
+
+class FaultError(RuntimeError):
+    """Raised by an armed injection point (never by production code)."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected fault at {site!r} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+class _Armed:
+    __slots__ = ("at", "times", "seconds", "fired")
+
+    def __init__(self, at: int, times: int, seconds: float):
+        self.at = at
+        self.times = times
+        self.seconds = seconds
+        self.fired = 0
+
+
+class FaultInjector:
+    """Per-engine fault plan: arm sites, count hits, fire precisely.
+
+    Pure host state; thread one instance through
+    ``ServingEngine(..., faults=...)`` and it reaches the engine, both
+    pools and the prefix cache.  All counters survive ``disable`` so a
+    test can assert exactly how often each site fired.
+    """
+
+    def __init__(self):
+        self._armed: Dict[str, _Armed] = {}
+        self.hits: Dict[str, int] = {p: 0 for p in POINTS}
+        self.fired: Dict[str, int] = {p: 0 for p in POINTS}
+
+    # ------------------------------------------------------------ arming
+    def enable(self, site: str, at: int = 0, times: int = 1,
+               seconds: float = 0.0) -> None:
+        """Arm ``site`` to fire on its next ``times`` hits starting at
+        hit index ``at`` (counted from the site's CURRENT hit count, so
+        ``at=0`` means "the very next pass").  ``seconds`` parameterises
+        ``slow_step``.  Pair every enable with a :meth:`disable` on all
+        exit paths (registered graftlint ``ResourcePair``)."""
+        if site not in POINTS:
+            raise ValueError(
+                f"unknown fault site {site!r}; known: {POINTS}")
+        if times < 1:
+            raise ValueError("times must be >= 1")
+        if at < 0:
+            raise ValueError("at must be >= 0")
+        self._armed[site] = _Armed(self.hits[site] + at, times, seconds)
+
+    def disable(self, site: str) -> None:
+        """Disarm ``site`` (idempotent; counters are kept)."""
+        self._armed.pop(site, None)
+
+    def disable_all(self) -> None:
+        self._armed.clear()
+
+    @property
+    def active(self) -> bool:
+        return bool(self._armed)
+
+    # ------------------------------------------------------------ firing
+    def check(self, site: str) -> Optional[_Armed]:
+        """One pass through injection point ``site``: bump its hit
+        counter and return the armed record when the fault fires (None
+        otherwise).  The *caller* applies the effect — raising, sleeping,
+        poisoning — because effects are site-specific."""
+        hit = self.hits[site]
+        self.hits[site] = hit + 1
+        armed = self._armed.get(site)
+        if armed is None or not armed.at <= hit < armed.at + armed.times:
+            return None
+        armed.fired += 1
+        self.fired[site] += 1
+        return armed
+
+    def fire(self, site: str) -> bool:
+        """``check()`` + raise :class:`FaultError` when armed — the
+        shape every raising site uses (``kv_alloc``, ``block_alloc``,
+        ``gather``, ``scatter``, ``step``).  Returns False when the
+        fault did not fire."""
+        armed = self.check(site)
+        if armed is not None:
+            raise FaultError(site, self.hits[site] - 1)
+        return False

@@ -65,6 +65,9 @@ class KVPool:
         # them; high churn relative to finished requests = thrashing)
         self.alloc_count = 0
         self.free_count = 0
+        # chaos hook (serving/faults.py): None in production — the only
+        # overhead when off is this attribute test in alloc()
+        self.faults = None
 
     @classmethod
     def create(cls, model, num_slots: int,
@@ -90,6 +93,8 @@ class KVPool:
         """Claim a free slot (lowest index first, so slot churn reuses a
         warm row).  Raises if the pool is full — the scheduler gates
         admission on ``free_slots``."""
+        if self.faults is not None:
+            self.faults.fire("kv_alloc")
         if not self._free:
             raise RuntimeError("KVPool exhausted: no free slot")
         self.alloc_count += 1
@@ -187,6 +192,8 @@ class BlockPool:
         self.trace_counts = {"gather": 0, "scatter": 0}
         self._load_fn = None
         self._store_fn = None
+        # chaos hook (serving/faults.py): None in production
+        self.faults = None
 
     @classmethod
     def create(cls, model, num_blocks: int, block_len: int,
@@ -206,6 +213,8 @@ class BlockPool:
         return self.num_blocks - len(self._free)
 
     def alloc(self) -> int:
+        if self.faults is not None:
+            self.faults.fire("block_alloc")
         if not self._free:
             raise RuntimeError("BlockPool exhausted: no free block")
         return self._free.pop()
@@ -222,6 +231,8 @@ class BlockPool:
         """Gather blocks ``idx`` ([blocks_per_row] int32, padded past the
         match with any in-bounds value) into per-layer ``[1, max_seq, h,
         d]`` staging rows."""
+        if self.faults is not None:
+            self.faults.fire("gather")
         if self._load_fn is None:
             def load(bks, bvs, idx):
                 self.trace_counts["gather"] += 1   # trace-time tick
@@ -237,6 +248,8 @@ class BlockPool:
         """Scatter pool slot ``slot``'s row into block rows ``dest``
         ([blocks_per_row] int32; entries == num_blocks are dropped).
         Donates the block slabs — cache memory stays one allocation."""
+        if self.faults is not None:
+            self.faults.fire("scatter")
         if self._store_fn is None:
             n = (1, self.max_seq) + self.bks[0].shape[2:]
 

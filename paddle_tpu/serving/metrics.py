@@ -141,6 +141,33 @@ class ServingMetrics:
                                 "waiting requests at the last step")
         self._g_occupancy = g("serving.slot_occupancy",
                               "occupied/total slots at the last step")
+        # robustness surface (docs/serving.md "Fault tolerance"): the
+        # terminal-status counters partition every submitted request —
+        # finished + cancelled + deadline_exceeded + failed (+ rejected,
+        # which never enters the queue) == submitted, once drained
+        self._c_cancelled = c("serving.requests_cancelled",
+                              "requests unwound by cancel()")
+        self._c_deadline = c("serving.requests_deadline_exceeded",
+                             "requests terminated by a blown deadline")
+        self._c_failed = c("serving.requests_failed",
+                           "requests terminally failed by a fault")
+        self._c_rejected = c("serving.requests_rejected",
+                             "submissions refused (backpressure/SLO/"
+                             "circuit)")
+        self._c_faults = c("serving.faults",
+                           "faults observed by the watchdog (injected "
+                           "or real)")
+        self._c_retries = c("serving.step_retries",
+                            "watchdog step retries (backoff sleeps)")
+        self._c_quarantines = c("serving.quarantines",
+                                "quarantine rebuilds of the device plane")
+        self._g_health = g("serving.health_state",
+                           "0 healthy / 1 degraded / 2 quarantined / "
+                           "3 circuit_open")
+        self._g_degradation = g("serving.degradation_level",
+                                "optional subsystems disabled by the "
+                                "degradation ladder")
+        self._last_health_state: Optional[str] = None
         self._phase_h: Dict[str, Histogram] = {}
         self._zero_local()
 
@@ -154,6 +181,7 @@ class ServingMetrics:
         self._occupancy_sum = 0.0
         self._tokens_local = 0
         self._steps_local = 0
+        self._finished_local = 0
 
     def reset(self) -> None:
         """Zero THIS engine's instruments and drop the tracer's recorded
@@ -251,6 +279,102 @@ class ServingMetrics:
 
     def on_finish(self, n: int = 1) -> None:
         self._c_finished.inc(n)
+        self._finished_local += n
+
+    # ----------------------------------------------- robustness events
+    def on_terminal(self, status: str, reason: str, request_id: int,
+                    now: Optional[float] = None) -> None:
+        """One request reached an ABNORMAL terminal status (normal
+        completion goes through :meth:`on_finish`): count it and drop a
+        discrete event on the request's lane so the trace shows why the
+        lifecycle ended."""
+        counter = {"cancelled": self._c_cancelled,
+                   "deadline_exceeded": self._c_deadline,
+                   "failed": self._c_failed,
+                   "rejected": self._c_rejected}.get(status)
+        if counter is None:
+            raise ValueError(f"unknown terminal status {status!r}")
+        counter.inc()
+        self.tracer.event("request_" + status,
+                          lane=self.request_lane(request_id),
+                          t=now, request=request_id, reason=reason)
+
+    def on_fault(self, site: str, error: str, step: int = 0) -> None:
+        """The watchdog observed one fault (injected or real) attributed
+        to ``site`` (an injection-point or subsystem name)."""
+        self._c_faults.inc()
+        self.tracer.event("fault", lane=self.engine_lane, site=site,
+                          error=error[:200], step=step)
+
+    def on_retry(self, attempt: int, backoff_s: float,
+                 step: int = 0) -> None:
+        self._c_retries.inc()
+        self.tracer.event("step_retry", lane=self.engine_lane,
+                          attempt=attempt, backoff_s=round(backoff_s, 4),
+                          step=step)
+
+    def on_degrade(self, subsystem: str, level: int, reason: str) -> None:
+        """The degradation ladder disabled an optional subsystem; the
+        gauge tracks the ladder level, the event carries which and why."""
+        self._g_degradation.set(level)
+        self.tracer.event("degrade", lane=self.engine_lane,
+                          subsystem=subsystem, level=level,
+                          reason=reason[:200])
+
+    def on_health_state(self, state: str, code: int,
+                        step: int = 0) -> None:
+        """Track the health state machine: the gauge always reflects the
+        latest state; the discrete event fires only on TRANSITIONS so
+        a million healthy steps cost one event, not a million."""
+        self._g_health.set(code)
+        if state != self._last_health_state:
+            self.tracer.event("health_state", lane=self.engine_lane,
+                              state=state, step=step)
+            self._last_health_state = state
+
+    def on_quarantine(self, phase: str, reason: str, step: int = 0,
+                      seconds: Optional[float] = None) -> None:
+        """``phase`` is "enter" or "leave"; one quarantine rebuild
+        counts once (on enter)."""
+        if phase == "enter":
+            self._c_quarantines.inc()
+        attrs = {"reason": reason[:200], "step": step}
+        if seconds is not None:
+            attrs["seconds"] = round(seconds, 4)
+        self.tracer.event(f"quarantine_{phase}", lane=self.engine_lane,
+                          **attrs)
+
+    # -------------------------------------------- admission projections
+    @property
+    def completion_rate(self) -> Optional[float]:
+        """Requests completed per second of engine busy time — the live
+        throughput estimate backpressure hints derive from (None until
+        at least one request finished in this window)."""
+        if self._finished_local <= 0 or self._busy_s <= 0:
+            return None
+        return self._finished_local / self._busy_s
+
+    def retry_after_hint(self, excess: int = 1) -> Optional[float]:
+        """Seconds until ~``excess`` queue positions should free, from
+        the live completion rate.  None with no history — callers
+        surface that as "no hint" rather than inventing a number."""
+        rate = self.completion_rate
+        if rate is None:
+            return None
+        return max(excess, 1) / rate
+
+    def projected_ttft_s(self, queue_depth: int) -> Optional[float]:
+        """SLO-aware admission estimate: time for the current queue to
+        drain ahead of a new arrival plus the live p50 TTFT.  A
+        heuristic, deliberately simple — it only needs to be right
+        enough to reject requests that are HOPELESSLY late, not to
+        schedule precisely.  None with no history (cold engines admit;
+        rejecting on zero data would deadlock the very first request)."""
+        rate = self.completion_rate
+        if rate is None:
+            return None
+        base = self._h_ttft.quantile(0.50) or 0.0
+        return queue_depth / rate + base
 
     def record_step(self, active_slots: int, num_slots: int,
                     queue_depth: int, new_tokens: int,
@@ -400,4 +524,14 @@ class ServingMetrics:
             "tpot_p99_ms": r(self.tpot_p99_ms, 3),
             "batch_fill_ratio": r(self.batch_fill_ratio),
             "mean_queue_depth": r(self.mean_queue_depth, 2),
+            # robustness block (keys only ever ADD — see class docstring)
+            "requests_cancelled": self._c_cancelled.value,
+            "requests_deadline_exceeded": self._c_deadline.value,
+            "requests_failed": self._c_failed.value,
+            "requests_rejected": self._c_rejected.value,
+            "faults": self._c_faults.value,
+            "step_retries": self._c_retries.value,
+            "quarantines": self._c_quarantines.value,
+            "health_state": self._g_health.value,
+            "degradation_level": self._g_degradation.value,
         }

@@ -88,6 +88,8 @@ class PrefixCache:
         # optional event sink ``fn(name, **attrs)`` — the engine points
         # this at its tracer so LRU evictions land in the event log
         self.on_event = None
+        # chaos hook (serving/faults.py): None in production
+        self.faults = None
         # observability (engine merges these into its metrics snapshot)
         self.hits = 0
         self.misses = 0
@@ -214,6 +216,9 @@ class PrefixCache:
 
     # ---------------------------------------------------------- eviction
     def _alloc_block(self) -> Optional[int]:
+        if self.faults is not None \
+                and self.faults.check("block_exhausted") is not None:
+            return None      # injected exhaustion: graceful partial path
         if self.pool.free_blocks:
             return self.pool.alloc()
         victim = self._lru_unpinned_leaf()

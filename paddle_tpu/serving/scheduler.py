@@ -79,10 +79,20 @@ class Request:
     eos_token_id: Optional[int] = None
     stream: Optional[object] = None          # callable(request, token)
     arrival_time: float = 0.0
+    # robustness surface (docs/serving.md "Fault tolerance"): deadlines
+    # are seconds RELATIVE to submission, checked host-side per step
+    deadline_s: Optional[float] = None       # submit -> finish budget
+    ttft_deadline_s: Optional[float] = None  # submit -> first token
     # engine-owned progress
     tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False
     finish_reason: Optional[str] = None      # "eos" | "length"
+    # terminal disposition — every request ends with exactly one:
+    # "finished" | "cancelled" | "deadline_exceeded" | "rejected" |
+    # "failed" (None only while in flight); status_reason carries the
+    # human-readable why ("eos", "ttft deadline 0.05s exceeded", ...)
+    status: Optional[str] = None
+    status_reason: Optional[str] = None
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     admit_time: Optional[float] = None       # queue exit (telemetry)
@@ -93,6 +103,20 @@ class Request:
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+    def deadline_violation(self, now: float) -> Optional[str]:
+        """The deadline this request has blown at host time ``now``
+        (perf_counter base), or None.  End-to-end is checked first —
+        it subsumes TTFT once tokens flow."""
+        if self.deadline_s is not None \
+                and now - self.arrival_time > self.deadline_s:
+            return (f"end-to-end deadline {self.deadline_s}s exceeded "
+                    f"({len(self.tokens)} tokens generated)")
+        if self.first_token_time is None \
+                and self.ttft_deadline_s is not None \
+                and now - self.arrival_time > self.ttft_deadline_s:
+            return f"TTFT deadline {self.ttft_deadline_s}s exceeded"
+        return None
 
 
 class Scheduler:
@@ -242,6 +266,26 @@ class Scheduler:
             del self.waiting[pick]
             budget -= pick_cost
             out.append((req, pick_cost))
+        return out
+
+    def remove_waiting(self, request_id: int) -> Optional[Request]:
+        """Pull one request out of the waiting queue by id (cancellation
+        / deadline expiry of a not-yet-admitted request); returns it, or
+        None when it is not queued."""
+        for i, req in enumerate(self.waiting):
+            if req.request_id == request_id:
+                del self.waiting[i]
+                return req
+        return None
+
+    def expired_waiting(self, now: float) -> List[Request]:
+        """Remove and return every queued request whose deadline has
+        already passed at ``now`` — a request that can no longer meet
+        its SLO must not consume a slot and a prefill first."""
+        out = [r for r in self.waiting
+               if r.deadline_violation(now) is not None]
+        for r in out:
+            self.waiting.remove(r)
         return out
 
     def requeue_front(self, reqs: List[Request]) -> None:

@@ -70,6 +70,19 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
     # prefix_cache.PrefixCache.match pins the radix path until release
     ResourcePair("match", "release", "radix prefix pin",
                  receiver_hint=("cache",)),
+    # serving/faults.py FaultInjector: an armed injection point must be
+    # disarmed on every exit path, or a raising chaos scenario leaves
+    # the fault live for whatever runs next (hinted to fault-ish
+    # receivers so tracer.enable/disable below keeps its own pair; this
+    # pair must sort BEFORE the tracer one — acquire-name collisions
+    # resolve first-match by receiver hint)
+    ResourcePair("enable", "disable", "fault injection",
+                 receiver_hint=("fault",)),
+    # serving/health.py EngineHealth: a quarantine window opened by the
+    # watchdog must close on every path (rebuild success OR failure), or
+    # the engine reports quarantined forever
+    ResourcePair("enter_quarantine", "leave_quarantine",
+                 "quarantine window", receiver_hint=("health",)),
     # obs.Tracer spans (paddle_tpu/obs/tracing.py): a begun span must be
     # ended on exception edges too, or every later span nests inside a
     # phantom (the engine's serving.step pattern — end_span in finally)

@@ -144,6 +144,12 @@ ALLOWLIST: Dict[str, str] = {
         "SamplingParams", "Scheduler", "KVPool", "ServingMetrics",
         "bucket_length", "sample_rows", "BlockPool", "PrefixCache",
         "MatchResult",
+        # fault-tolerance surface (ISSUE 8): watchdog/ladder/injection
+        # control plane + the in-program health probe; contract =
+        # tests/test_zz_chaos_serving.py
+        "FaultToleranceConfig", "EngineHealth", "DegradationLadder",
+        "FaultInjector", "FaultError", "RequestRejected",
+        "EngineStalledError", "finite_or_sentinel",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""chaos_smoke — run ONE injected-fault serving scenario end-to-end and
+emit the recovery evidence as artifacts (the fault-tolerance sibling of
+``scripts/obs_dump.py``):
+
+  * a step fault is injected mid-run (``--site``/``--at``/``--times``
+    pick any point from ``paddle_tpu/serving/faults.py``), the watchdog
+    retries/degrades/quarantines per the recovery matrix in
+    docs/serving.md, and the run drains;
+  * ``chaos.json``    — the accounting verdict: every submitted request's
+    terminal status+reason, fault/retry/quarantine counters, final
+    health state, and the pool/refcount baseline check;
+  * ``metrics.prom``  — Prometheus text of the same run, so the fault
+    counters and health gauge documented in docs/observability.md can be
+    eyeballed in their scraped form.
+
+Usage:
+    python scripts/chaos_smoke.py --out /tmp/chaos [--site step]
+        [--at 2] [--times 2] [--requests 6] [--slots 2]
+
+The script FAILS (exit 1) if any request ends non-terminal or the pools
+do not return to baseline — tests/test_zz_chaos_serving.py runs it as a
+tier-1 artifact smoke, so the recovery path cannot rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+TERMINAL = ("finished", "cancelled", "deadline_exceeded", "rejected",
+            "failed")
+
+
+def build_workload(n_requests: int, vocab: int, seed: int = 0):
+    """Same mixed-arrival smoke traffic shape as obs_dump: varied
+    lengths plus one shared-prefix pair (the radix cache participates in
+    the recovery path being smoked)."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    lens = [3 + (i * 5) % 12 for i in range(n_requests)]
+    prompts = [rs.randint(0, vocab, (L,)) for L in lens]
+    if n_requests >= 2:
+        prompts[-1] = np.concatenate(
+            [prompts[0], rs.randint(0, vocab, (2,))])
+    return prompts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos_smoke", description=__doc__)
+    ap.add_argument("--out", default="chaos_artifacts",
+                    help="output directory (created if missing)")
+    ap.add_argument("--site", default="step",
+                    help="fault injection point (serving/faults.py)")
+    ap.add_argument("--at", type=int, default=2,
+                    help="site hit index the fault first fires on")
+    ap.add_argument("--times", type=int, default=2,
+                    help="consecutive hits that fire")
+    ap.add_argument("--seconds", type=float, default=0.01,
+                    help="stall length for --site slow_step")
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-new-tokens", type=int, default=6)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                    ServingEngine)
+    from paddle_tpu.serving.faults import POINTS
+
+    if args.site not in POINTS:
+        ap.error(f"--site must be one of {POINTS}")
+
+    with jax.default_prng_impl("rbg"):
+        model = GPTForCausalLM(gpt_tiny())
+    faults = FaultInjector()
+    ft = FaultToleranceConfig(max_step_retries=3, backoff_base_s=0.0)
+    eng = ServingEngine(model, num_slots=args.slots, min_bucket=8,
+                        fault_tolerance=ft, faults=faults)
+    prompts = build_workload(args.requests, model.cfg.vocab_size)
+
+    faults.enable(args.site, at=args.at, times=args.times,
+                  seconds=args.seconds)
+    try:
+        half = max(len(prompts) // 2, 1)
+        ids = [eng.submit(p, max_new_tokens=args.max_new_tokens)
+               for p in prompts[:half]]
+        eng.step()
+        ids += [eng.submit(p, max_new_tokens=args.max_new_tokens)
+                for p in prompts[half:]]
+        eng.run_until_complete(max_steps=10000)
+    finally:
+        faults.disable(args.site)
+
+    outs = [eng.result(i) for i in ids]
+    core = eng.core
+    baseline_ok = (core.pool.free_slots == core.num_slots
+                   and core.scheduler.active == 0
+                   and core.scheduler.queue_depth == 0)
+    if core.block_pool is not None:
+        bp = core.block_pool
+        baseline_ok &= bp.free_blocks + bp.used_blocks == bp.num_blocks
+    accounted = all(o.finished and o.status in TERMINAL
+                    and o.status_reason for o in outs)
+
+    m = eng.metrics_dict()
+    os.makedirs(args.out, exist_ok=True)
+    prom_path = os.path.join(args.out, "metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(eng.registry.prometheus())
+    verdict = {
+        "site": args.site,
+        "fired": faults.fired[args.site],
+        "requests": [{"request_id": o.request_id, "status": o.status,
+                      "reason": o.status_reason,
+                      "tokens": len(o.tokens)} for o in outs],
+        "faults": m["faults"],
+        "step_retries": m["step_retries"],
+        "quarantines": m["quarantines"],
+        "degradation_level": m["degradation_level"],
+        "health": eng.health.state,
+        "all_terminal": accounted,
+        "pools_at_baseline": baseline_ok,
+        "metrics_prom": prom_path,
+    }
+    chaos_path = os.path.join(args.out, "chaos.json")
+    with open(chaos_path, "w") as f:
+        json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    if not (accounted and baseline_ok and faults.fired[args.site] >= 1):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

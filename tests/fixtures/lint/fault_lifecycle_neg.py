@@ -1,0 +1,32 @@
+"""Clean fault-injection / quarantine lifecycle idioms — zero findings.
+
+try/finally-protected fault windows, raise-window-free arm/disarm,
+finally-closed quarantines, and non-fault receivers that the hint gate
+must leave alone.
+"""
+
+
+def protected_fault_window(faults, engine, site):
+    faults.enable(site)
+    try:
+        engine.step()
+    finally:
+        faults.disable(site)         # protected: closes on raise too
+
+
+def adjacent_arm_disarm(faults, site):
+    faults.enable(site)
+    faults.disable(site)             # nothing can raise in between
+
+
+def protected_quarantine(health, engine, reason):
+    q = health.enter_quarantine(reason)
+    try:
+        engine.rebuild()
+    finally:
+        health.leave_quarantine(q)   # window closes on every path
+
+
+def non_fault_receiver_untracked(switch, engine, site):
+    switch.enable(site)              # hint gate: not a fault injector
+    engine.step()

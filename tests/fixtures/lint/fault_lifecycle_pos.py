@@ -1,0 +1,27 @@
+"""Planted resource-lifecycle bugs for the fault-injection and
+quarantine ResourcePairs — exactly 3 findings:
+
+  1. an armed fault site leaked on the exception edge (enable ->
+     raising call -> disable, unprotected);
+  2. an armed fault site never disarmed at all;
+  3. a quarantine window leaked on the exception edge (enter ->
+     raising rebuild -> leave, unprotected).
+"""
+
+
+def faulted_window_leaks_on_raise(faults, engine, site):
+    faults.enable(site)              # BUG 1: leaks if step() raises
+    engine.step()
+    faults.disable(site)
+
+
+def armed_and_forgotten(faults, site):
+    faults.enable(site)              # BUG 2: never disabled, no escape
+    count = site.count
+    return count
+
+
+def quarantine_window_leaks_on_raise(health, engine, reason):
+    q = health.enter_quarantine(reason)   # BUG 3: leaks if rebuild raises
+    engine.rebuild()
+    health.leave_quarantine(q)

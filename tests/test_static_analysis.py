@@ -379,6 +379,49 @@ def test_obs_span_lifecycle_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_fault_quarantine_pairs_registered():
+    """ISSUE 8: the serving fault-injector's enable/disable and the
+    watchdog's enter_quarantine/leave_quarantine are registered
+    ResourcePairs, receiver-hinted so they never collide with the
+    tracer's enable/disable pair (the fault pair sorts FIRST — acquire-
+    name collisions resolve first-match by hint)."""
+    from paddle_tpu.tools.analysis.checkers.lifecycle import DEFAULT_PAIRS
+    triples = {(p.acquire, p.release, p.kind) for p in DEFAULT_PAIRS}
+    assert ("enable", "disable", "fault injection") in triples
+    assert ("enter_quarantine", "leave_quarantine",
+            "quarantine window") in triples
+    by_kind = {p.kind: p for p in DEFAULT_PAIRS}
+    assert "fault" in by_kind["fault injection"].receiver_hint
+    assert "health" in by_kind["quarantine window"].receiver_hint
+    # ordering contract: fault pair before the tracer capture pair, so
+    # a `faults.enable(...)` receiver is never claimed by the tracer
+    # pair (and vice versa — hints are disjoint)
+    acquires = [p.kind for p in DEFAULT_PAIRS if p.acquire == "enable"]
+    assert acquires.index("fault injection") \
+        < acquires.index("tracer capture")
+
+
+def test_fault_lifecycle_positive():
+    """Exactly 3 planted bugs: a fault armed across a raising call
+    without protection, a fault armed and never disarmed, and a
+    quarantine window leaked on the exception edge."""
+    res = run_rule("fault_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 3, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "fault injection" in msgs
+    assert "quarantine window" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+
+
+def test_fault_lifecycle_negative():
+    """try/finally-protected fault windows and quarantines, adjacent
+    arm/disarm, and non-fault receivers (hint gate) — silent."""
+    res = run_rule("fault_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_resource_pair_registration_api():
     """Custom pairs plug in via the constructor — the documented
     registration API for new alloc/free protocols."""
