@@ -211,18 +211,24 @@ def fusion_legal(*, max_seq: int, hidden: int, heads: int, kv_heads: int,
     return True, None
 
 
-def decode_block_route(kv_len: int):
+def decode_block_route(kv_len: int, tp: int = 1):
     """Routing policy for the fused path (on top of ``fusion_legal``):
-    ``FLAGS_pallas_routing`` "never" wins everywhere including CPU (the
-    flag's all-Pallas-off contract); otherwise CPU always takes the
-    interpreted kernel (tier-1 exercises it), and on-chip the measured
-    decode-attention crossover (Pallas wins at kv <= 6144, statistical
-    tie beyond — kernels/routing.py) gates the fused path too, since
-    its inner loop is the same KV streaming pattern.  The fused-vs-
-    unfused `kernel_compare` row is the pending evidence to widen this.
+    a tensor-parallel mesh refuses outright (the kernel pair assumes the
+    whole layer's weights and slab are device-local; the TP decode path
+    is serving/tp.py's fused compute-collective program — a sharded
+    decode-block variant is future work), then ``FLAGS_pallas_routing``
+    "never" wins everywhere including CPU (the flag's all-Pallas-off
+    contract); otherwise CPU always takes the interpreted kernel
+    (tier-1 exercises it), and on-chip the measured decode-attention
+    crossover (Pallas wins at kv <= 6144, statistical tie beyond —
+    kernels/routing.py) gates the fused path too, since its inner loop
+    is the same KV streaming pattern.  The fused-vs-unfused
+    `kernel_compare` row is the pending evidence to widen this.
     Returns ``(ok, reason)``."""
     from ..core.flags import flags
     from .routing import use_pallas
+    if tp > 1:
+        return False, "tensor_parallel"
     if getattr(flags, "pallas_routing", "auto") == "never":
         return False, "FLAGS_pallas_routing=never"
     if jax.default_backend() == "cpu":
@@ -233,10 +239,13 @@ def decode_block_route(kv_len: int):
     return True, None
 
 
-def resolve_fused_decode(model, *, batch: int, kv_len: int):
+def resolve_fused_decode(model, *, batch: int, kv_len: int, tp: int = 1):
     """The full fused-vs-unfused fallback chain for a model at
     ``(batch, kv_len)``: model support (``fused_decode_step`` +
-    ``fused_decode_supported``) -> routing policy
+    ``fused_decode_supported``) -> mesh legality (``tp > 1`` refuses
+    with reason ``"tensor_parallel"`` — the Pallas pair has no sharded
+    variant yet; the TP engine's fused path is serving/tp.py's
+    compute-collective program) -> routing policy
     (:func:`decode_block_route`) -> shape/dtype/VMEM legality (the
     model's ``fused_decode_supported`` -> :func:`fusion_legal`).
     Shared by ``engine._resolve_decode_path`` and bench's
@@ -246,7 +255,7 @@ def resolve_fused_decode(model, *, batch: int, kv_len: int):
     supported = getattr(model, "fused_decode_supported", None)
     if supported is None or not hasattr(model, "fused_decode_step"):
         return False, "model has no fused_decode_step"
-    ok, reason = decode_block_route(kv_len)
+    ok, reason = decode_block_route(kv_len, tp=tp)
     if not ok:
         return False, reason
     return supported(batch=batch, kv_len=kv_len)

@@ -73,7 +73,10 @@ def _rule(kernel: str, f: dict) -> bool:
         # The fused-vs-unfused `kernel_compare` row
         # (scripts/tpu_evidence_bench.py) is the pending evidence that
         # will widen or narrow this; shape legality is checked
-        # separately by decode_block.fusion_legal.
+        # separately by decode_block.fusion_legal, and mesh legality
+        # (tp > 1 refuses with reason "tensor_parallel" — the pair
+        # assumes a device-local slab) by decode_block.decode_block_route
+        # BEFORE this table is consulted.
         return _rule("decode_attention", f)
     if kernel in ("layer_norm", "rms_norm"):
         return False

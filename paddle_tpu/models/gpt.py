@@ -320,6 +320,62 @@ class GPTForCausalLM(Layer):
         from .generation import generate
         return generate(self, input_ids, max_new_tokens, **kw)
 
+    # ---- tensor-parallel serving (serving/tp.py) ----------------------
+    def tp_decode_supported(self, tp: int):
+        """Static legality of the fused compute-collective TP decode
+        program at degree ``tp``: every partitioned dimension must tile
+        the mesh axis evenly (fixed shapes per device — the same
+        discipline as the engine's compile-count pin).  Returns
+        ``(ok, reason)``."""
+        cfg = self.cfg
+        for what, n in (("num_heads", cfg.num_heads),
+                        ("ffn_size", cfg.ffn_size),
+                        ("vocab_size", cfg.vocab_size)):
+            if n % tp:
+                return False, (f"{what} {n} not divisible by "
+                               f"tensor_parallel {tp}")
+        return True, None
+
+    def tp_decode_weights(self, tp: int):
+        """``(arch, weights)`` for the serving TP decode program
+        (serving/tp.py).  The fused QKV weight is re-arranged so each
+        device's contiguous column shard is ``[q_d | k_d | v_d]`` for
+        its own head group — the manual program needs head-aligned
+        blocks, which the training layout's plain contiguous split of
+        the fused ``[h, 3h]`` matrix does not give."""
+        cfg = self.cfg
+        h, dh = cfg.hidden_size, cfg.head_dim
+        arch = {"norm": "layer", "eps": cfg.layer_norm_eps,
+                "act": "gelu_tanh", "rope": False, "rope_theta": None,
+                "heads": cfg.num_heads, "kv_heads": cfg.num_heads,
+                "head_dim": dh, "hidden": h, "vocab": cfg.vocab_size}
+        step = (cfg.num_heads // tp) * dh
+        blocks = []
+        for blk in self.gpt.h:
+            w, bias = blk.qkv.weight, blk.qkv.bias
+            wq, wk, wv = w[:, :h], w[:, h:2 * h], w[:, 2 * h:]
+            parts, bparts = [], []
+            for d in range(tp):
+                sl = slice(d * step, (d + 1) * step)
+                parts += [wq[:, sl], wk[:, sl], wv[:, sl]]
+                if bias is not None:
+                    bparts += [bias[:h][sl], bias[h:2 * h][sl],
+                               bias[2 * h:][sl]]
+            blocks.append({
+                "n1w": blk.ln_1.weight, "n1b": blk.ln_1.bias,
+                "wqkv": jnp.concatenate(parts, axis=1),
+                "bqkv": jnp.concatenate(bparts) if bias is not None
+                else None,
+                "wo": blk.out_proj.weight, "bo": blk.out_proj.bias,
+                "n2w": blk.ln_2.weight, "n2b": blk.ln_2.bias,
+                "wup": blk.fc_in.weight, "bup": blk.fc_in.bias,
+                "wdown": blk.fc_out.weight, "bdown": blk.fc_out.bias})
+        return arch, {
+            "wte": self.gpt.wte.weight, "wpe": self.gpt.wpe.weight,
+            "head": None if cfg.tie_embeddings else self.lm_head.weight,
+            "nfw": self.gpt.ln_f.weight, "nfb": self.gpt.ln_f.bias,
+            "blocks": blocks}
+
 
 def gpt_tiny(**kw) -> GPTConfig:
     return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,

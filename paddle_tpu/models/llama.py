@@ -311,6 +311,63 @@ class LlamaForCausalLM(Layer):
         from .generation import generate
         return generate(self, input_ids, max_new_tokens, **kw)
 
+    # ---- tensor-parallel serving (serving/tp.py) ----------------------
+    def tp_decode_supported(self, tp: int):
+        """Static legality of the fused compute-collective TP decode
+        program at degree ``tp`` (GQA aware: the kv-head axis must tile
+        the mesh too, since the KV slot slabs partition on it).
+        Returns ``(ok, reason)``."""
+        cfg = self.cfg
+        for what, n in (("num_heads", cfg.num_heads),
+                        ("kv_heads", cfg.kv_heads),
+                        ("intermediate_size", cfg.intermediate_size),
+                        ("vocab_size", cfg.vocab_size)):
+            if n % tp:
+                return False, (f"{what} {n} not divisible by "
+                               f"tensor_parallel {tp}")
+        return True, None
+
+    def tp_decode_weights(self, tp: int):
+        """``(arch, weights)`` for the serving TP decode program
+        (serving/tp.py): q/k/v column shards re-arranged per device as
+        ``[q_d | k_d | v_d]`` head-group blocks (one fused entry
+        matmul), gate/up as ``[gate_d | up_d]`` (one fused MLP-up
+        matmul); o/down stay row-parallel, embedding/lm_head
+        vocab-parallel."""
+        cfg = self.cfg
+        dh = cfg.head_dim
+        arch = {"norm": "rms", "eps": cfg.rms_norm_eps, "act": "swiglu",
+                "rope": True, "rope_theta": cfg.rope_theta,
+                "heads": cfg.num_heads, "kv_heads": cfg.kv_heads,
+                "head_dim": dh, "hidden": cfg.hidden_size,
+                "vocab": cfg.vocab_size}
+        qs, kvs, fs = ((cfg.num_heads // tp) * dh,
+                       (cfg.kv_heads // tp) * dh,
+                       cfg.intermediate_size // tp)
+        blocks = []
+        for layer in self.llama.layers:
+            at, mlp = layer.self_attn, layer.mlp
+            parts, mparts = [], []
+            for d in range(tp):
+                parts += [at.q_proj.weight[:, d * qs:(d + 1) * qs],
+                          at.k_proj.weight[:, d * kvs:(d + 1) * kvs],
+                          at.v_proj.weight[:, d * kvs:(d + 1) * kvs]]
+                mparts += [mlp.gate_proj.weight[:, d * fs:(d + 1) * fs],
+                           mlp.up_proj.weight[:, d * fs:(d + 1) * fs]]
+            blocks.append({
+                "n1w": layer.input_layernorm.weight, "n1b": None,
+                "wqkv": jnp.concatenate(parts, axis=1), "bqkv": None,
+                "wo": at.o_proj.weight, "bo": None,
+                "n2w": layer.post_attention_layernorm.weight,
+                "n2b": None,
+                "wup": jnp.concatenate(mparts, axis=1), "bup": None,
+                "wdown": mlp.down_proj.weight, "bdown": None})
+        return arch, {
+            "wte": self.llama.embed_tokens.weight, "wpe": None,
+            "head": self.lm_head.weight,
+            "nfw": self.llama.norm.weight, "nfb": None,
+            "blocks": blocks}
+
 
 # ---------------------------------------------------------------------------
 # semi-auto sharding plan (reference: the hybrid_strategy llama tests call

@@ -95,10 +95,16 @@ class ServingEngine:
                  fused_decode: bool = False,
                  fault_tolerance: Optional[FaultToleranceConfig] = None,
                  faults=None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 tensor_parallel: int = 1,
+                 collective_fusion: bool = True):
         # registry/tracer (paddle_tpu.obs) may be shared across engines
         # (a fleet scraping one Prometheus surface: shared instruments
         # aggregate, lanes come from per-engine blocks); default: private
+        # tensor_parallel > 1 shards the engine over a 1-D mesh (model
+        # weights, KV slabs, every compiled program); collective_fusion
+        # opts the decode step into the fused compute-collective
+        # shard_map path — see docs/serving.md "Tensor-parallel serving"
         self.core = EngineCore(
             model, num_slots=num_slots, max_seq=max_seq,
             min_bucket=min_bucket,
@@ -111,7 +117,9 @@ class ServingEngine:
                                    registry=registry, tracer=tracer),
             fused_decode=fused_decode,
             fault_tolerance=fault_tolerance, faults=faults,
-            max_queue=max_queue)
+            max_queue=max_queue,
+            tensor_parallel=tensor_parallel,
+            collective_fusion=collective_fusion)
         self._requests = {}
 
     # -------------------------------------------------------- submission
@@ -279,15 +287,31 @@ class ServingEngine:
 
     @property
     def decode_path(self) -> str:
-        """``"fused"`` or ``"unfused"`` — which decode step this engine
-        compiled (resolved once at construction; see docs/serving.md)."""
+        """``"fused"`` (Pallas decode-block), ``"tp_fused"`` (the
+        tensor-parallel fused compute-collective shard_map program) or
+        ``"unfused"`` — which decode step this engine compiled
+        (resolved once at construction; see docs/serving.md)."""
         return self.core.decode_path
 
     @property
     def decode_fallback_reason(self):
         """Why ``fused_decode=True`` fell back to the composed path
-        (``None`` when fused is active or the flag is off)."""
+        (``None`` when fused is active or the flag is off;
+        ``"tensor_parallel"`` under a tp > 1 mesh — the Pallas pair has
+        no sharded variant)."""
         return self.core.decode_fallback_reason
+
+    @property
+    def tensor_parallel(self) -> int:
+        """The engine's tensor-parallel mesh degree (1 = single chip)."""
+        return self.core.tensor_parallel
+
+    @property
+    def tp_fusion_reason(self):
+        """Why a tp > 1 engine fell back from the fused
+        compute-collective decode to the composed GSPMD path (``None``
+        when ``tp_fused`` is active or the engine is single-chip)."""
+        return self.core.tp_fusion_reason
 
     @property
     def tracer(self):

@@ -170,7 +170,9 @@ class EngineCore:
                  fused_decode: bool = False,
                  fault_tolerance: Optional[FaultToleranceConfig] = None,
                  faults=None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 tensor_parallel: int = 1,
+                 collective_fusion: bool = True):
         if prefill_chunk is not None and prefill_chunk < min_bucket:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= min_bucket "
@@ -229,6 +231,42 @@ class EngineCore:
         # events so they correlate with the surrounding serving.step span
         self._step_index = 0
         self._step_in_flight = 0
+        # ---- tensor-parallel serving (docs/serving.md "Tensor-parallel
+        # serving"): tp > 1 shards the WHOLE device plane over a 1-D
+        # mesh — model weights Megatron-style, KV slot/block slabs on
+        # the kv-head axis — and every compiled program becomes a
+        # per-mesh SPMD program with its set size unchanged.  The decode
+        # step additionally takes the fused compute-collective shard_map
+        # path (serving/tp.py) when collective_fusion is on and the
+        # model supports it; otherwise the composed GSPMD decode serves.
+        if tensor_parallel < 1:
+            raise ValueError(
+                f"tensor_parallel must be >= 1, got {tensor_parallel}")
+        self.tensor_parallel = tensor_parallel
+        self.collective_fusion = collective_fusion
+        self.mesh = None
+        self._tp_program = None
+        self.tp_fusion_reason: Optional[str] = None
+        if tensor_parallel > 1:
+            from . import tp as _tp
+            # every construction-failure check runs BEFORE
+            # shard_model_params mutates the caller's model in place: a
+            # caller catching the ValueError and retrying at tp=1 must
+            # get back an untouched single-device model, not one whose
+            # weights were already laid out over a mesh
+            cfg = model.cfg
+            kv_heads = getattr(cfg, "kv_heads", None) or cfg.num_heads
+            if kv_heads % tensor_parallel:
+                raise ValueError(
+                    f"kv_heads {kv_heads} must divide evenly over "
+                    f"tensor_parallel {tensor_parallel} (the KV slot "
+                    f"slabs partition on the kv-head axis)")
+            self.mesh = _tp.build_serving_mesh(tensor_parallel)
+            # GSPMD layout for the whole program set: prefill chunks,
+            # staging init, gather/scatter, adopt and the sampling tail
+            # all compile against the sharded weights
+            _tp.shard_model_params(model, self.mesh)
+        self.metrics.set_tp_degree(tensor_parallel)
         self._build_device_plane()
         self.scheduler = Scheduler(num_slots, self.pool.max_seq,
                                    min_bucket=min_bucket,
@@ -252,7 +290,8 @@ class EngineCore:
         program SET stays {chunk} + buckets + ONE decode (pinned by the
         chaos suite's post-quarantine compile test)."""
         model, num_slots = self.model, self.num_slots
-        self.pool = KVPool.create(model, num_slots, self._max_seq_arg)
+        self.pool = KVPool.create(model, num_slots, self._max_seq_arg,
+                                  mesh=self.mesh)
         self.pool.faults = self.faults
         self.prefix_cache: Optional[PrefixCache] = None
         self.block_pool: Optional[BlockPool] = None
@@ -276,7 +315,8 @@ class EngineCore:
                 if self._prefix_blocks_arg is not None else \
                 num_slots * (self.pool.max_seq // block_len)
             self.block_pool = BlockPool.create(model, nb, block_len,
-                                               self.pool.max_seq)
+                                               self.pool.max_seq,
+                                               mesh=self.mesh)
             self.block_pool.faults = self.faults
             self.prefix_cache = PrefixCache(self.block_pool)
             self.prefix_cache.faults = self.faults
@@ -593,15 +633,35 @@ class EngineCore:
 
     # ------------------------------------------------------------ decode
     def _resolve_decode_path(self):
-        """Statically resolve fused-vs-unfused for THIS engine's shapes:
-        the flag opts in, ``decode_block_route`` applies the routing
-        policy (flags + measured win region), and the model's
-        ``fused_decode_supported`` checks shape/dtype/VMEM legality.
-        Returns ``(path, fallback_reason)``; reason is None when fused
-        engages (or the flag is simply off)."""
+        """Statically resolve the decode implementation for THIS
+        engine's shapes: the ``fused_decode`` flag opts into the Pallas
+        decode-block pair, ``decode_block_route`` applies the routing
+        policy (flags + measured win region + mesh legality), and the
+        model's ``fused_decode_supported`` checks shape/dtype/VMEM
+        legality.  Under tensor parallelism the Pallas pair refuses
+        (``decode_fallback_reason="tensor_parallel"`` — it assumes a
+        device-local slab) and the engine instead resolves the fused
+        compute-collective shard_map program (``"tp_fused"``,
+        serving/tp.py) when ``collective_fusion`` is on and legal, the
+        composed GSPMD decode otherwise.  Returns ``(path,
+        fallback_reason)``; reason is None when fused engages (or the
+        flag is simply off)."""
+        from ..kernels.decode_block import resolve_fused_decode
+        if self.tensor_parallel > 1:
+            reason = None
+            if self.fused_decode:
+                _, reason = resolve_fused_decode(
+                    self.model, batch=self.num_slots,
+                    kv_len=self.pool.max_seq, tp=self.tensor_parallel)
+            from . import tp as _tp
+            ok, tp_reason = _tp.tp_decode_supported(
+                self.model, self.tensor_parallel, self.num_slots) \
+                if self.collective_fusion \
+                else (False, "collective_fusion disabled")
+            self.tp_fusion_reason = None if ok else tp_reason
+            return ("tp_fused" if ok else "unfused"), reason
         if not self.fused_decode:
             return "unfused", None
-        from ..kernels.decode_block import resolve_fused_decode
         ok, reason = resolve_fused_decode(self.model,
                                           batch=self.num_slots,
                                           kv_len=self.pool.max_seq)
@@ -618,6 +678,8 @@ class EngineCore:
             reason=None if not self.fused_decode
             else self.decode_fallback_reason,
             step=self._step_in_flight)
+        if self.decode_path == "tp_fused":
+            return self._build_tp_decode_fn()
 
         def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
                    temperature, top_k, top_p):
@@ -640,6 +702,37 @@ class EngineCore:
 
         # donating the KV slabs aliases them in place — pool memory stays
         # a single allocation across the whole serving run
+        return jax.jit(decode, donate_argnums=(0, 1))
+
+    def _build_tp_decode_fn(self) -> Callable:
+        """The tensor-parallel fused compute-collective decode: ONE
+        shard_map program (serving/tp.py) whose entry all-gathers ride
+        the QKV/MLP-up dots and whose exit reduce-scatters ride the
+        out-proj/MLP-down dots, then the SAME per-slot sampling tail as
+        the composed path on the vocab-sharded logits (GSPMD partitions
+        the argmax/top-k reductions).  Same signature, same donation,
+        same single compiled decode program — the compile-count pin is
+        untouched.  The weight bundle survives quarantine rebuilds (it
+        is never donated), so a rebuilt plane reuses it."""
+        from . import tp as _tp
+        if self._tp_program is None:
+            self._tp_program = _tp.build_tp_decode_program(
+                self.model, self.mesh, self.tensor_parallel)
+        program = self._tp_program
+
+        def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
+                   temperature, top_k, top_p):
+            self.trace_counts["decode"] += 1  # trace-time side effect
+            logits, new_ks, new_vs, new_pos = program(
+                ks, vs, seq_pos, last_tok)
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            lg = logits[:, 0]
+            nxt = sample_rows(split[:, 1], lg, do_sample,
+                              temperature, top_k, top_p)
+            nxt = finite_or_sentinel(lg, nxt)
+            return (new_ks, new_vs, new_pos, nxt.astype(jnp.int32),
+                    split[:, 0])
+
         return jax.jit(decode, donate_argnums=(0, 1))
 
     def _decode_dispatch(self) -> jax.Array:
@@ -689,6 +782,17 @@ class EngineCore:
         return out
 
     def _step_impl(self) -> int:
+        """``_step_body`` inside the mesh scope when tensor-parallel:
+        the engine's jitted programs trace their bare-PartitionSpec
+        sharding constraints (the models' ``_maybe_constraint`` calls)
+        against the serving mesh, so GSPMD partitions every program the
+        step dispatches.  Single-chip engines skip the push entirely."""
+        if self.mesh is None:
+            return self._step_body()
+        with self.mesh:
+            return self._step_body()
+
+    def _step_body(self) -> int:
         """The raw step.  Telemetry rides the loop off the hot path: the
         step's phase breakdown (admission / prefill / decode dispatch /
         readback) lands as ``step.*`` spans on the engine lane +
@@ -795,6 +899,12 @@ class EngineCore:
                     # runs in the same registry (glossary:
                     # kernel.decode_block_s, docs/observability.md)
                     self.metrics.on_decode_block_step(t_decode - t_prefill)
+                if self.tensor_parallel > 1:
+                    # the TP decode's dispatch+readback carries its
+                    # fused entry/exit collectives — this histogram is
+                    # the trace evidence for the collective-fusion path
+                    # (glossary: serving.collective_s)
+                    self.metrics.on_collective(t_readback - t_prefill)
             self._evict_finished()
         finally:
             # a raised step must still close the span and the trace
@@ -1204,6 +1314,7 @@ class EngineCore:
             "degraded_subsystems": list(self.ladder.disabled_subsystems),
             "progress_counter": self.progress_counter,
             "steps": self._step_index,
+            "tensor_parallel": self.tensor_parallel,
         }
 
     def run_until_complete(self, max_steps: Optional[int] = None,

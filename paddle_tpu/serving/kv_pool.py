@@ -48,18 +48,40 @@ class KVPool:
     """
 
     def __init__(self, num_slots: int, max_seq: int, num_layers: int,
-                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 mesh=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if mesh is not None and kv_heads % mesh.devices.size:
+            raise ValueError(
+                f"kv_heads {kv_heads} must divide evenly over the "
+                f"{mesh.devices.size}-device tensor-parallel mesh (the "
+                f"slot slabs partition on the kv-head axis)")
         self.num_slots = num_slots
         self.max_seq = max_seq
         self.num_layers = num_layers
+        self.mesh = mesh
         shape = (num_slots, max_seq, kv_heads, head_dim)
-        self.ks: List[jax.Array] = [jnp.zeros(shape, dtype)
-                                    for _ in range(num_layers)]
-        self.vs: List[jax.Array] = [jnp.zeros(shape, dtype)
-                                    for _ in range(num_layers)]
-        self.seq_pos = jnp.zeros((num_slots,), jnp.int32)
+        if mesh is None:
+            self.ks: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                        for _ in range(num_layers)]
+            self.vs: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                        for _ in range(num_layers)]
+            self.seq_pos = jnp.zeros((num_slots,), jnp.int32)
+        else:
+            # tensor-parallel serving (serving/tp.py): slabs partition
+            # on the kv-head axis, the position vector replicates —
+            # every compiled program touching the pool then compiles
+            # against the sharded layout.  Born SHARDED (jit with
+            # out_shardings), never materialized whole on one device:
+            # at pod scale the full slab may not fit a single chip —
+            # that is the point of sharding it
+            from .tp import sharded_zeros, replicated
+            mk = sharded_zeros(mesh, shape, dtype)
+            self.ks = [mk() for _ in range(num_layers)]
+            self.vs = [mk() for _ in range(num_layers)]
+            self.seq_pos = replicated(
+                jnp.zeros((num_slots,), jnp.int32), mesh)
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
         # lifetime slot-churn counters (telemetry: metrics_dict reports
         # them; high churn relative to finished requests = thrashing)
@@ -71,14 +93,15 @@ class KVPool:
 
     @classmethod
     def create(cls, model, num_slots: int,
-               max_seq: Optional[int] = None) -> "KVPool":
+               max_seq: Optional[int] = None, mesh=None) -> "KVPool":
         """Size the pool from a causal-LM's config (kv_heads falls back
-        to num_heads for MHA models like GPT)."""
+        to num_heads for MHA models like GPT).  With ``mesh`` the slabs
+        lay out kv-head-sharded over the tensor-parallel mesh."""
         cfg = model.cfg
         max_seq = max_seq or cfg.max_seq_len
         kv_heads = getattr(cfg, "kv_heads", None) or cfg.num_heads
         return cls(num_slots, max_seq, cfg.num_layers, kv_heads,
-                   cfg.head_dim, dtype=jnp.dtype(cfg.dtype))
+                   cfg.head_dim, dtype=jnp.dtype(cfg.dtype), mesh=mesh)
 
     # ------------------------------------------------------------ slots
     @property
@@ -117,6 +140,9 @@ class KVPool:
         (stale rows are masked by seq_pos=0 until overwritten)."""
         self._free = list(range(self.num_slots - 1, -1, -1))
         self.seq_pos = jnp.zeros((self.num_slots,), jnp.int32)
+        if self.mesh is not None:
+            from .tp import replicated
+            self.seq_pos = replicated(self.seq_pos, self.mesh)
 
     def adopt(self, slot: int, layer_caches, length: int) -> None:
         """Move a freshly prefilled single-request cache (per-layer
@@ -169,7 +195,7 @@ class BlockPool:
 
     def __init__(self, num_blocks: int, block_len: int, max_seq: int,
                  num_layers: int, kv_heads: int, head_dim: int,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, mesh=None):
         if num_blocks < 1:
             raise ValueError("num_blocks must be >= 1")
         if block_len < 1:
@@ -182,12 +208,23 @@ class BlockPool:
         self.block_len = block_len
         self.max_seq = max_seq
         self.num_layers = num_layers
+        self.mesh = mesh
         self.blocks_per_row = max_seq // block_len
         shape = (num_blocks, block_len, kv_heads, head_dim)
-        self.bks: List[jax.Array] = [jnp.zeros(shape, dtype)
-                                     for _ in range(num_layers)]
-        self.bvs: List[jax.Array] = [jnp.zeros(shape, dtype)
-                                     for _ in range(num_layers)]
+        if mesh is None:
+            self.bks: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                         for _ in range(num_layers)]
+            self.bvs: List[jax.Array] = [jnp.zeros(shape, dtype)
+                                         for _ in range(num_layers)]
+        else:
+            # radix block slab partitions on the SAME kv-head axis as
+            # the slot slabs (so the gather/scatter copy programs move
+            # blocks without cross-device traffic), and is likewise
+            # born sharded — never whole on one device
+            from .tp import sharded_zeros
+            mk = sharded_zeros(mesh, shape, dtype)
+            self.bks = [mk() for _ in range(num_layers)]
+            self.bvs = [mk() for _ in range(num_layers)]
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self.trace_counts = {"gather": 0, "scatter": 0}
         self._load_fn = None
@@ -197,11 +234,12 @@ class BlockPool:
 
     @classmethod
     def create(cls, model, num_blocks: int, block_len: int,
-               max_seq: int) -> "BlockPool":
+               max_seq: int, mesh=None) -> "BlockPool":
         cfg = model.cfg
         kv_heads = getattr(cfg, "kv_heads", None) or cfg.num_heads
         return cls(num_blocks, block_len, max_seq, cfg.num_layers,
-                   kv_heads, cfg.head_dim, dtype=jnp.dtype(cfg.dtype))
+                   kv_heads, cfg.head_dim, dtype=jnp.dtype(cfg.dtype),
+                   mesh=mesh)
 
     # ------------------------------------------------------------ blocks
     @property

@@ -167,6 +167,26 @@ class ServingMetrics:
         self._g_degradation = g("serving.degradation_level",
                                 "optional subsystems disabled by the "
                                 "degradation ladder")
+        # tensor-parallel serving surface (docs/serving.md
+        # "Tensor-parallel serving"): the mesh degree this engine
+        # shards over, and the wall time of the collective-bearing
+        # decode dispatch+readback — on a TP mesh every decode step's
+        # latency includes its fused entry/exit collectives, so this
+        # histogram IS the trace evidence the collectives ride the
+        # step (compare its p50 against a tp=1 engine's
+        # serving.phase.decode_dispatch_s)
+        # the tp gauge binds OUTSIDE self._own: the degree is an
+        # engine-lifetime constant published once at construction, and
+        # the warmup->reset()->measure flow must not zero it into a
+        # lying 0 on every later scrape (health_state survives reset by
+        # being re-published each step; nothing re-publishes this)
+        self._g_tp = reg.gauge("serving.tp_degree",
+                               "tensor-parallel mesh degree "
+                               "(1 = single chip)")
+        self._h_collective = h("serving.collective_s",
+                               "collective-bearing decode "
+                               "dispatch+readback wall time (recorded "
+                               "only on tp > 1 engines)", unit="s")
         self._last_health_state: Optional[str] = None
         self._phase_h: Dict[str, Histogram] = {}
         self._zero_local()
@@ -249,6 +269,14 @@ class ServingMetrics:
         the ``kernel.decode_block_s`` histogram is separable from the
         unfused ``serving.phase.decode_dispatch_s`` in one registry)."""
         self._h_decode_block.observe(seconds)
+
+    def set_tp_degree(self, tp: int) -> None:
+        self._g_tp.set(tp)
+
+    def on_collective(self, seconds: float) -> None:
+        """One TP decode step's collective-bearing dispatch+readback
+        time (the engine calls this only when ``tp > 1``)."""
+        self._h_collective.observe(seconds)
 
     def on_compile(self, program: str, n: int = 1) -> None:
         self._c_compiles.inc(n)

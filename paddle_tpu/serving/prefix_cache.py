@@ -21,6 +21,12 @@ one-to-one and the device copies fixed-shape.  All tree state is plain
 host data: matching/insertion never touch the device except through the
 two jitted block-copy programs.
 
+Tensor parallelism (serving/tp.py) changes NOTHING here: the tree is
+host state, and under a mesh both slabs shard on the SAME kv-head axis,
+so the gather/scatter programs move each device's head shard of a block
+to the same device's head shard of the slot — GSPMD partitions the two
+copy programs with zero cross-device traffic.
+
 Lifecycle:
   * ``match()``   pins the matched path (refcount +1 per node) until the
     engine calls ``release()`` at request finish — a pinned block can

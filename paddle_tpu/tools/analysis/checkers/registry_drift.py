@@ -150,6 +150,13 @@ ALLOWLIST: Dict[str, str] = {
         "FaultToleranceConfig", "EngineHealth", "DegradationLadder",
         "FaultInjector", "FaultError", "RequestRejected",
         "EngineStalledError", "finite_or_sentinel",
+        # tensor-parallel serving plumbing (ISSUE 9): mesh/layout
+        # builders and the shard_map decode-program factory — sharding
+        # control plane, not array ops; contract =
+        # tests/test_zz_tp_serving.py
+        "build_serving_mesh", "serving_param_specs",
+        "shard_model_params", "sharded_zeros", "replicated",
+        "tp_decode_supported", "build_tp_decode_program",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

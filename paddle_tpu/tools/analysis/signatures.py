@@ -726,3 +726,50 @@ register_signature(
 register_signature(
     "paddle_tpu.kernels.decode_block.decode_block_mlp",
     _first_arg_like)
+
+
+def _allgather_matmul_sig(interp, rec):
+    """``allgather_matmul(x [B_l, K], w [K, N_l], axis, tp)`` ->
+    ``[B_l * tp, N_l]`` — the gathered-rows matmul of the TP decode
+    entry (kernels/collective_matmul.py).  The row blow-up needs a
+    concrete ``tp``; otherwise rank/dtype still propagate."""
+    x = _arg(rec, 0, "x")
+    w = _arg(rec, 1, "w")
+    tp = _arg(rec, 3, "tp")
+    shape = None
+    if isinstance(x, Arr) and x.shape is not None and len(x.shape) == 2 \
+            and isinstance(w, Arr) and w.shape is not None \
+            and len(w.shape) == 2 and isinstance(tp, Const) \
+            and isinstance(tp.value, int) \
+            and isinstance(x.shape[0], int):
+        shape = (x.shape[0] * tp.value, w.shape[1])
+    dt = x.dtype if isinstance(x, Arr) else None
+    tr = bool(getattr(x, "traced", False))
+    return Arr(shape=shape, dtype=dt, traced=tr)
+
+
+def _matmul_reduce_scatter_sig(interp, rec):
+    """``matmul_reduce_scatter(x [B, K_l], w [K_l, N], axis, tp)`` ->
+    ``[B // tp, N]`` — the scattered-sum matmul of the TP decode
+    exit."""
+    x = _arg(rec, 0, "x")
+    w = _arg(rec, 1, "w")
+    tp = _arg(rec, 3, "tp")
+    shape = None
+    if isinstance(x, Arr) and x.shape is not None and len(x.shape) == 2 \
+            and isinstance(w, Arr) and w.shape is not None \
+            and len(w.shape) == 2 and isinstance(tp, Const) \
+            and isinstance(tp.value, int) and tp.value > 0 \
+            and isinstance(x.shape[0], int):
+        shape = (x.shape[0] // tp.value, w.shape[1])
+    dt = x.dtype if isinstance(x, Arr) else None
+    tr = bool(getattr(x, "traced", False))
+    return Arr(shape=shape, dtype=dt, traced=tr)
+
+
+register_signature(
+    "paddle_tpu.kernels.collective_matmul.allgather_matmul",
+    _allgather_matmul_sig)
+register_signature(
+    "paddle_tpu.kernels.collective_matmul.matmul_reduce_scatter",
+    _matmul_reduce_scatter_sig)

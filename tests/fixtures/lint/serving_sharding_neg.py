@@ -1,0 +1,37 @@
+"""sharding-consistency negative, serving-shaped (ISSUE 9): the correct
+tensor-parallel serving idioms — every spec names the declared "mp"
+axis at the right rank, and the decode shard_map binds the axis its
+ring collectives address.  Zero findings expected."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+KV_SLAB_SPEC = P(None, None, "mp", None)
+
+
+def build_serving_mesh(tp):
+    return Mesh(np.array(jax.devices()[:tp]), ("mp",))
+
+
+def shard_slab(slab, mesh):
+    return jax.device_put(slab, NamedSharding(mesh, KV_SLAB_SPEC))
+
+
+def constrain_positions(seq_pos):
+    return jax.lax.with_sharding_constraint(seq_pos, P())
+
+
+def _decode_body(x):
+    idx = jax.lax.axis_index("mp")
+    chunk = jax.lax.ppermute(x, "mp", [(0, 1), (1, 0)])
+    return jax.lax.psum(chunk * (idx + 1), "mp")
+
+
+def decode_program(x, mesh):
+    f = shard_map(_decode_body, mesh=mesh, in_specs=P("mp"),
+                  out_specs=P(), axis_names=frozenset({"mp"}))
+    return f(x)

@@ -1,0 +1,41 @@
+"""sharding-consistency positive, serving-shaped (ISSUE 9): the
+tensor-parallel serving idioms — a 1-D "mp" mesh, kv-head-sharded slab
+specs, a shard_map decode body with ring collectives — with three
+planted mismatches: a slab spec naming an axis the serving mesh never
+declares, a constraint spec longer than the slab's rank, and a ppermute
+over an axis the decode shard_map does not bind."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def build_serving_mesh(tp):
+    return Mesh(np.array(jax.devices()[:tp]), ("mp",))
+
+
+def shard_slab(slab, mesh):
+    # 1: the serving mesh declares only "mp" — "tp" is the typo'd alias
+    return jax.device_put(slab, NamedSharding(mesh, P(None, None, "tp",
+                                                      None)))
+
+
+def constrain_positions(num_slots):
+    # 2: a 2-entry spec on the rank-1 per-slot position vector
+    seq_pos = jnp.zeros((8,), jnp.int32)
+    return jax.lax.with_sharding_constraint(seq_pos, P(None, "mp"))
+
+
+def _decode_body(x):
+    # 3: the decode shard_map below binds only "mp" — this ring rides
+    # a "dp" axis the program never made addressable
+    return jax.lax.ppermute(x, "dp", [(0, 1), (1, 0)])
+
+
+def decode_program(x, mesh):
+    f = shard_map(_decode_body, mesh=mesh, in_specs=P("mp"),
+                  out_specs=P("mp"), axis_names=frozenset({"mp"}))
+    return f(x)

@@ -617,6 +617,42 @@ def test_sharding_consistency_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_serving_sharding_positive():
+    """ISSUE 9: the rule covers the serving TP idioms — a "mp" serving
+    mesh, kv-head slab specs, a shard_map decode body with ring
+    collectives — catching exactly the 3 planted mismatches."""
+    res = run_rule("serving_sharding_pos.py", "sharding-consistency")
+    found = only_rule(res, "sharding-consistency")
+    assert len(found) == 3, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "'tp'" in msgs                      # slab spec typo
+    assert "2 entries" in msgs and "rank 1" in msgs
+    assert "'dp'" in msgs and "only binds ['mp']" in msgs
+
+
+def test_serving_sharding_negative():
+    """The real serving layout (tp.py's idioms) is clean: declared-axis
+    specs at the right rank, collectives bound by their shard_map."""
+    res = run_rule("serving_sharding_neg.py", "sharding-consistency")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_serving_tp_module_in_rule_scope():
+    """serving/tp.py is the serving mesh's home module: it constructs
+    the Mesh AND carries the slab/bundle P literals, so the rule's
+    'mesh visible -> specs checked' gate is ACTIVE over it (a typo'd
+    axis there would be a gate failure, not silence)."""
+    from paddle_tpu.tools.analysis.checkers.sharding_consistency import \
+        _mesh_axes
+    import ast
+    tp_py = REPO_ROOT / "paddle_tpu" / "serving" / "tp.py"
+    axes = _mesh_axes(ast.parse(tp_py.read_text()))
+    assert axes == {"mp"}
+    res = run_analysis([str(tp_py)], root=str(REPO_ROOT),
+                       rules=["sharding-consistency"])
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_sharding_consistency_no_mesh_module_is_skipped(tmp_path):
     """A module with NO visible mesh CONSTRUCTION never has its specs
     checked — the axes are the caller's contract.  An ``axis_name=``
@@ -661,6 +697,34 @@ def test_signature_table_registration():
             and ret.traced
     finally:
         del SIGNATURES[name]
+
+
+def test_collective_matmul_signatures_registered():
+    """ISSUE 9: the fused compute-collective matmuls carry graftshape
+    signatures keyed by definition site, and the handlers propagate the
+    TP row blow-up/shrink when tp is concrete."""
+    from paddle_tpu.tools.analysis.absint import Arr, Const
+    from paddle_tpu.tools.analysis.signatures import SIGNATURES
+
+    class _Rec:
+        def __init__(self, args):
+            self.args = args
+            self.kwargs = {}
+
+    ag = SIGNATURES["paddle_tpu.kernels.collective_matmul"
+                    ".allgather_matmul"]
+    out = ag(None, _Rec([Arr(shape=(2, 16), dtype="float32",
+                             traced=True),
+                         Arr(shape=(16, 8), dtype="float32"),
+                         Const("mp"), Const(4)]))
+    assert out.shape == (8, 8) and out.traced
+    rs = SIGNATURES["paddle_tpu.kernels.collective_matmul"
+                    ".matmul_reduce_scatter"]
+    out = rs(None, _Rec([Arr(shape=(8, 4), dtype="float32",
+                             traced=True),
+                         Arr(shape=(4, 16), dtype="float32"),
+                         Const("mp"), Const(4)]))
+    assert out.shape == (2, 16) and out.traced
 
 
 def test_signature_resolves_through_import_table():

@@ -293,6 +293,49 @@ def test_step_fault_quarantine_fails_inflight_recovers_queued(gpt):
     assert eng.core.trace_counts["prefill"] <= 2 * len(buckets)
 
 
+def test_tp_quarantine_rebuilds_sharded_plane():
+    """TP chaos (ISSUE 9): the quarantine recovery path on a
+    tensor-parallel mesh.  A spent retry budget rebuilds the device
+    plane SHARDED — slabs back on the kv-head axis, pools and radix
+    refcounts at baseline (the total-accounting invariant holds under a
+    mesh), queued work re-serves to token parity with a clean tp=1
+    engine, and the compile pin stays ONE decode per plane."""
+    import paddle_tpu
+    paddle_tpu.seed(11)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    paddle_tpu.seed(11)
+    oracle = GPTForCausalLM(gpt_tiny())
+    oracle.eval()
+    eng, faults = make_engine(model, retries=2, num_slots=2,
+                              tensor_parallel=2)
+    prompts = _prompts(7, (3, 6, 5, 9, 7))
+    faults.enable("step", at=2, times=3)   # first plane decodes, then
+    try:                                   # 3 faults force quarantine
+        rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_complete(400)
+    finally:
+        faults.disable("step")
+    assert eng.metrics_dict()["quarantines"] == 1
+    outs = [eng.result(r) for r in rids]
+    assert sum(o.status == "failed" for o in outs) == 2   # in-flight
+    assert sum(o.status == "finished" for o in outs) == 3  # queued
+    for o, p in zip(outs, prompts):
+        if o.status == "finished":
+            np.testing.assert_array_equal(o.tokens, _want(oracle, p, 4))
+    assert_accounting(eng, rids)
+    assert eng.health.state == "healthy"
+    core = eng.core
+    # the REBUILT plane is still tensor-parallel: slabs sharded on the
+    # kv-head axis over the serving mesh, block slab included
+    assert tuple(core.pool.ks[0].sharding.spec) == \
+        (None, None, "mp", None)
+    assert tuple(core.block_pool.bks[0].sharding.spec) == \
+        (None, None, "mp", None)
+    assert core.trace_counts["decode"] == 2   # ONE per device plane
+    assert eng.decode_path == "tp_fused"
+
+
 def test_persistent_fault_opens_circuit(gpt):
     eng, faults = make_engine(gpt, retries=1, circuit=2, num_slots=2)
     prompts = _prompts(8, (3, 5, 7, 4))
