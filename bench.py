@@ -742,6 +742,21 @@ def _secondary_benches(smoke=False):
         out["truncated"] = "budget"
         return out
 
+    # 6f fleet SLO serving (ISSUE 10): a 2-replica router replaying a
+    # bursty mixed trace — multi-turn chat (shared prefix + TTFT
+    # deadlines), long-prompt RAG, offline batch — under
+    # over-subscription, with and without a mid-run replica fault burst
+    # (quarantine -> failover).  Reports fleet p50/p99 TTFT, per-token
+    # latency and goodput so the fleet tax (routing, failover, SLO
+    # rejections) is tracked per round next to the single-engine rows.
+    try:
+        out["serving_slo"] = _serving_slo_bench(dm, smoke=smoke)
+    except Exception as e:
+        out["serving_slo"] = {"error": repr(e)[-300:]}
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
     # 7 int8 weight-only decode — the same loop with quantized weight
     # storage (decode is weight-HBM-bound; this row measures the payoff)
     try:
@@ -1053,6 +1068,163 @@ def _collective_fusion_compare(tp):
             "speedup": round(s_ms / max(o_ms, 1e-9), 3),
             "max_abs_diff": round(diff, 9),
             "config": f"tp{tp}-b{b}-k{k}-n{n}"}
+
+
+def _serving_slo_bench(model, smoke=False):
+    """Fleet SLO row (ISSUE 10): a 2-replica ``serving.Router`` replays
+    one bursty mixed trace under over-subscription —
+
+      * CHAT: multi-turn requests sharing a system-prompt prefix (the
+        prefix-affinity routing target), short suffixes, per-request
+        TTFT deadlines (SLO rejections count against goodput);
+      * RAG:  long cold prompts, few output tokens;
+      * BATCH: a burst of small offline requests, no deadlines —
+
+    twice on identical warmed fleets: once clean, once with a step-fault
+    burst injected on replica 0 mid-run sized to force a QUARANTINE (the
+    router fails the casualties over to replica 1).  Per pass: fleet
+    p50/p99 TTFT + per-token latency (the shared registry aggregates
+    both replicas), goodput (requests completed / submitted, SLO
+    rejections and failures both count against it), failover and
+    prefix-affinity counters.  The no-fault vs replica-fault delta IS
+    the robustness tax at fleet scope."""
+    from paddle_tpu.obs import MetricsRegistry, Tracer
+    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                    RequestRejected, Router,
+                                    ServingEngine)
+
+    rs = np.random.RandomState(17)
+    vocab = model.cfg.vocab_size
+    if smoke:
+        slots, block_len = 2, 8
+        chat_n, rag_n, batch_n = 6, 3, 6
+        chat_prefix, chat_suffix, chat_new = 24, 4, 4
+        rag_len, rag_new = 40, 4
+        batch_lens, batch_new = [4 + (i % 4) * 2 for i in range(batch_n)], 6
+        fault_at, retries = 4, 2
+        ttft_deadline = 30.0
+    else:
+        slots, block_len = 8, 64
+        chat_n, rag_n, batch_n = 16, 8, 16
+        chat_prefix, chat_suffix, chat_new = 256, 32, 64
+        rag_len, rag_new = 768, 32
+        batch_lens, batch_new = list(rs.randint(16, 129,
+                                                size=batch_n)), 96
+        fault_at, retries = 30, 2
+        ttft_deadline = 30.0
+    prefix = rs.randint(0, vocab, (chat_prefix,))
+    chat = [np.concatenate([prefix, rs.randint(0, vocab, (chat_suffix,))])
+            for _ in range(chat_n)]
+    rag = [rs.randint(0, vocab, (rag_len,)) for _ in range(rag_n)]
+    batch = [rs.randint(0, vocab, (int(L),)) for L in batch_lens]
+
+    def build_fleet(faulted):
+        registry, tracer = MetricsRegistry(), Tracer()
+        ft = FaultToleranceConfig(max_step_retries=retries,
+                                  backoff_base_s=0.0)
+        inj = FaultInjector() if faulted else None
+        engines = [ServingEngine(model, num_slots=slots, min_bucket=8,
+                                 block_len=block_len,
+                                 fault_tolerance=ft,
+                                 faults=inj if i == 0 else None,
+                                 registry=registry, tracer=tracer)
+                   for i in range(2)]
+        return Router(engines, registry=registry, tracer=tracer), inj
+
+    def replay(router):
+        """The bursty trace: chat burst -> steps -> RAG burst -> steps
+        -> offline batch dump -> drain.  Returns (fleet ids, submitted,
+        rejected) — rejected submissions raise and count against
+        goodput."""
+        fids, submitted, rejected = [], 0, 0
+
+        def sub(p, new, **kw):
+            nonlocal submitted, rejected
+            submitted += 1
+            try:
+                fids.append(router.submit(p, max_new_tokens=new, **kw))
+            except RequestRejected:
+                rejected += 1
+        for p in chat:
+            sub(p, chat_new, ttft_deadline_s=ttft_deadline)
+        for _ in range(2):
+            router.step()
+        for p in rag:
+            sub(p, rag_new)
+        for _ in range(2):
+            router.step()
+        for p in batch:
+            sub(p, batch_new)
+        router.run_until_complete(max_steps=50000)
+        return fids, submitted, rejected
+
+    def run(faulted):
+        router, inj = build_fleet(faulted)
+        replay(router)                     # warmup: compile + warm trees
+        for h in router.replicas:
+            h.engine.metrics.reset()
+        rm = router.metrics
+        for inst in (rm.c_routed, rm.c_hit_tokens, rm.c_failovers,
+                     rm.c_failover_exhausted, rm.c_rejected):
+            inst.reset()                   # row = the measured pass only
+        for fid in list(router._requests):
+            router.purge(fid)
+        if inj is not None:
+            inj.enable("step", at=fault_at, times=retries + 1)
+        t0 = time.perf_counter()
+        try:
+            fids, submitted, rejected = replay(router)
+        finally:
+            if inj is not None:
+                inj.disable("step")
+        wall = time.perf_counter() - t0
+        outs = [router.result(f) for f in fids]
+        completed = sum(1 for o in outs if o.status == "finished")
+        failed = sum(1 for o in outs if o.status == "failed")
+        deadline = sum(1 for o in outs
+                       if o.status == "deadline_exceeded")
+        total_tokens = sum(len(o.tokens) for o in outs)
+        snap = router.registry.snapshot()
+        ttft = snap.get("serving.ttft_s", {})
+        tpot = snap.get("serving.tpot_s", {})
+        q = lambda h, k: (round(h[k] * 1e3, 2)
+                          if h.get(k) is not None else None)
+        rm = router.metrics_dict()
+        row = {
+            "submitted": submitted,
+            "completed": completed,
+            "rejected": rejected,
+            "failed": failed,
+            "deadline_exceeded": deadline,
+            # goodput: the client's view — every submission that did
+            # not complete (rejected at the door, failed, expired)
+            # counts against it
+            "goodput_frac": round(completed / max(submitted, 1), 4),
+            "tokens_per_sec": round(total_tokens / wall, 1),
+            "ttft_p50_ms": q(ttft, "p50"),
+            "ttft_p99_ms": q(ttft, "p99"),
+            "tpot_p50_ms": q(tpot, "p50"),
+            "tpot_p99_ms": q(tpot, "p99"),
+            "prefix_hit_tokens": rm["prefix_hit_tokens"],
+            "failovers": rm["failovers"],
+            "wall_s": round(wall, 2),
+        }
+        if inj is not None:
+            row["fault"] = (f"step@{fault_at} x{retries + 1} on "
+                            f"replica 0 (-> quarantine)")
+            row["quarantines"] = sum(
+                h.engine.core.health.quarantine_count
+                for h in router.replicas)
+        return row
+
+    out = {
+        "no_fault": run(False),
+        "replica_fault": run(True),
+        "config": (f"replicas2-slots{slots}-chat{chat_n}-rag{rag_n}-"
+                   f"batch{batch_n}-prefix{chat_prefix}-"
+                   f"block{block_len}"),
+    }
+    return out
 
 
 def _serving_degraded_bench(model, smoke=False):

@@ -17,11 +17,13 @@ from .api import Request, RequestOutput, SamplingParams, ServingEngine
 from .engine import EngineCore, finite_or_sentinel, sample_rows
 from .errors import EngineStalledError, RequestRejected
 from .faults import FaultError, FaultInjector
+from .fleet import fleet_accounting, replica_accounting
 from .health import (DegradationLadder, EngineHealth,
                      FaultToleranceConfig)
 from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
 from .prefix_cache import MatchResult, PrefixCache
+from .router import ReplicaHandle, Router
 from .scheduler import Scheduler, bucket_length
 
 __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
@@ -31,4 +33,7 @@ __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            # fault-tolerance surface (docs/serving.md "Fault tolerance")
            "FaultToleranceConfig", "EngineHealth", "DegradationLadder",
            "FaultInjector", "FaultError", "RequestRejected",
-           "EngineStalledError"]
+           "EngineStalledError",
+           # fleet tier (docs/serving.md "Fleet tier")
+           "Router", "ReplicaHandle", "fleet_accounting",
+           "replica_accounting"]

@@ -274,6 +274,13 @@ class ServingEngine:
         self.run_until_complete(max_steps)
         return [self.purge(i) for i in ids]
 
+    def prefix_probe(self, prompt) -> int:
+        """Longest radix-cached prefix of ``prompt`` in tokens, WITHOUT
+        admitting or pinning anything — the cheap affinity signal the
+        fleet :class:`~paddle_tpu.serving.router.Router` routes on (0
+        when the cache is off, bypassed, or cold)."""
+        return self.core.prefix_probe(prompt)
+
     # ----------------------------------------------------------- metrics
     @property
     def metrics(self) -> ServingMetrics:

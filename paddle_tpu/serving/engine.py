@@ -386,6 +386,18 @@ class EngineCore:
         bypassed it."""
         return self.prefix_cache is not None and not self.prefix_bypass
 
+    def prefix_probe(self, prompt) -> int:
+        """Longest radix-cached prefix of ``prompt`` in TOKENS, without
+        admitting, pinning, or touching the device — a pure host walk of
+        the radix tree (``PrefixCache.match_length``).  This is the
+        replica-affinity signal the fleet router routes on: the replica
+        whose cache already holds the longest prefix serves the request
+        with the least recompute.  0 when the cache is off, bypassed by
+        the degradation ladder, or simply cold."""
+        if not self._cache_active:
+            return 0
+        return self.prefix_cache.match_length(prompt)
+
     def _contained_cache_fault(self, match: Optional[MatchResult],
                                exc: Exception) -> None:
         """A prefix-cache operation raised under the watchdog: unpin

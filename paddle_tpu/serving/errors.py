@@ -24,7 +24,12 @@ class RequestRejected(RuntimeError):
     ``max_queue``), ``"slo_unattainable"`` (projected TTFT already
     exceeds the request's ``ttft_deadline_s`` at submit time), or
     ``"circuit_open"`` (the engine's recovery circuit breaker tripped).
-    ``retry_after_s`` is the live-metrics-derived hint (None when the
+    The fleet router (serving/router.py) adds two fleet-scoped reasons:
+    ``"fleet_queue_full"`` (the router-level bounded queue across all
+    replicas) and ``"no_healthy_replica"`` (every replica excluded by
+    health state or drain).
+    ``retry_after_s`` is the live-metrics-derived hint, always finite
+    and clamped (``serving.metrics.MAX_RETRY_AFTER_S``; None when the
     engine has no throughput history yet, or will never recover —
     circuit_open).  ``output`` is the terminal
     :class:`~paddle_tpu.serving.api.RequestOutput` view with

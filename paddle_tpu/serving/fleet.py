@@ -1,0 +1,124 @@
+"""Fleet-level total accounting: the chaos invariant as a library.
+
+PR 8's chaos suite pinned the single-engine invariant — after any
+injected fault sequence, every submitted request is terminal with a
+reason and the pools return to baseline.  The fleet tier extends it
+across N replicas behind a :class:`~paddle_tpu.serving.router.Router`:
+
+  (a) every FLEET request reaches a terminal status with a reason —
+      failover may move a request between replicas, but it can never
+      lose one;
+  (b) every replica's ``KVPool`` free count, ``BlockPool`` block
+      accounting and radix-tree refcounts sit at baseline once the
+      fleet drains — a fault on one replica never leaks capacity on
+      any;
+  (c) no request is served twice: a failed-over request's total
+      submissions never exceed two (original + one resubmission), and
+      the router's delivered high-water mark keeps the client stream
+      exactly-once.
+
+These helpers compute the verdict as plain dicts so the chaos tests
+(``tests/test_zz_fleet_serving.py``), the CI smoke
+(``scripts/fleet_chaos_smoke.py``) and operator tooling all read the
+same accounting.  Pure host code; call after a drain
+(``router.run_until_complete()``) — a mid-flight fleet legitimately
+holds slots and pins.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+__all__ = ["replica_accounting", "fleet_accounting", "TERMINAL_STATUSES"]
+
+TERMINAL_STATUSES = ("finished", "cancelled", "deadline_exceeded",
+                     "rejected", "failed")
+
+
+def replica_accounting(engine) -> Dict[str, object]:
+    """One replica's baseline check (a drained
+    :class:`~paddle_tpu.serving.api.ServingEngine`): free slots back to
+    capacity, block pool conserved, zero radix pins, tree<->pool
+    ownership intact, nothing queued or placed.  ``ok`` is the verdict;
+    the rest is the diagnosis."""
+    core = engine.core
+    out: Dict[str, object] = {
+        "free_slots": core.pool.free_slots,
+        "num_slots": core.num_slots,
+        "queue_depth": core.scheduler.queue_depth,
+        "active": core.scheduler.active,
+        "mid_prefill": len(core._prefills),
+        "health": engine.health.state,
+        "degraded_subsystems": list(engine.degraded_subsystems),
+        "quarantines": core.health.quarantine_count,
+        "decode_traces": core.trace_counts["decode"],
+    }
+    slots_ok = (core.pool.free_slots == core.num_slots
+                and core.scheduler.active == 0
+                and core.scheduler.queue_depth == 0
+                and not core._prefills)
+    blocks_ok = pins_ok = tree_ok = True
+    if core.block_pool is not None:
+        bp = core.block_pool
+        out["free_blocks"] = bp.free_blocks
+        out["used_blocks"] = bp.used_blocks
+        blocks_ok = bp.free_blocks + bp.used_blocks == bp.num_blocks
+    if core.prefix_cache is not None:
+        nodes = 0
+        stack = list(core.prefix_cache.root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.refcount != 0:
+                pins_ok = False
+            nodes += 1
+            stack.extend(n.children.values())
+        out["radix_nodes"] = nodes
+        tree_ok = nodes == core.block_pool.used_blocks
+    out["ok"] = bool(slots_ok and blocks_ok and pins_ok and tree_ok)
+    if not out["ok"]:
+        out["violations"] = [name for name, ok in (
+            ("slots", slots_ok), ("blocks", blocks_ok),
+            ("radix_pins", pins_ok), ("tree_ownership", tree_ok)) if not ok]
+    return out
+
+
+def fleet_accounting(router) -> Dict[str, object]:
+    """The fleet verdict over a drained router: per-request terminal
+    statuses (invariant a), per-replica baselines (invariant b), and
+    the exactly-once bound (invariant c).  ``ok`` rolls all three up —
+    ``scripts/fleet_chaos_smoke.py`` exits nonzero on False."""
+    requests: List[Dict[str, object]] = []
+    all_terminal = True
+    once_ok = True
+    for fid in sorted(router._requests):
+        fr = router._requests[fid]
+        out = router.result(fid)
+        terminal = (out.finished and out.status in TERMINAL_STATUSES
+                    and bool(out.status_reason))
+        all_terminal &= terminal
+        once_ok &= fr.attempts <= 2
+        requests.append({
+            "fleet_id": fid, "replica": fr.replica,
+            "attempts": fr.attempts, "status": out.status,
+            "reason": out.status_reason, "tokens": len(out.tokens),
+            "delivered": fr.delivered,
+            "failed_over": fr.attempts > 1,
+            # the failover audit trail: which replica surrendered the
+            # request and why (empty for never-failed-over requests)
+            "history": [{"replica": r, "reason": why}
+                        for r, _, why in fr.history],
+        })
+    replicas = [replica_accounting(h.engine) for h in router.replicas]
+    ok = bool(all_terminal and once_ok
+              and all(r["ok"] for r in replicas))
+    return {
+        "ok": ok,
+        "all_terminal": bool(all_terminal),
+        "served_at_most_once_retry": bool(once_ok),
+        "pools_at_baseline": all(r["ok"] for r in replicas),
+        "requests": requests,
+        "replicas": replicas,
+        "failovers": router.metrics.c_failovers.value,
+        "failovers_exhausted":
+            router.metrics.c_failover_exhausted.value,
+    }

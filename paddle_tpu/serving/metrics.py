@@ -25,12 +25,23 @@ The metric glossary lives in docs/observability.md.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Dict, Optional, Sequence, Tuple
 
 from ..obs import Histogram, MetricsRegistry, Tracer
 
 __all__ = ["ServingMetrics"]
+
+# admission-projection clamps: a degenerate measurement window (one
+# finish inside a denormal-small busy window, or a finish against an
+# hours-long idle-heavy window) must yield a FINITE, bounded hint — a
+# retry_after_s of inf/nan/1e6 seconds is not a hint, it is a bug
+# surfaced to every rejected client.  Projections cap higher than hints:
+# a projection only needs to stay comparable against deadlines, while a
+# hint is an actual "come back in N seconds" told to a caller.
+MAX_RETRY_AFTER_S = 600.0
+MAX_PROJECTED_TTFT_S = 3600.0
 
 
 class ServingMetrics:
@@ -377,19 +388,31 @@ class ServingMetrics:
     def completion_rate(self) -> Optional[float]:
         """Requests completed per second of engine busy time — the live
         throughput estimate backpressure hints derive from (None until
-        at least one request finished in this window)."""
+        at least one request finished in this window).  Degenerate
+        windows — a finish counted against a denormal-small or infinite
+        busy time, where the division returns inf or 0.0 — also report
+        None: the hint/projection corners below must never divide by a
+        zero rate (a 0.0 rate used to raise ZeroDivisionError out of
+        ``retry_after_hint``, and an inf rate projected a 0.0 TTFT that
+        admitted hopeless requests)."""
         if self._finished_local <= 0 or self._busy_s <= 0:
             return None
-        return self._finished_local / self._busy_s
+        rate = self._finished_local / self._busy_s
+        if not math.isfinite(rate) or rate <= 0.0:
+            return None
+        return rate
 
     def retry_after_hint(self, excess: int = 1) -> Optional[float]:
         """Seconds until ~``excess`` queue positions should free, from
         the live completion rate.  None with no history — callers
-        surface that as "no hint" rather than inventing a number."""
+        surface that as "no hint" rather than inventing a number.
+        Always finite and clamped to :data:`MAX_RETRY_AFTER_S`: a
+        near-zero rate (one finish against an idle-heavy window) must
+        not tell a client to come back in 1e6 seconds."""
         rate = self.completion_rate
         if rate is None:
             return None
-        return max(excess, 1) / rate
+        return min(max(excess, 1) / rate, MAX_RETRY_AFTER_S)
 
     def projected_ttft_s(self, queue_depth: int) -> Optional[float]:
         """SLO-aware admission estimate: time for the current queue to
@@ -397,12 +420,14 @@ class ServingMetrics:
         heuristic, deliberately simple — it only needs to be right
         enough to reject requests that are HOPELESSLY late, not to
         schedule precisely.  None with no history (cold engines admit;
-        rejecting on zero data would deadlock the very first request)."""
+        rejecting on zero data would deadlock the very first request);
+        otherwise finite, clamped to :data:`MAX_PROJECTED_TTFT_S` so
+        deadline comparisons never meet an inf/nan."""
         rate = self.completion_rate
         if rate is None:
             return None
         base = self._h_ttft.quantile(0.50) or 0.0
-        return queue_depth / rate + base
+        return min(queue_depth / rate + base, MAX_PROJECTED_TTFT_S)
 
     def record_step(self, active_slots: int, num_slots: int,
                     queue_depth: int, new_tokens: int,

@@ -1,0 +1,675 @@
+"""Fleet tier: a replica router over N serving engines.
+
+ROADMAP direction 3's millions-of-users shape: one :class:`Router`
+fronts N :class:`~paddle_tpu.serving.api.ServingEngine` replicas (each
+with its own device plane / mesh slice, ideally sharing ONE obs
+registry and tracer so the fleet scrapes as a single surface) and
+routes every ``submit()`` on real signals:
+
+  * **prefix affinity** — ``EngineCore.prefix_probe(prompt)`` reports
+    each replica's longest radix-cached prefix WITHOUT admitting or
+    pinning (a pure host walk); the router picks the replica with the
+    longest hit, tie-broken by load, so shared-prefix traffic
+    (system prompts, multi-turn history) keeps landing where its KV
+    already lives and TTFT stays O(suffix) fleet-wide;
+  * **health** — the PR-8 robustness surface is the routing input:
+    replicas at ``quarantined``/``circuit_open`` are EXCLUDED,
+    ``degraded`` replicas are deprioritized behind healthy ones, and a
+    replica being drained (:meth:`Router.drain`) takes no new work
+    while its in-flight requests finish;
+  * **SLO-aware admission** — the fleet-level bounded queue
+    (``max_queue`` across all replicas) and each engine's own
+    submit-time backpressure (projected TTFT vs deadline, per-replica
+    queue bound) gate admission; when every eligible replica rejects,
+    the router re-raises :class:`RequestRejected` carrying the BEST
+    replica's ``retry_after_s`` (always finite and clamped —
+    serving/metrics.py).
+
+**Failover, exactly once.**  A request that dies with a
+replica-attributed terminal ``failed`` status (a quarantine casualty, a
+poisoned decode row, a prefill fault) is transparently resubmitted ONCE
+to the best healthy replica.  The fleet request id doubles as the
+idempotency key: ``attempts`` caps total submissions at two, and the
+``delivered`` high-water mark dedups the client-visible stream — the
+retry regenerates tokens from position 0 (greedy / seeded-sampling
+determinism makes the regenerated prefix identical), and the router
+forwards only positions the client has not yet seen, so every token
+position reaches the client exactly once.  Failures the CLIENT caused
+(a raising stream callback) are never failed over.  ``cancel()``,
+``result()``, ``stream()`` and ``purge()`` always resolve through the
+router's authoritative fleet-id -> (replica, engine-id) map, so they
+follow the request across a failover.
+
+The router is pure host-side control plane: it never touches a device
+array and adds zero work to any engine's hot step loop.  Replicas
+should be built with ``fault_tolerance=FaultToleranceConfig(...)`` —
+the watchdog's containment is what turns a replica fault into the
+terminal ``failed`` status the failover scan routes on; without it a
+step exception propagates out of :meth:`Router.step` to the caller.
+
+Fleet accounting (chaos invariant) lives in ``serving/fleet.py``;
+``scripts/fleet_chaos_smoke.py`` drives one injected replica fault
+end-to-end and ``tests/test_zz_fleet_serving.py`` pins the invariant.
+See docs/serving.md "Fleet tier".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .api import RequestOutput, ServingEngine
+from .errors import EngineStalledError, RequestRejected
+from .health import CIRCUIT_OPEN, DEGRADED, QUARANTINED
+from .scheduler import SamplingParams
+
+__all__ = ["Router", "ReplicaHandle"]
+
+# terminal reasons a failover must never retry: the failure is
+# attributed to the CLIENT's sink, not the replica — a resubmission
+# would re-raise into the same callback and burn the retry for nothing
+_CLIENT_FAULT_PREFIX = "stream callback"
+
+
+class ReplicaHandle:
+    """Router-side view of one replica: the engine plus the routing
+    state the router owns about it (drain flag, routed count)."""
+
+    __slots__ = ("index", "engine", "draining", "routed")
+
+    def __init__(self, index: int, engine: ServingEngine):
+        self.index = index
+        self.engine = engine
+        self.draining = False
+        self.routed = 0          # fleet requests ever routed here
+
+    @property
+    def load(self) -> int:
+        """Queued + placed requests — the affinity tie-breaker."""
+        core = self.engine.core
+        return core.scheduler.queue_depth + core.scheduler.active
+
+    def __repr__(self) -> str:
+        return (f"ReplicaHandle({self.index}, "
+                f"health={self.engine.health.state!r}, "
+                f"draining={self.draining}, load={self.load})")
+
+
+class _FleetRequest:
+    """One client-visible request's routing record.  ``fleet_id`` is
+    the idempotency key: ``attempts`` caps submissions at two (original
+    + one failover) and ``delivered`` is the exactly-once high-water
+    mark for the client stream."""
+
+    __slots__ = ("fleet_id", "prompt", "max_new_tokens", "sampling",
+                 "eos_token_id", "client_stream", "deadline_s",
+                 "ttft_deadline_s", "submit_time", "replica",
+                 "engine_rid", "attempts", "delivered", "history")
+
+    def __init__(self, fleet_id: int, prompt: np.ndarray,
+                 max_new_tokens: int, sampling, eos_token_id,
+                 client_stream, deadline_s, ttft_deadline_s):
+        self.fleet_id = fleet_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.eos_token_id = eos_token_id
+        self.client_stream = client_stream
+        self.deadline_s = deadline_s
+        self.ttft_deadline_s = ttft_deadline_s
+        self.submit_time = 0.0        # perf_counter at FIRST submission
+        self.replica = -1             # current owner (authoritative)
+        self.engine_rid = -1
+        self.attempts = 0
+        self.delivered = 0            # client-visible token positions
+        # (replica, engine_rid, status_reason) per surrendered attempt
+        self.history: List[Tuple[int, int, str]] = []
+
+
+class _RouterMetrics:
+    """The router's obs instruments, bound get-or-create into the
+    (usually shared) registry — glossary rows in docs/observability.md."""
+
+    def __init__(self, registry, tracer):
+        self.registry = registry
+        self.tracer = tracer
+        self.lane = tracer.claim_lane_block()
+        tracer.set_lane_name(self.lane, "serving.router", pin=True)
+        g, c = registry.gauge, registry.counter
+        self.g_replicas = g("router.replicas",
+                            "replicas fronted by this router")
+        self.g_healthy = g("router.healthy_replicas",
+                           "replicas currently routable (healthy or "
+                           "degraded, not draining)")
+        self.g_draining = g("router.draining_replicas",
+                            "replicas draining (no new admissions)")
+        self.g_queue = g("router.queue_depth",
+                         "fleet-wide waiting requests at the last step")
+        self.c_routed = c("router.requests_routed",
+                          "fleet submissions accepted and routed")
+        self.c_hit_tokens = c("router.prefix_hit_tokens",
+                              "prompt tokens the routed replica's radix "
+                              "cache already held at routing time")
+        self.c_failovers = c("router.failovers",
+                             "requests resubmitted to a healthy replica "
+                             "after a replica-attributed failure")
+        self.c_failover_exhausted = c(
+            "router.failovers_exhausted",
+            "replica-attributed failures that could NOT fail over "
+            "(retry spent, deadline blown, or no replica accepted)")
+        self.c_rejected = c("router.requests_rejected",
+                            "fleet submissions refused (no healthy "
+                            "replica / fleet queue / every replica "
+                            "rejected)")
+
+    def on_route(self, fleet_id: int, replica: int, hit_tokens: int) -> None:
+        self.c_routed.inc()
+        if hit_tokens > 0:
+            self.c_hit_tokens.inc(hit_tokens)
+
+    def on_failover(self, fleet_id: int, src: int, dst: int,
+                    reason: str) -> None:
+        self.c_failovers.inc()
+        self.tracer.event("failover", lane=self.lane, fleet_id=fleet_id,
+                          from_replica=src, to_replica=dst,
+                          reason=str(reason)[:200])
+
+    def on_failover_exhausted(self, fleet_id: int, replica: int,
+                              why: str) -> None:
+        self.c_failover_exhausted.inc()
+        self.tracer.event("failover_exhausted", lane=self.lane,
+                          fleet_id=fleet_id, replica=replica,
+                          reason=str(why)[:200])
+
+    def on_reject(self, reason: str) -> None:
+        self.c_rejected.inc()
+        self.tracer.event("router_reject", lane=self.lane, reason=reason)
+
+    def on_drain(self, replica: int, phase: str) -> None:
+        self.tracer.event(phase, lane=self.lane, replica=replica)
+
+    def publish(self, handles: Sequence[ReplicaHandle]) -> None:
+        self.g_replicas.set(len(handles))
+        healthy = sum(1 for h in handles if not h.draining
+                      and h.engine.health.state
+                      not in (QUARANTINED, CIRCUIT_OPEN))
+        self.g_healthy.set(healthy)
+        self.g_draining.set(sum(1 for h in handles if h.draining))
+        self.g_queue.set(sum(h.engine.core.scheduler.queue_depth
+                             for h in handles))
+
+
+class Router:
+    """Prefix-affinity, health-aware request router over N serving
+    replicas — the fleet tier (docs/serving.md "Fleet tier").
+
+    ``replicas`` are pre-built :class:`ServingEngine` instances (build
+    them onto ONE shared registry/tracer for a single scrape surface —
+    :meth:`Router.build` does exactly that).  The router owns the
+    fleet-id namespace: every id handed out by :meth:`submit` resolves
+    through the authoritative request -> replica map, across failovers.
+
+    ``max_queue`` bounds the FLEET queue (sum of replica queue depths);
+    per-replica bounds/SLO checks still apply at each engine.
+    ``failover=False`` disables resubmission (replica failures surface
+    as terminal ``failed``); ``affinity=False`` degrades routing to
+    round-robin over the eligible replicas — the measured baseline the
+    prefix-affinity win is pinned against.
+    """
+
+    def __init__(self, replicas: Sequence[ServingEngine], *,
+                 max_queue: Optional[int] = None,
+                 failover: bool = True,
+                 affinity: bool = True,
+                 registry=None, tracer=None):
+        if not replicas:
+            raise ValueError("Router needs at least one replica engine")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None)")
+        self._handles = [ReplicaHandle(i, eng)
+                         for i, eng in enumerate(replicas)]
+        self.max_queue = max_queue
+        self.failover = failover
+        self.affinity = affinity
+        self.registry = registry if registry is not None \
+            else replicas[0].registry
+        self.tracer = tracer if tracer is not None \
+            else replicas[0].tracer
+        self.metrics = _RouterMetrics(self.registry, self.tracer)
+        self._requests: Dict[int, _FleetRequest] = {}
+        self._live: set = set()       # fleet ids the failover scan owns
+        self._ids = itertools.count()
+        self._rr = 0                  # round-robin cursor (affinity off)
+        self._closed = False
+        self.metrics.publish(self._handles)
+
+    @classmethod
+    def build(cls, model_factory: Callable, replicas: int = 2, *,
+              registry=None, tracer=None, max_queue: Optional[int] = None,
+              failover: bool = True, affinity: bool = True,
+              **engine_kw) -> "Router":
+        """Construct ``replicas`` engines onto ONE shared registry and
+        tracer (fresh ones when not given) and front them with a router.
+        ``model_factory()`` is called once per replica — return the same
+        weights (e.g. re-seed inside the factory) when fleet-wide token
+        parity matters; ``engine_kw`` is forwarded to every
+        :class:`ServingEngine`."""
+        from ..obs import MetricsRegistry, Tracer
+        registry = registry if registry is not None else MetricsRegistry()
+        tracer = tracer if tracer is not None else Tracer()
+        engines = [ServingEngine(model_factory(), registry=registry,
+                                 tracer=tracer, **engine_kw)
+                   for _ in range(replicas)]
+        return cls(engines, max_queue=max_queue, failover=failover,
+                   affinity=affinity, registry=registry, tracer=tracer)
+
+    # ---------------------------------------------------------- topology
+    @property
+    def replicas(self) -> Tuple[ReplicaHandle, ...]:
+        return tuple(self._handles)
+
+    @property
+    def queue_depth(self) -> int:
+        """Fleet-wide waiting requests (the ``max_queue`` bound)."""
+        return sum(h.engine.core.scheduler.queue_depth
+                   for h in self._handles)
+
+    @property
+    def in_flight(self) -> int:
+        """Queued + placed requests across the fleet."""
+        return sum(h.load for h in self._handles)
+
+    def _handle(self, replica: int) -> ReplicaHandle:
+        if not 0 <= replica < len(self._handles):
+            raise KeyError(
+                f"unknown replica index {replica} — this router fronts "
+                f"{len(self._handles)} replicas")
+        return self._handles[replica]
+
+    def _eligible(self) -> List[ReplicaHandle]:
+        """Replicas new work may be routed to: not draining, not
+        quarantined, circuit not open (degraded stays eligible — it is
+        deprioritized by the route order, not excluded)."""
+        return [h for h in self._handles
+                if not h.draining
+                and h.engine.health.state not in (QUARANTINED,
+                                                  CIRCUIT_OPEN)]
+
+    def _route_order(self, eligible: List[ReplicaHandle],
+                     prompt: np.ndarray
+                     ) -> List[Tuple[ReplicaHandle, Optional[int]]]:
+        """The replica try-order for one prompt, best first, with each
+        candidate's probed prefix-hit length.  Affinity mode: longest
+        cached prefix wins, healthy beats degraded, load breaks ties.
+        Round-robin mode: rotate the cursor without probing anyone
+        (hit = None; the caller probes only the ACCEPTED replica so
+        ``router.prefix_hit_tokens`` stays comparable between the two
+        policies without N radix walks per submit)."""
+        if not self.affinity:
+            k = self._rr % len(eligible)
+            self._rr += 1
+            rotated = eligible[k:] + eligible[:k]
+            return [(h, None) for h in rotated]
+        probes = [(h, h.engine.core.prefix_probe(prompt))
+                  for h in eligible]
+        return sorted(
+            probes,
+            key=lambda p: (p[0].engine.health.state == DEGRADED,
+                           -p[1], p[0].load, p[0].index))
+
+    # -------------------------------------------------------- submission
+    def submit(self, prompt, max_new_tokens: int = 16,
+               sampling: Optional[SamplingParams] = None,
+               eos_token_id: Optional[int] = None,
+               stream: Optional[Callable] = None,
+               deadline_s: Optional[float] = None,
+               ttft_deadline_s: Optional[float] = None) -> int:
+        """Route one request; returns its FLEET id (valid with
+        :meth:`result`/:meth:`cancel`/:meth:`stream`/:meth:`purge` on
+        this router — engine-local ids never leak to clients).
+
+        Raises :class:`RequestRejected` when no replica can take the
+        request: ``no_healthy_replica`` (every replica excluded by
+        health or drain), ``fleet_queue_full`` (the fleet-wide
+        ``max_queue`` bound), or the best replica's own rejection
+        (``queue_full`` / ``slo_unattainable`` / ``circuit_open``) when
+        every eligible replica refused — always carrying the best
+        available ``retry_after_s`` hint.  Validation ``ValueError``\\ s
+        (empty prompt, prompt+new > max_seq, bad sampling) propagate
+        from the first replica tried, before any state is recorded."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        fleet_id = next(self._ids)
+        eligible = self._eligible()
+        if not eligible:
+            # hint only from replicas that can plausibly recover — a
+            # circuit-open replica never will (engine.check_admission
+            # hints None for the same reason), so an all-circuit-open
+            # fleet correctly reports "no hint" instead of telling
+            # clients to retry against the dead replicas' stale history
+            self._reject(fleet_id, prompt, "no_healthy_replica",
+                         self._best_hint(
+                             [h for h in self._handles
+                              if h.engine.health.state != CIRCUIT_OPEN]))
+        if self.max_queue is not None \
+                and self.queue_depth >= self.max_queue:
+            self._reject(fleet_id, prompt, "fleet_queue_full",
+                         self._best_hint(eligible))
+        order = self._route_order(eligible, prompt)
+        fr = _FleetRequest(fleet_id, prompt, max_new_tokens, sampling,
+                           eos_token_id, stream, deadline_s,
+                           ttft_deadline_s)
+        fr.submit_time = time.perf_counter()
+        rejections: List[RequestRejected] = []
+        for h, hit in order:
+            try:
+                rid = self._submit_to(h, fr)
+            except RequestRejected as e:
+                rejections.append(e)
+                continue
+            fr.replica, fr.engine_rid = h.index, rid
+            fr.attempts = 1
+            h.routed += 1
+            self._requests[fleet_id] = fr
+            self._live.add(fleet_id)
+            if hit is None:         # round-robin: probe the winner only
+                hit = h.engine.core.prefix_probe(prompt)
+            self.metrics.on_route(fleet_id, h.index, hit)
+            return fleet_id
+        # every eligible replica rejected: surface the BEST replica's
+        # reason with the best (smallest, still-finite) retry hint
+        hints = [e.retry_after_s for e in rejections
+                 if e.retry_after_s is not None]
+        self._reject(fleet_id, prompt, rejections[0].reason,
+                     min(hints) if hints else None)
+
+    def _reject(self, fleet_id: int, prompt: np.ndarray, reason: str,
+                retry_after_s: Optional[float]):
+        self.metrics.on_reject(reason)
+        out = RequestOutput(
+            request_id=fleet_id, prompt=prompt, tokens=[], finished=True,
+            finish_reason=None, ttft_s=None, status="rejected",
+            status_reason=reason)
+        raise RequestRejected(reason, retry_after_s, output=out)
+
+    def _best_hint(self, handles: Sequence[ReplicaHandle]
+                   ) -> Optional[float]:
+        hints = [h.engine.metrics.retry_after_hint() for h in handles]
+        hints = [x for x in hints if x is not None]
+        return min(hints) if hints else None
+
+    def _submit_to(self, h: ReplicaHandle, fr: _FleetRequest,
+                   now: Optional[float] = None) -> int:
+        """Submit (or RE-submit, on failover) one fleet request to a
+        replica, with the deadline budgets shrunk by the time already
+        spent — a failover must not silently grant a fresh deadline.  A
+        request whose first token was already delivered carries no TTFT
+        deadline into the retry (the client's TTFT was met)."""
+        if now is None:
+            now = time.perf_counter()
+        elapsed = max(now - fr.submit_time, 0.0)
+        deadline = fr.deadline_s
+        if deadline is not None:
+            deadline = max(deadline - elapsed, 0.0)
+        ttft = fr.ttft_deadline_s
+        if ttft is not None:
+            ttft = None if fr.delivered > 0 \
+                else max(ttft - elapsed, 0.0)
+        return h.engine.submit(
+            fr.prompt, max_new_tokens=fr.max_new_tokens,
+            sampling=fr.sampling, eos_token_id=fr.eos_token_id,
+            stream=self._fleet_stream(fr),
+            deadline_s=deadline, ttft_deadline_s=ttft)
+
+    def _fleet_stream(self, fr: _FleetRequest) -> Callable:
+        """The exactly-once dedup wrapper: every replica attempt streams
+        through it; positions below the delivered high-water mark (a
+        failover retry regenerating the prefix it already served) are
+        swallowed, so the client sees each token position once."""
+        def cb(req, tok):
+            pos = len(req.tokens) - 1   # _emit appends before calling
+            if pos < fr.delivered:
+                return
+            fr.delivered = pos + 1
+            if fr.client_stream is not None:
+                fr.client_stream(req, tok)
+        return cb
+
+    # --------------------------------------------------------- execution
+    def step(self) -> int:
+        """One fleet iteration: step every replica, then run the
+        failover scan over live requests and refresh the fleet gauges.
+        Returns the number of requests still in flight fleet-wide."""
+        for h in self._handles:
+            h.engine.step()
+        self._scan_failover()
+        self.metrics.publish(self._handles)
+        return self.in_flight
+
+    def has_work(self) -> bool:
+        return any(h.engine.core.scheduler.has_work()
+                   for h in self._handles)
+
+    def _progress(self) -> int:
+        return (sum(h.engine.core.progress_counter
+                    for h in self._handles)
+                + self.metrics.c_failovers.value
+                + self.metrics.c_failover_exhausted.value)
+
+    def run_until_complete(self, max_steps: Optional[int] = None,
+                           stall_steps: Optional[int] = 64) -> int:
+        """Step until every replica drains; returns steps taken.  The
+        stall detector watches FLEET progress (token emits, admissions,
+        dispositions, failovers) so a wedged replica raises
+        :class:`EngineStalledError` with a per-replica snapshot instead
+        of spinning."""
+        steps = stalled = 0
+        last = self._progress()
+        while self.has_work():
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain within {max_steps} steps")
+            self.step()
+            steps += 1
+            p = self._progress()
+            if p != last:
+                last, stalled = p, 0
+            else:
+                stalled += 1
+                if stall_steps is not None and stalled >= stall_steps \
+                        and self.has_work():
+                    raise EngineStalledError(stalled,
+                                             self.fleet_snapshot())
+        return steps
+
+    def stream(self, fleet_id: int) -> Iterator[int]:
+        """Yield the request's tokens as they are generated, stepping
+        the FLEET while waiting — so health scans and failovers keep
+        running; the iterator transparently follows the request onto a
+        failover target (the dedup wrapper guarantees each yielded
+        position was generated for this client exactly once)."""
+        fr = self._record(fleet_id)
+        seen = 0
+        while True:
+            req = self._handles[fr.replica].engine._requests.get(
+                fr.engine_rid)
+            toks = req.tokens if req is not None else []
+            while seen < len(toks):
+                yield toks[seen]
+                seen += 1
+            if fleet_id not in self._live:
+                return
+            self.step()
+
+    # ---------------------------------------------------------- failover
+    def _scan_failover(self) -> None:
+        """Settle finished fleet requests; resubmit replica-attributed
+        failures ONCE to the best healthy replica.  Runs after every
+        fleet step, off any engine's hot path."""
+        if not self._live:
+            return
+        for fid in list(self._live):
+            fr = self._requests[fid]
+            # the engine-internal record is authoritative and cheap;
+            # result() would build a RequestOutput copy per scan
+            req = self._handles[fr.replica].engine._requests.get(
+                fr.engine_rid)
+            if req is None or not req.finished:
+                continue
+            if (self.failover and req.status == "failed"
+                    and fr.attempts < 2
+                    and not str(req.status_reason or "").startswith(
+                        _CLIENT_FAULT_PREFIX)):
+                if self._try_failover(fr, req):
+                    continue        # re-owned: stays live on the target
+            self._live.discard(fid)
+
+    def _try_failover(self, fr: _FleetRequest, failed_req) -> bool:
+        """Resubmit one failed fleet request.  Returns True when a
+        healthy replica accepted it (the router map now points there);
+        False leaves the terminal ``failed`` standing."""
+        now = time.perf_counter()
+        if fr.deadline_s is not None \
+                and now - fr.submit_time >= fr.deadline_s:
+            self.metrics.on_failover_exhausted(
+                fr.fleet_id, fr.replica, "deadline already spent")
+            return False
+        # prefer a DIFFERENT replica; fall back to the (recovered)
+        # origin only when it is the sole eligible one
+        eligible = self._eligible()
+        targets = [h for h in eligible if h.index != fr.replica] \
+            or eligible
+        if not targets:
+            self.metrics.on_failover_exhausted(
+                fr.fleet_id, fr.replica, "no healthy replica")
+            return False
+        src, src_rid = fr.replica, fr.engine_rid
+        reason = failed_req.status_reason or "failed"
+        for h, hit in self._route_order(targets, fr.prompt):
+            try:
+                rid = self._submit_to(h, fr, now=now)
+            except RequestRejected:
+                continue
+            # drop the surrendered attempt's record from the old engine
+            # (terminal — purge only releases the host-side reference)
+            fr.history.append((src, src_rid, reason))
+            self._handles[src].engine.purge(src_rid)
+            fr.replica, fr.engine_rid = h.index, rid
+            fr.attempts += 1
+            h.routed += 1
+            self.metrics.on_failover(fr.fleet_id, src, h.index, reason)
+            return True
+        self.metrics.on_failover_exhausted(
+            fr.fleet_id, fr.replica, "every healthy replica rejected")
+        return False
+
+    # ------------------------------------------------------------ drains
+    def drain(self, replica: int) -> None:
+        """Stop routing NEW work to ``replica`` (index) while its
+        in-flight requests finish normally — the graceful half of
+        taking a replica out of rotation.  Balance with
+        :meth:`undrain` (a registered graftlint ``ResourcePair``): a
+        drain leaked on an exception path silently shrinks the fleet."""
+        h = self._handle(replica)
+        h.draining = True
+        self.metrics.on_drain(replica, "drain")
+        self.metrics.publish(self._handles)
+
+    def undrain(self, replica: int) -> None:
+        """Return a drained replica to the routing rotation
+        (idempotent)."""
+        h = self._handle(replica)
+        h.draining = False
+        self.metrics.on_drain(replica, "undrain")
+        self.metrics.publish(self._handles)
+
+    def drained(self, replica: int) -> bool:
+        """True once a draining replica has no queued or in-flight
+        work left — safe to rebuild/retire."""
+        h = self._handle(replica)
+        return h.draining and not h.engine.core.scheduler.has_work()
+
+    # ----------------------------------------------------------- results
+    def _record(self, fleet_id: int) -> _FleetRequest:
+        fr = self._requests.get(fleet_id)
+        if fr is None:
+            raise KeyError(
+                f"unknown fleet request_id {fleet_id} — never submitted "
+                f"to this router, or already purged")
+        return fr
+
+    def result(self, fleet_id: int) -> RequestOutput:
+        """The request's current view FROM ITS OWNING REPLICA (the map
+        is authoritative across failovers), re-keyed to the fleet id."""
+        fr = self._record(fleet_id)
+        out = self._handles[fr.replica].engine.result(fr.engine_rid)
+        return dataclasses.replace(out, request_id=fleet_id)
+
+    def cancel(self, fleet_id: int) -> RequestOutput:
+        """Cancel against the CURRENTLY-owning replica — after a
+        failover the map already points at the new owner, so a cancel
+        can never land on the stale replica's dead record.  Unknown or
+        purged ids raise the same descriptive ``KeyError`` the engines
+        use; cancelling an already-terminal request is idempotent."""
+        fr = self._record(fleet_id)
+        out = self._handles[fr.replica].engine.cancel(fr.engine_rid)
+        self._live.discard(fleet_id)   # settled: never fail over
+        return dataclasses.replace(out, request_id=fleet_id)
+
+    def purge(self, fleet_id: int) -> RequestOutput:
+        """``result()`` + drop every reference (router map AND the
+        owning engine's record).  Long-running fleets must consume
+        results this way, exactly like single engines."""
+        fr = self._record(fleet_id)
+        out = self._handles[fr.replica].engine.purge(fr.engine_rid)
+        self._live.discard(fleet_id)
+        del self._requests[fleet_id]
+        return dataclasses.replace(out, request_id=fleet_id)
+
+    # --------------------------------------------------------- lifecycle
+    def fleet_snapshot(self) -> Dict[str, object]:
+        """Per-replica diagnostic state (attached to the stall
+        detector's :class:`EngineStalledError`)."""
+        return {
+            "replicas": [
+                {"index": h.index, "draining": h.draining,
+                 "routed": h.routed,
+                 **h.engine.core.stall_snapshot()}
+                for h in self._handles],
+            "live_requests": len(self._live),
+            "failovers": self.metrics.c_failovers.value,
+        }
+
+    def metrics_dict(self) -> Dict[str, object]:
+        """Fleet-level counters + each replica's own
+        ``metrics_dict()``."""
+        return {
+            "replicas": len(self._handles),
+            "requests_routed": self.metrics.c_routed.value,
+            "prefix_hit_tokens": self.metrics.c_hit_tokens.value,
+            "failovers": self.metrics.c_failovers.value,
+            "failovers_exhausted":
+                self.metrics.c_failover_exhausted.value,
+            "requests_rejected": self.metrics.c_rejected.value,
+            "queue_depth": self.queue_depth,
+            "per_replica": [h.engine.metrics_dict()
+                            for h in self._handles],
+        }
+
+    def accounting(self) -> Dict[str, object]:
+        """The fleet total-accounting verdict (serving/fleet.py) over
+        every request this router still tracks — call after a drain."""
+        from . import fleet as _fleet
+        return _fleet.fleet_accounting(self)
+
+    def close(self) -> None:
+        """Close every replica (idempotent, like
+        :meth:`ServingEngine.close`)."""
+        if self._closed:
+            return
+        self._closed = True
+        for h in self._handles:
+            h.engine.close()

@@ -78,6 +78,12 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
     # resolve first-match by receiver hint)
     ResourcePair("enable", "disable", "fault injection",
                  receiver_hint=("fault",)),
+    # serving/router.py Router: a drained replica takes no new work —
+    # a drain leaked on an exception edge silently shrinks the fleet
+    # until an operator notices, so every drain must undrain on all
+    # paths (rebuild success OR failure)
+    ResourcePair("drain", "undrain", "replica drain",
+                 receiver_hint=("router",)),
     # serving/health.py EngineHealth: a quarantine window opened by the
     # watchdog must close on every path (rebuild success OR failure), or
     # the engine reports quarantined forever

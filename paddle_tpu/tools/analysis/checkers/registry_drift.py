@@ -157,6 +157,12 @@ ALLOWLIST: Dict[str, str] = {
         "build_serving_mesh", "serving_param_specs",
         "shard_model_params", "sharded_zeros", "replicated",
         "tp_decode_supported", "build_tp_decode_program",
+        # fleet tier (ISSUE 10): the replica router and the fleet
+        # accounting verdict — request routing / failover control
+        # plane, not array ops; contract =
+        # tests/test_zz_fleet_serving.py
+        "Router", "ReplicaHandle", "fleet_accounting",
+        "replica_accounting",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

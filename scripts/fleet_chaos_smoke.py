@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""fleet_chaos_smoke — run a 2-replica fleet with ONE injected replica
+fault end-to-end and emit the fleet-accounting evidence as artifacts
+(the fleet-tier sibling of ``scripts/chaos_smoke.py``):
+
+  * two fault-tolerant ``ServingEngine`` replicas share one obs
+    registry/tracer behind a ``serving.Router``; a fault burst sized to
+    force a QUARANTINE is injected on replica 0 mid-run
+    (``--site``/``--at``/``--times``), the watchdog rebuilds that
+    replica's device plane, and the router transparently fails the
+    quarantine casualties over to replica 1;
+  * ``fleet.json``   — the fleet-accounting verdict
+    (``serving.fleet.fleet_accounting``): every fleet request terminal
+    with a reason, per-replica pool/radix baselines, the exactly-once
+    bound, failover counts, per-replica health;
+  * ``metrics.prom`` — Prometheus text of the SHARED registry, so the
+    ``router_*`` metrics documented in docs/observability.md can be
+    eyeballed in their scraped form next to both replicas' serving
+    counters.
+
+Usage:
+    python scripts/fleet_chaos_smoke.py --out /tmp/fleet [--site step]
+        [--at 2] [--times 3] [--requests 6] [--slots 2]
+
+The script FAILS (exit 1) if the verdict is not ok or the fault never
+fired — tests/test_zz_fleet_serving.py runs it as a tier-1 artifact
+smoke, so the fleet recovery path cannot rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def build_workload(n_requests: int, vocab: int, seed: int = 0):
+    """Mixed lengths plus one shared-prefix pair, same shape as
+    chaos_smoke — the radix cache (and therefore prefix-affinity
+    routing) participates in the path being smoked."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    lens = [3 + (i * 5) % 12 for i in range(n_requests)]
+    prompts = [rs.randint(0, vocab, (L,)) for L in lens]
+    if n_requests >= 2:
+        prompts[-1] = np.concatenate(
+            [prompts[0], rs.randint(0, vocab, (2,))])
+    return prompts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_chaos_smoke",
+                                 description=__doc__)
+    ap.add_argument("--out", default="fleet_artifacts",
+                    help="output directory (created if missing)")
+    ap.add_argument("--site", default="step",
+                    help="fault injection point (serving/faults.py), "
+                         "armed on replica 0 only")
+    ap.add_argument("--at", type=int, default=2,
+                    help="site hit index the fault first fires on")
+    ap.add_argument("--times", type=int, default=3,
+                    help="consecutive hits that fire (default spends "
+                         "the retry budget -> quarantine -> failover)")
+    ap.add_argument("--seconds", type=float, default=0.01,
+                    help="stall length for --site slow_step")
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-new-tokens", type=int, default=6)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.obs import MetricsRegistry, Tracer
+    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                    Router, ServingEngine)
+    from paddle_tpu.serving.faults import POINTS
+
+    if args.site not in POINTS:
+        ap.error(f"--site must be one of {POINTS}")
+
+    def model():
+        # identical weights per replica: failover parity is the point
+        paddle_tpu.seed(7)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        return m
+
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+    faults = FaultInjector()           # armed on replica 0 only
+    replicas = [
+        ServingEngine(model(), num_slots=args.slots, min_bucket=8,
+                      fault_tolerance=ft, faults=faults,
+                      registry=registry, tracer=tracer),
+        ServingEngine(model(), num_slots=args.slots, min_bucket=8,
+                      fault_tolerance=ft,
+                      registry=registry, tracer=tracer),
+    ]
+    router = Router(replicas, registry=registry, tracer=tracer)
+    prompts = build_workload(args.requests,
+                             replicas[0].core.model.cfg.vocab_size)
+
+    half = max(len(prompts) // 2, 1)
+    fids = [router.submit(p, max_new_tokens=args.max_new_tokens)
+            for p in prompts[:half]]
+    router.step()
+    faults.enable(args.site, at=args.at, times=args.times,
+                  seconds=args.seconds)
+    try:
+        fids += [router.submit(p, max_new_tokens=args.max_new_tokens)
+                 for p in prompts[half:]]
+        router.run_until_complete(max_steps=10000)
+    finally:
+        faults.disable(args.site)
+
+    acc = router.accounting()
+    rm = router.metrics_dict()
+    os.makedirs(args.out, exist_ok=True)
+    prom_path = os.path.join(args.out, "metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(registry.prometheus())
+    verdict = {
+        "site": args.site,
+        "fired": faults.fired[args.site],
+        "ok": acc["ok"],
+        "all_terminal": acc["all_terminal"],
+        "pools_at_baseline": acc["pools_at_baseline"],
+        "served_at_most_once_retry": acc["served_at_most_once_retry"],
+        "failovers": acc["failovers"],
+        "failovers_exhausted": acc["failovers_exhausted"],
+        "prefix_hit_tokens": rm["prefix_hit_tokens"],
+        "requests": acc["requests"],
+        "replicas": [{"health": r["health"],
+                      "quarantines": r["quarantines"],
+                      "decode_traces": r["decode_traces"],
+                      "ok": r["ok"]} for r in acc["replicas"]],
+        "metrics_prom": prom_path,
+    }
+    fleet_path = os.path.join(args.out, "fleet.json")
+    with open(fleet_path, "w") as f:
+        json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    if not (acc["ok"] and faults.fired[args.site] >= 1):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -340,6 +340,7 @@ PADDLE_SERVING = """
 ServingEngine Request RequestOutput SamplingParams
 EngineCore KVPool Scheduler ServingMetrics bucket_length sample_rows
 BlockPool PrefixCache MatchResult
+Router ReplicaHandle fleet_accounting replica_accounting
 """
 
 PADDLE_STATIC_NN = """

@@ -1,0 +1,23 @@
+"""Clean replica drain/undrain idioms — zero findings.
+
+try/finally-protected drain windows, raise-window-free drain/undrain,
+and non-router receivers the hint gate must leave alone.
+"""
+
+
+def protected_drain_window(router, engine, idx):
+    router.drain(idx)
+    try:
+        engine.run_until_complete()
+    finally:
+        router.undrain(idx)      # protected: rotation restored on raise
+
+
+def adjacent_drain_undrain(router, idx):
+    router.drain(idx)
+    router.undrain(idx)          # nothing can raise in between
+
+
+def non_router_receiver_untracked(valve, pump, idx):
+    valve.drain(idx)             # hint gate: not a fleet router
+    pump.cycle()

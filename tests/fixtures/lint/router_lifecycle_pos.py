@@ -1,0 +1,19 @@
+"""Planted resource-lifecycle bugs for the fleet router's
+drain/undrain ResourcePair — exactly 2 findings:
+
+  1. a replica drain leaked on the exception edge (drain -> raising
+     wait loop -> undrain, unprotected);
+  2. a replica drained and never returned to rotation at all.
+"""
+
+
+def drain_leaks_on_raise(router, engine, idx):
+    router.drain(idx)            # BUG 1: leaks if the drain wait raises
+    engine.run_until_complete()
+    router.undrain(idx)
+
+
+def drained_and_forgotten(router, idx):
+    router.drain(idx)            # BUG 2: never undrained, no escape
+    depth = router.queue_depth
+    return depth

@@ -422,6 +422,36 @@ def test_fault_lifecycle_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_router_drain_pair_registered():
+    """ISSUE 10: the fleet router's drain/undrain is a registered
+    ResourcePair (hinted to router receivers), so the lifecycle rule
+    proves a drained replica returns to rotation on exception edges."""
+    from paddle_tpu.tools.analysis.checkers.lifecycle import DEFAULT_PAIRS
+    by_kind = {p.kind: p for p in DEFAULT_PAIRS}
+    pair = by_kind["replica drain"]
+    assert pair.acquire == "drain" and pair.release == "undrain"
+    assert "router" in pair.receiver_hint
+
+
+def test_router_drain_lifecycle_positive():
+    """Exactly 2 planted bugs: a drain leaked across a raising wait
+    loop, and a drain never undrained at all."""
+    res = run_rule("router_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "replica drain" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+
+
+def test_router_drain_lifecycle_negative():
+    """try/finally-protected drains, adjacent drain/undrain, and
+    non-router receivers (hint gate) — silent."""
+    res = run_rule("router_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_resource_pair_registration_api():
     """Custom pairs plug in via the constructor — the documented
     registration API for new alloc/free protocols."""

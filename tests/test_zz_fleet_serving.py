@@ -1,0 +1,547 @@
+"""Fleet tier: router + fleet-scope chaos suite (ISSUE 10).
+
+THE fleet invariant, extending PR 8's single-engine total accounting
+across N replicas behind a ``serving.Router``: after a fault injected
+on one replica mid-run,
+
+  (a) every FLEET request reaches a terminal status with a reason —
+      failover may move a request between replicas, never lose one;
+  (b) every replica's pool free counts and radix refcounts return to
+      baseline — one replica's fault leaks no capacity anywhere;
+  (c) failed-over requests are served EXACTLY ONCE client-side (the
+      delivered high-water mark dedups the retry's regenerated prefix)
+      with greedy token parity vs a healthy single engine;
+  (d) the per-replica compile pin holds across the quarantine rebuild
+      ({chunk} + buckets + ONE decode per device plane).
+
+Plus the router unit surface: prefix-affinity routing, the health
+exclusion matrix, drain semantics, idempotent failover, fleet-level
+backpressure, and the ISSUE 10 satellite regressions (clamped
+retry/projection hints, idempotent close, cancel-after-failover).
+
+zz-prefixed for the same reason as test_zz_chaos_serving /
+test_zz_tp_serving: early-alphabet placement reproducibly re-triggers
+the jaxlib-0.4 CPU dispatch-race segfault around the distributed test
+window (see tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.obs import MetricsRegistry, Tracer
+from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                RequestRejected, Router, ServingEngine,
+                                fleet_accounting, replica_accounting)
+
+TERMINAL = {"finished", "cancelled", "deadline_exceeded", "rejected",
+            "failed"}
+
+
+def make_model():
+    """Identical weights on every call — replicas and the parity oracle
+    must agree token-for-token."""
+    paddle_tpu.seed(13)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return make_model()
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _want(model, prompt, n=5):
+    seq = model.generate(jnp.asarray(prompt)[None], max_new_tokens=n)
+    return np.asarray(seq)[0, len(prompt):]
+
+
+def make_fleet(n=2, retries=2, faulted=(0,), num_slots=2, **kw):
+    """Fleet of ``n`` fault-tolerant replicas (identical weights) on
+    ONE registry/tracer.  Replicas in ``faulted`` get their own
+    armed-capable injector; returns (router, injectors) with
+    injectors[i] = None elsewhere."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=retries,
+                              backoff_base_s=0.0)
+    injectors = [FaultInjector() if i in faulted else None
+                 for i in range(n)]
+    engines = [ServingEngine(make_model(), num_slots=num_slots,
+                             min_bucket=8, fault_tolerance=ft,
+                             faults=injectors[i], registry=registry,
+                             tracer=tracer, **kw)
+               for i in range(n)]
+    return Router(engines, registry=registry, tracer=tracer), injectors
+
+
+# --------------------------------------------------------------- probes
+
+def test_prefix_probe_is_cheap_and_unpinned(oracle):
+    eng = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                        block_len=8)
+    prefix = _prompts(1, (32,))[0]
+    probe_prompt = np.concatenate([prefix, [5]])
+    assert eng.prefix_probe(probe_prompt) == 0        # cold
+    r = eng.submit(np.concatenate([prefix, _prompts(2, (4,))[0]]),
+                   max_new_tokens=2)
+    eng.run_until_complete(200)
+    eng.purge(r)
+    hit = eng.prefix_probe(probe_prompt)
+    assert hit == 32
+    # probing pins NOTHING: every tree node stays refcount 0
+    stack = list(eng.core.prefix_cache.root.children.values())
+    while stack:
+        node = stack.pop()
+        assert node.refcount == 0
+        stack.extend(node.children.values())
+    # cache off -> always 0
+    off = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                        enable_prefix_cache=False)
+    assert off.prefix_probe(probe_prompt) == 0
+
+
+def test_affinity_routes_to_the_warmed_replica():
+    router, _ = make_fleet(n=2, block_len=8)
+    prefix = _prompts(3, (32,))[0]
+    warm = router.submit(np.concatenate([prefix, _prompts(4, (4,))[0]]),
+                         max_new_tokens=2)
+    router.run_until_complete(200)
+    owner = router._requests[warm].replica
+    fids = [router.submit(np.concatenate([prefix, s]), max_new_tokens=2)
+            for s in _prompts(5, (4, 4, 4))]
+    assert all(router._requests[f].replica == owner for f in fids)
+    router.run_until_complete(300)
+    assert router.metrics_dict()["prefix_hit_tokens"] >= 3 * 32
+    assert fleet_accounting(router)["ok"]
+
+
+def test_affinity_beats_round_robin_on_shared_prefix():
+    """Acceptance: on a shared-prefix workload the affinity router's
+    ``router.prefix_hit_tokens`` beats round-robin routing, pinned via
+    the obs registry."""
+    prefix = _prompts(6, (48,))[0]
+    suffixes = _prompts(7, (4,) * 8)
+
+    def run(affinity):
+        router, _ = make_fleet(n=2, block_len=8)
+        router.affinity = affinity
+        for s in suffixes:
+            router.submit(np.concatenate([prefix, s]), max_new_tokens=2)
+            router.step()          # interleave so the tree warms up
+        router.run_until_complete(400)
+        assert fleet_accounting(router)["ok"]
+        snap = router.registry.snapshot()
+        return snap["router.prefix_hit_tokens"]
+
+    aff = run(True)
+    rr = run(False)
+    # round-robin alternates replicas, so at most every other request
+    # lands where the prefix is cached; affinity chases the warm cache
+    assert aff > rr, (aff, rr)
+
+
+# ------------------------------------------------- health / drain / SLO
+
+def test_health_exclusion_matrix():
+    router, _ = make_fleet(n=3)
+    h0, h1, h2 = (router.replicas[i].engine.core.health for i in range(3))
+    p = _prompts(8, (4,))[0]
+    # quarantined replica 0 + degraded replica 1 -> healthy replica 2
+    h0._in_quarantine = True
+    h1.degraded = True
+    f = router.submit(p, max_new_tokens=2)
+    assert router._requests[f].replica == 2
+    # circuit-open replica 2 -> the degraded replica still serves
+    h2._circuit_open = True
+    g = router.submit(p, max_new_tokens=2)
+    assert router._requests[g].replica == 1
+    # every replica excluded -> loud fleet-level rejection
+    h1._in_quarantine = True
+    with pytest.raises(RequestRejected, match="no_healthy_replica") as ei:
+        router.submit(p, max_new_tokens=2)
+    assert ei.value.output.status == "rejected"
+    h0._in_quarantine = h1._in_quarantine = False
+    h2._circuit_open = False
+    router.run_until_complete(200)
+    assert fleet_accounting(router)["ok"]
+
+
+def test_drain_semantics():
+    router, _ = make_fleet(n=2)
+    prompts = _prompts(9, (4, 5, 6, 7))
+    a = router.submit(prompts[0], max_new_tokens=8)
+    router.step()
+    victim = router._requests[a].replica
+    router.drain(victim)
+    try:
+        assert not router.drained(victim)      # in-flight work remains
+        # new work only lands on the other replica
+        fids = [router.submit(p, max_new_tokens=2) for p in prompts[1:]]
+        assert all(router._requests[f].replica != victim for f in fids)
+        router.run_until_complete(300)
+        # in-flight work on the drained replica finished normally
+        assert router.result(a).status == "finished"
+        assert router.drained(victim)
+    finally:
+        router.undrain(victim)
+    # back in rotation: route a shared-nothing request by load
+    b = router.submit(prompts[0], max_new_tokens=2)
+    router.run_until_complete(200)
+    assert router.result(b).status == "finished"
+    with pytest.raises(KeyError, match="unknown replica"):
+        router.drain(99)
+    assert fleet_accounting(router)["ok"]
+    ev = [e for e in router.tracer.events() if e[0] in ("drain", "undrain")]
+    assert len(ev) >= 2
+
+
+def test_fleet_queue_bound_rejects_with_best_hint(oracle):
+    """The fleet-wide ``max_queue`` gates at the router (submission
+    queues until a step admits, so two queued submits fill a bound of
+    2); once throughput history exists the rejection carries a finite,
+    clamped retry hint."""
+    router, _ = make_fleet(n=2)
+    router.max_queue = 2
+    prompts = _prompts(10, (3, 4, 5, 6))
+    fids = [router.submit(p, max_new_tokens=3) for p in prompts[:2]]
+    assert router.queue_depth == 2       # nothing admitted yet: queued
+    with pytest.raises(RequestRejected, match="fleet_queue_full") as ei:
+        router.submit(prompts[2], max_new_tokens=3)
+    assert ei.value.output.status == "rejected"
+    assert ei.value.output.status_reason == "fleet_queue_full"
+    assert ei.value.retry_after_s is None    # no throughput history yet
+    router.run_until_complete(400)
+    # with history on both replicas the hint is finite and clamped
+    from paddle_tpu.serving.metrics import MAX_RETRY_AFTER_S
+    fids += [router.submit(p, max_new_tokens=3) for p in prompts[:2]]
+    with pytest.raises(RequestRejected, match="fleet_queue_full") as ei:
+        router.submit(prompts[3], max_new_tokens=3)
+    assert ei.value.retry_after_s is not None
+    assert 0 < ei.value.retry_after_s <= MAX_RETRY_AFTER_S
+    router.run_until_complete(400)
+    assert fleet_accounting(router)["ok"]
+    assert router.metrics_dict()["requests_rejected"] == 2
+
+
+def test_slo_rejection_propagates_best_replica_reason():
+    router, _ = make_fleet(n=2)
+    prompts = _prompts(11, (4, 6))
+    fids = [router.submit(p, max_new_tokens=4) for p in prompts]
+    router.run_until_complete(300)           # throughput history on both
+    with pytest.raises(RequestRejected, match="slo_unattainable") as ei:
+        router.submit(prompts[0], max_new_tokens=4, ttft_deadline_s=1e-9)
+    assert ei.value.retry_after_s is None or ei.value.retry_after_s > 0
+    # an attainable deadline still routes
+    ok = router.submit(prompts[0], max_new_tokens=4, ttft_deadline_s=60.0)
+    router.run_until_complete(300)
+    assert router.result(ok).status == "finished"
+    assert fleet_accounting(router)["ok"]
+
+
+# ------------------------------------------------------------- failover
+
+def test_failover_exactly_once_with_parity(oracle):
+    """A replica-0 quarantine mid-decode: its in-flight requests fail
+    over to replica 1 ONCE, the client stream sees every token position
+    exactly once, and the delivered tokens match a healthy single
+    engine token-for-token (invariant c)."""
+    router, inj = make_fleet(n=2, retries=1)
+    prompts = _prompts(12, (3, 6, 5, 9))
+    streamed = {}
+
+    def recorder(fid):
+        def cb(req, tok):
+            streamed.setdefault(fid, []).append(
+                (len(req.tokens) - 1, tok))
+        return cb
+
+    fids = []
+    for p in prompts:
+        fid = router.submit(p, max_new_tokens=5)
+        router._requests[fid].client_stream = recorder(fid)
+        fids.append(fid)
+    router.step()                       # first plane decodes
+    inj[0].enable("step", times=2)      # 1 retry + quarantine
+    try:
+        router.run_until_complete(500)
+    finally:
+        inj[0].disable("step")
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["failovers"] >= 1
+    failed_over = [r for r in acc["requests"] if r["failed_over"]]
+    assert failed_over and all(r["attempts"] == 2 for r in failed_over)
+    for fid, p in zip(fids, prompts):
+        out = router.result(fid)
+        assert out.status == "finished", (out.status, out.status_reason)
+        want = _want(oracle, p)
+        np.testing.assert_array_equal(out.tokens, want)
+        # exactly-once: positions strictly sequential from 0, and the
+        # delivered values ARE the oracle tokens (replays suppressed)
+        positions = [pos for pos, _ in streamed[fid]]
+        assert positions == list(range(len(want))), positions
+        np.testing.assert_array_equal([t for _, t in streamed[fid]],
+                                      want)
+
+
+def test_failover_is_idempotent_second_failure_stands(oracle):
+    """One resubmission, never two: a request whose retry ALSO dies
+    ends terminal `failed` with attempts == 2 (the idempotency bound
+    fleet_accounting pins)."""
+    router, inj = make_fleet(n=2, faulted=(0, 1))
+    p = _prompts(13, (4,))[0]
+    fid = router.submit(p, max_new_tokens=6)
+    inj[0].enable("nan_logits")
+    inj[1].enable("nan_logits")
+    try:
+        router.run_until_complete(300)
+    finally:
+        inj[0].disable("nan_logits")
+        inj[1].disable("nan_logits")
+    out = router.result(fid)
+    assert out.status == "failed"
+    assert "non-finite" in out.status_reason
+    fr = router._requests[fid]
+    assert fr.attempts == 2
+    rm = router.metrics_dict()
+    assert rm["failovers"] == 1
+    acc = fleet_accounting(router)
+    assert acc["ok"] and acc["served_at_most_once_retry"]
+
+
+def test_client_stream_fault_is_never_failed_over():
+    """A raising CLIENT callback is client-attributed: terminal
+    `failed`, zero failovers — resubmitting would re-raise into the
+    same broken sink."""
+    router, _ = make_fleet(n=2)
+
+    def bad_stream(req, tok):
+        raise RuntimeError("client sink broke")
+
+    p = _prompts(14, (4,))[0]
+    fid = router.submit(p, max_new_tokens=5, stream=bad_stream)
+    router.run_until_complete(300)
+    out = router.result(fid)
+    assert out.status == "failed"
+    assert "stream callback" in out.status_reason
+    assert router.metrics_dict()["failovers"] == 0
+    assert router._requests[fid].attempts == 1
+    assert fleet_accounting(router)["ok"]
+
+
+def test_cancel_resolves_against_owning_replica_after_failover(oracle):
+    """Satellite: cancel() follows the authoritative map onto the
+    failover target; the surrendered replica no longer holds the
+    record; unknown/purged ids raise the descriptive KeyError."""
+    router, inj = make_fleet(n=2, retries=1)
+    p = _prompts(15, (4,))[0]
+    fid = router.submit(p, max_new_tokens=64)
+    router.step()
+    src = router._requests[fid].replica
+    old_rid = router._requests[fid].engine_rid
+    if src != 0:
+        # aim the injector at whichever replica owns the request (the
+        # step site reads core.faults each step)
+        router.replicas[src].engine.core.faults = inj[0]
+    inj[0].enable("step", times=2)
+    try:
+        for _ in range(40):
+            router.step()
+            if router._requests[fid].replica != src:
+                break
+    finally:
+        inj[0].disable("step")
+    fr = router._requests[fid]
+    assert fr.replica != src and fr.attempts == 2
+    # the stale replica purged the surrendered attempt entirely: a
+    # cancel aimed at it raises the same descriptive KeyError as any
+    # unknown id (the router map is the only authority)
+    assert old_rid not in router.replicas[src].engine._requests
+    with pytest.raises(KeyError, match="already purged"):
+        router.replicas[src].engine.cancel(old_rid)
+    out = router.cancel(fid)
+    assert out.status == "cancelled"
+    assert out.request_id == fid
+    # idempotent re-cancel, loud unknown/purged ids
+    assert router.cancel(fid).status == "cancelled"
+    with pytest.raises(KeyError, match="unknown fleet request_id"):
+        router.cancel(987654)
+    router.purge(fid)
+    with pytest.raises(KeyError, match="already purged"):
+        router.cancel(fid)
+    router.run_until_complete(200)
+    assert all(replica_accounting(h.engine)["ok"]
+               for h in router.replicas)
+
+
+# ------------------------------------------------- THE fleet chaos leg
+
+def test_fleet_chaos_total_accounting(oracle):
+    """Acceptance: fault injected on one of 2 replicas mid-run ->
+    every request terminal with a reason, failovers served exactly once
+    with greedy parity, all replicas' pools/refcounts at baseline, and
+    the per-replica compile pin across the quarantine rebuild."""
+    router, inj = make_fleet(n=2, retries=2, block_len=8)
+    rs = np.random.RandomState(16)
+    prefix = rs.randint(0, 256, (16,))
+    prompts = _prompts(17, (3, 6, 5, 9, 7))
+    prompts += [np.concatenate([prefix, s]) for s in _prompts(18, (4, 4))]
+    streamed = {}
+
+    def recorder(fid):
+        def cb(req, tok):
+            streamed.setdefault(fid, []).append(len(req.tokens) - 1)
+        return cb
+
+    fids = []
+    for p in prompts[:4]:
+        fid = router.submit(p, max_new_tokens=4)
+        router._requests[fid].client_stream = recorder(fid)
+        fids.append(fid)
+    for _ in range(2):
+        router.step()                    # both planes decode + trace
+    inj[0].enable("step", times=3)       # spends retries=2 -> quarantine
+    try:
+        for p in prompts[4:]:
+            fid = router.submit(p, max_new_tokens=4)
+            router._requests[fid].client_stream = recorder(fid)
+            fids.append(fid)
+        router.run_until_complete(800)
+    finally:
+        inj[0].disable("step")
+    assert inj[0].fired["step"] == 3
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    # (a) terminal with reasons — and in this scenario every request
+    # actually completes (failover re-serves the quarantine casualties)
+    for fid, p in zip(fids, prompts):
+        out = router.result(fid)
+        assert out.status == "finished", (out.status, out.status_reason)
+        want = _want(oracle, p, 4)
+        np.testing.assert_array_equal(out.tokens, want)     # (c) parity
+        assert streamed[fid] == list(range(4))        # (c) exactly once
+    # the fault actually exercised failover
+    assert acc["failovers"] >= 1
+    assert any(r["attempts"] == 2 for r in acc["requests"])
+    # (b) baselines, per replica (also inside acc["ok"], asserted
+    # explicitly for the reader)
+    for h in router.replicas:
+        ra = replica_accounting(h.engine)
+        assert ra["ok"], ra
+    # (d) compile pin: ONE decode program per device plane — the
+    # quarantined replica rebuilt exactly once, its peer never did
+    assert router.replicas[0].engine.core.trace_counts["decode"] == 2
+    assert router.replicas[1].engine.core.trace_counts["decode"] == 1
+    assert router.replicas[0].engine.health.quarantine_count == 1
+
+
+def test_fleet_chaos_smoke_artifacts(tmp_path):
+    """Tier-1 artifact smoke (mirrors test_chaos_smoke_artifacts): the
+    2-replica injected-fault scenario end-to-end through
+    scripts/fleet_chaos_smoke.py — a passing fleet.json verdict plus
+    router_* metrics in the shared Prometheus surface."""
+    import importlib.util
+    import json
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_chaos_smoke",
+        os.path.join(repo, "scripts", "fleet_chaos_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "artifacts")
+    assert mod.main(["--out", out, "--requests", "4"]) == 0
+    with open(os.path.join(out, "fleet.json")) as f:
+        v = json.load(f)
+    assert v["ok"] and v["all_terminal"] and v["pools_at_baseline"]
+    assert v["served_at_most_once_retry"]
+    assert v["fired"] >= 1
+    assert {r["status"] for r in v["requests"]} <= TERMINAL
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "router_failovers" in prom
+    assert "router_requests_routed" in prom
+    assert "serving_health_state" in prom
+
+
+# ------------------------------------------- ISSUE 10 satellite corners
+
+def test_retry_and_projection_hints_finite_and_clamped():
+    """Satellite: degenerate measurement windows must never surface an
+    inf/nan/unbounded hint.  A 0.0 completion rate used to raise
+    ZeroDivisionError out of retry_after_hint; an inf rate projected a
+    0.0 TTFT that admitted hopeless requests."""
+    from paddle_tpu.serving.metrics import (MAX_PROJECTED_TTFT_S,
+                                            MAX_RETRY_AFTER_S,
+                                            ServingMetrics)
+    # inf rate (denormal busy window): no estimate, not 0.0 hints
+    m = ServingMetrics()
+    m._finished_local, m._busy_s = 5, 1e-308
+    assert m.completion_rate is None
+    assert m.retry_after_hint() is None
+    assert m.projected_ttft_s(10) is None
+    # 0.0 rate (infinite busy window): no ZeroDivisionError
+    m2 = ServingMetrics()
+    m2._finished_local, m2._busy_s = 1, float("inf")
+    assert m2.completion_rate is None
+    assert m2.retry_after_hint() is None
+    assert m2.projected_ttft_s(3) is None
+    # near-zero rate: hints exist but are clamped finite
+    m3 = ServingMetrics()
+    m3._finished_local, m3._busy_s = 1, 1e6
+    assert m3.completion_rate == pytest.approx(1e-6)
+    assert m3.retry_after_hint() == MAX_RETRY_AFTER_S
+    assert m3.retry_after_hint(10 ** 9) == MAX_RETRY_AFTER_S
+    assert m3.projected_ttft_s(100) == MAX_PROJECTED_TTFT_S
+    # healthy window: hints pass through unclamped
+    m4 = ServingMetrics()
+    m4._finished_local, m4._busy_s = 10, 5.0
+    assert m4.retry_after_hint(2) == pytest.approx(1.0)
+    # cold engine: still None everywhere
+    m5 = ServingMetrics()
+    assert m5.completion_rate is None
+    assert m5.retry_after_hint() is None
+
+
+def test_close_is_idempotent_including_after_quarantine():
+    """Satellite: double-close and close-after-quarantine never raise
+    and never double-detach the profiler chrome-export source."""
+    eng = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                        record_events=True)
+    r = eng.submit(_prompts(19, (3,))[0], max_new_tokens=2)
+    eng.run_until_complete(100)
+    eng.purge(r)
+    tracer = eng.core.metrics.tracer
+    assert tracer._install_count == 1
+    eng.close()
+    eng.close()                               # double close: no raise
+    assert tracer._install_count == 0          # exactly one detach
+    # close after a quarantine rebuild
+    faults = FaultInjector()
+    eng2 = ServingEngine(
+        make_model(), num_slots=2, min_bucket=8, record_events=True,
+        fault_tolerance=FaultToleranceConfig(max_step_retries=1,
+                                             backoff_base_s=0.0),
+        faults=faults)
+    faults.enable("step", times=2)
+    try:
+        eng2.submit(_prompts(20, (4,))[0], max_new_tokens=2)
+        eng2.run_until_complete(200)
+    finally:
+        faults.disable("step")
+    assert eng2.metrics_dict()["quarantines"] == 1
+    eng2.close()
+    eng2.close()
+    assert eng2.core.metrics.tracer._install_count == 0
+    # the fleet surface composes: Router.close closes each replica once
+    router, _ = make_fleet(n=2)
+    router.close()
+    router.close()                             # idempotent at fleet scope
