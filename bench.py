@@ -58,17 +58,19 @@ def decode_bw_util(tps, b, prompt, new, n_params, layers, hidden, bpe,
     return round(bytes_per_step * (tps / b) / hbm_bw, 4)
 
 
-def decode_path_info(model, batch, kv_len):
+def decode_path_info(model, batch, kv_len, tp=1):
     """Which decode implementation a row's numbers came from, as a
     dict: ``path`` names what actually ran (callers override the
     "unfused" default when the fused engine path produced the row), and
     ``fused_available``/``fused_fallback_reason`` report whether the
-    decode-block megakernel (kernels/decode_block.py) WOULD engage at
+    decode-block megakernel (kernels/decode_block.py — at ``tp > 1``
+    the sharded variant, kernels/decode_block_tp.py) WOULD engage at
     this shape — a bench row must never be a bare number that leaves
-    the reader guessing which kernel it measured (ISSUE 7)."""
+    the reader guessing which kernel it measured (ISSUE 7/12)."""
     from paddle_tpu.kernels.decode_block import resolve_fused_decode
     info = {"path": "unfused"}
-    ok, reason = resolve_fused_decode(model, batch=batch, kv_len=kv_len)
+    ok, reason = resolve_fused_decode(model, batch=batch, kv_len=kv_len,
+                                      tp=tp)
     info["fused_available"] = bool(ok)
     if not ok:
         info["fused_fallback_reason"] = reason
@@ -864,7 +866,119 @@ def _decode_block_compare(smoke=False):
                        "signal here; the on-chip perf row is "
                        "BENCH_TPU_EVIDENCE.json kernel_compare "
                        "decode_block_*")
+    # ISSUE 12: fused-vs-composed at tensor-parallel degrees — the
+    # sharded Pallas block (kernels/decode_block_tp.py) against the
+    # composed compute-collective layer (serving/tp.py) on the same
+    # bundle, per layer, over the visible mesh
+    ndev = len(jax.devices())
+    tp_rows = []
+    for tp in (2, 4):
+        if tp > ndev:
+            tp_rows.append({"tp": tp, "skipped": f"{ndev} devices"})
+            continue
+        try:
+            tp_rows.append(_decode_block_tp_compare(tp, smoke=smoke))
+        except Exception as e:
+            tp_rows.append({"tp": tp, "error": repr(e)[-300:]})
+    row["tp_rows"] = tp_rows
     return row
+
+
+def _decode_block_tp_compare(tp, smoke=False):
+    """One GQA + SwiGLU layer at degree ``tp``: the sharded Pallas
+    decode block (entry/exit rings riding the tile dots, in-kernel
+    append on the local slab shard) vs the composed compute-collective
+    layer, SAME ``tp_decode_weights``-style bundle, same shard_map —
+    wall times, speedup, max-abs parity and the tp legality verdict.
+    On CPU the Pallas side runs the interpreter (parity is the
+    signal)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed._jax_compat import shard_map
+    from paddle_tpu.kernels.decode_block import (fusion_legal,
+                                                 plan_decode_block)
+    from paddle_tpu.kernels.decode_block_tp import tp_fused_block_layer
+    from paddle_tpu.serving.tp import _tp_layer, build_serving_mesh
+    on_cpu = jax.default_backend() == "cpu"
+    if smoke or on_cpu:
+        b, s, h, kh, dh, f, iters = 4, 64, 8, 4, 16, 32 * tp, 3
+        dt = jnp.float32
+    else:
+        b, s, h, kh, dh, f, iters = 8, 2048, 8, 4, 128, 4096, 30
+        dt = jnp.bfloat16
+    d = h * dh
+    h_l, kh_l, f_l = h // tp, kh // tp, f // tp
+    rs = np.random.RandomState(12)
+    A = lambda *sh: jnp.asarray(rs.randn(*sh), dt) * 0.05
+    wq, wk, wv = A(d, h * dh), A(d, kh * dh), A(d, kh * dh)
+    wg, w1 = A(d, f), A(d, f)
+    qs, kvs = h_l * dh, kh_l * dh
+    parts, mparts = [], []
+    for dev in range(tp):
+        parts += [wq[:, dev * qs:(dev + 1) * qs],
+                  wk[:, dev * kvs:(dev + 1) * kvs],
+                  wv[:, dev * kvs:(dev + 1) * kvs]]
+        mparts += [wg[:, dev * f_l:(dev + 1) * f_l],
+                   w1[:, dev * f_l:(dev + 1) * f_l]]
+    blk = {"n1w": A(d) + 1, "n1b": None,
+           "wqkv": jnp.concatenate(parts, 1), "bqkv": None,
+           "wo": A(h * dh, d), "bo": None,
+           "n2w": A(d) + 1, "n2b": None,
+           "wup": jnp.concatenate(mparts, 1), "bup": None,
+           "wdown": A(f, d), "bdown": None}
+    arch = {"norm": "rms", "eps": 1e-5, "act": "swiglu",
+            "heads": h, "kv_heads": kh, "head_dim": dh}
+    legal, why = fusion_legal(max_seq=s, hidden=d, heads=h, kv_heads=kh,
+                              head_dim=dh, ffn=f, batch=b, dtype=dt,
+                              gated=True, tp=tp)
+    plan, _ = plan_decode_block(max_seq=s, hidden=d, heads=h,
+                                kv_heads=kh, head_dim=dh, ffn=f,
+                                batch=b, itemsize=jnp.dtype(dt).itemsize,
+                                gated=True, tp=tp)
+    mesh = build_serving_mesh(tp)
+    x = A(b, 1, d)[:, 0]
+    k0, v0 = A(b, s, kh, dh), A(b, s, kh, dh)
+    pos = jnp.asarray(rs.randint(0, s, size=b), jnp.int32)
+    specs = {k: P() for k in blk}
+    specs.update(wqkv=P(None, "mp"), wo=P("mp", None),
+                 wup=P(None, "mp"), wdown=P("mp", None))
+    blk_specs = {k: (None if blk[k] is None else specs[k]) for k in blk}
+    slab = P(None, None, "mp", None)
+
+    def build(fused):
+        def body(x_s, pk, pv, blk_l):
+            if fused:
+                return tp_fused_block_layer(x_s, pk, pv, pos, blk_l,
+                                            arch, None, "mp", tp, plan)
+            return _tp_layer(x_s, pk, pv, pos, blk_l, arch, None,
+                             "mp", tp, True)
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P("mp", None), slab, slab, blk_specs),
+            out_specs=(P("mp", None), slab, slab), check_vma=False))
+
+    def timed(fn):
+        y, k2, v2 = fn(x, k0, v0, blk)              # compile
+        float(jnp.sum(y.astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y, k2, v2 = fn(x, k0, v0, blk)
+        float(jnp.sum(y.astype(jnp.float32)))
+        return (time.perf_counter() - t0) / iters * 1e3, y
+
+    f_ms, fy = timed(build(True))
+    c_ms, cy = timed(build(False))
+    diff = float(jnp.max(jnp.abs(fy.astype(jnp.float32)
+                                 - cy.astype(jnp.float32))))
+    return {"tp": tp, "fused_ms": round(f_ms, 3),
+            "composed_ms": round(c_ms, 3),
+            "speedup": round(c_ms / max(f_ms, 1e-9), 3),
+            "max_abs_diff": round(diff, 6), "ok": diff < 5e-2,
+            "fusion_legal": legal,
+            **({} if legal else {"fusion_fallback_reason": why}),
+            "config": f"tp{tp}-b{b}-kv{s}-h{h}-kvh{kh}-dh{dh}-ffn{f}-"
+                      f"{jnp.dtype(dt).name}"}
 
 
 def _serving_bench(model, smoke=False):
@@ -979,7 +1093,13 @@ def _serving_tp_bench(smoke=False):
         paddle_tpu.seed(0)
         m = GPTForCausalLM(cfg)
         m.eval()
-        eng = ServingEngine(m, num_slots=slots, tensor_parallel=tp)
+        # ISSUE 12: the scaling story is fused-vs-fused — the tp=1
+        # baseline runs the Pallas decode-block pair and the tp>1 rows
+        # the SHARDED block (tp_fused_block), so scaling_efficiency is
+        # per-chip tok/s against the tp=1 FUSED number; decode_path in
+        # every row says what actually ran (legality fallbacks included)
+        eng = ServingEngine(m, num_slots=slots, tensor_parallel=tp,
+                            fused_decode=True)
         workload(eng)               # compile warmup, same program set
         eng.metrics.reset()
         outs = workload(eng)
@@ -1014,10 +1134,11 @@ def _serving_tp_bench(smoke=False):
     }
     if jax.default_backend() == "cpu":
         out["note"] = ("cpu virtual-device mesh: efficiency measures "
-                       "wiring overhead, not ICI scaling — parity and "
-                       "the engaged tp_fused path are the signals; the "
-                       "on-chip rows are BENCH_TPU_EVIDENCE.json "
-                       "serving_tp_*")
+                       "wiring overhead (and the Pallas interpreter on "
+                       "the fused paths), not ICI scaling — parity and "
+                       "the engaged fused/tp_fused_block paths are the "
+                       "signals; the on-chip rows are "
+                       "BENCH_TPU_EVIDENCE.json serving_tp_*")
     return out
 
 

@@ -37,15 +37,53 @@ static axis size — callers pass it so the ring unrolls at trace time
 
 from __future__ import annotations
 
+from typing import List, Tuple
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["allgather_matmul", "matmul_reduce_scatter"]
+__all__ = ["RingSchedule", "ring_schedule", "allgather_matmul",
+           "matmul_reduce_scatter"]
 
 
-def _ring_perm(tp: int):
-    """The forward ring: device d sends to d+1 (mod tp)."""
-    return [(d, (d + 1) % tp) for d in range(tp)]
+class RingSchedule:
+    """The ring decomposition's bookkeeping — perm table plus the
+    per-hop shard/chunk index walk — as ONE shared object, so the XLA
+    rings here and the Pallas decode-block rings
+    (kernels/decode_block_tp.py) lower the SAME schedule and cannot
+    drift.
+
+    Forward ring: device ``d`` sends to ``d + 1 (mod tp)``.  After
+    ``hop`` forward ppermutes a device holds the shard that ORIGINATED
+    ``hop`` positions behind it (``entry_src``), and the travelling
+    exit accumulator a device computes a partial for at ``hop`` is the
+    chunk that finishes at this device after the remaining hops
+    (``exit_chunk`` — the final hop lands on the device's OWN chunk).
+    ``idx`` may be a traced ``axis_index`` or a host int (tests)."""
+
+    def __init__(self, tp: int):
+        if tp < 1:
+            raise ValueError(f"ring needs tp >= 1, got {tp}")
+        self.tp = tp
+        self.perm: List[Tuple[int, int]] = \
+            [(d, (d + 1) % tp) for d in range(tp)]
+
+    def entry_src(self, idx, hop: int):
+        """Origin device of the shard held at ``hop`` (the entry ring's
+        output-row block): walks backwards around the ring."""
+        return (idx - hop) % self.tp
+
+    def exit_chunk(self, idx, hop: int):
+        """Row chunk whose partial the exit ring computes at ``hop``:
+        it finishes at ``idx`` after the remaining ``tp - 1 - hop``
+        forward hops; the final hop is the local chunk itself."""
+        return (idx - hop - 1) % self.tp
+
+
+def ring_schedule(tp: int) -> RingSchedule:
+    """The shared ring schedule for ``tp`` devices (see
+    :class:`RingSchedule`)."""
+    return RingSchedule(tp)
 
 
 def allgather_matmul(x, w, axis_name: str, tp: int, *,
@@ -66,22 +104,23 @@ def allgather_matmul(x, w, axis_name: str, tp: int, *,
     if not overlap:
         xa = jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
         return xa @ w
+    ring = ring_schedule(tp)
     idx = jax.lax.axis_index(axis_name)
     b_local = x.shape[0]
     out = jnp.zeros((b_local * tp, w.shape[-1]),
                     jnp.result_type(x.dtype, w.dtype))
-    perm = _ring_perm(tp)
-    buf, src = x, idx
+    buf = x
     for hop in range(tp):
         # the ppermute for hop+1 and this hop's dot both consume `buf`
         # and neither consumes the other: XLA may run them concurrently
-        nxt = jax.lax.ppermute(buf, axis_name, perm) \
+        nxt = jax.lax.ppermute(buf, axis_name, ring.perm) \
             if hop < tp - 1 else None
         chunk = buf @ w
-        out = jax.lax.dynamic_update_slice(out, chunk, (src * b_local, 0))
-        # after one forward hop, this device holds its PREDECESSOR's
-        # shard: the source index walks backwards around the ring
-        buf, src = nxt, (src - 1) % tp
+        # after `hop` forward hops this device holds the shard that
+        # originated entry_src(idx, hop) positions back around the ring
+        out = jax.lax.dynamic_update_slice(
+            out, chunk, (ring.entry_src(idx, hop) * b_local, 0))
+        buf = nxt
     return out
 
 
@@ -109,18 +148,18 @@ def matmul_reduce_scatter(x, w, axis_name: str, tp: int, *,
         y = x @ w
         return jax.lax.psum_scatter(y, axis_name, scatter_dimension=0,
                                     tiled=True)
+    ring = ring_schedule(tp)
     idx = jax.lax.axis_index(axis_name)
     b_local = x.shape[0] // tp
-    perm = _ring_perm(tp)
     acc = None
     for hop in range(tp):
         # chunk destined to finish at this device after the remaining
         # hops: walks d-1, d-2, ..., d (mod tp) — the final hop adds the
         # local partial for this device's OWN chunk
-        chunk = (idx - hop - 1) % tp
+        chunk = ring.exit_chunk(idx, hop)
         part = jax.lax.dynamic_slice_in_dim(x, chunk * b_local, b_local,
                                             axis=0) @ w
         acc = part if acc is None else acc + part
         if hop < tp - 1:
-            acc = jax.lax.ppermute(acc, axis_name, perm)
+            acc = jax.lax.ppermute(acc, axis_name, ring.perm)
     return acc

@@ -125,7 +125,7 @@ def _norm_f32(x, w, b, norm: str, eps: float):
 
 def plan_decode_block(*, max_seq: int, hidden: int, heads: int,
                       kv_heads: int, head_dim: int, ffn: int, batch: int,
-                      itemsize: int, gated: bool = False,
+                      itemsize: int, gated: bool = False, tp: int = 1,
                       vmem_budget: int = VMEM_BUDGET):
     """Pick (block_k, block_f) under the VMEM budget, or explain why no
     tiling fits.  Returns ``(plan_dict, None)`` or ``(None, reason)``.
@@ -136,7 +136,21 @@ def plan_decode_block(*, max_seq: int, hidden: int, heads: int,
     (it cannot tile without a second cross-program reduction), the
     double-buffered MLP weight tiles, and three [B, D] f32 scratch rows.
     Shrinking the tiles is the only lever; when the irreducible parts
-    alone bust the budget the layer cannot fuse at this shape."""
+    alone bust the budget the layer cannot fuse at this shape.
+
+    ``tp > 1`` plans the SHARDED variant instead
+    (``decode_block_tp.plan_decode_block_tp``): the per-shard working
+    set — weights/tp plus the ring hop tile buffers — against the same
+    budget; the plan dict then carries the per-seam ring tiles
+    (``block_qkv``/``block_o``/``block_up``/``block_down``) next to
+    ``block_k``."""
+    if tp > 1:
+        from .decode_block_tp import plan_decode_block_tp
+        return plan_decode_block_tp(
+            max_seq=max_seq, hidden=hidden, heads=heads,
+            kv_heads=kv_heads, head_dim=head_dim, ffn=ffn, batch=batch,
+            itemsize=itemsize, tp=tp, gated=gated,
+            vmem_budget=vmem_budget)
     rep = heads // kv_heads
     dh = head_dim
 
@@ -144,7 +158,7 @@ def plan_decode_block(*, max_seq: int, hidden: int, heads: int,
     attn_fixed = (hidden * (rep + 2) * dh * itemsize      # wq slice, wk, wv
                   + hidden * itemsize                     # x row
                   + 2 * hidden * 4                        # norm params (f32 work)
-                  + (rep + 2) * 128 * 4                   # m/l scratch rows
+                  + 2 * rep * 128 * 4                     # m + l scratch rows
                   + rep * dh * 4 + 2 * dh * 4             # acc + fresh k/v
                   + 2 * dh * dh * 4)                      # rope tables + R
     bk = min(1024, max_seq)
@@ -186,12 +200,19 @@ def plan_decode_block(*, max_seq: int, hidden: int, heads: int,
 
 def fusion_legal(*, max_seq: int, hidden: int, heads: int, kv_heads: int,
                  head_dim: int, ffn: int, batch: int, dtype,
-                 gated: bool = False,
+                 gated: bool = False, tp: int = 1,
                  vmem_budget: int = VMEM_BUDGET):
     """Static legality of the fused decode block for this shape/dtype.
     Returns ``(ok, reason)``; ``reason`` names the first failing check —
     the engine surfaces it in the ``decode_block`` obs event and bench
-    rows report it as the fallback cause."""
+    rows report it as the fallback cause.
+
+    ``tp > 1`` checks the SHARDED variant (``decode_block_tp``): the
+    kv-head axis must tile the mesh (the slabs shard on it, so each
+    device's attention grid owns whole head groups), the batch must
+    slot-shard (the residual stream rides ``[B/tp, D]`` between the
+    ring collectives), the ffn must column-shard, and the per-shard
+    working set must fit the same VMEM budget."""
     dt = jnp.dtype(dtype)
     if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False, f"dtype {dt.name} not in (float32, bfloat16)"
@@ -202,33 +223,44 @@ def fusion_legal(*, max_seq: int, hidden: int, heads: int, kv_heads: int,
         return False, f"heads {heads} not a multiple of kv_heads {kv_heads}"
     if head_dim % 2:
         return False, f"head_dim {head_dim} must be even (rotary halves)"
+    if tp > 1:
+        if kv_heads % tp:
+            return False, (f"kv_heads {kv_heads} not divisible by "
+                           f"tensor_parallel {tp} (the slab shards on "
+                           f"the kv-head axis)")
+        if batch % tp:
+            return False, (f"batch {batch} not divisible by "
+                           f"tensor_parallel {tp} (the residual stream "
+                           f"slot-shards between the ring collectives)")
+        if ffn % tp:
+            return False, (f"ffn {ffn} not divisible by "
+                           f"tensor_parallel {tp} (MLP column shards)")
     plan, why = plan_decode_block(
         max_seq=max_seq, hidden=hidden, heads=heads, kv_heads=kv_heads,
         head_dim=head_dim, ffn=ffn, batch=batch, itemsize=dt.itemsize,
-        gated=gated, vmem_budget=vmem_budget)
+        gated=gated, tp=tp, vmem_budget=vmem_budget)
     if plan is None:
         return False, why
     return True, None
 
 
-def decode_block_route(kv_len: int, tp: int = 1):
+def decode_block_route(kv_len: int):
     """Routing policy for the fused path (on top of ``fusion_legal``):
-    a tensor-parallel mesh refuses outright (the kernel pair assumes the
-    whole layer's weights and slab are device-local; the TP decode path
-    is serving/tp.py's fused compute-collective program — a sharded
-    decode-block variant is future work), then ``FLAGS_pallas_routing``
-    "never" wins everywhere including CPU (the flag's all-Pallas-off
-    contract); otherwise CPU always takes the interpreted kernel
-    (tier-1 exercises it), and on-chip the measured decode-attention
-    crossover (Pallas wins at kv <= 6144, statistical tie beyond —
-    kernels/routing.py) gates the fused path too, since its inner loop
-    is the same KV streaming pattern.  The fused-vs-unfused
-    `kernel_compare` row is the pending evidence to widen this.
+    ``FLAGS_pallas_routing`` "never" wins everywhere including CPU (the
+    flag's all-Pallas-off contract); otherwise CPU always takes the
+    interpreted kernel (tier-1 exercises it), and on-chip the measured
+    decode-attention crossover (Pallas wins at kv <= 6144, statistical
+    tie beyond — kernels/routing.py) gates the fused path too, since
+    its inner loop is the same KV streaming pattern.  A tensor-parallel
+    mesh no longer refuses here — routing is mesh-agnostic: the sharded
+    kernels (kernels/decode_block_tp.py) serve tp > 1, and the REAL
+    mesh legality — kv_heads/batch/ffn divisibility, head alignment,
+    the per-shard VMEM plan — lives in ``fusion_legal(tp=...)``, not in
+    a blanket policy.  The fused-vs-composed ``kernel_compare`` rows
+    (tp included) are the pending evidence to widen the win region.
     Returns ``(ok, reason)``."""
     from ..core.flags import flags
     from .routing import use_pallas
-    if tp > 1:
-        return False, "tensor_parallel"
     if getattr(flags, "pallas_routing", "auto") == "never":
         return False, "FLAGS_pallas_routing=never"
     if jax.default_backend() == "cpu":
@@ -242,23 +274,33 @@ def decode_block_route(kv_len: int, tp: int = 1):
 def resolve_fused_decode(model, *, batch: int, kv_len: int, tp: int = 1):
     """The full fused-vs-unfused fallback chain for a model at
     ``(batch, kv_len)``: model support (``fused_decode_step`` +
-    ``fused_decode_supported``) -> mesh legality (``tp > 1`` refuses
-    with reason ``"tensor_parallel"`` — the Pallas pair has no sharded
-    variant yet; the TP engine's fused path is serving/tp.py's
-    compute-collective program) -> routing policy
-    (:func:`decode_block_route`) -> shape/dtype/VMEM legality (the
-    model's ``fused_decode_supported`` -> :func:`fusion_legal`).
-    Shared by ``engine._resolve_decode_path`` and bench's
+    ``fused_decode_supported``) -> mesh legality (``tp > 1`` needs the
+    model's ``tp_decode_weights`` bundle — the sharded Pallas block
+    consumes the same per-device head-aligned layout as serving/tp.py's
+    composed program — and its ``tp_decode_supported`` divisibility) ->
+    routing policy (:func:`decode_block_route`) -> shape/dtype/VMEM
+    legality (the model's ``fused_decode_supported`` ->
+    :func:`fusion_legal(tp=...)`, which under tp > 1 checks the
+    per-shard plan: kv_heads/batch/ffn tiling and the ring working
+    set).  Shared by ``engine._resolve_decode_path`` and bench's
     ``decode_path_info`` so the fallback matrix lives in exactly one
     place.  Returns ``(ok, reason)``; ``reason`` is None when the
     fused path may engage."""
     supported = getattr(model, "fused_decode_supported", None)
     if supported is None or not hasattr(model, "fused_decode_step"):
         return False, "model has no fused_decode_step"
-    ok, reason = decode_block_route(kv_len, tp=tp)
+    if tp > 1:
+        if not hasattr(model, "tp_decode_weights") \
+                or not hasattr(model, "tp_decode_supported"):
+            return False, ("model has no tp_decode_weights (the sharded "
+                           "decode block consumes the TP bundle layout)")
+        ok, reason = model.tp_decode_supported(tp)
+        if not ok:
+            return False, reason
+    ok, reason = decode_block_route(kv_len)
     if not ok:
         return False, reason
-    return supported(batch=batch, kv_len=kv_len)
+    return supported(batch=batch, kv_len=kv_len, tp=tp)
 
 
 # ============================================================ attention block

@@ -1,0 +1,607 @@
+"""Sharded decode-block megakernel: the fused transformer-layer decode
+step of ``kernels/decode_block.py``, re-partitioned over a 1-D
+tensor-parallel mesh with the TP collectives riding the kernels.
+
+ClusterFusion++ and the fused computation-collective work (PAPERS.md)
+both locate multi-chip decode latency at BLOCK-level fusion *across the
+interconnect*: the per-op path pays one serialized collective plus one
+HBM round-trip at every TP boundary of the layer.  This module makes
+the PR 7 megakernel and the PR 9 collective-fusion program multiply
+instead of exclude each other (ROADMAP direction 2's sharded variant):
+
+  * **entry** — the residual stream arrives slot-sharded ``[B/tp, D]``;
+    :func:`ring_entry_matmul` lowers ``collective_matmul``'s all-gather
+    ring INTO the Pallas grid: each hop's dot runs as a tile-streamed
+    Pallas program over the weight shard already held while the
+    ``ppermute`` forwards the travelling activation shard (the hop's
+    permute and the hop's grid both consume the same buffer and neither
+    consumes the other, so XLA overlaps them — the SAME schedule as
+    ``allgather_matmul``, shared via ``collective_matmul.ring_schedule``
+    so the XLA and in-kernel rings cannot drift).
+  * **attention** — :func:`decode_block_attn_tp` is the per-shard
+    attention block: grid ``(KH/tp, B)`` over the LOCAL kv-head group,
+    matrix-form rotary, the fresh K/V row DMA'd **in-kernel** into the
+    LOCAL kv-head slab shard at the slot's ``seq_pos`` (the
+    ``serving/kv_pool`` slabs partition on the kv-head axis, so each
+    device appends exactly its own head rows — byte-identical lifecycle
+    semantics to ``decode_block.decode_block_attn``), then the same
+    double-buffered online-softmax streaming over the live slab tiles.
+  * **exit** — :func:`ring_exit_matmul` lowers the reduce-scatter ring:
+    each hop's partial (out-proj / MLP-down) accumulates tile-by-tile
+    in the grid's f32 scratch — with the MLP activation (GeLU / SwiGLU
+    gate) fused into the tile read, so ``act(up)`` never materializes
+    in HBM — while the travelling accumulator ppermutes; hop *i*'s dot
+    is data-independent of hop *i-1*'s permute, exactly the
+    ``matmul_reduce_scatter`` schedule.
+
+The ring hops themselves stay ``jax.lax.ppermute`` at the shard_map
+level on the current jax pin: Pallas TPU remote-DMA collectives
+(``make_async_remote_copy`` rings) can replace them without touching
+the tile kernels once the pin moves — the seam is exactly the two
+``ppermute`` call sites in the ring drivers below, which is why the
+per-hop compute is packaged as one Pallas program per hop rather than
+fused across hops.
+
+VMEM budgeting (:func:`plan_decode_block_tp`): the per-shard working
+set — weights/tp plus the ring tile buffers — must fit the same 12 MiB
+budget as the tp=1 plan; the kv streaming tile ``block_k`` and the four
+matmul tile sizes shrink until it does, and the plan refuses (composed
+``tp_fused`` / GSPMD fallback, see ``decode_block.resolve_fused_decode``)
+when the irreducible residents cannot fit.
+
+CPU tier-1 runs these kernels under ``interpret=True`` inside the same
+shard_map program over the virtual-device mesh, including the aliased
+in-kernel append into the sharded slabs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .collective_matmul import ring_schedule
+from .decode_block import VMEM_BUDGET, _NEG_INF, _norm_f32, \
+    _rotate_half_matrix
+
+__all__ = ["plan_decode_block_tp", "ring_entry_matmul",
+           "ring_exit_matmul", "decode_block_attn_tp",
+           "tp_fused_block_layer"]
+
+
+# ======================================================== planning / legality
+
+def _fit_tile(dim: int, per_unit: int, fixed: int, budget: int):
+    """Largest tile dividing ``dim`` whose streamed working set
+    ``fixed + per_unit * tile`` fits ``budget``; 128-multiples
+    preferred (the Mosaic lane rule), any divisor as the shrink
+    fallback — the same never-escalate posture as
+    ``decode_block_mlp``'s tile fixup.  None when no divisor fits."""
+    lane = [t for t in range(128, dim + 1, 128) if dim % t == 0]
+    for t in sorted(lane, reverse=True):
+        if fixed + per_unit * t <= budget:
+            return t
+    for t in sorted((t for t in range(1, dim + 1) if dim % t == 0),
+                    reverse=True):
+        if fixed + per_unit * t <= budget:
+            return t
+    return None
+
+
+def plan_decode_block_tp(*, max_seq: int, hidden: int, heads: int,
+                         kv_heads: int, head_dim: int, ffn: int,
+                         batch: int, itemsize: int, tp: int,
+                         gated: bool = False,
+                         vmem_budget: int = VMEM_BUDGET):
+    """Per-shard VMEM plan for the sharded decode block at degree
+    ``tp``: the attention kernel's kv streaming tile plus one tile size
+    per ring matmul seam (QKV entry, out-proj exit, MLP-up entry,
+    MLP-down exit).  Divisibility (kv_heads/ffn/batch over tp) is
+    checked by ``decode_block.fusion_legal`` BEFORE this runs.  Returns
+    ``(plan_dict, None)`` or ``(None, reason)`` — same contract as
+    ``decode_block.plan_decode_block``."""
+    rep = heads // kv_heads
+    dh = head_dim
+    h_l = heads // tp
+    kh_l = kv_heads // tp
+    f_l = ffn // tp
+    b_l = batch // tp
+    qkv_l = (h_l + 2 * kh_l) * dh
+    up_l = f_l * (2 if gated else 1)
+
+    # ---- per-shard attention kernel (grid (KH/tp, B)): no weight
+    # residents — the projections rode the entry ring — just the fresh
+    # qkv row, rope tables and the double-buffered kv window
+    attn_fixed = ((rep + 2) * dh * itemsize          # fresh q group + k + v
+                  + 2 * rep * 128 * 4                # m + l scratch rows
+                  + rep * dh * 4 + 2 * dh * 4        # acc + stored k/v
+                  + 2 * dh * dh * 4)                 # rope tables + R
+    bk = min(1024, max_seq)
+    while max_seq % bk:
+        bk //= 2
+    while bk > 8 and attn_fixed + 4 * bk * dh * itemsize > vmem_budget:
+        bk //= 2
+    if attn_fixed + 4 * bk * dh * itemsize > vmem_budget:
+        return None, (f"vmem: tp attention residents "
+                      f"{attn_fixed + 4 * bk * dh * itemsize} bytes "
+                      f"exceed budget {vmem_budget} even at block_k={bk}")
+
+    # ---- entry ring hop kernels: the [B/tp, D] travelling shard stays
+    # resident while weight/bias/output tiles stream double-buffered
+    entry_fixed = b_l * hidden * (itemsize + 4)      # shard + f32 work
+    entry_unit = 2 * (hidden + b_l + 1) * itemsize   # w + out + bias tile
+    block_qkv = _fit_tile(qkv_l, entry_unit, entry_fixed, vmem_budget)
+    if block_qkv is None:
+        return None, (f"vmem: tp entry residents {entry_fixed} + weight "
+                      f"tiles exceed budget {vmem_budget} at any tile of "
+                      f"the per-device QKV width {qkv_l}")
+    block_up = _fit_tile(up_l, entry_unit, entry_fixed, vmem_budget)
+    if block_up is None:
+        return None, (f"vmem: tp entry residents {entry_fixed} + weight "
+                      f"tiles exceed budget {vmem_budget} at any tile of "
+                      f"the per-device MLP-up width {up_l}")
+
+    # ---- exit ring hop kernels: f32 accumulator + output chunk stay
+    # resident; contraction-row weight tiles and activation tiles (two
+    # for the fused SwiGLU gate) stream
+    exit_fixed = b_l * hidden * (4 + itemsize)       # acc scratch + out
+    exit_unit = 2 * (hidden + b_l) * itemsize        # w + act tile
+    block_o = _fit_tile(h_l * dh, exit_unit, exit_fixed, vmem_budget)
+    if block_o is None:
+        return None, (f"vmem: tp exit residents {exit_fixed} + tiles "
+                      f"exceed budget {vmem_budget} at any tile of the "
+                      f"per-device out-proj rows {h_l * dh}")
+    down_unit = exit_unit + 2 * b_l * itemsize * (1 if gated else 0)
+    block_down = _fit_tile(f_l, down_unit, exit_fixed, vmem_budget)
+    if block_down is None:
+        return None, (f"vmem: tp exit residents {exit_fixed} + tiles "
+                      f"exceed budget {vmem_budget} at any tile of the "
+                      f"per-device MLP-down rows {f_l}")
+    return {"block_k": bk, "block_qkv": block_qkv, "block_up": block_up,
+            "block_o": block_o, "block_down": block_down,
+            "vmem_attn": attn_fixed + 4 * bk * dh * itemsize,
+            "vmem_entry": entry_fixed
+            + entry_unit * max(block_qkv, block_up),
+            "vmem_exit": exit_fixed
+            + max(exit_unit * block_o, down_unit * block_down)}, None
+
+
+# ========================================================== entry ring kernel
+
+def _entry_kernel(x_ref, w_ref, b_ref, o_ref):
+    """One output tile of a ring hop's dot: the resident travelling
+    shard against one streamed weight column tile (+ its bias tile),
+    f32 contraction."""
+    dims = (((1,), (0,)), ((), ()))
+    o_ref[...] = (jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        dims, preferred_element_type=jnp.float32)
+        + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def ring_entry_matmul(h, w_l, bias_l, axis_name: str, tp: int, *,
+                      block_n: Optional[int] = None,
+                      interpret: Optional[bool] = None):
+    """``concat_all_devices(h) @ w_l (+ bias_l)`` with the all-gather
+    riding the Pallas tile dots — the sharded decode block's entry seam.
+
+    ``h [B_l, K]`` is this device's slot shard of the (already normed)
+    activation; ``w_l [K, N_l]`` / ``bias_l [N_l]`` the local column
+    shard.  Returns ``[B_l * tp, N_l]``.  Each ring hop launches ONE
+    Pallas grid streaming ``[K, block_n]`` weight tiles against the
+    shard currently held while the ppermute forwards that shard to the
+    neighbour (``collective_matmul.ring_schedule`` — the hop's permute
+    and the hop's grid are data-independent).  The two ``ppermute``
+    lines below are the seam where Pallas remote-DMA collectives swap
+    in when the jax pin moves."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b_loc, k = h.shape
+    n_l = w_l.shape[1]
+    bias = bias_l if bias_l is not None else jnp.zeros((n_l,), h.dtype)
+    bn = min(block_n or n_l, n_l)
+    while n_l % bn:
+        bn -= 1
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",))
+    hop_call = pl.pallas_call(
+        _entry_kernel,
+        grid=(n_l // bn,),
+        in_specs=[
+            pl.BlockSpec((b_loc, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((b_loc, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((b_loc, n_l), h.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )
+    if tp == 1:
+        return hop_call(h, w_l, bias)
+    ring = ring_schedule(tp)
+    idx = jax.lax.axis_index(axis_name)
+    out = jnp.zeros((b_loc * tp, n_l), h.dtype)
+    buf = h
+    for hop in range(tp):
+        # seam: the in-flight forward of the travelling shard (future
+        # Pallas remote-DMA ring); independent of this hop's grid
+        nxt = jax.lax.ppermute(buf, axis_name, ring.perm) \
+            if hop < tp - 1 else None
+        chunk = hop_call(buf, w_l, bias)
+        out = jax.lax.dynamic_update_slice(
+            out, chunk, (ring.entry_src(idx, hop) * b_loc, 0))
+        buf = nxt
+    return out
+
+
+# =========================================================== exit ring kernel
+
+def _exit_kernel(g_ref, y_ref, w_ref, o_ref, acc_sc, *, nk, act):
+    """One contraction tile of a ring hop's partial: activation fused
+    into the tile read (``act(up)`` never round-trips HBM), f32 scratch
+    accumulation, emit on the last tile."""
+    i = pl.program_id(0)
+    dims = (((1,), (0,)), ((), ()))
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    t = y_ref[...].astype(jnp.float32)
+    if act == "swiglu":
+        t = jax.nn.silu(g_ref[...].astype(jnp.float32)) * t
+    elif act == "gelu_tanh":
+        t = jax.nn.gelu(t, approximate=True)
+    elif act == "gelu":
+        t = jax.nn.gelu(t, approximate=False)
+    acc_sc[...] = acc_sc[...] + jax.lax.dot_general(
+        t, w_ref[...].astype(jnp.float32), dims,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(i == nk - 1)
+    def _emit():
+        o_ref[...] = acc_sc[...].astype(o_ref.dtype)
+
+
+def ring_exit_matmul(y, w_l, axis_name: str, tp: int, *,
+                     act: str = "none",
+                     block_f: Optional[int] = None,
+                     interpret: Optional[bool] = None):
+    """``reduce_scatter_over_rows(act(y) @ w_l)`` with the reduction
+    riding the Pallas tile dots — the sharded decode block's exit seam.
+
+    ``y [B, K_l]`` holds every slot's rows against this device's
+    contraction shard (for ``act="swiglu"``: ``[B, 2*K_l]`` with the
+    per-device ``[gate | up]`` halves of the bundle layout); ``w_l
+    [K_l, N]`` the row shard of the exit weight.  Returns ``[B//tp,
+    N]``.  Each hop's partial runs as ONE Pallas grid (activation fused
+    into the tile read, f32 scratch accumulation) while the travelling
+    accumulator ppermutes — the add of the arriving accumulator stays
+    OUTSIDE the kernel so the hop's grid never waits on the in-flight
+    permute, exactly ``matmul_reduce_scatter``'s dataflow."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    gated = act == "swiglu"
+    b = y.shape[0]
+    k_l = y.shape[1] // (2 if gated else 1)
+    n = w_l.shape[1]
+    b_l = b // tp
+    bf = min(block_f or k_l, k_l)
+    while k_l % bf:
+        bf -= 1
+    nk = k_l // bf
+    if gated:
+        g_spec = pl.BlockSpec((b_l, bf), lambda i: (0, i))
+        y_spec = pl.BlockSpec((b_l, bf), lambda i: (0, nk + i))
+    else:
+        # the kernel never reads the gate when not gated, but the grid
+        # pipeline DMAs every spec'd block — a one-tile placeholder with
+        # a constant index map keeps the dead operand free (the same
+        # posture as decode_block_mlp's ungated wg)
+        g_spec = pl.BlockSpec((b_l, bf), lambda i: (0, 0))
+        y_spec = pl.BlockSpec((b_l, bf), lambda i: (0, i))
+    kernel = functools.partial(_exit_kernel, nk=nk, act=act)
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",))
+    hop_call = pl.pallas_call(
+        kernel,
+        grid=(nk,),
+        in_specs=[
+            g_spec,
+            y_spec,
+            pl.BlockSpec((bf, n), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((b_l, n), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b_l, n), y.dtype),
+        scratch_shapes=[pltpu.VMEM((b_l, n), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )
+
+    def part_of(chunk):
+        g = chunk if gated else jnp.zeros((b_l, bf), y.dtype)
+        return hop_call(g, chunk, w_l)
+
+    if tp == 1:
+        return part_of(y)
+    ring = ring_schedule(tp)
+    idx = jax.lax.axis_index(axis_name)
+    acc = None
+    for hop in range(tp):
+        chunk = jax.lax.dynamic_slice_in_dim(
+            y, ring.exit_chunk(idx, hop) * b_l, b_l, axis=0)
+        part = part_of(chunk)
+        acc = part if acc is None else acc + part
+        if hop < tp - 1:
+            # seam: the travelling accumulator's forward (future Pallas
+            # remote-DMA ring); independent of the NEXT hop's grid
+            acc = jax.lax.ppermute(acc, axis_name, ring.perm)
+    return acc
+
+
+# ==================================================== per-shard attention
+
+def _attn_tp_kernel(pos_ref, q_ref, k_ref, v_ref, cos_ref, sin_ref,
+                    rot_ref, k_any, v_any,
+                    attn_ref, ko_any, vo_any,
+                    m_sc, l_sc, acc_sc, knew_sc, vnew_sc, kbuf, vbuf,
+                    rsem, wsem, *, S, rep, dh, bk, scale, use_rope):
+    """``decode_block._attn_kernel`` minus the norm/projection front end
+    (those rode the entry ring): rotary -> in-kernel append into the
+    LOCAL slab shard -> double-buffered online-softmax streaming, with
+    byte-identical masking/lifecycle semantics."""
+    kh = pl.program_id(0)
+    b = pl.program_id(1)
+    pos = pos_ref[0]
+    dims = (((1,), (0,)), ((), ()))
+
+    qm = q_ref[0, 0].reshape(rep, dh).astype(jnp.float32)
+    kx = k_ref[0, 0].reshape(1, dh).astype(jnp.float32)
+    vx = v_ref[0, 0].reshape(1, dh).astype(jnp.float32)
+    if use_rope:
+        c = cos_ref[...].astype(jnp.float32)                # [1, dh]
+        s = sin_ref[...].astype(jnp.float32)
+        rot = rot_ref[...]
+        qm = qm * c + jax.lax.dot_general(
+            qm, rot, dims, preferred_element_type=jnp.float32) * s
+        kx = kx * c + jax.lax.dot_general(
+            kx, rot, dims, preferred_element_type=jnp.float32) * s
+    qm = qm * scale
+
+    # ---- in-kernel KV append into the LOCAL kv-head slab shard
+    # (dynamic_update_slice's clamp: a full slot overwrites its last
+    # row, matching the unfused path)
+    posw = jnp.minimum(pos, S - 1)
+    knew_sc[...] = kx.astype(knew_sc.dtype)
+    vnew_sc[...] = vx.astype(vnew_sc.dtype)
+    kw_cp = pltpu.make_async_copy(knew_sc, ko_any.at[b, pl.ds(posw, 1), kh],
+                                  wsem.at[0])
+    vw_cp = pltpu.make_async_copy(vnew_sc, vo_any.at[b, pl.ds(posw, 1), kh],
+                                  wsem.at[1])
+    kw_cp.start()
+    vw_cp.start()
+
+    # ---- stream the live tiles once, double-buffered (pos bounds the
+    # loop, so dead tiles are never even DMA'd)
+    lim = posw
+    nlive = jax.lax.div(lim + bk - 1, bk)
+    m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+    l_sc[...] = jnp.zeros_like(l_sc)
+    acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    def k_cp(slot, ki):
+        return pltpu.make_async_copy(
+            k_any.at[b, pl.ds(ki * bk, bk), kh], kbuf.at[slot],
+            rsem.at[0, slot])
+
+    def v_cp(slot, ki):
+        return pltpu.make_async_copy(
+            v_any.at[b, pl.ds(ki * bk, bk), kh], vbuf.at[slot],
+            rsem.at[1, slot])
+
+    @pl.when(nlive > 0)
+    def _prefetch():
+        k_cp(0, 0).start()
+        v_cp(0, 0).start()
+
+    def _update(s_blk, v_blk, kpos_valid):
+        """One online-softmax step (decode_attention's recurrence)."""
+        s_blk = jnp.where(kpos_valid, s_blk, _NEG_INF)
+        m_prev = m_sc[...]
+        l_prev = l_sc[...]
+        m_curr = jnp.max(s_blk, axis=1)[:, None]
+        m_next = jnp.maximum(m_prev, m_curr)
+        m_safe = jnp.where(m_next == _NEG_INF, 0.0, m_next)
+        p = jnp.exp(s_blk - m_safe[:, :1])
+        alpha = jnp.exp(m_prev - m_safe)
+        l_sc[...] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_sc[...] = m_next
+        acc_sc[...] = acc_sc[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _body(ki, carry):
+        slot = jax.lax.rem(ki, 2)
+
+        @pl.when(ki + 1 < nlive)
+        def _next():
+            k_cp(1 - slot, ki + 1).start()
+            v_cp(1 - slot, ki + 1).start()
+
+        k_cp(slot, ki).wait()
+        v_cp(slot, ki).wait()
+        kt = kbuf[slot].astype(jnp.float32)                 # [bk, dh]
+        vt = vbuf[slot].astype(jnp.float32)
+        s_blk = jax.lax.dot_general(qm, kt, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (rep, bk), 1)
+        _update(s_blk, vt, kpos < lim)
+        return carry
+
+    jax.lax.fori_loop(0, nlive, _body, 0)
+
+    # ---- the fresh token folds in last, always valid (it reads its own
+    # STORED k/v so storage-dtype rounding matches the unfused path)
+    kq = knew_sc[...].astype(jnp.float32)                   # [1, dh]
+    vq = vnew_sc[...].astype(jnp.float32)
+    s_new = jax.lax.dot_general(qm, kq, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    _update(s_new, vq, jnp.full((rep, 1), True))
+
+    l = l_sc[...][:, :1]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    attn_ref[0, 0] = (acc_sc[...] / l_safe).astype(attn_ref.dtype)
+    kw_cp.wait()
+    vw_cp.wait()
+
+
+def decode_block_attn_tp(q, k, v, k_slab, v_slab, seq_pos, *,
+                         kv_heads: int, head_dim: int,
+                         scale: Optional[float] = None,
+                         rope_cos=None, rope_sin=None,
+                         block_k: Optional[int] = None,
+                         interpret: Optional[bool] = None):
+    """Per-shard attention block: rotary -> in-kernel KV append into
+    the LOCAL slab shard -> streaming decode attention over the local
+    kv-head group.
+
+    ``q [B, H_l*Dh]`` / ``k``/``v [B, KH_l*Dh]`` are THIS device's head
+    group's fresh projections (the entry ring's output, kv-head-grouped
+    columns); ``k_slab``/``v_slab [B, S, KH_l, Dh]`` the local slab
+    shards (updated IN PLACE via kernel aliasing); ``seq_pos [B]`` the
+    cache lengths BEFORE this token.  ``kv_heads`` is the LOCAL count.
+    Returns ``(attn [B, H_l*Dh], k_slab', v_slab')``."""
+    b = q.shape[0]
+    s_max, kh_l, dh = k_slab.shape[1], k_slab.shape[2], k_slab.shape[3]
+    assert kh_l == kv_heads and dh == head_dim
+    rep = q.shape[1] // (kv_heads * dh)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    scale = scale if scale is not None else 1.0 / (head_dim ** 0.5)
+    pos1 = jnp.asarray(seq_pos, jnp.int32)
+    if pos1.ndim == 0:
+        pos1 = jnp.broadcast_to(pos1, (b,))
+    bk = min(block_k or min(1024, s_max), s_max)
+    while s_max % bk:
+        bk //= 2
+    use_rope = rope_cos is not None
+    q3 = q.reshape(b, kv_heads, rep * dh)
+    k3 = k.reshape(b, kv_heads, dh)
+    v3 = v.reshape(b, kv_heads, dh)
+    if use_rope:
+        cosf, sinf = rope_cos, rope_sin
+        rot = _rotate_half_matrix(dh)
+    else:
+        cosf = jnp.ones((b, dh), jnp.float32)
+        sinf = jnp.zeros((b, dh), jnp.float32)
+        rot = jnp.zeros((dh, dh), jnp.float32)
+
+    kernel = functools.partial(
+        _attn_tp_kernel, S=s_max, rep=rep, dh=dh, bk=bk, scale=scale,
+        use_rope=use_rope)
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("arbitrary", "arbitrary"))
+    attn4, k2, v2 = pl.pallas_call(
+        kernel,
+        grid=(kv_heads, b),
+        in_specs=[
+            pl.BlockSpec((1,), lambda kh, bi: (bi,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, rep * dh), lambda kh, bi: (bi, kh, 0)),
+            pl.BlockSpec((1, 1, dh), lambda kh, bi: (bi, kh, 0)),
+            pl.BlockSpec((1, 1, dh), lambda kh, bi: (bi, kh, 0)),
+            pl.BlockSpec((1, dh), lambda kh, bi: (bi, 0)),
+            pl.BlockSpec((1, dh), lambda kh, bi: (bi, 0)),
+            pl.BlockSpec((dh, dh), lambda kh, bi: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, rep, dh), lambda kh, bi: (bi, kh, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv_heads, rep, dh), q.dtype),
+            jax.ShapeDtypeStruct(k_slab.shape, k_slab.dtype),
+            jax.ShapeDtypeStruct(v_slab.shape, v_slab.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rep, 128), jnp.float32),
+            pltpu.VMEM((rep, 128), jnp.float32),
+            pltpu.VMEM((rep, dh), jnp.float32),
+            pltpu.VMEM((1, dh), k_slab.dtype),
+            pltpu.VMEM((1, dh), v_slab.dtype),
+            pltpu.VMEM((2, bk, dh), k_slab.dtype),
+            pltpu.VMEM((2, bk, dh), v_slab.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={7: 1, 8: 2},
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(pos1, q3, k3, v3, cosf, sinf, rot, k_slab, v_slab)
+    return attn4.reshape(b, kv_heads * rep * dh), k2, v2
+
+
+# ============================================================== layer wrapper
+
+def tp_fused_block_layer(x_s, pk, pv, seq_pos, blk, arch, rope_full,
+                         axis_name: str, tp: int, plan,
+                         interpret: Optional[bool] = None):
+    """One transformer layer of the sharded fused decode program — a
+    shard_map-body function mirroring ``serving/tp.py``'s composed
+    ``_tp_layer`` dataflow with every seam lowered to the Pallas
+    kernels: entry rings for QKV / MLP-up (norm local on the slot
+    shard — fusing it into hop 0's grid would serialize the first
+    permute behind the whole first dot), the per-shard attention block
+    with its in-kernel append, exit rings for out-proj / MLP-down with
+    the activation fused into the tile reads.
+
+    ``x_s [B/tp, D]`` slot-sharded residual; ``pk``/``pv`` the local
+    slab shards; ``blk``/``arch`` the ``tp_decode_weights`` bundle
+    entries (already per-device inside the shard_map); ``rope_full``
+    ``(cos [B, Dh], sin [B, Dh])`` full-width tables or None; ``plan``
+    from :func:`plan_decode_block_tp`.  Returns ``(x_s', pk', pv')``."""
+    dh = arch["head_dim"]
+    h_l = arch["heads"] // tp
+    kh_l = arch["kv_heads"] // tp
+    norm, eps = arch["norm"], arch["eps"]
+
+    def local_norm(x, w, bvec):
+        nb = bvec.astype(jnp.float32) \
+            if (norm == "layer" and bvec is not None) else None
+        return _norm_f32(x.astype(jnp.float32), w.astype(jnp.float32),
+                         nb, norm, eps).astype(x.dtype)
+
+    h1 = local_norm(x_s, blk["n1w"], blk["n1b"])
+    qkv = ring_entry_matmul(h1, blk["wqkv"], blk["bqkv"], axis_name, tp,
+                            block_n=plan["block_qkv"],
+                            interpret=interpret)
+    q2 = qkv[:, :h_l * dh]
+    k2 = qkv[:, h_l * dh:(h_l + kh_l) * dh]
+    v2 = qkv[:, (h_l + kh_l) * dh:]
+    cos, sin = rope_full if rope_full is not None else (None, None)
+    attn, kb, vb = decode_block_attn_tp(
+        q2, k2, v2, pk, pv, seq_pos, kv_heads=kh_l, head_dim=dh,
+        rope_cos=cos, rope_sin=sin, block_k=plan["block_k"],
+        interpret=interpret)
+    o = ring_exit_matmul(attn, blk["wo"], axis_name, tp,
+                         block_f=plan["block_o"], interpret=interpret)
+    if blk["bo"] is not None:
+        o = o + blk["bo"]
+    x_s = x_s + o
+    h2 = local_norm(x_s, blk["n2w"], blk["n2b"])
+    up = ring_entry_matmul(h2, blk["wup"], blk["bup"], axis_name, tp,
+                           block_n=plan["block_up"], interpret=interpret)
+    d = ring_exit_matmul(up, blk["wdown"], axis_name, tp,
+                         act=arch["act"], block_f=plan["block_down"],
+                         interpret=interpret)
+    if blk["bdown"] is not None:
+        d = d + blk["bdown"]
+    return x_s + d, kb, vb

@@ -71,12 +71,12 @@ def _rule(kernel: str, f: dict) -> bool:
         # streaming, so it inherits that kernel's measured win region
         # (pallas <= 6144, statistical tie beyond -> composed XLA path).
         # The fused-vs-unfused `kernel_compare` row
-        # (scripts/tpu_evidence_bench.py) is the pending evidence that
-        # will widen or narrow this; shape legality is checked
-        # separately by decode_block.fusion_legal, and mesh legality
-        # (tp > 1 refuses with reason "tensor_parallel" — the pair
-        # assumes a device-local slab) by decode_block.decode_block_route
-        # BEFORE this table is consulted.
+        # (scripts/tpu_evidence_bench.py, tp rows included) is the
+        # pending evidence that will widen or narrow this; shape/mesh
+        # legality — incl. the tp > 1 per-shard plan of the sharded
+        # variant (kernels/decode_block_tp.py) — is checked separately
+        # by decode_block.fusion_legal(tp=...) before this table is
+        # consulted.
         return _rule("decode_attention", f)
     if kernel in ("layer_norm", "rms_norm"):
         return False

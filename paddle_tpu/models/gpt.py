@@ -288,9 +288,12 @@ class GPTForCausalLM(Layer):
         return self.logits(x), new_caches
 
     def fused_decode_supported(self, batch: int = 1,
-                               kv_len: Optional[int] = None):
+                               kv_len: Optional[int] = None,
+                               tp: int = 1):
         """Static legality of the fused decode-block path for this
-        config at ``(batch, kv_len)``.  Returns ``(ok, reason)``."""
+        config at ``(batch, kv_len)``; ``tp > 1`` checks the sharded
+        variant's per-shard plan (kernels/decode_block_tp.py).
+        Returns ``(ok, reason)``."""
         from ..kernels.decode_block import fusion_legal
         cfg = self.cfg
         if cfg.dropout and self.training:
@@ -299,7 +302,7 @@ class GPTForCausalLM(Layer):
             max_seq=kv_len or cfg.max_seq_len, hidden=cfg.hidden_size,
             heads=cfg.num_heads, kv_heads=cfg.num_heads,
             head_dim=cfg.head_dim, ffn=cfg.ffn_size, batch=batch,
-            dtype=cfg.dtype)
+            dtype=cfg.dtype, tp=tp)
 
     def fused_decode_step(self, input_ids, caches, position):
         """``decode_step`` through the fused decode-block kernels: the

@@ -272,9 +272,11 @@ class LlamaForCausalLM(Layer):
         return self.lm_head(hidden), new_caches
 
     def fused_decode_supported(self, batch: int = 1,
-                               kv_len: Optional[int] = None):
-        """Static legality of the fused decode-block path (GQA aware).
-        Returns ``(ok, reason)``."""
+                               kv_len: Optional[int] = None,
+                               tp: int = 1):
+        """Static legality of the fused decode-block path (GQA aware);
+        ``tp > 1`` checks the sharded variant's per-shard plan
+        (kernels/decode_block_tp.py).  Returns ``(ok, reason)``."""
         from ..kernels.decode_block import fusion_legal
         cfg = self.cfg
         if cfg.dropout and self.training:
@@ -283,7 +285,7 @@ class LlamaForCausalLM(Layer):
             max_seq=kv_len or cfg.max_seq_len, hidden=cfg.hidden_size,
             heads=cfg.num_heads, kv_heads=cfg.kv_heads,
             head_dim=cfg.head_dim, ffn=cfg.intermediate_size, batch=batch,
-            dtype=cfg.dtype, gated=True)
+            dtype=cfg.dtype, gated=True, tp=tp)
 
     def fused_decode_step(self, input_ids, caches, position):
         """``decode_step`` through the fused decode-block kernels —

@@ -294,7 +294,9 @@ class ServingEngine:
 
     @property
     def decode_path(self) -> str:
-        """``"fused"`` (Pallas decode-block), ``"tp_fused"`` (the
+        """``"fused"`` (Pallas decode-block), ``"tp_fused_block"``
+        (the SHARDED Pallas decode block on a tp > 1 mesh —
+        kernels/decode_block_tp.py), ``"tp_fused"`` (the
         tensor-parallel fused compute-collective shard_map program) or
         ``"unfused"`` — which decode step this engine compiled
         (resolved once at construction; see docs/serving.md)."""
@@ -302,10 +304,11 @@ class ServingEngine:
 
     @property
     def decode_fallback_reason(self):
-        """Why ``fused_decode=True`` fell back to the composed path
-        (``None`` when fused is active or the flag is off;
-        ``"tensor_parallel"`` under a tp > 1 mesh — the Pallas pair has
-        no sharded variant)."""
+        """Why ``fused_decode=True`` fell back down the chain
+        (``None`` when a fused block path is active or the flag is
+        off; under tp > 1 the reason names the REAL failed legality
+        gate — kv_heads/batch/ffn tiling, bundle surface, VMEM plan —
+        per docs/serving.md's fallback matrix)."""
         return self.core.decode_fallback_reason
 
     @property

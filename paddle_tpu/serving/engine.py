@@ -246,6 +246,7 @@ class EngineCore:
         self.collective_fusion = collective_fusion
         self.mesh = None
         self._tp_program = None
+        self._tp_program_path: Optional[str] = None
         self.tp_fusion_reason: Optional[str] = None
         if tensor_parallel > 1:
             from . import tp as _tp
@@ -647,24 +648,37 @@ class EngineCore:
     def _resolve_decode_path(self):
         """Statically resolve the decode implementation for THIS
         engine's shapes: the ``fused_decode`` flag opts into the Pallas
-        decode-block pair, ``decode_block_route`` applies the routing
-        policy (flags + measured win region + mesh legality), and the
-        model's ``fused_decode_supported`` checks shape/dtype/VMEM
-        legality.  Under tensor parallelism the Pallas pair refuses
-        (``decode_fallback_reason="tensor_parallel"`` — it assumes a
-        device-local slab) and the engine instead resolves the fused
-        compute-collective shard_map program (``"tp_fused"``,
-        serving/tp.py) when ``collective_fusion`` is on and legal, the
-        composed GSPMD decode otherwise.  Returns ``(path,
-        fallback_reason)``; reason is None when fused engages (or the
-        flag is simply off)."""
+        decode-block kernels, ``decode_block_route`` applies the
+        routing policy (flags + measured win region), and the model's
+        ``fused_decode_supported`` checks shape/dtype/VMEM legality.
+        Under tensor parallelism the fallback chain gains a leg: the
+        SHARDED Pallas decode block (``"tp_fused_block"``,
+        kernels/decode_block_tp.py — entry/exit ring collectives riding
+        the tile dots, in-kernel append on the local slab shard)
+        engages when the flag opts in, ``collective_fusion`` is on (its
+        rings ARE the fused collectives) and
+        ``resolve_fused_decode(tp=...)`` passes the real legality
+        (kv_heads/batch/ffn tiling, head alignment, per-shard VMEM
+        plan); otherwise the composed compute-collective shard_map
+        program (``"tp_fused"``, serving/tp.py) when legal, the
+        composed GSPMD decode last — every rung keeps serving.  Returns
+        ``(path, fallback_reason)``; reason is None when a fused-block
+        path engages (or the flag is simply off)."""
         from ..kernels.decode_block import resolve_fused_decode
         if self.tensor_parallel > 1:
             reason = None
             if self.fused_decode:
-                _, reason = resolve_fused_decode(
+                ok, reason = resolve_fused_decode(
                     self.model, batch=self.num_slots,
                     kv_len=self.pool.max_seq, tp=self.tensor_parallel)
+                if ok and not self.collective_fusion:
+                    ok, reason = False, ("collective_fusion disabled "
+                                         "(the sharded block's "
+                                         "entry/exit rings are fused "
+                                         "collectives)")
+                if ok:
+                    self.tp_fusion_reason = None
+                    return "tp_fused_block", None
             from . import tp as _tp
             ok, tp_reason = _tp.tp_decode_supported(
                 self.model, self.tensor_parallel, self.num_slots) \
@@ -684,13 +698,16 @@ class EngineCore:
         fused = self.decode_path == "fused"
         # the discrete obs event marks WHICH path this engine's single
         # decode program compiled with (and why, on fallback) — traces
-        # distinguish fused from unfused steps without diffing configs
+        # distinguish fused from unfused steps without diffing configs;
+        # the tp dimension separates the sharded block from the tp=1
+        # pair in a shared registry (glossary: docs/observability.md)
         self.metrics.on_decode_block(
-            active=fused,
+            active=self.decode_path in ("fused", "tp_fused_block"),
             reason=None if not self.fused_decode
             else self.decode_fallback_reason,
-            step=self._step_in_flight)
-        if self.decode_path == "tp_fused":
+            step=self._step_in_flight,
+            tp=self.tensor_parallel)
+        if self.decode_path in ("tp_fused", "tp_fused_block"):
             return self._build_tp_decode_fn()
 
         def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
@@ -722,14 +739,23 @@ class EngineCore:
         the QKV/MLP-up dots and whose exit reduce-scatters ride the
         out-proj/MLP-down dots, then the SAME per-slot sampling tail as
         the composed path on the vocab-sharded logits (GSPMD partitions
-        the argmax/top-k reductions).  Same signature, same donation,
-        same single compiled decode program — the compile-count pin is
-        untouched.  The weight bundle survives quarantine rebuilds (it
-        is never donated), so a rebuilt plane reuses it."""
+        the argmax/top-k reductions).  On the ``tp_fused_block`` path
+        the same program's layer bodies run the sharded Pallas
+        decode-block kernels instead (kernels/decode_block_tp.py) —
+        same signature, same donation, same single compiled decode
+        program either way, so the compile-count pin is untouched.  The
+        weight bundle survives quarantine rebuilds (it is never
+        donated), so a rebuilt plane reuses it; a degradation-ladder
+        path change invalidates the cached program (it is path-
+        specific)."""
         from . import tp as _tp
-        if self._tp_program is None:
+        if self._tp_program is None \
+                or self._tp_program_path != self.decode_path:
             self._tp_program = _tp.build_tp_decode_program(
-                self.model, self.mesh, self.tensor_parallel)
+                self.model, self.mesh, self.tensor_parallel,
+                pallas_block=self.decode_path == "tp_fused_block",
+                batch=self.num_slots, max_seq=self.pool.max_seq)
+            self._tp_program_path = self.decode_path
         program = self._tp_program
 
         def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
@@ -866,7 +892,8 @@ class EngineCore:
                 # watchdog attributes them to the decode path (ladder
                 # candidate when fused, retry/quarantine otherwise)
                 self._fault_phase = "fused_decode" \
-                    if self.decode_path == "fused" else "decode"
+                    if self.decode_path in ("fused", "tp_fused_block") \
+                    else "decode"
                 if faults is not None:
                     faults.fire("step")
                 nxt = self._decode_dispatch()
@@ -906,7 +933,7 @@ class EngineCore:
                 # histograms and fake slices into the timeline
                 phases += [("decode_dispatch", t_prefill, t_decode),
                            ("readback", t_decode, t_readback)]
-                if self.decode_path == "fused":
+                if self.decode_path in ("fused", "tp_fused_block"):
                     # fused-path dispatch cost, separable from unfused
                     # runs in the same registry (glossary:
                     # kernel.decode_block_s, docs/observability.md)
@@ -965,7 +992,8 @@ class EngineCore:
         runs normally after the backoff sleep."""
         step_i = self._step_in_flight
         phase = self._fault_phase or "step"
-        if phase == "fused_decode" and self.decode_path == "fused":
+        if phase == "fused_decode" \
+                and self.decode_path in ("fused", "tp_fused_block"):
             self._subsystem_fault("fused_decode", exc)
         else:
             self.metrics.on_fault(phase, repr(exc), step=step_i)
@@ -1001,7 +1029,19 @@ class EngineCore:
             self.prefill_chunk = None     # whole-bucket prefill; plans
             # already computed keep their compiled chunk widths
         elif subsystem == "fused_decode":
-            self.decode_path = "unfused"
+            if self.tensor_parallel > 1:
+                # the sharded-block rung degrades to the composed
+                # compute-collective program when it is legal, the GSPMD
+                # decode otherwise — the same order as the resolve chain
+                from . import tp as _tp
+                ok, tp_reason = _tp.tp_decode_supported(
+                    self.model, self.tensor_parallel, self.num_slots) \
+                    if self.collective_fusion \
+                    else (False, "collective_fusion disabled")
+                self.decode_path = "tp_fused" if ok else "unfused"
+                self.tp_fusion_reason = None if ok else tp_reason
+            else:
+                self.decode_path = "unfused"
             self.decode_fallback_reason = f"degraded: {reason}"
             self._decode_fn = None        # re-trace composed on next use
         else:

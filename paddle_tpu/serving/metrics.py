@@ -260,19 +260,21 @@ class ServingMetrics:
         self._h_gather.observe(seconds)
 
     def on_decode_block(self, active: bool, reason: Optional[str],
-                        step: int = 0) -> None:
+                        step: int = 0, tp: int = 1) -> None:
         """The engine resolved its decode path (emitted once, when the
         single decode program is built): ``active`` says whether the
-        fused decode-block kernel pair compiled in, ``reason`` carries
-        the fallback cause when the flag asked for fusion but routing or
-        legality refused (None when fused engaged or the flag was off).
-        Lands as a ``decode_block`` discrete event on the engine lane so
-        traces distinguish fused from unfused steps without diffing
-        engine configs (glossary: docs/observability.md)."""
+        fused decode-block kernels compiled in, ``reason`` carries the
+        fallback cause when the flag asked for fusion but routing or
+        legality refused (None when fused engaged or the flag was off),
+        and ``tp`` records the mesh degree — ``active`` at ``tp > 1``
+        means the SHARDED block (kernels/decode_block_tp.py), so traces
+        from a shared registry separate the two fused variants.  Lands
+        as a ``decode_block`` discrete event on the engine lane
+        (glossary: docs/observability.md)."""
         self.tracer.event("decode_block", lane=self.engine_lane,
                           active=active,
                           reason=reason if reason is not None else "",
-                          step=step)
+                          step=step, tp=tp)
 
     def on_decode_block_step(self, seconds: float) -> None:
         """One fused-path decode dispatch's wall time (the engine calls

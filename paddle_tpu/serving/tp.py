@@ -21,8 +21,12 @@ its WHOLE device plane over a 1-D mesh whose axis is the models' ``mp``
     their adjacent dots (kernels/collective_matmul.py): the entry
     all-gather rides the QKV / MLP-up matmul, the exit reduce-scatter
     rides the out-proj / MLP-down matmul, and the residual stream stays
-    slot-sharded between them so norms run local.  See docs/serving.md
-    "Tensor-parallel serving".
+    slot-sharded between them so norms run local.  With
+    ``pallas_block=True`` (the engine's ``tp_fused_block`` path, ISSUE
+    12) the same program's layer bodies run the SHARDED Pallas decode
+    block instead (kernels/decode_block_tp.py: the rings lowered into
+    the Pallas grid, KV append in-kernel on the local slab shard).  See
+    docs/serving.md "Tensor-parallel serving".
 
 Per-device decode dataflow (one layer; B slots, tp devices)::
 
@@ -287,11 +291,18 @@ def _tp_layer(x_s, pk, pv, seq_pos, blk, arch, rope, axis, tp, overlap):
 
 
 def _tp_decode_body(weights, ks, vs, seq_pos, last_tok, *, arch, tp,
-                    axis, overlap):
+                    axis, overlap, pallas_plan=None):
     """Per-device body of the ONE fused decode program: embed (masked
     vocab-shard lookup + psum) -> slot-shard the residual stream ->
     layers (fused collectives) -> final norm -> logits against the local
-    vocab columns (left vocab-sharded for the GSPMD sampling tail)."""
+    vocab columns (left vocab-sharded for the GSPMD sampling tail).
+
+    With ``pallas_plan`` the layer bodies run as the SHARDED Pallas
+    decode-block kernels (kernels/decode_block_tp.py — the entry/exit
+    rings lowered into the Pallas grid, attention + in-kernel append on
+    the local slab shard); the embed / final-norm / logits legs are
+    shared code either way, so the two paths cannot drift outside the
+    layer seam."""
     from ..kernels.collective_matmul import allgather_matmul
     idx = jax.lax.axis_index(axis)
     b = last_tok.shape[0]
@@ -305,18 +316,33 @@ def _tp_decode_body(weights, ks, vs, seq_pos, last_tok, *, arch, tp,
     x = jax.lax.psum(emb, axis)                  # [B, D] replicated
     if weights["wpe"] is not None:
         x = x + jnp.take(weights["wpe"], seq_pos, axis=0)
-    rope = None
+    rope, rope_full = None, None
     if arch["rope"]:
         from ..models.llama import _rope_tables
-        cos, sin = _rope_tables(seq_pos[:, None], arch["head_dim"],
-                                arch["rope_theta"], x.dtype)
-        rope = (cos, sin)
+        if pallas_plan is not None:
+            # full-width tables (halves duplicated) at each slot's
+            # position — the kernel applies rotary in matrix form,
+            # exactly like the models' tp=1 fused_decode_step
+            cos, sin = _rope_tables(seq_pos, arch["head_dim"],
+                                    arch["rope_theta"], jnp.float32)
+            rope_full = (jnp.concatenate([cos, cos], axis=-1),
+                         jnp.concatenate([sin, sin], axis=-1))
+        else:
+            cos, sin = _rope_tables(seq_pos[:, None], arch["head_dim"],
+                                    arch["rope_theta"], x.dtype)
+            rope = (cos, sin)
     # slot-shard the residual stream: this device's row chunk
     x_s = jax.lax.dynamic_slice_in_dim(x, idx * b_l, b_l, axis=0)
     new_ks, new_vs = [], []
     for blk, pk, pv in zip(weights["blocks"], ks, vs):
-        x_s, kb, vb = _tp_layer(x_s, pk, pv, seq_pos, blk, arch, rope,
-                                axis, tp, overlap)
+        if pallas_plan is not None:
+            from ..kernels.decode_block_tp import tp_fused_block_layer
+            x_s, kb, vb = tp_fused_block_layer(
+                x_s, pk, pv, seq_pos, blk, arch, rope_full, axis, tp,
+                pallas_plan)
+        else:
+            x_s, kb, vb = _tp_layer(x_s, pk, pv, seq_pos, blk, arch,
+                                    rope, axis, tp, overlap)
         new_ks.append(kb)
         new_vs.append(vb)
     xf = _norm(x_s, weights["nfw"], weights["nfb"], arch["norm"],
@@ -327,7 +353,10 @@ def _tp_decode_body(weights, ks, vs, seq_pos, last_tok, *, arch, tp,
 
 
 def build_tp_decode_program(model, mesh: Mesh, tp: int, *,
-                            overlap: bool = True):
+                            overlap: bool = True,
+                            pallas_block: bool = False,
+                            batch: Optional[int] = None,
+                            max_seq: Optional[int] = None):
     """Build the engine's fused compute-collective decode program:
     ``fn(ks, vs, seq_pos, last_tok) -> (logits, new_ks, new_vs,
     new_pos)`` with ``logits [num_slots, 1, vocab]`` vocab-sharded over
@@ -335,12 +364,38 @@ def build_tp_decode_program(model, mesh: Mesh, tp: int, *,
     sampling tail in the single compiled decode step, so the program-set
     pin (ONE decode) is unchanged.
 
+    ``pallas_block=True`` builds the ``tp_fused_block`` variant: the
+    layer bodies run the sharded Pallas decode-block kernels
+    (kernels/decode_block_tp.py) with the entry/exit collectives riding
+    the tile dots and the KV append landing in-kernel on the local slab
+    shard; ``batch``/``max_seq`` (the engine's num_slots / pool
+    max_seq) size the per-shard VMEM plan, which raises if illegal —
+    callers are contracted to gate on
+    ``decode_block.resolve_fused_decode(tp=...)`` first.
+
     The weight bundle is laid out here once (device_put per
     ``_BUNDLE_SPECS``); the returned closure captures it, exactly like
     the composed path captures the model's own parameters."""
     from ..distributed._jax_compat import shard_map
     from ..distributed.sharding_utils import put_global
     arch, weights = model.tp_decode_weights(tp)
+    pallas_plan = None
+    if pallas_block:
+        from ..kernels.decode_block import plan_decode_block
+        gated = arch["act"] == "swiglu"
+        blk0 = weights["blocks"][0]
+        ffn = blk0["wup"].shape[1] // (2 if gated else 1)
+        pallas_plan, why = plan_decode_block(
+            max_seq=max_seq, hidden=arch["hidden"], heads=arch["heads"],
+            kv_heads=arch["kv_heads"], head_dim=arch["head_dim"],
+            ffn=ffn, batch=batch,
+            itemsize=jnp.dtype(blk0["wqkv"].dtype).itemsize,
+            gated=gated, tp=tp)
+        if pallas_plan is None:
+            raise ValueError(
+                f"build_tp_decode_program(pallas_block=True): no VMEM "
+                f"tiling fits ({why}) — gate on resolve_fused_decode "
+                f"before requesting the sharded Pallas block")
     specs = _bundle_specs(weights)
     weights = jax.tree.map(
         lambda w, s: None if w is None
@@ -348,7 +403,8 @@ def build_tp_decode_program(model, mesh: Mesh, tp: int, *,
         weights, specs, is_leaf=lambda x: x is None)
     num_layers = len(weights["blocks"])
     body = functools.partial(_tp_decode_body, arch=arch, tp=tp,
-                             axis=TP_AXIS, overlap=overlap)
+                             axis=TP_AXIS, overlap=overlap,
+                             pallas_plan=pallas_plan)
     slab = [KV_SLAB_SPEC] * num_layers
 
     def program(ks, vs, seq_pos, last_tok):

@@ -728,6 +728,80 @@ register_signature(
     _first_arg_like)
 
 
+def _decode_block_tp_layer_sig(interp, rec):
+    """``tp_fused_block_layer(x_s, pk, pv, seq_pos, ...)``:
+    ``(x_s', pk', pv')`` — the sharded fused layer step is shape/dtype
+    preserving on the slot-sharded residual (arg 0) and returns the
+    local slab shards (args 1/2) updated in place, the same fixed-shape
+    discipline as the tp=1 ``decode_block_layer`` triple."""
+    return Tup((_decode_block_arr(rec, 0, "x_s"),
+                _decode_block_arr(rec, 1, "pk"),
+                _decode_block_arr(rec, 2, "pv")))
+
+
+def _decode_block_attn_tp_sig(interp, rec):
+    """``decode_block_attn_tp(q, k, v, k_slab, v_slab, seq_pos, ...)``:
+    ``(attn, k_slab', v_slab')`` — attn mirrors q's [B, H_l*Dh] shape
+    and dtype; the local slab shards thread through."""
+    return Tup((_decode_block_arr(rec, 0, "q"),
+                _decode_block_arr(rec, 3, "k_slab"),
+                _decode_block_arr(rec, 4, "v_slab")))
+
+
+def _ring_entry_matmul_sig(interp, rec):
+    """``ring_entry_matmul(h [B_l, K], w_l [K, N_l], bias_l, axis, tp)``
+    -> ``[B_l * tp, N_l]`` — the Pallas-grid lowering of the entry
+    all-gather ring (kernels/decode_block_tp.py); the same row blow-up
+    as ``allgather_matmul``."""
+    x = _arg(rec, 0, "h")
+    w = _arg(rec, 1, "w_l")
+    tp = _arg(rec, 4, "tp")
+    shape = None
+    if isinstance(x, Arr) and x.shape is not None and len(x.shape) == 2 \
+            and isinstance(w, Arr) and w.shape is not None \
+            and len(w.shape) == 2 and isinstance(tp, Const) \
+            and isinstance(tp.value, int) \
+            and isinstance(x.shape[0], int):
+        shape = (x.shape[0] * tp.value, w.shape[1])
+    dt = x.dtype if isinstance(x, Arr) else None
+    return Arr(shape=shape, dtype=dt,
+               traced=bool(getattr(x, "traced", False)))
+
+
+def _ring_exit_matmul_sig(interp, rec):
+    """``ring_exit_matmul(y [B, K_l], w_l [K_l, N], axis, tp)`` ->
+    ``[B // tp, N]`` — the Pallas-grid lowering of the exit
+    reduce-scatter ring; same row scatter as
+    ``matmul_reduce_scatter``."""
+    x = _arg(rec, 0, "y")
+    w = _arg(rec, 1, "w_l")
+    tp = _arg(rec, 3, "tp")
+    shape = None
+    if isinstance(x, Arr) and x.shape is not None and len(x.shape) == 2 \
+            and isinstance(w, Arr) and w.shape is not None \
+            and len(w.shape) == 2 and isinstance(tp, Const) \
+            and isinstance(tp.value, int) and tp.value > 0 \
+            and isinstance(x.shape[0], int):
+        shape = (x.shape[0] // tp.value, w.shape[1])
+    dt = x.dtype if isinstance(x, Arr) else None
+    return Arr(shape=shape, dtype=dt,
+               traced=bool(getattr(x, "traced", False)))
+
+
+register_signature(
+    "paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer",
+    _decode_block_tp_layer_sig)
+register_signature(
+    "paddle_tpu.kernels.decode_block_tp.decode_block_attn_tp",
+    _decode_block_attn_tp_sig)
+register_signature(
+    "paddle_tpu.kernels.decode_block_tp.ring_entry_matmul",
+    _ring_entry_matmul_sig)
+register_signature(
+    "paddle_tpu.kernels.decode_block_tp.ring_exit_matmul",
+    _ring_exit_matmul_sig)
+
+
 def _allgather_matmul_sig(interp, rec):
     """``allgather_matmul(x [B_l, K], w [K, N_l], axis, tp)`` ->
     ``[B_l * tp, N_l]`` — the gathered-rows matmul of the TP decode

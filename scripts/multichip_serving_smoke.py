@@ -4,18 +4,25 @@ over a virtual-device mesh end-to-end and emit the evidence as
 artifacts (the TP sibling of ``scripts/chaos_smoke.py``):
 
   * one identically-initialized GPT behind engines at every requested
-    tp degree; a mixed-length workload runs to completion per degree;
-  * ``serving_tp.json`` — per-degree verdict: decode path
-    (``tp_fused`` / ``unfused``), token PARITY against the tp=1 engine,
-    tokens/sec, TTFT p50/p99, ``serving.collective_s`` stats, and the
-    sharded-plane check (slab PartitionSpec on the kv-head axis);
+    tp degree, in BOTH modes: ``composed`` (the default engine — the
+    compute-collective ``tp_fused`` decode at tp > 1) and ``fused``
+    (``fused_decode=True`` — the Pallas decode-block pair at tp=1 and
+    the SHARDED Pallas block ``tp_fused_block`` at tp > 1, ISSUE 12); a
+    mixed-length workload runs to completion per (mode, degree);
+  * ``serving_tp.json`` — per-run verdict: decode path (asserted
+    ``tp_fused`` composed / ``tp_fused_block`` fused at tp > 1 — the
+    fused-TP leg cannot silently fall back), token PARITY against the
+    composed tp=1 engine ACROSS modes, tokens/sec, TTFT p50/p99,
+    ``serving.collective_s`` stats, and the sharded-plane check (slab
+    PartitionSpec on the kv-head axis);
   * ``metrics.prom``  — Prometheus text of the last degree's run, so the
     ``serving_tp_degree`` gauge and ``serving_collective_s`` histogram
     documented in docs/observability.md can be eyeballed as scraped.
 
 Usage:
     python scripts/multichip_serving_smoke.py --out /tmp/tp_smoke
-        [--degrees 1,2,4] [--requests 6] [--slots 4] [--new 6]
+        [--degrees 1,2,4] [--modes composed,fused] [--requests 6]
+        [--slots 4] [--new 6]
 
 The script FAILS (exit 1) on any parity break, undrained request, or a
 degree whose plane is not actually sharded —
@@ -73,7 +80,8 @@ def _ensure_devices(n: int) -> None:
     _jeb.clear_backends()
 
 
-def run_degree(model_seed, tp, prompts, slots, new_tokens):
+def run_degree(model_seed, tp, prompts, slots, new_tokens,
+               fused=False):
     import numpy as np  # noqa: F401  (parity compare below)
     import paddle_tpu
     from paddle_tpu.models import GPTForCausalLM, gpt_tiny
@@ -82,7 +90,8 @@ def run_degree(model_seed, tp, prompts, slots, new_tokens):
     paddle_tpu.seed(model_seed)
     model = GPTForCausalLM(gpt_tiny())
     model.eval()
-    eng = ServingEngine(model, num_slots=slots, tensor_parallel=tp)
+    eng = ServingEngine(model, num_slots=slots, tensor_parallel=tp,
+                        fused_decode=fused)
     outs = eng.serve_batch(prompts, max_new_tokens=new_tokens,
                            max_steps=20000)
     md = eng.metrics_dict()
@@ -91,7 +100,9 @@ def run_degree(model_seed, tp, prompts, slots, new_tokens):
         if tp > 1 else None
     return {
         "tp": tp,
+        "mode": "fused" if fused else "composed",
         "decode_path": eng.decode_path,
+        "decode_fallback_reason": eng.decode_fallback_reason,
         "tp_fusion_reason": eng.tp_fusion_reason,
         "finished": sum(o.finished for o in outs),
         "tokens": [list(map(int, o.tokens)) for o in outs],
@@ -108,6 +119,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out", required=True)
     ap.add_argument("--degrees", default="1,2,4")
+    ap.add_argument("--modes", default="composed,fused")
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--new", type=int, default=6)
@@ -121,24 +133,42 @@ def main(argv=None) -> int:
     prompts = [rs.randint(0, 256, (L,)) for L in lens]
 
     os.makedirs(args.out, exist_ok=True)
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    bad = [m for m in modes if m not in ("composed", "fused")]
+    if bad:
+        ap.error(f"--modes entries must be 'composed' or 'fused', "
+                 f"got {bad}")
     rows, ok = [], True
     base_tokens, eng = None, None
-    for tp in degrees:
-        row, eng = run_degree(0, tp, prompts, args.slots, args.new)
-        if base_tokens is None:
-            base_tokens = row["tokens"]
-            row["parity_vs_tp1"] = True
-        else:
-            row["parity_vs_tp1"] = row["tokens"] == base_tokens
-        row["drained"] = row.pop("finished") == args.requests
-        ok = ok and row["drained"] and row["parity_vs_tp1"]
-        if tp > 1:
-            sharded = row["slab_spec"] is not None \
-                and "mp" in row["slab_spec"]
-            row["plane_sharded"] = sharded
-            ok = ok and sharded and row["decode_path"] == "tp_fused"
-        del row["tokens"]           # the verdict, not the transcript
-        rows.append(row)
+    for mode in modes:
+        fused = mode == "fused"
+        for tp in degrees:
+            row, eng = run_degree(0, tp, prompts, args.slots,
+                                  args.new, fused=fused)
+            if base_tokens is None:
+                base_tokens = row["tokens"]
+                row["parity_vs_tp1"] = True
+            else:
+                # cross-mode parity: every (mode, degree) run must match
+                # the FIRST run's transcript — same model, same prompts
+                row["parity_vs_tp1"] = row["tokens"] == base_tokens
+            row["drained"] = row.pop("finished") == args.requests
+            ok = ok and row["drained"] and row["parity_vs_tp1"]
+            # the fused-TP leg must actually engage: a silent fallback
+            # is a verdict failure, not a quieter row
+            want = {("composed", False): "unfused",
+                    ("composed", True): "tp_fused",
+                    ("fused", False): "fused",
+                    ("fused", True): "tp_fused_block"}[(mode, tp > 1)]
+            row["path_ok"] = row["decode_path"] == want
+            ok = ok and row["path_ok"]
+            if tp > 1:
+                sharded = row["slab_spec"] is not None \
+                    and "mp" in row["slab_spec"]
+                row["plane_sharded"] = sharded
+                ok = ok and sharded
+            del row["tokens"]       # the verdict, not the transcript
+            rows.append(row)
     verdict = {"ok": ok, "rows": rows,
                "config": f"slots{args.slots}-reqs{args.requests}"
                          f"-new{args.new}"}

@@ -155,17 +155,23 @@ def _kc_ok(ev):
     kernels/decode_block.py against the composed per-op decode step),
     the evidence the ROADMAP names for the hbm_bw_util ceiling.
 
-    ISSUE 9 bumps it to table_version >= 4: the v4 table adds the
+    ISSUE 9 bumped it to table_version >= 4: the v4 table adds the
     tensor-parallel collective-fusion row (``serving_tp_collective`` —
     ring-overlapped vs serialized collective matmul,
     kernels/collective_matmul.py; a single-chip slice records the skip
-    explicitly).  Requiring v4 makes the watchdog recapture v3 tables
-    next time a chip — ideally a pod slice — is reachable."""
+    explicitly).
+
+    ISSUE 12 bumps it to table_version >= 5: the v5 table adds the
+    sharded decode-block rows (``decode_block_tp{2,4}`` — the Pallas
+    block with in-kernel ring collectives, kernels/decode_block_tp.py,
+    against the composed compute-collective layer; a too-small slice
+    records the skip explicitly).  Requiring v5 makes the watchdog
+    recapture v4 tables next time a pod slice is reachable."""
     kc = ev.get("kernel_compare") if ev else None
     return (_kc_structural(ev)
             and isinstance(kc, dict)
             and kc.get("timing") == "scan-chained"
-            and kc.get("table_version", 1) >= 4)
+            and kc.get("table_version", 1) >= 5)
 
 
 def _is_full(ev):
@@ -573,7 +579,11 @@ def _kernel_compare(budget_s, seq=2048):
         #      overlapped ring vs serialized collective matmul; on a
         #      single-chip slice the row records the skip so the
         #      watchdog recaptures on a pod slice)
-        "table_version": 4,
+        # v5: + sharded decode-block rows (ISSUE 12 — the Pallas block
+        #      with the ring collectives riding its tile dots vs the
+        #      composed compute-collective layer, per tp degree; a
+        #      too-small slice records the skip)
+        "table_version": 5,
         "routing": "empirical per-shape table (paddle_tpu/kernels/"
                    "routing.py); default column = the router's pick",
         # VERDICT r2 item 7 tick-cost note (kept for the judge): the fused
@@ -818,6 +828,27 @@ def _kernel_compare(budget_s, seq=2048):
             min(len(jax.devices()), 8))
     except Exception as e:
         res["serving_tp_collective"] = {"error": repr(e)[-300:]}
+
+    # ---- v5: sharded decode-block (ISSUE 12) — the Pallas block whose
+    # entry/exit ring collectives ride its tile dots vs the composed
+    # compute-collective layer, per tp degree over the visible chips.
+    # Same own-schema posture as serving_tp_collective (multi-device
+    # program: the scan-chain/routed-default columns don't apply); a
+    # too-small slice records the skip so the watchdog recaptures on a
+    # pod slice.
+    ndev = len(jax.devices())
+    for tpd in (2, 4):
+        name = f"decode_block_tp{tpd}"
+        if left() < 45:
+            res["truncated"] = "budget"
+            return res
+        if tpd > ndev:
+            res[name] = {"skipped": f"{ndev} device(s) visible"}
+            continue
+        try:
+            res[name] = _bench._decode_block_tp_compare(tpd)
+        except Exception as e:
+            res[name] = {"error": repr(e)[-300:]}
     return res
 
 

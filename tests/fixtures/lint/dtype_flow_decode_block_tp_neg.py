@@ -1,0 +1,27 @@
+"""dtype-flow negative for the decode_block_tp signatures: widened
+reductions and f32-preferred contractions downstream of the sharded
+layer stay silent, as does an f32 residual stream."""
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block_tp
+
+
+def layer_energy(pk, pv, pos, blk, arch, plan):
+    x_s = jnp.zeros((2, 64), jnp.bfloat16)
+    y, k2, v2 = paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer(
+        x_s, pk, pv, pos, blk, arch, None, "mp", 2, plan)
+    total = jnp.sum(y, dtype=jnp.float32)          # widened reduce
+    logits = jax.lax.dot_general(
+        y, pk.reshape(64, -1).astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # f32-preferred dot
+    return total, logits
+
+
+def f32_path(pk, pv, pos, blk, arch, plan):
+    x_s = jnp.zeros((2, 64), jnp.float32)
+    y, k2, v2 = paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer(
+        x_s, pk, pv, pos, blk, arch, None, "mp", 2, plan)
+    return jnp.sum(y)                              # f32 reduce: fine

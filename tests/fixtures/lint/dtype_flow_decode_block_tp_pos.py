@@ -1,0 +1,25 @@
+"""dtype-flow positives THROUGH the decode_block_tp signatures: the
+registered summaries carry the slot-sharded activation's dtype onto the
+sharded layer's outputs, so 16-bit accumulation hazards downstream are
+provable.  Two planted bugs: a bf16 sum of the sharded layer output
+without a widening dtype=, and a bf16 @-contraction of the ring-exit
+output."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block_tp
+
+
+def layer_energy(pk, pv, pos, blk, arch, plan):
+    x_s = jnp.zeros((2, 64), jnp.bfloat16)
+    y, k2, v2 = paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer(
+        x_s, pk, pv, pos, blk, arch, None, "mp", 2, plan)
+    return jnp.sum(y)                     # 1: bf16 accumulation
+
+
+def exit_logits(w, head):
+    y = jnp.zeros((4, 64), jnp.bfloat16)
+    o = paddle_tpu.kernels.decode_block_tp.ring_exit_matmul(
+        y, w, "mp", 2)
+    head16 = head.astype(jnp.bfloat16)
+    return o @ head16                     # 2: bf16 @ contraction

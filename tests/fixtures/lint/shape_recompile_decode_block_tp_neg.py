@@ -1,0 +1,25 @@
+"""recompile-shape negative for the decode_block_tp signatures: the TP
+decode body's real usage pattern — fixed-shape threading of the
+returned ``(x_s', pk', pv')`` triple, static slicing of the ring-entry
+output into the per-device q/k/v column blocks, shape-derived reshapes
+— stays silent."""
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block_tp
+
+
+@jax.jit
+def decode_layer(x_s, pk, pv, pos, blk, arch, plan):
+    y, k2, v2 = paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer(
+        x_s, pk, pv, pos, blk, arch, None, "mp", 2, plan)
+    b = y.shape[0]
+    return y.reshape(b, -1), k2, v2       # shape-derived: static
+
+
+@jax.jit
+def entry_split(h, w, b):
+    qkv = paddle_tpu.kernels.decode_block_tp.ring_entry_matmul(
+        h, w, b, "mp", 2)
+    return qkv[:, :64], qkv[:, 64:]       # static column split

@@ -1,0 +1,25 @@
+"""recompile-shape positives THROUGH the decode_block_tp signatures:
+the registered summaries return ``(x_s', pk', pv')`` for the sharded
+layer and the ring-matmul output arrays with the inputs' tracedness, so
+hazards on the sharded kernels' OUTPUTS are provable at the call site.
+Two planted violations: a boolean-mask index on the returned local slab
+shard, and a traced slice bound on the ring-entry output."""
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.kernels.decode_block_tp
+
+
+@jax.jit
+def live_rows(x_s, pk, pv, pos, blk, arch, plan):
+    y, k2, v2 = paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer(
+        x_s, pk, pv, pos, blk, arch, None, "mp", 2, plan)
+    return k2[k2 > 0]                     # 1: boolean-mask on the slab
+
+
+@jax.jit
+def head_of(h, w, b, n):
+    qkv = paddle_tpu.kernels.decode_block_tp.ring_entry_matmul(
+        h, w, b, "mp", 2)
+    return qkv[:n]                        # 2: traced slice width

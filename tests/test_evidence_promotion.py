@@ -49,10 +49,13 @@ V2 = _rows(6, timing="scan-chained", table_version=2)
 # ISSUE 7 format (fused-vs-unfused decode_block_* rows) — demoted to
 # "needs refresh" by ISSUE 9's v4 bump (tensor-parallel collective row)
 V3 = _rows(6, timing="scan-chained", table_version=3)
-# honest complete: scan-chained AND table_version >= 4 (the ISSUE 9
-# format with the serving_tp_collective row)
+# ISSUE 9 format (serving_tp_collective row) — demoted to "needs
+# refresh" by ISSUE 12's v5 bump (sharded decode-block rows)
 V4 = _rows(6, timing="scan-chained", table_version=4)
-V4_PARTIAL = _rows(3, timing="scan-chained", table_version=4,
+# honest complete: scan-chained AND table_version >= 5 (the ISSUE 12
+# format with the decode_block_tp{2,4} rows)
+V5 = _rows(6, timing="scan-chained", table_version=5)
+V5_PARTIAL = _rows(3, timing="scan-chained", table_version=5,
                    truncated="budget")
 # r4 secondary format: training rows must carry {config, mfu}
 SEC = {m: {"step_ms": 5.0, "items_per_sec": 1.0, "config": "b1-test",
@@ -68,9 +71,9 @@ def _promote(eb):
         return json.load(f)
 
 
-def test_v4_table_upgrades_over_v1(tmp_path):
+def test_v5_table_upgrades_over_v1(tmp_path):
     eb = _bench(tmp_path, canonical=_good(kc=V1))
-    eb.EV = _good(kc=V4)
+    eb.EV = _good(kc=V5)
     out = _promote(eb)
     assert out["kernel_compare"].get("timing") == "scan-chained"
     assert eb._is_full(out)
@@ -81,7 +84,7 @@ def test_honest_partial_not_replaced_by_dispatch_complete(tmp_path):
     the old per-dispatch table (documented invalid) may NOT overwrite
     them via carry."""
     eb = _bench(tmp_path, canonical=_good(kc=V1))
-    eb.EV = _good(kc=V4_PARTIAL)
+    eb.EV = _good(kc=V5_PARTIAL)
     out = _promote(eb)
     assert out["kernel_compare"].get("timing") == "scan-chained"
     assert "truncated" in out["kernel_compare"]
@@ -97,8 +100,8 @@ def test_zero_row_run_carries_old_table(tmp_path):
 
 def test_scan_chained_complete_carries_over_new_partial(tmp_path):
     """Old HONEST-complete beats a fresh truncated run: carry."""
-    eb = _bench(tmp_path, canonical=_good(kc=V4))
-    eb.EV = _good(kc=V4_PARTIAL)
+    eb = _bench(tmp_path, canonical=_good(kc=V5))
+    eb.EV = _good(kc=V5_PARTIAL)
     out = _promote(eb)
     assert "truncated" not in out["kernel_compare"]
     assert len([v for v in out["kernel_compare"].values()
@@ -106,7 +109,7 @@ def test_scan_chained_complete_carries_over_new_partial(tmp_path):
 
 
 def test_lower_mfu_does_not_promote(tmp_path):
-    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V4, sec=SEC))
+    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V5, sec=SEC))
     eb.EV = _good(mfu=0.40)
     out = _promote(eb)
     assert out["mfu"] == 0.63
@@ -115,7 +118,7 @@ def test_lower_mfu_does_not_promote(tmp_path):
 def test_higher_mfu_promotes_and_carries_sections(tmp_path):
     """The b8-experiment shape: a bench-only higher-MFU run keeps the
     old kernel table AND secondary."""
-    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V4, sec=SEC))
+    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V5, sec=SEC))
     eb.EV = _good(mfu=0.70)
     out = _promote(eb)
     assert out["mfu"] == 0.70
@@ -125,8 +128,8 @@ def test_higher_mfu_promotes_and_carries_sections(tmp_path):
 
 
 def test_new_secondary_promotes_at_comparable_mfu(tmp_path):
-    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V4))
-    eb.EV = _good(mfu=0.60, kc=V4, sec=SEC)
+    eb = _bench(tmp_path, canonical=_good(mfu=0.63, kc=V5))
+    eb.EV = _good(mfu=0.60, kc=V5, sec=SEC)
     out = _promote(eb)
     assert eb._sec_ok(out)
 
@@ -150,18 +153,20 @@ def test_v1_scan_chained_table_no_longer_counts_as_ok(tmp_path):
     eb = _bench(tmp_path)
     old_format = _good(kc=_rows(6, timing="scan-chained"))
     assert not eb._kc_ok(old_format)
-    assert eb._kc_ok(_good(kc=V4))
+    assert eb._kc_ok(_good(kc=V5))
 
 
-def test_v2_and_v3_tables_no_longer_count_as_ok(tmp_path):
-    """ISSUE 7/9 gates: a v2 table (no decode_block_* rows) and a v3
-    table (no serving_tp_collective row) both read as not-ok, so the
-    watchdog recaptures the kernel table — with the new rows — next
-    time a chip is reachable."""
+def test_v2_v3_v4_tables_no_longer_count_as_ok(tmp_path):
+    """ISSUE 7/9/12 gates: a v2 table (no decode_block_* rows), a v3
+    table (no serving_tp_collective row) and a v4 table (no sharded
+    decode_block_tp{2,4} rows) all read as not-ok, so the watchdog
+    recaptures the kernel table — with the new rows — next time a
+    chip is reachable."""
     eb = _bench(tmp_path)
     assert not eb._kc_ok(_good(kc=V2))
     assert not eb._kc_ok(_good(kc=V3))
-    assert eb._kc_ok(_good(kc=V4))
+    assert not eb._kc_ok(_good(kc=V4))
+    assert eb._kc_ok(_good(kc=V5))
 
 
 def test_serving_tp_rows_carry_over_skipping_run(tmp_path):
@@ -173,14 +178,14 @@ def test_serving_tp_rows_carry_over_skipping_run(tmp_path):
                     "parity_vs_tp1": True}],
           "config": "pod-slice"}
     eb = _bench(tmp_path,
-                canonical=dict(_good(mfu=0.63, kc=V4, sec=SEC),
+                canonical=dict(_good(mfu=0.63, kc=V5, sec=SEC),
                                serving_tp=tp))
     eb.EV = _good(mfu=0.70)                      # no serving_tp at all
     out = _promote(eb)
     assert out["mfu"] == 0.70
     assert out["serving_tp"]["rows"] == tp["rows"]
     eb2 = _bench(tmp_path,
-                 canonical=dict(_good(mfu=0.63, kc=V4, sec=SEC),
+                 canonical=dict(_good(mfu=0.63, kc=V5, sec=SEC),
                                 serving_tp=tp))
     eb2.EV = dict(_good(mfu=0.70), serving_tp={"error": "boom"})
     out2 = _promote(eb2)

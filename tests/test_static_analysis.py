@@ -620,6 +620,61 @@ def test_dtype_flow_decode_block_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_recompile_shape_through_decode_block_tp_signature():
+    """ISSUE 12: the sharded decode-block signatures flow
+    ``(x_s', pk', pv')`` / the ring-matmul outputs through call sites,
+    so fixed-shape hazards on the SHARDED kernels' outputs are provable
+    — exactly 2 planted (bool-mask on the returned slab shard, traced
+    slice bound on the ring-entry output)."""
+    res = run_rule("shape_recompile_decode_block_tp_pos.py",
+                   "recompile-shape")
+    found = only_rule(res, "recompile-shape")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "boolean-mask" in msgs
+    assert "slice bound" in msgs
+
+
+def test_recompile_shape_decode_block_tp_negative():
+    """The TP decode body's real sharded-block usage — fixed-shape
+    triple threading, static q/k/v column splits of the ring-entry
+    output — stays silent."""
+    res = run_rule("shape_recompile_decode_block_tp_neg.py",
+                   "recompile-shape")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_dtype_flow_through_decode_block_tp_signature():
+    """The decode_block_tp summaries carry the slot-sharded activation
+    dtype onto the outputs: exactly 2 planted bf16 accumulation bugs
+    (bf16 sum of the sharded layer output, bf16 @-contraction of the
+    ring-exit output)."""
+    res = run_rule("dtype_flow_decode_block_tp_pos.py", "dtype-flow")
+    found = only_rule(res, "dtype-flow")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "accumulates in bfloat16" in msgs
+    assert "@ on bfloat16" in msgs
+
+
+def test_dtype_flow_decode_block_tp_negative():
+    res = run_rule("dtype_flow_decode_block_tp_neg.py", "dtype-flow")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_decode_block_tp_module_in_sharding_rule_scope():
+    """kernels/decode_block_tp.py drives ppermute rings inside
+    shard_map bodies, so the sharding-consistency rule must SCAN it
+    clean rather than skip it: its collectives take the axis name as a
+    parameter (the caller's contract — serving/tp.py binds 'mp'), so
+    the module itself declares no mesh and must carry zero findings
+    under the rule."""
+    tp_py = REPO_ROOT / "paddle_tpu" / "kernels" / "decode_block_tp.py"
+    res = run_analysis([str(tp_py)], root=str(REPO_ROOT),
+                       rules=["sharding-consistency"])
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_dtype_flow_default_hot_paths_cover_kernels_and_optimizer():
     import fnmatch
     from paddle_tpu.tools.analysis.checkers.dtype_flow import \
@@ -792,7 +847,11 @@ def test_repo_kernel_signatures_shipped():
                 "paddle_tpu.kernels.decode_block.decode_block_layer",
                 "paddle_tpu.kernels.decode_block.decode_block_attn",
                 "paddle_tpu.kernels.decode_block.decode_block_mlp",
-                "paddle_tpu.kernels.decode_block.decode_block_reference"):
+                "paddle_tpu.kernels.decode_block.decode_block_reference",
+                "paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer",
+                "paddle_tpu.kernels.decode_block_tp.decode_block_attn_tp",
+                "paddle_tpu.kernels.decode_block_tp.ring_entry_matmul",
+                "paddle_tpu.kernels.decode_block_tp.ring_exit_matmul"):
         assert key in SIGNATURES, key
 
 

@@ -336,6 +336,98 @@ def test_tp_quarantine_rebuilds_sharded_plane():
     assert eng.decode_path == "tp_fused"
 
 
+def test_tp_fused_block_quarantine_rebuild():
+    """TP chaos on the SHARDED Pallas decode block (ISSUE 12): a spent
+    retry budget on a ``tp_fused_block`` engine quarantines, the
+    rebuilt plane still decodes through the sharded Pallas block
+    (degradation is for fused-path faults — a core step fault must not
+    silently demote the path), slabs come back sharded on the kv-head
+    axis, the total-accounting invariant holds, queued work re-serves
+    to parity with a clean tp=1 engine, and the compile pin stays ONE
+    decode per plane."""
+    import paddle_tpu
+    paddle_tpu.seed(13)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    paddle_tpu.seed(13)
+    oracle = GPTForCausalLM(gpt_tiny())
+    oracle.eval()
+    eng, faults = make_engine(model, retries=2, num_slots=2,
+                              tensor_parallel=2, fused_decode=True)
+    assert eng.decode_path == "tp_fused_block"
+    prompts = _prompts(12, (3, 6, 5, 9, 7))
+    # a fault in the DECODE phase of a fused-path engine is ladder
+    # territory by design (composed fallback exists), so quarantine
+    # must come from a CORE phase: fail eviction — it runs after the
+    # step's fault-phase window closes — three times, spending the
+    # retry budget
+    real_evict = eng.core._evict_finished
+    state = {"calls": 0}
+
+    def flaky_evict():
+        state["calls"] += 1
+        if 2 <= state["calls"] <= 4:
+            raise RuntimeError("injected core fault (eviction)")
+        return real_evict()
+
+    eng.core._evict_finished = flaky_evict
+    rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_complete(400)
+    assert eng.metrics_dict()["quarantines"] == 1
+    outs = [eng.result(r) for r in rids]
+    # in-flight work that already emitted everything settles finished
+    # (PR 8 semantics); anything mid-stream fails terminally; queued
+    # work re-serves — and every finished transcript matches the oracle
+    assert all(o.status in TERMINAL for o in outs)
+    assert sum(o.status == "finished" for o in outs) >= 3
+    for o, p in zip(outs, prompts):
+        if o.status == "finished":
+            np.testing.assert_array_equal(o.tokens, _want(oracle, p, 4))
+    assert_accounting(eng, rids)
+    assert eng.health.state == "healthy"
+    core = eng.core
+    assert eng.decode_path == "tp_fused_block"
+    assert tuple(core.pool.ks[0].sharding.spec) == \
+        (None, None, "mp", None)
+    assert core.trace_counts["decode"] == 2   # ONE per device plane
+
+
+def test_tp_fused_block_ladder_degrades_to_composed():
+    """A fault attributed to the SHARDED fused decode path feeds the
+    degradation ladder, and the rung lands on the composed
+    compute-collective program (``tp_fused``) — the same order as the
+    resolve chain — not all the way down to the GSPMD decode; the
+    engine keeps serving through it."""
+    import paddle_tpu
+    paddle_tpu.seed(14)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    eng, faults = make_engine(model, retries=3, ladder=1, num_slots=2,
+                              tensor_parallel=2, fused_decode=True)
+    assert eng.decode_path == "tp_fused_block"
+    prompts = _prompts(15, (3, 6, 4))
+    rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.step()                         # admit + first prefills
+    # fail the decode dispatch itself once: the watchdog attributes it
+    # to the fused path (ladder threshold 1 -> immediate demotion)
+    real_dispatch = eng.core._decode_dispatch
+    calls = {"n": 0}
+
+    def flaky_dispatch():
+        if calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("injected fused dispatch fault")
+        return real_dispatch()
+
+    eng.core._decode_dispatch = flaky_dispatch
+    eng.run_until_complete(400)
+    assert eng.decode_path == "tp_fused"
+    assert eng.decode_fallback_reason.startswith("degraded:")
+    outs = [eng.result(r) for r in rids]
+    assert all(o.status == "finished" for o in outs)
+    assert_accounting(eng, rids)
+
+
 def test_persistent_fault_opens_circuit(gpt):
     eng, faults = make_engine(gpt, retries=1, circuit=2, num_slots=2)
     prompts = _prompts(8, (3, 5, 7, 4))

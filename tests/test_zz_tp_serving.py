@@ -12,10 +12,11 @@ The load-bearing contracts:
     collective forms and the dense single-device reference;
   * the compile-count pin survives the mesh: {chunk} + pow2 buckets +
     ONE decode + ONE gather + ONE scatter per plane, at any tp;
-  * the fallback matrix: Pallas decode-block refuses under TP with
-    ``decode_fallback_reason="tensor_parallel"``; an unsupported shape
-    (num_slots not divisible) falls back to the composed GSPMD decode
-    and KEEPS SERVING with parity.
+  * the fallback matrix: the Pallas decode-block leg under TP is
+    legality-gated (ISSUE 12 — ``tp_fused_block`` engages at legal
+    shapes, tests/test_zz_decode_block_tp.py holds its parity matrix);
+    an unsupported shape (num_slots not divisible) falls back to the
+    composed GSPMD decode and KEEPS SERVING with parity.
 
 zz-prefixed for the same reason as test_zz_decode_block /
 test_zz_bench_projection: this file drives shard_map + ppermute rings on
@@ -214,20 +215,36 @@ def test_llama_tp4_rejects_on_kv_heads():
 
 # ----------------------------------------------- fallback matrix / pin
 
-def test_pallas_fused_decode_refuses_under_tp():
-    """fused_decode=True on a TP mesh: the Pallas decode-block leg of
-    the resolve chain refuses with reason "tensor_parallel", the engine
-    resolves the compute-collective program instead, and serving
-    continues (satellite: the composed-path-keeps-serving contract)."""
+def test_pallas_fused_decode_conditional_under_tp():
+    """fused_decode=True on a TP mesh (ISSUE 12): the hard
+    "tensor_parallel" refusal is gone — at a legal shape the resolve
+    chain ACCEPTS and the engine decodes through the sharded Pallas
+    block (``tp_fused_block``) with token parity; an ILLEGAL shape
+    (kv-heads not tiling the mesh is checked at construction, so probe
+    the resolver directly) refuses with the real legality reason and
+    the engine keeps serving on the next rung."""
     from paddle_tpu.kernels.decode_block import resolve_fused_decode
     m = _fresh(lambda: GPTForCausalLM(gpt_tiny()))
     ok, reason = resolve_fused_decode(m, batch=4, kv_len=128, tp=2)
-    assert (ok, reason) == (False, "tensor_parallel")
+    assert (ok, reason) == (True, None)
     toks, eng = _serve(m, 2, fused_decode=True)
-    assert eng.decode_path == "tp_fused"
-    assert eng.decode_fallback_reason == "tensor_parallel"
+    assert eng.decode_path == "tp_fused_block"
+    assert eng.decode_fallback_reason is None
     base, _ = _serve(_fresh(lambda: GPTForCausalLM(gpt_tiny())), 1)
     assert toks == base
+    # illegal: batch 3 cannot slot-shard over 2 devices — refusal names
+    # the real check, and the engine's chain lands on the composed
+    # compute-collective program... which ALSO refuses at num_slots=3,
+    # so the GSPMD decode serves (the chain's last rung)
+    ok, reason = resolve_fused_decode(m, batch=3, kv_len=128, tp=2)
+    assert not ok and "batch 3" in reason
+    m2 = _fresh(lambda: GPTForCausalLM(gpt_tiny()))
+    eng2 = ServingEngine(m2, num_slots=3, tensor_parallel=2,
+                         fused_decode=True)
+    assert eng2.decode_path == "unfused"
+    assert "batch 3" in eng2.decode_fallback_reason
+    outs = eng2.serve_batch(_prompts(lengths=(4, 9)), max_new_tokens=4)
+    assert all(o.finished for o in outs)
 
 
 def test_tp_unsupported_shape_falls_back_and_serves():
@@ -311,12 +328,19 @@ def test_multichip_serving_smoke_artifacts(tmp_path):
     with open(os.path.join(out, "serving_tp.json")) as f:
         v = json.load(f)
     assert v["ok"]
-    assert [r["tp"] for r in v["rows"]] == [1, 2, 4]
+    # ISSUE 12: both modes run — composed (tp_fused at tp > 1) and
+    # fused (the sharded Pallas block, tp_fused_block), with CROSS-mode
+    # token parity against the composed tp=1 baseline
+    assert [(r["mode"], r["tp"]) for r in v["rows"]] == \
+        [("composed", 1), ("composed", 2), ("composed", 4),
+         ("fused", 1), ("fused", 2), ("fused", 4)]
     for r in v["rows"]:
-        assert r["parity_vs_tp1"] and r["drained"]
+        assert r["parity_vs_tp1"] and r["drained"] and r["path_ok"]
         if r["tp"] > 1:
             assert r["plane_sharded"]
-            assert r["decode_path"] == "tp_fused"
+            assert r["decode_path"] == ("tp_fused_block"
+                                        if r["mode"] == "fused"
+                                        else "tp_fused")
             assert r["collective_s"]["count"] > 0
     prom = open(os.path.join(out, "metrics.prom")).read()
     assert "serving_tp_degree" in prom
