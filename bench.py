@@ -1205,12 +1205,24 @@ def _serving_slo_bench(model, smoke=False):
     burst injected on replica 0 mid-run sized to force a QUARANTINE (the
     router fails the casualties over to replica 1).  Per pass: fleet
     p50/p99 TTFT + per-token latency (the shared registry aggregates
-    both replicas), goodput (requests completed / submitted, SLO
-    rejections and failures both count against it), failover and
-    prefix-affinity counters.  The no-fault vs replica-fault delta IS
-    the robustness tax at fleet scope."""
+    both replicas), CHAT-class TTFT p99 (the SLO the trace exists to
+    protect), goodput (requests completed / submitted, SLO rejections
+    and failures both count against it), failover and prefix-affinity
+    counters.  The no-fault vs replica-fault delta IS the robustness
+    tax at fleet scope.
+
+    The DISAGGREGATED pass (ISSUE 13) replays the same trace on a
+    role-split fleet of the same engine count — one PREFILL replica
+    (long-prompt RAG prefills land here and migrate to the decode side
+    through the KV handoff) plus one DECODE replica, with an attached
+    autoscaler allowed to spawn one more decode replica on queue
+    pressure.  The win to read: ``chat_ttft_p99_ms`` disaggregated vs
+    unified — chat first tokens no longer queue behind RAG prefills —
+    with ``handoffs_*`` and ``autoscaler_*`` counts showing the
+    machinery (spawn/retire events land in the shared registry)."""
     from paddle_tpu.obs import MetricsRegistry, Tracer
-    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+    from paddle_tpu.serving import (Autoscaler, FaultInjector,
+                                    FaultToleranceConfig,
                                     RequestRejected, Router,
                                     ServingEngine)
 
@@ -1238,11 +1250,15 @@ def _serving_slo_bench(model, smoke=False):
             for _ in range(chat_n)]
     rag = [rs.randint(0, vocab, (rag_len,)) for _ in range(rag_n)]
     batch = [rs.randint(0, vocab, (int(L),)) for L in batch_lens]
+    # the disaggregated role split: prompts at/above this length take
+    # the prefill plane — sits between the chat and RAG lengths so RAG
+    # prefills migrate while chat stays on the decode replicas
+    prefill_threshold = (chat_prefix + chat_suffix + rag_len) // 2
+    ft = FaultToleranceConfig(max_step_retries=retries,
+                              backoff_base_s=0.0)
 
     def build_fleet(faulted):
         registry, tracer = MetricsRegistry(), Tracer()
-        ft = FaultToleranceConfig(max_step_retries=retries,
-                                  backoff_base_s=0.0)
         inj = FaultInjector() if faulted else None
         engines = [ServingEngine(model, num_slots=slots, min_bucket=8,
                                  block_len=block_len,
@@ -1252,41 +1268,81 @@ def _serving_slo_bench(model, smoke=False):
                    for i in range(2)]
         return Router(engines, registry=registry, tracer=tracer), inj
 
-    def replay(router):
-        """The bursty trace: chat burst -> steps -> RAG burst -> steps
-        -> offline batch dump -> drain.  Returns (fleet ids, submitted,
-        rejected) — rejected submissions raise and count against
-        goodput."""
-        fids, submitted, rejected = [], 0, 0
+    def build_disagg_fleet():
+        """Same engine count as the unified fleet, role-split: one
+        prefill + one decode replica, with the autoscaler allowed to
+        spawn a second decode replica under queue pressure.  Spawned
+        replicas warm up BEHIND the gate (a short serve compiles their
+        programs before they become routable); scale-down is disabled
+        so the warmup pass's spawn carries into the measured pass
+        instead of compiling mid-measure."""
+        registry, tracer = MetricsRegistry(), Tracer()
+        mk = lambda role: ServingEngine(
+            model, num_slots=slots, min_bucket=8, block_len=block_len,
+            fault_tolerance=ft, registry=registry, tracer=tracer,
+            role=role)
+        router = Router([mk("prefill"), mk("decode")],
+                        prefill_threshold=prefill_threshold,
+                        registry=registry, tracer=tracer)
 
-        def sub(p, new, **kw):
+        def warm(eng):
+            eng.serve_batch([chat[0]], max_new_tokens=2)
+            eng.metrics.reset()
+        Autoscaler(router, lambda: mk("decode"), warmup_fn=warm,
+                   min_decode=1, max_decode=2,
+                   scale_up_depth=max(slots, 4), scale_down_depth=-1,
+                   hysteresis_steps=2, cooldown_steps=8)
+        return router
+
+    def replay(router):
+        """The bursty trace: first chat wave -> long-prompt RAG burst
+        -> SECOND chat wave (these are the requests whose TTFT a
+        unified fleet blows: they queue behind the RAG prefills) ->
+        offline batch dump -> drain.  Returns (fleet ids, chat ids,
+        submitted, rejected) — rejected submissions raise and count
+        against goodput."""
+        fids, chat_ids, submitted, rejected = [], [], 0, 0
+
+        def sub(p, new, cls=None, **kw):
             nonlocal submitted, rejected
             submitted += 1
             try:
-                fids.append(router.submit(p, max_new_tokens=new, **kw))
+                fid = router.submit(p, max_new_tokens=new, **kw)
             except RequestRejected:
                 rejected += 1
-        for p in chat:
-            sub(p, chat_new, ttft_deadline_s=ttft_deadline)
+                return
+            fids.append(fid)
+            if cls is not None:
+                cls.append(fid)
+        for p in chat[::2]:
+            sub(p, chat_new, cls=chat_ids,
+                ttft_deadline_s=ttft_deadline)
         for _ in range(2):
             router.step()
         for p in rag:
             sub(p, rag_new)
+        router.step()
+        for p in chat[1::2]:
+            sub(p, chat_new, cls=chat_ids,
+                ttft_deadline_s=ttft_deadline)
         for _ in range(2):
             router.step()
         for p in batch:
             sub(p, batch_new)
         router.run_until_complete(max_steps=50000)
-        return fids, submitted, rejected
+        return fids, chat_ids, submitted, rejected
 
-    def run(faulted):
-        router, inj = build_fleet(faulted)
+    def measure(router, inj, fault_label=None):
+        """One warmed, reset, measured replay — shared by the unified
+        and disaggregated passes."""
         replay(router)                     # warmup: compile + warm trees
         for h in router.replicas:
             h.engine.metrics.reset()
         rm = router.metrics
         for inst in (rm.c_routed, rm.c_hit_tokens, rm.c_failovers,
-                     rm.c_failover_exhausted, rm.c_rejected):
+                     rm.c_failover_exhausted, rm.c_rejected,
+                     rm.c_handoff_staged, rm.c_handoff_committed,
+                     rm.c_handoff_aborted, rm.c_handoff_blocks):
             inst.reset()                   # row = the measured pass only
         for fid in list(router._requests):
             router.purge(fid)
@@ -1294,7 +1350,7 @@ def _serving_slo_bench(model, smoke=False):
             inj.enable("step", at=fault_at, times=retries + 1)
         t0 = time.perf_counter()
         try:
-            fids, submitted, rejected = replay(router)
+            fids, chat_ids, submitted, rejected = replay(router)
         finally:
             if inj is not None:
                 inj.disable("step")
@@ -1305,6 +1361,8 @@ def _serving_slo_bench(model, smoke=False):
         deadline = sum(1 for o in outs
                        if o.status == "deadline_exceeded")
         total_tokens = sum(len(o.tokens) for o in outs)
+        chat_ttfts = [router.result(f).ttft_s for f in chat_ids]
+        chat_ttfts = [t for t in chat_ttfts if t is not None]
         snap = router.registry.snapshot()
         ttft = snap.get("serving.ttft_s", {})
         tpot = snap.get("serving.tpot_s", {})
@@ -1324,26 +1382,56 @@ def _serving_slo_bench(model, smoke=False):
             "tokens_per_sec": round(total_tokens / wall, 1),
             "ttft_p50_ms": q(ttft, "p50"),
             "ttft_p99_ms": q(ttft, "p99"),
+            # the SLO class on its own: chat first-token p99 straight
+            # from the per-request outputs (the disagg-vs-unified
+            # comparison the role split exists for)
+            "chat_ttft_p99_ms": (round(float(np.percentile(
+                chat_ttfts, 99)) * 1e3, 2) if chat_ttfts else None),
             "tpot_p50_ms": q(tpot, "p50"),
             "tpot_p99_ms": q(tpot, "p99"),
             "prefix_hit_tokens": rm["prefix_hit_tokens"],
             "failovers": rm["failovers"],
             "wall_s": round(wall, 2),
         }
-        if inj is not None:
-            row["fault"] = (f"step@{fault_at} x{retries + 1} on "
-                            f"replica 0 (-> quarantine)")
+        if fault_label is not None:
+            row["fault"] = fault_label
             row["quarantines"] = sum(
                 h.engine.core.health.quarantine_count
                 for h in router.replicas)
         return row
 
+    def run(faulted):
+        router, inj = build_fleet(faulted)
+        label = (f"step@{fault_at} x{retries + 1} on replica 0 "
+                 f"(-> quarantine)") if faulted else None
+        return measure(router, inj, fault_label=label)
+
+    def run_disaggregated():
+        router = build_disagg_fleet()
+        row = measure(router, None)
+        rm = router.metrics_dict()
+        snap = router.registry.snapshot()
+        row.update({
+            "roles": rm["roles"],
+            "replicas": len(router.replicas),
+            "handoffs_committed": rm["handoffs_committed"],
+            "handoffs_aborted": rm["handoffs_aborted"],
+            "handoff_blocks_moved": rm["handoff_blocks_moved"],
+            # spawn/retire visibility in the SHARED registry — the
+            # acceptance criterion's "events visible" leg (the discrete
+            # autoscaler_* events ride the router tracer lane)
+            "autoscaler_spawns": snap.get("autoscaler.spawns", 0),
+            "autoscaler_retires": snap.get("autoscaler.retires", 0),
+        })
+        return row
+
     out = {
         "no_fault": run(False),
         "replica_fault": run(True),
+        "disaggregated": run_disaggregated(),
         "config": (f"replicas2-slots{slots}-chat{chat_n}-rag{rag_n}-"
                    f"batch{batch_n}-prefix{chat_prefix}-"
-                   f"block{block_len}"),
+                   f"block{block_len}-prefillthresh{prefill_threshold}"),
     }
     return out
 

@@ -14,10 +14,12 @@ See docs/serving.md and docs/observability.md.
 """
 
 from .api import Request, RequestOutput, SamplingParams, ServingEngine
+from .autoscaler import Autoscaler
 from .engine import EngineCore, finite_or_sentinel, sample_rows
 from .errors import EngineStalledError, RequestRejected
 from .faults import FaultError, FaultInjector
 from .fleet import fleet_accounting, replica_accounting
+from .handoff import Handoff, HandoffManager
 from .health import (DegradationLadder, EngineHealth,
                      FaultToleranceConfig)
 from .kv_pool import BlockPool, KVPool
@@ -36,4 +38,6 @@ __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            "EngineStalledError",
            # fleet tier (docs/serving.md "Fleet tier")
            "Router", "ReplicaHandle", "fleet_accounting",
-           "replica_accounting"]
+           "replica_accounting",
+           # disaggregated fleet (docs/serving.md "Disaggregated fleet")
+           "Autoscaler", "Handoff", "HandoffManager"]

@@ -97,7 +97,19 @@ class ServingEngine:
                  faults=None,
                  max_queue: Optional[int] = None,
                  tensor_parallel: int = 1,
-                 collective_fusion: bool = True):
+                 collective_fusion: bool = True,
+                 role: str = "unified"):
+        # fleet role metadata (docs/serving.md "Disaggregated fleet"):
+        # "prefill" replicas take only the router's prefill-stage work
+        # (large prefill buckets, few slots), "decode" replicas take
+        # decode-stage work (all slots), "unified" takes both.  The
+        # engine itself behaves identically — the role is the routing
+        # contract the fleet Router reads when its ``roles=`` is omitted
+        if role not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'unified', "
+                f"got {role!r}")
+        self.role = role
         # registry/tracer (paddle_tpu.obs) may be shared across engines
         # (a fleet scraping one Prometheus surface: shared instruments
         # aggregate, lanes come from per-engine blocks); default: private

@@ -1,0 +1,229 @@
+"""Drain-based decode-replica autoscaler for the fleet tier.
+
+Production decode demand breathes: a burst fills every replica's queue,
+a lull leaves silicon idle.  The :class:`Autoscaler` is the host-side
+control loop that sizes the DECODE side of a (possibly disaggregated)
+fleet against the live ``router.queue_depth`` gauge:
+
+  * **scale up** — queue depth at or above ``scale_up_depth`` for
+    ``hysteresis_steps`` CONSECUTIVE ticks spawns one decode replica via
+    the caller's ``spawn_fn`` (a zero-arg factory returning a ready
+    ``ServingEngine``).  The spawn is gated: the replica joins the
+    router's rotation ONLY after the factory (and the optional
+    ``warmup_fn``) returned successfully — a half-built replica is never
+    routable, and a spawn failure (the ``replica_spawn`` chaos point, or
+    a real construction error) leaves the router topology untouched;
+  * **scale down** — queue depth at or below ``scale_down_depth`` with
+    no fleet backlog for ``hysteresis_steps`` consecutive ticks retires
+    one AUTOSCALED decode replica (never an operator-built one, never
+    below ``min_decode`` decode-capable replicas) through the graceful
+    two-phase path: ``router.drain(i)`` stops new work immediately, and
+    once the replica reports ``drained`` it is closed and marked retired
+    (``router.retire(i)``) — in-flight requests always finish normally;
+  * **hysteresis + cooldown** — the consecutive-tick requirement plus a
+    ``cooldown_steps`` refractory period after every action stop the
+    loop from flapping on a noisy queue.
+
+``spawn``/``retire`` is a registered graftlint ``ResourcePair``
+(receiver hint ``scaler``): an autoscaled replica must eventually retire
+(or be explicitly kept), so capacity accounting cannot silently drift.
+All state is host-side; ``tick()`` is called by ``Router.step()`` once
+the autoscaler is attached (``Autoscaler(router, ...)`` attaches
+itself).  Telemetry: ``autoscaler.*`` counters/gauges plus
+``autoscaler_*`` events on the router's tracer lane
+(docs/observability.md glossary).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler:
+    """Queue-depth-driven spawn/retire loop over a
+    :class:`~paddle_tpu.serving.router.Router` (see module docstring).
+
+    ``spawn_fn()`` must return a fresh ``ServingEngine`` built onto the
+    ROUTER's shared registry/tracer (and, for token parity across the
+    fleet, the same weights as its peers).  ``warmup_fn(engine)``, when
+    given, runs after construction and before the replica becomes
+    routable — use it to pre-trace programs so a spawned replica serves
+    in steps, not compiles.
+    """
+
+    def __init__(self, router, spawn_fn: Callable, *,
+                 warmup_fn: Optional[Callable] = None,
+                 min_decode: int = 1, max_decode: int = 8,
+                 scale_up_depth: int = 8, scale_down_depth: int = 0,
+                 hysteresis_steps: int = 4, cooldown_steps: int = 16,
+                 faults=None):
+        if min_decode < 1:
+            raise ValueError("min_decode must be >= 1")
+        if max_decode < min_decode:
+            raise ValueError("max_decode must be >= min_decode")
+        if scale_up_depth <= scale_down_depth:
+            raise ValueError(
+                "scale_up_depth must exceed scale_down_depth "
+                "(overlapping thresholds would oscillate)")
+        if hysteresis_steps < 1:
+            raise ValueError("hysteresis_steps must be >= 1")
+        self.router = router
+        self.spawn_fn = spawn_fn
+        self.warmup_fn = warmup_fn
+        self.min_decode = min_decode
+        self.max_decode = max_decode
+        self.scale_up_depth = scale_up_depth
+        self.scale_down_depth = scale_down_depth
+        self.hysteresis_steps = hysteresis_steps
+        self.cooldown_steps = cooldown_steps
+        self.faults = faults            # chaos hook: replica_spawn
+        self._above = 0                 # consecutive ticks over the bar
+        self._below = 0                 # consecutive idle ticks
+        self._cooldown = 0
+        self._spawned: List[int] = []   # replica indices this loop added
+        self._retiring: List[int] = []  # draining, waiting to retire
+        m = router.metrics
+        g, c = m.registry.gauge, m.registry.counter
+        self._g_decode = g("autoscaler.decode_replicas",
+                           "decode-capable replicas in rotation "
+                           "(decode + unified, not draining/retired)")
+        self._c_spawns = c("autoscaler.spawns",
+                           "decode replicas spawned into the rotation")
+        self._c_retires = c("autoscaler.retires",
+                            "decode replicas retired via drain")
+        self._c_spawn_failures = c(
+            "autoscaler.spawn_failures",
+            "replica spawns that failed before becoming routable "
+            "(the half-built replica was never in rotation)")
+        self._lane = m.lane             # events share the router's lane
+        self._tracer = m.tracer
+        self._publish()
+        router.attach_autoscaler(self)
+
+    # ------------------------------------------------------------- sizing
+    def decode_count(self) -> int:
+        """Decode-capable replicas currently in rotation."""
+        return sum(1 for h in self.router.replicas
+                   if h.role in ("decode", "unified")
+                   and not h.draining and not h.retired)
+
+    def _publish(self) -> None:
+        self._g_decode.set(self.decode_count())
+
+    # --------------------------------------------------------------- tick
+    def tick(self) -> Optional[str]:
+        """One control iteration (the router calls this after every
+        fleet step).  Returns the action taken ("spawn" / "retire" /
+        "retired:<i>") or None — test and operator visibility."""
+        action = self._finish_retirements()
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return action
+        depth = self.router.queue_depth
+        self._above = self._above + 1 if depth >= self.scale_up_depth \
+            else 0
+        idle = depth <= self.scale_down_depth \
+            and self.router.in_flight == 0
+        self._below = self._below + 1 if idle else 0
+        if self._above >= self.hysteresis_steps \
+                and self.decode_count() < self.max_decode:
+            self._above = 0
+            self._cooldown = self.cooldown_steps
+            return "spawn" if self.spawn() is not None else action
+        if self._below >= self.hysteresis_steps and self._spawned \
+                and self.decode_count() > self.min_decode:
+            self._below = 0
+            self._cooldown = self.cooldown_steps
+            victim = self._pick_victim()
+            if victim is not None:
+                self.retire(victim)
+                return "retire"
+        return action
+
+    def _finish_retirements(self) -> Optional[str]:
+        """Close out replicas whose drain completed (second phase of
+        retire)."""
+        done = None
+        for idx in list(self._retiring):
+            if self.router.drained(idx):
+                self._retiring.remove(idx)
+                self.router.retire(idx)
+                self._publish()
+                self._tracer.event("autoscaler_retired", lane=self._lane,
+                                   replica=idx)
+                done = f"retired:{idx}"
+        return done
+
+    def _pick_victim(self) -> Optional[int]:
+        """Lightest-loaded autoscaled decode replica still in
+        rotation."""
+        live = [self.router.replicas[i] for i in self._spawned
+                if not self.router.replicas[i].draining
+                and not self.router.replicas[i].retired]
+        if not live:
+            return None
+        return min(live, key=lambda h: (h.load, h.index)).index
+
+    # ------------------------------------------------------ spawn/retire
+    def spawn(self) -> Optional[int]:
+        """Build one decode replica and add it to the rotation; returns
+        its replica index, or None when the spawn failed (the router is
+        then untouched — a half-built replica is never routable).
+        Balance with :meth:`retire` over the replica's life (registered
+        graftlint ``ResourcePair``)."""
+        engine = None
+        try:
+            if self.faults is not None:
+                self.faults.fire("replica_spawn")
+            engine = self.spawn_fn()
+            if self.warmup_fn is not None:
+                self.warmup_fn(engine)
+        except Exception as e:
+            if engine is not None:
+                # the factory succeeded but the warmup raised: the
+                # half-built engine already bound telemetry (tracer
+                # lanes, possibly a profiler source) — close it or a
+                # long-running server accumulates dead lanes per
+                # failed spawn
+                try:
+                    engine.close()
+                except Exception:
+                    pass
+            self._c_spawn_failures.inc()
+            self._tracer.event("autoscaler_spawn_failed", lane=self._lane,
+                               error=repr(e)[:200])
+            return None
+        idx = self.router.add_replica(engine, role="decode")
+        self._spawned.append(idx)
+        self._c_spawns.inc()
+        self._publish()
+        self._tracer.event("autoscaler_spawn", lane=self._lane,
+                           replica=idx)
+        return idx
+
+    def retire(self, replica: int) -> None:
+        """Begin the graceful retirement of ``replica``: drain it now
+        (no new work), close + mark retired once its in-flight work
+        finishes (a later :meth:`tick` completes the second phase)."""
+        self.router.drain(replica)
+        self._retiring.append(replica)
+        if replica in self._spawned:
+            self._spawned.remove(replica)
+        self._c_retires.inc()
+        self._publish()
+        self._tracer.event("autoscaler_retire", lane=self._lane,
+                           replica=replica)
+
+    # -------------------------------------------------------------- state
+    def snapshot(self) -> dict:
+        return {
+            "decode_replicas": self.decode_count(),
+            "spawned": list(self._spawned),
+            "retiring": list(self._retiring),
+            "cooldown": self._cooldown,
+            "spawns": self._c_spawns.value,
+            "retires": self._c_retires.value,
+            "spawn_failures": self._c_spawn_failures.value,
+        }

@@ -43,6 +43,7 @@ token, sampling included.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -398,6 +399,76 @@ class EngineCore:
         if not self._cache_active:
             return 0
         return self.prefix_cache.match_length(prompt)
+
+    # ------------------------------------------------ fleet KV handoff
+    # The disaggregated fleet (docs/serving.md "Disaggregated fleet")
+    # moves a finished prompt's radix blocks between replicas through
+    # these two halves.  Both ride the EXISTING compiled surface: the
+    # export is the prefix cache's one gather program, the adopt is the
+    # slot-adopt copy + the one scatter program — the handoff adds zero
+    # new compiled programs (pinned by the disagg chaos suite).
+
+    def export_prompt_kv(self, prompt) -> Optional[MatchResult]:
+        """PREFILL-side half: pin ``prompt``'s cached block path so the
+        transfer window cannot lose it to LRU eviction.  Returns the
+        pinned :class:`MatchResult` (``tokens == 0`` when nothing is
+        cached), or None when the cache is off/bypassed.  The caller
+        (serving/handoff.py) MUST hand the result back to
+        :meth:`release_export` on every path — commit or abort."""
+        if not self._cache_active:
+            return None
+        return self.prefix_cache.match(prompt, count_stats=False)
+
+    def _mesh_scope(self):
+        """The mesh context the engine's handoff copies dispatch under
+        (a no-op scope on single-chip engines) — the same push
+        ``_step_impl`` performs for the step programs."""
+        if self.mesh is not None:
+            return self.mesh
+        return contextlib.nullcontext()
+
+    def export_gather(self, match: MatchResult):
+        """Read the pinned blocks into per-layer ``[1, max_seq, h, d]``
+        staging rows via THE gather program (``BlockPool.load_row``)."""
+        with self._mesh_scope():
+            return self.prefix_cache.load_staging(match)
+
+    def release_export(self, match: Optional[MatchResult]) -> None:
+        """Unpin an export (idempotent — ``PrefixCache.release``).  A
+        quarantine rebuild may have dropped the cache entirely
+        (``prefix_cache = None`` under ladder bypass); the pinned nodes
+        then belong to a discarded tree and nothing reads their
+        refcounts again, so the release is a safe no-op — it must not
+        crash the handoff's abort path."""
+        if match is not None and self.prefix_cache is not None:
+            self.prefix_cache.release(match)
+
+    def adopt_prompt_kv(self, prompt, ks, vs, tokens: int,
+                        faults=None) -> int:
+        """DECODE-side half: land ``tokens`` transferred prompt tokens
+        (staging rows ``ks``/``vs`` from the source's
+        :meth:`export_gather`) in THIS engine's radix cache.  The rows
+        stage through a transient pool slot — the scatter program's only
+        legal source — which is freed again on every path, so the
+        transfer can never leak a slot.  Returns the number of new
+        blocks written (0: cache off/bypassed, or everything already
+        cached here).  Raises when no slot is free — the caller gates on
+        ``pool.free_slots`` and defers.  ``faults`` is the ROUTER-level
+        injector: ``handoff_scatter`` fires after the slot claim, so
+        the chaos suite proves the try/finally unwinding for real."""
+        if not self._cache_active or tokens < self.block_pool.block_len:
+            return 0
+        slot = self.pool.alloc()
+        try:
+            if faults is not None:
+                faults.fire("handoff_scatter")
+            with self._mesh_scope():
+                self.pool.adopt(slot, list(zip(ks, vs)), tokens,
+                                set_pos=False)
+                return self.prefix_cache.insert(
+                    np.asarray(prompt)[:tokens], self.pool, slot)
+        finally:
+            self.pool.free(slot)
 
     def _contained_cache_fault(self, match: Optional[MatchResult],
                                exc: Exception) -> None:

@@ -28,6 +28,20 @@ Injection points (``POINTS``):
                       so the *device-side* non-finite detector fires
   ``slow_step``       the engine sleeps ``seconds`` at the top of the
                       step (straggler simulation; deadline driver)
+  ``handoff_gather``  the fleet KV handoff raises at its GATHER stage —
+                      before the prefill replica's block rows are read
+                      (serving/handoff.py; router-level injector)
+  ``handoff_scatter`` the handoff raises at its SCATTER stage — after
+                      the decode replica's staging slot is claimed,
+                      before the blocks land in its pool (proves the
+                      temp slot unwinds)
+  ``handoff_commit``  the handoff raises at COMMIT — blocks already
+                      transferred; the abort path must still release
+                      the prefill-side radix pin
+  ``replica_spawn``   the fleet autoscaler's spawn path raises while a
+                      replica is half-built — it must never become
+                      routable and the router topology must be
+                      untouched
   =================  ====================================================
 
 Faults are armed per site with ``enable(site, at=..., times=...)``: the
@@ -50,7 +64,14 @@ from typing import Dict, Optional
 __all__ = ["FaultError", "FaultInjector", "POINTS"]
 
 POINTS = ("kv_alloc", "block_alloc", "block_exhausted", "gather",
-          "scatter", "step", "nan_logits", "slow_step")
+          "scatter", "step", "nan_logits", "slow_step",
+          # fleet-tier sites (ISSUE 13): the disaggregated KV handoff's
+          # three stages and the autoscaler's replica spawn — these are
+          # checked by ROUTER-level code (serving/handoff.py,
+          # serving/autoscaler.py), so arm them on the injector passed
+          # to Router/Autoscaler, not on a replica engine's
+          "handoff_gather", "handoff_scatter", "handoff_commit",
+          "replica_spawn")
 
 
 class FaultError(RuntimeError):

@@ -15,7 +15,13 @@ across N replicas behind a :class:`~paddle_tpu.serving.router.Router`:
   (c) no request is served twice: a failed-over request's total
       submissions never exceed two (original + one resubmission), and
       the router's delivered high-water mark keeps the client stream
-      exactly-once.
+      exactly-once;
+  (d) disaggregated fleets additionally conserve the KV handoff: every
+      opened handoff reached a terminal state (committed or aborted —
+      staged == committed + aborted once drained), so no prefill-side
+      radix pin or decode-side staging slot can be outstanding, and the
+      per-replica baselines of (b) hold on prefill, decode AND retired
+      replicas alike.
 
 These helpers compute the verdict as plain dicts so the chaos tests
 (``tests/test_zz_fleet_serving.py``), the CI smoke
@@ -84,8 +90,9 @@ def replica_accounting(engine) -> Dict[str, object]:
 
 def fleet_accounting(router) -> Dict[str, object]:
     """The fleet verdict over a drained router: per-request terminal
-    statuses (invariant a), per-replica baselines (invariant b), and
-    the exactly-once bound (invariant c).  ``ok`` rolls all three up —
+    statuses (invariant a), per-replica baselines (invariant b), the
+    exactly-once bound (invariant c), and — for disaggregated fleets —
+    handoff conservation (invariant d).  ``ok`` rolls all four up —
     ``scripts/fleet_chaos_smoke.py`` exits nonzero on False."""
     requests: List[Dict[str, object]] = []
     all_terminal = True
@@ -103,19 +110,36 @@ def fleet_accounting(router) -> Dict[str, object]:
             "reason": out.status_reason, "tokens": len(out.tokens),
             "delivered": fr.delivered,
             "failed_over": fr.attempts > 1,
+            "stage": fr.role_stage,
+            "handoffs": fr.handoffs,
             # the failover audit trail: which replica surrendered the
             # request and why (empty for never-failed-over requests)
             "history": [{"replica": r, "reason": why}
                         for r, _, why in fr.history],
         })
-    replicas = [replica_accounting(h.engine) for h in router.replicas]
-    ok = bool(all_terminal and once_ok
+    replicas = []
+    for h in router.replicas:
+        ra = replica_accounting(h.engine)
+        ra["role"] = h.role
+        ra["retired"] = h.retired
+        replicas.append(ra)
+    # invariant d: the handoff ledger is conserved — nothing left
+    # mid-flight, and every open matched a terminal transition
+    mgr = router._handoffs
+    handoffs_settled = (mgr.pending == 0
+                        and mgr.staged == mgr.committed + mgr.aborted)
+    ok = bool(all_terminal and once_ok and handoffs_settled
               and all(r["ok"] for r in replicas))
     return {
         "ok": ok,
         "all_terminal": bool(all_terminal),
         "served_at_most_once_retry": bool(once_ok),
         "pools_at_baseline": all(r["ok"] for r in replicas),
+        "handoffs_settled": bool(handoffs_settled),
+        "handoffs_staged": mgr.staged,
+        "handoffs_committed": mgr.committed,
+        "handoffs_aborted": mgr.aborted,
+        "handoff_blocks_moved": mgr.blocks_moved,
         "requests": requests,
         "replicas": replicas,
         "failovers": router.metrics.c_failovers.value,

@@ -163,6 +163,14 @@ class EngineHealth:
     def circuit_open(self) -> bool:
         return self._circuit_open
 
+    @property
+    def routable(self) -> bool:
+        """May a fleet router hand this engine NEW work?  Quarantined
+        (mid-rebuild) and circuit-open (terminal) replicas may not;
+        degraded replicas stay routable — the router deprioritizes
+        rather than excludes them (docs/serving.md health matrix)."""
+        return not (self._in_quarantine or self._circuit_open)
+
     # ------------------------------------------------------------- steps
     def on_step_ok(self) -> None:
         self.step_index += 1

@@ -144,17 +144,25 @@ class KVPool:
             from .tp import replicated
             self.seq_pos = replicated(self.seq_pos, self.mesh)
 
-    def adopt(self, slot: int, layer_caches, length: int) -> None:
+    def adopt(self, slot: int, layer_caches, length: int,
+              set_pos: bool = True) -> None:
         """Move a freshly prefilled single-request cache (per-layer
         ``(k [1, max_seq, h, d], v, _)`` tuples) into ``slot`` and record
         its ``length`` valid positions.  The copy is a jitted
         dynamic_update_slice with a traced slot index — admitting to a
-        different slot never recompiles."""
+        different slot never recompiles.
+
+        ``set_pos=False`` skips the position write: the fleet KV handoff
+        (serving/handoff.py) stages transferred rows through a transient
+        slot purely as the scatter program's source — no decode ever
+        reads the slot, so updating (and then re-zeroing) ``seq_pos``
+        would be two wasted device ops per transfer."""
         s = jnp.asarray(slot, jnp.int32)
         for i, layer in enumerate(layer_caches):
             self.ks[i] = _adopt_row(self.ks[i], layer[0], s)
             self.vs[i] = _adopt_row(self.vs[i], layer[1], s)
-        self.seq_pos = self.seq_pos.at[slot].set(length)
+        if set_pos:
+            self.seq_pos = self.seq_pos.at[slot].set(length)
 
     # ------------------------------------------------------- cache views
     def caches(self) -> List[Tuple[jax.Array, jax.Array, jax.Array]]:

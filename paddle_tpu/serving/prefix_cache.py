@@ -135,9 +135,16 @@ class PrefixCache:
             n += self.block_len
         return n
 
-    def match(self, tokens) -> MatchResult:
+    def match(self, tokens, count_stats: bool = True) -> MatchResult:
         """Longest cached block-aligned prefix of ``tokens``; pins every
-        node on the path (refcount +1) until :meth:`release`."""
+        node on the path (refcount +1) until :meth:`release`.
+
+        The pin is what makes the fleet KV handoff (serving/handoff.py)
+        safe: the prefill replica's exported blocks stay pinned — never
+        LRU-evictable — for the whole staged->committed/aborted window,
+        even though no request on THIS engine holds them.  Handoff
+        exports pass ``count_stats=False`` so the transfer walk does not
+        inflate the admission hit/miss telemetry."""
         toks = np.asarray(tokens, np.int32).reshape(-1)
         path: List[_Node] = []
         node = self.root
@@ -151,11 +158,12 @@ class PrefixCache:
             n.refcount += 1
             self._bump(n)
         matched = len(path) * self.block_len
-        if path:
-            self.hits += 1
-            self.hit_tokens += matched
-        else:
-            self.misses += 1
+        if count_stats:
+            if path:
+                self.hits += 1
+                self.hit_tokens += matched
+            else:
+                self.misses += 1
         return MatchResult(tokens=matched,
                            blocks=[n.block for n in path], _nodes=path)
 

@@ -40,6 +40,29 @@ position reaches the client exactly once.  Failures the CLIENT caused
 router's authoritative fleet-id -> (replica, engine-id) map, so they
 follow the request across a failover.
 
+**Disaggregated prefill/decode roles.**  Each replica carries a role —
+``prefill`` / ``decode`` / ``unified`` — and when the fleet holds
+prefill replicas, long prompts take a TWO-PHASE path: the router
+submits them to a prefill replica (prefix-affinity on the prefill
+side) capped at ONE token, and when that prefill finishes, the
+prompt's radix blocks move to the lightest-loaded decode replica
+through the fault-tolerant KV handoff state machine
+(serving/handoff.py: ``staged -> in_flight -> committed | aborted``,
+riding the existing gather/scatter programs — zero new compiled
+surface), where the request is resubmitted for its decode phase.  The
+first token was already delivered from the prefill side, so TTFT never
+waits on the transfer, and the ``delivered`` high-water mark dedups
+the decode side's deterministic regeneration exactly like a failover
+retry.  A handoff fault at any stage retries once, falls back to
+RE-PREFILLING on the decode side, or fails the request terminally —
+never leaking a block, slot, or radix pin on either replica (the
+disagg chaos suite pins this per injection point).  Short prompts
+(below ``prefill_threshold``) skip the prefill plane entirely.  An
+attached :class:`~paddle_tpu.serving.autoscaler.Autoscaler` sizes the
+decode side against ``router.queue_depth``, spawning behind a warmup
+gate and retiring through :meth:`Router.drain` /
+:meth:`Router.retire`.  See docs/serving.md "Disaggregated fleet".
+
 The router is pure host-side control plane: it never touches a device
 array and adds zero work to any engine's hot step loop.  Replicas
 should be built with ``fault_tolerance=FaultToleranceConfig(...)`` —
@@ -49,7 +72,8 @@ step exception propagates out of :meth:`Router.step` to the caller.
 
 Fleet accounting (chaos invariant) lives in ``serving/fleet.py``;
 ``scripts/fleet_chaos_smoke.py`` drives one injected replica fault
-end-to-end and ``tests/test_zz_fleet_serving.py`` pins the invariant.
+end-to-end and ``tests/test_zz_fleet_serving.py`` +
+``tests/test_zz_disagg_serving.py`` pin the invariant.
 See docs/serving.md "Fleet tier".
 """
 
@@ -64,10 +88,17 @@ import numpy as np
 
 from .api import RequestOutput, ServingEngine
 from .errors import EngineStalledError, RequestRejected
+from .handoff import ABORTED, HandoffManager
 from .health import CIRCUIT_OPEN, DEGRADED, QUARANTINED
 from .scheduler import SamplingParams
 
-__all__ = ["Router", "ReplicaHandle"]
+__all__ = ["Router", "ReplicaHandle", "ROLES"]
+
+# the routing roles a replica may carry (docs/serving.md
+# "Disaggregated fleet"): prefill replicas take only the router's
+# prefill-stage submissions, decode replicas take decode-stage work,
+# unified replicas take both (the single-role fleet default)
+ROLES = ("prefill", "decode", "unified")
 
 # terminal reasons a failover must never retry: the failure is
 # attributed to the CLIENT's sink, not the replica — a resubmission
@@ -77,14 +108,24 @@ _CLIENT_FAULT_PREFIX = "stream callback"
 
 class ReplicaHandle:
     """Router-side view of one replica: the engine plus the routing
-    state the router owns about it (drain flag, routed count)."""
+    state the router owns about it (role, drain/retire flags, routed
+    count)."""
 
-    __slots__ = ("index", "engine", "draining", "routed")
+    __slots__ = ("index", "engine", "role", "draining", "retired",
+                 "routed")
 
-    def __init__(self, index: int, engine: ServingEngine):
+    def __init__(self, index: int, engine: ServingEngine,
+                 role: str = "unified"):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         self.index = index
         self.engine = engine
+        self.role = role
         self.draining = False
+        # retired replicas keep their handle (indices stay stable in
+        # the fleet-id map) but their engine is closed and they never
+        # re-enter rotation — the autoscaler's drain-based retirement
+        self.retired = False
         self.routed = 0          # fleet requests ever routed here
 
     @property
@@ -93,10 +134,19 @@ class ReplicaHandle:
         core = self.engine.core
         return core.scheduler.queue_depth + core.scheduler.active
 
+    def serves(self, stage: str) -> bool:
+        """May this replica take new ``stage`` ("prefill"/"decode")
+        work?  Role compatibility only — health/drain gates live in
+        ``Router._eligible``."""
+        if stage == "prefill":
+            return self.role == "prefill"
+        return self.role in ("decode", "unified")
+
     def __repr__(self) -> str:
-        return (f"ReplicaHandle({self.index}, "
+        return (f"ReplicaHandle({self.index}, role={self.role!r}, "
                 f"health={self.engine.health.state!r}, "
-                f"draining={self.draining}, load={self.load})")
+                f"draining={self.draining}, retired={self.retired}, "
+                f"load={self.load})")
 
 
 class _FleetRequest:
@@ -108,7 +158,8 @@ class _FleetRequest:
     __slots__ = ("fleet_id", "prompt", "max_new_tokens", "sampling",
                  "eos_token_id", "client_stream", "deadline_s",
                  "ttft_deadline_s", "submit_time", "replica",
-                 "engine_rid", "attempts", "delivered", "history")
+                 "engine_rid", "attempts", "delivered", "history",
+                 "role_stage", "handoffs", "override")
 
     def __init__(self, fleet_id: int, prompt: np.ndarray,
                  max_new_tokens: int, sampling, eos_token_id,
@@ -128,6 +179,15 @@ class _FleetRequest:
         self.delivered = 0            # client-visible token positions
         # (replica, engine_rid, status_reason) per surrendered attempt
         self.history: List[Tuple[int, int, str]] = []
+        # disaggregated-fleet routing phase: "prefill" while the request
+        # runs (one-token-capped) on a prefill replica, "decode" once it
+        # owns a full submission on a decode/unified replica
+        self.role_stage = "decode"
+        self.handoffs = 0             # committed/aborted migrations
+        # router-level terminal stamp: set only when the handoff
+        # machinery exhausts every placement (the engine-side record is
+        # then a stale 1-token "finished" view); result() applies it
+        self.override: Optional[Tuple[str, str]] = None
 
 
 class _RouterMetrics:
@@ -165,6 +225,43 @@ class _RouterMetrics:
                             "fleet submissions refused (no healthy "
                             "replica / fleet queue / every replica "
                             "rejected)")
+        # disaggregated-fleet surface (docs/serving.md "Disaggregated
+        # fleet"; glossary rows in docs/observability.md)
+        self.g_prefill = g("router.role_prefill_replicas",
+                           "prefill-role replicas in rotation")
+        self.g_decode = g("router.role_decode_replicas",
+                          "decode-capable (decode/unified) replicas in "
+                          "rotation")
+        self.g_retired = g("router.retired_replicas",
+                           "replicas retired out of the fleet (drained, "
+                           "closed, indices kept stable)")
+        self.c_handoff_staged = c("handoff.staged",
+                                  "KV handoffs opened (prefill-side "
+                                  "path pinned)")
+        self.c_handoff_committed = c("handoff.committed",
+                                     "KV handoffs whose blocks landed "
+                                     "on the decode replica")
+        self.c_handoff_aborted = c("handoff.aborted",
+                                   "KV handoffs aborted (the request "
+                                   "re-prefilled on the decode side or "
+                                   "failed terminally)")
+        self.c_handoff_retries = c("handoff.retries",
+                                   "transfer attempts retried after an "
+                                   "in-flight fault")
+        self.c_handoff_blocks = c("handoff.blocks_moved",
+                                  "radix blocks moved prefill -> decode")
+        self.c_handoff_failed = c("handoff.failed_terminal",
+                                  "requests failed terminally because "
+                                  "no decode replica could place the "
+                                  "post-handoff submission")
+
+    def on_handoff(self, phase: str, fleet_id: int, src: int, dst: int,
+                   **attrs) -> None:
+        """Discrete handoff lifecycle event on the router lane; the
+        matching counters are bumped by the router at the transition
+        sites."""
+        self.tracer.event(f"handoff_{phase}", lane=self.lane,
+                          fleet_id=fleet_id, src=src, dst=dst, **attrs)
 
     def on_route(self, fleet_id: int, replica: int, hit_tokens: int) -> None:
         self.c_routed.inc()
@@ -194,13 +291,18 @@ class _RouterMetrics:
 
     def publish(self, handles: Sequence[ReplicaHandle]) -> None:
         self.g_replicas.set(len(handles))
-        healthy = sum(1 for h in handles if not h.draining
-                      and h.engine.health.state
-                      not in (QUARANTINED, CIRCUIT_OPEN))
+        live = [h for h in handles if not h.retired]
+        healthy = sum(1 for h in live if not h.draining
+                      and h.engine.health.routable)
         self.g_healthy.set(healthy)
-        self.g_draining.set(sum(1 for h in handles if h.draining))
+        self.g_draining.set(sum(1 for h in live if h.draining))
         self.g_queue.set(sum(h.engine.core.scheduler.queue_depth
-                             for h in handles))
+                             for h in live))
+        self.g_prefill.set(sum(1 for h in live if not h.draining
+                               and h.role == "prefill"))
+        self.g_decode.set(sum(1 for h in live if not h.draining
+                              and h.role in ("decode", "unified")))
+        self.g_retired.set(sum(1 for h in handles if h.retired))
 
 
 class Router:
@@ -219,27 +321,69 @@ class Router:
     as terminal ``failed``); ``affinity=False`` degrades routing to
     round-robin over the eligible replicas — the measured baseline the
     prefix-affinity win is pinned against.
+
+    ``roles`` assigns each replica its fleet role (default: the
+    engine's own ``role`` attribute, ``unified`` when absent).  A fleet
+    holding ``prefill`` replicas is DISAGGREGATED: prompts of at least
+    ``prefill_threshold`` tokens (needing more than one output token)
+    run their prefill on a prefill replica and migrate to a decode
+    replica through the KV handoff (serving/handoff.py); shorter
+    prompts route straight to decode/unified replicas.  The threshold
+    is REQUIRED when prefill roles are present — every request pays
+    the two-phase migration above it, so the split point is a sizing
+    decision the operator must make (an explicit 0 routes everything
+    through the prefill plane).  ``faults`` arms the router-level
+    chaos points (``handoff_*``) — None in production.
     """
 
     def __init__(self, replicas: Sequence[ServingEngine], *,
                  max_queue: Optional[int] = None,
                  failover: bool = True,
                  affinity: bool = True,
+                 roles: Optional[Sequence[str]] = None,
+                 prefill_threshold: Optional[int] = None,
+                 faults=None,
                  registry=None, tracer=None):
         if not replicas:
             raise ValueError("Router needs at least one replica engine")
         if max_queue is not None and max_queue < 1:
             raise ValueError("max_queue must be >= 1 (or None)")
-        self._handles = [ReplicaHandle(i, eng)
-                         for i, eng in enumerate(replicas)]
+        if prefill_threshold is not None and prefill_threshold < 0:
+            raise ValueError("prefill_threshold must be >= 0 (or None)")
+        if roles is None:
+            roles = [getattr(eng, "role", "unified") for eng in replicas]
+        if len(roles) != len(replicas):
+            raise ValueError(
+                f"roles has {len(roles)} entries for {len(replicas)} "
+                f"replicas")
+        self._handles = [ReplicaHandle(i, eng, role=r)
+                         for i, (eng, r) in enumerate(zip(replicas,
+                                                          roles))]
+        if any(h.role == "prefill" for h in self._handles):
+            if not any(h.serves("decode") for h in self._handles):
+                raise ValueError(
+                    "a disaggregated fleet needs at least one decode "
+                    "or unified replica — prefill replicas never "
+                    "decode past the first token")
+            if prefill_threshold is None:
+                raise ValueError(
+                    "a fleet with prefill-role replicas requires an "
+                    "explicit prefill_threshold (prompt length in "
+                    "tokens at which requests take the two-phase "
+                    "prefill->handoff path; 0 routes every multi-token "
+                    "request through the prefill plane)")
         self.max_queue = max_queue
         self.failover = failover
         self.affinity = affinity
+        self.prefill_threshold = prefill_threshold
+        self.faults = faults
         self.registry = registry if registry is not None \
             else replicas[0].registry
         self.tracer = tracer if tracer is not None \
             else replicas[0].tracer
         self.metrics = _RouterMetrics(self.registry, self.tracer)
+        self._handoffs = HandoffManager(faults=faults)
+        self._autoscaler = None       # attach via Autoscaler(router, ...)
         self._requests: Dict[int, _FleetRequest] = {}
         self._live: set = set()       # fleet ids the failover scan owns
         self._ids = itertools.count()
@@ -251,21 +395,45 @@ class Router:
     def build(cls, model_factory: Callable, replicas: int = 2, *,
               registry=None, tracer=None, max_queue: Optional[int] = None,
               failover: bool = True, affinity: bool = True,
+              roles: Optional[Sequence[str]] = None,
+              prefill_threshold: Optional[int] = None,
+              faults=None,
+              prefill_engine_kw: Optional[dict] = None,
+              decode_engine_kw: Optional[dict] = None,
               **engine_kw) -> "Router":
         """Construct ``replicas`` engines onto ONE shared registry and
         tracer (fresh ones when not given) and front them with a router.
         ``model_factory()`` is called once per replica — return the same
         weights (e.g. re-seed inside the factory) when fleet-wide token
         parity matters; ``engine_kw`` is forwarded to every
-        :class:`ServingEngine`."""
+        :class:`ServingEngine`.  With ``roles`` given, per-role kwargs
+        override the shared ones — e.g. ``prefill_engine_kw=dict(
+        num_slots=2, max_prefill_tokens_per_step=None)`` for the
+        big-bucket prefill shape, ``decode_engine_kw=dict(num_slots=16)``
+        for the all-slots decode shape."""
         from ..obs import MetricsRegistry, Tracer
         registry = registry if registry is not None else MetricsRegistry()
         tracer = tracer if tracer is not None else Tracer()
-        engines = [ServingEngine(model_factory(), registry=registry,
-                                 tracer=tracer, **engine_kw)
-                   for _ in range(replicas)]
+        role_list = list(roles) if roles is not None \
+            else ["unified"] * replicas
+        if len(role_list) != replicas:
+            raise ValueError(
+                f"roles has {len(role_list)} entries for {replicas} "
+                f"replicas")
+        engines = []
+        for r in role_list:
+            kw = dict(engine_kw)
+            if r == "prefill" and prefill_engine_kw:
+                kw.update(prefill_engine_kw)
+            elif r == "decode" and decode_engine_kw:
+                kw.update(decode_engine_kw)
+            engines.append(ServingEngine(model_factory(),
+                                         registry=registry,
+                                         tracer=tracer, role=r, **kw))
         return cls(engines, max_queue=max_queue, failover=failover,
-                   affinity=affinity, registry=registry, tracer=tracer)
+                   affinity=affinity, roles=role_list,
+                   prefill_threshold=prefill_threshold, faults=faults,
+                   registry=registry, tracer=tracer)
 
     # ---------------------------------------------------------- topology
     @property
@@ -273,15 +441,21 @@ class Router:
         return tuple(self._handles)
 
     @property
+    def disaggregated(self) -> bool:
+        """True once the fleet holds a live prefill-role replica."""
+        return any(h.role == "prefill" and not h.retired
+                   for h in self._handles)
+
+    @property
     def queue_depth(self) -> int:
         """Fleet-wide waiting requests (the ``max_queue`` bound)."""
         return sum(h.engine.core.scheduler.queue_depth
-                   for h in self._handles)
+                   for h in self._handles if not h.retired)
 
     @property
     def in_flight(self) -> int:
         """Queued + placed requests across the fleet."""
-        return sum(h.load for h in self._handles)
+        return sum(h.load for h in self._handles if not h.retired)
 
     def _handle(self, replica: int) -> ReplicaHandle:
         if not 0 <= replica < len(self._handles):
@@ -290,14 +464,56 @@ class Router:
                 f"{len(self._handles)} replicas")
         return self._handles[replica]
 
-    def _eligible(self) -> List[ReplicaHandle]:
-        """Replicas new work may be routed to: not draining, not
-        quarantined, circuit not open (degraded stays eligible — it is
-        deprioritized by the route order, not excluded)."""
+    def add_replica(self, engine: ServingEngine,
+                    role: str = "decode") -> int:
+        """Append one fully-built replica to the rotation (the
+        autoscaler's spawn endpoint — the engine must be READY: a
+        half-built replica must never reach this call).  Returns its
+        replica index; indices are append-only and never reused, so
+        the fleet-id map stays stable across topology changes."""
+        h = ReplicaHandle(len(self._handles), engine, role=role)
+        self._handles.append(h)
+        self.metrics.publish(self._handles)
+        return h.index
+
+    def retire(self, replica: int) -> None:
+        """Permanently remove a DRAINED replica from the fleet: close
+        its engine and mark the handle retired (kept in place — indices
+        stay stable; completed requests still resolve through it).
+        The graceful path is ``drain(i)`` → wait ``drained(i)`` →
+        ``retire(i)`` — the autoscaler's scale-down does exactly this.
+        Raises when the replica still has work (retiring it would
+        strand in-flight requests) or was already retired."""
+        h = self._handle(replica)
+        if h.retired:
+            raise ValueError(f"replica {replica} is already retired")
+        if h.engine.core.scheduler.has_work():
+            raise ValueError(
+                f"replica {replica} still has queued or in-flight work "
+                f"— drain it and wait for drained() first")
+        h.retired = True
+        h.engine.close()
+        self.metrics.on_drain(replica, "retire")
+        self.metrics.publish(self._handles)
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Register the autoscaler ``step()`` ticks (one per fleet
+        step).  Called by ``Autoscaler.__init__``."""
+        self._autoscaler = autoscaler
+
+    @property
+    def autoscaler(self):
+        return self._autoscaler
+
+    def _eligible(self, stage: str = "decode") -> List[ReplicaHandle]:
+        """Replicas new ``stage`` work may be routed to: role-compatible,
+        not draining/retired, not quarantined, circuit not open
+        (degraded stays eligible — it is deprioritized by the route
+        order, not excluded)."""
         return [h for h in self._handles
-                if not h.draining
-                and h.engine.health.state not in (QUARANTINED,
-                                                  CIRCUIT_OPEN)]
+                if h.serves(stage)
+                and not h.draining and not h.retired
+                and h.engine.health.routable]
 
     def _route_order(self, eligible: List[ReplicaHandle],
                      prompt: np.ndarray
@@ -333,17 +549,24 @@ class Router:
         this router — engine-local ids never leak to clients).
 
         Raises :class:`RequestRejected` when no replica can take the
-        request: ``no_healthy_replica`` (every replica excluded by
-        health or drain), ``fleet_queue_full`` (the fleet-wide
+        request: ``no_healthy_replica`` (every decode-capable replica
+        excluded by health or drain — a disaggregated fleet always
+        needs decode capacity), ``fleet_queue_full`` (the fleet-wide
         ``max_queue`` bound), or the best replica's own rejection
         (``queue_full`` / ``slo_unattainable`` / ``circuit_open``) when
         every eligible replica refused — always carrying the best
         available ``retry_after_s`` hint.  Validation ``ValueError``\\ s
         (empty prompt, prompt+new > max_seq, bad sampling) propagate
-        from the first replica tried, before any state is recorded."""
+        from the first replica tried, before any state is recorded.
+
+        In a disaggregated fleet a long prompt is submitted to a
+        PREFILL replica capped at one token; the KV handoff + decode
+        resubmission happen transparently inside later :meth:`step`\\ s.
+        When every prefill replica refuses, the request falls back to
+        the decode-direct path rather than rejecting."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         fleet_id = next(self._ids)
-        eligible = self._eligible()
+        eligible = self._eligible("decode")
         if not eligible:
             # hint only from replicas that can plausibly recover — a
             # circuit-open replica never will (engine.check_admission
@@ -353,38 +576,77 @@ class Router:
             self._reject(fleet_id, prompt, "no_healthy_replica",
                          self._best_hint(
                              [h for h in self._handles
-                              if h.engine.health.state != CIRCUIT_OPEN]))
+                              if h.serves("decode") and not h.retired
+                              and h.engine.health.state != CIRCUIT_OPEN]))
         if self.max_queue is not None \
                 and self.queue_depth >= self.max_queue:
             self._reject(fleet_id, prompt, "fleet_queue_full",
                          self._best_hint(eligible))
-        order = self._route_order(eligible, prompt)
         fr = _FleetRequest(fleet_id, prompt, max_new_tokens, sampling,
                            eos_token_id, stream, deadline_s,
                            ttft_deadline_s)
         fr.submit_time = time.perf_counter()
         rejections: List[RequestRejected] = []
-        for h, hit in order:
+        # disaggregated two-phase path: long prompts needing >1 output
+        # token try the prefill plane first (prefix affinity among the
+        # prefill replicas); the decode-direct order is the fallback
+        prefill_order: List[Tuple[ReplicaHandle, Optional[int]]] = []
+        if self.prefill_threshold is not None and max_new_tokens > 1 \
+                and prompt.size >= self.prefill_threshold:
+            pre = self._eligible("prefill")
+            if pre:
+                # the decode phase must eventually fit somewhere: a
+                # request that can never be placed on ANY decode-capable
+                # replica is a caller bug, surfaced loudly here instead
+                # of as a mid-handoff failure.  Capacity is a FLEET
+                # property — measured over every decode-capable replica
+                # (health is transient; a quarantined big replica comes
+                # back with the same max_seq), not just the currently
+                # eligible ones
+                fleet_max_seq = max(
+                    h.engine.core.pool.max_seq for h in self._handles
+                    if h.serves("decode") and not h.retired)
+                if prompt.size + max_new_tokens > fleet_max_seq:
+                    raise ValueError(
+                        f"prompt_len {prompt.size} + max_new_tokens "
+                        f"{max_new_tokens} exceeds every decode "
+                        f"replica's max_seq — the post-handoff "
+                        f"submission could never be placed")
+                prefill_order = self._route_order(pre, prompt)
+        for h, hit in prefill_order:
+            try:
+                rid = self._submit_to(h, fr, max_new=1)
+            except RequestRejected as e:
+                rejections.append(e)
+                continue
+            fr.role_stage = "prefill"
+            return self._place(fr, h, rid, hit)
+        for h, hit in self._route_order(eligible, prompt):
             try:
                 rid = self._submit_to(h, fr)
             except RequestRejected as e:
                 rejections.append(e)
                 continue
-            fr.replica, fr.engine_rid = h.index, rid
-            fr.attempts = 1
-            h.routed += 1
-            self._requests[fleet_id] = fr
-            self._live.add(fleet_id)
-            if hit is None:         # round-robin: probe the winner only
-                hit = h.engine.core.prefix_probe(prompt)
-            self.metrics.on_route(fleet_id, h.index, hit)
-            return fleet_id
+            return self._place(fr, h, rid, hit)
         # every eligible replica rejected: surface the BEST replica's
         # reason with the best (smallest, still-finite) retry hint
         hints = [e.retry_after_s for e in rejections
                  if e.retry_after_s is not None]
         self._reject(fleet_id, prompt, rejections[0].reason,
                      min(hints) if hints else None)
+
+    def _place(self, fr: _FleetRequest, h: ReplicaHandle,
+               rid: int, hit: Optional[int]) -> int:
+        """Record a freshly accepted fleet submission's ownership."""
+        fr.replica, fr.engine_rid = h.index, rid
+        fr.attempts = 1
+        h.routed += 1
+        self._requests[fr.fleet_id] = fr
+        self._live.add(fr.fleet_id)
+        if hit is None:             # round-robin: probe the winner only
+            hit = h.engine.core.prefix_probe(fr.prompt)
+        self.metrics.on_route(fr.fleet_id, h.index, hit)
+        return fr.fleet_id
 
     def _reject(self, fleet_id: int, prompt: np.ndarray, reason: str,
                 retry_after_s: Optional[float]):
@@ -402,12 +664,16 @@ class Router:
         return min(hints) if hints else None
 
     def _submit_to(self, h: ReplicaHandle, fr: _FleetRequest,
-                   now: Optional[float] = None) -> int:
-        """Submit (or RE-submit, on failover) one fleet request to a
-        replica, with the deadline budgets shrunk by the time already
-        spent — a failover must not silently grant a fresh deadline.  A
-        request whose first token was already delivered carries no TTFT
-        deadline into the retry (the client's TTFT was met)."""
+                   now: Optional[float] = None,
+                   max_new: Optional[int] = None) -> int:
+        """Submit (or RE-submit, on failover/handoff) one fleet request
+        to a replica, with the deadline budgets shrunk by the time
+        already spent — a failover must not silently grant a fresh
+        deadline.  A request whose first token was already delivered
+        carries no TTFT deadline into the retry (the client's TTFT was
+        met).  ``max_new`` overrides the client's budget — the
+        prefill-stage submission caps at ONE token (the TTFT token; the
+        decode phase regenerates it deduped and continues)."""
         if now is None:
             now = time.perf_counter()
         elapsed = max(now - fr.submit_time, 0.0)
@@ -419,7 +685,9 @@ class Router:
             ttft = None if fr.delivered > 0 \
                 else max(ttft - elapsed, 0.0)
         return h.engine.submit(
-            fr.prompt, max_new_tokens=fr.max_new_tokens,
+            fr.prompt,
+            max_new_tokens=fr.max_new_tokens if max_new is None
+            else max_new,
             sampling=fr.sampling, eos_token_id=fr.eos_token_id,
             stream=self._fleet_stream(fr),
             deadline_s=deadline, ttft_deadline_s=ttft)
@@ -440,24 +708,36 @@ class Router:
 
     # --------------------------------------------------------- execution
     def step(self) -> int:
-        """One fleet iteration: step every replica, then run the
-        failover scan over live requests and refresh the fleet gauges.
-        Returns the number of requests still in flight fleet-wide."""
+        """One fleet iteration: step every live replica, run the
+        failover scan over live requests, pump pending KV handoffs,
+        tick the autoscaler (when attached) and refresh the fleet
+        gauges.  Returns the number of requests still in flight
+        fleet-wide."""
         for h in self._handles:
-            h.engine.step()
+            if not h.retired:
+                h.engine.step()
         self._scan_failover()
+        self._pump_handoffs()
+        if self._autoscaler is not None:
+            self._autoscaler.tick()
         self.metrics.publish(self._handles)
         return self.in_flight
 
     def has_work(self) -> bool:
-        return any(h.engine.core.scheduler.has_work()
-                   for h in self._handles)
+        return (any(h.engine.core.scheduler.has_work()
+                    for h in self._handles if not h.retired)
+                or self._handoffs.pending > 0)
 
     def _progress(self) -> int:
         return (sum(h.engine.core.progress_counter
                     for h in self._handles)
                 + self.metrics.c_failovers.value
-                + self.metrics.c_failover_exhausted.value)
+                + self.metrics.c_failover_exhausted.value
+                # every handoff transition is fleet progress — a staged
+                # transfer waiting for a slot must not trip the stall
+                # detector while it is still advancing
+                + self._handoffs.staged + self._handoffs.committed
+                + self._handoffs.aborted + self._handoffs.retries)
 
     def run_until_complete(self, max_steps: Optional[int] = None,
                            stall_steps: Optional[int] = 64) -> int:
@@ -482,7 +762,7 @@ class Router:
                 if stall_steps is not None and stalled >= stall_steps \
                         and self.has_work():
                     raise EngineStalledError(stalled,
-                                             self.fleet_snapshot())
+                                             self.stall_snapshot())
         return steps
 
     def stream(self, fleet_id: int) -> Iterator[int]:
@@ -507,8 +787,9 @@ class Router:
     # ---------------------------------------------------------- failover
     def _scan_failover(self) -> None:
         """Settle finished fleet requests; resubmit replica-attributed
-        failures ONCE to the best healthy replica.  Runs after every
-        fleet step, off any engine's hot path."""
+        failures ONCE to the best healthy replica; open KV handoffs for
+        prefill-stage requests whose prefill completed.  Runs after
+        every fleet step, off any engine's hot path."""
         if not self._live:
             return
         for fid in list(self._live):
@@ -518,6 +799,18 @@ class Router:
             req = self._handles[fr.replica].engine._requests.get(
                 fr.engine_rid)
             if req is None or not req.finished:
+                continue
+            if fr.role_stage == "prefill" and req.status == "finished":
+                # the one-token prefill run completed.  A first token
+                # that already ended the request (eos, or a one-token
+                # budget that took the decode-direct guard's gap) is
+                # genuinely done; otherwise open the KV handoff and
+                # keep the fleet id live until the decode phase owns it
+                if req.finish_reason == "eos" or fr.max_new_tokens <= 1:
+                    self._live.discard(fid)
+                    continue
+                if fid not in self._handoffs.records:
+                    self._stage_handoff(fr)
                 continue
             if (self.failover and req.status == "failed"
                     and fr.attempts < 2
@@ -530,7 +823,10 @@ class Router:
     def _try_failover(self, fr: _FleetRequest, failed_req) -> bool:
         """Resubmit one failed fleet request.  Returns True when a
         healthy replica accepted it (the router map now points there);
-        False leaves the terminal ``failed`` standing."""
+        False leaves the terminal ``failed`` standing.  A request that
+        died during its PREFILL stage fails over as a FULL submission
+        onto the decode plane — the prefill shortcut already proved
+        unlucky, and decode/unified replicas prefill fine."""
         now = time.perf_counter()
         if fr.deadline_s is not None \
                 and now - fr.submit_time >= fr.deadline_s:
@@ -539,7 +835,7 @@ class Router:
             return False
         # prefer a DIFFERENT replica; fall back to the (recovered)
         # origin only when it is the sole eligible one
-        eligible = self._eligible()
+        eligible = self._eligible("decode")
         targets = [h for h in eligible if h.index != fr.replica] \
             or eligible
         if not targets:
@@ -558,6 +854,7 @@ class Router:
             fr.history.append((src, src_rid, reason))
             self._handles[src].engine.purge(src_rid)
             fr.replica, fr.engine_rid = h.index, rid
+            fr.role_stage = "decode"
             fr.attempts += 1
             h.routed += 1
             self.metrics.on_failover(fr.fleet_id, src, h.index, reason)
@@ -566,22 +863,199 @@ class Router:
             fr.fleet_id, fr.replica, "every healthy replica rejected")
         return False
 
+    # --------------------------------------------------------- handoffs
+    def _stage_handoff(self, fr: _FleetRequest) -> None:
+        """Open the KV handoff for a prefill-stage request whose
+        prefill just finished: pin its block path on the source replica
+        and let :meth:`_pump_handoffs` drive the transfer."""
+        src = self._handles[fr.replica]
+        rec = self._handoffs.stage(fr.fleet_id, src, fr.prompt)
+        try:
+            self.metrics.c_handoff_staged.inc()
+            self.metrics.on_handoff("stage", fr.fleet_id, rec.src, -1,
+                                    tokens=rec.tokens)
+        except BaseException:
+            # telemetry must never leak the staged pin
+            self._handoffs.abort(rec, "stage telemetry failed")
+            raise
+
+    def _handoff_dst(self, fr: _FleetRequest,
+                     tokens: int) -> Optional[ReplicaHandle]:
+        """The transfer target: the healthiest, lightest-loaded decode
+        replica (load on the decode side — the prefill side already
+        spent its affinity), skipping replicas with no free staging
+        slot while blocks actually need to move."""
+        targets = sorted(
+            self._eligible("decode"),
+            key=lambda h: (h.engine.health.state == DEGRADED, h.load,
+                           h.index))
+        for h in targets:
+            if tokens == 0 or h.engine.core.pool.free_slots > 0:
+                return h
+        return None
+
+    def _pump_handoffs(self) -> None:
+        """Advance every pending handoff one transition per fleet step:
+        staged records transfer (or defer while no destination can
+        stage them, bounded by the manager's patience), successful
+        transfers commit + resubmit, terminal failures fall to the
+        recovery path.  Any record whose request was settled meanwhile
+        (cancel/purge) is aborted so its pin cannot leak."""
+        for fid in list(self._handoffs.records):
+            rec = self._handoffs.records.get(fid)
+            if rec is None:
+                continue
+            fr = self._requests.get(fid)
+            if fr is None or fid not in self._live:
+                self._handoffs.abort(rec, "request settled mid-handoff")
+                self.metrics.c_handoff_aborted.inc()
+                self.metrics.on_handoff("abort", fid, rec.src, rec.dst,
+                                        reason=rec.reason)
+                continue
+            dst = self._handoff_dst(fr, rec.tokens)
+            if dst is None:
+                rec.deferred_steps += 1
+                if rec.deferred_steps > self._handoffs.stage_patience:
+                    self._handoffs.abort(
+                        rec, "no decode replica could stage the "
+                             "transfer within patience")
+                    self._abort_metrics(rec)
+                    self._recover_handoff(fr, rec)
+                continue
+            src = self._handles[rec.src]
+            if self._handoffs.transfer(rec, src, dst, fr.prompt):
+                self._commit_handoff(fr, rec, dst)
+            elif rec.state == ABORTED:
+                self._abort_metrics(rec)
+                self._recover_handoff(fr, rec)
+            else:
+                # retryable in-flight fault: the record fell back to
+                # staged with the pin held; the next pump retries
+                self.metrics.c_handoff_retries.inc()
+                self.metrics.on_handoff("retry", fid, rec.src, rec.dst,
+                                        attempt=rec.transfer_attempts)
+
+    def _abort_metrics(self, rec) -> None:
+        self.metrics.c_handoff_aborted.inc()
+        self.metrics.on_handoff("abort", rec.fleet_id, rec.src, rec.dst,
+                                reason=rec.reason)
+
+    def _commit_handoff(self, fr: _FleetRequest, rec,
+                        dst: ReplicaHandle) -> None:
+        """Seal a successful transfer and hand the decode phase to the
+        destination.  A commit-stage fault (the ``handoff_commit``
+        chaos point) aborts instead — the blocks already moved, so the
+        recovery resubmission simply finds them cached."""
+        try:
+            self._handoffs.commit(rec)
+        except Exception as e:
+            self._handoffs.abort(rec, f"commit fault: {e!r}")
+            self._abort_metrics(rec)
+            self._recover_handoff(fr, rec)
+            return
+        self.metrics.c_handoff_committed.inc()
+        if rec.blocks_moved:
+            self.metrics.c_handoff_blocks.inc(rec.blocks_moved)
+        self.metrics.on_handoff("commit", fr.fleet_id, rec.src, rec.dst,
+                                blocks=rec.blocks_moved,
+                                tokens=rec.tokens)
+        self._place_decode_phase(
+            fr, first=dst,
+            why=f"handoff committed ({rec.blocks_moved} blocks)")
+
+    def _recover_handoff(self, fr: _FleetRequest, rec) -> None:
+        """An aborted handoff's fallback: RE-PREFILL on the decode
+        side — the request resubmits in full with no transferred state
+        (whatever blocks DID land are found by normal admission
+        matching).  When no decode replica accepts, the request fails
+        terminally at the router (the engine-side record is a stale
+        one-token view, so the terminal stamp lives on the fleet
+        record)."""
+        self._place_decode_phase(
+            fr, first=None, why=f"handoff aborted: {rec.reason}")
+
+    def _place_decode_phase(self, fr: _FleetRequest,
+                            first: Optional[ReplicaHandle],
+                            why: str) -> None:
+        """Resubmit the full request for its decode phase, preferring
+        ``first`` (the transfer destination — its cache is warm), then
+        every other eligible decode replica.  Exhaustion is terminal.
+        A deadline that expired while the handoff waited is terminal
+        ``deadline_exceeded`` — not a zero-budget resubmission whose
+        failure would masquerade as a placement problem (the same
+        short-circuit ``_try_failover`` performs)."""
+        now = time.perf_counter()
+        if fr.deadline_s is not None \
+                and now - fr.submit_time >= fr.deadline_s:
+            self.metrics.on_handoff("expired", fr.fleet_id, fr.replica,
+                                    -1, reason=why)
+            fr.override = ("deadline_exceeded",
+                           f"end-to-end deadline {fr.deadline_s}s "
+                           f"spent during the KV handoff ({why})")
+            self._live.discard(fr.fleet_id)
+            return
+        targets = [] if first is None else [first]
+        targets += [h for h in self._eligible("decode")
+                    if h not in targets]
+        src, src_rid = fr.replica, fr.engine_rid
+        for h in targets:
+            try:
+                rid = self._submit_to(h, fr, now=now)
+            except RequestRejected:
+                continue
+            fr.history.append((src, src_rid, why))
+            self._handles[src].engine.purge(src_rid)
+            fr.replica, fr.engine_rid = h.index, rid
+            fr.role_stage = "decode"
+            fr.handoffs += 1
+            h.routed += 1
+            return
+        self.metrics.c_handoff_failed.inc()
+        self.metrics.on_handoff("failed_terminal", fr.fleet_id, src, -1,
+                                reason=why)
+        fr.override = ("failed",
+                       f"no decode replica accepted the post-handoff "
+                       f"submission ({why})")
+        self._live.discard(fr.fleet_id)
+
     # ------------------------------------------------------------ drains
     def drain(self, replica: int) -> None:
         """Stop routing NEW work to ``replica`` (index) while its
         in-flight requests finish normally — the graceful half of
         taking a replica out of rotation.  Balance with
-        :meth:`undrain` (a registered graftlint ``ResourcePair``): a
-        drain leaked on an exception path silently shrinks the fleet."""
+        :meth:`undrain` — or :meth:`retire`, for permanent removal —
+        (a registered graftlint ``ResourcePair``): a drain leaked on an
+        exception path silently shrinks the fleet.
+
+        Edge semantics (pinned by unit tests): an out-of-range index
+        raises the descriptive ``KeyError`` every replica lookup uses;
+        draining an ALREADY-draining or retired replica raises
+        ``ValueError`` — a double drain is always a caller bug (two
+        owners both believe they hold the drain window)."""
         h = self._handle(replica)
+        if h.retired:
+            raise ValueError(
+                f"replica {replica} is retired — it left the rotation "
+                f"permanently and cannot be drained")
+        if h.draining:
+            raise ValueError(
+                f"replica {replica} is already draining — a second "
+                f"drain means two owners think they hold the drain "
+                f"window; undrain() first if that is intended")
         h.draining = True
         self.metrics.on_drain(replica, "drain")
         self.metrics.publish(self._handles)
 
     def undrain(self, replica: int) -> None:
         """Return a drained replica to the routing rotation
-        (idempotent)."""
+        (idempotent — undraining a non-draining replica is a no-op;
+        out-of-range indices still raise the descriptive KeyError;
+        retired replicas can never re-enter rotation)."""
         h = self._handle(replica)
+        if h.retired:
+            raise ValueError(
+                f"replica {replica} is retired — its engine is closed "
+                f"and it cannot return to rotation")
         h.draining = False
         self.metrics.on_drain(replica, "undrain")
         self.metrics.publish(self._handles)
@@ -601,22 +1075,59 @@ class Router:
                 f"to this router, or already purged")
         return fr
 
+    def _migrating(self, fr: _FleetRequest, out: RequestOutput) -> bool:
+        """True while a prefill-stage request's one-token run has
+        finished but the router still owes it a decode phase (handoff
+        staged/pending or about to be) — the engine-side 'finished'
+        is an interim view, not the request's terminal state."""
+        return (fr.fleet_id in self._live
+                and fr.role_stage == "prefill"
+                and out.finished and out.status == "finished"
+                and out.finish_reason != "eos"
+                and fr.max_new_tokens > 1)
+
     def result(self, fleet_id: int) -> RequestOutput:
         """The request's current view FROM ITS OWNING REPLICA (the map
-        is authoritative across failovers), re-keyed to the fleet id."""
+        is authoritative across failovers AND handoffs), re-keyed to
+        the fleet id.  While a handoff is mid-flight the view shows
+        the prefill side's delivered prefix with ``finished=False`` —
+        a polling client must not mistake the one-token prefill run
+        for the request's terminal state.  A router-level terminal
+        stamp (handoff placement exhausted) overrides the stale engine
+        record."""
         fr = self._record(fleet_id)
         out = self._handles[fr.replica].engine.result(fr.engine_rid)
+        if fr.override is not None:
+            status, reason = fr.override
+            out = dataclasses.replace(out, finished=True, status=status,
+                                      status_reason=reason)
+        elif self._migrating(fr, out):
+            out = dataclasses.replace(out, finished=False,
+                                      finish_reason=None, status=None,
+                                      status_reason=None)
         return dataclasses.replace(out, request_id=fleet_id)
+
+    def _abort_pending_handoff(self, fleet_id: int, why: str) -> None:
+        """Cancel/purge settled a request the pump still owns a pin
+        for: abort its handoff so the source-side pin releases NOW, not
+        at the next step."""
+        rec = self._handoffs.records.get(fleet_id)
+        if rec is not None:
+            self._handoffs.abort(rec, why)
+            self._abort_metrics(rec)
 
     def cancel(self, fleet_id: int) -> RequestOutput:
         """Cancel against the CURRENTLY-owning replica — after a
         failover the map already points at the new owner, so a cancel
         can never land on the stale replica's dead record.  Unknown or
         purged ids raise the same descriptive ``KeyError`` the engines
-        use; cancelling an already-terminal request is idempotent."""
+        use; cancelling an already-terminal request is idempotent.  A
+        pending KV handoff is aborted (its source pin releases
+        immediately)."""
         fr = self._record(fleet_id)
         out = self._handles[fr.replica].engine.cancel(fr.engine_rid)
         self._live.discard(fleet_id)   # settled: never fail over
+        self._abort_pending_handoff(fleet_id, "cancelled by client")
         return dataclasses.replace(out, request_id=fleet_id)
 
     def purge(self, fleet_id: int) -> RequestOutput:
@@ -626,35 +1137,65 @@ class Router:
         fr = self._record(fleet_id)
         out = self._handles[fr.replica].engine.purge(fr.engine_rid)
         self._live.discard(fleet_id)
+        self._abort_pending_handoff(fleet_id, "purged by client")
         del self._requests[fleet_id]
+        if fr.override is not None:
+            status, reason = fr.override
+            out = dataclasses.replace(out, finished=True, status=status,
+                                      status_reason=reason)
         return dataclasses.replace(out, request_id=fleet_id)
 
     # --------------------------------------------------------- lifecycle
-    def fleet_snapshot(self) -> Dict[str, object]:
-        """Per-replica diagnostic state (attached to the stall
-        detector's :class:`EngineStalledError`)."""
+    def stall_snapshot(self) -> Dict[str, object]:
+        """Fleet-scope diagnostic state: every replica's
+        ``EngineCore.stall_snapshot()`` plus the router's own view —
+        roles, drain/retire flags, queue depth, live requests, pending
+        handoffs and the autoscaler's state.  Attached to the stall
+        detector's :class:`EngineStalledError`, so
+        ``run_until_complete(stall_steps=)`` diagnoses wedges at fleet
+        scope the way a single engine's snapshot does for one plane."""
         return {
+            "queue_depth": self.queue_depth,
+            "in_flight": self.in_flight,
+            "live_requests": len(self._live),
+            "failovers": self.metrics.c_failovers.value,
+            "handoffs_pending": self._handoffs.pending,
+            "handoffs": self._handoffs.snapshot(),
+            "autoscaler": None if self._autoscaler is None
+            else self._autoscaler.snapshot(),
             "replicas": [
-                {"index": h.index, "draining": h.draining,
+                {"index": h.index, "role": h.role,
+                 "draining": h.draining, "retired": h.retired,
                  "routed": h.routed,
                  **h.engine.core.stall_snapshot()}
                 for h in self._handles],
-            "live_requests": len(self._live),
-            "failovers": self.metrics.c_failovers.value,
         }
+
+    def fleet_snapshot(self) -> Dict[str, object]:
+        """Back-compat alias for :meth:`stall_snapshot`."""
+        return self.stall_snapshot()
 
     def metrics_dict(self) -> Dict[str, object]:
         """Fleet-level counters + each replica's own
         ``metrics_dict()``."""
+        m = self.metrics
         return {
             "replicas": len(self._handles),
-            "requests_routed": self.metrics.c_routed.value,
-            "prefix_hit_tokens": self.metrics.c_hit_tokens.value,
-            "failovers": self.metrics.c_failovers.value,
-            "failovers_exhausted":
-                self.metrics.c_failover_exhausted.value,
-            "requests_rejected": self.metrics.c_rejected.value,
+            "requests_routed": m.c_routed.value,
+            "prefix_hit_tokens": m.c_hit_tokens.value,
+            "failovers": m.c_failovers.value,
+            "failovers_exhausted": m.c_failover_exhausted.value,
+            "requests_rejected": m.c_rejected.value,
             "queue_depth": self.queue_depth,
+            "roles": [h.role for h in self._handles],
+            "retired_replicas": sum(1 for h in self._handles
+                                    if h.retired),
+            "handoffs_staged": m.c_handoff_staged.value,
+            "handoffs_committed": m.c_handoff_committed.value,
+            "handoffs_aborted": m.c_handoff_aborted.value,
+            "handoff_retries": m.c_handoff_retries.value,
+            "handoff_blocks_moved": m.c_handoff_blocks.value,
+            "handoffs_failed_terminal": m.c_handoff_failed.value,
             "per_replica": [h.engine.metrics_dict()
                             for h in self._handles],
         }

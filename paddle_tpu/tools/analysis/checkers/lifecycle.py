@@ -26,12 +26,16 @@ Pair registration API — pass ``pairs=(ResourcePair(...), ...)`` to the
 checker (or extend :data:`DEFAULT_PAIRS`): ``acquire``/``release`` are
 method names matched at call sites; ``receiver_hint`` restricts matching
 to receiver expressions containing one of the substrings (keeps
-``re.match`` out of the ``PrefixCache.match``/``release`` pair).  Two
-acquire shapes are understood: ``h = recv.alloc()`` (handle = the bound
-name) and ``recv.pin(x)`` / ``lock.acquire()`` (handle = the argument,
-or the receiver itself when there is none).  An acquire whose result is
-consumed inline (``return pool.alloc()``, ``use(pool.alloc())``) escapes
-immediately and is never tracked.
+``re.match`` out of the ``PrefixCache.match``/``release`` pair).
+``alt_release`` names ADDITIONAL closing methods for protocols with more
+than one legal terminal — the fleet KV handoff's ``stage`` closes with
+``commit`` OR ``abort``, and a replica ``drain`` window closes with
+``undrain`` OR permanent ``retire``; any of them balances the acquire.
+Two acquire shapes are understood: ``h = recv.alloc()`` (handle = the
+bound name) and ``recv.pin(x)`` / ``lock.acquire()`` (handle = the
+argument, or the receiver itself when there is none).  An acquire whose
+result is consumed inline (``return pool.alloc()``, ``use(pool.alloc())``)
+escapes immediately and is never tracked.
 """
 
 from __future__ import annotations
@@ -48,11 +52,19 @@ __all__ = ["ResourcePair", "DEFAULT_PAIRS", "ResourceLifecycleChecker"]
 
 @dataclass(frozen=True)
 class ResourcePair:
-    """One registered alloc/free (or pin/unpin) method-name pair."""
+    """One registered alloc/free (or pin/unpin) method-name pair.
+    ``alt_release`` lists additional closing method names — protocols
+    with several legal terminals (commit-or-abort, undrain-or-retire)
+    register them here and any one balances the acquire."""
     acquire: str
     release: str
     kind: str                           # human label for messages
     receiver_hint: Tuple[str, ...] = ()  # require a substring, () = any
+    alt_release: Tuple[str, ...] = ()    # extra closing method names
+
+    @property
+    def releases(self) -> Tuple[str, ...]:
+        return (self.release,) + self.alt_release
 
     def receiver_ok(self, recv_text: str) -> bool:
         if not self.receiver_hint:
@@ -80,10 +92,23 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
                  receiver_hint=("fault",)),
     # serving/router.py Router: a drained replica takes no new work —
     # a drain leaked on an exception edge silently shrinks the fleet
-    # until an operator notices, so every drain must undrain on all
-    # paths (rebuild success OR failure)
+    # until an operator notices, so every drain must undrain (return to
+    # rotation) or retire (permanent, drained removal) on all paths
+    # (rebuild success OR failure)
     ResourcePair("drain", "undrain", "replica drain",
-                 receiver_hint=("router",)),
+                 receiver_hint=("router",), alt_release=("retire",)),
+    # serving/handoff.py HandoffManager: a staged KV handoff pins the
+    # prompt's radix path on the prefill replica — a stage that reaches
+    # neither commit nor abort leaks the pin (those blocks can never be
+    # evicted again), so the window must close on every path
+    ResourcePair("stage", "commit", "kv handoff",
+                 receiver_hint=("handoff",), alt_release=("abort",)),
+    # serving/autoscaler.py Autoscaler: a spawned decode replica must
+    # eventually retire (drain-based removal) or capacity accounting
+    # silently drifts — the spawn/retire window is the autoscaled
+    # replica's lifetime
+    ResourcePair("spawn", "retire", "autoscaled replica",
+                 receiver_hint=("scaler",)),
     # serving/health.py EngineHealth: a quarantine window opened by the
     # watchdog must close on every path (rebuild success OR failure), or
     # the engine reports quarantined forever
@@ -135,7 +160,8 @@ class ResourceLifecycleChecker(Checker):
 
     def __init__(self, pairs: Sequence[ResourcePair] = DEFAULT_PAIRS):
         self.pairs = tuple(pairs)
-        self._release_names = {p.release for p in self.pairs}
+        self._release_names = {name for p in self.pairs
+                               for name in p.releases}
 
     def check(self, ctx) -> List[Finding]:
         findings: List[Finding] = []
@@ -161,9 +187,11 @@ class ResourceLifecycleChecker(Checker):
                        if isinstance(m, (ast.FunctionDef,
                                          ast.AsyncFunctionDef))}
             for pair in self.pairs:
-                if pair.acquire in methods and pair.release in methods:
+                defined = [r for r in pair.releases if r in methods]
+                if pair.acquire in methods and defined:
                     out.add(id(methods[pair.acquire]))
-                    out.add(id(methods[pair.release]))
+                    for r in defined:
+                        out.add(id(methods[r]))
         return out
 
     # -------------------------------------------------------- function
@@ -177,8 +205,8 @@ class ResourceLifecycleChecker(Checker):
                     h.node.col_offset,
                     f"{h.pair.kind} `{h.text}` acquired via "
                     f"{h.recv}.{h.pair.acquire}() has no matching "
-                    f"{h.pair.release}() and never escapes this "
-                    f"function on some path — leaked handle",
+                    f"{'/'.join(h.pair.releases)}() and never escapes "
+                    f"this function on some path — leaked handle",
                     self.severity))
 
     # ----------------------------------------------------------- suites
@@ -302,7 +330,7 @@ class ResourceLifecycleChecker(Checker):
                 continue
             harg = _unparse(call.args[0]) if call.args else recv
             for key, h in list(handles.items()):
-                if h.pair.release != meth or h.recv != recv \
+                if meth not in h.pair.releases or h.recv != recv \
                         or h.text != harg:
                     continue
                 if h.states == {_REL}:
@@ -397,7 +425,7 @@ class ResourceLifecycleChecker(Checker):
 
     def _sig_matches(self, h: _Handle,
                      sigs: Set[Tuple[str, str, str]]) -> bool:
-        return any(meth == h.pair.release and recv == h.recv
+        return any(meth in h.pair.releases and recv == h.recv
                    and harg == h.text for meth, recv, harg in sigs)
 
     def _escapes(self, stmt, h: _Handle) -> bool:
@@ -427,7 +455,7 @@ class ResourceLifecycleChecker(Checker):
             if isinstance(sub, ast.Call):
                 mc = _method_call(sub)
                 is_release = (mc is not None
-                              and mc[1] == h.pair.release
+                              and mc[1] in h.pair.releases
                               and mc[0] == h.recv)
                 if is_release:
                     continue

@@ -163,6 +163,11 @@ ALLOWLIST: Dict[str, str] = {
         # tests/test_zz_fleet_serving.py
         "Router", "ReplicaHandle", "fleet_accounting",
         "replica_accounting",
+        # disaggregated fleet (ISSUE 13): the KV handoff state machine
+        # and the drain-based autoscaler — cross-replica transfer /
+        # capacity control plane, not array ops; contract =
+        # tests/test_zz_disagg_serving.py
+        "Handoff", "HandoffManager", "Autoscaler",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

@@ -18,13 +18,26 @@ fault end-to-end and emit the fleet-accounting evidence as artifacts
     eyeballed in their scraped form next to both replicas' serving
     counters.
 
+``--disaggregated`` switches to the ISSUE 13 fleet shape: THREE
+replicas (one PREFILL, two DECODE) behind role-aware routing — long
+prompts prefill on the prefill replica and migrate to a decode replica
+through the fault-tolerant KV handoff — with an attached autoscaler
+whose drain-based retirement takes one decode replica out of rotation
+MID-BURST (drain → in-flight finishes → close + retire).  The fault is
+armed on the ROUTER-level injector when ``--site`` is a ``handoff_*``
+point, on replica 0's otherwise.  The verdict additionally reports
+roles, handoff ledger conservation (staged == committed + aborted) and
+the retired replica's baseline.
+
 Usage:
     python scripts/fleet_chaos_smoke.py --out /tmp/fleet [--site step]
         [--at 2] [--times 3] [--requests 6] [--slots 2]
+        [--disaggregated]
 
 The script FAILS (exit 1) if the verdict is not ok or the fault never
-fired — tests/test_zz_fleet_serving.py runs it as a tier-1 artifact
-smoke, so the fleet recovery path cannot rot.
+fired — tests/test_zz_fleet_serving.py and
+tests/test_zz_disagg_serving.py run both modes as tier-1 artifact
+smokes, so neither recovery path can rot.
 """
 
 from __future__ import annotations
@@ -38,13 +51,19 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def build_workload(n_requests: int, vocab: int, seed: int = 0):
+def build_workload(n_requests: int, vocab: int, seed: int = 0,
+                   long_every: int = 0):
     """Mixed lengths plus one shared-prefix pair, same shape as
     chaos_smoke — the radix cache (and therefore prefix-affinity
-    routing) participates in the path being smoked."""
+    routing) participates in the path being smoked.  ``long_every``
+    interleaves a LONG prompt every that many requests (the
+    disaggregated mode's prefill-plane traffic)."""
     import numpy as np
     rs = np.random.RandomState(seed)
     lens = [3 + (i * 5) % 12 for i in range(n_requests)]
+    if long_every:
+        for i in range(0, n_requests, long_every):
+            lens[i] = 40 + 8 * (i % 3)
     prompts = [rs.randint(0, vocab, (L,)) for L in lens]
     if n_requests >= 2:
         prompts[-1] = np.concatenate(
@@ -70,18 +89,24 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--max-new-tokens", type=int, default=6)
+    ap.add_argument("--disaggregated", action="store_true",
+                    help="3-replica prefill/decode fleet with KV "
+                         "handoffs and a mid-burst drain retirement")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import paddle_tpu
     from paddle_tpu.models import GPTForCausalLM, gpt_tiny
     from paddle_tpu.obs import MetricsRegistry, Tracer
-    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
-                                    Router, ServingEngine)
+    from paddle_tpu.serving import (Autoscaler, FaultInjector,
+                                    FaultToleranceConfig, Router,
+                                    ServingEngine)
     from paddle_tpu.serving.faults import POINTS
 
     if args.site not in POINTS:
         ap.error(f"--site must be one of {POINTS}")
+    handoff_site = args.site.startswith("handoff_") \
+        or args.site == "replica_spawn"
 
     def model():
         # identical weights per replica: failover parity is the point
@@ -92,18 +117,41 @@ def main(argv=None) -> int:
 
     registry, tracer = MetricsRegistry(), Tracer()
     ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
-    faults = FaultInjector()           # armed on replica 0 only
-    replicas = [
-        ServingEngine(model(), num_slots=args.slots, min_bucket=8,
-                      fault_tolerance=ft, faults=faults,
-                      registry=registry, tracer=tracer),
-        ServingEngine(model(), num_slots=args.slots, min_bucket=8,
-                      fault_tolerance=ft,
-                      registry=registry, tracer=tracer),
-    ]
-    router = Router(replicas, registry=registry, tracer=tracer)
-    prompts = build_workload(args.requests,
-                             replicas[0].core.model.cfg.vocab_size)
+    faults = FaultInjector()
+    engine_kw = dict(num_slots=args.slots, min_bucket=8, block_len=8,
+                     fault_tolerance=ft, registry=registry,
+                     tracer=tracer)
+    if args.disaggregated:
+        # one prefill + two decode replicas; engine-level faults (when
+        # the site is not a handoff point) arm on the PREFILL replica —
+        # the hard case: its casualties carry pinned handoff state
+        roles = ("prefill", "decode", "decode")
+        replicas = [
+            ServingEngine(model(), role=r,
+                          faults=faults if i == 0 and not handoff_site
+                          else None, **engine_kw)
+            for i, r in enumerate(roles)]
+        router = Router(replicas, roles=roles, prefill_threshold=16,
+                        faults=faults if handoff_site else None,
+                        registry=registry, tracer=tracer)
+        scaler = Autoscaler(
+            router,
+            lambda: ServingEngine(model(), role="decode", **engine_kw),
+            min_decode=1, max_decode=3, scale_up_depth=10 ** 6,
+            hysteresis_steps=4, cooldown_steps=4,
+            faults=faults if args.site == "replica_spawn" else None)
+        prompts = build_workload(args.requests,
+                                 replicas[0].core.model.cfg.vocab_size,
+                                 long_every=2)
+    else:
+        replicas = [
+            ServingEngine(model(), faults=faults if i == 0 else None,
+                          **engine_kw)
+            for i in range(2)]
+        router = Router(replicas, registry=registry, tracer=tracer)
+        scaler = None
+        prompts = build_workload(args.requests,
+                                 replicas[0].core.model.cfg.vocab_size)
 
     half = max(len(prompts) // 2, 1)
     fids = [router.submit(p, max_new_tokens=args.max_new_tokens)
@@ -112,11 +160,33 @@ def main(argv=None) -> int:
     faults.enable(args.site, at=args.at, times=args.times,
                   seconds=args.seconds)
     try:
+        if scaler is not None:
+            # mid-burst drain-based retirement of decode replica 2:
+            # new work stops landing there immediately, its in-flight
+            # requests finish, and a later autoscaler tick closes it
+            scaler.retire(2)
+            if args.site == "replica_spawn":
+                # the tick never scales up here (scale_up_depth is
+                # parked out of reach), so drive spawn attempts across
+                # the armed window directly — armed hits must fail
+                # closed (topology untouched), unarmed ones must land
+                # as live decode replicas for the rest of the burst
+                spawn_results = []
+                for k in range(args.at + args.times):
+                    before = len(router.replicas)
+                    spawn_results.append(scaler.spawn())
+                    armed = args.at <= k < args.at + args.times
+                    assert (spawn_results[-1] is None) == armed
+                    assert len(router.replicas) \
+                        == (before if armed else before + 1)
         fids += [router.submit(p, max_new_tokens=args.max_new_tokens)
                  for p in prompts[half:]]
         router.run_until_complete(max_steps=10000)
     finally:
         faults.disable(args.site)
+    if scaler is not None:
+        for _ in range(8):          # let the retirement's close land
+            router.step()
 
     acc = router.accounting()
     rm = router.metrics_dict()
@@ -126,16 +196,25 @@ def main(argv=None) -> int:
         f.write(registry.prometheus())
     verdict = {
         "site": args.site,
+        "disaggregated": bool(args.disaggregated),
         "fired": faults.fired[args.site],
         "ok": acc["ok"],
         "all_terminal": acc["all_terminal"],
         "pools_at_baseline": acc["pools_at_baseline"],
         "served_at_most_once_retry": acc["served_at_most_once_retry"],
+        "handoffs_settled": acc["handoffs_settled"],
+        "handoffs_committed": acc["handoffs_committed"],
+        "handoffs_aborted": acc["handoffs_aborted"],
+        "handoff_blocks_moved": acc["handoff_blocks_moved"],
         "failovers": acc["failovers"],
         "failovers_exhausted": acc["failovers_exhausted"],
         "prefix_hit_tokens": rm["prefix_hit_tokens"],
+        "retired_replicas": rm["retired_replicas"],
+        "autoscaler": None if scaler is None else scaler.snapshot(),
         "requests": acc["requests"],
-        "replicas": [{"health": r["health"],
+        "replicas": [{"role": r["role"],
+                      "retired": r["retired"],
+                      "health": r["health"],
                       "quarantines": r["quarantines"],
                       "decode_traces": r["decode_traces"],
                       "ok": r["ok"]} for r in acc["replicas"]],
@@ -145,7 +224,15 @@ def main(argv=None) -> int:
     with open(fleet_path, "w") as f:
         json.dump(verdict, f, indent=2)
     print(json.dumps(verdict))
-    if not (acc["ok"] and faults.fired[args.site] >= 1):
+    ok = acc["ok"] and faults.fired[args.site] >= 1
+    if args.disaggregated:
+        # the disagg run must actually exercise the new machinery: at
+        # least one handoff settled and the forced mid-burst
+        # retirement completed (idle-tick scale-down may retire more)
+        ok = ok and (acc["handoffs_committed"]
+                     + acc["handoffs_aborted"]) >= 1 \
+            and rm["retired_replicas"] >= 1
+    if not ok:
         return 1
     return 0
 

@@ -341,6 +341,7 @@ ServingEngine Request RequestOutput SamplingParams
 EngineCore KVPool Scheduler ServingMetrics bucket_length sample_rows
 BlockPool PrefixCache MatchResult
 Router ReplicaHandle fleet_accounting replica_accounting
+Autoscaler Handoff HandoffManager
 """
 
 PADDLE_STATIC_NN = """

@@ -1,0 +1,23 @@
+"""Clean autoscaler spawn/retire idioms — zero findings.
+
+try/finally-protected spawn windows, adjacent spawn/retire, and
+non-scaler receivers the hint gate must leave alone.
+"""
+
+
+def protected_spawn_window(scaler, engine):
+    idx = scaler.spawn()
+    try:
+        engine.run_until_complete()
+    finally:
+        scaler.retire(idx)        # capacity restored on raise too
+
+
+def spawn_retire_adjacent(scaler):
+    idx = scaler.spawn()
+    scaler.retire(idx)            # nothing can raise in between
+
+
+def non_scaler_receiver_untracked(fishery, egg):
+    fishery.spawn(egg)            # hint gate: not an autoscaler
+    fishery.harvest()

@@ -1,0 +1,19 @@
+"""Planted resource-lifecycle bugs for the fleet autoscaler's
+spawn/retire ResourcePair — exactly 2 findings:
+
+  1. a spawned replica leaked on the exception edge (spawn -> raising
+     drain wait -> retire, unprotected);
+  2. a replica spawned and never retired at all.
+"""
+
+
+def spawn_leaks_on_raise(scaler, engine):
+    idx = scaler.spawn()          # BUG 1: leaks if the wait raises
+    engine.run_until_complete()
+    scaler.retire(idx)
+
+
+def spawned_and_forgotten(scaler):
+    idx = scaler.spawn()          # BUG 2: never retired, no escape
+    count = idx + 1
+    return count

@@ -1,0 +1,26 @@
+"""Clean KV-handoff stage/commit-or-abort idioms — zero findings.
+
+try/except-protected stage windows closed by EITHER terminal
+(commit on success, abort on failure — ``abort`` is the pair's
+registered alt release), adjacent stage/abort, and non-handoff
+receivers the hint gate must leave alone.
+"""
+
+
+def protected_stage_window(handoff_mgr, src, prompt, engine):
+    rec = handoff_mgr.stage(1, src, prompt)
+    try:
+        engine.step()
+        handoff_mgr.commit(rec)           # success terminal
+    except Exception:
+        handoff_mgr.abort(rec, "fault")   # failure terminal protects
+
+
+def abort_is_a_legal_close(handoff_mgr, src, prompt):
+    rec = handoff_mgr.stage(2, src, prompt)
+    handoff_mgr.abort(rec, "no target")   # alt release balances stage
+
+
+def non_handoff_receiver_untracked(theater, actor):
+    theater.stage(actor)                  # hint gate: not a handoff
+    theater.lights()

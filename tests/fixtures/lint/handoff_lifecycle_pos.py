@@ -1,0 +1,20 @@
+"""Planted resource-lifecycle bugs for the fleet KV handoff's
+stage/commit-or-abort ResourcePair — exactly 2 findings:
+
+  1. a staged handoff leaked on the exception edge (stage -> raising
+     engine step -> commit, unprotected — the prefill-side radix pin
+     would never release if the step raised);
+  2. a handoff staged and never committed nor aborted at all.
+"""
+
+
+def stage_leaks_on_raise(handoff_mgr, src, prompt, engine):
+    rec = handoff_mgr.stage(7, src, prompt)   # BUG 1: leaks if step raises
+    engine.step()
+    handoff_mgr.commit(rec)
+
+
+def staged_and_forgotten(handoff_mgr, src, prompt):
+    rec = handoff_mgr.stage(9, src, prompt)   # BUG 2: never closed
+    tokens = rec.tokens
+    return tokens

@@ -21,3 +21,13 @@ def adjacent_drain_undrain(router, idx):
 def non_router_receiver_untracked(valve, pump, idx):
     valve.drain(idx)             # hint gate: not a fleet router
     pump.cycle()
+
+
+def drain_closed_by_retire(router, engine, idx):
+    router.drain(idx)
+    try:
+        engine.run_until_complete()
+    finally:
+        router.retire(idx)       # permanent removal — the pair's
+        # registered alt release: a drained replica may leave the
+        # rotation for good instead of undraining

@@ -452,6 +452,68 @@ def test_router_drain_lifecycle_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_handoff_and_autoscaler_pairs_registered():
+    """ISSUE 13: the disaggregated fleet's KV handoff protocol
+    (stage closes with commit OR abort — the first multi-terminal
+    pair, via ``alt_release``) and the autoscaler's spawn/retire are
+    registered ResourcePairs, receiver-hinted so theatrical ``stage``
+    and biological ``spawn`` call sites stay untracked.  The replica
+    drain pair additionally accepts permanent ``retire`` as its alt
+    release."""
+    from paddle_tpu.tools.analysis.checkers.lifecycle import DEFAULT_PAIRS
+    by_kind = {p.kind: p for p in DEFAULT_PAIRS}
+    handoff = by_kind["kv handoff"]
+    assert handoff.acquire == "stage"
+    assert handoff.releases == ("commit", "abort")
+    assert "handoff" in handoff.receiver_hint
+    scaler = by_kind["autoscaled replica"]
+    assert scaler.acquire == "spawn" and scaler.release == "retire"
+    assert "scaler" in scaler.receiver_hint
+    drain = by_kind["replica drain"]
+    assert drain.releases == ("undrain", "retire")
+
+
+def test_handoff_lifecycle_positive():
+    """Exactly 2 planted bugs: a staged handoff leaked across a
+    raising engine step, and a handoff staged but never committed nor
+    aborted."""
+    res = run_rule("handoff_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "kv handoff" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+    assert "commit/abort" in msgs        # both terminals named
+
+
+def test_handoff_lifecycle_negative():
+    """commit-on-success/abort-on-failure windows, adjacent
+    stage/abort (the alt release balances), and non-handoff receivers
+    (hint gate) — silent."""
+    res = run_rule("handoff_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_autoscaler_lifecycle_positive():
+    """Exactly 2 planted bugs: a spawn leaked across a raising wait,
+    and a spawn never retired."""
+    res = run_rule("autoscaler_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "autoscaled replica" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+
+
+def test_autoscaler_lifecycle_negative():
+    """try/finally-protected spawn windows, adjacent spawn/retire, and
+    non-scaler receivers (hint gate) — silent."""
+    res = run_rule("autoscaler_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_resource_pair_registration_api():
     """Custom pairs plug in via the constructor — the documented
     registration API for new alloc/free protocols."""

@@ -1,0 +1,619 @@
+"""Disaggregated prefill/decode fleet: KV handoff + autoscaler chaos
+suite (ISSUE 13).
+
+THE invariant, extending the fleet total accounting across the role
+split: with a fault injected at ANY stage of the prefill->decode KV
+handoff (``handoff_gather`` / ``handoff_scatter`` / ``handoff_commit``)
+or in the autoscaler's spawn path (``replica_spawn``),
+
+  (a) every fleet request reaches a terminal status with a reason;
+  (b) every replica's pool free counts and radix refcounts return to
+      baseline on BOTH sides of the transfer — a handoff fault never
+      leaks a block, a staging slot, or a radix pin on either replica;
+  (c) delivered tokens match the faults-off oracle token-for-token
+      (greedy AND seeded sampling) with the exactly-once stream bound;
+  (d) the per-plane compile pin holds: {chunk}+buckets+ONE decode and
+      at most 1 gather + 1 scatter trace per plane — the handoff adds
+      ZERO new compiled programs;
+  (e) the handoff ledger conserves: staged == committed + aborted once
+      the fleet drains.
+
+Plus the role-routing surface (long prompts via the prefill plane,
+short prompts direct to decode), the autoscaler's spawn-behind-warmup
+gate and drain-based retirement, the prefill-replica-quarantine-
+mid-handoff failover, and the fleet-scope ``Router.stall_snapshot``.
+
+zz-prefixed for the same reason as test_zz_chaos_serving /
+test_zz_fleet_serving: early-alphabet placement reproducibly
+re-triggers the jaxlib-0.4 CPU dispatch-race segfault around the
+distributed test window (see tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.obs import MetricsRegistry, Tracer
+from paddle_tpu.serving import (Autoscaler, FaultInjector,
+                                FaultToleranceConfig, Router,
+                                SamplingParams, ServingEngine,
+                                fleet_accounting, replica_accounting)
+
+TERMINAL = {"finished", "cancelled", "deadline_exceeded", "rejected",
+            "failed"}
+
+
+def make_model():
+    """Identical weights on every call — replicas and the parity oracle
+    must agree token-for-token."""
+    paddle_tpu.seed(13)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return make_model()
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _want(model, prompt, n=5, **kw):
+    seq = model.generate(jnp.asarray(prompt)[None], max_new_tokens=n,
+                         **kw)
+    return np.asarray(seq)[0, len(prompt):]
+
+
+ENGINE_KW = dict(num_slots=2, min_bucket=8, block_len=8)
+
+
+def make_disagg_fleet(roles=("prefill", "decode", "decode"), *,
+                      retries=2, router_faults=None,
+                      engine_faults=(), prefill_threshold=16,
+                      **engine_kw):
+    """Role-split fleet on ONE registry/tracer; ``engine_faults`` maps
+    replica index -> injector (None elsewhere)."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=retries,
+                              backoff_base_s=0.0)
+    kw = dict(ENGINE_KW)
+    kw.update(engine_kw)
+    engines = [ServingEngine(make_model(), fault_tolerance=ft,
+                             faults=dict(engine_faults).get(i),
+                             registry=registry, tracer=tracer,
+                             role=r, **kw)
+               for i, r in enumerate(roles)]
+    return Router(engines, roles=roles,
+                  prefill_threshold=prefill_threshold,
+                  faults=router_faults,
+                  registry=registry, tracer=tracer)
+
+
+def assert_compile_pin(router):
+    """(d): ONE decode program and at most one gather/scatter trace per
+    device plane, whatever the handoff did."""
+    for h in router.replicas:
+        core = h.engine.core
+        assert core.trace_counts["decode"] \
+            == 1 + core.health.quarantine_count, h
+        assert core.block_pool.trace_counts["gather"] <= 1, h
+        assert core.block_pool.trace_counts["scatter"] <= 1, h
+
+
+# ------------------------------------------------------- role routing
+
+def test_roles_route_and_handoff_moves_blocks(oracle):
+    """Long prompts take the prefill plane and migrate; short prompts
+    go straight to decode; both come out token-for-token identical to
+    the oracle, the decode side prefilled only the tail of the
+    migrated prompt, and the handoff ledger + baselines conserve."""
+    router = make_disagg_fleet()
+    long_p = _prompts(1, (40,))[0]
+    short_p = _prompts(2, (6,))[0]
+    f_long = router.submit(long_p, max_new_tokens=5)
+    f_short = router.submit(short_p, max_new_tokens=5)
+    fr_long, fr_short = (router._requests[f] for f in (f_long, f_short))
+    assert router.replicas[fr_long.replica].role == "prefill"
+    assert fr_long.role_stage == "prefill"
+    assert router.replicas[fr_short.replica].role == "decode"
+    router.run_until_complete(500)
+    for fid, p in ((f_long, long_p), (f_short, short_p)):
+        out = router.result(fid)
+        assert out.status == "finished", (out.status, out.status_reason)
+        np.testing.assert_array_equal(out.tokens, _want(oracle, p))
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["handoffs_staged"] == 1
+    assert acc["handoffs_committed"] == 1
+    # 40-token prompt, block_len 8: (40-1)//8 = 4 transferable blocks
+    assert acc["handoff_blocks_moved"] == 4
+    fr = router._requests[f_long]
+    assert fr.role_stage == "decode" and fr.handoffs == 1
+    # the decode side re-prefilled ONLY the uncached tail: the owning
+    # decode replica's admission matched the 32 transferred tokens
+    dec = router.replicas[fr.replica].engine
+    assert dec.metrics.prefix_hit_tokens >= 32
+    assert_compile_pin(router)
+    # exactly-once: delivered positions are the full token count, once
+    assert fr.delivered == 5
+
+
+def test_short_fleet_without_prefill_role_unchanged(oracle):
+    """A unified fleet (no prefill roles) never stages a handoff —
+    the role machinery is inert for existing fleets."""
+    router = make_disagg_fleet(roles=("unified", "unified"))
+    assert not router.disaggregated
+    p = _prompts(3, (40,))[0]
+    fid = router.submit(p, max_new_tokens=4)
+    router.run_until_complete(300)
+    np.testing.assert_array_equal(router.result(fid).tokens,
+                                  _want(oracle, p, 4))
+    acc = fleet_accounting(router)
+    assert acc["ok"] and acc["handoffs_staged"] == 0
+
+
+def test_disagg_requires_explicit_prefill_threshold():
+    """A fleet with prefill roles must choose its split point: the
+    threshold default would otherwise silently route EVERY multi-token
+    prompt through the two-phase migration.  An explicit 0 is legal
+    (everything via the prefill plane)."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    engines = [ServingEngine(make_model(), registry=registry,
+                             tracer=tracer, role=r, **ENGINE_KW)
+               for r in ("prefill", "decode")]
+    with pytest.raises(ValueError, match="prefill_threshold"):
+        Router(engines, registry=registry, tracer=tracer)
+    r2 = Router(engines, prefill_threshold=0, registry=registry,
+                tracer=tracer)
+    assert r2.disaggregated
+    # unified fleets never need one
+    assert not make_disagg_fleet(roles=("unified",)).disaggregated
+
+
+def test_result_masks_interim_prefill_finish(oracle):
+    """A polling client (`while not result(fid).finished: step()`)
+    must not mistake the one-token prefill run for the terminal state
+    while the handoff is still pending — even when the transfer defers
+    behind a saturated decode replica."""
+    router = make_disagg_fleet(roles=("prefill", "decode"),
+                               num_slots=1)
+    busy = router.submit(_prompts(13, (5,))[0], max_new_tokens=20)
+    router.step()            # the only decode slot: handoff must defer
+    long_p = _prompts(14, (40,))[0]
+    fid = router.submit(long_p, max_new_tokens=4)
+    steps = 0
+    while not router.result(fid).finished:    # the natural poll loop
+        router.step()
+        steps += 1
+        assert steps < 400
+    out = router.result(fid)
+    assert out.status == "finished" and len(out.tokens) == 4
+    np.testing.assert_array_equal(out.tokens, _want(oracle, long_p, 4))
+    router.run_until_complete(400)
+    assert router.result(busy).status == "finished"
+    assert fleet_accounting(router)["ok"]
+
+
+# --------------------------------------------- handoff chaos per site
+
+def _run_handoff_chaos(site, times, oracle, sampling=None,
+                       lengths=(40, 33, 6)):
+    inj = FaultInjector()
+    router = make_disagg_fleet(roles=("prefill", "decode"),
+                               router_faults=inj)
+    prompts = _prompts(4, lengths)
+    kw = {} if sampling is None else {"sampling": sampling}
+    fids = [router.submit(p, max_new_tokens=5, **kw) for p in prompts]
+    inj.enable(site, times=times)
+    try:
+        router.run_until_complete(800)
+    finally:
+        inj.disable(site)
+    assert inj.fired[site] == times
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    gen_kw = {} if sampling is None else dict(
+        do_sample=True, temperature=sampling.temperature,
+        top_k=sampling.top_k, top_p=sampling.top_p)
+    for i, (fid, p) in enumerate(zip(fids, prompts)):
+        out = router.result(fid)
+        assert out.status == "finished", (site, times, out.status,
+                                          out.status_reason)
+        if sampling is not None:
+            gen_kw["seed"] = sampling.seed + i
+        np.testing.assert_array_equal(out.tokens,
+                                      _want(oracle, p, 5, **gen_kw))
+    assert_compile_pin(router)
+    return acc
+
+
+@pytest.mark.parametrize("site", ["handoff_gather", "handoff_scatter",
+                                  "handoff_commit"])
+def test_handoff_fault_single_retries_to_parity(site, oracle):
+    """One injected fault at each stage: the transfer retries (gather/
+    scatter) or aborts into the re-prefill path (commit — the blocks
+    already moved, so recovery finds them cached), and every request
+    still lands finished with oracle parity and conserved ledger."""
+    acc = _run_handoff_chaos(site, 1, oracle)
+    assert acc["handoffs_staged"] == 2
+    assert acc["handoffs_committed"] + acc["handoffs_aborted"] == 2
+
+
+@pytest.mark.parametrize("site", ["handoff_gather", "handoff_scatter"])
+def test_handoff_fault_double_aborts_to_reprefill(site, oracle):
+    """The retry ALSO faults (one long prompt, so both hits land on
+    the SAME handoff): the handoff aborts and the request re-prefills
+    on the decode side — still finished, still parity, nothing
+    leaked."""
+    acc = _run_handoff_chaos(site, 2, oracle, lengths=(40, 6))
+    assert acc["handoffs_staged"] == 1
+    assert acc["handoffs_aborted"] == 1
+    aborted = [r for r in acc["requests"] if "handoff aborted"
+               in " ".join(h["reason"] for h in r["history"])]
+    assert aborted, acc["requests"]
+
+
+def test_handoff_chaos_seeded_sampling_parity(oracle):
+    """(c) under sampling: the handoff's decode-side regeneration is
+    deterministic from the request seed, so a mid-transfer fault still
+    yields generate(seed=...) token-for-token."""
+    sp = SamplingParams(do_sample=True, temperature=1.3, top_k=7,
+                        top_p=0.9, seed=5)
+    # per-request seeds offset by index, mirroring serve_batch's policy
+    import dataclasses
+    inj = FaultInjector()
+    router = make_disagg_fleet(roles=("prefill", "decode"),
+                               router_faults=inj)
+    prompts = _prompts(5, (40, 6))
+    fids = [router.submit(p, max_new_tokens=5,
+                          sampling=dataclasses.replace(sp, seed=sp.seed + i))
+            for i, p in enumerate(prompts)]
+    inj.enable("handoff_gather", times=1)
+    try:
+        router.run_until_complete(800)
+    finally:
+        inj.disable("handoff_gather")
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    for i, (fid, p) in enumerate(zip(fids, prompts)):
+        out = router.result(fid)
+        assert out.status == "finished"
+        want = _want(oracle, p, 5, do_sample=True, temperature=1.3,
+                     top_k=7, top_p=0.9, seed=5 + i)
+        np.testing.assert_array_equal(out.tokens, want)
+    assert_compile_pin(router)
+
+
+# ------------------------------------- prefill quarantine mid-handoff
+
+def test_prefill_quarantine_mid_handoff_recovers_exactly_once(oracle):
+    """The source replica QUARANTINES while a handoff is staged (its
+    radix tree — and the pinned path — is rebuilt away): the transfer
+    detects the dead plane, aborts, and the request re-prefills on the
+    decode side exactly once with full parity; both replicas return to
+    baseline."""
+    inj = FaultInjector()
+    router = make_disagg_fleet(roles=("prefill", "decode"), retries=1,
+                               engine_faults={0: inj},
+                               num_slots=1)
+    router._handoffs.stage_patience = 200   # hold the staged window
+    # occupy the ONLY decode slot so the staged handoff must defer
+    busy = router.submit(_prompts(6, (5,))[0], max_new_tokens=30)
+    router.step()
+    assert router.replicas[1].engine.core.pool.free_slots == 0
+    # the long prompt prefills, finishes its TTFT token, stages
+    long_p = _prompts(7, (40,))[0]
+    fid = router.submit(long_p, max_new_tokens=4)
+    for _ in range(8):
+        router.step()
+        if fid in router._handoffs.records:
+            break
+    assert fid in router._handoffs.records
+    assert router._handoffs.records[fid].state == "staged"
+    # now quarantine the prefill replica: admission-time kv_alloc
+    # faults spend the retry budget (retries=1 -> 2 hits)
+    inj.enable("kv_alloc", times=2)
+    try:
+        trigger = router.submit(_prompts(8, (40,))[0], max_new_tokens=2)
+        for _ in range(10):
+            router.step()
+            if router.replicas[0].engine.core.health.quarantine_count:
+                break
+    finally:
+        inj.disable("kv_alloc")
+    assert router.replicas[0].engine.core.health.quarantine_count == 1
+    router.run_until_complete(800)
+    out = router.result(fid)
+    assert out.status == "finished", (out.status, out.status_reason)
+    np.testing.assert_array_equal(out.tokens, _want(oracle, long_p, 4))
+    fr = router._requests[fid]
+    assert fr.attempts <= 2 and fr.handoffs == 1
+    assert any("rebuilt its device plane" in h[2] for h in
+               [(r, e, w) for r, e, w in fr.history]), fr.history
+    # the trigger request and the busy one also settled terminally
+    for other in (busy, trigger):
+        assert router.result(other).status in TERMINAL
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["handoffs_aborted"] >= 1
+
+
+def test_src_rebuild_with_cache_bypassed_aborts_cleanly(oracle):
+    """Review regression: the source rebuilds with the prefix cache
+    LADDER-BYPASSED (``prefix_cache = None``) while a handoff is
+    staged — the dead plane must be detected (no ``None is None``
+    false-alive) and the abort path must release the stale pin without
+    touching the missing cache; the request still re-prefills to
+    parity."""
+    router = make_disagg_fleet(roles=("prefill", "decode"), num_slots=1)
+    router._handoffs.stage_patience = 200
+    busy = router.submit(_prompts(22, (5,))[0], max_new_tokens=25)
+    router.step()            # the only decode slot: handoff will defer
+    long_p = _prompts(23, (40,))[0]
+    fid = router.submit(long_p, max_new_tokens=4)
+    for _ in range(8):
+        router.step()
+        if fid in router._handoffs.records:
+            break
+    assert router._handoffs.records[fid].state == "staged"
+    src_core = router.replicas[0].engine.core
+    src_core.prefix_bypass = True
+    src_core._build_device_plane()     # rebuild drops the cache entirely
+    assert src_core.prefix_cache is None
+    router.run_until_complete(800)     # must not raise out of the pump
+    out = router.result(fid)
+    assert out.status == "finished", (out.status, out.status_reason)
+    np.testing.assert_array_equal(out.tokens, _want(oracle, long_p, 4))
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["handoffs_aborted"] >= 1
+
+
+def test_deadline_spent_during_handoff_is_deadline_exceeded():
+    """Review regression: a deadline that expires while the handoff
+    waits ends the request as terminal ``deadline_exceeded`` — not a
+    zero-budget resubmission mislabeled as a placement failure."""
+    router = make_disagg_fleet(roles=("prefill", "decode"), num_slots=1)
+    router._handoffs.stage_patience = 0    # first deferral aborts
+    busy = router.submit(_prompts(24, (5,))[0], max_new_tokens=30)
+    router.step()            # decode slot taken: the handoff must defer
+    long_p = _prompts(25, (40,))[0]
+    fid = router.submit(long_p, max_new_tokens=4, deadline_s=500.0)
+    # the budget was spent long ago, fleet-side (the engine-side clock
+    # is untouched, so the one-token prefill itself still completes)
+    router._requests[fid].submit_time -= 1000.0
+    router.run_until_complete(800)
+    out = router.result(fid)
+    assert out.status == "deadline_exceeded", (out.status,
+                                               out.status_reason)
+    assert "during the KV handoff" in out.status_reason
+    assert router.result(busy).status == "finished"
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["handoffs_aborted"] == 1
+
+
+# --------------------------------------------------------- autoscaler
+
+def make_autoscaled_fleet(scaler_faults=None, **scaler_kw):
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(backoff_base_s=0.0)
+
+    def mk(role):
+        return ServingEngine(make_model(), fault_tolerance=ft,
+                             registry=registry, tracer=tracer,
+                             role=role, **ENGINE_KW)
+    router = Router([mk("prefill"), mk("decode")],
+                    prefill_threshold=16,
+                    registry=registry, tracer=tracer)
+    kw = dict(min_decode=1, max_decode=3, scale_up_depth=2,
+              scale_down_depth=0, hysteresis_steps=2, cooldown_steps=3)
+    kw.update(scaler_kw)
+    scaler = Autoscaler(router, lambda: mk("decode"),
+                        faults=scaler_faults, **kw)
+    return router, scaler
+
+
+def test_autoscaler_spawns_on_pressure_and_retires_on_idle():
+    """Queue pressure spawns decode replicas (behind the warmup gate);
+    sustained idle retires the autoscaled ones through drain ->
+    drained -> close, with the whole lifecycle visible in the shared
+    registry and accounting clean across the topology change."""
+    router, scaler = make_autoscaled_fleet()
+    prompts = _prompts(9, (6,) * 10)
+    fids = [router.submit(p, max_new_tokens=4) for p in prompts]
+    router.run_until_complete(800)
+    assert scaler.snapshot()["spawns"] >= 1
+    assert len(router.replicas) > 2
+    spawned = [h for h in router.replicas[2:]]
+    assert all(h.role == "decode" for h in spawned)
+    for fid in fids:
+        assert router.result(fid).status == "finished"
+    # idle ticks: hysteresis + cooldown drive drain-based retirement
+    for _ in range(40):
+        router.step()
+    snap = scaler.snapshot()
+    assert snap["retires"] >= 1
+    retired = [h for h in router.replicas if h.retired]
+    assert retired and all(h.index >= 2 for h in retired)
+    # a retired replica is out of rotation permanently
+    with pytest.raises(ValueError, match="retired"):
+        router.drain(retired[0].index)
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    reg = router.registry.snapshot()
+    assert reg["autoscaler.spawns"] >= 1
+    assert reg["autoscaler.retires"] >= 1
+    ev = {e[0] for e in router.tracer.events()}
+    assert {"autoscaler_spawn", "autoscaler_retire",
+            "autoscaler_retired"} <= ev
+    # min_decode floor held: the original decode replica survives
+    assert not router.replicas[1].retired
+
+
+def test_replica_spawn_fault_never_routable():
+    """An injected ``replica_spawn`` fault: the half-built replica
+    never enters the rotation (topology untouched, spawn_failures
+    counted), and a later unarmed spawn succeeds and serves."""
+    inj = FaultInjector()
+    router, scaler = make_autoscaled_fleet(scaler_faults=inj,
+                                           cooldown_steps=0)
+    before = len(router.replicas)
+    inj.enable("replica_spawn", times=1)
+    try:
+        assert scaler.spawn() is None
+    finally:
+        inj.disable("replica_spawn")
+    assert len(router.replicas) == before          # topology untouched
+    assert scaler.snapshot()["spawn_failures"] == 1
+    assert router.registry.snapshot()["autoscaler.spawn_failures"] == 1
+    # unarmed: the next spawn lands and the new replica serves
+    idx = scaler.spawn()
+    assert idx == before
+    fid = router.submit(_prompts(10, (6,))[0], max_new_tokens=3)
+    router.run_until_complete(300)
+    assert router.result(fid).status == "finished"
+    assert fleet_accounting(router)["ok"]
+
+
+def test_spawn_warmup_failure_closes_half_built_engine():
+    """Review regression: when the factory succeeds but warmup_fn
+    raises, the half-built engine's telemetry is detached (closed) —
+    repeated warmup failures must not accumulate dead profiler
+    sources."""
+    router, _ = make_autoscaled_fleet()
+    tracer = router.tracer
+
+    def mk():
+        return ServingEngine(make_model(), registry=router.registry,
+                             tracer=tracer, record_events=True,
+                             role="decode", **ENGINE_KW)
+
+    def bad_warm(engine):
+        raise RuntimeError("warmup blew")
+
+    scaler = Autoscaler(router, mk, warmup_fn=bad_warm,
+                        min_decode=1, max_decode=3, scale_up_depth=2,
+                        hysteresis_steps=2, cooldown_steps=3)
+    before = tracer._install_count
+    assert scaler.spawn() is None
+    assert tracer._install_count == before     # closed, not leaked
+    assert scaler.snapshot()["spawn_failures"] == 1
+    assert len(router.replicas) == 2           # topology untouched
+
+
+def test_autoscaler_validation():
+    router, _ = make_autoscaled_fleet()
+    with pytest.raises(ValueError, match="min_decode"):
+        Autoscaler(router, lambda: None, min_decode=0)
+    with pytest.raises(ValueError, match="max_decode"):
+        Autoscaler(router, lambda: None, min_decode=3, max_decode=2)
+    with pytest.raises(ValueError, match="scale_up_depth"):
+        Autoscaler(router, lambda: None, scale_up_depth=2,
+                   scale_down_depth=2)
+
+
+# ----------------------------------------------- fleet-scope snapshot
+
+def test_router_stall_snapshot_fleet_scope():
+    """Satellite: ``Router.stall_snapshot()`` aggregates per-replica
+    ``EngineCore.stall_snapshot()`` plus router queue/role/handoff/
+    autoscaler state, and ``run_until_complete(stall_steps=)`` attaches
+    it to the fleet-scope ``EngineStalledError``."""
+    from paddle_tpu.serving import EngineStalledError
+    router, scaler = make_autoscaled_fleet()
+    snap = router.stall_snapshot()
+    assert snap["queue_depth"] == 0
+    assert snap["handoffs_pending"] == 0
+    assert snap["autoscaler"]["decode_replicas"] == 1
+    roles = [r["role"] for r in snap["replicas"]]
+    assert roles == ["prefill", "decode"]
+    for r in snap["replicas"]:
+        # the per-replica block IS the engine's own stall snapshot
+        assert {"queue_depth", "free_slots", "health",
+                "progress_counter"} <= set(r)
+        assert {"index", "draining", "retired", "routed"} <= set(r)
+    assert router.fleet_snapshot() == snap       # back-compat alias
+    # a wedged fleet raises with the fleet-scope snapshot attached:
+    # exhaust every decode slot from the outside so admission can
+    # never place the queued request
+    for h in router.replicas:
+        while h.engine.core.pool.free_slots:
+            h.engine.core.pool.alloc()
+    router.submit(_prompts(11, (6,))[0], max_new_tokens=2)
+    with pytest.raises(EngineStalledError) as ei:
+        router.run_until_complete(stall_steps=5)
+    diag = ei.value.snapshot
+    assert "replicas" in diag and len(diag["replicas"]) == 2
+    assert diag["queue_depth"] == 1
+    assert diag["replicas"][1]["free_slots"] == 0
+
+
+# ------------------------------------------------- handoff unit edges
+
+def test_handoff_manager_unit_edges():
+    """State-machine edges: a cold-cache stage commits trivially with
+    zero blocks; abort is idempotent; transfer on a terminal record
+    raises; the ledger counts every transition once."""
+    from paddle_tpu.serving.handoff import HandoffManager
+    router = make_disagg_fleet(roles=("prefill", "decode"))
+    src, dst = router.replicas
+    mgr = HandoffManager()
+    prompt = _prompts(12, (40,))[0]
+    rec = mgr.stage(0, src, prompt)
+    assert rec.state == "staged" and rec.tokens == 0   # cold cache
+    assert mgr.transfer(rec, src, dst, prompt)         # trivially ok
+    mgr.commit(rec)
+    assert rec.state == "committed" and rec.blocks_moved == 0
+    mgr.commit(rec)                                    # idempotent
+    with pytest.raises(RuntimeError, match="terminal"):
+        mgr.transfer(rec, src, dst, prompt)
+    rec2 = mgr.stage(1, src, prompt)
+    mgr.abort(rec2, "test abort")
+    mgr.abort(rec2, "second abort ignored")
+    assert rec2.state == "aborted" and rec2.reason == "test abort"
+    assert (mgr.staged, mgr.committed, mgr.aborted) == (2, 1, 1)
+    assert mgr.pending == 0
+    # the pin accounting on the source survived all of it
+    assert replica_accounting(src.engine)["ok"]
+
+
+def test_disagg_smoke_artifacts(tmp_path):
+    """Tier-1 artifact smoke: the 3-replica disaggregated scenario —
+    one prefill, two decode, one retired mid-burst, a handoff-stage
+    fault — end-to-end through scripts/fleet_chaos_smoke.py."""
+    import importlib.util
+    import json
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_chaos_smoke",
+        os.path.join(repo, "scripts", "fleet_chaos_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "artifacts")
+    assert mod.main(["--out", out, "--requests", "6",
+                     "--disaggregated", "--site", "handoff_gather",
+                     "--at", "0", "--times", "1"]) == 0
+    with open(os.path.join(out, "fleet.json")) as f:
+        v = json.load(f)
+    assert v["ok"] and v["all_terminal"] and v["pools_at_baseline"]
+    assert v["handoffs_settled"]
+    assert v["handoffs_committed"] + v["handoffs_aborted"] >= 1
+    assert v["retired_replicas"] == 1
+    assert v["fired"] >= 1
+    roles = [r["role"] for r in v["replicas"]]
+    assert roles == ["prefill", "decode", "decode"]
+    assert any(r["retired"] for r in v["replicas"])
+    assert {r["status"] for r in v["requests"]} <= TERMINAL
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "handoff_committed" in prom or "handoff_aborted" in prom
+    assert "router_role_prefill_replicas" in prom
+    assert "autoscaler_retires" in prom

@@ -247,6 +247,38 @@ def test_slo_rejection_propagates_best_replica_reason():
     assert fleet_accounting(router)["ok"]
 
 
+def test_drain_undrain_edge_semantics():
+    """Satellite (ISSUE 13): the previously-unspecified drain edges are
+    pinned — out-of-range indices raise the descriptive KeyError on
+    BOTH calls, a second drain of an already-draining replica raises a
+    descriptive ValueError (two owners cannot both hold the drain
+    window), undrain is idempotent, and a retired replica can do
+    neither."""
+    router, _ = make_fleet(n=2)
+    with pytest.raises(KeyError, match="unknown replica index 7"):
+        router.drain(7)
+    with pytest.raises(KeyError, match="unknown replica index -1"):
+        router.undrain(-1)
+    router.drain(0)
+    try:
+        with pytest.raises(ValueError, match="already draining"):
+            router.drain(0)
+        assert router.replicas[0].draining      # first drain stands
+    finally:
+        router.undrain(0)
+    router.undrain(0)                # idempotent: no-op, no raise
+    assert not router.replicas[0].draining
+    # a retired replica is out of the drain lifecycle entirely
+    router.drain(1)
+    router.retire(1)
+    with pytest.raises(ValueError, match="retired"):
+        router.drain(1)
+    with pytest.raises(ValueError, match="retired"):
+        router.undrain(1)
+    with pytest.raises(ValueError, match="already retired"):
+        router.retire(1)
+
+
 # ------------------------------------------------------------- failover
 
 def test_failover_exactly_once_with_parity(oracle):
@@ -381,6 +413,51 @@ def test_cancel_resolves_against_owning_replica_after_failover(oracle):
     router.run_until_complete(200)
     assert all(replica_accounting(h.engine)["ok"]
                for h in router.replicas)
+
+
+def test_double_fault_during_failover_resubmission(oracle):
+    """Satellite (ISSUE 13): a fault injected during the failover
+    RESUBMISSION itself — the retry's target replica quarantines while
+    serving the resubmitted request.  The idempotency bound must hold
+    (attempts == 2, no third submission), the request lands terminal
+    with a reason, and BOTH replicas' pools/refcounts return to
+    baseline."""
+    router, inj = make_fleet(n=2, retries=1, faulted=(0, 1))
+    p = _prompts(21, (5,))[0]
+    fid = router.submit(p, max_new_tokens=24)
+    router.step()                       # first owner decodes
+    src = router._requests[fid].replica
+    # quarantine the FIRST owner: 2 step faults spend retries=1
+    inj[src].enable("step", times=2)
+    try:
+        for _ in range(40):
+            router.step()
+            if router._requests[fid].replica != src:
+                break
+    finally:
+        inj[src].disable("step")
+    fr = router._requests[fid]
+    dst = fr.replica
+    assert dst != src and fr.attempts == 2
+    # now quarantine the RETRY's target mid-resubmission
+    inj[dst].enable("step", times=2)
+    try:
+        router.run_until_complete(400)
+    finally:
+        inj[dst].disable("step")
+    out = router.result(fid)
+    assert out.status == "failed"
+    assert "quarantine" in out.status_reason
+    assert fr.attempts == 2             # the second failure STANDS
+    rm = router.metrics_dict()
+    assert rm["failovers"] == 1
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["served_at_most_once_retry"]
+    for h in router.replicas:
+        ra = replica_accounting(h.engine)
+        assert ra["ok"], ra
+        assert h.engine.core.health.quarantine_count == 1
 
 
 # ------------------------------------------------- THE fleet chaos leg
