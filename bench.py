@@ -728,6 +728,19 @@ def _secondary_benches(smoke=False):
         out["truncated"] = "budget"
         return out
 
+    # 6d' durable-journal tax (ISSUE 14): the same mixed workload with
+    # the crash-consistency WAL on vs off — tok/s both ways, overhead
+    # fraction, records/bytes/fsyncs written.  The journal is pure host
+    # code riding existing host state, so the overhead column is the
+    # whole robustness price of surviving a process kill.
+    try:
+        out["serving_journal"] = _serving_journal_bench(dm, smoke=smoke)
+    except Exception as e:
+        out["serving_journal"] = {"error": repr(e)[-300:]}
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
     # 6e tensor-parallel serving scaling (ISSUE 9): the mixed-arrival
     # workload behind engines sharded at tp in {1, 2, 4, 8} — decode
     # tok/s + scaling efficiency per degree, TTFT p50/p99, token parity
@@ -1539,6 +1552,83 @@ def _serving_degraded_bench(model, smoke=False):
         "health": eng.health.state,
         "wall_s": round(t_end - t0, 2),
         "config": f"slots{slots}-reqs{n_reqs}-mixed-arrival-1-fault",
+    }
+
+
+def _serving_journal_bench(model, smoke=False):
+    """Durable-journal overhead row (ISSUE 14, docs/serving.md "Crash
+    recovery"): the mixed-arrival serving workload run twice on
+    identically-configured engines — journal OFF then journal ON (real
+    fsync durability, submit/terminal synced, progress batched) —
+    reporting tok/s both ways and the overhead fraction, plus the
+    journal's own write/fsync volume.  Token parity between the runs is
+    asserted (the journal must not perturb serving), and the journaled
+    run's ledger must conserve (every submit exactly one terminal)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import Journal, ServingEngine
+
+    rs = np.random.RandomState(11)
+    vocab = model.cfg.vocab_size
+    if smoke:
+        slots, n_reqs, base_new = 2, 6, 8
+        lens = [3, 9, 5, 12, 7, 4]
+    else:
+        slots, n_reqs, base_new = 8, 24, 64
+        lens = list(rs.randint(16, 257, size=n_reqs))
+    prompts = [rs.randint(0, vocab, (int(L),)) for L in lens]
+    news = [base_new + (i % 3) * (2 if smoke else 16)
+            for i in range(n_reqs)]
+
+    def run(journal):
+        eng = ServingEngine(model, num_slots=slots, journal=journal)
+        # warmup compiles every program so both passes time serving,
+        # not tracing (the journal writes nothing device-side anyway)
+        w = [eng.submit(p, max_new_tokens=2) for p in prompts[:slots]]
+        eng.run_until_complete(max_steps=20000)
+        for i in w:
+            eng.purge(i)
+        t0 = time.perf_counter()
+        ids = [eng.submit(p, max_new_tokens=n)
+               for p, n in zip(prompts, news)]
+        eng.run_until_complete(max_steps=20000)
+        wall = time.perf_counter() - t0
+        outs = [eng.purge(i) for i in ids]
+        toks = [list(o.tokens) for o in outs]
+        return sum(len(t) for t in toks) / wall, toks, wall
+
+    tps_off, toks_off, wall_off = run(None)
+    wal_dir = tempfile.mkdtemp(prefix="bench_wal_")
+    try:
+        journal = Journal.open(wal_dir)
+        try:
+            tps_on, toks_on, wall_on = run(journal)
+            if toks_on != toks_off:
+                raise RuntimeError("journal perturbed token streams")
+            led = journal.ledger()
+            conserved = all(v["submits"] == 1 and v["terminals"] == 1
+                            for v in led.values())
+            stats = {"records": journal.records_appended,
+                     "bytes": journal.bytes_appended,
+                     "fsyncs": journal.fsyncs}
+        finally:
+            journal.close()
+    finally:
+        shutil.rmtree(wal_dir, ignore_errors=True)
+    return {
+        "requests": n_reqs,
+        "num_slots": slots,
+        "tokens_per_sec_journal_off": round(tps_off, 1),
+        "tokens_per_sec_journal_on": round(tps_on, 1),
+        "overhead_frac": round(max(1.0 - tps_on / tps_off, 0.0), 4)
+        if tps_off > 0 else None,
+        "token_parity": True,
+        "ledger_conserved": bool(conserved),
+        **stats,
+        "wall_s_off": round(wall_off, 2),
+        "wall_s_on": round(wall_on, 2),
+        "config": f"slots{slots}-reqs{n_reqs}-mixed-arrival-fsync-on",
     }
 
 

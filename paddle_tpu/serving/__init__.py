@@ -8,8 +8,9 @@ built TPU-natively: a slot-pooled KV cache + shared-prefix block pool
 (prefix_cache), FCFS admission with pow2 prefill buckets, chunked
 prefill and a bounded head-of-line skip (scheduler), one compiled
 fixed-shape decode step with per-slot sampling (engine), a
-submit/step/stream surface (api), and off-hot-path telemetry — metrics
-registry + request-lifecycle tracing via paddle_tpu.obs (metrics).
+submit/step/stream surface (api), off-hot-path telemetry — metrics
+registry + request-lifecycle tracing via paddle_tpu.obs (metrics) —
+and a durable request journal for crash-consistent fleets (journal).
 See docs/serving.md and docs/observability.md.
 """
 
@@ -22,6 +23,7 @@ from .fleet import fleet_accounting, replica_accounting
 from .handoff import Handoff, HandoffManager
 from .health import (DegradationLadder, EngineHealth,
                      FaultToleranceConfig)
+from .journal import Journal, JournalError
 from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
 from .prefix_cache import MatchResult, PrefixCache
@@ -40,4 +42,6 @@ __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            "Router", "ReplicaHandle", "fleet_accounting",
            "replica_accounting",
            # disaggregated fleet (docs/serving.md "Disaggregated fleet")
-           "Autoscaler", "Handoff", "HandoffManager"]
+           "Autoscaler", "Handoff", "HandoffManager",
+           # crash consistency (docs/serving.md "Crash recovery")
+           "Journal", "JournalError"]

@@ -98,7 +98,8 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  tensor_parallel: int = 1,
                  collective_fusion: bool = True,
-                 role: str = "unified"):
+                 role: str = "unified",
+                 journal=None):
         # fleet role metadata (docs/serving.md "Disaggregated fleet"):
         # "prefill" replicas take only the router's prefill-stage work
         # (large prefill buckets, few slots), "decode" replicas take
@@ -131,7 +132,20 @@ class ServingEngine:
             fault_tolerance=fault_tolerance, faults=faults,
             max_queue=max_queue,
             tensor_parallel=tensor_parallel,
-            collective_fusion=collective_fusion)
+            collective_fusion=collective_fusion,
+            # durable request journal (serving/journal.py): single-
+            # engine deployments journal with ENGINE request ids; a
+            # fleet journals at the Router with fleet ids instead, so
+            # replicas behind a Router are built journal-less
+            journal=journal)
+        if journal is not None:
+            journal.bind_metrics(self.core.metrics.registry)
+            if journal.state:
+                # a reopened journal already holds request ids — the
+                # engine's counter must start past them or the new
+                # run's records alias the dead run's in the ledger
+                # (the Router does the same for fleet ids)
+                self.core.scheduler.start_ids(max(journal.state) + 1)
         self._requests = {}
 
     # -------------------------------------------------------- submission
@@ -194,6 +208,14 @@ class ServingEngine:
         sched.submit(req)
         self._requests[req.request_id] = req
         self.core.metrics.on_submit()
+        if self.core.journal is not None:
+            # journaled ONLY after acceptance: a rejected submission
+            # raised above and owes the ledger nothing
+            self.core.journal.append_submit(
+                req.request_id, req.prompt, max_new_tokens,
+                sampling=dataclasses.asdict(sampling),
+                eos_token_id=eos_token_id, deadline_s=deadline_s,
+                ttft_deadline_s=ttft_deadline_s)
         return req.request_id
 
     def cancel(self, request_id: int) -> RequestOutput:

@@ -84,6 +84,10 @@ class Autoscaler:
         self._cooldown = 0
         self._spawned: List[int] = []   # replica indices this loop added
         self._retiring: List[int] = []  # draining, waiting to retire
+        # killed replica indices whose replacement already spawned —
+        # resurrection is per-victim so a dead PREFILL replica is
+        # replaced in kind, not as yet another decode replica
+        self._resurrected: set = set()
         m = router.metrics
         g, c = m.registry.gauge, m.registry.counter
         self._g_decode = g("autoscaler.decode_replicas",
@@ -97,6 +101,10 @@ class Autoscaler:
             "autoscaler.spawn_failures",
             "replica spawns that failed before becoming routable "
             "(the half-built replica was never in rotation)")
+        self._c_resurrections = c(
+            "autoscaler.resurrections",
+            "replacements spawned for KILLED replicas (Router.kill — "
+            "crash resurrection through the normal warmup gate)")
         self._lane = m.lane             # events share the router's lane
         self._tracer = m.tracer
         self._publish()
@@ -116,8 +124,35 @@ class Autoscaler:
     def tick(self) -> Optional[str]:
         """One control iteration (the router calls this after every
         fleet step).  Returns the action taken ("spawn" / "retire" /
-        "retired:<i>") or None — test and operator visibility."""
+        "resurrect" / "retired:<i>") or None — test and operator
+        visibility."""
         action = self._finish_retirements()
+        # replica RESURRECTION (docs/serving.md "Crash recovery"): a
+        # killed replica is lost capacity, not queue noise — replace it
+        # IN KIND (same role) through the same spawn/warmup gate,
+        # ignoring hysteresis and cooldown (which exist to damp
+        # flapping on a noisy queue, not to slow crash recovery).  A
+        # failed spawn (replica_spawn chaos point) leaves the victim
+        # unresurrected and the next tick retries.
+        dead = [h for h in self.router.replicas
+                if h.killed and h.index not in self._resurrected]
+        for victim in dead:
+            # max_decode bounds only the decode plane; a dead prefill
+            # replica's replacement never counts against it — and a
+            # decode-capped victim at the head of the list must not
+            # starve later victims (a prefill replica especially)
+            if victim.serves("decode") \
+                    and self.decode_count() >= self.max_decode:
+                continue
+            if self.spawn(role=victim.role) is not None:
+                self._resurrected.add(victim.index)
+                self._c_resurrections.inc()
+                self._tracer.event("autoscaler_resurrect",
+                                   lane=self._lane,
+                                   replica=victim.index,
+                                   role=victim.role)
+                return "resurrect"
+            break       # spawn failed: retry next tick, no spawn storm
         if self._cooldown > 0:
             self._cooldown -= 1
             return action
@@ -147,6 +182,11 @@ class Autoscaler:
         retire)."""
         done = None
         for idx in list(self._retiring):
+            if self.router.replicas[idx].retired:
+                # killed (or otherwise force-removed) while draining:
+                # the handle already left the fleet — nothing to close
+                self._retiring.remove(idx)
+                continue
             if self.router.drained(idx):
                 self._retiring.remove(idx)
                 self.router.retire(idx)
@@ -167,12 +207,14 @@ class Autoscaler:
         return min(live, key=lambda h: (h.load, h.index)).index
 
     # ------------------------------------------------------ spawn/retire
-    def spawn(self) -> Optional[int]:
-        """Build one decode replica and add it to the rotation; returns
-        its replica index, or None when the spawn failed (the router is
-        then untouched — a half-built replica is never routable).
-        Balance with :meth:`retire` over the replica's life (registered
-        graftlint ``ResourcePair``)."""
+    def spawn(self, role: str = "decode") -> Optional[int]:
+        """Build one replica (``role`` defaults to the scaling loop's
+        decode plane; resurrection passes the dead replica's role so a
+        prefill victim is replaced in kind) and add it to the rotation;
+        returns its replica index, or None when the spawn failed (the
+        router is then untouched — a half-built replica is never
+        routable).  Balance with :meth:`retire` over the replica's life
+        (registered graftlint ``ResourcePair``)."""
         engine = None
         try:
             if self.faults is not None:
@@ -195,12 +237,16 @@ class Autoscaler:
             self._tracer.event("autoscaler_spawn_failed", lane=self._lane,
                                error=repr(e)[:200])
             return None
-        idx = self.router.add_replica(engine, role="decode")
-        self._spawned.append(idx)
+        idx = self.router.add_replica(engine, role=role)
+        if role != "prefill":
+            # scale-down only ever retires decode-capable autoscaled
+            # replicas — a resurrected prefill replica must never be
+            # picked as an idle-retirement victim
+            self._spawned.append(idx)
         self._c_spawns.inc()
         self._publish()
         self._tracer.event("autoscaler_spawn", lane=self._lane,
-                           replica=idx)
+                           replica=idx, role=role)
         return idx
 
     def retire(self, replica: int) -> None:
@@ -226,4 +272,6 @@ class Autoscaler:
             "spawns": self._c_spawns.value,
             "retires": self._c_retires.value,
             "spawn_failures": self._c_spawn_failures.value,
+            "resurrections": self._c_resurrections.value,
+            "resurrected_victims": sorted(self._resurrected),
         }

@@ -173,7 +173,8 @@ class EngineCore:
                  faults=None,
                  max_queue: Optional[int] = None,
                  tensor_parallel: int = 1,
-                 collective_fusion: bool = True):
+                 collective_fusion: bool = True,
+                 journal=None):
         if prefill_chunk is not None and prefill_chunk < min_bucket:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= min_bucket "
@@ -198,6 +199,14 @@ class EngineCore:
         # their semantics.  Deadlines, cancel() and backpressure are
         # always available.
         self.faults = faults                    # serving/faults.py hook
+        # durable request journal (serving/journal.py, docs/serving.md
+        # "Crash recovery"): submit records are written by the API
+        # facade, terminal records by _finalize, and the per-step
+        # delivered high-water marks batch at the END of the step —
+        # every site guards `if journal is None` (the faults pattern),
+        # so a journal-less engine pays nothing and compiles nothing new
+        self.journal = journal
+        self._journal_hwm: Dict[int, int] = {}
         self.fault_tolerant = fault_tolerance is not None
         self.ft = fault_tolerance if fault_tolerance is not None \
             else FaultToleranceConfig()
@@ -1016,6 +1025,8 @@ class EngineCore:
                     # (glossary: serving.collective_s)
                     self.metrics.on_collective(t_readback - t_prefill)
             self._evict_finished()
+            if self.journal is not None:
+                self._journal_progress()
         finally:
             # a raised step must still close the span and the trace
             # annotation, or every later event nests inside a phantom
@@ -1032,6 +1043,19 @@ class EngineCore:
             step_index=step_i,
             phases=phases)
         return self.scheduler.active + self.scheduler.queue_depth
+
+    def _journal_progress(self) -> None:
+        """Batch this step's delivered high-water marks into ONE journal
+        record (host ints the harvest loop already produced — nothing
+        here touches the device).  Runs at the end of the step, after
+        eviction, so a request that finished this step is covered by its
+        terminal record instead."""
+        updates = {}
+        for st in self._slots.values():
+            rid, n = st.req.request_id, len(st.req.tokens)
+            if n and self._journal_hwm.get(rid) != n:
+                updates[rid] = self._journal_hwm[rid] = n
+        self.journal.append_progress(updates)
 
     def _poison_slot(self, slot: int, step_i: int) -> None:
         """Chaos-only: overwrite position 0 of ``slot``'s layer-0 K row
@@ -1280,6 +1304,12 @@ class EngineCore:
         else:
             self.metrics.on_terminal(status, reason, req.request_id,
                                      now=now)
+        if self.journal is not None:
+            # exactly ONE terminal record per request: _finalize's
+            # idempotence guard above is the single stamping path
+            self._journal_hwm.pop(req.request_id, None)
+            self.journal.append_terminal(req.request_id, status, reason,
+                                         delivered=len(req.tokens))
         self._close_request_telemetry(req, now)
 
     def _close_request_telemetry(self, req: Request, now: float) -> None:

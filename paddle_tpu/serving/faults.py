@@ -42,6 +42,21 @@ Injection points (``POINTS``):
                       replica is half-built — it must never become
                       routable and the router topology must be
                       untouched
+  ``journal_write``   ``Journal._write`` raises before the record's
+                      frame lands — the journal queues the record for
+                      retry and the serving loop must not fail the
+                      request (serving/journal.py)
+  ``journal_fsync``   ``Journal._sync`` raises at the fsync — the bytes
+                      stay in the OS cache and the NEXT sync must cover
+                      them (fsync is cumulative)
+  ``journal_replay``  the recovery scan raises while folding a record —
+                      a single fault retries the side-effect-free scan
+                      from scratch, a persistent one raises
+                      ``JournalError`` with nothing half-recovered
+  ``replica_crash``   ``Router.step`` SIGKILLs one live replica
+                      (``Router.kill`` — no drain, no close); in-flight
+                      work must re-attribute through the existing
+                      failover path and the ledger must conserve
   =================  ====================================================
 
 Faults are armed per site with ``enable(site, at=..., times=...)``: the
@@ -71,7 +86,13 @@ POINTS = ("kv_alloc", "block_alloc", "block_exhausted", "gather",
           # serving/autoscaler.py), so arm them on the injector passed
           # to Router/Autoscaler, not on a replica engine's
           "handoff_gather", "handoff_scatter", "handoff_commit",
-          "replica_spawn")
+          "replica_spawn",
+          # crash-consistency sites (ISSUE 14): the durable request
+          # journal's write/fsync/replay paths (arm on the injector
+          # passed to Journal.open) and the router-level simulated
+          # replica SIGKILL (arm on the Router's injector)
+          "journal_write", "journal_fsync", "journal_replay",
+          "replica_crash")
 
 
 class FaultError(RuntimeError):

@@ -21,7 +21,15 @@ across N replicas behind a :class:`~paddle_tpu.serving.router.Router`:
       staged == committed + aborted once drained), so no prefill-side
       radix pin or decode-side staging slot can be outstanding, and the
       per-replica baselines of (b) hold on prefill, decode AND retired
-      replicas alike.
+      replicas alike;
+  (e) journaled fleets (``Router(journal=...)``) additionally conserve
+      the LEDGER: every journaled submit record reaches EXACTLY ONE
+      terminal record — across process incarnations — and the baselines
+      of (b) hold on every SURVIVING replica (a killed replica is a
+      dead process; its internals are unreadable by definition and it
+      is excluded from the roll-up, which is precisely why the ledger
+      check matters: the journal is the only accounting a crash cannot
+      destroy).
 
 These helpers compute the verdict as plain dicts so the chaos tests
 (``tests/test_zz_fleet_serving.py``), the CI smoke
@@ -119,27 +127,64 @@ def fleet_accounting(router) -> Dict[str, object]:
         })
     replicas = []
     for h in router.replicas:
+        if h.killed:
+            # a killed replica is a dead process: nothing inside it is
+            # readable, so it carries no baseline verdict — invariant
+            # (e)'s ledger check is what accounts for its casualties
+            replicas.append({"ok": None, "role": h.role,
+                             "retired": h.retired, "killed": True})
+            continue
         ra = replica_accounting(h.engine)
         ra["role"] = h.role
         ra["retired"] = h.retired
+        ra["killed"] = False
         replicas.append(ra)
+    surviving_ok = all(r["ok"] for r in replicas if not r["killed"])
     # invariant d: the handoff ledger is conserved — nothing left
     # mid-flight, and every open matched a terminal transition
     mgr = router._handoffs
     handoffs_settled = (mgr.pending == 0
                         and mgr.staged == mgr.committed + mgr.aborted)
+    # invariant e: journal-ledger conservation — every journaled submit
+    # reached exactly one terminal record (across incarnations; the
+    # ledger folds every surviving segment).  flush() first so pending
+    # retried writes (journal_write chaos) land before the audit.
+    journal = getattr(router, "journal", None)
+    journal_ok = True
+    ledger_summary = None
+    if journal is not None:
+        journal.flush()
+        led = journal.ledger()
+        # rows with NO submit record are documented crash artifacts
+        # (the submit write died with the process; docs/serving.md's
+        # replay matrix: "unreplayable, skipped — nothing strands") —
+        # reported as orphans, never as conservation violations
+        bad = {rid: v for rid, v in led.items()
+               if v["submits"] >= 1 and v["terminals"] != 1}
+        orphans = sorted(rid for rid, v in led.items()
+                         if v["submits"] == 0)
+        journal_ok = not bad
+        ledger_summary = {
+            "requests": len(led),
+            "violations": sorted(bad) if bad else [],
+            "orphans": orphans,
+            "pending_writes": journal.position()["pending_writes"],
+        }
     ok = bool(all_terminal and once_ok and handoffs_settled
-              and all(r["ok"] for r in replicas))
+              and journal_ok and surviving_ok)
     return {
         "ok": ok,
         "all_terminal": bool(all_terminal),
         "served_at_most_once_retry": bool(once_ok),
-        "pools_at_baseline": all(r["ok"] for r in replicas),
+        "pools_at_baseline": surviving_ok,
         "handoffs_settled": bool(handoffs_settled),
         "handoffs_staged": mgr.staged,
         "handoffs_committed": mgr.committed,
         "handoffs_aborted": mgr.aborted,
         "handoff_blocks_moved": mgr.blocks_moved,
+        "journal_conserved": bool(journal_ok),
+        "journal_ledger": ledger_summary,
+        "killed_replicas": sum(1 for r in replicas if r["killed"]),
         "requests": requests,
         "replicas": replicas,
         "failovers": router.metrics.c_failovers.value,

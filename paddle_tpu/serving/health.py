@@ -189,6 +189,17 @@ class EngineHealth:
         return min(self.cfg.backoff_base_s * (2 ** (n - 1)),
                    self.cfg.backoff_cap_s)
 
+    def mark_dead(self, reason: str) -> None:
+        """Pin this engine terminally dead — the state a fleet router
+        stamps on a KILLED replica (``Router.kill``'s simulated
+        SIGKILL).  Implemented as an opened circuit: ``routable`` goes
+        False forever, ``submit`` fail-fasts with ``circuit_open``, and
+        ``step`` becomes a no-op — so a stale direct reference to the
+        dead engine can never serve a request the fleet believes is
+        owned elsewhere."""
+        self._circuit_open = True
+        self.last_fault = reason
+
     # -------------------------------------------------------- quarantine
     def enter_quarantine(self, reason: str) -> _QuarantineToken:
         """Open a quarantine window (rebuild in progress).  Balance with

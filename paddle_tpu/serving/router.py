@@ -112,7 +112,7 @@ class ReplicaHandle:
     count)."""
 
     __slots__ = ("index", "engine", "role", "draining", "retired",
-                 "routed")
+                 "killed", "routed")
 
     def __init__(self, index: int, engine: ServingEngine,
                  role: str = "unified"):
@@ -126,6 +126,12 @@ class ReplicaHandle:
         # the fleet-id map) but their engine is closed and they never
         # re-enter rotation — the autoscaler's drain-based retirement
         self.retired = False
+        # killed replicas ALSO set retired (they left the fleet) but
+        # their engine was never drained or closed — Router.kill's
+        # simulated SIGKILL; fleet accounting skips their baselines
+        # (a dead process returns nothing) and the autoscaler's
+        # resurrection path spawns their replacement
+        self.killed = False
         self.routed = 0          # fleet requests ever routed here
 
     @property
@@ -146,7 +152,7 @@ class ReplicaHandle:
         return (f"ReplicaHandle({self.index}, role={self.role!r}, "
                 f"health={self.engine.health.state!r}, "
                 f"draining={self.draining}, retired={self.retired}, "
-                f"load={self.load})")
+                f"killed={self.killed}, load={self.load})")
 
 
 class _FleetRequest:
@@ -159,7 +165,8 @@ class _FleetRequest:
                  "eos_token_id", "client_stream", "deadline_s",
                  "ttft_deadline_s", "submit_time", "replica",
                  "engine_rid", "attempts", "delivered", "history",
-                 "role_stage", "handoffs", "override")
+                 "role_stage", "handoffs", "override",
+                 "journal_hwm", "journaled_submit", "journaled_terminal")
 
     def __init__(self, fleet_id: int, prompt: np.ndarray,
                  max_new_tokens: int, sampling, eos_token_id,
@@ -188,6 +195,12 @@ class _FleetRequest:
         # machinery exhausts every placement (the engine-side record is
         # then a stale 1-token "finished" view); result() applies it
         self.override: Optional[Tuple[str, str]] = None
+        # durable-journal bookkeeping (docs/serving.md "Crash
+        # recovery"): the last delivered mark journaled, and the
+        # exactly-once guards for the submit/terminal records
+        self.journal_hwm = 0
+        self.journaled_submit = False
+        self.journaled_terminal = False
 
 
 class _RouterMetrics:
@@ -254,6 +267,34 @@ class _RouterMetrics:
                                   "requests failed terminally because "
                                   "no decode replica could place the "
                                   "post-handoff submission")
+        # crash-consistency surface (docs/serving.md "Crash recovery";
+        # glossary rows in docs/observability.md)
+        self.g_killed = g("router.killed_replicas",
+                          "replicas SIGKILLed out of the fleet "
+                          "(no drain, no close)")
+        self.c_crash_reattributed = c(
+            "router.crash_reattributed",
+            "in-flight requests re-attributed through the failover "
+            "path after their replica was killed")
+        self.c_replay_resubmitted = c(
+            "router.replay_resubmitted",
+            "journaled non-terminal requests resubmitted by "
+            "Router.recover")
+        self.c_replay_expired = c(
+            "router.replay_expired",
+            "journaled requests whose deadline was spent across the "
+            "downtime — settled deadline_exceeded without resubmit")
+
+    def on_crash(self, phase: str, replica: int, **attrs) -> None:
+        """``crash_*`` lifecycle event on the router lane (kill,
+        re-attribution, resurrection)."""
+        self.tracer.event(f"crash_{phase}", lane=self.lane,
+                          replica=replica, **attrs)
+
+    def on_replay(self, phase: str, **attrs) -> None:
+        """``replay_*`` lifecycle event on the router lane (begin,
+        resubmit, expired, unplaced, done)."""
+        self.tracer.event(f"replay_{phase}", lane=self.lane, **attrs)
 
     def on_handoff(self, phase: str, fleet_id: int, src: int, dst: int,
                    **attrs) -> None:
@@ -303,6 +344,7 @@ class _RouterMetrics:
         self.g_decode.set(sum(1 for h in live if not h.draining
                               and h.role in ("decode", "unified")))
         self.g_retired.set(sum(1 for h in handles if h.retired))
+        self.g_killed.set(sum(1 for h in handles if h.killed))
 
 
 class Router:
@@ -333,7 +375,21 @@ class Router:
     the two-phase migration above it, so the split point is a sizing
     decision the operator must make (an explicit 0 routes everything
     through the prefill plane).  ``faults`` arms the router-level
-    chaos points (``handoff_*``) — None in production.
+    chaos points (``handoff_*``, ``replica_crash``) — None in
+    production.
+
+    ``journal`` attaches a durable request :class:`~paddle_tpu.serving.
+    journal.Journal` (docs/serving.md "Crash recovery"): every accepted
+    submit, the per-step delivered high-water marks, and every terminal
+    disposition are journaled with FLEET ids, off every engine's hot
+    path (``if journal is None`` — the faults pattern, zero overhead
+    when unset).  After a process crash, build a fresh fleet on the
+    reopened journal and call :meth:`recover` — non-terminal requests
+    resubmit and the journaled high-water mark dedups their
+    deterministic regeneration, so clients see each recorded token
+    position at most once.  Replicas behind a journaled router should
+    be built journal-LESS (the router's fleet-id records are the
+    authoritative ledger).
     """
 
     def __init__(self, replicas: Sequence[ServingEngine], *,
@@ -343,6 +399,7 @@ class Router:
                  roles: Optional[Sequence[str]] = None,
                  prefill_threshold: Optional[int] = None,
                  faults=None,
+                 journal=None,
                  registry=None, tracer=None):
         if not replicas:
             raise ValueError("Router needs at least one replica engine")
@@ -386,7 +443,15 @@ class Router:
         self._autoscaler = None       # attach via Autoscaler(router, ...)
         self._requests: Dict[int, _FleetRequest] = {}
         self._live: set = set()       # fleet ids the failover scan owns
-        self._ids = itertools.count()
+        self.journal = journal
+        if journal is not None:
+            journal.bind_metrics(self.registry)
+            # the fleet-id namespace must never reuse a journaled id —
+            # a reused id would collide two requests in the ledger
+            start = max(journal.state) + 1 if journal.state else 0
+            self._ids = itertools.count(start)
+        else:
+            self._ids = itertools.count()
         self._rr = 0                  # round-robin cursor (affinity off)
         self._closed = False
         self.metrics.publish(self._handles)
@@ -457,6 +522,27 @@ class Router:
         """Queued + placed requests across the fleet."""
         return sum(h.load for h in self._handles if not h.retired)
 
+    @property
+    def routable_count(self) -> int:
+        """Replicas that could take new decode-capable work right now
+        (role-compatible, in rotation, health routable) — the headline
+        number of the fail-fast snapshot ``run_until_complete`` raises
+        when the fleet is dead."""
+        return len(self._eligible("decode"))
+
+    @property
+    def fleet_dead(self) -> bool:
+        """True when NO replica can ever make progress again: every
+        handle is retired/killed or its circuit is open (a terminal
+        state — step() is a no-op there).  Draining and quarantined
+        replicas do NOT count as dead: a draining replica still
+        finishes its in-flight work and a quarantined one is
+        mid-rebuild.  ``run_until_complete`` fails fast on this instead
+        of spinning ``stall_steps`` idle iterations into the generic
+        no-progress stall."""
+        return all(h.retired or h.engine.health.circuit_open
+                   for h in self._handles)
+
     def _handle(self, replica: int) -> ReplicaHandle:
         if not 0 <= replica < len(self._handles):
             raise KeyError(
@@ -495,6 +581,216 @@ class Router:
         h.engine.close()
         self.metrics.on_drain(replica, "retire")
         self.metrics.publish(self._handles)
+
+    # ------------------------------------------------------------ crash
+    def kill(self, replica: int) -> int:
+        """Simulated SIGKILL of one replica: it vanishes from the fleet
+        WITHOUT drain or close — no in-flight request finishes, no
+        queue drains, no telemetry detaches (a dead process runs no
+        cleanup).  Every live fleet request it owned is re-attributed
+        on the spot through the existing failover path (same attempts
+        budget, same deadline shrinking, same delivered-high-water-mark
+        dedup); requests that cannot fail over settle terminally at the
+        router.  Pending KV handoffs touching the replica abort (their
+        source pins are host objects the manager still holds).  The
+        handle stays in place killed+retired — indices stay stable —
+        and the autoscaler's resurrection path spawns a replacement
+        through its normal warmup gate.  Returns the number of
+        re-attributed (resubmitted) requests."""
+        h = self._handle(replica)
+        if h.retired:
+            raise ValueError(
+                f"replica {replica} already left the fleet "
+                f"(retired/killed) — there is nothing to kill")
+        h.killed = True
+        h.retired = True            # out of rotation; engine NOT closed
+        # a stale direct reference to the dead engine must fail fast,
+        # not serve: the health machine pins it terminally dead
+        h.engine.health.mark_dead("killed (simulated SIGKILL)")
+        self.metrics.on_crash("kill", replica,
+                              live_requests=sum(
+                                  1 for fid in self._live
+                                  if self._requests[fid].replica
+                                  == replica))
+        # abort handoffs whose source or destination just died — the
+        # pin release is a host-side operation on objects the manager
+        # holds, so it is safe against the dead engine
+        for fid in list(self._handoffs.records):
+            rec = self._handoffs.records.get(fid)
+            if rec is not None and replica in (rec.src, rec.dst):
+                self._handoffs.abort(rec, f"replica {replica} killed "
+                                          f"mid-handoff")
+                self._abort_metrics(rec)
+        reattributed = 0
+        for fid in sorted(self._live):
+            fr = self._requests[fid]
+            if fr.replica != replica:
+                continue
+            if self._reattribute(fr, f"replica {replica} killed "
+                                     f"(simulated SIGKILL)"):
+                reattributed += 1
+        self.metrics.publish(self._handles)
+        return reattributed
+
+    def _reattribute(self, fr: _FleetRequest, reason: str) -> bool:
+        """Move one fleet request off a DEAD replica: the failover path
+        without an engine record to read (the dead replica's state is
+        gone by definition).  Returns True when a live replica accepted
+        the resubmission; False settles the request terminally at the
+        router (deadline spent, attempts exhausted, or no replica
+        accepted)."""
+        now = time.perf_counter()
+        dead = fr.replica
+
+        def settle(status: str, why: str) -> bool:
+            self.metrics.on_failover_exhausted(fr.fleet_id, dead, why)
+            fr.override = (status, why)
+            self._journal_terminal(fr, status, why)
+            self._live.discard(fr.fleet_id)
+            return False
+
+        if fr.deadline_s is not None \
+                and now - fr.submit_time >= fr.deadline_s:
+            return settle("deadline_exceeded",
+                          f"deadline spent when {reason}")
+        if fr.attempts >= 2:
+            return settle("failed",
+                          f"{reason}; failover budget already spent")
+        for h, hit in self._route_order(self._eligible("decode"),
+                                        fr.prompt):
+            try:
+                rid = self._submit_to(h, fr, now=now)
+            except RequestRejected:
+                continue
+            fr.history.append((dead, fr.engine_rid, reason))
+            fr.replica, fr.engine_rid = h.index, rid
+            fr.role_stage = "decode"
+            fr.attempts += 1
+            h.routed += 1
+            self.metrics.c_crash_reattributed.inc()
+            self.metrics.on_failover(fr.fleet_id, dead, h.index, reason)
+            return True
+        return settle("failed", f"{reason}; no live replica accepted "
+                                f"the re-attribution")
+
+    def recover(self, journal=None, *,
+                stream_factory: Optional[Callable] = None) -> Dict:
+        """Replay a reopened journal into this (fresh) fleet — the
+        restart half of crash consistency (docs/serving.md "Crash
+        recovery").  For every journaled submit with no terminal
+        record:
+
+          * the deadline budget is re-checked against WALL-CLOCK
+            downtime (the submit record carries ``time.time()``); a
+            request whose budget was spent while the process was dead
+            settles ``deadline_exceeded`` in the journal WITHOUT a
+            resubmission;
+          * everything else resubmits in full with the remaining
+            budget, and the journaled delivered high-water mark seeds
+            the exactly-once dedup — the deterministic regeneration
+            (same prompt, same seed, same greedy/sampling spec) re-runs
+            from position 0 but the client stream only sees positions
+            the dead incarnation had not recorded;
+          * recovered requests route decode-direct (no prefill-stage
+            shortcut — the failover rule: decode/unified replicas
+            prefill fine), and a resubmission every replica refuses
+            settles terminal ``failed``.
+
+        ``stream_factory(fleet_id)``, when given, builds the client
+        stream callback for each recovered request (the old process's
+        callbacks died with it).  Returns a summary dict
+        (``resubmitted`` / ``expired`` / ``unplaced`` counts).  Must
+        run before any new traffic — a recovered fleet id joining a
+        half-filled request map would alias."""
+        if journal is not None:
+            if self.journal is not None and self.journal is not journal:
+                raise ValueError(
+                    "router already has a different journal attached")
+            self.journal = journal
+            journal.bind_metrics(self.registry)
+        if self.journal is None:
+            raise ValueError(
+                "recover() needs a journal — attach one at construction "
+                "(Router(journal=...)) or pass it here")
+        if self._requests:
+            raise RuntimeError(
+                "recover() must run on a fresh router, before any "
+                "submit — recovered fleet ids would alias live ones")
+        replayable = self.journal.replay()
+        start = max(self.journal.state) + 1 if self.journal.state else 0
+        self._ids = itertools.count(start)
+        self.metrics.on_replay("begin", requests=len(replayable))
+        now_wall = time.time()
+        summary = {"resubmitted": 0, "expired": 0, "unplaced": 0}
+        for fid in sorted(replayable):
+            info = replayable[fid]
+            rec, delivered = info["record"], info["delivered"]
+            prompt = np.asarray(rec["prompt"], np.int32)
+            sampling = None if rec.get("sampling") is None \
+                else SamplingParams(**rec["sampling"])
+            fr = _FleetRequest(fid, prompt, rec["max_new_tokens"],
+                               sampling, rec.get("eos_token_id"),
+                               None if stream_factory is None
+                               else stream_factory(fid),
+                               rec.get("deadline_s"),
+                               rec.get("ttft_deadline_s"))
+            fr.journaled_submit = True     # this IS the journaled submit
+            fr.delivered = fr.journal_hwm = delivered
+            fr.submit_time = time.perf_counter()
+            # charge the downtime against the budgets: elapsed wall
+            # clock since the original submission, deadlines relative
+            elapsed = max(now_wall - rec.get("wall_time", now_wall), 0.0)
+            expired = None
+            if fr.deadline_s is not None:
+                fr.deadline_s -= elapsed
+                if fr.deadline_s <= 0:
+                    expired = (f"end-to-end deadline "
+                               f"{rec['deadline_s']}s spent across "
+                               f"{elapsed:.3f}s including downtime")
+            if fr.ttft_deadline_s is not None:
+                if delivered > 0:
+                    fr.ttft_deadline_s = None    # TTFT already met
+                else:
+                    fr.ttft_deadline_s -= elapsed
+                    if expired is None and fr.ttft_deadline_s <= 0:
+                        expired = (f"TTFT deadline "
+                                   f"{rec['ttft_deadline_s']}s spent "
+                                   f"across {elapsed:.3f}s including "
+                                   f"downtime")
+            if expired is not None:
+                fr.override = ("deadline_exceeded", expired)
+                self._journal_terminal(fr, *fr.override)
+                self._requests[fid] = fr
+                self.metrics.c_replay_expired.inc()
+                self.metrics.on_replay("expired", fleet_id=fid,
+                                       downtime_s=round(elapsed, 3))
+                summary["expired"] += 1
+                continue
+            placed = False
+            for h, hit in self._route_order(self._eligible("decode"),
+                                            prompt):
+                try:
+                    rid = self._submit_to(h, fr)
+                except RequestRejected:
+                    continue
+                self._place(fr, h, rid, hit)
+                placed = True
+                break
+            if placed:
+                self.metrics.c_replay_resubmitted.inc()
+                self.metrics.on_replay("resubmit", fleet_id=fid,
+                                       replica=fr.replica,
+                                       delivered=delivered)
+                summary["resubmitted"] += 1
+            else:
+                fr.override = ("failed", "no replica accepted the "
+                                         "recovered resubmission")
+                self._journal_terminal(fr, *fr.override)
+                self._requests[fid] = fr
+                self.metrics.on_replay("unplaced", fleet_id=fid)
+                summary["unplaced"] += 1
+        self.metrics.on_replay("done", **summary)
+        return summary
 
     def attach_autoscaler(self, autoscaler) -> None:
         """Register the autoscaler ``step()`` ticks (one per fleet
@@ -643,10 +939,45 @@ class Router:
         h.routed += 1
         self._requests[fr.fleet_id] = fr
         self._live.add(fr.fleet_id)
+        if self.journal is not None and not fr.journaled_submit:
+            # once per fleet id, EVER: a recovered request was already
+            # journaled by its first incarnation (recover() pre-sets
+            # the flag), and failovers re-place without re-journaling
+            fr.journaled_submit = True
+            self.journal.append_submit(
+                fr.fleet_id, fr.prompt, fr.max_new_tokens,
+                sampling=None if fr.sampling is None
+                else dataclasses.asdict(fr.sampling),
+                eos_token_id=fr.eos_token_id,
+                deadline_s=fr.deadline_s,
+                ttft_deadline_s=fr.ttft_deadline_s)
         if hit is None:             # round-robin: probe the winner only
             hit = h.engine.core.prefix_probe(fr.prompt)
         self.metrics.on_route(fr.fleet_id, h.index, hit)
         return fr.fleet_id
+
+    def _journal_terminal(self, fr: _FleetRequest, status: str,
+                          reason) -> None:
+        """Write one fleet request's terminal record — exactly once per
+        fleet id across every settle site (scan, cancel, purge, kill,
+        handoff exhaustion, recovery expiry)."""
+        if self.journal is None or fr.journaled_terminal \
+                or not fr.journaled_submit:
+            return
+        fr.journaled_terminal = True
+        self.journal.append_terminal(fr.fleet_id, status,
+                                     reason or status,
+                                     delivered=fr.delivered)
+
+    def _journal_progress(self) -> None:
+        """Batch this step's delivered high-water marks into ONE journal
+        record (host ints the dedup wrapper already tracks)."""
+        updates = {}
+        for fid in self._live:
+            fr = self._requests[fid]
+            if fr.delivered > fr.journal_hwm:
+                updates[fid] = fr.journal_hwm = fr.delivered
+        self.journal.append_progress(updates)
 
     def _reject(self, fleet_id: int, prompt: np.ndarray, reason: str,
                 retry_after_s: Optional[float]):
@@ -710,14 +1041,27 @@ class Router:
     def step(self) -> int:
         """One fleet iteration: step every live replica, run the
         failover scan over live requests, pump pending KV handoffs,
-        tick the autoscaler (when attached) and refresh the fleet
-        gauges.  Returns the number of requests still in flight
-        fleet-wide."""
+        journal this step's delivered high-water marks, tick the
+        autoscaler (when attached) and refresh the fleet gauges.
+        Returns the number of requests still in flight fleet-wide."""
+        if self.faults is not None:
+            # the replica_crash chaos point: SIGKILL the lowest-index
+            # live replica (deterministic for a deterministic workload
+            # — the chaos suite's replay-parity invariant needs the
+            # same arming to kill the same replica every run)
+            armed = self.faults.check("replica_crash")
+            if armed is not None:
+                for h in self._handles:
+                    if not h.retired:
+                        self.kill(h.index)
+                        break
         for h in self._handles:
             if not h.retired:
                 h.engine.step()
         self._scan_failover()
         self._pump_handoffs()
+        if self.journal is not None:
+            self._journal_progress()
         if self._autoscaler is not None:
             self._autoscaler.tick()
         self.metrics.publish(self._handles)
@@ -752,6 +1096,13 @@ class Router:
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(
                     f"fleet did not drain within {max_steps} steps")
+            if stall_steps is not None and self.fleet_dead:
+                # every replica killed/retired/circuit-open with work
+                # still outstanding: no number of idle steps can drain
+                # it — fail fast with the descriptive snapshot
+                # (routable count, journal position) instead of
+                # spinning to the generic no-progress stall
+                raise EngineStalledError(0, self.stall_snapshot())
             self.step()
             steps += 1
             p = self._progress()
@@ -807,6 +1158,8 @@ class Router:
                 # genuinely done; otherwise open the KV handoff and
                 # keep the fleet id live until the decode phase owns it
                 if req.finish_reason == "eos" or fr.max_new_tokens <= 1:
+                    self._journal_terminal(fr, req.status,
+                                           req.status_reason)
                     self._live.discard(fid)
                     continue
                 if fid not in self._handoffs.records:
@@ -818,6 +1171,7 @@ class Router:
                         _CLIENT_FAULT_PREFIX)):
                 if self._try_failover(fr, req):
                     continue        # re-owned: stays live on the target
+            self._journal_terminal(fr, req.status, req.status_reason)
             self._live.discard(fid)
 
     def _try_failover(self, fr: _FleetRequest, failed_req) -> bool:
@@ -992,6 +1346,7 @@ class Router:
             fr.override = ("deadline_exceeded",
                            f"end-to-end deadline {fr.deadline_s}s "
                            f"spent during the KV handoff ({why})")
+            self._journal_terminal(fr, *fr.override)
             self._live.discard(fr.fleet_id)
             return
         targets = [] if first is None else [first]
@@ -1016,6 +1371,7 @@ class Router:
         fr.override = ("failed",
                        f"no decode replica accepted the post-handoff "
                        f"submission ({why})")
+        self._journal_terminal(fr, *fr.override)
         self._live.discard(fr.fleet_id)
 
     # ------------------------------------------------------------ drains
@@ -1096,6 +1452,16 @@ class Router:
         stamp (handoff placement exhausted) overrides the stale engine
         record."""
         fr = self._record(fleet_id)
+        if fr.replica < 0:
+            # never placed on any engine (a recovered request whose
+            # deadline was spent across the downtime, or whose
+            # resubmission no replica accepted): the fleet record IS
+            # the terminal view
+            status, reason = fr.override
+            return RequestOutput(
+                request_id=fleet_id, prompt=fr.prompt, tokens=[],
+                finished=True, finish_reason=None, ttft_s=None,
+                status=status, status_reason=reason)
         out = self._handles[fr.replica].engine.result(fr.engine_rid)
         if fr.override is not None:
             status, reason = fr.override
@@ -1125,9 +1491,12 @@ class Router:
         pending KV handoff is aborted (its source pin releases
         immediately)."""
         fr = self._record(fleet_id)
+        if fr.replica < 0:
+            return self.result(fleet_id)   # already terminal, unplaced
         out = self._handles[fr.replica].engine.cancel(fr.engine_rid)
         self._live.discard(fleet_id)   # settled: never fail over
         self._abort_pending_handoff(fleet_id, "cancelled by client")
+        self._journal_terminal(fr, out.status, out.status_reason)
         return dataclasses.replace(out, request_id=fleet_id)
 
     def purge(self, fleet_id: int) -> RequestOutput:
@@ -1135,9 +1504,17 @@ class Router:
         owning engine's record).  Long-running fleets must consume
         results this way, exactly like single engines."""
         fr = self._record(fleet_id)
+        if fr.replica < 0:
+            out = self.result(fleet_id)
+            del self._requests[fleet_id]
+            return out
         out = self._handles[fr.replica].engine.purge(fr.engine_rid)
         self._live.discard(fleet_id)
         self._abort_pending_handoff(fleet_id, "purged by client")
+        self._journal_terminal(fr, out.status if fr.override is None
+                               else fr.override[0],
+                               out.status_reason if fr.override is None
+                               else fr.override[1])
         del self._requests[fleet_id]
         if fr.override is not None:
             status, reason = fr.override
@@ -1158,16 +1535,24 @@ class Router:
             "queue_depth": self.queue_depth,
             "in_flight": self.in_flight,
             "live_requests": len(self._live),
+            "routable_replicas": self.routable_count,
+            "fleet_dead": self.fleet_dead,
             "failovers": self.metrics.c_failovers.value,
             "handoffs_pending": self._handoffs.pending,
             "handoffs": self._handoffs.snapshot(),
+            "journal": None if self.journal is None
+            else self.journal.position(),
             "autoscaler": None if self._autoscaler is None
             else self._autoscaler.snapshot(),
             "replicas": [
                 {"index": h.index, "role": h.role,
                  "draining": h.draining, "retired": h.retired,
-                 "routed": h.routed,
-                 **h.engine.core.stall_snapshot()}
+                 "killed": h.killed, "routed": h.routed,
+                 # a killed replica's engine is a dead process: its
+                 # internals are unreadable by definition, so the
+                 # snapshot carries only the router-side view
+                 **({} if h.killed
+                    else h.engine.core.stall_snapshot())}
                 for h in self._handles],
         }
 
@@ -1190,6 +1575,13 @@ class Router:
             "roles": [h.role for h in self._handles],
             "retired_replicas": sum(1 for h in self._handles
                                     if h.retired),
+            "killed_replicas": sum(1 for h in self._handles
+                                   if h.killed),
+            "crash_reattributed": m.c_crash_reattributed.value,
+            "replay_resubmitted": m.c_replay_resubmitted.value,
+            "replay_expired": m.c_replay_expired.value,
+            "journal": None if self.journal is None
+            else self.journal.position(),
             "handoffs_staged": m.c_handoff_staged.value,
             "handoffs_committed": m.c_handoff_committed.value,
             "handoffs_aborted": m.c_handoff_aborted.value,

@@ -109,6 +109,21 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
     # replica's lifetime
     ResourcePair("spawn", "retire", "autoscaled replica",
                  receiver_hint=("scaler",)),
+    # serving/journal.py Journal: an open journal holds an OS file
+    # handle and an unflushed tail — a journal leaked on an exception
+    # path silently stops journaling AND pins the fd; close() is the
+    # graceful terminal, crash() the simulated-SIGKILL one (chaos/test
+    # helper).  Hinted to journal-ish receivers (both the factory
+    # classmethod `Journal.open` and a bound `journal` variable) so
+    # file/zipfile/module `open` call sites stay untracked
+    ResourcePair("open", "close", "request journal",
+                 receiver_hint=("journal", "Journal"),
+                 alt_release=("crash",)),
+    # serving/journal.py segment rotation: a begun segment must seal
+    # (flush + fsync + close) before the next begins, or two active
+    # tails interleave and the torn-tail recovery contract breaks
+    ResourcePair("begin_segment", "seal_segment", "journal segment",
+                 receiver_hint=("journal",)),
     # serving/health.py EngineHealth: a quarantine window opened by the
     # watchdog must close on every path (rebuild success OR failure), or
     # the engine reports quarantined forever
@@ -330,8 +345,14 @@ class ResourceLifecycleChecker(Checker):
                 continue
             harg = _unparse(call.args[0]) if call.args else recv
             for key, h in list(handles.items()):
-                if meth not in h.pair.releases or h.recv != recv \
-                        or h.text != harg:
+                # two legal release shapes: the ACQUIRE receiver
+                # releases the handle (`pool.free(slot)`), or the
+                # HANDLE releases itself (`journal.close()` balancing
+                # `journal = Journal.open(...)` — the factory-open
+                # protocol, where the classmethod receiver never
+                # reappears)
+                if meth not in h.pair.releases or h.text != harg \
+                        or (h.recv != recv and h.text != recv):
                     continue
                 if h.states == {_REL}:
                     findings.append(Finding(
@@ -425,8 +446,11 @@ class ResourceLifecycleChecker(Checker):
 
     def _sig_matches(self, h: _Handle,
                      sigs: Set[Tuple[str, str, str]]) -> bool:
-        return any(meth in h.pair.releases and recv == h.recv
-                   and harg == h.text for meth, recv, harg in sigs)
+        # same two release shapes as the main loop: acquire-receiver
+        # release, or the handle releasing itself (factory-open)
+        return any(meth in h.pair.releases and harg == h.text
+                   and (recv == h.recv or recv == h.text)
+                   for meth, recv, harg in sigs)
 
     def _escapes(self, stmt, h: _Handle) -> bool:
         """Does this statement hand the handle off — return/yield it,
@@ -456,7 +480,7 @@ class ResourceLifecycleChecker(Checker):
                 mc = _method_call(sub)
                 is_release = (mc is not None
                               and mc[1] in h.pair.releases
-                              and mc[0] == h.recv)
+                              and mc[0] in (h.recv, h.text))
                 if is_release:
                     continue
                 for a in list(sub.args) + [k.value for k in sub.keywords]:

@@ -168,6 +168,11 @@ ALLOWLIST: Dict[str, str] = {
         # capacity control plane, not array ops; contract =
         # tests/test_zz_disagg_serving.py
         "Handoff", "HandoffManager", "Autoscaler",
+        # crash consistency (ISSUE 14): the durable request journal
+        # (append-only CRC-framed WAL) — pure host-side persistence
+        # control plane, no array ops; contract =
+        # tests/test_zz_crash_serving.py
+        "Journal", "JournalError",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

@@ -29,10 +29,23 @@ point, on replica 0's otherwise.  The verdict additionally reports
 roles, handoff ledger conservation (staged == committed + aborted) and
 the retired replica's baseline.
 
+``--crash`` switches to the ISSUE 14 crash-consistency shape: a
+2-replica fleet journaled through a durable ``serving.Journal``
+(docs/serving.md "Crash recovery").  Mid-burst, one replica is
+SIGKILLed (``Router.kill`` — in-flight work re-attributes through the
+failover path), then the whole PROCESS "dies" (the journal crashes
+unflushed) — and a second incarnation recovers: a fresh fleet reopens
+the journal, ``Router.recover`` resubmits every non-terminal request
+with the delivered high-water mark deduping the deterministic
+regeneration, and the run completes.  The verdict is ``crash.json``:
+journal-ledger conservation (every journaled submit reached exactly
+one terminal record across BOTH incarnations) and replay parity (the
+merged client streams contain every token position exactly once).
+
 Usage:
     python scripts/fleet_chaos_smoke.py --out /tmp/fleet [--site step]
         [--at 2] [--times 3] [--requests 6] [--slots 2]
-        [--disaggregated]
+        [--disaggregated | --crash]
 
 The script FAILS (exit 1) if the verdict is not ok or the fault never
 fired — tests/test_zz_fleet_serving.py and
@@ -71,6 +84,142 @@ def build_workload(n_requests: int, vocab: int, seed: int = 0,
     return prompts
 
 
+def run_crash(args) -> int:
+    """The ``--crash`` scenario: journaled fleet -> mid-burst replica
+    SIGKILL -> simulated process death -> second-incarnation recovery.
+    Emits crash.json (ledger conservation + replay parity) and the
+    second incarnation's metrics.prom."""
+    import numpy as np
+    import paddle_tpu
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.obs import MetricsRegistry, Tracer
+    from paddle_tpu.serving import (FaultToleranceConfig, Journal,
+                                    Router, ServingEngine,
+                                    SamplingParams)
+
+    def model():
+        paddle_tpu.seed(7)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        return m
+
+    def fleet(journal):
+        registry, tracer = MetricsRegistry(), Tracer()
+        ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+        engines = [ServingEngine(model(), num_slots=args.slots,
+                                 min_bucket=8, block_len=8,
+                                 fault_tolerance=ft, registry=registry,
+                                 tracer=tracer) for _ in range(2)]
+        return Router(engines, journal=journal, registry=registry,
+                      tracer=tracer), registry
+
+    os.makedirs(args.out, exist_ok=True)
+    wal = os.path.join(args.out, "wal")
+    prompts = build_workload(args.requests, 256)
+
+    # ---- incarnation 1: journaled fleet, kill replica 0 mid-burst,
+    # then die without flushing (fsync_batch=1 keeps every record the
+    # durability matrix promises on disk)
+    journal = Journal.open(wal, fsync_batch=1)
+    streams = {}
+
+    def recorder(streams, fid):
+        streams[fid] = []
+
+        def cb(req, tok):
+            streams[fid].append((len(req.tokens) - 1, int(tok)))
+        return cb
+
+    try:
+        router, _ = fleet(journal)
+        fids = []
+        for i, p in enumerate(prompts):
+            samp = SamplingParams(do_sample=i % 2 == 1, temperature=0.9,
+                                  seed=i)
+            fid = router.submit(p, max_new_tokens=args.max_new_tokens,
+                                sampling=samp)
+            router._requests[fid].client_stream = recorder(streams, fid)
+            fids.append(fid)
+        for _ in range(3):
+            router.step()
+        reattributed = router.kill(0)   # SIGKILL mid-burst
+        router.step()
+    finally:
+        journal.crash()                 # the whole process dies
+
+    # ---- uninterrupted oracle: the same workload on a never-crashed
+    # fleet (identical weights/seeds) — the parity reference
+    oracle, _ = fleet(None)
+    ofids = [oracle.submit(p, max_new_tokens=args.max_new_tokens,
+                           sampling=SamplingParams(
+                               do_sample=i % 2 == 1, temperature=0.9,
+                               seed=i))
+             for i, p in enumerate(prompts)]
+    oracle.run_until_complete(max_steps=10000)
+    want = {i: list(oracle.result(f).tokens)
+            for i, f in enumerate(ofids)}
+
+    # ---- incarnation 2: reopen the journal, recover, finish
+    journal2 = Journal.open(wal, fsync_batch=1)
+    try:
+        router2, registry2 = fleet(journal2)
+        streams2 = {}
+        recovered = router2.recover(
+            stream_factory=lambda fid: recorder(streams2, fid))
+        router2.run_until_complete(max_steps=10000)
+        acc = router2.accounting()
+
+        # replay parity: merged client streams across both incarnations
+        # hold every oracle token at its position exactly once
+        parity = True
+        requests = []
+        ledger = journal2.ledger()
+        for i, fid in enumerate(fids):
+            pos1 = dict(streams.get(fid, []))
+            pos2 = dict(streams2.get(fid, []))
+            merged = {**pos1, **pos2}
+            dup = sorted(set(pos1) & set(pos2))
+            got = [merged[k] for k in sorted(merged)]
+            ok = (not dup and sorted(merged) == list(range(len(merged)))
+                  and got == [int(t) for t in want[i]])
+            parity &= ok
+            # a request that reached its terminal BEFORE the crash is
+            # (correctly) unknown to the recovered router — its status
+            # lives only in the journal ledger
+            status = (router2.result(fid).status
+                      if fid in router2._requests
+                      else ledger.get(fid, {}).get("status"))
+            requests.append({
+                "fleet_id": fid, "parity": ok, "duplicates": dup,
+                "tokens_incarnation1": len(pos1),
+                "tokens_incarnation2": len(pos2),
+                "status": status,
+            })
+        with open(os.path.join(args.out, "metrics.prom"), "w") as f:
+            f.write(registry2.prometheus())
+        verdict = {
+            "site": "replica_crash+process_crash",
+            "ok": bool(acc["ok"] and parity),
+            "ledger_conserved": acc["journal_conserved"],
+            "journal_ledger": acc["journal_ledger"],
+            "replay_parity": bool(parity),
+            "killed_replicas": 1,
+            "reattributed": reattributed,
+            "recovered": recovered,
+            "all_terminal": acc["all_terminal"],
+            "pools_at_baseline": acc["pools_at_baseline"],
+            "requests": requests,
+            "replicas": [{"killed": r["killed"], "ok": r["ok"]}
+                         for r in acc["replicas"]],
+        }
+        with open(os.path.join(args.out, "crash.json"), "w") as f:
+            json.dump(verdict, f, indent=2)
+        print(json.dumps(verdict))
+    finally:
+        journal2.close()
+    return 0 if verdict["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fleet_chaos_smoke",
                                  description=__doc__)
@@ -92,7 +241,14 @@ def main(argv=None) -> int:
     ap.add_argument("--disaggregated", action="store_true",
                     help="3-replica prefill/decode fleet with KV "
                          "handoffs and a mid-burst drain retirement")
+    ap.add_argument("--crash", action="store_true",
+                    help="journaled 2-replica fleet: SIGKILL one "
+                         "replica mid-burst, crash the process, "
+                         "recover a fresh fleet from the journal and "
+                         "emit the crash.json verdict")
     args = ap.parse_args(argv)
+    if args.crash and args.disaggregated:
+        ap.error("--crash and --disaggregated are separate scenarios")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import paddle_tpu
@@ -105,6 +261,8 @@ def main(argv=None) -> int:
 
     if args.site not in POINTS:
         ap.error(f"--site must be one of {POINTS}")
+    if args.crash:
+        return run_crash(args)
     handoff_site = args.site.startswith("handoff_") \
         or args.site == "replica_spawn"
 

@@ -342,6 +342,7 @@ EngineCore KVPool Scheduler ServingMetrics bucket_length sample_rows
 BlockPool PrefixCache MatchResult
 Router ReplicaHandle fleet_accounting replica_accounting
 Autoscaler Handoff HandoffManager
+Journal
 """
 
 PADDLE_STATIC_NN = """

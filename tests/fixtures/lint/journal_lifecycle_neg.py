@@ -1,0 +1,45 @@
+"""Clean request-journal lifecycle idioms — zero findings.
+
+try/finally-protected open windows closed by EITHER terminal (close on
+the graceful path, crash() — the registered alt release — in the
+simulated-SIGKILL chaos helper), adjacent open/close, a sealed segment
+rotation, and non-journal receivers the hint gate must leave alone
+(builtin file `open` has no receiver at all and is never tracked).
+"""
+
+
+def protected_open_window(Journal, path, fleet):
+    journal = Journal.open(path)
+    try:
+        fleet.run_until_complete()
+    finally:
+        journal.close()               # handle releases itself
+
+
+def crash_is_a_legal_close(Journal, path, fleet):
+    journal = Journal.open(path)
+    try:
+        fleet.step()
+        journal.close()
+    except Exception:
+        journal.crash()               # alt release balances open
+
+
+def adjacent_open_close(Journal, path):
+    journal = Journal.open(path)
+    journal.close()
+
+
+def sealed_rotation(journal):
+    journal.begin_segment()
+    journal.seal_segment()
+
+
+def non_journal_receivers_untracked(door, path):
+    door.open(path)                   # hint gate: not a journal
+    door.slam()
+
+
+def builtin_open_untracked(path):
+    with open(path) as fh:            # no receiver: never tracked
+        return fh.read()

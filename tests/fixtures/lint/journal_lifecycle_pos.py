@@ -1,0 +1,27 @@
+"""Planted resource-lifecycle bugs for the request-journal pairs —
+exactly 3 findings:
+
+  1. a journal opened and leaked on the exception edge (open ->
+     raising workload -> close, unprotected — the fd and the unflushed
+     tail leak if the fleet run raises);
+  2. a journal opened and never closed (nor crashed) at all;
+  3. a begun segment never sealed — the next rotation would interleave
+     two active tails.
+"""
+
+
+def open_leaks_on_raise(Journal, path, fleet):
+    journal = Journal.open(path)      # BUG 1: leaks if the run raises
+    fleet.run_until_complete()
+    journal.close()
+
+
+def opened_and_forgotten(Journal, path):
+    journal = Journal.open(path)      # BUG 2: never closed
+    pos = journal.position()
+    return pos
+
+
+def segment_never_sealed(journal, workload):
+    journal.begin_segment()           # BUG 3: never sealed
+    workload.record()

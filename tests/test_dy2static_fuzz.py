@@ -15,6 +15,7 @@ import textwrap
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 import paddle_tpu  # noqa: F401
 from paddle_tpu import jit as pjit
@@ -159,6 +160,11 @@ def _gen_program(seed):
     return src
 
 
+# ISSUE 14 tier-1 budget audit: 60 generated programs x 3 inputs cost
+# ~10s inside the 870s tier-1 window; the converter's supported subset
+# stays pinned fast by tests/test_dy2static.py's 43 directed tests.
+# The differential soak runs outside the window.
+@pytest.mark.slow
 def test_dy2static_differential_fuzz():
     failures = []
     import linecache

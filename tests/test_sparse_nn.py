@@ -352,6 +352,14 @@ class TestPaddingRowChaining:
 
 
 class TestEndToEnd:
+    # ISSUE 14 tier-1 budget audit: 30 training iterations over 8
+    # separately-built BCOO graphs cost ~4 minutes — by far the most
+    # expensive test in the suite, and the 870s tier-1 window was
+    # truncating exactly here.  The operators' correctness, gradients
+    # and jit behaviour stay pinned fast by TestConv3D / TestSubmConv3D
+    # (incl. test_jit_and_grad) and the dense-oracle chain tests; this
+    # end-to-end soak runs outside the window.
+    @pytest.mark.slow
     def test_sparse_cnn_trains(self):
         """SubmConv3D -> BatchNorm -> ReLU -> global sum readout learns a
         2-class point-cloud problem end-to-end under jit."""

@@ -514,6 +514,50 @@ def test_autoscaler_lifecycle_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_journal_pairs_registered():
+    """ISSUE 14: the durable request journal's open/close (crash() —
+    the simulated-SIGKILL chaos helper — is a legal alt release) and
+    segment begin/seal are registered ResourcePairs, receiver-hinted to
+    journal-ish receivers so builtin/file/module ``open`` call sites
+    stay untracked.  The hint covers BOTH the factory classmethod
+    (``Journal.open``) and bound ``journal`` variables — the release
+    arrives as a method on the HANDLE (``journal.close()``), the
+    factory-open shape the lifecycle checker matches explicitly."""
+    from paddle_tpu.tools.analysis.checkers.lifecycle import DEFAULT_PAIRS
+    by_kind = {p.kind: p for p in DEFAULT_PAIRS}
+    journal = by_kind["request journal"]
+    assert journal.acquire == "open"
+    assert journal.releases == ("close", "crash")
+    assert "journal" in journal.receiver_hint
+    assert "Journal" in journal.receiver_hint
+    seg = by_kind["journal segment"]
+    assert seg.acquire == "begin_segment"
+    assert seg.release == "seal_segment"
+    assert "journal" in seg.receiver_hint
+
+
+def test_journal_lifecycle_positive():
+    """Exactly 3 planted bugs: a journal leaked across a raising fleet
+    run, a journal never closed, and a begun segment never sealed."""
+    res = run_rule("journal_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 3, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "request journal" in msgs
+    assert "journal segment" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+    assert "close/crash" in msgs         # both terminals named
+
+
+def test_journal_lifecycle_negative():
+    """try/finally-protected open windows, crash() as the alt release,
+    adjacent open/close, sealed rotations, and non-journal receivers
+    (hint gate; builtin ``open`` has no receiver) — silent."""
+    res = run_rule("journal_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_resource_pair_registration_api():
     """Custom pairs plug in via the constructor — the documented
     registration API for new alloc/free protocols."""

@@ -144,6 +144,12 @@ def test_store_timeout_zero_is_nonblocking_probe():
     master.close()
 
 
+# ISSUE 14 tier-1 budget audit: the garbage-bytes fuzz costs ~20s of
+# call plus ~130s of socket-timeout teardown (~150s for one dot).  The
+# store's wire format and cross-process behaviour stay pinned fast by
+# test_native_store_cross_process_and_large_values and the tcp_store
+# master/client pair; this robustness soak runs outside the window.
+@pytest.mark.slow
 def test_native_store_survives_garbage_bytes():
     """Malformed frames must not crash or wedge the C++ server: it may
     error-reply or drop the connection, but it keeps serving others."""

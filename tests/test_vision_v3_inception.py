@@ -47,6 +47,11 @@ class TestMobileNetV3:
         with pytest.raises(RuntimeError, match="zero-egress"):
             models.mobilenet_v3_small(pretrained=True)
 
+    # ISSUE 14 tier-1 budget audit: two full value_and_grad passes
+    # through mobilenet_v3_small cost ~27s; the model surface stays
+    # pinned fast by the forward-shape, features-only and param-count
+    # tests above.  The training soak runs outside the tier-1 window.
+    @pytest.mark.slow
     def test_trains(self):
         import jax
         import jax.numpy as jnp

@@ -1,0 +1,784 @@
+"""Crash-consistent fleet: durable journal + exactly-once replay +
+replica resurrection (ISSUE 14).
+
+THE crash invariant, extending the fleet total accounting across
+PROCESS INCARNATIONS through the durable request journal
+(serving/journal.py, docs/serving.md "Crash recovery"):
+
+  (a) kill -> recover -> the client-observed token streams are
+      identical to the uninterrupted run, greedy AND seeded, with every
+      recorded position delivered at most once (the journaled
+      high-water mark dedups the deterministic regeneration);
+  (b) journal-ledger conservation: every journaled submit reaches
+      exactly one terminal record across incarnations, pools and radix
+      refcounts at baseline on every SURVIVING replica
+      (``fleet_accounting`` invariant (e)), chaos-pinned at all four
+      new injection points (``journal_write``, ``journal_fsync``,
+      ``journal_replay``, ``replica_crash``) single- and double-fault;
+  (c) the per-plane compile pin ({chunk}+buckets+ONE decode) holds on
+      recovered and resurrected replicas;
+  (d) zero overhead and zero new compiled programs with the journal
+      disabled (and none either way — the journal is pure host code).
+
+Plus the torn-write fuzz satellite (truncate at every byte offset of
+the tail record: recovery never raises, never replays a partial record,
+never loses a fully-synced one), the zero-routable fail-fast satellite,
+and the ``--crash`` smoke artifact.
+
+zz-prefixed for the same reason as the other serving suites: early-
+alphabet placement reproducibly re-triggers the jaxlib-0.4 CPU
+dispatch-race segfault around the distributed test window (see
+tests/conftest.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.obs import MetricsRegistry, Tracer
+from paddle_tpu.serving import (Autoscaler, EngineStalledError,
+                                FaultInjector, FaultToleranceConfig,
+                                Journal, JournalError, Router,
+                                SamplingParams, ServingEngine,
+                                fleet_accounting)
+
+TERMINAL = {"finished", "cancelled", "deadline_exceeded", "rejected",
+            "failed"}
+
+
+def make_model():
+    """Identical weights on every call — replicas, resurrected spawns
+    and the parity oracle must agree token-for-token."""
+    paddle_tpu.seed(21)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return make_model()
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _want(model, prompt, n=5):
+    seq = model.generate(jnp.asarray(prompt)[None], max_new_tokens=n)
+    return np.asarray(seq)[0, len(prompt):]
+
+
+def make_fleet(journal=None, n=2, faults=None, num_slots=2, **kw):
+    """Fleet of ``n`` fault-tolerant replicas (identical weights) on
+    ONE registry/tracer, optionally journaled at the router.  The
+    ``faults`` injector arms the ROUTER-level points
+    (``replica_crash``)."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+    engines = [ServingEngine(make_model(), num_slots=num_slots,
+                             min_bucket=8, fault_tolerance=ft,
+                             registry=registry, tracer=tracer, **kw)
+               for _ in range(n)]
+    return Router(engines, journal=journal, faults=faults,
+                  registry=registry, tracer=tracer)
+
+
+def submit_recorded(router, prompts, streamed, max_new=5, sampling=None):
+    """Submit every prompt with a stream recorder appending
+    ``(position, token)`` pairs under the fleet id."""
+    fids = []
+    for i, p in enumerate(prompts):
+        s = None
+        if sampling is not None:
+            s = SamplingParams(do_sample=True, temperature=0.9,
+                               seed=sampling + i)
+        fid = router.submit(p, max_new_tokens=max_new, sampling=s)
+        streamed.setdefault(fid, [])
+
+        def cb(req, tok, fid=fid):
+            streamed[fid].append((len(req.tokens) - 1, int(tok)))
+        router._requests[fid].client_stream = cb
+        fids.append(fid)
+    return fids
+
+
+# ----------------------------------------------------------- journal unit
+
+def test_journal_roundtrip_rotation_and_compaction(tmp_path):
+    """Frames survive close/reopen across segment rotations; sealed
+    fully-terminal segments compact away; the ledger and replay views
+    agree with what was written."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           segment_bytes=4096)
+    try:
+        for rid in range(30):
+            journal.append_submit(rid, [1, 2, rid], 4,
+                                  sampling={"do_sample": False,
+                                            "seed": rid})
+            journal.append_progress({rid: 2})
+            if rid < 25:
+                journal.append_terminal(rid, "finished", "length",
+                                        delivered=4)
+    finally:
+        journal.close()
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           segment_bytes=4096)
+    try:
+        assert len(journal.segments) > 1          # rotation happened
+        led = journal.ledger()
+        assert len(led) == 30
+        for rid in range(25):
+            assert led[rid]["terminals"] == 1
+            assert led[rid]["status"] == "finished"
+            assert led[rid]["delivered"] == 4
+        replay = journal.replay()
+        assert sorted(replay) == [25, 26, 27, 28, 29]
+        assert replay[25]["delivered"] == 2
+        assert replay[25]["record"]["prompt"] == [1, 2, 25]
+        assert replay[25]["record"]["sampling"]["seed"] == 25
+        # compaction: terminal-only sealed segments die, live ones stay
+        before = len(journal.segments)
+        removed = journal.compact()
+        assert removed >= 1
+        assert len(journal.segments) == before - removed
+    finally:
+        journal.close()
+    # recovery after compaction still replays the live requests
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False)
+    try:
+        assert sorted(journal.replay()) == [25, 26, 27, 28, 29]
+    finally:
+        journal.close()
+
+
+def test_rotation_attributes_record_to_landing_segment(tmp_path):
+    """REGRESSION (review): a record whose append triggers rotation
+    physically lands in the NEW segment — it must be attributed there,
+    or compact() could delete a sealed segment still holding a LIVE
+    request's only submit record."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           segment_bytes=4096)
+    try:
+        # fill segment 1 with TERMINAL history right up to the boundary
+        rid = 0
+        while journal._fh.tell() < 4096 - 400:
+            journal.append_submit(rid, list(range(20)), 4)
+            journal.append_terminal(rid, "finished", "length",
+                                    delivered=4)
+            rid += 1
+        assert len(journal.segments) == 1
+        # the LIVE submit is the record that triggers the rotation (its
+        # ~700-byte frame cannot fit the <400 bytes left): it physically
+        # lands in segment 2 and must be attributed there
+        live = 10_000
+        journal.append_submit(live, list(range(150)), 4)
+        assert len(journal.segments) == 2
+        # seal segment 2 too (so compact may consider both)
+        while len(journal.segments) == 2:
+            journal.append_submit(rid, list(range(20)), 4)
+            journal.append_terminal(rid, "finished", "length")
+            rid += 1
+        removed = journal.compact()
+        assert removed == 1           # only the all-terminal segment 1
+        journal.close()
+        # the live submit survives compaction and replays from disk
+        j2 = Journal.open(str(tmp_path / "wal"), fsync=False)
+        assert live in j2.replay(), sorted(j2.replay())
+        j2.close()
+        journal = Journal.open(str(tmp_path / "wal"), fsync=False)
+    finally:
+        journal.close()
+
+
+def test_pended_submit_keeps_forced_fsync_class(tmp_path):
+    """REGRESSION (review): a submit record whose write fails and lands
+    later via the pending-retry path still forces a sync when it lands
+    — its durability class travels with the frame, not with whichever
+    record happened to trigger the retry."""
+    faults = FaultInjector()
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           fsync_batch=100, faults=faults)
+    try:
+        faults.enable("journal_write", times=1)
+        try:
+            journal.append_submit(0, [1, 2, 3], 4)   # write fails, pends
+        finally:
+            faults.disable("journal_write")
+        assert journal.write_failures == 1
+        synced_before = journal.fsyncs
+        # a batched progress record (sync=False, batch=100) retries the
+        # pended submit — the landed submit must force the sync itself
+        journal.append_progress({0: 1})
+        assert journal.position()["pending_writes"] == 0
+        assert journal.fsyncs == synced_before + 1
+    finally:
+        journal.close()
+
+
+def test_engine_reopen_offsets_request_ids(tmp_path):
+    """REGRESSION (review): a fresh ServingEngine on a reopened journal
+    starts its request ids PAST the journaled ones — otherwise the new
+    run's id-0 records alias the dead run's in the ledger."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False)
+    journal.append_submit(0, [1, 2, 3], 4)     # dead run, non-terminal
+    journal.append_submit(1, [4, 5], 4)
+    journal.close()
+    j2 = Journal.open(str(tmp_path / "wal"), fsync=False)
+    eng = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                        journal=j2)
+    rid = eng.submit([7, 8, 9], max_new_tokens=2)
+    assert rid >= 2, rid                       # never reuses 0 or 1
+    eng.run_until_complete(200)
+    led = j2.ledger()
+    # the dead run's requests keep submits==1, terminals==0 — untouched
+    assert led[0]["submits"] == 1 and led[0]["terminals"] == 0
+    assert led[rid]["terminals"] == 1
+    j2.close()
+
+
+def test_resurrection_not_starved_by_capped_victim(oracle):
+    """REGRESSION (review): a decode-capped victim at the head of the
+    dead list must not starve later victims — a killed PREFILL replica
+    (exempt from max_decode) is still resurrected."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+    roles = ("decode", "decode", "prefill")
+    engines = [ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                             fault_tolerance=ft, registry=registry,
+                             tracer=tracer, role=r) for r in roles]
+    router = Router(engines, roles=roles, prefill_threshold=64,
+                    registry=registry, tracer=tracer)
+    scaler = Autoscaler(
+        router,
+        lambda: ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                              fault_tolerance=ft, registry=registry,
+                              tracer=tracer),
+        min_decode=1, max_decode=1,            # decode plane is capped
+        scale_up_depth=10 ** 6, hysteresis_steps=2, cooldown_steps=2)
+    router.kill(0)          # decode victim: capped (1 decode >= max 1)
+    assert scaler.tick() is None
+    router.kill(2)          # prefill victim behind the capped head
+    assert scaler.tick() == "resurrect"
+    new = router.replicas[-1]
+    assert new.role == "prefill"               # replaced in kind
+    assert scaler.snapshot()["resurrected_victims"] == [2]
+    router.close()
+
+
+def test_torn_write_fuzz_every_byte_offset(tmp_path):
+    """SATELLITE: truncate the journal at EVERY byte offset inside its
+    tail record — recovery must never raise, never replay a partial
+    record, and never lose a fully-synced earlier one."""
+    base = tmp_path / "wal"
+    journal = Journal.open(str(base), fsync=False)
+    try:
+        journal.append_submit(0, [5, 6, 7], 4,
+                              sampling={"do_sample": False, "seed": 0})
+        journal.append_progress({0: 3})
+    finally:
+        journal.close()
+    seg = base / "wal-00000001.seg"
+    data = seg.read_bytes()
+    # the tail record is the progress frame; everything before intact
+    intact = data.rfind(b'{"kind":"progress"') - 8
+    assert intact > 0
+    for cut in range(intact, len(data) + 1):
+        d = tmp_path / f"fuzz-{cut}"
+        d.mkdir()
+        (d / "wal-00000001.seg").write_bytes(data[:cut])
+        j = Journal.open(str(d), fsync=False)
+        try:
+            led = j.ledger()
+            # the synced submit is NEVER lost
+            assert led[0]["submits"] == 1
+            # a partial progress frame is NEVER half-applied: delivered
+            # is either the full journaled mark or nothing
+            assert led[0]["delivered"] in (0, 3)
+            if cut < len(data):
+                assert led[0]["delivered"] == 0
+            # the torn tail was truncated: appending again is clean
+            j.append_terminal(0, "finished", "length", delivered=4)
+            assert j.ledger()[0]["terminals"] == 1
+        finally:
+            j.close()
+
+
+def test_sealed_segment_corruption_is_loud(tmp_path):
+    """A torn frame in a NON-final segment is real damage, not a crash
+    artifact — recovery refuses it instead of silently dropping
+    everything after."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           segment_bytes=4096)
+    try:
+        for rid in range(30):
+            journal.append_submit(rid, list(range(40)), 4)
+    finally:
+        journal.close()
+    segs = sorted((tmp_path / "wal").glob("wal-*.seg"))
+    assert len(segs) > 1
+    data = segs[0].read_bytes()
+    segs[0].write_bytes(data[:len(data) // 2])      # mid-file tear
+    with pytest.raises(JournalError, match="sealed segment"):
+        Journal.open(str(tmp_path / "wal"), fsync=False)
+
+
+# ------------------------------------------------- crash -> recover parity
+
+def test_crash_replay_token_parity_greedy_and_seeded(tmp_path, oracle):
+    """ACCEPTANCE (a): kill one replica mid-burst, then crash the whole
+    process mid-burst; a fresh fleet recovered from the journal delivers
+    streams identical to the uninterrupted run — greedy AND seeded —
+    with every position at most once, ledger conserved, and the compile
+    pin intact on every recovered plane."""
+    prompts = _prompts(31, (5, 9, 12, 7))
+    # uninterrupted oracle run: greedy from generate(), seeded from an
+    # identical (but never-crashed) fleet
+    want_greedy = {i: _want(oracle, p) for i, p in enumerate(prompts)}
+    ref = make_fleet()
+    ref_fids = [ref.submit(p, max_new_tokens=5,
+                           sampling=SamplingParams(
+                               do_sample=True, temperature=0.9,
+                               seed=100 + i))
+                for i, p in enumerate(prompts)]
+    ref.run_until_complete(500)
+    want_seeded = {i: list(ref.result(f).tokens)
+                   for i, f in enumerate(ref_fids)}
+
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           fsync_batch=1)
+    router = make_fleet(journal=journal)
+    streamed = {}
+    greedy_fids = submit_recorded(router, prompts, streamed)
+    seeded_fids = submit_recorded(router, prompts, streamed,
+                                  sampling=100)
+    for _ in range(3):
+        router.step()
+    assert router.kill(0) >= 0          # SIGKILL one replica mid-burst
+    router.step()
+    journal.crash()                     # then the whole process dies
+
+    journal2 = Journal.open(str(tmp_path / "wal"), fsync=False,
+                            fsync_batch=1)
+    router2 = make_fleet(journal=journal2)
+    streamed2 = {}
+
+    def factory(fid):
+        streamed2[fid] = []
+
+        def cb(req, tok):
+            streamed2[fid].append((len(req.tokens) - 1, int(tok)))
+        return cb
+
+    summary = router2.recover(stream_factory=factory)
+    assert summary["expired"] == summary["unplaced"] == 0
+    router2.run_until_complete(800)
+    acc = fleet_accounting(router2)
+    assert acc["ok"], acc
+    assert acc["journal_conserved"]
+    for kind, fids, want in (("greedy", greedy_fids, want_greedy),
+                             ("seeded", seeded_fids, want_seeded)):
+        for i, fid in enumerate(fids):
+            pos1 = dict(streamed.get(fid, []))
+            pos2 = dict(streamed2.get(fid, []))
+            # at most once: a position the dead incarnation RECORDED
+            # (fsync_batch=1 -> recorded == delivered) never replays
+            assert not set(pos1) & set(pos2), (kind, i)
+            merged = {**pos1, **pos2}
+            assert sorted(merged) == list(range(len(merged)))
+            got = [merged[k] for k in sorted(merged)]
+            np.testing.assert_array_equal(got, want[i]), (kind, i)
+    # compile pin on every recovered plane: ONE decode program each
+    for h in router2.replicas:
+        assert h.engine.core.trace_counts["decode"] == 1
+    # incarnations share the ledger: terminal may land in either, but
+    # exactly once — and the journal saw every fleet id exactly once
+    led = journal2.ledger()
+    assert len(led) == len(prompts) * 2
+    assert all(v["submits"] == 1 and v["terminals"] == 1
+               for v in led.values())
+
+
+def test_deadline_recheck_across_downtime(tmp_path):
+    """Recovery charges WALL-CLOCK downtime against the journaled
+    deadline: a spent budget settles ``deadline_exceeded`` in the
+    journal WITHOUT a resubmission; an unexpired request resubmits with
+    the shrunken budget; a request whose first token was already
+    delivered carries no TTFT deadline into the replay."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False,
+                           fsync_batch=1)
+    router = make_fleet(journal=journal, n=1, num_slots=4)
+    p1, p2, p3 = _prompts(33, (5, 7, 6))
+    dead = router.submit(p1, max_new_tokens=8, deadline_s=60.0)
+    alive = router.submit(p2, max_new_tokens=8, deadline_s=600.0)
+    ttft_met = router.submit(p3, max_new_tokens=8,
+                             ttft_deadline_s=60.0)
+    for _ in range(2):
+        router.step()          # everyone delivers a first token
+    assert router._requests[ttft_met].delivered >= 1
+    journal.crash()
+
+    journal2 = Journal.open(str(tmp_path / "wal"), fsync=False,
+                            fsync_batch=1)
+    # simulate 2 minutes of downtime: recovery charges wall-clock time
+    # since the journaled submit against the deadline budgets — enough
+    # to spend dead's 60s, not alive's 600s (and ttft_met's TTFT was
+    # already met, so its TTFT deadline is dropped, not re-charged)
+    for led in journal2.state.values():
+        led.record["wall_time"] -= 120.0
+    router2 = make_fleet(journal=journal2, n=1, num_slots=4)
+    summary = router2.recover()
+    assert summary["expired"] == 1
+    assert summary["resubmitted"] == 2
+    out = router2.result(dead)
+    assert out.status == "deadline_exceeded"
+    assert "downtime" in out.status_reason
+    assert out.tokens == []                  # never resubmitted
+    router2.run_until_complete(400)
+    assert router2.result(alive).status == "finished"
+    assert router2.result(ttft_met).status == "finished"
+    acc = fleet_accounting(router2)
+    assert acc["ok"], acc
+    led = journal2.ledger()
+    assert led[dead]["status"] == "deadline_exceeded"
+    assert all(v["terminals"] == 1 for v in led.values())
+
+
+# ------------------------------------------------------- kill semantics
+
+def test_kill_reattributes_in_flight_exactly_once(oracle):
+    """Router.kill: the replica vanishes (no drain, no close), its
+    in-flight requests re-attribute through the failover path with the
+    delivered high-water mark deduping the regeneration, and the fleet
+    accounting holds with the killed replica excluded from baselines."""
+    router = make_fleet(n=2)
+    prompts = _prompts(35, (4, 6, 8, 5))
+    streamed = {}
+    fids = submit_recorded(router, prompts, streamed)
+    for _ in range(2):
+        router.step()
+    killed = router._requests[fids[0]].replica
+    reattributed = router.kill(killed)
+    assert reattributed >= 1
+    assert router.replicas[killed].killed
+    assert router.replicas[killed].retired
+    assert not router.replicas[killed].engine.health.routable
+    # a second kill of the same replica is a caller bug
+    with pytest.raises(ValueError, match="nothing to kill"):
+        router.kill(killed)
+    router.run_until_complete(500)
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    assert acc["killed_replicas"] == 1
+    # killed replicas carry no baseline verdict (dead process)
+    assert [r["ok"] for r in acc["replicas"]].count(None) == 1
+    for i, fid in enumerate(fids):
+        out = router.result(fid)
+        assert out.status == "finished", (out.status, out.status_reason)
+        np.testing.assert_array_equal(out.tokens,
+                                      _want(oracle, prompts[i]))
+        positions = [pos for pos, _ in streamed[fid]]
+        assert positions == list(range(5))       # exactly once
+    assert router.metrics.c_crash_reattributed.value >= 1
+
+
+def test_kill_last_replica_settles_everything_terminally():
+    """Killing the only replica leaves no failover target: every live
+    request settles terminally at the router (nothing strands, nothing
+    spins) and the fleet reports dead."""
+    router = make_fleet(n=1)
+    fids = [router.submit(p, max_new_tokens=6)
+            for p in _prompts(36, (4, 6, 5))]
+    router.step()
+    router.kill(0)
+    assert router.fleet_dead
+    assert not router.has_work()         # nothing strands
+    for fid in fids:
+        out = router.result(fid)
+        assert out.status in ("failed", "deadline_exceeded")
+        assert "killed" in out.status_reason
+    acc = fleet_accounting(router)
+    assert acc["all_terminal"], acc
+
+
+def test_autoscaler_resurrects_killed_replica(oracle):
+    """Resurrection rides the autoscaler's spawn/warmup gate: a kill is
+    replaced on the next tick (no hysteresis, no cooldown), an armed
+    ``replica_spawn`` fault fails closed and the NEXT tick retries, and
+    the resurrected plane serves with the compile pin intact."""
+    router = make_fleet(n=2)
+    spawn_faults = FaultInjector()
+
+    def spawn():
+        if spawn_faults is not None:
+            spawn_faults.fire("replica_spawn")
+        return ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                             fault_tolerance=FaultToleranceConfig(
+                                 max_step_retries=2, backoff_base_s=0.0),
+                             registry=router.registry,
+                             tracer=router.tracer)
+
+    scaler = Autoscaler(router, spawn, min_decode=1, max_decode=4,
+                        scale_up_depth=10 ** 6, hysteresis_steps=2,
+                        cooldown_steps=2)
+    fids = [router.submit(p, max_new_tokens=5)
+            for p in _prompts(37, (4, 6, 5, 7))]
+    router.step()
+    router.kill(0)
+    spawn_faults.enable("replica_spawn", times=1)
+    try:
+        assert scaler.tick() is None          # armed spawn fails closed
+        assert len(router.replicas) == 2
+        assert scaler.snapshot()["spawn_failures"] == 1
+        assert scaler.tick() == "resurrect"   # next tick retries clean
+    finally:
+        spawn_faults.disable("replica_spawn")
+    assert len(router.replicas) == 3
+    new = router.replicas[2]
+    # replaced IN KIND: the victim's role (unified here), not a blanket
+    # decode spawn — a dead prefill replica must restore the prefill
+    # plane, not grow the decode one
+    assert new.role == "unified" and not new.killed
+    assert scaler.snapshot()["resurrections"] == 1
+    assert scaler.snapshot()["resurrected_victims"] == [0]
+    router.run_until_complete(500)
+    for i, fid in enumerate(fids):
+        out = router.result(fid)
+        assert out.status == "finished", (out.status, out.status_reason)
+    # the resurrected plane compiled exactly the pinned program set
+    assert new.engine.core.trace_counts["decode"] <= 1
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+
+
+# ------------------------------------------------ the four chaos points
+
+def test_replica_crash_chaos_point_single_and_double(tmp_path):
+    """The ``replica_crash`` injection point SIGKILLs the lowest-index
+    live replica inside ``Router.step`` — single fault (one of three
+    replicas dies) and double fault (two die) both conserve the ledger
+    and the surviving baselines."""
+    for times in (1, 2):
+        faults = FaultInjector()
+        journal = Journal.open(str(tmp_path / f"wal{times}"),
+                               fsync=False, fsync_batch=1)
+        router = make_fleet(journal=journal, n=3, faults=faults)
+        fids = [router.submit(p, max_new_tokens=4)
+                for p in _prompts(40 + times, (4, 6, 5, 7))]
+        router.step()
+        faults.enable("replica_crash", times=times)
+        try:
+            router.run_until_complete(500)
+        finally:
+            faults.disable("replica_crash")
+        assert faults.fired["replica_crash"] == times
+        acc = fleet_accounting(router)
+        assert acc["ok"], (times, acc)
+        assert acc["killed_replicas"] == times
+        assert acc["journal_conserved"]
+        for fid in fids:
+            assert router.result(fid).status in TERMINAL
+        journal.close()
+
+
+def test_journal_write_fault_single_and_double(tmp_path):
+    """An injected ``journal_write`` fault (single and double) queues
+    the record for retry — no request fails, no record is lost, and the
+    ledger conserves once the pending queue drains."""
+    for times in (1, 2):
+        faults = FaultInjector()
+        journal = Journal.open(str(tmp_path / f"wal{times}"),
+                               fsync=False, faults=faults)
+        router = make_fleet(journal=journal, n=2)
+        faults.enable("journal_write", at=1, times=times)
+        try:
+            fids = [router.submit(p, max_new_tokens=4)
+                    for p in _prompts(50 + times, (4, 6, 5))]
+            router.run_until_complete(400)
+        finally:
+            faults.disable("journal_write")
+        assert faults.fired["journal_write"] == times
+        assert journal.write_failures >= 1
+        for fid in fids:
+            assert router.result(fid).status == "finished"
+        acc = fleet_accounting(router)        # flushes pending writes
+        assert acc["ok"], (times, acc)
+        assert acc["journal_conserved"], acc["journal_ledger"]
+        assert acc["journal_ledger"]["pending_writes"] == 0
+        journal.close()
+        # the on-disk bytes agree after reopen
+        j2 = Journal.open(str(tmp_path / f"wal{times}"), fsync=False)
+        assert all(v["terminals"] == 1 for v in j2.ledger().values())
+        j2.close()
+
+
+def test_journal_fsync_fault_contained(tmp_path):
+    """An injected ``journal_fsync`` fault (single and double) is
+    contained inside the journal: the bytes stay written, the failure
+    is counted, and the next sync covers them — serving never notices."""
+    for times in (1, 2):
+        faults = FaultInjector()
+        journal = Journal.open(str(tmp_path / f"wal{times}"),
+                               faults=faults)
+        router = make_fleet(journal=journal, n=2)
+        faults.enable("journal_fsync", times=times)
+        try:
+            fids = [router.submit(p, max_new_tokens=4)
+                    for p in _prompts(60 + times, (4, 6))]
+            router.run_until_complete(400)
+        finally:
+            faults.disable("journal_fsync")
+        assert faults.fired["journal_fsync"] == times
+        assert journal.fsync_failures == times
+        for fid in fids:
+            assert router.result(fid).status == "finished"
+        acc = fleet_accounting(router)
+        assert acc["ok"] and acc["journal_conserved"], acc
+        journal.close()
+
+
+def test_journal_replay_fault_single_retries_double_raises(tmp_path):
+    """A single ``journal_replay`` fault retries the side-effect-free
+    scan and recovery proceeds; a persistent (double) fault raises
+    ``JournalError`` loudly with nothing half-recovered — the on-disk
+    journal stays intact either way."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False)
+    journal.append_submit(0, [1, 2, 3], 4)
+    journal.append_submit(1, [4, 5], 4)
+    journal.close()
+    # single fault: the retry scan succeeds
+    faults = FaultInjector()
+    faults.enable("journal_replay", times=1)
+    try:
+        j = Journal.open(str(tmp_path / "wal"), fsync=False,
+                         faults=faults)
+    finally:
+        faults.disable("journal_replay")
+    assert j.replay_retries_used == 1
+    assert sorted(j.replay()) == [0, 1]
+    j.close()
+    # double fault: loud failure, no half-folded state escapes
+    faults.enable("journal_replay", times=2)
+    try:
+        with pytest.raises(JournalError, match="replay failed"):
+            Journal.open(str(tmp_path / "wal"), fsync=False,
+                         faults=faults)
+    finally:
+        faults.disable("journal_replay")
+    # the journal on disk is untouched: a clean open recovers everything
+    j = Journal.open(str(tmp_path / "wal"), fsync=False)
+    assert sorted(j.replay()) == [0, 1]
+    j.close()
+
+
+# ----------------------------------------------------------- satellites
+
+def test_fleet_dead_fails_fast_with_descriptive_snapshot(tmp_path):
+    """SATELLITE: ``run_until_complete`` on a fleet whose routable
+    count dropped to zero fails fast with the routable count and the
+    journal position in the snapshot, instead of spinning
+    ``stall_steps`` idle iterations into the generic stall."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False)
+    router = make_fleet(journal=journal, n=1)
+    router.submit(_prompts(70, (5,))[0], max_new_tokens=4)
+    # the replica dies with the request still queued inside it (the
+    # engine-level queue is exactly what a dead process strands)
+    router.replicas[0].engine.health.mark_dead("test: process died")
+    assert router.routable_count == 0 and router.fleet_dead
+    t0 = time.perf_counter()
+    with pytest.raises(EngineStalledError) as ei:
+        router.run_until_complete(stall_steps=64)
+    assert time.perf_counter() - t0 < 1.0      # fail FAST, not 64 spins
+    snap = ei.value.snapshot
+    assert snap["routable_replicas"] == 0
+    assert snap["fleet_dead"] is True
+    assert snap["journal"]["segments"] >= 1
+    assert snap["journal"]["live_requests"] == 1
+    journal.close()
+
+
+def test_journal_disabled_zero_overhead_and_compile_pin(oracle):
+    """ACCEPTANCE (d): the journal adds zero compiled programs — trace
+    counts and tokens are identical with the journal on, off, and
+    absent (it is pure host code riding existing host state)."""
+    import tempfile
+    prompts = _prompts(71, (4, 9, 6))
+
+    def run(journal):
+        eng = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                            journal=journal)
+        rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_complete(300)
+        toks = [list(eng.result(r).tokens) for r in rids]
+        return eng.core.trace_counts.copy(), toks
+
+    counts_off, toks_off = run(None)
+    journal = Journal.open(tempfile.mkdtemp(), fsync=False)
+    counts_on, toks_on = run(journal)
+    assert counts_on == counts_off        # zero new compiled programs
+    assert toks_on == toks_off            # byte-identical serving
+    # ... and the journal actually recorded the run (engine ids)
+    led = journal.ledger()
+    assert len(led) == len(prompts)
+    assert all(v["submits"] == 1 and v["terminals"] == 1
+               and v["status"] == "finished" for v in led.values())
+    journal.close()
+
+
+def test_engine_level_journal_records_lifecycle(tmp_path):
+    """``ServingEngine(journal=...)`` journals submit / batched
+    progress / terminal with ENGINE request ids, including cancel and
+    deadline terminals, and binds the ``journal.*`` instruments."""
+    journal = Journal.open(str(tmp_path / "wal"), fsync=False)
+    eng = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                        journal=journal)
+    p1, p2 = _prompts(72, (5, 6))
+    r1 = eng.submit(p1, max_new_tokens=6)
+    r2 = eng.submit(p2, max_new_tokens=6)
+    for _ in range(3):
+        eng.step()
+    eng.cancel(r2)
+    eng.run_until_complete(200)
+    journal.flush()
+    led = journal.ledger()
+    assert led[r1]["status"] == "finished"
+    assert led[r2]["status"] == "cancelled"
+    # progress records landed mid-flight (delivered < final is fine —
+    # the terminal record carries the final mark)
+    assert led[r1]["delivered"] == 6
+    snap = eng.registry.snapshot()
+    assert snap["journal.records"] == journal.records_appended
+    journal.close()
+
+
+def test_crash_smoke_artifacts(tmp_path):
+    """Tier-1 artifact smoke: ``fleet_chaos_smoke.py --crash`` kills
+    one of two replicas mid-burst, recovers a fresh fleet from the
+    journal, and emits a passing crash.json verdict (ledger
+    conservation + replay parity)."""
+    import importlib.util
+    import json
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_chaos_smoke",
+        os.path.join(repo, "scripts", "fleet_chaos_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "artifacts")
+    assert mod.main(["--out", out, "--crash", "--requests", "4"]) == 0
+    with open(os.path.join(out, "crash.json")) as f:
+        v = json.load(f)
+    assert v["ok"] and v["ledger_conserved"] and v["replay_parity"]
+    assert v["killed_replicas"] == 1
+    assert v["recovered"]["resubmitted"] >= 1
+    assert {r["status"] for r in v["requests"]} <= TERMINAL
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "journal_records" in prom
+    assert "router_killed_replicas" in prom
