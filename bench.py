@@ -1224,6 +1224,17 @@ def _serving_slo_bench(model, smoke=False):
     counters.  The no-fault vs replica-fault delta IS the robustness
     tax at fleet scope.
 
+    The STRAGGLER pass (ISSUE 15) replays the trace TWICE on identical
+    2-replica fleets with one-of-two replicas slowed mid-trace (the
+    router-level ``replica_slow`` chaos point) — once with hedging
+    armed, once with it off — reporting chat TTFT/TPOT p99 and
+    goodput_frac per leg plus the hedge / straggler / brownout-shed
+    counters from the shared registry.  The batch class rides with
+    ``priority="batch"`` and a brownout depth sized to the burst, so
+    the shed counter shows batch absorbing the overload while
+    interactive goodput holds — the hedging-on vs hedging-off delta IS
+    the tail-latency win.
+
     The DISAGGREGATED pass (ISSUE 13) replays the same trace on a
     role-split fleet of the same engine count — one PREFILL replica
     (long-prompt RAG prefills land here and migrate to the decode side
@@ -1249,6 +1260,7 @@ def _serving_slo_bench(model, smoke=False):
         batch_lens, batch_new = [4 + (i % 4) * 2 for i in range(batch_n)], 6
         fault_at, retries = 4, 2
         ttft_deadline = 30.0
+        straggle_s, chat_deadline = 0.08, 3.0
     else:
         slots, block_len = 8, 64
         chat_n, rag_n, batch_n = 16, 8, 16
@@ -1258,6 +1270,7 @@ def _serving_slo_bench(model, smoke=False):
                                                 size=batch_n)), 96
         fault_at, retries = 30, 2
         ttft_deadline = 30.0
+        straggle_s, chat_deadline = 0.02, 10.0
     prefix = rs.randint(0, vocab, (chat_prefix,))
     chat = [np.concatenate([prefix, rs.randint(0, vocab, (chat_suffix,))])
             for _ in range(chat_n)]
@@ -1419,6 +1432,126 @@ def _serving_slo_bench(model, smoke=False):
                  f"(-> quarantine)") if faulted else None
         return measure(router, inj, fault_label=label)
 
+    def run_straggler(hedging):
+        """One tail-latency leg (ISSUE 15): one-of-two replicas slowed
+        mid-trace via the router-level ``replica_slow`` point, chat
+        carrying end-to-end deadlines (the hedge trigger), the batch
+        class sheddable under a brownout sized to the burst."""
+        registry, tracer = MetricsRegistry(), Tracer()
+        inj = FaultInjector()
+        engines = [ServingEngine(model, num_slots=slots, min_bucket=8,
+                                 block_len=block_len,
+                                 fault_tolerance=ft, registry=registry,
+                                 tracer=tracer) for _ in range(2)]
+        router = Router(engines, hedging=hedging, faults=inj,
+                        slow_threshold=2.0, slow_hysteresis=2,
+                        brownout_depth=max(slots, 2),
+                        brownout_hysteresis=2,
+                        registry=registry, tracer=tracer)
+        # warmup: compile both planes, then reset to a clean window
+        for p in chat[:2] + rag[:1]:
+            router.submit(p, max_new_tokens=2)
+        router.run_until_complete(max_steps=50000)
+        for h in router.replicas:
+            h.engine.metrics.reset()
+            h.step_ewma_s = 0.0
+        for fid in list(router._requests):
+            router.purge(fid)
+        counts = {"submitted": 0, "rejected": 0,
+                  "batch_submitted": 0, "batch_shed": 0}
+        fids, chat_ids, interactive_fids = [], [], []
+
+        def sub(p, new, cls=None, priority="interactive", **kw):
+            counts["submitted"] += 1
+            if priority == "batch":
+                counts["batch_submitted"] += 1
+            try:
+                fid = router.submit(p, max_new_tokens=new,
+                                    priority=priority, **kw)
+            except RequestRejected as e:
+                counts["rejected"] += 1
+                if priority == "batch":
+                    counts["batch_shed"] += 1
+                return
+            fids.append(fid)
+            if priority != "batch":
+                interactive_fids.append(fid)
+            if cls is not None:
+                cls.append(fid)
+
+        t0 = time.perf_counter()
+        for p in chat[::2]:
+            sub(p, chat_new, cls=chat_ids,
+                ttft_deadline_s=ttft_deadline,
+                deadline_s=chat_deadline)
+        for _ in range(2):
+            router.step()
+        for p in rag:
+            sub(p, rag_new)
+        router.step()
+        # one-of-two replicas slowed MID-TRACE: the second chat wave
+        # and the batch dump ride the straggled fleet
+        inj.enable("replica_slow", times=10 ** 6, seconds=straggle_s)
+        try:
+            for p in chat[1::2]:
+                sub(p, chat_new, cls=chat_ids,
+                    ttft_deadline_s=ttft_deadline,
+                    deadline_s=chat_deadline)
+            for _ in range(2):
+                router.step()
+            for p in batch:
+                sub(p, batch_new, priority="batch")
+                router.step()          # interleave: brownout can arm
+            router.run_until_complete(max_steps=50000)
+        finally:
+            inj.disable("replica_slow")
+        wall = time.perf_counter() - t0
+        outs = [router.result(f) for f in fids]
+        completed = sum(1 for o in outs if o.status == "finished")
+        inter_completed = sum(
+            1 for f in interactive_fids
+            if router.result(f).status == "finished")
+        inter_submitted = counts["submitted"] - counts["batch_submitted"]
+        chat_ttfts = [router.result(f).ttft_s for f in chat_ids]
+        chat_ttfts = [t for t in chat_ttfts if t is not None]
+        snap = router.registry.snapshot()
+        tpot = snap.get("serving.tpot_s", {})
+        q = lambda h, k: (round(h[k] * 1e3, 2)
+                          if h.get(k) is not None else None)
+        rm = router.metrics_dict()
+        return {
+            "hedging": bool(hedging),
+            "submitted": counts["submitted"],
+            "completed": completed,
+            "rejected": counts["rejected"],
+            "goodput_frac": round(
+                completed / max(counts["submitted"], 1), 4),
+            # interactive completions over interactive submissions
+            # ONLY — the number that must HOLD while batch absorbs
+            # the brownout's rejections
+            "interactive_goodput_frac": round(
+                inter_completed / max(inter_submitted, 1), 4),
+            "batch_submitted": counts["batch_submitted"],
+            "batch_shed": counts["batch_shed"],
+            "chat_ttft_p99_ms": (round(float(np.percentile(
+                chat_ttfts, 99)) * 1e3, 2) if chat_ttfts else None),
+            "tpot_p99_ms": q(tpot, "p99"),
+            "hedges": rm["hedges"],
+            "hedge_wins": rm["hedge_wins"],
+            "hedges_failed": rm["hedges_failed"],
+            "shed_batch": rm["shed_batch"],
+            # event-based: the end-of-run gauge clears once the
+            # straggler recovers, the mark event does not
+            "straggler_marked": any(
+                e[0] == "straggler_mark" for e in router.tracer.events()),
+            "brownout_entered": any(
+                e[0] == "brownout_enter"
+                for e in router.tracer.events()),
+            "brownout_level_end": rm["brownout_level"],
+            "straggle_s": straggle_s,
+            "wall_s": round(wall, 2),
+        }
+
     def run_disaggregated():
         router = build_disagg_fleet()
         row = measure(router, None)
@@ -1442,6 +1575,12 @@ def _serving_slo_bench(model, smoke=False):
         "no_fault": run(False),
         "replica_fault": run(True),
         "disaggregated": run_disaggregated(),
+        # the tail-latency pass (ISSUE 15): the hedging-on vs
+        # hedging-off delta under one straggled replica IS the win
+        "straggler": {
+            "hedging_on": run_straggler(True),
+            "hedging_off": run_straggler(False),
+        },
         "config": (f"replicas2-slots{slots}-chat{chat_n}-rag{rag_n}-"
                    f"batch{batch_n}-prefix{chat_prefix}-"
                    f"block{block_len}-prefillthresh{prefill_threshold}"),

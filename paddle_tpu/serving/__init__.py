@@ -28,7 +28,7 @@ from .kv_pool import BlockPool, KVPool
 from .metrics import ServingMetrics
 from .prefix_cache import MatchResult, PrefixCache
 from .router import ReplicaHandle, Router
-from .scheduler import Scheduler, bucket_length
+from .scheduler import PRIORITIES, Scheduler, bucket_length
 
 __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            "EngineCore", "sample_rows", "finite_or_sentinel", "KVPool",
@@ -44,4 +44,6 @@ __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            # disaggregated fleet (docs/serving.md "Disaggregated fleet")
            "Autoscaler", "Handoff", "HandoffManager",
            # crash consistency (docs/serving.md "Crash recovery")
-           "Journal", "JournalError"]
+           "Journal", "JournalError",
+           # tail latency (docs/serving.md "Tail latency")
+           "PRIORITIES"]

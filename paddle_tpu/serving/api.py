@@ -30,7 +30,7 @@ from .engine import EngineCore
 from .errors import RequestRejected
 from .health import FaultToleranceConfig
 from .metrics import ServingMetrics
-from .scheduler import Request, SamplingParams
+from .scheduler import PRIORITIES, Request, SamplingParams
 
 __all__ = ["ServingEngine", "RequestOutput", "Request", "SamplingParams"]
 
@@ -154,7 +154,8 @@ class ServingEngine:
                eos_token_id: Optional[int] = None,
                stream: Optional[Callable] = None,
                deadline_s: Optional[float] = None,
-               ttft_deadline_s: Optional[float] = None) -> int:
+               ttft_deadline_s: Optional[float] = None,
+               priority: str = "interactive") -> int:
         """Queue one request; returns its id (admission happens inside a
         later ``step()`` — submit never blocks on the device).
 
@@ -169,7 +170,13 @@ class ServingEngine:
         already exceeds ``ttft_deadline_s``, circuit-open fail-fast.
         ``deadline_s``/``ttft_deadline_s`` are seconds relative to this
         call, checked host-side every step; a blown deadline unwinds the
-        request with terminal status ``deadline_exceeded``."""
+        request with terminal status ``deadline_exceeded``.
+
+        ``priority`` is the request's class (``"interactive"`` —
+        latency-sensitive, the default — or ``"batch"`` — deferrable
+        offline work): admission prefers interactive inside the bounded
+        skip window, and a fleet router's brownout sheds batch first
+        under sustained overload (docs/serving.md "Tail latency")."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError(
@@ -190,11 +197,15 @@ class ServingEngine:
                 raise ValueError(f"{name} must be >= 0, got {d}")
         sampling = sampling or SamplingParams()
         sampling.validate()
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
         sched = self.core.scheduler
         req = Request(request_id=sched.next_request_id(),
                       prompt=prompt, max_new_tokens=max_new_tokens,
                       sampling=sampling,
                       eos_token_id=eos_token_id, stream=stream,
+                      priority=priority,
                       deadline_s=deadline_s,
                       ttft_deadline_s=ttft_deadline_s)
         try:
@@ -215,7 +226,7 @@ class ServingEngine:
                 req.request_id, req.prompt, max_new_tokens,
                 sampling=dataclasses.asdict(sampling),
                 eos_token_id=eos_token_id, deadline_s=deadline_s,
-                ttft_deadline_s=ttft_deadline_s)
+                ttft_deadline_s=ttft_deadline_s, priority=priority)
         return req.request_id
 
     def cancel(self, request_id: int) -> RequestOutput:

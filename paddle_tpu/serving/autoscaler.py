@@ -22,7 +22,13 @@ fleet against the live ``router.queue_depth`` gauge:
     (``router.retire(i)``) — in-flight requests always finish normally;
   * **hysteresis + cooldown** — the consecutive-tick requirement plus a
     ``cooldown_steps`` refractory period after every action stop the
-    loop from flapping on a noisy queue.
+    loop from flapping on a noisy queue;
+  * **straggler replacement** (``replace_slow_after``; docs/serving.md
+    "Tail latency") — an AUTOSCALED decode replica the router's
+    straggler detector has marked slow for that many consecutive fleet
+    steps is replaced: graceful drain → retire, replacement spawned
+    through the normal warmup gate, same cooldown as every other
+    action.
 
 ``spawn``/``retire`` is a registered graftlint ``ResourcePair``
 (receiver hint ``scaler``): an autoscaled replica must eventually retire
@@ -58,7 +64,12 @@ class Autoscaler:
                  min_decode: int = 1, max_decode: int = 8,
                  scale_up_depth: int = 8, scale_down_depth: int = 0,
                  hysteresis_steps: int = 4, cooldown_steps: int = 16,
+                 replace_slow_after: Optional[int] = None,
                  faults=None):
+        if replace_slow_after is not None and replace_slow_after < 1:
+            raise ValueError(
+                "replace_slow_after must be >= 1 (or None to disable "
+                "straggler replacement)")
         if min_decode < 1:
             raise ValueError("min_decode must be >= 1")
         if max_decode < min_decode:
@@ -78,6 +89,14 @@ class Autoscaler:
         self.scale_down_depth = scale_down_depth
         self.hysteresis_steps = hysteresis_steps
         self.cooldown_steps = cooldown_steps
+        # straggler replacement (docs/serving.md "Tail latency"): an
+        # AUTOSCALED decode replica continuously marked slow by the
+        # router's detector for this many fleet steps is replaced —
+        # drain → retire through the normal graceful path, replacement
+        # spawned through the normal warmup gate.  None disables;
+        # operator-built replicas are never replaced (slow hardware an
+        # operator placed deliberately is the operator's call).
+        self.replace_slow_after = replace_slow_after
         self.faults = faults            # chaos hook: replica_spawn
         self._above = 0                 # consecutive ticks over the bar
         self._below = 0                 # consecutive idle ticks
@@ -105,6 +124,10 @@ class Autoscaler:
             "autoscaler.resurrections",
             "replacements spawned for KILLED replicas (Router.kill — "
             "crash resurrection through the normal warmup gate)")
+        self._c_slow_replacements = c(
+            "autoscaler.slow_replacements",
+            "autoscaled decode replicas replaced for persistent "
+            "straggling (drain -> retire -> spawn)")
         self._lane = m.lane             # events share the router's lane
         self._tracer = m.tracer
         self._publish()
@@ -156,6 +179,38 @@ class Autoscaler:
         if self._cooldown > 0:
             self._cooldown -= 1
             return action
+        # straggler replacement (docs/serving.md "Tail latency"): an
+        # autoscaled replica persistently marked slow is retired
+        # through the normal graceful drain and its capacity respawned
+        # at once — subject to the same cooldown as every other action
+        # so one bad replica cannot start a churn storm
+        if self.replace_slow_after is not None:
+            victim = next(
+                (self.router.replicas[i] for i in self._spawned
+                 if not self.router.replicas[i].draining
+                 and not self.router.replicas[i].retired
+                 and self.router.replicas[i].slow_ticks
+                 >= self.replace_slow_after), None)
+            if victim is not None:
+                # spawn the replacement FIRST: a failed spawn must not
+                # shrink the fleet (slow capacity beats absent capacity
+                # and min_decode must hold) — the victim keeps serving
+                # and a post-cooldown tick retries.  The cooldown is
+                # taken on BOTH outcomes: a persistently failing
+                # spawn_fn must not be re-run (model build + warmup)
+                # on every fleet step.  The one-tick overshoot of
+                # max_decode resolves when the retire's drain starts
+                # (a draining replica leaves decode_count immediately).
+                self._cooldown = self.cooldown_steps
+                if self.spawn() is None:
+                    return action
+                self.retire(victim.index)
+                self._c_slow_replacements.inc()
+                self._tracer.event("autoscaler_replace_slow",
+                                   lane=self._lane,
+                                   replica=victim.index,
+                                   slow_ticks=victim.slow_ticks)
+                return "replace_slow"
         depth = self.router.queue_depth
         self._above = self._above + 1 if depth >= self.scale_up_depth \
             else 0
@@ -274,4 +329,5 @@ class Autoscaler:
             "spawn_failures": self._c_spawn_failures.value,
             "resurrections": self._c_resurrections.value,
             "resurrected_victims": sorted(self._resurrected),
+            "slow_replacements": self._c_slow_replacements.value,
         }

@@ -12,7 +12,7 @@ the diagnostic snapshot).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["RequestRejected", "EngineStalledError"]
 
@@ -24,10 +24,12 @@ class RequestRejected(RuntimeError):
     ``max_queue``), ``"slo_unattainable"`` (projected TTFT already
     exceeds the request's ``ttft_deadline_s`` at submit time), or
     ``"circuit_open"`` (the engine's recovery circuit breaker tripped).
-    The fleet router (serving/router.py) adds two fleet-scoped reasons:
-    ``"fleet_queue_full"`` (the router-level bounded queue across all
-    replicas) and ``"no_healthy_replica"`` (every replica excluded by
-    health state or drain).
+    The fleet router (serving/router.py) adds four fleet-scoped
+    reasons: ``"fleet_queue_full"`` (the router-level bounded queue
+    across all replicas), ``"no_healthy_replica"`` (every replica
+    excluded by health state or drain), and the brownout ladder's
+    ``"brownout_shed_batch"`` / ``"brownout_overload"`` (docs/serving.md
+    "Tail latency").
     ``retry_after_s`` is the live-metrics-derived hint, always finite
     and clamped (``serving.metrics.MAX_RETRY_AFTER_S``; None when the
     engine has no throughput history yet, or will never recover —
@@ -35,16 +37,25 @@ class RequestRejected(RuntimeError):
     :class:`~paddle_tpu.serving.api.RequestOutput` view with
     ``status="rejected"`` so callers that log every request still see an
     unambiguous terminal record.
+
+    ``per_replica`` (fleet rejections where every eligible replica
+    refused) carries EVERY replica's own rejection — a list of
+    ``{"replica", "reason", "retry_after_s"}`` dicts in try order — so
+    a heterogeneous refusal (one replica queue-full, another
+    SLO-hopeless) is debuggable from the exception alone; the
+    ``output.status_reason`` embeds the same breakdown in its text.
     """
 
     def __init__(self, reason: str, retry_after_s: Optional[float] = None,
-                 output=None):
+                 output=None,
+                 per_replica: Optional[List[Dict[str, object]]] = None):
         hint = "" if retry_after_s is None \
             else f" (retry after ~{retry_after_s:.3f}s)"
         super().__init__(f"request rejected: {reason}{hint}")
         self.reason = reason
         self.retry_after_s = retry_after_s
         self.output = output
+        self.per_replica = per_replica
 
 
 class EngineStalledError(RuntimeError):

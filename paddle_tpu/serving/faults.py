@@ -42,6 +42,16 @@ Injection points (``POINTS``):
                       replica is half-built — it must never become
                       routable and the router topology must be
                       untouched
+  ``replica_slow``    ``Router.step`` sleeps ``seconds`` around ONE
+                      replica's step (the lowest-index live replica —
+                      deterministic), so chaos can straggle a replica
+                      at the ROUTER without touching engine internals;
+                      the straggler detector must mark it ``slow`` and
+                      hedging must cover its at-risk deadline work
+  ``hedge_submit``    the router's hedge submission raises before the
+                      duplicate lands on the hedge target — the hedge
+                      must fail CLOSED (primary attempt untouched, no
+                      replica state leaked, accounting conserved)
   ``journal_write``   ``Journal._write`` raises before the record's
                       frame lands — the journal queues the record for
                       retry and the serving loop must not fail the
@@ -92,7 +102,12 @@ POINTS = ("kv_alloc", "block_alloc", "block_exhausted", "gather",
           # passed to Journal.open) and the router-level simulated
           # replica SIGKILL (arm on the Router's injector)
           "journal_write", "journal_fsync", "journal_replay",
-          "replica_crash")
+          "replica_crash",
+          # tail-latency sites (ISSUE 15): the router-level straggler
+          # (sleep around one replica's step — arm on the Router's
+          # injector) and the hedge-submission fault (the duplicate
+          # submission dies before landing; the hedge fails closed)
+          "replica_slow", "hedge_submit")
 
 
 class FaultError(RuntimeError):

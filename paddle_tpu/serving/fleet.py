@@ -22,6 +22,12 @@ across N replicas behind a :class:`~paddle_tpu.serving.router.Router`:
       radix pin or decode-side staging slot can be outstanding, and the
       per-replica baselines of (b) hold on prefill, decode AND retired
       replicas alike;
+  (f) hedged requests (docs/serving.md "Tail latency") additionally
+      conserve the RACE: every issued hedge reached a resolution (win
+      or purge — no settled request still holds a live hedge record),
+      a hedged request's total submissions still respect the
+      attempts <= 2 bound of (c), and the loser's unwind left both
+      replicas at the baselines of (b);
   (e) journaled fleets (``Router(journal=...)``) additionally conserve
       the LEDGER: every journaled submit record reaches EXACTLY ONE
       terminal record — across process incarnations — and the baselines
@@ -63,6 +69,7 @@ def replica_accounting(engine) -> Dict[str, object]:
         "active": core.scheduler.active,
         "mid_prefill": len(core._prefills),
         "health": engine.health.state,
+        "slow": engine.health.slow,
         "degraded_subsystems": list(engine.degraded_subsystems),
         "quarantines": core.health.quarantine_count,
         "decode_traces": core.trace_counts["decode"],
@@ -117,7 +124,9 @@ def fleet_accounting(router) -> Dict[str, object]:
             "attempts": fr.attempts, "status": out.status,
             "reason": out.status_reason, "tokens": len(out.tokens),
             "delivered": fr.delivered,
-            "failed_over": fr.attempts > 1,
+            "failed_over": fr.attempts > 1 and not fr.hedged,
+            "hedged": fr.hedged,
+            "priority": fr.priority,
             "stage": fr.role_stage,
             "handoffs": fr.handoffs,
             # the failover audit trail: which replica surrendered the
@@ -145,6 +154,12 @@ def fleet_accounting(router) -> Dict[str, object]:
     mgr = router._handoffs
     handoffs_settled = (mgr.pending == 0
                         and mgr.staged == mgr.committed + mgr.aborted)
+    # invariant f: every issued hedge reached a resolution — a settled
+    # request still pointing at a live hedge record means the loser
+    # was never unwound (its slot and pins are leaked on that replica)
+    hedges_settled = all(fr.hedge_rid < 0
+                         for fid, fr in router._requests.items()
+                         if fid not in router._live)
     # invariant e: journal-ledger conservation — every journaled submit
     # reached exactly one terminal record (across incarnations; the
     # ledger folds every surviving segment).  flush() first so pending
@@ -171,13 +186,16 @@ def fleet_accounting(router) -> Dict[str, object]:
             "pending_writes": journal.position()["pending_writes"],
         }
     ok = bool(all_terminal and once_ok and handoffs_settled
-              and journal_ok and surviving_ok)
+              and hedges_settled and journal_ok and surviving_ok)
     return {
         "ok": ok,
         "all_terminal": bool(all_terminal),
         "served_at_most_once_retry": bool(once_ok),
         "pools_at_baseline": surviving_ok,
         "handoffs_settled": bool(handoffs_settled),
+        "hedges_settled": bool(hedges_settled),
+        "hedges": router.metrics.c_hedges.value,
+        "hedge_wins": router.metrics.c_hedge_wins.value,
         "handoffs_staged": mgr.staged,
         "handoffs_committed": mgr.committed,
         "handoffs_aborted": mgr.aborted,

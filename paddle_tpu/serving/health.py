@@ -142,6 +142,15 @@ class EngineHealth:
         self._in_quarantine = False
         self._circuit_open = False
         self.degraded = False            # set by the engine (ladder > 0)
+        # the STRAGGLER signal (docs/serving.md "Tail latency"): set by
+        # a fleet router's outlier detector when this replica's step
+        # latency is a fleet-relative outlier, cleared with hysteresis
+        # when it recovers.  Slow is an overlay on the state machine,
+        # not a state: a slow replica stays routable (correct, just
+        # late) and is DEPRIORITIZED by the route order — between
+        # healthy and degraded — rather than excluded.
+        self.slow = False
+        self.slow_reason: Optional[str] = None
         self.last_fault: Optional[str] = None
 
     # ------------------------------------------------------------- state
@@ -188,6 +197,18 @@ class EngineHealth:
             return None
         return min(self.cfg.backoff_base_s * (2 ** (n - 1)),
                    self.cfg.backoff_cap_s)
+
+    def mark_slow(self, reason: str) -> None:
+        """Stamp the straggler signal (a fleet router's outlier
+        detector owns the decision; this just records it)."""
+        self.slow = True
+        self.slow_reason = reason
+
+    def clear_slow(self) -> None:
+        """The straggler recovered (hysteresis already applied by the
+        detector)."""
+        self.slow = False
+        self.slow_reason = None
 
     def mark_dead(self, reason: str) -> None:
         """Pin this engine terminally dead — the state a fleet router

@@ -287,7 +287,8 @@ class Journal:
                       eos_token_id: Optional[int] = None,
                       deadline_s: Optional[float] = None,
                       ttft_deadline_s: Optional[float] = None,
-                      wall_time: Optional[float] = None) -> None:
+                      wall_time: Optional[float] = None,
+                      priority: str = "interactive") -> None:
         """Journal one accepted submission (forces a sync: an accepted
         request must survive the very next crash).  ``sampling`` is the
         plain-dict sampling spec INCLUDING the seed; ``wall_time``
@@ -306,6 +307,10 @@ class Journal:
             else float(ttft_deadline_s),
             "wall_time": time.time() if wall_time is None
             else float(wall_time),
+            # priority class survives the crash so a recovered batch
+            # request is still sheddable (old journals lack the key —
+            # readers default it to "interactive")
+            "priority": str(priority),
         }, sync=True)
 
     def append_progress(self, delivered: Dict[int, int]) -> None:

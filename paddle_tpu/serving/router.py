@@ -90,7 +90,7 @@ from .api import RequestOutput, ServingEngine
 from .errors import EngineStalledError, RequestRejected
 from .handoff import ABORTED, HandoffManager
 from .health import CIRCUIT_OPEN, DEGRADED, QUARANTINED
-from .scheduler import SamplingParams
+from .scheduler import PRIORITIES, SamplingParams
 
 __all__ = ["Router", "ReplicaHandle", "ROLES"]
 
@@ -109,10 +109,17 @@ _CLIENT_FAULT_PREFIX = "stream callback"
 class ReplicaHandle:
     """Router-side view of one replica: the engine plus the routing
     state the router owns about it (role, drain/retire flags, routed
-    count)."""
+    count, and the step-latency EWMA the straggler detector reads)."""
 
     __slots__ = ("index", "engine", "role", "draining", "retired",
-                 "killed", "routed")
+                 "killed", "routed", "step_ewma_s", "slow_ticks",
+                 "_slow_streak", "_fast_streak", "_observed")
+
+    # EWMA smoothing for the router-measured per-replica step wall time
+    # (the straggler detector's input): ~10-step memory — fast enough
+    # to catch a real straggler, slow enough that hysteresis, not the
+    # average, decides flapping
+    STEP_EWMA_ALPHA = 0.2
 
     def __init__(self, index: int, engine: ServingEngine,
                  role: str = "unified"):
@@ -122,6 +129,17 @@ class ReplicaHandle:
         self.engine = engine
         self.role = role
         self.draining = False
+        # straggler-detection state (docs/serving.md "Tail latency"):
+        # the router times each replica's step() itself, so an
+        # engine-internal stall (slow_step chaos, a real slow device)
+        # and a router-level one (replica_slow chaos) both register;
+        # slow_ticks counts consecutive fleet steps spent marked slow
+        # (the autoscaler's replace-persistently-slow input)
+        self.step_ewma_s = 0.0
+        self.slow_ticks = 0
+        self._slow_streak = 0
+        self._fast_streak = 0
+        self._observed = True      # had a BUSY step this fleet step
         # retired replicas keep their handle (indices stay stable in
         # the fleet-id map) but their engine is closed and they never
         # re-enter rotation — the autoscaler's drain-based retirement
@@ -134,11 +152,26 @@ class ReplicaHandle:
         self.killed = False
         self.routed = 0          # fleet requests ever routed here
 
+    def observe_step(self, seconds: float) -> None:
+        """Fold one router-measured step wall time into the EWMA."""
+        a = self.STEP_EWMA_ALPHA
+        self.step_ewma_s = seconds if self.step_ewma_s == 0.0 \
+            else (1 - a) * self.step_ewma_s + a * seconds
+
     @property
     def load(self) -> int:
         """Queued + placed requests — the affinity tie-breaker."""
         core = self.engine.core
         return core.scheduler.queue_depth + core.scheduler.active
+
+    @property
+    def health_rank(self) -> int:
+        """The route-order deprioritization band (docs/serving.md "Tail
+        latency" routing matrix): 0 healthy, 1 slow, 2 degraded,
+        3 slow+degraded — healthy beats slow beats degraded among the
+        ROUTABLE replicas (excluded states never reach the sort)."""
+        h = self.engine.health
+        return (2 if h.state == DEGRADED else 0) + (1 if h.slow else 0)
 
     def serves(self, stage: str) -> bool:
         """May this replica take new ``stage`` ("prefill"/"decode")
@@ -165,12 +198,14 @@ class _FleetRequest:
                  "eos_token_id", "client_stream", "deadline_s",
                  "ttft_deadline_s", "submit_time", "replica",
                  "engine_rid", "attempts", "delivered", "history",
-                 "role_stage", "handoffs", "override",
+                 "role_stage", "handoffs", "override", "priority",
+                 "hedge_replica", "hedge_rid", "hedged",
                  "journal_hwm", "journaled_submit", "journaled_terminal")
 
     def __init__(self, fleet_id: int, prompt: np.ndarray,
                  max_new_tokens: int, sampling, eos_token_id,
-                 client_stream, deadline_s, ttft_deadline_s):
+                 client_stream, deadline_s, ttft_deadline_s,
+                 priority: str = "interactive"):
         self.fleet_id = fleet_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -191,6 +226,16 @@ class _FleetRequest:
         # owns a full submission on a decode/unified replica
         self.role_stage = "decode"
         self.handoffs = 0             # committed/aborted migrations
+        self.priority = priority      # "interactive" | "batch"
+        # hedged-request state (docs/serving.md "Tail latency"): while
+        # a hedge is live the request runs on TWO replicas — replica/
+        # engine_rid is the primary attempt, hedge_replica/hedge_rid
+        # the duplicate; first finished wins and the loser is purged.
+        # ``hedged`` stays True after resolution: ONE hedge per fleet
+        # id, ever (it consumed the attempts<=2 budget)
+        self.hedge_replica = -1
+        self.hedge_rid = -1
+        self.hedged = False
         # router-level terminal stamp: set only when the handoff
         # machinery exhausts every placement (the engine-side record is
         # then a stale 1-token "finished" view); result() applies it
@@ -284,6 +329,51 @@ class _RouterMetrics:
             "router.replay_expired",
             "journaled requests whose deadline was spent across the "
             "downtime — settled deadline_exceeded without resubmit")
+        # tail-latency surface (docs/serving.md "Tail latency";
+        # glossary rows in docs/observability.md)
+        self.g_slow = g("router.slow_replicas",
+                        "replicas currently marked slow by the "
+                        "straggler detector (deprioritized, not "
+                        "excluded)")
+        self.g_brownout = g("router.brownout_level",
+                            "overload-shedding ladder level (0 normal, "
+                            "1 shed batch + suspend hedging, 2 "
+                            "tightened admission)")
+        self.c_hedges = c("router.hedges",
+                          "duplicate submissions issued for "
+                          "deadline-at-risk requests (one per fleet "
+                          "id, ever)")
+        self.c_hedge_wins = c("router.hedge_wins",
+                              "hedges that finished before their "
+                              "primary attempt (the primary was purged)")
+        self.c_hedge_failed = c("router.hedges_failed",
+                                "hedge submissions that failed closed "
+                                "(every target rejected, or the "
+                                "hedge_submit chaos point fired)")
+        self.c_shed_batch = c("router.shed_batch",
+                              "batch-class submissions shed by the "
+                              "brownout ladder (rejected with an "
+                              "honest retry_after_s)")
+
+    def on_slow(self, phase: str, replica: int, **attrs) -> None:
+        """``straggler_*`` lifecycle event on the router lane (mark /
+        clear)."""
+        self.tracer.event(f"straggler_{phase}", lane=self.lane,
+                          replica=replica, **attrs)
+
+    def on_hedge(self, phase: str, fleet_id: int, **attrs) -> None:
+        """``hedge_*`` lifecycle event on the router lane (issue / win /
+        purge / failed); the matching counters are bumped at the
+        transition sites."""
+        self.tracer.event(f"hedge_{phase}", lane=self.lane,
+                          fleet_id=fleet_id, **attrs)
+
+    def on_brownout(self, phase: str, level: int, **attrs) -> None:
+        """``brownout_*`` lifecycle event (enter / exit / shed) plus the
+        ladder gauge."""
+        self.g_brownout.set(level)
+        self.tracer.event(f"brownout_{phase}", lane=self.lane,
+                          level=level, **attrs)
 
     def on_crash(self, phase: str, replica: int, **attrs) -> None:
         """``crash_*`` lifecycle event on the router lane (kill,
@@ -345,6 +435,79 @@ class _RouterMetrics:
                               and h.role in ("decode", "unified")))
         self.g_retired.set(sum(1 for h in handles if h.retired))
         self.g_killed.set(sum(1 for h in handles if h.killed))
+        self.g_slow.set(sum(1 for h in live if h.engine.health.slow))
+
+
+class _Brownout:
+    """The overload-shedding ladder (docs/serving.md "Tail latency"):
+    a host-side hysteretic controller over the fleet queue depth — the
+    same signal the SLO rejection reads — escalating one level per
+    sustained breach and de-escalating one level per sustained
+    recovery (the autoscaler's consecutive-tick idiom):
+
+      * level 0 — normal service;
+      * level 1 — shed: new BATCH-class submissions reject with an
+        honest ``retry_after_s`` and hedging is suspended (duplicates
+        are load an overloaded fleet must not amplify);
+      * level 2 — tightened admission: while the queue still exceeds
+        the ENTER depth, interactive submissions reject too — honest
+        fast failure beats a deadline the fleet already knows it will
+        blow.
+
+    Armed only when ``depth`` (the level-1 enter bound; level 2 enters
+    at twice it) is given; exit thresholds sit at half the entry
+    thresholds so the ladder cannot chatter on a boundary queue."""
+
+    __slots__ = ("depth", "hysteresis", "level", "_above", "_below")
+
+    def __init__(self, depth: Optional[int], hysteresis: int):
+        if depth is not None and depth < 1:
+            raise ValueError("brownout_depth must be >= 1 (or None)")
+        if hysteresis < 1:
+            raise ValueError("brownout_hysteresis must be >= 1")
+        self.depth = depth
+        self.hysteresis = hysteresis
+        self.level = 0
+        self._above = 0
+        self._below = 0
+
+    def _enter_depth(self, level: int) -> int:
+        return self.depth * (2 ** (level - 1))
+
+    def update(self, queue_depth: int,
+               exit_only: bool = False) -> Optional[str]:
+        """One control tick; returns "enter"/"exit" on a level
+        transition (None otherwise).  ``exit_only`` marks a
+        SUBMIT-time observation: it may walk the ladder DOWN (the
+        idle-fleet exit path — rejections enqueue nothing, so step()
+        may never run again) but never up, or a burst of submissions
+        would escalate faster than the per-step hysteresis the
+        thresholds are calibrated for."""
+        if self.depth is None:
+            return None
+        if self.level < 2 and queue_depth >= self._enter_depth(
+                self.level + 1):
+            if exit_only:
+                self._below = 0      # deep queue: no exit progress
+                return None
+            self._above += 1
+            self._below = 0
+            if self._above >= self.hysteresis:
+                self.level += 1
+                self._above = 0
+                return "enter"
+            return None
+        self._above = 0
+        if self.level > 0 and queue_depth <= \
+                self._enter_depth(self.level) // 2:
+            self._below += 1
+            if self._below >= self.hysteresis:
+                self.level -= 1
+                self._below = 0
+                return "exit"
+        else:
+            self._below = 0
+        return None
 
 
 class Router:
@@ -378,6 +541,25 @@ class Router:
     chaos points (``handoff_*``, ``replica_crash``) — None in
     production.
 
+    **Tail-latency defense** (docs/serving.md "Tail latency"):
+    ``slow_threshold``/``slow_hysteresis`` parameterize the straggler
+    detector — a replica whose router-measured step-latency EWMA
+    exceeds the fleet median by the threshold factor for the
+    hysteresis's consecutive fleet steps is marked ``slow``
+    (``EngineHealth.slow``) and deprioritized by the route order
+    between healthy and degraded; it recovers through the same
+    hysteresis.  ``hedging`` (default on) arms hedged requests: a
+    deadline-carrying request whose projected completion on its
+    current replica breaches the deadline gets ONE duplicate
+    submission on the best OTHER healthy replica after a p95-based
+    delay — first to finish wins, the loser is purged, and the
+    fleet-id idempotency key + delivered high-water mark keep the
+    client stream exactly-once and token-identical.  ``brownout_depth``
+    arms the overload-shedding ladder (None = off): sustained fleet
+    queue depth at the bound sheds BATCH-priority work first (honest
+    ``retry_after_s``), suspends hedging, and at twice the bound
+    tightens admission for everyone; it exits with hysteresis.
+
     ``journal`` attaches a durable request :class:`~paddle_tpu.serving.
     journal.Journal` (docs/serving.md "Crash recovery"): every accepted
     submit, the per-step delivered high-water marks, and every terminal
@@ -400,11 +582,22 @@ class Router:
                  prefill_threshold: Optional[int] = None,
                  faults=None,
                  journal=None,
+                 hedging: bool = True,
+                 slow_threshold: float = 3.0,
+                 slow_hysteresis: int = 3,
+                 brownout_depth: Optional[int] = None,
+                 brownout_hysteresis: int = 4,
                  registry=None, tracer=None):
         if not replicas:
             raise ValueError("Router needs at least one replica engine")
         if max_queue is not None and max_queue < 1:
             raise ValueError("max_queue must be >= 1 (or None)")
+        if slow_threshold <= 1.0:
+            raise ValueError(
+                "slow_threshold must exceed 1.0 — a replica at the "
+                "fleet median must never be an outlier")
+        if slow_hysteresis < 1:
+            raise ValueError("slow_hysteresis must be >= 1")
         if prefill_threshold is not None and prefill_threshold < 0:
             raise ValueError("prefill_threshold must be >= 0 (or None)")
         if roles is None:
@@ -434,6 +627,10 @@ class Router:
         self.affinity = affinity
         self.prefill_threshold = prefill_threshold
         self.faults = faults
+        self.hedging = hedging
+        self.slow_threshold = slow_threshold
+        self.slow_hysteresis = slow_hysteresis
+        self._brownout = _Brownout(brownout_depth, brownout_hysteresis)
         self.registry = registry if registry is not None \
             else replicas[0].registry
         self.tracer = tracer if tracer is not None \
@@ -463,6 +660,11 @@ class Router:
               roles: Optional[Sequence[str]] = None,
               prefill_threshold: Optional[int] = None,
               faults=None,
+              hedging: bool = True,
+              slow_threshold: float = 3.0,
+              slow_hysteresis: int = 3,
+              brownout_depth: Optional[int] = None,
+              brownout_hysteresis: int = 4,
               prefill_engine_kw: Optional[dict] = None,
               decode_engine_kw: Optional[dict] = None,
               **engine_kw) -> "Router":
@@ -498,6 +700,10 @@ class Router:
         return cls(engines, max_queue=max_queue, failover=failover,
                    affinity=affinity, roles=role_list,
                    prefill_threshold=prefill_threshold, faults=faults,
+                   hedging=hedging, slow_threshold=slow_threshold,
+                   slow_hysteresis=slow_hysteresis,
+                   brownout_depth=brownout_depth,
+                   brownout_hysteresis=brownout_hysteresis,
                    registry=registry, tracer=tracer)
 
     # ---------------------------------------------------------- topology
@@ -624,7 +830,20 @@ class Router:
         reattributed = 0
         for fid in sorted(self._live):
             fr = self._requests[fid]
+            if fr.hedge_rid >= 0 and fr.hedge_replica == replica:
+                # the hedge died with the replica (dead process —
+                # nothing to purge there); the primary stands alone
+                self.purge_hedge(fr, f"replica {replica} killed "
+                                     f"mid-hedge")
             if fr.replica != replica:
+                continue
+            if fr.hedge_rid >= 0:
+                # the PRIMARY died but its hedge is already running on
+                # a live replica: promote the hedge instead of burning
+                # a reattribution the attempts budget no longer has
+                self.resolve_hedge(fr, f"replica {replica} killed "
+                                       f"(simulated SIGKILL) — hedge "
+                                       f"survives")
                 continue
             if self._reattribute(fr, f"replica {replica} killed "
                                      f"(simulated SIGKILL)"):
@@ -733,7 +952,9 @@ class Router:
                                None if stream_factory is None
                                else stream_factory(fid),
                                rec.get("deadline_s"),
-                               rec.get("ttft_deadline_s"))
+                               rec.get("ttft_deadline_s"),
+                               priority=rec.get("priority",
+                                                "interactive"))
             fr.journaled_submit = True     # this IS the journaled submit
             fr.delivered = fr.journal_hwm = delivered
             fr.submit_time = time.perf_counter()
@@ -816,11 +1037,19 @@ class Router:
                      ) -> List[Tuple[ReplicaHandle, Optional[int]]]:
         """The replica try-order for one prompt, best first, with each
         candidate's probed prefix-hit length.  Affinity mode: longest
-        cached prefix wins, healthy beats degraded, load breaks ties.
+        cached prefix wins within each health band — healthy beats
+        SLOW (the straggler detector's deprioritization) beats
+        degraded beats slow+degraded — and load breaks ties.
         Round-robin mode: rotate the cursor without probing anyone
         (hit = None; the caller probes only the ACCEPTED replica so
         ``router.prefix_hit_tokens`` stays comparable between the two
         policies without N radix walks per submit)."""
+        if not eligible:
+            # hedge/failover scans legitimately produce an empty
+            # candidate list (the only other replica is draining or
+            # quarantined) — that must mean "no order", never a
+            # modulo-by-zero out of the round-robin cursor
+            return []
         if not self.affinity:
             k = self._rr % len(eligible)
             self._rr += 1
@@ -830,8 +1059,8 @@ class Router:
                   for h in eligible]
         return sorted(
             probes,
-            key=lambda p: (p[0].engine.health.state == DEGRADED,
-                           -p[1], p[0].load, p[0].index))
+            key=lambda p: (p[0].health_rank, -p[1], p[0].load,
+                           p[0].index))
 
     # -------------------------------------------------------- submission
     def submit(self, prompt, max_new_tokens: int = 16,
@@ -839,7 +1068,8 @@ class Router:
                eos_token_id: Optional[int] = None,
                stream: Optional[Callable] = None,
                deadline_s: Optional[float] = None,
-               ttft_deadline_s: Optional[float] = None) -> int:
+               ttft_deadline_s: Optional[float] = None,
+               priority: str = "interactive") -> int:
         """Route one request; returns its FLEET id (valid with
         :meth:`result`/:meth:`cancel`/:meth:`stream`/:meth:`purge` on
         this router — engine-local ids never leak to clients).
@@ -859,7 +1089,18 @@ class Router:
         PREFILL replica capped at one token; the KV handoff + decode
         resubmission happen transparently inside later :meth:`step`\\ s.
         When every prefill replica refuses, the request falls back to
-        the decode-direct path rather than rejecting."""
+        the decode-direct path rather than rejecting.
+
+        ``priority`` ("interactive" — the default — or "batch") is the
+        request's class: batch work is deferrable inside each engine's
+        admission window and is the FIRST thing the brownout ladder
+        sheds (``brownout_shed_batch``, with an honest retry hint)
+        under sustained overload; at ladder level 2 interactive
+        submissions shed too while the queue stays over the bound
+        (``brownout_overload``)."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         fleet_id = next(self._ids)
         eligible = self._eligible("decode")
@@ -874,15 +1115,36 @@ class Router:
                              [h for h in self._handles
                               if h.serves("decode") and not h.retired
                               and h.engine.health.state != CIRCUIT_OPEN]))
+        # the brownout ladder (docs/serving.md "Tail latency"): shed
+        # batch first, then — at level 2, while the queue still sits
+        # over the ENTER bound — everyone, always with the honest
+        # live-metrics retry hint.  While browned out, every submit is
+        # ALSO a control observation — EXIT-only: an idle fleet whose
+        # work drained before the exit hysteresis completed would
+        # otherwise shed batch forever (rejections enqueue nothing, so
+        # step() — the normal tick site — never runs again), while
+        # escalation stays a per-step judgement
+        if self._brownout.level > 0:
+            self._brownout_tick(exit_only=True)
+        if self._brownout.level >= 1 and priority == "batch":
+            self.metrics.c_shed_batch.inc()
+            self.metrics.on_brownout("shed", self._brownout.level,
+                                     fleet_id=fleet_id)
+            self._reject(fleet_id, prompt, "brownout_shed_batch",
+                         self._best_hint(eligible))
+        if self._brownout.level >= 2 \
+                and self.queue_depth >= self._brownout.depth:
+            self._reject(fleet_id, prompt, "brownout_overload",
+                         self._best_hint(eligible))
         if self.max_queue is not None \
                 and self.queue_depth >= self.max_queue:
             self._reject(fleet_id, prompt, "fleet_queue_full",
                          self._best_hint(eligible))
         fr = _FleetRequest(fleet_id, prompt, max_new_tokens, sampling,
                            eos_token_id, stream, deadline_s,
-                           ttft_deadline_s)
+                           ttft_deadline_s, priority=priority)
         fr.submit_time = time.perf_counter()
-        rejections: List[RequestRejected] = []
+        rejections: List[Tuple[int, RequestRejected]] = []
         # disaggregated two-phase path: long prompts needing >1 output
         # token try the prefill plane first (prefix affinity among the
         # prefill replicas); the decode-direct order is the fallback
@@ -913,7 +1175,7 @@ class Router:
             try:
                 rid = self._submit_to(h, fr, max_new=1)
             except RequestRejected as e:
-                rejections.append(e)
+                rejections.append((h.index, e))
                 continue
             fr.role_stage = "prefill"
             return self._place(fr, h, rid, hit)
@@ -921,15 +1183,20 @@ class Router:
             try:
                 rid = self._submit_to(h, fr)
             except RequestRejected as e:
-                rejections.append(e)
+                rejections.append((h.index, e))
                 continue
             return self._place(fr, h, rid, hit)
         # every eligible replica rejected: surface the BEST replica's
-        # reason with the best (smallest, still-finite) retry hint
-        hints = [e.retry_after_s for e in rejections
+        # reason with the best (smallest, still-finite) retry hint,
+        # carrying EVERY replica's own rejection for debuggability
+        hints = [e.retry_after_s for _, e in rejections
                  if e.retry_after_s is not None]
-        self._reject(fleet_id, prompt, rejections[0].reason,
-                     min(hints) if hints else None)
+        per_replica = [{"replica": i, "reason": e.reason,
+                        "retry_after_s": e.retry_after_s}
+                       for i, e in rejections]
+        self._reject(fleet_id, prompt, rejections[0][1].reason,
+                     min(hints) if hints else None,
+                     per_replica=per_replica)
 
     def _place(self, fr: _FleetRequest, h: ReplicaHandle,
                rid: int, hit: Optional[int]) -> int:
@@ -950,7 +1217,8 @@ class Router:
                 else dataclasses.asdict(fr.sampling),
                 eos_token_id=fr.eos_token_id,
                 deadline_s=fr.deadline_s,
-                ttft_deadline_s=fr.ttft_deadline_s)
+                ttft_deadline_s=fr.ttft_deadline_s,
+                priority=fr.priority)
         if hit is None:             # round-robin: probe the winner only
             hit = h.engine.core.prefix_probe(fr.prompt)
         self.metrics.on_route(fr.fleet_id, h.index, hit)
@@ -980,13 +1248,24 @@ class Router:
         self.journal.append_progress(updates)
 
     def _reject(self, fleet_id: int, prompt: np.ndarray, reason: str,
-                retry_after_s: Optional[float]):
+                retry_after_s: Optional[float],
+                per_replica: Optional[List[Dict[str, object]]] = None):
         self.metrics.on_reject(reason)
+        status_reason = reason
+        if per_replica:
+            # the output's terminal record names every replica's own
+            # refusal, not just the winning reason — the multi-replica
+            # rejection path's debuggability contract
+            detail = "; ".join(
+                f"replica {d['replica']}: {d['reason']}"
+                for d in per_replica)
+            status_reason = f"{reason} [{detail}]"
         out = RequestOutput(
             request_id=fleet_id, prompt=prompt, tokens=[], finished=True,
             finish_reason=None, ttft_s=None, status="rejected",
-            status_reason=reason)
-        raise RequestRejected(reason, retry_after_s, output=out)
+            status_reason=status_reason)
+        raise RequestRejected(reason, retry_after_s, output=out,
+                              per_replica=per_replica)
 
     def _best_hint(self, handles: Sequence[ReplicaHandle]
                    ) -> Optional[float]:
@@ -1021,7 +1300,8 @@ class Router:
             else max_new,
             sampling=fr.sampling, eos_token_id=fr.eos_token_id,
             stream=self._fleet_stream(fr),
-            deadline_s=deadline, ttft_deadline_s=ttft)
+            deadline_s=deadline, ttft_deadline_s=ttft,
+            priority=fr.priority)
 
     def _fleet_stream(self, fr: _FleetRequest) -> Callable:
         """The exactly-once dedup wrapper: every replica attempt streams
@@ -1039,11 +1319,14 @@ class Router:
 
     # --------------------------------------------------------- execution
     def step(self) -> int:
-        """One fleet iteration: step every live replica, run the
-        failover scan over live requests, pump pending KV handoffs,
-        journal this step's delivered high-water marks, tick the
-        autoscaler (when attached) and refresh the fleet gauges.
-        Returns the number of requests still in flight fleet-wide."""
+        """One fleet iteration: step every live replica (timed — the
+        straggler detector's input), run the failover + hedge scans
+        over live requests, pump pending KV handoffs, tick the
+        brownout ladder, journal this step's delivered high-water
+        marks, tick the autoscaler (when attached) and refresh the
+        fleet gauges.  Returns the number of requests still in flight
+        fleet-wide."""
+        slow_victim, slow_armed = -1, None
         if self.faults is not None:
             # the replica_crash chaos point: SIGKILL the lowest-index
             # live replica (deterministic for a deterministic workload
@@ -1055,17 +1338,131 @@ class Router:
                     if not h.retired:
                         self.kill(h.index)
                         break
+            # the replica_slow chaos point: straggle the lowest-index
+            # live replica at the ROUTER (a sleep inside its timed
+            # step window — engine internals untouched), deterministic
+            # for the same reason as replica_crash
+            slow_armed = self.faults.check("replica_slow")
+            if slow_armed is not None:
+                for h in self._handles:
+                    if not h.retired:
+                        slow_victim = h.index
+                        break
         for h in self._handles:
-            if not h.retired:
-                h.engine.step()
+            if h.retired:
+                continue
+            # latency is observed only on steps that SERVED something:
+            # an idle replica's near-zero step time is not a health
+            # baseline, and feeding it in would make any busy peer —
+            # i.e. exactly the replica affinity concentrates load on —
+            # look like an outlier
+            busy = h.engine.core.scheduler.has_work()
+            h._observed = busy
+            t0 = time.perf_counter()
+            if h.index == slow_victim and busy:
+                # straggle only SERVING steps: an idle victim's sleep
+                # is never observed into the EWMA (the busy gate
+                # below), so it would burn wall clock for zero
+                # detection value through every drain tail
+                time.sleep(slow_armed.seconds)
+            h.engine.step()
+            if busy:
+                h.observe_step(time.perf_counter() - t0)
+        self._detect_stragglers()
         self._scan_failover()
+        self._scan_hedges()
         self._pump_handoffs()
+        self._brownout_tick()
         if self.journal is not None:
             self._journal_progress()
         if self._autoscaler is not None:
             self._autoscaler.tick()
         self.metrics.publish(self._handles)
         return self.in_flight
+
+    @property
+    def brownout_level(self) -> int:
+        """The overload-shedding ladder's current level (0 = normal;
+        docs/serving.md "Tail latency")."""
+        return self._brownout.level
+
+    def _brownout_tick(self, exit_only: bool = False) -> None:
+        """One brownout control observation of the live queue depth,
+        with the transition telemetry."""
+        transition = self._brownout.update(self.queue_depth,
+                                           exit_only=exit_only)
+        if transition is not None:
+            self.metrics.on_brownout(transition, self._brownout.level,
+                                     queue_depth=self.queue_depth)
+
+    # ------------------------------------------------------- stragglers
+    def _detect_stragglers(self) -> None:
+        """The fleet-relative outlier rule (docs/serving.md "Tail
+        latency"): a replica whose step-latency EWMA exceeds its
+        PEERS' median by ``slow_threshold`` for ``slow_hysteresis``
+        consecutive fleet steps is marked slow; it clears through the
+        same hysteresis.  The median excludes the replica under test —
+        in a small fleet a straggler drags a self-inclusive median up
+        toward its own latency and can mask itself (at n=2 a 2x
+        threshold could NEVER fire).  Needs at least two live replicas
+        — "slow" is a relative judgement, and a fleet of one has no
+        peer to be slower than."""
+        live = [h for h in self._handles
+                if not h.retired and h.step_ewma_s > 0.0]
+        if len(live) < 2:
+            # no peer, no relative judgement — and a STANDING mark must
+            # not freeze into stale evidence (a slow_ticks count the
+            # autoscaler would act on) when the fleet shrinks around
+            # it: clear it and let a future peer comparison re-earn it
+            # through the normal hysteresis
+            for h in self._handles:
+                if not h.retired and h.engine.health.slow:
+                    h.engine.health.clear_slow()
+                    h.slow_ticks = 0
+                    h._slow_streak = h._fast_streak = 0
+                    self.metrics.on_slow("clear", h.index,
+                                         reason="no live peer to "
+                                                "compare against")
+            return
+        for h in live:
+            health = h.engine.health
+            if not h._observed:
+                # no busy step this round: the frozen EWMA proves
+                # nothing either way — streaks and slow_ticks hold (an
+                # idle deprioritized replica must neither clear its
+                # mark on stale data nor accrue replacement pressure
+                # while it serves nothing)
+                continue
+            median = float(np.median([p.step_ewma_s for p in live
+                                      if p is not h]))
+            if median <= 0.0:
+                continue
+            bar = median * self.slow_threshold
+            if h.step_ewma_s > bar:
+                h._fast_streak = 0
+                h._slow_streak += 1
+                if not health.slow \
+                        and h._slow_streak >= self.slow_hysteresis:
+                    health.mark_slow(
+                        f"step EWMA {h.step_ewma_s:.4f}s > "
+                        f"{self.slow_threshold:g}x fleet median "
+                        f"{median:.4f}s for {h._slow_streak} steps")
+                    self.metrics.on_slow(
+                        "mark", h.index,
+                        ewma_s=round(h.step_ewma_s, 4),
+                        fleet_median_s=round(median, 4))
+            else:
+                h._slow_streak = 0
+                h._fast_streak += 1
+                if health.slow \
+                        and h._fast_streak >= self.slow_hysteresis:
+                    health.clear_slow()
+                    h.slow_ticks = 0
+                    self.metrics.on_slow(
+                        "clear", h.index,
+                        ewma_s=round(h.step_ewma_s, 4),
+                        fleet_median_s=round(median, 4))
+            h.slow_ticks = h.slow_ticks + 1 if health.slow else 0
 
     def has_work(self) -> bool:
         return (any(h.engine.core.scheduler.has_work()
@@ -1081,7 +1478,13 @@ class Router:
                 # transfer waiting for a slot must not trip the stall
                 # detector while it is still advancing
                 + self._handoffs.staged + self._handoffs.committed
-                + self._handoffs.aborted + self._handoffs.retries)
+                + self._handoffs.aborted + self._handoffs.retries
+                # every hedge transition (issue, win, failed issue) is
+                # fleet progress — a hedge race mid-flight must not
+                # trip the stall detector while it is still advancing
+                + self.metrics.c_hedges.value
+                + self.metrics.c_hedge_wins.value
+                + self.metrics.c_hedge_failed.value)
 
     def run_until_complete(self, max_steps: Optional[int] = None,
                            stall_steps: Optional[int] = 64) -> int:
@@ -1135,6 +1538,218 @@ class Router:
                 return
             self.step()
 
+    # ----------------------------------------------------------- hedging
+    def _hedge_delay_s(self) -> float:
+        """The p95-based hedge delay: a request is never duplicated
+        before it has been given the fleet's p95 TTFT to show progress
+        (the Tail-at-Scale rule — hedge the outliers, not the median).
+        0.0 with no history: a cold fleet hedges on projection alone."""
+        hist = self.registry.get("serving.ttft_s")
+        if hist is None:
+            return 0.0
+        q = hist.quantile(0.95)
+        return float(q) if q is not None else 0.0
+
+    def _projected_completion_s(self, fr: _FleetRequest,
+                                h: ReplicaHandle, req,
+                                now: float) -> Optional[float]:
+        """Projected submit→finish seconds for ``fr`` on its CURRENT
+        replica: time already spent, plus the live per-replica step
+        latency (the straggler detector's EWMA — one decode position
+        per step) times the positions left, plus the queue ahead while
+        the request has not been admitted.  None without latency
+        history — a projection invented from zero data must not issue
+        hedges."""
+        ewma = h.step_ewma_s
+        if ewma <= 0.0:
+            return None
+        elapsed = now - fr.submit_time
+        done = 0 if req is None else len(req.tokens)
+        remaining = max(fr.max_new_tokens - done, 0)
+        queued_s = 0.0
+        if req is not None and not req.finished \
+                and req.admit_time is None:
+            # still waiting for a slot: the position term is the
+            # replica's own live TTFT projection (queue drain at its
+            # measured completion rate — the same estimate SLO
+            # rejection uses), falling back to one step per queued
+            # request on a history-less replica
+            depth = h.engine.core.scheduler.queue_depth
+            est = h.engine.metrics.projected_ttft_s(depth)
+            queued_s = est if est is not None else depth * ewma
+        return elapsed + remaining * ewma + queued_s
+
+    def _scan_hedges(self) -> None:
+        """Issue hedges for deadline-at-risk requests (docs/serving.md
+        "Tail latency" hedge state machine).  Runs after the failover
+        scan each fleet step; suspended entirely under brownout —
+        duplicate work is load an overloaded fleet must not amplify."""
+        if not self.hedging or self._brownout.level >= 1 \
+                or not self._live:
+            return
+        now = time.perf_counter()
+        delay = None                     # computed lazily, once per scan
+        for fid in list(self._live):
+            fr = self._requests[fid]
+            if (fr.hedged or fr.attempts >= 2
+                    or fr.deadline_s is None
+                    or fr.role_stage != "decode"):
+                continue
+            h = self._handles[fr.replica]
+            req = h.engine._requests.get(fr.engine_rid)
+            if req is None or req.finished:
+                continue
+            if delay is None:
+                delay = self._hedge_delay_s()
+            # the delay is additionally bounded by a quarter of the
+            # request's own deadline: waiting the fleet p95 before
+            # hedging a SHORT-deadline request would spend the budget
+            # the hedge exists to protect
+            if now - fr.submit_time < min(delay, 0.25 * fr.deadline_s):
+                continue
+            proj = self._projected_completion_s(fr, h, req, now)
+            if proj is None or proj <= fr.deadline_s:
+                continue
+            self.issue_hedge(fr, now=now, projected_s=proj)
+
+    def issue_hedge(self, fr: _FleetRequest, now: Optional[float] = None,
+                    projected_s: Optional[float] = None) -> bool:
+        """Issue THE duplicate submission for a deadline-at-risk fleet
+        request onto the best OTHER healthy replica — the failover
+        shape applied preemptively: same fleet-id idempotency key, same
+        delivered-high-water-mark dedup (both attempts stream through
+        the one wrapper, so the client sees each token position exactly
+        once), same attempts ≤ 2 budget and deadline shrinking as
+        ``_reattribute``.  One hedge per fleet id, EVER — issuing (even
+        a failed issue: the opportunity is spent) sets ``fr.hedged``;
+        only a fleet state with NO candidate target at all (the sole
+        peer draining/quarantined) is a no-op the scan may retry.
+        Balance with :meth:`resolve_hedge` (the hedge won the race) or
+        :meth:`purge_hedge` (the hedge lost and unwinds) — a registered
+        graftlint ``ResourcePair``.  Returns True when a replica
+        accepted the duplicate; a False (every target rejected, or the
+        ``hedge_submit`` chaos point fired) fails CLOSED — the primary
+        attempt is untouched."""
+        if fr.hedged or fr.attempts >= 2:
+            return False
+        if now is None:
+            now = time.perf_counter()
+        targets = [h for h in self._eligible("decode")
+                   if h.index != fr.replica]
+        if not targets:
+            # nowhere to hedge RIGHT NOW (the only peer is draining or
+            # quarantined): a no-op, not a spent opportunity — the
+            # scan retries once a peer recovers, deadline permitting
+            return False
+        fr.hedged = True
+        if self.faults is not None:
+            armed = self.faults.check("hedge_submit")
+            if armed is not None:
+                # injected submission fault: the duplicate dies before
+                # landing anywhere — nothing to unwind, primary stands
+                self.metrics.c_hedge_failed.inc()
+                self.metrics.on_hedge(
+                    "failed", fr.fleet_id,
+                    reason="injected fault at hedge_submit")
+                return False
+        for h, hit in self._route_order(targets, fr.prompt):
+            try:
+                rid = self._submit_to(h, fr, now=now)
+            except (RequestRejected, ValueError):
+                # ValueError: a heterogeneous fleet — this target's
+                # max_seq cannot hold the request the primary's could.
+                # A hedge runs inside the step loop, so validation
+                # refusals mean "next target", never a raise that
+                # would strand the whole fleet mid-serve
+                continue
+            fr.hedge_replica, fr.hedge_rid = h.index, rid
+            fr.attempts += 1
+            h.routed += 1
+            self.metrics.c_hedges.inc()
+            self.metrics.on_hedge(
+                "issue", fr.fleet_id, primary=fr.replica,
+                target=h.index, deadline_s=fr.deadline_s,
+                projected_s=None if projected_s is None
+                else round(projected_s, 4))
+            return True
+        self.metrics.c_hedge_failed.inc()
+        self.metrics.on_hedge("failed", fr.fleet_id,
+                              reason="every eligible replica rejected "
+                                     "the duplicate")
+        return False
+
+    def resolve_hedge(self, fr: _FleetRequest, reason: str) -> None:
+        """The hedge won the race (it finished first, or the primary
+        died under it): promote it to the authoritative attempt and
+        purge the surrendered primary's engine record — the loser's
+        slot, staging rows and radix pins release through the normal
+        cancel-on-purge unwind (a KILLED primary's dead engine is left
+        alone; its state is unreadable by definition).  A no-op when no
+        hedge is live — resolving twice must never repoint the request
+        at the -1 sentinel (which would negative-index into the LAST
+        replica's handle)."""
+        if fr.hedge_rid < 0:
+            return
+        src, src_rid = fr.replica, fr.engine_rid
+        fr.history.append((src, src_rid, reason))
+        src_h = self._handles[src]
+        if not src_h.killed and src_rid in src_h.engine._requests:
+            src_h.engine.purge(src_rid)
+        fr.replica, fr.engine_rid = fr.hedge_replica, fr.hedge_rid
+        fr.hedge_replica = fr.hedge_rid = -1
+        self.metrics.c_hedge_wins.inc()
+        self.metrics.on_hedge("win", fr.fleet_id, winner=fr.replica,
+                              loser=src, reason=str(reason)[:200])
+
+    def purge_hedge(self, fr: _FleetRequest, reason: str) -> None:
+        """The hedge lost the race (the primary finished first, or the
+        client settled the request, or the hedge's replica died): unwind
+        the duplicate completely — its engine record is purged (cancel-
+        on-purge returns the slot and every pin), so the loser leaves
+        ZERO state behind on its replica.  Idempotent once the hedge is
+        resolved."""
+        if fr.hedge_rid < 0:
+            return
+        h = self._handles[fr.hedge_replica]
+        if not h.killed and fr.hedge_rid in h.engine._requests:
+            h.engine.purge(fr.hedge_rid)
+        self.metrics.on_hedge("purge", fr.fleet_id,
+                              replica=fr.hedge_replica,
+                              reason=str(reason)[:200])
+        fr.hedge_replica = fr.hedge_rid = -1
+
+    def _settle_hedge_race(self, fr: _FleetRequest) -> None:
+        """One scan pass over a LIVE hedge race: the first attempt to
+        reach ``finished`` wins and the loser is purged; an attempt
+        that dies (failed / deadline) while its peer still runs
+        surrenders to the peer; both terminal keeps the primary's
+        record standing and unwinds the hedge."""
+        pri = self._handles[fr.replica].engine._requests.get(
+            fr.engine_rid)
+        hed = self._handles[fr.hedge_replica].engine._requests.get(
+            fr.hedge_rid)
+        if hed is None:
+            # the hedge record vanished underneath us (its replica was
+            # retired mid-race) — the primary stands alone
+            fr.hedge_replica = fr.hedge_rid = -1
+            return
+        if pri is None:
+            self.resolve_hedge(fr, "primary record lost")
+            return
+        if pri.finished and pri.status == "finished":
+            self.purge_hedge(fr, "primary finished first")
+        elif hed.finished and hed.status == "finished":
+            self.resolve_hedge(fr, "hedge finished first")
+        elif pri.finished and hed.finished:
+            self.purge_hedge(fr, f"both attempts terminal "
+                                 f"({pri.status} / {hed.status})")
+        elif pri.finished:
+            self.resolve_hedge(fr, f"primary {pri.status}: "
+                                   f"{pri.status_reason}")
+        elif hed.finished:
+            self.purge_hedge(fr, f"hedge {hed.status}: "
+                                 f"{hed.status_reason}")
+
     # ---------------------------------------------------------- failover
     def _scan_failover(self) -> None:
         """Settle finished fleet requests; resubmit replica-attributed
@@ -1145,6 +1760,11 @@ class Router:
             return
         for fid in list(self._live):
             fr = self._requests[fid]
+            if fr.hedge_rid >= 0:
+                # a live hedge race settles BEFORE the terminal scan:
+                # first finished wins, the loser is purged, and fr
+                # points at the winner below
+                self._settle_hedge_race(fr)
             # the engine-internal record is authoritative and cheap;
             # result() would build a RequestOutput copy per scan
             req = self._handles[fr.replica].engine._requests.get(
@@ -1241,8 +1861,7 @@ class Router:
         slot while blocks actually need to move."""
         targets = sorted(
             self._eligible("decode"),
-            key=lambda h: (h.engine.health.state == DEGRADED, h.load,
-                           h.index))
+            key=lambda h: (h.health_rank, h.load, h.index))
         for h in targets:
             if tokens == 0 or h.engine.core.pool.free_slots > 0:
                 return h
@@ -1493,6 +2112,7 @@ class Router:
         fr = self._record(fleet_id)
         if fr.replica < 0:
             return self.result(fleet_id)   # already terminal, unplaced
+        self.purge_hedge(fr, "cancelled by client")
         out = self._handles[fr.replica].engine.cancel(fr.engine_rid)
         self._live.discard(fleet_id)   # settled: never fail over
         self._abort_pending_handoff(fleet_id, "cancelled by client")
@@ -1508,6 +2128,7 @@ class Router:
             out = self.result(fleet_id)
             del self._requests[fleet_id]
             return out
+        self.purge_hedge(fr, "purged by client")
         out = self._handles[fr.replica].engine.purge(fr.engine_rid)
         self._live.discard(fleet_id)
         self._abort_pending_handoff(fleet_id, "purged by client")
@@ -1538,6 +2159,12 @@ class Router:
             "routable_replicas": self.routable_count,
             "fleet_dead": self.fleet_dead,
             "failovers": self.metrics.c_failovers.value,
+            "hedges_live": sum(1 for fr in self._requests.values()
+                               if fr.hedge_rid >= 0),
+            "brownout_level": self._brownout.level,
+            "slow_replicas": [h.index for h in self._handles
+                              if not h.retired
+                              and h.engine.health.slow],
             "handoffs_pending": self._handoffs.pending,
             "handoffs": self._handoffs.snapshot(),
             "journal": None if self.journal is None
@@ -1548,6 +2175,8 @@ class Router:
                 {"index": h.index, "role": h.role,
                  "draining": h.draining, "retired": h.retired,
                  "killed": h.killed, "routed": h.routed,
+                 "slow": h.engine.health.slow,
+                 "step_ewma_s": round(h.step_ewma_s, 4),
                  # a killed replica's engine is a dead process: its
                  # internals are unreadable by definition, so the
                  # snapshot carries only the router-side view
@@ -1580,6 +2209,14 @@ class Router:
             "crash_reattributed": m.c_crash_reattributed.value,
             "replay_resubmitted": m.c_replay_resubmitted.value,
             "replay_expired": m.c_replay_expired.value,
+            "hedges": m.c_hedges.value,
+            "hedge_wins": m.c_hedge_wins.value,
+            "hedges_failed": m.c_hedge_failed.value,
+            "shed_batch": m.c_shed_batch.value,
+            "brownout_level": self._brownout.level,
+            "slow_replicas": sum(1 for h in self._handles
+                                 if not h.retired
+                                 and h.engine.health.slow),
             "journal": None if self.journal is None
             else self.journal.position(),
             "handoffs_staged": m.c_handoff_staged.value,

@@ -25,9 +25,16 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["SamplingParams", "Request", "Scheduler", "bucket_length"]
+__all__ = ["SamplingParams", "Request", "Scheduler", "bucket_length",
+           "PRIORITIES"]
 
 DEFAULT_MIN_BUCKET = 16
+
+# request priority classes (docs/serving.md "Tail latency"):
+# "interactive" is the latency-sensitive default; "batch" is offline
+# work the admission window may defer behind interactive arrivals and
+# the fleet brownout sheds FIRST under sustained overload
+PRIORITIES = ("interactive", "batch")
 
 
 def bucket_length(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
@@ -78,6 +85,11 @@ class Request:
     sampling: SamplingParams
     eos_token_id: Optional[int] = None
     stream: Optional[object] = None          # callable(request, token)
+    # priority class ("interactive" | "batch"): interactive is the
+    # latency-sensitive default; batch is deferrable offline work —
+    # admission prefers interactive inside the bounded skip window and
+    # the fleet brownout sheds batch first (docs/serving.md)
+    priority: str = "interactive"
     arrival_time: float = 0.0
     # robustness surface (docs/serving.md "Fault tolerance"): deadlines
     # are seconds RELATIVE to submission, checked host-side per step
@@ -161,6 +173,10 @@ class Scheduler:
     # -------------------------------------------------------- submission
     def submit(self, req: Request) -> Request:
         req.sampling.validate()
+        if req.priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, "
+                f"got {req.priority!r}")
         if req.prompt_len < 1:
             raise ValueError("prompt must hold at least one token")
         if req.prompt_len + req.max_new_tokens > self.max_seq:
@@ -246,13 +262,26 @@ class Scheduler:
         while self.waiting and len(out) < cap and budget > 0:
             window = 1 if self._head_skips >= self.max_head_skips \
                 else 1 + self.skip_window
-            pick = None
+            # priority-aware pick inside the SAME bounded window: the
+            # first budget-fitting interactive request wins; a batch
+            # request is admitted only when no interactive one fits.
+            # The window/head-skip bounds are unchanged, so the
+            # no-starvation guarantee holds for batch work too — once
+            # max_head_skips jumps collapse the window to the head,
+            # even a batch head admits (a batch request can be
+            # deferred, never starved)
+            pick = batch_pick = None
             for j, req in enumerate(
                     itertools.islice(self.waiting, window)):
                 c = cost(req)
                 if c <= budget:
-                    pick, pick_cost = j, c
-                    break
+                    if req.priority != "batch":
+                        pick, pick_cost = j, c
+                        break
+                    if batch_pick is None:
+                        batch_pick = (j, c)
+            if pick is None and batch_pick is not None:
+                pick, pick_cost = batch_pick
             if pick is None:
                 head_cost = cost(self.waiting[0])
                 if not out and token_budget is not None \

@@ -109,6 +109,14 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
     # replica's lifetime
     ResourcePair("spawn", "retire", "autoscaled replica",
                  receiver_hint=("scaler",)),
+    # serving/router.py hedged requests (docs/serving.md "Tail
+    # latency"): an issued hedge runs one request on TWO replicas —
+    # the race must end in resolve_hedge (the hedge won, the primary
+    # was purged) or purge_hedge (the hedge lost and unwinds) on every
+    # path, or the loser's slot and radix pins leak on its replica
+    ResourcePair("issue_hedge", "resolve_hedge", "hedged request",
+                 receiver_hint=("router",),
+                 alt_release=("purge_hedge",)),
     # serving/journal.py Journal: an open journal holds an OS file
     # handle and an unflushed tail — a journal leaked on an exception
     # path silently stops journaling AND pins the fd; close() is the

@@ -42,10 +42,22 @@ journal-ledger conservation (every journaled submit reached exactly
 one terminal record across BOTH incarnations) and replay parity (the
 merged client streams contain every token position exactly once).
 
+``--straggler`` switches to the ISSUE 15 tail-latency shape: a
+2-replica fleet with hedging armed, the router-level ``replica_slow``
+chaos point straggling replica 0 for the whole burst, and one long
+blocker occupying replica 0's slots so deadline-carrying requests
+queue behind it.  Each queued deadline request is hedged onto replica
+1 (the hedge state machine driven deterministically), the straggler
+detector must mark the victim slow, and the verdict is
+``straggler.json``: hedging/accounting conservation (every hedge
+resolved, pools at baseline on winner AND loser, attempts <= 2) plus
+replay parity — the hedged client streams match a hedging-OFF fleet
+token-for-token with strictly sequential positions.
+
 Usage:
     python scripts/fleet_chaos_smoke.py --out /tmp/fleet [--site step]
         [--at 2] [--times 3] [--requests 6] [--slots 2]
-        [--disaggregated | --crash]
+        [--disaggregated | --crash | --straggler]
 
 The script FAILS (exit 1) if the verdict is not ok or the fault never
 fired — tests/test_zz_fleet_serving.py and
@@ -220,6 +232,151 @@ def run_crash(args) -> int:
     return 0 if verdict["ok"] else 1
 
 
+def run_straggler(args) -> int:
+    """The ``--straggler`` scenario: replica 0 straggled at the router
+    (``replica_slow``) under a long blocker, deadline requests queued
+    behind it hedged onto replica 1.  Emits straggler.json (hedging +
+    accounting verdict, parity vs a hedging-off fleet) and
+    metrics.prom."""
+    import numpy as np
+    import paddle_tpu
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.obs import MetricsRegistry, Tracer
+    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                    Router, ServingEngine)
+
+    def model():
+        paddle_tpu.seed(7)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        return m
+
+    def fleet(hedging, faults):
+        registry, tracer = MetricsRegistry(), Tracer()
+        ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+        engines = [ServingEngine(model(), num_slots=args.slots,
+                                 min_bucket=8, block_len=8,
+                                 fault_tolerance=ft, registry=registry,
+                                 tracer=tracer) for _ in range(2)]
+        return Router(engines, hedging=hedging, faults=faults,
+                      slow_threshold=2.0, slow_hysteresis=2,
+                      registry=registry, tracer=tracer), registry
+
+    prompts = build_workload(args.requests, 256)
+    blocker_prompt = np.arange(1, 9, dtype=np.int32)
+
+    def run(hedging, faults):
+        """One pass of the shared shape; returns (router, registry,
+        streams, blocker fid, request fids, hedged fids)."""
+        router, registry = fleet(hedging, faults)
+        streams = {}
+
+        def recorder(fid):
+            streams[fid] = []
+
+            def cb(req, tok):
+                streams[fid].append((len(req.tokens) - 1, int(tok)))
+            return cb
+
+        # warm BOTH planes (compile), then drop the compile-inflated
+        # EWMAs — the detector must judge the straggled steady state,
+        # and a replica idling on a frozen compile-heavy EWMA would
+        # otherwise mask the victim behind an inflated peer median
+        warm = [router.submit(p[:4], max_new_tokens=2)
+                for p in prompts[:2]]
+        router.run_until_complete(max_steps=5000)
+        for fid in warm:
+            router.purge(fid)
+        for h in router.replicas:
+            h.step_ewma_s = 0.0
+        # the blocker lands on replica 0 (index tie-break on an empty
+        # fleet) and holds its slots while the burst queues behind it
+        blocker = router.submit(blocker_prompt,
+                                max_new_tokens=8 * args.max_new_tokens)
+        router.step()
+        assert router._requests[blocker].replica == 0
+        fids = []
+        for p in prompts:
+            fid = router.submit(p, max_new_tokens=args.max_new_tokens,
+                                deadline_s=120.0)
+            router._requests[fid].client_stream = recorder(fid)
+            fids.append(fid)
+        router.step()
+        if faults is not None:
+            faults.enable("replica_slow", times=10 ** 6,
+                          seconds=args.seconds)
+        hedged = []
+        try:
+            # hedge every deadline request still owned by the straggled
+            # replica — the deterministic drive of the hedge machinery
+            # (the projection path needs wall-clock history; a smoke
+            # must not depend on timing)
+            for fid in fids:
+                fr = router._requests[fid]
+                if fr.replica == 0 and hedging \
+                        and router.issue_hedge(fr):
+                    hedged.append(fid)
+            router.run_until_complete(max_steps=20000)
+        finally:
+            if faults is not None:
+                faults.disable("replica_slow")
+        return router, registry, streams, blocker, fids, hedged
+
+    faults = FaultInjector()
+    router, registry, streams, blocker, fids, hedged = run(True, faults)
+    # the hedging-off oracle: same weights, same submission order, no
+    # chaos — greedy determinism makes its tokens the parity reference
+    oracle, _, _, _, ofids, _ = run(False, None)
+    want = {i: list(oracle.result(f).tokens) for i, f in enumerate(ofids)}
+
+    acc = router.accounting()
+    rm = router.metrics_dict()
+    straggler_marked = any(
+        e[0] == "straggler_mark" for e in router.tracer.events())
+    parity = True
+    requests = []
+    for i, fid in enumerate(fids):
+        pos = [q for q, _ in streams[fid]]
+        toks = [t for _, t in streams[fid]]
+        ok = (pos == list(range(len(pos))) and toks == want[i])
+        parity &= ok
+        fr = router._requests[fid]
+        requests.append({
+            "fleet_id": fid, "parity": ok, "hedged": fr.hedged,
+            "attempts": fr.attempts, "tokens": len(toks),
+            "status": router.result(fid).status,
+        })
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "metrics.prom"), "w") as f:
+        f.write(registry.prometheus())
+    ok = bool(acc["ok"] and acc["hedges_settled"] and parity
+              and straggler_marked and faults.fired["replica_slow"] >= 1
+              and len(hedged) >= 1)
+    verdict = {
+        "site": "replica_slow",
+        "ok": ok,
+        "fired": faults.fired["replica_slow"],
+        "straggler_marked": straggler_marked,
+        "hedged_requests": len(hedged),
+        "hedges": rm["hedges"],
+        "hedge_wins": rm["hedge_wins"],
+        "hedges_failed": rm["hedges_failed"],
+        "replay_parity": bool(parity),
+        "all_terminal": acc["all_terminal"],
+        "hedges_settled": acc["hedges_settled"],
+        "pools_at_baseline": acc["pools_at_baseline"],
+        "served_at_most_once_retry": acc["served_at_most_once_retry"],
+        "blocker_status": router.result(blocker).status,
+        "requests": requests,
+        "replicas": [{"slow": r.get("slow", False), "ok": r["ok"]}
+                     for r in acc["replicas"]],
+    }
+    with open(os.path.join(args.out, "straggler.json"), "w") as f:
+        json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fleet_chaos_smoke",
                                  description=__doc__)
@@ -246,9 +403,16 @@ def main(argv=None) -> int:
                          "replica mid-burst, crash the process, "
                          "recover a fresh fleet from the journal and "
                          "emit the crash.json verdict")
+    ap.add_argument("--straggler", action="store_true",
+                    help="2-replica fleet with hedging: replica 0 "
+                         "straggled via the router-level replica_slow "
+                         "point, queued deadline requests hedged onto "
+                         "replica 1, parity vs a hedging-off fleet — "
+                         "emits the straggler.json verdict")
     args = ap.parse_args(argv)
-    if args.crash and args.disaggregated:
-        ap.error("--crash and --disaggregated are separate scenarios")
+    if sum((args.crash, args.disaggregated, args.straggler)) > 1:
+        ap.error("--crash, --disaggregated and --straggler are "
+                 "separate scenarios")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import paddle_tpu
@@ -263,6 +427,8 @@ def main(argv=None) -> int:
         ap.error(f"--site must be one of {POINTS}")
     if args.crash:
         return run_crash(args)
+    if args.straggler:
+        return run_straggler(args)
     handoff_site = args.site.startswith("handoff_") \
         or args.site == "replica_spawn"
 

@@ -1,0 +1,26 @@
+"""Clean hedged-request issue/resolve-or-purge idioms — zero findings.
+
+try/except-protected issue windows closed by EITHER terminal
+(resolve_hedge when the hedge won, purge_hedge when it lost —
+``purge_hedge`` is the pair's registered alt release), adjacent
+issue/purge, and non-router receivers the hint gate must leave alone.
+"""
+
+
+def protected_hedge_window(router, fr, fleet):
+    router.issue_hedge(fr)
+    try:
+        fleet.step()
+        router.resolve_hedge(fr, "hedge finished first")   # win terminal
+    except Exception:
+        router.purge_hedge(fr, "primary stands")           # lose terminal
+
+
+def purge_is_a_legal_close(router, fr):
+    router.issue_hedge(fr)
+    router.purge_hedge(fr, "primary finished first")   # alt release
+
+
+def non_router_receiver_untracked(garden, seedling):
+    garden.issue_hedge(seedling)    # hint gate: not a fleet router
+    garden.trim()

@@ -1,0 +1,20 @@
+"""Planted resource-lifecycle bugs for the hedged-request
+issue/resolve-or-purge ResourcePair — exactly 2 findings:
+
+  1. an issued hedge leaked on the exception edge (issue_hedge ->
+     raising fleet step -> resolve_hedge, unprotected — the loser's
+     slot and radix pins would never release if the step raised);
+  2. a hedge issued and never resolved nor purged at all.
+"""
+
+
+def hedge_leaks_on_raise(router, fr, fleet):
+    router.issue_hedge(fr)          # BUG 1: leaks if the step raises
+    fleet.step()
+    router.resolve_hedge(fr, "hedge finished first")
+
+
+def issued_and_forgotten(router, fr):
+    router.issue_hedge(fr)          # BUG 2: never closed
+    attempts = fr.attempts
+    return attempts

@@ -514,6 +514,41 @@ def test_autoscaler_lifecycle_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_hedge_pair_registered():
+    """ISSUE 15: the hedged-request protocol (issue_hedge closes with
+    resolve_hedge — the hedge won — OR purge_hedge — the loser
+    unwinds, via ``alt_release``) is a registered ResourcePair,
+    receiver-hinted to router receivers so unrelated call sites stay
+    untracked."""
+    from paddle_tpu.tools.analysis.checkers.lifecycle import DEFAULT_PAIRS
+    by_kind = {p.kind: p for p in DEFAULT_PAIRS}
+    hedge = by_kind["hedged request"]
+    assert hedge.acquire == "issue_hedge"
+    assert hedge.releases == ("resolve_hedge", "purge_hedge")
+    assert "router" in hedge.receiver_hint
+
+
+def test_hedge_lifecycle_positive():
+    """Exactly 2 planted bugs: an issued hedge leaked across a raising
+    fleet step, and a hedge issued but never resolved nor purged."""
+    res = run_rule("hedge_lifecycle_pos.py", "resource-lifecycle")
+    found = only_rule(res, "resource-lifecycle")
+    assert len(found) == 2, [f.format() for f in res.findings]
+    msgs = " | ".join(f.message for f in found)
+    assert "hedged request" in msgs
+    assert "leaks if an exception fires" in msgs
+    assert "never escapes" in msgs
+    assert "resolve_hedge/purge_hedge" in msgs   # both terminals named
+
+
+def test_hedge_lifecycle_negative():
+    """resolve-on-win/purge-on-lose windows, adjacent issue/purge (the
+    alt release balances), and non-router receivers (hint gate) —
+    silent."""
+    res = run_rule("hedge_lifecycle_neg.py", "resource-lifecycle")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_journal_pairs_registered():
     """ISSUE 14: the durable request journal's open/close (crash() —
     the simulated-SIGKILL chaos helper — is a legal alt release) and

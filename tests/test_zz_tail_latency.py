@@ -1,0 +1,969 @@
+"""Tail-latency defense: straggler detection, hedged requests,
+priority-class load shedding (ISSUE 15).
+
+THE tail-latency invariant, extending the fleet chaos suite: under an
+armed straggler (``replica_slow`` at the router, or the engine-level
+``slow_step``),
+
+  (a) hedged delivery is exactly-once and token-identical — greedy and
+      seeded client streams match a hedging-off fleet token-for-token,
+      delivered positions strictly sequential, no duplicates;
+  (b) the hedge race conserves state: the loser is FULLY unwound
+      (pools / radix refcounts / journal ledger at baseline on winner
+      AND loser), every issued hedge reaches win or purge, and the
+      attempts <= 2 idempotency bound holds;
+  (c) the per-plane compile pin ({chunk} + buckets + ONE decode + 1
+      gather + 1 scatter) is untouched — hedging adds ZERO compiled
+      surface;
+  (d) the straggler detector marks (and clears) ``EngineHealth.slow``
+      with hysteresis, the route order deprioritizes slow replicas
+      between healthy and degraded, and brownout sheds batch work
+      first with honest retry hints.
+
+Plus the ISSUE 15 satellite regressions: the routing-order matrix with
+the slow state, slow x drain()/kill() interaction, per-replica
+rejection reasons on the multi-replica rejection path, priority-aware
+admission, and the autoscaler's replace-persistently-slow path.
+
+The soak-length chaos matrix variant is ``slow``-marked
+(``test_tail_latency_soak_matrix``); its fast siblings
+(``test_hedge_race_exactly_once_parity`` + ``test_replica_slow_chaos``
++ ``test_hedge_submit_fails_closed``) re-pin every invariant inside
+the tier-1 window — PR 14's budget discipline.
+
+zz-prefixed for the same reason as the other serving chaos suites
+(tests/conftest.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.obs import MetricsRegistry, Tracer
+from paddle_tpu.serving import (Autoscaler, FaultInjector,
+                                FaultToleranceConfig, RequestRejected,
+                                Router, SamplingParams, ServingEngine,
+                                fleet_accounting, replica_accounting)
+
+
+def make_model():
+    """Identical weights on every call — replicas and the parity oracle
+    must agree token-for-token (the hedge's regeneration depends on it)."""
+    paddle_tpu.seed(13)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return make_model()
+
+
+def _prompts(seed, lengths, vocab=256):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (L,)) for L in lengths]
+
+
+def _want(model, prompt, n=5):
+    seq = model.generate(jnp.asarray(prompt)[None], max_new_tokens=n)
+    return np.asarray(seq)[0, len(prompt):]
+
+
+def make_fleet(n=2, retries=2, num_slots=2, router_faults=None, **kw):
+    """Fleet of ``n`` fault-tolerant replicas (identical weights) on
+    ONE registry/tracer; ``router_faults`` arms the ROUTER-level chaos
+    points (replica_slow / hedge_submit / replica_crash)."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=retries,
+                              backoff_base_s=0.0)
+    engine_kw = {k: v for k, v in kw.items()
+                 if k not in ("hedging", "brownout_depth",
+                              "brownout_hysteresis", "slow_threshold",
+                              "slow_hysteresis", "journal")}
+    router_kw = {k: v for k, v in kw.items() if k not in engine_kw}
+    engines = [ServingEngine(make_model(), num_slots=num_slots,
+                             min_bucket=8, fault_tolerance=ft,
+                             registry=registry, tracer=tracer,
+                             **engine_kw)
+               for _ in range(n)]
+    return Router(engines, faults=router_faults, registry=registry,
+                  tracer=tracer, **router_kw)
+
+
+def recorder(streams, fid):
+    streams[fid] = []
+
+    def cb(req, tok):
+        streams[fid].append((len(req.tokens) - 1, int(tok)))
+    return cb
+
+
+# ------------------------------------------------- straggler detection
+
+def test_straggler_marks_and_clears_with_hysteresis():
+    """The outlier rule is deterministic on fed latencies: a replica at
+    threshold x the fleet median marks slow only after
+    ``slow_hysteresis`` CONSECUTIVE outlier steps (one slow step never
+    flaps it), and clears through the same hysteresis."""
+    router = make_fleet(n=3, slow_threshold=2.0, slow_hysteresis=3)
+    h0, h1, h2 = router.replicas
+
+    def feed(latencies):
+        for h, s in zip((h0, h1, h2), latencies):
+            # pin the EWMA exactly (the detector's input, decoupled
+            # from wall clocks for determinism)
+            h.step_ewma_s = s
+        router._detect_stragglers()
+
+    # one slow observation: NO mark (hysteresis)
+    feed((0.50, 0.01, 0.01))
+    assert not h0.engine.health.slow
+    feed((0.01, 0.01, 0.01))            # recovers: streak resets
+    feed((0.50, 0.01, 0.01))
+    feed((0.50, 0.01, 0.01))
+    assert not h0.engine.health.slow    # still only 2 consecutive
+    feed((0.50, 0.01, 0.01))
+    assert h0.engine.health.slow        # 3rd consecutive -> marked
+    assert "fleet median" in h0.engine.health.slow_reason
+    assert h0.health_rank == 1 and h1.health_rank == 0
+    router.metrics.publish(router.replicas)
+    assert router.registry.snapshot()["router.slow_replicas"] == 1
+    # clearing needs the same hysteresis
+    feed((0.01, 0.01, 0.01))
+    feed((0.01, 0.01, 0.01))
+    assert h0.engine.health.slow
+    feed((0.01, 0.01, 0.01))
+    assert not h0.engine.health.slow
+    assert h0.slow_ticks == 0
+    ev = [e[0] for e in router.tracer.events()
+          if e[0].startswith("straggler_")]
+    assert "straggler_mark" in ev and "straggler_clear" in ev
+    # idle rounds FREEZE the state: no clearing on a stale EWMA, no
+    # slow_ticks accrual while the replica serves nothing
+    feed((0.50, 0.01, 0.01))
+    feed((0.50, 0.01, 0.01))
+    feed((0.50, 0.01, 0.01))
+    assert h0.engine.health.slow
+    ticks = h0.slow_ticks
+    h0._observed = False
+    for _ in range(5):
+        feed((0.01, 0.01, 0.01))      # recovered latencies, but idle
+    assert h0.engine.health.slow      # mark stands
+    assert h0.slow_ticks == ticks     # no replacement pressure accrued
+    h0._observed = True
+    feed((0.01, 0.01, 0.01))
+    feed((0.01, 0.01, 0.01))
+    feed((0.01, 0.01, 0.01))
+    assert not h0.engine.health.slow  # busy steps prove the recovery
+    # a fleet of one has no peer to be slower than
+    solo = make_fleet(n=1)
+    solo.replicas[0].step_ewma_s = 99.0
+    solo._detect_stragglers()
+    assert not solo.replicas[0].engine.health.slow
+
+
+def test_routing_order_matrix_slow_degraded_draining_quarantined():
+    """The full routing matrix with the new slow band: healthy < slow
+    < degraded < slow+degraded among ROUTABLE replicas; draining /
+    quarantined / circuit-open / retired are excluded outright."""
+    router = make_fleet(n=6, num_slots=2)
+    hs = router.replicas
+    # 0 healthy, 1 slow, 2 degraded, 3 slow+degraded, 4 draining,
+    # 5 quarantined
+    hs[1].engine.health.mark_slow("test")
+    hs[2].engine.health.degraded = True
+    hs[3].engine.health.mark_slow("test")
+    hs[3].engine.health.degraded = True
+    router.drain(4)
+    hs[5].engine.health._in_quarantine = True
+    try:
+        eligible = router._eligible("decode")
+        assert [h.index for h in eligible] == [0, 1, 2, 3]
+        order = [h.index for h, _ in router._route_order(
+            eligible, np.array([1, 2, 3], np.int32))]
+        assert order == [0, 1, 2, 3]
+        # the ranks behind the order
+        assert [hs[i].health_rank for i in range(4)] == [0, 1, 2, 3]
+        # a submit lands on the healthy replica
+        fid = router.submit(np.array([1, 2, 3], np.int32),
+                            max_new_tokens=2)
+        assert router._requests[fid].replica == 0
+        # healthy excluded too -> the SLOW replica is next in line
+        router.drain(0)
+        fid2 = router.submit(np.array([1, 2, 3], np.int32),
+                             max_new_tokens=2)
+        assert router._requests[fid2].replica == 1
+    finally:
+        hs[5].engine.health._in_quarantine = False
+        router.undrain(4)
+        router.undrain(0)
+    router.run_until_complete(300)
+    assert fleet_accounting(router)["ok"]
+
+
+def test_slow_interacts_with_drain_and_kill():
+    """Slow is an overlay, not a state: a slow replica can drain (and
+    the drain wins — no new work), a slow replica can be killed (the
+    kill wins — excluded outright), and the gauge tracks only live
+    replicas."""
+    router = make_fleet(n=3)
+    hs = router.replicas
+    hs[0].engine.health.mark_slow("test")
+    hs[1].engine.health.mark_slow("test")
+    router.drain(0)
+    assert [h.index for h in router._eligible("decode")] == [1, 2]
+    router.undrain(0)
+    router.kill(1)
+    assert [h.index for h in router._eligible("decode")] == [0, 2]
+    router.metrics.publish(router.replicas)
+    # the killed replica's slow flag no longer counts (it left the
+    # fleet); the drained-then-undrained one still does
+    assert router.registry.snapshot()["router.slow_replicas"] == 1
+    router.run_until_complete(100)
+
+
+def test_stale_slow_mark_clears_when_fleet_shrinks_below_two():
+    """A standing slow mark must not freeze into replacement bait when
+    the fleet shrinks around it: with no live peer to compare against,
+    the mark (and its slow_ticks) clears and must be re-earned through
+    the normal hysteresis once a peer returns."""
+    router = make_fleet(n=2)
+    h0 = router.replicas[0]
+    h0.step_ewma_s = 0.5
+    h0.engine.health.mark_slow("test")
+    h0.slow_ticks = 99
+    router.kill(1)                      # the only peer is gone
+    router._detect_stragglers()
+    assert not h0.engine.health.slow
+    assert h0.slow_ticks == 0
+    ev = [e[0] for e in router.tracer.events()
+          if e[0] == "straggler_clear"]
+    assert ev
+    router.run_until_complete(100)
+
+
+def test_replica_slow_chaos_marks_the_victim():
+    """Satellite: the router-level ``replica_slow`` injection straggles
+    ONE replica without touching engine internals — the detector marks
+    it slow, the event lands on the router lane, and total accounting
+    holds."""
+    inj = FaultInjector()
+    router = make_fleet(n=2, router_faults=inj, slow_threshold=2.0,
+                        slow_hysteresis=2)
+    # warm both planes so step wall times are steady-state, then drop
+    # the compile-inflated warmup EWMAs — the detector should judge
+    # the straggled steady state, not the one-off trace cost
+    for p in _prompts(31, (4, 5)):
+        router.submit(p, max_new_tokens=2)
+    router.run_until_complete(200)
+    for h in router.replicas:
+        h.step_ewma_s = 0.0
+    # keep BOTH replicas serving through the straggle window — the
+    # detector only observes steps that served something (an idle
+    # replica is no baseline)
+    a = router.submit(_prompts(32, (4,))[0], max_new_tokens=60)
+    b = router.submit(_prompts(33, (5,))[0], max_new_tokens=60)
+    assert {router._requests[a].replica,
+            router._requests[b].replica} == {0, 1}
+    inj.enable("replica_slow", times=30, seconds=0.05)
+    try:
+        for _ in range(14):
+            router.step()
+    finally:
+        inj.disable("replica_slow")
+    assert inj.fired["replica_slow"] >= 10
+    # the victim is the lowest-index live replica: 0
+    assert router.replicas[0].engine.health.slow
+    assert not router.replicas[1].engine.health.slow
+    assert router.replicas[0].slow_ticks >= 1
+    assert router.metrics_dict()["slow_replicas"] == 1
+    router.cancel(a)
+    router.cancel(b)
+    router.run_until_complete(200)
+    assert fleet_accounting(router)["ok"]
+
+
+def test_autoscaler_replaces_persistently_slow_replica():
+    """The autoscaler's replace-slow path: an AUTOSCALED decode replica
+    continuously slow for ``replace_slow_after`` fleet steps is drained
+    and a replacement spawned through the normal warmup gate; operator
+    replicas are never victims."""
+    router = make_fleet(n=2)
+    registry = router.registry
+    ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+    spawn = lambda: ServingEngine(make_model(), num_slots=2,
+                                  min_bucket=8, fault_tolerance=ft,
+                                  registry=registry,
+                                  tracer=router.tracer)
+    scaler = Autoscaler(router, spawn, min_decode=1, max_decode=4,
+                        scale_up_depth=10 ** 6, cooldown_steps=0,
+                        replace_slow_after=3)
+    idx = scaler.spawn()
+    assert idx == 2
+    # operator replica 0 persistently slow: NEVER replaced
+    router.replicas[0].engine.health.mark_slow("test")
+    router.replicas[0].slow_ticks = 99
+    assert scaler.tick() is None
+    # the autoscaled replica crosses the bar -> drain + respawn
+    router.replicas[idx].engine.health.mark_slow("test")
+    router.replicas[idx].slow_ticks = 3
+    assert scaler.tick() == "replace_slow"
+    assert router.replicas[idx].draining
+    assert len(router.replicas) == 4          # replacement spawned
+    assert scaler.snapshot()["slow_replacements"] == 1
+    # the drained victim retires on a later tick
+    for _ in range(4):
+        router.step()
+    assert router.replicas[idx].retired
+    # a FAILED replacement spawn must not shrink the fleet: the victim
+    # keeps serving (slow beats absent) and the next tick retries
+    inj = FaultInjector()
+    scaler.faults = inj
+    idx2 = scaler.spawn()
+    router.replicas[idx2].engine.health.mark_slow("test")
+    router.replicas[idx2].slow_ticks = 3
+    inj.enable("replica_spawn")
+    try:
+        assert scaler.tick() != "replace_slow"
+    finally:
+        inj.disable("replica_spawn")
+    assert not router.replicas[idx2].draining     # victim untouched
+    assert scaler.snapshot()["spawn_failures"] == 1
+    assert scaler.tick() == "replace_slow"        # retry succeeds
+    assert router.replicas[idx2].draining
+
+
+# ------------------------------------------------------ hedged requests
+
+def _warm_affinity(router, prefix, replica=0):
+    """Warm ``replica``'s radix cache with ``prefix`` so affinity pins
+    later shared-prefix traffic there regardless of load."""
+    fid = router.submit(np.concatenate([prefix, [9]]), max_new_tokens=2)
+    assert router._requests[fid].replica == replica
+    router.run_until_complete(300)
+    router.purge(fid)
+
+
+@pytest.mark.parametrize("sampling", [
+    None,
+    SamplingParams(do_sample=True, temperature=0.9, seed=7),
+], ids=["greedy", "seeded"])
+def test_hedge_race_exactly_once_parity(oracle, sampling):
+    """THE hedge invariant (fast pin; the slow soak matrix re-runs it
+    across sites): a request queued behind a long job on its affinity
+    replica hedges onto the idle replica, the hedge WINS, and the
+    client stream is exactly-once and token-identical to a hedging-off
+    fleet — with pools/radix at baseline on winner AND loser and the
+    compile pin intact."""
+    prefix = _prompts(41, (16,))[0]
+    suffix = _prompts(42, (4,))[0]
+    prompt = np.concatenate([prefix, suffix])
+
+    def run(hedging):
+        router = make_fleet(n=2, num_slots=1, block_len=8,
+                            hedging=hedging)
+        _warm_affinity(router, prefix)
+        streams = {}
+        # occupy replica 0's single slot with a long request
+        blocker = router.submit(np.concatenate([prefix, [3]]),
+                                max_new_tokens=40)
+        router.step()
+        assert router._requests[blocker].replica == 0
+        # the target queues behind it on the warm replica
+        fid = router.submit(prompt, max_new_tokens=6, sampling=sampling,
+                            deadline_s=60.0)
+        router._requests[fid].client_stream = recorder(streams, fid)
+        fr = router._requests[fid]
+        assert fr.replica == 0
+        router.step()
+        if hedging:
+            assert router.issue_hedge(fr)
+            assert fr.hedge_replica == 1 and fr.attempts == 2
+        router.run_until_complete(800)
+        return router, fid, blocker, streams
+
+    router, fid, blocker, streams = run(True)
+    out = router.result(fid)
+    assert out.status == "finished"
+    fr = router._requests[fid]
+    # the hedge won: the queued primary was purged, replica 1 owns it
+    assert fr.replica == 1 and fr.hedge_rid == -1
+    rm = router.metrics_dict()
+    assert rm["hedges"] == 1 and rm["hedge_wins"] == 1
+    # exactly-once, strictly sequential positions
+    positions = [p for p, _ in streams[fid]]
+    assert positions == list(range(len(out.tokens)))
+    # token-identical vs the hedging-off fleet AND the oracle (greedy)
+    router_off, fid_off, blocker_off, streams_off = run(False)
+    out_off = router_off.result(fid_off)
+    assert out_off.status == "finished"
+    assert router_off.metrics_dict()["hedges"] == 0
+    assert list(out.tokens) == list(out_off.tokens)
+    assert [t for _, t in streams[fid]] \
+        == [t for _, t in streams_off[fid_off]] == list(out.tokens)
+    if sampling is None:
+        np.testing.assert_array_equal(out.tokens,
+                                      _want(oracle, prompt, 6))
+    for router_i, blk in ((router, blocker), (router_off, blocker_off)):
+        assert router_i.result(blk).status == "finished"
+        acc = fleet_accounting(router_i)
+        assert acc["ok"], acc
+        assert acc["hedges_settled"]
+        for h in router_i.replicas:         # winner AND loser baselines
+            ra = replica_accounting(h.engine)
+            assert ra["ok"], ra
+            # compile pin: at most ONE decode program per plane (the
+            # hedging-off fleet never touches replica 1), no
+            # hedge-borne recompiles anywhere
+            assert h.engine.core.trace_counts["decode"] <= 1
+    # both replicas of the HEDGED fleet served decode work on the one
+    # compiled program each
+    assert [h.engine.core.trace_counts["decode"]
+            for h in router.replicas] == [1, 1]
+
+
+def test_projection_breach_issues_hedge_automatically(oracle):
+    """The auto path end-to-end: a deadline-carrying request queued
+    behind a long job breaches its projected completion once the
+    replica has latency history, and the scan hedges it without any
+    manual driving."""
+    prefix = _prompts(43, (16,))[0]
+    prompt = np.concatenate([prefix, _prompts(44, (4,))[0]])
+    router = make_fleet(n=2, num_slots=1, block_len=8)
+    _warm_affinity(router, prefix)
+    blocker = router.submit(np.concatenate([prefix, [3]]),
+                            max_new_tokens=60)
+    router.step()
+    # a 1s deadline the projection (queue drain at the live completion
+    # rate + remaining tokens at the step EWMA, both inflated by the
+    # 60-token blocker holding the only slot) must breach; the engine-
+    # side deadlines are patched generous below so the WALL clock never
+    # expires anything — this pins issuance, not expiry
+    fid = router.submit(prompt, max_new_tokens=6, deadline_s=30.0)
+    fr = router._requests[fid]
+    fr.deadline_s = 1.0               # projection target
+    assert fr.replica == 0
+    for _ in range(60):
+        router.step()
+        if fr.hedged:
+            break
+        time.sleep(0.02)              # let elapsed cross the delay gate
+    assert fr.hedged, "projection never breached"
+    assert router.metrics_dict()["hedges"] == 1
+    fr.deadline_s = 60.0              # never let the wall clock expire
+    hedge_req = router.replicas[fr.hedge_replica].engine._requests[
+        fr.hedge_rid]
+    hedge_req.deadline_s = 60.0
+    router.run_until_complete(800)
+    out = router.result(fid)
+    assert out.status == "finished"
+    np.testing.assert_array_equal(out.tokens, _want(oracle, prompt, 6))
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+    ev = [e[0] for e in router.tracer.events()
+          if e[0].startswith("hedge_")]
+    assert "hedge_issue" in ev
+
+
+def test_hedge_submit_fails_closed(oracle):
+    """The ``hedge_submit`` chaos point: the duplicate dies before
+    landing — the primary attempt is untouched, the request completes
+    with parity, the hedge opportunity is spent (no retry storm), and
+    accounting conserves."""
+    inj = FaultInjector()
+    router = make_fleet(n=2, router_faults=inj)
+    p = _prompts(45, (5,))[0]
+    fid = router.submit(p, max_new_tokens=5, deadline_s=60.0)
+    router.step()
+    fr = router._requests[fid]
+    inj.enable("hedge_submit")
+    try:
+        assert router.issue_hedge(fr) is False
+    finally:
+        inj.disable("hedge_submit")
+    assert inj.fired["hedge_submit"] == 1
+    assert fr.hedged and fr.hedge_rid == -1 and fr.attempts == 1
+    # spent: the scan never re-hedges this fleet id
+    assert router.issue_hedge(fr) is False
+    router.run_until_complete(400)
+    out = router.result(fid)
+    assert out.status == "finished"
+    np.testing.assert_array_equal(out.tokens, _want(oracle, p, 5))
+    rm = router.metrics_dict()
+    assert rm["hedges"] == 0 and rm["hedges_failed"] == 1
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+
+
+def test_hedge_on_heterogeneous_fleet_fails_closed():
+    """A hedge target whose max_seq cannot hold the request refuses
+    with a validation error — the hedge must fail CLOSED (next target /
+    give up), never raise out of the fleet step loop."""
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+    big = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                        fault_tolerance=ft, registry=registry,
+                        tracer=tracer)
+    small = ServingEngine(make_model(), num_slots=2, min_bucket=8,
+                          max_seq=32, fault_tolerance=ft,
+                          registry=registry, tracer=tracer)
+    router = Router([big, small], registry=registry, tracer=tracer)
+    p = _prompts(57, (30,))[0]        # 30 + 20 > the small max_seq
+    fid = router.submit(p, max_new_tokens=20, deadline_s=60.0)
+    fr = router._requests[fid]
+    assert fr.replica == 0            # only the big replica fits it
+    router.step()
+    assert router.issue_hedge(fr) is False    # no crash, fail closed
+    assert fr.hedged and fr.hedge_rid == -1
+    router.run_until_complete(600)
+    assert router.result(fid).status == "finished"
+    assert router.metrics_dict()["hedges_failed"] == 1
+    assert fleet_accounting(router)["ok"]
+
+
+def test_hedge_with_no_target_is_a_retryable_noop():
+    """An empty hedge-target list (the only other replica is draining)
+    must mean "no hedge RIGHT NOW" — no modulo-by-zero out of the
+    round-robin cursor, and the once-per-fleet-id opportunity is NOT
+    spent, so the scan can hedge once the peer recovers."""
+    router = make_fleet(n=2)
+    router.affinity = False
+    assert router._route_order([], np.array([1], np.int32)) == []
+    p = _prompts(58, (4,))[0]
+    fid = router.submit(p, max_new_tokens=30, deadline_s=60.0)
+    router.step()
+    fr = router._requests[fid]
+    other = 1 - fr.replica
+    router.drain(other)
+    try:
+        assert router.issue_hedge(fr) is False   # no crash, no hedge
+        assert not fr.hedged and fr.hedge_rid == -1   # NOT spent
+    finally:
+        router.undrain(other)
+    assert router.issue_hedge(fr)                # peer back: hedge ok
+    router.run_until_complete(600)
+    assert router.result(fid).status == "finished"
+    acc = fleet_accounting(router)
+    assert acc["ok"] and acc["hedges_settled"]
+
+
+def test_batch_priority_survives_crash_recovery(tmp_path):
+    """The journaled class round-trips: a batch request recovered
+    after a crash is rebuilt as batch — still sheddable, still
+    deferrable — not silently promoted to interactive."""
+    from paddle_tpu.serving import Journal
+
+    def fleet(journal):
+        registry, tracer = MetricsRegistry(), Tracer()
+        ft = FaultToleranceConfig(max_step_retries=2,
+                                  backoff_base_s=0.0)
+        engines = [ServingEngine(make_model(), num_slots=2,
+                                 min_bucket=8, fault_tolerance=ft,
+                                 registry=registry, tracer=tracer)
+                   for _ in range(2)]
+        return Router(engines, journal=journal, registry=registry,
+                      tracer=tracer)
+
+    wal = str(tmp_path / "wal")
+    journal = Journal.open(wal, fsync_batch=1)
+    try:
+        router = fleet(journal)
+        p = _prompts(59, (5,))[0]
+        fid = router.submit(p, max_new_tokens=6, priority="batch")
+        router.step()
+    finally:
+        journal.crash()
+    journal2 = Journal.open(wal, fsync_batch=1)
+    try:
+        router2 = fleet(journal2)
+        summary = router2.recover()
+        assert summary["resubmitted"] == 1
+        fr = router2._requests[fid]
+        assert fr.priority == "batch"
+        req = router2.replicas[fr.replica].engine._requests[
+            fr.engine_rid]
+        assert req.priority == "batch"
+        router2.run_until_complete(400)
+        acc = fleet_accounting(router2)
+        assert acc["ok"] and acc["journal_conserved"]
+    finally:
+        journal2.close()
+
+
+def test_hedge_unwinds_on_cancel_and_purge():
+    """A client settling a hedged request unwinds BOTH attempts —
+    cancel and purge each release the loser immediately, leaving both
+    replicas at baseline."""
+    router = make_fleet(n=2, num_slots=1)
+    p = _prompts(46, (5,))[0]
+    fid = router.submit(p, max_new_tokens=30, deadline_s=60.0)
+    router.step()
+    fr = router._requests[fid]
+    assert router.issue_hedge(fr)
+    out = router.cancel(fid)
+    assert out.status == "cancelled"
+    assert fr.hedge_rid == -1
+    router.run_until_complete(200)
+    assert fleet_accounting(router)["ok"]
+    for h in router.replicas:
+        assert replica_accounting(h.engine)["ok"]
+    # purge path
+    fid2 = router.submit(p, max_new_tokens=30, deadline_s=60.0)
+    router.step()
+    fr2 = router._requests[fid2]
+    assert router.issue_hedge(fr2)
+    router.purge(fid2)
+    router.run_until_complete(200)
+    for h in router.replicas:
+        assert replica_accounting(h.engine)["ok"]
+
+
+def test_hedge_survives_primary_replica_kill(oracle):
+    """A SIGKILLed primary with a live hedge: the hedge is PROMOTED
+    (no reattribution — the attempts budget is already spent), the
+    client stream stays exactly-once, and the journal-less accounting
+    conserves on the survivor."""
+    router = make_fleet(n=2, num_slots=2)
+    p = _prompts(47, (5,))[0]
+    streams = {}
+    fid = router.submit(p, max_new_tokens=6, deadline_s=60.0)
+    router._requests[fid].client_stream = recorder(streams, fid)
+    router.step()
+    fr = router._requests[fid]
+    src = fr.replica
+    assert router.issue_hedge(fr)
+    router.kill(src)
+    assert fr.replica == fr.history[-1][0] or fr.replica != src
+    assert fr.replica != src and fr.hedge_rid == -1
+    router.run_until_complete(400)
+    out = router.result(fid)
+    assert out.status == "finished"
+    np.testing.assert_array_equal(out.tokens, _want(oracle, p, 6))
+    positions = [q for q, _ in streams[fid]]
+    assert positions == list(range(6))
+    rm = router.metrics_dict()
+    assert rm["hedge_wins"] == 1
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+
+
+# ---------------------------------------- priority classes + brownout
+
+def test_priority_validation_and_threading():
+    """Bad classes reject loudly at both surfaces; the class rides the
+    fleet record and the engine request."""
+    router = make_fleet(n=2)
+    p = _prompts(48, (4,))[0]
+    with pytest.raises(ValueError, match="priority"):
+        router.submit(p, max_new_tokens=2, priority="bulk")
+    eng = router.replicas[0].engine
+    with pytest.raises(ValueError, match="priority"):
+        eng.submit(p, max_new_tokens=2, priority="bulk")
+    fid = router.submit(p, max_new_tokens=2, priority="batch")
+    fr = router._requests[fid]
+    assert fr.priority == "batch"
+    req = router.replicas[fr.replica].engine._requests[fr.engine_rid]
+    assert req.priority == "batch"
+    router.run_until_complete(200)
+    assert fleet_accounting(router)["ok"]
+    acc = fleet_accounting(router)
+    assert acc["requests"][0]["priority"] == "batch"
+
+
+def test_admission_prefers_interactive_within_window():
+    """Scheduler unit: with one free slot, a batch head is jumped by an
+    interactive request inside the skip window; once the head-skip
+    budget collapses the window, the batch head admits (deferred, never
+    starved)."""
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+    sched = Scheduler(num_slots=4, max_seq=64, min_bucket=8,
+                      skip_window=4, max_head_skips=2)
+
+    def mk(rid, priority):
+        return Request(request_id=rid,
+                       prompt=np.array([1, 2, 3], np.int32),
+                       max_new_tokens=2, sampling=SamplingParams(),
+                       priority=priority)
+
+    sched.submit(mk(0, "batch"))
+    sched.submit(mk(1, "batch"))
+    sched.submit(mk(2, "interactive"))
+    out = sched.admit(free_slots=1)
+    assert [r.request_id for r, _ in out] == [2]   # interactive jumped
+    assert sched.total_head_skips == 1
+    out = sched.admit(free_slots=1)                # batch 0 next
+    assert [r.request_id for r, _ in out] == [0]
+    # starvation bound: after max_head_skips the window collapses
+    sched2 = Scheduler(num_slots=4, max_seq=64, min_bucket=8,
+                       skip_window=4, max_head_skips=1)
+    sched2.submit(mk(0, "batch"))
+    sched2.submit(mk(1, "interactive"))
+    sched2.submit(mk(2, "interactive"))
+    assert [r.request_id
+            for r, _ in sched2.admit(free_slots=1)] == [1]
+    assert [r.request_id
+            for r, _ in sched2.admit(free_slots=1)] == [0]
+
+
+def test_brownout_sheds_batch_then_tightens_then_exits():
+    """The ladder end-to-end on real queue depth: sustained overload
+    sheds batch (honest hint, interactive unaffected), suspends
+    hedging; deeper overload tightens admission for everyone; draining
+    the queue exits one level at a time with hysteresis."""
+    router = make_fleet(n=2, num_slots=1, brownout_depth=2,
+                        brownout_hysteresis=2)
+    prompts = _prompts(49, (4,) * 8)
+    # throughput history first, so shed hints are honest
+    router.submit(prompts[0], max_new_tokens=2)
+    router.run_until_complete(200)
+    # flood: 1-slot replicas, long decodes -> deep queue
+    fids = [router.submit(p, max_new_tokens=40) for p in prompts[:6]]
+    assert router.queue_depth >= 4          # 2 running, 4 queued
+    router.step()
+    router.step()
+    assert router.brownout_level == 1
+    ev = [e[0] for e in router.tracer.events()
+          if e[0].startswith("brownout_")]
+    assert "brownout_enter" in ev
+    # batch sheds with an honest, finite hint; interactive still lands
+    with pytest.raises(RequestRejected,
+                       match="brownout_shed_batch") as ei:
+        router.submit(prompts[6], max_new_tokens=2, priority="batch")
+    assert ei.value.retry_after_s is not None
+    assert 0 < ei.value.retry_after_s <= 600.0
+    assert router.metrics_dict()["shed_batch"] == 1
+    ok_fid = router.submit(prompts[6], max_new_tokens=4,
+                           priority="interactive")
+    # hedging suspended under brownout
+    fr = router._requests[fids[-1]]
+    fr.deadline_s = 1e-3                    # projection-hopeless
+    router._scan_hedges()
+    assert not fr.hedged and router.metrics_dict()["hedges"] == 0
+    fr.deadline_s = None
+    # level 2 at ~2x the enter depth: queue is already ~7 deep
+    router.step()
+    router.step()
+    assert router.brownout_level == 2
+    with pytest.raises(RequestRejected, match="brownout_overload"):
+        router.submit(prompts[7], max_new_tokens=2)
+    # drain -> the ladder exits one level per sustained recovery
+    router.run_until_complete(2000)
+    assert router.queue_depth == 0
+    for _ in range(2 * 2 + 1):
+        router.step()
+    assert router.brownout_level == 0
+    assert router.registry.snapshot()["router.brownout_level"] == 0
+    assert router.result(ok_fid).status == "finished"
+    acc = fleet_accounting(router)
+    assert acc["ok"], acc
+
+
+def test_brownout_exits_on_an_idle_fleet_via_submit_ticks():
+    """A fleet whose work drains before the exit hysteresis completes
+    must not shed batch forever: while browned out, every submit is
+    also a control observation, so a batch-only client's own (shed)
+    submissions walk the idle ladder back down."""
+    router = make_fleet(n=2, num_slots=1, brownout_depth=2,
+                        brownout_hysteresis=2)
+    prompts = _prompts(51, (4,) * 6)
+    for p in prompts:
+        router.submit(p, max_new_tokens=40)
+    router.step()
+    router.step()
+    assert router.brownout_level >= 1
+    router.run_until_complete(2000)          # queue fully drained
+    assert router.queue_depth == 0
+    level = router.brownout_level
+    if level == 0:
+        return                               # exit already completed
+    # a batch-only client against the idle browned-out fleet: the
+    # first submits shed, but each one ticks the controller with an
+    # empty queue — within 2 x hysteresis sheds the ladder reaches 0
+    # and batch work flows again (no step() ever ran between them)
+    p = prompts[0]
+    for _ in range(2 * level * 2):
+        if router.brownout_level == 0:
+            break
+        with pytest.raises(RequestRejected):
+            router.submit(p, max_new_tokens=2, priority="batch")
+    assert router.brownout_level == 0
+    fid = router.submit(p, max_new_tokens=2, priority="batch")
+    router.run_until_complete(300)
+    assert router.result(fid).status == "finished"
+    assert fleet_accounting(router)["ok"]
+
+
+# ------------------------------------------ per-replica rejection reasons
+
+def test_rejection_carries_per_replica_reasons():
+    """Satellite: when EVERY eligible replica refuses, the fleet
+    rejection carries each replica's own reason (exception attr AND the
+    output's terminal record) — not just the best replica's."""
+    router = make_fleet(n=2, num_slots=1, max_queue=1)
+    p = _prompts(50, (4,))[0]
+    # no step between submits: each replica's bounded queue (engine
+    # max_queue=1) fills with one waiting request, so the third submit
+    # is refused by BOTH replicas
+    fids = [router.submit(p, max_new_tokens=20) for _ in range(2)]
+    with pytest.raises(RequestRejected, match="queue_full") as ei:
+        router.submit(p, max_new_tokens=2)
+    per = ei.value.per_replica
+    assert per is not None and len(per) == 2
+    assert {d["replica"] for d in per} == {0, 1}
+    assert all(d["reason"] == "queue_full" for d in per)
+    out = ei.value.output
+    assert out.status == "rejected"
+    assert "replica 0: queue_full" in out.status_reason
+    assert "replica 1: queue_full" in out.status_reason
+    # fleet-level rejections (nothing was tried) carry NO per-replica
+    # breakdown — the distinction is part of the contract
+    router.drain(0)
+    router.drain(1)
+    try:
+        with pytest.raises(RequestRejected,
+                           match="no_healthy_replica") as ei2:
+            router.submit(p, max_new_tokens=2)
+        assert ei2.value.per_replica is None
+    finally:
+        router.undrain(0)
+        router.undrain(1)
+    router.run_until_complete(1200)
+    assert fleet_accounting(router)["ok"]
+
+
+def test_hedged_journal_ledger_conserved(oracle, tmp_path):
+    """A JOURNALED fleet hedging a request: the race (whichever attempt
+    wins) produces exactly ONE terminal record per fleet id in the
+    durable ledger — the loser's unwind writes nothing — and the
+    delivered high-water marks journaled across the race stay
+    monotonic, so a crash mid-race could never replay a duplicate."""
+    from paddle_tpu.serving import Journal
+    registry, tracer = MetricsRegistry(), Tracer()
+    ft = FaultToleranceConfig(max_step_retries=2, backoff_base_s=0.0)
+    engines = [ServingEngine(make_model(), num_slots=1, min_bucket=8,
+                             fault_tolerance=ft, registry=registry,
+                             tracer=tracer) for _ in range(2)]
+    journal = Journal.open(str(tmp_path / "wal"), fsync_batch=1)
+    try:
+        router = Router(engines, journal=journal, registry=registry,
+                        tracer=tracer)
+        prefix = _prompts(55, (16,))[0]
+        p = np.concatenate([prefix, _prompts(56, (4,))[0]])
+        # the blocker warms replica 0's radix cache with the shared
+        # prefix while holding its only slot, so affinity queues the
+        # target behind it despite replica 1 being idle
+        blocker = router.submit(np.concatenate([prefix, [3]]),
+                                max_new_tokens=30)
+        for _ in range(2):
+            router.step()
+        fid = router.submit(p, max_new_tokens=6, deadline_s=60.0)
+        router.step()
+        fr = router._requests[fid]
+        assert fr.replica == 0          # queued behind the blocker
+        assert router.issue_hedge(fr)
+        router.run_until_complete(800)
+        out = router.result(fid)
+        assert out.status == "finished"
+        np.testing.assert_array_equal(out.tokens, _want(oracle, p, 6))
+        assert router.metrics_dict()["hedge_wins"] == 1
+        acc = fleet_accounting(router)
+        assert acc["ok"], acc
+        assert acc["journal_conserved"]
+        led = journal.ledger()
+        # one submit, exactly one terminal, full delivered mark — for
+        # the hedged id AND the blocker
+        for rid in (fid, blocker):
+            assert led[rid]["submits"] == 1
+            assert led[rid]["terminals"] == 1
+        assert led[fid]["delivered"] == 6
+        for h in router.replicas:
+            assert replica_accounting(h.engine)["ok"]
+    finally:
+        journal.close()
+
+
+def test_straggler_smoke_artifacts(tmp_path):
+    """Tier-1 artifact smoke (mirrors test_fleet_chaos_smoke_artifacts):
+    the --straggler scenario end-to-end through
+    scripts/fleet_chaos_smoke.py — a passing straggler.json verdict
+    with hedging/accounting conservation, straggler detection, and
+    parity vs a hedging-off fleet."""
+    import importlib.util
+    import json
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_chaos_smoke",
+        os.path.join(repo, "scripts", "fleet_chaos_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "artifacts")
+    assert mod.main(["--out", out, "--straggler", "--requests", "4",
+                     "--seconds", "0.02"]) == 0
+    with open(os.path.join(out, "straggler.json")) as f:
+        v = json.load(f)
+    assert v["ok"] and v["replay_parity"] and v["hedges_settled"]
+    assert v["straggler_marked"] and v["fired"] >= 1
+    assert v["hedges"] >= 1 and v["pools_at_baseline"]
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "router_hedges" in prom
+    assert "router_slow_replicas" in prom
+    assert "router_brownout_level" in prom
+
+
+# --------------------------------------------------- the slow soak leg
+
+@pytest.mark.slow
+def test_tail_latency_soak_matrix(oracle):
+    """Soak-length matrix (slow-marked; fast siblings above re-pin
+    every invariant): straggler sites x sampling specs, each run
+    asserting the full hedge invariant — exactly-once, parity vs
+    hedging-off, baselines on both replicas, compile pin, accounting."""
+    prefix = _prompts(61, (16,))[0]
+    for site, seconds in (("replica_slow", 0.02), ("slow_step", 0.02)):
+        for sampling in (None, SamplingParams(do_sample=True,
+                                              temperature=0.9, seed=3)):
+            inj = FaultInjector()
+            router = make_fleet(
+                n=2, num_slots=1, block_len=8,
+                router_faults=inj if site == "replica_slow" else None)
+            if site == "slow_step":
+                router.replicas[0].engine.core.faults = inj
+            _warm_affinity(router, prefix)
+            prompt = np.concatenate([prefix, _prompts(62, (4,))[0]])
+            blocker = router.submit(np.concatenate([prefix, [3]]),
+                                    max_new_tokens=40)
+            router.step()
+            streams = {}
+            fid = router.submit(prompt, max_new_tokens=6,
+                                sampling=sampling, deadline_s=60.0)
+            router._requests[fid].client_stream = recorder(streams, fid)
+            fr = router._requests[fid]
+            router.step()
+            inj.enable(site, times=30, seconds=seconds)
+            try:
+                assert router.issue_hedge(fr)
+                router.run_until_complete(1000)
+            finally:
+                inj.disable(site)
+            assert inj.fired[site] >= 1
+            out = router.result(fid)
+            assert out.status == "finished"
+            positions = [q for q, _ in streams[fid]]
+            assert positions == list(range(len(out.tokens)))
+            if sampling is None:
+                np.testing.assert_array_equal(
+                    out.tokens, _want(oracle, prompt, 6))
+            assert router.result(blocker).status == "finished"
+            acc = fleet_accounting(router)
+            assert acc["ok"], acc
+            assert acc["hedges_settled"]
+            for h in router.replicas:
+                assert replica_accounting(h.engine)["ok"]
+                assert h.engine.core.trace_counts["decode"] == 1
