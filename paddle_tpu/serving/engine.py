@@ -65,6 +65,12 @@ from .scheduler import Request, Scheduler
 __all__ = ["EngineCore", "sample_rows", "finite_or_sentinel",
            "NONFINITE_SENTINEL"]
 
+# graftprog (tools/analysis/compile_surface.py) entry-point marker: the
+# engine core is a registered compile-surface root — every jit program
+# it can build must appear on the static manifest.  Pure data, read by
+# the AST analysis only; zero runtime effect.
+__compile_surface_roots__ = ("EngineCore",)
+
 # token-readback encoding of the device-side health check: a decode row
 # whose logits hold a non-finite value reads back as this instead of a
 # token id (ids are always >= 0, so the sentinel is unambiguous) — the

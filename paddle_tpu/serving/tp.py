@@ -57,6 +57,11 @@ __all__ = ["TP_AXIS", "build_serving_mesh", "serving_param_specs",
            "shard_model_params", "sharded_zeros", "tp_decode_supported",
            "build_tp_decode_program"]
 
+# graftprog entry-point marker (see tools/analysis/compile_surface.py):
+# the TP decode program factory roots the shard_map compile unit on the
+# static manifest.  Read by the AST analysis only; zero runtime effect.
+__compile_surface_roots__ = ("build_tp_decode_program",)
+
 # the serving TP axis IS the models' model-parallel axis: the
 # Column/RowParallelLinear layers annotate their weights over "mp"
 # (distributed/meta_parallel/mp_layers.py), so naming the serving mesh

@@ -9,7 +9,11 @@ under analysis.  v2 adds a whole-program symbol index + call graph
 (``project.py``) that interprocedural rules resolve through.  v3 adds
 graftshape (``absint.py`` + ``signatures.py``): abstract shape/dtype/
 sharding interpretation powering the recompile-shape, dtype-flow, and
-sharding-consistency rule families.
+sharding-consistency rule families.  v4 adds graftprog
+(``compile_surface.py`` + ``entrypoints.py``): whole-program
+compile-surface enumeration from registered entry points, the
+``compile-surface`` rule, and the AOT program manifest
+(``scripts/graftlint.py --manifest``).
 
 Entry points:
   * ``python scripts/graftlint.py`` — the CLI (default scope:
@@ -25,17 +29,27 @@ Suppression syntax (reason REQUIRED — see suppress.py):
 from .findings import Finding, ERROR, WARNING
 from .suppress import parse_suppressions, Suppressions
 from .walker import AnalysisResult, FileContext, run_analysis
-from .report import format_json, format_sarif, format_text
+from .report import format_json, format_manifest, format_sarif, format_text
 from .project import Project, build_project
 from .checkers import default_checkers
 from .absint import (Arr, Const, DYN, SpecVal, Sym, Tup, UNKNOWN,
                      Interpreter, interpret_function)
-from .signatures import register_signature, register_method_signature
+from .signatures import (register_signature, register_method_signature,
+                         table_fingerprint)
+from .compile_surface import (CompileUnit, Surface, build_manifest,
+                              build_manifest_for_paths, build_surface,
+                              surface_for)
+from .entrypoints import (compile_surface_root, entry_point_fingerprint,
+                          register_entry_point, registered_entry_points)
 
 __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "Suppressions", "AnalysisResult", "FileContext", "run_analysis",
-           "format_json", "format_sarif", "format_text", "Project",
-           "build_project", "default_checkers", "Arr", "Const", "DYN",
-           "SpecVal", "Sym", "Tup", "UNKNOWN", "Interpreter",
+           "format_json", "format_manifest", "format_sarif", "format_text",
+           "Project", "build_project", "default_checkers", "Arr", "Const",
+           "DYN", "SpecVal", "Sym", "Tup", "UNKNOWN", "Interpreter",
            "interpret_function", "register_signature",
-           "register_method_signature"]
+           "register_method_signature", "table_fingerprint",
+           "CompileUnit", "Surface", "build_manifest",
+           "build_manifest_for_paths", "build_surface", "surface_for",
+           "compile_surface_root", "entry_point_fingerprint",
+           "register_entry_point", "registered_entry_points"]

@@ -14,13 +14,15 @@ from .lifecycle import ResourceLifecycleChecker, ResourcePair, DEFAULT_PAIRS
 from .shape_recompile import ShapeRecompileChecker
 from .dtype_flow import DtypeFlowChecker
 from .sharding_consistency import ShardingConsistencyChecker
+from .compile_surface import CompileSurfaceChecker
 
 __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "HostSyncChecker", "AxisNameChecker", "RegistryDriftChecker",
            "DeadStateChecker", "UseAfterDonateChecker",
            "ResourceLifecycleChecker", "ResourcePair", "DEFAULT_PAIRS",
            "ShapeRecompileChecker", "DtypeFlowChecker",
-           "ShardingConsistencyChecker", "default_checkers"]
+           "ShardingConsistencyChecker", "CompileSurfaceChecker",
+           "default_checkers"]
 
 
 def default_checkers():
@@ -36,4 +38,5 @@ def default_checkers():
         ShapeRecompileChecker(),
         DtypeFlowChecker(),
         ShardingConsistencyChecker(),
+        CompileSurfaceChecker(),
     ]

@@ -1,0 +1,104 @@
+"""compile-surface: the compile pin, proved statically (graftprog).
+
+The serving engine promises a FINITE compiled-program set — ``{chunk} +
+O(log2) prefill buckets + ONE decode + 1 gather + 1 scatter`` per
+device plane.  graftprog (:mod:`..compile_surface`) enumerates every
+compile unit reachable from the registered entry points
+(:mod:`..entrypoints`) and derives each unit's static key space; this
+rule turns those facts into findings on the configured hot paths:
+
+  * **error** — a provably-unbounded key space: a graftshape ``DYN``
+    extent inside the traced body, or a data-dependent Python value
+    (``int(x.sum())``, ``.item()``) feeding a static jit argument.
+    Every distinct runtime value compiles a new program — the exact
+    failure mode the compile pin exists to forbid.
+  * **warning** — ``jax.jit`` constructed inside a loop without a
+    memoization idiom (attribute-is-None guard, module-dict cache,
+    decorator/module-level form): per-iteration program growth.
+  * **warning** — a dead program: a compile unit whose owner no
+    registered entry point reaches, in a module that REGISTERS entry
+    points (modules outside the registered surface are library code and
+    exempt).  Dead programs cost AOT-export time and mask drift.
+
+Suppress a finding with ``# graftlint: disable=compile-surface`` on the
+offending line; prefer registering the true entry point (the
+``__compile_surface_roots__`` marker or
+``entrypoints.register_entry_point``) over suppression when the walk is
+missing a root rather than the program being wrong.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import List, Optional, Sequence
+
+from ..findings import ERROR, WARNING, Finding
+from .base import Checker
+
+DEFAULT_HOT_PATHS = (
+    "paddle_tpu/serving/*.py",
+    "paddle_tpu/kernels/*.py",
+    # the rule's own fixtures (anchored: fixture dir for CLI runs, bare
+    # basename for fixture-rooted library tests)
+    "tests/fixtures/lint/compile_surface_*.py",
+    "compile_surface_*.py",
+)
+
+# cheap token gate: a file without any of these cannot host a compile
+# unit or a root marker, so it never pays for surface construction
+_TOKENS = ("jit", "pallas_call", "shard_map", "__compile_surface_roots__",
+           "compile_surface_root")
+
+
+class CompileSurfaceChecker(Checker):
+    name = "compile-surface"
+    severity = ERROR
+
+    def __init__(self, hot_paths: Optional[Sequence[str]] = None):
+        self.hot_paths = tuple(hot_paths or DEFAULT_HOT_PATHS)
+
+    def check(self, ctx) -> List[Finding]:
+        if ctx.project is None:
+            return []
+        if not any(fnmatch.fnmatch(ctx.relpath, p)
+                   for p in self.hot_paths):
+            return []
+        if not any(tok in ctx.src for tok in _TOKENS):
+            return []
+        # deferred: ..compile_surface imports ..project, which imports
+        # .base through this package — a module-level import would cycle
+        from ..compile_surface import surface_for
+        surface = surface_for(ctx.project)
+        root_modules = {
+            fi.module for fi in ctx.project.all_functions()
+            if fi.qname in surface.roots}
+
+        findings: List[Finding] = []
+        for unit in surface.units_for(ctx.relpath):
+            props = (("unit", unit.uid),
+                     ("key_space", unit.key_class),
+                     ("key_legs", "; ".join(unit.key_legs)))
+            if unit.key_class == "unbounded":
+                evidence = f" — {unit.evidence}" if unit.evidence else ""
+                findings.append(Finding(
+                    self.name, ctx.relpath, unit.line, unit.col,
+                    f"compile unit '{unit.name}' has an unbounded "
+                    f"static-key space{evidence}; every distinct "
+                    f"runtime value compiles a new program, breaking "
+                    f"the program-set pin", ERROR, props=props))
+            if unit.in_loop and not unit.memoized:
+                findings.append(Finding(
+                    self.name, ctx.relpath, unit.line, unit.col,
+                    f"'{unit.name}' is jit-compiled inside a loop "
+                    f"without a memoization idiom — the program set "
+                    f"grows per iteration; hoist the jit or cache the "
+                    f"compiled callable", WARNING, props=props))
+            if not unit.roots and unit.owner is not None \
+                    and unit.module in root_modules:
+                findings.append(Finding(
+                    self.name, ctx.relpath, unit.line, unit.col,
+                    f"dead program: compile unit '{unit.name}' (in "
+                    f"{unit.owner.rsplit('.', 1)[-1]}()) is unreachable "
+                    f"from every registered entry point — register the "
+                    f"root or delete the program", WARNING, props=props))
+        return findings

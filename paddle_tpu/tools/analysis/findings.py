@@ -8,8 +8,8 @@ help a human triage a long report, not to let warnings rot.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, asdict
-from typing import Dict
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
 
 ERROR = "error"
 WARNING = "warning"
@@ -23,10 +23,20 @@ class Finding:
     col: int           # 0-based (ast convention)
     message: str
     severity: str = ERROR
+    # rule-specific structured metadata (hashable key/value pairs) —
+    # surfaced as SARIF result ``properties`` and in the JSON report.
+    # A tuple-of-pairs (not a dict) keeps the dataclass frozen+hashable
+    # and old 6-tuple cache payloads constructible unchanged.
+    props: Tuple[Tuple[str, str], ...] = field(default=())
 
     def format(self) -> str:
         return (f"{self.path}:{self.line}:{self.col}: "
                 f"{self.severity}[{self.rule}] {self.message}")
 
     def to_json(self) -> Dict:
-        return asdict(self)
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message,
+               "severity": self.severity}
+        if self.props:
+            out["properties"] = dict(self.props)
+        return out

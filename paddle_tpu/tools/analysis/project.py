@@ -100,6 +100,7 @@ class Project:
         self.modules: Dict[str, ModuleInfo] = {}
         self.by_relpath: Dict[str, ModuleInfo] = {}
         self._callee_cache: Dict[str, Tuple[FunctionInfo, ...]] = {}
+        self._attr_type_cache: Dict[Tuple[str, str], Dict] = {}
 
     # ------------------------------------------------------------ lookup
     def module_for(self, relpath: str) -> Optional[ModuleInfo]:
@@ -217,6 +218,125 @@ class Project:
                     if hit is not None:
                         return hit
         return None
+
+    def resolve_qname(self, dotted: str) -> Optional[FunctionInfo]:
+        """Resolve a fully-qualified dotted name (``pkg.mod.fn`` /
+        ``pkg.mod.Cls.method``) to a project function — the public form
+        of the global lookup, used by graftprog's entry-point table."""
+        return self._global(dotted)
+
+    def resolve_class(self, mod_name: str,
+                      dotted: Optional[str]) -> Optional[ClassInfo]:
+        """Resolve a textual class reference seen in ``mod_name`` (bare
+        local name, imported name, or ``module.Cls`` chain) to a project
+        :class:`ClassInfo`."""
+        if not dotted:
+            return None
+        m = self.modules.get(mod_name)
+        if m is None:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            ci = m.classes.get(parts[0])
+            if ci is not None:
+                return ci
+            target = m.imports.get(parts[0])
+            if target is not None:
+                return self._global_class(target)
+            return None
+        target = m.imports.get(parts[0])
+        if target is not None:
+            return self._global_class(".".join([target] + parts[1:]))
+        return self._global_class(dotted)
+
+    def _global_class(self, dotted: str) -> Optional[ClassInfo]:
+        mod = self._longest_module_prefix(dotted)
+        if mod is None or mod == dotted:
+            return None
+        rest = dotted[len(mod) + 1:].split(".")
+        if len(rest) == 1:
+            return self.modules[mod].classes.get(rest[0])
+        return None
+
+    @staticmethod
+    def _annotation_class_name(ann: Optional[ast.AST]) -> Optional[str]:
+        """The class name a parameter/attribute annotation points at,
+        unwrapping one ``Optional[...]``/single-arg subscript layer and
+        PEP-563 string annotations."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):
+            ann = ann.slice
+        return dotted_name(ann)
+
+    def class_attr_types(self, mod_name: str,
+                         cls_name: str) -> Dict[str, Tuple[ClassInfo, ...]]:
+        """``{attr: candidate ClassInfos}`` for ``self.<attr>`` of one
+        class: inferred from ``self.x = Cls(...)`` / ``self.x =
+        Cls.create(...)`` constructor assignments, ``self.x = param``
+        where the param is class-annotated, and ``self.x: Cls`` /
+        ``self.x: Optional[Cls]`` annotated assignments across every
+        method.  Conflicting assignments keep ALL candidates — callers
+        doing reachability must follow each (sound over-approximation)."""
+        key = (mod_name, cls_name)
+        hit = self._attr_type_cache.get(key)
+        if hit is not None:
+            return hit
+        out: Dict[str, Dict[str, ClassInfo]] = {}
+        m = self.modules.get(mod_name)
+        ci = m.classes.get(cls_name) if m is not None else None
+
+        def record(attr: str, target: Optional[ClassInfo]) -> None:
+            if target is not None:
+                out.setdefault(attr, {})[target.module + "." +
+                                         target.name] = target
+
+        for fi in (ci.methods.values() if ci is not None else ()):
+            ann_types: Dict[str, Optional[str]] = {}
+            a = fi.node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                ann_types[p.arg] = self._annotation_class_name(p.annotation)
+            for node in ast.walk(fi.node):
+                target = None
+                value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target = node.target
+                    if not isinstance(target, ast.Attribute) \
+                            or not isinstance(target.value, ast.Name) \
+                            or target.value.id != "self":
+                        continue
+                    record(target.attr, self.resolve_class(
+                        mod_name, self._annotation_class_name(
+                            node.annotation)))
+                    value = node.value
+                if not isinstance(target, ast.Attribute) \
+                        or not isinstance(target.value, ast.Name) \
+                        or target.value.id != "self" or value is None:
+                    continue
+                if isinstance(value, ast.Call):
+                    d = dotted_name(value.func)
+                    if d is None:
+                        continue
+                    hit_cls = self.resolve_class(mod_name, d)
+                    if hit_cls is None and "." in d:
+                        # Cls.create(...) and friends: the class part
+                        hit_cls = self.resolve_class(
+                            mod_name, d.rsplit(".", 1)[0])
+                    record(target.attr, hit_cls)
+                elif isinstance(value, ast.Name) \
+                        and ann_types.get(value.id):
+                    record(target.attr, self.resolve_class(
+                        mod_name, ann_types[value.id]))
+        result = {attr: tuple(cands.values()) for attr, cands in out.items()}
+        self._attr_type_cache[key] = result
+        return result
 
     def resolve_str_const(self, mod_name: str,
                           dotted: Optional[str]) -> Optional[str]:

@@ -1,10 +1,12 @@
-"""Text / JSON / SARIF reporters for graftlint results."""
+"""Text / JSON / SARIF reporters for graftlint results, plus the
+graftprog program-manifest serializer (``--manifest``)."""
 
 from __future__ import annotations
 
 import json
+import sys
 from collections import Counter
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from .walker import AnalysisResult
 
@@ -63,7 +65,24 @@ def format_sarif(result: AnalysisResult,
         }
         if suppressed:
             res["suppressions"] = [{"kind": "inSource"}]
+        if f.props:
+            # rule-specific structured metadata (e.g. compile-surface's
+            # derived key space) rides in the SARIF property bag
+            res["properties"] = dict(f.props)
         return res
+
+    rules = []
+    descriptions: Dict[str, str] = {}
+    for c in checkers or ():
+        doc_str = sys.modules[type(c).__module__].__doc__ or ""
+        first = doc_str.strip().splitlines()[0] if doc_str.strip() else ""
+        if first:
+            descriptions[c.name] = first
+    for r in rule_ids:
+        entry: Dict = {"id": r}
+        if r in descriptions:
+            entry["shortDescription"] = {"text": descriptions[r]}
+        rules.append(entry)
 
     doc = {
         "$schema": _SARIF_SCHEMA,
@@ -73,10 +92,17 @@ def format_sarif(result: AnalysisResult,
                 # no informationUri: SARIF requires an absolute URI there
                 # and the rule docs live in-repo (docs/static_analysis.md)
                 "name": "graftlint",
-                "rules": [{"id": r} for r in rule_ids],
+                "rules": rules,
             }},
             "results": ([to_result(f, False) for f in result.findings]
                         + [to_result(f, True) for f in result.suppressed]),
         }],
     }
     return json.dumps(doc, indent=2)
+
+
+def format_manifest(manifest: Dict) -> str:
+    """Deterministic serialization of the graftprog program manifest:
+    sorted keys, stable indentation — byte-identical across runs over
+    identical sources, so the artifact is diffable and cacheable."""
+    return json.dumps(manifest, indent=2, sort_keys=True)

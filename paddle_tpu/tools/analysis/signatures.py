@@ -28,7 +28,8 @@ from .absint import (Arr, Const, DYN, SpecVal, Sym, Tup, UNKNOWN,
                      promote_dtypes, _broadcast, _matmul_shape)
 
 __all__ = ["SIGNATURES", "METHOD_SIGNATURES", "register_signature",
-           "register_method_signature", "lookup_signature"]
+           "register_method_signature", "lookup_signature",
+           "table_fingerprint"]
 
 # dotted / leaf call target -> handler
 SIGNATURES: Dict[str, Callable] = {}
@@ -50,6 +51,20 @@ def register_signature(name: str, handler: Callable) -> None:
 
 def register_method_signature(name: str, handler: Callable) -> None:
     METHOD_SIGNATURES[name] = handler
+
+
+def table_fingerprint() -> str:
+    """Stable content hash of the REGISTERED signature set (dotted,
+    method, and bare tables).  Part of the walker's parse-cache version:
+    a runtime ``register_signature`` or an edited table must invalidate
+    cached analysis inputs, because cross-module results derived under
+    the old semantics would otherwise be served stale (handler bodies
+    are covered separately by the package mtime fingerprint)."""
+    import hashlib
+    payload = "|".join((",".join(sorted(SIGNATURES)),
+                        ",".join(sorted(METHOD_SIGNATURES)),
+                        ",".join(sorted(_BARE_SIGNATURES))))
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()
 
 
 def lookup_signature(fname: Optional[str], leaf: Optional[str],

@@ -53,7 +53,16 @@ def _analysis_fingerprint() -> int:
     return latest
 
 
-_CACHE_VERSION = (2, sys.version_info[:2], _analysis_fingerprint())
+def _cache_version() -> Tuple:
+    """Computed per cache OPEN, not at import: beyond the interpreter
+    and package fingerprints, the registered-signatures and entry-point
+    tables participate — a runtime ``register_signature`` /
+    ``register_entry_point`` (or an edited table) must never serve
+    analysis state derived under the old registrations."""
+    from .entrypoints import entry_point_fingerprint
+    from .signatures import table_fingerprint
+    return (3, sys.version_info[:2], _analysis_fingerprint(),
+            table_fingerprint(), entry_point_fingerprint())
 
 
 @dataclass
@@ -127,6 +136,7 @@ class _ParseCache:
 
     def __init__(self, path: Optional[str]):
         self.path = path
+        self.version = _cache_version()
         self.entries: Dict[str, Tuple] = {}
         self.touched: set = set()      # keys used this run; rest evicted
         self.dirty = False
@@ -134,7 +144,7 @@ class _ParseCache:
             try:
                 with open(path, "rb") as fh:
                     payload = pickle.load(fh)
-                if payload.get("version") == _CACHE_VERSION:
+                if payload.get("version") == self.version:
                     self.entries = payload.get("entries", {})
             except Exception:
                 self.entries = {}    # corrupt cache: rebuild silently
@@ -192,7 +202,7 @@ class _ParseCache:
                 os.makedirs(parent, exist_ok=True)
             tmp = self.path + ".tmp"
             with open(tmp, "wb") as fh:
-                pickle.dump({"version": _CACHE_VERSION,
+                pickle.dump({"version": self.version,
                              "entries": self.entries}, fh,
                             protocol=pickle.HIGHEST_PROTOCOL)
             os.replace(tmp, self.path)

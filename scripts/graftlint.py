@@ -11,6 +11,8 @@ Usage:
     python scripts/graftlint.py --sarif paddle_tpu/serving
     python scripts/graftlint.py --rule use-after-donate paddle_tpu
     python scripts/graftlint.py --list-rules
+    python scripts/graftlint.py --manifest        # graftprog program
+                                                  # manifest (JSON)
 
 Default scope is the library AND the perf-critical entrypoints:
 ``paddle_tpu/``, ``bench.py``, ``__graft_entry__.py``, ``scripts/``.
@@ -117,6 +119,10 @@ def main(argv=None) -> int:
                     help="also list suppressed findings")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--manifest", action="store_true",
+                    help="emit the graftprog compile-surface manifest "
+                         "(deterministic JSON) over the default scope "
+                         "and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -128,6 +134,15 @@ def main(argv=None) -> int:
 
     scope = [os.path.join(ROOT, p) for p in DEFAULT_SCOPE]
     project_paths = scope
+    if args.manifest:
+        if args.changed or args.since or args.paths:
+            ap.error("--manifest walks the whole default scope; it "
+                     "cannot be combined with --changed/--since/paths")
+        cache = None if args.no_cache else CACHE_PATH
+        manifest = _analysis.build_manifest_for_paths(
+            scope, root=ROOT, cache_path=cache)
+        print(_analysis.format_manifest(manifest))
+        return 0
     if args.changed or args.since:
         if args.paths:
             ap.error("--changed/--since lint the git working set; they "

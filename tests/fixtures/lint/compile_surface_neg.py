@@ -1,0 +1,52 @@
+"""Negative fixture for the compile-surface rule (graftprog): the
+pinned-program engine idiom — memoized factory jits with trace-counter
+ticks and bucket-producer shapes.  Zero findings:
+
+  * factory-built programs held behind an ``is None`` guard are
+    memoized, not loop growth;
+  * a call-site argument whose shape flows from a bucket producer
+    (``bucket_length``) is a FINITE key set — bucketed, not unbounded;
+  * every unit is reachable from the registered ``Engine`` root.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__compile_surface_roots__ = ("Engine",)
+
+
+def bucket_length(n, lo=8):
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class Engine:
+    def __init__(self):
+        self._decode_fn = None
+        self._prefill_fn = None
+        self.trace_counts = {"prefill": 0, "decode": 0}
+
+    def _build_decode(self):
+        def decode(xs):
+            self.trace_counts["decode"] += 1
+            return xs + 1
+
+        return jax.jit(decode, donate_argnums=(0,))
+
+    def decode_step(self, xs):
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        return self._decode_fn(xs)
+
+    def prefill(self, ids, n):
+        if self._prefill_fn is None:
+            def run(chunk):
+                self.trace_counts["prefill"] += 1
+                return chunk * 2
+
+            self._prefill_fn = jax.jit(run)
+        width = bucket_length(n)
+        chunk = jnp.zeros((1, width), jnp.int32)
+        return self._prefill_fn(chunk)

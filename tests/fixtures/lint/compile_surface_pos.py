@@ -1,0 +1,62 @@
+"""Positive fixture for the compile-surface rule (graftprog).
+
+Exactly four findings:
+  * ERROR  — ``_dyn``: jnp.nonzero inside the traced body (DYN extent,
+             unbounded key space);
+  * ERROR  — ``_mul``: a data-dependent Python value (int(x.sum()))
+             feeding a static jit argument at the call site;
+  * WARNING — jit constructed inside ``hot_loop``'s loop without a
+             memoization idiom (per-iteration program growth);
+  * WARNING — ``_forgotten``: a compile unit no registered entry point
+             reaches (dead program).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__compile_surface_roots__ = ("serve", "hot_loop", "unbounded_static")
+
+
+def _pick(x):
+    idx = jnp.nonzero(x)[0]      # output extent = popcount(x) — DYN
+    return x[idx]
+
+
+_dyn = jax.jit(_pick)            # ERROR: unbounded key space
+
+
+def serve(x):
+    return _dyn(x)
+
+
+def _scale(i, x):
+    return x * i
+
+
+def hot_loop(xs):
+    outs = []
+    for i in range(4):
+        f = jax.jit(functools.partial(_scale, i))   # WARNING: loop growth
+        outs.append(f(xs))
+    return outs
+
+
+def _mul_impl(x, k):
+    return x * k
+
+
+_mul = jax.jit(_mul_impl, static_argnums=(1,))      # ERROR: see call site
+
+
+def unbounded_static(x, n_tokens):
+    return _mul(x, int(n_tokens.sum()))   # data-dependent static arg
+
+
+def _impl(x):
+    return x + 1
+
+
+def _forgotten(x):
+    return jax.jit(_impl)(x)     # WARNING: dead program (never rooted)

@@ -47,10 +47,10 @@ def test_rule_catalogue_is_complete():
                      "axis-name", "registry-drift", "dead-state",
                      "use-after-donate", "resource-lifecycle",
                      "recompile-shape", "dtype-flow",
-                     "sharding-consistency"}
-    # ISSUE 5: the catalogue is now eleven rules — a checker silently
+                     "sharding-consistency", "compile-surface"}
+    # ISSUE 16: the catalogue is now twelve rules — a checker silently
     # dropping out of default_checkers() must fail loudly
-    assert len(names) == 11 and len(default_checkers()) == 11
+    assert len(names) == 12 and len(default_checkers()) == 12
 
 
 # ------------------------------------------------- per-rule fixture pairs
@@ -1276,11 +1276,184 @@ def test_sarif_covers_graftshape_rules():
     assert levels == {"error", "warning"}   # dtype-flow warns, rest error
 
 
+# ---------------------------------------------- graftprog (ISSUE 16)
+
+def test_compile_surface_positive():
+    """Exactly the four planted findings: unbounded DYN body, unbounded
+    data-dependent static arg (both errors), jit-in-loop growth and a
+    dead program (both warnings) — each carrying its derived key space
+    in the finding props."""
+    res = run_rule("compile_surface_pos.py", "compile-surface")
+    found = only_rule(res, "compile-surface")
+    assert len(found) == 4, [f.format() for f in res.findings]
+    errors = [f for f in found if f.severity == "error"]
+    warns = [f for f in found if f.severity == "warning"]
+    assert len(errors) == 2 and len(warns) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "unbounded static-key space" in msgs
+    assert "inside a loop" in msgs
+    assert "dead program" in msgs
+    for f in found:
+        props = dict(f.props)
+        assert props["unit"].startswith("compile_surface_pos:")
+        assert props["key_space"] in {"trace-static", "bucketed",
+                                      "unbounded"}
+    assert {dict(f.props)["key_space"] for f in errors} == {"unbounded"}
+
+
+def test_compile_surface_negative():
+    """The pinned-engine idiom (memoized factory jits, bucket-producer
+    shapes, rooted class) stays silent."""
+    res = run_rule("compile_surface_neg.py", "compile-surface")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_cli_manifest_deterministic_and_pinned():
+    """``--manifest`` emits byte-identical JSON across runs, and the
+    EngineCore plane IS the pinned program set: bucketed prefill + ONE
+    decode + 1 gather + 1 scatter (the compile pin, proved statically)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmd = [sys.executable, "scripts/graftlint.py", "--manifest"]
+    a = subprocess.run(cmd, cwd=str(REPO_ROOT), capture_output=True,
+                       text=True, timeout=600, env=env)
+    b = subprocess.run(cmd, cwd=str(REPO_ROOT), capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert a.returncode == 0, a.stdout + a.stderr
+    assert b.returncode == 0, b.stdout + b.stderr
+    assert a.stdout == b.stdout      # deterministic artifact
+    m = json.loads(a.stdout)
+    assert m["graftprog_version"] == 1
+    plane = m["planes"]["paddle_tpu.serving.engine.EngineCore"]
+    assert set(plane) == {"prefill", "decode", "gather", "scatter"}
+    assert plane["decode"]["upper_bound"] == "1"
+    assert plane["gather"]["upper_bound"] == "1"
+    assert plane["scatter"]["upper_bound"] == "1"
+    assert plane["prefill"]["key_space"] == "bucketed"
+    # the two decode VARIANTS (composed + fused) share one holder slot
+    assert plane["decode"]["holders"] == ["_decode_fn"]
+    # schema smoke over every program record (satellite: --manifest is
+    # covered next to the SARIF smoke)
+    assert m["programs"], "empty program list"
+    for p in m["programs"]:
+        assert p["kind"] in {"jit", "shard_map", "pallas_call",
+                             "aot-export"}
+        assert p["key"]["class"] in {"bucketed", "trace-static",
+                                     "unbounded"}
+        assert p["key"]["upper_bound"]
+        assert isinstance(p["line"], int) and p["line"] >= 1
+        assert p["path"].endswith(".py")
+        assert p["id"].count(":") == 2
+    kinds = {p["kind"] for p in m["programs"]}
+    assert {"jit", "shard_map", "pallas_call", "aot-export"} <= kinds
+    # every registered entry point made it into the manifest header
+    assert "paddle_tpu.serving.engine.EngineCore.step" \
+        in m["entry_points"]["roots"] or any(
+            q.startswith("paddle_tpu.serving.engine.EngineCore.")
+            for q in m["entry_points"]["roots"])
+
+
+def test_sarif_compile_surface_properties():
+    """compile-surface SARIF results carry the derived key space in the
+    property bag and the rule carries driver metadata."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftlint.py", "--sarif",
+         "--rule", "compile-surface",
+         "tests/fixtures/lint/compile_surface_pos.py"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    assert "compile-surface" in rules
+    assert "compile pin" in rules["compile-surface"][
+        "shortDescription"]["text"]
+    live = [r for r in run["results"] if "suppressions" not in r]
+    assert len(live) == 4
+    levels = sorted(r["level"] for r in live)
+    assert levels == ["error", "error", "warning", "warning"]
+    for r in live:
+        assert r["properties"]["key_space"] in {
+            "trace-static", "bucketed", "unbounded"}
+        unit_mod = r["properties"]["unit"].split(":")[0]
+        assert unit_mod.endswith("compile_surface_pos")
+
+
+def test_cache_version_tracks_signature_and_entry_tables():
+    """Satellite (ISSUE 16): the parse-cache version must move when the
+    registered signatures or entry points change — the pre-PR cache
+    could serve cross-module results derived under stale tables."""
+    from paddle_tpu.tools.analysis import (register_entry_point,
+                                           register_signature)
+    from paddle_tpu.tools.analysis.entrypoints import _EXTRA_ENTRY_POINTS
+    from paddle_tpu.tools.analysis.signatures import SIGNATURES
+    from paddle_tpu.tools.analysis.walker import _cache_version
+    v0 = _cache_version()
+    register_signature("zz_cache_probe_sig", lambda interp, rec: None)
+    try:
+        assert _cache_version() != v0
+    finally:
+        SIGNATURES.pop("zz_cache_probe_sig")
+    assert _cache_version() == v0
+    register_entry_point("zz.cache.probe_entry")
+    try:
+        assert _cache_version() != v0
+    finally:
+        _EXTRA_ENTRY_POINTS.remove("zz.cache.probe_entry")
+    assert _cache_version() == v0
+
+
+def test_stale_cache_not_served_after_entry_point_change(tmp_path):
+    """End-to-end: a saved parse cache is NOT loaded once the entry-point
+    table differs from the one it was written under."""
+    from paddle_tpu.tools.analysis import register_entry_point
+    from paddle_tpu.tools.analysis.entrypoints import _EXTRA_ENTRY_POINTS
+    from paddle_tpu.tools.analysis.walker import _ParseCache, _parse_files
+    f = tmp_path / "m.py"
+    f.write_text("x = 1\n")
+    cache_path = str(tmp_path / "cache.pkl")
+    c1 = _ParseCache(cache_path)
+    _parse_files([str(f)], str(tmp_path), c1)
+    c1.save()
+    assert _ParseCache(cache_path).entries    # same tables: served
+    register_entry_point("zz.stale.probe")
+    try:
+        assert not _ParseCache(cache_path).entries   # stale: dropped
+    finally:
+        _EXTRA_ENTRY_POINTS.remove("zz.stale.probe")
+    assert _ParseCache(cache_path).entries    # tables restored: served
+
+
+def test_surface_build_skipped_for_inert_files(tmp_path):
+    """Satellite (ISSUE 16): a changed-file lint only pays for surface
+    construction when the file can actually host a compile unit or a
+    root marker — the checker's token gate keeps ``--changed`` runs over
+    inert files free of the graftprog pass."""
+    from paddle_tpu.tools.analysis import compile_surface as cs
+    inert = tmp_path / "compile_surface_inert.py"   # hot glob, no tokens
+    inert.write_text("def f():\n    return 1\n")
+    before = cs.BUILD_COUNT
+    run_analysis([str(inert)], root=str(tmp_path),
+                 rules=["compile-surface"])
+    assert cs.BUILD_COUNT == before, \
+        "surface built for a file that cannot hold a compile unit"
+    probe = tmp_path / "compile_surface_probe.py"
+    probe.write_text("import jax\n\n\ndef g(x):\n"
+                     "    return jax.jit(lambda y: y + 1)(x)\n")
+    run_analysis([str(probe)], root=str(tmp_path),
+                 rules=["compile-surface"])
+    assert cs.BUILD_COUNT == before + 1
+
+
 def test_scan_performance_budget_with_warm_cache():
     """Full-scope scan must stay pre-commit-viable: one timed run under
     a generous wall-clock bound (catches accidental O(files^2)
     regressions, not jitter).  The parse cache is warm here — the CLI
-    tests above populate it; the bound absorbs a cold standalone run."""
+    tests above populate it; the bound absorbs a cold standalone run.
+    ISSUE 16: the budget now covers graftprog too — the lint pass builds
+    the compile surface (serving/kernels are hot paths) AND a full
+    ``--manifest`` emission rides inside the same 90s pin."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     cmd = [sys.executable, "scripts/graftlint.py"]
     t0 = time.perf_counter()
@@ -1289,4 +1462,13 @@ def test_scan_performance_budget_with_warm_cache():
     dt = time.perf_counter() - t0
     assert timed.returncode == 0, timed.stdout + timed.stderr
     assert (REPO_ROOT / ".graftlint_cache" / "parse.pkl").exists()
-    assert dt < 90.0, f"warm full-scope scan took {dt:.1f}s (budget 90s)"
+    t1 = time.perf_counter()
+    man = subprocess.run(cmd + ["--manifest"], cwd=str(REPO_ROOT),
+                         capture_output=True, text=True, timeout=600,
+                         env=env)
+    dt_man = time.perf_counter() - t1
+    assert man.returncode == 0, man.stdout + man.stderr
+    json.loads(man.stdout)    # still a valid artifact under timing
+    assert dt + dt_man < 90.0, (
+        f"warm full-scope scan + manifest took {dt:.1f}s + {dt_man:.1f}s "
+        f"(budget 90s)")
