@@ -748,6 +748,19 @@ def _secondary_benches(smoke=False):
         out["truncated"] = "budget"
         return out
 
+    # 6d'' zero-cold-start (ISSUE 17): startup timed with the AOT
+    # program store on vs off — cold-start-to-first-token, autoscaler
+    # spawn-to-routable and journal-recovery restart, plus the one-time
+    # store build cost those columns amortize.
+    try:
+        out["serving_cold_start"] = _serving_cold_start_bench(
+            dm, smoke=smoke)
+    except Exception as e:
+        out["serving_cold_start"] = {"error": repr(e)[-300:]}
+    if over_budget():
+        out["truncated"] = "budget"
+        return out
+
     # 6e tensor-parallel serving scaling (ISSUE 9): the mixed-arrival
     # workload behind engines sharded at tp in {1, 2, 4, 8} — decode
     # tok/s + scaling efficiency per degree, TTFT p50/p99, token parity
@@ -1776,6 +1789,151 @@ def _serving_journal_bench(model, smoke=False):
         "wall_s_on": round(wall_on, 2),
         "config": f"slots{slots}-reqs{n_reqs}-mixed-arrival-fsync-on",
     }
+
+
+def _serving_cold_start_bench(model, smoke=False):
+    """Zero-cold-start row (ISSUE 17, docs/serving.md "Zero cold
+    start"): the startup path timed three ways, AOT store on vs off —
+
+      * cold-start-to-first-token: construct an engine and serve one
+        prompt to its first token (traced: pays the prefill + decode
+        compiles; warm: deserializes from the store);
+      * spawn-to-routable: construct + a warmup batch covering every
+        committed bucket width — the autoscaler's gate before a
+        replica joins the rotation;
+      * journal-recovery restart: replay a crashed fleet's WAL into a
+        fresh single-replica router and finish the recovered work.
+
+    The store build cost (one-time, amortized across every spawn) and
+    the store size are reported alongside.  Token parity between the
+    traced and warm first-token legs is asserted."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import (AOTStore, Journal, Router,
+                                    ServingEngine, build_engine_store)
+    from paddle_tpu.serving.engine import EngineCore
+
+    rs = np.random.RandomState(11)
+    vocab = model.cfg.vocab_size
+    if smoke:
+        kw = dict(num_slots=2, max_seq=64, min_bucket=8,
+                  prefill_chunk=16, block_len=16)
+        n_rec, max_new = 3, 4
+    else:
+        kw = dict(num_slots=4, max_seq=128, min_bucket=16,
+                  prefill_chunk=32, block_len=32)
+        n_rec, max_new = 6, 12
+    store_dir = tempfile.mkdtemp(prefix="bench_aot_")
+    try:
+        t0 = time.perf_counter()
+        index = build_engine_store(store_dir, EngineCore(model, **kw))
+        build_wall = time.perf_counter() - t0
+        store_bytes = sum(e["bytes"] for e in index["programs"].values())
+
+        ttft_prompt = np.arange(11) % vocab   # identical across legs
+
+        def first_token(store):
+            """Construct-to-first-token wall + the token stream."""
+            got = []
+            t0 = time.perf_counter()
+            eng = ServingEngine(model, aot_store=store, **kw)
+            eng.submit(ttft_prompt.copy(), max_new_tokens=max_new,
+                       stream=lambda req, tok: got.append(
+                           (time.perf_counter(), int(tok))))
+            while not got:
+                eng.step()
+            ttft = got[0][0] - t0
+            eng.run_until_complete(2000)
+            return ttft, [t for _, t in got], eng
+
+        def spawn_routable(store):
+            """Construct + warmup over every committed width — the
+            autoscaler's spawn gate."""
+            t0 = time.perf_counter()
+            eng = ServingEngine(model, aot_store=store, **kw)
+            max_len = kw["max_seq"] - 3
+            widths = eng.core.warm_buckets()
+            ids = [eng.submit(
+                rs.randint(0, vocab, (min(max(w - 1, 1), max_len),)),
+                max_new_tokens=2) for w in widths]
+            eng.run_until_complete(4000)
+            for i in ids:
+                eng.purge(i)
+            return time.perf_counter() - t0
+
+        ttft_off, toks_off, _ = first_token(None)
+        store = AOTStore.open(store_dir)
+        try:
+            ttft_on, toks_on, warm_eng = first_token(store)
+            if toks_on != toks_off:
+                raise RuntimeError("warm engine perturbed tokens")
+            if warm_eng.aot_status != "warm":
+                raise RuntimeError(
+                    f"store did not warm-load: {warm_eng.aot_status}")
+            spawn_off = spawn_routable(None)
+            spawn_on = spawn_routable(store)
+
+            def restart(use_store, wal):
+                from paddle_tpu.obs import MetricsRegistry
+                journal = Journal.open(wal, fsync=False)
+                try:
+                    reg = MetricsRegistry()
+                    router = Router(
+                        [ServingEngine(model, registry=reg, **kw)],
+                        journal=journal, registry=reg)
+                    for i in range(n_rec):
+                        router.submit(rs.randint(0, vocab, (9 + i,)),
+                                      max_new_tokens=max_new)
+                    for _ in range(2):
+                        router.step()
+                finally:
+                    journal.crash()           # simulated process kill
+                t0 = time.perf_counter()
+                j2 = Journal.open(wal, fsync=False)
+                try:
+                    reg2 = type(reg)()
+                    r2 = Router(
+                        [ServingEngine(
+                            model, registry=reg2,
+                            aot_store=store if use_store else None,
+                            **kw)],
+                        journal=j2, registry=reg2)
+                    summary = r2.recover()
+                    r2.run_until_complete(4000)
+                finally:
+                    j2.close()
+                return time.perf_counter() - t0, summary
+
+            wal_a = tempfile.mkdtemp(prefix="bench_aot_wal_")
+            wal_b = tempfile.mkdtemp(prefix="bench_aot_wal_")
+            try:
+                restart_off, _ = restart(False, wal_a)
+                restart_on, summary = restart(True, wal_b)
+            finally:
+                shutil.rmtree(wal_a, ignore_errors=True)
+                shutil.rmtree(wal_b, ignore_errors=True)
+        finally:
+            store.close()
+        return {
+            "store_build_s": round(build_wall, 3),
+            "store_bytes": store_bytes,
+            "store_programs": len(index["programs"]),
+            "cold_start_to_first_token_s_traced": round(ttft_off, 3),
+            "cold_start_to_first_token_s_aot": round(ttft_on, 3),
+            "cold_start_speedup": round(ttft_off / ttft_on, 1)
+            if ttft_on > 0 else None,
+            "spawn_to_routable_s_traced": round(spawn_off, 3),
+            "spawn_to_routable_s_aot": round(spawn_on, 3),
+            "restart_recover_s_traced": round(restart_off, 3),
+            "restart_recover_s_aot": round(restart_on, 3),
+            "recovered_requests": summary.get("resubmitted"),
+            "token_parity": True,
+            "config": f"slots{kw['num_slots']}-max{kw['max_seq']}-"
+                      f"aot-vs-traced",
+        }
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
 
 
 def _serving_prefix_bench(model, smoke=False):

@@ -10,10 +10,14 @@ prefill and a bounded head-of-line skip (scheduler), one compiled
 fixed-shape decode step with per-slot sampling (engine), a
 submit/step/stream surface (api), off-hot-path telemetry — metrics
 registry + request-lifecycle tracing via paddle_tpu.obs (metrics) —
-and a durable request journal for crash-consistent fleets (journal).
+a durable request journal for crash-consistent fleets (journal), and a
+manifest-driven AOT program store for zero-cold-start engines (aot).
 See docs/serving.md and docs/observability.md.
 """
 
+from .aot import (AOTStore, AOTStoreError, AOTStoreWriter,
+                  aot_fingerprint, build_engine_store,
+                  engine_aot_context)
 from .api import Request, RequestOutput, SamplingParams, ServingEngine
 from .autoscaler import Autoscaler
 from .engine import EngineCore, finite_or_sentinel, sample_rows
@@ -46,4 +50,8 @@ __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            # crash consistency (docs/serving.md "Crash recovery")
            "Journal", "JournalError",
            # tail latency (docs/serving.md "Tail latency")
-           "PRIORITIES"]
+           "PRIORITIES",
+           # zero cold start (docs/serving.md "Zero cold start")
+           "AOTStore", "AOTStoreWriter", "AOTStoreError",
+           "build_engine_store", "engine_aot_context",
+           "aot_fingerprint"]

@@ -99,7 +99,8 @@ class ServingEngine:
                  tensor_parallel: int = 1,
                  collective_fusion: bool = True,
                  role: str = "unified",
-                 journal=None):
+                 journal=None,
+                 aot_store=None):
         # fleet role metadata (docs/serving.md "Disaggregated fleet"):
         # "prefill" replicas take only the router's prefill-stage work
         # (large prefill buckets, few slots), "decode" replicas take
@@ -137,7 +138,12 @@ class ServingEngine:
             # engine deployments journal with ENGINE request ids; a
             # fleet journals at the Router with fleet ids instead, so
             # replicas behind a Router are built journal-less
-            journal=journal)
+            journal=journal,
+            # zero-cold-start (docs/serving.md "Zero cold start"): an
+            # attached AOT program store makes construction a LOAD —
+            # the engine installs pre-lowered artifacts instead of
+            # tracing, falling back per program on any miss/skew
+            aot_store=aot_store)
         if journal is not None:
             journal.bind_metrics(self.core.metrics.registry)
             if journal.state:
@@ -367,6 +373,16 @@ class ServingEngine:
         compute-collective decode to the composed GSPMD path (``None``
         when ``tp_fused`` is active or the engine is single-chip)."""
         return self.core.tp_fusion_reason
+
+    @property
+    def aot_status(self):
+        """Warm-load outcome when an AOT store was attached: ``"warm"``
+        (every program loaded), ``"partial"`` (some artifacts degraded
+        to trace-on-demand), ``"empty"`` (matched store held no usable
+        leg), ``"skew"`` (fingerprint mismatch — fully traced) or
+        ``None`` (no store attached).  See docs/serving.md "Zero cold
+        start" for the fallback matrix."""
+        return self.core.aot_status
 
     @property
     def tracer(self):

@@ -56,7 +56,11 @@ class Autoscaler:
     fleet, the same weights as its peers).  ``warmup_fn(engine)``, when
     given, runs after construction and before the replica becomes
     routable — use it to pre-trace programs so a spawned replica serves
-    in steps, not compiles.
+    in steps, not compiles.  With ``aot_store`` given and a factory
+    that accepts ``aot_store=``, warmup becomes a LOAD: every spawn
+    (scale-up, resurrection, straggler replacement) hands the shared
+    store to the factory so the replica deserializes its programs
+    instead of tracing them (docs/serving.md "Zero cold start").
     """
 
     def __init__(self, router, spawn_fn: Callable, *,
@@ -65,7 +69,8 @@ class Autoscaler:
                  scale_up_depth: int = 8, scale_down_depth: int = 0,
                  hysteresis_steps: int = 4, cooldown_steps: int = 16,
                  replace_slow_after: Optional[int] = None,
-                 faults=None):
+                 faults=None,
+                 aot_store=None):
         if replace_slow_after is not None and replace_slow_after < 1:
             raise ValueError(
                 "replace_slow_after must be >= 1 (or None to disable "
@@ -83,6 +88,24 @@ class Autoscaler:
         self.router = router
         self.spawn_fn = spawn_fn
         self.warmup_fn = warmup_fn
+        # zero-cold-start (serving/aot.py): when the fleet has a shared
+        # AOT program store and the caller's factory can take it
+        # (``spawn_fn(aot_store=...)``), every spawn — scale-up,
+        # resurrection, straggler replacement — passes the store so the
+        # new replica warm-loads its programs instead of compiling
+        # under fleet load.  Zero-arg factories keep working unchanged.
+        self.aot_store = aot_store
+        if aot_store is not None:
+            import inspect
+            try:
+                params = inspect.signature(spawn_fn).parameters
+            except (TypeError, ValueError):
+                params = {}
+            self._spawn_takes_store = "aot_store" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        else:
+            self._spawn_takes_store = False
         self.min_decode = min_decode
         self.max_decode = max_decode
         self.scale_up_depth = scale_up_depth
@@ -274,7 +297,10 @@ class Autoscaler:
         try:
             if self.faults is not None:
                 self.faults.fire("replica_spawn")
-            engine = self.spawn_fn()
+            if self._spawn_takes_store:
+                engine = self.spawn_fn(aot_store=self.aot_store)
+            else:
+                engine = self.spawn_fn()
             if self.warmup_fn is not None:
                 self.warmup_fn(engine)
         except Exception as e:

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import _filter_top_p
+from .aot import AOTStoreError, engine_aot_context, aot_fingerprint
 from .errors import EngineStalledError, RequestRejected
 from .health import (DegradationLadder, EngineHealth,
                      FaultToleranceConfig)
@@ -180,7 +181,8 @@ class EngineCore:
                  max_queue: Optional[int] = None,
                  tensor_parallel: int = 1,
                  collective_fusion: bool = True,
-                 journal=None):
+                 journal=None,
+                 aot_store=None):
         if prefill_chunk is not None and prefill_chunk < min_bucket:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= min_bucket "
@@ -213,6 +215,18 @@ class EngineCore:
         # so a journal-less engine pays nothing and compiles nothing new
         self.journal = journal
         self._journal_hwm: Dict[int, int] = {}
+        # zero-cold-start (docs/serving.md "Zero cold start"): with an
+        # attached AOT store the engine LOADS its compiled-program set
+        # instead of tracing it — every site guards `if aot_store is
+        # None` / on the loaded-handle dicts, so a store-less engine
+        # pays nothing and compiles exactly as before.  _warm_buckets
+        # (the committed chunk-width set) is derived after the
+        # scheduler exists; _attach_aot runs after the decode path
+        # resolves, and again from every _build_device_plane rebuild.
+        self.aot_store = aot_store
+        self.aot_status: Optional[str] = None
+        self._warm_buckets: Optional[frozenset] = None
+        self._aot_prefill: Dict[int, Callable] = {}
         self.fault_tolerant = fault_tolerance is not None
         self.ft = fault_tolerance if fault_tolerance is not None \
             else FaultToleranceConfig()
@@ -296,6 +310,166 @@ class EngineCore:
         self.fused_decode = fused_decode
         self.decode_path, self.decode_fallback_reason = \
             self._resolve_decode_path()
+        # the committed bucket set is pinned ONCE, at construction —
+        # the AOT builder enumerates the same set from an identically
+        # configured engine, and _run_chunk's drift guard holds every
+        # later plan width against it (ladder degradations only ever
+        # shrink the reachable set, never escape it)
+        self._warm_buckets = frozenset(self.warm_buckets())
+        if self.aot_store is not None:
+            self._attach_aot()
+
+    def warm_buckets(self) -> Tuple[int, ...]:
+        """The COMMITTED prefill chunk-width set: every width
+        ``Scheduler.chunk_plan`` can emit for THIS configuration, over
+        every reachable plan start (0, any block-aligned radix-cache
+        match, and each chunk-stride position past those) — for both
+        the chunked ladder rung and the chunking-disabled one, since
+        the degradation ladder can drop ``prefill_chunk`` mid-life.
+        This is the contract surface between the AOT builder and the
+        runtime: the builder exports exactly one prefill program per
+        width here, and ``_run_chunk`` raises (never silently traces)
+        on a width outside it while a store is attached."""
+        max_seq = self.pool.max_seq
+        mb = max(self.scheduler.min_bucket, 1)
+        chunk = self.prefill_chunk
+        starts = {0}
+        if self.block_pool is not None:
+            starts.update(range(0, max_seq, self.block_pool.block_len))
+        positions = set(starts)
+        if chunk is not None:
+            for s in starts:
+                positions.update(range(s, max_seq, chunk))
+        widths = set()
+        for pos in positions:
+            cap = max_seq - pos
+            if cap < 1:
+                continue
+            # bucket_length values are {mb * 2^k} capped at the row
+            # remainder — enumerate the ladder once per start
+            b = mb
+            while True:
+                widths.add(min(b, cap))
+                if chunk is not None:
+                    widths.add(min(b, cap, chunk))
+                if b >= cap:
+                    break
+                b *= 2
+        if chunk is not None:
+            widths.add(chunk)
+        return tuple(sorted(widths))
+
+    def _attach_aot(self) -> None:
+        """Warm-load the compiled-program set from the attached store:
+        one prefill per committed bucket width, gather + scatter into
+        the block pool, the ONE decode at the resolved path.  Any miss
+        (fingerprint skew, absent leg) or failed load (corrupt
+        artifact, injected fault) degrades THAT program to
+        trace-on-demand with an ``aot_miss``/``aot_fallback`` event —
+        never a crash.  A bucket-set disagreement under a MATCHING
+        fingerprint is different: builder and runtime no longer agree
+        on the committed widths, the contract itself broke, and the
+        engine refuses loudly."""
+        store = self.aot_store
+        t0 = time.perf_counter()
+        self.aot_status = None
+        self._aot_prefill = {}
+        fp = aot_fingerprint(engine_aot_context(self))
+        if fp != store.fingerprint:
+            self.aot_status = "skew"
+            self.metrics.on_aot_miss(
+                "store", f"fingerprint skew: engine {fp[:12]}, store "
+                         f"{store.fingerprint[:12]}")
+            return
+        committed = tuple(sorted(self._warm_buckets)) \
+            if self._warm_buckets is not None \
+            else self.warm_buckets()
+        if tuple(store.widths) != tuple(committed):
+            raise AOTStoreError(
+                f"committed bucket drift under a matching fingerprint: "
+                f"store built for widths {list(store.widths)}, runtime "
+                f"enumerates {list(committed)} — builder and engine "
+                f"disagree on warm_buckets()")
+        wanted = 0
+        loads = 0
+        for w in committed:
+            wanted += 1
+            fn = self._aot_load(f"prefill:w{w}", donate=(0, 1))
+            if fn is not None:
+                self._aot_prefill[w] = fn
+                loads += 1
+        if self._aot_prefill:
+            self._prefill_fn = self._make_aot_prefill_dispatch()
+        if self.block_pool is not None:
+            wanted += 2
+            fn = self._aot_load("gather")
+            if fn is not None:
+                self.block_pool._load_fn = fn
+                loads += 1
+            fn = self._aot_load("scatter", donate=(0, 1))
+            if fn is not None:
+                self.block_pool._store_fn = fn
+                loads += 1
+        wanted += 1
+        fn = self._aot_load(f"decode:{self.decode_path}",
+                            donate=(0, 1))
+        if fn is not None:
+            # observability parity with the traced build: the
+            # decode_block event still records which path this
+            # engine's single decode program runs
+            self.metrics.on_decode_block(
+                active=self.decode_path in ("fused", "tp_fused_block"),
+                reason=None if not self.fused_decode
+                else self.decode_fallback_reason,
+                step=self._step_in_flight,
+                tp=self.tensor_parallel)
+            self._decode_fn = fn
+            loads += 1
+        self.aot_status = "warm" if loads == wanted else \
+            ("partial" if loads else "empty")
+        if loads:
+            self.metrics.on_aot_load(loads, time.perf_counter() - t0,
+                                     build_s=store.build_seconds)
+
+    def _aot_load(self, name: str,
+                  donate: Tuple[int, ...] = ()) -> Optional[Callable]:
+        """Load ONE program from the store, or None with the
+        degradation event recorded (the caller then leaves the traced
+        lazy-build path in place)."""
+        store = self.aot_store
+        if store is None:
+            return None
+        if not store.has(name):
+            self.metrics.on_aot_miss(name, "not in store")
+            return None
+        try:
+            if self.faults is not None:
+                self.faults.fire("aot_load")
+            return store.load_call(name, donate=donate, mesh=self.mesh)
+        except Exception as e:
+            self.metrics.on_aot_fallback(name, repr(e))
+            return None
+
+    def _make_aot_prefill_dispatch(self) -> Callable:
+        """A ``_prefill_fn``-shaped dispatcher over the warm-loaded
+        per-width programs.  A committed width whose artifact failed to
+        load falls back to ONE lazily traced prefill (jit re-keys it
+        per width exactly as the cold path would)."""
+        loaded = self._aot_prefill
+        traced: Dict[str, Optional[Callable]] = {"fn": None}
+
+        def prefill_dispatch(ks, vs, ids, pos, valid):
+            fn = loaded.get(int(ids.shape[1]))
+            if fn is None:
+                if traced["fn"] is None:
+                    self.metrics.on_aot_fallback(
+                        f"prefill:w{int(ids.shape[1])}",
+                        "width artifact unavailable; tracing")
+                    traced["fn"] = self._build_prefill_fn()
+                fn = traced["fn"]
+            return fn(ks, vs, ids, pos, valid)
+
+        return prefill_dispatch
 
     def _build_device_plane(self) -> None:
         """Construct (or, on quarantine, RECONSTRUCT) everything that
@@ -362,6 +536,12 @@ class EngineCore:
         # stale baseline so its re-traces still emit compile events
         self._compile_seen = {k: v for k, v in self._compile_seen.items()
                               if not k.startswith("block_")}
+        # quarantine: the rebuilt plane re-loads from artifacts instead
+        # of re-tracing (the first construction-time call runs from
+        # __init__ once the decode path is resolved; _warm_buckets is
+        # still None here on that first pass)
+        if self.aot_store is not None and self._warm_buckets is not None:
+            self._attach_aot()
 
     def _lane(self, req: Request) -> int:
         """Tracer lane for one request's lifecycle spans (the engine's
@@ -581,6 +761,14 @@ class EngineCore:
         if self._prefill_fn is None:
             self._prefill_fn = self._build_prefill_fn()
         off, width, valid = st.plan[st.next_chunk]
+        if self.aot_store is not None and self._warm_buckets is not None \
+                and width not in self._warm_buckets:
+            # the committed-bucket contract (warm_buckets) broke: with
+            # a store attached this must be LOUD, not a silent trace
+            raise AOTStoreError(
+                f"prefill width {width} is outside the committed "
+                f"bucket set {sorted(self._warm_buckets)} — "
+                f"warm_buckets()/chunk_plan drift")
         t0 = time.perf_counter()
         ids = np.zeros((1, width), np.int32)
         ids[0, :valid] = np.asarray(st.req.prompt[off:off + valid],
@@ -865,7 +1053,15 @@ class EngineCore:
         step's single host readback (step() times dispatch and readback
         as separate timeline phases)."""
         if self._decode_fn is None:
-            self._decode_fn = self._build_decode_fn()
+            # a degradation-ladder path change dropped the handle: try
+            # the store's artifact for the NEW path first (a miss is a
+            # recorded degradation event), trace only when it has none
+            if self.aot_store is not None \
+                    and self.aot_status not in (None, "skew"):
+                self._decode_fn = self._aot_load(
+                    f"decode:{self.decode_path}", donate=(0, 1))
+            if self._decode_fn is None:
+                self._decode_fn = self._build_decode_fn()
         if self._sampling_dev is None:
             self._sampling_dev = (jnp.asarray(self._do_sample),
                                   jnp.asarray(self._temperature),

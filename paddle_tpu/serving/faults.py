@@ -67,6 +67,15 @@ Injection points (``POINTS``):
                       (``Router.kill`` — no drain, no close); in-flight
                       work must re-attribute through the existing
                       failover path and the ledger must conserve
+  ``aot_load``        the engine's warm-load of ONE program from the
+                      AOT store raises before the artifact is read
+                      (serving/aot.py; arm on the engine's injector) —
+                      the engine must degrade that program to
+                      trace-on-demand, never crash
+  ``aot_store_corrupt`` ``AOTStore._read_object`` reports the artifact
+                      frame corrupt (the CRC-mismatch path a real
+                      flipped bit takes; arm on the injector passed to
+                      ``AOTStore.open``)
   =================  ====================================================
 
 Faults are armed per site with ``enable(site, at=..., times=...)``: the
@@ -107,7 +116,13 @@ POINTS = ("kv_alloc", "block_alloc", "block_exhausted", "gather",
           # (sleep around one replica's step — arm on the Router's
           # injector) and the hedge-submission fault (the duplicate
           # submission dies before landing; the hedge fails closed)
-          "replica_slow", "hedge_submit")
+          "replica_slow", "hedge_submit",
+          # zero-cold-start sites (ISSUE 17): the engine-side warm load
+          # of one AOT program (arm on the engine's injector) and the
+          # store-side artifact-corruption report (arm on the injector
+          # passed to AOTStore.open) — both must degrade the engine to
+          # trace-on-demand with accounting and the compile pin intact
+          "aot_load", "aot_store_corrupt")
 
 
 class FaultError(RuntimeError):

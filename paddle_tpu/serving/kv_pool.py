@@ -273,6 +273,38 @@ class BlockPool:
         self._free.append(block)
 
     # ---------------------------------------------------- copy programs
+    def _build_load_fn(self):
+        """The gather program factory — shared by the lazy trace in
+        :meth:`load_row` and the AOT builder (serving/aot.py), so the
+        exported artifact and the traced program are one body."""
+        def load(bks, bvs, idx):
+            self.trace_counts["gather"] += 1   # trace-time tick
+            ks = [gather_block_rows(b, idx)[None] for b in bks]
+            vs = [gather_block_rows(b, idx)[None] for b in bvs]
+            return ks, vs
+
+        return jax.jit(load)
+
+    def _build_store_fn(self):
+        """The scatter program factory (same sharing contract as
+        :meth:`_build_load_fn`)."""
+        n = (1, self.max_seq) + self.bks[0].shape[2:]
+
+        def store(bks, bvs, ks, vs, slot, dest):
+            self.trace_counts["scatter"] += 1  # trace-time tick
+            start = (slot, 0, 0, 0)
+            new_bks = [
+                scatter_block_rows(
+                    b, jax.lax.dynamic_slice(k, start, n)[0], dest)
+                for b, k in zip(bks, ks)]
+            new_bvs = [
+                scatter_block_rows(
+                    b, jax.lax.dynamic_slice(v, start, n)[0], dest)
+                for b, v in zip(bvs, vs)]
+            return new_bks, new_bvs
+
+        return jax.jit(store, donate_argnums=(0, 1))
+
     def load_row(self, idx) -> Tuple[List[jax.Array], List[jax.Array]]:
         """Gather blocks ``idx`` ([blocks_per_row] int32, padded past the
         match with any in-bounds value) into per-layer ``[1, max_seq, h,
@@ -280,13 +312,7 @@ class BlockPool:
         if self.faults is not None:
             self.faults.fire("gather")
         if self._load_fn is None:
-            def load(bks, bvs, idx):
-                self.trace_counts["gather"] += 1   # trace-time tick
-                ks = [gather_block_rows(b, idx)[None] for b in bks]
-                vs = [gather_block_rows(b, idx)[None] for b in bvs]
-                return ks, vs
-
-            self._load_fn = jax.jit(load)
+            self._load_fn = self._build_load_fn()
         return self._load_fn(self.bks, self.bvs,
                              jnp.asarray(idx, jnp.int32))
 
@@ -297,22 +323,7 @@ class BlockPool:
         if self.faults is not None:
             self.faults.fire("scatter")
         if self._store_fn is None:
-            n = (1, self.max_seq) + self.bks[0].shape[2:]
-
-            def store(bks, bvs, ks, vs, slot, dest):
-                self.trace_counts["scatter"] += 1  # trace-time tick
-                start = (slot, 0, 0, 0)
-                new_bks = [
-                    scatter_block_rows(
-                        b, jax.lax.dynamic_slice(k, start, n)[0], dest)
-                    for b, k in zip(bks, ks)]
-                new_bvs = [
-                    scatter_block_rows(
-                        b, jax.lax.dynamic_slice(v, start, n)[0], dest)
-                    for b, v in zip(bvs, vs)]
-                return new_bks, new_bvs
-
-            self._store_fn = jax.jit(store, donate_argnums=(0, 1))
+            self._store_fn = self._build_store_fn()
         self.bks, self.bvs = self._store_fn(
             self.bks, self.bvs, pool.ks, pool.vs,
             jnp.asarray(slot, jnp.int32), jnp.asarray(dest, jnp.int32))

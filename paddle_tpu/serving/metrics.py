@@ -198,6 +198,32 @@ class ServingMetrics:
                                "collective-bearing decode "
                                "dispatch+readback wall time (recorded "
                                "only on tp > 1 engines)", unit="s")
+        # zero-cold-start surface (docs/serving.md "Zero cold start"):
+        # warm-load accounting for the AOT program store.  The event
+        # counters window-reset with the rest; the two gauges are
+        # engine-lifetime facts (how long THIS engine's warm load took,
+        # how long the store's build took) and bind outside self._own
+        # for the same reason as serving.tp_degree — nothing would ever
+        # re-publish them after a bench warmup reset
+        self._c_aot_loads = c("aot.loads",
+                              "programs warm-loaded from the AOT store "
+                              "instead of traced")
+        self._c_aot_misses = c("aot.misses",
+                               "AOT lookups with no usable artifact "
+                               "(fingerprint skew / leg not in store)")
+        self._c_aot_fallbacks = c("aot.fallbacks",
+                                  "AOT load attempts that failed "
+                                  "(corrupt artifact, version skew, "
+                                  "injected fault) and degraded to "
+                                  "tracing")
+        self._g_aot_load_s = reg.gauge("aot.load_s",
+                                       "wall seconds the engine's last "
+                                       "warm load spent")
+        self._g_aot_build_s = reg.gauge("aot.build_s",
+                                        "wall seconds the attached "
+                                        "store's builder spent "
+                                        "exporting (from the store "
+                                        "index)")
         self._last_health_state: Optional[str] = None
         self._phase_h: Dict[str, Histogram] = {}
         self._zero_local()
@@ -275,6 +301,37 @@ class ServingMetrics:
                           active=active,
                           reason=reason if reason is not None else "",
                           step=step, tp=tp)
+
+    def on_aot_load(self, programs: int, seconds: float,
+                    build_s: Optional[float] = None) -> None:
+        """The engine finished a warm load: ``programs`` artifacts
+        installed from the AOT store in ``seconds`` of wall time
+        (``build_s``: the store's recorded builder time, republished as
+        the ``aot.build_s`` gauge so one scrape shows both halves of
+        the build-once/load-many trade).  Lands as an ``aot_load``
+        discrete event on the engine lane."""
+        self._c_aot_loads.inc(programs)
+        self._g_aot_load_s.set(seconds)
+        if build_s is not None:
+            self._g_aot_build_s.set(build_s)
+        self.tracer.event("aot_load", lane=self.engine_lane,
+                          programs=programs, seconds=round(seconds, 6))
+
+    def on_aot_miss(self, program: str, reason: str) -> None:
+        """An AOT lookup found no usable artifact (store fingerprint
+        skew, or ``program``'s leg absent) — the engine traces instead.
+        A degradation event (``aot_miss``), never an error."""
+        self._c_aot_misses.inc()
+        self.tracer.event("aot_miss", lane=self.engine_lane,
+                          program=program, reason=reason)
+
+    def on_aot_fallback(self, program: str, reason: str) -> None:
+        """An AOT load ATTEMPT failed (corrupt artifact, deserialize
+        skew, injected ``aot_load`` fault) and ``program`` degraded to
+        trace-on-demand.  Lands as an ``aot_fallback`` event."""
+        self._c_aot_fallbacks.inc()
+        self.tracer.event("aot_fallback", lane=self.engine_lane,
+                          program=program, reason=reason)
 
     def on_decode_block_step(self, seconds: float) -> None:
         """One fused-path decode dispatch's wall time (the engine calls

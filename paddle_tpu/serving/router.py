@@ -667,6 +667,7 @@ class Router:
               brownout_hysteresis: int = 4,
               prefill_engine_kw: Optional[dict] = None,
               decode_engine_kw: Optional[dict] = None,
+              aot_store=None,
               **engine_kw) -> "Router":
         """Construct ``replicas`` engines onto ONE shared registry and
         tracer (fresh ones when not given) and front them with a router.
@@ -677,7 +678,15 @@ class Router:
         override the shared ones — e.g. ``prefill_engine_kw=dict(
         num_slots=2, max_prefill_tokens_per_step=None)`` for the
         big-bucket prefill shape, ``decode_engine_kw=dict(num_slots=16)``
-        for the all-slots decode shape."""
+        for the all-slots decode shape.
+
+        ``aot_store`` is the fleet's shared zero-cold-start program
+        store (serving/aot.py): every replica warm-loads its compiled
+        programs from the one store instead of tracing at construction.
+        Per-role kwarg overrides that change the engine's compile
+        fingerprint (slot count, bucket shape) fall back to tracing for
+        that role — loudly, via ``aot_miss`` — rather than refusing to
+        build."""
         from ..obs import MetricsRegistry, Tracer
         registry = registry if registry is not None else MetricsRegistry()
         tracer = tracer if tracer is not None else Tracer()
@@ -690,6 +699,8 @@ class Router:
         engines = []
         for r in role_list:
             kw = dict(engine_kw)
+            if aot_store is not None:
+                kw.setdefault("aot_store", aot_store)
             if r == "prefill" and prefill_engine_kw:
                 kw.update(prefill_engine_kw)
             elif r == "decode" and decode_engine_kw:

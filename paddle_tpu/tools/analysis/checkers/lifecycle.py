@@ -127,6 +127,17 @@ DEFAULT_PAIRS: Tuple[ResourcePair, ...] = (
     ResourcePair("open", "close", "request journal",
                  receiver_hint=("journal", "Journal"),
                  alt_release=("crash",)),
+    # serving/aot.py AOTStore: a reader handle opened on the program
+    # store must close on every path; hinted like the journal so plain
+    # file `open` call sites stay untracked
+    ResourcePair("open", "close", "aot program store",
+                 receiver_hint=("aot", "AOTStore", "store")),
+    # serving/aot.py AOTStore.create: an in-flight store build must
+    # terminate in publish (success) or discard (abort) on every path,
+    # or crashed builds leak half-written objects with no gc intent
+    ResourcePair("create", "publish", "aot store build",
+                 receiver_hint=("AOTStore",),
+                 alt_release=("discard",)),
     # serving/journal.py segment rotation: a begun segment must seal
     # (flush + fsync + close) before the next begins, or two active
     # tails interleave and the torn-tail recovery contract breaks

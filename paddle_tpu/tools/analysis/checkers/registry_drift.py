@@ -173,6 +173,11 @@ ALLOWLIST: Dict[str, str] = {
         # control plane, no array ops; contract =
         # tests/test_zz_crash_serving.py
         "Journal", "JournalError",
+        # zero cold start (ISSUE 17): the manifest-driven AOT program
+        # store — host-side artifact persistence + keying, no array
+        # ops; contract = tests/test_zz_aot_serving.py
+        "AOTStore", "AOTStoreWriter", "AOTStoreError",
+        "build_engine_store", "engine_aot_context", "aot_fingerprint",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

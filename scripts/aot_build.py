@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""aot_build CLI — build, verify and garbage-collect the zero-cold-start
+AOT program store (paddle_tpu/serving/aot.py; docs/serving.md "Zero cold
+start").
+
+Usage:
+    python scripts/aot_build.py build  <store> [--model gpt_tiny]
+                                       [--num-slots 4] [--max-seq 64]
+                                       [--min-bucket 8]
+                                       [--prefill-chunk 16]
+                                       [--block-len 16]
+                                       [--tensor-parallel 1]
+                                       [--fused-decode] [--seed 0]
+    python scripts/aot_build.py verify <store>
+    python scripts/aot_build.py gc     <store>
+
+``build`` constructs the engine at the given shape (the build IS the
+trace), AOT-lowers every program on the compile-surface manifest's
+``EngineCore`` plane and publishes the store atomically.  ``verify``
+re-derives the manifest and exits 1 unless the store covers every
+manifest program id for its committed bucket widths AND every artifact
+passes its CRC + deserialize check — the CI hook that keeps a stale or
+rotted store from reaching a fleet.  ``gc`` removes unreferenced
+``objects/*.aot`` left behind by builds that crashed before publish
+(the atomic-publish contract makes them garbage, never torn state).
+
+Exit code 0 iff the subcommand fully succeeded.
+"""
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# graftprog: the build path reaches the engine's compile surface
+# (prefill/decode/gather/scatter exports) — register main as its root
+__compile_surface_roots__ = ("main",)
+
+MODELS = ("gpt_tiny", "gpt_small")
+
+
+def _build_engine(ns):
+    """The builder engine at the requested shape (prefix cache on: the
+    manifest plane includes gather/scatter, publish refuses without)."""
+    import paddle_tpu
+    from paddle_tpu.models import GPTForCausalLM, gpt_small, gpt_tiny
+    from paddle_tpu.serving.engine import EngineCore
+
+    cfg_fn = {"gpt_tiny": gpt_tiny, "gpt_small": gpt_small}[ns.model]
+    paddle_tpu.seed(ns.seed)
+    model = GPTForCausalLM(cfg_fn())
+    model.eval()
+    return EngineCore(model, num_slots=ns.num_slots, max_seq=ns.max_seq,
+                      min_bucket=ns.min_bucket,
+                      prefill_chunk=ns.prefill_chunk,
+                      block_len=ns.block_len,
+                      tensor_parallel=ns.tensor_parallel,
+                      fused_decode=ns.fused_decode)
+
+
+def _cmd_build(ns):
+    from paddle_tpu.serving.aot import build_engine_store
+
+    core = _build_engine(ns)
+    index = build_engine_store(ns.store, core)
+    progs = index["programs"]
+    total = sum(e["bytes"] for e in progs.values())
+    build_s = sum(e["build_s"] for e in progs.values())
+    print(f"published {len(progs)} programs "
+          f"({total / 1e6:.1f} MB, {build_s:.1f}s build) -> {ns.store}")
+    print(f"fingerprint {index['fingerprint'][:16]}... "
+          f"widths {index['widths']}")
+    for name in sorted(progs):
+        print(f"  {name:<16} {progs[name]['bytes']:>9} B")
+    return 0
+
+
+def _verify_missing(store, plane):
+    """Manifest program ids the store does not cover — the same
+    completeness rule the writer enforces at publish, re-checked
+    against the CURRENT manifest so a drifted engine plane (a new
+    counter, say) fails verify even on an honestly published store."""
+    programs = store.programs()
+    covered = {e["counter"] for e in programs.values()}
+    missing = []
+    for counter in sorted(plane):
+        if counter == "prefill":
+            for w in store.widths:
+                if f"prefill:w{w}" not in programs:
+                    missing.append(f"prefill:w{w}")
+        elif counter == "decode":
+            if not any(n.startswith("decode:") for n in programs):
+                missing.append("decode:<path>")
+        elif counter not in covered:
+            missing.append(counter)
+    return missing
+
+
+def _cmd_verify(ns):
+    from paddle_tpu.serving.aot import (ENGINE_PLANE, AOTStore,
+                                        AOTStoreError, _default_manifest)
+
+    try:
+        store = AOTStore.open(ns.store)
+    except AOTStoreError as e:
+        print(f"verify FAILED: {e}")
+        return 1
+    try:
+        plane = _default_manifest().get("planes", {}).get(ENGINE_PLANE)
+        if plane is None:
+            print(f"verify FAILED: manifest has no {ENGINE_PLANE} plane")
+            return 1
+        for counter, entry in sorted(plane.items()):
+            if entry.get("key_space") == "unbounded":
+                print(f"verify FAILED: manifest classifies {counter!r} "
+                      f"UNBOUNDED — the store cannot cover it")
+                return 1
+        missing = _verify_missing(store, plane)
+        if missing:
+            print(f"verify FAILED: store misses manifest programs "
+                  f"{missing}")
+            return 1
+        bad = []
+        for name in sorted(store.programs()):
+            try:
+                store.load(name)     # CRC + deserialize both checked
+            except AOTStoreError as e:
+                bad.append(f"{name}: {e}")
+        if bad:
+            print("verify FAILED: corrupt artifacts:")
+            for line in bad:
+                print(f"  {line}")
+            return 1
+        print(f"verify OK: {len(store.programs())} programs cover the "
+              f"{ENGINE_PLANE} plane (widths {list(store.widths)}, "
+              f"fingerprint {store.fingerprint[:16]}...)")
+        return 0
+    finally:
+        store.close()
+
+
+def _cmd_gc(ns):
+    from paddle_tpu.serving.aot import OBJECTS_DIR, AOTStore
+
+    store = AOTStore.open(ns.store)
+    try:
+        live = {e["object"] + ".aot"
+                for e in store.programs().values()}
+    finally:
+        store.close()
+    obj_dir = os.path.join(ns.store, OBJECTS_DIR)
+    removed = 0
+    for fname in sorted(os.listdir(obj_dir)):
+        if fname.endswith(".aot") and fname not in live:
+            os.remove(os.path.join(obj_dir, fname))
+            removed += 1
+    print(f"gc: removed {removed} unreferenced objects "
+          f"({len(live)} live)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="aot_build",
+        description="build/verify/gc the serving AOT program store")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("build", help="build + publish a store")
+    b.add_argument("store", help="store directory")
+    b.add_argument("--model", choices=MODELS, default="gpt_tiny")
+    b.add_argument("--num-slots", type=int, default=4)
+    b.add_argument("--max-seq", type=int, default=64)
+    b.add_argument("--min-bucket", type=int, default=8)
+    b.add_argument("--prefill-chunk", type=int, default=16)
+    b.add_argument("--block-len", type=int, default=16)
+    b.add_argument("--tensor-parallel", type=int, default=1)
+    b.add_argument("--fused-decode", action="store_true")
+    b.add_argument("--seed", type=int, default=0)
+    b.set_defaults(fn=_cmd_build)
+
+    v = sub.add_parser("verify",
+                       help="exit 1 unless the store covers the "
+                            "manifest plane and every artifact is sound")
+    v.add_argument("store", help="store directory")
+    v.set_defaults(fn=_cmd_verify)
+
+    g = sub.add_parser("gc",
+                       help="remove unreferenced objects from crashed "
+                            "builds")
+    g.add_argument("store", help="store directory")
+    g.set_defaults(fn=_cmd_gc)
+
+    ns = ap.parse_args(argv)
+    return ns.fn(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
