@@ -10,8 +10,10 @@ prefill and a bounded head-of-line skip (scheduler), one compiled
 fixed-shape decode step with per-slot sampling (engine), a
 submit/step/stream surface (api), off-hot-path telemetry — metrics
 registry + request-lifecycle tracing via paddle_tpu.obs (metrics) —
-a durable request journal for crash-consistent fleets (journal), and a
-manifest-driven AOT program store for zero-cold-start engines (aot).
+a durable request journal for crash-consistent fleets (journal), a
+manifest-driven AOT program store for zero-cold-start engines (aot),
+and speculative decoding — host-side per-slot n-gram drafts checked by
+ONE batched fixed-shape verify program (spec).
 See docs/serving.md and docs/observability.md.
 """
 
@@ -33,6 +35,7 @@ from .metrics import ServingMetrics
 from .prefix_cache import MatchResult, PrefixCache
 from .router import ReplicaHandle, Router
 from .scheduler import PRIORITIES, Scheduler, bucket_length
+from .spec import NGramDraftTable
 
 __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            "EngineCore", "sample_rows", "finite_or_sentinel", "KVPool",
@@ -54,4 +57,7 @@ __all__ = ["ServingEngine", "Request", "RequestOutput", "SamplingParams",
            # zero cold start (docs/serving.md "Zero cold start")
            "AOTStore", "AOTStoreWriter", "AOTStoreError",
            "build_engine_store", "engine_aot_context",
-           "aot_fingerprint"]
+           "aot_fingerprint",
+           # speculative decoding (docs/serving.md "Speculative
+           # decoding")
+           "NGramDraftTable"]

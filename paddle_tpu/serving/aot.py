@@ -65,7 +65,12 @@ __all__ = ["AOTStore", "AOTStoreWriter", "AOTStoreError",
 # through a stored handle, which the static walk cannot follow)
 __compile_surface_roots__ = ("build_engine_store", "AOTStore")
 
-STORE_VERSION = 1
+# version 2: the decode signature gained the constrained-decoding vocab
+# mask operand and the plane gained the ONE verify program (ISSUE 18) —
+# a version-1 store's decode artifact would be called with an operand it
+# was never exported for, so open() refuses old stores outright instead
+# of letting the mismatch surface as a shape error mid-serve
+STORE_VERSION = 2
 INDEX_NAME = "index.json"
 OBJECTS_DIR = "objects"
 ENGINE_PLANE = "paddle_tpu.serving.engine.EngineCore"
@@ -132,6 +137,11 @@ def engine_aot_context(core) -> Dict[str, Any]:
         "block_len": bp.block_len if bp is not None else None,
         "num_blocks": bp.num_blocks if bp is not None else None,
         "tensor_parallel": core.tensor_parallel,
+        # the RESOLVED speculative window (0 when speculation was not
+        # requested or not viable): it shapes the verify program's
+        # [num_slots, spec_k+1] operands, so a spec_k=2 engine must not
+        # warm-load a spec_k=4 store's verify artifact
+        "spec_k": core.spec_k if core.spec_on else 0,
     }
 
 
@@ -370,6 +380,16 @@ class AOTStoreWriter:
                 if not any(n.startswith("decode:")
                            for n in self._programs):
                     missing.append("decode:<path>")
+            elif counter == "verify":
+                # the STATIC plane always carries the verify counter
+                # (the program exists in the source), but a spec_k=0
+                # build has no verify program to export — completeness
+                # is keyed on the store's resolved spec_k
+                if not self.context.get("spec_k"):
+                    continue
+                if not any(n.startswith("verify:")
+                           for n in self._programs):
+                    missing.append("verify:<path>")
             elif counter not in covered:
                 missing.append(counter)
         return missing
@@ -485,18 +505,35 @@ def _export_programs(core, writer: AOTStoreWriter) -> None:
     t0 = time.perf_counter()
     decode = core._build_decode_fn()
     n = core.num_slots
+    vocab = int(core.model.cfg.vocab_size)
+    sampling = (_on_mesh(core, jnp.tile(jax.random.PRNGKey(0)[None],
+                                        (n, 1))),
+                _on_mesh(core, jnp.zeros((n,), bool)),
+                _on_mesh(core, jnp.ones((n,), jnp.float32)),
+                _on_mesh(core, jnp.zeros((n,), jnp.int32)),
+                _on_mesh(core, jnp.ones((n,), jnp.float32)),
+                _on_mesh(core, jnp.ones((n, vocab), bool)))
     args = (core.pool.ks, core.pool.vs, core.pool.seq_pos,
             _on_mesh(core, jnp.zeros((n,), jnp.int32)),
-            _on_mesh(core, jnp.tile(jax.random.PRNGKey(0)[None],
-                                    (n, 1))),
-            _on_mesh(core, jnp.zeros((n,), bool)),
-            _on_mesh(core, jnp.ones((n,), jnp.float32)),
-            _on_mesh(core, jnp.zeros((n,), jnp.int32)),
-            _on_mesh(core, jnp.ones((n,), jnp.float32)))
+            *sampling)
     with core._mesh_scope():
         exported = _jx.export(decode)(*args)
     writer.add(f"decode:{core.decode_path}", exported,
                build_s=time.perf_counter() - t0)
+
+    if core.spec_on:
+        # the ONE verify program: same operands as decode plus the
+        # fixed-shape draft window (the engine keys the leg on the
+        # decode path, exactly like decode itself)
+        t0 = time.perf_counter()
+        verify = core._build_verify_fn()
+        vargs = args + (
+            _on_mesh(core, jnp.zeros((n, core.spec_k), jnp.int32)),
+            _on_mesh(core, jnp.zeros((n,), jnp.int32)))
+        with core._mesh_scope():
+            exported = _jx.export(verify)(*vargs)
+        writer.add(f"verify:{core.decode_path}", exported,
+                   build_s=time.perf_counter() - t0)
 
     bp = core.block_pool
     idx = _on_mesh(core, jnp.zeros((bp.blocks_per_row,), jnp.int32))

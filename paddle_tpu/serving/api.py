@@ -100,7 +100,8 @@ class ServingEngine:
                  collective_fusion: bool = True,
                  role: str = "unified",
                  journal=None,
-                 aot_store=None):
+                 aot_store=None,
+                 spec_k: int = 0):
         # fleet role metadata (docs/serving.md "Disaggregated fleet"):
         # "prefill" replicas take only the router's prefill-stage work
         # (large prefill buckets, few slots), "decode" replicas take
@@ -143,7 +144,13 @@ class ServingEngine:
             # attached AOT program store makes construction a LOAD —
             # the engine installs pre-lowered artifacts instead of
             # tracing, falling back per program on any miss/skew
-            aot_store=aot_store)
+            aot_store=aot_store,
+            # speculative decoding (docs/serving.md "Speculative
+            # decoding"): spec_k > 0 adds ONE batched verify program —
+            # per-slot n-gram drafts checked in a single fixed-shape
+            # [num_slots, spec_k+1] dispatch; token streams are
+            # identical to spec_k=0, only faster
+            spec_k=spec_k)
         if journal is not None:
             journal.bind_metrics(self.core.metrics.registry)
             if journal.state:
@@ -161,7 +168,8 @@ class ServingEngine:
                stream: Optional[Callable] = None,
                deadline_s: Optional[float] = None,
                ttft_deadline_s: Optional[float] = None,
-               priority: str = "interactive") -> int:
+               priority: str = "interactive",
+               allowed_tokens: Optional[Sequence[int]] = None) -> int:
         """Queue one request; returns its id (admission happens inside a
         later ``step()`` — submit never blocks on the device).
 
@@ -182,7 +190,15 @@ class ServingEngine:
         latency-sensitive, the default — or ``"batch"`` — deferrable
         offline work): admission prefers interactive inside the bounded
         skip window, and a fleet router's brownout sheds batch first
-        under sustained overload (docs/serving.md "Tail latency")."""
+        under sustained overload (docs/serving.md "Tail latency").
+
+        ``allowed_tokens`` constrains decoding to a token set: the
+        engine applies it as a per-slot vocab mask INSIDE the existing
+        decode/verify programs (a traced operand — zero new compiled
+        programs), so sampling can never emit an out-of-set token.
+        Speculation composes: drafts are truncated at the first
+        out-of-set token, so a constrained slot still speculates within
+        its set (docs/serving.md "Constrained decoding")."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError(
@@ -206,6 +222,20 @@ class ServingEngine:
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}")
+        if allowed_tokens is not None:
+            allowed_tokens = np.unique(
+                np.asarray(allowed_tokens, np.int64).reshape(-1))
+            if allowed_tokens.size < 1:
+                raise ValueError(
+                    "allowed_tokens is empty — an unsatisfiable "
+                    "constraint can never emit a token; pass None for "
+                    "unconstrained decoding")
+            vocab = int(self.core.model.cfg.vocab_size)
+            lo, hi = int(allowed_tokens[0]), int(allowed_tokens[-1])
+            if lo < 0 or hi >= vocab:
+                raise ValueError(
+                    f"allowed_tokens must lie in [0, {vocab}) — got "
+                    f"range [{lo}, {hi}]")
         sched = self.core.scheduler
         req = Request(request_id=sched.next_request_id(),
                       prompt=prompt, max_new_tokens=max_new_tokens,
@@ -213,7 +243,8 @@ class ServingEngine:
                       eos_token_id=eos_token_id, stream=stream,
                       priority=priority,
                       deadline_s=deadline_s,
-                      ttft_deadline_s=ttft_deadline_s)
+                      ttft_deadline_s=ttft_deadline_s,
+                      allowed_tokens=allowed_tokens)
         try:
             self.core.check_admission(req)
         except RequestRejected as e:
@@ -375,6 +406,34 @@ class ServingEngine:
         return self.core.tp_fusion_reason
 
     @property
+    def spec_k(self) -> int:
+        """The requested speculative draft length (0 = speculation
+        off).  ``spec_on``/``spec_fallback_reason`` report what the
+        engine actually resolved."""
+        return self.core.spec_k
+
+    @property
+    def spec_on(self) -> bool:
+        """Is speculative decoding ACTIVE — requested (``spec_k > 0``),
+        resolved viable at construction, and not disabled by the
+        degradation ladder since."""
+        return self.core.spec_on and not self.core.spec_bypass
+
+    @property
+    def spec_fallback_reason(self):
+        """Why speculation is off (``None`` while active): the
+        construction-time resolution reason, or ``"degraded: ..."``
+        when the ladder's ``spec_verify`` rung disabled it mid-run
+        (docs/serving.md fallback matrix)."""
+        return self.core.spec_fallback_reason
+
+    @property
+    def spec_acceptance_rate(self):
+        """Accepted / drafted over the current metrics window (None
+        before the first speculative step)."""
+        return self.core.metrics.spec_acceptance_rate
+
+    @property
     def aot_status(self):
         """Warm-load outcome when an AOT store was attached: ``"warm"``
         (every program loaded), ``"partial"`` (some artifacts degraded
@@ -402,7 +461,7 @@ class ServingEngine:
     def degraded_subsystems(self):
         """Optional subsystems the degradation ladder has disabled
         (subset of ``("prefix_cache", "chunked_prefill",
-        "fused_decode")``; empty = full service)."""
+        "fused_decode", "spec_verify")``; empty = full service)."""
         return self.core.ladder.disabled_subsystems
 
     def close(self) -> None:

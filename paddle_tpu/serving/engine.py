@@ -23,7 +23,17 @@ rule is the design constraint):
     sampling params as traced row values, and per-slot PRNG keys.  Free
     and mid-prefill slots ride along as no-ops: their rows decode garbage
     that nothing reads, their writes land at positions a later adopt
-    overwrites wholesale.
+    overwrites wholesale;
+  * ``verify``   — ONE program (speculative decoding, ``spec_k > 0``):
+    ``[num_slots, spec_k+1]`` draft windows — each slot's last committed
+    token followed by its host-proposed n-gram draft (serving/spec.py) —
+    at per-slot positions, with matched-sampling acceptance computed
+    in-program: the window replays the EXACT per-token split/sample
+    chain sequential decode would run, a slot commits its longest
+    draft prefix that matches those samples plus one bonus token, and
+    ``seq_pos`` advances only by the accepted length, so rejected rows'
+    KV writes sit past every visible position and the next append
+    overwrites them.  Accepted lengths vary per slot; shapes never do.
 
 Host plane: ONE device->host readback per step phase — the decode
 harvest reads the sampled token vector once, and a step that completes
@@ -102,15 +112,25 @@ def _filter_top_k_rows(logits, top_k):
     return jnp.where(keep, logits, -jnp.inf)
 
 
-def sample_rows(keys, logits, do_sample, temperature, top_k, top_p):
+def sample_rows(keys, logits, do_sample, temperature, top_k, top_p,
+                mask=None):
     """Per-row token selection over ``logits [rows, vocab]``.
 
     ``do_sample [rows] bool`` picks greedy argmax vs sampling per row;
     sampling rows apply ``temperature -> top_k -> top_p`` (the exact
     pipeline of ``generation.generate``) and draw from their OWN key row
     of ``keys [rows, key_dim]``, so one request's randomness never
-    depends on its slot neighbours."""
+    depends on its slot neighbours.
+
+    ``mask [rows, vocab] bool`` (constrained decoding) bans False
+    columns BEFORE everything — greedy argmax and the filter pipeline
+    both see ``-inf`` there, so a constrained row renormalizes over its
+    allowed set exactly like rejection-free constrained sampling.  The
+    mask is a traced operand of the existing decode/verify programs:
+    unconstrained rows pass all-True and the program set never grows."""
     logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
     greedy_tok = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     scaled = logits / temp[:, None]
@@ -123,16 +143,70 @@ def sample_rows(keys, logits, do_sample, temperature, top_k, top_p):
     return jnp.where(jnp.asarray(do_sample, bool), sampled, greedy_tok)
 
 
+def _verify_tail(logits, drafts, draft_len, keys, do_sample, temperature,
+                 top_k, top_p, mask, spec_k):
+    """Matched-sampling acceptance over one verify window (runs inside
+    the jitted verify program, after the model produced ``logits
+    [rows, spec_k+1, vocab]``).
+
+    The Python loop unrolls the EXACT per-token chain sequential decode
+    runs — one ``jax.random.split`` per emitted token per slot, sample
+    from the split's second half, carry the first — so position t's
+    sample is identical to what the t-th sequential decode step would
+    have drawn.  A slot's accepted length is its longest draft prefix
+    matching those samples (``cumprod`` of the running match), and the
+    committed tokens ARE the samples: greedy AND seeded runs are
+    token-for-token identical to non-speculative decode by
+    construction, and for temperature sampling the emitted tokens are
+    literally draws from the sequential target distribution
+    (rejection-sampling-correct with an exact-match acceptance rule).
+
+    Each position is sentinel-encoded through ``finite_or_sentinel``
+    first; the sentinel (-1) never equals a draft id (>= 0), so a
+    poisoned position terminates acceptance by itself — at most ONE
+    sentinel (the bonus slot) ever reaches the host, where the harvest
+    fails the request exactly as sequential decode would have.
+
+    Returns ``(committed [rows, spec_k+1] int32, accepted [rows] int32,
+    new_keys [rows, ...])`` with ``new_keys`` the key-chain entry after
+    ``accepted+1`` splits — the key sequential decode would hold."""
+    carry = keys
+    samples = []
+    carries = [carry]
+    for t in range(spec_k + 1):
+        split = jax.vmap(lambda kk: jax.random.split(kk, 2))(carry)
+        tok = sample_rows(split[:, 1], logits[:, t], do_sample,
+                          temperature, top_k, top_p, mask=mask)
+        tok = finite_or_sentinel(logits[:, t], tok)
+        samples.append(tok.astype(jnp.int32))
+        carry = split[:, 0]
+        carries.append(carry)
+    committed = jnp.stack(samples, axis=1)        # [rows, K+1]
+    key_chain = jnp.stack(carries, axis=1)        # [rows, K+2, ...]
+    if spec_k:
+        valid = jnp.arange(spec_k)[None, :] < draft_len[:, None]
+        match = (committed[:, :spec_k] == drafts) & valid
+        accepted = jnp.sum(
+            jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    else:
+        accepted = jnp.zeros(committed.shape[:1], jnp.int32)
+    new_keys = jax.vmap(lambda kc, a: kc[a])(key_chain, accepted + 1)
+    return committed, accepted, new_keys
+
+
 class _Slot:
     """Host mirror of one pool slot's request progress."""
 
-    __slots__ = ("req", "pos", "match")
+    __slots__ = ("req", "pos", "match", "draft", "allowed")
 
     def __init__(self, req: Request, prompt_len: int,
-                 match: Optional[MatchResult] = None):
+                 match: Optional[MatchResult] = None,
+                 draft=None, allowed=None):
         self.req = req
         self.pos = prompt_len       # cache length == next write offset
         self.match = match          # pinned radix-cache path, if any
+        self.draft = draft          # per-request NGramDraftTable (spec)
+        self.allowed = allowed      # frozenset of allowed token ids
 
 
 class _Prefill:
@@ -182,7 +256,10 @@ class EngineCore:
                  tensor_parallel: int = 1,
                  collective_fusion: bool = True,
                  journal=None,
-                 aot_store=None):
+                 aot_store=None,
+                 spec_k: int = 0):
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if prefill_chunk is not None and prefill_chunk < min_bucket:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} must be >= min_bucket "
@@ -233,6 +310,18 @@ class EngineCore:
         self.health = EngineHealth(self.ft)
         self.ladder = DegradationLadder(self.ft.ladder_threshold)
         self.prefix_bypass = False              # ladder: cache disabled
+        # ---- speculative decoding (docs/serving.md "Speculative
+        # decoding"): spec_k > 0 arms the draft/verify path — per-slot
+        # n-gram drafts (serving/spec.py) verified by ONE batched
+        # [num_slots, spec_k+1] program.  Static legality resolves with
+        # the decode path (_resolve_decode_path -> spec_on /
+        # spec_fallback_reason); spec_bypass is the ladder's runtime
+        # kill switch (a spec_verify fault ladder disables speculation
+        # and the engine keeps serving one token per step).
+        self.spec_k = spec_k
+        self.spec_on = False
+        self.spec_fallback_reason: Optional[str] = None
+        self.spec_bypass = False                # ladder: spec disabled
         self.max_queue = max_queue if max_queue is not None \
             else self.ft.max_queue
         # monotone work marker: tokens emitted, admissions, prefill
@@ -254,7 +343,7 @@ class EngineCore:
         # programs) are what the compile-count guard tests assert on.
         # Engine-lifetime: a quarantine rebuild re-traces ON TOP of them
         # (exactly one more decode program, the same bucket set).
-        self.trace_counts = {"prefill": 0, "decode": 0}
+        self.trace_counts = {"prefill": 0, "decode": 0, "verify": 0}
         self._compile_seen: Dict[str, int] = {}
         # telemetry plumbing: the step index keys every phase span; the
         # step currently executing tags lazily-built programs' obs
@@ -277,6 +366,8 @@ class EngineCore:
         self.mesh = None
         self._tp_program = None
         self._tp_program_path: Optional[str] = None
+        self._tp_verify_program = None
+        self._tp_verify_program_path: Optional[str] = None
         self.tp_fusion_reason: Optional[str] = None
         if tensor_parallel > 1:
             from . import tp as _tp
@@ -425,6 +516,13 @@ class EngineCore:
                 tp=self.tensor_parallel)
             self._decode_fn = fn
             loads += 1
+        if self.spec_on:
+            wanted += 1
+            fn = self._aot_load(f"verify:{self.decode_path}",
+                                donate=(0, 1))
+            if fn is not None:
+                self._verify_fn = fn
+                loads += 1
         self.aot_status = "warm" if loads == wanted else \
             ("partial" if loads else "empty")
         if loads:
@@ -529,7 +627,15 @@ class EngineCore:
         self._top_k = np.zeros((num_slots,), np.int32)
         self._top_p = np.ones((num_slots,), np.float32)
         self._sampling_dev: Optional[Tuple] = None
+        # per-slot allowed-token mask (constrained decoding): host rows
+        # dirtied on admission/release, lazily re-uploaded like the
+        # sampling params — all-True rows are unconstrained, and the
+        # mask is traced row data in the SAME decode/verify programs
+        self._mask_host = np.ones(
+            (num_slots, int(model.cfg.vocab_size)), bool)
+        self._mask_dev = None
         self._decode_fn = None
+        self._verify_fn = None
         self._prefill_fn: Optional[Callable] = None
         self._staging_init_fn: Optional[Callable] = None
         # a rebuilt BlockPool's trace counters restart at zero: drop the
@@ -800,15 +906,33 @@ class EngineCore:
         key = jax.random.PRNGKey(req.sampling.seed)
         key, sub = jax.random.split(key)
         s = req.sampling
+        allowed = None
+        self._mask_host[slot] = True
+        if req.allowed_tokens is not None:
+            # constrained decoding: the per-slot vocab mask constrains
+            # the FIRST token here and every later one inside the
+            # decode/verify programs; the host set gates draft proposals
+            allowed = frozenset(int(t) for t in req.allowed_tokens)
+            self._mask_host[slot] = False
+            self._mask_host[slot, np.asarray(req.allowed_tokens,
+                                             np.int64)] = True
+        self._mask_dev = None
         first = sample_rows(
             sub[None], st.last_logits[None],
             jnp.asarray([s.do_sample]),
             jnp.asarray([s.temperature], jnp.float32),
             jnp.asarray([s.top_k], jnp.int32),
-            jnp.asarray([s.top_p], jnp.float32))
+            jnp.asarray([s.top_p], jnp.float32),
+            mask=jnp.asarray(self._mask_host[slot][None]))
         first = finite_or_sentinel(st.last_logits[None], first)
+        draft = None
+        if self.spec_on:
+            from .spec import NGramDraftTable
+            draft = NGramDraftTable()
+            draft.seed(req.prompt)
         self.pool.adopt(slot, list(zip(st.ks, st.vs)), req.prompt_len)
-        self._slots[slot] = _Slot(req, req.prompt_len, match=st.match)
+        self._slots[slot] = _Slot(req, req.prompt_len, match=st.match,
+                                  draft=draft, allowed=allowed)
         self._last_tok = self._last_tok.at[slot].set(first[0])
         self._keys = self._keys.at[slot].set(key)
         self._do_sample[slot] = s.do_sample
@@ -937,8 +1061,26 @@ class EngineCore:
         program (``"tp_fused"``, serving/tp.py) when legal, the
         composed GSPMD decode last — every rung keeps serving.  Returns
         ``(path, fallback_reason)``; reason is None when a fused-block
-        path engages (or the flag is simply off)."""
+        path engages (or the flag is simply off).
+
+        The SPECULATIVE leg resolves here too, statically:
+        ``spec_on``/``spec_fallback_reason`` name why speculation is
+        armed or not for this engine shape (never a runtime surprise —
+        the per-step room gate and the ladder's ``spec_bypass`` are the
+        only dynamic fallbacks, both named in ``decode_path_info``)."""
         from ..kernels.decode_block import resolve_fused_decode
+        if self.spec_k == 0:
+            self.spec_on = False
+            self.spec_fallback_reason = \
+                "spec_k=0 (speculation not requested)"
+        elif self.pool.max_seq <= self.spec_k + 1:
+            self.spec_on = False
+            self.spec_fallback_reason = (
+                f"max_seq {self.pool.max_seq} leaves no room for a "
+                f"spec_k={self.spec_k} verify window")
+        else:
+            self.spec_on = True
+            self.spec_fallback_reason = None
         if self.tensor_parallel > 1:
             reason = None
             if self.fused_decode:
@@ -985,7 +1127,7 @@ class EngineCore:
             return self._build_tp_decode_fn()
 
         def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
-                   temperature, top_k, top_p):
+                   temperature, top_k, top_p, mask):
             self.trace_counts["decode"] += 1  # trace-time side effect
             caches = [(k, v, seq_pos) for k, v in zip(ks, vs)]
             step_fn = model.fused_decode_step if fused else \
@@ -993,7 +1135,7 @@ class EngineCore:
             logits, caches = step_fn(last_tok[:, None], caches, seq_pos)
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             nxt = sample_rows(split[:, 1], logits[:, 0], do_sample,
-                              temperature, top_k, top_p)
+                              temperature, top_k, top_p, mask=mask)
             # device-side health probe: a poisoned row reads back as the
             # sentinel through the step's EXISTING single readback (a
             # no-op on finite logits, so token parity is untouched)
@@ -1033,14 +1175,14 @@ class EngineCore:
         program = self._tp_program
 
         def decode(ks, vs, seq_pos, last_tok, keys, do_sample,
-                   temperature, top_k, top_p):
+                   temperature, top_k, top_p, mask):
             self.trace_counts["decode"] += 1  # trace-time side effect
             logits, new_ks, new_vs, new_pos = program(
                 ks, vs, seq_pos, last_tok)
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             lg = logits[:, 0]
             nxt = sample_rows(split[:, 1], lg, do_sample,
-                              temperature, top_k, top_p)
+                              temperature, top_k, top_p, mask=mask)
             nxt = finite_or_sentinel(lg, nxt)
             return (new_ks, new_vs, new_pos, nxt.astype(jnp.int32),
                     split[:, 0])
@@ -1067,12 +1209,157 @@ class EngineCore:
                                   jnp.asarray(self._temperature),
                                   jnp.asarray(self._top_k),
                                   jnp.asarray(self._top_p))
+        if self._mask_dev is None:
+            self._mask_dev = jnp.asarray(self._mask_host)
         ks, vs, pos, nxt, self._keys = self._decode_fn(
             self.pool.ks, self.pool.vs, self.pool.seq_pos,
-            self._last_tok, self._keys, *self._sampling_dev)
+            self._last_tok, self._keys, *self._sampling_dev,
+            self._mask_dev)
         self.pool.ks, self.pool.vs, self.pool.seq_pos = ks, vs, pos
         self._last_tok = nxt
         return nxt
+
+    # ----------------------------------------- speculative decode (spec)
+    def _build_verify_fn(self) -> Callable:
+        """The ONE batched verify program of the speculative path
+        (docs/serving.md "Speculative decoding"): fixed shapes
+        ``[num_slots, spec_k+1]`` regardless of per-slot acceptance.
+
+        The window runs ``model.decode_step`` at token width
+        ``spec_k+1`` with per-slot positions — the SAME ragged
+        discipline as decode (``cache_lens`` gives query t of a slot's
+        window visibility up to ``pos+t``), so free and mid-prefill
+        rows ride along as no-ops exactly as they do in decode.
+        Acceptance is MATCHED SAMPLING (``_verify_tail``): the program
+        replays the exact per-token split/sample chain sequential
+        decode would run over these logits, so the committed tokens ARE
+        the sequential target's tokens — token-for-token parity, greedy
+        and seeded, is structural rather than probabilistic.  KV of
+        rejected positions is written (fixed shapes) but never becomes
+        visible: ``seq_pos`` advances only by accepted+1, and the next
+        append overwrites the stale tail."""
+        model = self.model
+        if self.decode_path in ("tp_fused", "tp_fused_block"):
+            return self._build_tp_verify_fn()
+
+        def verify(ks, vs, seq_pos, last_tok, keys, do_sample,
+                   temperature, top_k, top_p, mask, drafts, draft_len):
+            self.trace_counts["verify"] += 1  # trace-time side effect
+            caches = [(k, v, seq_pos) for k, v in zip(ks, vs)]
+            ids = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            logits, caches = model.decode_step(ids, caches, seq_pos)
+            committed, accepted, new_keys = _verify_tail(
+                logits, drafts, draft_len, keys, do_sample, temperature,
+                top_k, top_p, mask, self.spec_k)
+            new_last = jnp.take_along_axis(
+                committed, accepted[:, None], axis=1)[:, 0]
+            # the caches advanced the full window width — the ragged
+            # truth is accepted+1, which also re-hides rejected KV
+            new_pos = seq_pos + accepted + 1
+            packed = jnp.concatenate([committed, accepted[:, None]],
+                                     axis=1)
+            new_ks = [c[0] for c in caches]
+            new_vs = [c[1] for c in caches]
+            return (new_ks, new_vs, new_pos,
+                    new_last.astype(jnp.int32), packed, new_keys)
+
+        return jax.jit(verify, donate_argnums=(0, 1))
+
+    def _build_tp_verify_fn(self) -> Callable:
+        """Tensor-parallel fused verify: the width-``spec_k+1`` member
+        of the SAME shard_map family as the fused decode
+        (tp.build_tp_verify_program — identical bundle layout and
+        specs, the layer seam IS ``_tp_layer``), with the matched-
+        sampling acceptance tail under GSPMD on the vocab-sharded
+        logits inside the same jit.  The ``tp_fused_block`` path
+        verifies through this program too (the Pallas block is a
+        single-token kernel) and keeps its block for decode steps."""
+        from . import tp as _tp
+        if self._tp_verify_program is None \
+                or self._tp_verify_program_path != self.decode_path:
+            self._tp_verify_program = _tp.build_tp_verify_program(
+                self.model, self.mesh, self.tensor_parallel,
+                width=self.spec_k + 1)
+            self._tp_verify_program_path = self.decode_path
+        program = self._tp_verify_program
+
+        def verify(ks, vs, seq_pos, last_tok, keys, do_sample,
+                   temperature, top_k, top_p, mask, drafts, draft_len):
+            self.trace_counts["verify"] += 1  # trace-time side effect
+            ids = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            logits, new_ks, new_vs, _ = program(ks, vs, seq_pos, ids)
+            committed, accepted, new_keys = _verify_tail(
+                logits, drafts, draft_len, keys, do_sample, temperature,
+                top_k, top_p, mask, self.spec_k)
+            new_last = jnp.take_along_axis(
+                committed, accepted[:, None], axis=1)[:, 0]
+            new_pos = seq_pos + accepted + 1
+            packed = jnp.concatenate([committed, accepted[:, None]],
+                                     axis=1)
+            return (new_ks, new_vs, new_pos,
+                    new_last.astype(jnp.int32), packed, new_keys)
+
+        return jax.jit(verify, donate_argnums=(0, 1))
+
+    def _verify_dispatch(self, drafts: np.ndarray,
+                         draft_len: np.ndarray) -> jax.Array:
+        """ONE fixed-shape verify step over every slot; returns the
+        packed ``[num_slots, spec_k+2]`` commit rows (each slot's
+        sentinel-encoded window samples + its accepted draft length)
+        STILL ON DEVICE — the caller performs the step's single host
+        readback, exactly like decode."""
+        if self._verify_fn is None:
+            if self.aot_store is not None \
+                    and self.aot_status not in (None, "skew"):
+                self._verify_fn = self._aot_load(
+                    f"verify:{self.decode_path}", donate=(0, 1))
+            if self._verify_fn is None:
+                self._verify_fn = self._build_verify_fn()
+        if self._sampling_dev is None:
+            self._sampling_dev = (jnp.asarray(self._do_sample),
+                                  jnp.asarray(self._temperature),
+                                  jnp.asarray(self._top_k),
+                                  jnp.asarray(self._top_p))
+        if self._mask_dev is None:
+            self._mask_dev = jnp.asarray(self._mask_host)
+        ks, vs, pos, nxt, packed, self._keys = self._verify_fn(
+            self.pool.ks, self.pool.vs, self.pool.seq_pos,
+            self._last_tok, self._keys, *self._sampling_dev,
+            self._mask_dev, jnp.asarray(drafts), jnp.asarray(draft_len))
+        self.pool.ks, self.pool.vs, self.pool.seq_pos = ks, vs, pos
+        self._last_tok = nxt
+        return packed
+
+    def _propose_drafts(self):
+        """Host draft phase: ask every active slot's n-gram table for up
+        to ``spec_k`` tokens.  Returns ``(drafts [num_slots, spec_k],
+        draft_len [num_slots], total_drafted)`` or None when this step
+        should run the normal decode instead — speculation off/bypassed,
+        nothing proposed anywhere, or ANY occupied slot within
+        ``spec_k+1`` rows of its row end (``append_kv`` clamps a
+        window's start at ``max_seq - width``, which would overwrite
+        that row's valid KV — the whole step falls back rather than
+        corrupt it; such a slot is about to hit max_seq anyway)."""
+        if not self.spec_on or self.spec_bypass or not self._slots:
+            return None
+        k = self.spec_k
+        limit = self.pool.max_seq - k - 1
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        lens = np.zeros((self.num_slots,), np.int32)
+        total = 0
+        for slot, st in self._slots.items():
+            if st.pos > limit:
+                return None
+            if st.draft is None or st.req.finished:
+                continue
+            toks = st.draft.propose(k, allowed=st.allowed)
+            if toks:
+                drafts[slot, :len(toks)] = toks
+                lens[slot] = len(toks)
+                total += len(toks)
+        if total == 0:
+            return None
+        return drafts, lens, total
 
     # -------------------------------------------------------- step loop
     def step(self) -> int:
@@ -1170,15 +1457,33 @@ class EngineCore:
                     armed = faults.check("nan_logits")
                     if armed is not None:
                         self._poison_slot(min(self._slots), step_i)
+                # speculative draft phase (pure host, spec_on only):
+                # None -> normal decode this step, else the batched
+                # fixed-shape verify program commits up to spec_k+1
+                # tokens per slot
+                spec = self._propose_drafts()
                 # decode faults cannot be pinned on one slot — the
                 # watchdog attributes them to the decode path (ladder
-                # candidate when fused, retry/quarantine otherwise)
-                self._fault_phase = "fused_decode" \
-                    if self.decode_path in ("fused", "tp_fused_block") \
-                    else "decode"
+                # candidate when fused or speculating, retry/quarantine
+                # otherwise)
+                if spec is not None:
+                    self._fault_phase = "spec_verify"
+                else:
+                    self._fault_phase = "fused_decode" \
+                        if self.decode_path in ("fused",
+                                                "tp_fused_block") \
+                        else "decode"
                 if faults is not None:
                     faults.fire("step")
-                nxt = self._decode_dispatch()
+                    if spec is not None:
+                        # fires BEFORE dispatch: nothing was mutated
+                        # yet, so the ladder's retry step is clean
+                        faults.fire("spec_verify")
+                if spec is not None:
+                    drafts, draft_len, drafted = spec
+                    nxt = self._verify_dispatch(drafts, draft_len)
+                else:
+                    nxt = self._decode_dispatch()
                 t_decode = time.perf_counter()
                 toks = np.asarray(nxt)     # THE per-step device readback
                 t_readback = time.perf_counter()
@@ -1192,6 +1497,7 @@ class EngineCore:
                 # outside the watchdog (inside it the containment is
                 # already complete — no retry needed).
                 harvest_exc = None
+                accepted_total = 0
                 for slot in sorted(self._slots):
                     # a stream callback may REENTRANTLY cancel/purge a
                     # sibling (first-of-N-wins clients): re-fetch, and
@@ -1200,7 +1506,14 @@ class EngineCore:
                     if st is None:
                         continue
                     try:
-                        new_tokens += self._harvest(slot, int(toks[slot]))
+                        if spec is None:
+                            new_tokens += self._harvest(slot,
+                                                        int(toks[slot]))
+                        else:
+                            a = int(toks[slot, self.spec_k + 1])
+                            accepted_total += a
+                            new_tokens += self._harvest_window(
+                                slot, toks[slot, :a + 1])
                     except Exception as e:
                         self.metrics.on_fault("harvest", repr(e),
                                               step=step_i)
@@ -1208,6 +1521,8 @@ class EngineCore:
                                        f"token emit failed: {e!r}")
                         if harvest_exc is None:
                             harvest_exc = e
+                if spec is not None:
+                    self.metrics.on_spec(int(drafted), accepted_total)
                 if harvest_exc is not None and not self.fault_tolerant:
                     raise harvest_exc
                 # decode phases exist only on steps that decoded — a
@@ -1289,7 +1604,12 @@ class EngineCore:
         runs normally after the backoff sleep."""
         step_i = self._step_in_flight
         phase = self._fault_phase or "step"
-        if phase == "fused_decode" \
+        if phase == "spec_verify" and self.spec_on \
+                and not self.spec_bypass:
+            # speculation is optional: its faults feed the ladder, which
+            # disables it at threshold — decode is always the fallback
+            self._subsystem_fault("spec_verify", exc)
+        elif phase == "fused_decode" \
                 and self.decode_path in ("fused", "tp_fused_block"):
             self._subsystem_fault("fused_decode", exc)
         else:
@@ -1341,6 +1661,13 @@ class EngineCore:
                 self.decode_path = "unfused"
             self.decode_fallback_reason = f"degraded: {reason}"
             self._decode_fn = None        # re-trace composed on next use
+            self._verify_fn = None        # verify is path-keyed too
+        elif subsystem == "spec_verify":
+            # back to one committed token per step; the draft tables
+            # stay on their slots (pure host state, nothing reads them)
+            self.spec_bypass = True
+            self.spec_fallback_reason = f"degraded: {reason}"
+            self.metrics.on_spec_disable(reason)
         else:
             raise ValueError(f"unknown subsystem {subsystem!r}")
         self.health.degraded = True
@@ -1421,8 +1748,13 @@ class EngineCore:
                          count=skips - skips_before, step=step_i)
 
     def _emit(self, slot: int, tok: int, first_token: bool = False) -> None:
-        req = self._slots[slot].req
+        st = self._slots[slot]
+        req = st.req
         req.tokens.append(tok)
+        if st.draft is not None:
+            # the n-gram draft table learns every COMMITTED token, off
+            # the hot path — harvest time, after the step's readback
+            st.draft.observe(tok)
         self.progress_counter += 1              # token out = progress
         now = time.perf_counter()
         if first_token:
@@ -1483,6 +1815,26 @@ class EngineCore:
         self._emit(slot, tok)
         return 1
 
+    def _harvest_window(self, slot: int, toks) -> int:
+        """Commit one slot's verify window — its accepted draft prefix
+        plus the bonus token — through the SAME per-token path as
+        sequential decode (:meth:`_harvest`), in order.  The loop
+        breaks where the sequential engine would have stopped stepping:
+        eos/length finishes, the non-finite sentinel, a reentrant
+        cancel.  A truncated tail is simply discarded — the slot is
+        evicted this same step, so its device state (which advanced by
+        the full accepted length) is never read again."""
+        emitted = 0
+        for tok in toks:
+            st = self._slots.get(slot)
+            if st is None or st.req.finished:
+                break
+            got = self._harvest(slot, int(tok))
+            if got == 0:
+                break              # sentinel failed the request
+            emitted += got
+        return emitted
+
     # --------------------------------------------- terminal dispositions
     def _finalize(self, req: Request, status: str, reason: str,
                   now: Optional[float] = None) -> None:
@@ -1540,6 +1892,9 @@ class EngineCore:
         self.pool.free(slot)
         self._do_sample[slot] = False
         self._sampling_dev = None
+        if not self._mask_host[slot].all():
+            self._mask_host[slot] = True      # constrained row retires
+            self._mask_dev = None
         tracer = self.metrics.tracer
         if tracer.enabled:
             tracer.event("slot_release", lane=self.metrics.engine_lane,
@@ -1562,6 +1917,9 @@ class EngineCore:
         self.pool.free(st.slot)
         self._do_sample[st.slot] = False
         self._sampling_dev = None
+        if not self._mask_host[st.slot].all():
+            self._mask_host[st.slot] = True
+            self._mask_dev = None
         self._finalize(st.req, status, reason)
 
     def cancel(self, request_id: int, status: str = "cancelled",
@@ -1670,6 +2028,7 @@ class EngineCore:
             "progress_counter": self.progress_counter,
             "steps": self._step_index,
             "tensor_parallel": self.tensor_parallel,
+            "speculation": self.spec_on and not self.spec_bypass,
         }
 
     def run_until_complete(self, max_steps: Optional[int] = None,

@@ -76,6 +76,12 @@ Injection points (``POINTS``):
                       frame corrupt (the CRC-mismatch path a real
                       flipped bit takes; arm on the injector passed to
                       ``AOTStore.open``)
+  ``spec_verify``     the engine raises on a SPECULATIVE step, after
+                      the draft phase but before the verify dispatch
+                      (nothing mutated yet) — the degradation ladder
+                      must disable speculation at threshold and the
+                      engine keeps serving one token per step, token
+                      accounting conserved
   =================  ====================================================
 
 Faults are armed per site with ``enable(site, at=..., times=...)``: the
@@ -122,7 +128,12 @@ POINTS = ("kv_alloc", "block_alloc", "block_exhausted", "gather",
           # store-side artifact-corruption report (arm on the injector
           # passed to AOTStore.open) — both must degrade the engine to
           # trace-on-demand with accounting and the compile pin intact
-          "aot_load", "aot_store_corrupt")
+          "aot_load", "aot_store_corrupt",
+          # speculative decoding (ISSUE 18): the engine-side verify
+          # fault — fired on speculative steps before the verify
+          # program dispatches, so the ladder's spec_bypass rung is
+          # driven with zero device state to unwind
+          "spec_verify")
 
 
 class FaultError(RuntimeError):

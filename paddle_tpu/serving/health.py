@@ -8,10 +8,11 @@ watchdog (enabled via ``ServingEngine(fault_tolerance=...)``):
     degradation ladder disables it, quarantine limit/window for the
     circuit breaker, and the bounded submit queue;
   * :class:`DegradationLadder` — per-OPTIONAL-subsystem fault counters
-    (``prefix_cache``, ``chunked_prefill``, ``fused_decode``): a
-    subsystem that faults ``ladder_threshold`` times is disabled and the
-    engine keeps serving without it (cache → bypass, chunking →
-    whole-bucket, fused decode → composed path);
+    (``prefix_cache``, ``chunked_prefill``, ``fused_decode``,
+    ``spec_verify``): a subsystem that faults ``ladder_threshold``
+    times is disabled and the engine keeps serving without it (cache →
+    bypass, chunking → whole-bucket, fused decode → composed path,
+    speculation → one token per step);
   * :class:`EngineHealth` — the state machine
     ``healthy → degraded → quarantined`` (+ terminal ``circuit_open``):
     consecutive core-step faults earn exponential-backoff retries until
@@ -48,7 +49,7 @@ STATE_CODES = {HEALTHY: 0, DEGRADED: 1, QUARANTINED: 2, CIRCUIT_OPEN: 3}
 # the optional subsystems the ladder may disable, in ladder order — the
 # engine serves correctly (if slower) without any of them
 SUBSYSTEMS: Tuple[str, ...] = ("prefix_cache", "chunked_prefill",
-                               "fused_decode")
+                               "fused_decode", "spec_verify")
 
 
 @dataclasses.dataclass

@@ -224,6 +224,17 @@ class ServingMetrics:
                                         "store's builder spent "
                                         "exporting (from the store "
                                         "index)")
+        # speculative-decoding surface (docs/serving.md "Speculative
+        # decoding"): draft tokens proposed vs draft tokens the verify
+        # program accepted.  accepted/draft is the acceptance rate — the
+        # single number that predicts the speedup (each accepted token
+        # is a decode step the engine did not pay for)
+        self._c_spec_draft = c("spec.draft_tokens",
+                               "draft tokens proposed by the n-gram "
+                               "tables (verify-window fill)")
+        self._c_spec_accept = c("spec.accepted_tokens",
+                                "draft tokens the verify program "
+                                "accepted (free decode steps)")
         self._last_health_state: Optional[str] = None
         self._phase_h: Dict[str, Histogram] = {}
         self._zero_local()
@@ -239,6 +250,8 @@ class ServingMetrics:
         self._tokens_local = 0
         self._steps_local = 0
         self._finished_local = 0
+        self._spec_draft_local = 0
+        self._spec_accept_local = 0
 
     def reset(self) -> None:
         """Zero THIS engine's instruments and drop the tracer's recorded
@@ -410,6 +423,22 @@ class ServingMetrics:
         self.tracer.event("step_retry", lane=self.engine_lane,
                           attempt=attempt, backoff_s=round(backoff_s, 4),
                           step=step)
+
+    def on_spec(self, drafted: int, accepted: int) -> None:
+        """One speculative step's draft/accept tally (the engine calls
+        this after the harvest of a verify window, never between device
+        dispatches)."""
+        self._c_spec_draft.inc(drafted)
+        self._c_spec_accept.inc(accepted)
+        self._spec_draft_local += drafted
+        self._spec_accept_local += accepted
+
+    def on_spec_disable(self, reason: str) -> None:
+        """The degradation ladder (or an unsatisfiable constraint)
+        turned speculation off — drop the discrete event so the trace
+        shows when the engine fell back to one token per step."""
+        self.tracer.event("spec_disable", lane=self.engine_lane,
+                          reason=reason[:200])
 
     def on_degrade(self, subsystem: str, level: int, reason: str) -> None:
         """The degradation ladder disabled an optional subsystem; the
@@ -610,6 +639,15 @@ class ServingMetrics:
             return None
         return self._queue_depth_sum / self._steps_local
 
+    @property
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Accepted / drafted over THIS engine's window (None until the
+        first speculative step) — the number that predicts the
+        speculative speedup."""
+        if self._spec_draft_local <= 0:
+            return None
+        return self._spec_accept_local / self._spec_draft_local
+
     # ---------------------------------------------------------- snapshot
     def snapshot(self) -> Dict[str, object]:
         """The engine-counter dict earlier rounds shipped, extended with
@@ -646,4 +684,8 @@ class ServingMetrics:
             "quarantines": self._c_quarantines.value,
             "health_state": self._g_health.value,
             "degradation_level": self._g_degradation.value,
+            # speculative decoding block (keys only ever ADD)
+            "spec_draft_tokens": self._c_spec_draft.value,
+            "spec_accepted_tokens": self._c_spec_accept.value,
+            "spec_acceptance_rate": r(self.spec_acceptance_rate),
         }

@@ -95,6 +95,11 @@ class Request:
     # are seconds RELATIVE to submission, checked host-side per step
     deadline_s: Optional[float] = None       # submit -> finish budget
     ttft_deadline_s: Optional[float] = None  # submit -> first token
+    # constrained decoding (docs/serving.md "Constrained decoding"):
+    # the only token ids this request may emit, applied as a per-slot
+    # vocab mask INSIDE the existing decode/verify programs (a traced
+    # operand — zero new compiled programs); None = unconstrained
+    allowed_tokens: Optional[np.ndarray] = None
     # engine-owned progress
     tokens: List[int] = dataclasses.field(default_factory=list)
     finished: bool = False

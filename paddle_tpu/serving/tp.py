@@ -55,12 +55,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["TP_AXIS", "build_serving_mesh", "serving_param_specs",
            "shard_model_params", "sharded_zeros", "tp_decode_supported",
-           "build_tp_decode_program"]
+           "build_tp_decode_program", "build_tp_verify_program"]
 
 # graftprog entry-point marker (see tools/analysis/compile_surface.py):
-# the TP decode program factory roots the shard_map compile unit on the
-# static manifest.  Read by the AST analysis only; zero runtime effect.
-__compile_surface_roots__ = ("build_tp_decode_program",)
+# the TP decode/verify program factories root their shard_map compile
+# units on the static manifest.  Read by the AST analysis only; zero
+# runtime effect.
+__compile_surface_roots__ = ("build_tp_decode_program",
+                             "build_tp_verify_program")
 
 # the serving TP axis IS the models' model-parallel axis: the
 # Column/RowParallelLinear layers annotate their weights over "mp"
@@ -240,11 +242,19 @@ def _norm(x, w, b, kind: str, eps: float):
     return F.layer_norm(x, (x.shape[-1],), w, b, eps)
 
 
-def _tp_layer(x_s, pk, pv, seq_pos, blk, arch, rope, axis, tp, overlap):
+def _tp_layer(x_s, pk, pv, seq_pos, blk, arch, rope, axis, tp, overlap,
+              s: int = 1):
     """One transformer layer of the per-device decode body: entry
     all-gather fused into the QKV / MLP-up dots, exit reduce-scatter
     fused into the out-proj / MLP-down dots, attention local to this
-    device's head group against its slab shard."""
+    device's head group against its slab shard.
+
+    ``s`` is the per-slot token width — 1 for the decode program, the
+    ``spec_k+1`` verify window for the speculative verify program.  Rows
+    stay flat ``[slots*s, features]`` (slot-major) through the fused
+    collective dots and fold back to ``[slots, s, ...]`` only around
+    attention, whose ragged visibility comes from ``cache_lens(pos, s)``
+    — query t of a slot's window sees keys up to ``pos + t``."""
     from ..kernels.collective_matmul import (allgather_matmul,
                                              matmul_reduce_scatter)
     from ..kernels.decode_attention import decode_attention_auto
@@ -258,22 +268,23 @@ def _tp_layer(x_s, pk, pv, seq_pos, blk, arch, rope, axis, tp, overlap):
     qkv = allgather_matmul(h1, blk["wqkv"], axis, tp, overlap=overlap)
     if blk["bqkv"] is not None:
         qkv = qkv + blk["bqkv"]
-    b = qkv.shape[0]
-    q = qkv[:, :h_l * dh].reshape(b, 1, h_l, dh)
-    k = qkv[:, h_l * dh:(h_l + kh_l) * dh].reshape(b, 1, kh_l, dh)
-    v = qkv[:, (h_l + kh_l) * dh:].reshape(b, 1, kh_l, dh)
+    rows = qkv.shape[0]
+    b = rows // s
+    q = qkv[:, :h_l * dh].reshape(b, s, h_l, dh)
+    k = qkv[:, h_l * dh:(h_l + kh_l) * dh].reshape(b, s, kh_l, dh)
+    v = qkv[:, (h_l + kh_l) * dh:].reshape(b, s, kh_l, dh)
     if rope is not None:
         from ..models.llama import apply_rotary_pos_emb
         cos, sin = rope
         q = apply_rotary_pos_emb(q, cos, sin)
         k = apply_rotary_pos_emb(k, cos, sin)
     k_buf, v_buf = append_kv(pk, pv, k, v, seq_pos)
-    lens = cache_lens(seq_pos, 1, b)
+    lens = cache_lens(seq_pos, s, b)
     rep = h_l // kh_l
     kk = jnp.repeat(k_buf, rep, axis=2) if rep > 1 else k_buf
     vv = jnp.repeat(v_buf, rep, axis=2) if rep > 1 else v_buf
-    attn = decode_attention_auto(q, kk, vv, lens)       # [B, 1, h_l, dh]
-    attn = attn.reshape(b, h_l * dh)
+    attn = decode_attention_auto(q, kk, vv, lens)       # [B, s, h_l, dh]
+    attn = attn.reshape(rows, h_l * dh)
     # ---- exit: out-proj dot with the reduce-scatter riding it
     o = matmul_reduce_scatter(attn, blk["wo"], axis, tp, overlap=overlap)
     if blk["bo"] is not None:
@@ -418,5 +429,96 @@ def build_tp_decode_program(model, mesh: Mesh, tp: int, *,
             in_specs=(specs, slab, slab, P(), P()),
             out_specs=(P(None, None, "mp"), slab, slab, P()),
             check_vma=False)(weights, ks, vs, seq_pos, last_tok)
+
+    return program
+
+
+def _tp_verify_body(weights, ks, vs, seq_pos, ids, *, arch, tp, axis,
+                    overlap, width):
+    """Per-device body of the ONE fused verify program — the decode
+    body at token width ``width`` (= spec_k+1): the ``[B, width]``
+    draft windows flatten slot-major to ``[B*width]`` rows so the same
+    fused compute-collective dots carry them, each slot's window sits
+    at its OWN ``seq_pos`` (embedding offsets, rope, and the ragged
+    ``cache_lens`` attention all take per-row position vectors), and
+    the layer seam is the SAME ``_tp_layer`` the decode program
+    compiles — the two paths cannot drift."""
+    from ..kernels.collective_matmul import allgather_matmul
+    idx = jax.lax.axis_index(axis)
+    b, s = ids.shape
+    b_l = b // tp
+    flat = ids.reshape(b * s).astype(jnp.int32)
+    wte_l = weights["wte"]                       # [V/tp, D] local rows
+    v_l = wte_l.shape[0]
+    loc = flat - idx * v_l
+    ok = (loc >= 0) & (loc < v_l)
+    emb = jnp.take(wte_l, jnp.clip(loc, 0, v_l - 1), axis=0)
+    emb = jnp.where(ok[:, None], emb, jnp.zeros((), emb.dtype))
+    x = jax.lax.psum(emb, axis)                  # [B*s, D] replicated
+    pos2d = seq_pos[:, None] + jnp.arange(s)     # [B, s] per-row offsets
+    if weights["wpe"] is not None:
+        x = x + jnp.take(weights["wpe"], pos2d.reshape(b * s), axis=0)
+    rope = None
+    if arch["rope"]:
+        from ..models.llama import _rope_tables
+        cos, sin = _rope_tables(pos2d, arch["head_dim"],
+                                arch["rope_theta"], x.dtype)
+        rope = (cos, sin)
+    # slot-shard the residual stream: this device's slot-major row chunk
+    x_s = jax.lax.dynamic_slice_in_dim(x, idx * b_l * s, b_l * s, axis=0)
+    new_ks, new_vs = [], []
+    for blk, pk, pv in zip(weights["blocks"], ks, vs):
+        x_s, kb, vb = _tp_layer(x_s, pk, pv, seq_pos, blk, arch, rope,
+                                axis, tp, overlap, s=s)
+        new_ks.append(kb)
+        new_vs.append(vb)
+    xf = _norm(x_s, weights["nfw"], weights["nfb"], arch["norm"],
+               arch["eps"])
+    head_l = weights["head"] if weights["head"] is not None else wte_l.T
+    logits = allgather_matmul(xf, head_l, axis, tp, overlap=overlap)
+    return (logits.reshape(b, s, logits.shape[-1]),
+            new_ks, new_vs, seq_pos + s)
+
+
+def build_tp_verify_program(model, mesh: Mesh, tp: int, *, width: int,
+                            overlap: bool = True):
+    """Build the fused verify program of the speculative-decoding path:
+    ``fn(ks, vs, seq_pos, ids) -> (logits, new_ks, new_vs, new_pos)``
+    with ``ids [num_slots, width]`` (each slot's last committed token
+    followed by its zero-padded draft window) and ``logits [num_slots,
+    width, vocab]`` vocab-sharded over the mesh.  NOT jitted — the
+    engine wraps it with its matched-sampling acceptance tail in the
+    single compiled verify step, so the program-set pin (ONE verify)
+    holds the same way decode's does.
+
+    Same shard_map family as ``build_tp_decode_program`` — identical
+    bundle layout, identical in/out specs modulo the width axis, the
+    layer bodies ARE ``_tp_layer`` — just at token width ``width``.
+    There is no ``pallas_block`` variant: the Pallas decode block
+    (kernels/decode_block_tp.py) is a single-token kernel, so the
+    ``tp_fused_block`` engine path verifies through THIS program and
+    keeps the Pallas block for its decode steps."""
+    from ..distributed._jax_compat import shard_map
+    from ..distributed.sharding_utils import put_global
+    if width < 2:
+        raise ValueError(f"verify width must be >= 2 (spec_k >= 1), "
+                         f"got {width}")
+    arch, weights = model.tp_decode_weights(tp)
+    specs = _bundle_specs(weights)
+    weights = jax.tree.map(
+        lambda w, s: None if w is None
+        else put_global(w, NamedSharding(mesh, s)),
+        weights, specs, is_leaf=lambda x: x is None)
+    num_layers = len(weights["blocks"])
+    body = functools.partial(_tp_verify_body, arch=arch, tp=tp,
+                             axis=TP_AXIS, overlap=overlap, width=width)
+    slab = [KV_SLAB_SPEC] * num_layers
+
+    def program(ks, vs, seq_pos, ids):
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(specs, slab, slab, P(), P()),
+            out_specs=(P(None, None, "mp"), slab, slab, P()),
+            check_vma=False)(weights, ks, vs, seq_pos, ids)
 
     return program

@@ -42,6 +42,9 @@ DEFAULT_HOT_PATHS = (
     # basename for fixture-rooted library tests)
     "tests/fixtures/lint/compile_surface_*.py",
     "compile_surface_*.py",
+    # speculative-decoding fixtures (ISSUE 18)
+    "tests/fixtures/lint/spec_*.py",
+    "spec_*.py",
 )
 
 # cheap token gate: a file without any of these cannot host a compile

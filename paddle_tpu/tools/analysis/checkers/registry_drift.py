@@ -178,6 +178,11 @@ ALLOWLIST: Dict[str, str] = {
         # ops; contract = tests/test_zz_aot_serving.py
         "AOTStore", "AOTStoreWriter", "AOTStoreError",
         "build_engine_store", "engine_aot_context", "aot_fingerprint",
+        # speculative decoding (ISSUE 18): the host-side n-gram draft
+        # table and the shard_map verify-program factory — draft
+        # control plane + sharding plumbing, not array ops; contract =
+        # tests/test_zz_spec_serving.py
+        "NGramDraftTable", "build_tp_verify_program",
     )},
     # ---- paddle_tpu.obs public surface (the OBS registry surface:
     #      counters/gauges/histograms and the span tracer are telemetry

@@ -10,7 +10,8 @@ Usage:
                                        [--prefill-chunk 16]
                                        [--block-len 16]
                                        [--tensor-parallel 1]
-                                       [--fused-decode] [--seed 0]
+                                       [--fused-decode] [--spec-k 0]
+                                       [--seed 0]
     python scripts/aot_build.py verify <store>
     python scripts/aot_build.py gc     <store>
 
@@ -59,7 +60,8 @@ def _build_engine(ns):
                       prefill_chunk=ns.prefill_chunk,
                       block_len=ns.block_len,
                       tensor_parallel=ns.tensor_parallel,
-                      fused_decode=ns.fused_decode)
+                      fused_decode=ns.fused_decode,
+                      spec_k=ns.spec_k)
 
 
 def _cmd_build(ns):
@@ -95,6 +97,14 @@ def _verify_missing(store, plane):
         elif counter == "decode":
             if not any(n.startswith("decode:") for n in programs):
                 missing.append("decode:<path>")
+        elif counter == "verify":
+            # the static plane always carries verify (the program
+            # exists in the source); a store built spec_k=0 owes no
+            # verify artifact, one built spec_k>0 must hold it
+            if not store.context.get("spec_k"):
+                continue
+            if not any(n.startswith("verify:") for n in programs):
+                missing.append("verify:<path>")
         elif counter not in covered:
             missing.append(counter)
     return missing
@@ -179,6 +189,9 @@ def main(argv=None):
     b.add_argument("--block-len", type=int, default=16)
     b.add_argument("--tensor-parallel", type=int, default=1)
     b.add_argument("--fused-decode", action="store_true")
+    b.add_argument("--spec-k", type=int, default=0,
+                   help="speculative draft length; > 0 additionally "
+                        "exports the ONE batched verify program")
     b.add_argument("--seed", type=int, default=0)
     b.set_defaults(fn=_cmd_build)
 

@@ -54,10 +54,21 @@ resolved, pools at baseline on winner AND loser, attempts <= 2) plus
 replay parity — the hedged client streams match a hedging-OFF fleet
 token-for-token with strictly sequential positions.
 
+``--spec`` switches to the ISSUE 18 speculative-decoding shape: a
+2-replica fleet serving chat-shaped cyclic prompts with per-slot
+n-gram drafting armed (``--spec-k``), and a ``spec_verify`` fault
+burst on replica 0 whose degradation ladder disables speculation
+mid-run.  The verdict is ``spec.json``: fleet-ledger conservation
+with speculation armed (every request terminal, pools at baseline),
+drafting actually exercised fleet-wide, the victim serving on under
+``spec_bypass`` — and token parity against a never-speculating oracle
+fleet, since matched sampling makes speculation (and its disable)
+invisible in tokens.
+
 Usage:
     python scripts/fleet_chaos_smoke.py --out /tmp/fleet [--site step]
         [--at 2] [--times 3] [--requests 6] [--slots 2]
-        [--disaggregated | --crash | --straggler]
+        [--disaggregated | --crash | --straggler | --spec]
 
 The script FAILS (exit 1) if the verdict is not ok or the fault never
 fired — tests/test_zz_fleet_serving.py and
@@ -377,6 +388,125 @@ def run_straggler(args) -> int:
     return 0 if ok else 1
 
 
+def run_spec(args) -> int:
+    """The ``--spec`` scenario (ISSUE 18): a 2-replica fleet with
+    speculative decoding armed on BOTH replicas, a ``spec_verify``
+    fault burst on replica 0 forcing its degradation ladder to disable
+    speculation mid-run.  The verdict (spec.json) is fleet-ledger
+    conservation WITH speculation armed: every request terminal with a
+    reason, pools at baseline, drafting actually happened fleet-wide,
+    the victim kept serving under ``spec_bypass``, and every token
+    stream matches a never-speculating oracle fleet — matched sampling
+    makes both speculation and its mid-run disable invisible in
+    tokens."""
+    import numpy as np
+    import paddle_tpu
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.obs import MetricsRegistry, Tracer
+    from paddle_tpu.serving import (FaultInjector, FaultToleranceConfig,
+                                    Router, ServingEngine)
+
+    def model():
+        paddle_tpu.seed(7)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        return m
+
+    # chat-shaped cyclic prompts: the per-slot n-gram tables must
+    # actually draft, or the run proves nothing about speculation
+    rs = np.random.RandomState(0)
+    prompts = [np.tile(rs.randint(0, 256, (3,)), 6)
+               for _ in range(args.requests)]
+
+    def run(spec_k, faults):
+        registry, tracer = MetricsRegistry(), Tracer()
+        ft = FaultToleranceConfig(max_step_retries=2,
+                                  backoff_base_s=0.0,
+                                  ladder_threshold=2)
+        replicas = [
+            ServingEngine(model(), num_slots=args.slots, min_bucket=8,
+                          block_len=8, spec_k=spec_k,
+                          fault_tolerance=ft, registry=registry,
+                          tracer=tracer,
+                          faults=faults if i == 0 else None)
+            for i in range(2)]
+        router = Router(replicas, registry=registry, tracer=tracer)
+        half = max(len(prompts) // 2, 1)
+        fids = [router.submit(p, max_new_tokens=args.max_new_tokens)
+                for p in prompts[:half]]
+        router.step()
+        if faults is not None:
+            # arm from the victim's FIRST speculating step: the fault
+            # fires before dispatch and the step retries, so two
+            # consecutive hits reach the ladder threshold even on a
+            # small burst
+            faults.enable("spec_verify", times=max(args.times, 2))
+        try:
+            fids += [router.submit(p,
+                                   max_new_tokens=args.max_new_tokens)
+                     for p in prompts[half:]]
+            router.run_until_complete(max_steps=10000)
+        finally:
+            if faults is not None:
+                faults.disable("spec_verify")
+        return router, registry, fids, replicas
+
+    faults = FaultInjector()
+    router, registry, fids, replicas = run(args.spec_k, faults)
+    # never-speculating oracle, same weights/order: greedy determinism
+    # makes its streams the parity reference (routing may differ — a
+    # greedy request's tokens depend only on its prompt and weights)
+    oracle, _, ofids, _ = run(0, None)
+
+    acc = router.accounting()
+    victim = replicas[0]
+    parity = True
+    requests = []
+    for fid, ofid in zip(fids, ofids):
+        got = list(router.result(fid).tokens)
+        want = list(oracle.result(ofid).tokens)
+        ok = got == want
+        parity &= ok
+        requests.append({"fleet_id": fid, "parity": ok,
+                         "tokens": len(got),
+                         "status": router.result(fid).status})
+
+    def counter(name):
+        inst = registry.get(name)
+        return 0 if inst is None else inst.value
+
+    drafted = counter("spec.draft_tokens")
+    accepted = counter("spec.accepted_tokens")
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "metrics.prom"), "w") as f:
+        f.write(registry.prometheus())
+    ok = bool(acc["ok"] and parity and drafted > 0
+              and accepted >= 0
+              and faults.fired["spec_verify"] >= 2   # ladder threshold
+              and victim.core.spec_bypass
+              and "spec_verify" in victim.degraded_subsystems)
+    verdict = {
+        "site": "spec_verify",
+        "ok": ok,
+        "fired": faults.fired["spec_verify"],
+        "spec_k": args.spec_k,
+        "spec_draft_tokens": drafted,
+        "spec_accepted_tokens": accepted,
+        "victim_spec_bypass": bool(victim.core.spec_bypass),
+        "victim_fallback_reason": victim.spec_fallback_reason,
+        "replay_parity": bool(parity),
+        "all_terminal": acc["all_terminal"],
+        "pools_at_baseline": acc["pools_at_baseline"],
+        "requests": requests,
+        "replicas": [{"health": r["health"], "ok": r["ok"]}
+                     for r in acc["replicas"]],
+    }
+    with open(os.path.join(args.out, "spec.json"), "w") as f:
+        json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fleet_chaos_smoke",
                                  description=__doc__)
@@ -409,10 +539,20 @@ def main(argv=None) -> int:
                          "point, queued deadline requests hedged onto "
                          "replica 1, parity vs a hedging-off fleet — "
                          "emits the straggler.json verdict")
+    ap.add_argument("--spec", action="store_true",
+                    help="2-replica fleet with speculative decoding "
+                         "armed: a spec_verify burst ladder-disables "
+                         "speculation on replica 0 mid-run; asserts "
+                         "ledger conservation + parity vs a never-"
+                         "speculating fleet — emits the spec.json "
+                         "verdict")
+    ap.add_argument("--spec-k", type=int, default=3,
+                    help="draft length for --spec (default 3)")
     args = ap.parse_args(argv)
-    if sum((args.crash, args.disaggregated, args.straggler)) > 1:
-        ap.error("--crash, --disaggregated and --straggler are "
-                 "separate scenarios")
+    if sum((args.crash, args.disaggregated, args.straggler,
+            args.spec)) > 1:
+        ap.error("--crash, --disaggregated, --straggler and --spec "
+                 "are separate scenarios")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import paddle_tpu
@@ -429,6 +569,8 @@ def main(argv=None) -> int:
         return run_crash(args)
     if args.straggler:
         return run_straggler(args)
+    if args.spec:
+        return run_spec(args)
     handoff_site = args.site.startswith("handoff_") \
         or args.site == "replica_spawn"
 

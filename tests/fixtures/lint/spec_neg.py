@@ -1,0 +1,62 @@
+"""Negative fixture for the compile-surface rule: the engine's
+speculative-decoding idiom — ONE memoized fixed-shape verify program
+(trace-counter tick, ``is None`` factory guard) fed by a pure-host
+draft table, decode as the named fallback.  Zero findings: the draft
+loop is host Python (no jit in sight), and both programs are memoized
+factory builds keyed off nothing data-dependent.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__compile_surface_roots__ = ("SpecEngine",)
+
+SPEC_K = 4
+NUM_SLOTS = 8
+
+
+class SpecEngine:
+    def __init__(self):
+        self._decode_fn = None
+        self._verify_fn = None
+        self.trace_counts = {"decode": 0, "verify": 0}
+        self._tables = [dict() for _ in range(NUM_SLOTS)]
+
+    # pure-host draft phase: dictionary lookups, no device code
+    def propose(self, last_tokens):
+        drafts = []
+        for slot, last in enumerate(last_tokens):
+            row, cur = [], last
+            for _ in range(SPEC_K):
+                nxt = self._tables[slot].get(cur)
+                if nxt is None:
+                    break
+                row.append(nxt)
+                cur = nxt
+            drafts.append(row)
+        return drafts
+
+    def _build_verify(self):
+        def verify(ids, drafts):
+            self.trace_counts["verify"] += 1
+            window = jnp.concatenate([ids[:, None], drafts], axis=1)
+            return window.sum(axis=1)
+
+        return jax.jit(verify, donate_argnums=(1,))
+
+    def verify_step(self, ids, drafts):
+        # the ONE batched program: fixed [NUM_SLOTS, SPEC_K] drafts,
+        # memoized behind the factory guard
+        if self._verify_fn is None:
+            self._verify_fn = self._build_verify()
+        return self._verify_fn(ids, drafts)
+
+    def decode_step(self, ids):
+        # the named fallback when no slot proposed anything
+        if self._decode_fn is None:
+            def decode(xs):
+                self.trace_counts["decode"] += 1
+                return xs + 1
+
+            self._decode_fn = jax.jit(decode)
+        return self._decode_fn(ids)

@@ -1,0 +1,56 @@
+"""Positive fixture for the compile-surface rule: the speculative-
+decoding anti-patterns the fixed-shape verify program exists to avoid.
+
+Exactly three findings:
+  * ERROR  — ``verify_ragged``: the host draft length (a data-dependent
+             Python int) feeds a static jit argument, so every distinct
+             acceptance pattern keys a NEW verify program (unbounded
+             static-key space);
+  * WARNING — ``verify_per_slot``: a verify jit constructed inside the
+             per-slot loop without a memoization idiom (per-iteration
+             program growth — the batched engine dispatches ONE program
+             over all slots instead);
+  * WARNING — ``_orphan_verify``: a verify unit no registered entry
+             point reaches (dead program).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__compile_surface_roots__ = ("verify_ragged", "verify_per_slot")
+
+
+def _verify_impl(ids, k):
+    return ids[:, : k + 1].sum(axis=1)
+
+
+_verify = jax.jit(_verify_impl, static_argnums=(1,))
+
+
+def verify_ragged(ids, draft_len):
+    # ERROR: int(draft_len.max()) is data-dependent — the verify window
+    # must be the FIXED shape [num_slots, spec_k+1], not the step's
+    # actual longest draft
+    return _verify(ids, int(draft_len.max()))
+
+
+def _slot_verify(k, row):
+    return row * k
+
+
+def verify_per_slot(rows):
+    outs = []
+    for k, row in enumerate(rows):
+        f = jax.jit(functools.partial(_slot_verify, k))  # WARNING: loop
+        outs.append(f(row))
+    return outs
+
+
+def _impl(ids):
+    return ids + 1
+
+
+def _orphan_verify(ids):
+    return jax.jit(_impl)(ids)   # WARNING: dead program (never rooted)

@@ -1308,6 +1308,33 @@ def test_compile_surface_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+# ------------------------------------------ spec fixtures (ISSUE 18)
+
+def test_spec_compile_surface_positive():
+    """The speculative anti-patterns: a ragged verify keyed on the
+    host draft length (unbounded static-key space — error), a per-slot
+    verify jit in the loop and an unrooted verify unit (warnings)."""
+    res = run_rule("spec_pos.py", "compile-surface")
+    found = only_rule(res, "compile-surface")
+    assert len(found) == 3, [f.format() for f in res.findings]
+    errors = [f for f in found if f.severity == "error"]
+    warns = [f for f in found if f.severity == "warning"]
+    assert len(errors) == 1 and len(warns) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "unbounded static-key space" in msgs
+    assert "inside a loop" in msgs
+    assert "dead program" in msgs
+    assert {dict(f.props)["key_space"] for f in errors} == {"unbounded"}
+
+
+def test_spec_compile_surface_negative():
+    """The engine's actual speculative idiom — pure-host draft table,
+    ONE memoized fixed-shape verify with a trace-counter tick, decode
+    as the named fallback — stays silent."""
+    res = run_rule("spec_neg.py", "compile-surface")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
 def test_cli_manifest_deterministic_and_pinned():
     """``--manifest`` emits byte-identical JSON across runs, and the
     EngineCore plane IS the pinned program set: bucketed prefill + ONE
@@ -1324,13 +1351,17 @@ def test_cli_manifest_deterministic_and_pinned():
     m = json.loads(a.stdout)
     assert m["graftprog_version"] == 1
     plane = m["planes"]["paddle_tpu.serving.engine.EngineCore"]
-    assert set(plane) == {"prefill", "decode", "gather", "scatter"}
+    assert set(plane) == {"prefill", "decode", "verify", "gather",
+                          "scatter"}
     assert plane["decode"]["upper_bound"] == "1"
+    assert plane["verify"]["upper_bound"] == "1"
     assert plane["gather"]["upper_bound"] == "1"
     assert plane["scatter"]["upper_bound"] == "1"
     assert plane["prefill"]["key_space"] == "bucketed"
-    # the two decode VARIANTS (composed + fused) share one holder slot
+    # the two decode VARIANTS (composed + fused) share one holder slot;
+    # same for the two verify variants (composed + tp shard_map)
     assert plane["decode"]["holders"] == ["_decode_fn"]
+    assert plane["verify"]["holders"] == ["_verify_fn"]
     # schema smoke over every program record (satellite: --manifest is
     # covered next to the SARIF smoke)
     assert m["programs"], "empty program list"

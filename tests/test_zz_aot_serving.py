@@ -141,8 +141,8 @@ def test_warm_engine_compiles_nothing_and_matches_traced(leg, stores):
         warm_tokens, warm_obs = _run(eng)
     finally:
         store.close()
-    assert warm_obs == {"prefill": 0, "decode": 0, "gather": 0,
-                        "scatter": 0}, (
+    assert warm_obs == {"prefill": 0, "decode": 0, "verify": 0,
+                        "gather": 0, "scatter": 0}, (
         f"[{leg}] warm engine traced: {warm_obs}")
     assert warm_tokens == traced_tokens, (
         f"[{leg}] warm tokens diverged from traced")
@@ -430,3 +430,69 @@ def test_aot_build_cli_roundtrip(tmp_path):
         f.write(b"leftover from a crashed build")
     assert main(["gc", path]) == 0
     assert not os.path.exists(garbage)
+
+
+# ------------------------------------------- speculative decoding (18)
+
+def _run_spec(eng):
+    """The shared workload plus one cyclic prompt the n-gram tables can
+    draft from, so the verify program actually dispatches."""
+    tokens, _ = _run(eng)
+    r = eng.submit(np.tile([5, 6, 7, 8], 8), max_new_tokens=8)
+    eng.run_until_complete(200)
+    out = eng.result(r)
+    assert out.finished
+    tokens.append(tuple(out.tokens))
+    observed = dict(eng.core.trace_counts)
+    observed.update(eng.core.block_pool.trace_counts)
+    return tokens, observed
+
+
+def test_warm_spec_engine_compiles_nothing_and_matches_traced(
+        tmp_path_factory, manifest):
+    """ISSUE 18: a store built with speculation on carries the verify
+    leg; a warm spec engine ticks ZERO trace counters — verify included
+    — while drafting (acceptance > 0) and staying token-identical to a
+    traced spec engine."""
+    kw = dict(ENGINE_KW, spec_k=3)
+    core = EngineCore(_fresh_gpt(), **kw)
+    assert core.spec_on
+    path = str(tmp_path_factory.mktemp("aot_spec"))
+    build_engine_store(path, core, manifest=manifest)
+
+    traced_tokens, traced_obs = _run_spec(
+        ServingEngine(_fresh_gpt(), **kw))
+    assert traced_obs["verify"] == 1      # the cold leg really traced
+
+    store = AOTStore.open(path)
+    try:
+        assert any(n.startswith("verify:") for n in store.programs())
+        eng = ServingEngine(_fresh_gpt(), aot_store=store, **kw)
+        assert eng.aot_status == "warm", eng.aot_status
+        warm_tokens, warm_obs = _run_spec(eng)
+    finally:
+        store.close()
+    assert warm_obs == {"prefill": 0, "decode": 0, "verify": 0,
+                        "gather": 0, "scatter": 0}, (
+        f"warm spec engine traced: {warm_obs}")
+    assert warm_tokens == traced_tokens, (
+        "warm spec tokens diverged from traced")
+    snap = eng.metrics.snapshot()
+    assert snap["spec_draft_tokens"] > 0
+    assert _counter(eng, "aot.fallbacks") == 0
+    acc = replica_accounting(eng)
+    assert acc["ok"], acc
+
+
+def test_specless_store_refuses_spec_engine(stores):
+    """A store built WITHOUT speculation (spec_k=0 context) cannot warm
+    a speculating engine — the fingerprint disagrees, so the engine
+    serves traced ("skew") rather than half-loading a plane with no
+    verify leg."""
+    store = AOTStore.open(stores["tp1"])
+    try:
+        eng = ServingEngine(_fresh_gpt(), aot_store=store,
+                            spec_k=3, **ENGINE_KW)
+        assert eng.aot_status == "skew"
+    finally:
+        store.close()

@@ -841,3 +841,50 @@ def test_fault_events_land_in_obs(gpt):
     m = eng.metrics_dict()
     assert m["quarantines"] == 1 and m["health_state"] == 0.0
     assert_accounting(eng, rids)
+
+
+# ------------------------------------------- speculative decoding (18)
+
+def test_spec_verify_fault_ladder_disables_speculation(gpt):
+    """ISSUE 18: ``spec_verify`` faults feed the degradation ladder; at
+    threshold speculation is disabled ENGINE-LIFETIME and the engine
+    keeps serving one committed token per step.  Matched sampling makes
+    the mid-run disable invisible in tokens — the stream stays
+    token-for-token ``generate()`` even though some of it was committed
+    by the verify program and the rest by plain decode."""
+    eng, faults = make_engine(gpt, spec_k=3)
+    assert eng.core.spec_on and eng.spec_fallback_reason is None
+    eng.tracer.enable()
+    # cyclic prompts: the per-slot n-gram tables propose from step one,
+    # so the speculative phase (and its fault point) actually runs
+    prompts = [np.tile([5, 6, 7, 8], 6), np.tile([9, 10, 11], 8),
+               np.tile([3, 4], 10)]
+    faults.enable("spec_verify", times=2)     # == ladder threshold
+    try:
+        rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run_until_complete(300)
+    finally:
+        faults.disable("spec_verify")
+        eng.tracer.disable()
+    assert faults.fired["spec_verify"] == 2
+    assert "spec_verify" in eng.degraded_subsystems
+    assert eng.core.spec_bypass and not eng.spec_on
+    assert eng.spec_fallback_reason.startswith("degraded:")
+    assert eng.health.state == "degraded"
+    assert {"fault", "degrade", "spec_disable"} <= \
+        {e[0] for e in eng.tracer.events()}
+    for rid, p in zip(rids, prompts):
+        out = eng.result(rid)
+        assert out.status == "finished"
+        np.testing.assert_array_equal(out.tokens, _want(gpt, p, 8))
+    assert_accounting(eng, rids)
+    m = eng.metrics_dict()
+    assert m["degradation_level"] == 1
+    # engine-lifetime: a fresh cyclic prompt drafts NOTHING after the
+    # rung applies — the draft counter stays where the disable left it
+    drafted = m["spec_draft_tokens"]
+    r = eng.submit(np.tile([7, 8, 9], 8), max_new_tokens=6)
+    eng.run_until_complete(200)
+    assert eng.result(r).status == "finished"
+    assert eng.metrics_dict()["spec_draft_tokens"] == drafted
+    assert_accounting(eng, rids + [r])

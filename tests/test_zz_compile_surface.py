@@ -87,8 +87,9 @@ def _check_against_plane(plane, observed, leg):
             f"manifest has no such program on the {ENGINE_PLANE} plane "
             f"(static analysis missed a compile unit); manifest "
             f"counters: {sorted(plane)}")
-    # and the static upper bounds hold: ONE decode / gather / scatter
-    for counter in ("decode", "gather", "scatter"):
+    # and the static upper bounds hold: ONE decode / verify / gather /
+    # scatter
+    for counter in ("decode", "verify", "gather", "scatter"):
         entry = plane[counter]
         assert entry["upper_bound"] == "1", (
             f"[{leg}] manifest bound for '{counter}' is "
@@ -113,14 +114,17 @@ def _check_against_plane(plane, observed, leg):
 
 def test_plane_is_the_pinned_program_set(engine_plane):
     """The static side of the pin: the EngineCore plane holds exactly
-    the four counters, with ONE-program bounds on decode/gather/scatter
-    and a bucketed prefill."""
-    assert set(engine_plane) == {"prefill", "decode", "gather",
-                                 "scatter"}, (
+    the five counters, with ONE-program bounds on
+    decode/verify/gather/scatter and a bucketed prefill."""
+    assert set(engine_plane) == {"prefill", "decode", "verify",
+                                 "gather", "scatter"}, (
         f"plane counters drifted: {sorted(engine_plane)}")
     # both decode VARIANTS (composed + fused) share one holder — the
-    # manifest proves at most one compiles per process
+    # manifest proves at most one compiles per process; same for the
+    # verify variants (composed + tp shard_map)
     assert engine_plane["decode"]["holders"] == ["_decode_fn"]
+    assert engine_plane["verify"]["holders"] == ["_verify_fn"]
+    assert engine_plane["verify"]["upper_bound"] == "1"
 
 
 def test_leg_tp1_composed(engine_plane):
@@ -140,3 +144,21 @@ def test_leg_tp2_composed(engine_plane):
     eng, observed = _run_leg(tensor_parallel=2)
     _check_against_plane(engine_plane, observed, "tp2-composed")
     assert observed["gather"] == 1 and observed["scatter"] == 1
+
+
+def test_leg_tp1_spec(engine_plane):
+    """Speculation on (ISSUE 18): a cyclic prompt guarantees the n-gram
+    table proposes, so the verify program dispatches — and still traces
+    exactly ONCE alongside the one decode (steps where nothing was
+    proposed fall back to it)."""
+    eng, observed = _run_leg(spec_k=3)
+    assert eng.core.spec_on and eng.spec_fallback_reason is None
+    r = eng.submit(np.tile([5, 6, 7, 8], 8), max_new_tokens=8)
+    eng.run_until_complete(100)
+    assert eng.result(r).finished
+    observed = dict(eng.core.trace_counts)
+    observed.update(eng.core.block_pool.trace_counts)
+    _check_against_plane(engine_plane, observed, "tp1-spec")
+    assert observed["verify"] == 1, (
+        f"expected exactly one verify trace, got {observed.get('verify')}")
+    assert eng.metrics.snapshot()["spec_draft_tokens"] > 0
