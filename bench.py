@@ -51,18 +51,65 @@ HBM_BW_BY_GEN = {"v5e": 819e9, "v5p": 2765e9, "v4": 1228e9}
 
 
 def decode_bw_util(tps, b, prompt, new, n_params, layers, hidden, bpe,
-                   gen="v5e"):
+                   gen="v5e", kv_tok=None):
     """HBM bandwidth utilization of a decode step: per step the chip
     reads every weight once (batch amortizes it) plus each sequence's
     live KV prefix, and writes one KV entry per layer.  Decode is
     bandwidth-bound, so this — not MFU — is the honest efficiency
-    metric (VERDICT r4 item 8)."""
+    metric (VERDICT r4 item 8).
+
+    ``kv_tok`` is the KV bytes per cached token per sequence — callers
+    with the graftmem capacity manifest (ISSUE 19) pass its
+    ``kv_tier.kv_bytes_per_token`` figure so this projection and the
+    static byte accounting can never drift apart; the inline fallback
+    is the MHA closed form (k+v per layer at the cache dtype)."""
     hbm_bw = HBM_BW_BY_GEN.get(gen, 819e9)
     avg_ctx = prompt + new / 2
-    kv_read = 2 * layers * avg_ctx * hidden * bpe
-    kv_write = 2 * layers * hidden * bpe
+    if kv_tok is None:
+        kv_tok = 2 * layers * hidden * bpe
+    kv_read = avg_ctx * kv_tok
+    kv_write = kv_tok
     bytes_per_step = n_params * bpe + b * (kv_read + kv_write)
     return round(bytes_per_step * (tps / b) / hbm_bw, 4)
+
+
+_GRAFTMEM_CACHE = []
+
+
+def _graftmem_manifest():
+    """The graftmem HBM capacity manifest (tools/analysis/memory.py),
+    built once per process through the same library entry point the
+    CLI's ``--memory`` uses.  The manifest's reference environment IS
+    the flagship decode shape, so its bytes-per-element table and
+    KV-bytes-per-token figure are the single source of truth for the
+    bandwidth rows.  ``None`` when the analysis cannot run — every
+    consumer keeps its inline fallback."""
+    if not _GRAFTMEM_CACHE:
+        try:
+            from paddle_tpu.tools.analysis import \
+                build_memory_manifest_for_paths
+            root = os.path.dirname(os.path.abspath(__file__))
+            scope = [os.path.join(root, p)
+                     for p in ("paddle_tpu", "bench.py", "scripts")]
+            cache = os.path.join(root, ".graftlint_cache", "parse.pkl")
+            _GRAFTMEM_CACHE.append(build_memory_manifest_for_paths(
+                scope, root=root, cache_path=cache))
+        except Exception:
+            _GRAFTMEM_CACHE.append(None)
+    return _GRAFTMEM_CACHE[0]
+
+
+def _graftmem_decode_bytes(dtype_name):
+    """(bytes_per_elt, kv_bytes_per_token) for the flagship decode rows,
+    read from the capacity manifest; (None, None) without one."""
+    mem = _graftmem_manifest()
+    if not mem:
+        return None, None
+    bpe = (mem.get("byte_semantics") or {}).get(
+        "itemsize_bytes", {}).get(dtype_name)
+    kv_tok = (mem.get("kv_tier") or {}).get(
+        "kv_bytes_per_token", {}).get(dtype_name)
+    return bpe, kv_tok
 
 
 def decode_path_info(model, batch, kv_len, tp=1, spec_k=0,
@@ -126,10 +173,15 @@ def decode_bw_projection(evidence_path=None):
     ecfg = GPTConfig(vocab_size=fd["vocab"], hidden_size=fd["hidden"],
                      num_layers=fd["layers"], num_heads=fd["heads"],
                      max_seq_len=fd["max_seq"], dtype=fd["dtype"])
+    # bytes/elt and KV bytes/token come from the graftmem capacity
+    # manifest when available (ISSUE 19) — the same figures the static
+    # memory pin proves — with the jnp itemsize as inline fallback
+    man_bpe, man_kv_tok = _graftmem_decode_bytes(str(ecfg.dtype))
     util = decode_bw_util(
         float(ev_tps), fd["batch"], fd["prompt"], fd["new"],
         ecfg.num_params(), ecfg.num_layers, ecfg.hidden_size,
-        jnp.dtype(ecfg.dtype).itemsize, "v5e")
+        man_bpe or jnp.dtype(ecfg.dtype).itemsize, "v5e",
+        kv_tok=man_kv_tok)
     # pre-ISSUE-7 evidence rows carry no decode_path key: they predate
     # the fused kernel, so "unfused" is the truthful default
     ev_path = ev_row.get("decode_path") or "unfused (pre-decode_block)"
@@ -644,12 +696,16 @@ def _secondary_benches(smoke=False):
     bw_util, bw_note = None, None
     if decode_tps and not smoke:
         # weights and KV cache both live in dcfg.dtype (init_cache
-        # defaults to cfg.dtype; the model was .to()'d above)
+        # defaults to cfg.dtype; the model was .to()'d above); bytes/elt
+        # and KV bytes/token read from the graftmem capacity manifest
+        # when available (ISSUE 19), inline closed form as fallback
+        man_bpe, man_kv_tok = _graftmem_decode_bytes(str(dcfg.dtype))
         bw_util = decode_bw_util(
             decode_tps, db, dprompt, dnew, dcfg.num_params(),
             dcfg.num_layers, dcfg.hidden_size,
-            jnp.dtype(dcfg.dtype).itemsize,
-            os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"))
+            man_bpe or jnp.dtype(dcfg.dtype).itemsize,
+            os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"),
+            kv_tok=man_kv_tok)
     elif smoke:
         # a CPU smoke has no HBM figure — rather than silently dropping
         # the metric, project it from the committed v5e hardware run
@@ -2016,6 +2072,23 @@ def _serving_prefix_bench(model, smoke=False):
     hit_ttft_ms = (round(1e3 * sum(hit_ttfts) / len(hit_ttfts), 2)
                    if hit_ttfts else None)
     saved = 1.0 - m["prefill_tokens"] / max(moff["prefill_tokens"], 1)
+    # direction-3 preview (ISSUE 19): how many prefix-cache blocks fit
+    # residence per chip at each KV dtype, straight from the graftmem
+    # capacity manifest — int8 KV doubles what this bench's radix cache
+    # can keep resident
+    cap_note = None
+    mem = _graftmem_manifest()
+    if mem and mem.get("kv_tier"):
+        kv = mem["kv_tier"]
+        blocks = kv["max_resident_blocks"].get("v5e", {})
+        if blocks.get("bfloat16") and blocks.get("int8"):
+            cap_note = (
+                f"graftmem capacity manifest (v5e HBM, flagship shape): "
+                f"{blocks['bfloat16']} resident blocks at bf16 KV vs "
+                f"{blocks['int8']} at int8 "
+                f"({kv['bytes_per_block']['bfloat16']} vs "
+                f"{kv['bytes_per_block']['int8']} B/block) — int8 KV "
+                f"doubles prefix-cache residency (ROADMAP direction 3)")
     return {
         "requests": n_reqs,
         "num_slots": slots,
@@ -2036,6 +2109,7 @@ def _serving_prefix_bench(model, smoke=False):
         "ttft_p99_ms_cache_off": moff["ttft_p99_ms"],
         "wall_s": round(wall, 2),
         "wall_s_cache_off": round(off_wall, 2),
+        "capacity_note": cap_note,
         "config": (f"slots{slots}-reqs{n_reqs}-prefix{pref_len}"
                    f"-suffix{suf_len}-block{block_len}-chunk{chunk}"),
     }

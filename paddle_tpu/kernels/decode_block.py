@@ -87,6 +87,11 @@ _NEG_INF = float("-inf")
 # per-block cap, scaled to a whole-layer working set)
 VMEM_BUDGET = 12 * 1024 * 1024
 
+# graftmem marker (tools/analysis/memory.py): the memory-budget rule
+# re-derives this plan's per-grid-step working set through an integer
+# mirror and proves every reference tiling fits VMEM_BUDGET
+__vmem_plans__ = ("plan_decode_block",)
+
 _ROT_CACHE = {}
 
 

@@ -72,6 +72,11 @@ __all__ = ["plan_decode_block_tp", "ring_entry_matmul",
            "ring_exit_matmul", "decode_block_attn_tp",
            "tp_fused_block_layer"]
 
+# graftmem marker (tools/analysis/memory.py): the memory-budget rule
+# re-derives this plan's working set and checks it against the budget
+# imported from decode_block (resolved statically through the import)
+__vmem_plans__ = ("plan_decode_block_tp",)
+
 
 # ======================================================== planning / legality
 

@@ -82,6 +82,23 @@ __all__ = ["EngineCore", "sample_rows", "finite_or_sentinel",
 # the AST analysis only; zero runtime effect.
 __compile_surface_roots__ = ("EngineCore",)
 
+# graftmem (tools/analysis/memory.py) byte declarations: the engine
+# plane's persistent device state OUTSIDE the derived pool slabs, as
+# closed-form byte formulas over capacity fields.  ``row_state`` legs
+# are the per-slot decode vectors ``_build_device_plane`` allocates
+# (last token i32, PRNG key pair u32x2, sampling params bool+f32+i32+f32,
+# logit mask bool[vocab]); ``staging`` is the single-slot prefill cache
+# (per-layer k+v at the model dtype).  Pure data, read by the AST
+# analysis and pinned against runtime measurement by
+# tests/test_zz_memory_surface.py; zero runtime effect.
+__memory_bytes__ = {
+    "row_state._last_tok": "4 * num_slots",
+    "row_state._keys": "8 * num_slots",
+    "row_state._sampling_dev": "13 * num_slots",
+    "row_state._mask_dev": "num_slots * vocab_size",
+    "staging": "2 * num_layers * max_seq * kv_heads * head_dim * itemsize",
+}
+
 # token-readback encoding of the device-side health check: a decode row
 # whose logits hold a non-finite value reads back as this instead of a
 # token id (ids are always >= 0, so the sentinel is unambiguous) — the

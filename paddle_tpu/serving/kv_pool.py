@@ -26,6 +26,12 @@ from ..models.kv_cache import gather_block_rows, scatter_block_rows
 
 __all__ = ["KVPool", "BlockPool"]
 
+# graftmem marker (tools/analysis/memory.py): every slab extent in the
+# pool constructors below must flow from registered capacity fields —
+# the derived blocks-per-row ratio is declared here so the capacity
+# manifest can name it alongside the constructor parameters
+__memory_capacity_fields__ = ("blocks_per_row",)
+
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _adopt_row(buf, row, slot):

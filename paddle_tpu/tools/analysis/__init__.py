@@ -13,7 +13,11 @@ sharding-consistency rule families.  v4 adds graftprog
 (``compile_surface.py`` + ``entrypoints.py``): whole-program
 compile-surface enumeration from registered entry points, the
 ``compile-surface`` rule, and the AOT program manifest
-(``scripts/graftlint.py --manifest``).
+(``scripts/graftlint.py --manifest``).  v5 adds graftmem
+(``memory.py``): static HBM/VMEM byte accounting over the graftshape
+domain — pool-slab formulas, VMEM plan mirrors checked against declared
+budgets, the ``memory-budget`` rule, and the HBM capacity manifest
+(``scripts/graftlint.py --memory``).
 
 Entry points:
   * ``python scripts/graftlint.py`` — the CLI (default scope:
@@ -41,6 +45,11 @@ from .compile_surface import (CompileUnit, Surface, build_manifest,
                               surface_for)
 from .entrypoints import (compile_surface_root, entry_point_fingerprint,
                           register_entry_point, registered_entry_points)
+from .memory import (PLAN_MIRRORS, REFERENCE_ENV, REFERENCE_TILINGS,
+                     build_memory_manifest, build_memory_manifest_for_paths,
+                     eval_formula, itemsize_bytes, memory_fingerprint,
+                     memory_surface_for, register_byte_signature,
+                     register_capacity_field)
 
 __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "Suppressions", "AnalysisResult", "FileContext", "run_analysis",
@@ -52,4 +61,9 @@ __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "CompileUnit", "Surface", "build_manifest",
            "build_manifest_for_paths", "build_surface", "surface_for",
            "compile_surface_root", "entry_point_fingerprint",
-           "register_entry_point", "registered_entry_points"]
+           "register_entry_point", "registered_entry_points",
+           "PLAN_MIRRORS", "REFERENCE_ENV", "REFERENCE_TILINGS",
+           "build_memory_manifest", "build_memory_manifest_for_paths",
+           "eval_formula", "itemsize_bytes", "memory_fingerprint",
+           "memory_surface_for", "register_byte_signature",
+           "register_capacity_field"]

@@ -15,6 +15,7 @@ from .shape_recompile import ShapeRecompileChecker
 from .dtype_flow import DtypeFlowChecker
 from .sharding_consistency import ShardingConsistencyChecker
 from .compile_surface import CompileSurfaceChecker
+from .memory_budget import MemoryBudgetChecker
 
 __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "HostSyncChecker", "AxisNameChecker", "RegistryDriftChecker",
@@ -22,7 +23,7 @@ __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "ResourceLifecycleChecker", "ResourcePair", "DEFAULT_PAIRS",
            "ShapeRecompileChecker", "DtypeFlowChecker",
            "ShardingConsistencyChecker", "CompileSurfaceChecker",
-           "default_checkers"]
+           "MemoryBudgetChecker", "default_checkers"]
 
 
 def default_checkers():
@@ -39,4 +40,5 @@ def default_checkers():
         DtypeFlowChecker(),
         ShardingConsistencyChecker(),
         CompileSurfaceChecker(),
+        MemoryBudgetChecker(),
     ]

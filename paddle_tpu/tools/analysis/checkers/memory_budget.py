@@ -1,0 +1,302 @@
+"""memory-budget: the memory pin, proved statically (graftmem).
+
+The serving stack promises a FIXED memory footprint: pool slabs sized
+once from capacity fields, kernels that fit the declared VMEM budget at
+every supported tiling, quantized weights that are dequantized
+per-tile / scale-after-dot — never materialized full-size — and host
+buffers that cannot grow without bound.  graftmem
+(:mod:`..memory`) derives the byte facts; this rule turns the
+violations into findings on the configured hot paths:
+
+  * **error** — a registered VMEM plan (``__vmem_plans__`` marker)
+    whose provable per-grid-step working set exceeds the budget the
+    module declares (``VMEM_BUDGET``, folded from the AST, resolved
+    through imports) at one of the reference tilings, or a plan that
+    refuses the tiling outright.
+  * **error** — a hot path materializes a full-size dequantized or
+    upcast copy of a pool slab (``.ks/.vs/.bks/.bvs`` astype-to-float
+    outside a tile subscript) or of a weight (a full-tensor
+    astype-to-float multiplied by a ``*scale*`` operand — the
+    ``nn.quant`` scale-after-dot discipline, enforced repo-wide; the
+    blessed form upcasts the MATMUL RESULT, never the weight).
+  * **warning** — unbounded host-side buffer growth: ``.append`` inside
+    ``while True`` with no bounding evidence (pop/clear/del or a
+    ``len()`` comparison) anywhere in the loop.
+  * **warning** — a pool allocation whose shape does not flow from
+    registered capacity fields (:data:`..memory.DEFAULT_CAPACITY_FIELDS`
+    plus the module's ``__memory_capacity_fields__`` marker) — bytes
+    the capacity manifest cannot account for.
+
+Suppress with ``# graftlint: disable=memory-budget -- reason`` on the
+offending line; the two sanctioned full materializations in
+``nn.quant`` (the documented dequantize inverse and the LLM.int8
+outlier float path) carry exactly that audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..absint import canon_dtype
+from ..findings import ERROR, WARNING, Finding
+from .base import Checker
+
+DEFAULT_HOT_PATHS = (
+    "paddle_tpu/serving/*.py",
+    "paddle_tpu/kernels/*.py",
+    "paddle_tpu/nn/quant/*.py",
+    # the rule's own fixtures (anchored: fixture dir for CLI runs, bare
+    # basename for fixture-rooted library tests)
+    "tests/fixtures/lint/memory_*.py",
+    "memory_*.py",
+)
+
+# cheap token gate: a file with none of these can host neither a
+# materialization, an unbounded append, a pool, nor a VMEM plan marker
+_TOKENS = ("astype", "append", "Pool", "__vmem_plans__", "pallas_call")
+
+# KV slab attributes across KVPool / BlockPool
+_SLAB_ATTRS = frozenset({"ks", "vs", "bks", "bvs"})
+_FLOAT_DTYPES = frozenset({"float16", "float32", "float64", "bfloat16"})
+
+
+def _dtype_leaf(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _astype_to_float(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "astype" and call.args):
+        return False
+    leaf = _dtype_leaf(call.args[0])
+    return canon_dtype(leaf) in _FLOAT_DTYPES if leaf else False
+
+
+def _slab_receiver(node: ast.AST) -> Optional[str]:
+    """The slab attr when ``node`` reads a WHOLE slab: ``pool.ks`` or
+    one layer of it ``pool.ks[i]``.  A second subscript is a tile read
+    and exempt."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+        if isinstance(node, ast.Subscript):
+            return None          # double subscript == tile read
+    if isinstance(node, ast.Attribute) and node.attr in _SLAB_ATTRS:
+        return node.attr
+    return None
+
+
+def _has_full_astype(node: ast.AST, params: Set[str],
+                     tainted: Set[str]) -> bool:
+    """Does this expression carry a FULL-tensor astype-to-float?  The
+    matmul operands are never full (the blessed scale-after-dot form
+    upcasts the dot RESULT); an astype on an arbitrary call result is
+    an accumulator, not a weight."""
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.MatMult):
+            return False
+        return _has_full_astype(node.left, params, tainted) \
+            or _has_full_astype(node.right, params, tainted)
+    if isinstance(node, ast.Call):
+        if _astype_to_float(node):
+            recv = node.func.value
+            if isinstance(recv, ast.Name):
+                return recv.id in params or recv.id in tainted
+            return isinstance(recv, ast.Attribute)
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return False
+
+
+def _mentions_scale(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and "scale" in name.lower():
+            return True
+    return False
+
+
+def _bounded_loop(loop: ast.While) -> bool:
+    """Any bounding evidence inside the loop: an eviction call, a del,
+    a break guard comparing ``len()``."""
+    for sub in ast.walk(loop):
+        if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                    ast.Attribute) \
+                and sub.func.attr in ("pop", "popleft", "clear"):
+            return True
+        if isinstance(sub, ast.Delete):
+            return True
+        if isinstance(sub, ast.Compare):
+            for part in ast.walk(sub):
+                if isinstance(part, ast.Call) \
+                        and isinstance(part.func, ast.Name) \
+                        and part.func.id == "len":
+                    return True
+    return False
+
+
+class MemoryBudgetChecker(Checker):
+    name = "memory-budget"
+    severity = ERROR
+
+    def __init__(self, hot_paths: Optional[Sequence[str]] = None):
+        self.hot_paths = tuple(hot_paths or DEFAULT_HOT_PATHS)
+
+    def check(self, ctx) -> List[Finding]:
+        if not any(fnmatch.fnmatch(ctx.relpath, p)
+                   for p in self.hot_paths):
+            return []
+        if not any(tok in ctx.src for tok in _TOKENS):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                findings.extend(self._check_function(ctx, node))
+            elif isinstance(node, ast.While):
+                findings.extend(self._check_loop(ctx, node))
+        # the surface-backed legs (VMEM plans, pool capacity flow) need
+        # the project index AND only exist behind their own markers —
+        # an inert file never pays for surface construction
+        if ctx.project is not None and (
+                "Pool" in ctx.src or "__vmem_plans__" in ctx.src):
+            from ..memory import memory_surface_for
+            surface = memory_surface_for(ctx.project)
+            findings.extend(self._check_vmem(ctx, surface))
+            findings.extend(self._check_pools(ctx, surface))
+        return findings
+
+    # ---- leg: full-size dequantized/upcast materializations --------
+
+    def _check_function(self, ctx, fn: ast.FunctionDef) -> List[Finding]:
+        out: List[Finding] = []
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        # taint pass: locals that HOLD a full astype-to-float
+        tainted: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _has_full_astype(node.value, params, tainted):
+                tainted.add(node.targets[0].id)
+        seen_lines: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _astype_to_float(node):
+                slab = _slab_receiver(node.func.value)
+                if slab is not None and node.lineno not in seen_lines:
+                    seen_lines.add(node.lineno)
+                    out.append(Finding(
+                        self.name, ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"'{fn.name}' materializes a full-size upcast "
+                        f"copy of pool slab '.{slab}' — dequantize "
+                        f"per-tile inside the kernel instead; a whole-"
+                        f"slab astype doubles the KV tier's HBM "
+                        f"footprint", ERROR,
+                        props=(("bytes", "full slab copy"),
+                               ("budget", "0 extra slab bytes"),
+                               ("unit", f"{fn.name}.{slab}"))))
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Mult) \
+                    and node.lineno not in seen_lines:
+                l_full = _has_full_astype(node.left, params, tainted)
+                r_full = _has_full_astype(node.right, params, tainted)
+                if (l_full and _mentions_scale(node.right)) \
+                        or (r_full and _mentions_scale(node.left)):
+                    seen_lines.add(node.lineno)
+                    out.append(Finding(
+                        self.name, ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"'{fn.name}' materializes a full-size "
+                        f"dequantized weight (full-tensor astype-to-"
+                        f"float times a scale) — apply the scale AFTER "
+                        f"the dot (`(x @ w_int).astype(f32) * scale`) "
+                        f"so the float copy never exists", ERROR,
+                        props=(("bytes", "full dequantized copy"),
+                               ("budget", "0 extra weight bytes"),
+                               ("unit", fn.name))))
+        return out
+
+    # ---- leg: unbounded host-side growth ---------------------------
+
+    def _check_loop(self, ctx, loop: ast.While) -> List[Finding]:
+        if not (isinstance(loop.test, ast.Constant)
+                and loop.test.value is True):
+            return []
+        if _bounded_loop(loop):
+            return []
+        out: List[Finding] = []
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "append":
+                out.append(Finding(
+                    self.name, ctx.relpath, sub.lineno, sub.col_offset,
+                    f"unbounded append inside `while True` with no "
+                    f"eviction or length bound in the loop — host "
+                    f"memory grows per iteration; cap the buffer or "
+                    f"evict", WARNING,
+                    props=(("bytes", "unbounded"),
+                           ("budget", "bounded buffer"),
+                           ("unit", "host buffer"))))
+        return out
+
+    # ---- leg: VMEM working set vs declared budget ------------------
+
+    def _check_vmem(self, ctx, surface) -> List[Finding]:
+        out: List[Finding] = []
+        for decl in surface.plans_for(ctx.relpath):
+            if decl.ok:
+                continue
+            failing = [r for r in decl.rows if not r["ok"]]
+            names = ", ".join(r["tiling"] for r in failing)
+            worst = "unfittable"
+            for r in failing:
+                if r["working_set"]:
+                    worst = str(max(r["working_set"].values()))
+                    break
+            out.append(Finding(
+                self.name, ctx.relpath, decl.line, 0,
+                f"VMEM plan '{decl.plan}' exceeds its declared budget "
+                f"{decl.budget} ({decl.budget_source}) at reference "
+                f"tiling(s): {names} — the per-grid-step working set "
+                f"does not fit; shrink the tile ladder or raise the "
+                f"budget the kernel actually reserves", ERROR,
+                props=(("bytes", worst),
+                       ("budget", str(decl.budget)),
+                       ("unit", decl.plan))))
+        return out
+
+    # ---- leg: pool shapes must flow from capacity fields -----------
+
+    def _check_pools(self, ctx, surface) -> List[Finding]:
+        out: List[Finding] = []
+        for spec in surface.pools_for(ctx.relpath):
+            for name in sorted(spec.attrs):
+                attr = spec.attrs[name]
+                if not attr.bad_dims:
+                    continue
+                bad = ", ".join(sorted(set(attr.bad_dims)))
+                out.append(Finding(
+                    self.name, ctx.relpath, attr.line, 0,
+                    f"pool allocation '{spec.qname.rsplit('.', 1)[-1]}"
+                    f".{name}' has shape extents ({bad}) that do not "
+                    f"flow from registered capacity fields — the "
+                    f"capacity manifest cannot account for these "
+                    f"bytes; register the field "
+                    f"(__memory_capacity_fields__) or derive the "
+                    f"extent from one", WARNING,
+                    props=(("bytes", attr.formula()),
+                           ("budget", "capacity-field extents"),
+                           ("unit", f"{spec.qname}.{name}"))))
+        return out

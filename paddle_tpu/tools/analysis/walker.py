@@ -60,9 +60,11 @@ def _cache_version() -> Tuple:
     ``register_entry_point`` (or an edited table) must never serve
     analysis state derived under the old registrations."""
     from .entrypoints import entry_point_fingerprint
+    from .memory import memory_fingerprint
     from .signatures import table_fingerprint
-    return (3, sys.version_info[:2], _analysis_fingerprint(),
-            table_fingerprint(), entry_point_fingerprint())
+    return (4, sys.version_info[:2], _analysis_fingerprint(),
+            table_fingerprint(), entry_point_fingerprint(),
+            memory_fingerprint())
 
 
 @dataclass

@@ -13,6 +13,8 @@ Usage:
     python scripts/graftlint.py --list-rules
     python scripts/graftlint.py --manifest        # graftprog program
                                                   # manifest (JSON)
+    python scripts/graftlint.py --memory          # graftmem HBM capacity
+                                                  # manifest (JSON)
 
 Default scope is the library AND the perf-critical entrypoints:
 ``paddle_tpu/``, ``bench.py``, ``__graft_entry__.py``, ``scripts/``.
@@ -123,6 +125,10 @@ def main(argv=None) -> int:
                     help="emit the graftprog compile-surface manifest "
                          "(deterministic JSON) over the default scope "
                          "and exit")
+    ap.add_argument("--memory", action="store_true", dest="memory",
+                    help="emit the graftmem HBM capacity manifest "
+                         "(deterministic JSON) over the default scope "
+                         "and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -140,6 +146,15 @@ def main(argv=None) -> int:
                      "cannot be combined with --changed/--since/paths")
         cache = None if args.no_cache else CACHE_PATH
         manifest = _analysis.build_manifest_for_paths(
+            scope, root=ROOT, cache_path=cache)
+        print(_analysis.format_manifest(manifest))
+        return 0
+    if args.memory:
+        if args.changed or args.since or args.paths:
+            ap.error("--memory walks the whole default scope; it "
+                     "cannot be combined with --changed/--since/paths")
+        cache = None if args.no_cache else CACHE_PATH
+        manifest = _analysis.build_memory_manifest_for_paths(
             scope, root=ROOT, cache_path=cache)
         print(_analysis.format_manifest(manifest))
         return 0

@@ -1,0 +1,45 @@
+"""memory-budget fixture: every leg of the rule fires exactly once.
+
+Planted findings (5 total — 3 errors, 2 warnings):
+  1. ERROR   line of ``__vmem_plans__`` — the declared 64 KiB budget is
+     far below the flagship attention residents, so every reference
+     tiling of the registered plan fails the static VMEM check.
+  2. WARNING ``ShadowPool.scratch`` — its shape extent ``n_extra`` is
+     not a registered capacity field (and the module registers none),
+     so the capacity manifest cannot account for the bytes.
+  3. ERROR   ``hot_dequant`` — a whole pool slab (``.ks[0]``) is upcast
+     to float: a full-size materialized copy.
+  4. ERROR   ``hot_dequant`` — a full-tensor astype-to-float multiplied
+     by a scale: the dequantized weight exists in HBM.
+  5. WARNING ``pump`` — append inside ``while True`` with no eviction
+     or length bound.
+"""
+
+import jax.numpy as jnp
+
+# a budget a real decode layer cannot possibly fit: the flagship
+# attention residents alone are ~288 KiB at bf16
+VMEM_BUDGET = 64 * 1024
+
+__vmem_plans__ = ("plan_decode_block",)
+
+
+class ShadowPool:
+    def __init__(self, num_slots, max_seq, n_extra):
+        shape = (num_slots, max_seq, 4, 16)
+        self.ks = [jnp.zeros(shape, jnp.float32) for _ in range(2)]
+        # n_extra is no capacity field: unaccounted bytes
+        self.scratch = jnp.zeros((n_extra, 128), jnp.float32)
+
+
+def hot_dequant(pool, w_quant, w_scale):
+    full = pool.ks[0].astype(jnp.float32)          # whole-slab upcast
+    w = w_quant.astype(jnp.float32) / 127.0
+    y = w * w_scale                                # dequantized weight
+    return full, y
+
+
+def pump(queue, out):
+    while True:
+        item = queue.get()
+        out.append(item)                           # unbounded growth

@@ -47,10 +47,11 @@ def test_rule_catalogue_is_complete():
                      "axis-name", "registry-drift", "dead-state",
                      "use-after-donate", "resource-lifecycle",
                      "recompile-shape", "dtype-flow",
-                     "sharding-consistency", "compile-surface"}
-    # ISSUE 16: the catalogue is now twelve rules — a checker silently
+                     "sharding-consistency", "compile-surface",
+                     "memory-budget"}
+    # ISSUE 19: the catalogue is now thirteen rules — a checker silently
     # dropping out of default_checkers() must fail loudly
-    assert len(names) == 12 and len(default_checkers()) == 12
+    assert len(names) == 13 and len(default_checkers()) == 13
 
 
 # ------------------------------------------------- per-rule fixture pairs
@@ -1335,6 +1336,114 @@ def test_spec_compile_surface_negative():
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+def test_memory_budget_positive():
+    """ISSUE 19: every leg of the memory-budget rule fires exactly once
+    on the planted fixture — 3 errors (VMEM over budget, whole-slab
+    upcast, dequantized-weight materialization) + 2 warnings
+    (non-capacity pool extent, unbounded append)."""
+    from paddle_tpu.tools.analysis import ERROR, WARNING
+    res = run_rule("memory_pos.py", "memory-budget")
+    found = only_rule(res, "memory-budget")
+    assert len(found) == 5, [f.format() for f in found]
+    sev = sorted(f.severity for f in found)
+    assert sev == sorted([ERROR, ERROR, ERROR, WARNING, WARNING])
+    msgs = " | ".join(f.message for f in found)
+    assert "VMEM plan 'plan_decode_block' exceeds" in msgs
+    assert "full-size upcast copy of pool slab '.ks'" in msgs
+    assert "full-size dequantized weight" in msgs
+    assert "do not flow from registered capacity fields" in msgs
+    assert "unbounded append inside `while True`" in msgs
+    # every memory finding carries the byte-evidence property triple
+    for f in found:
+        props = dict(f.props)
+        assert props.get("bytes") and props.get("budget") \
+            and props.get("unit"), f.format()
+
+
+def test_memory_budget_negative():
+    """The blessed forms: capacity-clean pool (including a
+    module-registered field), tile reads, scale-after-dot, bounded
+    append, a plan that fits its real budget — zero findings."""
+    res = run_rule("memory_neg.py", "memory-budget")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_sarif_memory_budget_properties():
+    """Satellite (ISSUE 19): memory-budget SARIF results carry
+    ``properties.{bytes,budget,unit}`` — CI annotators can show the
+    byte evidence inline."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftlint.py", "--sarif",
+         "--rule", "memory-budget",
+         "tests/fixtures/lint/memory_pos.py"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "memory-budget" in rules
+    live = [r for r in run["results"] if "suppressions" not in r]
+    assert len(live) == 5
+    assert sorted(r["level"] for r in live) == \
+        ["error", "error", "error", "warning", "warning"]
+    for r in live:
+        for key in ("bytes", "budget", "unit"):
+            assert r["properties"].get(key), (key, r)
+
+
+def test_cli_memory_manifest_deterministic_and_pinned():
+    """Tentpole artifact (ISSUE 19): ``--memory`` emits byte-identical
+    JSON across runs, and the capacity claims hold — both pools derive
+    capacity-clean formulas, every registered VMEM plan fits its
+    declared budget at every reference tiling, the KV tier's
+    bytes-per-block halves from bf16 to int8, and the EngineCore plane
+    is provably fixed-footprint (no allocation outside the init/rebuild
+    owners)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmd = [sys.executable, "scripts/graftlint.py", "--memory"]
+    a = subprocess.run(cmd, cwd=str(REPO_ROOT), capture_output=True,
+                       text=True, timeout=600, env=env)
+    b = subprocess.run(cmd, cwd=str(REPO_ROOT), capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert a.returncode == 0, a.stdout + a.stderr
+    assert b.returncode == 0, b.stdout + b.stderr
+    assert a.stdout == b.stdout      # deterministic artifact
+    m = json.loads(a.stdout)
+    assert m["graftmem_version"] == 1
+    pools = m["pools"]
+    assert {"paddle_tpu.serving.kv_pool.KVPool",
+            "paddle_tpu.serving.kv_pool.BlockPool"} <= set(pools)
+    for p in pools.values():
+        assert p["capacity_ok"], p
+        assert p["bytes_at_reference"] > 0
+    # the slab formulas carry the symbolic element size — the int8 KV
+    # ladder is derived by re-evaluating them, not by re-measuring
+    assert "itemsize" in pools[
+        "paddle_tpu.serving.kv_pool.KVPool"]["formula"]
+    kv = m["kv_tier"]
+    assert kv["bytes_per_block"]["bfloat16"] == \
+        2 * kv["bytes_per_block"]["int8"]
+    for chip, row in kv["max_resident_blocks"].items():
+        assert row["int8"] >= row["bfloat16"], chip
+    assert m["vmem"]["all_ok"], m["vmem"]
+    assert {"plan_decode_block", "plan_decode_block_tp"} <= \
+        set(m["vmem"]["plans"])
+    for plan in m["vmem"]["plans"].values():
+        assert plan["ok"] and plan["tilings"], plan
+    plane = m["planes"]["paddle_tpu.serving.engine.EngineCore"]
+    assert plane["fixed_footprint"], plane["alloc_sites"]
+    assert all(s["allowed"] for s in plane["alloc_sites"])
+    # per-program footprints carry evidence legs and donation notes
+    assert m["programs"]
+    for p in m["programs"]:
+        assert p["peak_bytes"] == sum(p["legs"].values())
+        assert set(p["legs"]) == {"weights", "pools", "row_state",
+                                  "staging", "activations"}
+    donated = {p["counter"]: p["donated"] for p in m["programs"]}
+    assert donated["decode"] is True     # donation: slabs counted once
+
+
 def test_cli_manifest_deterministic_and_pinned():
     """``--manifest`` emits byte-identical JSON across runs, and the
     EngineCore plane IS the pinned program set: bucketed prefill + ONE
@@ -1435,6 +1544,30 @@ def test_cache_version_tracks_signature_and_entry_tables():
     assert _cache_version() == v0
 
 
+def test_cache_version_tracks_memory_tables():
+    """Satellite (ISSUE 19): registering a byte signature or a capacity
+    field moves the parse-cache version — cached results derived under
+    the old byte-accounting tables must never be served."""
+    from paddle_tpu.tools.analysis import (register_byte_signature,
+                                           register_capacity_field)
+    from paddle_tpu.tools.analysis.memory import (_EXTRA_BYTE_SIGNATURES,
+                                                  _EXTRA_CAPACITY_FIELDS)
+    from paddle_tpu.tools.analysis.walker import _cache_version
+    v0 = _cache_version()
+    register_byte_signature("zz.probe_alloc", "prod(shape) * itemsize")
+    try:
+        assert _cache_version() != v0
+    finally:
+        _EXTRA_BYTE_SIGNATURES.pop("zz.probe_alloc")
+    assert _cache_version() == v0
+    register_capacity_field("zz_probe_depth")
+    try:
+        assert _cache_version() != v0
+    finally:
+        _EXTRA_CAPACITY_FIELDS.remove("zz_probe_depth")
+    assert _cache_version() == v0
+
+
 def test_stale_cache_not_served_after_entry_point_change(tmp_path):
     """End-to-end: a saved parse cache is NOT loaded once the entry-point
     table differs from the one it was written under."""
@@ -1477,6 +1610,28 @@ def test_surface_build_skipped_for_inert_files(tmp_path):
     assert cs.BUILD_COUNT == before + 1
 
 
+def test_memory_surface_build_skipped_for_inert_files(tmp_path):
+    """Satellite (ISSUE 19): the memory-budget token gate mirrors the
+    compile-surface one — an inert file on the hot globs never pays for
+    memory-surface construction in a ``--changed`` run."""
+    from paddle_tpu.tools.analysis import memory as gm
+    inert = tmp_path / "memory_inert.py"   # hot glob, no tokens
+    inert.write_text("def f():\n    return 1\n")
+    before = gm.BUILD_COUNT
+    run_analysis([str(inert)], root=str(tmp_path),
+                 rules=["memory-budget"])
+    assert gm.BUILD_COUNT == before, \
+        "memory surface built for a file with no memory tokens"
+    probe = tmp_path / "memory_probe.py"
+    probe.write_text(
+        "import jax.numpy as jnp\n\n\nclass ProbePool:\n"
+        "    def __init__(self, num_slots):\n"
+        "        self.ks = jnp.zeros((num_slots, 4), jnp.float32)\n")
+    run_analysis([str(probe)], root=str(tmp_path),
+                 rules=["memory-budget"])
+    assert gm.BUILD_COUNT == before + 1
+
+
 def test_scan_performance_budget_with_warm_cache():
     """Full-scope scan must stay pre-commit-viable: one timed run under
     a generous wall-clock bound (catches accidental O(files^2)
@@ -1484,7 +1639,10 @@ def test_scan_performance_budget_with_warm_cache():
     tests above populate it; the bound absorbs a cold standalone run.
     ISSUE 16: the budget now covers graftprog too — the lint pass builds
     the compile surface (serving/kernels are hot paths) AND a full
-    ``--manifest`` emission rides inside the same 90s pin."""
+    ``--manifest`` emission rides inside the same 90s pin.  ISSUE 19
+    adds graftmem: the ``--memory`` capacity-manifest emission rides
+    inside the SAME budget — byte accounting must stay pre-commit
+    cheap."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     cmd = [sys.executable, "scripts/graftlint.py"]
     t0 = time.perf_counter()
@@ -1500,6 +1658,13 @@ def test_scan_performance_budget_with_warm_cache():
     dt_man = time.perf_counter() - t1
     assert man.returncode == 0, man.stdout + man.stderr
     json.loads(man.stdout)    # still a valid artifact under timing
-    assert dt + dt_man < 90.0, (
-        f"warm full-scope scan + manifest took {dt:.1f}s + {dt_man:.1f}s "
-        f"(budget 90s)")
+    t2 = time.perf_counter()
+    mem = subprocess.run(cmd + ["--memory"], cwd=str(REPO_ROOT),
+                         capture_output=True, text=True, timeout=600,
+                         env=env)
+    dt_mem = time.perf_counter() - t2
+    assert mem.returncode == 0, mem.stdout + mem.stderr
+    json.loads(mem.stdout)    # still a valid artifact under timing
+    assert dt + dt_man + dt_mem < 90.0, (
+        f"warm full-scope scan + manifests took {dt:.1f}s + "
+        f"{dt_man:.1f}s + {dt_mem:.1f}s (budget 90s)")

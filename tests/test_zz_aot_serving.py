@@ -376,8 +376,8 @@ def test_autoscaler_spawn_from_store_is_warm_and_token_identical(
         eng = router.replicas[idx].engine
         assert eng.aot_status == "warm"
         tokens, observed = _run(eng)
-        assert observed == {"prefill": 0, "decode": 0, "gather": 0,
-                            "scatter": 0}
+        assert observed == {"prefill": 0, "decode": 0, "verify": 0,
+                            "gather": 0, "scatter": 0}
         assert tokens == traced_tokens
         scaler.retire(idx)
     finally:

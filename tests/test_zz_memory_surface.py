@@ -1,0 +1,198 @@
+"""Runtime/static consistency gate for graftmem (ISSUE 19).
+
+graftmem (tools/analysis/memory.py) statically derives the serving
+plane's byte footprint — pool-slab formulas from the constructor AST,
+declared row-state/staging legs, VMEM working sets from integer mirrors
+of the Pallas plans.  This test closes the loop from the OTHER side: it
+warms a CPU-smoke engine per config leg (tp=1 and tp=2) and measures
+the live device state from array shapes/dtypes (``.nbytes`` — no
+accelerator needed), then asserts:
+
+  * pool slabs match the manifest's formulas EXACTLY (byte-for-byte,
+    both legs — the capacity manifest's per-block ladder is only
+    trustworthy if the formulas are exact);
+  * staging (the single-slot prefill cache) matches its declared
+    formula EXACTLY;
+  * the persistent row-state + staging estimate matches the measured
+    footprint within a stated 5% tolerance (the declared legs include
+    lazily-uploaded sampling/mask vectors a fresh engine has not
+    materialized yet — the static side is the UPPER bound);
+  * the plan mirrors are line-for-line faithful: over every reference
+    tiling, mirror output equals live plan output exactly (tilings AND
+    refusal strings), so plan drift cannot silently de-sync the static
+    VMEM check.
+
+zz-prefixed for the same reason as test_zz_compile_surface: the tp=2
+leg drives shard_map on the 8-device CPU mesh — sort after the
+jaxlib-0.4 dispatch-race window conftest documents.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import ServingEngine
+
+ENGINE_PLANE = "paddle_tpu.serving.engine.EngineCore"
+KV_POOL = "paddle_tpu.serving.kv_pool.KVPool"
+BLOCK_POOL = "paddle_tpu.serving.kv_pool.BlockPool"
+
+NUM_SLOTS = 4
+MAX_SEQ = 64
+BLOCK_LEN = 16
+# the static side is an upper bound over lazily-materialized row state
+# (_sampling_dev/_mask_dev upload on first use) — tolerance, stated
+ROW_STATE_TOL = 0.05
+
+# the capacity environment of the smoke engine below (gpt_tiny: vocab
+# 256, hidden 64, 2 layers, 4 heads, head_dim 16, float32)
+TINY_ENV = {
+    "num_slots": NUM_SLOTS, "max_seq": MAX_SEQ, "num_layers": 2,
+    "kv_heads": 4, "head_dim": 16, "num_heads": 4, "hidden": 64,
+    "vocab_size": 256, "ffn": 256, "itemsize": 4,
+    "block_len": BLOCK_LEN,
+    "num_blocks": NUM_SLOTS * (MAX_SEQ // BLOCK_LEN),
+    "blocks_per_row": MAX_SEQ // BLOCK_LEN,
+}
+
+
+@pytest.fixture(scope="module")
+def manifest():
+    """The statically-derived capacity manifest, built through the same
+    library entry point the CLI's ``--memory`` uses."""
+    from paddle_tpu.tools.analysis import build_memory_manifest_for_paths
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    scope = [os.path.join(root, p)
+             for p in ("paddle_tpu", "bench.py", "scripts")]
+    m = build_memory_manifest_for_paths(scope, root=root)
+    assert ENGINE_PLANE in m["planes"], sorted(m["planes"])
+    return m
+
+
+def _eval(formula, env=TINY_ENV):
+    from paddle_tpu.tools.analysis import eval_formula
+    return eval_formula(formula, env)
+
+
+def _fresh_engine(**engine_kw):
+    paddle_tpu.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    eng = ServingEngine(model, num_slots=NUM_SLOTS, max_seq=MAX_SEQ,
+                        min_bucket=8, prefill_chunk=16,
+                        block_len=BLOCK_LEN, **engine_kw)
+    # warm it: real traffic so every persistent buffer exists
+    rs = np.random.RandomState(7)
+    rids = [eng.submit(rs.randint(0, 256, (L,)), max_new_tokens=3)
+            for L in (3, 17)]
+    eng.run_until_complete(200)
+    assert all(eng.result(r).finished for r in rids)
+    return eng, model
+
+
+def _measured_pool_bytes(pool):
+    return sum(a.nbytes for a in pool.ks) \
+        + sum(a.nbytes for a in pool.vs) + pool.seq_pos.nbytes
+
+
+def _measured_block_bytes(bp):
+    return sum(a.nbytes for a in bp.bks) + sum(a.nbytes for a in bp.bvs)
+
+
+def _measured_staging(model):
+    cache = model.init_cache(1, MAX_SEQ)
+    return sum(layer[0].nbytes + layer[1].nbytes for layer in cache)
+
+
+def _check_pools_exact(manifest, eng, model, leg):
+    kv_formula = manifest["pools"][KV_POOL]["formula"]
+    bp_formula = manifest["pools"][BLOCK_POOL]["formula"]
+    measured_kv = _measured_pool_bytes(eng.core.pool)
+    measured_bp = _measured_block_bytes(eng.core.block_pool)
+    assert measured_kv == _eval(kv_formula), (
+        f"[{leg}] KVPool: measured {measured_kv} B != static "
+        f"{_eval(kv_formula)} B from '{kv_formula}'")
+    assert measured_bp == _eval(bp_formula), (
+        f"[{leg}] BlockPool: measured {measured_bp} B != static "
+        f"{_eval(bp_formula)} B from '{bp_formula}'")
+    plane = manifest["planes"][ENGINE_PLANE]
+    staging = plane["staging"]["formula"]
+    assert staging and _measured_staging(model) == _eval(staging), (
+        f"[{leg}] staging: measured {_measured_staging(model)} B != "
+        f"static {_eval(staging)} B from '{staging}'")
+
+
+def test_leg_tp1_pools_match_static_exactly(manifest):
+    eng, model = _fresh_engine()
+    _check_pools_exact(manifest, eng, model, "tp1")
+
+
+def test_leg_tp2_pools_match_static_exactly(manifest):
+    """Sharded slabs: ``.nbytes`` is the GLOBAL logical size, which is
+    exactly what the capacity formula accounts — sharding changes the
+    per-chip share, never the total."""
+    eng, model = _fresh_engine(tensor_parallel=2)
+    _check_pools_exact(manifest, eng, model, "tp2")
+
+
+def test_row_state_estimate_within_tolerance(manifest):
+    """The declared row-state legs bound the measured persistent
+    non-pool device state within the stated tolerance.  Static must be
+    >= measured (it includes the lazily-uploaded vectors) and close."""
+    eng, model = _fresh_engine()
+    plane = manifest["planes"][ENGINE_PLANE]
+    static = _eval(plane["staging"]["formula"]) + sum(
+        _eval(r["formula"]) for r in plane["row_state"].values())
+    measured = (_measured_staging(model) + eng.core._last_tok.nbytes
+                + eng.core._keys.nbytes)
+    for attr in ("_sampling_dev", "_mask_dev"):
+        dev = getattr(eng.core, attr, None)
+        if dev is None:
+            continue
+        parts = dev if isinstance(dev, (tuple, list)) else [dev]
+        measured += sum(int(p.nbytes) for p in parts)
+    assert static >= measured, (static, measured)
+    assert (static - measured) / static <= ROW_STATE_TOL, (
+        f"row-state estimate {static} B vs measured {measured} B — "
+        f"off by more than {ROW_STATE_TOL:.0%}")
+
+
+def test_plan_mirrors_are_faithful():
+    """The static VMEM check is only as good as its mirrors: over every
+    reference tiling, mirror output must equal the LIVE plan's output
+    exactly — the chosen tiles, the working-set legs, and (at a
+    deliberately impossible budget) the refusal strings."""
+    from paddle_tpu.kernels.decode_block import plan_decode_block
+    from paddle_tpu.kernels.decode_block_tp import plan_decode_block_tp
+    from paddle_tpu.tools.analysis import PLAN_MIRRORS, REFERENCE_TILINGS
+    live = {"plan_decode_block": plan_decode_block,
+            "plan_decode_block_tp": plan_decode_block_tp}
+    assert set(PLAN_MIRRORS) == set(live)
+    for t in REFERENCE_TILINGS:
+        got = PLAN_MIRRORS[t["plan"]](**t["kwargs"])
+        want = live[t["plan"]](**t["kwargs"])
+        assert got == want, (t["name"], got, want)
+        # refusal path: both sides must refuse identically
+        got_r = PLAN_MIRRORS[t["plan"]](vmem_budget=64 * 1024,
+                                        **t["kwargs"])
+        want_r = live[t["plan"]](vmem_budget=64 * 1024, **t["kwargs"])
+        assert got_r == want_r, (t["name"], got_r, want_r)
+
+
+def test_manifest_vmem_all_green(manifest):
+    """Acceptance pin: every ``plan_decode_block{,_tp}`` tiling in-tree
+    passes the static VMEM check against the budget the kernels
+    declare."""
+    vmem = manifest["vmem"]
+    assert vmem["all_ok"], vmem
+    assert {"plan_decode_block", "plan_decode_block_tp"} <= \
+        set(vmem["plans"])
+    for name, plan in vmem["plans"].items():
+        assert plan["tilings"], f"no reference tilings ran for {name}"
+        for row in plan["tilings"]:
+            assert row["ok"], row
+            assert all(v <= plan["budget"]
+                       for v in row["working_set"].values()), row
