@@ -1236,6 +1236,7 @@ def _serving_tp_bench(smoke=False):
             "ttft_p99_ms": md["ttft_p99_ms"],
             "collective_p50_ms": (round(coll["p50"] * 1e3, 3)
                                   if coll.get("p50") else None),
+            "comm_note": _comm_seam_note(tp),
             "parity_vs_tp1": parity})
     out = {
         "rows": rows,
@@ -1251,6 +1252,34 @@ def _serving_tp_bench(smoke=False):
                        "signals; the on-chip rows are "
                        "BENCH_TPU_EVIDENCE.json serving_tp_*")
     return out
+
+
+_COMM_SEAM_LADDER = {}
+
+
+def _comm_seam_note(tp):
+    """Per-hop ring payload at this tp, quoted from the graftcomm seam
+    manifest (``scripts/graftlint.py --comm``) — the statically-proved
+    side of the measured collective row.  ``None`` when tp carries no
+    ring or the analysis toolchain is unavailable."""
+    if not _COMM_SEAM_LADDER:
+        try:
+            from paddle_tpu.tools.analysis import \
+                build_comm_manifest_for_paths
+            root = os.path.dirname(os.path.abspath(__file__))
+            m = build_comm_manifest_for_paths(
+                [os.path.join(root, "paddle_tpu")], root=root)
+            seam = m["seams"][
+                "paddle_tpu.kernels.collective_matmul.allgather_matmul"]
+            _COMM_SEAM_LADDER.update(seam["per_hop_payload_bytes"] or {})
+        except Exception:
+            _COMM_SEAM_LADDER["unavailable"] = True
+    per_hop = _COMM_SEAM_LADDER.get(f"tp={tp}")
+    if per_hop is None:
+        return None
+    return (f"graftcomm seam manifest: {per_hop} B/hop travelling "
+            f"shard per ring (entry+exit, tp-1 guarded neighbour "
+            f"hops, reference env)")
 
 
 def _collective_fusion_compare(tp):

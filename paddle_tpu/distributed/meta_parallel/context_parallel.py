@@ -37,6 +37,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .._jax_compat import axis_size as _axis_size
 from ..topology import get_hybrid_communicate_group
 
+# graftcomm seam marker: the ring-attention K/V (and gradient) blocks
+# travel one neighbor hop per step over the "sep" axis — a cross-host
+# seam on sequence-parallel meshes.  Forward ships the K/V block pair
+# per hop; backward additionally rotates the dk/dv accumulators, so the
+# roles differ and are pinned separately.
+__remote_dma_seams__ = {
+    "_ring_fwd_impl": {
+        "role": "cp-ring-fwd",
+        "payload": "max_seq // tp * kv_heads * head_dim * itemsize"},
+    "_ring_core_bwd": {
+        "role": "cp-ring-bwd",
+        "payload": "max_seq // tp * kv_heads * head_dim * itemsize"},
+}
+
 
 def _shard_map(body, mesh, in_specs, out_specs, manual_axes):
     """jax.shard_map in partial-manual mode: only ``manual_axes`` are

@@ -34,6 +34,15 @@ __all__ = ["pipeline_apply", "pipeline_apply_interleaved",
            "stack_stage_params", "stack_interleaved_stage_params",
            "stage_param_specs"]
 
+# graftcomm seam marker: the per-tick neighbor ppermute over "pp" is a
+# genuine cross-host boundary hand-off (stage activations travel one
+# hop per tick).  No payload formula — the transfer is the stage
+# output, sized by the caller's microbatch, not a reference-env shape.
+__remote_dma_seams__ = {
+    "pipeline_apply": {"role": "stage-handoff"},
+    "pipeline_apply_interleaved": {"role": "stage-handoff"},
+}
+
 
 def stack_stage_params(per_stage_params: list):
     """[{name: arr}, ...] per stage -> {name: arr[S, ...]} stacked."""

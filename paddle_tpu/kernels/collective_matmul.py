@@ -45,6 +45,21 @@ import jax.numpy as jnp
 __all__ = ["RingSchedule", "ring_schedule", "allgather_matmul",
            "matmul_reduce_scatter"]
 
+# graftcomm seam marker: the ppermute call sites in these drivers ARE
+# the remote-DMA swap-in seam (ROADMAP direction 4).  `payload` is the
+# per-hop transfer as a graftmem byte formula — the travelling
+# activation shard [num_slots/tp, hidden] for the entry ring and the
+# travelling partial-sum accumulator chunk for the exit ring (same
+# shape after the reduce-scatter decomposition).
+__remote_dma_seams__ = {
+    "allgather_matmul": {
+        "role": "entry",
+        "payload": "num_slots // tp * hidden * itemsize"},
+    "matmul_reduce_scatter": {
+        "role": "exit",
+        "payload": "num_slots // tp * hidden * itemsize"},
+}
+
 
 class RingSchedule:
     """The ring decomposition's bookkeeping — perm table plus the

@@ -77,6 +77,20 @@ __all__ = ["plan_decode_block_tp", "ring_entry_matmul",
 # imported from decode_block (resolved statically through the import)
 __vmem_plans__ = ("plan_decode_block_tp",)
 
+# graftcomm seam marker (tools/analysis/comm.py): these Pallas ring
+# drivers share seam roles with the composed XLA drivers in
+# kernels/collective_matmul.py — the collective-order rule proves the
+# two lowerings issue hop-equivalent ppermute schedules, so either can
+# take the remote-DMA swap-in (ROADMAP direction 4)
+__remote_dma_seams__ = {
+    "ring_entry_matmul": {
+        "role": "entry",
+        "payload": "num_slots // tp * hidden * itemsize"},
+    "ring_exit_matmul": {
+        "role": "exit",
+        "payload": "num_slots // tp * hidden * itemsize"},
+}
+
 
 # ======================================================== planning / legality
 

@@ -17,7 +17,13 @@ compile-surface enumeration from registered entry points, the
 (``memory.py``): static HBM/VMEM byte accounting over the graftshape
 domain — pool-slab formulas, VMEM plan mirrors checked against declared
 budgets, the ``memory-budget`` rule, and the HBM capacity manifest
-(``scripts/graftlint.py --memory``).
+(``scripts/graftlint.py --memory``).  v6 adds graftcomm (``comm.py``):
+static collective-order and ring-symmetry analysis over the shard_map
+programs — per-program collective schedules, order-safety (no
+value-divergent issue), permutation-table validation, seam-role
+hop-equivalence (fused vs composed ring drivers), the
+``collective-order`` rule, and the cross-host seam manifest
+(``scripts/graftlint.py --comm``).
 
 Entry points:
   * ``python scripts/graftlint.py`` — the CLI (default scope:
@@ -50,6 +56,12 @@ from .memory import (PLAN_MIRRORS, REFERENCE_ENV, REFERENCE_TILINGS,
                      eval_formula, itemsize_bytes, memory_fingerprint,
                      memory_surface_for, register_byte_signature,
                      register_capacity_field)
+from .comm import (RING_REFERENCE_TPS, SCHEDULE_OPS,
+                   build_comm_manifest, build_comm_manifest_for_paths,
+                   comm_fingerprint, comm_surface_for,
+                   mirror_entry_src, mirror_exit_chunk,
+                   mirror_ring_perm, mirror_ring_schedule,
+                   register_comm_module, registered_comm_modules)
 
 __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "Suppressions", "AnalysisResult", "FileContext", "run_analysis",
@@ -66,4 +78,9 @@ __all__ = ["Finding", "ERROR", "WARNING", "parse_suppressions",
            "build_memory_manifest", "build_memory_manifest_for_paths",
            "eval_formula", "itemsize_bytes", "memory_fingerprint",
            "memory_surface_for", "register_byte_signature",
-           "register_capacity_field"]
+           "register_capacity_field",
+           "RING_REFERENCE_TPS", "SCHEDULE_OPS", "build_comm_manifest",
+           "build_comm_manifest_for_paths", "comm_fingerprint",
+           "comm_surface_for", "mirror_entry_src", "mirror_exit_chunk",
+           "mirror_ring_perm", "mirror_ring_schedule",
+           "register_comm_module", "registered_comm_modules"]

@@ -16,6 +16,7 @@ from .dtype_flow import DtypeFlowChecker
 from .sharding_consistency import ShardingConsistencyChecker
 from .compile_surface import CompileSurfaceChecker
 from .memory_budget import MemoryBudgetChecker
+from .collective_order import CollectiveOrderChecker
 
 __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "HostSyncChecker", "AxisNameChecker", "RegistryDriftChecker",
@@ -23,7 +24,8 @@ __all__ = ["Checker", "TracerLeakChecker", "RecompileChecker",
            "ResourceLifecycleChecker", "ResourcePair", "DEFAULT_PAIRS",
            "ShapeRecompileChecker", "DtypeFlowChecker",
            "ShardingConsistencyChecker", "CompileSurfaceChecker",
-           "MemoryBudgetChecker", "default_checkers"]
+           "MemoryBudgetChecker", "CollectiveOrderChecker",
+           "default_checkers"]
 
 
 def default_checkers():
@@ -41,4 +43,5 @@ def default_checkers():
         ShardingConsistencyChecker(),
         CompileSurfaceChecker(),
         MemoryBudgetChecker(),
+        CollectiveOrderChecker(),
     ]

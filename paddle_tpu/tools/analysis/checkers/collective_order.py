@@ -1,0 +1,116 @@
+"""collective-order: the comm plane proved deadlock-free (graftcomm).
+
+The tensor-parallel serving programs, the pipeline ticks and the
+context-parallel rings all stand on one SPMD invariant: every device
+issues the same collectives in the same order with permutation tables
+that are true permutations of the bound axis.  graftcomm
+(:mod:`..comm`) derives the schedule facts; this rule turns the
+violations into findings on the configured hot paths:
+
+  * **error** — a collective issued under value-divergent control flow
+    (an ``if`` whose test derives from ``axis_index``) or inside a
+    ``while`` loop: devices can disagree on issue order, which is a
+    deadlock at the first rendezvous.
+  * **error** — a literal ``ppermute`` table that is not a permutation
+    (duplicate source or destination device).
+  * **error** — seam drift: two drivers sharing a
+    ``__remote_dma_seams__`` role (the fused Pallas ring vs the
+    composed XLA ring) whose ppermute schedules are not hop-equivalent
+    — the remote-DMA swap-in would deadlock one of them.
+  * **error** — a collective axis that resolves (through
+    functools.partial bindings and module constants) to a name the
+    binding shard_map's literal axis set does not declare.
+  * **warning** — a ``jax.lax`` collective in a module that is neither
+    in :func:`..comm.registered_comm_modules` nor declares a
+    ``__remote_dma_seams__`` marker: an unregistered comm-plane
+    participant the manifest cannot account for.  Register the module
+    (or mark the seam) rather than suppressing — the warning usually
+    means the comm plane grew a surface the DMA direction does not
+    know about.
+
+Every finding carries ``properties.{op,axis,bytes,hops}`` into SARIF.
+Suppress with ``# graftlint: disable=collective-order -- reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional, Sequence
+
+from ..findings import ERROR, WARNING, Finding
+from .base import Checker
+
+DEFAULT_HOT_PATHS = (
+    "paddle_tpu/serving/*.py",
+    "paddle_tpu/kernels/*.py",
+    "paddle_tpu/distributed/*.py",
+    "paddle_tpu/distributed/*/*.py",
+    # the rule's own fixtures (anchored: fixture dir for CLI runs, bare
+    # basename for fixture-rooted library tests)
+    "tests/fixtures/lint/comm_*.py",
+    "comm_*.py",
+)
+
+# cheap token gate: a file with none of these can host neither a
+# collective issue site, a shard_map program, nor a seam marker
+_TOKENS = ("ppermute", "psum", "all_gather", "all_to_all", "shard_map",
+           "__remote_dma_seams__")
+
+
+class CollectiveOrderChecker(Checker):
+    name = "collective-order"
+    severity = ERROR
+
+    def __init__(self, hot_paths: Optional[Sequence[str]] = None):
+        self.hot_paths = tuple(hot_paths or DEFAULT_HOT_PATHS)
+
+    def check(self, ctx) -> List[Finding]:
+        if not any(fnmatch.fnmatch(ctx.relpath, p)
+                   for p in self.hot_paths):
+            return []
+        if not any(tok in ctx.src for tok in _TOKENS):
+            return []
+        if ctx.project is None:
+            return []
+        from ..comm import (SEAMS_DUNDER, comm_surface_for,
+                            registered_comm_modules)
+        surface = comm_surface_for(ctx.project)
+        findings: List[Finding] = []
+        for issue in surface.issues_for(ctx.relpath):
+            findings.append(Finding(
+                self.name, ctx.relpath, issue.line, issue.col,
+                f"[{issue.kind}] {issue.message}", ERROR,
+                props=(("op", issue.op), ("axis", issue.axis),
+                       ("bytes", issue.bytes), ("hops", issue.hops))))
+        findings.extend(self._check_registration(ctx, surface,
+                                                 registered_comm_modules(),
+                                                 SEAMS_DUNDER))
+        return findings
+
+    def _check_registration(self, ctx, surface, registered,
+                            dunder) -> List[Finding]:
+        """The warning leg: a module issuing ``jax.lax`` schedule ops
+        with neither a registration nor a seam marker."""
+        mod = ctx.project.module_for(ctx.relpath) \
+            if ctx.project is not None else None
+        if mod is None:
+            return []
+        if mod.name in registered or mod.name in surface.marker_modules:
+            return []
+        if not surface.module_has_sites(mod.name):
+            return []
+        first = surface.first_site_in(ctx.relpath, ctx.project)
+        if first is None:
+            return []
+        line, col, op = first
+        return [Finding(
+            self.name, ctx.relpath, line, col,
+            f"module '{mod.name}' issues jax.lax collectives but is "
+            f"not a registered comm module and declares no "
+            f"'{dunder}' marker — the comm manifest cannot account "
+            f"for this surface; register the module "
+            f"(comm.register_comm_module) or declare the seam",
+            WARNING,
+            props=(("op", op), ("axis", "?"), ("bytes", "?"),
+                   ("hops", "?")))]

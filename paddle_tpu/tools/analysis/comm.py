@@ -1,0 +1,1090 @@
+"""graftcomm — static collective-order and ring-symmetry analysis (v6).
+
+The cross-host data plane (ROADMAP direction 4) swaps the ring drivers'
+``jax.lax.ppermute`` hops for remote-DMA collectives, and the swap is
+only safe if the communication schedule is part of the program's STATIC
+contract: every device must issue the same collectives in the same
+order (anything value-divergent is an SPMD deadlock), every ppermute
+table must be a true permutation of the bound axis, and the fused
+(Pallas) and composed (XLA) lowerings of the same layer must be
+hop-equivalent so either can be swapped for the DMA form.  graftcomm
+proves those properties without importing anything, riding the v2
+project index, the v4 graftprog compile surface (shard_map program
+enumeration + trace-counter attribution) and the v5 graftmem reference
+environment (payload bytes per hop):
+
+  * **collective schedule extraction** — for every function issuing a
+    ``jax.lax`` schedule op (:data:`SCHEDULE_OPS`) the per-site (op,
+    axis, hop structure, perm-table kind) tuple, with hop counts probed
+    numerically over symbolic axis sizes so ``for hop in range(tp)``
+    under ``if hop < tp - 1`` classifies as ``tp-1`` hops;
+  * **order-safety** — a collective lexically under an ``if`` whose
+    test derives from ``axis_index`` (value-divergent issue order), or
+    inside a ``while`` loop (trip count not trace-static), is an error;
+  * **ring symmetry** — literal permutation tables are validated
+    (duplicate source or destination = not a permutation); seam
+    functions sharing a ``__remote_dma_seams__`` role must issue
+    hop-equivalent ppermute schedules (fused/composed drift is an
+    error); the live ``ring_schedule(tp)`` is pinned by the
+    line-faithful integer mirror below (the graftmem plan-mirror
+    precedent);
+  * **axis discipline** — collective axes inside shard_map bodies are
+    resolved cross-module (functools.partial keyword bindings, call
+    argument propagation, UPPERCASE module constants) and checked
+    against the shard_map's literal bound-axis set when one exists.
+
+The CI face is rule 14 ``collective-order``
+(:mod:`.checkers.collective_order`); the artifact face is the comm
+manifest (``scripts/graftlint.py --comm``): per-program collective
+schedules, the enumerated ``__remote_dma_seams__`` call sites with
+per-hop payload bytes at the flagship reference env — the sizing
+ladder for cross-host DMA — and the fused-vs-composed layer role
+paths whose equality the zz surface test asserts.
+
+Marker (module-level, ``ast.literal_eval``-able)::
+
+    __remote_dma_seams__ = {
+        "allgather_matmul": {"role": "entry",
+                             "payload": "num_slots // tp * hidden * itemsize"},
+    }
+
+``role`` groups hop-equivalent drivers across modules; ``payload`` is
+an optional graftmem byte formula for ONE hop's transfer (evaluated at
+the reference env for each tp in :data:`RING_REFERENCE_TPS`).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .checkers.base import dotted_name
+from .memory import (REFERENCE_ENV, FormulaError, eval_formula,
+                     _module_dunder)
+
+GRAFTCOMM_VERSION = 1
+SEAMS_DUNDER = "__remote_dma_seams__"
+
+# the schedule ops: collectives whose ISSUE ORDER is the deadlock
+# surface (axis_index/axis_size are reads, not rendezvous points)
+SCHEDULE_OPS: Tuple[str, ...] = ("all_gather", "all_to_all", "ppermute",
+                                 "psum", "psum_scatter")
+
+# axis sizes the ring mirror (and the hop prober) are pinned over
+RING_REFERENCE_TPS: Tuple[int, ...] = (2, 4, 8)
+
+# modules whose collectives are part of the registered comm plane but
+# are API wrappers / utility shims, not remote-DMA seams — they issue
+# collectives over caller-supplied axes and carry no seam marker
+DEFAULT_COMM_MODULES: FrozenSet[str] = frozenset({
+    "paddle_tpu.serving.tp",                       # owns the shard_map programs
+    "paddle_tpu.distributed.collective",           # public collective API
+    "paddle_tpu.distributed._jax_compat",          # axis_size shim
+    "paddle_tpu.distributed.auto_parallel.api",    # partial-axes psum
+    "paddle_tpu.distributed.meta_parallel.mp_layers",  # mp psum
+})
+_EXTRA_COMM_MODULES: List[str] = []
+
+
+def register_comm_module(name: str) -> None:
+    """Register a module as part of the known comm plane — its
+    collectives stop raising the unregistered-module warning."""
+    if name not in _EXTRA_COMM_MODULES:
+        _EXTRA_COMM_MODULES.append(name)
+
+
+def registered_comm_modules() -> FrozenSet[str]:
+    return DEFAULT_COMM_MODULES | frozenset(_EXTRA_COMM_MODULES)
+
+
+def comm_fingerprint() -> str:
+    """Stable content hash of the collective-order configuration — rule
+    version, schedule ops, registered comm modules and the reference
+    axis sizes.  Part of the walker's parse-cache version: registering
+    a comm module must never serve analysis state derived under the
+    old registrations."""
+    payload = "|".join((str(GRAFTCOMM_VERSION),
+                        ",".join(SCHEDULE_OPS),
+                        ",".join(sorted(registered_comm_modules())),
+                        ",".join(str(t) for t in RING_REFERENCE_TPS),
+                        SEAMS_DUNDER))
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()
+
+
+# ------------------------------------------------------- ring mirror
+
+def mirror_ring_perm(tp: int) -> List[Tuple[int, int]]:
+    """Line-faithful mirror of ``RingSchedule.__init__``'s perm table
+    (kernels/collective_matmul.py): device ``d`` sends to ``d + 1
+    (mod tp)``.  Same refusal, same message."""
+    if tp < 1:
+        raise ValueError(f"ring needs tp >= 1, got {tp}")
+    return [(d, (d + 1) % tp) for d in range(tp)]
+
+
+def mirror_entry_src(tp: int, idx: int, hop: int) -> int:
+    """Mirror of ``RingSchedule.entry_src``: origin device of the shard
+    held at ``hop`` — walks backwards around the ring."""
+    return (idx - hop) % tp
+
+
+def mirror_exit_chunk(tp: int, idx: int, hop: int) -> int:
+    """Mirror of ``RingSchedule.exit_chunk``: the row chunk whose
+    partial the exit ring computes at ``hop``."""
+    return (idx - hop - 1) % tp
+
+
+def mirror_ring_schedule(tp: int) -> Dict:
+    """The whole ring schedule as JSON-able integers: perm table plus
+    every device's entry_src/exit_chunk walk over all ``tp`` hops.
+    ``tests/test_zz_comm_surface.py`` pins this equal to the live
+    ``ring_schedule(tp)`` — the manifest's ring facts cannot drift from
+    the code the programs actually trace."""
+    perm = mirror_ring_perm(tp)
+    srcs = sorted(s for s, _ in perm)
+    dsts = sorted(d for _, d in perm)
+    return {
+        "tp": tp,
+        "perm": [[s, d] for s, d in perm],
+        "is_permutation": srcs == list(range(tp)) == dsts,
+        "entry_src": {str(d): [mirror_entry_src(tp, d, hop)
+                               for hop in range(tp)] for d in range(tp)},
+        "exit_chunk": {str(d): [mirror_exit_chunk(tp, d, hop)
+                                for hop in range(tp)] for d in range(tp)},
+    }
+
+
+# --------------------------------------------- per-site extraction
+
+def _collective_op(call: ast.Call) -> Optional[str]:
+    """The schedule-op name iff this is a ``jax.lax.<op>`` /
+    ``lax.<op>`` call — repo API wrappers (``collective.all_gather``)
+    are callers of the plane, not issue sites."""
+    d = dotted_name(call.func)
+    if not d:
+        return None
+    parts = d.split(".")
+    if len(parts) >= 2 and parts[-2] == "lax" and parts[-1] in SCHEDULE_OPS:
+        return parts[-1]
+    return None
+
+
+def _axis_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        # all_gather's `axis=` kwarg is the ARRAY axis (an int) — only
+        # treat `axis=` as the mesh axis when it can name one
+        if kw.arg == "axis" and not (
+                isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, int)):
+            return kw.value
+    return None
+
+
+def _perm_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "perm":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func)
+            if d and d.split(".")[-1] == "axis_index":
+                return True
+    return False
+
+
+def _tainted_names(fn_node: ast.AST) -> Set[str]:
+    """Names (transitively) derived from ``axis_index`` — the values a
+    device-divergent branch would test.  Bounded fixpoint over simple
+    assignments; attribute/subscript targets are out of scope (they
+    never feed the repo's branch tests)."""
+    tainted: Set[str] = set()
+    for _ in range(4):
+        changed = False
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) \
+                    and _expr_tainted(node.value, tainted):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _peval(node: ast.AST, n: int, var: Optional[str], i):
+    """Tiny integer evaluator for hop probing: every free Name is the
+    symbolic axis size ``n`` except the loop variable ``var`` which is
+    the current iteration ``i``.  Raises on anything else."""
+    if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                     (int, bool)):
+        return node.value
+    if isinstance(node, ast.Name):
+        if var is not None and node.id == var:
+            if i is None:
+                raise FormulaError("loop var outside iteration")
+            return i
+        return n
+    if isinstance(node, ast.UnaryOp):
+        v = _peval(node.operand, n, var, i)
+        if isinstance(node.op, ast.USub):
+            return -v
+        if isinstance(node.op, ast.Not):
+            return not v
+        raise FormulaError("unary op")
+    if isinstance(node, ast.BinOp):
+        a = _peval(node.left, n, var, i)
+        b = _peval(node.right, n, var, i)
+        ops = {ast.Add: lambda: a + b, ast.Sub: lambda: a - b,
+               ast.Mult: lambda: a * b, ast.FloorDiv: lambda: a // b,
+               ast.Mod: lambda: a % b}
+        for k, f in ops.items():
+            if isinstance(node.op, k):
+                return f()
+        raise FormulaError("bin op")
+    if isinstance(node, ast.Compare) and len(node.ops) == 1:
+        a = _peval(node.left, n, var, i)
+        b = _peval(node.comparators[0], n, var, i)
+        ops = {ast.Lt: a < b, ast.LtE: a <= b, ast.Gt: a > b,
+               ast.GtE: a >= b, ast.Eq: a == b, ast.NotEq: a != b}
+        for k, v in ops.items():
+            if isinstance(node.ops[0], k):
+                return v
+        raise FormulaError("compare")
+    if isinstance(node, ast.BoolOp):
+        vals = [_peval(v, n, var, i) for v in node.values]
+        return all(vals) if isinstance(node.op, ast.And) else any(vals)
+    raise FormulaError("unsupported probe construct")
+
+
+def _probe_hops(loops: List[ast.For],
+                guards: List[Tuple[ast.AST, bool]]) -> str:
+    """Classify how many times a collective site issues per trace:
+    ``"1"`` (straight line), ``"tp"`` / ``"tp-1"`` (full /
+    all-but-last ring walk — probed numerically at symbolic axis sizes
+    8 and 4), a constant count, or ``"?"`` (unprovable)."""
+    if not loops:
+        return "1"
+    loop = loops[-1]
+    if not isinstance(loop.target, ast.Name):
+        return "?"
+    var = loop.target.id
+    it = loop.iter
+    if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range" and 1 <= len(it.args) <= 3):
+        return "?"
+    counts = []
+    for n in (8, 4):
+        try:
+            rargs = [_peval(a, n, None, None) for a in it.args]
+            idxs = list(range(*rargs))
+        except (FormulaError, TypeError, ValueError):
+            return "?"
+        c = 0
+        for i in idxs:
+            admit = True
+            for test, negated in guards:
+                try:
+                    v = bool(_peval(test, n, var, i))
+                except (FormulaError, TypeError, ValueError):
+                    return "?"
+                if negated:
+                    v = not v
+                if not v:
+                    admit = False
+                    break
+            if admit:
+                c += 1
+        counts.append((n, c))
+    if all(c == n for n, c in counts):
+        return "tp"
+    if all(c == n - 1 for n, c in counts):
+        return "tp-1"
+    if counts[0][1] == counts[1][1]:
+        return str(counts[0][1])
+    return "?"
+
+
+def _is_shift_comprehension(expr: ast.AST) -> bool:
+    """``[(i, (i + k) % N) for i in range(N)]`` — the neighbor-ring
+    table every in-tree driver builds."""
+    if not (isinstance(expr, ast.ListComp)
+            and len(expr.generators) == 1
+            and isinstance(expr.generators[0].target, ast.Name)
+            and isinstance(expr.elt, ast.Tuple)
+            and len(expr.elt.elts) == 2):
+        return False
+    var = expr.generators[0].target.id
+    src, dst = expr.elt.elts
+    if not (isinstance(src, ast.Name) and src.id == var):
+        return False
+    if not (isinstance(dst, ast.BinOp) and isinstance(dst.op, ast.Mod)):
+        return False
+    return any(isinstance(sub, ast.Name) and sub.id == var
+               for sub in ast.walk(dst.left))
+
+
+def _local_assign_value(fn_node: ast.AST, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name:
+            return node.value
+    return None
+
+
+_RING_FACTORIES = frozenset({"ring_schedule", "RingSchedule"})
+
+
+def _table_kind(call: ast.Call,
+                fn_node: ast.AST) -> Tuple[str, Optional[str]]:
+    """(kind, error): ``neighbor`` (ring-schedule object or shift
+    comprehension), ``literal`` (validated — duplicate src/dst is the
+    error), ``other`` (parameter/unknown: the caller's contract)."""
+    perm = _perm_arg(call)
+    if perm is None:
+        return "other", None
+    if isinstance(perm, ast.Attribute) and perm.attr == "perm" \
+            and isinstance(perm.value, ast.Name):
+        src = _local_assign_value(fn_node, perm.value.id)
+        if isinstance(src, ast.Call):
+            d = dotted_name(src.func)
+            if d and d.split(".")[-1] in _RING_FACTORIES:
+                return "neighbor", None
+        return "other", None
+    if isinstance(perm, ast.Name):
+        src = _local_assign_value(fn_node, perm.id)
+        if src is None:
+            return "other", None
+        perm = src
+    if _is_shift_comprehension(perm):
+        return "neighbor", None
+    try:
+        lit = ast.literal_eval(perm)
+    except (ValueError, SyntaxError):
+        return "other", None
+    if not (isinstance(lit, (list, tuple)) and lit
+            and all(isinstance(p, (list, tuple)) and len(p) == 2
+                    and all(isinstance(e, int) for e in p)
+                    for p in lit)):
+        return "other", None
+    srcs = [p[0] for p in lit]
+    dsts = [p[1] for p in lit]
+    if len(set(srcs)) != len(srcs):
+        return "literal", "duplicate source device in permutation table"
+    if len(set(dsts)) != len(dsts):
+        return "literal", ("duplicate destination device in "
+                           "permutation table")
+    return "literal", None
+
+
+@dataclass
+class CollectiveSite:
+    """One ``jax.lax`` schedule-op issue site inside one function."""
+    op: str
+    line: int
+    col: int
+    axis_literal: Optional[str] = None  # resolved constant axis, if any
+    axis_param: Optional[str] = None    # the Name feeding the axis arg
+    hops: str = "1"
+    table: str = "-"                    # ppermute perm-table kind
+    table_error: Optional[str] = None
+    divergent: Optional[str] = None     # order-safety violation reason
+
+
+def _sites_for_fn(fn_node: ast.AST) -> List[CollectiveSite]:
+    """Every schedule-op site in ``fn_node`` with its order-safety and
+    ring-symmetry facts, in source order.  The lexical walk tracks the
+    divergence context (tainted ``if`` tests, ``while`` loops), the
+    enclosing ``for`` loops (hop probing) and the untainted guards that
+    gate the site — nested ``def``/``lambda`` bodies reset the lexical
+    context (they run when called, not where written)."""
+    tainted = _tainted_names(fn_node)
+    sites: List[CollectiveSite] = []
+
+    def visit(node, guards, loops, divergent):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            for c in body:
+                visit(c, [], [], None)
+            return
+        if isinstance(node, ast.If):
+            bad = _expr_tainted(node.test, tainted)
+            reason = ("issued under a value-divergent `if` (test "
+                      "derives from axis_index)") if bad else None
+            visit(node.test, guards, loops, divergent)
+            g = guards if bad else guards + [(node.test, False)]
+            for c in node.body:
+                visit(c, g, loops, divergent or reason)
+            g = guards if bad else guards + [(node.test, True)]
+            for c in node.orelse:
+                visit(c, g, loops, divergent or reason)
+            return
+        if isinstance(node, ast.IfExp):
+            bad = _expr_tainted(node.test, tainted)
+            reason = ("issued under a value-divergent conditional "
+                      "expression (test derives from axis_index)") \
+                if bad else None
+            visit(node.test, guards, loops, divergent)
+            visit(node.body,
+                  guards if bad else guards + [(node.test, False)],
+                  loops, divergent or reason)
+            visit(node.orelse,
+                  guards if bad else guards + [(node.test, True)],
+                  loops, divergent or reason)
+            return
+        if isinstance(node, ast.While):
+            reason = ("issued inside a `while` loop (trip count is not "
+                      "trace-static)")
+            visit(node.test, guards, loops, divergent)
+            for c in node.body + node.orelse:
+                visit(c, guards, loops, divergent or reason)
+            return
+        if isinstance(node, ast.For):
+            visit(node.iter, guards, loops, divergent)
+            for c in node.body + node.orelse:
+                visit(c, guards, loops + [node], divergent)
+            return
+        if isinstance(node, ast.Call):
+            op = _collective_op(node)
+            if op is not None:
+                site = CollectiveSite(op=op, line=node.lineno,
+                                      col=node.col_offset,
+                                      divergent=divergent)
+                axis = _axis_arg(node)
+                if isinstance(axis, ast.Constant) \
+                        and isinstance(axis.value, str):
+                    site.axis_literal = axis.value
+                elif axis is not None:
+                    d = dotted_name(axis)
+                    if d:
+                        site.axis_param = d
+                site.hops = _probe_hops(loops, guards)
+                if op == "ppermute":
+                    site.table, site.table_error = _table_kind(node,
+                                                               fn_node)
+                sites.append(site)
+        for c in ast.iter_child_nodes(node):
+            visit(c, guards, loops, divergent)
+
+    for stmt in getattr(fn_node, "body", []):
+        visit(stmt, [], [], None)
+    sites.sort(key=lambda s: (s.line, s.col))
+    return sites
+
+
+# -------------------------------------------------------- seam decls
+
+@dataclass
+class SeamSpec:
+    qname: str
+    module: str
+    relpath: str
+    fn: str
+    role: str
+    payload: Optional[str]
+    marker_line: int
+    fn_line: int = 0
+    sites: List[Dict] = field(default_factory=list)   # ppermute sites
+    signature: Tuple[Tuple[str, str, str], ...] = ()
+
+
+def _seam_decls(tree: ast.Module) -> Tuple[Dict[str, Dict], int]:
+    stmt = _module_dunder(tree, SEAMS_DUNDER)
+    if stmt is None:
+        return {}, 0
+    try:
+        val = ast.literal_eval(stmt.value)
+    except (ValueError, SyntaxError):
+        return {}, stmt.lineno
+    out: Dict[str, Dict] = {}
+    if isinstance(val, dict):
+        for fn, spec in val.items():
+            if isinstance(fn, str) and isinstance(spec, dict) \
+                    and isinstance(spec.get("role"), str):
+                payload = spec.get("payload")
+                out[fn] = {"role": spec["role"],
+                           "payload": payload
+                           if isinstance(payload, str) else None}
+    return out, stmt.lineno
+
+
+# ---------------------------------------------------- comm surface
+
+BUILD_COUNT = 0    # observable: the token-gate test asserts inert
+                   # files never trigger a surface build
+
+
+@dataclass
+class CommIssue:
+    kind: str       # divergent-issue | bad-table | schedule-drift |
+                    # unbound-axis
+    relpath: str
+    line: int
+    col: int
+    message: str
+    op: str = "?"
+    axis: str = "?"
+    bytes: str = "?"
+    hops: str = "?"
+
+
+@dataclass
+class CommSurface:
+    """Everything graftcomm derives for one project, built once per
+    analysis run (same caching contract as graftprog/graftmem)."""
+    sites_by_fn: Dict[str, List[CollectiveSite]] = field(
+        default_factory=dict)
+    fn_module: Dict[str, str] = field(default_factory=dict)
+    seams: Dict[str, SeamSpec] = field(default_factory=dict)
+    marker_modules: Set[str] = field(default_factory=set)
+    issues: List[CommIssue] = field(default_factory=list)
+    programs: Dict[str, Dict] = field(default_factory=dict)
+    seam_programs: Dict[str, List[Dict]] = field(default_factory=dict)
+    layer_paths: Dict[str, Dict] = field(default_factory=dict)
+
+    def issues_for(self, relpath: str) -> List[CommIssue]:
+        return [i for i in self.issues if i.relpath == relpath]
+
+    def module_has_sites(self, module: str) -> bool:
+        return any(m == module for m in self.fn_module.values())
+
+    def first_site_in(self, relpath: str, project) -> Optional[Tuple]:
+        best = None
+        for qname, sites in self.sites_by_fn.items():
+            fi = project.resolve_qname(qname)
+            if fi is None or fi.relpath != relpath or not sites:
+                continue
+            s = sites[0]
+            if best is None or (s.line, s.col) < (best[0], best[1]):
+                best = (s.line, s.col, s.op)
+        return best
+
+
+def _str_value(project, mod_name: str,
+               node: ast.AST) -> Optional[str]:
+    """A string the binding propagation understands: a literal, or a
+    Name/Attribute resolving to an UPPERCASE module string constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    d = dotted_name(node)
+    if d:
+        return project.resolve_str_const(mod_name, d)
+    return None
+
+
+_PARTIAL_NAMES = ("functools.partial", "partial")
+
+
+def _resolve_unit_body(project, unit):
+    """(FunctionInfo, partial keyword bindings) for a shard_map unit's
+    traced body — chasing the ``body = functools.partial(_tp_decode_body,
+    ..., axis=TP_AXIS)`` idiom through the OWNER function's scope (the
+    shard_map call often sits in a nested closure while the partial is
+    assigned in the builder).  String-valued partial keywords become
+    the body's parameter bindings."""
+    mod = project.modules.get(unit.module)
+    call = unit.call
+    if mod is None or call is None or not call.args:
+        return None, {}
+    owner = project.resolve_qname(unit.owner) if unit.owner else None
+    scopes = ([owner.node] if owner is not None else []) + [mod.tree]
+    bindings: Dict[str, str] = {}
+    expr = call.args[0]
+    for _ in range(6):
+        if isinstance(expr, ast.Call) \
+                and dotted_name(expr.func) in _PARTIAL_NAMES \
+                and expr.args:
+            for kw in expr.keywords:
+                if kw.arg is None:
+                    continue
+                v = _str_value(project, mod.name, kw.value)
+                if v is not None:
+                    bindings.setdefault(kw.arg, v)
+            expr = expr.args[0]
+            continue
+        d = dotted_name(expr)
+        if d is None:
+            return None, bindings
+        fi = project.resolve_call(
+            mod.name, d, cls=owner.cls if owner is not None else None)
+        if fi is not None:
+            return fi, bindings
+        if "." in d:
+            return None, bindings
+        src = None
+        for sn in scopes:
+            src = _local_assign_value(sn, d)
+            if src is not None:
+                break
+        if src is None:
+            return None, bindings
+        expr = src
+    return None, bindings
+
+
+def _literal_axis_names(call: Optional[ast.Call]) -> Optional[FrozenSet[str]]:
+    """The shard_map call's literal bound-axis set (``axis_names=`` /
+    ``manual_axes=``), or None when the binding is not literal — full
+    manual shard_maps bind through the mesh, which is the caller's
+    contract."""
+    if call is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("axis_names", "manual_axes"):
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return None
+            if isinstance(val, (set, frozenset, tuple, list)) \
+                    and all(isinstance(v, str) for v in val):
+                return frozenset(val)
+            return None
+    return None
+
+
+def _callee_params(fn_info) -> List[str]:
+    a = fn_info.node.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _call_bindings(project, mod_name: str, call: ast.Call, callee,
+                   bindings: Dict[str, str]) -> Dict[str, str]:
+    """Propagate string-valued axis bindings through one call edge:
+    positional and keyword args that are literals, already-bound names,
+    or module constants become the callee's parameter bindings."""
+    params = _callee_params(callee)
+    if callee.cls is not None and params and params[0] == "self":
+        params = params[1:]
+    out: Dict[str, str] = {}
+
+    def value_of(node):
+        if isinstance(node, ast.Name) and node.id in bindings:
+            return bindings[node.id]
+        return _str_value(project, mod_name, node)
+
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(params):
+            v = value_of(arg)
+            if v is not None:
+                out[params[i]] = v
+    kwonly = [p.arg for p in callee.node.args.kwonlyargs]
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        if kw.arg in params or kw.arg in kwonly:
+            v = value_of(kw.value)
+            if v is not None:
+                out[kw.arg] = v
+    return out
+
+
+def _resolve_call_wide(project, fi, dotted: Optional[str],
+                       local_imports: Dict[str, str]):
+    """resolve_call widened with the function-local import table — the
+    serving stack leans on deferred in-function imports for the ring
+    drivers, which the module-level index cannot see."""
+    from .compile_surface import _resolve_in_fn
+    if not dotted:
+        return None
+    return _resolve_in_fn(project, fi, dotted, local_imports)
+
+
+def _fn_locals(project, fi) -> Dict[str, str]:
+    from .compile_surface import _fn_local_imports
+    mod = project.modules.get(fi.module)
+    return _fn_local_imports(mod, fi.node) if mod is not None else {}
+
+
+def _call_index(project):
+    """One cheap pass over every function: the dotted names it calls
+    (with line/col for lexical ordering and the basename for fast
+    candidate filtering) and whether its body carries function-local
+    imports.  Every later stage filters on basenames BEFORE paying for
+    resolution — full-project resolution is what made the naive
+    surface build dominate a warm lint run."""
+    calls: Dict[str, List[Tuple[int, int, str, str]]] = {}
+    has_import: Set[str] = set()
+    for fi in project.all_functions():
+        names: List[Tuple[int, int, str, str]] = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d:
+                    names.append((node.lineno, node.col_offset, d,
+                                  d.rsplit(".", 1)[-1]))
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                has_import.add(fi.qname)
+        if names:
+            names.sort()
+            calls[fi.qname] = names
+    return calls, has_import
+
+
+def _locals_if_any(project, fi, has_import: Set[str]) -> Dict[str, str]:
+    return _fn_locals(project, fi) if fi.qname in has_import else {}
+
+
+def _collective_closure(project, calls, has_import,
+                        fi_by_qname: Dict[str, object],
+                        sites_by_fn: Dict[str, List]) -> Set[str]:
+    """Functions that transitively reach a collective issue site —
+    the only ones the program-schedule walk needs to descend into.
+    Resolution only runs for calls whose basename matches a closure
+    member's basename (a sound pre-filter: a dotted call cannot
+    resolve to a function whose name it does not end with)."""
+    closure = set(sites_by_fn)
+    for _ in range(8):
+        changed = False
+        closure_bases = {q.rsplit(".", 1)[-1] for q in closure}
+        for qname, names in calls.items():
+            if qname in closure:
+                continue
+            cand = [d for _, _, d, b in names if b in closure_bases]
+            if not cand:
+                continue
+            fi = fi_by_qname.get(qname)
+            if fi is None:
+                continue
+            local = _locals_if_any(project, fi, has_import)
+            for d in cand:
+                tgt = _resolve_call_wide(project, fi, d, local)
+                if tgt is not None and tgt.qname in closure:
+                    closure.add(qname)
+                    changed = True
+                    break
+        if not changed:
+            break
+    return closure
+
+
+def _walk_schedule(project, surf: CommSurface, closure: Set[str],
+                   fn_info, bindings: Dict[str, str],
+                   bound_axes: Optional[FrozenSet[str]],
+                   schedule: List[Dict], visited: Set[str],
+                   stack: Tuple[str, ...], depth: int) -> None:
+    visited.add(fn_info.qname)
+    local_imports = _fn_locals(project, fn_info)
+    site_map = {(s.line, s.col): s
+                for s in surf.sites_by_fn.get(fn_info.qname, ())}
+    calls = [n for n in ast.walk(fn_info.node)
+             if isinstance(n, ast.Call)]
+    calls.sort(key=lambda n: (n.lineno, n.col_offset))
+    for call in calls:
+        site = site_map.get((call.lineno, call.col_offset))
+        if site is not None:
+            axis = site.axis_literal
+            if axis is None and site.axis_param:
+                axis = bindings.get(site.axis_param) \
+                    or project.resolve_str_const(fn_info.module,
+                                                 site.axis_param)
+            schedule.append({"op": site.op, "axis": axis or "?",
+                             "hops": site.hops, "line": site.line,
+                             "module": fn_info.module})
+            if bound_axes is not None and axis is not None \
+                    and axis not in bound_axes:
+                surf.issues.append(CommIssue(
+                    kind="unbound-axis", relpath=fn_info.relpath,
+                    line=site.line, col=site.col,
+                    message=(f"collective '{site.op}' issues over axis "
+                             f"'{axis}' but the binding shard_map "
+                             f"declares axes "
+                             f"{sorted(bound_axes)} — the axis never "
+                             f"exists inside this program"),
+                    op=site.op, axis=axis, hops=site.hops))
+            continue
+        if depth >= 4:
+            continue
+        callee = _resolve_call_wide(project, fn_info,
+                                    dotted_name(call.func),
+                                    local_imports)
+        if callee is None or callee.qname in stack \
+                or callee.qname not in closure:
+            continue
+        sub = _call_bindings(project, fn_info.module, call, callee,
+                             bindings)
+        _walk_schedule(project, surf, closure, callee, sub, bound_axes,
+                       schedule, visited, stack + (callee.qname,),
+                       depth + 1)
+
+
+def build_comm_surface(project) -> CommSurface:
+    global BUILD_COUNT
+    BUILD_COUNT += 1
+    surf = CommSurface()
+    calls, has_import = _call_index(project)
+    fi_by_qname = {fi.qname: fi for fi in project.all_functions()}
+    ops = set(SCHEDULE_OPS)
+
+    # 1. per-function collective sites (order-safety + table facts) —
+    # only functions that textually call a collective can have any
+    for fi in project.all_functions():
+        if not any(b in ops for _, _, _, b in calls.get(fi.qname, ())):
+            continue
+        sites = _sites_for_fn(fi.node)
+        if sites:
+            surf.sites_by_fn[fi.qname] = sites
+            surf.fn_module[fi.qname] = fi.module
+            for s in sites:
+                if s.divergent:
+                    surf.issues.append(CommIssue(
+                        kind="divergent-issue", relpath=fi.relpath,
+                        line=s.line, col=s.col,
+                        message=(f"collective '{s.op}' {s.divergent} — "
+                                 f"devices can disagree on issue order "
+                                 f"(SPMD deadlock); hoist the "
+                                 f"collective out of the divergent "
+                                 f"region or make the trip count "
+                                 f"trace-static"),
+                        op=s.op, axis=s.axis_literal or "?",
+                        hops=s.hops))
+                if s.table_error:
+                    surf.issues.append(CommIssue(
+                        kind="bad-table", relpath=fi.relpath,
+                        line=s.line, col=s.col,
+                        message=(f"ppermute table is not a permutation "
+                                 f"({s.table_error}) — two devices "
+                                 f"would send to (or receive from) the "
+                                 f"same peer and the collective "
+                                 f"deadlocks"),
+                        op=s.op, axis=s.axis_literal or "?",
+                        hops=s.hops))
+
+    # 2. seam markers
+    for mod in project.modules.values():
+        decls, marker_line = _seam_decls(mod.tree)
+        if marker_line:
+            surf.marker_modules.add(mod.name)
+        for fn_name, spec in decls.items():
+            fi = project.resolve_call(mod.name, fn_name)
+            if fi is None:
+                continue
+            qname = fi.qname
+            ppsites = [s for s in surf.sites_by_fn.get(qname, ())
+                       if s.op == "ppermute"]
+            seam = SeamSpec(
+                qname=qname, module=mod.name, relpath=mod.relpath,
+                fn=fn_name, role=spec["role"], payload=spec["payload"],
+                marker_line=marker_line, fn_line=fi.node.lineno,
+                sites=[{"line": s.line, "hops": s.hops,
+                        "table": s.table} for s in ppsites],
+                signature=tuple((s.op, s.hops, s.table)
+                                for s in ppsites))
+            surf.seams[qname] = seam
+
+    # 3. ring-symmetry drift: same role => hop-equivalent schedules
+    by_role: Dict[str, List[SeamSpec]] = {}
+    for seam in surf.seams.values():
+        by_role.setdefault(seam.role, []).append(seam)
+    for role, members in sorted(by_role.items()):
+        members.sort(key=lambda s: s.qname)
+        ref = members[0]
+        for other in members[1:]:
+            if other.signature != ref.signature:
+                line = other.sites[0]["line"] if other.sites \
+                    else other.fn_line
+                surf.issues.append(CommIssue(
+                    kind="schedule-drift", relpath=other.relpath,
+                    line=line, col=0,
+                    message=(f"'{other.fn}' declares seam role "
+                             f"'{role}' but issues schedule "
+                             f"{list(other.signature)} while "
+                             f"'{ref.qname}' issues "
+                             f"{list(ref.signature)} — fused and "
+                             f"composed lowerings of one role must be "
+                             f"hop-equivalent or the DMA swap-in "
+                             f"deadlocks one of them"),
+                    op="ppermute",
+                    hops=other.sites[0]["hops"] if other.sites
+                    else "?"))
+
+    # 4. program schedules from the graftprog shard_map units
+    from .compile_surface import surface_for
+    prog_surface = surface_for(project)
+    closure = _collective_closure(project, calls, has_import,
+                                  fi_by_qname, surf.sites_by_fn)
+    for unit in prog_surface.units:
+        if unit.kind != "shard_map":
+            continue
+        fi, bindings = _resolve_unit_body(project, unit)
+        if fi is None or fi.qname not in closure:
+            continue
+        bound_axes = _literal_axis_names(unit.call)
+        schedule: List[Dict] = []
+        visited: Set[str] = set()
+        _walk_schedule(project, surf, closure, fi, bindings,
+                       bound_axes, schedule, visited, (fi.qname,), 0)
+        if not schedule:
+            continue
+        surf.programs[unit.uid] = {
+            "counter": unit.counter, "module": unit.module,
+            "body": fi.qname, "line": unit.line,
+            "roots": list(unit.roots), "schedule": schedule}
+        for qname in visited:
+            if qname in surf.seams:
+                progs = surf.seam_programs.setdefault(qname, [])
+                entry = {"uid": unit.uid, "counter": unit.counter}
+                if entry not in progs:
+                    progs.append(entry)
+
+    # 5. layer role paths: functions calling >= 2 seam drivers — the
+    # fused-vs-composed equivalence object the zz test asserts on
+    seam_bases = {q.rsplit(".", 1)[-1] for q in surf.seams}
+    for qname, names in calls.items():
+        if qname in surf.seams:
+            continue
+        cand = [(ln, col, d) for ln, col, d, b in names
+                if b in seam_bases]
+        if len(cand) < 2:
+            continue
+        fi = fi_by_qname.get(qname)
+        if fi is None:
+            continue
+        local_imports = _locals_if_any(project, fi, has_import)
+        roles = []
+        for _, _, d in cand:
+            callee = _resolve_call_wide(project, fi, d, local_imports)
+            if callee is not None and callee.qname in surf.seams:
+                roles.append(surf.seams[callee.qname].role)
+        if len(roles) >= 2:
+            surf.layer_paths[qname] = {"module": fi.module,
+                                       "roles": roles}
+
+    for progs in surf.seam_programs.values():
+        progs.sort(key=lambda p: p["uid"])
+    return surf
+
+
+def comm_surface_for(project) -> CommSurface:
+    """Per-project surface cache (the checker and the manifest share
+    one build per analysis run — same contract as graftprog's and
+    graftmem's ``surface_for``)."""
+    surf = getattr(project, "_graftcomm_surface", None)
+    if surf is None:
+        surf = build_comm_surface(project)
+        setattr(project, "_graftcomm_surface", surf)
+    return surf
+
+
+# ----------------------------------------------------------- manifest
+
+def _payload_bytes(formula: Optional[str]) -> Optional[Dict[str, int]]:
+    if not formula:
+        return None
+    out: Dict[str, int] = {}
+    for tp in RING_REFERENCE_TPS:
+        try:
+            out[f"tp={tp}"] = eval_formula(
+                formula, dict(REFERENCE_ENV, tp=tp))
+        except FormulaError:
+            return None
+    return out
+
+
+def build_comm_manifest(project) -> Dict:
+    """The deterministic comm-plane artifact behind
+    ``scripts/graftlint.py --comm``: the ring mirror, every declared
+    seam with per-hop payload bytes at the reference env, every
+    shard_map program's collective schedule, the layer role paths, and
+    the order-safety verdict.  Serialize with
+    :func:`.report.format_manifest` — byte-identical across runs."""
+    surf = comm_surface_for(project)
+    seams = {}
+    for qname, seam in sorted(surf.seams.items()):
+        seams[qname] = {
+            "role": seam.role,
+            "module": seam.module,
+            "declared_at": f"{seam.relpath}:{seam.marker_line}",
+            "fn_line": seam.fn_line,
+            "payload_formula": seam.payload,
+            "per_hop_payload_bytes": _payload_bytes(seam.payload),
+            "ppermute_sites": seam.sites,
+            "signature": [":".join(sig) for sig in seam.signature],
+            "programs": surf.seam_programs.get(qname, []),
+        }
+    roles: Dict[str, Dict] = {}
+    by_role: Dict[str, List[SeamSpec]] = {}
+    for seam in surf.seams.values():
+        by_role.setdefault(seam.role, []).append(seam)
+    for role, members in sorted(by_role.items()):
+        members.sort(key=lambda s: s.qname)
+        roles[role] = {
+            "members": [s.qname for s in members],
+            "signature": [":".join(sig)
+                          for sig in members[0].signature],
+            "equivalent": all(s.signature == members[0].signature
+                              for s in members),
+        }
+    issues = [{"kind": i.kind, "path": i.relpath, "line": i.line,
+               "op": i.op, "message": i.message}
+              for i in sorted(surf.issues,
+                              key=lambda x: (x.relpath, x.line,
+                                             x.kind))]
+    return {
+        "graftcomm_version": GRAFTCOMM_VERSION,
+        "fingerprint": comm_fingerprint(),
+        "ops": list(SCHEDULE_OPS),
+        "ring_reference_tps": list(RING_REFERENCE_TPS),
+        "reference_env": {
+            "env": dict(REFERENCE_ENV),
+            "note": ("per-hop payload bytes are evaluated at this "
+                     "graftmem flagship environment with the seam's "
+                     "formula, for each tp in ring_reference_tps — "
+                     "the sizing ladder for cross-host DMA"),
+        },
+        "ring_mirror": {f"tp={tp}": mirror_ring_schedule(tp)
+                        for tp in RING_REFERENCE_TPS},
+        "comm_modules": sorted(registered_comm_modules()),
+        "seams": seams,
+        "roles": roles,
+        "programs": {uid: surf.programs[uid]
+                     for uid in sorted(surf.programs)},
+        "layer_paths": {q: surf.layer_paths[q]
+                        for q in sorted(surf.layer_paths)},
+        "order_safety": {"ok": not surf.issues, "issues": issues},
+        "note": ("program schedules enumerate every lexically "
+                 "reachable collective site in source order (both "
+                 "legality branches of a decode body included); role "
+                 "equivalence is the fused-vs-composed proof"),
+    }
+
+
+def build_comm_manifest_for_paths(paths: Sequence[str],
+                                  root: Optional[str] = None,
+                                  cache_path: Optional[str] = None
+                                  ) -> Dict:
+    """Parse ``paths`` (through the shared on-disk parse cache when
+    given), build the project index, and return the comm manifest —
+    the CLI's ``--comm`` entry point and the zz surface test's library
+    hook."""
+    import os
+    from pathlib import Path
+    from .walker import _ParseCache, _parse_files
+    from .project import build_project
+    root_str = str(Path(root).resolve()) if root else os.getcwd()
+    cache = _ParseCache(cache_path)
+    parsed = _parse_files(paths, root_str, cache)
+    cache.save()
+    project = build_project((pf.relpath, pf.tree, pf.sup)
+                            for pf in parsed.values()
+                            if pf.tree is not None)
+    return build_comm_manifest(project)

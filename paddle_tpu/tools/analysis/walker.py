@@ -59,12 +59,13 @@ def _cache_version() -> Tuple:
     tables participate — a runtime ``register_signature`` /
     ``register_entry_point`` (or an edited table) must never serve
     analysis state derived under the old registrations."""
+    from .comm import comm_fingerprint
     from .entrypoints import entry_point_fingerprint
     from .memory import memory_fingerprint
     from .signatures import table_fingerprint
     return (4, sys.version_info[:2], _analysis_fingerprint(),
             table_fingerprint(), entry_point_fingerprint(),
-            memory_fingerprint())
+            memory_fingerprint(), comm_fingerprint())
 
 
 @dataclass

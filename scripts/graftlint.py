@@ -15,6 +15,8 @@ Usage:
                                                   # manifest (JSON)
     python scripts/graftlint.py --memory          # graftmem HBM capacity
                                                   # manifest (JSON)
+    python scripts/graftlint.py --comm            # graftcomm cross-host
+                                                  # seam manifest (JSON)
 
 Default scope is the library AND the perf-critical entrypoints:
 ``paddle_tpu/``, ``bench.py``, ``__graft_entry__.py``, ``scripts/``.
@@ -129,6 +131,10 @@ def main(argv=None) -> int:
                     help="emit the graftmem HBM capacity manifest "
                          "(deterministic JSON) over the default scope "
                          "and exit")
+    ap.add_argument("--comm", action="store_true", dest="comm",
+                    help="emit the graftcomm cross-host seam manifest "
+                         "(deterministic JSON) over the default scope "
+                         "and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -155,6 +161,15 @@ def main(argv=None) -> int:
                      "cannot be combined with --changed/--since/paths")
         cache = None if args.no_cache else CACHE_PATH
         manifest = _analysis.build_memory_manifest_for_paths(
+            scope, root=ROOT, cache_path=cache)
+        print(_analysis.format_manifest(manifest))
+        return 0
+    if args.comm:
+        if args.changed or args.since or args.paths:
+            ap.error("--comm walks the whole default scope; it "
+                     "cannot be combined with --changed/--since/paths")
+        cache = None if args.no_cache else CACHE_PATH
+        manifest = _analysis.build_comm_manifest_for_paths(
             scope, root=ROOT, cache_path=cache)
         print(_analysis.format_manifest(manifest))
         return 0

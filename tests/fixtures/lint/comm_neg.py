@@ -1,0 +1,37 @@
+"""collective-order fixture: clean comm plane — zero findings.
+
+The guarded neighbour ring, a declared seam marker, and a shard_map
+whose body reduces over the axis the program actually binds.
+"""
+
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+__remote_dma_seams__ = {
+    "ring_entry": {
+        "role": "entry",
+        "payload": "num_slots // tp * hidden * itemsize"},
+}
+
+
+def ring_entry(x, w, axis_name, tp):
+    perm = [(i, (i + 1) % tp) for i in range(tp)]
+    out = x @ w
+    for hop in range(tp):
+        nxt = jax.lax.ppermute(x, axis_name, perm) \
+            if hop < tp - 1 else None
+        out = out + x @ w
+        x = nxt
+    return out
+
+
+def _body(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def build(mesh, specs):
+    body = functools.partial(_body, axis="x")
+    return shard_map(body, mesh=mesh, in_specs=specs, out_specs=specs,
+                     axis_names=("x",))

@@ -1,0 +1,87 @@
+"""collective-order fixture: every error leg of the rule fires once.
+
+Planted findings (5 total — all errors):
+  1. ERROR ``biased_ring`` — ppermute issued under ``if idx == 0``
+     where ``idx`` flows from ``axis_index``: devices disagree on
+     whether the collective is issued at all (SPMD deadlock).
+  2. ERROR ``drain`` — psum inside a ``while`` loop: the trip count is
+     value-divergent, so devices can issue different collective
+     schedules.
+  3. ERROR ``collide`` — literal ppermute table with a duplicated
+     source: two sends target the same edge and the permute deadlocks.
+  4. ERROR ``ring_unguarded`` — declares seam role "entry" like
+     ``ring_guarded`` but permutes on every hop (tp) instead of
+     between hops (tp-1): the fused and composed lowerings of one
+     role have drifted apart.
+  5. ERROR ``_mismatched_body`` — the binding shard_map declares axis
+     "x" but the body (bound via functools.partial) reduces over "y":
+     the axis never exists inside the program.
+
+The module carries a ``__remote_dma_seams__`` marker, so the
+unregistered-module WARNING leg must NOT fire here (see the
+tmp_path test for that leg).
+"""
+
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+__remote_dma_seams__ = {
+    "ring_guarded": {
+        "role": "entry",
+        "payload": "num_slots // tp * hidden * itemsize"},
+    "ring_unguarded": {
+        "role": "entry",
+        "payload": "num_slots // tp * hidden * itemsize"},
+}
+
+
+def biased_ring(x, axis_name, tp):
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % tp) for i in range(tp)]
+    if idx == 0:                       # divergent: only device 0 sends
+        x = jax.lax.ppermute(x, axis_name, perm)
+    return x
+
+
+def drain(x, axis_name, n):
+    while n > 0:                       # value-divergent trip count
+        x = jax.lax.psum(x, axis_name)
+        n -= 1
+    return x
+
+
+def collide(x, axis_name):
+    # two sends from device 0: not a permutation
+    return jax.lax.ppermute(x, axis_name, [(0, 1), (0, 0)])
+
+
+def ring_guarded(x, w, axis_name, tp):
+    perm = [(i, (i + 1) % tp) for i in range(tp)]
+    out = x @ w
+    for hop in range(tp):
+        nxt = jax.lax.ppermute(x, axis_name, perm) \
+            if hop < tp - 1 else None  # tp-1 hops: the reference form
+        out = out + x @ w
+        x = nxt
+    return out
+
+
+def ring_unguarded(x, w, axis_name, tp):
+    perm = [(i, (i + 1) % tp) for i in range(tp)]
+    out = x @ w
+    for hop in range(tp):              # tp hops: drifted from the role
+        x = jax.lax.ppermute(x, axis_name, perm)
+        out = out + x @ w
+    return out
+
+
+def _mismatched_body(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def build_mismatched(mesh, specs):
+    body = functools.partial(_mismatched_body, axis="y")
+    return shard_map(body, mesh=mesh, in_specs=specs, out_specs=specs,
+                     axis_names=("x",))

@@ -48,10 +48,10 @@ def test_rule_catalogue_is_complete():
                      "use-after-donate", "resource-lifecycle",
                      "recompile-shape", "dtype-flow",
                      "sharding-consistency", "compile-surface",
-                     "memory-budget"}
-    # ISSUE 19: the catalogue is now thirteen rules — a checker silently
+                     "memory-budget", "collective-order"}
+    # ISSUE 20: the catalogue is now fourteen rules — a checker silently
     # dropping out of default_checkers() must fail loudly
-    assert len(names) == 13 and len(default_checkers()) == 13
+    assert len(names) == 14 and len(default_checkers()) == 14
 
 
 # ------------------------------------------------- per-rule fixture pairs
@@ -1632,6 +1632,178 @@ def test_memory_surface_build_skipped_for_inert_files(tmp_path):
     assert gm.BUILD_COUNT == before + 1
 
 
+# ------------------------------------------- collective-order (ISSUE 20)
+
+def test_collective_order_positive():
+    """ISSUE 20: every error leg of the collective-order rule fires
+    exactly once on the planted fixture — divergent `if`, divergent
+    `while`, non-permutation table, fused/composed schedule drift, and
+    an axis the binding shard_map never declares."""
+    from paddle_tpu.tools.analysis import ERROR
+    res = run_rule("comm_pos.py", "collective-order")
+    found = only_rule(res, "collective-order")
+    assert len(found) == 5, [f.format() for f in found]
+    assert all(f.severity == ERROR for f in found)
+    msgs = " | ".join(f.message for f in found)
+    assert "value-divergent `if`" in msgs
+    assert "`while` loop" in msgs
+    assert "not a permutation" in msgs
+    assert "hop-equivalent" in msgs
+    assert "never exists inside this program" in msgs
+    # every comm finding carries the schedule-evidence property bag
+    for f in found:
+        props = dict(f.props)
+        assert props.get("op") and props.get("hops"), f.format()
+
+
+def test_collective_order_negative():
+    """The blessed forms: guarded neighbour ring, declared seam marker,
+    shard_map body reducing over the axis the program binds — zero
+    findings."""
+    res = run_rule("comm_neg.py", "collective-order")
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_collective_order_unregistered_module_warns(tmp_path):
+    """A module that issues collectives without being a registered comm
+    module and without a ``__remote_dma_seams__`` marker gets exactly
+    one WARNING (at its first collective site)."""
+    from paddle_tpu.tools.analysis import WARNING
+    probe = tmp_path / "comm_probe.py"
+    probe.write_text(
+        "import jax\n\n\ndef ring(x, axis_name, tp):\n"
+        "    perm = [(i, (i + 1) % tp) for i in range(tp)]\n"
+        "    return jax.lax.ppermute(x, axis_name, perm)\n")
+    res = run_analysis([str(probe)], root=str(tmp_path),
+                       rules=["collective-order"])
+    found = only_rule(res, "collective-order")
+    assert len(found) == 1, [f.format() for f in found]
+    assert found[0].severity == WARNING
+    assert "__remote_dma_seams__" in found[0].message
+
+
+def test_sarif_collective_order_properties():
+    """Satellite (ISSUE 20): collective-order SARIF results carry
+    ``properties.{op,axis,bytes,hops}`` — CI annotators can show the
+    schedule evidence inline."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftlint.py", "--sarif",
+         "--rule", "collective-order",
+         "tests/fixtures/lint/comm_pos.py"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "collective-order" in rules
+    live = [r for r in run["results"] if "suppressions" not in r]
+    assert len(live) == 5
+    assert all(r["level"] == "error" for r in live)
+    for r in live:
+        assert r["properties"].get("op"), r
+        assert r["properties"].get("hops"), r
+
+
+def test_cli_comm_manifest_deterministic_and_pinned():
+    """Tentpole artifact (ISSUE 20): ``--comm`` emits byte-identical
+    JSON across runs, both ring drivers' ppermute seams are enumerated
+    with per-hop payload bytes at the flagship reference env, the fused
+    (Pallas) and composed (XLA) TP decode paths are hop-equivalent, and
+    the order-safety proof holds over the whole scan scope."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmd = [sys.executable, "scripts/graftlint.py", "--comm"]
+    a = subprocess.run(cmd, cwd=str(REPO_ROOT), capture_output=True,
+                       text=True, timeout=600, env=env)
+    b = subprocess.run(cmd, cwd=str(REPO_ROOT), capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert a.returncode == 0, a.stdout + a.stderr
+    assert b.returncode == 0, b.stdout + b.stderr
+    assert a.stdout == b.stdout      # deterministic artifact
+    m = json.loads(a.stdout)
+    assert m["graftcomm_version"] == 1
+    assert m["order_safety"]["ok"], m["order_safety"]
+    seams = m["seams"]
+    # the two ring-driver families: composed XLA + fused Pallas seam
+    # sites, each with a payload ladder at the reference env
+    assert {"paddle_tpu.kernels.collective_matmul.allgather_matmul",
+            "paddle_tpu.kernels.collective_matmul.matmul_reduce_scatter",
+            "paddle_tpu.kernels.decode_block_tp.ring_entry_matmul",
+            "paddle_tpu.kernels.decode_block_tp.ring_exit_matmul"} \
+        <= set(seams)
+    # the travelling activation shard [num_slots/tp, hidden] at bf16:
+    # 8/tp * 768 * 2 bytes per hop
+    for q in ("paddle_tpu.kernels.collective_matmul.allgather_matmul",
+              "paddle_tpu.kernels.decode_block_tp.ring_entry_matmul"):
+        assert seams[q]["per_hop_payload_bytes"] == {
+            "tp=2": 6144, "tp=4": 3072, "tp=8": 1536}, q
+        assert seams[q]["ppermute_sites"], q
+    roles = m["roles"]
+    assert roles["entry"]["equivalent"] and roles["exit"]["equivalent"]
+    assert roles["entry"]["signature"] == ["ppermute:tp-1:neighbor"]
+    # the manifest names the programs each seam rides in: the TP decode
+    # and verify shard_maps, with every collective's axis resolved to
+    # the binding mesh axis
+    progs = m["programs"]
+    assert any(p["body"] == "paddle_tpu.serving.tp._tp_decode_body"
+               for p in progs.values())
+    assert any(p["body"] == "paddle_tpu.serving.tp._tp_verify_body"
+               for p in progs.values())
+    for p in progs.values():
+        for s in p["schedule"]:
+            assert s["axis"] == "mp", s
+    entry_seam = seams[
+        "paddle_tpu.kernels.collective_matmul.allgather_matmul"]
+    assert entry_seam["programs"], "seam not attributed to any program"
+    # ring mirror: the integer walk tables for every reference tp
+    assert set(m["ring_mirror"]) == {"tp=2", "tp=4", "tp=8"}
+    for row in m["ring_mirror"].values():
+        assert row["is_permutation"]
+    # fused and composed layer paths traverse the same role sequence
+    lp = m["layer_paths"]
+    assert lp["paddle_tpu.serving.tp._tp_layer"]["roles"] == \
+        lp["paddle_tpu.kernels.decode_block_tp.tp_fused_block_layer"][
+            "roles"] == ["entry", "exit", "entry", "exit"]
+
+
+def test_cache_version_tracks_comm_modules():
+    """Satellite (ISSUE 20): registering a comm module moves the
+    parse-cache version — cached results derived under the old comm
+    tables must never be served."""
+    from paddle_tpu.tools.analysis import register_comm_module
+    from paddle_tpu.tools.analysis.comm import _EXTRA_COMM_MODULES
+    from paddle_tpu.tools.analysis.walker import _cache_version
+    v0 = _cache_version()
+    register_comm_module("zz.probe_comm")
+    try:
+        assert _cache_version() != v0
+    finally:
+        _EXTRA_COMM_MODULES.remove("zz.probe_comm")
+    assert _cache_version() == v0
+
+
+def test_comm_surface_build_skipped_for_inert_files(tmp_path):
+    """Satellite (ISSUE 20): the collective-order token gate mirrors the
+    compile-surface one — an inert file on the hot globs never pays for
+    comm-surface construction in a ``--changed`` run."""
+    from paddle_tpu.tools.analysis import comm as gc
+    inert = tmp_path / "comm_inert.py"   # hot glob, no tokens
+    inert.write_text("def f():\n    return 1\n")
+    before = gc.BUILD_COUNT
+    run_analysis([str(inert)], root=str(tmp_path),
+                 rules=["collective-order"])
+    assert gc.BUILD_COUNT == before, \
+        "comm surface built for a file with no collective tokens"
+    probe = tmp_path / "comm_live.py"
+    probe.write_text(
+        "import jax\n\n__remote_dma_seams__ = {}\n\n\n"
+        "def g(x, axis_name):\n"
+        "    return jax.lax.psum(x, axis_name)\n")
+    run_analysis([str(probe)], root=str(tmp_path),
+                 rules=["collective-order"])
+    assert gc.BUILD_COUNT == before + 1
+
+
 def test_scan_performance_budget_with_warm_cache():
     """Full-scope scan must stay pre-commit-viable: one timed run under
     a generous wall-clock bound (catches accidental O(files^2)
@@ -1642,7 +1814,8 @@ def test_scan_performance_budget_with_warm_cache():
     ``--manifest`` emission rides inside the same 90s pin.  ISSUE 19
     adds graftmem: the ``--memory`` capacity-manifest emission rides
     inside the SAME budget — byte accounting must stay pre-commit
-    cheap."""
+    cheap.  ISSUE 20 adds graftcomm: the ``--comm`` seam-manifest
+    emission rides inside the same 90s pin too."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     cmd = [sys.executable, "scripts/graftlint.py"]
     t0 = time.perf_counter()
@@ -1665,6 +1838,13 @@ def test_scan_performance_budget_with_warm_cache():
     dt_mem = time.perf_counter() - t2
     assert mem.returncode == 0, mem.stdout + mem.stderr
     json.loads(mem.stdout)    # still a valid artifact under timing
-    assert dt + dt_man + dt_mem < 90.0, (
+    t3 = time.perf_counter()
+    comm = subprocess.run(cmd + ["--comm"], cwd=str(REPO_ROOT),
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    dt_comm = time.perf_counter() - t3
+    assert comm.returncode == 0, comm.stdout + comm.stderr
+    json.loads(comm.stdout)   # still a valid artifact under timing
+    assert dt + dt_man + dt_mem + dt_comm < 90.0, (
         f"warm full-scope scan + manifests took {dt:.1f}s + "
-        f"{dt_man:.1f}s + {dt_mem:.1f}s (budget 90s)")
+        f"{dt_man:.1f}s + {dt_mem:.1f}s + {dt_comm:.1f}s (budget 90s)")

@@ -360,4 +360,12 @@ def test_serving_tp_bench_row_smoke():
         assert "ttft_p50_ms" in r and "ttft_p99_ms" in r
         assert r["parity_vs_tp1"] is True
         assert 0 < r["scaling_efficiency"] or r["tp"] == 1
+        # ISSUE 20: tp>1 rows quote the statically-proved per-hop ring
+        # payload from the graftcomm seam manifest next to the measured
+        # collective latency
+        if r["tp"] > 1:
+            assert r["comm_note"] and "B/hop" in r["comm_note"], r
+            assert "graftcomm" in r["comm_note"]
+        else:
+            assert r["comm_note"] is None
     assert row["collective_fusion"]["max_abs_diff"] < 1e-4
